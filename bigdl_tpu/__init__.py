@@ -1,0 +1,22 @@
+"""bigdl_tpu — a TPU-native distributed deep-learning framework.
+
+A ground-up JAX/XLA/pjit/pallas re-design of the capabilities of BigDL
+(the Spark/Scala distributed DL library; see SURVEY.md): a Torch-style
+layer/criterion zoo with containers and graph execution, data-parallel
+synchronous SGD over a TPU mesh (XLA collectives over ICI/DCN replacing
+BigDL's Spark-BlockManager parameter server), composable host-side data
+pipelines, a full optimizer/LR-schedule suite with triggers and validation
+metrics, checkpoint/resume, observability, and Keras-style high-level APIs.
+
+Nothing here is a port: BigDL's hand-written autograd
+(reference: spark/dl/.../nn/abstractnn/AbstractModule.scala:58) is replaced
+by jax.grad over pure module applications; its MKL/MKL-DNN native kernels
+(reference: tensor/TensorNumeric.scala, nn/mkldnn/) are replaced by XLA
+fusion inside one jitted train step; its AllReduceParameter BlockManager
+shuffle (reference: parameters/AllReduceParameter.scala:84) is replaced by
+`lax.psum`/sharding-propagated collectives over a `jax.sharding.Mesh`.
+"""
+
+__version__ = "0.1.0"
+
+from bigdl_tpu.core.engine import Engine  # noqa: F401

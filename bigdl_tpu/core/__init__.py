@@ -1,0 +1,5 @@
+from bigdl_tpu.core.engine import Engine
+from bigdl_tpu.core.random import RandomGenerator
+from bigdl_tpu.core.table import Table, T
+
+__all__ = ["Engine", "RandomGenerator", "Table", "T"]

@@ -1,0 +1,74 @@
+"""Typed runtime configuration.
+
+BigDL scatters configuration across `bigdl.*` Java system properties,
+SparkConf injection, and per-model scopt parsers (reference:
+utils/Engine.scala:190-260, survey §5.6).  Here all runtime knobs live in one
+typed dataclass populated from environment variables with a single prefix,
+so every subsystem reads the same source of truth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+_PREFIX = "BIGDL_TPU_"
+
+
+def _env(name: str, default: str) -> str:
+    return os.environ.get(_PREFIX + name, default)
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(_env(name, str(default)))
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    return _env(name, str(default)).lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Runtime knobs, analogous to the `bigdl.*` property namespace.
+
+    reference: utils/Engine.scala:190-260 (localMode, engineType, coreNumber,
+    check.singleton), optim/DistriOptimizer.scala:856-857 (failure.retryTimes).
+    """
+
+    # Execution platform: "tpu", "cpu", "auto". "auto" takes whatever
+    # jax.devices() offers (the analogue of EngineType MklBlas|MklDnn
+    # selection, utils/Engine.scala:37-38 — on TPU there is one engine: XLA).
+    platform: str = "auto"
+    # Default compute dtype policy: "float32" or "bfloat16" (replaces BigDL's
+    # fp16 wire compression, parameters/FP16CompressedTensor.scala — on TPU
+    # bf16 is native and the compression layer disappears into dtype choice).
+    compute_dtype: str = "float32"
+    # Failure-retry budget for the training loop
+    # (reference: optim/DistriOptimizer.scala:855-935).
+    failure_retry_times: int = 5
+    failure_retry_interval_s: int = 120
+    # Multi-host coordination (replaces Spark driver/executor bring-up).
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+    # Logging
+    log_level: str = "INFO"
+    # Seed for the global RandomGenerator (utils/RandomGenerator.scala:50-56).
+    seed: int = 1
+
+    @staticmethod
+    def from_env() -> "EngineConfig":
+        cfg = EngineConfig(
+            platform=_env("PLATFORM", "auto"),
+            compute_dtype=_env("COMPUTE_DTYPE", "float32"),
+            failure_retry_times=_env_int("FAILURE_RETRY_TIMES", 5),
+            failure_retry_interval_s=_env_int("FAILURE_RETRY_INTERVAL_S", 120),
+            log_level=_env("LOG_LEVEL", "INFO"),
+            seed=_env_int("SEED", 1),
+        )
+        if _PREFIX + "COORDINATOR_ADDRESS" in os.environ:
+            cfg.coordinator_address = os.environ[_PREFIX + "COORDINATOR_ADDRESS"]
+            cfg.num_processes = _env_int("NUM_PROCESSES", 1)
+            cfg.process_id = _env_int("PROCESS_ID", 0)
+        return cfg

@@ -1,0 +1,188 @@
+"""Engine — process/mesh bring-up for the TPU runtime.
+
+BigDL's `Engine` singleton (reference: utils/Engine.scala:41) discovers
+executor/core topology from SparkConf, owns thread pools, and binds MKL/OMP
+affinity.  On TPU none of that exists: XLA owns intra-chip parallelism, and
+inter-chip parallelism is expressed as a `jax.sharding.Mesh` over which
+jitted programs are partitioned.  So this Engine's job is:
+
+  * device discovery (the analogue of `sparkExecutorAndCore`,
+    utils/Engine.scala:446-465),
+  * multi-host coordination (`jax.distributed.initialize` replaces one Spark
+    executor per node, survey §5.8),
+  * mesh construction with named axes (data/model/sequence/pipeline/expert)
+    laid out so collectives ride ICI before DCN,
+  * the global config + RNG seed plumbing.
+
+There are no thread pools to manage — `Engine.default`/`Engine.model`
+(utils/Engine.scala:324-334) have no TPU equivalent because replica fan-out
+happens inside one compiled program, not across JVM threads.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from bigdl_tpu.core.config import EngineConfig
+
+logger = logging.getLogger("bigdl_tpu")
+
+# Canonical mesh axis names, in the order they should be laid out over the
+# device topology.  Data-parallel is outermost (maps to DCN across slices),
+# model/tensor axes innermost (maps to ICI neighbours).
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQUENCE = "sequence"
+AXIS_PIPELINE = "pipeline"
+AXIS_EXPERT = "expert"
+
+
+class Engine:
+    """Singleton runtime. Call `Engine.init()` once per process before use."""
+
+    _lock = threading.Lock()
+    _initialized = False
+    _config: Optional[EngineConfig] = None
+    _mesh: Optional[Mesh] = None
+
+    @classmethod
+    def init(
+        cls,
+        config: Optional[EngineConfig] = None,
+        mesh_shape: Optional[Dict[str, int]] = None,
+    ) -> None:
+        """Bring up the runtime.
+
+        The analogue of `Engine.init` (utils/Engine.scala:105): resolves the
+        device topology and (optionally) joins a multi-host cluster.  Unlike
+        the reference there is no per-executor re-init inside tasks
+        (optim/DistriOptimizer.scala:581) — every process runs this once.
+        """
+        with cls._lock:
+            if cls._initialized:
+                return
+            cfg = config or EngineConfig.from_env()
+            logging.basicConfig(level=getattr(logging, cfg.log_level, logging.INFO))
+            if cfg.coordinator_address is not None:
+                # Multi-host bring-up: the moral equivalent of Spark executor
+                # registration (survey §5.8 "one JAX process per TPU host
+                # replaces one Spark executor per node").  Must run before ANY
+                # backend-initializing jax call (including process_count), so
+                # the only guard is the config itself.
+                jax.distributed.initialize(
+                    coordinator_address=cfg.coordinator_address,
+                    num_processes=cfg.num_processes,
+                    process_id=cfg.process_id,
+                )
+            cls._config = cfg
+            cls._mesh = cls._build_mesh(mesh_shape)
+            cls._initialized = True
+            logger.info(
+                "Engine initialized: %d device(s) on platform %s, mesh %s",
+                jax.device_count(),
+                jax.devices()[0].platform,
+                dict(zip(cls._mesh.axis_names, cls._mesh.devices.shape)),
+            )
+
+    @classmethod
+    def reset(cls) -> None:
+        """Tear down (test helper)."""
+        with cls._lock:
+            cls._initialized = False
+            cls._config = None
+            cls._mesh = None
+
+    # ------------------------------------------------------------------
+    # Topology
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def config(cls) -> EngineConfig:
+        cls.init()
+        assert cls._config is not None
+        return cls._config
+
+    @classmethod
+    def node_number(cls) -> int:
+        """Number of host processes (BigDL: executor count)."""
+        return jax.process_count()
+
+    @classmethod
+    def core_number(cls) -> int:
+        """Number of accelerator chips (BigDL: total cores across executors,
+        utils/Engine.scala:446-465 — on TPU the unit of data parallelism is
+        the chip, not the CPU core)."""
+        return jax.device_count()
+
+    @classmethod
+    def mesh(cls) -> Mesh:
+        cls.init()
+        assert cls._mesh is not None
+        return cls._mesh
+
+    @classmethod
+    def set_mesh(cls, mesh: Mesh) -> None:
+        cls.init()
+        cls._mesh = mesh
+
+    # ------------------------------------------------------------------
+    # Mesh construction
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _build_mesh(mesh_shape: Optional[Dict[str, int]]) -> Mesh:
+        if mesh_shape is None:
+            mesh_shape = {AXIS_DATA: jax.device_count()}
+        return Engine.build_mesh(**mesh_shape)
+
+    @staticmethod
+    def build_mesh(**axes: int) -> Mesh:
+        """Build a named-axis device mesh.
+
+        Axis sizes must multiply to the device count; `-1` means "whatever is
+        left".  Uses `mesh_utils.create_device_mesh` so that the innermost
+        (rightmost) axes land on ICI-adjacent devices — put `model`/
+        `sequence` axes last and `data` first so gradient allreduce crosses
+        DCN only on the data axis.
+        """
+        names = list(axes.keys())
+        sizes = list(axes.values())
+        n = jax.device_count()
+        if sizes.count(-1) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        if -1 in sizes:
+            known = int(np.prod([s for s in sizes if s != -1])) if len(sizes) > 1 else 1
+            if n % known != 0:
+                raise ValueError(f"device count {n} not divisible by {known}")
+            sizes[sizes.index(-1)] = n // known
+        if int(np.prod(sizes)) != n:
+            raise ValueError(f"mesh {dict(zip(names, sizes))} != device count {n}")
+        try:
+            from jax.experimental import mesh_utils
+
+            devices = mesh_utils.create_device_mesh(tuple(sizes))
+        except Exception:  # pragma: no cover - non-uniform topologies
+            devices = np.array(jax.devices()).reshape(tuple(sizes))
+        return Mesh(devices, tuple(names))
+
+    # ------------------------------------------------------------------
+    # Virtual-device helpers (testing the multi-chip path on one host —
+    # the analogue of BigDL testing BlockManager allreduce with
+    # SparkContext("local[N]"), survey §4)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def force_host_device_count(n: int) -> None:
+        """Must be called before jax backends initialize (e.g. in conftest)."""
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -1,0 +1,51 @@
+"""Deterministic RNG plumbing.
+
+BigDL's `RandomGenerator` is a per-thread mersenne twister with a settable
+global seed (reference: utils/RandomGenerator.scala:50-56).  JAX uses
+counter-based threefry keys; this module provides the same "set one seed,
+everything downstream is reproducible" ergonomics by owning a root key and
+handing out deterministically derived subkeys (fold_in by purpose/name), so
+per-replica/per-layer streams are independent without any mutable state on
+device.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+
+class RandomGenerator:
+    """Process-global seed registry (functional keys underneath)."""
+
+    _lock = threading.Lock()
+    _seed: int = 1
+    _counter: int = 0
+
+    @classmethod
+    def set_seed(cls, seed: int) -> None:
+        with cls._lock:
+            cls._seed = seed
+            cls._counter = 0
+
+    @classmethod
+    def get_seed(cls) -> int:
+        return cls._seed
+
+    @classmethod
+    def next_key(cls) -> jax.Array:
+        """A fresh key; successive calls yield independent streams."""
+        with cls._lock:
+            cls._counter += 1
+            c = cls._counter
+        return jax.random.fold_in(jax.random.PRNGKey(cls._seed), c)
+
+    @classmethod
+    def key_for(cls, name: str, step: Optional[int] = None) -> jax.Array:
+        """Deterministic named stream (e.g. 'dropout', 'shuffle')."""
+        key = jax.random.fold_in(jax.random.PRNGKey(cls._seed), hash(name) & 0x7FFFFFFF)
+        if step is not None:
+            key = jax.random.fold_in(key, step)
+        return key

@@ -1,0 +1,104 @@
+"""Table — the heterogeneous activity container.
+
+BigDL's `Activity` union is `Tensor | Table` where `Table` is a lua-style
+1-indexed int/any-keyed map built with `T(...)` (reference:
+nn/abstractnn/Activity.scala, utils/Table.scala).  Here a Table is a jax
+pytree node, so it flows through jit/grad/vmap transparently; layers that
+take/return multiple activities (ConcatTable, CAddTable, LSTM hidden state)
+use it exactly where the reference uses Table.
+
+Indexing is 1-based via `table[1]` to preserve reference call-site semantics,
+while iteration order is insertion order.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator
+
+import jax
+
+
+class Table:
+    """Ordered, 1-indexed container of activities. Registered as a pytree."""
+
+    def __init__(self, *items: Any, **named: Any):
+        self._dict: Dict[Any, Any] = {}
+        for i, item in enumerate(items):
+            self._dict[i + 1] = item
+        self._dict.update(named)
+
+    # -- mapping interface ------------------------------------------------
+    def __getitem__(self, key: Any) -> Any:
+        return self._dict[key]
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        self._dict[key] = value
+
+    def __contains__(self, key: Any) -> bool:
+        return key in self._dict
+
+    def __len__(self) -> int:
+        return len(self._dict)
+
+    def __iter__(self) -> Iterator[Any]:
+        return iter(self._dict.values())
+
+    def keys(self):
+        return self._dict.keys()
+
+    def values(self):
+        return self._dict.values()
+
+    def items(self):
+        return self._dict.items()
+
+    def insert(self, value: Any) -> "Table":
+        """Append at the next integer slot (reference Table.insert)."""
+        i = 1
+        while i in self._dict:
+            i += 1
+        self._dict[i] = value
+        return self
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{k}: {v!r}" for k, v in self._dict.items())
+        return f"T({inner})"
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, Table) and self._dict.keys() == other._dict.keys() and all(
+            _eq(self._dict[k], other._dict[k]) for k in self._dict
+        )
+
+    def __hash__(self):  # pytree nodes must not rely on hashing contents
+        return id(self)
+
+
+def _eq(a: Any, b: Any) -> bool:
+    try:
+        import numpy as np
+
+        if hasattr(a, "shape") or hasattr(b, "shape"):
+            return bool(np.array_equal(a, b))
+    except Exception:
+        pass
+    return bool(a == b)
+
+
+def T(*items: Any, **named: Any) -> Table:
+    """Constructor matching the reference's `T(...)` (utils/Table.scala)."""
+    return Table(*items, **named)
+
+
+def _table_flatten(t: Table):
+    keys = tuple(t._dict.keys())
+    return tuple(t._dict[k] for k in keys), keys
+
+
+def _table_unflatten(keys, children) -> Table:
+    t = Table()
+    for k, c in zip(keys, children):
+        t._dict[k] = c
+    return t
+
+
+jax.tree_util.register_pytree_node(Table, _table_flatten, _table_unflatten)

@@ -1,0 +1,135 @@
+"""Torch-style NN module zoo, re-designed functionally for JAX/XLA.
+
+The reference's `AbstractModule` carries hand-written
+`updateOutput/updateGradInput/accGradParameters` per layer (reference:
+nn/abstractnn/AbstractModule.scala:58).  Here every module is a pure function
+`apply(params, state, input) -> (output, state)`; gradients come from
+`jax.grad` over the whole model, and the entire forward+backward+update step
+lowers to one XLA program — the role BigDL's mkldnn fused `DnnGraph` plays
+(nn/mkldnn/DnnGraph.scala:314-415) is played by XLA fusion for free.
+"""
+
+from bigdl_tpu.nn.module import Module, Container, Sequential, Node, Input
+from bigdl_tpu.nn.graph import Graph
+from bigdl_tpu.nn import init
+from bigdl_tpu.nn.linear import Linear, SparseLinear
+from bigdl_tpu.nn.conv import (
+    SpatialConvolution,
+    SpatialDilatedConvolution,
+    SpatialSeparableConvolution,
+    SpatialFullConvolution,
+    TemporalConvolution,
+)
+from bigdl_tpu.nn.pooling import (
+    SpatialMaxPooling,
+    SpatialAveragePooling,
+    TemporalMaxPooling,
+    GlobalAveragePooling2D,
+)
+from bigdl_tpu.nn.norm import (
+    BatchNormalization,
+    SpatialBatchNormalization,
+    LayerNormalization,
+    Normalize,
+    SpatialCrossMapLRN,
+)
+from bigdl_tpu.nn.activation import (
+    ReLU,
+    ReLU6,
+    Tanh,
+    Sigmoid,
+    SoftMax,
+    LogSoftMax,
+    ELU,
+    GELU,
+    SiLU,
+    LeakyReLU,
+    PReLU,
+    HardTanh,
+    HardSigmoid,
+    SoftPlus,
+    SoftSign,
+)
+from bigdl_tpu.nn.dropout import Dropout, GaussianDropout, GaussianNoise
+from bigdl_tpu.nn.embedding import LookupTable
+from bigdl_tpu.nn.reshape import (
+    Reshape,
+    View,
+    Flatten,
+    Squeeze,
+    Unsqueeze,
+    Transpose,
+    Contiguous,
+    Identity,
+    Select,
+    Narrow,
+    SplitTable,
+    JoinTable,
+    Padding,
+)
+from bigdl_tpu.nn.arithmetic import (
+    CAddTable,
+    CSubTable,
+    CMulTable,
+    CDivTable,
+    CMaxTable,
+    CMinTable,
+    CAveTable,
+    MM,
+    Mul,
+    Add,
+    CMul,
+    CAdd,
+    Scale,
+    MulConstant,
+    AddConstant,
+    Power,
+    Sqrt,
+    Square,
+    Log,
+    Exp,
+    Abs,
+    Clamp,
+    Mean,
+    Sum,
+    Max,
+    Min,
+    Cosine,
+    DotProduct,
+)
+from bigdl_tpu.nn.table_ops import ConcatTable, ParallelTable, MapTable, SelectTable, FlattenTable
+from bigdl_tpu.nn.concat import Concat, Bottle
+from bigdl_tpu.nn.recurrent import (
+    RnnCell,
+    LSTMCell,
+    GRUCell,
+    LSTM,
+    GRU,
+    RnnLayer,
+    Recurrent,
+    BiRecurrent,
+    TimeDistributed,
+)
+from bigdl_tpu.nn.criterion import (
+    Criterion,
+    ClassNLLCriterion,
+    CrossEntropyCriterion,
+    MSECriterion,
+    AbsCriterion,
+    BCECriterion,
+    BCEWithLogitsCriterion,
+    SmoothL1Criterion,
+    MultiLabelSoftMarginCriterion,
+    MarginCriterion,
+    HingeEmbeddingCriterion,
+    CosineEmbeddingCriterion,
+    KLDCriterion,
+    DiceCoefficientCriterion,
+    L1Cost,
+    MultiCriterion,
+    ParallelCriterion,
+    TimeDistributedCriterion,
+    ClassSimplexCriterion,
+    DistKLDivCriterion,
+    SoftmaxWithCriterion,
+)

@@ -1,0 +1,132 @@
+"""Activation layers.
+
+Reference: nn/ReLU.scala, nn/Tanh.scala, nn/Sigmoid.scala, nn/SoftMax.scala,
+nn/LogSoftMax.scala, nn/ELU.scala, nn/LeakyReLU.scala, nn/PReLU.scala,
+nn/HardTanh.scala, nn/SoftPlus.scala, nn/SoftSign.scala, nn/ReLU6.scala.
+All are elementwise VPU ops that XLA fuses into neighbouring matmuls/convs;
+the reference's in-place (`ip`) variants are meaningless under XLA (buffer
+reuse is the compiler's job).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+class _Elementwise(Module):
+    def _fn(self, x):
+        raise NotImplementedError
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return self._fn(x), state
+
+
+class ReLU(_Elementwise):
+    def __init__(self, ip: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+
+    def _fn(self, x):
+        return jax.nn.relu(x)
+
+
+class ReLU6(_Elementwise):
+    def _fn(self, x):
+        return jnp.clip(x, 0.0, 6.0)
+
+
+class Tanh(_Elementwise):
+    def _fn(self, x):
+        return jnp.tanh(x)
+
+
+class Sigmoid(_Elementwise):
+    def _fn(self, x):
+        return jax.nn.sigmoid(x)
+
+
+class SoftMax(_Elementwise):
+    def _fn(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+
+class LogSoftMax(_Elementwise):
+    def _fn(self, x):
+        return jax.nn.log_softmax(x, axis=-1)
+
+
+class ELU(_Elementwise):
+    def __init__(self, alpha: float = 1.0, ip: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self.alpha = alpha
+
+    def _fn(self, x):
+        return jax.nn.elu(x, alpha=self.alpha)
+
+
+class GELU(_Elementwise):
+    def _fn(self, x):
+        return jax.nn.gelu(x)
+
+
+class SiLU(_Elementwise):
+    def _fn(self, x):
+        return jax.nn.silu(x)
+
+
+class LeakyReLU(_Elementwise):
+    def __init__(self, negval: float = 0.01, ip: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self.negval = negval
+
+    def _fn(self, x):
+        return jax.nn.leaky_relu(x, negative_slope=self.negval)
+
+
+class PReLU(Module):
+    """Learnable negative slope per channel. reference: nn/PReLU.scala."""
+
+    def __init__(self, n_output_plane: int = 0, name: Optional[str] = None):
+        super().__init__(name)
+        self.n_output_plane = n_output_plane  # 0 = single shared slope
+
+    def build(self, rng, input_shape):
+        n = self.n_output_plane if self.n_output_plane > 0 else 1
+        return {"weight": jnp.full((n,), 0.25, jnp.float32)}, {}, input_shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        w = params["weight"]
+        return jnp.where(x >= 0, x, x * w), state
+
+
+class HardTanh(_Elementwise):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 ip: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self.min_value, self.max_value = min_value, max_value
+
+    def _fn(self, x):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class HardSigmoid(_Elementwise):
+    def _fn(self, x):
+        return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+class SoftPlus(_Elementwise):
+    def __init__(self, beta: float = 1.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.beta = beta
+
+    def _fn(self, x):
+        return jax.nn.softplus(self.beta * x) / self.beta
+
+
+class SoftSign(_Elementwise):
+    def _fn(self, x):
+        return x / (1.0 + jnp.abs(x))

@@ -1,0 +1,285 @@
+"""Elementwise/table arithmetic layers.
+
+Reference: nn/CAddTable.scala and friends (CSubTable, CMulTable, CDivTable,
+CMaxTable, CMinTable, CAveTable), nn/MM.scala, nn/Mul.scala, nn/Add.scala,
+nn/CMul.scala, nn/CAdd.scala, nn/Scale.scala, nn/MulConstant.scala,
+nn/AddConstant.scala, nn/Power.scala, nn/Sqrt.scala, nn/Square.scala,
+nn/Log.scala, nn/Exp.scala, nn/Abs.scala, nn/Clamp.scala, nn/Mean.scala,
+nn/Sum.scala, nn/Max.scala, nn/Min.scala, nn/Cosine.scala,
+nn/DotProduct.scala.  All fuse into neighbouring ops under XLA.
+
+Table-op inputs are `Table`s (or plain sequences) of tensors.
+"""
+
+from __future__ import annotations
+
+import functools
+import operator
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.table import Table
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.module import Module
+
+
+def _items(x):
+    return list(x) if isinstance(x, (Table, list, tuple)) else [x]
+
+
+class _TableReduce(Module):
+    _op = None
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        items = _items(x)
+        return functools.reduce(type(self)._op, items), state
+
+    def output_shape(self, input_shape):
+        shapes = _items(input_shape)
+        return shapes[0]
+
+
+class CAddTable(_TableReduce):
+    _op = staticmethod(operator.add)
+
+
+class CSubTable(_TableReduce):
+    _op = staticmethod(operator.sub)
+
+
+class CMulTable(_TableReduce):
+    _op = staticmethod(operator.mul)
+
+
+class CDivTable(_TableReduce):
+    _op = staticmethod(operator.truediv)
+
+
+class CMaxTable(_TableReduce):
+    _op = staticmethod(jnp.maximum)
+
+
+class CMinTable(_TableReduce):
+    _op = staticmethod(jnp.minimum)
+
+
+class CAveTable(Module):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        items = _items(x)
+        return sum(items) / len(items), state
+
+
+class MM(Module):
+    """Batched matmul of a 2-tensor Table. reference: nn/MM.scala."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.trans_a, self.trans_b = trans_a, trans_b
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        a, b = _items(x)
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return a @ b, state
+
+
+class Mul(Module):
+    """Single learnable scalar gain. reference: nn/Mul.scala."""
+
+    def build(self, rng, input_shape):
+        return {"weight": jnp.ones((1,), jnp.float32)}, {}, input_shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x * params["weight"], state
+
+
+class Add(Module):
+    """Learnable bias vector. reference: nn/Add.scala."""
+
+    def __init__(self, input_size: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size = input_size
+
+    def build(self, rng, input_shape):
+        return {"bias": jnp.zeros((self.input_size,), jnp.float32)}, {}, input_shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x + params["bias"], state
+
+
+class CMul(Module):
+    """Learnable componentwise gain of given shape. reference: nn/CMul.scala."""
+
+    def __init__(self, size: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def build(self, rng, input_shape):
+        return {"weight": jnp.ones(self.size, jnp.float32)}, {}, input_shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x * params["weight"], state
+
+
+class CAdd(Module):
+    """Learnable componentwise bias of given shape. reference: nn/CAdd.scala."""
+
+    def __init__(self, size: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def build(self, rng, input_shape):
+        return {"bias": jnp.zeros(self.size, jnp.float32)}, {}, input_shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x + params["bias"], state
+
+
+class Scale(Module):
+    """CMul then CAdd. reference: nn/Scale.scala."""
+
+    def __init__(self, size: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def build(self, rng, input_shape):
+        return {"weight": jnp.ones(self.size, jnp.float32),
+                "bias": jnp.zeros(self.size, jnp.float32)}, {}, input_shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x * params["weight"] + params["bias"], state
+
+
+class MulConstant(Module):
+    def __init__(self, scalar: float, ip: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self.scalar = scalar
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x * self.scalar, state
+
+
+class AddConstant(Module):
+    def __init__(self, constant_scalar: float, ip: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self.constant = constant_scalar
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x + self.constant, state
+
+
+class Power(Module):
+    """(shift + scale*x)^power. reference: nn/Power.scala."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return (self.shift + self.scale * x) ** self.power, state
+
+
+class Sqrt(Module):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.sqrt(x), state
+
+
+class Square(Module):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.square(x), state
+
+
+class Log(Module):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.log(x), state
+
+
+class Exp(Module):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.exp(x), state
+
+
+class Abs(Module):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.abs(x), state
+
+
+class Clamp(Module):
+    def __init__(self, min_value: float, max_value: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.min_value, self.max_value = min_value, max_value
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.clip(x, self.min_value, self.max_value), state
+
+
+class _Reduce(Module):
+    def __init__(self, dimension: int = 0, squeeze: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dimension = dimension
+        self.squeeze = squeeze
+
+    _fn = None
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = type(self)._fn(x, axis=self.dimension, keepdims=not self.squeeze)
+        return y, state
+
+    def output_shape(self, input_shape):
+        s = list(input_shape)
+        if self.squeeze:
+            del s[self.dimension]
+        else:
+            s[self.dimension] = 1
+        return tuple(s)
+
+
+class Mean(_Reduce):
+    _fn = staticmethod(jnp.mean)
+
+
+class Sum(_Reduce):
+    _fn = staticmethod(jnp.sum)
+
+
+class Max(_Reduce):
+    _fn = staticmethod(jnp.max)
+
+
+class Min(_Reduce):
+    _fn = staticmethod(jnp.min)
+
+
+class Cosine(Module):
+    """Cosine similarity of rows against learnable weights.
+    reference: nn/Cosine.scala."""
+
+    def __init__(self, input_size: int, output_size: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.output_size = input_size, output_size
+
+    def build(self, rng, input_shape):
+        w = init_mod.RandomUniform()(rng, (self.input_size, self.output_size),
+                                     self.input_size, self.output_size)
+        return {"weight": w}, {}, (input_shape[0], self.output_size)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        w = params["weight"]
+        xn = x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
+        wn = w / jnp.maximum(jnp.linalg.norm(w, axis=0, keepdims=True), 1e-12)
+        return xn @ wn, state
+
+
+class DotProduct(Module):
+    """Rowwise dot of a 2-tensor Table. reference: nn/DotProduct.scala."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        a, b = _items(x)
+        return jnp.sum(a * b, axis=-1), state

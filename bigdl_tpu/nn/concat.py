@@ -1,0 +1,74 @@
+"""Branch-and-concat containers.
+
+Reference: nn/Concat.scala (apply branches to one input, concatenate outputs
+along a dim — the Inception building block), nn/Bottle.scala.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Container, Module, child_rng
+
+
+class Concat(Container):
+    """reference: nn/Concat.scala.  `dimension` is 0-based here; for NHWC
+    feature-map concat use dimension=3 (the reference's NCHW dim 2)."""
+
+    def __init__(self, dimension: int, *modules: Module, name: Optional[str] = None):
+        super().__init__(name)
+        self.dimension = dimension
+        for m in modules:
+            self.add(m)
+
+    def build(self, rng, input_shape):
+        params, state = {}, {}
+        shapes = []
+        for i, (key, m) in enumerate(self.children.items()):
+            p, s, out = m.build(jax.random.fold_in(rng, i), input_shape)
+            params[key], state[key] = p, s
+            shapes.append(out)
+        return params, state, self._concat_shape(shapes)
+
+    def _concat_shape(self, shapes):
+        out = list(shapes[0])
+        out[self.dimension] = sum(s[self.dimension] for s in shapes)
+        return tuple(out)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        outs = []
+        new_state = {}
+        for i, (key, m) in enumerate(self.children.items()):
+            y, new_state[key] = m.apply(params[key], state[key], x,
+                                        training=training, rng=child_rng(rng, i))
+            outs.append(y)
+        return jnp.concatenate(outs, axis=self.dimension), new_state
+
+    def output_shape(self, input_shape):
+        return self._concat_shape([m.output_shape(input_shape) for m in self.children.values()])
+
+
+class Bottle(Container):
+    """Collapse leading dims, apply inner module, restore.
+    reference: nn/Bottle.scala."""
+
+    def __init__(self, module: Module, n_input_dim: int = 2, n_output_dim: int = 2,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.add(module)
+        self.n_input_dim = n_input_dim
+
+    def build(self, rng, input_shape):
+        lead = input_shape[: len(input_shape) - self.n_input_dim + 1]
+        inner_shape = (int(jnp.prod(jnp.array(lead))),) + tuple(input_shape[len(lead):])
+        p, s, out = self[0].build(rng, inner_shape)
+        return {"0": p}, {"0": s}, tuple(lead) + tuple(out[1:])
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        lead = x.shape[: x.ndim - self.n_input_dim + 1]
+        flat = jnp.reshape(x, (-1,) + x.shape[len(lead):])
+        y, s = self[0].apply(params["0"], state["0"], flat, training=training, rng=rng)
+        return jnp.reshape(y, lead + y.shape[1:]), {"0": s}

@@ -1,0 +1,245 @@
+"""Convolution layers (NHWC, HWIO kernels — TPU-native layouts).
+
+Reference: nn/SpatialConvolution.scala (im2col+gemm on MKL),
+nn/SpatialDilatedConvolution.scala, nn/SpatialFullConvolution.scala
+(deconvolution), nn/SpatialSeparableConvolution.scala,
+nn/TemporalConvolution.scala.  All lower to `lax.conv_general_dilated`,
+which XLA maps directly onto the MXU — no im2col materialization.
+
+Padding semantics: BigDL uses explicit (padW, padH) with -1 meaning
+TensorFlow-style SAME (nn/SpatialConvolution.scala scaladoc).  We keep that
+contract: pad = -1 -> "SAME", else explicit symmetric padding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.module import Module
+
+_DIMSPEC_2D = ("NHWC", "HWIO", "NHWC")
+
+
+def _same_pad(size: int, k: int, stride: int, dilation: int):
+    eff = (k - 1) * dilation + 1
+    total = max(0, (-(-size // stride) - 1) * stride + eff - size)
+    return (total // 2, total - total // 2)
+
+
+def _pad2d(pad_h: int, pad_w: int, in_hw=None, kernel=None, stride=None, dilation=(1, 1)):
+    """pad = -1 means TF-style SAME, resolvable per-dim (mixed -1/explicit
+    is supported, matching output_shape's per-dim computation)."""
+    if pad_h == -1 or pad_w == -1:
+        h, w = in_hw
+        kh, kw = kernel
+        sh, sw = stride
+        ph = _same_pad(h, kh, sh, dilation[0]) if pad_h == -1 else (pad_h, pad_h)
+        pw = _same_pad(w, kw, sw, dilation[1]) if pad_w == -1 else (pad_w, pad_w)
+        return [ph, pw]
+    return [(pad_h, pad_h), (pad_w, pad_w)]
+
+
+def _conv_out(size: int, k: int, stride: int, pad: int, dilation: int = 1) -> int:
+    if pad == -1:  # SAME
+        return -(-size // stride)
+    eff = (k - 1) * dilation + 1
+    return (size + 2 * pad - eff) // stride + 1
+
+
+class SpatialConvolution(Module):
+    """2-D convolution.  reference: nn/SpatialConvolution.scala.
+
+    Args mirror the reference: (nInputPlane, nOutputPlane, kernelW, kernelH,
+    strideW, strideH, padW, padH, nGroup, withBias).  Input is NHWC.
+    """
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int, stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, n_group: int = 1,
+                 with_bias: bool = True, weight_init=None, bias_init=None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        assert n_input_plane % n_group == 0 and n_output_plane % n_group == 0
+        self.n_input = n_input_plane
+        self.n_output = n_output_plane
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.n_group = n_group
+        self.with_bias = with_bias
+        self.weight_init = weight_init or init_mod.MsraFiller(False)
+        self.bias_init = bias_init or init_mod.Zeros()
+        self.dilation = (1, 1)
+
+    def set_init_method(self, weight_init=None, bias_init=None):
+        if weight_init is not None:
+            self.weight_init = weight_init
+        if bias_init is not None:
+            self.bias_init = bias_init
+        return self
+
+    def _kernel_shape(self) -> Tuple[int, ...]:
+        kh, kw = self.kernel
+        return (kh, kw, self.n_input // self.n_group, self.n_output)
+
+    def build(self, rng, input_shape):
+        k_w, k_b = jax.random.split(rng)
+        kh, kw = self.kernel
+        fan_in = self.n_input // self.n_group * kh * kw
+        fan_out = self.n_output // self.n_group * kh * kw
+        params = {"weight": self.weight_init(k_w, self._kernel_shape(), fan_in, fan_out)}
+        if self.with_bias:
+            params["bias"] = self.bias_init(k_b, (self.n_output,), fan_in, fan_out)
+        return params, {}, self.output_shape(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = lax.conv_general_dilated(
+            x, params["weight"], window_strides=self.stride,
+            padding=_pad2d(*self.pad, in_hw=x.shape[1:3], kernel=self.kernel,
+                           stride=self.stride, dilation=self.dilation),
+            rhs_dilation=self.dilation,
+            dimension_numbers=_DIMSPEC_2D, feature_group_count=self.n_group,
+        )
+        if self.with_bias:
+            y = y + params["bias"]
+        return y, state
+
+    def output_shape(self, input_shape):
+        n, h, w, _ = input_shape
+        kh, kw = self.kernel
+        oh = _conv_out(h, kh, self.stride[0], self.pad[0], self.dilation[0])
+        ow = _conv_out(w, kw, self.stride[1], self.pad[1], self.dilation[1])
+        return (n, oh, ow, self.n_output)
+
+
+class SpatialDilatedConvolution(SpatialConvolution):
+    """Atrous conv. reference: nn/SpatialDilatedConvolution.scala."""
+
+    def __init__(self, n_input_plane, n_output_plane, kernel_w, kernel_h,
+                 stride_w=1, stride_h=1, pad_w=0, pad_h=0,
+                 dilation_w=1, dilation_h=1, name=None):
+        super().__init__(n_input_plane, n_output_plane, kernel_w, kernel_h,
+                         stride_w, stride_h, pad_w, pad_h, name=name)
+        self.dilation = (dilation_h, dilation_w)
+
+
+class SpatialSeparableConvolution(Module):
+    """Depthwise + pointwise. reference: nn/SpatialSeparableConvolution.scala."""
+
+    def __init__(self, n_input_channel: int, n_output_channel: int,
+                 depth_multiplier: int, k_w: int, k_h: int,
+                 s_w: int = 1, s_h: int = 1, p_w: int = 0, p_h: int = 0,
+                 with_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.depthwise = SpatialConvolution(
+            n_input_channel, n_input_channel * depth_multiplier, k_w, k_h,
+            s_w, s_h, p_w, p_h, n_group=n_input_channel, with_bias=False)
+        self.pointwise = SpatialConvolution(
+            n_input_channel * depth_multiplier, n_output_channel, 1, 1,
+            with_bias=with_bias)
+
+    def build(self, rng, input_shape):
+        k1, k2 = jax.random.split(rng)
+        p1, s1, shape = self.depthwise.build(k1, input_shape)
+        p2, s2, shape = self.pointwise.build(k2, shape)
+        return {"depthwise": p1, "pointwise": p2}, {}, shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y, _ = self.depthwise.apply(params["depthwise"], {}, x)
+        y, _ = self.pointwise.apply(params["pointwise"], {}, y)
+        return y, state
+
+    def output_shape(self, input_shape):
+        return self.pointwise.output_shape(self.depthwise.output_shape(input_shape))
+
+
+class SpatialFullConvolution(Module):
+    """Transposed convolution (deconv). reference:
+    nn/SpatialFullConvolution.scala.  Implemented with lhs dilation so XLA
+    emits a single fused transposed conv."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int, stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, adj_w: int = 0, adj_h: int = 0,
+                 with_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.n_input = n_input_plane
+        self.n_output = n_output_plane
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.adj = (adj_h, adj_w)
+        self.with_bias = with_bias
+        self.weight_init = init_mod.Xavier()
+        self.bias_init = init_mod.Zeros()
+
+    def build(self, rng, input_shape):
+        k_w, k_b = jax.random.split(rng)
+        kh, kw = self.kernel
+        fan_in = self.n_input * kh * kw
+        fan_out = self.n_output * kh * kw
+        params = {"weight": self.weight_init(k_w, (kh, kw, self.n_input, self.n_output),
+                                             fan_in, fan_out)}
+        if self.with_bias:
+            params["bias"] = self.bias_init(k_b, (self.n_output,), fan_in, fan_out)
+        return params, {}, self.output_shape(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        kh, kw = self.kernel
+        ph, pw = self.pad
+        ah, aw = self.adj
+        pad = [(kh - 1 - ph, kh - 1 - ph + ah), (kw - 1 - pw, kw - 1 - pw + aw)]
+        w = jnp.flip(params["weight"], axis=(0, 1))
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding=pad,
+            lhs_dilation=self.stride, dimension_numbers=_DIMSPEC_2D)
+        if self.with_bias:
+            y = y + params["bias"]
+        return y, state
+
+    def output_shape(self, input_shape):
+        n, h, w, _ = input_shape
+        kh, kw = self.kernel
+        oh = (h - 1) * self.stride[0] - 2 * self.pad[0] + kh + self.adj[0]
+        ow = (w - 1) * self.stride[1] - 2 * self.pad[1] + kw + self.adj[1]
+        return (n, oh, ow, self.n_output)
+
+
+class TemporalConvolution(Module):
+    """1-D conv over (N, T, C). reference: nn/TemporalConvolution.scala."""
+
+    def __init__(self, input_frame_size: int, output_frame_size: int,
+                 kernel_w: int, stride_w: int = 1, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size = input_frame_size
+        self.output_size = output_frame_size
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+        self.weight_init = init_mod.Xavier()
+        self.bias_init = init_mod.Zeros()
+
+    def build(self, rng, input_shape):
+        k_w, k_b = jax.random.split(rng)
+        fan_in = self.input_size * self.kernel_w
+        params = {
+            "weight": self.weight_init(k_w, (self.kernel_w, self.input_size, self.output_size),
+                                       fan_in, self.output_size),
+            "bias": self.bias_init(k_b, (self.output_size,), fan_in, self.output_size),
+        }
+        return params, {}, self.output_shape(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = lax.conv_general_dilated(
+            x, params["weight"], window_strides=(self.stride_w,), padding="VALID",
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        return y + params["bias"], state
+
+    def output_shape(self, input_shape):
+        n, t, _ = input_shape
+        ot = (t - self.kernel_w) // self.stride_w + 1
+        return (n, ot, self.output_size)

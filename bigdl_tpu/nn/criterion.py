@@ -1,0 +1,321 @@
+"""Loss functions (criterions).
+
+Reference: nn/abstractnn/AbstractCriterion.scala plus the ~40-criterion zoo
+(nn/ClassNLLCriterion.scala, nn/CrossEntropyCriterion.scala,
+nn/MSECriterion.scala, nn/BCECriterion.scala, nn/SmoothL1Criterion.scala,
+nn/MarginCriterion.scala, nn/KLDCriterion.scala,
+nn/DiceCoefficientCriterion.scala, nn/TimeDistributedCriterion.scala,
+nn/MultiCriterion.scala, nn/ParallelCriterion.scala, ...).
+
+Redesign: a Criterion is a pure function `forward(input, target) -> scalar`;
+there is no `backward`/`updateGradInput` because jax.grad differentiates the
+loss.  Class targets are 0-based int arrays (the reference is 1-based).
+All criterions honour `size_average` like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.table import Table
+
+
+class Criterion:
+    """Base. reference: nn/abstractnn/AbstractCriterion.scala."""
+
+    def forward(self, input, target):
+        raise NotImplementedError
+
+    def __call__(self, input, target):
+        return self.forward(input, target)
+
+
+def _reduce(x, size_average: bool):
+    return jnp.mean(x) if size_average else jnp.sum(x)
+
+
+class ClassNLLCriterion(Criterion):
+    """NLL over log-probabilities (pair with LogSoftMax).
+    reference: nn/ClassNLLCriterion.scala (weights + sizeAverage supported;
+    logProbAsInput=True matches the reference default)."""
+
+    def __init__(self, weights: Optional[jnp.ndarray] = None, size_average: bool = True,
+                 log_prob_as_input: bool = True):
+        self.weights = weights
+        self.size_average = size_average
+        self.log_prob = log_prob_as_input
+
+    def forward(self, input, target):
+        logp = input if self.log_prob else jnp.log(jnp.maximum(input, 1e-8))
+        t = target.astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, t[:, None], axis=-1)[:, 0]
+        if self.weights is not None:
+            w = jnp.take(self.weights, t)
+            total = -jnp.sum(w * picked)
+            return total / jnp.sum(w) if self.size_average else total
+        return _reduce(-picked, self.size_average)
+
+
+class CrossEntropyCriterion(Criterion):
+    """LogSoftMax + ClassNLL fused. reference: nn/CrossEntropyCriterion.scala."""
+
+    def __init__(self, weights: Optional[jnp.ndarray] = None, size_average: bool = True):
+        self.inner = ClassNLLCriterion(weights, size_average)
+
+    def forward(self, input, target):
+        return self.inner.forward(jax.nn.log_softmax(input, axis=-1), target)
+
+
+class MSECriterion(Criterion):
+    """reference: nn/MSECriterion.scala."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        return _reduce(jnp.square(input - target), self.size_average)
+
+
+class AbsCriterion(Criterion):
+    """L1. reference: nn/AbsCriterion.scala."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        return _reduce(jnp.abs(input - target), self.size_average)
+
+
+class BCECriterion(Criterion):
+    """Binary cross-entropy over probabilities. reference: nn/BCECriterion.scala."""
+
+    def __init__(self, weights: Optional[jnp.ndarray] = None, size_average: bool = True):
+        self.weights = weights
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        eps = 1e-12
+        p = jnp.clip(input, eps, 1.0 - eps)
+        loss = -(target * jnp.log(p) + (1.0 - target) * jnp.log(1.0 - p))
+        if self.weights is not None:
+            loss = loss * self.weights
+        return _reduce(loss, self.size_average)
+
+
+class BCEWithLogitsCriterion(Criterion):
+    """Numerically-stable sigmoid+BCE (log-sum-exp trick)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        loss = jnp.maximum(input, 0) - input * target + jnp.log1p(jnp.exp(-jnp.abs(input)))
+        return _reduce(loss, self.size_average)
+
+
+class SmoothL1Criterion(Criterion):
+    """Huber with delta=1. reference: nn/SmoothL1Criterion.scala."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        d = jnp.abs(input - target)
+        loss = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        return _reduce(loss, self.size_average)
+
+
+class MultiLabelSoftMarginCriterion(Criterion):
+    """reference: nn/MultiLabelSoftMarginCriterion.scala."""
+
+    def __init__(self, weights: Optional[jnp.ndarray] = None, size_average: bool = True):
+        self.weights = weights
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        loss = -(target * jax.nn.log_sigmoid(input) + (1 - target) * jax.nn.log_sigmoid(-input))
+        if self.weights is not None:
+            loss = loss * self.weights
+        per_sample = jnp.mean(loss, axis=-1)
+        return _reduce(per_sample, self.size_average)
+
+
+class MarginCriterion(Criterion):
+    """Hinge; target in {-1, 1}. reference: nn/MarginCriterion.scala."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True,
+                 squared: bool = False):
+        self.margin = margin
+        self.size_average = size_average
+        self.squared = squared
+
+    def forward(self, input, target):
+        loss = jnp.maximum(0.0, self.margin - input * target)
+        if self.squared:
+            loss = jnp.square(loss)
+        return _reduce(loss, self.size_average)
+
+
+class HingeEmbeddingCriterion(Criterion):
+    """reference: nn/HingeEmbeddingCriterion.scala."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        self.margin = margin
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        loss = jnp.where(target > 0, input, jnp.maximum(0.0, self.margin - input))
+        return _reduce(loss, self.size_average)
+
+
+class CosineEmbeddingCriterion(Criterion):
+    """Input is Table(x1, x2); target +-1.
+    reference: nn/CosineEmbeddingCriterion.scala."""
+
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        self.margin = margin
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        x1, x2 = input[1], input[2]
+        cos = jnp.sum(x1 * x2, -1) / jnp.maximum(
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+        loss = jnp.where(target > 0, 1.0 - cos, jnp.maximum(0.0, cos - self.margin))
+        return _reduce(loss, self.size_average)
+
+
+class KLDCriterion(Criterion):
+    """VAE KL(N(mu, sigma) || N(0, 1)); input is Table(mean, log_var).
+    reference: nn/KLDCriterion.scala (GaussianSampler counterpart)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target=None):
+        mean, log_var = input[1], input[2]
+        kld = -0.5 * jnp.sum(1 + log_var - jnp.square(mean) - jnp.exp(log_var), axis=-1)
+        return _reduce(kld, self.size_average)
+
+
+class DistKLDivCriterion(Criterion):
+    """KL divergence given log-probs input. reference: nn/DistKLDivCriterion.scala."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        loss = jnp.where(target > 0, target * (jnp.log(jnp.maximum(target, 1e-12)) - input), 0.0)
+        if self.size_average:
+            return jnp.sum(loss) / input.shape[0]
+        return jnp.sum(loss)
+
+
+class DiceCoefficientCriterion(Criterion):
+    """1 - dice overlap. reference: nn/DiceCoefficientCriterion.scala."""
+
+    def __init__(self, size_average: bool = True, epsilon: float = 1.0):
+        self.size_average = size_average
+        self.epsilon = epsilon
+
+    def forward(self, input, target):
+        axes = tuple(range(1, input.ndim))
+        inter = jnp.sum(input * target, axis=axes)
+        denom = jnp.sum(input, axis=axes) + jnp.sum(target, axis=axes)
+        dice = 1.0 - 2.0 * (inter + self.epsilon) / (denom + 2 * self.epsilon)
+        return _reduce(dice, self.size_average)
+
+
+class L1Cost(Criterion):
+    """Sum of |input|. reference: nn/L1Cost.scala."""
+
+    def forward(self, input, target=None):
+        return jnp.sum(jnp.abs(input))
+
+
+class ClassSimplexCriterion(Criterion):
+    """MSE against simplex-embedded class targets.
+    reference: nn/ClassSimplexCriterion.scala."""
+
+    def __init__(self, n_classes: int):
+        self.n_classes = n_classes
+        self.mse = MSECriterion()
+
+    def forward(self, input, target):
+        onehot = jax.nn.one_hot(target.astype(jnp.int32), self.n_classes, dtype=input.dtype)
+        return self.mse.forward(input, onehot)
+
+
+class SoftmaxWithCriterion(Criterion):
+    """Caffe-style fused softmax loss. reference: nn/SoftmaxWithCriterion.scala."""
+
+    def __init__(self, ignore_label: Optional[int] = None):
+        self.ignore_label = ignore_label
+
+    def forward(self, input, target):
+        logp = jax.nn.log_softmax(input, axis=-1)
+        t = target.astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, t[..., None], axis=-1)[..., 0]
+        if self.ignore_label is not None:
+            mask = (t != self.ignore_label).astype(input.dtype)
+            return -jnp.sum(picked * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return -jnp.mean(picked)
+
+
+class MultiCriterion(Criterion):
+    """Weighted sum of criterions on the same (input, target).
+    reference: nn/MultiCriterion.scala."""
+
+    def __init__(self):
+        self.criteria = []
+        self.weights = []
+
+    def add(self, criterion: Criterion, weight: float = 1.0) -> "MultiCriterion":
+        self.criteria.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def forward(self, input, target):
+        return sum(w * c.forward(input, target)
+                   for c, w in zip(self.criteria, self.weights))
+
+
+class ParallelCriterion(Criterion):
+    """i-th criterion on i-th (input, target) table entries.
+    reference: nn/ParallelCriterion.scala."""
+
+    def __init__(self, repeat_target: bool = False):
+        self.criteria = []
+        self.weights = []
+        self.repeat_target = repeat_target
+
+    def add(self, criterion: Criterion, weight: float = 1.0) -> "ParallelCriterion":
+        self.criteria.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def forward(self, input, target):
+        ins = list(input)
+        tgts = [target] * len(ins) if self.repeat_target else list(target)
+        return sum(w * c.forward(i, t)
+                   for c, w, i, t in zip(self.criteria, self.weights, ins, tgts))
+
+
+class TimeDistributedCriterion(Criterion):
+    """Apply a criterion at each timestep of (B, T, ...) input and average.
+    reference: nn/TimeDistributedCriterion.scala."""
+
+    def __init__(self, criterion: Criterion, size_average: bool = False):
+        self.criterion = criterion
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        n, t = input.shape[0], input.shape[1]
+        flat_in = jnp.reshape(input, (n * t,) + input.shape[2:])
+        flat_t = jnp.reshape(target, (n * t,) + target.shape[2:])
+        total = self.criterion.forward(flat_in, flat_t)
+        # inner criterion averages over n*t; reference divides by T only when
+        # sizeAverage is set at this level
+        return total if self.size_average else total * t

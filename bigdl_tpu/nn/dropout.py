@@ -1,0 +1,71 @@
+"""Stochastic regularization layers.
+
+Reference: nn/Dropout.scala (scale-at-train-time, i.e. inverted dropout),
+nn/GaussianDropout.scala, nn/GaussianNoise.scala.  Randomness comes from the
+`rng` threaded through `apply` (threefry keys — deterministic per step), not
+from mutable generator state like the reference's per-thread mersenne
+twister (utils/RandomGenerator.scala).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+class Dropout(Module):
+    """Inverted dropout. reference: nn/Dropout.scala."""
+
+    def __init__(self, init_p: float = 0.5, ip: bool = False, scale: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.p = init_p
+        self.scale = scale
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if not training or self.p <= 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("Dropout in training mode requires an rng")
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        y = jnp.where(mask, x, 0.0)
+        if self.scale:
+            y = y / keep
+        return y.astype(x.dtype), state
+
+
+class GaussianDropout(Module):
+    """Multiplicative N(1, p/(1-p)) noise. reference: nn/GaussianDropout.scala."""
+
+    def __init__(self, rate: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.rate = rate
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if not training or self.rate <= 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("GaussianDropout in training mode requires an rng")
+        stddev = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + stddev * jax.random.normal(rng, x.shape, x.dtype)
+        return x * noise, state
+
+
+class GaussianNoise(Module):
+    """Additive N(0, stddev) noise. reference: nn/GaussianNoise.scala."""
+
+    def __init__(self, stddev: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.stddev = stddev
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if not training:
+            return x, state
+        if rng is None:
+            raise ValueError("GaussianNoise in training mode requires an rng")
+        return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype), state

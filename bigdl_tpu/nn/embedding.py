@@ -1,0 +1,50 @@
+"""Embedding layers.
+
+Reference: nn/LookupTable.scala (with maxNorm renorm + paddingValue),
+nn/LookupTableSparse.scala.  A gather on TPU; XLA lowers `take` to an
+efficient dynamic-gather.  Indices are 0-based (the reference is 1-based —
+framework-wide convention delta, documented in module.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.module import Module
+
+
+class LookupTable(Module):
+    """Index -> embedding row. reference: nn/LookupTable.scala."""
+
+    def __init__(self, n_index: int, n_output: int, padding_value: Optional[int] = None,
+                 max_norm: Optional[float] = None, norm_type: float = 2.0,
+                 weight_init=None, name: Optional[str] = None):
+        super().__init__(name)
+        self.n_index = n_index
+        self.n_output = n_output
+        self.padding_value = padding_value
+        self.max_norm = max_norm
+        self.norm_type = norm_type
+        self.weight_init = weight_init or init_mod.RandomNormal(0.0, 1.0)
+
+    def build(self, rng, input_shape):
+        w = self.weight_init(rng, (self.n_index, self.n_output),
+                             self.n_index, self.n_output)
+        if self.padding_value is not None:
+            w = w.at[self.padding_value].set(0.0)
+        return {"weight": w}, {}, self.output_shape(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        # gather first, renorm only the gathered rows — O(batch*d), not O(V*d)
+        y = jnp.take(params["weight"], x.astype(jnp.int32), axis=0)
+        if self.max_norm is not None:
+            norms = jnp.linalg.norm(y, ord=self.norm_type, axis=-1, keepdims=True)
+            y = y * jnp.minimum(1.0, self.max_norm / jnp.maximum(norms, 1e-7))
+        return y, state
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape) + (self.n_output,)

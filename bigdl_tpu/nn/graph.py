@@ -1,0 +1,106 @@
+"""Graph container — DAG execution.
+
+Reference: nn/Graph.scala:72 (node DAG; backward graph is derived by
+reversing edges) and nn/StaticGraph.scala:44,82-84 (pre-topo-sorted
+execution arrays).  Here the DAG is topo-sorted once at construction and
+`apply` walks it in order; the backward graph never exists because jax.grad
+differentiates the whole walk.  BigDL's DynamicGraph/Scheduler/FrameManager
+(TF-style control-flow frames) has no analogue: data-dependent control flow
+inside jit is expressed with lax.cond/lax.while_loop at the layer level.
+
+Build a graph with the node-calling sugar:
+
+    inp = Input()
+    h = Linear(10, 20)(inp)
+    out = ReLU()(h)
+    model = Graph(inp, out)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+
+from bigdl_tpu.core.table import Table
+from bigdl_tpu.nn.module import Container, Module, Node, child_rng
+
+
+class Graph(Container):
+    """Static DAG of modules. reference: nn/Graph.scala, nn/StaticGraph.scala."""
+
+    def __init__(self, inputs: Union[Node, Sequence[Node]],
+                 outputs: Union[Node, Sequence[Node]],
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_nodes: List[Node] = [inputs] if isinstance(inputs, Node) else list(inputs)
+        self.output_nodes: List[Node] = [outputs] if isinstance(outputs, Node) else list(outputs)
+        self.topo: List[Node] = self._topo_sort()
+        for node in self.topo:
+            if node.module is not None:
+                self.children[node.name] = node.module
+
+    def _topo_sort(self) -> List[Node]:
+        """DFS post-order from outputs (reference: utils/DirectedGraph.scala
+        topologySort, executed backwards from the output like StaticGraph)."""
+        visited: Dict[int, bool] = {}
+        order: List[Node] = []
+
+        def visit(node: Node):
+            if id(node) in visited:
+                if not visited[id(node)]:
+                    raise ValueError("cycle detected in Graph")
+                return
+            visited[id(node)] = False
+            for p in node.prevs:
+                visit(p)
+            visited[id(node)] = True
+            order.append(node)
+
+        for out in self.output_nodes:
+            visit(out)
+        return order
+
+    def _gather_inputs(self, node: Node, values: Dict[int, Any]) -> Any:
+        ins = [values[id(p)] for p in node.prevs]
+        return ins[0] if len(ins) == 1 else Table(*ins)
+
+    def build(self, rng, input_shape):
+        shapes_in = [input_shape] if not isinstance(input_shape, (list, Table)) else list(input_shape)
+        if len(shapes_in) != len(self.input_nodes):
+            raise ValueError(f"graph has {len(self.input_nodes)} inputs, got {len(shapes_in)} shapes")
+        shape_vals: Dict[int, Any] = {}
+        for node, sh in zip(self.input_nodes, shapes_in):
+            shape_vals[id(node)] = tuple(sh)
+        params, state = {}, {}
+        for i, node in enumerate(self.topo):
+            if node.module is None:
+                if id(node) not in shape_vals:
+                    raise ValueError(f"unbound graph input {node.name}")
+                continue
+            sh = self._gather_inputs(node, shape_vals)
+            p, s, out = node.module.build(jax.random.fold_in(rng, i), sh)
+            params[node.name], state[node.name] = p, s
+            shape_vals[id(node)] = out
+        outs = [shape_vals[id(n)] for n in self.output_nodes]
+        return params, state, outs[0] if len(outs) == 1 else Table(*outs)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        xs = [x] if not isinstance(x, (list, Table)) else list(x)
+        values: Dict[int, Any] = {}
+        for node, v in zip(self.input_nodes, xs):
+            values[id(node)] = v
+        new_state: Dict[str, Any] = {}
+        for i, node in enumerate(self.topo):
+            if node.module is None:
+                continue
+            inp = self._gather_inputs(node, values)
+            y, s = node.module.apply(params[node.name], state[node.name], inp,
+                                     training=training, rng=child_rng(rng, i))
+            values[id(node)] = y
+            new_state[node.name] = s
+        outs = [values[id(n)] for n in self.output_nodes]
+        return (outs[0] if len(outs) == 1 else Table(*outs)), new_state
+
+    def output_shape(self, input_shape):
+        raise NotImplementedError("use build() for graph shape inference")

@@ -1,0 +1,99 @@
+"""Weight initialization methods.
+
+Reference: nn/InitializationMethod.scala + nn/abstractnn/Initializable.scala
+(Zeros, Ones, ConstInit, RandomUniform, RandomNormal, Xavier, MsraFiller,
+BilinearFiller).  Each method is a callable `(rng, shape, fan_in, fan_out,
+dtype) -> array`; layers expose `set_init_method(weight_init, bias_init)`
+like the reference's `setInitMethod`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class InitializationMethod:
+    def __call__(self, rng, shape, fan_in: int, fan_out: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class Zeros(InitializationMethod):
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return jnp.zeros(shape, dtype)
+
+
+class Ones(InitializationMethod):
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return jnp.ones(shape, dtype)
+
+
+class ConstInit(InitializationMethod):
+    def __init__(self, value: float):
+        self.value = value
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype)
+
+
+class RandomUniform(InitializationMethod):
+    """U(lower, upper); with no bounds, BigDL uses +-1/sqrt(fan_in)
+    (reference: nn/InitializationMethod.scala RandomUniform)."""
+
+    def __init__(self, lower: Optional[float] = None, upper: Optional[float] = None):
+        self.lower, self.upper = lower, upper
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        if self.lower is None:
+            bound = 1.0 / math.sqrt(max(1, fan_in))
+            lo, hi = -bound, bound
+        else:
+            lo, hi = self.lower, self.upper
+        return jax.random.uniform(rng, shape, dtype, minval=lo, maxval=hi)
+
+
+class RandomNormal(InitializationMethod):
+    def __init__(self, mean: float = 0.0, stdv: float = 1.0):
+        self.mean, self.stdv = mean, stdv
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return self.mean + self.stdv * jax.random.normal(rng, shape, dtype)
+
+
+class Xavier(InitializationMethod):
+    """Glorot uniform: U(+-sqrt(6/(fan_in+fan_out)))."""
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        bound = math.sqrt(6.0 / max(1, fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, minval=-bound, maxval=bound)
+
+
+class MsraFiller(InitializationMethod):
+    """He init; varianceNormAverage=True averages fan_in/fan_out
+    (reference MsraFiller)."""
+
+    def __init__(self, variance_norm_average: bool = True):
+        self.avg = variance_norm_average
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        n = (fan_in + fan_out) / 2.0 if self.avg else float(fan_in)
+        std = math.sqrt(2.0 / max(1.0, n))
+        return std * jax.random.normal(rng, shape, dtype)
+
+
+class BilinearFiller(InitializationMethod):
+    """Bilinear upsampling kernel for deconvolution weights (HWIO)."""
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        kh, kw, cin, cout = shape
+        f_h, f_w = math.ceil(kh / 2.0), math.ceil(kw / 2.0)
+        c_h, c_w = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h), (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        ii, jj = jnp.meshgrid(jnp.arange(kh), jnp.arange(kw), indexing="ij")
+        filt = (1 - jnp.abs(ii / f_h - c_h)) * (1 - jnp.abs(jj / f_w - c_w))
+        # only the (in == out) channel-pair diagonal carries the filter, so
+        # each channel is upsampled independently (no channel mixing)
+        diag = jnp.eye(cin, cout, dtype=dtype)
+        return (filt[..., None, None] * diag).astype(dtype)

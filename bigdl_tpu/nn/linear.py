@@ -1,0 +1,73 @@
+"""Dense layers.
+
+Reference: nn/Linear.scala:83-153 (addmm -> gemm -> MKL vsgemm).  Here the
+matmul is a plain `x @ W` that XLA tiles onto the MXU; weight layout is
+(in, out) so no transpose appears in the hot path (the reference stores
+(out, in) and transposes — an MKL-ism with no TPU benefit).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.module import Module
+
+
+class Linear(Module):
+    """y = x @ W + b.  reference: nn/Linear.scala:83-153."""
+
+    def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
+                 weight_init=None, bias_init=None, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.weight_init = weight_init or init_mod.Xavier()
+        self.bias_init = bias_init or init_mod.Zeros()
+
+    def set_init_method(self, weight_init=None, bias_init=None) -> "Linear":
+        if weight_init is not None:
+            self.weight_init = weight_init
+        if bias_init is not None:
+            self.bias_init = bias_init
+        return self
+
+    def build(self, rng, input_shape):
+        k_w, k_b = jax.random.split(rng)
+        fan_in, fan_out = self.input_size, self.output_size
+        params = {"weight": self.weight_init(k_w, (fan_in, fan_out), fan_in, fan_out)}
+        if self.with_bias:
+            params["bias"] = self.bias_init(k_b, (fan_out,), fan_in, fan_out)
+        return params, {}, self.output_shape(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = x @ params["weight"]
+        if self.with_bias:
+            y = y + params["bias"]
+        return y, state
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_size,)
+
+
+class SparseLinear(Linear):
+    """Linear over sparse-ish inputs (reference: nn/SparseLinear.scala).
+
+    The reference multiplies a COO SparseTensor against dense weights for
+    wide-and-deep style features.  On TPU, scatter/gather-heavy sparse gemm
+    loses to a dense matmul on the MXU for the feature widths BigDL targets,
+    so the TPU-native design densifies at the input pipeline and reuses the
+    dense kernel; the class exists for API parity and accepts already-dense
+    input (e.g. multi-hot encoded).
+    """
+
+    def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
+                 backward_start: int = -1, backward_length: int = -1,
+                 name: Optional[str] = None):
+        super().__init__(input_size, output_size, with_bias, name=name)
+        self.backward_start = backward_start
+        self.backward_length = backward_length

@@ -1,0 +1,218 @@
+"""Module base classes.
+
+Replaces `AbstractModule[A, B, T]` (reference:
+nn/abstractnn/AbstractModule.scala:58).  The reference API is stateful and
+autograd-by-hand (`forward` caches `output`, `backward` =
+`updateGradInput` + `accGradParameters`); here the core protocol is pure:
+
+    params, state, out_shape = module.build(rng, input_shape)
+    output, new_state       = module.apply(params, state, x, training=...)
+
+`params` are trainable leaves (pytree), `state` is non-trained buffers
+(BatchNorm running stats — the analogue of runningMean/runningVar).  Autograd
+is `jax.grad` of a loss over `apply`; there is no per-layer backward.
+
+A thin stateful convenience layer (`init` / `forward`) mirrors the reference
+ergonomics for interactive use and the Keras-style frontend; trainers use the
+functional protocol so the whole step jits into one XLA program.
+
+Shapes are tuples INCLUDING the batch dimension, NHWC layout for images
+(TPU-native; the reference is NCHW — documented capability-parity delta).
+Multi-activity inputs/outputs are `Table`s (see core/table.py), matching the
+reference's `Activity = Tensor | Table` union.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.random import RandomGenerator
+from bigdl_tpu.core.table import Table
+
+_counter = itertools.count()
+
+Shape = Tuple[int, ...]
+
+
+def shape_of(x: Any) -> Any:
+    """Structure-preserving shape extraction (arrays -> shape tuples)."""
+    if isinstance(x, Table):
+        t = Table()
+        for k, v in x.items():
+            t[k] = shape_of(v)
+        return t
+    if isinstance(x, (list, tuple)):
+        return type(x)(shape_of(v) for v in x)
+    return tuple(x.shape)
+
+
+def _is_shape(s: Any) -> bool:
+    return isinstance(s, tuple) and all(isinstance(i, int) for i in s)
+
+
+class Module:
+    """Base module. Subclasses implement `build` and `apply`."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or f"{type(self).__name__.lower()}_{next(_counter)}"
+        # stateful convenience slots (not used by the functional path)
+        self.params: Any = None
+        self.state: Any = None
+        self.training: bool = True
+
+    # ------------------------------------------------------------------
+    # Functional protocol
+    # ------------------------------------------------------------------
+
+    def build(self, rng: jax.Array, input_shape: Any):
+        """Create (params, state) for `input_shape`; return output shape too.
+
+        Analogue of the reference's lazy build + `computeOutputShape`
+        (nn/abstractnn/InferShape.scala).
+        """
+        return {}, {}, self.output_shape(input_shape)
+
+    def apply(self, params: Any, state: Any, x: Any, *, training: bool = False,
+              rng: Optional[jax.Array] = None):
+        raise NotImplementedError(type(self).__name__)
+
+    def output_shape(self, input_shape: Any) -> Any:
+        """Shape inference for stateless modules; stateful ones override
+        `build` and may compute it there."""
+        return input_shape
+
+    # ------------------------------------------------------------------
+    # Stateful convenience (mirrors reference forward/evaluate ergonomics)
+    # ------------------------------------------------------------------
+
+    def init(self, input_shape: Any, rng: Optional[jax.Array] = None):
+        if rng is None:
+            rng = RandomGenerator.next_key()
+        self.params, self.state, out = self.build(rng, input_shape)
+        return self.params, self.state
+
+    def forward(self, x: Any, rng: Optional[jax.Array] = None) -> Any:
+        """Stateful forward using stored params (lazy-inits from x)."""
+        if self.params is None:
+            self.init(shape_of(x))
+        y, new_state = self.apply(self.params, self.state, x,
+                                  training=self.training, rng=rng)
+        self.state = new_state
+        return y
+
+    def evaluate(self) -> "Module":
+        """Eval mode (reference: AbstractModule.evaluate, :438-447)."""
+        self.training = False
+        return self
+
+    def train_mode(self) -> "Module":
+        self.training = True
+        return self
+
+    # ------------------------------------------------------------------
+    # Graph-building sugar: calling a module on Node(s) records an edge
+    # (reference: `layer.inputs(node)`, nn/Graph.scala:72)
+    # ------------------------------------------------------------------
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        if args and all(isinstance(a, Node) for a in args):
+            return self.inputs(*args)
+        return self.forward(*args, **kwargs)
+
+    def inputs(self, *nodes: "Node") -> "Node":
+        return Node(self, list(nodes))
+
+    # ------------------------------------------------------------------
+
+    def param_count(self, params: Any = None) -> int:
+        p = params if params is not None else self.params
+        return sum(int(leaf.size) for leaf in jax.tree_util.tree_leaves(p))
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.name})"
+
+
+class Node:
+    """A node in a model DAG under construction (reference: utils/Node.scala
+    + nn/Graph.scala node wiring)."""
+
+    def __init__(self, module: Optional[Module], prevs: List["Node"]):
+        self.module = module
+        self.prevs = prevs
+        self.name = module.name if module else f"input_{next(_counter)}"
+
+
+def Input(name: Optional[str] = None) -> Node:
+    """Graph input placeholder (reference: nn/Input.scala)."""
+    n = Node(None, [])
+    if name:
+        n.name = name
+    return n
+
+
+class Container(Module):
+    """Module with named children (reference: nn/Container.scala)."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self.children: "OrderedDict[str, Module]" = OrderedDict()
+
+    def add(self, module: Module) -> "Container":
+        key = str(len(self.children))
+        self.children[key] = module
+        return self
+
+    def __getitem__(self, i: int) -> Module:
+        return list(self.children.values())[i]
+
+    def __len__(self) -> int:
+        return len(self.children)
+
+    def modules(self) -> List[Module]:
+        return list(self.children.values())
+
+    def __repr__(self) -> str:
+        inner = ", ".join(repr(m) for m in self.children.values())
+        return f"{type(self).__name__}[{inner}]"
+
+
+def child_rng(rng: Optional[jax.Array], i: int) -> Optional[jax.Array]:
+    if rng is None:
+        return None
+    return jax.random.fold_in(rng, i)
+
+
+class Sequential(Container):
+    """Feed-forward chain (reference: nn/Sequential.scala)."""
+
+    def __init__(self, *modules: Module, name: Optional[str] = None):
+        super().__init__(name)
+        for m in modules:
+            self.add(m)
+
+    def build(self, rng, input_shape):
+        params, state = {}, {}
+        shape = input_shape
+        for i, (key, m) in enumerate(self.children.items()):
+            p, s, shape = m.build(jax.random.fold_in(rng, i), shape)
+            params[key] = p
+            state[key] = s
+        return params, state, shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        new_state = {}
+        for i, (key, m) in enumerate(self.children.items()):
+            x, new_state[key] = m.apply(params[key], state[key], x,
+                                        training=training, rng=child_rng(rng, i))
+        return x, new_state
+
+    def output_shape(self, input_shape):
+        shape = input_shape
+        for m in self.children.values():
+            shape = m.output_shape(shape)
+        return shape

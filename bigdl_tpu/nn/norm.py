@@ -1,0 +1,153 @@
+"""Normalization layers.
+
+Reference: nn/BatchNormalization.scala, nn/SpatialBatchNormalization.scala,
+nn/Normalize.scala, nn/SpatialCrossMapLRN.scala.
+
+Sync-BN: the reference synchronizes batch statistics across intra-node model
+replicas via `setParallism` + ParameterSynchronizer thread barriers
+(models/resnet/TrainImageNet.scala:151-158, utils/ParameterSynchronizer.scala).
+On TPU there are two regimes, both cleaner:
+  * under pjit with a batch-sharded global array, the mean/var reductions are
+    global automatically — sync-BN is the default semantics;
+  * under shard_map (per-shard code), pass `axis_name` and the layer inserts
+    `lax.pmean` over that mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.module import Module
+
+
+class BatchNormalization(Module):
+    """BN over the last axis of (N, C) input.
+    reference: nn/BatchNormalization.scala (momentum=0.1, eps=1e-5, affine)."""
+
+    _reduce_axes: Tuple[int, ...] = (0,)
+
+    def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
+                 affine: bool = True, axis_name: Optional[str] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_output = n_output
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+        self.axis_name = axis_name
+
+    def set_axis_name(self, axis_name: Optional[str]) -> "BatchNormalization":
+        """Cross-replica stat sync under shard_map (the `setParallism`
+        analogue, survey §2.10 Sync-BN row)."""
+        self.axis_name = axis_name
+        return self
+
+    def build(self, rng, input_shape):
+        c = self.n_output
+        params = {}
+        if self.affine:
+            params = {"weight": jnp.ones((c,), jnp.float32),
+                      "bias": jnp.zeros((c,), jnp.float32)}
+        state = {"running_mean": jnp.zeros((c,), jnp.float32),
+                 "running_var": jnp.ones((c,), jnp.float32)}
+        return params, state, input_shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if training:
+            mean = jnp.mean(x, axis=self._reduce_axes)
+            mean2 = jnp.mean(jnp.square(x), axis=self._reduce_axes)
+            if self.axis_name is not None:
+                mean = lax.pmean(mean, self.axis_name)
+                mean2 = lax.pmean(mean2, self.axis_name)
+            var = mean2 - jnp.square(mean)
+            m = self.momentum
+            new_state = {
+                "running_mean": (1 - m) * state["running_mean"] + m * mean,
+                "running_var": (1 - m) * state["running_var"] + m * var,
+            }
+        else:
+            mean, var = state["running_mean"], state["running_var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.eps)
+        y = (x - mean) * inv
+        if self.affine:
+            y = y * params["weight"] + params["bias"]
+        return y.astype(x.dtype), new_state
+
+    def output_shape(self, input_shape):
+        return input_shape
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """BN over (N, H, W) of NHWC input.
+    reference: nn/SpatialBatchNormalization.scala."""
+
+    _reduce_axes = (0, 1, 2)
+
+
+class LayerNormalization(Module):
+    """LayerNorm over the last axis (reference keras-style LayerNorm;
+    also the building block the TPU transformer stack uses)."""
+
+    def __init__(self, hidden_size: int, eps: float = 1e-5, name: Optional[str] = None):
+        super().__init__(name)
+        self.hidden_size = hidden_size
+        self.eps = eps
+
+    def build(self, rng, input_shape):
+        params = {"weight": jnp.ones((self.hidden_size,), jnp.float32),
+                  "bias": jnp.zeros((self.hidden_size,), jnp.float32)}
+        return params, {}, input_shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+        y = (x - mean) * lax.rsqrt(var + self.eps)
+        return y * params["weight"] + params["bias"], state
+
+
+class Normalize(Module):
+    """Lp-normalize along the last axis. reference: nn/Normalize.scala."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10, name: Optional[str] = None):
+        super().__init__(name)
+        self.p = p
+        self.eps = eps
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if self.p == 2.0:
+            norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+        else:
+            norm = jnp.sum(jnp.abs(x) ** self.p, axis=-1, keepdims=True) ** (1.0 / self.p)
+        return x / jnp.maximum(norm, self.eps), state
+
+
+class SpatialCrossMapLRN(Module):
+    """Local response normalization across channels (NHWC).
+    reference: nn/SpatialCrossMapLRN.scala (AlexNet/Inception-v1 era).
+
+    y = x / (k + alpha/size * sum_{local window} x^2)^beta
+    Implemented as a channel-axis reduce_window — XLA fuses it; no explicit
+    ring buffers like the reference's scale-tensor bookkeeping."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 k: float = 1.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        half = (self.size - 1) // 2
+        sq = jnp.square(x)
+        window_sum = lax.reduce_window(
+            sq, 0.0, lax.add, (1, 1, 1, self.size), (1, 1, 1, 1),
+            [(0, 0), (0, 0), (0, 0), (half, self.size - 1 - half)])
+        scale = (self.k + self.alpha / self.size * window_sum) ** self.beta
+        return x / scale, state

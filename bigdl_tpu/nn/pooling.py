@@ -1,0 +1,143 @@
+"""Pooling layers (NHWC).
+
+Reference: nn/SpatialMaxPooling.scala, nn/SpatialAveragePooling.scala,
+nn/TemporalMaxPooling.scala.  All lower to `lax.reduce_window`, which XLA
+vectorizes on the VPU; no explicit index bookkeeping for the backward pass
+(the reference tracks argmax indices by hand — jax.grad derives it).
+
+BigDL pooling supports `ceilMode` (nn/SpatialMaxPooling.scala); we keep it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+
+
+def _pool_out(size: int, k: int, stride: int, pad: int, ceil_mode: bool) -> int:
+    if ceil_mode:
+        out = -(-(size + 2 * pad - k) // stride) + 1
+        # Torch/BigDL rule: the last window may not start entirely inside the
+        # right padding (otherwise it would read only pad values -> -inf/NaN)
+        if (out - 1) * stride >= size + pad:
+            out -= 1
+        return out
+    return (size + 2 * pad - k) // stride + 1
+
+
+def _window_pad(size, k, stride, pad, ceil_mode):
+    """Explicit (lo, hi) padding that realizes ceil/floor semantics."""
+    out = _pool_out(size, k, stride, pad, ceil_mode)
+    needed = max(0, (out - 1) * stride + k - size - pad)
+    return (pad, needed)
+
+
+class SpatialMaxPooling(Module):
+    """reference: nn/SpatialMaxPooling.scala."""
+
+    def __init__(self, kw: int, kh: int, dw: Optional[int] = None, dh: Optional[int] = None,
+                 pad_w: int = 0, pad_h: int = 0, ceil_mode: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.kernel = (kh, kw)
+        self.stride = (dh or kh, dw or kw)
+        self.pad = (pad_h, pad_w)
+        self.ceil_mode = ceil_mode
+
+    def set_ceil_mode(self) -> "SpatialMaxPooling":
+        self.ceil_mode = True
+        return self
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        _, h, w, _ = x.shape
+        pad_h = _window_pad(h, kh, sh, self.pad[0], self.ceil_mode)
+        pad_w = _window_pad(w, kw, sw, self.pad[1], self.ceil_mode)
+        # -inf (not finfo.min) so JAX recognizes the differentiable
+        # reduce_window_max special case
+        y = lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, kh, kw, 1), (1, sh, sw, 1),
+            [(0, 0), pad_h, pad_w, (0, 0)])
+        return y, state
+
+    def output_shape(self, input_shape):
+        n, h, w, c = input_shape
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        return (n, _pool_out(h, kh, sh, self.pad[0], self.ceil_mode),
+                _pool_out(w, kw, sw, self.pad[1], self.ceil_mode), c)
+
+
+class SpatialAveragePooling(Module):
+    """reference: nn/SpatialAveragePooling.scala.  `count_include_pad`
+    matches the reference's countIncludePad."""
+
+    def __init__(self, kw: int, kh: int, dw: Optional[int] = None, dh: Optional[int] = None,
+                 pad_w: int = 0, pad_h: int = 0, ceil_mode: bool = False,
+                 count_include_pad: bool = True, divide: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.kernel = (kh, kw)
+        self.stride = (dh or kh, dw or kw)
+        self.pad = (pad_h, pad_w)
+        self.ceil_mode = ceil_mode
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        _, h, w, _ = x.shape
+        pad_h = _window_pad(h, kh, sh, self.pad[0], self.ceil_mode)
+        pad_w = _window_pad(w, kw, sw, self.pad[1], self.ceil_mode)
+        window_pad = [(0, 0), pad_h, pad_w, (0, 0)]
+        summed = lax.reduce_window(x, 0.0, lax.add, (1, kh, kw, 1), (1, sh, sw, 1), window_pad)
+        if not self.divide:
+            return summed, state
+        if self.count_include_pad:
+            y = summed / (kh * kw)
+        else:
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, (1, kh, kw, 1), (1, sh, sw, 1), window_pad)
+            y = summed / counts
+        return y, state
+
+    def output_shape(self, input_shape):
+        n, h, w, c = input_shape
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        return (n, _pool_out(h, kh, sh, self.pad[0], self.ceil_mode),
+                _pool_out(w, kw, sw, self.pad[1], self.ceil_mode), c)
+
+
+class TemporalMaxPooling(Module):
+    """1-D max pool over (N, T, C). reference: nn/TemporalMaxPooling.scala."""
+
+    def __init__(self, k_w: int, d_w: Optional[int] = None, name: Optional[str] = None):
+        super().__init__(name)
+        self.k_w = k_w
+        self.d_w = d_w or k_w
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = lax.reduce_window(x, -jnp.inf, lax.max, (1, self.k_w, 1), (1, self.d_w, 1), "VALID")
+        return y, state
+
+    def output_shape(self, input_shape):
+        n, t, c = input_shape
+        return (n, (t - self.k_w) // self.d_w + 1, c)
+
+
+class GlobalAveragePooling2D(Module):
+    """Mean over H, W (Keras-style; reference keras/GlobalAveragePooling2D)."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.mean(x, axis=(1, 2)), state
+
+    def output_shape(self, input_shape):
+        n, h, w, c = input_shape
+        return (n, c)

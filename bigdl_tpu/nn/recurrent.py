@@ -1,0 +1,256 @@
+"""Recurrent layers.
+
+Reference: nn/Cell.scala (cell contract), nn/RnnCell.scala, nn/LSTM.scala,
+nn/GRU.scala, nn/Recurrent.scala:47,241 (timestep loop over CLONED cells
+sharing weights), nn/BiRecurrent.scala, nn/TimeDistributed.scala.
+
+TPU-native redesign: the reference unrolls python/JVM-side over timesteps
+with per-step cell clones; here the time loop is a single `lax.scan`, so the
+whole sequence compiles to one XLA while-loop with the cell body fused.
+The 4 gate matmuls of LSTM/GRU are packed into one (in+hidden, 4H) matmul to
+keep the MXU busy (the reference computes them as separate gemms).
+
+Input layout: (batch, time, features) — batchNormParams/maskZero options of
+the reference's Recurrent are not carried over (capability delta: masking is
+done with explicit length masks at the criterion level).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.core.table import Table
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.module import Module
+
+
+class Cell(Module):
+    """Single-timestep recurrent cell.
+
+    Protocol: `step(params, x_t, hidden) -> (out_t, new_hidden)` where
+    `hidden` is a pytree (array or Table).  reference: nn/Cell.scala.
+    """
+
+    hidden_size: int
+
+    def init_hidden(self, batch_size: int, dtype=jnp.float32) -> Any:
+        return jnp.zeros((batch_size, self.hidden_size), dtype)
+
+    def step(self, params, x_t, hidden):
+        raise NotImplementedError
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        # standalone use: x is Table(x_t, hidden)
+        x_t, hidden = x[1], x[2]
+        out, new_hidden = self.step(params, x_t, hidden)
+        return Table(out, new_hidden), state
+
+
+class RnnCell(Cell):
+    """Elman RNN cell: h' = act(W x + U h + b). reference: nn/RnnCell.scala."""
+
+    def __init__(self, input_size: int, hidden_size: int, activation=jnp.tanh,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+
+    def build(self, rng, input_shape):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        xavier = init_mod.Xavier()
+        params = {
+            "w_ih": xavier(k1, (self.input_size, self.hidden_size),
+                           self.input_size, self.hidden_size),
+            "w_hh": xavier(k2, (self.hidden_size, self.hidden_size),
+                           self.hidden_size, self.hidden_size),
+            "bias": jnp.zeros((self.hidden_size,), jnp.float32),
+        }
+        n = input_shape[0]
+        return params, {}, (n, self.hidden_size)
+
+    def step(self, params, x_t, hidden):
+        h = self.activation(x_t @ params["w_ih"] + hidden @ params["w_hh"] + params["bias"])
+        return h, h
+
+
+class LSTMCell(Cell):
+    """LSTM cell, gates packed in one matmul (order: i, f, g, o).
+    reference: nn/LSTM.scala.  Hidden is Table(h, c)."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 forget_bias: float = 0.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.forget_bias = forget_bias
+
+    def build(self, rng, input_shape):
+        k1, k2 = jax.random.split(rng)
+        xavier = init_mod.Xavier()
+        h = self.hidden_size
+        params = {
+            "w_ih": xavier(k1, (self.input_size, 4 * h), self.input_size, h),
+            "w_hh": xavier(k2, (h, 4 * h), h, h),
+            "bias": jnp.zeros((4 * h,), jnp.float32),
+        }
+        n = input_shape[0]
+        return params, {}, (n, h)
+
+    def init_hidden(self, batch_size: int, dtype=jnp.float32):
+        z = jnp.zeros((batch_size, self.hidden_size), dtype)
+        return Table(z, z)
+
+    def step(self, params, x_t, hidden):
+        h_prev, c_prev = hidden[1], hidden[2]
+        gates = x_t @ params["w_ih"] + h_prev @ params["w_hh"] + params["bias"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f + self.forget_bias)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c = f * c_prev + i * g
+        h = o * jnp.tanh(c)
+        return h, Table(h, c)
+
+
+class GRUCell(Cell):
+    """GRU cell, all gates packed (order: r, z, n); the reset gate is applied
+    AFTER the hidden matmul so the three hidden projections fuse into one
+    (H, 3H) MXU matmul.  reference: nn/GRU.scala."""
+
+    def __init__(self, input_size: int, hidden_size: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+    def build(self, rng, input_shape):
+        k1, k2 = jax.random.split(rng)
+        xavier = init_mod.Xavier()
+        h = self.hidden_size
+        params = {
+            "w_ih": xavier(k1, (self.input_size, 3 * h), self.input_size, h),
+            "w_hh": xavier(k2, (h, 3 * h), h, h),
+            "bias": jnp.zeros((3 * h,), jnp.float32),
+        }
+        n = input_shape[0]
+        return params, {}, (n, h)
+
+    def step(self, params, x_t, hidden):
+        gi = x_t @ params["w_ih"] + params["bias"]
+        gh = hidden @ params["w_hh"]
+        gi_r, gi_z, gi_n = jnp.split(gi, 3, axis=-1)
+        gh_r, gh_z, gh_n = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(gi_r + gh_r)
+        z = jax.nn.sigmoid(gi_z + gh_z)
+        n = jnp.tanh(gi_n + r * gh_n)
+        h = (1.0 - z) * n + z * hidden
+        return h, h
+
+
+class Recurrent(Module):
+    """Scan a cell over the time axis.
+    reference: nn/Recurrent.scala (JVM-side unroll -> lax.scan here)."""
+
+    def __init__(self, cell: Cell, return_state: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.cell = cell
+        self.return_state = return_state
+
+    def build(self, rng, input_shape):
+        n, t, f = input_shape
+        p, s, _ = self.cell.build(rng, (n, f))
+        return {"cell": p}, {"cell": s}, (n, t, self.cell.hidden_size)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        n = x.shape[0]
+        h0 = self.cell.init_hidden(n, x.dtype)
+        xs = jnp.swapaxes(x, 0, 1)  # (T, B, F)
+
+        def body(hidden, x_t):
+            out, new_hidden = self.cell.step(params["cell"], x_t, hidden)
+            return new_hidden, out
+
+        last_hidden, outs = lax.scan(body, h0, xs)
+        y = jnp.swapaxes(outs, 0, 1)  # (B, T, H)
+        if self.return_state:
+            return Table(y, last_hidden), state
+        return y, state
+
+    def output_shape(self, input_shape):
+        n, t, _ = input_shape
+        return (n, t, self.cell.hidden_size)
+
+
+def LSTM(input_size: int, hidden_size: int, name: Optional[str] = None) -> Recurrent:
+    """reference: nn/LSTM.scala (used inside Recurrent)."""
+    return Recurrent(LSTMCell(input_size, hidden_size), name=name)
+
+
+def GRU(input_size: int, hidden_size: int, name: Optional[str] = None) -> Recurrent:
+    return Recurrent(GRUCell(input_size, hidden_size), name=name)
+
+
+def RnnLayer(input_size: int, hidden_size: int, activation=jnp.tanh,
+             name: Optional[str] = None) -> Recurrent:
+    return Recurrent(RnnCell(input_size, hidden_size, activation), name=name)
+
+
+class BiRecurrent(Module):
+    """Bidirectional scan; merge = 'concat' | 'add'.
+    reference: nn/BiRecurrent.scala."""
+
+    def __init__(self, cell_fwd: Cell, cell_bwd: Cell, merge: str = "concat",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.fwd = Recurrent(cell_fwd)
+        self.bwd = Recurrent(cell_bwd)
+        self.merge = merge
+
+    def build(self, rng, input_shape):
+        k1, k2 = jax.random.split(rng)
+        p1, s1, out = self.fwd.build(k1, input_shape)
+        p2, s2, _ = self.bwd.build(k2, input_shape)
+        if self.merge == "concat":
+            out = out[:-1] + (out[-1] * 2,)
+        return {"fwd": p1, "bwd": p2}, {"fwd": s1, "bwd": s2}, out
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y_f, _ = self.fwd.apply(params["fwd"], state["fwd"], x, training=training)
+        x_rev = jnp.flip(x, axis=1)
+        y_b, _ = self.bwd.apply(params["bwd"], state["bwd"], x_rev, training=training)
+        y_b = jnp.flip(y_b, axis=1)
+        if self.merge == "concat":
+            return jnp.concatenate([y_f, y_b], axis=-1), state
+        return y_f + y_b, state
+
+    def output_shape(self, input_shape):
+        n, t, _ = input_shape
+        h = self.fwd.cell.hidden_size
+        return (n, t, 2 * h if self.merge == "concat" else h)
+
+
+class TimeDistributed(Module):
+    """Apply a module independently at each timestep by folding time into
+    batch. reference: nn/TimeDistributed.scala."""
+
+    def __init__(self, module: Module, name: Optional[str] = None):
+        super().__init__(name)
+        self.inner = module
+
+    def build(self, rng, input_shape):
+        n, t = input_shape[0], input_shape[1]
+        p, s, out = self.inner.build(rng, (n * t,) + tuple(input_shape[2:]))
+        return {"inner": p}, {"inner": s}, (n, t) + tuple(out[1:])
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        n, t = x.shape[0], x.shape[1]
+        flat = jnp.reshape(x, (n * t,) + x.shape[2:])
+        y, s = self.inner.apply(params["inner"], state["inner"], flat,
+                                training=training, rng=rng)
+        return jnp.reshape(y, (n, t) + y.shape[1:]), {"inner": s}

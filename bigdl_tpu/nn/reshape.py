@@ -1,0 +1,218 @@
+"""Shape-manipulation layers.
+
+Reference: nn/Reshape.scala, nn/View.scala, nn/Squeeze.scala,
+nn/Unsqueeze.scala, nn/Transpose.scala, nn/Contiguous.scala,
+nn/Identity.scala, nn/Select.scala, nn/Narrow.scala, nn/SplitTable.scala,
+nn/JoinTable.scala, nn/Padding.scala.  All are metadata ops or cheap copies
+under XLA; `Contiguous` is the identity (XLA owns layouts).
+
+Axis convention: 0-based with negative indexing, batch dim included
+(the reference is 1-based with batch handled via `batchMode` flags).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.core.table import Table
+from bigdl_tpu.nn.module import Module
+
+
+class Reshape(Module):
+    """Reshape non-batch dims. reference: nn/Reshape.scala."""
+
+    def __init__(self, size: Sequence[int], batch_mode: Optional[bool] = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if self.batch_mode:
+            return jnp.reshape(x, (x.shape[0],) + self.size), state
+        return jnp.reshape(x, self.size), state
+
+    def output_shape(self, input_shape):
+        if self.batch_mode:
+            return (input_shape[0],) + self.size
+        return self.size
+
+
+class View(Module):
+    """Reshape with one -1 wildcard allowed. reference: nn/View.scala."""
+
+    def __init__(self, *sizes: int, num_input_dims: int = 0, name: Optional[str] = None):
+        super().__init__(name)
+        self.sizes = tuple(sizes[0]) if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)) else tuple(sizes)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.reshape(x, (x.shape[0],) + self.sizes), state
+
+    def output_shape(self, input_shape):
+        n = input_shape[0]
+        if -1 in self.sizes:
+            total = int(np.prod(input_shape[1:]))
+            known = -int(np.prod(self.sizes))
+            out = tuple(total // known if s == -1 else s for s in self.sizes)
+            return (n,) + out
+        return (n,) + self.sizes
+
+
+class Flatten(Module):
+    """Flatten non-batch dims (keras-style; reference InferReshape(-1))."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.reshape(x, (x.shape[0], -1)), state
+
+    def output_shape(self, input_shape):
+        return (input_shape[0], int(np.prod(input_shape[1:])))
+
+
+class Squeeze(Module):
+    def __init__(self, dim: Optional[int] = None, name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.squeeze(x, axis=self.dim), state
+
+    def output_shape(self, input_shape):
+        if self.dim is None:
+            return tuple(s for s in input_shape if s != 1)
+        d = self.dim % len(input_shape)
+        if input_shape[d] != 1:
+            raise ValueError(
+                f"{self.name}: cannot squeeze dim {self.dim} of size {input_shape[d]}")
+        return tuple(s for i, s in enumerate(input_shape) if i != d)
+
+
+class Unsqueeze(Module):
+    def __init__(self, dim: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.expand_dims(x, self.dim), state
+
+    def output_shape(self, input_shape):
+        s = list(input_shape)
+        s.insert(self.dim % (len(s) + 1), 1)
+        return tuple(s)
+
+
+class Transpose(Module):
+    """Swap listed axis pairs in order. reference: nn/Transpose.scala."""
+
+    def __init__(self, permutations: Sequence[Tuple[int, int]], name: Optional[str] = None):
+        super().__init__(name)
+        self.permutations = [tuple(p) for p in permutations]
+
+    def _perm(self, ndim):
+        axes = list(range(ndim))
+        for a, b in self.permutations:
+            axes[a], axes[b] = axes[b], axes[a]
+        return axes
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.transpose(x, self._perm(x.ndim)), state
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape[i] for i in self._perm(len(input_shape)))
+
+
+class Contiguous(Module):
+    """No-op on TPU (XLA owns memory layout). reference: nn/Contiguous.scala."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x, state
+
+
+class Identity(Module):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x, state
+
+
+class Select(Module):
+    """Index one slice along an axis. reference: nn/Select.scala."""
+
+    def __init__(self, dim: int, index: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.dim, self.index = dim, index
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.take(x, self.index, axis=self.dim), state
+
+    def output_shape(self, input_shape):
+        return tuple(s for i, s in enumerate(input_shape) if i != self.dim % len(input_shape))
+
+
+class Narrow(Module):
+    """Slice [offset, offset+length) along an axis. reference: nn/Narrow.scala."""
+
+    def __init__(self, dim: int, offset: int, length: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.dim, self.offset, self.length = dim, offset, length
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        idx = [slice(None)] * x.ndim
+        idx[self.dim] = slice(self.offset, self.offset + self.length)
+        return x[tuple(idx)], state
+
+    def output_shape(self, input_shape):
+        s = list(input_shape)
+        s[self.dim] = self.length
+        return tuple(s)
+
+
+class SplitTable(Module):
+    """Split an axis into a Table of slices. reference: nn/SplitTable.scala."""
+
+    def __init__(self, dim: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        n = x.shape[self.dim]
+        parts = jnp.split(x, n, axis=self.dim)
+        t = Table(*[jnp.squeeze(p, axis=self.dim) for p in parts])
+        return t, state
+
+
+class JoinTable(Module):
+    """Concatenate a Table of tensors along an axis. reference: nn/JoinTable.scala."""
+
+    def __init__(self, dim: int, n_input_dims: int = 0, name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        parts = list(x) if isinstance(x, Table) else list(x)
+        return jnp.concatenate(parts, axis=self.dim), state
+
+    def output_shape(self, input_shape):
+        shapes = list(input_shape)
+        out = list(shapes[0])
+        out[self.dim] = sum(s[self.dim] for s in shapes)
+        return tuple(out)
+
+
+class Padding(Module):
+    """Pad `pad` entries (sign = side) along a dim. reference: nn/Padding.scala."""
+
+    def __init__(self, dim: int, pad: int, n_input_dim: int = 0, value: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dim, self.pad, self.value = dim, pad, value
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        widths = [(0, 0)] * x.ndim
+        widths[self.dim] = (abs(self.pad), 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(x, widths, constant_values=self.value), state
+
+    def output_shape(self, input_shape):
+        s = list(input_shape)
+        s[self.dim] += abs(self.pad)
+        return tuple(s)

@@ -1,0 +1,142 @@
+"""Table-routing containers.
+
+Reference: nn/ConcatTable.scala (one input -> Table of branch outputs),
+nn/ParallelTable.scala (Table in -> Table out, childwise),
+nn/MapTable.scala (same module over each element), nn/SelectTable.scala,
+nn/FlattenTable.scala.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from bigdl_tpu.core.table import Table
+from bigdl_tpu.nn.module import Container, Module, child_rng
+
+
+class ConcatTable(Container):
+    """Apply each child to the same input; output a Table.
+    reference: nn/ConcatTable.scala."""
+
+    def __init__(self, *modules: Module, name: Optional[str] = None):
+        super().__init__(name)
+        for m in modules:
+            self.add(m)
+
+    def build(self, rng, input_shape):
+        params, state = {}, {}
+        shapes = Table()
+        for i, (key, m) in enumerate(self.children.items()):
+            p, s, out = m.build(jax.random.fold_in(rng, i), input_shape)
+            params[key], state[key] = p, s
+            shapes[i + 1] = out
+        return params, state, shapes
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        out = Table()
+        new_state = {}
+        for i, (key, m) in enumerate(self.children.items()):
+            y, new_state[key] = m.apply(params[key], state[key], x,
+                                        training=training, rng=child_rng(rng, i))
+            out[i + 1] = y
+        return out, new_state
+
+    def output_shape(self, input_shape):
+        t = Table()
+        for i, m in enumerate(self.children.values()):
+            t[i + 1] = m.output_shape(input_shape)
+        return t
+
+
+class ParallelTable(Container):
+    """i-th child consumes i-th table element. reference: nn/ParallelTable.scala."""
+
+    def __init__(self, *modules: Module, name: Optional[str] = None):
+        super().__init__(name)
+        for m in modules:
+            self.add(m)
+
+    def build(self, rng, input_shape):
+        params, state = {}, {}
+        shapes = Table()
+        inputs = list(input_shape) if isinstance(input_shape, Table) else list(input_shape)
+        for i, (key, m) in enumerate(self.children.items()):
+            p, s, out = m.build(jax.random.fold_in(rng, i), inputs[i])
+            params[key], state[key] = p, s
+            shapes[i + 1] = out
+        return params, state, shapes
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        items = list(x) if isinstance(x, Table) else list(x)
+        out = Table()
+        new_state = {}
+        for i, (key, m) in enumerate(self.children.items()):
+            y, new_state[key] = m.apply(params[key], state[key], items[i],
+                                        training=training, rng=child_rng(rng, i))
+            out[i + 1] = y
+        return out, new_state
+
+
+class MapTable(Container):
+    """Same module applied to each table element (shared params).
+    reference: nn/MapTable.scala."""
+
+    def __init__(self, module: Module, name: Optional[str] = None):
+        super().__init__(name)
+        self.add(module)
+
+    def build(self, rng, input_shape):
+        inner = self[0]
+        items = list(input_shape) if isinstance(input_shape, Table) else list(input_shape)
+        p, s, _ = inner.build(rng, items[0])
+        shapes = Table(*[inner.output_shape(sh) for sh in items])
+        return {"0": p}, {"0": s}, shapes
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        inner = self[0]
+        items = list(x) if isinstance(x, Table) else list(x)
+        out = Table()
+        s = state["0"]
+        for i, item in enumerate(items):
+            y, s = inner.apply(params["0"], s, item, training=training,
+                               rng=child_rng(rng, i))
+            out[i + 1] = y
+        return out, {"0": s}
+
+
+class SelectTable(Module):
+    """Pick the k-th (1-based, like the reference) element.
+    reference: nn/SelectTable.scala."""
+
+    def __init__(self, index: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.index = index
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if isinstance(x, Table):
+            return x[self.index], state
+        return x[self.index - 1], state
+
+    def output_shape(self, input_shape):
+        if isinstance(input_shape, Table):
+            return input_shape[self.index]
+        return input_shape[self.index - 1]
+
+
+class FlattenTable(Module):
+    """Flatten nested Tables into one flat Table. reference: nn/FlattenTable.scala."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        flat = []
+
+        def rec(t):
+            if isinstance(t, (Table, list, tuple)):
+                for v in t:
+                    rec(v)
+            else:
+                flat.append(t)
+
+        rec(x)
+        return Table(*flat), state

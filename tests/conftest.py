@@ -1,0 +1,23 @@
+"""Test configuration: force an 8-virtual-device CPU platform BEFORE jax
+backends initialize, so multi-chip sharding paths are exercised in one
+process — the analogue of the reference testing its BlockManager allreduce
+with SparkContext("local[N]") (survey §4)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# Full-precision matmuls for differential tests against torch CPU (on TPU the
+# framework default stays at the fast bf16-pass precision).
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture()
+def rng():
+    return jax.random.PRNGKey(0)
