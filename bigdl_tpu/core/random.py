@@ -44,8 +44,13 @@ class RandomGenerator:
 
     @classmethod
     def key_for(cls, name: str, step: Optional[int] = None) -> jax.Array:
-        """Deterministic named stream (e.g. 'dropout', 'shuffle')."""
-        key = jax.random.fold_in(jax.random.PRNGKey(cls._seed), hash(name) & 0x7FFFFFFF)
+        """Deterministic named stream (e.g. 'dropout', 'shuffle').  Uses a
+        stable hash (crc32), NOT python's salted hash(), so every process of
+        a multi-host job derives the same key for the same name."""
+        import zlib
+
+        tag = zlib.crc32(name.encode()) & 0x7FFFFFFF
+        key = jax.random.fold_in(jax.random.PRNGKey(cls._seed), tag)
         if step is not None:
             key = jax.random.fold_in(key, step)
         return key

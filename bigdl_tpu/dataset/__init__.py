@@ -1,0 +1,7 @@
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.minibatch import MiniBatch
+from bigdl_tpu.dataset.transformer import Transformer, SampleToMiniBatch
+from bigdl_tpu.dataset.dataset import DataSet, LocalDataSet, ArrayDataSet
+
+__all__ = ["Sample", "MiniBatch", "Transformer", "SampleToMiniBatch",
+           "DataSet", "LocalDataSet", "ArrayDataSet"]

@@ -1,0 +1,85 @@
+"""DataSet abstractions.
+
+Reference: dataset/DataSet.scala:57-68 (AbstractDataSet{data, shuffle,
+size}), LocalDataSet (:113), DistributedDataSet (:167), factories
+DataSet.array/rdd/ImageFolder (:322-482).
+
+TPU redesign: there is no RDD; every process hosts the same logical
+dataset and the trainer device_puts each global batch with the right
+sharding (each host materializes only its shard of the batch under
+multi-host jax.make_array_from_process_local_data).  `ArrayDataSet` is the
+in-memory path (the DataSet.array analogue); sharded-file datasets
+(ImageNet) live in bigdl_tpu/dataset/image.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.core.random import RandomGenerator
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+class DataSet:
+    """reference: dataset/DataSet.scala:57 (AbstractDataSet)."""
+
+    def data(self, train: bool) -> Iterator[Any]:
+        """One pass over the data (shuffled if train)."""
+        raise NotImplementedError
+
+    def shuffle(self) -> None:
+        pass
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def transform(self, transformer: Transformer) -> "TransformedDataSet":
+        """reference: AbstractDataSet.transform / `->` (DataSet.scala:65)."""
+        return TransformedDataSet(self, transformer)
+
+    # factory, reference: DataSet.array (DataSet.scala:322)
+    @staticmethod
+    def array(data: Sequence[Any]) -> "ArrayDataSet":
+        return ArrayDataSet(list(data))
+
+
+class ArrayDataSet(DataSet):
+    """In-memory dataset with epoch shuffling (seeded via RandomGenerator,
+    matching the reference's deterministic shuffle)."""
+
+    def __init__(self, items: List[Any]):
+        self.items = list(items)
+        self._epoch = 0
+
+    def size(self) -> int:
+        return len(self.items)
+
+    def data(self, train: bool) -> Iterator[Any]:
+        if train:
+            idx = np.arange(len(self.items))
+            rs = np.random.RandomState(RandomGenerator.get_seed() + self._epoch)
+            rs.shuffle(idx)
+            self._epoch += 1
+            return (self.items[i] for i in idx)
+        return iter(self.items)
+
+
+LocalDataSet = ArrayDataSet
+
+
+class TransformedDataSet(DataSet):
+    def __init__(self, base: DataSet, transformer: Transformer):
+        self.base = base
+        self.transformer = transformer
+
+    def size(self) -> int:
+        return self.base.size()
+
+    def data(self, train: bool) -> Iterator[Any]:
+        return self.transformer(self.base.data(train))
+
+    def transform(self, transformer: Transformer) -> "TransformedDataSet":
+        return TransformedDataSet(self.base, self.transformer >> transformer)

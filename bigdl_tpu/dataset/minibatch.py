@@ -1,0 +1,81 @@
+"""MiniBatch — a batch of Samples.
+
+Reference: dataset/MiniBatch.scala:34-91 (getInput/getTarget/slice/set),
+ArrayTensorMiniBatch (:111).  Inputs/targets are numpy arrays (or tuples
+for multi-io); the trainer device_puts them with the right sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+
+
+class MiniBatch:
+    """reference: dataset/MiniBatch.scala:34."""
+
+    def __init__(self, input: Any, target: Optional[Any] = None):
+        self.input = input
+        self.target = target
+
+    def get_input(self) -> Any:
+        return self.input
+
+    def get_target(self) -> Any:
+        return self.target
+
+    def size(self) -> int:
+        first = self.input[0] if isinstance(self.input, (tuple, list)) else self.input
+        return int(first.shape[0])
+
+    def slice(self, offset: int, length: int) -> "MiniBatch":
+        """0-based slice (the reference is 1-based)."""
+
+        def sl(x):
+            if isinstance(x, (tuple, list)):
+                return type(x)(sl(v) for v in x)
+            return x[offset:offset + length]
+
+        return MiniBatch(sl(self.input), sl(self.target) if self.target is not None else None)
+
+    @staticmethod
+    def from_samples(samples: Sequence[Sample],
+                     feature_padding: Optional[float] = None,
+                     label_padding: Optional[float] = None) -> "MiniBatch":
+        """Stack samples; optionally pad variable-length features to the
+        batch max (reference: SampleToMiniBatch padding params,
+        dataset/MiniBatch.scala:579+)."""
+        feats = [np.asarray(s.feature) for s in samples]
+        if feature_padding is not None:
+            feats = _pad_stack(feats, feature_padding)
+        else:
+            feats = np.stack(feats)
+        labels = None
+        if samples[0].label is not None:
+            labs = [np.asarray(s.label) for s in samples]
+            if label_padding is not None:
+                labels = _pad_stack(labs, label_padding)
+            else:
+                labels = np.stack(labs)
+        return MiniBatch(feats, labels)
+
+    def __repr__(self):
+        def sh(x):
+            if isinstance(x, (tuple, list)):
+                return tuple(sh(v) for v in x)
+            return tuple(x.shape)
+
+        return f"MiniBatch(input={sh(self.input)}, target={sh(self.target) if self.target is not None else None})"
+
+
+def _pad_stack(arrays: List[np.ndarray], pad_value: float) -> np.ndarray:
+    ndim = arrays[0].ndim
+    max_shape = [max(a.shape[d] for a in arrays) for d in range(ndim)]
+    out = np.full((len(arrays),) + tuple(max_shape), pad_value, arrays[0].dtype)
+    for i, a in enumerate(arrays):
+        sl = (i,) + tuple(slice(0, s) for s in a.shape)
+        out[sl] = a
+    return out

@@ -1,0 +1,41 @@
+"""Sample — one training record.
+
+Reference: dataset/Sample.scala:32,138,250 (ArraySample: feature tensors +
+label tensors packed contiguously).  Here a Sample is a light pair of
+numpy arrays (or tuples of arrays for multi-input models); contiguous
+packing is pointless on the host side — batching is where device layout
+begins.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+ArrayLike = Union[np.ndarray, Sequence[np.ndarray]]
+
+
+class Sample:
+    """One record: feature(s) + label(s). reference: dataset/Sample.scala:32."""
+
+    __slots__ = ("feature", "label")
+
+    def __init__(self, feature: ArrayLike, label: Optional[ArrayLike] = None):
+        self.feature = feature
+        self.label = label
+
+    @staticmethod
+    def from_ndarray(feature: np.ndarray, label: Optional[Any] = None) -> "Sample":
+        if label is not None and np.isscalar(label):
+            label = np.asarray(label)
+        return Sample(np.asarray(feature), label)
+
+    def feature_size(self) -> Tuple[int, ...]:
+        return tuple(np.asarray(self.feature).shape)
+
+    def label_size(self) -> Tuple[int, ...]:
+        return tuple(np.asarray(self.label).shape) if self.label is not None else ()
+
+    def __repr__(self):
+        return f"Sample(feature={self.feature_size()}, label={self.label_size()})"

@@ -1,0 +1,80 @@
+"""Transformer — composable preprocessing combinators.
+
+Reference: dataset/Transformer.scala:44-50,86 — a serializable
+`Iterator[A] -> Iterator[B]` chained with `->`, used identically on the
+local and RDD paths.  Here a Transformer is `__call__(iterator) ->
+iterator` chained with `>>` (python has no `->` operator); it runs on the
+HOST (numpy), feeding the device via MiniBatch.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.minibatch import MiniBatch
+from bigdl_tpu.dataset.sample import Sample
+
+
+class Transformer:
+    """reference: dataset/Transformer.scala:44."""
+
+    def __call__(self, it: Iterator[Any]) -> Iterator[Any]:
+        raise NotImplementedError
+
+    def __rshift__(self, other: "Transformer") -> "ChainedTransformer":
+        """`a >> b` pipes a's output into b (the reference's `->`)."""
+        return ChainedTransformer([self, other])
+
+    def apply_to(self, data: Iterable[Any]) -> Iterator[Any]:
+        return self(iter(data))
+
+
+class ChainedTransformer(Transformer):
+    def __init__(self, stages: List[Transformer]):
+        self.stages = list(stages)
+
+    def __call__(self, it: Iterator[Any]) -> Iterator[Any]:
+        for s in self.stages:
+            it = s(it)
+        return it
+
+    def __rshift__(self, other: Transformer) -> "ChainedTransformer":
+        return ChainedTransformer(self.stages + [other])
+
+
+class FnTransformer(Transformer):
+    """Wrap a per-element function."""
+
+    def __init__(self, fn: Callable[[Any], Any]):
+        self.fn = fn
+
+    def __call__(self, it: Iterator[Any]) -> Iterator[Any]:
+        return (self.fn(x) for x in it)
+
+
+class SampleToMiniBatch(Transformer):
+    """Group Samples into MiniBatches.
+    reference: dataset/MiniBatch.scala SampleToMiniBatch (:579+).
+
+    `drop_remainder=True` keeps batch shapes static for XLA (the trailing
+    partial batch would force a recompile; the reference pads instead)."""
+
+    def __init__(self, batch_size: int, feature_padding: Optional[float] = None,
+                 label_padding: Optional[float] = None, drop_remainder: bool = True):
+        self.batch_size = batch_size
+        self.feature_padding = feature_padding
+        self.label_padding = label_padding
+        self.drop_remainder = drop_remainder
+
+    def __call__(self, it: Iterator[Sample]) -> Iterator[MiniBatch]:
+        buf: List[Sample] = []
+        for s in it:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield MiniBatch.from_samples(buf, self.feature_padding, self.label_padding)
+                buf = []
+        if buf and not self.drop_remainder:
+            yield MiniBatch.from_samples(buf, self.feature_padding, self.label_padding)

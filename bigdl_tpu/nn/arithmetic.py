@@ -65,7 +65,7 @@ class CMinTable(_TableReduce):
     _op = staticmethod(jnp.minimum)
 
 
-class CAveTable(Module):
+class CAveTable(_TableReduce):
     def apply(self, params, state, x, *, training=False, rng=None):
         items = _items(x)
         return sum(items) / len(items), state

@@ -316,6 +316,11 @@ class TimeDistributedCriterion(Criterion):
         flat_in = jnp.reshape(input, (n * t,) + input.shape[2:])
         flat_t = jnp.reshape(target, (n * t,) + target.shape[2:])
         total = self.criterion.forward(flat_in, flat_t)
-        # inner criterion averages over n*t; reference divides by T only when
-        # sizeAverage is set at this level
-        return total if self.size_average else total * t
+        # reference semantics: sum over timesteps of the per-timestep loss,
+        # divided by T iff sizeAverage is set at THIS level.  Whether the
+        # flat total needs rescaling depends on the inner reduction:
+        # mean-reducing inner -> flat mean * T == sum_t(mean_n); sum-reducing
+        # inner -> flat sum already == sum_t(sum_n).
+        inner_avg = getattr(self.criterion, "size_average", True)
+        sum_over_t = total * t if inner_avg else total
+        return sum_over_t / t if self.size_average else sum_over_t

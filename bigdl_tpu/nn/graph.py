@@ -87,6 +87,9 @@ class Graph(Container):
 
     def apply(self, params, state, x, *, training=False, rng=None):
         xs = [x] if not isinstance(x, (list, Table)) else list(x)
+        if len(xs) != len(self.input_nodes):
+            raise ValueError(
+                f"graph has {len(self.input_nodes)} inputs, got {len(xs)} activities")
         values: Dict[int, Any] = {}
         for node, v in zip(self.input_nodes, xs):
             values[id(node)] = v

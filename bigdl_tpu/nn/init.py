@@ -44,6 +44,8 @@ class RandomUniform(InitializationMethod):
     (reference: nn/InitializationMethod.scala RandomUniform)."""
 
     def __init__(self, lower: Optional[float] = None, upper: Optional[float] = None):
+        if (lower is None) != (upper is None):
+            raise ValueError("RandomUniform needs both bounds or neither")
         self.lower, self.upper = lower, upper
 
     def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):

@@ -61,14 +61,21 @@ class BatchNormalization(Module):
         if training:
             mean = jnp.mean(x, axis=self._reduce_axes)
             mean2 = jnp.mean(jnp.square(x), axis=self._reduce_axes)
+            n = 1
+            for ax in self._reduce_axes:
+                n *= x.shape[ax]
             if self.axis_name is not None:
                 mean = lax.pmean(mean, self.axis_name)
                 mean2 = lax.pmean(mean2, self.axis_name)
+                n = n * lax.psum(1, self.axis_name)
             var = mean2 - jnp.square(mean)
             m = self.momentum
+            # running stats use the UNBIASED variance (n/(n-1)), matching
+            # torch and the reference's runningVar semantics
+            unbiased = var * (n / jnp.maximum(n - 1, 1))
             new_state = {
                 "running_mean": (1 - m) * state["running_mean"] + m * mean,
-                "running_var": (1 - m) * state["running_var"] + m * var,
+                "running_var": (1 - m) * state["running_var"] + m * unbiased,
             }
         else:
             mean, var = state["running_mean"], state["running_var"]

@@ -189,7 +189,7 @@ class JoinTable(Module):
         self.dim = dim
 
     def apply(self, params, state, x, *, training=False, rng=None):
-        parts = list(x) if isinstance(x, Table) else list(x)
+        parts = list(x)
         return jnp.concatenate(parts, axis=self.dim), state
 
     def output_shape(self, input_shape):

@@ -61,7 +61,7 @@ class ParallelTable(Container):
     def build(self, rng, input_shape):
         params, state = {}, {}
         shapes = Table()
-        inputs = list(input_shape) if isinstance(input_shape, Table) else list(input_shape)
+        inputs = list(input_shape)
         for i, (key, m) in enumerate(self.children.items()):
             p, s, out = m.build(jax.random.fold_in(rng, i), inputs[i])
             params[key], state[key] = p, s
@@ -69,7 +69,7 @@ class ParallelTable(Container):
         return params, state, shapes
 
     def apply(self, params, state, x, *, training=False, rng=None):
-        items = list(x) if isinstance(x, Table) else list(x)
+        items = list(x)
         out = Table()
         new_state = {}
         for i, (key, m) in enumerate(self.children.items()):
@@ -89,14 +89,14 @@ class MapTable(Container):
 
     def build(self, rng, input_shape):
         inner = self[0]
-        items = list(input_shape) if isinstance(input_shape, Table) else list(input_shape)
+        items = list(input_shape)
         p, s, _ = inner.build(rng, items[0])
         shapes = Table(*[inner.output_shape(sh) for sh in items])
         return {"0": p}, {"0": s}, shapes
 
     def apply(self, params, state, x, *, training=False, rng=None):
         inner = self[0]
-        items = list(x) if isinstance(x, Table) else list(x)
+        items = list(x)
         out = Table()
         s = state["0"]
         for i, item in enumerate(items):

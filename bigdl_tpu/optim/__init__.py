@@ -1,0 +1,27 @@
+"""Optimization & training.
+
+Reference packages: optim/ (Optimizer, DistriOptimizer, LocalOptimizer,
+OptimMethod zoo, Trigger, ValidationMethod) and parameters/
+(AllReduceParameter — replaced by XLA collectives; see optimizer.py).
+"""
+
+from bigdl_tpu.optim.optim_method import (
+    OptimMethod, SGD, Adam, ParallelAdam, Adamax, Adadelta, Adagrad,
+    RMSprop, Ftrl,
+)
+from bigdl_tpu.optim import schedules
+from bigdl_tpu.optim.schedules import (
+    Default, Poly, Step, MultiStep, EpochDecay, EpochStep, NaturalExp,
+    Exponential, Warmup, SequentialSchedule, EpochSchedule,
+    EpochDecayWithWarmUp, Plateau,
+)
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import (
+    ValidationMethod, ValidationResult, Top1Accuracy, Top5Accuracy, Loss,
+    MAE, HitRatio, NDCG,
+)
+from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.optim.parameter_processor import (
+    ParameterProcessor, ConstantClippingProcessor, L2NormClippingProcessor,
+)
+from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer, DistriOptimizer

@@ -1,0 +1,51 @@
+"""Named per-phase metrics.
+
+Reference: optim/Metrics.scala:31-103 — Spark-accumulator-backed named
+counters ("computing time average", "put gradient", ...) summarized per
+iteration.  Here there is no cross-process accumulation to do (the train
+step is one compiled program), so Metrics is a host-side registry of named
+timers/counters feeding the driver log and TrainSummary.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Dict
+
+
+class Metrics:
+    def __init__(self):
+        self._sums: Dict[str, float] = defaultdict(float)
+        self._counts: Dict[str, int] = defaultdict(int)
+
+    def add(self, name: str, value: float) -> None:
+        self._sums[name] += value
+        self._counts[name] += 1
+
+    def set(self, name: str, value: float) -> None:
+        self._sums[name] = value
+        self._counts[name] = 1
+
+    def get(self, name: str) -> float:
+        c = self._counts[name]
+        return self._sums[name] / c if c else 0.0
+
+    def summary(self) -> str:
+        parts = [f"{k}: {self.get(k):.6g}" for k in sorted(self._sums)]
+        return "[" + ", ".join(parts) + "]"
+
+    class Timer:
+        def __init__(self, metrics: "Metrics", name: str):
+            self.metrics = metrics
+            self.name = name
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self.metrics.add(self.name, time.perf_counter() - self.t0)
+
+    def timer(self, name: str) -> "Metrics.Timer":
+        return Metrics.Timer(self, name)

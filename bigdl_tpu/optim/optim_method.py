@@ -1,0 +1,281 @@
+"""Optimization methods (the OptimMethod zoo).
+
+Reference: optim/OptimMethod.scala + SGD/Adam/ParallelAdam/Adamax/Adadelta/
+Adagrad/RMSprop/Ftrl (optim/*.scala).  The reference mutates a flattened
+1-D parameter tensor in place with a `Table` state bag; here each method is
+a pure pytree transform
+
+    opt_state = method.init(params)
+    params, opt_state = method.step(grads, params, opt_state[, lr])
+
+that traces into the jitted train step.  Counters (`neval`, `epoch`) live in
+opt_state so LR schedules compute on-device.  `ParallelAdam` (the
+reference's multi-threaded Adam) is an alias for `Adam`: intra-host
+parallelism is XLA's job on TPU.
+
+Weight decay follows the reference semantics (L2 added to the gradient
+before momentum, optim/SGD.scala) — not decoupled AdamW; `Ftrl` matches the
+TF/reference formulation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.optim.schedules import Default, LearningRateSchedule
+
+
+def _tree_map(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+class OptimMethod:
+    """Base. reference: optim/OptimMethod.scala."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 schedule: Optional[LearningRateSchedule] = None):
+        self.learning_rate = learning_rate
+        self.schedule = schedule
+
+    # ------------------------------------------------------------------
+    def init(self, params: Any) -> Dict[str, Any]:
+        state = self._init_slots(params)
+        state["neval"] = jnp.zeros((), jnp.int32)
+        state["epoch"] = jnp.zeros((), jnp.int32)
+        return state
+
+    def _init_slots(self, params: Any) -> Dict[str, Any]:
+        return {}
+
+    def current_lr(self, opt_state: Dict[str, Any]):
+        it = opt_state["neval"]
+        ep = opt_state["epoch"]
+        if self.schedule is None:
+            return jnp.asarray(self.learning_rate, jnp.float32)
+        return self.schedule(jnp.asarray(self.learning_rate, jnp.float32), it, ep)
+
+    def step(self, grads: Any, params: Any, opt_state: Dict[str, Any],
+             lr: Optional[jnp.ndarray] = None):
+        """Pure update; returns (new_params, new_opt_state)."""
+        raise NotImplementedError
+
+    def get_hyper_parameter(self) -> str:
+        return f"lr={self.learning_rate}"
+
+
+class SGD(OptimMethod):
+    """SGD with momentum/nesterov/dampening/weightDecay + schedules.
+    reference: optim/SGD.scala:39."""
+
+    def __init__(self, learning_rate: float = 1e-3, learning_rate_decay: float = 0.0,
+                 weight_decay: float = 0.0, momentum: float = 0.0,
+                 dampening: Optional[float] = None, nesterov: bool = False,
+                 schedule: Optional[LearningRateSchedule] = None):
+        if schedule is None and learning_rate_decay > 0.0:
+            schedule = Default(learning_rate_decay)
+        super().__init__(learning_rate, schedule)
+        self.weight_decay = weight_decay
+        self.momentum = momentum
+        self.dampening = momentum if dampening is None else dampening
+        self.nesterov = nesterov
+        if nesterov and (momentum <= 0 or self.dampening != 0):
+            raise ValueError("nesterov requires momentum > 0 and dampening = 0")
+
+    def _init_slots(self, params):
+        if self.momentum > 0:
+            return {"velocity": _tree_map(jnp.zeros_like, params)}
+        return {}
+
+    def step(self, grads, params, opt_state, lr=None):
+        lr = self.current_lr(opt_state) if lr is None else lr
+        if self.weight_decay > 0:
+            grads = _tree_map(lambda g, p: g + self.weight_decay * p, grads, params)
+        if self.momentum > 0:
+            vel = _tree_map(
+                lambda v, g: self.momentum * v + (1.0 - self.dampening) * g,
+                opt_state["velocity"], grads)
+            if self.nesterov:
+                upd = _tree_map(lambda g, v: g + self.momentum * v, grads, vel)
+            else:
+                upd = vel
+            new_params = _tree_map(lambda p, u: p - lr * u, params, upd)
+            new_state = dict(opt_state, velocity=vel, neval=opt_state["neval"] + 1)
+        else:
+            new_params = _tree_map(lambda p, g: p - lr * g, params, grads)
+            new_state = dict(opt_state, neval=opt_state["neval"] + 1)
+        return new_params, new_state
+
+
+class Adam(OptimMethod):
+    """reference: optim/Adam.scala (and ParallelAdam.scala — on TPU the
+    multi-threaded variant is the same compiled program)."""
+
+    def __init__(self, learning_rate: float = 1e-3, learning_rate_decay: float = 0.0,
+                 beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8,
+                 schedule: Optional[LearningRateSchedule] = None):
+        if schedule is None and learning_rate_decay > 0.0:
+            schedule = Default(learning_rate_decay)
+        super().__init__(learning_rate, schedule)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, params):
+        return {"m": _tree_map(jnp.zeros_like, params),
+                "v": _tree_map(jnp.zeros_like, params)}
+
+    def step(self, grads, params, opt_state, lr=None):
+        lr = self.current_lr(opt_state) if lr is None else lr
+        t = opt_state["neval"] + 1
+        b1, b2 = self.beta1, self.beta2
+        m = _tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, opt_state["m"], grads)
+        v = _tree_map(lambda v_, g: b2 * v_ + (1 - b2) * jnp.square(g), opt_state["v"], grads)
+        bc1 = 1 - b1 ** t.astype(jnp.float32)
+        bc2 = 1 - b2 ** t.astype(jnp.float32)
+        new_params = _tree_map(
+            lambda p, m_, v_: p - lr * (m_ / bc1) / (jnp.sqrt(v_ / bc2) + self.epsilon),
+            params, m, v)
+        return new_params, dict(opt_state, m=m, v=v, neval=t)
+
+
+ParallelAdam = Adam
+
+
+class Adamax(OptimMethod):
+    """reference: optim/Adamax.scala."""
+
+    def __init__(self, learning_rate: float = 2e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-38):
+        super().__init__(learning_rate)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, params):
+        return {"m": _tree_map(jnp.zeros_like, params),
+                "u": _tree_map(jnp.zeros_like, params)}
+
+    def step(self, grads, params, opt_state, lr=None):
+        lr = self.current_lr(opt_state) if lr is None else lr
+        t = opt_state["neval"] + 1
+        b1 = self.beta1
+        m = _tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, opt_state["m"], grads)
+        u = _tree_map(lambda u_, g: jnp.maximum(self.beta2 * u_, jnp.abs(g) + self.epsilon),
+                      opt_state["u"], grads)
+        bc = 1 - b1 ** t.astype(jnp.float32)
+        new_params = _tree_map(lambda p, m_, u_: p - (lr / bc) * m_ / u_, params, m, u)
+        return new_params, dict(opt_state, m=m, u=u, neval=t)
+
+
+class Adadelta(OptimMethod):
+    """reference: optim/Adadelta.scala."""
+
+    def __init__(self, decay_rate: float = 0.9, epsilon: float = 1e-10):
+        super().__init__(1.0)
+        self.rho = decay_rate
+        self.epsilon = epsilon
+
+    def _init_slots(self, params):
+        return {"accum": _tree_map(jnp.zeros_like, params),
+                "accum_update": _tree_map(jnp.zeros_like, params)}
+
+    def step(self, grads, params, opt_state, lr=None):
+        rho, eps = self.rho, self.epsilon
+        accum = _tree_map(lambda a, g: rho * a + (1 - rho) * jnp.square(g),
+                          opt_state["accum"], grads)
+        delta = _tree_map(
+            lambda g, a, au: g * jnp.sqrt(au + eps) / jnp.sqrt(a + eps),
+            grads, accum, opt_state["accum_update"])
+        accum_update = _tree_map(lambda au, d: rho * au + (1 - rho) * jnp.square(d),
+                                 opt_state["accum_update"], delta)
+        new_params = _tree_map(lambda p, d: p - d, params, delta)
+        return new_params, dict(opt_state, accum=accum, accum_update=accum_update,
+                                neval=opt_state["neval"] + 1)
+
+
+class Adagrad(OptimMethod):
+    """reference: optim/Adagrad.scala."""
+
+    def __init__(self, learning_rate: float = 1e-3, learning_rate_decay: float = 0.0,
+                 weight_decay: float = 0.0):
+        super().__init__(learning_rate, Default(learning_rate_decay)
+                         if learning_rate_decay > 0 else None)
+        self.weight_decay = weight_decay
+
+    def _init_slots(self, params):
+        return {"accum": _tree_map(jnp.zeros_like, params)}
+
+    def step(self, grads, params, opt_state, lr=None):
+        lr = self.current_lr(opt_state) if lr is None else lr
+        if self.weight_decay > 0:
+            grads = _tree_map(lambda g, p: g + self.weight_decay * p, grads, params)
+        accum = _tree_map(lambda a, g: a + jnp.square(g), opt_state["accum"], grads)
+        new_params = _tree_map(lambda p, g, a: p - lr * g / (jnp.sqrt(a) + 1e-10),
+                               params, grads, accum)
+        return new_params, dict(opt_state, accum=accum, neval=opt_state["neval"] + 1)
+
+
+class RMSprop(OptimMethod):
+    """reference: optim/RMSprop.scala."""
+
+    def __init__(self, learning_rate: float = 1e-2, learning_rate_decay: float = 0.0,
+                 decay_rate: float = 0.99, epsilon: float = 1e-8):
+        super().__init__(learning_rate, Default(learning_rate_decay)
+                         if learning_rate_decay > 0 else None)
+        self.decay_rate = decay_rate
+        self.epsilon = epsilon
+
+    def _init_slots(self, params):
+        return {"accum": _tree_map(jnp.zeros_like, params)}
+
+    def step(self, grads, params, opt_state, lr=None):
+        lr = self.current_lr(opt_state) if lr is None else lr
+        rho = self.decay_rate
+        accum = _tree_map(lambda a, g: rho * a + (1 - rho) * jnp.square(g),
+                          opt_state["accum"], grads)
+        new_params = _tree_map(lambda p, g, a: p - lr * g / (jnp.sqrt(a) + self.epsilon),
+                               params, grads, accum)
+        return new_params, dict(opt_state, accum=accum, neval=opt_state["neval"] + 1)
+
+
+class Ftrl(OptimMethod):
+    """Follow-the-regularized-leader. reference: optim/Ftrl.scala."""
+
+    def __init__(self, learning_rate: float = 1e-3, learning_rate_power: float = -0.5,
+                 initial_accumulator_value: float = 0.1,
+                 l1_regularization_strength: float = 0.0,
+                 l2_regularization_strength: float = 0.0,
+                 l2_shrinkage_regularization_strength: float = 0.0):
+        super().__init__(learning_rate)
+        self.lr_power = learning_rate_power
+        self.init_accum = initial_accumulator_value
+        self.l1 = l1_regularization_strength
+        self.l2 = l2_regularization_strength
+        self.l2_shrinkage = l2_shrinkage_regularization_strength
+
+    def _init_slots(self, params):
+        return {"accum": _tree_map(lambda p: jnp.full_like(p, self.init_accum), params),
+                "linear": _tree_map(jnp.zeros_like, params)}
+
+    def step(self, grads, params, opt_state, lr=None):
+        lr = self.current_lr(opt_state) if lr is None else lr
+
+        def upd(p, g, a, l):
+            g_shr = g + 2 * self.l2_shrinkage * p
+            a_new = a + jnp.square(g)
+            sigma = (a_new ** -self.lr_power - a ** -self.lr_power) / lr
+            l_new = l + g_shr - sigma * p
+            quad = a_new ** -self.lr_power / lr + 2 * self.l2
+            l_clip = jnp.clip(l_new, -self.l1, self.l1)
+            p_new = (l_clip - l_new) / quad
+            return p_new, a_new, l_new
+
+        flat_p, tdef = jax.tree_util.tree_flatten(params)
+        flat_g = jax.tree_util.tree_leaves(grads)
+        flat_a = jax.tree_util.tree_leaves(opt_state["accum"])
+        flat_l = jax.tree_util.tree_leaves(opt_state["linear"])
+        outs = [upd(p, g, a, l) for p, g, a, l in zip(flat_p, flat_g, flat_a, flat_l)]
+        new_params = jax.tree_util.tree_unflatten(tdef, [o[0] for o in outs])
+        accum = jax.tree_util.tree_unflatten(tdef, [o[1] for o in outs])
+        linear = jax.tree_util.tree_unflatten(tdef, [o[2] for o in outs])
+        return new_params, dict(opt_state, accum=accum, linear=linear,
+                                neval=opt_state["neval"] + 1)

@@ -1,0 +1,387 @@
+"""Optimizer — the training loop.
+
+Reference: optim/Optimizer.scala:47 (builder API: setValidation,
+setCheckpoint, setTrainSummary, setOptimMethod, setEndWhen,
+setGradientClipping; factory picks DistriOptimizer vs LocalOptimizer from
+the DataSet type, :602-697) and optim/DistriOptimizer.scala:49 (the
+distributed trainer detailed in survey §3.2).
+
+TPU redesign — the core claim of this framework: BigDL's entire two-Spark-
+jobs-per-iteration structure (broadcast weights -> per-core fwd/bwd ->
+fp16 BlockManager shuffle -> sharded update -> republish) collapses into
+ONE jitted train step over a device mesh:
+
+  * batch arrays are device_put with a `data`-axis NamedSharding;
+  * params/optimizer slots are replicated; XLA inserts the gradient
+    all-reduce where sharding propagation demands it (the
+    AllReduceParameter, parameters/AllReduceParameter.scala:84, is gone);
+  * fp16 wire compression is the bf16 dtype policy;
+  * `subModelNumber` intra-node replicas = the data-axis shards;
+  * straggler dropping (DistriOptimizer.scala:177-183) is meaningless on a
+    synchronous mesh — documented capability delta.
+
+LocalOptimizer and DistriOptimizer share this loop; they differ only in
+mesh (single device vs Engine.mesh()).  Failure retry from the latest
+checkpoint matches optim/DistriOptimizer.scala:855-935.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.core.engine import AXIS_DATA, Engine
+from bigdl_tpu.core.random import RandomGenerator
+from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.dataset.minibatch import MiniBatch
+from bigdl_tpu.nn.criterion import Criterion
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.optim.optim_method import OptimMethod, SGD
+from bigdl_tpu.optim.parameter_processor import (
+    ConstantClippingProcessor,
+    L2NormClippingProcessor,
+    ParameterProcessor,
+)
+from bigdl_tpu.optim.schedules import Plateau
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+from bigdl_tpu.utils.checkpoint import latest_checkpoint, load_checkpoint, save_checkpoint
+from bigdl_tpu.utils.summary import TrainSummary, ValidationSummary
+
+logger = logging.getLogger("bigdl_tpu.optim")
+
+
+class Optimizer:
+    """Builder + training loop. reference: optim/Optimizer.scala:47."""
+
+    def __init__(self, model: Module, dataset: DataSet, criterion: Criterion,
+                 optim_method: Optional[OptimMethod] = None,
+                 mesh: Optional[Mesh] = None,
+                 end_trigger: Optional[Trigger] = None):
+        self.model = model
+        self.dataset = dataset
+        self.criterion = criterion
+        self.optim_method = optim_method or SGD()
+        self.mesh = mesh
+        self.end_when = end_trigger or Trigger.max_epoch(1)
+        # validation
+        self.val_trigger: Optional[Trigger] = None
+        self.val_dataset: Optional[DataSet] = None
+        self.val_methods: Optional[List[ValidationMethod]] = None
+        # checkpoint
+        self.ckpt_path: Optional[str] = None
+        self.ckpt_trigger: Optional[Trigger] = None
+        # summaries
+        self.train_summary: Optional[TrainSummary] = None
+        self.val_summary: Optional[ValidationSummary] = None
+        # gradient processing
+        self.processors: List[ParameterProcessor] = []
+        # state
+        self.params = None
+        self.model_state = None
+        self.opt_state = None
+        self.metrics = Metrics()
+        self._compiled = None
+        self._driver_state: Dict[str, Any] = {"epoch": 0, "neval": 0, "loss": None,
+                                              "score": None, "epoch_finished": False}
+
+    # ------------------------------------------------------------------
+    # Builder API (reference: optim/Optimizer.scala:111-452)
+    # ------------------------------------------------------------------
+
+    def set_validation(self, trigger: Trigger, dataset: DataSet,
+                       methods: Sequence[ValidationMethod]) -> "Optimizer":
+        self.val_trigger = trigger
+        self.val_dataset = dataset
+        self.val_methods = list(methods)
+        return self
+
+    def set_checkpoint(self, path: str, trigger: Trigger) -> "Optimizer":
+        self.ckpt_path = path
+        self.ckpt_trigger = trigger
+        return self
+
+    def set_train_summary(self, summary: TrainSummary) -> "Optimizer":
+        self.train_summary = summary
+        return self
+
+    def set_val_summary(self, summary: ValidationSummary) -> "Optimizer":
+        self.val_summary = summary
+        return self
+
+    def set_end_when(self, trigger: Trigger) -> "Optimizer":
+        self.end_when = trigger
+        return self
+
+    def set_optim_method(self, method: OptimMethod) -> "Optimizer":
+        self.optim_method = method
+        return self
+
+    def set_gradient_clipping_by_value(self, min_value: float, max_value: float) -> "Optimizer":
+        self.processors.append(ConstantClippingProcessor(min_value, max_value))
+        return self
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm: float) -> "Optimizer":
+        self.processors.append(L2NormClippingProcessor(clip_norm))
+        return self
+
+    def disable_gradient_clipping(self) -> "Optimizer":
+        self.processors = []
+        return self
+
+    # ------------------------------------------------------------------
+    # Compilation
+    # ------------------------------------------------------------------
+
+    def _batch_sharding(self):
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, P(AXIS_DATA))
+
+    def _replicated(self):
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, P())
+
+    def _put_batch(self, arr):
+        sh = self._batch_sharding()
+        if sh is None:
+            return jnp.asarray(arr)
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sh, np.asarray(arr))
+        return jax.device_put(jnp.asarray(arr), sh)
+
+    def _put_replicated(self, tree):
+        sh = self._replicated()
+        if sh is None:
+            return tree
+        return jax.device_put(tree, sh)
+
+    def _host_lr(self) -> bool:
+        sched = self.optim_method.schedule
+        return isinstance(sched, Plateau)
+
+    def _build_step(self):
+        model, criterion = self.model, self.criterion
+        optim, processors = self.optim_method, list(self.processors)
+
+        def train_step(params, model_state, opt_state, x, y, rng, lr):
+            def loss_fn(p):
+                out, new_state = model.apply(p, model_state, x, training=True, rng=rng)
+                return criterion.forward(out, y), new_state
+
+            (loss, new_model_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            for proc in processors:
+                grads = proc.process(grads)
+            new_params, new_opt_state = optim.step(
+                grads, params, opt_state, lr=(lr if self._host_lr() else None))
+            return new_params, new_model_state, new_opt_state, loss
+
+        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    def _build_eval_step(self):
+        model, methods = self.model, self.val_methods
+
+        def eval_step(params, model_state, x, y):
+            out, _ = model.apply(params, model_state, x, training=False)
+            return [m.batch(out, y) for m in methods]
+
+        return jax.jit(eval_step)
+
+    def _init_model(self, first_batch: MiniBatch):
+        if self.params is None:
+            shape = _shape_of_input(first_batch.get_input())
+            self.params, self.model_state, _ = self.model.build(
+                RandomGenerator.next_key(), shape)
+            self.opt_state = self.optim_method.init(self.params)
+        self.params = self._put_replicated(self.params)
+        self.model_state = self._put_replicated(self.model_state)
+        self.opt_state = self._put_replicated(self.opt_state)
+
+    # ------------------------------------------------------------------
+    # The loop (reference: optim/DistriOptimizer.scala:786 optimize())
+    # ------------------------------------------------------------------
+
+    def optimize(self):
+        retries = Engine.config().failure_retry_times
+        while True:
+            try:
+                return self._optimize_impl()
+            except KeyboardInterrupt:
+                raise
+            except Exception:
+                # failure retry from last checkpoint
+                # (reference: optim/DistriOptimizer.scala:855-935)
+                if retries <= 0 or self.ckpt_path is None:
+                    raise
+                retries -= 1
+                ckpt = latest_checkpoint(self.ckpt_path)
+                logger.exception("training failed; retrying from checkpoint %s "
+                                 "(%d retries left)", ckpt, retries)
+                if ckpt is not None:
+                    self._restore(ckpt)
+
+    def _restore(self, ckpt_dir: str) -> None:
+        self.params, self.model_state, self.opt_state, driver = load_checkpoint(
+            ckpt_dir, self.params, self.model_state, self.opt_state)
+        self._driver_state.update(driver)
+
+    def resume_from(self, ckpt_path: str) -> "Optimizer":
+        """Explicit resume (reference: Train --model/--state snapshots)."""
+        ckpt = latest_checkpoint(ckpt_path) if not ckpt_path.endswith(".json") else ckpt_path
+        if ckpt is None:
+            raise FileNotFoundError(f"no checkpoint under {ckpt_path}")
+        # Need built params first: build lazily on first batch then restore
+        self._pending_restore = ckpt
+        return self
+
+    def _optimize_impl(self):
+        state = self._driver_state
+        step_fn = None
+        eval_fn = None
+        root_key = RandomGenerator.next_key()
+        wall_start = time.time()
+        record_count_epoch = 0
+
+        while not self.end_when(state):
+            state["epoch_finished"] = False
+            epoch_start = time.time()
+            record_count_epoch = 0
+            for batch in self.dataset.data(train=True):
+                if self.end_when(state):
+                    break
+                if self.params is None or step_fn is None:
+                    self._init_model(batch)
+                    if getattr(self, "_pending_restore", None):
+                        self._restore(self._pending_restore)
+                        self._pending_restore = None
+                    step_fn = self._build_step()
+                bs = batch.size()
+                x = self._put_batch(batch.get_input())
+                y = self._put_batch(batch.get_target())
+                rng = jax.random.fold_in(root_key, state["neval"])
+                lr = jnp.asarray(float(self._current_lr()), jnp.float32)
+                t0 = time.perf_counter()
+                self.params, self.model_state, self.opt_state, loss = step_fn(
+                    self.params, self.model_state, self.opt_state, x, y, rng, lr)
+                loss_f = float(loss)
+                dt = time.perf_counter() - t0
+                state["neval"] += 1
+                state["loss"] = loss_f
+                record_count_epoch += bs
+                throughput = bs / dt
+                self.metrics.add("computing time", dt)
+                self.metrics.set("throughput", throughput)
+                # driver log (reference: DistriOptimizer.scala:402-407)
+                logger.info(
+                    "Epoch %d iteration %d: loss %.6f, throughput %.1f records/s, lr %.6g",
+                    state["epoch"] + 1, state["neval"], loss_f, throughput,
+                    float(self._current_lr()))
+                if self.train_summary is not None:
+                    s = self.train_summary
+                    if s.should_log("Loss", state["neval"]):
+                        s.add_scalar("Loss", loss_f, state["neval"])
+                    if s.should_log("Throughput", state["neval"]):
+                        s.add_scalar("Throughput", throughput, state["neval"])
+                    if s.should_log("LearningRate", state["neval"]):
+                        s.add_scalar("LearningRate", float(self._current_lr()), state["neval"])
+                self._maybe_validate(state)
+                self._maybe_checkpoint(state)
+            state["epoch"] += 1
+            state["epoch_finished"] = True
+            if self.opt_state is not None:
+                self.opt_state = dict(self.opt_state,
+                                      epoch=jnp.asarray(state["epoch"], jnp.int32))
+            logger.info("Epoch %d done: %d records in %.1fs",
+                        state["epoch"], record_count_epoch, time.time() - epoch_start)
+            self._maybe_validate(state)
+            self._maybe_checkpoint(state)
+        logger.info("Training finished after %d iterations (%.1fs)",
+                    state["neval"], time.time() - wall_start)
+        self.model.params = self.params
+        self.model.state = self.model_state
+        return self.model
+
+    def _current_lr(self):
+        if self.opt_state is None:
+            return self.optim_method.learning_rate
+        return self.optim_method.current_lr(self.opt_state)
+
+    # ------------------------------------------------------------------
+
+    def _maybe_validate(self, state):
+        if (self.val_trigger is None or self.val_dataset is None
+                or not self.val_trigger(state)):
+            return
+        results = self.validate()
+        for r in results:
+            v, _ = r.result()
+            logger.info("Validation %s: %.6f", r.name, v)
+            if self.val_summary is not None:
+                self.val_summary.add_scalar(r.name, v, state["neval"])
+        if results:
+            state["score"] = results[0].result()[0]
+            sched = self.optim_method.schedule
+            if sched is not None:
+                sched.on_score(state["score"])
+
+    def validate(self) -> List[ValidationResult]:
+        """Distributed eval (reference: optim/AbstractOptimizer.scala:93 +
+        Evaluator.scala — RDD mapPartitions becomes batched jitted eval)."""
+        if self._compiled is None:
+            self._compiled = self._build_eval_step()
+        totals = [ValidationResult(0.0, 0, m.name) for m in self.val_methods]
+        for batch in self.val_dataset.data(train=False):
+            x = self._put_batch(batch.get_input())
+            y = self._put_batch(batch.get_target())
+            outs = self._compiled(self.params, self.model_state, x, y)
+            for i, (v, c) in enumerate(outs):
+                totals[i] = totals[i] + ValidationResult(float(v), int(c), totals[i].name)
+        return totals
+
+    def _maybe_checkpoint(self, state):
+        if (self.ckpt_path is None or self.ckpt_trigger is None
+                or not self.ckpt_trigger(state)):
+            return
+        d = save_checkpoint(self.ckpt_path, state["neval"], self.params,
+                            self.model_state, self.opt_state,
+                            driver_state={k: v for k, v in state.items()
+                                          if k in ("epoch", "neval", "loss", "score")})
+        logger.info("Checkpoint saved to %s", d)
+
+
+def _shape_of_input(x) -> Any:
+    if isinstance(x, (tuple, list)):
+        return [tuple(np.asarray(v).shape) for v in x]
+    return tuple(np.asarray(x).shape)
+
+
+class LocalOptimizer(Optimizer):
+    """Single-device trainer. reference: optim/LocalOptimizer.scala:45 —
+    its per-core replica fan-out is XLA's job now."""
+
+    def __init__(self, model: Module, dataset: DataSet, criterion: Criterion,
+                 optim_method: Optional[OptimMethod] = None,
+                 end_trigger: Optional[Trigger] = None):
+        super().__init__(model, dataset, criterion, optim_method,
+                         mesh=None, end_trigger=end_trigger)
+
+
+class DistriOptimizer(Optimizer):
+    """Mesh-parallel trainer. reference: optim/DistriOptimizer.scala:49.
+    Defaults to the Engine mesh (all devices on the data axis)."""
+
+    def __init__(self, model: Module, dataset: DataSet, criterion: Criterion,
+                 optim_method: Optional[OptimMethod] = None,
+                 mesh: Optional[Mesh] = None,
+                 end_trigger: Optional[Trigger] = None):
+        super().__init__(model, dataset, criterion, optim_method,
+                         mesh=mesh or Engine.mesh(), end_trigger=end_trigger)

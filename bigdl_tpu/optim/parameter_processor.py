@@ -1,0 +1,47 @@
+"""Gradient processors (clipping).
+
+Reference: parameters/ParameterOperations.scala:33-89 —
+ConstantClippingProcessor and L2NormClippingProcessor.  The reference
+computes the global L2 norm with a cross-node collect; here grads inside
+the jitted step are global arrays, so the norm is global by construction
+(one more way the Spark control plane disappears into XLA).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+class ParameterProcessor:
+    def process(self, grads: Any) -> Any:
+        raise NotImplementedError
+
+
+class ConstantClippingProcessor(ParameterProcessor):
+    """Clip each gradient element to [min, max].
+    reference: ParameterOperations.scala ConstantClippingProcessor."""
+
+    def __init__(self, min_value: float, max_value: float):
+        self.min_value = min_value
+        self.max_value = max_value
+
+    def process(self, grads):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min_value, self.max_value), grads)
+
+
+class L2NormClippingProcessor(ParameterProcessor):
+    """Scale grads so the GLOBAL l2 norm <= max_norm.
+    reference: ParameterOperations.scala L2NormClippingProcessor."""
+
+    def __init__(self, l2_norm_threshold: float):
+        self.max_norm = l2_norm_threshold
+
+    def process(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        global_norm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+        scale = jnp.minimum(1.0, self.max_norm / jnp.maximum(global_norm, 1e-12))
+        return jax.tree_util.tree_map(lambda g: g * scale, grads)

@@ -1,0 +1,247 @@
+"""Learning-rate schedules.
+
+Reference: optim/SGD.scala:200-680 — the 12-schedule zoo (Default, Poly,
+Step, MultiStep, EpochDecay, EpochStep, NaturalExp, Exponential, Plateau,
+Warmup, SequentialSchedule, EpochSchedule + EpochDecayWithWarmUp used by the
+ResNet ImageNet baseline).  These are load-bearing for baseline parity.
+
+Redesign: each schedule is a pure function of the iteration/epoch counters,
+`schedule(base_lr, iteration, epoch) -> lr` with jnp scalars, so the LR
+computation traces into the jitted train step (no host round-trip per step).
+`Plateau` is the one metric-driven schedule — it runs host-side between
+epochs (`on_score`) and the resulting LR is fed into the step as an argument.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+
+class LearningRateSchedule:
+    """lr(base_lr, iteration, epoch) with traced int32 counters.
+
+    `iteration` counts optimizer steps (the reference's state("neval")),
+    `epoch` counts epochs from 0 (the reference is 1-based)."""
+
+    def __call__(self, base_lr, iteration, epoch):
+        raise NotImplementedError
+
+    # host-side hook for metric-driven schedules; default no-op
+    def on_score(self, score: float) -> None:
+        pass
+
+
+class Default(LearningRateSchedule):
+    """lr / (1 + n*decay). reference: SGD.Default."""
+
+    def __init__(self, leaning_rate_decay: float = 0.0):
+        self.decay = leaning_rate_decay
+
+    def __call__(self, base_lr, iteration, epoch):
+        return base_lr / (1.0 + iteration * self.decay)
+
+
+class Poly(LearningRateSchedule):
+    """lr * (1 - iter/max_iter)^power; 0 after max. reference: SGD.Poly."""
+
+    def __init__(self, power: float, max_iteration: int):
+        self.power = power
+        self.max_iteration = max_iteration
+
+    def __call__(self, base_lr, iteration, epoch):
+        frac = jnp.minimum(iteration / self.max_iteration, 1.0)
+        return base_lr * (1.0 - frac) ** self.power
+
+
+class Step(LearningRateSchedule):
+    """lr * gamma^(floor(iter/step_size)). reference: SGD.Step."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size = step_size
+        self.gamma = gamma
+
+    def __call__(self, base_lr, iteration, epoch):
+        return base_lr * self.gamma ** jnp.floor(iteration / self.step_size)
+
+
+class MultiStep(LearningRateSchedule):
+    """lr * gamma^(#milestones passed). reference: SGD.MultiStep."""
+
+    def __init__(self, step_sizes: Sequence[int], gamma: float):
+        self.step_sizes = list(step_sizes)
+        self.gamma = gamma
+
+    def __call__(self, base_lr, iteration, epoch):
+        passed = sum((iteration >= s).astype(jnp.float32) if hasattr(iteration, "astype")
+                     else jnp.float32(iteration >= s)
+                     for s in [jnp.int32(s) for s in self.step_sizes])
+        return base_lr * self.gamma ** passed
+
+
+class EpochDecay(LearningRateSchedule):
+    """lr * 0.1^decay_fn(epoch); the reference takes an arbitrary
+    Int=>Double fn. reference: SGD.EpochDecay."""
+
+    def __init__(self, decay_fn):
+        self.decay_fn = decay_fn
+
+    def __call__(self, base_lr, iteration, epoch):
+        # decay_fn must be jnp-traceable (e.g. lambda e: (e // 30))
+        return base_lr * 0.1 ** self.decay_fn(epoch)
+
+
+class EpochStep(LearningRateSchedule):
+    """lr * gamma^(floor(epoch/step)). reference: SGD.EpochStep."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size = step_size
+        self.gamma = gamma
+
+    def __call__(self, base_lr, iteration, epoch):
+        return base_lr * self.gamma ** jnp.floor(epoch / self.step_size)
+
+
+class NaturalExp(LearningRateSchedule):
+    """lr * exp(-decay_rate * floor(iter/decay_step)).
+    reference: SGD.NaturalExp."""
+
+    def __init__(self, decay_step: int, decay_rate: float):
+        self.decay_step = decay_step
+        self.decay_rate = decay_rate
+
+    def __call__(self, base_lr, iteration, epoch):
+        return base_lr * jnp.exp(-self.decay_rate * jnp.floor(iteration / self.decay_step))
+
+
+class Exponential(LearningRateSchedule):
+    """lr * decay_rate^(iter/decay_step), optionally staircased.
+    reference: SGD.Exponential."""
+
+    def __init__(self, decay_step: int, decay_rate: float, stair_case: bool = False):
+        self.decay_step = decay_step
+        self.decay_rate = decay_rate
+        self.stair_case = stair_case
+
+    def __call__(self, base_lr, iteration, epoch):
+        p = iteration / self.decay_step
+        if self.stair_case:
+            p = jnp.floor(p)
+        return base_lr * self.decay_rate ** p
+
+
+class Warmup(LearningRateSchedule):
+    """Linear ramp by `delta` per iteration (combined via SequentialSchedule).
+    reference: SGD.Warmup."""
+
+    def __init__(self, delta: float):
+        self.delta = delta
+
+    def __call__(self, base_lr, iteration, epoch):
+        return base_lr + self.delta * iteration
+
+
+class SequentialSchedule(LearningRateSchedule):
+    """Chain schedules, each active for `maxIteration` steps.
+    reference: SGD.SequentialSchedule."""
+
+    def __init__(self, iteration_per_epoch: int = 1):
+        self.schedules: List[Tuple[LearningRateSchedule, int]] = []
+
+    def add(self, schedule: LearningRateSchedule, max_iteration: int) -> "SequentialSchedule":
+        self.schedules.append((schedule, max_iteration))
+        return self
+
+    def __call__(self, base_lr, iteration, epoch):
+        lr = base_lr
+        offset = 0
+        result = None
+        remaining = iteration
+        for sched, max_it in self.schedules:
+            local = jnp.clip(iteration - offset, 0, max_it)
+            candidate = sched(base_lr, local, epoch)
+            active = (iteration >= offset)
+            result = candidate if result is None else jnp.where(active, candidate, result)
+            offset += max_it
+        return result if result is not None else lr
+
+
+class EpochSchedule(LearningRateSchedule):
+    """Explicit per-epoch-range LRs. reference: SGD.EpochSchedule
+    (Regime list)."""
+
+    def __init__(self, regimes: Sequence[Tuple[int, int, float]]):
+        # regimes: (start_epoch, end_epoch, lr) — 0-based inclusive ranges
+        self.regimes = list(regimes)
+
+    def __call__(self, base_lr, iteration, epoch):
+        lr = base_lr
+        for start, end, r_lr in self.regimes:
+            inside = jnp.logical_and(epoch >= start, epoch <= end)
+            lr = jnp.where(inside, r_lr, lr)
+        return lr
+
+
+class EpochDecayWithWarmUp(LearningRateSchedule):
+    """Linear warmup for `warmupEpoch` epochs then step decay by epoch —
+    the ResNet-50 ImageNet baseline schedule
+    (reference: SGD.EpochDecayWithWarmUp, models/resnet/TrainImageNet.scala:100-123)."""
+
+    def __init__(self, warmup_epoch: int, warmup_delta: float, decay_fn,
+                 iterations_per_epoch: int = 1):
+        self.warmup_epoch = warmup_epoch
+        self.warmup_delta = warmup_delta
+        self.decay_fn = decay_fn
+        self.iterations_per_epoch = iterations_per_epoch
+
+    def __call__(self, base_lr, iteration, epoch):
+        warm = base_lr + self.warmup_delta * epoch
+        decayed = (base_lr + self.warmup_delta * (self.warmup_epoch - 1)) * \
+            0.1 ** self.decay_fn(epoch)
+        return jnp.where(epoch < self.warmup_epoch, warm, decayed)
+
+
+class Plateau(LearningRateSchedule):
+    """Reduce LR when a monitored metric stops improving.  Host-side: call
+    `on_score(score)` after each validation; `current_factor` multiplies the
+    base LR.  reference: SGD.Plateau."""
+
+    def __init__(self, monitor: str = "score", factor: float = 0.1,
+                 patience: int = 10, mode: str = "min", epsilon: float = 1e-4,
+                 cooldown: int = 0, min_lr: float = 0.0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.mode = mode
+        self.epsilon = epsilon
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.current_factor = 1.0
+        self._best: Optional[float] = None
+        self._wait = 0
+        self._cooldown_left = 0
+
+    def on_score(self, score: float) -> None:
+        if self._cooldown_left > 0:
+            self._cooldown_left -= 1
+            self._wait = 0
+        improved = (
+            self._best is None
+            or (self.mode == "min" and score < self._best - self.epsilon)
+            or (self.mode == "max" and score > self._best + self.epsilon)
+        )
+        if improved:
+            self._best = score
+            self._wait = 0
+        elif self._cooldown_left <= 0:
+            self._wait += 1
+            if self._wait >= self.patience:
+                self.current_factor *= self.factor
+                self._cooldown_left = self.cooldown
+                self._wait = 0
+
+    def __call__(self, base_lr, iteration, epoch):
+        return jnp.maximum(base_lr * self.current_factor, self.min_lr)

@@ -1,0 +1,59 @@
+"""Composable triggers for validation/checkpoint/termination.
+
+Reference: optim/Trigger.scala:30-132 (everyEpoch, severalIteration,
+maxEpoch, maxIteration, maxScore, minLoss, and, or).  A trigger is a
+predicate over the driver-side training state dict
+{"epoch", "neval", "loss", "score", "record_count", "epoch_finished"}.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+
+class Trigger:
+    def __init__(self, fn: Callable[[Dict], bool], desc: str = "trigger"):
+        self._fn = fn
+        self.desc = desc
+
+    def __call__(self, state: Dict) -> bool:
+        return self._fn(state)
+
+    def __repr__(self):
+        return f"Trigger({self.desc})"
+
+    # -- factories (reference: optim/Trigger.scala) ---------------------
+    @staticmethod
+    def every_epoch() -> "Trigger":
+        return Trigger(lambda s: s.get("epoch_finished", False), "everyEpoch")
+
+    @staticmethod
+    def several_iteration(interval: int) -> "Trigger":
+        return Trigger(lambda s: s["neval"] > 0 and s["neval"] % interval == 0,
+                       f"severalIteration({interval})")
+
+    @staticmethod
+    def max_epoch(max_e: int) -> "Trigger":
+        return Trigger(lambda s: s["epoch"] >= max_e, f"maxEpoch({max_e})")
+
+    @staticmethod
+    def max_iteration(max_it: int) -> "Trigger":
+        return Trigger(lambda s: s["neval"] >= max_it, f"maxIteration({max_it})")
+
+    @staticmethod
+    def max_score(max_s: float) -> "Trigger":
+        return Trigger(lambda s: s.get("score") is not None and s["score"] > max_s,
+                       f"maxScore({max_s})")
+
+    @staticmethod
+    def min_loss(min_l: float) -> "Trigger":
+        return Trigger(lambda s: s.get("loss") is not None and s["loss"] < min_l,
+                       f"minLoss({min_l})")
+
+    @staticmethod
+    def and_(*triggers: "Trigger") -> "Trigger":
+        return Trigger(lambda s: all(t(s) for t in triggers), "and")
+
+    @staticmethod
+    def or_(*triggers: "Trigger") -> "Trigger":
+        return Trigger(lambda s: any(t(s) for t in triggers), "or")

@@ -1,0 +1,134 @@
+"""Validation metrics.
+
+Reference: optim/ValidationMethod.scala:118-500 (Top1Accuracy, Top5Accuracy,
+Loss, MAE, HitRatio@k, NDCG, TreeNNAccuracy) and ValidationResult merge
+semantics (`+`, optim/ValidationMethod.scala:52).
+
+Each method has a jittable per-batch part `batch(output, target) ->
+(value, count)` and results merge associatively so distributed eval is a
+psum (the reference reduces ValidationResults over the RDD).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.criterion import Criterion
+
+
+class ValidationResult:
+    """(result, count) pair with `+` merge. reference: AccuracyResult/
+    LossResult (optim/ValidationMethod.scala:52-117)."""
+
+    def __init__(self, value: float, count: int, name: str = ""):
+        self.value = float(value)
+        self.count = int(count)
+        self.name = name
+
+    def result(self) -> Tuple[float, int]:
+        return (self.value / max(self.count, 1), self.count)
+
+    def __add__(self, other: "ValidationResult") -> "ValidationResult":
+        return ValidationResult(self.value + other.value, self.count + other.count,
+                                self.name)
+
+    def __repr__(self):
+        v, c = self.result()
+        return f"{self.name}: {v:.6f} (count {c})"
+
+
+class ValidationMethod:
+    name = "validation"
+
+    def batch(self, output, target):
+        """Jittable: returns (sum_value, count) jnp scalars for one batch."""
+        raise NotImplementedError
+
+    def to_result(self, value, count) -> ValidationResult:
+        return ValidationResult(float(value), int(count), self.name)
+
+    def __repr__(self):
+        return self.name
+
+
+class Top1Accuracy(ValidationMethod):
+    """reference: optim/ValidationMethod.scala Top1Accuracy."""
+
+    name = "Top1Accuracy"
+
+    def batch(self, output, target):
+        pred = jnp.argmax(output, axis=-1)
+        correct = jnp.sum((pred == target.astype(pred.dtype)).astype(jnp.float32))
+        return correct, jnp.asarray(target.shape[0], jnp.int32)
+
+
+class Top5Accuracy(ValidationMethod):
+    """reference: optim/ValidationMethod.scala Top5Accuracy."""
+
+    name = "Top5Accuracy"
+
+    def batch(self, output, target):
+        top5 = jnp.argsort(output, axis=-1)[..., -5:]
+        hit = jnp.any(top5 == target.astype(top5.dtype)[..., None], axis=-1)
+        return jnp.sum(hit.astype(jnp.float32)), jnp.asarray(target.shape[0], jnp.int32)
+
+
+class Loss(ValidationMethod):
+    """Criterion value as a metric. reference: ValidationMethod.Loss."""
+
+    name = "Loss"
+
+    def __init__(self, criterion: Criterion):
+        self.criterion = criterion
+
+    def batch(self, output, target):
+        n = output.shape[0]
+        return self.criterion.forward(output, target) * n, jnp.asarray(n, jnp.int32)
+
+
+class MAE(ValidationMethod):
+    """Mean absolute error. reference: ValidationMethod.MAE."""
+
+    name = "MAE"
+
+    def batch(self, output, target):
+        n = output.shape[0]
+        return jnp.sum(jnp.mean(jnp.abs(output - target),
+                                axis=tuple(range(1, output.ndim)))), jnp.asarray(n, jnp.int32)
+
+
+class HitRatio(ValidationMethod):
+    """HR@k over (positive-first) ranking rows: output (N, candidates),
+    position 0 is the positive item. reference: ValidationMethod.HitRatio."""
+
+    name = "HitRatio"
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        self.k = k
+        self.name = f"HitRatio@{k}"
+
+    def batch(self, output, target):
+        # rank of item 0 among all candidates (0 = best)
+        pos_score = output[:, :1]
+        rank = jnp.sum((output > pos_score).astype(jnp.int32), axis=-1)
+        hit = (rank < self.k).astype(jnp.float32)
+        return jnp.sum(hit), jnp.asarray(output.shape[0], jnp.int32)
+
+
+class NDCG(ValidationMethod):
+    """NDCG@k with a single positive at column 0.
+    reference: ValidationMethod.NDCG."""
+
+    name = "NDCG"
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        self.k = k
+        self.name = f"NDCG@{k}"
+
+    def batch(self, output, target):
+        pos_score = output[:, :1]
+        rank = jnp.sum((output > pos_score).astype(jnp.int32), axis=-1)
+        gain = jnp.where(rank < self.k, 1.0 / jnp.log2(rank.astype(jnp.float32) + 2.0), 0.0)
+        return jnp.sum(gain), jnp.asarray(output.shape[0], jnp.int32)

@@ -1,0 +1,5 @@
+from bigdl_tpu.utils.checkpoint import save_checkpoint, load_checkpoint, latest_checkpoint
+from bigdl_tpu.utils.summary import TrainSummary, ValidationSummary
+
+__all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
+           "TrainSummary", "ValidationSummary"]

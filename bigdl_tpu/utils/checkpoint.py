@@ -1,0 +1,110 @@
+"""Checkpoint save/load.
+
+Reference: optim/AbstractOptimizer.scala:202-221 (trigger-driven
+`model.<iter>` + `optimMethod-<name>.<iter>` files in a timestamped subdir)
+and utils/File.scala (local/HDFS/S3).  Resume restores mid-epoch because
+counters live in optimizer state (optim/DistriOptimizer.scala:127-137).
+
+Format: a directory per checkpoint containing a schema-versioned
+`meta.json` plus one `.npz` per pytree (params / model_state / opt_state).
+Pytrees are flattened to path-keyed arrays ("0/weight", "cell/w_ih"), so
+the format is stable across process restarts and inspectable with numpy —
+the same goals as the reference's protobuf ModuleSerializer (§2.6), without
+inventing a binary schema.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+SCHEMA_VERSION = 1
+_SEP = "/"
+
+
+def _flatten(tree: Any) -> Dict[str, np.ndarray]:
+    flat = {}
+    paths = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in paths[0]:
+        key = _SEP.join(_path_part(p) for p in path)
+        flat[key if key else "_root"] = np.asarray(leaf)
+    return flat
+
+
+def _path_part(p) -> str:
+    if hasattr(p, "key"):
+        return str(p.key)
+    if hasattr(p, "idx"):
+        return str(p.idx)
+    return str(p)
+
+
+def _unflatten_into(template: Any, flat: Dict[str, np.ndarray]) -> Any:
+    """Rebuild arrays into the structure of `template`."""
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths:
+        key = _SEP.join(_path_part(p) for p in path) or "_root"
+        if key not in flat:
+            raise KeyError(f"checkpoint missing tensor '{key}'")
+        arr = flat[key]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"checkpoint tensor '{key}' shape {arr.shape} != model {np.shape(leaf)}")
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def save_checkpoint(path: str, step: int, params: Any, model_state: Any = None,
+                    opt_state: Any = None, driver_state: Optional[Dict] = None) -> str:
+    """Write checkpoint dir `<path>/ckpt_<step>`; returns its path."""
+    d = os.path.join(path, f"ckpt_{step}")
+    os.makedirs(d, exist_ok=True)
+    meta = {"schema_version": SCHEMA_VERSION, "step": int(step),
+            "driver_state": driver_state or {}}
+    np.savez(os.path.join(d, "params.npz"), **_flatten(params))
+    if model_state is not None:
+        np.savez(os.path.join(d, "model_state.npz"), **_flatten(model_state))
+    if opt_state is not None:
+        np.savez(os.path.join(d, "opt_state.npz"), **_flatten(opt_state))
+    with open(os.path.join(d, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    return d
+
+
+def load_checkpoint(ckpt_dir: str, params_template: Any,
+                    model_state_template: Any = None,
+                    opt_state_template: Any = None) -> Tuple[Any, Any, Any, Dict]:
+    """Returns (params, model_state, opt_state, driver_state)."""
+    with open(os.path.join(ckpt_dir, "meta.json")) as f:
+        meta = json.load(f)
+    if meta.get("schema_version") != SCHEMA_VERSION:
+        raise ValueError(f"unsupported checkpoint schema {meta.get('schema_version')}")
+
+    def load_npz(name, template):
+        p = os.path.join(ckpt_dir, name)
+        if template is None or not os.path.exists(p):
+            return None
+        with np.load(p) as z:
+            return _unflatten_into(template, dict(z))
+
+    params = load_npz("params.npz", params_template)
+    model_state = load_npz("model_state.npz", model_state_template)
+    opt_state = load_npz("opt_state.npz", opt_state_template)
+    return params, model_state, opt_state, meta.get("driver_state", {})
+
+
+def latest_checkpoint(path: str) -> Optional[str]:
+    if not os.path.isdir(path):
+        return None
+    best, best_step = None, -1
+    for name in os.listdir(path):
+        m = re.fullmatch(r"ckpt_(\d+)", name)
+        if m and int(m.group(1)) > best_step:
+            best, best_step = os.path.join(path, name), int(m.group(1))
+    return best

@@ -1,0 +1,69 @@
+"""Training/validation summaries (observability).
+
+Reference: visualization/TrainSummary.scala:32, ValidationSummary.scala:29 —
+a from-scratch TensorBoard event-file writer (FileWriter/EventWriter/
+RecordWriter + Crc32c) logging Loss/LR/Throughput scalars and parameter
+histograms, with per-tag triggers and a `read_scalar` read-back API.
+
+Here summaries are JSONL (one {"tag", "step", "value", "wall_time"} per
+line) — trivially consumable by pandas/TensorBoard-via-converter, durable,
+and append-only.  A TF-event-file emitter can be layered on the same
+Summary interface later without touching trainer code.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class Summary:
+    def __init__(self, log_dir: str, app_name: str, kind: str):
+        self.dir = os.path.join(log_dir, app_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(self.dir, f"{kind}.jsonl")
+        self._fh = open(self.path, "a")
+        self._triggers: Dict[str, int] = {}
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        rec = {"tag": tag, "step": int(step), "value": float(value),
+               "wall_time": time.time()}
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def set_summary_trigger(self, tag: str, every_n_iterations: int) -> None:
+        """reference: TrainSummary.setSummaryTrigger."""
+        self._triggers[tag] = every_n_iterations
+
+    def should_log(self, tag: str, step: int) -> bool:
+        n = self._triggers.get(tag, 1)
+        return step % max(n, 1) == 0
+
+    def read_scalar(self, tag: str) -> List[Tuple[int, float]]:
+        """reference: TrainSummary.readScalar (notebook read-back)."""
+        out = []
+        with open(self.path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec["tag"] == tag:
+                    out.append((rec["step"], rec["value"]))
+        return out
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+class TrainSummary(Summary):
+    """reference: visualization/TrainSummary.scala:32."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "train")
+
+
+class ValidationSummary(Summary):
+    """reference: visualization/ValidationSummary.scala:29."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "validation")

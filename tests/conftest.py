@@ -1,7 +1,12 @@
-"""Test configuration: force an 8-virtual-device CPU platform BEFORE jax
-backends initialize, so multi-chip sharding paths are exercised in one
-process — the analogue of the reference testing its BlockManager allreduce
-with SparkContext("local[N]") (survey §4)."""
+"""Test configuration: force an 8-virtual-device CPU platform so multi-chip
+sharding paths are exercised in one process — the analogue of the reference
+testing its BlockManager allreduce with SparkContext("local[N]") (survey §4).
+
+Note: the environment's sitecustomize registers and initializes the real
+TPU backend at interpreter startup, BEFORE this conftest runs — so setting
+env vars is not enough; we must also clear the already-initialized backends
+and switch the platform config to cpu.
+"""
 
 import os
 
@@ -12,6 +17,19 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax.extend.backend as _jeb
+
+    _jeb.clear_backends()
+except Exception:  # pragma: no cover - fallback for older jax
+    import jax._src.xla_bridge as _xb
+
+    _xb._clear_backends()
+
+assert jax.device_count() == 8, (
+    f"tests need the 8-virtual-device CPU mesh, got {jax.devices()}")
 
 # Full-precision matmuls for differential tests against torch CPU (on TPU the
 # framework default stays at the fast bf16-pass precision).
