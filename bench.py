@@ -1,0 +1,96 @@
+"""Benchmark: ResNet-50 ImageNet-shape training throughput (images/sec/chip).
+
+The reference's measurement harness is DistriOptimizerPerf
+(models/utils/DistriOptimizerPerf.scala:32-86): synthetic ImageNet-shaped
+input, throughput = records / iteration wall time
+(optim/DistriOptimizer.scala:402-407).  This is the same measurement on one
+TPU chip: full train step (fwd+bwd+SGD-momentum update+BN stats), bf16
+compute / fp32 params.
+
+vs_baseline: BigDL publishes no absolute throughput numbers
+(BASELINE.json published: {}); the comparison anchor is ~16 img/s for
+ResNet-50 training on a dual-socket Xeon Broadwell node — the hardware
+class of the whitepaper's scaling study (docs/docs/whitepaper.md:160-164) —
+a widely-reported public figure for that era's 2-socket CPU training.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+XEON_NODE_BASELINE_IMG_S = 16.0
+
+BATCH = 128
+IMAGE = 224
+CLASSES = 1000
+WARMUP = 3
+ITERS = 20
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models import resnet50
+    from bigdl_tpu.optim import SGD
+
+    model = resnet50(CLASSES)
+    shape = (BATCH, IMAGE, IMAGE, 3)
+    params, state, _ = model.build(jax.random.PRNGKey(0), shape)
+    optim = SGD(learning_rate=0.1, momentum=0.9, dampening=0.0)
+    opt_state = optim.init(params)
+    criterion = nn.ClassNLLCriterion()
+
+    def train_step(params, model_state, opt_state, x, y):
+        def loss_fn(p):
+            # bf16 compute, fp32 params/update (the MXU-native dtype policy;
+            # replaces the reference's fp16 wire compression,
+            # parameters/FP16CompressedTensor.scala)
+            p16 = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16), p)
+            s16 = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16), model_state)
+            out, new_state = model.apply(p16, s16, x.astype(jnp.bfloat16), training=True,
+                                         rng=None)
+            return criterion.forward(out.astype(jnp.float32), y), new_state
+
+        (loss, new_model_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new_params, new_opt_state = optim.step(grads, params, opt_state)
+        new_model_state = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.float32), new_model_state)
+        return new_params, new_model_state, new_opt_state, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(*shape), jnp.float32)
+    y = jnp.asarray(rs.randint(0, CLASSES, BATCH))
+
+    def sync(tree):
+        # NOTE: through the remote-TPU tunnel block_until_ready returns
+        # before execution finishes; a host readback is the only real sync
+        leaf = jax.tree_util.tree_leaves(tree)[0]
+        return float(jnp.sum(leaf.astype(jnp.float32)))
+
+    for _ in range(WARMUP):
+        params, state, opt_state, loss = step(params, state, opt_state, x, y)
+    sync(params)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        params, state, opt_state, loss = step(params, state, opt_state, x, y)
+    sync(params)  # depends on the final update: full chain executed
+    dt = time.perf_counter() - t0
+
+    img_s = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_imagenet_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_s / XEON_NODE_BASELINE_IMG_S, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -143,18 +143,20 @@ class Engine:
         return Engine.build_mesh(**mesh_shape)
 
     @staticmethod
-    def build_mesh(**axes: int) -> Mesh:
+    def build_mesh(devices: Optional[Sequence] = None, **axes: int) -> Mesh:
         """Build a named-axis device mesh.
 
-        Axis sizes must multiply to the device count; `-1` means "whatever is
-        left".  Uses `mesh_utils.create_device_mesh` so that the innermost
-        (rightmost) axes land on ICI-adjacent devices — put `model`/
-        `sequence` axes last and `data` first so gradient allreduce crosses
-        DCN only on the data axis.
+        Axis sizes must multiply to the device count (all devices, or the
+        given `devices` subset); `-1` means "whatever is left".  Uses
+        `mesh_utils.create_device_mesh` so that the innermost (rightmost)
+        axes land on ICI-adjacent devices — put `model`/`sequence` axes last
+        and `data` first so gradient allreduce crosses DCN only on the data
+        axis.
         """
         names = list(axes.keys())
         sizes = list(axes.values())
-        n = jax.device_count()
+        pool = list(devices) if devices is not None else jax.devices()
+        n = len(pool)
         if sizes.count(-1) > 1:
             raise ValueError("at most one mesh axis may be -1")
         if -1 in sizes:
@@ -167,10 +169,10 @@ class Engine:
         try:
             from jax.experimental import mesh_utils
 
-            devices = mesh_utils.create_device_mesh(tuple(sizes))
+            dev_array = mesh_utils.create_device_mesh(tuple(sizes), devices=pool)
         except Exception:  # pragma: no cover - non-uniform topologies
-            devices = np.array(jax.devices()).reshape(tuple(sizes))
-        return Mesh(devices, tuple(names))
+            dev_array = np.array(pool).reshape(tuple(sizes))
+        return Mesh(dev_array, tuple(names))
 
     # ------------------------------------------------------------------
     # Virtual-device helpers (testing the multi-chip path on one host —

@@ -47,19 +47,26 @@ class MiniBatch:
                      label_padding: Optional[float] = None) -> "MiniBatch":
         """Stack samples; optionally pad variable-length features to the
         batch max (reference: SampleToMiniBatch padding params,
-        dataset/MiniBatch.scala:579+)."""
-        feats = [np.asarray(s.feature) for s in samples]
-        if feature_padding is not None:
-            feats = _pad_stack(feats, feature_padding)
+        dataset/MiniBatch.scala:579+).  Multi-input samples (tuple of
+        feature arrays) stack per component into a tuple of batches."""
+
+        def stack(values, padding):
+            arrays = [np.asarray(v) for v in values]
+            return _pad_stack(arrays, padding) if padding is not None else np.stack(arrays)
+
+        if isinstance(samples[0].feature, (tuple, list)):
+            n_inputs = len(samples[0].feature)
+            feats = tuple(stack([s.feature[i] for s in samples], feature_padding)
+                          for i in range(n_inputs))
         else:
-            feats = np.stack(feats)
+            feats = stack([s.feature for s in samples], feature_padding)
         labels = None
         if samples[0].label is not None:
-            labs = [np.asarray(s.label) for s in samples]
-            if label_padding is not None:
-                labels = _pad_stack(labs, label_padding)
+            if isinstance(samples[0].label, (tuple, list)):
+                labels = tuple(stack([s.label[i] for s in samples], label_padding)
+                               for i in range(len(samples[0].label)))
             else:
-                labels = np.stack(labs)
+                labels = stack([s.label for s in samples], label_padding)
         return MiniBatch(feats, labels)
 
     def __repr__(self):

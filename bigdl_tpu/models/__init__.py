@@ -1,0 +1,16 @@
+"""Model zoo — the reference's models/ directory rebuilt NHWC/TPU-first.
+
+Reference: models/{lenet,vgg,resnet,inception,rnn,autoencoder} (survey §2.8).
+Each module exposes a builder returning an nn.Module plus a `Train` entry
+point mirroring the reference's scopt-driven Train objects.
+"""
+
+from bigdl_tpu.models.lenet import LeNet5
+from bigdl_tpu.models.vgg import VggForCifar10, Vgg16, Vgg19
+from bigdl_tpu.models.resnet import ResNet, resnet50, resnet_cifar
+from bigdl_tpu.models.inception import InceptionV1
+from bigdl_tpu.models.rnn import PTBModel, SimpleRNN
+from bigdl_tpu.models.autoencoder import Autoencoder
+
+__all__ = ["LeNet5", "VggForCifar10", "Vgg16", "Vgg19", "ResNet", "resnet50",
+           "resnet_cifar", "InceptionV1", "PTBModel", "SimpleRNN", "Autoencoder"]

@@ -1,0 +1,20 @@
+"""MNIST autoencoder.
+
+Reference: models/autoencoder/Autoencoder.scala (784 -> 32 -> 784 with
+sigmoid output trained against MSE on the input).
+"""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def Autoencoder(class_num: int = 32) -> nn.Sequential:
+    """reference: models/autoencoder/Autoencoder.scala."""
+    return nn.Sequential(
+        nn.Flatten(),
+        nn.Linear(28 * 28, class_num),
+        nn.ReLU(),
+        nn.Linear(class_num, 28 * 28),
+        nn.Sigmoid(),
+    )

@@ -1,0 +1,68 @@
+"""Inception v1 / GoogLeNet (BASELINE config 4 — the reference whitepaper's
+scaling-benchmark model).
+
+Reference: models/inception/Inception_v1.scala (inception module built from
+Concat of 1x1 / 3x3-reduce+3x3 / 5x5-reduce+5x5 / pool-proj branches; the
+no-aux-classifier variant Inception_v1_NoAuxClassifier).  NHWC, so the
+feature concat is on axis 3.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn import init as init_mod
+
+
+def _conv(cin, cout, k, stride=1, pad=0, name: Optional[str] = None):
+    return nn.Sequential(
+        nn.SpatialConvolution(cin, cout, k, k, stride, stride, pad, pad,
+                              weight_init=init_mod.Xavier(), name=name),
+        nn.ReLU(),
+    )
+
+
+def inception_module(cin: int, c1x1: int, c3x3r: int, c3x3: int,
+                     c5x5r: int, c5x5: int, pool_proj: int) -> nn.Concat:
+    """reference: Inception_v1.scala inception()."""
+    return nn.Concat(
+        3,
+        _conv(cin, c1x1, 1),
+        nn.Sequential(_conv(cin, c3x3r, 1), _conv(c3x3r, c3x3, 3, 1, 1)),
+        nn.Sequential(_conv(cin, c5x5r, 1), _conv(c5x5r, c5x5, 5, 1, 2)),
+        nn.Sequential(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1), _conv(cin, pool_proj, 1)),
+    )
+
+
+def InceptionV1(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
+    """reference: models/inception/Inception_v1.scala
+    (Inception_v1_NoAuxClassifier topology; 224x224 NHWC input)."""
+    layers = [
+        _conv(3, 64, 7, 2, 3, name="conv1/7x7_s2"),
+        nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True),
+        nn.SpatialCrossMapLRN(5, 0.0001, 0.75),
+        _conv(64, 64, 1, name="conv2/3x3_reduce"),
+        _conv(64, 192, 3, 1, 1, name="conv2/3x3"),
+        nn.SpatialCrossMapLRN(5, 0.0001, 0.75),
+        nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True),
+        inception_module(192, 64, 96, 128, 16, 32, 32),     # 3a -> 256
+        inception_module(256, 128, 128, 192, 32, 96, 64),   # 3b -> 480
+        nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True),
+        inception_module(480, 192, 96, 208, 16, 48, 64),    # 4a -> 512
+        inception_module(512, 160, 112, 224, 24, 64, 64),   # 4b -> 512
+        inception_module(512, 128, 128, 256, 24, 64, 64),   # 4c -> 512
+        inception_module(512, 112, 144, 288, 32, 64, 64),   # 4d -> 528
+        inception_module(528, 256, 160, 320, 32, 128, 128),  # 4e -> 832
+        nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True),
+        inception_module(832, 256, 160, 320, 32, 128, 128),  # 5a -> 832
+        inception_module(832, 384, 192, 384, 48, 128, 128),  # 5b -> 1024
+        nn.GlobalAveragePooling2D(),
+    ]
+    if has_dropout:
+        layers.append(nn.Dropout(0.4))
+    layers += [
+        nn.Linear(1024, class_num, weight_init=init_mod.Xavier(), name="loss3/classifier"),
+        nn.LogSoftMax(),
+    ]
+    return nn.Sequential(*layers)

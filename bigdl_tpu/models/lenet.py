@@ -1,0 +1,27 @@
+"""LeNet-5 for MNIST (BASELINE config 1).
+
+Reference: models/lenet/LeNet5.scala (conv 6@5x5 -> pool -> conv 12@5x5 ->
+pool -> fc 100 -> fc 10, tanh activations) and models/lenet/Train.scala.
+Input is NHWC (N, 28, 28, 1); the reference reshapes 1x28x28 NCHW.
+"""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def LeNet5(class_num: int = 10) -> nn.Sequential:
+    """reference: models/lenet/LeNet5.scala."""
+    return nn.Sequential(
+        nn.SpatialConvolution(1, 6, 5, 5, name="conv1_5x5"),
+        nn.Tanh(),
+        nn.SpatialMaxPooling(2, 2),
+        nn.Tanh(),
+        nn.SpatialConvolution(6, 12, 5, 5, name="conv2_5x5"),
+        nn.SpatialMaxPooling(2, 2),
+        nn.Flatten(),
+        nn.Linear(12 * 4 * 4, 100, name="fc1"),
+        nn.Tanh(),
+        nn.Linear(100, class_num, name="fc2"),
+        nn.LogSoftMax(),
+    )

@@ -1,0 +1,158 @@
+"""ResNet (BASELINE config 3: ResNet-50/ImageNet, ResNet-20/CIFAR).
+
+Reference: models/resnet/ResNet.scala (basicBlock/bottleneck builders,
+shortcut types A/B/C, shareGradInput trick, iChannels bookkeeping) and
+models/resnet/TrainImageNet.scala (v1.5 stride placement: stride lives on
+the 3x3 conv of the bottleneck, not the 1x1 — matching the mkldnn graph
+the reference actually benchmarks).
+
+TPU redesign notes:
+  * NHWC + HWIO; all convs hit the MXU directly.
+  * `shareGradInput` (reference memory-aliasing trick) has no analogue —
+    XLA's buffer assignment already reuses gradient buffers.
+  * zero-init of the last BN gamma in each residual block ("zero gamma"
+    warmup trick from the reference's ImageNet recipe) is kept, as it is a
+    numerics choice, not a memory one.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn import init as init_mod
+
+
+class _ZeroGamma(init_mod.InitializationMethod):
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return jnp.zeros(shape, dtype)
+
+
+def _bn(c: int, zero_init: bool = False) -> nn.SpatialBatchNormalization:
+    bn = nn.SpatialBatchNormalization(c)
+    if zero_init:
+        orig_build = bn.build
+
+        def build(rng, input_shape):
+            params, state, out = orig_build(rng, input_shape)
+            params["weight"] = jnp.zeros_like(params["weight"])
+            return params, state, out
+
+        bn.build = build
+    return bn
+
+
+def _conv(cin, cout, k, stride=1, pad=0):
+    return nn.SpatialConvolution(cin, cout, k, k, stride, stride, pad, pad,
+                                 with_bias=False,
+                                 weight_init=init_mod.MsraFiller(False))
+
+
+def basic_block(cin: int, cout: int, stride: int = 1) -> nn.Module:
+    """reference: models/resnet/ResNet.scala basicBlock."""
+    inp = nn.Input()
+    h = _conv(cin, cout, 3, stride, 1)(inp)
+    h = _bn(cout)(h)
+    h = nn.ReLU()(h)
+    h = _conv(cout, cout, 3, 1, 1)(h)
+    h = _bn(cout, zero_init=True)(h)
+    if stride != 1 or cin != cout:
+        sc = _conv(cin, cout, 1, stride, 0)(inp)
+        sc = _bn(cout)(sc)
+    else:
+        sc = inp
+    out = nn.CAddTable()(h, sc)
+    out = nn.ReLU()(out)
+    return nn.Graph(inp, out)
+
+
+def bottleneck(cin: int, planes: int, stride: int = 1,
+               expansion: int = 4) -> nn.Module:
+    """reference: models/resnet/ResNet.scala bottleneck; stride on the 3x3
+    (v1.5) like TrainImageNet's mkldnn graph."""
+    cout = planes * expansion
+    inp = nn.Input()
+    h = _conv(cin, planes, 1)(inp)
+    h = _bn(planes)(h)
+    h = nn.ReLU()(h)
+    h = _conv(planes, planes, 3, stride, 1)(h)
+    h = _bn(planes)(h)
+    h = nn.ReLU()(h)
+    h = _conv(planes, cout, 1)(h)
+    h = _bn(cout, zero_init=True)(h)
+    if stride != 1 or cin != cout:
+        sc = _conv(cin, cout, 1, stride, 0)(inp)
+        sc = _bn(cout)(sc)
+    else:
+        sc = inp
+    out = nn.CAddTable()(h, sc)
+    out = nn.ReLU()(out)
+    return nn.Graph(inp, out)
+
+
+def ResNet(depth: int = 50, class_num: int = 1000,
+           dataset: str = "imagenet") -> nn.Sequential:
+    """reference: models/resnet/ResNet.scala apply()."""
+    if dataset == "imagenet":
+        cfgs = {
+            18: ([2, 2, 2, 2], basic_block, 1),
+            34: ([3, 4, 6, 3], basic_block, 1),
+            50: ([3, 4, 6, 3], bottleneck, 4),
+            101: ([3, 4, 23, 3], bottleneck, 4),
+            152: ([3, 8, 36, 3], bottleneck, 4),
+        }
+        if depth not in cfgs:
+            raise ValueError(f"unsupported imagenet resnet depth {depth}")
+        blocks, block_fn, expansion = cfgs[depth]
+        layers: List[nn.Module] = [
+            _conv(3, 64, 7, 2, 3),
+            _bn(64),
+            nn.ReLU(),
+            nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1),
+        ]
+        cin = 64
+        for stage, n_blocks in enumerate(blocks):
+            planes = 64 * (2 ** stage)
+            for b in range(n_blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                layers.append(block_fn(cin, planes, stride))
+                cin = planes * expansion
+        layers += [
+            nn.GlobalAveragePooling2D(),
+            nn.Linear(cin, class_num),
+            nn.LogSoftMax(),
+        ]
+        return nn.Sequential(*layers)
+    elif dataset == "cifar10":
+        return resnet_cifar(depth, class_num)
+    raise ValueError(f"unknown dataset {dataset}")
+
+
+def resnet50(class_num: int = 1000) -> nn.Sequential:
+    return ResNet(50, class_num)
+
+
+def resnet_cifar(depth: int = 20, class_num: int = 10) -> nn.Sequential:
+    """reference: models/resnet/ResNet.scala (cifar10 path: 6n+2 layers)."""
+    assert (depth - 2) % 6 == 0, "cifar depth must be 6n+2"
+    n = (depth - 2) // 6
+    layers: List[nn.Module] = [
+        _conv(3, 16, 3, 1, 1),
+        _bn(16),
+        nn.ReLU(),
+    ]
+    cin = 16
+    for stage in range(3):
+        planes = 16 * (2 ** stage)
+        for b in range(n):
+            stride = 2 if (stage > 0 and b == 0) else 1
+            layers.append(basic_block(cin, planes, stride))
+            cin = planes
+    layers += [
+        nn.GlobalAveragePooling2D(),
+        nn.Linear(cin, class_num),
+        nn.LogSoftMax(),
+    ]
+    return nn.Sequential(*layers)

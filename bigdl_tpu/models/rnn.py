@@ -1,0 +1,44 @@
+"""PTB-style language models (BASELINE config 5).
+
+Reference: models/rnn/SimpleRNN.scala:29-31 (LookupTable -> Recurrent(RnnCell)
+-> TimeDistributed(Linear) -> LogSoftMax over TimeDistributed) and
+example/languagemodel/PTBModel.scala (embedding -> stacked LSTM ->
+TimeDistributed(Linear)).  The reference's JVM timestep loop is a lax.scan.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+
+
+def SimpleRNN(input_size: int = 4001, hidden_size: int = 40,
+              output_size: int = 4001) -> nn.Sequential:
+    """reference: models/rnn/SimpleRNN.scala."""
+    return nn.Sequential(
+        nn.LookupTable(input_size, hidden_size),
+        nn.RnnLayer(hidden_size, hidden_size, activation=jnp.tanh),
+        nn.TimeDistributed(nn.Linear(hidden_size, output_size)),
+        nn.TimeDistributed(nn.LogSoftMax()),
+    )
+
+
+def PTBModel(vocab_size: int = 10001, embedding_dim: int = 650,
+             hidden_size: int = 650, num_layers: int = 2,
+             keep_prob: float = 0.5) -> nn.Sequential:
+    """reference: example/languagemodel/PTBModel.scala (stacked-LSTM LM)."""
+    layers = [nn.LookupTable(vocab_size, embedding_dim)]
+    if keep_prob < 1.0:
+        layers.append(nn.Dropout(1.0 - keep_prob))
+    in_size = embedding_dim
+    for _ in range(num_layers):
+        layers.append(nn.LSTM(in_size, hidden_size))
+        if keep_prob < 1.0:
+            layers.append(nn.Dropout(1.0 - keep_prob))
+        in_size = hidden_size
+    layers += [
+        nn.TimeDistributed(nn.Linear(hidden_size, vocab_size)),
+        nn.TimeDistributed(nn.LogSoftMax()),
+    ]
+    return nn.Sequential(*layers)

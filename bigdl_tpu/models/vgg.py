@@ -1,0 +1,84 @@
+"""VGG models (BASELINE config 2: VGG on CIFAR-10).
+
+Reference: models/vgg/VggForCifar10.scala (conv-BN-relu blocks + 512-wide
+classifier with dropout+BN) and models/vgg/Vgg_16.scala / Vgg_19.scala
+(ImageNet).  NHWC layout.
+"""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def _conv_bn_relu(cin: int, cout: int) -> list:
+    return [
+        nn.SpatialConvolution(cin, cout, 3, 3, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(cout, eps=1e-3),
+        nn.ReLU(),
+    ]
+
+
+def VggForCifar10(class_num: int = 10, has_dropout: bool = True) -> nn.Sequential:
+    """reference: models/vgg/VggForCifar10.scala."""
+    cfg = [(3, 64), (64, 64), "M", (64, 128), (128, 128), "M",
+           (128, 256), (256, 256), (256, 256), "M",
+           (256, 512), (512, 512), (512, 512), "M",
+           (512, 512), (512, 512), (512, 512), "M"]
+    layers = []
+    for item in cfg:
+        if item == "M":
+            layers.append(nn.SpatialMaxPooling(2, 2, 2, 2, ceil_mode=True))
+        else:
+            layers.extend(_conv_bn_relu(*item))
+    classifier = [
+        nn.Flatten(),
+        nn.Linear(512, 512),
+        nn.BatchNormalization(512),
+        nn.ReLU(),
+    ]
+    if has_dropout:
+        classifier.append(nn.Dropout(0.5))
+    classifier += [nn.Linear(512, class_num), nn.LogSoftMax()]
+    return nn.Sequential(*(layers + classifier))
+
+
+def _vgg_block(layers: list, cin: int, cout: int, n: int, with_bn: bool = False) -> int:
+    for i in range(n):
+        layers.append(nn.SpatialConvolution(cin if i == 0 else cout, cout, 3, 3, 1, 1, 1, 1))
+        if with_bn:
+            layers.append(nn.SpatialBatchNormalization(cout))
+        layers.append(nn.ReLU())
+    layers.append(nn.SpatialMaxPooling(2, 2, 2, 2))
+    return cout
+
+
+def Vgg16(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
+    """reference: models/vgg/Vgg_16.scala (ImageNet, 224x224 NHWC input)."""
+    layers = []
+    for cin, cout, n in [(3, 64, 2), (64, 128, 2), (128, 256, 3),
+                         (256, 512, 3), (512, 512, 3)]:
+        _vgg_block(layers, cin, cout, n)
+    layers += [nn.Flatten(), nn.Linear(512 * 7 * 7, 4096), nn.ReLU()]
+    if has_dropout:
+        layers.append(nn.Dropout(0.5))
+    layers += [nn.Linear(4096, 4096), nn.ReLU()]
+    if has_dropout:
+        layers.append(nn.Dropout(0.5))
+    layers += [nn.Linear(4096, class_num), nn.LogSoftMax()]
+    return nn.Sequential(*layers)
+
+
+def Vgg19(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
+    """reference: models/vgg/Vgg_19.scala."""
+    layers = []
+    for cin, cout, n in [(3, 64, 2), (64, 128, 2), (128, 256, 4),
+                         (256, 512, 4), (512, 512, 4)]:
+        _vgg_block(layers, cin, cout, n)
+    layers += [nn.Flatten(), nn.Linear(512 * 7 * 7, 4096), nn.ReLU()]
+    if has_dropout:
+        layers.append(nn.Dropout(0.5))
+    layers += [nn.Linear(4096, 4096), nn.ReLU()]
+    if has_dropout:
+        layers.append(nn.Dropout(0.5))
+    layers += [nn.Linear(4096, class_num), nn.LogSoftMax()]
+    return nn.Sequential(*layers)

@@ -152,6 +152,8 @@ class Optimizer:
         return NamedSharding(self.mesh, P())
 
     def _put_batch(self, arr):
+        if isinstance(arr, (tuple, list)):
+            return type(arr)(self._put_batch(a) for a in arr)
         sh = self._batch_sharding()
         if sh is None:
             return jnp.asarray(arr)
@@ -246,29 +248,35 @@ class Optimizer:
     def _optimize_impl(self):
         state = self._driver_state
         step_fn = None
-        eval_fn = None
         root_key = RandomGenerator.next_key()
         wall_start = time.time()
-        record_count_epoch = 0
+
+        # Resume must restore BEFORE the first end_when check so a
+        # fully-trained checkpoint does not get an extra step.
+        if getattr(self, "_pending_restore", None):
+            first = next(iter(self.dataset.data(train=False)))
+            self._init_model(first)
+            self._restore(self._pending_restore)
+            self._pending_restore = None
 
         while not self.end_when(state):
             state["epoch_finished"] = False
             epoch_start = time.time()
             record_count_epoch = 0
+            completed_epoch = True
             for batch in self.dataset.data(train=True):
                 if self.end_when(state):
+                    completed_epoch = False
                     break
                 if self.params is None or step_fn is None:
                     self._init_model(batch)
-                    if getattr(self, "_pending_restore", None):
-                        self._restore(self._pending_restore)
-                        self._pending_restore = None
                     step_fn = self._build_step()
                 bs = batch.size()
                 x = self._put_batch(batch.get_input())
                 y = self._put_batch(batch.get_target())
                 rng = jax.random.fold_in(root_key, state["neval"])
-                lr = jnp.asarray(float(self._current_lr()), jnp.float32)
+                lr_f = float(self._current_lr())  # lr applied THIS step
+                lr = jnp.asarray(lr_f, jnp.float32)
                 t0 = time.perf_counter()
                 self.params, self.model_state, self.opt_state, loss = step_fn(
                     self.params, self.model_state, self.opt_state, x, y, rng, lr)
@@ -283,8 +291,7 @@ class Optimizer:
                 # driver log (reference: DistriOptimizer.scala:402-407)
                 logger.info(
                     "Epoch %d iteration %d: loss %.6f, throughput %.1f records/s, lr %.6g",
-                    state["epoch"] + 1, state["neval"], loss_f, throughput,
-                    float(self._current_lr()))
+                    state["epoch"] + 1, state["neval"], loss_f, throughput, lr_f)
                 if self.train_summary is not None:
                     s = self.train_summary
                     if s.should_log("Loss", state["neval"]):
@@ -292,9 +299,11 @@ class Optimizer:
                     if s.should_log("Throughput", state["neval"]):
                         s.add_scalar("Throughput", throughput, state["neval"])
                     if s.should_log("LearningRate", state["neval"]):
-                        s.add_scalar("LearningRate", float(self._current_lr()), state["neval"])
+                        s.add_scalar("LearningRate", lr_f, state["neval"])
                 self._maybe_validate(state)
                 self._maybe_checkpoint(state)
+            if not completed_epoch:
+                break
             state["epoch"] += 1
             state["epoch_finished"] = True
             if self.opt_state is not None:
@@ -336,6 +345,10 @@ class Optimizer:
     def validate(self) -> List[ValidationResult]:
         """Distributed eval (reference: optim/AbstractOptimizer.scala:93 +
         Evaluator.scala — RDD mapPartitions becomes batched jitted eval)."""
+        if self.val_dataset is None or self.val_methods is None:
+            raise ValueError("call set_validation(trigger, dataset, methods) first")
+        if self.params is None:
+            raise ValueError("model not built yet: run optimize() (or init) first")
         if self._compiled is None:
             self._compiled = self._build_eval_step()
         totals = [ValidationResult(0.0, 0, m.name) for m in self.val_methods]

@@ -148,7 +148,7 @@ class SequentialSchedule(LearningRateSchedule):
     """Chain schedules, each active for `maxIteration` steps.
     reference: SGD.SequentialSchedule."""
 
-    def __init__(self, iteration_per_epoch: int = 1):
+    def __init__(self):
         self.schedules: List[Tuple[LearningRateSchedule, int]] = []
 
     def add(self, schedule: LearningRateSchedule, max_iteration: int) -> "SequentialSchedule":
@@ -190,12 +190,10 @@ class EpochDecayWithWarmUp(LearningRateSchedule):
     the ResNet-50 ImageNet baseline schedule
     (reference: SGD.EpochDecayWithWarmUp, models/resnet/TrainImageNet.scala:100-123)."""
 
-    def __init__(self, warmup_epoch: int, warmup_delta: float, decay_fn,
-                 iterations_per_epoch: int = 1):
+    def __init__(self, warmup_epoch: int, warmup_delta: float, decay_fn):
         self.warmup_epoch = warmup_epoch
         self.warmup_delta = warmup_delta
         self.decay_fn = decay_fn
-        self.iterations_per_epoch = iterations_per_epoch
 
     def __call__(self, base_lr, iteration, epoch):
         warm = base_lr + self.warmup_delta * epoch

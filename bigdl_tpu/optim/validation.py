@@ -85,7 +85,12 @@ class Loss(ValidationMethod):
 
     def batch(self, output, target):
         n = output.shape[0]
-        return self.criterion.forward(output, target) * n, jnp.asarray(n, jnp.int32)
+        val = self.criterion.forward(output, target)
+        # mean-reducing criteria contribute mean*n (so merge yields the
+        # dataset mean); sum-reducing ones already carry the batch total
+        if getattr(self.criterion, "size_average", True):
+            val = val * n
+        return val, jnp.asarray(n, jnp.int32)
 
 
 class MAE(ValidationMethod):
@@ -105,7 +110,7 @@ class HitRatio(ValidationMethod):
 
     name = "HitRatio"
 
-    def __init__(self, k: int = 10, neg_num: int = 100):
+    def __init__(self, k: int = 10):
         self.k = k
         self.name = f"HitRatio@{k}"
 
@@ -123,7 +128,7 @@ class NDCG(ValidationMethod):
 
     name = "NDCG"
 
-    def __init__(self, k: int = 10, neg_num: int = 100):
+    def __init__(self, k: int = 10):
         self.k = k
         self.name = f"NDCG@{k}"
 

@@ -1,0 +1,5 @@
+from bigdl_tpu.parallel.sharding import (
+    ShardingRules, shard_params, batch_sharding, replicate,
+)
+
+__all__ = ["ShardingRules", "shard_params", "batch_sharding", "replicate"]

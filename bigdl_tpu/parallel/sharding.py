@@ -1,0 +1,80 @@
+"""Parameter/batch sharding rules over a named-axis mesh.
+
+The reference's only inter-node strategy is data parallelism with a
+parameter-server-sharded update (survey §2.10); its AllReduceParameter
+slices the flattened parameter vector 1/N per node
+(parameters/AllReduceParameter.scala:73-76).  On TPU, parallelism is
+declarative: params/batches carry `NamedSharding`s and XLA inserts the
+collectives.  This module is the one place sharding layouts are decided:
+
+  * `batch_sharding(mesh)` — batch dim over the `data` axis (dp; sequence
+    models can add the `sequence` axis on their length dim — sp).
+  * `ShardingRules` — ordered (path-regex -> PartitionSpec) rules mapping
+    parameter pytree paths to shardings (tp for wide layers; anything the
+    rules don't match is replicated).
+
+Rules are matched against "/"-joined tree paths, e.g. "10/weight" for
+Sequential child 10 or "fc/weight" for a named Graph node.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.core.engine import AXIS_DATA
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+class ShardingRules:
+    """Ordered path-regex -> PartitionSpec table (first match wins)."""
+
+    def __init__(self, rules: Optional[Sequence[Tuple[str, P]]] = None):
+        self.rules: List[Tuple[re.Pattern, P]] = [
+            (re.compile(pat), spec) for pat, spec in (rules or [])]
+
+    def add(self, pattern: str, spec: P) -> "ShardingRules":
+        self.rules.append((re.compile(pattern), spec))
+        return self
+
+    def spec_for(self, path_str: str, ndim: int) -> P:
+        for pat, spec in self.rules:
+            if pat.search(path_str):
+                return spec
+        return P()  # replicate
+
+
+def shard_params(params: Any, mesh: Mesh,
+                 rules: Optional[ShardingRules] = None) -> Any:
+    """device_put each param leaf with its rule's NamedSharding."""
+    rules = rules or ShardingRules()
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        spec = rules.spec_for(_path_str(path), np.ndim(leaf))
+        out.append(jax.device_put(leaf, NamedSharding(mesh, spec)))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def batch_sharding(mesh: Mesh, axis: str = AXIS_DATA) -> NamedSharding:
+    """Shard dim 0 (batch) over the data axis; rest replicated."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    return jax.device_put(tree, NamedSharding(mesh, P()))

@@ -1,0 +1,124 @@
+"""Model zoo shape/param/grad smoke tests (on small inputs for CI speed;
+the bench harness runs full-size)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.models import (
+    Autoencoder, InceptionV1, LeNet5, PTBModel, ResNet, SimpleRNN,
+    VggForCifar10, resnet_cifar, resnet50,
+)
+
+
+def build_forward(model, shape, train=False):
+    params, state, out_shape = model.build(jax.random.PRNGKey(0), shape)
+    y, _ = model.apply(params, state, jnp.ones(shape),
+                       training=train, rng=jax.random.PRNGKey(1))
+    return y, out_shape, params, state
+
+
+class TestLeNet:
+    def test_shapes_and_params(self):
+        m = LeNet5()
+        y, out_shape, params, _ = build_forward(m, (2, 28, 28, 1))
+        assert y.shape == (2, 10) == tuple(out_shape)
+        # reference LeNet5 param count: conv1 (1*6*25+6) + conv2 (6*12*25+12)
+        # + fc1 (192*100+100) + fc2 (100*10+10)
+        assert m.param_count(params) == (6 * 25 + 6) + (6 * 12 * 25 + 12) + \
+            (192 * 100 + 100) + (100 * 10 + 10)
+
+    def test_grad_flows(self):
+        m = LeNet5()
+        params, state, _ = m.build(jax.random.PRNGKey(0), (2, 28, 28, 1))
+        crit = nn.ClassNLLCriterion()
+
+        def loss(p):
+            out, _ = m.apply(p, state, jnp.ones((2, 28, 28, 1)))
+            return crit.forward(out, jnp.array([1, 2]))
+
+        g = jax.grad(loss)(params)
+        assert all(float(jnp.sum(jnp.abs(leaf))) > 0
+                   for leaf in jax.tree_util.tree_leaves(g))
+
+
+class TestVgg:
+    def test_cifar_shape(self):
+        m = VggForCifar10()
+        y, out_shape, params, _ = build_forward(m, (2, 32, 32, 3))
+        assert y.shape == (2, 10) == tuple(out_shape)
+        n_params = m.param_count(params)
+        assert 14_000_000 < n_params < 16_000_000, n_params  # ~15M like vgg16-cifar
+
+
+class TestResNet:
+    def test_resnet_cifar20(self):
+        m = resnet_cifar(20)
+        y, out_shape, params, _ = build_forward(m, (2, 32, 32, 3))
+        assert y.shape == (2, 10) == tuple(out_shape)
+        n = m.param_count(params)
+        assert 250_000 < n < 300_000, n  # resnet-20 ~272k
+
+    def test_resnet50_imagenet(self):
+        m = resnet50()
+        params, state, out_shape = m.build(jax.random.PRNGKey(0), (1, 224, 224, 3))
+        assert tuple(out_shape) == (1, 1000)
+        n = m.param_count(params)
+        # torchvision resnet50: 25,557,032
+        assert 25_000_000 < n < 26_000_000, n
+
+    def test_resnet50_small_forward(self):
+        # forward on small spatial dims to keep CI fast
+        m = ResNet(50, class_num=10)
+        y, out_shape, _, _ = build_forward(m, (1, 64, 64, 3))
+        assert y.shape == (1, 10)
+
+    def test_zero_gamma_init(self):
+        blk = __import__("bigdl_tpu.models.resnet", fromlist=["bottleneck"]).bottleneck(64, 16, 1)
+        params, _, _ = blk.build(jax.random.PRNGKey(0), (1, 8, 8, 64))
+        # find the zero-init BN (last bn of residual branch)
+        zeros = [k for k, v in params.items()
+                 if isinstance(v, dict) and "weight" in v
+                 and v["weight"].ndim == 1 and float(jnp.sum(jnp.abs(v["weight"]))) == 0.0]
+        assert len(zeros) == 1, zeros
+
+
+class TestInception:
+    def test_inception_v1(self):
+        m = InceptionV1(class_num=1000)
+        params, state, out_shape = m.build(jax.random.PRNGKey(0), (1, 224, 224, 3))
+        assert tuple(out_shape) == (1, 1000)
+        n = m.param_count(params)
+        # googlenet (no aux) ~ 6.0M params
+        assert 5_500_000 < n < 7_500_000, n
+        y, _ = m.apply(params, state, jnp.ones((1, 224, 224, 3)))
+        assert y.shape == (1, 1000)
+        np.testing.assert_allclose(float(jnp.sum(jnp.exp(y))), 1.0, rtol=1e-3)
+
+
+class TestRnnModels:
+    def test_simple_rnn(self):
+        m = SimpleRNN(101, 16, 101)
+        y, out_shape, _, _ = build_forward(m, (2, 7))
+        assert y.shape == (2, 7, 101) == tuple(out_shape)
+
+    def test_ptb_lstm(self):
+        m = PTBModel(vocab_size=201, embedding_dim=32, hidden_size=32,
+                     num_layers=2, keep_prob=1.0)
+        params, state, out_shape = m.build(jax.random.PRNGKey(0), (2, 10))
+        x = jnp.zeros((2, 10), jnp.int32)
+        y, _ = m.apply(params, state, x)
+        assert y.shape == (2, 10, 201) == tuple(out_shape)
+        # perplexity loss path
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+        loss = crit.forward(y, jnp.zeros((2, 10), jnp.int32))
+        assert jnp.isfinite(loss)
+
+
+class TestAutoencoder:
+    def test_roundtrip_shape(self):
+        m = Autoencoder(32)
+        y, out_shape, _, _ = build_forward(m, (2, 28, 28, 1))
+        assert y.shape == (2, 784) == tuple(out_shape)
