@@ -21,10 +21,15 @@ import jax
 import jax.numpy as jnp
 
 from bigdl_tpu.core.table import Table
+from bigdl_tpu.nn.module import capture_init
 
 
 class Criterion:
     """Base. reference: nn/abstractnn/AbstractCriterion.scala."""
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        capture_init(cls)
 
     def forward(self, input, target):
         raise NotImplementedError

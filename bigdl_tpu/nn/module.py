@@ -24,6 +24,8 @@ reference's `Activity = Tensor | Table` union.
 
 from __future__ import annotations
 
+import functools
+import inspect
 import itertools
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -37,6 +39,53 @@ from bigdl_tpu.core.table import Table
 _counter = itertools.count()
 
 Shape = Tuple[int, ...]
+
+
+def capture_init(cls) -> None:
+    """Wrap `cls.__init__` to record the bound constructor arguments on the
+    instance (`_captured_config`, `_captured_vararg`).
+
+    This is the substrate for the reflection-driven model serializer
+    (reference: utils/serializer/ModuleSerializer.scala:34-107 walks class
+    constructors via scala reflection at LOAD time; here the same
+    information is captured at CONSTRUCTION time, which also covers
+    default arguments).  Only the outermost (most-derived) __init__ call
+    records; nested super().__init__ calls are ignored.
+    """
+    orig = cls.__dict__.get("__init__")
+    if orig is None or getattr(orig, "_config_capture", False):
+        return
+    sig = inspect.signature(orig)
+
+    @functools.wraps(orig)
+    def wrapped(self, *args, **kwargs):
+        if not hasattr(self, "_captured_config"):
+            self._captured_config = None
+            self._captured_vararg = None
+            try:
+                bound = sig.bind(self, *args, **kwargs)
+            except TypeError:
+                pass
+            else:
+                cfg = OrderedDict()
+                for p in list(sig.parameters.values())[1:]:
+                    if p.name in bound.arguments:
+                        v = bound.arguments[p.name]
+                    elif p.default is not inspect.Parameter.empty:
+                        v = p.default
+                    else:
+                        continue
+                    if p.kind is inspect.Parameter.VAR_POSITIONAL:
+                        self._captured_vararg = (p.name, list(v))
+                    elif p.kind is inspect.Parameter.VAR_KEYWORD:
+                        cfg.update(v)
+                    else:
+                        cfg[p.name] = v
+                self._captured_config = cfg
+        orig(self, *args, **kwargs)
+
+    wrapped._config_capture = True
+    cls.__init__ = wrapped
 
 
 def shape_of(x: Any) -> Any:
@@ -57,6 +106,10 @@ def _is_shape(s: Any) -> bool:
 
 class Module:
     """Base module. Subclasses implement `build` and `apply`."""
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        capture_init(cls)
 
     def __init__(self, name: Optional[str] = None):
         self.name = name or f"{type(self).__name__.lower()}_{next(_counter)}"
@@ -135,6 +188,9 @@ class Module:
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}({self.name})"
+
+
+capture_init(Module)
 
 
 class Node:

@@ -1,5 +1,19 @@
 from bigdl_tpu.utils.checkpoint import save_checkpoint, load_checkpoint, latest_checkpoint
 from bigdl_tpu.utils.summary import TrainSummary, ValidationSummary
+from bigdl_tpu.utils.serializer import (
+    save_model,
+    load_model,
+    module_to_spec,
+    module_from_spec,
+    criterion_to_spec,
+    criterion_from_spec,
+    register_module,
+    register_criterion,
+    register_fn,
+)
 
 __all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
-           "TrainSummary", "ValidationSummary"]
+           "TrainSummary", "ValidationSummary",
+           "save_model", "load_model", "module_to_spec", "module_from_spec",
+           "criterion_to_spec", "criterion_from_spec",
+           "register_module", "register_criterion", "register_fn"]
