@@ -25,3 +25,9 @@ from bigdl_tpu.optim.parameter_processor import (
     ParameterProcessor, ConstantClippingProcessor, L2NormClippingProcessor,
 )
 from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer, DistriOptimizer
+from bigdl_tpu.optim.predictor import (
+    Predictor,
+    LocalPredictor,
+    Evaluator,
+    PredictionService,
+)
