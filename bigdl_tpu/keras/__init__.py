@@ -1,0 +1,46 @@
+"""Keras-1.2.2-compatible high-level API (TPU-native).
+
+Reference: nn/keras/ (Scala Keras API, 71 files) and
+pyspark/bigdl/nn/keras/ (Python mirror).  The reference maintains this as a
+separate layer zoo wrapping bigdl layers; here Keras layers are thin
+lazily-shaped adapters over bigdl_tpu.nn and the topologies reuse the
+Optimizer/Predictor/Evaluator machinery directly — Python IS the host
+language on TPU, so there is no Py4J split.
+"""
+
+from bigdl_tpu.keras.layers import (
+    KerasLayer,
+    Dense,
+    Activation,
+    Dropout,
+    Flatten,
+    Reshape,
+    Convolution2D,
+    MaxPooling2D,
+    AveragePooling2D,
+    GlobalAveragePooling2D,
+    BatchNormalization,
+    Embedding,
+    LSTM,
+    GRU,
+    SimpleRNN,
+    TimeDistributed,
+)
+from bigdl_tpu.keras.topology import Sequential, Model
+from bigdl_tpu.keras.objectives import (
+    CategoricalCrossEntropy,
+    resolve_loss,
+    resolve_optimizer,
+    resolve_metrics,
+)
+
+Conv2D = Convolution2D  # keras-2 alias
+
+__all__ = [
+    "KerasLayer", "Dense", "Activation", "Dropout", "Flatten", "Reshape",
+    "Convolution2D", "Conv2D", "MaxPooling2D", "AveragePooling2D",
+    "GlobalAveragePooling2D", "BatchNormalization", "Embedding", "LSTM",
+    "GRU", "SimpleRNN", "TimeDistributed", "Sequential", "Model",
+    "CategoricalCrossEntropy", "resolve_loss", "resolve_optimizer",
+    "resolve_metrics",
+]

@@ -1,0 +1,296 @@
+"""Keras-1.2.2-style layers.
+
+Reference: nn/keras/ (71 files) — each Keras layer wraps a bigdl layer
+behind Keras argument names, with shape inference provided by the
+`KerasLayer` adapter (nn/keras/KerasLayer.scala:165).
+
+Same design here: a KerasLayer is a Module whose inner nn layer is created
+lazily at `build` time when the input shape is known (Keras layers don't
+take input sizes; bigdl_tpu.nn layers do).  Image layout is NHWC
+("tf" dim ordering in Keras-1 terms — the TPU-native choice; the
+reference's Scala Keras API uses NCHW "th" ordering).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.module import Module
+
+_ACTIVATIONS = {
+    "relu": nn.ReLU,
+    "tanh": nn.Tanh,
+    "sigmoid": nn.Sigmoid,
+    "softmax": nn.SoftMax,
+    "log_softmax": nn.LogSoftMax,
+    "softplus": nn.SoftPlus,
+    "softsign": nn.SoftSign,
+    "hard_sigmoid": nn.HardSigmoid,
+    "linear": None,
+    None: None,
+}
+
+
+def activation_layer(name: Optional[str]) -> Optional[Module]:
+    if name not in _ACTIVATIONS:
+        raise ValueError(f"unknown activation {name!r}; "
+                         f"one of {sorted(k for k in _ACTIVATIONS if k)}")
+    cls = _ACTIVATIONS[name]
+    return cls() if cls is not None else None
+
+
+class KerasLayer(Module):
+    """Adapter: lazily constructs the inner nn layer from the input shape
+    (reference: nn/keras/KerasLayer.scala:165)."""
+
+    _serial_name: Optional[str] = None
+
+    def __init__(self, input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        # Keras input_shape excludes the batch dim
+        self.keras_input_shape = tuple(input_shape) if input_shape else None
+        self.inner: Optional[Module] = None
+
+    def _make(self, input_shape: Tuple[int, ...]) -> Module:
+        raise NotImplementedError
+
+    def _inner_for(self, input_shape) -> Module:
+        if self.inner is None:
+            self.inner = self._make(tuple(input_shape))
+        return self.inner
+
+    def build(self, rng, input_shape):
+        inner = self._inner_for(input_shape)
+        return inner.build(rng, input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if self.inner is None:
+            raise RuntimeError(f"{self.name}: build() must run before apply()")
+        return self.inner.apply(params, state, x, training=training, rng=rng)
+
+    def output_shape(self, input_shape):
+        return self._inner_for(input_shape).output_shape(input_shape)
+
+
+def _with_activation(core: Module, activation: Optional[str]) -> Module:
+    act = activation_layer(activation)
+    if act is None:
+        return core
+    return nn.Sequential(core, act)
+
+
+class Dense(KerasLayer):
+    """reference: nn/keras/Dense.scala."""
+
+    def __init__(self, output_dim: int, activation: Optional[str] = None,
+                 bias: bool = True, input_dim: Optional[int] = None,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        if input_dim is not None and input_shape is None:
+            input_shape = (input_dim,)
+        super().__init__(input_shape, name)
+        self.output_dim = output_dim
+        self.activation = activation
+        self.bias = bias
+
+    def _make(self, input_shape):
+        return _with_activation(
+            nn.Linear(input_shape[-1], self.output_dim, with_bias=self.bias),
+            self.activation)
+
+
+class Activation(KerasLayer):
+    def __init__(self, activation: str,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.activation = activation
+
+    def _make(self, input_shape):
+        layer = activation_layer(self.activation)
+        return layer if layer is not None else nn.Identity()
+
+
+class Dropout(KerasLayer):
+    def __init__(self, p: float, input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.p = p
+
+    def _make(self, input_shape):
+        return nn.Dropout(self.p)
+
+
+class Flatten(KerasLayer):
+    def _make(self, input_shape):
+        return nn.Flatten()
+
+
+class Reshape(KerasLayer):
+    def __init__(self, target_shape: Sequence[int],
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.target_shape = tuple(target_shape)
+
+    def _make(self, input_shape):
+        return nn.Reshape(self.target_shape, batch_mode=True)
+
+
+class Convolution2D(KerasLayer):
+    """NHWC conv (Keras-1 'tf' ordering). reference: nn/keras/Convolution2D.scala."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation: Optional[str] = None, border_mode: str = "valid",
+                 subsample: Tuple[int, int] = (1, 1), bias: bool = True,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.nb_filter = nb_filter
+        self.nb_row = nb_row
+        self.nb_col = nb_col
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample = tuple(subsample)
+        self.bias = bias
+
+    def _make(self, input_shape):
+        cin = input_shape[-1]
+        if self.border_mode == "same":
+            pad_h = (self.nb_row - 1) // 2
+            pad_w = (self.nb_col - 1) // 2
+        elif self.border_mode == "valid":
+            pad_h = pad_w = 0
+        else:
+            raise ValueError(f"border_mode must be 'valid' or 'same', "
+                             f"got {self.border_mode!r}")
+        core = nn.SpatialConvolution(
+            cin, self.nb_filter, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0], pad_w, pad_h,
+            with_bias=self.bias)
+        return _with_activation(core, self.activation)
+
+
+class _Pooling2D(KerasLayer):
+    def __init__(self, pool_size: Tuple[int, int] = (2, 2),
+                 strides: Optional[Tuple[int, int]] = None,
+                 border_mode: str = "valid",
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.pool_size = tuple(pool_size)
+        self.strides = tuple(strides) if strides is not None else self.pool_size
+        self.border_mode = border_mode
+
+    def _pads(self):
+        if self.border_mode == "same":
+            return (self.pool_size[1] - 1) // 2, (self.pool_size[0] - 1) // 2
+        return 0, 0
+
+
+class MaxPooling2D(_Pooling2D):
+    def _make(self, input_shape):
+        pw, ph = self._pads()
+        return nn.SpatialMaxPooling(self.pool_size[1], self.pool_size[0],
+                                    self.strides[1], self.strides[0], pw, ph)
+
+
+class AveragePooling2D(_Pooling2D):
+    def _make(self, input_shape):
+        pw, ph = self._pads()
+        return nn.SpatialAveragePooling(self.pool_size[1], self.pool_size[0],
+                                        self.strides[1], self.strides[0], pw, ph)
+
+
+class GlobalAveragePooling2D(KerasLayer):
+    def _make(self, input_shape):
+        return nn.GlobalAveragePooling2D()
+
+
+class BatchNormalization(KerasLayer):
+    """Spatial for 4-D input, plain for 2-D — resolved at build time."""
+
+    def __init__(self, epsilon: float = 1e-3, momentum: float = 0.99,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.epsilon = epsilon
+        self.momentum = momentum
+
+    def _make(self, input_shape):
+        n_out = input_shape[-1]
+        # Keras momentum is the running-average retain factor; bigdl's is the
+        # update factor.
+        mom = 1.0 - self.momentum
+        if len(input_shape) == 4:
+            return nn.SpatialBatchNormalization(n_out, eps=self.epsilon,
+                                                momentum=mom)
+        return nn.BatchNormalization(n_out, eps=self.epsilon, momentum=mom)
+
+
+class Embedding(KerasLayer):
+    def __init__(self, input_dim: int, output_dim: int,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+
+    def _make(self, input_shape):
+        return nn.LookupTable(self.input_dim, self.output_dim)
+
+
+class _Rnn(KerasLayer):
+    def __init__(self, output_dim: int, return_sequences: bool = False,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.output_dim = output_dim
+        self.return_sequences = return_sequences
+
+    def _cell(self, input_size: int):
+        raise NotImplementedError
+
+    def _make(self, input_shape):
+        _, t, f = input_shape
+        rec = nn.Recurrent(self._cell(f))
+        if self.return_sequences:
+            return rec
+        return nn.Sequential(rec, nn.Select(1, t - 1))
+
+
+class LSTM(_Rnn):
+    def _cell(self, input_size):
+        return nn.LSTMCell(input_size, self.output_dim)
+
+
+class GRU(_Rnn):
+    def _cell(self, input_size):
+        return nn.GRUCell(input_size, self.output_dim)
+
+
+class SimpleRNN(_Rnn):
+    def _cell(self, input_size):
+        return nn.RnnCell(input_size, self.output_dim)
+
+
+class TimeDistributed(KerasLayer):
+    """Wrap a Keras layer to apply per timestep."""
+
+    def __init__(self, layer: KerasLayer,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.layer = layer
+
+    def _make(self, input_shape):
+        n, t = input_shape[0], input_shape[1]
+        inner = self.layer._inner_for((n * t,) + tuple(input_shape[2:]))
+        return nn.TimeDistributed(inner)
+
+
+# serializer registration happens in bigdl_tpu/keras/__init__.py

@@ -1,0 +1,96 @@
+"""Keras loss/metric/optimizer name resolution.
+
+Reference: nn/keras/Topology.scala compile() accepts objects; the Python
+Keras API (pyspark/bigdl/nn/keras/topology.py:82-105) accepts strings —
+both are supported here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.criterion import Criterion
+from bigdl_tpu.optim import (
+    SGD, Adam, Adamax, Adadelta, Adagrad, RMSprop,
+    Top1Accuracy, Top5Accuracy, Loss, MAE,
+)
+from bigdl_tpu.optim.optim_method import OptimMethod
+from bigdl_tpu.optim.validation import ValidationMethod
+
+
+class CategoricalCrossEntropy(Criterion):
+    """Keras categorical_crossentropy: one-hot targets over logits."""
+
+    def __init__(self):
+        self.inner = nn.CrossEntropyCriterion()
+
+    def forward(self, input, target):
+        return self.inner.forward(input, jnp.argmax(target, axis=-1))
+
+
+_LOSSES = {
+    "categorical_crossentropy": CategoricalCrossEntropy,
+    "sparse_categorical_crossentropy": nn.CrossEntropyCriterion,
+    "mse": nn.MSECriterion,
+    "mean_squared_error": nn.MSECriterion,
+    "mae": nn.AbsCriterion,
+    "mean_absolute_error": nn.AbsCriterion,
+    "binary_crossentropy": nn.BCECriterion,
+    "hinge": nn.MarginCriterion,
+    "kld": nn.DistKLDivCriterion,
+    "kullback_leibler_divergence": nn.DistKLDivCriterion,
+    "smooth_l1": nn.SmoothL1Criterion,
+}
+
+_OPTIMIZERS = {
+    "sgd": lambda: SGD(learning_rate=0.01),
+    "adam": lambda: Adam(),
+    "adamax": lambda: Adamax(),
+    "adadelta": lambda: Adadelta(),
+    "adagrad": lambda: Adagrad(),
+    "rmsprop": lambda: RMSprop(),
+}
+
+_METRICS = {
+    "accuracy": Top1Accuracy,
+    "acc": Top1Accuracy,
+    "top1": Top1Accuracy,
+    "top5": Top5Accuracy,
+    "top5accuracy": Top5Accuracy,
+    "mae": MAE,
+}
+
+
+def resolve_loss(loss: Union[str, Criterion]) -> Criterion:
+    if isinstance(loss, Criterion):
+        return loss
+    key = str(loss).lower()
+    if key not in _LOSSES:
+        raise ValueError(f"unknown loss {loss!r}; one of {sorted(_LOSSES)}")
+    return _LOSSES[key]()
+
+
+def resolve_optimizer(opt: Union[str, OptimMethod]) -> OptimMethod:
+    if isinstance(opt, OptimMethod):
+        return opt
+    key = str(opt).lower()
+    if key not in _OPTIMIZERS:
+        raise ValueError(f"unknown optimizer {opt!r}; one of {sorted(_OPTIMIZERS)}")
+    return _OPTIMIZERS[key]()
+
+
+def resolve_metrics(metrics: Optional[Sequence[Union[str, ValidationMethod]]]
+                    ) -> List[ValidationMethod]:
+    out: List[ValidationMethod] = []
+    for m in metrics or []:
+        if isinstance(m, ValidationMethod):
+            out.append(m)
+            continue
+        key = str(m).lower()
+        if key not in _METRICS:
+            raise ValueError(f"unknown metric {m!r}; one of {sorted(_METRICS)}")
+        out.append(_METRICS[key]())
+    return out

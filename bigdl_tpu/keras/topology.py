@@ -1,0 +1,173 @@
+"""Keras-style Model/Sequential with compile/fit/evaluate/predict.
+
+Reference: nn/keras/Topology.scala:55-158 (KerasModel.compile/fit/evaluate/
+predict over DataSet or RDD) and the Python mirror
+(pyspark/bigdl/nn/keras/topology.py:82-105).
+
+fit() drives the same LocalOptimizer/DistriOptimizer machinery the
+low-level API uses (reference fit does exactly this: it builds an
+Optimizer internally), so mesh sharding, checkpointing, and summaries all
+apply.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.dataset.minibatch import MiniBatch
+from bigdl_tpu.keras.objectives import (
+    resolve_loss,
+    resolve_metrics,
+    resolve_optimizer,
+)
+from bigdl_tpu.optim import Optimizer, Predictor, Evaluator, Trigger, Loss
+from bigdl_tpu.utils import TrainSummary, ValidationSummary
+
+
+def _to_minibatches(x: np.ndarray, y: Optional[np.ndarray],
+                    batch_size: int) -> List[MiniBatch]:
+    n = x.shape[0]
+    out = []
+    for off in range(0, n, batch_size):
+        xi = np.asarray(x[off:off + batch_size])
+        yi = None if y is None else np.asarray(y[off:off + batch_size])
+        out.append(MiniBatch(xi, yi))
+    return out
+
+
+class _ListDataSet(DataSet):
+    """Fixed pre-built batches (evaluation path — order is irrelevant)."""
+
+    def __init__(self, batches: List[MiniBatch]):
+        self.batches = batches
+
+    def size(self) -> int:
+        return sum(b.size() for b in self.batches)
+
+    def data(self, train: bool):
+        return iter(self.batches)
+
+
+class _ArrayTrainDataSet(DataSet):
+    """Training batches with a fresh seeded row permutation each epoch
+    (the reference's DistributedDataSet shuffles per epoch,
+    dataset/DataSet.scala:167)."""
+
+    def __init__(self, x: np.ndarray, y: np.ndarray, batch_size: int,
+                 seed: int = 1):
+        self.x, self.y = x, y
+        self.batch_size = batch_size
+        self.seed = seed
+        self._epoch = 0
+
+    def size(self) -> int:
+        return self.x.shape[0]
+
+    def data(self, train: bool):
+        if not train:
+            return iter(_to_minibatches(self.x, self.y, self.batch_size))
+        perm = np.random.RandomState(self.seed + self._epoch).permutation(
+            self.x.shape[0])
+        self._epoch += 1
+        return iter(_to_minibatches(self.x[perm], self.y[perm], self.batch_size))
+
+
+class KerasTopology:
+    """compile/fit/evaluate/predict mixin (reference: Topology.scala:55-158)."""
+
+    def compile(self, optimizer: Union[str, Any], loss: Union[str, Any],
+                metrics: Optional[Sequence[Any]] = None) -> None:
+        self.optim_method = resolve_optimizer(optimizer)
+        self.criterion = resolve_loss(loss)
+        self.metrics = resolve_metrics(metrics)
+        # keep any set_checkpoint/set_tensorboard made before compile()
+        self._ckpt = getattr(self, "_ckpt", None)
+        self._tb = getattr(self, "_tb", None)
+
+    def set_checkpoint(self, path: str, trigger: Optional[Trigger] = None) -> None:
+        self._ckpt = (path, trigger or Trigger.every_epoch())
+
+    def set_tensorboard(self, log_dir: str, app_name: str) -> None:
+        self._tb = (log_dir, app_name)
+
+    def _require_compiled(self):
+        if not hasattr(self, "optim_method"):
+            raise RuntimeError("call compile(optimizer, loss[, metrics]) first")
+
+    def fit(self, x: Union[np.ndarray, DataSet], y: Optional[np.ndarray] = None,
+            batch_size: int = 32, nb_epoch: int = 10,
+            validation_data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+            mesh=None) -> "KerasTopology":
+        self._require_compiled()
+        if isinstance(x, DataSet):
+            dataset = x
+        else:
+            if y is None:
+                raise ValueError("fit(x, y) needs labels unless x is a DataSet")
+            # drop-last so the jitted train step sees one static batch shape
+            n_full = (x.shape[0] // batch_size) * batch_size
+            if n_full == 0:
+                raise ValueError(
+                    f"fewer samples ({x.shape[0]}) than batch_size ({batch_size})")
+            dataset = _ArrayTrainDataSet(np.asarray(x[:n_full]),
+                                         np.asarray(y[:n_full]), batch_size)
+        opt = Optimizer(model=self, dataset=dataset, criterion=self.criterion,
+                        end_trigger=Trigger.max_epoch(nb_epoch), mesh=mesh)
+        opt.set_optim_method(self.optim_method)
+        if validation_data is not None:
+            vx, vy = validation_data
+            val_methods = list(self.metrics) or [Loss(self.criterion)]
+            opt.set_validation(Trigger.every_epoch(),
+                               _ListDataSet(_to_minibatches(vx, vy, batch_size)),
+                               val_methods)
+        if self._ckpt is not None:
+            opt.set_checkpoint(*self._ckpt)
+        if self._tb is not None:
+            log_dir, app = self._tb
+            opt.set_train_summary(TrainSummary(log_dir, app))
+            opt.set_val_summary(ValidationSummary(log_dir, app))
+        opt.optimize()
+        return self
+
+    def evaluate(self, x: np.ndarray, y: np.ndarray,
+                 batch_size: int = 32) -> List[Tuple[str, float]]:
+        """Returns [(name, value)]: loss first, then compiled metrics."""
+        self._require_compiled()
+        if self.params is None:
+            raise RuntimeError("model has no parameters; fit() or init() first")
+        methods = [Loss(self.criterion)] + list(self.metrics)
+        ev = Evaluator(self)
+        results = ev.test(self.params, self.state,
+                          _ListDataSet(_to_minibatches(x, y, batch_size)),
+                          methods, batch_size=batch_size)
+        return [(r.name, r.result()[0]) for r in results]
+
+    def predict(self, x: np.ndarray, batch_size: int = 32) -> np.ndarray:
+        if self.params is None:
+            raise RuntimeError("model has no parameters; fit() or init() first")
+        return Predictor(self, self.params, self.state,
+                         batch_size=batch_size).predict(x)
+
+    def predict_classes(self, x: np.ndarray, batch_size: int = 32) -> np.ndarray:
+        return np.argmax(self.predict(x, batch_size), axis=-1)
+
+
+# KerasTopology is first in the MRO so its evaluate() (metric evaluation,
+# Keras semantics) wins over Module.evaluate() (eval-mode switch).
+class Sequential(KerasTopology, nn.Sequential):
+    """Keras-style Sequential (reference: nn/keras/Topology.scala Sequential)."""
+
+    _serial_name = "keras.Sequential"
+
+
+class Model(KerasTopology, nn.Graph):
+    """Keras-style functional Model over a node DAG
+    (reference: nn/keras/Topology.scala Model)."""
+
+    _serial_name = "keras.Model"

@@ -93,6 +93,21 @@ def _scan_registry() -> None:
         if isinstance(obj, type) and issubclass(obj, InitializationMethod):
             INIT_REGISTRY[obj.__name__] = obj
 
+    # Keras layer/topology zoo registers under "keras.<Name>" so e.g.
+    # keras Sequential does not shadow nn.Sequential.
+    import bigdl_tpu.keras as keras_pkg
+
+    for name in dir(keras_pkg):
+        obj = getattr(keras_pkg, name)
+        if isinstance(obj, type) and issubclass(obj, Module):
+            # __dict__ lookup, NOT getattr: _serial_name set on a base class
+            # must not leak into subclasses or they'd all share one key.
+            serial = obj.__dict__.get("_serial_name") or f"keras.{obj.__name__}"
+            obj._serial_name = serial
+            MODULE_REGISTRY[serial] = obj
+        elif isinstance(obj, type) and issubclass(obj, Criterion):
+            CRITERION_REGISTRY[obj.__name__] = obj
+
 
 _scanned = False
 
@@ -169,6 +184,12 @@ def decode_value(v: Any) -> Any:
 # ---------------------------------------------------------------------------
 
 
+def _serial_class_name(m: Any) -> str:
+    # own-class __dict__ only — an inherited _serial_name would mislabel
+    # subclasses with their parent's registry key.
+    return type(m).__dict__.get("_serial_name") or type(m).__name__
+
+
 def module_to_spec(m: Module) -> Dict[str, Any]:
     _ensure_registry()
     if isinstance(m, Graph):
@@ -176,7 +197,7 @@ def module_to_spec(m: Module) -> Dict[str, Any]:
     cfg = getattr(m, "_captured_config", None) or OrderedDict()
     vararg = getattr(m, "_captured_vararg", None)
     spec: Dict[str, Any] = {
-        "class": type(m).__name__,
+        "class": _serial_class_name(m),
         "name": m.name,
         "config": {k: encode_value(v) for k, v in cfg.items() if k != "name"},
     }
@@ -210,7 +231,7 @@ def module_to_spec(m: Module) -> Dict[str, Any]:
 
 def module_from_spec(spec: Dict[str, Any]) -> Module:
     _ensure_registry()
-    if spec["class"] == "Graph":
+    if "nodes" in spec:
         return _graph_from_spec(spec)
     cls = MODULE_REGISTRY.get(spec["class"])
     if cls is None:
@@ -242,7 +263,7 @@ def _graph_to_spec(g: Graph) -> Dict[str, Any]:
             "prevs": [idx[id(p)] for p in n.prevs],
         })
     return {
-        "class": "Graph",
+        "class": _serial_class_name(g),
         "name": g.name,
         "nodes": nodes,
         "inputs": [idx[id(n)] for n in g.input_nodes],
@@ -251,6 +272,7 @@ def _graph_to_spec(g: Graph) -> Dict[str, Any]:
 
 
 def _graph_from_spec(spec: Dict[str, Any]) -> Graph:
+    cls = MODULE_REGISTRY.get(spec["class"], Graph)
     nodes: List[Node] = []
     for ns in spec["nodes"]:
         if ns["module"] is None:
@@ -260,8 +282,8 @@ def _graph_from_spec(spec: Dict[str, Any]) -> Graph:
                         [nodes[i] for i in ns["prevs"]])
         node.name = ns["name"]
         nodes.append(node)
-    g = Graph([nodes[i] for i in spec["inputs"]],
-              [nodes[i] for i in spec["outputs"]])
+    g = cls([nodes[i] for i in spec["inputs"]],
+            [nodes[i] for i in spec["outputs"]])
     g.name = spec["name"]
     return g
 

@@ -14,6 +14,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+import bigdl_tpu.keras as keras
 import bigdl_tpu.nn as nn
 from bigdl_tpu.core.table import Table
 from bigdl_tpu.utils import serializer as ser
@@ -141,6 +142,36 @@ EXEMPLARS = {
     "Transpose": (lambda: nn.Transpose([(1, 2)]), lambda: rand(2, 3, 4)),
     "Unsqueeze": (lambda: nn.Unsqueeze(1), lambda: rand(2, 3)),
     "View": (lambda: nn.View(6), lambda: rand(2, 2, 3)),
+    # keras layer zoo (registered under "keras.<Name>")
+    "keras.Dense": (lambda: keras.Dense(3, activation="relu", input_dim=4),
+                    lambda: rand(2, 4)),
+    "keras.Activation": (lambda: keras.Activation("tanh"), lambda: rand(2, 3)),
+    "keras.Dropout": (lambda: keras.Dropout(0.4), lambda: rand(2, 3)),
+    "keras.Flatten": (lambda: keras.Flatten(), lambda: rand(2, 3, 4)),
+    "keras.Reshape": (lambda: keras.Reshape((6,)), lambda: rand(2, 2, 3)),
+    "keras.Convolution2D": (
+        lambda: keras.Convolution2D(4, 3, 3, border_mode="same"),
+        lambda: rand(2, 5, 5, 3)),
+    "keras.MaxPooling2D": (lambda: keras.MaxPooling2D((2, 2)),
+                           lambda: rand(2, 4, 4, 3)),
+    "keras.AveragePooling2D": (lambda: keras.AveragePooling2D((2, 2)),
+                               lambda: rand(2, 4, 4, 3)),
+    "keras.GlobalAveragePooling2D": (lambda: keras.GlobalAveragePooling2D(),
+                                     lambda: rand(2, 4, 4, 3)),
+    "keras.BatchNormalization": (lambda: keras.BatchNormalization(),
+                                 lambda: rand(3, 4)),
+    "keras.Embedding": (lambda: keras.Embedding(10, 4),
+                        lambda: jnp.asarray([[1, 2], [3, 4]], jnp.int32)),
+    "keras.LSTM": (lambda: keras.LSTM(5), lambda: rand(2, 4, 3)),
+    "keras.GRU": (lambda: keras.GRU(5, return_sequences=True),
+                  lambda: rand(2, 4, 3)),
+    "keras.SimpleRNN": (lambda: keras.SimpleRNN(5), lambda: rand(2, 4, 3)),
+    "keras.TimeDistributed": (
+        lambda: keras.TimeDistributed(keras.Dense(4)), lambda: rand(2, 5, 3)),
+    "keras.Sequential": (
+        lambda: keras.Sequential(keras.Dense(4, input_dim=3), keras.Dense(2)),
+        lambda: rand(2, 3)),
+    "keras.Model": ("special", None),
 }
 
 CRITERION_EXEMPLARS = {
@@ -167,9 +198,11 @@ CRITERION_EXEMPLARS = {
     "SoftmaxWithCriterion": (lambda: nn.SoftmaxWithCriterion(), "cls"),
     "TimeDistributedCriterion": (
         lambda: nn.TimeDistributedCriterion(nn.MSECriterion()), "td"),
+    "CategoricalCrossEntropy": (lambda: keras.CategoricalCrossEntropy(),
+                                "onehot"),
 }
 
-EXCLUDED = {"Module", "Container", "Criterion"}
+EXCLUDED = {"Module", "Container", "Criterion", "keras.KerasLayer"}
 
 
 def _registered_modules():
@@ -208,6 +241,9 @@ def test_module_roundtrip(cls_name):
         return
     x = make_input()
     params, state, _ = m.build(jax.random.PRNGKey(7), _shape_of(x))
+    # keras layers construct their inner nn layer during build; the rebuilt
+    # instance must build before applying shared weights
+    rebuilt.build(jax.random.PRNGKey(7), _shape_of(x))
     y1, _ = m.apply(params, state, x, training=False)
     y2, _ = rebuilt.apply(params, state, x, training=False)
     _assert_close(y1, y2)
@@ -245,6 +281,8 @@ def _criterion_io(kind):
         return table((4, 3), (4, 3)), rand(4, 3)
     if kind == "td":
         return rand(2, 3, 4), rand(2, 3, 4)
+    if kind == "onehot":
+        return rand(4, 3), jnp.asarray(np.eye(3, dtype=np.float32)[[0, 1, 2, 1]])
     raise ValueError(kind)
 
 
@@ -301,6 +339,23 @@ def test_save_load_model_lenet(tmp_path):
     ser.save_model(path, m, params, state)
     m2, p2, s2 = ser.load_model(path)
     y2, _ = m2.apply(p2, s2, x, training=False)
+    _assert_close(y1, y2)
+
+
+def test_keras_functional_model_roundtrip():
+    inp = nn.Input()
+    h = keras.Dense(8, activation="relu")(inp)
+    out = keras.Dense(2)(h)
+    m = keras.Model(inp, out)
+    x = rand(3, 4)
+    params, state, _ = m.build(jax.random.PRNGKey(0), (3, 4))
+    y1, _ = m.apply(params, state, x)
+
+    spec = ser.module_to_spec(m)
+    m2 = ser.module_from_spec(spec)
+    assert type(m2) is keras.Model
+    m2.build(jax.random.PRNGKey(0), (3, 4))
+    y2, _ = m2.apply(params, state, x)
     _assert_close(y1, y2)
 
 
