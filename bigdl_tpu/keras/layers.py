@@ -59,6 +59,14 @@ class KerasLayer(Module):
         raise NotImplementedError
 
     def _inner_for(self, input_shape) -> Module:
+        if self.keras_input_shape is not None:
+            declared = self.keras_input_shape
+            actual = tuple(input_shape)[1:]  # drop batch dim
+            if len(declared) != len(actual) or any(
+                    d is not None and d != a for d, a in zip(declared, actual)):
+                raise ValueError(
+                    f"{self.name}: declared input_shape {declared} does not "
+                    f"match data shape {actual} (batch dim excluded)")
         if self.inner is None:
             self.inner = self._make(tuple(input_shape))
         return self.inner
@@ -161,8 +169,7 @@ class Convolution2D(KerasLayer):
     def _make(self, input_shape):
         cin = input_shape[-1]
         if self.border_mode == "same":
-            pad_h = (self.nb_row - 1) // 2
-            pad_w = (self.nb_col - 1) // 2
+            pad_h = pad_w = -1  # TF-SAME: out = ceil(n/s), asymmetric pad
         elif self.border_mode == "valid":
             pad_h = pad_w = 0
         else:
@@ -188,7 +195,7 @@ class _Pooling2D(KerasLayer):
 
     def _pads(self):
         if self.border_mode == "same":
-            return (self.pool_size[1] - 1) // 2, (self.pool_size[0] - 1) // 2
+            return -1, -1  # TF-SAME: out = ceil(n/s), asymmetric pad
         return 0, 0
 
 
@@ -202,8 +209,10 @@ class MaxPooling2D(_Pooling2D):
 class AveragePooling2D(_Pooling2D):
     def _make(self, input_shape):
         pw, ph = self._pads()
+        # TF/Keras 'same' avg-pool divides by the count of valid elements
         return nn.SpatialAveragePooling(self.pool_size[1], self.pool_size[0],
-                                        self.strides[1], self.strides[0], pw, ph)
+                                        self.strides[1], self.strides[0], pw, ph,
+                                        count_include_pad=(self.border_mode != "same"))
 
 
 class GlobalAveragePooling2D(KerasLayer):
@@ -229,6 +238,9 @@ class BatchNormalization(KerasLayer):
         if len(input_shape) == 4:
             return nn.SpatialBatchNormalization(n_out, eps=self.epsilon,
                                                 momentum=mom)
+        if len(input_shape) == 3:
+            return nn.TemporalBatchNormalization(n_out, eps=self.epsilon,
+                                                 momentum=mom)
         return nn.BatchNormalization(n_out, eps=self.epsilon, momentum=mom)
 
 

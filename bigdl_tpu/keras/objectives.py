@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Any, List, Optional, Sequence, Union
 
+import jax
 import jax.numpy as jnp
 
 import bigdl_tpu.nn as nn
@@ -22,13 +23,15 @@ from bigdl_tpu.optim.validation import ValidationMethod
 
 
 class CategoricalCrossEntropy(Criterion):
-    """Keras categorical_crossentropy: one-hot targets over logits."""
+    """Keras categorical_crossentropy over logits: -sum(t * log_softmax(x)).
 
-    def __init__(self):
-        self.inner = nn.CrossEntropyCriterion()
+    Targets may be one-hot OR soft/label-smoothed distributions — both are
+    honored exactly (argmax-collapsing soft targets would silently optimize
+    a different objective)."""
 
     def forward(self, input, target):
-        return self.inner.forward(input, jnp.argmax(target, axis=-1))
+        logp = jax.nn.log_softmax(input, axis=-1)
+        return -jnp.mean(jnp.sum(target * logp, axis=-1))
 
 
 _LOSSES = {

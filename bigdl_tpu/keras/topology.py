@@ -142,17 +142,24 @@ class KerasTopology:
         if self.params is None:
             raise RuntimeError("model has no parameters; fit() or init() first")
         methods = [Loss(self.criterion)] + list(self.metrics)
-        ev = Evaluator(self)
-        results = ev.test(self.params, self.state,
-                          _ListDataSet(_to_minibatches(x, y, batch_size)),
-                          methods, batch_size=batch_size)
+        # cache the Evaluator so its jitted eval step survives across calls
+        if getattr(self, "_evaluator", None) is None:
+            self._evaluator = Evaluator(self)
+        results = self._evaluator.test(self.params, self.state,
+                                       _ListDataSet(_to_minibatches(x, y, batch_size)),
+                                       methods, batch_size=batch_size)
         return [(r.name, r.result()[0]) for r in results]
 
     def predict(self, x: np.ndarray, batch_size: int = 32) -> np.ndarray:
         if self.params is None:
             raise RuntimeError("model has no parameters; fit() or init() first")
-        return Predictor(self, self.params, self.state,
-                         batch_size=batch_size).predict(x)
+        # cache the Predictor (and so its jitted forward) per params/batch_size
+        cached = getattr(self, "_predictor", None)
+        if cached is None or cached[0] is not self.params or cached[1] != batch_size:
+            self._predictor = (self.params, batch_size,
+                               Predictor(self, self.params, self.state,
+                                         batch_size=batch_size))
+        return self._predictor[2].predict(x)
 
     def predict_classes(self, x: np.ndarray, batch_size: int = 32) -> np.ndarray:
         return np.argmax(self.predict(x, batch_size), axis=-1)

@@ -11,6 +11,12 @@ from bigdl_tpu.models.resnet import ResNet, resnet50, resnet_cifar
 from bigdl_tpu.models.inception import InceptionV1
 from bigdl_tpu.models.rnn import PTBModel, SimpleRNN
 from bigdl_tpu.models.autoencoder import Autoencoder
+from bigdl_tpu.models.transformer import (
+    TransformerLM,
+    transformer_lm_small,
+    transformer_lm_base,
+)
 
 __all__ = ["LeNet5", "VggForCifar10", "Vgg16", "Vgg19", "ResNet", "resnet50",
-           "resnet_cifar", "InceptionV1", "PTBModel", "SimpleRNN", "Autoencoder"]
+           "resnet_cifar", "InceptionV1", "PTBModel", "SimpleRNN", "Autoencoder",
+           "TransformerLM", "transformer_lm_small", "transformer_lm_base"]

@@ -1,0 +1,108 @@
+"""Transformer language model — the long-context flagship.
+
+No reference counterpart (the reference's only LM is the PTB LSTM,
+models/rnn/Train.scala); this is the designed-fresh TPU capability the
+rebuild adds: decoder-only LM with RoPE, causal attention, optional ring /
+Ulysses sequence parallelism, and scan-over-layers so N blocks compile as
+ONE scanned XLA loop body (fast compiles, weight-stationary layout) with
+optional rematerialization (`jax.checkpoint`) to trade FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.attention import TransformerBlock
+from bigdl_tpu.nn.embedding import LookupTable
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.norm import LayerNormalization
+
+
+class TransformerLM(Module):
+    """Decoder-only LM over int32 token ids (B, S) -> log-probs (B, S, V)."""
+
+    def __init__(self, vocab_size: int, hidden_size: int = 512, n_layer: int = 6,
+                 n_head: int = 8, *, max_len: int = 2048, dropout: float = 0.0,
+                 rope: bool = True, tie_embeddings: bool = True,
+                 seq_parallel: Optional[str] = None, scan_layers: bool = True,
+                 remat: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.n_layer = n_layer
+        self.n_head = n_head
+        self.max_len = max_len
+        self.rope = rope
+        self.tie_embeddings = tie_embeddings
+        self.scan_layers = scan_layers
+        self.remat = remat
+        self.embed = LookupTable(vocab_size, hidden_size,
+                                 weight_init=init_mod.RandomNormal(0.0, 0.02))
+        self.block = TransformerBlock(hidden_size, n_head, causal=True,
+                                      dropout=dropout, rope=rope,
+                                      seq_parallel=seq_parallel)
+        self.ln_f = LayerNormalization(hidden_size)
+
+    def build(self, rng, input_shape):
+        b, s = input_shape
+        d = self.hidden_size
+        k_emb, k_pos, k_blocks, k_head = jax.random.split(rng, 4)
+        params = {"embed": self.embed.build(k_emb, input_shape)[0]}
+        if not self.rope:
+            params["pos"] = init_mod.RandomNormal(0.0, 0.02)(
+                k_pos, (self.max_len, d), self.max_len, d)
+        block_shape = (b, s, d)
+        blocks = [self.block.build(jax.random.fold_in(k_blocks, i), block_shape)[0]
+                  for i in range(self.n_layer)]
+        if self.scan_layers:
+            params["blocks"] = jax.tree_util.tree_map(
+                lambda *leaves: jnp.stack(leaves), *blocks)
+        else:
+            params["blocks"] = {str(i): p for i, p in enumerate(blocks)}
+        params["ln_f"] = self.ln_f.build(jax.random.fold_in(rng, 3), block_shape)[0]
+        if not self.tie_embeddings:
+            params["head"] = init_mod.Xavier()(k_head, (d, self.vocab_size),
+                                               d, self.vocab_size)
+        return params, {}, (b, s, self.vocab_size)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        b, s = x.shape
+        h, _ = self.embed.apply(params["embed"], {}, x)
+        if not self.rope:
+            h = h + params["pos"][:s][None]
+
+        blk = self.block
+
+        def body(carry, layer_params):
+            h, i = carry
+            r = None if rng is None else jax.random.fold_in(rng, i)
+            out, _ = blk.apply(layer_params, {}, h, training=training, rng=r)
+            return (out, i + 1), None
+
+        if self.scan_layers:
+            fn = jax.checkpoint(body) if self.remat else body
+            (h, _), _ = lax.scan(fn, (h, 0), params["blocks"])
+        else:
+            for i in range(self.n_layer):
+                (h, _), _ = body((h, i), params["blocks"][str(i)])
+
+        h, _ = self.ln_f.apply(params["ln_f"], {}, h)
+        head = params["embed"]["weight"].T if self.tie_embeddings else params["head"]
+        logits = h @ head
+        return jax.nn.log_softmax(logits, axis=-1), state
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape) + (self.vocab_size,)
+
+
+def transformer_lm_small(vocab_size: int = 32000, **kw) -> TransformerLM:
+    return TransformerLM(vocab_size, hidden_size=512, n_layer=8, n_head=8, **kw)
+
+
+def transformer_lm_base(vocab_size: int = 32000, **kw) -> TransformerLM:
+    return TransformerLM(vocab_size, hidden_size=768, n_layer=12, n_head=12, **kw)

@@ -28,6 +28,7 @@ from bigdl_tpu.nn.pooling import (
 )
 from bigdl_tpu.nn.norm import (
     BatchNormalization,
+    TemporalBatchNormalization,
     SpatialBatchNormalization,
     LayerNormalization,
     Normalize,
@@ -109,6 +110,11 @@ from bigdl_tpu.nn.recurrent import (
     Recurrent,
     BiRecurrent,
     TimeDistributed,
+)
+from bigdl_tpu.nn.attention import (
+    MultiHeadAttention,
+    TransformerBlock,
+    apply_rope,
 )
 from bigdl_tpu.nn.criterion import (
     Criterion,

@@ -1,0 +1,199 @@
+"""Multi-head attention + transformer blocks.
+
+The reference has no attention layers at all (survey §5.7); long-context is
+a designed-fresh, first-class TPU capability here.  The layer wraps the
+attention cores in `bigdl_tpu.ops.attention`:
+
+  * default: dense softmax attention (XLA-fused, MXU-friendly),
+  * `seq_parallel="ring"` — ring attention over the mesh `sequence` axis
+    (K/V blocks rotate one ICI hop per step; O(S_local) memory/chip),
+  * `seq_parallel="ulysses"` — all-to-all head-scatter/sequence-gather.
+
+Sequence parallelism engages only when the active mesh actually has a
+sequence axis of size > 1, so the same model code runs single-chip and on a
+dp x sp x tp mesh unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bigdl_tpu.core.engine import AXIS_DATA, AXIS_SEQUENCE, Engine
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.activation import GELU
+from bigdl_tpu.nn.dropout import Dropout
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.module import Container, Module, child_rng
+from bigdl_tpu.nn.norm import LayerNormalization
+from bigdl_tpu.ops.attention import dense_attention, ring_attention, ulysses_attention
+
+
+def apply_rope(x: jax.Array, *, base: float = 10000.0,
+               positions: Optional[jax.Array] = None) -> jax.Array:
+    """Rotary position embedding over (B, S, H, D) (D even)."""
+    b, s, h, d = x.shape
+    if positions is None:
+        positions = jnp.arange(s)
+    freqs = base ** (-jnp.arange(0, d, 2) / d)
+    angles = positions[:, None] * freqs[None, :]  # (S, D/2)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    rot = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rot.reshape(b, s, h, d).astype(x.dtype)
+
+
+def _active_mesh(explicit: Optional[Mesh]) -> Optional[Mesh]:
+    if explicit is not None:
+        return explicit
+    if Engine._mesh is not None:  # initialized Engine wins
+        return Engine._mesh
+    return None
+
+
+class MultiHeadAttention(Module):
+    """Self-attention over (B, S, D) inputs.
+
+    No reference counterpart (the reference tops out at LSTM/GRU recurrence,
+    nn/Recurrent.scala); API follows the framework's functional Module
+    protocol.  `causal=True` gives decoder (LM) masking.
+    """
+
+    def __init__(self, hidden_size: int, n_head: int, *, causal: bool = False,
+                 dropout: float = 0.0, with_bias: bool = True, rope: bool = False,
+                 seq_parallel: Optional[str] = None,
+                 seq_axis: str = AXIS_SEQUENCE, data_axis: str = AXIS_DATA,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        if hidden_size % n_head != 0:
+            raise ValueError(f"hidden_size {hidden_size} % n_head {n_head} != 0")
+        if seq_parallel not in (None, "ring", "ulysses"):
+            raise ValueError(f"unknown seq_parallel {seq_parallel!r}")
+        self.hidden_size = hidden_size
+        self.n_head = n_head
+        self.head_dim = hidden_size // n_head
+        self.causal = causal
+        self.dropout_p = dropout
+        self.with_bias = with_bias
+        self.rope = rope
+        self.seq_parallel = seq_parallel
+        self.seq_axis = seq_axis
+        self.data_axis = data_axis
+        self.mesh: Optional[Mesh] = None  # explicit override for tests
+
+    def build(self, rng, input_shape):
+        d = self.hidden_size
+        ks = jax.random.split(rng, 4)
+        xavier = init_mod.Xavier()
+        params = {}
+        for key, k in zip(("wq", "wk", "wv", "wo"), ks):
+            params[key] = xavier(k, (d, d), d, d)
+            if self.with_bias:
+                params[key.replace("w", "b")] = jnp.zeros((d,), jnp.float32)
+        return params, {}, input_shape
+
+    def _core(self, q, k, v):
+        mesh = _active_mesh(self.mesh)
+        sp = self.seq_parallel
+        if sp is not None and mesh is not None and \
+                mesh.shape.get(self.seq_axis, 1) > 1:
+            core = ring_attention if sp == "ring" else ulysses_attention
+            fn = partial(core, axis_name=self.seq_axis, causal=self.causal)
+            data = self.data_axis if self.data_axis in mesh.axis_names else None
+            spec = P(data, self.seq_axis, None, None)
+            return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                                 out_specs=spec)(q, k, v)
+        return dense_attention(q, k, v, causal=self.causal)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        b, s, d = x.shape
+        h, hd = self.n_head, self.head_dim
+
+        def proj(name, t):
+            y = t @ params["w" + name]
+            if self.with_bias:
+                y = y + params["b" + name]
+            return y.reshape(b, s, h, hd)
+
+        q, k, v = proj("q", x), proj("k", x), proj("v", x)
+        if self.rope:
+            q, k = apply_rope(q), apply_rope(k)
+        ctx = self._core(q, k, v).reshape(b, s, d)
+        out = ctx @ params["wo"]
+        if self.with_bias:
+            out = out + params["bo"]
+        if self.dropout_p > 0.0:
+            out, _ = Dropout(self.dropout_p).apply({}, {}, out,
+                                                   training=training, rng=rng)
+        return out, state
+
+
+class TransformerBlock(Container):
+    """Pre-LN transformer decoder/encoder block:
+    x + MHA(LN(x)); then x + MLP(LN(x)) with a GELU 4x-wide MLP."""
+
+    _constructor_children = True  # children derive from config; don't serialize
+
+    def __init__(self, hidden_size: int, n_head: int, *, causal: bool = True,
+                 mlp_ratio: int = 4, dropout: float = 0.0, rope: bool = False,
+                 seq_parallel: Optional[str] = None, name: Optional[str] = None):
+        super().__init__(name)
+        self.hidden_size = hidden_size
+        self.children["ln1"] = LayerNormalization(hidden_size)
+        self.children["attn"] = MultiHeadAttention(
+            hidden_size, n_head, causal=causal, dropout=dropout, rope=rope,
+            seq_parallel=seq_parallel)
+        self.children["ln2"] = LayerNormalization(hidden_size)
+        self.children["mlp"] = _Mlp(hidden_size, mlp_ratio * hidden_size, dropout)
+
+    def build(self, rng, input_shape):
+        params, state = {}, {}
+        shape = input_shape
+        for i, (key, m) in enumerate(self.children.items()):
+            params[key], state[key], _ = m.build(jax.random.fold_in(rng, i), shape)
+        return params, state, shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        c = self.children
+        st = state if isinstance(state, dict) else {}
+        h, _ = c["ln1"].apply(params["ln1"], st.get("ln1", {}), x)
+        h, _ = c["attn"].apply(params["attn"], st.get("attn", {}), h,
+                               training=training, rng=child_rng(rng, 0))
+        x = x + h
+        h, _ = c["ln2"].apply(params["ln2"], st.get("ln2", {}), x)
+        h, _ = c["mlp"].apply(params["mlp"], st.get("mlp", {}), h,
+                              training=training, rng=child_rng(rng, 1))
+        return x + h, state
+
+
+class _Mlp(Container):
+    _constructor_children = True
+
+    def __init__(self, d: int, hidden: int, dropout: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.children["fc1"] = Linear(d, hidden)
+        self.children["act"] = GELU()
+        self.children["fc2"] = Linear(hidden, d)
+        self.dropout = Dropout(dropout) if dropout > 0.0 else None
+
+    def build(self, rng, input_shape):
+        params, state = {}, {}
+        shape = input_shape
+        for i, (key, m) in enumerate(self.children.items()):
+            params[key], state[key], shape = m.build(jax.random.fold_in(rng, i), shape)
+        return params, state, shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        st = state if isinstance(state, dict) else {}
+        for i, (key, m) in enumerate(self.children.items()):
+            x, _ = m.apply(params[key], st.get(key, {}), x, training=training,
+                           rng=child_rng(rng, i))
+        if self.dropout is not None:
+            x, _ = self.dropout.apply({}, {}, x, training=training, rng=rng)
+        return x, state

@@ -90,6 +90,13 @@ class BatchNormalization(Module):
         return input_shape
 
 
+class TemporalBatchNormalization(BatchNormalization):
+    """BN over (N, T) of (N, T, C) input — per-feature stats for sequence
+    activations (the Keras BatchNormalization semantics on 3-D input)."""
+
+    _reduce_axes = (0, 1)
+
+
 class SpatialBatchNormalization(BatchNormalization):
     """BN over (N, H, W) of NHWC input.
     reference: nn/SpatialBatchNormalization.scala."""

@@ -19,6 +19,8 @@ from bigdl_tpu.nn.module import Module
 
 
 def _pool_out(size: int, k: int, stride: int, pad: int, ceil_mode: bool) -> int:
+    if pad == -1:  # TF-style SAME: out = ceil(size / stride)
+        return -(-size // stride)
     if ceil_mode:
         out = -(-(size + 2 * pad - k) // stride) + 1
         # Torch/BigDL rule: the last window may not start entirely inside the
@@ -30,8 +32,11 @@ def _pool_out(size: int, k: int, stride: int, pad: int, ceil_mode: bool) -> int:
 
 
 def _window_pad(size, k, stride, pad, ceil_mode):
-    """Explicit (lo, hi) padding that realizes ceil/floor semantics."""
+    """Explicit (lo, hi) padding that realizes ceil/floor/SAME semantics."""
     out = _pool_out(size, k, stride, pad, ceil_mode)
+    if pad == -1:  # SAME: split the deficit, extra on the high side
+        needed = max(0, (out - 1) * stride + k - size)
+        return (needed // 2, needed - needed // 2)
     needed = max(0, (out - 1) * stride + k - size - pad)
     return (pad, needed)
 

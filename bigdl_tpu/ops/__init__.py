@@ -1,0 +1,10 @@
+"""Pure array-level ops: attention cores (dense / ring / Ulysses) and, later,
+pallas TPU kernels.  These are functions over jax arrays, independent of the
+Module system — the layer in `bigdl_tpu.nn.attention` wraps them.
+"""
+
+from bigdl_tpu.ops.attention import (
+    dense_attention,
+    ring_attention,
+    ulysses_attention,
+)

@@ -1,0 +1,135 @@
+"""Attention cores: dense, ring (sequence-parallel over ICI), Ulysses.
+
+The reference has NO attention at all (survey §5.7: "there is no transformer
+in this codebase"); its only sequence machinery is single-device recurrence
+(nn/Recurrent.scala:47,241).  Long-context support is therefore designed
+fresh, TPU-first:
+
+  * `dense_attention` — the plain softmax(QK^T)V core XLA fuses well for
+    moderate sequence lengths.
+  * `ring_attention` — blockwise attention with an online softmax whose K/V
+    blocks rotate around a mesh axis via `lax.ppermute` (one ICI hop per
+    step).  Memory per chip is O(S_local), enabling sequences that cannot fit
+    on one chip.  Must run inside `shard_map` with the sequence dimension
+    sharded over `axis_name`.
+  * `ulysses_attention` — all-to-all sequence parallelism: scatter heads /
+    gather sequence (`lax.all_to_all`), run full-sequence attention on a head
+    subset per chip, and transpose back.  Cheaper than ring when
+    n_heads >= axis_size and the full sequence fits per chip.
+
+All cores take (B, S, H, D)-shaped q/k/v ("BSHD") and return (B, S, H, D).
+Causal masking uses GLOBAL positions, so ring/ulysses produce bitwise the
+same math as dense attention over the gathered sequence.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _scale(q, sm_scale: Optional[float]):
+    return q * (sm_scale if sm_scale is not None else q.shape[-1] ** -0.5)
+
+
+def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = False, sm_scale: Optional[float] = None,
+                    mask: Optional[jax.Array] = None,
+                    q_offset: int | jax.Array = 0,
+                    k_offset: int | jax.Array = 0) -> jax.Array:
+    """softmax(q k^T) v over (B, S, H, D) inputs.
+
+    `q_offset`/`k_offset` are the global positions of q[0]/k[0] — used by the
+    sequence-parallel cores so causal masks line up across shards.
+    """
+    q = _scale(q, sm_scale)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        causal_mask = qpos[:, None] >= kpos[None, :]
+        logits = jnp.where(causal_mask[None, None], logits, NEG_INF)
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis_name: str, causal: bool = False,
+                   sm_scale: Optional[float] = None) -> jax.Array:
+    """Ring attention: must run inside shard_map, sequence sharded on
+    `axis_name`.  q/k/v are the LOCAL (B, S_local, H, D) shards.
+
+    Each of the `axis_size` steps attends local q against the K/V block that
+    originated on device (my_idx - step) mod axis_size, folded into a
+    numerically-stable online softmax (running max `m`, normalizer `l`,
+    accumulator `acc`), then rotates K/V one ICI hop forward.  This is the
+    blockwise-parallel formulation of Liu et al.'s Ring Attention expressed
+    with XLA collectives rather than NCCL send/recv.
+    """
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    qs = _scale(q, sm_scale)
+    qpos = my_idx * s + jnp.arange(s)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, step_idx):
+        acc, m, l, kb, vb = carry
+        src = (my_idx - step_idx) % axis_size
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qs, kb)
+        if causal:
+            kpos = src * s + jnp.arange(s)
+            cm = qpos[:, None] >= kpos[None, :]
+            logits = jnp.where(cm[None, None], logits, NEG_INF)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        # guard fully-masked rows (m_new == NEG_INF) against NaN from exp
+        m_safe = jnp.where(m_new <= NEG_INF, 0.0, m_new)
+        p = jnp.exp(logits - m_safe[..., None])
+        correction = jnp.exp(jnp.where(m <= NEG_INF, NEG_INF, m - m_safe))
+        l_new = l * correction + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, vb)
+        acc_new = acc * correction.transpose(0, 2, 1)[..., None] + pv
+        kb_next = lax.ppermute(kb, axis_name, perm)
+        vb_next = lax.ppermute(vb, axis_name, perm)
+        return (acc_new, m_new, l_new, kb_next, vb_next), None
+
+    # derive initial accumulators from qs so they carry the same
+    # varying-manual-axes type as the rotating K/V blocks (shard_map scan
+    # requires carry-in and carry-out types to match)
+    acc0 = jnp.zeros_like(qs)
+    m0 = jnp.zeros_like(qs[..., 0]).transpose(0, 2, 1) + NEG_INF
+    l0 = jnp.zeros_like(qs[..., 0]).transpose(0, 2, 1)
+    (acc, m, l, _, _), _ = lax.scan(step, (acc0, m0, l0, k, v),
+                                    jnp.arange(axis_size))
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros, not NaN
+    return acc / l.transpose(0, 2, 1)[..., None]
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      axis_name: str, causal: bool = False,
+                      sm_scale: Optional[float] = None) -> jax.Array:
+    """Ulysses (DeepSpeed-style) sequence parallelism: must run inside
+    shard_map with the sequence dim sharded on `axis_name`, and n_heads
+    divisible by the axis size.
+
+    all_to_all converts the (B, S/N, H, D) sequence shard into a
+    (B, S, H/N, D) head shard (gather sequence, scatter heads), full dense
+    attention runs locally on the head subset, and a second all_to_all
+    transposes back.  Two all-to-alls replace ring's N ppermute steps.
+    """
+    # (B, S/N, H, D) -> (B, S, H/N, D): split axis 2 (heads), concat axis 1.
+    qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    out = dense_attention(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    # back: split axis 1 (sequence), concat axis 2 (heads)
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)

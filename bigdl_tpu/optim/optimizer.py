@@ -84,9 +84,11 @@ class Optimizer:
         self.val_summary: Optional[ValidationSummary] = None
         # gradient processing
         self.processors: List[ParameterProcessor] = []
-        # state
-        self.params = None
-        self.model_state = None
+        # state — adopt weights already on the model so repeated fit()s
+        # continue training instead of silently re-initializing (Keras fit
+        # is incremental; reference fit reuses the trained module in place)
+        self.params = getattr(model, "params", None)
+        self.model_state = getattr(model, "state", None)
         self.opt_state = None
         self.metrics = Metrics()
         self._compiled = None
@@ -203,6 +205,7 @@ class Optimizer:
             shape = _shape_of_input(first_batch.get_input())
             self.params, self.model_state, _ = self.model.build(
                 RandomGenerator.next_key(), shape)
+        if self.opt_state is None:
             self.opt_state = self.optim_method.init(self.params)
         self.params = self._put_replicated(self.params)
         self.model_state = self._put_replicated(self.model_state)

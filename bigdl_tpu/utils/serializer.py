@@ -93,6 +93,14 @@ def _scan_registry() -> None:
         if isinstance(obj, type) and issubclass(obj, InitializationMethod):
             INIT_REGISTRY[obj.__name__] = obj
 
+    # Model zoo classes that are Modules in their own right (TransformerLM)
+    import bigdl_tpu.models as models_pkg
+
+    for name in dir(models_pkg):
+        obj = getattr(models_pkg, name)
+        if isinstance(obj, type) and issubclass(obj, Module):
+            MODULE_REGISTRY.setdefault(obj.__name__, obj)
+
     # Keras layer/topology zoo registers under "keras.<Name>" so e.g.
     # keras Sequential does not shadow nn.Sequential.
     import bigdl_tpu.keras as keras_pkg
@@ -208,11 +216,14 @@ def module_to_spec(m: Module) -> Dict[str, Any]:
             # Module varargs are covered by the children list below.
             spec["vararg"] = {"name": vname,
                              "values": [encode_value(x) for x in vals]}
-    if isinstance(m, Container):
+    if isinstance(m, Container) and not getattr(m, "_constructor_children", False):
         # Children whose Module object also appears in the captured config
         # (e.g. MapTable's / Bottle's inner module) are reconstructed by the
         # constructor itself — serializing them again would duplicate the
         # spec, so only post-`add()` children travel in the children list.
+        # Containers that build ALL children from constructor args set
+        # `_constructor_children = True` and skip the children list entirely
+        # (e.g. TransformerBlock).
         cfg_module_ids = set()
 
         def _collect(v):

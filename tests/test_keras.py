@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 
 import bigdl_tpu.keras as keras
 import bigdl_tpu.nn as nn
@@ -127,3 +128,42 @@ def test_keras_model_serializes(tmp_path):
     m2.build(jax.random.PRNGKey(1), (4, 8))
     y2, _ = m2.apply(p2, s2, x[:4], training=False)
     np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)
+
+
+def test_fit_is_incremental():
+    """A second fit() must continue from trained weights, not re-init
+    (Keras fit semantics)."""
+    x, y = make_blobs()
+    model = keras.Sequential(
+        keras.Dense(32, activation="relu", input_dim=8),
+        keras.Dense(4),
+    )
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    model.fit(x, y, batch_size=32, nb_epoch=5)
+    before = np.concatenate([np.ravel(l) for l in
+                             jax.tree_util.tree_leaves(model.params)])
+    loss_before = dict(model.evaluate(x, y))["Loss"]
+    model.fit(x, y, batch_size=32, nb_epoch=1)
+    after = np.concatenate([np.ravel(l) for l in
+                            jax.tree_util.tree_leaves(model.params)])
+    corr = np.corrcoef(before, after)[0, 1]
+    assert corr > 0.9, f"weights discarded between fits (corr={corr:.3f})"
+    loss_after = dict(model.evaluate(x, y))["Loss"]
+    assert loss_after < loss_before * 1.5  # continued, not restarted
+
+
+def test_categorical_crossentropy_soft_targets():
+    from bigdl_tpu.keras.objectives import CategoricalCrossEntropy
+
+    logits = jnp.asarray([[2.0, 1.0, 0.1], [0.3, 2.2, 0.5]])
+    soft = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1]])
+    got = float(CategoricalCrossEntropy().forward(logits, soft))
+    logp = np.asarray(jax.nn.log_softmax(logits, axis=-1))
+    want = float(-np.mean(np.sum(np.asarray(soft) * logp, axis=-1)))
+    assert abs(got - want) < 1e-6
+    # and one-hot targets still match sparse CE
+    onehot = jnp.asarray([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]])
+    got_oh = float(CategoricalCrossEntropy().forward(logits, onehot))
+    want_oh = float(nn.CrossEntropyCriterion().forward(
+        logits, jnp.asarray([0, 1])))
+    assert abs(got_oh - want_oh) < 1e-6

@@ -28,6 +28,12 @@ def table(*shapes):
     return Table(*[rand(*s) for s in shapes])
 
 
+def _transformer_lm():
+    from bigdl_tpu.models import TransformerLM
+
+    return TransformerLM(vocab_size=20, hidden_size=16, n_layer=2, n_head=2)
+
+
 # class name -> (factory, input builder or None for spec-only round-trip)
 EXEMPLARS = {
     "Abs": (lambda: nn.Abs(), lambda: rand(2, 3)),
@@ -113,6 +119,15 @@ EXEMPLARS = {
                               lambda: rand(2, 4, 4, 3)),
     "SpatialBatchNormalization": (lambda: nn.SpatialBatchNormalization(3),
                                   lambda: rand(2, 4, 4, 3)),
+    "TemporalBatchNormalization": (lambda: nn.TemporalBatchNormalization(3),
+                                   lambda: rand(2, 4, 3)),
+    "MultiHeadAttention": (lambda: nn.MultiHeadAttention(8, 2, causal=True),
+                           lambda: rand(2, 5, 8)),
+    "TransformerBlock": (lambda: nn.TransformerBlock(8, 2),
+                         lambda: rand(2, 5, 8)),
+    "TransformerLM": (lambda: _transformer_lm(),
+                      lambda: jnp.asarray(
+                          np.random.RandomState(3).randint(0, 20, (2, 6)))),
     "SpatialConvolution": (lambda: nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1),
                            lambda: rand(2, 5, 5, 3)),
     "SpatialCrossMapLRN": (lambda: nn.SpatialCrossMapLRN(5, 1.0, 0.75),
