@@ -2,6 +2,8 @@ from bigdl_tpu.dataset.sample import Sample
 from bigdl_tpu.dataset.minibatch import MiniBatch
 from bigdl_tpu.dataset.transformer import Transformer, SampleToMiniBatch
 from bigdl_tpu.dataset.dataset import DataSet, LocalDataSet, ArrayDataSet
+from bigdl_tpu.dataset import image
+from bigdl_tpu.dataset import text
 
 __all__ = ["Sample", "MiniBatch", "Transformer", "SampleToMiniBatch",
-           "DataSet", "LocalDataSet", "ArrayDataSet"]
+           "DataSet", "LocalDataSet", "ArrayDataSet", "image", "text"]

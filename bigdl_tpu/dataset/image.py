@@ -1,0 +1,273 @@
+"""Host-side image transformers (numpy, NHWC float32).
+
+Reference: dataset/image/ (24 files — BytesToBGRImg, BGRImgCropper,
+BGRImgNormalizer, ColorJitter, Lighting, HFlip, MTLabeledBGRImgToBatch).
+The reference decodes/augments on Spark executors with OpenCV + JVM
+threads; here augmentation is a host-side numpy pipeline feeding the TPU
+input queue (channel order is RGB/NHWC, not BGR/NCHW — a TPU-native
+layout decision, documented as a capability-parity delta).
+
+Each transformer is a `Transformer` (iterator combinator, chained with
+`>>`) over `LabeledImage` records.  Randomized transforms take a seed and
+own a private RandomState so the pipeline is reproducible (the analogue of
+the reference's per-executor RNG discipline).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+class LabeledImage:
+    """One image record: HWC float32 array + label.
+    reference: dataset/image/LabeledBGRImage.scala."""
+
+    __slots__ = ("image", "label")
+
+    def __init__(self, image: np.ndarray, label: Any = None):
+        self.image = image
+        self.label = label
+
+
+# ---------------------------------------------------------------------------
+# numpy kernels (shared with the vision ImageFrame pipeline)
+# ---------------------------------------------------------------------------
+
+
+def resize_bilinear(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """Pure-numpy bilinear resize, HWC (align_corners=False, half-pixel
+    centers — matches OpenCV INTER_LINEAR / tf.image semantics)."""
+    h, w = img.shape[:2]
+    if (h, w) == (out_h, out_w):
+        return img.astype(np.float32, copy=False)
+    ys = (np.arange(out_h) + 0.5) * h / out_h - 0.5
+    xs = (np.arange(out_w) + 0.5) * w / out_w - 0.5
+    y0 = np.clip(np.floor(ys).astype(np.int64), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(np.int64), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0).astype(np.float32)[:, None, None]
+    wx = np.clip(xs - x0, 0.0, 1.0).astype(np.float32)[None, :, None]
+    img = img.astype(np.float32, copy=False)
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+def crop(img: np.ndarray, y: int, x: int, ch: int, cw: int) -> np.ndarray:
+    return img[y:y + ch, x:x + cw]
+
+
+def hflip(img: np.ndarray) -> np.ndarray:
+    return img[:, ::-1]
+
+
+def adjust_brightness(img: np.ndarray, delta: float) -> np.ndarray:
+    return img + delta
+
+
+def adjust_contrast(img: np.ndarray, factor: float) -> np.ndarray:
+    mean = img.mean()
+    return (img - mean) * factor + mean
+
+
+def adjust_saturation(img: np.ndarray, factor: float) -> np.ndarray:
+    gray = img @ np.asarray([0.299, 0.587, 0.114], np.float32)
+    return (img - gray[..., None]) * factor + gray[..., None]
+
+
+def adjust_hue(img: np.ndarray, delta_deg: float) -> np.ndarray:
+    """Rotate hue by `delta_deg` degrees using the YIQ approximation
+    (linear, fast — the classic Paeth rotation used by tf.image)."""
+    rad = np.deg2rad(delta_deg)
+    cos, sin = np.cos(rad), np.sin(rad)
+    t_yiq = np.asarray([[0.299, 0.587, 0.114],
+                        [0.596, -0.274, -0.321],
+                        [0.211, -0.523, 0.311]], np.float32)
+    t_rgb = np.linalg.inv(t_yiq).astype(np.float32)
+    rot = np.asarray([[1, 0, 0], [0, cos, -sin], [0, sin, cos]], np.float32)
+    m = t_rgb @ rot @ t_yiq
+    return img @ m.T
+
+
+# ---------------------------------------------------------------------------
+# Transformers
+# ---------------------------------------------------------------------------
+
+
+class PixelBytesToImage(Transformer):
+    """Fixed-shape raw pixel byte records -> LabeledImage (the analogue of
+    BytesToBGRImg over SequenceFile records,
+    dataset/image/BytesToBGRImg.scala).  Input: (bytes, label) tuples."""
+
+    def __init__(self, height: int, width: int, channels: int = 3):
+        self.shape = (height, width, channels)
+
+    def __call__(self, it: Iterator[Tuple[bytes, Any]]) -> Iterator[LabeledImage]:
+        for raw, label in it:
+            arr = np.frombuffer(raw, np.uint8).reshape(self.shape)
+            yield LabeledImage(arr.astype(np.float32), label)
+
+
+class Resize(Transformer):
+    def __init__(self, height: int, width: int):
+        self.h, self.w = height, width
+
+    def __call__(self, it):
+        for r in it:
+            yield LabeledImage(resize_bilinear(r.image, self.h, self.w), r.label)
+
+
+class RandomCrop(Transformer):
+    """reference: dataset/image/BGRImgCropper.scala (CropRandom)."""
+
+    def __init__(self, height: int, width: int, seed: int = 0):
+        self.h, self.w = height, width
+        self.rs = np.random.RandomState(seed)
+
+    def __call__(self, it):
+        for r in it:
+            ih, iw = r.image.shape[:2]
+            y = self.rs.randint(0, ih - self.h + 1)
+            x = self.rs.randint(0, iw - self.w + 1)
+            yield LabeledImage(crop(r.image, y, x, self.h, self.w), r.label)
+
+
+class CenterCrop(Transformer):
+    def __init__(self, height: int, width: int):
+        self.h, self.w = height, width
+
+    def __call__(self, it):
+        for r in it:
+            ih, iw = r.image.shape[:2]
+            y, x = (ih - self.h) // 2, (iw - self.w) // 2
+            yield LabeledImage(crop(r.image, y, x, self.h, self.w), r.label)
+
+
+class RandomResizedCrop(Transformer):
+    """Inception-style area+aspect random crop then resize (the ImageNet
+    training crop; reference: transform/vision/image/augmentation/
+    RandomAspectScale + RandomCropper)."""
+
+    def __init__(self, height: int, width: int,
+                 area_range: Tuple[float, float] = (0.08, 1.0),
+                 aspect_range: Tuple[float, float] = (3 / 4, 4 / 3),
+                 seed: int = 0, max_tries: int = 10):
+        self.h, self.w = height, width
+        self.area_range = area_range
+        self.aspect_range = aspect_range
+        self.max_tries = max_tries
+        self.rs = np.random.RandomState(seed)
+
+    def __call__(self, it):
+        for r in it:
+            ih, iw = r.image.shape[:2]
+            area = ih * iw
+            out = None
+            for _ in range(self.max_tries):
+                target = area * self.rs.uniform(*self.area_range)
+                aspect = self.rs.uniform(*self.aspect_range)
+                cw = int(round(np.sqrt(target * aspect)))
+                ch = int(round(np.sqrt(target / aspect)))
+                if cw <= iw and ch <= ih:
+                    y = self.rs.randint(0, ih - ch + 1)
+                    x = self.rs.randint(0, iw - cw + 1)
+                    out = crop(r.image, y, x, ch, cw)
+                    break
+            if out is None:  # fallback: center crop of the short side
+                side = min(ih, iw)
+                y, x = (ih - side) // 2, (iw - side) // 2
+                out = crop(r.image, y, x, side, side)
+            yield LabeledImage(resize_bilinear(out, self.h, self.w), r.label)
+
+
+class HFlip(Transformer):
+    """reference: dataset/image/HFlip.scala."""
+
+    def __init__(self, p: float = 0.5, seed: int = 0):
+        self.p = p
+        self.rs = np.random.RandomState(seed)
+
+    def __call__(self, it):
+        for r in it:
+            img = hflip(r.image) if self.rs.rand() < self.p else r.image
+            yield LabeledImage(img, r.label)
+
+
+class Normalizer(Transformer):
+    """Per-channel (x - mean) / std.
+    reference: dataset/image/BGRImgNormalizer.scala."""
+
+    def __init__(self, mean: Sequence[float], std: Sequence[float]):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def __call__(self, it):
+        for r in it:
+            yield LabeledImage((r.image - self.mean) / self.std, r.label)
+
+
+class ColorJitter(Transformer):
+    """Random brightness/contrast/saturation in random order.
+    reference: dataset/image/ColorJitter.scala (torch ColorJitter port)."""
+
+    def __init__(self, brightness: float = 0.4, contrast: float = 0.4,
+                 saturation: float = 0.4, seed: int = 0):
+        self.strengths = (brightness, contrast, saturation)
+        self.rs = np.random.RandomState(seed)
+
+    def __call__(self, it):
+        fns = (adjust_brightness, adjust_contrast, adjust_saturation)
+        for r in it:
+            img = r.image
+            order = self.rs.permutation(3)
+            for i in order:
+                strength = self.strengths[i]
+                if strength <= 0:
+                    continue
+                if fns[i] is adjust_brightness:
+                    # reference jitters in 0..255 pixel space multiplicatively
+                    img = img * self.rs.uniform(1 - strength, 1 + strength)
+                else:
+                    img = fns[i](img, self.rs.uniform(1 - strength, 1 + strength))
+            yield LabeledImage(img, r.label)
+
+
+class Lighting(Transformer):
+    """AlexNet-style PCA lighting noise with the ImageNet eigen-decomposition
+    constants. reference: dataset/image/Lighting.scala."""
+
+    EIG_VAL = np.asarray([0.2175, 0.0188, 0.0045], np.float32)
+    EIG_VEC = np.asarray([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.8140],
+                          [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alpha_std: float = 0.1, seed: int = 0):
+        self.alpha_std = alpha_std
+        self.rs = np.random.RandomState(seed)
+
+    def __call__(self, it):
+        for r in it:
+            alpha = self.rs.normal(0, self.alpha_std, 3).astype(np.float32)
+            noise = (self.EIG_VEC * alpha * self.EIG_VAL).sum(axis=1)
+            yield LabeledImage(r.image + noise, r.label)
+
+
+class ImageToSample(Transformer):
+    """LabeledImage -> Sample (feature HWC float32, scalar label)."""
+
+    def __call__(self, it):
+        for r in it:
+            label = None if r.label is None else np.asarray(r.label)
+            yield Sample(np.ascontiguousarray(r.image, np.float32), label)
+
+
+IMAGENET_MEAN = (0.485 * 255, 0.456 * 255, 0.406 * 255)
+IMAGENET_STD = (0.229 * 255, 0.224 * 255, 0.225 * 255)
+CIFAR_MEAN = (125.3, 123.0, 113.9)
+CIFAR_STD = (63.0, 62.1, 66.7)

@@ -1,0 +1,180 @@
+"""Text pipeline: tokenization, vocabulary, LM sample construction.
+
+Reference: dataset/text/ — SentenceSplitter/SentenceTokenizer (OpenNLP),
+Dictionary (dataset/text/Dictionary.scala), TextToLabeledSentence,
+LabeledSentenceToSample; feeds the PTB LSTM LM
+(models/rnn/Train.scala:48-59).  The OpenNLP dependency is replaced with
+regex tokenization (no JVM on the TPU host path); everything else is
+capability-parity.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+_SENT_RE = re.compile(r"(?<=[.!?])\s+")
+_WORD_RE = re.compile(r"[A-Za-z0-9']+|[^\sA-Za-z0-9]")
+
+
+class SentenceSplitter(Transformer):
+    """Text blobs -> sentences. reference: dataset/text/SentenceSplitter.scala
+    (OpenNLP SentenceDetector -> regex on terminal punctuation)."""
+
+    def __call__(self, it: Iterator[str]) -> Iterator[str]:
+        for blob in it:
+            for sent in _SENT_RE.split(blob.strip()):
+                if sent:
+                    yield sent
+
+
+class SentenceTokenizer(Transformer):
+    """Sentence -> token list. reference: dataset/text/SentenceTokenizer.scala."""
+
+    def __init__(self, lower: bool = True):
+        self.lower = lower
+
+    def __call__(self, it: Iterator[str]) -> Iterator[List[str]]:
+        for sent in it:
+            if self.lower:
+                sent = sent.lower()
+            yield _WORD_RE.findall(sent)
+
+
+class SentenceBiPadding(Transformer):
+    """Wrap each token list with sentence-start/end markers.
+    reference: dataset/text/SentenceBiPadding.scala."""
+
+    START = "SENTENCESTART"
+    END = "SENTENCEEND"
+
+    def __call__(self, it: Iterator[List[str]]) -> Iterator[List[str]]:
+        for toks in it:
+            yield [self.START] + toks + [self.END]
+
+
+class Dictionary:
+    """Token <-> index vocabulary with capped size + UNK.
+    reference: dataset/text/Dictionary.scala."""
+
+    UNK = "<unk>"
+
+    def __init__(self, sentences: Optional[Iterable[Sequence[str]]] = None,
+                 vocab_size: Optional[int] = None):
+        self.word2index: Dict[str, int] = {}
+        self.index2word: List[str] = []
+        if sentences is not None:
+            counts = Counter(tok for s in sentences for tok in s)
+            keep = [w for w, _ in counts.most_common(vocab_size)]
+            for w in keep:
+                self.add_word(w)
+        self.add_word(self.UNK)
+
+    def add_word(self, word: str) -> int:
+        if word not in self.word2index:
+            self.word2index[word] = len(self.index2word)
+            self.index2word.append(word)
+        return self.word2index[word]
+
+    def vocab_size(self) -> int:
+        return len(self.index2word)
+
+    def get_index(self, word: str) -> int:
+        return self.word2index.get(word, self.word2index[self.UNK])
+
+    def get_word(self, index: int) -> str:
+        return self.index2word[index]
+
+    def encode(self, tokens: Sequence[str]) -> np.ndarray:
+        return np.asarray([self.get_index(t) for t in tokens], np.int32)
+
+    def decode(self, ids: Sequence[int]) -> List[str]:
+        return [self.get_word(int(i)) for i in ids]
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as fh:
+            for w in self.index2word:
+                fh.write(w + "\n")
+
+    @classmethod
+    def load(cls, path: str) -> "Dictionary":
+        d = cls()
+        d.word2index.clear()
+        d.index2word.clear()
+        with open(path) as fh:
+            for line in fh:
+                d.add_word(line.rstrip("\n"))
+        if cls.UNK not in d.word2index:
+            d.add_word(cls.UNK)
+        return d
+
+
+class LabeledSentence:
+    """(input ids, target ids) pair. reference: dataset/text/LabeledSentence.scala."""
+
+    __slots__ = ("data", "label")
+
+    def __init__(self, data: np.ndarray, label: np.ndarray):
+        self.data = data
+        self.label = label
+
+
+class TextToLabeledSentence(Transformer):
+    """Token ids -> next-token-prediction pair (x = ids[:-1], y = ids[1:]).
+    reference: dataset/text/TextToLabeledSentence.scala."""
+
+    def __init__(self, dictionary: Dictionary):
+        self.dictionary = dictionary
+
+    def __call__(self, it: Iterator[Sequence[str]]) -> Iterator[LabeledSentence]:
+        for toks in it:
+            ids = self.dictionary.encode(toks)
+            if len(ids) < 2:
+                continue
+            yield LabeledSentence(ids[:-1], ids[1:])
+
+
+class LabeledSentenceToSample(Transformer):
+    """LabeledSentence -> fixed-length Sample (pad/truncate so every batch
+    is one static XLA shape). reference: dataset/text/LabeledSentenceToSample.scala."""
+
+    def __init__(self, seq_len: Optional[int] = None, pad_id: int = 0,
+                 pad_label: int = 0):
+        self.seq_len = seq_len
+        self.pad_id = pad_id
+        self.pad_label = pad_label
+
+    def _fix(self, ids: np.ndarray, pad: int) -> np.ndarray:
+        if self.seq_len is None:
+            return ids
+        if len(ids) >= self.seq_len:
+            return ids[:self.seq_len]
+        out = np.full(self.seq_len, pad, ids.dtype)
+        out[:len(ids)] = ids
+        return out
+
+    def __call__(self, it: Iterator[LabeledSentence]) -> Iterator[Sample]:
+        for ls in it:
+            yield Sample(self._fix(ls.data, self.pad_id),
+                         self._fix(ls.label, self.pad_label))
+
+
+def ptb_stream_batches(ids: np.ndarray, batch_size: int, num_steps: int
+                       ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """The PTB continuous-stream batcher: reshape the full token stream into
+    `batch_size` parallel lanes, slide a `num_steps` window.
+    reference: models/rnn/Train.scala + SequencePreprocess (PTB path)."""
+    n = (len(ids) - 1) // (batch_size * num_steps) * batch_size * num_steps
+    if n <= 0:
+        return
+    x = ids[:n].reshape(batch_size, -1)
+    y = ids[1:n + 1].reshape(batch_size, -1)
+    for off in range(0, x.shape[1], num_steps):
+        if off + num_steps <= x.shape[1]:
+            yield x[:, off:off + num_steps], y[:, off:off + num_steps]

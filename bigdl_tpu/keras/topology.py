@@ -141,10 +141,13 @@ class KerasTopology:
         self._require_compiled()
         if self.params is None:
             raise RuntimeError("model has no parameters; fit() or init() first")
-        methods = [Loss(self.criterion)] + list(self.metrics)
-        # cache the Evaluator so its jitted eval step survives across calls
+        # cache the Evaluator AND the methods list (the Evaluator's jitted
+        # step is keyed on the method objects) so repeated evaluate() calls
+        # reuse one compiled program
         if getattr(self, "_evaluator", None) is None:
             self._evaluator = Evaluator(self)
+            self._eval_methods = [Loss(self.criterion)] + list(self.metrics)
+        methods = self._eval_methods
         results = self._evaluator.test(self.params, self.state,
                                        _ListDataSet(_to_minibatches(x, y, batch_size)),
                                        methods, batch_size=batch_size)
@@ -153,13 +156,15 @@ class KerasTopology:
     def predict(self, x: np.ndarray, batch_size: int = 32) -> np.ndarray:
         if self.params is None:
             raise RuntimeError("model has no parameters; fit() or init() first")
-        # cache the Predictor (and so its jitted forward) per params/batch_size
+        # cache the Predictor (and so its jitted forward), invalidated when
+        # params OR state change identity (stale BN running stats otherwise)
         cached = getattr(self, "_predictor", None)
-        if cached is None or cached[0] is not self.params or cached[1] != batch_size:
-            self._predictor = (self.params, batch_size,
+        if (cached is None or cached[0] is not self.params
+                or cached[1] is not self.state or cached[2] != batch_size):
+            self._predictor = (self.params, self.state, batch_size,
                                Predictor(self, self.params, self.state,
                                          batch_size=batch_size))
-        return self._predictor[2].predict(x)
+        return self._predictor[3].predict(x)
 
     def predict_classes(self, x: np.ndarray, batch_size: int = 32) -> np.ndarray:
         return np.argmax(self.predict(x, batch_size), axis=-1)

@@ -1,0 +1,152 @@
+// Multi-threaded prefetching record loader.
+//
+// The reference overlaps input decode with compute via JVM thread pools
+// (dataset/image/MTLabeledBGRImgToBatch.scala, utils/ThreadPool.scala); on
+// TPU the same overlap must happen on the host so the infeed queue never
+// starves the chip.  This loader owns N reader threads, each draining a
+// shard-partitioned list of TFRecord files into one bounded ring buffer;
+// the Python side pops records (GIL released while blocked).
+//
+// Concurrency: one mutex + two condvars (not_empty / not_full) around a
+// fixed-capacity ring of heap-owned records.  Shutdown is cooperative via
+// `stop` + broadcast.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* bigdl_tfrecord_reader_open(const char* path);
+long long bigdl_tfrecord_reader_next(void* handle, const uint8_t** out);
+void bigdl_tfrecord_reader_close(void* handle);
+}
+
+namespace {
+
+struct Record {
+  uint8_t* data;
+  size_t len;
+};
+
+struct Loader {
+  std::vector<std::string> files;
+  size_t capacity;
+  std::vector<std::thread> threads;
+
+  std::mutex mu;
+  std::condition_variable not_empty, not_full;
+  std::vector<Record> ring;
+  size_t head = 0, tail = 0, count = 0;
+  std::atomic<bool> stop{false};
+  std::atomic<int> active_readers{0};
+  std::atomic<long long> errors{0};
+
+  bool push(Record rec) {
+    std::unique_lock<std::mutex> lk(mu);
+    not_full.wait(lk, [&] { return count < capacity || stop.load(); });
+    if (stop.load()) {
+      free(rec.data);
+      return false;
+    }
+    ring[tail] = rec;
+    tail = (tail + 1) % capacity;
+    ++count;
+    not_empty.notify_one();
+    return true;
+  }
+
+  void reader_main(size_t start_idx, size_t stride) {
+    for (size_t i = start_idx; i < files.size() && !stop.load(); i += stride) {
+      void* rd = bigdl_tfrecord_reader_open(files[i].c_str());
+      if (!rd) {
+        ++errors;
+        continue;
+      }
+      const uint8_t* ptr = nullptr;
+      long long len;
+      while (!stop.load() && (len = bigdl_tfrecord_reader_next(rd, &ptr)) >= 0) {
+        Record rec{static_cast<uint8_t*>(malloc(len ? len : 1)),
+                   static_cast<size_t>(len)};
+        if (len) memcpy(rec.data, ptr, len);
+        if (!push(rec)) break;
+      }
+      if (len == -1) ++errors;
+      bigdl_tfrecord_reader_close(rd);
+    }
+    if (--active_readers == 0) {
+      std::lock_guard<std::mutex> lk(mu);
+      not_empty.notify_all();  // wake consumers: stream is done
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* bigdl_prefetch_open(const char** paths, int n_paths, int n_threads,
+                          int capacity) {
+  Loader* L = new Loader;
+  for (int i = 0; i < n_paths; ++i) L->files.emplace_back(paths[i]);
+  L->capacity = capacity > 0 ? capacity : 64;
+  L->ring.resize(L->capacity);
+  if (n_threads <= 0) n_threads = 2;
+  if (n_threads > n_paths && n_paths > 0) n_threads = n_paths;
+  L->active_readers = n_threads;
+  for (int t = 0; t < n_threads; ++t)
+    L->threads.emplace_back(&Loader::reader_main, L, t, n_threads);
+  return L;
+}
+
+// Pops one record. Returns length (>= 0; empty records are valid), -2 when
+// the stream is exhausted, -1 if `buf_cap` is too small (record stays
+// queued; call again bigger — required size is written to *needed).
+long long bigdl_prefetch_next(void* handle, uint8_t* buf, size_t buf_cap,
+                              size_t* needed) {
+  Loader* L = static_cast<Loader*>(handle);
+  std::unique_lock<std::mutex> lk(L->mu);
+  L->not_empty.wait(lk, [&] {
+    return L->count > 0 || L->active_readers.load() == 0 || L->stop.load();
+  });
+  if (L->count == 0) return -2;  // drained and all readers done
+  Record& rec = L->ring[L->head];
+  if (rec.len > buf_cap) {
+    if (needed) *needed = rec.len;
+    return -1;
+  }
+  if (rec.len) memcpy(buf, rec.data, rec.len);
+  free(rec.data);
+  long long len = static_cast<long long>(rec.len);
+  L->head = (L->head + 1) % L->capacity;
+  --L->count;
+  L->not_full.notify_one();
+  return len;
+}
+
+long long bigdl_prefetch_errors(void* handle) {
+  return static_cast<Loader*>(handle)->errors.load();
+}
+
+void bigdl_prefetch_close(void* handle) {
+  Loader* L = static_cast<Loader*>(handle);
+  L->stop.store(true);
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->not_full.notify_all();
+    L->not_empty.notify_all();
+  }
+  for (auto& t : L->threads) t.join();
+  while (L->count > 0) {
+    free(L->ring[L->head].data);
+    L->head = (L->head + 1) % L->capacity;
+    --L->count;
+  }
+  delete L;
+}
+
+}  // extern "C"

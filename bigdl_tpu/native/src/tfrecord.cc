@@ -1,0 +1,117 @@
+// TFRecord framing: read/write records with masked-crc32c integrity.
+//
+// Frame layout (TFRecordWriter wire format):
+//   uint64 length | uint32 masked_crc32(length) | bytes data |
+//   uint32 masked_crc32(data)
+//
+// Reference counterpart: utils/tf/TFRecordIterator + TFRecordInputFormat
+// (JVM) over netty/Crc32c.java.  Here the reader/writer are native so the
+// host input pipeline never pays Python byte-twiddling costs.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+uint32_t bigdl_crc32c_masked(const uint8_t* data, size_t n);
+}
+
+namespace {
+
+struct Reader {
+  FILE* f;
+  uint8_t* buf;
+  size_t cap;
+};
+
+struct Writer {
+  FILE* f;
+};
+
+bool read_exact(FILE* f, void* dst, size_t n) {
+  return fread(dst, 1, n, f) == n;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------- reader ----------------
+
+void* bigdl_tfrecord_reader_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  Reader* r = new Reader{f, static_cast<uint8_t*>(malloc(1 << 16)), 1 << 16};
+  return r;
+}
+
+// Returns record length (>= 0; empty records are valid), -2 on clean EOF,
+// -1 on corruption/short read.  Data pointer (valid until next call) is
+// written to *out.
+long long bigdl_tfrecord_reader_next(void* handle, const uint8_t** out) {
+  Reader* r = static_cast<Reader*>(handle);
+  uint8_t header[12];
+  size_t got = fread(header, 1, 12, r->f);
+  if (got == 0) return -2;  // EOF
+  if (got != 12) return -1;
+  uint64_t len;
+  memcpy(&len, header, 8);
+  uint32_t len_crc;
+  memcpy(&len_crc, header + 8, 4);
+  if (bigdl_crc32c_masked(header, 8) != len_crc) return -1;
+  if (len + 4 > r->cap) {
+    while (r->cap < len + 4) r->cap <<= 1;
+    r->buf = static_cast<uint8_t*>(realloc(r->buf, r->cap));
+  }
+  if (!read_exact(r->f, r->buf, len + 4)) return -1;
+  uint32_t data_crc;
+  memcpy(&data_crc, r->buf + len, 4);
+  if (bigdl_crc32c_masked(r->buf, len) != data_crc) return -1;
+  *out = r->buf;
+  return static_cast<long long>(len);
+}
+
+void bigdl_tfrecord_reader_close(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (r) {
+    fclose(r->f);
+    free(r->buf);
+    delete r;
+  }
+}
+
+// ---------------- writer ----------------
+
+void* bigdl_tfrecord_writer_open(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  return new Writer{f};
+}
+
+int bigdl_tfrecord_writer_write(void* handle, const uint8_t* data, uint64_t len) {
+  Writer* w = static_cast<Writer*>(handle);
+  uint8_t header[12];
+  memcpy(header, &len, 8);
+  uint32_t len_crc = bigdl_crc32c_masked(header, 8);
+  memcpy(header + 8, &len_crc, 4);
+  uint32_t data_crc = bigdl_crc32c_masked(data, len);
+  if (fwrite(header, 1, 12, w->f) != 12) return -1;
+  if (fwrite(data, 1, len, w->f) != len) return -1;
+  if (fwrite(&data_crc, 1, 4, w->f) != 4) return -1;
+  return 0;
+}
+
+int bigdl_tfrecord_writer_flush(void* handle) {
+  return fflush(static_cast<Writer*>(handle)->f);
+}
+
+void bigdl_tfrecord_writer_close(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  if (w) {
+    fclose(w->f);
+    delete w;
+  }
+}
+
+}  // extern "C"

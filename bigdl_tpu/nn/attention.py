@@ -102,6 +102,11 @@ class MultiHeadAttention(Module):
         sp = self.seq_parallel
         if sp is not None and mesh is not None and \
                 mesh.shape.get(self.seq_axis, 1) > 1:
+            axis_size = mesh.shape[self.seq_axis]
+            if sp == "ulysses" and self.n_head % axis_size != 0:
+                raise ValueError(
+                    f"ulysses sequence parallelism needs n_head ({self.n_head}) "
+                    f"divisible by the '{self.seq_axis}' mesh axis ({axis_size})")
             core = ring_attention if sp == "ring" else ulysses_attention
             fn = partial(core, axis_name=self.seq_axis, causal=self.causal)
             data = self.data_axis if self.data_axis in mesh.axis_names else None

@@ -89,6 +89,7 @@ class Optimizer:
         # is incremental; reference fit reuses the trained module in place)
         self.params = getattr(model, "params", None)
         self.model_state = getattr(model, "state", None)
+        self._adopted_params = self.params is not None
         self.opt_state = None
         self.metrics = Metrics()
         self._compiled = None
@@ -205,6 +206,13 @@ class Optimizer:
             shape = _shape_of_input(first_batch.get_input())
             self.params, self.model_state, _ = self.model.build(
                 RandomGenerator.next_key(), shape)
+        elif self._adopted_params:
+            # weights adopted from the model: the jitted step DONATES its
+            # buffers, so train on copies — an interrupt mid-optimize must
+            # not leave model.params pointing at deleted arrays
+            self.params = jax.tree_util.tree_map(jnp.copy, self.params)
+            self.model_state = jax.tree_util.tree_map(jnp.copy, self.model_state)
+            self._adopted_params = False
         if self.opt_state is None:
             self.opt_state = self.optim_method.init(self.params)
         self.params = self._put_replicated(self.params)

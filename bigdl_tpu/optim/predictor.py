@@ -162,15 +162,24 @@ class Evaluator:
         self.model = model
         self.mesh = mesh
         self._step = None
+        self._step_key = None
 
     def _build(self, methods: Sequence[ValidationMethod]):
+        # cache the jitted step across test() calls (keyed on the method
+        # objects): re-tracing per evaluate() would pay a full XLA
+        # recompile in monitoring loops
+        key = tuple(id(m) for m in methods)
+        if self._step is not None and self._step_key == key:
+            return self._step
         model = self.model
 
         def step(params, state, x, y):
             out, _ = model.apply(params, state, x, training=False)
             return [m.batch(out, y) for m in methods]
 
-        return jax.jit(step)
+        self._step = jax.jit(step)
+        self._step_key = key
+        return self._step
 
     def test(self, params: Any, state: Any, data: Any,
              methods: Sequence[ValidationMethod],

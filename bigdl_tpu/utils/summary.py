@@ -5,10 +5,12 @@ a from-scratch TensorBoard event-file writer (FileWriter/EventWriter/
 RecordWriter + Crc32c) logging Loss/LR/Throughput scalars and parameter
 histograms, with per-tag triggers and a `read_scalar` read-back API.
 
-Here summaries are JSONL (one {"tag", "step", "value", "wall_time"} per
-line) — trivially consumable by pandas/TensorBoard-via-converter, durable,
-and append-only.  A TF-event-file emitter can be layered on the same
-Summary interface later without touching trainer code.
+Summaries write BOTH a real TensorBoard event file (via
+bigdl_tpu.visualization.FileWriter — Event protobuf + crc32c framing,
+loadable by TensorBoard directly, matching the reference's event-writer
+stack) and an append-only JSONL mirror (one {"tag", "step", "value",
+"wall_time"} per line) for pandas-grade read-back without a TensorBoard
+dependency.
 """
 
 from __future__ import annotations
@@ -18,20 +20,32 @@ import os
 import time
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 
 class Summary:
     def __init__(self, log_dir: str, app_name: str, kind: str):
-        self.dir = os.path.join(log_dir, app_name)
+        from bigdl_tpu.visualization import FileWriter
+
+        self.dir = os.path.join(log_dir, app_name, kind)
         os.makedirs(self.dir, exist_ok=True)
-        self.path = os.path.join(self.dir, f"{kind}.jsonl")
+        self.path = os.path.join(self.dir, "scalars.jsonl")
         self._fh = open(self.path, "a")
+        self._writer = FileWriter(self.dir)
         self._triggers: Dict[str, int] = {}
 
     def add_scalar(self, tag: str, value: float, step: int) -> None:
+        now = time.time()
         rec = {"tag": tag, "step": int(step), "value": float(value),
-               "wall_time": time.time()}
+               "wall_time": now}
         self._fh.write(json.dumps(rec) + "\n")
         self._fh.flush()
+        self._writer.add_scalar(tag, float(value), int(step), wall_time=now)
+
+    def add_histogram(self, tag: str, values: "np.ndarray", step: int) -> None:
+        """Parameter/gradient histograms (reference:
+        AbstractOptimizer.saveSummary, optim/AbstractOptimizer.scala:47)."""
+        self._writer.add_histogram(tag, np.asarray(values), int(step))
 
     def set_summary_trigger(self, tag: str, every_n_iterations: int) -> None:
         """reference: TrainSummary.setSummaryTrigger."""
@@ -53,6 +67,7 @@ class Summary:
 
     def close(self) -> None:
         self._fh.close()
+        self._writer.close()
 
 
 class TrainSummary(Summary):

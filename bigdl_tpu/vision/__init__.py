@@ -1,0 +1,25 @@
+"""Vision pipeline: ImageFeature records + composable FeatureTransformers.
+
+Reference: transform/vision/image/ (ImageFrame, ImageFeature,
+FeatureTransformer, augmentation/*).
+"""
+
+from bigdl_tpu.vision.image import (
+    ImageFeature,
+    ImageFrame,
+    LocalImageFrame,
+    FeatureTransformer,
+    PixelsToFeature,
+    Brightness,
+    Contrast,
+    Saturation,
+    Hue,
+    ChannelNormalize,
+    RandomCropper,
+    CenterCropper,
+    FixedCrop,
+    Expand,
+    Flip,
+    ResizeTo,
+    ImageFrameToSample,
+)

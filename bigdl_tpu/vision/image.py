@@ -1,0 +1,285 @@
+"""ImageFeature / ImageFrame / FeatureTransformer.
+
+Reference: transform/vision/image/ — `ImageFeature` is a dict-like record
+(bytes/mat/label/originalSize...), `ImageFrame` wraps a collection
+(Local/Distributed), `FeatureTransformer` is a composable augmentation
+applied feature-by-feature (FeatureTransformer.scala), with the
+augmentation zoo under transform/vision/image/augmentation/.
+
+TPU-native redesign: the OpenCV Mat becomes a numpy HWC float32 array; the
+distributed ImageFrame (Spark RDD) becomes a sharded host pipeline — each
+JAX process transforms only its shard, so `LocalImageFrame` is the one
+engine.  Augmentation kernels are shared with bigdl_tpu.dataset.image.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.image import (
+    adjust_brightness,
+    adjust_contrast,
+    adjust_hue,
+    adjust_saturation,
+    crop as _crop,
+    hflip,
+    resize_bilinear,
+)
+from bigdl_tpu.dataset.sample import Sample
+
+
+class ImageFeature(dict):
+    """Dict-like record. Well-known keys mirror the reference's constants
+    (transform/vision/image/ImageFeature.scala)."""
+
+    IMAGE = "image"          # numpy HWC float32
+    LABEL = "label"
+    ORIGINAL_SIZE = "originalSize"
+    URI = "uri"
+
+    def __init__(self, image: Optional[np.ndarray] = None, label: Any = None,
+                 uri: Optional[str] = None, **kw):
+        super().__init__(**kw)
+        if image is not None:
+            self[self.IMAGE] = np.asarray(image, np.float32)
+            self[self.ORIGINAL_SIZE] = tuple(self[self.IMAGE].shape)
+        if label is not None:
+            self[self.LABEL] = label
+        if uri is not None:
+            self[self.URI] = uri
+
+    @property
+    def image(self) -> np.ndarray:
+        return self[self.IMAGE]
+
+    @image.setter
+    def image(self, v: np.ndarray) -> None:
+        self[self.IMAGE] = v
+
+    @property
+    def label(self):
+        return self.get(self.LABEL)
+
+
+class FeatureTransformer:
+    """Composable per-feature augmentation
+    (reference: transform/vision/image/FeatureTransformer.scala — chains
+    with `->`; here with `>>`)."""
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        feature.image = self.transform_image(feature.image)
+        return feature
+
+    def transform_image(self, img: np.ndarray) -> np.ndarray:
+        raise NotImplementedError(type(self).__name__)
+
+    def __call__(self, feature: ImageFeature) -> ImageFeature:
+        return self.transform(feature)
+
+    def __rshift__(self, other: "FeatureTransformer") -> "ChainedFeatureTransformer":
+        return ChainedFeatureTransformer([self, other])
+
+    def apply_frame(self, frame: "ImageFrame") -> "ImageFrame":
+        return frame.transform(self)
+
+
+class ChainedFeatureTransformer(FeatureTransformer):
+    def __init__(self, stages: List[FeatureTransformer]):
+        self.stages = list(stages)
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        for s in self.stages:
+            feature = s.transform(feature)
+        return feature
+
+    def __rshift__(self, other: FeatureTransformer) -> "ChainedFeatureTransformer":
+        return ChainedFeatureTransformer(self.stages + [other])
+
+
+class ImageFrame:
+    """Collection of ImageFeatures (reference:
+    transform/vision/image/ImageFrame.scala).  `read` builds from arrays;
+    the distributed variant is deliberately absent — each host process
+    pipelines its own shard (survey §5.8 TPU mapping)."""
+
+    @staticmethod
+    def read(images: Iterable[np.ndarray], labels: Optional[Iterable[Any]] = None
+             ) -> "LocalImageFrame":
+        labels = list(labels) if labels is not None else None
+        feats = []
+        for i, img in enumerate(images):
+            feats.append(ImageFeature(img, None if labels is None else labels[i]))
+        return LocalImageFrame(feats)
+
+    def transform(self, t: FeatureTransformer) -> "ImageFrame":
+        raise NotImplementedError
+
+
+class LocalImageFrame(ImageFrame):
+    def __init__(self, features: List[ImageFeature]):
+        self.features = list(features)
+
+    def transform(self, t: FeatureTransformer) -> "LocalImageFrame":
+        return LocalImageFrame([t(f) for f in self.features])
+
+    def __len__(self) -> int:
+        return len(self.features)
+
+    def __iter__(self) -> Iterator[ImageFeature]:
+        return iter(self.features)
+
+
+# ---------------------------------------------------------------------------
+# Augmentations (reference: transform/vision/image/augmentation/*)
+# ---------------------------------------------------------------------------
+
+
+class PixelsToFeature(FeatureTransformer):
+    """Identity marker for pipelines starting from raw arrays."""
+
+    def transform_image(self, img):
+        return np.asarray(img, np.float32)
+
+
+class Brightness(FeatureTransformer):
+    """Add a uniform delta (reference: augmentation/Brightness.scala)."""
+
+    def __init__(self, delta_low: float, delta_high: float, seed: int = 0):
+        self.low, self.high = delta_low, delta_high
+        self.rs = np.random.RandomState(seed)
+
+    def transform_image(self, img):
+        return adjust_brightness(img, self.rs.uniform(self.low, self.high))
+
+
+class Contrast(FeatureTransformer):
+    def __init__(self, factor_low: float, factor_high: float, seed: int = 0):
+        self.low, self.high = factor_low, factor_high
+        self.rs = np.random.RandomState(seed)
+
+    def transform_image(self, img):
+        return adjust_contrast(img, self.rs.uniform(self.low, self.high))
+
+
+class Saturation(FeatureTransformer):
+    def __init__(self, factor_low: float, factor_high: float, seed: int = 0):
+        self.low, self.high = factor_low, factor_high
+        self.rs = np.random.RandomState(seed)
+
+    def transform_image(self, img):
+        return adjust_saturation(img, self.rs.uniform(self.low, self.high))
+
+
+class Hue(FeatureTransformer):
+    def __init__(self, delta_low: float = -18.0, delta_high: float = 18.0,
+                 seed: int = 0):
+        self.low, self.high = delta_low, delta_high
+        self.rs = np.random.RandomState(seed)
+
+    def transform_image(self, img):
+        return adjust_hue(img, self.rs.uniform(self.low, self.high))
+
+
+class ChannelNormalize(FeatureTransformer):
+    """(x - mean) / std per channel (reference: augmentation/ChannelNormalize.scala)."""
+
+    def __init__(self, mean: Sequence[float], std: Sequence[float]):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def transform_image(self, img):
+        return (img - self.mean) / self.std
+
+
+class ResizeTo(FeatureTransformer):
+    def __init__(self, height: int, width: int):
+        self.h, self.w = height, width
+
+    def transform_image(self, img):
+        return resize_bilinear(img, self.h, self.w)
+
+
+class RandomCropper(FeatureTransformer):
+    def __init__(self, height: int, width: int, seed: int = 0):
+        self.h, self.w = height, width
+        self.rs = np.random.RandomState(seed)
+
+    def transform_image(self, img):
+        ih, iw = img.shape[:2]
+        y = self.rs.randint(0, ih - self.h + 1)
+        x = self.rs.randint(0, iw - self.w + 1)
+        return _crop(img, y, x, self.h, self.w)
+
+
+class CenterCropper(FeatureTransformer):
+    def __init__(self, height: int, width: int):
+        self.h, self.w = height, width
+
+    def transform_image(self, img):
+        ih, iw = img.shape[:2]
+        return _crop(img, (ih - self.h) // 2, (iw - self.w) // 2, self.h, self.w)
+
+
+class FixedCrop(FeatureTransformer):
+    """Crop at explicit (x1, y1, x2, y2), normalized or absolute
+    (reference: augmentation/FixedCrop.scala)."""
+
+    def __init__(self, x1: float, y1: float, x2: float, y2: float,
+                 normalized: bool = False):
+        self.box = (x1, y1, x2, y2)
+        self.normalized = normalized
+
+    def transform_image(self, img):
+        ih, iw = img.shape[:2]
+        x1, y1, x2, y2 = self.box
+        if self.normalized:
+            x1, x2 = x1 * iw, x2 * iw
+            y1, y2 = y1 * ih, y2 * ih
+        x1, y1, x2, y2 = (int(round(v)) for v in (x1, y1, x2, y2))
+        return img[y1:y2, x1:x2]
+
+
+class Expand(FeatureTransformer):
+    """Zoom-out: place the image on a larger mean-filled canvas
+    (reference: augmentation/Expand.scala)."""
+
+    def __init__(self, max_ratio: float = 4.0, means: Sequence[float] = (123, 117, 104),
+                 seed: int = 0):
+        self.max_ratio = max_ratio
+        self.means = np.asarray(means, np.float32)
+        self.rs = np.random.RandomState(seed)
+
+    def transform_image(self, img):
+        ih, iw, c = img.shape
+        ratio = self.rs.uniform(1.0, self.max_ratio)
+        oh, ow = int(ih * ratio), int(iw * ratio)
+        canvas = np.broadcast_to(self.means, (oh, ow, c)).astype(np.float32).copy()
+        y = self.rs.randint(0, oh - ih + 1)
+        x = self.rs.randint(0, ow - iw + 1)
+        canvas[y:y + ih, x:x + iw] = img
+        return canvas
+
+
+class Flip(FeatureTransformer):
+    def __init__(self, p: float = 0.5, seed: int = 0):
+        self.p = p
+        self.rs = np.random.RandomState(seed)
+
+    def transform_image(self, img):
+        return hflip(img) if self.rs.rand() < self.p else img
+
+
+class ImageFrameToSample(FeatureTransformer):
+    """Terminal stage: ImageFeature -> Sample stored under key 'sample'
+    (reference: ImageFrameToSample.scala)."""
+
+    SAMPLE = "sample"
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        label = feature.label
+        feature[self.SAMPLE] = Sample(
+            np.ascontiguousarray(feature.image, np.float32),
+            None if label is None else np.asarray(label))
+        return feature

@@ -1,0 +1,20 @@
+"""TensorBoard event-file writing/reading.
+
+Reference: visualization/ — TrainSummary/ValidationSummary
+(TrainSummary.scala:32, ValidationSummary.scala:29) over a from-scratch
+FileWriter -> EventWriter -> RecordWriter stack emitting TF Event protobufs
+with crc32c framing (EventWriter.scala:26-68, RecordWriter.scala:25,
+netty/Crc32c.java).
+
+Here the protobuf subset is hand-encoded (proto.py), the crc32c comes from
+the native C++ layer (bigdl_tpu/native), and the record framing is the
+shared TFRecord framing — real `events.out.tfevents.*` files TensorBoard
+loads directly.
+"""
+
+from bigdl_tpu.visualization.writer import (
+    FileWriter,
+    read_events,
+    read_scalar,
+    histogram_of,
+)

@@ -1,0 +1,156 @@
+"""Minimal protobuf wire-format encode/decode for TensorBoard Event files.
+
+Reference: visualization/tensorboard/ writes TF `Event` protobufs via
+generated Java classes (EventWriter.scala:26-68, RecordWriter.scala:25).
+Here the needed subset of event.proto/summary.proto is encoded by hand —
+five message types, no protoc dependency:
+
+  Event       { double wall_time=1; int64 step=2; string file_version=3;
+                Summary summary=5; }
+  Summary     { repeated Value value=1; }
+  Value       { string tag=1; float simple_value=2; HistogramProto histo=5; }
+  HistogramProto { double min=1,max=2,num=3,sum=4,sum_squares=5;
+                   repeated double bucket_limit=7 [packed];
+                   repeated double bucket=8 [packed]; }
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# wire primitives
+# ---------------------------------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _double(field: int, v: float) -> bytes:
+    return _key(field, 1) + struct.pack("<d", v)
+
+
+def _float(field: int, v: float) -> bytes:
+    return _key(field, 5) + struct.pack("<f", v)
+
+
+def _int64(field: int, v: int) -> bytes:
+    return _key(field, 0) + _varint(v & 0xFFFFFFFFFFFFFFFF)
+
+
+def _bytes(field: int, v: bytes) -> bytes:
+    return _key(field, 2) + _varint(len(v)) + v
+
+
+def _packed_doubles(field: int, vs) -> bytes:
+    body = b"".join(struct.pack("<d", v) for v in vs)
+    return _bytes(field, body)
+
+
+# ---------------------------------------------------------------------------
+# encode
+# ---------------------------------------------------------------------------
+
+
+def encode_histogram(min_v: float, max_v: float, num: float, sum_v: float,
+                     sum_sq: float, limits, counts) -> bytes:
+    return (_double(1, min_v) + _double(2, max_v) + _double(3, num) +
+            _double(4, sum_v) + _double(5, sum_sq) +
+            _packed_doubles(7, limits) + _packed_doubles(8, counts))
+
+
+def encode_value_scalar(tag: str, value: float) -> bytes:
+    return _bytes(1, tag.encode()) + _float(2, value)
+
+
+def encode_value_histo(tag: str, histo: bytes) -> bytes:
+    return _bytes(1, tag.encode()) + _bytes(5, histo)
+
+
+def encode_event(wall_time: float, step: Optional[int] = None,
+                 file_version: Optional[str] = None,
+                 values: Optional[List[bytes]] = None) -> bytes:
+    out = _double(1, wall_time)
+    if step is not None:
+        out += _int64(2, step)
+    if file_version is not None:
+        out += _bytes(3, file_version.encode())
+    if values:
+        out += _bytes(5, b"".join(_bytes(1, v) for v in values))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# decode (read-back path: TrainSummary.readScalar parity)
+# ---------------------------------------------------------------------------
+
+
+def _read_varint(buf: bytes, off: int) -> Tuple[int, int]:
+    n = shift = 0
+    while True:
+        b = buf[off]
+        off += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, off
+        shift += 7
+
+
+def iter_fields(buf: bytes) -> Iterator[Tuple[int, int, Any]]:
+    off = 0
+    while off < len(buf):
+        key, off = _read_varint(buf, off)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, off = _read_varint(buf, off)
+        elif wire == 1:
+            v = struct.unpack_from("<d", buf, off)[0]
+            off += 8
+        elif wire == 5:
+            v = struct.unpack_from("<f", buf, off)[0]
+            off += 4
+        elif wire == 2:
+            ln, off = _read_varint(buf, off)
+            v = buf[off:off + ln]
+            off += ln
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, v
+
+
+def decode_event(buf: bytes) -> Dict[str, Any]:
+    ev: Dict[str, Any] = {"values": []}
+    for field, wire, v in iter_fields(buf):
+        if field == 1 and wire == 1:
+            ev["wall_time"] = v
+        elif field == 2 and wire == 0:
+            ev["step"] = v
+        elif field == 3 and wire == 2:
+            ev["file_version"] = v.decode()
+        elif field == 5 and wire == 2:
+            for f2, w2, summary_val in iter_fields(v):
+                if f2 == 1 and w2 == 2:
+                    val: Dict[str, Any] = {}
+                    for f3, w3, x in iter_fields(summary_val):
+                        if f3 == 1 and w3 == 2:
+                            val["tag"] = x.decode()
+                        elif f3 == 2 and w3 == 5:
+                            val["simple_value"] = x
+                        elif f3 == 5 and w3 == 2:
+                            val["histo"] = x
+                    ev["values"].append(val)
+    return ev

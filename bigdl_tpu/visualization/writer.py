@@ -1,0 +1,143 @@
+"""FileWriter: append Event protobufs to an events.out.tfevents file.
+
+Reference: visualization/tensorboard/FileWriter.scala + EventWriter.scala
+(queue + writer thread, :26-68) + RecordWriter.scala:25 (length/crc
+framing).  The queue/thread is unnecessary here — scalar writes are
+microseconds off the training step's critical path (the step itself runs
+async on the TPU), so writes are synchronous and flushed per event.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu import native
+from bigdl_tpu.visualization import proto
+
+
+def _frame(record: bytes) -> bytes:
+    header = struct.pack("<Q", len(record))
+    return (header + struct.pack("<I", native.crc32c_masked(header)) +
+            record + struct.pack("<I", native.crc32c_masked(record)))
+
+
+class FileWriter:
+    """reference: visualization/tensorboard/FileWriter.scala."""
+
+    def __init__(self, log_dir: str, filename_suffix: str = ""):
+        os.makedirs(log_dir, exist_ok=True)
+        fname = (f"events.out.tfevents.{int(time.time())}."
+                 f"{socket.gethostname()}{filename_suffix}")
+        self.path = os.path.join(log_dir, fname)
+        self._fh = open(self.path, "ab")
+        # every event file starts with a file_version event
+        self._write_event(proto.encode_event(time.time(),
+                                             file_version="brain.Event:2"))
+
+    def _write_event(self, event: bytes) -> None:
+        self._fh.write(_frame(event))
+        self._fh.flush()
+
+    def add_scalar(self, tag: str, value: float, step: int,
+                   wall_time: Optional[float] = None) -> None:
+        v = proto.encode_value_scalar(tag, float(value))
+        self._write_event(proto.encode_event(wall_time or time.time(),
+                                             step=int(step), values=[v]))
+
+    def add_histogram(self, tag: str, values: np.ndarray, step: int,
+                      wall_time: Optional[float] = None) -> None:
+        histo = histogram_of(np.asarray(values))
+        v = proto.encode_value_histo(tag, histo)
+        self._write_event(proto.encode_event(wall_time or time.time(),
+                                             step=int(step), values=[v]))
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def histogram_of(values: np.ndarray) -> bytes:
+    """Build a HistogramProto with TensorBoard's exponential bucket scheme
+    (reference parity: the Scala writer uses the same TF bucketing)."""
+    flat = values.reshape(-1).astype(np.float64)
+    if flat.size == 0:
+        return proto.encode_histogram(0, 0, 0, 0, 0, [], [])
+    limits = _default_bucket_limits()
+    counts, _ = np.histogram(flat, bins=[-np.inf] + list(limits))
+    nz = np.nonzero(counts)[0]
+    if nz.size:
+        lo, hi = nz[0], nz[-1] + 1
+        used_limits = limits[lo:hi]
+        used_counts = counts[lo:hi]
+    else:
+        used_limits, used_counts = limits[:1], counts[:1]
+    return proto.encode_histogram(
+        float(flat.min()), float(flat.max()), float(flat.size),
+        float(flat.sum()), float(np.square(flat).sum()),
+        used_limits, used_counts)
+
+
+_BUCKETS: Optional[np.ndarray] = None
+
+
+def _default_bucket_limits() -> np.ndarray:
+    global _BUCKETS
+    if _BUCKETS is None:
+        pos = []
+        v = 1e-12
+        while v < 1e20:
+            pos.append(v)
+            v *= 1.1
+        neg = [-x for x in reversed(pos)]
+        _BUCKETS = np.asarray(neg + [0.0] + pos + [np.finfo(np.float64).max])
+    return _BUCKETS
+
+
+# ---------------------------------------------------------------------------
+# read-back (reference: TrainSummary.readScalar)
+# ---------------------------------------------------------------------------
+
+
+def read_events(path: str) -> Iterator[Dict]:
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if len(header) < 12:
+                return
+            (length,) = struct.unpack("<Q", header[:8])
+            (crc,) = struct.unpack("<I", header[8:])
+            if native.crc32c_masked(header[:8]) != crc:
+                raise IOError(f"corrupt event header in {path}")
+            data = f.read(length)
+            (dcrc,) = struct.unpack("<I", f.read(4))
+            if native.crc32c_masked(data) != dcrc:
+                raise IOError(f"corrupt event data in {path}")
+            yield proto.decode_event(data)
+
+
+def read_scalar(log_dir_or_file: str, tag: str) -> List[Tuple[int, float]]:
+    """(step, value) series for `tag` across all event files in a dir."""
+    if os.path.isdir(log_dir_or_file):
+        paths = sorted(
+            os.path.join(log_dir_or_file, f) for f in os.listdir(log_dir_or_file)
+            if "tfevents" in f)
+    else:
+        paths = [log_dir_or_file]
+    out: List[Tuple[int, float]] = []
+    for p in paths:
+        for ev in read_events(p):
+            for v in ev["values"]:
+                if v.get("tag") == tag and "simple_value" in v:
+                    out.append((int(ev.get("step", 0)), float(v["simple_value"])))
+    return out

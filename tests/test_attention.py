@@ -176,3 +176,12 @@ def test_transformer_lm_trains(rng):
         params, opt_state, loss = step(params, opt_state)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_ulysses_head_divisibility_validated(rng):
+    layer = nn.MultiHeadAttention(32, 4, seq_parallel="ulysses")
+    layer.mesh = _seq_mesh(seq=8, data=1)
+    params, state, _ = layer.build(rng, (2, 16, 32))
+    x = jax.random.normal(rng, (2, 16, 32), jnp.float32)
+    with pytest.raises(ValueError, match="divisible"):
+        layer.apply(params, state, x)

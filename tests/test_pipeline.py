@@ -1,0 +1,176 @@
+"""Data-pipeline tests: image transformers (dataset/image/* parity), text
+pipeline (dataset/text/* parity), vision ImageFrame
+(transform/vision/image parity)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset import image as I
+from bigdl_tpu.dataset import text as T
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+import bigdl_tpu.vision as V
+
+
+def _imgs(n=4, h=12, w=16, c=3, seed=0):
+    rs = np.random.RandomState(seed)
+    return [I.LabeledImage(rs.rand(h, w, c).astype(np.float32) * 255, i)
+            for i in range(n)]
+
+
+class TestImageTransformers:
+    def test_resize_bilinear_identity_and_interp(self):
+        img = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
+        assert I.resize_bilinear(img, 2, 2) is img or np.allclose(
+            I.resize_bilinear(img, 2, 2), img)
+        up = I.resize_bilinear(img, 4, 4)
+        assert up.shape == (4, 4, 3)
+        # values stay within original range (bilinear is a convex combination)
+        assert up.min() >= img.min() - 1e-5 and up.max() <= img.max() + 1e-5
+
+    def test_resize_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(0)
+        img = rs.rand(9, 7, 3).astype(np.float32)
+        got = I.resize_bilinear(img, 5, 11)
+        want = torch.nn.functional.interpolate(
+            torch.from_numpy(img).permute(2, 0, 1)[None], size=(5, 11),
+            mode="bilinear", align_corners=False)[0].permute(1, 2, 0).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_crop_shapes(self):
+        recs = list(I.RandomCrop(8, 8, seed=1)(iter(_imgs())))
+        assert all(r.image.shape == (8, 8, 3) for r in recs)
+        recs = list(I.CenterCrop(8, 10)(iter(_imgs())))
+        assert all(r.image.shape == (8, 10, 3) for r in recs)
+        center = recs[0].image
+        src = _imgs()[0].image
+        np.testing.assert_array_equal(center, src[2:10, 3:13])
+
+    def test_random_resized_crop(self):
+        recs = list(I.RandomResizedCrop(6, 6, seed=2)(iter(_imgs())))
+        assert all(r.image.shape == (6, 6, 3) for r in recs)
+
+    def test_hflip(self):
+        recs = list(I.HFlip(p=1.0)(iter(_imgs(n=1))))
+        np.testing.assert_array_equal(recs[0].image, _imgs(n=1)[0].image[:, ::-1])
+
+    def test_normalizer(self):
+        mean, std = (10.0, 20.0, 30.0), (2.0, 4.0, 8.0)
+        recs = list(I.Normalizer(mean, std)(iter(_imgs(n=1))))
+        want = (_imgs(n=1)[0].image - np.asarray(mean)) / np.asarray(std)
+        np.testing.assert_allclose(recs[0].image, want, rtol=1e-6)
+
+    def test_color_jitter_and_lighting_are_deterministic(self):
+        a = [r.image for r in I.ColorJitter(seed=3)(iter(_imgs()))]
+        b = [r.image for r in I.ColorJitter(seed=3)(iter(_imgs()))]
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+        la = [r.image for r in I.Lighting(seed=4)(iter(_imgs()))]
+        lb = [r.image for r in I.Lighting(seed=5)(iter(_imgs()))]
+        assert not np.allclose(la[0], lb[0])
+
+    def test_hue_rotation_roundtrip(self):
+        img = _imgs(n=1)[0].image
+        back = I.adjust_hue(I.adjust_hue(img, 40.0), -40.0)
+        np.testing.assert_allclose(back, img, rtol=1e-3, atol=1e-2)
+
+    def test_pipeline_to_minibatch(self):
+        pipe = (I.Resize(10, 10) >> I.RandomCrop(8, 8, seed=0) >>
+                I.HFlip(seed=0) >> I.Normalizer((0, 0, 0), (255, 255, 255)) >>
+                I.ImageToSample() >> SampleToMiniBatch(2))
+        batches = list(pipe.apply_to(_imgs(n=4)))
+        assert len(batches) == 2
+        x = batches[0].get_input()
+        assert x.shape == (2, 8, 8, 3)
+        assert float(np.abs(x).max()) <= 1.0
+
+    def test_pixel_bytes_to_image(self):
+        raw = bytes(range(24))
+        recs = list(I.PixelBytesToImage(2, 4, 3)(iter([(raw, 7)])))
+        assert recs[0].image.shape == (2, 4, 3)
+        assert recs[0].label == 7
+        assert recs[0].image[0, 0, 1] == 1.0
+
+
+class TestTextPipeline:
+    CORPUS = ("The cat sat on the mat. The dog ate the cat! A bird flew.\n"
+              "The mat was red.")
+
+    def test_split_and_tokenize(self):
+        sents = list(T.SentenceSplitter()(iter([self.CORPUS])))
+        assert len(sents) == 4
+        toks = list(T.SentenceTokenizer()(iter(sents)))
+        assert toks[0] == ["the", "cat", "sat", "on", "the", "mat", "."]
+
+    def test_bipadding(self):
+        out = list(T.SentenceBiPadding()(iter([["a", "b"]])))[0]
+        assert out[0] == T.SentenceBiPadding.START and out[-1] == T.SentenceBiPadding.END
+
+    def test_dictionary(self):
+        toks = list(T.SentenceTokenizer()(T.SentenceSplitter()(iter([self.CORPUS]))))
+        d = T.Dictionary(toks, vocab_size=5)
+        assert d.vocab_size() == 6  # 5 kept + UNK
+        assert d.get_index("the") == 0  # most frequent first
+        assert d.get_index("zebra") == d.get_index(T.Dictionary.UNK)
+        ids = d.encode(["the", "cat", "zebra"])
+        assert d.decode(ids) == ["the", "cat", "<unk>"]
+
+    def test_dictionary_save_load(self, tmp_path):
+        d = T.Dictionary([["a", "b", "a"]])
+        p = str(tmp_path / "vocab.txt")
+        d.save(p)
+        d2 = T.Dictionary.load(p)
+        assert d2.word2index == d.word2index
+
+    def test_lm_samples(self):
+        d = T.Dictionary([["a", "b", "c", "d"]])
+        pipe = T.TextToLabeledSentence(d) >> T.LabeledSentenceToSample(seq_len=5)
+        samples = list(pipe.apply_to([["a", "b", "c", "d"]]))
+        s = samples[0]
+        assert s.feature.shape == (5,) and s.label.shape == (5,)
+        np.testing.assert_array_equal(s.feature[:3], d.encode(["a", "b", "c"]))
+        np.testing.assert_array_equal(s.label[:3], d.encode(["b", "c", "d"]))
+
+    def test_ptb_stream_batches(self):
+        ids = np.arange(100, dtype=np.int32)
+        batches = list(T.ptb_stream_batches(ids, batch_size=4, num_steps=6))
+        assert all(x.shape == (4, 6) and y.shape == (4, 6) for x, y in batches)
+        x0, y0 = batches[0]
+        np.testing.assert_array_equal(y0, x0 + 1)  # next-token shift
+
+
+class TestImageFrame:
+    def test_frame_transform_chain(self):
+        rs = np.random.RandomState(0)
+        imgs = [rs.rand(20, 20, 3).astype(np.float32) * 255 for _ in range(3)]
+        frame = V.ImageFrame.read(imgs, labels=[1, 2, 3])
+        pipe = (V.ResizeTo(16, 16) >> V.RandomCropper(12, 12, seed=1) >>
+                V.Flip(p=1.0) >> V.ChannelNormalize((128,) * 3, (64,) * 3) >>
+                V.ImageFrameToSample())
+        out = frame.transform(pipe)
+        assert len(out) == 3
+        for f, want_label in zip(out, [1, 2, 3]):
+            s = f[V.ImageFrameToSample.SAMPLE]
+            assert isinstance(s, Sample)
+            assert s.feature.shape == (12, 12, 3)
+            assert int(s.label) == want_label
+
+    def test_expand_and_fixed_crop(self):
+        img = np.full((10, 10, 3), 50.0, np.float32)
+        f = V.ImageFeature(img)
+        out = V.Expand(max_ratio=2.0, seed=0)(f)
+        oh, ow, _ = out.image.shape
+        assert oh >= 10 and ow >= 10
+        f2 = V.ImageFeature(np.arange(75, dtype=np.float32).reshape(5, 5, 3))
+        cropped = V.FixedCrop(0.2, 0.2, 0.8, 0.8, normalized=True)(f2)
+        assert cropped.image.shape == (3, 3, 3)
+
+    def test_color_ops_change_pixels(self):
+        rs = np.random.RandomState(0)
+        img = rs.rand(8, 8, 3).astype(np.float32) * 255
+        for t in (V.Brightness(-20, 20, seed=1), V.Contrast(0.5, 1.5, seed=1),
+                  V.Saturation(0.5, 1.5, seed=1), V.Hue(seed=1)):
+            out = t(V.ImageFeature(img.copy()))
+            assert out.image.shape == img.shape
+            assert not np.allclose(out.image, img)
