@@ -54,8 +54,9 @@ def resize_bilinear(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
     wy = np.clip(ys - y0, 0.0, 1.0).astype(np.float32)[:, None, None]
     wx = np.clip(xs - x0, 0.0, 1.0).astype(np.float32)[None, :, None]
     img = img.astype(np.float32, copy=False)
-    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
-    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    r0, r1 = img[y0], img[y1]  # hoist the row gathers (hot augmentation path)
+    top = r0[:, x0] * (1 - wx) + r0[:, x1] * wx
+    bot = r1[:, x0] * (1 - wx) + r1[:, x1] * wx
     return top * (1 - wy) + bot * wy
 
 

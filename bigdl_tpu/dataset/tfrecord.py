@@ -22,6 +22,37 @@ from bigdl_tpu.dataset.sample import Sample
 from bigdl_tpu.dataset.transformer import Transformer
 
 
+def frame_record(record: bytes) -> bytes:
+    """One TFRecord frame: len | masked_crc(len) | data | masked_crc(data).
+    The single definition of the wire format (event files use it too)."""
+    header = struct.pack("<Q", len(record))
+    return (header + struct.pack("<I", native.crc32c_masked(header)) +
+            record + struct.pack("<I", native.crc32c_masked(record)))
+
+
+def iter_framed(fh, what: str = "record") -> Iterator[bytes]:
+    """Iterate frames from an open binary file, verifying checksums;
+    raises IOError (never struct.error) on truncation or corruption."""
+    while True:
+        header = fh.read(12)
+        if not header:
+            return
+        if len(header) != 12:
+            raise IOError(f"truncated {what} header")
+        (length,) = struct.unpack("<Q", header[:8])
+        (len_crc,) = struct.unpack("<I", header[8:])
+        if native.crc32c_masked(header[:8]) != len_crc:
+            raise IOError(f"corrupt {what} length crc")
+        data = fh.read(length)
+        tail = fh.read(4)
+        if len(data) != length or len(tail) != 4:
+            raise IOError(f"truncated {what} body")
+        (data_crc,) = struct.unpack("<I", tail)
+        if native.crc32c_masked(data) != data_crc:
+            raise IOError(f"corrupt {what} data crc")
+        yield data
+
+
 class TFRecordWriter:
     """Write length-prefixed, crc32c-masked records."""
 
@@ -43,11 +74,7 @@ class TFRecordWriter:
             if self._lib.bigdl_tfrecord_writer_write(self._h, buf, len(record)) != 0:
                 raise IOError(f"short write to {self.path}")
         else:
-            header = struct.pack("<Q", len(record))
-            self._f.write(header)
-            self._f.write(struct.pack("<I", native.crc32c_masked(header)))
-            self._f.write(record)
-            self._f.write(struct.pack("<I", native.crc32c_masked(record)))
+            self._f.write(frame_record(record))
 
     def close(self) -> None:
         if self._h is not None:
@@ -84,21 +111,10 @@ def read_tfrecords(path: str) -> Iterator[bytes]:
             lib.bigdl_tfrecord_reader_close(h)
     else:
         with open(path, "rb") as f:
-            while True:
-                header = f.read(12)
-                if not header:
-                    return
-                if len(header) != 12:
-                    raise IOError(f"truncated TFRecord header in {path}")
-                (length,) = struct.unpack("<Q", header[:8])
-                (len_crc,) = struct.unpack("<I", header[8:])
-                if native.crc32c_masked(header[:8]) != len_crc:
-                    raise IOError(f"corrupt TFRecord length crc in {path}")
-                data = f.read(length)
-                (data_crc,) = struct.unpack("<I", f.read(4))
-                if len(data) != length or native.crc32c_masked(data) != data_crc:
-                    raise IOError(f"corrupt TFRecord data crc in {path}")
-                yield data
+            try:
+                yield from iter_framed(f, "TFRecord")
+            except IOError as e:
+                raise IOError(f"{e} in {path}") from None
 
 
 class PrefetchRecordReader:

@@ -86,6 +86,10 @@ class KerasTopology:
         self.optim_method = resolve_optimizer(optimizer)
         self.criterion = resolve_loss(loss)
         self.metrics = resolve_metrics(metrics)
+        # a re-compile changes loss/metrics: drop cached compiled programs
+        self._evaluator = None
+        self._eval_methods = None
+        self._predictor = None
         # keep any set_checkpoint/set_tensorboard made before compile()
         self._ckpt = getattr(self, "_ckpt", None)
         self._tb = getattr(self, "_tb", None)

@@ -12,7 +12,6 @@
 namespace {
 
 uint32_t g_tables[8][256];
-bool g_init = false;
 
 void init_tables() {
   const uint32_t poly = 0x82f63b78u;  // reflected CRC-32C polynomial
@@ -29,15 +28,19 @@ void init_tables() {
       g_tables[t][i] = crc;
     }
   }
-  g_init = true;
 }
+
+// Run at .so load time (single-threaded), so concurrent prefetch reader
+// threads never race a lazy init.
+struct TableInit {
+  TableInit() { init_tables(); }
+} g_table_init;
 
 }  // namespace
 
 extern "C" {
 
 uint32_t bigdl_crc32c_extend(uint32_t crc, const uint8_t* data, size_t n) {
-  if (!g_init) init_tables();
   crc = ~crc;
   while (n >= 8) {
     crc ^= static_cast<uint32_t>(data[0]) | (static_cast<uint32_t>(data[1]) << 8) |

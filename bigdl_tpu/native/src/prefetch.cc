@@ -68,7 +68,7 @@ struct Loader {
         continue;
       }
       const uint8_t* ptr = nullptr;
-      long long len;
+      long long len = -2;  // "clean EOF" if stop interrupts before first read
       while (!stop.load() && (len = bigdl_tfrecord_reader_next(rd, &ptr)) >= 0) {
         Record rec{static_cast<uint8_t*>(malloc(len ? len : 1)),
                    static_cast<size_t>(len)};

@@ -60,9 +60,17 @@ long long bigdl_tfrecord_reader_next(void* handle, const uint8_t** out) {
   uint32_t len_crc;
   memcpy(&len_crc, header + 8, 4);
   if (bigdl_crc32c_masked(header, 8) != len_crc) return -1;
+  // a crc-valid but absurd length (corruption or forgery — crc32c is not
+  // cryptographic) must not overflow the doubling loop or exhaust memory
+  const uint64_t kMaxRecord = 1ull << 36;  // 64 GiB
+  if (len > kMaxRecord) return -1;
   if (len + 4 > r->cap) {
-    while (r->cap < len + 4) r->cap <<= 1;
-    r->buf = static_cast<uint8_t*>(realloc(r->buf, r->cap));
+    size_t want = r->cap;
+    while (want < len + 4) want <<= 1;
+    uint8_t* grown = static_cast<uint8_t*>(realloc(r->buf, want));
+    if (!grown) return -1;
+    r->buf = grown;
+    r->cap = want;
   }
   if (!read_exact(r->f, r->buf, len + 4)) return -1;
   uint32_t data_crc;

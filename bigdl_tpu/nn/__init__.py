@@ -116,6 +116,12 @@ from bigdl_tpu.nn.attention import (
     TransformerBlock,
     apply_rope,
 )
+from bigdl_tpu.nn.quantized import (
+    QuantizedLinear,
+    QuantizedSpatialConvolution,
+    quantize,
+)
+from bigdl_tpu.nn import ops
 from bigdl_tpu.nn.criterion import (
     Criterion,
     ClassNLLCriterion,

@@ -1,0 +1,490 @@
+"""Forward-only TF-style operations + control flow.
+
+Reference: nn/ops/ (71 files — `Operation` base forbids backward,
+nn/ops/Operation.scala:32: compare/gather/oneHot/pad/rank/select/slice,
+feature-column ops CategoricalColHashBucket/CrossCol/IndicatorCol/
+Kv2Tensor/MkString) and nn/tf/ (ControlOps Switch/Merge/Enter/Exit/
+NextIteration, StridedSlice).
+
+TPU-native redesign: numeric ops are thin jnp wrappers whose outputs pass
+through `lax.stop_gradient` (the functional meaning of "backward
+forbidden"); TF's frame-based control flow (Scheduler/FrameManager,
+nn/Scheduler.scala:36) collapses into structured `lax.cond`/
+`lax.while_loop` modules, which is how XLA wants control flow expressed.
+String/feature-column ops run host-side on numpy object arrays (strings
+never enter XLA) with a deterministic FNV-1a hash replacing the JVM's
+`##` hashing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.core.table import Table
+from bigdl_tpu.nn.module import Module
+
+
+class Operation(Module):
+    """Forward-only op (reference: nn/ops/Operation.scala:32 — backward
+    throws).  Outputs are wrapped in stop_gradient so `jax.grad` through a
+    graph containing Operations treats them as constants, the functional
+    equivalent of 'no backward'."""
+
+    def compute(self, x: Any) -> Any:
+        raise NotImplementedError(type(self).__name__)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = self.compute(x)
+        if isinstance(y, Table):
+            y = Table(*[lax.stop_gradient(v) for v in y])
+        elif isinstance(y, (jnp.ndarray, jax.Array)):
+            y = lax.stop_gradient(y)
+        return y, state
+
+
+def _pair(x: Any) -> Tuple[Any, Any]:
+    a, b = list(x) if isinstance(x, Table) else x
+    return a, b
+
+
+# ---------------------------------------------------------------------------
+# comparison / logical (reference: nn/ops/{Equal,Greater,...}.scala)
+# ---------------------------------------------------------------------------
+
+
+class Equal(Operation):
+    def compute(self, x):
+        a, b = _pair(x)
+        return jnp.equal(a, b)
+
+
+class NotEqual(Operation):
+    def compute(self, x):
+        a, b = _pair(x)
+        return jnp.not_equal(a, b)
+
+
+class Greater(Operation):
+    def compute(self, x):
+        a, b = _pair(x)
+        return jnp.greater(a, b)
+
+
+class GreaterEqual(Operation):
+    def compute(self, x):
+        a, b = _pair(x)
+        return jnp.greater_equal(a, b)
+
+
+class Less(Operation):
+    def compute(self, x):
+        a, b = _pair(x)
+        return jnp.less(a, b)
+
+
+class LessEqual(Operation):
+    def compute(self, x):
+        a, b = _pair(x)
+        return jnp.less_equal(a, b)
+
+
+class LogicalAnd(Operation):
+    def compute(self, x):
+        a, b = _pair(x)
+        return jnp.logical_and(a, b)
+
+
+class LogicalOr(Operation):
+    def compute(self, x):
+        a, b = _pair(x)
+        return jnp.logical_or(a, b)
+
+
+class LogicalNot(Operation):
+    def compute(self, x):
+        return jnp.logical_not(x)
+
+
+class All(Operation):
+    def __init__(self, axis: Optional[int] = None, keep_dims: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.axis, self.keep_dims = axis, keep_dims
+
+    def compute(self, x):
+        return jnp.all(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+class Any(Operation):
+    def __init__(self, axis: Optional[int] = None, keep_dims: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.axis, self.keep_dims = axis, keep_dims
+
+    def compute(self, x):
+        return jnp.any(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+# ---------------------------------------------------------------------------
+# structural (reference: nn/ops/{Gather,OneHot,Pad,Rank,Select,Slice,...})
+# ---------------------------------------------------------------------------
+
+
+class Gather(Operation):
+    """Gather rows along `axis` by integer indices; input Table(params, ids)."""
+
+    def __init__(self, axis: int = 0, name: Optional[str] = None):
+        super().__init__(name)
+        self.axis = axis
+
+    def compute(self, x):
+        table, idx = _pair(x)
+        return jnp.take(table, idx.astype(jnp.int32), axis=self.axis)
+
+
+class OneHot(Operation):
+    def __init__(self, depth: int, on_value: float = 1.0, off_value: float = 0.0,
+                 axis: int = -1, name: Optional[str] = None):
+        super().__init__(name)
+        self.depth, self.on, self.off, self.axis = depth, on_value, off_value, axis
+
+    def compute(self, x):
+        oh = jax.nn.one_hot(x.astype(jnp.int32), self.depth, axis=self.axis)
+        return oh * (self.on - self.off) + self.off
+
+
+class Pad(Operation):
+    def __init__(self, paddings: Sequence[Tuple[int, int]], value: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.paddings = [tuple(p) for p in paddings]
+        self.value = value
+
+    def compute(self, x):
+        return jnp.pad(x, self.paddings, constant_values=self.value)
+
+
+class Rank(Operation):
+    def compute(self, x):
+        return jnp.asarray(x.ndim, jnp.int32)
+
+
+class ShapeOp(Operation):
+    def compute(self, x):
+        return jnp.asarray(x.shape, jnp.int32)
+
+
+class SelectOp(Operation):
+    """Elementwise where(cond, then, else); input Table(cond, t, e)
+    (reference: nn/ops/Select.scala)."""
+
+    def compute(self, x):
+        cond, t, e = list(x)
+        return jnp.where(cond, t, e)
+
+
+class Slice(Operation):
+    def __init__(self, begin: Sequence[int], size: Sequence[int],
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.begin, self.size = list(begin), list(size)
+
+    def compute(self, x):
+        sizes = [dim - b if s == -1 else s
+                 for b, s, dim in zip(self.begin, self.size, x.shape)]
+        return lax.slice(x, self.begin, [b + s for b, s in zip(self.begin, sizes)])
+
+
+class StridedSlice(Operation):
+    """reference: nn/tf/StridedSlice.scala — python slice semantics."""
+
+    def __init__(self, slices: Sequence[Tuple[Optional[int], Optional[int], int]],
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.slices = [tuple(s) for s in slices]
+
+    def compute(self, x):
+        return x[tuple(slice(*s) for s in self.slices)]
+
+
+class Tile(Operation):
+    def __init__(self, multiples: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.multiples = list(multiples)
+
+    def compute(self, x):
+        return jnp.tile(x, self.multiples)
+
+
+class ArgMax(Operation):
+    def __init__(self, axis: int = -1, name: Optional[str] = None):
+        super().__init__(name)
+        self.axis = axis
+
+    def compute(self, x):
+        return jnp.argmax(x, axis=self.axis)
+
+
+class Cast(Operation):
+    def __init__(self, dtype: str, name: Optional[str] = None):
+        super().__init__(name)
+        self.dtype = dtype
+
+    def compute(self, x):
+        return x.astype(jnp.dtype(self.dtype))
+
+
+class TopK(Operation):
+    def __init__(self, k: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.k = k
+
+    def compute(self, x):
+        values, indices = lax.top_k(x, self.k)
+        return Table(values, indices)
+
+
+class InTopK(Operation):
+    def __init__(self, k: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.k = k
+
+    def compute(self, x):
+        predictions, targets = _pair(x)
+        _, top = lax.top_k(predictions, self.k)
+        return jnp.any(top == targets[:, None].astype(top.dtype), axis=-1)
+
+
+class Sign(Operation):
+    def compute(self, x):
+        return jnp.sign(x)
+
+
+class Mod(Operation):
+    def compute(self, x):
+        a, b = _pair(x)
+        return jnp.mod(a, b)
+
+
+class FloorDiv(Operation):
+    def compute(self, x):
+        a, b = _pair(x)
+        return jnp.floor_divide(a, b)
+
+
+class Maximum(Operation):
+    def compute(self, x):
+        a, b = _pair(x)
+        return jnp.maximum(a, b)
+
+
+class Minimum(Operation):
+    def compute(self, x):
+        a, b = _pair(x)
+        return jnp.minimum(a, b)
+
+
+class SquaredDifference(Operation):
+    def compute(self, x):
+        a, b = _pair(x)
+        return jnp.square(a - b)
+
+
+class RandomUniformOp(Operation):
+    """reference: nn/ops/RandomUniform.scala."""
+
+    def __init__(self, minval: float = 0.0, maxval: float = 1.0, seed: int = 0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.minval, self.maxval, self.seed = minval, maxval, seed
+        self._count = 0
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if rng is None:
+            rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), self._count)
+            self._count += 1
+        shape = tuple(np.asarray(x).tolist()) if not hasattr(x, "shape") or x.ndim == 1 \
+            else tuple(x.shape)
+        y = jax.random.uniform(rng, shape, jnp.float32, self.minval, self.maxval)
+        return lax.stop_gradient(y), state
+
+
+# ---------------------------------------------------------------------------
+# control flow (reference: nn/tf/ControlOps.scala Switch/Merge/Enter/Exit ->
+# structured lax control flow)
+# ---------------------------------------------------------------------------
+
+
+class Cond(Module):
+    """Run `then_module` or `else_module` on the data input depending on a
+    scalar boolean — Switch+Merge collapsed into `lax.cond`.  Input:
+    Table(pred, data)."""
+
+    _constructor_children = True
+
+    def __init__(self, then_module: Module, else_module: Module,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.then_module = then_module
+        self.else_module = else_module
+
+    def build(self, rng, input_shape):
+        pred_shape, data_shape = list(input_shape)
+        k1, k2 = jax.random.split(rng)
+        p_then, s_then, out = self.then_module.build(k1, data_shape)
+        p_else, s_else, _ = self.else_module.build(k2, data_shape)
+        return ({"then": p_then, "else": p_else},
+                {"then": s_then, "else": s_else}, out)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        pred, data = _pair(x)
+        out = lax.cond(
+            jnp.asarray(pred).reshape(()),
+            lambda d: self.then_module.apply(params["then"], state["then"], d,
+                                             training=training, rng=rng)[0],
+            lambda d: self.else_module.apply(params["else"], state["else"], d,
+                                             training=training, rng=rng)[0],
+            data)
+        return out, state
+
+
+class WhileLoop(Module):
+    """Repeat `body` while `cond_fn(x)` holds — Enter/Exit/NextIteration
+    frames collapsed into `lax.while_loop`.  `body` must be shape-
+    preserving (the TF loop-invariant requirement, enforced by XLA)."""
+
+    _constructor_children = True
+
+    def __init__(self, body: Module, cond_fn: Callable[[Any], Any],
+                 max_iterations: Optional[int] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.body = body
+        self.cond_fn = cond_fn
+        self.max_iterations = max_iterations
+
+    def build(self, rng, input_shape):
+        p, s, out = self.body.build(rng, input_shape)
+        return {"body": p}, {"body": s}, out
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        limit = self.max_iterations
+
+        def cond(carry):
+            i, v = carry
+            ok = jnp.asarray(self.cond_fn(v)).reshape(())
+            if limit is not None:
+                ok = jnp.logical_and(ok, i < limit)
+            return ok
+
+        def body(carry):
+            i, v = carry
+            out, _ = self.body.apply(params["body"], state["body"], v,
+                                     training=training, rng=rng)
+            return i + 1, out
+
+        _, out = lax.while_loop(cond, body, (jnp.asarray(0), x))
+        return out, state
+
+
+# ---------------------------------------------------------------------------
+# feature-column ops (host-side, numpy object/string arrays)
+# reference: nn/ops/{CategoricalColHashBucket,CrossCol,IndicatorCol,
+# Kv2Tensor,MkString}.scala
+# ---------------------------------------------------------------------------
+
+
+def fnv1a(s: str) -> int:
+    h = 0xCBF29CE484222325
+    for b in s.encode():
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+class CategoricalColHashBucket(Operation):
+    """String column -> stable hash bucket ids."""
+
+    def __init__(self, hash_bucket_size: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.hash_bucket_size = hash_bucket_size
+
+    def compute(self, x):
+        flat = np.asarray(x, dtype=object).reshape(-1)
+        ids = np.asarray([fnv1a(str(v)) % self.hash_bucket_size for v in flat],
+                         np.int32)
+        return jnp.asarray(ids.reshape(np.asarray(x, dtype=object).shape))
+
+
+class CrossCol(Operation):
+    """Cross N string columns -> hashed bucket of the joined key."""
+
+    def __init__(self, hash_bucket_size: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.hash_bucket_size = hash_bucket_size
+
+    def compute(self, x):
+        cols = [np.asarray(c, dtype=object).reshape(-1) for c in x]
+        n = len(cols[0])
+        ids = np.asarray(
+            [fnv1a("_X_".join(str(c[i]) for c in cols)) % self.hash_bucket_size
+             for i in range(n)], np.int32)
+        return jnp.asarray(ids)
+
+
+class IndicatorCol(Operation):
+    """Categorical indices -> multi-hot indicator vector."""
+
+    def __init__(self, feature_num: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.feature_num = feature_num
+
+    def compute(self, x):
+        idx = jnp.asarray(x).astype(jnp.int32)
+        if idx.ndim == 1:
+            idx = idx[:, None]
+        oh = jax.nn.one_hot(idx, self.feature_num)
+        return jnp.clip(oh.sum(axis=-2), 0.0, 1.0)
+
+
+class Kv2Tensor(Operation):
+    """Parse "k:v,k:v" strings into dense rows (host-side)."""
+
+    def __init__(self, kv_delimiter: str = ",", item_delimiter: str = ":",
+                 feature_num: int = 0, name: Optional[str] = None):
+        super().__init__(name)
+        self.kv_delimiter = kv_delimiter
+        self.item_delimiter = item_delimiter
+        self.feature_num = feature_num
+
+    def compute(self, x):
+        rows = np.asarray(x, dtype=object).reshape(-1)
+        out = np.zeros((len(rows), self.feature_num), np.float32)
+        for i, row in enumerate(rows):
+            for item in str(row).split(self.kv_delimiter):
+                if not item:
+                    continue
+                k, v = item.split(self.item_delimiter)
+                out[i, int(k)] = float(v)
+        return jnp.asarray(out)
+
+
+class MkString(Operation):
+    """Join numeric rows into delimiter-separated strings (host-side)."""
+
+    def __init__(self, str_delimiter: str = ",", name: Optional[str] = None):
+        super().__init__(name)
+        self.str_delimiter = str_delimiter
+
+    def compute(self, x):
+        arr = np.asarray(x)
+        def fmt(v):
+            f = float(v)
+            return str(int(f)) if f.is_integer() else str(f)
+        return np.asarray(
+            [self.str_delimiter.join(fmt(v) for v in row) for row in arr],
+            dtype=object)

@@ -1,0 +1,201 @@
+"""Int8 quantized inference.
+
+Reference: nn/quantized/ — `Quantizer` walks a trained module tree
+replacing Linear / SpatialConvolution / SpatialDilatedConvolution with
+quantized versions (nn/quantized/Quantizer.scala:27-32); weights live in
+int8 `QuantizedTensor`s with per-output-channel scales; the native
+BigQuant `MixPrecisionGEMM` multiplies int8 weights against per-minibatch
+quantized activations (survey §2.9 BigQuant row).
+
+TPU-native redesign: symmetric per-output-channel int8 weights + dynamic
+per-tensor activation quantization; the int8 x int8 -> int32 matmul/conv
+is a single `lax.dot_general` / `conv_general_dilated` with
+`preferred_element_type=int32`, which XLA lowers onto the MXU's native
+int8 path; dequantization fuses into the epilogue.  The functional pass
+`quantize(module, params) -> (q_module, q_params)` replaces the in-place
+tree mutation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.conv import SpatialConvolution, _DIMSPEC_2D, _pad2d
+from bigdl_tpu.nn.graph import Graph
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.module import Container, Module, Node
+
+
+def quantize_weight(w: jnp.ndarray, channel_axis: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-channel int8: returns (int8 weights, fp32 scale) with
+    w ~= w_q * scale (scale broadcast over channel_axis)."""
+    reduce_axes = tuple(i for i in range(w.ndim) if i != channel_axis)
+    absmax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    w_q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return w_q, scale.astype(jnp.float32)
+
+
+def quantize_activation(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dynamic symmetric per-tensor int8 activations (the analogue of
+    BigQuant's per-minibatch activation quantization)."""
+    absmax = jnp.max(jnp.abs(x))
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    x_q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return x_q, scale.astype(jnp.float32)
+
+
+class QuantizedLinear(Module):
+    """Int8 Linear. reference: nn/quantized/Linear.scala."""
+
+    def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+
+    @staticmethod
+    def from_float(layer: Linear, params: Any) -> Tuple["QuantizedLinear", Any]:
+        q = QuantizedLinear(layer.input_size, layer.output_size, layer.with_bias)
+        w_q, scale = quantize_weight(jnp.asarray(params["weight"]), channel_axis=1)
+        q_params = {"weight_q": w_q, "scale": scale[0]}  # (out,) after squeeze
+        if layer.with_bias:
+            q_params["bias"] = jnp.asarray(params["bias"])
+        return q, q_params
+
+    def build(self, rng, input_shape):
+        float_layer = Linear(self.input_size, self.output_size, self.with_bias)
+        params, _, out = float_layer.build(rng, input_shape)
+        _, q_params = QuantizedLinear.from_float(float_layer, params)
+        return q_params, {}, out
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x_q, x_scale = quantize_activation(x)
+        acc = lax.dot_general(x_q, params["weight_q"],
+                              (((x.ndim - 1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * (x_scale * params["scale"])
+        if self.with_bias:
+            y = y + params["bias"]
+        return y.astype(x.dtype), state
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_size,)
+
+
+class QuantizedSpatialConvolution(Module):
+    """Int8 conv. reference: nn/quantized/SpatialConvolution.scala."""
+
+    def __init__(self, conv_cfg: dict, name: Optional[str] = None):
+        super().__init__(name)
+        self.cfg = dict(conv_cfg)
+
+    @staticmethod
+    def from_float(layer: SpatialConvolution, params: Any
+                   ) -> Tuple["QuantizedSpatialConvolution", Any]:
+        cfg = dict(n_input=layer.n_input, n_output=layer.n_output,
+                   kernel=layer.kernel, stride=layer.stride, pad=layer.pad,
+                   n_group=layer.n_group, with_bias=layer.with_bias,
+                   dilation=layer.dilation)
+        q = QuantizedSpatialConvolution(cfg)
+        # kernel layout HWIO: output channel axis = 3
+        w_q, scale = quantize_weight(jnp.asarray(params["weight"]), channel_axis=3)
+        q_params = {"weight_q": w_q, "scale": scale.reshape(-1)}
+        if layer.with_bias:
+            q_params["bias"] = jnp.asarray(params["bias"])
+        return q, q_params
+
+    def _float_layer(self) -> SpatialConvolution:
+        c = self.cfg
+        ref = SpatialConvolution(
+            c["n_input"], c["n_output"], c["kernel"][1], c["kernel"][0],
+            c["stride"][1], c["stride"][0], c["pad"][1], c["pad"][0],
+            c["n_group"], c["with_bias"])
+        ref.dilation = tuple(c["dilation"])
+        return ref
+
+    def build(self, rng, input_shape):
+        float_layer = self._float_layer()
+        params, _, out = float_layer.build(rng, input_shape)
+        _, q_params = QuantizedSpatialConvolution.from_float(float_layer, params)
+        return q_params, {}, out
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        c = self.cfg
+        x_q, x_scale = quantize_activation(x)
+        acc = lax.conv_general_dilated(
+            x_q, params["weight_q"], window_strides=tuple(c["stride"]),
+            padding=_pad2d(*c["pad"], in_hw=x.shape[1:3], kernel=tuple(c["kernel"]),
+                           stride=tuple(c["stride"]), dilation=tuple(c["dilation"])),
+            rhs_dilation=tuple(c["dilation"]), dimension_numbers=_DIMSPEC_2D,
+            feature_group_count=c["n_group"],
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * (x_scale * params["scale"])
+        if c["with_bias"]:
+            y = y + params["bias"]
+        return y.astype(x.dtype), state
+
+    def output_shape(self, input_shape):
+        return self._float_layer().output_shape(input_shape)
+
+
+def quantize(module: Module, params: Any) -> Tuple[Module, Any]:
+    """Walk the module tree, swapping Linear/SpatialConvolution (incl.
+    dilated) for int8 versions with converted params.  The functional
+    analogue of `module.quantize()` (nn/abstractnn/AbstractModule.scala:918
+    -> nn/quantized/Quantizer.scala)."""
+    from bigdl_tpu.nn.linear import SparseLinear
+
+    if isinstance(module, Linear) and not isinstance(module, SparseLinear):
+        return QuantizedLinear.from_float(module, params)
+    if isinstance(module, SpatialConvolution):  # incl. SpatialDilatedConvolution
+        return QuantizedSpatialConvolution.from_float(module, params)
+    if isinstance(module, Graph):
+        return _quantize_graph(module, params)
+    if isinstance(module, Container) and not getattr(
+            module, "_constructor_children", False):
+        new = type(module).__new__(type(module))
+        new.__dict__.update(module.__dict__)
+        from collections import OrderedDict
+
+        new.children = OrderedDict()
+        q_params = dict(params) if isinstance(params, dict) else params
+        for key, child in module.children.items():
+            qc, qp = quantize(child, params[key])
+            new.children[key] = qc
+            q_params[key] = qp
+        return new, q_params
+    return module, params
+
+
+def _quantize_graph(g: Graph, params: Any) -> Tuple[Graph, Any]:
+    # rebuild nodes with quantized modules, preserving topology
+    mapping: dict = {}
+    q_params = dict(params)
+
+    def conv_node(node: Node) -> Node:
+        if id(node) in mapping:
+            return mapping[id(node)]
+        prevs = [conv_node(p) for p in node.prevs]
+        if node.module is None:
+            new = Node(None, prevs)
+            new.name = node.name
+        else:
+            qm, qp = quantize(node.module, params.get(node.name, {}))
+            q_params[node.name] = qp
+            new = Node(qm, prevs)
+            new.name = node.name
+            qm.name = node.module.name
+        mapping[id(node)] = new
+        return new
+
+    new_inputs = [conv_node(n) for n in g.input_nodes]
+    new_outputs = [conv_node(n) for n in g.output_nodes]
+    ng = Graph(new_inputs, new_outputs)
+    ng.name = g.name
+    return ng, q_params

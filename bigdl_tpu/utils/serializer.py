@@ -93,6 +93,17 @@ def _scan_registry() -> None:
         if isinstance(obj, type) and issubclass(obj, InitializationMethod):
             INIT_REGISTRY[obj.__name__] = obj
 
+    # Forward-only op zoo (reference nn/ops) registers under "ops.<Name>"
+    from bigdl_tpu.nn import ops as ops_mod
+
+    for name in dir(ops_mod):
+        obj = getattr(ops_mod, name)
+        if isinstance(obj, type) and issubclass(obj, Module) and \
+                obj.__module__ == ops_mod.__name__:
+            serial = f"ops.{obj.__name__}"
+            obj._serial_name = serial
+            MODULE_REGISTRY[serial] = obj
+
     # Model zoo classes that are Modules in their own right (TransformerLM)
     import bigdl_tpu.models as models_pkg
 
@@ -139,6 +150,8 @@ def encode_value(v: Any) -> Any:
         return {"__tuple__": [encode_value(i) for i in v]}
     if isinstance(v, list):
         return {"__list__": [encode_value(i) for i in v]}
+    if isinstance(v, dict) and all(isinstance(k, str) for k in v):
+        return {"__dict__": {k: encode_value(x) for k, x in v.items()}}
     if isinstance(v, Module):
         return {"__module__": module_to_spec(v)}
     if isinstance(v, Criterion):
@@ -170,6 +183,8 @@ def decode_value(v: Any) -> Any:
         return tuple(decode_value(i) for i in v["__tuple__"])
     if "__list__" in v:
         return [decode_value(i) for i in v["__list__"]]
+    if "__dict__" in v:
+        return {k: decode_value(x) for k, x in v["__dict__"].items()}
     if "__module__" in v:
         return module_from_spec(v["__module__"])
     if "__criterion__" in v:

@@ -17,14 +17,8 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from bigdl_tpu import native
+from bigdl_tpu.dataset.tfrecord import frame_record, iter_framed
 from bigdl_tpu.visualization import proto
-
-
-def _frame(record: bytes) -> bytes:
-    header = struct.pack("<Q", len(record))
-    return (header + struct.pack("<I", native.crc32c_masked(header)) +
-            record + struct.pack("<I", native.crc32c_masked(record)))
 
 
 class FileWriter:
@@ -41,7 +35,7 @@ class FileWriter:
                                              file_version="brain.Event:2"))
 
     def _write_event(self, event: bytes) -> None:
-        self._fh.write(_frame(event))
+        self._fh.write(frame_record(event))
         self._fh.flush()
 
     def add_scalar(self, tag: str, value: float, step: int,
@@ -111,18 +105,7 @@ def _default_bucket_limits() -> np.ndarray:
 
 def read_events(path: str) -> Iterator[Dict]:
     with open(path, "rb") as f:
-        while True:
-            header = f.read(12)
-            if len(header) < 12:
-                return
-            (length,) = struct.unpack("<Q", header[:8])
-            (crc,) = struct.unpack("<I", header[8:])
-            if native.crc32c_masked(header[:8]) != crc:
-                raise IOError(f"corrupt event header in {path}")
-            data = f.read(length)
-            (dcrc,) = struct.unpack("<I", f.read(4))
-            if native.crc32c_masked(data) != dcrc:
-                raise IOError(f"corrupt event data in {path}")
+        for data in iter_framed(f, "event"):
             yield proto.decode_event(data)
 
 

@@ -128,3 +128,14 @@ def test_sample_record_scalar_label_rank():
     s2 = tfr.record_to_sample(tfr.sample_to_record(s))
     assert s2.label.shape == ()  # 0-d stays 0-d
     assert int(s2.label) == 3
+
+
+def test_truncated_tail_raises_ioerror(tmp_path):
+    """A file truncated mid-record must raise IOError, not struct.error."""
+    path = str(tmp_path / "trunc.tfrecord")
+    with tfr.TFRecordWriter(path) as w:
+        w.write(b"hello world, a record to truncate")
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[:-6])  # cut into the data-crc tail
+    with pytest.raises(IOError):
+        list(tfr.read_tfrecords(path))

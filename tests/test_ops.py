@@ -1,0 +1,181 @@
+"""Forward-only op zoo tests (reference: nn/ops + nn/tf ControlOps).
+
+Checks: numeric/structural op semantics vs numpy, stop_gradient behavior
+(the 'backward forbidden' contract), control-flow modules under jit, and
+feature-column host ops; plus serializer roundtrip for the ops namespace.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.table import Table
+from bigdl_tpu.nn import ops
+from bigdl_tpu.utils import serializer as ser
+
+
+def t2(a, b):
+    return Table(jnp.asarray(a), jnp.asarray(b))
+
+
+class TestNumericOps:
+    def test_comparisons(self):
+        a, b = jnp.asarray([1.0, 2.0, 3.0]), jnp.asarray([2.0, 2.0, 2.0])
+        cases = [(ops.Equal(), np.equal), (ops.NotEqual(), np.not_equal),
+                 (ops.Greater(), np.greater), (ops.GreaterEqual(), np.greater_equal),
+                 (ops.Less(), np.less), (ops.LessEqual(), np.less_equal)]
+        for op, ref in cases:
+            got, _ = op.apply({}, {}, t2(a, b))
+            np.testing.assert_array_equal(np.asarray(got), ref(np.asarray(a), np.asarray(b)))
+
+    def test_logical_and_reduce(self):
+        x = jnp.asarray([[True, False], [True, True]])
+        got, _ = ops.All(axis=1).apply({}, {}, x)
+        np.testing.assert_array_equal(np.asarray(got), [False, True])
+        got, _ = ops.Any(axis=0).apply({}, {}, x)
+        np.testing.assert_array_equal(np.asarray(got), [True, True])
+        got, _ = ops.LogicalNot().apply({}, {}, x)
+        np.testing.assert_array_equal(np.asarray(got), ~np.asarray(x))
+
+    def test_binary_math(self):
+        a, b = jnp.asarray([7.0, -4.0]), jnp.asarray([3.0, 3.0])
+        assert np.allclose(ops.Mod().apply({}, {}, t2(a, b))[0], [1.0, 2.0])
+        assert np.allclose(ops.FloorDiv().apply({}, {}, t2(a, b))[0], [2.0, -2.0])
+        assert np.allclose(ops.Maximum().apply({}, {}, t2(a, b))[0], [7.0, 3.0])
+        assert np.allclose(ops.Minimum().apply({}, {}, t2(a, b))[0], [3.0, -4.0])
+        assert np.allclose(ops.SquaredDifference().apply({}, {}, t2(a, b))[0],
+                           [16.0, 49.0])
+
+
+class TestStructuralOps:
+    def test_gather_onehot(self):
+        table = jnp.arange(12.0).reshape(4, 3)
+        got, _ = ops.Gather().apply({}, {}, Table(table, jnp.asarray([2, 0])))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(table)[[2, 0]])
+        oh, _ = ops.OneHot(4, on_value=5.0, off_value=-1.0).apply(
+            {}, {}, jnp.asarray([1, 3]))
+        assert oh.shape == (2, 4)
+        assert float(oh[0, 1]) == 5.0 and float(oh[0, 0]) == -1.0
+
+    def test_pad_slice_strided(self):
+        x = jnp.arange(6.0).reshape(2, 3)
+        padded, _ = ops.Pad([(1, 0), (0, 2)], value=9.0).apply({}, {}, x)
+        assert padded.shape == (3, 5) and float(padded[0, 0]) == 9.0
+        sliced, _ = ops.Slice([0, 1], [2, -1]).apply({}, {}, x)
+        np.testing.assert_array_equal(np.asarray(sliced), np.asarray(x)[:, 1:])
+        ss, _ = ops.StridedSlice([(None, None, 1), (2, None, -2)]).apply({}, {}, x)
+        np.testing.assert_array_equal(np.asarray(ss), np.asarray(x)[:, 2::-2])
+
+    def test_rank_shape_tile_argmax_cast(self):
+        x = jnp.ones((2, 3))
+        assert int(ops.Rank().apply({}, {}, x)[0]) == 2
+        np.testing.assert_array_equal(np.asarray(ops.ShapeOp().apply({}, {}, x)[0]),
+                                      [2, 3])
+        tiled, _ = ops.Tile([2, 1]).apply({}, {}, x)
+        assert tiled.shape == (4, 3)
+        am, _ = ops.ArgMax(-1).apply({}, {}, jnp.asarray([[1.0, 9.0, 2.0]]))
+        assert int(am[0]) == 1
+        casted, _ = ops.Cast("int32").apply({}, {}, jnp.asarray([1.9]))
+        assert casted.dtype == jnp.int32
+
+    def test_topk_intopk_select(self):
+        x = jnp.asarray([[1.0, 5.0, 3.0, 2.0]])
+        tk, _ = ops.TopK(2).apply({}, {}, x)
+        vals, idx = list(tk)
+        np.testing.assert_array_equal(np.asarray(vals), [[5.0, 3.0]])
+        np.testing.assert_array_equal(np.asarray(idx), [[1, 2]])
+        hit, _ = ops.InTopK(2).apply({}, {}, Table(x, jnp.asarray([2])))
+        assert bool(hit[0])
+        miss, _ = ops.InTopK(2).apply({}, {}, Table(x, jnp.asarray([0])))
+        assert not bool(miss[0])
+        sel, _ = ops.SelectOp().apply(
+            {}, {}, Table(jnp.asarray([True, False]), jnp.asarray([1.0, 1.0]),
+                          jnp.asarray([2.0, 2.0])))
+        np.testing.assert_array_equal(np.asarray(sel), [1.0, 2.0])
+
+    def test_operation_stops_gradient(self):
+        op = ops.Maximum()
+
+        def f(a):
+            y, _ = op.apply({}, {}, Table(a, jnp.zeros_like(a)))
+            return jnp.sum(y * a)
+
+        a = jnp.asarray([2.0, 3.0])
+        g = jax.grad(f)(a)
+        # gradient flows only through the second use of `a`, not the op output
+        np.testing.assert_allclose(np.asarray(g), [2.0, 3.0])
+
+
+class TestControlFlow:
+    def test_cond(self, rng):
+        then_m, else_m = nn.Linear(4, 4), nn.Linear(4, 4)
+        cond = ops.Cond(then_m, else_m)
+        params, state, _ = cond.build(rng, Table((), (2, 4)))
+        x = jax.random.normal(jax.random.fold_in(rng, 1), (2, 4))
+
+        @jax.jit
+        def run(pred):
+            y, _ = cond.apply(params, state, Table(pred, x))
+            return y
+
+        want_t, _ = then_m.apply(params["then"], state["then"], x)
+        want_e, _ = else_m.apply(params["else"], state["else"], x)
+        np.testing.assert_allclose(np.asarray(run(jnp.asarray(True))),
+                                   np.asarray(want_t), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(run(jnp.asarray(False))),
+                                   np.asarray(want_e), rtol=1e-6)
+
+    def test_while_loop(self):
+        double = nn.MulConstant(2.0)
+        loop = ops.WhileLoop(double, cond_fn=lambda v: jnp.max(v) < 100.0,
+                             max_iterations=50)
+        params, state, _ = loop.build(jax.random.PRNGKey(0), (2,))
+        y = jax.jit(lambda x: loop.apply(params, state, x)[0])(
+            jnp.asarray([1.0, 1.0]))
+        assert float(y[0]) == 128.0  # 1 -> 2 -> ... -> 128 (first >= 100)
+
+
+class TestFeatureColumns:
+    def test_hash_bucket_deterministic(self):
+        op = ops.CategoricalColHashBucket(100)
+        a, _ = op.apply({}, {}, np.asarray(["cat", "dog", "cat"], dtype=object))
+        assert int(a[0]) == int(a[2])
+        assert 0 <= int(a[1]) < 100
+        b, _ = op.apply({}, {}, np.asarray(["cat"], dtype=object))
+        assert int(b[0]) == int(a[0])  # stable across calls/processes
+
+    def test_cross_col(self):
+        op = ops.CrossCol(1000)
+        out, _ = op.apply({}, {}, [np.asarray(["a", "b"], dtype=object),
+                                   np.asarray(["x", "y"], dtype=object)])
+        out2, _ = op.apply({}, {}, [np.asarray(["a"], dtype=object),
+                                    np.asarray(["x"], dtype=object)])
+        assert int(out[0]) == int(out2[0])
+        assert int(out[0]) != int(out[1])
+
+    def test_indicator_col(self):
+        out, _ = ops.IndicatorCol(5).apply({}, {}, jnp.asarray([[1, 3], [0, 0]]))
+        np.testing.assert_array_equal(np.asarray(out),
+                                      [[0, 1, 0, 1, 0], [1, 0, 0, 0, 0]])
+
+    def test_kv2tensor_mkstring(self):
+        kv, _ = ops.Kv2Tensor(feature_num=4).apply(
+            {}, {}, np.asarray(["0:1.5,2:3", "1:2"], dtype=object))
+        np.testing.assert_allclose(np.asarray(kv),
+                                   [[1.5, 0, 3.0, 0], [0, 2.0, 0, 0]])
+        s, _ = ops.MkString().apply({}, {}, np.asarray([[1.0, 2.5], [3.0, 4.0]]))
+        assert list(s) == ["1,2.5", "3,4"]
+
+
+def test_ops_serialize_roundtrip():
+    for op in (ops.OneHot(4, 2.0, -1.0), ops.Pad([(1, 1)], 3.0),
+               ops.Slice([0], [2]), ops.TopK(3), ops.Cast("int32"),
+               ops.CategoricalColHashBucket(64),
+               ops.Kv2Tensor(feature_num=8)):
+        spec = ser.module_to_spec(op)
+        assert spec["class"].startswith("ops.")
+        rebuilt = ser.module_from_spec(spec)
+        assert type(rebuilt) is type(op)
+        assert ser.module_to_spec(rebuilt) == spec

@@ -1,0 +1,132 @@
+"""Int8 quantized-inference tests (reference: nn/quantized/ + the
+Quantization integration spec): quantized layers stay close to float,
+quantize() swaps the right layers across Sequential and Graph trees, and
+end-to-end model accuracy survives quantization."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.quantized import quantize_weight, quantize_activation
+
+
+def test_quantize_weight_roundtrip():
+    rs = np.random.RandomState(0)
+    w = jnp.asarray(rs.randn(16, 8).astype(np.float32))
+    w_q, scale = quantize_weight(w, channel_axis=1)
+    assert w_q.dtype == jnp.int8
+    recon = w_q.astype(jnp.float32) * scale
+    # per-channel symmetric int8: max error <= scale/2 per channel
+    err = np.abs(np.asarray(recon - w))
+    assert err.max() <= float(scale.max()) * 0.5 + 1e-6
+
+
+def test_quantized_linear_close_to_float(rng):
+    layer = nn.Linear(32, 16)
+    params, state, _ = layer.build(rng, (4, 32))
+    x = jax.random.normal(jax.random.fold_in(rng, 1), (4, 32))
+    want, _ = layer.apply(params, state, x)
+    qlayer, qparams = nn.QuantizedLinear.from_float(layer, params)
+    got, _ = qlayer.apply(qparams, {}, x)
+    rel = float(jnp.linalg.norm(got - want) / jnp.linalg.norm(want))
+    assert rel < 0.02, rel
+
+
+def test_quantized_conv_close_to_float(rng):
+    layer = nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1)
+    params, state, _ = layer.build(rng, (2, 8, 8, 3))
+    x = jax.random.normal(jax.random.fold_in(rng, 1), (2, 8, 8, 3))
+    want, _ = layer.apply(params, state, x)
+    qlayer, qparams = nn.QuantizedSpatialConvolution.from_float(layer, params)
+    got, _ = qlayer.apply(qparams, {}, x)
+    assert got.shape == want.shape
+    rel = float(jnp.linalg.norm(got - want) / jnp.linalg.norm(want))
+    assert rel < 0.03, rel
+
+
+def test_quantize_walks_sequential(rng):
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1), nn.ReLU(),
+        nn.Flatten(), nn.Linear(4 * 6 * 6, 10), nn.LogSoftMax())
+    params, state, _ = model.build(rng, (2, 6, 6, 3))
+    qmodel, qparams = nn.quantize(model, params)
+    kinds = [type(m).__name__ for m in qmodel.children.values()]
+    assert kinds == ["QuantizedSpatialConvolution", "ReLU", "Flatten",
+                     "QuantizedLinear", "LogSoftMax"]
+    # original model untouched
+    assert type(model[0]).__name__ == "SpatialConvolution"
+    x = jax.random.normal(jax.random.fold_in(rng, 1), (2, 6, 6, 3))
+    want, _ = model.apply(params, state, x)
+    got, _ = qmodel.apply(qparams, state, x)
+    assert got.shape == want.shape
+    # predictions agree (log-softmax argmax robust to small error)
+    np.testing.assert_array_equal(np.argmax(np.asarray(got), -1),
+                                  np.argmax(np.asarray(want), -1))
+
+
+def test_quantize_walks_graph(rng):
+    inp = nn.Input()
+    h = nn.Linear(8, 16)(inp)
+    h2 = nn.ReLU()(h)
+    out = nn.Linear(16, 4)(h2)
+    model = nn.Graph(inp, out)
+    params, state, _ = model.build(rng, (3, 8))
+    qmodel, qparams = nn.quantize(model, params)
+    q_kinds = {type(m).__name__ for m in qmodel.children.values()}
+    assert "QuantizedLinear" in q_kinds and "Linear" not in q_kinds
+    x = jax.random.normal(jax.random.fold_in(rng, 1), (3, 8))
+    want, _ = model.apply(params, state, x)
+    got, _ = qmodel.apply(qparams, state, x)
+    rel = float(jnp.linalg.norm(got - want) / jnp.linalg.norm(want))
+    assert rel < 0.05, rel
+
+
+def test_quantized_model_accuracy_end_to_end(rng):
+    """Train a small classifier, quantize, verify accuracy holds (the
+    reference's Quantization integration test shape)."""
+    from bigdl_tpu.optim import Adam
+
+    rs = np.random.RandomState(0)
+    centers = rs.randn(3, 8) * 3
+    y = rs.randint(0, 3, 256)
+    x = jnp.asarray((centers[y] + rs.randn(256, 8)).astype(np.float32))
+    yj = jnp.asarray(y)
+
+    model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 3),
+                          nn.LogSoftMax())
+    params, state, _ = model.build(rng, (256, 8))
+    crit = nn.ClassNLLCriterion()
+    optim = Adam(1e-2)
+    opt_state = optim.init(params)
+
+    @jax.jit
+    def step(p, o):
+        loss, g = jax.value_and_grad(
+            lambda pp: crit.forward(model.apply(pp, state, x)[0], yj))(p)
+        p, o = optim.step(g, p, o)
+        return p, o, loss
+
+    for _ in range(60):
+        params, opt_state, _ = step(params, opt_state)
+
+    def acc(m, p):
+        out, _ = m.apply(p, state, x)
+        return float(jnp.mean(jnp.argmax(out, -1) == yj))
+
+    float_acc = acc(model, params)
+    qmodel, qparams = nn.quantize(model, params)
+    q_acc = acc(qmodel, qparams)
+    assert float_acc > 0.9
+    assert q_acc >= float_acc - 0.02, (float_acc, q_acc)
+
+
+def test_quantized_int8_params_are_small(rng):
+    layer = nn.Linear(128, 64)
+    params, _, _ = layer.build(rng, (1, 128))
+    _, qparams = nn.QuantizedLinear.from_float(layer, params)
+    assert qparams["weight_q"].dtype == jnp.int8
+    float_bytes = np.asarray(params["weight"]).nbytes
+    q_bytes = np.asarray(qparams["weight_q"]).nbytes
+    assert q_bytes * 4 == float_bytes

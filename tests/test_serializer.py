@@ -128,6 +128,12 @@ EXEMPLARS = {
     "TransformerLM": (lambda: _transformer_lm(),
                       lambda: jnp.asarray(
                           np.random.RandomState(3).randint(0, 20, (2, 6)))),
+    "QuantizedLinear": (lambda: nn.QuantizedLinear(4, 3), lambda: rand(2, 4)),
+    "QuantizedSpatialConvolution": (
+        lambda: nn.QuantizedSpatialConvolution(
+            dict(n_input=3, n_output=4, kernel=(3, 3), stride=(1, 1),
+                 pad=(1, 1), n_group=1, with_bias=True, dilation=(1, 1))),
+        lambda: rand(2, 5, 5, 3)),
     "SpatialConvolution": (lambda: nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1),
                            lambda: rand(2, 5, 5, 3)),
     "SpatialCrossMapLRN": (lambda: nn.SpatialCrossMapLRN(5, 1.0, 0.75),
@@ -217,7 +223,54 @@ CRITERION_EXEMPLARS = {
                                 "onehot"),
 }
 
-EXCLUDED = {"Module", "Container", "Criterion", "keras.KerasLayer"}
+EXCLUDED = {"Module", "Container", "Criterion", "keras.KerasLayer",
+            "ops.Operation",  # abstract base
+            # WhileLoop holds an arbitrary python cond_fn — users register
+            # custom callables via serializer.register_fn to persist it
+            "ops.WhileLoop"}
+
+# Forward-only op zoo: spec-only roundtrips (semantics covered in
+# tests/test_ops.py; several take host string arrays, not jax inputs)
+OPS_EXEMPLARS = {
+    "ops.All": lambda: nn.ops.All(axis=1),
+    "ops.Any": lambda: nn.ops.Any(axis=0, keep_dims=True),
+    "ops.ArgMax": lambda: nn.ops.ArgMax(1),
+    "ops.Cast": lambda: nn.ops.Cast("int32"),
+    "ops.CategoricalColHashBucket": lambda: nn.ops.CategoricalColHashBucket(64),
+    "ops.Cond": lambda: nn.ops.Cond(nn.Linear(3, 3), nn.Identity()),
+    "ops.CrossCol": lambda: nn.ops.CrossCol(128),
+    "ops.Equal": lambda: nn.ops.Equal(),
+    "ops.FloorDiv": lambda: nn.ops.FloorDiv(),
+    "ops.Gather": lambda: nn.ops.Gather(1),
+    "ops.Greater": lambda: nn.ops.Greater(),
+    "ops.GreaterEqual": lambda: nn.ops.GreaterEqual(),
+    "ops.InTopK": lambda: nn.ops.InTopK(5),
+    "ops.IndicatorCol": lambda: nn.ops.IndicatorCol(10),
+    "ops.Kv2Tensor": lambda: nn.ops.Kv2Tensor(feature_num=8),
+    "ops.Less": lambda: nn.ops.Less(),
+    "ops.LessEqual": lambda: nn.ops.LessEqual(),
+    "ops.LogicalAnd": lambda: nn.ops.LogicalAnd(),
+    "ops.LogicalNot": lambda: nn.ops.LogicalNot(),
+    "ops.LogicalOr": lambda: nn.ops.LogicalOr(),
+    "ops.Maximum": lambda: nn.ops.Maximum(),
+    "ops.Minimum": lambda: nn.ops.Minimum(),
+    "ops.MkString": lambda: nn.ops.MkString(";"),
+    "ops.Mod": lambda: nn.ops.Mod(),
+    "ops.NotEqual": lambda: nn.ops.NotEqual(),
+    "ops.OneHot": lambda: nn.ops.OneHot(7, 2.0, -1.0),
+    "ops.Pad": lambda: nn.ops.Pad([(1, 2)], 4.0),
+    "ops.RandomUniformOp": lambda: nn.ops.RandomUniformOp(0.0, 2.0, seed=3),
+    "ops.Rank": lambda: nn.ops.Rank(),
+    "ops.SelectOp": lambda: nn.ops.SelectOp(),
+    "ops.ShapeOp": lambda: nn.ops.ShapeOp(),
+    "ops.Sign": lambda: nn.ops.Sign(),
+    "ops.Slice": lambda: nn.ops.Slice([0, 1], [2, -1]),
+    "ops.SquaredDifference": lambda: nn.ops.SquaredDifference(),
+    "ops.StridedSlice": lambda: nn.ops.StridedSlice([(None, None, 2)]),
+    "ops.Tile": lambda: nn.ops.Tile([2, 1]),
+    "ops.TopK": lambda: nn.ops.TopK(3),
+}
+EXEMPLARS.update({k: (v, None) for k, v in OPS_EXEMPLARS.items()})
 
 
 def _registered_modules():
