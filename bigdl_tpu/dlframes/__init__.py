@@ -1,0 +1,19 @@
+"""DataFrame ML-pipeline integration.
+
+Reference: dlframes/ — `DLEstimator`/`DLModel`/`DLClassifier`/
+`DLClassifierModel` wrap the Optimizer as a Spark-ML Estimator/Transformer
+over DataFrame columns (dlframes/DLEstimator.scala), plus
+`DLImageTransformer` for image DataFrames.
+
+TPU-native redesign: there is no Spark on the TPU host; the DataFrame of
+record is pandas.  The Estimator/Model split and the column-oriented
+fit/transform contract are preserved so pipeline code ports 1:1.
+"""
+
+from bigdl_tpu.dlframes.estimator import (
+    DLEstimator,
+    DLModel,
+    DLClassifier,
+    DLClassifierModel,
+    DLImageTransformer,
+)

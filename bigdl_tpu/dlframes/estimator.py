@@ -1,0 +1,186 @@
+"""DLEstimator / DLModel / DLClassifier over pandas DataFrames.
+
+Reference: dlframes/DLEstimator.scala — an Estimator whose `fit` trains the
+wrapped module with the builder-configured Optimizer over (features, label)
+columns and returns a Transformer (`DLModel`) adding a prediction column;
+`DLClassifier` specializes to class labels + argmax predictions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+import bigdl_tpu.nn as nn_mod
+from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.dataset.minibatch import MiniBatch
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.optim import Optimizer, Trigger
+from bigdl_tpu.optim.optim_method import Adam, OptimMethod
+
+
+def _column_to_array(col, size: Sequence[int]) -> np.ndarray:
+    rows = [np.asarray(v, np.float32).reshape(size) for v in col]
+    return np.stack(rows)
+
+
+class _FrameDataSet(DataSet):
+    def __init__(self, x: np.ndarray, y: np.ndarray, batch_size: int):
+        self.x, self.y = x, y
+        self.batch_size = batch_size
+        self._epoch = 0
+
+    def size(self) -> int:
+        return self.x.shape[0]
+
+    def data(self, train: bool):
+        n = (self.x.shape[0] // self.batch_size) * self.batch_size
+        idx = np.arange(self.x.shape[0])
+        if train:
+            idx = np.random.RandomState(17 + self._epoch).permutation(idx)
+            self._epoch += 1
+        for off in range(0, n, self.batch_size):
+            sel = idx[off:off + self.batch_size]
+            yield MiniBatch(self.x[sel], self.y[sel])
+
+
+class DLEstimator:
+    """reference: dlframes/DLEstimator.scala — builder config + fit()."""
+
+    def __init__(self, model: Module, criterion, feature_size: Sequence[int],
+                 label_size: Sequence[int]):
+        self.model = model
+        self.criterion = criterion
+        self.feature_size = tuple(feature_size)
+        self.label_size = tuple(label_size)
+        self.batch_size = 32
+        self.max_epoch = 10
+        self.optim_method: OptimMethod = Adam()
+        self.features_col = "features"
+        self.label_col = "label"
+        self.prediction_col = "prediction"
+
+    # builder API (reference setters)
+    def set_batch_size(self, v: int) -> "DLEstimator":
+        self.batch_size = v
+        return self
+
+    def set_max_epoch(self, v: int) -> "DLEstimator":
+        self.max_epoch = v
+        return self
+
+    def set_optim_method(self, m: OptimMethod) -> "DLEstimator":
+        self.optim_method = m
+        return self
+
+    def set_features_col(self, c: str) -> "DLEstimator":
+        self.features_col = c
+        return self
+
+    def set_label_col(self, c: str) -> "DLEstimator":
+        self.label_col = c
+        return self
+
+    def set_prediction_col(self, c: str) -> "DLEstimator":
+        self.prediction_col = c
+        return self
+
+    def _label_array(self, df) -> np.ndarray:
+        return _column_to_array(df[self.label_col], self.label_size)
+
+    def fit(self, df) -> "DLModel":
+        x = _column_to_array(df[self.features_col], self.feature_size)
+        y = self._label_array(df)
+        if x.shape[0] < self.batch_size:
+            self.batch_size = x.shape[0]
+        opt = Optimizer(model=self.model, dataset=_FrameDataSet(x, y, self.batch_size),
+                        criterion=self.criterion,
+                        end_trigger=Trigger.max_epoch(self.max_epoch))
+        opt.set_optim_method(self.optim_method)
+        opt.optimize()
+        return self._make_model()
+
+    def _make_model(self) -> "DLModel":
+        m = DLModel(self.model, self.feature_size)
+        m.features_col = self.features_col
+        m.prediction_col = self.prediction_col
+        m.batch_size = self.batch_size
+        return m
+
+
+class DLModel:
+    """Transformer: adds a prediction column.
+    reference: dlframes/DLEstimator.scala DLModel."""
+
+    def __init__(self, model: Module, feature_size: Sequence[int]):
+        self.model = model
+        self.feature_size = tuple(feature_size)
+        self.features_col = "features"
+        self.prediction_col = "prediction"
+        self.batch_size = 32
+
+    def _forward(self, df) -> np.ndarray:
+        from bigdl_tpu.optim import Predictor
+
+        x = _column_to_array(df[self.features_col], self.feature_size)
+        pred = Predictor(self.model, self.model.params, self.model.state,
+                         batch_size=min(self.batch_size, x.shape[0]))
+        return np.asarray(pred.predict(x))
+
+    def transform(self, df):
+        out = df.copy()
+        preds = self._forward(df)
+        out[self.prediction_col] = [row for row in preds]
+        return out
+
+
+class DLClassifier(DLEstimator):
+    """Class-index labels; predictions are argmax class ids (1-based in the
+    reference's Spark-ML convention — 0-based here, documented delta).
+    reference: dlframes/DLClassifier.scala."""
+
+    def __init__(self, model: Module, criterion, feature_size: Sequence[int]):
+        super().__init__(model, criterion, feature_size, (1,))
+
+    def _label_array(self, df) -> np.ndarray:
+        return np.asarray(df[self.label_col], np.int32)
+
+    def _make_model(self) -> "DLClassifierModel":
+        m = DLClassifierModel(self.model, self.feature_size)
+        m.features_col = self.features_col
+        m.prediction_col = self.prediction_col
+        m.batch_size = self.batch_size
+        return m
+
+
+class DLClassifierModel(DLModel):
+    def transform(self, df):
+        out = df.copy()
+        preds = self._forward(df)
+        out[self.prediction_col] = np.argmax(preds, axis=-1)
+        return out
+
+
+class DLImageTransformer:
+    """Apply a vision FeatureTransformer to an image column.
+    reference: dlframes/DLImageTransformer.scala."""
+
+    def __init__(self, transformer, image_col: str = "image",
+                 output_col: str = "output"):
+        self.transformer = transformer
+        self.image_col = image_col
+        self.output_col = output_col
+
+    def transform(self, df):
+        from bigdl_tpu.vision import ImageFeature
+
+        out = df.copy()
+        results = []
+        for img in df[self.image_col]:
+            feat = self.transformer(ImageFeature(np.asarray(img, np.float32)))
+            results.append(feat.image)
+        out[self.output_col] = results
+        return out

@@ -1,0 +1,333 @@
+"""Model interop: PyTorch state-dict import/export, Keras weight import,
+ConvertModel CLI.
+
+Reference: the interop layer (survey §2.6) — Caffe import/export
+(utils/caffe/CaffeLoader.scala), Torch .t7 (utils/TorchFile.scala), TF
+GraphDef import (utils/tf/TensorflowLoader.scala), Keras 1.2.2 weight
+conversion (pyspark/bigdl/keras/converter.py), and the `ConvertModel` CLI
+(utils/ConvertModel.scala).
+
+TPU-native redesign: the ecosystem's lingua franca today is the PyTorch
+state dict, so that is the first-class import/export path (torch CPU is in
+the image); Keras weights import accepts per-layer weight lists
+(`layer.get_weights()` order).  The Torch7 `.t7` and Caffe binary formats
+are legacy-dead — their role (bringing pretrained weights in) is covered
+by these converters plus the native save_model format.
+
+Layout conversions (ours -> theirs):
+  Linear      (in, out)        <-> torch (out, in)            [transpose]
+  Conv2d HWIO (kh, kw, in, out)<-> torch OIHW (out, in, kh, kw)
+  BatchNorm   weight/bias + running stats map 1:1
+  LSTM        packed (in, 4h) gates i,f,g,o  <-> torch weight_ih/hh_l0
+  GRU         packed (in, 3h) gates r,z,n    <-> torch (b_hn must be 0)
+  LookupTable (vocab, dim) 1:1
+"""
+
+from __future__ import annotations
+
+import argparse
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.conv import SpatialConvolution
+from bigdl_tpu.nn.embedding import LookupTable
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.module import Container, Module
+from bigdl_tpu.nn.norm import BatchNormalization
+from bigdl_tpu.nn.recurrent import GRUCell, LSTMCell, Recurrent
+
+
+def _np(x) -> np.ndarray:
+    return x.detach().cpu().numpy() if hasattr(x, "detach") else np.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# per-layer converters: (our module, torch param-group dict) -> params/state
+# ---------------------------------------------------------------------------
+
+
+def _import_linear(m: Linear, g: Dict[str, np.ndarray]):
+    params = {"weight": jnp.asarray(_np(g["weight"]).T)}
+    if m.with_bias and "bias" in g:
+        params["bias"] = jnp.asarray(_np(g["bias"]))
+    return params, {}
+
+
+def _import_conv(m: SpatialConvolution, g: Dict[str, np.ndarray]):
+    w = _np(g["weight"])  # OIHW
+    params = {"weight": jnp.asarray(w.transpose(2, 3, 1, 0))}  # HWIO
+    if m.with_bias and "bias" in g:
+        params["bias"] = jnp.asarray(_np(g["bias"]))
+    return params, {}
+
+
+def _import_bn(m: BatchNormalization, g: Dict[str, np.ndarray]):
+    params = {}
+    if m.affine:
+        params = {"weight": jnp.asarray(_np(g["weight"])),
+                  "bias": jnp.asarray(_np(g["bias"]))}
+    state = {"running_mean": jnp.asarray(_np(g["running_mean"])),
+             "running_var": jnp.asarray(_np(g["running_var"]))}
+    return params, state
+
+
+def _import_lstm_cell(m: LSTMCell, g: Dict[str, np.ndarray]):
+    # torch packs (4h, in) in gate order i,f,g,o — identical to ours
+    w_ih = _np(g["weight_ih_l0"]).T
+    w_hh = _np(g["weight_hh_l0"]).T
+    bias = _np(g["bias_ih_l0"]) + _np(g["bias_hh_l0"])
+    return {"w_ih": jnp.asarray(w_ih), "w_hh": jnp.asarray(w_hh),
+            "bias": jnp.asarray(bias)}, {}
+
+
+def _import_gru_cell(m: GRUCell, g: Dict[str, np.ndarray]):
+    h = m.hidden_size
+    b_hh = _np(g["bias_hh_l0"])
+    if np.abs(b_hh[2 * h:]).max() > 1e-6:
+        raise ValueError(
+            "torch GRU has a nonzero hidden bias on the n-gate (b_hn); the "
+            "fused-gate GRU cell cannot represent it exactly — retrain or "
+            "zero b_hn before importing")
+    bias = _np(g["bias_ih_l0"]).copy()
+    bias[:2 * h] += b_hh[:2 * h]  # r,z hidden biases fold into the input bias
+    return {"w_ih": jnp.asarray(_np(g["weight_ih_l0"]).T),
+            "w_hh": jnp.asarray(_np(g["weight_hh_l0"]).T),
+            "bias": jnp.asarray(bias)}, {}
+
+
+def _import_embedding(m: LookupTable, g: Dict[str, np.ndarray]):
+    return {"weight": jnp.asarray(_np(g["weight"]))}, {}
+
+
+# ---------------------------------------------------------------------------
+# state-dict group walking
+# ---------------------------------------------------------------------------
+
+
+def _group_state_dict(state_dict: Dict[str, Any]) -> "OrderedDict[str, Dict[str, np.ndarray]]":
+    """Group torch keys by their layer prefix, preserving order:
+    {"0.weight": w, "0.bias": b, "2.running_mean": ...} ->
+    {"0": {"weight": w, "bias": b}, "2": {...}}.  RNN keys (weight_ih_l0)
+    keep the full suffix inside the group."""
+    groups: "OrderedDict[str, Dict[str, np.ndarray]]" = OrderedDict()
+    for key, val in state_dict.items():
+        if "." in key:
+            prefix, leaf = key.rsplit(".", 1)
+        else:
+            prefix, leaf = "", key
+        if leaf in ("num_batches_tracked",):
+            continue
+        groups.setdefault(prefix, {})[leaf] = val
+    return groups
+
+
+def _leaf_modules(module: Module) -> List[Module]:
+    """Our modules that own parameters, in execution order."""
+    out: List[Module] = []
+
+    def walk(m: Module):
+        if isinstance(m, Recurrent):
+            out.append(m.cell)
+            return
+        if isinstance(m, Container):
+            for c in m.children.values():
+                walk(c)
+            return
+        if isinstance(m, (Linear, SpatialConvolution, BatchNormalization,
+                          LookupTable, LSTMCell, GRUCell)):
+            out.append(m)
+
+    walk(module)
+    return out
+
+
+_IMPORTERS = [
+    (LSTMCell, _import_lstm_cell),
+    (GRUCell, _import_gru_cell),
+    (BatchNormalization, _import_bn),
+    (SpatialConvolution, _import_conv),
+    (Linear, _import_linear),
+    (LookupTable, _import_embedding),
+]
+
+
+def _importer_for(m: Module):
+    for cls, fn in _IMPORTERS:
+        if isinstance(m, cls):
+            return fn
+    raise ValueError(f"no torch importer for {type(m).__name__}")
+
+
+def import_torch_state_dict(module: Module, params: Any, state: Any,
+                            state_dict: Dict[str, Any]) -> Tuple[Any, Any]:
+    """Load a torch state dict into (params, state) built for `module`.
+
+    Matches our parameterized leaves (execution order) against the state
+    dict's layer groups (insertion order) — the positional discipline the
+    reference's Keras converter uses (pyspark/bigdl/keras/converter.py).
+    Returns NEW params/state trees; inputs are not mutated.
+    """
+    groups = list(_group_state_dict(state_dict).values())
+    leaves = _leaf_modules(module)
+    if len(groups) != len(leaves):
+        raise ValueError(
+            f"layer count mismatch: our model has {len(leaves)} parameterized "
+            f"layers, torch state dict has {len(groups)} groups")
+
+    converted = {id(m): _importer_for(m)(m, g) for m, g in zip(leaves, groups)}
+
+    def rebuild(m: Module, p: Any, s: Any) -> Tuple[Any, Any]:
+        if isinstance(m, Recurrent):
+            cp, cs = converted[id(m.cell)]
+            # Recurrent nests the cell's params under "cell"
+            new_p = dict(p)
+            new_p["cell"] = cp
+            return new_p, s
+        if isinstance(m, Container):
+            new_p, new_s = dict(p), dict(s)
+            for key, c in m.children.items():
+                new_p[key], new_s[key] = rebuild(c, p.get(key, {}), s.get(key, {}))
+            return new_p, new_s
+        if id(m) in converted:
+            cp, cs = converted[id(m)]
+            merged_p = dict(p) if isinstance(p, dict) else {}
+            merged_p.update(cp)
+            merged_s = dict(s) if isinstance(s, dict) else {}
+            merged_s.update(cs)
+            return merged_p, merged_s
+        return p, s
+
+    return rebuild(module, params, state)
+
+
+# ---------------------------------------------------------------------------
+# export
+# ---------------------------------------------------------------------------
+
+
+def export_torch_state_dict(module: Module, params: Any, state: Any
+                            ) -> "OrderedDict[str, np.ndarray]":
+    """Produce a torch-layout state dict (numpy values) for our model —
+    loadable into an equivalent torch.nn.Sequential via load_state_dict
+    (after tensor conversion)."""
+    out: "OrderedDict[str, np.ndarray]" = OrderedDict()
+
+    def emit(m: Module, p: Any, s: Any, prefix: str):
+        if isinstance(m, Recurrent):
+            emit(m.cell, p.get("cell", {}), {}, prefix)
+            return
+        if isinstance(m, Container):
+            for key, c in m.children.items():
+                emit(c, p.get(key, {}), s.get(key, {}) if isinstance(s, dict) else {},
+                     f"{prefix}{key}.")
+            return
+        if isinstance(m, (LSTMCell, GRUCell)):
+            out[f"{prefix}weight_ih_l0"] = np.asarray(p["w_ih"]).T
+            out[f"{prefix}weight_hh_l0"] = np.asarray(p["w_hh"]).T
+            out[f"{prefix}bias_ih_l0"] = np.asarray(p["bias"])
+            out[f"{prefix}bias_hh_l0"] = np.zeros_like(np.asarray(p["bias"]))
+            return
+        if isinstance(m, BatchNormalization):
+            if m.affine:
+                out[f"{prefix}weight"] = np.asarray(p["weight"])
+                out[f"{prefix}bias"] = np.asarray(p["bias"])
+            out[f"{prefix}running_mean"] = np.asarray(s["running_mean"])
+            out[f"{prefix}running_var"] = np.asarray(s["running_var"])
+            return
+        if isinstance(m, SpatialConvolution):
+            out[f"{prefix}weight"] = np.asarray(p["weight"]).transpose(3, 2, 0, 1)
+            if m.with_bias:
+                out[f"{prefix}bias"] = np.asarray(p["bias"])
+            return
+        if isinstance(m, Linear):
+            out[f"{prefix}weight"] = np.asarray(p["weight"]).T
+            if m.with_bias:
+                out[f"{prefix}bias"] = np.asarray(p["bias"])
+            return
+        if isinstance(m, LookupTable):
+            out[f"{prefix}weight"] = np.asarray(p["weight"])
+            return
+
+    emit(module, params, state, "")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Keras weight import (reference: pyspark/bigdl/keras/converter.py — here
+# from layer.get_weights() lists rather than HDF5 internals)
+# ---------------------------------------------------------------------------
+
+
+def import_keras_weights(module: Module, params: Any, state: Any,
+                         layer_weights: Sequence[Sequence[np.ndarray]]
+                         ) -> Tuple[Any, Any]:
+    """Load Keras `get_weights()` lists (per parameterized layer, in order).
+    Keras Dense keeps (in, out) — our layout; Conv2D ('tf' dim ordering)
+    keeps HWIO — our layout; BatchNorm is [gamma, beta, mean, var]."""
+    sd: "OrderedDict[str, Any]" = OrderedDict()
+    leaves = _leaf_modules(module)
+    if len(layer_weights) != len(leaves):
+        raise ValueError(f"{len(leaves)} parameterized layers vs "
+                         f"{len(layer_weights)} keras weight lists")
+    for i, (m, ws) in enumerate(zip(leaves, layer_weights)):
+        if isinstance(m, BatchNormalization):
+            sd[f"{i}.weight"], sd[f"{i}.bias"] = ws[0], ws[1]
+            sd[f"{i}.running_mean"], sd[f"{i}.running_var"] = ws[2], ws[3]
+        elif isinstance(m, SpatialConvolution):
+            sd[f"{i}.weight"] = np.asarray(ws[0]).transpose(3, 2, 0, 1)  # ->OIHW
+            if len(ws) > 1:
+                sd[f"{i}.bias"] = ws[1]
+        elif isinstance(m, Linear):
+            sd[f"{i}.weight"] = np.asarray(ws[0]).T  # (in,out) -> torch (out,in)
+            if len(ws) > 1:
+                sd[f"{i}.bias"] = ws[1]
+        elif isinstance(m, LookupTable):
+            sd[f"{i}.weight"] = ws[0]
+        else:
+            raise ValueError(f"no keras importer for {type(m).__name__}")
+    return import_torch_state_dict(module, params, state, sd)
+
+
+# ---------------------------------------------------------------------------
+# ConvertModel CLI (reference: utils/ConvertModel.scala)
+# ---------------------------------------------------------------------------
+
+
+def convert_model(args: Optional[Sequence[str]] = None) -> None:
+    """Convert between the native model dir format and torch .pt files."""
+    import jax
+
+    from bigdl_tpu.utils import serializer as ser
+
+    p = argparse.ArgumentParser("ConvertModel")
+    p.add_argument("--from", dest="src", required=True)
+    p.add_argument("--to", dest="dst", required=True)
+    p.add_argument("--input-shape", dest="shape", required=True,
+                   help="comma-separated build shape, e.g. 8,28,28,1")
+    ns = p.parse_args(args)
+    shape = tuple(int(s) for s in ns.shape.split(","))
+
+    import torch
+
+    if ns.src.endswith(".pt"):
+        raise SystemExit("importing a bare .pt needs the model spec; save the "
+                         "model with save_model and use --from <dir>")
+    module, params, state = ser.load_model(ns.src)
+    if params is None:
+        params, state, _ = module.build(jax.random.PRNGKey(0), shape)
+    if ns.dst.endswith(".pt"):
+        sd = export_torch_state_dict(module, params, state)
+        torch.save({k: torch.from_numpy(np.ascontiguousarray(v))
+                    for k, v in sd.items()}, ns.dst)
+        print(f"wrote torch state dict ({len(sd)} tensors) to {ns.dst}")
+    else:
+        ser.save_model(ns.dst, module, params, state)
+        print(f"wrote native model to {ns.dst}")
+
+
+if __name__ == "__main__":
+    convert_model()

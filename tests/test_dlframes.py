@@ -1,0 +1,67 @@
+"""DataFrame pipeline tests (reference: dlframes/ DLEstimator/DLClassifier
+specs): fit over feature/label columns, transform adds predictions, image
+column transformation."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dlframes import (
+    DLClassifier,
+    DLEstimator,
+    DLImageTransformer,
+)
+
+
+def _class_df(n=128, d=8, classes=3, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(classes, d) * 3
+    y = rs.randint(0, classes, n)
+    x = centers[y] + rs.randn(n, d)
+    return pd.DataFrame({"features": [row.astype(np.float32) for row in x],
+                         "label": y})
+
+
+def test_classifier_fit_transform():
+    df = _class_df()
+    model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 3),
+                          nn.LogSoftMax())
+    est = (DLClassifier(model, nn.ClassNLLCriterion(), [8])
+           .set_batch_size(32).set_max_epoch(20))
+    fitted = est.fit(df)
+    out = fitted.transform(df)
+    assert "prediction" in out.columns
+    acc = float(np.mean(out["prediction"].to_numpy() == df["label"].to_numpy()))
+    assert acc > 0.9, acc
+
+
+def test_estimator_regression():
+    rs = np.random.RandomState(1)
+    x = rs.randn(96, 4).astype(np.float32)
+    w = rs.randn(4, 2).astype(np.float32)
+    y = x @ w
+    df = pd.DataFrame({"feat": [r for r in x], "target": [r for r in y]})
+    model = nn.Sequential(nn.Linear(4, 2))
+    est = (DLEstimator(model, nn.MSECriterion(), [4], [2])
+           .set_batch_size(32).set_max_epoch(60)
+           .set_features_col("feat").set_label_col("target")
+           .set_prediction_col("pred"))
+    fitted = est.fit(df)
+    out = fitted.transform(df)
+    pred = np.stack(out["pred"].to_list())
+    rel = np.linalg.norm(pred - y) / np.linalg.norm(y)
+    assert rel < 0.1, rel
+
+
+def test_image_transformer():
+    import bigdl_tpu.vision as V
+
+    rs = np.random.RandomState(0)
+    imgs = [rs.rand(10, 10, 3).astype(np.float32) for _ in range(4)]
+    df = pd.DataFrame({"image": imgs})
+    t = V.ResizeTo(6, 6) >> V.ChannelNormalize((0.5,) * 3, (0.5,) * 3)
+    out = DLImageTransformer(t).transform(df)
+    assert out["output"][0].shape == (6, 6, 3)
+    # original column untouched
+    assert out["image"][0].shape == (10, 10, 3)

@@ -1,0 +1,154 @@
+"""Interop tests: import real torch modules' weights and match their
+forward outputs (the rebuild's analogue of the reference's Torch-oracle
+differential tests, survey §4), roundtrip export, Keras weight lists,
+ConvertModel CLI."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils import interop
+from bigdl_tpu.utils import serializer as ser
+
+torch = pytest.importorskip("torch")
+
+
+def _import_from_torch(model, our, shape, seed=0):
+    params, state, _ = our.build(jax.random.PRNGKey(seed), shape)
+    return interop.import_torch_state_dict(our, params, state,
+                                           model.state_dict())
+
+
+def test_import_mlp_matches_torch():
+    tm = torch.nn.Sequential(
+        torch.nn.Linear(8, 16), torch.nn.ReLU(), torch.nn.Linear(16, 4))
+    our = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    params, state = _import_from_torch(tm, our, (2, 8))
+    x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    want = tm(torch.from_numpy(x)).detach().numpy()
+    got, _ = our.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_import_convnet_matches_torch():
+    tm = torch.nn.Sequential(
+        torch.nn.Conv2d(3, 6, 3, stride=1, padding=1),
+        torch.nn.BatchNorm2d(6),
+        torch.nn.ReLU(),
+        torch.nn.Conv2d(6, 4, 3),
+    ).eval()
+    # put nontrivial running stats into BN
+    with torch.no_grad():
+        tm[1].running_mean.uniform_(-0.5, 0.5)
+        tm[1].running_var.uniform_(0.5, 1.5)
+    our = nn.Sequential(
+        nn.SpatialConvolution(3, 6, 3, 3, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(6),
+        nn.ReLU(),
+        nn.SpatialConvolution(6, 4, 3, 3),
+    )
+    params, state = _import_from_torch(tm, our, (2, 7, 7, 3))
+    x = np.random.RandomState(1).randn(2, 7, 7, 3).astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    got, _ = our.apply(params, state, jnp.asarray(x), training=False)
+    np.testing.assert_allclose(np.asarray(got).transpose(0, 3, 1, 2), want,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_import_lstm_matches_torch():
+    t, b, f, h = 5, 3, 4, 6
+    tm = torch.nn.LSTM(f, h, batch_first=True)
+    our = nn.LSTM(f, h)
+    params, state, _ = our.build(jax.random.PRNGKey(0), (b, t, f))
+    params, state = interop.import_torch_state_dict(our, params, state,
+                                                    tm.state_dict())
+    x = np.random.RandomState(2).randn(b, t, f).astype(np.float32)
+    with torch.no_grad():
+        want, _ = tm(torch.from_numpy(x))
+    got, _ = our.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_import_gru_matches_torch_when_bhn_zero():
+    t, b, f, h = 4, 2, 3, 5
+    tm = torch.nn.GRU(f, h, batch_first=True)
+    with torch.no_grad():
+        tm.bias_hh_l0[2 * h:] = 0.0  # the representable case
+    our = nn.GRU(f, h)
+    params, state, _ = our.build(jax.random.PRNGKey(0), (b, t, f))
+    params, state = interop.import_torch_state_dict(our, params, state,
+                                                    tm.state_dict())
+    x = np.random.RandomState(3).randn(b, t, f).astype(np.float32)
+    with torch.no_grad():
+        want, _ = tm(torch.from_numpy(x))
+    got, _ = our.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_import_gru_rejects_nonzero_bhn():
+    tm = torch.nn.GRU(3, 5, batch_first=True)
+    with torch.no_grad():
+        tm.bias_hh_l0.fill_(0.3)
+    our = nn.GRU(3, 5)
+    params, state, _ = our.build(jax.random.PRNGKey(0), (2, 4, 3))
+    with pytest.raises(ValueError, match="b_hn"):
+        interop.import_torch_state_dict(our, params, state, tm.state_dict())
+
+
+def test_export_roundtrip():
+    our = nn.Sequential(nn.Linear(6, 8), nn.ReLU(),
+                        nn.SpatialConvolution(2, 3, 3, 3))
+    # conv on (N,H,W,2) after reshape is artificial; test layout fidelity only
+    our = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 2))
+    params, state, _ = our.build(jax.random.PRNGKey(0), (2, 6))
+    sd = interop.export_torch_state_dict(our, params, state)
+    params2, state2 = interop.import_torch_state_dict(our, params, state, sd)
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(params2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    # and torch itself accepts the export
+    tm = torch.nn.Sequential(torch.nn.Linear(6, 8), torch.nn.ReLU(),
+                             torch.nn.Linear(8, 2))
+    tm.load_state_dict({k: torch.from_numpy(np.ascontiguousarray(v))
+                        for k, v in sd.items()})
+    x = np.random.RandomState(0).randn(2, 6).astype(np.float32)
+    want = tm(torch.from_numpy(x)).detach().numpy()
+    got, _ = our.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_import_keras_weight_lists():
+    our = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    params, state, _ = our.build(jax.random.PRNGKey(0), (2, 4))
+    rs = np.random.RandomState(0)
+    # keras Dense: [W (in,out), b]
+    lw = [[rs.randn(4, 8).astype(np.float32), rs.randn(8).astype(np.float32)],
+          [rs.randn(8, 3).astype(np.float32), rs.randn(3).astype(np.float32)]]
+    params, state = interop.import_keras_weights(our, params, state, lw)
+    np.testing.assert_allclose(np.asarray(params["0"]["weight"]), lw[0][0])
+    np.testing.assert_allclose(np.asarray(params["2"]["bias"]), lw[1][1])
+
+
+def test_layer_count_mismatch_raises():
+    our = nn.Sequential(nn.Linear(4, 8))
+    params, state, _ = our.build(jax.random.PRNGKey(0), (2, 4))
+    tm = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.Linear(8, 2))
+    with pytest.raises(ValueError, match="mismatch"):
+        interop.import_torch_state_dict(our, params, state, tm.state_dict())
+
+
+def test_convert_model_cli(tmp_path):
+    model = nn.Sequential(nn.Linear(5, 3), nn.ReLU())
+    params, state, _ = model.build(jax.random.PRNGKey(0), (2, 5))
+    src = str(tmp_path / "native_model")
+    ser.save_model(src, model, params, state)
+    dst = str(tmp_path / "model.pt")
+    interop.convert_model(["--from", src, "--to", dst, "--input-shape", "2,5"])
+    sd = torch.load(dst)
+    assert "0.weight" in sd and tuple(sd["0.weight"].shape) == (3, 5)
+    np.testing.assert_allclose(sd["0.weight"].numpy(),
+                               np.asarray(params["0"]["weight"]).T, rtol=1e-6)
