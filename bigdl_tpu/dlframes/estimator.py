@@ -8,13 +8,11 @@ columns and returns a Transformer (`DLModel`) adding a prediction column;
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Tuple
+from typing import Sequence
 
 import numpy as np
 
-import jax
-
-import bigdl_tpu.nn as nn_mod
+from bigdl_tpu.core.random import RandomGenerator
 from bigdl_tpu.dataset.dataset import DataSet
 from bigdl_tpu.dataset.minibatch import MiniBatch
 from bigdl_tpu.nn.module import Module
@@ -40,7 +38,8 @@ class _FrameDataSet(DataSet):
         n = (self.x.shape[0] // self.batch_size) * self.batch_size
         idx = np.arange(self.x.shape[0])
         if train:
-            idx = np.random.RandomState(17 + self._epoch).permutation(idx)
+            rs = np.random.RandomState(RandomGenerator.get_seed() + self._epoch)
+            idx = rs.permutation(idx)
             self._epoch += 1
         for off in range(0, n, self.batch_size):
             sel = idx[off:off + self.batch_size]
@@ -92,22 +91,23 @@ class DLEstimator:
         return _column_to_array(df[self.label_col], self.label_size)
 
     def fit(self, df) -> "DLModel":
+        if len(df) == 0:
+            raise ValueError("cannot fit on an empty DataFrame")
         x = _column_to_array(df[self.features_col], self.feature_size)
         y = self._label_array(df)
-        if x.shape[0] < self.batch_size:
-            self.batch_size = x.shape[0]
-        opt = Optimizer(model=self.model, dataset=_FrameDataSet(x, y, self.batch_size),
+        batch_size = min(self.batch_size, x.shape[0])
+        opt = Optimizer(model=self.model, dataset=_FrameDataSet(x, y, batch_size),
                         criterion=self.criterion,
                         end_trigger=Trigger.max_epoch(self.max_epoch))
         opt.set_optim_method(self.optim_method)
         opt.optimize()
-        return self._make_model()
+        return self._make_model(batch_size)
 
-    def _make_model(self) -> "DLModel":
+    def _make_model(self, batch_size: int) -> "DLModel":
         m = DLModel(self.model, self.feature_size)
         m.features_col = self.features_col
         m.prediction_col = self.prediction_col
-        m.batch_size = self.batch_size
+        m.batch_size = batch_size
         return m
 
 
@@ -121,14 +121,26 @@ class DLModel:
         self.features_col = "features"
         self.prediction_col = "prediction"
         self.batch_size = 32
+        self._predictor = None
+        self._predictor_batch = None
+        self._predictor_params = None
 
     def _forward(self, df) -> np.ndarray:
         from bigdl_tpu.optim import Predictor
 
+        if len(df) == 0:
+            raise ValueError("cannot transform an empty DataFrame")
         x = _column_to_array(df[self.features_col], self.feature_size)
-        pred = Predictor(self.model, self.model.params, self.model.state,
-                         batch_size=min(self.batch_size, x.shape[0]))
-        return np.asarray(pred.predict(x))
+        batch = min(self.batch_size, x.shape[0])
+        # cache keyed on (batch, params identity): retraining the shared
+        # Module swaps model.params, which must invalidate the jitted closure
+        if (self._predictor is None or self._predictor_batch != batch
+                or self._predictor_params is not self.model.params):
+            self._predictor = Predictor(self.model, self.model.params,
+                                        self.model.state, batch_size=batch)
+            self._predictor_batch = batch
+            self._predictor_params = self.model.params
+        return np.asarray(self._predictor.predict(x))
 
     def transform(self, df):
         out = df.copy()
@@ -148,11 +160,11 @@ class DLClassifier(DLEstimator):
     def _label_array(self, df) -> np.ndarray:
         return np.asarray(df[self.label_col], np.int32)
 
-    def _make_model(self) -> "DLClassifierModel":
+    def _make_model(self, batch_size: int) -> "DLClassifierModel":
         m = DLClassifierModel(self.model, self.feature_size)
         m.features_col = self.features_col
         m.prediction_col = self.prediction_col
-        m.batch_size = self.batch_size
+        m.batch_size = batch_size
         return m
 
 

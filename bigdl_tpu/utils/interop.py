@@ -70,29 +70,54 @@ def _import_bn(m: BatchNormalization, g: Dict[str, np.ndarray]):
     if m.affine:
         params = {"weight": jnp.asarray(_np(g["weight"])),
                   "bias": jnp.asarray(_np(g["bias"]))}
+    elif "weight" in g:
+        raise ValueError(
+            "torch BatchNorm has affine weight/bias but the target "
+            "BatchNormalization(affine=False) cannot hold them — use "
+            "affine=True or strip gamma/beta before importing")
     state = {"running_mean": jnp.asarray(_np(g["running_mean"])),
              "running_var": jnp.asarray(_np(g["running_var"]))}
     return params, state
 
 
+def _check_single_layer_rnn(kind: str, g: Dict[str, np.ndarray]):
+    extra = [k for k in g if k.endswith(("_l1", "_reverse")) or "_l1_" in k]
+    if extra:
+        raise ValueError(
+            f"torch {kind} state dict has multi-layer/bidirectional keys "
+            f"{sorted(extra)[:4]} — import each layer/direction into its own "
+            f"cell; a single {kind}Cell only holds the l0 forward weights")
+
+
+def _rnn_bias(g: Dict[str, np.ndarray], rows: int) -> np.ndarray:
+    # torch bias=False RNNs omit the bias keys; our cells always carry one
+    b_ih = _np(g["bias_ih_l0"]) if "bias_ih_l0" in g else np.zeros(rows, np.float32)
+    b_hh = _np(g["bias_hh_l0"]) if "bias_hh_l0" in g else np.zeros(rows, np.float32)
+    return b_ih, b_hh
+
+
 def _import_lstm_cell(m: LSTMCell, g: Dict[str, np.ndarray]):
     # torch packs (4h, in) in gate order i,f,g,o — identical to ours
+    _check_single_layer_rnn("LSTM", g)
     w_ih = _np(g["weight_ih_l0"]).T
     w_hh = _np(g["weight_hh_l0"]).T
-    bias = _np(g["bias_ih_l0"]) + _np(g["bias_hh_l0"])
+    b_ih, b_hh = _rnn_bias(g, 4 * m.hidden_size)
+    bias = b_ih + b_hh
     return {"w_ih": jnp.asarray(w_ih), "w_hh": jnp.asarray(w_hh),
             "bias": jnp.asarray(bias)}, {}
 
 
 def _import_gru_cell(m: GRUCell, g: Dict[str, np.ndarray]):
+    _check_single_layer_rnn("GRU", g)
     h = m.hidden_size
-    b_hh = _np(g["bias_hh_l0"])
+    _, b_hh = _rnn_bias(g, 3 * h)
     if np.abs(b_hh[2 * h:]).max() > 1e-6:
         raise ValueError(
             "torch GRU has a nonzero hidden bias on the n-gate (b_hn); the "
             "fused-gate GRU cell cannot represent it exactly — retrain or "
             "zero b_hn before importing")
-    bias = _np(g["bias_ih_l0"]).copy()
+    b_ih, _ = _rnn_bias(g, 3 * h)
+    bias = b_ih.copy()
     bias[:2 * h] += b_hh[:2 * h]  # r,z hidden biases fold into the input bias
     return {"w_ih": jnp.asarray(_np(g["weight_ih_l0"]).T),
             "w_hh": jnp.asarray(_np(g["weight_hh_l0"]).T),
@@ -251,6 +276,10 @@ def export_torch_state_dict(module: Module, params: Any, state: Any
         if isinstance(m, LookupTable):
             out[f"{prefix}weight"] = np.asarray(p["weight"])
             return
+        if isinstance(p, dict) and p:
+            raise ValueError(
+                f"no torch exporter for {type(m).__name__} (parameters "
+                f"{sorted(p)}) — the state dict would be silently incomplete")
 
     emit(module, params, state, "")
     return out

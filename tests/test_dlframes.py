@@ -7,6 +7,7 @@ import pandas as pd
 import pytest
 
 import bigdl_tpu.nn as nn
+from bigdl_tpu.optim.optim_method import Adam
 from bigdl_tpu.dlframes import (
     DLClassifier,
     DLEstimator,
@@ -45,6 +46,7 @@ def test_estimator_regression():
     model = nn.Sequential(nn.Linear(4, 2))
     est = (DLEstimator(model, nn.MSECriterion(), [4], [2])
            .set_batch_size(32).set_max_epoch(60)
+           .set_optim_method(Adam(learning_rate=0.05))
            .set_features_col("feat").set_label_col("target")
            .set_prediction_col("pred"))
     fitted = est.fit(df)

@@ -99,10 +99,36 @@ def test_import_gru_rejects_nonzero_bhn():
         interop.import_torch_state_dict(our, params, state, tm.state_dict())
 
 
+def test_import_lstm_without_bias():
+    t, b, f, h = 3, 2, 4, 6
+    tm = torch.nn.LSTM(f, h, bias=False, batch_first=True)
+    our = nn.LSTM(f, h)
+    params, state, _ = our.build(jax.random.PRNGKey(0), (b, t, f))
+    params, state = interop.import_torch_state_dict(our, params, state,
+                                                    tm.state_dict())
+    x = np.random.RandomState(4).randn(b, t, f).astype(np.float32)
+    with torch.no_grad():
+        want, _ = tm(torch.from_numpy(x))
+    got, _ = our.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_import_rejects_multilayer_rnn():
+    tm = torch.nn.LSTM(4, 6, num_layers=2, batch_first=True)
+    our = nn.LSTM(4, 6)
+    params, state, _ = our.build(jax.random.PRNGKey(0), (2, 3, 4))
+    with pytest.raises(ValueError, match="multi-layer"):
+        interop.import_torch_state_dict(our, params, state, tm.state_dict())
+
+
+def test_export_rejects_unsupported_layer():
+    m = nn.Sequential(nn.Linear(3, 3), nn.PReLU())
+    params, state, _ = m.build(jax.random.PRNGKey(0), (2, 3))
+    with pytest.raises(ValueError, match="no torch exporter"):
+        interop.export_torch_state_dict(m, params, state)
+
+
 def test_export_roundtrip():
-    our = nn.Sequential(nn.Linear(6, 8), nn.ReLU(),
-                        nn.SpatialConvolution(2, 3, 3, 3))
-    # conv on (N,H,W,2) after reshape is artificial; test layout fidelity only
     our = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 2))
     params, state, _ = our.build(jax.random.PRNGKey(0), (2, 6))
     sd = interop.export_torch_state_dict(our, params, state)
