@@ -145,3 +145,64 @@ from bigdl_tpu.nn.criterion import (
     DistKLDivCriterion,
     SoftmaxWithCriterion,
 )
+from bigdl_tpu.nn.activation import (
+    SoftMin,
+    LogSigmoid,
+    HardShrink,
+    SoftShrink,
+    TanhShrink,
+    Threshold,
+    BinaryThreshold,
+    RReLU,
+    SReLU,
+)
+from bigdl_tpu.nn.structural import (
+    Negative,
+    Echo,
+    GradientReversal,
+    ActivityRegularization,
+    L1Penalty,
+    NegativeEntropyPenalty,
+    Index,
+    Masking,
+    MaskedSelect,
+    Pack,
+    Replicate,
+    Reverse,
+    Tile,
+    InferReshape,
+    NarrowTable,
+    BifurcateSplitTable,
+    CrossProduct,
+    DenseToSparse,
+    SparseJoinTable,
+)
+from bigdl_tpu.nn.distance import (
+    Euclidean,
+    CosineDistance,
+    PairwiseDistance,
+    Bilinear,
+    MixtureTable,
+    Maxout,
+    Highway,
+    LookupTableSparse,
+)
+from bigdl_tpu.nn.criterion import (
+    MarginRankingCriterion,
+    MultiMarginCriterion,
+    MultiLabelMarginCriterion,
+    SoftMarginCriterion,
+    L1HingeEmbeddingCriterion,
+    CosineDistanceCriterion,
+    CosineProximityCriterion,
+    DotProductCriterion,
+    PGCriterion,
+    GaussianCriterion,
+    KullbackLeiblerDivergenceCriterion,
+    MeanAbsolutePercentageCriterion,
+    MeanSquaredLogarithmicCriterion,
+    PoissonCriterion,
+    SmoothL1CriterionWithWeights,
+    TimeDistributedMaskCriterion,
+    TransformerCriterion,
+)

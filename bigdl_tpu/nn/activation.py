@@ -130,3 +130,124 @@ class SoftPlus(_Elementwise):
 class SoftSign(_Elementwise):
     def _fn(self, x):
         return x / (1.0 + jnp.abs(x))
+
+
+class SoftMin(_Elementwise):
+    """softmax of -x. reference: nn/SoftMin.scala."""
+
+    def _fn(self, x):
+        return jax.nn.softmax(-x, axis=-1)
+
+
+class LogSigmoid(_Elementwise):
+    """log(sigmoid(x)). reference: nn/LogSigmoid.scala."""
+
+    def _fn(self, x):
+        return jax.nn.log_sigmoid(x)
+
+
+class HardShrink(_Elementwise):
+    """0 inside [-lambda, lambda], identity outside. reference: nn/HardShrink.scala."""
+
+    def __init__(self, lambd: float = 0.5, name: Optional[str] = None):
+        super().__init__(name)
+        self.lambd = lambd
+
+    def _fn(self, x):
+        return jnp.where(jnp.abs(x) > self.lambd, x, 0.0)
+
+
+class SoftShrink(_Elementwise):
+    """Shrink towards zero by lambda. reference: nn/SoftShrink.scala."""
+
+    def __init__(self, lambd: float = 0.5, name: Optional[str] = None):
+        super().__init__(name)
+        self.lambd = lambd
+
+    def _fn(self, x):
+        return jnp.sign(x) * jnp.maximum(jnp.abs(x) - self.lambd, 0.0)
+
+
+class TanhShrink(_Elementwise):
+    """x - tanh(x). reference: nn/TanhShrink.scala."""
+
+    def _fn(self, x):
+        return x - jnp.tanh(x)
+
+
+class Threshold(_Elementwise):
+    """x where x > th else value. reference: nn/Threshold.scala."""
+
+    def __init__(self, th: float = 1e-6, v: float = 0.0, ip: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.th, self.v = th, v
+
+    def _fn(self, x):
+        return jnp.where(x > self.th, x, self.v)
+
+
+class BinaryThreshold(_Elementwise):
+    """1 where x > th else 0. reference: nn/BinaryThreshold.scala."""
+
+    def __init__(self, th: float = 1e-6, ip: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self.th = th
+
+    def _fn(self, x):
+        return (x > self.th).astype(jnp.float32)
+
+
+class RReLU(Module):
+    """Randomized leaky ReLU: slope ~ U[lower, upper] per element at train
+    time, fixed mean slope at eval. reference: nn/RReLU.scala."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 ip: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self.lower, self.upper = lower, upper
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if training:
+            if rng is None:
+                raise ValueError("RReLU in training mode requires an rng")
+            slope = jax.random.uniform(rng, x.shape, x.dtype,
+                                       self.lower, self.upper)
+        else:
+            slope = (self.lower + self.upper) / 2.0
+        return jnp.where(x >= 0, x, x * slope), state
+
+
+class SReLU(Module):
+    """S-shaped ReLU with four learned per-channel params (t_left, a_left,
+    t_right, a_right). reference: nn/SReLU.scala."""
+
+    def __init__(self, shape=None, share_axes=None, name: Optional[str] = None):
+        super().__init__(name)
+        self.shape = tuple(shape) if shape else None
+        self.share_axes = tuple(share_axes) if share_axes else None
+
+    def _param_shape(self, input_shape):
+        shp = list(self.shape or input_shape[1:])
+        if self.share_axes:
+            for ax in self.share_axes:
+                shp[ax - 1] = 1  # share_axes count feature dims from 1
+        return tuple(shp)
+
+    def build(self, rng, input_shape):
+        ps = self._param_shape(input_shape)
+        k = jax.random.split(rng, 2)
+        params = {
+            "t_left": jnp.zeros(ps, jnp.float32),
+            "a_left": jnp.zeros(ps, jnp.float32),
+            "t_right": jax.random.uniform(k[0], ps, jnp.float32, 0.0, 1.0),
+            "a_right": jnp.ones(ps, jnp.float32),
+        }
+        return params, {}, input_shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        tl, al = params["t_left"], params["a_left"]
+        tr, ar = params["t_right"], params["a_right"]
+        y = jnp.where(x >= tr, tr + ar * (x - tr), x)
+        y = jnp.where(x <= tl, tl + al * (x - tl), y)
+        return y, state

@@ -329,3 +329,268 @@ class TimeDistributedCriterion(Criterion):
         inner_avg = getattr(self.criterion, "size_average", True)
         sum_over_t = total * t if inner_avg else total
         return sum_over_t / t if self.size_average else sum_over_t
+
+
+class MarginRankingCriterion(Criterion):
+    """Table(x1, x2), y in {1,-1}: max(0, -y*(x1-x2) + margin).
+    reference: nn/MarginRankingCriterion.scala."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        self.margin = margin
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        x1, x2 = input[1], input[2]
+        y = target[1] if isinstance(target, Table) else target
+        return _reduce(jnp.maximum(0.0, -y * (x1 - x2) + self.margin),
+                       self.size_average)
+
+
+class MultiMarginCriterion(Criterion):
+    """Multiclass hinge: mean_j max(0, margin - x[t] + x[j])^p / dim.
+    reference: nn/MultiMarginCriterion.scala (0-based classes here)."""
+
+    def __init__(self, p: int = 1, weights=None, margin: float = 1.0,
+                 size_average: bool = True):
+        self.p, self.weights, self.margin = p, weights, margin
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        t = target.astype(jnp.int32)
+        n, dim = input.shape
+        xt = jnp.take_along_axis(input, t[:, None], axis=-1)
+        h = jnp.maximum(0.0, self.margin - xt + input)
+        if self.p == 2:
+            h = h * h
+        if self.weights is not None:
+            h = h * jnp.take(self.weights, t)[:, None]
+        # the j == t term contributes margin^p; mask it out
+        mask = jax.nn.one_hot(t, dim, dtype=input.dtype)
+        per_sample = jnp.sum(h * (1.0 - mask), axis=-1) / dim
+        return _reduce(per_sample, self.size_average)
+
+
+class MultiLabelMarginCriterion(Criterion):
+    """Multilabel hinge; target rows hold 0-based class ids padded with -1.
+    reference: nn/MultiLabelMarginCriterion.scala (1-based, 0-padded there)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        t = target.astype(jnp.int32)
+        n, dim = input.shape
+        valid = (t >= 0)
+        safe_t = jnp.maximum(t, 0)
+        one_hot = jax.nn.one_hot(safe_t, dim, dtype=jnp.bool_)  # (n, k, dim)
+        is_target = jnp.any(one_hot & valid[:, :, None], axis=1)
+        xt = jnp.take_along_axis(input, safe_t, axis=-1)        # (n, k)
+        # hinge of every non-target j against every valid target slot
+        h = jnp.maximum(0.0, 1.0 - xt[:, :, None] + input[:, None, :])
+        keep = valid[:, :, None] & ~is_target[:, None, :]
+        per_sample = jnp.sum(jnp.where(keep, h, 0.0), axis=(1, 2)) / dim
+        return _reduce(per_sample, self.size_average)
+
+
+class SoftMarginCriterion(Criterion):
+    """log(1 + exp(-y*x)) with y in {1,-1}. reference: nn/SoftMarginCriterion.scala."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        # softplus(-y*x) == log(1+exp(-y*x)) without overflow for large |x|
+        return _reduce(jax.nn.softplus(-input * target), self.size_average)
+
+
+class L1HingeEmbeddingCriterion(Criterion):
+    """Table(x1, x2), y=1: ||x1-x2||_1; y=-1: max(0, margin - ||x1-x2||_1).
+    reference: nn/L1HingeEmbeddingCriterion.scala."""
+
+    def __init__(self, margin: float = 1.0):
+        self.margin = margin
+
+    def forward(self, input, target):
+        d = jnp.sum(jnp.abs(input[1] - input[2]), axis=-1)
+        y = target[1] if isinstance(target, Table) else target
+        y = jnp.reshape(y, d.shape)
+        loss = jnp.where(y > 0, d, jnp.maximum(0.0, self.margin - d))
+        return jnp.sum(loss)
+
+
+class CosineDistanceCriterion(Criterion):
+    """1 - cos(input, target) per row. reference: nn/CosineDistanceCriterion.scala."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        num = jnp.sum(input * target, axis=-1)
+        den = jnp.linalg.norm(input, axis=-1) * jnp.linalg.norm(target, axis=-1)
+        return _reduce(1.0 - num / jnp.maximum(den, 1e-12), self.size_average)
+
+
+class CosineProximityCriterion(Criterion):
+    """-sum(l2norm(input) . l2norm(target)) (Keras cosine_proximity).
+    reference: nn/CosineProximityCriterion.scala."""
+
+    def forward(self, input, target):
+        a = input / jnp.maximum(jnp.linalg.norm(input, axis=-1, keepdims=True), 1e-12)
+        b = target / jnp.maximum(jnp.linalg.norm(target, axis=-1, keepdims=True), 1e-12)
+        return -jnp.mean(jnp.sum(a * b, axis=-1))
+
+
+class DotProductCriterion(Criterion):
+    """loss = dot(input, target) (positive; gradInput = target).
+    reference: nn/DotProductCriterion.scala."""
+
+    def __init__(self, size_average: bool = False):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        dot = jnp.sum(input * target)
+        if self.size_average and input.ndim == 2:
+            dot = dot / input.shape[0]
+        return dot
+
+
+class PGCriterion(Criterion):
+    """Negative policy gradient: -1/n sum(R . log P) over a batch of
+    multinomial distributions; target holds reward at the sampled action
+    index. reference: nn/PGCriterion.scala (built there as
+    TransformerCriterion(DotProductCriterion, Log->MulConstant(-1)))."""
+
+    def __init__(self, size_average: bool = False):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        logp = -jnp.log(jnp.maximum(input, 1e-12))
+        dot = jnp.sum(logp * target)
+        if self.size_average and input.ndim == 2:
+            dot = dot / input.shape[0]
+        return dot
+
+
+class GaussianCriterion(Criterion):
+    """Negative log-likelihood of a diagonal Gaussian; input Table(mean,
+    log_variance). reference: nn/GaussianCriterion.scala (VAE decoder loss)."""
+
+    def forward(self, input, target):
+        mean, log_var = input[1], input[2]
+        return jnp.sum(0.5 * jnp.log(2.0 * jnp.pi) + 0.5 * log_var
+                       + jnp.square(target - mean) / (2.0 * jnp.exp(log_var)))
+
+
+class KullbackLeiblerDivergenceCriterion(Criterion):
+    """KL(y_true || y_pred) over probability rows (Keras kld).
+    reference: nn/KullbackLeiblerDivergenceCriterion.scala."""
+
+    def forward(self, input, target):
+        p = jnp.clip(target, 1e-7, 1.0)
+        q = jnp.clip(input, 1e-7, 1.0)
+        return jnp.mean(jnp.sum(p * jnp.log(p / q), axis=-1))
+
+
+class MeanAbsolutePercentageCriterion(Criterion):
+    """100 * mean(|y_t - y_p| / clip(|y_t|)). 
+    reference: nn/MeanAbsolutePercentageCriterion.scala."""
+
+    def forward(self, input, target):
+        diff = jnp.abs(target - input) / jnp.clip(jnp.abs(target), 1e-7, None)
+        return 100.0 * jnp.mean(diff)
+
+
+class MeanSquaredLogarithmicCriterion(Criterion):
+    """mean((log(y_t+1) - log(y_p+1))^2).
+    reference: nn/MeanSquaredLogarithmicCriterion.scala."""
+
+    def forward(self, input, target):
+        a = jnp.log(jnp.clip(target, 1e-7, None) + 1.0)
+        b = jnp.log(jnp.clip(input, 1e-7, None) + 1.0)
+        return jnp.mean(jnp.square(a - b))
+
+
+class PoissonCriterion(Criterion):
+    """mean(y_p - y_t * log(y_p)). reference: nn/PoissonCriterion.scala."""
+
+    def forward(self, input, target):
+        return jnp.mean(input - target * jnp.log(jnp.clip(input, 1e-7, None)))
+
+
+class SmoothL1CriterionWithWeights(Criterion):
+    """Fast-RCNN bbox regression loss: smooth-L1 with sigma and
+    inside/outside weights, normalized by `num`.
+    reference: nn/SmoothL1CriterionWithWeights.scala.
+
+    forward(input, Table(target, inside_w, outside_w)) or plain target."""
+
+    def __init__(self, sigma: float = 1.0, num: int = 0):
+        self.sigma2 = sigma * sigma
+        self.num = num
+
+    def forward(self, input, target):
+        if isinstance(target, Table):
+            t = target[1]
+            in_w = target[2] if 2 in target else None
+            out_w = target[3] if 3 in target else None
+        else:
+            t, in_w, out_w = target, None, None
+        d = input - t
+        if in_w is not None:
+            d = d * in_w
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < 1.0 / self.sigma2,
+                         0.5 * self.sigma2 * d * d,
+                         ad - 0.5 / self.sigma2)
+        if out_w is not None:
+            loss = loss * out_w
+        total = jnp.sum(loss)
+        return total / self.num if self.num > 0 else total
+
+
+class TimeDistributedMaskCriterion(Criterion):
+    """Apply a criterion per timestep, skipping padded positions
+    (target == padding_value). reference: nn/TimeDistributedMaskCriterion.scala."""
+
+    def __init__(self, criterion: Criterion, padding_value: int = 0):
+        self.criterion = criterion
+        self.padding_value = padding_value
+
+    def forward(self, input, target):
+        b, t = target.shape[0], target.shape[1]
+        flat_in = input.reshape((b * t,) + input.shape[2:])
+        flat_tg = target.reshape((b * t,) + target.shape[2:])
+        not_pad = flat_tg != self.padding_value
+        if not_pad.ndim > 1:
+            # a timestep is padded only when ALL its features equal the pad value
+            not_pad = jnp.any(not_pad.reshape(b * t, -1), axis=-1)
+        mask = not_pad.astype(flat_in.dtype)
+        # per-element loss via vmap of the inner criterion on singletons
+        per = jax.vmap(
+            lambda i, tg: self.criterion.forward(i[None], tg[None]))(
+                flat_in, flat_tg)
+        return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+class TransformerCriterion(Criterion):
+    """Apply transformation modules to input/target, then an inner criterion
+    (perceptual-loss style). reference: nn/TransformerCriterion.scala."""
+
+    def __init__(self, criterion: Criterion, input_transformer=None,
+                 target_transformer=None):
+        self.criterion = criterion
+        self.input_transformer = input_transformer
+        self.target_transformer = target_transformer
+
+    def _run(self, module, x):
+        if module is None:
+            return x
+        if module.params is None:
+            from bigdl_tpu.nn.module import shape_of
+            module.init(shape_of(x))
+        y, _ = module.apply(module.params, module.state, x, training=False)
+        return y
+
+    def forward(self, input, target):
+        return self.criterion.forward(self._run(self.input_transformer, input),
+                                      self._run(self.target_transformer, target))

@@ -1,0 +1,236 @@
+"""Distance, similarity and gating layers.
+
+Reference files (all under nn/): Euclidean.scala, CosineDistance.scala,
+PairwiseDistance.scala, Bilinear.scala, MixtureTable.scala, Maxout.scala,
+Highway.scala, LookupTableSparse.scala.
+
+All are small batched tensor-contraction ops; the bilinear form and maxout
+lower to single einsum/matmul calls that XLA tiles onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.table import Table
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.module import Module, Sequential
+
+
+class Euclidean(Module):
+    """y_j = ||x - w_j||_2 for each of output_size centers.
+    reference: nn/Euclidean.scala."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 fast_backward: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.output_size = input_size, output_size
+
+    def build(self, rng, input_shape):
+        bound = 1.0 / jnp.sqrt(self.input_size)
+        w = jax.random.uniform(rng, (self.input_size, self.output_size),
+                               jnp.float32, -bound, bound)
+        return {"weight": w}, {}, self.output_shape(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        # (B, in) vs (in, out): expand the quadratic form so the dominant
+        # term is one matmul (x @ w) instead of a (B, in, out) broadcast.
+        w = params["weight"]
+        x2 = jnp.sum(x * x, axis=-1, keepdims=True)          # (B, 1)
+        w2 = jnp.sum(w * w, axis=0, keepdims=True)           # (1, out)
+        cross = x @ w                                        # (B, out) MXU
+        d2 = jnp.maximum(x2 + w2 - 2.0 * cross, 0.0)
+        return jnp.sqrt(d2 + 1e-12), state
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_size,)
+
+
+class CosineDistance(Module):
+    """Table(x1, x2) -> cosine similarity per row.
+    reference: nn/CosineDistance.scala."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        a, b = x[1], x[2]
+        num = jnp.sum(a * b, axis=-1)
+        den = jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1)
+        return num / jnp.maximum(den, 1e-12), state
+
+
+class PairwiseDistance(Module):
+    """Table(x1, x2) -> ||x1 - x2||_p per row. reference: nn/PairwiseDistance.scala."""
+
+    def __init__(self, norm: int = 2, name: Optional[str] = None):
+        super().__init__(name)
+        self.norm = norm
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        d = x[1] - x[2]
+        if self.norm == 1:
+            return jnp.sum(jnp.abs(d), axis=-1), state
+        if self.norm == 2:
+            return jnp.sqrt(jnp.sum(d * d, axis=-1) + 1e-12), state
+        p = float(self.norm)
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p), state
+
+
+class Bilinear(Module):
+    """Table(x1, x2) -> x1^T W_k x2 + b_k for each output k.
+    reference: nn/Bilinear.scala.  One einsum -> batched MXU contraction."""
+
+    def __init__(self, input_size1: int, input_size2: int, output_size: int,
+                 bias_res: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size1 = input_size1
+        self.input_size2 = input_size2
+        self.output_size = output_size
+        self.bias_res = bias_res
+
+    def build(self, rng, input_shape):
+        k_w, k_b = jax.random.split(rng)
+        bound = 1.0 / jnp.sqrt(self.input_size1)
+        w = jax.random.uniform(
+            k_w, (self.output_size, self.input_size1, self.input_size2),
+            jnp.float32, -bound, bound)
+        params = {"weight": w}
+        if self.bias_res:
+            params["bias"] = jax.random.uniform(
+                k_b, (self.output_size,), jnp.float32, -bound, bound)
+        return params, {}, input_shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        a, b = x[1], x[2]
+        y = jnp.einsum("bi,oij,bj->bo", a, params["weight"], b)
+        if self.bias_res:
+            y = y + params["bias"]
+        return y, state
+
+
+class MixtureTable(Module):
+    """Table(gate (B, n), experts Table/tensor) -> gate-weighted sum of
+    expert outputs. reference: nn/MixtureTable.scala."""
+
+    def __init__(self, dim: int = 1, name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        gate, experts = x[1], x[2]
+        d = self.dim
+        if isinstance(experts, Table):
+            stacked = jnp.stack(list(experts), axis=d)
+        else:
+            stacked = experts
+        # gate is (B, n); align n with the expert axis `d` for broadcasting
+        gshape = [1] * stacked.ndim
+        gshape[0] = gate.shape[0]
+        gshape[d] = gate.shape[1]
+        g = gate.reshape(gshape)
+        return jnp.sum(stacked * g, axis=d), state
+
+
+class Maxout(Module):
+    """Linear to (out * pool) units, max over each pool group.
+    reference: nn/Maxout.scala."""
+
+    def __init__(self, input_size: int, output_size: int, maxout_number: int,
+                 with_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.maxout_number = maxout_number
+        self.inner = Linear(input_size, output_size * maxout_number,
+                            with_bias=with_bias)
+
+    def build(self, rng, input_shape):
+        p, s, _ = self.inner.build(rng, input_shape)
+        return p, s, self.output_shape(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y, state = self.inner.apply(params, state, x, training=training)
+        y = y.reshape(y.shape[:-1] + (self.output_size, self.maxout_number))
+        return jnp.max(y, axis=-1), state
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_size,)
+
+
+class Highway(Module):
+    """y = t * h(Wx+b) + (1-t) * x with transform gate t = sigmoid(Wt x + bt).
+    reference: nn/Highway.scala."""
+
+    def __init__(self, size: int, with_bias: bool = True, activation=None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.size = size
+        self.activation = activation  # a Module or None (identity)
+        self.h = Linear(size, size, with_bias=with_bias)
+        self.t = Linear(size, size, with_bias=with_bias,
+                        bias_init=init_mod.ConstInit(-2.0))
+
+    def build(self, rng, input_shape):
+        k1, k2 = jax.random.split(rng)
+        ph, sh, _ = self.h.build(k1, input_shape)
+        pt, st, _ = self.t.build(k2, input_shape)
+        return {"h": ph, "t": pt}, {"h": sh, "t": st}, input_shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        h, _ = self.h.apply(params["h"], state["h"], x, training=training)
+        if self.activation is not None:
+            h, _ = self.activation.apply({}, {}, h, training=training)
+        t, _ = self.t.apply(params["t"], state["t"], x, training=training)
+        t = jax.nn.sigmoid(t)
+        return t * h + (1.0 - t) * x, state
+
+
+class LookupTableSparse(Module):
+    """Embedding over (dense-encoded) sparse id bags: input Table(ids,
+    weights) or ids tensor, ids padded with -1; combiner sum/mean/sqrtn.
+    reference: nn/LookupTableSparse.scala (COO SparseTensor input there;
+    padded dense bags here — same capability, MXU/gather-friendly layout)."""
+
+    def __init__(self, n_index: int, n_output: int, combiner: str = "sum",
+                 max_norm: float = -1.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.n_index, self.n_output = n_index, n_output
+        self.combiner = combiner
+        self.max_norm = max_norm
+
+    def build(self, rng, input_shape):
+        w = jax.random.normal(rng, (self.n_index, self.n_output), jnp.float32)
+        return {"weight": w}, {}, self.output_shape(input_shape)
+
+    def output_shape(self, input_shape):
+        ids_shape = input_shape[1] if isinstance(input_shape, Table) else input_shape
+        # the bag axis reduces away: (B, bag) -> (B, n_output)
+        return tuple(ids_shape[:-1]) + (self.n_output,)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if isinstance(x, Table):
+            ids, weights = x[1], x[2]
+        else:
+            ids, weights = x, None
+        valid = ids >= 0
+        safe_ids = jnp.maximum(ids, 0).astype(jnp.int32)
+        w = params["weight"]
+        if self.max_norm > 0:
+            norms = jnp.linalg.norm(w, axis=-1, keepdims=True)
+            w = w * jnp.minimum(1.0, self.max_norm / jnp.maximum(norms, 1e-12))
+        emb = w[safe_ids]                              # (B, bag, out)
+        mask = valid.astype(emb.dtype)[..., None]
+        if weights is not None:
+            mask = mask * weights[..., None]
+        summed = jnp.sum(emb * mask, axis=-2)
+        if self.combiner == "sum":
+            return summed, state
+        count = jnp.maximum(jnp.sum(mask, axis=-2), 1e-12)
+        if self.combiner == "mean":
+            return summed / count, state
+        if self.combiner == "sqrtn":
+            sq = jnp.sqrt(jnp.maximum(jnp.sum(mask * mask, axis=-2), 1e-12))
+            return summed / sq, state
+        raise ValueError(f"unknown combiner {self.combiner}")

@@ -1,0 +1,361 @@
+"""Structural / utility layers and gradient-shaping identities.
+
+Reference files (all under nn/): Negative.scala, Echo.scala,
+GradientReversal.scala, ActivityRegularization.scala, L1Penalty.scala,
+NegativeEntropyPenalty.scala, Index.scala, Masking.scala, MaskedSelect.scala,
+Pack.scala, Replicate.scala, Reverse.scala, Tile.scala, InferReshape.scala,
+NarrowTable.scala, BifurcateSplitTable.scala, CrossProduct.scala,
+DenseToSparse.scala, SparseJoinTable.scala.
+
+The penalty layers (ActivityRegularization/L1Penalty/NegativeEntropyPenalty)
+are identity maps whose *backward* adds the penalty's gradient to gradInput
+(the reference accumulates `loss` forward and patches gradInput backward).
+Under jax autograd the same contract is a `custom_vjp` identity whose
+cotangent is `g + d(penalty)/dx` — the penalty then influences training
+exactly as in the reference without the trainer summing side losses.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.core.table import Table
+from bigdl_tpu.nn.module import Module
+
+
+class Negative(Module):
+    """y = -x. reference: nn/Negative.scala."""
+
+    def __init__(self, inplace: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return -x, state
+
+
+class Echo(Module):
+    """Identity that prints the activity shape on host — debugging aid.
+    reference: nn/Echo.scala.  Uses jax.debug.callback so it works under jit
+    without forcing a host sync of the values."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        jax.debug.print("{name}: shape={shape}", name=self.name,
+                        shape=str(jnp.shape(x)))
+        return x, state
+
+
+def _grad_scale_identity(scale):
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (g * scale,)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+class GradientReversal(Module):
+    """Identity forward, gradient scaled by -lambda backward (adversarial
+    domain adaptation). reference: nn/GradientReversal.scala."""
+
+    def __init__(self, the_lambda: float = 1.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.the_lambda = the_lambda
+
+    def set_lambda(self, l: float) -> "GradientReversal":
+        self.the_lambda = l
+        return self
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return _grad_scale_identity(-self.the_lambda)(x), state
+
+
+def _penalty_identity(penalty_grad):
+    """Identity whose backward adds d(penalty)/dx to the cotangent."""
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, x
+
+    def bwd(x, g):
+        return (g + penalty_grad(x),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+class ActivityRegularization(Module):
+    """L1+L2 activity penalty: loss += l1*sum|x| + l2*sum(x^2).
+    reference: nn/ActivityRegularization.scala."""
+
+    def __init__(self, l1: float = 0.0, l2: float = 0.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.l1, self.l2 = l1, l2
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if not training or (self.l1 == 0.0 and self.l2 == 0.0):
+            return x, state
+        l1, l2 = self.l1, self.l2
+        y = _penalty_identity(lambda t: l1 * jnp.sign(t) + 2.0 * l2 * t)(x)
+        return y, state
+
+
+class L1Penalty(Module):
+    """Sparsity penalty l1weight * sum|x| on the activity.
+    reference: nn/L1Penalty.scala."""
+
+    def __init__(self, l1weight: float, size_average: bool = False,
+                 provide_output: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.l1weight = float(l1weight)
+        self.size_average = size_average
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if not training:
+            return x, state
+        w = self.l1weight
+        if self.size_average:
+            w = w / np.prod(x.shape)
+        y = _penalty_identity(lambda t, w=w: w * jnp.sign(t))(x)
+        return y, state
+
+
+class NegativeEntropyPenalty(Module):
+    """Penalty beta * sum(p log p) pushing a probability activity towards
+    high entropy (exploration bonus). reference: nn/NegativeEntropyPenalty.scala."""
+
+    def __init__(self, beta: float = 0.01, name: Optional[str] = None):
+        super().__init__(name)
+        self.beta = beta
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if not training:
+            return x, state
+        beta = self.beta
+
+        def grad(p):
+            return beta * (jnp.log(jnp.maximum(p, 1e-12)) + 1.0)
+
+        return _penalty_identity(grad)(x), state
+
+
+class Index(Module):
+    """Table(tensor, indices) -> gather along `dim`. Indices are 1-based in
+    the reference (nn/Index.scala); here 0-based like the rest of the API."""
+
+    def __init__(self, dim: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        t, idx = x[1], x[2]
+        return jnp.take(t, idx.astype(jnp.int32), axis=self.dim), state
+
+
+class Masking(Module):
+    """Zero out timesteps whose features ALL equal mask_value (the mask
+    propagation contract of Keras Masking). reference: nn/Masking.scala."""
+
+    def __init__(self, mask_value: float = 0.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.mask_value = mask_value
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        keep = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return jnp.where(keep, x, 0.0), state
+
+
+class MaskedSelect(Module):
+    """Table(tensor, byte mask) -> 1-D tensor of selected elements.
+
+    reference: nn/MaskedSelect.scala.  The output length is data-dependent,
+    which XLA cannot compile (dynamic shapes break MXU tiling), so this op is
+    host-eager: under `jit` tracing it raises, directing the model author to
+    the static-shape alternative (multiply by the mask / jnp.where), which is
+    what a TPU-native graph should contain.
+    """
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        t, mask = x[1], x[2]
+        if isinstance(jnp.asarray(t), jax.core.Tracer):
+            raise TypeError(
+                "MaskedSelect has a data-dependent output shape and cannot be "
+                "jitted; use masking (x * mask) for on-device graphs")
+        tn = np.asarray(t)
+        mn = np.asarray(mask).astype(bool)
+        return jnp.asarray(tn[mn]), state
+
+
+class Pack(Module):
+    """Stack a Table of equal-shape tensors along a new axis.
+    reference: nn/Pack.scala."""
+
+    def __init__(self, dim: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        parts = list(x) if isinstance(x, Table) else [x]
+        return jnp.stack(parts, axis=self.dim), state
+
+
+class Replicate(Module):
+    """Insert a new axis of size n_features at `dim` by broadcasting.
+    reference: nn/Replicate.scala."""
+
+    def __init__(self, n_features: int, dim: int = 0, n_dim: int = 0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_features, self.dim = n_features, dim
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = jnp.expand_dims(x, self.dim)
+        reps = [1] * y.ndim
+        reps[self.dim] = self.n_features
+        return jnp.tile(y, reps), state
+
+    def output_shape(self, input_shape):
+        s = list(input_shape)
+        s.insert(self.dim, self.n_features)
+        return tuple(s)
+
+
+class Reverse(Module):
+    """Flip along one axis. reference: nn/Reverse.scala."""
+
+    def __init__(self, dimension: int = 0, is_inplace: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.flip(x, axis=self.dimension), state
+
+
+class Tile(Module):
+    """Repeat `copies` times along an axis. reference: nn/Tile.scala."""
+
+    def __init__(self, dim: int = 0, copies: int = 2, name: Optional[str] = None):
+        super().__init__(name)
+        self.dim, self.copies = dim, copies
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        reps = [1] * x.ndim
+        reps[self.dim] = self.copies
+        return jnp.tile(x, reps), state
+
+    def output_shape(self, input_shape):
+        s = list(input_shape)
+        s[self.dim] *= self.copies
+        return tuple(s)
+
+
+class InferReshape(Module):
+    """Reshape with -1 (inferred) and 0 (copy input dim) entries.
+    reference: nn/InferReshape.scala."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def _target(self, in_shape):
+        lead = (in_shape[0],) if self.batch_mode else ()
+        offset = 1 if self.batch_mode else 0
+        out = []
+        for i, s in enumerate(self.size):
+            if s == 0:
+                out.append(in_shape[i + offset])
+            else:
+                out.append(s)
+        known = int(np.prod([s for s in out if s != -1])) * int(np.prod(lead, dtype=np.int64) if lead else 1)
+        total = int(np.prod(in_shape))
+        out = [total // known if s == -1 else s for s in out]
+        return tuple(lead) + tuple(out)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.reshape(x, self._target(x.shape)), state
+
+    def output_shape(self, input_shape):
+        return self._target(input_shape)
+
+
+class NarrowTable(Module):
+    """Slice a Table: elements [offset, offset+length).
+    reference: nn/NarrowTable.scala (1-based offset there; 0-based here)."""
+
+    def __init__(self, offset: int, length: int = 1, name: Optional[str] = None):
+        super().__init__(name)
+        self.offset, self.length = offset, length
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        vals = list(x)[self.offset:self.offset + self.length]
+        return Table(*vals), state
+
+
+class BifurcateSplitTable(Module):
+    """Split a tensor into two halves along `dimension` -> Table(left, right).
+    reference: nn/BifurcateSplitTable.scala."""
+
+    def __init__(self, dimension: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        n = x.shape[self.dimension]
+        left = jax.lax.slice_in_dim(x, 0, n // 2, axis=self.dimension)
+        right = jax.lax.slice_in_dim(x, n // 2, n, axis=self.dimension)
+        return Table(left, right), state
+
+
+class CrossProduct(Module):
+    """Pairwise dot products of a Table of vectors -> (batch, numPairs).
+    reference: nn/CrossProduct.scala (wide-and-deep feature crossing)."""
+
+    def __init__(self, num_tensor: int = 0, embedding_size: int = 0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        vals = list(x)
+        outs = []
+        for i in range(len(vals)):
+            for j in range(i + 1, len(vals)):
+                outs.append(jnp.sum(vals[i] * vals[j], axis=-1, keepdims=True))
+        return jnp.concatenate(outs, axis=-1), state
+
+
+class DenseToSparse(Module):
+    """Identity on TPU: the reference converts DenseTensor -> SparseTensor
+    (nn/DenseToSparse.scala) to feed SparseLinear/SparseJoinTable; the
+    TPU-native pipeline keeps sparse-ish features dense (multi-hot) because
+    scatter/gather sparse gemm loses to the MXU's dense matmul at BigDL's
+    feature widths (see SparseLinear docstring)."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x, state
+
+
+class SparseJoinTable(Module):
+    """Concatenate (dense-encoded) sparse features along `dimension`.
+    reference: nn/SparseJoinTable.scala."""
+
+    def __init__(self, dimension: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.concatenate(list(x), axis=self.dimension), state

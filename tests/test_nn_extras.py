@@ -1,0 +1,361 @@
+"""Tests for the structural/penalty/distance layer batch and the extended
+criterion zoo — differential against torch CPU where torch has the same op
+(the Torch7-oracle role, survey §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.table import Table
+
+
+def run(module, x, training=False):
+    from bigdl_tpu.nn.module import shape_of
+    params, state, _ = module.build(jax.random.PRNGKey(0), shape_of(x))
+    y, _ = module.apply(params, state, x, training=training,
+                        rng=jax.random.PRNGKey(1))
+    return y, params
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+class TestShrinkActivations:
+    def _vs_torch(self, mine, torch_fn, x):
+        torch = pytest.importorskip("torch")
+        y, _ = run(mine, jnp.asarray(x))
+        ty = torch_fn(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(np.asarray(y), ty, rtol=1e-5, atol=1e-6)
+
+    def test_hardshrink(self):
+        torch = pytest.importorskip("torch")
+        x = np.linspace(-2, 2, 13, dtype=np.float32)
+        self._vs_torch(nn.HardShrink(0.5), torch.nn.Hardshrink(0.5), x)
+
+    def test_softshrink(self):
+        torch = pytest.importorskip("torch")
+        x = np.linspace(-2, 2, 13, dtype=np.float32)
+        self._vs_torch(nn.SoftShrink(0.5), torch.nn.Softshrink(0.5), x)
+
+    def test_tanhshrink(self):
+        torch = pytest.importorskip("torch")
+        x = np.linspace(-2, 2, 13, dtype=np.float32)
+        self._vs_torch(nn.TanhShrink(), torch.nn.Tanhshrink(), x)
+
+    def test_logsigmoid(self):
+        torch = pytest.importorskip("torch")
+        x = np.linspace(-4, 4, 9, dtype=np.float32)
+        self._vs_torch(nn.LogSigmoid(), torch.nn.LogSigmoid(), x)
+
+    def test_softmin(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+        self._vs_torch(nn.SoftMin(), torch.nn.Softmin(dim=-1), x)
+
+    def test_threshold(self):
+        x = np.array([-1.0, 0.5, 2.0], np.float32)
+        y, _ = run(nn.Threshold(1.0, -7.0), jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(y), [-7.0, -7.0, 2.0])
+
+    def test_binary_threshold(self):
+        y, _ = run(nn.BinaryThreshold(0.0), jnp.asarray(np.array([-1.0, 1.0])))
+        np.testing.assert_allclose(np.asarray(y), [0.0, 1.0])
+
+    def test_rrelu_train_bounds_and_eval(self):
+        x = jnp.asarray(np.full((100,), -1.0, np.float32))
+        m = nn.RReLU(0.1, 0.3)
+        y_train, _ = run(m, x, training=True)
+        assert np.all(np.asarray(y_train) <= -0.1 + 1e-6)
+        assert np.all(np.asarray(y_train) >= -0.3 - 1e-6)
+        y_eval, _ = run(m, x, training=False)
+        np.testing.assert_allclose(np.asarray(y_eval), -0.2, atol=1e-6)
+
+    def test_srelu_default_is_identity_inside(self):
+        # with t_left=0, a_left=0: negative side clips to 0 at init;
+        # inner segment is identity below t_right
+        m = nn.SReLU()
+        x = jnp.asarray(np.array([[-1.0, 0.0, 0.2]], np.float32))
+        params, state, _ = m.build(jax.random.PRNGKey(0), (1, 3))
+        y, _ = m.apply(params, state, x)
+        assert np.asarray(y)[0, 0] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# structural
+# ---------------------------------------------------------------------------
+
+class TestStructural:
+    def test_negative_reverse_tile_replicate_pack(self):
+        x = jnp.arange(6.0).reshape(2, 3)
+        assert np.allclose(run(nn.Negative(), x)[0], -np.asarray(x))
+        assert np.allclose(run(nn.Reverse(1), x)[0], np.asarray(x)[:, ::-1])
+        assert run(nn.Tile(0, 3), x)[0].shape == (6, 3)
+        assert run(nn.Replicate(4, 1), x)[0].shape == (2, 4, 3)
+        t = Table(x, x + 1.0)
+        y, _ = run(nn.Pack(1), t)
+        assert y.shape == (2, 2, 3)
+
+    def test_index(self):
+        t = jnp.arange(12.0).reshape(3, 4)
+        idx = jnp.asarray([2, 0])
+        y, _ = run(nn.Index(0), Table(t, idx))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(t)[[2, 0]])
+
+    def test_masking(self):
+        x = np.ones((1, 3, 2), np.float32)
+        x[0, 1] = 0.0  # masked timestep
+        y, _ = run(nn.Masking(0.0), jnp.asarray(x))
+        assert np.all(np.asarray(y)[0, 1] == 0.0)
+        assert np.all(np.asarray(y)[0, 0] == 1.0)
+
+    def test_masked_select_eager(self):
+        t = jnp.arange(6.0).reshape(2, 3)
+        mask = jnp.asarray([[1, 0, 1], [0, 1, 0]], bool)
+        y, _ = run(nn.MaskedSelect(), Table(t, mask))
+        np.testing.assert_allclose(np.asarray(y), [0.0, 2.0, 4.0])
+
+    def test_infer_reshape(self):
+        x = jnp.arange(24.0).reshape(2, 12)
+        y, _ = run(nn.InferReshape([-1, 4], batch_mode=True), x)
+        assert y.shape == (2, 3, 4)
+        y2, _ = run(nn.InferReshape([4, -1]), x)
+        assert y2.shape == (4, 6)
+
+    def test_narrow_table_bifurcate(self):
+        t = Table(jnp.ones(2), jnp.ones(3), jnp.ones(4))
+        y, _ = run(nn.NarrowTable(1, 2), t)
+        assert [v.shape[0] for v in y] == [3, 4]
+        x = jnp.arange(8.0).reshape(2, 4)
+        halves, _ = run(nn.BifurcateSplitTable(1), x)
+        assert halves[1].shape == (2, 2) and halves[2].shape == (2, 2)
+
+    def test_cross_product(self):
+        a = jnp.asarray([[1.0, 0.0]])
+        b = jnp.asarray([[0.0, 1.0]])
+        c = jnp.asarray([[1.0, 1.0]])
+        y, _ = run(nn.CrossProduct(), Table(a, b, c))
+        np.testing.assert_allclose(np.asarray(y), [[0.0, 1.0, 1.0]])
+
+    def test_gradient_reversal(self):
+        m = nn.GradientReversal(2.0)
+
+        def f(x):
+            y, _ = m.apply({}, {}, x, training=True)
+            return jnp.sum(y * y)
+
+        x = jnp.asarray([3.0])
+        g = jax.grad(f)(x)
+        np.testing.assert_allclose(np.asarray(g), [-12.0])  # -2 * 2x
+
+    def test_l1_penalty_gradient(self):
+        m = nn.L1Penalty(0.5)
+
+        def f(x):
+            y, _ = m.apply({}, {}, x, training=True)
+            return jnp.sum(y)
+
+        g = jax.grad(f)(jnp.asarray([2.0, -3.0]))
+        np.testing.assert_allclose(np.asarray(g), [1.5, 0.5])
+
+    def test_activity_regularization_gradient(self):
+        m = nn.ActivityRegularization(l1=1.0, l2=0.5)
+
+        def f(x):
+            y, _ = m.apply({}, {}, x, training=True)
+            return jnp.sum(y)
+
+        g = jax.grad(f)(jnp.asarray([2.0]))
+        # 1 (upstream) + sign(2) * 1 + 2 * 0.5 * 2
+        np.testing.assert_allclose(np.asarray(g), [4.0])
+
+    def test_echo_passthrough(self):
+        x = jnp.ones((2, 2))
+        y, _ = run(nn.Echo(), x)
+        np.testing.assert_allclose(np.asarray(y), 1.0)
+
+    def test_dense_to_sparse_join(self):
+        x = jnp.ones((2, 3))
+        y, _ = run(nn.DenseToSparse(), x)
+        assert y.shape == (2, 3)
+        j, _ = run(nn.SparseJoinTable(1), Table(x, x))
+        assert j.shape == (2, 6)
+
+
+# ---------------------------------------------------------------------------
+# distance / gating
+# ---------------------------------------------------------------------------
+
+class TestDistance:
+    def test_euclidean_matches_direct(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(4, 5).astype(np.float32)
+        m = nn.Euclidean(5, 3)
+        y, params = run(m, jnp.asarray(x))
+        w = np.asarray(params["weight"])  # (5, 3)
+        direct = np.linalg.norm(x[:, :, None] - w[None], axis=1)
+        np.testing.assert_allclose(np.asarray(y), direct, rtol=1e-4, atol=1e-4)
+
+    def test_cosine_distance(self):
+        a = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        b = np.random.RandomState(2).randn(3, 4).astype(np.float32)
+        y, _ = run(nn.CosineDistance(), Table(jnp.asarray(a), jnp.asarray(b)))
+        expect = np.sum(a * b, -1) / (np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1))
+        np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5)
+
+    def test_pairwise_distance_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        a = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        b = np.random.RandomState(2).randn(3, 4).astype(np.float32)
+        y, _ = run(nn.PairwiseDistance(2), Table(jnp.asarray(a), jnp.asarray(b)))
+        ty = torch.nn.PairwiseDistance(p=2)(torch.from_numpy(a), torch.from_numpy(b))
+        np.testing.assert_allclose(np.asarray(y), ty.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_bilinear_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(3)
+        a = rs.randn(2, 3).astype(np.float32)
+        b = rs.randn(2, 4).astype(np.float32)
+        m = nn.Bilinear(3, 4, 5)
+        y, params = run(m, Table(jnp.asarray(a), jnp.asarray(b)))
+        tb = torch.nn.Bilinear(3, 4, 5)
+        with torch.no_grad():
+            tb.weight.copy_(torch.from_numpy(np.asarray(params["weight"])))
+            tb.bias.copy_(torch.from_numpy(np.asarray(params["bias"])))
+            ty = tb(torch.from_numpy(a), torch.from_numpy(b)).numpy()
+        np.testing.assert_allclose(np.asarray(y), ty, rtol=1e-4, atol=1e-5)
+
+    def test_mixture_table(self):
+        gate = jnp.asarray([[0.3, 0.7]])
+        e1 = jnp.ones((1, 4))
+        e2 = jnp.full((1, 4), 2.0)
+        y, _ = run(nn.MixtureTable(), Table(gate, Table(e1, e2)))
+        np.testing.assert_allclose(np.asarray(y), np.full((1, 4), 1.7), rtol=1e-6)
+
+    def test_maxout_shape(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(3, 6).astype(np.float32))
+        y, _ = run(nn.Maxout(6, 4, 3), x)
+        assert y.shape == (3, 4)
+
+    def test_highway_identity_gate(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 5).astype(np.float32))
+        m = nn.Highway(5, activation=nn.Tanh())
+        y, params = run(m, x)
+        assert y.shape == (2, 5)
+
+    def test_lookup_table_sparse_combiners(self):
+        ids = jnp.asarray([[0, 1, -1]])
+        m = nn.LookupTableSparse(4, 3, combiner="mean")
+        params, state, _ = m.build(jax.random.PRNGKey(0), (1, 3))
+        y, _ = m.apply(params, state, ids)
+        w = np.asarray(params["weight"])
+        np.testing.assert_allclose(np.asarray(y)[0], (w[0] + w[1]) / 2.0, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# criterions
+# ---------------------------------------------------------------------------
+
+class TestNewCriterions:
+    def test_multi_margin_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(0)
+        x = rs.randn(4, 6).astype(np.float32)
+        t = rs.randint(0, 6, (4,))
+        mine = nn.MultiMarginCriterion(p=1).forward(jnp.asarray(x), jnp.asarray(t))
+        ref = torch.nn.MultiMarginLoss(p=1)(torch.from_numpy(x), torch.from_numpy(t))
+        np.testing.assert_allclose(float(mine), float(ref), rtol=1e-5)
+
+    def test_multilabel_margin_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.asarray([[0.1, 0.2, 0.4, 0.8]], np.float32)
+        # torch convention: class ids then -1 padding
+        t_torch = np.asarray([[3, 0, -1, -1]], np.int64)
+        mine = nn.MultiLabelMarginCriterion().forward(
+            jnp.asarray(x), jnp.asarray(t_torch))
+        ref = torch.nn.MultiLabelMarginLoss()(torch.from_numpy(x), torch.from_numpy(t_torch))
+        np.testing.assert_allclose(float(mine), float(ref), rtol=1e-5)
+
+    def test_soft_margin_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(1)
+        x = rs.randn(5, 3).astype(np.float32)
+        y = np.sign(rs.randn(5, 3)).astype(np.float32)
+        mine = nn.SoftMarginCriterion().forward(jnp.asarray(x), jnp.asarray(y))
+        ref = torch.nn.SoftMarginLoss()(torch.from_numpy(x), torch.from_numpy(y))
+        np.testing.assert_allclose(float(mine), float(ref), rtol=1e-5)
+
+    def test_margin_ranking_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(2)
+        x1 = rs.randn(6).astype(np.float32)
+        x2 = rs.randn(6).astype(np.float32)
+        y = np.sign(rs.randn(6)).astype(np.float32)
+        mine = nn.MarginRankingCriterion(margin=0.5).forward(
+            Table(jnp.asarray(x1), jnp.asarray(x2)), jnp.asarray(y))
+        ref = torch.nn.MarginRankingLoss(margin=0.5)(
+            torch.from_numpy(x1), torch.from_numpy(x2), torch.from_numpy(y))
+        np.testing.assert_allclose(float(mine), float(ref), rtol=1e-5)
+
+    def test_cosine_distance_criterion(self):
+        a = np.asarray([[1.0, 0.0]], np.float32)
+        loss = nn.CosineDistanceCriterion().forward(jnp.asarray(a), jnp.asarray(a))
+        np.testing.assert_allclose(float(loss), 0.0, atol=1e-6)
+
+    def test_dot_product_and_pg(self):
+        x = jnp.asarray([[0.5, 0.5]])
+        t = jnp.asarray([[1.0, 0.0]])
+        assert float(nn.DotProductCriterion().forward(x, t)) == pytest.approx(0.5)
+        pg = float(nn.PGCriterion().forward(x, t))
+        assert pg == pytest.approx(-np.log(0.5))
+
+    def test_gaussian_criterion(self):
+        mean = jnp.zeros((2, 3))
+        log_var = jnp.zeros((2, 3))
+        target = jnp.zeros((2, 3))
+        loss = nn.GaussianCriterion().forward(Table(mean, log_var), target)
+        np.testing.assert_allclose(float(loss), 6 * 0.5 * np.log(2 * np.pi), rtol=1e-5)
+
+    def test_keras_style_regression_criterions(self):
+        y_t = np.asarray([[1.0, 2.0]], np.float32)
+        y_p = np.asarray([[1.1, 1.9]], np.float32)
+        mape = float(nn.MeanAbsolutePercentageCriterion().forward(
+            jnp.asarray(y_p), jnp.asarray(y_t)))
+        assert mape == pytest.approx(100 * (0.1 / 1 + 0.1 / 2) / 2, rel=1e-3)
+        msle = float(nn.MeanSquaredLogarithmicCriterion().forward(
+            jnp.asarray(y_p), jnp.asarray(y_t)))
+        expect = np.mean((np.log(y_t + 1) - np.log(y_p + 1)) ** 2)
+        assert msle == pytest.approx(float(expect), rel=1e-4)
+        poisson = float(nn.PoissonCriterion().forward(
+            jnp.asarray(y_p), jnp.asarray(y_t)))
+        assert poisson == pytest.approx(float(np.mean(y_p - y_t * np.log(y_p))), rel=1e-4)
+
+    def test_kld(self):
+        p = np.asarray([[0.5, 0.5]], np.float32)
+        kl = float(nn.KullbackLeiblerDivergenceCriterion().forward(
+            jnp.asarray(p), jnp.asarray(p)))
+        assert kl == pytest.approx(0.0, abs=1e-6)
+
+    def test_smooth_l1_with_weights(self):
+        x = jnp.asarray([[0.5, -2.0]])
+        t = jnp.zeros((1, 2))
+        loss = float(nn.SmoothL1CriterionWithWeights(sigma=1.0, num=1).forward(x, t))
+        assert loss == pytest.approx(0.5 * 0.25 + (2.0 - 0.5), rel=1e-5)
+
+    def test_time_distributed_mask(self):
+        inner = nn.MSECriterion()
+        crit = nn.TimeDistributedMaskCriterion(inner, padding_value=0)
+        inp = jnp.asarray([[[1.0], [5.0]]])   # (B=1, T=2, 1)
+        tgt = jnp.asarray([[[2.0], [0.0]]])   # second step padded
+        loss = float(crit.forward(inp, tgt))
+        assert loss == pytest.approx(1.0)
+
+    def test_transformer_criterion(self):
+        crit = nn.TransformerCriterion(nn.MSECriterion(),
+                                       input_transformer=nn.Negative(),
+                                       target_transformer=nn.Negative())
+        x = jnp.asarray([[1.0, 2.0]])
+        loss = float(crit.forward(x, x))
+        assert loss == pytest.approx(0.0)

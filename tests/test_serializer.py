@@ -193,6 +193,47 @@ EXEMPLARS = {
         lambda: keras.Sequential(keras.Dense(4, input_dim=3), keras.Dense(2)),
         lambda: rand(2, 3)),
     "keras.Model": ("special", None),
+    # structural / penalty / distance batch
+    "Negative": (lambda: nn.Negative(), lambda: rand(2, 3)),
+    "Echo": (lambda: nn.Echo(), None),
+    "GradientReversal": (lambda: nn.GradientReversal(0.7), lambda: rand(2, 3)),
+    "ActivityRegularization": (lambda: nn.ActivityRegularization(0.1, 0.2),
+                               lambda: rand(2, 3)),
+    "L1Penalty": (lambda: nn.L1Penalty(0.1), lambda: rand(2, 3)),
+    "NegativeEntropyPenalty": (lambda: nn.NegativeEntropyPenalty(0.01),
+                               lambda: rand(2, 3)),
+    "Index": (lambda: nn.Index(0), None),
+    "Masking": (lambda: nn.Masking(0.0), lambda: rand(2, 3, 4)),
+    "MaskedSelect": (lambda: nn.MaskedSelect(), None),
+    "Pack": (lambda: nn.Pack(1), lambda: table((2, 3), (2, 3))),
+    "Replicate": (lambda: nn.Replicate(3, 1), lambda: rand(2, 4)),
+    "Reverse": (lambda: nn.Reverse(1), lambda: rand(2, 4)),
+    "Tile": (lambda: nn.Tile(1, 2), lambda: rand(2, 4)),
+    "InferReshape": (lambda: nn.InferReshape([-1, 2], True), lambda: rand(2, 6)),
+    "NarrowTable": (lambda: nn.NarrowTable(0, 1), lambda: table((2, 3), (2, 4))),
+    "BifurcateSplitTable": (lambda: nn.BifurcateSplitTable(1), lambda: rand(2, 4)),
+    "CrossProduct": (lambda: nn.CrossProduct(), lambda: table((2, 3), (2, 3))),
+    "DenseToSparse": (lambda: nn.DenseToSparse(), lambda: rand(2, 3)),
+    "SparseJoinTable": (lambda: nn.SparseJoinTable(1), lambda: table((2, 3), (2, 3))),
+    "SoftMin": (lambda: nn.SoftMin(), lambda: rand(2, 3)),
+    "LogSigmoid": (lambda: nn.LogSigmoid(), lambda: rand(2, 3)),
+    "HardShrink": (lambda: nn.HardShrink(0.4), lambda: rand(2, 3)),
+    "SoftShrink": (lambda: nn.SoftShrink(0.4), lambda: rand(2, 3)),
+    "TanhShrink": (lambda: nn.TanhShrink(), lambda: rand(2, 3)),
+    "Threshold": (lambda: nn.Threshold(0.2, -1.0), lambda: rand(2, 3)),
+    "BinaryThreshold": (lambda: nn.BinaryThreshold(0.1), lambda: rand(2, 3)),
+    "RReLU": (lambda: nn.RReLU(0.1, 0.3), lambda: rand(2, 3)),
+    "SReLU": (lambda: nn.SReLU(), lambda: rand(2, 3)),
+    "Euclidean": (lambda: nn.Euclidean(4, 3), lambda: rand(2, 4)),
+    "CosineDistance": (lambda: nn.CosineDistance(), lambda: table((2, 3), (2, 3))),
+    "PairwiseDistance": (lambda: nn.PairwiseDistance(2),
+                         lambda: table((2, 3), (2, 3))),
+    "Bilinear": (lambda: nn.Bilinear(3, 4, 5), None),
+    "MixtureTable": (lambda: nn.MixtureTable(), None),
+    "Maxout": (lambda: nn.Maxout(4, 3, 2), lambda: rand(2, 4)),
+    "Highway": (lambda: nn.Highway(4), lambda: rand(2, 4)),
+    "LookupTableSparse": (lambda: nn.LookupTableSparse(8, 4),
+                          lambda: jnp.asarray([[0, 1, -1]], jnp.int32)),
 }
 
 CRITERION_EXEMPLARS = {
@@ -221,6 +262,30 @@ CRITERION_EXEMPLARS = {
         lambda: nn.TimeDistributedCriterion(nn.MSECriterion()), "td"),
     "CategoricalCrossEntropy": (lambda: keras.CategoricalCrossEntropy(),
                                 "onehot"),
+    "MarginRankingCriterion": (lambda: nn.MarginRankingCriterion(0.5), "rank"),
+    "MultiMarginCriterion": (lambda: nn.MultiMarginCriterion(), "cls"),
+    "MultiLabelMarginCriterion": (lambda: nn.MultiLabelMarginCriterion(), "mlm"),
+    "SoftMarginCriterion": (lambda: nn.SoftMarginCriterion(), "hinge"),
+    "L1HingeEmbeddingCriterion": (lambda: nn.L1HingeEmbeddingCriterion(0.5), "emb"),
+    "CosineDistanceCriterion": (lambda: nn.CosineDistanceCriterion(), "reg"),
+    "CosineProximityCriterion": (lambda: nn.CosineProximityCriterion(), "reg"),
+    "DotProductCriterion": (lambda: nn.DotProductCriterion(), "reg"),
+    "PGCriterion": (lambda: nn.PGCriterion(), "prob"),
+    "GaussianCriterion": (lambda: nn.GaussianCriterion(), "kld"),
+    "KullbackLeiblerDivergenceCriterion": (
+        lambda: nn.KullbackLeiblerDivergenceCriterion(), "prob"),
+    "MeanAbsolutePercentageCriterion": (
+        lambda: nn.MeanAbsolutePercentageCriterion(), "prob"),
+    "MeanSquaredLogarithmicCriterion": (
+        lambda: nn.MeanSquaredLogarithmicCriterion(), "prob"),
+    "PoissonCriterion": (lambda: nn.PoissonCriterion(), "prob"),
+    "SmoothL1CriterionWithWeights": (
+        lambda: nn.SmoothL1CriterionWithWeights(1.0, 4), "reg"),
+    "TimeDistributedMaskCriterion": (
+        lambda: nn.TimeDistributedMaskCriterion(nn.MSECriterion()), "td"),
+    "TransformerCriterion": (
+        lambda: nn.TransformerCriterion(nn.MSECriterion(),
+                                        input_transformer=nn.Negative()), "reg"),
 }
 
 EXCLUDED = {"Module", "Container", "Criterion", "keras.KerasLayer",
@@ -351,6 +416,11 @@ def _criterion_io(kind):
         return rand(2, 3, 4), rand(2, 3, 4)
     if kind == "onehot":
         return rand(4, 3), jnp.asarray(np.eye(3, dtype=np.float32)[[0, 1, 2, 1]])
+    if kind == "rank":
+        return table((4,), (4,)), jnp.asarray([1, -1, 1, -1], jnp.float32)
+    if kind == "mlm":
+        return rand(4, 3), jnp.asarray([[0, -1, -1], [1, 2, -1],
+                                        [2, -1, -1], [0, 1, -1]], jnp.int32)
     raise ValueError(kind)
 
 
