@@ -110,6 +110,10 @@ from bigdl_tpu.nn.recurrent import (
     Recurrent,
     BiRecurrent,
     TimeDistributed,
+    LSTMPeephole,
+    ConvLSTMPeephole,
+    MultiRNNCell,
+    RecurrentDecoder,
 )
 from bigdl_tpu.nn.attention import (
     MultiHeadAttention,
@@ -205,4 +209,23 @@ from bigdl_tpu.nn.criterion import (
     SmoothL1CriterionWithWeights,
     TimeDistributedMaskCriterion,
     TransformerCriterion,
+)
+from bigdl_tpu.nn.volumetric import (
+    VolumetricConvolution,
+    VolumetricFullConvolution,
+    VolumetricMaxPooling,
+    VolumetricAveragePooling,
+)
+from bigdl_tpu.nn.detection import (
+    Anchor,
+    Nms,
+    PriorBox,
+    Proposal,
+    RoiPooling,
+    RoiAlign,
+    DetectionOutputSSD,
+    DetectionOutputFrcnn,
+    bbox_iou,
+    bbox_transform_inv,
+    nms,
 )

@@ -195,7 +195,7 @@ class BinaryThreshold(_Elementwise):
         self.th = th
 
     def _fn(self, x):
-        return (x > self.th).astype(jnp.float32)
+        return (x > self.th).astype(x.dtype)
 
 
 class RReLU(Module):

@@ -1,0 +1,526 @@
+"""Detection heads: anchors, NMS, proposals, ROI pooling, SSD/Frcnn outputs.
+
+Reference: nn/Anchor.scala, nn/Nms.scala, nn/PriorBox.scala,
+nn/Proposal.scala, nn/RoiPooling.scala, nn/DetectionOutputSSD.scala,
+nn/DetectionOutputFrcnn.scala.
+
+TPU-first redesign: the reference's NMS is a scalar greedy loop with early
+exit; data-dependent shapes don't compile under XLA, so every op here is
+FIXED-SHAPE — NMS returns a (max_out,) index vector plus a validity mask,
+proposals/detections are padded to their top-k, and suppression runs as a
+`lax.fori_loop` over a precomputed IoU matrix.  Boxes are (x1, y1, x2, y2).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.core.table import Table
+from bigdl_tpu.nn.module import Module
+
+# ---------------------------------------------------------------------------
+# box math
+
+
+def bbox_area(boxes: jnp.ndarray) -> jnp.ndarray:
+    return jnp.clip(boxes[..., 2] - boxes[..., 0], 0) * \
+        jnp.clip(boxes[..., 3] - boxes[..., 1], 0)
+
+
+def bbox_iou(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise IoU: a (N, 4), b (M, 4) -> (N, M)."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = bbox_area(a)[:, None] + bbox_area(b)[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def bbox_transform_inv(boxes: jnp.ndarray, deltas: jnp.ndarray) -> jnp.ndarray:
+    """Decode (dx, dy, dw, dh) deltas onto anchor/prior boxes.
+    reference: the BboxUtil.bboxTransformInv used by Proposal.scala."""
+    widths = boxes[:, 2] - boxes[:, 0] + 1.0
+    heights = boxes[:, 3] - boxes[:, 1] + 1.0
+    ctr_x = boxes[:, 0] + 0.5 * (widths - 1.0)
+    ctr_y = boxes[:, 1] + 0.5 * (heights - 1.0)
+    dx, dy, dw, dh = deltas[:, 0], deltas[:, 1], deltas[:, 2], deltas[:, 3]
+    pred_ctr_x = dx * widths + ctr_x
+    pred_ctr_y = dy * heights + ctr_y
+    pred_w = jnp.exp(dw) * widths
+    pred_h = jnp.exp(dh) * heights
+    # exact inverse of the encode: zero deltas reproduce the input box
+    return jnp.stack([pred_ctr_x - 0.5 * (pred_w - 1.0),
+                      pred_ctr_y - 0.5 * (pred_h - 1.0),
+                      pred_ctr_x + 0.5 * (pred_w - 1.0),
+                      pred_ctr_y + 0.5 * (pred_h - 1.0)], axis=1)
+
+
+def clip_boxes(boxes: jnp.ndarray, height: float, width: float) -> jnp.ndarray:
+    x1 = jnp.clip(boxes[:, 0], 0, width - 1.0)
+    y1 = jnp.clip(boxes[:, 1], 0, height - 1.0)
+    x2 = jnp.clip(boxes[:, 2], 0, width - 1.0)
+    y2 = jnp.clip(boxes[:, 3], 0, height - 1.0)
+    return jnp.stack([x1, y1, x2, y2], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# NMS
+
+
+def nms(boxes: jnp.ndarray, scores: jnp.ndarray, iou_threshold: float,
+        max_out: int, score_threshold: float = -jnp.inf
+        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy NMS, fixed output size.
+
+    Returns (indices (max_out,) int32, valid (max_out,) bool).  Padded slots
+    hold index 0 with valid=False.  reference: nn/Nms.scala (scalar greedy
+    loop -> fori_loop over a precomputed IoU matrix here).
+    """
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    sboxes = boxes[order]
+    sscores = scores[order]
+    iou_mat = bbox_iou(sboxes, sboxes)
+
+    def body(i, suppressed):
+        alive = jnp.logical_not(suppressed[i]) & (sscores[i] > score_threshold)
+        kill = alive & (iou_mat[i] > iou_threshold) & \
+            (jnp.arange(n) > i)
+        return jnp.where(kill, True, suppressed)
+
+    suppressed = lax.fori_loop(0, n, body, jnp.zeros((n,), bool))
+    keep = jnp.logical_not(suppressed) & (sscores > score_threshold)
+    # stable-select the kept entries into the first `max_out` slots
+    rank = jnp.cumsum(keep) - 1
+    # suppressed/overflow entries get slot >= max_out -> mode="drop" discards
+    slot = jnp.where(keep, rank, max_out)
+    idx_out = jnp.zeros((max_out,), jnp.int32).at[slot].set(
+        order.astype(jnp.int32), mode="drop")
+    valid = jnp.zeros((max_out,), bool).at[slot].set(keep, mode="drop")
+    return idx_out, valid
+
+
+class Nms(Module):
+    """Module wrapper: input Table(boxes, scores) -> Table(indices, valid).
+    reference: nn/Nms.scala."""
+
+    def __init__(self, iou_threshold: float = 0.3, max_out: int = 100,
+                 score_threshold: float = -float("inf"),
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.iou_threshold = iou_threshold
+        self.max_out = max_out
+        self.score_threshold = score_threshold
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        boxes, scores = x[1], x[2]
+        idx, valid = nms(boxes, scores, self.iou_threshold, self.max_out,
+                         self.score_threshold)
+        return Table(idx, valid), state
+
+
+# ---------------------------------------------------------------------------
+# anchor / prior generation
+
+
+class Anchor:
+    """RPN anchor generator.  reference: nn/Anchor.scala (generateAnchors:
+    base box 0..base_size-1, ratio enumeration then scale enumeration)."""
+
+    def __init__(self, ratios: Sequence[float], scales: Sequence[float],
+                 base_size: int = 16):
+        self.ratios = list(ratios)
+        self.scales = list(scales)
+        self.base_size = base_size
+        self._base = self._generate_base()
+
+    def _generate_base(self) -> np.ndarray:
+        base = np.array([0, 0, self.base_size - 1, self.base_size - 1], np.float32)
+        w = base[2] - base[0] + 1
+        h = base[3] - base[1] + 1
+        x_ctr = base[0] + 0.5 * (w - 1)
+        y_ctr = base[1] + 0.5 * (h - 1)
+        size = w * h
+        anchors = []
+        for r in self.ratios:
+            ws = round(math.sqrt(size / r))
+            hs = round(ws * r)
+            for s in self.scales:
+                wss, hss = ws * s, hs * s
+                anchors.append([x_ctr - 0.5 * (wss - 1), y_ctr - 0.5 * (hss - 1),
+                                x_ctr + 0.5 * (wss - 1), y_ctr + 0.5 * (hss - 1)])
+        return np.asarray(anchors, np.float32)
+
+    @property
+    def anchor_num(self) -> int:
+        return len(self.ratios) * len(self.scales)
+
+    def generate(self, height: int, width: int, stride: float) -> jnp.ndarray:
+        """All anchors for an HxW feature grid -> (H*W*A, 4)."""
+        shift_x = jnp.arange(width, dtype=jnp.float32) * stride
+        shift_y = jnp.arange(height, dtype=jnp.float32) * stride
+        sx, sy = jnp.meshgrid(shift_x, shift_y)
+        shifts = jnp.stack([sx.ravel(), sy.ravel(), sx.ravel(), sy.ravel()], axis=1)
+        return (shifts[:, None, :] + jnp.asarray(self._base)[None, :, :]
+                ).reshape(-1, 4)
+
+
+class PriorBox(Module):
+    """SSD prior boxes for one feature map.  reference: nn/PriorBox.scala.
+
+    Output Table(priors (K, 4) normalized cxcy-minmax boxes, variances
+    (K, 4)).  Input is the feature map (N, H, W, C); `image_size` fixes the
+    normalization.
+    """
+
+    def __init__(self, min_sizes: Sequence[float],
+                 max_sizes: Optional[Sequence[float]] = None,
+                 aspect_ratios: Sequence[float] = (2.0,),
+                 flip: bool = True, clip: bool = False,
+                 variances: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+                 offset: float = 0.5,
+                 img_h: int = 300, img_w: int = 300,
+                 step_h: Optional[float] = None, step_w: Optional[float] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.min_sizes = list(min_sizes)
+        self.max_sizes = list(max_sizes or [])
+        if self.max_sizes:
+            assert len(self.max_sizes) == len(self.min_sizes)
+        ars = [1.0]
+        for ar in aspect_ratios:
+            if any(abs(ar - a) < 1e-6 for a in ars):
+                continue
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+        self.ars = ars
+        self.clip = clip
+        self.variances = list(variances)
+        self.offset = offset
+        self.img_h, self.img_w = img_h, img_w
+        self.step_h, self.step_w = step_h, step_w
+
+    def num_priors(self) -> int:
+        n = len(self.ars) * len(self.min_sizes)
+        return n + len(self.max_sizes)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        _, h, w, _ = x.shape
+        step_h = self.step_h or self.img_h / h
+        step_w = self.step_w or self.img_w / w
+        widths, heights = [], []
+        for i, ms in enumerate(self.min_sizes):
+            for ar in self.ars:
+                if abs(ar - 1.0) < 1e-6:
+                    widths.append(ms)
+                    heights.append(ms)
+                    if self.max_sizes:
+                        rs = math.sqrt(ms * self.max_sizes[i])
+                        widths.append(rs)
+                        heights.append(rs)
+                else:
+                    widths.append(ms * math.sqrt(ar))
+                    heights.append(ms / math.sqrt(ar))
+        ws = jnp.asarray(widths, jnp.float32) / 2.0
+        hs = jnp.asarray(heights, jnp.float32) / 2.0
+        cx = (jnp.arange(w, dtype=jnp.float32) + self.offset) * step_w
+        cy = (jnp.arange(h, dtype=jnp.float32) + self.offset) * step_h
+        gx, gy = jnp.meshgrid(cx, cy)  # (h, w)
+        cxs = gx[..., None]  # (h, w, 1)
+        cys = gy[..., None]
+        boxes = jnp.stack([
+            (cxs - ws) / self.img_w, (cys - hs) / self.img_h,
+            (cxs + ws) / self.img_w, (cys + hs) / self.img_h], axis=-1)
+        boxes = boxes.reshape(-1, 4)
+        if self.clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        variances = jnp.tile(jnp.asarray(self.variances, jnp.float32),
+                             (boxes.shape[0], 1))
+        return Table(boxes, variances), state
+
+
+# ---------------------------------------------------------------------------
+# proposal
+
+
+class Proposal(Module):
+    """RPN proposal layer: decode anchor deltas, clip, NMS, top-k.
+    reference: nn/Proposal.scala.
+
+    Input Table(scores (N, H, W, 2A) — second half is fg, bbox_deltas
+    (N, H, W, 4A), im_info (2,) = (height, width)); batch 1, like the
+    reference.  Output: (post_nms_top_n, 5) rois as (0, x1, y1, x2, y2),
+    plus a validity mask, as Table(rois, valid).
+    """
+
+    def __init__(self, pre_nms_top_n: int = 6000, post_nms_top_n: int = 300,
+                 ratios: Sequence[float] = (0.5, 1.0, 2.0),
+                 scales: Sequence[float] = (8.0, 16.0, 32.0),
+                 feat_stride: int = 16, min_size: int = 16,
+                 nms_threshold: float = 0.7, name: Optional[str] = None):
+        super().__init__(name)
+        self.pre_nms_top_n = pre_nms_top_n
+        self.post_nms_top_n = post_nms_top_n
+        self.anchor = Anchor(ratios, scales, base_size=feat_stride)
+        self.feat_stride = feat_stride
+        self.min_size = min_size
+        self.nms_threshold = nms_threshold
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        scores, deltas, im_info = x[1], x[2], x[3]
+        a = self.anchor.anchor_num
+        _, h, w, _ = scores.shape
+        fg = scores[0, :, :, a:].reshape(-1)  # (H*W*A,)
+        deltas = deltas[0].reshape(-1, 4)
+        anchors = self.anchor.generate(h, w, self.feat_stride)
+        proposals = bbox_transform_inv(anchors, deltas)
+        proposals = clip_boxes(proposals, im_info[0], im_info[1])
+        ws = proposals[:, 2] - proposals[:, 0] + 1
+        hs = proposals[:, 3] - proposals[:, 1] + 1
+        keep = (ws >= self.min_size) & (hs >= self.min_size)
+        fg = jnp.where(keep, fg, -jnp.inf)
+        k = min(self.pre_nms_top_n, proposals.shape[0])
+        top_scores, top_idx = lax.top_k(fg, k)
+        top_boxes = proposals[top_idx]
+        idx, valid = nms(top_boxes, top_scores, self.nms_threshold,
+                         self.post_nms_top_n, score_threshold=-jnp.inf)
+        rois = top_boxes[idx]
+        valid = valid & jnp.isfinite(top_scores[idx])
+        rois = jnp.concatenate([jnp.zeros((rois.shape[0], 1), rois.dtype), rois],
+                               axis=1)
+        return Table(rois, valid), state
+
+
+# ---------------------------------------------------------------------------
+# ROI pooling
+
+
+class RoiPooling(Module):
+    """Quantized max ROI pooling (Fast R-CNN semantics, exact Caffe bin
+    rounding).  reference: nn/RoiPooling.scala.
+
+    Input Table(features (1, H, W, C), rois (R, 5) as (batch_idx, x1, y1,
+    x2, y2) in image coords).  Output (R, PH, PW, C).
+    """
+
+    def __init__(self, pooled_h: int, pooled_w: int, spatial_scale: float,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.ph = pooled_h
+        self.pw = pooled_w
+        self.spatial_scale = spatial_scale
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        feats, rois = x[1], x[2]
+        fmap = feats[0]  # (H, W, C); batch 1 like the reference
+        h, w, _ = fmap.shape
+        ph, pw = self.ph, self.pw
+
+        def pool_one(roi):
+            x1 = jnp.round(roi[1] * self.spatial_scale)
+            y1 = jnp.round(roi[2] * self.spatial_scale)
+            x2 = jnp.round(roi[3] * self.spatial_scale)
+            y2 = jnp.round(roi[4] * self.spatial_scale)
+            roi_w = jnp.maximum(x2 - x1 + 1.0, 1.0)
+            roi_h = jnp.maximum(y2 - y1 + 1.0, 1.0)
+            bin_w = roi_w / pw
+            bin_h = roi_h / ph
+            # membership matrices: Mh (PH, H), Mw (PW, W)
+            hh = jnp.arange(h, dtype=jnp.float32)
+            wwv = jnp.arange(w, dtype=jnp.float32)
+            pa = jnp.arange(ph, dtype=jnp.float32)
+            pb = jnp.arange(pw, dtype=jnp.float32)
+            hstart = jnp.clip(jnp.floor(pa * bin_h) + y1, 0, h)
+            hend = jnp.clip(jnp.ceil((pa + 1) * bin_h) + y1, 0, h)
+            wstart = jnp.clip(jnp.floor(pb * bin_w) + x1, 0, w)
+            wend = jnp.clip(jnp.ceil((pb + 1) * bin_w) + x1, 0, w)
+            mh = (hh[None, :] >= hstart[:, None]) & (hh[None, :] < hend[:, None])
+            mw = (wwv[None, :] >= wstart[:, None]) & (wwv[None, :] < wend[:, None])
+            neg = jnp.asarray(-jnp.inf, fmap.dtype)
+            # max over w per output col: (H, PW, C)
+            a_ = jnp.max(jnp.where(mw[None, :, :, None], fmap[:, None, :, :], neg),
+                         axis=2)
+            # then max over h per output row: (PH, PW, C)
+            out = jnp.max(jnp.where(mh[:, :, None, None], a_[None, :, :, :], neg),
+                          axis=1)
+            return jnp.where(jnp.isfinite(out), out, 0.0)  # empty bin -> 0
+
+        return jax.vmap(pool_one)(rois), state
+
+    def output_shape(self, input_shape):
+        feats, rois = input_shape
+        return (rois[0], self.ph, self.pw, feats[-1])
+
+
+class RoiAlign(Module):
+    """Bilinear ROI align (avg), the TPU-friendly successor the framework
+    prefers for new models; sampling_ratio fixed grid per bin."""
+
+    def __init__(self, pooled_h: int, pooled_w: int, spatial_scale: float,
+                 sampling_ratio: int = 2, name: Optional[str] = None):
+        super().__init__(name)
+        self.ph, self.pw = pooled_h, pooled_w
+        self.spatial_scale = spatial_scale
+        self.sampling_ratio = sampling_ratio
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        feats, rois = x[1], x[2]
+        fmap = feats[0]
+        h, w, c = fmap.shape
+        ph, pw, sr = self.ph, self.pw, self.sampling_ratio
+
+        def bilinear(yy, xx):
+            y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+            y1 = jnp.clip(y0 + 1, 0, h - 1)
+            x1 = jnp.clip(x0 + 1, 0, w - 1)
+            ly = jnp.clip(yy - y0, 0, 1)[..., None]
+            lx = jnp.clip(xx - x0, 0, 1)[..., None]
+            i = lambda a, b: fmap[a.astype(jnp.int32), b.astype(jnp.int32)]
+            return (i(y0, x0) * (1 - ly) * (1 - lx) + i(y0, x1) * (1 - ly) * lx
+                    + i(y1, x0) * ly * (1 - lx) + i(y1, x1) * ly * lx)
+
+        def pool_one(roi):
+            x1 = roi[1] * self.spatial_scale
+            y1 = roi[2] * self.spatial_scale
+            x2 = roi[3] * self.spatial_scale
+            y2 = roi[4] * self.spatial_scale
+            roi_w = jnp.maximum(x2 - x1, 1.0)
+            roi_h = jnp.maximum(y2 - y1, 1.0)
+            bin_w = roi_w / pw
+            bin_h = roi_h / ph
+            # sample grid: (PH*SR) x (PW*SR) points
+            gy = y1 + (jnp.arange(ph * sr, dtype=jnp.float32) + 0.5) * bin_h / sr
+            gx = x1 + (jnp.arange(pw * sr, dtype=jnp.float32) + 0.5) * bin_w / sr
+            yy, xx = jnp.meshgrid(gy, gx, indexing="ij")
+            vals = bilinear(yy, xx)  # (PH*SR, PW*SR, C)
+            vals = vals.reshape(ph, sr, pw, sr, c)
+            return vals.mean(axis=(1, 3))
+
+        return jax.vmap(pool_one)(rois), state
+
+
+# ---------------------------------------------------------------------------
+# detection outputs
+
+
+def _decode_ssd(priors: jnp.ndarray, variances: jnp.ndarray,
+                loc: jnp.ndarray) -> jnp.ndarray:
+    """Decode SSD loc predictions with prior variances (CENTER_SIZE code)."""
+    pw = priors[:, 2] - priors[:, 0]
+    ph_ = priors[:, 3] - priors[:, 1]
+    pcx = (priors[:, 0] + priors[:, 2]) / 2
+    pcy = (priors[:, 1] + priors[:, 3]) / 2
+    cx = variances[:, 0] * loc[:, 0] * pw + pcx
+    cy = variances[:, 1] * loc[:, 1] * ph_ + pcy
+    bw = jnp.exp(variances[:, 2] * loc[:, 2]) * pw
+    bh = jnp.exp(variances[:, 3] * loc[:, 3]) * ph_
+    return jnp.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2], axis=1)
+
+
+class DetectionOutputSSD(Module):
+    """SSD post-processing: decode + per-class NMS + global top-k.
+    reference: nn/DetectionOutputSSD.scala.
+
+    Input Table(loc (1, K*4), conf (1, K*n_classes), priors Table from
+    PriorBox).  Output Table(dets (keep_top_k, 6) = (class, score, x1, y1,
+    x2, y2), valid mask).
+    """
+
+    def __init__(self, n_classes: int, background_label: int = 0,
+                 nms_threshold: float = 0.45, nms_top_k: int = 400,
+                 keep_top_k: int = 200, conf_threshold: float = 0.01,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_classes = n_classes
+        self.background_label = background_label
+        self.nms_threshold = nms_threshold
+        self.nms_top_k = nms_top_k
+        self.keep_top_k = keep_top_k
+        self.conf_threshold = conf_threshold
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        loc, conf, prior_table = x[1], x[2], x[3]
+        priors, variances = prior_table[1], prior_table[2]
+        k = priors.shape[0]
+        loc = loc.reshape(k, 4)
+        conf = conf.reshape(k, self.n_classes)
+        boxes = _decode_ssd(priors, variances, loc)
+
+        all_scores, all_cls, all_box = [], [], []
+        pre_k = min(self.nms_top_k, k)
+        for c in range(self.n_classes):
+            if c == self.background_label:
+                continue
+            # pre-filter by score so the IoU matrix is (nms_top_k, nms_top_k),
+            # not (K, K) — K=8732 for SSD300 would be quadratic in priors
+            top_s, top_i = lax.top_k(conf[:, c], pre_k)
+            cand = boxes[top_i]
+            idx, valid = nms(cand, top_s, self.nms_threshold, pre_k,
+                             self.conf_threshold)
+            all_scores.append(jnp.where(valid, top_s[idx], -jnp.inf))
+            all_cls.append(jnp.full((pre_k,), c, jnp.float32))
+            all_box.append(cand[idx])
+        scores = jnp.concatenate(all_scores)
+        classes = jnp.concatenate(all_cls)
+        bxs = jnp.concatenate(all_box, axis=0)
+        topk = min(self.keep_top_k, scores.shape[0])
+        top_s, top_i = lax.top_k(scores, topk)
+        dets = jnp.concatenate([
+            classes[top_i][:, None], top_s[:, None], bxs[top_i]], axis=1)
+        return Table(dets, jnp.isfinite(top_s)), state
+
+
+class DetectionOutputFrcnn(Module):
+    """Fast R-CNN post-processing: per-class bbox regression decode,
+    per-class NMS.  reference: nn/DetectionOutputFrcnn.scala.
+
+    Input Table(rois (R, 5), cls_prob (R, n_classes), bbox_pred
+    (R, n_classes*4), im_info (2,)).  Output Table(dets (max_per_image, 6),
+    valid).
+    """
+
+    def __init__(self, n_classes: int, nms_threshold: float = 0.3,
+                 max_per_image: int = 100, conf_threshold: float = 0.05,
+                 bbox_normalize_means: Sequence[float] = (0.0, 0.0, 0.0, 0.0),
+                 bbox_normalize_stds: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_classes = n_classes
+        self.nms_threshold = nms_threshold
+        self.max_per_image = max_per_image
+        self.conf_threshold = conf_threshold
+        self.means = jnp.asarray(bbox_normalize_means, jnp.float32)
+        self.stds = jnp.asarray(bbox_normalize_stds, jnp.float32)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        rois, cls_prob, bbox_pred, im_info = x[1], x[2], x[3], x[4]
+        r = rois.shape[0]
+        boxes = rois[:, 1:5]
+        deltas = bbox_pred.reshape(r, self.n_classes, 4) * self.stds + self.means
+
+        all_scores, all_cls, all_box = [], [], []
+        for c in range(1, self.n_classes):  # 0 = background
+            dec = bbox_transform_inv(boxes, deltas[:, c, :])
+            dec = clip_boxes(dec, im_info[0], im_info[1])
+            s = cls_prob[:, c]
+            idx, valid = nms(dec, s, self.nms_threshold, r, self.conf_threshold)
+            all_scores.append(jnp.where(valid, s[idx], -jnp.inf))
+            all_cls.append(jnp.full((r,), c, jnp.float32))
+            all_box.append(dec[idx])
+        scores = jnp.concatenate(all_scores)
+        classes = jnp.concatenate(all_cls)
+        bxs = jnp.concatenate(all_box, axis=0)
+        topk = min(self.max_per_image, scores.shape[0])
+        top_s, top_i = lax.top_k(scores, topk)
+        dets = jnp.concatenate([
+            classes[top_i][:, None], top_s[:, None], bxs[top_i]], axis=1)
+        return Table(dets, jnp.isfinite(top_s)), state

@@ -100,7 +100,10 @@ class Bilinear(Module):
         if self.bias_res:
             params["bias"] = jax.random.uniform(
                 k_b, (self.output_size,), jnp.float32, -bound, bound)
-        return params, {}, input_shape
+        return params, {}, self.output_shape(input_shape)
+
+    def output_shape(self, input_shape):
+        return (input_shape[1][0], self.output_size)
 
     def apply(self, params, state, x, *, training=False, rng=None):
         a, b = x[1], x[2]
@@ -173,18 +176,29 @@ class Highway(Module):
                         bias_init=init_mod.ConstInit(-2.0))
 
     def build(self, rng, input_shape):
-        k1, k2 = jax.random.split(rng)
+        k1, k2, k3 = jax.random.split(rng, 3)
         ph, sh, _ = self.h.build(k1, input_shape)
         pt, st, _ = self.t.build(k2, input_shape)
-        return {"h": ph, "t": pt}, {"h": sh, "t": st}, input_shape
+        params = {"h": ph, "t": pt}
+        state = {"h": sh, "t": st}
+        if self.activation is not None:
+            pa, sa, _ = self.activation.build(k3, input_shape)
+            params["act"] = pa
+            state["act"] = sa
+        return params, state, input_shape
 
     def apply(self, params, state, x, *, training=False, rng=None):
-        h, _ = self.h.apply(params["h"], state["h"], x, training=training)
+        new_state = dict(state)
+        h, new_state["h"] = self.h.apply(params["h"], state["h"], x,
+                                         training=training)
         if self.activation is not None:
-            h, _ = self.activation.apply({}, {}, h, training=training)
-        t, _ = self.t.apply(params["t"], state["t"], x, training=training)
+            h, new_state["act"] = self.activation.apply(
+                params.get("act", {}), state.get("act", {}), h,
+                training=training)
+        t, new_state["t"] = self.t.apply(params["t"], state["t"], x,
+                                         training=training)
         t = jax.nn.sigmoid(t)
-        return t * h + (1.0 - t) * x, state
+        return t * h + (1.0 - t) * x, new_state
 
 
 class LookupTableSparse(Module):

@@ -40,6 +40,11 @@ class Cell(Module):
     def init_hidden(self, batch_size: int, dtype=jnp.float32) -> Any:
         return jnp.zeros((batch_size, self.hidden_size), dtype)
 
+    def init_hidden_for(self, x_t) -> Any:
+        """Zero hidden matching a per-step input (convolutional cells use
+        its spatial dims)."""
+        return self.init_hidden(x_t.shape[0], x_t.dtype)
+
     def step(self, params, x_t, hidden):
         raise NotImplementedError
 
@@ -163,28 +168,32 @@ class Recurrent(Module):
         self.return_state = return_state
 
     def build(self, rng, input_shape):
-        n, t, f = input_shape
-        p, s, _ = self.cell.build(rng, (n, f))
-        return {"cell": p}, {"cell": s}, (n, t, self.cell.hidden_size)
+        # rank-agnostic: (B, T, F) for dense cells, (B, T, H, W, C) for
+        # convolutional cells
+        n, t = input_shape[0], input_shape[1]
+        p, s, out = self.cell.build(rng, (n,) + tuple(input_shape[2:]))
+        return {"cell": p}, {"cell": s}, (n, t) + tuple(out[1:])
 
     def apply(self, params, state, x, *, training=False, rng=None):
-        n = x.shape[0]
-        h0 = self.cell.init_hidden(n, x.dtype)
-        xs = jnp.swapaxes(x, 0, 1)  # (T, B, F)
+        xs = jnp.swapaxes(x, 0, 1)  # (T, B, ...)
+        h0 = self.cell.init_hidden_for(xs[0])
 
         def body(hidden, x_t):
             out, new_hidden = self.cell.step(params["cell"], x_t, hidden)
             return new_hidden, out
 
         last_hidden, outs = lax.scan(body, h0, xs)
-        y = jnp.swapaxes(outs, 0, 1)  # (B, T, H)
+        y = jnp.swapaxes(outs, 0, 1)  # (B, T, ...)
         if self.return_state:
             return Table(y, last_hidden), state
         return y, state
 
     def output_shape(self, input_shape):
-        n, t, _ = input_shape
-        return (n, t, self.cell.hidden_size)
+        n, t = input_shape[0], input_shape[1]
+        if len(input_shape) == 3:
+            return (n, t, self.cell.hidden_size)
+        # convolutional cell: SAME-padded, spatial dims preserved
+        return (n, t) + tuple(input_shape[2:-1]) + (self.cell.hidden_size,)
 
 
 def LSTM(input_size: int, hidden_size: int, name: Optional[str] = None) -> Recurrent:
@@ -254,3 +263,184 @@ class TimeDistributed(Module):
         y, s = self.inner.apply(params["inner"], state["inner"], flat,
                                 training=training, rng=rng)
         return jnp.reshape(y, (n, t) + y.shape[1:]), {"inner": s}
+
+
+class LSTMPeephole(Cell):
+    """LSTM with peephole connections: i and f gates see c_prev, o sees the
+    new c.  reference: nn/LSTMPeephole.scala.  Hidden is Table(h, c)."""
+
+    def __init__(self, input_size: int, hidden_size: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+    def build(self, rng, input_shape):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        xavier = init_mod.Xavier()
+        h = self.hidden_size
+        params = {
+            "w_ih": xavier(k1, (self.input_size, 4 * h), self.input_size, h),
+            "w_hh": xavier(k2, (h, 4 * h), h, h),
+            # per-channel peephole weights (the reference's CMul vectors)
+            "peep": xavier(k3, (3, h), h, h),
+            "bias": jnp.zeros((4 * h,), jnp.float32),
+        }
+        n = input_shape[0]
+        return params, {}, (n, h)
+
+    def init_hidden(self, batch_size: int, dtype=jnp.float32):
+        z = jnp.zeros((batch_size, self.hidden_size), dtype)
+        return Table(z, z)
+
+    def step(self, params, x_t, hidden):
+        h_prev, c_prev = hidden[1], hidden[2]
+        gates = x_t @ params["w_ih"] + h_prev @ params["w_hh"] + params["bias"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        p_i, p_f, p_o = params["peep"][0], params["peep"][1], params["peep"][2]
+        i = jax.nn.sigmoid(i + p_i * c_prev)
+        f = jax.nn.sigmoid(f + p_f * c_prev)
+        g = jnp.tanh(g)
+        c = f * c_prev + i * g
+        o = jax.nn.sigmoid(o + p_o * c)
+        h = o * jnp.tanh(c)
+        return h, Table(h, c)
+
+
+class ConvLSTMPeephole(Cell):
+    """Convolutional LSTM (2-D) with optional peepholes over NHWC maps.
+    reference: nn/ConvLSTMPeephole.scala (kernelI over input, kernelC over
+    hidden, SAME padding so spatial dims are preserved)."""
+
+    def __init__(self, input_size: int, output_size: int, kernel_i: int = 3,
+                 kernel_c: int = 3, stride: int = 1, with_peephole: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        assert stride == 1, "ConvLSTM hidden recurrence requires stride 1"
+        self.input_size = input_size
+        self.hidden_size = output_size
+        self.kernel_i = kernel_i
+        self.kernel_c = kernel_c
+        self.with_peephole = with_peephole
+        self._spatial: Optional[Tuple[int, int]] = None
+
+    def build(self, rng, input_shape):
+        # input_shape: (B, H, W, C_in)
+        k1, k2, k3 = jax.random.split(rng, 3)
+        xavier = init_mod.Xavier()
+        ci, co = self.input_size, self.hidden_size
+        ki, kc = self.kernel_i, self.kernel_c
+        params = {
+            "w_ih": xavier(k1, (ki, ki, ci, 4 * co), ki * ki * ci, ki * ki * co),
+            "w_hh": xavier(k2, (kc, kc, co, 4 * co), kc * kc * co, kc * kc * co),
+            "bias": jnp.zeros((4 * co,), jnp.float32),
+        }
+        if self.with_peephole:
+            params["peep"] = xavier(k3, (3, co), co, co)
+        self._spatial = tuple(input_shape[1:3])
+        n = input_shape[0]
+        return params, {}, (n,) + self._spatial + (co,)
+
+    def init_hidden(self, batch_size: int, dtype=jnp.float32):
+        assert self._spatial is not None, "build() first"
+        z = jnp.zeros((batch_size,) + self._spatial + (self.hidden_size,), dtype)
+        return Table(z, z)
+
+    def init_hidden_for(self, x_t):
+        z = jnp.zeros(x_t.shape[:-1] + (self.hidden_size,), x_t.dtype)
+        return Table(z, z)
+
+    def step(self, params, x_t, hidden):
+        h_prev, c_prev = hidden[1], hidden[2]
+        dimspec = ("NHWC", "HWIO", "NHWC")
+        gates = (
+            lax.conv_general_dilated(x_t, params["w_ih"], (1, 1), "SAME",
+                                     dimension_numbers=dimspec)
+            + lax.conv_general_dilated(h_prev, params["w_hh"], (1, 1), "SAME",
+                                       dimension_numbers=dimspec)
+            + params["bias"])
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        if self.with_peephole:
+            p_i, p_f, p_o = params["peep"][0], params["peep"][1], params["peep"][2]
+            i = jax.nn.sigmoid(i + p_i * c_prev)
+            f = jax.nn.sigmoid(f + p_f * c_prev)
+        else:
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        c = f * c_prev + i * g
+        if self.with_peephole:
+            o = jax.nn.sigmoid(o + p_o * c)
+        else:
+            o = jax.nn.sigmoid(o)
+        h = o * jnp.tanh(c)
+        return h, Table(h, c)
+
+
+class MultiRNNCell(Cell):
+    """Stack of cells applied in sequence within one timestep; hidden is a
+    Table of each cell's hidden.  reference: nn/MultiRNNCell.scala."""
+
+    def __init__(self, cells, name: Optional[str] = None):
+        super().__init__(name)
+        self.cells = list(cells)
+        self.hidden_size = self.cells[-1].hidden_size
+
+    def build(self, rng, input_shape):
+        keys = jax.random.split(rng, len(self.cells))
+        params, states = {}, {}
+        shape = input_shape
+        for idx, (k, cell) in enumerate(zip(keys, self.cells)):
+            p, s, shape = cell.build(k, shape)
+            params[str(idx)] = p
+            states[str(idx)] = s
+        return params, states, shape
+
+    def init_hidden(self, batch_size: int, dtype=jnp.float32):
+        return Table(*[c.init_hidden(batch_size, dtype) for c in self.cells])
+
+    def init_hidden_for(self, x_t):
+        return Table(*[c.init_hidden_for(x_t) for c in self.cells])
+
+    def step(self, params, x_t, hidden):
+        new_hiddens = []
+        out = x_t
+        for idx, cell in enumerate(self.cells):
+            out, h = cell.step(params[str(idx)], out, hidden[idx + 1])
+            new_hiddens.append(h)
+        return out, Table(*new_hiddens)
+
+
+class RecurrentDecoder(Module):
+    """Autoregressive decoder: scans `seq_length` steps feeding each step's
+    output back as the next input (cell output size must equal its input
+    size).  Input is the first-step input (B, F) or (B, H, W, C); output is
+    (B, T, ...).  reference: nn/RecurrentDecoder.scala."""
+
+    def __init__(self, cell: Cell, seq_length: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.cell = cell
+        self.seq_length = seq_length
+
+    def build(self, rng, input_shape):
+        p, s, out = self.cell.build(rng, input_shape)
+        if tuple(out) != tuple(input_shape):
+            raise ValueError(
+                f"RecurrentDecoder feeds outputs back as inputs; the cell "
+                f"output shape {tuple(out)} must equal its input shape "
+                f"{tuple(input_shape)} (reference: RecurrentDecoder.scala "
+                f"requires outputSize == inputSize)")
+        return {"cell": p}, {"cell": s}, (out[0], self.seq_length) + tuple(out[1:])
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        h0 = self.cell.init_hidden_for(x)
+
+        def body(carry, _):
+            inp, hidden = carry
+            out, new_hidden = self.cell.step(params["cell"], inp, hidden)
+            return (out, new_hidden), out
+
+        _, outs = lax.scan(body, (x, h0), None, length=self.seq_length)
+        return jnp.swapaxes(outs, 0, 1), state
+
+    def output_shape(self, input_shape):
+        return (input_shape[0], self.seq_length) + tuple(input_shape[1:])

@@ -43,8 +43,9 @@ class Echo(Module):
     without forcing a host sync of the values."""
 
     def apply(self, params, state, x, *, training=False, rng=None):
+        shapes = jax.tree_util.tree_map(lambda a: jnp.shape(a), x)
         jax.debug.print("{name}: shape={shape}", name=self.name,
-                        shape=str(jnp.shape(x)))
+                        shape=str(shapes))
         return x, state
 
 

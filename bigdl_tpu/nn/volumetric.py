@@ -1,0 +1,221 @@
+"""Volumetric (3-D) convolution and pooling layers (NDHWC, DHWIO kernels).
+
+Reference: nn/VolumetricConvolution.scala, nn/VolumetricFullConvolution.scala,
+nn/VolumetricMaxPooling.scala, nn/VolumetricAveragePooling.scala.  The
+reference unfolds 3-D volumes into im2col matrices per output frame; here a
+single `lax.conv_general_dilated` over three spatial dims hits the MXU
+directly.
+
+Argument order mirrors the reference: (kT, kW, kH, dT, dW, dH, padT, padW,
+padH) — temporal first, then width, then height.  Internally everything is
+(D=T, H, W) with NDHWC activations.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.pooling import _pool_out, _window_pad
+
+_DIMSPEC_3D = ("NDHWC", "DHWIO", "NDHWC")
+
+
+def _conv_out(size: int, k: int, stride: int, pad: int) -> int:
+    if pad == -1:  # SAME
+        return -(-size // stride)
+    return (size + 2 * pad - k) // stride + 1
+
+
+def _pad3d(pads, in_dhw, kernel, stride):
+    out = []
+    for p, s, k, st in zip(pads, in_dhw, kernel, stride):
+        if p == -1:  # TF-style SAME
+            total = max(0, (-(-s // st) - 1) * st + k - s)
+            out.append((total // 2, total - total // 2))
+        else:
+            out.append((p, p))
+    return out
+
+
+class VolumetricConvolution(Module):
+    """3-D convolution. reference: nn/VolumetricConvolution.scala."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 k_t: int, k_w: int, k_h: int,
+                 d_t: int = 1, d_w: int = 1, d_h: int = 1,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 with_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.n_input = n_input_plane
+        self.n_output = n_output_plane
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t, d_h, d_w)
+        self.pad = (pad_t, pad_h, pad_w)
+        self.with_bias = with_bias
+        self.weight_init = init_mod.MsraFiller(False)
+        self.bias_init = init_mod.Zeros()
+
+    def set_init_method(self, weight_init=None, bias_init=None):
+        if weight_init is not None:
+            self.weight_init = weight_init
+        if bias_init is not None:
+            self.bias_init = bias_init
+        return self
+
+    def build(self, rng, input_shape):
+        k_w, k_b = jax.random.split(rng)
+        kt, kh, kw = self.kernel
+        fan_in = self.n_input * kt * kh * kw
+        fan_out = self.n_output * kt * kh * kw
+        params = {"weight": self.weight_init(
+            k_w, (kt, kh, kw, self.n_input, self.n_output), fan_in, fan_out)}
+        if self.with_bias:
+            params["bias"] = self.bias_init(k_b, (self.n_output,), fan_in, fan_out)
+        return params, {}, self.output_shape(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = lax.conv_general_dilated(
+            x, params["weight"], window_strides=self.stride,
+            padding=_pad3d(self.pad, x.shape[1:4], self.kernel, self.stride),
+            dimension_numbers=_DIMSPEC_3D)
+        if self.with_bias:
+            y = y + params["bias"]
+        return y, state
+
+    def output_shape(self, input_shape):
+        n, d, h, w, _ = input_shape
+        kt, kh, kw = self.kernel
+        st, sh, sw = self.stride
+        return (n, _conv_out(d, kt, st, self.pad[0]),
+                _conv_out(h, kh, sh, self.pad[1]),
+                _conv_out(w, kw, sw, self.pad[2]), self.n_output)
+
+
+class VolumetricFullConvolution(Module):
+    """3-D transposed convolution (deconvolution).
+    reference: nn/VolumetricFullConvolution.scala (adjT/adjW/adjH extend the
+    output on the high side, as in Torch)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 k_t: int, k_w: int, k_h: int,
+                 d_t: int = 1, d_w: int = 1, d_h: int = 1,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 adj_t: int = 0, adj_w: int = 0, adj_h: int = 0,
+                 with_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        assert adj_t < d_t and adj_w < d_w and adj_h < d_h, \
+            "adj must be smaller than the stride"
+        self.n_input = n_input_plane
+        self.n_output = n_output_plane
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t, d_h, d_w)
+        self.pad = (pad_t, pad_h, pad_w)
+        self.adj = (adj_t, adj_h, adj_w)
+        self.with_bias = with_bias
+        self.weight_init = init_mod.MsraFiller(False)
+        self.bias_init = init_mod.Zeros()
+
+    def build(self, rng, input_shape):
+        k_w, k_b = jax.random.split(rng)
+        kt, kh, kw = self.kernel
+        fan_in = self.n_input * kt * kh * kw
+        fan_out = self.n_output * kt * kh * kw
+        params = {"weight": self.weight_init(
+            k_w, (kt, kh, kw, self.n_input, self.n_output), fan_in, fan_out)}
+        if self.with_bias:
+            params["bias"] = self.bias_init(k_b, (self.n_output,), fan_in, fan_out)
+        return params, {}, self.output_shape(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        # transposed conv = lhs-dilated conv with flipped-effective padding
+        pads = []
+        for k, p, a in zip(self.kernel, self.pad, self.adj):
+            pads.append((k - 1 - p, k - 1 - p + a))
+        y = lax.conv_general_dilated(
+            x, jnp.flip(params["weight"], axis=(0, 1, 2)),
+            window_strides=(1, 1, 1), padding=pads,
+            lhs_dilation=self.stride,
+            dimension_numbers=_DIMSPEC_3D)
+        if self.with_bias:
+            y = y + params["bias"]
+        return y, state
+
+    def output_shape(self, input_shape):
+        n, d, h, w, _ = input_shape
+        out = [(s - 1) * st - 2 * p + k + a
+               for s, st, p, k, a in zip((d, h, w), self.stride, self.pad,
+                                         self.kernel, self.adj)]
+        return (n, *out, self.n_output)
+
+
+class VolumetricMaxPooling(Module):
+    """reference: nn/VolumetricMaxPooling.scala."""
+
+    def __init__(self, k_t: int, k_w: Optional[int] = None, k_h: Optional[int] = None,
+                 d_t: Optional[int] = None, d_w: Optional[int] = None,
+                 d_h: Optional[int] = None,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 ceil_mode: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        k_w = k_t if k_w is None else k_w
+        k_h = k_t if k_h is None else k_h
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t or k_t, d_h or k_h, d_w or k_w)
+        self.pad = (pad_t, pad_h, pad_w)
+        self.ceil_mode = ceil_mode
+
+    def set_ceil_mode(self):
+        self.ceil_mode = True
+        return self
+
+    def _pads(self, dhw):
+        return [_window_pad(s, k, st, p, self.ceil_mode)
+                for s, k, st, p in zip(dhw, self.kernel, self.stride, self.pad)]
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        pads = self._pads(x.shape[1:4])
+        y = lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, *self.kernel, 1), (1, *self.stride, 1),
+            [(0, 0), *pads, (0, 0)])
+        return y, state
+
+    def output_shape(self, input_shape):
+        n, d, h, w, c = input_shape
+        out = [_pool_out(s, k, st, p, self.ceil_mode)
+               for s, k, st, p in zip((d, h, w), self.kernel, self.stride, self.pad)]
+        return (n, *out, c)
+
+
+class VolumetricAveragePooling(VolumetricMaxPooling):
+    """reference: nn/VolumetricAveragePooling.scala.  `count_include_pad`
+    matches the reference's countIncludePad."""
+
+    def __init__(self, k_t: int, k_w: Optional[int] = None, k_h: Optional[int] = None,
+                 d_t: Optional[int] = None, d_w: Optional[int] = None,
+                 d_h: Optional[int] = None,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 ceil_mode: bool = False, count_include_pad: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(k_t, k_w, k_h, d_t, d_w, d_h, pad_t, pad_w, pad_h,
+                         ceil_mode, name=name)
+        self.count_include_pad = count_include_pad
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        pads = self._pads(x.shape[1:4])
+        window = (1, *self.kernel, 1)
+        strides = (1, *self.stride, 1)
+        full_pads = [(0, 0), *pads, (0, 0)]
+        total = lax.reduce_window(x, 0.0, lax.add, window, strides, full_pads)
+        if self.count_include_pad:
+            y = total / float(self.kernel[0] * self.kernel[1] * self.kernel[2])
+        else:
+            ones = jnp.ones(x.shape[1:4], x.dtype)[None, ..., None]
+            count = lax.reduce_window(ones, 0.0, lax.add, window, strides, full_pads)
+            y = total / count
+        return y, state

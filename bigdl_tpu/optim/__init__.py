@@ -9,6 +9,7 @@ from bigdl_tpu.optim.optim_method import (
     OptimMethod, SGD, Adam, ParallelAdam, Adamax, Adadelta, Adagrad,
     RMSprop, Ftrl,
 )
+from bigdl_tpu.optim.lbfgs import LBFGS
 from bigdl_tpu.optim import schedules
 from bigdl_tpu.optim.schedules import (
     Default, Poly, Step, MultiStep, EpochDecay, EpochStep, NaturalExp,

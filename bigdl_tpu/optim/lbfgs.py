@@ -1,0 +1,229 @@
+"""L-BFGS with strong-Wolfe cubic line search.
+
+Reference: optim/LBFGS.scala + optim/LineSearch.scala (the `lswolfe`
+interpolating line search).  Like the reference, this is a *closure-driven*
+full-batch method: `optimize(feval, params)` where
+`feval(params) -> (loss, grads)`; the reference signature is
+`optimize(feval: Tensor => (T, Tensor), x: Tensor)`.  It runs driver-side
+(Python loop over inner iterations — data-dependent termination cannot live
+inside one XLA program), but `feval` itself is typically a jitted
+value_and_grad, so every heavy evaluation is one compiled TPU step.
+
+State is kept on a raveled 1-D vector (jax.flatten_util.ravel_pytree), the
+same flattened-parameter view the reference's `model.getParameters()`
+produces (optim/DistriOptimizer.scala:809).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from bigdl_tpu.optim.optim_method import OptimMethod
+
+
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2, bounds=None):
+    """Minimizer of the cubic through (x1,f1,g1),(x2,f2,g2).
+    reference: optim/LineSearch.scala polyinterp."""
+    if bounds is not None:
+        xmin_bound, xmax_bound = bounds
+    else:
+        xmin_bound, xmax_bound = (x1, x2) if x1 <= x2 else (x2, x1)
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    d2_square = d1 ** 2 - g1 * g2
+    if d2_square >= 0:
+        d2 = d2_square ** 0.5
+        if x1 <= x2:
+            min_pos = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2 * d2))
+        else:
+            min_pos = x1 - (x1 - x2) * ((g1 + d2 - d1) / (g1 - g2 + 2 * d2))
+        return min(max(min_pos, xmin_bound), xmax_bound)
+    return (xmin_bound + xmax_bound) / 2.0
+
+
+def _strong_wolfe(feval_1d: Callable[[float], Tuple[float, float]],
+                  t: float, f0: float, g0: float,
+                  c1: float = 1e-4, c2: float = 0.9,
+                  tolerance_change: float = 1e-9,
+                  max_ls: int = 25) -> Tuple[float, float, int]:
+    """Strong-Wolfe line search on the 1-D slice f(t) = feval(x + t*d).
+
+    Returns (f_new, t, n_evals).  reference: optim/LineSearch.scala lswolfe.
+    """
+    f_prev, g_prev, t_prev = f0, g0, 0.0
+    f_new, g_new = feval_1d(t)
+    ls_iter = 1
+
+    # bracketing phase
+    bracket = None
+    while ls_iter < max_ls:
+        if f_new > f0 + c1 * t * g0 or (ls_iter > 1 and f_new >= f_prev):
+            bracket = (t_prev, f_prev, g_prev, t, f_new, g_new)
+            break
+        if abs(g_new) <= -c2 * g0:
+            return f_new, t, ls_iter
+        if g_new >= 0:
+            bracket = (t, f_new, g_new, t_prev, f_prev, g_prev)
+            break
+        t_next = _cubic_interpolate(t_prev, f_prev, g_prev, t, f_new, g_new,
+                                    bounds=(t + 0.01 * (t - t_prev),
+                                            t * 10))
+        t_prev, f_prev, g_prev = t, f_new, g_new
+        t = t_next
+        f_new, g_new = feval_1d(t)
+        ls_iter += 1
+    if bracket is None:  # ran out while bracketing
+        return f_new, t, ls_iter
+
+    # zoom phase
+    t_lo, f_lo, g_lo, t_hi, f_hi, g_hi = bracket
+    while ls_iter < max_ls:
+        if abs(t_hi - t_lo) * 1.0 < tolerance_change:
+            break
+        t = _cubic_interpolate(t_lo, f_lo, g_lo, t_hi, f_hi, g_hi)
+        # keep t a sensible fraction inside the bracket
+        lo, hi = (t_lo, t_hi) if t_lo <= t_hi else (t_hi, t_lo)
+        eps = 0.1 * (hi - lo)
+        if min(t - lo, hi - t) < eps:
+            t = max(min(t, hi - eps), lo + eps)
+        f_new, g_new = feval_1d(t)
+        ls_iter += 1
+        if f_new > f0 + c1 * t * g0 or f_new >= f_lo:
+            t_hi, f_hi, g_hi = t, f_new, g_new
+        else:
+            if abs(g_new) <= -c2 * g0:
+                return f_new, t, ls_iter
+            if g_new * (t_hi - t_lo) >= 0:
+                t_hi, f_hi, g_hi = t_lo, f_lo, g_lo
+            t_lo, f_lo, g_lo = t, f_new, g_new
+    return f_lo, t_lo, ls_iter
+
+
+class LBFGS(OptimMethod):
+    """Limited-memory BFGS. reference: optim/LBFGS.scala.
+
+    `optimize(feval, params)` performs up to `max_iter` quasi-Newton
+    iterations on the full batch and returns `(new_params, f_history)` —
+    the reference returns `(x, history of f)` the same way.
+    """
+
+    def __init__(self, max_iter: int = 20, max_eval: Optional[float] = None,
+                 tolerance_fun: float = 1e-5, tolerance_x: float = 1e-9,
+                 n_correction: int = 100, learning_rate: float = 1.0,
+                 line_search: bool = True,
+                 line_search_options: Optional[dict] = None):
+        super().__init__(learning_rate)
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else max_iter * 1.25
+        self.tolerance_fun = tolerance_fun
+        self.tolerance_x = tolerance_x
+        self.n_correction = n_correction
+        self.line_search = line_search
+        self.line_search_options = line_search_options or {}
+
+    def optimize(self, feval: Callable[[Any], Tuple[Any, Any]],
+                 params: Any) -> Tuple[Any, List[float]]:
+        x0, unravel = ravel_pytree(params)
+
+        def eval_flat(x):
+            loss, grads = feval(unravel(x))
+            g, _ = ravel_pytree(grads)
+            return jnp.asarray(loss, jnp.float32), g.astype(x.dtype)
+
+        x = x0
+        f, g = eval_flat(x)
+        f_hist = [float(f)]
+        n_eval = 1
+        if float(jnp.abs(g).sum()) <= self.tolerance_fun:
+            return unravel(x), f_hist  # already at a critical point
+
+        old_dirs: List[jnp.ndarray] = []  # y_k
+        old_steps: List[jnp.ndarray] = []  # s_k
+        ro: List[jnp.ndarray] = []
+        h_diag = 1.0
+        g_prev = None
+        d = -g
+        t = min(1.0, 1.0 / float(jnp.abs(g).sum())) * self.learning_rate
+
+        for n_iter in range(self.max_iter):
+            if n_iter > 0:
+                y = g - g_prev
+                s = d * t
+                ys = float(jnp.dot(y, s))
+                if ys > 1e-10:
+                    if len(old_dirs) == self.n_correction:
+                        old_dirs.pop(0)
+                        old_steps.pop(0)
+                        ro.pop(0)
+                    old_dirs.append(y)
+                    old_steps.append(s)
+                    ro.append(1.0 / ys)
+                    h_diag = ys / float(jnp.dot(y, y))
+                # two-loop recursion
+                k = len(old_dirs)
+                al = [0.0] * k
+                q = -g
+                for i in range(k - 1, -1, -1):
+                    al[i] = float(jnp.dot(old_steps[i], q)) * ro[i]
+                    q = q - al[i] * old_dirs[i]
+                d = q * h_diag
+                for i in range(k):
+                    be_i = float(jnp.dot(old_dirs[i], d)) * ro[i]
+                    d = d + old_steps[i] * (al[i] - be_i)
+            g_prev = g
+
+            gtd = float(jnp.dot(g, d))
+            if gtd > -self.tolerance_x:
+                break  # not a descent direction
+            if n_iter > 0:
+                t = self.learning_rate
+
+            f_old = float(f)
+            if self.line_search:
+                # cache (f, g) per step size so the accepted point's full
+                # gradient is reused instead of re-evaluating feval
+                cache = {}
+
+                def feval_1d(step, x=x, d=d):
+                    f_s, g_s = eval_flat(x + step * d)
+                    cache[float(step)] = (f_s, g_s)
+                    return float(f_s), float(jnp.dot(g_s, d))
+
+                f_new, t, ls_evals = _strong_wolfe(
+                    feval_1d, t, float(f), gtd, **self.line_search_options)
+                n_eval += ls_evals
+                x = x + t * d
+                if float(t) in cache:
+                    f, g = cache[float(t)]
+                else:
+                    f, g = eval_flat(x)
+                    n_eval += 1
+            else:
+                x = x + t * d
+                f, g = eval_flat(x)
+                n_eval += 1
+            f_hist.append(float(f))
+
+            # termination checks (reference: LBFGS.scala end-of-loop tests)
+            if n_eval >= self.max_eval:
+                break
+            if float(jnp.abs(g).sum()) <= self.tolerance_fun:
+                break
+            if float(jnp.abs(t * d).sum()) <= self.tolerance_x:
+                break
+            if abs(float(f) - f_old) < self.tolerance_fun:
+                break
+
+        return unravel(x), f_hist
+
+    def step(self, grads, params, opt_state, lr=None):
+        raise NotImplementedError(
+            "LBFGS is closure-driven; use optimize(feval, params) "
+            "(reference: optim/LBFGS.scala optimize(feval, x))")
+
+    def get_hyper_parameter(self) -> str:
+        return (f"maxIter={self.max_iter} nCorrection={self.n_correction} "
+                f"lineSearch={'wolfe' if self.line_search else 'fixed'}")

@@ -1,5 +1,6 @@
 from bigdl_tpu.utils.checkpoint import save_checkpoint, load_checkpoint, latest_checkpoint
 from bigdl_tpu.utils.summary import TrainSummary, ValidationSummary
+from bigdl_tpu.utils.torchfile import load_t7, save_t7, TorchObject
 from bigdl_tpu.utils.serializer import (
     save_model,
     load_model,
@@ -16,4 +17,5 @@ __all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
            "TrainSummary", "ValidationSummary",
            "save_model", "load_model", "module_to_spec", "module_from_spec",
            "criterion_to_spec", "criterion_from_spec",
-           "register_module", "register_criterion", "register_fn"]
+           "register_module", "register_criterion", "register_fn",
+           "load_t7", "save_t7", "TorchObject"]

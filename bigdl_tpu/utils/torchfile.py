@@ -1,0 +1,265 @@
+"""Torch7 `.t7` binary serialization (read + write).
+
+Reference: utils/TorchFile.scala (loadTorch/saveTorch) + utils/File.scala:36-48.
+The reference uses this to exchange models/tensors with Torch7; here it is a
+pure-Python codec mapping
+
+    torch.*Tensor  <->  numpy.ndarray   (strided read honoured, contiguous write)
+    lua table      <->  dict (or list when keys are 1..n)
+    number/string/boolean/nil  <->  float/str/bool/None
+
+API: `load_t7(path)` / `save_t7(path, obj)`.  Unknown torch classes load as
+`TorchObject(torch_typename, contents_dict)` so nn.* module files remain
+inspectable even without a layer converter.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Any, BinaryIO, Dict, Optional
+
+import numpy as np
+
+TYPE_NIL = 0
+TYPE_NUMBER = 1
+TYPE_STRING = 2
+TYPE_TABLE = 3
+TYPE_TORCH = 4
+TYPE_BOOLEAN = 5
+TYPE_FUNCTION = 6
+TYPE_RECUR_FUNCTION = 8
+TYPE_LEGACY_RECUR_FUNCTION = 7
+
+_TENSOR_DTYPES = {
+    "torch.DoubleTensor": np.float64,
+    "torch.FloatTensor": np.float32,
+    "torch.LongTensor": np.int64,
+    "torch.IntTensor": np.int32,
+    "torch.ShortTensor": np.int16,
+    "torch.CharTensor": np.int8,
+    "torch.ByteTensor": np.uint8,
+}
+_STORAGE_DTYPES = {k.replace("Tensor", "Storage"): v for k, v in _TENSOR_DTYPES.items()}
+_NP_TO_TENSOR = {
+    np.dtype(np.float64): "torch.DoubleTensor",
+    np.dtype(np.float32): "torch.FloatTensor",
+    np.dtype(np.int64): "torch.LongTensor",
+    np.dtype(np.int32): "torch.IntTensor",
+    np.dtype(np.int16): "torch.ShortTensor",
+    np.dtype(np.int8): "torch.CharTensor",
+    np.dtype(np.uint8): "torch.ByteTensor",
+}
+
+
+@dataclass
+class TorchObject:
+    """An arbitrary `torch.class` instance (e.g. an nn layer)."""
+
+    torch_typename: str
+    contents: Any
+
+
+class _Reader:
+    def __init__(self, f: BinaryIO):
+        self.f = f
+        self.memo: Dict[int, Any] = {}
+
+    def _read(self, fmt: str):
+        size = struct.calcsize(fmt)
+        data = self.f.read(size)
+        if len(data) != size:
+            raise EOFError("truncated .t7 file")
+        return struct.unpack(fmt, data)[0]
+
+    def _int(self) -> int:
+        return self._read("<i")
+
+    def _long(self) -> int:
+        return self._read("<q")
+
+    def _string(self) -> str:
+        n = self._int()
+        return self.f.read(n).decode("utf-8", errors="replace")
+
+    def read_object(self) -> Any:
+        typeidx = self._int()
+        if typeidx == TYPE_NIL:
+            return None
+        if typeidx == TYPE_NUMBER:
+            v = self._read("<d")
+            return int(v) if float(v).is_integer() and abs(v) < 2 ** 53 else v
+        if typeidx == TYPE_STRING:
+            return self._string()
+        if typeidx == TYPE_BOOLEAN:
+            return bool(self._int())
+        if typeidx in (TYPE_TABLE, TYPE_TORCH, TYPE_FUNCTION,
+                       TYPE_RECUR_FUNCTION, TYPE_LEGACY_RECUR_FUNCTION):
+            index = self._int()
+            if index in self.memo:
+                return self.memo[index]
+            if typeidx == TYPE_TORCH:
+                return self._read_torch(index)
+            if typeidx == TYPE_TABLE:
+                return self._read_table(index)
+            # function dump: size + bytecode, then upvalue table — keep opaque
+            n = self._int()
+            code = self.f.read(n)
+            upvalues = self.read_object()
+            obj = TorchObject("function", {"bytecode": code, "upvalues": upvalues})
+            self.memo[index] = obj
+            return obj
+        raise ValueError(f"unknown .t7 type tag {typeidx}")
+
+    def _read_version_and_class(self):
+        s = self._string()
+        if s.startswith("V "):
+            return int(s[2:]), self._string()
+        return 0, s  # legacy files have no version record
+
+    def _read_torch(self, index: int) -> Any:
+        _version, cls = self._read_version_and_class()
+        if cls in _TENSOR_DTYPES:
+            ndim = self._int()
+            sizes = [self._long() for _ in range(ndim)]
+            strides = [self._long() for _ in range(ndim)]
+            offset = self._long() - 1  # 1-based in the file
+            storage = self.read_object()  # the Storage object
+            if ndim == 0 or storage is None:
+                arr = np.zeros(sizes, _TENSOR_DTYPES[cls])
+            else:
+                arr = np.lib.stride_tricks.as_strided(
+                    storage[offset:], shape=sizes,
+                    strides=[s * storage.itemsize for s in strides]).copy()
+            self.memo[index] = arr
+            return arr
+        if cls in _STORAGE_DTYPES:
+            n = self._long()
+            dtype = np.dtype(_STORAGE_DTYPES[cls])
+            arr = np.frombuffer(self.f.read(n * dtype.itemsize), dtype).copy()
+            self.memo[index] = arr
+            return arr
+        # arbitrary torch class: its contents follow as one object
+        obj = TorchObject(cls, None)
+        self.memo[index] = obj  # memoize BEFORE recursing (cycles)
+        obj.contents = self.read_object()
+        return obj
+
+    def _read_table(self, index: int) -> Any:
+        n = self._int()
+        table: Dict[Any, Any] = {}
+        self.memo[index] = table
+        for _ in range(n):
+            k = self.read_object()
+            v = self.read_object()
+            table[k] = v
+        # tables keyed 1..n are lua arrays -> python list
+        if table and all(isinstance(k, int) for k in table):
+            keys = sorted(table)
+            if keys == list(range(1, len(keys) + 1)):
+                lst = [table[k] for k in keys]
+                self.memo[index] = lst
+                return lst
+        return table
+
+
+class _Writer:
+    def __init__(self, f: BinaryIO):
+        self.f = f
+        self.memo: Dict[int, int] = {}  # id(obj) -> heap index
+        self.next_index = 1
+
+    def _write(self, fmt: str, v):
+        self.f.write(struct.pack(fmt, v))
+
+    def _int(self, v: int):
+        self._write("<i", v)
+
+    def _string(self, s: str):
+        b = s.encode("utf-8")
+        self._int(len(b))
+        self.f.write(b)
+
+    def _heap_index(self, obj) -> Optional[int]:
+        """Returns the existing index (after writing it) or None for new."""
+        key = id(obj)
+        if key in self.memo:
+            self._int(self.memo[key])
+            return self.memo[key]
+        self.memo[key] = self.next_index
+        self._int(self.next_index)
+        self.next_index += 1
+        return None
+
+    def write_object(self, obj: Any):
+        if obj is None:
+            self._int(TYPE_NIL)
+        elif isinstance(obj, bool):  # before int check
+            self._int(TYPE_BOOLEAN)
+            self._int(1 if obj else 0)
+        elif isinstance(obj, (int, float, np.integer, np.floating)):
+            self._int(TYPE_NUMBER)
+            self._write("<d", float(obj))
+        elif isinstance(obj, str):
+            self._int(TYPE_STRING)
+            self._string(obj)
+        elif isinstance(obj, np.ndarray):
+            self._int(TYPE_TORCH)
+            if self._heap_index(obj) is None:
+                self._write_tensor(obj)
+        elif isinstance(obj, TorchObject):
+            self._int(TYPE_TORCH)
+            if self._heap_index(obj) is None:
+                self._string("V 1")
+                self._string(obj.torch_typename)
+                self.write_object(obj.contents)
+        elif isinstance(obj, (dict, list, tuple)):
+            self._int(TYPE_TABLE)
+            if self._heap_index(obj) is None:
+                items = (list(enumerate(obj, start=1))
+                         if isinstance(obj, (list, tuple)) else list(obj.items()))
+                self._int(len(items))
+                for k, v in items:
+                    self.write_object(k)
+                    self.write_object(v)
+        else:
+            raise TypeError(f"cannot serialize {type(obj).__name__} to .t7")
+
+    def _write_tensor(self, arr: np.ndarray):
+        if arr.dtype not in _NP_TO_TENSOR:
+            arr = arr.astype(np.float32)
+        cls = _NP_TO_TENSOR[arr.dtype]
+        arr = np.ascontiguousarray(arr)
+        self._string("V 1")
+        self._string(cls)
+        self._int(arr.ndim)
+        for s in arr.shape:
+            self._write("<q", s)
+        stride = 1
+        strides = []
+        for s in reversed(arr.shape):
+            strides.append(stride)
+            stride *= s
+        for s in reversed(strides):
+            self._write("<q", s)
+        self._write("<q", 1)  # storageOffset, 1-based
+        # storage object
+        self._int(TYPE_TORCH)
+        self._int(self.next_index)
+        self.next_index += 1
+        self._string("V 1")
+        self._string(cls.replace("Tensor", "Storage"))
+        self._write("<q", arr.size)
+        self.f.write(arr.tobytes())
+
+
+def load_t7(path: str) -> Any:
+    """Read a Torch7 binary file.  reference: TorchFile.loadTorch."""
+    with open(path, "rb") as f:
+        return _Reader(f).read_object()
+
+
+def save_t7(path: str, obj: Any) -> None:
+    """Write a Torch7 binary file.  reference: TorchFile.saveTorch."""
+    with open(path, "wb") as f:
+        _Writer(f).write_object(obj)

@@ -1,0 +1,199 @@
+"""Detection head tests (reference: nn/Anchor.scala, nn/Nms.scala,
+nn/PriorBox.scala, nn/Proposal.scala, nn/RoiPooling.scala,
+nn/DetectionOutputSSD.scala) — hand-computed small-case oracles plus a
+numpy reference implementation for ROI pooling."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.table import Table
+from bigdl_tpu.nn.detection import bbox_iou, bbox_transform_inv, nms
+
+
+class TestBoxMath:
+    def test_iou(self):
+        a = jnp.asarray([[0.0, 0, 10, 10]])
+        b = jnp.asarray([[0.0, 0, 10, 10], [5, 5, 15, 15], [20, 20, 30, 30]])
+        iou = np.asarray(bbox_iou(a, b))[0]
+        np.testing.assert_allclose(iou, [1.0, 25.0 / 175.0, 0.0], atol=1e-6)
+
+    def test_transform_inv_identity(self):
+        boxes = jnp.asarray([[0.0, 0, 9, 19]])
+        dec = bbox_transform_inv(boxes, jnp.zeros((1, 4)))
+        np.testing.assert_allclose(np.asarray(dec), [[0, 0, 9, 19]], atol=1e-5)
+
+    def test_transform_inv_shift(self):
+        boxes = jnp.asarray([[0.0, 0, 9, 9]])  # w = h = 10, ctr (4.5, 4.5)
+        dec = bbox_transform_inv(boxes, jnp.asarray([[0.1, 0.0, 0.0, 0.0]]))
+        # ctr_x moves by 0.1 * 10 = 1
+        np.testing.assert_allclose(np.asarray(dec), [[1, 0, 10, 9]], atol=1e-5)
+
+
+class TestNms:
+    def test_greedy_suppression(self):
+        boxes = jnp.asarray([
+            [0.0, 0, 10, 10],   # score .9, kept
+            [1.0, 1, 11, 11],   # overlaps #0 heavily, suppressed
+            [20.0, 20, 30, 30],  # disjoint, kept
+        ])
+        scores = jnp.asarray([0.9, 0.8, 0.7])
+        idx, valid = nms(boxes, scores, 0.5, 3)
+        kept = np.asarray(idx)[np.asarray(valid)]
+        np.testing.assert_array_equal(kept, [0, 2])
+
+    def test_score_threshold_and_padding(self):
+        boxes = jnp.asarray([[0.0, 0, 10, 10], [20.0, 20, 30, 30]])
+        scores = jnp.asarray([0.9, 0.01])
+        idx, valid = nms(boxes, scores, 0.5, 4, score_threshold=0.05)
+        assert np.asarray(valid).sum() == 1
+        assert np.asarray(idx)[0] == 0
+
+    def test_jit_fixed_shape(self):
+        f = jax.jit(lambda b, s: nms(b, s, 0.5, 8))
+        b = jnp.asarray(np.random.RandomState(0).rand(16, 4) * 50)
+        b = b.at[:, 2:].set(b[:, :2] + 5.0)
+        idx, valid = f(b, jnp.arange(16, dtype=jnp.float32))
+        assert idx.shape == (8,) and valid.shape == (8,)
+
+
+class TestAnchorPrior:
+    def test_anchor_count_and_center(self):
+        a = nn.Anchor(ratios=[0.5, 1.0, 2.0], scales=[8.0], base_size=16)
+        assert a.anchor_num == 3
+        all_a = np.asarray(a.generate(2, 3, 16.0))
+        assert all_a.shape == (2 * 3 * 3, 4)
+        # the ratio-1 base anchor at shift (0,0) is centered on (7.5, 7.5);
+        # layout is cell-major, anchors within a cell ratio-major
+        sq = all_a[1]
+        cx = (sq[0] + sq[2]) / 2
+        assert abs(cx - 7.5) < 1e-4
+
+    def test_prior_box(self):
+        pb = nn.PriorBox([30.0], [60.0], aspect_ratios=[2.0], flip=True,
+                         img_h=300, img_w=300)
+        x = jnp.zeros((1, 2, 2, 8))
+        out, _ = pb.apply({}, {}, x)
+        priors, variances = np.asarray(out[1]), np.asarray(out[2])
+        assert priors.shape == (2 * 2 * pb.num_priors(), 4)
+        assert variances.shape == priors.shape
+        # first prior: min_size square at cell (0,0), center (75, 75)/300
+        np.testing.assert_allclose(
+            priors[0], [(75 - 15) / 300, (75 - 15) / 300,
+                        (75 + 15) / 300, (75 + 15) / 300], atol=1e-5)
+        np.testing.assert_allclose(variances[0], [0.1, 0.1, 0.2, 0.2])
+
+
+class TestRoiPooling:
+    def _numpy_roi_pool(self, fmap, roi, ph, pw, scale):
+        h, w, c = fmap.shape
+        x1 = int(round(roi[1] * scale))
+        y1 = int(round(roi[2] * scale))
+        x2 = int(round(roi[3] * scale))
+        y2 = int(round(roi[4] * scale))
+        roi_w = max(x2 - x1 + 1, 1)
+        roi_h = max(y2 - y1 + 1, 1)
+        out = np.zeros((ph, pw, c), fmap.dtype)
+        for i in range(ph):
+            for j in range(pw):
+                hs = min(max(int(np.floor(i * roi_h / ph)) + y1, 0), h)
+                he = min(max(int(np.ceil((i + 1) * roi_h / ph)) + y1, 0), h)
+                ws = min(max(int(np.floor(j * roi_w / pw)) + x1, 0), w)
+                we = min(max(int(np.ceil((j + 1) * roi_w / pw)) + x1, 0), w)
+                if he > hs and we > ws:
+                    out[i, j] = fmap[hs:he, ws:we].reshape(-1, c).max(axis=0)
+        return out
+
+    def test_matches_numpy_reference(self):
+        rs = np.random.RandomState(0)
+        fmap = rs.rand(1, 8, 10, 3).astype("float32")
+        rois = np.asarray([[0, 0, 0, 12, 12], [0, 4, 2, 18, 14]], "float32")
+        m = nn.RoiPooling(3, 3, 0.5)
+        y, _ = m.apply({}, {}, Table(jnp.asarray(fmap), jnp.asarray(rois)))
+        y = np.asarray(y)
+        for r in range(2):
+            ref = self._numpy_roi_pool(fmap[0], rois[r], 3, 3, 0.5)
+            np.testing.assert_allclose(y[r], ref, atol=1e-6)
+
+    def test_roi_align_smooth(self):
+        fmap = jnp.ones((1, 6, 6, 2))
+        rois = jnp.asarray([[0.0, 0, 0, 10, 10]])
+        m = nn.RoiAlign(2, 2, 0.5)
+        y, _ = m.apply({}, {}, Table(fmap, rois))
+        np.testing.assert_allclose(np.asarray(y), np.ones((1, 2, 2, 2)), atol=1e-6)
+
+
+class TestProposal:
+    def test_shapes_and_validity(self):
+        rs = np.random.RandomState(0)
+        h, w, a = 4, 5, 9
+        scores = jnp.asarray(rs.rand(1, h, w, 2 * a), jnp.float32)
+        deltas = jnp.asarray(rs.randn(1, h, w, 4 * a) * 0.1, jnp.float32)
+        im_info = jnp.asarray([64.0, 80.0])
+        m = nn.Proposal(pre_nms_top_n=50, post_nms_top_n=10)
+        out, _ = m.apply({}, {}, Table(scores, deltas, im_info))
+        rois, valid = np.asarray(out[1]), np.asarray(out[2])
+        assert rois.shape == (10, 5)
+        assert valid.any()
+        r = rois[valid]
+        assert (r[:, 1] >= 0).all() and (r[:, 3] <= 79).all()
+        assert (r[:, 2] >= 0).all() and (r[:, 4] <= 63).all()
+
+
+class TestDetectionOutput:
+    def test_ssd_decode_and_nms(self):
+        # 2 priors, 3 classes (0 = background)
+        priors = jnp.asarray([[0.1, 0.1, 0.3, 0.3], [0.6, 0.6, 0.9, 0.9]])
+        variances = jnp.tile(jnp.asarray([0.1, 0.1, 0.2, 0.2]), (2, 1))
+        loc = jnp.zeros((1, 8))  # no offset: decoded boxes == priors
+        conf = jnp.asarray([[0.05, 0.9, 0.05, 0.1, 0.05, 0.85]])
+        m = nn.DetectionOutputSSD(3, keep_top_k=4, conf_threshold=0.5)
+        out, _ = m.apply({}, {}, Table(loc, conf, Table(priors, variances)))
+        dets, valid = np.asarray(out[1]), np.asarray(out[2])
+        kept = dets[valid]
+        assert kept.shape[0] == 2
+        # highest score first: class 1 @ 0.9 on prior 0
+        assert kept[0][0] == 1 and abs(kept[0][1] - 0.9) < 1e-6
+        np.testing.assert_allclose(kept[0][2:], [0.1, 0.1, 0.3, 0.3], atol=1e-5)
+        assert kept[1][0] == 2 and abs(kept[1][1] - 0.85) < 1e-6
+
+    def test_frcnn_output(self):
+        rs = np.random.RandomState(0)
+        r, n_cls = 6, 3
+        rois = jnp.asarray(
+            np.hstack([np.zeros((r, 1)), rs.rand(r, 4) * 20]), jnp.float32)
+        rois = rois.at[:, 3:].set(rois[:, 1:3] + 10.0)
+        cls_prob = jax.nn.softmax(jnp.asarray(rs.randn(r, n_cls)), axis=1)
+        bbox_pred = jnp.asarray(rs.randn(r, n_cls * 4) * 0.05, jnp.float32)
+        m = nn.DetectionOutputFrcnn(n_cls, max_per_image=8, conf_threshold=0.1)
+        out, _ = m.apply({}, {}, Table(rois, cls_prob, bbox_pred,
+                                       jnp.asarray([40.0, 40.0])))
+        dets, valid = np.asarray(out[1]), np.asarray(out[2])
+        assert dets.shape == (8, 6)
+        kept = dets[valid]
+        assert (kept[:, 0] >= 1).all()  # never background
+        assert (kept[:, 2] >= 0).all() and (kept[:, 4] <= 39).all()
+
+
+class TestNmsSlotRegression:
+    """Regressions for the scatter-slot bug: suppressed/overflow entries must
+    not overwrite the last output slot."""
+
+    def test_overflow_does_not_corrupt_last_slot(self):
+        # 5 disjoint boxes, max_out=3: output must be the top-3 by score
+        boxes = jnp.asarray(
+            [[i * 20.0, i * 20.0, i * 20.0 + 10, i * 20.0 + 10] for i in range(5)])
+        scores = jnp.asarray([0.9, 0.8, 0.7, 0.6, 0.5])
+        idx, valid = nms(boxes, scores, 0.5, 3)
+        np.testing.assert_array_equal(np.asarray(idx), [0, 1, 2])
+        assert np.asarray(valid).all()
+
+    def test_suppressed_entry_does_not_shadow_kept(self):
+        # box 3 overlaps box 0 (suppressed); boxes 0,1,2 disjoint, max_out=3
+        boxes = jnp.asarray([[0.0, 0, 10, 10], [20.0, 20, 30, 30],
+                             [40.0, 40, 50, 50], [1.0, 1, 11, 11]])
+        scores = jnp.asarray([0.9, 0.8, 0.7, 0.85])
+        idx, valid = nms(boxes, scores, 0.5, 3)
+        kept = np.asarray(idx)[np.asarray(valid)]
+        np.testing.assert_array_equal(sorted(kept), [0, 1, 2])

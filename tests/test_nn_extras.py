@@ -359,3 +359,127 @@ class TestNewCriterions:
         x = jnp.asarray([[1.0, 2.0]])
         loss = float(crit.forward(x, x))
         assert loss == pytest.approx(0.0)
+
+
+class TestVolumetric:
+    """3-D conv/pool vs torch CPU oracle (survey §4: differential testing)."""
+
+    def _x(self):
+        return np.random.RandomState(0).rand(2, 5, 7, 6, 3).astype("float32")
+
+    def test_conv3d_matches_torch(self):
+        import torch
+        import torch.nn.functional as F
+
+        x = self._x()
+        m = nn.VolumetricConvolution(3, 4, 2, 3, 2, 1, 2, 1, 1, 1, 0)
+        p, s, oshape = m.build(jax.random.PRNGKey(0), x.shape)
+        y, _ = m.apply(p, s, jnp.asarray(x))
+        assert y.shape == oshape
+        tw = torch.from_numpy(np.transpose(np.asarray(p["weight"]), (4, 3, 0, 1, 2)).copy())
+        tx = torch.from_numpy(np.transpose(x, (0, 4, 1, 2, 3)).copy())
+        ty = F.conv3d(tx, tw, torch.from_numpy(np.asarray(p["bias"]).copy()),
+                      stride=(1, 1, 2), padding=(1, 0, 1))
+        ref = np.transpose(ty.numpy(), (0, 2, 3, 4, 1))
+        np.testing.assert_allclose(np.asarray(y), ref, atol=1e-5)
+
+    def test_pool3d_matches_torch(self):
+        import torch
+        import torch.nn.functional as F
+
+        x = self._x()
+        tx = torch.from_numpy(np.transpose(x, (0, 4, 1, 2, 3)).copy())
+        yp, _ = nn.VolumetricMaxPooling(2, 2, 2, 2, 2, 2).apply({}, {}, jnp.asarray(x))
+        ref = np.transpose(F.max_pool3d(tx, 2, 2).numpy(), (0, 2, 3, 4, 1))
+        np.testing.assert_allclose(np.asarray(yp), ref, atol=1e-6)
+        ya, _ = nn.VolumetricAveragePooling(2, 2, 2, 2, 2, 2).apply({}, {}, jnp.asarray(x))
+        ref = np.transpose(F.avg_pool3d(tx, 2, 2).numpy(), (0, 2, 3, 4, 1))
+        np.testing.assert_allclose(np.asarray(ya), ref, atol=1e-6)
+
+    def test_full_conv3d_matches_torch(self):
+        import torch
+        import torch.nn.functional as F
+
+        x = self._x()
+        tx = torch.from_numpy(np.transpose(x, (0, 4, 1, 2, 3)).copy())
+        fc = nn.VolumetricFullConvolution(3, 2, 3, 3, 3, 2, 2, 2, 1, 1, 1)
+        p, s, oshape = fc.build(jax.random.PRNGKey(2), x.shape)
+        y, _ = fc.apply(p, s, jnp.asarray(x))
+        assert y.shape == oshape
+        tw = torch.from_numpy(np.transpose(np.asarray(p["weight"]), (3, 4, 0, 1, 2)).copy())
+        ty = F.conv_transpose3d(tx, tw, torch.from_numpy(np.asarray(p["bias"]).copy()),
+                                stride=2, padding=1)
+        ref = np.transpose(ty.numpy(), (0, 2, 3, 4, 1))
+        np.testing.assert_allclose(np.asarray(y), ref, atol=1e-5)
+
+    def test_conv3d_grad(self):
+        x = jnp.asarray(self._x())
+        m = nn.VolumetricConvolution(3, 2, 2, 2, 2)
+        p, s, _ = m.build(jax.random.PRNGKey(0), x.shape)
+        g = jax.grad(lambda p_: m.apply(p_, s, x)[0].sum())(p)
+        assert np.isfinite(np.asarray(g["weight"])).all()
+
+
+class TestRecurrentVariants:
+    def test_lstm_peephole(self):
+        x = jnp.asarray(np.random.RandomState(0).rand(3, 5, 4), jnp.float32)
+        m = nn.Recurrent(nn.LSTMPeephole(4, 6))
+        p, s, oshape = m.build(jax.random.PRNGKey(0), x.shape)
+        y, _ = m.apply(p, s, x)
+        assert y.shape == (3, 5, 6) == oshape
+        g = jax.grad(lambda p_: m.apply(p_, s, x)[0].sum())(p)
+        assert np.isfinite(np.asarray(g["cell"]["peep"])).all()
+
+    def test_conv_lstm(self):
+        x = jnp.asarray(np.random.RandomState(0).rand(2, 4, 5, 6, 3), jnp.float32)
+        m = nn.Recurrent(nn.ConvLSTMPeephole(3, 7, 3, 3))
+        p, s, oshape = m.build(jax.random.PRNGKey(0), x.shape)
+        y, _ = m.apply(p, s, x)
+        assert y.shape == (2, 4, 5, 6, 7) == oshape
+        assert m.output_shape(x.shape) == oshape
+        assert np.isfinite(np.asarray(y)).all()
+
+    def test_conv_lstm_no_peephole(self):
+        x = jnp.asarray(np.random.RandomState(1).rand(1, 3, 4, 4, 2), jnp.float32)
+        m = nn.Recurrent(nn.ConvLSTMPeephole(2, 3, with_peephole=False))
+        p, s, _ = m.build(jax.random.PRNGKey(0), x.shape)
+        assert "peep" not in p["cell"]
+        y, _ = m.apply(p, s, x)
+        assert y.shape == (1, 3, 4, 4, 3)
+
+    def test_multi_rnn_cell(self):
+        x = jnp.asarray(np.random.RandomState(0).rand(3, 5, 4), jnp.float32)
+        cell = nn.MultiRNNCell([nn.LSTMCell(4, 8), nn.GRUCell(8, 6)])
+        m = nn.Recurrent(cell)
+        p, s, oshape = m.build(jax.random.PRNGKey(0), x.shape)
+        y, _ = m.apply(p, s, x)
+        assert y.shape == (3, 5, 6) == oshape
+
+    def test_recurrent_decoder(self):
+        x0 = jnp.asarray(np.random.RandomState(0).rand(3, 6), jnp.float32)
+        m = nn.RecurrentDecoder(nn.LSTMCell(6, 6), seq_length=4)
+        p, s, oshape = m.build(jax.random.PRNGKey(0), x0.shape)
+        y, _ = m.apply(p, s, x0)
+        assert y.shape == (3, 4, 6) == oshape
+        # autoregressive: step t+1 depends on step t's output
+        y2, _ = m.apply(p, s, x0 * 2.0)
+        assert not np.allclose(np.asarray(y), np.asarray(y2))
+
+
+class TestDistanceRegressions:
+    def test_bilinear_in_sequential(self):
+        from bigdl_tpu.core.table import Table as T
+
+        m = nn.Sequential(nn.Bilinear(3, 4, 5), nn.Linear(5, 2))
+        p, s, out = m.build(jax.random.PRNGKey(0), T((2, 3), (2, 4)))
+        assert out == (2, 2)
+        x = T(jnp.ones((2, 3)), jnp.ones((2, 4)))
+        y, _ = m.apply(p, s, x)
+        assert y.shape == (2, 2)
+
+    def test_highway_parameterized_activation(self):
+        m = nn.Highway(4, activation=nn.PReLU())
+        p, s, _ = m.build(jax.random.PRNGKey(0), (2, 4))
+        assert "act" in p
+        y, _ = m.apply(p, s, jnp.ones((2, 4)))
+        assert y.shape == (2, 4)

@@ -286,3 +286,36 @@ class TestTrainingLoop:
         norm = np.sqrt(sum(np.sum(np.square(np.asarray(v))) for v in
                            jax.tree_util.tree_leaves(l2)))
         np.testing.assert_allclose(norm, 1.0, rtol=1e-5)
+
+
+class TestLBFGS:
+    def test_rosenbrock_converges(self):
+        from bigdl_tpu.optim import LBFGS
+
+        def rosenbrock(p):
+            x, y = p["x"], p["y"]
+            return (1 - x) ** 2 + 100 * (y - x ** 2) ** 2
+
+        feval = jax.jit(jax.value_and_grad(rosenbrock))
+        params = {"x": jnp.asarray(-1.2), "y": jnp.asarray(1.0)}
+        opt = LBFGS(max_iter=60, max_eval=500)
+        new_params, hist = opt.optimize(feval, params)
+        assert hist[-1] < 1e-6
+        assert abs(float(new_params["x"]) - 1.0) < 1e-3
+        assert abs(float(new_params["y"]) - 1.0) < 1e-3
+
+    def test_quadratic_no_line_search(self):
+        from bigdl_tpu.optim import LBFGS
+
+        A = jnp.asarray(np.diag([1.0, 10.0, 100.0]), jnp.float32)
+        b = jnp.asarray([1.0, -2.0, 3.0])
+
+        def quad(x):
+            return 0.5 * x @ A @ x - b @ x
+
+        feval = jax.jit(jax.value_and_grad(quad))
+        x0 = jnp.zeros(3)
+        opt = LBFGS(max_iter=50, line_search=False, learning_rate=1.0)
+        x, hist = opt.optimize(feval, x0)
+        x_star = jnp.linalg.solve(A, b)
+        assert hist[-1] < float(quad(x_star)) + 1e-4

@@ -37,6 +37,28 @@ def _transformer_lm():
 # class name -> (factory, input builder or None for spec-only round-trip)
 EXEMPLARS = {
     "Abs": (lambda: nn.Abs(), lambda: rand(2, 3)),
+    "LSTMPeephole": (lambda: nn.LSTMPeephole(3, 5), None),
+    "ConvLSTMPeephole": (lambda: nn.ConvLSTMPeephole(3, 4), None),
+    "MultiRNNCell": (lambda: nn.MultiRNNCell([nn.LSTMCell(3, 5), nn.GRUCell(5, 4)]),
+                     None),
+    "RecurrentDecoder": (lambda: nn.RecurrentDecoder(nn.LSTMCell(6, 6), 4),
+                         lambda: rand(2, 6)),
+    "VolumetricConvolution": (lambda: nn.VolumetricConvolution(3, 4, 2, 2, 2),
+                              lambda: rand(2, 4, 5, 5, 3)),
+    "VolumetricFullConvolution": (
+        lambda: nn.VolumetricFullConvolution(3, 2, 2, 2, 2, 2, 2, 2),
+        lambda: rand(2, 4, 5, 5, 3)),
+    "VolumetricMaxPooling": (lambda: nn.VolumetricMaxPooling(2),
+                             lambda: rand(2, 4, 5, 5, 3)),
+    "VolumetricAveragePooling": (lambda: nn.VolumetricAveragePooling(2),
+                                 lambda: rand(2, 4, 5, 5, 3)),
+    "Nms": (lambda: nn.Nms(0.5, 10), None),
+    "PriorBox": (lambda: nn.PriorBox([30.0], [60.0]), None),
+    "Proposal": (lambda: nn.Proposal(100, 10), None),
+    "RoiPooling": (lambda: nn.RoiPooling(3, 3, 0.5), None),
+    "RoiAlign": (lambda: nn.RoiAlign(3, 3, 0.5), None),
+    "DetectionOutputSSD": (lambda: nn.DetectionOutputSSD(4), None),
+    "DetectionOutputFrcnn": (lambda: nn.DetectionOutputFrcnn(4), None),
     "Add": (lambda: nn.Add(4), lambda: rand(2, 4)),
     "AddConstant": (lambda: nn.AddConstant(1.5), lambda: rand(2, 3)),
     "BatchNormalization": (lambda: nn.BatchNormalization(4), lambda: rand(3, 4)),
