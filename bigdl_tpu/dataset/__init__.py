@@ -7,3 +7,13 @@ from bigdl_tpu.dataset import text
 
 __all__ = ["Sample", "MiniBatch", "Transformer", "SampleToMiniBatch",
            "DataSet", "LocalDataSet", "ArrayDataSet", "image", "text"]
+from bigdl_tpu.dataset import datasets
+from bigdl_tpu.dataset.datasets import (
+    load_mnist,
+    load_cifar10,
+    load_movielens_ratings,
+    load_news20,
+    load_glove_embeddings,
+    read_sentence_corpus,
+    maybe_download,
+)

@@ -1,0 +1,219 @@
+"""Standard dataset parsers/loaders.
+
+Reference: pyspark/bigdl/dataset/{mnist,movielens,news20,sentence}.py (+
+models/lenet reading idx files, dataset/DataSet.scala CIFAR-10 binary
+reader).  The reference downloads then parses; this environment has no
+egress, so parsers read LOCAL files and `maybe_download` only checks
+existence (raising with the canonical URL in the message when missing).
+
+All parsers return numpy arrays (host data; device placement is the
+trainer's job).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import tarfile
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MNIST_URL = "http://yann.lecun.com/exdb/mnist/"
+CIFAR10_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-binary.tar.gz"
+MOVIELENS_URL = "http://files.grouplens.org/datasets/movielens/ml-1m.zip"
+NEWS20_URL = "http://qwone.com/~jason/20Newsgroups/20news-19997.tar.gz"
+GLOVE_URL = "http://nlp.stanford.edu/data/glove.6B.zip"
+
+# the reference's canonical normalization constants
+# (pyspark/bigdl/dataset/mnist.py TRAIN_MEAN/TRAIN_STD)
+MNIST_TRAIN_MEAN = 0.13066047740239506 * 255
+MNIST_TRAIN_STD = 0.3081078 * 255
+CIFAR_MEAN = (125.3, 123.0, 113.9)
+CIFAR_STD = (63.0, 62.1, 66.7)
+
+
+def maybe_download(filename: str, work_dir: str, source_url: str) -> str:
+    """Existence check standing in for the reference's downloader
+    (zero-egress environment).  reference: pyspark/bigdl/dataset/base.py
+    maybe_download."""
+    path = os.path.join(work_dir, filename)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} not found and this environment has no network egress; "
+            f"fetch it from {source_url} and place it there")
+    return path
+
+
+def _open_maybe_gzip(path: str):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+# ---------------------------------------------------------------------------
+# MNIST (idx-ubyte)
+
+
+def read_mnist_images(path: str) -> np.ndarray:
+    """Parse an idx3-ubyte (optionally .gz) image file -> (N, 28, 28, 1)
+    float32.  reference: pyspark/bigdl/dataset/mnist.py extract_images."""
+    with _open_maybe_gzip(path) as f:
+        magic, n, rows, cols = struct.unpack(">iiii", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"bad idx3 magic {magic} in {path}")
+        data = np.frombuffer(f.read(n * rows * cols), np.uint8)
+    return data.reshape(n, rows, cols, 1).astype(np.float32)
+
+
+def read_mnist_labels(path: str) -> np.ndarray:
+    with _open_maybe_gzip(path) as f:
+        magic, n = struct.unpack(">ii", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"bad idx1 magic {magic} in {path}")
+        return np.frombuffer(f.read(n), np.uint8).astype(np.int32)
+
+
+def load_mnist(work_dir: str, kind: str = "train",
+               normalize: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    prefix = "train" if kind == "train" else "t10k"
+    img = None
+    for suffix in ("-images-idx3-ubyte.gz", "-images-idx3-ubyte"):
+        p = os.path.join(work_dir, prefix + suffix)
+        if os.path.exists(p):
+            img = p
+            break
+    if img is None:
+        raise FileNotFoundError(
+            f"no {prefix}-images-idx3-ubyte[.gz] under {work_dir} "
+            f"(source: {MNIST_URL})")
+    labels = img.replace("images-idx3", "labels-idx1")
+    x = read_mnist_images(img)
+    y = read_mnist_labels(labels)
+    if normalize:
+        x = (x - MNIST_TRAIN_MEAN) / MNIST_TRAIN_STD
+    return x, y
+
+
+# ---------------------------------------------------------------------------
+# CIFAR-10 (binary batches)
+
+
+def read_cifar10_bin(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """One CIFAR-10 binary batch file -> ((N, 32, 32, 3) float32, (N,) int32).
+    reference: dataset/DataSet.scala Cifar-10 SeqFile/array pipeline."""
+    raw = np.fromfile(path, np.uint8).reshape(-1, 3073)
+    labels = raw[:, 0].astype(np.int32)
+    imgs = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return imgs.astype(np.float32), labels
+
+
+def load_cifar10(work_dir: str, kind: str = "train",
+                 normalize: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    sub = os.path.join(work_dir, "cifar-10-batches-bin")
+    base = sub if os.path.isdir(sub) else work_dir
+    names = [f"data_batch_{i}.bin" for i in range(1, 6)] if kind == "train" \
+        else ["test_batch.bin"]
+    xs, ys = [], []
+    for n in names:
+        p = os.path.join(base, n)
+        if not os.path.exists(p):
+            raise FileNotFoundError(f"{p} missing (source: {CIFAR10_URL})")
+        x, y = read_cifar10_bin(p)
+        xs.append(x)
+        ys.append(y)
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+    if normalize:
+        x = (x - np.asarray(CIFAR_MEAN)) / np.asarray(CIFAR_STD)
+    return x.astype(np.float32), y
+
+
+# ---------------------------------------------------------------------------
+# MovieLens ratings
+
+
+def load_movielens_ratings(path: str, sep: str = "::") -> np.ndarray:
+    """ratings.dat -> (N, 3) int32 (user, item, rating).
+    reference: pyspark/bigdl/dataset/movielens.py read_data_sets."""
+    rows: List[Tuple[int, int, int]] = []
+    with open(path, "r", encoding="latin-1") as f:
+        for line in f:
+            parts = line.strip().split(sep)
+            if len(parts) >= 3:
+                rows.append((int(parts[0]), int(parts[1]), int(float(parts[2]))))
+    return np.asarray(rows, np.int32)
+
+
+# ---------------------------------------------------------------------------
+# News20 (20 newsgroups) text classification
+
+
+def load_news20(work_dir: str) -> List[Tuple[str, int]]:
+    """Directory-of-directories (or .tar.gz) -> [(text, label_idx)].
+    reference: pyspark/bigdl/dataset/news20.py get_news20."""
+    tar = None
+    for cand in os.listdir(work_dir) if os.path.isdir(work_dir) else []:
+        if cand.endswith(".tar.gz") and "news" in cand:
+            tar = os.path.join(work_dir, cand)
+            break
+    texts: List[Tuple[str, int]] = []
+    if tar is not None:
+        # labels assigned by SORTED group name, matching the unpacked-dir
+        # path below, so both layouts of the same data agree
+        by_group: Dict[str, List[str]] = {}
+        with tarfile.open(tar, "r:gz") as tf:
+            for m in tf.getmembers():
+                if not m.isfile():
+                    continue
+                parts = m.name.split("/")
+                if len(parts) < 2:
+                    continue
+                data = tf.extractfile(m)
+                if data is not None:
+                    by_group.setdefault(parts[-2], []).append(
+                        data.read().decode("latin-1"))
+        for label, g in enumerate(sorted(by_group)):
+            texts.extend((t, label) for t in by_group[g])
+        return texts
+    # unpacked layout: work_dir/<group>/<doc>
+    groups = sorted(d for d in os.listdir(work_dir)
+                    if os.path.isdir(os.path.join(work_dir, d)))
+    if not groups:
+        raise FileNotFoundError(
+            f"no newsgroup directories or tarball under {work_dir} "
+            f"(source: {NEWS20_URL})")
+    for label, g in enumerate(groups):
+        gdir = os.path.join(work_dir, g)
+        for doc in sorted(os.listdir(gdir)):
+            with open(os.path.join(gdir, doc), "r", encoding="latin-1") as f:
+                texts.append((f.read(), label))
+    return texts
+
+
+def load_glove_embeddings(path: str, dim: int = 100
+                          ) -> Tuple[Dict[str, int], np.ndarray]:
+    """glove.6B.<dim>d.txt -> (word->row index, (V, dim) float32 matrix).
+    reference: pyspark/bigdl/dataset/news20.py get_glove_w2v."""
+    vocab: Dict[str, int] = {}
+    vecs: List[np.ndarray] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            parts = line.rstrip().split(" ")
+            if len(parts) != dim + 1:
+                continue
+            vocab[parts[0]] = len(vecs)
+            vecs.append(np.asarray(parts[1:], np.float32))
+    return vocab, np.stack(vecs) if vecs else np.zeros((0, dim), np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Sentence corpus (PTB-style)
+
+
+def read_sentence_corpus(path: str) -> List[str]:
+    """One sentence per line.  reference: pyspark/bigdl/dataset/sentence.py
+    read_localfile."""
+    with open(path, "r", encoding="utf-8") as f:
+        return [line.strip() for line in f if line.strip()]

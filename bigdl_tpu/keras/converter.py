@@ -1,0 +1,105 @@
+"""Keras-1.2.2 model-definition converter.
+
+Reference: pyspark/bigdl/keras/converter.py (1759 LoC — loads real Keras
+1.2.2 models into BigDL via definition + weight conversion).  Here
+`model_from_json_config` rebuilds a `bigdl_tpu.keras.Sequential` from the
+JSON produced by Keras-1 `model.to_json()`, and `load_keras_weights`
+applies a `get_weights()`-style weight list (delegating layout fixes to
+`bigdl_tpu.utils.interop.import_keras_weights`).
+
+Supported layer classes mirror the reference converter's core set: Dense,
+Activation, Dropout, Flatten, Reshape, Convolution2D, MaxPooling2D,
+AveragePooling2D, GlobalAveragePooling2D, BatchNormalization, Embedding,
+LSTM, GRU, SimpleRNN, TimeDistributed(Dense).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+from bigdl_tpu.keras import layers as KL
+from bigdl_tpu.keras.topology import Sequential
+
+
+def _input_shape_of(cfg: Dict[str, Any]) -> Optional[Sequence[int]]:
+    shape = cfg.get("batch_input_shape")
+    if shape is not None:
+        return tuple(s for s in shape[1:])
+    return None
+
+
+def _convert_layer(class_name: str, cfg: Dict[str, Any]):
+    shape = _input_shape_of(cfg)
+    name = cfg.get("name")
+    act = cfg.get("activation")
+    if act == "linear":
+        act = None
+    if class_name == "Dense":
+        return KL.Dense(cfg["output_dim"], activation=act,
+                        bias=cfg.get("bias", True), input_shape=shape, name=name)
+    if class_name == "Activation":
+        return KL.Activation(cfg["activation"], input_shape=shape, name=name)
+    if class_name == "Dropout":
+        return KL.Dropout(cfg["p"], input_shape=shape, name=name)
+    if class_name == "Flatten":
+        return KL.Flatten(input_shape=shape, name=name)
+    if class_name == "Reshape":
+        return KL.Reshape(cfg["target_shape"], input_shape=shape, name=name)
+    if class_name == "Convolution2D":
+        if cfg.get("dim_ordering", "tf") != "tf":
+            raise ValueError("only dim_ordering='tf' (NHWC) is supported")
+        return KL.Convolution2D(
+            cfg["nb_filter"], cfg["nb_row"], cfg["nb_col"], activation=act,
+            border_mode=cfg.get("border_mode", "valid"),
+            subsample=tuple(cfg.get("subsample", (1, 1))),
+            bias=cfg.get("bias", True), input_shape=shape, name=name)
+    if class_name in ("MaxPooling2D", "AveragePooling2D"):
+        cls = KL.MaxPooling2D if class_name == "MaxPooling2D" else KL.AveragePooling2D
+        return cls(pool_size=tuple(cfg.get("pool_size", (2, 2))),
+                   strides=(tuple(cfg["strides"]) if cfg.get("strides") else None),
+                   border_mode=cfg.get("border_mode", "valid"),
+                   input_shape=shape, name=name)
+    if class_name == "GlobalAveragePooling2D":
+        return KL.GlobalAveragePooling2D(input_shape=shape, name=name)
+    if class_name == "BatchNormalization":
+        return KL.BatchNormalization(epsilon=cfg.get("epsilon", 1e-3),
+                                     momentum=cfg.get("momentum", 0.99),
+                                     input_shape=shape, name=name)
+    if class_name == "Embedding":
+        return KL.Embedding(cfg["input_dim"], cfg["output_dim"],
+                            input_shape=shape, name=name)
+    if class_name in ("LSTM", "GRU", "SimpleRNN"):
+        cls = getattr(KL, class_name)
+        return cls(cfg["output_dim"],
+                   return_sequences=cfg.get("return_sequences", False),
+                   input_shape=shape, name=name)
+    if class_name == "TimeDistributed":
+        inner_def = cfg["layer"]
+        inner = _convert_layer(inner_def["class_name"], inner_def["config"])
+        return KL.TimeDistributed(inner, input_shape=shape, name=name)
+    raise ValueError(f"unsupported Keras layer class {class_name!r} "
+                     f"(reference converter: pyspark/bigdl/keras/converter.py)")
+
+
+def model_from_json_config(json_str_or_dict) -> Sequential:
+    """Rebuild a Sequential from Keras-1.2.2 `model.to_json()` output."""
+    spec = (json.loads(json_str_or_dict)
+            if isinstance(json_str_or_dict, (str, bytes)) else json_str_or_dict)
+    class_name = spec.get("class_name")
+    if class_name != "Sequential":
+        raise ValueError(
+            f"only Sequential definitions are supported (got {class_name!r}); "
+            f"functional Model graphs load via bigdl_tpu.nn.Graph directly")
+    model = Sequential()
+    for layer_def in spec["config"]:
+        model.add(_convert_layer(layer_def["class_name"], layer_def["config"]))
+    return model
+
+
+def load_keras_weights(model, params, state,
+                       layer_weights: List[List]) -> Any:
+    """Apply Keras `get_weights()` lists onto built params/state."""
+    from bigdl_tpu.utils.interop import import_keras_weights
+
+    return import_keras_weights(model, params, state, layer_weights)

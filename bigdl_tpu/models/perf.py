@@ -1,0 +1,121 @@
+"""Synthetic-input throughput harnesses.
+
+Reference: models/utils/DistriOptimizerPerf.scala:32-86 and
+LocalOptimizerPerf.scala — select a model (inception/vgg/resnet/lenet/
+transformer), feed random ImageNet-shaped batches, report records/sec the
+same way DistriOptimizer logs Throughput
+(optim/DistriOptimizer.scala:402-407).
+
+CLI:
+    python -m bigdl_tpu.models.perf --model resnet50 --batch-size 64 \
+        --iteration 20 [--distributed]
+
+`--distributed` shards the batch over the Engine mesh (all local devices on
+the data axis) — the DistriOptimizerPerf analogue; without it the step runs
+single-device (LocalOptimizerPerf).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Tuple
+
+import numpy as np
+
+
+def build_model_and_shape(name: str, batch: int):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import models
+
+    if name == "lenet":
+        return models.LeNet5(10), (batch, 28, 28, 1), 10
+    if name == "vgg16":
+        return models.Vgg16(1000), (batch, 224, 224, 3), 1000
+    if name == "resnet50":
+        return models.resnet50(1000), (batch, 224, 224, 3), 1000
+    if name == "inception":
+        return models.InceptionV1(1000), (batch, 224, 224, 3), 1000
+    raise ValueError(f"unknown model {name!r} "
+                     f"(lenet | vgg16 | resnet50 | inception)")
+
+
+def run_perf(model_name: str = "inception", batch_size: int = 32,
+             iterations: int = 10, warmup: int = 3, distributed: bool = False,
+             dtype: str = "float32") -> Tuple[float, float]:
+    """Returns (records_per_sec, ms_per_iteration)."""
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.core.engine import Engine
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.parallel import batch_sharding
+
+    model, shape, classes = build_model_and_shape(model_name, batch_size)
+    params, state, _ = model.build(jax.random.PRNGKey(0), shape)
+    optim = SGD(learning_rate=0.01, momentum=0.9, dampening=0.0)
+    opt_state = optim.init(params)
+    criterion = nn.ClassNLLCriterion()
+    compute_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+
+    def train_step(params, model_state, opt_state, x, y):
+        def loss_fn(p):
+            p_c = jax.tree_util.tree_map(lambda a: a.astype(compute_dtype), p)
+            s_c = jax.tree_util.tree_map(lambda a: a.astype(compute_dtype),
+                                         model_state)
+            out, new_state = model.apply(p_c, s_c, x.astype(compute_dtype),
+                                         training=True, rng=None)
+            new_state = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.float32), new_state)
+            return criterion.forward(out.astype(jnp.float32), y), new_state
+
+        (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new_params, new_opt = optim.step(grads, params, opt_state)
+        return new_params, new_state, new_opt, loss
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(*shape), jnp.float32)
+    y = jnp.asarray(rs.randint(0, classes, shape[0]))
+    if distributed:
+        mesh = Engine.init() if Engine._mesh is None else Engine._mesh
+        x = jax.device_put(x, batch_sharding(mesh))
+        y = jax.device_put(y, batch_sharding(mesh))
+
+    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    def sync(tree):
+        # host readback: the only true sync through the remote-TPU tunnel
+        return float(jnp.sum(jax.tree_util.tree_leaves(tree)[0]
+                             .astype(jnp.float32)))
+
+    for _ in range(warmup):
+        params, state, opt_state, loss = step(params, state, opt_state, x, y)
+    sync(params)
+    t0 = time.perf_counter()
+    for _ in range(iterations):
+        params, state, opt_state, loss = step(params, state, opt_state, x, y)
+    sync(params)
+    dt = time.perf_counter() - t0
+    rec_s = batch_size * iterations / dt
+    return rec_s, dt / iterations * 1e3
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="inception")
+    ap.add_argument("-b", "--batch-size", type=int, default=32)
+    ap.add_argument("-i", "--iteration", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--distributed", action="store_true")
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    args = ap.parse_args(argv)
+    rec_s, ms = run_perf(args.model, args.batch_size, args.iteration,
+                         args.warmup, args.distributed, args.dtype)
+    print(f"[{args.model}] Throughput is {rec_s:.1f} records/second, "
+          f"{ms:.1f} ms/iteration")
+
+
+if __name__ == "__main__":
+    main()

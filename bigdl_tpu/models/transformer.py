@@ -30,7 +30,8 @@ class TransformerLM(Module):
                  n_head: int = 8, *, max_len: int = 2048, dropout: float = 0.0,
                  rope: bool = True, tie_embeddings: bool = True,
                  seq_parallel: Optional[str] = None, scan_layers: bool = True,
-                 remat: bool = False, name: Optional[str] = None):
+                 remat: bool = False, use_flash: bool = False,
+                 name: Optional[str] = None):
         super().__init__(name)
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -45,7 +46,8 @@ class TransformerLM(Module):
                                  weight_init=init_mod.RandomNormal(0.0, 0.02))
         self.block = TransformerBlock(hidden_size, n_head, causal=True,
                                       dropout=dropout, rope=rope,
-                                      seq_parallel=seq_parallel)
+                                      seq_parallel=seq_parallel,
+                                      use_flash=use_flash)
         self.ln_f = LayerNormalization(hidden_size)
 
     def build(self, rng, input_shape):

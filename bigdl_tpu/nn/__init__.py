@@ -229,3 +229,4 @@ from bigdl_tpu.nn.detection import (
     bbox_transform_inv,
     nms,
 )
+from bigdl_tpu.nn.treelstm import BinaryTreeLSTM

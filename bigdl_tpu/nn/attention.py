@@ -31,6 +31,7 @@ from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.nn.module import Container, Module, child_rng
 from bigdl_tpu.nn.norm import LayerNormalization
 from bigdl_tpu.ops.attention import dense_attention, ring_attention, ulysses_attention
+from bigdl_tpu.ops.flash_attention import flash_attention
 
 
 def apply_rope(x: jax.Array, *, base: float = 10000.0,
@@ -66,7 +67,7 @@ class MultiHeadAttention(Module):
 
     def __init__(self, hidden_size: int, n_head: int, *, causal: bool = False,
                  dropout: float = 0.0, with_bias: bool = True, rope: bool = False,
-                 seq_parallel: Optional[str] = None,
+                 seq_parallel: Optional[str] = None, use_flash: bool = False,
                  seq_axis: str = AXIS_SEQUENCE, data_axis: str = AXIS_DATA,
                  name: Optional[str] = None):
         super().__init__(name)
@@ -82,6 +83,7 @@ class MultiHeadAttention(Module):
         self.with_bias = with_bias
         self.rope = rope
         self.seq_parallel = seq_parallel
+        self.use_flash = use_flash
         self.seq_axis = seq_axis
         self.data_axis = data_axis
         self.mesh: Optional[Mesh] = None  # explicit override for tests
@@ -113,6 +115,10 @@ class MultiHeadAttention(Module):
             spec = P(data, self.seq_axis, None, None)
             return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                                  out_specs=spec)(q, k, v)
+        if self.use_flash:
+            # pallas blockwise kernel; falls back to dense when shapes
+            # don't tile (bigdl_tpu/ops/flash_attention.py)
+            return flash_attention(q, k, v, causal=self.causal)
         return dense_attention(q, k, v, causal=self.causal)
 
     def apply(self, params, state, x, *, training=False, rng=None):
@@ -146,13 +152,14 @@ class TransformerBlock(Container):
 
     def __init__(self, hidden_size: int, n_head: int, *, causal: bool = True,
                  mlp_ratio: int = 4, dropout: float = 0.0, rope: bool = False,
-                 seq_parallel: Optional[str] = None, name: Optional[str] = None):
+                 seq_parallel: Optional[str] = None, use_flash: bool = False,
+                 name: Optional[str] = None):
         super().__init__(name)
         self.hidden_size = hidden_size
         self.children["ln1"] = LayerNormalization(hidden_size)
         self.children["attn"] = MultiHeadAttention(
             hidden_size, n_head, causal=causal, dropout=dropout, rope=rope,
-            seq_parallel=seq_parallel)
+            seq_parallel=seq_parallel, use_flash=use_flash)
         self.children["ln2"] = LayerNormalization(hidden_size)
         self.children["mlp"] = _Mlp(hidden_size, mlp_ratio * hidden_size, dropout)
 

@@ -1,0 +1,120 @@
+"""Binary (constituency) Tree-LSTM.
+
+Reference: nn/BinaryTreeLSTM.scala (+ nn/TreeLSTM.scala base), used by the
+treeLSTMSentiment example.  The reference walks the tree recursively on the
+JVM, cloning composer/leaf modules per node.
+
+TPU-native redesign: trees are PADDED ARRAYS in children-before-parent
+topological order, and the node loop is ONE `lax.scan` whose carry is the
+(n_nodes, H) hidden/cell buffers — every step is the same fused XLA body,
+batched with vmap.  Tree encoding per example:
+
+  * `left`, `right`: (n_nodes,) int32 — child node indices, -1 for leaves
+  * `word`: (n_nodes,) int32 — embedding-row index for leaves, -1 internal
+  * padding nodes (beyond the real tree) have left=right=word=-1 and produce
+    zero hidden states.
+
+The ROOT is the last real node (topological order ⇒ parents after children).
+Output is (B, n_nodes, H), matching the reference's per-node outputs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.core.table import Table
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.module import Module
+
+
+class BinaryTreeLSTM(Module):
+    """Input Table(embeddings (B, n_words, D), trees Table/stacked arrays
+    (left, right, word) each (B, n_nodes)) -> (B, n_nodes, H) hiddens."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 gate_output: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.gate_output = gate_output
+
+    def build(self, rng, input_shape):
+        d, h = self.input_size, self.hidden_size
+        k1, k2, k3 = jax.random.split(rng, 3)
+        xavier = init_mod.Xavier()
+        params = {
+            # leaf: i, o, u gates from the word embedding (f unused on leaves)
+            "w_leaf": xavier(k1, (d, 3 * h), d, h),
+            "b_leaf": jnp.zeros((3 * h,), jnp.float32),
+            # composer: i, f_l, f_r, o, u from (h_l, h_r)
+            "w_comp": xavier(k2, (2 * h, 5 * h), 2 * h, h),
+            "b_comp": jnp.zeros((5 * h,), jnp.float32),
+        }
+        emb_shape = input_shape[1]
+        tree_spec = input_shape[2]
+        # Table of three (B, n_nodes) shapes, or one stacked (B, n_nodes[, 3])
+        n_nodes = tree_spec[1][1] if isinstance(tree_spec, Table) else tree_spec[1]
+        return params, {}, (emb_shape[0], n_nodes, h)
+
+    def _leaf(self, params, x):
+        gates = x @ params["w_leaf"] + params["b_leaf"]
+        i, o, u = jnp.split(gates, 3, axis=-1)
+        c = jax.nn.sigmoid(i) * jnp.tanh(u)
+        h = jnp.tanh(c)
+        if self.gate_output:
+            h = jax.nn.sigmoid(o) * h
+        return h, c
+
+    def _compose(self, params, h_l, c_l, h_r, c_r):
+        gates = jnp.concatenate([h_l, h_r], axis=-1) @ params["w_comp"] \
+            + params["b_comp"]
+        i, f_l, f_r, o, u = jnp.split(gates, 5, axis=-1)
+        c = (jax.nn.sigmoid(i) * jnp.tanh(u)
+             + jax.nn.sigmoid(f_l) * c_l + jax.nn.sigmoid(f_r) * c_r)
+        h = jnp.tanh(c)
+        if self.gate_output:
+            h = jax.nn.sigmoid(o) * h
+        return h, c
+
+    def _one_tree(self, params, emb, left, right, word):
+        n_nodes = left.shape[0]
+        hsize = self.hidden_size
+        h_buf = jnp.zeros((n_nodes, hsize), emb.dtype)
+        c_buf = jnp.zeros((n_nodes, hsize), emb.dtype)
+
+        def step(carry, idx):
+            h_all, c_all = carry
+            l, r, w = left[idx], right[idx], word[idx]
+            is_leaf = l < 0
+            x = emb[jnp.clip(w, 0, emb.shape[0] - 1)]
+            h_leaf, c_leaf = self._leaf(params, x)
+            h_l = h_all[jnp.clip(l, 0, n_nodes - 1)]
+            c_l = c_all[jnp.clip(l, 0, n_nodes - 1)]
+            h_r = h_all[jnp.clip(r, 0, n_nodes - 1)]
+            c_r = c_all[jnp.clip(r, 0, n_nodes - 1)]
+            h_comp, c_comp = self._compose(params, h_l, c_l, h_r, c_r)
+            h_new = jnp.where(is_leaf, h_leaf, h_comp)
+            c_new = jnp.where(is_leaf, c_leaf, c_comp)
+            # padding node (leaf-coded but word < 0): zero state
+            is_pad = jnp.logical_and(is_leaf, w < 0)
+            h_new = jnp.where(is_pad, 0.0, h_new)
+            c_new = jnp.where(is_pad, 0.0, c_new)
+            return (h_all.at[idx].set(h_new), c_all.at[idx].set(c_new)), None
+
+        (h_all, _), _ = lax.scan(step, (h_buf, c_buf), jnp.arange(n_nodes))
+        return h_all
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        emb, tree = x[1], x[2]
+        if isinstance(tree, Table):
+            left, right, word = tree[1], tree[2], tree[3]
+        else:  # stacked (B, n_nodes, 3)
+            left, right, word = tree[..., 0], tree[..., 1], tree[..., 2]
+        out = jax.vmap(lambda e, l, r, w: self._one_tree(params, e, l, r, w)
+                       )(emb, left.astype(jnp.int32), right.astype(jnp.int32),
+                         word.astype(jnp.int32))
+        return out, state

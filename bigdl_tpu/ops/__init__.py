@@ -8,3 +8,4 @@ from bigdl_tpu.ops.attention import (
     ring_attention,
     ulysses_attention,
 )
+from bigdl_tpu.ops.flash_attention import flash_attention

@@ -1,0 +1,231 @@
+"""Pallas TPU flash attention (blockwise, online-softmax) with custom VJP.
+
+No reference counterpart (the reference has no attention, survey §5.7); this
+is the single-chip hot core under `MultiHeadAttention`, complementing the
+cross-chip cores in `bigdl_tpu.ops.attention` (ring/Ulysses move K/V between
+chips; flash tiles them through VMEM within a chip).
+
+Design (per /opt/skills/guides/pallas_guide.md):
+  * grid = (B*H, Sq/block_q, Sk/block_k); the k-block axis is innermost and
+    therefore sequential on TPU, so the online-softmax accumulators (acc, m,
+    l) live in VMEM scratch across k iterations.
+  * Q blocks stream (block_q, D); K/V blocks stream (block_k, D); logits are
+    computed on the MXU with preferred_element_type=float32.
+  * The forward also emits the per-row log-sum-exp (LSE); the backward
+    recomputes P = exp(S - LSE) blockwise under `lax.scan` (no O(S^2)
+    residual is ever materialized), which is the standard FlashAttention-2
+    recompute strategy.
+
+`flash_attention` falls back to the dense core when shapes don't tile
+(sequence not divisible by the block sizes) so callers can use it
+unconditionally.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+from bigdl_tpu.ops.attention import dense_attention
+
+NEG_INF = -1e30
+# tuned on v5e: 1024-blocks beat 128..512 at S in [2k, 8k] (the (bq, bk)
+# f32 probability tile is the VMEM governor: 1024^2*4B = 4M of ~16M)
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, sm_scale: float, causal: bool,
+                block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _compute():
+        q = q_ref[0]  # (block_q, D)
+        k = k_ref[0]  # (block_k, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        m_safe = jnp.where(m_new <= NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - m_safe)
+        correction = jnp.exp(jnp.where(m_prev <= NEG_INF, NEG_INF,
+                                       m_prev - m_safe))
+        l_ref[:] = l_ref[:] * correction + p.sum(axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * correction + pv
+        m_ref[:] = m_new
+
+    if causal:
+        # whole block above the diagonal: nothing to add
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        m = m_ref[:]
+        lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
+        lse_ref[0] = lse  # (block_q, 1)
+
+
+def _flash_fwd_call(q, k, v, sm_scale: float, causal: bool,
+                    block_q: int, block_k: int, interpret: bool):
+    """q/k/v: (BH, S, D) -> (out (BH, Sq, D), lse (BH, Sq))."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    grid = (bh, sq // block_q, sk // block_k)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+    scratch = [
+        pltpu.VMEM((block_q, d), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        # (BH, Sq, 1): trailing dim 1 == full array dim satisfies the TPU
+        # block-tiling rule (last two block dims divisible by (8, 128) OR
+        # equal to the array dims)
+        jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+    ]
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse[..., 0]
+
+
+def _bwd_blockwise(q, k, v, out, lse, g, sm_scale: float, causal: bool,
+                   block_k: int):
+    """Memory-bounded backward: scan over k blocks recomputing P from LSE.
+
+    q/k/v/out/g: (BH, S, D), lse: (BH, Sq).  Standard FlashAttention-2
+    gradient: D = rowsum(dO * O); dS = P * (dP - D); dQ = dS K;
+    dK = dS^T Q; dV = P^T dO.
+    """
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nk = sk // block_k
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)  # (BH, Sq)
+    qpos = jnp.arange(sq)
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+
+    def kblock(carry, j):
+        dq_acc = carry
+        kb = lax.dynamic_slice_in_dim(k, j * block_k, block_k, 1).astype(jnp.float32)
+        vb = lax.dynamic_slice_in_dim(v, j * block_k, block_k, 1).astype(jnp.float32)
+        s = jnp.einsum("bqd,bkd->bqk", qf, kb) * sm_scale
+        if causal:
+            kpos = j * block_k + jnp.arange(block_k)
+            s = jnp.where(qpos[:, None] >= kpos[None, :], s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])  # rows with lse=NEG_INF -> exp(-inf)=0
+        dv = jnp.einsum("bqk,bqd->bkd", p, gf)
+        dp = jnp.einsum("bqd,bkd->bqk", gf, vb)
+        ds = p * (dp - delta[..., None]) * sm_scale
+        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, kb)
+        dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        return dq_acc, (dk, dv)
+
+    dq, (dks, dvs) = lax.scan(kblock, jnp.zeros_like(qf), jnp.arange(nk))
+    # dks/dvs: (nk, BH, block_k, D) -> (BH, Sk, D)
+    dk = jnp.moveaxis(dks, 0, 1).reshape(bh, sk, d)
+    dv = jnp.moveaxis(dvs, 0, 1).reshape(bh, sk, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd_call(q, k, v, sm_scale, causal, block_q, block_k,
+                             interpret)
+    return out
+
+
+def _flash_core_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_call(q, k, v, sm_scale, causal, block_q, block_k,
+                               interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_core_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    return _bwd_blockwise(q, k, v, out, lse, g, sm_scale, causal, block_k)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = False, sm_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool = False) -> jax.Array:
+    """Blockwise flash attention over (B, S, H, D) inputs.
+
+    Falls back to `dense_attention` when the sequence doesn't tile by the
+    block sizes or pallas is unavailable, so it is always safe to call.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    on_tpu = jax.default_backend() == "tpu"
+    if (not _HAS_PLTPU) or sq % bq or sk % bk or not (on_tpu or interpret):
+        return dense_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    # (B, S, H, D) -> (B*H, S, D)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    out = _flash_core(qt, kt, vt, scale, causal, bq, bk, interpret)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -19,7 +19,7 @@ from bigdl_tpu.optim.schedules import (
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
     ValidationMethod, ValidationResult, Top1Accuracy, Top5Accuracy, Loss,
-    MAE, HitRatio, NDCG,
+    MAE, HitRatio, NDCG, TreeNNAccuracy,
 )
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.parameter_processor import (

@@ -137,3 +137,27 @@ class NDCG(ValidationMethod):
         rank = jnp.sum((output > pos_score).astype(jnp.int32), axis=-1)
         gain = jnp.where(rank < self.k, 1.0 / jnp.log2(rank.astype(jnp.float32) + 2.0), 0.0)
         return jnp.sum(gain), jnp.asarray(output.shape[0], jnp.int32)
+
+
+class TreeNNAccuracy(ValidationMethod):
+    """Root-node classification accuracy for tree models: output is
+    (B, n_nodes, C) per-node scores, target the root label.  The root is
+    the LAST node in this framework's children-before-parent topological
+    encoding (nn/treelstm.py); the reference selects its first-stored node
+    (optim/ValidationMethod.scala TreeNNAccuracy) — same capability,
+    different node order convention.
+    """
+
+    name = "TreeNNAccuracy"
+
+    def batch(self, output, target):
+        # per-example root = LAST NON-PADDING node (padding rows are exact
+        # zeros per nn/treelstm.py); a fixed -1 index would score padding
+        n = output.shape[1]
+        nonzero = jnp.any(output != 0, axis=-1)  # (B, N)
+        root_idx = n - 1 - jnp.argmax(nonzero[:, ::-1], axis=-1)
+        root = output[jnp.arange(output.shape[0]), root_idx]
+        pred = jnp.argmax(root, axis=-1)
+        target = target.reshape(pred.shape)
+        correct = jnp.sum((pred == target.astype(pred.dtype)).astype(jnp.float32))
+        return correct, jnp.asarray(target.shape[0], jnp.int32)

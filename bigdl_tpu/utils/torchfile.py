@@ -93,7 +93,15 @@ class _Reader:
             return self._string()
         if typeidx == TYPE_BOOLEAN:
             return bool(self._int())
-        if typeidx in (TYPE_TABLE, TYPE_TORCH, TYPE_FUNCTION,
+        if typeidx == TYPE_FUNCTION:
+            # plain function dump carries NO heap index (torch File.lua):
+            # size + bytecode, then the upvalue table
+            n = self._int()
+            code = self.f.read(n)
+            upvalues = self.read_object()
+            return TorchObject("function", {"bytecode": code,
+                                            "upvalues": upvalues})
+        if typeidx in (TYPE_TABLE, TYPE_TORCH,
                        TYPE_RECUR_FUNCTION, TYPE_LEGACY_RECUR_FUNCTION):
             index = self._int()
             if index in self.memo:
@@ -102,12 +110,12 @@ class _Reader:
                 return self._read_torch(index)
             if typeidx == TYPE_TABLE:
                 return self._read_table(index)
-            # function dump: size + bytecode, then upvalue table — keep opaque
+            # recursive function dump: indexed, then size + bytecode + upvalues
             n = self._int()
             code = self.f.read(n)
-            upvalues = self.read_object()
-            obj = TorchObject("function", {"bytecode": code, "upvalues": upvalues})
-            self.memo[index] = obj
+            obj = TorchObject("function", {"bytecode": code, "upvalues": None})
+            self.memo[index] = obj  # memoize BEFORE upvalues (may self-refer)
+            obj.contents["upvalues"] = self.read_object()
             return obj
         raise ValueError(f"unknown .t7 type tag {typeidx}")
 

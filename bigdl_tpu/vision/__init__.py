@@ -22,4 +22,15 @@ from bigdl_tpu.vision.image import (
     Flip,
     ResizeTo,
     ImageFrameToSample,
+    ColorJitter,
+    Lighting,
+    AspectScale,
+    RandomAspectScale,
+    RandomAlterAspect,
+    ChannelOrder,
+    Filler,
+    PixelNormalizer,
+    ChannelScaledNormalizer,
+    RandomTransformer,
+    MTImageFeatureToBatch,
 )

@@ -14,9 +14,21 @@ engine.  Augmentation kernels are shared with bigdl_tpu.dataset.image.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+
+def _locked_sample(transformer, fn):
+    """Draw from a transformer's RandomState under a per-instance lock —
+    np.random.RandomState is not thread-safe, and MTImageFeatureToBatch runs
+    transforms on a thread pool."""
+    lock = getattr(transformer, "_rs_lock", None)
+    if lock is None:
+        lock = transformer._rs_lock = threading.Lock()
+    with lock:
+        return fn()
 
 from bigdl_tpu.dataset.image import (
     adjust_brightness,
@@ -151,7 +163,8 @@ class Brightness(FeatureTransformer):
         self.rs = np.random.RandomState(seed)
 
     def transform_image(self, img):
-        return adjust_brightness(img, self.rs.uniform(self.low, self.high))
+        delta = _locked_sample(self, lambda: self.rs.uniform(self.low, self.high))
+        return adjust_brightness(img, delta)
 
 
 class Contrast(FeatureTransformer):
@@ -160,7 +173,8 @@ class Contrast(FeatureTransformer):
         self.rs = np.random.RandomState(seed)
 
     def transform_image(self, img):
-        return adjust_contrast(img, self.rs.uniform(self.low, self.high))
+        f = _locked_sample(self, lambda: self.rs.uniform(self.low, self.high))
+        return adjust_contrast(img, f)
 
 
 class Saturation(FeatureTransformer):
@@ -169,7 +183,8 @@ class Saturation(FeatureTransformer):
         self.rs = np.random.RandomState(seed)
 
     def transform_image(self, img):
-        return adjust_saturation(img, self.rs.uniform(self.low, self.high))
+        f = _locked_sample(self, lambda: self.rs.uniform(self.low, self.high))
+        return adjust_saturation(img, f)
 
 
 class Hue(FeatureTransformer):
@@ -179,7 +194,8 @@ class Hue(FeatureTransformer):
         self.rs = np.random.RandomState(seed)
 
     def transform_image(self, img):
-        return adjust_hue(img, self.rs.uniform(self.low, self.high))
+        d = _locked_sample(self, lambda: self.rs.uniform(self.low, self.high))
+        return adjust_hue(img, d)
 
 
 class ChannelNormalize(FeatureTransformer):
@@ -208,8 +224,8 @@ class RandomCropper(FeatureTransformer):
 
     def transform_image(self, img):
         ih, iw = img.shape[:2]
-        y = self.rs.randint(0, ih - self.h + 1)
-        x = self.rs.randint(0, iw - self.w + 1)
+        y, x = _locked_sample(self, lambda: (self.rs.randint(0, ih - self.h + 1),
+                                              self.rs.randint(0, iw - self.w + 1)))
         return _crop(img, y, x, self.h, self.w)
 
 
@@ -253,11 +269,11 @@ class Expand(FeatureTransformer):
 
     def transform_image(self, img):
         ih, iw, c = img.shape
-        ratio = self.rs.uniform(1.0, self.max_ratio)
+        ratio = _locked_sample(self, lambda: self.rs.uniform(1.0, self.max_ratio))
         oh, ow = int(ih * ratio), int(iw * ratio)
         canvas = np.broadcast_to(self.means, (oh, ow, c)).astype(np.float32).copy()
-        y = self.rs.randint(0, oh - ih + 1)
-        x = self.rs.randint(0, ow - iw + 1)
+        y, x = _locked_sample(self, lambda: (self.rs.randint(0, oh - ih + 1),
+                                              self.rs.randint(0, ow - iw + 1)))
         canvas[y:y + ih, x:x + iw] = img
         return canvas
 
@@ -268,7 +284,7 @@ class Flip(FeatureTransformer):
         self.rs = np.random.RandomState(seed)
 
     def transform_image(self, img):
-        return hflip(img) if self.rs.rand() < self.p else img
+        return hflip(img) if _locked_sample(self, self.rs.rand) < self.p else img
 
 
 class ImageFrameToSample(FeatureTransformer):
@@ -283,3 +299,231 @@ class ImageFrameToSample(FeatureTransformer):
             np.ascontiguousarray(feature.image, np.float32),
             None if label is None else np.asarray(label))
         return feature
+
+
+class ColorJitter(FeatureTransformer):
+    """Random brightness/contrast/saturation in random order.
+    reference: augmentation/ColorJitter.scala."""
+
+    def __init__(self, brightness: float = 32.0, contrast: float = 0.5,
+                 saturation: float = 0.5, seed: int = 0):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        self.rs = np.random.RandomState(seed)
+
+    def transform_image(self, img):
+        def draw():
+            return (self.rs.uniform(-self.brightness, self.brightness),
+                    self.rs.uniform(1 - self.contrast, 1 + self.contrast),
+                    self.rs.uniform(1 - self.saturation, 1 + self.saturation),
+                    self.rs.permutation(3))
+
+        b_delta, c_factor, s_factor, order = _locked_sample(self, draw)
+        ops = [lambda im: adjust_brightness(im, b_delta),
+               lambda im: adjust_contrast(im, c_factor),
+               lambda im: adjust_saturation(im, s_factor)]
+        for i in order:
+            img = ops[i](img)
+        return img
+
+
+class Lighting(FeatureTransformer):
+    """AlexNet-style PCA color noise (reference: augmentation/Lighting.scala;
+    eigen basis shared with dataset.image.Lighting)."""
+
+    def __init__(self, alpha_std: float = 0.1, seed: int = 0):
+        self.alpha_std = alpha_std
+        self.rs = np.random.RandomState(seed)
+
+    def transform_image(self, img):
+        from bigdl_tpu.dataset.image import Lighting as _L
+
+        alpha = _locked_sample(
+            self, lambda: self.rs.normal(0, self.alpha_std, 3)).astype(np.float32)
+        noise = (_L.EIG_VEC * alpha * _L.EIG_VAL).sum(axis=1)
+        return img + noise[None, None, :]
+
+
+class AspectScale(FeatureTransformer):
+    """Resize so the short side equals `scale`, capped at `max_size` on the
+    long side (reference: augmentation/AspectScale.scala)."""
+
+    def __init__(self, scale: int, max_size: int = 1000,
+                 scale_multiple_of: int = 1):
+        self.scale = scale
+        self.max_size = max_size
+        self.multiple = scale_multiple_of
+
+    def _target(self, h, w, scale=None):
+        short, long = min(h, w), max(h, w)
+        ratio = (self.scale if scale is None else scale) / short
+        if ratio * long > self.max_size:
+            ratio = self.max_size / long
+        th, tw = int(round(h * ratio)), int(round(w * ratio))
+        if self.multiple > 1:
+            th = -(-th // self.multiple) * self.multiple
+            tw = -(-tw // self.multiple) * self.multiple
+        return th, tw
+
+    def transform_image(self, img):
+        th, tw = self._target(img.shape[0], img.shape[1])
+        return resize_bilinear(img, th, tw)
+
+
+class RandomAspectScale(AspectScale):
+    """Pick the short-side scale randomly from `scales`.
+    reference: augmentation/RandomAspectScale.scala."""
+
+    def __init__(self, scales: Sequence[int], max_size: int = 1000, seed: int = 0):
+        super().__init__(scales[0], max_size)
+        self.scales = list(scales)
+        self.rs = np.random.RandomState(seed)
+
+    def transform_image(self, img):
+        scale = _locked_sample(
+            self, lambda: self.scales[self.rs.randint(len(self.scales))])
+        th, tw = self._target(img.shape[0], img.shape[1], scale)
+        return resize_bilinear(img, th, tw)
+
+
+class RandomAlterAspect(FeatureTransformer):
+    """Random area+aspect-ratio crop resized to a fixed size — the
+    Inception-style training crop (reference:
+    augmentation/RandomAlterAspect.scala)."""
+
+    def __init__(self, min_area_ratio: float = 0.08, max_area_ratio: float = 1.0,
+                 min_aspect: float = 3 / 4, out_h: int = 224, out_w: int = 224,
+                 seed: int = 0):
+        self.min_area = min_area_ratio
+        self.max_area = max_area_ratio
+        self.min_aspect = min_aspect
+        self.out_h, self.out_w = out_h, out_w
+        self.rs = np.random.RandomState(seed)
+
+    def transform_image(self, img):
+        h, w = img.shape[:2]
+        area = h * w
+
+        def draw():
+            for _ in range(10):
+                target = self.rs.uniform(self.min_area, self.max_area) * area
+                aspect = self.rs.uniform(self.min_aspect, 1.0 / self.min_aspect)
+                cw = int(round(np.sqrt(target * aspect)))
+                ch = int(round(np.sqrt(target / aspect)))
+                if ch <= h and cw <= w:
+                    return (self.rs.randint(0, h - ch + 1),
+                            self.rs.randint(0, w - cw + 1), ch, cw)
+            return None
+
+        box = _locked_sample(self, draw)
+        if box is None:
+            return resize_bilinear(img, self.out_h, self.out_w)
+        y, x, ch, cw = box
+        return resize_bilinear(_crop(img, y, x, ch, cw), self.out_h, self.out_w)
+
+
+class ChannelOrder(FeatureTransformer):
+    """Randomly permute the color channels
+    (reference: augmentation/ChannelOrder.scala — RGB<->BGR swap)."""
+
+    def __init__(self, seed: int = 0):
+        self.rs = np.random.RandomState(seed)
+
+    def transform_image(self, img):
+        perm = _locked_sample(self, lambda: self.rs.permutation(img.shape[2]))
+        return img[:, :, perm]
+
+
+class Filler(FeatureTransformer):
+    """Fill a normalized-coordinate sub-rectangle with a constant value
+    (reference: augmentation/Filler.scala)."""
+
+    def __init__(self, start_x: float, start_y: float, end_x: float,
+                 end_y: float, value: float = 255.0):
+        self.box = (start_x, start_y, end_x, end_y)
+        self.value = value
+
+    def transform_image(self, img):
+        h, w = img.shape[:2]
+        x1, y1, x2, y2 = self.box
+        out = img.copy()
+        out[int(y1 * h):int(y2 * h), int(x1 * w):int(x2 * w)] = self.value
+        return out
+
+
+class PixelNormalizer(FeatureTransformer):
+    """Subtract a per-pixel mean array (reference:
+    augmentation/PixelNormalizer.scala)."""
+
+    def __init__(self, means: np.ndarray):
+        self.means = np.asarray(means, np.float32)
+
+    def transform_image(self, img):
+        return img - self.means.reshape(img.shape)
+
+
+class ChannelScaledNormalizer(FeatureTransformer):
+    """Per-channel mean subtraction + global scale
+    (reference: augmentation/ChannelScaledNormalizer.scala)."""
+
+    def __init__(self, mean_r: float, mean_g: float, mean_b: float, scale: float):
+        self.means = np.asarray([mean_r, mean_g, mean_b], np.float32)
+        self.scale = scale
+
+    def transform_image(self, img):
+        return (img - self.means[None, None, :]) * self.scale
+
+
+class RandomTransformer(FeatureTransformer):
+    """Apply the inner transformer with probability p
+    (reference: augmentation/RandomTransformer.scala)."""
+
+    def __init__(self, inner: FeatureTransformer, p: float, seed: int = 0):
+        self.inner = inner
+        self.p = p
+        self.rs = np.random.RandomState(seed)
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        if _locked_sample(self, self.rs.rand) < self.p:
+            return self.inner.transform(feature)
+        return feature
+
+
+class MTImageFeatureToBatch:
+    """Thread-pooled transform + batch assembly: pulls ImageFeatures, runs
+    the transformer across worker threads, emits stacked (images, labels)
+    numpy batches.  reference: MTImageFeatureToBatch.scala (its Engine-pool
+    parallel transform); numpy releases the GIL on the heavy ops so Python
+    threads genuinely overlap.
+    """
+
+    def __init__(self, width: int, height: int, batch_size: int,
+                 transformer: FeatureTransformer, num_threads: int = 4):
+        self.width, self.height = width, height
+        self.batch_size = batch_size
+        self.transformer = transformer
+        self.num_threads = num_threads
+
+    def __call__(self, features: Iterable[ImageFeature]
+                 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        from concurrent.futures import ThreadPoolExecutor
+
+        feats = iter(features)
+        with ThreadPoolExecutor(self.num_threads) as pool:
+            while True:
+                chunk = []
+                for _ in range(self.batch_size):
+                    try:
+                        chunk.append(next(feats))
+                    except StopIteration:
+                        break
+                if not chunk:
+                    return
+                done = list(pool.map(self.transformer.transform, chunk))
+                imgs = np.stack([
+                    resize_bilinear(f.image, self.height, self.width)
+                    if f.image.shape[:2] != (self.height, self.width)
+                    else f.image for f in done])
+                labels = np.asarray([f.get(ImageFeature.LABEL, -1) for f in done])
+                yield imgs, labels

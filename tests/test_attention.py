@@ -185,3 +185,58 @@ def test_ulysses_head_divisibility_validated(rng):
     x = jax.random.normal(rng, (2, 16, 32), jnp.float32)
     with pytest.raises(ValueError, match="divisible"):
         layer.apply(params, state, x)
+
+
+class TestFlashAttention:
+    """Pallas blockwise kernel vs the dense core (interpret mode on CPU)."""
+
+    def _qkv(self, b=2, s=256, h=4, d=64):
+        rs = np.random.RandomState(0)
+        mk = lambda: jnp.asarray(rs.randn(b, s, h, d), jnp.float32)
+        return mk(), mk(), mk()
+
+    def test_fwd_matches_dense(self):
+        from bigdl_tpu.ops.flash_attention import flash_attention
+
+        q, k, v = self._qkv()
+        for causal in (False, True):
+            ref = dense_attention(q, k, v, causal=causal)
+            out = flash_attention(q, k, v, causal=causal, block_q=64,
+                                  block_k=64, interpret=True)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=2e-5)
+
+    def test_grads_match_dense(self):
+        from bigdl_tpu.ops.flash_attention import flash_attention
+
+        q, k, v = self._qkv(s=128)
+        loss_f = lambda *a: (flash_attention(
+            *a, causal=True, block_q=64, block_k=64, interpret=True) ** 2).sum()
+        loss_d = lambda *a: (dense_attention(*a, causal=True) ** 2).sum()
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gd):
+            scale = float(jnp.abs(b).max())
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5 * max(scale, 1.0))
+
+    def test_fallback_on_untiled_shapes(self):
+        from bigdl_tpu.ops.flash_attention import flash_attention
+
+        # s=100 doesn't tile by 64 -> silently uses dense path
+        q, k, v = self._qkv(s=100)
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_mha_use_flash_flag(self):
+        m_flash = nn.MultiHeadAttention(32, 4, causal=True, use_flash=True)
+        m_dense = nn.MultiHeadAttention(32, 4, causal=True)
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 64, 32), jnp.float32)
+        p, s, _ = m_flash.build(jax.random.PRNGKey(0), x.shape)
+        # interpret-mode via monkeypatched default is unnecessary: on CPU
+        # without pallas-TPU these shapes fall back to dense; outputs of the
+        # two configs must agree either way
+        y1, _ = m_flash.apply(p, s, x)
+        y2, _ = m_dense.apply(p, s, x)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-5)

@@ -1,0 +1,106 @@
+"""Tests for the Keras-1.2.2 config converter, the perf harness, and
+example entry points (reference: pyspark/bigdl/keras/converter.py,
+models/utils/{Local,Distri}OptimizerPerf.scala, example/)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+
+
+class TestKerasConverter:
+    def _mlp_json(self):
+        return json.dumps({
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "Dense",
+                 "config": {"name": "d1", "output_dim": 16,
+                            "activation": "relu", "bias": True,
+                            "batch_input_shape": [None, 8]}},
+                {"class_name": "Dropout", "config": {"name": "do", "p": 0.5}},
+                {"class_name": "Dense",
+                 "config": {"name": "d2", "output_dim": 4,
+                            "activation": "softmax"}},
+            ]})
+
+    def test_mlp_roundtrip(self):
+        from bigdl_tpu.keras.converter import model_from_json_config
+
+        m = model_from_json_config(self._mlp_json())
+        p, s, out = m.build(jax.random.PRNGKey(0), (2, 8))
+        assert out == (2, 4)
+        y, _ = m.apply(p, s, jnp.ones((2, 8)))
+        np.testing.assert_allclose(np.asarray(y).sum(axis=-1), 1.0, atol=1e-5)
+
+    def test_convnet_config(self):
+        from bigdl_tpu.keras.converter import model_from_json_config
+
+        spec = {
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "Convolution2D",
+                 "config": {"nb_filter": 6, "nb_row": 5, "nb_col": 5,
+                            "activation": "tanh", "border_mode": "valid",
+                            "subsample": [1, 1], "dim_ordering": "tf",
+                            "batch_input_shape": [None, 28, 28, 1]}},
+                {"class_name": "MaxPooling2D",
+                 "config": {"pool_size": [2, 2]}},
+                {"class_name": "Flatten", "config": {}},
+                {"class_name": "Dense", "config": {"output_dim": 10}},
+            ]}
+        m = model_from_json_config(spec)
+        p, s, out = m.build(jax.random.PRNGKey(0), (2, 28, 28, 1))
+        assert out == (2, 10)
+
+    def test_lstm_and_embedding(self):
+        from bigdl_tpu.keras.converter import model_from_json_config
+
+        spec = {
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "Embedding",
+                 "config": {"input_dim": 50, "output_dim": 8,
+                            "batch_input_shape": [None, 12]}},
+                {"class_name": "LSTM",
+                 "config": {"output_dim": 6, "return_sequences": False}},
+                {"class_name": "Dense", "config": {"output_dim": 2}},
+            ]}
+        m = model_from_json_config(spec)
+        p, s, out = m.build(jax.random.PRNGKey(0), (3, 12))
+        y, _ = m.apply(p, s, jnp.zeros((3, 12), jnp.int32))
+        assert y.shape == (3, 2)
+
+    def test_unknown_layer_raises(self):
+        from bigdl_tpu.keras.converter import model_from_json_config
+
+        with pytest.raises(ValueError, match="unsupported"):
+            model_from_json_config({
+                "class_name": "Sequential",
+                "config": [{"class_name": "Lambda", "config": {}}]})
+
+
+class TestPerfHarness:
+    def test_lenet_perf_runs(self):
+        from bigdl_tpu.models.perf import run_perf
+
+        rec_s, ms = run_perf("lenet", batch_size=8, iterations=2, warmup=1)
+        assert rec_s > 0 and ms > 0
+
+    def test_unknown_model(self):
+        from bigdl_tpu.models.perf import build_model_and_shape
+
+        with pytest.raises(ValueError):
+            build_model_and_shape("nope", 4)
+
+
+class TestExamples:
+    def test_prediction_service_example(self, capsys):
+        import examples.prediction_service as ex
+
+        ex.main()
+        outp = capsys.readouterr().out
+        assert "request 7" in outp

@@ -174,3 +174,149 @@ class TestImageFrame:
             out = t(V.ImageFeature(img.copy()))
             assert out.image.shape == img.shape
             assert not np.allclose(out.image, img)
+
+
+class TestVisionAugmentationZoo:
+    def _feat(self, h=32, w=48, seed=0):
+        from bigdl_tpu.vision import ImageFeature
+
+        rs = np.random.RandomState(seed)
+        return ImageFeature(rs.rand(h, w, 3).astype("float32") * 255, label=3)
+
+    def test_aspect_scale(self):
+        from bigdl_tpu.vision import AspectScale, RandomAspectScale
+
+        f = AspectScale(64, max_size=200).transform(self._feat())
+        assert min(f.image.shape[:2]) == 64
+        f2 = RandomAspectScale([32, 64], seed=1).transform(self._feat())
+        assert min(f2.image.shape[:2]) in (32, 64)
+
+    def test_random_alter_aspect(self):
+        from bigdl_tpu.vision import RandomAlterAspect
+
+        f = RandomAlterAspect(out_h=24, out_w=24).transform(self._feat())
+        assert f.image.shape == (24, 24, 3)
+
+    def test_channel_order_permutes(self):
+        from bigdl_tpu.vision import ChannelOrder
+
+        feat = self._feat()
+        orig = feat.image.copy()
+        out = ChannelOrder(seed=3).transform(feat).image
+        np.testing.assert_allclose(
+            sorted(np.sum(out.astype("float64"), axis=(0, 1))),
+            sorted(np.sum(orig.astype("float64"), axis=(0, 1))), rtol=1e-9)
+
+    def test_filler_and_normalizers(self):
+        from bigdl_tpu.vision import ChannelScaledNormalizer, Filler, PixelNormalizer
+
+        feat = self._feat()
+        out = Filler(0.0, 0.0, 0.5, 0.5, value=7.0).transform(feat).image
+        assert (out[:16, :24] == 7.0).all()
+        means = np.zeros_like(feat.image) + 2.0
+        out2 = PixelNormalizer(means).transform(self._feat()).image
+        np.testing.assert_allclose(out2, self._feat().image - 2.0, atol=1e-5)
+        out3 = ChannelScaledNormalizer(10, 20, 30, 0.5).transform(self._feat()).image
+        ref = (self._feat().image - np.asarray([10, 20, 30], "float32")) * 0.5
+        np.testing.assert_allclose(out3, ref, atol=1e-4)
+
+    def test_color_jitter_lighting_random_transformer(self):
+        from bigdl_tpu.vision import ColorJitter, Lighting, RandomTransformer
+
+        f = ColorJitter(seed=0).transform(self._feat())
+        assert f.image.shape == (32, 48, 3)
+        f2 = Lighting(seed=0).transform(self._feat())
+        assert not np.allclose(f2.image, self._feat().image)
+        # p=0 never applies; p=1 always applies
+        rt0 = RandomTransformer(Lighting(seed=0), 0.0)
+        np.testing.assert_allclose(rt0.transform(self._feat()).image,
+                                   self._feat().image)
+
+    def test_mt_image_feature_to_batch(self):
+        from bigdl_tpu.vision import ChannelNormalize, MTImageFeatureToBatch
+
+        feats = [self._feat(seed=i) for i in range(10)]
+        mt = MTImageFeatureToBatch(24, 20, batch_size=4,
+                                   transformer=ChannelNormalize([0, 0, 0], [1, 1, 1]),
+                                   num_threads=3)
+        batches = list(mt(feats))
+        assert [b[0].shape for b in batches] == \
+            [(4, 20, 24, 3), (4, 20, 24, 3), (2, 20, 24, 3)]
+        assert batches[0][1].shape == (4,)
+
+
+class TestDatasetParsers:
+    """Parsers against synthetic fixture files (reference:
+    pyspark/bigdl/dataset/{mnist,movielens,news20,sentence}.py)."""
+
+    def _write_mnist(self, tmp_path, n=5):
+        import gzip
+        import struct
+
+        rs = np.random.RandomState(0)
+        imgs = rs.randint(0, 256, (n, 28, 28), dtype=np.uint8)
+        labels = rs.randint(0, 10, n).astype(np.uint8)
+        with gzip.open(tmp_path / "train-images-idx3-ubyte.gz", "wb") as f:
+            f.write(struct.pack(">iiii", 2051, n, 28, 28) + imgs.tobytes())
+        with gzip.open(tmp_path / "train-labels-idx1-ubyte.gz", "wb") as f:
+            f.write(struct.pack(">ii", 2049, n) + labels.tobytes())
+        return imgs, labels
+
+    def test_mnist(self, tmp_path):
+        from bigdl_tpu.dataset import load_mnist
+
+        imgs, labels = self._write_mnist(tmp_path)
+        x, y = load_mnist(str(tmp_path), "train", normalize=False)
+        assert x.shape == (5, 28, 28, 1)
+        np.testing.assert_array_equal(x[..., 0].astype(np.uint8), imgs)
+        np.testing.assert_array_equal(y, labels)
+        xn, _ = load_mnist(str(tmp_path), "train", normalize=True)
+        assert abs(xn.mean()) < 3.0  # roughly standardized
+
+    def test_cifar10(self, tmp_path):
+        from bigdl_tpu.dataset import load_cifar10
+
+        rs = np.random.RandomState(0)
+        for i in range(1, 6):
+            rows = np.zeros((4, 3073), np.uint8)
+            rows[:, 0] = rs.randint(0, 10, 4)
+            rows[:, 1:] = rs.randint(0, 256, (4, 3072))
+            rows.tofile(str(tmp_path / f"data_batch_{i}.bin"))
+        x, y = load_cifar10(str(tmp_path), "train", normalize=False)
+        assert x.shape == (20, 32, 32, 3) and y.shape == (20,)
+
+    def test_movielens(self, tmp_path):
+        from bigdl_tpu.dataset import load_movielens_ratings
+
+        p = tmp_path / "ratings.dat"
+        p.write_text("1::31::2.5::964982224\n2::10::4.0::964982225\n")
+        r = load_movielens_ratings(str(p))
+        np.testing.assert_array_equal(r, [[1, 31, 2], [2, 10, 4]])
+
+    def test_news20_dirs_and_glove(self, tmp_path):
+        from bigdl_tpu.dataset import load_glove_embeddings, load_news20
+
+        for g, docs in [("alt.atheism", 2), ("sci.space", 3)]:
+            d = tmp_path / g
+            d.mkdir()
+            for i in range(docs):
+                (d / f"{i}").write_text(f"document {i} of {g}")
+        texts = load_news20(str(tmp_path))
+        assert len(texts) == 5
+        assert {t[1] for t in texts} == {0, 1}
+        gp = tmp_path / "glove.6B.3d.txt"
+        gp.write_text("the 0.1 0.2 0.3\ncat 1.0 2.0 3.0\n")
+        vocab, mat = load_glove_embeddings(str(gp), dim=3)
+        assert vocab == {"the": 0, "cat": 1}
+        np.testing.assert_allclose(mat[1], [1.0, 2.0, 3.0])
+
+    def test_sentence_and_missing_download(self, tmp_path):
+        import pytest as _pytest
+
+        from bigdl_tpu.dataset import maybe_download, read_sentence_corpus
+
+        p = tmp_path / "corpus.txt"
+        p.write_text("hello world\n\nsecond line\n")
+        assert read_sentence_corpus(str(p)) == ["hello world", "second line"]
+        with _pytest.raises(FileNotFoundError):
+            maybe_download("nope.bin", str(tmp_path), "http://example.com/x")

@@ -38,6 +38,7 @@ def _transformer_lm():
 EXEMPLARS = {
     "Abs": (lambda: nn.Abs(), lambda: rand(2, 3)),
     "LSTMPeephole": (lambda: nn.LSTMPeephole(3, 5), None),
+    "BinaryTreeLSTM": (lambda: nn.BinaryTreeLSTM(8, 6), None),
     "ConvLSTMPeephole": (lambda: nn.ConvLSTMPeephole(3, 4), None),
     "MultiRNNCell": (lambda: nn.MultiRNNCell([nn.LSTMCell(3, 5), nn.GRUCell(5, 4)]),
                      None),

@@ -1,0 +1,109 @@
+"""BinaryTreeLSTM tests (reference: nn/BinaryTreeLSTM.scala + the
+treeLSTMSentiment example; TreeNNAccuracy from ValidationMethod.scala)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.table import Table
+from bigdl_tpu.optim import TreeNNAccuracy
+
+
+def _tree_inputs():
+    """Two trees over 4-word sentences, padded to 7 nodes.
+
+    Tree A: ((w0 w1) (w2 w3)) — nodes: 0..3 leaves, 4=(0,1), 5=(2,3), 6=(4,5)
+    Tree B: (w0 (w1 w2)) padded — 0,1,2 leaves, 3=(1,2), 4=(0,3), 5,6 padding
+    """
+    left = np.asarray([[-1, -1, -1, -1, 0, 2, 4],
+                       [-1, -1, -1, 1, 0, -1, -1]], np.int32)
+    right = np.asarray([[-1, -1, -1, -1, 1, 3, 5],
+                        [-1, -1, -1, 2, 3, -1, -1]], np.int32)
+    word = np.asarray([[0, 1, 2, 3, -1, -1, -1],
+                       [0, 1, 2, -1, -1, -1, -1]], np.int32)
+    rs = np.random.RandomState(0)
+    emb = jnp.asarray(rs.rand(2, 4, 8), jnp.float32)
+    return emb, jnp.asarray(left), jnp.asarray(right), jnp.asarray(word)
+
+
+class TestBinaryTreeLSTM:
+    def test_shapes_and_padding(self):
+        emb, left, right, word = _tree_inputs()
+        m = nn.BinaryTreeLSTM(8, 6)
+        p, s, oshape = m.build(jax.random.PRNGKey(0),
+                               Table((2, 4, 8), (2, 7), (2, 7)))
+        out, _ = m.apply(p, s, Table(emb, Table(left, right, word)))
+        assert out.shape == (2, 7, 6) == oshape
+        out_np = np.asarray(out)
+        # padding nodes of tree B are zero; real nodes are not
+        assert np.allclose(out_np[1, 5:], 0.0)
+        assert not np.allclose(out_np[1, 4], 0.0)
+
+    def test_composition_uses_children(self):
+        emb, left, right, word = _tree_inputs()
+        m = nn.BinaryTreeLSTM(8, 6)
+        p, s, _ = m.build(jax.random.PRNGKey(0),
+                          Table((2, 4, 8), (2, 7), (2, 7)))
+        out1, _ = m.apply(p, s, Table(emb, Table(left, right, word)))
+        # perturb word 0's embedding: root of both trees must change
+        emb2 = emb.at[:, 0].add(1.0)
+        out2, _ = m.apply(p, s, Table(emb2, Table(left, right, word)))
+        assert not np.allclose(np.asarray(out1)[0, 6], np.asarray(out2)[0, 6])
+        assert not np.allclose(np.asarray(out1)[1, 4], np.asarray(out2)[1, 4])
+
+    def test_gradients_flow_to_both_branches(self):
+        emb, left, right, word = _tree_inputs()
+        m = nn.BinaryTreeLSTM(8, 6)
+        p, s, _ = m.build(jax.random.PRNGKey(0),
+                          Table((2, 4, 8), (2, 7), (2, 7)))
+
+        def loss(p_):
+            out, _ = m.apply(p_, s, Table(emb, Table(left, right, word)))
+            return (out[:, -1] ** 2).sum()  # root only
+
+        g = jax.grad(loss)(p)
+        for leaf in jax.tree_util.tree_leaves(g):
+            assert np.isfinite(np.asarray(leaf)).all()
+        assert float(jnp.abs(g["w_leaf"]).sum()) > 0
+        assert float(jnp.abs(g["w_comp"]).sum()) > 0
+
+    def test_jit_and_stacked_tree_encoding(self):
+        emb, left, right, word = _tree_inputs()
+        tree = jnp.stack([left, right, word], axis=-1)  # (B, n_nodes, 3)
+        m = nn.BinaryTreeLSTM(8, 4)
+        p, s, _ = m.build(jax.random.PRNGKey(1),
+                          Table((2, 4, 8), (2, 7), (2, 7)))
+        f = jax.jit(lambda p_, e_, t_: m.apply(p_, s, Table(e_, t_))[0])
+        out = f(p, emb, tree)
+        ref, _ = m.apply(p, s, Table(emb, Table(left, right, word)))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+class TestTreeNNAccuracy:
+    def test_root_accuracy(self):
+        out = jnp.asarray([
+            [[0.0, 1.0], [0.0, 1.0], [2.0, 0.0]],   # root predicts 0
+            [[0.0, 1.0], [0.0, 1.0], [0.0, 2.0]],   # root predicts 1
+        ])
+        target = jnp.asarray([0, 0])
+        v, c = TreeNNAccuracy().batch(out, target)
+        assert float(v) == 1.0 and int(c) == 2
+
+
+class TestReviewRegressions:
+    def test_build_with_table_tree_spec(self):
+        m = nn.BinaryTreeLSTM(8, 6)
+        _, _, out = m.build(jax.random.PRNGKey(0),
+                            Table((2, 4, 8), Table((2, 7), (2, 7), (2, 7))))
+        assert out == (2, 7, 6)
+        _, _, out2 = m.build(jax.random.PRNGKey(0),
+                             Table((2, 4, 8), (2, 7, 3)))
+        assert out2 == (2, 7, 6)
+
+    def test_tree_accuracy_skips_padding(self):
+        # root of a padded tree is node 1, nodes 2.. are zero padding
+        out = jnp.asarray([[[0.0, 5.0], [3.0, 0.0], [0.0, 0.0], [0.0, 0.0]]])
+        target = jnp.asarray([0])
+        v, c = TreeNNAccuracy().batch(out, target)
+        assert float(v) == 1.0 and int(c) == 1  # node 1 predicts class 0
