@@ -141,23 +141,33 @@ class NDCG(ValidationMethod):
 
 class TreeNNAccuracy(ValidationMethod):
     """Root-node classification accuracy for tree models: output is
-    (B, n_nodes, C) per-node scores, target the root label.  The root is
-    the LAST node in this framework's children-before-parent topological
-    encoding (nn/treelstm.py); the reference selects its first-stored node
+    (B, n_nodes, C) per-node scores.  The root is the LAST node in this
+    framework's children-before-parent topological encoding
+    (nn/treelstm.py); the reference selects its first-stored node
     (optim/ValidationMethod.scala TreeNNAccuracy) — same capability,
     different node order convention.
+
+    For batches of padded trees, pass per-example root indices as
+    `target = Table(labels, root_indices)` — a heuristic cannot recover
+    the root once a classifier head has made padding rows non-zero.
     """
 
     name = "TreeNNAccuracy"
 
+    def __init__(self, root_index: int = -1):
+        self.root_index = root_index
+
     def batch(self, output, target):
-        # per-example root = LAST NON-PADDING node (padding rows are exact
-        # zeros per nn/treelstm.py); a fixed -1 index would score padding
-        n = output.shape[1]
-        nonzero = jnp.any(output != 0, axis=-1)  # (B, N)
-        root_idx = n - 1 - jnp.argmax(nonzero[:, ::-1], axis=-1)
-        root = output[jnp.arange(output.shape[0]), root_idx]
+        from bigdl_tpu.core.table import Table
+
+        if isinstance(target, Table):
+            labels, roots = target[1], target[2]
+            root = output[jnp.arange(output.shape[0]),
+                          roots.astype(jnp.int32)]
+        else:
+            labels = target
+            root = output[:, self.root_index, :]
         pred = jnp.argmax(root, axis=-1)
-        target = target.reshape(pred.shape)
-        correct = jnp.sum((pred == target.astype(pred.dtype)).astype(jnp.float32))
-        return correct, jnp.asarray(target.shape[0], jnp.int32)
+        labels = labels.reshape(pred.shape)
+        correct = jnp.sum((pred == labels.astype(pred.dtype)).astype(jnp.float32))
+        return correct, jnp.asarray(labels.shape[0], jnp.int32)

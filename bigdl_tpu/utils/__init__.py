@@ -19,3 +19,9 @@ __all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
            "criterion_to_spec", "criterion_from_spec",
            "register_module", "register_criterion", "register_fn",
            "load_t7", "save_t7", "TorchObject"]
+from bigdl_tpu.utils.caffe import load_caffe, save_caffe
+
+__all__ += ["load_caffe", "save_caffe"]
+from bigdl_tpu.utils.tensorflow import load_tensorflow, save_tensorflow
+
+__all__ += ["load_tensorflow", "save_tensorflow"]

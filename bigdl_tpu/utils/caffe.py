@@ -1,0 +1,465 @@
+"""Caffe model import/export.
+
+Reference: utils/caffe/CaffeLoader.scala:57-100 (prototxt + caffemodel ->
+Graph with V1+V2 layer converters) and utils/caffe/CaffePersister.scala.
+The schema is a freshly-written minimal caffe.proto
+(bigdl_tpu/proto/caffe.proto) compiled with protoc.
+
+Layout conversions (Caffe is NCHW/OIHW; this framework is NHWC/HWIO):
+  conv weight (O, I, KH, KW) <-> (KH, KW, I, O); InnerProduct (O, I) <->
+  (I, O); Caffe InnerProduct consumes flattened NCHW activations, so a
+  4-D -> dense transition inserts a NHWC->NCHW Transpose before Flatten to
+  keep imported weights bit-compatible.
+
+`load_caffe(def_path, model_path)` -> (Graph, params, state): supports
+Convolution, InnerProduct, Pooling (max/ave/global, Caffe ceil-mode),
+ReLU, TanH, Sigmoid, Softmax, Dropout, LRN, BatchNorm(+fused Scale),
+Concat, Eltwise, Flatten, Input — enough for the LeNet/AlexNet/VGG/
+GoogLeNet families the reference loads.  V1 (`layers`) nets are upgraded
+in-place like CaffeLoader's V1 converters.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_PROTO_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                          "proto")
+if _PROTO_DIR not in sys.path:
+    sys.path.insert(0, _PROTO_DIR)
+
+import caffe_pb2  # noqa: E402  (generated; see bigdl_tpu/proto/caffe.proto)
+from google.protobuf import text_format  # noqa: E402
+
+import jax  # noqa: E402
+import bigdl_tpu.nn as nn  # noqa: E402
+from bigdl_tpu.core.table import Table  # noqa: E402
+
+_V1_TYPE_NAMES = {
+    caffe_pb2.V1LayerParameter.CONVOLUTION: "Convolution",
+    caffe_pb2.V1LayerParameter.INNER_PRODUCT: "InnerProduct",
+    caffe_pb2.V1LayerParameter.POOLING: "Pooling",
+    caffe_pb2.V1LayerParameter.RELU: "ReLU",
+    caffe_pb2.V1LayerParameter.LRN: "LRN",
+    caffe_pb2.V1LayerParameter.SOFTMAX: "Softmax",
+    caffe_pb2.V1LayerParameter.SOFTMAX_LOSS: "SoftmaxWithLoss",
+    caffe_pb2.V1LayerParameter.DROPOUT: "Dropout",
+    caffe_pb2.V1LayerParameter.CONCAT: "Concat",
+    caffe_pb2.V1LayerParameter.ELTWISE: "Eltwise",
+    caffe_pb2.V1LayerParameter.TANH: "TanH",
+    caffe_pb2.V1LayerParameter.SIGMOID: "Sigmoid",
+    caffe_pb2.V1LayerParameter.FLATTEN: "Flatten",
+}
+
+
+def _blob_array(blob) -> np.ndarray:
+    data = np.asarray(blob.double_data if len(blob.double_data) else blob.data,
+                      np.float32)
+    if blob.HasField("shape"):
+        dims = tuple(blob.shape.dim)
+    else:
+        dims = tuple(d for d in (blob.num, blob.channels, blob.height, blob.width))
+        while len(dims) > 1 and dims[0] in (0, 1) and int(np.prod([d for d in dims if d])) != data.size:
+            dims = dims[1:]
+        dims = tuple(d if d else 1 for d in dims)
+    return data.reshape(dims) if data.size == int(np.prod(dims)) else data
+
+
+def _upgrade_v1(net) -> List:
+    layers = list(net.layer)
+    for v1 in net.layers:
+        l = caffe_pb2.LayerParameter()
+        l.name = v1.name
+        l.type = _V1_TYPE_NAMES.get(v1.type, "Unknown")
+        l.bottom.extend(v1.bottom)
+        l.top.extend(v1.top)
+        for b in v1.blobs:
+            l.blobs.add().CopyFrom(b)
+        for field in ("convolution_param", "inner_product_param", "pooling_param",
+                      "lrn_param", "dropout_param", "concat_param", "eltwise_param"):
+            if v1.HasField(field):
+                getattr(l, field).CopyFrom(getattr(v1, field))
+        layers.append(l)
+    return layers
+
+
+def _conv_geom(cp):
+    kh = cp.kernel_h if cp.HasField("kernel_h") else (cp.kernel_size[0] if cp.kernel_size else 1)
+    kw = cp.kernel_w if cp.HasField("kernel_w") else (cp.kernel_size[-1] if cp.kernel_size else 1)
+    sh = cp.stride_h if cp.HasField("stride_h") else (cp.stride[0] if cp.stride else 1)
+    sw = cp.stride_w if cp.HasField("stride_w") else (cp.stride[-1] if cp.stride else 1)
+    ph = cp.pad_h if cp.pad_h else (cp.pad[0] if cp.pad else 0)
+    pw = cp.pad_w if cp.pad_w else (cp.pad[-1] if cp.pad else 0)
+    dil = cp.dilation[0] if cp.dilation else 1
+    return kh, kw, sh, sw, ph, pw, dil
+
+
+def load_caffe(def_path: str, model_path: Optional[str] = None,
+               input_shape: Optional[Sequence[int]] = None, seed: int = 0
+               ) -> Tuple[nn.Graph, Any, Any]:
+    """Parse prototxt (+ optional caffemodel weights) into (Graph, params,
+    state).  `input_shape` is NHWC and overrides the prototxt input dims."""
+    net = caffe_pb2.NetParameter()
+    with open(def_path, "r") as f:
+        text_format.Parse(f.read(), net)
+    weights: Dict[str, List[np.ndarray]] = {}
+    if model_path is not None:
+        wnet = caffe_pb2.NetParameter()
+        with open(model_path, "rb") as f:
+            wnet.ParseFromString(f.read())
+        for l in _upgrade_v1(wnet):
+            if l.blobs:
+                weights[l.name] = [_blob_array(b) for b in l.blobs]
+
+    layers = _upgrade_v1(net)
+
+    # --- input blobs -------------------------------------------------------
+    nodes: Dict[str, Any] = {}
+    shapes: Dict[str, Tuple[int, ...]] = {}
+    input_nodes: List[Any] = []
+
+    def add_input(name: str, shape_nchw: Sequence[int]):
+        node = nn.Input(name=f"input_{name}")
+        if input_shape is not None:
+            sh = tuple(input_shape)
+        else:
+            n, c, h, w = (list(shape_nchw) + [1, 1, 1, 1])[:4]
+            sh = (n, h, w, c) if len(shape_nchw) == 4 else tuple(shape_nchw)
+        nodes[name] = node
+        shapes[name] = sh
+        input_nodes.append(node)
+
+    for i, blob in enumerate(net.input):
+        if net.input_shape:
+            add_input(blob, tuple(net.input_shape[i].dim))
+        elif net.input_dim:
+            add_input(blob, tuple(net.input_dim[4 * i:4 * i + 4]))
+        else:
+            add_input(blob, (1, 3, 224, 224))
+
+    weight_sets: List[Tuple[str, Dict[str, np.ndarray]]] = []
+    consumed = set()
+    output_blobs: List[str] = []
+    pending_bn: Dict[str, str] = {}  # top blob -> bn layer name (await Scale)
+
+    for l in layers:
+        ltype = l.type
+        if ltype in ("Input", "Data"):
+            if l.top and l.top[0] not in nodes:
+                shape = tuple(l.input_param.shape[0].dim) if (
+                    l.HasField("input_param") and l.input_param.shape) else (1, 3, 224, 224)
+                add_input(l.top[0], shape)
+            continue
+        if not l.bottom:
+            continue
+        bottoms = list(l.bottom)
+        for b in bottoms:
+            consumed.add(b)
+        top = l.top[0] if l.top else l.name
+        bshape = shapes[bottoms[0]]
+        lw = weights.get(l.name)
+        module = None
+        extra_pre = None  # module applied to input first (dense transition)
+
+        if ltype == "Convolution":
+            cp = l.convolution_param
+            kh, kw, sh, sw, ph, pw, dil = _conv_geom(cp)
+            cin = bshape[-1]
+            if dil > 1:
+                module = nn.SpatialDilatedConvolution(
+                    cin, cp.num_output, kw, kh, sw, sh, pw, ph, dil, dil,
+                    name=l.name)
+            else:
+                module = nn.SpatialConvolution(
+                    cin, cp.num_output, kw, kh, sw, sh, pw, ph,
+                    n_group=cp.group, with_bias=cp.bias_term, name=l.name)
+            if lw:
+                w = {"weight": np.transpose(lw[0], (2, 3, 1, 0))}  # OIHW->HWIO
+                if cp.bias_term and len(lw) > 1:
+                    w["bias"] = lw[1].reshape(-1)
+                weight_sets.append((l.name, w))
+        elif ltype == "InnerProduct":
+            ip = l.inner_product_param
+            if len(bshape) == 4:
+                # caffe flattens NCHW; insert NHWC->NCHW transpose + flatten
+                extra_pre = nn.Sequential(
+                    nn.Transpose([(1, 3), (2, 3)]), nn.Flatten(),
+                    name=f"{l.name}_flatten")
+                fan_in = bshape[1] * bshape[2] * bshape[3]
+            else:
+                fan_in = bshape[-1]
+            module = nn.Linear(fan_in, ip.num_output, with_bias=ip.bias_term,
+                               name=l.name)
+            if lw:
+                w = {"weight": np.asarray(lw[0]).reshape(ip.num_output, -1).T}
+                if ip.bias_term and len(lw) > 1:
+                    w["bias"] = lw[1].reshape(-1)
+                weight_sets.append((l.name, w))
+        elif ltype == "Pooling":
+            pp = l.pooling_param
+            if pp.global_pooling:
+                module = nn.GlobalAveragePooling2D(name=l.name) \
+                    if pp.pool == caffe_pb2.PoolingParameter.AVE else None
+                if module is None:
+                    raise ValueError("global max pooling unsupported")
+            else:
+                kh = pp.kernel_h if pp.HasField("kernel_h") else pp.kernel_size
+                kw = pp.kernel_w if pp.HasField("kernel_w") else pp.kernel_size
+                sh = pp.stride_h if pp.HasField("stride_h") else pp.stride
+                sw = pp.stride_w if pp.HasField("stride_w") else pp.stride
+                cls = nn.SpatialMaxPooling \
+                    if pp.pool == caffe_pb2.PoolingParameter.MAX \
+                    else nn.SpatialAveragePooling
+                # Caffe's default round mode is CEIL (pooling_layer.cpp)
+                ceil = pp.round_mode == caffe_pb2.PoolingParameter.CEIL
+                module = cls(kw, kh, sw, sh, pp.pad_w or pp.pad,
+                             pp.pad_h or pp.pad, ceil_mode=ceil, name=l.name)
+        elif ltype == "ReLU":
+            slope = l.relu_param.negative_slope if l.HasField("relu_param") else 0.0
+            module = nn.LeakyReLU(slope, name=l.name) if slope else nn.ReLU(name=l.name)
+        elif ltype == "TanH":
+            module = nn.Tanh(name=l.name)
+        elif ltype == "Sigmoid":
+            module = nn.Sigmoid(name=l.name)
+        elif ltype in ("Softmax", "SoftmaxWithLoss"):
+            module = nn.SoftMax(name=l.name)
+        elif ltype == "Dropout":
+            module = nn.Dropout(l.dropout_param.dropout_ratio, name=l.name)
+        elif ltype == "LRN":
+            lp = l.lrn_param
+            module = nn.SpatialCrossMapLRN(lp.local_size, lp.alpha, lp.beta,
+                                           lp.k, name=l.name)
+        elif ltype == "BatchNorm":
+            cin = bshape[-1]
+            module = nn.SpatialBatchNormalization(
+                cin, eps=l.batch_norm_param.eps or 1e-5, name=l.name)
+            pending_bn[top] = l.name
+            if lw:
+                scale = lw[2].reshape(-1)[0] if len(lw) > 2 and lw[2].size else 1.0
+                scale = 1.0 / scale if scale != 0 else 0.0
+                weight_sets.append((l.name, {
+                    "running_mean": lw[0].reshape(-1) * scale,
+                    "running_var": lw[1].reshape(-1) * scale,
+                }))
+        elif ltype == "Scale":
+            # fuse gamma/beta into the preceding BatchNorm (CaffeLoader fuses
+            # the BatchNorm+Scale pair into one BN layer the same way)
+            bn_name = pending_bn.pop(bottoms[0], None)
+            if bn_name is None:
+                cin = bshape[-1]
+                module = nn.CMul((cin,), name=l.name) \
+                    if not l.scale_param.bias_term else nn.Scale((cin,), name=l.name)
+                if lw:
+                    w = {"weight": lw[0].reshape(-1)}
+                    if l.scale_param.bias_term and len(lw) > 1:
+                        w["bias"] = lw[1].reshape(-1)
+                    weight_sets.append((l.name, w))
+            else:
+                if lw:
+                    w = {"weight": lw[0].reshape(-1)}
+                    if len(lw) > 1:
+                        w["bias"] = lw[1].reshape(-1)
+                    weight_sets.append((bn_name, w))
+                nodes[top] = nodes[bottoms[0]]
+                shapes[top] = shapes[bottoms[0]]
+                continue
+        elif ltype == "Concat":
+            axis = l.concat_param.axis if l.HasField("concat_param") else 1
+            if len(bshape) == 4:
+                # NCHW -> NHWC axis map: N->N, C->last, H->1, W->2
+                our_axis = {0: 0, 1: 3, 2: 1, 3: 2}[axis % 4]
+            else:
+                our_axis = axis
+            module = nn.JoinTable(our_axis, name=l.name)
+        elif ltype == "Eltwise":
+            op = l.eltwise_param.operation
+            module = {caffe_pb2.EltwiseParameter.SUM: nn.CAddTable,
+                      caffe_pb2.EltwiseParameter.PROD: nn.CMulTable,
+                      caffe_pb2.EltwiseParameter.MAX: nn.CMaxTable}[op](name=l.name)
+        elif ltype == "Flatten":
+            module = nn.Sequential(nn.Transpose([(1, 3), (2, 3)]), nn.Flatten(),
+                                   name=l.name)
+        elif ltype in ("Accuracy", "Silence"):
+            continue
+        else:
+            raise ValueError(f"unsupported caffe layer type {ltype!r} "
+                             f"({l.name}); reference: utils/caffe/Caffe*.scala")
+
+        in_nodes = [nodes[b] for b in bottoms]
+        src = in_nodes[0]
+        if extra_pre is not None:
+            src = extra_pre(src)
+        node = module(src) if len(in_nodes) == 1 else module(*in_nodes)
+        nodes[top] = node
+        shapes[top] = _propagate_shape(module, extra_pre,
+                                       [shapes[b] for b in bottoms])
+        output_blobs.append(top)
+
+    outs = [nodes[b] for b in output_blobs if b not in consumed] or \
+        [nodes[output_blobs[-1]]]
+    model = nn.Graph(input_nodes, outs, name=net.name or "caffe_net")
+    build_shape = [shapes[b] for b in shapes if nodes.get(b) in input_nodes]
+    params, state, _ = model.build(
+        jax.random.PRNGKey(seed),
+        build_shape[0] if len(build_shape) == 1 else Table(*build_shape))
+
+    # inject weights
+    for lname, w in weight_sets:
+        target_p = params.get(lname)
+        target_s = state.get(lname)
+        for k, v in w.items():
+            arr = np.asarray(v, np.float32)
+            if target_p is not None and k in target_p:
+                assert target_p[k].shape == arr.shape, \
+                    f"{lname}.{k}: {target_p[k].shape} vs {arr.shape}"
+                target_p[k] = jax.numpy.asarray(arr)
+            elif target_s is not None and k in target_s:
+                target_s[k] = jax.numpy.asarray(arr)
+            else:
+                raise KeyError(f"no slot {k} in layer {lname}")
+    return model, params, state
+
+
+def _propagate_shape(module, extra_pre, in_shapes):
+    sh = in_shapes[0] if len(in_shapes) == 1 else Table(*in_shapes)
+    if extra_pre is not None:
+        _, _, sh = extra_pre.build(jax.random.PRNGKey(0), sh)
+    try:
+        _, _, out = module.build(jax.random.PRNGKey(0), sh)
+        return out
+    except Exception:
+        return sh
+
+
+# ---------------------------------------------------------------------------
+# export
+
+
+def save_caffe(model: nn.Module, params: Any, state: Any,
+               prototxt_path: str, caffemodel_path: Optional[str] = None,
+               input_shape: Optional[Sequence[int]] = None) -> None:
+    """Export a Sequential chain of supported layers to prototxt (+ weights).
+    reference: utils/caffe/CaffePersister.scala."""
+    net = caffe_pb2.NetParameter()
+    net.name = getattr(model, "name", "bigdl_tpu_net")
+    if input_shape is not None:
+        net.input.append("data")
+        n, h, w, c = input_shape
+        net.input_shape.add().dim.extend([n, c, h, w])  # NCHW on the wire
+    prev = "data"
+    if not hasattr(model, "children"):
+        raise ValueError("save_caffe exports Sequential models")
+    cur_shape = tuple(input_shape) if input_shape is not None else None
+    spatial_before_flatten = None  # (H, W, C) at the 4D->dense transition
+    for key, m in model.children.items():
+        l = net.layer.add()
+        l.name = m.name
+        l.bottom.append(prev)
+        l.top.append(m.name)
+        prev = m.name
+        p = params.get(key, {})
+        s = state.get(key, {})
+        if isinstance(m, nn.SpatialConvolution):
+            l.type = "Convolution"
+            cp = l.convolution_param
+            cp.num_output = m.n_output
+            cp.kernel_h, cp.kernel_w = m.kernel
+            cp.stride_h, cp.stride_w = m.stride
+            cp.pad_h, cp.pad_w = max(m.pad[0], 0), max(m.pad[1], 0)
+            cp.group = m.n_group
+            cp.bias_term = m.with_bias
+            if m.dilation != (1, 1):  # SpatialDilatedConvolution subclass
+                if m.dilation[0] != m.dilation[1]:
+                    raise ValueError("caffe supports square dilation only")
+                cp.dilation.append(m.dilation[0])
+            b = l.blobs.add()
+            w = np.transpose(np.asarray(p["weight"]), (3, 2, 0, 1))  # HWIO->OIHW
+            b.shape.dim.extend(w.shape)
+            b.data.extend(w.reshape(-1).tolist())
+            if m.with_bias:
+                bb = l.blobs.add()
+                bias = np.asarray(p["bias"])
+                bb.shape.dim.extend(bias.shape)
+                bb.data.extend(bias.tolist())
+        elif isinstance(m, nn.Linear):
+            l.type = "InnerProduct"
+            ip = l.inner_product_param
+            w = np.asarray(p["weight"])  # (in, out), rows in NHWC-flat order
+            if spatial_before_flatten is not None:
+                # caffe flattens NCHW: reorder rows (h, w, c) -> (c, h, w)
+                h_, w_, c_ = spatial_before_flatten
+                w = w.reshape(h_, w_, c_, -1).transpose(2, 0, 1, 3) \
+                    .reshape(h_ * w_ * c_, -1)
+                spatial_before_flatten = None
+            ip.num_output = w.shape[1]
+            ip.bias_term = "bias" in p
+            b = l.blobs.add()
+            b.shape.dim.extend([w.shape[1], w.shape[0]])
+            b.data.extend(w.T.reshape(-1).tolist())
+            if "bias" in p:
+                bb = l.blobs.add()
+                bb.shape.dim.extend(np.asarray(p["bias"]).shape)
+                bb.data.extend(np.asarray(p["bias"]).tolist())
+        elif isinstance(m, nn.SpatialMaxPooling) or \
+                isinstance(m, nn.SpatialAveragePooling):
+            l.type = "Pooling"
+            pp = l.pooling_param
+            pp.pool = caffe_pb2.PoolingParameter.MAX \
+                if isinstance(m, nn.SpatialMaxPooling) \
+                else caffe_pb2.PoolingParameter.AVE
+            pp.kernel_h, pp.kernel_w = m.kernel
+            pp.stride_h, pp.stride_w = m.stride
+            pp.pad_h, pp.pad_w = max(m.pad[0], 0), max(m.pad[1], 0)
+            pp.round_mode = caffe_pb2.PoolingParameter.CEIL if m.ceil_mode \
+                else caffe_pb2.PoolingParameter.FLOOR
+        elif isinstance(m, nn.ReLU):
+            l.type = "ReLU"
+        elif isinstance(m, nn.Tanh):
+            l.type = "TanH"
+        elif isinstance(m, nn.Sigmoid):
+            l.type = "Sigmoid"
+        elif isinstance(m, (nn.SoftMax, nn.LogSoftMax)):
+            l.type = "Softmax"
+        elif isinstance(m, nn.Dropout):
+            l.type = "Dropout"
+            l.dropout_param.dropout_ratio = m.p
+        elif isinstance(m, nn.Flatten):
+            l.type = "Flatten"
+        elif isinstance(m, nn.SpatialBatchNormalization):
+            l.type = "BatchNorm"
+            l.batch_norm_param.eps = m.eps
+            for kk in ("running_mean", "running_var"):
+                b = l.blobs.add()
+                arr = np.asarray(s[kk])
+                b.shape.dim.extend(arr.shape)
+                b.data.extend(arr.tolist())
+            b = l.blobs.add()
+            b.shape.dim.extend([1])
+            b.data.append(1.0)  # scale factor
+        else:
+            raise ValueError(f"save_caffe: unsupported layer {type(m).__name__}")
+        # track the activation shape for the dense transition
+        if cur_shape is not None:
+            if isinstance(m, nn.Flatten) and len(cur_shape) == 4:
+                spatial_before_flatten = tuple(cur_shape[1:])
+                cur_shape = (cur_shape[0],
+                             int(np.prod(cur_shape[1:])))
+            else:
+                try:
+                    cur_shape = tuple(m.output_shape(cur_shape))
+                except Exception:
+                    pass  # shape-preserving layer
+    with open(prototxt_path, "w") as f:
+        # weights live in the .caffemodel; prototxt is the def only
+        def_net = caffe_pb2.NetParameter()
+        def_net.CopyFrom(net)
+        for l in def_net.layer:
+            del l.blobs[:]
+        f.write(text_format.MessageToString(def_net))
+    if caffemodel_path is not None:
+        with open(caffemodel_path, "wb") as f:
+            f.write(net.SerializeToString())

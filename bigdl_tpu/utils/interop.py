@@ -327,16 +327,24 @@ def import_keras_weights(module: Module, params: Any, state: Any,
 
 
 def convert_model(args: Optional[Sequence[str]] = None) -> None:
-    """Convert between the native model dir format and torch .pt files."""
+    """Convert between the native model dir format, torch .pt state dicts,
+    Caffe prototxt/caffemodel, and TF frozen GraphDefs.
+    reference: utils/ConvertModel.scala (bigdl <-> caffe/torch/tf)."""
     import jax
 
     from bigdl_tpu.utils import serializer as ser
 
     p = argparse.ArgumentParser("ConvertModel")
-    p.add_argument("--from", dest="src", required=True)
-    p.add_argument("--to", dest="dst", required=True)
+    p.add_argument("--from", dest="src", required=True,
+                   help="native model dir, or <def.prototxt>:<w.caffemodel>, "
+                        "or frozen .pb")
+    p.add_argument("--to", dest="dst", required=True,
+                   help="native model dir, .pt, .prototxt (writes sibling "
+                        ".caffemodel), or .pb")
     p.add_argument("--input-shape", dest="shape", required=True,
-                   help="comma-separated build shape, e.g. 8,28,28,1")
+                   help="comma-separated NHWC build shape, e.g. 8,28,28,1")
+    p.add_argument("--tf-inputs", default="input")
+    p.add_argument("--tf-outputs", default="output")
     ns = p.parse_args(args)
     shape = tuple(int(s) for s in ns.shape.split(","))
 
@@ -345,14 +353,38 @@ def convert_model(args: Optional[Sequence[str]] = None) -> None:
     if ns.src.endswith(".pt"):
         raise SystemExit("importing a bare .pt needs the model spec; save the "
                          "model with save_model and use --from <dir>")
-    module, params, state = ser.load_model(ns.src)
-    if params is None:
-        params, state, _ = module.build(jax.random.PRNGKey(0), shape)
+    if ".prototxt" in ns.src:
+        from bigdl_tpu.utils.caffe import load_caffe
+
+        parts = ns.src.split(":")
+        module, params, state = load_caffe(
+            parts[0], parts[1] if len(parts) > 1 else None, input_shape=shape)
+    elif ns.src.endswith(".pb"):
+        from bigdl_tpu.utils.tensorflow import load_tensorflow
+
+        module, params, state = load_tensorflow(
+            ns.src, ns.tf_inputs.split(","), ns.tf_outputs.split(","), [shape])
+    else:
+        module, params, state = ser.load_model(ns.src)
+        if params is None:
+            params, state, _ = module.build(jax.random.PRNGKey(0), shape)
     if ns.dst.endswith(".pt"):
         sd = export_torch_state_dict(module, params, state)
         torch.save({k: torch.from_numpy(np.ascontiguousarray(v))
                     for k, v in sd.items()}, ns.dst)
         print(f"wrote torch state dict ({len(sd)} tensors) to {ns.dst}")
+    elif ns.dst.endswith(".prototxt"):
+        from bigdl_tpu.utils.caffe import save_caffe
+
+        save_caffe(module, params, state, ns.dst,
+                   ns.dst.replace(".prototxt", ".caffemodel"),
+                   input_shape=shape)
+        print(f"wrote caffe def+weights to {ns.dst}")
+    elif ns.dst.endswith(".pb"):
+        from bigdl_tpu.utils.tensorflow import save_tensorflow
+
+        save_tensorflow(module, params, state, ns.dst, shape)
+        print(f"wrote frozen GraphDef to {ns.dst}")
     else:
         ser.save_model(ns.dst, module, params, state)
         print(f"wrote native model to {ns.dst}")

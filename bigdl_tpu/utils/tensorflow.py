@@ -1,0 +1,462 @@
+"""TensorFlow frozen-GraphDef import/export.
+
+Reference: utils/tf/TensorflowLoader.scala:43-179 (GraphDef -> bigdl Graph
+via per-op loaders, 161 of them under utils/tf/loaders/) and
+utils/tf/TensorflowSaver.scala / BigDLToTensorflow.scala for export.  The
+schema is a freshly-written minimal tf_graph.proto whose field numbers
+match the public tensorflow framework protos, so real frozen graphs parse.
+
+TF is already NHWC/HWIO — no layout conversion (the reference spends much
+of its loader translating NHWC to its NCHW layers; this framework IS
+NHWC).  Supported ops: Const, Placeholder, Identity, Conv2D,
+DepthwiseConv2dNative, BiasAdd, MatMul, Relu, Relu6, Tanh, Sigmoid, Elu,
+Softplus, Softmax, MaxPool, AvgPool, FusedBatchNorm(V3), Reshape, Squeeze,
+Add/AddV2/Sub/Mul/Maximum, ConcatV2, Pad, Mean (global average pool).
+
+`load_tensorflow(pb_path, inputs, outputs)` -> (Graph, params, state);
+`save_tensorflow(model, params, state, path, input_shape)` exports a
+Sequential chain as a frozen inference GraphDef.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_PROTO_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                          "proto")
+if _PROTO_DIR not in sys.path:
+    sys.path.insert(0, _PROTO_DIR)
+
+import tf_graph_pb2 as tfp  # noqa: E402  (generated; proto/tf_graph.proto)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import bigdl_tpu.nn as nn  # noqa: E402
+from bigdl_tpu.core.table import Table  # noqa: E402
+
+_NP_DTYPES = {
+    tfp.DT_FLOAT: np.float32,
+    tfp.DT_DOUBLE: np.float64,
+    tfp.DT_INT32: np.int32,
+    tfp.DT_INT64: np.int64,
+    tfp.DT_BOOL: np.bool_,
+    tfp.DT_UINT8: np.uint8,
+    tfp.DT_INT8: np.int8,
+    tfp.DT_INT16: np.int16,
+}
+
+
+def tensor_to_ndarray(t) -> np.ndarray:
+    dtype = _NP_DTYPES[t.dtype]
+    shape = tuple(d.size for d in t.tensor_shape.dim)
+    if t.tensor_content:
+        return np.frombuffer(t.tensor_content, dtype).reshape(shape).copy()
+    for field in ("float_val", "double_val", "int_val", "int64_val", "bool_val"):
+        vals = getattr(t, field)
+        if len(vals):
+            arr = np.asarray(list(vals), dtype)
+            if int(np.prod(shape)) != arr.size and arr.size == 1:
+                arr = np.full(shape, arr[0], dtype)
+            return arr.reshape(shape)
+    return np.zeros(shape, dtype)
+
+
+def ndarray_to_tensor(arr: np.ndarray, t) -> None:
+    arr = np.asarray(arr)
+    dt = {np.dtype(np.float32): tfp.DT_FLOAT, np.dtype(np.float64): tfp.DT_DOUBLE,
+          np.dtype(np.int32): tfp.DT_INT32, np.dtype(np.int64): tfp.DT_INT64,
+          np.dtype(np.bool_): tfp.DT_BOOL}[arr.dtype]
+    t.dtype = dt
+    for s in arr.shape:
+        t.tensor_shape.dim.add().size = s
+    t.tensor_content = arr.tobytes()
+
+
+def _clean(name: str) -> str:
+    name = name.split(":")[0]
+    return name[1:] if name.startswith("^") else name
+
+
+class _TFImporter:
+    def __init__(self, graph_def, input_names: Sequence[str],
+                 input_shapes: Sequence[Sequence[int]]):
+        self.nodes_by_name = {n.name: n for n in graph_def.node}
+        self.consts: Dict[str, np.ndarray] = {}
+        self.graph_nodes: Dict[str, Any] = {}
+        self.shapes: Dict[str, Any] = {}
+        self.weight_sets: List[Tuple[str, Dict[str, np.ndarray]]] = []
+        self.input_nodes = []
+        for name, sh in zip(input_names, input_shapes):
+            node = nn.Input(name=f"input_{name}")
+            self.graph_nodes[name] = node
+            self.shapes[name] = tuple(sh)
+            self.input_nodes.append(node)
+
+    def const_of(self, name: str) -> np.ndarray:
+        name = _clean(name)
+        if name in self.consts:
+            return self.consts[name]
+        nd = self.nodes_by_name[name]
+        if nd.op == "Const":
+            arr = tensor_to_ndarray(nd.attr["value"].tensor)
+            self.consts[name] = arr
+            return arr
+        if nd.op == "Identity":  # frozen variables are Identity(Const)
+            return self.const_of(nd.input[0])
+        raise ValueError(f"expected Const, got {nd.op} for {name}")
+
+    def _attach(self, tf_name: str, module, in_names: List[str],
+                weights: Optional[Dict[str, np.ndarray]] = None):
+        srcs = [self.graph_nodes[_clean(i)] for i in in_names]
+        node = module(*srcs)
+        self.graph_nodes[tf_name] = node
+        in_shapes = [self.shapes[_clean(i)] for i in in_names]
+        sh = in_shapes[0] if len(in_shapes) == 1 else Table(*in_shapes)
+        try:
+            _, _, out = module.build(jax.random.PRNGKey(0), sh)
+        except Exception:
+            out = in_shapes[0]
+        self.shapes[tf_name] = out
+        if weights:
+            self.weight_sets.append((module.name, weights))
+
+    def _alias(self, tf_name: str, src: str):
+        src = _clean(src)
+        self.graph_nodes[tf_name] = self.graph_nodes[src]
+        self.shapes[tf_name] = self.shapes[src]
+
+    def convert(self, nd) -> None:
+        op = nd.op
+        name = nd.name
+        if op in ("Const", "Placeholder", "NoOp"):
+            return
+        data_inputs = [i for i in nd.input if not i.startswith("^")]
+        if op == "Identity":
+            if _clean(data_inputs[0]) in self.graph_nodes:
+                self._alias(name, data_inputs[0])
+            # else: frozen-variable Identity(Const), resolved via const_of
+            return
+        if _clean(data_inputs[0]) not in self.graph_nodes:
+            return  # constant-only subgraph (weights), folded on demand
+
+        bshape = self.shapes[_clean(data_inputs[0])]
+        if op == "Conv2D" or op == "DepthwiseConv2dNative":
+            w = self.const_of(data_inputs[1])  # HWIO (HWIM for depthwise)
+            kh, kw = w.shape[0], w.shape[1]
+            strides = list(nd.attr["strides"].list.i) or [1, 1, 1, 1]
+            dilations = list(nd.attr["dilations"].list.i) or [1, 1, 1, 1]
+            pad = nd.attr["padding"].s.decode() if nd.attr["padding"].s else "VALID"
+            p = -1 if pad == "SAME" else 0
+            cin = bshape[-1]
+            if op == "Conv2D" and (dilations[1] > 1 or dilations[2] > 1):
+                m = nn.SpatialDilatedConvolution(
+                    cin, w.shape[3], kw, kh, strides[2], strides[1], p, p,
+                    dilations[2], dilations[1], name=name)
+                self._attach(name, m, [data_inputs[0]], {"weight": w})
+                return
+            if op == "DepthwiseConv2dNative":
+                mult = w.shape[3]
+                m = nn.SpatialConvolution(cin, cin * mult, kw, kh,
+                                          strides[2], strides[1], p, p,
+                                          n_group=cin, with_bias=False,
+                                          name=name)
+                # TF depthwise HWIM -> grouped HWIO: (kh,kw,cin,mult) ->
+                # (kh,kw,1,cin*mult) with output channels ordered i*mult+m
+                wg = w.reshape(kh, kw, 1, cin * mult)
+                weights = {"weight": wg}
+            else:
+                m = nn.SpatialConvolution(cin, w.shape[3], kw, kh,
+                                          strides[2], strides[1], p, p,
+                                          with_bias=False, name=name)
+                weights = {"weight": w}
+            self._attach(name, m, [data_inputs[0]], weights)
+        elif op == "MatMul":
+            w = self.const_of(data_inputs[1])
+            if nd.attr["transpose_b"].b:
+                w = w.T
+            m = nn.Linear(w.shape[0], w.shape[1], with_bias=False, name=name)
+            self._attach(name, m, [data_inputs[0]], {"weight": w})
+        elif op == "BiasAdd":
+            b = self.const_of(data_inputs[1])
+            m = nn.CAdd(b.shape, name=name)
+            self._attach(name, m, [data_inputs[0]], {"bias": b})
+        elif op in ("Relu", "Relu6", "Tanh", "Sigmoid", "Elu", "Softplus",
+                    "Softmax"):
+            cls = {"Relu": nn.ReLU, "Relu6": nn.ReLU6, "Tanh": nn.Tanh,
+                   "Sigmoid": nn.Sigmoid, "Elu": nn.ELU,
+                   "Softplus": nn.SoftPlus, "Softmax": nn.SoftMax}[op]
+            self._attach(name, cls(name=name), [data_inputs[0]])
+        elif op in ("MaxPool", "AvgPool"):
+            ks = list(nd.attr["ksize"].list.i)
+            st = list(nd.attr["strides"].list.i)
+            pad = nd.attr["padding"].s.decode() if nd.attr["padding"].s else "VALID"
+            p = -1 if pad == "SAME" else 0
+            cls = nn.SpatialMaxPooling if op == "MaxPool" else nn.SpatialAveragePooling
+            kw_ = dict(name=name)
+            if cls is nn.SpatialAveragePooling and pad == "SAME":
+                kw_["count_include_pad"] = False
+            m = cls(ks[2], ks[1], st[2], st[1], p, p, **kw_)
+            self._attach(name, m, [data_inputs[0]])
+        elif op in ("FusedBatchNorm", "FusedBatchNormV3"):
+            gamma = self.const_of(data_inputs[1])
+            beta = self.const_of(data_inputs[2])
+            mean = self.const_of(data_inputs[3])
+            var = self.const_of(data_inputs[4])
+            eps = nd.attr["epsilon"].f or 1e-3
+            m = nn.SpatialBatchNormalization(gamma.shape[0], eps=eps, name=name)
+            self._attach(name, m, [data_inputs[0]],
+                         {"weight": gamma, "bias": beta,
+                          "running_mean": mean, "running_var": var})
+        elif op == "Reshape":
+            target = self.const_of(data_inputs[1]).tolist()
+            m = nn.Reshape([int(t) for t in target[1:]], batch_mode=True,
+                           name=name) if target and target[0] in (-1, bshape[0]) \
+                else nn.Reshape([int(t) for t in target], batch_mode=False,
+                                name=name)
+            self._attach(name, m, [data_inputs[0]])
+        elif op == "Squeeze":
+            dims = list(nd.attr["squeeze_dims"].list.i)
+            m = nn.Squeeze(dims[0] if dims else None, name=name)
+            self._attach(name, m, [data_inputs[0]])
+        elif op in ("Add", "AddV2", "Sub", "Mul", "Maximum"):
+            # tensor-tensor when both inputs are graph nodes; else constant op
+            other = _clean(data_inputs[1])
+            if other in self.graph_nodes:
+                cls = {"Add": nn.CAddTable, "AddV2": nn.CAddTable,
+                       "Sub": nn.CSubTable, "Mul": nn.CMulTable,
+                       "Maximum": nn.CMaxTable}[op]
+                self._attach(name, cls(name=name), data_inputs[:2])
+            else:
+                c = self.const_of(data_inputs[1])
+                if op in ("Add", "AddV2"):
+                    m = nn.AddConstant(float(c), name=name) if c.size == 1 \
+                        else nn.CAdd(c.shape, name=name)
+                    w = None if c.size == 1 else {"bias": c}
+                elif op == "Mul":
+                    m = nn.MulConstant(float(c), name=name) if c.size == 1 \
+                        else nn.CMul(c.shape, name=name)
+                    w = None if c.size == 1 else {"weight": c}
+                elif op == "Sub":
+                    if c.size == 1:
+                        m = nn.AddConstant(-float(c), name=name)
+                        w = None
+                    else:
+                        m = nn.CAdd(c.shape, name=name)
+                        w = {"bias": -c}
+                else:
+                    raise ValueError(f"constant {op} unsupported")
+                self._attach(name, m, [data_inputs[0]], w)
+        elif op == "ConcatV2":
+            axis = int(self.const_of(data_inputs[-1]))
+            m = nn.JoinTable(axis, name=name)
+            self._attach(name, m, data_inputs[:-1])
+        elif op == "Pad":
+            pads = self.const_of(data_inputs[1])  # (rank, 2)
+            m = nn.ops.Pad(pads.tolist(), name=name)
+            self._attach(name, m, [data_inputs[0]])
+        elif op == "Mean":
+            dims = self.const_of(data_inputs[1]).reshape(-1).tolist()
+            if sorted(int(d) for d in dims) == [1, 2] and len(bshape) == 4:
+                self._attach(name, nn.GlobalAveragePooling2D(name=name),
+                             [data_inputs[0]])
+            elif len(dims) == 1:
+                m = nn.Mean(int(dims[0]),
+                            squeeze=not bool(nd.attr["keep_dims"].b), name=name)
+                self._attach(name, m, [data_inputs[0]])
+            else:
+                raise ValueError(f"Mean over dims {dims} unsupported")
+        else:
+            raise ValueError(
+                f"unsupported TF op {op!r} at node {name!r} "
+                f"(reference: utils/tf/loaders/)")
+
+
+def load_tensorflow(pb_path: str, inputs: Sequence[str],
+                    outputs: Sequence[str],
+                    input_shapes: Sequence[Sequence[int]],
+                    seed: int = 0) -> Tuple[nn.Graph, Any, Any]:
+    """Parse a frozen GraphDef into (Graph, params, state).
+    reference: TensorflowLoader.load (utils/tf/TensorflowLoader.scala:55)."""
+    gd = tfp.GraphDef()
+    with open(pb_path, "rb") as f:
+        gd.ParseFromString(f.read())
+    imp = _TFImporter(gd, inputs, input_shapes)
+    # GraphDef does not guarantee topological order: iterate to fixpoint,
+    # deferring nodes whose data inputs aren't converted yet
+    pending = list(gd.node)
+    while pending:
+        deferred = []
+        for node in pending:
+            data_in = [_clean(i) for i in node.input if not i.startswith("^")]
+            needs_graph_input = node.op not in ("Const", "Placeholder", "NoOp")
+            if needs_graph_input and data_in and \
+                    data_in[0] not in imp.graph_nodes and \
+                    data_in[0] in imp.nodes_by_name and \
+                    imp.nodes_by_name[data_in[0]].op not in ("Const", "Identity",
+                                                             "Placeholder"):
+                deferred.append(node)
+                continue
+            imp.convert(node)
+        if len(deferred) == len(pending):
+            break  # remaining nodes are constant-only subgraphs
+        pending = deferred
+    outs = [imp.graph_nodes[_clean(o)] for o in outputs]
+    model = nn.Graph(imp.input_nodes, outs, name="tf_graph")
+    build_shapes = [imp.shapes[i] for i in inputs]
+    params, state, _ = model.build(
+        jax.random.PRNGKey(seed),
+        build_shapes[0] if len(build_shapes) == 1 else Table(*build_shapes))
+    for lname, w in imp.weight_sets:
+        for k, v in w.items():
+            arr = np.asarray(v, np.float32)
+            if lname in params and k in params[lname]:
+                assert tuple(params[lname][k].shape) == arr.shape, \
+                    f"{lname}.{k}: {params[lname][k].shape} vs {arr.shape}"
+                params[lname][k] = jnp.asarray(arr)
+            elif lname in state and k in state[lname]:
+                state[lname][k] = jnp.asarray(arr)
+            else:
+                raise KeyError(f"no slot {k} in node {lname}")
+    return model, params, state
+
+
+# ---------------------------------------------------------------------------
+# export
+
+
+def save_tensorflow(model: nn.Module, params: Any, state: Any, path: str,
+                    input_shape: Sequence[int],
+                    input_name: str = "input") -> None:
+    """Export a Sequential chain as a frozen inference GraphDef.
+    reference: utils/tf/TensorflowSaver.scala + BigDLToTensorflow.scala."""
+    gd = tfp.GraphDef()
+    gd.versions.producer = 27
+
+    def add_const(cname: str, arr: np.ndarray) -> str:
+        nd = gd.node.add()
+        nd.name = cname
+        nd.op = "Const"
+        nd.attr["dtype"].type = tfp.DT_FLOAT
+        ndarray_to_tensor(np.asarray(arr, np.float32), nd.attr["value"].tensor)
+        return cname
+
+    ph = gd.node.add()
+    ph.name = input_name
+    ph.op = "Placeholder"
+    ph.attr["dtype"].type = tfp.DT_FLOAT
+    for s in input_shape:
+        ph.attr["shape"].shape.dim.add().size = s
+    prev = input_name
+    if not hasattr(model, "children"):
+        raise ValueError("save_tensorflow exports Sequential models")
+    cur_shape = tuple(input_shape)
+    for key, m in model.children.items():
+        p = params.get(key, {})
+        s = state.get(key, {})
+        if isinstance(m, nn.SpatialConvolution):
+            if m.n_group != 1:
+                raise ValueError("TF export does not support grouped "
+                                 "convolutions (Conv2D has no group attr)")
+            wname = add_const(f"{m.name}/weight", np.asarray(p["weight"]))
+            nd = gd.node.add()
+            nd.name = m.name
+            nd.op = "Conv2D"
+            nd.input.extend([prev, wname])
+            nd.attr["strides"].list.i.extend([1, m.stride[0], m.stride[1], 1])
+            if m.dilation != (1, 1):  # SpatialDilatedConvolution subclass
+                nd.attr["dilations"].list.i.extend(
+                    [1, m.dilation[0], m.dilation[1], 1])
+            nd.attr["padding"].s = b"SAME" if m.pad[0] == -1 else b"VALID"
+            if m.pad[0] not in (-1, 0):
+                raise ValueError("TF export supports pad 0 or SAME only")
+            prev = m.name
+            if m.with_bias:
+                bname = add_const(f"{m.name}/bias", np.asarray(p["bias"]))
+                nb = gd.node.add()
+                nb.name = f"{m.name}/BiasAdd"
+                nb.op = "BiasAdd"
+                nb.input.extend([prev, bname])
+                prev = nb.name
+        elif isinstance(m, nn.Linear):
+            w = np.asarray(p["weight"])
+            wname = add_const(f"{m.name}/weight", w)
+            nd = gd.node.add()
+            nd.name = m.name
+            nd.op = "MatMul"
+            nd.input.extend([prev, wname])
+            prev = m.name
+            if "bias" in p:
+                bname = add_const(f"{m.name}/bias", np.asarray(p["bias"]))
+                nb = gd.node.add()
+                nb.name = f"{m.name}/BiasAdd"
+                nb.op = "BiasAdd"
+                nb.input.extend([prev, bname])
+                prev = nb.name
+        elif isinstance(m, (nn.SpatialMaxPooling, nn.SpatialAveragePooling)):
+            nd = gd.node.add()
+            nd.name = m.name
+            nd.op = "MaxPool" if isinstance(m, nn.SpatialMaxPooling) else "AvgPool"
+            nd.input.append(prev)
+            nd.attr["ksize"].list.i.extend([1, m.kernel[0], m.kernel[1], 1])
+            nd.attr["strides"].list.i.extend([1, m.stride[0], m.stride[1], 1])
+            nd.attr["padding"].s = b"SAME" if m.pad[0] == -1 else b"VALID"
+            prev = m.name
+        elif isinstance(m, (nn.ReLU, nn.ReLU6, nn.Tanh, nn.Sigmoid, nn.ELU,
+                            nn.SoftPlus, nn.SoftMax)):
+            nd = gd.node.add()
+            nd.name = m.name
+            nd.op = {nn.ReLU: "Relu", nn.ReLU6: "Relu6", nn.Tanh: "Tanh",
+                     nn.Sigmoid: "Sigmoid", nn.ELU: "Elu",
+                     nn.SoftPlus: "Softplus", nn.SoftMax: "Softmax"}[type(m)]
+            nd.input.append(prev)
+            prev = m.name
+        elif isinstance(m, nn.SpatialBatchNormalization):
+            nd = gd.node.add()
+            nd.name = m.name
+            nd.op = "FusedBatchNorm"
+            g_ = add_const(f"{m.name}/gamma", np.asarray(p["weight"]))
+            b_ = add_const(f"{m.name}/beta", np.asarray(p["bias"]))
+            mu = add_const(f"{m.name}/mean", np.asarray(s["running_mean"]))
+            var = add_const(f"{m.name}/var", np.asarray(s["running_var"]))
+            nd.input.extend([prev, g_, b_, mu, var])
+            nd.attr["epsilon"].f = m.eps
+            prev = m.name
+        elif isinstance(m, nn.Flatten):
+            flat = int(np.prod(cur_shape[1:])) if cur_shape is not None else -1
+            shape_name = add_const_int(gd, f"{m.name}/shape",
+                                       np.asarray([-1, flat], np.int32))
+            nd = gd.node.add()
+            nd.name = m.name
+            nd.op = "Reshape"
+            nd.input.extend([prev, shape_name])
+            prev = m.name
+        elif isinstance(m, nn.Dropout):
+            continue  # inference graph: dropout is identity
+        else:
+            raise ValueError(f"save_tensorflow: unsupported layer "
+                             f"{type(m).__name__}")
+        if cur_shape is not None:
+            try:
+                cur_shape = tuple(m.output_shape(cur_shape))
+            except Exception:
+                if isinstance(m, nn.Flatten):
+                    cur_shape = (cur_shape[0], int(np.prod(cur_shape[1:])))
+    with open(path, "wb") as f:
+        f.write(gd.SerializeToString())
+
+
+def add_const_int(gd, cname: str, arr: np.ndarray) -> str:
+    nd = gd.node.add()
+    nd.name = cname
+    nd.op = "Const"
+    nd.attr["dtype"].type = tfp.DT_INT32
+    t = nd.attr["value"].tensor
+    t.dtype = tfp.DT_INT32
+    for s in arr.shape:
+        t.tensor_shape.dim.add().size = s
+    t.tensor_content = np.asarray(arr, np.int32).tobytes()
+    return cname

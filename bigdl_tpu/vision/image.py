@@ -20,13 +20,20 @@ from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequ
 import numpy as np
 
 
+_RS_LOCK_GUARD = threading.Lock()
+
+
 def _locked_sample(transformer, fn):
     """Draw from a transformer's RandomState under a per-instance lock —
     np.random.RandomState is not thread-safe, and MTImageFeatureToBatch runs
-    transforms on a thread pool."""
+    transforms on a thread pool.  Lazy lock creation is itself guarded so
+    two first-callers cannot each mint their own lock."""
     lock = getattr(transformer, "_rs_lock", None)
     if lock is None:
-        lock = transformer._rs_lock = threading.Lock()
+        with _RS_LOCK_GUARD:
+            lock = getattr(transformer, "_rs_lock", None)
+            if lock is None:
+                lock = transformer._rs_lock = threading.Lock()
     with lock:
         return fn()
 

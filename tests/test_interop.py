@@ -231,3 +231,200 @@ class TestTorchFile:
         p2 = jax.tree_util.tree_map(jnp.asarray, load_t7(path))
         y1, _ = m.apply(p2, s, x)
         np.testing.assert_allclose(np.asarray(y0), np.asarray(y1))
+
+
+class TestCaffe:
+    """Caffe import/export (reference: utils/caffe/CaffeLoader.scala,
+    CaffePersister.scala)."""
+
+    def _lenet(self):
+        return nn.Sequential(
+            nn.SpatialConvolution(1, 6, 5, 5), nn.ReLU(),
+            nn.SpatialMaxPooling(2, 2),
+            nn.SpatialConvolution(6, 12, 5, 5), nn.ReLU(),
+            nn.SpatialMaxPooling(2, 2),
+            nn.Flatten(),
+            nn.Linear(12 * 4 * 4, 10), nn.SoftMax())
+
+    def test_roundtrip_exact(self, tmp_path):
+        from bigdl_tpu.utils.caffe import load_caffe, save_caffe
+
+        m = self._lenet()
+        p, s, _ = m.build(jax.random.PRNGKey(0), (2, 28, 28, 1))
+        x = jnp.asarray(np.random.RandomState(0).rand(2, 28, 28, 1), jnp.float32)
+        y_ref, _ = m.apply(p, s, x)
+        proto = str(tmp_path / "net.prototxt")
+        cmodel = str(tmp_path / "net.caffemodel")
+        save_caffe(m, p, s, proto, cmodel, input_shape=(2, 28, 28, 1))
+        g, gp, gs = load_caffe(proto, cmodel)
+        y2, _ = g.apply(gp, gs, x)
+        np.testing.assert_allclose(np.asarray(y2), np.asarray(y_ref), atol=1e-6)
+
+    def test_branching_prototxt(self, tmp_path):
+        from bigdl_tpu.utils.caffe import load_caffe
+
+        prototxt = """
+name: "branchy"
+input: "data"
+input_shape { dim: 1 dim: 3 dim: 16 dim: 16 }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 1 } }
+layer { name: "conv2" type: "Convolution" bottom: "data" top: "conv2"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }
+layer { name: "cc" type: "Concat" bottom: "conv1" bottom: "conv2" top: "cc" }
+layer { name: "elt" type: "Eltwise" bottom: "cc" bottom: "cc" top: "elt" }
+"""
+        p = tmp_path / "branchy.prototxt"
+        p.write_text(prototxt)
+        g, gp, gs = load_caffe(str(p))
+        x = jnp.asarray(np.random.RandomState(1).rand(1, 16, 16, 3), jnp.float32)
+        y, _ = g.apply(gp, gs, x)
+        assert y.shape == (1, 16, 16, 8)
+
+    def test_batchnorm_scale_fusion(self, tmp_path):
+        from bigdl_tpu.utils.caffe import load_caffe
+
+        import caffe_pb2
+        from google.protobuf import text_format
+
+        prototxt = """
+input: "data"
+input_shape { dim: 1 dim: 2 dim: 4 dim: 4 }
+layer { name: "bn" type: "BatchNorm" bottom: "data" top: "bn" }
+layer { name: "sc" type: "Scale" bottom: "bn" top: "sc"
+  scale_param { bias_term: true } }
+"""
+        proto = tmp_path / "bn.prototxt"
+        proto.write_text(prototxt)
+        # weights: mean, var, scale-factor; then gamma/beta from Scale
+        wnet = caffe_pb2.NetParameter()
+        text_format.Parse(prototxt, wnet)
+        bn = wnet.layer[0]
+        for arr in ([1.0, 2.0], [4.0, 9.0], [1.0]):
+            b = bn.blobs.add()
+            b.shape.dim.extend([len(arr)])
+            b.data.extend(arr)
+        sc = wnet.layer[1]
+        for arr in ([2.0, 3.0], [0.5, -0.5]):
+            b = sc.blobs.add()
+            b.shape.dim.extend([len(arr)])
+            b.data.extend(arr)
+        cmodel = tmp_path / "bn.caffemodel"
+        cmodel.write_bytes(wnet.SerializeToString())
+        g, gp, gs = load_caffe(str(proto), str(cmodel))
+        x = jnp.asarray(np.random.RandomState(0).rand(1, 4, 4, 2), jnp.float32)
+        y, _ = g.apply(gp, gs, x, training=False)
+        want = (np.asarray(x) - [1.0, 2.0]) / np.sqrt(np.asarray([4.0, 9.0]) + 1e-5)
+        want = want * [2.0, 3.0] + [0.5, -0.5]
+        np.testing.assert_allclose(np.asarray(y), want, atol=1e-4)
+
+
+class TestTensorflowGraphDef:
+    """TF frozen-GraphDef import/export (reference:
+    utils/tf/TensorflowLoader.scala, TensorflowSaver.scala)."""
+
+    def _convnet(self):
+        return nn.Sequential(
+            nn.SpatialConvolution(3, 8, 3, 3, 1, 1, -1, -1), nn.ReLU(),
+            nn.SpatialMaxPooling(2, 2),
+            nn.SpatialBatchNormalization(8),
+            nn.Flatten(),
+            nn.Linear(8 * 8 * 8, 10), nn.SoftMax())
+
+    def test_roundtrip_exact(self, tmp_path):
+        from bigdl_tpu.utils.tensorflow import load_tensorflow, save_tensorflow
+
+        m = self._convnet()
+        p, s, _ = m.build(jax.random.PRNGKey(0), (2, 16, 16, 3))
+        x = jnp.asarray(np.random.RandomState(0).rand(2, 16, 16, 3), jnp.float32)
+        y_ref, _ = m.apply(p, s, x, training=False)
+        pb = str(tmp_path / "model.pb")
+        save_tensorflow(m, p, s, pb, (2, 16, 16, 3))
+        out_name = list(m.children.values())[-1].name
+        g, gp, gs = load_tensorflow(pb, ["input"], [out_name], [(2, 16, 16, 3)])
+        y2, _ = g.apply(gp, gs, x, training=False)
+        np.testing.assert_allclose(np.asarray(y2), np.asarray(y_ref), atol=1e-6)
+
+    def test_handwritten_branching_graph(self, tmp_path):
+        """A GraphDef with ConcatV2 + constant-add, built node-by-node the
+        way a frozen TF export looks (Identity-wrapped consts)."""
+        import sys
+
+        import tf_graph_pb2 as tfp
+
+        from bigdl_tpu.utils.tensorflow import load_tensorflow, ndarray_to_tensor
+
+        gd = tfp.GraphDef()
+        ph = gd.node.add(); ph.name = "input"; ph.op = "Placeholder"
+        w = gd.node.add(); w.name = "w"; w.op = "Const"
+        rs = np.random.RandomState(0)
+        ndarray_to_tensor(rs.rand(1, 1, 3, 4).astype("float32"),
+                          w.attr["value"].tensor)
+        wid = gd.node.add(); wid.name = "w_id"; wid.op = "Identity"
+        wid.input.append("w")
+        conv = gd.node.add(); conv.name = "conv"; conv.op = "Conv2D"
+        conv.input.extend(["input", "w_id"])
+        conv.attr["strides"].list.i.extend([1, 1, 1, 1])
+        conv.attr["padding"].s = b"SAME"
+        relu = gd.node.add(); relu.name = "relu"; relu.op = "Relu"
+        relu.input.append("conv")
+        axis = gd.node.add(); axis.name = "axis"; axis.op = "Const"
+        t = axis.attr["value"].tensor
+        t.dtype = tfp.DT_INT32
+        t.int_val.append(3)
+        cc = gd.node.add(); cc.name = "cc"; cc.op = "ConcatV2"
+        cc.input.extend(["conv", "relu", "axis"])
+        pb = str(tmp_path / "branchy.pb")
+        with open(pb, "wb") as f:
+            f.write(gd.SerializeToString())
+        g, gp, gs = load_tensorflow(pb, ["input"], ["cc"], [(1, 5, 5, 3)])
+        x = jnp.asarray(rs.rand(1, 5, 5, 3), jnp.float32)
+        y, _ = g.apply(gp, gs, x)
+        assert y.shape == (1, 5, 5, 8)
+        # second half is relu of first half
+        y = np.asarray(y)
+        np.testing.assert_allclose(y[..., 4:], np.maximum(y[..., :4], 0),
+                                   atol=1e-6)
+
+    def test_convert_model_cli_tf(self, tmp_path):
+        from bigdl_tpu.utils import serializer as ser
+        from bigdl_tpu.utils.interop import convert_model
+
+        m = nn.Sequential(nn.Linear(4, 3), nn.ReLU(), nn.Linear(3, 2))
+        p, s, _ = m.build(jax.random.PRNGKey(0), (1, 4))
+        src = str(tmp_path / "native")
+        ser.save_model(src, m, p, s)
+        dst = str(tmp_path / "model.pb")
+        convert_model(["--from", src, "--to", dst, "--input-shape", "1,4"])
+        from bigdl_tpu.utils.tensorflow import load_tensorflow
+
+        out_name = list(m.children.values())[-1].name + "/BiasAdd"
+        g, gp, gs = load_tensorflow(dst, ["input"], [out_name], [(1, 4)])
+        x = jnp.asarray(np.random.RandomState(0).rand(1, 4), jnp.float32)
+        y_ref, _ = m.apply(p, s, x)
+        y2, _ = g.apply(gp, gs, x)
+        np.testing.assert_allclose(np.asarray(y2), np.asarray(y_ref), atol=1e-6)
+
+    def test_out_of_order_graphdef(self, tmp_path):
+        """GraphDef nodes listed consumer-before-producer still load."""
+        import tf_graph_pb2 as tfp
+
+        from bigdl_tpu.utils.tensorflow import load_tensorflow, ndarray_to_tensor
+
+        rs = np.random.RandomState(0)
+        gd = tfp.GraphDef()
+        # relu listed BEFORE its producer matmul
+        relu = gd.node.add(); relu.name = "relu"; relu.op = "Relu"
+        relu.input.append("mm")
+        mm = gd.node.add(); mm.name = "mm"; mm.op = "MatMul"
+        mm.input.extend(["input", "w"])
+        w = gd.node.add(); w.name = "w"; w.op = "Const"
+        ndarray_to_tensor(rs.rand(4, 3).astype("float32"), w.attr["value"].tensor)
+        ph = gd.node.add(); ph.name = "input"; ph.op = "Placeholder"
+        pb = str(tmp_path / "ooo.pb")
+        with open(pb, "wb") as f:
+            f.write(gd.SerializeToString())
+        g, gp, gs = load_tensorflow(pb, ["input"], ["relu"], [(2, 4)])
+        x = jnp.asarray(rs.rand(2, 4), jnp.float32)
+        y, _ = g.apply(gp, gs, x)
+        assert y.shape == (2, 3) and (np.asarray(y) >= 0).all()
