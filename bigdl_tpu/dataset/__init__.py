@@ -1,12 +1,17 @@
-from bigdl_tpu.dataset.sample import Sample
-from bigdl_tpu.dataset.minibatch import MiniBatch
+from bigdl_tpu.dataset.sample import Sample, SparseFeature
+from bigdl_tpu.dataset.minibatch import MiniBatch, SparseMiniBatch
 from bigdl_tpu.dataset.transformer import Transformer, SampleToMiniBatch
 from bigdl_tpu.dataset.dataset import DataSet, LocalDataSet, ArrayDataSet
+from bigdl_tpu.dataset.datamining import (RowTransformer, RowTransformSchema,
+                                          TableToSample)
 from bigdl_tpu.dataset import image
 from bigdl_tpu.dataset import text
 
-__all__ = ["Sample", "MiniBatch", "Transformer", "SampleToMiniBatch",
-           "DataSet", "LocalDataSet", "ArrayDataSet", "image", "text"]
+__all__ = ["Sample", "SparseFeature", "MiniBatch", "SparseMiniBatch",
+           "Transformer", "SampleToMiniBatch",
+           "DataSet", "LocalDataSet", "ArrayDataSet",
+           "RowTransformer", "RowTransformSchema", "TableToSample",
+           "image", "text"]
 from bigdl_tpu.dataset import datasets
 from bigdl_tpu.dataset.datasets import (
     load_mnist,

@@ -1,0 +1,109 @@
+"""Row -> Table transformers for tabular (dataframe-style) records.
+
+Reference: dataset/datamining/RowTransformer.scala — a Transformer[Row,
+Table] holding RowTransformSchemas; each schema selects row columns (by
+field name or index) and assembles them into one tensor, and the output
+Table is keyed by schemaKey.  Here a "Row" is any mapping (dict, pandas
+Series) or plain sequence, and the emitted Table is the framework's Table
+keyed by schema key — ready to feed Sample/MiniBatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.core.table import Table
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+def _row_get(row: Any, key: Any) -> Any:
+    if hasattr(row, "keys"):  # dict / pandas Series
+        return row[key]
+    return row[key]  # sequence indexed by position
+
+
+def _row_len(row: Any) -> int:
+    return len(row)
+
+
+def _row_keys(row: Any) -> List[Any]:
+    if hasattr(row, "keys"):
+        return list(row.keys())
+    return list(range(len(row)))
+
+
+class RowTransformSchema:
+    """One output tensor: which columns it reads and how they combine.
+    reference: RowTransformSchema (datamining/RowTransformer.scala)."""
+
+    def __init__(self, key: str, field_names: Sequence[Any] = (),
+                 indices: Sequence[int] = (),
+                 transform: Optional[Callable[[List[Any]], np.ndarray]] = None):
+        if field_names and indices:
+            raise ValueError("give field_names or indices, not both")
+        self.key = key
+        self.field_names = list(field_names)
+        self.indices = list(indices)
+        self.transform = transform or (lambda values: np.asarray(values, np.float32))
+
+    def select(self, row: Any) -> List[Any]:
+        if self.field_names:
+            return [_row_get(row, f) for f in self.field_names]
+        if self.indices:
+            keys = _row_keys(row)
+            return [_row_get(row, keys[i]) for i in self.indices]
+        return [_row_get(row, k) for k in _row_keys(row)]
+
+
+class RowTransformer(Transformer):
+    """reference: datamining/RowTransformer.scala (Transformer[Row, Table])."""
+
+    def __init__(self, schemas: Sequence[RowTransformSchema],
+                 row_size: Optional[int] = None):
+        seen = set()
+        for s in schemas:
+            if s.key in seen:
+                raise ValueError(f"replicated schemaKey: {s.key}")
+            seen.add(s.key)
+            if s.indices and row_size is not None:
+                if not all(0 <= i < row_size for i in s.indices):
+                    raise ValueError(f"indices out of bound: {s.indices}")
+        self.schemas = list(schemas)
+
+    def __call__(self, it: Iterator[Any]) -> Iterator[Table]:
+        for row in it:
+            out = Table()
+            for schema in self.schemas:
+                out[schema.key] = schema.transform(schema.select(row))
+            yield out
+
+    # -- factory helpers (reference: RowTransformer.atomic / numeric) -----
+
+    @staticmethod
+    def atomic(field_names: Sequence[Any]) -> "RowTransformer":
+        """One scalar tensor per column, keyed by the column name."""
+        return RowTransformer(
+            [RowTransformSchema(str(f), field_names=[f]) for f in field_names])
+
+    @staticmethod
+    def numeric(key: str, field_names: Sequence[Any]) -> "RowTransformer":
+        """All named columns assembled into one numeric vector."""
+        return RowTransformer([RowTransformSchema(key, field_names=field_names)])
+
+
+class TableToSample(Transformer):
+    """Table (from RowTransformer) -> Sample, picking feature/label keys."""
+
+    def __init__(self, feature_keys: Sequence[str], label_key: Optional[str] = None):
+        self.feature_keys = list(feature_keys)
+        self.label_key = label_key
+
+    def __call__(self, it: Iterator[Table]) -> Iterator[Sample]:
+        for t in it:
+            feats = [np.asarray(t[k]) for k in self.feature_keys]
+            feature = feats[0] if len(feats) == 1 else tuple(feats)
+            label = np.asarray(t[self.label_key]) if self.label_key is not None else None
+            yield Sample(feature, label)

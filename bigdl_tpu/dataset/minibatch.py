@@ -11,7 +11,7 @@ from typing import Any, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.sample import Sample, SparseFeature
 
 
 class MiniBatch:
@@ -76,6 +76,50 @@ class MiniBatch:
             return tuple(x.shape)
 
         return f"MiniBatch(input={sh(self.input)}, target={sh(self.target) if self.target is not None else None})"
+
+
+class SparseMiniBatch(MiniBatch):
+    """MiniBatch for samples carrying SparseFeature components.
+
+    Reference: dataset/MiniBatch.scala:579 (SparseMiniBatch over
+    TensorSample) — batches per-record sparse tensors into one
+    (batch, *dense_shape) tensor per component.  The reference keeps the
+    batch sparse (feeding SparseLinear's sparse gemm); here the batch is
+    DENSIFIED at this host-side boundary: static dense shapes are what jit
+    wants, and the MXU beats scatter-based sparse gemm at these widths.
+    Mixed dense/sparse components are fine — dense ones stack as usual.
+    """
+
+    @staticmethod
+    def from_samples(samples: Sequence[Sample],
+                     feature_padding: Optional[float] = None,
+                     label_padding: Optional[float] = None) -> "SparseMiniBatch":
+        def batch_one(values, padding):
+            if isinstance(values[0], SparseFeature):
+                shapes = {v.dense_shape for v in values}
+                if len(shapes) != 1:
+                    raise ValueError(f"inconsistent dense_shapes in batch: {shapes}")
+                return np.stack([v.to_dense() for v in values])
+            arrays = [np.asarray(v) for v in values]
+            return _pad_stack(arrays, padding) if padding is not None else np.stack(arrays)
+
+        def batch_side(first, get, padding):
+            if isinstance(first, (tuple, list)):
+                return tuple(batch_one([get(s)[i] for s in samples], padding)
+                             for i in range(len(first)))
+            return batch_one([get(s) for s in samples], padding)
+
+        feats = batch_side(samples[0].feature, lambda s: s.feature, feature_padding)
+        labels = None
+        if samples[0].label is not None:
+            labels = batch_side(samples[0].label, lambda s: s.label, label_padding)
+        return SparseMiniBatch(feats, labels)
+
+
+def has_sparse_feature(sample: Sample) -> bool:
+    parts = sample.feature if isinstance(sample.feature, (tuple, list)) else [sample.feature]
+    labels = sample.label if isinstance(sample.label, (tuple, list)) else [sample.label]
+    return any(isinstance(p, SparseFeature) for p in list(parts) + list(labels))
 
 
 def _pad_stack(arrays: List[np.ndarray], pad_value: float) -> np.ndarray:

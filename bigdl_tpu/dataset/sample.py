@@ -39,3 +39,40 @@ class Sample:
 
     def __repr__(self):
         return f"Sample(feature={self.feature_size()}, label={self.label_size()})"
+
+
+class SparseFeature:
+    """COO-encoded sparse feature of one record.
+
+    Reference: tensor/SparseTensor.scala (the per-record sparse tensors that
+    TensorSample carries into SparseMiniBatch, dataset/Sample.scala:250).
+    `indices` is (nnz, ndim) int coordinates into `dense_shape`; `values`
+    is (nnz,).  TPU-native note: these exist only on the host side — the
+    batching step (SparseMiniBatch) densifies, because scatter/gather sparse
+    matmul loses to the MXU's dense matmul at the feature widths BigDL's
+    wide-and-deep workloads use (see nn/SparseLinear docstring).
+    """
+
+    __slots__ = ("indices", "values", "dense_shape")
+
+    def __init__(self, indices, values, dense_shape: Sequence[int]):
+        self.indices = np.atleast_2d(np.asarray(indices, np.int64))
+        self.values = np.asarray(values)
+        self.dense_shape = tuple(int(s) for s in dense_shape)
+        if self.indices.size and self.indices.shape[1] != len(self.dense_shape):
+            raise ValueError(
+                f"indices ndim {self.indices.shape[1]} != dense rank {len(self.dense_shape)}")
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self.dense_shape
+
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros(self.dense_shape, self.values.dtype)
+        if self.values.size:
+            out[tuple(self.indices.T)] = self.values
+        return out
+
+    def __repr__(self):
+        return (f"SparseFeature(nnz={self.values.size}, "
+                f"dense_shape={self.dense_shape})")

@@ -14,7 +14,7 @@ from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
-from bigdl_tpu.dataset.minibatch import MiniBatch
+from bigdl_tpu.dataset.minibatch import MiniBatch, SparseMiniBatch, has_sparse_feature
 from bigdl_tpu.dataset.sample import Sample
 
 
@@ -74,7 +74,13 @@ class SampleToMiniBatch(Transformer):
         for s in it:
             buf.append(s)
             if len(buf) == self.batch_size:
-                yield MiniBatch.from_samples(buf, self.feature_padding, self.label_padding)
+                yield self._batch(buf)
                 buf = []
         if buf and not self.drop_remainder:
-            yield MiniBatch.from_samples(buf, self.feature_padding, self.label_padding)
+            yield self._batch(buf)
+
+    def _batch(self, buf: List[Sample]) -> MiniBatch:
+        # samples carrying SparseFeatures batch via SparseMiniBatch, like the
+        # reference routes TensorSamples with sparse tensors (MiniBatch.scala:579)
+        cls = SparseMiniBatch if has_sparse_feature(buf[0]) else MiniBatch
+        return cls.from_samples(buf, self.feature_padding, self.label_padding)

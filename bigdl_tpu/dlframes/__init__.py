@@ -15,5 +15,6 @@ from bigdl_tpu.dlframes.estimator import (
     DLModel,
     DLClassifier,
     DLClassifierModel,
+    DLImageReader,
     DLImageTransformer,
 )

@@ -176,6 +176,45 @@ class DLClassifierModel(DLModel):
         return out
 
 
+class DLImageReader:
+    """Read image files into an image dataframe.
+
+    Reference: dlframes/DLImageReader.scala — `readImages(path)` produces a
+    DataFrame with an `image` struct column (origin, height, width,
+    nChannels, data).  Here the frame is a pandas DataFrame whose `image`
+    column holds float32 HWC arrays (channel order RGB — the TPU pipeline
+    is RGB-native; the reference's BGR is an OpenCV-ism) plus origin/
+    height/width/n_channels columns.
+    """
+
+    EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif")
+
+    @staticmethod
+    def read_images(path: str, recursive: bool = True):
+        import glob
+        import os
+
+        import pandas as pd
+        from PIL import Image
+
+        if os.path.isdir(path):
+            pattern = os.path.join(path, "**" if recursive else "", "*")
+            names = sorted(glob.glob(pattern, recursive=recursive))
+        else:
+            names = sorted(glob.glob(path, recursive=recursive))
+        rows = []
+        for name in names:
+            if not name.lower().endswith(DLImageReader.EXTENSIONS):
+                continue
+            with Image.open(name) as im:
+                arr = np.asarray(im.convert("RGB"), np.float32)
+            rows.append({"origin": name, "height": arr.shape[0],
+                         "width": arr.shape[1], "n_channels": arr.shape[2],
+                         "image": arr})
+        return pd.DataFrame(rows,
+                            columns=["origin", "height", "width", "n_channels", "image"])
+
+
 class DLImageTransformer:
     """Apply a vision FeatureTransformer to an image column.
     reference: dlframes/DLImageTransformer.scala."""

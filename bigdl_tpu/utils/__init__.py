@@ -1,6 +1,7 @@
 from bigdl_tpu.utils.checkpoint import save_checkpoint, load_checkpoint, latest_checkpoint
 from bigdl_tpu.utils.summary import TrainSummary, ValidationSummary
 from bigdl_tpu.utils.torchfile import load_t7, save_t7, TorchObject
+from bigdl_tpu.utils.logger_filter import redirect_verbose_logs, undo_redirect
 from bigdl_tpu.utils.serializer import (
     save_model,
     load_model,
@@ -13,15 +14,31 @@ from bigdl_tpu.utils.serializer import (
     register_fn,
 )
 
+# Caffe/TF codecs (and Session on top of them) need google.protobuf; resolve
+# them lazily so `import bigdl_tpu.utils` works without protobuf installed
+# (interop.convert_model imports them inside the function for the same reason).
+_LAZY = {
+    "load_caffe": ("bigdl_tpu.utils.caffe", "load_caffe"),
+    "save_caffe": ("bigdl_tpu.utils.caffe", "save_caffe"),
+    "load_tensorflow": ("bigdl_tpu.utils.tensorflow", "load_tensorflow"),
+    "save_tensorflow": ("bigdl_tpu.utils.tensorflow", "save_tensorflow"),
+    "Session": ("bigdl_tpu.utils.session", "Session"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
            "TrainSummary", "ValidationSummary",
            "save_model", "load_model", "module_to_spec", "module_from_spec",
            "criterion_to_spec", "criterion_from_spec",
            "register_module", "register_criterion", "register_fn",
-           "load_t7", "save_t7", "TorchObject"]
-from bigdl_tpu.utils.caffe import load_caffe, save_caffe
-
-__all__ += ["load_caffe", "save_caffe"]
-from bigdl_tpu.utils.tensorflow import load_tensorflow, save_tensorflow
-
-__all__ += ["load_tensorflow", "save_tensorflow"]
+           "load_t7", "save_t7", "TorchObject",
+           "redirect_verbose_logs", "undo_redirect"] + sorted(_LAZY)

@@ -226,6 +226,10 @@ def load_caffe(def_path: str, model_path: Optional[str] = None,
         elif ltype == "Sigmoid":
             module = nn.Sigmoid(name=l.name)
         elif ltype in ("Softmax", "SoftmaxWithLoss"):
+            # a train prototxt's loss layer has bottoms [logits, label]; the
+            # label blob has no producer node here — import probs from logits
+            # only (the reference likewise imports the inference net)
+            bottoms = bottoms[:1]
             module = nn.SoftMax(name=l.name)
         elif ltype == "Dropout":
             module = nn.Dropout(l.dropout_param.dropout_ratio, name=l.name)
@@ -440,6 +444,21 @@ def save_caffe(model: nn.Module, params: Any, state: Any,
             b = l.blobs.add()
             b.shape.dim.extend([1])
             b.data.append(1.0)  # scale factor
+            if m.affine and "weight" in p:
+                # caffe splits BN into BatchNorm (stats) + Scale (gamma/beta);
+                # emit the Scale pair so affine params survive the roundtrip
+                # (the loader fuses it back — CaffeLoader does the same)
+                sl = net.layer.add()
+                sl.name = f"{m.name}_scale"
+                sl.type = "Scale"
+                sl.bottom.append(prev)
+                sl.top.append(sl.name)
+                sl.scale_param.bias_term = True
+                for arr in (np.asarray(p["weight"]), np.asarray(p["bias"])):
+                    sb = sl.blobs.add()
+                    sb.shape.dim.extend(arr.shape)
+                    sb.data.extend(arr.tolist())
+                prev = sl.name
         else:
             raise ValueError(f"save_caffe: unsupported layer {type(m).__name__}")
         # track the activation shape for the dense transition

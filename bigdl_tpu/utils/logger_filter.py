@@ -1,0 +1,53 @@
+"""Console-noise control for training runs.
+
+Reference: utils/LoggerFilter.scala:91 (redirectSparkInfoLogs) — routes the
+noisy engine-under-the-framework logs (Spark/Akka INFO there; jax/absl/XLA
+chatter here) into a log file, while `bigdl.optim` keeps logging the
+per-iteration loss/throughput lines to the console.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence
+
+DEFAULT_NOISY = ("jax", "jax._src", "absl", "orbax", "flax")
+_redirected: list = []
+
+
+def redirect_verbose_logs(log_path: Optional[str] = None,
+                          noisy_loggers: Sequence[str] = DEFAULT_NOISY,
+                          keep_console: str = "bigdl_tpu") -> str:
+    """Send `noisy_loggers` INFO+ output to `log_path` (default
+    ./bigdl_tpu.log, overridable via $BIGDL_LOG_PATH like the reference's
+    -Dbigdl.utils.LoggerFilter.logFile) instead of the console; `keep_console`
+    loggers still propagate normally.  Returns the log file path.
+    reference: utils/LoggerFilter.scala:91-137.
+    """
+    path = log_path or os.environ.get("BIGDL_LOG_PATH", "bigdl_tpu.log")
+    handler = logging.FileHandler(path)
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+    for name in noisy_loggers:
+        lg = logging.getLogger(name)
+        lg.addHandler(handler)
+        lg.propagate = False  # keep it off the console
+        _redirected.append((lg, handler))
+    keep = logging.getLogger(keep_console)
+    keep.setLevel(logging.INFO)
+    if not logging.getLogger().handlers and not keep.handlers:
+        # no console handler configured at all: give the kept logger one so
+        # per-iteration lines stay visible (the reference's console appender)
+        console = logging.StreamHandler()
+        console.setFormatter(logging.Formatter("%(asctime)s %(levelname)s %(message)s"))
+        keep.addHandler(console)
+    return path
+
+
+def undo_redirect() -> None:
+    """Detach handlers installed by redirect_verbose_logs (tests/cleanup)."""
+    while _redirected:
+        lg, handler = _redirected.pop()
+        lg.removeHandler(handler)
+        lg.propagate = True

@@ -1,0 +1,94 @@
+"""Train / serve a loaded TensorFlow graph end-to-end.
+
+Reference: utils/tf/Session.scala:43-166 (BigDLSessionImpl) — wraps a
+parsed GraphDef, constructs a BigDL Graph ending at the requested output
+endpoints, and hooks it into DistriOptimizer for training or into
+Predictor-style inference; `saveParameters` dumps the trained variables.
+
+TPU-native shape: the GraphDef import (utils/tensorflow.load_tensorflow)
+already yields a jit-lowerable Graph module with its weights, so Session is
+a thin orchestration layer: train() runs the standard Optimizer loop (one
+pjit step instead of the reference's two Spark jobs), predict() uses the
+batched jitted Predictor.  The reference's queue-fed variant (train with an
+input queue and FakeCriterion) is a Spark-RDD-ism with no TPU analogue —
+feed a DataSet instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.utils.tensorflow import load_tensorflow
+
+
+class Session:
+    """reference: utils/tf/Session.scala:43 (abstract Session API)."""
+
+    def __init__(self, pb_path: str, inputs: Sequence[str],
+                 input_shapes: Sequence[Sequence[int]], seed: int = 0):
+        self.pb_path = pb_path
+        self.inputs = list(inputs)
+        self.input_shapes = [tuple(s) for s in input_shapes]
+        self.seed = seed
+        self.model = None
+        self.params = None
+        self.state = None
+        self._outputs: Optional[Sequence[str]] = None
+
+    def _construct(self, outputs: Sequence[str]):
+        """constructModel analogue (Session.scala:116): (re)build the Graph
+        ending at `outputs`, keeping already-trained weights when the
+        endpoints are unchanged."""
+        outputs = list(outputs)
+        if self.model is None or outputs != self._outputs:
+            self.model, self.params, self.state = load_tensorflow(
+                self.pb_path, self.inputs, outputs, self.input_shapes,
+                seed=self.seed)
+            self._outputs = outputs
+        return self.model
+
+    def train(self, outputs: Sequence[str], dataset: DataSet, criterion,
+              optim_method=None, end_when: Optional[Trigger] = None,
+              mesh=None):
+        """Train the imported graph; returns the trained Graph module
+        (weights on `.params`/`.state`).  reference: Session.scala:110-129
+        (train with in-memory DataSet — Placeholder-fed)."""
+        from bigdl_tpu.optim.optimizer import Optimizer  # avoid import cycle
+
+        model = self._construct(outputs)
+        model.params, model.state = self.params, self.state
+        opt = Optimizer(model, dataset, criterion, optim_method=optim_method,
+                        mesh=mesh, end_trigger=end_when)
+        opt.optimize()
+        self.params, self.state = model.params, model.state
+        return model
+
+    def predict(self, outputs: Sequence[str], data: Any,
+                batch_size: Optional[int] = None, mesh=None) -> np.ndarray:
+        """reference: Session.scala predict (batched graph inference)."""
+        from bigdl_tpu.optim.predictor import Predictor  # avoid import cycle
+
+        model = self._construct(outputs)
+        pred = Predictor(model, self.params, self.state, mesh=mesh)
+        return pred.predict(data, batch_size=batch_size)
+
+    def save_parameters(self, path: str) -> None:
+        """Dump variable contents. reference: Session.scala saveParameters."""
+        if self.params is None:
+            raise ValueError("no parameters: construct/train the graph first")
+        flat = {}
+
+        def walk(prefix, tree):
+            if hasattr(tree, "items"):
+                for k, v in tree.items():
+                    walk(f"{prefix}/{k}" if prefix else str(k), v)
+            else:
+                flat[prefix] = np.asarray(tree)
+
+        walk("", self.params)
+        walk("__state__", self.state)
+        np.savez(path, **flat)

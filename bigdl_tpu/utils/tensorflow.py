@@ -293,11 +293,16 @@ def load_tensorflow(pb_path: str, inputs: Sequence[str],
         for node in pending:
             data_in = [_clean(i) for i in node.input if not i.startswith("^")]
             needs_graph_input = node.op not in ("Const", "Placeholder", "NoOp")
-            if needs_graph_input and data_in and \
-                    data_in[0] not in imp.graph_nodes and \
-                    data_in[0] in imp.nodes_by_name and \
-                    imp.nodes_by_name[data_in[0]].op not in ("Const", "Identity",
-                                                             "Placeholder"):
+
+            def unresolved(name):
+                # a data input whose producer is a real op (not a foldable
+                # const/identity/placeholder) that hasn't been converted yet
+                return (name not in imp.graph_nodes
+                        and name in imp.nodes_by_name
+                        and imp.nodes_by_name[name].op not in
+                        ("Const", "Identity", "Placeholder"))
+
+            if needs_graph_input and any(unresolved(i) for i in data_in):
                 deferred.append(node)
                 continue
             imp.convert(node)
@@ -311,6 +316,10 @@ def load_tensorflow(pb_path: str, inputs: Sequence[str],
         jax.random.PRNGKey(seed),
         build_shapes[0] if len(build_shapes) == 1 else Table(*build_shapes))
     for lname, w in imp.weight_sets:
+        if lname not in params and lname not in state:
+            # node converted but pruned from the graph (it sits past the
+            # requested output endpoints, e.g. loading an intermediate layer)
+            continue
         for k, v in w.items():
             arr = np.asarray(v, np.float32)
             if lname in params and k in params[lname]:
@@ -371,7 +380,7 @@ def save_tensorflow(model: nn.Module, params: Any, state: Any, path: str,
                 nd.attr["dilations"].list.i.extend(
                     [1, m.dilation[0], m.dilation[1], 1])
             nd.attr["padding"].s = b"SAME" if m.pad[0] == -1 else b"VALID"
-            if m.pad[0] not in (-1, 0):
+            if m.pad[0] not in (-1, 0) or m.pad[1] not in (-1, 0):
                 raise ValueError("TF export supports pad 0 or SAME only")
             prev = m.name
             if m.with_bias:
@@ -404,6 +413,8 @@ def save_tensorflow(model: nn.Module, params: Any, state: Any, path: str,
             nd.attr["ksize"].list.i.extend([1, m.kernel[0], m.kernel[1], 1])
             nd.attr["strides"].list.i.extend([1, m.stride[0], m.stride[1], 1])
             nd.attr["padding"].s = b"SAME" if m.pad[0] == -1 else b"VALID"
+            if m.pad[0] not in (-1, 0) or m.pad[1] not in (-1, 0):
+                raise ValueError("TF export supports pad 0 or SAME only")
             prev = m.name
         elif isinstance(m, (nn.ReLU, nn.ReLU6, nn.Tanh, nn.Sigmoid, nn.ELU,
                             nn.SoftPlus, nn.SoftMax)):

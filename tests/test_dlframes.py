@@ -67,3 +67,31 @@ def test_image_transformer():
     assert out["output"][0].shape == (6, 6, 3)
     # original column untouched
     assert out["image"][0].shape == (10, 10, 3)
+
+
+class TestDLImageReader:
+    """reference: dlframes/DLImageReader.scala (readImages -> image frame)."""
+
+    def test_read_and_transform(self, tmp_path):
+        from PIL import Image
+
+        from bigdl_tpu.dlframes import DLImageReader, DLImageTransformer
+        from bigdl_tpu.vision import CenterCropper
+
+        rs = np.random.RandomState(0)
+        for i in range(3):
+            arr = rs.randint(0, 255, (12, 10, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(tmp_path / f"img_{i}.png")
+        (tmp_path / "notes.txt").write_text("not an image")
+
+        df = DLImageReader.read_images(str(tmp_path))
+        assert len(df) == 3
+        assert list(df.columns) == ["origin", "height", "width", "n_channels", "image"]
+        assert df.iloc[0]["height"] == 12 and df.iloc[0]["width"] == 10
+        assert df.iloc[0]["image"].shape == (12, 10, 3)
+        assert df.iloc[0]["image"].dtype == np.float32
+
+        out = DLImageTransformer(CenterCropper(8, 8)).transform(df)
+        assert out.iloc[1]["output"].shape == (8, 8, 3)
+        # original column untouched
+        assert out.iloc[1]["image"].shape == (12, 10, 3)

@@ -428,3 +428,168 @@ class TestTensorflowGraphDef:
         x = jnp.asarray(rs.rand(2, 4), jnp.float32)
         y, _ = g.apply(gp, gs, x)
         assert y.shape == (2, 3) and (np.asarray(y) >= 0).all()
+
+
+class TestTFSession:
+    """reference: utils/tf/Session.scala:43-166 — train/predict/save a
+    loaded TF graph end-to-end."""
+
+    def _export_mlp(self, tmp_path):
+        from bigdl_tpu.utils.tensorflow import save_tensorflow
+
+        m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+        p, s, _ = m.build(jax.random.PRNGKey(0), (4, 4))
+        pb = str(tmp_path / "mlp.pb")
+        save_tensorflow(m, p, s, pb, (4, 4))
+        # a Linear with bias exports as MatMul + BiasAdd; the graph output
+        # endpoint is the BiasAdd node
+        out_name = f"{list(m.children.values())[-1].name}/BiasAdd"
+        return pb, out_name, m
+
+    def test_train_predict_save(self, tmp_path):
+        from bigdl_tpu.dataset import DataSet, MiniBatch
+        from bigdl_tpu.optim import SGD, Trigger
+        from bigdl_tpu.utils import Session
+
+        pb, out_name, _ = self._export_mlp(tmp_path)
+        sess = Session(pb, ["input"], [(4, 4)])
+
+        rs = np.random.RandomState(0)
+        x = rs.rand(4, 4).astype(np.float32)
+        w = rs.rand(4, 2).astype(np.float32)
+        y = x @ w
+        pred_before = sess.predict([out_name], x)
+        mse_before = float(np.mean((pred_before - y) ** 2))
+
+        ds = DataSet.array([MiniBatch(x, y)])
+        model = sess.train([out_name], ds, nn.MSECriterion(),
+                           optim_method=SGD(learning_rate=0.5),
+                           end_when=Trigger.max_epoch(60))
+        assert model.params is not None
+        pred_after = sess.predict([out_name], x)
+        mse_after = float(np.mean((pred_after - y) ** 2))
+        assert mse_after < mse_before * 0.2, (mse_before, mse_after)
+
+        npz = str(tmp_path / "vars.npz")
+        sess.save_parameters(npz)
+        loaded = np.load(npz)
+        assert any(k.endswith("weight") for k in loaded.files)
+
+    def test_reconstruct_on_new_outputs(self, tmp_path):
+        from bigdl_tpu.utils import Session
+
+        pb, out_name, m = self._export_mlp(tmp_path)
+        sess = Session(pb, ["input"], [(4, 4)])
+        x = np.random.RandomState(1).rand(4, 4).astype(np.float32)
+        full = sess.predict([out_name], x)
+        assert full.shape == (4, 2)
+        # asking for an intermediate endpoint (the Tanh hidden layer)
+        # rebuilds the graph ending there
+        tanh_name = list(m.children.values())[1].name
+        hidden = sess.predict([tanh_name], x)
+        assert hidden.shape == (4, 8)
+        assert sess._outputs == [tanh_name]
+        assert np.all(np.abs(hidden) <= 1.0)
+
+
+class TestReviewRegressions:
+    """Regressions for interop edge cases found in review."""
+
+    def test_caffe_bn_affine_roundtrip(self, tmp_path):
+        """save_caffe must emit the Scale pair so gamma/beta survive
+        (reference: CaffePersister splits BN into BatchNorm+Scale)."""
+        from bigdl_tpu.utils.caffe import load_caffe, save_caffe
+
+        m = nn.Sequential(nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1),
+                          nn.SpatialBatchNormalization(4), nn.ReLU())
+        p, s, _ = m.build(jax.random.PRNGKey(0), (2, 8, 8, 3))
+        bn_key = list(m.children)[1]
+        rs = np.random.RandomState(0)
+        p[bn_key]["weight"] = jnp.asarray(rs.rand(4).astype(np.float32) + 0.5)
+        p[bn_key]["bias"] = jnp.asarray(rs.rand(4).astype(np.float32))
+        s[bn_key]["running_mean"] = jnp.asarray(rs.rand(4).astype(np.float32))
+        s[bn_key]["running_var"] = jnp.asarray(rs.rand(4).astype(np.float32) + 1.0)
+        x = jnp.asarray(rs.rand(2, 8, 8, 3), jnp.float32)
+        y_ref, _ = m.apply(p, s, x)
+        proto = str(tmp_path / "bn.prototxt")
+        cmodel = str(tmp_path / "bn.caffemodel")
+        save_caffe(m, p, s, proto, cmodel, input_shape=(2, 8, 8, 3))
+        g, gp, gs = load_caffe(proto, cmodel)
+        y2, _ = g.apply(gp, gs, x)
+        np.testing.assert_allclose(np.asarray(y2), np.asarray(y_ref), atol=1e-5)
+
+    def test_caffe_softmax_with_loss_label_bottom(self, tmp_path):
+        """A train prototxt's SoftmaxWithLoss has a label bottom with no
+        producer; import must use the logits bottom only."""
+        from bigdl_tpu.utils.caffe import load_caffe
+
+        prototxt = """
+name: "trainnet"
+input: "data"
+input_shape { dim: 2 dim: 3 dim: 4 dim: 4 }
+layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+  inner_product_param { num_output: 5 } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "fc" bottom: "label"
+  top: "loss" }
+"""
+        path = tmp_path / "train.prototxt"
+        path.write_text(prototxt)
+        g, gp, gs = load_caffe(str(path))
+        x = jnp.asarray(np.random.RandomState(0).rand(2, 4, 4, 3), jnp.float32)
+        y, _ = g.apply(gp, gs, x)
+        np.testing.assert_allclose(np.sum(np.asarray(y), -1), 1.0, atol=1e-5)
+
+    def test_tf_export_padded_pooling_raises(self, tmp_path):
+        from bigdl_tpu.utils.tensorflow import save_tensorflow
+
+        m = nn.Sequential(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1))
+        p, s, _ = m.build(jax.random.PRNGKey(0), (2, 8, 8, 3))
+        with pytest.raises(ValueError, match="pad 0 or SAME"):
+            save_tensorflow(m, p, s, str(tmp_path / "x.pb"), (2, 8, 8, 3))
+
+    def test_tf_out_of_order_multi_input(self, tmp_path):
+        """Residual-style GraphDef where the second input's producer appears
+        AFTER the consumer: the fixpoint must defer on any unresolved data
+        input, not only the first."""
+        import tf_graph_pb2 as tfp
+
+        from bigdl_tpu.utils.tensorflow import load_tensorflow, ndarray_to_tensor
+
+        rs = np.random.RandomState(0)
+        gd = tfp.GraphDef()
+        ph = gd.node.add(); ph.name = "input"; ph.op = "Placeholder"
+        wa = gd.node.add(); wa.name = "wa"; wa.op = "Const"
+        ndarray_to_tensor(rs.rand(1, 1, 3, 4).astype("float32"), wa.attr["value"].tensor)
+        ca = gd.node.add(); ca.name = "convA"; ca.op = "Conv2D"
+        ca.input.extend(["input", "wa"])
+        ca.attr["strides"].list.i.extend([1, 1, 1, 1])
+        ca.attr["padding"].s = b"SAME"
+        # the Add consumes convB BEFORE convB is declared
+        ad = gd.node.add(); ad.name = "add"; ad.op = "Add"
+        ad.input.extend(["convA", "convB"])
+        wb = gd.node.add(); wb.name = "wb"; wb.op = "Const"
+        ndarray_to_tensor(rs.rand(1, 1, 3, 4).astype("float32"), wb.attr["value"].tensor)
+        cb = gd.node.add(); cb.name = "convB"; cb.op = "Conv2D"
+        cb.input.extend(["input", "wb"])
+        cb.attr["strides"].list.i.extend([1, 1, 1, 1])
+        cb.attr["padding"].s = b"SAME"
+        pb = str(tmp_path / "ooo.pb")
+        with open(pb, "wb") as f:
+            f.write(gd.SerializeToString())
+        g, gp, gs = load_tensorflow(pb, ["input"], ["add"], [(2, 5, 5, 3)])
+        x = rs.rand(2, 5, 5, 3).astype(np.float32)
+        y, _ = g.apply(gp, gs, jnp.asarray(x))
+        # numeric check vs direct computation
+        import jax.lax as lax
+        dn = ("NHWC", "HWIO", "NHWC")
+        ref = (lax.conv_general_dilated(x, tensor_to_np(wa), (1, 1), "SAME",
+                                        dimension_numbers=dn)
+               + lax.conv_general_dilated(x, tensor_to_np(wb), (1, 1), "SAME",
+                                          dimension_numbers=dn))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5)
+
+
+def tensor_to_np(const_node):
+    from bigdl_tpu.utils.tensorflow import tensor_to_ndarray
+
+    return tensor_to_ndarray(const_node.attr["value"].tensor)

@@ -320,3 +320,128 @@ class TestDatasetParsers:
         assert read_sentence_corpus(str(p)) == ["hello world", "second line"]
         with _pytest.raises(FileNotFoundError):
             maybe_download("nope.bin", str(tmp_path), "http://example.com/x")
+
+
+class TestSparseMiniBatch:
+    """reference: dataset/MiniBatch.scala:579 (SparseMiniBatch over
+    TensorSample) — here sparse features densify at the batch boundary."""
+
+    def test_sparse_feature_to_dense(self):
+        from bigdl_tpu.dataset import SparseFeature
+
+        f = SparseFeature([[0, 1], [2, 3]], [5.0, 7.0], (3, 4))
+        d = f.to_dense()
+        assert d.shape == (3, 4)
+        assert d[0, 1] == 5.0 and d[2, 3] == 7.0 and d.sum() == 12.0
+
+    def test_batch_sparse_and_mixed(self):
+        from bigdl_tpu.dataset import Sample, SparseFeature, SparseMiniBatch
+
+        samples = [
+            Sample((SparseFeature([[i]], [1.0], (6,)),
+                    np.full((2,), float(i), np.float32)),
+                   np.asarray(i))
+            for i in range(4)
+        ]
+        mb = SparseMiniBatch.from_samples(samples)
+        sparse_batch, dense_batch = mb.get_input()
+        assert sparse_batch.shape == (4, 6)
+        np.testing.assert_allclose(sparse_batch, np.eye(4, 6, dtype=np.float32)[:, :6])
+        assert dense_batch.shape == (4, 2)
+        assert mb.get_target().shape == (4,)
+
+    def test_sample_to_minibatch_routes_sparse(self):
+        from bigdl_tpu.dataset import (Sample, SampleToMiniBatch, SparseFeature,
+                                       SparseMiniBatch)
+
+        samples = [Sample(SparseFeature([[i % 3]], [2.0], (3,)), np.asarray(i))
+                   for i in range(6)]
+        batches = list(SampleToMiniBatch(3).apply_to(samples))
+        assert len(batches) == 2
+        assert all(isinstance(b, SparseMiniBatch) for b in batches)
+        assert batches[0].get_input().shape == (3, 3)
+
+    def test_inconsistent_shapes_raise(self):
+        from bigdl_tpu.dataset import Sample, SparseFeature, SparseMiniBatch
+
+        samples = [Sample(SparseFeature([[0]], [1.0], (3,))),
+                   Sample(SparseFeature([[0]], [1.0], (4,)))]
+        with pytest.raises(ValueError):
+            SparseMiniBatch.from_samples(samples)
+
+
+    def test_padding_applies_to_dense_components(self):
+        from bigdl_tpu.dataset import Sample, SparseFeature, SparseMiniBatch
+
+        samples = [Sample((SparseFeature([[0]], [1.0], (4,)),
+                           np.ones((2,), np.float32)),
+                          np.asarray(0)),
+                   Sample((SparseFeature([[1]], [1.0], (4,)),
+                           np.ones((3,), np.float32)),
+                          np.asarray(1))]
+        mb = SparseMiniBatch.from_samples(samples, feature_padding=-1.0)
+        sparse_batch, dense_batch = mb.get_input()
+        assert sparse_batch.shape == (2, 4)
+        assert dense_batch.shape == (2, 3)
+        np.testing.assert_allclose(dense_batch[0], [1.0, 1.0, -1.0])
+
+class TestRowTransformer:
+    """reference: dataset/datamining/RowTransformer.scala."""
+
+    def test_numeric_schema_over_dict_rows(self):
+        from bigdl_tpu.dataset import RowTransformer, TableToSample
+
+        rows = [{"a": 1.0, "b": 2.0, "label": 0},
+                {"a": 3.0, "b": 4.0, "label": 1}]
+        rt = RowTransformer.numeric("feat", ["a", "b"])
+        tables = list(rt.apply_to(rows))
+        np.testing.assert_allclose(tables[0]["feat"], [1.0, 2.0])
+        # chain into samples with a second schema for the label
+        from bigdl_tpu.dataset.datamining import RowTransformSchema, RowTransformer as RT
+
+        rt2 = RT([RowTransformSchema("feat", field_names=["a", "b"]),
+                  RowTransformSchema("label", field_names=["label"])])
+        samples = list((rt2 >> TableToSample(["feat"], "label")).apply_to(iter(rows)))
+        assert samples[0].feature_size() == (2,)
+        np.testing.assert_allclose(samples[1].label, [1])
+
+    def test_atomic_and_indices(self):
+        from bigdl_tpu.dataset.datamining import RowTransformSchema, RowTransformer
+
+        rows = [[10.0, 20.0, 30.0]]
+        rt = RowTransformer([RowTransformSchema("pair", indices=[0, 2])])
+        out = list(rt.apply_to(rows))[0]
+        np.testing.assert_allclose(out["pair"], [10.0, 30.0])
+        at = RowTransformer.atomic(["x"])
+        t = list(at.apply_to([{"x": 5.0}]))[0]
+        np.testing.assert_allclose(t["x"], [5.0])
+
+    def test_duplicate_key_and_oob_raise(self):
+        from bigdl_tpu.dataset.datamining import RowTransformSchema, RowTransformer
+
+        with pytest.raises(ValueError):
+            RowTransformer([RowTransformSchema("k"), RowTransformSchema("k")])
+        with pytest.raises(ValueError):
+            RowTransformer([RowTransformSchema("k", indices=[5])], row_size=3)
+
+
+class TestLoggerFilter:
+    """reference: utils/LoggerFilter.scala:91 (redirectSparkInfoLogs)."""
+
+    def test_redirect_and_undo(self, tmp_path):
+        import logging
+
+        from bigdl_tpu.utils import redirect_verbose_logs, undo_redirect
+
+        path = str(tmp_path / "noise.log")
+        try:
+            out = redirect_verbose_logs(path, noisy_loggers=("some.noisy.lib",))
+            assert out == path
+            lg = logging.getLogger("some.noisy.lib")
+            lg.warning("hidden from console")
+            assert not lg.propagate
+            with open(path) as f:
+                assert "hidden from console" in f.read()
+        finally:
+            undo_redirect()
+        assert logging.getLogger("some.noisy.lib").propagate
