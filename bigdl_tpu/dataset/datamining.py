@@ -19,16 +19,6 @@ from bigdl_tpu.dataset.sample import Sample
 from bigdl_tpu.dataset.transformer import Transformer
 
 
-def _row_get(row: Any, key: Any) -> Any:
-    if hasattr(row, "keys"):  # dict / pandas Series
-        return row[key]
-    return row[key]  # sequence indexed by position
-
-
-def _row_len(row: Any) -> int:
-    return len(row)
-
-
 def _row_keys(row: Any) -> List[Any]:
     if hasattr(row, "keys"):
         return list(row.keys())
@@ -51,11 +41,11 @@ class RowTransformSchema:
 
     def select(self, row: Any) -> List[Any]:
         if self.field_names:
-            return [_row_get(row, f) for f in self.field_names]
+            return [row[f] for f in self.field_names]
         if self.indices:
             keys = _row_keys(row)
-            return [_row_get(row, keys[i]) for i in self.indices]
-        return [_row_get(row, k) for k in _row_keys(row)]
+            return [row[keys[i]] for i in self.indices]
+        return [row[k] for k in _row_keys(row)]
 
 
 class RowTransformer(Transformer):

@@ -25,7 +25,9 @@ from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.parameter_processor import (
     ParameterProcessor, ConstantClippingProcessor, L2NormClippingProcessor,
 )
-from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer, DistriOptimizer
+from bigdl_tpu.optim.optimizer import (Optimizer, LocalOptimizer,
+                                       DistriOptimizer, ParallelOptimizer)
+from bigdl_tpu.optim.profiling import layer_times, profiler_trace
 from bigdl_tpu.optim.predictor import (
     Predictor,
     LocalPredictor,

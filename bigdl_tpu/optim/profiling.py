@@ -1,0 +1,125 @@
+"""Per-layer timing and trace capture.
+
+Reference (survey §5.1): AbstractModule.forward/backward accumulate
+per-layer wall time (`forwardTime`/`backwardTime`,
+nn/abstractnn/AbstractModule.scala:254-288), exposed via `getTimes()`;
+DistriOptimizer feeds `moduleTimeList` into straggler detection; plus the
+driver-side Metrics registry (optim/Metrics.scala).
+
+TPU redesign: inside one jitted step there are no per-layer host
+timestamps — XLA fuses across layer boundaries.  The honest equivalents:
+
+  * `layer_times(model, ...)` — an offline attribution harness: each child
+    of a Sequential chain is jitted and timed in isolation (forward and
+    VJP), which is what per-layer wall times mean on an accelerator.
+  * `profiler_trace(log_dir)` — a context manager over `jax.profiler`
+    producing xplane traces for TensorBoard, the real production profiling
+    path (replaces the reference's "no sampling profiler" gap upward).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+class LayerTime(NamedTuple):
+    name: str
+    forward_s: float
+    backward_s: float
+
+
+def _sync(x) -> None:
+    # through the remote-TPU tunnel block_until_ready can return before
+    # execution finishes; a host readback is the only real sync
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    float(jnp.sum(leaf.astype(jnp.float32)))
+
+
+def layer_times(model: Module, params: Any, state: Any, x: Any, *,
+                training: bool = False, iters: int = 5,
+                warmup: int = 2) -> List[LayerTime]:
+    """Time each child of a Sequential-style chain (reference: getTimes).
+
+    Returns one (name, forward_s, backward_s) entry per child, averaged
+    over `iters` runs after `warmup`.  backward_s is the VJP time for
+    children with parameters (0.0 for parameter-free layers whose backward
+    fuses away).
+    """
+    if not getattr(model, "children", None):
+        raise ValueError("layer_times needs a container with children "
+                         "(Sequential or models built from one)")
+    warmup = max(warmup, 1)  # at least one run to compile (and to bind y/g)
+    results: List[LayerTime] = []
+    act = x
+    for key, child in model.children.items():
+        p, s = params.get(key, {}), state.get(key, {})
+
+        fwd = jax.jit(lambda p_, a, _c=child, _s=s:
+                      _c.apply(p_, _s, a, training=training)[0])
+        for _ in range(warmup):
+            y = fwd(p, act)
+        _sync(y)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            y = fwd(p, act)
+        _sync(y)
+        f_t = (time.perf_counter() - t0) / iters
+
+        b_t = 0.0
+        if jax.tree_util.tree_leaves(p):
+            def loss(p_, a, _c=child, _s=s):
+                out, _ = _c.apply(p_, _s, a, training=training)
+                return jnp.sum(out.astype(jnp.float32))
+
+            bwd = jax.jit(jax.grad(loss, argnums=(0, 1)))
+            for _ in range(warmup):
+                g = bwd(p, act)
+            _sync(g)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                g = bwd(p, act)
+            _sync(g)
+            b_t = (time.perf_counter() - t0) / iters
+
+        results.append(LayerTime(child.name, f_t, b_t))
+        act = y  # feed the next layer this layer's (last) output
+    return results
+
+
+def summarize(times: List[LayerTime]) -> str:
+    """Human-readable table, slowest first (reference: getTimes dumps)."""
+    total = sum(t.forward_s + t.backward_s for t in times) or 1.0
+    lines = [f"{'layer':<28} {'fwd ms':>9} {'bwd ms':>9} {'%':>6}"]
+    for t in sorted(times, key=lambda t: -(t.forward_s + t.backward_s)):
+        pct = 100.0 * (t.forward_s + t.backward_s) / total
+        lines.append(f"{t.name:<28} {t.forward_s * 1e3:>9.3f} "
+                     f"{t.backward_s * 1e3:>9.3f} {pct:>5.1f}%")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str):
+    """jax.profiler xplane trace for TensorBoard (survey §5.1's "TPU
+    equivalent: jax profiler/xplane traces").  Degrades to a no-op if the
+    backend can't trace (e.g. tunneled devices)."""
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception:  # pragma: no cover - backend-dependent
+        pass
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # pragma: no cover
+                pass

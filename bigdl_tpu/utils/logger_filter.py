@@ -25,6 +25,7 @@ def redirect_verbose_logs(log_path: Optional[str] = None,
     loggers still propagate normally.  Returns the log file path.
     reference: utils/LoggerFilter.scala:91-137.
     """
+    undo_redirect()  # calling twice must not stack handlers / double lines
     path = log_path or os.environ.get("BIGDL_LOG_PATH", "bigdl_tpu.log")
     handler = logging.FileHandler(path)
     handler.setFormatter(logging.Formatter(
@@ -32,6 +33,9 @@ def redirect_verbose_logs(log_path: Optional[str] = None,
     for name in noisy_loggers:
         lg = logging.getLogger(name)
         lg.addHandler(handler)
+        # INFO must actually reach the file: the inherited root level is
+        # usually WARNING, which would drop the records before the handler
+        lg.setLevel(logging.INFO)
         lg.propagate = False  # keep it off the console
         _redirected.append((lg, handler))
     keep = logging.getLogger(keep_console)
@@ -47,7 +51,12 @@ def redirect_verbose_logs(log_path: Optional[str] = None,
 
 def undo_redirect() -> None:
     """Detach handlers installed by redirect_verbose_logs (tests/cleanup)."""
+    handlers = set()
     while _redirected:
         lg, handler = _redirected.pop()
         lg.removeHandler(handler)
+        lg.setLevel(logging.NOTSET)
         lg.propagate = True
+        handlers.add(handler)
+    for h in handlers:
+        h.close()

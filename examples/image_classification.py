@@ -1,0 +1,77 @@
+"""ResNet image classification with the vision pipeline (reference:
+example/imageclassification + models/resnet/Train.scala, cifar10 path).
+
+Trains ResNet-20 on CIFAR-10 when --data-dir holds the python batches,
+else on synthetic images, with the reference's augmentation chain
+(random crop + flip + channel normalize) expressed as FeatureTransformers.
+
+    python examples/image_classification.py [--data-dir cifar-10-batches-py]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--depth", type=int, default=20)
+    ap.add_argument("--samples", type=int, default=256,
+                    help="synthetic sample count when no --data-dir")
+    args = ap.parse_args(argv)
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import ArrayDataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.models import resnet_cifar
+    from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+    from bigdl_tpu.vision import (ChannelNormalize, Expand, Flip, ImageFeature,
+                                  RandomCropper, RandomTransformer)
+
+    if args.data_dir:
+        from bigdl_tpu.dataset import load_cifar10
+
+        # normalize=False: the augmentation chain below ends with
+        # ChannelNormalize, which must see raw 0-255 pixels
+        x, y = load_cifar10(args.data_dir, "train", normalize=False)
+        x = x.astype(np.float32)
+    else:
+        rs = np.random.RandomState(0)
+        y = rs.randint(0, 10, args.samples)
+        # class-dependent mean shift so the synthetic run actually learns
+        x = rs.rand(args.samples, 32, 32, 3).astype(np.float32) * 60 + 100
+        x += y[:, None, None, None] * 2.0
+
+    # the reference cifar chain: pad+random crop 32, random hflip, normalize
+    # (models/resnet/Train.scala + dataset/image/*)
+    augment = (Expand(max_ratio=1.25, means=(0, 0, 0))
+               >> RandomCropper(32, 32)
+               >> RandomTransformer(Flip(p=1.0), 0.5)
+               >> ChannelNormalize((125.3, 123.0, 113.9), (63.0, 62.1, 66.7)))
+
+    def to_sample(args_):
+        xi, yi = args_
+        feat = augment(ImageFeature(xi))
+        return Sample.from_ndarray(feat.image, np.int32(yi))
+
+    samples = [to_sample(a) for a in zip(x, y)]
+    ds = ArrayDataSet(samples).transform(SampleToMiniBatch(args.batch_size))
+
+    model = resnet_cifar(args.depth, 10)  # ends in LogSoftMax -> NLL loss
+    opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion(),
+                         optim_method=SGD(learning_rate=0.05, momentum=0.9,
+                                          weight_decay=1e-4),
+                         end_trigger=Trigger.max_epoch(args.epochs))
+    opt.optimize()
+    print(f"final loss {opt._driver_state['loss']:.4f}")
+    return opt._driver_state["loss"]
+
+
+if __name__ == "__main__":
+    main()

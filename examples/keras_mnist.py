@@ -1,0 +1,69 @@
+"""Keras-style compile/fit on MNIST (reference: example/keras — the
+Keras-1.2.2-compatible API of nn/keras/Topology.scala).
+
+Runs on real MNIST idx files when --data-dir is given, else synthetic
+digits; shows compile/fit/evaluate/predict plus TensorBoard scalars.
+
+    python examples/keras_mnist.py [--data-dir ~/mnist] [--epochs 2]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--samples", type=int, default=512)
+    args = ap.parse_args(argv)
+
+    from bigdl_tpu import keras
+
+    if args.data_dir:
+        from bigdl_tpu.dataset import load_mnist
+
+        # the loader already mean/std-normalizes (normalize=True default)
+        x, y = load_mnist(args.data_dir, "train")
+        x = x.astype(np.float32).reshape(-1, 28, 28, 1)
+    else:
+        rs = np.random.RandomState(0)
+        y = rs.randint(0, 10, args.samples)
+        x = rs.rand(args.samples, 28, 28, 1).astype(np.float32) * 0.1
+        for i, yi in enumerate(y):  # a learnable bright patch per class
+            x[i, 2 + yi * 2: 6 + yi * 2, 4:24] += 0.9
+
+    model = keras.Sequential(
+        keras.Convolution2D(16, 3, 3, activation="relu",
+                            input_shape=(28, 28, 1)),
+        keras.MaxPooling2D((2, 2)),
+        keras.Flatten(),
+        keras.Dense(64, activation="relu"),
+        keras.Dropout(0.25),
+        keras.Dense(10),  # logits: sparse_categorical_crossentropy fuses
+        # log_softmax + NLL (CrossEntropyCriterion)
+    )
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.set_tensorboard(tempfile.mkdtemp(), "keras_mnist")
+
+    split = int(0.9 * len(x))
+    model.fit(x[:split], y[:split], batch_size=args.batch_size,
+              nb_epoch=args.epochs, validation_data=(x[split:], y[split:]))
+    results = model.evaluate(x[split:], y[split:], batch_size=args.batch_size)
+    for name, value in results:
+        print(f"{name}: {value:.4f}")
+    preds = model.predict_classes(x[:8])
+    print("sample predictions:", preds, "labels:", y[:8])
+    return dict(results)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""PTB-style LSTM language model (reference: example/languagemodel +
+models/rnn/Train.scala:48-59).
+
+Trains the stacked-LSTM LM on a real tokenized corpus when --data is a
+text file, else on a synthetic token stream; reports per-word perplexity.
+
+    python examples/language_model.py [--data ptb.train.txt] [--epochs 1]
+"""
+
+import argparse
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def load_ids(path, vocab_size):
+    from bigdl_tpu.dataset.text import Dictionary
+
+    with open(path) as f:
+        words = f.read().replace("\n", " <eos> ").split()
+    d = Dictionary([words], vocab_size=vocab_size)
+    ids = np.asarray([d.get_index(w) for w in words], np.int32)
+    return ids, d.vocab_size()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=None, help="tokenized corpus text file")
+    ap.add_argument("--vocab-size", type=int, default=256)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--num-steps", type=int, default=16)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--tokens", type=int, default=20_000,
+                    help="synthetic stream length when no --data")
+    args = ap.parse_args(argv)
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, MiniBatch
+    from bigdl_tpu.dataset.text import ptb_stream_batches
+    from bigdl_tpu.models import PTBModel
+    from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+
+    if args.data:
+        ids, vocab = load_ids(args.data, args.vocab_size)
+    else:  # synthetic markov-ish stream so the example always runs
+        rs = np.random.RandomState(0)
+        vocab = args.vocab_size
+        ids = np.cumsum(rs.randint(1, 4, args.tokens)) % vocab
+
+    batches = [MiniBatch(x, y) for x, y in
+               ptb_stream_batches(ids, args.batch_size, args.num_steps)]
+    print(f"{len(ids)} tokens, vocab {vocab}, {len(batches)} batches/epoch")
+
+    model = PTBModel(vocab_size=vocab, embedding_dim=args.hidden,
+                     hidden_size=args.hidden, num_layers=args.layers,
+                     keep_prob=0.9)
+    # LM loss: NLL at every timestep, averaged over B and T so the loss is
+    # per-token and perplexity is exp(loss)
+    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                            size_average=True)
+
+    opt = LocalOptimizer(model, DataSet.array(batches), criterion,
+                         optim_method=SGD(learning_rate=0.5, momentum=0.9),
+                         end_trigger=Trigger.max_epoch(args.epochs))
+    opt.optimize()
+    loss = opt._driver_state["loss"]
+    print(f"final loss {loss:.4f}  perplexity {math.exp(min(loss, 20.0)):.1f}")
+    return loss
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""DataFrame ML pipeline (reference: example/MLPipeline + example/dlframes:
+DLClassifier over Spark-ML columns; pandas is the TPU-side dataframe).
+
+Trains a DLClassifier on a toy two-moons-ish frame, appends predictions
+with the fitted DLClassifierModel, and runs DLImageReader +
+DLImageTransformer over a directory of generated images.
+
+    python examples/ml_pipeline.py
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main(argv=None):
+    import pandas as pd
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dlframes import (DLClassifier, DLImageReader,
+                                    DLImageTransformer)
+    from bigdl_tpu.vision import CenterCropper, ChannelNormalize
+
+    # --- tabular: DLClassifier.fit over (features, label) columns ---------
+    rs = np.random.RandomState(0)
+    n = 512
+    labels = rs.randint(0, 2, n)  # 0-based class ids (documented delta from
+    # the reference's 1-based Spark-ML convention)
+    feats = rs.randn(n, 4).astype(np.float32) + labels[:, None] * 1.5
+    df = pd.DataFrame({"features": [f for f in feats], "label": labels})
+
+    from bigdl_tpu.optim import SGD
+
+    model = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2),
+                          nn.LogSoftMax())
+    clf = (DLClassifier(model, nn.ClassNLLCriterion(), [4])
+           .set_batch_size(64).set_max_epoch(5)
+           .set_optim_method(SGD(learning_rate=0.1)))
+    fitted = clf.fit(df)
+    out = fitted.transform(df)
+    acc = float(np.mean(out["prediction"].to_numpy() == df["label"].to_numpy()))
+    print(f"DLClassifier train accuracy: {acc:.3f}")
+
+    # --- images: DLImageReader -> DLImageTransformer ----------------------
+    img_dir = tempfile.mkdtemp()
+    from PIL import Image
+
+    for i in range(4):
+        arr = rs.randint(0, 255, (20, 24, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(os.path.join(img_dir, f"img_{i}.png"))
+    frame = DLImageReader.read_images(img_dir)
+    frame = DLImageTransformer(
+        CenterCropper(16, 16) >> ChannelNormalize((127,) * 3, (64,) * 3),
+        output_col="normalized").transform(frame)
+    print(f"image frame: {len(frame)} rows, normalized shape "
+          f"{frame.iloc[0]['normalized'].shape}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""Load a frozen TensorFlow GraphDef, fine-tune it, and serve it
+(reference: example/tensorflow + example/loadmodel + utils/tf/Session.scala).
+
+Without --pb, first exports a small convnet as a frozen GraphDef so the
+example is self-contained; then imports it through Session, fine-tunes on
+synthetic data, and runs batched prediction.
+
+    python examples/tf_loadmodel.py [--pb model.pb --input input --output out]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def export_demo_pb(path, shape):
+    import jax
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.utils import save_tensorflow
+
+    m = nn.Sequential(
+        nn.SpatialConvolution(3, 8, 3, 3, 1, 1, -1, -1), nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2),
+        nn.Flatten(),
+        nn.Linear(8 * (shape[1] // 2) * (shape[2] // 2), 10), nn.SoftMax())
+    p, s, _ = m.build(jax.random.PRNGKey(0), shape)
+    save_tensorflow(m, p, s, path, shape)
+    return list(m.children.values())[-1].name  # the Softmax output node
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pb", default=None, help="frozen GraphDef path")
+    ap.add_argument("--input", default="input")
+    ap.add_argument("--output", default=None)
+    ap.add_argument("--epochs", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, MiniBatch
+    from bigdl_tpu.optim import SGD, Trigger
+    from bigdl_tpu.utils import Session
+
+    shape = (16, 16, 16, 3)
+    pb, out_name = args.pb, args.output
+    if pb is None:
+        pb = os.path.join(tempfile.mkdtemp(), "demo.pb")
+        out_name = export_demo_pb(pb, shape)
+        print(f"exported demo GraphDef to {pb} (output node {out_name!r})")
+
+    sess = Session(pb, [args.input], [shape])
+    rs = np.random.RandomState(0)
+    x = rs.rand(*shape).astype(np.float32)
+    y = rs.randint(0, 10, shape[0])
+
+    before = sess.predict([out_name], x)
+    print(f"imported graph predicts {before.shape}; fine-tuning...")
+
+    # SoftMax output -> train against NLL on log-probs via CrossEntropy on
+    # the probabilities' logs: use ClassNLL with log_prob_as_input=False
+    crit = nn.ClassNLLCriterion(log_prob_as_input=False)
+    sess.train([out_name], DataSet.array([MiniBatch(x, y)]), crit,
+               optim_method=SGD(learning_rate=0.1),
+               end_when=Trigger.max_epoch(args.epochs))
+    after = sess.predict([out_name], x)
+    acc = float(np.mean(np.argmax(after, -1) == y))
+    print(f"post-finetune train accuracy {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

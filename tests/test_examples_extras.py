@@ -104,3 +104,50 @@ class TestExamples:
         ex.main()
         outp = capsys.readouterr().out
         assert "request 7" in outp
+
+
+class TestNewExamples:
+    """Smoke tests for the example entry points (reference: example/)."""
+
+    def test_language_model(self):
+        import examples.language_model as ex
+
+        loss = ex.main(["--tokens", "3000", "--vocab-size", "64",
+                        "--hidden", "16", "--epochs", "1"])
+        assert np.isfinite(loss)
+
+    def test_tree_lstm_sentiment(self):
+        import examples.tree_lstm_sentiment as ex
+
+        acc = ex.main(["--steps", "30", "--batch", "8", "--hidden", "8"])
+        assert acc > 0.7  # separable synthetic classes
+
+    def test_image_classification(self):
+        import examples.image_classification as ex
+
+        loss = ex.main(["--samples", "64", "--batch-size", "16",
+                        "--epochs", "1", "--depth", "8"])
+        assert np.isfinite(loss)
+
+    def test_tf_loadmodel(self):
+        import examples.tf_loadmodel as ex
+
+        acc = ex.main(["--epochs", "1"])
+        assert 0.0 <= acc <= 1.0
+
+    def test_ml_pipeline(self):
+        import examples.ml_pipeline as ex
+
+        assert ex.main() > 0.8
+
+    def test_keras_mnist(self):
+        import examples.keras_mnist as ex
+
+        results = ex.main(["--samples", "128", "--epochs", "1",
+                           "--batch-size", "32"])
+        assert "Loss" in results
+
+    def test_udf_predictor(self):
+        import examples.udf_predictor as ex
+
+        assert ex.main() > 0.8

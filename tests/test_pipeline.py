@@ -436,12 +436,18 @@ class TestLoggerFilter:
         path = str(tmp_path / "noise.log")
         try:
             out = redirect_verbose_logs(path, noisy_loggers=("some.noisy.lib",))
+            # re-redirecting must not stack a second handler (double lines)
+            redirect_verbose_logs(path, noisy_loggers=("some.noisy.lib",))
             assert out == path
             lg = logging.getLogger("some.noisy.lib")
             lg.warning("hidden from console")
+            lg.info("info reaches the file too")  # INFO+ promised
             assert not lg.propagate
+            assert len(lg.handlers) == 1
             with open(path) as f:
-                assert "hidden from console" in f.read()
+                content = f.read()
+            assert content.count("hidden from console") == 1
+            assert "info reaches the file too" in content
         finally:
             undo_redirect()
         assert logging.getLogger("some.noisy.lib").propagate
