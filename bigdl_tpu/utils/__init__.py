@@ -2,6 +2,7 @@ from bigdl_tpu.utils.checkpoint import save_checkpoint, load_checkpoint, latest_
 from bigdl_tpu.utils.summary import TrainSummary, ValidationSummary
 from bigdl_tpu.utils.torchfile import load_t7, save_t7, TorchObject
 from bigdl_tpu.utils.logger_filter import redirect_verbose_logs, undo_redirect
+from bigdl_tpu.utils.ir import IRGraph, CompiledGraph
 from bigdl_tpu.utils.serializer import (
     save_model,
     load_model,
@@ -41,4 +42,5 @@ __all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
            "criterion_to_spec", "criterion_from_spec",
            "register_module", "register_criterion", "register_fn",
            "load_t7", "save_t7", "TorchObject",
-           "redirect_verbose_logs", "undo_redirect"] + sorted(_LAZY)
+           "redirect_verbose_logs", "undo_redirect",
+           "IRGraph", "CompiledGraph"] + sorted(_LAZY)

@@ -1,0 +1,133 @@
+"""Engine-neutral intermediate representation of a model's computation.
+
+Reference: utils/intermediate/ (IRGraph.scala:41-99, IRConverter.scala:
+58-108, IRToBlas.scala, IRToDnn.scala) — the reference captures a BLAS
+graph into an engine-neutral IR, then lowers it to the BLAS or MKL-DNN
+execution engine depending on `bigdl.engineType`.
+
+TPU mapping: the IR is the jaxpr / StableHLO that `jax.jit` traces; the
+"engine choice" that blas-vs-dnn represented (same math, different kernel
+library + layouts) maps to the DTYPE POLICY (fp32 vs bf16-compute) and the
+XLA backend platform.  IRGraph.trace captures a module once; convert()
+re-targets it to a policy; lower()/compile() expose the StableHLO text,
+the compiled executable, and XLA's cost/memory analysis (the introspection
+`nn/mkldnn/Perf` and layout logs provided in the reference).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+ENGINES = ("fp32", "bf16")  # reference: EngineType MklBlas | MklDnn
+
+
+class CompiledGraph:
+    """A compiled executable + its analyses (reference: the compiled
+    DnnGraph with its primitives; analyses replace `Perf` micro-bench)."""
+
+    def __init__(self, compiled):
+        self._compiled = compiled
+
+    def __call__(self, params, state, x):
+        return self._compiled(params, state, x)
+
+    def cost_analysis(self) -> Dict[str, float]:
+        ca = self._compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0] if ca else {}
+        return dict(ca) if ca else {}
+
+    def flops(self) -> float:
+        return float(self.cost_analysis().get("flops", 0.0))
+
+    def bytes_accessed(self) -> float:
+        return float(self.cost_analysis().get("bytes accessed", 0.0))
+
+    def memory_analysis(self):
+        return self._compiled.memory_analysis()
+
+    def as_text(self) -> str:
+        """Optimized HLO of the executable."""
+        return self._compiled.as_text()
+
+
+class IRGraph:
+    """Captured, engine-neutral computation of one forward pass.
+    reference: utils/intermediate/IRGraph.scala:41."""
+
+    def __init__(self, model: Module, params: Any, state: Any,
+                 input_shape: Sequence[int], training: bool = False,
+                 engine: str = "fp32", rng: Optional[jax.Array] = None):
+        if engine not in ENGINES:
+            raise ValueError(f"engine must be one of {ENGINES}, got {engine!r}")
+        self.model = model
+        self.params = params
+        self.state = state
+        self.input_shape = tuple(input_shape)
+        self.training = training
+        self.engine = engine
+        # stochastic layers (Dropout, samplers) need a key in training mode
+        self.rng = rng if rng is not None or not training \
+            else jax.random.PRNGKey(0)
+
+    # -- construction (reference: BlasToIR) ------------------------------
+
+    @staticmethod
+    def trace(model: Module, params: Any, state: Any,
+              input_shape: Sequence[int], training: bool = False,
+              rng: Optional[jax.Array] = None) -> "IRGraph":
+        return IRGraph(model, params, state, input_shape, training, rng=rng)
+
+    # -- engine conversion (reference: IRConverter to Blas/Dnn) ----------
+
+    def convert(self, engine: str) -> "IRGraph":
+        """Re-target to a dtype policy ('fp32' or 'bf16' compute), the TPU
+        analogue of IRToBlas/IRToDnn.  Params stay fp32 masters; under
+        'bf16' the forward casts params+input to bf16 (MXU-native)."""
+        return IRGraph(self.model, self.params, self.state, self.input_shape,
+                       self.training, engine, rng=self.rng)
+
+    def _fn(self) -> Callable:
+        model, training, engine = self.model, self.training, self.engine
+        rng = self.rng
+
+        def forward(params, state, x):
+            if engine == "bf16":
+                params = jax.tree_util.tree_map(
+                    lambda a: a.astype(jnp.bfloat16)
+                    if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+                    params)
+                x = x.astype(jnp.bfloat16) \
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x
+            out, new_state = model.apply(params, state, x, training=training,
+                                         rng=rng)
+            return out, new_state
+
+        return forward
+
+    def _example_x(self):
+        return jnp.zeros(self.input_shape, jnp.float32)
+
+    # -- inspection / lowering -------------------------------------------
+
+    def jaxpr(self) -> str:
+        """The engine-neutral IR itself (reference: the IRElement list)."""
+        return str(jax.make_jaxpr(self._fn())(
+            self.params, self.state, self._example_x()))
+
+    def lower(self):
+        """StableHLO lowering (pre-backend-optimization)."""
+        return jax.jit(self._fn()).lower(self.params, self.state,
+                                         self._example_x())
+
+    def as_stablehlo_text(self) -> str:
+        return self.lower().as_text()
+
+    def compile(self) -> CompiledGraph:
+        """Backend compile (reference: IRGraph.build + DnnGraph.compile)."""
+        return CompiledGraph(self.lower().compile())

@@ -532,3 +532,64 @@ def test_save_load_graph_model(tmp_path):
     m2, p2, s2 = ser.load_model(path)
     y2, _ = m2.apply(p2, s2, x, training=False)
     _assert_close(y1, y2)
+
+
+class TestIRGraph:
+    """reference: utils/intermediate/ (IRGraph, IRConverter) — the
+    engine-neutral capture + per-engine lowering seam."""
+
+    def _model(self):
+        m = nn.Sequential(nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1),
+                          nn.ReLU(), nn.Flatten(), nn.Linear(8 * 8 * 8, 4))
+        p, s, _ = m.build(jax.random.PRNGKey(0), (2, 8, 8, 3))
+        return m, p, s
+
+    def test_trace_convert_compile(self):
+        from bigdl_tpu.utils.ir import IRGraph
+
+        m, p, s = self._model()
+        ir = IRGraph.trace(m, p, s, (2, 8, 8, 3))
+        assert "conv" in ir.jaxpr() or "dot" in ir.jaxpr()
+
+        x = jnp.asarray(np.random.RandomState(0).rand(2, 8, 8, 3), jnp.float32)
+        g32 = ir.compile()
+        y32, _ = g32(p, s, x)
+        assert y32.dtype == jnp.float32
+
+        g16 = ir.convert("bf16").compile()
+        y16, _ = g16(p, s, x)
+        assert y16.dtype == jnp.bfloat16
+        # same math, reduced precision
+        np.testing.assert_allclose(np.asarray(y16, np.float32),
+                                   np.asarray(y32), atol=0.2, rtol=0.1)
+
+    def test_cost_analysis_and_text(self):
+        from bigdl_tpu.utils.ir import IRGraph
+
+        m, p, s = self._model()
+        g = IRGraph.trace(m, p, s, (2, 8, 8, 3)).compile()
+        assert g.flops() > 0
+        assert "hlo" in g.as_text().lower() or "ENTRY" in g.as_text()
+        ir = IRGraph.trace(m, p, s, (2, 8, 8, 3))
+        assert "stablehlo" in ir.as_stablehlo_text() or "func" in ir.as_stablehlo_text()
+
+    def test_bad_engine_raises(self):
+        from bigdl_tpu.utils.ir import IRGraph
+
+        m, p, s = self._model()
+        with pytest.raises(ValueError, match="engine"):
+            IRGraph.trace(m, p, s, (2, 8, 8, 3)).convert("mkldnn")
+
+    def test_training_mode_with_dropout(self):
+        from bigdl_tpu.utils.ir import IRGraph
+
+        m = nn.Sequential(nn.Linear(4, 8), nn.Dropout(0.5), nn.Linear(8, 2))
+        p, s, _ = m.build(jax.random.PRNGKey(0), (2, 4))
+        ir = IRGraph.trace(m, p, s, (2, 4), training=True)  # default key
+        g = ir.compile()
+        y, _ = g(p, s, jnp.ones((2, 4)))
+        assert y.shape == (2, 2)
+        ir2 = IRGraph.trace(m, p, s, (2, 4), training=True,
+                            rng=jax.random.PRNGKey(3))
+        y2, _ = ir2.convert("bf16").compile()(p, s, jnp.ones((2, 4)))
+        assert y2.dtype == jnp.bfloat16
