@@ -45,6 +45,45 @@ class DataSet:
     def array(data: Sequence[Any]) -> "ArrayDataSet":
         return ArrayDataSet(list(data))
 
+    @staticmethod
+    def image_folder(path: str, class_dirs: bool = True) -> "ArrayDataSet":
+        """Directory of images -> Samples; with `class_dirs`, each
+        subdirectory is a class (label = sorted subdir index, like the
+        reference's ImageFolder local path, DataSet.scala:322-482).
+        Decoding uses PIL on the host (the reference used OpenCV)."""
+        import glob
+        import os
+
+        from PIL import Image
+
+        def decode(p):
+            with Image.open(p) as im:
+                return np.asarray(im.convert("RGB"), np.float32)
+
+        exts = (".png", ".jpg", ".jpeg", ".bmp")
+        samples = []
+        if class_dirs:
+            classes = sorted(d for d in os.listdir(path)
+                             if os.path.isdir(os.path.join(path, d)))
+            for label, cls in enumerate(classes):
+                for p in sorted(glob.glob(os.path.join(path, cls, "*"))):
+                    if p.lower().endswith(exts):
+                        samples.append(Sample(decode(p), np.int32(label)))
+        else:
+            for p in sorted(glob.glob(os.path.join(path, "*"))):
+                if p.lower().endswith(exts):
+                    samples.append(Sample(decode(p)))
+        return ArrayDataSet(samples)
+
+    @staticmethod
+    def record_shards(dir_path: str, n_threads: int = 4) -> "RecordShardDataSet":
+        """Sharded TFRecord folder -> streaming Sample dataset (the
+        reference's SeqFileFolder / Hadoop-SequenceFile ImageNet layout,
+        DataSet.scala:482-560; TFRecord is the TPU-native container).
+        Shard order reshuffles per epoch; records stream through the
+        native prefetching reader."""
+        return RecordShardDataSet(dir_path, n_threads)
+
 
 class ArrayDataSet(DataSet):
     """In-memory dataset with epoch shuffling (seeded via RandomGenerator,
@@ -68,6 +107,50 @@ class ArrayDataSet(DataSet):
 
 
 LocalDataSet = ArrayDataSet
+
+
+class RecordShardDataSet(DataSet):
+    """Streaming dataset over a directory of TFRecord shards (the
+    reference's DistributedDataSet over SequenceFile folders).  Each epoch
+    shuffles SHARD order (record order within a shard is the reader's —
+    throughput over order, like the reference's multithreaded decode)."""
+
+    def __init__(self, dir_path: str, n_threads: int = 4):
+        import glob
+        import os
+
+        paths = sorted(glob.glob(os.path.join(dir_path, "*.tfrecord"))) \
+            or sorted(glob.glob(os.path.join(dir_path, "*")))
+        # the '*' fallback must not pick up _SUCCESS markers / subdirs
+        self.paths = [p for p in paths
+                      if os.path.isfile(p)
+                      and not os.path.basename(p).startswith(("_", "."))]
+        if not self.paths:
+            raise FileNotFoundError(f"no record shards under {dir_path}")
+        self.n_threads = n_threads
+        self._epoch = 0
+        self._size: int = -1
+
+    def size(self) -> int:
+        if self._size < 0:
+            from bigdl_tpu.dataset.tfrecord import count_records
+
+            # frame-length scan only — no payload decode (an ImageNet-scale
+            # folder would otherwise stream the whole dataset to count it)
+            self._size = sum(count_records(p) for p in self.paths)
+        return self._size
+
+    def data(self, train: bool) -> Iterator[Any]:
+        from bigdl_tpu.dataset.tfrecord import (PrefetchRecordReader,
+                                                record_to_sample)
+
+        paths = list(self.paths)
+        if train:
+            rs = np.random.RandomState(RandomGenerator.get_seed() + self._epoch)
+            rs.shuffle(paths)
+            self._epoch += 1
+        for rec in PrefetchRecordReader(paths, n_threads=self.n_threads):
+            yield record_to_sample(rec)
 
 
 class TransformedDataSet(DataSet):

@@ -62,9 +62,13 @@ class SpatialConvolution(Module):
                  kernel_w: int, kernel_h: int, stride_w: int = 1, stride_h: int = 1,
                  pad_w: int = 0, pad_h: int = 0, n_group: int = 1,
                  with_bias: bool = True, weight_init=None, bias_init=None,
+                 w_regularizer=None, b_regularizer=None,
                  name: Optional[str] = None):
         super().__init__(name)
         assert n_input_plane % n_group == 0 and n_output_plane % n_group == 0
+        # reference: wRegularizer/bRegularizer (nn/SpatialConvolution.scala)
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
         self.n_input = n_input_plane
         self.n_output = n_output_plane
         self.kernel = (kernel_h, kernel_w)

@@ -22,7 +22,7 @@ class LookupTable(Module):
 
     def __init__(self, n_index: int, n_output: int, padding_value: Optional[int] = None,
                  max_norm: Optional[float] = None, norm_type: float = 2.0,
-                 weight_init=None, name: Optional[str] = None):
+                 weight_init=None, w_regularizer=None, name: Optional[str] = None):
         super().__init__(name)
         self.n_index = n_index
         self.n_output = n_output
@@ -30,6 +30,7 @@ class LookupTable(Module):
         self.max_norm = max_norm
         self.norm_type = norm_type
         self.weight_init = weight_init or init_mod.RandomNormal(0.0, 1.0)
+        self.w_regularizer = w_regularizer  # reference: nn/LookupTable.scala
 
     def build(self, rng, input_shape):
         w = self.weight_init(rng, (self.n_index, self.n_output),

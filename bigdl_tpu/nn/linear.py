@@ -21,13 +21,19 @@ class Linear(Module):
     """y = x @ W + b.  reference: nn/Linear.scala:83-153."""
 
     def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
-                 weight_init=None, bias_init=None, name: Optional[str] = None):
+                 weight_init=None, bias_init=None,
+                 w_regularizer=None, b_regularizer=None,
+                 name: Optional[str] = None):
         super().__init__(name)
         self.input_size = input_size
         self.output_size = output_size
         self.with_bias = with_bias
         self.weight_init = weight_init or init_mod.Xavier()
         self.bias_init = bias_init or init_mod.Zeros()
+        # reference: wRegularizer/bRegularizer (nn/Linear.scala ctor),
+        # applied by the trainer via optim.regularizer.collect_regularizers
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
 
     def set_init_method(self, weight_init=None, bias_init=None) -> "Linear":
         if weight_init is not None:

@@ -167,6 +167,46 @@ class Module:
         self.training = True
         return self
 
+    # -- inference sugar over stored params (reference: the predict*/
+    # evaluate(rdd)/quantize convenience API on AbstractModule) -----------
+
+    def _predictor(self, x: Any, batch_size: int, mesh):
+        """Cached Predictor (a fresh one per call would re-jit every time);
+        invalidated when params/state/batch/mesh change identity."""
+        from bigdl_tpu.optim.predictor import Predictor  # avoid cycle
+
+        if self.params is None:
+            self.init(shape_of(x))
+        cached = getattr(self, "_predictor_cache", None)
+        key = (id(self.params), id(self.state), batch_size, id(mesh))
+        if cached is None or cached[0] != key:
+            self._predictor_cache = (key, Predictor(self, self.params,
+                                                    self.state, mesh=mesh,
+                                                    batch_size=batch_size))
+        return self._predictor_cache[1]
+
+    def predict(self, x: Any, batch_size: int = 32, mesh=None):
+        """Batched jitted inference (reference: AbstractModule.predict,
+        :636 — the RDD is just host arrays here)."""
+        return self._predictor(x, batch_size, mesh).predict(x)
+
+    def predict_class(self, x: Any, batch_size: int = 32, mesh=None):
+        """reference: AbstractModule.predictClass (:693)."""
+        return self._predictor(x, batch_size, mesh).predict_class(x)
+
+    def quantize(self) -> "Module":
+        """Int8 inference copy of this (trained) module; weights must be on
+        `.params`. reference: AbstractModule.quantize (:918)."""
+        from bigdl_tpu.nn.quantized import quantize as _quantize  # avoid cycle
+
+        if self.params is None:
+            raise ValueError("quantize() needs trained weights on .params "
+                             "(run init()/optimize() first)")
+        qm, qp = _quantize(self, self.params)
+        qm.params = qp
+        qm.state = self.state
+        return qm
+
     # ------------------------------------------------------------------
     # Graph-building sugar: calling a module on Node(s) records an edge
     # (reference: `layer.inputs(node)`, nn/Graph.scala:72)

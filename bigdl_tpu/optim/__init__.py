@@ -28,6 +28,8 @@ from bigdl_tpu.optim.parameter_processor import (
 from bigdl_tpu.optim.optimizer import (Optimizer, LocalOptimizer,
                                        DistriOptimizer, ParallelOptimizer)
 from bigdl_tpu.optim.profiling import layer_times, profiler_trace
+from bigdl_tpu.optim.regularizer import (L1L2Regularizer, L1Regularizer,
+                                         L2Regularizer, Regularizer)
 from bigdl_tpu.optim.predictor import (
     Predictor,
     LocalPredictor,

@@ -50,6 +50,7 @@ from bigdl_tpu.optim.parameter_processor import (
     L2NormClippingProcessor,
     ParameterProcessor,
 )
+from bigdl_tpu.optim.regularizer import apply_regularizers, collect_regularizers
 from bigdl_tpu.optim.schedules import Plateau
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
@@ -177,6 +178,7 @@ class Optimizer:
     def _build_step(self):
         model, criterion = self.model, self.criterion
         optim, processors = self.optim_method, list(self.processors)
+        regs = collect_regularizers(model)
 
         def train_step(params, model_state, opt_state, x, y, rng, lr):
             def loss_fn(p):
@@ -184,6 +186,9 @@ class Optimizer:
                 return criterion.forward(out, y), new_state
 
             (loss, new_model_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            # per-layer wRegularizer/bRegularizer contributions
+            # (reference: accGradParameters + optim/Regularizer.scala)
+            grads = apply_regularizers(grads, params, regs)
             for proc in processors:
                 grads = proc.process(grads)
             new_params, new_opt_state = optim.step(
@@ -455,6 +460,7 @@ class ParallelOptimizer(DistriOptimizer):
     def _build_step(self):
         model, criterion = self.model, self.criterion
         optim, processors = self.optim_method, list(self.processors)
+        regs = collect_regularizers(model)
         mesh = self.mesh
 
         def shard_step(params, model_state, opt_state, x, y, rng, lr):
@@ -472,6 +478,7 @@ class ParallelOptimizer(DistriOptimizer):
 
             (loss, new_model_state), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            grads = apply_regularizers(grads, params, regs)
             for proc in processors:
                 grads = proc.process(grads)
             new_params, new_opt_state = optim.step(

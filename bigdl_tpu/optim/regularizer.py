@@ -1,0 +1,105 @@
+"""Per-parameter weight regularization.
+
+Reference: optim/Regularizer.scala — `L1Regularizer`/`L2Regularizer`/
+`L1L2Regularizer` attached to individual layers as `wRegularizer`/
+`bRegularizer`; their contribution is added to the parameter's gradient
+inside `accGradParameters` (gradWeight += l2*w + l1*sign(w)).
+
+TPU design: layers store the regularizer objects (`w_regularizer`/
+`b_regularizer` kwargs); the Optimizer collects them with
+`collect_regularizers` (a walk mirroring the params tree) and adds
+`reg.grad(param)` to the matching gradient leaf inside the jitted train
+step — the same gradient-side semantics, fused by XLA into the update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax.numpy as jnp
+
+
+class Regularizer:
+    """reference: optim/Regularizer.scala (trait Regularizer)."""
+
+    l1: float = 0.0
+    l2: float = 0.0
+
+    def grad(self, p):
+        """d(penalty)/dp — what accGradParameters adds to the gradient."""
+        g = jnp.zeros_like(p)
+        if self.l1:
+            g = g + self.l1 * jnp.sign(p)
+        if self.l2:
+            g = g + self.l2 * p
+        return g
+
+    def penalty(self, p):
+        """The scalar loss term (for reporting; the trainer uses grad())."""
+        val = 0.0
+        if self.l1:
+            val = val + self.l1 * jnp.sum(jnp.abs(p))
+        if self.l2:
+            val = val + 0.5 * self.l2 * jnp.sum(jnp.square(p))
+        return val
+
+    def __repr__(self):
+        return f"{type(self).__name__}(l1={self.l1}, l2={self.l2})"
+
+
+class L1L2Regularizer(Regularizer):
+    def __init__(self, l1: float, l2: float):
+        self.l1 = float(l1)
+        self.l2 = float(l2)
+
+
+class L1Regularizer(L1L2Regularizer):
+    def __init__(self, l1: float):
+        super().__init__(l1, 0.0)
+
+
+class L2Regularizer(L1L2Regularizer):
+    def __init__(self, l2: float):
+        super().__init__(0.0, l2)
+
+
+_SLOTS = (("w_regularizer", "weight"), ("b_regularizer", "bias"))
+
+
+def collect_regularizers(model) -> List[Tuple[Tuple[str, ...], str, Regularizer]]:
+    """Walk the module tree (mirroring build()'s params keys) and return
+    [(path, param_key, regularizer)] for every attached regularizer."""
+    out: List[Tuple[Tuple[str, ...], str, Regularizer]] = []
+
+    def walk(m, path):
+        for attr, key in _SLOTS:
+            reg = getattr(m, attr, None)
+            if reg is not None:
+                out.append((path, key, reg))
+        children = getattr(m, "children", None)
+        if children:
+            for k, child in children.items():
+                walk(child, path + (k,))
+
+    walk(model, ())
+    return out
+
+
+def apply_regularizers(grads: Any, params: Any, regs) -> Any:
+    """grads[path][key] += reg.grad(params[path][key]) for each entry.
+    Missing paths/keys (e.g. with_bias=False) are skipped silently, like
+    the reference's null-gradWeight guards."""
+    for path, key, reg in regs:
+        g = grads
+        p = params
+        ok = True
+        for part in path:
+            if not (isinstance(g, dict) and part in g):
+                ok = False
+                break
+            g = g[part]
+            p = p[part]
+        if not ok or not isinstance(g, dict) or key not in g:
+            continue
+        g[key] = g[key] + reg.grad(p[key])
+    return grads

@@ -62,7 +62,8 @@ class IRGraph:
 
     def __init__(self, model: Module, params: Any, state: Any,
                  input_shape: Sequence[int], training: bool = False,
-                 engine: str = "fp32", rng: Optional[jax.Array] = None):
+                 engine: str = "fp32", rng: Optional[jax.Array] = None,
+                 input_dtype: Any = jnp.float32):
         if engine not in ENGINES:
             raise ValueError(f"engine must be one of {ENGINES}, got {engine!r}")
         self.model = model
@@ -71,6 +72,9 @@ class IRGraph:
         self.input_shape = tuple(input_shape)
         self.training = training
         self.engine = engine
+        # compiled executables are dtype-specialized: callers feeding bf16
+        # batches (the host pipeline's delivery dtype) must trace with bf16
+        self.input_dtype = input_dtype
         # stochastic layers (Dropout, samplers) need a key in training mode
         self.rng = rng if rng is not None or not training \
             else jax.random.PRNGKey(0)
@@ -80,8 +84,10 @@ class IRGraph:
     @staticmethod
     def trace(model: Module, params: Any, state: Any,
               input_shape: Sequence[int], training: bool = False,
-              rng: Optional[jax.Array] = None) -> "IRGraph":
-        return IRGraph(model, params, state, input_shape, training, rng=rng)
+              rng: Optional[jax.Array] = None,
+              input_dtype: Any = jnp.float32) -> "IRGraph":
+        return IRGraph(model, params, state, input_shape, training, rng=rng,
+                       input_dtype=input_dtype)
 
     # -- engine conversion (reference: IRConverter to Blas/Dnn) ----------
 
@@ -90,7 +96,8 @@ class IRGraph:
         analogue of IRToBlas/IRToDnn.  Params stay fp32 masters; under
         'bf16' the forward casts params+input to bf16 (MXU-native)."""
         return IRGraph(self.model, self.params, self.state, self.input_shape,
-                       self.training, engine, rng=self.rng)
+                       self.training, engine, rng=self.rng,
+                       input_dtype=self.input_dtype)
 
     def _fn(self) -> Callable:
         model, training, engine = self.model, self.training, self.engine
@@ -111,7 +118,7 @@ class IRGraph:
         return forward
 
     def _example_x(self):
-        return jnp.zeros(self.input_shape, jnp.float32)
+        return jnp.zeros(self.input_shape, self.input_dtype)
 
     # -- inspection / lowering -------------------------------------------
 

@@ -29,6 +29,8 @@ from bigdl_tpu.nn.criterion import Criterion
 from bigdl_tpu.nn.graph import Graph
 from bigdl_tpu.nn.init import InitializationMethod
 from bigdl_tpu.nn.module import Container, Module, Node
+from bigdl_tpu.optim.regularizer import (L1L2Regularizer, L1Regularizer,
+                                         L2Regularizer, Regularizer)
 
 SPEC_VERSION = 1
 
@@ -159,6 +161,9 @@ def encode_value(v: Any) -> Any:
     if isinstance(v, InitializationMethod):
         return {"__init_method__": type(v).__name__,
                 "state": {k: encode_value(x) for k, x in vars(v).items()}}
+    if isinstance(v, Regularizer):
+        return {"__regularizer__": {"class": type(v).__name__,
+                                    "l1": v.l1, "l2": v.l2}}
     if isinstance(v, (np.ndarray, jax.Array)):
         arr = np.asarray(v)
         return {"__array__": arr.tolist(), "dtype": str(arr.dtype)}
@@ -195,6 +200,12 @@ def decode_value(v: Any) -> Any:
         for k, x in v["state"].items():
             setattr(inst, k, decode_value(x))
         return inst
+    if "__regularizer__" in v:
+        r = v["__regularizer__"]
+        cls = {"L1Regularizer": lambda: L1Regularizer(r["l1"]),
+               "L2Regularizer": lambda: L2Regularizer(r["l2"])}.get(
+            r.get("class", ""))
+        return cls() if cls else L1L2Regularizer(r["l1"], r["l2"])
     if "__array__" in v:
         return jnp.asarray(np.array(v["__array__"], dtype=v["dtype"]))
     if "__fn__" in v:

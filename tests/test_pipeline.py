@@ -451,3 +451,102 @@ class TestLoggerFilter:
         finally:
             undo_redirect()
         assert logging.getLogger("some.noisy.lib").propagate
+
+
+class TestDataSetFactories:
+    """reference: DataSet.ImageFolder / SeqFileFolder (DataSet.scala:322-560)."""
+
+    def test_image_folder(self, tmp_path):
+        from PIL import Image
+
+        from bigdl_tpu.dataset import DataSet
+
+        rs = np.random.RandomState(0)
+        for cls in ("cats", "dogs"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(2):
+                Image.fromarray(rs.randint(0, 255, (8, 8, 3), dtype=np.uint8)
+                                ).save(d / f"{i}.png")
+        (tmp_path / "README.txt").write_text("not an image")
+        ds = DataSet.image_folder(str(tmp_path))
+        assert ds.size() == 4
+        samples = list(ds.data(train=False))
+        assert {int(s.label) for s in samples} == {0, 1}
+        assert samples[0].feature.shape == (8, 8, 3)
+
+    def test_record_shards_roundtrip(self, tmp_path):
+        from bigdl_tpu.dataset import DataSet, Sample
+        from bigdl_tpu.dataset.tfrecord import write_sample_shards
+
+        rs = np.random.RandomState(0)
+        samples = [Sample(rs.rand(3, 2).astype(np.float32), np.int32(i % 4))
+                   for i in range(20)]
+        write_sample_shards(samples, str(tmp_path), n_shards=4)
+        ds = DataSet.record_shards(str(tmp_path))
+        assert ds.size() == 20
+        got = sorted(int(s.label) for s in ds.data(train=False))
+        assert got == sorted(i % 4 for i in range(20))
+        # train epoch streams all records too (shard order shuffled)
+        assert sum(1 for _ in ds.data(train=True)) == 20
+
+    def test_record_shards_missing_dir(self, tmp_path):
+        from bigdl_tpu.dataset import DataSet
+
+        with pytest.raises(FileNotFoundError):
+            DataSet.record_shards(str(tmp_path / "nope"))
+
+
+class TestModuleSugar:
+    """reference: AbstractModule predict/predictClass/quantize convenience."""
+
+    def test_predict_and_class(self):
+        import bigdl_tpu.nn as nn
+
+        m = nn.Sequential(nn.Linear(4, 3), nn.LogSoftMax())
+        x = np.random.RandomState(0).rand(10, 4).astype(np.float32)
+        probs = m.predict(x, batch_size=4)
+        assert probs.shape == (10, 3)
+        cls = m.predict_class(x)
+        assert cls.shape == (10,)
+        assert (cls == np.argmax(probs, -1)).all()
+
+    def test_quantize_sugar(self):
+        import bigdl_tpu.nn as nn
+
+        m = nn.Sequential(nn.Linear(8, 4))
+        x = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+        y = m.predict(x)  # lazy-inits params
+        qm = m.quantize()
+        yq = qm.predict(x)
+        np.testing.assert_allclose(yq, y, atol=0.1)
+        with pytest.raises(ValueError, match="params"):
+            nn.Sequential(nn.Linear(3, 2)).quantize()
+
+
+def test_count_records_matches_stream(tmp_path):
+    from bigdl_tpu.dataset import Sample
+    from bigdl_tpu.dataset.tfrecord import count_records, write_sample_shards
+
+    rs = np.random.RandomState(0)
+    samples = [Sample(rs.rand(4).astype(np.float32), np.int32(i))
+               for i in range(13)]
+    paths = write_sample_shards(samples, str(tmp_path), n_shards=2)
+    assert sum(count_records(p) for p in paths) == 13
+
+
+def test_record_shards_skip_markers(tmp_path):
+    from bigdl_tpu.dataset import DataSet, Sample
+    from bigdl_tpu.dataset.tfrecord import write_sample_shards
+    import os, shutil
+
+    rs = np.random.RandomState(0)
+    samples = [Sample(rs.rand(4).astype(np.float32), np.int32(i)) for i in range(6)]
+    paths = write_sample_shards(samples, str(tmp_path), n_shards=2)
+    # non-.tfrecord names + hadoop-ish markers
+    for i, p in enumerate(paths):
+        shutil.move(p, os.path.join(str(tmp_path), f"part-{i:05d}"))
+    (tmp_path / "_SUCCESS").write_text("")
+    (tmp_path / "_metadata").mkdir()
+    ds = DataSet.record_shards(str(tmp_path))
+    assert ds.size() == 6
