@@ -46,34 +46,14 @@ class DataSet:
         return ArrayDataSet(list(data))
 
     @staticmethod
-    def image_folder(path: str, class_dirs: bool = True) -> "ArrayDataSet":
+    def image_folder(path: str, class_dirs: bool = True) -> "ImageFolderDataSet":
         """Directory of images -> Samples; with `class_dirs`, each
         subdirectory is a class (label = sorted subdir index, like the
         reference's ImageFolder local path, DataSet.scala:322-482).
-        Decoding uses PIL on the host (the reference used OpenCV)."""
-        import glob
-        import os
-
-        from PIL import Image
-
-        def decode(p):
-            with Image.open(p) as im:
-                return np.asarray(im.convert("RGB"), np.float32)
-
-        exts = (".png", ".jpg", ".jpeg", ".bmp")
-        samples = []
-        if class_dirs:
-            classes = sorted(d for d in os.listdir(path)
-                             if os.path.isdir(os.path.join(path, d)))
-            for label, cls in enumerate(classes):
-                for p in sorted(glob.glob(os.path.join(path, cls, "*"))):
-                    if p.lower().endswith(exts):
-                        samples.append(Sample(decode(p), np.int32(label)))
-        else:
-            for p in sorted(glob.glob(os.path.join(path, "*"))):
-                if p.lower().endswith(exts):
-                    samples.append(Sample(decode(p)))
-        return ArrayDataSet(samples)
+        Only PATHS are listed up front; decoding (PIL on the host — the
+        reference used OpenCV) streams lazily per epoch, so an
+        ImageNet-scale folder never resides in memory at once."""
+        return ImageFolderDataSet(path, class_dirs)
 
     @staticmethod
     def record_shards(dir_path: str, n_threads: int = 4) -> "RecordShardDataSet":
@@ -107,6 +87,46 @@ class ArrayDataSet(DataSet):
 
 
 LocalDataSet = ArrayDataSet
+
+
+class ImageFolderDataSet(DataSet):
+    """Lazily-decoded image-tree dataset (see DataSet.image_folder)."""
+
+    EXTS = (".png", ".jpg", ".jpeg", ".bmp")
+
+    def __init__(self, path: str, class_dirs: bool = True):
+        import glob
+        import os
+
+        self.entries = []  # (path, label-or-None)
+        if class_dirs:
+            classes = sorted(d for d in os.listdir(path)
+                             if os.path.isdir(os.path.join(path, d)))
+            for label, cls in enumerate(classes):
+                for p in sorted(glob.glob(os.path.join(path, cls, "*"))):
+                    if p.lower().endswith(self.EXTS):
+                        self.entries.append((p, label))
+        else:
+            for p in sorted(glob.glob(os.path.join(path, "*"))):
+                if p.lower().endswith(self.EXTS):
+                    self.entries.append((p, None))
+        self._epoch = 0
+
+    def size(self) -> int:
+        return len(self.entries)
+
+    def data(self, train: bool) -> Iterator[Any]:
+        from PIL import Image
+
+        entries = list(self.entries)
+        if train:
+            rs = np.random.RandomState(RandomGenerator.get_seed() + self._epoch)
+            rs.shuffle(entries)
+            self._epoch += 1
+        for p, label in entries:
+            with Image.open(p) as im:
+                arr = np.asarray(im.convert("RGB"), np.float32)
+            yield Sample(arr, None if label is None else np.int32(label))
 
 
 class RecordShardDataSet(DataSet):

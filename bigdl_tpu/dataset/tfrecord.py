@@ -55,8 +55,10 @@ def iter_framed(fh, what: str = "record") -> Iterator[bytes]:
 
 def count_records(path: str) -> int:
     """Count frames by seeking over payloads (length header + skip) —
-    no decode, no checksum; cheap size() for shard folders."""
+    no decode, no checksum; cheap size() for shard folders.  Truncation
+    raises like iter_framed does, so size() and the actual stream agree."""
     n = 0
+    end = os.path.getsize(path)
     with open(path, "rb") as fh:
         while True:
             header = fh.read(12)
@@ -65,6 +67,8 @@ def count_records(path: str) -> int:
             if len(header) != 12:
                 raise IOError(f"truncated record header in {path}")
             (length,) = struct.unpack("<Q", header[:8])
+            if fh.tell() + length + 4 > end:
+                raise IOError(f"truncated record body in {path}")
             fh.seek(length + 4, 1)  # payload + data crc
             n += 1
 

@@ -31,6 +31,7 @@ class TransformerLM(Module):
                  rope: bool = True, tie_embeddings: bool = True,
                  seq_parallel: Optional[str] = None, scan_layers: bool = True,
                  remat: bool = False, use_flash: bool = False,
+                 moe_experts: int = 0, moe_k: int = 1,
                  name: Optional[str] = None):
         super().__init__(name)
         self.vocab_size = vocab_size
@@ -47,7 +48,8 @@ class TransformerLM(Module):
         self.block = TransformerBlock(hidden_size, n_head, causal=True,
                                       dropout=dropout, rope=rope,
                                       seq_parallel=seq_parallel,
-                                      use_flash=use_flash)
+                                      use_flash=use_flash,
+                                      moe_experts=moe_experts, moe_k=moe_k)
         self.ln_f = LayerNormalization(hidden_size)
 
     def build(self, rng, input_shape):

@@ -120,6 +120,7 @@ from bigdl_tpu.nn.attention import (
     TransformerBlock,
     apply_rope,
 )
+from bigdl_tpu.nn.moe import MoE
 from bigdl_tpu.nn.quantized import (
     QuantizedLinear,
     QuantizedSpatialConvolution,

@@ -153,6 +153,7 @@ class TransformerBlock(Container):
     def __init__(self, hidden_size: int, n_head: int, *, causal: bool = True,
                  mlp_ratio: int = 4, dropout: float = 0.0, rope: bool = False,
                  seq_parallel: Optional[str] = None, use_flash: bool = False,
+                 moe_experts: int = 0, moe_k: int = 1,
                  name: Optional[str] = None):
         super().__init__(name)
         self.hidden_size = hidden_size
@@ -161,7 +162,15 @@ class TransformerBlock(Container):
             hidden_size, n_head, causal=causal, dropout=dropout, rope=rope,
             seq_parallel=seq_parallel, use_flash=use_flash)
         self.children["ln2"] = LayerNormalization(hidden_size)
-        self.children["mlp"] = _Mlp(hidden_size, mlp_ratio * hidden_size, dropout)
+        if moe_experts > 0:
+            # expert-parallel MLP (shard its stacked params over 'expert')
+            from bigdl_tpu.nn.moe import MoE
+
+            self.children["mlp"] = MoE(hidden_size, moe_experts, k=moe_k,
+                                       mlp_ratio=mlp_ratio, dropout=dropout)
+        else:
+            self.children["mlp"] = _Mlp(hidden_size, mlp_ratio * hidden_size,
+                                        dropout)
 
     def build(self, rng, input_shape):
         params, state = {}, {}

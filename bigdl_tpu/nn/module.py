@@ -177,13 +177,16 @@ class Module:
 
         if self.params is None:
             self.init(shape_of(x))
+        # strong refs in the key: `is` checks on live objects, never ids
+        # (a freed dict's id can be reused, which would serve stale weights)
         cached = getattr(self, "_predictor_cache", None)
-        key = (id(self.params), id(self.state), batch_size, id(mesh))
-        if cached is None or cached[0] != key:
-            self._predictor_cache = (key, Predictor(self, self.params,
-                                                    self.state, mesh=mesh,
-                                                    batch_size=batch_size))
-        return self._predictor_cache[1]
+        if (cached is None or cached[0] is not self.params
+                or cached[1] is not self.state or cached[2] != batch_size
+                or cached[3] is not mesh):
+            self._predictor_cache = (self.params, self.state, batch_size, mesh,
+                                     Predictor(self, self.params, self.state,
+                                               mesh=mesh, batch_size=batch_size))
+        return self._predictor_cache[4]
 
     def predict(self, x: Any, batch_size: int = 32, mesh=None):
         """Batched jitted inference (reference: AbstractModule.predict,

@@ -1,0 +1,165 @@
+"""Mixture-of-Experts MLP with expert parallelism.
+
+No reference counterpart — survey §2.10 records expert parallelism as
+absent from BigDL; this is beyond-reference TPU capability (the `expert`
+mesh axis declared in core/engine.py).
+
+Design (Switch/top-k routing, fixed capacity — every shape is static so
+the whole layer jits):
+  * experts are STACKED on a leading E dimension (fc1 (E, D, H), ...);
+    sharding them with `P('expert', ...)` over the mesh's expert axis
+    makes XLA insert the dispatch/return all-to-alls — no hand-written
+    collectives (vs the NCCL alltoall an MoE framework hand-codes);
+  * routing is dense one-hot einsum dispatch (Switch-Transformer style):
+    tokens over capacity are DROPPED (residual passes them through),
+    keeping shapes static for jit;
+  * the load-balance auxiliary loss enters training through the same
+    custom_vjp identity the penalty layers use (nn/structural.py) — the
+    trainer needs no side-loss plumbing.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.module import Module
+
+
+@jax.custom_vjp
+def _aux_identity(probs, penalty_grad):
+    """Identity on probs whose backward adds `penalty_grad` to the
+    cotangent.  The penalty gradient is an explicit ARGUMENT (not a python
+    closure) so the custom_vjp stays valid inside scan/jit traces."""
+    return probs
+
+
+def _aux_fwd(probs, penalty_grad):
+    return probs, penalty_grad
+
+
+def _aux_bwd(penalty_grad, g):
+    return (g + penalty_grad, None)
+
+
+_aux_identity.defvjp(_aux_fwd, _aux_bwd)
+
+
+class MoE(Module):
+    """Top-k routed expert MLP over (..., D) activations.
+
+    Args: hidden_size D, n_expert E, k (experts per token, 1=Switch),
+    mlp_ratio (expert hidden width H = ratio*D), capacity_factor (slots per
+    expert = ceil(k*T/E * factor)), aux_weight (load-balance loss scale).
+    """
+
+    def __init__(self, hidden_size: int, n_expert: int, k: int = 1,
+                 mlp_ratio: int = 4, capacity_factor: float = 1.25,
+                 aux_weight: float = 1e-2, dropout: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        assert 1 <= k <= n_expert
+        self.hidden_size = hidden_size
+        self.n_expert = n_expert
+        self.k = k
+        self.mlp_hidden = mlp_ratio * hidden_size
+        self.capacity_factor = capacity_factor
+        self.aux_weight = aux_weight
+        self.dropout = dropout
+
+    def build(self, rng, input_shape):
+        d, h, e = self.hidden_size, self.mlp_hidden, self.n_expert
+        ks = jax.random.split(rng, 3)
+        xavier = init_mod.Xavier()
+        params = {
+            "router": {"weight": xavier(ks[0], (d, e), d, e)},
+            "experts": {
+                "fc1_w": xavier(ks[1], (e, d, h), d, h),
+                "fc1_b": jnp.zeros((e, h), jnp.float32),
+                "fc2_w": xavier(ks[2], (e, h, d), h, d),
+                "fc2_b": jnp.zeros((e, d), jnp.float32),
+            },
+        }
+        return params, {}, input_shape
+
+    def capacity(self, n_tokens: int) -> int:
+        return max(1, int(math.ceil(
+            self.k * n_tokens / self.n_expert * self.capacity_factor)))
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        d, e, k = self.hidden_size, self.n_expert, self.k
+        lead = x.shape[:-1]
+        t = 1
+        for s in lead:
+            t *= int(s)
+        xt = x.reshape(t, d)
+        cap = self.capacity(t)
+
+        logits = (xt @ params["router"]["weight"].astype(xt.dtype))
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # (T,E)
+
+        # top-k choice.  k=1 gates by the RAW router probability (Switch
+        # semantics: y = p_i(x) * E_i(x)) — renormalizing would make the
+        # gate identically 1.0 and starve the router of task-loss gradient;
+        # k>=2 renormalizes over the chosen k (top-2 semantics), where the
+        # relative weights still carry gradient.
+        top_vals, top_idx = jax.lax.top_k(probs, k)           # (T,k)
+        if k > 1:
+            top_vals = top_vals / jnp.maximum(
+                jnp.sum(top_vals, -1, keepdims=True), 1e-9)
+
+        # slot-priority position assignment: slot 0 of every token wins
+        # capacity before slot 1 (standard Switch/top-2 semantics)
+        onehots = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # (T,k,E)
+        flat = jnp.swapaxes(onehots, 0, 1).reshape(k * t, e)     # slot-major
+        pos_flat = jnp.cumsum(flat, axis=0) * flat - 1.0         # (k*T,E)
+        pos = jnp.swapaxes(pos_flat.reshape(k, t, e), 0, 1)      # (T,k,E)
+        keep = (pos >= 0) & (pos < cap)
+        slot = jax.nn.one_hot(
+            jnp.sum(pos * onehots, -1).astype(jnp.int32), cap,
+            dtype=jnp.float32)                                   # (T,k,C)
+        kept = jnp.any(keep & (onehots > 0), axis=-1)            # (T,k)
+
+        # dispatch (T,E,C) and combine (T,E,C)
+        dispatch = jnp.einsum("tke,tkc->tec", onehots,
+                              slot * kept[..., None])
+        combine = jnp.einsum("tke,tkc->tec", onehots,
+                             slot * (kept * top_vals)[..., None])
+
+        if training and self.aux_weight > 0.0:
+            # Switch load-balance loss: E * sum_e(frac_dispatched_e * P_e);
+            # frac is stop-grad (argmax path), gradient flows via probs
+            frac = jax.lax.stop_gradient(
+                jnp.mean(jnp.sum(dispatch, axis=-1), axis=0))    # (E,)
+            w = self.aux_weight * e / t
+            # d(aux)/d(probs) with aux = w*T*sum_e(frac_e * mean_t probs)
+            probs = _aux_identity(probs,
+                                  jnp.broadcast_to(w * frac, probs.shape))
+            # re-derive combine from the penalized probs so the vjp engages
+            top_vals2 = jnp.take_along_axis(probs, top_idx, axis=-1)
+            if k > 1:
+                top_vals2 = top_vals2 / jnp.maximum(
+                    jnp.sum(top_vals2, -1, keepdims=True), 1e-9)
+            combine = jnp.einsum("tke,tkc->tec", onehots,
+                                 slot * (kept * top_vals2)[..., None])
+
+        w1 = params["experts"]["fc1_w"].astype(x.dtype)
+        b1 = params["experts"]["fc1_b"].astype(x.dtype)
+        w2 = params["experts"]["fc2_w"].astype(x.dtype)
+        b2 = params["experts"]["fc2_b"].astype(x.dtype)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), xt)
+        h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", expert_in, w1)
+                        + b1[:, None, :])
+        if training and self.dropout > 0.0 and rng is not None:
+            mask = jax.random.bernoulli(rng, 1.0 - self.dropout, h.shape)
+            h = h * mask.astype(h.dtype) / (1.0 - self.dropout)
+        expert_out = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+        y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+        return y.reshape(x.shape), state
+
+    def output_shape(self, input_shape):
+        return input_shape

@@ -14,9 +14,12 @@ step — the same gradient-side semantics, fused by XLA into the update.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, List, Tuple
 
 import jax.numpy as jnp
+
+logger = logging.getLogger("bigdl_tpu.optim")
 
 
 class Regularizer:
@@ -68,10 +71,18 @@ _SLOTS = (("w_regularizer", "weight"), ("b_regularizer", "bias"))
 
 def collect_regularizers(model) -> List[Tuple[Tuple[str, ...], str, Regularizer]]:
     """Walk the module tree (mirroring build()'s params keys) and return
-    [(path, param_key, regularizer)] for every attached regularizer."""
+    [(path, param_key, regularizer)] for every attached regularizer.
+
+    Only `children`-held submodules map onto param paths the trainer can
+    address; a regularizer on an attribute-held submodule (e.g. a custom
+    Module keeping `self.fc = Linear(...)` outside `children`) would be
+    silently inert, so it is reported loudly instead.
+    """
     out: List[Tuple[Tuple[str, ...], str, Regularizer]] = []
+    covered = set()
 
     def walk(m, path):
+        covered.add(id(m))
         for attr, key in _SLOTS:
             reg = getattr(m, attr, None)
             if reg is not None:
@@ -82,13 +93,39 @@ def collect_regularizers(model) -> List[Tuple[Tuple[str, ...], str, Regularizer]
                 walk(child, path + (k,))
 
     walk(model, ())
+
+    # second pass: find attribute-held submodules the children walk cannot
+    # reach, and warn if they carry regularizers (which would be inert)
+    def scan_attrs(m, seen):
+        if id(m) in seen:
+            return
+        seen.add(id(m))
+        for v in list(vars(m).values()):
+            vals = v if isinstance(v, (list, tuple)) else \
+                (list(v.values()) if isinstance(v, dict) else [v])
+            for item in vals:
+                if not hasattr(item, "apply") or not hasattr(item, "build"):
+                    continue  # not a Module
+                if id(item) not in covered:
+                    for attr, _ in _SLOTS:
+                        if getattr(item, attr, None) is not None:
+                            logger.warning(
+                                "%s on %r is unreachable through the children "
+                                "tree and will NOT be applied (hold the layer "
+                                "in a container, not as a plain attribute)",
+                                attr, item.name)
+                scan_attrs(item, seen)
+
+    scan_attrs(model, set())
     return out
 
 
 def apply_regularizers(grads: Any, params: Any, regs) -> Any:
     """grads[path][key] += reg.grad(params[path][key]) for each entry.
-    Missing paths/keys (e.g. with_bias=False) are skipped silently, like
-    the reference's null-gradWeight guards."""
+    A missing param KEY (e.g. with_bias=False dropping 'bias') is fine —
+    the reference's null-gradWeight guard; a missing PATH means the module
+    tree and params tree disagree (e.g. scan-stacked layers renaming keys)
+    and is reported, since the regularizer would silently not apply."""
     for path, key, reg in regs:
         g = grads
         p = params
@@ -99,7 +136,11 @@ def apply_regularizers(grads: Any, params: Any, regs) -> Any:
                 break
             g = g[part]
             p = p[part]
-        if not ok or not isinstance(g, dict) or key not in g:
+        if not ok:
+            logger.warning("regularizer path %s not found in params tree; "
+                           "not applied", "/".join(path))
             continue
+        if not isinstance(g, dict) or key not in g:
+            continue  # e.g. with_bias=False
         g[key] = g[key] + reg.grad(p[key])
     return grads

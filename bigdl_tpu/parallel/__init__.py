@@ -1,5 +1,7 @@
 from bigdl_tpu.parallel.sharding import (
     ShardingRules, shard_params, batch_sharding, replicate,
 )
+from bigdl_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 
-__all__ = ["ShardingRules", "shard_params", "batch_sharding", "replicate"]
+__all__ = ["ShardingRules", "shard_params", "batch_sharding", "replicate",
+           "pipeline_apply", "stack_stage_params"]

@@ -1,0 +1,107 @@
+"""GPipe-style pipeline parallelism over the `pipeline` mesh axis.
+
+No reference counterpart — survey §2.10 records pipeline parallelism as
+absent from BigDL; this is beyond-reference TPU capability for models too
+large for one chip's HBM.
+
+Design (the scaling-book recipe): layer stages are STACKED on a leading
+dim sharded `P('pipeline')`, so under `shard_map` each device holds one
+stage's parameters.  The batch is split into M microbatches; the schedule
+runs M + S - 1 ticks of a `lax.scan`, each tick computing every stage on
+its in-flight microbatch and `ppermute`-ing activations one stage forward
+(the bubble is the standard (S-1)/(M+S-1) fraction).  Autodiff through
+the scan + ppermute yields the backward pipeline automatically — no
+hand-written 1F1B schedule; wrap the stage in `jax.checkpoint` (remat=True)
+to keep activation memory at one-microbatch-per-tick.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.core.engine import AXIS_PIPELINE
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                   stage_params: Any, x: jnp.ndarray, n_microbatch: int,
+                   axis_name: str = AXIS_PIPELINE,
+                   remat: bool = False) -> jnp.ndarray:
+    """Run `stage_fn` as a pipeline over `axis_name`.  MUST be called
+    inside `shard_map` with `stage_params` carrying a leading
+    stage-stacked dim of size 1 per device (sharded `P(axis_name)`) and
+    `x` the full (replicated) batch whose leading dim splits into
+    `n_microbatch` equal microbatches.  Returns the pipeline output,
+    replicated to every stage.
+    """
+    n_stage = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        assert leaf.shape[0] == 1, (
+            f"stage_params' local stacked dim is {leaf.shape[0]}, expected 1 "
+            f"per device — shard the stacked stage dim P({axis_name!r}) with "
+            f"exactly one stage per pipeline-axis device")
+    my_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+
+    b = x.shape[0]
+    assert b % n_microbatch == 0, (b, n_microbatch)
+    mb = b // n_microbatch
+    micro = x.reshape((n_microbatch, mb) + x.shape[1:])
+
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    # activation shape probe (stages must be shape-preserving so the relay
+    # buffer has one static shape; true of transformer blocks)
+    out_struct = jax.eval_shape(fn, my_params, jax.ShapeDtypeStruct(
+        micro.shape[1:], micro.dtype))
+    assert out_struct.shape == micro.shape[1:], (
+        f"pipeline stages must preserve activation shape, got "
+        f"{out_struct.shape} vs {micro.shape[1:]}")
+
+    fwd_perm = [(i, i + 1) for i in range(n_stage - 1)]
+    n_tick = n_microbatch + n_stage - 1
+
+    def tick(carry, t):
+        relay, outputs = carry
+        # stage 0 injects microbatch t (clamped; masked later), others take
+        # the relayed activation from the previous stage
+        feed = micro[jnp.minimum(t, n_microbatch - 1)]
+        inp = jnp.where(idx == 0, feed, relay)
+        out = fn(my_params, inp)
+        # the LAST stage finished microbatch t - (S-1) this tick
+        done = t - (n_stage - 1)
+        outputs = jnp.where(
+            (idx == n_stage - 1) & (done >= 0),
+            lax.dynamic_update_index_in_dim(
+                outputs, out, jnp.maximum(done, 0), axis=0),
+            outputs)
+        relay = lax.ppermute(out, axis_name, fwd_perm)
+        return (relay, outputs), None
+
+    # zeros_like(micro) inherits micro's varying axes (e.g. a data axis the
+    # batch is sharded over); the body's outputs additionally vary over the
+    # pipeline axis (they depend on axis_index), so cast that in too or the
+    # scan carry types won't match
+    relay0 = jnp.zeros_like(micro[0])
+    outputs0 = jnp.zeros_like(micro)
+    pcast = getattr(lax, "pcast", None)
+    if pcast is not None:
+        relay0 = pcast(relay0, (axis_name,), to="varying")
+        outputs0 = pcast(outputs0, (axis_name,), to="varying")
+    (_, outputs), _ = lax.scan(tick, (relay0, outputs0), jnp.arange(n_tick))
+
+    # broadcast the last stage's collected outputs to every stage
+    outputs = lax.psum(
+        jnp.where(idx == n_stage - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name)
+    return outputs.reshape((b,) + x.shape[1:])
+
+
+def stack_stage_params(per_stage_params: list) -> Any:
+    """Stack a list of per-stage param trees on a new leading dim (shard it
+    `P('pipeline')`); the inverse of what each device's `tree_map(a[0])`
+    sees inside pipeline_apply."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage_params)

@@ -1,0 +1,147 @@
+"""Pipeline-parallelism tests (beyond-reference: survey §2.10 records PP
+absent in BigDL; the `pipeline` mesh axis implements GPipe-style stages)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.engine import AXIS_DATA, AXIS_PIPELINE, Engine
+from bigdl_tpu.parallel import pipeline_apply, stack_stage_params
+
+N_STAGE = 4
+D = 6
+
+
+def _stages(seed=0):
+    rs = np.random.RandomState(seed)
+    per_stage = [{"w": jnp.asarray(rs.randn(D, D).astype(np.float32) * 0.5),
+                  "b": jnp.asarray(rs.randn(D).astype(np.float32) * 0.1)}
+                 for _ in range(N_STAGE)]
+    return per_stage, stack_stage_params(per_stage)
+
+
+def stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def sequential_ref(per_stage, x):
+    for p in per_stage:
+        x = stage_fn(p, x)
+    return x
+
+
+class TestPipelineApply:
+    def test_matches_sequential(self):
+        per_stage, stacked = _stages()
+        mesh = Engine.build_mesh(devices=jax.devices()[:N_STAGE],
+                                 **{AXIS_PIPELINE: N_STAGE})
+        x = jnp.asarray(np.random.RandomState(1).rand(8, D), jnp.float32)
+
+        fn = jax.jit(jax.shard_map(
+            lambda p, x: pipeline_apply(stage_fn, p, x, n_microbatch=4),
+            mesh=mesh, in_specs=(P(AXIS_PIPELINE), P()), out_specs=P()))
+        y = fn(stacked, x)
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(sequential_ref(per_stage, x)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_microbatch_count_variants(self):
+        per_stage, stacked = _stages(seed=2)
+        mesh = Engine.build_mesh(devices=jax.devices()[:N_STAGE],
+                                 **{AXIS_PIPELINE: N_STAGE})
+        x = jnp.asarray(np.random.RandomState(2).rand(12, D), jnp.float32)
+        want = np.asarray(sequential_ref(per_stage, x))
+        for m in (1, 2, 3, 6, 12):
+            fn = jax.jit(jax.shard_map(
+                lambda p, x, m=m: pipeline_apply(stage_fn, p, x, n_microbatch=m),
+                mesh=mesh, in_specs=(P(AXIS_PIPELINE), P()), out_specs=P()))
+            np.testing.assert_allclose(np.asarray(fn(stacked, x)), want,
+                                       rtol=1e-5, atol=1e-5,
+                                       err_msg=f"n_microbatch={m}")
+
+    def test_gradients_match_sequential(self):
+        per_stage, stacked = _stages(seed=3)
+        mesh = Engine.build_mesh(devices=jax.devices()[:N_STAGE],
+                                 **{AXIS_PIPELINE: N_STAGE})
+        x = jnp.asarray(np.random.RandomState(3).rand(8, D), jnp.float32)
+        y_t = jnp.asarray(np.random.RandomState(4).rand(8, D), jnp.float32)
+
+        def piped_loss(stacked):
+            fn = jax.shard_map(
+                lambda p, x: pipeline_apply(stage_fn, p, x, n_microbatch=4,
+                                            remat=True),
+                mesh=mesh, in_specs=(P(AXIS_PIPELINE), P()), out_specs=P())
+            return jnp.mean((fn(stacked, x) - y_t) ** 2)
+
+        def seq_loss(per_stage):
+            return jnp.mean((sequential_ref(per_stage, x) - y_t) ** 2)
+
+        g_pipe = jax.jit(jax.grad(piped_loss))(stacked)
+        g_seq = jax.grad(seq_loss)(per_stage)
+        for i in range(N_STAGE):
+            np.testing.assert_allclose(np.asarray(g_pipe["w"][i]),
+                                       np.asarray(g_seq[i]["w"]),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_dp_pp_combined(self):
+        """data x pipeline mesh: batch sharded over data, stages over
+        pipeline — the full 2-D layout in one jitted step."""
+        per_stage, stacked = _stages(seed=5)
+        mesh = Engine.build_mesh(devices=jax.devices(),
+                                 **{AXIS_DATA: 2, AXIS_PIPELINE: N_STAGE})
+        x = jnp.asarray(np.random.RandomState(5).rand(16, D), jnp.float32)
+
+        fn = jax.jit(jax.shard_map(
+            lambda p, x: pipeline_apply(stage_fn, p, x, n_microbatch=4),
+            mesh=mesh, in_specs=(P(AXIS_PIPELINE), P(AXIS_DATA)),
+            out_specs=P(AXIS_DATA)))
+        y = fn(stacked, jax.device_put(x, NamedSharding(mesh, P(AXIS_DATA))))
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(sequential_ref(per_stage, x)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_rejects_shape_changing_stage(self):
+        _, stacked = _stages()
+        mesh = Engine.build_mesh(devices=jax.devices()[:N_STAGE],
+                                 **{AXIS_PIPELINE: N_STAGE})
+        x = jnp.ones((8, D))
+        bad = lambda p, x: jnp.concatenate([x, x], axis=-1)
+        with pytest.raises(AssertionError, match="preserve"):
+            jax.shard_map(
+                lambda p, x: pipeline_apply(bad, p, x, n_microbatch=4),
+                mesh=mesh, in_specs=(P(AXIS_PIPELINE), P()), out_specs=P())(
+                stacked, x)
+
+
+class TestPipelinedTransformer:
+    def test_transformer_blocks_as_stages(self):
+        """Two transformer blocks per stage-device: pipeline the block stack
+        and match the sequential forward."""
+        from bigdl_tpu.nn.attention import TransformerBlock
+
+        d, heads = 16, 4
+        block = TransformerBlock(d, heads, causal=True)
+        per_stage = []
+        for i in range(N_STAGE):
+            p, _, _ = block.build(jax.random.PRNGKey(i), (4, 8, d))
+            per_stage.append(p)
+        stacked = stack_stage_params(per_stage)
+        mesh = Engine.build_mesh(devices=jax.devices()[:N_STAGE],
+                                 **{AXIS_PIPELINE: N_STAGE})
+        x = jnp.asarray(np.random.RandomState(0).rand(4, 8, d), jnp.float32)
+
+        def stage(p, h):
+            return block.apply(p, {}, h, training=False)[0]
+
+        fn = jax.jit(jax.shard_map(
+            lambda p, x: pipeline_apply(stage, p, x, n_microbatch=2),
+            mesh=mesh, in_specs=(P(AXIS_PIPELINE), P()), out_specs=P()))
+        y = fn(stacked, x)
+        want = x
+        for p in per_stage:
+            want = block.apply(p, {}, want, training=False)[0]
+        np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)

@@ -148,6 +148,8 @@ EXEMPLARS = {
                            lambda: rand(2, 5, 8)),
     "TransformerBlock": (lambda: nn.TransformerBlock(8, 2),
                          lambda: rand(2, 5, 8)),
+    "MoE": (lambda: nn.MoE(8, 4, k=2, mlp_ratio=2),
+            lambda: rand(2, 5, 8)),
     "TransformerLM": (lambda: _transformer_lm(),
                       lambda: jnp.asarray(
                           np.random.RandomState(3).randint(0, 20, (2, 6)))),
