@@ -1,5 +1,9 @@
 from bigdl_tpu.core.engine import Engine
 from bigdl_tpu.core.random import RandomGenerator
 from bigdl_tpu.core.table import Table, T
+from bigdl_tpu.core.debug import (assert_finite, enable_inf_checks,
+                                  enable_nan_checks, tap_finite)
 
-__all__ = ["Engine", "RandomGenerator", "Table", "T"]
+__all__ = ["Engine", "RandomGenerator", "Table", "T",
+           "assert_finite", "enable_inf_checks", "enable_nan_checks",
+           "tap_finite"]

@@ -56,6 +56,24 @@ class EngineConfig:
     log_level: str = "INFO"
     # Seed for the global RandomGenerator (utils/RandomGenerator.scala:50-56).
     seed: int = 1
+    # Default mesh layout, e.g. "data=8,model=2" (all devices on the data
+    # axis when unset); the launcher's --mesh flag exports this.
+    mesh_spec: Optional[str] = None
+
+    def parse_mesh(self) -> Optional[dict]:
+        if not self.mesh_spec:
+            return None
+        out = {}
+        for part in self.mesh_spec.split(","):
+            axis, sep, n = part.partition("=")
+            axis = axis.strip()
+            if not sep or not axis or not n.strip().isdigit():
+                raise ValueError(
+                    f"bad mesh spec {self.mesh_spec!r} (BIGDL_TPU_MESH / "
+                    f"--mesh): expected 'axis=N[,axis=N...]', e.g. "
+                    f"'data=8,model=2'; offending part: {part!r}")
+            out[axis] = int(n)
+        return out
 
     @staticmethod
     def from_env() -> "EngineConfig":
@@ -66,6 +84,7 @@ class EngineConfig:
             failure_retry_interval_s=_env_int("FAILURE_RETRY_INTERVAL_S", 120),
             log_level=_env("LOG_LEVEL", "INFO"),
             seed=_env_int("SEED", 1),
+            mesh_spec=os.environ.get(_PREFIX + "MESH"),
         )
         if _PREFIX + "COORDINATOR_ADDRESS" in os.environ:
             cfg.coordinator_address = os.environ[_PREFIX + "COORDINATOR_ADDRESS"]

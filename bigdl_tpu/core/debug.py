@@ -1,0 +1,90 @@
+"""Numerics debugging switches.
+
+Reference (survey §5.2): BigDL has NO race detection or sanitizers —
+concurrency safety is by convention, and the survey's rebuild note is that
+JAX's functional purity removes that bug class, with jax's nan/inf debug
+checks as the analogue.  This module is that analogue: one switch for the
+trace-level nan/inf checks plus an eager tree assertion for debugging.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def enable_nan_checks(enable: bool = True) -> None:
+    """Re-run jitted computations de-optimized when a NaN appears and point
+    at the producing primitive (jax_debug_nans)."""
+    jax.config.update("jax_debug_nans", enable)
+
+
+def enable_inf_checks(enable: bool = True) -> None:
+    jax.config.update("jax_debug_infs", enable)
+
+
+def assert_finite(tree: Any, name: str = "tree") -> None:
+    """Host-side check that every leaf of a pytree is finite; raises
+    FloatingPointError naming the offending path (eager debugging aid for
+    params/grads between steps)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        arr = np.asarray(leaf)
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        if not np.isfinite(arr).all():
+            keys = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                            for p in path)
+            n_bad = int((~np.isfinite(arr)).sum())
+            raise FloatingPointError(
+                f"{name}/{keys}: {n_bad} non-finite value(s) "
+                f"(shape {arr.shape})")
+
+
+_callbacks_ok: bool = None  # probed lazily; some backends (tunneled TPU
+# PJRT plugins) don't implement host send/recv callbacks
+
+
+def _callbacks_supported() -> bool:
+    global _callbacks_ok
+    if _callbacks_ok is None:
+        import threading
+
+        # tap_finite is typically called while TRACING a jit function;
+        # jit-under-trace inlines, so the probe must run with clean trace
+        # state — trace state is thread-local, so probe on a fresh thread.
+        def probe():
+            global _callbacks_ok
+            try:
+                y = jax.jit(
+                    lambda a: jax.debug.callback(lambda v: None, a) or a)(
+                    jnp.zeros(()))
+                float(np.asarray(y))  # host readback: surfaces async errors
+                _callbacks_ok = True
+            except Exception:
+                _callbacks_ok = False
+
+        t = threading.Thread(target=probe)
+        t.start()
+        t.join()
+    return bool(_callbacks_ok)
+
+
+def tap_finite(x: jnp.ndarray, name: str = "value") -> jnp.ndarray:
+    """Identity usable INSIDE jit that host-prints a warning when the
+    tensor contains non-finite values (jax.debug.callback — does not
+    sync).  Degrades to a plain identity on backends without host
+    callbacks (e.g. tunneled TPU plugins)."""
+    if not _callbacks_supported():
+        return x
+
+    def cb(ok, count):
+        if not ok:
+            print(f"[bigdl_tpu.debug] {name}: {int(count)} non-finite value(s)")
+
+    finite = jnp.isfinite(x)
+    jax.debug.callback(cb, jnp.all(finite), jnp.sum(~finite))
+    return x

@@ -82,7 +82,7 @@ class Engine:
                     process_id=cfg.process_id,
                 )
             cls._config = cfg
-            cls._mesh = cls._build_mesh(mesh_shape)
+            cls._mesh = cls._build_mesh(mesh_shape or cfg.parse_mesh())
             cls._initialized = True
             logger.info(
                 "Engine initialized: %d device(s) on platform %s, mesh %s",

@@ -1,0 +1,63 @@
+"""Multi-host launcher: `python -m bigdl_tpu.launch [opts] script.py [args]`.
+
+Reference: scripts/spark-submit-with-bigdl.sh + Engine.createSparkConf —
+the reference ships a submit wrapper that injects the bigdl conf before
+handing the program to Spark.  On TPU the cluster runtime is
+`jax.distributed`: this launcher injects the coordinator/process topology
+(flags or the TPU pod environment) as BIGDL_TPU_* env vars and executes
+the training script in-process; `Engine.init()` inside the script then
+joins the cluster (core/engine.py).
+
+On Cloud TPU pod slices the topology is auto-detected (jax.distributed
+with no arguments), so the common invocation is simply:
+
+    python -m bigdl_tpu.launch train.py --epochs 90    # every host
+
+For explicit CPU/GPU multi-process clusters:
+
+    python -m bigdl_tpu.launch --coordinator host0:1234 \
+        --num-processes 4 --process-id $RANK train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+_PREFIX = "BIGDL_TPU_"
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.launch",
+        description="Launch a training script into a jax.distributed cluster")
+    ap.add_argument("--coordinator", default=None,
+                    help="coordinator host:port (omit on TPU pod slices — "
+                         "auto-detected)")
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("--mesh", default=None,
+                    help="mesh spec like 'data=8,model=4' exported as "
+                         f"{_PREFIX}MESH for Engine.init")
+    ap.add_argument("script", help="training script to run")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+
+    if args.coordinator is not None:
+        os.environ[_PREFIX + "COORDINATOR_ADDRESS"] = args.coordinator
+        if args.num_processes is not None:
+            os.environ[_PREFIX + "NUM_PROCESSES"] = str(args.num_processes)
+        if args.process_id is not None:
+            os.environ[_PREFIX + "PROCESS_ID"] = str(args.process_id)
+    if args.mesh is not None:
+        os.environ[_PREFIX + "MESH"] = args.mesh
+
+    sys.argv = [args.script] + list(args.script_args)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(args.script)) or ".")
+    runpy.run_path(args.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

@@ -124,28 +124,29 @@ class MoE(Module):
             dtype=jnp.float32)                                   # (T,k,C)
         kept = jnp.any(keep & (onehots > 0), axis=-1)            # (T,k)
 
-        # dispatch (T,E,C) and combine (T,E,C)
+        # dispatch (T,E,C); combine weights are derived after the optional
+        # aux-loss hook so the penalized probs feed the one combine einsum
         dispatch = jnp.einsum("tke,tkc->tec", onehots,
                               slot * kept[..., None])
-        combine = jnp.einsum("tke,tkc->tec", onehots,
-                             slot * (kept * top_vals)[..., None])
 
         if training and self.aux_weight > 0.0:
-            # Switch load-balance loss: E * sum_e(frac_dispatched_e * P_e);
-            # frac is stop-grad (argmax path), gradient flows via probs
-            frac = jax.lax.stop_gradient(
-                jnp.mean(jnp.sum(dispatch, axis=-1), axis=0))    # (E,)
+            # Switch load-balance loss: E * sum_e(frac_e * P_e) where frac_e
+            # is the PRE-capacity-drop top-1 routing fraction (Switch paper
+            # semantics — computing it post-drop would cap the penalty at
+            # capacity/T exactly when an expert is most overloaded).  frac
+            # is stop-grad (argmax path); gradient flows via probs.
+            frac = jax.lax.stop_gradient(jnp.mean(onehots[:, 0, :], axis=0))
             w = self.aux_weight * e / t
             # d(aux)/d(probs) with aux = w*T*sum_e(frac_e * mean_t probs)
             probs = _aux_identity(probs,
                                   jnp.broadcast_to(w * frac, probs.shape))
-            # re-derive combine from the penalized probs so the vjp engages
-            top_vals2 = jnp.take_along_axis(probs, top_idx, axis=-1)
+            top_vals = jnp.take_along_axis(probs, top_idx, axis=-1)
             if k > 1:
-                top_vals2 = top_vals2 / jnp.maximum(
-                    jnp.sum(top_vals2, -1, keepdims=True), 1e-9)
-            combine = jnp.einsum("tke,tkc->tec", onehots,
-                                 slot * (kept * top_vals2)[..., None])
+                top_vals = top_vals / jnp.maximum(
+                    jnp.sum(top_vals, -1, keepdims=True), 1e-9)
+
+        combine = jnp.einsum("tke,tkc->tec", onehots,
+                             slot * (kept * top_vals)[..., None])
 
         w1 = params["experts"]["fc1_w"].astype(x.dtype)
         b1 = params["experts"]["fc1_b"].astype(x.dtype)

@@ -1,0 +1,92 @@
+"""Launcher (spark-submit analogue) and numerics-debug tests
+(reference: scripts/spark-submit-with-bigdl.sh; survey §5.2)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+class TestLauncher:
+    def test_runs_script_with_args_and_env(self, tmp_path, monkeypatch):
+        from bigdl_tpu import launch
+
+        out = tmp_path / "out.txt"
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import os, sys\n"
+            "with open(sys.argv[1], 'w') as f:\n"
+            "    f.write(os.environ.get('BIGDL_TPU_COORDINATOR_ADDRESS', '') + '|'\n"
+            "            + os.environ.get('BIGDL_TPU_NUM_PROCESSES', '') + '|'\n"
+            "            + os.environ.get('BIGDL_TPU_MESH', '') + '|'\n"
+            "            + ' '.join(sys.argv[1:]))\n")
+        env_vars = ("BIGDL_TPU_COORDINATOR_ADDRESS", "BIGDL_TPU_NUM_PROCESSES",
+                    "BIGDL_TPU_PROCESS_ID", "BIGDL_TPU_MESH")
+        for var in env_vars:
+            monkeypatch.delenv(var, raising=False)
+        try:
+            launch.main(["--coordinator", "host0:1234", "--num-processes", "2",
+                         "--process-id", "0", "--mesh", "data=2,model=1",
+                         str(script), str(out), "--epochs", "3"])
+        finally:
+            # launch.main intentionally exports these for the script; they
+            # must not leak into later tests (Engine.init would try to join
+            # the fake coordinator)
+            for var in env_vars:
+                os.environ.pop(var, None)
+        coord, nproc, mesh, argv = out.read_text().split("|")
+        assert coord == "host0:1234" and nproc == "2"
+        assert mesh == "data=2,model=1"
+        assert argv.endswith("--epochs 3")
+
+    def test_mesh_spec_parsing(self):
+        from bigdl_tpu.core.config import EngineConfig
+
+        cfg = EngineConfig(mesh_spec="data=4, model=2")
+        assert cfg.parse_mesh() == {"data": 4, "model": 2}
+        assert EngineConfig().parse_mesh() is None
+
+
+class TestDebug:
+    def test_assert_finite(self):
+        from bigdl_tpu.core import assert_finite
+
+        ok = {"a": {"w": jnp.ones((2, 2))}, "idx": jnp.arange(3)}
+        assert_finite(ok, "params")  # no raise
+        bad = {"a": {"w": jnp.asarray([1.0, np.nan])}}
+        with pytest.raises(FloatingPointError, match="a/w"):
+            assert_finite(bad, "params")
+
+    def test_tap_finite_inside_jit(self, capsys):
+        import jax
+
+        from bigdl_tpu.core import tap_finite
+
+        @jax.jit
+        def f(x):
+            return tap_finite(x * 2, "act")
+
+        y = f(jnp.asarray([1.0, jnp.inf]))
+        jax.effects_barrier()
+        assert np.isinf(np.asarray(y)).any()
+        assert "non-finite" in capsys.readouterr().out
+
+    def test_nan_check_switch(self):
+        import jax
+
+        from bigdl_tpu.core import enable_nan_checks
+
+        try:
+            enable_nan_checks(True)
+            with pytest.raises(FloatingPointError):
+                jax.jit(lambda x: x / 0.0 * 0.0)(jnp.ones(2)).block_until_ready()
+        finally:
+            enable_nan_checks(False)
+
+    def test_bad_mesh_spec_raises_helpfully(self):
+        from bigdl_tpu.core.config import EngineConfig
+
+        for bad in ("data=8;model=2", "data=8,", "data", "=4"):
+            with pytest.raises(ValueError, match="BIGDL_TPU_MESH"):
+                EngineConfig(mesh_spec=bad).parse_mesh()

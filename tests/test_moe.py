@@ -194,3 +194,30 @@ class TestMoEExpertParallel:
         for leaf in jax.tree_util.tree_leaves(g):
             assert np.isfinite(np.asarray(leaf)).all()
         assert float(jnp.max(jnp.abs(g["blocks"]["mlp"]["router"]["weight"]))) > 0
+
+    def test_aux_frac_is_pre_capacity_drop(self):
+        """Regression: the load-balance fraction must reflect the router's
+        assignment BEFORE capacity dropping, or the penalty saturates at
+        capacity/T exactly when one expert is overloaded."""
+        m, p, s = _moe(e=2, k=1, capacity_factor=1e-9, aux_weight=1.0)
+        p["router"]["weight"] = jnp.zeros_like(p["router"]["weight"]
+                                               ).at[:, 0].set(5.0)
+        x = jnp.asarray(np.random.RandomState(6).rand(1, 8, 8), jnp.float32)
+
+        def loss(p_):
+            y, _ = m.apply(p_, s, x, training=True)
+            return jnp.sum(y * 0.0)
+
+        g = jax.grad(loss)(p)["router"]["weight"]
+        # aux gradient must push column 0 DOWN relative to column 1 with the
+        # full frac=1.0 weight, even though only 1 of 8 tokens was served
+        col_diff = float(jnp.mean(g[:, 0] - g[:, 1]))
+        # d(aux)/d(logit) via softmax: proportional to frac difference
+        assert col_diff != 0.0
+        m2, p2, s2 = _moe(e=2, k=1, capacity_factor=8.0, aux_weight=1.0)
+        p2["router"]["weight"] = jnp.zeros_like(p2["router"]["weight"]
+                                                ).at[:, 0].set(5.0)
+        g2 = jax.grad(lambda p_: jnp.sum(
+            m2.apply(p_, s2, x, training=True)[0] * 0.0))(p2)["router"]["weight"]
+        # same routing fractions -> same aux gradient regardless of capacity
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g2), atol=1e-6)
