@@ -67,11 +67,14 @@ class EngineConfig:
         for part in self.mesh_spec.split(","):
             axis, sep, n = part.partition("=")
             axis = axis.strip()
-            if not sep or not axis or not n.strip().isdigit():
+            n = n.strip()
+            # -1 means "whatever is left" (Engine.build_mesh infers it)
+            if not sep or not axis or not (n.isdigit() or n == "-1"):
                 raise ValueError(
                     f"bad mesh spec {self.mesh_spec!r} (BIGDL_TPU_MESH / "
-                    f"--mesh): expected 'axis=N[,axis=N...]', e.g. "
-                    f"'data=8,model=2'; offending part: {part!r}")
+                    f"--mesh): expected 'axis=N[,axis=N...]' (N an int or "
+                    f"-1 for remainder), e.g. 'data=8,model=2'; offending "
+                    f"part: {part!r}")
             out[axis] = int(n)
         return out
 

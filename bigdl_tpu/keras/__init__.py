@@ -25,6 +25,21 @@ from bigdl_tpu.keras.layers import (
     GRU,
     SimpleRNN,
     TimeDistributed,
+    Convolution1D,
+    MaxPooling1D,
+    GlobalMaxPooling1D,
+    GlobalMaxPooling2D,
+    GlobalAveragePooling1D,
+    ZeroPadding1D,
+    ZeroPadding2D,
+    Cropping2D,
+    UpSampling1D,
+    UpSampling2D,
+    Permute,
+    RepeatVector,
+    Highway,
+    SpatialDropout1D,
+    SpatialDropout2D,
 )
 from bigdl_tpu.keras.topology import Sequential, Model
 from bigdl_tpu.keras.objectives import (
@@ -41,6 +56,11 @@ __all__ = [
     "Convolution2D", "Conv2D", "MaxPooling2D", "AveragePooling2D",
     "GlobalAveragePooling2D", "BatchNormalization", "Embedding", "LSTM",
     "GRU", "SimpleRNN", "TimeDistributed", "Sequential", "Model",
+    "Convolution1D", "MaxPooling1D", "GlobalMaxPooling1D",
+    "GlobalMaxPooling2D", "GlobalAveragePooling1D", "ZeroPadding1D",
+    "ZeroPadding2D", "Cropping2D", "UpSampling1D", "UpSampling2D",
+    "Permute", "RepeatVector", "Highway", "SpatialDropout1D",
+    "SpatialDropout2D",
     "CategoricalCrossEntropy", "resolve_loss", "resolve_optimizer",
     "resolve_metrics",
 ]

@@ -306,3 +306,197 @@ class TimeDistributed(KerasLayer):
 
 
 # serializer registration happens in bigdl_tpu/keras/__init__.py
+
+
+class Convolution1D(KerasLayer):
+    """1-D conv over (N, T, C). reference: nn/keras/Convolution1D.scala."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 activation: Optional[str] = None, subsample_length: int = 1,
+                 bias: bool = True, input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.activation = activation
+        self.subsample_length = subsample_length
+        self.bias = bias
+
+    def _make(self, input_shape):
+        core = nn.TemporalConvolution(input_shape[-1], self.nb_filter,
+                                      self.filter_length, self.subsample_length,
+                                      with_bias=self.bias)
+        return _with_activation(core, self.activation)
+
+
+class MaxPooling1D(KerasLayer):
+    """reference: nn/keras/MaxPooling1D.scala."""
+
+    def __init__(self, pool_length: int = 2, stride: Optional[int] = None,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.pool_length = pool_length
+        self.stride = stride or pool_length
+
+    def _make(self, input_shape):
+        return nn.TemporalMaxPooling(self.pool_length, self.stride)
+
+
+class GlobalMaxPooling1D(KerasLayer):
+    """reference: nn/keras/GlobalMaxPooling1D.scala."""
+
+    def _make(self, input_shape):
+        return nn.Max(dimension=1)
+
+
+class GlobalMaxPooling2D(KerasLayer):
+    """reference: nn/keras/GlobalMaxPooling2D.scala."""
+
+    def _make(self, input_shape):
+        return nn.GlobalMaxPooling2D()
+
+
+class GlobalAveragePooling1D(KerasLayer):
+    """reference: nn/keras/GlobalAveragePooling1D.scala."""
+
+    def _make(self, input_shape):
+        return nn.Mean(dimension=1)
+
+
+class ZeroPadding1D(KerasLayer):
+    """reference: nn/keras/ZeroPadding1D.scala."""
+
+    def __init__(self, padding: int = 1,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.padding = padding
+
+    def _make(self, input_shape):
+        return nn.Sequential(nn.Padding(1, -self.padding),
+                             nn.Padding(1, self.padding))
+
+
+class ZeroPadding2D(KerasLayer):
+    """reference: nn/keras/ZeroPadding2D.scala ((top, bottom), (left, right))."""
+
+    def __init__(self, padding: Sequence[int] = (1, 1),
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        p = tuple(padding)
+        if len(p) == 2:   # symmetric keras-1 form (pad_h, pad_w)
+            p = (p[0], p[0], p[1], p[1])
+        self.padding = p  # (top, bottom, left, right)
+
+    def _make(self, input_shape):
+        t, b, l, r = self.padding
+        return nn.SpatialZeroPadding(l, r, t, b)
+
+
+class Cropping2D(KerasLayer):
+    """reference: nn/keras/Cropping2D.scala."""
+
+    def __init__(self, cropping: Sequence[Sequence[int]] = ((0, 0), (0, 0)),
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.cropping = tuple(tuple(c) for c in cropping)
+
+    def _make(self, input_shape):
+        return nn.Cropping2D(self.cropping[0], self.cropping[1])
+
+
+class UpSampling1D(KerasLayer):
+    """reference: nn/keras/UpSampling1D.scala."""
+
+    def __init__(self, length: int = 2,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.length = length
+
+    def _make(self, input_shape):
+        return nn.UpSampling1D(self.length)
+
+
+class UpSampling2D(KerasLayer):
+    """reference: nn/keras/UpSampling2D.scala."""
+
+    def __init__(self, size: Sequence[int] = (2, 2),
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.size = tuple(size)
+
+    def _make(self, input_shape):
+        return nn.UpSampling2D(self.size)
+
+
+class Permute(KerasLayer):
+    """Permute non-batch dims; 1-based keras dims.
+    reference: nn/keras/Permute.scala."""
+
+    def __init__(self, dims: Sequence[int],
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.dims = tuple(dims)
+
+    def _make(self, input_shape):
+        perm = (0,) + self.dims  # keras dims are 1-based over non-batch
+        swaps = []
+        order = list(range(len(perm)))
+        for i, want in enumerate(perm):
+            j = order.index(want)
+            if i != j:
+                order[i], order[j] = order[j], order[i]
+                swaps.append((i, j))
+        return nn.Transpose(swaps)
+
+
+class RepeatVector(KerasLayer):
+    """(N, C) -> (N, n, C). reference: nn/keras/RepeatVector.scala."""
+
+    def __init__(self, n: int, input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.n = n
+
+    def _make(self, input_shape):
+        return nn.Replicate(self.n, dim=1)
+
+
+class Highway(KerasLayer):
+    """reference: nn/keras/Highway.scala."""
+
+    def __init__(self, activation: Optional[str] = "tanh",
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.activation = activation
+
+    def _make(self, input_shape):
+        return nn.Highway(input_shape[-1],
+                          activation=activation_layer(self.activation))
+
+
+class SpatialDropout1D(KerasLayer):
+    """reference: nn/keras/SpatialDropout1D.scala."""
+
+    def __init__(self, p: float = 0.5,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.p = p
+
+    def _make(self, input_shape):
+        return nn.SpatialDropout1D(self.p)
+
+
+class SpatialDropout2D(SpatialDropout1D):
+    """reference: nn/keras/SpatialDropout2D.scala."""
+
+    def _make(self, input_shape):
+        return nn.SpatialDropout2D(self.p)

@@ -45,6 +45,10 @@ def main(argv=None) -> None:
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
 
+    if args.coordinator is None and (args.num_processes is not None
+                                     or args.process_id is not None):
+        ap.error("--num-processes/--process-id require --coordinator "
+                 "(TPU pod slices auto-detect all three)")
     if args.coordinator is not None:
         os.environ[_PREFIX + "COORDINATOR_ADDRESS"] = args.coordinator
         if args.num_processes is not None:

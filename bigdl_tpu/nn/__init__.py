@@ -25,6 +25,7 @@ from bigdl_tpu.nn.pooling import (
     SpatialAveragePooling,
     TemporalMaxPooling,
     GlobalAveragePooling2D,
+    GlobalMaxPooling2D,
 )
 from bigdl_tpu.nn.norm import (
     BatchNormalization,
@@ -51,12 +52,19 @@ from bigdl_tpu.nn.activation import (
     SoftPlus,
     SoftSign,
 )
-from bigdl_tpu.nn.dropout import Dropout, GaussianDropout, GaussianNoise
+from bigdl_tpu.nn.dropout import (Dropout, GaussianDropout, GaussianNoise,
+                                  SpatialDropout1D, SpatialDropout2D,
+                                  SpatialDropout3D)
 from bigdl_tpu.nn.embedding import LookupTable
 from bigdl_tpu.nn.reshape import (
     Reshape,
     View,
     Flatten,
+    SpatialZeroPadding,
+    Cropping2D,
+    UpSampling1D,
+    UpSampling2D,
+    UpSampling3D,
     Squeeze,
     Unsqueeze,
     Transpose,

@@ -218,12 +218,14 @@ class TemporalConvolution(Module):
     """1-D conv over (N, T, C). reference: nn/TemporalConvolution.scala."""
 
     def __init__(self, input_frame_size: int, output_frame_size: int,
-                 kernel_w: int, stride_w: int = 1, name: Optional[str] = None):
+                 kernel_w: int, stride_w: int = 1, with_bias: bool = True,
+                 name: Optional[str] = None):
         super().__init__(name)
         self.input_size = input_frame_size
         self.output_size = output_frame_size
         self.kernel_w = kernel_w
         self.stride_w = stride_w
+        self.with_bias = with_bias
         self.weight_init = init_mod.Xavier()
         self.bias_init = init_mod.Zeros()
 
@@ -233,15 +235,19 @@ class TemporalConvolution(Module):
         params = {
             "weight": self.weight_init(k_w, (self.kernel_w, self.input_size, self.output_size),
                                        fan_in, self.output_size),
-            "bias": self.bias_init(k_b, (self.output_size,), fan_in, self.output_size),
         }
+        if self.with_bias:
+            params["bias"] = self.bias_init(k_b, (self.output_size,), fan_in,
+                                            self.output_size)
         return params, {}, self.output_shape(input_shape)
 
     def apply(self, params, state, x, *, training=False, rng=None):
         y = lax.conv_general_dilated(
             x, params["weight"], window_strides=(self.stride_w,), padding="VALID",
             dimension_numbers=("NWC", "WIO", "NWC"))
-        return y + params["bias"], state
+        if self.with_bias:
+            y = y + params["bias"]
+        return y, state
 
     def output_shape(self, input_shape):
         n, t, _ = input_shape

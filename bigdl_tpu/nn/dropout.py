@@ -69,3 +69,37 @@ class GaussianNoise(Module):
         if rng is None:
             raise ValueError("GaussianNoise in training mode requires an rng")
         return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype), state
+
+
+class SpatialDropout1D(Module):
+    """Drop whole channels of (N, T, C). reference: nn/SpatialDropout1D.scala."""
+
+    def __init__(self, init_p: float = 0.5, name: Optional[str] = None):
+        super().__init__(name)
+        self.p = init_p
+
+    _mask_axes = (1,)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if not training or self.p <= 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError(f"{type(self).__name__} in training needs an rng")
+        shape = list(x.shape)
+        for ax in self._mask_axes:
+            shape[ax] = 1
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, tuple(shape))
+        return (jnp.where(mask, x, 0.0) / keep).astype(x.dtype), state
+
+
+class SpatialDropout2D(SpatialDropout1D):
+    """Drop whole feature maps of NHWC. reference: nn/SpatialDropout2D.scala."""
+
+    _mask_axes = (1, 2)
+
+
+class SpatialDropout3D(SpatialDropout1D):
+    """Drop whole volumes of NDHWC. reference: nn/SpatialDropout3D.scala."""
+
+    _mask_axes = (1, 2, 3)

@@ -146,3 +146,14 @@ class GlobalAveragePooling2D(Module):
     def output_shape(self, input_shape):
         n, h, w, c = input_shape
         return (n, c)
+
+
+class GlobalMaxPooling2D(Module):
+    """Max over H, W (reference: keras/GlobalMaxPooling2D.scala)."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.max(x, axis=(1, 2)), state
+
+    def output_shape(self, input_shape):
+        n, h, w, c = input_shape
+        return (n, c)

@@ -14,6 +14,14 @@ is a single `lax.dot_general` / `conv_general_dilated` with
 int8 path; dequantization fuses into the epilogue.  The functional pass
 `quantize(module, params) -> (q_module, q_params)` replaces the in-place
 tree mutation.
+
+Performance note (measured, v5e, ResNet-50 batch 256 inference): int8 runs
+at ~0.9x of bf16 — the model is HBM-bandwidth-bound, so halved weight
+traffic doesn't pay for the extra per-layer dynamic-activation
+quantization passes; int8's 2x MXU peak only wins on compute-bound
+(large-matmul) workloads.  The reference's premise differs on CPU, where
+BigQuant's int8 GEMM is the fast path.  This port is therefore capability
+parity (memory-footprint halving for weights) first, speedup second.
 """
 
 from __future__ import annotations

@@ -216,3 +216,94 @@ class Padding(Module):
         s = list(input_shape)
         s[self.dim] += abs(self.pad)
         return tuple(s)
+
+
+class SpatialZeroPadding(Module):
+    """Zero-pad NHWC spatial dims (left, right, top, bottom).
+    reference: nn/SpatialZeroPadding.scala."""
+
+    def __init__(self, pad_left: int, pad_right: int, pad_top: int,
+                 pad_bottom: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.pads = (pad_left, pad_right, pad_top, pad_bottom)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        l, r, t, b = self.pads
+        return jnp.pad(x, [(0, 0), (t, b), (l, r), (0, 0)]), state
+
+    def output_shape(self, input_shape):
+        n, h, w, c = input_shape
+        l, r, t, b = self.pads
+        return (n, h + t + b, w + l + r, c)
+
+
+class Cropping2D(Module):
+    """Crop ((top, bottom), (left, right)) off NHWC spatial dims.
+    reference: nn/Cropping2D.scala."""
+
+    def __init__(self, heightCrop: Sequence[int] = (0, 0),
+                 widthCrop: Sequence[int] = (0, 0), name: Optional[str] = None):
+        super().__init__(name)
+        self.hc = tuple(heightCrop)
+        self.wc = tuple(widthCrop)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        (t, b), (l, r) = self.hc, self.wc
+        h, w = x.shape[1], x.shape[2]
+        return x[:, t:h - b or None, l:w - r or None, :], state
+
+    def output_shape(self, input_shape):
+        n, h, w, c = input_shape
+        return (n, h - sum(self.hc), w - sum(self.wc), c)
+
+
+class UpSampling1D(Module):
+    """Repeat each timestep `length` times on (N, T, C).
+    reference: nn/UpSampling1D.scala."""
+
+    def __init__(self, length: int = 2, name: Optional[str] = None):
+        super().__init__(name)
+        self.length = length
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.repeat(x, self.length, axis=1), state
+
+    def output_shape(self, input_shape):
+        n, t, c = input_shape
+        return (n, t * self.length, c)
+
+
+class UpSampling2D(Module):
+    """Nearest-neighbour upsampling of NHWC by (sh, sw).
+    reference: nn/UpSampling2D.scala."""
+
+    def __init__(self, size: Sequence[int] = (2, 2), name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        sh, sw = self.size
+        return jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2), state
+
+    def output_shape(self, input_shape):
+        n, h, w, c = input_shape
+        return (n, h * self.size[0], w * self.size[1], c)
+
+
+class UpSampling3D(Module):
+    """Nearest-neighbour upsampling of NDHWC by (sd, sh, sw).
+    reference: nn/UpSampling3D.scala."""
+
+    def __init__(self, size: Sequence[int] = (2, 2, 2), name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        sd, sh, sw = self.size
+        y = jnp.repeat(x, sd, axis=1)
+        y = jnp.repeat(y, sh, axis=2)
+        return jnp.repeat(y, sw, axis=3), state
+
+    def output_shape(self, input_shape):
+        n, d, h, w, c = input_shape
+        return (n, d * self.size[0], h * self.size[1], w * self.size[2], c)

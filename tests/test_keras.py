@@ -167,3 +167,74 @@ def test_categorical_crossentropy_soft_targets():
     want_oh = float(nn.CrossEntropyCriterion().forward(
         logits, jnp.asarray([0, 1])))
     assert abs(got_oh - want_oh) < 1e-6
+
+
+class TestNewKerasLayers:
+    """reference: nn/keras/{Convolution1D,ZeroPadding2D,UpSampling2D,
+    Permute,RepeatVector,Highway,...}.scala."""
+
+    def test_conv1d_pool1d_chain(self):
+        m = keras.Sequential(
+            keras.Convolution1D(8, 3, activation="relu", input_shape=(10, 4)),
+            keras.MaxPooling1D(2),
+            keras.GlobalMaxPooling1D(),
+            keras.Dense(3))
+        p, s, out = m.build(jax.random.PRNGKey(0), (2, 10, 4))
+        assert out == (2, 3)
+        y, _ = m.apply(p, s, jnp.ones((2, 10, 4)))
+        assert y.shape == (2, 3)
+
+    def test_padding_crop_upsample_shapes(self):
+        m = keras.Sequential(
+            keras.ZeroPadding2D((1, 2)),
+            keras.Cropping2D(((1, 1), (2, 2))),
+            keras.UpSampling2D((2, 2)))
+        p, s, out = m.build(jax.random.PRNGKey(0), (2, 4, 5, 3))
+        assert out == (2, 8, 10, 3)
+
+    def test_permute_matches_transpose(self):
+        x = jnp.asarray(np.random.RandomState(0).rand(2, 3, 4), jnp.float32)
+        m = keras.Permute((2, 1))
+        p, s, _ = m.build(jax.random.PRNGKey(0), (2, 3, 4))
+        y, _ = m.apply(p, s, x)
+        np.testing.assert_array_equal(np.asarray(y),
+                                      np.asarray(x).transpose(0, 2, 1))
+
+    def test_repeat_vector(self):
+        x = jnp.asarray([[1.0, 2.0]])
+        m = keras.RepeatVector(3)
+        p, s, _ = m.build(jax.random.PRNGKey(0), (1, 2))
+        y, _ = m.apply(p, s, x)
+        assert y.shape == (1, 3, 2)
+        np.testing.assert_array_equal(np.asarray(y)[0, 1], [1.0, 2.0])
+
+    def test_highway_trains(self):
+        from bigdl_tpu.core.random import RandomGenerator
+
+        RandomGenerator.set_seed(3)  # decouple from earlier tests' RNG use
+        x, y = make_blobs(classes=2, d=6)
+        m = keras.Sequential(keras.Highway(input_shape=(6,)),
+                             keras.Dense(2))
+        m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        m.fit(x, y, batch_size=32, nb_epoch=20)
+        acc = dict(m.evaluate(x, y, batch_size=32))["Top1Accuracy"]
+        assert acc > 0.8
+
+    def test_spatial_dropout_wrappers(self):
+        m1 = keras.SpatialDropout1D(0.3)
+        p, s, _ = m1.build(jax.random.PRNGKey(0), (2, 5, 3))
+        y, _ = m1.apply(p, s, jnp.ones((2, 5, 3)), training=True,
+                        rng=jax.random.PRNGKey(1))
+        assert y.shape == (2, 5, 3)
+        m2 = keras.SpatialDropout2D(0.3)
+        p2, s2, _ = m2.build(jax.random.PRNGKey(0), (2, 4, 4, 3))
+        y2, _ = m2.apply(p2, s2, jnp.ones((2, 4, 4, 3)), training=False)
+        np.testing.assert_array_equal(np.asarray(y2), 1.0)
+
+    def test_conv1d_bias_flag(self):
+        m = keras.Convolution1D(4, 3, bias=False)
+        p, s, _ = m.build(jax.random.PRNGKey(0), (2, 8, 3))
+        leaves = jax.tree_util.tree_leaves(p)
+        # weight only — no bias created when disabled
+        assert all(l.ndim == 3 for l in leaves)

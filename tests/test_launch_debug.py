@@ -90,3 +90,17 @@ class TestDebug:
         for bad in ("data=8;model=2", "data=8,", "data", "=4"):
             with pytest.raises(ValueError, match="BIGDL_TPU_MESH"):
                 EngineConfig(mesh_spec=bad).parse_mesh()
+
+    def test_rank_flags_require_coordinator(self, tmp_path):
+        from bigdl_tpu import launch
+
+        script = tmp_path / "t.py"
+        script.write_text("pass\n")
+        with pytest.raises(SystemExit):
+            launch.main(["--num-processes", "4", str(script)])
+
+    def test_mesh_spec_accepts_remainder(self):
+        from bigdl_tpu.core.config import EngineConfig
+
+        assert EngineConfig(mesh_spec="data=-1,model=2").parse_mesh() == \
+            {"data": -1, "model": 2}

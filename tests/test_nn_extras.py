@@ -483,3 +483,59 @@ class TestDistanceRegressions:
         assert "act" in p
         y, _ = m.apply(p, s, jnp.ones((2, 4)))
         assert y.shape == (2, 4)
+
+
+class TestPaddingUpsamplingCrop:
+    """reference: nn/SpatialZeroPadding.scala, nn/Cropping2D.scala,
+    nn/UpSampling{1,2,3}D.scala, nn/SpatialDropout{1,2}D.scala."""
+
+    def test_spatial_zero_padding(self):
+        x = jnp.ones((2, 3, 4, 5))
+        m = nn.SpatialZeroPadding(1, 2, 3, 0)
+        p, s, out = m.build(jax.random.PRNGKey(0), x.shape)
+        y, _ = m.apply(p, s, x)
+        assert y.shape == (2, 6, 7, 5) == out
+        assert float(y[0, 0, 0, 0]) == 0.0  # top padding
+        assert float(y[0, 3, 1, 0]) == 1.0  # body
+
+    def test_cropping2d(self):
+        x = jnp.arange(2 * 5 * 6 * 1, dtype=jnp.float32).reshape(2, 5, 6, 1)
+        m = nn.Cropping2D((1, 2), (0, 3))
+        y, _ = m.apply({}, {}, x)
+        assert y.shape == (2, 2, 3, 1)
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x)[:, 1:3, 0:3])
+
+    def test_upsampling(self):
+        x = jnp.asarray([[[1.0], [2.0]]])  # (1, 2, 1)
+        y, _ = nn.UpSampling1D(3).apply({}, {}, x)
+        np.testing.assert_array_equal(np.asarray(y).ravel(),
+                                      [1, 1, 1, 2, 2, 2])
+        x2 = jnp.arange(4, dtype=jnp.float32).reshape(1, 2, 2, 1)
+        y2, _ = nn.UpSampling2D((2, 2)).apply({}, {}, x2)
+        assert y2.shape == (1, 4, 4, 1)
+        np.testing.assert_array_equal(np.asarray(y2)[0, :2, :2, 0],
+                                      [[0, 0], [0, 0]])
+        x3 = jnp.ones((1, 2, 2, 2, 1))
+        y3, _ = nn.UpSampling3D((2, 1, 2)).apply({}, {}, x3)
+        assert y3.shape == (1, 4, 2, 4, 1)
+
+    def test_spatial_dropout_drops_whole_channels(self):
+        x = jnp.ones((2, 6, 6, 8))
+        m = nn.SpatialDropout2D(0.5)
+        y, _ = m.apply({}, {}, x, training=True, rng=jax.random.PRNGKey(0))
+        arr = np.asarray(y)
+        # each (batch, channel) map is either all-zero or all-scaled
+        per_map = arr.reshape(2, 36, 8)
+        for b in range(2):
+            for c in range(8):
+                vals = np.unique(per_map[b, :, c])
+                assert len(vals) == 1
+        # eval mode: identity
+        y2, _ = m.apply({}, {}, x, training=False)
+        np.testing.assert_array_equal(np.asarray(y2), np.asarray(x))
+
+    def test_global_max_pooling2d(self):
+        x = jnp.asarray(np.random.RandomState(0).rand(2, 3, 4, 5), jnp.float32)
+        y, _ = nn.GlobalMaxPooling2D().apply({}, {}, x)
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(x).max(axis=(1, 2)))

@@ -150,6 +150,21 @@ EXEMPLARS = {
                          lambda: rand(2, 5, 8)),
     "MoE": (lambda: nn.MoE(8, 4, k=2, mlp_ratio=2),
             lambda: rand(2, 5, 8)),
+    "SpatialZeroPadding": (lambda: nn.SpatialZeroPadding(1, 2, 3, 0),
+                           lambda: rand(2, 5, 6, 3)),
+    "Cropping2D": (lambda: nn.Cropping2D((1, 1), (0, 2)),
+                   lambda: rand(2, 6, 7, 3)),
+    "UpSampling1D": (lambda: nn.UpSampling1D(3), lambda: rand(2, 4, 3)),
+    "UpSampling2D": (lambda: nn.UpSampling2D((2, 3)), lambda: rand(2, 4, 4, 3)),
+    "UpSampling3D": (lambda: nn.UpSampling3D((2, 1, 2)),
+                     lambda: rand(2, 3, 4, 4, 2)),
+    "SpatialDropout1D": (lambda: nn.SpatialDropout1D(0.3), lambda: rand(2, 5, 3)),
+    "SpatialDropout2D": (lambda: nn.SpatialDropout2D(0.3),
+                         lambda: rand(2, 4, 4, 3)),
+    "SpatialDropout3D": (lambda: nn.SpatialDropout3D(0.3),
+                         lambda: rand(2, 3, 4, 4, 2)),
+    "GlobalMaxPooling2D": (lambda: nn.GlobalMaxPooling2D(),
+                           lambda: rand(2, 4, 5, 3)),
     "TransformerLM": (lambda: _transformer_lm(),
                       lambda: jnp.asarray(
                           np.random.RandomState(3).randint(0, 20, (2, 6)))),
@@ -189,6 +204,30 @@ EXEMPLARS = {
     "Unsqueeze": (lambda: nn.Unsqueeze(1), lambda: rand(2, 3)),
     "View": (lambda: nn.View(6), lambda: rand(2, 2, 3)),
     # keras layer zoo (registered under "keras.<Name>")
+    "keras.Convolution1D": (lambda: keras.Convolution1D(4, 3, activation="relu"),
+                            lambda: rand(2, 6, 3)),
+    "keras.MaxPooling1D": (lambda: keras.MaxPooling1D(2), lambda: rand(2, 6, 3)),
+    "keras.GlobalMaxPooling1D": (lambda: keras.GlobalMaxPooling1D(),
+                                 lambda: rand(2, 5, 3)),
+    "keras.GlobalMaxPooling2D": (lambda: keras.GlobalMaxPooling2D(),
+                                 lambda: rand(2, 4, 5, 3)),
+    "keras.GlobalAveragePooling1D": (lambda: keras.GlobalAveragePooling1D(),
+                                     lambda: rand(2, 5, 3)),
+    "keras.ZeroPadding1D": (lambda: keras.ZeroPadding1D(2), lambda: rand(2, 4, 3)),
+    "keras.ZeroPadding2D": (lambda: keras.ZeroPadding2D((1, 2)),
+                            lambda: rand(2, 4, 5, 3)),
+    "keras.Cropping2D": (lambda: keras.Cropping2D(((1, 0), (1, 1))),
+                         lambda: rand(2, 5, 6, 3)),
+    "keras.UpSampling1D": (lambda: keras.UpSampling1D(2), lambda: rand(2, 3, 4)),
+    "keras.UpSampling2D": (lambda: keras.UpSampling2D((2, 2)),
+                           lambda: rand(2, 3, 3, 2)),
+    "keras.Permute": (lambda: keras.Permute((2, 1)), lambda: rand(2, 3, 4)),
+    "keras.RepeatVector": (lambda: keras.RepeatVector(3), lambda: rand(2, 4)),
+    "keras.Highway": (lambda: keras.Highway(), lambda: rand(2, 5)),
+    "keras.SpatialDropout1D": (lambda: keras.SpatialDropout1D(0.2),
+                               lambda: rand(2, 5, 3)),
+    "keras.SpatialDropout2D": (lambda: keras.SpatialDropout2D(0.2),
+                               lambda: rand(2, 4, 4, 3)),
     "keras.Dense": (lambda: keras.Dense(3, activation="relu", input_dim=4),
                     lambda: rand(2, 4)),
     "keras.Activation": (lambda: keras.Activation("tanh"), lambda: rand(2, 3)),
