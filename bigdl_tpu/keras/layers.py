@@ -386,7 +386,9 @@ class ZeroPadding2D(KerasLayer):
                  name: Optional[str] = None):
         super().__init__(input_shape, name)
         p = tuple(padding)
-        if len(p) == 2:   # symmetric keras-1 form (pad_h, pad_w)
+        if len(p) == 2 and all(isinstance(v, (tuple, list)) for v in p):
+            p = (p[0][0], p[0][1], p[1][0], p[1][1])  # ((t, b), (l, r))
+        elif len(p) == 2:  # symmetric keras-1 form (pad_h, pad_w)
             p = (p[0], p[0], p[1], p[1])
         self.padding = p  # (top, bottom, left, right)
 

@@ -10,7 +10,7 @@ lowers to one XLA program — the role BigDL's mkldnn fused `DnnGraph` plays
 """
 
 from bigdl_tpu.nn.module import Module, Container, Sequential, Node, Input
-from bigdl_tpu.nn.graph import Graph
+from bigdl_tpu.nn.graph import Graph, StaticGraph, DynamicGraph
 from bigdl_tpu.nn import init
 from bigdl_tpu.nn.linear import Linear, SparseLinear
 from bigdl_tpu.nn.conv import (
@@ -19,6 +19,13 @@ from bigdl_tpu.nn.conv import (
     SpatialSeparableConvolution,
     SpatialFullConvolution,
     TemporalConvolution,
+    SpatialShareConvolution,
+    SpatialConvolutionMap,
+    LocallyConnected1D,
+    LocallyConnected2D,
+    full_connection_table,
+    one_to_one_connection_table,
+    random_connection_table,
 )
 from bigdl_tpu.nn.pooling import (
     SpatialMaxPooling,
@@ -34,6 +41,11 @@ from bigdl_tpu.nn.norm import (
     LayerNormalization,
     Normalize,
     SpatialCrossMapLRN,
+    NormalizeScale,
+    SpatialWithinChannelLRN,
+    SpatialSubtractiveNormalization,
+    SpatialDivisiveNormalization,
+    SpatialContrastiveNormalization,
 )
 from bigdl_tpu.nn.activation import (
     ReLU,
@@ -54,7 +66,7 @@ from bigdl_tpu.nn.activation import (
 )
 from bigdl_tpu.nn.dropout import (Dropout, GaussianDropout, GaussianNoise,
                                   SpatialDropout1D, SpatialDropout2D,
-                                  SpatialDropout3D)
+                                  SpatialDropout3D, GaussianSampler)
 from bigdl_tpu.nn.embedding import LookupTable
 from bigdl_tpu.nn.reshape import (
     Reshape,
@@ -75,6 +87,7 @@ from bigdl_tpu.nn.reshape import (
     SplitTable,
     JoinTable,
     Padding,
+    Cropping3D,
 )
 from bigdl_tpu.nn.arithmetic import (
     CAddTable,
@@ -85,6 +98,7 @@ from bigdl_tpu.nn.arithmetic import (
     CMinTable,
     CAveTable,
     MM,
+    MV,
     Mul,
     Add,
     CMul,
@@ -120,6 +134,7 @@ from bigdl_tpu.nn.recurrent import (
     TimeDistributed,
     LSTMPeephole,
     ConvLSTMPeephole,
+    ConvLSTMPeephole3D,
     MultiRNNCell,
     RecurrentDecoder,
 )
@@ -170,6 +185,7 @@ from bigdl_tpu.nn.activation import (
     SReLU,
 )
 from bigdl_tpu.nn.structural import (
+    ResizeBilinear,
     Negative,
     Echo,
     GradientReversal,

@@ -283,3 +283,24 @@ class DotProduct(Module):
     def apply(self, params, state, x, *, training=False, rng=None):
         a, b = _items(x)
         return jnp.sum(a * b, axis=-1), state
+
+
+class MV(Module):
+    """Matrix-vector product of a 2-tensor Table: {mat (b, n, m) or (n, m),
+    vec (b, m) or (m,)} -> (b, n) or (n,).  reference: nn/MV.scala:33-84."""
+
+    def __init__(self, trans: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self.trans = trans
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        m, v = _items(x)
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...nm,...m->...n", m, v), state
+
+    def output_shape(self, input_shape):
+        ms = list(_items(input_shape)[0])
+        if self.trans:
+            ms[-1], ms[-2] = ms[-2], ms[-1]
+        return tuple(ms[:-1])

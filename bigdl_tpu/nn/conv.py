@@ -253,3 +253,203 @@ class TemporalConvolution(Module):
         n, t, _ = input_shape
         ot = (t - self.kernel_w) // self.stride_w + 1
         return (n, ot, self.output_size)
+
+
+class SpatialShareConvolution(SpatialConvolution):
+    """Same math as SpatialConvolution.  The reference variant
+    (nn/SpatialShareConvolution.scala) exists only to share im2col buffers
+    across replicas on the JVM heap; under XLA buffer reuse is the
+    compiler's job, so this is a name-parity alias."""
+
+
+def full_connection_table(n_in: int, n_out: int):
+    """Every input feature feeds every output feature
+    (reference: SpatialConvolutionMap's full table / torch nn.tables.full)."""
+    return [(i, o) for o in range(n_out) for i in range(n_in)]
+
+
+def one_to_one_connection_table(n_features: int):
+    """Feature i feeds only feature i (torch nn.tables.oneToOne)."""
+    return [(i, i) for i in range(n_features)]
+
+
+def random_connection_table(n_in: int, n_out: int, n_into: int, seed=None):
+    """Each output feature draws `n_into` random input features
+    (torch nn.tables.random).  Pass `seed` for a reproducible table;
+    the default draws fresh entropy per call like the torch original."""
+    import numpy as _np
+    r = _np.random.default_rng(seed)
+    pairs = []
+    for o in range(n_out):
+        for i in r.permutation(n_in)[:n_into]:
+            pairs.append((int(i), o))
+    return pairs
+
+
+class SpatialConvolutionMap(Module):
+    """Convolution with a generic input->output connection table — the
+    generalisation of SpatialConvolution (full table) and depthwise conv
+    (one-to-one table).  reference: nn/SpatialConvolutionMap.scala.
+
+    `conn_table` is a list of (in_feature, out_feature) pairs (0-based).
+    TPU-first realisation: one dense conv with a static binary mask over the
+    (kh, kw, cin, cout) kernel — the MXU runs the dense matmul either way,
+    and the mask folds into the weights at trace time (no gather loops)."""
+
+    def __init__(self, conn_table, kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 with_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.conn_table = [(int(i), int(o)) for i, o in conn_table]
+        self.n_input = 1 + max(i for i, _ in self.conn_table)
+        self.n_output = 1 + max(o for _, o in self.conn_table)
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.with_bias = with_bias
+
+    def _mask(self):
+        import numpy as _np
+        m = _np.zeros((self.n_input, self.n_output), _np.float32)
+        for i, o in self.conn_table:
+            m[i, o] = 1.0
+        return jnp.asarray(m)
+
+    def build(self, rng, input_shape):
+        kh, kw = self.kernel
+        # torch init: stdv = 1/sqrt(kW*kH*nInputPlane) per connection
+        fan = kh * kw * max(1, len(self.conn_table) // self.n_output)
+        k_w, k_b = jax.random.split(rng)
+        stdv = 1.0 / (fan ** 0.5)
+        w = jax.random.uniform(k_w, (kh, kw, self.n_input, self.n_output),
+                               jnp.float32, -stdv, stdv)
+        params = {"weight": w * self._mask()}
+        if self.with_bias:
+            params["bias"] = jax.random.uniform(
+                k_b, (self.n_output,), jnp.float32, -stdv, stdv)
+        return params, {}, self.output_shape(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = lax.conv_general_dilated(
+            x, params["weight"] * self._mask(), window_strides=self.stride,
+            padding=[(self.pad[0], self.pad[0]), (self.pad[1], self.pad[1])],
+            dimension_numbers=_DIMSPEC_2D)
+        if self.with_bias:
+            y = y + params["bias"]
+        return y, state
+
+    def output_shape(self, input_shape):
+        n, h, w, _ = input_shape
+        kh, kw = self.kernel
+        oh = _conv_out(h, kh, self.stride[0], self.pad[0], 1)
+        ow = _conv_out(w, kw, self.stride[1], self.pad[1], 1)
+        return (n, oh, ow, self.n_output)
+
+
+class LocallyConnected2D(Module):
+    """Convolution with UNSHARED weights: a different filter bank at every
+    output location.  reference: nn/LocallyConnected2D.scala.
+
+    Patches are extracted with conv_general_dilated_patches and contracted
+    against per-position weights in one einsum (a batched matmul on the
+    MXU), instead of the reference's per-location gemm loop."""
+
+    def __init__(self, n_input_plane: int, input_width: int, input_height: int,
+                 n_output_plane: int, kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 with_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.n_input = n_input_plane
+        self.n_output = n_output_plane
+        self.in_hw = (input_height, input_width)
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.with_bias = with_bias
+
+    def _out_hw(self):
+        oh = _conv_out(self.in_hw[0], self.kernel[0], self.stride[0], self.pad[0], 1)
+        ow = _conv_out(self.in_hw[1], self.kernel[1], self.stride[1], self.pad[1], 1)
+        return oh, ow
+
+    def build(self, rng, input_shape):
+        kh, kw = self.kernel
+        oh, ow = self._out_hw()
+        fan_in = kh * kw * self.n_input
+        k_w, k_b = jax.random.split(rng)
+        stdv = 1.0 / (fan_in ** 0.5)
+        params = {"weight": jax.random.uniform(
+            k_w, (oh, ow, kh * kw * self.n_input, self.n_output),
+            jnp.float32, -stdv, stdv)}
+        if self.with_bias:
+            params["bias"] = jax.random.uniform(
+                k_b, (oh, ow, self.n_output), jnp.float32, -stdv, stdv)
+        return params, {}, self.output_shape(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        kh, kw = self.kernel
+        # patches: (N, C*kh*kw, OH, OW) with feature-major ordering (C slowest)
+        patches = lax.conv_general_dilated_patches(
+            jnp.moveaxis(x, -1, 1), (kh, kw), self.stride,
+            [(self.pad[0], self.pad[0]), (self.pad[1], self.pad[1])])
+        # (N, C*kh*kw, OH, OW), feature dim C-major (C, kh, kw) — the same
+        # ordering as torch unfold
+        p = jnp.moveaxis(patches, 1, -1)  # (N, OH, OW, C*kh*kw)
+        y = jnp.einsum("nhwk,hwko->nhwo", p, params["weight"])
+        if self.with_bias:
+            y = y + params["bias"]
+        return y, state
+
+    def output_shape(self, input_shape):
+        oh, ow = self._out_hw()
+        return (input_shape[0], oh, ow, self.n_output)
+
+
+class LocallyConnected1D(Module):
+    """1-D locally connected layer over (N, T, C) frames.
+    reference: nn/LocallyConnected1D.scala."""
+
+    def __init__(self, n_input_frame: int, input_frame_size: int,
+                 output_frame_size: int, kernel_w: int, stride_w: int = 1,
+                 with_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.n_input_frame = n_input_frame
+        self.in_size = input_frame_size
+        self.out_size = output_frame_size
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+        self.with_bias = with_bias
+
+    def _out_frames(self):
+        return (self.n_input_frame - self.kernel_w) // self.stride_w + 1
+
+    def build(self, rng, input_shape):
+        ot = self._out_frames()
+        fan_in = self.kernel_w * self.in_size
+        k_w, k_b = jax.random.split(rng)
+        stdv = 1.0 / (fan_in ** 0.5)
+        params = {"weight": jax.random.uniform(
+            k_w, (ot, self.kernel_w * self.in_size, self.out_size),
+            jnp.float32, -stdv, stdv)}
+        if self.with_bias:
+            params["bias"] = jax.random.uniform(
+                k_b, (ot, self.out_size), jnp.float32, -stdv, stdv)
+        return params, {}, self.output_shape(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        ot = self._out_frames()
+        idx = jnp.arange(ot) * self.stride_w
+        # windows: (N, OT, kW, C)
+        win = jax.vmap(lambda s: lax.dynamic_slice_in_dim(x, s, self.kernel_w, 1),
+                       out_axes=1)(idx)
+        n = x.shape[0]
+        win = win.reshape(n, ot, self.kernel_w * self.in_size)
+        y = jnp.einsum("ntk,tko->nto", win, params["weight"])
+        if self.with_bias:
+            y = y + params["bias"]
+        return y, state
+
+    def output_shape(self, input_shape):
+        return (input_shape[0], self._out_frames(), self.out_size)

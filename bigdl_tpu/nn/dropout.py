@@ -103,3 +103,20 @@ class SpatialDropout3D(SpatialDropout1D):
     """Drop whole volumes of NDHWC. reference: nn/SpatialDropout3D.scala."""
 
     _mask_axes = (1, 2, 3)
+
+
+class GaussianSampler(Module):
+    """Reparameterised gaussian sampling for VAEs: input Table{mean,
+    log_variance} -> mean + eps * exp(0.5 * log_var), eps ~ N(0, 1).
+    reference: nn/GaussianSampler.scala:29-41 (samples in both train and
+    eval mode; gradients flow to both inputs via the reparameterisation)."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        mean, log_var = list(x)[:2]
+        if rng is None:
+            raise ValueError("GaussianSampler requires an rng")
+        eps = jax.random.normal(rng, mean.shape, mean.dtype)
+        return mean + eps * jnp.exp(0.5 * log_var), state
+
+    def output_shape(self, input_shape):
+        return list(input_shape)[0]

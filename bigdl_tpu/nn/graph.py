@@ -107,3 +107,13 @@ class Graph(Container):
 
     def output_shape(self, input_shape):
         raise NotImplementedError("use build() for graph shape inference")
+
+
+# Name-parity aliases.  The reference splits Graph into StaticGraph
+# (pre-topo-sorted execution arrays, nn/StaticGraph.scala:44) and
+# DynamicGraph (breadth-first Scheduler/FrameManager control flow,
+# nn/DynamicGraph.scala:28).  Under XLA the whole walk is traced once and
+# compiled, so one Graph serves both roles; data-dependent control flow is
+# expressed with the structured ops (nn.ops.Cond / nn.ops.WhileLoop).
+StaticGraph = Graph
+DynamicGraph = Graph

@@ -165,3 +165,136 @@ class SpatialCrossMapLRN(Module):
             [(0, 0), (0, 0), (0, 0), (half, self.size - 1 - half)])
         scale = (self.k + self.alpha / self.size * window_sum) ** self.beta
         return x / scale, state
+
+
+class NormalizeScale(Module):
+    """Lp-normalize then multiply by a learnable per-channel scale — the
+    Caffe `Normalize` layer used by SSD conv4_3.
+    reference: nn/NormalizeScale.scala (Normalize + CMul(size) with the
+    scale weight initialised to a constant)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10, scale: float = 1.0,
+                 size: Optional[Sequence[int]] = None, name: Optional[str] = None):
+        super().__init__(name)
+        self.p = p
+        self.eps = eps
+        self.scale = scale
+        self.size = tuple(size) if size is not None else None
+
+    def build(self, rng, input_shape):
+        size = self.size if self.size is not None else (input_shape[-1],)
+        return {"weight": jnp.full(size, self.scale, jnp.float32)}, {}, input_shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if self.p == 2.0:
+            norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+        else:
+            norm = jnp.sum(jnp.abs(x) ** self.p, axis=-1, keepdims=True) ** (1.0 / self.p)
+        return (x / jnp.maximum(norm, self.eps)) * params["weight"], state
+
+
+class SpatialWithinChannelLRN(Module):
+    """LRN within each channel over a size x size spatial window (NHWC).
+    reference: nn/SpatialWithinChannelLRN.scala:40-48 — composed there as
+    x * (1 + alpha * avgpool(x^2, size, pad=(size-1)/2))^(-beta); here one
+    fused reduce_window expression."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        half = (self.size - 1) // 2
+        hi = self.size - 1 - half
+        window_sum = lax.reduce_window(
+            jnp.square(x), 0.0, lax.add, (1, self.size, self.size, 1),
+            (1, 1, 1, 1), [(0, 0), (half, hi), (half, hi), (0, 0)])
+        avg = window_sum / (self.size * self.size)
+        return x * (1.0 + self.alpha * avg) ** (-self.beta), state
+
+
+def _gaussian_kernel(size: int, sigma_frac: float = 0.25) -> jnp.ndarray:
+    """Default 2-D gaussian kernel matching torch's image.gaussian default
+    (the reference's default 9x9 kernel)."""
+    sigma = sigma_frac * size
+    r = jnp.arange(size, dtype=jnp.float32) - (size - 1) / 2.0
+    g = jnp.exp(-0.5 * jnp.square(r / sigma))
+    k = jnp.outer(g, g)
+    return k / jnp.max(k)
+
+
+class _LocalMeanEstimator(Module):
+    """Shared machinery: weighted local mean across a spatial window AND all
+    channels, with border-coefficient correction (the conv-over-ones trick
+    the reference caches as `coef`)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_input = n_input_plane
+        if kernel is None:
+            kernel = _gaussian_kernel(9)
+        kernel = jnp.asarray(kernel, jnp.float32)
+        if kernel.ndim == 1:  # separable 1-D kernel -> outer product
+            kernel = jnp.outer(kernel, kernel)
+        # normalise so the window+channel weighted sum is a mean
+        self.kernel = kernel / (jnp.sum(kernel) * n_input_plane)
+
+    def _mean(self, x):
+        kh, kw = self.kernel.shape
+        w = jnp.broadcast_to(self.kernel[:, :, None, None],
+                             (kh, kw, self.n_input, 1))
+        pads = [((kh - 1) // 2, kh // 2), ((kw - 1) // 2, kw // 2)]
+        mean = lax.conv_general_dilated(
+            x, w, (1, 1), pads, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        ones = jnp.ones((1,) + x.shape[1:3] + (self.n_input,), x.dtype)
+        coef = lax.conv_general_dilated(
+            ones, w, (1, 1), pads, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return mean / coef
+
+
+class SpatialSubtractiveNormalization(_LocalMeanEstimator):
+    """Subtract the kernel-weighted neighborhood mean (across space and all
+    channels) from every channel.
+    reference: nn/SpatialSubtractiveNormalization.scala."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x - self._mean(x), state
+
+
+class SpatialDivisiveNormalization(_LocalMeanEstimator):
+    """Divide by the kernel-weighted neighborhood standard deviation,
+    thresholded from below.
+    reference: nn/SpatialDivisiveNormalization.scala (threshold/thresval)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, thresval: float = 1e-4,
+                 name: Optional[str] = None):
+        super().__init__(n_input_plane, kernel, name)
+        self.threshold = threshold
+        self.thresval = thresval
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        stds = jnp.sqrt(jnp.maximum(self._mean(jnp.square(x)), 0.0))
+        stds = jnp.where(stds <= self.threshold, self.thresval, stds)
+        return x / stds, state
+
+
+class SpatialContrastiveNormalization(Module):
+    """Subtractive then divisive normalization with one shared kernel.
+    reference: nn/SpatialContrastiveNormalization.scala."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, thresval: float = 1e-4,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel)
+        self.div = SpatialDivisiveNormalization(n_input_plane, kernel,
+                                                threshold, thresval)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y, _ = self.sub.apply({}, {}, x)
+        return self.div.apply({}, {}, y)[0], state

@@ -307,9 +307,14 @@ class LSTMPeephole(Cell):
 
 
 class ConvLSTMPeephole(Cell):
-    """Convolutional LSTM (2-D) with optional peepholes over NHWC maps.
+    """Convolutional LSTM with optional peepholes over NHWC maps.
     reference: nn/ConvLSTMPeephole.scala (kernelI over input, kernelC over
-    hidden, SAME padding so spatial dims are preserved)."""
+    hidden, SAME padding so spatial dims are preserved).  The spatial rank
+    is a class attribute so the 3-D twin (nn/ConvLSTMPeephole3D.scala)
+    shares the gate wiring."""
+
+    _rank = 2
+    _dimspec = ("NHWC", "HWIO", "NHWC")
 
     def __init__(self, input_size: int, output_size: int, kernel_i: int = 3,
                  kernel_c: int = 3, stride: int = 1, with_peephole: bool = True,
@@ -321,22 +326,23 @@ class ConvLSTMPeephole(Cell):
         self.kernel_i = kernel_i
         self.kernel_c = kernel_c
         self.with_peephole = with_peephole
-        self._spatial: Optional[Tuple[int, int]] = None
+        self._spatial: Optional[Tuple[int, ...]] = None
 
     def build(self, rng, input_shape):
-        # input_shape: (B, H, W, C_in)
+        # input_shape: (B, *spatial, C_in)
         k1, k2, k3 = jax.random.split(rng, 3)
         xavier = init_mod.Xavier()
         ci, co = self.input_size, self.hidden_size
         ki, kc = self.kernel_i, self.kernel_c
+        r = self._rank
         params = {
-            "w_ih": xavier(k1, (ki, ki, ci, 4 * co), ki * ki * ci, ki * ki * co),
-            "w_hh": xavier(k2, (kc, kc, co, 4 * co), kc * kc * co, kc * kc * co),
+            "w_ih": xavier(k1, (ki,) * r + (ci, 4 * co), ki**r * ci, ki**r * co),
+            "w_hh": xavier(k2, (kc,) * r + (co, 4 * co), kc**r * co, kc**r * co),
             "bias": jnp.zeros((4 * co,), jnp.float32),
         }
         if self.with_peephole:
             params["peep"] = xavier(k3, (3, co), co, co)
-        self._spatial = tuple(input_shape[1:3])
+        self._spatial = tuple(input_shape[1:1 + r])
         n = input_shape[0]
         return params, {}, (n,) + self._spatial + (co,)
 
@@ -351,12 +357,12 @@ class ConvLSTMPeephole(Cell):
 
     def step(self, params, x_t, hidden):
         h_prev, c_prev = hidden[1], hidden[2]
-        dimspec = ("NHWC", "HWIO", "NHWC")
+        ones = (1,) * self._rank
         gates = (
-            lax.conv_general_dilated(x_t, params["w_ih"], (1, 1), "SAME",
-                                     dimension_numbers=dimspec)
-            + lax.conv_general_dilated(h_prev, params["w_hh"], (1, 1), "SAME",
-                                       dimension_numbers=dimspec)
+            lax.conv_general_dilated(x_t, params["w_ih"], ones, "SAME",
+                                     dimension_numbers=self._dimspec)
+            + lax.conv_general_dilated(h_prev, params["w_hh"], ones, "SAME",
+                                       dimension_numbers=self._dimspec)
             + params["bias"])
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         if self.with_peephole:
@@ -444,3 +450,12 @@ class RecurrentDecoder(Module):
 
     def output_shape(self, input_shape):
         return (input_shape[0], self.seq_length) + tuple(input_shape[1:])
+
+
+class ConvLSTMPeephole3D(ConvLSTMPeephole):
+    """Convolutional LSTM over NDHWC volumes with optional peepholes.
+    reference: nn/ConvLSTMPeephole3D.scala — same gate wiring as the 2-D
+    cell, volumetric kernels."""
+
+    _rank = 3
+    _dimspec = ("NDHWC", "DHWIO", "NDHWC")

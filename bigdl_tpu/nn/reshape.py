@@ -241,11 +241,11 @@ class Cropping2D(Module):
     """Crop ((top, bottom), (left, right)) off NHWC spatial dims.
     reference: nn/Cropping2D.scala."""
 
-    def __init__(self, heightCrop: Sequence[int] = (0, 0),
-                 widthCrop: Sequence[int] = (0, 0), name: Optional[str] = None):
+    def __init__(self, height_crop: Sequence[int] = (0, 0),
+                 width_crop: Sequence[int] = (0, 0), name: Optional[str] = None):
         super().__init__(name)
-        self.hc = tuple(heightCrop)
-        self.wc = tuple(widthCrop)
+        self.hc = tuple(height_crop)
+        self.wc = tuple(width_crop)
 
     def apply(self, params, state, x, *, training=False, rng=None):
         (t, b), (l, r) = self.hc, self.wc
@@ -307,3 +307,24 @@ class UpSampling3D(Module):
     def output_shape(self, input_shape):
         n, d, h, w, c = input_shape
         return (n, d * self.size[0], h * self.size[1], w * self.size[2], c)
+
+
+class Cropping3D(Module):
+    """Crop ((front, back), (top, bottom), (left, right)) off NDHWC volumes.
+    reference: nn/Cropping3D.scala."""
+
+    def __init__(self, dim1_crop: Sequence[int] = (1, 1),
+                 dim2_crop: Sequence[int] = (1, 1),
+                 dim3_crop: Sequence[int] = (1, 1), name: Optional[str] = None):
+        super().__init__(name)
+        self.crops = (tuple(dim1_crop), tuple(dim2_crop), tuple(dim3_crop))
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        (f, bk), (t, b), (l, r) = self.crops
+        d, h, w = x.shape[1:4]
+        return x[:, f:d - bk or None, t:h - b or None, l:w - r or None, :], state
+
+    def output_shape(self, input_shape):
+        n, d, h, w, c = input_shape
+        return (n, d - sum(self.crops[0]), h - sum(self.crops[1]),
+                w - sum(self.crops[2]), c)

@@ -360,3 +360,43 @@ class SparseJoinTable(Module):
 
     def apply(self, params, state, x, *, training=False, rng=None):
         return jnp.concatenate(list(x), axis=self.dimension), state
+
+
+class ResizeBilinear(Module):
+    """Bilinear resize of NHWC maps to (out_height, out_width).
+    reference: nn/ResizeBilinear.scala (and the TF ResizeBilinear op it
+    backs).  align_corners matches TF semantics: corner pixels map to
+    corners exactly (scale = (in-1)/(out-1))."""
+
+    def __init__(self, output_height: int, output_width: int,
+                 align_corners: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self.out_hw = (output_height, output_width)
+        self.align_corners = align_corners
+
+    def _interp_1d(self, x, axis, out_size):
+        in_size = x.shape[axis]
+        if in_size == out_size:
+            return x
+        if self.align_corners and out_size > 1:
+            pos = jnp.arange(out_size, dtype=jnp.float32) * (
+                (in_size - 1) / (out_size - 1))
+        else:
+            pos = jnp.arange(out_size, dtype=jnp.float32) * (in_size / out_size)
+        lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, in_size - 1)
+        hi = jnp.minimum(lo + 1, in_size - 1)
+        frac = (pos - lo).astype(x.dtype)
+        shape = [1] * x.ndim
+        shape[axis] = out_size
+        frac = frac.reshape(shape)
+        return (jnp.take(x, lo, axis=axis) * (1 - frac)
+                + jnp.take(x, hi, axis=axis) * frac)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = self._interp_1d(x, 1, self.out_hw[0])
+        y = self._interp_1d(y, 2, self.out_hw[1])
+        return y, state
+
+    def output_shape(self, input_shape):
+        n, _, _, c = input_shape
+        return (n, self.out_hw[0], self.out_hw[1], c)

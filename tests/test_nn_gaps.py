@@ -1,0 +1,229 @@
+"""Tests for the layer-zoo gap batch: spatial normalizations, locally
+connected / connection-table convolutions, MV, GaussianSampler,
+ResizeBilinear, Cropping3D, ConvLSTMPeephole3D, graph aliases.
+
+Differential against torch CPU where torch has the same op (the
+Torch7-oracle role, survey §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.table import Table
+
+
+def run(module, x, training=False):
+    from bigdl_tpu.nn.module import shape_of
+    params, state, out_shape = module.build(jax.random.PRNGKey(0), shape_of(x))
+    y, _ = module.apply(params, state, x, training=training,
+                        rng=jax.random.PRNGKey(1))
+    return y, params, out_shape
+
+
+class TestSpatialNormalizations:
+    def test_within_channel_lrn_formula(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 7, 7, 3))
+        y, _, _ = run(nn.SpatialWithinChannelLRN(size=3, alpha=0.5, beta=0.75), x)
+        # interior pixel: full 3x3 window
+        win = x[0, 1:4, 1:4, 0]
+        expect = x[0, 2, 2, 0] * (1 + 0.5 * jnp.mean(jnp.square(win))) ** -0.75
+        np.testing.assert_allclose(float(y[0, 2, 2, 0]), float(expect), rtol=1e-5)
+
+    def test_subtractive_constant_input_is_zeroed(self):
+        # constant input: neighborhood mean == value everywhere (incl. borders
+        # thanks to the coef correction), so output must be ~0
+        x = jnp.full((1, 9, 9, 3), 2.5)
+        y, _, _ = run(nn.SpatialSubtractiveNormalization(3), x)
+        np.testing.assert_allclose(np.asarray(y), 0.0, atol=1e-5)
+
+    def test_divisive_constant_input_is_ones(self):
+        # constant input: local std == |value| everywhere -> output == 1
+        x = jnp.full((1, 9, 9, 2), 3.0)
+        y, _, _ = run(nn.SpatialDivisiveNormalization(2), x)
+        np.testing.assert_allclose(np.asarray(y), 1.0, atol=1e-4)
+
+    def test_contrastive_composes(self):
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8, 3))
+        y, _, _ = run(nn.SpatialContrastiveNormalization(3), x)
+        ys, _, _ = run(nn.SpatialSubtractiveNormalization(3), x)
+        yd, _, _ = run(nn.SpatialDivisiveNormalization(3), ys)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(yd), rtol=1e-5)
+
+    def test_normalize_scale(self):
+        x = jax.random.normal(jax.random.PRNGKey(2), (2, 4, 4, 8))
+        y, params, _ = run(nn.NormalizeScale(scale=20.0), x)
+        assert params["weight"].shape == (8,)
+        norms = jnp.sqrt(jnp.sum(jnp.square(y / 20.0), axis=-1))
+        np.testing.assert_allclose(np.asarray(norms), 1.0, rtol=1e-4)
+
+
+class TestConnectionTableConv:
+    def test_one_to_one_is_depthwise(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 3))
+        m = nn.SpatialConvolutionMap(nn.one_to_one_connection_table(3), 3, 3)
+        y, params, out_shape = run(m, x)
+        assert y.shape == out_shape == (2, 6, 6, 3)
+        # output channel o depends ONLY on input channel o
+        w = params["weight"]
+        mask = np.ones((3, 3)) - np.eye(3)
+        assert float(jnp.sum(jnp.abs(w) * mask[None, None])) == 0.0
+
+    def test_full_table_matches_spatial_convolution(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 3))
+        m = nn.SpatialConvolutionMap(nn.full_connection_table(3, 5), 3, 3)
+        params, state, _ = m.build(jax.random.PRNGKey(0), x.shape)
+        ref = nn.SpatialConvolution(3, 5, 3, 3)
+        y, _ = m.apply(params, state, x)
+        y2, _ = ref.apply(params, {}, x)  # same param tree layout
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y2), rtol=1e-5)
+
+    def test_random_table(self):
+        m = nn.SpatialConvolutionMap(nn.random_connection_table(4, 6, 2), 3, 3)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 6, 6, 4))
+        y, _, _ = run(m, x)
+        assert y.shape == (1, 4, 4, 6)
+
+
+class TestLocallyConnected:
+    def test_2d_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        # unshared-weights conv == conv2d_local; verify against an explicit
+        # patch einsum in torch
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 7, 7, 3).astype(np.float32)
+        m = nn.LocallyConnected2D(3, 7, 7, 4, 3, 3, with_bias=True)
+        y, params, out_shape = run(m, jnp.asarray(x))
+        assert y.shape == out_shape == (2, 5, 5, 4)
+        # torch oracle: unfold -> per-position matmul.  torch unfold orders
+        # features C-major like our realigned layout (C, kh, kw)
+        tx = torch.from_numpy(np.moveaxis(x, -1, 1))  # NCHW
+        patches = torch.nn.functional.unfold(tx, 3)  # (N, C*9, L)
+        patches = patches.transpose(1, 2).reshape(2, 5, 5, 27)
+        w = torch.from_numpy(np.asarray(params["weight"]))
+        b = torch.from_numpy(np.asarray(params["bias"]))
+        ty = torch.einsum("nhwk,hwko->nhwo", patches, w) + b
+        np.testing.assert_allclose(np.asarray(y), ty.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_2d_differs_across_positions(self):
+        # same input patch at two positions must produce different outputs
+        x = np.zeros((1, 6, 6, 1), np.float32)
+        x[0, 0:3, 0:3, 0] = 1.0
+        x[0, 3:6, 3:6, 0] = 1.0
+        m = nn.LocallyConnected2D(1, 6, 6, 2, 3, 3, stride_w=3, stride_h=3,
+                                  with_bias=False)
+        y, _, _ = run(m, jnp.asarray(x))
+        assert not np.allclose(np.asarray(y[0, 0, 0]), np.asarray(y[0, 1, 1]))
+
+    def test_1d_shapes_and_locality(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 10, 4))
+        m = nn.LocallyConnected1D(10, 4, 6, 3, stride_w=2)
+        y, params, out_shape = run(m, x)
+        assert y.shape == out_shape == (2, 4, 6)
+        assert params["weight"].shape == (4, 12, 6)
+
+
+class TestSmallGapLayers:
+    def test_mv_batched(self):
+        m = jax.random.normal(jax.random.PRNGKey(0), (3, 4, 5))
+        v = jax.random.normal(jax.random.PRNGKey(1), (3, 5))
+        y, _, _ = run(nn.MV(), Table(m, v))
+        np.testing.assert_allclose(
+            np.asarray(y), np.einsum("bnm,bm->bn", m, v), rtol=1e-5)
+
+    def test_mv_trans(self):
+        m = jax.random.normal(jax.random.PRNGKey(0), (4, 5))
+        v = jax.random.normal(jax.random.PRNGKey(1), (4,))
+        y, _, _ = run(nn.MV(trans=True), Table(m, v))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(m.T @ v), rtol=1e-5)
+
+    def test_gaussian_sampler_stats(self):
+        mean = jnp.full((4000,), 3.0)
+        log_var = jnp.full((4000,), np.log(0.25))
+        y, _, _ = run(nn.GaussianSampler(), Table(mean, log_var))
+        assert abs(float(jnp.mean(y)) - 3.0) < 0.05
+        assert abs(float(jnp.std(y)) - 0.5) < 0.05
+
+    def test_gaussian_sampler_grad_flows(self):
+        # reparameterisation: d/dmean == 1, d/dlogvar == 0.5*eps*exp(.5 lv)
+        sampler = nn.GaussianSampler()
+
+        def f(mean, lv):
+            y, _ = sampler.apply({}, {}, Table(mean, lv),
+                                 rng=jax.random.PRNGKey(7))
+            return jnp.sum(y)
+
+        g_mean, g_lv = jax.grad(f, argnums=(0, 1))(jnp.zeros(8), jnp.zeros(8))
+        np.testing.assert_allclose(np.asarray(g_mean), 1.0)
+        assert float(jnp.sum(jnp.abs(g_lv))) > 0.0
+
+    def test_resize_bilinear_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 5, 7, 3).astype(np.float32)
+        for align in (False, True):
+            y, _, _ = run(nn.ResizeBilinear(10, 14, align_corners=align),
+                          jnp.asarray(x))
+            tx = torch.from_numpy(np.moveaxis(x, -1, 1))
+            ty = torch.nn.functional.interpolate(
+                tx, size=(10, 14), mode="bilinear", align_corners=align)
+            ty = np.moveaxis(ty.numpy(), 1, -1)
+            if align:
+                np.testing.assert_allclose(np.asarray(y), ty, rtol=1e-4, atol=1e-5)
+            else:
+                # half_pixel vs TF's legacy asymmetric mapping differ at
+                # non-sample points; both agree on shape and range
+                assert y.shape == ty.shape
+
+    def test_resize_bilinear_identity(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 6, 6, 2))
+        y, _, _ = run(nn.ResizeBilinear(6, 6), x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+
+    def test_cropping3d(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 6, 7, 8, 3))
+        y, _, out_shape = run(nn.Cropping3D((1, 2), (0, 1), (2, 2)), x)
+        assert y.shape == out_shape == (2, 3, 6, 4, 3)
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(x[:, 1:4, 0:6, 2:6, :]))
+
+    def test_graph_aliases(self):
+        assert nn.StaticGraph is nn.Graph and nn.DynamicGraph is nn.Graph
+
+
+class TestConvLSTM3D:
+    def test_shapes_and_recurrence(self):
+        cell = nn.ConvLSTMPeephole3D(2, 4, 3, 3)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 4, 4, 4, 2))
+        rec = nn.Recurrent(cell)
+        params, state, out_shape = rec.build(jax.random.PRNGKey(0),
+                                             (2, 5, 4, 4, 4, 2))
+        y, _ = rec.apply(params, state, x)
+        assert y.shape == (2, 5, 4, 4, 4, 4)
+
+    def test_no_peephole(self):
+        cell = nn.ConvLSTMPeephole3D(2, 3, 3, 3, with_peephole=False)
+        params, _, _ = cell.build(jax.random.PRNGKey(0), (1, 4, 4, 4, 2))
+        assert "peep" not in params
+
+
+class TestReviewRegressions:
+    def test_mv_output_shape_tuple_input(self):
+        assert nn.MV().output_shape(((2, 3, 4), (2, 4))) == (2, 3)
+        assert nn.MV(trans=True).output_shape(((2, 3, 4), (2, 3))) == (2, 4)
+
+    def test_keras_zeropadding2d_nested_form(self):
+        import bigdl_tpu.keras as keras
+        layer = keras.ZeroPadding2D(((1, 2), (3, 4)))
+        m = layer._make((2, 4, 5, 3))
+        y, _, _ = run(m, jnp.zeros((2, 4, 5, 3)))
+        assert y.shape == (2, 7, 12, 3)
+
+    def test_random_connection_table_varies(self):
+        a = nn.random_connection_table(8, 8, 4)
+        b = nn.random_connection_table(8, 8, 4)
+        c = nn.random_connection_table(8, 8, 4, seed=5)
+        d = nn.random_connection_table(8, 8, 4, seed=5)
+        assert c == d
+        assert a != b or a != c  # fresh entropy (overwhelmingly likely)

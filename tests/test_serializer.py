@@ -108,6 +108,35 @@ EXEMPLARS = {
     "LookupTable": (lambda: nn.LookupTable(10, 4),
                     lambda: jnp.asarray([[1, 2], [3, 4]], jnp.int32)),
     "MM": (lambda: nn.MM(), lambda: table((2, 3, 4), (2, 4, 5))),
+    "MV": (lambda: nn.MV(), lambda: table((2, 3, 4), (2, 4))),
+    "GaussianSampler": (lambda: nn.GaussianSampler(), None),  # needs rng
+    "NormalizeScale": (lambda: nn.NormalizeScale(scale=20.0, size=(4,)),
+                       lambda: rand(2, 4)),
+    "SpatialWithinChannelLRN": (lambda: nn.SpatialWithinChannelLRN(3),
+                                lambda: rand(2, 5, 5, 3)),
+    "SpatialSubtractiveNormalization": (
+        lambda: nn.SpatialSubtractiveNormalization(3),
+        lambda: rand(2, 5, 5, 3)),
+    "SpatialDivisiveNormalization": (
+        lambda: nn.SpatialDivisiveNormalization(3),
+        lambda: rand(2, 5, 5, 3)),
+    "SpatialContrastiveNormalization": (
+        lambda: nn.SpatialContrastiveNormalization(3),
+        lambda: rand(2, 5, 5, 3)),
+    "SpatialShareConvolution": (lambda: nn.SpatialShareConvolution(3, 4, 3, 3),
+                                lambda: rand(2, 5, 5, 3)),
+    "SpatialConvolutionMap": (
+        lambda: nn.SpatialConvolutionMap(nn.one_to_one_connection_table(3), 3, 3),
+        lambda: rand(2, 5, 5, 3)),
+    "LocallyConnected1D": (lambda: nn.LocallyConnected1D(6, 3, 4, 3),
+                           lambda: rand(2, 6, 3)),
+    "LocallyConnected2D": (lambda: nn.LocallyConnected2D(3, 5, 5, 4, 3, 3),
+                           lambda: rand(2, 5, 5, 3)),
+    "ResizeBilinear": (lambda: nn.ResizeBilinear(8, 8),
+                       lambda: rand(2, 5, 5, 3)),
+    "Cropping3D": (lambda: nn.Cropping3D((1, 1), (1, 1), (1, 1)),
+                   lambda: rand(2, 5, 5, 5, 3)),
+    "ConvLSTMPeephole3D": (lambda: nn.ConvLSTMPeephole3D(2, 3), None),
     "MapTable": (lambda: nn.MapTable(nn.Linear(4, 2)),
                  lambda: table((2, 4), (2, 4))),
     "Max": (lambda: nn.Max(1), lambda: rand(2, 3)),
