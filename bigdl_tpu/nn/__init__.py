@@ -88,6 +88,7 @@ from bigdl_tpu.nn.reshape import (
     JoinTable,
     Padding,
     Cropping3D,
+    VolumetricZeroPadding,
 )
 from bigdl_tpu.nn.arithmetic import (
     CAddTable,
@@ -150,6 +151,7 @@ from bigdl_tpu.nn.quantized import (
     quantize,
 )
 from bigdl_tpu.nn import ops
+from bigdl_tpu.nn import tf_ops
 from bigdl_tpu.nn.criterion import (
     Criterion,
     ClassNLLCriterion,

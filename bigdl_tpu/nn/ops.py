@@ -488,3 +488,443 @@ class MkString(Operation):
         return np.asarray(
             [self.str_delimiter.join(fmt(v) for v in row) for row in arr],
             dtype=object)
+
+
+# ---------------------------------------------------------------------------
+# elementwise math op zoo (reference: nn/ops/{Floor,Round,Erf,...}.scala —
+# thin forward-only wrappers used by loaded TF graphs)
+# ---------------------------------------------------------------------------
+
+
+class _Elementwise(Operation):
+    _fn: Callable = None
+
+    def compute(self, x):
+        return type(self)._fn(jnp.asarray(x))
+
+
+class Floor(_Elementwise):
+    """reference: nn/ops/Floor.scala."""
+    _fn = staticmethod(jnp.floor)
+
+
+class Rint(_Elementwise):
+    """Round to nearest even integer. reference: nn/ops/Rint.scala."""
+    _fn = staticmethod(jnp.rint)
+
+
+class Round(_Elementwise):
+    """reference: nn/ops/Round.scala (TF Round = half-to-even)."""
+    _fn = staticmethod(jnp.rint)
+
+
+class Erf(_Elementwise):
+    """reference: nn/ops/Erf.scala."""
+    _fn = staticmethod(jax.scipy.special.erf)
+
+
+class Erfc(_Elementwise):
+    """reference: nn/ops/Erfc.scala."""
+    _fn = staticmethod(jax.scipy.special.erfc)
+
+
+class Expm1(_Elementwise):
+    """reference: nn/ops/Expm1.scala."""
+    _fn = staticmethod(jnp.expm1)
+
+
+class Digamma(_Elementwise):
+    """reference: nn/ops/Digamma.scala."""
+    _fn = staticmethod(jax.scipy.special.digamma)
+
+
+class Lgamma(_Elementwise):
+    """reference: nn/ops/Lgamma.scala."""
+    _fn = staticmethod(jax.scipy.special.gammaln)
+
+
+class IsFinite(_Elementwise):
+    """reference: nn/ops/IsFinite.scala."""
+    _fn = staticmethod(jnp.isfinite)
+
+
+class IsInf(_Elementwise):
+    """reference: nn/ops/IsInf.scala."""
+    _fn = staticmethod(jnp.isinf)
+
+
+class IsNan(_Elementwise):
+    """reference: nn/ops/IsNan.scala."""
+    _fn = staticmethod(jnp.isnan)
+
+
+class Pow(Operation):
+    """{base, exponent} -> base ** exponent. reference: nn/ops/Pow.scala."""
+
+    def compute(self, x):
+        a, b = _pair(x)
+        return jnp.power(jnp.asarray(a), jnp.asarray(b))
+
+
+class FloorMod(Operation):
+    """Python/TF-style modulo (sign follows divisor).
+    reference: nn/ops/FloorMod.scala."""
+
+    def compute(self, x):
+        a, b = _pair(x)
+        return jnp.mod(jnp.asarray(a), jnp.asarray(b))
+
+
+class TruncateDiv(Operation):
+    """Integer division truncating toward zero.
+    reference: nn/ops/TruncateDiv.scala."""
+
+    def compute(self, x):
+        a, b = _pair(x)
+        a, b = jnp.asarray(a), jnp.asarray(b)
+        return (jnp.sign(a) * jnp.sign(b) *
+                (jnp.abs(a) // jnp.abs(b))).astype(a.dtype)
+
+
+class ApproximateEqual(Operation):
+    """|a - b| < tolerance. reference: nn/ops/ApproximateEqual.scala."""
+
+    def __init__(self, tolerance: float = 1e-5, name: Optional[str] = None):
+        super().__init__(name)
+        self.tolerance = tolerance
+
+    def compute(self, x):
+        a, b = _pair(x)
+        return jnp.abs(jnp.asarray(a) - jnp.asarray(b)) < self.tolerance
+
+
+class Prod(Operation):
+    """Product along an axis. reference: nn/ops/Prod.scala (1-based axis in
+    the reference; 0-based here like the rest of the port)."""
+
+    def __init__(self, axis: int = 0, keep_dims: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.axis = axis
+        self.keep_dims = keep_dims
+
+    def compute(self, x):
+        return jnp.prod(jnp.asarray(x), axis=self.axis,
+                        keepdims=self.keep_dims)
+
+
+class RangeOps(Operation):
+    """{start, limit, delta} -> arange. reference: nn/ops/RangeOps.scala.
+    Host-side (shape depends on values, so it cannot live under jit)."""
+
+    def compute(self, x):
+        start, limit, delta = [np.asarray(v).item() for v in list(x)]
+        return jnp.arange(start, limit, delta)
+
+
+class L2Loss(Operation):
+    """sum(x^2) / 2. reference: nn/ops/L2Loss.scala."""
+
+    def compute(self, x):
+        return jnp.sum(jnp.square(jnp.asarray(x))) / 2.0
+
+
+class BatchMatMul(Operation):
+    """Batched matmul with optional adjoints.
+    reference: nn/ops/BatchMatMul.scala."""
+
+    def __init__(self, adj_x: bool = False, adj_y: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.adj_x, self.adj_y = adj_x, adj_y
+
+    def compute(self, x):
+        a, b = _pair(x)
+        if self.adj_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.adj_y:
+            b = jnp.swapaxes(b, -1, -2)
+        return a @ b
+
+
+class SegmentSum(Operation):
+    """{data, segment_ids} -> per-segment sums over axis 0.
+    reference: nn/ops/SegmentSum.scala:25-50 (ids sorted ascending; output
+    rows = last id + 1).  Uses jax segment_sum (one scatter-add on device);
+    num_segments read from the ids (host trip, like the reference)."""
+
+    def compute(self, x):
+        data, ids = _pair(x)
+        ids = jnp.asarray(ids, jnp.int32)
+        num = int(ids[-1]) + 1
+        return jax.ops.segment_sum(jnp.asarray(data), ids, num_segments=num)
+
+
+class TruncatedNormal(Operation):
+    """Sample from a truncated normal (±2 sigma) of the given shape.
+    reference: nn/ops/TruncatedNormal.scala (shape arrives as the input
+    tensor, mean/stddev are constructor args)."""
+
+    def __init__(self, mean: float = 0.0, stddev: float = 1.0, seed: int = 0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.mean, self.stddev, self.seed = mean, stddev, seed
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        shape = tuple(np.asarray(x).astype(int).tolist())
+        if rng is None:  # seeded fallback; step rng gives fresh draws
+            rng = jax.random.PRNGKey(self.seed)
+        z = jax.random.truncated_normal(rng, -2.0, 2.0, shape)
+        return lax.stop_gradient(z * self.stddev + self.mean), state
+
+
+class CrossEntropyOp(Operation):
+    """{logits, one-hot labels} -> per-sample softmax cross entropy.
+    reference: nn/ops/CrossEntropy.scala (the forward-only TF op, distinct
+    from the trainable CrossEntropyCriterion)."""
+
+    def compute(self, x):
+        logits, labels = _pair(x)
+        logp = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+        return -jnp.sum(jnp.asarray(labels) * logp, axis=-1)
+
+
+class DepthwiseConv2DOp(Operation):
+    """Forward-only depthwise conv (TF DepthwiseConv2dNative): input
+    {x NHWC, filter (kh, kw, C, multiplier)}.
+    reference: nn/ops/DepthwiseConv2D.scala."""
+
+    def __init__(self, stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = -1, pad_h: int = -1, name: Optional[str] = None):
+        super().__init__(name)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+
+    def compute(self, x):
+        inp, filt = _pair(x)
+        kh, kw, c, mult = filt.shape
+        w = jnp.reshape(filt, (kh, kw, 1, c * mult))
+        pad = ("SAME" if self.pad == (-1, -1)
+               else [(self.pad[0], self.pad[0]), (self.pad[1], self.pad[1])])
+        return lax.conv_general_dilated(
+            inp, w, self.stride, pad,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=c)
+
+
+class Dilation2D(Operation):
+    """Greyscale morphological dilation: {x NHWC, filter (kh, kw, C)}.
+    out[b,y,x,c] = max_{dy,dx} (x[b, y*s+dy*r, x*s+dx*r, c] + filter[dy,dx,c]).
+    reference: nn/ops/Dilation2D.scala.  Realised as a max-plus
+    reduce_window per filter tap (XLA fuses the unrolled taps)."""
+
+    def __init__(self, strides: Sequence[int] = (1, 1, 1, 1),
+                 rates: Sequence[int] = (1, 1, 1, 1),
+                 padding: str = "SAME", name: Optional[str] = None):
+        super().__init__(name)
+        self.strides = tuple(strides)
+        self.rates = tuple(rates)
+        self.padding = padding.upper()
+
+    def compute(self, x):
+        inp, filt = _pair(x)
+        kh, kw, _ = filt.shape
+        sh, sw = self.strides[1], self.strides[2]
+        rh, rw = self.rates[1], self.rates[2]
+        eff_h, eff_w = (kh - 1) * rh + 1, (kw - 1) * rw + 1
+        if self.padding == "SAME":
+            ph = max(0, (-(-inp.shape[1] // sh) - 1) * sh + eff_h - inp.shape[1])
+            pw = max(0, (-(-inp.shape[2] // sw) - 1) * sw + eff_w - inp.shape[2])
+            pads = [(0, 0), (ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2), (0, 0)]
+        else:
+            pads = [(0, 0)] * 4
+        padded = jnp.pad(inp, pads, constant_values=-jnp.inf)
+        oh = (padded.shape[1] - eff_h) // sh + 1
+        ow = (padded.shape[2] - eff_w) // sw + 1
+        out = jnp.full((inp.shape[0], oh, ow, inp.shape[3]), -jnp.inf, inp.dtype)
+        for dy in range(kh):
+            for dx in range(kw):
+                win = lax.slice(
+                    padded, (0, dy * rh, dx * rw, 0),
+                    (padded.shape[0], dy * rh + (oh - 1) * sh + 1,
+                     dx * rw + (ow - 1) * sw + 1, padded.shape[3]),
+                    (1, sh, sw, 1))
+                out = jnp.maximum(out, win + filt[dy, dx])
+        return out
+
+
+class ResizeBilinearOp(Operation):
+    """Forward-only resize (TF ResizeBilinear op).
+    reference: nn/ops/ResizeBilinear.scala — wraps the nn layer."""
+
+    def __init__(self, align_corners: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self.align_corners = align_corners
+
+    def compute(self, x):
+        from bigdl_tpu.nn.structural import ResizeBilinear as _RB
+        inp, size = _pair(x)
+        oh, ow = [int(v) for v in np.asarray(size).tolist()]
+        y, _ = _RB(oh, ow, self.align_corners).apply({}, {}, inp)
+        return y
+
+
+class BucketizedCol(Operation):
+    """Numeric column -> bucket index per `boundaries` (TF
+    bucketized_column).  reference: nn/ops/BucketizedCol.scala."""
+
+    def __init__(self, boundaries: Sequence[float], name: Optional[str] = None):
+        super().__init__(name)
+        self.boundaries = jnp.asarray(list(boundaries), jnp.float32)
+
+    def compute(self, x):
+        return jnp.searchsorted(self.boundaries, jnp.asarray(x, jnp.float32),
+                                side="right").astype(jnp.int32)
+
+
+class CategoricalColVocaList(Operation):
+    """String column -> vocabulary ids (host-side strings).
+    reference: nn/ops/CategoricalColVocaList.scala — OOV handling: dropped
+    by default, mapped to len(vocab) when is_set_default, or hashed into
+    [len(vocab), len(vocab)+num_oov_buckets) when num_oov_buckets > 0."""
+
+    def __init__(self, vocabulary: Sequence[str], strDelimiter: str = ",",
+                 is_set_default: bool = False, num_oov_buckets: int = 0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        assert not (is_set_default and num_oov_buckets > 0), \
+            "num_oov_buckets cannot be combined with default_value"
+        self.vocab = {v: i for i, v in enumerate(vocabulary)}
+        self.delim = strDelimiter
+        self.is_set_default = is_set_default
+        self.num_oov_buckets = num_oov_buckets
+
+    def _lookup(self, s: str):
+        if s in self.vocab:
+            return self.vocab[s]
+        if self.num_oov_buckets > 0:
+            return len(self.vocab) + fnv1a(s) % self.num_oov_buckets
+        if self.is_set_default:
+            return len(self.vocab)
+        return None
+
+    def compute(self, x):
+        rows = np.asarray(x, dtype=object).reshape(-1)
+        out = []
+        for row in rows:
+            ids = [self._lookup(tok) for tok in str(row).split(self.delim)]
+            out.append([i for i in ids if i is not None])
+        width = max((len(r) for r in out), default=0)
+        dense = np.full((len(out), width), -1, np.int32)
+        for i, r in enumerate(out):
+            dense[i, :len(r)] = r
+        return jnp.asarray(dense)
+
+
+class Substr(Operation):
+    """{string scalar, pos, len} -> substring (host-side).
+    reference: nn/ops/Substr.scala:25-38."""
+
+    def compute(self, x):
+        data, pos, ln = list(x)
+        s = np.asarray(data, dtype=object).item()
+        if isinstance(s, bytes):
+            s = s.decode()
+        p = int(np.asarray(pos).item())
+        l = int(np.asarray(ln).item())
+        return np.asarray(str(s)[p:p + l], dtype=object)
+
+
+class ModuleToOperation(Operation):
+    """Wrap any Module as a forward-only Operation (gradients blocked).
+    reference: nn/ops/ModuleToOperation.scala."""
+
+    def __init__(self, module: Module, name: Optional[str] = None):
+        super().__init__(name)
+        self.module = module
+
+    def build(self, rng, input_shape):
+        params, state, out = self.module.build(rng, input_shape)
+        return params, state, out
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y, new_state = self.module.apply(params, state, x, training=False,
+                                         rng=rng)
+        return jax.tree_util.tree_map(lax.stop_gradient, y), new_state
+
+    def output_shape(self, input_shape):
+        return self.module.output_shape(input_shape)
+
+
+class TensorOp(Operation):
+    """Composable closure-based tensor transform with operator sugar:
+    `(TensorOp() * 2.3 + 1.2).sqrt()` builds one fused transform; `a >> b`
+    chains.  reference: nn/ops/TensorOp.scala (the `->` chained closures
+    and the arithmetic shortcut API)."""
+
+    def __init__(self, fn: Optional[Callable] = None, name: Optional[str] = None):
+        super().__init__(name)
+        self.fn = fn or (lambda t: t)
+
+    def compute(self, x):
+        return self.fn(jnp.asarray(x))
+
+    def _then(self, g: Callable) -> "TensorOp":
+        f = self.fn
+        return TensorOp(lambda t: g(f(t)))
+
+    def __rshift__(self, other: "TensorOp") -> "TensorOp":
+        return self._then(other.fn)
+
+    def __add__(self, c):
+        return self._then(lambda t: t + c)
+
+    def __sub__(self, c):
+        return self._then(lambda t: t - c)
+
+    def __mul__(self, c):
+        return self._then(lambda t: t * c)
+
+    def __truediv__(self, c):
+        return self._then(lambda t: t / c)
+
+    def __pow__(self, c):
+        return self._then(lambda t: t ** c)
+
+    def abs(self):
+        return self._then(jnp.abs)
+
+    def sqrt(self):
+        return self._then(jnp.sqrt)
+
+    def log(self):
+        return self._then(jnp.log)
+
+    def log1p(self):
+        return self._then(jnp.log1p)
+
+    def exp(self):
+        return self._then(jnp.exp)
+
+    def floor(self):
+        return self._then(jnp.floor)
+
+    def ceil(self):
+        return self._then(jnp.ceil)
+
+    def tanh(self):
+        return self._then(jnp.tanh)
+
+    def sigmoid(self):
+        return self._then(jax.nn.sigmoid)
+
+    def softmax(self):
+        return self._then(lambda t: jax.nn.softmax(t, axis=-1))
+
+    def square(self):
+        return self._then(jnp.square)
+
+    def negative(self):
+        return self._then(jnp.negative)
+
+    def inv(self):
+        return self._then(lambda t: 1.0 / t)

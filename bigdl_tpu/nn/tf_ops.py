@@ -1,0 +1,565 @@
+"""TF-graph structural layers (the reference's nn/tf/ package).
+
+Reference: nn/tf/ — ArrayOps.scala (Const/Fill/InvertPermutation/
+ConcatOffset), StateOps.scala (Variable/Assign), ParsingOps.scala
+(ParseExample/ParseSingleExample over tf.train.Example protos),
+SplitAndSelect.scala, NoOp / Assert / ControlDependency, BiasAdd
+(nn/tf/BiasAdd.scala), Log1p, TensorModuleWrapper, DataFlowOps.scala
+(TensorArray*/Stack*), ImageOps.scala (DecodeRaw/DecodeJpeg/DecodePng).
+
+The reference also carries ~20 hand-written *Grad ops (NNOps.scala:600-1149
+— ReluGrad, FusedBatchNormGrad, MaxPoolGrad, ...) because its autograd is
+manual and imported TF training graphs need explicit backward nodes.  Under
+JAX those nodes are unnecessary: `jax.grad` differentiates the imported
+forward graph directly (utils/session.py trains loaded graphs this way), so
+no Grad ops exist here by design.
+
+The tf.train.Example codec below is a from-scratch protobuf wire-format
+implementation (like the repo's other hand-written schemas in proto/);
+strings/bytes stay host-side, numeric features become jnp arrays.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.core.table import Table
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.ops import Operation, _pair
+
+
+# ---------------------------------------------------------------------------
+# ArrayOps (reference: nn/tf/ArrayOps.scala)
+# ---------------------------------------------------------------------------
+
+
+class Const(Operation):
+    """Emit a constant tensor regardless of input.
+    reference: nn/tf/ArrayOps.scala:32."""
+
+    def __init__(self, value, name: Optional[str] = None):
+        super().__init__(name)
+        self.value = jnp.asarray(value)
+
+    def compute(self, x):
+        return self.value
+
+    def output_shape(self, input_shape):
+        return tuple(self.value.shape)
+
+
+class Fill(Operation):
+    """{shape, scalar} -> filled tensor. reference: nn/tf/ArrayOps.scala:132.
+    Host-side shape read (value-dependent shape cannot live under jit)."""
+
+    def compute(self, x):
+        shape, value = _pair(x)
+        dims = tuple(int(v) for v in np.asarray(shape).reshape(-1))
+        return jnp.full(dims, jnp.asarray(value))
+
+
+class InvertPermutation(Operation):
+    """y[x[i]] = i. reference: nn/tf/ArrayOps.scala:64."""
+
+    def compute(self, x):
+        idx = jnp.asarray(x, jnp.int32)
+        return jnp.zeros_like(idx).at[idx].set(jnp.arange(idx.shape[0],
+                                                          dtype=jnp.int32))
+
+
+class ConcatOffset(Operation):
+    """{concat_dim, shape_1..shape_N} -> per-input start offsets along the
+    concat axis. reference: nn/tf/ArrayOps.scala:102."""
+
+    def compute(self, x):
+        items = list(x)
+        dim = int(np.asarray(items[0]).item())
+        shapes = [np.asarray(s).astype(np.int32) for s in items[1:]]
+        outs, acc = [], 0
+        for s in shapes:
+            off = np.zeros_like(s)
+            off[dim] = acc
+            acc += int(s[dim])
+            outs.append(jnp.asarray(off))
+        return Table(*outs)
+
+
+class BroadcastGradientArgs(Operation):
+    """{shape_a, shape_b} -> axes each side must reduce over to undo numpy
+    broadcasting. reference: nn/tf/ArrayOps.scala:197."""
+
+    def compute(self, x):
+        sa, sb = [list(np.asarray(v).astype(int)) for v in _pair(x)]
+        n = max(len(sa), len(sb))
+        pa = [1] * (n - len(sa)) + sa
+        pb = [1] * (n - len(sb)) + sb
+        # TF bcast rule: each side reduces every axis whose (1-padded) dim
+        # is 1 — including axes where both are 1 (harmless, matches TF)
+        return Table(jnp.asarray([i for i in range(n) if pa[i] == 1], jnp.int32),
+                     jnp.asarray([i for i in range(n) if pb[i] == 1], jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# structural / control (NoOp, Assert, ControlDependency, SplitAndSelect,
+# BiasAdd, Log1p, TensorModuleWrapper)
+# ---------------------------------------------------------------------------
+
+
+class NoOp(Operation):
+    """Pass-through marker node. reference: nn/tf/NoOp.scala."""
+
+    def compute(self, x):
+        return x
+
+
+class Assert(Operation):
+    """{condition, data} -> data, raising when the host-readable condition
+    is false. reference: nn/tf/Assert.scala.  Uses checkify-style host
+    check via jax.debug outside jit; inside jit it is a no-op passthrough
+    (XLA has no exceptions)."""
+
+    def __init__(self, message: str = "Assert failed", name: Optional[str] = None):
+        super().__init__(name)
+        self.message = message
+
+    def compute(self, x):
+        cond, data = _pair(x)
+        if isinstance(cond, jax.core.Tracer):  # under jit: passthrough
+            return data
+        if not bool(np.asarray(cond).all()):
+            raise AssertionError(self.message)
+        return data
+
+
+class ControlDependency(Operation):
+    """Order-only edge: forwards input 1, ignores the rest.
+    reference: nn/tf/ControlDependency.scala (under XLA, ordering is data
+    dependence — this survives only as a graph-shape adapter)."""
+
+    def compute(self, x):
+        return list(x)[0] if isinstance(x, (Table, list, tuple)) else x
+
+
+class SplitAndSelect(Operation):
+    """Split along `dimension` into `num_split` parts, emit part `index`.
+    reference: nn/tf/SplitAndSelect.scala."""
+
+    def __init__(self, dimension: int, index: int, num_split: int,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dimension = dimension
+        self.index = index
+        self.num_split = num_split
+
+    def compute(self, x):
+        return jnp.split(jnp.asarray(x), self.num_split,
+                         axis=self.dimension)[self.index]
+
+    def output_shape(self, input_shape):
+        s = list(input_shape)
+        s[self.dimension] //= self.num_split
+        return tuple(s)
+
+
+class BiasAdd(Module):
+    """{value, bias} -> value + bias broadcast over the channel axis.
+    reference: nn/tf/BiasAdd.scala.  A Module (not Operation): imported TF
+    training graphs need gradients through it."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        value, bias = _pair(x)
+        return value + bias, state
+
+    def output_shape(self, input_shape):
+        return list(input_shape)[0]
+
+
+class Log1p(Module):
+    """log(1 + x), differentiable. reference: nn/tf/Log1p.scala."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.log1p(x), state
+
+
+class TensorModuleWrapper(Module):
+    """Adapt a Tensor-in/Tensor-out module into an op-graph node.
+    reference: nn/tf/TensorModuleWrapper.scala.  Our modules already take
+    arrays, so this is a transparent delegator kept for name parity."""
+
+    def __init__(self, module: Module, name: Optional[str] = None):
+        super().__init__(name)
+        self.module = module
+
+    def build(self, rng, input_shape):
+        return self.module.build(rng, input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return self.module.apply(params, state, x, training=training, rng=rng)
+
+    def output_shape(self, input_shape):
+        return self.module.output_shape(input_shape)
+
+
+# ---------------------------------------------------------------------------
+# StateOps (reference: nn/tf/StateOps.scala) — mutable TF variables.
+# Functionally: the variable lives in `state`, Assign returns updated state.
+# ---------------------------------------------------------------------------
+
+
+class Variable(Module):
+    """A stateful value node.  reference: nn/tf/StateOps.scala:27 —
+    there the tensor mutates in place; here it lives in `state` and
+    Assign produces the next state (functional, jit-safe)."""
+
+    def __init__(self, value, trainable: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.initial = jnp.asarray(value)
+        self.trainable = trainable
+
+    def build(self, rng, input_shape):
+        if self.trainable:
+            return {"value": self.initial}, {}, tuple(self.initial.shape)
+        return {}, {"value": self.initial}, tuple(self.initial.shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return (params if self.trainable else state)["value"], state
+
+    def output_shape(self, input_shape):
+        return tuple(self.initial.shape)
+
+
+class Assign(Module):
+    """{ref_state, value} -> value, with the new value also returned as
+    state (the functional reading of TF Assign).
+    reference: nn/tf/StateOps.scala:71."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        _, value = _pair(x)
+        return value, {"value": value}
+
+
+# ---------------------------------------------------------------------------
+# tf.train.Example wire-format codec + ParsingOps
+# (reference: nn/tf/ParsingOps.scala:36-93)
+# ---------------------------------------------------------------------------
+
+
+def _varint(buf: bytes, off: int) -> Tuple[int, int]:
+    r = s = 0
+    while True:
+        b = buf[off]
+        off += 1
+        r |= (b & 0x7F) << s
+        if not b & 0x80:
+            return r, off
+        s += 7
+
+
+def _enc_varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _fields(buf: bytes):
+    off = 0
+    while off < len(buf):
+        key, off = _varint(buf, off)
+        field, wire = key >> 3, key & 7
+        if wire == 2:  # length-delimited
+            ln, off = _varint(buf, off)
+            yield field, buf[off:off + ln]
+            off += ln
+        elif wire == 0:
+            v, off = _varint(buf, off)
+            yield field, v
+        elif wire == 5:
+            yield field, buf[off:off + 4]
+            off += 4
+        elif wire == 1:
+            yield field, buf[off:off + 8]
+            off += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+
+
+def parse_example_proto(buf: bytes) -> Dict[str, Union[np.ndarray, List[bytes]]]:
+    """Decode a serialized tf.train.Example into {name: ndarray | [bytes]}.
+
+    Schema (tensorflow/core/example/{example,feature}.proto): Example{1:
+    Features}, Features{1: map<string, Feature>}, Feature = oneof
+    {1: BytesList, 2: FloatList, 3: Int64List}, each list field 1 repeated.
+    """
+    out: Dict[str, Any] = {}
+    for f, features in _fields(buf):
+        if f != 1:
+            continue
+        for f2, entry in _fields(features):
+            if f2 != 1:
+                continue
+            key, feature = None, b""
+            for f3, v in _fields(entry):
+                if f3 == 1:
+                    key = v.decode()
+                elif f3 == 2:
+                    feature = v
+            if key is None:
+                continue
+            for f4, payload in _fields(feature):
+                if f4 == 1:  # BytesList
+                    out[key] = [v for f5, v in _fields(payload) if f5 == 1]
+                elif f4 == 2:  # FloatList (packed floats)
+                    vals: List[float] = []
+                    for f5, v in _fields(payload):
+                        if f5 != 1:
+                            continue
+                        if isinstance(v, bytes):  # packed
+                            vals.extend(struct.unpack(f"<{len(v)//4}f", v))
+                        else:
+                            vals.append(struct.unpack("<f", struct.pack("<I", v))[0])
+                    out[key] = np.asarray(vals, np.float32)
+                elif f4 == 3:  # Int64List (packed varints)
+                    ivals: List[int] = []
+                    if isinstance(payload, bytes):
+                        o = 0
+                        # field 1 entries: either packed buffer or repeated varint
+                        for f5, v in _fields(payload):
+                            if f5 != 1:
+                                continue
+                            if isinstance(v, bytes):
+                                o = 0
+                                while o < len(v):
+                                    iv, o = _varint(v, o)
+                                    ivals.append(iv)
+                            else:
+                                ivals.append(v)
+                    out[key] = np.asarray(ivals, np.int64)
+    return out
+
+
+def build_example_proto(features: Dict[str, Any]) -> bytes:
+    """Encode {name: ndarray | bytes | [bytes]} as tf.train.Example."""
+    def ld(field: int, payload: bytes) -> bytes:
+        return _enc_varint(field << 3 | 2) + _enc_varint(len(payload)) + payload
+
+    entries = b""
+    for key, value in features.items():
+        if isinstance(value, bytes):
+            value = [value]
+        if isinstance(value, (list, tuple)) and all(
+                isinstance(v, bytes) for v in value):
+            blist = b"".join(ld(1, v) for v in value)
+            feature = ld(1, blist)
+        else:
+            arr = np.asarray(value)
+            if np.issubdtype(arr.dtype, np.integer):
+                packed = b"".join(_enc_varint(int(v) & (2**64 - 1))
+                                  for v in arr.reshape(-1))
+                feature = ld(3, ld(1, packed))
+            else:
+                packed = struct.pack(f"<{arr.size}f",
+                                     *arr.astype(np.float32).reshape(-1))
+                feature = ld(2, ld(1, packed))
+        entries += ld(1, ld(1, key.encode()) + ld(2, feature))
+    return ld(1, entries)
+
+
+class ParseSingleExample(Operation):
+    """Parse ONE serialized tf.train.Example into a Table of dense tensors
+    in `dense_keys` order.  reference: nn/tf/ParsingOps.scala:93."""
+
+    def __init__(self, dense_keys: Sequence[str],
+                 dense_shapes: Optional[Sequence[Sequence[int]]] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dense_keys = list(dense_keys)
+        self.dense_shapes = ([tuple(s) for s in dense_shapes]
+                             if dense_shapes else None)
+
+    def _one(self, buf: bytes) -> List[Any]:
+        feats = parse_example_proto(bytes(buf))
+        row = []
+        for i, k in enumerate(self.dense_keys):
+            v = feats[k]
+            if isinstance(v, list):  # bytes feature
+                row.append(np.asarray(v, dtype=object))
+                continue
+            if self.dense_shapes:
+                v = v.reshape(self.dense_shapes[i])
+            row.append(jnp.asarray(v))
+        return row
+
+    def compute(self, x):
+        buf = x if isinstance(x, (bytes, bytearray)) else bytes(
+            np.asarray(x, dtype=object).item())
+        return Table(*self._one(buf))
+
+
+class ParseExample(ParseSingleExample):
+    """Parse a BATCH of serialized Examples; dense features are stacked
+    along axis 0.  reference: nn/tf/ParsingOps.scala:36."""
+
+    def compute(self, x):
+        bufs = [bytes(b) for b in np.asarray(x, dtype=object).reshape(-1)]
+        rows = [self._one(b) for b in bufs]
+        cols = []
+        for i in range(len(self.dense_keys)):
+            vals = [r[i] for r in rows]
+            if isinstance(vals[0], np.ndarray) and vals[0].dtype == object:
+                cols.append(np.stack(vals))
+            else:
+                cols.append(jnp.stack(vals))
+        return Table(*cols)
+
+
+# ---------------------------------------------------------------------------
+# DataFlowOps: TensorArray / Stack (reference: nn/tf/DataFlowOps.scala).
+# Host-side containers used when executing imported TF graphs eagerly; under
+# jit, loops carry arrays through lax.scan instead.
+# ---------------------------------------------------------------------------
+
+
+class TensorArray:
+    """Growable list of tensors keyed by index
+    (reference: DataFlowOps.scala:176-576 TensorArray* ops)."""
+
+    def __init__(self, size: int = 0, dynamic_size: bool = True):
+        self._items: Dict[int, Any] = {}
+        self.size_hint = size
+        self.dynamic_size = dynamic_size
+
+    def write(self, index: int, value):
+        if not self.dynamic_size and index >= self.size_hint:
+            raise IndexError(f"index {index} out of fixed size {self.size_hint}")
+        self._items[index] = value
+        return self
+
+    def read(self, index: int):
+        return self._items[index]
+
+    def size(self) -> int:
+        return max(self.size_hint, (max(self._items) + 1) if self._items else 0)
+
+    def gather(self, indices=None):
+        idx = range(self.size()) if indices is None else [int(i) for i in indices]
+        return jnp.stack([self._items[i] for i in idx])
+
+    def scatter(self, values):
+        for i, v in enumerate(values):
+            self.write(i, v)
+        return self
+
+    def concat(self):
+        return jnp.concatenate([self._items[i] for i in range(self.size())])
+
+    def split(self, value, lengths):
+        off = 0
+        for i, ln in enumerate(int(v) for v in lengths):
+            self.write(i, value[off:off + ln])
+            off += ln
+        return self
+
+    def close(self):
+        self._items.clear()
+
+
+class Stack:
+    """LIFO of tensors (reference: DataFlowOps.scala:579-676 Stack*)."""
+
+    def __init__(self, max_size: int = -1):
+        self._items: List[Any] = []
+        self.max_size = max_size
+
+    def push(self, v):
+        if 0 <= self.max_size <= len(self._items):
+            raise OverflowError("stack full")
+        self._items.append(v)
+        return v
+
+    def pop(self):
+        return self._items.pop()
+
+
+# ---------------------------------------------------------------------------
+# ImageOps (reference: nn/tf/ImageOps.scala) — host-side decoders
+# ---------------------------------------------------------------------------
+
+
+class DecodeRaw(Operation):
+    """Bytes -> flat tensor of `out_type`.
+    reference: nn/tf/ImageOps.scala:150 (little_endian semantics)."""
+
+    def __init__(self, out_type=np.uint8, little_endian: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.out_type = np.dtype(out_type)
+        self.little_endian = little_endian
+
+    def compute(self, x):
+        buf = x if isinstance(x, (bytes, bytearray)) else bytes(
+            np.asarray(x, dtype=object).item())
+        dt = self.out_type.newbyteorder("<" if self.little_endian else ">")
+        return jnp.asarray(np.frombuffer(buf, dt).astype(self.out_type))
+
+
+class DecodeImage(Operation):
+    """Compressed image bytes -> (H, W, C) uint8 via PIL (host-side).
+    reference: nn/tf/ImageOps.scala:36 (DecodeImage base; DecodeJpeg/
+    DecodePng/DecodeBmp/DecodeGif below are format-pinned aliases)."""
+
+    _format: Optional[str] = None
+
+    def __init__(self, channels: int = 0, name: Optional[str] = None):
+        super().__init__(name)
+        self.channels = channels
+
+    def compute(self, x):
+        import io
+
+        from PIL import Image
+
+        buf = x if isinstance(x, (bytes, bytearray)) else bytes(
+            np.asarray(x, dtype=object).item())
+        img = Image.open(io.BytesIO(buf))
+        if self._format and img.format != self._format:
+            raise ValueError(f"expected {self._format}, got {img.format}")
+        if self.channels == 1:
+            img = img.convert("L")
+        elif self.channels == 3:
+            img = img.convert("RGB")
+        elif self.channels == 4:
+            img = img.convert("RGBA")
+        # channels == 0: keep the image's native channel count (TF semantics)
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return jnp.asarray(arr)
+
+
+class DecodeJpeg(DecodeImage):
+    _format = "JPEG"
+
+
+class DecodePng(DecodeImage):
+    _format = "PNG"
+
+
+class DecodeBmp(DecodeImage):
+    _format = "BMP"
+
+
+class DecodeGif(DecodeImage):
+    _format = "GIF"

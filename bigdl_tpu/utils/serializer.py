@@ -95,16 +95,24 @@ def _scan_registry() -> None:
         if isinstance(obj, type) and issubclass(obj, InitializationMethod):
             INIT_REGISTRY[obj.__name__] = obj
 
-    # Forward-only op zoo (reference nn/ops) registers under "ops.<Name>"
-    from bigdl_tpu.nn import ops as ops_mod
+    def _register_prefixed(mod, prefix: str) -> None:
+        for name in dir(mod):
+            if name.startswith("_"):
+                continue
+            obj = getattr(mod, name)
+            if isinstance(obj, type) and issubclass(obj, Module) and \
+                    obj.__module__ == mod.__name__:
+                serial = f"{prefix}.{obj.__name__}"
+                obj._serial_name = serial
+                MODULE_REGISTRY[serial] = obj
 
-    for name in dir(ops_mod):
-        obj = getattr(ops_mod, name)
-        if isinstance(obj, type) and issubclass(obj, Module) and \
-                obj.__module__ == ops_mod.__name__:
-            serial = f"ops.{obj.__name__}"
-            obj._serial_name = serial
-            MODULE_REGISTRY[serial] = obj
+    # Forward-only op zoo (reference nn/ops) registers under "ops.<Name>";
+    # TF-graph structural layers (reference nn/tf) under "tf.<Name>"
+    from bigdl_tpu.nn import ops as ops_mod
+    from bigdl_tpu.nn import tf_ops as tf_mod
+
+    _register_prefixed(ops_mod, "ops")
+    _register_prefixed(tf_mod, "tf")
 
     # Model zoo classes that are Modules in their own right (TransformerLM)
     import bigdl_tpu.models as models_pkg
