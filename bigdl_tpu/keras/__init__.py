@@ -26,13 +26,33 @@ from bigdl_tpu.keras.layers import (
     SimpleRNN,
     TimeDistributed,
     Convolution1D,
+    Convolution3D,
+    AtrousConvolution1D,
+    AtrousConvolution2D,
+    Deconvolution2D,
+    SeparableConvolution2D,
+    ConvLSTM2D,
+    Bidirectional,
+    MaxoutDense,
+    ThresholdedReLU,
+    LocallyConnected1D,
+    LocallyConnected2D,
+    Merge,
     MaxPooling1D,
     GlobalMaxPooling1D,
     GlobalMaxPooling2D,
     GlobalAveragePooling1D,
+    AveragePooling1D,
+    MaxPooling3D,
+    AveragePooling3D,
+    GlobalMaxPooling3D,
+    GlobalAveragePooling3D,
     ZeroPadding1D,
     ZeroPadding2D,
+    ZeroPadding3D,
     Cropping2D,
+    Cropping1D,
+    Cropping3D,
     UpSampling1D,
     UpSampling2D,
     Permute,
@@ -40,6 +60,7 @@ from bigdl_tpu.keras.layers import (
     Highway,
     SpatialDropout1D,
     SpatialDropout2D,
+    SpatialDropout3D,
 )
 from bigdl_tpu.keras.topology import Sequential, Model
 from bigdl_tpu.keras.objectives import (
@@ -60,7 +81,12 @@ __all__ = [
     "GlobalMaxPooling2D", "GlobalAveragePooling1D", "ZeroPadding1D",
     "ZeroPadding2D", "Cropping2D", "UpSampling1D", "UpSampling2D",
     "Permute", "RepeatVector", "Highway", "SpatialDropout1D",
-    "SpatialDropout2D",
+    "SpatialDropout2D", "SpatialDropout3D", "Cropping1D", "Cropping3D",
+    "ZeroPadding3D", "AveragePooling1D", "MaxPooling3D", "AveragePooling3D",
+    "GlobalMaxPooling3D", "GlobalAveragePooling3D", "Convolution3D",
+    "AtrousConvolution1D", "AtrousConvolution2D", "Deconvolution2D",
+    "SeparableConvolution2D", "ConvLSTM2D", "Bidirectional", "MaxoutDense",
+    "ThresholdedReLU", "LocallyConnected1D", "LocallyConnected2D", "Merge",
     "CategoricalCrossEntropy", "resolve_loss", "resolve_optimizer",
     "resolve_metrics",
 ]

@@ -502,3 +502,401 @@ class SpatialDropout2D(SpatialDropout1D):
 
     def _make(self, input_shape):
         return nn.SpatialDropout2D(self.p)
+
+
+class SpatialDropout3D(SpatialDropout1D):
+    """reference: nn/keras/SpatialDropout3D.scala."""
+
+    def _make(self, input_shape):
+        return nn.SpatialDropout3D(self.p)
+
+
+class MaxPooling3D(KerasLayer):
+    """NDHWC volumetric max pool. reference: nn/keras/MaxPooling3D.scala."""
+
+    def __init__(self, pool_size=(2, 2, 2), strides=None,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.pool_size = tuple(pool_size)
+        self.strides = tuple(strides) if strides else self.pool_size
+
+    def _make(self, input_shape):
+        (kt, kh, kw), (dt, dh, dw) = self.pool_size, self.strides
+        return nn.VolumetricMaxPooling(kt, kw, kh, dt, dw, dh)
+
+
+class AveragePooling3D(MaxPooling3D):
+    """reference: nn/keras/AveragePooling3D.scala."""
+
+    def _make(self, input_shape):
+        (kt, kh, kw), (dt, dh, dw) = self.pool_size, self.strides
+        return nn.VolumetricAveragePooling(kt, kw, kh, dt, dw, dh)
+
+
+class AveragePooling1D(KerasLayer):
+    """reference: nn/keras/AveragePooling1D.scala.  Composed as a width-1
+    2-D avg pool over (N, T, 1, C)."""
+
+    def __init__(self, pool_length: int = 2, stride: Optional[int] = None,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.pool_length = pool_length
+        self.stride = stride or pool_length
+
+    def _make(self, input_shape):
+        return nn.Sequential(
+            nn.Unsqueeze(2),
+            nn.SpatialAveragePooling(1, self.pool_length, 1, self.stride),
+            nn.Squeeze(2))
+
+
+class GlobalMaxPooling3D(KerasLayer):
+    """reference: nn/keras/GlobalMaxPooling3D.scala."""
+
+    def _make(self, input_shape):
+        return nn.Sequential(nn.Max(1), nn.Max(1), nn.Max(1))
+
+
+class GlobalAveragePooling3D(KerasLayer):
+    """reference: nn/keras/GlobalAveragePooling3D.scala."""
+
+    def _make(self, input_shape):
+        return nn.Sequential(nn.Mean(1), nn.Mean(1), nn.Mean(1))
+
+
+class Convolution3D(KerasLayer):
+    """NDHWC volumetric conv. reference: nn/keras/Convolution3D.scala."""
+
+    def __init__(self, nb_filter: int, kernel_dim1: int, kernel_dim2: int,
+                 kernel_dim3: int, activation: Optional[str] = None,
+                 border_mode: str = "valid", subsample=(1, 1, 1),
+                 bias: bool = True,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.nb_filter = nb_filter
+        self.kernel = (kernel_dim1, kernel_dim2, kernel_dim3)
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample = tuple(subsample)
+        self.bias = bias
+
+    def _make(self, input_shape):
+        cin = input_shape[-1]
+        kt, kh, kw = self.kernel
+        dt, dh, dw = self.subsample
+        if self.border_mode == "same":
+            pt = ph = pw = -1
+        else:
+            pt = ph = pw = 0
+        core = nn.VolumetricConvolution(
+            cin, self.nb_filter, kt, kw, kh, dt, dw, dh, pt, pw, ph,
+            with_bias=self.bias)
+        return _with_activation(core, self.activation)
+
+
+class AtrousConvolution2D(KerasLayer):
+    """Dilated conv. reference: nn/keras/AtrousConvolution2D.scala."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation: Optional[str] = None,
+                 subsample=(1, 1), atrous_rate=(1, 1),
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.nb_filter = nb_filter
+        self.nb_row = nb_row
+        self.nb_col = nb_col
+        self.activation = activation
+        self.subsample = tuple(subsample)
+        self.atrous_rate = tuple(atrous_rate)
+
+    def _make(self, input_shape):
+        core = nn.SpatialDilatedConvolution(
+            input_shape[-1], self.nb_filter, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0], 0, 0,
+            self.atrous_rate[1], self.atrous_rate[0])
+        return _with_activation(core, self.activation)
+
+
+class AtrousConvolution1D(KerasLayer):
+    """Dilated 1-D conv over (N, T, C), composed as a width-1 dilated 2-D
+    conv. reference: nn/keras/AtrousConvolution1D.scala."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 activation: Optional[str] = None, subsample_length: int = 1,
+                 atrous_rate: int = 1,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.activation = activation
+        self.subsample_length = subsample_length
+        self.atrous_rate = atrous_rate
+
+    def _make(self, input_shape):
+        core = nn.Sequential(
+            nn.Unsqueeze(2),
+            nn.SpatialDilatedConvolution(
+                input_shape[-1], self.nb_filter, 1, self.filter_length,
+                1, self.subsample_length, 0, 0, 1, self.atrous_rate),
+            nn.Squeeze(2))
+        return _with_activation(core, self.activation)
+
+
+class Deconvolution2D(KerasLayer):
+    """Transposed conv. reference: nn/keras/Deconvolution2D.scala."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation: Optional[str] = None, subsample=(1, 1),
+                 bias: bool = True,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.nb_filter = nb_filter
+        self.nb_row = nb_row
+        self.nb_col = nb_col
+        self.activation = activation
+        self.subsample = tuple(subsample)
+        self.bias = bias
+
+    def _make(self, input_shape):
+        core = nn.SpatialFullConvolution(
+            input_shape[-1], self.nb_filter, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0], with_bias=self.bias)
+        return _with_activation(core, self.activation)
+
+
+class SeparableConvolution2D(KerasLayer):
+    """Depthwise + pointwise. reference: nn/keras/SeparableConvolution2D.scala."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation: Optional[str] = None, border_mode: str = "valid",
+                 subsample=(1, 1), depth_multiplier: int = 1,
+                 bias: bool = True,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.nb_filter = nb_filter
+        self.nb_row = nb_row
+        self.nb_col = nb_col
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample = tuple(subsample)
+        self.depth_multiplier = depth_multiplier
+        self.bias = bias
+
+    def _make(self, input_shape):
+        pad = -1 if self.border_mode == "same" else 0
+        core = nn.SpatialSeparableConvolution(
+            input_shape[-1], self.nb_filter, self.depth_multiplier,
+            self.nb_col, self.nb_row, self.subsample[1], self.subsample[0],
+            pad, pad, with_bias=self.bias)
+        return _with_activation(core, self.activation)
+
+
+class ConvLSTM2D(KerasLayer):
+    """Convolutional LSTM over (N, T, H, W, C).
+    reference: nn/keras/ConvLSTM2D.scala (square kernels, stride 1)."""
+
+    def __init__(self, nb_filter: int, nb_kernel: int,
+                 return_sequences: bool = False,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.nb_filter = nb_filter
+        self.nb_kernel = nb_kernel
+        self.return_sequences = return_sequences
+
+    def _make(self, input_shape):
+        _, t = input_shape[0], input_shape[1]
+        cell = nn.ConvLSTMPeephole(input_shape[-1], self.nb_filter,
+                                   self.nb_kernel, self.nb_kernel)
+        rec = nn.Recurrent(cell)
+        if self.return_sequences:
+            return rec
+        return nn.Sequential(rec, nn.Select(1, t - 1))
+
+
+class Bidirectional(KerasLayer):
+    """Run a recurrent Keras layer forward and backward, merging outputs.
+    reference: nn/keras/Bidirectional.scala (merge modes concat/sum/mul/ave)."""
+
+    def __init__(self, layer: "_Rnn", merge_mode: str = "concat",
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        assert merge_mode in ("concat", "sum", "mul", "ave")
+        self.layer = layer
+        self.merge_mode = merge_mode
+
+    def _make(self, input_shape):
+        _, t, f = input_shape
+        bi = nn.BiRecurrent(self.layer._cell(f), self.layer._cell(f),
+                            merge=self.merge_mode)
+        if self.layer.return_sequences:
+            return bi
+        return nn.Sequential(bi, nn.Select(1, t - 1))
+
+
+class Cropping1D(KerasLayer):
+    """Crop (left, right) timesteps off (N, T, C).
+    reference: nn/keras/Cropping1D.scala."""
+
+    def __init__(self, cropping=(1, 1),
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.cropping = tuple(cropping)
+
+    def _make(self, input_shape):
+        t = input_shape[1]
+        l, r = self.cropping
+        return nn.Narrow(1, l, t - l - r)
+
+
+class Cropping3D(KerasLayer):
+    """reference: nn/keras/Cropping3D.scala."""
+
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)),
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.cropping = tuple(tuple(c) for c in cropping)
+
+    def _make(self, input_shape):
+        return nn.Cropping3D(*self.cropping)
+
+
+class ZeroPadding3D(KerasLayer):
+    """reference: nn/keras/ZeroPadding3D.scala."""
+
+    def __init__(self, padding=(1, 1, 1),
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.padding = tuple(padding)
+
+    def _make(self, input_shape):
+        return nn.VolumetricZeroPadding(*self.padding)
+
+
+class MaxoutDense(KerasLayer):
+    """Dense with maxout over nb_feature linear pieces: out_j = max_k
+    (x W_jk + b_jk). reference: nn/keras/MaxoutDense.scala (wraps Maxout)."""
+
+    def __init__(self, output_dim: int, nb_feature: int = 4,
+                 bias: bool = True,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.output_dim = output_dim
+        self.nb_feature = nb_feature
+        self.bias = bias
+
+    def _make(self, input_shape):
+        return nn.Sequential(
+            nn.Linear(input_shape[-1], self.output_dim * self.nb_feature,
+                      with_bias=self.bias),
+            nn.Reshape((self.nb_feature, self.output_dim)),
+            nn.Max(1))
+
+
+class ThresholdedReLU(KerasLayer):
+    """x if x > theta else 0. reference: nn/keras/ThresholdedReLU.scala."""
+
+    def __init__(self, theta: float = 1.0,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.theta = theta
+
+    def _make(self, input_shape):
+        return nn.Threshold(self.theta, 0.0)
+
+
+class LocallyConnected2D(KerasLayer):
+    """reference: nn/keras/LocallyConnected2D.scala."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation: Optional[str] = None, subsample=(1, 1),
+                 bias: bool = True,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.nb_filter = nb_filter
+        self.nb_row = nb_row
+        self.nb_col = nb_col
+        self.activation = activation
+        self.subsample = tuple(subsample)
+        self.bias = bias
+
+    def _make(self, input_shape):
+        _, h, w, c = input_shape
+        core = nn.LocallyConnected2D(
+            c, w, h, self.nb_filter, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0], with_bias=self.bias)
+        return _with_activation(core, self.activation)
+
+
+class LocallyConnected1D(KerasLayer):
+    """reference: nn/keras/LocallyConnected1D.scala."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 activation: Optional[str] = None, subsample_length: int = 1,
+                 bias: bool = True,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.activation = activation
+        self.subsample_length = subsample_length
+        self.bias = bias
+
+    def _make(self, input_shape):
+        _, t, c = input_shape
+        core = nn.LocallyConnected1D(t, c, self.nb_filter, self.filter_length,
+                                     self.subsample_length,
+                                     with_bias=self.bias)
+        return _with_activation(core, self.activation)
+
+
+class Merge(KerasLayer):
+    """Merge a list of branch layers applied to a Table of inputs.
+    reference: nn/keras/Merge.scala (modes sum/mul/ave/max/concat/dot/cos).
+
+    `Merge([l1, l2], mode)` consumes Table{x1, x2}: each branch processes
+    its own input, then the mode combines the branch outputs."""
+
+    def __init__(self, layers: Sequence[Module], mode: str = "sum",
+                 concat_axis: int = -1,
+                 input_shape: Optional[Sequence[Sequence[int]]] = None,
+                 name: Optional[str] = None):
+        super().__init__(None, name)
+        assert mode in ("sum", "mul", "ave", "max", "concat", "dot", "cosine")
+        self.branches = list(layers)
+        self.mode = mode
+        self.concat_axis = concat_axis
+        # per-branch declared shapes (batch dim excluded), validated in _make
+        self.branch_input_shapes = (
+            [tuple(s) for s in input_shape] if input_shape else None)
+
+    def _make(self, input_shape):
+        if self.branch_input_shapes is not None:
+            actual = [tuple(s)[1:] for s in input_shape]
+            if actual != self.branch_input_shapes:
+                raise ValueError(
+                    f"{self.name}: declared branch shapes "
+                    f"{self.branch_input_shapes} do not match data shapes "
+                    f"{actual} (batch dim excluded)")
+        combine = {
+            "sum": nn.CAddTable(), "mul": nn.CMulTable(),
+            "ave": nn.CAveTable(), "max": nn.CMaxTable(),
+            "concat": nn.JoinTable(self.concat_axis),
+            "dot": nn.DotProduct(), "cosine": nn.CosineDistance(),
+        }[self.mode]
+        return nn.Sequential(nn.ParallelTable(*self.branches), combine)

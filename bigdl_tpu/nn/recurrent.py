@@ -217,6 +217,7 @@ class BiRecurrent(Module):
     def __init__(self, cell_fwd: Cell, cell_bwd: Cell, merge: str = "concat",
                  name: Optional[str] = None):
         super().__init__(name)
+        assert merge in ("concat", "add", "sum", "mul", "ave")
         self.fwd = Recurrent(cell_fwd)
         self.bwd = Recurrent(cell_bwd)
         self.merge = merge
@@ -236,6 +237,10 @@ class BiRecurrent(Module):
         y_b = jnp.flip(y_b, axis=1)
         if self.merge == "concat":
             return jnp.concatenate([y_f, y_b], axis=-1), state
+        if self.merge == "mul":
+            return y_f * y_b, state
+        if self.merge == "ave":
+            return (y_f + y_b) / 2.0, state
         return y_f + y_b, state
 
     def output_shape(self, input_shape):

@@ -328,3 +328,23 @@ class Cropping3D(Module):
         n, d, h, w, c = input_shape
         return (n, d - sum(self.crops[0]), h - sum(self.crops[1]),
                 w - sum(self.crops[2]), c)
+
+
+class VolumetricZeroPadding(Module):
+    """Zero-pad NDHWC dims symmetrically per spatial axis.
+    reference: the keras ZeroPadding3D wrapper's core
+    (nn/keras/ZeroPadding3D.scala pads the 3 spatial dims of 5-D input)."""
+
+    def __init__(self, pad_d: int = 1, pad_h: int = 1, pad_w: int = 1,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.pads = (pad_d, pad_h, pad_w)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        d, h, w = self.pads
+        return jnp.pad(x, [(0, 0), (d, d), (h, h), (w, w), (0, 0)]), state
+
+    def output_shape(self, input_shape):
+        n, D, H, W, c = input_shape
+        d, h, w = self.pads
+        return (n, D + 2 * d, H + 2 * h, W + 2 * w, c)

