@@ -735,11 +735,9 @@ class Bidirectional(KerasLayer):
 
     def _make(self, input_shape):
         _, t, f = input_shape
-        bi = nn.BiRecurrent(self.layer._cell(f), self.layer._cell(f),
-                            merge=self.merge_mode)
-        if self.layer.return_sequences:
-            return bi
-        return nn.Sequential(bi, nn.Select(1, t - 1))
+        return nn.BiRecurrent(self.layer._cell(f), self.layer._cell(f),
+                              merge=self.merge_mode,
+                              return_sequences=self.layer.return_sequences)
 
 
 class Cropping1D(KerasLayer):

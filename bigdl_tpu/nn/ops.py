@@ -199,6 +199,10 @@ class Slice(Operation):
                  for b, s, dim in zip(self.begin, self.size, x.shape)]
         return lax.slice(x, self.begin, [b + s for b, s in zip(self.begin, sizes)])
 
+    def output_shape(self, input_shape):
+        return tuple(dim - b if s == -1 else s
+                     for b, s, dim in zip(self.begin, self.size, input_shape))
+
 
 class StridedSlice(Operation):
     """reference: nn/tf/StridedSlice.scala — python slice semantics."""
@@ -211,6 +215,12 @@ class StridedSlice(Operation):
     def compute(self, x):
         return x[tuple(slice(*s) for s in self.slices)]
 
+    def output_shape(self, input_shape):
+        out = []
+        for dim, s in zip(input_shape, self.slices):
+            out.append(len(range(*slice(*s).indices(dim))))
+        return tuple(out) + tuple(input_shape[len(self.slices):])
+
 
 class Tile(Operation):
     def __init__(self, multiples: Sequence[int], name: Optional[str] = None):
@@ -219,6 +229,12 @@ class Tile(Operation):
 
     def compute(self, x):
         return jnp.tile(x, self.multiples)
+
+    def output_shape(self, input_shape):
+        n = max(len(input_shape), len(self.multiples))
+        s = [1] * (n - len(input_shape)) + list(input_shape)
+        m = [1] * (n - len(self.multiples)) + self.multiples
+        return tuple(d * r for d, r in zip(s, m))
 
 
 class ArgMax(Operation):
@@ -928,3 +944,10 @@ class TensorOp(Operation):
 
     def inv(self):
         return self._then(lambda t: 1.0 / t)
+
+
+# Name-parity aliases for the reference's file names (nn/ops/CrossEntropy.
+# scala, nn/ops/DepthwiseConv2D.scala, nn/ops/Compare.scala base)
+Compare = Operation
+CrossEntropy = CrossEntropyOp
+DepthwiseConv2D = DepthwiseConv2DOp

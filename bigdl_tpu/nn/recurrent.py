@@ -215,26 +215,31 @@ class BiRecurrent(Module):
     reference: nn/BiRecurrent.scala."""
 
     def __init__(self, cell_fwd: Cell, cell_bwd: Cell, merge: str = "concat",
-                 name: Optional[str] = None):
+                 return_sequences: bool = True, name: Optional[str] = None):
         super().__init__(name)
         assert merge in ("concat", "add", "sum", "mul", "ave")
         self.fwd = Recurrent(cell_fwd)
         self.bwd = Recurrent(cell_bwd)
         self.merge = merge
+        # return_sequences=False: merge the two FINAL outputs (fwd at t-1,
+        # bwd at original index 0 — the backward cell's full-sequence
+        # output), matching Keras Bidirectional semantics
+        self.return_sequences = return_sequences
 
     def build(self, rng, input_shape):
         k1, k2 = jax.random.split(rng)
-        p1, s1, out = self.fwd.build(k1, input_shape)
+        p1, s1, _ = self.fwd.build(k1, input_shape)
         p2, s2, _ = self.bwd.build(k2, input_shape)
-        if self.merge == "concat":
-            out = out[:-1] + (out[-1] * 2,)
-        return {"fwd": p1, "bwd": p2}, {"fwd": s1, "bwd": s2}, out
+        return ({"fwd": p1, "bwd": p2}, {"fwd": s1, "bwd": s2},
+                self.output_shape(input_shape))
 
     def apply(self, params, state, x, *, training=False, rng=None):
         y_f, _ = self.fwd.apply(params["fwd"], state["fwd"], x, training=training)
         x_rev = jnp.flip(x, axis=1)
         y_b, _ = self.bwd.apply(params["bwd"], state["bwd"], x_rev, training=training)
         y_b = jnp.flip(y_b, axis=1)
+        if not self.return_sequences:
+            y_f, y_b = y_f[:, -1], y_b[:, 0]
         if self.merge == "concat":
             return jnp.concatenate([y_f, y_b], axis=-1), state
         if self.merge == "mul":
@@ -245,8 +250,9 @@ class BiRecurrent(Module):
 
     def output_shape(self, input_shape):
         n, t, _ = input_shape
-        h = self.fwd.cell.hidden_size
-        return (n, t, 2 * h if self.merge == "concat" else h)
+        h = 2 * self.fwd.cell.hidden_size if self.merge == "concat" \
+            else self.fwd.cell.hidden_size
+        return (n, t, h) if self.return_sequences else (n, h)
 
 
 class TimeDistributed(Module):

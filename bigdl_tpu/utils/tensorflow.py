@@ -11,7 +11,11 @@ of its loader translating NHWC to its NCHW layers; this framework IS
 NHWC).  Supported ops: Const, Placeholder, Identity, Conv2D,
 DepthwiseConv2dNative, BiasAdd, MatMul, Relu, Relu6, Tanh, Sigmoid, Elu,
 Softplus, Softmax, MaxPool, AvgPool, FusedBatchNorm(V3), Reshape, Squeeze,
-Add/AddV2/Sub/Mul/Maximum, ConcatV2, Pad, Mean (global average pool).
+Add/AddV2/Sub/Mul/Maximum/Minimum/RealDiv/Div/Pow/SquaredDifference,
+ConcatV2, Pad, Mean/Sum/Max/Min/Prod, LogSoftmax/Softsign/LeakyRelu, unary
+math (Sqrt/Rsqrt/Square/Exp/Log/Log1p/Expm1/Abs/Neg/Floor/Round/Rint/Erf),
+ExpandDims/Transpose/Cast/Shape/Rank/Tile/Slice/StridedSlice/Gather(V2),
+comparisons + Select(V2), ArgMax, OneHot, LRN, ResizeBilinear.
 
 `load_tensorflow(pb_path, inputs, outputs)` -> (Graph, params, state);
 `save_tensorflow(model, params, state, path, input_shape)` exports a
@@ -124,6 +128,21 @@ class _TFImporter:
         if weights:
             self.weight_sets.append((module.name, weights))
 
+    def _ensure_node(self, tf_name: str, anchor: str):
+        """Materialize a Const graph node for a constant input consumed as
+        a tensor (comparisons, gathers).  `anchor` is any existing node the
+        Const piggybacks on (its input is ignored)."""
+        from bigdl_tpu.nn import tf_ops as _tf
+
+        cname = _clean(tf_name)
+        if cname in self.graph_nodes:
+            return
+        arr = self.const_of(tf_name)
+        cnode = _tf.Const(arr, name=f"{cname}_const")(
+            self.graph_nodes[_clean(anchor)])
+        self.graph_nodes[cname] = cnode
+        self.shapes[cname] = tuple(arr.shape)
+
     def _alias(self, tf_name: str, src: str):
         src = _clean(src)
         self.graph_nodes[tf_name] = self.graph_nodes[src]
@@ -140,10 +159,11 @@ class _TFImporter:
                 self._alias(name, data_inputs[0])
             # else: frozen-variable Identity(Const), resolved via const_of
             return
-        if _clean(data_inputs[0]) not in self.graph_nodes:
+        graph_in = [i for i in data_inputs if _clean(i) in self.graph_nodes]
+        if not graph_in:
             return  # constant-only subgraph (weights), folded on demand
 
-        bshape = self.shapes[_clean(data_inputs[0])]
+        bshape = self.shapes[_clean(graph_in[0])]
         if op == "Conv2D" or op == "DepthwiseConv2dNative":
             w = self.const_of(data_inputs[1])  # HWIO (HWIM for depthwise)
             kh, kw = w.shape[0], w.shape[1]
@@ -224,6 +244,8 @@ class _TFImporter:
             self._attach(name, m, [data_inputs[0]])
         elif op in ("Add", "AddV2", "Sub", "Mul", "Maximum"):
             # tensor-tensor when both inputs are graph nodes; else constant op
+            if _clean(data_inputs[0]) not in self.graph_nodes:
+                self._ensure_node(data_inputs[0], anchor=graph_in[0])
             other = _clean(data_inputs[1])
             if other in self.graph_nodes:
                 cls = {"Add": nn.CAddTable, "AddV2": nn.CAddTable,
@@ -269,6 +291,174 @@ class _TFImporter:
                 self._attach(name, m, [data_inputs[0]])
             else:
                 raise ValueError(f"Mean over dims {dims} unsupported")
+        elif op in ("LogSoftmax", "Softsign", "Sqrt", "Square", "Exp", "Log",
+                    "Abs", "Neg", "Floor", "Round", "Rint", "Erf", "Log1p",
+                    "Expm1", "Rsqrt"):
+            from bigdl_tpu.nn import tf_ops as _tf
+
+            cls = {"LogSoftmax": nn.LogSoftMax, "Softsign": nn.SoftSign,
+                   "Sqrt": nn.Sqrt, "Square": nn.Square, "Exp": nn.Exp,
+                   "Log": nn.Log, "Abs": nn.Abs, "Neg": nn.Negative,
+                   "Floor": nn.ops.Floor, "Round": nn.ops.Round,
+                   "Rint": nn.ops.Rint, "Erf": nn.ops.Erf,
+                   "Log1p": _tf.Log1p, "Expm1": nn.ops.Expm1}.get(op)
+            m = cls(name=name) if cls else nn.Power(-0.5, name=name)  # Rsqrt
+            self._attach(name, m, [data_inputs[0]])
+        elif op == "LeakyRelu":
+            alpha = nd.attr["alpha"].f if "alpha" in nd.attr else 0.2
+            self._attach(name, nn.LeakyReLU(alpha, name=name), [data_inputs[0]])
+        elif op in ("RealDiv", "Div", "Minimum"):
+            if _clean(data_inputs[0]) not in self.graph_nodes:
+                self._ensure_node(data_inputs[0], anchor=graph_in[0])
+            other = _clean(data_inputs[1])
+            if other in self.graph_nodes:
+                cls = nn.CDivTable if op != "Minimum" else nn.CMinTable
+                self._attach(name, cls(name=name), data_inputs[:2])
+            else:
+                c = self.const_of(data_inputs[1])
+                if op == "Minimum":
+                    if c.size != 1:  # per-channel min: go through the table op
+                        self._ensure_node(data_inputs[1], anchor=graph_in[0])
+                        self._attach(name, nn.CMinTable(name=name),
+                                     data_inputs[:2])
+                        return
+                    m = nn.Clamp(-float("inf"), float(c), name=name)
+                    self._attach(name, m, [data_inputs[0]])
+                elif c.size == 1:
+                    self._attach(name, nn.MulConstant(1.0 / float(c), name=name),
+                                 [data_inputs[0]])
+                else:
+                    m = nn.CMul(c.shape, name=name)
+                    self._attach(name, m, [data_inputs[0]], {"weight": 1.0 / c})
+        elif op == "Pow":
+            c = self.const_of(data_inputs[1])
+            self._attach(name, nn.Power(float(c), name=name), [data_inputs[0]])
+        elif op == "SquaredDifference":
+            self._attach(name, nn.ops.SquaredDifference(name=name),
+                         data_inputs[:2])
+        elif op in ("Sum", "Max", "Min", "Prod"):
+            dims = self.const_of(data_inputs[1]).reshape(-1).tolist()
+            keep = bool(nd.attr["keep_dims"].b)
+            if len(dims) != 1:
+                raise ValueError(f"{op} over dims {dims} unsupported")
+            d = int(dims[0])
+            if op == "Prod":
+                m = nn.ops.Prod(d, keep_dims=keep, name=name)
+            else:
+                cls = {"Sum": nn.Sum, "Max": nn.Max, "Min": nn.Min}[op]
+                m = cls(d, squeeze=not keep, name=name)
+            self._attach(name, m, [data_inputs[0]])
+        elif op == "ExpandDims":
+            d = int(self.const_of(data_inputs[1]))
+            self._attach(name, nn.Unsqueeze(d, name=name), [data_inputs[0]])
+        elif op == "Transpose":
+            perm = [int(v) for v in self.const_of(data_inputs[1]).reshape(-1)]
+            swaps, axes = [], list(range(len(perm)))
+            for i in range(len(perm)):  # selection-sort into swap pairs
+                j = axes.index(perm[i])
+                if j != i:
+                    swaps.append((i, j))
+                    axes[i], axes[j] = axes[j], axes[i]
+            self._attach(name, nn.Transpose(swaps, name=name), [data_inputs[0]])
+        elif op == "Cast":
+            dst = nd.attr["DstT"].type
+            dtype = {1: "float32", 3: "int32", 9: "int64", 10: "bool",
+                     4: "uint8", 2: "float64"}.get(dst, "float32")
+            self._attach(name, nn.ops.Cast(dtype, name=name), [data_inputs[0]])
+        elif op == "Shape":
+            self._attach(name, nn.ops.ShapeOp(name=name), [data_inputs[0]])
+        elif op == "Rank":
+            self._attach(name, nn.ops.Rank(name=name), [data_inputs[0]])
+        elif op == "ResizeBilinear":
+            oh, ow = [int(v) for v in self.const_of(data_inputs[1]).reshape(-1)]
+            align = bool(nd.attr["align_corners"].b)
+            m = nn.ResizeBilinear(oh, ow, align_corners=align, name=name)
+            self._attach(name, m, [data_inputs[0]])
+        elif op == "LRN":
+            r = int(nd.attr["depth_radius"].i) or 5
+            size = 2 * r + 1
+            alpha = nd.attr["alpha"].f or 1.0
+            beta = nd.attr["beta"].f or 0.5
+            bias = nd.attr["bias"].f or 1.0
+            # TF LRN does not divide alpha by size; our layer does
+            m = nn.SpatialCrossMapLRN(size, alpha * size, beta, bias, name=name)
+            self._attach(name, m, [data_inputs[0]])
+        elif op in ("Greater", "GreaterEqual", "Less", "LessEqual", "Equal",
+                    "NotEqual", "LogicalAnd", "LogicalOr"):
+            cls = {"Greater": nn.ops.Greater, "GreaterEqual": nn.ops.GreaterEqual,
+                   "Less": nn.ops.Less, "LessEqual": nn.ops.LessEqual,
+                   "Equal": nn.ops.Equal, "NotEqual": nn.ops.NotEqual,
+                   "LogicalAnd": nn.ops.LogicalAnd,
+                   "LogicalOr": nn.ops.LogicalOr}[op]
+            for di in data_inputs[:2]:
+                if _clean(di) not in self.graph_nodes:
+                    self._ensure_node(di, anchor=graph_in[0])
+            self._attach(name, cls(name=name), data_inputs[:2])
+        elif op in ("Select", "SelectV2"):
+            for di in data_inputs[:3]:
+                if _clean(di) not in self.graph_nodes:
+                    self._ensure_node(di, anchor=graph_in[0])
+            self._attach(name, nn.ops.SelectOp(name=name), data_inputs[:3])
+        elif op == "ArgMax":
+            d = int(self.const_of(data_inputs[1]))
+            self._attach(name, nn.ops.ArgMax(d, name=name), [data_inputs[0]])
+        elif op == "OneHot":
+            depth = int(self.const_of(data_inputs[1]))
+            on = float(self.const_of(data_inputs[2]))
+            off = float(self.const_of(data_inputs[3]))
+            self._attach(name, nn.ops.OneHot(depth, on, off, name=name),
+                         [data_inputs[0]])
+        elif op == "Tile":
+            mult = [int(v) for v in self.const_of(data_inputs[1]).reshape(-1)]
+            self._attach(name, nn.ops.Tile(mult, name=name), [data_inputs[0]])
+        elif op == "Slice":
+            begin = [int(v) for v in self.const_of(data_inputs[1]).reshape(-1)]
+            size = [int(v) for v in self.const_of(data_inputs[2]).reshape(-1)]
+            self._attach(name, nn.ops.Slice(begin, size, name=name),
+                         [data_inputs[0]])
+        elif op == "StridedSlice":
+            if any(int(nd.attr[k].i) for k in
+                   ("ellipsis_mask", "new_axis_mask")):
+                raise ValueError("StridedSlice ellipsis/new_axis masks "
+                                 "unsupported")
+            begin = [int(v) for v in self.const_of(data_inputs[1]).reshape(-1)]
+            end = [int(v) for v in self.const_of(data_inputs[2]).reshape(-1)]
+            strides = [int(v) for v in self.const_of(data_inputs[3]).reshape(-1)]
+            bm = int(nd.attr["begin_mask"].i)
+            em = int(nd.attr["end_mask"].i)
+            sm = int(nd.attr["shrink_axis_mask"].i)
+            spec = []
+            for i in range(len(begin)):
+                if sm & (1 << i):  # shrink: TF ignores end; take [b, b+1)
+                    b = begin[i]
+                    spec.append((b, b + 1 if b != -1 else None, 1))
+                    continue
+                b = None if bm & (1 << i) else begin[i]
+                e = None if em & (1 << i) else end[i]
+                spec.append((b, e, strides[i]))
+            m = nn.ops.StridedSlice(spec, name=name)
+            self._attach(name, m, [data_inputs[0]])
+            if sm:  # shrink: squeeze the masked axes (highest first)
+                sq = nn.Sequential(
+                    *[nn.Squeeze(i) for i in sorted(
+                        (i for i in range(len(begin)) if sm & (1 << i)),
+                        reverse=True)], name=f"{name}_shrink")
+                self.graph_nodes[name] = sq(self.graph_nodes[name])
+                try:
+                    self.shapes[name] = sq.output_shape(self.shapes[name])
+                except Exception:
+                    pass
+        elif op in ("Gather", "GatherV2"):
+            from bigdl_tpu.nn import tf_ops as _tf
+
+            axis = 0
+            if op == "GatherV2" and len(data_inputs) > 2:
+                axis = int(self.const_of(data_inputs[2]))
+            for di in data_inputs[:2]:
+                if _clean(di) not in self.graph_nodes:
+                    self._ensure_node(di, anchor=graph_in[0])
+            self._attach(name, nn.ops.Gather(axis, name=name),
+                         data_inputs[:2])
         else:
             raise ValueError(
                 f"unsupported TF op {op!r} at node {name!r} "

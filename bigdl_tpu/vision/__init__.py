@@ -21,6 +21,7 @@ from bigdl_tpu.vision.image import (
     Expand,
     Flip,
     ResizeTo,
+    RandomResize,
     ImageFrameToSample,
     ColorJitter,
     Lighting,

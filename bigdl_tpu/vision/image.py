@@ -224,6 +224,26 @@ class ResizeTo(FeatureTransformer):
         return resize_bilinear(img, self.h, self.w)
 
 
+class RandomResize(FeatureTransformer):
+    """Resize so the SHORTER side equals a random size drawn from
+    [min_size, max_size] (aspect preserved).
+    reference: transform/vision/image/augmentation/RandomResize.scala."""
+
+    def __init__(self, min_size: int, max_size: int, seed: int = 0):
+        self.min_size, self.max_size = min_size, max_size
+        self.rs = np.random.RandomState(seed)
+
+    def transform_image(self, img):
+        size = _locked_sample(
+            self, lambda: self.rs.randint(self.min_size, self.max_size + 1))
+        ih, iw = img.shape[:2]
+        if ih < iw:
+            h, w = size, max(1, round(iw * size / ih))
+        else:
+            h, w = max(1, round(ih * size / iw)), size
+        return resize_bilinear(img, h, w)
+
+
 class RandomCropper(FeatureTransformer):
     def __init__(self, height: int, width: int, seed: int = 0):
         self.h, self.w = height, width

@@ -1,0 +1,271 @@
+"""Tests for the widened TF GraphDef importer op coverage (reference:
+utils/tf/loaders/ — 161 per-op loaders; this exercises the new batch:
+elementwise math, reductions, transpose/expand, comparisons/select,
+strided slice, gather, LRN, resize)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.utils.tensorflow import load_tensorflow, ndarray_to_tensor
+
+import tf_graph_pb2 as tfp  # path registered by the tensorflow util import
+
+
+def _const(gd, name, arr):
+    n = gd.node.add()
+    n.name = name
+    n.op = "Const"
+    ndarray_to_tensor(np.asarray(arr), n.attr["value"].tensor)
+    return name
+
+
+def _node(gd, name, op, inputs, **attrs):
+    n = gd.node.add()
+    n.name = name
+    n.op = op
+    n.input.extend(inputs)
+    for k, v in attrs.items():
+        if isinstance(v, bool):
+            n.attr[k].b = v
+        elif isinstance(v, int):
+            n.attr[k].i = v
+        elif isinstance(v, float):
+            n.attr[k].f = v
+        elif isinstance(v, bytes):
+            n.attr[k].s = v
+        elif isinstance(v, list):
+            n.attr[k].list.i.extend(v)
+    return n
+
+
+def _load(gd, tmp_path, outputs, in_shape, fname="g.pb"):
+    pb = str(tmp_path / fname)
+    with open(pb, "wb") as f:
+        f.write(gd.SerializeToString())
+    return load_tensorflow(pb, ["input"], outputs, [in_shape])
+
+
+def _run(gd, tmp_path, outputs, x, fname="g.pb"):
+    g, gp, gs = _load(gd, tmp_path, outputs, tuple(x.shape), fname)
+    y, _ = g.apply(gp, gs, jnp.asarray(x))
+    return np.asarray(y)
+
+
+def _graph():
+    gd = tfp.GraphDef()
+    ph = gd.node.add()
+    ph.name = "input"
+    ph.op = "Placeholder"
+    return gd
+
+
+class TestElementwiseImport:
+    def test_unary_chain(self, tmp_path):
+        gd = _graph()
+        _node(gd, "sq", "Square", ["input"])
+        _node(gd, "ad", "AddV2", ["sq", _const(gd, "one", np.float32(1.0))])
+        _node(gd, "lg", "Log", ["ad"])
+        _node(gd, "ex", "Expm1", ["lg"])
+        x = np.random.RandomState(0).rand(2, 3).astype(np.float32)
+        y = _run(gd, tmp_path, ["ex"], x)
+        np.testing.assert_allclose(y, x * x, rtol=1e-5, atol=1e-6)
+
+    def test_rsqrt_and_pow(self, tmp_path):
+        gd = _graph()
+        _node(gd, "rs", "Rsqrt", ["input"])
+        _node(gd, "pw", "Pow", ["rs", _const(gd, "p", np.float32(4.0))])
+        x = np.asarray([[4.0, 9.0]], np.float32)
+        y = _run(gd, tmp_path, ["pw"], x)
+        np.testing.assert_allclose(y, [[1.0 / 16.0, 1.0 / 81.0]], rtol=1e-5)
+
+    def test_leaky_relu(self, tmp_path):
+        gd = _graph()
+        _node(gd, "lr", "LeakyRelu", ["input"], alpha=0.1)
+        y = _run(gd, tmp_path, ["lr"], np.asarray([[-2.0, 3.0]], np.float32))
+        np.testing.assert_allclose(y, [[-0.2, 3.0]], rtol=1e-6)
+
+    def test_realdiv_const_and_tensor(self, tmp_path):
+        gd = _graph()
+        _node(gd, "half", "RealDiv", ["input", _const(gd, "two", np.float32(2.0))])
+        _node(gd, "one", "RealDiv", ["input", "input"])
+        x = np.asarray([[4.0, 8.0]], np.float32)
+        y = _run(gd, tmp_path, ["half"], x)
+        np.testing.assert_allclose(y, x / 2.0)
+        y2 = _run(gd, tmp_path, ["one"], x, fname="g2.pb")
+        np.testing.assert_allclose(y2, 1.0)
+
+
+class TestShapeImport:
+    def test_reductions(self, tmp_path):
+        gd = _graph()
+        _const(gd, "dims", np.asarray([1], np.int32))
+        _node(gd, "s", "Sum", ["input", "dims"])
+        _node(gd, "m", "Max", ["input", "dims"], keep_dims=True)
+        x = np.asarray([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], np.float32)
+        np.testing.assert_allclose(_run(gd, tmp_path, ["s"], x), [6.0, 15.0])
+        np.testing.assert_allclose(_run(gd, tmp_path, ["m"], x, fname="g2.pb"),
+                                   [[3.0], [6.0]])
+
+    def test_transpose_expand(self, tmp_path):
+        gd = _graph()
+        _const(gd, "perm", np.asarray([0, 2, 1], np.int32))
+        _node(gd, "tr", "Transpose", ["input", "perm"])
+        _const(gd, "d", np.int32(1))
+        _node(gd, "ed", "ExpandDims", ["tr", "d"])
+        x = np.random.RandomState(0).rand(2, 3, 4).astype(np.float32)
+        y = _run(gd, tmp_path, ["ed"], x)
+        np.testing.assert_allclose(y, np.transpose(x, (0, 2, 1))[:, None])
+
+    def test_strided_slice_with_masks(self, tmp_path):
+        gd = _graph()
+        _const(gd, "b", np.asarray([0, 1], np.int32))
+        _const(gd, "e", np.asarray([0, 3], np.int32))
+        _const(gd, "s", np.asarray([1, 1], np.int32))
+        _node(gd, "ss", "StridedSlice", ["input", "b", "e", "s"],
+              begin_mask=1, end_mask=1)
+        x = np.random.RandomState(0).rand(2, 5).astype(np.float32)
+        y = _run(gd, tmp_path, ["ss"], x)
+        np.testing.assert_allclose(y, x[:, 1:3])
+
+    def test_strided_slice_shrink(self, tmp_path):
+        gd = _graph()
+        _const(gd, "b", np.asarray([0, 2], np.int32))
+        _const(gd, "e", np.asarray([0, 3], np.int32))
+        _const(gd, "s", np.asarray([1, 1], np.int32))
+        _node(gd, "ss", "StridedSlice", ["input", "b", "e", "s"],
+              begin_mask=1, end_mask=1, shrink_axis_mask=2)
+        x = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+        y = _run(gd, tmp_path, ["ss"], x)
+        np.testing.assert_allclose(y, x[:, 2])
+
+    def test_gather_const_indices(self, tmp_path):
+        gd = _graph()
+        _const(gd, "idx", np.asarray([2, 0], np.int32))
+        _node(gd, "gt", "Gather", ["input", "idx"])
+        x = np.random.RandomState(0).rand(4, 3).astype(np.float32)
+        y = _run(gd, tmp_path, ["gt"], x)
+        np.testing.assert_allclose(y, x[[2, 0]])
+
+    def test_tile_slice(self, tmp_path):
+        gd = _graph()
+        _const(gd, "m", np.asarray([1, 2], np.int32))
+        _node(gd, "tl", "Tile", ["input", "m"])
+        _const(gd, "b", np.asarray([0, 1], np.int32))
+        _const(gd, "sz", np.asarray([-1, 3], np.int32))
+        _node(gd, "sl", "Slice", ["tl", "b", "sz"])
+        x = np.random.RandomState(0).rand(2, 3).astype(np.float32)
+        y = _run(gd, tmp_path, ["sl"], x)
+        np.testing.assert_allclose(y, np.tile(x, (1, 2))[:, 1:4])
+
+
+class TestSelectCompareImport:
+    def test_greater_const_arg(self, tmp_path):
+        gd = _graph()
+        _node(gd, "gt", "Greater", ["input", _const(gd, "z", np.float32(0.5))])
+        x = np.asarray([[0.2, 0.9]], np.float32)
+        y = _run(gd, tmp_path, ["gt"], x)
+        np.testing.assert_array_equal(y, [[False, True]])
+
+    def test_tensor_tensor_compare_select(self, tmp_path):
+        gd = _graph()
+        _node(gd, "neg", "Neg", ["input"])
+        _node(gd, "gt", "Greater", ["input", "neg"])  # x > -x  <=>  x > 0
+        _node(gd, "sel", "Select", ["gt", "input", "neg"])  # |x|
+        x = np.asarray([[-2.0, 3.0, -0.5]], np.float32)
+        y = _run(gd, tmp_path, ["sel"], x)
+        np.testing.assert_allclose(y, np.abs(x))
+
+
+class TestVisionImport:
+    def test_lrn_matches_tf_formula(self, tmp_path):
+        gd = _graph()
+        _node(gd, "lrn", "LRN", ["input"], depth_radius=2, alpha=1e-4,
+              beta=0.75, bias=2.0)
+        x = np.random.RandomState(0).rand(1, 3, 3, 8).astype(np.float32)
+        y = _run(gd, tmp_path, ["lrn"], x)
+        # TF formula: x / (bias + alpha * sum_window x^2)^beta, window=2r+1
+        pad = np.pad(x * x, [(0, 0)] * 3 + [(2, 2)])
+        sq = sum(pad[..., i:i + 8] for i in range(5))
+        expect = x / (2.0 + 1e-4 * sq) ** 0.75
+        np.testing.assert_allclose(y, expect, rtol=1e-4)
+
+    def test_resize_bilinear(self, tmp_path):
+        gd = _graph()
+        _const(gd, "size", np.asarray([8, 6], np.int32))
+        _node(gd, "rb", "ResizeBilinear", ["input", "size"], align_corners=True)
+        x = np.random.RandomState(0).rand(1, 4, 3, 2).astype(np.float32)
+        y = _run(gd, tmp_path, ["rb"], x)
+        assert y.shape == (1, 8, 6, 2)
+        # corners map exactly under align_corners
+        np.testing.assert_allclose(y[0, 0, 0], x[0, 0, 0], rtol=1e-5)
+        np.testing.assert_allclose(y[0, -1, -1], x[0, -1, -1], rtol=1e-5)
+
+
+class TestReviewRegressions:
+    def test_strided_slice_negative_shrink(self, tmp_path):
+        # TF emits begin=[-1], end=[0] for x[-1]
+        gd = _graph()
+        _const(gd, "b", np.asarray([0, -1], np.int32))
+        _const(gd, "e", np.asarray([0, 0], np.int32))
+        _const(gd, "s", np.asarray([1, 1], np.int32))
+        _node(gd, "ss", "StridedSlice", ["input", "b", "e", "s"],
+              begin_mask=1, end_mask=1, shrink_axis_mask=2)
+        x = np.random.RandomState(0).rand(3, 5).astype(np.float32)
+        y = _run(gd, tmp_path, ["ss"], x)
+        np.testing.assert_allclose(y, x[:, -1])
+
+    def test_minimum_vector_const(self, tmp_path):
+        gd = _graph()
+        _node(gd, "mn", "Minimum",
+              ["input", _const(gd, "cap", np.asarray([1.0, 2.0], np.float32))])
+        x = np.asarray([[0.5, 5.0], [3.0, 1.5]], np.float32)
+        y = _run(gd, tmp_path, ["mn"], x)
+        np.testing.assert_allclose(y, np.minimum(x, [1.0, 2.0]))
+
+    def test_gather_const_params_dynamic_indices(self, tmp_path):
+        # embedding-lookup pattern: Gather(const_table, dynamic_ids)
+        gd = _graph()
+        table = np.random.RandomState(0).rand(10, 4).astype(np.float32)
+        _const(gd, "emb", table)
+        _node(gd, "cast", "Cast", ["input"], DstT=3)
+        _node(gd, "gt", "Gather", ["emb", "cast"])
+        x = np.asarray([2, 7, 0], np.float32)
+        y = _run(gd, tmp_path, ["gt"], x)
+        np.testing.assert_allclose(y, table[[2, 7, 0]], rtol=1e-6)
+
+    def test_leaky_relu_explicit_zero_alpha(self, tmp_path):
+        gd = _graph()
+        _node(gd, "lr", "LeakyRelu", ["input"], alpha=0.0)
+        y = _run(gd, tmp_path, ["lr"], np.asarray([[-3.0, 2.0]], np.float32))
+        np.testing.assert_allclose(y, [[0.0, 2.0]])
+
+    def test_tile_prepended_dims_shape(self):
+        from bigdl_tpu.nn import ops
+        op = ops.Tile([2, 1, 1])
+        assert op.output_shape((4, 5)) == (2, 4, 5)
+        y, _ = op.apply({}, {}, jnp.ones((4, 5)))
+        assert y.shape == (2, 4, 5)
+
+
+class TestBidirectionalSemantics:
+    def test_final_step_uses_full_backward_pass(self):
+        import bigdl_tpu.nn as nn
+
+        cell_f, cell_b = nn.LSTMCell(3, 4), nn.LSTMCell(3, 4)
+        bi_seq = nn.BiRecurrent(cell_f, cell_b, merge="concat")
+        params, state, _ = bi_seq.build(jax.random.PRNGKey(0), (2, 5, 3))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 3))
+        full, _ = bi_seq.apply(params, state, x)
+        bi_last = nn.BiRecurrent(cell_f, cell_b, merge="concat",
+                                 return_sequences=False)
+        last, _ = bi_last.apply(params, state, x)
+        assert last.shape == (2, 8)
+        # fwd half = last timestep of fwd sequence; bwd half = index 0 of
+        # the (time-restored) bwd sequence — the full-sequence bwd output
+        np.testing.assert_allclose(np.asarray(last[:, :4]),
+                                   np.asarray(full[:, -1, :4]), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(last[:, 4:]),
+                                   np.asarray(full[:, 0, 4:]), rtol=1e-6)
