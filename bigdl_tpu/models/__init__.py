@@ -8,7 +8,7 @@ point mirroring the reference's scopt-driven Train objects.
 from bigdl_tpu.models.lenet import LeNet5
 from bigdl_tpu.models.vgg import VggForCifar10, Vgg16, Vgg19
 from bigdl_tpu.models.resnet import ResNet, resnet50, resnet_cifar
-from bigdl_tpu.models.inception import InceptionV1
+from bigdl_tpu.models.inception import InceptionV1, InceptionV2
 from bigdl_tpu.models.rnn import PTBModel, SimpleRNN
 from bigdl_tpu.models.autoencoder import Autoencoder
 from bigdl_tpu.models.transformer import (

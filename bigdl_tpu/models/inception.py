@@ -66,3 +66,82 @@ def InceptionV1(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequentia
         nn.LogSoftMax(),
     ]
     return nn.Sequential(*layers)
+
+
+def _conv_bn(cin, cout, k, stride=1, pad=0, name: Optional[str] = None):
+    """conv + BN(eps 1e-3) + ReLU — the BN-Inception building block
+    (reference: models/inception/Inception_v2.scala Inception_Layer_v2)."""
+    return nn.Sequential(
+        nn.SpatialConvolution(cin, cout, k, k, stride, stride, pad, pad,
+                              weight_init=init_mod.Xavier(), name=name),
+        nn.SpatialBatchNormalization(cout, eps=1e-3),
+        nn.ReLU(),
+    )
+
+
+def inception_module_v2(cin: int, c1x1: int, c3x3: tuple, cd3x3: tuple,
+                        pool: tuple, name: Optional[str] = None):
+    """BN-Inception module: 1x1 / 3x3 / double-3x3 / pool branches concat on
+    channels.  `pool` = ("avg"|"max", proj_channels); proj 0 with "max"
+    marks a stride-2 grid-reduction module (no 1x1 branch, strided convs,
+    passthrough max pool).
+    reference: models/inception/Inception_v2.scala:27-105."""
+    pool_kind, pool_proj = pool
+    reduce_grid = pool_kind == "max" and pool_proj == 0
+    stride = 2 if reduce_grid else 1
+    branches = []
+    if c1x1:
+        branches.append(_conv_bn(cin, c1x1, 1))
+    branches.append(nn.Sequential(
+        _conv_bn(cin, c3x3[0], 1),
+        _conv_bn(c3x3[0], c3x3[1], 3, stride, 1)))
+    branches.append(nn.Sequential(
+        _conv_bn(cin, cd3x3[0], 1),
+        _conv_bn(cd3x3[0], cd3x3[1], 3, 1, 1),
+        _conv_bn(cd3x3[1], cd3x3[1], 3, stride, 1)))
+    if reduce_grid:
+        branches.append(nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True))
+    else:
+        pool_layer = (nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1, ceil_mode=True)
+                      if pool_kind == "max"
+                      else nn.SpatialAveragePooling(3, 3, 1, 1, 1, 1,
+                                                    ceil_mode=True))
+        branches.append(nn.Sequential(
+            pool_layer, _conv_bn(cin, pool_proj, 1)))
+    return nn.Concat(3, *branches, name=name)
+
+
+def InceptionV2(class_num: int = 1000) -> nn.Sequential:
+    """BN-Inception / Inception-v2 for 224x224x3 (NHWC).
+    reference: models/inception/Inception_v2.scala
+    Inception_v2_NoAuxClassifier:188-231 (channel configs verbatim)."""
+    return nn.Sequential(
+        _conv_bn(3, 64, 7, 2, 3, name="conv1/7x7_s2"),
+        nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True),
+        _conv_bn(64, 64, 1, name="conv2/3x3_reduce"),
+        _conv_bn(64, 192, 3, 1, 1, name="conv2/3x3"),
+        nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True),
+        inception_module_v2(192, 64, (64, 64), (64, 96), ("avg", 32),
+                            name="inception_3a"),
+        inception_module_v2(256, 64, (64, 96), (64, 96), ("avg", 64),
+                            name="inception_3b"),
+        inception_module_v2(320, 0, (128, 160), (64, 96), ("max", 0),
+                            name="inception_3c"),
+        inception_module_v2(576, 224, (64, 96), (96, 128), ("avg", 128),
+                            name="inception_4a"),
+        inception_module_v2(576, 192, (96, 128), (96, 128), ("avg", 128),
+                            name="inception_4b"),
+        inception_module_v2(576, 160, (128, 160), (128, 160), ("avg", 96),
+                            name="inception_4c"),
+        inception_module_v2(576, 96, (128, 192), (160, 192), ("avg", 96),
+                            name="inception_4d"),
+        inception_module_v2(576, 0, (128, 192), (192, 256), ("max", 0),
+                            name="inception_4e"),
+        inception_module_v2(1024, 352, (192, 320), (160, 224), ("avg", 128),
+                            name="inception_5a"),
+        inception_module_v2(1024, 352, (192, 320), (192, 224), ("max", 128),
+                            name="inception_5b"),
+        nn.GlobalAveragePooling2D(),
+        nn.Linear(1024, class_num, name="loss3/classifier"),
+        nn.LogSoftMax(),
+    )

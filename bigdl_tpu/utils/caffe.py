@@ -287,6 +287,137 @@ def load_caffe(def_path: str, model_path: Optional[str] = None,
         elif ltype == "Flatten":
             module = nn.Sequential(nn.Transpose([(1, 3), (2, 3)]), nn.Flatten(),
                                    name=l.name)
+        elif ltype == "ELU":
+            alpha = l.elu_param.alpha if l.HasField("elu_param") else 1.0
+            module = nn.ELU(alpha, name=l.name)
+        elif ltype == "PReLU":
+            shared = l.prelu_param.channel_shared \
+                if l.HasField("prelu_param") else False
+            module = nn.PReLU(1 if shared else bshape[-1], name=l.name)
+            if lw:
+                weight_sets.append((l.name, {"weight": lw[0].reshape(-1)}))
+        elif ltype == "AbsVal":
+            module = nn.Abs(name=l.name)
+        elif ltype == "Power":
+            pp = l.power_param
+            module = nn.Power(pp.power, pp.scale, pp.shift, name=l.name)
+        elif ltype == "Exp":
+            ep = l.exp_param
+            base = ep.base if ep.base != -1.0 else float(np.e)
+            # caffe: y = base^(shift + scale*x) = exp(scale*lnb*x + shift*lnb)
+            lnb = float(np.log(base))
+            module = nn.Sequential(
+                nn.MulConstant(ep.scale * lnb), nn.AddConstant(ep.shift * lnb),
+                nn.Exp(), name=l.name)
+        elif ltype == "Log":
+            lp2 = l.log_param
+            base = lp2.base if lp2.base != -1.0 else float(np.e)
+            # caffe: y = log_base(shift + scale*x)
+            module = nn.Sequential(
+                nn.MulConstant(lp2.scale), nn.AddConstant(lp2.shift), nn.Log(),
+                nn.MulConstant(1.0 / float(np.log(base))), name=l.name)
+        elif ltype == "BNLL":
+            module = nn.SoftPlus(name=l.name)
+        elif ltype == "Threshold":
+            # caffe Threshold outputs INDICATOR (0/1), unlike torch Threshold
+            th = l.threshold_param.threshold
+            module = nn.Sequential(nn.AddConstant(-th), nn.ops.Sign(),
+                                   nn.Clamp(0.0, 1.0), name=l.name)
+        elif ltype == "Deconvolution":
+            cp = l.convolution_param
+            kh, kw, sh, sw, ph, pw, _ = _conv_geom(cp)
+            cin = bshape[-1]
+            module = nn.SpatialFullConvolution(
+                cin, cp.num_output, kw, kh, sw, sh, pw, ph,
+                with_bias=cp.bias_term, name=l.name)
+            if lw:
+                # caffe deconv blobs are (in, out, kh, kw) -> HWIO
+                w = {"weight": np.transpose(lw[0], (2, 3, 0, 1))}
+                if cp.bias_term and len(lw) > 1:
+                    w["bias"] = lw[1].reshape(-1)
+                weight_sets.append((l.name, w))
+        elif ltype == "Reshape":
+            dims = [int(d) for d in l.reshape_param.shape.dim]
+            # caffe shape is NCHW-ordered incl. batch; dim 0 = copy that dim
+            if len(bshape) == 4:
+                nchw_in = (bshape[0], bshape[3], bshape[1], bshape[2])
+            else:
+                nchw_in = tuple(bshape)
+            dims = [nchw_in[i] if d == 0 and i < len(nchw_in) else d
+                    for i, d in enumerate(dims)]
+            tail = dims[1:]
+            if len(tail) == 3:  # C,H,W -> H,W,C
+                c, h, w = tail
+                tail = [h, w, c]
+            module = nn.Reshape(tail, batch_mode=True, name=l.name)
+        elif ltype == "Permute":
+            if len(bshape) != 4:
+                raise ValueError("Permute supported on 4-D blobs only")
+            order = [int(v) for v in l.permute_param.order]
+            # map NCHW axis ids to our NHWC layout
+            axmap = {0: 0, 1: 3, 2: 1, 3: 2}
+            # caffe: out_nchw[j] = in_nchw[order_full[j]].  Both sides live
+            # in NHWC here, so conjugate by the layout map: with g = our
+            # axis -> nchw axis and axmap its inverse,
+            # ours[k] = axmap[order_full[g[k]]]
+            order_full = order + [a for a in range(len(bshape))
+                                  if a not in order]
+            g = [0, 2, 3, 1]
+            ours = [axmap[order_full[g[k]]] for k in range(len(bshape))]
+            swaps, axes = [], list(range(len(bshape)))
+            for i, want in enumerate(ours[:len(axes)]):
+                j = axes.index(want)
+                if j != i:
+                    swaps.append((i, j))
+                    axes[i], axes[j] = axes[j], axes[i]
+            module = nn.Transpose(swaps, name=l.name)
+        elif ltype == "Tile":
+            tp = l.tile_param
+            axis = {0: 0, 1: 3, 2: 1, 3: 2}[tp.axis % 4] if len(bshape) == 4 \
+                else tp.axis
+            module = nn.Tile(axis, tp.tiles, name=l.name)
+        elif ltype == "Crop":
+            # crop bottom[0] to bottom[1]'s spatial size from `offset`
+            ref_shape = shapes[bottoms[1]]
+            offs = list(l.crop_param.offset) or [0]
+            axis = l.crop_param.axis
+            if axis == 2 and len(bshape) == 4:  # spatial crop (common case)
+                oh = offs[0]
+                ow = offs[1] if len(offs) > 1 else offs[0]
+                module = nn.Sequential(
+                    nn.Narrow(1, oh, ref_shape[1]),
+                    nn.Narrow(2, ow, ref_shape[2]), name=l.name)
+                bottoms = bottoms[:1]
+            else:
+                raise ValueError("Crop along non-spatial axes unsupported")
+        elif ltype == "Bias":
+            module = nn.CAdd((bshape[-1],), name=l.name)
+            if lw:
+                weight_sets.append((l.name, {"bias": lw[0].reshape(-1)}))
+        elif ltype == "ArgMax":
+            ap = l.argmax_param
+            if ap.out_max_val or ap.top_k != 1:
+                raise ValueError("ArgMax out_max_val/top_k unsupported")
+            if ap.HasField("axis"):
+                axis = {0: 0, 1: 3, 2: 1, 3: 2}[ap.axis % 4] \
+                    if len(bshape) == 4 else ap.axis
+            else:
+                axis = -1 if len(bshape) == 2 else 3
+            module = nn.ops.ArgMax(axis, name=l.name)
+        elif ltype == "Normalize":
+            npm = l.norm_param
+            module = nn.NormalizeScale(2.0, eps=npm.eps or 1e-10, scale=1.0,
+                                       size=(bshape[-1],), name=l.name)
+            if lw:
+                scale = lw[0].reshape(-1)
+                if scale.size == 1:  # channel_shared
+                    scale = np.full((bshape[-1],), float(scale[0]), np.float32)
+                weight_sets.append((l.name, {"weight": scale}))
+        elif ltype == "Split":
+            for t_ in l.top:
+                nodes[t_] = nodes[bottoms[0]]
+                shapes[t_] = shapes[bottoms[0]]
+            continue
         elif ltype in ("Accuracy", "Silence"):
             continue
         else:
@@ -306,7 +437,14 @@ def load_caffe(def_path: str, model_path: Optional[str] = None,
     outs = [nodes[b] for b in output_blobs if b not in consumed] or \
         [nodes[output_blobs[-1]]]
     model = nn.Graph(input_nodes, outs, name=net.name or "caffe_net")
-    build_shape = [shapes[b] for b in shapes if nodes.get(b) in input_nodes]
+    # one shape per distinct input node (alias tops — e.g. Split fan-out —
+    # map to the same node and must not be counted again)
+    build_shape, seen_inputs = [], []
+    for b in shapes:
+        node = nodes.get(b)
+        if node in input_nodes and not any(node is s for s in seen_inputs):
+            seen_inputs.append(node)
+            build_shape.append(shapes[b])
     params, state, _ = model.build(
         jax.random.PRNGKey(seed),
         build_shape[0] if len(build_shape) == 1 else Table(*build_shape))

@@ -375,11 +375,11 @@ class _TFImporter:
             m = nn.ResizeBilinear(oh, ow, align_corners=align, name=name)
             self._attach(name, m, [data_inputs[0]])
         elif op == "LRN":
-            r = int(nd.attr["depth_radius"].i) or 5
+            r = int(nd.attr["depth_radius"].i) if "depth_radius" in nd.attr else 5
             size = 2 * r + 1
-            alpha = nd.attr["alpha"].f or 1.0
-            beta = nd.attr["beta"].f or 0.5
-            bias = nd.attr["bias"].f or 1.0
+            alpha = nd.attr["alpha"].f if "alpha" in nd.attr else 1.0
+            beta = nd.attr["beta"].f if "beta" in nd.attr else 0.5
+            bias = nd.attr["bias"].f if "bias" in nd.attr else 1.0
             # TF LRN does not divide alpha by size; our layer does
             m = nn.SpatialCrossMapLRN(size, alpha * size, beta, bias, name=name)
             self._attach(name, m, [data_inputs[0]])
@@ -444,10 +444,10 @@ class _TFImporter:
                         (i for i in range(len(begin)) if sm & (1 << i)),
                         reverse=True)], name=f"{name}_shrink")
                 self.graph_nodes[name] = sq(self.graph_nodes[name])
-                try:
-                    self.shapes[name] = sq.output_shape(self.shapes[name])
-                except Exception:
-                    pass
+                sliced = self.shapes[name]
+                self.shapes[name] = tuple(
+                    d for i, d in enumerate(sliced)
+                    if not (sm & (1 << i)))
         elif op in ("Gather", "GatherV2"):
             from bigdl_tpu.nn import tf_ops as _tf
 

@@ -1,0 +1,167 @@
+"""Tests for the widened Caffe prototxt/caffemodel importer (reference:
+utils/caffe/CaffeLoader.scala layer converters) and the InceptionV2 model."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils.caffe import load_caffe
+
+import caffe_pb2  # path registered by the caffe util import
+
+
+def _write_net(tmp_path, body, name="net"):
+    proto = f'name: "{name}"\ninput: "data"\n' \
+            'input_shape { dim: 1 dim: 3 dim: 8 dim: 8 }\n' + body
+    p = tmp_path / f"{name}.prototxt"
+    p.write_text(proto)
+    return str(p)
+
+
+def _layer(name, ltype, bottom, top, extra=""):
+    return (f'layer {{ name: "{name}" type: "{ltype}" '
+            f'bottom: "{bottom}" top: "{top}" {extra} }}\n')
+
+
+class TestNewCaffeLayers:
+    def _run(self, tmp_path, body, out_shape=(1, 8, 8, 3), x=None, name="net"):
+        path = _write_net(tmp_path, body, name)
+        g, p, s = load_caffe(path)
+        if x is None:
+            x = jnp.asarray(np.random.RandomState(0).rand(1, 8, 8, 3),
+                            jnp.float32)
+        y, _ = g.apply(p, s, x)
+        return np.asarray(y), np.asarray(x)
+
+    def test_elu_prelu_absval(self, tmp_path):
+        body = (_layer("e", "ELU", "data", "e", "elu_param { alpha: 0.5 }")
+                + _layer("p", "PReLU", "e", "p")
+                + _layer("a", "AbsVal", "p", "a"))
+        y, x = self._run(tmp_path, body)
+        assert y.shape == (1, 8, 8, 3) and np.all(y >= 0)
+
+    def test_power(self, tmp_path):
+        body = _layer("pw", "Power", "data", "pw",
+                      "power_param { power: 2.0 scale: 3.0 shift: 1.0 }")
+        y, x = self._run(tmp_path, body)
+        np.testing.assert_allclose(y, (1.0 + 3.0 * x) ** 2, rtol=1e-5)
+
+    def test_exp_log_roundtrip(self, tmp_path):
+        body = (_layer("ex", "Exp", "data", "ex")
+                + _layer("lg", "Log", "ex", "lg"))
+        y, x = self._run(tmp_path, body)
+        np.testing.assert_allclose(y, x, rtol=1e-4, atol=1e-5)
+
+    def test_exp_base2(self, tmp_path):
+        body = _layer("ex", "Exp", "data", "ex", "exp_param { base: 2.0 }")
+        y, x = self._run(tmp_path, body)
+        np.testing.assert_allclose(y, 2.0 ** x, rtol=1e-5)
+
+    def test_bnll_threshold(self, tmp_path):
+        body = (_layer("b", "BNLL", "data", "b")
+                + _layer("t", "Threshold", "b", "t",
+                         "threshold_param { threshold: 0.8 }"))
+        y, x = self._run(tmp_path, body)
+        expect = (np.log1p(np.exp(x)) > 0.8).astype(np.float32)
+        np.testing.assert_allclose(y, expect)
+
+    def test_deconvolution(self, tmp_path):
+        body = _layer("dc", "Deconvolution", "data", "dc",
+                      "convolution_param { num_output: 4 kernel_size: 3 "
+                      "stride: 2 }")
+        y, x = self._run(tmp_path, body)
+        assert y.shape == (1, 17, 17, 4)
+
+    def test_reshape_permute(self, tmp_path):
+        body = _layer("rs", "Reshape", "data", "rs",
+                      "reshape_param { shape { dim: 0 dim: 3 dim: 64 dim: 1 } }")
+        y, x = self._run(tmp_path, body)
+        assert y.shape == (1, 64, 1, 3)  # C,H,W -> H,W,C mapped
+
+    def test_tile(self, tmp_path):
+        body = _layer("tl", "Tile", "data", "tl",
+                      "tile_param { axis: 1 tiles: 2 }")
+        y, x = self._run(tmp_path, body)
+        assert y.shape == (1, 8, 8, 6)  # channel axis in NHWC
+        np.testing.assert_allclose(y[..., :3], x)
+        np.testing.assert_allclose(y[..., 3:], x)
+
+    def test_normalize_ssd(self, tmp_path):
+        body = _layer("nm", "Normalize", "data", "nm")
+        y, x = self._run(tmp_path, body)
+        norms = np.sqrt((y ** 2).sum(-1))
+        np.testing.assert_allclose(norms, 1.0, rtol=1e-4)
+
+    def test_split_fanout(self, tmp_path):
+        body = ('layer { name: "sp" type: "Split" bottom: "data" '
+                'top: "d1" top: "d2" }\n'
+                + _layer("s1", "Sigmoid", "d1", "s1")
+                + _layer("s2", "TanH", "d2", "s2")
+                + 'layer { name: "el" type: "Eltwise" bottom: "s1" '
+                'bottom: "s2" top: "el" }\n')
+        y, x = self._run(tmp_path, body)
+        np.testing.assert_allclose(y, 1.0 / (1.0 + np.exp(-x)) + np.tanh(x),
+                                   rtol=1e-4)
+
+
+class TestInceptionV2:
+    def test_shapes_and_module_widths(self):
+        from bigdl_tpu.models import InceptionV2
+        from bigdl_tpu.models.inception import inception_module_v2
+
+        m = inception_module_v2(192, 64, (64, 64), (64, 96), ("avg", 32))
+        _, _, out = m.build(jax.random.PRNGKey(0), (1, 28, 28, 192))
+        assert out == (1, 28, 28, 256)
+        # grid-reduction module halves spatial dims, no 1x1 branch
+        mr = inception_module_v2(320, 0, (128, 160), (64, 96), ("max", 0))
+        _, _, out = mr.build(jax.random.PRNGKey(0), (1, 28, 28, 320))
+        assert out == (1, 14, 14, 576)
+
+    def test_full_model_tiny_input(self):
+        from bigdl_tpu.models import InceptionV2
+
+        m = InceptionV2(10)
+        p, s, out = m.build(jax.random.PRNGKey(0), (1, 224, 224, 3))
+        assert out == (1, 10)
+
+
+class TestReviewRegressions:
+    def _run(self, tmp_path, body, x=None, name="net"):
+        path = _write_net(tmp_path, body, name)
+        g, p, s = load_caffe(path)
+        if x is None:
+            x = jnp.asarray(np.random.RandomState(0).rand(1, 8, 8, 3),
+                            jnp.float32)
+        y, _ = g.apply(p, s, x)
+        return np.asarray(y), np.asarray(x)
+
+    def test_permute_partial_order(self, tmp_path):
+        # order {1, 0}: swap N and C, unlisted axes keep ascending order
+        body = _layer("pm", "Permute", "data", "pm",
+                      "permute_param { order: 1 order: 0 }")
+        y, x = self._run(tmp_path, body)
+        # NCHW (1,3,8,8) -> (3,1,8,8); our NHWC out = (3,8,8,1)
+        assert y.shape == (3, 8, 8, 1)
+
+    def test_reshape_copy_dims(self, tmp_path):
+        # keep N and C, flatten spatial: shape {0, 0, -1}
+        body = _layer("rs", "Reshape", "data", "rs",
+                      "reshape_param { shape { dim: 0 dim: 0 dim: -1 } }")
+        y, x = self._run(tmp_path, body)
+        assert y.shape == (1, 3, 64) or y.shape == (1, 64, 3)
+
+    def test_exp_scale_zero_constant(self, tmp_path):
+        body = _layer("ex", "Exp", "data", "ex",
+                      "exp_param { scale: 0.0 shift: 2.0 }")
+        y, x = self._run(tmp_path, body)
+        np.testing.assert_allclose(y, np.e ** 2, rtol=1e-5)
+
+    def test_argmax_unsupported_raises(self, tmp_path):
+        body = _layer("am", "ArgMax", "data", "am",
+                      "argmax_param { top_k: 5 }")
+        path = _write_net(tmp_path, body)
+        with pytest.raises(ValueError, match="ArgMax"):
+            load_caffe(path)
