@@ -231,7 +231,8 @@ class TestFlashAttention:
 
     def test_mha_use_flash_flag(self):
         m_flash = nn.MultiHeadAttention(32, 4, causal=True, use_flash=True)
-        m_dense = nn.MultiHeadAttention(32, 4, causal=True)
+        # use_flash=True is the default now; pin the dense side explicitly
+        m_dense = nn.MultiHeadAttention(32, 4, causal=True, use_flash=False)
         x = jnp.asarray(np.random.RandomState(0).randn(2, 64, 32), jnp.float32)
         p, s, _ = m_flash.build(jax.random.PRNGKey(0), x.shape)
         # interpret-mode via monkeypatched default is unnecessary: on CPU
