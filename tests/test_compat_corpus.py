@@ -1,0 +1,51 @@
+"""Backward-compatibility corpus: models serialized with an earlier
+snapshot of the schema must keep loading and produce identical outputs.
+
+Analogue of the reference's serialized-model compatibility corpus in
+test resources (SURVEY §4: `resources/serialization`, loaded by
+backward-compat tests).  NEVER regenerate these fixtures to make a test
+pass — a failure here means the schema change broke old checkpoints and
+needs a migration path in `utils/serializer.py` instead.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from bigdl_tpu.utils import serializer as ser
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "compat")
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    with open(os.path.join(FIXTURES, "input_shapes.json")) as fh:
+        shapes = json.load(fh)
+    with np.load(os.path.join(FIXTURES, "inputs.npz")) as ins, \
+            np.load(os.path.join(FIXTURES, "expected_outputs.npz")) as outs:
+        inputs = {k: ins[k] for k in ins.files}
+        expected = {k: outs[k] for k in outs.files}
+    # every fixture subdirectory must be covered by the manifest
+    dirs = {d for d in os.listdir(FIXTURES)
+            if os.path.isdir(os.path.join(FIXTURES, d))}
+    assert dirs == set(shapes) == set(inputs) == set(expected)
+    return shapes, inputs, expected
+
+
+CORPUS_NAMES = ["keras_cnn", "lenet5", "mlp_graph", "rnn"]
+
+
+@pytest.mark.parametrize("name", CORPUS_NAMES)
+def test_corpus_model_loads_and_matches(name, corpus):
+    shapes, inputs, expected = corpus
+    model, params, state = ser.load_model(os.path.join(FIXTURES, name))
+    # build instantiates lazily-shaped inners (keras layers); the freshly
+    # initialized params are discarded in favor of the loaded ones
+    model.build(jax.random.PRNGKey(0), tuple(shapes[name]))
+    y, _ = model.apply(params, state, inputs[name], training=False)
+    np.testing.assert_allclose(np.asarray(y), expected[name],
+                               rtol=1e-4, atol=1e-5)
