@@ -113,12 +113,19 @@ class _TFImporter:
             return self.const_of(nd.input[0])
         raise ValueError(f"expected Const, got {nd.op} for {name}")
 
+    def _key(self, ref: str) -> str:
+        """Resolve an input reference: multi-output producers register
+        per-output keys ("split:1"); everything else registers under the
+        bare name."""
+        ref = ref[1:] if ref.startswith("^") else ref
+        return ref if ref in self.graph_nodes else _clean(ref)
+
     def _attach(self, tf_name: str, module, in_names: List[str],
                 weights: Optional[Dict[str, np.ndarray]] = None):
-        srcs = [self.graph_nodes[_clean(i)] for i in in_names]
+        srcs = [self.graph_nodes[self._key(i)] for i in in_names]
         node = module(*srcs)
         self.graph_nodes[tf_name] = node
-        in_shapes = [self.shapes[_clean(i)] for i in in_names]
+        in_shapes = [self.shapes[self._key(i)] for i in in_names]
         sh = in_shapes[0] if len(in_shapes) == 1 else Table(*in_shapes)
         try:
             _, _, out = module.build(jax.random.PRNGKey(0), sh)
@@ -139,12 +146,12 @@ class _TFImporter:
             return
         arr = self.const_of(tf_name)
         cnode = _tf.Const(arr, name=f"{cname}_const")(
-            self.graph_nodes[_clean(anchor)])
+            self.graph_nodes[self._key(anchor)])
         self.graph_nodes[cname] = cnode
         self.shapes[cname] = tuple(arr.shape)
 
     def _alias(self, tf_name: str, src: str):
-        src = _clean(src)
+        src = self._key(src)
         self.graph_nodes[tf_name] = self.graph_nodes[src]
         self.shapes[tf_name] = self.shapes[src]
 
@@ -155,15 +162,16 @@ class _TFImporter:
             return
         data_inputs = [i for i in nd.input if not i.startswith("^")]
         if op == "Identity":
-            if _clean(data_inputs[0]) in self.graph_nodes:
+            if self._key(data_inputs[0]) in self.graph_nodes:
                 self._alias(name, data_inputs[0])
             # else: frozen-variable Identity(Const), resolved via const_of
             return
-        graph_in = [i for i in data_inputs if _clean(i) in self.graph_nodes]
+        graph_in = [i for i in data_inputs
+                    if self._key(i) in self.graph_nodes]
         if not graph_in:
             return  # constant-only subgraph (weights), folded on demand
 
-        bshape = self.shapes[_clean(graph_in[0])]
+        bshape = self.shapes[self._key(graph_in[0])]
         if op == "Conv2D" or op == "DepthwiseConv2dNative":
             w = self.const_of(data_inputs[1])  # HWIO (HWIM for depthwise)
             kh, kw = w.shape[0], w.shape[1]
@@ -244,9 +252,9 @@ class _TFImporter:
             self._attach(name, m, [data_inputs[0]])
         elif op in ("Add", "AddV2", "Sub", "Mul", "Maximum"):
             # tensor-tensor when both inputs are graph nodes; else constant op
-            if _clean(data_inputs[0]) not in self.graph_nodes:
+            if self._key(data_inputs[0]) not in self.graph_nodes:
                 self._ensure_node(data_inputs[0], anchor=graph_in[0])
-            other = _clean(data_inputs[1])
+            other = self._key(data_inputs[1])
             if other in self.graph_nodes:
                 cls = {"Add": nn.CAddTable, "AddV2": nn.CAddTable,
                        "Sub": nn.CSubTable, "Mul": nn.CMulTable,
@@ -308,9 +316,9 @@ class _TFImporter:
             alpha = nd.attr["alpha"].f if "alpha" in nd.attr else 0.2
             self._attach(name, nn.LeakyReLU(alpha, name=name), [data_inputs[0]])
         elif op in ("RealDiv", "Div", "Minimum"):
-            if _clean(data_inputs[0]) not in self.graph_nodes:
+            if self._key(data_inputs[0]) not in self.graph_nodes:
                 self._ensure_node(data_inputs[0], anchor=graph_in[0])
-            other = _clean(data_inputs[1])
+            other = self._key(data_inputs[1])
             if other in self.graph_nodes:
                 cls = nn.CDivTable if op != "Minimum" else nn.CMinTable
                 self._attach(name, cls(name=name), data_inputs[:2])
@@ -391,12 +399,12 @@ class _TFImporter:
                    "LogicalAnd": nn.ops.LogicalAnd,
                    "LogicalOr": nn.ops.LogicalOr}[op]
             for di in data_inputs[:2]:
-                if _clean(di) not in self.graph_nodes:
+                if self._key(di) not in self.graph_nodes:
                     self._ensure_node(di, anchor=graph_in[0])
             self._attach(name, cls(name=name), data_inputs[:2])
         elif op in ("Select", "SelectV2"):
             for di in data_inputs[:3]:
-                if _clean(di) not in self.graph_nodes:
+                if self._key(di) not in self.graph_nodes:
                     self._ensure_node(di, anchor=graph_in[0])
             self._attach(name, nn.ops.SelectOp(name=name), data_inputs[:3])
         elif op == "ArgMax":
@@ -455,10 +463,30 @@ class _TFImporter:
             if op == "GatherV2" and len(data_inputs) > 2:
                 axis = int(self.const_of(data_inputs[2]))
             for di in data_inputs[:2]:
-                if _clean(di) not in self.graph_nodes:
+                if self._key(di) not in self.graph_nodes:
                     self._ensure_node(di, anchor=graph_in[0])
             self._attach(name, nn.ops.Gather(axis, name=name),
                          data_inputs[:2])
+        elif op in ("Split", "SplitV"):
+            from bigdl_tpu.nn import tf_ops as _tf
+
+            if op == "Split":  # inputs: [axis, value]
+                axis = int(self.const_of(data_inputs[0]))
+                value = data_inputs[1]
+                num = int(nd.attr["num_split"].i)
+            else:  # SplitV inputs: [value, size_splits, axis]
+                sizes = [int(v) for v in
+                         self.const_of(data_inputs[1]).reshape(-1)]
+                if len(set(sizes)) != 1:
+                    raise ValueError("SplitV with uneven sizes unsupported")
+                axis = int(self.const_of(data_inputs[2]))
+                value = data_inputs[0]
+                num = len(sizes)
+            for kth in range(num):
+                self._attach(f"{name}:{kth}" if kth else name,
+                             _tf.SplitAndSelect(axis, kth, num,
+                                                name=f"{name}_{kth}"),
+                             [value])
         else:
             raise ValueError(
                 f"unsupported TF op {op!r} at node {name!r} "
@@ -499,7 +527,7 @@ def load_tensorflow(pb_path: str, inputs: Sequence[str],
         if len(deferred) == len(pending):
             break  # remaining nodes are constant-only subgraphs
         pending = deferred
-    outs = [imp.graph_nodes[_clean(o)] for o in outputs]
+    outs = [imp.graph_nodes[imp._key(o)] for o in outputs]
     model = nn.Graph(imp.input_nodes, outs, name="tf_graph")
     build_shapes = [imp.shapes[i] for i in inputs]
     params, state, _ = model.build(

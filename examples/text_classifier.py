@@ -22,13 +22,17 @@ def build_model(vocab_size, embed_dim, seq_len, n_classes,
 
     import bigdl_tpu.nn as nn
 
+    flat = 128 * ((seq_len - 4) // 5 - 4)
+    if flat <= 0:
+        raise ValueError(f"seq_len={seq_len} too short for the conv stack "
+                         f"(2x conv5 + pool5 needs seq_len >= 29)")
     model = nn.Sequential(
         nn.LookupTable(vocab_size, embed_dim),
         nn.TemporalConvolution(embed_dim, 128, 5), nn.ReLU(),
         nn.TemporalMaxPooling(5, 5),
         nn.TemporalConvolution(128, 128, 5), nn.ReLU(),
         nn.Flatten(),
-        nn.Linear(128 * ((seq_len - 4) // 5 - 4), 128), nn.ReLU(),
+        nn.Linear(flat, 128), nn.ReLU(),
         nn.Linear(128, n_classes), nn.LogSoftMax())
     return model
 

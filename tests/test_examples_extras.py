@@ -151,3 +151,22 @@ class TestNewExamples:
         import examples.udf_predictor as ex
 
         assert ex.main() > 0.8
+
+    def test_lenet_local(self, capsys):
+        import examples.lenet_local as ex
+
+        ex.main(["--batch-size", "64", "--epochs", "1"])
+        assert "Top1Accuracy" in capsys.readouterr().out
+
+    def test_text_classifier(self, capsys):
+        import examples.text_classifier as ex
+
+        # the CNN stack (2x conv5 + pool5) needs seq_len >= 29
+        ex.main(["--seq-len", "50", "--batch-size", "32", "--epochs", "1"])
+        assert "validation:" in capsys.readouterr().out
+
+    def test_text_classifier_short_seq_raises(self):
+        import examples.text_classifier as ex
+
+        with pytest.raises(ValueError, match="seq_len=16 too short"):
+            ex.main(["--seq-len", "16", "--epochs", "1"])

@@ -269,3 +269,39 @@ class TestBidirectionalSemantics:
                                    np.asarray(full[:, -1, :4]), rtol=1e-6)
         np.testing.assert_allclose(np.asarray(last[:, 4:]),
                                    np.asarray(full[:, 0, 4:]), rtol=1e-6)
+
+
+class TestSplitImport:
+    def test_split_multi_output(self, tmp_path):
+        gd = _graph()
+        _const(gd, "axis", np.int32(1))
+        sp = _node(gd, "sp", "Split", ["axis", "input"])
+        sp.attr["num_split"].i = 3
+        _node(gd, "s0", "Neg", ["sp"])         # bare name -> output 0
+        _node(gd, "s2", "Abs", ["sp:2"])       # explicit output index
+        _node(gd, "cat", "ConcatV2", ["s0", "sp:1", "s2", "axis"])
+        x = np.random.RandomState(0).randn(2, 9).astype(np.float32)
+        y = _run(gd, tmp_path, ["cat"], x)
+        expect = np.concatenate([-x[:, :3], x[:, 3:6], np.abs(x[:, 6:])], 1)
+        np.testing.assert_allclose(y, expect, rtol=1e-6)
+
+    def test_splitv_even(self, tmp_path):
+        gd = _graph()
+        _const(gd, "sizes", np.asarray([4, 4], np.int32))
+        _const(gd, "axis", np.int32(1))
+        sp = _node(gd, "sp", "SplitV", ["input", "sizes", "axis"])
+        _node(gd, "add", "AddV2", ["sp", "sp:1"])
+        x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+        y = _run(gd, tmp_path, ["add"], x)
+        np.testing.assert_allclose(y, x[:, :4] + x[:, 4:], rtol=1e-6)
+
+    def test_identity_of_split_output(self, tmp_path):
+        gd = _graph()
+        _const(gd, "axis", np.int32(1))
+        sp = _node(gd, "sp", "Split", ["axis", "input"])
+        sp.attr["num_split"].i = 2
+        _node(gd, "id1", "Identity", ["sp:1"])
+        _node(gd, "out", "Neg", ["id1"])
+        x = np.random.RandomState(0).randn(2, 6).astype(np.float32)
+        y = _run(gd, tmp_path, ["out"], x)
+        np.testing.assert_allclose(y, -x[:, 3:], rtol=1e-6)
