@@ -15,7 +15,8 @@ Add/AddV2/Sub/Mul/Maximum/Minimum/RealDiv/Div/Pow/SquaredDifference,
 ConcatV2, Pad, Mean/Sum/Max/Min/Prod, LogSoftmax/Softsign/LeakyRelu, unary
 math (Sqrt/Rsqrt/Square/Exp/Log/Log1p/Expm1/Abs/Neg/Floor/Round/Rint/Erf),
 ExpandDims/Transpose/Cast/Shape/Rank/Tile/Slice/StridedSlice/Gather(V2),
-comparisons + Select(V2), ArgMax, OneHot, LRN, ResizeBilinear.
+comparisons + Select(V2), ArgMax, OneHot, LRN, ResizeBilinear,
+Split/SplitV (multi-output ':k' references).
 
 `load_tensorflow(pb_path, inputs, outputs)` -> (Graph, params, state);
 `save_tensorflow(model, params, state, path, input_shape)` exports a
@@ -116,9 +117,16 @@ class _TFImporter:
     def _key(self, ref: str) -> str:
         """Resolve an input reference: multi-output producers register
         per-output keys ("split:1"); everything else registers under the
-        bare name."""
+        bare name.  An explicit non-zero output index that was never
+        registered must NOT silently alias to output 0."""
         ref = ref[1:] if ref.startswith("^") else ref
-        return ref if ref in self.graph_nodes else _clean(ref)
+        if ref in self.graph_nodes:
+            return ref
+        base, _, idx = ref.partition(":")
+        if idx not in ("", "0") and base in self.graph_nodes:
+            raise ValueError(f"output {ref!r} of multi-output node "
+                             f"{base!r} was never produced")
+        return base
 
     def _attach(self, tf_name: str, module, in_names: List[str],
                 weights: Optional[Dict[str, np.ndarray]] = None):
@@ -477,10 +485,14 @@ class _TFImporter:
             else:  # SplitV inputs: [value, size_splits, axis]
                 sizes = [int(v) for v in
                          self.const_of(data_inputs[1]).reshape(-1)]
-                if len(set(sizes)) != 1:
-                    raise ValueError("SplitV with uneven sizes unsupported")
                 axis = int(self.const_of(data_inputs[2]))
                 value = data_inputs[0]
+                if sizes.count(-1) == 1:  # one inferred slot (TF convention)
+                    dim = self.shapes[self._key(value)][axis]
+                    sizes[sizes.index(-1)] = dim - sum(s for s in sizes
+                                                       if s != -1)
+                if len(set(sizes)) != 1:
+                    raise ValueError("SplitV with uneven sizes unsupported")
                 num = len(sizes)
             for kth in range(num):
                 self._attach(f"{name}:{kth}" if kth else name,

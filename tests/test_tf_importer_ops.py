@@ -305,3 +305,22 @@ class TestSplitImport:
         x = np.random.RandomState(0).randn(2, 6).astype(np.float32)
         y = _run(gd, tmp_path, ["out"], x)
         np.testing.assert_allclose(y, -x[:, 3:], rtol=1e-6)
+
+    def test_splitv_inferred_size(self, tmp_path):
+        gd = _graph()
+        _const(gd, "sizes", np.asarray([4, -1], np.int32))
+        _const(gd, "axis", np.int32(1))
+        _node(gd, "sp", "SplitV", ["input", "sizes", "axis"])
+        _node(gd, "add", "AddV2", ["sp", "sp:1"])
+        x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+        y = _run(gd, tmp_path, ["add"], x)
+        np.testing.assert_allclose(y, x[:, :4] + x[:, 4:], rtol=1e-6)
+
+    def test_out_of_range_split_output_raises(self, tmp_path):
+        gd = _graph()
+        _const(gd, "axis", np.int32(1))
+        sp = _node(gd, "sp", "Split", ["axis", "input"])
+        sp.attr["num_split"].i = 2
+        _node(gd, "bad", "Neg", ["sp:5"])
+        with pytest.raises(ValueError, match="sp:5"):
+            _load(gd, tmp_path, ["bad"], (2, 6))
