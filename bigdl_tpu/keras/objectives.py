@@ -46,6 +46,13 @@ _LOSSES = {
     "kld": nn.DistKLDivCriterion,
     "kullback_leibler_divergence": nn.DistKLDivCriterion,
     "smooth_l1": nn.SmoothL1Criterion,
+    "mape": nn.MeanAbsolutePercentageCriterion,
+    "mean_absolute_percentage_error": nn.MeanAbsolutePercentageCriterion,
+    "msle": nn.MeanSquaredLogarithmicCriterion,
+    "mean_squared_logarithmic_error": nn.MeanSquaredLogarithmicCriterion,
+    "poisson": nn.PoissonCriterion,
+    "cosine_proximity": nn.CosineProximityCriterion,
+    "squared_hinge": lambda: nn.MarginCriterion(squared=True),
 }
 
 _OPTIMIZERS = {

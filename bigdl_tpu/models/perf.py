@@ -36,8 +36,10 @@ def build_model_and_shape(name: str, batch: int):
         return models.resnet50(1000), (batch, 224, 224, 3), 1000
     if name == "inception":
         return models.InceptionV1(1000), (batch, 224, 224, 3), 1000
+    if name == "inception_v2":
+        return models.InceptionV2(1000), (batch, 224, 224, 3), 1000
     raise ValueError(f"unknown model {name!r} "
-                     f"(lenet | vgg16 | resnet50 | inception)")
+                     f"(lenet | vgg16 | resnet50 | inception | inception_v2)")
 
 
 def run_perf(model_name: str = "inception", batch_size: int = 32,

@@ -183,3 +183,16 @@ class TestSpatialDropout3DWrapper:
             for c in range(6):
                 sl = arr[b, :, :, :, c]
                 assert np.all(sl == 0) or np.all(sl == sl.flat[0])
+
+
+class TestObjectiveRegistry:
+    def test_new_loss_names_resolve(self):
+        from bigdl_tpu.keras.objectives import resolve_loss
+
+        for name, cls in [("mape", "MeanAbsolutePercentageCriterion"),
+                          ("msle", "MeanSquaredLogarithmicCriterion"),
+                          ("poisson", "PoissonCriterion"),
+                          ("cosine_proximity", "CosineProximityCriterion"),
+                          ("squared_hinge", "MarginCriterion")]:
+            assert type(resolve_loss(name)).__name__ == cls
+        assert resolve_loss("squared_hinge").squared

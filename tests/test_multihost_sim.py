@@ -6,11 +6,60 @@ with REAL process isolation (closer to multi-host than the in-process
 8-device mesh the rest of the suite uses)."""
 
 import os
+import socket
 import subprocess
 import sys
 import textwrap
 
 import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _subprocess_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # one CPU device per process
+    # the axon sitecustomize (PYTHONPATH) force-registers the TPU tunnel at
+    # interpreter startup; strip it so the subprocesses are pure-CPU
+    keep = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon" not in p]
+    if REPO_ROOT not in keep:
+        keep.insert(0, REPO_ROOT)
+    env["PYTHONPATH"] = os.pathsep.join(keep)
+    return env
+
+
+def _launch_pair(script_path, timeout_s: float):
+    """Run `script_path` as a 2-process jax.distributed cluster; returns the
+    two processes' outputs (asserting both exited 0)."""
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "bigdl_tpu.launch",
+         "--coordinator", f"127.0.0.1:{port}",
+         "--num-processes", "2", "--process-id", str(pid), str(script_path)],
+        env=_subprocess_env(), cwd=REPO_ROOT,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for pid in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process cluster did not converge in time")
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out}"
+    return outs
+
 
 SCRIPT = textwrap.dedent("""
     import jax
@@ -19,8 +68,6 @@ SCRIPT = textwrap.dedent("""
 
     Engine.init()
     assert jax.process_count() == 2, jax.process_count()
-    # one device per process -> global psum over both processes' values
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     from jax.experimental import multihost_utils
 
     local = jnp.asarray([float(jax.process_index() + 1)])
@@ -30,41 +77,11 @@ SCRIPT = textwrap.dedent("""
 """)
 
 
-@pytest.mark.timeout(180)
 def test_two_process_cluster(tmp_path):
     script = tmp_path / "train.py"
     script.write_text(SCRIPT)
-    port = 18765
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)  # one CPU device per process
-    # the axon sitecustomize (PYTHONPATH) force-registers the TPU tunnel at
-    # interpreter startup; strip it so the subprocesses are pure-CPU
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-        if p and "axon" not in p) or "/root/repo"
-    if "/root/repo" not in env["PYTHONPATH"].split(os.pathsep):
-        env["PYTHONPATH"] = "/root/repo" + os.pathsep + env["PYTHONPATH"]
-    procs = []
-    for pid in range(2):
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "bigdl_tpu.launch",
-             "--coordinator", f"127.0.0.1:{port}",
-             "--num-processes", "2", "--process-id", str(pid),
-             str(script)],
-            env=env, cwd="/root/repo",
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=150)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("multi-process cluster did not converge in time")
-        outs.append(out)
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"process {i} failed:\n{out}"
+    outs = _launch_pair(script, timeout_s=150)
+    for i, out in enumerate(outs):
         assert f"PSUM_OK {i}" in out
 
 
@@ -100,34 +117,12 @@ TRAIN_SCRIPT = textwrap.dedent("""
 """)
 
 
-@pytest.mark.timeout(240)
 def test_two_process_distributed_training(tmp_path):
     script = tmp_path / "train2.py"
     script.write_text(TRAIN_SCRIPT)
-    port = 18767
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
-    env["PYTHONPATH"] = "/root/repo"
-    procs = [subprocess.Popen(
-        [sys.executable, "-m", "bigdl_tpu.launch",
-         "--coordinator", f"127.0.0.1:{port}",
-         "--num-processes", "2", "--process-id", str(pid), str(script)],
-        env=env, cwd="/root/repo",
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for pid in range(2)]
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=220)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("distributed training did not converge in time")
-        outs.append(out)
+    outs = _launch_pair(script, timeout_s=220)
     wsums = {}
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"process {i} failed:\n{out}"
+    for out in outs:
         for line in out.splitlines():
             if line.startswith("WSUM"):
                 _, pid, val = line.split()
