@@ -377,8 +377,14 @@ class Optimizer:
         return totals
 
     def _maybe_checkpoint(self, state):
-        if (self.ckpt_path is None or self.ckpt_trigger is None
-                or not self.ckpt_trigger(state)):
+        if self.ckpt_path is None or self.ckpt_trigger is None:
+            return
+        # the save is collective: process 0's trigger decision must bind
+        # every process (min_loss/max_score can diverge by float noise
+        # across hosts and would otherwise deadlock the gather barrier)
+        from bigdl_tpu.utils.checkpoint import agree_from_process_zero
+        should = agree_from_process_zero(int(bool(self.ckpt_trigger(state))))
+        if not should:
             return
         d = save_checkpoint(self.ckpt_path, state["neval"], self.params,
                             self.model_state, self.opt_state,

@@ -27,12 +27,46 @@ SCHEMA_VERSION = 1
 _SEP = "/"
 
 
-def _flatten(tree: Any) -> Dict[str, np.ndarray]:
+def agree_from_process_zero(value: int) -> int:
+    """Make process 0's scalar decision global (collective; every process
+    must call).  Used so checkpoint triggers that read locally-divergent
+    state (min_loss/max_score) cannot deadlock the collective save."""
+    if jax.process_count() <= 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    return int(multihost_utils.broadcast_one_to_all(
+        np.asarray(value, np.int64)))
+
+
+def _to_numpy(leaf) -> np.ndarray:
+    """Host copy of a leaf.  Cross-process sharded arrays are gathered
+    collectively (every process must reach this point) so each host holds
+    the FULL array — the multi-host analogue of DistriOptimizer.getModel
+    gathering shards back before checkpointing
+    (optim/DistriOptimizer.scala:938)."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+    return np.asarray(leaf)
+
+
+def _flatten(tree: Any, materialize: bool = True) -> Dict[str, np.ndarray]:
+    """materialize=False: participate in the collective gathers for
+    cross-process shards (same traversal order) but skip the device->host
+    copy of replicated leaves — non-writer processes need no host copy."""
     flat = {}
     paths = jax.tree_util.tree_flatten_with_path(tree)
     for path, leaf in paths[0]:
         key = _SEP.join(_path_part(p) for p in path)
-        flat[key if key else "_root"] = np.asarray(leaf)
+        addressable = not (isinstance(leaf, jax.Array)
+                           and not leaf.is_fully_addressable)
+        if not materialize and addressable:
+            continue
+        arr = _to_numpy(leaf)
+        if materialize:
+            flat[key if key else "_root"] = arr
     return flat
 
 
@@ -62,49 +96,103 @@ def _unflatten_into(template: Any, flat: Dict[str, np.ndarray]) -> Any:
 
 def save_checkpoint(path: str, step: int, params: Any, model_state: Any = None,
                     opt_state: Any = None, driver_state: Optional[Dict] = None) -> str:
-    """Write checkpoint dir `<path>/ckpt_<step>`; returns its path."""
+    """Write checkpoint dir `<path>/ckpt_<step>`; returns its path.
+
+    Multi-process safe: EVERY process must call this (the flatten step runs
+    collective gathers for cross-process shards), but only process 0
+    touches the filesystem; a barrier at the end keeps fast processes from
+    racing ahead and reading a half-written checkpoint on resume."""
     d = os.path.join(path, f"ckpt_{step}")
-    os.makedirs(d, exist_ok=True)
-    meta = {"schema_version": SCHEMA_VERSION, "step": int(step),
-            "driver_state": driver_state or {}}
-    np.savez(os.path.join(d, "params.npz"), **_flatten(params))
-    if model_state is not None:
-        np.savez(os.path.join(d, "model_state.npz"), **_flatten(model_state))
-    if opt_state is not None:
-        np.savez(os.path.join(d, "opt_state.npz"), **_flatten(opt_state))
-    with open(os.path.join(d, "meta.json"), "w") as f:
-        json.dump(meta, f, indent=2)
+    writer = jax.process_index() == 0
+    flat_p = _flatten(params, materialize=writer)
+    flat_ms = _flatten(model_state, materialize=writer) \
+        if model_state is not None else None
+    flat_os = _flatten(opt_state, materialize=writer) \
+        if opt_state is not None else None
+    if writer:
+        os.makedirs(d, exist_ok=True)
+        meta = {"schema_version": SCHEMA_VERSION, "step": int(step),
+                "driver_state": driver_state or {}}
+        np.savez(os.path.join(d, "params.npz"), **flat_p)
+        if flat_ms is not None:
+            np.savez(os.path.join(d, "model_state.npz"), **flat_ms)
+        if flat_os is not None:
+            np.savez(os.path.join(d, "opt_state.npz"), **flat_os)
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"ckpt_{step}")
     return d
 
 
 def load_checkpoint(ckpt_dir: str, params_template: Any,
                     model_state_template: Any = None,
                     opt_state_template: Any = None) -> Tuple[Any, Any, Any, Dict]:
-    """Returns (params, model_state, opt_state, driver_state)."""
-    with open(os.path.join(ckpt_dir, "meta.json")) as f:
-        meta = json.load(f)
-    if meta.get("schema_version") != SCHEMA_VERSION:
-        raise ValueError(f"unsupported checkpoint schema {meta.get('schema_version')}")
+    """Returns (params, model_state, opt_state, driver_state).
+
+    Multi-process: collective — EVERY process must call.  Only process 0
+    reads the filesystem (the writer side mirrors this); the loaded values
+    are broadcast to all processes, so hosts without a shared filesystem
+    resume identically."""
+    reader = jax.process_count() <= 1 or jax.process_index() == 0
+    meta = {"schema_version": SCHEMA_VERSION, "driver_state": {}}
+    if reader:
+        with open(os.path.join(ckpt_dir, "meta.json")) as f:
+            meta = json.load(f)
+        if meta.get("schema_version") != SCHEMA_VERSION:
+            raise ValueError(
+                f"unsupported checkpoint schema {meta.get('schema_version')}")
 
     def load_npz(name, template):
-        p = os.path.join(ckpt_dir, name)
-        if template is None or not os.path.exists(p):
+        if template is None:
             return None
-        with np.load(p) as z:
-            return _unflatten_into(template, dict(z))
+        p = os.path.join(ckpt_dir, name)
+        if reader and os.path.exists(p):
+            with np.load(p) as z:
+                return _unflatten_into(template, dict(z))
+        # non-reader (or writer-absent file): zeros in template structure,
+        # overwritten by the broadcast below when multi-process
+        return jax.tree_util.tree_map(
+            lambda l: np.zeros(np.shape(l), np.asarray(l).dtype), template)
 
     params = load_npz("params.npz", params_template)
     model_state = load_npz("model_state.npz", model_state_template)
     opt_state = load_npz("opt_state.npz", opt_state_template)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        trees = [t for t in (params, model_state, opt_state) if t is not None]
+        if trees:
+            synced = multihost_utils.broadcast_one_to_all(trees)
+            it = iter(synced)
+            params = next(it) if params is not None else None
+            model_state = next(it) if model_state is not None else None
+            opt_state = next(it) if opt_state is not None else None
+        # driver_state: small json, broadcast as padded bytes
+        raw = json.dumps(meta.get("driver_state", {})).encode()[:4096]
+        buf = np.zeros(4096, np.uint8)
+        buf[:len(raw)] = np.frombuffer(raw, np.uint8)
+        buf = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+        text = bytes(buf[buf != 0].tobytes()).decode()
+        meta["driver_state"] = json.loads(text) if text else {}
     return params, model_state, opt_state, meta.get("driver_state", {})
 
 
 def latest_checkpoint(path: str) -> Optional[str]:
-    if not os.path.isdir(path):
+    """Newest ckpt dir under `path`, agreed across processes (collective
+    when multi-process): only process 0's filesystem answer counts —
+    checkpoints are written by process 0, so on hosts without a shared
+    filesystem the others see nothing yet must resume the SAME step."""
+    best_step = -1
+    if jax.process_count() <= 1 or jax.process_index() == 0:
+        if os.path.isdir(path):
+            for name in os.listdir(path):
+                m = re.fullmatch(r"ckpt_(\d+)", name)
+                if m:
+                    best_step = max(best_step, int(m.group(1)))
+    best_step = agree_from_process_zero(best_step)
+    if best_step < 0:
         return None
-    best, best_step = None, -1
-    for name in os.listdir(path):
-        m = re.fullmatch(r"ckpt_(\d+)", name)
-        if m and int(m.group(1)) > best_step:
-            best, best_step = os.path.join(path, name), int(m.group(1))
-    return best
+    return os.path.join(path, f"ckpt_{best_step}")

@@ -36,14 +36,15 @@ def _subprocess_env() -> dict:
     return env
 
 
-def _launch_pair(script_path, timeout_s: float):
+def _launch_pair(script_path, timeout_s: float, *extra_args: str):
     """Run `script_path` as a 2-process jax.distributed cluster; returns the
     two processes' outputs (asserting both exited 0)."""
     port = _free_port()
     procs = [subprocess.Popen(
         [sys.executable, "-m", "bigdl_tpu.launch",
          "--coordinator", f"127.0.0.1:{port}",
-         "--num-processes", "2", "--process-id", str(pid), str(script_path)],
+         "--num-processes", "2", "--process-id", str(pid), str(script_path),
+         *extra_args],
         env=_subprocess_env(), cwd=REPO_ROOT,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         for pid in range(2)]
@@ -130,3 +131,47 @@ def test_two_process_distributed_training(tmp_path):
     # data-parallel sync training: both processes end with the same weights
     assert set(wsums) == {0, 1}
     assert wsums[0] == wsums[1]
+
+
+CKPT_SCRIPT = textwrap.dedent("""
+    import sys
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu import Engine
+    from bigdl_tpu.utils import checkpoint as ck
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    Engine.init()
+    assert jax.process_count() == 2
+    ckpt_dir = sys.argv[1]
+
+    # a CROSS-PROCESS sharded param: each process holds half the rows
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2), ("data",))
+    sh = NamedSharding(mesh, P("data"))
+    local = np.full((2, 3), float(jax.process_index() + 1), np.float32)
+    w = jax.make_array_from_process_local_data(sh, local)
+    assert not w.is_fully_addressable  # truly distributed
+
+    params = {"w": w}
+    d = ck.save_checkpoint(ckpt_dir, 7, params)
+    if jax.process_index() == 0:
+        with np.load(d + "/params.npz") as z:
+            full = z["w"]
+        assert full.shape == (4, 3), full.shape
+        assert full[:2].max() == 1.0 and full[2:].min() == 2.0
+        print("CKPT_FULL_OK")
+    # resume on every process from the gathered file
+    loaded = ck.load_checkpoint(d, {"w": np.zeros((4, 3), np.float32)})
+    assert np.asarray(loaded[0]["w"]).shape == (4, 3)
+    print("RESUME_OK", jax.process_index())
+""")
+
+
+def test_two_process_sharded_checkpoint(tmp_path):
+    script = tmp_path / "ckpt.py"
+    script.write_text(CKPT_SCRIPT)
+    outs = _launch_pair(script, 150, str(tmp_path / "ckpts"))
+    for i, out in enumerate(outs):
+        assert f"RESUME_OK {i}" in out
+    assert "CKPT_FULL_OK" in outs[0]
