@@ -343,8 +343,12 @@ class Optimizer:
     # ------------------------------------------------------------------
 
     def _maybe_validate(self, state):
-        if (self.val_trigger is None or self.val_dataset is None
-                or not self.val_trigger(state)):
+        if self.val_trigger is None or self.val_dataset is None:
+            return
+        # validation forms global batches (collective under multi-process):
+        # the trigger decision must be identical on every process
+        from bigdl_tpu.utils.checkpoint import agree_from_process_zero
+        if not agree_from_process_zero(int(bool(self.val_trigger(state)))):
             return
         results = self.validate()
         for r in results:
