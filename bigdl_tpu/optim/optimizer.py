@@ -342,13 +342,22 @@ class Optimizer:
 
     # ------------------------------------------------------------------
 
+    def _agreed_trigger(self, trigger, state) -> bool:
+        """Trigger decision binding on every process.  Validation batches
+        and checkpoint gathers are collective under multi-process, so a
+        trigger reading locally-divergent floats (min_loss/max_score) must
+        defer to process 0; deterministic triggers skip the broadcast."""
+        fired = bool(trigger(state))
+        if getattr(trigger, "deterministic", False):
+            return fired
+        from bigdl_tpu.utils.checkpoint import agree_from_process_zero
+
+        return bool(agree_from_process_zero(int(fired)))
+
     def _maybe_validate(self, state):
         if self.val_trigger is None or self.val_dataset is None:
             return
-        # validation forms global batches (collective under multi-process):
-        # the trigger decision must be identical on every process
-        from bigdl_tpu.utils.checkpoint import agree_from_process_zero
-        if not agree_from_process_zero(int(bool(self.val_trigger(state)))):
+        if not self._agreed_trigger(self.val_trigger, state):
             return
         results = self.validate()
         for r in results:
@@ -383,12 +392,7 @@ class Optimizer:
     def _maybe_checkpoint(self, state):
         if self.ckpt_path is None or self.ckpt_trigger is None:
             return
-        # the save is collective: process 0's trigger decision must bind
-        # every process (min_loss/max_score can diverge by float noise
-        # across hosts and would otherwise deadlock the gather barrier)
-        from bigdl_tpu.utils.checkpoint import agree_from_process_zero
-        should = agree_from_process_zero(int(bool(self.ckpt_trigger(state))))
-        if not should:
+        if not self._agreed_trigger(self.ckpt_trigger, state):
             return
         d = save_checkpoint(self.ckpt_path, state["neval"], self.params,
                             self.model_state, self.opt_state,

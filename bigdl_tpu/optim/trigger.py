@@ -12,9 +12,15 @@ from typing import Callable, Dict
 
 
 class Trigger:
-    def __init__(self, fn: Callable[[Dict], bool], desc: str = "trigger"):
+    def __init__(self, fn: Callable[[Dict], bool], desc: str = "trigger",
+                 deterministic: bool = True):
         self._fn = fn
         self.desc = desc
+        # deterministic: the predicate reads only process-identical driver
+        # state (epoch/neval/epoch_finished), so every process computes the
+        # same answer and no cross-host agreement collective is needed.
+        # loss/score-based triggers read locally-divergent floats.
+        self.deterministic = deterministic
 
     def __call__(self, state: Dict) -> bool:
         return self._fn(state)
@@ -43,17 +49,19 @@ class Trigger:
     @staticmethod
     def max_score(max_s: float) -> "Trigger":
         return Trigger(lambda s: s.get("score") is not None and s["score"] > max_s,
-                       f"maxScore({max_s})")
+                       f"maxScore({max_s})", deterministic=False)
 
     @staticmethod
     def min_loss(min_l: float) -> "Trigger":
         return Trigger(lambda s: s.get("loss") is not None and s["loss"] < min_l,
-                       f"minLoss({min_l})")
+                       f"minLoss({min_l})", deterministic=False)
 
     @staticmethod
     def and_(*triggers: "Trigger") -> "Trigger":
-        return Trigger(lambda s: all(t(s) for t in triggers), "and")
+        return Trigger(lambda s: all(t(s) for t in triggers), "and",
+                       deterministic=all(t.deterministic for t in triggers))
 
     @staticmethod
     def or_(*triggers: "Trigger") -> "Trigger":
-        return Trigger(lambda s: any(t(s) for t in triggers), "or")
+        return Trigger(lambda s: any(t(s) for t in triggers), "or",
+                       deterministic=all(t.deterministic for t in triggers))

@@ -488,3 +488,18 @@ class TestRegularizer:
         m2, p2, s2 = load_model(path)
         reg = list(m2.children.values())[0].w_regularizer
         assert reg is not None and reg.l1 == 0.1 and reg.l2 == 0.2
+
+
+class TestTriggerDeterminism:
+    def test_deterministic_flags(self):
+        from bigdl_tpu.optim import Trigger
+
+        assert Trigger.every_epoch().deterministic
+        assert Trigger.several_iteration(5).deterministic
+        assert Trigger.max_epoch(3).deterministic
+        assert not Trigger.min_loss(0.1).deterministic
+        assert not Trigger.max_score(0.9).deterministic
+        assert Trigger.and_(Trigger.max_epoch(2),
+                            Trigger.every_epoch()).deterministic
+        assert not Trigger.or_(Trigger.every_epoch(),
+                               Trigger.min_loss(0.1)).deterministic
