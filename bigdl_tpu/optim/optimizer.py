@@ -275,13 +275,13 @@ class Optimizer:
             self._restore(self._pending_restore)
             self._pending_restore = None
 
-        while not self.end_when(state):
+        while not self._agreed_trigger(self.end_when, state):
             state["epoch_finished"] = False
             epoch_start = time.time()
             record_count_epoch = 0
             completed_epoch = True
             for batch in self.dataset.data(train=True):
-                if self.end_when(state):
+                if self._agreed_trigger(self.end_when, state):
                     completed_epoch = False
                     break
                 if self.params is None or step_fn is None:

@@ -13,13 +13,14 @@ from typing import Callable, Dict
 
 class Trigger:
     def __init__(self, fn: Callable[[Dict], bool], desc: str = "trigger",
-                 deterministic: bool = True):
+                 deterministic: bool = False):
         self._fn = fn
         self.desc = desc
         # deterministic: the predicate reads only process-identical driver
         # state (epoch/neval/epoch_finished), so every process computes the
         # same answer and no cross-host agreement collective is needed.
-        # loss/score-based triggers read locally-divergent floats.
+        # Defaults to False — user-constructed triggers get the safe
+        # broadcast path; the factory methods opt in where provable.
         self.deterministic = deterministic
 
     def __call__(self, state: Dict) -> bool:
@@ -31,20 +32,20 @@ class Trigger:
     # -- factories (reference: optim/Trigger.scala) ---------------------
     @staticmethod
     def every_epoch() -> "Trigger":
-        return Trigger(lambda s: s.get("epoch_finished", False), "everyEpoch")
+        return Trigger(lambda s: s.get("epoch_finished", False), "everyEpoch", deterministic=True)
 
     @staticmethod
     def several_iteration(interval: int) -> "Trigger":
         return Trigger(lambda s: s["neval"] > 0 and s["neval"] % interval == 0,
-                       f"severalIteration({interval})")
+                       f"severalIteration({interval})", deterministic=True)
 
     @staticmethod
     def max_epoch(max_e: int) -> "Trigger":
-        return Trigger(lambda s: s["epoch"] >= max_e, f"maxEpoch({max_e})")
+        return Trigger(lambda s: s["epoch"] >= max_e, f"maxEpoch({max_e})", deterministic=True)
 
     @staticmethod
     def max_iteration(max_it: int) -> "Trigger":
-        return Trigger(lambda s: s["neval"] >= max_it, f"maxIteration({max_it})")
+        return Trigger(lambda s: s["neval"] >= max_it, f"maxIteration({max_it})", deterministic=True)
 
     @staticmethod
     def max_score(max_s: float) -> "Trigger":
@@ -58,10 +59,12 @@ class Trigger:
 
     @staticmethod
     def and_(*triggers: "Trigger") -> "Trigger":
+        det = all(getattr(t, "deterministic", False) for t in triggers)
         return Trigger(lambda s: all(t(s) for t in triggers), "and",
-                       deterministic=all(t.deterministic for t in triggers))
+                       deterministic=det)
 
     @staticmethod
     def or_(*triggers: "Trigger") -> "Trigger":
+        det = all(getattr(t, "deterministic", False) for t in triggers)
         return Trigger(lambda s: any(t(s) for t in triggers), "or",
-                       deterministic=all(t.deterministic for t in triggers))
+                       deterministic=det)

@@ -475,6 +475,41 @@ class _TFImporter:
                     self._ensure_node(di, anchor=graph_in[0])
             self._attach(name, nn.ops.Gather(axis, name=name),
                          data_inputs[:2])
+        elif op == "Conv2DBackpropInput":
+            # frozen-graph deconvolution = gradient of the forward conv:
+            # inputs [output_shape, filter (kh, kw, fwd_in_c, fwd_out_c), x].
+            # The declared output_shape drives the edge padding exactly, so
+            # stride-remainder VALID cases and TF's ASYMMETRIC SAME padding
+            # are both honored (adjoint-verified in tests).
+            w = self.const_of(data_inputs[1])
+            kh, kw, out_c, in_c = w.shape
+            strides = list(nd.attr["strides"].list.i) or [1, 1, 1, 1]
+            sh, sw = strides[1], strides[2]
+            dil = list(nd.attr["dilations"].list.i) or [1, 1, 1, 1]
+            if dil[1] > 1 or dil[2] > 1:
+                raise ValueError("dilated Conv2DBackpropInput unsupported")
+            pad = nd.attr["padding"].s.decode() if nd.attr["padding"].s \
+                else "VALID"
+            oshape = [int(v) for v in self.const_of(data_inputs[0]).reshape(-1)]
+            th, tw_ = oshape[1], oshape[2]
+            h, w_in = bshape[1], bshape[2]
+
+            def geom(target, hin, k, s):
+                if pad == "SAME":
+                    total = max(0, (hin - 1) * s + k - target)
+                    p_before = total // 2
+                else:
+                    p_before = 0
+                adj = target - ((hin - 1) * s - 2 * p_before + k)
+                return p_before, adj
+
+            ph, ah = geom(th, h, kh, sh)
+            pw, aw = geom(tw_, w_in, kw, sw)
+            m = nn.SpatialFullConvolution(
+                in_c, out_c, kw, kh, sw, sh, pw, ph, aw, ah,
+                with_bias=False, name=name)
+            self._attach(name, m, [data_inputs[2]],
+                         {"weight": np.transpose(w, (0, 1, 3, 2))})
         elif op in ("Split", "SplitV"):
             from bigdl_tpu.nn import tf_ops as _tf
 

@@ -503,3 +503,10 @@ class TestTriggerDeterminism:
                             Trigger.every_epoch()).deterministic
         assert not Trigger.or_(Trigger.every_epoch(),
                                Trigger.min_loss(0.1)).deterministic
+        # user-constructed triggers default to the SAFE broadcast path
+        assert not Trigger(lambda s: s["loss"] < 0.1, "custom").deterministic
+        # plain callables compose (classified non-deterministic)
+        mixed = Trigger.and_(Trigger.every_epoch(),
+                             lambda s: s["neval"] % 7 == 0)
+        assert not mixed.deterministic
+        assert mixed({"epoch_finished": True, "neval": 7})

@@ -324,3 +324,56 @@ class TestSplitImport:
         _node(gd, "bad", "Neg", ["sp:5"])
         with pytest.raises(ValueError, match="sp:5"):
             _load(gd, tmp_path, ["bad"], (2, 6))
+
+
+class TestDeconvImport:
+    def _adjoint_check(self, filt, x_shape, target_hw, stride, padding,
+                       tmp_path):
+        """Conv2DBackpropInput must be the EXACT adjoint of TF's forward
+        conv: <deconv(x), y> == <x, conv_fwd(y)> for random x, y — with
+        conv_fwd computed by lax's "SAME"/"VALID" (TF-identical asymmetric
+        padding), an oracle independent of the importer."""
+        from jax import lax
+
+        rs = np.random.RandomState(1)
+        kh, kw, out_c, in_c = filt.shape
+        x = rs.randn(*x_shape).astype(np.float32)
+        gd = _graph()
+        _const(gd, "oshape",
+               np.asarray([x_shape[0], *target_hw, out_c], np.int32))
+        _const(gd, "w", filt)
+        _node(gd, "dc", "Conv2DBackpropInput", ["oshape", "w", "input"],
+              strides=[1, stride, stride, 1], padding=padding)
+        y = _run(gd, tmp_path, ["dc"], x)
+        assert y.shape == (x_shape[0], *target_hw, out_c)
+        probe = rs.randn(x_shape[0], *target_hw, out_c).astype(np.float32)
+        fwd = lax.conv_general_dilated(
+            jnp.asarray(probe), jnp.asarray(filt), (stride, stride),
+            padding.decode(), dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        lhs = float(np.sum(y * probe))
+        rhs = float(np.sum(x * np.asarray(fwd)))
+        np.testing.assert_allclose(lhs, rhs, rtol=1e-3)
+
+    def test_same_stride2_adjoint(self, tmp_path):
+        filt = (np.random.RandomState(0).randn(3, 3, 5, 2) * 0.3
+                ).astype(np.float32)
+        self._adjoint_check(filt, (1, 4, 4, 2), (8, 8), 2, b"SAME", tmp_path)
+
+    def test_valid_adjoint(self, tmp_path):
+        filt = np.random.RandomState(0).randn(2, 2, 3, 2).astype(np.float32)
+        self._adjoint_check(filt, (1, 4, 4, 2), (8, 8), 2, b"VALID", tmp_path)
+
+    def test_valid_stride_remainder(self, tmp_path):
+        # fwd input 9, k=2, s=2 -> fwd out 4; declared deconv output 9
+        filt = np.random.RandomState(0).randn(2, 2, 3, 2).astype(np.float32)
+        self._adjoint_check(filt, (1, 4, 4, 2), (9, 9), 2, b"VALID", tmp_path)
+
+    def test_dilated_deconv_raises(self, tmp_path):
+        filt = np.zeros((3, 3, 2, 2), np.float32)
+        gd = _graph()
+        _const(gd, "oshape", np.asarray([1, 8, 8, 2], np.int32))
+        _const(gd, "w", filt)
+        _node(gd, "dc", "Conv2DBackpropInput", ["oshape", "w", "input"],
+              strides=[1, 2, 2, 1], padding=b"SAME", dilations=[1, 2, 2, 1])
+        with pytest.raises(ValueError, match="dilated"):
+            _load(gd, tmp_path, ["dc"], (1, 4, 4, 2))
