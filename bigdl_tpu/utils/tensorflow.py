@@ -490,6 +490,9 @@ class _TFImporter:
                 raise ValueError("dilated Conv2DBackpropInput unsupported")
             pad = nd.attr["padding"].s.decode() if nd.attr["padding"].s \
                 else "VALID"
+            if pad not in ("SAME", "VALID"):
+                raise ValueError(f"Conv2DBackpropInput padding {pad!r} "
+                                 f"unsupported")
             oshape = [int(v) for v in self.const_of(data_inputs[0]).reshape(-1)]
             th, tw_ = oshape[1], oshape[2]
             h, w_in = bshape[1], bshape[2]

@@ -1,0 +1,200 @@
+"""Differential tests of the GraphDef importer against REAL TensorFlow:
+build a tf.function, freeze it to a GraphDef, import with
+`load_tensorflow`, and compare outputs numerically with TF's own
+execution — the reference's oracle strategy (Torch7/Keras-1.2.2 runners,
+SURVEY §4) applied to the TF import path.
+
+TF is only an available test oracle in this environment; the framework
+itself never depends on it.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+tf = pytest.importorskip("tensorflow")
+
+from tensorflow.python.framework.convert_to_constants import (  # noqa: E402
+    convert_variables_to_constants_v2)
+
+from bigdl_tpu.utils.tensorflow import load_tensorflow  # noqa: E402
+from bigdl_tpu.nn import tf_ops  # noqa: E402
+
+
+def freeze(fn, spec):
+    cf = fn.get_concrete_function(tf.TensorSpec(spec, tf.float32))
+    return convert_variables_to_constants_v2(cf).graph.as_graph_def()
+
+
+def import_and_compare(fn, x, out_op, tmp_path, rtol=2e-4, atol=1e-5):
+    gd = freeze(fn, x.shape)
+    pb = str(tmp_path / "g.pb")
+    with open(pb, "wb") as fh:
+        fh.write(gd.SerializeToString())
+    inp = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    outs = [n.name for n in gd.node if n.op == out_op]
+    assert outs, f"no {out_op} node in {sorted({n.op for n in gd.node})}"
+    g, gp, gs = load_tensorflow(pb, [inp], [outs[-1]], [tuple(x.shape)])
+    y_ours = np.asarray(g.apply(gp, gs, jnp.asarray(x))[0])
+    y_tf = fn(x).numpy()
+    np.testing.assert_allclose(y_ours, y_tf, rtol=rtol, atol=atol)
+    return y_ours
+
+
+class TestRealTFGraphs:
+    def test_mlp(self, tmp_path):
+        rs = np.random.RandomState(0)
+        w1 = tf.constant(rs.randn(8, 16).astype(np.float32))
+        b1 = tf.constant(rs.randn(16).astype(np.float32))
+        w2 = tf.constant(rs.randn(16, 4).astype(np.float32))
+
+        @tf.function
+        def f(x):
+            h = tf.nn.relu(tf.linalg.matmul(x, w1) + b1)
+            return tf.nn.softmax(tf.linalg.matmul(h, w2))
+
+        import_and_compare(f, rs.randn(3, 8).astype(np.float32), "Softmax",
+                           tmp_path)
+
+    def test_cnn_same_valid_pool(self, tmp_path):
+        rs = np.random.RandomState(1)
+        k1 = tf.constant(rs.randn(3, 3, 2, 4).astype(np.float32) * 0.4)
+        k2 = tf.constant(rs.randn(3, 3, 4, 6).astype(np.float32) * 0.3)
+        b = tf.constant(rs.randn(4).astype(np.float32))
+
+        @tf.function
+        def f(x):
+            h = tf.nn.conv2d(x, k1, strides=2, padding="SAME")
+            h = tf.nn.relu(tf.nn.bias_add(h, b))
+            h = tf.nn.max_pool2d(h, 2, 2, padding="VALID")
+            h = tf.nn.conv2d(h, k2, strides=1, padding="VALID")
+            return tf.math.tanh(h)
+
+        import_and_compare(f, rs.randn(2, 12, 12, 2).astype(np.float32),
+                           "Tanh", tmp_path)
+
+    def test_depthwise_conv(self, tmp_path):
+        rs = np.random.RandomState(2)
+        k = tf.constant(rs.randn(3, 3, 3, 2).astype(np.float32) * 0.4)
+
+        @tf.function
+        def f(x):
+            return tf.nn.depthwise_conv2d(x, k, [1, 1, 1, 1], "SAME")
+
+        import_and_compare(f, rs.randn(1, 6, 6, 3).astype(np.float32),
+                           "DepthwiseConv2dNative", tmp_path)
+
+    def test_conv2d_transpose_same_k3s2(self, tmp_path):
+        # the asymmetric-SAME deconv alignment case vs REAL TF
+        rs = np.random.RandomState(3)
+        k = tf.constant(rs.randn(3, 3, 5, 2).astype(np.float32) * 0.3)
+
+        @tf.function
+        def f(x):
+            return tf.nn.conv2d_transpose(
+                x, k, output_shape=[1, 8, 8, 5], strides=2, padding="SAME")
+
+        import_and_compare(f, rs.randn(1, 4, 4, 2).astype(np.float32),
+                           "Conv2DBackpropInput", tmp_path)
+
+    def test_conv2d_transpose_valid(self, tmp_path):
+        rs = np.random.RandomState(4)
+        k = tf.constant(rs.randn(2, 2, 3, 2).astype(np.float32))
+
+        @tf.function
+        def f(x):
+            return tf.nn.conv2d_transpose(
+                x, k, output_shape=[1, 8, 8, 3], strides=2, padding="VALID")
+
+        import_and_compare(f, rs.randn(1, 4, 4, 2).astype(np.float32),
+                           "Conv2DBackpropInput", tmp_path)
+
+    def test_split_concat(self, tmp_path):
+        @tf.function
+        def f(x):
+            a, b, c = tf.split(x, 3, axis=1)
+            return tf.concat([tf.nn.relu(a), -b, tf.abs(c)], axis=1)
+
+        rs = np.random.RandomState(5)
+        import_and_compare(f, rs.randn(2, 9).astype(np.float32), "ConcatV2",
+                           tmp_path)
+
+    def test_strided_slice_and_reduce(self, tmp_path):
+        @tf.function
+        def f(x):
+            h = x[:, 1:5, ::2, :]
+            return tf.reduce_max(h, axis=2)
+
+        rs = np.random.RandomState(6)
+        import_and_compare(f, rs.randn(2, 6, 8, 3).astype(np.float32), "Max",
+                           tmp_path)
+
+    def test_lrn(self, tmp_path):
+        @tf.function
+        def f(x):
+            return tf.nn.local_response_normalization(
+                x, depth_radius=2, bias=1.5, alpha=2e-4, beta=0.6)
+
+        rs = np.random.RandomState(7)
+        import_and_compare(f, rs.randn(1, 4, 4, 8).astype(np.float32), "LRN",
+                           tmp_path)
+
+    def test_resize_bilinear(self, tmp_path):
+        @tf.function
+        def f(x):
+            return tf.compat.v1.image.resize_bilinear(
+                x, [9, 7], align_corners=True)
+
+        rs = np.random.RandomState(8)
+        import_and_compare(f, rs.randn(1, 5, 4, 2).astype(np.float32),
+                           "ResizeBilinear", tmp_path)
+
+    def test_batch_norm_inference(self, tmp_path):
+        rs = np.random.RandomState(9)
+        gamma = tf.constant(rs.rand(4).astype(np.float32) + 0.5)
+        beta = tf.constant(rs.randn(4).astype(np.float32))
+        mean = tf.constant(rs.randn(4).astype(np.float32))
+        var = tf.constant(rs.rand(4).astype(np.float32) + 0.5)
+
+        @tf.function
+        def f(x):
+            y, _, _ = tf.compat.v1.nn.fused_batch_norm(
+                x, gamma, beta, mean=mean, variance=var,
+                epsilon=1e-3, is_training=False)
+            return tf.identity(y)
+
+        gd = freeze(f, (2, 5, 5, 4))
+        ops = {n.op for n in gd.node}
+        assert any(o.startswith("FusedBatchNorm") for o in ops), ops
+        import_and_compare(f, rs.randn(2, 5, 5, 4).astype(np.float32),
+                           "Identity", tmp_path)
+
+
+class TestExampleProtoDifferential:
+    def test_parse_tf_encoded_example(self):
+        ex = tf.train.Example(features=tf.train.Features(feature={
+            "img": tf.train.Feature(float_list=tf.train.FloatList(
+                value=[1.5, -2.25, 3.0])),
+            "label": tf.train.Feature(int64_list=tf.train.Int64List(
+                value=[7, 9])),
+            "name": tf.train.Feature(bytes_list=tf.train.BytesList(
+                value=[b"cat.jpg"])),
+        }))
+        out = tf_ops.parse_example_proto(ex.SerializeToString())
+        np.testing.assert_allclose(out["img"], [1.5, -2.25, 3.0])
+        np.testing.assert_array_equal(out["label"], [7, 9])
+        assert out["name"] == [b"cat.jpg"]
+
+    def test_tf_parses_our_encoding(self):
+        buf = tf_ops.build_example_proto(
+            {"v": np.asarray([0.5, 1.5], np.float32),
+             "i": np.asarray([3], np.int64),
+             "s": b"hello"})
+        ex = tf.train.Example()
+        ex.ParseFromString(buf)
+        f = ex.features.feature
+        np.testing.assert_allclose(list(f["v"].float_list.value), [0.5, 1.5])
+        assert list(f["i"].int64_list.value) == [3]
+        assert list(f["s"].bytes_list.value) == [b"hello"]

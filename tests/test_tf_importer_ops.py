@@ -377,3 +377,13 @@ class TestDeconvImport:
               strides=[1, 2, 2, 1], padding=b"SAME", dilations=[1, 2, 2, 1])
         with pytest.raises(ValueError, match="dilated"):
             _load(gd, tmp_path, ["dc"], (1, 4, 4, 2))
+
+    def test_explicit_padding_raises(self, tmp_path):
+        filt = np.zeros((3, 3, 2, 2), np.float32)
+        gd = _graph()
+        _const(gd, "oshape", np.asarray([1, 8, 8, 2], np.int32))
+        _const(gd, "w", filt)
+        _node(gd, "dc", "Conv2DBackpropInput", ["oshape", "w", "input"],
+              strides=[1, 2, 2, 1], padding=b"EXPLICIT")
+        with pytest.raises(ValueError, match="EXPLICIT"):
+            _load(gd, tmp_path, ["dc"], (1, 4, 4, 2))
