@@ -11,6 +11,10 @@ Pytrees are flattened to path-keyed arrays ("0/weight", "cell/w_ih"), so
 the format is stable across process restarts and inspectable with numpy —
 the same goals as the reference's protobuf ModuleSerializer (§2.6), without
 inventing a binary schema.
+
+Remote paths: any `scheme://...` path (gs://, s3://, hdfs://, memory://)
+routes through fsspec — the analogue of utils/File.scala's hdfs:/s3a:
+support.  Plain paths use the local filesystem directly.
 """
 
 from __future__ import annotations
@@ -25,6 +29,87 @@ import numpy as np
 
 SCHEMA_VERSION = 1
 _SEP = "/"
+
+
+def _is_remote(path: str) -> bool:
+    return "://" in path
+
+
+def _fs_for(path: str):
+    import fsspec
+
+    return fsspec.core.url_to_fs(path)[0]
+
+
+def _open(path: str, mode: str):
+    if _is_remote(path):
+        import fsspec
+
+        return fsspec.open(path, mode).open()
+    return open(path, mode)
+
+
+def _makedirs(path: str) -> None:
+    if _is_remote(path):
+        _fs_for(path).makedirs(path, exist_ok=True)
+    else:
+        os.makedirs(path, exist_ok=True)
+
+
+def _isdir(path: str) -> bool:
+    if _is_remote(path):
+        try:
+            return _fs_for(path).isdir(path)
+        except FileNotFoundError:
+            return False
+        # auth/network errors propagate: silently reporting "no checkpoint"
+        # would restart training from scratch
+    return os.path.isdir(path)
+
+
+def _listdir(path: str):
+    if _is_remote(path):
+        fs = _fs_for(path)
+        names = []
+        for e in fs.ls(path, detail=False):
+            name = e if isinstance(e, str) else e["name"]
+            names.append(name.rstrip("/").rsplit("/", 1)[-1])
+        return names
+    return os.listdir(path)
+
+
+def _exists(path: str) -> bool:
+    if _is_remote(path):
+        return _fs_for(path).exists(path)
+    return os.path.exists(path)
+
+
+def _join(*parts: str) -> str:
+    if _is_remote(parts[0]):
+        return "/".join(p.strip("/") if i else p.rstrip("/")
+                        for i, p in enumerate(parts))
+    return os.path.join(*parts)
+
+
+def _savez(path: str, flat) -> None:
+    if _is_remote(path):
+        import io
+
+        buf = io.BytesIO()
+        np.savez(buf, **flat)
+        with _open(path, "wb") as fh:
+            fh.write(buf.getbuffer())
+    else:
+        np.savez(path, **flat)
+
+
+def _loadz(path: str):
+    if _is_remote(path):
+        import io
+
+        with _open(path, "rb") as fh:
+            return np.load(io.BytesIO(fh.read()))
+    return np.load(path)
 
 
 def agree_from_process_zero(value: int) -> int:
@@ -102,7 +187,7 @@ def save_checkpoint(path: str, step: int, params: Any, model_state: Any = None,
     collective gathers for cross-process shards), but only process 0
     touches the filesystem; a barrier at the end keeps fast processes from
     racing ahead and reading a half-written checkpoint on resume."""
-    d = os.path.join(path, f"ckpt_{step}")
+    d = _join(path, f"ckpt_{step}")
     writer = jax.process_index() == 0
     flat_p = _flatten(params, materialize=writer)
     flat_ms = _flatten(model_state, materialize=writer) \
@@ -110,15 +195,15 @@ def save_checkpoint(path: str, step: int, params: Any, model_state: Any = None,
     flat_os = _flatten(opt_state, materialize=writer) \
         if opt_state is not None else None
     if writer:
-        os.makedirs(d, exist_ok=True)
+        _makedirs(d)
         meta = {"schema_version": SCHEMA_VERSION, "step": int(step),
                 "driver_state": driver_state or {}}
-        np.savez(os.path.join(d, "params.npz"), **flat_p)
+        _savez(_join(d, "params.npz"), flat_p)
         if flat_ms is not None:
-            np.savez(os.path.join(d, "model_state.npz"), **flat_ms)
+            _savez(_join(d, "model_state.npz"), flat_ms)
         if flat_os is not None:
-            np.savez(os.path.join(d, "opt_state.npz"), **flat_os)
-        with open(os.path.join(d, "meta.json"), "w") as f:
+            _savez(_join(d, "opt_state.npz"), flat_os)
+        with _open(_join(d, "meta.json"), "w") as f:
             json.dump(meta, f, indent=2)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
@@ -139,7 +224,7 @@ def load_checkpoint(ckpt_dir: str, params_template: Any,
     reader = jax.process_count() <= 1 or jax.process_index() == 0
     meta = {"schema_version": SCHEMA_VERSION, "driver_state": {}}
     if reader:
-        with open(os.path.join(ckpt_dir, "meta.json")) as f:
+        with _open(_join(ckpt_dir, "meta.json"), "r") as f:
             meta = json.load(f)
         if meta.get("schema_version") != SCHEMA_VERSION:
             raise ValueError(
@@ -148,9 +233,9 @@ def load_checkpoint(ckpt_dir: str, params_template: Any,
     def load_npz(name, template):
         if template is None:
             return None
-        p = os.path.join(ckpt_dir, name)
-        if reader and os.path.exists(p):
-            with np.load(p) as z:
+        p = _join(ckpt_dir, name)
+        if reader and _exists(p):
+            with _loadz(p) as z:
                 return _unflatten_into(template, dict(z))
         # non-reader (or writer-absent file): zeros in template structure,
         # overwritten by the broadcast below when multi-process
@@ -187,12 +272,12 @@ def latest_checkpoint(path: str) -> Optional[str]:
     filesystem the others see nothing yet must resume the SAME step."""
     best_step = -1
     if jax.process_count() <= 1 or jax.process_index() == 0:
-        if os.path.isdir(path):
-            for name in os.listdir(path):
+        if _isdir(path):
+            for name in _listdir(path):
                 m = re.fullmatch(r"ckpt_(\d+)", name)
                 if m:
                     best_step = max(best_step, int(m.group(1)))
     best_step = agree_from_process_zero(best_step)
     if best_step < 0:
         return None
-    return os.path.join(path, f"ckpt_{best_step}")
+    return _join(path, f"ckpt_{best_step}")
