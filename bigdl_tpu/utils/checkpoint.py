@@ -69,12 +69,8 @@ def _isdir(path: str) -> bool:
 
 def _listdir(path: str):
     if _is_remote(path):
-        fs = _fs_for(path)
-        names = []
-        for e in fs.ls(path, detail=False):
-            name = e if isinstance(e, str) else e["name"]
-            names.append(name.rstrip("/").rsplit("/", 1)[-1])
-        return names
+        return [e.rstrip("/").rsplit("/", 1)[-1]
+                for e in _fs_for(path).ls(path, detail=False)]
     return os.listdir(path)
 
 
@@ -275,7 +271,10 @@ def latest_checkpoint(path: str) -> Optional[str]:
         if _isdir(path):
             for name in _listdir(path):
                 m = re.fullmatch(r"ckpt_(\d+)", name)
-                if m:
+                # meta.json is written LAST: a dir without it is an
+                # interrupted save and must not block resume from the
+                # previous intact checkpoint
+                if m and _exists(_join(path, name, "meta.json")):
                     best_step = max(best_step, int(m.group(1)))
     best_step = agree_from_process_zero(best_step)
     if best_step < 0:

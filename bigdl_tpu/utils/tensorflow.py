@@ -613,6 +613,12 @@ def save_tensorflow(model: nn.Module, params: Any, state: Any, path: str,
     gd = tfp.GraphDef()
     gd.versions.producer = 27
 
+    def typed(nd):
+        # real TF's importer requires the non-defaulted dtype attr on every
+        # typed op (NodeDef missing attr 'T' otherwise)
+        nd.attr["T"].type = tfp.DT_FLOAT
+        return nd
+
     def add_const(cname: str, arr: np.ndarray) -> str:
         nd = gd.node.add()
         nd.name = cname
@@ -642,20 +648,23 @@ def save_tensorflow(model: nn.Module, params: Any, state: Any, path: str,
             nd = gd.node.add()
             nd.name = m.name
             nd.op = "Conv2D"
+            typed(nd)
             nd.input.extend([prev, wname])
             nd.attr["strides"].list.i.extend([1, m.stride[0], m.stride[1], 1])
             if m.dilation != (1, 1):  # SpatialDilatedConvolution subclass
                 nd.attr["dilations"].list.i.extend(
                     [1, m.dilation[0], m.dilation[1], 1])
+            if m.pad not in ((-1, -1), (0, 0)):
+                raise ValueError("TF export supports pad (0, 0) or "
+                                 "SAME (-1, -1) only, uniformly per layer")
             nd.attr["padding"].s = b"SAME" if m.pad[0] == -1 else b"VALID"
-            if m.pad[0] not in (-1, 0) or m.pad[1] not in (-1, 0):
-                raise ValueError("TF export supports pad 0 or SAME only")
             prev = m.name
             if m.with_bias:
                 bname = add_const(f"{m.name}/bias", np.asarray(p["bias"]))
                 nb = gd.node.add()
                 nb.name = f"{m.name}/BiasAdd"
                 nb.op = "BiasAdd"
+                typed(nb)
                 nb.input.extend([prev, bname])
                 prev = nb.name
         elif isinstance(m, nn.Linear):
@@ -664,6 +673,7 @@ def save_tensorflow(model: nn.Module, params: Any, state: Any, path: str,
             nd = gd.node.add()
             nd.name = m.name
             nd.op = "MatMul"
+            typed(nd)
             nd.input.extend([prev, wname])
             prev = m.name
             if "bias" in p:
@@ -671,18 +681,21 @@ def save_tensorflow(model: nn.Module, params: Any, state: Any, path: str,
                 nb = gd.node.add()
                 nb.name = f"{m.name}/BiasAdd"
                 nb.op = "BiasAdd"
+                typed(nb)
                 nb.input.extend([prev, bname])
                 prev = nb.name
         elif isinstance(m, (nn.SpatialMaxPooling, nn.SpatialAveragePooling)):
             nd = gd.node.add()
             nd.name = m.name
             nd.op = "MaxPool" if isinstance(m, nn.SpatialMaxPooling) else "AvgPool"
+            typed(nd)
             nd.input.append(prev)
             nd.attr["ksize"].list.i.extend([1, m.kernel[0], m.kernel[1], 1])
             nd.attr["strides"].list.i.extend([1, m.stride[0], m.stride[1], 1])
+            if m.pad not in ((-1, -1), (0, 0)):
+                raise ValueError("TF export supports pad (0, 0) or "
+                                 "SAME (-1, -1) only, uniformly per layer")
             nd.attr["padding"].s = b"SAME" if m.pad[0] == -1 else b"VALID"
-            if m.pad[0] not in (-1, 0) or m.pad[1] not in (-1, 0):
-                raise ValueError("TF export supports pad 0 or SAME only")
             prev = m.name
         elif isinstance(m, (nn.ReLU, nn.ReLU6, nn.Tanh, nn.Sigmoid, nn.ELU,
                             nn.SoftPlus, nn.SoftMax)):
@@ -691,18 +704,21 @@ def save_tensorflow(model: nn.Module, params: Any, state: Any, path: str,
             nd.op = {nn.ReLU: "Relu", nn.ReLU6: "Relu6", nn.Tanh: "Tanh",
                      nn.Sigmoid: "Sigmoid", nn.ELU: "Elu",
                      nn.SoftPlus: "Softplus", nn.SoftMax: "Softmax"}[type(m)]
+            typed(nd)
             nd.input.append(prev)
             prev = m.name
         elif isinstance(m, nn.SpatialBatchNormalization):
             nd = gd.node.add()
             nd.name = m.name
             nd.op = "FusedBatchNorm"
+            typed(nd)
             g_ = add_const(f"{m.name}/gamma", np.asarray(p["weight"]))
             b_ = add_const(f"{m.name}/beta", np.asarray(p["bias"]))
             mu = add_const(f"{m.name}/mean", np.asarray(s["running_mean"]))
             var = add_const(f"{m.name}/var", np.asarray(s["running_var"]))
             nd.input.extend([prev, g_, b_, mu, var])
             nd.attr["epsilon"].f = m.eps
+            nd.attr["is_training"].b = False  # inference: use mean/var inputs
             prev = m.name
         elif isinstance(m, nn.Flatten):
             flat = int(np.prod(cur_shape[1:])) if cur_shape is not None else -1
@@ -711,6 +727,8 @@ def save_tensorflow(model: nn.Module, params: Any, state: Any, path: str,
             nd = gd.node.add()
             nd.name = m.name
             nd.op = "Reshape"
+            typed(nd)
+            nd.attr["Tshape"].type = tfp.DT_INT32
             nd.input.extend([prev, shape_name])
             prev = m.name
         elif isinstance(m, nn.Dropout):

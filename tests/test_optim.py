@@ -517,6 +517,7 @@ class TestRemoteCheckpoint:
         """fsspec-routed checkpoint path (memory:// stands in for gs://
         hdfs:// s3:// — the reference's utils/File remote-path parity)."""
         import numpy as np
+        pytest.importorskip("fsspec")
         from bigdl_tpu.utils import checkpoint as ck
 
         params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
@@ -528,3 +529,14 @@ class TestRemoteCheckpoint:
             d, {"w": np.zeros((2, 3), np.float32)})
         np.testing.assert_allclose(loaded["w"], params["w"])
         assert drv["epoch"] == 1
+
+    def test_interrupted_save_does_not_block_resume(self, tmp_path):
+        """A ckpt dir without meta.json (killed mid-save) is skipped and
+        the previous intact checkpoint resumes."""
+        import numpy as np
+        from bigdl_tpu.utils import checkpoint as ck
+
+        params = {"w": np.ones((2, 2), np.float32)}
+        good = ck.save_checkpoint(str(tmp_path), 5, params)
+        (tmp_path / "ckpt_9").mkdir()  # interrupted: no meta.json
+        assert ck.latest_checkpoint(str(tmp_path)) == good

@@ -198,3 +198,64 @@ class TestExampleProtoDifferential:
         np.testing.assert_allclose(list(f["v"].float_list.value), [0.5, 1.5])
         assert list(f["i"].int64_list.value) == [3]
         assert list(f["s"].bytes_list.value) == [b"hello"]
+
+
+class TestExportToRealTF:
+    """The reverse direction: real TensorFlow executes GraphDefs exported
+    by save_tensorflow (reference: TensorflowSaver/BigDLToTensorflow)."""
+
+    def _tf_run(self, pb, x):
+        gd = tf.compat.v1.GraphDef()
+        with open(pb, "rb") as fh:
+            gd.ParseFromString(fh.read())
+        g = tf.Graph()
+        with g.as_default():
+            tf.import_graph_def(gd, name="")
+        inp = [n.name for n in gd.node if n.op == "Placeholder"][0]
+        # output = the node nobody consumes (gd.node[-1] can be a Const:
+        # FusedBatchNorm appends its stat constants after the op node)
+        consumed = {i.split(":")[0] for n in gd.node for i in n.input}
+        outs = [n.name for n in gd.node
+                if n.op not in ("Const", "Placeholder")
+                and n.name not in consumed]
+        assert len(outs) == 1, outs
+        with tf.compat.v1.Session(graph=g) as s:
+            return s.run(outs[0] + ":0", {inp + ":0": x})
+
+    def _roundtrip(self, model, shape, tmp_path):
+        import bigdl_tpu.nn as nn  # noqa: F401
+        from bigdl_tpu.utils.tensorflow import save_tensorflow
+
+        params, state, _ = model.build(jax.random.PRNGKey(0), shape)
+        model.evaluate()
+        pb = str(tmp_path / "export.pb")
+        save_tensorflow(model, params, state, pb, shape)
+        x = np.random.RandomState(0).rand(*shape).astype(np.float32)
+        y_tf = self._tf_run(pb, x)
+        y_ours = np.asarray(model.apply(params, state, jnp.asarray(x),
+                                        training=False)[0])
+        np.testing.assert_allclose(y_tf, y_ours, rtol=2e-4, atol=1e-5)
+
+    def test_cnn_export(self, tmp_path):
+        import bigdl_tpu.nn as nn
+
+        self._roundtrip(nn.Sequential(
+            nn.SpatialConvolution(3, 4, 3, 3, pad_w=-1, pad_h=-1),
+            nn.SpatialBatchNormalization(4), nn.ReLU(),
+            nn.SpatialMaxPooling(2, 2), nn.Flatten(),
+            nn.Linear(4 * 4 * 4, 5), nn.SoftMax()), (2, 8, 8, 3), tmp_path)
+
+    def test_mlp_export(self, tmp_path):
+        import bigdl_tpu.nn as nn
+
+        self._roundtrip(nn.Sequential(
+            nn.Linear(6, 12), nn.Tanh(), nn.Dropout(0.5),
+            nn.Linear(12, 3), nn.Sigmoid()), (4, 6), tmp_path)
+
+    def test_avgpool_elu_export(self, tmp_path):
+        import bigdl_tpu.nn as nn
+
+        self._roundtrip(nn.Sequential(
+            nn.SpatialConvolution(2, 3, 2, 2), nn.ELU(),
+            nn.SpatialAveragePooling(2, 2), nn.Flatten(),
+            nn.Linear(3 * 3 * 3, 2)), (1, 8, 8, 2), tmp_path)
