@@ -170,3 +170,17 @@ class TestNewExamples:
 
         with pytest.raises(ValueError, match="seq_len=16 too short"):
             ex.main(["--seq-len", "16", "--epochs", "1"])
+
+    def test_vae(self):
+        import examples.vae as ex
+
+        loss, kl = ex.main(["--epochs", "2", "--batch-size", "64"])
+        assert np.isfinite(loss)
+        assert kl > 0.0  # posterior must not collapse to exactly N(0,1)
+
+    def test_vae_empty_raises(self):
+        import examples.vae as ex
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="nothing to train"):
+            ex.main(["--batch-size", "4096", "--epochs", "1"])
