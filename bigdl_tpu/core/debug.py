@@ -88,3 +88,64 @@ def tap_finite(x: jnp.ndarray, name: str = "value") -> jnp.ndarray:
     finite = jnp.isfinite(x)
     jax.debug.callback(cb, jnp.all(finite), jnp.sum(~finite))
     return x
+
+
+def check_gradients(module, input_shape, *, rng=None, eps: float = 1e-3,
+                    rtol: float = 1e-2, atol: float = 1e-4,
+                    n_probe: int = 5, criterion=None, target=None,
+                    seed: int = 0):
+    """Numeric (central-difference) vs autodiff gradient check for a module
+    — the analogue of the reference's test-side GradientChecker
+    (spark/dl test utils, used across its nn specs).
+
+    Checks d(loss)/d(param) on `n_probe` randomly chosen parameter scalars
+    per leaf, where loss = criterion(module(x), target) (defaults to
+    sum-of-squares of the output).  Returns the max relative error;
+    raises AssertionError beyond (rtol, atol).  Perturbations keep each
+    leaf's own dtype (enable jax_enable_x64 and tighten eps for fp64-grade
+    checks); non-floating leaves are skipped.
+    """
+    if rng is None:
+        rng = jax.random.PRNGKey(seed)
+    k_build, k_x = jax.random.split(rng)
+    params, state, _ = module.build(k_build, input_shape)
+    x = jax.random.normal(k_x, input_shape)
+
+    def loss_fn(p):
+        y, _ = module.apply(p, state, x, training=False)
+        if criterion is not None:
+            return criterion.forward(y, target)
+        leaves = jax.tree_util.tree_leaves(y)
+        return sum(jnp.sum(jnp.square(leaf)) for leaf in leaves) * 0.5
+
+    loss_jit = jax.jit(loss_fn)  # one compile; reused 2*n_probe*leaves times
+    auto = jax.grad(loss_fn)(params)
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_g = jax.tree_util.tree_leaves(auto)
+    rs = np.random.RandomState(seed)
+    worst = 0.0
+    for li, (leaf0, g) in enumerate(zip(flat_p, flat_g)):
+        dtype = np.asarray(leaf0).dtype
+        if leaf0.size == 0 or not np.issubdtype(dtype, np.floating):
+            continue
+        leaf = np.asarray(leaf0, np.float64)
+        for idx in rs.choice(leaf.size, min(n_probe, leaf.size), replace=False):
+            loc = np.unravel_index(idx, leaf.shape)
+
+            def perturbed(delta):
+                pl = leaf.copy()
+                pl[loc] += delta
+                flat2 = list(flat_p)
+                flat2[li] = jnp.asarray(pl, dtype)
+                return float(loss_jit(jax.tree_util.tree_unflatten(treedef, flat2)))
+
+            numeric = (perturbed(eps) - perturbed(-eps)) / (2 * eps)
+            analytic = float(np.asarray(g)[loc])
+            err = abs(numeric - analytic) / max(abs(numeric), abs(analytic), atol / rtol)
+            worst = max(worst, err)
+            if err > rtol and abs(numeric - analytic) > atol:
+                raise AssertionError(
+                    f"gradient mismatch at leaf {li} {loc}: "
+                    f"numeric {numeric:.6g} vs autodiff {analytic:.6g} "
+                    f"(rel err {err:.3g})")
+    return worst

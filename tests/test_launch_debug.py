@@ -104,3 +104,50 @@ class TestDebug:
 
         assert EngineConfig(mesh_spec="data=-1,model=2").parse_mesh() == \
             {"data": -1, "model": 2}
+
+
+class TestGradientChecker:
+    def test_linear_chain_passes(self):
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.core.debug import check_gradients
+
+        m = nn.Sequential(nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 3))
+        worst = check_gradients(m, (4, 6))
+        assert worst < 1e-2
+
+    def test_conv_bn_passes(self):
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.core.debug import check_gradients
+
+        m = nn.Sequential(nn.SpatialConvolution(2, 3, 3, 3),
+                          nn.SpatialBatchNormalization(3), nn.SiLU())
+        check_gradients(m, (2, 6, 6, 2))
+
+    def test_with_criterion(self):
+        import jax.numpy as jnp
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.core.debug import check_gradients
+
+        m = nn.Sequential(nn.Linear(5, 4), nn.LogSoftMax())
+        check_gradients(m, (3, 5), criterion=nn.ClassNLLCriterion(),
+                        target=jnp.asarray([0, 2, 1]))
+
+    def test_detects_wrong_gradient(self):
+        import jax
+        import jax.numpy as jnp
+        import pytest as _pytest
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.core.debug import check_gradients
+        from bigdl_tpu.nn.module import Module
+
+        class BrokenGrad(Module):
+            def build(self, rng, input_shape):
+                return {"w": jnp.ones((3,))}, {}, input_shape
+
+            def apply(self, params, state, x, *, training=False, rng=None):
+                # stop_gradient makes autodiff report 0 while the numeric
+                # gradient is nonzero
+                return x * jax.lax.stop_gradient(params["w"]) + params["w"] * 0.0, state
+
+        with _pytest.raises(AssertionError, match="gradient mismatch"):
+            check_gradients(BrokenGrad(), (2, 3))
