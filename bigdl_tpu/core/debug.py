@@ -112,11 +112,16 @@ def check_gradients(module, input_shape, *, rng=None, eps: float = 1e-3,
     x = jax.random.normal(k_x, input_shape)
 
     def loss_fn(p):
-        y, _ = module.apply(p, state, x, training=False)
-        if criterion is not None:
-            return criterion.forward(y, target)
-        leaves = jax.tree_util.tree_leaves(y)
-        return sum(jnp.sum(jnp.square(leaf)) for leaf in leaves) * 0.5
+        # full-precision matmuls INSIDE the traced function: on TPU the
+        # default fast (bf16-pass) precision injects noise larger than the
+        # eps-sized central differences.  (A `with` block around jax.jit
+        # would be inert — tracing happens lazily at the first call.)
+        with jax.default_matmul_precision("highest"):
+            y, _ = module.apply(p, state, x, training=False)
+            if criterion is not None:
+                return criterion.forward(y, target)
+            leaves = jax.tree_util.tree_leaves(y)
+            return sum(jnp.sum(jnp.square(leaf)) for leaf in leaves) * 0.5
 
     loss_jit = jax.jit(loss_fn)  # one compile; reused 2*n_probe*leaves times
     auto = jax.grad(loss_fn)(params)
