@@ -130,3 +130,33 @@ def test_quantized_int8_params_are_small(rng):
     float_bytes = np.asarray(params["weight"]).nbytes
     q_bytes = np.asarray(qparams["weight_q"]).nbytes
     assert q_bytes * 4 == float_bytes
+
+
+class TestQuantizeImportedModels:
+    def test_quantize_loaded_caffe_graph(self, tmp_path):
+        """The reference headline flow: import a trained model, then
+        `quantize()` it for int8 inference (whitepaper; Quantizer.scala
+        applied to CaffeLoader output)."""
+        import jax
+        import jax.numpy as jnp
+
+        from bigdl_tpu.nn.quantized import quantize
+        from bigdl_tpu.utils.caffe import load_caffe
+
+        proto = (tmp_path / "n.prototxt")
+        proto.write_text(
+            'name: "n"\ninput: "data"\n'
+            'input_shape { dim: 1 dim: 3 dim: 16 dim: 16 }\n'
+            'layer { name: "c1" type: "Convolution" bottom: "data" top: "c1"'
+            ' convolution_param { num_output: 8 kernel_size: 3 pad: 1 } }\n'
+            'layer { name: "r1" type: "ReLU" bottom: "c1" top: "r1" }\n'
+            'layer { name: "fc" type: "InnerProduct" bottom: "r1" top: "fc"'
+            ' inner_product_param { num_output: 5 } }\n'
+            'layer { name: "sm" type: "Softmax" bottom: "fc" top: "sm" }\n')
+        g, p, s = load_caffe(str(proto))
+        qg, qp = quantize(g, p)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 16, 16, 3))
+        y, _ = g.apply(p, s, x)
+        yq, _ = qg.apply(qp, s, x)
+        assert int(jnp.argmax(y)) == int(jnp.argmax(yq))
+        assert float(jnp.max(jnp.abs(y - yq))) < 0.05
