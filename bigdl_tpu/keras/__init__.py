@@ -35,6 +35,10 @@ from bigdl_tpu.keras.layers import (
     Bidirectional,
     MaxoutDense,
     ThresholdedReLU,
+    LeakyReLU,
+    ELU,
+    PReLU,
+    SReLU,
     LocallyConnected1D,
     LocallyConnected2D,
     Merge,
@@ -86,7 +90,7 @@ __all__ = [
     "GlobalMaxPooling3D", "GlobalAveragePooling3D", "Convolution3D",
     "AtrousConvolution1D", "AtrousConvolution2D", "Deconvolution2D",
     "SeparableConvolution2D", "ConvLSTM2D", "Bidirectional", "MaxoutDense",
-    "ThresholdedReLU", "LocallyConnected1D", "LocallyConnected2D", "Merge",
+    "ThresholdedReLU", "LeakyReLU", "ELU", "PReLU", "SReLU", "LocallyConnected1D", "LocallyConnected2D", "Merge",
     "CategoricalCrossEntropy", "resolve_loss", "resolve_optimizer",
     "resolve_metrics",
 ]

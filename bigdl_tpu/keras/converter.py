@@ -7,10 +7,16 @@ JSON produced by Keras-1 `model.to_json()`, and `load_keras_weights`
 applies a `get_weights()`-style weight list (delegating layout fixes to
 `bigdl_tpu.utils.interop.import_keras_weights`).
 
-Supported layer classes mirror the reference converter's core set: Dense,
-Activation, Dropout, Flatten, Reshape, Convolution2D, MaxPooling2D,
-AveragePooling2D, GlobalAveragePooling2D, BatchNormalization, Embedding,
-LSTM, GRU, SimpleRNN, TimeDistributed(Dense).
+Definition coverage spans the wrapper zoo: dense/conv 1-3D (incl. atrous/
+deconv/separable/locally-connected), pooling (incl. global, 1/2/3-D),
+padding/cropping/upsampling, Permute/RepeatVector, BatchNormalization,
+Embedding, recurrent (LSTM/GRU/SimpleRNN) + Bidirectional +
+TimeDistributed, advanced activations (LeakyReLU/ELU/PReLU/
+ThresholdedReLU), MaxoutDense, Highway, SpatialDropout1/2/3D.
+`get_weights()` import covers Dense, Convolution1/2/3D, Deconvolution2D,
+BatchNormalization, Embedding, LSTM/GRU/SimpleRNN; other classes convert
+definition-only and raise a clear error if weights are supplied for them.
+Unsupported border modes raise instead of silently converting.
 """
 
 from __future__ import annotations
@@ -78,6 +84,139 @@ def _convert_layer(class_name: str, cfg: Dict[str, Any]):
         inner_def = cfg["layer"]
         inner = _convert_layer(inner_def["class_name"], inner_def["config"])
         return KL.TimeDistributed(inner, input_shape=shape, name=name)
+    if class_name == "Convolution1D":
+        if cfg.get("border_mode", "valid") != "valid":
+            raise ValueError("Convolution1D supports border_mode='valid' only")
+        return KL.Convolution1D(
+            cfg["nb_filter"], cfg["filter_length"], activation=act,
+            subsample_length=cfg.get("subsample_length", 1),
+            bias=cfg.get("bias", True), input_shape=shape, name=name)
+    if class_name in ("MaxPooling1D", "AveragePooling1D"):
+        if cfg.get("border_mode", "valid") != "valid":
+            raise ValueError(f"{class_name} supports border_mode='valid' only")
+        cls = getattr(KL, class_name)
+        return cls(cfg.get("pool_length", 2), stride=cfg.get("stride"),
+                   input_shape=shape, name=name)
+    if class_name in ("MaxPooling3D", "AveragePooling3D"):
+        if cfg.get("border_mode", "valid") != "valid":
+            raise ValueError(f"{class_name} supports border_mode='valid' only")
+        cls = getattr(KL, class_name)
+        return cls(pool_size=tuple(cfg.get("pool_size", (2, 2, 2))),
+                   strides=(tuple(cfg["strides"]) if cfg.get("strides") else None),
+                   input_shape=shape, name=name)
+    if class_name in ("GlobalMaxPooling1D", "GlobalAveragePooling1D",
+                      "GlobalMaxPooling2D", "GlobalMaxPooling3D",
+                      "GlobalAveragePooling3D"):
+        return getattr(KL, class_name)(input_shape=shape, name=name)
+    if class_name == "Convolution3D":
+        return KL.Convolution3D(
+            cfg["nb_filter"], cfg["kernel_dim1"], cfg["kernel_dim2"],
+            cfg["kernel_dim3"], activation=act,
+            border_mode=cfg.get("border_mode", "valid"),
+            subsample=tuple(cfg.get("subsample", (1, 1, 1))),
+            bias=cfg.get("bias", True), input_shape=shape, name=name)
+    if class_name == "AtrousConvolution2D":
+        if cfg.get("border_mode", "valid") != "valid":
+            raise ValueError("AtrousConvolution2D supports "
+                             "border_mode='valid' only")
+        if not cfg.get("bias", True):
+            raise ValueError("AtrousConvolution2D without bias unsupported")
+        return KL.AtrousConvolution2D(
+            cfg["nb_filter"], cfg["nb_row"], cfg["nb_col"], activation=act,
+            subsample=tuple(cfg.get("subsample", (1, 1))),
+            atrous_rate=tuple(cfg.get("atrous_rate", (1, 1))),
+            input_shape=shape, name=name)
+    if class_name == "AtrousConvolution1D":
+        if cfg.get("border_mode", "valid") != "valid":
+            raise ValueError("AtrousConvolution1D supports "
+                             "border_mode='valid' only")
+        return KL.AtrousConvolution1D(
+            cfg["nb_filter"], cfg["filter_length"], activation=act,
+            subsample_length=cfg.get("subsample_length", 1),
+            atrous_rate=cfg.get("atrous_rate", 1),
+            input_shape=shape, name=name)
+    if class_name == "Deconvolution2D":
+        if cfg.get("border_mode", "valid") != "valid":
+            raise ValueError("Deconvolution2D supports border_mode='valid' "
+                             "only")
+        return KL.Deconvolution2D(
+            cfg["nb_filter"], cfg["nb_row"], cfg["nb_col"], activation=act,
+            subsample=tuple(cfg.get("subsample", (1, 1))),
+            bias=cfg.get("bias", True), input_shape=shape, name=name)
+    if class_name == "SeparableConvolution2D":
+        return KL.SeparableConvolution2D(
+            cfg["nb_filter"], cfg["nb_row"], cfg["nb_col"], activation=act,
+            border_mode=cfg.get("border_mode", "valid"),
+            subsample=tuple(cfg.get("subsample", (1, 1))),
+            depth_multiplier=cfg.get("depth_multiplier", 1),
+            bias=cfg.get("bias", True), input_shape=shape, name=name)
+    if class_name in ("LocallyConnected1D",):
+        return KL.LocallyConnected1D(
+            cfg["nb_filter"], cfg["filter_length"], activation=act,
+            subsample_length=cfg.get("subsample_length", 1),
+            bias=cfg.get("bias", True), input_shape=shape, name=name)
+    if class_name == "LocallyConnected2D":
+        return KL.LocallyConnected2D(
+            cfg["nb_filter"], cfg["nb_row"], cfg["nb_col"], activation=act,
+            subsample=tuple(cfg.get("subsample", (1, 1))),
+            bias=cfg.get("bias", True), input_shape=shape, name=name)
+    if class_name == "ZeroPadding1D":
+        return KL.ZeroPadding1D(cfg.get("padding", 1), input_shape=shape,
+                                name=name)
+    if class_name == "ZeroPadding2D":
+        return KL.ZeroPadding2D(tuple(cfg.get("padding", (1, 1))),
+                                input_shape=shape, name=name)
+    if class_name == "ZeroPadding3D":
+        return KL.ZeroPadding3D(tuple(cfg.get("padding", (1, 1, 1))),
+                                input_shape=shape, name=name)
+    if class_name == "Cropping1D":
+        return KL.Cropping1D(tuple(cfg.get("cropping", (1, 1))),
+                             input_shape=shape, name=name)
+    if class_name == "Cropping2D":
+        return KL.Cropping2D(tuple(tuple(c) for c in
+                                   cfg.get("cropping", ((0, 0), (0, 0)))),
+                             input_shape=shape, name=name)
+    if class_name == "Cropping3D":
+        return KL.Cropping3D(tuple(tuple(c) for c in
+                                   cfg.get("cropping",
+                                           ((1, 1), (1, 1), (1, 1)))),
+                             input_shape=shape, name=name)
+    if class_name == "UpSampling1D":
+        return KL.UpSampling1D(cfg.get("length", 2), input_shape=shape,
+                               name=name)
+    if class_name == "UpSampling2D":
+        return KL.UpSampling2D(tuple(cfg.get("size", (2, 2))),
+                               input_shape=shape, name=name)
+    if class_name == "Permute":
+        return KL.Permute(tuple(cfg["dims"]), input_shape=shape, name=name)
+    if class_name == "RepeatVector":
+        return KL.RepeatVector(cfg["n"], input_shape=shape, name=name)
+    if class_name == "Highway":
+        return KL.Highway(activation=act, input_shape=shape, name=name)
+    if class_name == "MaxoutDense":
+        return KL.MaxoutDense(cfg["output_dim"],
+                              nb_feature=cfg.get("nb_feature", 4),
+                              bias=cfg.get("bias", True),
+                              input_shape=shape, name=name)
+    if class_name in ("SpatialDropout1D", "SpatialDropout2D",
+                      "SpatialDropout3D"):
+        return getattr(KL, class_name)(cfg["p"], input_shape=shape, name=name)
+    if class_name == "ThresholdedReLU":
+        return KL.ThresholdedReLU(cfg.get("theta", 1.0), input_shape=shape,
+                                  name=name)
+    if class_name == "LeakyReLU":
+        return KL.LeakyReLU(cfg.get("alpha", 0.3), input_shape=shape,
+                            name=name)
+    if class_name == "ELU":
+        return KL.ELU(cfg.get("alpha", 1.0), input_shape=shape, name=name)
+    if class_name == "PReLU":
+        return KL.PReLU(input_shape=shape, name=name)
+    if class_name == "Bidirectional":
+        inner_def = cfg["layer"]
+        inner = _convert_layer(inner_def["class_name"], inner_def["config"])
+        return KL.Bidirectional(inner,
+                                merge_mode=cfg.get("merge_mode", "concat"),
+                                input_shape=shape, name=name)
     raise ValueError(f"unsupported Keras layer class {class_name!r} "
                      f"(reference converter: pyspark/bigdl/keras/converter.py)")
 

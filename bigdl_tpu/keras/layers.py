@@ -898,3 +898,43 @@ class Merge(KerasLayer):
             "dot": nn.DotProduct(), "cosine": nn.CosineDistance(),
         }[self.mode]
         return nn.Sequential(nn.ParallelTable(*self.branches), combine)
+
+
+class LeakyReLU(KerasLayer):
+    """Advanced activation. reference: nn/keras/LeakyReLU.scala."""
+
+    def __init__(self, alpha: float = 0.3,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.alpha = alpha
+
+    def _make(self, input_shape):
+        return nn.LeakyReLU(self.alpha)
+
+
+class ELU(KerasLayer):
+    """Advanced activation. reference: nn/keras/ELU.scala."""
+
+    def __init__(self, alpha: float = 1.0,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.alpha = alpha
+
+    def _make(self, input_shape):
+        return nn.ELU(self.alpha)
+
+
+class PReLU(KerasLayer):
+    """Advanced activation (learned slopes). reference: nn/keras/PReLU.scala."""
+
+    def _make(self, input_shape):
+        return nn.PReLU(input_shape[-1])
+
+
+class SReLU(KerasLayer):
+    """S-shaped ReLU. reference: nn/keras/SReLU.scala."""
+
+    def _make(self, input_shape):
+        return nn.SReLU((input_shape[-1],))

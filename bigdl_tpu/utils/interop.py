@@ -33,7 +33,9 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from bigdl_tpu.nn.conv import SpatialConvolution
+from bigdl_tpu.nn.conv import (SpatialConvolution, SpatialFullConvolution,
+                               TemporalConvolution)
+from bigdl_tpu.nn.volumetric import VolumetricConvolution
 from bigdl_tpu.nn.embedding import LookupTable
 from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.nn.module import Container, Module
@@ -60,6 +62,30 @@ def _import_linear(m: Linear, g: Dict[str, np.ndarray]):
 def _import_conv(m: SpatialConvolution, g: Dict[str, np.ndarray]):
     w = _np(g["weight"])  # OIHW
     params = {"weight": jnp.asarray(w.transpose(2, 3, 1, 0))}  # HWIO
+    if m.with_bias and "bias" in g:
+        params["bias"] = jnp.asarray(_np(g["bias"]))
+    return params, {}
+
+
+def _import_temporal_conv(m: TemporalConvolution, g: Dict[str, np.ndarray]):
+    w = _np(g["weight"])  # torch Conv1d: (out, in, k)
+    params = {"weight": jnp.asarray(w.transpose(2, 1, 0))}  # (k, in, out)
+    if m.with_bias and "bias" in g:
+        params["bias"] = jnp.asarray(_np(g["bias"]))
+    return params, {}
+
+
+def _import_volumetric_conv(m: VolumetricConvolution, g: Dict[str, np.ndarray]):
+    w = _np(g["weight"])  # torch Conv3d: (out, in, kt, kh, kw)
+    params = {"weight": jnp.asarray(w.transpose(2, 3, 4, 1, 0))}  # DHWIO
+    if m.with_bias and "bias" in g:
+        params["bias"] = jnp.asarray(_np(g["bias"]))
+    return params, {}
+
+
+def _import_full_conv(m: SpatialFullConvolution, g: Dict[str, np.ndarray]):
+    w = _np(g["weight"])  # torch ConvTranspose2d: (in, out, kh, kw)
+    params = {"weight": jnp.asarray(w.transpose(2, 3, 0, 1))}  # (kh, kw, in, out)
     if m.with_bias and "bias" in g:
         params["bias"] = jnp.asarray(_np(g["bias"]))
     return params, {}
@@ -154,7 +180,16 @@ def _leaf_modules(module: Module) -> List[Module]:
     """Our modules that own parameters, in execution order."""
     out: List[Module] = []
 
+    from bigdl_tpu.keras.layers import KerasLayer  # local: avoid cycle
+
     def walk(m: Module):
+        if isinstance(m, KerasLayer):
+            if m.inner is None:
+                raise ValueError(
+                    f"{m.name}: build() the model before loading weights "
+                    f"(keras wrappers create their layers lazily)")
+            walk(m.inner)
+            return
         if isinstance(m, Recurrent):
             out.append(m.cell)
             return
@@ -162,8 +197,10 @@ def _leaf_modules(module: Module) -> List[Module]:
             for c in m.children.values():
                 walk(c)
             return
-        if isinstance(m, (Linear, SpatialConvolution, BatchNormalization,
-                          LookupTable, LSTMCell, GRUCell)):
+        if isinstance(m, (Linear, SpatialConvolution, SpatialFullConvolution,
+                          TemporalConvolution, VolumetricConvolution,
+                          BatchNormalization, LookupTable, LSTMCell,
+                          GRUCell)):
             out.append(m)
 
     walk(module)
@@ -174,6 +211,9 @@ _IMPORTERS = [
     (LSTMCell, _import_lstm_cell),
     (GRUCell, _import_gru_cell),
     (BatchNormalization, _import_bn),
+    (SpatialFullConvolution, _import_full_conv),
+    (TemporalConvolution, _import_temporal_conv),
+    (VolumetricConvolution, _import_volumetric_conv),
     (SpatialConvolution, _import_conv),
     (Linear, _import_linear),
     (LookupTable, _import_embedding),
@@ -205,7 +245,11 @@ def import_torch_state_dict(module: Module, params: Any, state: Any,
 
     converted = {id(m): _importer_for(m)(m, g) for m, g in zip(leaves, groups)}
 
+    from bigdl_tpu.keras.layers import KerasLayer  # local: avoid cycle
+
     def rebuild(m: Module, p: Any, s: Any) -> Tuple[Any, Any]:
+        if isinstance(m, KerasLayer):
+            return rebuild(m.inner, p, s)
         if isinstance(m, Recurrent):
             cp, cs = converted[id(m.cell)]
             # Recurrent nests the cell's params under "cell"
@@ -306,6 +350,21 @@ def import_keras_weights(module: Module, params: Any, state: Any,
         if isinstance(m, BatchNormalization):
             sd[f"{i}.weight"], sd[f"{i}.bias"] = ws[0], ws[1]
             sd[f"{i}.running_mean"], sd[f"{i}.running_var"] = ws[2], ws[3]
+        elif isinstance(m, SpatialFullConvolution):
+            # keras-1 tf deconv kernel: (kh, kw, out, in) -> torch (in, out, kh, kw)
+            sd[f"{i}.weight"] = np.asarray(ws[0]).transpose(3, 2, 0, 1)
+            if len(ws) > 1:
+                sd[f"{i}.bias"] = ws[1]
+        elif isinstance(m, TemporalConvolution):
+            # keras-1 Conv1D kernel: (k, in, out) -> torch (out, in, k)
+            sd[f"{i}.weight"] = np.asarray(ws[0]).transpose(2, 1, 0)
+            if len(ws) > 1:
+                sd[f"{i}.bias"] = ws[1]
+        elif isinstance(m, VolumetricConvolution):
+            # keras-1 tf Conv3D kernel: (k1, k2, k3, in, out) -> torch
+            sd[f"{i}.weight"] = np.asarray(ws[0]).transpose(4, 3, 0, 1, 2)
+            if len(ws) > 1:
+                sd[f"{i}.bias"] = ws[1]
         elif isinstance(m, SpatialConvolution):
             sd[f"{i}.weight"] = np.asarray(ws[0]).transpose(3, 2, 0, 1)  # ->OIHW
             if len(ws) > 1:
@@ -317,7 +376,10 @@ def import_keras_weights(module: Module, params: Any, state: Any,
         elif isinstance(m, LookupTable):
             sd[f"{i}.weight"] = ws[0]
         else:
-            raise ValueError(f"no keras importer for {type(m).__name__}")
+            raise ValueError(
+                f"no keras weight importer for {type(m).__name__} — this "
+                f"layer converts definition-only (weights must be set "
+                f"manually on the params tree)")
     return import_torch_state_dict(module, params, state, sd)
 
 

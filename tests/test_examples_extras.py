@@ -184,3 +184,125 @@ class TestNewExamples:
 
         with _pytest.raises(ValueError, match="nothing to train"):
             ex.main(["--batch-size", "4096", "--epochs", "1"])
+
+
+class TestConverterWidening:
+    """Keras-1.2.2 JSON definitions using the widened layer coverage
+    (reference: pyspark/bigdl/keras/converter.py)."""
+
+    def _roundtrip(self, layers, in_shape):
+        from bigdl_tpu.keras.converter import model_from_json_config
+
+        spec = {"class_name": "Sequential",
+                "config": [{"class_name": c, "config": cfg}
+                           for c, cfg in layers]}
+        model = model_from_json_config(spec)
+        x = jnp.asarray(np.random.RandomState(0).rand(*in_shape), jnp.float32)
+        params, state, _ = model.build(jax.random.PRNGKey(0), in_shape)
+        y, _ = model.apply(params, state, x)
+        return np.asarray(y)
+
+    def test_conv1d_pool_stack(self):
+        y = self._roundtrip([
+            ("Convolution1D", {"nb_filter": 6, "filter_length": 3,
+                               "activation": "relu",
+                               "batch_input_shape": [None, 12, 4]}),
+            ("MaxPooling1D", {"pool_length": 2}),
+            ("GlobalAveragePooling1D", {}),
+            ("Dense", {"output_dim": 3, "activation": "softmax"}),
+        ], (2, 12, 4))
+        assert y.shape == (2, 3)
+        np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-5)
+
+    def test_pad_crop_upsample(self):
+        y = self._roundtrip([
+            ("ZeroPadding2D", {"padding": [1, 1],
+                               "batch_input_shape": [None, 6, 6, 2]}),
+            ("Cropping2D", {"cropping": [[1, 0], [0, 1]]}),
+            ("UpSampling2D", {"size": [2, 2]}),
+        ], (1, 6, 6, 2))
+        assert y.shape == (1, 14, 14, 2)
+
+    def test_advanced_activations(self):
+        y = self._roundtrip([
+            ("Dense", {"output_dim": 4,
+                       "batch_input_shape": [None, 5]}),
+            ("LeakyReLU", {"alpha": 0.1}),
+            ("ELU", {"alpha": 0.9}),
+            ("ThresholdedReLU", {"theta": 0.0}),
+        ], (3, 5))
+        assert y.shape == (3, 4)
+
+    def test_bidirectional_json(self):
+        y = self._roundtrip([
+            ("Bidirectional", {
+                "layer": {"class_name": "LSTM",
+                          "config": {"output_dim": 6,
+                                     "return_sequences": False}},
+                "merge_mode": "concat",
+                "batch_input_shape": [None, 7, 3]}),
+        ], (2, 7, 3))
+        assert y.shape == (2, 12)
+
+    def test_maxout_highway_spatialdropout(self):
+        y = self._roundtrip([
+            ("MaxoutDense", {"output_dim": 5, "nb_feature": 3,
+                             "batch_input_shape": [None, 6]}),
+            ("Highway", {"activation": "tanh"}),
+        ], (2, 6))
+        assert y.shape == (2, 5)
+
+    def test_conv1d_weight_import(self):
+        from bigdl_tpu.keras.converter import (model_from_json_config,
+                                               load_keras_weights)
+
+        spec = {"class_name": "Sequential", "config": [
+            {"class_name": "Convolution1D",
+             "config": {"nb_filter": 4, "filter_length": 3,
+                        "batch_input_shape": [None, 8, 2]}},
+            {"class_name": "Flatten", "config": {}},
+            {"class_name": "Dense", "config": {"output_dim": 3}},
+        ]}
+        model = model_from_json_config(spec)
+        params, state, _ = model.build(jax.random.PRNGKey(0), (1, 8, 2))
+        rs = np.random.RandomState(0)
+        kconv = rs.randn(3, 2, 4).astype(np.float32)   # (k, in, out)
+        kb = rs.randn(4).astype(np.float32)
+        dw = rs.randn(24, 3).astype(np.float32)
+        db = rs.randn(3).astype(np.float32)
+        p2, s2 = load_keras_weights(model, params, state,
+                                    [[kconv, kb], [dw, db]])
+        x = jnp.asarray(rs.rand(1, 8, 2), jnp.float32)
+        y, _ = model.apply(p2, s2, x)
+        # manual conv1d VALID oracle
+        ref = np.zeros((1, 6, 4), np.float32)
+        xn = np.asarray(x)
+        for t_ in range(6):
+            ref[0, t_] = np.einsum("kc,kco->o", xn[0, t_:t_+3], kconv) + kb
+        expect = ref.reshape(1, -1) @ dw + db
+        np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-4, atol=1e-5)
+
+    def test_same_border_mode_raises_for_unsupported(self):
+        from bigdl_tpu.keras.converter import model_from_json_config
+
+        spec = {"class_name": "Sequential", "config": [
+            {"class_name": "MaxPooling1D",
+             "config": {"pool_length": 2, "border_mode": "same",
+                        "batch_input_shape": [None, 7, 3]}}]}
+        with pytest.raises(ValueError, match="border_mode"):
+            model_from_json_config(spec)
+
+    def test_leaky_relu_survives_serializer_roundtrip(self):
+        import bigdl_tpu.keras as keras
+        from bigdl_tpu.utils import serializer as ser
+
+        m = keras.Sequential(keras.Dense(4, input_dim=3),
+                             keras.LeakyReLU(0.1))
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 3), jnp.float32)
+        params, state, _ = m.build(jax.random.PRNGKey(0), (2, 3))
+        y1, _ = m.apply(params, state, x)
+        spec = ser.module_to_spec(m)
+        m2 = ser.module_from_spec(spec)
+        m2.build(jax.random.PRNGKey(0), (2, 3))
+        y2, _ = m2.apply(params, state, x)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)

@@ -283,6 +283,10 @@ EXEMPLARS = {
                           lambda: rand(2, 5)),
     "keras.ThresholdedReLU": (lambda: keras.ThresholdedReLU(0.5),
                               lambda: rand(2, 4)),
+    "keras.LeakyReLU": (lambda: keras.LeakyReLU(0.1), lambda: rand(2, 4)),
+    "keras.ELU": (lambda: keras.ELU(0.9), lambda: rand(2, 4)),
+    "keras.PReLU": (lambda: keras.PReLU(), lambda: rand(2, 4)),
+    "keras.SReLU": (lambda: keras.SReLU(), lambda: rand(2, 4)),
     "keras.LocallyConnected1D": (lambda: keras.LocallyConnected1D(4, 3),
                                  lambda: rand(2, 6, 3)),
     "keras.LocallyConnected2D": (lambda: keras.LocallyConnected2D(4, 3, 3),
