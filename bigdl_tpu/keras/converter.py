@@ -14,7 +14,7 @@ Embedding, recurrent (LSTM/GRU/SimpleRNN) + Bidirectional +
 TimeDistributed, advanced activations (LeakyReLU/ELU/PReLU/
 ThresholdedReLU), MaxoutDense, Highway, SpatialDropout1/2/3D.
 `get_weights()` import covers Dense, Convolution1/2/3D, Deconvolution2D,
-BatchNormalization, Embedding, LSTM/GRU/SimpleRNN; other classes convert
+BatchNormalization, Embedding; recurrent and the remaining classes convert
 definition-only and raise a clear error if weights are supplied for them.
 Unsupported border modes raise instead of silently converting.
 """
@@ -242,3 +242,51 @@ def load_keras_weights(model, params, state,
     from bigdl_tpu.utils.interop import import_keras_weights
 
     return import_keras_weights(model, params, state, layer_weights)
+
+
+def load_keras_hdf5_weights(model, params, state, h5_path: str):
+    """Load a Keras-1 `model.save_weights()` HDF5 file.
+
+    Layout (keras 1.2.2 topology.py save_weights): file attr `layer_names`
+    lists layer groups in model order; each group's attr `weight_names`
+    lists its datasets in get_weights() order.  Layers with no weights have
+    empty weight_names and are skipped — matching the positional discipline
+    of `load_keras_weights`.
+    """
+    import h5py
+
+    def _names(attr):
+        return [n.decode() if isinstance(n, bytes) else str(n) for n in attr]
+
+    layer_weights: List[List] = []
+    with h5py.File(h5_path, "r") as f:
+        for lname in _names(f.attrs["layer_names"]):
+            g = f[lname]
+            wnames = _names(g.attrs.get("weight_names", []))
+            if wnames:
+                layer_weights.append([g[w][()] for w in wnames])
+    return load_keras_weights(model, params, state, layer_weights)
+
+
+def load_keras_model(json_path: str, h5_path: str = None, *,
+                     input_shape=None, seed: int = 0):
+    """One-call reference flow: Keras-1 `model.to_json()` file (+ optional
+    `save_weights()` HDF5) -> (model, params, state).
+    reference: pyspark/bigdl/keras/converter.py load_keras entry."""
+    import jax
+
+    with open(json_path) as fh:
+        model = model_from_json_config(fh.read())
+    shape = input_shape
+    if shape is None:
+        first = model.children[next(iter(model.children))]
+        declared = getattr(first, "keras_input_shape", None)
+        if declared is None or any(d is None for d in declared):
+            raise ValueError(
+                "pass input_shape= (the model JSON declares no concrete "
+                "batch_input_shape — variable dims need an explicit shape)")
+        shape = (1,) + tuple(declared)
+    params, state, _ = model.build(jax.random.PRNGKey(seed), tuple(shape))
+    if h5_path is not None:
+        params, state = load_keras_hdf5_weights(model, params, state, h5_path)
+    return model, params, state

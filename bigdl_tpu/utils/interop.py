@@ -351,8 +351,11 @@ def import_keras_weights(module: Module, params: Any, state: Any,
             sd[f"{i}.weight"], sd[f"{i}.bias"] = ws[0], ws[1]
             sd[f"{i}.running_mean"], sd[f"{i}.running_var"] = ws[2], ws[3]
         elif isinstance(m, SpatialFullConvolution):
-            # keras-1 tf deconv kernel: (kh, kw, out, in) -> torch (in, out, kh, kw)
-            sd[f"{i}.weight"] = np.asarray(ws[0]).transpose(3, 2, 0, 1)
+            # keras-1 Deconvolution2D stores the kernel exactly like
+            # Convolution2D — (kh, kw, in, out); the conv_transpose axis
+            # swap happens at call time in the keras backend, not in the
+            # stored weight.  -> torch ConvTranspose2d (in, out, kh, kw)
+            sd[f"{i}.weight"] = np.asarray(ws[0]).transpose(2, 3, 0, 1)
             if len(ws) > 1:
                 sd[f"{i}.bias"] = ws[1]
         elif isinstance(m, TemporalConvolution):
@@ -370,7 +373,13 @@ def import_keras_weights(module: Module, params: Any, state: Any,
             if len(ws) > 1:
                 sd[f"{i}.bias"] = ws[1]
         elif isinstance(m, Linear):
-            sd[f"{i}.weight"] = np.asarray(ws[0]).T  # (in,out) -> torch (out,in)
+            w0 = np.asarray(ws[0])
+            if w0.ndim != 2:
+                raise ValueError(
+                    f"layer {i}: expected a 2-D Dense kernel, got shape "
+                    f"{w0.shape} — this layer likely lowered from a "
+                    f"definition-only keras class (e.g. MaxoutDense)")
+            sd[f"{i}.weight"] = w0.T  # (in,out) -> torch (out,in)
             if len(ws) > 1:
                 sd[f"{i}.bias"] = ws[1]
         elif isinstance(m, LookupTable):

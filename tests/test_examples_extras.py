@@ -306,3 +306,93 @@ class TestConverterWidening:
         m2.build(jax.random.PRNGKey(0), (2, 3))
         y2, _ = m2.apply(params, state, x)
         np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)
+
+    def test_hdf5_weight_loading(self, tmp_path):
+        """Full reference flow: Keras-1 JSON + save_weights() HDF5."""
+        import json as _json
+
+        import h5py
+
+        from bigdl_tpu.keras.converter import load_keras_model
+
+        spec = {"class_name": "Sequential", "config": [
+            {"class_name": "Dense",
+             "config": {"output_dim": 4, "activation": "relu",
+                        "batch_input_shape": [None, 5], "name": "d1"}},
+            {"class_name": "Dropout", "config": {"p": 0.5, "name": "drop"}},
+            {"class_name": "Dense",
+             "config": {"output_dim": 2, "name": "d2"}}]}
+        jpath = tmp_path / "model.json"
+        jpath.write_text(_json.dumps(spec))
+
+        rs = np.random.RandomState(0)
+        w1, b1 = rs.randn(5, 4).astype("f"), rs.randn(4).astype("f")
+        w2, b2 = rs.randn(4, 2).astype("f"), rs.randn(2).astype("f")
+        hpath = tmp_path / "weights.h5"
+        with h5py.File(hpath, "w") as f:
+            f.attrs["layer_names"] = [b"d1", b"drop", b"d2"]
+            g1 = f.create_group("d1")
+            g1.attrs["weight_names"] = [b"d1_W", b"d1_b"]
+            g1.create_dataset("d1_W", data=w1)
+            g1.create_dataset("d1_b", data=b1)
+            f.create_group("drop").attrs["weight_names"] = []
+            g2 = f.create_group("d2")
+            g2.attrs["weight_names"] = [b"d2_W", b"d2_b"]
+            g2.create_dataset("d2_W", data=w2)
+            g2.create_dataset("d2_b", data=b2)
+
+        model, params, state = load_keras_model(str(jpath), str(hpath))
+        x = rs.rand(3, 5).astype("f")
+        y, _ = model.apply(params, state, jnp.asarray(x))
+        expect = np.maximum(x @ w1 + b1, 0) @ w2 + b2
+        np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5, atol=1e-6)
+
+    def test_deconv_weight_import_layout(self):
+        from bigdl_tpu.keras.converter import (model_from_json_config,
+                                               load_keras_weights)
+
+        spec = {"class_name": "Sequential", "config": [
+            {"class_name": "Deconvolution2D",
+             "config": {"nb_filter": 5, "nb_row": 3, "nb_col": 3,
+                        "batch_input_shape": [None, 4, 4, 3]}}]}
+        model = model_from_json_config(spec)
+        params, state, _ = model.build(jax.random.PRNGKey(0), (1, 4, 4, 3))
+        rs = np.random.RandomState(0)
+        k = rs.randn(3, 3, 3, 5).astype("f")  # keras layout (kh, kw, in, out)
+        b = rs.randn(5).astype("f")
+        p2, s2 = load_keras_weights(model, params, state, [[k, b]])
+        y, _ = model.apply(p2, s2, jnp.ones((1, 4, 4, 3)))
+        assert y.shape == (1, 6, 6, 5)
+
+    def test_maxout_weights_raise_clearly(self):
+        from bigdl_tpu.keras.converter import (model_from_json_config,
+                                               load_keras_weights)
+
+        spec = {"class_name": "Sequential", "config": [
+            {"class_name": "MaxoutDense",
+             "config": {"output_dim": 3, "nb_feature": 2,
+                        "batch_input_shape": [None, 6]}}]}
+        model = model_from_json_config(spec)
+        params, state, _ = model.build(jax.random.PRNGKey(0), (1, 6))
+        rs = np.random.RandomState(0)
+        with pytest.raises(ValueError, match="definition-only"):
+            load_keras_weights(model, params, state,
+                               [[rs.randn(6, 2, 3).astype("f"),
+                                 rs.randn(2, 3).astype("f")]])
+
+    def test_variable_dims_need_explicit_shape(self, tmp_path):
+        import json as _json
+
+        from bigdl_tpu.keras.converter import load_keras_model
+
+        spec = {"class_name": "Sequential", "config": [
+            {"class_name": "LSTM",
+             "config": {"output_dim": 4,
+                        "batch_input_shape": [None, None, 7]}}]}
+        jpath = tmp_path / "m.json"
+        jpath.write_text(_json.dumps(spec))
+        with pytest.raises(ValueError, match="input_shape"):
+            load_keras_model(str(jpath))
+        model, p, s = load_keras_model(str(jpath), input_shape=(1, 5, 7))
+        y, _ = model.apply(p, s, jnp.ones((1, 5, 7)))
+        assert y.shape == (1, 4)
