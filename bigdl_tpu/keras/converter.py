@@ -14,9 +14,12 @@ Embedding, recurrent (LSTM/GRU/SimpleRNN) + Bidirectional +
 TimeDistributed, advanced activations (LeakyReLU/ELU/PReLU/
 ThresholdedReLU), MaxoutDense, Highway, SpatialDropout1/2/3D.
 `get_weights()` import covers Dense, Convolution1/2/3D, Deconvolution2D,
-BatchNormalization, Embedding; recurrent and the remaining classes convert
-definition-only and raise a clear error if weights are supplied for them.
-Unsupported border modes raise instead of silently converting.
+BatchNormalization, Embedding, LSTM (exact; keras-1 i,c,f,o gate order
+repacked) and SimpleRNN; GRU raises — keras-1 applies the reset gate
+before the hidden matmul, a different recurrence from the fused cell.
+Remaining classes convert definition-only and raise a clear error if
+weights are supplied for them.  Unsupported border modes raise instead of
+silently converting.
 """
 
 from __future__ import annotations

@@ -40,7 +40,8 @@ from bigdl_tpu.nn.embedding import LookupTable
 from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.nn.module import Container, Module
 from bigdl_tpu.nn.norm import BatchNormalization
-from bigdl_tpu.nn.recurrent import GRUCell, LSTMCell, Recurrent
+from bigdl_tpu.nn.recurrent import (GRUCell, LSTMCell, Recurrent, RnnCell,
+                                    TimeDistributed)
 
 
 def _np(x) -> np.ndarray:
@@ -150,6 +151,15 @@ def _import_gru_cell(m: GRUCell, g: Dict[str, np.ndarray]):
             "bias": jnp.asarray(bias)}, {}
 
 
+def _import_rnn_cell(m: RnnCell, g: Dict[str, np.ndarray]):
+    # torch RNN layout: weight_ih_l0 (h, in), weight_hh_l0 (h, h)
+    _check_single_layer_rnn("RNN", g)
+    b_ih, b_hh = _rnn_bias(g, m.hidden_size)
+    return {"w_ih": jnp.asarray(_np(g["weight_ih_l0"]).T),
+            "w_hh": jnp.asarray(_np(g["weight_hh_l0"]).T),
+            "bias": jnp.asarray(b_ih + b_hh)}, {}
+
+
 def _import_embedding(m: LookupTable, g: Dict[str, np.ndarray]):
     return {"weight": jnp.asarray(_np(g["weight"]))}, {}
 
@@ -193,6 +203,9 @@ def _leaf_modules(module: Module) -> List[Module]:
         if isinstance(m, Recurrent):
             out.append(m.cell)
             return
+        if isinstance(m, TimeDistributed):
+            walk(m.inner)
+            return
         if isinstance(m, Container):
             for c in m.children.values():
                 walk(c)
@@ -200,7 +213,7 @@ def _leaf_modules(module: Module) -> List[Module]:
         if isinstance(m, (Linear, SpatialConvolution, SpatialFullConvolution,
                           TemporalConvolution, VolumetricConvolution,
                           BatchNormalization, LookupTable, LSTMCell,
-                          GRUCell)):
+                          GRUCell, RnnCell)):
             out.append(m)
 
     walk(module)
@@ -210,6 +223,7 @@ def _leaf_modules(module: Module) -> List[Module]:
 _IMPORTERS = [
     (LSTMCell, _import_lstm_cell),
     (GRUCell, _import_gru_cell),
+    (RnnCell, _import_rnn_cell),
     (BatchNormalization, _import_bn),
     (SpatialFullConvolution, _import_full_conv),
     (TemporalConvolution, _import_temporal_conv),
@@ -250,6 +264,9 @@ def import_torch_state_dict(module: Module, params: Any, state: Any,
     def rebuild(m: Module, p: Any, s: Any) -> Tuple[Any, Any]:
         if isinstance(m, KerasLayer):
             return rebuild(m.inner, p, s)
+        if isinstance(m, TimeDistributed):
+            ip, is_ = rebuild(m.inner, p.get("inner", {}), s.get("inner", {}))
+            return {**p, "inner": ip}, {**s, "inner": is_}
         if isinstance(m, Recurrent):
             cp, cs = converted[id(m.cell)]
             # Recurrent nests the cell's params under "cell"
@@ -384,6 +401,41 @@ def import_keras_weights(module: Module, params: Any, state: Any,
                 sd[f"{i}.bias"] = ws[1]
         elif isinstance(m, LookupTable):
             sd[f"{i}.weight"] = ws[0]
+        elif isinstance(m, LSTMCell):
+            # keras-1 LSTM trainable_weights order: (W,U,b) per gate in
+            # i, c, f, o order (keras/layers/recurrent.py build()); our
+            # packing is i, f, g(c), o like torch — reorder and pack.
+            # Same cell math (standard LSTM), so the import is exact.
+            if len(ws) != 12:
+                raise ValueError(
+                    f"layer {i}: expected 12 keras-1 LSTM weights (W,U,b x "
+                    f"4 gates, consume_less='cpu'/'mem'), got {len(ws)}")
+            gate = {"i": 0, "c": 3, "f": 6, "o": 9}
+            order = ["i", "f", "c", "o"]  # torch/our packed order
+            sd[f"{i}.weight_ih_l0"] = np.concatenate(
+                [np.asarray(ws[gate[g]]).T for g in order], axis=0)
+            sd[f"{i}.weight_hh_l0"] = np.concatenate(
+                [np.asarray(ws[gate[g] + 1]).T for g in order], axis=0)
+            sd[f"{i}.bias_ih_l0"] = np.concatenate(
+                [np.asarray(ws[gate[g] + 2]) for g in order])
+            sd[f"{i}.bias_hh_l0"] = np.zeros(
+                sd[f"{i}.bias_ih_l0"].shape, np.float32)
+        elif isinstance(m, GRUCell):
+            raise ValueError(
+                f"layer {i}: keras-1 GRU applies the reset gate BEFORE the "
+                f"hidden matmul (tanh(x W + (r*h) U)); this fused cell "
+                f"applies it after (torch convention) — the math differs, "
+                f"so weights cannot be imported exactly")
+        elif isinstance(m, RnnCell):
+            # keras-1 SimpleRNN: [W (in,h), U (h,h), b] — same math as
+            # RnnCell (tanh(x W + h U + b)); emit torch RNN-layout keys
+            if len(ws) != 3:
+                raise ValueError(
+                    f"layer {i}: expected 3 SimpleRNN weights, got {len(ws)}")
+            sd[f"{i}.weight_ih_l0"] = np.asarray(ws[0]).T  # (h, in)
+            sd[f"{i}.weight_hh_l0"] = np.asarray(ws[1]).T
+            sd[f"{i}.bias_ih_l0"] = np.asarray(ws[2])
+            sd[f"{i}.bias_hh_l0"] = np.zeros_like(np.asarray(ws[2]))
         else:
             raise ValueError(
                 f"no keras weight importer for {type(m).__name__} — this "
