@@ -82,6 +82,9 @@ def _convert_layer(class_name: str, cfg: Dict[str, Any]):
         cls = getattr(KL, class_name)
         return cls(cfg["output_dim"],
                    return_sequences=cfg.get("return_sequences", False),
+                   activation=cfg.get("activation", "tanh"),
+                   inner_activation=cfg.get("inner_activation",
+                                            "hard_sigmoid"),
                    input_shape=shape, name=name)
     if class_name == "TimeDistributed":
         inner_def = cfg["layer"]

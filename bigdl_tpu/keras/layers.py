@@ -258,11 +258,15 @@ class Embedding(KerasLayer):
 
 class _Rnn(KerasLayer):
     def __init__(self, output_dim: int, return_sequences: bool = False,
+                 activation: str = "tanh",
+                 inner_activation: str = "hard_sigmoid",
                  input_shape: Optional[Sequence[int]] = None,
                  name: Optional[str] = None):
         super().__init__(input_shape, name)
         self.output_dim = output_dim
         self.return_sequences = return_sequences
+        self.activation = activation
+        self.inner_activation = inner_activation
 
     def _cell(self, input_size: int):
         raise NotImplementedError
@@ -277,7 +281,9 @@ class _Rnn(KerasLayer):
 
 class LSTM(_Rnn):
     def _cell(self, input_size):
-        return nn.LSTMCell(input_size, self.output_dim)
+        return nn.LSTMCell(input_size, self.output_dim,
+                           gate_activation=self.inner_activation,
+                           activation=self.activation)
 
 
 class GRU(_Rnn):
@@ -287,7 +293,8 @@ class GRU(_Rnn):
 
 class SimpleRNN(_Rnn):
     def _cell(self, input_size):
-        return nn.RnnCell(input_size, self.output_dim)
+        return nn.RnnCell(input_size, self.output_dim,
+                          activation=self.activation)
 
 
 class TimeDistributed(KerasLayer):

@@ -58,8 +58,8 @@ class Cell(Module):
 class RnnCell(Cell):
     """Elman RNN cell: h' = act(W x + U h + b). reference: nn/RnnCell.scala."""
 
-    def __init__(self, input_size: int, hidden_size: int, activation=jnp.tanh,
-                 name: Optional[str] = None):
+    def __init__(self, input_size: int, hidden_size: int,
+                 activation="tanh", name: Optional[str] = None):
         super().__init__(name)
         self.input_size = input_size
         self.hidden_size = hidden_size
@@ -79,20 +79,38 @@ class RnnCell(Cell):
         return params, {}, (n, self.hidden_size)
 
     def step(self, params, x_t, hidden):
-        h = self.activation(x_t @ params["w_ih"] + hidden @ params["w_hh"] + params["bias"])
+        act = _resolve_activation(self.activation)
+        h = act(x_t @ params["w_ih"] + hidden @ params["w_hh"] + params["bias"])
         return h, h
+
+
+def _resolve_activation(name):
+    """String activation names for cells (serializer-friendly).
+    'hard_sigmoid' is the keras-1 variant: clip(0.2x + 0.5, 0, 1)."""
+    if callable(name):
+        return name
+    return {"sigmoid": jax.nn.sigmoid,
+            "hard_sigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
+            "tanh": jnp.tanh,
+            "relu": jax.nn.relu}[name]
 
 
 class LSTMCell(Cell):
     """LSTM cell, gates packed in one matmul (order: i, f, g, o).
-    reference: nn/LSTM.scala.  Hidden is Table(h, c)."""
+    reference: nn/LSTM.scala.  Hidden is Table(h, c).
+    `gate_activation`/`activation` accept string names so imported keras-1
+    models (default inner_activation='hard_sigmoid') compute exactly."""
 
     def __init__(self, input_size: int, hidden_size: int,
-                 forget_bias: float = 0.0, name: Optional[str] = None):
+                 forget_bias: float = 0.0,
+                 gate_activation: str = "sigmoid",
+                 activation: str = "tanh", name: Optional[str] = None):
         super().__init__(name)
         self.input_size = input_size
         self.hidden_size = hidden_size
         self.forget_bias = forget_bias
+        self.gate_activation = gate_activation
+        self.activation = activation
 
     def build(self, rng, input_shape):
         k1, k2 = jax.random.split(rng)
@@ -112,14 +130,16 @@ class LSTMCell(Cell):
 
     def step(self, params, x_t, hidden):
         h_prev, c_prev = hidden[1], hidden[2]
+        sig = _resolve_activation(self.gate_activation)
+        act = _resolve_activation(self.activation)
         gates = x_t @ params["w_ih"] + h_prev @ params["w_hh"] + params["bias"]
         i, f, g, o = jnp.split(gates, 4, axis=-1)
-        i = jax.nn.sigmoid(i)
-        f = jax.nn.sigmoid(f + self.forget_bias)
-        g = jnp.tanh(g)
-        o = jax.nn.sigmoid(o)
+        i = sig(i)
+        f = sig(f + self.forget_bias)
+        g = act(g)
+        o = sig(o)
         c = f * c_prev + i * g
-        h = o * jnp.tanh(c)
+        h = o * act(c)
         return h, Table(h, c)
 
 

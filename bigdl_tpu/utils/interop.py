@@ -302,16 +302,25 @@ def export_torch_state_dict(module: Module, params: Any, state: Any
     (after tensor conversion)."""
     out: "OrderedDict[str, np.ndarray]" = OrderedDict()
 
+    from bigdl_tpu.keras.layers import KerasLayer  # local: avoid cycle
+
     def emit(m: Module, p: Any, s: Any, prefix: str):
+        if isinstance(m, KerasLayer):
+            emit(m.inner, p, s, prefix)
+            return
         if isinstance(m, Recurrent):
             emit(m.cell, p.get("cell", {}), {}, prefix)
+            return
+        if isinstance(m, TimeDistributed):
+            emit(m.inner, p.get("inner", {}),
+                 s.get("inner", {}) if isinstance(s, dict) else {}, prefix)
             return
         if isinstance(m, Container):
             for key, c in m.children.items():
                 emit(c, p.get(key, {}), s.get(key, {}) if isinstance(s, dict) else {},
                      f"{prefix}{key}.")
             return
-        if isinstance(m, (LSTMCell, GRUCell)):
+        if isinstance(m, (LSTMCell, GRUCell, RnnCell)):
             out[f"{prefix}weight_ih_l0"] = np.asarray(p["w_ih"]).T
             out[f"{prefix}weight_hh_l0"] = np.asarray(p["w_hh"]).T
             out[f"{prefix}bias_ih_l0"] = np.asarray(p["bias"])
@@ -451,7 +460,8 @@ def import_keras_weights(module: Module, params: Any, state: Any,
 
 def convert_model(args: Optional[Sequence[str]] = None) -> None:
     """Convert between the native model dir format, torch .pt state dicts,
-    Caffe prototxt/caffemodel, and TF frozen GraphDefs.
+    Caffe prototxt/caffemodel, TF frozen GraphDefs, and keras-1
+    JSON(+HDF5) models.
     reference: utils/ConvertModel.scala (bigdl <-> caffe/torch/tf)."""
     import jax
 
@@ -459,8 +469,8 @@ def convert_model(args: Optional[Sequence[str]] = None) -> None:
 
     p = argparse.ArgumentParser("ConvertModel")
     p.add_argument("--from", dest="src", required=True,
-                   help="native model dir, or <def.prototxt>:<w.caffemodel>, "
-                        "or frozen .pb")
+                   help="native model dir, <def.prototxt>:<w.caffemodel>, "
+                        "frozen .pb, or keras-1 <model.json>[:<weights.h5>]")
     p.add_argument("--to", dest="dst", required=True,
                    help="native model dir, .pt, .prototxt (writes sibling "
                         ".caffemodel), or .pb")
@@ -487,6 +497,13 @@ def convert_model(args: Optional[Sequence[str]] = None) -> None:
 
         module, params, state = load_tensorflow(
             ns.src, ns.tf_inputs.split(","), ns.tf_outputs.split(","), [shape])
+    elif ".json" in ns.src:
+        from bigdl_tpu.keras.converter import load_keras_model
+
+        parts = ns.src.split(":")
+        module, params, state = load_keras_model(
+            parts[0], parts[1] if len(parts) > 1 else None,
+            input_shape=shape)
     else:
         module, params, state = ser.load_model(ns.src)
         if params is None:

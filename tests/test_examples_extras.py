@@ -407,6 +407,7 @@ class TestConverterWidening:
         spec = {"class_name": "Sequential", "config": [
             {"class_name": "LSTM",
              "config": {"output_dim": H, "return_sequences": False,
+                        "inner_activation": "sigmoid",
                         "batch_input_shape": [None, 5, I]}}]}
         model = model_from_json_config(spec)
         params, state, _ = model.build(jax.random.PRNGKey(0), (2, 5, I))
@@ -500,3 +501,84 @@ class TestConverterWidening:
             hs.append(h)
         expect = np.stack(hs, 1) @ dw + db
         np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-4, atol=1e-5)
+
+    def test_convert_model_cli_keras_to_native(self, tmp_path):
+        import json as _json
+
+        from bigdl_tpu.utils import serializer as ser
+        from bigdl_tpu.utils.interop import convert_model
+
+        spec = {"class_name": "Sequential", "config": [
+            {"class_name": "Dense",
+             "config": {"output_dim": 4, "activation": "relu",
+                        "batch_input_shape": [None, 5]}},
+            {"class_name": "Dense", "config": {"output_dim": 2}}]}
+        jpath = tmp_path / "m.json"
+        jpath.write_text(_json.dumps(spec))
+        out = tmp_path / "native_model"
+        convert_model(["--from", str(jpath), "--to", str(out),
+                       "--input-shape", "1,5"])
+        model, params, state = ser.load_model(str(out))
+        model.build(jax.random.PRNGKey(0), (1, 5))
+        y, _ = model.apply(params, state, jnp.ones((1, 5)))
+        assert y.shape == (1, 2)
+
+    def test_keras_lstm_hard_sigmoid_exact(self):
+        """keras-1 default inner_activation='hard_sigmoid' computes exactly
+        (gate activation honored, not silently replaced by sigmoid)."""
+        from bigdl_tpu.keras.converter import (model_from_json_config,
+                                               load_keras_weights)
+
+        H, I = 3, 2
+        spec = {"class_name": "Sequential", "config": [
+            {"class_name": "LSTM",
+             "config": {"output_dim": H, "activation": "tanh",
+                        "inner_activation": "hard_sigmoid",
+                        "batch_input_shape": [None, 4, I]}}]}
+        model = model_from_json_config(spec)
+        params, state, _ = model.build(jax.random.PRNGKey(0), (1, 4, I))
+        rs = np.random.RandomState(3)
+        ws = []
+        gates = "icfo"
+        W = {g: rs.randn(I, H).astype("f") for g in gates}
+        U = {g: rs.randn(H, H).astype("f") for g in gates}
+        b = {g: rs.randn(H).astype("f") for g in gates}
+        for g in gates:
+            ws += [W[g], U[g], b[g]]
+        p2, s2 = load_keras_weights(model, params, state, [ws])
+        x = (rs.randn(1, 4, I) * 3).astype("f")  # reach hard-sigmoid clips
+        y, _ = model.apply(p2, s2, jnp.asarray(x))
+
+        def hsig(v):
+            return np.clip(0.2 * v + 0.5, 0.0, 1.0)
+
+        h = np.zeros((1, H), "f")
+        c = np.zeros((1, H), "f")
+        for t_ in range(4):
+            xt = x[:, t_]
+            i_ = hsig(xt @ W["i"] + h @ U["i"] + b["i"])
+            f_ = hsig(xt @ W["f"] + h @ U["f"] + b["f"])
+            g_ = np.tanh(xt @ W["c"] + h @ U["c"] + b["c"])
+            o_ = hsig(xt @ W["o"] + h @ U["o"] + b["o"])
+            c = f_ * c + i_ * g_
+            h = o_ * np.tanh(c)
+        np.testing.assert_allclose(np.asarray(y), h, rtol=1e-4, atol=1e-5)
+
+    def test_rnn_model_exports_to_torch(self, tmp_path):
+        """CLI asymmetry fix: keras SimpleRNN+TimeDistributed model exports
+        a torch state dict."""
+        from bigdl_tpu.keras.converter import model_from_json_config
+        from bigdl_tpu.utils.interop import export_torch_state_dict
+
+        spec = {"class_name": "Sequential", "config": [
+            {"class_name": "SimpleRNN",
+             "config": {"output_dim": 3, "return_sequences": True,
+                        "batch_input_shape": [None, 4, 2]}},
+            {"class_name": "TimeDistributed",
+             "config": {"layer": {"class_name": "Dense",
+                                  "config": {"output_dim": 2}}}}]}
+        model = model_from_json_config(spec)
+        params, state, _ = model.build(jax.random.PRNGKey(0), (1, 4, 2))
+        sd = export_torch_state_dict(model, params, state)
+        assert any(k.endswith("weight_ih_l0") for k in sd)
+        assert any(k.endswith("weight") for k in sd)
