@@ -10,6 +10,12 @@ lowers to one XLA program — the role BigDL's mkldnn fused `DnnGraph` plays
 """
 
 from bigdl_tpu.nn.module import Module, Container, Sequential, Node, Input
+
+# name-parity aliases: the reference's DynamicContainer (nn/DynamicContainer
+# .scala, the add()-able container base) is our Container; TreeLSTM
+# (nn/TreeLSTM.scala, the tree-recursive base) has BinaryTreeLSTM as its one
+# concrete implementation here as there
+DynamicContainer = Container
 from bigdl_tpu.nn.graph import Graph, StaticGraph, DynamicGraph
 from bigdl_tpu.nn import init
 from bigdl_tpu.nn.linear import Linear, SparseLinear
@@ -257,3 +263,4 @@ from bigdl_tpu.nn.detection import (
     nms,
 )
 from bigdl_tpu.nn.treelstm import BinaryTreeLSTM
+TreeLSTM = BinaryTreeLSTM
