@@ -34,5 +34,10 @@ from bigdl_tpu.optim.predictor import (
     Predictor,
     LocalPredictor,
     Evaluator,
+    Validator,
     PredictionService,
 )
+
+# deprecated-name parity (reference optim/Validator.scala family)
+LocalValidator = Validator
+DistriValidator = Validator

@@ -252,3 +252,20 @@ class PredictionService:
         out = io.BytesIO()
         np.savez(out, output=y)
         return out.getvalue()
+
+
+class Validator(Evaluator):
+    """Deprecated-name parity (reference: optim/Validator.scala, superseded
+    by Evaluator there).  The legacy form Validator(model, dataset) is
+    rejected with a pointer to the current API instead of silently binding
+    the dataset to the mesh argument."""
+
+    def __init__(self, model, mesh=None):
+        from bigdl_tpu.dataset.dataset import DataSet
+
+        if isinstance(mesh, DataSet):
+            raise TypeError(
+                "Validator(model, dataset) is the deprecated reference API; "
+                "construct Validator(model) and call "
+                ".test(dataset, params, state, methods) (Evaluator API)")
+        super().__init__(model, mesh=mesh)
