@@ -267,5 +267,5 @@ class Validator(Evaluator):
             raise TypeError(
                 "Validator(model, dataset) is the deprecated reference API; "
                 "construct Validator(model) and call "
-                ".test(dataset, params, state, methods) (Evaluator API)")
+                ".test(params, state, dataset, methods) (Evaluator API)")
         super().__init__(model, mesh=mesh)
