@@ -28,15 +28,21 @@ def freeze(fn, spec):
     return convert_variables_to_constants_v2(cf).graph.as_graph_def()
 
 
-def import_and_compare(fn, x, out_op, tmp_path, rtol=2e-4, atol=1e-5):
-    gd = freeze(fn, x.shape)
+def import_graph(fn, spec, out_op, tmp_path):
+    """Freeze `fn`, write the GraphDef, and import it ending at the last
+    node of op type `out_op`; returns (graph, params, state)."""
+    gd = freeze(fn, spec)
     pb = str(tmp_path / "g.pb")
     with open(pb, "wb") as fh:
         fh.write(gd.SerializeToString())
     inp = [n.name for n in gd.node if n.op == "Placeholder"][0]
     outs = [n.name for n in gd.node if n.op == out_op]
     assert outs, f"no {out_op} node in {sorted({n.op for n in gd.node})}"
-    g, gp, gs = load_tensorflow(pb, [inp], [outs[-1]], [tuple(x.shape)])
+    return load_tensorflow(pb, [inp], [outs[-1]], [tuple(spec)])
+
+
+def import_and_compare(fn, x, out_op, tmp_path, rtol=2e-4, atol=1e-5):
+    g, gp, gs = import_graph(fn, x.shape, out_op, tmp_path)
     y_ours = np.asarray(g.apply(gp, gs, jnp.asarray(x))[0])
     y_tf = fn(x).numpy()
     np.testing.assert_allclose(y_ours, y_tf, rtol=rtol, atol=atol)
@@ -259,3 +265,50 @@ class TestExportToRealTF:
             nn.SpatialConvolution(2, 3, 2, 2), nn.ELU(),
             nn.SpatialAveragePooling(2, 2), nn.Flatten(),
             nn.Linear(3 * 3 * 3, 2)), (1, 8, 8, 2), tmp_path)
+
+
+class TestGradientDifferential:
+    def test_imported_graph_gradients_match_tf(self, tmp_path):
+        """jax.grad through an imported frozen graph equals TF GradientTape
+        gradients w.r.t. the (frozen-constant) weights — the correctness
+        basis of Session.train on imported graphs."""
+        rs = np.random.RandomState(0)
+        w1_np = (rs.randn(6, 8) * 0.5).astype(np.float32)
+        w2_np = (rs.randn(8, 3) * 0.5).astype(np.float32)
+        x_np = rs.randn(4, 6).astype(np.float32)
+        y_idx = np.asarray([0, 2, 1, 0])
+
+        w1 = tf.constant(w1_np)
+        w2 = tf.constant(w2_np)
+
+        @tf.function
+        def f(x):
+            return tf.linalg.matmul(tf.nn.relu(tf.linalg.matmul(x, w1)), w2)
+
+        g, gp, gs = import_graph(f, (4, 6), "MatMul", tmp_path)
+
+        import bigdl_tpu.nn as nn
+
+        crit = nn.CrossEntropyCriterion()
+
+        def loss_ours(p):
+            logits, _ = g.apply(p, gs, jnp.asarray(x_np))
+            return crit.forward(logits, jnp.asarray(y_idx))
+
+        grads = jax.tree_util.tree_leaves(jax.grad(loss_ours)(gp))
+        # match by shape: one (6,8) grad and one (8,3) grad
+        g1 = next(np.asarray(v) for v in grads if np.shape(v) == (6, 8))
+        g2 = next(np.asarray(v) for v in grads if np.shape(v) == (8, 3))
+
+        # TF oracle with variables at the same values
+        v1 = tf.Variable(w1_np)
+        v2 = tf.Variable(w2_np)
+        with tf.GradientTape() as tape:
+            logits = tf.linalg.matmul(
+                tf.nn.relu(tf.linalg.matmul(tf.constant(x_np), v1)), v2)
+            loss = tf.reduce_mean(
+                tf.nn.sparse_softmax_cross_entropy_with_logits(
+                    labels=tf.constant(y_idx, tf.int64), logits=logits))
+        tg1, tg2 = tape.gradient(loss, [v1, v2])
+        np.testing.assert_allclose(g1, tg1.numpy(), rtol=2e-4, atol=1e-6)
+        np.testing.assert_allclose(g2, tg2.numpy(), rtol=2e-4, atol=1e-6)
