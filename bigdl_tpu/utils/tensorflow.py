@@ -88,8 +88,10 @@ def _clean(name: str) -> str:
 
 class _TFImporter:
     def __init__(self, graph_def, input_names: Sequence[str],
-                 input_shapes: Sequence[Sequence[int]]):
-        self.nodes_by_name = {n.name: n for n in graph_def.node}
+                 input_shapes: Sequence[Sequence[int]],
+                 node_index: Optional[Dict[str, Any]] = None):
+        self.nodes_by_name = (node_index if node_index is not None
+                              else {n.name: n for n in graph_def.node})
         self.consts: Dict[str, np.ndarray] = {}
         self.graph_nodes: Dict[str, Any] = {}
         self.shapes: Dict[str, Any] = {}
@@ -545,14 +547,31 @@ class _TFImporter:
 
 def load_tensorflow(pb_path: str, inputs: Sequence[str],
                     outputs: Sequence[str],
-                    input_shapes: Sequence[Sequence[int]],
+                    input_shapes: Optional[Sequence[Sequence[int]]] = None,
                     seed: int = 0) -> Tuple[nn.Graph, Any, Any]:
     """Parse a frozen GraphDef into (Graph, params, state).
-    reference: TensorflowLoader.load (utils/tf/TensorflowLoader.scala:55)."""
+    reference: TensorflowLoader.load (utils/tf/TensorflowLoader.scala:55).
+
+    `input_shapes` may be omitted when every input Placeholder declares a
+    fully-static shape attr (TF marks unknown dims as -1/0)."""
     gd = tfp.GraphDef()
     with open(pb_path, "rb") as f:
         gd.ParseFromString(f.read())
-    imp = _TFImporter(gd, inputs, input_shapes)
+    node_index = {n.name: n for n in gd.node}
+    if input_shapes is None:
+        input_shapes = []
+        for name in inputs:
+            nd = node_index.get(name)
+            if nd is None:
+                raise ValueError(f"input node {name!r} does not exist in the "
+                                 f"GraphDef")
+            dims = [d.size for d in nd.attr["shape"].shape.dim]
+            if not dims or any(d <= 0 for d in dims):
+                raise ValueError(
+                    f"input {name!r} has no fully-static declared shape "
+                    f"({dims or 'missing'}); pass input_shapes= explicitly")
+            input_shapes.append(tuple(dims))
+    imp = _TFImporter(gd, inputs, input_shapes, node_index)
     # GraphDef does not guarantee topological order: iterate to fixpoint,
     # deferring nodes whose data inputs aren't converted yet
     pending = list(gd.node)

@@ -387,3 +387,44 @@ class TestDeconvImport:
               strides=[1, 2, 2, 1], padding=b"EXPLICIT")
         with pytest.raises(ValueError, match="EXPLICIT"):
             _load(gd, tmp_path, ["dc"], (1, 4, 4, 2))
+
+
+class TestAutoShapes:
+    def test_shapes_from_placeholder_attr(self, tmp_path):
+        gd = tfp.GraphDef()
+        ph = gd.node.add()
+        ph.name = "input"
+        ph.op = "Placeholder"
+        for s in (2, 5):
+            ph.attr["shape"].shape.dim.add().size = s
+        _node(gd, "neg", "Neg", ["input"])
+        pb = str(tmp_path / "g.pb")
+        with open(pb, "wb") as f:
+            f.write(gd.SerializeToString())
+        g, gp, gs = load_tensorflow(pb, ["input"], ["neg"])  # no shapes arg
+        x = np.random.RandomState(0).rand(2, 5).astype(np.float32)
+        y, _ = g.apply(gp, gs, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(y), -x)
+
+    def test_dynamic_placeholder_requires_explicit(self, tmp_path):
+        gd = tfp.GraphDef()
+        ph = gd.node.add()
+        ph.name = "input"
+        ph.op = "Placeholder"
+        ph.attr["shape"].shape.dim.add().size = -1
+        ph.attr["shape"].shape.dim.add().size = 5
+        _node(gd, "neg", "Neg", ["input"])
+        pb = str(tmp_path / "g.pb")
+        with open(pb, "wb") as f:
+            f.write(gd.SerializeToString())
+        with pytest.raises(ValueError, match="input_shapes"):
+            load_tensorflow(pb, ["input"], ["neg"])
+
+    def test_missing_input_node_clear_error(self, tmp_path):
+        gd = _graph()
+        _node(gd, "neg", "Neg", ["input"])
+        pb = str(tmp_path / "g.pb")
+        with open(pb, "wb") as f:
+            f.write(gd.SerializeToString())
+        with pytest.raises(ValueError, match="does not exist"):
+            load_tensorflow(pb, ["inptu"], ["neg"])
