@@ -36,7 +36,8 @@ def corpus():
     return shapes, inputs, expected
 
 
-CORPUS_NAMES = ["keras_cnn", "lenet5", "mlp_graph", "rnn"]
+CORPUS_NAMES = ["adv_act", "bidir_rnn", "keras_cnn", "lenet5",
+                "mlp_graph", "rnn"]
 
 
 @pytest.mark.parametrize("name", CORPUS_NAMES)
