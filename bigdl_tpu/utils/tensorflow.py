@@ -624,143 +624,250 @@ def load_tensorflow(pb_path: str, inputs: Sequence[str],
 # export
 
 
+def _emit_const(gd, cname: str, arr: np.ndarray) -> str:
+    nd = gd.node.add()
+    nd.name = cname
+    nd.op = "Const"
+    nd.attr["dtype"].type = tfp.DT_FLOAT
+    ndarray_to_tensor(np.asarray(arr, np.float32), nd.attr["value"].tensor)
+    return cname
+
+
+def _emit_module(gd, m, p, s, prevs, cur_shape):
+    """Emit NodeDef(s) for one module.  `prevs` is the list of upstream tf
+    node names (len > 1 only for table ops).  Returns (output_name,
+    output_shape or None).  Raises for unsupported layers — exports must
+    never be silently incomplete."""
+
+    def typed(nd):
+        nd.attr["T"].type = tfp.DT_FLOAT
+        return nd
+
+    def out_shape():
+        if cur_shape is None:
+            return None
+        try:
+            return tuple(m.output_shape(cur_shape))
+        except Exception:
+            return None
+
+    prev = prevs[0]
+    if isinstance(m, nn.Identity):
+        nd = typed(gd.node.add())
+        nd.name = m.name
+        nd.op = "Identity"
+        nd.input.append(prev)
+        return m.name, cur_shape
+    if isinstance(m, (nn.CAddTable, nn.CMulTable)):
+        op = "AddV2" if isinstance(m, nn.CAddTable) else "Mul"
+        acc = prevs[0]
+        for k, other in enumerate(prevs[1:]):
+            nd = typed(gd.node.add())
+            nd.name = m.name if k == len(prevs) - 2 else f"{m.name}_{k}"
+            nd.op = op
+            nd.input.extend([acc, other])
+            acc = nd.name
+        shapes = cur_shape if isinstance(cur_shape, list) else None
+        return acc, (shapes[0] if shapes else None)
+    if isinstance(m, nn.JoinTable):
+        shapes = cur_shape if isinstance(cur_shape, list) else None
+        known = shapes if shapes and all(sh is not None for sh in shapes) \
+            else None
+        rank = len(known[0]) if known else 4
+        axis = m.dim % rank
+        axis_name = add_const_int(gd, f"{m.name}/axis",
+                                  np.asarray(axis, np.int32))
+        nd = typed(gd.node.add())
+        nd.name = m.name
+        nd.op = "ConcatV2"
+        nd.input.extend(list(prevs) + [axis_name])
+        nd.attr["N"].i = len(prevs)
+        out = None
+        if known:
+            out = list(known[0])
+            out[axis] = sum(sh[axis] for sh in known)
+            out = tuple(out)
+        return m.name, out
+    if isinstance(m, nn.SpatialConvolution):
+        if m.n_group != 1:
+            raise ValueError("TF export does not support grouped "
+                             "convolutions (Conv2D has no group attr)")
+        if m.pad not in ((-1, -1), (0, 0)):
+            raise ValueError("TF export supports pad (0, 0) or "
+                             "SAME (-1, -1) only, uniformly per layer")
+        wname = _emit_const(gd, f"{m.name}/weight", np.asarray(p["weight"]))
+        nd = typed(gd.node.add())
+        nd.name = m.name
+        nd.op = "Conv2D"
+        nd.input.extend([prev, wname])
+        nd.attr["strides"].list.i.extend([1, m.stride[0], m.stride[1], 1])
+        if m.dilation != (1, 1):  # SpatialDilatedConvolution subclass
+            nd.attr["dilations"].list.i.extend(
+                [1, m.dilation[0], m.dilation[1], 1])
+        nd.attr["padding"].s = b"SAME" if m.pad[0] == -1 else b"VALID"
+        out = m.name
+        if m.with_bias:
+            bname = _emit_const(gd, f"{m.name}/bias", np.asarray(p["bias"]))
+            nb = typed(gd.node.add())
+            nb.name = f"{m.name}/BiasAdd"
+            nb.op = "BiasAdd"
+            nb.input.extend([out, bname])
+            out = nb.name
+        return out, out_shape()
+    if isinstance(m, nn.Linear):
+        wname = _emit_const(gd, f"{m.name}/weight", np.asarray(p["weight"]))
+        nd = typed(gd.node.add())
+        nd.name = m.name
+        nd.op = "MatMul"
+        nd.input.extend([prev, wname])
+        out = m.name
+        if "bias" in p:
+            bname = _emit_const(gd, f"{m.name}/bias", np.asarray(p["bias"]))
+            nb = typed(gd.node.add())
+            nb.name = f"{m.name}/BiasAdd"
+            nb.op = "BiasAdd"
+            nb.input.extend([out, bname])
+            out = nb.name
+        return out, out_shape()
+    if isinstance(m, (nn.SpatialMaxPooling, nn.SpatialAveragePooling)):
+        if m.pad not in ((-1, -1), (0, 0)):
+            raise ValueError("TF export supports pad (0, 0) or "
+                             "SAME (-1, -1) only, uniformly per layer")
+        nd = typed(gd.node.add())
+        nd.name = m.name
+        nd.op = "MaxPool" if isinstance(m, nn.SpatialMaxPooling) else "AvgPool"
+        nd.input.append(prev)
+        nd.attr["ksize"].list.i.extend([1, m.kernel[0], m.kernel[1], 1])
+        nd.attr["strides"].list.i.extend([1, m.stride[0], m.stride[1], 1])
+        nd.attr["padding"].s = b"SAME" if m.pad[0] == -1 else b"VALID"
+        return m.name, out_shape()
+    act_ops = {nn.ReLU: "Relu", nn.ReLU6: "Relu6", nn.Tanh: "Tanh",
+               nn.Sigmoid: "Sigmoid", nn.ELU: "Elu",
+               nn.SoftPlus: "Softplus", nn.SoftMax: "Softmax"}
+    if type(m) in act_ops:
+        nd = typed(gd.node.add())
+        nd.name = m.name
+        nd.op = act_ops[type(m)]
+        nd.input.append(prev)
+        return m.name, cur_shape
+    if isinstance(m, nn.SpatialBatchNormalization):
+        nd = typed(gd.node.add())
+        nd.name = m.name
+        nd.op = "FusedBatchNorm"
+        g_ = _emit_const(gd, f"{m.name}/gamma", np.asarray(p["weight"]))
+        b_ = _emit_const(gd, f"{m.name}/beta", np.asarray(p["bias"]))
+        mu = _emit_const(gd, f"{m.name}/mean", np.asarray(s["running_mean"]))
+        var = _emit_const(gd, f"{m.name}/var", np.asarray(s["running_var"]))
+        nd.input.extend([prev, g_, b_, mu, var])
+        nd.attr["epsilon"].f = m.eps
+        nd.attr["is_training"].b = False  # inference: use mean/var inputs
+        return m.name, cur_shape
+    if isinstance(m, nn.Flatten):
+        flat = int(np.prod(cur_shape[1:])) if cur_shape is not None else -1
+        shape_name = add_const_int(gd, f"{m.name}/shape",
+                                   np.asarray([-1, flat], np.int32))
+        nd = typed(gd.node.add())
+        nd.name = m.name
+        nd.op = "Reshape"
+        nd.attr["Tshape"].type = tfp.DT_INT32
+        nd.input.extend([prev, shape_name])
+        return m.name, ((cur_shape[0], flat) if cur_shape is not None else None)
+    if isinstance(m, nn.CAdd):
+        # the importer lowers TF BiasAdd to nn.CAdd; emit it back.  1-D
+        # biases use BiasAdd (channel broadcast); other shapes AddV2 a const
+        bias = np.asarray(p["bias"])
+        bname = _emit_const(gd, f"{m.name}/bias", bias)
+        nd = typed(gd.node.add())
+        nd.name = m.name
+        nd.op = "BiasAdd" if bias.ndim == 1 else "AddV2"
+        nd.input.extend([prev, bname])
+        return m.name, cur_shape
+    if isinstance(m, nn.Reshape):
+        target = ([-1] + [int(v) for v in m.size]) if m.batch_mode \
+            else [int(v) for v in m.size]
+        shape_name = add_const_int(gd, f"{m.name}/shape",
+                                   np.asarray(target, np.int32))
+        nd = typed(gd.node.add())
+        nd.name = m.name
+        nd.op = "Reshape"
+        nd.attr["Tshape"].type = tfp.DT_INT32
+        nd.input.extend([prev, shape_name])
+        return m.name, out_shape()
+    if isinstance(m, nn.Dropout):
+        return prev, cur_shape  # inference graph: dropout is identity
+    if isinstance(m, nn.Sequential):
+        out, sh = prev, cur_shape
+        for key, child in m.children.items():
+            out, sh = _emit_module(
+                gd, child, p.get(key, {}),
+                s.get(key, {}) if isinstance(s, dict) else {}, [out], sh)
+        return out, sh
+    raise ValueError(f"save_tensorflow: unsupported layer "
+                     f"{type(m).__name__}")
+
+
 def save_tensorflow(model: nn.Module, params: Any, state: Any, path: str,
                     input_shape: Sequence[int],
                     input_name: str = "input") -> None:
-    """Export a Sequential chain as a frozen inference GraphDef.
+    """Export a model as a frozen inference GraphDef — Sequential chains
+    or Graph DAGs (branches, residual adds, concats).
     reference: utils/tf/TensorflowSaver.scala + BigDLToTensorflow.scala."""
     gd = tfp.GraphDef()
     gd.versions.producer = 27
 
-    def typed(nd):
-        # real TF's importer requires the non-defaulted dtype attr on every
-        # typed op (NodeDef missing attr 'T' otherwise)
-        nd.attr["T"].type = tfp.DT_FLOAT
-        return nd
+    def placeholder(name, shape):
+        ph = gd.node.add()
+        ph.name = name
+        ph.op = "Placeholder"
+        ph.attr["dtype"].type = tfp.DT_FLOAT
+        for sdim in shape:
+            ph.attr["shape"].shape.dim.add().size = sdim
 
-    def add_const(cname: str, arr: np.ndarray) -> str:
-        nd = gd.node.add()
-        nd.name = cname
-        nd.op = "Const"
-        nd.attr["dtype"].type = tfp.DT_FLOAT
-        ndarray_to_tensor(np.asarray(arr, np.float32), nd.attr["value"].tensor)
-        return cname
-
-    ph = gd.node.add()
-    ph.name = input_name
-    ph.op = "Placeholder"
-    ph.attr["dtype"].type = tfp.DT_FLOAT
-    for s in input_shape:
-        ph.attr["shape"].shape.dim.add().size = s
-    prev = input_name
-    if not hasattr(model, "children"):
-        raise ValueError("save_tensorflow exports Sequential models")
-    cur_shape = tuple(input_shape)
-    for key, m in model.children.items():
-        p = params.get(key, {})
-        s = state.get(key, {})
-        if isinstance(m, nn.SpatialConvolution):
-            if m.n_group != 1:
-                raise ValueError("TF export does not support grouped "
-                                 "convolutions (Conv2D has no group attr)")
-            wname = add_const(f"{m.name}/weight", np.asarray(p["weight"]))
-            nd = gd.node.add()
-            nd.name = m.name
-            nd.op = "Conv2D"
-            typed(nd)
-            nd.input.extend([prev, wname])
-            nd.attr["strides"].list.i.extend([1, m.stride[0], m.stride[1], 1])
-            if m.dilation != (1, 1):  # SpatialDilatedConvolution subclass
-                nd.attr["dilations"].list.i.extend(
-                    [1, m.dilation[0], m.dilation[1], 1])
-            if m.pad not in ((-1, -1), (0, 0)):
-                raise ValueError("TF export supports pad (0, 0) or "
-                                 "SAME (-1, -1) only, uniformly per layer")
-            nd.attr["padding"].s = b"SAME" if m.pad[0] == -1 else b"VALID"
-            prev = m.name
-            if m.with_bias:
-                bname = add_const(f"{m.name}/bias", np.asarray(p["bias"]))
-                nb = gd.node.add()
-                nb.name = f"{m.name}/BiasAdd"
-                nb.op = "BiasAdd"
-                typed(nb)
-                nb.input.extend([prev, bname])
-                prev = nb.name
-        elif isinstance(m, nn.Linear):
-            w = np.asarray(p["weight"])
-            wname = add_const(f"{m.name}/weight", w)
-            nd = gd.node.add()
-            nd.name = m.name
-            nd.op = "MatMul"
-            typed(nd)
-            nd.input.extend([prev, wname])
-            prev = m.name
-            if "bias" in p:
-                bname = add_const(f"{m.name}/bias", np.asarray(p["bias"]))
-                nb = gd.node.add()
-                nb.name = f"{m.name}/BiasAdd"
-                nb.op = "BiasAdd"
-                typed(nb)
-                nb.input.extend([prev, bname])
-                prev = nb.name
-        elif isinstance(m, (nn.SpatialMaxPooling, nn.SpatialAveragePooling)):
-            nd = gd.node.add()
-            nd.name = m.name
-            nd.op = "MaxPool" if isinstance(m, nn.SpatialMaxPooling) else "AvgPool"
-            typed(nd)
-            nd.input.append(prev)
-            nd.attr["ksize"].list.i.extend([1, m.kernel[0], m.kernel[1], 1])
-            nd.attr["strides"].list.i.extend([1, m.stride[0], m.stride[1], 1])
-            if m.pad not in ((-1, -1), (0, 0)):
-                raise ValueError("TF export supports pad (0, 0) or "
-                                 "SAME (-1, -1) only, uniformly per layer")
-            nd.attr["padding"].s = b"SAME" if m.pad[0] == -1 else b"VALID"
-            prev = m.name
-        elif isinstance(m, (nn.ReLU, nn.ReLU6, nn.Tanh, nn.Sigmoid, nn.ELU,
-                            nn.SoftPlus, nn.SoftMax)):
-            nd = gd.node.add()
-            nd.name = m.name
-            nd.op = {nn.ReLU: "Relu", nn.ReLU6: "Relu6", nn.Tanh: "Tanh",
-                     nn.Sigmoid: "Sigmoid", nn.ELU: "Elu",
-                     nn.SoftPlus: "Softplus", nn.SoftMax: "Softmax"}[type(m)]
-            typed(nd)
-            nd.input.append(prev)
-            prev = m.name
-        elif isinstance(m, nn.SpatialBatchNormalization):
-            nd = gd.node.add()
-            nd.name = m.name
-            nd.op = "FusedBatchNorm"
-            typed(nd)
-            g_ = add_const(f"{m.name}/gamma", np.asarray(p["weight"]))
-            b_ = add_const(f"{m.name}/beta", np.asarray(p["bias"]))
-            mu = add_const(f"{m.name}/mean", np.asarray(s["running_mean"]))
-            var = add_const(f"{m.name}/var", np.asarray(s["running_var"]))
-            nd.input.extend([prev, g_, b_, mu, var])
-            nd.attr["epsilon"].f = m.eps
-            nd.attr["is_training"].b = False  # inference: use mean/var inputs
-            prev = m.name
-        elif isinstance(m, nn.Flatten):
-            flat = int(np.prod(cur_shape[1:])) if cur_shape is not None else -1
-            shape_name = add_const_int(gd, f"{m.name}/shape",
-                                       np.asarray([-1, flat], np.int32))
-            nd = gd.node.add()
-            nd.name = m.name
-            nd.op = "Reshape"
-            typed(nd)
-            nd.attr["Tshape"].type = tfp.DT_INT32
-            nd.input.extend([prev, shape_name])
-            prev = m.name
-        elif isinstance(m, nn.Dropout):
-            continue  # inference graph: dropout is identity
+    if isinstance(model, nn.Graph):
+        multi = len(model.input_nodes) > 1
+        if multi:
+            shapes_in = list(input_shape)
+            if (len(shapes_in) != len(model.input_nodes)
+                    or not all(isinstance(sh, (tuple, list))
+                               for sh in shapes_in)):
+                raise ValueError(
+                    f"graph has {len(model.input_nodes)} inputs: pass a "
+                    f"list of {len(model.input_nodes)} shapes, got "
+                    f"{input_shape!r}")
         else:
-            raise ValueError(f"save_tensorflow: unsupported layer "
-                             f"{type(m).__name__}")
-        if cur_shape is not None:
-            try:
-                cur_shape = tuple(m.output_shape(cur_shape))
-            except Exception:
-                if isinstance(m, nn.Flatten):
-                    cur_shape = (cur_shape[0], int(np.prod(cur_shape[1:])))
+            shapes_in = [tuple(input_shape)]
+        names: Dict[int, str] = {}
+        shapes: Dict[int, Any] = {}
+        for i, node in enumerate(model.input_nodes):
+            nm = input_name if not multi else f"{input_name}_{i}"
+            placeholder(nm, shapes_in[i])
+            names[id(node)] = nm
+            shapes[id(node)] = tuple(shapes_in[i])
+        for node in model.topo:
+            if node.module is None:
+                if id(node) not in names:
+                    raise ValueError(f"graph input {node.name} missing from "
+                                     f"input_nodes")
+                continue
+            prevs = [names[id(pn)] for pn in node.prevs]
+            pshapes = [shapes.get(id(pn)) for pn in node.prevs]
+            cur = pshapes[0] if len(pshapes) == 1 else list(pshapes)
+            key = node.name
+            out, osh = _emit_module(gd, node.module, params.get(key, {}),
+                                    state.get(key, {}), prevs, cur)
+            names[id(node)] = out
+            shapes[id(node)] = osh
+    elif hasattr(model, "children"):
+        placeholder(input_name, input_shape)
+        prev = input_name
+        cur_shape = tuple(input_shape)
+        for key, m in model.children.items():
+            prev, cur_shape = _emit_module(
+                gd, m, params.get(key, {}),
+                state.get(key, {}) if isinstance(state, dict) else {},
+                [prev], cur_shape)
+    else:
+        raise ValueError("save_tensorflow exports Sequential or Graph models")
     with open(path, "wb") as f:
         f.write(gd.SerializeToString())
 

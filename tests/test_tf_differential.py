@@ -312,3 +312,115 @@ class TestGradientDifferential:
         tg1, tg2 = tape.gradient(loss, [v1, v2])
         np.testing.assert_allclose(g1, tg1.numpy(), rtol=2e-4, atol=1e-6)
         np.testing.assert_allclose(g2, tg2.numpy(), rtol=2e-4, atol=1e-6)
+
+
+class TestGraphExport:
+    def test_residual_graph_exports_and_tf_matches(self, tmp_path):
+        """A branchy Graph (residual add + concat) exports as a GraphDef
+        that real TF executes identically."""
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.utils.tensorflow import save_tensorflow
+
+        inp = nn.Input()
+        c1 = nn.SpatialConvolution(3, 4, 3, 3, pad_w=-1, pad_h=-1)(inp)
+        r1 = nn.ReLU()(c1)
+        c2 = nn.SpatialConvolution(4, 4, 3, 3, pad_w=-1, pad_h=-1)(r1)
+        added = nn.CAddTable()(c2, c1)          # residual
+        cat = nn.JoinTable(3)(added, r1)        # channel concat
+        out = nn.Sequential(nn.Flatten(), nn.Linear(8 * 8 * 8, 5),
+                            nn.SoftMax())(cat)
+        g = nn.Graph([inp], [out])
+        params, state, _ = g.build(jax.random.PRNGKey(0), (2, 8, 8, 3))
+        pb = str(tmp_path / "graph.pb")
+        save_tensorflow(g, params, state, pb, (2, 8, 8, 3))
+
+        x = np.random.RandomState(0).rand(2, 8, 8, 3).astype(np.float32)
+        gd = tf.compat.v1.GraphDef()
+        with open(pb, "rb") as fh:
+            gd.ParseFromString(fh.read())
+        tg = tf.Graph()
+        with tg.as_default():
+            tf.import_graph_def(gd, name="")
+        consumed = {i.split(":")[0] for n in gd.node for i in n.input}
+        outs = [n.name for n in gd.node
+                if n.op not in ("Const", "Placeholder")
+                and n.name not in consumed]
+        assert len(outs) == 1, outs
+        with tf.compat.v1.Session(graph=tg) as sess:
+            y_tf = sess.run(outs[0] + ":0", {"input:0": x})
+        y_ours = np.asarray(g.apply(params, state, jnp.asarray(x))[0])
+        np.testing.assert_allclose(y_tf, y_ours, rtol=2e-4, atol=1e-5)
+
+    def test_import_reexport_roundtrip(self, tmp_path):
+        """Frozen TF graph -> import -> re-export -> TF executes it with
+        identical outputs (full circle)."""
+        rs = np.random.RandomState(1)
+        k1 = tf.constant(rs.randn(3, 3, 2, 4).astype(np.float32) * 0.4)
+
+        @tf.function
+        def f(x):
+            h = tf.nn.conv2d(x, k1, strides=1, padding="SAME")
+            return tf.nn.relu(h)
+
+        g, gp, gs = import_graph(f, (1, 6, 6, 2), "Relu", tmp_path)
+        from bigdl_tpu.utils.tensorflow import save_tensorflow
+
+        pb2 = str(tmp_path / "reexport.pb")
+        save_tensorflow(g, gp, gs, pb2, (1, 6, 6, 2))
+        x = rs.rand(1, 6, 6, 2).astype(np.float32)
+        gd = tf.compat.v1.GraphDef()
+        with open(pb2, "rb") as fh:
+            gd.ParseFromString(fh.read())
+        tg = tf.Graph()
+        with tg.as_default():
+            tf.import_graph_def(gd, name="")
+        consumed = {i.split(":")[0] for n in gd.node for i in n.input}
+        outs = [n.name for n in gd.node
+                if n.op not in ("Const", "Placeholder")
+                and n.name not in consumed]
+        with tf.compat.v1.Session(graph=tg) as sess:
+            y_rt = sess.run(outs[0] + ":0", {"input:0": x})
+        np.testing.assert_allclose(y_rt, f(x).numpy(), rtol=2e-4, atol=1e-5)
+
+    def test_import_reexport_with_bias(self, tmp_path):
+        """Re-export of imported graphs containing biases (the common case:
+        conv + bias_add + relu + dense)."""
+        rs = np.random.RandomState(2)
+        k1 = tf.constant(rs.randn(3, 3, 2, 4).astype(np.float32) * 0.4)
+        b1 = tf.constant(rs.randn(4).astype(np.float32))
+
+        @tf.function
+        def f(x):
+            h = tf.nn.bias_add(tf.nn.conv2d(x, k1, 1, "SAME"), b1)
+            return tf.nn.relu(h)
+
+        g, gp, gs = import_graph(f, (1, 6, 6, 2), "Relu", tmp_path)
+        from bigdl_tpu.utils.tensorflow import save_tensorflow
+
+        pb2 = str(tmp_path / "re2.pb")
+        save_tensorflow(g, gp, gs, pb2, (1, 6, 6, 2))
+        x = rs.rand(1, 6, 6, 2).astype(np.float32)
+        gd = tf.compat.v1.GraphDef()
+        with open(pb2, "rb") as fh:
+            gd.ParseFromString(fh.read())
+        tg = tf.Graph()
+        with tg.as_default():
+            tf.import_graph_def(gd, name="")
+        consumed = {i.split(":")[0] for n in gd.node for i in n.input}
+        outs = [n.name for n in gd.node
+                if n.op not in ("Const", "Placeholder")
+                and n.name not in consumed]
+        with tf.compat.v1.Session(graph=tg) as sess:
+            y_rt = sess.run(outs[0] + ":0", {"input:0": x})
+        np.testing.assert_allclose(y_rt, f(x).numpy(), rtol=2e-4, atol=1e-5)
+
+    def test_multi_input_graph_shape_validation(self, tmp_path):
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.utils.tensorflow import save_tensorflow
+
+        a, b = nn.Input(), nn.Input()
+        out = nn.CAddTable()(a, b)
+        g = nn.Graph([a, b], [out])
+        params, state, _ = g.build(jax.random.PRNGKey(0), [(1, 4), (1, 4)])
+        with pytest.raises(ValueError, match="list of 2 shapes"):
+            save_tensorflow(g, params, state, str(tmp_path / "x.pb"), (1, 4))
