@@ -87,6 +87,15 @@ class MM(Module):
             b = jnp.swapaxes(b, -1, -2)
         return a @ b, state
 
+    def output_shape(self, input_shape):
+        sa, sb = [list(s) for s in _items(input_shape)]
+        if self.trans_a:
+            sa[-1], sa[-2] = sa[-2], sa[-1]
+        if self.trans_b:
+            sb[-1], sb[-2] = sb[-2], sb[-1]
+        batch = sa[:-2] if len(sa) >= len(sb) else sb[:-2]
+        return tuple(batch) + (sa[-2], sb[-1])
+
 
 class Mul(Module):
     """Single learnable scalar gain. reference: nn/Mul.scala."""

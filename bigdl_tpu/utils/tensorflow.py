@@ -16,7 +16,8 @@ ConcatV2, Pad, Mean/Sum/Max/Min/Prod, LogSoftmax/Softsign/LeakyRelu, unary
 math (Sqrt/Rsqrt/Square/Exp/Log/Log1p/Expm1/Abs/Neg/Floor/Round/Rint/Erf),
 ExpandDims/Transpose/Cast/Shape/Rank/Tile/Slice/StridedSlice/Gather(V2),
 comparisons + Select(V2), ArgMax, OneHot, LRN, ResizeBilinear,
-Split/SplitV (multi-output ':k' references).
+Split/SplitV (multi-output ':k' references), BatchMatMul(V2/V3) and
+dynamic-x-dynamic MatMul (attention-style graphs), Conv2DBackpropInput.
 
 `load_tensorflow(pb_path, inputs, outputs)` -> (Graph, params, state);
 `save_tensorflow(model, params, state, path, input_shape)` exports a
@@ -213,11 +214,31 @@ class _TFImporter:
                 weights = {"weight": w}
             self._attach(name, m, [data_inputs[0]], weights)
         elif op == "MatMul":
-            w = self.const_of(data_inputs[1])
-            if nd.attr["transpose_b"].b:
-                w = w.T
-            m = nn.Linear(w.shape[0], w.shape[1], with_bias=False, name=name)
-            self._attach(name, m, [data_inputs[0]], {"weight": w})
+            dynamic_rhs = self._key(data_inputs[1]) in self.graph_nodes
+            if dynamic_rhs or nd.attr["transpose_a"].b:
+                # dynamic operand(s) or transposed LHS (attention-style).
+                # nn.MM, NOT the forward-only ops.BatchMatMul: imported
+                # graphs must stay differentiable for Session.train
+                for di in data_inputs[:2]:
+                    if self._key(di) not in self.graph_nodes:
+                        self._ensure_node(di, anchor=graph_in[0])
+                m = nn.MM(trans_a=bool(nd.attr["transpose_a"].b),
+                          trans_b=bool(nd.attr["transpose_b"].b), name=name)
+                self._attach(name, m, data_inputs[:2])
+            else:
+                w = self.const_of(data_inputs[1])
+                if nd.attr["transpose_b"].b:
+                    w = w.T
+                m = nn.Linear(w.shape[0], w.shape[1], with_bias=False,
+                              name=name)
+                self._attach(name, m, [data_inputs[0]], {"weight": w})
+        elif op in ("BatchMatMul", "BatchMatMulV2", "BatchMatMulV3"):
+            for di in data_inputs[:2]:
+                if self._key(di) not in self.graph_nodes:
+                    self._ensure_node(di, anchor=graph_in[0])
+            m = nn.MM(trans_a=bool(nd.attr["adj_x"].b),
+                      trans_b=bool(nd.attr["adj_y"].b), name=name)
+            self._attach(name, m, data_inputs[:2])
         elif op == "BiasAdd":
             b = self.const_of(data_inputs[1])
             m = nn.CAdd(b.shape, name=name)
@@ -793,6 +814,23 @@ def _emit_module(gd, m, p, s, prevs, cur_shape):
         nd.attr["Tshape"].type = tfp.DT_INT32
         nd.input.extend([prev, shape_name])
         return m.name, out_shape()
+    if isinstance(m, nn.MM):
+        shapes = cur_shape if isinstance(cur_shape, list) else None
+        rank = len(shapes[0]) if shapes and shapes[0] is not None else 2
+        nd = typed(gd.node.add())
+        nd.name = m.name
+        nd.op = "MatMul" if rank == 2 else "BatchMatMulV2"
+        if rank == 2:
+            nd.attr["transpose_a"].b = bool(m.trans_a)
+            nd.attr["transpose_b"].b = bool(m.trans_b)
+        else:
+            nd.attr["adj_x"].b = bool(m.trans_a)
+            nd.attr["adj_y"].b = bool(m.trans_b)
+        nd.input.extend(prevs[:2])
+        out = None
+        if shapes and all(sh is not None for sh in shapes):
+            out = tuple(m.output_shape(shapes))
+        return m.name, out
     if isinstance(m, nn.Dropout):
         return prev, cur_shape  # inference graph: dropout is identity
     if isinstance(m, nn.Sequential):

@@ -424,3 +424,101 @@ class TestGraphExport:
         params, state, _ = g.build(jax.random.PRNGKey(0), [(1, 4), (1, 4)])
         with pytest.raises(ValueError, match="list of 2 shapes"):
             save_tensorflow(g, params, state, str(tmp_path / "x.pb"), (1, 4))
+
+
+class TestAttentionStyleImport:
+    def test_self_attention_block(self, tmp_path):
+        """A frozen single-head self-attention block (dynamic QK^T matmuls,
+        softmax, AV matmul) imports and matches real TF."""
+        rs = np.random.RandomState(0)
+        wq = tf.constant(rs.randn(8, 8).astype(np.float32) * 0.3)
+        wk = tf.constant(rs.randn(8, 8).astype(np.float32) * 0.3)
+        wv = tf.constant(rs.randn(8, 8).astype(np.float32) * 0.3)
+
+        @tf.function
+        def f(x):  # x: (seq, 8) — 2-D so plain MatMul ops are emitted
+            q = tf.linalg.matmul(x, wq)
+            k = tf.linalg.matmul(x, wk)
+            v = tf.linalg.matmul(x, wv)
+            scores = tf.linalg.matmul(q, k, transpose_b=True) / 8.0 ** 0.5
+            return tf.linalg.matmul(tf.nn.softmax(scores), v)
+
+        import_and_compare(f, rs.randn(6, 8).astype(np.float32), "MatMul",
+                           tmp_path)
+
+    def test_batch_matmul_v2(self, tmp_path):
+        rs = np.random.RandomState(1)
+
+        @tf.function
+        def f(x):  # (B, S, D): batched x x^T
+            return tf.linalg.matmul(x, x, transpose_b=True)
+
+        import_and_compare(f, rs.randn(2, 5, 4).astype(np.float32),
+                           "BatchMatMulV2", tmp_path)
+
+    def test_attention_import_is_differentiable(self, tmp_path):
+        """Gradients must flow through dynamic matmuls (Session.train on
+        attention graphs); the importer must not use forward-only ops."""
+        rs = np.random.RandomState(2)
+        wq = tf.constant(rs.randn(6, 6).astype(np.float32) * 0.4)
+
+        @tf.function
+        def f(x):
+            q = tf.linalg.matmul(x, wq)
+            s = tf.linalg.matmul(q, x, transpose_b=True)
+            return tf.linalg.matmul(tf.nn.softmax(s), x)
+
+        g, gp, gs = import_graph(f, (5, 6), "MatMul", tmp_path)
+        x = jnp.asarray(rs.randn(5, 6).astype(np.float32))
+
+        def loss(p):
+            y, _ = g.apply(p, gs, x)
+            return jnp.sum(jnp.square(y))
+
+        grads = jax.tree_util.tree_leaves(jax.grad(loss)(gp))
+        total = sum(float(jnp.sum(jnp.abs(l))) for l in grads)
+        assert total > 0.0  # wq gradient flows through the dynamic matmuls
+
+    def test_const_lhs_and_transpose_a(self, tmp_path):
+        rs = np.random.RandomState(3)
+        w = tf.constant(rs.randn(5, 6).astype(np.float32))
+
+        @tf.function
+        def f(x):
+            a = tf.linalg.matmul(w, x)                 # const LHS, dynamic RHS
+            return tf.linalg.matmul(a, a, transpose_a=True)
+
+        import_and_compare(f, rs.randn(6, 4).astype(np.float32), "MatMul",
+                           tmp_path)
+
+    def test_attention_graph_reexports(self, tmp_path):
+        """Imported attention graphs re-export (MM -> MatMul) and real TF
+        matches."""
+        rs = np.random.RandomState(4)
+        wq = tf.constant(rs.randn(6, 6).astype(np.float32) * 0.4)
+
+        @tf.function
+        def f(x):
+            q = tf.linalg.matmul(x, wq)
+            s = tf.linalg.matmul(q, x, transpose_b=True)
+            return tf.linalg.matmul(tf.nn.softmax(s), x)
+
+        g, gp, gs = import_graph(f, (5, 6), "MatMul", tmp_path)
+        from bigdl_tpu.utils.tensorflow import save_tensorflow
+
+        pb2 = str(tmp_path / "attn_re.pb")
+        save_tensorflow(g, gp, gs, pb2, (5, 6))
+        x = rs.randn(5, 6).astype(np.float32)
+        gd = tf.compat.v1.GraphDef()
+        with open(pb2, "rb") as fh:
+            gd.ParseFromString(fh.read())
+        tg = tf.Graph()
+        with tg.as_default():
+            tf.import_graph_def(gd, name="")
+        consumed = {i.split(":")[0] for n in gd.node for i in n.input}
+        outs = [n.name for n in gd.node
+                if n.op not in ("Const", "Placeholder")
+                and n.name not in consumed]
+        with tf.compat.v1.Session(graph=tg) as sess:
+            y_rt = sess.run(outs[0] + ":0", {"input:0": x})
+        np.testing.assert_allclose(y_rt, f(x).numpy(), rtol=2e-4, atol=1e-5)
