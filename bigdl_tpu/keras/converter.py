@@ -217,6 +217,25 @@ def _convert_layer(class_name: str, cfg: Dict[str, Any]):
         return KL.ELU(cfg.get("alpha", 1.0), input_shape=shape, name=name)
     if class_name == "PReLU":
         return KL.PReLU(input_shape=shape, name=name)
+    if class_name == "Merge":
+        # keras-1 pattern: Sequential([Merge([modelA, modelB], mode=...)]) —
+        # each branch is a nested model definition; input is a Table of the
+        # branch inputs
+        branch_defs = cfg.get("layers", [])
+        if not branch_defs:
+            raise ValueError("Merge config has no nested branch layers")
+        branches = [model_from_json_config(b) if b.get("class_name") ==
+                    "Sequential" else _convert_layer(b["class_name"],
+                                                     b["config"])
+                    for b in branch_defs]
+        mode = {"cos": "cosine"}.get(cfg.get("mode", "sum"),
+                                     cfg.get("mode", "sum"))
+        if mode not in ("sum", "mul", "ave", "max", "concat", "dot", "cosine"):
+            raise ValueError(f"unsupported Merge mode {cfg.get('mode')!r}")
+        if mode == "dot" and cfg.get("dot_axes") not in (None, -1, [-1, -1]):
+            raise ValueError("Merge dot_axes other than -1 unsupported")
+        return KL.Merge(branches, mode=mode,
+                        concat_axis=cfg.get("concat_axis", -1), name=name)
     if class_name == "Bidirectional":
         inner_def = cfg["layer"]
         inner = _convert_layer(inner_def["class_name"], inner_def["config"])

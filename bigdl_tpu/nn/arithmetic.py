@@ -93,7 +93,12 @@ class MM(Module):
             sa[-1], sa[-2] = sa[-2], sa[-1]
         if self.trans_b:
             sb[-1], sb[-2] = sb[-2], sb[-1]
-        batch = sa[:-2] if len(sa) >= len(sb) else sb[:-2]
+        # numpy batch broadcasting over the leading dims
+        ba, bb = sa[:-2], sb[:-2]
+        n = max(len(ba), len(bb))
+        ba = [1] * (n - len(ba)) + ba
+        bb = [1] * (n - len(bb)) + bb
+        batch = [max(x, y) for x, y in zip(ba, bb)]
         return tuple(batch) + (sa[-2], sb[-1])
 
 

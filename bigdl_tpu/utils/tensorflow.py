@@ -161,6 +161,17 @@ class _TFImporter:
         self.graph_nodes[cname] = cnode
         self.shapes[cname] = tuple(arr.shape)
 
+    def _attach_dynamic_matmul(self, name, data_inputs, graph_in,
+                               trans_a: bool, trans_b: bool) -> None:
+        """Dynamic-operand matmul (attention-style).  nn.MM, NOT the
+        forward-only ops.BatchMatMul: imported graphs must stay
+        differentiable for Session.train."""
+        for di in data_inputs[:2]:
+            if self._key(di) not in self.graph_nodes:
+                self._ensure_node(di, anchor=graph_in[0])
+        self._attach(name, nn.MM(trans_a=trans_a, trans_b=trans_b, name=name),
+                     data_inputs[:2])
+
     def _alias(self, tf_name: str, src: str):
         src = self._key(src)
         self.graph_nodes[tf_name] = self.graph_nodes[src]
@@ -216,15 +227,10 @@ class _TFImporter:
         elif op == "MatMul":
             dynamic_rhs = self._key(data_inputs[1]) in self.graph_nodes
             if dynamic_rhs or nd.attr["transpose_a"].b:
-                # dynamic operand(s) or transposed LHS (attention-style).
-                # nn.MM, NOT the forward-only ops.BatchMatMul: imported
-                # graphs must stay differentiable for Session.train
-                for di in data_inputs[:2]:
-                    if self._key(di) not in self.graph_nodes:
-                        self._ensure_node(di, anchor=graph_in[0])
-                m = nn.MM(trans_a=bool(nd.attr["transpose_a"].b),
-                          trans_b=bool(nd.attr["transpose_b"].b), name=name)
-                self._attach(name, m, data_inputs[:2])
+                self._attach_dynamic_matmul(
+                    name, data_inputs, graph_in,
+                    bool(nd.attr["transpose_a"].b),
+                    bool(nd.attr["transpose_b"].b))
             else:
                 w = self.const_of(data_inputs[1])
                 if nd.attr["transpose_b"].b:
@@ -233,12 +239,9 @@ class _TFImporter:
                               name=name)
                 self._attach(name, m, [data_inputs[0]], {"weight": w})
         elif op in ("BatchMatMul", "BatchMatMulV2", "BatchMatMulV3"):
-            for di in data_inputs[:2]:
-                if self._key(di) not in self.graph_nodes:
-                    self._ensure_node(di, anchor=graph_in[0])
-            m = nn.MM(trans_a=bool(nd.attr["adj_x"].b),
-                      trans_b=bool(nd.attr["adj_y"].b), name=name)
-            self._attach(name, m, data_inputs[:2])
+            self._attach_dynamic_matmul(name, data_inputs, graph_in,
+                                        bool(nd.attr["adj_x"].b),
+                                        bool(nd.attr["adj_y"].b))
         elif op == "BiasAdd":
             b = self.const_of(data_inputs[1])
             m = nn.CAdd(b.shape, name=name)
@@ -816,7 +819,10 @@ def _emit_module(gd, m, p, s, prevs, cur_shape):
         return m.name, out_shape()
     if isinstance(m, nn.MM):
         shapes = cur_shape if isinstance(cur_shape, list) else None
-        rank = len(shapes[0]) if shapes and shapes[0] is not None else 2
+        known = shapes and shapes[0] is not None
+        # unknown rank defaults to BatchMatMulV2: valid for rank >= 2, while
+        # a guessed MatMul would be invalid for 3-D tensors
+        rank = len(shapes[0]) if known else 3
         nd = typed(gd.node.add())
         nd.name = m.name
         nd.op = "MatMul" if rank == 2 else "BatchMatMulV2"

@@ -582,3 +582,39 @@ class TestConverterWidening:
         sd = export_torch_state_dict(model, params, state)
         assert any(k.endswith("weight_ih_l0") for k in sd)
         assert any(k.endswith("weight") for k in sd)
+
+    def test_merge_of_sequentials_json(self):
+        """keras-1 two-branch pattern: Sequential([Merge([mA, mB],
+        mode='concat'), Dense])."""
+        from bigdl_tpu.keras.converter import (model_from_json_config,
+                                               load_keras_weights)
+        from bigdl_tpu.core.table import Table
+
+        def dense(out, in_dim=None):
+            cfg = {"output_dim": out}
+            if in_dim:
+                cfg["batch_input_shape"] = [None, in_dim]
+            return {"class_name": "Dense", "config": cfg}
+
+        spec = {"class_name": "Sequential", "config": [
+            {"class_name": "Merge", "config": {
+                "mode": "concat", "concat_axis": -1,
+                "layers": [
+                    {"class_name": "Sequential", "config": [dense(4, 3)]},
+                    {"class_name": "Sequential", "config": [dense(5, 2)]},
+                ]}},
+            dense(2)]}
+        model = model_from_json_config(spec)
+        params, state, _ = model.build(jax.random.PRNGKey(0),
+                                       Table((1, 3), (1, 2)))
+        rs = np.random.RandomState(0)
+        wa, ba = rs.randn(3, 4).astype("f"), rs.randn(4).astype("f")
+        wb, bb = rs.randn(2, 5).astype("f"), rs.randn(5).astype("f")
+        wd, bd = rs.randn(9, 2).astype("f"), rs.randn(2).astype("f")
+        p2, s2 = load_keras_weights(model, params, state,
+                                    [[wa, ba], [wb, bb], [wd, bd]])
+        xa = rs.randn(1, 3).astype("f")
+        xb = rs.randn(1, 2).astype("f")
+        y, _ = model.apply(p2, s2, Table(jnp.asarray(xa), jnp.asarray(xb)))
+        expect = np.concatenate([xa @ wa + ba, xb @ wb + bb], -1) @ wd + bd
+        np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-4, atol=1e-5)
