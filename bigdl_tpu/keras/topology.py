@@ -85,7 +85,19 @@ class KerasTopology:
                 metrics: Optional[Sequence[Any]] = None) -> None:
         self.optim_method = resolve_optimizer(optimizer)
         self.criterion = resolve_loss(loss)
-        self.metrics = resolve_metrics(metrics)
+        # keras semantics: the GENERIC 'accuracy'/'acc' string under
+        # binary_crossentropy means elementwise binary accuracy; explicit
+        # Top1Accuracy instances (or 'top1') are honored as requested
+        from bigdl_tpu.nn.criterion import BCECriterion
+        from bigdl_tpu.optim.validation import BinaryAccuracy
+        resolved = []
+        for m in (metrics or []):
+            if (isinstance(m, str) and m.lower() in ("accuracy", "acc")
+                    and isinstance(self.criterion, BCECriterion)):
+                resolved.append(BinaryAccuracy())
+            else:
+                resolved.extend(resolve_metrics([m]))
+        self.metrics = resolved
         # a re-compile changes loss/metrics: drop cached compiled programs
         self._evaluator = None
         self._eval_methods = None

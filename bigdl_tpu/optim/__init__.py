@@ -18,7 +18,8 @@ from bigdl_tpu.optim.schedules import (
 )
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
-    ValidationMethod, ValidationResult, Top1Accuracy, Top5Accuracy, Loss,
+    ValidationMethod, ValidationResult, Top1Accuracy, BinaryAccuracy,
+    Top5Accuracy, Loss,
     MAE, HitRatio, NDCG, TreeNNAccuracy,
 )
 from bigdl_tpu.optim.metrics import Metrics

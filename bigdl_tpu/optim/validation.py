@@ -64,6 +64,22 @@ class Top1Accuracy(ValidationMethod):
         return correct, jnp.asarray(target.shape[0], jnp.int32)
 
 
+class BinaryAccuracy(ValidationMethod):
+    """keras binary_accuracy: elementwise mean of (round(pred) == target) —
+    what keras means by metrics=['accuracy'] under binary_crossentropy
+    (K.mean(K.equal(y_true, K.round(y_pred)))), including multi-label
+    sigmoid heads.  Top1Accuracy on a 1-unit output would degenerate to
+    argmax==0."""
+
+    name = "BinaryAccuracy"
+
+    def batch(self, output, target):
+        pred = (jnp.reshape(output, (output.shape[0], -1)) > 0.5)
+        tgt = (jnp.reshape(target, (target.shape[0], -1)) > 0.5)
+        correct = jnp.sum((pred == tgt).astype(jnp.float32))
+        return correct, jnp.asarray(pred.shape[0] * pred.shape[1], jnp.int32)
+
+
 class Top5Accuracy(ValidationMethod):
     """reference: optim/ValidationMethod.scala Top5Accuracy."""
 

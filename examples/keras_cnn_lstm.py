@@ -1,0 +1,61 @@
+"""IMDB-style sentiment model through the Keras-1 API: Embedding ->
+Conv1D -> MaxPooling1D -> LSTM -> Dense(sigmoid).
+
+Reference: pyspark/bigdl/examples/keras/imdb_cnn_lstm.py (the same stack
+trained via the keras compile/fit front end).  Without --data-dir it
+synthesizes class-dependent token streams so the example runs in seconds.
+
+    python examples/keras_cnn_lstm.py [--epochs 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def build_model(vocab_size: int, seq_len: int):
+    from bigdl_tpu import keras
+
+    return keras.Sequential(
+        keras.Embedding(vocab_size, 32, input_shape=(seq_len,)),
+        keras.Convolution1D(32, 5, activation="relu"),
+        keras.MaxPooling1D(2),
+        keras.LSTM(32),
+        keras.Dense(1, activation="sigmoid"),
+    )
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--vocab-size", type=int, default=1000)
+    ap.add_argument("--samples", type=int, default=512)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    rs = np.random.RandomState(0)
+    half = args.vocab_size // 2
+    x = np.zeros((args.samples, args.seq_len), np.int32)
+    y = np.zeros((args.samples,), np.float32)
+    for i in range(args.samples):
+        cls = i % 2
+        lo = 1 + cls * half
+        x[i] = rs.randint(lo, lo + half - 1, args.seq_len)
+        y[i] = cls
+
+    model = build_model(args.vocab_size, args.seq_len)
+    model.compile(optimizer="adam", loss="binary_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y[:, None], batch_size=args.batch_size,
+              nb_epoch=args.epochs)
+    results = model.evaluate(x, y[:, None], batch_size=args.batch_size)
+    for name, value in results:
+        print(f"{name}: {value:.4f}")
+    return dict(results)
+
+
+if __name__ == "__main__":
+    main()

@@ -618,3 +618,10 @@ class TestConverterWidening:
         y, _ = model.apply(p2, s2, Table(jnp.asarray(xa), jnp.asarray(xb)))
         expect = np.concatenate([xa @ wa + ba, xb @ wb + bb], -1) @ wd + bd
         np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-4, atol=1e-5)
+
+    def test_keras_cnn_lstm_example(self):
+        import examples.keras_cnn_lstm as ex
+
+        r = ex.main(["--epochs", "3", "--samples", "256", "--seq-len", "32"])
+        assert 0.0 <= r["BinaryAccuracy"] <= 1.0
+        assert r["BinaryAccuracy"] > 0.6  # separable synthetic classes

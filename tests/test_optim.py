@@ -577,3 +577,38 @@ class TestDeterminism:
         b = run_once()
         for la, lb in zip(a, b):
             np.testing.assert_array_equal(la, lb)
+
+
+class TestBinaryAccuracy:
+    def test_thresholded_counting(self):
+        import jax.numpy as jnp
+        from bigdl_tpu.optim import BinaryAccuracy
+
+        m = BinaryAccuracy()
+        out = jnp.asarray([[0.9], [0.2], [0.6], [0.4]])
+        tgt = jnp.asarray([[1.0], [0.0], [0.0], [0.0]])
+        correct, count = m.batch(out, tgt)
+        assert float(correct) == 3.0 and int(count) == 4
+        # keras elementwise semantics for multi-label heads: 4/5 right = 0.8
+        out2 = jnp.asarray([[0.9, 0.9, 0.1, 0.1, 0.9]])
+        tgt2 = jnp.asarray([[1.0, 1.0, 0.0, 0.0, 0.0]])
+        c2, n2 = m.batch(out2, tgt2)
+        assert float(c2) == 4.0 and int(n2) == 5
+
+    def test_keras_compile_maps_accuracy_for_bce(self):
+        from bigdl_tpu import keras
+        from bigdl_tpu.optim.validation import BinaryAccuracy, Top1Accuracy
+
+        m = keras.Sequential(keras.Dense(1, activation="sigmoid",
+                                         input_dim=4))
+        m.compile(optimizer="sgd", loss="binary_crossentropy",
+                  metrics=["accuracy"])
+        assert any(isinstance(x, BinaryAccuracy) for x in m.metrics)
+        # explicit top1 request is honored even under BCE
+        m.compile(optimizer="sgd", loss="binary_crossentropy",
+                  metrics=["top1"])
+        assert any(isinstance(x, Top1Accuracy) for x in m.metrics)
+        m2 = keras.Sequential(keras.Dense(3, input_dim=4))
+        m2.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                   metrics=["accuracy"])
+        assert any(isinstance(x, Top1Accuracy) for x in m2.metrics)
