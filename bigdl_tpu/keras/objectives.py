@@ -65,6 +65,7 @@ _OPTIMIZERS = {
 }
 
 _METRICS = {
+    "binary_accuracy": __import__("bigdl_tpu.optim.validation", fromlist=["BinaryAccuracy"]).BinaryAccuracy,
     "accuracy": Top1Accuracy,
     "acc": Top1Accuracy,
     "top1": Top1Accuracy,
