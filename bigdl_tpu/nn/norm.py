@@ -174,22 +174,28 @@ class NormalizeScale(Module):
     scale weight initialised to a constant)."""
 
     def __init__(self, p: float = 2.0, eps: float = 1e-10, scale: float = 1.0,
-                 size: Optional[Sequence[int]] = None, name: Optional[str] = None):
+                 size: Optional[Sequence[int]] = None, name: Optional[str] = None,
+                 across_spatial: bool = False):
         super().__init__(name)
         self.p = p
         self.eps = eps
         self.scale = scale
         self.size = tuple(size) if size is not None else None
+        # across_spatial: the norm is taken over ALL non-batch axes (caffe
+        # norm_param.across_spatial=true, the proto default) instead of the
+        # channel axis only (the SSD conv4_3 configuration)
+        self.across_spatial = across_spatial
 
     def build(self, rng, input_shape):
         size = self.size if self.size is not None else (input_shape[-1],)
         return {"weight": jnp.full(size, self.scale, jnp.float32)}, {}, input_shape
 
     def apply(self, params, state, x, *, training=False, rng=None):
+        axes = tuple(range(1, x.ndim)) if self.across_spatial else (-1,)
         if self.p == 2.0:
-            norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+            norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True))
         else:
-            norm = jnp.sum(jnp.abs(x) ** self.p, axis=-1, keepdims=True) ** (1.0 / self.p)
+            norm = jnp.sum(jnp.abs(x) ** self.p, axis=axes, keepdims=True) ** (1.0 / self.p)
         return (x / jnp.maximum(norm, self.eps)) * params["weight"], state
 
 

@@ -94,6 +94,7 @@ class Optimizer:
         self.opt_state = None
         self.metrics = Metrics()
         self._compiled = None
+        self._compiled_key = None
         self._driver_state: Dict[str, Any] = {"epoch": 0, "neval": 0, "loss": None,
                                               "score": None, "epoch_finished": False}
 
@@ -378,8 +379,15 @@ class Optimizer:
             raise ValueError("call set_validation(trigger, dataset, methods) first")
         if self.params is None:
             raise ValueError("model not built yet: run optimize() (or init) first")
-        if self._compiled is None:
+        # key the compiled eval step on the method list so swapping
+        # val_methods recompiles instead of silently reusing the old closure
+        # (strong refs, not id()s: a freed method's address can be reused)
+        key = tuple(self.val_methods)
+        if self._compiled is None or self._compiled_key is None \
+                or len(self._compiled_key) != len(key) \
+                or any(a is not b for a, b in zip(self._compiled_key, key)):
             self._compiled = self._build_eval_step()
+            self._compiled_key = key
         totals = [ValidationResult(0.0, 0, m.name) for m in self.val_methods]
         for batch in self.val_dataset.data(train=False):
             x = self._put_batch(batch.get_input())

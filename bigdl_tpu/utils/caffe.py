@@ -406,8 +406,12 @@ def load_caffe(def_path: str, model_path: Optional[str] = None,
             module = nn.ops.ArgMax(axis, name=l.name)
         elif ltype == "Normalize":
             npm = l.norm_param
+            # across_spatial defaults to TRUE in caffe.proto; only the
+            # SSD-style across_spatial=false maps to the channel-axis norm
+            across = bool(npm.across_spatial)
             module = nn.NormalizeScale(2.0, eps=npm.eps or 1e-10, scale=1.0,
-                                       size=(bshape[-1],), name=l.name)
+                                       size=(bshape[-1],), name=l.name,
+                                       across_spatial=across)
             if lw:
                 scale = lw[0].reshape(-1)
                 if scale.size == 1:  # channel_shared

@@ -226,21 +226,38 @@ def load_checkpoint(ckpt_dir: str, params_template: Any,
             raise ValueError(
                 f"unsupported checkpoint schema {meta.get('schema_version')}")
 
-    def load_npz(name, template):
+    # File presence is decided by the reader and agreed collectively, so
+    # every process takes the same branch (loads+broadcast vs None).
+    names = ("params.npz", "model_state.npz", "opt_state.npz")
+    present = [0, 0, 0]
+    if reader:
+        present = [int(_exists(_join(ckpt_dir, n))) for n in names]
+    present = [agree_from_process_zero(v) for v in present]
+
+    def load_npz(name, template, is_present):
         if template is None:
             return None
+        if not is_present:
+            # A supplied template with no file is a missing/partial
+            # checkpoint: zeros here would silently corrupt state like BN
+            # running_var, so params are an error and aux trees load as
+            # None (caller re-inits them).
+            if name == "params.npz":
+                raise FileNotFoundError(
+                    f"checkpoint {ckpt_dir} has no {name}")
+            return None
         p = _join(ckpt_dir, name)
-        if reader and _exists(p):
+        if reader:
             with _loadz(p) as z:
                 return _unflatten_into(template, dict(z))
-        # non-reader (or writer-absent file): zeros in template structure,
-        # overwritten by the broadcast below when multi-process
+        # non-reader: zeros placeholder in template structure, overwritten
+        # by the broadcast below
         return jax.tree_util.tree_map(
             lambda l: np.zeros(np.shape(l), np.asarray(l).dtype), template)
 
-    params = load_npz("params.npz", params_template)
-    model_state = load_npz("model_state.npz", model_state_template)
-    opt_state = load_npz("opt_state.npz", opt_state_template)
+    params = load_npz("params.npz", params_template, present[0])
+    model_state = load_npz("model_state.npz", model_state_template, present[1])
+    opt_state = load_npz("opt_state.npz", opt_state_template, present[2])
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
@@ -251,12 +268,16 @@ def load_checkpoint(ckpt_dir: str, params_template: Any,
             params = next(it) if params is not None else None
             model_state = next(it) if model_state is not None else None
             opt_state = next(it) if opt_state is not None else None
-        # driver_state: small json, broadcast as padded bytes
-        raw = json.dumps(meta.get("driver_state", {})).encode()[:4096]
-        buf = np.zeros(4096, np.uint8)
-        buf[:len(raw)] = np.frombuffer(raw, np.uint8)
-        buf = np.asarray(multihost_utils.broadcast_one_to_all(buf))
-        text = bytes(buf[buf != 0].tobytes()).decode()
+        # driver_state json: broadcast the byte length first, then a buffer
+        # sized to it — no fixed-size truncation
+        raw = json.dumps(meta.get("driver_state", {})).encode()
+        nbytes = agree_from_process_zero(len(raw))
+        buf = np.zeros(nbytes, np.uint8)
+        if reader and nbytes:
+            buf[:] = np.frombuffer(raw, np.uint8)
+        if nbytes:
+            buf = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+        text = buf.tobytes().decode()
         meta["driver_state"] = json.loads(text) if text else {}
     return params, model_state, opt_state, meta.get("driver_state", {})
 

@@ -448,7 +448,9 @@ class _TFImporter:
             depth = int(self.const_of(data_inputs[1]))
             on = float(self.const_of(data_inputs[2]))
             off = float(self.const_of(data_inputs[3]))
-            self._attach(name, nn.ops.OneHot(depth, on, off, name=name),
+            axis = int(nd.attr["axis"].i) if "axis" in nd.attr else -1
+            self._attach(name, nn.ops.OneHot(depth, on, off, axis=axis,
+                                             name=name),
                          [data_inputs[0]])
         elif op == "Tile":
             mult = [int(v) for v in self.const_of(data_inputs[1]).reshape(-1)]

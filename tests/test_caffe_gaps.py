@@ -89,8 +89,17 @@ class TestNewCaffeLayers:
         np.testing.assert_allclose(y[..., :3], x)
         np.testing.assert_allclose(y[..., 3:], x)
 
-    def test_normalize_ssd(self, tmp_path):
+    def test_normalize_default_across_spatial(self, tmp_path):
+        # caffe.proto default: across_spatial=true -> L2 norm over C*H*W
         body = _layer("nm", "Normalize", "data", "nm")
+        y, x = self._run(tmp_path, body)
+        total = np.sqrt((y ** 2).sum())
+        np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+    def test_normalize_ssd(self, tmp_path):
+        # SSD conv4_3 config: across_spatial=false -> per-position channel norm
+        body = _layer("nm", "Normalize", "data", "nm",
+                      "norm_param { across_spatial: false }")
         y, x = self._run(tmp_path, body)
         norms = np.sqrt((y ** 2).sum(-1))
         np.testing.assert_allclose(norms, 1.0, rtol=1e-4)

@@ -276,6 +276,50 @@ class TestTrainingLoop:
         assert o2._driver_state["epoch"] == 3
         assert o2._driver_state["neval"] > o._driver_state["neval"]
 
+    def test_validate_recompiles_on_method_swap(self):
+        """Swapping val_methods must not reuse the stale jitted eval
+        closure (regression: _compiled was cached unconditionally)."""
+        ds = make_classification_dataset(n=64)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4),
+                              nn.LogSoftMax())
+        o = optim.LocalOptimizer(model, ds, nn.ClassNLLCriterion(),
+                                 optim_method=SGD(learning_rate=0.5),
+                                 end_trigger=Trigger.max_epoch(2))
+        o.set_validation(Trigger.every_epoch(),
+                         make_classification_dataset(n=64, seed=1),
+                         [Top1Accuracy()])
+        o.optimize()
+        acc = o.validate()[0].result()[0]
+        assert 0.0 <= acc <= 1.0
+        # swap to a Loss method: the value must be an NLL mean (a per-record
+        # average < the accuracy COUNT the stale closure would produce)
+        o.val_methods = [optim.Loss(nn.ClassNLLCriterion())]
+        res = o.validate()[0]
+        assert res.name == "Loss"
+        loss_val = res.result()[0]
+        # with a >90%-accurate model the stale Top1 closure would return a
+        # per-batch *count* (>= 1 per batch summed); a real NLL mean on this
+        # converged model is well below 1
+        assert loss_val < 0.9, f"stale eval closure suspected: {loss_val}"
+
+    def test_checkpoint_missing_files(self, tmp_path):
+        from bigdl_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+        params = {"w": np.ones((2, 2), np.float32)}
+        opt_template = {"m": np.full((2, 2), 7.0, np.float32)}
+        # save WITHOUT opt_state: loading with a template must yield None,
+        # not a zero-filled tree that silently corrupts optimizer slots
+        d = save_checkpoint(str(tmp_path), 1, params)
+        p, ms, os_, drv = load_checkpoint(d, params, None, opt_template)
+        assert os_ is None
+        np.testing.assert_allclose(p["w"], params["w"])
+        # a dir with no params.npz at all is a broken checkpoint: raise
+        bad = tmp_path / "ckpt_9"
+        bad.mkdir()
+        (bad / "meta.json").write_text('{"schema_version": 1, "driver_state": {}}')
+        with pytest.raises(FileNotFoundError):
+            load_checkpoint(str(bad), params)
+
     def test_gradient_clipping(self):
         from bigdl_tpu.optim.parameter_processor import (
             ConstantClippingProcessor, L2NormClippingProcessor)
