@@ -119,7 +119,8 @@ class KerasTopology:
     def fit(self, x: Union[np.ndarray, DataSet], y: Optional[np.ndarray] = None,
             batch_size: int = 32, nb_epoch: int = 10,
             validation_data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
-            mesh=None) -> "KerasTopology":
+            mesh=None, sharding_rules=None,
+            batch_partition=None) -> "KerasTopology":
         self._require_compiled()
         if isinstance(x, DataSet):
             dataset = x
@@ -134,7 +135,9 @@ class KerasTopology:
             dataset = _ArrayTrainDataSet(np.asarray(x[:n_full]),
                                          np.asarray(y[:n_full]), batch_size)
         opt = Optimizer(model=self, dataset=dataset, criterion=self.criterion,
-                        end_trigger=Trigger.max_epoch(nb_epoch), mesh=mesh)
+                        end_trigger=Trigger.max_epoch(nb_epoch), mesh=mesh,
+                        sharding_rules=sharding_rules,
+                        batch_partition=batch_partition)
         opt.set_optim_method(self.optim_method)
         if validation_data is not None:
             vx, vy = validation_data

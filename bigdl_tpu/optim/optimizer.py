@@ -66,12 +66,22 @@ class Optimizer:
     def __init__(self, model: Module, dataset: DataSet, criterion: Criterion,
                  optim_method: Optional[OptimMethod] = None,
                  mesh: Optional[Mesh] = None,
-                 end_trigger: Optional[Trigger] = None):
+                 end_trigger: Optional[Trigger] = None,
+                 sharding_rules: Optional["ShardingRules"] = None,
+                 batch_partition: Optional[P] = None):
         self.model = model
         self.dataset = dataset
         self.criterion = criterion
         self.optim_method = optim_method or SGD()
         self.mesh = mesh
+        # tensor/sequence/expert parallelism through the SAME builder entry
+        # (reference keeps one entry point for all training,
+        # optim/Optimizer.scala:47): `sharding_rules` maps parameter paths
+        # to PartitionSpecs (parallel/sharding.py), `batch_partition`
+        # overrides the default P('data') batch layout (e.g.
+        # P('data','sequence') for sequence-parallel token batches)
+        self.sharding_rules = sharding_rules
+        self.batch_partition = batch_partition
         self.end_when = end_trigger or Trigger.max_epoch(1)
         # validation
         self.val_trigger: Optional[Trigger] = None
@@ -149,7 +159,9 @@ class Optimizer:
     def _batch_sharding(self):
         if self.mesh is None:
             return None
-        return NamedSharding(self.mesh, P(AXIS_DATA))
+        return NamedSharding(self.mesh, self.batch_partition
+                             if self.batch_partition is not None
+                             else P(AXIS_DATA))
 
     def _replicated(self):
         if self.mesh is None:
@@ -221,9 +233,21 @@ class Optimizer:
             self._adopted_params = False
         if self.opt_state is None:
             self.opt_state = self.optim_method.init(self.params)
-        self.params = self._put_replicated(self.params)
-        self.model_state = self._put_replicated(self.model_state)
-        self.opt_state = self._put_replicated(self.opt_state)
+        if self.mesh is not None and self.sharding_rules is not None:
+            # tp/sp/ep layouts: params by rule, optimizer slots mirror the
+            # params' shardings, model state (BN stats) replicated — XLA
+            # propagates these through the jitted step and inserts the
+            # collectives (the declarative AllReduceParameter)
+            from bigdl_tpu.parallel.sharding import shard_opt_state, shard_params
+
+            self.params = shard_params(self.params, self.mesh, self.sharding_rules)
+            self.model_state = shard_params(self.model_state, self.mesh)
+            self.opt_state = shard_opt_state(self.opt_state, self.params,
+                                             self.mesh, self.sharding_rules)
+        else:
+            self.params = self._put_replicated(self.params)
+            self.model_state = self._put_replicated(self.model_state)
+            self.opt_state = self._put_replicated(self.opt_state)
 
     # ------------------------------------------------------------------
     # The loop (reference: optim/DistriOptimizer.scala:786 optimize())
@@ -433,9 +457,13 @@ class DistriOptimizer(Optimizer):
     def __init__(self, model: Module, dataset: DataSet, criterion: Criterion,
                  optim_method: Optional[OptimMethod] = None,
                  mesh: Optional[Mesh] = None,
-                 end_trigger: Optional[Trigger] = None):
+                 end_trigger: Optional[Trigger] = None,
+                 sharding_rules: Optional["ShardingRules"] = None,
+                 batch_partition: Optional[P] = None):
         super().__init__(model, dataset, criterion, optim_method,
-                         mesh=mesh or Engine.mesh(), end_trigger=end_trigger)
+                         mesh=mesh or Engine.mesh(), end_trigger=end_trigger,
+                         sharding_rules=sharding_rules,
+                         batch_partition=batch_partition)
 
 
 class ParallelOptimizer(DistriOptimizer):
@@ -462,6 +490,11 @@ class ParallelOptimizer(DistriOptimizer):
     """
 
     def optimize(self):
+        if self.sharding_rules is not None:
+            raise ValueError(
+                "ParallelOptimizer's per-leaf-collective shard_map step is "
+                "data-parallel only (params replicated); use DistriOptimizer "
+                "for sharding_rules-based tp/sp/ep")
         # sync-BN only while THIS trainer's shard_map step is being traced:
         # set the axis name for the run and restore afterwards, so the same
         # model can later train under plain jit (where a bound 'data' axis

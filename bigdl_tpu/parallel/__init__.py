@@ -1,7 +1,8 @@
 from bigdl_tpu.parallel.sharding import (
-    ShardingRules, shard_params, batch_sharding, replicate,
+    ShardingRules, shard_params, shard_opt_state, batch_sharding, replicate,
 )
 from bigdl_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 
-__all__ = ["ShardingRules", "shard_params", "batch_sharding", "replicate",
-           "pipeline_apply", "stack_stage_params"]
+__all__ = ["ShardingRules", "shard_params", "shard_opt_state",
+           "batch_sharding", "replicate", "pipeline_apply",
+           "stack_stage_params"]

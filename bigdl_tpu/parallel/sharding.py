@@ -55,6 +55,11 @@ class ShardingRules:
     def spec_for(self, path_str: str, ndim: int) -> P:
         for pat, spec in self.rules:
             if pat.search(path_str):
+                if len(spec) > ndim:
+                    raise ValueError(
+                        f"sharding rule {pat.pattern!r} -> {spec} has "
+                        f"{len(spec)} dims but parameter {path_str!r} has "
+                        f"only {ndim}")
                 return spec
         return P()  # replicate
 
@@ -69,6 +74,28 @@ def shard_params(params: Any, mesh: Mesh,
         spec = rules.spec_for(_path_str(path), np.ndim(leaf))
         out.append(jax.device_put(leaf, NamedSharding(mesh, spec)))
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def shard_opt_state(opt_state: Any, params: Any, mesh: Mesh,
+                    rules: Optional[ShardingRules] = None) -> Any:
+    """Shard optimizer state to MATCH the params' rules.
+
+    OptimMethod state is a dict of slot trees (velocity/exp_avg/...), each
+    mirroring the params pytree, plus scalar counters (neval/epoch).  Slot
+    subtrees whose structure equals the params' get the params' rules
+    (their in-subtree paths line up with parameter paths); anything else —
+    counters, schedule state — is replicated.
+    """
+    if not isinstance(opt_state, dict):
+        return replicate(opt_state, mesh)
+    params_def = jax.tree_util.tree_structure(params)
+    out = {}
+    for k, v in opt_state.items():
+        if jax.tree_util.tree_structure(v) == params_def:
+            out[k] = shard_params(v, mesh, rules)
+        else:
+            out[k] = replicate(v, mesh)
+    return out
 
 
 def batch_sharding(mesh: Mesh, axis: str = AXIS_DATA) -> NamedSharding:

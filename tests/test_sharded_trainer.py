@@ -1,0 +1,157 @@
+"""Tensor/sequence parallelism through the PUBLIC trainer API.
+
+Reference: optim/Optimizer.scala:47 — one builder entry point for all
+training.  Round-1 review finding: TP/SP/EP were demo-only (hand-written
+jitted steps).  These tests train tp- and sp-sharded models end-to-end via
+`DistriOptimizer(..., sharding_rules=...)` / Keras `fit` and assert both
+the placement (leaves actually sharded) and numeric parity with the
+replicated data-parallel run — the sharding layout must not change the
+math, only the layout.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import optim
+from bigdl_tpu.core.engine import AXIS_DATA, AXIS_MODEL, AXIS_SEQUENCE, Engine
+from bigdl_tpu.core.random import RandomGenerator
+from bigdl_tpu.dataset import ArrayDataSet, Sample, SampleToMiniBatch
+from bigdl_tpu.optim import SGD, Adam, Trigger
+from bigdl_tpu.parallel import ShardingRules
+
+
+def make_ds(n=128, dim=8, classes=4, batch=32, seed=0):
+    centers = np.random.RandomState(1234).randn(classes, dim).astype(np.float32) * 3
+    rs = np.random.RandomState(seed)
+    samples = [
+        Sample.from_ndarray(
+            centers[i % classes] + rs.randn(dim).astype(np.float32) * 0.3,
+            np.int32(i % classes))
+        for i in range(n)]
+    return ArrayDataSet(samples).transform(SampleToMiniBatch(batch))
+
+
+def mlp():
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4),
+                         nn.LogSoftMax())
+
+
+def train(mesh, rules):
+    RandomGenerator.set_seed(11)
+    model = mlp()
+    o = optim.DistriOptimizer(model, make_ds(), nn.ClassNLLCriterion(),
+                              optim_method=SGD(learning_rate=0.2, momentum=0.9,
+                                               dampening=0.0),
+                              mesh=mesh, sharding_rules=rules,
+                              end_trigger=Trigger.max_epoch(2))
+    o.optimize()
+    return o
+
+
+class TestShardedDistriOptimizer:
+    def test_dp_tp_via_builder_parity(self):
+        """dp+tp through DistriOptimizer == replicated dp, and the tp
+        leaves are genuinely sharded over 'model'."""
+        # Megatron-style: fc1 column-parallel, fc2 row-parallel
+        rules = (ShardingRules()
+                 .add(r"^0/weight$", P(None, AXIS_MODEL))
+                 .add(r"^0/bias$", P(AXIS_MODEL))
+                 .add(r"^2/weight$", P(AXIS_MODEL, None)))
+        mesh_tp = Engine.build_mesh(**{AXIS_DATA: 4, AXIS_MODEL: 2})
+        mesh_dp = Engine.build_mesh(**{AXIS_DATA: 8})
+
+        o_tp = train(mesh_tp, rules)
+        o_dp = train(mesh_dp, None)
+
+        # placement: fc1 weight split over 'model', opt velocity mirrors it
+        w = o_tp.params["0"]["weight"]
+        assert AXIS_MODEL in str(w.sharding.spec), w.sharding.spec
+        vel = o_tp.opt_state["velocity"]["0"]["weight"]
+        assert AXIS_MODEL in str(vel.sharding.spec), vel.sharding.spec
+
+        # parity: same seed, same math, different layout
+        for a, b in zip(jax.tree_util.tree_leaves(o_tp.params),
+                        jax.tree_util.tree_leaves(o_dp.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+        assert abs(o_tp._driver_state["loss"] - o_dp._driver_state["loss"]) < 1e-3
+
+    def test_transformer_dp_sp_tp_via_builder(self):
+        """TransformerLM with ring attention trained via DistriOptimizer:
+        dp x sp x tp mesh, token batch partitioned P('data','sequence'),
+        MLP tp-sharded — the round-1 __graft_entry__ demo as a user
+        program."""
+        from bigdl_tpu.models import TransformerLM
+
+        dp, sp, tp = 2, 2, 2
+        mesh = Engine.build_mesh(**{AXIS_DATA: dp, AXIS_SEQUENCE: sp,
+                                    AXIS_MODEL: tp})
+        vocab, seq_len, batch = 64, 16, 4
+        RandomGenerator.set_seed(5)
+        model = TransformerLM(vocab_size=vocab, hidden_size=32, n_layer=2,
+                              n_head=4, rope=True, seq_parallel="ring",
+                              scan_layers=True)
+        model.block.children["attn"].mesh = mesh
+
+        rs = np.random.RandomState(0)
+        toks = rs.randint(0, vocab, (64, seq_len + 1))
+        samples = [Sample.from_ndarray(t[:-1].astype(np.int32),
+                                       t[1:].astype(np.int32)) for t in toks]
+        ds = ArrayDataSet(samples).transform(SampleToMiniBatch(batch))
+
+        rules = (ShardingRules()
+                 .add(r"blocks/mlp/fc1/weight", P(None, None, AXIS_MODEL))
+                 .add(r"blocks/mlp/fc1/bias", P(None, AXIS_MODEL))
+                 .add(r"blocks/mlp/fc2/weight", P(None, AXIS_MODEL, None)))
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                           size_average=True)
+        o = optim.DistriOptimizer(
+            model, ds, crit, optim_method=Adam(learning_rate=1e-3),
+            mesh=mesh, sharding_rules=rules,
+            batch_partition=P(AXIS_DATA, AXIS_SEQUENCE),
+            end_trigger=Trigger.max_iteration(3))
+        o.optimize()
+        assert np.isfinite(o._driver_state["loss"])
+        fc1 = o.params["blocks"]["mlp"]["fc1"]["weight"]
+        assert AXIS_MODEL in str(fc1.sharding.spec), fc1.sharding.spec
+
+    def test_keras_fit_sharding_rules(self):
+        """Keras compile/fit carries sharding_rules down to the trainer."""
+        from bigdl_tpu import keras
+
+        mesh = Engine.build_mesh(**{AXIS_DATA: 4, AXIS_MODEL: 2})
+        rules = (ShardingRules()
+                 .add(r"weight$", P(None, AXIS_MODEL)))
+        m = keras.Sequential(keras.Dense(16, input_dim=8, activation="relu"),
+                             keras.Dense(4, activation="softmax"))
+        m.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+        rs = np.random.RandomState(0)
+        x = rs.rand(64, 8).astype(np.float32)
+        y = (np.arange(64) % 4).astype(np.int32)
+        m.fit(x, y, batch_size=32, nb_epoch=1, mesh=mesh,
+              sharding_rules=rules)
+        flat = jax.tree_util.tree_flatten_with_path(m.params)[0]
+        sharded = [p for p, leaf in flat
+                   if AXIS_MODEL in str(leaf.sharding.spec)]
+        assert sharded, "no keras param ended up tp-sharded"
+
+    def test_parallel_optimizer_rejects_rules(self):
+        import pytest
+
+        mesh = Engine.build_mesh(**{AXIS_DATA: 8})
+        o = optim.ParallelOptimizer(mlp(), make_ds(), nn.ClassNLLCriterion(),
+                                    mesh=mesh,
+                                    sharding_rules=ShardingRules())
+        with pytest.raises(ValueError, match="data-parallel only"):
+            o.optimize()
+
+    def test_rule_ndim_validation(self):
+        import pytest
+
+        rules = ShardingRules().add(r"^0/bias$", P(None, AXIS_MODEL))
+        mesh = Engine.build_mesh(**{AXIS_DATA: 4, AXIS_MODEL: 2})
+        with pytest.raises(ValueError, match="dims"):
+            train(mesh, rules)
