@@ -23,6 +23,16 @@ from bigdl_tpu.nn.module import Module
 from bigdl_tpu.nn.norm import LayerNormalization
 
 
+def _axis_bound(name: str) -> bool:
+    """True when `name` is a bound mesh axis in the current trace (i.e. we
+    are inside shard_map over it)."""
+    try:
+        lax.axis_index(name)
+        return True
+    except NameError:
+        return False
+
+
 class TransformerLM(Module):
     """Decoder-only LM over int32 token ids (B, S) -> log-probs (B, S, V)."""
 
@@ -32,6 +42,9 @@ class TransformerLM(Module):
                  seq_parallel: Optional[str] = None, scan_layers: bool = True,
                  remat: bool = False, use_flash: bool = True,
                  moe_experts: int = 0, moe_k: int = 1,
+                 pipeline_axis: Optional[str] = None,
+                 pipeline_microbatches: int = 4,
+                 pipeline_interleave: bool = False,
                  name: Optional[str] = None):
         super().__init__(name)
         self.vocab_size = vocab_size
@@ -43,6 +56,20 @@ class TransformerLM(Module):
         self.tie_embeddings = tie_embeddings
         self.scan_layers = scan_layers
         self.remat = remat
+        self.dropout = dropout
+        # pipeline parallelism (parallel/pipeline.py): when `pipeline_axis`
+        # is set AND bound (the trainer runs apply inside shard_map), the
+        # block stack executes as a GPipe/interleaved microbatch pipeline;
+        # embed/ln_f/head run outside the pipelined region, replicated over
+        # the pipeline axis (the scaling-book partitioning).  Outside
+        # shard_map (predict/eval on one device) apply falls back to the
+        # sequential scan, so params stay in model order everywhere.
+        self.pipeline_axis = pipeline_axis
+        self.pipeline_microbatches = pipeline_microbatches
+        self.pipeline_interleave = pipeline_interleave
+        if pipeline_axis is not None and not scan_layers:
+            raise ValueError("pipeline_axis requires scan_layers=True "
+                             "(stacked block params)")
         self.embed = LookupTable(vocab_size, hidden_size,
                                  weight_init=init_mod.RandomNormal(0.0, 0.02))
         self.block = TransformerBlock(hidden_size, n_head, causal=True,
@@ -88,7 +115,23 @@ class TransformerLM(Module):
             out, _ = blk.apply(layer_params, {}, h, training=training, rng=r)
             return (out, i + 1), None
 
-        if self.scan_layers:
+        if self.pipeline_axis is not None and _axis_bound(self.pipeline_axis):
+            if training and rng is not None and self.dropout > 0:
+                raise NotImplementedError(
+                    "dropout under pipeline parallelism is not supported "
+                    "yet; build the pipelined TransformerLM with dropout=0")
+            from bigdl_tpu.parallel.pipeline import pipeline_apply
+
+            def layer_fn(lp, hh):
+                out, _ = blk.apply(lp, {}, hh, training=training, rng=None)
+                return out
+
+            h = pipeline_apply(layer_fn, params["blocks"], h,
+                               n_microbatch=self.pipeline_microbatches,
+                               axis_name=self.pipeline_axis,
+                               remat=self.remat,
+                               interleave=self.pipeline_interleave)
+        elif self.scan_layers:
             fn = jax.checkpoint(body) if self.remat else body
             (h, _), _ = lax.scan(fn, (h, 0), params["blocks"])
         else:
@@ -102,6 +145,17 @@ class TransformerLM(Module):
 
     def output_shape(self, input_shape):
         return tuple(input_shape) + (self.vocab_size,)
+
+    def prepare_pipeline_params(self, params, n_stage: int):
+        """Trainer hook, called at the GLOBAL (jit) level before shard_map:
+        permutes the block stack into the interleaved schedule's layout
+        (parallel/pipeline.py interleave_stack).  Stored params stay in
+        model order, so checkpoints are layout-independent."""
+        if not self.pipeline_interleave:
+            return params
+        from bigdl_tpu.parallel.pipeline import interleave_stack
+
+        return dict(params, blocks=interleave_stack(params["blocks"], n_stage))
 
 
 def transformer_lm_small(vocab_size: int = 32000, **kw) -> TransformerLM:

@@ -188,7 +188,55 @@ class Optimizer:
         sched = self.optim_method.schedule
         return isinstance(sched, Plateau)
 
+    def _pipeline_axis(self) -> Optional[str]:
+        """The model's pipeline axis, when it is actually in this mesh."""
+        ax = getattr(self.model, "pipeline_axis", None)
+        if ax is not None and self.mesh is not None and ax in self.mesh.shape \
+                and self.mesh.shape[ax] > 1:
+            return ax
+        return None
+
+    def _pipeline_forward(self, training: bool):
+        """shard_map-wrapped model.apply for pipelined models: params enter
+        by their sharding_rules specs (the block stack P('pipeline')), the
+        batch by batch_partition; inside, the model runs its microbatch
+        schedule (models/transformer.py pipeline path).  Returns
+        fwd(params, model_state, x, rng) -> output, for use at jit level."""
+        import jax as _jax
+        from bigdl_tpu.parallel.sharding import spec_tree
+
+        model, mesh = self.model, self.mesh
+        ax = self._pipeline_axis()
+        n_stage = mesh.shape[ax]
+        batch_spec = self.batch_partition if self.batch_partition is not None \
+            else P(AXIS_DATA)
+        prepare = getattr(model, "prepare_pipeline_params", lambda p, n: p)
+
+        def fwd(params, model_state, x, rng):
+            p = prepare(params, n_stage)
+            specs = spec_tree(p, self.sharding_rules)
+            # without a rule mapping the block stack to P(pipeline_axis),
+            # every device would hold ALL layers and the schedule would
+            # silently apply the full stack n_stage times
+            if not any(ax in _flatten_spec_axes(s)
+                       for s in jax.tree_util.tree_leaves(
+                           specs, is_leaf=lambda v: isinstance(v, P))):
+                raise ValueError(
+                    f"pipelined model needs sharding_rules that place the "
+                    f"block stack on the {ax!r} mesh axis, e.g. "
+                    f"ShardingRules().add(r'^blocks/', P({ax!r}))")
+            sm = _jax.shard_map(
+                lambda p_, s_, x_, r_: model.apply(
+                    p_, s_, x_, training=training, rng=r_),
+                mesh=mesh, in_specs=(specs, P(), batch_spec, P()),
+                out_specs=(batch_spec, P()))
+            return sm(p, model_state, x, rng)
+
+        return fwd
+
     def _build_step(self):
+        if self._pipeline_axis() is not None:
+            return self._build_pipeline_step()
         model, criterion = self.model, self.criterion
         optim, processors = self.optim_method, list(self.processors)
         regs = collect_regularizers(model)
@@ -210,8 +258,46 @@ class Optimizer:
 
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
+    def _build_pipeline_step(self):
+        """Train step for a pipelined model: the forward runs inside
+        shard_map (GPipe/interleaved microbatch schedule over the
+        'pipeline' axis, parallel/pipeline.py); criterion, autodiff (which
+        transposes the schedule into the backward pipeline), gradient
+        processing and the optimizer update happen at the jit level where
+        XLA's sharding propagation places them."""
+        criterion = self.criterion
+        optim, processors = self.optim_method, list(self.processors)
+        regs = collect_regularizers(self.model)
+        fwd = self._pipeline_forward(training=True)
+
+        def train_step(params, model_state, opt_state, x, y, rng, lr):
+            def loss_fn(p):
+                out, new_state = fwd(p, model_state, x, rng)
+                return criterion.forward(out, y), new_state
+
+            (loss, new_model_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            grads = apply_regularizers(grads, params, regs)
+            for proc in processors:
+                grads = proc.process(grads)
+            new_params, new_opt_state = optim.step(
+                grads, params, opt_state, lr=(lr if self._host_lr() else None))
+            return new_params, new_model_state, new_opt_state, loss
+
+        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
     def _build_eval_step(self):
         model, methods = self.model, self.val_methods
+
+        if self._pipeline_axis() is not None:
+            fwd = self._pipeline_forward(training=False)
+            rng = jax.random.PRNGKey(0)
+
+            def eval_step(params, model_state, x, y):
+                out, _ = fwd(params, model_state, x, rng)
+                return [m.batch(out, y) for m in methods]
+
+            return jax.jit(eval_step)
 
         def eval_step(params, model_state, x, y):
             out, _ = model.apply(params, model_state, x, training=False)
@@ -433,6 +519,19 @@ class Optimizer:
         logger.info("Checkpoint saved to %s", d)
 
 
+def _flatten_spec_axes(spec) -> set:
+    """Mesh axis names referenced by a PartitionSpec."""
+    axes = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            axes.update(entry)
+        else:
+            axes.add(entry)
+    return axes
+
+
 def _shape_of_input(x) -> Any:
     if isinstance(x, (tuple, list)):
         return [tuple(np.asarray(v).shape) for v in x]
@@ -495,6 +594,10 @@ class ParallelOptimizer(DistriOptimizer):
                 "ParallelOptimizer's per-leaf-collective shard_map step is "
                 "data-parallel only (params replicated); use DistriOptimizer "
                 "for sharding_rules-based tp/sp/ep")
+        if self.batch_partition is not None:
+            raise ValueError(
+                "ParallelOptimizer shards the batch P('data') only; use "
+                "DistriOptimizer for a custom batch_partition")
         # sync-BN only while THIS trainer's shard_map step is being traced:
         # set the axis name for the run and restore afterwards, so the same
         # model can later train under plain jit (where a bound 'data' axis

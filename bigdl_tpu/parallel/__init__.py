@@ -1,8 +1,11 @@
 from bigdl_tpu.parallel.sharding import (
-    ShardingRules, shard_params, shard_opt_state, batch_sharding, replicate,
+    ShardingRules, shard_params, shard_opt_state, spec_tree, batch_sharding,
+    replicate,
 )
-from bigdl_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from bigdl_tpu.parallel.pipeline import (
+    pipeline_apply, stack_stage_params, interleave_stack, deinterleave_stack,
+)
 
-__all__ = ["ShardingRules", "shard_params", "shard_opt_state",
+__all__ = ["ShardingRules", "shard_params", "shard_opt_state", "spec_tree",
            "batch_sharding", "replicate", "pipeline_apply",
-           "stack_stage_params"]
+           "stack_stage_params", "interleave_stack", "deinterleave_stack"]

@@ -1,23 +1,38 @@
-"""GPipe-style pipeline parallelism over the `pipeline` mesh axis.
+"""Pipeline parallelism over the `pipeline` mesh axis.
 
 No reference counterpart — survey §2.10 records pipeline parallelism as
 absent from BigDL; this is beyond-reference TPU capability for models too
 large for one chip's HBM.
 
-Design (the scaling-book recipe): layer stages are STACKED on a leading
-dim sharded `P('pipeline')`, so under `shard_map` each device holds one
-stage's parameters.  The batch is split into M microbatches; the schedule
-runs M + S - 1 ticks of a `lax.scan`, each tick computing every stage on
-its in-flight microbatch and `ppermute`-ing activations one stage forward
-(the bubble is the standard (S-1)/(M+S-1) fraction).  Autodiff through
-the scan + ppermute yields the backward pipeline automatically — no
-hand-written 1F1B schedule; wrap the stage in `jax.checkpoint` (remat=True)
-to keep activation memory at one-microbatch-per-tick.
+Design (the scaling-book recipe): the model's REPEATED blocks are stacked
+on a leading dim sharded `P('pipeline')`, so under `shard_map` each device
+holds a contiguous group of layers.  Heterogeneous ends (embedding, final
+norm, LM head) run OUTSIDE the pipelined region, replicated over the
+pipeline axis — on an SPMD mesh every device executes the same program, so
+pipelining only the uniform block stack (and keeping the cheap ends
+data-parallel) is the idiomatic partitioning, not a limitation: the pytree
+per stage is "k transformer blocks", and embed/head stages need no relay
+slot of their own.
+
+Two schedules, both expressed as a `lax.scan` of compute + `ppermute`
+ticks so that JAX autodiff yields the backward pipeline automatically (no
+hand-written 1F1B backward; wrap stages in `jax.checkpoint` via
+`remat=True` to keep activation memory at one-microbatch-per-tick):
+
+  * GPipe (default): microbatch m enters stage 0 at tick m; each tick every
+    device applies its WHOLE local group (k layers).  Ticks = M + S - 1,
+    bubble (S-1)/(M+S-1) of k-layer ticks.
+  * Interleaved / circular (`interleave=True`): each of the k local layers
+    is its own virtual stage (v = k groups per device, V = S*v virtual
+    stages); microbatches travel the ring v times, one LAYER per tick, new
+    chunks of S microbatches injected as the previous chunk drains.
+    Ticks = M*v + S - 1 single-layer ticks vs GPipe's (M + S - 1)*v — the
+    fill/drain bubble shrinks by ~v, the Megatron interleaved-schedule
+    effect.  Requires S | M.
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable, Optional
 
 import jax
@@ -27,25 +42,38 @@ from jax import lax
 from bigdl_tpu.core.engine import AXIS_PIPELINE
 
 
+def _local_stack(stage_params: Any) -> int:
+    """Leading (local layer-group) dim of the per-device param stack."""
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    if not leaves:
+        raise ValueError("pipeline stage_params has no leaves")
+    k = leaves[0].shape[0]
+    for leaf in leaves:
+        if leaf.ndim < 1 or leaf.shape[0] != k:
+            raise ValueError(
+                f"stage_params leaves must share the leading stacked layer "
+                f"dim, got {leaf.shape} vs {k}")
+    return k
+
+
 def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                    stage_params: Any, x: jnp.ndarray, n_microbatch: int,
                    axis_name: str = AXIS_PIPELINE,
-                   remat: bool = False) -> jnp.ndarray:
-    """Run `stage_fn` as a pipeline over `axis_name`.  MUST be called
-    inside `shard_map` with `stage_params` carrying a leading
-    stage-stacked dim of size 1 per device (sharded `P(axis_name)`) and
-    `x` the full (replicated) batch whose leading dim splits into
-    `n_microbatch` equal microbatches.  Returns the pipeline output,
-    replicated to every stage.
+                   remat: bool = False,
+                   interleave: bool = False) -> jnp.ndarray:
+    """Run `stage_fn` (ONE layer: params-without-stack-dim, h -> h) as a
+    pipeline over `axis_name`.  MUST be called inside `shard_map` with
+    `stage_params` carrying a leading layer-stacked dim sharded
+    `P(axis_name)` (k >= 1 local layers per device) and `x` the full
+    (pipeline-replicated) batch whose leading dim splits into
+    `n_microbatch` equal microbatches.  Layers apply in global stacked
+    order: device d holds layers [d*k, (d+1)*k).  Returns the pipeline
+    output, replicated to every stage.
     """
     n_stage = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
-    for leaf in jax.tree_util.tree_leaves(stage_params):
-        assert leaf.shape[0] == 1, (
-            f"stage_params' local stacked dim is {leaf.shape[0]}, expected 1 "
-            f"per device — shard the stacked stage dim P({axis_name!r}) with "
-            f"exactly one stage per pipeline-axis device")
-    my_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+    k = _local_stack(stage_params)
+    my_params = stage_params
 
     b = x.shape[0]
     assert b % n_microbatch == 0, (b, n_microbatch)
@@ -53,14 +81,50 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     micro = x.reshape((n_microbatch, mb) + x.shape[1:])
 
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
-    # activation shape probe (stages must be shape-preserving so the relay
-    # buffer has one static shape; true of transformer blocks)
-    out_struct = jax.eval_shape(fn, my_params, jax.ShapeDtypeStruct(
+    # activation shape probe (pipelined layers must be shape-preserving so
+    # the relay buffer has one static shape; true of transformer blocks —
+    # shape-CHANGING ends like embed/head run outside the pipelined region)
+    probe_params = jax.tree_util.tree_map(lambda a: a[0], my_params)
+    out_struct = jax.eval_shape(fn, probe_params, jax.ShapeDtypeStruct(
         micro.shape[1:], micro.dtype))
     assert out_struct.shape == micro.shape[1:], (
-        f"pipeline stages must preserve activation shape, got "
+        f"pipelined layers must preserve activation shape, got "
         f"{out_struct.shape} vs {micro.shape[1:]}")
 
+    if interleave:
+        outputs = _interleaved_schedule(fn, my_params, micro, n_stage, idx,
+                                        axis_name, k)
+    else:
+        outputs = _gpipe_schedule(fn, my_params, micro, n_stage, idx,
+                                  axis_name, k)
+
+    # broadcast the last stage's collected outputs to every stage
+    outputs = lax.psum(
+        jnp.where(idx == n_stage - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name)
+    return outputs.reshape((b,) + x.shape[1:])
+
+
+def _apply_group(fn, my_params, h):
+    """Apply all k local layers in stacked order (one GPipe tick)."""
+    def body(h, layer_params):
+        return fn(layer_params, h), None
+
+    h, _ = lax.scan(body, h, my_params)
+    return h
+
+
+def _varying(axis_name, *arrays):
+    """Mark scan-carry init values as varying over the pipeline axis (the
+    body outputs depend on axis_index, so carry types must match)."""
+    pcast = getattr(lax, "pcast", None)
+    if pcast is None:
+        return arrays
+    return tuple(pcast(a, (axis_name,), to="varying") for a in arrays)
+
+
+def _gpipe_schedule(fn, my_params, micro, n_stage, idx, axis_name, k):
+    n_microbatch = micro.shape[0]
     fwd_perm = [(i, i + 1) for i in range(n_stage - 1)]
     n_tick = n_microbatch + n_stage - 1
 
@@ -70,7 +134,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
         # the relayed activation from the previous stage
         feed = micro[jnp.minimum(t, n_microbatch - 1)]
         inp = jnp.where(idx == 0, feed, relay)
-        out = fn(my_params, inp)
+        out = _apply_group(fn, my_params, inp)
         # the LAST stage finished microbatch t - (S-1) this tick
         done = t - (n_stage - 1)
         outputs = jnp.where(
@@ -81,27 +145,96 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
         relay = lax.ppermute(out, axis_name, fwd_perm)
         return (relay, outputs), None
 
-    # zeros_like(micro) inherits micro's varying axes (e.g. a data axis the
-    # batch is sharded over); the body's outputs additionally vary over the
-    # pipeline axis (they depend on axis_index), so cast that in too or the
-    # scan carry types won't match
-    relay0 = jnp.zeros_like(micro[0])
-    outputs0 = jnp.zeros_like(micro)
-    pcast = getattr(lax, "pcast", None)
-    if pcast is not None:
-        relay0 = pcast(relay0, (axis_name,), to="varying")
-        outputs0 = pcast(outputs0, (axis_name,), to="varying")
+    relay0, outputs0 = _varying(axis_name, jnp.zeros_like(micro[0]),
+                                jnp.zeros_like(micro))
     (_, outputs), _ = lax.scan(tick, (relay0, outputs0), jnp.arange(n_tick))
+    return outputs
 
-    # broadcast the last stage's collected outputs to every stage
-    outputs = lax.psum(
-        jnp.where(idx == n_stage - 1, outputs, jnp.zeros_like(outputs)),
-        axis_name)
-    return outputs.reshape((b,) + x.shape[1:])
+
+def _interleaved_schedule(fn, my_params, micro, n_stage, idx, axis_name, v):
+    """Circular schedule: v = k virtual stages per device, one LAYER per
+    tick, ring ppermute (stage S-1 wraps to stage 0).  Microbatch m (in
+    chunks of S) is injected at tick inj(m) = (m // S)*(v*S) + (m % S) and
+    occupies virtual stage vs = t - inj(m) at tick t — device vs % S, local
+    layer vs // S.  Closed form per (tick, device): r = (t - d) mod S is
+    the microbatch's index within its chunk, c = (t - r) // (v*S) its
+    chunk.  Chunk injections are spaced v*S ticks so ring slots never
+    collide.  Ticks = (M/S)*v*S + S - 1 = M*v + S - 1.
+    """
+    n_microbatch = micro.shape[0]
+    if n_microbatch % n_stage != 0:
+        raise ValueError(
+            f"interleaved pipeline needs n_microbatch ({n_microbatch}) "
+            f"divisible by pipeline size ({n_stage})")
+    ring_perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+    n_tick = n_microbatch * v + n_stage - 1
+
+    def tick(carry, t):
+        relay, outputs = carry
+        r = jnp.mod(t - idx, n_stage)          # index within chunk
+        c = (t - r) // (v * n_stage)            # chunk id
+        m = c * n_stage + r                     # global microbatch id
+        vs = (t - r) - c * (v * n_stage)        # virtual stage
+        g = jnp.clip(vs // n_stage, 0, v - 1)   # local layer index
+        active = (m >= 0) & (m < n_microbatch) & (vs >= 0) & (vs < v * n_stage)
+        layer_params = jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, g, 0, keepdims=False),
+            my_params)
+        feed = micro[jnp.clip(m, 0, n_microbatch - 1)]
+        inp = jnp.where(vs == 0, feed, relay)
+        out = fn(layer_params, inp)
+        # keep the relay clean on idle ticks so a microbatch's activation
+        # survives the ring hop even if schedule holes appear
+        out = jnp.where(active, out, relay)
+        finished = active & (idx == n_stage - 1) & (vs == v * n_stage - 1)
+        outputs = jnp.where(
+            finished,
+            lax.dynamic_update_index_in_dim(
+                outputs, out, jnp.clip(m, 0, n_microbatch - 1), axis=0),
+            outputs)
+        relay = lax.ppermute(out, axis_name, ring_perm)
+        return (relay, outputs), None
+
+    relay0, outputs0 = _varying(axis_name, jnp.zeros_like(micro[0]),
+                                jnp.zeros_like(micro))
+    (_, outputs), _ = lax.scan(tick, (relay0, outputs0), jnp.arange(n_tick))
+    return outputs
 
 
 def stack_stage_params(per_stage_params: list) -> Any:
-    """Stack a list of per-stage param trees on a new leading dim (shard it
-    `P('pipeline')`); the inverse of what each device's `tree_map(a[0])`
-    sees inside pipeline_apply."""
+    """Stack a list of per-layer param trees on a new leading dim (shard it
+    `P('pipeline')`); each device's shard is its local layer group inside
+    `pipeline_apply`."""
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def interleave_stack(stacked: Any, n_stage: int) -> Any:
+    """Permute a layer-stacked param tree (L, ...) from MODEL order into the
+    interleaved schedule's layout: virtual stage vs runs on device vs % S at
+    local slot vs // S, and `P('pipeline')` sharding gives device d the
+    contiguous slice [d*k, (d+1)*k) — so physical[d*k + j] must hold logical
+    layer j*S + d.  That is a (v, S) -> (S, v) transpose of the leading dim.
+    Call at the GLOBAL (jit) level, before entering shard_map; gradients
+    flow back through the permutation automatically."""
+
+    def perm(a):
+        L = a.shape[0]
+        if L % n_stage != 0:
+            raise ValueError(f"layer count {L} not divisible by {n_stage} stages")
+        v = L // n_stage
+        return a.reshape((v, n_stage) + a.shape[1:]).swapaxes(0, 1) \
+                .reshape((L,) + a.shape[1:])
+
+    return jax.tree_util.tree_map(perm, stacked)
+
+
+def deinterleave_stack(stacked: Any, n_stage: int) -> Any:
+    """Inverse of `interleave_stack` (schedule layout back to model order)."""
+
+    def perm(a):
+        L = a.shape[0]
+        v = L // n_stage
+        return a.reshape((n_stage, v) + a.shape[1:]).swapaxes(0, 1) \
+                .reshape((L,) + a.shape[1:])
+
+    return jax.tree_util.tree_map(perm, stacked)

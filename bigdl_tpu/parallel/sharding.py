@@ -98,6 +98,17 @@ def shard_opt_state(opt_state: Any, params: Any, mesh: Mesh,
     return out
 
 
+def spec_tree(params: Any, rules: Optional[ShardingRules] = None) -> Any:
+    """PartitionSpec pytree matching `params` leaf-for-leaf (rule-matched
+    leaves get their rule's spec, everything else P()) — the form
+    `jax.shard_map` in_specs wants."""
+    rules = rules or ShardingRules()
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    specs = [rules.spec_for(_path_str(path), np.ndim(leaf))
+             for path, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
 def batch_sharding(mesh: Mesh, axis: str = AXIS_DATA) -> NamedSharding:
     """Shard dim 0 (batch) over the data axis; rest replicated."""
     return NamedSharding(mesh, P(axis))

@@ -16,11 +16,15 @@ D = 6
 
 
 def _stages(seed=0):
+    return _stages_n(N_STAGE, seed)
+
+
+def _stages_n(n_layer, seed=0):
     rs = np.random.RandomState(seed)
-    per_stage = [{"w": jnp.asarray(rs.randn(D, D).astype(np.float32) * 0.5),
+    per_layer = [{"w": jnp.asarray(rs.randn(D, D).astype(np.float32) * 0.5),
                   "b": jnp.asarray(rs.randn(D).astype(np.float32) * 0.1)}
-                 for _ in range(N_STAGE)]
-    return per_stage, stack_stage_params(per_stage)
+                 for _ in range(n_layer)]
+    return per_layer, stack_stage_params(per_layer)
 
 
 def stage_fn(p, x):
@@ -102,6 +106,85 @@ class TestPipelineApply:
         np.testing.assert_allclose(np.asarray(y),
                                    np.asarray(sequential_ref(per_stage, x)),
                                    rtol=1e-5, atol=1e-5)
+
+    def test_multi_layer_local_groups(self):
+        """8 layers over 4 devices (k=2 local layers per stage) must equal
+        the 8-layer sequential forward — the lifted one-layer-per-device
+        restriction."""
+        per_layer, stacked = _stages_n(8, seed=6)
+        mesh = Engine.build_mesh(devices=jax.devices()[:N_STAGE],
+                                 **{AXIS_PIPELINE: N_STAGE})
+        x = jnp.asarray(np.random.RandomState(6).rand(8, D), jnp.float32)
+        fn = jax.jit(jax.shard_map(
+            lambda p, x: pipeline_apply(stage_fn, p, x, n_microbatch=4),
+            mesh=mesh, in_specs=(P(AXIS_PIPELINE), P()), out_specs=P()))
+        np.testing.assert_allclose(np.asarray(fn(stacked, x)),
+                                   np.asarray(sequential_ref(per_layer, x)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_interleaved_matches_sequential(self):
+        """Circular/interleaved schedule (one layer per tick, v=2 virtual
+        stages per device, schedule-layout params) == sequential forward."""
+        from bigdl_tpu.parallel import interleave_stack, deinterleave_stack
+
+        per_layer, stacked = _stages_n(8, seed=7)
+        mesh = Engine.build_mesh(devices=jax.devices()[:N_STAGE],
+                                 **{AXIS_PIPELINE: N_STAGE})
+        x = jnp.asarray(np.random.RandomState(7).rand(8, D), jnp.float32)
+        sched = interleave_stack(stacked, N_STAGE)
+        # layout roundtrip
+        back = deinterleave_stack(sched, N_STAGE)
+        np.testing.assert_array_equal(np.asarray(back["w"]),
+                                      np.asarray(stacked["w"]))
+        for m in (4, 8):  # S | M required
+            fn = jax.jit(jax.shard_map(
+                lambda p, x, m=m: pipeline_apply(stage_fn, p, x,
+                                                 n_microbatch=m,
+                                                 interleave=True),
+                mesh=mesh, in_specs=(P(AXIS_PIPELINE), P()), out_specs=P()))
+            np.testing.assert_allclose(
+                np.asarray(fn(sched, x)),
+                np.asarray(sequential_ref(per_layer, x)),
+                rtol=1e-5, atol=1e-5, err_msg=f"n_microbatch={m}")
+
+    def test_interleaved_gradients_match(self):
+        from bigdl_tpu.parallel import interleave_stack
+
+        per_layer, stacked = _stages_n(8, seed=8)
+        mesh = Engine.build_mesh(devices=jax.devices()[:N_STAGE],
+                                 **{AXIS_PIPELINE: N_STAGE})
+        x = jnp.asarray(np.random.RandomState(8).rand(8, D), jnp.float32)
+        y_t = jnp.asarray(np.random.RandomState(9).rand(8, D), jnp.float32)
+
+        def piped_loss(stacked):
+            sched = interleave_stack(stacked, N_STAGE)
+            fn = jax.shard_map(
+                lambda p, x: pipeline_apply(stage_fn, p, x, n_microbatch=4,
+                                            remat=True, interleave=True),
+                mesh=mesh, in_specs=(P(AXIS_PIPELINE), P()), out_specs=P())
+            return jnp.mean((fn(sched, x) - y_t) ** 2)
+
+        def seq_loss(per_layer):
+            return jnp.mean((sequential_ref(per_layer, x) - y_t) ** 2)
+
+        g_pipe = jax.jit(jax.grad(piped_loss))(stacked)
+        g_seq = jax.grad(seq_loss)(per_layer)
+        for i in range(8):
+            np.testing.assert_allclose(np.asarray(g_pipe["w"][i]),
+                                       np.asarray(g_seq[i]["w"]),
+                                       rtol=1e-4, atol=1e-5, err_msg=f"layer {i}")
+
+    def test_interleaved_rejects_bad_microbatch(self):
+        _, stacked = _stages_n(8)
+        mesh = Engine.build_mesh(devices=jax.devices()[:N_STAGE],
+                                 **{AXIS_PIPELINE: N_STAGE})
+        x = jnp.ones((6, D))
+        with pytest.raises(ValueError, match="divisible"):
+            jax.shard_map(
+                lambda p, x: pipeline_apply(stage_fn, p, x, n_microbatch=3,
+                                            interleave=True),
+                mesh=mesh, in_specs=(P(AXIS_PIPELINE), P()), out_specs=P())(
+                stacked, x)
 
     def test_rejects_shape_changing_stage(self):
         _, stacked = _stages()
