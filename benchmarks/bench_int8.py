@@ -1,0 +1,121 @@
+"""Int8 inference benchmark: where quantization PAYS on TPU.
+
+Reference premise: int8 exists to be fast (nn/quantized/Quantizer.scala:
+27-32, BigQuant MixPrecisionGEMM).  Round-1 finding: dynamic int8 was ~8%
+SLOWER than fp32 on ResNet-50 (per-layer activation abs-max reduces on an
+HBM-bound model).  This harness measures all modes on the two headline
+workloads:
+
+  * ResNet-50 batch-256 inference: bf16 vs int8 dynamic vs int8 static
+    (calibrated scales — no runtime reduce) vs weight-only.
+  * TransformerLM single-token decode step (batch 8): bf16 vs weight-only
+    int8 — bandwidth-bound, weights dominate HBM traffic, int8 halves it.
+
+Run on the TPU:
+  PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/bench_int8.py
+
+Prints one json line per (workload, mode) with ms/step and speedup vs the
+bf16 baseline of that workload.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def _sync(v):
+    # through the remote-TPU tunnel block_until_ready returns early; a
+    # host readback on a value depending on the computation is the sync
+    import jax.numpy as jnp
+
+    return float(jnp.sum(v.astype(jnp.float32)))
+
+
+def _time_fn(fn, *args, warmup=3, iters=20):
+    for _ in range(warmup):
+        out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+
+def bench_resnet():
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models import resnet50
+
+    batch, image, classes = 256, 224, 1000
+    model = resnet50(classes)
+    shape = (batch, image, image, 3)
+    params, state, _ = model.build(jax.random.PRNGKey(0), shape)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(*shape), jnp.bfloat16)
+
+    results = {}
+
+    p16 = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16), params)
+    fwd16 = jax.jit(lambda p, s, x: model.apply(p, s, x, training=False)[0])
+    results["bf16"] = _time_fn(fwd16, p16, state, x)
+
+    for mode in ("dynamic", "static", "weight_only"):
+        qm, qp = nn.quantize(model, params, mode=mode)
+        if mode == "static":
+            t0 = time.perf_counter()
+            qp = nn.calibrate(qm, qp, state,
+                              [jnp.asarray(rs.rand(8, image, image, 3),
+                                           jnp.float32)])
+            print(f"# calibration took {time.perf_counter() - t0:.1f}s",
+                  flush=True)
+        qfwd = jax.jit(lambda p, s, x, qm=qm: qm.apply(p, s, x,
+                                                       training=False)[0])
+        results[mode] = _time_fn(qfwd, qp, state, x)
+
+    for mode, ms in results.items():
+        print(json.dumps({
+            "workload": "resnet50_b256_infer", "mode": mode,
+            "ms_per_step": round(ms, 2),
+            "speedup_vs_bf16": round(results["bf16"] / ms, 3)}), flush=True)
+    return results
+
+
+def bench_decode():
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.nn.quantized import WeightOnlyInt8
+
+    vocab, hidden, layers, heads, batch = 32000, 1024, 12, 16, 8
+    model = TransformerLM(vocab_size=vocab, hidden_size=hidden,
+                          n_layer=layers, n_head=heads, use_flash=False,
+                          scan_layers=True)
+    params, state, _ = model.build(jax.random.PRNGKey(0), (batch, 1))
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, vocab, (batch, 1)))
+
+    results = {}
+    p16 = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16), params)
+    fwd16 = jax.jit(lambda p, s, x: model.apply(p, s, x, training=False)[0])
+    results["bf16"] = _time_fn(fwd16, p16, state, toks, iters=50)
+
+    qm, qp = WeightOnlyInt8.from_float(model, params,
+                                       compute_dtype=jnp.bfloat16)
+    qfwd = jax.jit(lambda p, s, x: qm.apply(p, s, x, training=False)[0])
+    results["weight_only"] = _time_fn(qfwd, qp, state, toks, iters=50)
+
+    for mode, ms in results.items():
+        print(json.dumps({
+            "workload": "transformer_lm_decode_b8", "mode": mode,
+            "ms_per_step": round(ms, 3),
+            "speedup_vs_bf16": round(results["bf16"] / ms, 3)}), flush=True)
+    return results
+
+
+if __name__ == "__main__":
+    bench_decode()
+    bench_resnet()

@@ -154,6 +154,8 @@ from bigdl_tpu.nn.moe import MoE
 from bigdl_tpu.nn.quantized import (
     QuantizedLinear,
     QuantizedSpatialConvolution,
+    WeightOnlyInt8,
+    calibrate,
     quantize,
 )
 from bigdl_tpu.nn import ops

@@ -160,3 +160,67 @@ class TestQuantizeImportedModels:
         yq, _ = qg.apply(qp, s, x)
         assert int(jnp.argmax(y)) == int(jnp.argmax(yq))
         assert float(jnp.max(jnp.abs(y - yq))) < 0.05
+
+
+class TestStaticAndWeightOnly:
+    def test_static_mode_calibrate(self, rng):
+        """static scales from calibrate() ~= dynamic quantization quality,
+        and the compiled static forward has no runtime absmax reduce."""
+        model = nn.Sequential(
+            nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1), nn.ReLU(),
+            nn.Flatten(), nn.Linear(8 * 6 * 6, 10), nn.LogSoftMax())
+        params, state, _ = model.build(rng, (2, 6, 6, 3))
+        x = jax.random.normal(jax.random.fold_in(rng, 1), (8, 6, 6, 3))
+        want, _ = model.apply(params, state, x)
+
+        qm, qp = nn.quantize(model, params, mode="static")
+        # un-calibrated static scale is a placeholder 1.0
+        conv_p = qp["0"]
+        assert float(conv_p["x_scale"]) == 1.0
+        qp = nn.calibrate(qm, qp, state, [x[:4], x[4:]])
+        assert float(qp["0"]["x_scale"]) != 1.0
+        got, _ = qm.apply(qp, state, x)
+        rel = float(jnp.linalg.norm(got - want) / jnp.linalg.norm(want))
+        assert rel < 0.05, rel
+
+    def test_weight_only_mode(self, rng):
+        layer = nn.Linear(64, 32)
+        params, state, _ = layer.build(rng, (4, 64))
+        x = jax.random.normal(jax.random.fold_in(rng, 1), (4, 64))
+        want, _ = layer.apply(params, state, x)
+        qlayer, qparams = nn.QuantizedLinear.from_float(layer, params,
+                                                        mode="weight_only")
+        got, _ = qlayer.apply(qparams, {}, x)
+        rel = float(jnp.linalg.norm(got - want) / jnp.linalg.norm(want))
+        assert rel < 0.01, rel
+
+    def test_weight_only_wrapper_transformer(self, rng):
+        """WeightOnlyInt8 wraps a whole TransformerLM: int8 leaves, close
+        log-probs, and the param bytes shrink ~4x for the big matrices."""
+        from bigdl_tpu.models import TransformerLM
+        from bigdl_tpu.nn.quantized import WeightOnlyInt8
+
+        model = TransformerLM(vocab_size=128, hidden_size=32, n_layer=2,
+                              n_head=4, use_flash=False)
+        params, state, _ = model.build(rng, (2, 8))
+        toks = jnp.asarray(np.random.RandomState(0).randint(0, 128, (2, 8)))
+        want, _ = model.apply(params, state, toks)
+
+        qm, qp = WeightOnlyInt8.from_float(model, params, min_size=256)
+        flat = jax.tree_util.tree_leaves(qp)
+        assert any(l.dtype == jnp.int8 for l in flat)
+        got, _ = qm.apply(qp, state, toks)
+        # log-softmax outputs: compare probabilities
+        diff = float(jnp.max(jnp.abs(jnp.exp(got) - jnp.exp(want))))
+        assert diff < 0.05, diff
+
+        def nbytes(t):
+            return sum(l.size * l.dtype.itemsize
+                       for l in jax.tree_util.tree_leaves(t))
+        assert nbytes(qp) < 0.45 * nbytes(params)
+
+    def test_quantize_rejects_bad_mode(self, rng):
+        layer = nn.Linear(8, 4)
+        params, _, _ = layer.build(rng, (2, 8))
+        with pytest.raises(ValueError, match="mode"):
+            nn.quantize(layer, params, mode="int4")
