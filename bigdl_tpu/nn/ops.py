@@ -574,6 +574,58 @@ class IsNan(_Elementwise):
     _fn = staticmethod(jnp.isnan)
 
 
+class Ceil(_Elementwise):
+    """reference: utils/tf/loaders/Ceil.scala."""
+    _fn = staticmethod(jnp.ceil)
+
+
+class TruncateMod(Operation):
+    """C-style remainder (sign follows dividend) — TF TruncateMod.
+    reference: utils/tf/loaders/TruncateMod.scala."""
+
+    def compute(self, x):
+        a, b = _pair(x)
+        a, b = jnp.asarray(a), jnp.asarray(b)
+        return a - b * (jnp.sign(a) * jnp.sign(b) *
+                        (jnp.abs(a) // jnp.abs(b))).astype(a.dtype)
+
+
+class Pack(Operation):
+    """Stack N inputs on a new `axis` (TF Pack/stack).
+    reference: utils/tf/loaders/Pack.scala -> nn/ops (Stack)."""
+
+    def __init__(self, axis: int = 0, name: Optional[str] = None):
+        super().__init__(name)
+        self.axis = axis
+
+    def compute(self, x):
+        parts = [jnp.asarray(v) for v in (list(x) if isinstance(x, Table)
+                                          else [x])]
+        return jnp.stack(parts, axis=self.axis)
+
+
+class UnpackSelect(Operation):
+    """Output k of TF Unpack (unstack): take index k along `axis` and drop
+    the axis. reference: utils/tf/loaders/Unpack.scala."""
+
+    def __init__(self, axis: int, index: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.axis, self.index = axis, index
+
+    def compute(self, x):
+        return jnp.take(jnp.asarray(x), self.index, axis=self.axis)
+
+
+class SoftmaxGradOp(Operation):
+    """Second output of SoftmaxCrossEntropyWithLogits: softmax(logits) -
+    labels (the backprop tensor TF materializes).
+    reference: utils/tf/loaders/SoftmaxCrossEntropyWithLogits.scala."""
+
+    def compute(self, x):
+        logits, labels = _pair(x)
+        return jax.nn.softmax(jnp.asarray(logits), axis=-1) - jnp.asarray(labels)
+
+
 class Pow(Operation):
     """{base, exponent} -> base ** exponent. reference: nn/ops/Pow.scala."""
 

@@ -115,6 +115,24 @@ class _TFImporter:
             return arr
         if nd.op == "Identity":  # frozen variables are Identity(Const)
             return self.const_of(nd.input[0])
+        if nd.op == "Fill":  # constant-operand Fill folds
+            dims = tuple(int(v) for v in
+                         self.const_of(nd.input[0]).reshape(-1))
+            val = np.asarray(self.const_of(nd.input[1]))
+            arr = np.full(dims, val.item(), val.dtype)
+            self.consts[name] = arr
+            return arr
+        if nd.op == "Range":
+            start, limit, delta = [np.asarray(self.const_of(i)).item()
+                                   for i in nd.input[:3]]
+            arr = np.arange(start, limit, delta)
+            self.consts[name] = arr
+            return arr
+        if nd.op == "Cast":  # const dtype conversion folds
+            arr = self.const_of(nd.input[0]).astype(
+                _NP_DTYPES.get(nd.attr["DstT"].type, np.float32))
+            self.consts[name] = arr
+            return arr
         raise ValueError(f"expected Const, got {nd.op} for {name}")
 
     def _key(self, ref: str) -> str:
@@ -565,6 +583,178 @@ class _TFImporter:
                              _tf.SplitAndSelect(axis, kth, num,
                                                 name=f"{name}_{kth}"),
                              [value])
+        elif op == "AddN":
+            for di in data_inputs:
+                if self._key(di) not in self.graph_nodes:
+                    self._ensure_node(di, anchor=graph_in[0])
+            self._attach(name, nn.CAddTable(name=name), data_inputs)
+        elif op in ("All", "Any"):
+            dims = self.const_of(data_inputs[1]).reshape(-1).tolist()
+            if len(dims) != 1:
+                raise ValueError(f"{op} over dims {dims} unsupported")
+            cls = nn.ops.All if op == "All" else nn.ops.Any
+            self._attach(name, cls(int(dims[0]),
+                                   keep_dims=bool(nd.attr["keep_dims"].b),
+                                   name=name), [data_inputs[0]])
+        elif op in ("Ceil", "Sign", "Erfc", "Lgamma", "Digamma", "IsFinite",
+                    "IsInf", "IsNan", "LogicalNot"):
+            cls = {"Ceil": nn.ops.Ceil, "Sign": nn.ops.Sign,
+                   "Erfc": nn.ops.Erfc, "Lgamma": nn.ops.Lgamma,
+                   "Digamma": nn.ops.Digamma, "IsFinite": nn.ops.IsFinite,
+                   "IsInf": nn.ops.IsInf, "IsNan": nn.ops.IsNan,
+                   "LogicalNot": nn.ops.LogicalNot}[op]
+            self._attach(name, cls(name=name), [data_inputs[0]])
+        elif op in ("Reciprocal", "Inv"):
+            self._attach(name, nn.Power(-1.0, name=name), [data_inputs[0]])
+        elif op in ("FloorDiv", "FloorMod", "Mod", "TruncateMod",
+                    "TruncateDiv", "LogicalAnd", "LogicalOr", "NotEqual",
+                    "ApproximateEqual"):
+            for di in data_inputs[:2]:
+                if self._key(di) not in self.graph_nodes:
+                    self._ensure_node(di, anchor=graph_in[0])
+            cls = {"FloorDiv": nn.ops.FloorDiv, "FloorMod": nn.ops.FloorMod,
+                   # TF Mod on floats follows the divisor sign (floormod)
+                   "Mod": nn.ops.FloorMod,
+                   "TruncateMod": nn.ops.TruncateMod,
+                   "TruncateDiv": nn.ops.TruncateDiv,
+                   "LogicalAnd": nn.ops.LogicalAnd,
+                   "LogicalOr": nn.ops.LogicalOr,
+                   "NotEqual": nn.ops.NotEqual,
+                   "ApproximateEqual": nn.ops.ApproximateEqual}[op]
+            kw = {}
+            if op == "ApproximateEqual" and "tolerance" in nd.attr:
+                kw["tolerance"] = float(nd.attr["tolerance"].f)
+            self._attach(name, cls(name=name, **kw), data_inputs[:2])
+        elif op == "Fill":
+            from bigdl_tpu.nn import tf_ops as _tf
+
+            try:  # both operands const: fold
+                dims = tuple(int(v) for v in
+                             self.const_of(data_inputs[0]).reshape(-1))
+                val = self.const_of(data_inputs[1])
+                self.consts[name] = np.full(dims, np.asarray(val).item(),
+                                            np.asarray(val).dtype)
+                return
+            except (ValueError, KeyError):
+                pass
+            for di in data_inputs[:2]:
+                if self._key(di) not in self.graph_nodes:
+                    self._ensure_node(di, anchor=graph_in[0])
+            self._attach(name, _tf.Fill(name=name), data_inputs[:2])
+        elif op == "Range":
+            start, limit, delta = [np.asarray(self.const_of(i)).item()
+                                   for i in data_inputs[:3]]
+            self.consts[name] = np.arange(start, limit, delta)
+            return
+        elif op == "Pack":
+            axis = int(nd.attr["axis"].i) if "axis" in nd.attr else 0
+            for di in data_inputs:
+                if self._key(di) not in self.graph_nodes:
+                    self._ensure_node(di, anchor=graph_in[0])
+            self._attach(name, nn.ops.Pack(axis, name=name), data_inputs)
+        elif op == "Unpack":
+            axis = int(nd.attr["axis"].i) if "axis" in nd.attr else 0
+            num = int(nd.attr["num"].i)
+            for kth in range(num):
+                self._attach(f"{name}:{kth}" if kth else name,
+                             nn.ops.UnpackSelect(axis, kth,
+                                                 name=f"{name}_{kth}"),
+                             [data_inputs[0]])
+        elif op in ("TopKV2", "TopK"):
+            if op == "TopKV2":
+                k = int(self.const_of(data_inputs[1]))
+            else:
+                k = int(nd.attr["k"].i)
+            self._attach(name, nn.ops.TopK(k, name=name), [data_inputs[0]])
+            # outputs: values (:0) and indices (:1) via (1-based) selection
+            from bigdl_tpu.nn.table_ops import SelectTable
+
+            top_node = self.graph_nodes[name]
+            for kth, key in ((1, name), (2, f"{name}:1")):
+                sel = SelectTable(kth, name=f"{name}_out{kth}")(top_node)
+                self.graph_nodes[key] = sel
+                self.shapes[key] = tuple(bshape[:-1]) + (k,)
+        elif op in ("InTopK", "InTopKV2"):
+            if op == "InTopKV2":  # k arrives as the third input
+                k = int(self.const_of(data_inputs[2]))
+            else:
+                k = int(nd.attr["k"].i)
+            for di in data_inputs[:2]:
+                if self._key(di) not in self.graph_nodes:
+                    self._ensure_node(di, anchor=graph_in[0])
+            self._attach(name, nn.ops.InTopK(k, name=name), data_inputs[:2])
+        elif op == "L2Loss":
+            self._attach(name, nn.ops.L2Loss(name=name), [data_inputs[0]])
+        elif op == "SegmentSum":
+            for di in data_inputs[:2]:
+                if self._key(di) not in self.graph_nodes:
+                    self._ensure_node(di, anchor=graph_in[0])
+            self._attach(name, nn.ops.SegmentSum(name=name), data_inputs[:2])
+        elif op == "SoftmaxCrossEntropyWithLogits":
+            for di in data_inputs[:2]:
+                if self._key(di) not in self.graph_nodes:
+                    self._ensure_node(di, anchor=graph_in[0])
+            self._attach(name, nn.ops.CrossEntropyOp(name=name),
+                         data_inputs[:2])
+            # output :1 is the backprop tensor softmax(logits) - labels
+            self._attach(f"{name}:1", nn.ops.SoftmaxGradOp(name=f"{name}_grad"),
+                         data_inputs[:2])
+        elif op == "Conv3D":
+            w = self.const_of(data_inputs[1])  # DHWIO
+            kd, kh, kw_, in_c, out_c = w.shape
+            strides = list(nd.attr["strides"].list.i) or [1] * 5
+            pad = nd.attr["padding"].s.decode() if nd.attr["padding"].s \
+                else "VALID"
+            conv_input = data_inputs[0]
+            if pad == "SAME":
+                # TF SAME is asymmetric for stride > 1: explicit zero-pad
+                # then a VALID conv reproduces it exactly
+                pads = [(0, 0)]
+                for dim, k, s in zip(bshape[1:4], (kd, kh, kw_),
+                                     (strides[1], strides[2], strides[3])):
+                    total = max(0, (-(-dim // s) - 1) * s + k - dim)
+                    pads.append((total // 2, total - total // 2))
+                pads.append((0, 0))
+                if any(p != (0, 0) for p in pads):
+                    pname = f"{name}_samepad"
+                    self._attach(pname, nn.ops.Pad(pads, name=pname),
+                                 [conv_input])
+                    conv_input = pname
+            m = nn.VolumetricConvolution(
+                in_c, out_c, kd, kw_, kh, strides[1], strides[3], strides[2],
+                0, 0, 0, with_bias=False, name=name)
+            self._attach(name, m, [conv_input], {"weight": w})
+        elif op == "RandomUniform":
+            seed = int(nd.attr["seed"].i) if "seed" in nd.attr else 0
+            if self._key(data_inputs[0]) not in self.graph_nodes:
+                self._ensure_node(data_inputs[0], anchor=graph_in[0])
+            self._attach(name, nn.ops.RandomUniformOp(seed=seed, name=name),
+                         [data_inputs[0]])
+        elif op in ("DecodeJpeg", "DecodePng", "DecodeBmp", "DecodeGif"):
+            from bigdl_tpu.nn import tf_ops as _tf
+
+            channels = int(nd.attr["channels"].i) if "channels" in nd.attr else 0
+            cls = getattr(_tf, op)
+            self._attach(name, cls(channels, name=name), [data_inputs[0]])
+        elif op == "DecodeRaw":
+            from bigdl_tpu.nn import tf_ops as _tf
+
+            out_t = _NP_DTYPES.get(nd.attr["out_type"].type, np.uint8)
+            little = bool(nd.attr["little_endian"].b) \
+                if "little_endian" in nd.attr else True
+            self._attach(name, _tf.DecodeRaw(out_t, little, name=name),
+                         [data_inputs[0]])
+        elif op == "Dilation2D":
+            strides = list(nd.attr["strides"].list.i) or [1, 1, 1, 1]
+            rates = list(nd.attr["rates"].list.i) or [1, 1, 1, 1]
+            pad = nd.attr["padding"].s.decode() if nd.attr["padding"].s \
+                else "VALID"
+            for di in data_inputs[:2]:
+                if self._key(di) not in self.graph_nodes:
+                    self._ensure_node(di, anchor=graph_in[0])
+            self._attach(name, nn.ops.Dilation2D(
+                strides=strides, rates=rates, padding=pad, name=name),
+                data_inputs[:2])
         else:
             raise ValueError(
                 f"unsupported TF op {op!r} at node {name!r} "
@@ -611,9 +801,10 @@ def load_tensorflow(pb_path: str, inputs: Sequence[str],
                 # a data input whose producer is a real op (not a foldable
                 # const/identity/placeholder) that hasn't been converted yet
                 return (name not in imp.graph_nodes
+                        and name not in imp.consts
                         and name in imp.nodes_by_name
                         and imp.nodes_by_name[name].op not in
-                        ("Const", "Identity", "Placeholder"))
+                        ("Const", "Identity", "Placeholder", "Fill", "Range"))
 
             if needs_graph_input and any(unresolved(i) for i in data_in):
                 deferred.append(node)

@@ -1,0 +1,170 @@
+"""Differential tests (vs REAL TensorFlow) for the long-tail importer ops
+added in round 2: AddN, All/Any, Ceil/Sign/Reciprocal, FloorDiv/FloorMod/
+TruncateMod/TruncateDiv, logical ops, NotEqual, Fill/Range folding,
+Pack/Unpack, TopKV2 (both outputs), InTopK, L2Loss, SegmentSum,
+SoftmaxCrossEntropyWithLogits, Conv3D, Dilation2D.
+
+Reference parity target: utils/tf/loaders/ (161 per-op loaders)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+tf = pytest.importorskip("tensorflow")
+
+from tensorflow.python.framework.convert_to_constants import (  # noqa: E402
+    convert_variables_to_constants_v2)
+
+from bigdl_tpu.utils.tensorflow import load_tensorflow  # noqa: E402
+
+
+def freeze(fn, spec, dtype=tf.float32):
+    cf = fn.get_concrete_function(tf.TensorSpec(spec, dtype))
+    return convert_variables_to_constants_v2(cf).graph.as_graph_def()
+
+
+def run_import(fn, x, out_op, tmp_path, dtype=tf.float32):
+    gd = freeze(fn, x.shape, dtype)
+    pb = str(tmp_path / "g.pb")
+    with open(pb, "wb") as fh:
+        fh.write(gd.SerializeToString())
+    inp = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    outs = [n.name for n in gd.node if n.op == out_op]
+    assert outs, f"no {out_op} node in {sorted({n.op for n in gd.node})}"
+    g, gp, gs = load_tensorflow(pb, [inp], [outs[-1]], [tuple(x.shape)])
+    return np.asarray(g.apply(gp, gs, jnp.asarray(x))[0])
+
+
+def check(fn, x, out_op, tmp_path, rtol=1e-4, atol=1e-5, dtype=tf.float32):
+    ours = run_import(fn, x, out_op, tmp_path, dtype)
+    theirs = np.asarray(fn(tf.constant(x)))
+    np.testing.assert_allclose(ours.astype(np.float64),
+                               theirs.astype(np.float64), rtol=rtol, atol=atol)
+
+
+class TestLongTailOps:
+    def test_addn(self, tmp_path):
+        rs = np.random.RandomState(0)
+        check(tf.function(lambda x: tf.add_n([x, x * 2.0, x + 1.0])),
+              rs.randn(3, 4).astype(np.float32), "AddN", tmp_path)
+
+    def test_all_any(self, tmp_path):
+        rs = np.random.RandomState(1)
+        x = rs.randn(4, 5).astype(np.float32)
+        check(tf.function(
+            lambda x: tf.cast(tf.reduce_all(x > 0.0, axis=1), tf.float32)),
+            x, "Cast", tmp_path)
+        check(tf.function(
+            lambda x: tf.cast(tf.reduce_any(x > 0.0, axis=0), tf.float32)),
+            x, "Cast", tmp_path)
+
+    def test_unary_ceil_sign_reciprocal(self, tmp_path):
+        rs = np.random.RandomState(2)
+        x = (rs.randn(3, 4) * 3).astype(np.float32)
+        check(tf.function(tf.math.ceil), x, "Ceil", tmp_path)
+        check(tf.function(tf.math.sign), x, "Sign", tmp_path)
+        check(tf.function(tf.math.reciprocal), x + 5.0, "Reciprocal", tmp_path)
+
+    def test_div_mod_family(self, tmp_path):
+        rs = np.random.RandomState(3)
+        x = (rs.randn(4, 4) * 5).astype(np.float32)
+        d = tf.constant(np.full((4, 4), 3.0, np.float32))
+        check(tf.function(lambda x: tf.math.floordiv(x, d)), x, "FloorDiv",
+              tmp_path)
+        check(tf.function(lambda x: tf.math.floormod(x, d)), x, "FloorMod",
+              tmp_path)
+        check(tf.function(lambda x: tf.raw_ops.TruncateMod(x=x, y=d)), x,
+              "TruncateMod", tmp_path)
+        check(tf.function(lambda x: tf.raw_ops.TruncateDiv(x=x, y=d)), x,
+              "TruncateDiv", tmp_path)
+
+    def test_logical_and_not_equal(self, tmp_path):
+        rs = np.random.RandomState(4)
+        x = rs.randn(4, 4).astype(np.float32)
+        check(tf.function(lambda x: tf.cast(
+            tf.logical_and(x > 0.0, x < 1.0), tf.float32)), x, "Cast",
+            tmp_path)
+        check(tf.function(lambda x: tf.cast(
+            tf.logical_or(x > 1.0, x < -1.0), tf.float32)), x, "Cast",
+            tmp_path)
+        check(tf.function(lambda x: tf.cast(
+            tf.logical_not(x > 0.0), tf.float32)), x, "Cast", tmp_path)
+        check(tf.function(lambda x: tf.cast(
+            tf.not_equal(tf.round(x), 0.0), tf.float32)), x, "Cast", tmp_path)
+
+    def test_fill_range_fold(self, tmp_path):
+        rs = np.random.RandomState(5)
+        x = rs.randn(3, 8).astype(np.float32)
+        check(tf.function(lambda x: x + tf.fill([3, 8], 2.5)), x, "AddV2",
+              tmp_path)
+        check(tf.function(lambda x: x * tf.range(8.0)), x, "Mul", tmp_path)
+
+    def test_pack_unpack(self, tmp_path):
+        rs = np.random.RandomState(6)
+        x = rs.randn(4, 6).astype(np.float32)
+        check(tf.function(lambda x: tf.stack([x, x * 2.0], axis=1)), x,
+              "Pack", tmp_path)
+        # unstack output 1 consumed via the :1 reference
+        check(tf.function(lambda x: tf.exp(tf.unstack(x, axis=1)[1])), x,
+              "Exp", tmp_path)
+
+    def test_topk_both_outputs(self, tmp_path):
+        rs = np.random.RandomState(7)
+        x = rs.randn(5, 9).astype(np.float32)
+        check(tf.function(lambda x: tf.math.top_k(x, k=3).values), x,
+              "TopKV2", tmp_path)
+        check(tf.function(
+            lambda x: tf.cast(tf.math.top_k(x, k=3).indices, tf.float32)), x,
+            "Cast", tmp_path)
+
+    def test_in_top_k(self, tmp_path):
+        rs = np.random.RandomState(8)
+        x = rs.randn(6, 10).astype(np.float32)
+        t = tf.constant(np.arange(6, dtype=np.int32))
+        check(tf.function(lambda x: tf.cast(
+            tf.math.in_top_k(t, x, k=3), tf.float32)), x, "Cast", tmp_path)
+
+    def test_l2_loss(self, tmp_path):
+        rs = np.random.RandomState(9)
+        check(tf.function(tf.nn.l2_loss), rs.randn(4, 4).astype(np.float32),
+              "L2Loss", tmp_path)
+
+    def test_segment_sum(self, tmp_path):
+        rs = np.random.RandomState(10)
+        x = rs.randn(6, 3).astype(np.float32)
+        ids = tf.constant(np.asarray([0, 0, 1, 2, 2, 2], np.int32))
+        check(tf.function(lambda x: tf.math.segment_sum(x, ids)), x,
+              "SegmentSum", tmp_path)
+
+    def test_softmax_cross_entropy_with_logits(self, tmp_path):
+        rs = np.random.RandomState(11)
+        x = rs.randn(4, 7).astype(np.float32)
+        labels = np.eye(7, dtype=np.float32)[[0, 3, 5, 6]]
+        lab = tf.constant(labels)
+        check(tf.function(lambda x: tf.raw_ops.SoftmaxCrossEntropyWithLogits(
+            features=x, labels=lab)[0]), x,
+            "SoftmaxCrossEntropyWithLogits", tmp_path)
+        # backprop output (:1) consumed downstream
+        check(tf.function(lambda x: tf.exp(
+            tf.raw_ops.SoftmaxCrossEntropyWithLogits(
+                features=x, labels=lab)[1])), x, "Exp", tmp_path)
+
+    def test_conv3d(self, tmp_path):
+        rs = np.random.RandomState(12)
+        x = rs.randn(2, 5, 6, 6, 3).astype(np.float32)
+        k = tf.constant(rs.randn(3, 3, 3, 3, 4).astype(np.float32) * 0.3)
+        check(tf.function(lambda x: tf.nn.conv3d(
+            x, k, strides=[1, 1, 1, 1, 1], padding="VALID")), x, "Conv3D",
+            tmp_path, rtol=5e-4, atol=5e-5)
+        check(tf.function(lambda x: tf.nn.conv3d(
+            x, k, strides=[1, 1, 2, 2, 1], padding="SAME")), x, "Conv3D",
+            tmp_path, rtol=5e-4, atol=5e-5)
+
+    def test_dilation2d(self, tmp_path):
+        rs = np.random.RandomState(13)
+        x = rs.randn(2, 8, 8, 3).astype(np.float32)
+        filt = tf.constant(rs.randn(3, 3, 3).astype(np.float32) * 0.2)
+        check(tf.function(lambda x: tf.nn.dilation2d(
+            x, filt, strides=[1, 1, 1, 1], dilations=[1, 1, 1, 1],
+            padding="SAME", data_format="NHWC")), x, "Dilation2D", tmp_path)
