@@ -432,6 +432,128 @@ class ParseExample(ParseSingleExample):
 # ---------------------------------------------------------------------------
 
 
+class TensorArrayReadOp(Module):
+    """{buffer (T, ...), index} -> buffer[index].  The traced form of
+    TensorArrayReadV3: flow values ARE dense buffers in this import
+    (reference: DataFlowOps.scala TensorArrayRead).  A differentiable
+    Module (not a stop-gradient Operation): imported loops must
+    fine-tune through Session.train."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        buf, idx = list(x)[:2]
+        return jax.lax.dynamic_index_in_dim(
+            jnp.asarray(buf), jnp.asarray(idx).reshape(()), 0,
+            keepdims=False), state
+
+    def output_shape(self, input_shape):
+        buf_shape = list(input_shape)[0]
+        return tuple(buf_shape[1:]) if buf_shape else None
+
+
+class TensorArrayWriteOp(Module):
+    """{buffer (T, ...), index, value} -> buffer with row `index` replaced.
+    The traced TensorArrayWriteV3 (the returned 'flow' IS the updated
+    buffer).  Differentiable, like TensorArrayReadOp."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        buf, idx, val = list(x)[:3]
+        return jax.lax.dynamic_update_index_in_dim(
+            jnp.asarray(buf), jnp.asarray(val),
+            jnp.asarray(idx).reshape(()), 0), state
+
+    def output_shape(self, input_shape):
+        return list(input_shape)[0]
+
+
+class TakeRows(Module):
+    """Select rows along axis 0 by a CONST index vector (TensorArray
+    gather/scatter permutations; identity when idx == arange).
+    Differentiable."""
+
+    def __init__(self, indices, name: Optional[str] = None):
+        super().__init__(name)
+        self.indices = np.asarray(indices, np.int32)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if np.array_equal(self.indices, np.arange(len(self.indices))):
+            return jnp.asarray(x), state
+        return jnp.take(jnp.asarray(x), jnp.asarray(self.indices),
+                        axis=0), state
+
+    def output_shape(self, input_shape):
+        if input_shape is None:
+            return None
+        return (len(self.indices),) + tuple(input_shape[1:])
+
+
+class TFWhile(Module):
+    """Structured import of a TF v1 while frame (Enter/Merge/Switch/Exit/
+    NextIteration, reference: nn/tf/ControlOps.scala + utils/tf/loaders/
+    ControlFlowOps.scala).
+
+    Input: Table(init_1..n, capture_1..m); output Table(final_1..n).
+    `cond_graph`/`body_graph` map Table(var_1..n, capture_1..m) to a scalar
+    bool / Table(new var_1..n).  When the frame is a counted loop
+    (cond = Less(counter, const), counter += 1) the importer passes
+    `trip_count` and the loop lowers to `lax.scan` — REVERSE-MODE
+    DIFFERENTIABLE, so imported dynamic_rnn graphs fine-tune through
+    Session.train; otherwise it lowers to `lax.while_loop` (forward-only).
+    """
+
+    _constructor_children = True
+
+    def __init__(self, cond_graph: Module, body_graph: Module, n_vars: int,
+                 trip_count: Optional[int] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.cond_graph = cond_graph
+        self.body_graph = body_graph
+        self.n_vars = n_vars
+        self.trip_count = trip_count
+
+    def build(self, rng, input_shape):
+        shapes = list(input_shape) if isinstance(input_shape, Table) \
+            else [input_shape]
+        k1, k2 = jax.random.split(jnp.asarray(rng)) if rng is not None \
+            else (None, None)
+        pc, sc = {}, {}
+        if self.cond_graph is not None:
+            pc, sc, _ = self.cond_graph.build(k1, Table(*shapes))
+        pb, sb, _ = self.body_graph.build(k2, Table(*shapes))
+        out = Table(*shapes[:self.n_vars])
+        return {"cond": pc, "body": pb}, {"cond": sc, "body": sb}, out
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        items = list(x) if isinstance(x, Table) else [x]
+        vars0 = tuple(jnp.asarray(v) for v in items[:self.n_vars])
+        caps = tuple(items[self.n_vars:])
+
+        def run_body(vs):
+            out, _ = self.body_graph.apply(
+                params["body"], state["body"], Table(*vs, *caps),
+                training=training, rng=rng)
+            outs = list(out) if isinstance(out, Table) else [out]
+            # preserve loop-var dtypes (weak-typed consts can promote)
+            return tuple(jnp.asarray(o).astype(v.dtype)
+                         for o, v in zip(outs, vars0))
+
+        if self.trip_count is not None:
+            def sbody(vs, _):
+                return run_body(vs), None
+
+            final, _ = jax.lax.scan(sbody, vars0, None,
+                                    length=self.trip_count)
+        else:
+            def cond_fn(vs):
+                c, _ = self.cond_graph.apply(
+                    params["cond"], state["cond"], Table(*vs, *caps),
+                    training=training, rng=rng)
+                return jnp.asarray(c).reshape(())
+
+            final = jax.lax.while_loop(cond_fn, run_body, vars0)
+        return Table(*final), state
+
+
 class TensorArray:
     """Growable list of tensors keyed by index
     (reference: DataFlowOps.scala:176-576 TensorArray* ops)."""

@@ -113,7 +113,7 @@ class _TFImporter:
             arr = tensor_to_ndarray(nd.attr["value"].tensor)
             self.consts[name] = arr
             return arr
-        if nd.op == "Identity":  # frozen variables are Identity(Const)
+        if nd.op in ("Identity", "Enter"):  # frozen vars / loop invariants
             return self.const_of(nd.input[0])
         if nd.op == "Fill":  # constant-operand Fill folds
             dims = tuple(int(v) for v in
@@ -133,6 +133,35 @@ class _TFImporter:
                 _NP_DTYPES.get(nd.attr["DstT"].type, np.float32))
             self.consts[name] = arr
             return arr
+        if nd.op == "Shape":  # static shapes fold to int vectors
+            sh = self.shapes.get(self._key(nd.input[0]))
+            if sh is not None and not isinstance(sh, Table) \
+                    and all(isinstance(d, int) and d > 0 for d in sh):
+                arr = np.asarray(sh, np.int32)
+                self.consts[name] = arr
+                return arr
+        if nd.op == "StridedSlice":  # const slicing (no ellipsis/new_axis)
+            a = self.const_of(nd.input[0])
+            begin = self.const_of(nd.input[1]).reshape(-1)
+            end = self.const_of(nd.input[2]).reshape(-1)
+            strides = self.const_of(nd.input[3]).reshape(-1)
+            bm = int(nd.attr["begin_mask"].i)
+            em = int(nd.attr["end_mask"].i)
+            sm = int(nd.attr["shrink_axis_mask"].i)
+            if not (int(nd.attr["ellipsis_mask"].i)
+                    or int(nd.attr["new_axis_mask"].i)):
+                idx = []
+                for i in range(len(begin)):
+                    if sm & (1 << i):
+                        idx.append(int(begin[i]))
+                    else:
+                        idx.append(slice(
+                            None if bm & (1 << i) else int(begin[i]),
+                            None if em & (1 << i) else int(end[i]),
+                            int(strides[i])))
+                arr = np.asarray(a[tuple(idx)])
+                self.consts[name] = arr
+                return arr
         raise ValueError(f"expected Const, got {nd.op} for {name}")
 
     def _key(self, ref: str) -> str:
@@ -314,17 +343,19 @@ class _TFImporter:
                 self._attach(name, cls(name=name), data_inputs[:2])
             else:
                 c = self.const_of(data_inputs[1])
+                # .item() (not float()) keeps python-int consts weak-typed so
+                # integer loop counters stay int32 through `i + 1`
                 if op in ("Add", "AddV2"):
-                    m = nn.AddConstant(float(c), name=name) if c.size == 1 \
+                    m = nn.AddConstant(c.item(), name=name) if c.size == 1 \
                         else nn.CAdd(c.shape, name=name)
                     w = None if c.size == 1 else {"bias": c}
                 elif op == "Mul":
-                    m = nn.MulConstant(float(c), name=name) if c.size == 1 \
+                    m = nn.MulConstant(c.item(), name=name) if c.size == 1 \
                         else nn.CMul(c.shape, name=name)
                     w = None if c.size == 1 else {"weight": c}
                 elif op == "Sub":
                     if c.size == 1:
-                        m = nn.AddConstant(-float(c), name=name)
+                        m = nn.AddConstant(-c.item(), name=name)
                         w = None
                     else:
                         m = nn.CAdd(c.shape, name=name)
@@ -755,10 +786,353 @@ class _TFImporter:
             self._attach(name, nn.ops.Dilation2D(
                 strides=strides, rates=rates, padding=pad, name=name),
                 data_inputs[:2])
+        elif op == "TensorArrayV3":
+            # handle (:0) is dead plumbing; flow (:1) becomes a dense
+            # buffer, materialized where consumed (Scatter or frame import)
+            return
+        elif op == "TensorArrayScatterV3":
+            # (handle, indices, value, flow) -> buffer = value permuted by
+            # indices (identity for the standard unstack arange)
+            from bigdl_tpu.nn import tf_ops as _tf
+
+            idx = self.const_of(data_inputs[1]).reshape(-1)
+            perm = np.argsort(idx)
+            self._attach(name, _tf.TakeRows(perm, name=name),
+                         [data_inputs[2]])
+        elif op == "TensorArrayGatherV3":
+            # (handle, indices, flow) -> rows of the final buffer
+            from bigdl_tpu.nn import tf_ops as _tf
+
+            idx = self.const_of(data_inputs[1]).reshape(-1)
+            self._attach(name, _tf.TakeRows(idx, name=name),
+                         [data_inputs[2]])
+        elif op == "TensorArraySizeV3":
+            ta = self.nodes_by_name[_clean(data_inputs[0])]
+            self.consts[name] = np.asarray(
+                int(self.const_of(ta.input[0])), np.int32)
+            return
+        elif op == "TensorArrayReadV3":
+            # (handle, index, flow-buffer) -> buffer[index]
+            from bigdl_tpu.nn import tf_ops as _tf
+
+            self._attach(name, _tf.TensorArrayReadOp(name=name),
+                         [data_inputs[2], data_inputs[1]])
+        elif op == "TensorArrayWriteV3":
+            # (handle, index, value, flow-buffer) -> updated buffer
+            from bigdl_tpu.nn import tf_ops as _tf
+
+            self._attach(name, _tf.TensorArrayWriteOp(name=name),
+                         [data_inputs[3], data_inputs[1], data_inputs[2]])
         else:
             raise ValueError(
                 f"unsupported TF op {op!r} at node {name!r} "
                 f"(reference: utils/tf/loaders/)")
+
+
+_CF_SKELETON = ("Enter", "Merge", "Switch", "Exit", "NextIteration",
+                "LoopCond")
+
+
+def _sweep(imp: "_TFImporter", pending):
+    """One conversion pass: convert every node whose data inputs are
+    resolved; return (deferred, progressed).  GraphDef does not guarantee
+    topological order, so callers iterate this to fixpoint."""
+    deferred = []
+    progressed = False
+    for node in pending:
+        data_in = [i for i in node.input if not i.startswith("^")]
+        needs_graph_input = node.op not in ("Const", "Placeholder", "NoOp")
+
+        def unresolved(ref):
+            # a data input whose producer is a real op (not a foldable
+            # const/identity/placeholder) that hasn't been converted yet.
+            # Multi-output refs ("switch:1") may be registered under the
+            # full ref (sub-import seeds), so check both forms.
+            nm = _clean(ref)
+            return (ref not in imp.graph_nodes
+                    and nm not in imp.graph_nodes
+                    and nm not in imp.consts
+                    and nm in imp.nodes_by_name
+                    and imp.nodes_by_name[nm].op not in
+                    ("Const", "Identity", "Placeholder", "Fill", "Range",
+                     # TA handles are dead plumbing; Enter is identity-like
+                     # (const-folds, or is pre-seeded as a capture)
+                     "TensorArrayV3", "Enter"))
+
+        if needs_graph_input and any(unresolved(i) for i in data_in):
+            deferred.append(node)
+            continue
+        try:
+            imp.convert(node)
+        except KeyError:
+            # an input resolving through an Identity/Enter chain that this
+            # (sub-)import never materializes — e.g. the cond importer
+            # visiting body-only nodes.  Defer; a genuinely missing node
+            # still fails loudly at the output lookup.
+            deferred.append(node)
+            continue
+        progressed = True
+    return deferred, progressed
+
+
+def _run_fixpoint(imp: "_TFImporter", nodes) -> None:
+    pending = list(nodes)
+    while pending:
+        pending, progressed = _sweep(imp, pending)
+        if not progressed:
+            break  # leftovers belong to another sub-import (cond vs body)
+
+
+def _detect_frames(gd, node_index) -> Dict[str, list]:
+    """Group nodes into v1 while frames by propagating membership from
+    Enter nodes (frame_name attr) through data edges, stopping at Exit."""
+    member: Dict[str, str] = {}
+    for n in gd.node:
+        if n.op == "Enter":
+            member[n.name] = n.attr["frame_name"].s.decode()
+    if not member:
+        return {}
+    changed = True
+    while changed:
+        changed = False
+        for n in gd.node:
+            if n.name in member:
+                continue
+            for i in n.input:
+                src = _clean(i)
+                if src in member and node_index[src].op != "Exit":
+                    member[n.name] = member[src]
+                    changed = True
+                    break
+    for n in gd.node:
+        if n.op == "Enter" and _clean(n.input[0]) in member:
+            raise NotImplementedError(
+                "nested TF while frames are not supported yet")
+    frames: Dict[str, list] = {}
+    for n in gd.node:
+        if n.name in member:
+            frames.setdefault(member[n.name], []).append(n)
+    return frames
+
+
+def _frame_ready(imp: "_TFImporter", nodes) -> bool:
+    """A frame converts once every Enter input is a converted graph node,
+    a foldable const, or a TensorArray flow with const size."""
+    for n in nodes:
+        if n.op != "Enter":
+            continue
+        src = n.input[0]
+        base = _clean(src)
+        if imp._key(src) in imp.graph_nodes or base in imp.consts:
+            continue
+        prod = imp.nodes_by_name.get(base)
+        try:
+            if prod is not None and prod.op == "TensorArrayV3":
+                imp.const_of(prod.input[0])
+            else:
+                imp.const_of(src)
+        except (ValueError, KeyError):
+            return False
+    return True
+
+
+def _follow_identity(imp: "_TFImporter", ref: str) -> str:
+    """Resolve a ref through Identity nodes to its producing ref."""
+    while True:
+        base = _clean(ref)
+        nd = imp.nodes_by_name.get(base)
+        if nd is None or nd.op != "Identity":
+            return ref
+        ref = nd.input[0]
+
+
+def _convert_frame(imp: "_TFImporter", fr_name: str, nodes) -> None:
+    """Import one v1 while frame as a structured TFWhile module.
+
+    Loop vars = Merge nodes (init from Enter, next from NextIteration);
+    cond = subgraph feeding LoopCond (loop-var refs are the Merge names);
+    body = subgraph feeding the NextIterations (loop-var refs are
+    Switch:1); loop-invariant Enters fold as consts or become captured
+    inputs; TensorArray flow vars become dense (T, ...) buffers.
+    Reference: utils/tf/loaders/ControlFlowOps.scala + Scheduler/
+    FrameManager (nn/Scheduler.scala:36) — the breadth-first frame
+    executor collapses into lax.scan/while_loop."""
+    from bigdl_tpu.nn import tf_ops as _tf
+
+    merges = [n for n in nodes if n.op == "Merge"]
+    loopcond = next(n for n in nodes if n.op == "LoopCond")
+    switch_by_merge = {_clean(n.input[0]): n for n in nodes
+                       if n.op == "Switch"}
+    exit_by_switch = {_clean(n.input[0]): n for n in nodes if n.op == "Exit"}
+    anchor = next(iter(imp.graph_nodes))
+
+    var_info = []
+    for m in merges:
+        enter_nd = imp.nodes_by_name[_clean(m.input[0])]
+        var_info.append({
+            "merge": m,
+            "enter": enter_nd,
+            "next_nd": imp.nodes_by_name[_clean(m.input[1])],
+            "switch": switch_by_merge[m.name],
+        })
+
+    # --- initial values -------------------------------------------------
+    initial_refs: List[Optional[str]] = []
+    var_shapes: List[Optional[tuple]] = []
+    buffer_vars: List[Tuple[int, int]] = []  # (var index, TA size)
+    for i, v in enumerate(var_info):
+        src = v["enter"].input[0]
+        base = _clean(src)
+        prod = imp.nodes_by_name.get(base)
+        if prod is not None and prod.op == "TensorArrayV3":
+            buffer_vars.append((i, int(imp.const_of(prod.input[0]))))
+            initial_refs.append(None)  # zeros const created after body import
+            var_shapes.append(None)
+        elif imp._key(src) in imp.graph_nodes:
+            initial_refs.append(src)
+            var_shapes.append(imp.shapes[imp._key(src)])
+        else:
+            arr = imp.const_of(src)
+            imp._ensure_node(src, anchor=anchor)
+            initial_refs.append(src)
+            var_shapes.append(tuple(arr.shape))
+
+    # --- loop-invariant Enters: consts fold; the rest are captures ------
+    merge_init_enters = {_clean(m.input[0]) for m in merges}
+    captures: List[Tuple[str, str]] = []  # (enter name, outer ref)
+    for n in nodes:
+        if n.op != "Enter" or n.name in merge_init_enters:
+            continue
+        src = n.input[0]
+        base = _clean(src)
+        prod = imp.nodes_by_name.get(base)
+        if prod is not None and prod.op == "TensorArrayV3":
+            continue  # dead TA handle plumbing (Read/Write ignore it)
+        try:
+            imp.const_of(src)
+            continue
+        except (ValueError, KeyError):
+            captures.append((n.name, src))
+
+    compute_nodes = [n for n in nodes if n.op not in _CF_SKELETON]
+
+    def sub_importer(seed_fn):
+        sub = _TFImporter.__new__(_TFImporter)
+        sub.nodes_by_name = imp.nodes_by_name
+        sub.consts = imp.consts  # shared const cache
+        sub.graph_nodes = {}
+        sub.shapes = {}
+        sub.weight_sets = []
+        sub.input_nodes = []
+        inputs = []
+        seed_fn(sub, inputs)
+        for cap_name, src in captures:
+            node_in = nn.Input(name=f"cap_{cap_name}")
+            sub.graph_nodes[cap_name] = node_in
+            sub.shapes[cap_name] = imp.shapes.get(imp._key(src))
+            inputs.append(node_in)
+        _run_fixpoint(sub, compute_nodes)
+        return sub, inputs
+
+    # --- body: loop-var refs are Switch:1 -------------------------------
+    def seed_body(sub, inputs):
+        for i, v in enumerate(var_info):
+            node_in = nn.Input(name=f"{fr_name}_var{i}")
+            sub.graph_nodes[v["switch"].name + ":1"] = node_in
+            sub.shapes[v["switch"].name + ":1"] = var_shapes[i]
+            inputs.append(node_in)
+
+    body_imp, body_inputs = sub_importer(seed_body)
+    body_outs = [body_imp.graph_nodes[body_imp._key(v["next_nd"].input[0])]
+                 for v in var_info]
+    body_graph = nn.Graph(body_inputs, body_outs, name=f"{fr_name}_body")
+
+    # --- cond: loop-var refs are the Merge names ------------------------
+    def seed_cond(sub, inputs):
+        for i, v in enumerate(var_info):
+            node_in = nn.Input(name=f"{fr_name}_cvar{i}")
+            sub.graph_nodes[v["merge"].name] = node_in
+            sub.shapes[v["merge"].name] = var_shapes[i]
+            inputs.append(node_in)
+
+    cond_imp, cond_inputs = sub_importer(seed_cond)
+    pred_node = cond_imp.graph_nodes[cond_imp._key(loopcond.input[0])]
+    cond_graph = nn.Graph(cond_inputs, [pred_node], name=f"{fr_name}_cond")
+
+    # --- TA buffer vars: zeros init, elem shape from the body's Write ---
+    for i, size in buffer_vars:
+        write_ref = _follow_identity(imp, var_info[i]["next_nd"].input[0])
+        write_nd = imp.nodes_by_name[_clean(write_ref)]
+        if write_nd.op != "TensorArrayWriteV3":
+            raise ValueError(
+                f"TensorArray loop var {i} is not produced by a Write "
+                f"(got {write_nd.op})")
+        elem = body_imp.shapes.get(body_imp._key(write_nd.input[2]))
+        if elem is None:
+            raise ValueError("cannot infer TensorArray element shape")
+        zeros = np.zeros((size,) + tuple(elem), np.float32)
+        cname = f"{fr_name}_buf{i}"
+        cnode = _tf.Const(zeros, name=cname)(imp.graph_nodes[anchor])
+        imp.graph_nodes[cname] = cnode
+        imp.shapes[cname] = zeros.shape
+        initial_refs[i] = cname
+        var_shapes[i] = zeros.shape
+
+    # --- static trip count: cond == Less(counter, const), counter += 1 --
+    trip = None
+    pred_nd = imp.nodes_by_name.get(_clean(loopcond.input[0]))
+    if pred_nd is not None and pred_nd.op == "Less":
+        k = next((i for i, v in enumerate(var_info)
+                  if v["merge"].name == _clean(pred_nd.input[0])), None)
+        try:
+            limit = int(imp.const_of(pred_nd.input[1])) if k is not None \
+                else None
+            v0 = int(imp.const_of(var_info[k]["enter"].input[0])) \
+                if k is not None else None
+        except (ValueError, KeyError):
+            limit = v0 = None
+        if limit is not None and v0 is not None:
+            add_ref = _follow_identity(imp, var_info[k]["next_nd"].input[0])
+            add_nd = imp.nodes_by_name.get(_clean(add_ref))
+            if add_nd is not None and add_nd.op in ("Add", "AddV2"):
+                operands = [_follow_identity(imp, r) for r in add_nd.input[:2]]
+                bases = [_clean(r) for r in operands]
+                sw = var_info[k]["switch"].name
+                counter_in = any(b == sw for b in bases)
+                one = False
+                for r in add_nd.input[:2]:
+                    try:
+                        one = one or int(imp.const_of(r)) == 1
+                    except (ValueError, KeyError):
+                        pass
+                if counter_in and one:
+                    trip = max(0, limit - v0)
+
+    # --- attach ---------------------------------------------------------
+    wname = f"{fr_name}_while"
+    mod = _tf.TFWhile(cond_graph, body_graph, n_vars=len(var_info),
+                      trip_count=trip, name=wname)
+    in_refs = list(initial_refs) + [src for _, src in captures]
+    imp._attach(wname, mod, in_refs)
+    imp.shapes[wname] = Table(*var_shapes)
+
+    from bigdl_tpu.nn.table_ops import SelectTable
+
+    while_node = imp.graph_nodes[wname]
+    for i, v in enumerate(var_info):
+        ex = exit_by_switch.get(v["switch"].name)
+        if ex is None:
+            continue
+        sel = SelectTable(i + 1, name=f"{wname}_out{i}")(while_node)
+        imp.graph_nodes[ex.name] = sel
+        imp.shapes[ex.name] = var_shapes[i]
+
+    # nested weight assignments (body/cond const weights, e.g. an RNN
+    # cell's MatMul) re-route through the TFWhile param subtree
+    for lname, w in body_imp.weight_sets:
+        imp.weight_sets.append(((wname, "body", lname), w))
+    for lname, w in cond_imp.weight_sets:
+        imp.weight_sets.append(((wname, "cond", lname), w))
 
 
 def load_tensorflow(pb_path: str, inputs: Sequence[str],
@@ -788,31 +1162,26 @@ def load_tensorflow(pb_path: str, inputs: Sequence[str],
                     f"({dims or 'missing'}); pass input_shapes= explicitly")
             input_shapes.append(tuple(dims))
     imp = _TFImporter(gd, inputs, input_shapes, node_index)
-    # GraphDef does not guarantee topological order: iterate to fixpoint,
-    # deferring nodes whose data inputs aren't converted yet
-    pending = list(gd.node)
-    while pending:
-        deferred = []
-        for node in pending:
-            data_in = [_clean(i) for i in node.input if not i.startswith("^")]
-            needs_graph_input = node.op not in ("Const", "Placeholder", "NoOp")
-
-            def unresolved(name):
-                # a data input whose producer is a real op (not a foldable
-                # const/identity/placeholder) that hasn't been converted yet
-                return (name not in imp.graph_nodes
-                        and name not in imp.consts
-                        and name in imp.nodes_by_name
-                        and imp.nodes_by_name[name].op not in
-                        ("Const", "Identity", "Placeholder", "Fill", "Range"))
-
-            if needs_graph_input and any(unresolved(i) for i in data_in):
-                deferred.append(node)
-                continue
-            imp.convert(node)
-        if len(deferred) == len(pending):
-            break  # remaining nodes are constant-only subgraphs
-        pending = deferred
+    # v1 control-flow frames (Enter/Merge/Switch/Exit/NextIteration) are
+    # imported as STRUCTURED TFWhile modules, each converting once all its
+    # Enter inputs resolve (reference: utils/tf/loaders/ControlFlowOps.scala
+    # -> nn/tf/ControlOps.scala; here the frame lowers to lax.scan /
+    # lax.while_loop)
+    frames = _detect_frames(gd, node_index)
+    frame_member_names = {n.name for nodes in frames.values() for n in nodes}
+    pending = [n for n in gd.node if n.name not in frame_member_names]
+    todo_frames = dict(frames)
+    while True:
+        pending, progressed = _sweep(imp, pending)
+        for fr in list(todo_frames):
+            if _frame_ready(imp, todo_frames[fr]):
+                _convert_frame(imp, fr, todo_frames.pop(fr))
+                progressed = True
+        if not progressed or (not pending and not todo_frames):
+            break
+    if todo_frames:
+        raise ValueError(
+            f"could not resolve while-frame inputs for {list(todo_frames)}")
     outs = [imp.graph_nodes[imp._key(o)] for o in outputs]
     model = nn.Graph(imp.input_nodes, outs, name="tf_graph")
     build_shapes = [imp.shapes[i] for i in inputs]
@@ -820,20 +1189,27 @@ def load_tensorflow(pb_path: str, inputs: Sequence[str],
         jax.random.PRNGKey(seed),
         build_shapes[0] if len(build_shapes) == 1 else Table(*build_shapes))
     for lname, w in imp.weight_sets:
-        if lname not in params and lname not in state:
+        # tuple lnames address nested subtrees (TFWhile body/cond params)
+        path = lname if isinstance(lname, tuple) else (lname,)
+        p_tgt, s_tgt = params, state
+        for part in path[:-1]:
+            p_tgt = p_tgt.get(part, {}) if isinstance(p_tgt, dict) else {}
+            s_tgt = s_tgt.get(part, {}) if isinstance(s_tgt, dict) else {}
+        leaf = path[-1]
+        if leaf not in p_tgt and leaf not in s_tgt:
             # node converted but pruned from the graph (it sits past the
             # requested output endpoints, e.g. loading an intermediate layer)
             continue
         for k, v in w.items():
             arr = np.asarray(v, np.float32)
-            if lname in params and k in params[lname]:
-                assert tuple(params[lname][k].shape) == arr.shape, \
-                    f"{lname}.{k}: {params[lname][k].shape} vs {arr.shape}"
-                params[lname][k] = jnp.asarray(arr)
-            elif lname in state and k in state[lname]:
-                state[lname][k] = jnp.asarray(arr)
+            if leaf in p_tgt and k in p_tgt[leaf]:
+                assert tuple(p_tgt[leaf][k].shape) == arr.shape, \
+                    f"{path}.{k}: {p_tgt[leaf][k].shape} vs {arr.shape}"
+                p_tgt[leaf][k] = jnp.asarray(arr)
+            elif leaf in s_tgt and k in s_tgt[leaf]:
+                s_tgt[leaf][k] = jnp.asarray(arr)
             else:
-                raise KeyError(f"no slot {k} in node {lname}")
+                raise KeyError(f"no slot {k} in node {path}")
     return model, params, state
 
 

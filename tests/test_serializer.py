@@ -198,6 +198,8 @@ EXEMPLARS = {
                       lambda: jnp.asarray(
                           np.random.RandomState(3).randint(0, 20, (2, 6)))),
     "QuantizedLinear": (lambda: nn.QuantizedLinear(4, 3), lambda: rand(2, 4)),
+    "WeightOnlyInt8": (lambda: nn.WeightOnlyInt8(nn.Linear(4, 3), min_size=1),
+                       lambda: rand(2, 4)),
     "QuantizedSpatialConvolution": (
         lambda: nn.QuantizedSpatialConvolution(
             dict(n_input=3, n_output=4, kernel=(3, 3), stride=(1, 1),
@@ -440,6 +442,12 @@ EXCLUDED = {"Module", "Container", "Criterion", "keras.KerasLayer",
 
 # Forward-only op zoo: spec-only roundtrips (semantics covered in
 # tests/test_ops.py; several take host string arrays, not jax inputs)
+def _tiny_graph():
+    inp = nn.Input()
+    out = nn.Identity()(inp)
+    return nn.Graph([inp], [out])
+
+
 OPS_EXEMPLARS = {
     "ops.All": lambda: nn.ops.All(axis=1),
     "ops.Any": lambda: nn.ops.Any(axis=0, keep_dims=True),
@@ -531,6 +539,16 @@ OPS_EXEMPLARS = {
     "tf.SplitAndSelect": lambda: nn.tf_ops.SplitAndSelect(1, 0, 2),
     "tf.TensorModuleWrapper": lambda: nn.tf_ops.TensorModuleWrapper(nn.ReLU()),
     "tf.Variable": lambda: nn.tf_ops.Variable([1.0, 2.0], trainable=False),
+    "ops.Ceil": lambda: nn.ops.Ceil(),
+    "ops.Pack": lambda: nn.ops.Pack(1),
+    "ops.SoftmaxGradOp": lambda: nn.ops.SoftmaxGradOp(),
+    "ops.TruncateMod": lambda: nn.ops.TruncateMod(),
+    "ops.UnpackSelect": lambda: nn.ops.UnpackSelect(1, 0),
+    "tf.TakeRows": lambda: nn.tf_ops.TakeRows([1, 0, 2]),
+    "tf.TensorArrayReadOp": lambda: nn.tf_ops.TensorArrayReadOp(),
+    "tf.TensorArrayWriteOp": lambda: nn.tf_ops.TensorArrayWriteOp(),
+    "tf.TFWhile": lambda: nn.tf_ops.TFWhile(
+        _tiny_graph(), _tiny_graph(), n_vars=1, trip_count=2),
 }
 EXEMPLARS.update({k: (v, None) for k, v in OPS_EXEMPLARS.items()})
 
