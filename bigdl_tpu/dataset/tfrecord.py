@@ -18,6 +18,7 @@ from typing import Iterator, List, Optional, Sequence
 import numpy as np
 
 from bigdl_tpu import native
+from bigdl_tpu.dataset.dataset import DataSet
 from bigdl_tpu.dataset.sample import Sample
 from bigdl_tpu.dataset.transformer import Transformer
 
@@ -254,3 +255,64 @@ class RecordToSample(Transformer):
     def __call__(self, it: Iterator[bytes]) -> Iterator[Sample]:
         for rec in it:
             yield record_to_sample(rec)
+
+
+class ParsedExampleDataSet(DataSet):
+    """TFRecord shards of serialized tf.train.Examples -> MiniBatches via
+    the host-side ParseExample op: the imported-graph training data path
+    (reference: utils/tf/TFRecordInputFormat + nn/tf/ParsingOps.scala
+    feeding Session.train, example/tensorflow).
+
+    Each batch parses `batch_size` serialized Examples into dense feature
+    columns (`dense_keys`/`dense_shapes` order); `label_key` becomes the
+    target, the remaining columns the (tuple of) inputs.  The trailing
+    partial batch is dropped so the jitted step sees one static shape.
+    """
+
+    def __init__(self, paths: Sequence[str], batch_size: int,
+                 dense_keys: Sequence[str],
+                 dense_shapes: Sequence[Sequence[int]],
+                 label_key: str, n_threads: int = 4,
+                 label_dtype: str = "int32"):
+        from bigdl_tpu.nn.tf_ops import ParseExample
+
+        self.paths = list(paths)
+        self.batch_size = batch_size
+        self.dense_keys = list(dense_keys)
+        self.label_key = label_key
+        if label_key not in self.dense_keys:
+            raise ValueError(f"label_key {label_key!r} not in dense_keys")
+        self.n_threads = n_threads
+        self.label_dtype = label_dtype
+        self._parser = ParseExample(dense_keys, dense_shapes)
+        self._epoch = 0
+        self._size = -1
+
+    def size(self) -> int:
+        if self._size < 0:
+            self._size = sum(count_records(p) for p in self.paths)
+        return self._size
+
+    def data(self, train: bool):
+        import numpy as _np
+
+        from bigdl_tpu.core.random import RandomGenerator
+        from bigdl_tpu.dataset.minibatch import MiniBatch
+
+        paths = list(self.paths)
+        if train and len(paths) > 1:
+            rs = _np.random.RandomState(RandomGenerator.get_seed()
+                                        + self._epoch)
+            rs.shuffle(paths)
+            self._epoch += 1
+        li = self.dense_keys.index(self.label_key)
+        buf: List[bytes] = []
+        for rec in PrefetchRecordReader(paths, n_threads=self.n_threads):
+            buf.append(rec)
+            if len(buf) == self.batch_size:
+                cols = list(self._parser.compute(
+                    _np.asarray(buf, dtype=object)))
+                y = _np.asarray(cols[li]).astype(self.label_dtype)
+                xs = [c for i, c in enumerate(cols) if i != li]
+                yield MiniBatch(xs[0] if len(xs) == 1 else tuple(xs), y)
+                buf = []

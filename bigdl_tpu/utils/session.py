@@ -76,6 +76,71 @@ class Session:
         pred = Predictor(model, self.params, self.state, mesh=mesh)
         return pred.predict(data, batch_size=batch_size)
 
+    def train_from_records(self, record_paths: Sequence[str],
+                           outputs: Sequence[str], criterion, *,
+                           dense_keys: Sequence[str],
+                           dense_shapes: Sequence[Sequence[int]],
+                           label_key: str, batch_size: int,
+                           parse_node: Optional[str] = None,
+                           optim_method=None,
+                           end_when: Optional[Trigger] = None, mesh=None,
+                           label_dtype: str = "int32"):
+        """Train an imported graph whose input chain is a record reader:
+        the graph is CUT at its ParseExample outputs and fed from TFRecord
+        shards through the host-side ParseExample op — the reference's
+        queue-fed Session.train (utils/tf/Session.scala:43-109,
+        TFRecordInputFormat + nn/tf/ParsingOps.scala, example/tensorflow).
+
+        `dense_keys` must list the parse features in the GRAPH's dense
+        output order (TF sorts feature dicts by key); `label_key` names the
+        target column, the rest feed the model inputs in order.
+        """
+        import tf_graph_pb2 as tfp
+
+        from bigdl_tpu.dataset.tfrecord import ParsedExampleDataSet
+        from bigdl_tpu.optim.optimizer import Optimizer  # import cycle
+
+        gd = tfp.GraphDef()
+        with open(self.pb_path, "rb") as f:
+            gd.ParseFromString(f.read())
+        if parse_node is None:
+            cands = [n.name for n in gd.node
+                     if n.op in ("ParseExample", "ParseExampleV2",
+                                 "ParseSingleExample")]
+            if not cands:
+                raise ValueError("no ParseExample node in the graph; pass "
+                                 "parse_node= explicitly")
+            parse_node = cands[0]
+        nd = next(n for n in gd.node if n.name == parse_node)
+        for sparse_attr in ("Nsparse", "num_sparse"):
+            if sparse_attr in nd.attr and int(nd.attr[sparse_attr].i):
+                raise NotImplementedError(
+                    "sparse ParseExample features are not supported")
+        # dense values are the parse op's outputs :0..:n-1 (no sparse)
+        feat_keys = [k for k in dense_keys if k != label_key]
+        cut_inputs, cut_shapes = [], []
+        for i, k in enumerate(dense_keys):
+            if k == label_key:
+                continue
+            ref = parse_node if i == 0 else f"{parse_node}:{i}"
+            cut_inputs.append(ref)
+            cut_shapes.append((batch_size,) + tuple(dense_shapes[i]))
+
+        self.inputs = cut_inputs
+        self.input_shapes = [tuple(s) for s in cut_shapes]
+        self.model = None  # force reconstruction at the new cut
+        model = self._construct(list(outputs))
+        model.params, model.state = self.params, self.state
+
+        ds = ParsedExampleDataSet(record_paths, batch_size, dense_keys,
+                                  dense_shapes, label_key,
+                                  label_dtype=label_dtype)
+        opt = Optimizer(model, ds, criterion, optim_method=optim_method,
+                        mesh=mesh, end_trigger=end_when)
+        opt.optimize()
+        self.params, self.state = model.params, model.state
+        return model
+
     def save_parameters(self, path: str) -> None:
         """Dump variable contents. reference: Session.scala saveParameters."""
         if self.params is None:

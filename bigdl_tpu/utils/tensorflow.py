@@ -227,6 +227,8 @@ class _TFImporter:
     def convert(self, nd) -> None:
         op = nd.op
         name = nd.name
+        if name in self.graph_nodes:
+            return  # pre-registered input (placeholder or graph cut point)
         if op in ("Const", "Placeholder", "NoOp"):
             return
         data_inputs = [i for i in nd.input if not i.startswith("^")]
@@ -883,6 +885,23 @@ def _run_fixpoint(imp: "_TFImporter", nodes) -> None:
             break  # leftovers belong to another sub-import (cond vs body)
 
 
+def _ancestors(node_index, outputs, stop: set) -> set:
+    """Names of all nodes the outputs depend on, not crossing `stop`
+    (the declared inputs)."""
+    seen: set = set()
+    stack = [_clean(o) for o in outputs]
+    while stack:
+        nm = stack.pop()
+        if nm in seen or nm in stop:
+            continue
+        seen.add(nm)
+        nd = node_index.get(nm)
+        if nd is None:
+            continue
+        stack.extend(_clean(i) for i in nd.input)
+    return seen
+
+
 def _detect_frames(gd, node_index) -> Dict[str, list]:
     """Group nodes into v1 while frames by propagating membership from
     Enter nodes (frame_name attr) through data edges, stopping at Exit."""
@@ -1162,14 +1181,22 @@ def load_tensorflow(pb_path: str, inputs: Sequence[str],
                     f"({dims or 'missing'}); pass input_shapes= explicitly")
             input_shapes.append(tuple(dims))
     imp = _TFImporter(gd, inputs, input_shapes, node_index)
+    # convert only ANCESTORS of the requested outputs, stopping at the
+    # inputs: a graph cut at e.g. the ParseExample outputs must not try to
+    # convert the upstream reader/queue chain (reference:
+    # TensorflowLoader builds the sub-graph ending at the endpoints)
+    wanted = _ancestors(node_index, outputs, {_clean(i) for i in inputs})
     # v1 control-flow frames (Enter/Merge/Switch/Exit/NextIteration) are
     # imported as STRUCTURED TFWhile modules, each converting once all its
     # Enter inputs resolve (reference: utils/tf/loaders/ControlFlowOps.scala
     # -> nn/tf/ControlOps.scala; here the frame lowers to lax.scan /
     # lax.while_loop)
     frames = _detect_frames(gd, node_index)
+    frames = {fr: nodes for fr, nodes in frames.items()
+              if any(n.name in wanted for n in nodes)}
     frame_member_names = {n.name for nodes in frames.values() for n in nodes}
-    pending = [n for n in gd.node if n.name not in frame_member_names]
+    pending = [n for n in gd.node
+               if n.name not in frame_member_names and n.name in wanted]
     todo_frames = dict(frames)
     while True:
         pending, progressed = _sweep(imp, pending)

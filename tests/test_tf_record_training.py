@@ -1,0 +1,109 @@
+"""Imported-TF-graph training FROM TFRecord shards: the graph is cut at
+its ParseExample outputs and fed by the host-side ParseExample pipeline —
+the reference's record-reader-fed Session.train
+(utils/tf/Session.scala:43-109, TFRecordInputFormat, nn/tf/ParsingOps.scala,
+example/tensorflow)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+tf = pytest.importorskip("tensorflow")
+
+from tensorflow.python.framework.convert_to_constants import (  # noqa: E402
+    convert_variables_to_constants_v2)
+
+import bigdl_tpu.nn as nn  # noqa: E402
+from bigdl_tpu.dataset.tfrecord import TFRecordWriter  # noqa: E402
+from bigdl_tpu.optim import SGD, Trigger  # noqa: E402
+from bigdl_tpu.utils.session import Session  # noqa: E402
+
+BATCH = 8
+DIM, CLASSES = 4, 3
+
+
+def _freeze_parse_graph(tmp_path):
+    """serialized Examples -> parse {x, y} -> softmax(xW + b)."""
+    rs = np.random.RandomState(0)
+    w = tf.constant(rs.randn(DIM, CLASSES).astype(np.float32) * 0.1)
+    b = tf.constant(np.zeros(CLASSES, np.float32))
+
+    spec = {"x": tf.io.FixedLenFeature([DIM], tf.float32),
+            "y": tf.io.FixedLenFeature([], tf.int64)}
+
+    @tf.function
+    def f(serialized):
+        feats = tf.io.parse_example(serialized, spec)
+        return tf.nn.softmax(tf.linalg.matmul(feats["x"], w) + b)
+
+    cf = f.get_concrete_function(tf.TensorSpec([BATCH], tf.string))
+    gd = convert_variables_to_constants_v2(cf).graph.as_graph_def()
+    pb = str(tmp_path / "parse_graph.pb")
+    with open(pb, "wb") as fh:
+        fh.write(gd.SerializeToString())
+    out = [n.name for n in gd.node if n.op == "Softmax"][-1]
+    parse_ops = sorted({n.op for n in gd.node if "ParseExample" in n.op})
+    assert parse_ops, "graph has no parse node"
+    return pb, out
+
+
+def _write_records(tmp_path, n=96, seed=0):
+    centers = np.random.RandomState(77).randn(CLASSES, DIM) * 3
+    rs = np.random.RandomState(seed)
+    path = str(tmp_path / "train.tfrecord")
+    xs, ys = [], []
+    with TFRecordWriter(path) as w:
+        for i in range(n):
+            c = i % CLASSES
+            x = (centers[c] + rs.randn(DIM) * 0.3).astype(np.float32)
+            ex = tf.train.Example(features=tf.train.Features(feature={
+                "x": tf.train.Feature(
+                    float_list=tf.train.FloatList(value=x.tolist())),
+                "y": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[c]))}))
+            w.write(ex.SerializeToString())
+            xs.append(x)
+            ys.append(c)
+    return path, np.stack(xs), np.asarray(ys)
+
+
+class TestTrainFromRecords:
+    def test_session_trains_from_tfrecord_shards(self, tmp_path):
+        pb, out = _freeze_parse_graph(tmp_path)
+        rec, xs, ys = _write_records(tmp_path)
+
+        sess = Session(pb, [], [])
+        crit = nn.ClassNLLCriterion(log_prob_as_input=False)
+        model = sess.train_from_records(
+            [rec], [out], crit,
+            dense_keys=["x", "y"], dense_shapes=[(DIM,), ()],
+            label_key="y", batch_size=BATCH,
+            optim_method=SGD(learning_rate=0.5),
+            end_when=Trigger.max_epoch(8))
+
+        # accuracy on the training distribution after fitting
+        probs, _ = model.apply(sess.params, sess.state,
+                               jnp.asarray(xs[:BATCH]))
+        acc = float((np.argmax(np.asarray(probs), -1) == ys[:BATCH]).mean())
+        assert acc >= 0.9, acc
+
+    def test_missing_parse_node_errors(self, tmp_path):
+        rs = np.random.RandomState(0)
+        w = tf.constant(rs.randn(4, 2).astype(np.float32))
+
+        @tf.function
+        def f(x):
+            return tf.linalg.matmul(x, w)
+
+        cf = f.get_concrete_function(tf.TensorSpec([2, 4], tf.float32))
+        gd = convert_variables_to_constants_v2(cf).graph.as_graph_def()
+        pb = str(tmp_path / "plain.pb")
+        with open(pb, "wb") as fh:
+            fh.write(gd.SerializeToString())
+        sess = Session(pb, [], [])
+        with pytest.raises(ValueError, match="ParseExample"):
+            sess.train_from_records(
+                ["none.tfrecord"], ["MatMul"], nn.MSECriterion(),
+                dense_keys=["x"], dense_shapes=[(4,)], label_key="x",
+                batch_size=2)
