@@ -143,6 +143,54 @@ class Engine:
         return Engine.build_mesh(**mesh_shape)
 
     @staticmethod
+    def build_multislice_mesh(devices: Optional[Sequence] = None,
+                              slice_of=None, **axes: int) -> Mesh:
+        """Multislice mesh recipe: the OUTERMOST axis (put `data` first)
+        crosses slice boundaries — its collectives ride DCN — while every
+        inner axis (`model`/`sequence`/...) stays WITHIN one slice so its
+        collectives ride ICI.  This is the pod-scale layout the gradient
+        all-reduce wants: one DCN hop per step on the data axis, all
+        tensor-parallel traffic on ICI (survey §5.8 TPU-native note).
+
+        `slice_of(device)` maps a device to its slice id (defaults to the
+        device's `slice_index`, 0 when absent — single-slice devices
+        degrade to plain `build_mesh`).  Raises when an inner axis would
+        straddle a slice boundary.
+        """
+        pool = list(devices) if devices is not None else jax.devices()
+        if slice_of is None:
+            slice_of = lambda d: getattr(d, "slice_index", 0) or 0
+        groups: Dict[int, list] = {}
+        for d in pool:
+            groups.setdefault(int(slice_of(d)), []).append(d)
+        slice_sizes = {len(v) for v in groups.values()}
+        if len(slice_sizes) != 1:
+            raise ValueError(f"uneven slices: "
+                             f"{ {k: len(v) for k, v in groups.items()} }")
+        slice_size = slice_sizes.pop()
+        names = list(axes.keys())
+        sizes = list(axes.values())
+        if -1 in sizes:
+            known = int(np.prod([s for s in sizes if s != -1]))
+            sizes[sizes.index(-1)] = len(pool) // known
+        inner = int(np.prod(sizes[1:])) if len(sizes) > 1 else 1
+        if slice_size % inner != 0:
+            raise ValueError(
+                f"inner axes {dict(zip(names[1:], sizes[1:]))} "
+                f"(size {inner}) would straddle a slice of {slice_size} "
+                f"devices — keep model/sequence axes within one slice "
+                f"(ICI) and put the slice-crossing dimension on "
+                f"{names[0]!r}")
+        # slice-major device order => slice boundaries land on the
+        # outermost axis when the array is reshaped to the mesh shape
+        ordered = [d for k in sorted(groups) for d in groups[k]]
+        if int(np.prod(sizes)) != len(ordered):
+            raise ValueError(f"mesh {dict(zip(names, sizes))} != device "
+                             f"count {len(ordered)}")
+        dev_array = np.array(ordered).reshape(tuple(sizes))
+        return Mesh(dev_array, tuple(names))
+
+    @staticmethod
     def build_mesh(devices: Optional[Sequence] = None, **axes: int) -> Mesh:
         """Build a named-axis device mesh.
 

@@ -152,6 +152,19 @@ class Optimizer:
         self.processors = []
         return self
 
+    def set_profile(self, enabled: bool = True) -> "Optimizer":
+        """Per-layer fwd/bwd attribution on the LIVE training path
+        (reference: AbstractModule forwardTime/backwardTime accumulated in
+        every forward/backward, nn/abstractnn/AbstractModule.scala:254-288,
+        surfaced via getTimes()).  One jitted step has no per-layer host
+        timestamps — XLA fuses across layers — so after the first step the
+        trainer runs the per-child attribution harness
+        (optim/profiling.layer_times) on the live batch and surfaces the
+        shares through Metrics ("layer <name> forward/backward") and the
+        TrainSummary, then logs the getTimes()-style table."""
+        self._profile = enabled
+        return self
+
     # ------------------------------------------------------------------
     # Compilation
     # ------------------------------------------------------------------
@@ -411,6 +424,10 @@ class Optimizer:
                 dt = time.perf_counter() - t0
                 state["neval"] += 1
                 state["loss"] = loss_f
+                if getattr(self, "_profile", False) \
+                        and not getattr(self, "_profiled", False):
+                    self._profiled = True
+                    self._run_profile(x)
                 record_count_epoch += bs
                 throughput = bs / dt
                 self.metrics.add("computing time", dt)
@@ -445,6 +462,26 @@ class Optimizer:
         self.model.params = self.params
         self.model.state = self.model_state
         return self.model
+
+    def _run_profile(self, x) -> None:
+        from bigdl_tpu.optim.profiling import layer_times, summarize
+
+        try:
+            times = layer_times(self.model, self.params, self.model_state, x,
+                                training=True)
+        except ValueError as e:
+            logger.warning("profile=True: %s", e)
+            return
+        for t in times:
+            self.metrics.set(f"layer {t.name} forward", t.forward_s)
+            self.metrics.set(f"layer {t.name} backward", t.backward_s)
+            if self.train_summary is not None:
+                step = self._driver_state["neval"]
+                self.train_summary.add_scalar(
+                    f"LayerTime/{t.name}/forward_ms", t.forward_s * 1e3, step)
+                self.train_summary.add_scalar(
+                    f"LayerTime/{t.name}/backward_ms", t.backward_s * 1e3, step)
+        logger.info("per-layer times (live batch):\n%s", summarize(times))
 
     def _current_lr(self):
         if self.opt_state is None:

@@ -320,6 +320,28 @@ class TestTrainingLoop:
         with pytest.raises(FileNotFoundError):
             load_checkpoint(str(bad), params)
 
+    def test_live_per_layer_profile(self, tmp_path):
+        """profile=True surfaces per-layer fwd/bwd times through Metrics
+        and the TrainSummary (reference: AbstractModule getTimes)."""
+        from bigdl_tpu.utils import TrainSummary
+
+        ds = make_classification_dataset(n=64)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4),
+                              nn.LogSoftMax())
+        o = optim.LocalOptimizer(model, ds, nn.ClassNLLCriterion(),
+                                 optim_method=SGD(learning_rate=0.1),
+                                 end_trigger=Trigger.max_epoch(1))
+        o.set_train_summary(TrainSummary(str(tmp_path), "prof"))
+        o.set_profile()
+        o.optimize()
+        layer_metrics = [k for k in o.metrics._sums
+                         if k.startswith("layer ")]
+        assert any("forward" in k for k in layer_metrics), layer_metrics
+        assert any("backward" in k for k in layer_metrics), layer_metrics
+        scalars = o.train_summary.read_scalar(
+            f"LayerTime/{model[0].name}/forward_ms")
+        assert len(scalars) == 1
+
     def test_gradient_clipping(self):
         from bigdl_tpu.optim.parameter_processor import (
             ConstantClippingProcessor, L2NormClippingProcessor)
