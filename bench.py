@@ -8,10 +8,12 @@ TPU chip: full train step (fwd+bwd+SGD-momentum update+BN stats), bf16
 compute / fp32 params.
 
 vs_baseline: BigDL publishes no absolute throughput numbers
-(BASELINE.json published: {}); the comparison anchor is ~16 img/s for
-ResNet-50 training on a dual-socket Xeon Broadwell node — the hardware
-class of the whitepaper's scaling study (docs/docs/whitepaper.md:160-164) —
-a widely-reported public figure for that era's 2-socket CPU training.
+(BASELINE.json published: {}), so the anchor is an ESTIMATE: ~16 img/s
+for ResNet-50 training on one dual-socket Xeon Broadwell node, the
+hardware class of the whitepaper's scaling study
+(docs/docs/whitepaper.md:160-164).  Treat vs_baseline as indicative; the
+measured claims (batch sweep, XLA cost-analysis bytes/FLOPs, roofline
+saturation evidence) are in BENCH_APPENDIX.md + benchmarks/.
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -23,10 +25,11 @@ import numpy as np
 
 XEON_NODE_BASELINE_IMG_S = 16.0
 
-# Batch 256 is the measured throughput sweet spot on v5e (probed 128..512);
-# the step is HBM-bandwidth-bound (XLA cost analysis: ~77 GB/step -> 95 ms
-# roofline at 819 GB/s; measured ~102 ms), so larger batches only help until
-# temp HBM (~9 GB at 256) forces spills.
+# Batch 256 is the measured throughput sweet spot on v5e (sweep table in
+# BENCH_APPENDIX.md); the step is HBM-bandwidth-bound (XLA cost analysis:
+# 77.1 GB/step -> 94.1 ms roofline at 819 GB/s; measured 103.1 ms = 91% of
+# roofline) and remat was measured to INCREASE bytes (appendix), so the
+# standard step is the shipped configuration.
 BATCH = 256
 IMAGE = 224
 CLASSES = 1000

@@ -1,0 +1,113 @@
+"""Evidence-grade ResNet-50 training-throughput appendix.
+
+Produces the artifacts BENCH_APPENDIX.md records: a batch-size sweep with
+measured ms/step, XLA cost-analysis FLOPs and HBM bytes per step, and the
+derived roofline (v5e: ~197 TFLOP/s bf16, ~819 GB/s HBM), following the
+reference's measurement methodology (records / iteration wall time,
+models/utils/DistriOptimizerPerf.scala:32-86).
+
+Run on the TPU:
+  PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/bench_appendix.py
+"""
+
+import json
+import time
+
+import numpy as np
+
+V5E_BF16_FLOPS = 197e12
+V5E_HBM_BYTES_S = 819e9
+WARMUP, ITERS = 3, 20
+
+
+def build_step(model, optim, criterion):
+    import jax
+    import jax.numpy as jnp
+
+    def train_step(params, model_state, opt_state, x, y):
+        def loss_fn(p):
+            p16 = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16), p)
+            out, new_state = model.apply(p16, model_state, x, training=True,
+                                         rng=None)
+            return criterion.forward(out.astype(jnp.float32), y), new_state
+
+        (loss, new_model_state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params, new_opt_state = optim.step(grads, params, opt_state)
+        return new_params, new_model_state, new_opt_state, loss
+
+    return train_step
+
+
+def sweep(batches=(128, 192, 256, 320, 384), remat=False):
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models import resnet50
+    from bigdl_tpu.optim import SGD
+
+    rows = []
+    for batch in batches:
+        model = resnet50(1000, remat=remat)
+        shape = (batch, 224, 224, 3)
+        params, state, _ = model.build(jax.random.PRNGKey(0), shape)
+        optim = SGD(learning_rate=0.1, momentum=0.9, dampening=0.0)
+        opt_state = optim.init(params)
+        step = build_step(model, optim, nn.ClassNLLCriterion())
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.rand(*shape), jnp.bfloat16)
+        y = jnp.asarray(rs.randint(0, 1000, batch))
+
+        lowered = jax.jit(step, donate_argnums=(0, 1, 2)).lower(
+            params, state, opt_state, x, y)
+        compiled = lowered.compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        bytes_ = float(cost.get("bytes accessed", 0.0))
+
+        def sync(tree):
+            leaf = jax.tree_util.tree_leaves(tree)[0]
+            return float(jnp.sum(leaf.astype(jnp.float32)))
+
+        p, s, o = params, state, opt_state
+        for _ in range(WARMUP):
+            p, s, o, loss = compiled(p, s, o, x, y)
+        sync(p)
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            p, s, o, loss = compiled(p, s, o, x, y)
+        sync(p)
+        dt = (time.perf_counter() - t0) / ITERS
+
+        flop_floor = flops / V5E_BF16_FLOPS
+        hbm_floor = bytes_ / V5E_HBM_BYTES_S
+        roofline = max(flop_floor, hbm_floor)
+        rows.append({
+            "remat": remat,
+            "batch": batch,
+            "ms_per_step": round(dt * 1e3, 2),
+            "img_per_s": round(batch / dt, 1),
+            "tflops_per_step": round(flops / 1e12, 2),
+            "hbm_gb_per_step": round(bytes_ / 1e9, 2),
+            "flop_floor_ms": round(flop_floor * 1e3, 2),
+            "hbm_floor_ms": round(hbm_floor * 1e3, 2),
+            "roofline_ms": round(roofline * 1e3, 2),
+            "roofline_frac": round(roofline / dt, 3),
+            "bound": "HBM" if hbm_floor > flop_floor else "FLOP",
+        })
+        print(json.dumps(rows[-1]), flush=True)
+        del p, s, o, compiled, lowered
+    return rows
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--remat" in sys.argv:
+        rows = sweep(batches=(256, 384, 512), remat=True)
+    else:
+        rows = sweep()
+    print(json.dumps({"sweep": rows}))

@@ -93,8 +93,12 @@ def bottleneck(cin: int, planes: int, stride: int = 1,
 
 
 def ResNet(depth: int = 50, class_num: int = 1000,
-           dataset: str = "imagenet") -> nn.Sequential:
-    """reference: models/resnet/ResNet.scala apply()."""
+           dataset: str = "imagenet", remat: bool = False) -> nn.Sequential:
+    """reference: models/resnet/ResNet.scala apply().
+
+    remat=True wraps every residual block in nn.Remat (activations
+    recomputed in backward) — the HBM-bandwidth lever on training steps
+    with spare MXU headroom (BENCH_APPENDIX.md)."""
     if dataset == "imagenet":
         cfgs = {
             18: ([2, 2, 2, 2], basic_block, 1),
@@ -117,7 +121,8 @@ def ResNet(depth: int = 50, class_num: int = 1000,
             planes = 64 * (2 ** stage)
             for b in range(n_blocks):
                 stride = 2 if (stage > 0 and b == 0) else 1
-                layers.append(block_fn(cin, planes, stride))
+                block = block_fn(cin, planes, stride)
+                layers.append(nn.Remat(block) if remat else block)
                 cin = planes * expansion
         layers += [
             nn.GlobalAveragePooling2D(),
@@ -130,8 +135,8 @@ def ResNet(depth: int = 50, class_num: int = 1000,
     raise ValueError(f"unknown dataset {dataset}")
 
 
-def resnet50(class_num: int = 1000) -> nn.Sequential:
-    return ResNet(50, class_num)
+def resnet50(class_num: int = 1000, remat: bool = False) -> nn.Sequential:
+    return ResNet(50, class_num, remat=remat)
 
 
 def resnet_cifar(depth: int = 20, class_num: int = 10) -> nn.Sequential:

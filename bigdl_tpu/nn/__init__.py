@@ -195,6 +195,7 @@ from bigdl_tpu.nn.activation import (
     SReLU,
 )
 from bigdl_tpu.nn.structural import (
+    Remat,
     ResizeBilinear,
     Negative,
     Echo,

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.core.table import Table
-from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.module import Container, Module
 
 
 class Negative(Module):
@@ -400,3 +400,40 @@ class ResizeBilinear(Module):
     def output_shape(self, input_shape):
         n, _, _, c = input_shape
         return (n, self.out_hw[0], self.out_hw[1], c)
+
+
+class Remat(Container):
+    """Gradient checkpointing wrapper (`jax.checkpoint` around the child):
+    the child's internal activations are RECOMPUTED during backward instead
+    of stored to HBM.
+
+    No reference counterpart — the closest is shareGradInput's memory
+    aliasing (models/resnet/ResNet.scala), which XLA buffer reuse already
+    subsumes.  On an HBM-bandwidth-bound train step (ResNet-50 at batch
+    256 has ~3x more bandwidth demand than FLOP demand, see
+    BENCH_APPENDIX.md) rematerialization converts spare MXU FLOPs into
+    reduced activation traffic.
+    """
+
+    _constructor_children = True
+
+    def __init__(self, inner: Module, name: Optional[str] = None):
+        super().__init__(name)
+        self.children["inner"] = inner
+        self.inner = inner
+
+    def build(self, rng, input_shape):
+        p, s, out = self.inner.build(rng, input_shape)
+        return {"inner": p}, {"inner": s}, out
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        import jax as _jax
+
+        fn = _jax.checkpoint(
+            lambda p, xx: self.inner.apply(p, state["inner"], xx,
+                                           training=training, rng=rng))
+        out, new_s = fn(params["inner"], x)
+        return out, {"inner": new_s}
+
+    def output_shape(self, input_shape):
+        return self.inner.output_shape(input_shape)

@@ -122,3 +122,32 @@ class TestAutoencoder:
         m = Autoencoder(32)
         y, out_shape, _, _ = build_forward(m, (2, 28, 28, 1))
         assert y.shape == (2, 784) == tuple(out_shape)
+
+
+class TestRemat:
+    def test_remat_block_parity(self, rng):
+        """nn.Remat(checkpointed block) is numerically identical fwd+bwd."""
+        import jax
+        from bigdl_tpu.models.resnet import bottleneck
+
+        blk = bottleneck(16, 4)
+        p, s, _ = blk.build(rng, (2, 8, 8, 16))
+        wrap = nn.Remat(blk)
+        x = jnp.asarray(np.random.RandomState(0).rand(2, 8, 8, 16), jnp.float32)
+        y0, _ = blk.apply(p, s, x, training=True)
+        y1, _ = wrap.apply({"inner": p}, {"inner": s}, x, training=True)
+        np.testing.assert_array_equal(np.asarray(y0), np.asarray(y1))
+        g0 = jax.grad(lambda pp: jnp.sum(
+            blk.apply(pp, s, x, training=True)[0] ** 2))(p)
+        g1 = jax.grad(lambda pp: jnp.sum(
+            wrap.apply(pp, {"inner": s}, x, training=True)[0] ** 2))(
+            {"inner": p})
+        for a, b in zip(jax.tree_util.tree_leaves(g0),
+                        jax.tree_util.tree_leaves(g1)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_resnet_remat_flag_builds(self, rng):
+        from bigdl_tpu.models.resnet import ResNet
+
+        m = ResNet(18, class_num=4, remat=True)
+        assert any(type(c).__name__ == "Remat" for c in m.children.values())

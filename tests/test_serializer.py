@@ -200,6 +200,7 @@ EXEMPLARS = {
     "QuantizedLinear": (lambda: nn.QuantizedLinear(4, 3), lambda: rand(2, 4)),
     "WeightOnlyInt8": (lambda: nn.WeightOnlyInt8(nn.Linear(4, 3), min_size=1),
                        lambda: rand(2, 4)),
+    "Remat": (lambda: nn.Remat(nn.Linear(4, 3)), lambda: rand(2, 4)),
     "QuantizedSpatialConvolution": (
         lambda: nn.QuantizedSpatialConvolution(
             dict(n_input=3, n_output=4, kernel=(3, 3), stride=(1, 1),
