@@ -417,6 +417,31 @@ def load_caffe(def_path: str, model_path: Optional[str] = None,
                 if scale.size == 1:  # channel_shared
                     scale = np.full((bshape[-1],), float(scale[0]), np.float32)
                 weight_sets.append((l.name, {"weight": scale}))
+        elif ltype == "Slice":
+            sp = l.slice_param
+            axis = sp.axis if sp.HasField("axis") else \
+                (sp.slice_dim if sp.HasField("slice_dim") else 1)
+            ax = {0: 0, 1: 3, 2: 1, 3: 2}[axis % 4] if len(bshape) == 4 \
+                else axis
+            dim = bshape[ax]
+            points = [int(p_) for p_ in sp.slice_point]
+            if not points:  # even split over the tops
+                if dim % len(l.top):
+                    raise ValueError(f"Slice: {dim} not divisible by "
+                                     f"{len(l.top)} tops")
+                step = dim // len(l.top)
+                points = [step * i for i in range(1, len(l.top))]
+            bounds = [0] + points + [dim]
+            for k, t_ in enumerate(l.top):
+                start, stop = bounds[k], bounds[k + 1]
+                mod_k = nn.Narrow(ax, start, stop - start,
+                                  name=f"{l.name}_{k}")
+                node_k = mod_k(nodes[bottoms[0]])
+                nodes[t_] = node_k
+                sh = list(bshape)
+                sh[ax] = stop - start
+                shapes[t_] = tuple(sh)
+            continue
         elif ltype == "Split":
             for t_ in l.top:
                 nodes[t_] = nodes[bottoms[0]]
@@ -575,6 +600,16 @@ def save_caffe(model: nn.Module, params: Any, state: Any,
             l.dropout_param.dropout_ratio = m.p
         elif isinstance(m, nn.Flatten):
             l.type = "Flatten"
+        elif isinstance(m, nn.Sequential) and len(m) == 2 \
+                and isinstance(m[0], nn.Transpose) \
+                and isinstance(m[1], nn.Flatten):
+            # the importer's NCHW-order Flatten composite round-trips back
+            # to a caffe Flatten.  The downstream Linear's rows are ALREADY
+            # in caffe's C,H,W order (the composite transposes before
+            # flattening), so the dense-transition row reorder must NOT fire:
+            # m stays the Sequential, whose output_shape collapses the
+            # spatial dims without setting spatial_before_flatten.
+            l.type = "Flatten"
         elif isinstance(m, nn.SpatialBatchNormalization):
             l.type = "BatchNorm"
             l.batch_norm_param.eps = m.eps
@@ -601,6 +636,43 @@ def save_caffe(model: nn.Module, params: Any, state: Any,
                     sb.shape.dim.extend(arr.shape)
                     sb.data.extend(arr.tolist())
                 prev = sl.name
+        elif isinstance(m, nn.SpatialFullConvolution):
+            l.type = "Deconvolution"
+            cp = l.convolution_param
+            cp.num_output = m.n_output
+            kh, kw = m.kernel
+            cp.kernel_h, cp.kernel_w = kh, kw
+            cp.stride_h, cp.stride_w = m.stride
+            cp.pad_h, cp.pad_w = m.pad
+            cp.bias_term = m.with_bias
+            b = l.blobs.add()
+            # HWIO -> caffe deconv (in, out, kh, kw)
+            w = np.transpose(np.asarray(p["weight"]), (2, 3, 0, 1))
+            b.shape.dim.extend(w.shape)
+            b.data.extend(w.reshape(-1).tolist())
+            if m.with_bias:
+                bb = l.blobs.add()
+                bias = np.asarray(p["bias"])
+                bb.shape.dim.extend(bias.shape)
+                bb.data.extend(bias.tolist())
+        elif isinstance(m, nn.ELU):
+            l.type = "ELU"
+            l.elu_param.alpha = m.alpha
+        elif isinstance(m, nn.Abs):
+            l.type = "AbsVal"
+        elif isinstance(m, nn.Power):
+            l.type = "Power"
+            l.power_param.power = m.power
+            l.power_param.scale = m.scale
+            l.power_param.shift = m.shift
+        elif isinstance(m, nn.NormalizeScale):
+            l.type = "Normalize"
+            l.norm_param.across_spatial = bool(m.across_spatial)
+            l.norm_param.eps = m.eps
+            b = l.blobs.add()
+            scale = np.asarray(p["weight"]).reshape(-1)
+            b.shape.dim.extend(scale.shape)
+            b.data.extend(scale.tolist())
         else:
             raise ValueError(f"save_caffe: unsupported layer {type(m).__name__}")
         # track the activation shape for the dense transition
