@@ -288,7 +288,10 @@ class LSTM(_Rnn):
 
 class GRU(_Rnn):
     def _cell(self, input_size):
-        return nn.GRUCell(input_size, self.output_dim)
+        # keras-1 GRU semantics: reset gate applies BEFORE the hidden
+        # matmul (keras/layers/recurrent.py), which reset_after=False
+        # implements exactly — so keras-1 GRU weights import bit-exactly
+        return nn.GRUCell(input_size, self.output_dim, reset_after=False)
 
 
 class SimpleRNN(_Rnn):

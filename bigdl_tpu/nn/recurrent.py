@@ -144,14 +144,21 @@ class LSTMCell(Cell):
 
 
 class GRUCell(Cell):
-    """GRU cell, all gates packed (order: r, z, n); the reset gate is applied
-    AFTER the hidden matmul so the three hidden projections fuse into one
-    (H, 3H) MXU matmul.  reference: nn/GRU.scala."""
+    """GRU cell, all gates packed (order: r, z, n).
 
-    def __init__(self, input_size: int, hidden_size: int, name: Optional[str] = None):
+    reset_after=True (default, torch convention): the reset gate applies
+    AFTER the hidden matmul, so the three hidden projections fuse into one
+    (H, 3H) MXU matmul.  reset_after=False (keras-1 convention,
+    keras/layers/recurrent.py GRU: tanh(x W + (r*h) U)): the n-gate hidden
+    projection runs on r*h — one extra (H, H) matmul, but keras-1.2.2 GRU
+    weights import EXACTLY.  reference: nn/GRU.scala."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 reset_after: bool = True, name: Optional[str] = None):
         super().__init__(name)
         self.input_size = input_size
         self.hidden_size = hidden_size
+        self.reset_after = reset_after
 
     def build(self, rng, input_shape):
         k1, k2 = jax.random.split(rng)
@@ -167,12 +174,20 @@ class GRUCell(Cell):
 
     def step(self, params, x_t, hidden):
         gi = x_t @ params["w_ih"] + params["bias"]
-        gh = hidden @ params["w_hh"]
         gi_r, gi_z, gi_n = jnp.split(gi, 3, axis=-1)
-        gh_r, gh_z, gh_n = jnp.split(gh, 3, axis=-1)
-        r = jax.nn.sigmoid(gi_r + gh_r)
-        z = jax.nn.sigmoid(gi_z + gh_z)
-        n = jnp.tanh(gi_n + r * gh_n)
+        if self.reset_after:
+            gh = hidden @ params["w_hh"]
+            gh_r, gh_z, gh_n = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(gi_r + gh_r)
+            z = jax.nn.sigmoid(gi_z + gh_z)
+            n = jnp.tanh(gi_n + r * gh_n)
+        else:
+            h2 = self.hidden_size * 2
+            gh_rz = hidden @ params["w_hh"][:, :h2]
+            gh_r, gh_z = jnp.split(gh_rz, 2, axis=-1)
+            r = jax.nn.sigmoid(gi_r + gh_r)
+            z = jax.nn.sigmoid(gi_z + gh_z)
+            n = jnp.tanh(gi_n + (r * hidden) @ params["w_hh"][:, h2:])
         h = (1.0 - z) * n + z * hidden
         return h, h
 
@@ -221,8 +236,10 @@ def LSTM(input_size: int, hidden_size: int, name: Optional[str] = None) -> Recur
     return Recurrent(LSTMCell(input_size, hidden_size), name=name)
 
 
-def GRU(input_size: int, hidden_size: int, name: Optional[str] = None) -> Recurrent:
-    return Recurrent(GRUCell(input_size, hidden_size), name=name)
+def GRU(input_size: int, hidden_size: int, reset_after: bool = True,
+        name: Optional[str] = None) -> Recurrent:
+    return Recurrent(GRUCell(input_size, hidden_size,
+                             reset_after=reset_after), name=name)
 
 
 def RnnLayer(input_size: int, hidden_size: int, activation=jnp.tanh,

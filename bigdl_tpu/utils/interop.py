@@ -134,18 +134,38 @@ def _import_lstm_cell(m: LSTMCell, g: Dict[str, np.ndarray]):
             "bias": jnp.asarray(bias)}, {}
 
 
-def _import_gru_cell(m: GRUCell, g: Dict[str, np.ndarray]):
+def _import_gru_cell(m: GRUCell, g: Dict[str, np.ndarray],
+                     approximate: bool = False):
+    """torch GRU applies the reset gate INSIDE the hidden matmul's bias
+    (n = tanh(b_in + x W_in + r * (h W_hn + b_hn))); the fused-gate cell
+    applies r after the matmul with no inner bias, so a nonzero b_hn is
+    not exactly representable.
+
+    approximate=True folds b_hn into the input-side n bias.  The
+    pre-activation error is (1 - r) * b_hn elementwise, so per step
+    |Δn| <= |b_hn| (tanh is 1-Lipschitz) and |Δh| <= (1-z)|b_hn| —
+    the importer logs the max |b_hn| as the bound."""
     _check_single_layer_rnn("GRU", g)
     h = m.hidden_size
     _, b_hh = _rnn_bias(g, 3 * h)
-    if np.abs(b_hh[2 * h:]).max() > 1e-6:
+    b_hn_max = float(np.abs(b_hh[2 * h:]).max())
+    if b_hn_max > 1e-6 and not approximate:
         raise ValueError(
-            "torch GRU has a nonzero hidden bias on the n-gate (b_hn); the "
-            "fused-gate GRU cell cannot represent it exactly — retrain or "
+            "torch GRU has a nonzero hidden bias on the n-gate (b_hn; max "
+            f"|b_hn| = {b_hn_max:.4g}); the fused-gate GRU cell cannot "
+            "represent it exactly — pass approximate=True to fold it into "
+            "the input bias (per-step pre-activation error <= |b_hn|), or "
             "zero b_hn before importing")
     b_ih, _ = _rnn_bias(g, 3 * h)
     bias = b_ih.copy()
     bias[:2 * h] += b_hh[:2 * h]  # r,z hidden biases fold into the input bias
+    if b_hn_max > 1e-6:
+        import logging
+
+        bias[2 * h:] += b_hh[2 * h:]
+        logging.getLogger("bigdl_tpu.interop").warning(
+            "approximate GRU import: folded b_hn into the input n bias; "
+            "per-step pre-activation error bound max|b_hn| = %.4g", b_hn_max)
     return {"w_ih": jnp.asarray(_np(g["weight_ih_l0"]).T),
             "w_hh": jnp.asarray(_np(g["weight_hh_l0"]).T),
             "bias": jnp.asarray(bias)}, {}
@@ -242,14 +262,16 @@ def _importer_for(m: Module):
 
 
 def import_torch_state_dict(module: Module, params: Any, state: Any,
-                            state_dict: Dict[str, Any]) -> Tuple[Any, Any]:
+                            state_dict: Dict[str, Any],
+                            approximate: bool = False) -> Tuple[Any, Any]:
     """Load a torch state dict into (params, state) built for `module`.
 
     Matches our parameterized leaves (execution order) against the state
     dict's layer groups (insertion order) — the positional discipline the
     reference's Keras converter uses (pyspark/bigdl/keras/converter.py).
     Returns NEW params/state trees; inputs are not mutated.
-    """
+    `approximate=True` permits convention-gap imports with a logged error
+    bound (currently: GRU b_hn folding)."""
     groups = list(_group_state_dict(state_dict).values())
     leaves = _leaf_modules(module)
     if len(groups) != len(leaves):
@@ -257,7 +279,13 @@ def import_torch_state_dict(module: Module, params: Any, state: Any,
             f"layer count mismatch: our model has {len(leaves)} parameterized "
             f"layers, torch state dict has {len(groups)} groups")
 
-    converted = {id(m): _importer_for(m)(m, g) for m, g in zip(leaves, groups)}
+    def _convert(m, g):
+        fn = _importer_for(m)
+        if fn is _import_gru_cell:
+            return fn(m, g, approximate=approximate)
+        return fn(m, g)
+
+    converted = {id(m): _convert(m, g) for m, g in zip(leaves, groups)}
 
     from bigdl_tpu.keras.layers import KerasLayer  # local: avoid cycle
 
@@ -430,11 +458,31 @@ def import_keras_weights(module: Module, params: Any, state: Any,
             sd[f"{i}.bias_hh_l0"] = np.zeros(
                 sd[f"{i}.bias_ih_l0"].shape, np.float32)
         elif isinstance(m, GRUCell):
-            raise ValueError(
-                f"layer {i}: keras-1 GRU applies the reset gate BEFORE the "
-                f"hidden matmul (tanh(x W + (r*h) U)); this fused cell "
-                f"applies it after (torch convention) — the math differs, "
-                f"so weights cannot be imported exactly")
+            if m.reset_after:
+                raise ValueError(
+                    f"layer {i}: keras-1 GRU applies the reset gate BEFORE "
+                    f"the hidden matmul (tanh(x W + (r*h) U)); the fused "
+                    f"reset-after cell applies it after (torch convention) "
+                    f"— build the model with GRUCell(reset_after=False) "
+                    f"for an EXACT import")
+            # keras-1.2.2 GRU trainable_weights: (W,U,b) per gate in
+            # z, r, h build order (keras/layers/recurrent.py GRU.build);
+            # our packed order is r, z, n — reorder and pack.  Same math
+            # as the reset_after=False cell, so the import is exact.
+            if len(ws) != 9:
+                raise ValueError(
+                    f"layer {i}: expected 9 keras-1 GRU weights (W,U,b x "
+                    f"3 gates), got {len(ws)}")
+            gate = {"z": 0, "r": 3, "h": 6}
+            order = ["r", "z", "h"]  # our packed order
+            sd[f"{i}.weight_ih_l0"] = np.concatenate(
+                [np.asarray(ws[gate[g]]).T for g in order], axis=0)
+            sd[f"{i}.weight_hh_l0"] = np.concatenate(
+                [np.asarray(ws[gate[g] + 1]).T for g in order], axis=0)
+            sd[f"{i}.bias_ih_l0"] = np.concatenate(
+                [np.asarray(ws[gate[g] + 2]) for g in order])
+            sd[f"{i}.bias_hh_l0"] = np.zeros(
+                sd[f"{i}.bias_ih_l0"].shape, np.float32)
         elif isinstance(m, RnnCell):
             # keras-1 SimpleRNN: [W (in,h), U (h,h), b] — same math as
             # RnnCell (tanh(x W + h U + b)); emit torch RNN-layout keys

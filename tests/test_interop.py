@@ -593,3 +593,50 @@ def tensor_to_np(const_node):
     from bigdl_tpu.utils.tensorflow import tensor_to_ndarray
 
     return tensor_to_ndarray(const_node.attr["value"].tensor)
+
+
+def test_import_gru_approximate_with_bound():
+    """approximate=True folds b_hn into the input n bias; per-step
+    pre-activation error <= max|b_hn| (documented bound)."""
+    t, b, f, h = 4, 2, 3, 5
+    tm = torch.nn.GRU(f, h, batch_first=True)
+    with torch.no_grad():
+        tm.bias_hh_l0[2 * h:] = 0.05  # small but nonzero b_hn
+    our = nn.GRU(f, h)
+    params, state, _ = our.build(jax.random.PRNGKey(0), (b, t, f))
+    params, state = interop.import_torch_state_dict(
+        our, params, state, tm.state_dict(), approximate=True)
+    x = np.random.RandomState(3).randn(b, t, f).astype(np.float32)
+    with torch.no_grad():
+        want, _ = tm(torch.from_numpy(x))
+    got, _ = our.apply(params, state, jnp.asarray(x))
+    err = float(np.abs(np.asarray(got) - want.numpy()).max())
+    # per-step bound max|b_hn| = 0.05, loose accumulation factor over T=4
+    assert err < 0.05 * t, err
+    assert err > 0  # genuinely approximate
+
+
+def test_keras1_gru_exact_with_reset_before_cell():
+    """GRUCell(reset_after=False) implements the keras-1 convention
+    (tanh(x W + (r*h) U)), so keras-1 GRU weights import EXACTLY —
+    differential oracle: tf.keras GRU(reset_after=False)."""
+    tf = pytest.importorskip("tensorflow")
+
+    f, h, b, t = 3, 5, 2, 6
+    layer = tf.keras.layers.GRU(h, reset_after=False, return_sequences=True,
+                                activation="tanh",
+                                recurrent_activation="sigmoid")
+    x = np.random.RandomState(0).randn(b, t, f).astype(np.float32)
+    want = layer(x).numpy()
+    kernel, rec, bias = [np.asarray(w) for w in layer.get_weights()]
+    # consolidated (in, 3h) in z, r, h gate order -> 9 keras-1 arrays
+    ws = []
+    for g in range(3):
+        ws += [kernel[:, g * h:(g + 1) * h], rec[:, g * h:(g + 1) * h],
+               bias[g * h:(g + 1) * h]]
+
+    our = nn.GRU(f, h, reset_after=False)
+    params, state, _ = our.build(jax.random.PRNGKey(0), (b, t, f))
+    params, state = interop.import_keras_weights(our, params, state, [ws])
+    got, _ = our.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
