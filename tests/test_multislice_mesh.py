@@ -57,3 +57,52 @@ class TestMultisliceMesh:
     def test_default_single_slice_degrades(self):
         mesh = Engine.build_multislice_mesh(**{AXIS_DATA: 8})
         assert mesh.devices.shape == (8,)
+
+
+class TestSliceFailureDrill:
+    def test_resume_on_smaller_mesh_after_slice_loss(self, tmp_path):
+        """Elastic story (survey §5.3): lose a slice -> resume the latest
+        checkpoint on the surviving half-size mesh and keep training.
+        Checkpoints are mesh-independent (host numpy, re-placed at
+        _init_model), so the drill is a resume with a different mesh."""
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu import optim
+        from bigdl_tpu.core.random import RandomGenerator
+        from bigdl_tpu.dataset import ArrayDataSet, Sample, SampleToMiniBatch
+        from bigdl_tpu.optim import SGD, Trigger
+
+        def make_ds(seed=0):
+            centers = np.random.RandomState(1234).randn(4, 8) * 3
+            rs = np.random.RandomState(seed)
+            samples = [Sample.from_ndarray(
+                (centers[i % 4] + rs.randn(8) * 0.3).astype(np.float32),
+                np.int32(i % 4)) for i in range(128)]
+            return ArrayDataSet(samples).transform(SampleToMiniBatch(32))
+
+        RandomGenerator.set_seed(9)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4),
+                              nn.LogSoftMax())
+        full = Engine.build_mesh(**{AXIS_DATA: 8})  # both slices alive
+        o1 = optim.DistriOptimizer(model, make_ds(), nn.ClassNLLCriterion(),
+                                   optim_method=SGD(learning_rate=0.2),
+                                   mesh=full,
+                                   end_trigger=Trigger.max_epoch(2))
+        o1.set_checkpoint(str(tmp_path / "ck"), Trigger.every_epoch())
+        o1.optimize()
+        loss_before = o1._driver_state["loss"]
+
+        # slice 1 dies: surviving devices form a half-size mesh
+        survivors = Engine.build_mesh(devices=jax.devices()[:4],
+                                      **{AXIS_DATA: 4})
+        model2 = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4),
+                               nn.LogSoftMax())
+        o2 = optim.DistriOptimizer(model2, make_ds(), nn.ClassNLLCriterion(),
+                                   optim_method=SGD(learning_rate=0.2),
+                                   mesh=survivors,
+                                   end_trigger=Trigger.max_epoch(4))
+        o2.resume_from(str(tmp_path / "ck"))
+        o2.optimize()
+        # resumed mid-run state, continued, and kept improving
+        assert o2._driver_state["epoch"] == 4
+        assert o2._driver_state["loss"] <= loss_before * 1.5
+        assert o2._driver_state["loss"] < 0.2, o2._driver_state["loss"]
