@@ -639,6 +639,21 @@ class _TFImporter:
             self._attach(name, cls(name=name), [data_inputs[0]])
         elif op in ("Reciprocal", "Inv"):
             self._attach(name, nn.Power(-1.0, name=name), [data_inputs[0]])
+        elif op == "BiasAddV1":
+            c = self.const_of(data_inputs[1])
+            self._attach(name, nn.CAdd(c.shape, name=name), [data_inputs[0]],
+                         {"bias": c})
+        elif op == "Substr":
+            for di in data_inputs[:3]:
+                if self._key(di) not in self.graph_nodes:
+                    self._ensure_node(di, anchor=graph_in[0])
+            self._attach(name, nn.ops.Substr(name=name), data_inputs[:3])
+        elif op == "Assert":
+            # runtime assertion on host-fed graphs: importing as a pass-
+            # through keeps the data path intact (reference maps Assert to
+            # a control node, utils/tf/loaders/Assert.scala)
+            self._alias(name, data_inputs[0])
+            return
         elif op in ("FloorDiv", "FloorMod", "Mod", "TruncateMod",
                     "TruncateDiv", "LogicalAnd", "LogicalOr", "NotEqual",
                     "ApproximateEqual"):

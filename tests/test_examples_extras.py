@@ -625,3 +625,14 @@ class TestConverterWidening:
         r = ex.main(["--epochs", "3", "--samples", "256", "--seq-len", "32"])
         assert 0.0 <= r["BinaryAccuracy"] <= 1.0
         assert r["BinaryAccuracy"] > 0.6  # separable synthetic classes
+
+    def test_pipelined_lm_example(self):
+        import examples.pipelined_lm as ex
+
+        ex.main()  # asserts loss < log(vocab) internally
+
+    def test_int8_inference_example(self, capsys):
+        import examples.int8_inference as ex
+
+        ex.main()  # asserts drift bounds internally
+        assert "weight-only int8" in capsys.readouterr().out
