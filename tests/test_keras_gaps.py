@@ -196,3 +196,51 @@ class TestObjectiveRegistry:
                           ("squared_hinge", "MarginCriterion")]:
             assert type(resolve_loss(name)).__name__ == cls
         assert resolve_loss("squared_hinge").squared
+
+
+class TestKerasJsonGRU:
+    def test_keras1_json_gru_flow(self, tmp_path):
+        """keras-1 model.to_json() with a GRU layer now loads end-to-end:
+        the Keras-API GRU builds the reset-before cell, and 9-array
+        keras-1 GRU weights import exactly (differential oracle:
+        tf.keras GRU(reset_after=False))."""
+        import json
+
+        tf = pytest.importorskip("tensorflow")
+        from bigdl_tpu.keras.converter import load_keras_model
+        from bigdl_tpu.utils import interop
+
+        f, h, b, t = 3, 5, 2, 6
+        cfg = {
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "GRU",
+                 "config": {"output_dim": h, "return_sequences": True,
+                            "activation": "tanh",
+                            "inner_activation": "sigmoid",
+                            "batch_input_shape": [None, t, f],
+                            "name": "gru_1"}},
+            ],
+        }
+        jpath = tmp_path / "m.json"
+        jpath.write_text(json.dumps(cfg))
+        model, params, state = load_keras_model(str(jpath),
+                                                input_shape=(b, t, f))
+
+        # oracle weights from tf.keras GRU(reset_after=False)
+        layer = tf.keras.layers.GRU(h, reset_after=False,
+                                    return_sequences=True,
+                                    activation="tanh",
+                                    recurrent_activation="sigmoid")
+        x = np.random.RandomState(0).randn(b, t, f).astype(np.float32)
+        want = layer(x).numpy()
+        kernel, rec, bias = [np.asarray(w) for w in layer.get_weights()]
+        ws = []
+        for g in range(3):  # z, r, h gate order = keras-1 build order
+            ws += [kernel[:, g * h:(g + 1) * h], rec[:, g * h:(g + 1) * h],
+                   bias[g * h:(g + 1) * h]]
+        params, state = interop.import_keras_weights(model, params, state,
+                                                     [ws])
+        got, _ = model.apply(params, state, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                                   atol=1e-5)
