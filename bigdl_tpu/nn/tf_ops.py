@@ -554,6 +554,69 @@ class TFWhile(Module):
         return Table(*final), state
 
 
+class TFCond(Module):
+    """Structured import of a v1 tf.cond region (standalone Switch/Merge,
+    reference: nn/tf/ControlOps.scala SwitchOps/MergeOps +
+    utils/tf/loaders/ControlFlowOps.scala) lowered to `lax.cond`.
+
+    Input Table(pred, d_1..d_n); `then_graph`/`else_graph` map the data
+    inputs (Table when n > 1) to the branch value."""
+
+    _constructor_children = True
+
+    def __init__(self, then_graph: Module, else_graph: Module,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.then_graph = then_graph
+        self.else_graph = else_graph
+
+    def _data(self, items):
+        data = items[1:]
+        return Table(*data) if len(data) > 1 else data[0]
+
+    def build(self, rng, input_shape):
+        shapes = list(input_shape) if isinstance(input_shape, Table) \
+            else [input_shape]
+        dshape = self._data(shapes)
+        k1, k2 = jax.random.split(jnp.asarray(rng))
+        pt, st, out = self.then_graph.build(k1, dshape)
+        pe, se, _ = self.else_graph.build(k2, dshape)
+        return ({"then": pt, "else": pe}, {"then": st, "else": se}, out)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        items = list(x) if isinstance(x, Table) else [x]
+        pred = jnp.asarray(items[0]).reshape(())
+        data = tuple(jnp.asarray(v) for v in items[1:])
+
+        def run(graph, pkey):
+            def fn(d):
+                arg = Table(*d) if len(d) > 1 else d[0]
+                out, _ = graph.apply(params[pkey], state[pkey], arg,
+                                     training=training, rng=rng)
+                return out
+
+            return fn
+
+        out = jax.lax.cond(pred, run(self.then_graph, "then"),
+                           run(self.else_graph, "else"), data)
+        return out, state
+
+
+class MergeSelect(Module):
+    """{pred, true_value, false_value} -> where(pred, t, f).  The import
+    lowering of a standalone v1 Switch/Merge cond region: both branches
+    compute (pure graphs — same math), Merge selects.  Differentiable
+    (gradients flow through the taken branch)."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        pred, t, f = list(x)[:3]
+        return jnp.where(jnp.asarray(pred).reshape(()), jnp.asarray(t),
+                         jnp.asarray(f)), state
+
+    def output_shape(self, input_shape):
+        return list(input_shape)[1]
+
+
 class TensorArray:
     """Growable list of tensors keyed by index
     (reference: DataFlowOps.scala:176-576 TensorArray* ops)."""

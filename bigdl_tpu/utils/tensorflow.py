@@ -219,6 +219,29 @@ class _TFImporter:
         self._attach(name, nn.MM(trans_a=trans_a, trans_b=trans_b, name=name),
                      data_inputs[:2])
 
+    def _cond_branch_side(self, ref: str):
+        """(side, pred_ref) for a standalone-cond Merge input: walk back to
+        the nearest Switch; the output index consumed (:1 true, :0 false)
+        identifies the branch."""
+        seen = set()
+        stack = [ref]
+        while stack:
+            r = stack.pop()
+            base = _clean(r)
+            if base in seen:
+                continue
+            seen.add(base)
+            nd = self.nodes_by_name.get(base)
+            if nd is None:
+                continue
+            if nd.op == "Switch":
+                idx = r.split(":")[1] if ":" in r else "0"
+                pred = getattr(self, "_switch_pred", {}).get(base,
+                                                             nd.input[1])
+                return (1 if idx == "1" else 0), pred
+            stack.extend(i for i in nd.input if not i.startswith("^"))
+        raise ValueError(f"no Switch ancestor for merge input {ref!r}")
+
     def _alias(self, tf_name: str, src: str):
         src = self._key(src)
         self.graph_nodes[tf_name] = self.graph_nodes[src]
@@ -803,6 +826,32 @@ class _TFImporter:
             self._attach(name, nn.ops.Dilation2D(
                 strides=strides, rates=rates, padding=pad, name=name),
                 data_inputs[:2])
+        elif op == "Switch":
+            # standalone v1 tf.cond (frames' Switches never reach here —
+            # their nodes are frame members): both outputs alias the data
+            # value; the Merge selects on the predicate
+            # (reference: nn/tf/ControlOps.scala SwitchOps)
+            self._alias(name, data_inputs[0])
+            self.graph_nodes[f"{name}:1"] = self.graph_nodes[name]
+            self.shapes[f"{name}:1"] = self.shapes[name]
+            if not hasattr(self, "_switch_pred"):
+                self._switch_pred = {}
+            self._switch_pred[name] = data_inputs[1]
+        elif op == "Merge":
+            from bigdl_tpu.nn import tf_ops as _tf
+
+            sides = [self._cond_branch_side(r) for r in data_inputs[:2]]
+            if sorted(s for s, _ in sides) != [0, 1]:
+                raise ValueError(
+                    f"Merge {name!r}: could not identify true/false branch "
+                    f"sides {sides}")
+            pred_ref = sides[0][1]
+            true_ref = data_inputs[0] if sides[0][0] == 1 else data_inputs[1]
+            false_ref = data_inputs[1] if sides[0][0] == 1 else data_inputs[0]
+            if self._key(pred_ref) not in self.graph_nodes:
+                self._ensure_node(pred_ref, anchor=graph_in[0])
+            self._attach(name, _tf.MergeSelect(name=name),
+                         [pred_ref, true_ref, false_ref])
         elif op == "TensorArrayV3":
             # handle (:0) is dead plumbing; flow (:1) becomes a dense
             # buffer, materialized where consumed (Scatter or frame import)

@@ -550,6 +550,8 @@ OPS_EXEMPLARS = {
     "tf.TensorArrayWriteOp": lambda: nn.tf_ops.TensorArrayWriteOp(),
     "tf.TFWhile": lambda: nn.tf_ops.TFWhile(
         _tiny_graph(), _tiny_graph(), n_vars=1, trip_count=2),
+    "tf.TFCond": lambda: nn.tf_ops.TFCond(_tiny_graph(), _tiny_graph()),
+    "tf.MergeSelect": lambda: nn.tf_ops.MergeSelect(),
 }
 EXEMPLARS.update({k: (v, None) for k, v in OPS_EXEMPLARS.items()})
 

@@ -147,3 +147,48 @@ class TestHandBuiltWhile:
         while want.sum() < 100.0:
             want = want * 2.0
         np.testing.assert_allclose(y, want, rtol=1e-6)
+
+
+class TestStandaloneCond:
+    def _build(self, tmp_path):
+        import tf_graph_pb2 as tfp
+
+        gd = tfp.GraphDef()
+        _nodedef(gd, "x", "Placeholder")
+        _nodedef(gd, "thr", "Const", value=np.asarray(10.0, np.float32))
+        _nodedef(gd, "ten", "Const", value=np.asarray(10.0, np.float32))
+        _nodedef(gd, "two", "Const", value=np.asarray(2.0, np.float32))
+        _nodedef(gd, "axis0", "Const", value=np.asarray(0, np.int32))
+        _nodedef(gd, "s", "Sum", ["x", "axis0"])
+        _nodedef(gd, "pred", "Less", ["s", "thr"])
+        _nodedef(gd, "sw", "Switch", ["x", "pred"])
+        _nodedef(gd, "tbr", "Mul", ["sw:1", "two"])      # pred true: x*2
+        _nodedef(gd, "fbr", "Add", ["sw", "ten"])        # pred false: x+10
+        _nodedef(gd, "mg", "Merge", ["fbr", "tbr"])
+        _nodedef(gd, "out", "Identity", ["mg"])
+        pb = str(tmp_path / "cond.pb")
+        with open(pb, "wb") as fh:
+            fh.write(gd.SerializeToString())
+        return load_tensorflow(pb, ["x"], ["out"], [(4,)])
+
+    def test_both_predicate_outcomes(self, tmp_path):
+        g, gp, gs = self._build(tmp_path)
+        small = np.asarray([1.0, 1.0, 1.0, 1.0], np.float32)   # sum < 10
+        big = np.asarray([5.0, 5.0, 5.0, 5.0], np.float32)     # sum >= 10
+        y_small = np.asarray(g.apply(gp, gs, jnp.asarray(small))[0])
+        y_big = np.asarray(g.apply(gp, gs, jnp.asarray(big))[0])
+        np.testing.assert_allclose(y_small, small * 2.0)
+        np.testing.assert_allclose(y_big, big + 10.0)
+
+    def test_cond_is_differentiable(self, tmp_path):
+        g, gp, gs = self._build(tmp_path)
+
+        def f(x):
+            return jnp.sum(g.apply(gp, gs, x)[0])
+
+        grad_small = np.asarray(jax.grad(f)(jnp.asarray(
+            [1.0, 1.0, 1.0, 1.0], dtype=jnp.float32)))
+        grad_big = np.asarray(jax.grad(f)(jnp.asarray(
+            [5.0, 5.0, 5.0, 5.0], dtype=jnp.float32)))
+        np.testing.assert_allclose(grad_small, np.full(4, 2.0))
+        np.testing.assert_allclose(grad_big, np.full(4, 1.0))
