@@ -116,21 +116,22 @@ class TransformerLM(Module):
             return (out, i + 1), None
 
         if self.pipeline_axis is not None and _axis_bound(self.pipeline_axis):
-            if training and rng is not None and self.dropout > 0:
-                raise NotImplementedError(
-                    "dropout under pipeline parallelism is not supported "
-                    "yet; build the pipelined TransformerLM with dropout=0")
             from bigdl_tpu.parallel.pipeline import pipeline_apply
 
-            def layer_fn(lp, hh):
-                out, _ = blk.apply(lp, {}, hh, training=training, rng=None)
+            def layer_fn(lp, hh, uid):
+                # dropout rng: fold by the schedule's (microbatch, layer)
+                # uid so every pipelined block application draws a
+                # distinct mask
+                r = None if rng is None else jax.random.fold_in(rng, uid)
+                out, _ = blk.apply(lp, {}, hh, training=training, rng=r)
                 return out
 
             h = pipeline_apply(layer_fn, params["blocks"], h,
                                n_microbatch=self.pipeline_microbatches,
                                axis_name=self.pipeline_axis,
                                remat=self.remat,
-                               interleave=self.pipeline_interleave)
+                               interleave=self.pipeline_interleave,
+                               with_uid=True)
         elif self.scan_layers:
             fn = jax.checkpoint(body) if self.remat else body
             (h, _), _ = lax.scan(fn, (h, 0), params["blocks"])

@@ -56,11 +56,12 @@ def _local_stack(stage_params: Any) -> int:
     return k
 
 
-def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+def pipeline_apply(stage_fn: Callable[..., jnp.ndarray],
                    stage_params: Any, x: jnp.ndarray, n_microbatch: int,
                    axis_name: str = AXIS_PIPELINE,
                    remat: bool = False,
-                   interleave: bool = False) -> jnp.ndarray:
+                   interleave: bool = False,
+                   with_uid: bool = False) -> jnp.ndarray:
     """Run `stage_fn` (ONE layer: params-without-stack-dim, h -> h) as a
     pipeline over `axis_name`.  MUST be called inside `shard_map` with
     `stage_params` carrying a leading layer-stacked dim sharded
@@ -69,6 +70,10 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     `n_microbatch` equal microbatches.  Layers apply in global stacked
     order: device d holds layers [d*k, (d+1)*k).  Returns the pipeline
     output, replicated to every stage.
+
+    with_uid=True calls `stage_fn(layer_params, h, uid)` where `uid` is a
+    scalar unique per (microbatch, global layer) — the RNG-folding handle
+    for dropout inside pipelined blocks.
     """
     n_stage = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
@@ -80,13 +85,14 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     mb = b // n_microbatch
     micro = x.reshape((n_microbatch, mb) + x.shape[1:])
 
-    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    raw = stage_fn if with_uid else (lambda p, h, uid: stage_fn(p, h))
+    fn = jax.checkpoint(raw) if remat else raw
     # activation shape probe (pipelined layers must be shape-preserving so
     # the relay buffer has one static shape; true of transformer blocks —
     # shape-CHANGING ends like embed/head run outside the pipelined region)
     probe_params = jax.tree_util.tree_map(lambda a: a[0], my_params)
     out_struct = jax.eval_shape(fn, probe_params, jax.ShapeDtypeStruct(
-        micro.shape[1:], micro.dtype))
+        micro.shape[1:], micro.dtype), jax.ShapeDtypeStruct((), jnp.int32))
     assert out_struct.shape == micro.shape[1:], (
         f"pipelined layers must preserve activation shape, got "
         f"{out_struct.shape} vs {micro.shape[1:]}")
@@ -105,12 +111,16 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     return outputs.reshape((b,) + x.shape[1:])
 
 
-def _apply_group(fn, my_params, h):
-    """Apply all k local layers in stacked order (one GPipe tick)."""
-    def body(h, layer_params):
-        return fn(layer_params, h), None
+def _apply_group(fn, my_params, h, base_uid):
+    """Apply all k local layers in stacked order (one GPipe tick).  Layer
+    j's uid = base_uid + j (base encodes microbatch and device offset)."""
+    k = jax.tree_util.tree_leaves(my_params)[0].shape[0]
 
-    h, _ = lax.scan(body, h, my_params)
+    def body(h, pj):
+        layer_params, j = pj
+        return fn(layer_params, h, (base_uid + j).astype(jnp.int32)), None
+
+    h, _ = lax.scan(body, h, (my_params, jnp.arange(k)))
     return h
 
 
@@ -134,7 +144,9 @@ def _gpipe_schedule(fn, my_params, micro, n_stage, idx, axis_name, k):
         # the relayed activation from the previous stage
         feed = micro[jnp.minimum(t, n_microbatch - 1)]
         inp = jnp.where(idx == 0, feed, relay)
-        out = _apply_group(fn, my_params, inp)
+        # the microbatch this device computes at tick t is m = t - idx
+        m = jnp.clip(t - idx, 0, n_microbatch - 1)
+        out = _apply_group(fn, my_params, inp, m * (n_stage * k) + idx * k)
         # the LAST stage finished microbatch t - (S-1) this tick
         done = t - (n_stage - 1)
         outputs = jnp.where(
@@ -182,7 +194,9 @@ def _interleaved_schedule(fn, my_params, micro, n_stage, idx, axis_name, v):
             my_params)
         feed = micro[jnp.clip(m, 0, n_microbatch - 1)]
         inp = jnp.where(vs == 0, feed, relay)
-        out = fn(layer_params, inp)
+        uid = jnp.clip(m, 0, n_microbatch - 1) * (v * n_stage) \
+            + jnp.clip(vs, 0, v * n_stage - 1)
+        out = fn(layer_params, inp, uid.astype(jnp.int32))
         # keep the relay clean on idle ticks so a microbatch's activation
         # survives the ring hop even if schedule holes appear
         out = jnp.where(active, out, relay)

@@ -8,16 +8,33 @@ match the public tensorflow framework protos, so real frozen graphs parse.
 
 TF is already NHWC/HWIO — no layout conversion (the reference spends much
 of its loader translating NHWC to its NCHW layers; this framework IS
-NHWC).  Supported ops: Const, Placeholder, Identity, Conv2D,
-DepthwiseConv2dNative, BiasAdd, MatMul, Relu, Relu6, Tanh, Sigmoid, Elu,
-Softplus, Softmax, MaxPool, AvgPool, FusedBatchNorm(V3), Reshape, Squeeze,
-Add/AddV2/Sub/Mul/Maximum/Minimum/RealDiv/Div/Pow/SquaredDifference,
-ConcatV2, Pad, Mean/Sum/Max/Min/Prod, LogSoftmax/Softsign/LeakyRelu, unary
-math (Sqrt/Rsqrt/Square/Exp/Log/Log1p/Expm1/Abs/Neg/Floor/Round/Rint/Erf),
-ExpandDims/Transpose/Cast/Shape/Rank/Tile/Slice/StridedSlice/Gather(V2),
-comparisons + Select(V2), ArgMax, OneHot, LRN, ResizeBilinear,
-Split/SplitV (multi-output ':k' references), BatchMatMul(V2/V3) and
-dynamic-x-dynamic MatMul (attention-style graphs), Conv2DBackpropInput.
+NHWC).  ~100 supported ops: Const, Placeholder, Identity, Conv2D,
+DepthwiseConv2dNative, Conv3D (asymmetric SAME via pre-pad), Dilation2D,
+BiasAdd(V1), MatMul, Relu, Relu6, Tanh, Sigmoid, Elu, Softplus, Softmax,
+MaxPool, AvgPool, FusedBatchNorm(V3), Reshape, Squeeze,
+Add/AddV2/AddN/Sub/Mul/Maximum/Minimum/RealDiv/Div/FloorDiv/FloorMod/Mod/
+TruncateDiv/TruncateMod/Pow/SquaredDifference, ConcatV2, Pad,
+Mean/Sum/Max/Min/Prod/All/Any, LogSoftmax/Softsign/LeakyRelu, unary math
+(Sqrt/Rsqrt/Square/Exp/Log/Log1p/Expm1/Abs/Neg/Floor/Ceil/Round/Rint/Erf/
+Erfc/Lgamma/Digamma/Sign/Reciprocal/Inv/IsFinite/IsInf/IsNan),
+ExpandDims/Transpose/Cast/Shape/Rank/Tile/Slice/StridedSlice/Gather(V2)/
+Pack/Unpack/Fill/Range, comparisons + logical ops + Select(V2)/NotEqual/
+ApproximateEqual, ArgMax, OneHot, TopK(V2) (values+indices), InTopK(V2),
+L2Loss, SegmentSum, SoftmaxCrossEntropyWithLogits (loss+backprop), LRN,
+ResizeBilinear, Split/SplitV (multi-output ':k' references),
+BatchMatMul(V2/V3) and dynamic-x-dynamic MatMul (attention-style graphs),
+Conv2DBackpropInput, RandomUniform, DecodeJpeg/Png/Bmp/Gif/Raw, Substr,
+Assert.
+
+Control flow: v1 while frames (Enter/Merge/Switch/Exit/NextIteration +
+TensorArrayV3 machinery) import as structured TFWhile — lax.scan when the
+trip count is static (differentiable; dynamic_rnn-class graphs fine-tune),
+lax.while_loop otherwise; standalone Switch/Merge (v1 tf.cond) lower to a
+differentiable select.  Queue/reader input chains (QueueDequeue*,
+ReaderReadV2) are not executed as ops: the importer converts only
+ancestors of the requested outputs, and Session.train_from_records cuts
+the graph at its ParseExample outputs, feeding records host-side —
+mirroring the reference's Session input rewiring (Session.scala:43-109).
 
 `load_tensorflow(pb_path, inputs, outputs)` -> (Graph, params, state);
 `save_tensorflow(model, params, state, path, input_shape)` exports a

@@ -233,3 +233,29 @@ class TestShardedDistriOptimizer:
         mesh = Engine.build_mesh(**{AXIS_DATA: 4, AXIS_MODEL: 2})
         with pytest.raises(ValueError, match="dims"):
             train(mesh, rules)
+
+    def test_pipeline_with_dropout_trains(self):
+        """Dropout inside pipelined blocks: the schedule's (microbatch,
+        layer) uid folds the rng, so training runs (no raise) and loss is
+        finite."""
+        from bigdl_tpu.models import TransformerLM
+
+        RandomGenerator.set_seed(31)
+        model = TransformerLM(vocab_size=32, hidden_size=16, n_layer=4,
+                              n_head=2, dropout=0.1, use_flash=False,
+                              scan_layers=True, pipeline_axis="pipeline",
+                              pipeline_microbatches=4)
+        rs = np.random.RandomState(3)
+        toks = rs.randint(0, 32, (16, 9))
+        samples = [Sample.from_ndarray(t[:-1].astype(np.int32),
+                                       t[1:].astype(np.int32)) for t in toks]
+        ds = ArrayDataSet(samples).transform(SampleToMiniBatch(8))
+        mesh = Engine.build_mesh(**{AXIS_DATA: 2, "pipeline": 4})
+        o = optim.DistriOptimizer(
+            model, ds, nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                                   True),
+            optim_method=Adam(learning_rate=1e-2), mesh=mesh,
+            sharding_rules=ShardingRules().add(r"^blocks/", P("pipeline")),
+            end_trigger=Trigger.max_iteration(2))
+        o.optimize()
+        assert np.isfinite(o._driver_state["loss"])
