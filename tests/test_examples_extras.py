@@ -459,7 +459,11 @@ class TestConverterWidening:
             h = np.tanh(x[:, t_] @ W + h @ U + b)
         np.testing.assert_allclose(np.asarray(y), h, rtol=1e-4, atol=1e-5)
 
-    def test_keras_gru_weight_import_raises_clearly(self):
+    def test_keras_gru_weight_import_now_exact(self):
+        """Round-2 change: the Keras-API GRU builds the reset-before cell
+        (GRUCell(reset_after=False)), so 9-array keras-1 GRU weights load
+        without error; exactness vs tf.keras is covered in
+        tests/test_interop.py / test_keras_gaps.py."""
         from bigdl_tpu.keras.converter import (model_from_json_config,
                                                load_keras_weights)
 
@@ -470,9 +474,12 @@ class TestConverterWidening:
         model = model_from_json_config(spec)
         params, state, _ = model.build(jax.random.PRNGKey(0), (1, 4, 2))
         rs = np.random.RandomState(1)
-        ws = [rs.randn(2, 3).astype("f") for _ in range(9)]
-        with pytest.raises(ValueError, match="reset gate"):
-            load_keras_weights(model, params, state, [ws])
+        ws = ([rs.randn(2, 3).astype("f"), rs.randn(3, 3).astype("f"),
+               rs.randn(3).astype("f")] * 3)
+        params, state = load_keras_weights(model, params, state, [ws])
+        y, _ = model.apply(params, state,
+                           jnp.asarray(rs.randn(1, 4, 2), jnp.float32))
+        assert np.isfinite(np.asarray(y)).all()
 
     def test_timedistributed_dense_weight_import(self):
         from bigdl_tpu.keras.converter import (model_from_json_config,
