@@ -153,7 +153,7 @@ class GRUCell(Cell):
     projection runs on r*h — one extra (H, H) matmul, but keras-1.2.2 GRU
     weights import EXACTLY.  reference: nn/GRU.scala."""
 
-    def __init__(self, input_size: int, hidden_size: int,
+    def __init__(self, input_size: int, hidden_size: int, *,
                  reset_after: bool = True, name: Optional[str] = None):
         super().__init__(name)
         self.input_size = input_size
@@ -236,7 +236,7 @@ def LSTM(input_size: int, hidden_size: int, name: Optional[str] = None) -> Recur
     return Recurrent(LSTMCell(input_size, hidden_size), name=name)
 
 
-def GRU(input_size: int, hidden_size: int, reset_after: bool = True,
+def GRU(input_size: int, hidden_size: int, *, reset_after: bool = True,
         name: Optional[str] = None) -> Recurrent:
     return Recurrent(GRUCell(input_size, hidden_size,
                              reset_after=reset_after), name=name)

@@ -111,10 +111,9 @@ def pipeline_apply(stage_fn: Callable[..., jnp.ndarray],
     return outputs.reshape((b,) + x.shape[1:])
 
 
-def _apply_group(fn, my_params, h, base_uid):
+def _apply_group(fn, my_params, h, base_uid, k):
     """Apply all k local layers in stacked order (one GPipe tick).  Layer
     j's uid = base_uid + j (base encodes microbatch and device offset)."""
-    k = jax.tree_util.tree_leaves(my_params)[0].shape[0]
 
     def body(h, pj):
         layer_params, j = pj
@@ -146,7 +145,8 @@ def _gpipe_schedule(fn, my_params, micro, n_stage, idx, axis_name, k):
         inp = jnp.where(idx == 0, feed, relay)
         # the microbatch this device computes at tick t is m = t - idx
         m = jnp.clip(t - idx, 0, n_microbatch - 1)
-        out = _apply_group(fn, my_params, inp, m * (n_stage * k) + idx * k)
+        out = _apply_group(fn, my_params, inp,
+                           m * (n_stage * k) + idx * k, k)
         # the LAST stage finished microbatch t - (S-1) this tick
         done = t - (n_stage - 1)
         outputs = jnp.where(

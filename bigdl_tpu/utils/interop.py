@@ -135,7 +135,7 @@ def _import_lstm_cell(m: LSTMCell, g: Dict[str, np.ndarray]):
 
 
 def _import_gru_cell(m: GRUCell, g: Dict[str, np.ndarray],
-                     approximate: bool = False):
+                     approximate: bool = False, convention: str = "torch"):
     """torch GRU applies the reset gate INSIDE the hidden matmul's bias
     (n = tanh(b_in + x W_in + r * (h W_hn + b_hn))); the fused-gate cell
     applies r after the matmul with no inner bias, so a nonzero b_hn is
@@ -146,6 +146,14 @@ def _import_gru_cell(m: GRUCell, g: Dict[str, np.ndarray],
     |Δn| <= |b_hn| (tanh is 1-Lipschitz) and |Δh| <= (1-z)|b_hn| —
     the importer logs the max |b_hn| as the bound."""
     _check_single_layer_rnn("GRU", g)
+    if convention == "torch" and not m.reset_after:
+        raise ValueError(
+            "torch GRU weights follow the reset-AFTER convention; this "
+            "cell was built with reset_after=False (keras-1 reset-before "
+            "math) — the recurrences differ, so the import would be "
+            "silently wrong.  Build the model with GRUCell(reset_after="
+            "True) for torch imports (use import_keras_weights for "
+            "keras-1 GRU weights).")
     h = m.hidden_size
     _, b_hh = _rnn_bias(g, 3 * h)
     b_hn_max = float(np.abs(b_hh[2 * h:]).max())
@@ -263,7 +271,8 @@ def _importer_for(m: Module):
 
 def import_torch_state_dict(module: Module, params: Any, state: Any,
                             state_dict: Dict[str, Any],
-                            approximate: bool = False) -> Tuple[Any, Any]:
+                            approximate: bool = False,
+                            _convention: str = "torch") -> Tuple[Any, Any]:
     """Load a torch state dict into (params, state) built for `module`.
 
     Matches our parameterized leaves (execution order) against the state
@@ -282,7 +291,7 @@ def import_torch_state_dict(module: Module, params: Any, state: Any,
     def _convert(m, g):
         fn = _importer_for(m)
         if fn is _import_gru_cell:
-            return fn(m, g, approximate=approximate)
+            return fn(m, g, approximate=approximate, convention=_convention)
         return fn(m, g)
 
     converted = {id(m): _convert(m, g) for m, g in zip(leaves, groups)}
@@ -498,7 +507,11 @@ def import_keras_weights(module: Module, params: Any, state: Any,
                 f"no keras weight importer for {type(m).__name__} — this "
                 f"layer converts definition-only (weights must be set "
                 f"manually on the params tree)")
-    return import_torch_state_dict(module, params, state, sd)
+    # keras-origin weights: the GRU reset-before convention is carried by
+    # the CELL (reset_after=False), so the torch-convention guard must not
+    # fire on this path
+    return import_torch_state_dict(module, params, state, sd,
+                                   _convention="keras")
 
 
 # ---------------------------------------------------------------------------

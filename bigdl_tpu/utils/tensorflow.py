@@ -331,7 +331,7 @@ class _TFImporter:
             self._attach_dynamic_matmul(name, data_inputs, graph_in,
                                         bool(nd.attr["adj_x"].b),
                                         bool(nd.attr["adj_y"].b))
-        elif op == "BiasAdd":
+        elif op in ("BiasAdd", "BiasAddV1"):
             b = self.const_of(data_inputs[1])
             m = nn.CAdd(b.shape, name=name)
             self._attach(name, m, [data_inputs[0]], {"bias": b})
@@ -679,10 +679,6 @@ class _TFImporter:
             self._attach(name, cls(name=name), [data_inputs[0]])
         elif op in ("Reciprocal", "Inv"):
             self._attach(name, nn.Power(-1.0, name=name), [data_inputs[0]])
-        elif op == "BiasAddV1":
-            c = self.const_of(data_inputs[1])
-            self._attach(name, nn.CAdd(c.shape, name=name), [data_inputs[0]],
-                         {"bias": c})
         elif op == "Substr":
             for di in data_inputs[:3]:
                 if self._key(di) not in self.graph_nodes:
@@ -862,6 +858,14 @@ class _TFImporter:
                 raise ValueError(
                     f"Merge {name!r}: could not identify true/false branch "
                     f"sides {sides}")
+            if _clean(sides[0][1]) != _clean(sides[1][1]):
+                # nested conds: the nearest-Switch walk found different
+                # predicates — selecting on either would be silently wrong
+                raise NotImplementedError(
+                    f"Merge {name!r}: branches trace to Switches with "
+                    f"different predicates ({sides[0][1]!r} vs "
+                    f"{sides[1][1]!r}) — nested tf.cond import is not "
+                    f"supported")
             pred_ref = sides[0][1]
             true_ref = data_inputs[0] if sides[0][0] == 1 else data_inputs[1]
             false_ref = data_inputs[1] if sides[0][0] == 1 else data_inputs[0]

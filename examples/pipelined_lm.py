@@ -26,6 +26,11 @@ def main():
     from bigdl_tpu.parallel import ShardingRules
 
     n_dev = jax.device_count()
+    if n_dev < 2 or n_dev % 2:
+        raise SystemExit(
+            f"pipelined_lm needs an even device count >= 2 (got {n_dev}); "
+            f"run with JAX_PLATFORMS=cpu "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=8")
     pp = 4 if n_dev % 4 == 0 else 2
     dp = n_dev // pp
     mesh = Engine.build_mesh(**{AXIS_DATA: dp, AXIS_PIPELINE: pp})

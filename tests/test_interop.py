@@ -640,3 +640,13 @@ def test_keras1_gru_exact_with_reset_before_cell():
     params, state = interop.import_keras_weights(our, params, state, [ws])
     got, _ = our.apply(params, state, jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_torch_gru_into_reset_before_cell_raises():
+    """torch weights follow reset-AFTER math; loading them into a
+    reset_after=False (keras-convention) cell must fail loudly."""
+    tm = torch.nn.GRU(3, 5, batch_first=True)
+    our = nn.GRU(3, 5, reset_after=False)
+    params, state, _ = our.build(jax.random.PRNGKey(0), (2, 4, 3))
+    with pytest.raises(ValueError, match="reset-AFTER"):
+        interop.import_torch_state_dict(our, params, state, tm.state_dict())
