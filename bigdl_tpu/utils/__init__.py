@@ -3,6 +3,7 @@ from bigdl_tpu.utils.summary import TrainSummary, ValidationSummary
 from bigdl_tpu.utils.torchfile import load_t7, save_t7, TorchObject
 from bigdl_tpu.utils.logger_filter import redirect_verbose_logs, undo_redirect
 from bigdl_tpu.utils.ir import IRGraph, CompiledGraph
+from bigdl_tpu.utils.fusion import fold_batchnorm
 from bigdl_tpu.utils.serializer import (
     save_model,
     load_model,

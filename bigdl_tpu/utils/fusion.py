@@ -1,0 +1,109 @@
+"""Inference-graph fusion: fold BatchNorm into the preceding convolution /
+linear layer.
+
+Reference: nn/mkldnn/Fusion.scala:26-31 (conv+bn fusion inside
+DnnGraph.compile) — the one reference fusion XLA canNOT reproduce on its
+own: under jit, params/state are runtime ARGUMENTS, so the compiler must
+keep the BN normalize as live elementwise work every step.  Folding at the
+framework level bakes the (frozen) running statistics into the conv
+weights once, deleting the BN's per-activation multiply/add entirely:
+
+  scale = gamma / sqrt(running_var + eps)
+  w'    = w * scale        (per output channel)
+  b'    = (b - running_mean) * scale + beta
+
+Inference-only by construction (training BN uses batch statistics).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+import bigdl_tpu.nn as nn
+
+
+def _fold_pair(conv, conv_p, bn, bn_p, bn_s):
+    gamma = bn_p.get("weight") if bn.affine else None
+    beta = bn_p.get("bias") if bn.affine else None
+    mean = jnp.asarray(bn_s["running_mean"])
+    var = jnp.asarray(bn_s["running_var"])
+    scale = (jnp.asarray(gamma) if gamma is not None else 1.0) \
+        / jnp.sqrt(var + bn.eps)
+    w = jnp.asarray(conv_p["weight"])
+    # conv weight HWIO / linear weight (in, out): out channel is LAST
+    new_w = w * scale
+    bias = jnp.asarray(conv_p["bias"]) if "bias" in conv_p \
+        else jnp.zeros_like(mean)
+    new_b = (bias - mean) * scale
+    if beta is not None:
+        new_b = new_b + jnp.asarray(beta)
+    return {"weight": new_w, "bias": new_b}
+
+
+def _foldable(prev, cur) -> bool:
+    if not isinstance(cur, nn.BatchNormalization):
+        return False
+    if isinstance(prev, nn.SpatialConvolution):
+        # grouped convs keep out-channel last too — still foldable
+        return True
+    return isinstance(prev, nn.Linear)
+
+
+def fold_batchnorm(model: nn.Module, params: Any, state: Any
+                   ) -> Tuple[nn.Module, Any, Any]:
+    """Return (model', params', state') with every conv/linear + BN pair
+    fused for INFERENCE.  Works on Sequential chains (and recurses into
+    nested Sequentials); layers keep their names, the folded conv gains a
+    bias, and the BN is replaced by Identity so downstream indices and
+    serialized shapes stay aligned."""
+    if not isinstance(model, nn.Sequential):
+        return model, params, state
+    keys = list(model.children.keys())
+    mods = list(model.children.values())
+    new_model = nn.Sequential(name=model.name)
+    new_params, new_state = {}, {}
+    i = 0
+    out_keys = []
+    while i < len(mods):
+        m, key = mods[i], keys[i]
+        p = params.get(key, {}) if isinstance(params, dict) else {}
+        s = state.get(key, {}) if isinstance(state, dict) else {}
+        nxt = mods[i + 1] if i + 1 < len(mods) else None
+        if nxt is not None and _foldable(m, nxt):
+            bn_key = keys[i + 1]
+            bn_p = params.get(bn_key, {})
+            bn_s = state.get(bn_key, {})
+            folded = _fold_pair(m, p, nxt, bn_p, bn_s)
+            if isinstance(m, nn.SpatialConvolution):
+                fm = nn.SpatialConvolution(
+                    m.n_input, m.n_output, m.kernel[1], m.kernel[0],
+                    m.stride[1], m.stride[0], m.pad[1], m.pad[0],
+                    n_group=m.n_group, with_bias=True)
+                fm.dilation = tuple(m.dilation)
+            else:
+                fm = nn.Linear(m.input_size, m.output_size, with_bias=True)
+            fm.name = m.name
+            new_model.children[key] = fm
+            new_params[key] = folded
+            new_state[key] = {}
+            ident = nn.Identity()
+            ident.name = nxt.name
+            new_model.children[bn_key] = ident
+            new_params[bn_key] = {}
+            new_state[bn_key] = {}
+            out_keys += [key, bn_key]
+            i += 2
+            continue
+        if isinstance(m, nn.Sequential):
+            fm, fp, fs = fold_batchnorm(m, p, s)
+            new_model.children[key] = fm
+            new_params[key], new_state[key] = fp, fs
+        else:
+            new_model.children[key] = m
+            new_params[key], new_state[key] = p, s
+        out_keys.append(key)
+        i += 1
+    return new_model, new_params, new_state

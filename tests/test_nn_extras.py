@@ -539,3 +539,46 @@ class TestPaddingUpsamplingCrop:
         y, _ = nn.GlobalMaxPooling2D().apply({}, {}, x)
         np.testing.assert_allclose(np.asarray(y),
                                    np.asarray(x).max(axis=(1, 2)))
+
+
+class TestFoldBatchNorm:
+    def test_conv_bn_fold_parity(self, rng):
+        """fold_batchnorm bakes frozen BN stats into conv weights: same
+        inference outputs, BN layers gone (reference:
+        nn/mkldnn/Fusion.scala conv+bn)."""
+        from bigdl_tpu.utils.fusion import fold_batchnorm
+
+        model = nn.Sequential(
+            nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1, with_bias=False),
+            nn.SpatialBatchNormalization(8), nn.ReLU(),
+            nn.SpatialConvolution(8, 4, 3, 3, 2, 2, 1, 1),
+            nn.SpatialBatchNormalization(4), nn.ReLU(),
+            nn.Flatten(), nn.Linear(4 * 4 * 4, 6),
+            nn.BatchNormalization(6), nn.LogSoftMax())
+        params, state, _ = model.build(rng, (2, 8, 8, 3))
+        # non-trivial running stats and affine params
+        rs = np.random.RandomState(0)
+        for k in list(state):
+            if "running_mean" in (state[k] or {}):
+                state[k]["running_mean"] = jnp.asarray(
+                    rs.randn(state[k]["running_mean"].shape[0]), jnp.float32)
+                state[k]["running_var"] = jnp.asarray(
+                    0.5 + rs.rand(state[k]["running_var"].shape[0]),
+                    jnp.float32)
+        for k in list(params):
+            if isinstance(params[k], dict) and "weight" in params[k] \
+                    and params[k]["weight"].ndim == 1:
+                params[k]["weight"] = jnp.asarray(
+                    1.0 + rs.rand(*params[k]["weight"].shape), jnp.float32)
+
+        x = jnp.asarray(rs.rand(2, 8, 8, 3), jnp.float32)
+        want, _ = model.apply(params, state, x, training=False)
+
+        fm, fp, fs = fold_batchnorm(model, params, state)
+        got, _ = fm.apply(fp, fs, x, training=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+        kinds = [type(m).__name__ for m in fm.children.values()]
+        assert "SpatialBatchNormalization" not in kinds
+        assert "BatchNormalization" not in kinds
+        assert kinds.count("Identity") == 3
