@@ -63,6 +63,16 @@ def bench_resnet():
     fwd16 = jax.jit(lambda p, s, x: model.apply(p, s, x, training=False)[0])
     results["bf16"] = _time_fn(fwd16, p16, state, x)
 
+    # conv+BN folded serving graph (utils/fusion.py): deletes the BN
+    # elementwise passes the compiler must otherwise keep live
+    from bigdl_tpu.utils.fusion import fold_batchnorm
+
+    fmodel, fparams, fstate = fold_batchnorm(model, params, state)
+    fp16 = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16), fparams)
+    ffwd = jax.jit(lambda p, s, x, m=fmodel: m.apply(p, s, x,
+                                                     training=False)[0])
+    results["bf16_bnfold"] = _time_fn(ffwd, fp16, fstate, x)
+
     for mode in ("dynamic", "static", "weight_only"):
         qm, qp = nn.quantize(model, params, mode=mode)
         if mode == "static":

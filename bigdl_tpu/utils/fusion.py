@@ -52,13 +52,94 @@ def _foldable(prev, cur) -> bool:
     return isinstance(prev, nn.Linear)
 
 
+def _replacement_conv(m):
+    if isinstance(m, nn.SpatialConvolution):
+        fm = nn.SpatialConvolution(
+            m.n_input, m.n_output, m.kernel[1], m.kernel[0],
+            m.stride[1], m.stride[0], m.pad[1], m.pad[0],
+            n_group=m.n_group, with_bias=True)
+        fm.dilation = tuple(m.dilation)
+    else:
+        fm = nn.Linear(m.input_size, m.output_size, with_bias=True)
+    fm.name = m.name
+    return fm
+
+
+def _fold_graph(g, params: Any, state: Any):
+    """Fold conv+BN pairs inside a Graph: a BN node whose single producer
+    is a conv/linear consumed by nothing else."""
+    from collections import defaultdict
+
+    consumers = defaultdict(int)
+    for node in g.topo:
+        for p_ in node.prevs:
+            consumers[id(p_)] += 1
+    for out in g.output_nodes:
+        consumers[id(out)] += 1
+
+    fold_conv: dict = {}   # id(conv node) -> folded params
+    fold_bn: set = set()   # id(bn node)
+    new_params, new_state = dict(params), dict(state)
+    for node in g.topo:
+        m = node.module
+        if m is None or not isinstance(m, nn.BatchNormalization):
+            continue
+        if len(node.prevs) != 1:
+            continue
+        prev = node.prevs[0]
+        pm = prev.module
+        if pm is None or not _foldable(pm, m) or consumers[id(prev)] != 1:
+            continue
+        folded = _fold_pair(pm, params.get(prev.name, {}), m,
+                            params.get(node.name, {}),
+                            state.get(node.name, {}))
+        fold_conv[id(prev)] = folded
+        fold_bn.add(id(node))
+        new_params[prev.name] = folded
+        new_params[node.name] = {}
+        new_state[node.name] = {}
+
+    if not fold_bn:
+        return g, params, state
+
+    mapping: dict = {}
+
+    def walk(node):
+        if id(node) in mapping:
+            return mapping[id(node)]
+        prevs = [walk(p_) for p_ in node.prevs]
+        if node.module is None:
+            new = nn.Input(name=node.name)
+            new.name = node.name
+        else:
+            if id(node) in fold_conv:
+                mod = _replacement_conv(node.module)
+            elif id(node) in fold_bn:
+                mod = nn.Identity()
+                mod.name = node.module.name
+            else:
+                mod = node.module
+            new = mod(*prevs)
+            new.name = node.name
+        mapping[id(node)] = new
+        return new
+
+    new_inputs = [walk(n) for n in g.input_nodes]
+    new_outputs = [walk(n) for n in g.output_nodes]
+    ng = nn.Graph(new_inputs, new_outputs)
+    ng.name = g.name
+    return ng, new_params, new_state
+
+
 def fold_batchnorm(model: nn.Module, params: Any, state: Any
                    ) -> Tuple[nn.Module, Any, Any]:
     """Return (model', params', state') with every conv/linear + BN pair
-    fused for INFERENCE.  Works on Sequential chains (and recurses into
-    nested Sequentials); layers keep their names, the folded conv gains a
-    bias, and the BN is replaced by Identity so downstream indices and
-    serialized shapes stay aligned."""
+    fused for INFERENCE.  Works on Sequential chains and Graph models
+    (recursing into nested containers); layers keep their names, the
+    folded conv gains a bias, and the BN is replaced by Identity so
+    downstream indices and serialized shapes stay aligned."""
+    if isinstance(model, nn.Graph):
+        return _fold_graph(model, params, state)
     if not isinstance(model, nn.Sequential):
         return model, params, state
     keys = list(model.children.keys())
@@ -97,7 +178,7 @@ def fold_batchnorm(model: nn.Module, params: Any, state: Any
             out_keys += [key, bn_key]
             i += 2
             continue
-        if isinstance(m, nn.Sequential):
+        if isinstance(m, (nn.Sequential, nn.Graph)):
             fm, fp, fs = fold_batchnorm(m, p, s)
             new_model.children[key] = fm
             new_params[key], new_state[key] = fp, fs

@@ -582,3 +582,41 @@ class TestFoldBatchNorm:
         assert "SpatialBatchNormalization" not in kinds
         assert "BatchNormalization" not in kinds
         assert kinds.count("Identity") == 3
+
+    def test_graph_resnet_fold_parity(self, rng):
+        """Graph folding: every conv+BN pair inside ResNet-18's residual
+        blocks folds; outputs match and no BN remains anywhere."""
+        from bigdl_tpu.models.resnet import ResNet
+        from bigdl_tpu.utils.fusion import fold_batchnorm
+
+        model = ResNet(18, class_num=6)
+        params, state, _ = model.build(rng, (2, 32, 32, 3))
+        rs = np.random.RandomState(1)
+
+        def jitter(tree):
+            for k, v in tree.items():
+                if isinstance(v, dict):
+                    if "running_mean" in v:
+                        c = v["running_mean"].shape[0]
+                        v["running_mean"] = jnp.asarray(rs.randn(c) * 0.2,
+                                                        jnp.float32)
+                        v["running_var"] = jnp.asarray(0.5 + rs.rand(c),
+                                                       jnp.float32)
+                    else:
+                        jitter(v)
+
+        jitter(state)
+        x = jnp.asarray(rs.rand(2, 32, 32, 3), jnp.float32)
+        want, _ = model.apply(params, state, x, training=False)
+        fm, fp, fs = fold_batchnorm(model, params, state)
+        got, _ = fm.apply(fp, fs, x, training=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+        def no_bn(m):
+            if isinstance(m, nn.BatchNormalization):
+                return False
+            children = getattr(m, "children", {})
+            return all(no_bn(c) for c in children.values())
+
+        assert no_bn(fm)
