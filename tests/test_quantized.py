@@ -224,3 +224,24 @@ class TestStaticAndWeightOnly:
         params, _, _ = layer.build(rng, (2, 8))
         with pytest.raises(ValueError, match="mode"):
             nn.quantize(layer, params, mode="int4")
+
+
+def test_fold_then_static_int8_stack(rng):
+    """The serving stack: fold conv+BN, then calibrated static int8 — the
+    two measured inference levers compose."""
+    from bigdl_tpu.models.resnet import ResNet
+    from bigdl_tpu.utils.fusion import fold_batchnorm
+
+    model = ResNet(18, class_num=6)
+    params, state, _ = model.build(rng, (2, 32, 32, 3))
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(4, 32, 32, 3), jnp.float32)
+    want, _ = model.apply(params, state, x, training=False)
+
+    fm, fp, fs = fold_batchnorm(model, params, state)
+    qm, qp = nn.quantize(fm, fp, mode="static")
+    qp = nn.calibrate(qm, qp, fs, [x])
+    got, _ = qm.apply(qp, fs, x, training=False)
+    # log-probs: compare class probabilities
+    drift = float(jnp.max(jnp.abs(jnp.exp(got) - jnp.exp(want))))
+    assert drift < 0.08, drift
