@@ -808,6 +808,41 @@ class _TFImporter:
                 in_c, out_c, kd, kw_, kh, strides[1], strides[3], strides[2],
                 0, 0, 0, with_bias=False, name=name)
             self._attach(name, m, [conv_input], {"weight": w})
+        elif op in ("Conv3DBackpropInputV2", "Conv3DBackpropInput"):
+            # transposed 3-D conv: inputs [output_shape, filter DHWIO, x];
+            # the declared output shape drives pads exactly, like the 2-D
+            # Conv2DBackpropInput above (reference: utils/tf/loaders/
+            # Conv3DBackpropInputV2.scala)
+            w = self.const_of(data_inputs[1])
+            kd, kh, kw_, out_c, in_c = w.shape
+            strides = list(nd.attr["strides"].list.i) or [1] * 5
+            pad = nd.attr["padding"].s.decode() if nd.attr["padding"].s \
+                else "VALID"
+            if pad not in ("SAME", "VALID"):
+                raise ValueError(f"Conv3DBackpropInput padding {pad!r} "
+                                 f"unsupported")
+            oshape = [int(v) for v in
+                      self.const_of(data_inputs[0]).reshape(-1)]
+
+            def geom(target, hin, k, s):
+                if pad == "SAME":
+                    total = max(0, (hin - 1) * s + k - target)
+                    p_before = total // 2
+                else:
+                    p_before = 0
+                adj = target - ((hin - 1) * s - 2 * p_before + k)
+                return p_before, adj
+
+            pt, at = geom(oshape[1], bshape[1], kd, strides[1])
+            ph, ah = geom(oshape[2], bshape[2], kh, strides[2])
+            pw, aw = geom(oshape[3], bshape[3], kw_, strides[3])
+            m = nn.VolumetricFullConvolution(
+                in_c, out_c, kd, kw_, kh,
+                strides[1], strides[3], strides[2],
+                pt, pw, ph, at, aw, ah,
+                with_bias=False, name=name)
+            self._attach(name, m, [data_inputs[2]],
+                         {"weight": np.transpose(w, (0, 1, 2, 4, 3))})
         elif op == "RandomUniform":
             seed = int(nd.attr["seed"].i) if "seed" in nd.attr else 0
             if self._key(data_inputs[0]) not in self.graph_nodes:

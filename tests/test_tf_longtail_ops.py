@@ -168,3 +168,18 @@ class TestLongTailOps:
         check(tf.function(lambda x: tf.nn.dilation2d(
             x, filt, strides=[1, 1, 1, 1], dilations=[1, 1, 1, 1],
             padding="SAME", data_format="NHWC")), x, "Dilation2D", tmp_path)
+
+    def test_conv3d_transpose(self, tmp_path):
+        rs = np.random.RandomState(14)
+        x = rs.randn(1, 3, 4, 4, 2).astype(np.float32)
+        k = tf.constant(rs.randn(2, 3, 3, 5, 2).astype(np.float32) * 0.3)
+        for strides, pad, out_sp in (
+                ([1, 1, 1, 1, 1], "VALID", (4, 6, 6)),
+                ([1, 2, 2, 2, 1], "SAME", (6, 8, 8))):
+            out_shape = (1,) + out_sp + (5,)
+            check(tf.function(lambda x, s=strides, p=pad, o=out_shape:
+                              tf.nn.conv3d_transpose(
+                                  x, k, output_shape=o, strides=s,
+                                  padding=p)),
+                  x, "Conv3DBackpropInputV2", tmp_path,
+                  rtol=5e-4, atol=5e-5)
