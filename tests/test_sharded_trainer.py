@@ -259,3 +259,30 @@ class TestShardedDistriOptimizer:
             end_trigger=Trigger.max_iteration(2))
         o.optimize()
         assert np.isfinite(o._driver_state["loss"])
+
+    def test_transformer_ulysses_sp_via_builder(self):
+        """Ulysses (all-to-all head/sequence) sequence parallelism through
+        DistriOptimizer, same shape as the ring variant."""
+        from bigdl_tpu.models import TransformerLM
+
+        dp, sp = 4, 2
+        mesh = Engine.build_mesh(**{AXIS_DATA: dp, AXIS_SEQUENCE: sp})
+        vocab, seq_len, batch = 64, 16, 8
+        RandomGenerator.set_seed(7)
+        model = TransformerLM(vocab_size=vocab, hidden_size=32, n_layer=2,
+                              n_head=4, rope=True, seq_parallel="ulysses",
+                              scan_layers=True)
+        model.block.children["attn"].mesh = mesh
+        rs = np.random.RandomState(0)
+        toks = rs.randint(0, vocab, (32, seq_len + 1))
+        samples = [Sample.from_ndarray(t[:-1].astype(np.int32),
+                                       t[1:].astype(np.int32)) for t in toks]
+        ds = ArrayDataSet(samples).transform(SampleToMiniBatch(batch))
+        o = optim.DistriOptimizer(
+            model, ds,
+            nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True),
+            optim_method=Adam(learning_rate=1e-3), mesh=mesh,
+            batch_partition=P(AXIS_DATA, AXIS_SEQUENCE),
+            end_trigger=Trigger.max_iteration(3))
+        o.optimize()
+        assert np.isfinite(o._driver_state["loss"])
