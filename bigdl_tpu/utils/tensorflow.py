@@ -1022,15 +1022,20 @@ def _ancestors(node_index, outputs, stop: set) -> set:
     return seen
 
 
-def _detect_frames(gd, node_index) -> Dict[str, list]:
+def _detect_frames(gd, node_index):
     """Group nodes into v1 while frames by propagating membership from
-    Enter nodes (frame_name attr) through data edges, stopping at Exit."""
+    Enter nodes (frame_name attr) through data edges, stopping at Exit.
+    Every non-Enter node's data inputs are same-frame by TF construction
+    (outer values enter only through Enter), so first-wins propagation
+    assigns each node its innermost frame.  Returns (frames, parents):
+    parents maps a frame to the frame its Enter inputs live in (None for
+    root frames)."""
     member: Dict[str, str] = {}
     for n in gd.node:
         if n.op == "Enter":
             member[n.name] = n.attr["frame_name"].s.decode()
     if not member:
-        return {}
+        return {}, {}
     changed = True
     while changed:
         changed = False
@@ -1043,15 +1048,39 @@ def _detect_frames(gd, node_index) -> Dict[str, list]:
                     member[n.name] = member[src]
                     changed = True
                     break
+    # a NextIteration fed directly by a nested frame's Exit has no
+    # forward-propagated membership (propagation stops at Exit): it
+    # belongs to its consuming Merge's frame
     for n in gd.node:
-        if n.op == "Enter" and _clean(n.input[0]) in member:
-            raise NotImplementedError(
-                "nested TF while frames are not supported yet")
+        if n.op == "Merge" and n.name in member:
+            for i in n.input:
+                src = _clean(i)
+                if src not in member \
+                        and node_index.get(src) is not None \
+                        and node_index[src].op == "NextIteration":
+                    member[src] = member[n.name]
     frames: Dict[str, list] = {}
     for n in gd.node:
         if n.name in member:
             frames.setdefault(member[n.name], []).append(n)
-    return frames
+    parents: Dict[str, Optional[str]] = {fr: None for fr in frames}
+    for _ in range(len(frames) + 1):  # Exit-fed chains settle iteratively
+        changed = False
+        for n in gd.node:
+            if n.op != "Enter":
+                continue
+            src = _clean(n.input[0])
+            src_fr = member.get(src)
+            # an Exit of a sibling frame feeds this Enter from the PARENT
+            if src_fr is not None and node_index[src].op == "Exit":
+                src_fr = parents.get(src_fr)
+            if src_fr is not None and src_fr != member[n.name] \
+                    and parents[member[n.name]] != src_fr:
+                parents[member[n.name]] = src_fr
+                changed = True
+        if not changed:
+            break
+    return frames, parents
 
 
 def _frame_ready(imp: "_TFImporter", nodes) -> bool:
@@ -1085,7 +1114,8 @@ def _follow_identity(imp: "_TFImporter", ref: str) -> str:
         ref = nd.input[0]
 
 
-def _convert_frame(imp: "_TFImporter", fr_name: str, nodes) -> None:
+def _convert_frame(imp: "_TFImporter", fr_name: str, nodes,
+                   frames=None, parents=None) -> None:
     """Import one v1 while frame as a structured TFWhile module.
 
     Loop vars = Merge nodes (init from Enter, next from NextIteration);
@@ -1170,7 +1200,21 @@ def _convert_frame(imp: "_TFImporter", fr_name: str, nodes) -> None:
             sub.graph_nodes[cap_name] = node_in
             sub.shapes[cap_name] = imp.shapes.get(imp._key(src))
             inputs.append(node_in)
-        _run_fixpoint(sub, compute_nodes)
+        # nested while frames whose parent is THIS frame convert inside
+        # this sub-import (their Enter inputs are body/cond nodes)
+        child_frames = {cf: frames[cf] for cf in (frames or {})
+                        if parents.get(cf) == fr_name} if frames else {}
+        pending_nodes = list(compute_nodes)
+        todo = dict(child_frames)
+        while True:
+            pending_nodes, progressed = _sweep(sub, pending_nodes)
+            for cf in list(todo):
+                if _frame_ready(sub, todo[cf]):
+                    _convert_frame(sub, cf, todo.pop(cf),
+                                   frames=frames, parents=parents)
+                    progressed = True
+            if not progressed or (not pending_nodes and not todo):
+                break
         return sub, inputs
 
     # --- body: loop-var refs are Switch:1 -------------------------------
@@ -1267,11 +1311,14 @@ def _convert_frame(imp: "_TFImporter", fr_name: str, nodes) -> None:
         imp.shapes[ex.name] = var_shapes[i]
 
     # nested weight assignments (body/cond const weights, e.g. an RNN
-    # cell's MatMul) re-route through the TFWhile param subtree
+    # cell's MatMul) re-route through the TFWhile param subtree; child
+    # frames may already carry tuple paths — flatten
     for lname, w in body_imp.weight_sets:
-        imp.weight_sets.append(((wname, "body", lname), w))
+        path = lname if isinstance(lname, tuple) else (lname,)
+        imp.weight_sets.append(((wname, "body") + path, w))
     for lname, w in cond_imp.weight_sets:
-        imp.weight_sets.append(((wname, "cond", lname), w))
+        path = lname if isinstance(lname, tuple) else (lname,)
+        imp.weight_sets.append(((wname, "cond") + path, w))
 
 
 def load_tensorflow(pb_path: str, inputs: Sequence[str],
@@ -1311,18 +1358,22 @@ def load_tensorflow(pb_path: str, inputs: Sequence[str],
     # Enter inputs resolve (reference: utils/tf/loaders/ControlFlowOps.scala
     # -> nn/tf/ControlOps.scala; here the frame lowers to lax.scan /
     # lax.while_loop)
-    frames = _detect_frames(gd, node_index)
-    frames = {fr: nodes for fr, nodes in frames.items()
+    all_frames, parents = _detect_frames(gd, node_index)
+    frames = {fr: nodes for fr, nodes in all_frames.items()
               if any(n.name in wanted for n in nodes)}
     frame_member_names = {n.name for nodes in frames.values() for n in nodes}
     pending = [n for n in gd.node
                if n.name not in frame_member_names and n.name in wanted]
-    todo_frames = dict(frames)
+    # nested frames convert inside their parent's body sub-import
+    root_frames = {fr: nodes for fr, nodes in frames.items()
+                   if parents.get(fr) is None or parents[fr] not in frames}
+    todo_frames = dict(root_frames)
     while True:
         pending, progressed = _sweep(imp, pending)
         for fr in list(todo_frames):
             if _frame_ready(imp, todo_frames[fr]):
-                _convert_frame(imp, fr, todo_frames.pop(fr))
+                _convert_frame(imp, fr, todo_frames.pop(fr),
+                               frames=frames, parents=parents)
                 progressed = True
         if not progressed or (not pending and not todo_frames):
             break

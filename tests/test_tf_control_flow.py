@@ -192,3 +192,62 @@ class TestStandaloneCond:
             [5.0, 5.0, 5.0, 5.0], dtype=jnp.float32)))
         np.testing.assert_allclose(grad_small, np.full(4, 2.0))
         np.testing.assert_allclose(grad_big, np.full(4, 1.0))
+
+
+class TestNestedWhile:
+    def test_nested_counted_loops(self, tmp_path):
+        """Inner counted loop (double v twice) inside an outer counted
+        loop (3 iterations): v * 2^(2*3).  The inner frame converts inside
+        the outer body sub-import."""
+        import tf_graph_pb2 as tfp
+
+        gd = tfp.GraphDef()
+        _nodedef(gd, "x", "Placeholder")
+        _nodedef(gd, "c0", "Const", value=np.asarray(0, np.int32))
+        _nodedef(gd, "c3", "Const", value=np.asarray(3, np.int32))
+        _nodedef(gd, "c2i", "Const", value=np.asarray(2, np.int32))
+        _nodedef(gd, "one", "Const", value=np.asarray(1, np.int32))
+        _nodedef(gd, "two", "Const", value=np.asarray(2.0, np.float32))
+        # outer frame "o": vars (t, v)
+        _nodedef(gd, "o/Enter_t", "Enter", ["c0"], frame_name=b"o")
+        _nodedef(gd, "o/Enter_v", "Enter", ["x"], frame_name=b"o")
+        _nodedef(gd, "o/Merge_t", "Merge", ["o/Enter_t", "o/NextIteration_t"])
+        _nodedef(gd, "o/Merge_v", "Merge", ["o/Enter_v", "o/NextIteration_v"])
+        _nodedef(gd, "o/Less", "Less", ["o/Merge_t", "c3"])
+        _nodedef(gd, "o/LoopCond", "LoopCond", ["o/Less"])
+        _nodedef(gd, "o/Switch_t", "Switch", ["o/Merge_t", "o/LoopCond"])
+        _nodedef(gd, "o/Switch_v", "Switch", ["o/Merge_v", "o/LoopCond"])
+        _nodedef(gd, "o/Ident_t", "Identity", ["o/Switch_t:1"])
+        _nodedef(gd, "o/Ident_v", "Identity", ["o/Switch_v:1"])
+        _nodedef(gd, "o/add_t", "Add", ["o/Ident_t", "one"])
+        # inner frame "i": vars (s, w); w enters from the outer body
+        _nodedef(gd, "i/Enter_s", "Enter", ["c0"], frame_name=b"i")
+        _nodedef(gd, "i/Enter_w", "Enter", ["o/Ident_v"], frame_name=b"i")
+        _nodedef(gd, "i/Merge_s", "Merge", ["i/Enter_s", "i/NextIteration_s"])
+        _nodedef(gd, "i/Merge_w", "Merge", ["i/Enter_w", "i/NextIteration_w"])
+        _nodedef(gd, "i/Less", "Less", ["i/Merge_s", "c2i"])
+        _nodedef(gd, "i/LoopCond", "LoopCond", ["i/Less"])
+        _nodedef(gd, "i/Switch_s", "Switch", ["i/Merge_s", "i/LoopCond"])
+        _nodedef(gd, "i/Switch_w", "Switch", ["i/Merge_w", "i/LoopCond"])
+        _nodedef(gd, "i/Ident_s", "Identity", ["i/Switch_s:1"])
+        _nodedef(gd, "i/Ident_w", "Identity", ["i/Switch_w:1"])
+        _nodedef(gd, "i/add_s", "Add", ["i/Ident_s", "one"])
+        _nodedef(gd, "i/mul_w", "Mul", ["i/Ident_w", "two"])
+        _nodedef(gd, "i/NextIteration_s", "NextIteration", ["i/add_s"])
+        _nodedef(gd, "i/NextIteration_w", "NextIteration", ["i/mul_w"])
+        _nodedef(gd, "i/Exit_s", "Exit", ["i/Switch_s"])
+        _nodedef(gd, "i/Exit_w", "Exit", ["i/Switch_w"])
+        # close the outer loop
+        _nodedef(gd, "o/NextIteration_t", "NextIteration", ["o/add_t"])
+        _nodedef(gd, "o/NextIteration_v", "NextIteration", ["i/Exit_w"])
+        _nodedef(gd, "o/Exit_t", "Exit", ["o/Switch_t"])
+        _nodedef(gd, "o/Exit_v", "Exit", ["o/Switch_v"])
+        _nodedef(gd, "out", "Identity", ["o/Exit_v"])
+        pb = str(tmp_path / "nested.pb")
+        with open(pb, "wb") as fh:
+            fh.write(gd.SerializeToString())
+
+        g, gp, gs = load_tensorflow(pb, ["x"], ["out"], [(4,)])
+        x = np.asarray([1.0, 2.0, 3.0, 4.0], np.float32)
+        y = np.asarray(g.apply(gp, gs, jnp.asarray(x))[0])
+        np.testing.assert_allclose(y, x * 64.0, rtol=1e-6)
