@@ -539,6 +539,11 @@ def convert_model(args: Optional[Sequence[str]] = None) -> None:
                    help="comma-separated NHWC build shape, e.g. 8,28,28,1")
     p.add_argument("--tf-inputs", default="input")
     p.add_argument("--tf-outputs", default="output")
+    p.add_argument("--quantize", choices=("dynamic", "static", "weight_only"),
+                   help="int8-quantize before writing (native output only; "
+                        "reference: ConvertModel --quantize)")
+    p.add_argument("--fold-bn", action="store_true",
+                   help="fold conv+BN pairs for inference before writing")
     ns = p.parse_args(args)
     shape = tuple(int(s) for s in ns.shape.split(","))
 
@@ -569,6 +574,20 @@ def convert_model(args: Optional[Sequence[str]] = None) -> None:
         module, params, state = ser.load_model(ns.src)
         if params is None:
             params, state, _ = module.build(jax.random.PRNGKey(0), shape)
+    if ns.fold_bn:
+        from bigdl_tpu.utils.fusion import fold_batchnorm
+
+        module, params, state = fold_batchnorm(module, params, state)
+        print("folded conv+BN pairs for inference")
+    if ns.quantize:
+        if any(ns.dst.endswith(s) for s in (".pt", ".prototxt", ".pb")):
+            raise SystemExit("--quantize requires a native output dir "
+                             "(other formats cannot hold int8 layers)")
+        from bigdl_tpu.nn.quantized import quantize
+
+        module, params = quantize(module, params, mode=ns.quantize)
+        print(f"quantized to int8 ({ns.quantize}); static mode needs a "
+              f"calibrate() pass over real data before serving")
     if ns.dst.endswith(".pt"):
         sd = export_torch_state_dict(module, params, state)
         torch.save({k: torch.from_numpy(np.ascontiguousarray(v))

@@ -650,3 +650,26 @@ def test_torch_gru_into_reset_before_cell_raises():
     params, state, _ = our.build(jax.random.PRNGKey(0), (2, 4, 3))
     with pytest.raises(ValueError, match="reset-AFTER"):
         interop.import_torch_state_dict(our, params, state, tm.state_dict())
+
+
+def test_convert_model_quantize_and_fold(tmp_path):
+    """ConvertModel --fold-bn --quantize static (reference: ConvertModel
+    --quantize): imports caffe, folds BN, quantizes, writes native."""
+    proto = tmp_path / "n.prototxt"
+    proto.write_text(
+        'name: "n"\ninput: "data"\n'
+        'input_shape { dim: 1 dim: 3 dim: 8 dim: 8 }\n'
+        'layer { name: "c1" type: "Convolution" bottom: "data" top: "c1"'
+        ' convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }\n'
+        'layer { name: "b1" type: "BatchNorm" bottom: "c1" top: "b1" }\n'
+        'layer { name: "r1" type: "ReLU" bottom: "b1" top: "r1" }\n')
+    out = tmp_path / "native_model"
+    interop.convert_model([
+        "--from", str(proto), "--to", str(out),
+        "--input-shape", "1,8,8,3", "--fold-bn", "--quantize", "static"])
+    from bigdl_tpu.utils import serializer as ser
+
+    m, p, s = ser.load_model(str(out))
+    kinds = {type(c).__name__ for c in m.children.values()}
+    assert "QuantizedSpatialConvolution" in kinds
+    assert "SpatialBatchNormalization" not in kinds
