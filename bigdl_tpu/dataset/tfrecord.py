@@ -299,15 +299,36 @@ class ParsedExampleDataSet(DataSet):
         from bigdl_tpu.core.random import RandomGenerator
         from bigdl_tpu.dataset.minibatch import MiniBatch
 
+        rs = None
         paths = list(self.paths)
-        if train and len(paths) > 1:
+        if train:
             rs = _np.random.RandomState(RandomGenerator.get_seed()
                                         + self._epoch)
             rs.shuffle(paths)
             self._epoch += 1
         li = self.dense_keys.index(self.label_key)
+
+        def records():
+            it = PrefetchRecordReader(paths, n_threads=self.n_threads)
+            if rs is None:
+                yield from it
+                return
+            # within-shard shuffle buffer (reservoir style): shard-order
+            # shuffling alone leaves single-shard training in identical
+            # order every epoch, degrading SGD
+            window: List[bytes] = []
+            cap = max(4 * self.batch_size, 1024)
+            for rec in it:
+                window.append(rec)
+                if len(window) >= cap:
+                    k = rs.randint(len(window))
+                    window[k], window[-1] = window[-1], window[k]
+                    yield window.pop()
+            rs.shuffle(window)
+            yield from window
+
         buf: List[bytes] = []
-        for rec in PrefetchRecordReader(paths, n_threads=self.n_threads):
+        for rec in records():
             buf.append(rec)
             if len(buf) == self.batch_size:
                 cols = list(self._parser.compute(

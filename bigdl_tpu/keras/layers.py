@@ -287,11 +287,27 @@ class LSTM(_Rnn):
 
 
 class GRU(_Rnn):
+    """keras-1 GRU.  `reset_after` is an explicit constructor arg so the
+    cell convention travels in the serialized spec: False (default) is the
+    keras-1 semantics — reset gate applies BEFORE the hidden matmul
+    (keras/layers/recurrent.py), so keras-1 GRU weights import bit-exactly;
+    True is the torch/fused convention.  NOTE: specs saved before this arg
+    existed were built reset_after=True and rebuild as False — reload those
+    checkpoints with GRU(..., reset_after=True)."""
+
+    def __init__(self, output_dim: int, return_sequences: bool = False,
+                 activation: str = "tanh",
+                 inner_activation: str = "hard_sigmoid",
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None, *,
+                 reset_after: bool = False):
+        super().__init__(output_dim, return_sequences, activation,
+                         inner_activation, input_shape, name)
+        self.reset_after = reset_after
+
     def _cell(self, input_size):
-        # keras-1 GRU semantics: reset gate applies BEFORE the hidden
-        # matmul (keras/layers/recurrent.py), which reset_after=False
-        # implements exactly — so keras-1 GRU weights import bit-exactly
-        return nn.GRUCell(input_size, self.output_dim, reset_after=False)
+        return nn.GRUCell(input_size, self.output_dim,
+                          reset_after=self.reset_after)
 
 
 class SimpleRNN(_Rnn):

@@ -226,6 +226,16 @@ class Optimizer:
         prepare = getattr(model, "prepare_pipeline_params", lambda p, n: p)
 
         def fwd(params, model_state, x, rng):
+            # the shard_map below replicates model_state (P()): per-layer
+            # state updated during TRAINING (e.g. BatchNorm running stats)
+            # would silently mis-replicate across stages.  Read-only state
+            # at eval is safe.
+            if training and jax.tree_util.tree_leaves(model_state):
+                raise ValueError(
+                    "pipeline-parallel training requires a stateless model "
+                    "(no BatchNorm running stats or other per-layer state); "
+                    "found non-empty model state — use LayerNorm-style "
+                    "stateless blocks or train without pipeline_axis")
             p = prepare(params, n_stage)
             specs = spec_tree(p, self.sharding_rules)
             # without a rule mapping the block stack to P(pipeline_axis),

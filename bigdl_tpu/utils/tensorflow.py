@@ -104,6 +104,13 @@ def _clean(name: str) -> str:
     return name[1:] if name.startswith("^") else name
 
 
+class _UnresolvedInput(KeyError):
+    """An input lookup this (sub-)import has not materialized yet: the
+    node defers and retries on a later sweep.  Distinct from a bare
+    KeyError so genuine bugs inside op converters fail loudly instead of
+    being silently swallowed as 'not ready'."""
+
+
 class _TFImporter:
     def __init__(self, graph_def, input_names: Sequence[str],
                  input_shapes: Sequence[Sequence[int]],
@@ -197,10 +204,14 @@ class _TFImporter:
 
     def _attach(self, tf_name: str, module, in_names: List[str],
                 weights: Optional[Dict[str, np.ndarray]] = None):
-        srcs = [self.graph_nodes[self._key(i)] for i in in_names]
+        try:
+            srcs = [self.graph_nodes[self._key(i)] for i in in_names]
+            in_shapes = [self.shapes[self._key(i)] for i in in_names]
+        except KeyError as e:
+            # an input this (sub-)import never materializes — _sweep defers
+            raise _UnresolvedInput(str(e)) from e
         node = module(*srcs)
         self.graph_nodes[tf_name] = node
-        in_shapes = [self.shapes[self._key(i)] for i in in_names]
         sh = in_shapes[0] if len(in_shapes) == 1 else Table(*in_shapes)
         try:
             _, _, out = module.build(jax.random.PRNGKey(0), sh)
@@ -261,8 +272,11 @@ class _TFImporter:
 
     def _alias(self, tf_name: str, src: str):
         src = self._key(src)
-        self.graph_nodes[tf_name] = self.graph_nodes[src]
-        self.shapes[tf_name] = self.shapes[src]
+        try:
+            self.graph_nodes[tf_name] = self.graph_nodes[src]
+            self.shapes[tf_name] = self.shapes[src]
+        except KeyError as e:
+            raise _UnresolvedInput(str(e)) from e
 
     def convert(self, nd) -> None:
         op = nd.op
@@ -645,6 +659,12 @@ class _TFImporter:
                 axis = int(self.const_of(data_inputs[2]))
                 value = data_inputs[0]
                 if sizes.count(-1) == 1:  # one inferred slot (TF convention)
+                    if self._key(value) not in self.graph_nodes:
+                        try:
+                            self._ensure_node(value, anchor=graph_in[0])
+                        except ValueError as e:
+                            # dynamic producer not yet converted: defer
+                            raise _UnresolvedInput(str(e)) from e
                     dim = self.shapes[self._key(value)][axis]
                     sizes[sizes.index(-1)] = dim - sum(s for s in sizes
                                                        if s != -1)
@@ -986,11 +1006,12 @@ def _sweep(imp: "_TFImporter", pending):
             continue
         try:
             imp.convert(node)
-        except KeyError:
+        except _UnresolvedInput:
             # an input resolving through an Identity/Enter chain that this
             # (sub-)import never materializes — e.g. the cond importer
             # visiting body-only nodes.  Defer; a genuinely missing node
-            # still fails loudly at the output lookup.
+            # still fails loudly at the output lookup.  A plain KeyError
+            # from a converter body is a real bug and propagates.
             deferred.append(node)
             continue
         progressed = True
@@ -1321,6 +1342,273 @@ def _convert_frame(imp: "_TFImporter", fr_name: str, nodes,
         imp.weight_sets.append(((wname, "cond") + path, w))
 
 
+def _resolve_identity(node_index, ref: str) -> str:
+    """Resolve a ref through Identity nodes using only the static index."""
+    while True:
+        base = _clean(ref)
+        nd = node_index.get(base)
+        if nd is None or nd.op != "Identity":
+            return base
+        ref = nd.input[0]
+
+
+def _detect_cond_regions(gd, node_index, excluded: set, wanted: set,
+                         outputs) -> List[dict]:
+    """Standalone (non-frame) v1 tf.cond regions, grouped by predicate.
+
+    A region = every Switch guarding on one predicate + the branch
+    subgraphs reachable from its outputs + the Merges joining them.  Only
+    CLEANLY separable regions are returned (each branch node traces to
+    exactly one side, every Merge joins one true and one false input, no
+    nested foreign Switch/Merge inside a branch); anything ambiguous is
+    left to the eager Switch-alias/MergeSelect fallback so behavior
+    degrades rather than breaks.  Reference: utils/tf/loaders/
+    ControlFlowOps.scala Switch/Merge + nn/tf/ControlOps.scala."""
+    switches = [n for n in gd.node
+                if n.op == "Switch" and n.name in wanted
+                and n.name not in excluded]
+    if not switches:
+        return []
+    by_pred: Dict[str, list] = {}
+    for sw in switches:
+        by_pred.setdefault(_resolve_identity(node_index, sw.input[1]),
+                           []).append(sw)
+    out_names = {_clean(o) for o in outputs}
+    # consumer adjacency built once: worklist propagation visits only the
+    # branch subgraphs, not the whole GraphDef per predicate
+    consumers: Dict[str, list] = {}
+    for n in gd.node:
+        if n.name not in wanted:
+            continue
+        for ref in n.input:
+            if not ref.startswith("^"):
+                consumers.setdefault(_clean(ref), []).append(n)
+    regions = []
+    for pred, sws in by_pred.items():
+        sw_names = {s.name for s in sws}
+        # forward-propagate (branch side, source switches) from the Switch
+        # outputs; stop at Merge nodes (TF cond branches only exit through
+        # a Merge)
+        info: Dict[str, Tuple[set, set]] = {}
+        work = [c for s in sws for c in consumers.get(s.name, [])]
+        while work:
+            n = work.pop()
+            if (n.name not in wanted or n.name in excluded
+                    or n.op == "Merge" or n.name in sw_names):
+                continue
+            sides, srcs = info.get(n.name, (set(), set()))
+            ns, nr = set(sides), set(srcs)
+            for ref in n.input:
+                if ref.startswith("^"):
+                    continue
+                base = _clean(ref)
+                if base in sw_names:
+                    ns.add(1 if ref.endswith(":1") else 0)
+                    nr.add(base)
+                elif base in info:
+                    ns |= info[base][0]
+                    nr |= info[base][1]
+            if (ns, nr) != (sides, srcs):
+                info[n.name] = (ns, nr)
+                work.extend(consumers.get(n.name, []))
+        # two independent conds sharing one predicate (e.g. a reused
+        # is_training flag, possibly cascaded through intermediate layers)
+        # must become SEPARATE regions or the later one's inputs would wait
+        # on the earlier one's Merge forever: union-find switches linked by
+        # a shared branch node or a shared Merge into components
+        parent = {s: s for s in sw_names}
+
+        def find(a):
+            while parent[a] != a:
+                parent[a] = parent[parent[a]]
+                a = parent[a]
+            return a
+
+        def union(a, b):
+            ra, rb = find(a), find(b)
+            if ra != rb:
+                parent[ra] = rb
+
+        for sides, srcs in info.values():
+            first = next(iter(srcs), None)
+            for o in srcs:
+                union(first, o)
+        merge_entries = []
+        for n in gd.node:
+            if n.op != "Merge" or n.name not in wanted \
+                    or n.name in excluded:
+                continue
+            refs: Dict[Any, str] = {}
+            msrcs: set = set()
+            for ref in n.input:
+                base = _clean(ref)
+                if base in sw_names:
+                    refs[1 if ref.endswith(":1") else 0] = ref
+                    msrcs.add(base)
+                elif base in info:
+                    bs = info[base][0]
+                    refs[next(iter(bs)) if len(bs) == 1 else None] = ref
+                    msrcs |= info[base][1]
+            if not msrcs:
+                continue  # another predicate's (or a frame's) merge
+            first = next(iter(msrcs))
+            for o in msrcs:
+                union(first, o)
+            merge_entries.append((n, refs, first))
+        comp_members: Dict[str, Dict[str, set]] = {}
+        for nm, (sides, srcs) in info.items():
+            if srcs:
+                comp_members.setdefault(
+                    find(next(iter(srcs))), {})[nm] = sides
+        comp_merges: Dict[str, list] = {}
+        for n, refs, src in merge_entries:
+            comp_merges.setdefault(find(src), []).append((n, refs))
+        for root in {find(s) for s in sw_names}:
+            comp_sws = [s for s in sws if find(s.name) == root]
+            members = comp_members.get(root, {})
+            mlist = comp_merges.get(root, [])
+            merges, side_refs = [], {}
+            ok = bool(mlist)
+            for n, refs in mlist:
+                if set(refs) != {0, 1} or len(n.input) != 2:
+                    ok = False
+                    break
+                merges.append(n)
+                side_refs[n.name] = refs
+            if ok:
+                ok = all(len(v) == 1 for v in members.values()) \
+                    and not (set(members) & out_names) \
+                    and not any(node_index[nm].op in ("Switch", "Merge")
+                                for nm in members)
+            if ok:
+                # a region whose own inputs depend on its own Merges can
+                # never become ready — leave it to the eager fallback
+                ext = [pred] + [s.input[0] for s in comp_sws]
+                for nm in members:
+                    for ref in node_index[nm].input:
+                        base = _clean(ref)
+                        if not ref.startswith("^") and base not in members \
+                                and base not in sw_names:
+                            ext.append(base)
+                anc = _ancestors(node_index, ext, set())
+                ok = not (anc & {m.name for m in merges})
+            if not ok or not merges:
+                continue
+            regions.append({"pred": pred, "switches": comp_sws,
+                            "merges": merges, "side_refs": side_refs,
+                            "members": members})
+    return regions
+
+
+def _cond_captures(imp: "_TFImporter", region) -> List[str]:
+    """Outer values consumed directly by branch nodes (tf.cond switches
+    every external tensor, so these are rare: usually consts, resolved
+    through the shared const cache — anything else becomes a data input)."""
+    if "captures" in region:
+        return region["captures"]
+    members = region["members"]
+    sw_names = {s.name for s in region["switches"]}
+    captures: List[str] = []  # FULL refs — "split:1" must keep its port
+    for nm in members:
+        for ref in imp.nodes_by_name[nm].input:
+            if ref.startswith("^"):
+                continue
+            base = _clean(ref)
+            if base in members or base in sw_names or ref in captures:
+                continue
+            try:
+                imp.const_of(ref)
+            except (ValueError, KeyError):
+                captures.append(ref)
+    region["captures"] = captures
+    return captures
+
+
+def _cond_ready(imp: "_TFImporter", region) -> bool:
+    """A cond region converts once its predicate and every Switch data
+    input / outer capture is a converted graph node or a foldable const."""
+    for ref in ([region["pred"]]
+                + [sw.input[0] for sw in region["switches"]]
+                + _cond_captures(imp, region)):
+        if imp._key(ref) in imp.graph_nodes:
+            continue
+        try:
+            imp.const_of(ref)
+        except (ValueError, KeyError):
+            return False
+    return True
+
+
+def _convert_cond_region(imp: "_TFImporter", region) -> None:
+    """Import one standalone cond region as a structured TFCond module
+    lowered to lax.cond: ONLY the taken branch executes (and is
+    differentiated), matching TF's deferred-branch semantics — unlike the
+    MergeSelect fallback, which evaluates both branches and can leak NaN
+    through the untaken branch's reverse-mode derivative."""
+    from bigdl_tpu.nn import tf_ops as _tf
+
+    switches, merges = region["switches"], region["merges"]
+    members = region["members"]
+    anchor = next(iter(imp.graph_nodes))
+    cname = f"{merges[0].name}_cond"
+    captures = _cond_captures(imp, region)
+    data_refs = [sw.input[0] for sw in switches]
+    for ref in [region["pred"]] + data_refs + captures:
+        if imp._key(ref) not in imp.graph_nodes:
+            imp._ensure_node(ref, anchor=anchor)
+
+    def build_branch(side: int, tag: str):
+        sub = _TFImporter.__new__(_TFImporter)
+        sub.nodes_by_name = imp.nodes_by_name
+        sub.consts = imp.consts
+        sub.graph_nodes = {}
+        sub.shapes = {}
+        sub.weight_sets = []
+        sub.input_nodes = []
+        inputs = []
+        for k, sw in enumerate(switches):
+            node_in = nn.Input(name=f"{cname}_{tag}_d{k}")
+            ref = f"{sw.name}:1" if side == 1 else sw.name
+            sub.graph_nodes[ref] = node_in
+            sub.shapes[ref] = imp.shapes.get(imp._key(sw.input[0]))
+            inputs.append(node_in)
+        for k, cap in enumerate(captures):
+            node_in = nn.Input(name=f"{cname}_{tag}_cap{k}")
+            sub.graph_nodes[cap] = node_in  # full ref: keeps the out port
+            sub.shapes[cap] = imp.shapes.get(imp._key(cap))
+            inputs.append(node_in)
+        branch_nodes = [imp.nodes_by_name[nm] for nm in members
+                        if side in members[nm]]
+        _run_fixpoint(sub, branch_nodes)
+        outs = []
+        for mg in merges:
+            ref = region["side_refs"][mg.name][side]
+            outs.append(sub.graph_nodes[sub._key(ref)])
+        return sub, nn.Graph(inputs, outs, name=f"{cname}_{tag}")
+
+    then_imp, then_graph = build_branch(1, "then")
+    else_imp, else_graph = build_branch(0, "else")
+    mod = _tf.TFCond(then_graph, else_graph, name=cname)
+    imp._attach(cname, mod, [region["pred"]] + data_refs + captures)
+
+    from bigdl_tpu.nn.table_ops import SelectTable
+
+    cond_node = imp.graph_nodes[cname]
+    out_shape = imp.shapes.get(cname)
+    for i, mg in enumerate(merges):
+        if len(merges) == 1:
+            imp._alias(mg.name, cname)
+        else:
+            sel = SelectTable(i + 1, name=f"{cname}_out{i}")(cond_node)
+            imp.graph_nodes[mg.name] = sel
+            imp.shapes[mg.name] = list(out_shape)[i] \
+                if isinstance(out_shape, (Table, list, tuple)) else None
+    for sub, tag in ((then_imp, "then"), (else_imp, "else")):
+        for lname, w in sub.weight_sets:
+            path = lname if isinstance(lname, tuple) else (lname,)
+            imp.weight_sets.append(((cname, tag) + path, w))
+
+
 def load_tensorflow(pb_path: str, inputs: Sequence[str],
                     outputs: Sequence[str],
                     input_shapes: Optional[Sequence[Sequence[int]]] = None,
@@ -1362,12 +1650,23 @@ def load_tensorflow(pb_path: str, inputs: Sequence[str],
     frames = {fr: nodes for fr, nodes in all_frames.items()
               if any(n.name in wanted for n in nodes)}
     frame_member_names = {n.name for nodes in frames.values() for n in nodes}
+    # standalone Switch/Merge regions (v1 tf.cond) lower to structured
+    # TFCond/lax.cond: only the taken branch runs and is differentiated
+    cond_regions = _detect_cond_regions(gd, node_index, frame_member_names,
+                                        wanted, outputs)
+    cond_member_names = set()
+    for cr in cond_regions:
+        cond_member_names |= set(cr["members"])
+        cond_member_names |= {s.name for s in cr["switches"]}
+        cond_member_names |= {m.name for m in cr["merges"]}
     pending = [n for n in gd.node
-               if n.name not in frame_member_names and n.name in wanted]
+               if n.name not in frame_member_names
+               and n.name not in cond_member_names and n.name in wanted]
     # nested frames convert inside their parent's body sub-import
     root_frames = {fr: nodes for fr, nodes in frames.items()
                    if parents.get(fr) is None or parents[fr] not in frames}
     todo_frames = dict(root_frames)
+    todo_conds = list(cond_regions)
     while True:
         pending, progressed = _sweep(imp, pending)
         for fr in list(todo_frames):
@@ -1375,11 +1674,21 @@ def load_tensorflow(pb_path: str, inputs: Sequence[str],
                 _convert_frame(imp, fr, todo_frames.pop(fr),
                                frames=frames, parents=parents)
                 progressed = True
-        if not progressed or (not pending and not todo_frames):
+        for cr in list(todo_conds):
+            if _cond_ready(imp, cr):
+                _convert_cond_region(imp, cr)
+                todo_conds.remove(cr)
+                progressed = True
+        if not progressed or (not pending and not todo_frames
+                              and not todo_conds):
             break
     if todo_frames:
         raise ValueError(
             f"could not resolve while-frame inputs for {list(todo_frames)}")
+    if todo_conds:
+        raise ValueError(
+            "could not resolve cond-region inputs for "
+            f"{[cr['merges'][0].name for cr in todo_conds]}")
     outs = [imp.graph_nodes[imp._key(o)] for o in outputs]
     model = nn.Graph(imp.input_nodes, outs, name="tf_graph")
     build_shapes = [imp.shapes[i] for i in inputs]

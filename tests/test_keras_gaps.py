@@ -244,3 +244,21 @@ class TestKerasJsonGRU:
         got, _ = model.apply(params, state, jnp.asarray(x))
         np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
                                    atol=1e-5)
+
+
+class TestGRUResetAfterSpec:
+    def test_reset_after_travels_in_spec(self):
+        """The cell convention is a constructor arg captured by the
+        serializer, so a rebuilt spec preserves the recurrence."""
+        from bigdl_tpu.keras.layers import GRU
+        from bigdl_tpu.utils.serializer import module_from_spec, module_to_spec
+
+        for ra in (False, True):
+            layer = GRU(4, reset_after=ra, input_shape=(5, 3))
+            assert layer._captured_config["reset_after"] is ra
+            spec = module_to_spec(layer)
+            assert spec["config"]["reset_after"] is ra
+            rebuilt = module_from_spec(spec)
+            assert rebuilt.reset_after is ra
+            cell = rebuilt._cell(3)
+            assert cell.reset_after is ra

@@ -193,6 +193,134 @@ class TestStandaloneCond:
         np.testing.assert_allclose(grad_small, np.full(4, 2.0))
         np.testing.assert_allclose(grad_big, np.full(4, 1.0))
 
+    def test_lowered_to_structured_lax_cond(self, tmp_path):
+        """The importer must produce a TFCond (lax.cond — only the taken
+        branch runs), not the both-branches MergeSelect fallback."""
+        from bigdl_tpu.nn.tf_ops import TFCond
+
+        g, _, _ = self._build(tmp_path)
+        conds = [m for m in g.children.values() if isinstance(m, TFCond)]
+        assert len(conds) == 1
+
+    def test_guard_cond_gradient_has_no_nan(self, tmp_path):
+        """Guard-style cond(x >= 0 ? sqrt(x) : -x): with both-branch
+        evaluation the untaken sqrt branch's reverse-mode derivative at
+        x < 0 is NaN and 0 * NaN leaks; lax.cond differentiates only the
+        taken branch."""
+        import tf_graph_pb2 as tfp
+
+        gd = tfp.GraphDef()
+        _nodedef(gd, "x", "Placeholder")
+        _nodedef(gd, "zero", "Const", value=np.asarray(0.0, np.float32))
+        _nodedef(gd, "axis0", "Const", value=np.asarray(0, np.int32))
+        _nodedef(gd, "s", "Sum", ["x", "axis0"])
+        _nodedef(gd, "pred", "GreaterEqual", ["s", "zero"])
+        _nodedef(gd, "sw", "Switch", ["x", "pred"])
+        _nodedef(gd, "tbr", "Sqrt", ["sw:1"])
+        _nodedef(gd, "fbr", "Neg", ["sw"])
+        _nodedef(gd, "mg", "Merge", ["fbr", "tbr"])
+        _nodedef(gd, "out", "Identity", ["mg"])
+        pb = str(tmp_path / "guard.pb")
+        with open(pb, "wb") as fh:
+            fh.write(gd.SerializeToString())
+        g, gp, gs = load_tensorflow(pb, ["x"], ["out"], [(4,)])
+
+        def f(x):
+            return jnp.sum(g.apply(gp, gs, x)[0])
+
+        neg = jnp.asarray([-1.0, -2.0, -3.0, -4.0], dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(f(neg)), 10.0, rtol=1e-6)
+        grad = np.asarray(jax.grad(f)(neg))
+        assert np.all(np.isfinite(grad)), grad
+        np.testing.assert_allclose(grad, np.full(4, -1.0))
+
+    def test_shared_predicate_multi_output_cond(self, tmp_path):
+        """Two Switches + two Merges on one predicate import as a single
+        multi-output TFCond (region grouping by predicate)."""
+        import tf_graph_pb2 as tfp
+
+        gd = tfp.GraphDef()
+        _nodedef(gd, "x", "Placeholder")
+        _nodedef(gd, "y", "Placeholder")
+        _nodedef(gd, "thr", "Const", value=np.asarray(10.0, np.float32))
+        _nodedef(gd, "two", "Const", value=np.asarray(2.0, np.float32))
+        _nodedef(gd, "axis0", "Const", value=np.asarray(0, np.int32))
+        _nodedef(gd, "s", "Sum", ["x", "axis0"])
+        _nodedef(gd, "pred", "Less", ["s", "thr"])
+        _nodedef(gd, "swx", "Switch", ["x", "pred"])
+        _nodedef(gd, "swy", "Switch", ["y", "pred"])
+        _nodedef(gd, "tx", "Mul", ["swx:1", "two"])       # true: x*2, y+x*2
+        _nodedef(gd, "ty", "Add", ["swy:1", "tx"])
+        _nodedef(gd, "fx", "Neg", ["swx"])                # false: -x, y*2
+        _nodedef(gd, "fy", "Mul", ["swy", "two"])
+        _nodedef(gd, "mgx", "Merge", ["fx", "tx"])
+        _nodedef(gd, "mgy", "Merge", ["fy", "ty"])
+        _nodedef(gd, "outx", "Identity", ["mgx"])
+        _nodedef(gd, "outy", "Identity", ["mgy"])
+        pb = str(tmp_path / "multi.pb")
+        with open(pb, "wb") as fh:
+            fh.write(gd.SerializeToString())
+        g, gp, gs = load_tensorflow(pb, ["x", "y"], ["outx", "outy"],
+                                    [(4,), (4,)])
+        from bigdl_tpu.core.table import Table
+        from bigdl_tpu.nn.tf_ops import TFCond
+
+        assert sum(isinstance(m, TFCond) for m in g.children.values()) == 1
+        small = np.full(4, 1.0, np.float32)
+        yv = np.full(4, 3.0, np.float32)
+        out = g.apply(gp, gs, Table(jnp.asarray(small), jnp.asarray(yv)))[0]
+        ox, oy = (np.asarray(v) for v in out)
+        np.testing.assert_allclose(ox, small * 2.0)
+        np.testing.assert_allclose(oy, yv + small * 2.0)
+        big = np.full(4, 5.0, np.float32)
+        out = g.apply(gp, gs, Table(jnp.asarray(big), jnp.asarray(yv)))[0]
+        ox, oy = (np.asarray(v) for v in out)
+        np.testing.assert_allclose(ox, -big)
+        np.testing.assert_allclose(oy, yv * 2.0)
+
+    def test_cascaded_conds_on_shared_predicate(self, tmp_path):
+        """Two SEQUENTIAL conds guarded by the same predicate (reused
+        is_training-style flag): the second cond's data input depends on
+        the first cond's Merge.  Region detection must split them into two
+        components or the second region's readiness waits on its own
+        group's Merge forever."""
+        import tf_graph_pb2 as tfp
+
+        gd = tfp.GraphDef()
+        _nodedef(gd, "x", "Placeholder")
+        _nodedef(gd, "thr", "Const", value=np.asarray(10.0, np.float32))
+        _nodedef(gd, "two", "Const", value=np.asarray(2.0, np.float32))
+        _nodedef(gd, "ten", "Const", value=np.asarray(10.0, np.float32))
+        _nodedef(gd, "axis0", "Const", value=np.asarray(0, np.int32))
+        _nodedef(gd, "s", "Sum", ["x", "axis0"])
+        _nodedef(gd, "pred", "Less", ["s", "thr"])
+        # cond 1: x*2 | x+10
+        _nodedef(gd, "sw1", "Switch", ["x", "pred"])
+        _nodedef(gd, "t1", "Mul", ["sw1:1", "two"])
+        _nodedef(gd, "f1", "Add", ["sw1", "ten"])
+        _nodedef(gd, "mg1", "Merge", ["f1", "t1"])
+        # intermediate layer between the two conds
+        _nodedef(gd, "mid", "Add", ["mg1", "two"])
+        # cond 2 (same predicate): mid+10 | mid*2
+        _nodedef(gd, "sw2", "Switch", ["mid", "pred"])
+        _nodedef(gd, "t2", "Add", ["sw2:1", "ten"])
+        _nodedef(gd, "f2", "Mul", ["sw2", "two"])
+        _nodedef(gd, "mg2", "Merge", ["f2", "t2"])
+        _nodedef(gd, "out", "Identity", ["mg2"])
+        pb = str(tmp_path / "cascade.pb")
+        with open(pb, "wb") as fh:
+            fh.write(gd.SerializeToString())
+        g, gp, gs = load_tensorflow(pb, ["x"], ["out"], [(4,)])
+        from bigdl_tpu.nn.tf_ops import TFCond
+
+        assert sum(isinstance(m, TFCond) for m in g.children.values()) == 2
+        small = np.full(4, 1.0, np.float32)   # sum=4 < 10: true branches
+        y = np.asarray(g.apply(gp, gs, jnp.asarray(small))[0])
+        np.testing.assert_allclose(y, (small * 2.0 + 2.0) + 10.0)
+        big = np.full(4, 5.0, np.float32)     # sum=20 >= 10: false branches
+        y = np.asarray(g.apply(gp, gs, jnp.asarray(big))[0])
+        np.testing.assert_allclose(y, (big + 10.0 + 2.0) * 2.0)
+
 
 class TestNestedWhile:
     def test_nested_counted_loops(self, tmp_path):

@@ -107,3 +107,39 @@ class TestTrainFromRecords:
                 ["none.tfrecord"], ["MatMul"], nn.MSECriterion(),
                 dense_keys=["x"], dense_shapes=[(4,)], label_key="x",
                 batch_size=2)
+
+
+class TestSingleShardShuffle:
+    def test_epochs_reshuffle_within_one_shard(self, tmp_path):
+        """A single TFRecord file must still reorder records across epochs
+        (within-shard shuffle buffer), not just shuffle the shard list."""
+        from bigdl_tpu.dataset.tfrecord import ParsedExampleDataSet
+
+        rec, xs, _ = _write_records(tmp_path)
+        ds = ParsedExampleDataSet([rec], batch_size=BATCH,
+                                  dense_keys=["x", "y"],
+                                  dense_shapes=[(DIM,), ()], label_key="y")
+
+        def epoch_xs():
+            return np.concatenate([np.asarray(b.input)
+                                   for b in ds.data(train=True)])
+
+        e1, e2 = epoch_xs(), epoch_xs()
+        assert e1.shape == e2.shape == xs.shape
+        assert not np.allclose(e1, e2), "epochs served identical order"
+        # same multiset of records either epoch
+        key = lambda a: np.sort(a.round(5).sum(axis=1))
+        np.testing.assert_allclose(key(e1), key(e2), rtol=1e-5)
+        np.testing.assert_allclose(key(e1), key(xs), rtol=1e-5)
+
+    def test_eval_order_is_stable(self, tmp_path):
+        from bigdl_tpu.dataset.tfrecord import ParsedExampleDataSet
+
+        rec, xs, _ = _write_records(tmp_path)
+        ds = ParsedExampleDataSet([rec], batch_size=BATCH,
+                                  dense_keys=["x", "y"],
+                                  dense_shapes=[(DIM,), ()], label_key="y")
+        a = np.concatenate([np.asarray(m.input) for m in ds.data(train=False)])
+        b = np.concatenate([np.asarray(m.input) for m in ds.data(train=False)])
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_allclose(a, xs, rtol=1e-6)
