@@ -245,6 +245,75 @@ class Assign(Module):
         return value, {"value": value}
 
 
+class DynamicConv2D(Module):
+    """{x(NHWC), w(HWIO)} -> conv2d where the filter is a LIVE tensor —
+    the import lowering for Conv2D whose filter is an unfrozen graph
+    Variable (reference: TensorflowLoader.scala:456 binds VariableV2
+    endpoints as trainable weights; here the conv consumes the Variable
+    module's value so autodiff trains it)."""
+
+    def __init__(self, strides: Sequence[int], padding: str,
+                 dilations: Sequence[int] = (1, 1),
+                 groups: int = 1, name: Optional[str] = None):
+        super().__init__(name)
+        self.strides = tuple(strides)
+        self.padding = padding
+        self.dilations = tuple(dilations)
+        self.groups = groups
+
+    def build(self, rng, input_shape):
+        xs, ws = tuple(input_shape)
+        n, h, w_, _ = xs
+        kh, kw, _, co = ws
+        co = co * (self.groups if self.groups > 1 else 1)
+
+        def out_dim(size, k, s, d):
+            eff = (k - 1) * d + 1
+            if self.padding == "SAME":
+                return -(-size // s)
+            return -(-(size - eff + 1) // s)
+
+        oh = out_dim(h, kh, self.strides[0], self.dilations[0])
+        ow = out_dim(w_, kw, self.strides[1], self.dilations[1])
+        return {}, {}, (n, oh, ow, co)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x, w = _pair(x)
+        if self.groups > 1:  # depthwise: HWIM -> HWI'(I*M) grouped filter
+            kh, kw, ci, mult = w.shape
+            w = jnp.reshape(w, (kh, kw, 1, ci * mult))
+        y = jax.lax.conv_general_dilated(
+            x, w, window_strides=self.strides, padding=self.padding,
+            rhs_dilation=self.dilations,
+            feature_group_count=self.groups,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return y, state
+
+
+class DynamicFusedBatchNorm(Module):
+    """{x, gamma, beta, mean, var} -> batch norm with LIVE parameters —
+    the import lowering for FusedBatchNorm(V2/V3) whose scale/offset are
+    unfrozen graph Variables.  is_training=True computes batch moments
+    over N,H,W (TF semantics: the incoming mean/var inputs are ignored)."""
+
+    def __init__(self, eps: float, is_training: bool,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.eps = eps
+        self.is_training = is_training
+
+    def build(self, rng, input_shape):
+        return {}, {}, tuple(tuple(input_shape)[0])
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x, g, b, m, v = tuple(x)
+        if self.is_training:
+            m = jnp.mean(x, axis=(0, 1, 2))
+            v = jnp.var(x, axis=(0, 1, 2))
+        y = (x - m) * (g * jax.lax.rsqrt(v + self.eps)) + b
+        return y, state
+
+
 # ---------------------------------------------------------------------------
 # tf.train.Example wire-format codec + ParsingOps
 # (reference: nn/tf/ParsingOps.scala:36-93)

@@ -539,6 +539,10 @@ def convert_model(args: Optional[Sequence[str]] = None) -> None:
                    help="comma-separated NHWC build shape, e.g. 8,28,28,1")
     p.add_argument("--tf-inputs", default="input")
     p.add_argument("--tf-outputs", default="output")
+    p.add_argument("--tf-checkpoint", default=None,
+                   help="TF checkpoint PREFIX for an UNFROZEN .pb "
+                        "(VariableV2/VarHandleOp graphs; reference: "
+                        "scripts/export_tf_checkpoint.py)")
     p.add_argument("--quantize", choices=("dynamic", "static", "weight_only"),
                    help="int8-quantize before writing (native output only; "
                         "reference: ConvertModel --quantize)")
@@ -562,7 +566,8 @@ def convert_model(args: Optional[Sequence[str]] = None) -> None:
         from bigdl_tpu.utils.tensorflow import load_tensorflow
 
         module, params, state = load_tensorflow(
-            ns.src, ns.tf_inputs.split(","), ns.tf_outputs.split(","), [shape])
+            ns.src, ns.tf_inputs.split(","), ns.tf_outputs.split(","),
+            [shape], checkpoint=ns.tf_checkpoint)
     elif ".json" in ns.src:
         from bigdl_tpu.keras.converter import load_keras_model
 

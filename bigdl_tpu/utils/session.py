@@ -29,11 +29,13 @@ class Session:
     """reference: utils/tf/Session.scala:43 (abstract Session API)."""
 
     def __init__(self, pb_path: str, inputs: Sequence[str],
-                 input_shapes: Sequence[Sequence[int]], seed: int = 0):
+                 input_shapes: Sequence[Sequence[int]], seed: int = 0,
+                 checkpoint: Optional[str] = None):
         self.pb_path = pb_path
         self.inputs = list(inputs)
         self.input_shapes = [tuple(s) for s in input_shapes]
         self.seed = seed
+        self.checkpoint = checkpoint
         self.model = None
         self.params = None
         self.state = None
@@ -47,7 +49,7 @@ class Session:
         if self.model is None or outputs != self._outputs:
             self.model, self.params, self.state = load_tensorflow(
                 self.pb_path, self.inputs, outputs, self.input_shapes,
-                seed=self.seed)
+                seed=self.seed, checkpoint=self.checkpoint)
             self._outputs = outputs
         return self.model
 

@@ -114,7 +114,8 @@ class _UnresolvedInput(KeyError):
 class _TFImporter:
     def __init__(self, graph_def, input_names: Sequence[str],
                  input_shapes: Sequence[Sequence[int]],
-                 node_index: Optional[Dict[str, Any]] = None):
+                 node_index: Optional[Dict[str, Any]] = None,
+                 var_values: Optional[Dict[str, np.ndarray]] = None):
         self.nodes_by_name = (node_index if node_index is not None
                               else {n.name: n for n in graph_def.node})
         self.consts: Dict[str, np.ndarray] = {}
@@ -122,11 +123,65 @@ class _TFImporter:
         self.shapes: Dict[str, Any] = {}
         self.weight_sets: List[Tuple[str, Dict[str, np.ndarray]]] = []
         self.input_nodes = []
+        self.var_values = var_values
         for name, sh in zip(input_names, input_shapes):
             node = nn.Input(name=f"input_{name}")
             self.graph_nodes[name] = node
             self.shapes[name] = tuple(sh)
             self.input_nodes.append(node)
+
+    def _initializer_value(self, name: str) -> Optional[np.ndarray]:
+        """Fold a variable's initializer Assign(var, const) — how values
+        reach an UNFROZEN graph loaded without a checkpoint (reference:
+        TensorflowLoader evaluates Variable endpoints at import)."""
+        if not hasattr(self, "_assign_index"):
+            idx: Dict[str, list] = {}
+            for n in self.nodes_by_name.values():
+                if n.op in ("Assign", "AssignVariableOp") and len(n.input) > 1:
+                    idx.setdefault(_clean(n.input[0]), []).append(n)
+            self._assign_index = idx
+        for n in self._assign_index.get(name, []):
+            try:
+                return self.const_of(n.input[1])
+            except (ValueError, KeyError):
+                continue
+        return None
+
+    def _attach_variable(self, nd) -> None:
+        """VariableV2 / Variable / VarHandleOp -> trainable parameter
+        (float dtypes; integer variables such as global_step live in
+        state).  Value: checkpoint tensor if one was passed, else the
+        const-foldable initializer.  reference:
+        utils/tf/TensorflowLoader.scala:456 (Variable endpoint binding),
+        nn/tf/StateOps.scala."""
+        from bigdl_tpu.nn import tf_ops as _tf
+
+        name = nd.name
+        if not self.graph_nodes:
+            raise _UnresolvedInput(name)  # needs any node to anchor on
+        np_dtype = _NP_DTYPES.get(nd.attr["dtype"].type, np.float32)
+        if self.var_values is not None and name in self.var_values:
+            value = np.asarray(self.var_values[name], np_dtype)
+        else:
+            value = self._initializer_value(name)
+            if value is not None:
+                value = np.asarray(value, np_dtype)
+        if value is None:
+            raise ValueError(
+                f"variable {name!r} has no value: pass checkpoint= (a TF "
+                f"checkpoint prefix) to load_tensorflow, or keep the "
+                f"variable's initializer Assign const-foldable")
+        shape = tuple(d.size for d in nd.attr["shape"].shape.dim)
+        if shape and tuple(value.shape) != shape:
+            raise ValueError(
+                f"variable {name!r}: checkpoint/initializer shape "
+                f"{value.shape} != declared {shape}")
+        trainable = bool(np.issubdtype(np_dtype, np.floating))
+        anchor = next(iter(self.graph_nodes))
+        node = _tf.Variable(value, trainable=trainable, name=name)(
+            self.graph_nodes[anchor])
+        self.graph_nodes[name] = node
+        self.shapes[name] = tuple(value.shape)
 
     def const_of(self, name: str) -> np.ndarray:
         name = _clean(name)
@@ -289,7 +344,22 @@ class _TFImporter:
         if op == "Identity":
             if self._key(data_inputs[0]) in self.graph_nodes:
                 self._alias(name, data_inputs[0])
+                return
+            prod = self.nodes_by_name.get(_clean(data_inputs[0]))
+            if prod is not None and prod.op in _VAR_OPS:
+                # variable read before the Variable converted: defer so the
+                # alias lands (const_of would wrongly claim it frozen)
+                raise _UnresolvedInput(data_inputs[0])
             # else: frozen-variable Identity(Const), resolved via const_of
+            return
+        if op in _VAR_OPS:
+            self._attach_variable(nd)
+            return
+        if op == "ReadVariableOp":
+            # resource-variable read: alias the VarHandleOp's live value
+            if self._key(data_inputs[0]) not in self.graph_nodes:
+                raise _UnresolvedInput(data_inputs[0])
+            self._alias(name, data_inputs[0])
             return
         graph_in = [i for i in data_inputs
                     if self._key(i) in self.graph_nodes]
@@ -298,6 +368,20 @@ class _TFImporter:
 
         bshape = self.shapes[self._key(graph_in[0])]
         if op == "Conv2D" or op == "DepthwiseConv2dNative":
+            if self._key(data_inputs[1]) in self.graph_nodes:
+                # unfrozen filter (graph Variable): live-weight conv
+                from bigdl_tpu.nn import tf_ops as _tf
+
+                strides = list(nd.attr["strides"].list.i) or [1, 1, 1, 1]
+                dil = list(nd.attr["dilations"].list.i) or [1, 1, 1, 1]
+                pad = nd.attr["padding"].s.decode() \
+                    if nd.attr["padding"].s else "VALID"
+                groups = bshape[-1] if op == "DepthwiseConv2dNative" else 1
+                m = _tf.DynamicConv2D((strides[1], strides[2]), pad,
+                                      (dil[1], dil[2]), groups=groups,
+                                      name=name)
+                self._attach(name, m, data_inputs[:2])
+                return
             w = self.const_of(data_inputs[1])  # HWIO (HWIM for depthwise)
             kh, kw = w.shape[0], w.shape[1]
             strides = list(nd.attr["strides"].list.i) or [1, 1, 1, 1]
@@ -346,9 +430,13 @@ class _TFImporter:
                                         bool(nd.attr["adj_x"].b),
                                         bool(nd.attr["adj_y"].b))
         elif op in ("BiasAdd", "BiasAddV1"):
-            b = self.const_of(data_inputs[1])
-            m = nn.CAdd(b.shape, name=name)
-            self._attach(name, m, [data_inputs[0]], {"bias": b})
+            if self._key(data_inputs[1]) in self.graph_nodes:
+                # unfrozen bias (graph Variable): broadcast table add
+                self._attach(name, nn.CAddTable(name=name), data_inputs[:2])
+            else:
+                b = self.const_of(data_inputs[1])
+                m = nn.CAdd(b.shape, name=name)
+                self._attach(name, m, [data_inputs[0]], {"bias": b})
         elif op in ("Relu", "Relu6", "Tanh", "Sigmoid", "Elu", "Softplus",
                     "Softmax"):
             cls = {"Relu": nn.ReLU, "Relu6": nn.ReLU6, "Tanh": nn.Tanh,
@@ -366,7 +454,21 @@ class _TFImporter:
                 kw_["count_include_pad"] = False
             m = cls(ks[2], ks[1], st[2], st[1], p, p, **kw_)
             self._attach(name, m, [data_inputs[0]])
-        elif op in ("FusedBatchNorm", "FusedBatchNormV3"):
+        elif op in ("FusedBatchNorm", "FusedBatchNormV2",
+                    "FusedBatchNormV3"):
+            if any(self._key(di) in self.graph_nodes
+                   for di in data_inputs[1:5]):
+                # unfrozen scale/offset/stats (graph Variables)
+                from bigdl_tpu.nn import tf_ops as _tf
+
+                eps = nd.attr["epsilon"].f or 1e-3
+                is_training = bool(nd.attr["is_training"].b)
+                for di in data_inputs[1:5]:
+                    if self._key(di) not in self.graph_nodes:
+                        self._ensure_node(di, anchor=graph_in[0])
+                m = _tf.DynamicFusedBatchNorm(eps, is_training, name=name)
+                self._attach(name, m, data_inputs[:5])
+                return
             gamma = self.const_of(data_inputs[1])
             beta = self.const_of(data_inputs[2])
             mean = self.const_of(data_inputs[3])
@@ -973,6 +1075,7 @@ class _TFImporter:
 
 _CF_SKELETON = ("Enter", "Merge", "Switch", "Exit", "NextIteration",
                 "LoopCond")
+_VAR_OPS = ("VariableV2", "Variable", "VarHandleOp")
 
 
 def _sweep(imp: "_TFImporter", pending):
@@ -1612,16 +1715,30 @@ def _convert_cond_region(imp: "_TFImporter", region) -> None:
 def load_tensorflow(pb_path: str, inputs: Sequence[str],
                     outputs: Sequence[str],
                     input_shapes: Optional[Sequence[Sequence[int]]] = None,
-                    seed: int = 0) -> Tuple[nn.Graph, Any, Any]:
-    """Parse a frozen GraphDef into (Graph, params, state).
+                    seed: int = 0,
+                    checkpoint: Optional[str] = None
+                    ) -> Tuple[nn.Graph, Any, Any]:
+    """Parse a (frozen or unfrozen) GraphDef into (Graph, params, state).
     reference: TensorflowLoader.load (utils/tf/TensorflowLoader.scala:55).
 
     `input_shapes` may be omitted when every input Placeholder declares a
-    fully-static shape attr (TF marks unknown dims as -1/0)."""
+    fully-static shape attr (TF marks unknown dims as -1/0).
+
+    `checkpoint` — a TF v2-format checkpoint PREFIX (e.g.
+    '.../model.ckpt'): graph Variables (VariableV2/VarHandleOp) bind the
+    checkpoint tensors and import as trainable parameters, the reference's
+    unfrozen-graph flow (TensorflowLoader.scala:456 Variable endpoints +
+    scripts/export_tf_checkpoint.py).  Without it, variables fold their
+    const-foldable initializer Assign instead."""
     gd = tfp.GraphDef()
     with open(pb_path, "rb") as f:
         gd.ParseFromString(f.read())
     node_index = {n.name: n for n in gd.node}
+    var_values = None
+    if checkpoint is not None:
+        from bigdl_tpu.utils.tf_checkpoint import read_checkpoint
+
+        var_values = read_checkpoint(checkpoint)
     if input_shapes is None:
         input_shapes = []
         for name in inputs:
@@ -1635,7 +1752,8 @@ def load_tensorflow(pb_path: str, inputs: Sequence[str],
                     f"input {name!r} has no fully-static declared shape "
                     f"({dims or 'missing'}); pass input_shapes= explicitly")
             input_shapes.append(tuple(dims))
-    imp = _TFImporter(gd, inputs, input_shapes, node_index)
+    imp = _TFImporter(gd, inputs, input_shapes, node_index,
+                      var_values=var_values)
     # convert only ANCESTORS of the requested outputs, stopping at the
     # inputs: a graph cut at e.g. the ParseExample outputs must not try to
     # convert the upstream reader/queue chain (reference:

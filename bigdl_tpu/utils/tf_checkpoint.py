@@ -1,0 +1,198 @@
+"""TF v2-format ("tensor bundle") checkpoint reader — pure host-side
+decode, no TensorFlow runtime.
+
+A checkpoint `prefix` names two files: `{prefix}.index`, a leveldb-style
+SSTable mapping tensor names to BundleEntryProto records, and
+`{prefix}.data-{shard:05d}-of-{n:05d}` shards holding the raw tensor
+bytes.  The reference restores these through the TF runtime when binding
+variables at import (utils/tf/TensorflowLoader.scala:456 collects
+Variable endpoints; utils/tf/Session.scala drives the training restore);
+here the bundle format itself is decoded so `load_tensorflow(...,
+checkpoint=...)` works on any host.
+
+Format notes (tensorflow/core/lib/table, a leveldb fork):
+- footer = last 48 bytes: metaindex BlockHandle + index BlockHandle
+  (each two varint64s), zero padding to 40 bytes, 8-byte magic.
+- a BlockHandle addresses block contents [offset, offset+size), followed
+  by a 1-byte compression type (0 raw, 1 snappy) + 4-byte crc32c.
+- block contents = prefix-compressed entries (varint32 shared, unshared,
+  value_len; key tail; value) with a restart-point array at the end.
+- the index block's values are BlockHandles of the data blocks; data
+  block keys are tensor names ("" = BundleHeaderProto).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, Iterator, Tuple
+
+import numpy as np
+
+_PROTO_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "proto")
+if _PROTO_DIR not in sys.path:
+    sys.path.insert(0, _PROTO_DIR)
+
+import tensor_bundle_pb2 as tbp  # noqa: E402  (generated; proto/)
+import tf_graph_pb2 as tfp  # noqa: E402
+
+_TABLE_MAGIC = 0xDB4775248B80FB57
+
+_BUNDLE_DTYPES = {
+    tfp.DT_FLOAT: np.float32,
+    tfp.DT_DOUBLE: np.float64,
+    tfp.DT_INT32: np.int32,
+    tfp.DT_INT64: np.int64,
+    tfp.DT_BOOL: np.bool_,
+    tfp.DT_UINT8: np.uint8,
+    tfp.DT_INT8: np.int8,
+    tfp.DT_INT16: np.int16,
+}
+
+
+def _varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result, shift = 0, 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _snappy_decompress(buf: bytes) -> bytes:
+    """Minimal raw-snappy decoder (the block format, not framed)."""
+    out_len, pos = _varint(buf, 0)
+    out = bytearray()
+    while pos < len(buf):
+        tag = buf[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            length = (tag >> 2) + 1
+            if length > 60:
+                extra = length - 60
+                length = int.from_bytes(buf[pos:pos + extra], "little") + 1
+                pos += extra
+            out += buf[pos:pos + length]
+            pos += length
+        else:
+            if kind == 1:  # copy, 1-byte offset
+                length = ((tag >> 2) & 0x7) + 4
+                offset = ((tag >> 5) << 8) | buf[pos]
+                pos += 1
+            elif kind == 2:  # copy, 2-byte offset
+                length = (tag >> 2) + 1
+                offset = int.from_bytes(buf[pos:pos + 2], "little")
+                pos += 2
+            else:  # copy, 4-byte offset
+                length = (tag >> 2) + 1
+                offset = int.from_bytes(buf[pos:pos + 4], "little")
+                pos += 4
+            start = len(out) - offset
+            for i in range(length):  # may self-overlap: byte-wise
+                out.append(out[start + i])
+    if len(out) != out_len:
+        raise ValueError(f"snappy: expected {out_len} bytes, got {len(out)}")
+    return bytes(out)
+
+
+def _read_block(data: bytes, offset: int, size: int) -> bytes:
+    contents = data[offset:offset + size]
+    ctype = data[offset + size]
+    if ctype == 1:
+        contents = _snappy_decompress(contents)
+    elif ctype != 0:
+        raise ValueError(f"unsupported block compression {ctype}")
+    return contents
+
+
+def _block_entries(block: bytes) -> Iterator[Tuple[bytes, bytes]]:
+    n_restarts = int.from_bytes(block[-4:], "little")
+    end = len(block) - 4 - 4 * n_restarts
+    pos, key = 0, b""
+    while pos < end:
+        shared, pos = _varint(block, pos)
+        unshared, pos = _varint(block, pos)
+        vlen, pos = _varint(block, pos)
+        key = key[:shared] + block[pos:pos + unshared]
+        pos += unshared
+        yield key, block[pos:pos + vlen]
+        pos += vlen
+
+
+def _index_entries(index_path: str) -> Iterator[Tuple[bytes, bytes]]:
+    with open(index_path, "rb") as f:
+        data = f.read()
+    if len(data) < 48:
+        raise ValueError(f"{index_path}: too small for an SSTable footer")
+    footer = data[-48:]
+    magic = int.from_bytes(footer[40:48], "little")
+    if magic != _TABLE_MAGIC:
+        raise ValueError(
+            f"{index_path}: bad table magic {magic:#x} — not a TF v2 "
+            f"(tensor bundle) checkpoint index")
+    _, p = _varint(footer, 0)      # metaindex offset
+    _, p = _varint(footer, p)      # metaindex size
+    ioff, p = _varint(footer, p)   # index block handle
+    isize, p = _varint(footer, p)
+    for _, handle in _block_entries(_read_block(data, ioff, isize)):
+        boff, hp = _varint(handle, 0)
+        bsize, _ = _varint(handle, hp)
+        yield from _block_entries(_read_block(data, boff, bsize))
+
+
+def read_checkpoint(prefix: str) -> Dict[str, np.ndarray]:
+    """Read every tensor of a TF v2-format checkpoint into host arrays.
+
+    `prefix` is the path passed to the TF saver (e.g. ".../model.ckpt"),
+    NOT one of the physical files.
+    """
+    index_path = prefix + ".index"
+    if not os.path.exists(index_path):
+        raise FileNotFoundError(
+            f"{index_path} not found — pass the checkpoint PREFIX "
+            f"(e.g. '/dir/model.ckpt'), not a physical file")
+    header = None
+    entries: Dict[str, tbp.BundleEntryProto] = {}
+    for key, value in _index_entries(index_path):
+        if key == b"":
+            header = tbp.BundleHeaderProto()
+            header.ParseFromString(value)
+            if header.endianness != 0:
+                raise ValueError("big-endian checkpoints unsupported")
+        else:
+            e = tbp.BundleEntryProto()
+            e.ParseFromString(value)
+            entries[key.decode()] = e
+    if header is None:
+        raise ValueError(f"{index_path}: missing bundle header entry")
+    shards: Dict[int, bytes] = {}
+
+    def shard(i: int) -> bytes:
+        if i not in shards:
+            path = f"{prefix}.data-{i:05d}-of-{header.num_shards:05d}"
+            with open(path, "rb") as f:
+                shards[i] = f.read()
+        return shards[i]
+
+    out: Dict[str, np.ndarray] = {}
+    for name, e in entries.items():
+        if e.slices:
+            raise ValueError(
+                f"checkpoint tensor {name!r} is a partitioned-variable "
+                f"slice — unsupported")
+        np_dtype = _BUNDLE_DTYPES.get(e.dtype)
+        if np_dtype is None:
+            continue  # e.g. DT_STRING bookkeeping tensors
+        shape = tuple(d.size for d in e.shape.dim)
+        raw = shard(e.shard_id)[e.offset:e.offset + e.size]
+        arr = np.frombuffer(raw, np_dtype)
+        if arr.size != int(np.prod(shape)):
+            raise ValueError(
+                f"checkpoint tensor {name!r}: {arr.size} values for shape "
+                f"{shape}")
+        out[name] = arr.reshape(shape).copy()
+    return out

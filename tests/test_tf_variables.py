@@ -1,0 +1,164 @@
+"""Unfrozen TF graphs: VariableV2 / VarHandleOp import with checkpoint
+restore — the reference's real-world TF story (TensorflowLoader.scala:456
+filters Variable endpoints and binds checkpoint values;
+scripts/export_tf_checkpoint.py + nn/tf/StateOps.scala support the flow).
+
+Fixtures are generated with the env's real TF (graph-mode sessions inside
+an explicit tf.Graph — no global eager disable needed); the framework's
+own bundle decode (utils/tf_checkpoint.py) never touches the TF runtime.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+tf = pytest.importorskip("tensorflow")
+
+import bigdl_tpu.nn as nn  # noqa: E402
+from bigdl_tpu.utils.tensorflow import load_tensorflow  # noqa: E402
+from bigdl_tpu.utils.tf_checkpoint import read_checkpoint  # noqa: E402
+
+N, H, W, C = 4, 8, 8, 3
+FILTERS, CLASSES = 6, 5
+
+
+def _build_v1_conv_graph(tmp_path, use_resource=False):
+    """conv(var) -> bias(var) -> relu -> flatten -> matmul(var) -> out,
+    saved UNFROZEN with a v2-format checkpoint."""
+    rs = np.random.RandomState(7)
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [N, H, W, C], name="x")
+        k = tf.compat.v1.Variable(
+            rs.randn(3, 3, C, FILTERS).astype(np.float32) * 0.2,
+            name="conv_w", use_resource=use_resource)
+        cb = tf.compat.v1.Variable(rs.randn(FILTERS).astype(np.float32) * 0.1,
+                                   name="conv_b", use_resource=use_resource)
+        w = tf.compat.v1.Variable(
+            rs.randn(H * W * FILTERS, CLASSES).astype(np.float32) * 0.05,
+            name="fc_w", use_resource=use_resource)
+        y = tf.nn.conv2d(x, k, strides=[1, 1, 1, 1], padding="SAME")
+        y = tf.nn.relu(tf.nn.bias_add(y, cb))
+        y = tf.reshape(y, [N, -1])
+        y = tf.linalg.matmul(y, w)
+        y = tf.identity(y, name="out")
+        init = tf.compat.v1.global_variables_initializer()
+        saver = tf.compat.v1.train.Saver()
+    xv = rs.randn(N, H, W, C).astype(np.float32)
+    with tf.compat.v1.Session(graph=g) as sess:
+        sess.run(init)
+        ref = sess.run(y, {x: xv})
+        prefix = saver.save(sess, str(tmp_path / "model.ckpt"))
+    pb = str(tmp_path / "graph.pb")
+    with open(pb, "wb") as fh:
+        fh.write(g.as_graph_def().SerializeToString())
+    return pb, prefix, xv, ref
+
+
+class TestBundleReader:
+    def test_matches_tf_loader(self, tmp_path):
+        pb, prefix, _, _ = _build_v1_conv_graph(tmp_path)
+        ours = read_checkpoint(prefix)
+        reader = tf.train.load_checkpoint(prefix)
+        keys = [k for k in reader.get_variable_to_shape_map()]
+        assert set(keys) <= set(ours) | {"_CHECKPOINTABLE_OBJECT_GRAPH"}
+        for k in keys:
+            if k in ours:
+                np.testing.assert_array_equal(ours[k], reader.get_tensor(k))
+        assert {"conv_w", "conv_b", "fc_w"} <= set(ours)
+
+    def test_prefix_not_file_error(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="PREFIX"):
+            read_checkpoint(str(tmp_path / "nothing"))
+
+
+class TestVariableImport:
+    def test_checkpoint_forward_matches_tf(self, tmp_path):
+        pb, prefix, xv, ref = _build_v1_conv_graph(tmp_path)
+        g, gp, gs = load_tensorflow(pb, ["x"], ["out"], [(N, H, W, C)],
+                                    checkpoint=prefix)
+        y = np.asarray(g.apply(gp, gs, jnp.asarray(xv))[0])
+        np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+
+    def test_initializer_fold_without_checkpoint(self, tmp_path):
+        """No checkpoint: variables bind their const-foldable initializer
+        Assign — matching TF right after global_variables_initializer."""
+        pb, _, xv, ref = _build_v1_conv_graph(tmp_path)
+        g, gp, gs = load_tensorflow(pb, ["x"], ["out"], [(N, H, W, C)])
+        y = np.asarray(g.apply(gp, gs, jnp.asarray(xv))[0])
+        np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+
+    def test_resource_variables(self, tmp_path):
+        """VarHandleOp/ReadVariableOp (TF2-style resource variables)."""
+        pb, prefix, xv, ref = _build_v1_conv_graph(tmp_path,
+                                                   use_resource=True)
+        g, gp, gs = load_tensorflow(pb, ["x"], ["out"], [(N, H, W, C)],
+                                    checkpoint=prefix)
+        y = np.asarray(g.apply(gp, gs, jnp.asarray(xv))[0])
+        np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+
+    def test_variables_are_trainable_params(self, tmp_path):
+        pb, prefix, _, _ = _build_v1_conv_graph(tmp_path)
+        g, gp, gs = load_tensorflow(pb, ["x"], ["out"], [(N, H, W, C)],
+                                    checkpoint=prefix)
+        names = " ".join(
+            jax.tree_util.keystr(path)
+            for path, _ in jax.tree_util.tree_flatten_with_path(gp)[0])
+        assert "conv_w" in names and "fc_w" in names, names
+
+    def test_missing_value_is_loud(self, tmp_path):
+        """A variable with neither checkpoint nor foldable initializer
+        must fail loudly, not import garbage."""
+        g = tf.Graph()
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, [2, 3], name="x")
+            w = tf.compat.v1.Variable(
+                tf.random.normal([3, 2]),  # non-const initializer
+                name="w", use_resource=False)
+            tf.linalg.matmul(x, w, name="out")
+        pb = str(tmp_path / "graph.pb")
+        with open(pb, "wb") as fh:
+            fh.write(g.as_graph_def().SerializeToString())
+        with pytest.raises(ValueError, match="checkpoint"):
+            load_tensorflow(pb, ["x"], ["out"], [(2, 3)])
+
+
+class TestFineTune:
+    def test_session_finetunes_checkpointed_graph(self, tmp_path):
+        """Fine-tune the restored (unfrozen) graph via Session.train:
+        loss decreases and the conv/fc variables move off their
+        checkpoint values."""
+        from bigdl_tpu.dataset import ArrayDataSet, Sample, SampleToMiniBatch
+        from bigdl_tpu.optim import SGD, Trigger
+        from bigdl_tpu.utils.session import Session
+
+        pb, prefix, xv, _ = _build_v1_conv_graph(tmp_path)
+        rs = np.random.RandomState(3)
+        labels = (np.arange(N) % CLASSES).astype(np.int32)
+        samples = [Sample.from_ndarray(xv[i], labels[i]) for i in range(N)]
+        ds = ArrayDataSet(samples).transform(SampleToMiniBatch(N))
+
+        sess = Session(pb, ["x"], [(N, H, W, C)], checkpoint=prefix)
+        crit = nn.CrossEntropyCriterion()
+        before = read_checkpoint(prefix)
+
+        def loss_of():
+            out, _ = sess.model.apply(sess.params, sess.state,
+                                      jnp.asarray(xv))
+            return float(crit.forward(out, jnp.asarray(labels)))
+
+        sess.train(["out"], ds, crit,
+                   optim_method=SGD(learning_rate=0.5),
+                   end_when=Trigger.max_epoch(30))
+        after_loss = loss_of()
+        # params moved off the checkpoint and the fit improved
+        moved = np.abs(np.asarray(sess.params["conv_w"]["value"])
+                       - before["conv_w"]).max()
+        assert moved > 1e-4, moved
+        g0, gp0, gs0 = load_tensorflow(pb, ["x"], ["out"], [(N, H, W, C)],
+                                       checkpoint=prefix)
+        out0, _ = g0.apply(gp0, gs0, jnp.asarray(xv))
+        loss0 = float(crit.forward(out0, jnp.asarray(labels)))
+        assert after_loss < loss0 * 0.5, (loss0, after_loss)
