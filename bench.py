@@ -46,7 +46,11 @@ def main():
     from bigdl_tpu.models import resnet50
     from bigdl_tpu.optim import SGD
 
-    model = resnet50(CLASSES)
+    import os
+
+    # BENCH_FUSE_BN=1 measures the pallas conv+BN-stats variant
+    # (nn.SpatialConvolutionBN; BENCH_APPENDIX.md's named lever)
+    model = resnet50(CLASSES, fuse_bn=os.environ.get("BENCH_FUSE_BN") == "1")
     shape = (BATCH, IMAGE, IMAGE, 3)
     params, state, _ = model.build(jax.random.PRNGKey(0), shape)
     optim = SGD(learning_rate=0.1, momentum=0.9, dampening=0.0)

@@ -39,7 +39,8 @@ def build_step(model, optim, criterion):
     return train_step
 
 
-def sweep(batches=(128, 192, 256, 320, 384), remat=False):
+def sweep(batches=(128, 192, 256, 320, 384), remat=False,
+          fuse_bn=False):
     import jax
     import jax.numpy as jnp
 
@@ -49,7 +50,7 @@ def sweep(batches=(128, 192, 256, 320, 384), remat=False):
 
     rows = []
     for batch in batches:
-        model = resnet50(1000, remat=remat)
+        model = resnet50(1000, remat=remat, fuse_bn=fuse_bn)
         shape = (batch, 224, 224, 3)
         params, state, _ = model.build(jax.random.PRNGKey(0), shape)
         optim = SGD(learning_rate=0.1, momentum=0.9, dampening=0.0)
@@ -87,6 +88,7 @@ def sweep(batches=(128, 192, 256, 320, 384), remat=False):
         roofline = max(flop_floor, hbm_floor)
         rows.append({
             "remat": remat,
+            "fuse_bn": fuse_bn,
             "batch": batch,
             "ms_per_step": round(dt * 1e3, 2),
             "img_per_s": round(batch / dt, 1),
@@ -108,6 +110,11 @@ if __name__ == "__main__":
 
     if "--remat" in sys.argv:
         rows = sweep(batches=(256, 384, 512), remat=True)
+    elif "--fuse-bn" in sys.argv:
+        # the conv+BN-stats pallas epilogue variant (nn.SpatialConvolutionBN)
+        # vs the standard step at the operating point and one larger batch
+        rows = sweep(batches=(256, 384), fuse_bn=True)
+        rows += sweep(batches=(256,), fuse_bn=False)
     else:
         rows = sweep()
     print(json.dumps({"sweep": rows}))

@@ -4,6 +4,7 @@ from bigdl_tpu.dataset.transformer import Transformer, SampleToMiniBatch
 from bigdl_tpu.dataset.dataset import DataSet, LocalDataSet, ArrayDataSet
 from bigdl_tpu.dataset.datamining import (RowTransformer, RowTransformSchema,
                                           TableToSample)
+from bigdl_tpu.dataset.tfrecord import VarLenFeature
 from bigdl_tpu.dataset import image
 from bigdl_tpu.dataset import text
 
@@ -11,7 +12,7 @@ __all__ = ["Sample", "SparseFeature", "MiniBatch", "SparseMiniBatch",
            "Transformer", "SampleToMiniBatch",
            "DataSet", "LocalDataSet", "ArrayDataSet",
            "RowTransformer", "RowTransformSchema", "TableToSample",
-           "image", "text"]
+           "VarLenFeature", "image", "text"]
 from bigdl_tpu.dataset import datasets
 from bigdl_tpu.dataset.datasets import (
     load_mnist,

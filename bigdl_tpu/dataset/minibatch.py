@@ -99,13 +99,21 @@ class SparseMiniBatch(MiniBatch):
                 shapes = {v.dense_shape for v in values}
                 if len(shapes) != 1:
                     raise ValueError(f"inconsistent dense_shapes in batch: {shapes}")
-                return np.stack([v.to_dense() for v in values])
+                pad = 0 if padding is None else padding
+                return np.stack([v.to_dense(pad) for v in values])
             arrays = [np.asarray(v) for v in values]
             return _pad_stack(arrays, padding) if padding is not None else np.stack(arrays)
 
         def batch_side(first, get, padding):
             if isinstance(first, (tuple, list)):
-                return tuple(batch_one([get(s)[i] for s in samples], padding)
+                # padding may be per-component (reference: PaddingParam per
+                # tensor, MiniBatch.scala:579) or one value for all
+                def pad_of(i):
+                    return padding[i] if isinstance(padding, (tuple, list)) \
+                        else padding
+
+                return tuple(batch_one([get(s)[i] for s in samples],
+                                       pad_of(i))
                              for i in range(len(first)))
             return batch_one([get(s) for s in samples], padding)
 

@@ -67,8 +67,10 @@ class SparseFeature:
     def shape(self) -> Tuple[int, ...]:
         return self.dense_shape
 
-    def to_dense(self) -> np.ndarray:
-        out = np.zeros(self.dense_shape, self.values.dtype)
+    def to_dense(self, pad=0) -> np.ndarray:
+        """Densify; `pad` fills the non-stored positions (e.g. -1 for id
+        bags feeding LookupTableSparse, whose padding id is -1)."""
+        out = np.full(self.dense_shape, pad, self.values.dtype)
         if self.values.size:
             out[tuple(self.indices.T)] = self.values
         return out

@@ -257,6 +257,56 @@ class RecordToSample(Transformer):
             yield record_to_sample(rec)
 
 
+class VarLenFeature:
+    """Declaration of a variable-length (sparse) Example feature column.
+
+    Reference: utils/tf/loaders/ParseExample.scala + nn/tf/
+    ParsingOps.scala parse VarLen features into COO SparseTensors; here
+    each record becomes a host-side `SparseFeature` that SparseMiniBatch
+    densifies at the batch boundary (static shapes for jit, MXU-friendly).
+
+    encodings:
+    - "positions" (TF parity): values scatter at positions 0..n-1 into a
+      (`size`,) vector — a padded ragged list once densified.  Pair with
+      feature_padding=-1 to feed LookupTableSparse id bags.
+    - "multi_hot": int values are INDICES into a (`size`,)-wide vocab;
+      the densified row is their multi-hot (count) encoding — the
+      SparseLinear wide-model input.
+    """
+
+    def __init__(self, key: str, size: int, dtype: str = "int64",
+                 encoding: str = "positions"):
+        if encoding not in ("positions", "multi_hot"):
+            raise ValueError(f"unknown VarLen encoding {encoding!r}")
+        self.key = key
+        self.size = int(size)
+        self.dtype = dtype
+        self.encoding = encoding
+
+    def to_sparse(self, values):
+        import numpy as _np
+
+        from bigdl_tpu.dataset.sample import SparseFeature
+
+        values = _np.asarray(values)
+        if self.encoding == "multi_hot":
+            if values.size and (values.min() < 0
+                                or values.max() >= self.size):
+                raise ValueError(
+                    f"VarLen {self.key!r}: id out of range [0, {self.size})")
+            idx, counts = _np.unique(values.astype(_np.int64),
+                                     return_counts=True)
+            return SparseFeature(idx[:, None], counts.astype(self.dtype),
+                                 (self.size,))
+        if values.size > self.size:
+            raise ValueError(
+                f"VarLen {self.key!r}: record has {values.size} values, "
+                f"declared size {self.size}")
+        return SparseFeature(
+            _np.arange(values.size, dtype=_np.int64)[:, None],
+            values.astype(self.dtype), (self.size,))
+
+
 class ParsedExampleDataSet(DataSet):
     """TFRecord shards of serialized tf.train.Examples -> MiniBatches via
     the host-side ParseExample op: the imported-graph training data path
@@ -267,13 +317,21 @@ class ParsedExampleDataSet(DataSet):
     columns (`dense_keys`/`dense_shapes` order); `label_key` becomes the
     target, the remaining columns the (tuple of) inputs.  The trailing
     partial batch is dropped so the jitted step sees one static shape.
+
+    `sparse_features` (VarLenFeature declarations) append sparse columns
+    after the dense ones; batches then come out as SparseMiniBatch with
+    each sparse column densified per its encoding (`feature_padding`
+    fills the unset positions — scalar or per-column tuple over the
+    FULL input column list, dense columns first).
     """
 
     def __init__(self, paths: Sequence[str], batch_size: int,
                  dense_keys: Sequence[str],
                  dense_shapes: Sequence[Sequence[int]],
                  label_key: str, n_threads: int = 4,
-                 label_dtype: str = "int32"):
+                 label_dtype: str = "int32",
+                 sparse_features: Sequence[VarLenFeature] = (),
+                 feature_padding=None):
         from bigdl_tpu.nn.tf_ops import ParseExample
 
         self.paths = list(paths)
@@ -284,6 +342,9 @@ class ParsedExampleDataSet(DataSet):
             raise ValueError(f"label_key {label_key!r} not in dense_keys")
         self.n_threads = n_threads
         self.label_dtype = label_dtype
+        self.sparse_features = list(sparse_features)
+        self.feature_padding = feature_padding
+        self._dense_shapes = [tuple(s) for s in dense_shapes]
         self._parser = ParseExample(dense_keys, dense_shapes)
         self._epoch = 0
         self._size = -1
@@ -331,9 +392,39 @@ class ParsedExampleDataSet(DataSet):
         for rec in records():
             buf.append(rec)
             if len(buf) == self.batch_size:
-                cols = list(self._parser.compute(
-                    _np.asarray(buf, dtype=object)))
-                y = _np.asarray(cols[li]).astype(self.label_dtype)
-                xs = [c for i, c in enumerate(cols) if i != li]
-                yield MiniBatch(xs[0] if len(xs) == 1 else tuple(xs), y)
+                if self.sparse_features:
+                    yield self._sparse_batch(buf)
+                else:
+                    cols = list(self._parser.compute(
+                        _np.asarray(buf, dtype=object)))
+                    y = _np.asarray(cols[li]).astype(self.label_dtype)
+                    xs = [c for i, c in enumerate(cols) if i != li]
+                    yield MiniBatch(xs[0] if len(xs) == 1 else tuple(xs), y)
                 buf = []
+
+    def _sparse_batch(self, records: Sequence[bytes]):
+        """Per-record parse -> Sample(dense..., SparseFeature...) ->
+        SparseMiniBatch (densified at this batch boundary)."""
+        import numpy as _np
+
+        from bigdl_tpu.dataset.minibatch import SparseMiniBatch
+        from bigdl_tpu.dataset.sample import Sample
+        from bigdl_tpu.nn.tf_ops import parse_example_proto
+
+        samples = []
+        for rec in records:
+            feats = parse_example_proto(bytes(rec))
+            parts = []
+            label = None
+            for k, sh in zip(self.dense_keys, self._dense_shapes):
+                v = _np.asarray(feats[k]).reshape(sh)
+                if k == self.label_key:
+                    label = v.astype(self.label_dtype)
+                else:
+                    parts.append(v)
+            for sf in self.sparse_features:
+                parts.append(sf.to_sparse(feats.get(sf.key, ())))
+            samples.append(Sample(tuple(parts) if len(parts) > 1
+                                  else parts[0], label))
+        return SparseMiniBatch.from_samples(
+            samples, feature_padding=self.feature_padding)

@@ -1,0 +1,155 @@
+"""Run keras-1 code with this framework as the training backend.
+
+Reference: pyspark/bigdl/keras/backend.py — `with_bigdl_backend(kmodel)`
+wraps a LIVE, compiled keras-1.2.2 model: the definition converts through
+DefinitionLoader, the weights through WeightLoader, the compiled
+optimizer/loss/metrics through OptimConverter, and fit/evaluate/predict
+then run on the BigDL engine with keras signatures.
+
+Here the wrapper is DUCK-TYPED (keras 1.2.2 is dead software and not in
+the environment): anything exposing `to_json()`, `layers` (each with
+`.name`/`.get_weights()`), and the compiled `loss`/`optimizer`/`metrics`
+attributes converts — which is exactly the surface a real keras-1 Model
+object exposes.  fit/evaluate/predict keep the keras-1 signatures
+(`nb_epoch`, `validation_data`) and delegate to the Keras-API topology
+(`keras/topology.py`), i.e. the standard Optimizer/Evaluator/Predictor
+stack on the TPU path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from bigdl_tpu.keras.converter import (load_keras_weights,
+                                       model_from_json_config)
+
+
+def _scalar(v, default=None):
+    if v is None:
+        return default
+    try:
+        return float(np.asarray(v))
+    except Exception:
+        get = getattr(v, "get_value", None)
+        if get is not None:
+            return float(np.asarray(get()))
+        raise
+
+
+def to_bigdl_optim_method(koptim_method) -> Any:
+    """Map a keras-1 optimizer OBJECT (duck-typed by class name + hyper
+    attrs) to an OptimMethod.  Reference:
+    pyspark/bigdl/keras/optimization.py OptimConverter.to_bigdl_optim_method."""
+    from bigdl_tpu import optim
+
+    name = type(koptim_method).__name__.lower()
+    o = koptim_method
+    lr = _scalar(getattr(o, "lr", None), 0.01)
+    decay = _scalar(getattr(o, "decay", None), 0.0)
+    if name == "sgd":
+        return optim.SGD(
+            learning_rate=lr, learning_rate_decay=decay,
+            momentum=_scalar(getattr(o, "momentum", None), 0.0),
+            dampening=0.0,
+            nesterov=bool(getattr(o, "nesterov", False)))
+    if name == "rmsprop":
+        return optim.RMSprop(learning_rate=lr, learning_rate_decay=decay,
+                             decay_rate=_scalar(getattr(o, "rho", None), 0.9),
+                             epsilon=_scalar(getattr(o, "epsilon", None), 1e-8))
+    if name == "adagrad":
+        return optim.Adagrad(learning_rate=lr, learning_rate_decay=decay)
+    if name == "adadelta":
+        return optim.Adadelta(decay_rate=_scalar(getattr(o, "rho", None), 0.95),
+                              epsilon=_scalar(getattr(o, "epsilon", None), 1e-8))
+    if name == "adam":
+        return optim.Adam(learning_rate=lr, learning_rate_decay=decay,
+                          beta1=_scalar(getattr(o, "beta_1", None), 0.9),
+                          beta2=_scalar(getattr(o, "beta_2", None), 0.999),
+                          epsilon=_scalar(getattr(o, "epsilon", None), 1e-8))
+    if name == "adamax":
+        return optim.Adamax(learning_rate=lr,
+                            beta1=_scalar(getattr(o, "beta_1", None), 0.9),
+                            beta2=_scalar(getattr(o, "beta_2", None), 0.999))
+    raise ValueError(f"unsupported keras optimizer {type(koptim_method).__name__!r}")
+
+
+class KerasModelWrapper:
+    """reference: pyspark/bigdl/keras/backend.py:21."""
+
+    def __init__(self, kmodel, input_shape=None, seed: int = 0):
+        import jax
+
+        from bigdl_tpu import nn
+        from bigdl_tpu.core.table import Table
+
+        self.model = model_from_json_config(kmodel.to_json())
+        shape = input_shape
+        if shape is None:
+            declared = getattr(self.model, "keras_batch_input_shapes", None)
+            if declared is not None:
+                shapes = [(1,) + tuple(s[1:]) for s in declared]
+                shape = shapes[0] if len(shapes) == 1 else shapes
+            else:
+                first = self.model.children[next(iter(self.model.children))]
+                shape = (1,) + tuple(first.keras_input_shape)
+        multi = (isinstance(shape, (list, tuple)) and shape
+                 and isinstance(shape[0], (list, tuple)))
+        build_shape = Table(*[tuple(s) for s in shape]) if multi \
+            else tuple(shape)
+        params, state, _ = self.model.build(jax.random.PRNGKey(seed),
+                                            build_shape)
+        # weights from the live model (reference: WeightLoader)
+        if isinstance(self.model, nn.Graph):
+            for layer in kmodel.layers:
+                ws = layer.get_weights()
+                if not ws:
+                    continue
+                child = self.model.children[layer.name]
+                params[layer.name], state[layer.name] = load_keras_weights(
+                    child, params[layer.name], state.get(layer.name, {}),
+                    [ws])
+        else:
+            groups = [layer.get_weights() for layer in kmodel.layers
+                      if layer.get_weights()]
+            if groups:
+                params, state = load_keras_weights(self.model, params,
+                                                   state, groups)
+        self.model.params, self.model.state = params, state
+        # compiled training config (reference: OptimConverter)
+        loss = getattr(kmodel, "loss", None)
+        if loss is not None:
+            optimizer = getattr(kmodel, "optimizer", None)
+            self.model.compile(
+                to_bigdl_optim_method(optimizer) if optimizer is not None
+                and not isinstance(optimizer, str) else (optimizer or "sgd"),
+                loss, list(getattr(kmodel, "metrics", None) or []))
+
+    @property
+    def params(self):
+        return self.model.params
+
+    @property
+    def state(self):
+        return self.model.state
+
+    def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 10,
+            validation_data=None, **kwargs):
+        self.model.fit(x, y, batch_size=batch_size, nb_epoch=nb_epoch,
+                       validation_data=validation_data, **kwargs)
+        return self
+
+    def evaluate(self, x, y, batch_size: int = 32):
+        return self.model.evaluate(x, y, batch_size=batch_size)
+
+    def predict(self, x, batch_size: Optional[int] = None):
+        return self.model.predict(x, batch_size=batch_size or 32)
+
+    def predict_classes(self, x, batch_size: int = 32):
+        return self.model.predict_classes(x, batch_size=batch_size)
+
+
+def with_bigdl_backend(kmodel, input_shape=None) -> KerasModelWrapper:
+    """reference: pyspark/bigdl/keras/backend.py:178."""
+    return KerasModelWrapper(kmodel, input_shape=input_shape)

@@ -246,15 +246,89 @@ def _convert_layer(class_name: str, cfg: Dict[str, Any]):
                      f"(reference converter: pyspark/bigdl/keras/converter.py)")
 
 
-def model_from_json_config(json_str_or_dict) -> Sequential:
-    """Rebuild a Sequential from Keras-1.2.2 `model.to_json()` output."""
+def _functional_model_from_config(spec):
+    """Rebuild a keras-1 functional `Model` as an nn.Graph: walk
+    config["layers"] wiring each layer to its inbound nodes
+    (`[[layer, node_idx, tensor_idx], ...]`), inputs/outputs per
+    config["input_layers"]/["output_layers"].  Reference:
+    pyspark/bigdl/keras/converter.py:289 (DefinitionLoader builds the
+    BigDL graph from the keras node graph)."""
+    import bigdl_tpu.nn as nn
+
+    cfg = spec["config"]
+    nodes: Dict[str, Any] = {}
+    input_shapes: Dict[str, Any] = {}
+    for ld in cfg["layers"]:
+        class_name, lcfg = ld["class_name"], ld["config"]
+        lname = ld.get("name") or lcfg.get("name")
+        inbound = ld.get("inbound_nodes") or []
+        if class_name == "InputLayer":
+            nodes[lname] = nn.Input(name=lname)
+            shp = lcfg.get("batch_input_shape")
+            input_shapes[lname] = tuple(shp) if shp else None
+            continue
+        if len(inbound) != 1:
+            raise ValueError(
+                f"layer {lname!r} is applied {len(inbound)} times — "
+                f"shared layers are unsupported")
+        ups = []
+        for ref in inbound[0]:
+            src, node_idx, tensor_idx = ref[0], ref[1], ref[2]
+            if node_idx or tensor_idx:
+                raise ValueError(
+                    f"layer {lname!r}: inbound ref {ref} uses a non-zero "
+                    f"node/tensor index — shared/multi-output layers are "
+                    f"unsupported")
+            ups.append(nodes[src])
+        if class_name == "Merge" and not lcfg.get("layers"):
+            # functional-style Merge: branches arrive via inbound edges,
+            # so only the combine op is needed
+            mode = {"cos": "cosine"}.get(lcfg.get("mode", "sum"),
+                                         lcfg.get("mode", "sum"))
+            if mode == "dot" and lcfg.get("dot_axes") not in (None, -1,
+                                                              [-1, -1]):
+                raise ValueError("Merge dot_axes other than -1 unsupported")
+            combine = {
+                "sum": lambda: nn.CAddTable(name=lname),
+                "mul": lambda: nn.CMulTable(name=lname),
+                "ave": lambda: nn.CAveTable(name=lname),
+                "max": lambda: nn.CMaxTable(name=lname),
+                "concat": lambda: nn.JoinTable(
+                    lcfg.get("concat_axis", -1), name=lname),
+                "dot": lambda: nn.DotProduct(name=lname),
+                "cosine": lambda: nn.CosineDistance(name=lname),
+            }.get(mode)
+            if combine is None:
+                raise ValueError(f"unsupported Merge mode {mode!r}")
+            module = combine()
+        else:
+            module = _convert_layer(class_name, lcfg)
+            module.name = lname
+        nodes[lname] = module(*ups)
+    from bigdl_tpu.keras.topology import Model as KerasModel
+
+    graph_inputs = [nodes[r[0]] for r in cfg["input_layers"]]
+    outs = [nodes[r[0]] for r in cfg["output_layers"]]
+    graph = KerasModel(graph_inputs, outs,
+                       name=cfg.get("name") or "keras_model")
+    # batch_input_shapes in declared input order, for load_keras_model
+    graph.keras_batch_input_shapes = [input_shapes[r[0]]
+                                      for r in cfg["input_layers"]]
+    return graph
+
+
+def model_from_json_config(json_str_or_dict):
+    """Rebuild a model from Keras-1.2.2 `model.to_json()` output:
+    Sequential -> keras.Sequential, functional Model -> nn.Graph."""
     spec = (json.loads(json_str_or_dict)
             if isinstance(json_str_or_dict, (str, bytes)) else json_str_or_dict)
     class_name = spec.get("class_name")
+    if class_name == "Model":
+        return _functional_model_from_config(spec)
     if class_name != "Sequential":
         raise ValueError(
-            f"only Sequential definitions are supported (got {class_name!r}); "
-            f"functional Model graphs load via bigdl_tpu.nn.Graph directly")
+            f"only Sequential and functional Model definitions are "
+            f"supported (got {class_name!r})")
     model = Sequential()
     for layer_def in spec["config"]:
         model.add(_convert_layer(layer_def["class_name"], layer_def["config"]))
@@ -274,23 +348,38 @@ def load_keras_hdf5_weights(model, params, state, h5_path: str):
 
     Layout (keras 1.2.2 topology.py save_weights): file attr `layer_names`
     lists layer groups in model order; each group's attr `weight_names`
-    lists its datasets in get_weights() order.  Layers with no weights have
-    empty weight_names and are skipped — matching the positional discipline
-    of `load_keras_weights`.
+    lists its datasets in get_weights() order.  Sequential: layers with no
+    weights are skipped, matching `load_keras_weights`'s positional
+    discipline.  Functional `Model` graphs align BY NAME: each hdf5 group
+    maps to the graph child of the same name (two topological orders need
+    not tie-break identically, so positional alignment would be fragile).
     """
     import h5py
+
+    from bigdl_tpu import nn
 
     def _names(attr):
         return [n.decode() if isinstance(n, bytes) else str(n) for n in attr]
 
-    layer_weights: List[List] = []
     with h5py.File(h5_path, "r") as f:
+        groups = []
         for lname in _names(f.attrs["layer_names"]):
             g = f[lname]
             wnames = _names(g.attrs.get("weight_names", []))
             if wnames:
-                layer_weights.append([g[w][()] for w in wnames])
-    return load_keras_weights(model, params, state, layer_weights)
+                groups.append((lname, [g[w][()] for w in wnames]))
+    if not isinstance(model, nn.Graph):
+        return load_keras_weights(model, params, state,
+                                  [ws for _, ws in groups])
+    for lname, ws in groups:
+        child = model.children.get(lname)
+        if child is None:
+            raise ValueError(
+                f"hdf5 layer {lname!r} has no graph child of that name "
+                f"(children: {sorted(model.children)})")
+        params[lname], state[lname] = load_keras_weights(
+            child, params.get(lname, {}), state.get(lname, {}), [ws])
+    return params, state
 
 
 def load_keras_model(json_path: str, h5_path: str = None, *,
@@ -300,18 +389,35 @@ def load_keras_model(json_path: str, h5_path: str = None, *,
     reference: pyspark/bigdl/keras/converter.py load_keras entry."""
     import jax
 
+    from bigdl_tpu.core.table import Table
+
     with open(json_path) as fh:
         model = model_from_json_config(fh.read())
     shape = input_shape
     if shape is None:
-        first = model.children[next(iter(model.children))]
-        declared = getattr(first, "keras_input_shape", None)
-        if declared is None or any(d is None for d in declared):
-            raise ValueError(
-                "pass input_shape= (the model JSON declares no concrete "
-                "batch_input_shape — variable dims need an explicit shape)")
-        shape = (1,) + tuple(declared)
-    params, state, _ = model.build(jax.random.PRNGKey(seed), tuple(shape))
+        declared_list = getattr(model, "keras_batch_input_shapes", None)
+        if declared_list is not None:  # functional Model
+            if any(s is None or any(d is None for d in s[1:])
+                   for s in declared_list):
+                raise ValueError(
+                    "pass input_shape= (an InputLayer declares no concrete "
+                    "batch_input_shape)")
+            shapes = [(1,) + tuple(s[1:]) for s in declared_list]
+            shape = shapes[0] if len(shapes) == 1 else shapes
+        else:
+            first = model.children[next(iter(model.children))]
+            declared = getattr(first, "keras_input_shape", None)
+            if declared is None or any(d is None for d in declared):
+                raise ValueError(
+                    "pass input_shape= (the model JSON declares no concrete "
+                    "batch_input_shape — variable dims need an explicit "
+                    "shape)")
+            shape = (1,) + tuple(declared)
+    multi = (isinstance(shape, (list, tuple)) and shape
+             and isinstance(shape[0], (list, tuple)))
+    build_shape = Table(*[tuple(s) for s in shape]) if multi \
+        else tuple(shape)
+    params, state, _ = model.build(jax.random.PRNGKey(seed), build_shape)
     if h5_path is not None:
         params, state = load_keras_hdf5_weights(model, params, state, h5_path)
     return model, params, state

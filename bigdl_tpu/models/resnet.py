@@ -69,22 +69,37 @@ def basic_block(cin: int, cout: int, stride: int = 1) -> nn.Module:
 
 
 def bottleneck(cin: int, planes: int, stride: int = 1,
-               expansion: int = 4) -> nn.Module:
+               expansion: int = 4, fuse_bn: bool = False) -> nn.Module:
     """reference: models/resnet/ResNet.scala bottleneck; stride on the 3x3
-    (v1.5) like TrainImageNet's mkldnn graph."""
+    (v1.5) like TrainImageNet's mkldnn graph.
+
+    fuse_bn=True replaces every 1x1 conv+BN pair (the reduce, the 4C
+    expand, and the downsample shortcut) with `nn.SpatialConvolutionBN` —
+    the pallas conv-epilogue-stats kernel that removes the BN stats-reduce
+    HBM pass (BENCH_APPENDIX.md's named lever; reference fusion role:
+    nn/mkldnn/Fusion.scala:26-31)."""
     cout = planes * expansion
     inp = nn.Input()
-    h = _conv(cin, planes, 1)(inp)
-    h = _bn(planes)(h)
+    if fuse_bn:
+        h = nn.SpatialConvolutionBN(cin, planes)(inp)
+    else:
+        h = _conv(cin, planes, 1)(inp)
+        h = _bn(planes)(h)
     h = nn.ReLU()(h)
     h = _conv(planes, planes, 3, stride, 1)(h)
     h = _bn(planes)(h)
     h = nn.ReLU()(h)
-    h = _conv(planes, cout, 1)(h)
-    h = _bn(cout, zero_init=True)(h)
+    if fuse_bn:
+        h = nn.SpatialConvolutionBN(planes, cout, zero_gamma=True)(h)
+    else:
+        h = _conv(planes, cout, 1)(h)
+        h = _bn(cout, zero_init=True)(h)
     if stride != 1 or cin != cout:
-        sc = _conv(cin, cout, 1, stride, 0)(inp)
-        sc = _bn(cout)(sc)
+        if fuse_bn:
+            sc = nn.SpatialConvolutionBN(cin, cout, stride=stride)(inp)
+        else:
+            sc = _conv(cin, cout, 1, stride, 0)(inp)
+            sc = _bn(cout)(sc)
     else:
         sc = inp
     out = nn.CAddTable()(h, sc)
@@ -93,7 +108,8 @@ def bottleneck(cin: int, planes: int, stride: int = 1,
 
 
 def ResNet(depth: int = 50, class_num: int = 1000,
-           dataset: str = "imagenet", remat: bool = False) -> nn.Sequential:
+           dataset: str = "imagenet", remat: bool = False,
+           fuse_bn: bool = False) -> nn.Sequential:
     """reference: models/resnet/ResNet.scala apply().
 
     remat=True wraps every residual block in nn.Remat (activations
@@ -110,6 +126,10 @@ def ResNet(depth: int = 50, class_num: int = 1000,
         if depth not in cfgs:
             raise ValueError(f"unsupported imagenet resnet depth {depth}")
         blocks, block_fn, expansion = cfgs[depth]
+        if fuse_bn and block_fn is not bottleneck:
+            raise ValueError(
+                "fuse_bn=True is only implemented for bottleneck ResNets "
+                "(depth 50/101/152) — basic_block has no 1x1 conv+BN pairs")
         layers: List[nn.Module] = [
             _conv(3, 64, 7, 2, 3),
             _bn(64),
@@ -121,7 +141,9 @@ def ResNet(depth: int = 50, class_num: int = 1000,
             planes = 64 * (2 ** stage)
             for b in range(n_blocks):
                 stride = 2 if (stage > 0 and b == 0) else 1
-                block = block_fn(cin, planes, stride)
+                block = block_fn(cin, planes, stride, fuse_bn=fuse_bn) \
+                    if block_fn is bottleneck else block_fn(cin, planes,
+                                                            stride)
                 layers.append(nn.Remat(block) if remat else block)
                 cin = planes * expansion
         layers += [
@@ -131,12 +153,16 @@ def ResNet(depth: int = 50, class_num: int = 1000,
         ]
         return nn.Sequential(*layers)
     elif dataset == "cifar10":
+        if fuse_bn:
+            raise ValueError("fuse_bn=True is only implemented for "
+                             "bottleneck ResNets (imagenet depth 50/101/152)")
         return resnet_cifar(depth, class_num)
     raise ValueError(f"unknown dataset {dataset}")
 
 
-def resnet50(class_num: int = 1000, remat: bool = False) -> nn.Sequential:
-    return ResNet(50, class_num, remat=remat)
+def resnet50(class_num: int = 1000, remat: bool = False,
+             fuse_bn: bool = False) -> nn.Sequential:
+    return ResNet(50, class_num, remat=remat, fuse_bn=fuse_bn)
 
 
 def resnet_cifar(depth: int = 20, class_num: int = 10) -> nn.Sequential:

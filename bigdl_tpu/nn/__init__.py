@@ -26,6 +26,7 @@ from bigdl_tpu.nn.conv import (
     SpatialFullConvolution,
     TemporalConvolution,
     SpatialShareConvolution,
+    SpatialConvolutionBN,
     SpatialConvolutionMap,
     LocallyConnected1D,
     LocallyConnected2D,

@@ -286,6 +286,102 @@ def random_connection_table(n_in: int, n_out: int, n_into: int, seed=None):
     return pairs
 
 
+class SpatialConvolutionBN(Module):
+    """FUSED 1x1 conv + SpatialBatchNormalization for training.
+
+    Reference role: conv+BN fusion is the reference's marquee MKL-DNN
+    optimization (`nn/mkldnn/Fusion.scala:26-31`); on its training side
+    MKL-DNN's batchnorm primitive computes the stats inline.  Here the
+    BN moments come out of the conv's pallas epilogue
+    (`ops/conv_bn_stats.py`) while the output tile is still in VMEM —
+    deleting the HBM stats-reduce read that makes the ResNet train step
+    bandwidth-bound (BENCH_APPENDIX.md).
+
+    Semantics match `Sequential(SpatialConvolution(cin, cout, 1, 1,
+    stride, stride, with_bias=False), SpatialBatchNormalization(cout))`
+    exactly — same param shapes (`weight` HWIO (1,1,cin,cout), BN
+    `gamma`/`beta`), same biased/unbiased variance handling, same
+    running-stat update; `axis_name` gives the same cross-replica
+    sync-BN.  Eval mode folds the BN affine into one scale/shift after
+    the conv (no stats pass at all)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 stride: int = 1, eps: float = 1e-5, momentum: float = 0.1,
+                 zero_gamma: bool = False, weight_init=None,
+                 axis_name: Optional[str] = None,
+                 w_regularizer=None, interpret: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_input = n_input_plane
+        self.n_output = n_output_plane
+        self.stride = stride
+        self.eps = eps
+        self.momentum = momentum
+        self.zero_gamma = zero_gamma
+        self.weight_init = weight_init or init_mod.MsraFiller(False)
+        self.axis_name = axis_name
+        self.w_regularizer = w_regularizer
+        self.interpret = interpret
+
+    def set_axis_name(self, axis_name: Optional[str]) -> "SpatialConvolutionBN":
+        self.axis_name = axis_name
+        return self
+
+    def build(self, rng, input_shape):
+        c_in, c_out = self.n_input, self.n_output
+        params = {
+            "weight": self.weight_init(rng, (1, 1, c_in, c_out),
+                                       c_in, c_out),
+            "gamma": (jnp.zeros if self.zero_gamma else jnp.ones)(
+                (c_out,), jnp.float32),
+            "beta": jnp.zeros((c_out,), jnp.float32),
+        }
+        state = {"running_mean": jnp.zeros((c_out,), jnp.float32),
+                 "running_var": jnp.ones((c_out,), jnp.float32)}
+        return params, state, self.output_shape(input_shape)
+
+    def output_shape(self, input_shape):
+        n, h, w, _ = input_shape
+        s = self.stride
+        return (n, -(-h // s), -(-w // s), self.n_output)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        from bigdl_tpu.ops.conv_bn_stats import conv1x1_bn_stats
+
+        w = params["weight"]
+        gamma, beta = params["gamma"], params["beta"]
+        if training:
+            y, s1, s2 = conv1x1_bn_stats(x, w, stride=self.stride,
+                                         interpret=self.interpret)
+            m = y.shape[0] * y.shape[1] * y.shape[2]
+            mean = s1 / m
+            mean2 = s2 / m
+            n_count = m
+            if self.axis_name is not None:
+                mean = lax.pmean(mean, self.axis_name)
+                mean2 = lax.pmean(mean2, self.axis_name)
+                n_count = m * lax.psum(1, self.axis_name)
+            var = mean2 - jnp.square(mean)
+            unbiased = var * (n_count / jnp.maximum(n_count - 1, 1))
+            mm = self.momentum
+            new_state = {
+                "running_mean": (1 - mm) * state["running_mean"] + mm * mean,
+                "running_var": (1 - mm) * state["running_var"]
+                + mm * unbiased,
+            }
+        else:
+            if self.stride > 1:
+                x = x[:, ::self.stride, ::self.stride, :]
+            y = lax.conv_general_dilated(
+                x, w, window_strides=(1, 1), padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            mean, var = state["running_mean"], state["running_var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.eps)
+        out = (y - mean) * inv * gamma + beta
+        return out.astype(x.dtype), new_state
+
+
 class SpatialConvolutionMap(Module):
     """Convolution with a generic input->output connection table — the
     generalisation of SpatialConvolution (full table) and depthwise conv

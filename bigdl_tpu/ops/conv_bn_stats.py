@@ -1,0 +1,197 @@
+"""Pallas TPU fused matmul + BN-statistics epilogue, with custom VJP.
+
+The perf lever named by BENCH_APPENDIX.md: training-mode BatchNorm forces
+every conv output to materialize in HBM so the stats reduce (Σy, Σy²) can
+run before the normalize pass — one extra full read of the conv output
+per conv+BN pair.  This kernel computes the per-channel sums IN THE CONV
+EPILOGUE while the output tile is still in VMEM, deleting that read.
+
+Scope: 1x1 convolutions, which ARE matmuls ((N·H·W, Cin) × (Cin, Cout))
+and carry most of ResNet's conv-output bytes (2 of 3 convs per bottleneck
+— including the widest 4C expand).  3x3 convs keep the XLA path.
+
+Reference role: conv+BN fusion is the reference's marquee MKL-DNN
+optimization (`nn/mkldnn/Fusion.scala:26-31`); its training-side stats
+fusion happens inside MKL-DNN's batchnorm primitive.  This is the
+TPU-native equivalent: matmul on the MXU, stats on the VPU, one HBM pass.
+
+Design (per /opt/skills/guides/pallas_guide.md):
+  * grid = (N/bn, M/bm, K/bk): k innermost (sequential on TPU) so the f32
+    accumulator lives in VMEM scratch across k steps; m next, so the
+    (1, bn) stats tiles stay resident while every m block accumulates
+    into them; n outermost.
+  * matmul on the MXU with preferred_element_type=float32; the epilogue
+    (at the last k step) writes the y tile once and adds its column sums
+    into the stats tiles — y is never re-read.
+  * stats are exact f32 sums; mean = Σy/M, biased var = Σy²/M − mean²,
+    matching `nn.BatchNormalization` training semantics bit-for-bit in
+    f32 (bf16 y introduces the same rounding the unfused path has).
+
+Backward (custom VJP): d/dy_total = ȳ + s̄1 + 2·y·s̄2 (s1 = Σy, s2 = Σy²),
+then the standard matmul cotangents x̄ = ȳ_tot·Wᵀ, W̄ = xᵀ·ȳ_tot — exact,
+so gradient parity with the unfused conv+BN is a test invariant, not an
+approximation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+# v5e VMEM governor: bm*bk + bk*bn inputs + bm*bn f32 acc well under 16M
+DEFAULT_BLOCK_M = 512
+DEFAULT_BLOCK_N = 256
+DEFAULT_BLOCK_K = 256
+
+
+def _kernel(x_ref, w_ref, y_ref, s1_ref, s2_ref, acc_ref):
+    mi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(x_ref[:], w_ref[:],
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _epilogue():
+        y = acc_ref[:]
+        y_ref[:] = y.astype(y_ref.dtype)
+        p1 = jnp.sum(y, axis=0, keepdims=True)
+        p2 = jnp.sum(y * y, axis=0, keepdims=True)
+
+        @pl.when(mi == 0)
+        def _first():
+            s1_ref[:] = p1
+            s2_ref[:] = p2
+
+        @pl.when(mi > 0)
+        def _accum():
+            s1_ref[:] += p1
+            s2_ref[:] += p2
+
+
+def _pad_to(a, axis, mult):
+    size = a.shape[axis]
+    rem = size % mult
+    if rem == 0:
+        return a
+    pads = [(0, 0)] * a.ndim
+    pads[axis] = (0, mult - rem)
+    return jnp.pad(a, pads)
+
+
+def _matmul_stats_call(x, w, block_m, block_n, block_k, interpret):
+    m, k = x.shape
+    _, n = w.shape
+    xp = _pad_to(_pad_to(x, 0, block_m), 1, block_k)
+    wp = _pad_to(_pad_to(w, 0, block_k), 1, block_n)
+    mp, kp = xp.shape
+    np_ = wp.shape[1]
+    grid = (np_ // block_n, mp // block_m, kp // block_k)
+    scratch = [pltpu.VMEM((block_m, block_n), jnp.float32)]
+    y, s1, s2 = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda ni, mi, ki: (mi, ki)),
+            pl.BlockSpec((block_k, block_n), lambda ni, mi, ki: (ki, ni)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_m, block_n), lambda ni, mi, ki: (mi, ni)),
+            pl.BlockSpec((1, block_n), lambda ni, mi, ki: (0, ni)),
+            pl.BlockSpec((1, block_n), lambda ni, mi, ki: (0, ni)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, np_), x.dtype),
+            jax.ShapeDtypeStruct((1, np_), jnp.float32),
+            jax.ShapeDtypeStruct((1, np_), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(xp, wp)
+    # padded rows/cols are zero: they add nothing to the sums
+    return y[:m, :n], s1[0, :n], s2[0, :n]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _matmul_stats(x, w, block_m, block_n, block_k, interpret):
+    return _matmul_stats_call(x, w, block_m, block_n, block_k, interpret)
+
+
+def _matmul_stats_fwd(x, w, block_m, block_n, block_k, interpret):
+    y, s1, s2 = _matmul_stats_call(x, w, block_m, block_n, block_k,
+                                   interpret)
+    return (y, s1, s2), (x, w, y)
+
+
+def _matmul_stats_bwd(block_m, block_n, block_k, interpret, res, cot):
+    x, w, y = res
+    y_bar, s1_bar, s2_bar = cot
+    # stats cotangents fold into the y cotangent: s1 = Σ_m y, s2 = Σ_m y²
+    g = (y_bar.astype(jnp.float32)
+         + s1_bar[None, :]
+         + 2.0 * y.astype(jnp.float32) * s2_bar[None, :])
+    x_bar = jnp.dot(g, w.astype(jnp.float32).T,
+                    preferred_element_type=jnp.float32).astype(x.dtype)
+    w_bar = jnp.dot(x.astype(jnp.float32).T, g,
+                    preferred_element_type=jnp.float32).astype(w.dtype)
+    return x_bar, w_bar
+
+
+_matmul_stats.defvjp(_matmul_stats_fwd, _matmul_stats_bwd)
+
+
+def _dense_matmul_stats(x, w):
+    """XLA fallback with identical semantics (used off-TPU and for odd
+    shapes); jax.grad of this matches the custom VJP above exactly."""
+    y = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    yf = y.astype(jnp.float32)
+    return y.astype(x.dtype), jnp.sum(yf, 0), jnp.sum(yf * yf, 0)
+
+
+def matmul_bn_stats(x, w, *, block_m: int = DEFAULT_BLOCK_M,
+                    block_n: int = DEFAULT_BLOCK_N,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool = False
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(M, K) × (K, N) -> (y, Σ_M y, Σ_M y²) in one HBM pass over y."""
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    if (not _HAS_PLTPU) or not (on_tpu or interpret):
+        return _dense_matmul_stats(x, w)
+    return _matmul_stats(x, w, block_m, block_n, block_k, interpret)
+
+
+def conv1x1_bn_stats(x, w, *, stride: int = 1, interpret: bool = False
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """1x1 conv (NHWC × HWIO) returning (y, Σy, Σy²) over (N, H, W).
+
+    `stride` subsamples the input first (exactly a strided 1x1 conv).
+    The sums divide by M = N·H_out·W_out to give BN's biased moments.
+    """
+    if w.shape[0] != 1 or w.shape[1] != 1:
+        raise ValueError(f"conv1x1_bn_stats needs a 1x1 kernel, got "
+                         f"{w.shape[:2]}")
+    if stride > 1:
+        x = x[:, ::stride, ::stride, :]
+    n, h, ww, cin = x.shape
+    cout = w.shape[3]
+    y2d, s1, s2 = matmul_bn_stats(x.reshape(n * h * ww, cin),
+                                  w.reshape(cin, cout),
+                                  interpret=interpret)
+    return y2d.reshape(n, h, ww, cout), s1, s2

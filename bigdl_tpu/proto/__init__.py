@@ -1,0 +1,10 @@
+"""Generated-protobuf home.  protoc emits absolute `import x_pb2` lines,
+so importing this package puts the directory on sys.path once — every
+consumer does `import bigdl_tpu.proto` then `import <schema>_pb2`."""
+
+import os
+import sys
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+if _DIR not in sys.path:
+    sys.path.insert(0, _DIR)

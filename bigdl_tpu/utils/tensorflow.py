@@ -160,7 +160,16 @@ class _TFImporter:
         if not self.graph_nodes:
             raise _UnresolvedInput(name)  # needs any node to anchor on
         np_dtype = _NP_DTYPES.get(nd.attr["dtype"].type, np.float32)
-        if self.var_values is not None and name in self.var_values:
+        if self.var_values is not None:
+            if name not in self.var_values:
+                # NEVER fall back silently: an explicit checkpoint that
+                # misses a variable means untrained weights would load
+                some = ", ".join(sorted(self.var_values)[:5])
+                raise ValueError(
+                    f"variable {name!r} not found in the checkpoint "
+                    f"(available keys include: {some}).  TF2 object-based "
+                    f"checkpoints key by object path, not node name — "
+                    f"re-save with tf.compat.v1.train.Saver")
             value = np.asarray(self.var_values[name], np_dtype)
         else:
             value = self._initializer_value(name)
@@ -172,7 +181,10 @@ class _TFImporter:
                 f"checkpoint prefix) to load_tensorflow, or keep the "
                 f"variable's initializer Assign const-foldable")
         shape = tuple(d.size for d in nd.attr["shape"].shape.dim)
-        if shape and tuple(value.shape) != shape:
+        if shape and (len(value.shape) != len(shape)
+                      or any(d > 0 and d != v
+                             for d, v in zip(shape, value.shape))):
+            # unknown dims (-1/0) are wildcards
             raise ValueError(
                 f"variable {name!r}: checkpoint/initializer shape "
                 f"{value.shape} != declared {shape}")
@@ -192,6 +204,13 @@ class _TFImporter:
             arr = tensor_to_ndarray(nd.attr["value"].tensor)
             self.consts[name] = arr
             return arr
+        if nd.op in _VAR_OPS:
+            # a consumer folding a variable read (GraphDef order is not
+            # topological): defer — on a later sweep the read aliases the
+            # live Variable node and the consumer takes its dynamic path.
+            # A converter that can ONLY take consts keeps deferring and
+            # surfaces as a missing node at the output lookup.
+            raise _UnresolvedInput(name)
         if nd.op in ("Identity", "Enter"):  # frozen vars / loop invariants
             return self.const_of(nd.input[0])
         if nd.op == "Fill":  # constant-operand Fill folds
@@ -345,10 +364,16 @@ class _TFImporter:
             if self._key(data_inputs[0]) in self.graph_nodes:
                 self._alias(name, data_inputs[0])
                 return
-            prod = self.nodes_by_name.get(_clean(data_inputs[0]))
+            # walk the WHOLE identity chain: a read of a not-yet-converted
+            # Variable must defer so the alias lands (const_of would
+            # wrongly claim it frozen)
+            ref, prod = data_inputs[0], None
+            while True:
+                prod = self.nodes_by_name.get(_clean(ref))
+                if prod is None or prod.op != "Identity":
+                    break
+                ref = prod.input[0]
             if prod is not None and prod.op in _VAR_OPS:
-                # variable read before the Variable converted: defer so the
-                # alias lands (const_of would wrongly claim it frozen)
                 raise _UnresolvedInput(data_inputs[0])
             # else: frozen-variable Identity(Const), resolved via const_of
             return
@@ -461,8 +486,11 @@ class _TFImporter:
                 # unfrozen scale/offset/stats (graph Variables)
                 from bigdl_tpu.nn import tf_ops as _tf
 
-                eps = nd.attr["epsilon"].f or 1e-3
-                is_training = bool(nd.attr["is_training"].b)
+                # op-def defaults (strip_default_attrs removes them):
+                # epsilon=1e-4, is_training=TRUE
+                eps = nd.attr["epsilon"].f or 1e-4
+                is_training = bool(nd.attr["is_training"].b) \
+                    if "is_training" in nd.attr else True
                 for di in data_inputs[1:5]:
                     if self._key(di) not in self.graph_nodes:
                         self._ensure_node(di, anchor=graph_in[0])

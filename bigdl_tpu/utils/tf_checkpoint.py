@@ -24,31 +24,39 @@ Format notes (tensorflow/core/lib/table, a leveldb fork):
 from __future__ import annotations
 
 import os
-import sys
-from typing import Dict, Iterator, Tuple
+from typing import Any, Dict, Iterator, Tuple
 
 import numpy as np
 
-_PROTO_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "proto")
-if _PROTO_DIR not in sys.path:
-    sys.path.insert(0, _PROTO_DIR)
-
+import bigdl_tpu.proto  # noqa: F401  (puts the generated pb2 dir on sys.path)
 import tensor_bundle_pb2 as tbp  # noqa: E402  (generated; proto/)
 import tf_graph_pb2 as tfp  # noqa: E402
 
 _TABLE_MAGIC = 0xDB4775248B80FB57
 
-_BUNDLE_DTYPES = {
-    tfp.DT_FLOAT: np.float32,
-    tfp.DT_DOUBLE: np.float64,
-    tfp.DT_INT32: np.int32,
-    tfp.DT_INT64: np.int64,
-    tfp.DT_BOOL: np.bool_,
-    tfp.DT_UINT8: np.uint8,
-    tfp.DT_INT8: np.int8,
-    tfp.DT_INT16: np.int16,
-}
+def _bundle_dtypes():
+    d = {
+        tfp.DT_FLOAT: np.float32,
+        tfp.DT_DOUBLE: np.float64,
+        tfp.DT_INT32: np.int32,
+        tfp.DT_INT64: np.int64,
+        tfp.DT_BOOL: np.bool_,
+        tfp.DT_UINT8: np.uint8,
+        tfp.DT_INT8: np.int8,
+        tfp.DT_INT16: np.int16,
+        19: np.float16,  # DT_HALF (proto3 open enum: raw value survives)
+    }
+    try:
+        import ml_dtypes
+
+        d[14] = ml_dtypes.bfloat16  # DT_BFLOAT16
+    except ImportError:  # pragma: no cover
+        pass
+    return d
+
+
+_BUNDLE_DTYPES = _bundle_dtypes()
+_DT_STRING = 7
 
 
 def _varint(buf: bytes, pos: int) -> Tuple[int, int]:
@@ -169,30 +177,35 @@ def read_checkpoint(prefix: str) -> Dict[str, np.ndarray]:
             entries[key.decode()] = e
     if header is None:
         raise ValueError(f"{index_path}: missing bundle header entry")
-    shards: Dict[int, bytes] = {}
-
-    def shard(i: int) -> bytes:
-        if i not in shards:
-            path = f"{prefix}.data-{i:05d}-of-{header.num_shards:05d}"
-            with open(path, "rb") as f:
-                shards[i] = f.read()
-        return shards[i]
-
+    shards: Dict[int, Any] = {}
     out: Dict[str, np.ndarray] = {}
-    for name, e in entries.items():
-        if e.slices:
-            raise ValueError(
-                f"checkpoint tensor {name!r} is a partitioned-variable "
-                f"slice — unsupported")
-        np_dtype = _BUNDLE_DTYPES.get(e.dtype)
-        if np_dtype is None:
-            continue  # e.g. DT_STRING bookkeeping tensors
-        shape = tuple(d.size for d in e.shape.dim)
-        raw = shard(e.shard_id)[e.offset:e.offset + e.size]
-        arr = np.frombuffer(raw, np_dtype)
-        if arr.size != int(np.prod(shape)):
-            raise ValueError(
-                f"checkpoint tensor {name!r}: {arr.size} values for shape "
-                f"{shape}")
-        out[name] = arr.reshape(shape).copy()
+    try:
+        for name, e in entries.items():
+            if e.slices:
+                raise ValueError(
+                    f"checkpoint tensor {name!r} is a partitioned-variable "
+                    f"slice — unsupported")
+            if e.dtype == _DT_STRING:
+                continue  # bookkeeping (e.g. object-graph blobs)
+            np_dtype = _BUNDLE_DTYPES.get(e.dtype)
+            if np_dtype is None:
+                raise ValueError(
+                    f"checkpoint tensor {name!r} has unsupported dtype "
+                    f"enum {e.dtype}")
+            shape = tuple(d.size for d in e.shape.dim)
+            if e.shard_id not in shards:  # seek per entry, never slurp
+                shards[e.shard_id] = open(
+                    f"{prefix}.data-{e.shard_id:05d}"
+                    f"-of-{header.num_shards:05d}", "rb")
+            f = shards[e.shard_id]
+            f.seek(e.offset)
+            arr = np.frombuffer(f.read(e.size), np_dtype)
+            if arr.size != int(np.prod(shape)):
+                raise ValueError(
+                    f"checkpoint tensor {name!r}: {arr.size} values for "
+                    f"shape {shape}")
+            out[name] = arr.reshape(shape).copy()
+    finally:
+        for f in shards.values():
+            f.close()
     return out

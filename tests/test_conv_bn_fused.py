@@ -1,0 +1,192 @@
+"""Fused 1x1 conv + BN-stats training kernel: numerical parity with the
+unfused Sequential(SpatialConvolution, SpatialBatchNormalization) pair —
+forward, running-state update, gradients, eval mode — plus the pallas
+kernel itself (interpret mode) and the resnet50(fuse_bn=True) wiring.
+
+Reference role: nn/mkldnn/Fusion.scala:26-31 (conv+bn is the reference's
+marquee fusion; the training-side stats fusion here is the TPU-native
+equivalent, BENCH_APPENDIX.md's named lever)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.ops.conv_bn_stats import (_dense_matmul_stats,
+                                         conv1x1_bn_stats, matmul_bn_stats)
+
+N, H, W, CIN, COUT = 4, 8, 8, 16, 32
+
+
+def _pair_model(stride=1, zero_gamma=False):
+    conv = nn.SpatialConvolution(CIN, COUT, 1, 1, stride, stride, 0, 0,
+                                 with_bias=False)
+    bn = nn.SpatialBatchNormalization(COUT)
+    return nn.Sequential(conv, bn)
+
+
+def _sync_params(fused_params, pair, pair_params):
+    pair_params = jax.tree_util.tree_map(lambda v: v, pair_params)
+    names = list(pair.children)
+    pair_params[names[0]]["weight"] = fused_params["weight"]
+    pair_params[names[1]]["weight"] = fused_params["gamma"]
+    pair_params[names[1]]["bias"] = fused_params["beta"]
+    return pair_params
+
+
+class TestKernel:
+    def test_pallas_matches_dense(self):
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(200, 48).astype(np.float32))
+        w = jnp.asarray(rs.randn(48, 96).astype(np.float32))
+        y1, a1, b1 = matmul_bn_stats(x, w, block_m=128, block_n=64,
+                                     block_k=32, interpret=True)
+        y0, a0, b0 = _dense_matmul_stats(x, w)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(a1), np.asarray(a0),
+                                   rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(b1), np.asarray(b0),
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_custom_vjp_matches_autodiff(self):
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.randn(96, 24).astype(np.float32))
+        w = jnp.asarray(rs.randn(24, 40).astype(np.float32))
+
+        def loss(fn):
+            def f(x, w):
+                y, s1, s2 = fn(x, w)
+                return (jnp.sum(jnp.tanh(y)) + jnp.sum(s1) * 0.1
+                        + jnp.sum(jnp.sqrt(s2 + 1.0)))
+
+            return f
+
+        pallas_fn = lambda x, w: matmul_bn_stats(  # noqa: E731
+            x, w, block_m=32, block_n=32, block_k=8, interpret=True)
+        g1 = jax.grad(loss(pallas_fn), argnums=(0, 1))(x, w)
+        g0 = jax.grad(loss(_dense_matmul_stats), argnums=(0, 1))(x, w)
+        for a, b in zip(g1, g0):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_strided_conv_path(self):
+        rs = np.random.RandomState(2)
+        x = jnp.asarray(rs.randn(2, 8, 8, 6).astype(np.float32))
+        w = jnp.asarray(rs.randn(1, 1, 6, 10).astype(np.float32))
+        y, s1, s2 = conv1x1_bn_stats(x, w, stride=2)
+        assert y.shape == (2, 4, 4, 10)
+        yf = np.asarray(y)
+        np.testing.assert_allclose(np.asarray(s1), yf.sum((0, 1, 2)),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(s2), (yf * yf).sum((0, 1, 2)),
+                                   rtol=1e-5)
+
+
+class TestFusedModuleParity:
+    def _build_both(self, stride=1, zero_gamma=False, seed=0):
+        fused = nn.SpatialConvolutionBN(CIN, COUT, stride=stride,
+                                        zero_gamma=zero_gamma)
+        pair = _pair_model(stride, zero_gamma)
+        key = jax.random.PRNGKey(seed)
+        fp, fs, _ = fused.build(key, (N, H, W, CIN))
+        pp, ps, _ = pair.build(key, (N, H, W, CIN))
+        pp = _sync_params(fp, pair, pp)
+        return fused, fp, fs, pair, pp, ps
+
+    def test_training_forward_and_state(self):
+        fused, fp, fs, pair, pp, ps = self._build_both()
+        rs = np.random.RandomState(3)
+        x = jnp.asarray(rs.randn(N, H, W, CIN).astype(np.float32))
+        yf, sf = fused.apply(fp, fs, x, training=True)
+        yp, sp = pair.apply(pp, ps, x, training=True)
+        np.testing.assert_allclose(np.asarray(yf), np.asarray(yp),
+                                   rtol=1e-4, atol=1e-5)
+        bn_name = list(pair.children)[1]
+        for k in ("running_mean", "running_var"):
+            np.testing.assert_allclose(np.asarray(sf[k]),
+                                       np.asarray(sp[bn_name][k]),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_training_forward_strided(self):
+        fused, fp, fs, pair, pp, ps = self._build_both(stride=2)
+        rs = np.random.RandomState(4)
+        x = jnp.asarray(rs.randn(N, H, W, CIN).astype(np.float32))
+        yf, _ = fused.apply(fp, fs, x, training=True)
+        yp, _ = pair.apply(pp, ps, x, training=True)
+        assert yf.shape == yp.shape == (N, H // 2, W // 2, COUT)
+        np.testing.assert_allclose(np.asarray(yf), np.asarray(yp),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gradient_parity(self):
+        fused, fp, fs, pair, pp, ps = self._build_both()
+        rs = np.random.RandomState(5)
+        x = jnp.asarray(rs.randn(N, H, W, CIN).astype(np.float32))
+        t = jnp.asarray(rs.randn(N, H, W, COUT).astype(np.float32))
+
+        def loss_fused(p):
+            y, _ = fused.apply(p, fs, x, training=True)
+            return jnp.mean((y - t) ** 2)
+
+        def loss_pair(p):
+            y, _ = pair.apply(p, ps, x, training=True)
+            return jnp.mean((y - t) ** 2)
+
+        gf = jax.grad(loss_fused)(fp)
+        gp = jax.grad(loss_pair)(pp)
+        names = list(pair.children)
+        np.testing.assert_allclose(np.asarray(gf["weight"]),
+                                   np.asarray(gp[names[0]]["weight"]),
+                                   rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gf["gamma"]),
+                                   np.asarray(gp[names[1]]["weight"]),
+                                   rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gf["beta"]),
+                                   np.asarray(gp[names[1]]["bias"]),
+                                   rtol=1e-3, atol=1e-5)
+
+    def test_eval_mode_uses_running_stats(self):
+        fused, fp, fs, pair, pp, ps = self._build_both()
+        rs = np.random.RandomState(6)
+        # make running stats non-trivial first
+        x = jnp.asarray(rs.randn(N, H, W, CIN).astype(np.float32))
+        _, fs = fused.apply(fp, fs, x, training=True)
+        _, ps = pair.apply(pp, ps, x, training=True)
+        xe = jnp.asarray(rs.randn(N, H, W, CIN).astype(np.float32))
+        ye_f, fs2 = fused.apply(fp, fs, xe, training=False)
+        ye_p, _ = pair.apply(pp, ps, xe, training=False)
+        np.testing.assert_allclose(np.asarray(ye_f), np.asarray(ye_p),
+                                   rtol=1e-4, atol=1e-5)
+        assert fs2 is fs  # eval does not touch state
+
+
+class TestResNetFuseBn:
+    def test_resnet50_fuse_bn_trains_a_step(self):
+        from bigdl_tpu.models import resnet50
+
+        model = resnet50(class_num=16, fuse_bn=True)
+
+        def walk(m):
+            yield m
+            for c in getattr(m, "children", {}).values():
+                yield from walk(c)
+
+        fused = [m for m in walk(model)
+                 if isinstance(m, nn.SpatialConvolutionBN)]
+        assert len(fused) == 36, len(fused)  # 2/bottleneck + 4 shortcuts
+        params, state, _ = model.build(jax.random.PRNGKey(0), (2, 32, 32, 3))
+        x = jnp.asarray(np.random.RandomState(0)
+                        .randn(2, 32, 32, 3).astype(np.float32))
+        yt = jnp.asarray(np.arange(2) % 16)
+        crit = nn.ClassNLLCriterion()
+
+        def loss(p):
+            out, new_state = model.apply(p, state, x, training=True)
+            return crit.forward(out, yt), new_state
+
+        (lv, new_state), grads = jax.value_and_grad(loss, has_aux=True)(params)
+        assert np.isfinite(float(lv))
+        gmax = max(float(jnp.max(jnp.abs(g)))
+                   for g in jax.tree_util.tree_leaves(grads))
+        assert np.isfinite(gmax) and gmax > 0

@@ -1,0 +1,122 @@
+"""keras-1 backend shim: with_bigdl_backend(kmodel) wraps a LIVE
+(duck-typed) keras-1 model — definition via to_json(), weights via
+layers[].get_weights(), compiled optimizer/loss via attribute conversion
+— and fit/evaluate/predict run on this framework's engine.
+
+Reference: pyspark/bigdl/keras/backend.py (KerasModelWrapper,
+with_bigdl_backend), optimization.py (OptimConverter).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.keras.backend import (KerasModelWrapper,
+                                     to_bigdl_optim_method,
+                                     with_bigdl_backend)
+
+IN, HID, OUT = 4, 8, 3
+
+
+class _FakeLayer:
+    def __init__(self, name, ws):
+        self.name = name
+        self._ws = ws
+
+    def get_weights(self):
+        return list(self._ws)
+
+
+class _FakeOpt:
+    pass
+
+
+class _FakeSGD(_FakeOpt):
+    lr, momentum, decay, nesterov = 0.05, 0.9, 0.0, False
+
+
+class _FakeAdam(_FakeOpt):
+    lr, beta_1, beta_2, epsilon, decay = 0.002, 0.9, 0.999, 1e-8, 0.0
+
+
+_FakeSGD.__name__ = "SGD"
+_FakeAdam.__name__ = "Adam"
+
+
+class _FakeKerasModel:
+    """The attribute surface a compiled keras-1.2.2 Sequential exposes."""
+
+    def __init__(self, w1, b1, w2, b2, loss="mse", optimizer=None):
+        self.layers = [_FakeLayer("dense_1", [w1, b1]),
+                       _FakeLayer("act_1", []),
+                       _FakeLayer("dense_2", [w2, b2])]
+        self.loss = loss
+        self.optimizer = optimizer or _FakeSGD()
+        self.metrics = None
+
+    def to_json(self):
+        return json.dumps({
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "Dense",
+                 "config": {"output_dim": HID, "activation": "linear",
+                            "batch_input_shape": [None, IN],
+                            "name": "dense_1"}},
+                {"class_name": "Activation",
+                 "config": {"activation": "tanh", "name": "act_1"}},
+                {"class_name": "Dense",
+                 "config": {"output_dim": OUT, "activation": "linear",
+                            "name": "dense_2"}},
+            ]})
+
+
+def _fake_model(seed=0, **kw):
+    rs = np.random.RandomState(seed)
+    w1 = (rs.randn(IN, HID) * 0.5).astype(np.float32)
+    b1 = rs.randn(HID).astype(np.float32)
+    w2 = (rs.randn(HID, OUT) * 0.5).astype(np.float32)
+    b2 = rs.randn(OUT).astype(np.float32)
+    return _FakeKerasModel(w1, b1, w2, b2, **kw), (w1, b1, w2, b2)
+
+
+class TestOptimConverter:
+    def test_sgd_and_adam_map(self):
+        sgd = to_bigdl_optim_method(_FakeSGD())
+        assert type(sgd).__name__ == "SGD"
+        assert sgd.learning_rate == pytest.approx(0.05)
+        assert sgd.momentum == pytest.approx(0.9)
+        adam = to_bigdl_optim_method(_FakeAdam())
+        assert type(adam).__name__ == "Adam"
+        assert adam.learning_rate == pytest.approx(0.002)
+
+    def test_unknown_optimizer_raises(self):
+        class Exotic:
+            lr = 0.1
+
+        with pytest.raises(ValueError, match="unsupported keras optimizer"):
+            to_bigdl_optim_method(Exotic())
+
+
+class TestKerasModelWrapper:
+    def test_predict_matches_numpy_oracle(self):
+        kmodel, (w1, b1, w2, b2) = _fake_model()
+        wrapped = with_bigdl_backend(kmodel)
+        assert isinstance(wrapped, KerasModelWrapper)
+        rs = np.random.RandomState(1)
+        x = rs.randn(16, IN).astype(np.float32)
+        got = wrapped.predict(x, batch_size=8)
+        want = np.tanh(x @ w1 + b1) @ w2 + b2
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_fit_reduces_loss_and_evaluate_reports(self):
+        kmodel, _ = _fake_model()
+        wrapped = with_bigdl_backend(kmodel)
+        rs = np.random.RandomState(2)
+        x = rs.randn(64, IN).astype(np.float32)
+        wt = rs.randn(IN, OUT).astype(np.float32)
+        y = (x @ wt).astype(np.float32)
+        before = dict(wrapped.evaluate(x, y, batch_size=16))["Loss"]
+        wrapped.fit(x, y, batch_size=16, nb_epoch=30)
+        after = dict(wrapped.evaluate(x, y, batch_size=16))["Loss"]
+        assert after < before * 0.5, (before, after)

@@ -208,6 +208,8 @@ EXEMPLARS = {
         lambda: rand(2, 5, 5, 3)),
     "SpatialConvolution": (lambda: nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1),
                            lambda: rand(2, 5, 5, 3)),
+    "SpatialConvolutionBN": (lambda: nn.SpatialConvolutionBN(3, 4, stride=2),
+                             lambda: rand(2, 6, 6, 3)),
     "SpatialCrossMapLRN": (lambda: nn.SpatialCrossMapLRN(5, 1.0, 0.75),
                            lambda: rand(2, 4, 4, 6)),
     "SpatialDilatedConvolution": (
@@ -518,6 +520,9 @@ OPS_EXEMPLARS = {
     "ops.TruncateDiv": lambda: nn.ops.TruncateDiv(),
     "ops.TruncatedNormal": lambda: nn.ops.TruncatedNormal(0.0, 2.0, seed=1),
     "tf.Assert": lambda: nn.tf_ops.Assert("boom"),
+    "tf.DynamicConv2D": lambda: nn.tf_ops.DynamicConv2D((1, 1), "SAME"),
+    "tf.DynamicFusedBatchNorm": lambda: nn.tf_ops.DynamicFusedBatchNorm(
+        1e-3, False),
     "tf.Assign": lambda: nn.tf_ops.Assign(),
     "tf.BiasAdd": lambda: nn.tf_ops.BiasAdd(),
     "tf.BroadcastGradientArgs": lambda: nn.tf_ops.BroadcastGradientArgs(),

@@ -1,0 +1,135 @@
+"""Sparse (VarLen) ParseExample host path: TFRecord shards with
+variable-length feature lists -> SparseFeature records -> SparseMiniBatch
+-> SparseLinear / LookupTableSparse training.
+
+Reference: utils/tf/loaders/ParseExample.scala + nn/tf/ParsingOps.scala
+(VarLen features parse to COO SparseTensors feeding the wide-and-deep
+models); here parsing runs host-side and densifies per encoding at the
+batch boundary (static shapes for jit).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dataset import VarLenFeature
+from bigdl_tpu.dataset.sample import SparseFeature
+from bigdl_tpu.dataset.tfrecord import ParsedExampleDataSet, TFRecordWriter
+from bigdl_tpu.nn.tf_ops import build_example_proto
+from bigdl_tpu.optim import DistriOptimizer, SGD, Trigger
+
+VOCAB, CLASSES, MAXLEN, BATCH, N = 24, 3, 6, 8, 96
+
+
+def _write_varlen_records(tmp_path, n=N, seed=0):
+    """Each record: VarLen int64 "ids" (1..MAXLEN ids); label = the class
+    of its FIRST id (ids are drawn from per-class vocab ranges so both
+    the multi-hot and the embedding-bag model can recover the class)."""
+    rs = np.random.RandomState(seed)
+    path = str(tmp_path / "sparse.tfrecord")
+    all_ids, labels = [], []
+    per_class = VOCAB // CLASSES
+    with TFRecordWriter(path) as w:
+        for i in range(n):
+            c = i % CLASSES
+            k = rs.randint(1, MAXLEN + 1)
+            ids = rs.randint(c * per_class, (c + 1) * per_class,
+                             size=k).astype(np.int64)
+            w.write(build_example_proto(
+                {"ids": ids, "y": np.asarray([c], np.int64)}))
+            all_ids.append(ids)
+            labels.append(c)
+    return path, all_ids, np.asarray(labels)
+
+
+class TestVarLenParsing:
+    def test_multi_hot_batches(self, tmp_path):
+        path, all_ids, labels = _write_varlen_records(tmp_path)
+        ds = ParsedExampleDataSet(
+            [path], batch_size=BATCH, dense_keys=["y"], dense_shapes=[()],
+            label_key="y", sparse_features=[
+                VarLenFeature("ids", VOCAB, dtype="float32",
+                              encoding="multi_hot")])
+        batches = list(ds.data(train=False))
+        assert len(batches) == N // BATCH
+        b0 = batches[0]
+        x = np.asarray(b0.input)
+        assert x.shape == (BATCH, VOCAB)
+        for r in range(BATCH):
+            want = np.zeros(VOCAB, np.float32)
+            for i in all_ids[r]:
+                want[i] += 1.0
+            np.testing.assert_array_equal(x[r], want)
+        np.testing.assert_array_equal(
+            np.asarray(b0.target).ravel(), labels[:BATCH])
+
+    def test_positions_encoding_pads_id_bags(self, tmp_path):
+        path, all_ids, _ = _write_varlen_records(tmp_path)
+        ds = ParsedExampleDataSet(
+            [path], batch_size=BATCH, dense_keys=["y"], dense_shapes=[()],
+            label_key="y", feature_padding=-1, sparse_features=[
+                VarLenFeature("ids", MAXLEN, encoding="positions")])
+        x = np.asarray(next(iter(ds.data(train=False))).input)
+        assert x.shape == (BATCH, MAXLEN)
+        for r in range(BATCH):
+            k = len(all_ids[r])
+            np.testing.assert_array_equal(x[r, :k], all_ids[r])
+            assert np.all(x[r, k:] == -1)
+
+    def test_oversize_record_is_loud(self):
+        f = VarLenFeature("ids", 2, encoding="positions")
+        with pytest.raises(ValueError, match="declared size"):
+            f.to_sparse(np.arange(5))
+        m = VarLenFeature("ids", 4, encoding="multi_hot")
+        with pytest.raises(ValueError, match="out of range"):
+            m.to_sparse(np.asarray([7]))
+
+    def test_sparse_feature_pad_fill(self):
+        sf = SparseFeature(np.asarray([[0], [2]]), np.asarray([5, 9]), (4,))
+        np.testing.assert_array_equal(sf.to_dense(-1), [5, -1, 9, -1])
+
+
+class TestSparseTraining:
+    def test_sparse_linear_trains_from_shard(self, tmp_path):
+        """Wide model: multi-hot VarLen ids -> SparseLinear -> classes."""
+        path, _, labels = _write_varlen_records(tmp_path)
+        ds = ParsedExampleDataSet(
+            [path], batch_size=BATCH, dense_keys=["y"], dense_shapes=[()],
+            label_key="y", sparse_features=[
+                VarLenFeature("ids", VOCAB, dtype="float32",
+                              encoding="multi_hot")])
+        model = nn.Sequential(nn.SparseLinear(VOCAB, CLASSES),
+                              nn.LogSoftMax())
+        opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                              optim_method=SGD(learning_rate=0.5),
+                              end_trigger=Trigger.max_epoch(12))
+        opt.optimize()
+        xs = np.stack([np.asarray(b.input)
+                       for b in ds.data(train=False)]).reshape(-1, VOCAB)
+        out, _ = model.apply(opt.params, opt.model_state, jnp.asarray(xs))
+        acc = float((np.argmax(np.asarray(out), -1) == labels).mean())
+        assert acc >= 0.95, acc
+
+    def test_lookup_table_sparse_trains_from_shard(self, tmp_path):
+        """Deep model: padded id bags -> LookupTableSparse(mean) ->
+        Linear -> classes."""
+        path, _, labels = _write_varlen_records(tmp_path)
+        ds = ParsedExampleDataSet(
+            [path], batch_size=BATCH, dense_keys=["y"], dense_shapes=[()],
+            label_key="y", feature_padding=-1, sparse_features=[
+                VarLenFeature("ids", MAXLEN, encoding="positions")])
+        emb = 8
+        model = nn.Sequential(
+            nn.LookupTableSparse(VOCAB, emb, combiner="mean"),
+            nn.Linear(emb, CLASSES), nn.LogSoftMax())
+        opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                              optim_method=SGD(learning_rate=0.5),
+                              end_trigger=Trigger.max_epoch(25))
+        opt.optimize()
+        xs = np.concatenate([np.asarray(b.input)
+                             for b in ds.data(train=False)])
+        out, _ = model.apply(opt.params, opt.model_state, jnp.asarray(xs))
+        acc = float((np.argmax(np.asarray(out), -1) == labels).mean())
+        assert acc >= 0.9, acc

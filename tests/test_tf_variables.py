@@ -108,6 +108,49 @@ class TestVariableImport:
             for path, _ in jax.tree_util.tree_flatten_with_path(gp)[0])
         assert "conv_w" in names and "fc_w" in names, names
 
+    def test_reversed_node_order_imports(self, tmp_path):
+        """GraphDef order is not topological (grappler/transform_graph
+        rewrites reorder nodes): consumers listed BEFORE the variables
+        they read must defer and retry, not crash or misfold."""
+        pb, prefix, xv, ref = _build_v1_conv_graph(tmp_path)
+        import bigdl_tpu.proto  # noqa: F401
+        import tf_graph_pb2 as tfp2
+
+        gd = tfp2.GraphDef()
+        with open(pb, "rb") as fh:
+            gd.ParseFromString(fh.read())
+        rev = list(gd.node)[::-1]
+        del gd.node[:]
+        for n in rev:
+            gd.node.add().CopyFrom(n)
+        pb2 = str(tmp_path / "reversed.pb")
+        with open(pb2, "wb") as fh:
+            fh.write(gd.SerializeToString())
+        g, gp, gs = load_tensorflow(pb2, ["x"], ["out"], [(N, H, W, C)],
+                                    checkpoint=prefix)
+        y = np.asarray(g.apply(gp, gs, jnp.asarray(xv))[0])
+        np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+
+    def test_checkpoint_missing_variable_is_loud(self, tmp_path):
+        """An explicit checkpoint that lacks a graph variable must fail,
+        never silently fall back to the untrained initializer."""
+        pb, prefix, _, _ = _build_v1_conv_graph(tmp_path)
+        from bigdl_tpu.utils import tensorflow as tf_mod
+
+        ck = read_checkpoint(prefix)
+        ck.pop("conv_w")
+        real = tf_mod.load_tensorflow
+
+        g = tf.Graph  # keep flake quiet; not used
+        import bigdl_tpu.utils.tf_checkpoint as ckpt_mod
+        orig = ckpt_mod.read_checkpoint
+        ckpt_mod.read_checkpoint = lambda p: ck
+        try:
+            with pytest.raises(ValueError, match="not found in the checkpoint"):
+                real(pb, ["x"], ["out"], [(N, H, W, C)], checkpoint=prefix)
+        finally:
+            ckpt_mod.read_checkpoint = orig
+
     def test_missing_value_is_loud(self, tmp_path):
         """A variable with neither checkpoint nor foldable initializer
         must fail loudly, not import garbage."""
