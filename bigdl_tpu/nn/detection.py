@@ -524,3 +524,92 @@ class DetectionOutputFrcnn(Module):
         dets = jnp.concatenate([
             classes[top_i][:, None], top_s[:, None], bxs[top_i]], axis=1)
         return Table(dets, jnp.isfinite(top_s)), state
+
+
+# ---------------------------------------------------------------------------
+# SSD training loss (the trainable glue for the detection heads: the
+# reference trains SSD in its model-zoo projects on top of these same
+# primitives; here the matcher/criterion ships in-core so ROI-augmented
+# detection training is testable end-to-end — consumes RoiImageToBatch's
+# padded (B, n_max, 5) targets, vision/roi.py)
+
+
+class MultiBoxCriterion:
+    """SSD MultiBox loss: prior<->gt matching (bipartite force-match +
+    IoU>=`overlap` soft match), (cx, cy, w, h) offset encoding with SSD
+    variances, smooth-L1 localization on positives, cross-entropy
+    confidence with 3:1 hard negative mining.
+
+    `priors`: (M, 4) normalized corner boxes (e.g. concatenated PriorBox
+    outputs).  Input: Table(loc (B, M, 4), conf (B, M, C)) with class 0 =
+    background; target: (B, n_max, 5) rows [class, x1, y1, x2, y2],
+    class −1 = padding (vision/roi.py RoiImageToBatch)."""
+
+    def __init__(self, priors, overlap: float = 0.5,
+                 neg_pos_ratio: float = 3.0,
+                 variances: Tuple[float, float] = (0.1, 0.2)):
+        self.priors = jnp.asarray(priors, jnp.float32).reshape(-1, 4)
+        self.overlap = overlap
+        self.neg_pos_ratio = neg_pos_ratio
+        self.variances = variances
+
+    def _encode(self, gt):
+        p = self.priors
+        pw = p[:, 2] - p[:, 0]
+        ph = p[:, 3] - p[:, 1]
+        pcx = p[:, 0] + 0.5 * pw
+        pcy = p[:, 1] + 0.5 * ph
+        gw = jnp.clip(gt[:, 2] - gt[:, 0], 1e-6)
+        gh = jnp.clip(gt[:, 3] - gt[:, 1], 1e-6)
+        gcx = gt[:, 0] + 0.5 * gw
+        gcy = gt[:, 1] + 0.5 * gh
+        v0, v1 = self.variances
+        return jnp.stack([(gcx - pcx) / pw / v0, (gcy - pcy) / ph / v0,
+                          jnp.log(gw / pw) / v1, jnp.log(gh / ph) / v1], 1)
+
+    def _match(self, gt_boxes, gt_cls):
+        """(n_max, 4), (n_max,) -> (labels (M,), loc_targets (M, 4),
+        pos mask (M,)).  Matching follows the standard SSD assigner."""
+        valid = gt_cls >= 0
+        iou = bbox_iou(self.priors, gt_boxes) * valid[None, :]
+        best_gt = jnp.argmax(iou, axis=1)
+        best_gt_iou = jnp.max(iou, axis=1)
+        # force-match: each valid gt claims its best prior.  Invalid
+        # (padding) gts scatter out-of-bounds and are dropped — their
+        # argmax is also index 0 and a duplicate-index write could
+        # otherwise clobber a real force-match.
+        best_prior = jnp.argmax(iou, axis=0)  # (n_max,)
+        forced_gt = jnp.arange(gt_boxes.shape[0])
+        idx = jnp.where(valid, best_prior, self.priors.shape[0])
+        best_gt = best_gt.at[idx].set(forced_gt, mode="drop")
+        best_gt_iou = best_gt_iou.at[idx].set(2.0, mode="drop")
+        pos = best_gt_iou >= self.overlap
+        labels = jnp.where(pos, gt_cls[best_gt] + 1.0, 0.0)
+        loc_t = self._encode(gt_boxes[best_gt])
+        return labels.astype(jnp.int32), loc_t, pos
+
+    def forward(self, output, target):
+        loc, conf = output[1], output[2]
+        target = jnp.asarray(target)
+        gt_boxes, gt_cls = target[..., 1:5], target[..., 0]
+        labels, loc_t, pos = jax.vmap(self._match)(gt_boxes, gt_cls)
+        n_pos = jnp.sum(pos, axis=1)  # (B,)
+
+        diff = jnp.abs(loc - loc_t)
+        sl1 = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5)
+        loss_loc = jnp.sum(sl1.sum(-1) * pos, axis=1)
+
+        logp = jax.nn.log_softmax(conf, axis=-1)
+        ce = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        # hard negative mining: rank background losses per image, keep
+        # the top neg_pos_ratio * n_pos
+        neg_ce = jnp.where(pos, -jnp.inf, ce)
+        order = jnp.argsort(-neg_ce, axis=1)
+        rank = jnp.argsort(order, axis=1)
+        n_neg = jnp.clip(self.neg_pos_ratio * n_pos, 1,
+                         pos.shape[1] - 1)[:, None]
+        neg = (~pos) & (rank < n_neg)
+        loss_conf = jnp.sum(ce * (pos | neg), axis=1)
+
+        denom = jnp.clip(n_pos.astype(jnp.float32), 1.0).sum()
+        return (loss_loc.sum() + loss_conf.sum()) / denom

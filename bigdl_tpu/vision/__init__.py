@@ -35,3 +35,4 @@ from bigdl_tpu.vision.image import (
     RandomTransformer,
     MTImageFeatureToBatch,
 )
+from bigdl_tpu.vision import roi  # noqa: F401,E402

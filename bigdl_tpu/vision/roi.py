@@ -1,0 +1,301 @@
+"""ROI-aware detection augmentation: RoiLabel + geometry-preserving
+transforms + the SSD random-crop sampler + ROI batching.
+
+Reference: transform/vision/image/label/roi/{RoiLabel, RoiTransformer,
+BatchSampler, RandomSampler}.scala + util/BoundingBox.scala — the
+transforms that make detection heads TRAINABLE: every geometric image
+augmentation (flip/crop/resize/expand) is mirrored on the ground-truth
+boxes, and the SSD-style random crop re-samples patches constrained by
+gt overlap.
+
+Host-side numpy throughout (augmentation is input-pipeline work); the
+batch boundary pads to a static box count so the jitted training step
+sees one shape (`RoiImageToBatch`), with class −1 marking padding —
+consumed by `MultiBoxCriterion` (nn/detection.py)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.vision.image import FeatureTransformer, ImageFeature
+
+BOUNDING_BOX = "boundingBox"  # reference: ImageFeature.boundingBox
+
+
+class RoiLabel:
+    """Ground-truth record: `classes` (N,) float class ids — or (2, N)
+    with difficult flags in the second row — and `bboxes` (N, 4) x1y1x2y2.
+    reference: label/roi/RoiLabel.scala."""
+
+    def __init__(self, classes, bboxes):
+        self.classes = np.asarray(classes, np.float32)
+        self.bboxes = np.asarray(bboxes, np.float32).reshape(-1, 4)
+        n = self.bboxes.shape[0]
+        if self.classes.ndim == 1:
+            if self.classes.shape[0] != n:
+                raise ValueError(
+                    f"{self.classes.shape[0]} classes vs {n} boxes")
+        elif self.classes.size and self.classes.shape[1] != n:
+            raise ValueError(f"{self.classes.shape[1]} classes vs {n} boxes")
+
+    def size(self) -> int:
+        return 0 if self.bboxes.size < 4 else self.bboxes.shape[0]
+
+    @property
+    def class_row(self) -> np.ndarray:
+        return self.classes if self.classes.ndim == 1 else self.classes[0]
+
+    @property
+    def difficults(self) -> np.ndarray:
+        if self.classes.ndim == 2:
+            return self.classes[1]
+        return np.zeros_like(self.class_row)
+
+    @staticmethod
+    def from_tensor(t) -> "RoiLabel":
+        """(N, 6) rows [class, difficult, x1, y1, x2, y2] — the layout
+        RoiLabel.fromTensor unpacks (RoiLabel.scala:56)."""
+        t = np.asarray(t, np.float32)
+        return RoiLabel(t[:, :2].T.copy(), t[:, 2:6].copy())
+
+    def __repr__(self):
+        return f"RoiLabel(n={self.size()})"
+
+
+def jaccard_overlap(box: np.ndarray, boxes: np.ndarray) -> np.ndarray:
+    """IoU of one (4,) box against (N, 4) boxes
+    (BoundingBox.scala:99)."""
+    if boxes.size == 0:
+        return np.zeros((0,), np.float32)
+    w = np.minimum(box[2], boxes[:, 2]) - np.maximum(box[0], boxes[:, 0])
+    h = np.minimum(box[3], boxes[:, 3]) - np.maximum(box[1], boxes[:, 1])
+    inter = np.where((w < 0) | (h < 0), 0.0, w * h)
+    area = (box[2] - box[0]) * (box[3] - box[1])
+    areas = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    return (inter / np.maximum(area + areas - inter, 1e-12)).astype(np.float32)
+
+
+class RoiNormalize(FeatureTransformer):
+    """Pixel-space boxes -> [0, 1] (RoiTransformer.scala RoiNormalize)."""
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        h, w = feature.image.shape[:2]
+        label: RoiLabel = feature[ImageFeature.LABEL]
+        label.bboxes[:, 0::2] /= w
+        label.bboxes[:, 1::2] /= h
+        return feature
+
+
+class RoiHFlip(FeatureTransformer):
+    """Mirror boxes to match a horizontal image flip
+    (RoiTransformer.scala RoiHFlip)."""
+
+    def __init__(self, normalized: bool = True):
+        self.normalized = normalized
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        label: RoiLabel = feature[ImageFeature.LABEL]
+        width = 1.0 if self.normalized else feature.image.shape[1]
+        x1 = label.bboxes[:, 0].copy()
+        label.bboxes[:, 0] = width - label.bboxes[:, 2]
+        label.bboxes[:, 2] = width - x1
+        return feature
+
+
+class RoiResize(FeatureTransformer):
+    """Scale pixel-space boxes after an image resize
+    (RoiTransformer.scala RoiResize); normalized boxes are unchanged."""
+
+    def __init__(self, normalized: bool = False):
+        self.normalized = normalized
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        if not self.normalized:
+            oh, ow = feature[ImageFeature.ORIGINAL_SIZE][:2]
+            h, w = feature.image.shape[:2]
+            label: RoiLabel = feature[ImageFeature.LABEL]
+            label.bboxes[:, 0::2] *= w / ow
+            label.bboxes[:, 1::2] *= h / oh
+        return feature
+
+
+class RoiProject(FeatureTransformer):
+    """Re-express normalized gt boxes in the coordinate system of the
+    crop window stored under feature['boundingBox'], dropping boxes that
+    fall outside (optionally requiring the gt CENTER inside the window).
+    (RoiTransformer.scala RoiProject + BoundingBox.projectBbox)."""
+
+    def __init__(self, need_meet_center_constraint: bool = True):
+        self.need_center = need_meet_center_constraint
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        win = np.asarray(feature[BOUNDING_BOX], np.float32)
+        label: RoiLabel = feature[ImageFeature.LABEL]
+        boxes, classes, diffs = label.bboxes, label.class_row, \
+            label.difficults
+        keep_boxes, keep_cls, keep_diff = [], [], []
+        ww, wh = win[2] - win[0], win[3] - win[1]
+        for i in range(label.size()):
+            b = boxes[i]
+            cx, cy = (b[0] + b[2]) / 2, (b[1] + b[3]) / 2
+            if self.need_center and not (win[0] <= cx <= win[2]
+                                         and win[1] <= cy <= win[3]):
+                continue
+            if b[0] >= win[2] or b[2] <= win[0] \
+                    or b[1] >= win[3] or b[3] <= win[1]:
+                continue  # no overlap
+            proj = np.asarray([(b[0] - win[0]) / ww, (b[1] - win[1]) / wh,
+                               (b[2] - win[0]) / ww, (b[3] - win[1]) / wh],
+                              np.float32)
+            proj = np.clip(proj, 0.0, 1.0)
+            if (proj[2] - proj[0]) * (proj[3] - proj[1]) > 0:
+                keep_boxes.append(proj)
+                keep_cls.append(classes[i])
+                keep_diff.append(diffs[i])
+        label.bboxes = (np.stack(keep_boxes) if keep_boxes
+                        else np.zeros((0, 4), np.float32))
+        label.classes = np.stack([np.asarray(keep_cls, np.float32),
+                                  np.asarray(keep_diff, np.float32)])
+        return feature
+
+
+class BatchSampler:
+    """Sample normalized crop candidates constrained by scale/aspect and
+    gt jaccard overlap (label/roi/BatchSampler.scala)."""
+
+    def __init__(self, max_sample: int = 1, max_trials: int = 50,
+                 min_scale: float = 1.0, max_scale: float = 1.0,
+                 min_aspect_ratio: float = 1.0, max_aspect_ratio: float = 1.0,
+                 min_overlap: Optional[float] = None,
+                 max_overlap: Optional[float] = None):
+        if not (0 < min_scale <= max_scale <= 1):
+            raise ValueError("scale range must satisfy 0 < min <= max <= 1")
+        self.max_sample = max_sample
+        self.max_trials = max_trials
+        self.min_scale, self.max_scale = min_scale, max_scale
+        self.min_ar, self.max_ar = min_aspect_ratio, max_aspect_ratio
+        self.min_overlap, self.max_overlap = min_overlap, max_overlap
+
+    def _sample_box(self, rs: np.random.RandomState) -> np.ndarray:
+        scale = rs.uniform(self.min_scale, self.max_scale)
+        ratio = rs.uniform(self.min_ar, self.max_ar)
+        ratio = min(max(ratio, scale * scale), 1.0 / scale / scale)
+        w = scale * np.sqrt(ratio)
+        h = scale / np.sqrt(ratio)
+        x1 = rs.uniform(0, 1 - w)
+        y1 = rs.uniform(0, 1 - h)
+        return np.asarray([x1, y1, x1 + w, y1 + h], np.float32)
+
+    def _satisfies(self, box: np.ndarray, label: RoiLabel) -> bool:
+        if self.min_overlap is None and self.max_overlap is None:
+            return True
+        ov = jaccard_overlap(box, label.bboxes)
+        ok = np.ones_like(ov, bool)
+        if self.min_overlap is not None:
+            ok &= ov >= self.min_overlap
+        if self.max_overlap is not None:
+            ok &= ov <= self.max_overlap
+        return bool(ok.any())
+
+    def sample(self, label: RoiLabel, out: List[np.ndarray],
+               rs: np.random.RandomState) -> None:
+        found = 0
+        for _ in range(self.max_trials):
+            if found >= self.max_sample:
+                return
+            box = self._sample_box(rs)
+            if self._satisfies(box, label):
+                out.append(box)
+                found += 1
+
+
+SSD_SAMPLERS = (
+    BatchSampler(max_trials=1),
+    BatchSampler(min_scale=0.3, min_aspect_ratio=0.5, max_aspect_ratio=2,
+                 min_overlap=0.1),
+    BatchSampler(min_scale=0.3, min_aspect_ratio=0.5, max_aspect_ratio=2,
+                 min_overlap=0.3),
+    BatchSampler(min_scale=0.3, min_aspect_ratio=0.5, max_aspect_ratio=2,
+                 min_overlap=0.5),
+    BatchSampler(min_scale=0.3, min_aspect_ratio=0.5, max_aspect_ratio=2,
+                 min_overlap=0.7),
+    BatchSampler(min_scale=0.3, min_aspect_ratio=0.5, max_aspect_ratio=2,
+                 min_overlap=0.9),
+    BatchSampler(min_scale=0.3, min_aspect_ratio=0.5, max_aspect_ratio=2,
+                 max_overlap=1.0),
+)
+
+
+class RandomSampler(FeatureTransformer):
+    """SSD random-crop: generate candidates from the 7-sampler zoo, pick
+    one uniformly, crop the IMAGE to it and record it under
+    feature['boundingBox'] for RoiProject (label/roi/RandomSampler.scala;
+    `RandomSampler.create()` chains the project step like the reference's
+    `RandomSampler() -> RoiProject()`).  Boxes must be normalized."""
+
+    def __init__(self, samplers: Sequence[BatchSampler] = SSD_SAMPLERS,
+                 seed: Optional[int] = None):
+        self.samplers = list(samplers)
+        self._rs = np.random.RandomState(seed)
+
+    @staticmethod
+    def create(seed: Optional[int] = None) -> FeatureTransformer:
+        return RandomSampler(seed=seed) >> RoiProject()
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        label: RoiLabel = feature[ImageFeature.LABEL]
+        candidates: List[np.ndarray] = []
+        for s in self.samplers:
+            s.sample(label, candidates, self._rs)
+        if candidates:
+            box = candidates[int(self._rs.uniform(0, 1) * len(candidates))]
+        else:
+            box = np.asarray([0, 0, 1, 1], np.float32)
+        h, w = feature.image.shape[:2]
+        x1, y1 = int(round(box[0] * w)), int(round(box[1] * h))
+        x2, y2 = int(round(box[2] * w)), int(round(box[3] * h))
+        feature.image = feature.image[max(y1, 0):max(y2, y1 + 1),
+                                      max(x1, 0):max(x2, x1 + 1)].copy()
+        feature[BOUNDING_BOX] = box
+        return feature
+
+
+class RoiImageToBatch:
+    """Batch ImageFeatures carrying RoiLabels into one MiniBatch with a
+    STATIC box count: images stack (B, H, W, C); targets pad to
+    (B, n_max, 5) rows [class, x1, y1, x2, y2] with class −1 padding —
+    what the jitted step and MultiBoxCriterion consume.  (The reference's
+    RoiMiniBatch keeps ragged tables; static shapes are the jit
+    requirement here.)"""
+
+    def __init__(self, batch_size: int, n_max_boxes: int = 32):
+        self.batch_size = batch_size
+        self.n_max = n_max_boxes
+
+    def __call__(self, features):
+        from bigdl_tpu.dataset.minibatch import MiniBatch
+
+        buf = []
+        for f in features:
+            buf.append(f)
+            if len(buf) == self.batch_size:
+                yield self._batch(buf)
+                buf = []
+
+    def _batch(self, feats):
+        from bigdl_tpu.dataset.minibatch import MiniBatch
+
+        imgs = np.stack([f.image for f in feats]).astype(np.float32)
+        target = np.full((len(feats), self.n_max, 5), -1.0, np.float32)
+        for b, f in enumerate(feats):
+            label: RoiLabel = f[ImageFeature.LABEL]
+            n = min(label.size(), self.n_max)
+            if label.size() > self.n_max:
+                raise ValueError(
+                    f"{label.size()} gt boxes > n_max_boxes={self.n_max}")
+            if n:
+                target[b, :n, 0] = label.class_row[:n]
+                target[b, :n, 1:] = label.bboxes[:n]
+        return MiniBatch(imgs, target)

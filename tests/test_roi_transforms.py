@@ -1,0 +1,220 @@
+"""ROI-aware detection augmentation + SSD training glue.
+
+Reference: transform/vision/image/label/roi/{RoiLabel, RoiTransformer,
+BatchSampler, RandomSampler}.scala, util/BoundingBox.scala — geometry
+transforms mirrored onto gt boxes so detection heads are trainable."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.detection import MultiBoxCriterion, PriorBox, bbox_iou
+from bigdl_tpu.vision.image import ImageFeature
+from bigdl_tpu.vision.roi import (BOUNDING_BOX, BatchSampler, RandomSampler,
+                                  RoiHFlip, RoiImageToBatch, RoiLabel,
+                                  RoiNormalize, RoiProject, RoiResize,
+                                  jaccard_overlap)
+
+
+def _feature(h=40, w=60, boxes=((10, 5, 30, 25),), classes=(2.0,)):
+    img = np.zeros((h, w, 3), np.float32)
+    label = RoiLabel(np.asarray(classes, np.float32),
+                     np.asarray(boxes, np.float32))
+    return ImageFeature(image=img, label=label)
+
+
+class TestRoiLabel:
+    def test_shapes_and_size(self):
+        lab = RoiLabel([1.0, 2.0], [[0, 0, 1, 1], [1, 1, 2, 2]])
+        assert lab.size() == 2
+        with pytest.raises(ValueError):
+            RoiLabel([1.0], [[0, 0, 1, 1], [1, 1, 2, 2]])
+
+    def test_from_tensor_layout(self):
+        t = np.asarray([[2.0, 0.0, 1, 2, 3, 4],
+                        [5.0, 1.0, 5, 6, 7, 8]], np.float32)
+        lab = RoiLabel.from_tensor(t)
+        np.testing.assert_array_equal(lab.class_row, [2.0, 5.0])
+        np.testing.assert_array_equal(lab.difficults, [0.0, 1.0])
+        np.testing.assert_array_equal(lab.bboxes,
+                                      [[1, 2, 3, 4], [5, 6, 7, 8]])
+
+
+class TestTransforms:
+    def test_normalize(self):
+        f = _feature()
+        RoiNormalize()(f)
+        np.testing.assert_allclose(f[ImageFeature.LABEL].bboxes,
+                                   [[10 / 60, 5 / 40, 30 / 60, 25 / 40]])
+
+    def test_hflip_normalized(self):
+        f = _feature()
+        RoiNormalize()(f)
+        RoiHFlip(normalized=True)(f)
+        np.testing.assert_allclose(
+            f[ImageFeature.LABEL].bboxes,
+            [[1 - 30 / 60, 5 / 40, 1 - 10 / 60, 25 / 40]])
+
+    def test_hflip_pixel_space(self):
+        f = _feature()
+        RoiHFlip(normalized=False)(f)
+        np.testing.assert_allclose(f[ImageFeature.LABEL].bboxes,
+                                   [[60 - 30, 5, 60 - 10, 25]])
+
+    def test_resize_scales_pixel_boxes(self):
+        f = _feature()
+        f.image = np.zeros((80, 30, 3), np.float32)  # h x2, w /2
+        RoiResize(normalized=False)(f)
+        np.testing.assert_allclose(f[ImageFeature.LABEL].bboxes,
+                                   [[5, 10, 15, 50]])
+
+    def test_project_keeps_center_and_reprojects(self):
+        f = _feature()
+        RoiNormalize()(f)
+        f[BOUNDING_BOX] = np.asarray([0.0, 0.0, 0.5, 0.5], np.float32)
+        RoiProject()(f)
+        lab = f[ImageFeature.LABEL]
+        assert lab.size() == 1
+        # original normalized box (1/6, 1/8, 1/2, 5/8) reprojected into
+        # the window and clipped
+        np.testing.assert_allclose(lab.bboxes,
+                                   [[1 / 3, 1 / 4, 1.0, 1.0]], rtol=1e-5)
+
+    def test_project_drops_outside_center(self):
+        f = _feature()
+        RoiNormalize()(f)
+        f[BOUNDING_BOX] = np.asarray([0.8, 0.8, 1.0, 1.0], np.float32)
+        RoiProject()(f)
+        assert f[ImageFeature.LABEL].size() == 0
+
+    def test_jaccard_matches_manual(self):
+        box = np.asarray([0.0, 0.0, 2.0, 2.0], np.float32)
+        others = np.asarray([[1, 1, 3, 3], [5, 5, 6, 6]], np.float32)
+        got = jaccard_overlap(box, others)
+        np.testing.assert_allclose(got, [1.0 / 7.0, 0.0], rtol=1e-6)
+
+
+class TestSampler:
+    def test_unconstrained_sampler_always_accepts(self):
+        lab = RoiLabel(np.asarray([1.0]), np.asarray([[0.4, 0.4, 0.6, 0.6]]))
+        out = []
+        BatchSampler(max_trials=1).sample(lab, out,
+                                          np.random.RandomState(0))
+        assert len(out) == 1
+
+    def test_overlap_constraint_filters(self):
+        lab = RoiLabel(np.asarray([1.0]),
+                       np.asarray([[0.45, 0.45, 0.55, 0.55]]))
+        s = BatchSampler(max_sample=5, max_trials=200, min_scale=0.3,
+                         min_aspect_ratio=0.5, max_aspect_ratio=2.0,
+                         min_overlap=0.3)
+        out = []
+        s.sample(lab, out, np.random.RandomState(0))
+        for box in out:
+            assert jaccard_overlap(box, lab.bboxes)[0] >= 0.3
+
+    def test_random_sampler_crops_image_and_projects(self):
+        rs_feats = []
+        for seed in range(5):
+            f = _feature()
+            RoiNormalize()(f)
+            chain = RandomSampler.create(seed=seed)
+            f = chain(f)
+            assert BOUNDING_BOX in f
+            lab = f[ImageFeature.LABEL]
+            # surviving boxes are normalized to the crop
+            if lab.size():
+                assert (lab.bboxes >= 0).all() and (lab.bboxes <= 1).all()
+            rs_feats.append(f.image.shape)
+        assert len({s for s in rs_feats}) >= 1  # crops happened
+
+
+class TestRoiBatching:
+    def test_pads_to_static_shape(self):
+        feats = []
+        for k in (1, 3):
+            boxes = [(0.1 * i, 0.1 * i, 0.1 * i + 0.2, 0.1 * i + 0.2)
+                     for i in range(k)]
+            f = _feature(boxes=boxes, classes=tuple(float(i) for i in range(k)))
+            RoiNormalize()(f)
+            feats.append(f)
+        batches = list(RoiImageToBatch(2, n_max_boxes=4)(feats))
+        assert len(batches) == 1
+        tgt = batches[0].target
+        assert tgt.shape == (2, 4, 5)
+        assert (tgt[0, 1:, 0] == -1).all()
+        assert (tgt[1, 3:, 0] == -1).all()
+        assert (tgt[1, :3, 0] == [0, 1, 2]).all()
+
+
+class TestMultiBoxTraining:
+    def _priors(self, grid=4):
+        # one prior per cell of a grid x grid map, square 0.3-sized
+        cx, cy = np.meshgrid((np.arange(grid) + 0.5) / grid,
+                             (np.arange(grid) + 0.5) / grid)
+        c = np.stack([cx.ravel(), cy.ravel()], 1)
+        return np.concatenate([c - 0.15, c + 0.15], 1).astype(np.float32)
+
+    def test_matching_assigns_best_prior(self):
+        priors = self._priors()
+        crit = MultiBoxCriterion(priors)
+        gt = np.full((4, 5), -1.0, np.float32)
+        gt[0] = [2.0, 0.05, 0.05, 0.3, 0.3]  # near cell (0,0)
+        labels, loc_t, pos = crit._match(jnp.asarray(gt[:, 1:5]),
+                                         jnp.asarray(gt[:, 0]))
+        assert int(pos.sum()) >= 1
+        assert int(labels[int(jnp.argmax(pos))]) == 3  # class 2 + 1
+
+    def test_ssd_head_smoke_trains_on_synthetic_boxes(self):
+        """End-to-end: ROI-augmented synthetic single-box images ->
+        RoiImageToBatch -> tiny conv SSD head -> MultiBoxCriterion; loss
+        halves and the head learns to classify the right cell."""
+        rs = np.random.RandomState(0)
+        grid, classes, n_max = 4, 3, 4
+        priors = self._priors(grid)
+        m = priors.shape[0]
+
+        def make_batch(n=8):
+            imgs = np.zeros((n, 16, 16, 3), np.float32)
+            tgt = np.full((n, n_max, 5), -1.0, np.float32)
+            for b in range(n):
+                c = rs.randint(classes)
+                gx, gy = rs.randint(grid), rs.randint(grid)
+                x1, y1 = gx / grid + 0.02, gy / grid + 0.02
+                box = [x1, y1, x1 + 0.21, y1 + 0.21]
+                tgt[b, 0] = [c, *box]
+                # paint the box region with a class-coded color
+                px = slice(int(y1 * 16), int((y1 + 0.25) * 16))
+                py = slice(int(x1 * 16), int((x1 + 0.25) * 16))
+                imgs[b, px, py, c] = 1.0
+            return imgs, tgt
+
+        head = nn.Sequential(
+            nn.SpatialConvolution(3, 16, 3, 3, 1, 1, 1, 1), nn.ReLU(),
+            nn.SpatialConvolution(16, 16, 3, 3, 4, 4, 1, 1), nn.ReLU(),
+            nn.ConcatTable(
+                nn.Sequential(nn.SpatialConvolution(16, 4, 1, 1),
+                              nn.Reshape([m, 4], batch_mode=True)),
+                nn.Sequential(nn.SpatialConvolution(16, classes + 1, 1, 1),
+                              nn.Reshape([m, classes + 1], batch_mode=True))))
+        params, state, _ = head.build(jax.random.PRNGKey(0), (8, 16, 16, 3))
+        crit = MultiBoxCriterion(priors)
+
+        def loss_fn(p, x, t):
+            out, _ = head.apply(p, state, jnp.asarray(x), training=True)
+            return crit.forward(out, jnp.asarray(t))
+
+        grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+        imgs, tgt = make_batch(16)
+        l0 = float(loss_fn(params, imgs, tgt))
+        lr = 0.1
+        for i in range(60):
+            lv, g = grad_fn(params, imgs, tgt)
+            params = jax.tree_util.tree_map(lambda p, gg: p - lr * gg,
+                                            params, g)
+        l1 = float(loss_fn(params, imgs, tgt))
+        assert np.isfinite(l1)
+        assert l1 < l0 * 0.5, (l0, l1)
