@@ -169,6 +169,11 @@ class GRUCell(Cell):
             "w_hh": xavier(k2, (h, 3 * h), h, h),
             "bias": jnp.zeros((3 * h,), jnp.float32),
         }
+        if self.reset_after:
+            # torch's inner n-gate bias: n = tanh(.. + r*(h W_hn + b_hn)).
+            # Separate because r multiplies it — folding into `bias` is
+            # only exact when b_hn = 0 (zero init keeps that default).
+            params["bias_hn"] = jnp.zeros((h,), jnp.float32)
         n = input_shape[0]
         return params, {}, (n, h)
 
@@ -180,7 +185,7 @@ class GRUCell(Cell):
             gh_r, gh_z, gh_n = jnp.split(gh, 3, axis=-1)
             r = jax.nn.sigmoid(gi_r + gh_r)
             z = jax.nn.sigmoid(gi_z + gh_z)
-            n = jnp.tanh(gi_n + r * gh_n)
+            n = jnp.tanh(gi_n + r * (gh_n + params["bias_hn"]))
         else:
             h2 = self.hidden_size * 2
             gh_rz = hidden @ params["w_hh"][:, :h2]

@@ -817,3 +817,22 @@ class DecodeBmp(DecodeImage):
 
 class DecodeGif(DecodeImage):
     _format = "GIF"
+
+
+class RandomShuffleOp(Module):
+    """TF RandomShuffle: permute along dim 0.  The REFERENCE lowers this
+    op to Identity (utils/tf/loaders/RandomShuffle.scala — its graphs use
+    it only on input pipelines it replaces anyway); here eval mode keeps
+    that identity parity and TRAINING mode genuinely shuffles with the
+    step rng (a documented capability delta)."""
+
+    def __init__(self, seed: int = 0, name: Optional[str] = None):
+        super().__init__(name)
+        self.seed = seed
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if not training or rng is None:
+            return x, state
+        key = jax.random.fold_in(jnp.asarray(rng), self.seed)
+        perm = jax.random.permutation(key, x.shape[0])
+        return jnp.take(x, perm, axis=0), state

@@ -136,15 +136,11 @@ def _import_lstm_cell(m: LSTMCell, g: Dict[str, np.ndarray]):
 
 def _import_gru_cell(m: GRUCell, g: Dict[str, np.ndarray],
                      approximate: bool = False, convention: str = "torch"):
-    """torch GRU applies the reset gate INSIDE the hidden matmul's bias
-    (n = tanh(b_in + x W_in + r * (h W_hn + b_hn))); the fused-gate cell
-    applies r after the matmul with no inner bias, so a nonzero b_hn is
-    not exactly representable.
-
-    approximate=True folds b_hn into the input-side n bias.  The
-    pre-activation error is (1 - r) * b_hn elementwise, so per step
-    |Δn| <= |b_hn| (tanh is 1-Lipschitz) and |Δh| <= (1-z)|b_hn| —
-    the importer logs the max |b_hn| as the bound."""
+    """torch GRU: n = tanh(b_in + x W_in + r * (h W_hn + b_hn)).  The
+    reset-after cell carries the inner n-gate bias as its own `bias_hn`
+    parameter, so the import is EXACT: r,z hidden biases fold into the
+    input bias (r and z see b_ih + b_hh linearly), b_hn maps to bias_hn.
+    (`approximate` is kept for API compatibility and no longer needed.)"""
     _check_single_layer_rnn("GRU", g)
     if convention == "torch" and not m.reset_after:
         raise ValueError(
@@ -155,28 +151,13 @@ def _import_gru_cell(m: GRUCell, g: Dict[str, np.ndarray],
             "True) for torch imports (use import_keras_weights for "
             "keras-1 GRU weights).")
     h = m.hidden_size
-    _, b_hh = _rnn_bias(g, 3 * h)
-    b_hn_max = float(np.abs(b_hh[2 * h:]).max())
-    if b_hn_max > 1e-6 and not approximate:
-        raise ValueError(
-            "torch GRU has a nonzero hidden bias on the n-gate (b_hn; max "
-            f"|b_hn| = {b_hn_max:.4g}); the fused-gate GRU cell cannot "
-            "represent it exactly — pass approximate=True to fold it into "
-            "the input bias (per-step pre-activation error <= |b_hn|), or "
-            "zero b_hn before importing")
-    b_ih, _ = _rnn_bias(g, 3 * h)
+    b_ih, b_hh = _rnn_bias(g, 3 * h)
     bias = b_ih.copy()
     bias[:2 * h] += b_hh[:2 * h]  # r,z hidden biases fold into the input bias
-    if b_hn_max > 1e-6:
-        import logging
-
-        bias[2 * h:] += b_hh[2 * h:]
-        logging.getLogger("bigdl_tpu.interop").warning(
-            "approximate GRU import: folded b_hn into the input n bias; "
-            "per-step pre-activation error bound max|b_hn| = %.4g", b_hn_max)
     return {"w_ih": jnp.asarray(_np(g["weight_ih_l0"]).T),
             "w_hh": jnp.asarray(_np(g["weight_hh_l0"]).T),
-            "bias": jnp.asarray(bias)}, {}
+            "bias": jnp.asarray(bias),
+            "bias_hn": jnp.asarray(b_hh[2 * h:])}, {}
 
 
 def _import_rnn_cell(m: RnnCell, g: Dict[str, np.ndarray]):

@@ -999,6 +999,12 @@ class _TFImporter:
                 self._ensure_node(data_inputs[0], anchor=graph_in[0])
             self._attach(name, nn.ops.RandomUniformOp(seed=seed, name=name),
                          [data_inputs[0]])
+        elif op == "RandomShuffle":
+            from bigdl_tpu.nn import tf_ops as _tf
+
+            seed = int(nd.attr["seed"].i) if "seed" in nd.attr else 0
+            self._attach(name, _tf.RandomShuffleOp(seed=seed, name=name),
+                         [data_inputs[0]])
         elif op in ("DecodeJpeg", "DecodePng", "DecodeBmp", "DecodeGif"):
             from bigdl_tpu.nn import tf_ops as _tf
 

@@ -89,14 +89,24 @@ def test_import_gru_matches_torch_when_bhn_zero():
     np.testing.assert_allclose(np.asarray(got), want.numpy(), rtol=1e-4, atol=1e-4)
 
 
-def test_import_gru_rejects_nonzero_bhn():
-    tm = torch.nn.GRU(3, 5, batch_first=True)
+def test_import_gru_exact_with_nonzero_bhn():
+    """The reset-after cell's separate bias_hn parameter makes the torch
+    GRU import EXACT even with a nonzero inner n-gate bias (closing the
+    former approximate-fold limitation)."""
+    t, b, f, h = 4, 2, 3, 5
+    tm = torch.nn.GRU(f, h, batch_first=True)
     with torch.no_grad():
         tm.bias_hh_l0.fill_(0.3)
-    our = nn.GRU(3, 5)
-    params, state, _ = our.build(jax.random.PRNGKey(0), (2, 4, 3))
-    with pytest.raises(ValueError, match="b_hn"):
-        interop.import_torch_state_dict(our, params, state, tm.state_dict())
+    our = nn.GRU(f, h)
+    params, state, _ = our.build(jax.random.PRNGKey(0), (b, t, f))
+    params, state = interop.import_torch_state_dict(our, params, state,
+                                                    tm.state_dict())
+    x = np.random.RandomState(7).randn(b, t, f).astype(np.float32)
+    with torch.no_grad():
+        want, _ = tm(torch.from_numpy(x))
+    got, _ = our.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), rtol=1e-4,
+                               atol=1e-5)
 
 
 def test_import_lstm_without_bias():
@@ -595,13 +605,13 @@ def tensor_to_np(const_node):
     return tensor_to_ndarray(const_node.attr["value"].tensor)
 
 
-def test_import_gru_approximate_with_bound():
-    """approximate=True folds b_hn into the input n bias; per-step
-    pre-activation error <= max|b_hn| (documented bound)."""
+def test_import_gru_exact_under_legacy_approximate_flag():
+    """approximate=True (the former b_hn-folding escape hatch) is now a
+    no-op: the import is exact either way via the cell's bias_hn param."""
     t, b, f, h = 4, 2, 3, 5
     tm = torch.nn.GRU(f, h, batch_first=True)
     with torch.no_grad():
-        tm.bias_hh_l0[2 * h:] = 0.05  # small but nonzero b_hn
+        tm.bias_hh_l0[2 * h:] = 0.05
     our = nn.GRU(f, h)
     params, state, _ = our.build(jax.random.PRNGKey(0), (b, t, f))
     params, state = interop.import_torch_state_dict(
@@ -611,9 +621,7 @@ def test_import_gru_approximate_with_bound():
         want, _ = tm(torch.from_numpy(x))
     got, _ = our.apply(params, state, jnp.asarray(x))
     err = float(np.abs(np.asarray(got) - want.numpy()).max())
-    # per-step bound max|b_hn| = 0.05, loose accumulation factor over T=4
-    assert err < 0.05 * t, err
-    assert err > 0  # genuinely approximate
+    assert err < 1e-4, err
 
 
 def test_keras1_gru_exact_with_reset_before_cell():

@@ -183,3 +183,70 @@ class TestLongTailOps:
                                   padding=p)),
                   x, "Conv3DBackpropInputV2", tmp_path,
                   rtol=5e-4, atol=5e-5)
+
+
+class TestFusedBatchNormV2:
+    def test_v2_matches_tf(self, tmp_path):
+        """FusedBatchNormV2 (frozen, inference) differential vs TF.
+        reference loader: utils/tf/loaders/FusedBatchNormV2.scala."""
+        rs = np.random.RandomState(0)
+        c = 6
+        scale = tf.constant(rs.rand(c).astype(np.float32) + 0.5)
+        offset = tf.constant(rs.randn(c).astype(np.float32))
+        mean = tf.constant(rs.randn(c).astype(np.float32))
+        var = tf.constant(rs.rand(c).astype(np.float32) + 0.5)
+
+        @tf.function
+        def f(x):
+            out = tf.raw_ops.FusedBatchNormV2(
+                x=x, scale=scale, offset=offset, mean=mean, variance=var,
+                epsilon=1e-3, is_training=False)
+            return tf.identity(out[0], name="out")
+
+        x = rs.randn(2, 5, 5, c).astype(np.float32)
+        ours = run_import(f, x, "Identity", tmp_path)
+        want = f(tf.constant(x)).numpy()
+        np.testing.assert_allclose(ours, want, rtol=1e-4, atol=1e-5)
+
+
+class TestRandomShuffle:
+    def _import(self, tmp_path):
+        import bigdl_tpu.proto  # noqa: F401
+        import tf_graph_pb2 as tfp2
+
+        gd = tfp2.GraphDef()
+        x = gd.node.add()
+        x.name, x.op = "x", "Placeholder"
+        sh = gd.node.add()
+        sh.name, sh.op = "shuf", "RandomShuffle"
+        sh.input.append("x")
+        sh.attr["seed"].i = 3
+        out = gd.node.add()
+        out.name, out.op = "out", "Identity"
+        out.input.append("shuf")
+        pb = str(tmp_path / "shuffle.pb")
+        with open(pb, "wb") as fh:
+            fh.write(gd.SerializeToString())
+        return load_tensorflow(pb, ["x"], ["out"], [(6, 3)])
+
+    def test_eval_is_identity_like_reference(self, tmp_path):
+        """The reference lowers RandomShuffle to Identity
+        (utils/tf/loaders/RandomShuffle.scala); eval mode matches."""
+        g, gp, gs = self._import(tmp_path)
+        x = np.arange(18, dtype=np.float32).reshape(6, 3)
+        y = np.asarray(g.apply(gp, gs, jnp.asarray(x))[0])
+        np.testing.assert_array_equal(y, x)
+
+    def test_training_mode_permutes_rows(self, tmp_path):
+        import jax
+
+        g, gp, gs = self._import(tmp_path)
+        x = np.arange(18, dtype=np.float32).reshape(6, 3)
+        y = np.asarray(g.apply(gp, gs, jnp.asarray(x), training=True,
+                               rng=jax.random.PRNGKey(5))[0])
+        # a true permutation of the rows, and (with these keys) not the
+        # identity permutation
+        got = {tuple(r) for r in y}
+        want = {tuple(r) for r in x}
+        assert got == want
+        assert not np.array_equal(y, x)
