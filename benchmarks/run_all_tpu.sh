@@ -1,0 +1,22 @@
+#!/bin/bash
+# One-shot on-chip measurement capture (run when the axon tunnel is up):
+#   bash benchmarks/run_all_tpu.sh [outdir]
+# Each stage is bounded by `timeout` so a dead tunnel cannot wedge the
+# process holding the device grant (never kill -9 a TPU holder).
+set -u
+OUT=${1:-/root/repo/benchmarks/results}
+mkdir -p "$OUT"
+export PYTHONPATH=/root/repo:/root/.axon_site
+
+run() {  # name, timeout_s, cmd...
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name ==="
+  timeout "$tmo" "$@" 2>&1 | tee "$OUT/$name.log"
+  echo "rc=$? ($name)"
+}
+
+run bench          600 python /root/repo/bench.py
+run bench_fusebn   600 env BENCH_FUSE_BN=1 python /root/repo/bench.py
+run int8           900 python /root/repo/benchmarks/bench_int8.py
+run appendix_fuse 1500 python /root/repo/benchmarks/bench_appendix.py --fuse-bn
+echo "all done -> $OUT"
