@@ -19,7 +19,7 @@ Model:
   per-chip ring all-reduce moves 2*(N-1)/N * G bytes over the slowest
   link; ICI all-reduce effective bandwidth B_ici per chip within a slice
   (v5e public figure ~45 GB/s/link x 4 links, derated to an effective
-  ALGORITHM bandwidth), DCN between slices at B_dcn per host.  Gradient
+  ALGORITHM bandwidth); one v5e slice only (no DCN modeling).  Gradient
   all-reduce OVERLAPS backward (ParallelOptimizer's per-leaf collectives;
   XLA latency-hiding scheduler): exposed comm = max(0, t_comm -
   overlap_window).  Weak scaling (fixed per-chip batch 256).
@@ -42,8 +42,10 @@ BACKWARD_FRACTION = 0.6        # bwd ~2/3 of fwd+bwd FLOPs; overlap window
 ICI_ALGO_BW = 90e9   # bytes/s effective all-reduce bandwidth per chip
 #   (v5e: 4 ICI links x ~45 GB/s raw; ring algorithm efficiency + framing
 #    derate to ~90 GB/s usable — conservative vs the scaling-book figures)
-DCN_ALGO_BW = 12.5e9  # bytes/s per host across slices (100 Gbps NICs)
-CHIPS_PER_SLICE = 256  # v5e slice ceiling: ICI-only up to 256 chips
+CHIPS_PER_SLICE = 256  # v5e slice ceiling: ICI-only up to 256 chips; the
+#   table deliberately stops here — the DCN/multislice regime is NOT
+#   modeled (Engine.build_multislice_mesh exists, but an honest DCN model
+#   needs cross-slice measurements this environment cannot produce)
 
 
 _COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
@@ -146,11 +148,12 @@ def model_scaling(grad_bytes_per_chip, chips=(8, 16, 32, 64, 128, 256),
     t_step = STEP_MS_1CHIP / 1e3
     overlap = t_step * overlap_frac
     for n in chips:
-        # grad_bytes_per_chip is the HLO collective-output count of the
-        # 8-device program; a ring all-reduce moves 2*(N-1)/N * G per
-        # chip, so rescale from the 8-device ring factor to N's
+        # grad_bytes_per_chip is the all-reduce OUTPUT size G from the
+        # compiled HLO (validated: exactly 4 bytes x n_params — no ring
+        # factor baked in); a ring all-reduce moves 2*(N-1)/N * G of wire
+        # traffic per chip
         ring = 2 * (n - 1) / n
-        moved = grad_bytes_per_chip * (ring / (2 * 7 / 8))
+        moved = grad_bytes_per_chip * ring
         t_comm = moved / ici_bw + 2 * (n - 1) * HOP_LATENCY_S
         exposed = max(0.0, t_comm - overlap)
         t_n = t_step + exposed
@@ -187,7 +190,6 @@ def main():
         "ici_algo_bw_GBs": ICI_ALGO_BW / 1e9,
         "ici_pessimistic_GBs": 45.0,
         "hop_latency_us": HOP_LATENCY_S * 1e6,
-        "dcn_algo_bw_GBs": DCN_ALGO_BW / 1e9,
         "overlap_window_fraction": BACKWARD_FRACTION,
         "weak_scaling_batch_per_chip": 256,
         "chips_per_slice": CHIPS_PER_SLICE,

@@ -12,7 +12,8 @@ run() {  # name, timeout_s, cmd...
   local name=$1 tmo=$2; shift 2
   echo "=== $name ==="
   timeout "$tmo" "$@" 2>&1 | tee "$OUT/$name.log"
-  echo "rc=$? ($name)"
+  local rc=${PIPESTATUS[0]}  # the benchmark's status, not tee's
+  echo "rc=$rc ($name)"
 }
 
 run bench          600 python /root/repo/bench.py

@@ -1061,7 +1061,12 @@ class _TFImporter:
             true_ref = data_inputs[0] if sides[0][0] == 1 else data_inputs[1]
             false_ref = data_inputs[1] if sides[0][0] == 1 else data_inputs[0]
             if self._key(pred_ref) not in self.graph_nodes:
-                self._ensure_node(pred_ref, anchor=graph_in[0])
+                try:
+                    self._ensure_node(pred_ref, anchor=graph_in[0])
+                except ValueError as e:
+                    # dynamic predicate not yet converted (GraphDef order
+                    # is not topological): defer and retry
+                    raise _UnresolvedInput(str(e)) from e
             self._attach(name, _tf.MergeSelect(name=name),
                          [pred_ref, true_ref, false_ref])
         elif op == "TensorArrayV3":
@@ -1107,8 +1112,10 @@ class _TFImporter:
                 f"(reference: utils/tf/loaders/)")
 
 
-_CF_SKELETON = ("Enter", "Merge", "Switch", "Exit", "NextIteration",
-                "LoopCond")
+# LOOP skeleton ops excluded from frame body sub-imports; Switch/Merge
+# are NOT listed — loop-var ones are filtered by name (body-internal
+# cond Switch/Merge convert inside the sub-import)
+_CF_SKELETON = ("Enter", "Exit", "NextIteration", "LoopCond")
 _VAR_OPS = ("VariableV2", "Variable", "VarHandleOp")
 
 
@@ -1286,10 +1293,20 @@ def _convert_frame(imp: "_TFImporter", fr_name: str, nodes,
     executor collapses into lax.scan/while_loop."""
     from bigdl_tpu.nn import tf_ops as _tf
 
-    merges = [n for n in nodes if n.op == "Merge"]
+    # LOOP-var merges are Merge(Enter, NextIteration); a Merge whose
+    # inputs are ordinary body nodes belongs to a tf.cond INSIDE the body
+    # and converts via the sub-import's Switch/Merge path instead
+    def _is_loop_merge(n) -> bool:
+        prod = imp.nodes_by_name.get(_clean(n.input[0]))
+        return prod is not None and prod.op == "Enter"
+
+    merges = [n for n in nodes if n.op == "Merge" and _is_loop_merge(n)]
+    loop_merge_names = {m.name for m in merges}
     loopcond = next(n for n in nodes if n.op == "LoopCond")
     switch_by_merge = {_clean(n.input[0]): n for n in nodes
-                       if n.op == "Switch"}
+                       if n.op == "Switch"
+                       and _clean(n.input[0]) in loop_merge_names}
+    loop_switch_names = {s.name for s in switch_by_merge.values()}
     exit_by_switch = {_clean(n.input[0]): n for n in nodes if n.op == "Exit"}
     anchor = next(iter(imp.graph_nodes))
 
@@ -1341,7 +1358,14 @@ def _convert_frame(imp: "_TFImporter", fr_name: str, nodes,
         except (ValueError, KeyError):
             captures.append((n.name, src))
 
-    compute_nodes = [n for n in nodes if n.op not in _CF_SKELETON]
+    # keep body-internal cond Switch/Merge (they convert via the eager
+    # Switch-alias/MergeSelect path inside the sub-import); exclude only
+    # the LOOP skeleton
+    compute_nodes = [
+        n for n in nodes
+        if n.op not in _CF_SKELETON
+        and not (n.op == "Switch" and n.name in loop_switch_names)
+        and not (n.op == "Merge" and n.name in loop_merge_names)]
 
     def sub_importer(seed_fn):
         sub = _TFImporter.__new__(_TFImporter)

@@ -521,6 +521,7 @@ OPS_EXEMPLARS = {
     "ops.TruncatedNormal": lambda: nn.ops.TruncatedNormal(0.0, 2.0, seed=1),
     "tf.Assert": lambda: nn.tf_ops.Assert("boom"),
     "tf.DynamicConv2D": lambda: nn.tf_ops.DynamicConv2D((1, 1), "SAME"),
+    "tf.RandomShuffleOp": lambda: nn.tf_ops.RandomShuffleOp(seed=3),
     "tf.DynamicFusedBatchNorm": lambda: nn.tf_ops.DynamicFusedBatchNorm(
         1e-3, False),
     "tf.Assign": lambda: nn.tf_ops.Assign(),

@@ -379,3 +379,54 @@ class TestNestedWhile:
         x = np.asarray([1.0, 2.0, 3.0, 4.0], np.float32)
         y = np.asarray(g.apply(gp, gs, jnp.asarray(x))[0])
         np.testing.assert_allclose(y, x * 64.0, rtol=1e-6)
+
+
+class TestCondInsideWhile:
+    def test_counted_loop_with_body_cond(self, tmp_path):
+        """A tf.cond INSIDE a while body (its Switch/Merge are frame
+        members but not loop skeleton) imports: loop-var Merges are
+        Merge(Enter, NextIteration); the body cond converts via the
+        sub-import's Switch/Merge path.  v' = sum(v) < 10 ? v*2 : v+1,
+        4 iterations from [1, 1] -> [2,2] -> [4,4] -> [8,8] -> [9,9]."""
+        import tf_graph_pb2 as tfp
+
+        gd = tfp.GraphDef()
+        _nodedef(gd, "x", "Placeholder")
+        _nodedef(gd, "c0", "Const", value=np.asarray(0, np.int32))
+        _nodedef(gd, "c4", "Const", value=np.asarray(4, np.int32))
+        _nodedef(gd, "one_i", "Const", value=np.asarray(1, np.int32))
+        _nodedef(gd, "one_f", "Const", value=np.asarray(1.0, np.float32))
+        _nodedef(gd, "two", "Const", value=np.asarray(2.0, np.float32))
+        _nodedef(gd, "thr", "Const", value=np.asarray(10.0, np.float32))
+        _nodedef(gd, "axis0", "Const", value=np.asarray(0, np.int32))
+        # frame "w": vars (t counter, v value)
+        _nodedef(gd, "w/Enter_t", "Enter", ["c0"], frame_name=b"w")
+        _nodedef(gd, "w/Enter_v", "Enter", ["x"], frame_name=b"w")
+        _nodedef(gd, "w/Merge_t", "Merge", ["w/Enter_t", "w/NextIteration_t"])
+        _nodedef(gd, "w/Merge_v", "Merge", ["w/Enter_v", "w/NextIteration_v"])
+        _nodedef(gd, "w/Less", "Less", ["w/Merge_t", "c4"])
+        _nodedef(gd, "w/LoopCond", "LoopCond", ["w/Less"])
+        _nodedef(gd, "w/Switch_t", "Switch", ["w/Merge_t", "w/LoopCond"])
+        _nodedef(gd, "w/Switch_v", "Switch", ["w/Merge_v", "w/LoopCond"])
+        _nodedef(gd, "w/Ident_t", "Identity", ["w/Switch_t:1"])
+        _nodedef(gd, "w/Ident_v", "Identity", ["w/Switch_v:1"])
+        _nodedef(gd, "w/add_t", "Add", ["w/Ident_t", "one_i"])
+        # body cond: pred = sum(v) < thr
+        _nodedef(gd, "w/sum", "Sum", ["w/Ident_v", "axis0"])
+        _nodedef(gd, "w/pred", "Less", ["w/sum", "thr"])
+        _nodedef(gd, "w/csw", "Switch", ["w/Ident_v", "w/pred"])
+        _nodedef(gd, "w/tbr", "Mul", ["w/csw:1", "two"])
+        _nodedef(gd, "w/fbr", "Add", ["w/csw", "one_f"])
+        _nodedef(gd, "w/cmg", "Merge", ["w/fbr", "w/tbr"])
+        _nodedef(gd, "w/NextIteration_t", "NextIteration", ["w/add_t"])
+        _nodedef(gd, "w/NextIteration_v", "NextIteration", ["w/cmg"])
+        _nodedef(gd, "w/Exit_t", "Exit", ["w/Switch_t"])
+        _nodedef(gd, "w/Exit_v", "Exit", ["w/Switch_v"])
+        _nodedef(gd, "out", "Identity", ["w/Exit_v"])
+        pb = str(tmp_path / "cond_in_while.pb")
+        with open(pb, "wb") as fh:
+            fh.write(gd.SerializeToString())
+        g, gp, gs = load_tensorflow(pb, ["x"], ["out"], [(2,)])
+        x = np.asarray([1.0, 1.0], np.float32)
+        y = np.asarray(g.apply(gp, gs, jnp.asarray(x))[0])
+        np.testing.assert_allclose(y, [9.0, 9.0])
