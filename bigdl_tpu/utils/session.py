@@ -159,3 +159,36 @@ class Session:
         walk("", self.params)
         walk("__state__", self.state)
         np.savez(path, **flat)
+
+    def save_checkpoint(self, prefix: str) -> str:
+        """Write the graph's (fine-tuned) Variables back as a TF v2-format
+        checkpoint under their ORIGINAL node names — readable by
+        tf.train.load_checkpoint / tf.compat.v1.train.Saver.restore, so a
+        model trained here drops back into the TF world.  The export half
+        of the reference's variable flow (scripts/export_tf_checkpoint.py
+        + Session.scala saveParameters)."""
+        from bigdl_tpu.nn.tf_ops import Variable as TFVariable
+        from bigdl_tpu.utils.tf_checkpoint import write_checkpoint
+
+        if self.model is None:
+            raise ValueError("no graph: construct/train first")
+        tensors = {}
+
+        def walk(module, p_tree, s_tree):
+            for name, child in getattr(module, "children", {}).items():
+                if isinstance(child, TFVariable):
+                    src = p_tree.get(name) if child.trainable \
+                        else s_tree.get(name)
+                    if src is not None and "value" in src:
+                        tensors[child.name] = np.asarray(src["value"])
+                else:
+                    walk(child,
+                         p_tree.get(name, {}) if hasattr(p_tree, "get") else {},
+                         s_tree.get(name, {}) if hasattr(s_tree, "get") else {})
+
+        walk(self.model, self.params or {}, self.state or {})
+        if not tensors:
+            raise ValueError(
+                "graph has no Variables — it was loaded frozen; "
+                "save_parameters() dumps the whole parameter tree instead")
+        return write_checkpoint(prefix, tensors)

@@ -152,6 +152,126 @@ def _index_entries(index_path: str) -> Iterator[Tuple[bytes, bytes]]:
         yield from _block_entries(_read_block(data, boff, bsize))
 
 
+# ---------------------------------------------------------------------------
+# writer (exact inverse: TF's own loader reads these bundles back)
+
+
+_CRC_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ 0x82F63B78 if _c & 1 else _c >> 1
+    _CRC_TABLE.append(_c)
+
+
+def _crc32c(data: bytes) -> int:
+    try:  # the native TFRecord CRC kernel shares the polynomial
+        from bigdl_tpu.native import crc32c as _native
+
+        return _native(data)
+    except Exception:
+        crc = 0xFFFFFFFF
+        for b in data:
+            crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+        return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    c = _crc32c(data)
+    return (((c >> 15) | (c << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def _enc_varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _build_block(entries) -> bytes:
+    """Prefix-compression-free block: every entry is its own restart point
+    (shared=0), which any leveldb-style reader binary-searches fine."""
+    out = bytearray()
+    restarts = []
+    for key, value in entries:
+        restarts.append(len(out))
+        out += _enc_varint(0) + _enc_varint(len(key)) + _enc_varint(len(value))
+        out += key + value
+    for r in restarts or [0]:
+        out += r.to_bytes(4, "little")
+    out += max(len(restarts), 1).to_bytes(4, "little")
+    return bytes(out)
+
+
+def write_checkpoint(prefix: str, tensors: Dict[str, np.ndarray]) -> str:
+    """Write a TF v2-format ("tensor bundle") checkpoint that
+    `tf.train.load_checkpoint` (and `read_checkpoint` above) reads back —
+    the export half of the reference's variable flow
+    (scripts/export_tf_checkpoint.py + Session.saveParameters).  Returns
+    the prefix."""
+    np_to_dt = {np.dtype(np.float32): tfp.DT_FLOAT,
+                np.dtype(np.float64): tfp.DT_DOUBLE,
+                np.dtype(np.int32): tfp.DT_INT32,
+                np.dtype(np.int64): tfp.DT_INT64,
+                np.dtype(np.bool_): tfp.DT_BOOL,
+                np.dtype(np.uint8): tfp.DT_UINT8,
+                np.dtype(np.int8): tfp.DT_INT8,
+                np.dtype(np.int16): tfp.DT_INT16,
+                np.dtype(np.float16): 19}
+    data = bytearray()
+    kvs = []
+    header = tbp.BundleHeaderProto()
+    header.num_shards = 1
+    header.version.producer = 1
+    kvs.append((b"", header.SerializeToString()))
+    for name in sorted(tensors):
+        arr = np.ascontiguousarray(tensors[name])
+        dt = np_to_dt.get(arr.dtype)
+        if dt is None:
+            raise ValueError(f"tensor {name!r}: unsupported dtype "
+                             f"{arr.dtype}")
+        raw = arr.tobytes()
+        e = tbp.BundleEntryProto()
+        e.dtype = dt
+        for s in arr.shape:
+            e.shape.dim.add().size = s
+        e.shard_id = 0
+        e.offset = len(data)
+        e.size = len(raw)
+        e.crc32c = _masked_crc(raw)
+        data += raw
+        kvs.append((name.encode(), e.SerializeToString()))
+    with open(f"{prefix}.data-00000-of-00001", "wb") as f:
+        f.write(bytes(data))
+
+    def emit_block(out: bytearray, block: bytes):
+        handle = _enc_varint(len(out)) + _enc_varint(len(block))
+        out += block
+        out += bytes([0])  # no compression
+        out += _masked_crc(block + bytes([0])).to_bytes(4, "little")
+        return handle
+
+    index = bytearray()
+    data_handle = emit_block(index, _build_block(kvs))
+    # index block: one separator key >= every data key -> data block handle
+    last_key = kvs[-1][0]
+    index_handle = emit_block(
+        index, _build_block([(last_key + b"\x00", data_handle)]))
+    meta_handle = emit_block(index, _build_block([]))
+    footer = meta_handle + index_handle
+    footer += b"\x00" * (40 - len(footer))
+    footer += _TABLE_MAGIC.to_bytes(8, "little")
+    index += footer
+    with open(f"{prefix}.index", "wb") as f:
+        f.write(bytes(index))
+    return prefix
+
+
 def read_checkpoint(prefix: str) -> Dict[str, np.ndarray]:
     """Read every tensor of a TF v2-format checkpoint into host arrays.
 

@@ -205,3 +205,68 @@ class TestFineTune:
         out0, _ = g0.apply(gp0, gs0, jnp.asarray(xv))
         loss0 = float(crit.forward(out0, jnp.asarray(labels)))
         assert after_loss < loss0 * 0.5, (loss0, after_loss)
+
+
+class TestCheckpointWriter:
+    def test_roundtrip_and_tf_reads_our_bundle(self, tmp_path):
+        """write_checkpoint output loads back through BOTH our reader and
+        tf.train.load_checkpoint (byte-exact tensors + masked-crc32c
+        entries the TF runtime verifies)."""
+        from bigdl_tpu.utils.tf_checkpoint import write_checkpoint
+
+        rs = np.random.RandomState(0)
+        tensors = {"conv/w": rs.randn(3, 3, 2, 4).astype(np.float32),
+                   "fc/bias": rs.randn(6).astype(np.float32),
+                   "global_step": np.asarray(77, np.int64)}
+        prefix = write_checkpoint(str(tmp_path / "out.ckpt"), tensors)
+        back = read_checkpoint(prefix)
+        for k, v in tensors.items():
+            np.testing.assert_array_equal(back[k], v)
+        reader = tf.train.load_checkpoint(prefix)
+        for k, v in tensors.items():
+            np.testing.assert_array_equal(reader.get_tensor(k), v)
+
+    def test_finetune_then_save_checkpoint_tf_compatible(self, tmp_path):
+        """Import + fine-tune an unfrozen graph, save_checkpoint(), and
+        confirm TF reads back the TRAINED values under the original
+        variable names (the round-trip the reference's
+        export_tf_checkpoint flow provides)."""
+        from bigdl_tpu.dataset import ArrayDataSet, Sample, SampleToMiniBatch
+        from bigdl_tpu.optim import SGD, Trigger
+        from bigdl_tpu.utils.session import Session
+
+        pb, prefix, xv, _ = _build_v1_conv_graph(tmp_path)
+        labels = (np.arange(N) % CLASSES).astype(np.int32)
+        ds = ArrayDataSet([Sample.from_ndarray(xv[i], labels[i])
+                           for i in range(N)]).transform(SampleToMiniBatch(N))
+        sess = Session(pb, ["x"], [(N, H, W, C)], checkpoint=prefix)
+        sess.train(["out"], ds, nn.CrossEntropyCriterion(),
+                   optim_method=SGD(learning_rate=0.3),
+                   end_when=Trigger.max_epoch(3))
+        out_prefix = sess.save_checkpoint(str(tmp_path / "trained.ckpt"))
+        reader = tf.train.load_checkpoint(out_prefix)
+        for name in ("conv_w", "conv_b", "fc_w"):
+            np.testing.assert_array_equal(
+                reader.get_tensor(name),
+                np.asarray(sess.params[name]["value"]))
+        # and the trained values differ from the original checkpoint
+        orig = read_checkpoint(prefix)
+        assert np.abs(reader.get_tensor("conv_w") - orig["conv_w"]).max() > 1e-5
+
+    def test_frozen_graph_save_checkpoint_is_loud(self, tmp_path):
+        from bigdl_tpu.utils.session import Session
+
+        pb, prefix, xv, _ = _build_v1_conv_graph(tmp_path)
+        # freeze by loading without variables? simplest: a const-only graph
+        g = tf.Graph()
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, [2, 3], name="x")
+            w = tf.constant(np.ones((3, 2), np.float32))
+            tf.linalg.matmul(x, w, name="out")
+        pb2 = str(tmp_path / "frozen.pb")
+        with open(pb2, "wb") as fh:
+            fh.write(g.as_graph_def().SerializeToString())
+        sess = Session(pb2, ["x"], [(2, 3)])
+        sess._construct(["out"])
+        with pytest.raises(ValueError, match="no Variables"):
+            sess.save_checkpoint(str(tmp_path / "nope.ckpt"))
