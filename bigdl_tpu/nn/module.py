@@ -134,6 +134,17 @@ class Module:
               rng: Optional[jax.Array] = None):
         raise NotImplementedError(type(self).__name__)
 
+    def flattened_modules(self) -> List["Module"]:
+        """Every module in the `children` subtree, depth-first, self
+        included — for passes that must reach nested structure (e.g.
+        sync-BN patching of BNs inside residual Graph blocks).  Modules
+        held as plain attributes (a TFWhile's body graph, a KerasLayer's
+        lazily built inner) are NOT traversed."""
+        out: List["Module"] = [self]
+        for c in getattr(self, "children", {}).values():
+            out.extend(c.flattened_modules())
+        return out
+
     def output_shape(self, input_shape: Any) -> Any:
         """Shape inference for stateless modules; stateful ones override
         `build` and may compute it there."""
@@ -273,6 +284,7 @@ class Container(Module):
         return len(self.children)
 
     def modules(self) -> List[Module]:
+        """DIRECT children (reference: Container.scala `modules` buffer)."""
         return list(self.children.values())
 
     def __repr__(self) -> str:

@@ -700,10 +700,14 @@ class ParallelOptimizer(DistriOptimizer):
         # set the axis name for the run and restore afterwards, so the same
         # model can later train under plain jit (where a bound 'data' axis
         # would be an error)
+        from bigdl_tpu.nn.conv import SpatialConvolutionBN
         from bigdl_tpu.nn.norm import BatchNormalization
 
-        bns = [m for m in self.model.modules()
-               if isinstance(m, BatchNormalization)]
+        # flattened walk: residual-net BNs live nested inside Graph blocks
+        # (a direct-children scan would silently skip them and lose the
+        # sync-BN semantics)
+        bns = [m for m in self.model.flattened_modules()
+               if isinstance(m, (BatchNormalization, SpatialConvolutionBN))]
         saved = [m.axis_name for m in bns]
         for m in bns:
             m.set_axis_name(AXIS_DATA)

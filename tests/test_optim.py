@@ -678,3 +678,45 @@ class TestBinaryAccuracy:
         m2.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
                    metrics=["accuracy"])
         assert any(isinstance(x, Top1Accuracy) for x in m2.metrics)
+
+
+class TestSyncBnPatchingDepth:
+    def test_nested_bns_get_axis_name(self):
+        """ParallelOptimizer's sync-BN patch must reach BNs NESTED inside
+        Graph blocks (a direct-children scan silently skips them)."""
+        from unittest import mock
+
+        from bigdl_tpu.models.resnet import basic_block
+        from bigdl_tpu.nn.norm import BatchNormalization
+        from bigdl_tpu.optim.optimizer import ParallelOptimizer
+
+        model = nn.Sequential(basic_block(4, 8, 1),
+                              nn.GlobalAveragePooling2D(),
+                              nn.Linear(8, 2), nn.LogSoftMax())
+        nested_bns = [m for m in model.flattened_modules()
+                      if isinstance(m, BatchNormalization)]
+        assert len(nested_bns) >= 2  # inside the residual Graph
+        assert all(m.axis_name is None for m in nested_bns)
+
+        seen = {}
+
+        def fake_optimize(self):
+            seen["axis"] = [m.axis_name for m in nested_bns]
+            return model
+
+        rs = np.random.RandomState(0)
+        from bigdl_tpu.dataset import ArrayDataSet, Sample, SampleToMiniBatch
+
+        ds = ArrayDataSet([Sample.from_ndarray(
+            rs.rand(4, 4, 4).astype(np.float32), np.int32(0))]
+        ).transform(SampleToMiniBatch(1))
+        opt = ParallelOptimizer(model, ds, nn.ClassNLLCriterion(),
+                                optim_method=SGD(learning_rate=0.1),
+                                end_trigger=Trigger.max_iteration(1))
+        with mock.patch(
+                "bigdl_tpu.optim.optimizer.DistriOptimizer.optimize",
+                fake_optimize):
+            opt.optimize()
+        assert seen["axis"] == ["data"] * len(nested_bns)
+        # and restored afterwards
+        assert all(m.axis_name is None for m in nested_bns)
