@@ -326,10 +326,8 @@ def calibrate(q_module: Module, q_params: Any, state: Any, batches,
 
 
 def _walk(module: Module):
-    yield module
-    if isinstance(module, Container):
-        for child in module.children.values():
-            yield from _walk(child)
+    # one canonical tree walker (Module.flattened_modules)
+    yield from module.flattened_modules()
 
 
 class WeightOnlyInt8(Module):

@@ -706,8 +706,21 @@ class ParallelOptimizer(DistriOptimizer):
         # flattened walk: residual-net BNs live nested inside Graph blocks
         # (a direct-children scan would silently skip them and lose the
         # sync-BN semantics)
-        bns = [m for m in self.model.flattened_modules()
+        flat = self.model.flattened_modules()
+        bns = [m for m in flat
                if isinstance(m, (BatchNormalization, SpatialConvolutionBN))]
+        # keras-adapter layers build their inner nn module LAZILY (during
+        # optimize itself), so a BN inside one is unreachable here — say so
+        # instead of silently dropping sync-BN (the keras path trains via
+        # Optimizer/fit(), where this does not apply)
+        lazy = [m for m in flat
+                if hasattr(m, "_make") and getattr(m, "inner", None) is None]
+        if lazy:
+            logger.warning(
+                "ParallelOptimizer sync-BN cannot reach modules inside "
+                "unbuilt keras-adapter layers (%s); any BatchNorm there "
+                "will use per-shard statistics",
+                ", ".join(type(m).__name__ for m in lazy[:3]))
         saved = [m.axis_name for m in bns]
         for m in bns:
             m.set_axis_name(AXIS_DATA)
