@@ -1358,16 +1358,17 @@ def _convert_frame(imp: "_TFImporter", fr_name: str, nodes,
         except (ValueError, KeyError):
             captures.append((n.name, src))
 
-    # keep body-internal cond Switch/Merge (they convert via the eager
-    # Switch-alias/MergeSelect path inside the sub-import); exclude only
-    # the LOOP skeleton
+    # body-internal cond Switch/Merge convert inside the sub-import:
+    # structured TFCond regions where cleanly separable, the eager
+    # Switch-alias/MergeSelect path otherwise; exclude only the LOOP
+    # skeleton
     compute_nodes = [
         n for n in nodes
         if n.op not in _CF_SKELETON
         and not (n.op == "Switch" and n.name in loop_switch_names)
         and not (n.op == "Merge" and n.name in loop_merge_names)]
 
-    def sub_importer(seed_fn):
+    def sub_importer(seed_fn, outputs=()):
         sub = _TFImporter.__new__(_TFImporter)
         sub.nodes_by_name = imp.nodes_by_name
         sub.consts = imp.consts  # shared const cache
@@ -1386,8 +1387,19 @@ def _convert_frame(imp: "_TFImporter", fr_name: str, nodes,
         # this sub-import (their Enter inputs are body/cond nodes)
         child_frames = {cf: frames[cf] for cf in (frames or {})
                         if parents.get(cf) == fr_name} if frames else {}
-        pending_nodes = list(compute_nodes)
+        body_names = {n.name for n in compute_nodes}
+        regions = _detect_cond_regions(
+            compute_nodes, imp.nodes_by_name, set(), body_names, outputs,
+            stop=frozenset(loop_switch_names | loop_merge_names))
+        region_names = set()
+        for cr in regions:
+            region_names |= set(cr["members"])
+            region_names |= {s.name for s in cr["switches"]}
+            region_names |= {m.name for m in cr["merges"]}
+        pending_nodes = [n for n in compute_nodes
+                         if n.name not in region_names]
         todo = dict(child_frames)
+        todo_conds = list(regions)
         while True:
             pending_nodes, progressed = _sweep(sub, pending_nodes)
             for cf in list(todo):
@@ -1395,7 +1407,13 @@ def _convert_frame(imp: "_TFImporter", fr_name: str, nodes,
                     _convert_frame(sub, cf, todo.pop(cf),
                                    frames=frames, parents=parents)
                     progressed = True
-            if not progressed or (not pending_nodes and not todo):
+            for cr in list(todo_conds):
+                if _cond_ready(sub, cr):
+                    _convert_cond_region(sub, cr)
+                    todo_conds.remove(cr)
+                    progressed = True
+            if not progressed or (not pending_nodes and not todo
+                                  and not todo_conds):
                 break
         return sub, inputs
 
@@ -1407,7 +1425,8 @@ def _convert_frame(imp: "_TFImporter", fr_name: str, nodes,
             sub.shapes[v["switch"].name + ":1"] = var_shapes[i]
             inputs.append(node_in)
 
-    body_imp, body_inputs = sub_importer(seed_body)
+    body_imp, body_inputs = sub_importer(
+        seed_body, outputs=[v["next_nd"].input[0] for v in var_info])
     body_outs = [body_imp.graph_nodes[body_imp._key(v["next_nd"].input[0])]
                  for v in var_info]
     body_graph = nn.Graph(body_inputs, body_outs, name=f"{fr_name}_body")
@@ -1420,7 +1439,8 @@ def _convert_frame(imp: "_TFImporter", fr_name: str, nodes,
             sub.shapes[v["merge"].name] = var_shapes[i]
             inputs.append(node_in)
 
-    cond_imp, cond_inputs = sub_importer(seed_cond)
+    cond_imp, cond_inputs = sub_importer(seed_cond,
+                                         outputs=[loopcond.input[0]])
     pred_node = cond_imp.graph_nodes[cond_imp._key(loopcond.input[0])]
     cond_graph = nn.Graph(cond_inputs, [pred_node], name=f"{fr_name}_cond")
 
@@ -1513,8 +1533,8 @@ def _resolve_identity(node_index, ref: str) -> str:
         ref = nd.input[0]
 
 
-def _detect_cond_regions(gd, node_index, excluded: set, wanted: set,
-                         outputs) -> List[dict]:
+def _detect_cond_regions(node_list, node_index, excluded: set, wanted: set,
+                         outputs, stop: frozenset = frozenset()) -> List[dict]:
     """Standalone (non-frame) v1 tf.cond regions, grouped by predicate.
 
     A region = every Switch guarding on one predicate + the branch
@@ -1525,7 +1545,7 @@ def _detect_cond_regions(gd, node_index, excluded: set, wanted: set,
     left to the eager Switch-alias/MergeSelect fallback so behavior
     degrades rather than breaks.  Reference: utils/tf/loaders/
     ControlFlowOps.scala Switch/Merge + nn/tf/ControlOps.scala."""
-    switches = [n for n in gd.node
+    switches = [n for n in node_list
                 if n.op == "Switch" and n.name in wanted
                 and n.name not in excluded]
     if not switches:
@@ -1538,7 +1558,7 @@ def _detect_cond_regions(gd, node_index, excluded: set, wanted: set,
     # consumer adjacency built once: worklist propagation visits only the
     # branch subgraphs, not the whole GraphDef per predicate
     consumers: Dict[str, list] = {}
-    for n in gd.node:
+    for n in node_list:
         if n.name not in wanted:
             continue
         for ref in n.input:
@@ -1595,7 +1615,7 @@ def _detect_cond_regions(gd, node_index, excluded: set, wanted: set,
             for o in srcs:
                 union(first, o)
         merge_entries = []
-        for n in gd.node:
+        for n in node_list:
             if n.op != "Merge" or n.name not in wanted \
                     or n.name in excluded:
                 continue
@@ -1651,7 +1671,10 @@ def _detect_cond_regions(gd, node_index, excluded: set, wanted: set,
                         if not ref.startswith("^") and base not in members \
                                 and base not in sw_names:
                             ext.append(base)
-                anc = _ancestors(node_index, ext, set())
+                # `stop` cuts the walk at loop boundaries (a while
+                # body's back-edge would otherwise look like a false
+                # self-dependency through NextIteration -> this Merge)
+                anc = _ancestors(node_index, ext, set(stop))
                 ok = not (anc & {m.name for m in merges})
             if not ok or not merges:
                 continue
@@ -1828,8 +1851,9 @@ def load_tensorflow(pb_path: str, inputs: Sequence[str],
     frame_member_names = {n.name for nodes in frames.values() for n in nodes}
     # standalone Switch/Merge regions (v1 tf.cond) lower to structured
     # TFCond/lax.cond: only the taken branch runs and is differentiated
-    cond_regions = _detect_cond_regions(gd, node_index, frame_member_names,
-                                        wanted, outputs)
+    cond_regions = _detect_cond_regions(list(gd.node), node_index,
+                                        frame_member_names, wanted,
+                                        outputs)
     cond_member_names = set()
     for cr in cond_regions:
         cond_member_names |= set(cr["members"])

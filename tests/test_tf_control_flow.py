@@ -386,7 +386,8 @@ class TestCondInsideWhile:
         """A tf.cond INSIDE a while body (its Switch/Merge are frame
         members but not loop skeleton) imports: loop-var Merges are
         Merge(Enter, NextIteration); the body cond converts via the
-        sub-import's Switch/Merge path.  v' = sum(v) < 10 ? v*2 : v+1,
+        sub-import (structured TFCond when cleanly separable).
+        v' = sum(v) < 10 ? v*2 : v+1,
         4 iterations from [1, 1] -> [2,2] -> [4,4] -> [8,8] -> [9,9]."""
         import tf_graph_pb2 as tfp
 
@@ -427,6 +428,17 @@ class TestCondInsideWhile:
         with open(pb, "wb") as fh:
             fh.write(gd.SerializeToString())
         g, gp, gs = load_tensorflow(pb, ["x"], ["out"], [(2,)])
+        from bigdl_tpu.nn.tf_ops import TFCond, TFWhile
+
+        wh = [m for m in g.children.values() if isinstance(m, TFWhile)][0]
+        assert any(isinstance(m, TFCond)
+                   for m in wh.body_graph.flattened_modules()), \
+            "body cond should lower to structured TFCond/lax.cond"
         x = np.asarray([1.0, 1.0], np.float32)
         y = np.asarray(g.apply(gp, gs, jnp.asarray(x))[0])
         np.testing.assert_allclose(y, [9.0, 9.0])
+        # differentiable through scan(cond): d/dx (x * 2^3) = 8 on the
+        # taken-branch path
+        gr = jax.grad(lambda v: jnp.sum(g.apply(gp, gs, v)[0]))(
+            jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(gr), [8.0, 8.0])
