@@ -38,7 +38,31 @@ ITERS = 40  # ±4% run-to-run variance through the device tunnel; more
 # iterations tighten the estimate at ~10s extra wall time
 
 
+def _watchdog(seconds: float):
+    """A dead device tunnel hangs backend init forever; fail FAST with a
+    parseable artifact instead (the r02 bench failure mode was a silent
+    hang until the driver's own timeout)."""
+    import os
+    import threading
+
+    def _fire():
+        print(json.dumps({
+            "metric": "resnet50_imagenet_train_throughput",
+            "value": 0.0,
+            "unit": "images/sec/chip",
+            "vs_baseline": 0.0,
+            "error": f"device unreachable: no progress within {seconds:.0f}s "
+                     f"(TPU tunnel down?)"}), flush=True)
+        os._exit(3)
+
+    t = threading.Timer(seconds, _fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main():
+    watchdog = _watchdog(600.0)
     import jax
     import jax.numpy as jnp
 
@@ -96,6 +120,7 @@ def main():
     sync(params)  # depends on the final update: full chain executed
     dt = time.perf_counter() - t0
 
+    watchdog.cancel()
     img_s = BATCH * ITERS / dt
     print(json.dumps({
         "metric": "resnet50_imagenet_train_throughput",

@@ -643,3 +643,16 @@ class TestConverterWidening:
 
         ex.main()  # asserts drift bounds internally
         assert "weight-only int8" in capsys.readouterr().out
+
+    def test_ssd_detection_example(self, capsys):
+        import examples.ssd_detection_training as ex
+
+        ex.main()  # asserts loss halves internally
+        assert "multibox loss" in capsys.readouterr().out
+
+    def test_tf_finetune_checkpoint_example(self, capsys):
+        pytest.importorskip("tensorflow")
+        import examples.tf_finetune_checkpoint as ex
+
+        ex.main()  # asserts accuracy internally
+        assert "fine-tuned accuracy" in capsys.readouterr().out
