@@ -256,30 +256,37 @@ def _functional_model_from_config(spec):
     import bigdl_tpu.nn as nn
 
     cfg = spec["config"]
-    nodes: Dict[str, Any] = {}
+    # layer name -> list of application Nodes (keras node graph: a SHARED
+    # layer has one node per application; `[src, node_idx, tensor_idx]`
+    # refs select the application — weight sharing falls out of one
+    # module applied to several graph nodes, a single params entry)
+    nodes: Dict[str, list] = {}
     input_shapes: Dict[str, Any] = {}
+
+    def resolve(ref):
+        src, node_idx, tensor_idx = ref[0], ref[1], ref[2]
+        if tensor_idx:
+            raise ValueError(
+                f"inbound ref {ref}: non-zero tensor index — multi-output "
+                f"keras layers are unsupported")
+        apps = nodes[src]
+        if node_idx >= len(apps):
+            raise ValueError(f"inbound ref {ref}: layer {src!r} has only "
+                             f"{len(apps)} applications")
+        return apps[node_idx]
+
     for ld in cfg["layers"]:
         class_name, lcfg = ld["class_name"], ld["config"]
         lname = ld.get("name") or lcfg.get("name")
         inbound = ld.get("inbound_nodes") or []
         if class_name == "InputLayer":
-            nodes[lname] = nn.Input(name=lname)
+            nodes[lname] = [nn.Input(name=lname)]
             shp = lcfg.get("batch_input_shape")
             input_shapes[lname] = tuple(shp) if shp else None
             continue
-        if len(inbound) != 1:
-            raise ValueError(
-                f"layer {lname!r} is applied {len(inbound)} times — "
-                f"shared layers are unsupported")
-        ups = []
-        for ref in inbound[0]:
-            src, node_idx, tensor_idx = ref[0], ref[1], ref[2]
-            if node_idx or tensor_idx:
-                raise ValueError(
-                    f"layer {lname!r}: inbound ref {ref} uses a non-zero "
-                    f"node/tensor index — shared/multi-output layers are "
-                    f"unsupported")
-            ups.append(nodes[src])
+        if not inbound:
+            raise ValueError(f"non-input layer {lname!r} has no inbound "
+                             f"nodes")
         if class_name == "Merge" and not lcfg.get("layers"):
             # functional-style Merge: branches arrive via inbound edges,
             # so only the combine op is needed
@@ -304,11 +311,12 @@ def _functional_model_from_config(spec):
         else:
             module = _convert_layer(class_name, lcfg)
             module.name = lname
-        nodes[lname] = module(*ups)
+        nodes[lname] = [module(*[resolve(r) for r in node_refs])
+                        for node_refs in inbound]
     from bigdl_tpu.keras.topology import Model as KerasModel
 
-    graph_inputs = [nodes[r[0]] for r in cfg["input_layers"]]
-    outs = [nodes[r[0]] for r in cfg["output_layers"]]
+    graph_inputs = [resolve(r) for r in cfg["input_layers"]]
+    outs = [resolve(r) for r in cfg["output_layers"]]
     graph = KerasModel(graph_inputs, outs,
                        name=cfg.get("name") or "keras_model")
     # batch_input_shapes in declared input order, for load_keras_model

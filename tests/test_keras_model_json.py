@@ -125,23 +125,48 @@ class TestFunctionalModelJson:
         np.testing.assert_allclose(np.asarray(got), x + (x @ w + b),
                                    rtol=1e-5, atol=1e-5)
 
-    def test_shared_layer_rejected_loudly(self):
+    def test_shared_layer_siamese(self, tmp_path):
+        """A layer applied at TWO inbound nodes (keras shared layer /
+        siamese pattern): one module, one weight set, two applications —
+        node_index selects the application for downstream refs."""
+        rs = np.random.RandomState(4)
+        w, b = rs.randn(A, HID).astype(np.float32), \
+            rs.randn(HID).astype(np.float32)
         layers = [
             {"class_name": "InputLayer",
              "config": {"batch_input_shape": [None, A], "name": "in_a"},
              "name": "in_a", "inbound_nodes": []},
+            {"class_name": "InputLayer",
+             "config": {"batch_input_shape": [None, A], "name": "in_b"},
+             "name": "in_b", "inbound_nodes": []},
             {"class_name": "Dense",
              "config": {"output_dim": HID, "activation": "linear",
                         "name": "shared"},
              "name": "shared",
-             "inbound_nodes": [[["in_a", 0, 0]], [["in_a", 0, 0]]]},
+             "inbound_nodes": [[["in_a", 0, 0]], [["in_b", 0, 0]]]},
+            {"class_name": "Merge",
+             "config": {"mode": "sum", "name": "add"}, "name": "add",
+             "inbound_nodes": [[["shared", 0, 0], ["shared", 1, 0]]]},
         ]
         spec = {"class_name": "Model",
-                "config": {"name": "m", "layers": layers,
-                           "input_layers": [["in_a", 0, 0]],
-                           "output_layers": [["shared", 0, 0]]}}
-        with pytest.raises(ValueError, match="shared"):
-            model_from_json_config(spec)
+                "config": {"name": "siamese", "layers": layers,
+                           "input_layers": [["in_a", 0, 0],
+                                            ["in_b", 0, 0]],
+                           "output_layers": [["add", 0, 0]]}}
+        jpath = tmp_path / "m.json"
+        jpath.write_text(json.dumps(spec))
+        hpath = tmp_path / "w.h5"
+        _write_h5(hpath, {"in_a": [], "in_b": [], "shared": [w, b],
+                          "add": []})
+        model, params, state = load_keras_model(str(jpath), str(hpath))
+        assert list(params["shared"])  # ONE weight entry for both uses
+        xa = rs.randn(BATCH, A).astype(np.float32)
+        xb = rs.randn(BATCH, A).astype(np.float32)
+        got, _ = model.apply(params, state,
+                             Table(jnp.asarray(xa), jnp.asarray(xb)))
+        want = (xa @ w + b) + (xb @ w + b)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                                   atol=1e-5)
 
     def test_unknown_class_still_raises(self):
         with pytest.raises(ValueError, match="Sequential and functional"):
