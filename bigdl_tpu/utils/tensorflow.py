@@ -2203,3 +2203,46 @@ def add_const_int(gd, cname: str, arr: np.ndarray) -> str:
         t.tensor_shape.dim.add().size = s
     t.tensor_content = np.asarray(arr, np.int32).tobytes()
     return cname
+
+
+def summarize_graph(pb_path: str) -> Dict[str, Any]:
+    """Inspect a GraphDef before importing it — op histogram, inputs
+    (placeholders + declared shapes), variables, while frames, likely
+    output nodes (consumed by nothing).  The analogue of the reference's
+    `scripts/dump_tf_graph.py` inspection flow.
+
+    CLI: python -m bigdl_tpu.utils.tensorflow graph.pb
+    """
+    gd = tfp.GraphDef()
+    with open(pb_path, "rb") as f:
+        gd.ParseFromString(f.read())
+    ops: Dict[str, int] = {}
+    consumed = set()
+    placeholders, variables = [], []
+    frames = set()
+    for n in gd.node:
+        ops[n.op] = ops.get(n.op, 0) + 1
+        for i in n.input:
+            consumed.add(_clean(i))
+        if n.op == "Placeholder":
+            dims = [d.size for d in n.attr["shape"].shape.dim]
+            placeholders.append({"name": n.name, "shape": dims})
+        elif n.op in _VAR_OPS:
+            dims = [d.size for d in n.attr["shape"].shape.dim]
+            variables.append({"name": n.name, "op": n.op, "shape": dims})
+        elif n.op == "Enter":
+            frames.add(n.attr["frame_name"].s.decode())
+    leaf_ops_skip = ("Const", "NoOp", "Assign", "AssignVariableOp",
+                     "SaveV2", "RestoreV2", "Placeholder") + _VAR_OPS
+    outputs = [n.name for n in gd.node
+               if n.name not in consumed and n.op not in leaf_ops_skip]
+    return {"n_nodes": len(gd.node), "ops": dict(sorted(ops.items())),
+            "inputs": placeholders, "variables": variables,
+            "while_frames": sorted(frames), "likely_outputs": outputs}
+
+
+if __name__ == "__main__":  # pragma: no cover - thin CLI
+    import json as _json
+    import sys as _sys
+
+    print(_json.dumps(summarize_graph(_sys.argv[1]), indent=2))

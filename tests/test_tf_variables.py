@@ -270,3 +270,16 @@ class TestCheckpointWriter:
         sess._construct(["out"])
         with pytest.raises(ValueError, match="no Variables"):
             sess.save_checkpoint(str(tmp_path / "nope.ckpt"))
+
+
+class TestSummarizeGraph:
+    def test_reports_inputs_variables_frames_outputs(self, tmp_path):
+        from bigdl_tpu.utils.tensorflow import summarize_graph
+
+        pb, _, _, _ = _build_v1_conv_graph(tmp_path)
+        s = summarize_graph(pb)
+        assert [i["name"] for i in s["inputs"]] == ["x"]
+        assert {v["name"] for v in s["variables"]} == \
+            {"conv_w", "conv_b", "fc_w"}
+        assert "out" in s["likely_outputs"]
+        assert s["ops"]["VariableV2"] == 3
