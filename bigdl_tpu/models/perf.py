@@ -34,12 +34,16 @@ def build_model_and_shape(name: str, batch: int):
         return models.Vgg16(1000), (batch, 224, 224, 3), 1000
     if name == "resnet50":
         return models.resnet50(1000), (batch, 224, 224, 3), 1000
+    if name == "resnet50_fused":
+        # fused conv+BN-stats training variant (pallas epilogue kernel)
+        return models.resnet50(1000, fuse_bn=True), (batch, 224, 224, 3), 1000
     if name == "inception":
         return models.InceptionV1(1000), (batch, 224, 224, 3), 1000
     if name == "inception_v2":
         return models.InceptionV2(1000), (batch, 224, 224, 3), 1000
     raise ValueError(f"unknown model {name!r} "
-                     f"(lenet | vgg16 | resnet50 | inception | inception_v2)")
+                     f"(lenet | vgg16 | resnet50 | resnet50_fused | inception | "
+                     f"inception_v2)")
 
 
 def run_perf(model_name: str = "inception", batch_size: int = 32,
