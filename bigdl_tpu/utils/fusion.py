@@ -43,6 +43,20 @@ def _fold_pair(conv, conv_p, bn, bn_p, bn_s):
     return {"weight": new_w, "bias": new_b}
 
 
+def _fold_fused_module(m, p, s):
+    """SpatialConvolutionBN (the TRAINING-fused conv+BN, nn/conv.py) folds
+    alone: bake gamma/beta + running stats into a plain 1x1 conv."""
+    mean = jnp.asarray(s["running_mean"])
+    var = jnp.asarray(s["running_var"])
+    scale = jnp.asarray(p["gamma"]) / jnp.sqrt(var + m.eps)
+    new_w = jnp.asarray(p["weight"]) * scale  # HWIO: out channel last
+    new_b = -mean * scale + jnp.asarray(p["beta"])
+    fm = nn.SpatialConvolution(m.n_input, m.n_output, 1, 1,
+                               m.stride, m.stride, 0, 0, with_bias=True)
+    fm.name = m.name
+    return fm, {"weight": new_w, "bias": new_b}
+
+
 def _foldable(prev, cur) -> bool:
     if not isinstance(cur, nn.BatchNormalization):
         return False
@@ -77,11 +91,19 @@ def _fold_graph(g, params: Any, state: Any):
     for out in g.output_nodes:
         consumers[id(out)] += 1
 
-    fold_conv: dict = {}   # id(conv node) -> folded params
-    fold_bn: set = set()   # id(bn node)
+    fold_conv: dict = {}    # id(conv node) -> folded params
+    fold_bn: set = set()    # id(bn node)
+    fold_fused: dict = {}   # id(SpatialConvolutionBN node) -> (module, p)
     new_params, new_state = dict(params), dict(state)
     for node in g.topo:
         m = node.module
+        if isinstance(m, nn.SpatialConvolutionBN):
+            fm, fp = _fold_fused_module(m, params.get(node.name, {}),
+                                        state.get(node.name, {}))
+            fold_fused[id(node)] = fm
+            new_params[node.name] = fp
+            new_state[node.name] = {}
+            continue
         if m is None or not isinstance(m, nn.BatchNormalization):
             continue
         if len(node.prevs) != 1:
@@ -99,7 +121,7 @@ def _fold_graph(g, params: Any, state: Any):
         new_params[node.name] = {}
         new_state[node.name] = {}
 
-    if not fold_bn:
+    if not fold_bn and not fold_fused:
         return g, params, state
 
     mapping: dict = {}
@@ -112,7 +134,9 @@ def _fold_graph(g, params: Any, state: Any):
             new = nn.Input(name=node.name)
             new.name = node.name
         else:
-            if id(node) in fold_conv:
+            if id(node) in fold_fused:
+                mod = fold_fused[id(node)]
+            elif id(node) in fold_conv:
                 mod = _replacement_conv(node.module)
             elif id(node) in fold_bn:
                 mod = nn.Identity()
@@ -178,7 +202,11 @@ def fold_batchnorm(model: nn.Module, params: Any, state: Any
             out_keys += [key, bn_key]
             i += 2
             continue
-        if isinstance(m, (nn.Sequential, nn.Graph)):
+        if isinstance(m, nn.SpatialConvolutionBN):
+            fm, fp = _fold_fused_module(m, p, s)
+            new_model.children[key] = fm
+            new_params[key], new_state[key] = fp, {}
+        elif isinstance(m, (nn.Sequential, nn.Graph)):
             fm, fp, fs = fold_batchnorm(m, p, s)
             new_model.children[key] = fm
             new_params[key], new_state[key] = fp, fs

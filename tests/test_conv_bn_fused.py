@@ -190,3 +190,54 @@ class TestResNetFuseBn:
         gmax = max(float(jnp.max(jnp.abs(g)))
                    for g in jax.tree_util.tree_leaves(grads))
         assert np.isfinite(gmax) and gmax > 0
+
+
+class TestServingFold:
+    def test_fold_fused_module_matches_eval(self):
+        """A TRAINED SpatialConvolutionBN folds into one plain 1x1 conv
+        for serving (utils/fusion.fold_batchnorm), matching eval-mode
+        output exactly — the full train-fused -> serve-folded story."""
+        from bigdl_tpu.utils.fusion import fold_batchnorm
+
+        rs = np.random.RandomState(0)
+        model = nn.Sequential(nn.SpatialConvolutionBN(CIN, COUT, stride=2),
+                              nn.ReLU())
+        params, state, _ = model.build(jax.random.PRNGKey(0), (N, H, W, CIN))
+        # move params/stats off init so the fold is non-trivial
+        key = list(model.children)[0]
+        params[key]["gamma"] = jnp.asarray(rs.rand(COUT).astype(np.float32) + 0.5)
+        params[key]["beta"] = jnp.asarray(rs.randn(COUT).astype(np.float32))
+        x = jnp.asarray(rs.randn(N, H, W, CIN).astype(np.float32))
+        _, state = model.apply(params, state, x, training=True)
+
+        fm, fp, fs = fold_batchnorm(model, params, state)
+        assert not any(isinstance(m, nn.SpatialConvolutionBN)
+                       for m in fm.flattened_modules())
+        xe = jnp.asarray(rs.randn(N, H, W, CIN).astype(np.float32))
+        want, _ = model.apply(params, state, xe, training=False)
+        got, _ = fm.apply(fp, fs, xe, training=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fold_resnet50_fuse_bn_graph_blocks(self):
+        """resnet50(fuse_bn=True) folds end to end: every
+        SpatialConvolutionBN inside the bottleneck Graphs becomes a plain
+        conv, outputs match eval mode."""
+        from bigdl_tpu.models import resnet50
+        from bigdl_tpu.utils.fusion import fold_batchnorm
+
+        model = resnet50(class_num=8, fuse_bn=True)
+        params, state, _ = model.build(jax.random.PRNGKey(1), (2, 32, 32, 3))
+        rs = np.random.RandomState(2)
+        x = jnp.asarray(rs.rand(2, 32, 32, 3).astype(np.float32))
+        _, state = model.apply(params, state, x, training=True)
+
+        fm, fp, fs = fold_batchnorm(model, params, state)
+        remaining = [m for m in fm.flattened_modules()
+                     if isinstance(m, nn.SpatialConvolutionBN)]
+        assert not remaining
+        xe = jnp.asarray(rs.rand(2, 32, 32, 3).astype(np.float32))
+        want, _ = model.apply(params, state, xe, training=False)
+        got, _ = fm.apply(fp, fs, xe, training=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-3, atol=1e-4)
