@@ -13,6 +13,12 @@ weights once, deleting the BN's per-activation multiply/add entirely:
   b'    = (b - running_mean) * scale + beta
 
 Inference-only by construction (training BN uses batch statistics).
+
+Dtype note: folded weights keep the source dtype (fp32 by default) — a
+bf16 serving pipeline should cast the folded params once
+(`tree_map(lambda a: a.astype(jnp.bfloat16), params)`), exactly like any
+other conv net; the fused module's output-cast-to-input-dtype behavior
+is then preserved by the conv's own promotion rules.
 """
 
 from __future__ import annotations
@@ -93,7 +99,8 @@ def _fold_graph(g, params: Any, state: Any):
 
     fold_conv: dict = {}    # id(conv node) -> folded params
     fold_bn: set = set()    # id(bn node)
-    fold_fused: dict = {}   # id(SpatialConvolutionBN node) -> (module, p)
+    fold_fused: dict = {}   # id(SpatialConvolutionBN node) -> plain conv
+    #   (its folded params land in new_params under the node name)
     new_params, new_state = dict(params), dict(state)
     for node in g.topo:
         m = node.module
@@ -206,6 +213,13 @@ def fold_batchnorm(model: nn.Module, params: Any, state: Any
             fm, fp = _fold_fused_module(m, p, s)
             new_model.children[key] = fm
             new_params[key], new_state[key] = fp, {}
+        elif isinstance(m, nn.Remat):
+            # remat is a TRAINING device (recompute in backward); for the
+            # inference fold, unwrap and fold the inner block directly
+            fm, fp, fs = fold_batchnorm(m.inner, p.get("inner", {}),
+                                        s.get("inner", {}))
+            new_model.children[key] = fm
+            new_params[key], new_state[key] = fp, fs
         elif isinstance(m, (nn.Sequential, nn.Graph)):
             fm, fp, fs = fold_batchnorm(m, p, s)
             new_model.children[key] = fm

@@ -241,3 +241,22 @@ class TestServingFold:
         got, _ = fm.apply(fp, fs, xe, training=False)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-3, atol=1e-4)
+
+    def test_fold_unwraps_remat_blocks(self):
+        """resnet50(fuse_bn=True, remat=True): the serving fold unwraps
+        nn.Remat (a training-only device) and folds the inner blocks."""
+        from bigdl_tpu.models import resnet50
+        from bigdl_tpu.utils.fusion import fold_batchnorm
+
+        model = resnet50(class_num=8, fuse_bn=True, remat=True)
+        params, state, _ = model.build(jax.random.PRNGKey(1), (2, 32, 32, 3))
+        rs = np.random.RandomState(3)
+        x = jnp.asarray(rs.rand(2, 32, 32, 3).astype(np.float32))
+        _, state = model.apply(params, state, x, training=True)
+        fm, fp, fs = fold_batchnorm(model, params, state)
+        assert not any(isinstance(m, (nn.SpatialConvolutionBN, nn.Remat))
+                       for m in fm.flattened_modules())
+        want, _ = model.apply(params, state, x, training=False)
+        got, _ = fm.apply(fp, fs, x, training=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-3, atol=1e-4)
