@@ -117,7 +117,13 @@ class Session:
         for sparse_attr in ("Nsparse", "num_sparse"):
             if sparse_attr in nd.attr and int(nd.attr[sparse_attr].i):
                 raise NotImplementedError(
-                    "sparse ParseExample features are not supported")
+                    "this graph's ParseExample emits SPARSE "
+                    "(indices, values, shape) outputs, which in-graph "
+                    "consumers read as sparse ops — cutting there is "
+                    "unsupported.  Use the host sparse pipeline instead: "
+                    "ParsedExampleDataSet(..., sparse_features="
+                    "[VarLenFeature(...)]) feeding SparseLinear/"
+                    "LookupTableSparse (tests/test_sparse_parse.py)")
         # dense values are the parse op's outputs :0..:n-1 (no sparse)
         feat_keys = [k for k in dense_keys if k != label_key]
         cut_inputs, cut_shapes = [], []
