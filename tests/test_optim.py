@@ -720,3 +720,31 @@ class TestSyncBnPatchingDepth:
         assert seen["axis"] == ["data"] * len(nested_bns)
         # and restored afterwards
         assert all(m.axis_name is None for m in nested_bns)
+
+
+class TestAsyncDrainLogging:
+    def test_epoch_flush_throughput_is_sane(self, tmp_path):
+        """The async drain's burst flush at epoch end must reuse the
+        steady-state dt — a sub-millisecond pop gap must not log
+        million-records/s throughput to TrainSummary."""
+        from bigdl_tpu.dataset import ArrayDataSet, Sample, SampleToMiniBatch
+        from bigdl_tpu.optim.optimizer import Optimizer
+        from bigdl_tpu.utils.summary import TrainSummary
+
+        rs = np.random.RandomState(0)
+        xs = rs.rand(32, 6).astype(np.float32)
+        ys = (np.arange(32) % 3).astype(np.int32)
+        ds = ArrayDataSet([Sample.from_ndarray(x, y)
+                           for x, y in zip(xs, ys)]
+                          ).transform(SampleToMiniBatch(8))
+        model = nn.Sequential(nn.Linear(6, 3), nn.LogSoftMax())
+        opt = Optimizer(model, ds, nn.ClassNLLCriterion(),
+                        optim_method=SGD(learning_rate=0.1),
+                        end_trigger=Trigger.max_epoch(3))
+        summ = TrainSummary(str(tmp_path), "drain")
+        summ.set_summary_trigger("Throughput", 1)
+        opt.set_train_summary(summ)
+        opt.optimize()
+        vals = [v for _, v in summ.read_scalar("Throughput")]
+        assert len(vals) >= 6
+        assert all(np.isfinite(v) and 0 < v < 1e7 for v in vals), vals
