@@ -30,14 +30,24 @@ from bigdl_tpu.optim import Optimizer, Predictor, Evaluator, Trigger, Loss
 from bigdl_tpu.utils import TrainSummary, ValidationSummary
 
 
-def _to_minibatches(x: np.ndarray, y: Optional[np.ndarray],
-                    batch_size: int) -> List[MiniBatch]:
-    n = x.shape[0]
+def _rows(x) -> int:
+    return x[0].shape[0] if isinstance(x, (list, tuple)) else x.shape[0]
+
+
+def _take(x, idx):
+    """Row-slice an array or a LIST of arrays (keras multi-input x)."""
+    if isinstance(x, (list, tuple)):
+        return tuple(np.asarray(c[idx]) for c in x)
+    return np.asarray(x[idx])
+
+
+def _to_minibatches(x, y, batch_size: int) -> List[MiniBatch]:
+    n = _rows(x)
     out = []
     for off in range(0, n, batch_size):
-        xi = np.asarray(x[off:off + batch_size])
-        yi = None if y is None else np.asarray(y[off:off + batch_size])
-        out.append(MiniBatch(xi, yi))
+        sl = slice(off, off + batch_size)
+        yi = None if y is None else np.asarray(y[sl])
+        out.append(MiniBatch(_take(x, sl), yi))
     return out
 
 
@@ -59,7 +69,7 @@ class _ArrayTrainDataSet(DataSet):
     (the reference's DistributedDataSet shuffles per epoch,
     dataset/DataSet.scala:167)."""
 
-    def __init__(self, x: np.ndarray, y: np.ndarray, batch_size: int,
+    def __init__(self, x, y: np.ndarray, batch_size: int,
                  seed: int = 1):
         self.x, self.y = x, y
         self.batch_size = batch_size
@@ -67,15 +77,16 @@ class _ArrayTrainDataSet(DataSet):
         self._epoch = 0
 
     def size(self) -> int:
-        return self.x.shape[0]
+        return _rows(self.x)
 
     def data(self, train: bool):
         if not train:
             return iter(_to_minibatches(self.x, self.y, self.batch_size))
         perm = np.random.RandomState(self.seed + self._epoch).permutation(
-            self.x.shape[0])
+            _rows(self.x))
         self._epoch += 1
-        return iter(_to_minibatches(self.x[perm], self.y[perm], self.batch_size))
+        return iter(_to_minibatches(_take(self.x, perm), self.y[perm],
+                                    self.batch_size))
 
 
 class KerasTopology:
@@ -127,12 +138,14 @@ class KerasTopology:
         else:
             if y is None:
                 raise ValueError("fit(x, y) needs labels unless x is a DataSet")
+            if isinstance(x, (list, tuple)):  # keras multi-input x
+                x = tuple(np.asarray(c) for c in x)
             # drop-last so the jitted train step sees one static batch shape
-            n_full = (x.shape[0] // batch_size) * batch_size
+            n_full = (_rows(x) // batch_size) * batch_size
             if n_full == 0:
                 raise ValueError(
-                    f"fewer samples ({x.shape[0]}) than batch_size ({batch_size})")
-            dataset = _ArrayTrainDataSet(np.asarray(x[:n_full]),
+                    f"fewer samples ({_rows(x)}) than batch_size ({batch_size})")
+            dataset = _ArrayTrainDataSet(_take(x, slice(0, n_full)),
                                          np.asarray(y[:n_full]), batch_size)
         opt = Optimizer(model=self, dataset=dataset, criterion=self.criterion,
                         end_trigger=Trigger.max_epoch(nb_epoch), mesh=mesh,

@@ -86,7 +86,7 @@ class Graph(Container):
         return params, state, outs[0] if len(outs) == 1 else Table(*outs)
 
     def apply(self, params, state, x, *, training=False, rng=None):
-        xs = [x] if not isinstance(x, (list, Table)) else list(x)
+        xs = [x] if not isinstance(x, (list, tuple, Table)) else list(x)
         if len(xs) != len(self.input_nodes):
             raise ValueError(
                 f"graph has {len(self.input_nodes)} inputs, got {len(xs)} activities")

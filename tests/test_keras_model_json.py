@@ -171,3 +171,24 @@ class TestFunctionalModelJson:
     def test_unknown_class_still_raises(self):
         with pytest.raises(ValueError, match="Sequential and functional"):
             model_from_json_config({"class_name": "Nonsense", "config": {}})
+
+
+class TestMultiInputFit:
+    def test_fit_with_list_of_arrays(self, tmp_path):
+        """keras-1 signature: model.fit([xa, xb], y) on a converted
+        multi-input functional Model trains through the standard engine."""
+        jpath = tmp_path / "model.json"
+        jpath.write_text(json.dumps(_model_json()))
+        model, params, state = load_keras_model(str(jpath))
+        model.params, model.state = params, state
+        model.compile("sgd", "mse")
+        rs = np.random.RandomState(0)
+        n = 32
+        xa = rs.randn(n, A).astype(np.float32)
+        xb = rs.randn(n, B).astype(np.float32)
+        yt = rs.randn(n, OUT).astype(np.float32) * 0.1
+        model.fit([xa, xb], yt, batch_size=8, nb_epoch=5)
+        out, _ = model.apply(model.params, model.state,
+                             Table(jnp.asarray(xa), jnp.asarray(xb)))
+        loss = float(np.mean((np.asarray(out) - yt) ** 2))
+        assert np.isfinite(loss) and loss < 5.0, loss
