@@ -69,18 +69,38 @@ def basic_block(cin: int, cout: int, stride: int = 1) -> nn.Module:
 
 
 def bottleneck(cin: int, planes: int, stride: int = 1,
-               expansion: int = 4, fuse_bn: bool = False) -> nn.Module:
+               expansion: int = 4, fuse_bn: bool = False,
+               feat_w: int = None) -> nn.Module:
     """reference: models/resnet/ResNet.scala bottleneck; stride on the 3x3
     (v1.5) like TrainImageNet's mkldnn graph.
 
-    fuse_bn=True replaces every 1x1 conv+BN pair (the reduce, the 4C
-    expand, and the downsample shortcut) with `nn.SpatialConvolutionBN` —
+    fuse_bn=True replaces 1x1 conv+BN pairs (the reduce, the 4C expand,
+    and the stride-1 downsample shortcut) with `nn.SpatialConvolutionBN` —
     the pallas conv-epilogue-stats kernel that removes the BN stats-reduce
     HBM pass (BENCH_APPENDIX.md's named lever; reference fusion role:
-    nn/mkldnn/Fusion.scala:26-31)."""
+    nn/mkldnn/Fusion.scala:26-31).
+
+    `feat_w` is the static input feature-map width.  When given, a pair is
+    fused ONLY where the kernel's (N*H*W, C) <-> NHWC reshapes are layout
+    bitcasts — conv output width a multiple of 8 (the TPU sublane tile)
+    and stride 1.  Elsewhere (w=28/14/7 stages) the reshape is a genuine
+    retiling copy: two extra HBM passes per conv that cost more than the
+    stats read the fusion saves, and enough duplicate buffers to OOM a
+    b256 step (measured, BENCH_APPENDIX.md).  feat_w=None fuses every
+    pair (CPU/interpret tests, where there is no tiled layout)."""
     cout = planes * expansion
     inp = nn.Input()
-    if fuse_bn:
+
+    def _ok(w_out, conv_stride=1):
+        if not fuse_bn:
+            return False
+        if feat_w is None:
+            return True
+        return conv_stride == 1 and w_out is not None and w_out % 8 == 0
+
+    w_in = feat_w
+    w_mid = (feat_w - 1) // stride + 1 if feat_w is not None else None
+    if _ok(w_in):
         h = nn.SpatialConvolutionBN(cin, planes)(inp)
     else:
         h = _conv(cin, planes, 1)(inp)
@@ -89,13 +109,13 @@ def bottleneck(cin: int, planes: int, stride: int = 1,
     h = _conv(planes, planes, 3, stride, 1)(h)
     h = _bn(planes)(h)
     h = nn.ReLU()(h)
-    if fuse_bn:
+    if _ok(w_mid):
         h = nn.SpatialConvolutionBN(planes, cout, zero_gamma=True)(h)
     else:
         h = _conv(planes, cout, 1)(h)
         h = _bn(cout, zero_init=True)(h)
     if stride != 1 or cin != cout:
-        if fuse_bn:
+        if _ok(w_mid, stride):
             sc = nn.SpatialConvolutionBN(cin, cout, stride=stride)(inp)
         else:
             sc = _conv(cin, cout, 1, stride, 0)(inp)
@@ -137,13 +157,21 @@ def ResNet(depth: int = 50, class_num: int = 1000,
             nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1),
         ]
         cin = 64
+        # 224 input -> conv7/s2 -> 112 -> maxpool/s2 -> 56.  A width
+        # HINT for picking which pairs to fuse at trace time; if the
+        # model is built on a different resolution, conv1x1_bn_stats's
+        # runtime w%8 gate still falls back to the XLA path per conv, so
+        # a wrong hint costs nothing but a missed fusion.
+        feat_w = 56
         for stage, n_blocks in enumerate(blocks):
             planes = 64 * (2 ** stage)
             for b in range(n_blocks):
                 stride = 2 if (stage > 0 and b == 0) else 1
-                block = block_fn(cin, planes, stride, fuse_bn=fuse_bn) \
+                block = block_fn(cin, planes, stride, fuse_bn=fuse_bn,
+                                 feat_w=feat_w) \
                     if block_fn is bottleneck else block_fn(cin, planes,
                                                             stride)
+                feat_w = (feat_w - 1) // stride + 1
                 layers.append(nn.Remat(block) if remat else block)
                 cin = planes * expansion
         layers += [

@@ -378,7 +378,16 @@ class SpatialConvolutionBN(Module):
             mean, var = state["running_mean"], state["running_var"]
             new_state = state
         inv = lax.rsqrt(var + self.eps)
-        out = (y - mean) * inv * gamma + beta
+        # Scale/shift form: fold the BN affine into two per-channel
+        # vectors cast to y.dtype BEFORE touching y.  The naive
+        # (y - mean) * inv * gamma + beta upcasts the whole conv output
+        # to f32 and reverse-mode AD then keeps full-size f32 residuals
+        # ((y - mean) * inv for gamma_bar) — ~0.4 GB per wide layer,
+        # enough to blow HBM at b256.  With y * scale + shift the only
+        # AD residuals besides y itself are the per-channel vectors.
+        scale = (gamma * inv).astype(y.dtype)
+        shift = (beta - mean * gamma * inv).astype(y.dtype)
+        out = y * scale + shift
         return out.astype(x.dtype), new_state
 
 

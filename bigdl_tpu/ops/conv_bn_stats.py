@@ -28,9 +28,11 @@ Design (per /opt/skills/guides/pallas_guide.md):
     f32 (bf16 y introduces the same rounding the unfused path has).
 
 Backward (custom VJP): d/dy_total = ȳ + s̄1 + 2·y·s̄2 (s1 = Σy, s2 = Σy²),
-then the standard matmul cotangents x̄ = ȳ_tot·Wᵀ, W̄ = xᵀ·ȳ_tot — exact,
-so gradient parity with the unfused conv+BN is a test invariant, not an
-approximation.
+then the standard matmul cotangents x̄ = ȳ_tot·Wᵀ, W̄ = xᵀ·ȳ_tot.  The
+cotangent matmuls run in the INPUT dtype with f32 accumulation — the
+same precision class as the unfused conv backward (all-f32 matmuls were
+measured ~40% slower end-to-end), so gradient parity with the unfused
+conv+BN holds to that precision class, bit-exact when inputs are f32.
 """
 
 from __future__ import annotations
@@ -50,8 +52,10 @@ except Exception:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
-# v5e VMEM governor: bm*bk + bk*bn inputs + bm*bn f32 acc well under 16M
-DEFAULT_BLOCK_M = 512
+# v5e VMEM governor: bm*bk + bk*bn inputs + bm*bn f32 acc well under 16M.
+# bm=1024 measured best across all ResNet 1x1 shapes (min-of-3x50 sweep on
+# chip: 6-23% under both XLA and bm=512); bm=2048 regresses narrow-N.
+DEFAULT_BLOCK_M = 1024
 DEFAULT_BLOCK_N = 256
 DEFAULT_BLOCK_K = 256
 
@@ -86,6 +90,10 @@ def _kernel(x_ref, w_ref, y_ref, s1_ref, s2_ref, acc_ref):
             s2_ref[:] += p2
 
 
+def _pad_to_mult(v, mult):
+    return -(-v // mult) * mult
+
+
 def _pad_to(a, axis, mult):
     size = a.shape[axis]
     rem = size % mult
@@ -96,9 +104,24 @@ def _pad_to(a, axis, mult):
     return jnp.pad(a, pads)
 
 
+def _clamp_block(block, dim):
+    """Shrink a block size to the actual dim so small channel counts do
+    not pad 4x (e.g. N=64 under block_n=256 quadruples the y write and
+    the MXU work; measured 27% slower than XLA on the 256->64 reduce
+    conv).  A dim under the 128-lane width is used as-is — Mosaic pads
+    the VMEM tile internally, which wastes MXU lanes but avoids the HBM
+    pad copy a jnp.pad would cost."""
+    if dim >= block:
+        return block
+    return dim if dim <= 128 or dim % 128 == 0 else block
+
+
 def _matmul_stats_call(x, w, block_m, block_n, block_k, interpret):
     m, k = x.shape
     _, n = w.shape
+    block_n = _clamp_block(block_n, n)
+    block_k = _clamp_block(block_k, k)
+    block_m = min(block_m, _pad_to_mult(m, 8))
     xp = _pad_to(_pad_to(x, 0, block_m), 1, block_k)
     wp = _pad_to(_pad_to(w, 0, block_k), 1, block_n)
     mp, kp = xp.shape
@@ -147,9 +170,15 @@ def _matmul_stats_bwd(block_m, block_n, block_k, interpret, res, cot):
     g = (y_bar.astype(jnp.float32)
          + s1_bar[None, :]
          + 2.0 * y.astype(jnp.float32) * s2_bar[None, :])
-    x_bar = jnp.dot(g, w.astype(jnp.float32).T,
+    # the cotangent matmuls run in the INPUT dtype (bf16 on the bench
+    # path) with f32 accumulation — the same precision class as the
+    # unfused conv backward.  Keeping g in f32 here forces f32 MXU
+    # matmuls, several times slower than bf16 (measured: the all-f32
+    # backward cost the fused step ~40% end-to-end).
+    g = g.astype(x.dtype)
+    x_bar = jnp.dot(g, w.T,
                     preferred_element_type=jnp.float32).astype(x.dtype)
-    w_bar = jnp.dot(x.astype(jnp.float32).T, g,
+    w_bar = jnp.dot(x.T, g,
                     preferred_element_type=jnp.float32).astype(w.dtype)
     return x_bar, w_bar
 
@@ -165,16 +194,150 @@ def _dense_matmul_stats(x, w):
     return y.astype(x.dtype), jnp.sum(yf, 0), jnp.sum(yf * yf, 0)
 
 
+def _use_pallas(interpret: bool) -> bool:
+    """One place for the backend dispatch both entry points share."""
+    if not _HAS_PLTPU:
+        return False
+    return interpret or any(d.platform == "tpu" for d in jax.devices())
+
+
 def matmul_bn_stats(x, w, *, block_m: int = DEFAULT_BLOCK_M,
                     block_n: int = DEFAULT_BLOCK_N,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool = False
                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(M, K) × (K, N) -> (y, Σ_M y, Σ_M y²) in one HBM pass over y."""
-    on_tpu = any(d.platform == "tpu" for d in jax.devices())
-    if (not _HAS_PLTPU) or not (on_tpu or interpret):
+    if not _use_pallas(interpret):
         return _dense_matmul_stats(x, w)
     return _matmul_stats(x, w, block_m, block_n, block_k, interpret)
+
+
+# ---------------------------------------------------------------------------
+# 4D-native path: NHWC in, NHWC out.  The 2D matmul view above costs two
+# HBM retiling copies per conv on TPU (the (N*H*W, C) <-> NHWC reshapes are
+# NOT bitcasts under tiled layouts — measured +26 GB/step on the b256
+# ResNet-50 train step, turning the fusion into a 35% LOSS).  Here the
+# (bh*W, C) flattening happens on the VMEM block inside the kernel, where
+# it is a no-op relayout whenever W is a multiple of the 8-sublane tile,
+# and the backward is expressed as a 1x1 conv + dot_general so no reshape
+# ever touches HBM.
+# ---------------------------------------------------------------------------
+
+
+def _kernel4d(x_ref, w_ref, y_ref, s1_ref, s2_ref, acc_ref):
+    mi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    _, bh, wdim, bk = x_ref.shape
+    xb = x_ref[:].reshape(bh * wdim, bk)
+    acc_ref[:] += jnp.dot(xb, w_ref[:], preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _epilogue():
+        y = acc_ref[:]
+        y_ref[:] = y.reshape(y_ref.shape).astype(y_ref.dtype)
+        p1 = jnp.sum(y, axis=0, keepdims=True)
+        p2 = jnp.sum(y * y, axis=0, keepdims=True)
+
+        @pl.when(mi == 0)
+        def _first():
+            s1_ref[:] = p1
+            s2_ref[:] = p2
+
+        @pl.when(mi > 0)
+        def _accum():
+            s1_ref[:] += p1
+            s2_ref[:] += p2
+
+
+def _pick_bh(h: int, w: int, target_rows: int) -> int:
+    """Largest divisor of h with bh*w <= target rows (>=1)."""
+    best = 1
+    for bh in range(1, h + 1):
+        if h % bh == 0 and bh * w <= target_rows:
+            best = bh
+    return best
+
+
+def _conv_stats_call_4d(x, w2d, block_n, block_k, interpret):
+    n, h, wdim, cin = x.shape
+    cout = w2d.shape[1]
+    bn = _clamp_block(block_n, cout)
+    bk = _clamp_block(block_k, cin)
+    bh = _pick_bh(h, wdim, DEFAULT_BLOCK_M)
+    xp = _pad_to(x, 3, bk)
+    wp = _pad_to(_pad_to(w2d, 0, bk), 1, bn)
+    kp = xp.shape[3]
+    np_ = wp.shape[1]
+    grid = (np_ // bn, n * (h // bh), kp // bk)
+    h_blocks = h // bh
+    y, s1, s2 = pl.pallas_call(
+        _kernel4d,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bh, wdim, bk),
+                         lambda ni, mi, ki: (mi // h_blocks, mi % h_blocks,
+                                             0, ki)),
+            pl.BlockSpec((bk, bn), lambda ni, mi, ki: (ki, ni)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bh, wdim, bn),
+                         lambda ni, mi, ki: (mi // h_blocks, mi % h_blocks,
+                                             0, ni)),
+            pl.BlockSpec((1, bn), lambda ni, mi, ki: (0, ni)),
+            pl.BlockSpec((1, bn), lambda ni, mi, ki: (0, ni)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h, wdim, np_), x.dtype),
+            jax.ShapeDtypeStruct((1, np_), jnp.float32),
+            jax.ShapeDtypeStruct((1, np_), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bh * wdim, bn), jnp.float32)],
+        interpret=interpret,
+    )(xp, wp)
+    return y[..., :cout], s1[0, :cout], s2[0, :cout]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _conv_stats_4d(x, w2d, block_n, block_k, interpret):
+    return _conv_stats_call_4d(x, w2d, block_n, block_k, interpret)
+
+
+def _conv_stats_4d_fwd(x, w2d, block_n, block_k, interpret):
+    y, s1, s2 = _conv_stats_call_4d(x, w2d, block_n, block_k, interpret)
+    return (y, s1, s2), (x, w2d, y)
+
+
+def _conv_stats_4d_bwd(block_n, block_k, interpret, res, cot):
+    x, w2d, y = res
+    y_bar, s1_bar, s2_bar = cot
+    # stats cotangents fold into y's: s1 = Σ_nhw y, s2 = Σ_nhw y².
+    g = (y_bar.astype(jnp.float32)
+         + s1_bar[None, None, None, :]
+         + 2.0 * y.astype(jnp.float32) * s2_bar[None, None, None, :])
+    # bf16 matmuls with f32 accumulation — the unfused conv backward's
+    # precision class (all-f32 cotangent matmuls measured ~40% slower
+    # end-to-end).
+    g = g.astype(x.dtype)
+    cin, cout = w2d.shape
+    # x̄ = g ∗ Wᵀ as a 1x1 conv: stays NHWC, no reshape through HBM.
+    x_bar = jax.lax.conv_general_dilated(
+        g, w2d.T.reshape(1, 1, cout, cin), window_strides=(1, 1),
+        padding="VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    # W̄ = Σ_nhw x ⊗ g: dot_general contracting the spatial dims directly.
+    w_bar = jax.lax.dot_general(
+        x, g, (((0, 1, 2), (0, 1, 2)), ((), ())),
+        preferred_element_type=jnp.float32).astype(w2d.dtype)
+    return x_bar, w_bar
+
+
+_conv_stats_4d.defvjp(_conv_stats_4d_fwd, _conv_stats_4d_bwd)
 
 
 def conv1x1_bn_stats(x, w, *, stride: int = 1, interpret: bool = False
@@ -191,7 +354,15 @@ def conv1x1_bn_stats(x, w, *, stride: int = 1, interpret: bool = False
         x = x[:, ::stride, ::stride, :]
     n, h, ww, cin = x.shape
     cout = w.shape[3]
-    y2d, s1, s2 = matmul_bn_stats(x.reshape(n * h * ww, cin),
-                                  w.reshape(cin, cout),
-                                  interpret=interpret)
-    return y2d.reshape(n, h, ww, cout), s1, s2
+    # The pallas path is only profitable when the in-kernel (bh*W, C)
+    # flatten is a no-op relayout: W a multiple of the 8-sublane tile.
+    # Other widths re-enter the retiling-copy regime measured as a net
+    # loss (BENCH_APPENDIX.md), so they take the XLA path regardless of
+    # what the caller's width guess was — semantics are identical either
+    # way, this is purely a perf-safety gate.
+    if not _use_pallas(interpret) or ww % 8 != 0:
+        y2d, s1, s2 = _dense_matmul_stats(x.reshape(n * h * ww, cin),
+                                          w.reshape(cin, cout))
+        return y2d.reshape(n, h, ww, cout), s1, s2
+    return _conv_stats_4d(x, w.reshape(cin, cout), DEFAULT_BLOCK_N,
+                          DEFAULT_BLOCK_K, interpret)

@@ -174,7 +174,13 @@ class TestResNetFuseBn:
 
         fused = [m for m in walk(model)
                  if isinstance(m, nn.SpatialConvolutionBN)]
-        assert len(fused) == 36, len(fused)  # 2/bottleneck + 4 shortcuts
+        # Fusion is restricted to convs whose output width is a multiple
+        # of the 8-sublane tile at stride 1 (w=56 stage): elsewhere the
+        # kernel's NHWC boundary costs retiling copies that were measured
+        # to exceed the stats-read savings on chip (BENCH_APPENDIX.md).
+        # stage0: 3 blocks x (reduce+expand) + 1 stride-1 shortcut = 7,
+        # plus stage1 block0's reduce conv (input still 56) = 8.
+        assert len(fused) == 8, len(fused)
         params, state, _ = model.build(jax.random.PRNGKey(0), (2, 32, 32, 3))
         x = jnp.asarray(np.random.RandomState(0)
                         .randn(2, 32, 32, 3).astype(np.float32))
