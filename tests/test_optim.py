@@ -748,3 +748,53 @@ class TestAsyncDrainLogging:
         vals = [v for _, v in summ.read_scalar("Throughput")]
         assert len(vals) >= 6
         assert all(np.isfinite(v) and 0 < v < 1e7 for v in vals), vals
+
+
+class TestComputeDtypePolicy:
+    def test_bf16_policy_trains_with_f32_masters(self):
+        """compute_dtype=bfloat16 runs fwd/bwd in bf16 while params and
+        optimizer slots stay fp32 masters (the bench.py policy, now a
+        public builder feature)."""
+        import jax.numpy as jnp
+
+        ds = make_classification_dataset()
+        model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4),
+                              nn.LogSoftMax())
+        o = optim.LocalOptimizer(model, ds, nn.ClassNLLCriterion(),
+                                 optim_method=SGD(learning_rate=0.5),
+                                 end_trigger=Trigger.max_epoch(5),
+                                 compute_dtype=jnp.bfloat16)
+        o.optimize()
+        # masters stayed fp32
+        for leaf in jax.tree_util.tree_leaves(o.params):
+            assert leaf.dtype == jnp.float32, leaf.dtype
+        for leaf in jax.tree_util.tree_leaves(o.opt_state):
+            if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                         jnp.floating):
+                assert leaf.dtype == jnp.float32, leaf.dtype
+        # and the model still learned the task through bf16 compute
+        o.set_validation(Trigger.every_epoch(),
+                         make_classification_dataset(seed=1),
+                         [Top1Accuracy()])
+        acc = o.validate()[0].result()[0]
+        assert acc > 0.9, f"accuracy {acc}"
+
+    def test_bf16_policy_keeps_bn_state_f32(self):
+        import jax.numpy as jnp
+
+        rs = np.random.RandomState(0)
+        xs = rs.rand(64, 6).astype(np.float32)
+        ys = (np.arange(64) % 3).astype(np.int32)
+        ds = ArrayDataSet([Sample.from_ndarray(x, y) for x, y in zip(xs, ys)]
+                          ).transform(SampleToMiniBatch(16))
+        model = nn.Sequential(nn.Linear(6, 8), nn.BatchNormalization(8),
+                              nn.ReLU(), nn.Linear(8, 3), nn.LogSoftMax())
+        o = optim.LocalOptimizer(model, ds, nn.ClassNLLCriterion(),
+                                 optim_method=SGD(learning_rate=0.1),
+                                 end_trigger=Trigger.max_epoch(2),
+                                 compute_dtype=jnp.bfloat16)
+        o.optimize()
+        for leaf in jax.tree_util.tree_leaves(o.model_state):
+            if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                         jnp.floating):
+                assert leaf.dtype == jnp.float32, leaf.dtype
