@@ -86,6 +86,24 @@ def bench_resnet():
                                                        training=False)[0])
         results[mode] = _time_fn(qfwd, qp, state, x)
 
+    # the composed serving stack: fold conv+BN FIRST, then quantize —
+    # quantizing the unfolded model leaves f32 BN normalize passes
+    # between every dequant and the next quant (tested compose:
+    # tests/test_quantized.py round-3; this is the deployment path)
+    for mode in ("static", "weight_only"):
+        qm, qp = nn.quantize(fmodel, fparams, mode=mode)
+        if mode == "static":
+            qp = nn.calibrate(qm, qp, fstate,
+                              [jnp.asarray(rs.rand(8, image, image, 3),
+                                           jnp.float32)])
+        qfwd = jax.jit(lambda p, s, x, qm=qm: qm.apply(p, s, x,
+                                                       training=False)[0])
+        results[f"{mode}_bnfold"] = _time_fn(qfwd, qp, fstate, x)
+
+    # repeat the baseline last: the spread between the two bf16 runs is
+    # the run-to-run noise floor of the tunnel, printed for honesty
+    results["bf16_rep"] = _time_fn(fwd16, p16, state, x)
+
     for mode, ms in results.items():
         print(json.dumps({
             "workload": "resnet50_b256_infer", "mode": mode,

@@ -263,17 +263,34 @@ def _functional_model_from_config(spec):
     nodes: Dict[str, list] = {}
     input_shapes: Dict[str, Any] = {}
 
+    # (src, node_idx, tensor_idx) -> SelectTable node, so several refs to
+    # the same output component share one selector
+    select_cache: Dict[tuple, Any] = {}
+    # layer name -> number of outputs, for producers whose application
+    # yields a Table (nested multi-output Models): EVERY ref into one of
+    # those must select a component, including tensor index 0
+    multi_out: Dict[str, int] = {}
+
     def resolve(ref):
         src, node_idx, tensor_idx = ref[0], ref[1], ref[2]
-        if tensor_idx:
-            raise ValueError(
-                f"inbound ref {ref}: non-zero tensor index — multi-output "
-                f"keras layers are unsupported")
         apps = nodes[src]
         if node_idx >= len(apps):
             raise ValueError(f"inbound ref {ref}: layer {src!r} has only "
                              f"{len(apps)} applications")
-        return apps[node_idx]
+        if src not in multi_out:
+            if tensor_idx:
+                raise ValueError(
+                    f"inbound ref {ref}: non-zero tensor index into "
+                    f"single-output layer {src!r}")
+            return apps[node_idx]
+        # multi-output producer: its application yields a Table; the
+        # ref's tensor index picks the component (SelectTable is 1-based)
+        key = (src, node_idx, tensor_idx)
+        if key not in select_cache:
+            select_cache[key] = nn.SelectTable(
+                tensor_idx + 1,
+                name=f"{src}_out{tensor_idx}")(apps[node_idx])
+        return select_cache[key]
 
     for ld in cfg["layers"]:
         class_name, lcfg = ld["class_name"], ld["config"]
@@ -308,6 +325,17 @@ def _functional_model_from_config(spec):
             if combine is None:
                 raise ValueError(f"unsupported Merge mode {mode!r}")
             module = combine()
+        elif class_name in ("Model", "Sequential"):
+            # nested sub-model used as a layer (keras-1 allows Model
+            # composition; reference DefinitionLoader handles the nested
+            # node graph the same way) — one module, its application
+            # nodes below share the single weight set
+            module = model_from_json_config(ld)
+            module.name = lname
+            if class_name == "Model":
+                n_out = len(ld["config"].get("output_layers", []))
+                if n_out > 1:
+                    multi_out[lname] = n_out
         else:
             module = _convert_layer(class_name, lcfg)
             module.name = lname
@@ -375,19 +403,73 @@ def load_keras_hdf5_weights(model, params, state, h5_path: str):
             g = f[lname]
             wnames = _names(g.attrs.get("weight_names", []))
             if wnames:
-                groups.append((lname, [g[w][()] for w in wnames]))
+                groups.append((lname, wnames, [g[w][()] for w in wnames]))
     if not isinstance(model, nn.Graph):
         return load_keras_weights(model, params, state,
-                                  [ws for _, ws in groups])
-    for lname, ws in groups:
+                                  [ws for _, _, ws in groups])
+    for lname, wnames, ws in groups:
         child = model.children.get(lname)
         if child is None:
             raise ValueError(
                 f"hdf5 layer {lname!r} has no graph child of that name "
                 f"(children: {sorted(model.children)})")
-        params[lname], state[lname] = load_keras_weights(
-            child, params.get(lname, {}), state.get(lname, {}), [ws])
+        params[lname], state[lname] = _assign_group(
+            child, params.get(lname, {}), state.get(lname, {}), wnames, ws)
     return params, state
+
+
+# keras-1 weight-name suffixes, longest first ('_running_mean' before '_b')
+_KERAS1_WEIGHT_SUFFIXES = (
+    "_running_mean", "_running_std", "_embeddings", "_gamma", "_beta",
+    "_alphas", "_W", "_U", "_b",
+)
+
+
+def _split_group(wnames, ws):
+    """Split one hdf5 group's flat weight list into per-layer sublists by
+    the keras-1 '{layer_name}{suffix}' naming (a nested sub-model saves as
+    ONE group whose weight_names carry the inner layer names)."""
+    from collections import OrderedDict
+
+    def base(wn):
+        wn = wn.split("/")[-1]
+        if wn.endswith(":0"):
+            wn = wn[:-2]
+        for sf in _KERAS1_WEIGHT_SUFFIXES:
+            if wn.endswith(sf):
+                return wn[: -len(sf)]
+        return wn
+    sub: "OrderedDict[str, list]" = OrderedDict()
+    for wn, w in zip(wnames, ws):
+        sub.setdefault(base(wn), []).append(w)
+    return sub
+
+
+def _assign_group(child, p, s, wnames, ws):
+    """Assign one hdf5 layer group to a converted module: leaf layers take
+    the flat list; nested sub-models (Graph or Sequential containers) are
+    split by inner layer name — name-matched for Graphs, positional for
+    Sequentials (keras-1 save_weights order)."""
+    from bigdl_tpu import nn
+
+    if isinstance(child, nn.Graph):
+        sub = _split_group(wnames, ws)
+        for nname, nws in sub.items():
+            nchild = child.children.get(nname)
+            if nchild is None:
+                raise ValueError(
+                    f"nested model has no child {nname!r} for hdf5 weights "
+                    f"(children: {sorted(child.children)})")
+            p[nname], s[nname] = _assign_group(
+                nchild, p.get(nname, {}), s.get(nname, {}),
+                [wn for wn in wnames if wn.startswith(nname)], nws)
+        return p, s
+    from bigdl_tpu.nn.module import Container
+
+    if isinstance(child, Container):
+        sub = _split_group(wnames, ws)
+        return load_keras_weights(child, p, s, list(sub.values()))
+    return load_keras_weights(child, p, s, [ws])
 
 
 def load_keras_model(json_path: str, h5_path: str = None, *,

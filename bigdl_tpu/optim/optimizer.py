@@ -797,30 +797,49 @@ class ParallelOptimizer(DistriOptimizer):
 
         # flattened walk: residual-net BNs live nested inside Graph blocks
         # (a direct-children scan would silently skip them and lose the
-        # sync-BN semantics)
-        flat = self.model.flattened_modules()
-        bns = [m for m in flat
-               if isinstance(m, (BatchNormalization, SpatialConvolutionBN))]
-        # keras-adapter layers build their inner nn module LAZILY (during
-        # optimize itself), so a BN inside one is unreachable here — say so
-        # instead of silently dropping sync-BN (the keras path trains via
-        # Optimizer/fit(), where this does not apply)
-        lazy = [m for m in flat
-                if hasattr(m, "_make") and getattr(m, "inner", None) is None]
-        if lazy:
-            logger.warning(
-                "ParallelOptimizer sync-BN cannot reach modules inside "
-                "unbuilt keras-adapter layers (%s); any BatchNorm there "
-                "will use per-shard statistics",
-                ", ".join(type(m).__name__ for m in lazy[:3]))
-        saved = [m.axis_name for m in bns]
-        for m in bns:
-            m.set_axis_name(AXIS_DATA)
+        # sync-BN semantics).  keras-adapter layers build their inner nn
+        # module lazily during _init_model, so a second patch pass runs
+        # there (see _init_model below) — by then every inner exists.
+        self._syncbn_saved = []
+        self._patch_sync_bn()
         try:
             return super().optimize()
         finally:
-            for m, a in zip(bns, saved):
+            for m, a in self._syncbn_saved:
                 m.set_axis_name(a)
+            self._syncbn_saved = []
+
+    def _patch_sync_bn(self) -> None:
+        from bigdl_tpu.nn.conv import SpatialConvolutionBN
+        from bigdl_tpu.nn.norm import BatchNormalization
+
+        already = {id(m) for m, _ in self._syncbn_saved}
+        stack = list(self.model.flattened_modules())
+        visited = set()
+        while stack:
+            m = stack.pop()
+            if id(m) in visited:
+                continue
+            visited.add(id(m))
+            # keras-adapter layers hold their (lazily built) nn module as
+            # `.inner`, which flattened_modules deliberately skips; after
+            # _init_model it exists and its BNs need the axis too
+            inner = getattr(m, "inner", None)
+            if isinstance(inner, Module):
+                stack.extend(inner.flattened_modules())
+            if isinstance(m, (BatchNormalization, SpatialConvolutionBN)) \
+                    and id(m) not in already:
+                self._syncbn_saved.append((m, m.axis_name))
+                m.set_axis_name(AXIS_DATA)
+
+    def _init_model(self, first_batch) -> None:
+        super()._init_model(first_batch)
+        # lazily-built keras-adapter inners now exist; patch any BNs that
+        # appeared, BEFORE the step is traced.  Without this second pass a
+        # BN inside a keras layer silently trained on per-shard statistics
+        # (PARITY known-gap, now closed).
+        if getattr(self, "_syncbn_saved", None) is not None:
+            self._patch_sync_bn()
 
     def _build_step(self):
         model, criterion = self.model, self.criterion
