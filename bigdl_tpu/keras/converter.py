@@ -10,16 +10,16 @@ applies a `get_weights()`-style weight list (delegating layout fixes to
 Definition coverage spans the wrapper zoo: dense/conv 1-3D (incl. atrous/
 deconv/separable/locally-connected), pooling (incl. global, 1/2/3-D),
 padding/cropping/upsampling, Permute/RepeatVector, BatchNormalization,
-Embedding, recurrent (LSTM/GRU/SimpleRNN) + Bidirectional +
-TimeDistributed, advanced activations (LeakyReLU/ELU/PReLU/
-ThresholdedReLU), MaxoutDense, Highway, SpatialDropout1/2/3D.
-`get_weights()` import covers Dense, Convolution1/2/3D, Deconvolution2D,
-BatchNormalization, Embedding, LSTM (exact; keras-1 i,c,f,o gate order
-repacked) and SimpleRNN; GRU raises — keras-1 applies the reset gate
-before the hidden matmul, a different recurrence from the fused cell.
-Remaining classes convert definition-only and raise a clear error if
-weights are supplied for them.  Unsupported border modes raise instead of
-silently converting.
+Embedding, recurrent (LSTM/GRU/SimpleRNN/ConvLSTM2D) + Bidirectional +
+TimeDistributed(+Dense), advanced activations (LeakyReLU/ELU/PReLU/
+ThresholdedReLU/SReLU), MaxoutDense, Highway, SpatialDropout1/2/3D.
+`get_weights()` import covers every reference WeightsConverter family
+(pyspark/bigdl/keras/converter.py:110-281): Dense, Convolution1/2/3D,
+Atrous/Separable/Deconvolution, LocallyConnected1/2D, BatchNormalization,
+Embedding, LSTM / GRU / SimpleRNN / ConvLSTM2D (keras-1 gate orders
+repacked exactly; GRU via the reset-before cell), Bidirectional,
+TimeDistributed(+Dense), Highway, MaxoutDense, SReLU.  Unsupported border
+modes raise instead of silently converting.
 """
 
 from __future__ import annotations
@@ -90,6 +90,34 @@ def _convert_layer(class_name: str, cfg: Dict[str, Any]):
         inner_def = cfg["layer"]
         inner = _convert_layer(inner_def["class_name"], inner_def["config"])
         return KL.TimeDistributed(inner, input_shape=shape, name=name)
+    if class_name == "TimeDistributedDense":
+        # deprecated keras-1 alias for TimeDistributed(Dense); weights are
+        # plain Dense weights (reference convert_timedistributeddense)
+        return KL.TimeDistributed(
+            KL.Dense(cfg["output_dim"], activation=act,
+                     bias=cfg.get("bias", True)),
+            input_shape=shape, name=name)
+    if class_name == "ConvLSTM2D":
+        if cfg.get("dim_ordering", "tf") != "tf":
+            raise ValueError("only dim_ordering='tf' (NHWC) is supported")
+        if cfg.get("border_mode", "same") != "same":
+            raise ValueError("ConvLSTM2D supports border_mode='same' only "
+                             "(the hidden recurrence preserves the spatial "
+                             "shape)")
+        if cfg["nb_row"] != cfg["nb_col"]:
+            raise ValueError("ConvLSTM2D requires square kernels "
+                             "(reference: nn/keras/ConvLSTM2D.scala)")
+        if tuple(cfg.get("subsample", (1, 1))) != (1, 1):
+            raise ValueError("ConvLSTM2D supports subsample=(1, 1) only")
+        return KL.ConvLSTM2D(
+            cfg["nb_filter"], cfg["nb_row"],
+            return_sequences=cfg.get("return_sequences", False),
+            activation=cfg.get("activation", "tanh"),
+            inner_activation=cfg.get("inner_activation", "hard_sigmoid"),
+            input_shape=shape, name=name)
+    if class_name == "SReLU":
+        return KL.SReLU(shared_axes=cfg.get("shared_axes"),
+                        input_shape=shape, name=name)
     if class_name == "Convolution1D":
         if cfg.get("border_mode", "valid") != "valid":
             raise ValueError("Convolution1D supports border_mode='valid' only")
@@ -426,9 +454,12 @@ _KERAS1_WEIGHT_SUFFIXES = (
 
 
 def _split_group(wnames, ws):
-    """Split one hdf5 group's flat weight list into per-layer sublists by
-    the keras-1 '{layer_name}{suffix}' naming (a nested sub-model saves as
-    ONE group whose weight_names carry the inner layer names)."""
+    """Split one hdf5 group's flat weight list into per-layer (names,
+    weights) sublists by the keras-1 '{layer_name}{suffix}' naming (a
+    nested sub-model saves as ONE group whose weight_names carry the inner
+    layer names).  Returning the names alongside the weights keeps the
+    recursive assignment exact even when sibling layer names
+    prefix-collide ('conv' vs 'conv_bn')."""
     from collections import OrderedDict
 
     def base(wn):
@@ -439,9 +470,11 @@ def _split_group(wnames, ws):
             if wn.endswith(sf):
                 return wn[: -len(sf)]
         return wn
-    sub: "OrderedDict[str, list]" = OrderedDict()
+    sub: "OrderedDict[str, Tuple[list, list]]" = OrderedDict()
     for wn, w in zip(wnames, ws):
-        sub.setdefault(base(wn), []).append(w)
+        names, weights = sub.setdefault(base(wn), ([], []))
+        names.append(wn)
+        weights.append(w)
     return sub
 
 
@@ -453,22 +486,21 @@ def _assign_group(child, p, s, wnames, ws):
     from bigdl_tpu import nn
 
     if isinstance(child, nn.Graph):
-        sub = _split_group(wnames, ws)
-        for nname, nws in sub.items():
+        for nname, (nnames, nws) in _split_group(wnames, ws).items():
             nchild = child.children.get(nname)
             if nchild is None:
                 raise ValueError(
                     f"nested model has no child {nname!r} for hdf5 weights "
                     f"(children: {sorted(child.children)})")
             p[nname], s[nname] = _assign_group(
-                nchild, p.get(nname, {}), s.get(nname, {}),
-                [wn for wn in wnames if wn.startswith(nname)], nws)
+                nchild, p.get(nname, {}), s.get(nname, {}), nnames, nws)
         return p, s
     from bigdl_tpu.nn.module import Container
 
     if isinstance(child, Container):
         sub = _split_group(wnames, ws)
-        return load_keras_weights(child, p, s, list(sub.values()))
+        return load_keras_weights(child, p, s,
+                                  [weights for _, weights in sub.values()])
     return load_keras_weights(child, p, s, [ws])
 
 

@@ -726,21 +726,29 @@ class SeparableConvolution2D(KerasLayer):
 
 class ConvLSTM2D(KerasLayer):
     """Convolutional LSTM over (N, T, H, W, C).
-    reference: nn/keras/ConvLSTM2D.scala (square kernels, stride 1)."""
+    reference: nn/keras/ConvLSTM2D.scala (square kernels, stride 1,
+    withPeephole=false — keras-1 ConvLSTM2D has no peepholes)."""
 
     def __init__(self, nb_filter: int, nb_kernel: int,
                  return_sequences: bool = False,
+                 activation: str = "tanh",
+                 inner_activation: str = "hard_sigmoid",
                  input_shape: Optional[Sequence[int]] = None,
                  name: Optional[str] = None):
         super().__init__(input_shape, name)
         self.nb_filter = nb_filter
         self.nb_kernel = nb_kernel
         self.return_sequences = return_sequences
+        self.activation = activation
+        self.inner_activation = inner_activation
 
     def _make(self, input_shape):
         _, t = input_shape[0], input_shape[1]
         cell = nn.ConvLSTMPeephole(input_shape[-1], self.nb_filter,
-                                   self.nb_kernel, self.nb_kernel)
+                                   self.nb_kernel, self.nb_kernel,
+                                   with_peephole=False,
+                                   gate_activation=self.inner_activation,
+                                   activation=self.activation)
         rec = nn.Recurrent(cell)
         if self.return_sequences:
             return rec
@@ -953,14 +961,23 @@ class ELU(KerasLayer):
 
 
 class PReLU(KerasLayer):
-    """Advanced activation (learned slopes). reference: nn/keras/PReLU.scala."""
+    """Advanced activation: one learned slope per ELEMENT over the feature
+    shape (keras-1 PReLU semantics).  reference: nn/keras/PReLU.scala."""
 
     def _make(self, input_shape):
-        return nn.PReLU(input_shape[-1])
+        return nn.PReLU(shape=tuple(input_shape[1:]))
 
 
 class SReLU(KerasLayer):
-    """S-shaped ReLU. reference: nn/keras/SReLU.scala."""
+    """S-shaped ReLU with learned per-element params over the full feature
+    shape (keras-1 default), optionally shared along `shared_axes`.
+    reference: nn/keras/SReLU.scala (SharedAxes default null)."""
+
+    def __init__(self, shared_axes: Optional[Sequence[int]] = None,
+                 input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(input_shape, name)
+        self.shared_axes = tuple(shared_axes) if shared_axes else None
 
     def _make(self, input_shape):
-        return nn.SReLU((input_shape[-1],))
+        return nn.SReLU(tuple(input_shape[1:]), share_axes=self.shared_axes)

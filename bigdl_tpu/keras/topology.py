@@ -35,7 +35,8 @@ def _rows(x) -> int:
 
 
 def _take(x, idx):
-    """Row-slice an array or a LIST of arrays (keras multi-input x)."""
+    """Row-slice an array or a LIST of arrays (keras multi-input x /
+    multi-output y)."""
     if isinstance(x, (list, tuple)):
         return tuple(np.asarray(c[idx]) for c in x)
     return np.asarray(x[idx])
@@ -46,7 +47,7 @@ def _to_minibatches(x, y, batch_size: int) -> List[MiniBatch]:
     out = []
     for off in range(0, n, batch_size):
         sl = slice(off, off + batch_size)
-        yi = None if y is None else np.asarray(y[sl])
+        yi = None if y is None else _take(y, sl)
         out.append(MiniBatch(_take(x, sl), yi))
     return out
 
@@ -85,7 +86,7 @@ class _ArrayTrainDataSet(DataSet):
         perm = np.random.RandomState(self.seed + self._epoch).permutation(
             _rows(self.x))
         self._epoch += 1
-        return iter(_to_minibatches(_take(self.x, perm), self.y[perm],
+        return iter(_to_minibatches(_take(self.x, perm), _take(self.y, perm),
                                     self.batch_size))
 
 
@@ -95,19 +96,46 @@ class KerasTopology:
     def compile(self, optimizer: Union[str, Any], loss: Union[str, Any],
                 metrics: Optional[Sequence[Any]] = None) -> None:
         self.optim_method = resolve_optimizer(optimizer)
-        self.criterion = resolve_loss(loss)
+        # multi-output functional Models (keras semantics,
+        # nn/keras/Topology.scala:55-158): a LIST of losses pairs one per
+        # output head; a single loss repeats across heads; totals sum
+        n_out = len(getattr(self, "output_nodes", ()) or ()) or 1
+        if isinstance(loss, (list, tuple)) and len(loss) != n_out:
+            raise ValueError(f"{len(loss)} losses for {n_out} outputs")
+        if isinstance(loss, (list, tuple)) or n_out > 1:
+            from bigdl_tpu.nn.criterion import ParallelCriterion
+            items = (list(loss) if isinstance(loss, (list, tuple))
+                     else [loss] * n_out)
+            pc = ParallelCriterion()
+            for item in items:
+                pc.add(resolve_loss(item))
+            self.criterion = pc
+        else:
+            self.criterion = resolve_loss(loss)
         # keras semantics: the GENERIC 'accuracy'/'acc' string under
         # binary_crossentropy means elementwise binary accuracy; explicit
         # Top1Accuracy instances (or 'top1') are honored as requested
         from bigdl_tpu.nn.criterion import BCECriterion
-        from bigdl_tpu.optim.validation import BinaryAccuracy
+        from bigdl_tpu.optim.validation import BinaryAccuracy, Loss
         resolved = []
-        for m in (metrics or []):
-            if (isinstance(m, str) and m.lower() in ("accuracy", "acc")
-                    and isinstance(self.criterion, BCECriterion)):
-                resolved.append(BinaryAccuracy())
-            else:
-                resolved.extend(resolve_metrics([m]))
+        if n_out > 1:
+            bad = [m for m in (metrics or [])
+                   if not isinstance(m, Loss) and m != "loss"]
+            if bad:
+                raise ValueError(
+                    f"metrics {bad!r} are per-tensor and this Model has "
+                    f"{n_out} outputs (a Table) — multi-output models "
+                    f"support only loss-type metrics; evaluate() reports "
+                    f"the summed multi-head loss")
+            resolved = [m if isinstance(m, Loss) else Loss(self.criterion)
+                        for m in (metrics or [])]
+        else:
+            for m in (metrics or []):
+                if (isinstance(m, str) and m.lower() in ("accuracy", "acc")
+                        and isinstance(self.criterion, BCECriterion)):
+                    resolved.append(BinaryAccuracy())
+                else:
+                    resolved.extend(resolve_metrics([m]))
         self.metrics = resolved
         # a re-compile changes loss/metrics: drop cached compiled programs
         self._evaluator = None
@@ -140,13 +168,16 @@ class KerasTopology:
                 raise ValueError("fit(x, y) needs labels unless x is a DataSet")
             if isinstance(x, (list, tuple)):  # keras multi-input x
                 x = tuple(np.asarray(c) for c in x)
+            if isinstance(y, (list, tuple)):  # keras multi-output y
+                y = tuple(np.asarray(c) for c in y)
             # drop-last so the jitted train step sees one static batch shape
             n_full = (_rows(x) // batch_size) * batch_size
             if n_full == 0:
                 raise ValueError(
                     f"fewer samples ({_rows(x)}) than batch_size ({batch_size})")
             dataset = _ArrayTrainDataSet(_take(x, slice(0, n_full)),
-                                         np.asarray(y[:n_full]), batch_size)
+                                         _take(y, slice(0, n_full)),
+                                         batch_size)
         opt = Optimizer(model=self, dataset=dataset, criterion=self.criterion,
                         end_trigger=Trigger.max_epoch(nb_epoch), mesh=mesh,
                         sharding_rules=sharding_rules,

@@ -88,13 +88,21 @@ class LeakyReLU(_Elementwise):
 
 
 class PReLU(Module):
-    """Learnable negative slope per channel. reference: nn/PReLU.scala."""
+    """Learnable negative slope per channel. reference: nn/PReLU.scala.
+    `shape` overrides the per-channel layout with an explicit broadcastable
+    alpha shape (keras-1 PReLU learns one slope per ELEMENT over the full
+    feature shape)."""
 
-    def __init__(self, n_output_plane: int = 0, name: Optional[str] = None):
+    def __init__(self, n_output_plane: int = 0, shape=None,
+                 name: Optional[str] = None):
         super().__init__(name)
         self.n_output_plane = n_output_plane  # 0 = single shared slope
+        self.shape = tuple(shape) if shape else None
 
     def build(self, rng, input_shape):
+        if self.shape is not None:
+            return ({"weight": jnp.full(self.shape, 0.25, jnp.float32)},
+                    {}, input_shape)
         n = self.n_output_plane if self.n_output_plane > 0 else 1
         return {"weight": jnp.full((n,), 0.25, jnp.float32)}, {}, input_shape
 

@@ -371,6 +371,7 @@ class ConvLSTMPeephole(Cell):
 
     def __init__(self, input_size: int, output_size: int, kernel_i: int = 3,
                  kernel_c: int = 3, stride: int = 1, with_peephole: bool = True,
+                 gate_activation: str = "sigmoid", activation: str = "tanh",
                  name: Optional[str] = None):
         super().__init__(name)
         assert stride == 1, "ConvLSTM hidden recurrence requires stride 1"
@@ -379,6 +380,10 @@ class ConvLSTMPeephole(Cell):
         self.kernel_i = kernel_i
         self.kernel_c = kernel_c
         self.with_peephole = with_peephole
+        # string names so imported keras-1 ConvLSTM2D models (default
+        # inner_activation='hard_sigmoid') compute exactly
+        self.gate_activation = gate_activation
+        self.activation = activation
         self._spatial: Optional[Tuple[int, ...]] = None
 
     def build(self, rng, input_shape):
@@ -410,6 +415,8 @@ class ConvLSTMPeephole(Cell):
 
     def step(self, params, x_t, hidden):
         h_prev, c_prev = hidden[1], hidden[2]
+        sig = _resolve_activation(self.gate_activation)
+        act = _resolve_activation(self.activation)
         ones = (1,) * self._rank
         gates = (
             lax.conv_general_dilated(x_t, params["w_ih"], ones, "SAME",
@@ -420,18 +427,18 @@ class ConvLSTMPeephole(Cell):
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         if self.with_peephole:
             p_i, p_f, p_o = params["peep"][0], params["peep"][1], params["peep"][2]
-            i = jax.nn.sigmoid(i + p_i * c_prev)
-            f = jax.nn.sigmoid(f + p_f * c_prev)
+            i = sig(i + p_i * c_prev)
+            f = sig(f + p_f * c_prev)
         else:
-            i = jax.nn.sigmoid(i)
-            f = jax.nn.sigmoid(f)
-        g = jnp.tanh(g)
+            i = sig(i)
+            f = sig(f)
+        g = act(g)
         c = f * c_prev + i * g
         if self.with_peephole:
-            o = jax.nn.sigmoid(o + p_o * c)
+            o = sig(o + p_o * c)
         else:
-            o = jax.nn.sigmoid(o)
-        h = o * jnp.tanh(c)
+            o = sig(o)
+        h = o * act(c)
         return h, Table(h, c)
 
 

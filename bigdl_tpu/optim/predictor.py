@@ -210,6 +210,8 @@ class Evaluator:
     def _put_batch(self, x):
         if isinstance(x, Table):
             return Table(*[self._put_batch(v) for v in x])
+        if isinstance(x, (tuple, list)):  # multi-io batches
+            return type(x)(self._put_batch(v) for v in x)
         if self.mesh is None:
             return jnp.asarray(np.asarray(x))
         return jax.device_put(jnp.asarray(np.asarray(x)),

@@ -100,7 +100,9 @@ class Loss(ValidationMethod):
         self.criterion = criterion
 
     def batch(self, output, target):
-        n = output.shape[0]
+        from bigdl_tpu.core.table import Table
+        first = output[1] if isinstance(output, Table) else output
+        n = first.shape[0]
         val = self.criterion.forward(output, target)
         # mean-reducing criteria contribute mean*n (so merge yields the
         # dataset mean); sum-reducing ones already carry the batch total

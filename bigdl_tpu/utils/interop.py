@@ -250,6 +250,52 @@ def _importer_for(m: Module):
     raise ValueError(f"no torch importer for {type(m).__name__}")
 
 
+def _deep_merge(dst: Any, patch: Any) -> Any:
+    """Merge a (possibly nested) params patch over an existing subtree;
+    non-dict patch values (arrays) replace."""
+    if not isinstance(patch, dict):
+        return patch
+    out = dict(dst) if isinstance(dst, dict) else {}
+    for k, v in patch.items():
+        out[k] = _deep_merge(out.get(k, {}), v)
+    return out
+
+
+def _apply_patches(module: Module, params: Any, state: Any,
+                   converted: Dict[int, Tuple[Any, Any]]) -> Tuple[Any, Any]:
+    """Walk the module tree applying per-module (params, state) patches
+    keyed by id(module).  Patches mirror the params-tree structure at the
+    target (flat for leaves, nested for composite layers).  Returns NEW
+    trees; inputs are not mutated."""
+    from bigdl_tpu.keras.layers import KerasLayer  # local: avoid cycle
+
+    def rebuild(m: Module, p: Any, s: Any) -> Tuple[Any, Any]:
+        if id(m) in converted:
+            cp, cs = converted[id(m)]
+            return _deep_merge(p, cp), _deep_merge(s, cs)
+        if isinstance(m, KerasLayer):
+            return rebuild(m.inner, p, s)
+        if isinstance(m, TimeDistributed):
+            ip, is_ = rebuild(m.inner, p.get("inner", {}), s.get("inner", {}))
+            return {**p, "inner": ip}, {**s, "inner": is_}
+        if isinstance(m, Recurrent):
+            if id(m.cell) in converted:
+                # Recurrent nests the cell's params under "cell"
+                cp, cs = converted[id(m.cell)]
+                new_p = dict(p)
+                new_p["cell"] = _deep_merge(p.get("cell", {}), cp)
+                return new_p, s
+            return p, s
+        if isinstance(m, Container):
+            new_p, new_s = dict(p), dict(s)
+            for key, c in m.children.items():
+                new_p[key], new_s[key] = rebuild(c, p.get(key, {}), s.get(key, {}))
+            return new_p, new_s
+        return p, s
+
+    return rebuild(module, params, state)
+
+
 def import_torch_state_dict(module: Module, params: Any, state: Any,
                             state_dict: Dict[str, Any],
                             approximate: bool = False,
@@ -276,36 +322,7 @@ def import_torch_state_dict(module: Module, params: Any, state: Any,
         return fn(m, g)
 
     converted = {id(m): _convert(m, g) for m, g in zip(leaves, groups)}
-
-    from bigdl_tpu.keras.layers import KerasLayer  # local: avoid cycle
-
-    def rebuild(m: Module, p: Any, s: Any) -> Tuple[Any, Any]:
-        if isinstance(m, KerasLayer):
-            return rebuild(m.inner, p, s)
-        if isinstance(m, TimeDistributed):
-            ip, is_ = rebuild(m.inner, p.get("inner", {}), s.get("inner", {}))
-            return {**p, "inner": ip}, {**s, "inner": is_}
-        if isinstance(m, Recurrent):
-            cp, cs = converted[id(m.cell)]
-            # Recurrent nests the cell's params under "cell"
-            new_p = dict(p)
-            new_p["cell"] = cp
-            return new_p, s
-        if isinstance(m, Container):
-            new_p, new_s = dict(p), dict(s)
-            for key, c in m.children.items():
-                new_p[key], new_s[key] = rebuild(c, p.get(key, {}), s.get(key, {}))
-            return new_p, new_s
-        if id(m) in converted:
-            cp, cs = converted[id(m)]
-            merged_p = dict(p) if isinstance(p, dict) else {}
-            merged_p.update(cp)
-            merged_s = dict(s) if isinstance(s, dict) else {}
-            merged_s.update(cs)
-            return merged_p, merged_s
-        return p, s
-
-    return rebuild(module, params, state)
+    return _apply_patches(module, params, state, converted)
 
 
 # ---------------------------------------------------------------------------
@@ -374,125 +391,386 @@ def export_torch_state_dict(module: Module, params: Any, state: Any
 
 
 # ---------------------------------------------------------------------------
-# Keras weight import (reference: pyspark/bigdl/keras/converter.py — here
-# from layer.get_weights() lists rather than HDF5 internals)
+# Keras weight import (reference: pyspark/bigdl/keras/converter.py
+# WeightsConverter:110-281 — here from layer.get_weights() lists rather
+# than HDF5 internals; every WeightsConverter family is covered)
 # ---------------------------------------------------------------------------
+
+
+def _keras_cell_patch(cell, ws, where: str):
+    """keras-1 trainable_weights of ONE recurrent keras layer -> cell
+    params.  Used standalone (LSTM/GRU/SimpleRNN/ConvLSTM2D) and per
+    direction by Bidirectional (reference convert_bidirectional splits the
+    list in half)."""
+    from bigdl_tpu.nn.recurrent import ConvLSTMPeephole
+
+    ws = [np.asarray(w) for w in ws]
+    if isinstance(cell, ConvLSTMPeephole):
+        # keras-1 ConvLSTM2D trainable_weights: (W,U,b) per gate listed in
+        # i, c, f, o order, like LSTM (derived from the reference's
+        # convert_convlstm2d index map against the Scala ConvLSTMPeephole
+        # parameter order f,i,c,o).  Kernels are already HWIO ('tf'
+        # ordering) — concat along the output-channel axis in our i,f,g,o
+        # split order.
+        if len(ws) != 12:
+            raise ValueError(
+                f"{where}: expected 12 keras-1 ConvLSTM2D weights "
+                f"(W,U,b x 4 gates), got {len(ws)}")
+        gate = {"i": 0, "c": 3, "f": 6, "o": 9}
+        order = ["i", "f", "c", "o"]  # our gate split order (i, f, g, o)
+        p = {"w_ih": jnp.asarray(np.concatenate(
+                 [ws[gate[g]] for g in order], axis=-1)),
+             "w_hh": jnp.asarray(np.concatenate(
+                 [ws[gate[g] + 1] for g in order], axis=-1)),
+             "bias": jnp.asarray(np.concatenate(
+                 [ws[gate[g] + 2] for g in order]))}
+        if cell.with_peephole:
+            # keras-1 ConvLSTM2D has no peepholes; zeros disable them
+            p["peep"] = jnp.zeros((3, cell.hidden_size), jnp.float32)
+        return p, {}
+    if isinstance(cell, LSTMCell):
+        # keras-1 LSTM trainable_weights order: (W,U,b) per gate in
+        # i, c, f, o order (keras/layers/recurrent.py build()); our
+        # packing is i, f, g(c), o like torch — reorder and pack.
+        # Same cell math (standard LSTM), so the import is exact.
+        if len(ws) != 12:
+            raise ValueError(
+                f"{where}: expected 12 keras-1 LSTM weights (W,U,b x "
+                f"4 gates, consume_less='cpu'/'mem'), got {len(ws)}")
+        gate = {"i": 0, "c": 3, "f": 6, "o": 9}
+        order = ["i", "f", "c", "o"]
+        g = {"weight_ih_l0": np.concatenate(
+                 [ws[gate[x]].T for x in order], axis=0),
+             "weight_hh_l0": np.concatenate(
+                 [ws[gate[x] + 1].T for x in order], axis=0),
+             "bias_ih_l0": np.concatenate(
+                 [ws[gate[x] + 2] for x in order])}
+        return _import_lstm_cell(cell, g)
+    if isinstance(cell, GRUCell):
+        if cell.reset_after:
+            raise ValueError(
+                f"{where}: keras-1 GRU applies the reset gate BEFORE "
+                f"the hidden matmul (tanh(x W + (r*h) U)); the fused "
+                f"reset-after cell applies it after (torch convention) "
+                f"— build the model with GRUCell(reset_after=False) "
+                f"for an EXACT import")
+        # keras-1.2.2 GRU trainable_weights: (W,U,b) per gate in
+        # z, r, h build order (keras/layers/recurrent.py GRU.build);
+        # our packed order is r, z, n — reorder and pack.  Same math
+        # as the reset_after=False cell, so the import is exact.
+        if len(ws) != 9:
+            raise ValueError(
+                f"{where}: expected 9 keras-1 GRU weights (W,U,b x "
+                f"3 gates), got {len(ws)}")
+        gate = {"z": 0, "r": 3, "h": 6}
+        order = ["r", "z", "h"]
+        g = {"weight_ih_l0": np.concatenate(
+                 [ws[gate[x]].T for x in order], axis=0),
+             "weight_hh_l0": np.concatenate(
+                 [ws[gate[x] + 1].T for x in order], axis=0),
+             "bias_ih_l0": np.concatenate(
+                 [ws[gate[x] + 2] for x in order])}
+        return _import_gru_cell(cell, g, convention="keras")
+    if isinstance(cell, RnnCell):
+        # keras-1 SimpleRNN: [W (in,h), U (h,h), b] — same math as
+        # RnnCell (tanh(x W + h U + b))
+        if len(ws) != 3:
+            raise ValueError(
+                f"{where}: expected 3 SimpleRNN weights, got {len(ws)}")
+        g = {"weight_ih_l0": ws[0].T, "weight_hh_l0": ws[1].T,
+             "bias_ih_l0": ws[2]}
+        return _import_rnn_cell(cell, g)
+    raise ValueError(f"{where}: no keras recurrent importer for "
+                     f"{type(cell).__name__}")
+
+
+def _keras_leaf_patch(m: Module, ws, where: str):
+    """keras-1 get_weights() of one plain parameterized layer -> native
+    params/state patch.  Keras Dense keeps (in, out) — our layout; Conv2D
+    ('tf' dim ordering) keeps HWIO — our layout; BatchNorm is
+    [gamma, beta, mean, var]."""
+    ws = [np.asarray(w) for w in ws]
+    if isinstance(m, BatchNormalization):
+        g = {"weight": ws[0], "bias": ws[1],
+             "running_mean": ws[2], "running_var": ws[3]}
+        return _import_bn(m, g)
+    if isinstance(m, TemporalConvolution):
+        w = ws[0]
+        if w.ndim == 4:  # real keras-1 Convolution1D kernels: (k, 1, in, out)
+            w = w[:, 0]
+        p = {"weight": jnp.asarray(w)}  # (k, in, out) — our layout
+        if m.with_bias and len(ws) > 1:
+            p["bias"] = jnp.asarray(ws[1])
+        return p, {}
+    if isinstance(m, (SpatialConvolution, SpatialFullConvolution,
+                      VolumetricConvolution)):
+        # keras-1 'tf'-ordering kernels are already our native layout for
+        # all of these: Conv2D/Atrous HWIO, Conv3D DHWIO, and
+        # Deconvolution2D stores its kernel exactly like Convolution2D
+        # (the conv_transpose axis swap happens at call time in the keras
+        # backend, not in the stored weight)
+        p = {"weight": jnp.asarray(ws[0])}
+        if m.with_bias and len(ws) > 1:
+            p["bias"] = jnp.asarray(ws[1])
+        return p, {}
+    if isinstance(m, Linear):
+        w0 = ws[0]
+        if w0.ndim != 2:
+            raise ValueError(
+                f"{where}: expected a 2-D Dense kernel, got shape "
+                f"{w0.shape}")
+        p = {"weight": jnp.asarray(w0)}  # (in, out) = our layout
+        if m.with_bias and len(ws) > 1:
+            p["bias"] = jnp.asarray(ws[1])
+        return p, {}
+    if isinstance(m, LookupTable):
+        return {"weight": jnp.asarray(ws[0])}, {}
+    from bigdl_tpu.nn.activation import PReLU as NNPReLU
+    if isinstance(m, NNPReLU):
+        # keras-1 PReLU: [alphas] over the full feature shape
+        return {"weight": jnp.asarray(ws[0])}, {}
+    raise ValueError(
+        f"no keras weight importer for {type(m).__name__} — this "
+        f"layer converts definition-only (weights must be set "
+        f"manually on the params tree)")
+
+
+def _locate_inner(root: Module, cls):
+    """Find the unique `cls` instance inside a built module tree, returning
+    (path of params-tree keys, module).  Handles the `_with_activation`
+    Sequential wrapping the keras layer factories apply."""
+    from bigdl_tpu.keras.layers import KerasLayer  # local: avoid cycle
+
+    found = []
+
+    def walk(m, path):
+        if isinstance(m, cls):
+            found.append((path, m))
+            return
+        if isinstance(m, KerasLayer):
+            walk(m.inner, path)
+        elif isinstance(m, TimeDistributed):
+            walk(m.inner, path + ("inner",))
+        elif isinstance(m, Recurrent):
+            walk(m.cell, path + ("cell",))
+        elif isinstance(m, Container):
+            for k, c in m.children.items():
+                walk(c, path + (k,))
+
+    walk(root, ())
+    if len(found) != 1:
+        raise ValueError(f"expected exactly one {cls.__name__} inside "
+                         f"{type(root).__name__}, found {len(found)}")
+    return found[0]
+
+
+def _nest(path, p, s):
+    for k in reversed(path):
+        p, s = {k: p}, {k: s}
+    return p, s
+
+
+def _kimp_bidirectional(root, ws, where: str):
+    """reference converter.py convert_bidirectional: forward weights are
+    the first half of the list, backward the second; each half converts by
+    the wrapped recurrent layer's own rule."""
+    from bigdl_tpu.nn.recurrent import BiRecurrent
+
+    path, bi = _locate_inner(root, BiRecurrent)
+    half = len(ws) // 2
+    pf, _ = _keras_cell_patch(bi.fwd.cell, ws[:half], f"{where} (forward)")
+    pb, _ = _keras_cell_patch(bi.bwd.cell, ws[half:], f"{where} (backward)")
+    return _nest(path, {"fwd": {"cell": pf}, "bwd": {"cell": pb}}, {})
+
+
+def _kimp_highway(root, ws, where: str):
+    """keras-1 Highway trainable_weights: [W, W_carry] (+ [b, b_carry]);
+    reference converter.py convert_highway.  Keras (in, in) = our layout;
+    the carry/transform gate maps to our `t` Linear, W to `h`."""
+    from bigdl_tpu.nn.distance import Highway as NNHighway
+
+    path, _ = _locate_inner(root, NNHighway)
+    if len(ws) not in (2, 4):
+        raise ValueError(f"{where}: expected 2 or 4 keras-1 Highway "
+                         f"weights, got {len(ws)}")
+    p = {"h": {"weight": jnp.asarray(np.asarray(ws[0]))},
+         "t": {"weight": jnp.asarray(np.asarray(ws[1]))}}
+    if len(ws) == 4:
+        p["h"]["bias"] = jnp.asarray(np.asarray(ws[2]))
+        p["t"]["bias"] = jnp.asarray(np.asarray(ws[3]))
+    return _nest(path, p, {})
+
+
+def _kimp_srelu(root, ws, where: str):
+    """keras-1 SReLU trainable_weights: [t_left, a_left, t_right, a_right]
+    (reference converter.py convert_srelu passes them through) — same
+    names and shapes as our params."""
+    from bigdl_tpu.nn.activation import SReLU as NNSReLU
+
+    path, _ = _locate_inner(root, NNSReLU)
+    if len(ws) != 4:
+        raise ValueError(f"{where}: expected 4 keras-1 SReLU weights, "
+                         f"got {len(ws)}")
+    names = ("t_left", "a_left", "t_right", "a_right")
+    return _nest(path, {n: jnp.asarray(np.asarray(w))
+                        for n, w in zip(names, ws)}, {})
+
+
+def _kimp_separable_conv(root, ws, where: str):
+    """keras-1 SeparableConvolution2D: [depthwise (kh,kw,in,mult),
+    pointwise (1,1,in*mult,out), bias?] (reference convert_
+    separableconvolution2d).  Our depthwise grouped conv stores
+    (kh,kw,1,in*mult) with channel-major output ordering — a reshape of
+    the keras kernel."""
+    from bigdl_tpu.nn.conv import SpatialSeparableConvolution
+
+    path, m = _locate_inner(root, SpatialSeparableConvolution)
+    dw = np.asarray(ws[0])
+    kh, kw, cin, mult = dw.shape
+    p = {"depthwise": {"weight": jnp.asarray(dw.reshape(kh, kw, 1,
+                                                        cin * mult))},
+         "pointwise": {"weight": jnp.asarray(np.asarray(ws[1]))}}
+    if m.pointwise.with_bias and len(ws) > 2:
+        p["pointwise"]["bias"] = jnp.asarray(np.asarray(ws[2]))
+    return _nest(path, p, {})
+
+
+def _kimp_locally_connected_1d(root, ws, where: str):
+    """keras-1 LocallyConnected1D W: (out_frames, k*in, out) with the
+    patch dim ordered (k, C) C-fastest — exactly our layout (reference
+    convert_locallyconnected1d transposes for bigdl; we don't need to)."""
+    from bigdl_tpu.nn.conv import LocallyConnected1D as NNLC1D
+
+    path, m = _locate_inner(root, NNLC1D)
+    p = {"weight": jnp.asarray(np.asarray(ws[0]))}
+    if m.with_bias and len(ws) > 1:
+        p["bias"] = jnp.asarray(np.asarray(ws[1]))
+    return _nest(path, p, {})
+
+
+def _kimp_locally_connected_2d(root, ws, where: str):
+    """keras-1 LocallyConnected2D W: (oh*ow, kh*kw*in, out) with patch dim
+    ordered (kh, kw, C) C-fastest; ours is (oh, ow, C*kh*kw, out) with the
+    conv_general_dilated_patches C-major ordering — reorder both axes."""
+    from bigdl_tpu.nn.conv import LocallyConnected2D as NNLC2D
+
+    path, m = _locate_inner(root, NNLC2D)
+    w = np.asarray(ws[0])
+    oh, ow = m._out_hw()
+    kh, kw = m.kernel
+    cin = m.n_input
+    w = (w.reshape(oh, ow, kh, kw, cin, -1)
+          .transpose(0, 1, 4, 2, 3, 5)
+          .reshape(oh, ow, cin * kh * kw, -1))
+    p = {"weight": jnp.asarray(w)}
+    if m.with_bias and len(ws) > 1:
+        # keras bias (output_row, output_col, nb_filter) = our layout
+        p["bias"] = jnp.asarray(np.asarray(ws[1]))
+    return _nest(path, p, {})
+
+
+def _kimp_maxout_dense(root, ws, where: str):
+    """keras-1 MaxoutDense: W (nb_feature, in, out), b (nb_feature, out);
+    our lowering is Linear(in, nb_feature*out) + Reshape + Max, so column
+    k*out+o of the packed kernel is W[k, :, o] (reference
+    convert_maxoutdense concatenates the per-feature kernels the same
+    way for bigdl's (out, in) layout)."""
+    path, lin = _locate_inner(root, Linear)
+    w = np.asarray(ws[0])
+    k, din, dout = w.shape
+    p = {"weight": jnp.asarray(w.transpose(1, 0, 2).reshape(din, k * dout))}
+    if lin.with_bias and len(ws) > 1:
+        p["bias"] = jnp.asarray(np.asarray(ws[1]).reshape(k * dout))
+    return _nest(path, p, {})
+
+
+def _composite_importers():
+    """(nn module class, importer) pairs for keras layers that lower to a
+    composite module — matched wherever the anchor class appears."""
+    from bigdl_tpu.nn.activation import SReLU as NNSReLU
+    from bigdl_tpu.nn.conv import (LocallyConnected1D as NNLC1D,
+                                   LocallyConnected2D as NNLC2D,
+                                   SpatialSeparableConvolution)
+    from bigdl_tpu.nn.distance import Highway as NNHighway
+    from bigdl_tpu.nn.recurrent import BiRecurrent
+
+    return [
+        (BiRecurrent, _kimp_bidirectional),
+        (NNHighway, _kimp_highway),
+        (NNSReLU, _kimp_srelu),
+        (SpatialSeparableConvolution, _kimp_separable_conv),
+        (NNLC1D, _kimp_locally_connected_1d),
+        (NNLC2D, _kimp_locally_connected_2d),
+    ]
+
+
+def _keras_units(module: Module):
+    """One (target module, converter) unit per weight-owning keras layer,
+    in execution order — the positional discipline of the reference's
+    WeightsConverter.get_weights_from_kmodel (one get_weights() list per
+    keras layer that has weights)."""
+    from functools import partial
+
+    import bigdl_tpu.keras.layers as KL
+    from bigdl_tpu.keras.layers import KerasLayer  # local: avoid cycle
+
+    composites = _composite_importers()
+    units = []
+
+    def walk(m: Module):
+        if isinstance(m, KerasLayer):
+            if m.inner is None:
+                raise ValueError(
+                    f"{m.name}: build() the model before loading weights "
+                    f"(keras wrappers create their layers lazily)")
+            if isinstance(m, KL.MaxoutDense):
+                # anchors on a plain Linear, so it must be recognized at
+                # the wrapper, not from the lowered tree
+                units.append((m.inner, partial(_kimp_maxout_dense, m.inner)))
+                return
+            walk(m.inner)
+            return
+        for cls, fn in composites:
+            if isinstance(m, cls):
+                units.append((m, partial(fn, m)))
+                return
+        if isinstance(m, Recurrent):
+            units.append((m.cell, partial(_keras_cell_patch, m.cell)))
+            return
+        if isinstance(m, TimeDistributed):
+            walk(m.inner)
+            return
+        if isinstance(m, Container):
+            for c in m.children.values():
+                walk(c)
+            return
+        from bigdl_tpu.nn.activation import PReLU as NNPReLU
+        if isinstance(m, (Linear, SpatialConvolution, SpatialFullConvolution,
+                          TemporalConvolution, VolumetricConvolution,
+                          BatchNormalization, LookupTable, NNPReLU)):
+            units.append((m, partial(_keras_leaf_patch, m)))
+
+    walk(module)
+    return units
 
 
 def import_keras_weights(module: Module, params: Any, state: Any,
                          layer_weights: Sequence[Sequence[np.ndarray]]
                          ) -> Tuple[Any, Any]:
-    """Load Keras `get_weights()` lists (per parameterized layer, in order).
-    Keras Dense keeps (in, out) — our layout; Conv2D ('tf' dim ordering)
-    keeps HWIO — our layout; BatchNorm is [gamma, beta, mean, var]."""
-    sd: "OrderedDict[str, Any]" = OrderedDict()
-    leaves = _leaf_modules(module)
-    if len(layer_weights) != len(leaves):
-        raise ValueError(f"{len(leaves)} parameterized layers vs "
+    """Load Keras `get_weights()` lists (one per keras layer that owns
+    weights, in execution order).  Covers every reference WeightsConverter
+    family (pyspark/bigdl/keras/converter.py:110-281): dense/convs (incl.
+    atrous/separable/deconv/locally-connected), BN, embeddings,
+    LSTM/GRU/SimpleRNN (+ Bidirectional, TimeDistributed), ConvLSTM2D,
+    Highway, MaxoutDense, SReLU.  Returns NEW params/state trees."""
+    units = _keras_units(module)
+    if len(layer_weights) != len(units):
+        raise ValueError(f"{len(units)} parameterized layers vs "
                          f"{len(layer_weights)} keras weight lists")
-    for i, (m, ws) in enumerate(zip(leaves, layer_weights)):
-        if isinstance(m, BatchNormalization):
-            sd[f"{i}.weight"], sd[f"{i}.bias"] = ws[0], ws[1]
-            sd[f"{i}.running_mean"], sd[f"{i}.running_var"] = ws[2], ws[3]
-        elif isinstance(m, SpatialFullConvolution):
-            # keras-1 Deconvolution2D stores the kernel exactly like
-            # Convolution2D — (kh, kw, in, out); the conv_transpose axis
-            # swap happens at call time in the keras backend, not in the
-            # stored weight.  -> torch ConvTranspose2d (in, out, kh, kw)
-            sd[f"{i}.weight"] = np.asarray(ws[0]).transpose(2, 3, 0, 1)
-            if len(ws) > 1:
-                sd[f"{i}.bias"] = ws[1]
-        elif isinstance(m, TemporalConvolution):
-            # keras-1 Conv1D kernel: (k, in, out) -> torch (out, in, k)
-            sd[f"{i}.weight"] = np.asarray(ws[0]).transpose(2, 1, 0)
-            if len(ws) > 1:
-                sd[f"{i}.bias"] = ws[1]
-        elif isinstance(m, VolumetricConvolution):
-            # keras-1 tf Conv3D kernel: (k1, k2, k3, in, out) -> torch
-            sd[f"{i}.weight"] = np.asarray(ws[0]).transpose(4, 3, 0, 1, 2)
-            if len(ws) > 1:
-                sd[f"{i}.bias"] = ws[1]
-        elif isinstance(m, SpatialConvolution):
-            sd[f"{i}.weight"] = np.asarray(ws[0]).transpose(3, 2, 0, 1)  # ->OIHW
-            if len(ws) > 1:
-                sd[f"{i}.bias"] = ws[1]
-        elif isinstance(m, Linear):
-            w0 = np.asarray(ws[0])
-            if w0.ndim != 2:
-                raise ValueError(
-                    f"layer {i}: expected a 2-D Dense kernel, got shape "
-                    f"{w0.shape} — this layer likely lowered from a "
-                    f"definition-only keras class (e.g. MaxoutDense)")
-            sd[f"{i}.weight"] = w0.T  # (in,out) -> torch (out,in)
-            if len(ws) > 1:
-                sd[f"{i}.bias"] = ws[1]
-        elif isinstance(m, LookupTable):
-            sd[f"{i}.weight"] = ws[0]
-        elif isinstance(m, LSTMCell):
-            # keras-1 LSTM trainable_weights order: (W,U,b) per gate in
-            # i, c, f, o order (keras/layers/recurrent.py build()); our
-            # packing is i, f, g(c), o like torch — reorder and pack.
-            # Same cell math (standard LSTM), so the import is exact.
-            if len(ws) != 12:
-                raise ValueError(
-                    f"layer {i}: expected 12 keras-1 LSTM weights (W,U,b x "
-                    f"4 gates, consume_less='cpu'/'mem'), got {len(ws)}")
-            gate = {"i": 0, "c": 3, "f": 6, "o": 9}
-            order = ["i", "f", "c", "o"]  # torch/our packed order
-            sd[f"{i}.weight_ih_l0"] = np.concatenate(
-                [np.asarray(ws[gate[g]]).T for g in order], axis=0)
-            sd[f"{i}.weight_hh_l0"] = np.concatenate(
-                [np.asarray(ws[gate[g] + 1]).T for g in order], axis=0)
-            sd[f"{i}.bias_ih_l0"] = np.concatenate(
-                [np.asarray(ws[gate[g] + 2]) for g in order])
-            sd[f"{i}.bias_hh_l0"] = np.zeros(
-                sd[f"{i}.bias_ih_l0"].shape, np.float32)
-        elif isinstance(m, GRUCell):
-            if m.reset_after:
-                raise ValueError(
-                    f"layer {i}: keras-1 GRU applies the reset gate BEFORE "
-                    f"the hidden matmul (tanh(x W + (r*h) U)); the fused "
-                    f"reset-after cell applies it after (torch convention) "
-                    f"— build the model with GRUCell(reset_after=False) "
-                    f"for an EXACT import")
-            # keras-1.2.2 GRU trainable_weights: (W,U,b) per gate in
-            # z, r, h build order (keras/layers/recurrent.py GRU.build);
-            # our packed order is r, z, n — reorder and pack.  Same math
-            # as the reset_after=False cell, so the import is exact.
-            if len(ws) != 9:
-                raise ValueError(
-                    f"layer {i}: expected 9 keras-1 GRU weights (W,U,b x "
-                    f"3 gates), got {len(ws)}")
-            gate = {"z": 0, "r": 3, "h": 6}
-            order = ["r", "z", "h"]  # our packed order
-            sd[f"{i}.weight_ih_l0"] = np.concatenate(
-                [np.asarray(ws[gate[g]]).T for g in order], axis=0)
-            sd[f"{i}.weight_hh_l0"] = np.concatenate(
-                [np.asarray(ws[gate[g] + 1]).T for g in order], axis=0)
-            sd[f"{i}.bias_ih_l0"] = np.concatenate(
-                [np.asarray(ws[gate[g] + 2]) for g in order])
-            sd[f"{i}.bias_hh_l0"] = np.zeros(
-                sd[f"{i}.bias_ih_l0"].shape, np.float32)
-        elif isinstance(m, RnnCell):
-            # keras-1 SimpleRNN: [W (in,h), U (h,h), b] — same math as
-            # RnnCell (tanh(x W + h U + b)); emit torch RNN-layout keys
-            if len(ws) != 3:
-                raise ValueError(
-                    f"layer {i}: expected 3 SimpleRNN weights, got {len(ws)}")
-            sd[f"{i}.weight_ih_l0"] = np.asarray(ws[0]).T  # (h, in)
-            sd[f"{i}.weight_hh_l0"] = np.asarray(ws[1]).T
-            sd[f"{i}.bias_ih_l0"] = np.asarray(ws[2])
-            sd[f"{i}.bias_hh_l0"] = np.zeros_like(np.asarray(ws[2]))
-        else:
-            raise ValueError(
-                f"no keras weight importer for {type(m).__name__} — this "
-                f"layer converts definition-only (weights must be set "
-                f"manually on the params tree)")
-    # keras-origin weights: the GRU reset-before convention is carried by
-    # the CELL (reset_after=False), so the torch-convention guard must not
-    # fire on this path
-    return import_torch_state_dict(module, params, state, sd,
-                                   _convention="keras")
+    converted = {}
+    for i, ((target, fn), ws) in enumerate(zip(units, layer_weights)):
+        converted[id(target)] = fn(list(ws), f"layer {i}")
+    return _apply_patches(module, params, state, converted)
 
 
 # ---------------------------------------------------------------------------
