@@ -11,7 +11,7 @@ from typing import Any, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from bigdl_tpu.dataset.sample import Sample, SparseFeature
+from bigdl_tpu.dataset.sample import Sample, SparseBag, SparseFeature
 
 
 class MiniBatch:
@@ -84,10 +84,12 @@ class SparseMiniBatch(MiniBatch):
     Reference: dataset/MiniBatch.scala:579 (SparseMiniBatch over
     TensorSample) — batches per-record sparse tensors into one
     (batch, *dense_shape) tensor per component.  The reference keeps the
-    batch sparse (feeding SparseLinear's sparse gemm); here the batch is
-    DENSIFIED at this host-side boundary: static dense shapes are what jit
-    wants, and the MXU beats scatter-based sparse gemm at these widths.
-    Mixed dense/sparse components are fine — dense ones stack as usual.
+    batch sparse (feeding SparseLinear's sparse gemm); here a component
+    either DENSIFIES at this host-side boundary (SparseFeature — fine for
+    narrow vocabs, the MXU eats the dense matmul) or stays device-sparse
+    as a padded (ids, values) bag pair (SparseBag — the wide-vocab path:
+    work scales with nnz, not vocab).  Mixed dense/sparse components are
+    fine — dense ones stack as usual.
     """
 
     @staticmethod
@@ -95,6 +97,12 @@ class SparseMiniBatch(MiniBatch):
                      feature_padding: Optional[float] = None,
                      label_padding: Optional[float] = None) -> "SparseMiniBatch":
         def batch_one(values, padding):
+            if isinstance(values[0], SparseBag):
+                caps = {v.nnz_cap for v in values}
+                if len(caps) != 1:
+                    raise ValueError(f"inconsistent bag capacities: {caps}")
+                return (np.stack([v.ids for v in values]),
+                        np.stack([v.values for v in values]))
             if isinstance(values[0], SparseFeature):
                 shapes = {v.dense_shape for v in values}
                 if len(shapes) != 1:
@@ -127,7 +135,8 @@ class SparseMiniBatch(MiniBatch):
 def has_sparse_feature(sample: Sample) -> bool:
     parts = sample.feature if isinstance(sample.feature, (tuple, list)) else [sample.feature]
     labels = sample.label if isinstance(sample.label, (tuple, list)) else [sample.label]
-    return any(isinstance(p, SparseFeature) for p in list(parts) + list(labels))
+    return any(isinstance(p, (SparseFeature, SparseBag))
+               for p in list(parts) + list(labels))
 
 
 def _pad_stack(arrays: List[np.ndarray], pad_value: float) -> np.ndarray:

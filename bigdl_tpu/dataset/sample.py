@@ -75,6 +75,58 @@ class SparseFeature:
             out[tuple(self.indices.T)] = self.values
         return out
 
+    def to_bag(self, nnz_cap: int) -> "SparseBag":
+        """Re-encode a 1-D sparse feature as a padded (ids, values) bag —
+        the DEVICE-sparse input encoding (see SparseBag)."""
+        if len(self.dense_shape) != 1:
+            raise ValueError(
+                f"to_bag needs a 1-D sparse feature, got dense rank "
+                f"{len(self.dense_shape)}")
+        return SparseBag(self.indices[:, 0] if self.indices.size else [],
+                         self.values, nnz_cap)
+
     def __repr__(self):
         return (f"SparseFeature(nnz={self.values.size}, "
                 f"dense_shape={self.dense_shape})")
+
+
+class SparseBag:
+    """Padded (ids, values) bag of one record — the device-sparse encoding.
+
+    Reference capability: tensor/SparseTensor.scala + SparseTensorMath
+    .scala execute sparse gemm natively so wide features never densify.
+    The TPU-native equivalent keeps (ids, values) as DENSE arrays padded
+    to a static `nnz_cap` (id -1 = empty slot): on device, SparseLinear /
+    LookupTableSparse gather the referenced weight rows and do a masked
+    weighted reduce — work and HBM traffic scale with nnz, not vocab
+    width, while shapes stay static for jit (the batched-gather layout of
+    segment_sum with fixed-size segments)."""
+
+    __slots__ = ("ids", "values")
+
+    def __init__(self, ids, values, nnz_cap: int):
+        # preserve the dtype of typed inputs even when empty (batches
+        # must not flip dtype when a record happens to have zero ids);
+        # only untyped empty python sequences default to float32
+        vdtype = getattr(values, "dtype", None)
+        values = np.asarray(values).ravel()
+        if vdtype is None:
+            vdtype = values.dtype if values.size else np.float32
+        ids = np.asarray(ids, np.int64).ravel()
+        if ids.size != values.size:
+            raise ValueError(f"{ids.size} ids vs {values.size} values")
+        if ids.size > nnz_cap:
+            raise ValueError(
+                f"record has {ids.size} entries, bag capacity {nnz_cap}")
+        self.ids = np.full((int(nnz_cap),), -1, np.int32)
+        self.ids[:ids.size] = ids
+        self.values = np.zeros((int(nnz_cap),), vdtype)
+        self.values[:values.size] = values
+
+    @property
+    def nnz_cap(self) -> int:
+        return self.ids.shape[0]
+
+    def __repr__(self):
+        return (f"SparseBag(nnz={int((self.ids >= 0).sum())}, "
+                f"cap={self.nnz_cap})")

@@ -271,31 +271,44 @@ class VarLenFeature:
       feature_padding=-1 to feed LookupTableSparse id bags.
     - "multi_hot": int values are INDICES into a (`size`,)-wide vocab;
       the densified row is their multi-hot (count) encoding — the
-      SparseLinear wide-model input.
+      SparseLinear wide-model input (fine for narrow vocabs).
+    - "bag": multi_hot semantics WITHOUT densification — the column
+      batches as a (ids, values) pair padded to `max_nnz` per record,
+      feeding SparseLinear's device-sparse gather path.  Work and HBM
+      traffic scale with max_nnz instead of vocab `size`; use this for
+      1e5+ vocabs (reference capability: tensor/SparseTensorMath.scala
+      sparse gemm).
     """
 
     def __init__(self, key: str, size: int, dtype: str = "int64",
-                 encoding: str = "positions"):
-        if encoding not in ("positions", "multi_hot"):
+                 encoding: str = "positions", max_nnz: int = 0):
+        if encoding not in ("positions", "multi_hot", "bag"):
             raise ValueError(f"unknown VarLen encoding {encoding!r}")
+        if encoding == "bag" and max_nnz <= 0:
+            raise ValueError("encoding='bag' needs max_nnz (the static "
+                             "per-record id capacity)")
         self.key = key
         self.size = int(size)
         self.dtype = dtype
         self.encoding = encoding
+        self.max_nnz = int(max_nnz)
 
     def to_sparse(self, values):
         import numpy as _np
 
-        from bigdl_tpu.dataset.sample import SparseFeature
+        from bigdl_tpu.dataset.sample import SparseBag, SparseFeature
 
         values = _np.asarray(values)
-        if self.encoding == "multi_hot":
+        if self.encoding in ("multi_hot", "bag"):
             if values.size and (values.min() < 0
                                 or values.max() >= self.size):
                 raise ValueError(
                     f"VarLen {self.key!r}: id out of range [0, {self.size})")
             idx, counts = _np.unique(values.astype(_np.int64),
                                      return_counts=True)
+            if self.encoding == "bag":
+                return SparseBag(idx, counts.astype(self.dtype),
+                                 self.max_nnz)
             return SparseFeature(idx[:, None], counts.astype(self.dtype),
                                  (self.size,))
         if values.size > self.size:

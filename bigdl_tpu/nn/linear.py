@@ -61,14 +61,21 @@ class Linear(Module):
 
 
 class SparseLinear(Linear):
-    """Linear over sparse-ish inputs (reference: nn/SparseLinear.scala).
+    """Linear over sparse inputs (reference: nn/SparseLinear.scala +
+    tensor/SparseTensorMath.scala sparse gemm).
 
-    The reference multiplies a COO SparseTensor against dense weights for
-    wide-and-deep style features.  On TPU, scatter/gather-heavy sparse gemm
-    loses to a dense matmul on the MXU for the feature widths BigDL targets,
-    so the TPU-native design densifies at the input pipeline and reuses the
-    dense kernel; the class exists for API parity and accepts already-dense
-    input (e.g. multi-hot encoded).
+    Two input forms:
+    - dense (B, input_size) multi-hot — plain MXU matmul (fine for the
+      narrow vocabs BigDL's examples use);
+    - a device-sparse bag pair `(ids, values)` / `Table(ids, values)` with
+      ids (B, nnz) int padded -1 and values (B, nnz) — the wide-vocab
+      path: y[b] = Σ_j values[b,j] · W[ids[b,j], :] + bias, computed as a
+      batched row gather + masked weighted reduce.  Work and HBM traffic
+      scale with nnz, not input_size; the gradient w.r.t. W is the gather
+      transpose (a scatter-add XLA emits natively), never a dense
+      (B, input_size) one-hot.  Equivalent to segment_sum over COO with
+      static-size segments — the jit/TPU-friendly layout.  Batches in this
+      form come from `VarLenFeature(..., encoding='bag')`.
     """
 
     def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
@@ -77,3 +84,30 @@ class SparseLinear(Linear):
         super().__init__(input_size, output_size, with_bias, name=name)
         self.backward_start = backward_start
         self.backward_length = backward_length
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        from bigdl_tpu.core.table import Table
+        if isinstance(x, (Table, tuple, list)):
+            seq = list(x)
+            if len(seq) != 2:
+                raise ValueError(
+                    f"SparseLinear bag input needs (ids, values), got "
+                    f"{len(seq)} components")
+            ids, vals = seq
+            valid = ids >= 0
+            safe = jnp.maximum(ids, 0).astype(jnp.int32)
+            rows = params["weight"][safe]                 # (B, nnz, out)
+            w = jnp.where(valid, vals, 0).astype(rows.dtype)
+            y = jnp.einsum("bn,bno->bo", w, rows)
+            if self.with_bias:
+                y = y + params["bias"]
+            return y, state
+        return super().apply(params, state, x, training=training, rng=rng)
+
+    def output_shape(self, input_shape):
+        from bigdl_tpu.core.table import Table
+        if isinstance(input_shape, (Table, tuple, list)):
+            shapes = list(input_shape)
+            if len(shapes) == 2 and isinstance(shapes[0], (tuple, list)):
+                return (tuple(shapes[0])[0], self.output_size)  # (B, out)
+        return super().output_shape(input_shape)

@@ -1,0 +1,181 @@
+"""Device-side sparse path: SparseLinear (ids, values) bags.
+
+Reference capability: tensor/SparseTensor.scala + SparseTensorMath.scala
+execute sparse gemm natively so wide features never densify.  The
+TPU-native equivalent is a batched row gather + masked weighted reduce
+over bags padded to a static nnz — parity-tested here against the dense
+multi-hot path (forward AND gradients), end-to-end through the TFRecord
+VarLen flow with encoding='bag'.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.table import Table
+from bigdl_tpu.dataset import VarLenFeature
+from bigdl_tpu.dataset.minibatch import SparseMiniBatch, has_sparse_feature
+from bigdl_tpu.dataset.sample import Sample, SparseBag, SparseFeature
+from bigdl_tpu.dataset.tfrecord import ParsedExampleDataSet, TFRecordWriter
+from bigdl_tpu.nn.tf_ops import build_example_proto
+from bigdl_tpu.optim import DistriOptimizer, SGD, Trigger
+
+VOCAB, B, NNZ, OUT = 40, 6, 5, 3
+
+
+def _random_bags(rs, vocab=VOCAB, b=B, nnz=NNZ):
+    """(ids, vals) padded bags + the equivalent dense multi-hot batch."""
+    ids = np.full((b, nnz), -1, np.int32)
+    vals = np.zeros((b, nnz), np.float32)
+    dense = np.zeros((b, vocab), np.float32)
+    for r in range(b):
+        k = rs.randint(1, nnz + 1)
+        chosen = rs.choice(vocab, size=k, replace=False)
+        w = rs.rand(k).astype(np.float32) + 0.5
+        ids[r, :k] = chosen
+        vals[r, :k] = w
+        dense[r, chosen] = w
+    return ids, vals, dense
+
+
+class TestSparseLinearBag:
+    def test_forward_parity_vs_dense(self):
+        rs = np.random.RandomState(0)
+        ids, vals, dense = _random_bags(rs)
+        m = nn.SparseLinear(VOCAB, OUT)
+        params, state, out_shape = m.build(jax.random.PRNGKey(0),
+                                           Table((B, NNZ), (B, NNZ)))
+        assert tuple(out_shape) == (B, OUT)
+        y_bag, _ = m.apply(params, state, Table(jnp.asarray(ids),
+                                                jnp.asarray(vals)))
+        y_dense, _ = m.apply(params, state, jnp.asarray(dense))
+        np.testing.assert_allclose(np.asarray(y_bag), np.asarray(y_dense),
+                                   rtol=1e-5, atol=1e-5)
+        # tuple input form works too (how SparseMiniBatch delivers it)
+        y_tup, _ = m.apply(params, state, (jnp.asarray(ids),
+                                           jnp.asarray(vals)))
+        np.testing.assert_allclose(np.asarray(y_tup), np.asarray(y_bag))
+
+    def test_gradient_parity_vs_dense(self):
+        """d loss / d W through the gather path == through the dense
+        multi-hot matmul (the VERDICT 'done' criterion)."""
+        rs = np.random.RandomState(1)
+        ids, vals, dense = _random_bags(rs)
+        m = nn.SparseLinear(VOCAB, OUT)
+        params, state, _ = m.build(jax.random.PRNGKey(1),
+                                   Table((B, NNZ), (B, NNZ)))
+        tgt = rs.randn(B, OUT).astype(np.float32)
+
+        def loss_bag(p):
+            y, _ = m.apply(p, state, Table(jnp.asarray(ids),
+                                           jnp.asarray(vals)))
+            return jnp.mean((y - tgt) ** 2)
+
+        def loss_dense(p):
+            y, _ = m.apply(p, state, jnp.asarray(dense))
+            return jnp.mean((y - tgt) ** 2)
+
+        g_bag = jax.grad(loss_bag)(params)
+        g_dense = jax.grad(loss_dense)(params)
+        np.testing.assert_allclose(np.asarray(g_bag["weight"]),
+                                   np.asarray(g_dense["weight"]),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(g_bag["bias"]),
+                                   np.asarray(g_dense["bias"]),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_grad_hlo_has_no_dense_vocab_product(self):
+        """The backward pass must scale with nnz, not vocab: no
+        (B, vocab)-shaped intermediate may appear in the compiled grad."""
+        wide = 100_000
+        m = nn.SparseLinear(wide, OUT)
+        params, state, _ = m.build(jax.random.PRNGKey(0),
+                                   Table((B, NNZ), (B, NNZ)))
+        ids = jnp.zeros((B, NNZ), jnp.int32)
+        vals = jnp.ones((B, NNZ), jnp.float32)
+
+        def loss(p):
+            y, _ = m.apply(p, state, Table(ids, vals))
+            return jnp.sum(y)
+
+        txt = jax.jit(jax.grad(loss)).lower(params).as_text()
+        assert f"{B},{wide}" not in txt  # no densified one-hot batch
+
+
+class TestSparseBagHost:
+    def test_bag_from_sparse_feature(self):
+        sf = SparseFeature(np.array([[2], [7]]), np.array([1.5, 2.5],
+                                                          np.float32),
+                           (VOCAB,))
+        bag = sf.to_bag(4)
+        np.testing.assert_array_equal(bag.ids, [2, 7, -1, -1])
+        np.testing.assert_array_equal(bag.values, [1.5, 2.5, 0, 0])
+
+    def test_empty_record_keeps_dtype(self):
+        """A zero-id record must not flip the batch dtype (jit recompile
+        hazard)."""
+        full = SparseBag(np.array([3]), np.array([2], np.int64), 4)
+        empty = SparseBag(np.array([], np.int64),
+                          np.array([], np.int64), 4)
+        assert empty.values.dtype == np.int64
+        batch = SparseMiniBatch.from_samples(
+            [Sample(full, np.int32(0)), Sample(empty, np.int32(1))])
+        ids, vals = batch.input
+        assert vals.dtype == np.int64
+        assert ids.shape == (2, 4)
+
+    def test_has_sparse_feature_sees_bags(self):
+        s = Sample(SparseBag([1], [1.0], 3), np.int32(0))
+        assert has_sparse_feature(s)
+
+    def test_capacity_overflow_raises(self):
+        import pytest
+        with pytest.raises(ValueError, match="capacity"):
+            SparseBag([1, 2, 3], [1, 1, 1], 2)
+
+
+class TestVarLenBagE2E:
+    def test_bag_flow_trains_sparse_linear(self, tmp_path):
+        """TFRecord VarLen -> encoding='bag' -> SparseMiniBatch (ids,
+        values) -> SparseLinear device-sparse training (the
+        test_sparse_parse.py e2e flow without densification)."""
+        vocab, classes, maxlen, batch, n = 24, 3, 6, 8, 96
+        rs = np.random.RandomState(0)
+        path = str(tmp_path / "bag.tfrecord")
+        per_class = vocab // classes
+        with TFRecordWriter(path) as w:
+            for i in range(n):
+                c = i % classes
+                k = rs.randint(1, maxlen + 1)
+                ids = rs.randint(c * per_class, (c + 1) * per_class,
+                                 size=k).astype(np.int64)
+                w.write(build_example_proto(
+                    {"ids": ids, "y": np.asarray([c], np.int64)}))
+
+        ds = ParsedExampleDataSet(
+            [path], batch_size=batch, dense_keys=["y"], dense_shapes=[()],
+            label_key="y", sparse_features=[
+                VarLenFeature("ids", vocab, dtype="float32",
+                              encoding="bag", max_nnz=maxlen)])
+        b0 = next(iter(ds.data(train=False)))
+        ids_arr, vals_arr = b0.input
+        assert ids_arr.shape == (batch, maxlen)
+        assert vals_arr.shape == (batch, maxlen)
+        assert (ids_arr >= -1).all() and (ids_arr < vocab).all()
+
+        model = nn.Sequential(nn.SparseLinear(vocab, classes),
+                              nn.LogSoftMax())
+        opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                              optim_method=SGD(learning_rate=0.5),
+                              end_trigger=Trigger.max_epoch(12))
+        opt.optimize()
+        # the class is recoverable from the id range: training must
+        # reach a confident fit
+        logits, _ = model.apply(opt.params, opt.model_state,
+                                Table(jnp.asarray(ids_arr),
+                                      jnp.asarray(vals_arr)))
+        pred = np.argmax(np.asarray(logits), axis=1)
+        want = np.asarray(b0.target).ravel()[:batch]
+        assert (pred == want).mean() >= 0.9
