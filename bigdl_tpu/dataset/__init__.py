@@ -1,4 +1,4 @@
-from bigdl_tpu.dataset.sample import Sample, SparseFeature
+from bigdl_tpu.dataset.sample import Sample, SparseBag, SparseFeature
 from bigdl_tpu.dataset.minibatch import MiniBatch, SparseMiniBatch
 from bigdl_tpu.dataset.transformer import Transformer, SampleToMiniBatch
 from bigdl_tpu.dataset.dataset import DataSet, LocalDataSet, ArrayDataSet
@@ -8,7 +8,7 @@ from bigdl_tpu.dataset.tfrecord import VarLenFeature
 from bigdl_tpu.dataset import image
 from bigdl_tpu.dataset import text
 
-__all__ = ["Sample", "SparseFeature", "MiniBatch", "SparseMiniBatch",
+__all__ = ["Sample", "SparseBag", "SparseFeature", "MiniBatch", "SparseMiniBatch",
            "Transformer", "SampleToMiniBatch",
            "DataSet", "LocalDataSet", "ArrayDataSet",
            "RowTransformer", "RowTransformSchema", "TableToSample",

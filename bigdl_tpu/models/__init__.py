@@ -16,7 +16,9 @@ from bigdl_tpu.models.transformer import (
     transformer_lm_small,
     transformer_lm_base,
 )
+from bigdl_tpu.models.pipelined_conv import PipelinedConvNet
 
 __all__ = ["LeNet5", "VggForCifar10", "Vgg16", "Vgg19", "ResNet", "resnet50",
            "resnet_cifar", "InceptionV1", "PTBModel", "SimpleRNN", "Autoencoder",
-           "TransformerLM", "transformer_lm_small", "transformer_lm_base"]
+           "TransformerLM", "transformer_lm_small", "transformer_lm_base",
+           "PipelinedConvNet"]

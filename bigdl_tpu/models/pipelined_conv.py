@@ -1,0 +1,193 @@
+"""Conv+BN residual stack with pipeline-parallel STATEFUL stages.
+
+The stateful-pipeline demonstration model: the repeated middle blocks
+(conv3x3 SAME -> BatchNorm -> ReLU, residual) carry BatchNorm running
+stats as per-stage state stacked like the block params and sharded
+P('pipeline') — parallel/pipeline.py threads it through the microbatch
+schedule (each layer sees microbatches in order; fill/drain ticks are
+masked), so pipelining is purely an execution-schedule transformation of
+the microbatched program.  Shape-changing ends (stem conv+BN, pooled
+classifier head) run outside the pipelined region, replicated over the
+pipeline axis, exactly like TransformerLM's embed/head.
+
+Under shard_map every BN syncs its batch statistics over the 'data' mesh
+axis (sync-BN, the reference's setParallism semantics — survey §2.10):
+that is what makes the replicated stem state and the pipeline-sharded
+block state single-valued along the data axis, so shard_map's state
+out-specs are well-defined.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.core.engine import AXIS_DATA
+from bigdl_tpu.nn.conv import SpatialConvolution
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.norm import SpatialBatchNormalization
+
+
+def _axis_bound(name: str) -> bool:
+    try:
+        lax.axis_index(name)
+        return True
+    except NameError:
+        return False
+
+
+class PipelinedConvNet(Module):
+    """NHWC image classifier: stem conv+BN -> n_layer residual conv+BN
+    blocks (pipelined over `pipeline_axis` when bound) -> GAP -> linear
+    -> log-probs."""
+
+    def __init__(self, n_input: int, n_class: int, width: int = 32,
+                 n_layer: int = 8, *,
+                 pipeline_axis: Optional[str] = None,
+                 pipeline_microbatches: int = 4,
+                 pipeline_interleave: bool = False,
+                 sync_bn_axis: str = AXIS_DATA,
+                 microbatch_sequential: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_input = n_input
+        self.n_class = n_class
+        self.width = width
+        self.n_layer = n_layer
+        self.pipeline_axis = pipeline_axis
+        self.pipeline_microbatches = pipeline_microbatches
+        self.pipeline_interleave = pipeline_interleave
+        self.sync_bn_axis = sync_bn_axis
+        # microbatch the sequential fallback too, so a pipeline-configured
+        # model computes the SAME function whether or not the pipeline
+        # axis is bound (BN stats are per-microbatch either way); also the
+        # parity oracle for the pipelined run
+        self.microbatch_sequential = microbatch_sequential
+        self.stem = SpatialConvolution(n_input, width, 3, 3, 1, 1, -1, -1,
+                                       with_bias=False)
+        self.stem_bn = SpatialBatchNormalization(width)
+        self.conv = SpatialConvolution(width, width, 3, 3, 1, 1, -1, -1,
+                                       with_bias=False)
+        self.bn = SpatialBatchNormalization(width)
+        self.head = Linear(width, n_class)
+
+    def build(self, rng, input_shape):
+        b, h, w, _ = input_shape
+        ks = jax.random.split(rng, 4)
+        params = {"stem": self.stem.build(ks[0], input_shape)[0]}
+        stem_shape = (b, h, w, self.width)
+        pb, sb, _ = self.stem_bn.build(ks[1], stem_shape)
+        params["stem_bn"] = pb
+        state = {"stem_bn": sb}
+        blocks_p, blocks_s = [], []
+        for i in range(self.n_layer):
+            ki = jax.random.fold_in(ks[2], i)
+            cp, _, _ = self.conv.build(ki, stem_shape)
+            bp, bs, _ = self.bn.build(jax.random.fold_in(ki, 1), stem_shape)
+            blocks_p.append({"conv": cp, "bn": bp})
+            blocks_s.append({"bn": bs})
+        stack = lambda *xs: jnp.stack(xs)  # noqa: E731
+        params["blocks"] = jax.tree_util.tree_map(stack, *blocks_p)
+        state["blocks"] = jax.tree_util.tree_map(stack, *blocks_s)
+        params["head"] = self.head.build(ks[3], (b, self.width))[0]
+        return params, state, (b, self.n_class)
+
+    def _block(self, lp, ls, h, training):
+        h2, _ = self.conv.apply(lp["conv"], {}, h)
+        h2, ns = self.bn.apply(lp["bn"], ls["bn"], h2, training=training)
+        return jax.nn.relu(h2) + h, {"bn": ns}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        # sync-BN only where the mesh axis is actually bound (inside the
+        # trainer's shard_map); at jit level the batch is already global
+        sync = self.sync_bn_axis if _axis_bound(self.sync_bn_axis) else None
+        self.stem_bn.axis_name = sync
+        self.bn.axis_name = sync
+
+        h, _ = self.stem.apply(params["stem"], {}, x)
+        h, stem_bn_state = self.stem_bn.apply(
+            params["stem_bn"], state["stem_bn"], h, training=training)
+        h = jax.nn.relu(h)
+
+        if self.pipeline_axis is not None and _axis_bound(self.pipeline_axis):
+            from bigdl_tpu.parallel.pipeline import pipeline_apply
+
+            h, blocks_state = pipeline_apply(
+                lambda lp, ls, hh: self._block(lp, ls, hh, training),
+                params["blocks"], h,
+                n_microbatch=self.pipeline_microbatches,
+                axis_name=self.pipeline_axis,
+                interleave=self.pipeline_interleave,
+                stage_state=state["blocks"])
+        elif ((self.microbatch_sequential
+               or (self.pipeline_axis is not None
+                   and self.pipeline_microbatches > 1))
+              and h.shape[0] % self.pipeline_microbatches == 0):
+            # batches not divisible by M (e.g. single-sample predict) fall
+            # through to the plain scan below — identical at eval (BN
+            # reads running stats), and training batches are static/
+            # divisible under the trainer
+            # microbatched sequential program — what the pipeline schedule
+            # is an execution-reordering of; layer l sees microbatches in
+            # order and threads its state exactly like the pipelined run
+            M = self.pipeline_microbatches
+            b = h.shape[0]
+            micro = h.reshape((M, b // M) + h.shape[1:])
+
+            def outer(bs, hm):
+                def inner(hh, ps):
+                    lp, ls = ps
+                    h2, ns = self._block(lp, ls, hh, training)
+                    return h2, ns
+
+                hm2, new_bs = lax.scan(inner, hm, (params["blocks"], bs))
+                return new_bs, hm2
+
+            blocks_state, outs = lax.scan(outer, state["blocks"], micro)
+            h = outs.reshape((b,) + outs.shape[2:])
+        else:
+            def body(hh, ps):
+                lp, ls = ps
+                h2, ns = self._block(lp, ls, hh, training)
+                return h2, ns
+
+            h, blocks_state = lax.scan(
+                body, h, (params["blocks"], state["blocks"]))
+
+        h = jnp.mean(h, axis=(1, 2))  # global average pool
+        logits, _ = self.head.apply(params["head"], {}, h)
+        new_state = {"stem_bn": stem_bn_state, "blocks": blocks_state}
+        return jax.nn.log_softmax(logits, axis=-1), new_state
+
+    def output_shape(self, input_shape):
+        return (input_shape[0], self.n_class)
+
+    def prepare_pipeline_params(self, params, n_stage: int):
+        if not self.pipeline_interleave:
+            return params
+        from bigdl_tpu.parallel.pipeline import interleave_stack
+
+        return dict(params, blocks=interleave_stack(params["blocks"], n_stage))
+
+    def prepare_pipeline_state(self, state, n_stage: int):
+        if not self.pipeline_interleave:
+            return state
+        from bigdl_tpu.parallel.pipeline import interleave_stack
+
+        return dict(state, blocks=interleave_stack(state["blocks"], n_stage))
+
+    def restore_pipeline_state(self, state, n_stage: int):
+        """Undo the interleaved-schedule layout on the state coming OUT of
+        the pipelined step, so stored state stays in model order (params
+        never come back out, their gradients flow through the permutation
+        instead)."""
+        if not self.pipeline_interleave:
+            return state
+        from bigdl_tpu.parallel.pipeline import deinterleave_stack
+
+        return dict(state, blocks=deinterleave_stack(state["blocks"],
+                                                     n_stage))

@@ -244,25 +244,26 @@ class Optimizer:
         batch_spec = self.batch_partition if self.batch_partition is not None \
             else P(AXIS_DATA)
         prepare = getattr(model, "prepare_pipeline_params", lambda p, n: p)
+        # stateful pipelined models (conv+BN stages): per-layer state is
+        # stacked like the params, enters sharded P(pipeline) by the same
+        # sharding_rules, and comes back out through the same specs; the
+        # restore hook undoes any schedule-layout permutation so stored
+        # state stays in model order (like params/checkpoints)
+        prepare_state = getattr(model, "prepare_pipeline_state",
+                                lambda s, n: s)
+        restore_state = getattr(model, "restore_pipeline_state",
+                                lambda s, n: s)
 
         def fwd(params, model_state, x, rng):
-            # the shard_map below replicates model_state (P()): per-layer
-            # state updated during TRAINING (e.g. BatchNorm running stats)
-            # would silently mis-replicate across stages.  Read-only state
-            # at eval is safe.
-            if training and jax.tree_util.tree_leaves(model_state):
-                raise ValueError(
-                    "pipeline-parallel training requires a stateless model "
-                    "(no BatchNorm running stats or other per-layer state); "
-                    "found non-empty model state — use LayerNorm-style "
-                    "stateless blocks or train without pipeline_axis")
             p = prepare(params, n_stage)
+            s = prepare_state(model_state, n_stage)
             specs = spec_tree(p, self.sharding_rules)
+            state_specs = spec_tree(s, self.sharding_rules)
             # without a rule mapping the block stack to P(pipeline_axis),
             # every device would hold ALL layers and the schedule would
             # silently apply the full stack n_stage times
-            if not any(ax in _flatten_spec_axes(s)
-                       for s in jax.tree_util.tree_leaves(
+            if not any(ax in _flatten_spec_axes(s_)
+                       for s_ in jax.tree_util.tree_leaves(
                            specs, is_leaf=lambda v: isinstance(v, P))):
                 raise ValueError(
                     f"pipelined model needs sharding_rules that place the "
@@ -271,9 +272,10 @@ class Optimizer:
             sm = _jax.shard_map(
                 lambda p_, s_, x_, r_: model.apply(
                     p_, s_, x_, training=training, rng=r_),
-                mesh=mesh, in_specs=(specs, P(), batch_spec, P()),
-                out_specs=(batch_spec, P()))
-            return sm(p, model_state, x, rng)
+                mesh=mesh, in_specs=(specs, state_specs, batch_spec, P()),
+                out_specs=(batch_spec, state_specs))
+            out, new_state = sm(p, s, x, rng)
+            return out, restore_state(new_state, n_stage)
 
         return fwd
 

@@ -61,7 +61,8 @@ def pipeline_apply(stage_fn: Callable[..., jnp.ndarray],
                    axis_name: str = AXIS_PIPELINE,
                    remat: bool = False,
                    interleave: bool = False,
-                   with_uid: bool = False) -> jnp.ndarray:
+                   with_uid: bool = False,
+                   stage_state: Any = None):
     """Run `stage_fn` (ONE layer: params-without-stack-dim, h -> h) as a
     pipeline over `axis_name`.  MUST be called inside `shard_map` with
     `stage_params` carrying a leading layer-stacked dim sharded
@@ -74,79 +75,132 @@ def pipeline_apply(stage_fn: Callable[..., jnp.ndarray],
     with_uid=True calls `stage_fn(layer_params, h, uid)` where `uid` is a
     scalar unique per (microbatch, global layer) — the RNG-folding handle
     for dropout inside pipelined blocks.
+
+    stage_state (optional) carries PER-LAYER STATE stacked like the params
+    (same leading dim, same `P(axis_name)` sharding) for stateful layers —
+    BatchNorm running stats being the canonical case.  The stage_fn
+    signature becomes `(layer_params, layer_state, h[, uid]) ->
+    (h, new_layer_state)` and pipeline_apply returns `(out,
+    new_stage_state)`.  Each layer sees the microbatches in order
+    0..M-1 and updates its state sequentially (masked off on fill/drain
+    ticks), so the result is EXACTLY the microbatch-sequential reference:
+    pipelining changes the execution schedule, not the stats semantics.
+    (Microbatching itself changes BN's normalization batch vs a full-batch
+    step — the standard GPipe property — which is why parity is defined
+    against the microbatched sequential program.)
     """
     n_stage = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     k = _local_stack(stage_params)
     my_params = stage_params
+    with_state = stage_state is not None
+    my_state = stage_state if with_state else {}
 
     b = x.shape[0]
     assert b % n_microbatch == 0, (b, n_microbatch)
     mb = b // n_microbatch
     micro = x.reshape((n_microbatch, mb) + x.shape[1:])
 
-    raw = stage_fn if with_uid else (lambda p, h, uid: stage_fn(p, h))
+    # canonical internal signature: (params, state, h, uid) -> (h, state)
+    if with_state and with_uid:
+        raw = stage_fn
+    elif with_state:
+        raw = lambda p, s, h, uid: stage_fn(p, s, h)  # noqa: E731
+    elif with_uid:
+        raw = lambda p, s, h, uid: (stage_fn(p, h, uid), s)  # noqa: E731
+    else:
+        raw = lambda p, s, h, uid: (stage_fn(p, h), s)  # noqa: E731
     fn = jax.checkpoint(raw) if remat else raw
     # activation shape probe (pipelined layers must be shape-preserving so
     # the relay buffer has one static shape; true of transformer blocks —
     # shape-CHANGING ends like embed/head run outside the pipelined region)
-    probe_params = jax.tree_util.tree_map(lambda a: a[0], my_params)
-    out_struct = jax.eval_shape(fn, probe_params, jax.ShapeDtypeStruct(
-        micro.shape[1:], micro.dtype), jax.ShapeDtypeStruct((), jnp.int32))
+    take0 = lambda a: a[0]  # noqa: E731
+    probe_params = jax.tree_util.tree_map(take0, my_params)
+    probe_state = jax.tree_util.tree_map(take0, my_state)
+    out_struct, _ = jax.eval_shape(
+        fn, probe_params, probe_state,
+        jax.ShapeDtypeStruct(micro.shape[1:], micro.dtype),
+        jax.ShapeDtypeStruct((), jnp.int32))
     assert out_struct.shape == micro.shape[1:], (
         f"pipelined layers must preserve activation shape, got "
         f"{out_struct.shape} vs {micro.shape[1:]}")
 
     if interleave:
-        outputs = _interleaved_schedule(fn, my_params, micro, n_stage, idx,
-                                        axis_name, k)
+        outputs, new_state = _interleaved_schedule(
+            fn, my_params, my_state, micro, n_stage, idx, axis_name, k)
     else:
-        outputs = _gpipe_schedule(fn, my_params, micro, n_stage, idx,
-                                  axis_name, k)
+        outputs, new_state = _gpipe_schedule(
+            fn, my_params, my_state, micro, n_stage, idx, axis_name, k)
 
     # broadcast the last stage's collected outputs to every stage
     outputs = lax.psum(
         jnp.where(idx == n_stage - 1, outputs, jnp.zeros_like(outputs)),
         axis_name)
-    return outputs.reshape((b,) + x.shape[1:])
+    outputs = outputs.reshape((b,) + x.shape[1:])
+    if with_state:
+        return outputs, new_state
+    return outputs
 
 
-def _apply_group(fn, my_params, h, base_uid, k):
+def _apply_group(fn, my_params, my_state, h, base_uid, k):
     """Apply all k local layers in stacked order (one GPipe tick).  Layer
-    j's uid = base_uid + j (base encodes microbatch and device offset)."""
+    j's uid = base_uid + j (base encodes microbatch and device offset).
+    Returns (h, k-stacked new layer states)."""
 
-    def body(h, pj):
-        layer_params, j = pj
-        return fn(layer_params, h, (base_uid + j).astype(jnp.int32)), None
+    def body(h, psj):
+        layer_params, layer_state, j = psj
+        h2, s2 = fn(layer_params, layer_state, h,
+                    (base_uid + j).astype(jnp.int32))
+        return h2, s2
 
-    h, _ = lax.scan(body, h, (my_params, jnp.arange(k)))
-    return h
+    h, new_states = lax.scan(body, h, (my_params, my_state, jnp.arange(k)))
+    return h, new_states
 
 
-def _varying(axis_name, *arrays):
+def _varying(axis_name, *trees):
     """Mark scan-carry init values as varying over the pipeline axis (the
     body outputs depend on axis_index, so carry types must match)."""
     pcast = getattr(lax, "pcast", None)
     if pcast is None:
-        return arrays
-    return tuple(pcast(a, (axis_name,), to="varying") for a in arrays)
+        return trees
+
+    def cast(a):
+        try:
+            return pcast(a, (axis_name,), to="varying")
+        except ValueError as e:
+            if "varying" in str(e):
+                return a  # already varying (e.g. P(pipeline)-sharded state)
+            raise
+
+    return tuple(jax.tree_util.tree_map(cast, t) for t in trees)
 
 
-def _gpipe_schedule(fn, my_params, micro, n_stage, idx, axis_name, k):
+def _masked_state(active, new, old):
+    """Keep `new` state only on active ticks (fill/drain ticks compute
+    garbage microbatches whose stat updates must not land)."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(active, n, o), new, old)
+
+
+def _gpipe_schedule(fn, my_params, my_state, micro, n_stage, idx,
+                    axis_name, k):
     n_microbatch = micro.shape[0]
     fwd_perm = [(i, i + 1) for i in range(n_stage - 1)]
     n_tick = n_microbatch + n_stage - 1
 
     def tick(carry, t):
-        relay, outputs = carry
+        relay, outputs, state = carry
         # stage 0 injects microbatch t (clamped; masked later), others take
         # the relayed activation from the previous stage
         feed = micro[jnp.minimum(t, n_microbatch - 1)]
         inp = jnp.where(idx == 0, feed, relay)
         # the microbatch this device computes at tick t is m = t - idx
         m = jnp.clip(t - idx, 0, n_microbatch - 1)
-        out = _apply_group(fn, my_params, inp,
-                           m * (n_stage * k) + idx * k, k)
+        out, new_state = _apply_group(fn, my_params, state, inp,
+                                      m * (n_stage * k) + idx * k, k)
+        # this stage holds a real microbatch only for idx <= t < idx + M
+        active = (t >= idx) & (t - idx < n_microbatch)
+        state = _masked_state(active, new_state, state)
         # the LAST stage finished microbatch t - (S-1) this tick
         done = t - (n_stage - 1)
         outputs = jnp.where(
@@ -155,15 +209,17 @@ def _gpipe_schedule(fn, my_params, micro, n_stage, idx, axis_name, k):
                 outputs, out, jnp.maximum(done, 0), axis=0),
             outputs)
         relay = lax.ppermute(out, axis_name, fwd_perm)
-        return (relay, outputs), None
+        return (relay, outputs, state), None
 
-    relay0, outputs0 = _varying(axis_name, jnp.zeros_like(micro[0]),
-                                jnp.zeros_like(micro))
-    (_, outputs), _ = lax.scan(tick, (relay0, outputs0), jnp.arange(n_tick))
-    return outputs
+    relay0, outputs0, state0 = _varying(
+        axis_name, jnp.zeros_like(micro[0]), jnp.zeros_like(micro), my_state)
+    (_, outputs, new_state), _ = lax.scan(
+        tick, (relay0, outputs0, state0), jnp.arange(n_tick))
+    return outputs, new_state
 
 
-def _interleaved_schedule(fn, my_params, micro, n_stage, idx, axis_name, v):
+def _interleaved_schedule(fn, my_params, my_state, micro, n_stage, idx,
+                          axis_name, v):
     """Circular schedule: v = k virtual stages per device, one LAYER per
     tick, ring ppermute (stage S-1 wraps to stage 0).  Microbatch m (in
     chunks of S) is injected at tick inj(m) = (m // S)*(v*S) + (m % S) and
@@ -182,21 +238,28 @@ def _interleaved_schedule(fn, my_params, micro, n_stage, idx, axis_name, v):
     n_tick = n_microbatch * v + n_stage - 1
 
     def tick(carry, t):
-        relay, outputs = carry
+        relay, outputs, state = carry
         r = jnp.mod(t - idx, n_stage)          # index within chunk
         c = (t - r) // (v * n_stage)            # chunk id
         m = c * n_stage + r                     # global microbatch id
         vs = (t - r) - c * (v * n_stage)        # virtual stage
         g = jnp.clip(vs // n_stage, 0, v - 1)   # local layer index
         active = (m >= 0) & (m < n_microbatch) & (vs >= 0) & (vs < v * n_stage)
-        layer_params = jax.tree_util.tree_map(
-            lambda a: lax.dynamic_index_in_dim(a, g, 0, keepdims=False),
-            my_params)
+        take_g = lambda a: lax.dynamic_index_in_dim(  # noqa: E731
+            a, g, 0, keepdims=False)
+        layer_params = jax.tree_util.tree_map(take_g, my_params)
+        layer_state = jax.tree_util.tree_map(take_g, state)
         feed = micro[jnp.clip(m, 0, n_microbatch - 1)]
         inp = jnp.where(vs == 0, feed, relay)
         uid = jnp.clip(m, 0, n_microbatch - 1) * (v * n_stage) \
             + jnp.clip(vs, 0, v * n_stage - 1)
-        out = fn(layer_params, inp, uid.astype(jnp.int32))
+        out, new_ls = fn(layer_params, layer_state, inp, uid.astype(jnp.int32))
+        # write local layer g's new state back, only on active ticks
+        state = jax.tree_util.tree_map(
+            lambda buf, new: jnp.where(
+                active, lax.dynamic_update_index_in_dim(buf, new, g, 0),
+                buf),
+            state, new_ls)
         # keep the relay clean on idle ticks so a microbatch's activation
         # survives the ring hop even if schedule holes appear
         out = jnp.where(active, out, relay)
@@ -207,12 +270,13 @@ def _interleaved_schedule(fn, my_params, micro, n_stage, idx, axis_name, v):
                 outputs, out, jnp.clip(m, 0, n_microbatch - 1), axis=0),
             outputs)
         relay = lax.ppermute(out, axis_name, ring_perm)
-        return (relay, outputs), None
+        return (relay, outputs, state), None
 
-    relay0, outputs0 = _varying(axis_name, jnp.zeros_like(micro[0]),
-                                jnp.zeros_like(micro))
-    (_, outputs), _ = lax.scan(tick, (relay0, outputs0), jnp.arange(n_tick))
-    return outputs
+    relay0, outputs0, state0 = _varying(
+        axis_name, jnp.zeros_like(micro[0]), jnp.zeros_like(micro), my_state)
+    (_, outputs, new_state), _ = lax.scan(
+        tick, (relay0, outputs0, state0), jnp.arange(n_tick))
+    return outputs, new_state
 
 
 def stack_stage_params(per_stage_params: list) -> Any:
