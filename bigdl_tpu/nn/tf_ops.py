@@ -675,7 +675,8 @@ class MergeSelect(Module):
     """{pred, true_value, false_value} -> where(pred, t, f).  The import
     lowering of a standalone v1 Switch/Merge cond region: both branches
     compute (pure graphs — same math), Merge selects.  Differentiable
-    (gradients flow through the taken branch)."""
+    (gradients flow through the taken branch; the paired SwitchGate
+    double-where keeps the untaken branch's reverse-mode finite)."""
 
     def apply(self, params, state, x, *, training=False, rng=None):
         pred, t, f = list(x)[:3]
@@ -684,6 +685,40 @@ class MergeSelect(Module):
 
     def output_shape(self, input_shape):
         return list(input_shape)[1]
+
+
+class SwitchGate(Module):
+    """One output side of a v1 Switch in the eager cond fallback:
+    (data, pred) -> data when this side is TAKEN, a ones fill otherwise.
+
+    This is the double-where clamp that pairs with MergeSelect: the
+    untaken branch still executes (eager fallback — both branches are
+    plain graph nodes), but on in-domain ones instead of out-of-domain
+    real data, so its local derivatives are finite and the masked-zero
+    cotangent coming back from MergeSelect's `where` cannot turn into
+    0*NaN (guard-style conds like cond(x>0, sqrt(x), c) fine-tune
+    without NaN gradients).  Forward values of the taken branch are
+    unchanged; the untaken branch's value is discarded by MergeSelect —
+    and in real TF it would be a dead tensor, so the fill is closer to
+    TF semantics than the old pass-through alias.
+    reference: nn/tf/ControlOps.scala SwitchOps."""
+
+    def __init__(self, side: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.side = side  # 1 = true output (:1), 0 = false output (:0)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        data, pred = list(x)[:2]
+        data = jnp.asarray(data)
+        taken = jnp.asarray(pred).reshape(())
+        if not self.side:
+            taken = jnp.logical_not(taken)
+        return jnp.where(taken, data, jnp.ones_like(data)), state
+
+    def output_shape(self, input_shape):
+        if isinstance(input_shape, Table):
+            return input_shape[1]
+        return list(input_shape)[0]
 
 
 class TensorArray:

@@ -1032,12 +1032,18 @@ class _TFImporter:
                 data_inputs[:2])
         elif op == "Switch":
             # standalone v1 tf.cond (frames' Switches never reach here —
-            # their nodes are frame members): both outputs alias the data
-            # value; the Merge selects on the predicate
+            # their nodes are frame members): each output is a SwitchGate
+            # feeding its branch the real data only when that side is
+            # taken (double-where clamp — the untaken branch computes on
+            # in-domain ones, so reverse-mode through it stays finite);
+            # the Merge then selects on the predicate
             # (reference: nn/tf/ControlOps.scala SwitchOps)
-            self._alias(name, data_inputs[0])
-            self.graph_nodes[f"{name}:1"] = self.graph_nodes[name]
-            self.shapes[f"{name}:1"] = self.shapes[name]
+            from bigdl_tpu.nn import tf_ops as _tf
+
+            self._attach(name, _tf.SwitchGate(0, name=name),
+                         [data_inputs[0], data_inputs[1]])
+            self._attach(f"{name}:1", _tf.SwitchGate(1, name=f"{name}_t"),
+                         [data_inputs[0], data_inputs[1]])
             if not hasattr(self, "_switch_pred"):
                 self._switch_pred = {}
             self._switch_pred[name] = data_inputs[1]

@@ -234,6 +234,59 @@ class TestStandaloneCond:
         assert np.all(np.isfinite(grad)), grad
         np.testing.assert_allclose(grad, np.full(4, -1.0))
 
+    def test_nonseparable_guard_cond_fallback_has_no_nan_grad(self, tmp_path):
+        """A cond region that is NOT cleanly separable (a node consumes
+        BOTH Switch sides) falls back to the eager SwitchGate/MergeSelect
+        lowering.  The SwitchGate double-where clamp must keep a
+        guard-style cond (x >= 0 ? sqrt(x) : -x) NaN-free in reverse mode
+        even though both branches execute: the untaken sqrt runs on ones,
+        not on negative data."""
+        import tf_graph_pb2 as tfp
+
+        gd = tfp.GraphDef()
+        _nodedef(gd, "x", "Placeholder")
+        _nodedef(gd, "zero", "Const", value=np.asarray(0.0, np.float32))
+        _nodedef(gd, "axis0", "Const", value=np.asarray(0, np.int32))
+        _nodedef(gd, "s", "Sum", ["x", "axis0"])
+        _nodedef(gd, "pred", "GreaterEqual", ["s", "zero"])
+        _nodedef(gd, "sw", "Switch", ["x", "pred"])
+        # `mix` consumes BOTH Switch sides -> region is ambiguous ->
+        # the structured lax.cond lowering must refuse it
+        _nodedef(gd, "mix", "Mul", ["sw", "sw:1"])
+        _nodedef(gd, "tbr", "Sqrt", ["sw:1"])
+        _nodedef(gd, "fbr", "Neg", ["sw"])
+        _nodedef(gd, "mg", "Merge", ["fbr", "tbr"])
+        _nodedef(gd, "out", "Identity", ["mg"])
+        _nodedef(gd, "out2", "Identity", ["mix"])
+        pb = str(tmp_path / "guard_fallback.pb")
+        with open(pb, "wb") as fh:
+            fh.write(gd.SerializeToString())
+        g, gp, gs = load_tensorflow(pb, ["x"], ["out", "out2"], [(4,)])
+
+        from bigdl_tpu.nn.tf_ops import MergeSelect, TFCond
+
+        assert not any(isinstance(m, TFCond) for m in g.children.values())
+        assert any(isinstance(m, MergeSelect) for m in g.children.values())
+
+        def f(x):
+            return jnp.sum(g.apply(gp, gs, x)[0][1])
+
+        # pred FALSE: out = -x; the sqrt branch runs on gated ones
+        neg = jnp.asarray([-1.0, -2.0, -3.0, -4.0], dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(f(neg)), 10.0, rtol=1e-6)
+        grad = np.asarray(jax.grad(f)(neg))
+        assert np.all(np.isfinite(grad)), grad
+        np.testing.assert_allclose(grad, np.full(4, -1.0))
+        # pred TRUE: out = sqrt(x), grad = 0.5/sqrt(x)
+        pos = jnp.asarray([1.0, 4.0, 9.0, 16.0], dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(f(pos)),
+                                   np.sum(np.sqrt(np.asarray(pos))),
+                                   rtol=1e-6)
+        grad_pos = np.asarray(jax.grad(f)(pos))
+        np.testing.assert_allclose(grad_pos,
+                                   0.5 / np.sqrt(np.asarray(pos)),
+                                   rtol=1e-5)
+
     def test_shared_predicate_multi_output_cond(self, tmp_path):
         """Two Switches + two Merges on one predicate import as a single
         multi-output TFCond (region grouping by predicate)."""
