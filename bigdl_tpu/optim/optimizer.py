@@ -61,6 +61,14 @@ from bigdl_tpu.utils.summary import TrainSummary, ValidationSummary
 logger = logging.getLogger("bigdl_tpu.optim")
 
 
+# fixed-structure driver-loop helpers, compiled once per structure/backend:
+# eager equivalents pay per-op dispatch every step (fold_in) or a fresh
+# XLA compile per burst length (stack) — measured as the dominant loop
+# overhead in benchmarks/bench_trainer_overhead.py
+_pack_scalars = jax.jit(lambda xs: jnp.stack(xs))
+_fold_in = jax.jit(jax.random.fold_in)
+
+
 def _cast_floats(tree, dtype):
     """astype(dtype) on floating leaves, everything else untouched."""
     return jax.tree_util.tree_map(
@@ -286,13 +294,21 @@ class Optimizer:
         return _cast_floats(tree, self.compute_dtype)
 
     def _build_step(self):
-        # cache across optimize() calls: rebuilding the jit closure forces
-        # a retrace (and through a remote compile service, a recompile)
-        # even though nothing changed — incremental fit()/optimize() calls
-        # must reuse the compiled step
+        # cache across optimize() calls ON THIS INSTANCE: rebuilding the
+        # jit closure forces a retrace (and through a remote compile
+        # service, a recompile) even though nothing changed.  Keras
+        # fit() constructs a fresh Optimizer per call, so repeated fit()s
+        # rely on jax's own trace cache keyed by the jitted function —
+        # which this instance cache bypasses rebuilding but cannot share.
+        # content-derived key for the mutable rule table: id() would miss
+        # in-place rule edits (stale compiled step) and can false-hit
+        # after rebinding to a recycled address
+        rules_key = None if self.sharding_rules is None else tuple(
+            (pat.pattern, spec) for pat, spec in self.sharding_rules.rules)
         key = (self.compute_dtype, id(self.model), id(self.criterion),
                id(self.optim_method), self.mesh,
-               tuple(self.processors), self._pipeline_axis())
+               tuple(self.processors), self._pipeline_axis(),
+               rules_key, self.batch_partition)
         if self._compiled is not None and self._compiled_key == key:
             return self._compiled
         self._compiled = self._build_step_uncached()
@@ -493,6 +509,8 @@ class Optimizer:
         depth = self._async_depth()
         pending = deque()  # (epoch, neval, bs, loss_dev, lr_dev)
         drain_clock = [time.perf_counter(), 1.0]  # [last drain t, last dt]
+        lr_cache = [None, None]  # [host float, device scalar]
+        lr_zero = jnp.zeros((), jnp.float32)
 
         def drain(keep: int):
             """Read back completed steps, keeping `keep` in flight.
@@ -518,11 +536,17 @@ class Optimizer:
                 burst.append(pending.popleft())
             # one transfer for losses AND lrs: each readback is a full
             # host<->device round trip, and the round trip (not the bytes)
-            # is the cost
-            packed = np.asarray(
-                jnp.stack([b[3] for b in burst] + [b[4] for b in burst]),
-                np.float32)
-            losses, lrs = packed[:len(burst)], packed[len(burst):]
+            # is the cost.  The burst is PADDED to a fixed width and
+            # packed by a jitted stack: an eager jnp.stack here compiles
+            # a fresh concat executable for every distinct burst length
+            # (measured: dominant loop cost on a local backend) and pays
+            # ~2 eager dispatches per scalar besides.
+            cap = depth + 1
+            pad = [burst[-1]] * (cap - len(burst))
+            packed = np.asarray(_pack_scalars(
+                tuple(b[3] for b in burst + pad)
+                + tuple(b[4] for b in burst + pad)), np.float32)
+            losses, lrs = packed[:len(burst)], packed[cap:cap + len(burst)]
             now = time.perf_counter()
             dt_total = now - drain_clock[0]
             per_step = dt_total / len(burst) if dt_total > 1e-7 \
@@ -563,11 +587,19 @@ class Optimizer:
                 bs = batch.size()
                 x = self._put_batch(batch.get_input())
                 y = self._put_batch(batch.get_target())
-                rng = jax.random.fold_in(root_key, state["neval"])
+                rng = _fold_in(root_key, state["neval"])
                 if self._host_lr():
-                    lr = jnp.asarray(float(self._current_lr()), jnp.float32)
+                    # schedules hold the lr constant for stretches of
+                    # steps; reuse the device scalar instead of a fresh
+                    # host->device put per step (a put can serialize the
+                    # in-flight step pipeline)
+                    lr_f = float(self._current_lr())
+                    if lr_cache[0] != lr_f:
+                        lr_cache[0] = lr_f
+                        lr_cache[1] = jnp.asarray(lr_f, jnp.float32)
+                    lr = lr_cache[1]
                 else:
-                    lr = jnp.zeros((), jnp.float32)  # unused; device schedule
+                    lr = lr_zero  # unused; device schedule
                 (self.params, self.model_state, self.opt_state, loss,
                  lr_used) = step_fn(
                     self.params, self.model_state, self.opt_state, x, y, rng,
@@ -581,8 +613,14 @@ class Optimizer:
                     self._profiled = True
                     self._run_profile(x)
                 record_count_epoch += bs
+                t_cb = time.perf_counter()
                 self._maybe_validate(state)
                 self._maybe_checkpoint(state)
+                dt_cb = time.perf_counter() - t_cb
+                if dt_cb > 1e-3:
+                    # exclude validation/checkpoint time from the next
+                    # drain's per-step throughput attribution
+                    drain_clock[0] += dt_cb
             # epoch boundary: under async depth the backlog can ride
             # across epochs (deterministic triggers never read
             # state['loss']); the synchronous path (depth=0) still
@@ -604,8 +642,12 @@ class Optimizer:
                 self.opt_state = dict(self.opt_state, epoch=new_epoch)
             logger.info("Epoch %d done: %d records in %.1fs",
                         state["epoch"], record_count_epoch, time.time() - epoch_start)
+            t_cb = time.perf_counter()
             self._maybe_validate(state)
             self._maybe_checkpoint(state)
+            dt_cb = time.perf_counter() - t_cb
+            if dt_cb > 1e-3:
+                drain_clock[0] += dt_cb
         drain(0)
         logger.info("Training finished after %d iterations (%.1fs)",
                     state["neval"], time.time() - wall_start)
@@ -809,7 +851,9 @@ class ParallelOptimizer(DistriOptimizer):
         finally:
             for m, a in self._syncbn_saved:
                 m.set_axis_name(a)
-            self._syncbn_saved = []
+            # None (not []): _init_model outside optimize() must not
+            # re-patch axis names with no paired restore
+            self._syncbn_saved = None
 
     def _patch_sync_bn(self) -> None:
         from bigdl_tpu.nn.conv import SpatialConvolutionBN
