@@ -100,6 +100,23 @@ def bench_resnet():
                                                        training=False)[0])
         results[f"{mode}_bnfold"] = _time_fn(qfwd, qp, fstate, x)
 
+    # auto mode: quantize() measures float+all modes itself and keeps the
+    # winner — the row must match the best of the measured modes (VERDICT
+    # r3 item 6: no mode may ship a silent slowdown vs bf16)
+    am, ap = nn.quantize(
+        model, params, mode="auto",
+        sample_input=np.asarray(rs.rand(*shape), np.float32), state=state,
+        calib_batches=[jnp.asarray(rs.rand(8, image, image, 3),
+                                   jnp.float32)])
+    afwd = jax.jit(lambda p, s, x, am=am: am.apply(p, s, x,
+                                                   training=False)[0])
+    results["auto"] = _time_fn(afwd, ap, state, x)
+    print(json.dumps({"auto_picked": am._quant_auto_report["picked"],
+                      "auto_table_ms": {
+                          k: round(v, 2) for k, v in
+                          am._quant_auto_report["ms_per_batch"].items()}}),
+          flush=True)
+
     # repeat the baseline last: the spread between the two bf16 runs is
     # the run-to-run noise floor of the tunnel, printed for honesty
     results["bf16_rep"] = _time_fn(fwd16, p16, state, x)

@@ -216,13 +216,26 @@ class QuantizedSpatialConvolution(_QuantizedBase):
 
 
 def quantize(module: Module, params: Any,
-             mode: str = "dynamic") -> Tuple[Module, Any]:
+             mode: str = "dynamic", *, sample_input=None, state=None,
+             calib_batches=None, bench_iters: int = 10) -> Tuple[Module, Any]:
     """Walk the module tree, swapping Linear/SpatialConvolution (incl.
     dilated) for int8 versions with converted params.  The functional
     analogue of `module.quantize()` (nn/abstractnn/AbstractModule.scala:918
     -> nn/quantized/Quantizer.scala).  `mode`: dynamic | static |
-    weight_only (see _QuantizedBase); static needs a `calibrate()` pass
-    before inference."""
+    weight_only (see _QuantizedBase) | auto; static needs a `calibrate()`
+    pass before inference.
+
+    `mode="auto"` microbenches float + all three int8 modes on the LIVE
+    backend with `sample_input` and returns the fastest — the winning
+    mode flips with the toolchain (round-2 static was 1.26x vs bf16;
+    round-3 re-measure 0.96x, BENCH_APPENDIX.md), so no fixed choice is
+    safe, and returning the FLOAT model when every int8 mode is a
+    slowdown prevents quantize() shipping a regression silently.  The
+    decision table lands on the returned module as
+    `_quant_auto_report`."""
+    if mode == "auto":
+        return _quantize_auto(module, params, sample_input, state,
+                              calib_batches, bench_iters)
     if mode not in ("dynamic", "static", "weight_only"):
         raise ValueError(f"unknown quantization mode {mode!r}")
     from bigdl_tpu.nn.linear import SparseLinear
@@ -275,6 +288,70 @@ def _quantize_graph(g: Graph, params: Any, mode: str) -> Tuple[Graph, Any]:
     ng = Graph(new_inputs, new_outputs)
     ng.name = g.name
     return ng, q_params
+
+
+def _quantize_auto(module: Module, params: Any, sample_input, state,
+                   calib_batches, iters: int) -> Tuple[Module, Any]:
+    """Pick the fastest of {float, dynamic, static, weight_only} by
+    measurement (reference premise: nn/quantized/Quantizer.scala treats
+    int8 as THE fast path — on TPU which mode is fastest depends on the
+    compiler/libtpu version, so measure, don't assume)."""
+    import logging
+    import time
+
+    import jax
+
+    if sample_input is None:
+        raise ValueError(
+            "quantize(mode='auto') needs sample_input= (a representative "
+            "batch) to microbench the modes on the live toolchain")
+    log = logging.getLogger("bigdl_tpu.quantized")
+    state = {} if state is None else state
+    x = jnp.asarray(sample_input)
+    x16 = x.astype(jnp.bfloat16) if jnp.issubdtype(x.dtype, jnp.floating) \
+        else x
+    batches = calib_batches if calib_batches is not None else [x]
+
+    # the float baseline runs TWICE: native dtype AND bf16 (the usual TPU
+    # serving dtype) — comparing int8 only against f32 would let an int8
+    # mode "win" while still being a regression vs bf16 serving
+    p16 = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+        else a, params)
+    candidates = [("float", module, params, x), ("bf16", module, p16, x16)]
+    for m in ("dynamic", "static", "weight_only"):
+        qm, qp = quantize(module, params, m)
+        if m == "static":
+            qp = calibrate(qm, qp, state, batches)
+        candidates.append((m, qm, qp, x))
+
+    def time_mode(mod, p, xi):
+        fwd = jax.jit(lambda p_, x_: mod.apply(p_, state, x_,
+                                               training=False)[0])
+        out = fwd(p, xi)
+        # sync through a dependent readback (block_until_ready does not
+        # truly block through the axon tunnel)
+        float(jnp.sum(out.astype(jnp.float32)))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fwd(p, xi)
+        float(jnp.sum(out.astype(jnp.float32)))
+        return (time.perf_counter() - t0) / iters
+
+    report = []
+    best = None
+    for name, mod, p, xi in candidates:
+        dt = time_mode(mod, p, xi)
+        report.append((name, dt * 1e3))
+        if best is None or dt < best[0]:
+            best = (dt, name, mod, p)
+    _, name, mod, p = best
+    log.info("quantize(auto): %s -> picked %r",
+             ", ".join(f"{n}={ms:.2f}ms" for n, ms in report), name)
+    mod._quant_auto_report = {"picked": name,
+                              "ms_per_batch": dict(report)}
+    return mod, p
 
 
 def calibrate(q_module: Module, q_params: Any, state: Any, batches,

@@ -677,6 +677,10 @@ def _kimp_maxout_dense(root, ws, where: str):
     way for bigdl's (out, in) layout)."""
     path, lin = _locate_inner(root, Linear)
     w = np.asarray(ws[0])
+    if w.ndim != 3:
+        raise ValueError(
+            f"{where}: keras-1 MaxoutDense kernel must be 3-D "
+            f"(nb_feature, in, out), got shape {w.shape}")
     k, din, dout = w.shape
     p = {"weight": jnp.asarray(w.transpose(1, 0, 2).reshape(din, k * dout))}
     if lin.with_bias and len(ws) > 1:
@@ -802,9 +806,12 @@ def convert_model(args: Optional[Sequence[str]] = None) -> None:
                    help="TF checkpoint PREFIX for an UNFROZEN .pb "
                         "(VariableV2/VarHandleOp graphs; reference: "
                         "scripts/export_tf_checkpoint.py)")
-    p.add_argument("--quantize", choices=("dynamic", "static", "weight_only"),
+    p.add_argument("--quantize",
+                   choices=("dynamic", "static", "weight_only", "auto"),
                    help="int8-quantize before writing (native output only; "
-                        "reference: ConvertModel --quantize)")
+                        "reference: ConvertModel --quantize).  'auto' "
+                        "microbenches float + all int8 modes on a random "
+                        "batch of --input-shape and keeps the fastest")
     p.add_argument("--fold-bn", action="store_true",
                    help="fold conv+BN pairs for inference before writing")
     ns = p.parse_args(args)
@@ -849,9 +856,19 @@ def convert_model(args: Optional[Sequence[str]] = None) -> None:
                              "(other formats cannot hold int8 layers)")
         from bigdl_tpu.nn.quantized import quantize
 
-        module, params = quantize(module, params, mode=ns.quantize)
-        print(f"quantized to int8 ({ns.quantize}); static mode needs a "
-              f"calibrate() pass over real data before serving")
+        if ns.quantize == "auto":
+            sample = np.random.RandomState(0).randn(*shape).astype(np.float32)
+            module, params = quantize(module, params, mode="auto",
+                                      sample_input=sample, state=state)
+            rep = getattr(module, "_quant_auto_report",
+                          {"picked": "float", "ms_per_batch": {}})
+            table = ", ".join(f"{k}={v:.2f}ms"
+                              for k, v in rep["ms_per_batch"].items())
+            print(f"quantize auto: {table} -> kept {rep['picked']!r}")
+        else:
+            module, params = quantize(module, params, mode=ns.quantize)
+            print(f"quantized to int8 ({ns.quantize}); static mode needs "
+                  f"a calibrate() pass over real data before serving")
     if ns.dst.endswith(".pt"):
         sd = export_torch_state_dict(module, params, state)
         torch.save({k: torch.from_numpy(np.ascontiguousarray(v))

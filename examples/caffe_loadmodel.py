@@ -1,0 +1,129 @@
+"""Load a Caffe model (prototxt + binary .caffemodel) AND a Torch .t7,
+run inference, then the serving pipeline: fold conv+BN, int8-quantize,
+save native (reference: example/loadmodel — its Test entry loads Caffe /
+Torch / BigDL models and evaluates; utils/caffe/CaffeLoader.scala,
+utils/TorchFile.scala, ConvertModel --quantize).
+
+Without --prototxt the example is self-contained: it builds a small
+conv+BN net, writes a REAL binary .caffemodel + prototxt pair with
+save_caffe, and loads that back.
+
+    python examples/caffe_loadmodel.py \
+        [--prototxt net.prototxt --caffemodel net.caffemodel] \
+        [--quantize dynamic|static|weight_only|auto] [--out ./served]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+SHAPE = (8, 16, 16, 3)
+CLASSES = 5
+
+
+def export_demo_caffe(proto_path, weights_path):
+    """A conv+BN+fc net saved as prototxt + BINARY caffemodel."""
+    import jax
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.utils.caffe import save_caffe
+
+    m = nn.Sequential(
+        nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1, with_bias=False),
+        nn.SpatialBatchNormalization(8), nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Flatten(),
+        nn.Linear(8 * (SHAPE[1] // 2) * (SHAPE[2] // 2), CLASSES),
+        nn.SoftMax())
+    p, s, _ = m.build(jax.random.PRNGKey(0), SHAPE)
+    save_caffe(m, p, s, proto_path, weights_path, input_shape=SHAPE)
+    return proto_path, weights_path
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prototxt", default=None)
+    ap.add_argument("--caffemodel", default=None)
+    ap.add_argument("--quantize", default="dynamic",
+                    choices=("dynamic", "static", "weight_only", "auto"))
+    ap.add_argument("--out", default=None, help="native save dir")
+    args = ap.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.utils.caffe import load_caffe
+    from bigdl_tpu.utils.fusion import fold_batchnorm
+    from bigdl_tpu.utils.serializer import save_model
+    from bigdl_tpu.utils.torchfile import load_t7, save_t7
+
+    tmp = tempfile.mkdtemp(prefix="caffe_loadmodel_")
+    proto, weights = args.prototxt, args.caffemodel
+    if proto is None:
+        proto, weights = export_demo_caffe(
+            os.path.join(tmp, "net.prototxt"),
+            os.path.join(tmp, "net.caffemodel"))
+        print(f"exported demo caffe pair under {tmp}")
+
+    # --- 1. load + predict (reference: loadmodel Caffe leg) ------------
+    model, params, state = load_caffe(proto, weights)
+    rs = np.random.RandomState(0)
+    x = rs.rand(*SHAPE).astype(np.float32)
+    t0 = time.perf_counter()
+    probs, _ = model.apply(params, state, jnp.asarray(x), training=False)
+    probs = np.asarray(probs)
+    print(f"caffe model loaded: {probs.shape[0]} predictions in "
+          f"{(time.perf_counter() - t0) * 1e3:.1f}ms, "
+          f"top-1 classes {np.argmax(probs, -1).tolist()}")
+
+    # --- 2. Torch .t7 leg (reference: loadmodel Torch leg) -------------
+    from bigdl_tpu.utils.interop import export_torch_state_dict, \
+        import_torch_state_dict
+
+    t7 = os.path.join(tmp, "weights.t7")
+    save_t7(t7, {k: np.asarray(v)
+                 for k, v in export_torch_state_dict(
+                     model, params, state).items()})
+    restored = load_t7(t7)
+    params2, state2 = import_torch_state_dict(model, params, state,
+                                              dict(restored))
+    probs2, _ = model.apply(params2, state2, jnp.asarray(x), training=False)
+    drift = float(np.max(np.abs(np.asarray(probs2) - probs)))
+    print(f"torch .t7 round trip: {len(restored)} tensors, "
+          f"max prediction drift {drift:.2e}")
+
+    # --- 3. serving pipeline: fold BN, quantize, save ------------------
+    fm, fp, fs = fold_batchnorm(model, params, state)
+    fold_probs, _ = fm.apply(fp, fs, jnp.asarray(x), training=False)
+    print(f"conv+BN folded: max drift "
+          f"{float(np.max(np.abs(np.asarray(fold_probs) - probs))):.2e}")
+
+    if args.quantize == "auto":
+        qm, qp = nn.quantize(fm, fp, mode="auto", sample_input=x, state=fs)
+        rep = qm._quant_auto_report
+        print(f"quantize auto picked {rep['picked']!r}: "
+              f"{ {k: round(v, 2) for k, v in rep['ms_per_batch'].items()} }")
+    else:
+        qm, qp = nn.quantize(fm, fp, mode=args.quantize)
+        if args.quantize == "static":
+            qp = nn.calibrate(qm, qp, fs, [x])
+    q_probs, _ = qm.apply(qp, fs, jnp.asarray(x), training=False)
+    agree = float(np.mean(np.argmax(np.asarray(q_probs), -1)
+                          == np.argmax(probs, -1)))
+    print(f"int8 ({args.quantize}): top-1 agreement with float "
+          f"{agree:.0%}")
+
+    out = args.out or os.path.join(tmp, "served")
+    save_model(out, qm, qp, fs)
+    print(f"saved serving model to {out}")
+    return probs
+
+
+if __name__ == "__main__":
+    main()

@@ -12,6 +12,11 @@ import pytest
 import bigdl_tpu.nn as nn
 
 
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 class TestKerasConverter:
     def _mlp_json(self):
         return json.dumps({
@@ -364,7 +369,9 @@ class TestConverterWidening:
         y, _ = model.apply(p2, s2, jnp.ones((1, 4, 4, 3)))
         assert y.shape == (1, 6, 6, 5)
 
-    def test_maxout_weights_raise_clearly(self):
+    def test_maxout_weights_import(self):
+        """MaxoutDense weights now import (round-4 WeightsConverter
+        coverage); malformed kernels still raise clearly."""
         from bigdl_tpu.keras.converter import (model_from_json_config,
                                                load_keras_weights)
 
@@ -375,10 +382,17 @@ class TestConverterWidening:
         model = model_from_json_config(spec)
         params, state, _ = model.build(jax.random.PRNGKey(0), (1, 6))
         rs = np.random.RandomState(0)
-        with pytest.raises(ValueError, match="definition-only"):
+        W = rs.randn(2, 6, 3).astype("f")
+        b = rs.randn(2, 3).astype("f")
+        p2, s2 = load_keras_weights(model, params, state, [[W, b]])
+        x = rs.randn(4, 6).astype("f")
+        y, _ = model.apply(p2, s2, jnp.asarray(x))
+        want = np.max(np.einsum("bi,kio->bko", x, W) + b, axis=1)
+        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-5,
+                                   atol=1e-5)
+        with pytest.raises(ValueError, match="3-D"):
             load_keras_weights(model, params, state,
-                               [[rs.randn(6, 2, 3).astype("f"),
-                                 rs.randn(2, 3).astype("f")]])
+                               [[rs.randn(6, 6).astype("f"), b]])
 
     def test_variable_dims_need_explicit_shape(self, tmp_path):
         import json as _json
@@ -656,3 +670,14 @@ class TestConverterWidening:
 
         ex.main()  # asserts accuracy internally
         assert "fine-tuned accuracy" in capsys.readouterr().out
+
+
+class TestCaffeLoadmodelExample:
+    def test_caffe_loadmodel(self):
+        """reference example/loadmodel: Caffe + Torch inference legs plus
+        the serving pipeline (fold BN, int8, native save)."""
+        import examples.caffe_loadmodel as ex
+
+        probs = ex.main([])
+        assert probs.shape == (8, 5)
+        assert np.isfinite(probs).all()
