@@ -272,6 +272,67 @@ def write_checkpoint(prefix: str, tensors: Dict[str, np.ndarray]) -> str:
     return prefix
 
 
+# --- OrderedCode (tensorflow/core/lib/strings/ordered_code.cc) — the
+# binary key encoding bundle writers use for partitioned-variable slice
+# entries via checkpoint::EncodeTensorNameSlice
+# (tensorflow/core/util/saved_tensor_slice_util.cc):
+#   WriteNumIncreasing(0) + WriteString(name) + WriteNumIncreasing(dims)
+#   + per dim WriteSignedNumIncreasing(start), ...(length)
+
+_OC_HEADERS = {1: (0x80, 0), 2: (0xC0, 0), 3: (0xE0, 0), 4: (0xF0, 0),
+               5: (0xF8, 0), 6: (0xFC, 0), 7: (0xFE, 0), 8: (0xFF, 0),
+               9: (0xFF, 0x80), 10: (0xFF, 0xC0)}
+
+
+def _oc_num_increasing(v: int) -> bytes:
+    payload = b"" if v == 0 else v.to_bytes((v.bit_length() + 7) // 8, "big")
+    return bytes([len(payload)]) + payload
+
+
+def _oc_signed_increasing(val: int) -> bytes:
+    x = ~val if val < 0 else val
+    if x < 64:  # single-byte fast path
+        return bytes([(0x80 + val) & 0xFF])
+    n = 1
+    while x >= (1 << (7 * n - 1)):
+        n += 1
+    twos = (val & ((1 << 80) - 1)).to_bytes(10, "big")
+    b = bytearray(twos[10 - n:])
+    h0, h1 = _OC_HEADERS[n]
+    b[0] ^= h0
+    if n >= 2:
+        b[1] ^= h1
+    return bytes(b)
+
+
+def _oc_string(s: bytes) -> bytes:
+    out = bytearray()
+    for c in s:
+        if c == 0x00:
+            out += b"\x00\xff"
+        elif c == 0xFF:
+            out += b"\xff\x00"
+        else:
+            out.append(c)
+    return bytes(out) + b"\x00\x01"
+
+
+def _slice_entry_key(name: str, sp) -> bytes:
+    """The bundle key of one slice's data entry for a partitioned
+    tensor."""
+    out = bytearray(_oc_num_increasing(0))
+    out += _oc_string(name.encode())
+    out += _oc_num_increasing(len(sp.extent))
+    for ext in sp.extent:
+        if ext.HasField("length"):
+            out += _oc_signed_increasing(ext.start)
+            out += _oc_signed_increasing(ext.length)
+        else:  # full extent: TensorSlice stores (0, -1)
+            out += _oc_signed_increasing(0)
+            out += _oc_signed_increasing(-1)
+    return bytes(out)
+
+
 def read_checkpoint(prefix: str) -> Dict[str, np.ndarray]:
     """Read every tensor of a TF v2-format checkpoint into host arrays.
 
@@ -284,7 +345,9 @@ def read_checkpoint(prefix: str) -> Dict[str, np.ndarray]:
             f"{index_path} not found — pass the checkpoint PREFIX "
             f"(e.g. '/dir/model.ckpt'), not a physical file")
     header = None
-    entries: Dict[str, tbp.BundleEntryProto] = {}
+    # keyed by RAW bytes: partitioned-variable slice entries use the
+    # binary OrderedCode key encoding (leading 0x00), not tensor names
+    entries: Dict[bytes, tbp.BundleEntryProto] = {}
     for key, value in _index_entries(index_path):
         if key == b"":
             header = tbp.BundleHeaderProto()
@@ -294,37 +357,84 @@ def read_checkpoint(prefix: str) -> Dict[str, np.ndarray]:
         else:
             e = tbp.BundleEntryProto()
             e.ParseFromString(value)
-            entries[key.decode()] = e
+            entries[bytes(key)] = e
     if header is None:
         raise ValueError(f"{index_path}: missing bundle header entry")
     shards: Dict[int, Any] = {}
     out: Dict[str, np.ndarray] = {}
+
+    def read_raw(name: str, e) -> np.ndarray:
+        np_dtype = _BUNDLE_DTYPES.get(e.dtype)
+        if np_dtype is None:
+            raise ValueError(
+                f"checkpoint tensor {name!r} has unsupported dtype "
+                f"enum {e.dtype}")
+        shape = tuple(d.size for d in e.shape.dim)
+        if e.shard_id not in shards:  # seek per entry, never slurp
+            shards[e.shard_id] = open(
+                f"{prefix}.data-{e.shard_id:05d}"
+                f"-of-{header.num_shards:05d}", "rb")
+        f = shards[e.shard_id]
+        f.seek(e.offset)
+        arr = np.frombuffer(f.read(e.size), np_dtype)
+        if arr.size != int(np.prod(shape)):
+            raise ValueError(
+                f"checkpoint tensor {name!r}: {arr.size} values for "
+                f"shape {shape}")
+        return arr.reshape(shape).copy()
+
     try:
-        for name, e in entries.items():
-            if e.slices:
-                raise ValueError(
-                    f"checkpoint tensor {name!r} is a partitioned-variable "
-                    f"slice — unsupported")
+        for key, e in entries.items():
+            if key.startswith(b"\x00"):
+                continue  # a slice data entry; consumed by its full tensor
+            name = key.decode()
             if e.dtype == _DT_STRING:
                 continue  # bookkeeping (e.g. object-graph blobs)
-            np_dtype = _BUNDLE_DTYPES.get(e.dtype)
-            if np_dtype is None:
-                raise ValueError(
-                    f"checkpoint tensor {name!r} has unsupported dtype "
-                    f"enum {e.dtype}")
-            shape = tuple(d.size for d in e.shape.dim)
-            if e.shard_id not in shards:  # seek per entry, never slurp
-                shards[e.shard_id] = open(
-                    f"{prefix}.data-{e.shard_id:05d}"
-                    f"-of-{header.num_shards:05d}", "rb")
-            f = shards[e.shard_id]
-            f.seek(e.offset)
-            arr = np.frombuffer(f.read(e.size), np_dtype)
-            if arr.size != int(np.prod(shape)):
-                raise ValueError(
-                    f"checkpoint tensor {name!r}: {arr.size} values for "
-                    f"shape {shape}")
-            out[name] = arr.reshape(shape).copy()
+            if e.slices:
+                # partitioned variable (tf.compat.v1 partitioners): the
+                # full-tensor entry lists TensorSliceProtos; each slice's
+                # data lives in a sibling entry under its OrderedCode key.
+                # Reassemble host-side.
+                full_shape = tuple(d.size for d in e.shape.dim)
+                np_dtype = _BUNDLE_DTYPES.get(e.dtype)
+                if np_dtype is None:
+                    raise ValueError(
+                        f"checkpoint tensor {name!r} has unsupported "
+                        f"dtype enum {e.dtype}")
+                full = np.zeros(full_shape, np_dtype)
+                covered = 0
+                parts = []
+                for sp in e.slices:
+                    skey = _slice_entry_key(name, sp)
+                    se = entries.get(skey)
+                    if se is None:
+                        raise ValueError(
+                            f"partitioned tensor {name!r}: missing slice "
+                            f"entry for extents "
+                            f"{[(x.start, x.length) for x in sp.extent]}")
+                    part = read_raw(name, se)
+                    idx = tuple(
+                        slice(ext.start, ext.start + ext.length)
+                        if ext.HasField("length") else slice(None)
+                        for ext in sp.extent)
+                    full[idx] = part
+                    covered += part.size
+                    starts = tuple(ext.start for ext in sp.extent)
+                    parts.append((starts, part))
+                if covered != full.size:
+                    raise ValueError(
+                        f"partitioned tensor {name!r}: slices cover "
+                        f"{covered} of {full.size} elements")
+                out[name] = full
+                # graphs built under a v1 variable partitioner hold the
+                # PARTS as their VariableV2 nodes ("{name}/part_{i}");
+                # expose each slice under that name so variable binding
+                # at import needs no special casing
+                for i, (_, part) in enumerate(sorted(parts,
+                                                     key=lambda t: t[0])):
+                    out.setdefault(f"{name}/part_{i}", part)
+                continue
+            out[name] = read_raw(name, e)
     finally:
         for f in shards.values():
             f.close()

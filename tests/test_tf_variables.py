@@ -20,6 +20,11 @@ import bigdl_tpu.nn as nn  # noqa: E402
 from bigdl_tpu.utils.tensorflow import load_tensorflow  # noqa: E402
 from bigdl_tpu.utils.tf_checkpoint import read_checkpoint  # noqa: E402
 
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 N, H, W, C = 4, 8, 8, 3
 FILTERS, CLASSES = 6, 5
 
@@ -283,3 +288,84 @@ class TestSummarizeGraph:
             {"conv_w", "conv_b", "fc_w"}
         assert "out" in s["likely_outputs"]
         assert s["ops"]["VariableV2"] == 3
+
+
+def _build_partitioned_graph(tmp_path, n_parts=2):
+    """v1 graph whose fc weight is created under a fixed-size variable
+    partitioner: the checkpoint stores 'fc_w' as a full-tensor entry with
+    TensorSliceProtos plus per-slice data entries, and the GraphDef holds
+    the parts as separate VariableV2 nodes 'fc_w/part_i'."""
+    rs = np.random.RandomState(11)
+    din, dout = 6, CLASSES
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [N, din], name="x")
+        w = tf.compat.v1.get_variable(
+            "fc_w", shape=(din, dout),
+            partitioner=tf.compat.v1.fixed_size_partitioner(n_parts),
+            initializer=tf.compat.v1.random_normal_initializer(
+                stddev=0.3, seed=11),
+            use_resource=False)
+        b = tf.compat.v1.get_variable(
+            "fc_b", shape=(dout,),
+            initializer=tf.compat.v1.random_normal_initializer(
+                stddev=0.1, seed=12),
+            use_resource=False)
+        y = tf.linalg.matmul(x, tf.convert_to_tensor(w)) + b
+        y = tf.identity(y, name="out")
+        init = tf.compat.v1.global_variables_initializer()
+        saver = tf.compat.v1.train.Saver()
+    xv = rs.randn(N, din).astype(np.float32)
+    with tf.compat.v1.Session(graph=g) as sess:
+        sess.run(init)
+        ref, wv = sess.run([y, tf.convert_to_tensor(w)], {x: xv})
+        prefix = saver.save(sess, str(tmp_path / "part.ckpt"))
+    pb = str(tmp_path / "part_graph.pb")
+    with open(pb, "wb") as fh:
+        fh.write(g.as_graph_def().SerializeToString())
+    return pb, prefix, xv, ref, wv, din
+
+
+class TestPartitionedVariables:
+    def test_partitioned_checkpoint_reassembles(self, tmp_path):
+        """BundleEntryProto.slices: the full tensor reassembles from its
+        slice entries and matches TF's own loader; the per-part aliases
+        carry the slices in order."""
+        _, prefix, _, _, wv, din = _build_partitioned_graph(tmp_path)
+        ours = read_checkpoint(prefix)
+        np.testing.assert_allclose(ours["fc_w"], wv, rtol=1e-6)
+        # parity with TF's reader on the full tensor
+        reader = tf.train.load_checkpoint(prefix)
+        np.testing.assert_allclose(ours["fc_w"],
+                                   reader.get_tensor("fc_w"), rtol=1e-6)
+        # part aliases stack back to the full tensor (partitioned on dim 0)
+        np.testing.assert_allclose(
+            np.concatenate([ours["fc_w/part_0"], ours["fc_w/part_1"]],
+                           axis=0), wv, rtol=1e-6)
+
+    def test_partitioned_graph_restores_and_finetunes(self, tmp_path):
+        """The VERDICT 'done' criterion: a 2-way-partitioned variable
+        fixture restores (forward parity vs the TF session) and
+        fine-tunes via Session."""
+        from bigdl_tpu.dataset import ArrayDataSet, Sample, SampleToMiniBatch
+        from bigdl_tpu.optim import SGD, Trigger
+        from bigdl_tpu.utils.session import Session
+
+        pb, prefix, xv, ref, _, din = _build_partitioned_graph(tmp_path)
+        g0, gp0, gs0 = load_tensorflow(pb, ["x"], ["out"], [(N, din)],
+                                       checkpoint=prefix)
+        out0, _ = g0.apply(gp0, gs0, jnp.asarray(xv))
+        np.testing.assert_allclose(np.asarray(out0), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+        labels = (np.arange(N) % CLASSES).astype(np.int32)
+        samples = [Sample.from_ndarray(xv[i], labels[i]) for i in range(N)]
+        ds = ArrayDataSet(samples).transform(SampleToMiniBatch(N))
+        sess = Session(pb, ["x"], [(N, din)], checkpoint=prefix)
+        crit = nn.CrossEntropyCriterion()
+        loss0 = float(crit.forward(jnp.asarray(out0), jnp.asarray(labels)))
+        sess.train(["out"], ds, crit, optim_method=SGD(learning_rate=0.5),
+                   end_when=Trigger.max_epoch(30))
+        out1, _ = sess.model.apply(sess.params, sess.state, jnp.asarray(xv))
+        loss1 = float(crit.forward(out1, jnp.asarray(labels)))
+        assert loss1 < loss0 * 0.5, (loss0, loss1)
