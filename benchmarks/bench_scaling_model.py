@@ -42,10 +42,16 @@ BACKWARD_FRACTION = 0.6        # bwd ~2/3 of fwd+bwd FLOPs; overlap window
 ICI_ALGO_BW = 90e9   # bytes/s effective all-reduce bandwidth per chip
 #   (v5e: 4 ICI links x ~45 GB/s raw; ring algorithm efficiency + framing
 #    derate to ~90 GB/s usable — conservative vs the scaling-book figures)
-CHIPS_PER_SLICE = 256  # v5e slice ceiling: ICI-only up to 256 chips; the
-#   table deliberately stops here — the DCN/multislice regime is NOT
-#   modeled (Engine.build_multislice_mesh exists, but an honest DCN model
-#   needs cross-slice measurements this environment cannot produce)
+CHIPS_PER_SLICE = 256  # v5e slice ceiling: ICI-only up to 256 chips
+DCN_ALGO_BW = 6.25e9  # bytes/s per chip cross-slice (50 Gbps) — a STATED
+#   ASSUMPTION, not a measurement (this environment has no second slice);
+#   conservative vs public v5e multislice figures.  The multislice rows
+#   model the hierarchical all-reduce Engine.build_multislice_mesh's
+#   layout produces: within-slice reduce-scatter + all-gather over ICI
+#   (the full 2(n-1)/n ring), plus a cross-slice all-reduce of each
+#   chip's G/n_slice_chips gradient shard over DCN
+#   (2(S-1)/S * G/chips_per_slice wire bytes per chip).
+DCN_HOP_LATENCY_S = 10e-6  # per cross-slice hop (assumption, printed)
 
 
 _COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
@@ -173,7 +179,54 @@ def model_scaling(grad_bytes_per_chip, chips=(8, 16, 32, 64, 128, 256),
     return rows
 
 
+def model_scaling_multislice(grad_bytes_per_chip, slices=(2, 4, 8),
+                             chips_per_slice=CHIPS_PER_SLICE,
+                             ici_bw=ICI_ALGO_BW, dcn_bw=DCN_ALGO_BW,
+                             overlap_frac=BACKWARD_FRACTION):
+    """Pod-scale rows past the single-slice ceiling: hierarchical
+    all-reduce = full within-slice ring over ICI + cross-slice all-reduce
+    of the per-chip gradient SHARD over DCN (the layout
+    Engine.build_multislice_mesh encodes: data axis outermost, crossing
+    slices)."""
+    rows = []
+    t_step = STEP_MS_1CHIP / 1e3
+    overlap = t_step * overlap_frac
+    n = chips_per_slice
+    for s in slices:
+        chips = s * n
+        ici_moved = grad_bytes_per_chip * 2 * (n - 1) / n
+        dcn_moved = (grad_bytes_per_chip / n) * 2 * (s - 1) / s
+        t_comm = (ici_moved / ici_bw + 2 * (n - 1) * HOP_LATENCY_S
+                  + dcn_moved / dcn_bw + 2 * (s - 1) * DCN_HOP_LATENCY_S)
+        exposed = max(0.0, t_comm - overlap)
+        t_n = t_step + exposed
+        rows.append({
+            "model": "multislice",
+            "slices": s,
+            "chips": chips,
+            "per_chip_ici_MB": round(ici_moved / 1e6, 1),
+            "per_chip_dcn_MB": round(dcn_moved / 1e6, 2),
+            "t_comm_ms": round(t_comm * 1e3, 2),
+            "exposed_ms": round(exposed * 1e3, 2),
+            "ms_per_step": round(t_n * 1e3, 1),
+            "img_s_total": round(256 * chips / t_n),
+            "efficiency_vs_1slice": None,
+        })
+    return rows
+
+
 def main():
+    # the axon sitecustomize registers/initializes the TPU plugin at
+    # interpreter startup; force the 8-virtual-device CPU platform the
+    # same way the graft entry's dryrun does
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import __graft_entry__ as ge
+
+    ge._force_virtual_cpu(8)
     total, per_op, n_params = measure_collectives()
     print(json.dumps({"hlo_collective_bytes_8dev": total,
                       "per_op": per_op,
@@ -183,7 +236,12 @@ def main():
     # backward overlap — every collective byte is exposed
     worst = model_scaling(total, ici_bw=45e9, overlap_frac=0.0,
                           label="no-overlap/45GBs")
-    for r in rows + worst:
+    multi = model_scaling_multislice(total)
+    base = rows[-1]["img_s_total"] / rows[-1]["chips"]  # 256-chip slice
+    for r in multi:
+        r["efficiency_vs_1slice"] = round(
+            r["img_s_total"] / r["chips"] / base, 3)
+    for r in rows + worst + multi:
         print(json.dumps(r), flush=True)
     print(json.dumps({"assumptions": {
         "step_ms_1chip_b256": STEP_MS_1CHIP,
@@ -193,7 +251,9 @@ def main():
         "overlap_window_fraction": BACKWARD_FRACTION,
         "weak_scaling_batch_per_chip": 256,
         "chips_per_slice": CHIPS_PER_SLICE,
-    }, "table": rows, "pessimistic": worst}))
+        "dcn_algo_bw_GBs_ASSUMED": DCN_ALGO_BW / 1e9,
+        "dcn_hop_latency_us_ASSUMED": DCN_HOP_LATENCY_S * 1e6,
+    }, "table": rows, "pessimistic": worst, "multislice": multi}))
 
 
 if __name__ == "__main__":

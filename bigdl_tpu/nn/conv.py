@@ -414,15 +414,21 @@ class SpatialConvolutionMap(Module):
         self.pad = (pad_h, pad_w)
         self.with_bias = with_bias
 
-    def _mask(self):
+    def _mask(self, n_input=None, n_output=None):
         import numpy as _np
-        m = _np.zeros((self.n_input, self.n_output), _np.float32)
+        m = _np.zeros((n_input or self.n_input, n_output or self.n_output),
+                      _np.float32)
         for i, o in self.conn_table:
             m[i, o] = 1.0
         return jnp.asarray(m)
 
     def build(self, rng, input_shape):
         kh, kw = self.kernel
+        # the table's max input index under-counts when the highest input
+        # features happen to be unconnected (legal for random tables —
+        # torch's nn.tables.random can skip features); the real channel
+        # count comes from the input
+        self.n_input = max(self.n_input, int(input_shape[-1]))
         # torch init: stdv = 1/sqrt(kW*kH*nInputPlane) per connection
         fan = kh * kw * max(1, len(self.conn_table) // self.n_output)
         k_w, k_b = jax.random.split(rng)
@@ -436,8 +442,13 @@ class SpatialConvolutionMap(Module):
         return params, {}, self.output_shape(input_shape)
 
     def apply(self, params, state, x, *, training=False, rng=None):
+        # mask dims come from the WEIGHT, not self.n_input: build() may
+        # have widened the input width beyond the table's max index, and
+        # a serializer-reloaded module only knows its __init__ args
+        w = params["weight"]
         y = lax.conv_general_dilated(
-            x, params["weight"] * self._mask(), window_strides=self.stride,
+            x, w * self._mask(w.shape[2], w.shape[3]),
+            window_strides=self.stride,
             padding=[(self.pad[0], self.pad[0]), (self.pad[1], self.pad[1])],
             dimension_numbers=_DIMSPEC_2D)
         if self.with_bias:

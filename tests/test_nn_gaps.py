@@ -14,6 +14,11 @@ import bigdl_tpu.nn as nn
 from bigdl_tpu.core.table import Table
 
 
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 def run(module, x, training=False):
     from bigdl_tpu.nn.module import shape_of
     params, state, out_shape = module.build(jax.random.PRNGKey(0), shape_of(x))
@@ -227,3 +232,21 @@ class TestReviewRegressions:
         d = nn.random_connection_table(8, 8, 4, seed=5)
         assert c == d
         assert a != b or a != c  # fresh entropy (overwhelmingly likely)
+
+
+class TestConnectionTableWidening:
+    def test_unused_top_input_features(self):
+        """A random table may leave the highest input features unconnected
+        (torch nn.tables.random allows it); the conv must still accept the
+        full-width input, including after a serializer round trip."""
+        from bigdl_tpu.utils.serializer import module_from_spec, module_to_spec
+
+        table = [(0, o) for o in range(3)] + [(1, o) for o in range(3)]
+        m = nn.SpatialConvolutionMap(table, 3, 3)  # inputs 2,3 unused
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 6, 6, 4))
+        p, s, _ = m.build(jax.random.PRNGKey(1), (1, 6, 6, 4))
+        y, _ = m.apply(p, s, x)
+        assert y.shape == (1, 4, 4, 3)
+        m2 = module_from_spec(module_to_spec(m))
+        y2, _ = m2.apply(p, s, x)  # reloaded module, widened params
+        np.testing.assert_allclose(np.asarray(y2), np.asarray(y))
