@@ -39,3 +39,13 @@ jax.config.update("jax_default_matmul_precision", "highest")
 @pytest.fixture()
 def rng():
     return jax.random.PRNGKey(0)
+
+
+def pytest_configure(config):
+    # two-tier test strategy (the reference tag-splits integration tests,
+    # spark/dl/pom.xml:327-341): the quick tier is `pytest -m "not slow"`
+    # (<2 min); the full tier runs everything
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight tier — differential oracles, trainer loops, "
+        "registry-wide sweeps; deselect with -m \"not slow\"")

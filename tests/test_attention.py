@@ -19,6 +19,11 @@ from bigdl_tpu.models import TransformerLM
 from bigdl_tpu.ops.attention import dense_attention, ring_attention, ulysses_attention
 
 
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 def _qkv(rng, b=2, s=32, h=4, d=16):
     ks = jax.random.split(rng, 3)
     shape = (b, s, h, d)

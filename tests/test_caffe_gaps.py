@@ -12,6 +12,11 @@ from bigdl_tpu.utils.caffe import load_caffe
 
 import caffe_pb2  # path registered by the caffe util import
 
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
+
 
 def _write_net(tmp_path, body, name="net"):
     proto = f'name: "{name}"\ninput: "data"\n' \

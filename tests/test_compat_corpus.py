@@ -18,6 +18,11 @@ import jax
 
 from bigdl_tpu.utils import serializer as ser
 
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "compat")
 
 

@@ -13,8 +13,14 @@ import jax
 import jax.numpy as jnp
 
 import bigdl_tpu.nn as nn
+import pytest
+
 from bigdl_tpu.ops.conv_bn_stats import (_dense_matmul_stats,
                                          conv1x1_bn_stats, matmul_bn_stats)
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
 
 N, H, W, CIN, COUT = 4, 8, 8, 16, 32
 

@@ -11,6 +11,13 @@ import bigdl_tpu.nn as nn
 from bigdl_tpu.core.table import Table
 from bigdl_tpu.nn.detection import bbox_iou, bbox_transform_inv, nms
 
+import pytest
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
+
 
 class TestBoxMath:
     def test_iou(self):

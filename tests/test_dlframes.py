@@ -9,10 +9,16 @@ import pytest
 import bigdl_tpu.nn as nn
 from bigdl_tpu.optim.optim_method import Adam
 from bigdl_tpu.dlframes import (
+
     DLClassifier,
     DLEstimator,
     DLImageTransformer,
 )
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 
 
 def _class_df(n=128, d=8, classes=3, seed=0):

@@ -16,6 +16,11 @@ from bigdl_tpu.utils import serializer as ser
 torch = pytest.importorskip("torch")
 
 
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 def _import_from_torch(model, our, shape, seed=0):
     params, state, _ = our.build(jax.random.PRNGKey(seed), shape)
     return interop.import_torch_state_dict(our, params, state,

@@ -14,6 +14,11 @@ import bigdl_tpu.nn as nn
 from bigdl_tpu.utils import serializer as ser
 
 
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 def make_blobs(n=256, d=8, classes=4, seed=0):
     rs = np.random.RandomState(seed)
     centers = rs.randn(classes, d) * 3.0

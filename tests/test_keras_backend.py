@@ -13,8 +13,14 @@ import numpy as np
 import pytest
 
 from bigdl_tpu.keras.backend import (KerasModelWrapper,
+
                                      to_bigdl_optim_method,
                                      with_bigdl_backend)
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 
 IN, HID, OUT = 4, 8, 3
 

@@ -13,6 +13,11 @@ from bigdl_tpu.core.table import Table
 from bigdl_tpu.nn.module import shape_of
 
 
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 def run(layer, x, training=False):
     params, state, out_shape = layer.build(jax.random.PRNGKey(0), shape_of(x))
     y, _ = layer.apply(params, state, x, training=training,

@@ -17,7 +17,13 @@ import jax.numpy as jnp
 import bigdl_tpu.nn as nn
 from bigdl_tpu.core.table import Table
 from bigdl_tpu.keras.converter import (load_keras_model,
+
                                        model_from_json_config)
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 
 A, B, HID, OUT, BATCH = 4, 6, 5, 3, 7
 

@@ -23,6 +23,11 @@ from bigdl_tpu.keras.converter import model_from_json_config
 from bigdl_tpu.keras.topology import Sequential as KSequential
 from bigdl_tpu.utils import interop
 
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 RS = np.random.RandomState
 
 

@@ -8,9 +8,15 @@ import pytest
 
 import bigdl_tpu.nn as nn
 from bigdl_tpu.models import (
+
     Autoencoder, InceptionV1, LeNet5, PTBModel, ResNet, SimpleRNN,
     VggForCifar10, resnet_cifar, resnet50,
 )
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 
 
 def build_forward(model, shape, train=False):

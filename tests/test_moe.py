@@ -11,6 +11,11 @@ from bigdl_tpu.core.engine import AXIS_DATA, AXIS_EXPERT, Engine
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 def _moe(d=8, e=4, k=1, **kw):
     m = nn.MoE(d, e, k=k, mlp_ratio=2, **kw)
     p, s, _ = m.build(jax.random.PRNGKey(0), (2, 6, d))

@@ -12,6 +12,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from bigdl_tpu.core.engine import AXIS_DATA, AXIS_MODEL, Engine
 
 
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 def two_slices(d):
     return d.id // 4  # simulated: ranks 0-3 = slice 0, ranks 4-7 = slice 1
 

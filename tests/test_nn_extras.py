@@ -11,6 +11,11 @@ import bigdl_tpu.nn as nn
 from bigdl_tpu.core.table import Table
 
 
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 def run(module, x, training=False):
     from bigdl_tpu.nn.module import shape_of
     params, state, _ = module.build(jax.random.PRNGKey(0), shape_of(x))

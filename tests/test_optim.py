@@ -18,9 +18,15 @@ from bigdl_tpu import optim
 from bigdl_tpu.core.engine import Engine
 from bigdl_tpu.dataset import ArrayDataSet, Sample, SampleToMiniBatch
 from bigdl_tpu.optim import (
+
     SGD, Adam, Adadelta, Adagrad, Adamax, Ftrl, RMSprop, Trigger,
     Top1Accuracy, Loss,
 )
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 
 
 def quad_problem():

@@ -11,6 +11,11 @@ import bigdl_tpu.nn as nn
 from bigdl_tpu.core.engine import AXIS_DATA, AXIS_PIPELINE, Engine
 from bigdl_tpu.parallel import pipeline_apply, stack_stage_params
 
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 N_STAGE = 4
 D = 6
 

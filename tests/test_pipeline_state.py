@@ -26,6 +26,11 @@ from bigdl_tpu.optim import Adam, Trigger
 from bigdl_tpu.parallel import pipeline_apply, stack_stage_params
 from bigdl_tpu.parallel.sharding import ShardingRules
 
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 N_STAGE, D = 4, 6
 
 

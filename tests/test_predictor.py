@@ -24,6 +24,11 @@ from bigdl_tpu.optim import (
     Loss,
 )
 
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
+
 
 @pytest.fixture(scope="module")
 def small_model():

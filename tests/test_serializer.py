@@ -20,6 +20,11 @@ from bigdl_tpu.core.table import Table
 from bigdl_tpu.utils import serializer as ser
 
 
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 def rand(*shape):
     return jnp.asarray(np.random.RandomState(0).randn(*shape).astype(np.float32))
 
@@ -32,6 +37,12 @@ def _transformer_lm():
     from bigdl_tpu.models import TransformerLM
 
     return TransformerLM(vocab_size=20, hidden_size=16, n_layer=2, n_head=2)
+
+
+def _pipelined_convnet():
+    from bigdl_tpu.models import PipelinedConvNet
+
+    return PipelinedConvNet(2, 3, width=4, n_layer=2)
 
 
 # class name -> (factory, input builder or None for spec-only round-trip)
@@ -197,6 +208,8 @@ EXEMPLARS = {
     "TransformerLM": (lambda: _transformer_lm(),
                       lambda: jnp.asarray(
                           np.random.RandomState(3).randint(0, 20, (2, 6)))),
+    "PipelinedConvNet": (lambda: _pipelined_convnet(),
+                         lambda: rand(4, 4, 4, 2)),
     "QuantizedLinear": (lambda: nn.QuantizedLinear(4, 3), lambda: rand(2, 4)),
     "WeightOnlyInt8": (lambda: nn.WeightOnlyInt8(nn.Linear(4, 3), min_size=1),
                        lambda: rand(2, 4)),
@@ -558,6 +571,7 @@ OPS_EXEMPLARS = {
         _tiny_graph(), _tiny_graph(), n_vars=1, trip_count=2),
     "tf.TFCond": lambda: nn.tf_ops.TFCond(_tiny_graph(), _tiny_graph()),
     "tf.MergeSelect": lambda: nn.tf_ops.MergeSelect(),
+    "tf.SwitchGate": lambda: nn.tf_ops.SwitchGate(1),
 }
 EXEMPLARS.update({k: (v, None) for k, v in OPS_EXEMPLARS.items()})
 

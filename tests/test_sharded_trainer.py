@@ -23,6 +23,13 @@ from bigdl_tpu.optim import SGD, Adam, Trigger
 from bigdl_tpu.parallel import ShardingRules
 
 
+
+import pytest
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 def make_ds(n=128, dim=8, classes=4, batch=32, seed=0):
     centers = np.random.RandomState(1234).randn(classes, dim).astype(np.float32) * 3
     rs = np.random.RandomState(seed)

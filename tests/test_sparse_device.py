@@ -22,6 +22,13 @@ from bigdl_tpu.dataset.tfrecord import ParsedExampleDataSet, TFRecordWriter
 from bigdl_tpu.nn.tf_ops import build_example_proto
 from bigdl_tpu.optim import DistriOptimizer, SGD, Trigger
 
+import pytest
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
+
 VOCAB, B, NNZ, OUT = 40, 6, 5, 3
 
 

@@ -20,6 +20,11 @@ from bigdl_tpu.dataset.tfrecord import ParsedExampleDataSet, TFRecordWriter
 from bigdl_tpu.nn.tf_ops import build_example_proto
 from bigdl_tpu.optim import DistriOptimizer, SGD, Trigger
 
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
+
 VOCAB, CLASSES, MAXLEN, BATCH, N = 24, 3, 6, 8, 96
 
 

@@ -21,6 +21,11 @@ import jax.numpy as jnp
 import bigdl_tpu.nn as nn
 from bigdl_tpu.utils.tensorflow import load_tensorflow
 
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
+
 FIX = os.path.join(os.path.dirname(__file__), "fixtures", "tf_while")
 
 

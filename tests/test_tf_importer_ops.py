@@ -14,6 +14,11 @@ from bigdl_tpu.utils.tensorflow import load_tensorflow, ndarray_to_tensor
 import tf_graph_pb2 as tfp  # path registered by the tensorflow util import
 
 
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 def _const(gd, name, arr):
     n = gd.node.add()
     n.name = name

@@ -14,9 +14,15 @@ import jax.numpy as jnp
 tf = pytest.importorskip("tensorflow")
 
 from tensorflow.python.framework.convert_to_constants import (  # noqa: E402
+
     convert_variables_to_constants_v2)
 
 from bigdl_tpu.utils.tensorflow import load_tensorflow  # noqa: E402
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 
 
 def freeze(fn, spec, dtype=tf.float32):

@@ -12,12 +12,18 @@ import jax.numpy as jnp
 tf = pytest.importorskip("tensorflow")
 
 from tensorflow.python.framework.convert_to_constants import (  # noqa: E402
+
     convert_variables_to_constants_v2)
 
 import bigdl_tpu.nn as nn  # noqa: E402
 from bigdl_tpu.dataset.tfrecord import TFRecordWriter  # noqa: E402
 from bigdl_tpu.optim import SGD, Trigger  # noqa: E402
 from bigdl_tpu.utils.session import Session  # noqa: E402
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
 
 BATCH = 8
 DIM, CLASSES = 4, 3

@@ -9,6 +9,13 @@ import bigdl_tpu.nn as nn
 from bigdl_tpu.core.table import Table
 from bigdl_tpu.optim import TreeNNAccuracy
 
+import pytest
+
+# heavyweight tier: differential oracles / trainers / registry sweeps;
+# the quick tier is 'pytest -m "not slow"' (README Testing)
+pytestmark = pytest.mark.slow
+
+
 
 def _tree_inputs():
     """Two trees over 4-word sentences, padded to 7 nodes.
