@@ -817,17 +817,18 @@ class ParallelOptimizer(DistriOptimizer):
     — the hand-built priority-queue overlap, for free, at finer (per-leaf)
     granularity than the reference's per-layer blocks.
 
+    `sharding_rules` COMPOSE with the overlap: only the 'data' axis is
+    MANUAL in the shard_map (`axis_names={'data'}`); every other mesh
+    axis stays under GSPMD, so tensor-parallel layouts propagate from the
+    rule-sharded params exactly as on the DistriOptimizer path while the
+    data-axis gradient sync keeps its per-leaf overlap schedule.
+
     BatchNormalization layers are switched to cross-shard statistics
     (`set_axis_name`) so training semantics match the pjit path's global
     batch stats (and the reference's `setParallism` sync-BN).
     """
 
     def optimize(self):
-        if self.sharding_rules is not None:
-            raise ValueError(
-                "ParallelOptimizer's per-leaf-collective shard_map step is "
-                "data-parallel only (params replicated); use DistriOptimizer "
-                "for sharding_rules-based tp/sp/ep")
         if self.batch_partition is not None:
             raise ValueError(
                 "ParallelOptimizer shards the batch P('data') only; use "
@@ -918,8 +919,16 @@ class ParallelOptimizer(DistriOptimizer):
 
         rep = P()
         data = P(AXIS_DATA)
+        kwargs = {}
+        if self.sharding_rules is not None or len(mesh.shape) > 1:
+            # manual over 'data' only: the in/out specs constrain just the
+            # data axis (params replicated over it), while tp/ep axes stay
+            # AUTO — GSPMD propagates the rule-applied param shardings
+            # through the body and inserts the model-axis collectives,
+            # composing with the per-leaf data-axis gradient psums
+            kwargs["axis_names"] = frozenset({AXIS_DATA})
         sharded = jax.shard_map(
             shard_step, mesh=mesh,
             in_specs=(rep, rep, rep, data, data, rep, rep),
-            out_specs=(rep, rep, rep, rep, rep))
+            out_specs=(rep, rep, rep, rep, rep), **kwargs)
         return jax.jit(sharded, donate_argnums=(0, 1, 2))

@@ -438,6 +438,47 @@ class TestParallelOptimizer:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-5, atol=2e-5)
 
+    def test_composes_with_tensor_parallel(self):
+        """sharding_rules on ParallelOptimizer: the 'data' axis stays
+        MANUAL (per-leaf overlapped gradient psums) while tp axes run
+        under GSPMD — same weights as the DistriOptimizer tp path, with
+        the fc genuinely sharded over 'model'."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from bigdl_tpu.core.engine import AXIS_DATA, AXIS_MODEL, Engine
+        from bigdl_tpu.core.random import RandomGenerator
+        from bigdl_tpu.optim import (DistriOptimizer, ParallelOptimizer,
+                                     SGD, Trigger)
+        from bigdl_tpu.parallel import ShardingRules
+
+        mesh = Engine.build_mesh(devices=jax.devices(),
+                                 **{AXIS_DATA: 4, AXIS_MODEL: 2})
+
+        def train(cls):
+            ds, _, _ = self._data()
+            RandomGenerator.set_seed(9)
+            model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                  nn.Linear(16, 4), nn.LogSoftMax())
+            rules = (ShardingRules()
+                     .add(r"^2/weight$", P(None, AXIS_MODEL))
+                     .add(r"^2/bias$", P(AXIS_MODEL)))
+            opt = cls(model, ds, nn.ClassNLLCriterion(),
+                      optim_method=SGD(learning_rate=0.1, momentum=0.9),
+                      mesh=mesh, sharding_rules=rules,
+                      end_trigger=Trigger.max_epoch(2))
+            opt.optimize()
+            return opt
+
+        o1 = train(DistriOptimizer)
+        o2 = train(ParallelOptimizer)
+        fc = o2.params["2"]["weight"]
+        assert AXIS_MODEL in str(fc.sharding.spec), fc.sharding.spec
+        for a, b in zip(jax.tree_util.tree_leaves(o1.params),
+                        jax.tree_util.tree_leaves(o2.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-5, atol=2e-5)
+
     def test_sync_bn_enabled(self):
         import jax
         from bigdl_tpu.core.engine import AXIS_DATA, Engine

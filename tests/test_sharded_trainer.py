@@ -223,15 +223,25 @@ class TestShardedDistriOptimizer:
                    if AXIS_MODEL in str(leaf.sharding.spec)]
         assert sharded, "no keras param ended up tp-sharded"
 
-    def test_parallel_optimizer_rejects_rules(self):
+    def test_parallel_optimizer_accepts_rules(self):
+        """Round-3 weak #8 closed: sharding_rules compose with the
+        per-leaf overlap (tp axes run AUTO inside the shard_map; the
+        parity test lives in test_optim.TestParallelOptimizer).
+        batch_partition remains data-only."""
         import pytest
 
         mesh = Engine.build_mesh(**{AXIS_DATA: 8})
         o = optim.ParallelOptimizer(mlp(), make_ds(), nn.ClassNLLCriterion(),
                                     mesh=mesh,
                                     sharding_rules=ShardingRules())
-        with pytest.raises(ValueError, match="data-parallel only"):
-            o.optimize()
+        o.end_when = optim.Trigger.max_iteration(1)
+        o.optimize()
+        assert np.isfinite(o._driver_state["loss"])
+        o2 = optim.ParallelOptimizer(mlp(), make_ds(), nn.ClassNLLCriterion(),
+                                     mesh=mesh,
+                                     batch_partition=P(AXIS_DATA))
+        with pytest.raises(ValueError, match="data"):
+            o2.optimize()
 
     def test_rule_ndim_validation(self):
         import pytest
