@@ -919,16 +919,15 @@ class ParallelOptimizer(DistriOptimizer):
 
         rep = P()
         data = P(AXIS_DATA)
-        kwargs = {}
-        if self.sharding_rules is not None or len(mesh.shape) > 1:
-            # manual over 'data' only: the in/out specs constrain just the
-            # data axis (params replicated over it), while tp/ep axes stay
-            # AUTO — GSPMD propagates the rule-applied param shardings
-            # through the body and inserts the model-axis collectives,
-            # composing with the per-leaf data-axis gradient psums
-            kwargs["axis_names"] = frozenset({AXIS_DATA})
+        # manual over 'data' only: the in/out specs constrain just the
+        # data axis (params replicated over it), while tp/ep axes stay
+        # AUTO — GSPMD propagates the rule-applied param shardings
+        # through the body and inserts the model-axis collectives,
+        # composing with the per-leaf data-axis gradient psums.  (On a
+        # data-only mesh this equals full-manual shard_map.)
         sharded = jax.shard_map(
             shard_step, mesh=mesh,
             in_specs=(rep, rep, rep, data, data, rep, rep),
-            out_specs=(rep, rep, rep, rep, rep), **kwargs)
+            out_specs=(rep, rep, rep, rep, rep),
+            axis_names=frozenset({AXIS_DATA}))
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
