@@ -24,7 +24,12 @@ While building this, three real loop defects were found and fixed (each
 reproduced here before the fix):
   - the drain's eager `jnp.stack` compiled a FRESH concat executable for
     every distinct burst length (seconds of XLA compiles per epoch) and
-    paid ~2 eager dispatches per scalar -> now a fixed-width jitted pack;
+    paid ~2 eager dispatches per scalar; worse, ANY packing program run
+    at drain time enqueues BEHIND the in-flight steps on the in-order
+    device, stalling each drain for queue_depth x step_time (measured
+    1.3 s/drain at depth 32 on the tunnel) -> a device-side telemetry
+    ring written by a tiny per-step jit; the drain reads the ring
+    SNAPSHOT of an already-executed step (one transfer, no queue wait);
   - `jax.random.fold_in` dispatched ~5 eager ops per step -> jitted;
   - the host-lr path device_put a fresh scalar every step (a put can
     serialize the in-flight pipeline) -> cached until the lr changes.

@@ -59,6 +59,13 @@ class EngineConfig:
     # Default mesh layout, e.g. "data=8,model=2" (all devices on the data
     # axis when unset); the launcher's --mesh flag exports this.
     mesh_spec: Optional[str] = None
+    # Async driver depth: in-flight steps before the driver reads a loss
+    # back.  Per-step readback cost ~= readback_latency / (depth/2)
+    # (BENCH_APPENDIX "Trainer-loop gap attribution"); raise it on
+    # high-latency links (remote tunnels), at the price of driver logs
+    # trailing up to `depth` steps.  Deterministic triggers only; loss-
+    # reading triggers (min_loss/max_score) force synchronous mode.
+    async_depth: int = 32
 
     def parse_mesh(self) -> Optional[dict]:
         if not self.mesh_spec:
@@ -88,6 +95,7 @@ class EngineConfig:
             log_level=_env("LOG_LEVEL", "INFO"),
             seed=_env_int("SEED", 1),
             mesh_spec=os.environ.get(_PREFIX + "MESH"),
+            async_depth=_env_int("ASYNC_DEPTH", 32),
         )
         if _PREFIX + "COORDINATOR_ADDRESS" in os.environ:
             cfg.coordinator_address = os.environ[_PREFIX + "COORDINATOR_ADDRESS"]

@@ -65,8 +65,22 @@ logger = logging.getLogger("bigdl_tpu.optim")
 # eager equivalents pay per-op dispatch every step (fold_in) or a fresh
 # XLA compile per burst length (stack) — measured as the dominant loop
 # overhead in benchmarks/bench_trainer_overhead.py
-_pack_scalars = jax.jit(lambda xs: jnp.stack(xs))
 _fold_in = jax.jit(jax.random.fold_in)
+
+
+@jax.jit
+def _ring_write(ring, slot, loss, lr):
+    """Append (loss, lr) into the device-side telemetry ring.
+
+    The drain reads the ring SNAPSHOT of a step that has already executed
+    (depth/2 behind the dispatch head) — one small transfer with no queue
+    wait.  Running any packing program at drain time instead would
+    enqueue it BEHIND the in-flight steps on the in-order device: each
+    drain then stalls for queue_depth x step_time (measured 1.3 s per
+    drain at depth 32 on the 100 ms tunnel — the whole batching win
+    eaten).  NOT donated: pending holds per-step snapshots."""
+    entry = jnp.stack([loss.astype(jnp.float32), lr.astype(jnp.float32)])
+    return ring.at[slot].set(entry)
 
 
 def _cast_floats(tree, dtype):
@@ -215,6 +229,23 @@ class Optimizer:
         sh = self._batch_sharding()
         if sh is None:
             return jnp.asarray(arr)
+        # device-resident batches with an EQUIVALENT layout must not be
+        # re-put: device_put to a merely differently-expressed sharding
+        # (SingleDeviceSharding vs a 1-shard NamedSharding) is a real
+        # per-step on-device copy (~1s/step for a b256 batch through the
+        # remote tunnel, measured) — and under multi-process a global
+        # array must never round-trip through np.asarray at all
+        if isinstance(arr, jax.Array):
+            try:
+                if arr.sharding.is_equivalent_to(sh, arr.ndim):
+                    return arr
+            except (AttributeError, TypeError):
+                if not getattr(self, "_warned_shard_equiv", False):
+                    self._warned_shard_equiv = True
+                    logger.warning(
+                        "sharding equivalence check unavailable on this "
+                        "jax version; device-resident batches will be "
+                        "re-put every step (a per-step on-device copy)")
         if jax.process_count() > 1:
             return jax.make_array_from_process_local_data(sh, np.asarray(arr))
         return jax.device_put(jnp.asarray(arr), sh)
@@ -489,7 +520,7 @@ class Optimizer:
         if getattr(self, "ckpt_trigger", None) is not None:
             triggers.append(self.ckpt_trigger)
         if all(getattr(t, "deterministic", False) for t in triggers):
-            return 16
+            return max(0, Engine.config().async_depth)
         return 0
 
     def _optimize_impl(self):
@@ -507,24 +538,26 @@ class Optimizer:
             self._pending_restore = None
 
         depth = self._async_depth()
-        pending = deque()  # (epoch, neval, bs, loss_dev, lr_dev)
+        pending = deque()  # (epoch, neval, bs, slot, ring_snapshot)
         drain_clock = [time.perf_counter(), 1.0]  # [last drain t, last dt]
         lr_cache = [None, None]  # [host float, device scalar]
         lr_zero = jnp.zeros((), jnp.float32)
+        ring_cap = depth + 2  # burst span never exceeds depth+1 entries
+        ring = jnp.zeros((ring_cap, 2), jnp.float32)
 
         def drain(keep: int):
             """Read back completed steps, keeping `keep` in flight.
 
-            Flushes the WHOLE backlog in two stacked transfers (one for
-            losses, one for lrs) instead of one host round-trip per step:
-            a readback's fixed latency serializes the host loop, so with
-            per-step float() calls the dispatch rate degrades to one
-            round-trip per iteration (measured 0.3 s/step through the
-            remote-TPU tunnel vs 0.1 s of compute).  Batched, the
-            round-trip cost is paid once per `depth` steps and the
-            trainer tracks the raw jitted step (VERDICT: trainer within
-            ~5% of the raw-step bench).  Per-iteration logs still appear
-            for every step, `depth` steps late at most."""
+            Reads ONE telemetry-ring snapshot for the whole backlog
+            instead of one host round-trip per step: per-step float()
+            calls degrade the dispatch rate to one round trip per
+            iteration (measured 0.3 s/step through the remote-TPU tunnel
+            vs 0.1 s of compute).  The snapshot comes from a step that
+            already EXECUTED (depth/2 behind the dispatch head), so the
+            read never waits behind the in-flight queue — see
+            _ring_write for why no packing program may run here.
+            Per-iteration logs still appear for every step, `depth`
+            steps late at most."""
             if len(pending) <= keep:
                 return
             # flush down to keep//2, not keep: the steps left in flight
@@ -534,27 +567,22 @@ class Optimizer:
             burst = []
             while len(pending) > target:
                 burst.append(pending.popleft())
-            # one transfer for losses AND lrs: each readback is a full
-            # host<->device round trip, and the round trip (not the bytes)
-            # is the cost.  The burst is PADDED to a fixed width and
-            # packed by a jitted stack: an eager jnp.stack here compiles
-            # a fresh concat executable for every distinct burst length
-            # (measured: dominant loop cost on a local backend) and pays
-            # ~2 eager dispatches per scalar besides.
-            cap = depth + 1
-            pad = [burst[-1]] * (cap - len(burst))
-            packed = np.asarray(_pack_scalars(
-                tuple(b[3] for b in burst + pad)
-                + tuple(b[4] for b in burst + pad)), np.float32)
-            losses, lrs = packed[:len(burst)], packed[cap:cap + len(burst)]
+            # ONE transfer for every burst entry's loss AND lr: read the
+            # NEWEST burst entry's ring snapshot — that step sits depth/2
+            # behind the dispatch head, so its buffer is (about) done
+            # executing and the read is a pure round trip; the older
+            # entries' slots are still intact in that snapshot (overwrites
+            # only happen in newer snapshots).  See _ring_write for why no
+            # packing program may run at drain time.
+            packed = np.asarray(burst[-1][4], np.float32)  # (ring_cap, 2)
             now = time.perf_counter()
             dt_total = now - drain_clock[0]
             per_step = dt_total / len(burst) if dt_total > 1e-7 \
                 else drain_clock[1]
             drain_clock[0], drain_clock[1] = now, per_step
-            for (ep, it, bs, _, _), loss_f, lr_f in zip(burst, losses, lrs):
-                loss_f = float(loss_f)
-                lr_f = float(lr_f)
+            for ep, it, bs, slot, _ in burst:
+                loss_f = float(packed[slot, 0])
+                lr_f = float(packed[slot, 1])
                 state["loss"] = loss_f
                 throughput = bs / per_step
                 self.metrics.add("computing time", per_step)
@@ -605,8 +633,10 @@ class Optimizer:
                     self.params, self.model_state, self.opt_state, x, y, rng,
                     lr)
                 state["neval"] += 1
+                slot = (state["neval"] - 1) % ring_cap
+                ring = _ring_write(ring, slot, loss, lr_used)
                 pending.append((state["epoch"] + 1, state["neval"], bs,
-                                loss, lr_used))
+                                slot, ring))
                 drain(depth)
                 if getattr(self, "_profile", False) \
                         and not getattr(self, "_profiled", False):
