@@ -1,0 +1,119 @@
+"""MEASURED CPU-framework baseline for the headline vs_baseline anchor.
+
+The reference (BigDL) publishes no absolute throughput numbers; its
+premise is ResNet-class training on dual-socket Xeon nodes with a
+mainstream CPU DL stack (whitepaper Fig 7; README "orders of magnitude
+faster than out-of-box ... Torch" on Xeon).  The reference itself cannot
+run in this image (Scala/Spark, no JVM), so the closest MEASURABLE
+stand-in is PyTorch CPU — a mainstream CPU framework with MKL-class
+kernels — training the same ResNet-50 ImageNet-shape step on THIS host's
+Xeon-class CPUs, all cores.
+
+This replaces the round-1..3 anchor (a ~16 img/s order-of-magnitude
+ESTIMATE for a 2017 Broadwell node): the number below is measured on the
+actual host, which is a far larger machine than the whitepaper's nodes —
+i.e. the resulting vs_baseline is CONSERVATIVE.
+
+Run: python benchmarks/bench_cpu_torch_baseline.py [--batch 32] [--iters 8]
+Prints one json line.
+"""
+
+import argparse
+import json
+import os
+import time
+
+import torch
+import torch.nn as nn
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, cin, planes, stride=1, down=None):
+        super().__init__()
+        self.conv1 = nn.Conv2d(cin, planes, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(planes)
+        self.conv2 = nn.Conv2d(planes, planes, 3, stride, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.conv3 = nn.Conv2d(planes, planes * 4, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(planes * 4)
+        self.relu = nn.ReLU(inplace=True)
+        self.down = down
+
+    def forward(self, x):
+        idt = x if self.down is None else self.down(x)
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        return self.relu(out + idt)
+
+
+class ResNet50(nn.Module):
+    def __init__(self, classes=1000):
+        super().__init__()
+        self.cin = 64
+        self.stem = nn.Sequential(
+            nn.Conv2d(3, 64, 7, 2, 3, bias=False), nn.BatchNorm2d(64),
+            nn.ReLU(inplace=True), nn.MaxPool2d(3, 2, 1))
+        self.layer1 = self._layer(64, 3, 1)
+        self.layer2 = self._layer(128, 4, 2)
+        self.layer3 = self._layer(256, 6, 2)
+        self.layer4 = self._layer(512, 3, 2)
+        self.pool = nn.AdaptiveAvgPool2d(1)
+        self.fc = nn.Linear(2048, classes)
+
+    def _layer(self, planes, blocks, stride):
+        down = None
+        if stride != 1 or self.cin != planes * 4:
+            down = nn.Sequential(
+                nn.Conv2d(self.cin, planes * 4, 1, stride, bias=False),
+                nn.BatchNorm2d(planes * 4))
+        layers = [Bottleneck(self.cin, planes, stride, down)]
+        self.cin = planes * 4
+        layers += [Bottleneck(self.cin, planes) for _ in range(blocks - 1)]
+        return nn.Sequential(*layers)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        return self.fc(torch.flatten(self.pool(x), 1))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--warmup", type=int, default=2)
+    args = ap.parse_args()
+
+    torch.set_num_threads(os.cpu_count() or 1)
+    torch.manual_seed(0)
+    model = ResNet50()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    crit = nn.CrossEntropyLoss()
+    x = torch.randn(args.batch, 3, 224, 224)
+    y = torch.randint(0, 1000, (args.batch,))
+
+    def step():
+        opt.zero_grad(set_to_none=True)
+        loss = crit(model(x), y)
+        loss.backward()
+        opt.step()
+        return float(loss)
+
+    for _ in range(args.warmup):
+        step()
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        step()
+    dt = (time.perf_counter() - t0) / args.iters
+    print(json.dumps({
+        "metric": "torch_cpu_resnet50_train_throughput",
+        "value": round(args.batch / dt, 2), "unit": "images/sec",
+        "ms_per_step": round(dt * 1e3, 1), "batch": args.batch,
+        "threads": torch.get_num_threads()}))
+
+
+if __name__ == "__main__":
+    main()

@@ -20,7 +20,7 @@ measures each component on the local CPU backend:
      sweep (+0/1/10/100 ms per readback) checked against the
      amortization model ms/step ~= raw + (readback + injected)/(depth/2).
 
-While building this, three real loop defects were found and fixed (each
+While building this, four real loop defects were found and fixed (each
 reproduced here before the fix):
   - the drain's eager `jnp.stack` compiled a FRESH concat executable for
     every distinct burst length (seconds of XLA compiles per epoch) and
@@ -173,7 +173,7 @@ def measure_loop(latency_ms=0.0, no_drain=False):
         o._async_depth = lambda: 4 * ITERS
     restore = _inject_latency(latency_ms / 1e3) if latency_ms else None
     try:
-        o.optimize()  # warm: compiles the step + drain pack
+        o.optimize()  # warm: compiles the step + telemetry-ring write
         o.end_when = Trigger.max_iteration(2 * ITERS)
         t0 = time.perf_counter()
         o.optimize()

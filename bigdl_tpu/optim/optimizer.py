@@ -83,6 +83,38 @@ def _ring_write(ring, slot, loss, lr):
     return ring.at[slot].set(entry)
 
 
+_warned_shard_equiv = [False]
+
+
+def put_batch_array(arr, sh):
+    """Place one batch array under sharding `sh` (None = single device).
+
+    Device-resident batches with an EQUIVALENT layout are returned as-is:
+    device_put to a merely differently-expressed sharding
+    (SingleDeviceSharding vs a 1-shard NamedSharding) is a real per-step
+    on-device copy (~1s/step for a b256 batch through the remote tunnel,
+    measured).  Global jax.Arrays never round-trip through np.asarray —
+    they reshard on device; host arrays go through
+    make_array_from_process_local_data under multi-process."""
+    if sh is None:
+        return jnp.asarray(arr)
+    if isinstance(arr, jax.Array):
+        try:
+            if arr.sharding.is_equivalent_to(sh, arr.ndim):
+                return arr
+        except (AttributeError, TypeError):
+            if not _warned_shard_equiv[0]:
+                _warned_shard_equiv[0] = True
+                logger.warning(
+                    "sharding equivalence check unavailable on this jax "
+                    "version; device-resident batches will be re-put "
+                    "every step (a per-step on-device copy)")
+        return jax.device_put(arr, sh)
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(sh, np.asarray(arr))
+    return jax.device_put(jnp.asarray(arr), sh)
+
+
 def _cast_floats(tree, dtype):
     """astype(dtype) on floating leaves, everything else untouched."""
     return jax.tree_util.tree_map(
@@ -226,29 +258,7 @@ class Optimizer:
     def _put_batch(self, arr):
         if isinstance(arr, (tuple, list)):
             return type(arr)(self._put_batch(a) for a in arr)
-        sh = self._batch_sharding()
-        if sh is None:
-            return jnp.asarray(arr)
-        # device-resident batches with an EQUIVALENT layout must not be
-        # re-put: device_put to a merely differently-expressed sharding
-        # (SingleDeviceSharding vs a 1-shard NamedSharding) is a real
-        # per-step on-device copy (~1s/step for a b256 batch through the
-        # remote tunnel, measured) — and under multi-process a global
-        # array must never round-trip through np.asarray at all
-        if isinstance(arr, jax.Array):
-            try:
-                if arr.sharding.is_equivalent_to(sh, arr.ndim):
-                    return arr
-            except (AttributeError, TypeError):
-                if not getattr(self, "_warned_shard_equiv", False):
-                    self._warned_shard_equiv = True
-                    logger.warning(
-                        "sharding equivalence check unavailable on this "
-                        "jax version; device-resident batches will be "
-                        "re-put every step (a per-step on-device copy)")
-        if jax.process_count() > 1:
-            return jax.make_array_from_process_local_data(sh, np.asarray(arr))
-        return jax.device_put(jnp.asarray(arr), sh)
+        return put_batch_array(arr, self._batch_sharding())
 
     def _put_replicated(self, tree):
         sh = self._replicated()

@@ -208,14 +208,15 @@ class Evaluator:
         return [t for t in totals if t is not None]
 
     def _put_batch(self, x):
+        from bigdl_tpu.optim.optimizer import put_batch_array
+
         if isinstance(x, Table):
             return Table(*[self._put_batch(v) for v in x])
         if isinstance(x, (tuple, list)):  # multi-io batches
             return type(x)(self._put_batch(v) for v in x)
-        if self.mesh is None:
-            return jnp.asarray(np.asarray(x))
-        return jax.device_put(jnp.asarray(np.asarray(x)),
-                              NamedSharding(self.mesh, P(AXIS_DATA)))
+        sh = None if self.mesh is None \
+            else NamedSharding(self.mesh, P(AXIS_DATA))
+        return put_batch_array(x, sh)
 
 
 class PredictionService:
