@@ -140,7 +140,11 @@ class DLModel:
                                         self.model.state, batch_size=batch)
             self._predictor_batch = batch
             self._predictor_params = self.model.params
-        return np.asarray(self._predictor.predict(x))
+        preds = self._predictor.predict(x)
+        if isinstance(preds, list):
+            # multi-output model: one tuple of per-head rows per record
+            return list(zip(*preds))
+        return np.asarray(preds)
 
     def transform(self, df):
         out = df.copy()

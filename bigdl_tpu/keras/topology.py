@@ -116,26 +116,56 @@ class KerasTopology:
         # binary_crossentropy means elementwise binary accuracy; explicit
         # Top1Accuracy instances (or 'top1') are honored as requested
         from bigdl_tpu.nn.criterion import BCECriterion
-        from bigdl_tpu.optim.validation import BinaryAccuracy, Loss
+        from bigdl_tpu.optim.validation import BinaryAccuracy, Loss, PerOutput
+
+        def resolve_one(m, crit):
+            # generic 'accuracy' under a BCE head = elementwise binary acc
+            if (isinstance(m, str) and m.lower() in ("accuracy", "acc")
+                    and isinstance(crit, BCECriterion)):
+                return BinaryAccuracy()
+            return resolve_metrics([m])[0]
+
         resolved = []
         if n_out > 1:
-            bad = [m for m in (metrics or [])
-                   if not isinstance(m, Loss) and m != "loss"]
-            if bad:
-                raise ValueError(
-                    f"metrics {bad!r} are per-tensor and this Model has "
-                    f"{n_out} outputs (a Table) — multi-output models "
-                    f"support only loss-type metrics; evaluate() reports "
-                    f"the summed multi-head loss")
-            resolved = [m if isinstance(m, Loss) else Loss(self.criterion)
-                        for m in (metrics or [])]
+            # per-tensor metrics on multi-output Models (reference:
+            # nn/keras/Topology.scala:55-158).  Two spec shapes:
+            #   metrics=["accuracy", None]      one entry PER OUTPUT
+            #     (length == n_out, with None / nested-list entries);
+            #   metrics=["accuracy"]            flat list, applied to
+            #     EVERY output (keras-1 semantics).
+            # "loss"/Loss entries stay whole-model (the summed multi-head
+            # criterion), never routed per head.
+            ms = list(metrics or [])
+            crits = getattr(self.criterion, "criteria",
+                            [None] * n_out)
+            per_output_spec = len(ms) == n_out and any(
+                m is None or isinstance(m, (list, tuple)) for m in ms)
+
+            def add(m, head):
+                if isinstance(m, Loss) or m == "loss":
+                    resolved.append(m if isinstance(m, Loss)
+                                    else Loss(self.criterion))
+                else:
+                    resolved.append(
+                        PerOutput(resolve_one(m, crits[head]), head))
+
+            if per_output_spec:
+                for i, spec in enumerate(ms):
+                    if spec is None:
+                        continue
+                    for m in (spec if isinstance(spec, (list, tuple))
+                              else [spec]):
+                        add(m, i)
+            else:
+                for m in ms:
+                    if isinstance(m, Loss) or m == "loss":
+                        add(m, 0)
+                    else:
+                        for i in range(n_out):
+                            add(m, i)
         else:
             for m in (metrics or []):
-                if (isinstance(m, str) and m.lower() in ("accuracy", "acc")
-                        and isinstance(self.criterion, BCECriterion)):
-                    resolved.append(BinaryAccuracy())
-                else:
-                    resolved.extend(resolve_metrics([m]))
+                resolved.append(resolve_one(m, self.criterion))
         self.metrics = resolved
         # a re-compile changes loss/metrics: drop cached compiled programs
         self._evaluator = None
@@ -229,8 +259,11 @@ class KerasTopology:
                                          batch_size=batch_size))
         return self._predictor[3].predict(x)
 
-    def predict_classes(self, x: np.ndarray, batch_size: int = 32) -> np.ndarray:
-        return np.argmax(self.predict(x, batch_size), axis=-1)
+    def predict_classes(self, x: np.ndarray, batch_size: int = 32):
+        y = self.predict(x, batch_size)
+        if isinstance(y, list):  # multi-output: one argmax per head
+            return [np.argmax(h, axis=-1) for h in y]
+        return np.argmax(y, axis=-1)
 
 
 # KerasTopology is first in the MRO so its evaluate() (metric evaluation,

@@ -230,8 +230,10 @@ def quantize(module: Module, params: Any,
     mode flips with the toolchain (round-2 static was 1.26x vs bf16;
     round-3 re-measure 0.96x, BENCH_APPENDIX.md), so no fixed choice is
     safe, and returning the FLOAT model when every int8 mode is a
-    slowdown prevents quantize() shipping a regression silently.  The
-    decision table lands on the returned module as
+    slowdown prevents quantize() shipping a regression silently.  NOTE:
+    when `bf16` wins, the returned params are a bf16 CAST of the model
+    (a dtype change, warned loudly), not int8.  The decision table lands
+    on the returned module (a copy, never the caller's object) as
     `_quant_auto_report`."""
     if mode == "auto":
         return _quantize_auto(module, params, sample_input, state,
@@ -349,6 +351,17 @@ def _quantize_auto(module: Module, params: Any, sample_input, state,
     _, name, mod, p = best
     log.info("quantize(auto): %s -> picked %r",
              ", ".join(f"{n}={ms:.2f}ms" for n, ms in report), name)
+    if name == "bf16":
+        # loud, not silent: a function named quantize() is returning a
+        # dtype-cast rather than an int8 model because that measured faster
+        log.warning("quantize(auto): every int8 mode measured slower than "
+                    "bf16; returning BF16-CAST params (not int8)")
+    if mod is module:
+        # float/bf16 winner is the caller's original module object —
+        # annotate a shallow copy so the report never mutates their model
+        import copy
+
+        mod = copy.copy(mod)
     mod._quant_auto_report = {"picked": name,
                               "ms_per_batch": dict(report)}
     return mod, p

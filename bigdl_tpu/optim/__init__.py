@@ -19,7 +19,7 @@ from bigdl_tpu.optim.schedules import (
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
     ValidationMethod, ValidationResult, Top1Accuracy, BinaryAccuracy,
-    Top5Accuracy, Loss,
+    Top5Accuracy, Loss, PerOutput,
     MAE, HitRatio, NDCG, TreeNNAccuracy,
 )
 from bigdl_tpu.optim.metrics import Metrics

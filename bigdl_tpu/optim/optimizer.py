@@ -659,8 +659,12 @@ class Optimizer:
                 dt_cb = time.perf_counter() - t_cb
                 if dt_cb > 1e-3:
                     # exclude validation/checkpoint time from the next
-                    # drain's per-step throughput attribution
-                    drain_clock[0] += dt_cb
+                    # drain's per-step throughput attribution; clamp to
+                    # 'now' — callbacks overlap in-flight device compute,
+                    # and an unclamped advance can pass the next drain's
+                    # timestamp, making dt_total<=0 there
+                    drain_clock[0] = min(time.perf_counter(),
+                                         drain_clock[0] + dt_cb)
             # epoch boundary: under async depth the backlog can ride
             # across epochs (deterministic triggers never read
             # state['loss']); the synchronous path (depth=0) still
@@ -687,7 +691,8 @@ class Optimizer:
             self._maybe_checkpoint(state)
             dt_cb = time.perf_counter() - t_cb
             if dt_cb > 1e-3:
-                drain_clock[0] += dt_cb
+                drain_clock[0] = min(time.perf_counter(),
+                                     drain_clock[0] + dt_cb)
         drain(0)
         logger.info("Training finished after %d iterations (%.1fs)",
                     state["neval"], time.time() - wall_start)

@@ -81,13 +81,26 @@ def _as_batches(data: Any, batch_size: int) -> Iterable[MiniBatch]:
 def _to_device(x: Any) -> Any:
     if isinstance(x, Table):
         return Table(*[_to_device(v) for v in x])
+    if isinstance(x, (list, tuple)):  # multi-input x / multi-output y
+        return type(x)(_to_device(v) for v in x)
     return jnp.asarray(np.asarray(x))
+
+
+def _batch_rows(x: Any) -> int:
+    """Leading-dim row count for an array, Table, or tuple/list batch."""
+    if isinstance(x, Table):
+        return next(iter(x)).shape[0]
+    if isinstance(x, (list, tuple)):
+        return x[0].shape[0]
+    return x.shape[0]
 
 
 def _pad_batch(x: Any, to: int) -> Any:
     """Pad the batch (leading) dim to `to` rows by repeating the last row."""
     if isinstance(x, Table):
         return Table(*[_pad_batch(v, to) for v in x])
+    if isinstance(x, (list, tuple)):
+        return type(x)(_pad_batch(v, to) for v in x)
     x = np.asarray(x)
     n = x.shape[0]
     if n == to:
@@ -125,25 +138,41 @@ class Predictor:
     def _put(self, x):
         if isinstance(x, Table):
             return Table(*[self._put(v) for v in x])
+        if isinstance(x, (list, tuple)):  # keras multi-input batches
+            return type(x)(self._put(v) for v in x)
         if self.mesh is None:
             return jnp.asarray(x)
         return jax.device_put(jnp.asarray(x), NamedSharding(self.mesh, P(AXIS_DATA)))
 
-    def predict(self, data: Any, batch_size: Optional[int] = None) -> np.ndarray:
-        """Returns stacked outputs for every input record."""
+    def predict(self, data: Any, batch_size: Optional[int] = None):
+        """Returns stacked outputs for every input record; a multi-output
+        model (Table output) returns a LIST of stacked arrays, one per
+        head (reference: Predictor handles Table activities)."""
         bs = batch_size or self.batch_size
-        outs: List[np.ndarray] = []
+        outs: List[Any] = []
+        multi = False
         for batch in _as_batches(data, bs):
             x = batch.get_input()
-            n = x.shape[0] if not isinstance(x, Table) else next(iter(x)).shape[0]
+            n = _batch_rows(x)
             xp = _pad_batch(x, bs) if n < bs else x
             y = self._fwd(self.params, self.state, self._put(xp))
-            outs.append(np.asarray(y)[:n])
+            if isinstance(y, (Table, list, tuple)):
+                multi = True
+                outs.append([np.asarray(h)[:n] for h in y])
+            else:
+                outs.append(np.asarray(y)[:n])
+        if multi:
+            return [np.concatenate([o[i] for o in outs], axis=0)
+                    for i in range(len(outs[0]))]
         return np.concatenate(outs, axis=0)
 
-    def predict_class(self, data: Any, batch_size: Optional[int] = None) -> np.ndarray:
-        """argmax over the class dim (reference: Predictor.predictClass)."""
-        return np.argmax(self.predict(data, batch_size), axis=-1)
+    def predict_class(self, data: Any, batch_size: Optional[int] = None):
+        """argmax over the class dim (reference: Predictor.predictClass).
+        Multi-output models return a list, one argmax array per head."""
+        y = self.predict(data, batch_size)
+        if isinstance(y, list):
+            return [np.argmax(h, axis=-1) for h in y]
+        return np.argmax(y, axis=-1)
 
 
 LocalPredictor = Predictor  # single-chip is the mesh=None case
@@ -192,7 +221,7 @@ class Evaluator:
         totals: List[Optional[ValidationResult]] = [None] * len(methods)
         for batch in _as_batches(data, batch_size):
             x, y = batch.get_input(), batch.get_target()
-            n = x.shape[0] if not isinstance(x, Table) else next(iter(x)).shape[0]
+            n = _batch_rows(x)
             if n < batch_size:
                 # evaluate the ragged tail unpadded (and unsharded); metric
                 # sums would count repeated pad rows otherwise.  One extra
@@ -253,7 +282,10 @@ class PredictionService:
         x = arrays[0] if len(arrays) == 1 else Table(*arrays)
         y = self.predict(x)
         out = io.BytesIO()
-        np.savez(out, output=y)
+        if isinstance(y, list):  # multi-output model: one entry per head
+            np.savez(out, **{f"output_{i}": h for i, h in enumerate(y)})
+        else:
+            np.savez(out, output=y)
         return out.getvalue()
 
 

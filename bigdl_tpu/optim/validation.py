@@ -111,6 +111,35 @@ class Loss(ValidationMethod):
         return val, jnp.asarray(n, jnp.int32)
 
 
+class PerOutput(ValidationMethod):
+    """Route a per-tensor metric to ONE head of a multi-output model:
+    select entry `index` of the output/target activity Tables and
+    delegate to the wrapped method.  This is how keras-style per-output
+    metric lists (reference: nn/keras/Topology.scala:55-158, compile's
+    per-output metrics) evaluate on models whose output is a Table.
+
+    A single (non-Table) target is shared across heads, matching
+    ParallelCriterion(repeat_target=True) semantics."""
+
+    def __init__(self, inner: ValidationMethod, index: int):
+        self.inner = inner
+        self.index = index
+        self.name = f"{inner.name}[out{index}]"
+
+    @staticmethod
+    def _entry(activity, i):
+        from bigdl_tpu.core.table import Table
+        if isinstance(activity, Table):
+            return activity[i + 1]  # Tables are 1-indexed
+        if isinstance(activity, (list, tuple)):
+            return activity[i]
+        return activity  # one shared tensor (repeat_target)
+
+    def batch(self, output, target):
+        return self.inner.batch(self._entry(output, self.index),
+                                self._entry(target, self.index))
+
+
 class MAE(ValidationMethod):
     """Mean absolute error. reference: ValidationMethod.MAE."""
 

@@ -165,12 +165,12 @@ def _varying(axis_name, *trees):
         return trees
 
     def cast(a):
-        try:
-            return pcast(a, (axis_name,), to="varying")
-        except ValueError as e:
-            if "varying" in str(e):
-                return a  # already varying (e.g. P(pipeline)-sharded state)
-            raise
+        # typed check, not error-message parsing: jax.typeof().vma is the
+        # set of axes a value already varies over under shard_map tracing
+        vma = getattr(jax.typeof(a), "vma", None)
+        if vma is not None and axis_name in vma:
+            return a  # already varying (e.g. P(pipeline)-sharded state)
+        return pcast(a, (axis_name,), to="varying")
 
     return tuple(jax.tree_util.tree_map(cast, t) for t in trees)
 

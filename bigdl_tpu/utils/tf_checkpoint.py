@@ -402,7 +402,10 @@ def read_checkpoint(prefix: str) -> Dict[str, np.ndarray]:
                         f"checkpoint tensor {name!r} has unsupported "
                         f"dtype enum {e.dtype}")
                 full = np.zeros(full_shape, np_dtype)
-                covered = 0
+                # boolean coverage mask, not an element-count sum:
+                # TF's TensorSlice model permits overlapping-but-complete
+                # slice sets, which a count would wrongly reject
+                covered = np.zeros(full_shape, bool)
                 parts = []
                 for sp in e.slices:
                     skey = _slice_entry_key(name, sp)
@@ -418,13 +421,14 @@ def read_checkpoint(prefix: str) -> Dict[str, np.ndarray]:
                         if ext.HasField("length") else slice(None)
                         for ext in sp.extent)
                     full[idx] = part
-                    covered += part.size
+                    covered[idx] = True
                     starts = tuple(ext.start for ext in sp.extent)
                     parts.append((starts, part))
-                if covered != full.size:
+                n_cov = int(covered.sum())
+                if n_cov != full.size:
                     raise ValueError(
                         f"partitioned tensor {name!r}: slices cover "
-                        f"{covered} of {full.size} elements")
+                        f"{n_cov} of {full.size} elements")
                 out[name] = full
                 # graphs built under a v1 variable partitioner hold the
                 # PARTS as their VariableV2 nodes ("{name}/part_{i}");

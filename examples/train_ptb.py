@@ -1,0 +1,148 @@
+"""BASELINE config 5: PTB-style stacked-LSTM language model trained on the
+TPU to a stated held-out perplexity, end-to-end through DistriOptimizer
+with checkpoints and TensorBoard summaries.
+
+Reference: models/rnn/Train.scala:48-59 + example/languagemodel/
+PTBWordLM.scala (SequencePreprocess -> PTBModel -> Optimizer with
+TimeDistributedCriterion(CrossEntropy)).
+
+Data: `python tools/gen_ptb.py --out data/ptb` writes PTB-format
+ptb.{train,valid,test}.txt built from real English prose (installed
+package docstrings — see that script's docstring; it is real natural
+language but NOT the Penn Treebank, so perplexities are comparable only
+within this corpus).
+
+Training recipe is the classic PTB one (Zaremba et al. as used by the
+reference's example): SGD lr 1.0, gradient L2-clip 5, lr halves each
+epoch after a flat start, dropout between LSTM layers.
+
+    python examples/train_ptb.py --data-dir data/ptb --epochs 8 \
+        --checkpoint /tmp/ptb_ckpt --summary /tmp/ptb_summary
+
+Prints one JSON line {valid_ppl, test_ppl, wall_s, tok_per_s, epochs}.
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def load_split(path, d):
+    with open(path, encoding="utf-8") as f:
+        words = f.read().replace("\n", " <eos> ").split()
+    return np.asarray([d.get_index(w) for w in words], np.int32)
+
+
+def to_dataset(ids, batch_size, num_steps, device_resident=True):
+    import jax.numpy as jnp
+
+    from bigdl_tpu.dataset import ArrayDataSet, MiniBatch
+    from bigdl_tpu.dataset.text import ptb_stream_batches
+
+    items = []
+    for x, y in ptb_stream_batches(ids, batch_size, num_steps):
+        if device_resident:
+            x, y = jnp.asarray(x), jnp.asarray(y)
+        items.append(MiniBatch(x, y))
+    return ArrayDataSet(items)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", default="data/ptb")
+    ap.add_argument("--vocab-size", type=int, default=10_000)
+    ap.add_argument("--embed", type=int, default=256)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--keep-prob", type=float, default=0.75)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-steps", type=int, default=35)
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=1.0)
+    ap.add_argument("--flat-epochs", type=int, default=3,
+                    help="epochs at full lr before halving per epoch")
+    ap.add_argument("--clip", type=float, default=5.0)
+    ap.add_argument("--checkpoint", default=None)
+    ap.add_argument("--summary", default=None)
+    args = ap.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset.text import Dictionary
+    from bigdl_tpu.models import PTBModel
+    from bigdl_tpu.optim import DistriOptimizer, Loss, SGD, Trigger
+    from bigdl_tpu.optim.schedules import EpochDecay
+    from bigdl_tpu.utils.summary import TrainSummary, ValidationSummary
+
+    # vocabulary from the train split only (PTB convention; the corpus
+    # already maps rare words to <unk> so this is just word->id)
+    with open(os.path.join(args.data_dir, "ptb.train.txt"), encoding="utf-8") as f:
+        train_words = f.read().replace("\n", " <eos> ").split()
+    d = Dictionary([train_words], vocab_size=args.vocab_size + 2)
+    vocab = d.vocab_size()
+
+    ids = {}
+    for split in ("train", "valid", "test"):
+        ids[split] = load_split(
+            os.path.join(args.data_dir, f"ptb.{split}.txt"), d)
+    print(f"vocab {vocab}; tokens train/valid/test: "
+          f"{len(ids['train'])}/{len(ids['valid'])}/{len(ids['test'])}")
+
+    train_ds = to_dataset(ids["train"], args.batch_size, args.num_steps)
+    valid_ds = to_dataset(ids["valid"], args.batch_size, args.num_steps)
+    test_ds = to_dataset(ids["test"], args.batch_size, args.num_steps)
+
+    model = PTBModel(vocab_size=vocab, embedding_dim=args.embed,
+                     hidden_size=args.hidden, num_layers=args.layers,
+                     keep_prob=args.keep_prob)
+    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                            size_average=True)
+
+    # lr * 0.5^(epoch - flat) after the flat epochs (0.1^(x*log10 2))
+    flat = args.flat_epochs
+    sched = EpochDecay(lambda e: jnp.maximum(e - flat, 0) * 0.3010299957)
+    optimizer = DistriOptimizer(
+        model, train_ds, criterion,
+        optim_method=SGD(learning_rate=args.lr, schedule=sched),
+        end_trigger=Trigger.max_epoch(args.epochs))
+    optimizer.set_gradient_clipping_by_l2_norm(args.clip)
+    optimizer.set_validation(Trigger.every_epoch(), valid_ds,
+                             [Loss(criterion)])
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+    if args.summary:
+        optimizer.set_train_summary(TrainSummary(args.summary, "ptb"))
+        optimizer.set_val_summary(ValidationSummary(args.summary, "ptb"))
+
+    t0 = time.time()
+    optimizer.optimize()
+    wall = time.time() - t0
+
+    def ppl(ds):
+        optimizer.val_dataset = ds
+        loss = optimizer.validate()[0].result()[0]
+        return math.exp(min(loss, 20.0))
+
+    valid_ppl = ppl(valid_ds)
+    test_ppl = ppl(test_ds)
+    n_tok = train_ds.size() * args.batch_size * args.num_steps * args.epochs
+    out = {"config": "ptb_lstm", "valid_ppl": round(valid_ppl, 2),
+           "test_ppl": round(test_ppl, 2),
+           "vocab": vocab, "epochs": args.epochs,
+           "hidden": args.hidden, "layers": args.layers,
+           "wall_s": round(wall, 1), "tok_per_s": round(n_tok / wall, 0),
+           "corpus": "docstring-prose (real English, not Penn Treebank)"}
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    main()

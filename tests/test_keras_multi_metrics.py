@@ -1,0 +1,117 @@
+"""Per-output metrics on multi-output keras Models (VERDICT r4 item 5).
+
+Reference: nn/keras/Topology.scala:55-158 — compile() accepts metrics per
+output; validation is routed per head.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import bigdl_tpu.keras as keras
+import bigdl_tpu.nn as nn
+from bigdl_tpu.optim.validation import Loss, PerOutput, Top1Accuracy
+
+
+def _two_head_model():
+    inp = nn.Input()
+    h = keras.Dense(16, activation="relu")(inp)
+    cls = keras.Dense(3)(h)        # classification head
+    reg = keras.Dense(1)(h)        # regression head
+    return keras.Model(inp, [cls, reg])
+
+
+def _data(n=64, d=8):
+    rs = np.random.RandomState(0)
+    x = rs.randn(n, d).astype(np.float32)
+    y_cls = rs.randint(0, 3, n).astype(np.int32)
+    y_reg = rs.randn(n, 1).astype(np.float32)
+    return x, [y_cls, y_reg]
+
+
+def test_per_output_spec_compiles_and_fits():
+    model = _two_head_model()
+    # one entry PER OUTPUT: accuracy on the class head, nothing on the
+    # regression head — the shape the r4 verdict names
+    model.compile(optimizer="adam",
+                  loss=["sparse_categorical_crossentropy", "mse"],
+                  metrics=["accuracy", None])
+    assert len(model.metrics) == 1
+    m = model.metrics[0]
+    assert isinstance(m, PerOutput) and m.index == 0
+    assert isinstance(m.inner, Top1Accuracy)
+
+    x, y = _data()
+    model.fit(x, y, batch_size=32, nb_epoch=2,
+              validation_data=(x, y))
+    results = model.evaluate(x, y, batch_size=32)
+    names = [n for n, _ in results]
+    assert names[0] == "Loss"
+    assert "Top1Accuracy[out0]" in names
+    acc = dict(results)["Top1Accuracy[out0]"]
+    assert 0.0 <= acc <= 1.0
+
+
+def test_per_output_nested_lists():
+    model = _two_head_model()
+    model.compile(optimizer="adam",
+                  loss=["sparse_categorical_crossentropy", "mse"],
+                  metrics=[["accuracy", "top5"], ["mae"]])
+    names = [m.name for m in model.metrics]
+    assert names == ["Top1Accuracy[out0]", "Top5Accuracy[out0]",
+                     "MAE[out1]"]
+
+
+def test_flat_list_applies_to_every_output():
+    # keras-1 semantics: a flat list (no None / nesting) replicates the
+    # metric across heads
+    model = _two_head_model()
+    model.compile(optimizer="adam",
+                  loss=["sparse_categorical_crossentropy", "mse"],
+                  metrics=["mae"])
+    names = [m.name for m in model.metrics]
+    assert names == ["MAE[out0]", "MAE[out1]"]
+
+
+def test_loss_metric_stays_whole_model():
+    model = _two_head_model()
+    model.compile(optimizer="adam",
+                  loss=["sparse_categorical_crossentropy", "mse"],
+                  metrics=["loss", None])
+    # None-routed head contributes nothing; 'loss' is the summed
+    # multi-head criterion, not per-head
+    assert len(model.metrics) == 1
+    assert isinstance(model.metrics[0], Loss)
+
+
+def test_multi_output_eval_ragged_final_batch():
+    # 70 % 32 != 0: the unpadded-tail eval path must handle tuple targets
+    model = _two_head_model()
+    model.compile(optimizer="adam",
+                  loss=["sparse_categorical_crossentropy", "mse"],
+                  metrics=["accuracy", None])
+    x, y = _data(n=70)
+    model.fit(x[:64], [y[0][:64], y[1][:64]], batch_size=32, nb_epoch=1)
+    res = dict(model.evaluate(x, y, batch_size=32))
+    assert 0.0 <= res["Top1Accuracy[out0]"] <= 1.0
+    # multi-head predict_class returns one argmax per head
+    from bigdl_tpu.optim.predictor import Predictor
+    pc = Predictor(model, model.params, model.state,
+                   batch_size=32).predict_class(x)
+    assert isinstance(pc, list) and pc[0].shape == (70,)
+
+
+def test_per_output_eval_values_match_manual():
+    model = _two_head_model()
+    model.compile(optimizer="adam",
+                  loss=["sparse_categorical_crossentropy", "mse"],
+                  metrics=["accuracy", None])
+    x, y = _data()
+    model.fit(x, y, batch_size=32, nb_epoch=1)
+    acc = dict(model.evaluate(x, y, batch_size=32))["Top1Accuracy[out0]"]
+    # manual: argmax of head 0 vs y_cls over the full set
+    preds = model.predict(x, batch_size=32)
+    head0 = np.asarray(preds[0] if isinstance(preds, (list, tuple))
+                       else preds)
+    manual = float((head0.argmax(-1) == y[0]).mean())
+    assert acc == pytest.approx(manual, abs=1e-6)
