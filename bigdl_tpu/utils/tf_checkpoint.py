@@ -24,7 +24,7 @@ Format notes (tensorflow/core/lib/table, a leveldb fork):
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Iterator, Tuple
+from typing import Any, Dict, Iterator, Optional, Tuple
 
 import numpy as np
 
@@ -208,12 +208,44 @@ def _build_block(entries) -> bytes:
     return bytes(out)
 
 
-def write_checkpoint(prefix: str, tensors: Dict[str, np.ndarray]) -> str:
+def _string_tensor_bytes(arr: np.ndarray) -> Tuple[bytes, int]:
+    """DT_STRING on-disk layout (tensor_bundle.cc WriteStringTensor):
+    varint64 length per element, a 4-byte masked-crc32c of the lengths
+    section, then the concatenated bytes.  Returns (raw, entry_crc).
+
+    Both checksums treat the lengths as FIXED uint32-LE values, not the
+    varint encoding that is actually on disk — determined differentially
+    against tf.train.load_checkpoint: the length checksum is
+    masked_crc(lens_fixed) and the ENTRY checksum is
+    masked_crc(lens_fixed + length_checksum_bytes + payload)."""
+    elems = [v if isinstance(v, bytes) else str(v).encode()
+             for v in arr.reshape(-1)]
+    out = bytearray()
+    for b in elems:
+        out += _enc_varint(len(b))
+    lens_fixed = b"".join(len(b).to_bytes(4, "little") for b in elems)
+    crc4 = _masked_crc(lens_fixed).to_bytes(4, "little")
+    out += crc4
+    payload = b"".join(elems)
+    out += payload
+    entry_crc = _masked_crc(lens_fixed + crc4 + payload)
+    return bytes(out), entry_crc
+
+
+def write_checkpoint(prefix: str, tensors: Dict[str, np.ndarray],
+                     partitions: Optional[Dict[str, int]] = None) -> str:
     """Write a TF v2-format ("tensor bundle") checkpoint that
     `tf.train.load_checkpoint` (and `read_checkpoint` above) reads back —
     the export half of the reference's variable flow
-    (scripts/export_tf_checkpoint.py + Session.saveParameters).  Returns
-    the prefix."""
+    (scripts/export_tf_checkpoint.py + Session.saveParameters).
+
+    DT_STRING tensors (object/str/bytes-dtype arrays) use the bundle's
+    varint-lengths-then-bytes layout.  `partitions` maps tensor name ->
+    number of parts split along dim 0 (the layout
+    tf.compat.v1.fixed_size_partitioner produces): the full-tensor entry
+    carries TensorSliceProtos and each part's data lands in its own
+    OrderedCode-keyed slice entry, exactly like TensorFlow's saver.
+    Returns the prefix."""
     np_to_dt = {np.dtype(np.float32): tfp.DT_FLOAT,
                 np.dtype(np.float64): tfp.DT_DOUBLE,
                 np.dtype(np.int32): tfp.DT_INT32,
@@ -223,29 +255,75 @@ def write_checkpoint(prefix: str, tensors: Dict[str, np.ndarray]) -> str:
                 np.dtype(np.int8): tfp.DT_INT8,
                 np.dtype(np.int16): tfp.DT_INT16,
                 np.dtype(np.float16): 19}
+    partitions = partitions or {}
     data = bytearray()
     kvs = []
     header = tbp.BundleHeaderProto()
     header.num_shards = 1
     header.version.producer = 1
     kvs.append((b"", header.SerializeToString()))
+
+    def emit_data(arr: np.ndarray):
+        """Append one tensor's bytes; returns (dtype_enum, offset, size, crc)."""
+        if arr.dtype == object or arr.dtype.kind in ("U", "S"):
+            raw, crc = _string_tensor_bytes(arr)
+            dt = _DT_STRING
+        else:
+            dt = np_to_dt.get(arr.dtype)
+            if dt is None:
+                raise ValueError(f"unsupported dtype {arr.dtype}")
+            raw = arr.tobytes()
+            crc = _masked_crc(raw)
+        off = len(data)
+        data.extend(raw)
+        return dt, off, len(raw), crc
+
     for name in sorted(tensors):
         arr = np.ascontiguousarray(tensors[name])
-        dt = np_to_dt.get(arr.dtype)
-        if dt is None:
-            raise ValueError(f"tensor {name!r}: unsupported dtype "
-                             f"{arr.dtype}")
-        raw = arr.tobytes()
         e = tbp.BundleEntryProto()
-        e.dtype = dt
         for s in arr.shape:
             e.shape.dim.add().size = s
         e.shard_id = 0
-        e.offset = len(data)
-        e.size = len(raw)
-        e.crc32c = _masked_crc(raw)
-        data += raw
+        n_part = partitions.get(name, 0)
+        if n_part:
+            if arr.dtype == object or arr.dtype.kind in ("U", "S"):
+                raise ValueError(
+                    f"tensor {name!r}: partitioned string tensors "
+                    f"unsupported")
+            if not arr.ndim or n_part > arr.shape[0]:
+                raise ValueError(
+                    f"tensor {name!r}: cannot split dim0={arr.shape[:1]} "
+                    f"into {n_part} parts")
+            e.dtype = np_to_dt.get(arr.dtype)
+            if e.dtype is None:
+                raise ValueError(f"unsupported dtype {arr.dtype}")
+            # fixed_size_partitioner split: ceil-sized leading parts
+            base, extra = divmod(arr.shape[0], n_part)
+            start = 0
+            for i in range(n_part):
+                length = base + (1 if i < extra else 0)
+                sp = e.slices.add()
+                ext0 = sp.extent.add()
+                ext0.start = start
+                ext0.length = length
+                for d in arr.shape[1:]:  # full extents on other dims
+                    sp.extent.add()
+                part = np.ascontiguousarray(arr[start:start + length])
+                se = tbp.BundleEntryProto()
+                se.shape.dim.add().size = length
+                for d in arr.shape[1:]:
+                    se.shape.dim.add().size = d
+                se.shard_id = 0
+                (se.dtype, se.offset, se.size, se.crc32c) = emit_data(part)
+                kvs.append((_slice_entry_key(name, sp),
+                            se.SerializeToString()))
+                start += length
+        else:
+            (e.dtype, e.offset, e.size, e.crc32c) = emit_data(arr)
         kvs.append((name.encode(), e.SerializeToString()))
+    # sstable keys must be sorted: b"" (header) < b"\x00..." (slice
+    # entries, OrderedCode) < tensor names
+    kvs.sort(key=lambda kv: kv[0])
     with open(f"{prefix}.data-00000-of-00001", "wb") as f:
         f.write(bytes(data))
 
@@ -364,11 +442,6 @@ def read_checkpoint(prefix: str) -> Dict[str, np.ndarray]:
     out: Dict[str, np.ndarray] = {}
 
     def read_raw(name: str, e) -> np.ndarray:
-        np_dtype = _BUNDLE_DTYPES.get(e.dtype)
-        if np_dtype is None:
-            raise ValueError(
-                f"checkpoint tensor {name!r} has unsupported dtype "
-                f"enum {e.dtype}")
         shape = tuple(d.size for d in e.shape.dim)
         if e.shard_id not in shards:  # seek per entry, never slurp
             shards[e.shard_id] = open(
@@ -376,7 +449,27 @@ def read_checkpoint(prefix: str) -> Dict[str, np.ndarray]:
                 f"-of-{header.num_shards:05d}", "rb")
         f = shards[e.shard_id]
         f.seek(e.offset)
-        arr = np.frombuffer(f.read(e.size), np_dtype)
+        buf = f.read(e.size)
+        if e.dtype == _DT_STRING:
+            # varint64 length per element, 4-byte lengths-crc, then the
+            # concatenated bytes (tensor_bundle.cc WriteStringTensor)
+            n = int(np.prod(shape)) if shape else 1
+            lens, pos = [], 0
+            for _ in range(n):
+                v, pos = _varint(buf, pos)
+                lens.append(v)
+            pos += 4  # masked crc32c of the lengths section
+            arr = np.empty(n, object)
+            for i, ln in enumerate(lens):
+                arr[i] = buf[pos:pos + ln]
+                pos += ln
+            return arr.reshape(shape)
+        np_dtype = _BUNDLE_DTYPES.get(e.dtype)
+        if np_dtype is None:
+            raise ValueError(
+                f"checkpoint tensor {name!r} has unsupported dtype "
+                f"enum {e.dtype}")
+        arr = np.frombuffer(buf, np_dtype)
         if arr.size != int(np.prod(shape)):
             raise ValueError(
                 f"checkpoint tensor {name!r}: {arr.size} values for "
@@ -388,8 +481,11 @@ def read_checkpoint(prefix: str) -> Dict[str, np.ndarray]:
             if key.startswith(b"\x00"):
                 continue  # a slice data entry; consumed by its full tensor
             name = key.decode()
+            if e.dtype == _DT_STRING and name.startswith("_CHECKPOINTABLE"):
+                continue  # TF2 object-graph bookkeeping blob
             if e.dtype == _DT_STRING:
-                continue  # bookkeeping (e.g. object-graph blobs)
+                out[name] = read_raw(name, e)  # object array of bytes
+                continue
             if e.slices:
                 # partitioned variable (tf.compat.v1 partitioners): the
                 # full-tensor entry lists TensorSliceProtos; each slice's

@@ -369,3 +369,63 @@ class TestPartitionedVariables:
         out1, _ = sess.model.apply(sess.params, sess.state, jnp.asarray(xv))
         loss1 = float(crit.forward(out1, jnp.asarray(labels)))
         assert loss1 < loss0 * 0.5, (loss0, loss1)
+
+
+class TestPartitionedAndStringWrite:
+    def test_partitioned_write_roundtrips_and_tf_reads(self, tmp_path):
+        """VERDICT r4 item 9 (write half): partitioned bundle write —
+        differential against real TF's reader AND our own restore."""
+        from bigdl_tpu.utils.tf_checkpoint import write_checkpoint
+
+        rs = np.random.RandomState(1)
+        full = rs.randn(10, 6).astype(np.float32)
+        tensors = {"emb/weights": full,
+                   "plain": rs.randn(4).astype(np.float32)}
+        prefix = write_checkpoint(str(tmp_path / "part.ckpt"), tensors,
+                                  partitions={"emb/weights": 3})
+        # our reader reassembles the full tensor and exposes the parts
+        back = read_checkpoint(prefix)
+        np.testing.assert_array_equal(back["emb/weights"], full)
+        np.testing.assert_array_equal(back["emb/weights/part_0"], full[:4])
+        np.testing.assert_array_equal(back["emb/weights/part_2"], full[7:])
+        np.testing.assert_array_equal(back["plain"], tensors["plain"])
+        # real TF reassembles the sliced tensor too
+        reader = tf.train.load_checkpoint(prefix)
+        np.testing.assert_array_equal(reader.get_tensor("emb/weights"), full)
+        np.testing.assert_array_equal(reader.get_tensor("plain"),
+                                      tensors["plain"])
+
+    def test_string_tensor_roundtrips_and_tf_reads(self, tmp_path):
+        """VERDICT r4 item 9 (DT_STRING half)."""
+        from bigdl_tpu.utils.tf_checkpoint import write_checkpoint
+
+        strs = np.array([b"alpha", b"", b"long-" * 40 + b"tail",
+                         "unicode-é".encode()], object).reshape(2, 2)
+        tensors = {"vocab/words": strs,
+                   "num": np.arange(3, dtype=np.int32)}
+        prefix = write_checkpoint(str(tmp_path / "str.ckpt"), tensors)
+        back = read_checkpoint(prefix)
+        assert back["vocab/words"].shape == (2, 2)
+        assert [bytes(v) for v in back["vocab/words"].reshape(-1)] == \
+            [bytes(v) for v in strs.reshape(-1)]
+        reader = tf.train.load_checkpoint(prefix)
+        got = reader.get_tensor("vocab/words")
+        assert [bytes(v) for v in np.asarray(got).reshape(-1)] == \
+            [bytes(v) for v in strs.reshape(-1)]
+
+    def test_tf_written_string_tensor_reads_back(self, tmp_path):
+        """Differential the OTHER direction: TF writes DT_STRING, our
+        reader parses it (previously skipped as bookkeeping)."""
+        from bigdl_tpu.utils.tf_checkpoint import write_checkpoint  # noqa
+
+        with tf.Graph().as_default():
+            v = tf.Variable(np.array([b"abc", b"de"], object), name="sv",
+                            dtype=tf.string)
+            num = tf.Variable(np.float32(3.5), name="nv")
+            saver = tf.compat.v1.train.Saver([v, num])
+            with tf.compat.v1.Session() as s:
+                s.run(tf.compat.v1.global_variables_initializer())
+                prefix = saver.save(s, str(tmp_path / "tfstr.ckpt"))
+        back = read_checkpoint(prefix)
+        assert [bytes(x) for x in back["sv"]] == [b"abc", b"de"]
+        assert back["nv"] == np.float32(3.5)
