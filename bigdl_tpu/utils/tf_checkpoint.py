@@ -263,7 +263,7 @@ def write_checkpoint(prefix: str, tensors: Dict[str, np.ndarray],
     header.version.producer = 1
     kvs.append((b"", header.SerializeToString()))
 
-    def emit_data(arr: np.ndarray):
+    def emit_data(name: str, arr: np.ndarray):
         """Append one tensor's bytes; returns (dtype_enum, offset, size, crc)."""
         if arr.dtype == object or arr.dtype.kind in ("U", "S"):
             raw, crc = _string_tensor_bytes(arr)
@@ -271,12 +271,22 @@ def write_checkpoint(prefix: str, tensors: Dict[str, np.ndarray],
         else:
             dt = np_to_dt.get(arr.dtype)
             if dt is None:
-                raise ValueError(f"unsupported dtype {arr.dtype}")
+                raise ValueError(
+                    f"tensor {name!r}: unsupported dtype {arr.dtype}")
             raw = arr.tobytes()
             crc = _masked_crc(raw)
         off = len(data)
         data.extend(raw)
         return dt, off, len(raw), crc
+
+    unknown = set(partitions) - set(tensors)
+    if unknown:
+        raise ValueError(f"partitions name(s) not in tensors: "
+                         f"{sorted(unknown)}")
+    bad_counts = {k: v for k, v in partitions.items()
+                  if not isinstance(v, int) or v < 1}
+    if bad_counts:
+        raise ValueError(f"partitions counts must be >= 1: {bad_counts}")
 
     for name in sorted(tensors):
         arr = np.ascontiguousarray(tensors[name])
@@ -294,9 +304,6 @@ def write_checkpoint(prefix: str, tensors: Dict[str, np.ndarray],
                 raise ValueError(
                     f"tensor {name!r}: cannot split dim0={arr.shape[:1]} "
                     f"into {n_part} parts")
-            e.dtype = np_to_dt.get(arr.dtype)
-            if e.dtype is None:
-                raise ValueError(f"unsupported dtype {arr.dtype}")
             # fixed_size_partitioner split: ceil-sized leading parts
             base, extra = divmod(arr.shape[0], n_part)
             start = 0
@@ -306,7 +313,7 @@ def write_checkpoint(prefix: str, tensors: Dict[str, np.ndarray],
                 ext0 = sp.extent.add()
                 ext0.start = start
                 ext0.length = length
-                for d in arr.shape[1:]:  # full extents on other dims
+                for _ in arr.shape[1:]:  # full extents on other dims
                     sp.extent.add()
                 part = np.ascontiguousarray(arr[start:start + length])
                 se = tbp.BundleEntryProto()
@@ -314,12 +321,14 @@ def write_checkpoint(prefix: str, tensors: Dict[str, np.ndarray],
                 for d in arr.shape[1:]:
                     se.shape.dim.add().size = d
                 se.shard_id = 0
-                (se.dtype, se.offset, se.size, se.crc32c) = emit_data(part)
+                (se.dtype, se.offset, se.size, se.crc32c) = \
+                    emit_data(name, part)
+                e.dtype = se.dtype
                 kvs.append((_slice_entry_key(name, sp),
                             se.SerializeToString()))
                 start += length
         else:
-            (e.dtype, e.offset, e.size, e.crc32c) = emit_data(arr)
+            (e.dtype, e.offset, e.size, e.crc32c) = emit_data(name, arr)
         kvs.append((name.encode(), e.SerializeToString()))
     # sstable keys must be sorted: b"" (header) < b"\x00..." (slice
     # entries, OrderedCode) < tensor names
@@ -483,7 +492,7 @@ def read_checkpoint(prefix: str) -> Dict[str, np.ndarray]:
             name = key.decode()
             if e.dtype == _DT_STRING and name.startswith("_CHECKPOINTABLE"):
                 continue  # TF2 object-graph bookkeeping blob
-            if e.dtype == _DT_STRING:
+            if e.dtype == _DT_STRING and not e.slices:
                 out[name] = read_raw(name, e)  # object array of bytes
                 continue
             if e.slices:
@@ -492,12 +501,16 @@ def read_checkpoint(prefix: str) -> Dict[str, np.ndarray]:
                 # data lives in a sibling entry under its OrderedCode key.
                 # Reassemble host-side.
                 full_shape = tuple(d.size for d in e.shape.dim)
-                np_dtype = _BUNDLE_DTYPES.get(e.dtype)
-                if np_dtype is None:
-                    raise ValueError(
-                        f"checkpoint tensor {name!r} has unsupported "
-                        f"dtype enum {e.dtype}")
-                full = np.zeros(full_shape, np_dtype)
+                if e.dtype == _DT_STRING:
+                    full = np.empty(full_shape, object)
+                    full[...] = b""
+                else:
+                    np_dtype = _BUNDLE_DTYPES.get(e.dtype)
+                    if np_dtype is None:
+                        raise ValueError(
+                            f"checkpoint tensor {name!r} has unsupported "
+                            f"dtype enum {e.dtype}")
+                    full = np.zeros(full_shape, np_dtype)
                 # boolean coverage mask, not an element-count sum:
                 # TF's TensorSlice model permits overlapping-but-complete
                 # slice sets, which a count would wrongly reject

@@ -429,3 +429,31 @@ class TestPartitionedAndStringWrite:
         back = read_checkpoint(prefix)
         assert [bytes(x) for x in back["sv"]] == [b"abc", b"de"]
         assert back["nv"] == np.float32(3.5)
+
+    def test_tf_written_partitioned_string_reads_back(self, tmp_path):
+        """TF-written PARTITIONED string variable (slices + DT_STRING at
+        once): reassembled instead of crashing in the string fast path."""
+        with tf.Graph().as_default():
+            with tf.compat.v1.variable_scope(
+                    "s", partitioner=tf.compat.v1.fixed_size_partitioner(2)):
+                v = tf.compat.v1.get_variable(
+                    "words", dtype=tf.string,
+                    initializer=tf.constant(["aa", "bb", "cc", "dd"]))
+            saver = tf.compat.v1.train.Saver()
+            with tf.compat.v1.Session() as s:
+                s.run(tf.compat.v1.global_variables_initializer())
+                prefix = saver.save(s, str(tmp_path / "pstr.ckpt"))
+        back = read_checkpoint(prefix)
+        got = [bytes(x) for x in back["s/words"]]
+        assert got == [b"aa", b"bb", b"cc", b"dd"]
+
+    def test_write_partitions_validation(self, tmp_path):
+        from bigdl_tpu.utils.tf_checkpoint import write_checkpoint
+
+        t = {"a": np.arange(6, dtype=np.float32)}
+        with pytest.raises(ValueError, match="not in tensors"):
+            write_checkpoint(str(tmp_path / "x.ckpt"), t,
+                             partitions={"typo": 2})
+        with pytest.raises(ValueError, match=">= 1"):
+            write_checkpoint(str(tmp_path / "x.ckpt"), t,
+                             partitions={"a": -1})
