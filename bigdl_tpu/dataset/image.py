@@ -39,12 +39,29 @@ class LabeledImage:
 # ---------------------------------------------------------------------------
 
 
+try:  # SIMD resize for the hot augmentation path (the reference's
+    # pipeline is OpenCV too: transform/vision/image/opencv); numpy
+    # fallback below keeps the package dependency-free
+    import cv2 as _cv2
+except ImportError:  # pragma: no cover
+    _cv2 = None
+
+
 def resize_bilinear(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
-    """Pure-numpy bilinear resize, HWC (align_corners=False, half-pixel
-    centers — matches OpenCV INTER_LINEAR / tf.image semantics)."""
+    """Bilinear resize, HWC (align_corners=False, half-pixel centers —
+    OpenCV INTER_LINEAR / tf.image semantics).  Uses OpenCV's SIMD kernel
+    when available: the pure-numpy path measured ~14 ms per ImageNet
+    frame and capped the host input pipeline at ~33 img/s on 2 cores
+    (benchmarks/bench_input_pipeline.py), vs sub-ms in cv2."""
     h, w = img.shape[:2]
     if (h, w) == (out_h, out_w):
         return img.astype(np.float32, copy=False)
+    if _cv2 is not None:
+        out = _cv2.resize(img.astype(np.float32, copy=False),
+                          (out_w, out_h), interpolation=_cv2.INTER_LINEAR)
+        if out.ndim < img.ndim:  # cv2 drops a size-1 channel axis
+            out = out.reshape(out.shape + (1,) * (img.ndim - out.ndim))
+        return out
     ys = (np.arange(out_h) + 0.5) * h / out_h - 0.5
     xs = (np.arange(out_w) + 0.5) * w / out_w - 0.5
     y0 = np.clip(np.floor(ys).astype(np.int64), 0, h - 1)

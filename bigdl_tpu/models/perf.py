@@ -41,9 +41,20 @@ def build_model_and_shape(name: str, batch: int):
         return models.InceptionV1(1000), (batch, 224, 224, 3), 1000
     if name == "inception_v2":
         return models.InceptionV2(1000), (batch, 224, 224, 3), 1000
+    # sequence models: input is int32 token ids (B, S), label (B, S)
+    if name == "transformer":
+        m = models.TransformerLM(vocab_size=32_000, hidden_size=768,
+                                 n_layer=12, n_head=12, max_len=1024)
+        return m, (batch, 1024), 32_000
+    if name == "ptb_lstm":
+        # the reference PTB 'medium' LM (example/languagemodel/PTBModel)
+        return (models.PTBModel(vocab_size=10_000, embedding_dim=650,
+                                hidden_size=650, num_layers=2,
+                                keep_prob=1.0),
+                (batch, 35), 10_000)
     raise ValueError(f"unknown model {name!r} "
                      f"(lenet | vgg16 | resnet50 | resnet50_fused | inception | "
-                     f"inception_v2)")
+                     f"inception_v2 | transformer | ptb_lstm)")
 
 
 def run_perf(model_name: str = "inception", batch_size: int = 32,
@@ -59,10 +70,13 @@ def run_perf(model_name: str = "inception", batch_size: int = 32,
     from bigdl_tpu.parallel import batch_sharding
 
     model, shape, classes = build_model_and_shape(model_name, batch_size)
+    is_seq = len(shape) == 2  # (B, S) token-id models
     params, state, _ = model.build(jax.random.PRNGKey(0), shape)
     optim = SGD(learning_rate=0.01, momentum=0.9, dampening=0.0)
     opt_state = optim.init(params)
-    criterion = nn.ClassNLLCriterion()
+    criterion = nn.TimeDistributedCriterion(
+        nn.ClassNLLCriterion(), size_average=True) if is_seq \
+        else nn.ClassNLLCriterion()
     compute_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
 
     def train_step(params, model_state, opt_state, x, y):
@@ -70,7 +84,9 @@ def run_perf(model_name: str = "inception", batch_size: int = 32,
             p_c = jax.tree_util.tree_map(lambda a: a.astype(compute_dtype), p)
             s_c = jax.tree_util.tree_map(lambda a: a.astype(compute_dtype),
                                          model_state)
-            out, new_state = model.apply(p_c, s_c, x.astype(compute_dtype),
+            xc = x if jnp.issubdtype(x.dtype, jnp.integer) \
+                else x.astype(compute_dtype)
+            out, new_state = model.apply(p_c, s_c, xc,
                                          training=True, rng=None)
             new_state = jax.tree_util.tree_map(
                 lambda a: a.astype(jnp.float32), new_state)
@@ -81,8 +97,12 @@ def run_perf(model_name: str = "inception", batch_size: int = 32,
         return new_params, new_state, new_opt, loss
 
     rs = np.random.RandomState(0)
-    x = jnp.asarray(rs.rand(*shape), jnp.float32)
-    y = jnp.asarray(rs.randint(0, classes, shape[0]))
+    if is_seq:
+        x = jnp.asarray(rs.randint(0, classes, shape), jnp.int32)
+        y = jnp.asarray(rs.randint(0, classes, shape), jnp.int32)
+    else:
+        x = jnp.asarray(rs.rand(*shape), jnp.float32)
+        y = jnp.asarray(rs.randint(0, classes, shape[0]))
     if distributed:
         mesh = Engine.init() if Engine._mesh is None else Engine._mesh
         x = jax.device_put(x, batch_sharding(mesh))

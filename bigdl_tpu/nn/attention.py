@@ -4,11 +4,16 @@ The reference has no attention layers at all (survey §5.7); long-context is
 a designed-fresh, first-class TPU capability here.  The layer wraps the
 attention cores in `bigdl_tpu.ops.attention`:
 
-  * default: pallas flash attention (use_flash=True; measured faster than
-    the XLA dense path from S~8k and the only path that compiles at
-    S=32k — see ops/flash_attention.py; falls back to dense automatically
-    off-TPU or on non-tiling shapes),
-  * `use_flash=False` — dense softmax attention (XLA-fused),
+  * default: dense softmax attention — XLA:TPU fuses it flash-style
+    (no materialized (S,S) scores: S=32k compiles and runs in 15.75 GB),
+    and the round-5 re-measure has it FASTER than the hand-written
+    pallas kernel at every probed shape (S=1k..32k, fwd and train —
+    BENCH_APPENDIX.md "Attention kernel"); earlier toolchains measured
+    the opposite, which is why the default is a measured, revisitable
+    choice, not an assumption,
+  * `use_flash=True` — the pallas blockwise kernel
+    (ops/flash_attention.py), kept as the measured-fallback for
+    toolchains where XLA's fusion regresses,
   * `seq_parallel="ring"` — ring attention over the mesh `sequence` axis
     (K/V blocks rotate one ICI hop per step; O(S_local) memory/chip),
   * `seq_parallel="ulysses"` — all-to-all head-scatter/sequence-gather.
@@ -71,7 +76,7 @@ class MultiHeadAttention(Module):
 
     def __init__(self, hidden_size: int, n_head: int, *, causal: bool = False,
                  dropout: float = 0.0, with_bias: bool = True, rope: bool = False,
-                 seq_parallel: Optional[str] = None, use_flash: bool = True,
+                 seq_parallel: Optional[str] = None, use_flash: bool = False,
                  seq_axis: str = AXIS_SEQUENCE, data_axis: str = AXIS_DATA,
                  name: Optional[str] = None):
         super().__init__(name)
@@ -156,7 +161,7 @@ class TransformerBlock(Container):
 
     def __init__(self, hidden_size: int, n_head: int, *, causal: bool = True,
                  mlp_ratio: int = 4, dropout: float = 0.0, rope: bool = False,
-                 seq_parallel: Optional[str] = None, use_flash: bool = True,
+                 seq_parallel: Optional[str] = None, use_flash: bool = False,
                  moe_experts: int = 0, moe_k: int = 1,
                  name: Optional[str] = None):
         super().__init__(name)

@@ -215,12 +215,19 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     Falls back to `dense_attention` when the sequence doesn't tile by the
     block sizes or pallas is unavailable, so it is always safe to call.
 
-    Measured on v5e (causal, H=8, D=64, bf16, this kernel vs the XLA
-    einsum-softmax path): S=2048 20.1 vs 20.3 ms, S=8192 22.6 vs 28.8 ms,
-    S=16384 24.4 vs 39.6 ms; at S=32768 the dense path fails to compile
-    (scores buffer) while this kernel runs 39 ms fwd with finite grads.
-    It also beats jax.experimental.pallas.ops.tpu.flash_attention ~2x at
-    these shapes, so MultiHeadAttention defaults to use_flash=True.
+    Measurement history (v5e, causal, bf16 — the default follows the
+    measurement, not an assumption):
+
+    * round-3 toolchain (H=8, D=64): this kernel beat the XLA
+      einsum-softmax path from S~8k (22.6 vs 28.8 ms) and was the only
+      path that compiled at S=32768 (dense died on the scores buffer).
+    * round-5 toolchain (H=12, D=64, benchmarks/bench_transformer.py +
+      BENCH_APPENDIX "Attention kernel"): XLA now fuses the dense path
+      flash-style — S=32768 compiles in 15.75 GB and runs FASTER than
+      this kernel at every probed shape, fwd and train (speedup of this
+      kernel vs XLA: 0.42x-0.76x).  MultiHeadAttention therefore
+      defaults to use_flash=False; the kernel stays as the measured
+      fallback for toolchains where XLA's fusion regresses.
     """
     b, sq, h, d = q.shape
     sk = k.shape[1]

@@ -1,0 +1,95 @@
+"""Ready-made host input pipelines.
+
+The production ImageNet-train path as ONE reusable builder: C++ TFRecord
+prefetcher -> Example parse -> JPEG decode + augmentation in the MT pool
+-> stacked (images, labels) batches.  Used by `bench.py --real-data` and
+`benchmarks/bench_input_pipeline.py` (the two must measure the SAME
+pipeline), and directly usable by trainers.
+
+Reference analogue: dataset/image/MTLabeledBGRImgToBatch.scala over the
+SeqFile ImageNet layout (dataset/DataSet.scala:482-560).
+"""
+
+from __future__ import annotations
+
+import glob
+import io
+import os
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.vision.image import (
+    ChannelNormalize,
+    Flip,
+    ImageFeature,
+    MTImageFeatureToBatch,
+    RandomCropper,
+    RandomResize,
+)
+
+# the standard ImageNet channel statistics (reference:
+# BGRImgNormalizer defaults, in RGB order here)
+IMAGENET_MEAN = (123.68, 116.78, 103.94)
+IMAGENET_STD = (58.4, 57.12, 57.38)
+
+
+class DecodeJPEGFeature:
+    """ImageFeature with raw bytes under 'bytes' -> decoded .image, then
+    the wrapped augmentation chain — all inside the MT worker pool (PIL
+    releases the GIL during decode)."""
+
+    def __init__(self, chain):
+        self.chain = chain
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(feature.pop("bytes")))
+        feature.image = np.asarray(img.convert("RGB"), np.float32)
+        return self.chain.transform(feature)
+
+
+def imagenet_train_chain(image: int = 224):
+    """RandomResize(256..480) -> RandomCrop(image) -> HFlip -> Normalize
+    (the reference's BGRImg train augmentation, RGB order)."""
+    return (RandomResize(256, 480) >> RandomCropper(image, image)
+            >> Flip(0.5) >> ChannelNormalize(IMAGENET_MEAN, IMAGENET_STD))
+
+
+def shard_paths(data_dir: str) -> List[str]:
+    paths = sorted(glob.glob(os.path.join(data_dir, "*.tfrecord")))
+    if not paths:
+        raise FileNotFoundError(
+            f"no *.tfrecord shards under {data_dir} "
+            f"(tools/gen_imagenet_shards.py writes them)")
+    return paths
+
+
+def imagenet_record_features(paths: Sequence[str], *, loop: bool = False,
+                             n_threads: int = 2,
+                             capacity: int = 512) -> Iterator[ImageFeature]:
+    """Shards -> undecoded ImageFeatures (bytes + label)."""
+    from bigdl_tpu.dataset.tfrecord import PrefetchRecordReader
+    from bigdl_tpu.nn.tf_ops import parse_example_proto
+
+    while True:
+        for rec in PrefetchRecordReader(list(paths), n_threads=n_threads,
+                                        capacity=capacity):
+            f = parse_example_proto(rec)
+            yield ImageFeature(label=int(f["image/class/label"][0]),
+                               bytes=f["image/encoded"][0])
+        if not loop:
+            return
+
+
+def imagenet_train_batches(data_dir: str, batch: int, *, image: int = 224,
+                           num_threads: Optional[int] = None,
+                           loop: bool = False
+                           ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """The full pipeline: (B, image, image, 3) float32 + (B,) labels."""
+    mt = MTImageFeatureToBatch(
+        image, image, batch, DecodeJPEGFeature(imagenet_train_chain(image)),
+        num_threads=num_threads or os.cpu_count() or 2)
+    return iter(mt(imagenet_record_features(shard_paths(data_dir),
+                                            loop=loop)))

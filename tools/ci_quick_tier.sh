@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# CI gate for the quick test tier (VERDICT r4 item 8).
+#
+# Runs `pytest -m "not slow"` under a HARD wall-clock budget and fails on
+# breach — the budget keeps the quick tier honest: tests that grow past
+# it must either get faster or move to the slow tier (the reference's
+# integration-test tag split, spark/dl/pom.xml:327-341).
+#
+#   tools/ci_quick_tier.sh [budget_seconds]   # default 180
+set -u
+BUDGET="${1:-180}"
+cd "$(dirname "$0")/.."
+
+start=$(date +%s)
+timeout --signal=TERM "$BUDGET" python -m pytest tests/ -m "not slow" -q
+rc=$?
+elapsed=$(( $(date +%s) - start ))
+
+if [ "$rc" -eq 124 ]; then
+    echo "FAIL: quick tier exceeded the ${BUDGET}s budget (killed)" >&2
+    exit 1
+fi
+if [ "$rc" -ne 0 ]; then
+    echo "FAIL: quick tier red (pytest rc=$rc, ${elapsed}s)" >&2
+    exit "$rc"
+fi
+echo "OK: quick tier green in ${elapsed}s (budget ${BUDGET}s)"

@@ -1,0 +1,101 @@
+"""Write ImageNet-shaped TFRecord shards for input-pipeline benchmarking.
+
+Records carry REAL JPEG bytes (`image/encoded` + `image/class/label`
+Example features — the standard ImageNet-TFRecord schema) so the host
+pipeline pays the true decode cost.  Pixels are synthetic but with
+natural-image statistics (smooth low-frequency fields + blobs + grain,
+~street-scene JPEG entropy) so per-image decode time and file size are
+ImageNet-like (~tens of KB at 500x375, the ImageNet-train average frame).
+
+A pool of --pool distinct JPEGs is generated once and cycled with fresh
+labels to reach the target size: encode cost is paid per POOL image,
+decode cost downstream is identical for every record, and the byte
+stream is exactly what the reference's production path consumes
+(dataset/DataSet.scala:482-560 SeqFile ImageNet -> here TFRecord shards +
+native/src/prefetch.cc).
+
+    python tools/gen_imagenet_shards.py --out data/imagenet_tfr --gb 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import os
+
+import numpy as np
+from scipy import ndimage
+
+
+def make_jpeg(rs: np.random.RandomState, h: int = 375, w: int = 500) -> bytes:
+    from PIL import Image
+
+    # low-frequency color field (the "scene")
+    base = rs.rand(3, h // 25 + 2, w // 25 + 2).astype(np.float32)
+    img = np.stack([ndimage.zoom(c, 25, order=3)[:h, :w] for c in base], -1)
+    # mid-frequency blobs (objects/texture)
+    blobs = rs.rand(3, h // 5 + 2, w // 5 + 2).astype(np.float32)
+    img += 0.35 * np.stack([ndimage.zoom(c, 5, order=1)[:h, :w]
+                            for c in blobs], -1)
+    img += 0.05 * rs.rand(h, w, 3).astype(np.float32)  # grain
+    img = (255 * (img - img.min()) / (np.ptp(img) + 1e-6)).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, "JPEG", quality=88)
+    return buf.getvalue()
+
+
+def main(argv=None) -> None:
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bigdl_tpu.dataset.tfrecord import TFRecordWriter
+    from bigdl_tpu.nn.tf_ops import build_example_proto
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="data/imagenet_tfr")
+    ap.add_argument("--gb", type=float, default=20.0)
+    ap.add_argument("--pool", type=int, default=1024,
+                    help="distinct JPEGs; cycled with fresh labels")
+    ap.add_argument("--shard-mb", type=int, default=256)
+    args = ap.parse_args(argv)
+
+    rs = np.random.RandomState(7)
+    pool = [make_jpeg(rs) for _ in range(args.pool)]
+    mean = sum(map(len, pool)) / len(pool)
+    print(f"pool: {args.pool} jpegs, mean {mean/1e3:.1f} KB")
+
+    os.makedirs(args.out, exist_ok=True)
+    target = int(args.gb * 1e9)
+    shard_target = args.shard_mb * 1_000_000
+    written = shard_idx = n_rec = 0
+    w = None
+    lab_rs = np.random.RandomState(11)
+    while written < target:
+        if w is None:
+            path = os.path.join(args.out,
+                                f"train-{shard_idx:05d}.tfrecord")
+            w = TFRecordWriter(path)
+            shard_written = 0
+        rec = build_example_proto({
+            "image/encoded": [pool[n_rec % args.pool]],
+            "image/class/label": np.asarray(
+                [lab_rs.randint(0, 1000)], np.int64),
+        })
+        w.write(rec)
+        n_rec += 1
+        written += len(rec) + 16
+        shard_written += len(rec) + 16
+        if shard_written >= shard_target:
+            w.close()
+            w = None
+            shard_idx += 1
+    if w is not None:
+        w.close()
+        shard_idx += 1
+    print(f"{n_rec} records, {shard_idx} shards, "
+          f"{written/1e9:.2f} GB -> {args.out}")
+
+
+if __name__ == "__main__":
+    main()
