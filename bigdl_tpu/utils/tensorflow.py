@@ -322,27 +322,35 @@ class _TFImporter:
                      data_inputs[:2])
 
     def _cond_branch_side(self, ref: str):
-        """(side, pred_ref) for a standalone-cond Merge input: walk back to
-        the nearest Switch; the output index consumed (:1 true, :0 false)
-        identifies the branch."""
+        """(sides, pred_ref) for a standalone-cond Merge input: walk back
+        to the nearest Switches; the output indexes consumed (:1 true,
+        :0 false) identify the branch.  `sides` is a SET — a cross-linked
+        producer reaches both ports and yields {0, 1}, which the Merge
+        conversion resolves by complementing the other input's side."""
         seen = set()
         stack = [ref]
+        sides: set = set()
+        pred = None
         while stack:
             r = stack.pop()
             base = _clean(r)
-            if base in seen:
+            if (base, r.endswith(":1")) in seen:
                 continue
-            seen.add(base)
+            seen.add((base, r.endswith(":1")))
             nd = self.nodes_by_name.get(base)
             if nd is None:
                 continue
             if nd.op == "Switch":
                 idx = r.split(":")[1] if ":" in r else "0"
-                pred = getattr(self, "_switch_pred", {}).get(base,
-                                                             nd.input[1])
-                return (1 if idx == "1" else 0), pred
+                if pred is None:
+                    pred = getattr(self, "_switch_pred", {}).get(
+                        base, nd.input[1])
+                sides.add(1 if idx == "1" else 0)
+                continue
             stack.extend(i for i in nd.input if not i.startswith("^"))
-        raise ValueError(f"no Switch ancestor for merge input {ref!r}")
+        if pred is None:
+            raise ValueError(f"no Switch ancestor for merge input {ref!r}")
+        return sides, pred
 
     def _alias(self, tf_name: str, src: str):
         src = self._key(src)
@@ -1051,10 +1059,23 @@ class _TFImporter:
             from bigdl_tpu.nn import tf_ops as _tf
 
             sides = [self._cond_branch_side(r) for r in data_inputs[:2]]
-            if sorted(s for s, _ in sides) != [0, 1]:
+
+            def uniq(s):
+                return next(iter(s)) if len(s) == 1 else None
+
+            u = [uniq(s) for s, _ in sides]
+            # a cross-linked input (reaches both ports) takes the
+            # complement of the uniquely-sided other input — the defined
+            # extension for the always-dead-in-TF dual producer
+            if u[0] is None and u[1] is not None:
+                u[0] = 1 - u[1]
+            elif u[1] is None and u[0] is not None:
+                u[1] = 1 - u[0]
+            if sorted(x for x in u if x is not None) != [0, 1]:
                 raise ValueError(
                     f"Merge {name!r}: could not identify true/false branch "
-                    f"sides {sides}")
+                    f"sides {[s for s, _ in sides]}")
+            sides = [(u[0], sides[0][1]), (u[1], sides[1][1])]
             if _clean(sides[0][1]) != _clean(sides[1][1]):
                 # nested conds: the nearest-Switch walk found different
                 # predicates — selecting on either would be silently wrong
@@ -1643,10 +1664,23 @@ def _detect_cond_regions(node_list, node_index, excluded: set, wanted: set,
                 union(first, o)
             merge_entries.append((n, refs, first))
         comp_members: Dict[str, Dict[str, set]] = {}
+        comp_dual: Dict[str, set] = {}
         for nm, (sides, srcs) in info.items():
-            if srcs:
-                comp_members.setdefault(
-                    find(next(iter(srcs))), {})[nm] = sides
+            if not srcs:
+                continue
+            root = find(next(iter(srcs)))
+            if len(sides) == 1:
+                comp_members.setdefault(root, {})[nm] = sides
+            else:
+                # cross-linked producer: consumes BOTH Switch sides
+                # (transitively).  In real TF such a node is always dead;
+                # the framework's defined extension is the eager
+                # SwitchGate semantics (untaken side clamps to ones).
+                # It is EXCLUDED from the structured region — it converts
+                # on the eager path — so the merges can still lower to
+                # lax.cond.  Note consumers of a dual node are dual too
+                # (sides propagate), so the pure/dual split is closed.
+                comp_dual.setdefault(root, set()).add(nm)
         comp_merges: Dict[str, list] = {}
         for n, refs, src in merge_entries:
             comp_merges.setdefault(find(src), []).append((n, refs))
@@ -1663,8 +1697,12 @@ def _detect_cond_regions(node_list, node_index, excluded: set, wanted: set,
                 merges.append(n)
                 side_refs[n.name] = refs
             if ok:
-                ok = all(len(v) == 1 for v in members.values()) \
-                    and not (set(members) & out_names) \
+                # members are single-side by construction (dual nodes are
+                # split out above); a region still falls back eagerly when
+                # a single-side value ESCAPES as a graph output (needed
+                # unconditionally outside the cond) or a branch embeds a
+                # foreign Switch/Merge (nested cond)
+                ok = not (set(members) & out_names) \
                     and not any(node_index[nm].op in ("Switch", "Merge")
                                 for nm in members)
             if ok:
@@ -1686,7 +1724,8 @@ def _detect_cond_regions(node_list, node_index, excluded: set, wanted: set,
                 continue
             regions.append({"pred": pred, "switches": comp_sws,
                             "merges": merges, "side_refs": side_refs,
-                            "members": members})
+                            "members": members,
+                            "dual": comp_dual.get(root, set())})
     return regions
 
 
@@ -1863,7 +1902,11 @@ def load_tensorflow(pb_path: str, inputs: Sequence[str],
     cond_member_names = set()
     for cr in cond_regions:
         cond_member_names |= set(cr["members"])
-        cond_member_names |= {s.name for s in cr["switches"]}
+        # a region with cross-linked (dual-side) nodes leaves its
+        # Switches ON the eager path as well: the dual nodes convert
+        # through SwitchGates while the merges still lower to lax.cond
+        if not cr.get("dual"):
+            cond_member_names |= {s.name for s in cr["switches"]}
         cond_member_names |= {m.name for m in cr["merges"]}
     pending = [n for n in gd.node
                if n.name not in frame_member_names
