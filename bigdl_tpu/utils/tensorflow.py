@@ -322,15 +322,20 @@ class _TFImporter:
                      data_inputs[:2])
 
     def _cond_branch_side(self, ref: str):
-        """(sides, pred_ref) for a standalone-cond Merge input: walk back
-        to the nearest Switches; the output indexes consumed (:1 true,
+        """(sides, preds) for a standalone-cond Merge input: walk back to
+        the nearest Switches; the output indexes consumed (:1 true,
         :0 false) identify the branch.  `sides` is a SET — a cross-linked
         producer reaches both ports and yields {0, 1}, which the Merge
-        conversion resolves by complementing the other input's side."""
+        conversion resolves by complementing the other input's side.
+        `preds` collects EVERY distinct nearest-Switch predicate so an
+        ancestry spanning two conds is detected deterministically (not by
+        GraphDef serialization order).  The walk covers the full ancestor
+        cone — acceptable: this is the rare eager-fallback path."""
         seen = set()
         stack = [ref]
         sides: set = set()
-        pred = None
+        preds: set = set()
+        pred_refs = {}
         while stack:
             r = stack.pop()
             base = _clean(r)
@@ -342,15 +347,16 @@ class _TFImporter:
                 continue
             if nd.op == "Switch":
                 idx = r.split(":")[1] if ":" in r else "0"
-                if pred is None:
-                    pred = getattr(self, "_switch_pred", {}).get(
-                        base, nd.input[1])
+                pref = getattr(self, "_switch_pred", {}).get(
+                    base, nd.input[1])
+                preds.add(_clean(pref))
+                pred_refs.setdefault(_clean(pref), pref)
                 sides.add(1 if idx == "1" else 0)
                 continue
             stack.extend(i for i in nd.input if not i.startswith("^"))
-        if pred is None:
+        if not preds:
             raise ValueError(f"no Switch ancestor for merge input {ref!r}")
-        return sides, pred
+        return sides, [pred_refs[p] for p in sorted(preds)]
 
     def _alias(self, tf_name: str, src: str):
         src = self._key(src)
@@ -1059,14 +1065,22 @@ class _TFImporter:
             from bigdl_tpu.nn import tf_ops as _tf
 
             sides = [self._cond_branch_side(r) for r in data_inputs[:2]]
+            all_preds = {p for _, ps in sides for p in (_clean(x) for x in ps)}
+            if len(all_preds) > 1 or any(len(ps) > 1 for _, ps in sides):
+                # ancestry spans multiple predicates: selecting on either
+                # would be silently wrong (nested/multi-pred cond)
+                raise NotImplementedError(
+                    f"Merge {name!r}: inputs trace to Switches with "
+                    f"different predicates {sorted(all_preds)} — nested "
+                    f"tf.cond import is not supported")
 
             def uniq(s):
                 return next(iter(s)) if len(s) == 1 else None
 
             u = [uniq(s) for s, _ in sides]
-            # a cross-linked input (reaches both ports) takes the
-            # complement of the uniquely-sided other input — the defined
-            # extension for the always-dead-in-TF dual producer
+            # a cross-linked input (reaches both ports of THE predicate)
+            # takes the complement of the uniquely-sided other input —
+            # the defined extension for the always-dead-in-TF dual node
             if u[0] is None and u[1] is not None:
                 u[0] = 1 - u[1]
             elif u[1] is None and u[0] is not None:
@@ -1075,15 +1089,7 @@ class _TFImporter:
                 raise ValueError(
                     f"Merge {name!r}: could not identify true/false branch "
                     f"sides {[s for s, _ in sides]}")
-            sides = [(u[0], sides[0][1]), (u[1], sides[1][1])]
-            if _clean(sides[0][1]) != _clean(sides[1][1]):
-                # nested conds: the nearest-Switch walk found different
-                # predicates — selecting on either would be silently wrong
-                raise NotImplementedError(
-                    f"Merge {name!r}: branches trace to Switches with "
-                    f"different predicates ({sides[0][1]!r} vs "
-                    f"{sides[1][1]!r}) — nested tf.cond import is not "
-                    f"supported")
+            sides = [(u[0], sides[0][1][0]), (u[1], sides[1][1][0])]
             pred_ref = sides[0][1]
             true_ref = data_inputs[0] if sides[0][0] == 1 else data_inputs[1]
             false_ref = data_inputs[1] if sides[0][0] == 1 else data_inputs[0]
@@ -1700,9 +1706,19 @@ def _detect_cond_regions(node_list, node_index, excluded: set, wanted: set,
                 # members are single-side by construction (dual nodes are
                 # split out above); a region still falls back eagerly when
                 # a single-side value ESCAPES as a graph output (needed
-                # unconditionally outside the cond) or a branch embeds a
-                # foreign Switch/Merge (nested cond)
+                # unconditionally outside the cond), a branch embeds a
+                # foreign Switch/Merge (nested cond), or a DUAL node
+                # consumes a single-side member — that member would then
+                # exist only inside the lax.cond branches while the dual
+                # node needs it eagerly (the whole region stays eager)
+                dual_names = comp_dual.get(root, set())
+                dual_reads_member = any(
+                    _clean(ref) in members
+                    for dn in dual_names
+                    for ref in node_index[dn].input
+                    if not ref.startswith("^"))
                 ok = not (set(members) & out_names) \
+                    and not dual_reads_member \
                     and not any(node_index[nm].op in ("Switch", "Merge")
                                 for nm in members)
             if ok:

@@ -340,6 +340,38 @@ class TestStandaloneCond:
             val = g.apply(gp, gs, jnp.asarray(vec, jnp.float32))[0]
             assert np.all(np.isfinite(np.asarray(val)))
 
+    def test_dual_node_reading_branch_member_falls_back(self, tmp_path):
+        """Regression (r5 review): a cross-linked node consuming
+        SINGLE-side branch members (mix = tbr*fbr) must push the whole
+        region onto the eager path — structuring it would trap tbr/fbr
+        inside the lax.cond branches while mix needs them eagerly."""
+        import tf_graph_pb2 as tfp
+
+        gd = tfp.GraphDef()
+        _nodedef(gd, "x", "Placeholder")
+        _nodedef(gd, "zero", "Const", value=np.asarray(0.0, np.float32))
+        _nodedef(gd, "axis0", "Const", value=np.asarray(0, np.int32))
+        _nodedef(gd, "s", "Sum", ["x", "axis0"])
+        _nodedef(gd, "pred", "GreaterEqual", ["s", "zero"])
+        _nodedef(gd, "sw", "Switch", ["x", "pred"])
+        _nodedef(gd, "tbr", "Sqrt", ["sw:1"])
+        _nodedef(gd, "fbr", "Neg", ["sw"])
+        _nodedef(gd, "mix", "Mul", ["tbr", "fbr"])  # dual via pure members
+        _nodedef(gd, "mg", "Merge", ["fbr", "tbr"])
+        _nodedef(gd, "out", "Add", ["mg", "mix"])
+        pb = str(tmp_path / "dual_reads_member.pb")
+        with open(pb, "wb") as fh:
+            fh.write(gd.SerializeToString())
+        g, gp, gs = load_tensorflow(pb, ["x"], ["out"], [(4,)])
+
+        from bigdl_tpu.nn.tf_ops import MergeSelect, TFCond
+
+        assert not any(isinstance(m, TFCond) for m in g.children.values())
+        assert any(isinstance(m, MergeSelect) for m in g.children.values())
+        for vec in ([1.0, 4.0, 9.0, 16.0], [-1.0, -2.0, -3.0, -4.0]):
+            val = g.apply(gp, gs, jnp.asarray(vec, jnp.float32))[0]
+            assert np.all(np.isfinite(np.asarray(val)))
+
     def test_shared_predicate_multi_output_cond(self, tmp_path):
         """Two Switches + two Merges on one predicate import as a single
         multi-output TFCond (region grouping by predicate)."""
