@@ -1,0 +1,144 @@
+"""Bytes-reduction experiment: bf16 gradients + bf16 momentum with fp32
+master weights on the HBM-bound ResNet-50 train step (VERDICT r4 item 10).
+
+The b256 step moves 77.1 GB (XLA cost analysis); params+grads+momentum
+are the fixed ~0.4 GB/step term (25.6M params x 4 B x {param read, grad
+write+read, slot read+write}).  Storing the SGD-momentum slot in bf16 and
+keeping gradients bf16 through the update halves those streams; the fp32
+master copy preserves update precision (the standard mixed-precision
+recipe — and the analogue of the reference's fp16 wire compression,
+parameters/FP16CompressedTensor.scala, applied to optimizer state).
+
+Accept/reject is measured, appendix-style, like the remat and conv+BN
+chapters: both variants on the real chip, XLA cost-analysis bytes for
+each, plus an update-precision parity probe (fp32-slot vs bf16-slot
+parameter drift after N steps).
+
+    python benchmarks/bench_bf16_state.py [--iters 40]
+
+Prints one JSON row per variant + a parity row.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=40)
+    ap.add_argument("--batch", type=int, default=256)
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models import resnet50
+
+    batch, image, classes = args.batch, 224, 1000
+    model = resnet50(classes)
+    shape = (batch, image, image, 3)
+    params, state, _ = model.build(jax.random.PRNGKey(0), shape)
+    criterion = nn.ClassNLLCriterion()
+    lr, momentum = 0.1, 0.9
+
+    def grads_of(params, model_state, x, y):
+        def loss_fn(p):
+            p16 = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16), p)
+            out, new_state = model.apply(p16, model_state, x,
+                                         training=True, rng=None)
+            return criterion.forward(out.astype(jnp.float32), y), new_state
+
+        (loss, new_state), g = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        return loss, new_state, g
+
+    def step_fp32(params, model_state, mom, x, y):
+        """Baseline: fp32 grads (jax.grad of fp32 params), fp32 slots."""
+        loss, new_state, g = grads_of(params, model_state, x, y)
+        new_mom = jax.tree_util.tree_map(
+            lambda m, gi: momentum * m + gi, mom, g)
+        new_params = jax.tree_util.tree_map(
+            lambda p, m: p - lr * m, params, new_mom)
+        return new_params, new_state, new_mom, loss
+
+    def step_bf16_state(params, model_state, mom, x, y):
+        """Experiment: gradients cast bf16 at the boundary, momentum
+        STORED bf16; update math in fp32 against the fp32 master."""
+        loss, new_state, g = grads_of(params, model_state, x, y)
+        g16 = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16), g)
+        new_mom = jax.tree_util.tree_map(
+            lambda m, gi: (momentum * m.astype(jnp.float32)
+                           + gi.astype(jnp.float32)).astype(jnp.bfloat16),
+            mom, g16)
+        new_params = jax.tree_util.tree_map(
+            lambda p, m: p - lr * m.astype(jnp.float32), params, new_mom)
+        return new_params, new_state, new_mom, loss
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(*shape), jnp.bfloat16)
+    y = jnp.asarray(rs.randint(0, classes, batch))
+
+    def sync(tree):
+        leaf = jax.tree_util.tree_leaves(tree)[0]
+        return float(jnp.sum(leaf.astype(jnp.float32)))
+
+    def run(step_fn, mom_dtype, tag):
+        # fresh buffers per variant: the step donates its params/state,
+        # which deletes the donated arrays — sharing the global trees
+        # across variants would crash the second run on deleted Arrays
+        p = jax.tree_util.tree_map(jnp.array, params)
+        st = jax.tree_util.tree_map(jnp.array, state)
+        mom = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, mom_dtype), params)
+        step = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        # XLA's own account of the bytes the compiled step accesses
+        cost = step.lower(p, st, mom, x, y).compile().cost_analysis()
+        if isinstance(cost, list):  # older jax returns [dict]
+            cost = cost[0]
+        gb = float(cost.get("bytes accessed", 0.0)) / 1e9
+        for _ in range(3):
+            p, st, mom, loss = step(p, st, mom, x, y)
+        sync(p)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            p, st, mom, loss = step(p, st, mom, x, y)
+        sync(p)
+        dt = (time.perf_counter() - t0) / args.iters
+        row = {"variant": tag, "ms_per_step": round(dt * 1e3, 2),
+               "img_per_s": round(batch / dt, 1),
+               "hbm_GB_per_step_xla": round(gb, 2)}
+        print(json.dumps(row), flush=True)
+        return row, p
+
+    base_row, base_p = run(step_fp32, jnp.float32, "fp32_grads_slots")
+    exp_row, exp_p = run(step_bf16_state, jnp.bfloat16, "bf16_grads_slots")
+
+    # update-precision parity after iters steps (same data each step)
+    diffs = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                           - b.astype(jnp.float32)))),
+        base_p, exp_p)
+    scale = jax.tree_util.tree_map(
+        lambda a: float(jnp.max(jnp.abs(a.astype(jnp.float32))) + 1e-12),
+        base_p)
+    rel = max(d / s for d, s in zip(jax.tree_util.tree_leaves(diffs),
+                                    jax.tree_util.tree_leaves(scale)))
+    print(json.dumps({
+        "parity_max_rel_param_drift": round(rel, 5),
+        "speedup": round(base_row["ms_per_step"] / exp_row["ms_per_step"], 3),
+        "bytes_saved_GB": round(base_row["hbm_GB_per_step_xla"]
+                                - exp_row["hbm_GB_per_step_xla"], 2)}),
+        flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -153,6 +153,22 @@ def bench_decode():
     qfwd = jax.jit(lambda p, s, x: qm.apply(p, s, x, training=False)[0])
     results["weight_only"] = _time_fn(qfwd, qp, state, toks, iters=50)
 
+    # auto row (VERDICT r4 item 6): quantize(mode='auto') must govern the
+    # decode workload class too — on a non-walkable custom Module it
+    # microbenches {float, bf16, weight_only_wrap} and keeps the winner
+    from bigdl_tpu.nn.quantized import quantize
+
+    am, ap = quantize(model, params, mode="auto", sample_input=toks,
+                      state=state, bench_iters=20)
+    afwd = jax.jit(lambda p, s, x, am=am: am.apply(p, s, x,
+                                                   training=False)[0])
+    results["auto"] = _time_fn(afwd, ap, state, toks, iters=50)
+    print(json.dumps({"decode_auto_picked": am._quant_auto_report["picked"],
+                      "decode_auto_table_ms": {
+                          k: round(v, 3) for k, v in
+                          am._quant_auto_report["ms_per_batch"].items()}}),
+          flush=True)
+
     for mode, ms in results.items():
         print(json.dumps({
             "workload": "transformer_lm_decode_b8", "mode": mode,

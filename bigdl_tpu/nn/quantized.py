@@ -321,12 +321,45 @@ def _quantize_auto(module: Module, params: Any, sample_input, state,
         lambda a: a.astype(jnp.bfloat16)
         if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
         else a, params)
+    def _has_quantized(mod) -> bool:
+        """True when the walker actually swapped some layer for an int8
+        one — object identity is NOT enough (Containers/Graphs rebuild
+        a fresh wrapper even when no child quantized)."""
+        if isinstance(mod, _QuantizedBase):
+            return True
+        for child in getattr(mod, "children", {}).values():
+            if _has_quantized(child):
+                return True
+        if isinstance(mod, Graph):
+            seen, stack = set(), list(mod.output_nodes)
+            while stack:
+                nd = stack.pop()
+                if id(nd) in seen:
+                    continue
+                seen.add(id(nd))
+                if nd.module is not None and _has_quantized(nd.module):
+                    return True
+                stack.extend(nd.prevs)
+        return False
+
     candidates = [("float", module, params, x), ("bf16", module, p16, x16)]
+    walkable = False
     for m in ("dynamic", "static", "weight_only"):
         qm, qp = quantize(module, params, m)
+        if not _has_quantized(qm):
+            continue  # walker found nothing quantizable: identity, skip
+        walkable = True
         if m == "static":
             qp = calibrate(qm, qp, state, batches)
         candidates.append((m, qm, qp, x))
+    if not walkable:
+        # custom Modules the tree walker cannot descend (TransformerLM,
+        # scan-stacked blocks): the leaf-wise weight-only wrapper is the
+        # int8 path — decode-class workloads are weight-bandwidth-bound,
+        # exactly where it can pay
+        qm, qp = WeightOnlyInt8.from_float(module, params,
+                                           compute_dtype=jnp.bfloat16)
+        candidates.append(("weight_only_wrap", qm, qp, x16))
 
     def time_mode(mod, p, xi):
         fwd = jax.jit(lambda p_, x_: mod.apply(p_, state, x_,

@@ -1066,7 +1066,7 @@ class _TFImporter:
 
             sides = [self._cond_branch_side(r) for r in data_inputs[:2]]
             all_preds = {p for _, ps in sides for p in (_clean(x) for x in ps)}
-            if len(all_preds) > 1 or any(len(ps) > 1 for _, ps in sides):
+            if len(all_preds) > 1:
                 # ancestry spans multiple predicates: selecting on either
                 # would be silently wrong (nested/multi-pred cond)
                 raise NotImplementedError(
