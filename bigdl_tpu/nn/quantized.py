@@ -351,7 +351,12 @@ def _quantize_auto(module: Module, params: Any, sample_input, state,
         walkable = True
         if m == "static":
             qp = calibrate(qm, qp, state, batches)
-        candidates.append((m, qm, qp, x))
+        # int8 layers return y.astype(x.dtype): benching them on the raw
+        # fp32 sample runs the whole net's ACTIVATIONS fp32 and
+        # systematically penalizes int8 vs the bf16 serving reality
+        # (r5 capture: auto's static read 28.4 ms where the bf16-input
+        # table row measured 20.0 ms — a mispick, not noise)
+        candidates.append((m, qm, qp, x16))
     if not walkable:
         # custom Modules the tree walker cannot descend (TransformerLM,
         # scan-stacked blocks): the leaf-wise weight-only wrapper is the
