@@ -436,13 +436,19 @@ class TestMultiOutputModel:
         with pytest.raises(ValueError, match="losses for"):
             model.compile(optimizer="sgd", loss=["mse", "mse", "mse"])
 
-    def test_per_tensor_metrics_rejected_loudly(self):
-        # Top1Accuracy.batch would crash on the Table output mid-training;
-        # compile() must reject it up front
+    def test_per_tensor_metrics_route_per_output(self):
+        # round-5: per-tensor metrics on multi-output Models are ROUTED
+        # per head (PerOutput wrapper) instead of rejected — the keras-1
+        # flat-list form replicates across every output
+        # (tests/test_keras_multi_metrics.py covers the full matrix)
+        from bigdl_tpu.optim.validation import PerOutput
+
         model = model_from_json_config(self._two_head_json())
-        with pytest.raises(ValueError, match="per-tensor"):
-            model.compile(optimizer="sgd", loss=["mse", "mse"],
-                          metrics=["top1"])
+        model.compile(optimizer="sgd", loss=["mse", "mse"],
+                      metrics=["top1"])
+        assert [m.name for m in model.metrics] == \
+            ["Top1Accuracy[out0]", "Top1Accuracy[out1]"]
+        assert all(isinstance(m, PerOutput) for m in model.metrics)
 
 
 class TestWrapperZooFixtureModel:

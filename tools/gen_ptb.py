@@ -94,12 +94,15 @@ def main(argv=None) -> None:
                     help="PTB convention: top vocab-1 words + <unk>")
     ap.add_argument("--max-train-tokens", type=int, default=950_000,
                     help="cap near real-PTB scale (929k train tokens)")
+    ap.add_argument("--pkgs", default=None,
+                    help="comma-separated package subset (default: all)")
     args = ap.parse_args(argv)
+    pkgs = tuple(args.pkgs.split(",")) if args.pkgs else PKGS
 
     splits: dict[str, list[list[str]]] = {"train": [], "valid": [], "test": []}
     site = _site()
     files = []
-    for pkg in PKGS:
+    for pkg in pkgs:
         files += sorted(glob.glob(os.path.join(site, pkg, "**/*.py"),
                                   recursive=True))
     for path in files:
@@ -137,7 +140,7 @@ def main(argv=None) -> None:
         h = hashlib.sha256(open(path, "rb").read()).hexdigest()[:16]
         print(f"{path}  {len(sents)} sentences  {n_tok} tokens  sha256:{h}")
     print(f"vocab: {min(len(counts), args.vocab_size - 1) + 1} types "
-          f"(incl <unk>); corpus: real docstring prose from {PKGS}")
+          f"(incl <unk>); corpus: real docstring prose from {pkgs}")
 
 
 if __name__ == "__main__":
