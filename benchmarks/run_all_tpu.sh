@@ -18,6 +18,15 @@ run() {  # name, timeout_s, cmd...
 
 run bench          600 python /root/repo/bench.py
 run bench_fusebn   600 env BENCH_FUSE_BN=1 python /root/repo/bench.py
-run int8           900 python /root/repo/benchmarks/bench_int8.py
+run int8          1800 python /root/repo/benchmarks/bench_int8.py
 run appendix_fuse 1500 python /root/repo/benchmarks/bench_appendix.py --fuse-bn
+# round-5 additions.  bench_input_pipeline is host-only (forces the CPU
+# backend) but still run it SEQUENTIALLY: one process per tunnel.
+# Real-data stages need shards: python tools/gen_imagenet_shards.py --gb 20
+run transformer   2400 python /root/repo/benchmarks/bench_transformer.py --iters 40
+run bf16_state    1500 python /root/repo/benchmarks/bench_bf16_state.py
+if [ -d /root/repo/data/imagenet_tfr ]; then
+  run input_pipeline 600 python /root/repo/benchmarks/bench_input_pipeline.py
+  run bench_realdata 600 python /root/repo/bench.py --real-data
+fi
 echo "all done -> $OUT"
