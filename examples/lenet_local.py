@@ -2,8 +2,11 @@
 
 Trains on real MNIST idx files if --data-dir holds them, else on synthetic
 digits, using the LocalOptimizer API end-to-end (checkpoint + validation).
+No MNIST download in this environment: `python tools/gen_mnist.py --out
+data/mnist` writes real-format idx files (see its docstring); the
+full-convergence DistriOptimizer run lives in examples/train_mnist.py.
 
-    python examples/lenet_local.py [--data-dir ~/mnist] [--epochs 1]
+    python examples/lenet_local.py [--data-dir data/mnist] [--epochs 1]
 """
 
 import argparse
