@@ -1,0 +1,147 @@
+"""Serving latency through the micro-batching runtime (VERDICT r5 item 6).
+
+The PredictionService path had never been latency-measured; this harness
+times it END-TO-END through `bigdl_tpu.serving.ServingRuntime` — admission
+queue, bucket coalescing, pad-to-bucket, jitted forward, readback — not
+just the bare forward.  Three serving variants of the same weights:
+
+  * fp32        — the model as built
+  * int8        — calibrated static int8 (`nn.quantize(mode="static")`)
+  * bn_folded   — inference conv+BN fold (`utils/fusion.fold_batchnorm`)
+
+and three request phases per variant:
+
+  * b1   — sequential single-row requests (pure latency; includes the
+           max-wait coalescing window, which is part of the honest number)
+  * b8   — sequential 8-row requests
+  * burst64_b1 — 64 concurrent single-row requests (the coalescing smoke:
+           occupancy/batches show the scheduler folding them into few
+           fixed-shape forwards)
+
+Emits one JSON row per (variant, phase) with p50/p99/mean latency, batch
+occupancy, device-batch count and compiled-shape count, and writes the
+table to benchmarks/results/serving.json.
+
+    python benchmarks/bench_serving.py            # ResNet-50 @224 (TPU)
+    python benchmarks/bench_serving.py --quick    # ResNet-20/CIFAR @32 (CPU-sized)
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+BUCKETS = (1, 8, 32)
+MAX_WAIT_MS = 2.0
+
+
+def build_variants(model_name: str):
+    """Returns (image, [(variant, module, params, state), ...])."""
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models import resnet50
+    from bigdl_tpu.models.resnet import resnet_cifar
+    from bigdl_tpu.utils.fusion import fold_batchnorm
+
+    if model_name == "resnet50":
+        model, image, classes = resnet50(1000), 224, 1000
+    else:
+        model, image, classes = resnet_cifar(20, 10), 32, 10
+    params, state, _ = model.build(jax.random.PRNGKey(0),
+                                   (BUCKETS[-1], image, image, 3))
+    rs = np.random.RandomState(0)
+    calib = [jnp.asarray(rs.rand(8, image, image, 3), jnp.float32)]
+
+    variants = [("fp32", model, params, state)]
+
+    qm, qp = nn.quantize(model, params, mode="static")
+    qp = nn.calibrate(qm, qp, state, calib)
+    variants.append(("int8", qm, qp, state))
+
+    fmodel, fparams, fstate = fold_batchnorm(model, params, state)
+    variants.append(("bn_folded", fmodel, fparams, fstate))
+    return image, variants
+
+
+def run_phase(module, params, state, image: int, phase: str, n: int):
+    from bigdl_tpu.serving import ServingConfig, ServingRuntime
+
+    rs = np.random.RandomState(1)
+    example = rs.rand(1, image, image, 3).astype(np.float32)
+    rt = ServingRuntime(
+        module, params, state, example_input=example,
+        config=ServingConfig(buckets=BUCKETS, max_wait_ms=MAX_WAIT_MS,
+                             capacity=256))
+    try:
+        t0 = time.perf_counter()
+        if phase == "burst64_b1":
+            reqs = [rs.rand(1, image, image, 3).astype(np.float32)
+                    for _ in range(n)]
+            with concurrent.futures.ThreadPoolExecutor(16) as pool:
+                list(pool.map(rt.predict, reqs))
+        else:
+            rows = 1 if phase == "b1" else 8
+            for _ in range(n):
+                rt.predict(rs.rand(rows, image, image, 3).astype(np.float32))
+        wall = time.perf_counter() - t0
+        snap = rt.metrics.snapshot()
+        return {
+            "phase": phase, "requests": n,
+            "p50_ms": snap["latency_ms"]["p50"],
+            "p99_ms": snap["latency_ms"]["p99"],
+            "mean_ms": snap["latency_ms"]["mean"],
+            "device_batch_p50_ms": snap["device_batch_ms"]["p50"],
+            "batch_occupancy": snap["batch_occupancy"],
+            "batches": snap["batches"],
+            "compiled_shapes": rt.compile_count(),
+            "wall_s": round(wall, 2),
+        }
+    finally:
+        rt.close()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="ResNet-20/CIFAR @32x32, fewer requests (CPU-sized)")
+    ap.add_argument("--model", choices=("resnet50", "resnet20_cifar"),
+                    default=None)
+    ap.add_argument("--requests", type=int, default=None)
+    args = ap.parse_args(argv)
+    model_name = args.model or ("resnet20_cifar" if args.quick else "resnet50")
+    n_seq = args.requests or (24 if args.quick else 50)
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    image, variants = build_variants(model_name)
+
+    rows = []
+    for variant, module, params, state in variants:
+        for phase, n in (("b1", n_seq), ("b8", max(8, n_seq // 2)),
+                         ("burst64_b1", 64)):
+            row = {"model": model_name, "variant": variant,
+                   "platform": platform, "max_wait_ms": MAX_WAIT_MS,
+                   "buckets": list(BUCKETS),
+                   **run_phase(module, params, state, image, phase, n)}
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+
+    out = os.path.join(os.path.dirname(__file__), "results", "serving.json")
+    with open(out, "w") as f:
+        json.dump(rows, f, indent=1)
+    print(f"# wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -120,7 +120,12 @@ def bench_attention(seq: int, train: bool, iters: int, heads=12, hd=64,
     from bigdl_tpu.ops.flash_attention import flash_attention
 
     rs = np.random.RandomState(0)
-    shape = (batch, heads, seq, hd)
+    # (B, S, H, D) — BOTH cores take batch-major sequence layout (dense
+    # einsum 'bqhd,bkhd->bhqk'; flash unpacks b, sq, h, d = q.shape).  The
+    # round-5 sweep built (B, H, S, D) here and therefore measured
+    # attention over an actual sequence length of `hd` with `seq` heads —
+    # every round-5 attention row is invalid (ADVICE.md high, r5).
+    shape = (batch, seq, heads, hd)
     q = jnp.asarray(rs.randn(*shape), jnp.bfloat16)
     k = jnp.asarray(rs.randn(*shape), jnp.bfloat16)
     v = jnp.asarray(rs.randn(*shape), jnp.bfloat16)

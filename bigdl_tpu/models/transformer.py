@@ -40,7 +40,7 @@ class TransformerLM(Module):
                  n_head: int = 8, *, max_len: int = 2048, dropout: float = 0.0,
                  rope: bool = True, tie_embeddings: bool = True,
                  seq_parallel: Optional[str] = None, scan_layers: bool = True,
-                 remat: bool = False, use_flash: bool = False,
+                 remat: bool = False, use_flash: bool = True,
                  moe_experts: int = 0, moe_k: int = 1,
                  pipeline_axis: Optional[str] = None,
                  pipeline_microbatches: int = 4,

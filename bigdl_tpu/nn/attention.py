@@ -4,16 +4,15 @@ The reference has no attention layers at all (survey §5.7); long-context is
 a designed-fresh, first-class TPU capability here.  The layer wraps the
 attention cores in `bigdl_tpu.ops.attention`:
 
-  * default: dense softmax attention — XLA:TPU fuses it flash-style
-    (no materialized (S,S) scores: S=32k compiles and runs in 15.75 GB),
-    and the round-5 re-measure has it FASTER than the hand-written
-    pallas kernel at every probed shape (S=1k..32k, fwd and train —
-    BENCH_APPENDIX.md "Attention kernel"); earlier toolchains measured
-    the opposite, which is why the default is a measured, revisitable
-    choice, not an assumption,
-  * `use_flash=True` — the pallas blockwise kernel
-    (ops/flash_attention.py), kept as the measured-fallback for
-    toolchains where XLA's fusion regresses,
+  * default (`use_flash=True`): the pallas blockwise flash kernel
+    (ops/flash_attention.py) — per the last VALID measurement (round 3:
+    flash wins from S~8k, dense fails to compile at S=32768).  The
+    round-5 re-measure that flipped the default to dense fed the cores
+    axis-swapped (B, H, S, D) inputs and is struck as invalid
+    (ADVICE.md r5 high; BENCH_APPENDIX "Attention kernel" section is
+    marked accordingly); the default stays a measured, revisitable
+    choice — re-flip only on a valid re-run,
+  * `use_flash=False` — XLA's dense softmax-attention fusion,
   * `seq_parallel="ring"` — ring attention over the mesh `sequence` axis
     (K/V blocks rotate one ICI hop per step; O(S_local) memory/chip),
   * `seq_parallel="ulysses"` — all-to-all head-scatter/sequence-gather.
@@ -76,7 +75,7 @@ class MultiHeadAttention(Module):
 
     def __init__(self, hidden_size: int, n_head: int, *, causal: bool = False,
                  dropout: float = 0.0, with_bias: bool = True, rope: bool = False,
-                 seq_parallel: Optional[str] = None, use_flash: bool = False,
+                 seq_parallel: Optional[str] = None, use_flash: bool = True,
                  seq_axis: str = AXIS_SEQUENCE, data_axis: str = AXIS_DATA,
                  name: Optional[str] = None):
         super().__init__(name)
@@ -161,7 +160,7 @@ class TransformerBlock(Container):
 
     def __init__(self, hidden_size: int, n_head: int, *, causal: bool = True,
                  mlp_ratio: int = 4, dropout: float = 0.0, rope: bool = False,
-                 seq_parallel: Optional[str] = None, use_flash: bool = False,
+                 seq_parallel: Optional[str] = None, use_flash: bool = True,
                  moe_experts: int = 0, moe_k: int = 1,
                  name: Optional[str] = None):
         super().__init__(name)

@@ -221,13 +221,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     * round-3 toolchain (H=8, D=64): this kernel beat the XLA
       einsum-softmax path from S~8k (22.6 vs 28.8 ms) and was the only
       path that compiled at S=32768 (dense died on the scores buffer).
-    * round-5 toolchain (H=12, D=64, benchmarks/bench_transformer.py +
-      BENCH_APPENDIX "Attention kernel"): XLA now fuses the dense path
-      flash-style — S=32768 compiles in 15.75 GB and runs FASTER than
-      this kernel at every probed shape, fwd and train (speedup of this
-      kernel vs XLA: 0.42x-0.76x).  MultiHeadAttention therefore
-      defaults to use_flash=False; the kernel stays as the measured
-      fallback for toolchains where XLA's fusion regresses.
+    * round-5 re-measure: INVALID.  bench_transformer.py built q/k/v as
+      (B, H, S, D) against cores that take (B, S, H, D), so its sweep
+      timed attention over an actual sequence length of D with S heads;
+      the "dense wins everywhere, 0.42x-0.76x" verdict and the
+      `use_flash=False` default flip drawn from it were artifacts
+      (ADVICE.md r5, high).  The layout is fixed; the default is back at
+      `use_flash=True` per the round-3 measurement until a valid re-run
+      on the current toolchain says otherwise.
     """
     b, sq, h, d = q.shape
     sk = k.shape[1]

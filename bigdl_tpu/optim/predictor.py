@@ -8,7 +8,8 @@ Reference:
   Sample RDD, reduce ValidationResults with `+`.
 - optim/PredictionService.scala:56,79-128 — concurrent serving facade:
   a pool of module instances in a LinkedBlockingQueue plus a byte-array
-  request/response API.
+  request/response API.  Served here by `bigdl_tpu.serving` (dynamic
+  micro-batching runtime); PredictionService below is the compat facade.
 
 TPU-native redesign: "broadcast the model" is device placement of one
 params pytree; per-node replicas become batch sharding over the mesh's
@@ -21,8 +22,6 @@ rows are dropped (Predictor) or masked out of the metric sums (Evaluator).
 from __future__ import annotations
 
 import io
-import queue
-import threading
 from typing import Any, Iterable, List, Optional, Sequence
 
 import jax
@@ -251,26 +250,39 @@ class Evaluator:
 class PredictionService:
     """Concurrent serving facade (reference: optim/PredictionService.scala:56).
 
-    The reference pools N stateful module clones in a LinkedBlockingQueue
-    because its modules cache activations; jitted JAX forwards are pure, so
-    the pool here bounds *concurrency* (queue slots) rather than cloning
-    weights — same interface, one weight copy.
+    Since the serving subsystem landed this is a THIN compatibility facade
+    over `bigdl_tpu.serving.ServingRuntime`: same constructor and
+    predict/predict_bytes surface, but concurrent requests now coalesce
+    into bucketed fixed-shape micro-batches (one jitted forward per
+    bucket) instead of each running alone.  The reference pooled N module
+    clones in a LinkedBlockingQueue because its modules cache activations;
+    here `concurrency` survives as an admission-queue sizing hint only.
+
+    New-code path: use `bigdl_tpu.serving.ServingRuntime` directly (hot
+    swap, deadlines, metrics — docs/serving.md).
     """
 
     def __init__(self, model: Module, params: Any, state: Any,
-                 concurrency: int = 4, batch_size: int = 1):
-        self.predictor = Predictor(model, params, state, batch_size=batch_size)
-        self._slots: "queue.Queue[int]" = queue.Queue()
-        for i in range(max(1, concurrency)):
-            self._slots.put(i)
+                 concurrency: int = 4, batch_size: int = 1,
+                 buckets: Optional[Sequence[int]] = None,
+                 max_wait_ms: float = 2.0):
+        from bigdl_tpu.serving import ServingConfig, ServingRuntime
+
+        if buckets is None:
+            # cover the legacy per-request batch size plus the coalescing
+            # sweet spots, deduped (e.g. batch_size=8 -> (1, 8, 32))
+            buckets = tuple(sorted({1, int(batch_size), 8, 32}))
+        self.runtime = ServingRuntime(
+            model, params, state,
+            config=ServingConfig(buckets=buckets, max_wait_ms=max_wait_ms,
+                                 capacity=max(16, int(concurrency) * 16)))
 
     def predict(self, x: Any) -> np.ndarray:
-        slot = self._slots.get()
-        try:
-            return self.predictor.predict(
-                x if isinstance(x, Table) else np.asarray(x))
-        finally:
-            self._slots.put(slot)
+        return self.runtime.predict(
+            x if isinstance(x, Table) else np.asarray(x))
+
+    def close(self, drain: bool = True) -> None:
+        self.runtime.close(drain=drain)
 
     # Byte-array request/response API (reference: PredictionService.scala:79-128
     # serves protobuf-serialized activities; here the wire format is npz).

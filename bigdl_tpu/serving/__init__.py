@@ -1,0 +1,50 @@
+"""TPU-native model serving runtime.
+
+Reference: optim/PredictionService.scala:56,79-128 — BigDL's serving story
+is a pool of stateful module clones behind a LinkedBlockingQueue; each
+request runs its own forward.  On TPU that design is wrong twice over:
+jitted forwards are pure (no clones needed), and per-request forwards give
+XLA one compiled shape per distinct request size plus per-request dispatch
+overhead.  The TPU-native redesign is the serving-side dual of the
+trainer's one-sync step:
+
+  * `MicroBatcher` coalesces concurrent single requests into a SMALL FIXED
+    SET of bucketed batch shapes (pad-to-bucket, max-wait deadline), so
+    the hot path is one jitted forward per bucket and XLA compiles at most
+    `len(buckets)` executables, ever.
+  * `ModelRegistry` holds versioned immutable (params, state) snapshots
+    with atomic hot-swap under load (a dispatching batch sees exactly one
+    version) and AOT warmup on registration so the first request after a
+    swap never eats a compile.
+  * Admission control: bounded queue, per-request deadlines, graceful
+    rejection, and drain/shutdown that completes in-flight batches —
+    mirroring the trainer's telemetry-ring drain guard.
+  * `ServingMetrics` exports p50/p99 latency, queue depth, batch occupancy
+    and rejection counters through the summary/TensorBoard machinery.
+
+`optim.PredictionService` remains as a thin compatibility facade over
+`ServingRuntime`.
+"""
+
+from bigdl_tpu.serving.batcher import (
+    DeadlineExceeded,
+    MicroBatcher,
+    Rejected,
+    ServingClosed,
+)
+from bigdl_tpu.serving.metrics import LatencyHistogram, ServingMetrics
+from bigdl_tpu.serving.registry import ModelRegistry, ModelVersion
+from bigdl_tpu.serving.runtime import ServingConfig, ServingRuntime
+
+__all__ = [
+    "DeadlineExceeded",
+    "LatencyHistogram",
+    "MicroBatcher",
+    "ModelRegistry",
+    "ModelVersion",
+    "Rejected",
+    "ServingClosed",
+    "ServingConfig",
+    "ServingMetrics",
+    "ServingRuntime",
+]

@@ -1,0 +1,264 @@
+"""Dynamic micro-batching scheduler with admission control.
+
+Reference: optim/PredictionService.scala:56 — the reference admits every
+request immediately and runs it alone on a pooled module clone; under
+overload the JVM queue grows without bound and every distinct request
+shape is a fresh execution plan.  Here the scheduler is shaped by the TPU
+cost model instead:
+
+  * Requests park in a BOUNDED queue (overload rejects loudly at admission
+    instead of queueing into timeout oblivion).
+  * A single scheduler thread coalesces waiting requests into the
+    smallest configured BUCKET that fits them (pad-to-bucket), dispatching
+    either when the largest bucket is full or when the oldest waiting
+    request has waited `max_wait_ms` — the classic latency/occupancy
+    trade, made explicit.
+  * Per-request deadlines: a request whose deadline passes while it waits
+    is failed with `DeadlineExceeded` at coalesce time and never occupies
+    device rows; requests that expire mid-collection simply drop out of
+    the forming batch.
+  * `close(drain=True)` stops admission, runs the queue dry (in-flight
+    batches complete), then joins the scheduler — the serving analogue of
+    the trainer's telemetry-ring drain guard
+    (tests/test_trainer_drain_guard.py).
+
+The batcher is model-agnostic: `dispatch(requests, bucket)` is injected by
+`ServingRuntime`, which owns padding, the jitted forward, and result
+splitting.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+
+class Rejected(RuntimeError):
+    """Request refused at admission (queue full / runtime closed)."""
+
+
+class ServingClosed(Rejected):
+    """Runtime is shut down (or shutting down) — request not admitted."""
+
+
+class DeadlineExceeded(Rejected):
+    """Request deadline passed before its batch dispatched."""
+
+
+class _Future:
+    """Single-assignment result slot (stdlib concurrent.futures would drag
+    in an executor; the scheduler thread IS the executor here)."""
+
+    __slots__ = ("_event", "_value", "_error", "meta")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self.meta: dict = {}
+
+    def set_result(self, value) -> None:
+        self._value = value
+        self._event.set()
+
+    def set_error(self, err: BaseException) -> None:
+        self._error = err
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving request did not complete in time")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class _Request:
+    __slots__ = ("x", "rows", "future", "t_enqueue", "deadline")
+
+    def __init__(self, x: Any, rows: int, deadline: Optional[float]):
+        self.x = x
+        self.rows = rows
+        self.future = _Future()
+        self.t_enqueue = time.perf_counter()
+        self.deadline = deadline  # absolute perf_counter time, or None
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+
+def pick_bucket(buckets: Sequence[int], rows: int) -> int:
+    """Smallest configured bucket that fits `rows` (buckets sorted asc)."""
+    for b in buckets:
+        if rows <= b:
+            return b
+    raise ValueError(f"{rows} rows exceed the largest bucket {buckets[-1]}")
+
+
+class MicroBatcher:
+    """Bounded-queue request coalescer around an injected dispatch fn.
+
+    dispatch(requests, bucket) must fulfil every request's future (it owns
+    padding + forward + splitting); an exception from dispatch fails the
+    whole batch.
+    """
+
+    def __init__(self, dispatch: Callable[[List[_Request], int], None],
+                 *, buckets: Sequence[int] = (1, 8, 32),
+                 max_wait_ms: float = 2.0, capacity: int = 128,
+                 default_deadline_ms: Optional[float] = None,
+                 metrics=None, name: str = "serving-batcher"):
+        if not buckets:
+            raise ValueError("need at least one bucket size")
+        self.buckets: Tuple[int, ...] = tuple(sorted(set(int(b) for b in buckets)))
+        if self.buckets[0] < 1:
+            raise ValueError(f"bucket sizes must be >= 1, got {buckets}")
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.default_deadline_ms = default_deadline_ms
+        self._dispatch = dispatch
+        self._metrics = metrics
+        self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=int(capacity))
+        self._closed = False
+        self._abort = False
+        self._drained = threading.Event()
+        self._carry: Optional[_Request] = None  # overflow from the last batch
+        self._thread = threading.Thread(target=self._loop, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, x: Any, rows: int,
+               deadline_ms: Optional[float] = None) -> _Future:
+        if rows < 1 or rows > self.buckets[-1]:
+            raise ValueError(
+                f"request rows {rows} outside [1, {self.buckets[-1]}] "
+                f"(largest bucket); chunk oversized requests before submit")
+        if self._closed:
+            if self._metrics:
+                self._metrics.on_reject("shutdown")
+            raise ServingClosed("serving runtime is closed")
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        deadline = (time.perf_counter() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        req = _Request(x, rows, deadline)
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            if self._metrics:
+                self._metrics.on_reject("queue_full")
+            raise Rejected(
+                f"serving queue full ({self._queue.maxsize} requests); "
+                "backpressure — retry with backoff or raise capacity")
+        if self._metrics:
+            self._metrics.on_admit(self._queue.qsize())
+        return req.future
+
+    @property
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    # -- scheduler loop ----------------------------------------------------
+
+    def _take(self, timeout: Optional[float]) -> Optional[_Request]:
+        if self._carry is not None:
+            req, self._carry = self._carry, None
+            return req
+        try:
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def _collect(self) -> Optional[Tuple[List[_Request], int]]:
+        """Block for the first request, then gather until the largest
+        bucket fills or the first request has waited max_wait_ms."""
+        first = self._take(timeout=0.05)
+        if first is None:
+            return None
+        batch = [first]
+        rows = first.rows
+        deadline = time.perf_counter() + self.max_wait_s
+        max_rows = self.buckets[-1]
+        while rows < max_rows:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            nxt = self._take(timeout=remaining)
+            if nxt is None:
+                break
+            if rows + nxt.rows > max_rows:
+                self._carry = nxt  # heads the next batch
+                break
+            batch.append(nxt)
+            rows += nxt.rows
+        return batch, rows
+
+    def _expire(self, batch: List[_Request]) -> List[_Request]:
+        """Fail deadline-expired requests; they never occupy device rows."""
+        now = time.perf_counter()
+        live = []
+        for req in batch:
+            if req.expired(now):
+                if self._metrics:
+                    self._metrics.on_reject("deadline")
+                req.future.set_error(DeadlineExceeded(
+                    f"deadline passed after {1e3 * (now - req.t_enqueue):.1f} ms "
+                    "in queue (coalesced but not dispatched)"))
+            else:
+                live.append(req)
+        return live
+
+    def _loop(self) -> None:
+        while True:
+            got = self._collect()
+            if got is None:
+                if self._closed and self._carry is None and self._queue.empty():
+                    break
+                continue
+            batch, _ = got
+            if self._abort:
+                for req in batch:
+                    if self._metrics:
+                        self._metrics.on_reject("shutdown")
+                    req.future.set_error(ServingClosed("runtime shut down"))
+                continue
+            batch = self._expire(batch)
+            if not batch:
+                continue
+            bucket = pick_bucket(self.buckets, sum(r.rows for r in batch))
+            try:
+                self._dispatch(batch, bucket)
+            except BaseException as e:  # noqa: BLE001 — fail the batch, keep serving
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_error(e)
+        # submissions that raced the close flag and slipped into the queue
+        # after the final empty-check must not hang their callers
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.future.set_error(ServingClosed("runtime shut down"))
+        self._drained.set()
+
+    # -- shutdown ----------------------------------------------------------
+
+    def close(self, drain: bool = True, timeout: Optional[float] = 30.0) -> None:
+        """Stop admission; `drain=True` completes everything already
+        admitted (in-flight batches included), `drain=False` fails the
+        still-queued requests with ServingClosed."""
+        self._closed = True
+        if not drain:
+            # the scheduler thread itself fails what is still queued (it
+            # owns the carry slot; draining from this thread would race it)
+            self._abort = True
+        if not self._drained.wait(timeout):
+            raise TimeoutError("serving batcher did not drain in time")
+        self._thread.join(timeout)

@@ -1,0 +1,241 @@
+"""ServingRuntime: bucketed jitted forwards behind the micro-batcher.
+
+Reference: optim/PredictionService.scala:79-128 (the byte API survives on
+the `optim.PredictionService` facade).  The runtime owns the TPU side of
+serving:
+
+  * ONE jitted forward, shared by every model version.  The jit cache is
+    keyed on input shapes, and every dispatch pads to a configured bucket,
+    so the executable set is exactly `len(buckets)` — 64 concurrent b1
+    requests compile at most 3 shapes (asserted by the compile-count
+    probe, `tests/test_serving.py`), the serving dual of the trainer's
+    one-compiled-step discipline.
+  * Padding reuses the Predictor's pad/mask rules (optim/predictor.py):
+    pad rows repeat the last real row, outputs are sliced back to real
+    rows before futures resolve — padded rows never leak.
+  * Hot-swap: `swap()/swap_checkpoint()` register a new version through
+    `ModelRegistry` (AOT-warmed per bucket BEFORE activation); dispatch
+    grabs one registry snapshot per batch, so results are always
+    single-version consistent.
+  * `metrics` (ServingMetrics) tracks p50/p99 latency, queue depth, batch
+    occupancy, rejections; `export_metrics()` writes them through the
+    summary/TensorBoard machinery.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from bigdl_tpu.core.table import Table
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.optim.predictor import _batch_rows, _pad_batch
+from bigdl_tpu.serving.batcher import MicroBatcher
+from bigdl_tpu.serving.metrics import ServingMetrics
+from bigdl_tpu.serving.registry import ModelRegistry, ModelVersion
+
+
+class ServingConfig:
+    """Knobs for the micro-batching scheduler (docs/serving.md)."""
+
+    def __init__(self, buckets: Sequence[int] = (1, 8, 32),
+                 max_wait_ms: float = 2.0, capacity: int = 128,
+                 default_deadline_ms: Optional[float] = None):
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.max_wait_ms = float(max_wait_ms)
+        self.capacity = int(capacity)
+        self.default_deadline_ms = default_deadline_ms
+
+
+def _concat_rows(xs: List[Any]) -> Any:
+    if len(xs) == 1:
+        return xs[0]
+    head = xs[0]
+    if isinstance(head, Table):
+        return Table(*[_concat_rows([x[i] for x in xs])
+                       for i in range(1, len(head) + 1)])
+    if isinstance(head, (list, tuple)):
+        return type(head)(_concat_rows([x[i] for x in xs])
+                          for i in range(len(head)))
+    return np.concatenate([np.asarray(x) for x in xs], axis=0)
+
+
+def _slice_rows(y: Any, lo: int, hi: int) -> Any:
+    if isinstance(y, (Table, list, tuple)):  # multi-head -> list per head
+        return [np.asarray(h)[lo:hi] for h in y]
+    return np.asarray(y)[lo:hi]
+
+
+class ServingRuntime:
+    """Dynamic micro-batching inference runtime over a versioned registry."""
+
+    def __init__(self, model: Module, params: Any, state: Any = None, *,
+                 config: Optional[ServingConfig] = None,
+                 example_input: Any = None, version: str = "v0",
+                 summary=None, **config_kw):
+        self.model = model
+        self.config = config or ServingConfig(**config_kw)
+        self.metrics = ServingMetrics()
+        self.summary = summary
+        self._example = example_input  # one-row example for AOT warmup
+        self._export_step = 0
+
+        def fwd(p, s, x):
+            out, _ = model.apply(p, s, x, training=False)
+            return out
+
+        self._fwd = jax.jit(fwd)
+        self._shapes = set()  # distinct padded input shapes ever dispatched
+
+        self.registry = ModelRegistry(warmup=self._warmup)
+        self.registry.register(version, params, state if state is not None else {})
+        self._batcher = MicroBatcher(
+            self._dispatch, buckets=self.config.buckets,
+            max_wait_ms=self.config.max_wait_ms,
+            capacity=self.config.capacity,
+            default_deadline_ms=self.config.default_deadline_ms,
+            metrics=self.metrics)
+
+    # -- warmup / compile probe -------------------------------------------
+
+    def _record_shape(self, x: Any) -> None:
+        leaves = jax.tree_util.tree_leaves(x)
+        self._shapes.add(tuple(tuple(np.shape(l)) for l in leaves))
+
+    def _warmup(self, params: Any, state: Any) -> None:
+        """One forward per bucket shape (jit compile on first registration;
+        cache hits on same-shaped swaps) so no request ever eats a compile."""
+        if self._example is None:
+            return
+        for bucket in self.config.buckets:
+            xp = _pad_batch(self._example, bucket)
+            self._record_shape(xp)
+            y = self._fwd(params, state, self._to_device(xp))
+            jax.tree_util.tree_map(
+                lambda l: getattr(l, "block_until_ready", lambda: l)(), y)
+
+    def compile_count(self) -> int:
+        """Distinct compiled forward shapes.  The jit cache size is the
+        ground truth when the runtime exposes it; the dispatched-shape set
+        is the structural fallback (identical whenever padding is sound)."""
+        try:
+            n = self._fwd._cache_size()  # pjit probe (jax >= 0.4)
+            if n is not None:
+                return int(n)
+        except Exception:
+            pass
+        return len(self._shapes)
+
+    # -- hot path ----------------------------------------------------------
+
+    @staticmethod
+    def _to_device(x: Any) -> Any:
+        import jax.numpy as jnp
+
+        if isinstance(x, Table):
+            return Table(*[ServingRuntime._to_device(v) for v in x])
+        if isinstance(x, (list, tuple)):
+            return type(x)(ServingRuntime._to_device(v) for v in x)
+        return jnp.asarray(np.asarray(x))
+
+    def _dispatch(self, requests, bucket: int) -> None:
+        t_dispatch = time.perf_counter()
+        snap: ModelVersion = self.registry.active()
+        if self._example is None:
+            # first traffic fixes the row spec; later hot-swaps AOT-warm
+            self._example = _slice_rows_like(requests[0].x, 0, 1)
+        rows = sum(r.rows for r in requests)
+        x = _concat_rows([r.x for r in requests])
+        xp = _pad_batch(x, bucket) if rows < bucket else x
+        self._record_shape(xp)
+        y = self._fwd(snap.params, snap.state, self._to_device(xp))
+        y = jax.tree_util.tree_map(np.asarray, y)  # host sync + split copy
+        t_done = time.perf_counter()
+        self.metrics.on_batch(bucket, rows, (t_done - t_dispatch) * 1e3)
+        off = 0
+        depth = self._batcher.queue_depth
+        for req in requests:
+            out = _slice_rows(y, off, off + req.rows)
+            off += req.rows
+            req.future.meta = {
+                "version": snap.version, "bucket": bucket, "batch_rows": rows,
+                "queue_ms": (t_dispatch - req.t_enqueue) * 1e3,
+                "batch_ms": (t_done - t_dispatch) * 1e3,
+            }
+            self.metrics.on_complete((t_dispatch - req.t_enqueue) * 1e3,
+                                     (t_done - req.t_enqueue) * 1e3, depth)
+            req.future.set_result(out)
+
+    def submit(self, x: Any, deadline_ms: Optional[float] = None):
+        """Async admission: returns a future (result(timeout=...))."""
+        return self._batcher.submit(x, _batch_rows(x), deadline_ms=deadline_ms)
+
+    def predict(self, x: Any, deadline_ms: Optional[float] = None,
+                timeout: Optional[float] = 60.0) -> Any:
+        """Blocking single-request predict.  Requests wider than the
+        largest bucket are chunked and reassembled."""
+        max_rows = self.config.buckets[-1]
+        n = _batch_rows(x)
+        if n <= max_rows:
+            return self.submit(x, deadline_ms).result(timeout)
+        outs = [self.submit(_slice_rows_like(x, lo, min(lo + max_rows, n)),
+                            deadline_ms).result(timeout)
+                for lo in range(0, n, max_rows)]
+        if isinstance(outs[0], list):  # multi-head
+            return [np.concatenate([o[i] for o in outs], axis=0)
+                    for i in range(len(outs[0]))]
+        return np.concatenate(outs, axis=0)
+
+    # -- versioning --------------------------------------------------------
+
+    def swap(self, version: str, params: Any, state: Any = None) -> None:
+        """Atomic params hot-swap: warm (AOT, off the request path), then
+        activate.  In-flight batches finish on the previous snapshot."""
+        self.registry.register(version, params, state if state is not None else {})
+        self.metrics.on_swap()
+
+    def swap_checkpoint(self, version: str, ckpt_dir: str) -> None:
+        """Load a trainer checkpoint dir and hot-swap to it."""
+        self.registry.register_checkpoint(version, ckpt_dir)
+        self.metrics.on_swap()
+
+    @property
+    def active_version(self) -> Optional[str]:
+        return self.registry.active_version
+
+    # -- observability / lifecycle ----------------------------------------
+
+    def export_metrics(self, step: Optional[int] = None) -> dict:
+        """Snapshot the metrics; when a summary is attached, also write
+        the scalar set + latency histogram through it."""
+        snap = self.metrics.snapshot()
+        if self.summary is not None:
+            if step is None:
+                step = self._export_step
+            self._export_step = step + 1
+            self.metrics.export(self.summary, step)
+        return snap
+
+    def close(self, drain: bool = True, timeout: Optional[float] = 30.0) -> None:
+        self._batcher.close(drain=drain, timeout=timeout)
+        if self.summary is not None:
+            self.export_metrics()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _slice_rows_like(x: Any, lo: int, hi: int) -> Any:
+    """Row-slice an INPUT (keeps Table/tuple structure, unlike the output
+    splitter which flattens multi-head outputs to a list)."""
+    if isinstance(x, Table):
+        return Table(*[_slice_rows_like(v, lo, hi) for v in x])
+    if isinstance(x, (list, tuple)):
+        return type(x)(_slice_rows_like(v, lo, hi) for v in x)
+    return np.asarray(x)[lo:hi]

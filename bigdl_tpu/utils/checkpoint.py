@@ -282,6 +282,18 @@ def load_checkpoint(ckpt_dir: str, params_template: Any,
     return params, model_state, opt_state, meta.get("driver_state", {})
 
 
+def load_params(ckpt_dir: str, params_template: Any,
+                model_state_template: Any = None) -> Tuple[Any, Any]:
+    """Serving-side load: (params, model_state) only — no optimizer slots,
+    no driver state.  Used by the model registry's checkpoint hot-swap
+    (`bigdl_tpu/serving/registry.py`): the template comes from the version
+    currently serving, so a shape-drifted checkpoint fails HERE (with the
+    offending tensor named) instead of inside a request's forward."""
+    params, model_state, _, _ = load_checkpoint(
+        ckpt_dir, params_template, model_state_template)
+    return params, model_state
+
+
 def latest_checkpoint(path: str) -> Optional[str]:
     """Newest ckpt dir under `path`, agreed across processes (collective
     when multi-process): only process 0's filesystem answer counts —

@@ -82,3 +82,14 @@ class ValidationSummary(Summary):
 
     def __init__(self, log_dir: str, app_name: str):
         super().__init__(log_dir, app_name, "validation")
+
+
+class ServingSummary(Summary):
+    """Serving-runtime observability stream (no reference counterpart —
+    PredictionService.scala has no metrics).  Same event-file + JSONL
+    machinery as train/validation, under `<app>/serving/`; fed by
+    `bigdl_tpu.serving.ServingMetrics.export` with p50/p99 latency, queue
+    depth, batch occupancy and rejection counters."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "serving")

@@ -67,9 +67,17 @@ def shard_paths(data_dir: str) -> List[str]:
 
 
 def imagenet_record_features(paths: Sequence[str], *, loop: bool = False,
-                             n_threads: int = 2,
-                             capacity: int = 512) -> Iterator[ImageFeature]:
-    """Shards -> undecoded ImageFeatures (bytes + label)."""
+                             n_threads: int = 2, capacity: int = 512,
+                             label_offset: int = 0) -> Iterator[ImageFeature]:
+    """Shards -> undecoded ImageFeatures (bytes + label).
+
+    `label_offset` is ADDED to the stored `image/class/label` value.  The
+    default 0 matches the in-repo shards (tools/gen_imagenet_shards.py
+    writes 0-based labels).  Standard inception-style ImageNet shards
+    store 1-based labels (0 reserved for background); pass
+    `label_offset=-1` for those so labels land in [0, 1000) as the
+    criterion expects.
+    """
     from bigdl_tpu.dataset.tfrecord import PrefetchRecordReader
     from bigdl_tpu.nn.tf_ops import parse_example_proto
 
@@ -77,19 +85,24 @@ def imagenet_record_features(paths: Sequence[str], *, loop: bool = False,
         for rec in PrefetchRecordReader(list(paths), n_threads=n_threads,
                                         capacity=capacity):
             f = parse_example_proto(rec)
-            yield ImageFeature(label=int(f["image/class/label"][0]),
-                               bytes=f["image/encoded"][0])
+            yield ImageFeature(
+                label=int(f["image/class/label"][0]) + label_offset,
+                bytes=f["image/encoded"][0])
         if not loop:
             return
 
 
 def imagenet_train_batches(data_dir: str, batch: int, *, image: int = 224,
                            num_threads: Optional[int] = None,
-                           loop: bool = False
+                           loop: bool = False, label_offset: int = 0
                            ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-    """The full pipeline: (B, image, image, 3) float32 + (B,) labels."""
+    """The full pipeline: (B, image, image, 3) float32 + (B,) labels.
+
+    `label_offset`: see `imagenet_record_features` (-1 for standard
+    1-based inception-style shards; default 0 for the in-repo shards)."""
     mt = MTImageFeatureToBatch(
         image, image, batch, DecodeJPEGFeature(imagenet_train_chain(image)),
         num_threads=num_threads or os.cpu_count() or 2)
     return iter(mt(imagenet_record_features(shard_paths(data_dir),
-                                            loop=loop)))
+                                            loop=loop,
+                                            label_offset=label_offset)))

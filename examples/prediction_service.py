@@ -1,8 +1,12 @@
-"""Concurrent model serving (reference: optim/PredictionService.scala +
-example/udfpredictor).
+"""Concurrent model serving on the micro-batching runtime.
 
-Builds a trained-ish LeNet, stands up a PredictionService pool, and fires
-concurrent requests at it.
+Reference: optim/PredictionService.scala + example/udfpredictor.  The
+reference pools module clones and runs every request alone; here 64
+concurrent single-image requests coalesce into a handful of bucketed
+fixed-shape batches (one jitted forward per bucket — watch the
+`batches` / `batch_occupancy` metrics), a checkpoint hot-swaps under
+load without a dropped request, and the admission queue rejects
+gracefully when overloaded.
 
     python examples/prediction_service.py
 """
@@ -10,6 +14,7 @@ concurrent requests at it.
 import concurrent.futures
 import os
 import sys
+import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -20,19 +25,62 @@ def main():
     import jax
 
     from bigdl_tpu.models import LeNet5
-    from bigdl_tpu.optim import PredictionService
+    from bigdl_tpu.serving import Rejected, ServingConfig, ServingRuntime
+    from bigdl_tpu.utils.checkpoint import save_checkpoint
 
     model = LeNet5(10)
     params, state, _ = model.build(jax.random.PRNGKey(0), (1, 28, 28, 1))
-    service = PredictionService(model, params, state, concurrency=2)
 
     rs = np.random.RandomState(0)
-    batches = [rs.rand(4, 28, 28, 1).astype("float32") for _ in range(8)]
-    with concurrent.futures.ThreadPoolExecutor(4) as pool:
-        results = list(pool.map(service.predict, batches))
-    for i, r in enumerate(results):
-        print(f"request {i}: output {np.asarray(r).shape}, "
-              f"pred {np.asarray(r).argmax(-1).tolist()}")
+    example = rs.rand(1, 28, 28, 1).astype("float32")
+    runtime = ServingRuntime(
+        model, params, state, example_input=example,
+        config=ServingConfig(buckets=(1, 8, 32), max_wait_ms=3.0,
+                             capacity=256, default_deadline_ms=5_000.0))
+
+    # -- phase 1: 64 concurrent single-image requests ----------------------
+    images = [rs.rand(1, 28, 28, 1).astype("float32") for _ in range(64)]
+    with concurrent.futures.ThreadPoolExecutor(16) as pool:
+        results = list(pool.map(runtime.predict, images))
+    preds = [int(np.asarray(r).argmax(-1)[0]) for r in results]
+    print(f"phase 1: {len(results)} concurrent b1 requests -> "
+          f"{runtime.metrics.batches} device batches, "
+          f"{runtime.compile_count()} compiled shapes, preds[:8]={preds[:8]}")
+
+    # -- phase 2: hot-swap a checkpoint while requests are in flight -------
+    params2, state2, _ = model.build(jax.random.PRNGKey(1), (1, 28, 28, 1))
+    with tempfile.TemporaryDirectory() as tmp:
+        ckpt = save_checkpoint(tmp, step=1, params=params2, model_state=state2)
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            futs = [pool.submit(runtime.predict, img) for img in images]
+            runtime.swap_checkpoint("v1", ckpt)
+            done = sum(1 for f in futs if f.result() is not None)
+    print(f"phase 2: hot-swapped to {runtime.active_version!r} under load, "
+          f"{done}/{len(images)} requests served (zero dropped)")
+
+    # -- phase 3: overload -> graceful admission rejection -----------------
+    tiny = ServingRuntime(model, params, state, example_input=example,
+                          config=ServingConfig(buckets=(1, 8), max_wait_ms=1.0,
+                                               capacity=4))
+    rejected = 0
+    futures = []
+    for img in images:
+        try:
+            futures.append(tiny.submit(img))
+        except Rejected:
+            rejected += 1
+    for f in futures:
+        f.result(timeout=30)
+    print(f"phase 3: capacity-4 queue under a 64-request burst -> "
+          f"{rejected} rejected at admission, {len(futures)} served")
+    tiny.close()
+
+    runtime.close()  # drains in-flight batches
+    snap = runtime.metrics.snapshot()
+    print(f"latency p50/p99: {snap['latency_ms']['p50']}/"
+          f"{snap['latency_ms']['p99']} ms, "
+          f"occupancy {snap['batch_occupancy']}, "
+          f"queue peak {snap['queue_depth_peak']}")
 
 
 if __name__ == "__main__":

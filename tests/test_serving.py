@@ -1,0 +1,376 @@
+"""bigdl_tpu.serving: micro-batcher, registry, runtime (ISSUE serving PR).
+
+The acceptance-criteria tests live here: 64 concurrent b1 requests must
+compile at most len(buckets)=3 distinct forward shapes (the compile-count
+probe) and every served output must be BITWISE equal to the unbatched
+jitted forward — padding to a bucket and slicing back may not perturb a
+single ulp.  Plus the scheduler edge cases: deadline expiry at coalesce
+time, queue-full rejection, hot-swap single-version consistency, drain
+with in-flight batches.
+
+Quick tier: the model is a 6->4 Linear stack, so the three bucket
+compiles are milliseconds on the CPU backend.
+"""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.serving import (
+    DeadlineExceeded,
+    MicroBatcher,
+    ModelRegistry,
+    Rejected,
+    ServingClosed,
+    ServingRuntime,
+)
+from bigdl_tpu.serving.batcher import pick_bucket
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    model = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 4),
+                          nn.LogSoftMax())
+    params, state, _ = model.build(jax.random.PRNGKey(0), (8, 6))
+    return model, params, state
+
+
+def _runtime(small_model, **kw):
+    model, params, state = small_model
+    kw.setdefault("buckets", (1, 8, 32))
+    kw.setdefault("example_input", np.zeros((1, 6), np.float32))
+    return ServingRuntime(model, params, state, **kw)
+
+
+# -- bucket selection ------------------------------------------------------
+
+
+def test_pick_bucket_smallest_fit():
+    assert pick_bucket((1, 8, 32), 1) == 1
+    assert pick_bucket((1, 8, 32), 2) == 8
+    assert pick_bucket((1, 8, 32), 8) == 8
+    assert pick_bucket((1, 8, 32), 9) == 32
+    with pytest.raises(ValueError):
+        pick_bucket((1, 8, 32), 33)
+
+
+# -- acceptance criteria: compile count + bitwise equality -----------------
+
+
+def test_64_concurrent_b1_three_shapes_bitwise_equal(small_model):
+    model, params, state = small_model
+    rs = np.random.RandomState(0)
+    xs = [rs.randn(1, 6).astype(np.float32) for _ in range(64)]
+
+    ref_fwd = jax.jit(lambda p, s, x: model.apply(p, s, x, training=False)[0])
+    refs = [np.asarray(ref_fwd(params, state, jnp.asarray(x))) for x in xs]
+
+    with _runtime(small_model, max_wait_ms=5.0) as rt:
+        with ThreadPoolExecutor(max_workers=64) as pool:
+            outs = list(pool.map(rt.predict, xs))
+        n_shapes = rt.compile_count()
+        snap = rt.metrics.snapshot()
+
+    assert n_shapes <= 3, f"compiled {n_shapes} shapes for 3 buckets"
+    for got, want in zip(outs, refs):
+        np.testing.assert_array_equal(got, want)  # bitwise, not allclose
+    assert snap["requests_completed"] == 64
+    assert snap["batches"] < 64  # coalescing actually happened
+    assert snap["latency_ms"]["p99"] > 0
+
+
+def test_bucket_padding_bitwise_equal_all_widths(small_model):
+    """Every request width in [1, 9] pads to a different occupancy of the
+    (1, 8, 32) buckets; each sliced-back output must match the unbatched
+    forward bitwise (pad rows repeat the last row — they may never bleed
+    into real rows)."""
+    model, params, state = small_model
+    ref_fwd = jax.jit(lambda p, s, x: model.apply(p, s, x, training=False)[0])
+    rs = np.random.RandomState(1)
+    with _runtime(small_model, max_wait_ms=0.5) as rt:
+        for rows in range(1, 10):
+            x = rs.randn(rows, 6).astype(np.float32)
+            got = rt.predict(x)
+            want = np.asarray(ref_fwd(params, state, jnp.asarray(x)))
+            np.testing.assert_array_equal(got, want)
+            assert got.shape == (rows, 4)
+
+
+def test_oversized_request_chunks_through_largest_bucket(small_model):
+    model, params, state = small_model
+    x = np.random.RandomState(2).randn(70, 6).astype(np.float32)  # > 2*32
+    with _runtime(small_model, max_wait_ms=0.5) as rt:
+        got = rt.predict(x)
+    want, _ = model.apply(params, state, jnp.asarray(x), training=False)
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-6, atol=1e-7)
+    assert got.shape == (70, 4)
+
+
+# -- scheduler edge cases (batcher-level, injected dispatch) ---------------
+
+
+class _GatedDispatch:
+    """Dispatch stub: blocks inside dispatch until released; resolves
+    futures with the request rows so callers can identify their batch."""
+
+    def __init__(self, gate: bool = False):
+        self.release = threading.Event()
+        self.entered = threading.Event()
+        self.gate = gate
+        self.batches = []
+
+    def __call__(self, requests, bucket):
+        self.entered.set()
+        if self.gate:
+            assert self.release.wait(10.0), "test forgot to release the gate"
+        self.batches.append((len(requests), bucket))
+        for r in requests:
+            r.future.set_result(r.rows)
+
+
+def test_deadline_expired_mid_batch_fails_only_expired():
+    """A request whose deadline passes while the PREVIOUS batch occupies
+    the device is failed with DeadlineExceeded at coalesce time; its
+    batch-mates with room to spare still dispatch."""
+    d = _GatedDispatch(gate=True)
+    b = MicroBatcher(d, buckets=(4,), max_wait_ms=1.0, capacity=16)
+    try:
+        f_blocker = b.submit("blocker", 1)  # heads batch 1, parks in dispatch
+        assert d.entered.wait(5.0)
+        f_doomed = b.submit("doomed", 1, deadline_ms=1.0)
+        f_fine = b.submit("fine", 1)  # no deadline
+        time.sleep(0.05)  # let the 1 ms deadline lapse while gated
+        d.release.set()
+        assert f_blocker.result(5.0) == 1
+        with pytest.raises(DeadlineExceeded):
+            f_doomed.result(5.0)
+        assert f_fine.result(5.0) == 1
+    finally:
+        d.release.set()
+        b.close(drain=False, timeout=5.0)
+
+
+def test_queue_full_rejects_at_admission():
+    d = _GatedDispatch(gate=True)
+    b = MicroBatcher(d, buckets=(1,), max_wait_ms=0.5, capacity=2)
+    try:
+        b.submit("a", 1)  # heads the first batch (scheduler takes it)
+        assert d.entered.wait(5.0)
+        b.submit("b", 1)
+        b.submit("c", 1)  # queue now holds 2 = capacity
+        with pytest.raises(Rejected) as exc:
+            b.submit("overflow", 1)
+        assert "queue full" in str(exc.value)
+        assert not isinstance(exc.value, (ServingClosed, DeadlineExceeded))
+    finally:
+        d.release.set()
+        b.close(drain=True, timeout=5.0)
+
+
+def test_close_drain_completes_in_flight_and_queued():
+    d = _GatedDispatch(gate=True)
+    b = MicroBatcher(d, buckets=(2,), max_wait_ms=0.5, capacity=16)
+    futures = [b.submit(i, 1) for i in range(6)]
+    assert d.entered.wait(5.0)  # first batch is on the "device"
+    closer = threading.Thread(target=b.close, kwargs={"drain": True,
+                                                      "timeout": 10.0})
+    closer.start()
+    d.release.set()
+    closer.join(10.0)
+    assert not closer.is_alive()
+    assert all(f.result(1.0) == 1 for f in futures)  # nobody dropped
+    with pytest.raises(ServingClosed):
+        b.submit("late", 1)
+
+
+def test_close_abort_fails_queued_requests():
+    d = _GatedDispatch(gate=True)
+    b = MicroBatcher(d, buckets=(1,), max_wait_ms=0.5, capacity=16)
+    f_inflight = b.submit("inflight", 1)
+    assert d.entered.wait(5.0)
+    f_queued = [b.submit(i, 1) for i in range(3)]
+    t = threading.Thread(target=b.close, kwargs={"drain": False,
+                                                 "timeout": 10.0})
+    t.start()
+    d.release.set()
+    t.join(10.0)
+    assert f_inflight.result(1.0) == 1  # in-flight batch still completes
+    for f in f_queued:
+        with pytest.raises(ServingClosed):
+            f.result(1.0)
+
+
+def test_dispatch_exception_fails_batch_keeps_serving():
+    calls = []
+
+    def dispatch(requests, bucket):
+        calls.append(len(requests))
+        if len(calls) == 1:
+            raise RuntimeError("transient device error")
+        for r in requests:
+            r.future.set_result(r.rows)
+
+    b = MicroBatcher(dispatch, buckets=(1,), max_wait_ms=0.5, capacity=16)
+    try:
+        with pytest.raises(RuntimeError, match="transient"):
+            b.submit("a", 1).result(5.0)
+        assert b.submit("b", 1).result(5.0) == 1  # scheduler survived
+    finally:
+        b.close(drain=True, timeout=5.0)
+
+
+# -- registry / hot-swap ---------------------------------------------------
+
+
+def test_registry_swap_rollback_retire():
+    reg = ModelRegistry()
+    reg.register("v0", {"w": 0})
+    reg.register("v1", {"w": 1})
+    assert reg.active_version == "v1"
+    assert reg.active().params == {"w": 1}
+    reg.activate("v0")  # rollback
+    assert reg.active().params == {"w": 0}
+    with pytest.raises(ValueError):
+        reg.retire("v0")  # refuses the active version
+    reg.retire("v1")
+    assert reg.versions() == ["v0"]
+    with pytest.raises(KeyError):
+        reg.activate("v1")
+
+
+def test_registry_warmup_runs_before_activation():
+    seen = []
+
+    def warmup(params, state):
+        # at warmup time the OLD version must still be what active() serves
+        seen.append((params["w"], reg.active_version if reg._active else None))
+
+    reg = ModelRegistry(warmup=warmup)
+    reg.register("v0", {"w": 0})
+    reg.register("v1", {"w": 1})
+    assert seen == [(0, None), (1, "v0")]
+
+
+def test_hot_swap_mid_flight_single_version_consistency(small_model):
+    """Concurrent requests racing repeated hot-swaps: every response must
+    bitwise-match the forward of EXACTLY the version its batch dispatched
+    with (recorded in future.meta) — no torn half-swapped params."""
+    model, params, state = small_model
+    params2 = jax.tree_util.tree_map(lambda a: a * 2.0, params)
+    by_version = {"v0": params, "v1": params2}
+    ref_fwd = jax.jit(lambda p, s, x: model.apply(p, s, x, training=False)[0])
+
+    x = np.random.RandomState(3).randn(1, 6).astype(np.float32)
+    refs = {v: np.asarray(ref_fwd(p, state, jnp.asarray(x)))
+            for v, p in by_version.items()}
+    assert not np.array_equal(refs["v0"], refs["v1"])  # distinguishable
+
+    with _runtime(small_model, max_wait_ms=1.0) as rt:
+        stop = threading.Event()
+
+        def swapper():
+            i = 0
+            while not stop.is_set():
+                v = ("v0", "v1")[i % 2]
+                rt.swap(v, by_version[v], state)
+                i += 1
+
+        t = threading.Thread(target=swapper, daemon=True)
+        t.start()
+        try:
+            futures = []
+            for _ in range(40):
+                futures.append(rt.submit(x))
+            results = [(f.result(30.0), f.meta["version"]) for f in futures]
+        finally:
+            stop.set()
+            t.join(5.0)
+        n_shapes = rt.compile_count()
+
+    versions_seen = {v for _, v in results}
+    for out, version in results:
+        np.testing.assert_array_equal(out, refs[version])
+    assert versions_seen <= {"v0", "v1"}
+    # same-shaped swaps warm from the jit cache: still only bucket shapes
+    assert n_shapes <= 3
+
+
+def test_swap_checkpoint_loads_and_serves(small_model, tmp_path):
+    from bigdl_tpu.utils.checkpoint import save_checkpoint
+
+    model, params, state = small_model
+    params2 = jax.tree_util.tree_map(lambda a: a + 1.0, params)
+    ckpt = save_checkpoint(str(tmp_path), step=7, params=params2,
+                           model_state=state)
+    x = np.random.RandomState(4).randn(2, 6).astype(np.float32)
+    with _runtime(small_model, max_wait_ms=0.5) as rt:
+        before = rt.predict(x)
+        rt.swap_checkpoint("ckpt7", ckpt)
+        assert rt.active_version == "ckpt7"
+        after = rt.predict(x)
+    want, _ = model.apply(params2, state, jnp.asarray(x), training=False)
+    np.testing.assert_allclose(after, np.asarray(want), rtol=1e-6, atol=1e-7)
+    assert not np.array_equal(before, after)
+
+
+# -- runtime admission / metrics ------------------------------------------
+
+
+def test_runtime_deadline_rejection_surfaces(small_model):
+    with _runtime(small_model, max_wait_ms=30.0, buckets=(32,)) as rt:
+        # bucket 32 never fills, so the request waits out max_wait; its
+        # 1 ms deadline lapses first -> DeadlineExceeded at coalesce
+        with pytest.raises(DeadlineExceeded):
+            rt.predict(np.zeros((1, 6), np.float32), deadline_ms=1.0)
+        snap = rt.metrics.snapshot()
+    assert snap["rejected_deadline"] == 1
+
+
+def test_submit_after_close_raises(small_model):
+    rt = _runtime(small_model, max_wait_ms=0.5)
+    rt.close()
+    with pytest.raises(ServingClosed):
+        rt.submit(np.zeros((1, 6), np.float32))
+    snap = rt.metrics.snapshot()
+    assert snap["rejected_shutdown"] == 1
+
+
+def test_metrics_occupancy_and_export(small_model, tmp_path):
+    from bigdl_tpu.utils import ServingSummary
+
+    summary = ServingSummary(str(tmp_path), "serving-test")
+    with _runtime(small_model, max_wait_ms=0.5, summary=summary) as rt:
+        rt.predict(np.zeros((3, 6), np.float32))  # 3 rows pad to bucket 8
+        snap = rt.export_metrics(step=0)
+    assert snap["per_bucket"]["8"] == {"batches": 1, "rows": 3,
+                                       "occupancy": 0.375}
+    assert snap["batch_occupancy"] == 0.375
+    summary.close()
+    import glob
+    import os
+
+    assert glob.glob(os.path.join(str(tmp_path), "serving-test", "*"))
+
+
+def test_prediction_service_facade_still_serves(small_model):
+    """The optim.PredictionService facade (thin shim over ServingRuntime)
+    keeps its quick-tier contract; the full concurrent/bytes suite stays
+    in the slow tier (tests/test_predictor.py)."""
+    from bigdl_tpu.optim import PredictionService
+
+    model, params, state = small_model
+    svc = PredictionService(model, params, state, concurrency=2)
+    try:
+        x = np.random.RandomState(5).randn(1, 6).astype(np.float32)
+        y = svc.predict(x)
+        want, _ = model.apply(params, state, jnp.asarray(x), training=False)
+        np.testing.assert_allclose(y, np.asarray(want), rtol=1e-6, atol=1e-7)
+    finally:
+        svc.close()

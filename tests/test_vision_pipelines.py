@@ -48,6 +48,19 @@ def test_train_batches_shapes_and_loop(shards):
         assert b.shape[0] == 64
 
 
+def test_label_offset_shifts_labels(shards):
+    from bigdl_tpu.vision.pipelines import (
+        imagenet_record_features, shard_paths)
+
+    paths = shard_paths(shards)
+    base = [f.label for f in imagenet_record_features(paths)]
+    # -1 is the knob for standard 1-based inception-style shards; on these
+    # 0-based in-repo shards it simply shifts every label down by one
+    shifted = [f.label
+               for f in imagenet_record_features(paths, label_offset=-1)]
+    assert shifted == [l - 1 for l in base]
+
+
 def test_missing_dir_raises():
     from bigdl_tpu.vision.pipelines import shard_paths
 

@@ -11,6 +11,9 @@ set -u
 BUDGET="${1:-180}"
 cd "$(dirname "$0")/.."
 
+# docs must track the code: PARITY.md claims vs shipped evidence
+python tools/parity_drift_guard.py || exit 1
+
 start=$(date +%s)
 timeout --signal=TERM "$BUDGET" python -m pytest tests/ -m "not slow" -q
 rc=$?

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""PARITY.md drift guard (wired into tools/ci_quick_tier.sh).
+
+PARITY.md's "Known remaining gaps" rots in one direction: a gap gets
+closed in code but the doc keeps claiming it's missing (this happened to
+the multi-output-metrics and partitioned-checkpoint-write gaps — both
+shipped with tests while the doc still said "unsupported").  This guard
+encodes the closed gaps as (stale-claim pattern, evidence) pairs and
+fails when:
+
+  1. a stale claim pattern reappears in PARITY.md while its evidence
+     files still exist (the doc regressed), or
+  2. an evidence file named by a CLOSED rule disappears (the doc now
+     overclaims — the feature was removed without reopening the gap).
+
+Add a rule when you close a gap; the pattern should match the OLD
+gap wording tightly enough not to trip on the new CLOSED note.
+
+  python tools/parity_drift_guard.py        # exit 0 clean, 1 on drift
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Gap wordings that must NOT appear while their closing evidence exists
+# (the doc regressed to claiming a shipped feature is missing).
+STALE_GAP_RULES = [
+    (
+        "multi-output per-tensor validation metrics",
+        r"multi-output Models support only loss-type validation metrics",
+        ["tests/test_keras_multi_metrics.py"],
+    ),
+    (
+        "DT_STRING checkpoint write",
+        r"DT_STRING[^.]*unsupported on write",
+        ["bigdl_tpu/utils/tf_checkpoint.py",
+         "tests/test_tf_variables.py"],
+    ),
+    (
+        "partitioned checkpoint write",
+        r"writing partitioned checkpoints is unsupported",
+        ["bigdl_tpu/utils/tf_checkpoint.py",
+         "tests/test_tf_variables.py"],
+    ),
+]
+
+# Shipped-capability wordings whose evidence must EXIST while the claim
+# is in the doc (the doc overclaims a feature that was removed).
+CLOSED_CLAIM_RULES = [
+    (
+        "per-output metrics CLOSED note",
+        r"per-output\s+validation metrics",
+        ["tests/test_keras_multi_metrics.py"],
+    ),
+    (
+        "partitioned/DT_STRING write CLOSED note",
+        r"partitioned checkpoints write",
+        ["bigdl_tpu/utils/tf_checkpoint.py", "tests/test_tf_variables.py"],
+    ),
+    (
+        "serving runtime behind PredictionService",
+        r"facade over the `bigdl_tpu\.serving`",
+        ["bigdl_tpu/serving/runtime.py", "docs/serving.md",
+         "tests/test_serving.py"],
+    ),
+]
+
+
+def main() -> int:
+    parity = os.path.join(REPO, "PARITY.md")
+    with open(parity, encoding="utf-8") as f:
+        text = f.read()
+
+    def line_of(match: "re.Match") -> int:
+        return text.count("\n", 0, match.start()) + 1
+
+    failures = []
+    for name, pattern, evidence in STALE_GAP_RULES:
+        missing = [p for p in evidence
+                   if not os.path.exists(os.path.join(REPO, p))]
+        stale = re.search(pattern, text)
+        if stale and not missing:
+            failures.append(
+                f"PARITY.md:{line_of(stale)} still claims '{name}' is a gap, "
+                f"but the evidence shipped: {', '.join(evidence)}")
+
+    for name, pattern, evidence in CLOSED_CLAIM_RULES:
+        missing = [p for p in evidence
+                   if not os.path.exists(os.path.join(REPO, p))]
+        claim = re.search(pattern, text)
+        if claim and missing:
+            failures.append(
+                f"PARITY.md:{line_of(claim)} claims '{name}' but its "
+                f"evidence is gone: {', '.join(missing)} "
+                "(reopen the gap or fix the paths)")
+
+    if failures:
+        for msg in failures:
+            print(f"DRIFT: {msg}", file=sys.stderr)
+        return 1
+    n = len(STALE_GAP_RULES) + len(CLOSED_CLAIM_RULES)
+    print(f"parity drift guard: {n} rules clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
