@@ -9,6 +9,10 @@ end to end:
   stage 4  full: + ImageNet-train augmentation (RandomResize ->
            RandomCropper(224) -> Flip -> ChannelNormalize) +
            MTImageFeatureToBatch assembly -> b256 batches
+  stage 5  + DeviceFeed end to end: the stage-4 pipeline behind the
+           async feed (assembly + device staging in the worker), a
+           consumer draining staged batches — reports delivered
+           throughput plus the consumer's residual stall per batch
 
 Reference analogue: dataset/image/MTLabeledBGRImgToBatch.scala over
 SeqFile ImageNet shards (dataset/DataSet.scala:482-560).
@@ -123,6 +127,35 @@ def main(argv=None):
     results["4_full_pipeline"] = {
         "img_per_s": img_s, "batch_per_s": n / dt,
         "threads": args.threads, "decoded_GB_per_s": tot / dt / 1e9}
+
+    # stage 5: DeviceFeed end to end — same pipeline, but assembly AND
+    # device staging run in the feed worker while the consumer (standing
+    # in for the step loop) only drains.  stall_ms is what a training
+    # step would still wait on input per batch; ~0 means full overlap.
+    from bigdl_tpu.dataset.feed import DeviceFeed
+
+    mt5 = MTImageFeatureToBatch(224, 224, args.batch_size,
+                                DecodeJPEGFeature(imagenet_train_chain(224)),
+                                num_threads=args.threads)
+
+    def _stage(b):
+        return tuple(jax.device_put(a) for a in b)
+
+    stalls = []
+
+    def fed():
+        with DeviceFeed(mt5(imagenet_record_features(paths)), _stage,
+                        prefetch_depth=2, name="DeviceFeed-bench") as feed:
+            for item in feed:
+                stalls.append(item.stall_s)
+                yield item
+
+    n, tot, dt = _timed(fed(), args.seconds,
+                        cost_fn=lambda it: it.batch[0].nbytes)
+    results["5_device_feed_e2e"] = {
+        "img_per_s": n * args.batch_size / dt, "batch_per_s": n / dt,
+        "prefetch_depth": 2, "staged_GB_per_s": tot / dt / 1e9,
+        "mean_stall_ms": 1e3 * float(np.mean(stalls)) if stalls else 0.0}
 
     # worker math vs the chip's synthetic-input ceiling
     chip = None

@@ -34,10 +34,18 @@ reproduced here before the fix):
   - the host-lr path device_put a fresh scalar every step (a put can
     serialize the in-flight pipeline) -> cached until the lr changes.
 
+A fifth experiment A-Bs the DeviceFeed input pipeline (ISSUE 2): the same
+loop over HOST-resident batches (so per-step assembly + H2D staging work
+exists) with the feed off (inline staging, prefetch_depth=0) vs on
+(depth 2, staging overlapped in the worker), plus the device-resident
+path where the feed's residual stall must be ~0.
+
 Run: PYTHONPATH=. JAX_PLATFORMS=cpu python benchmarks/bench_trainer_overhead.py
+     [--feed-only]
 Prints one json line per row.
 """
 
+import argparse
 import json
 import time
 from collections import deque
@@ -96,6 +104,23 @@ def _build(iters=ITERS):
         optim_method=SGD(learning_rate=0.01),
         end_trigger=Trigger.max_iteration(iters))
     return o, x, y
+
+
+class _HostDataSet(ArrayDataSet):
+    """Cycles prebuilt HOST-resident MiniBatches: unlike _RepeatDataSet,
+    every step pays batch staging (numpy -> sharded device arrays), so the
+    feed has real work to pull off the hot loop."""
+
+    def __init__(self, batches, n):
+        self.batches = list(batches)
+        self.n = n
+
+    def size(self):
+        return self.batches[0].size() * self.n
+
+    def data(self, train):
+        return iter([self.batches[i % len(self.batches)]
+                     for i in range(self.n)])
 
 
 def _inject_latency(latency_s):
@@ -183,7 +208,72 @@ def measure_loop(latency_ms=0.0, no_drain=False):
             restore()
 
 
-def main():
+def measure_feed(prefetch_depth, host_batches=True, iters=ITERS):
+    """optimize() ms/step with the input feed at `prefetch_depth`.
+
+    host_batches=True uses numpy batches (staging work exists each step);
+    False uses the device-resident batch (staging is a sharding check, so
+    the feed's residual stall must be ~0).
+    """
+    RandomGenerator.set_seed(7)
+    rs = np.random.RandomState(0)
+    if host_batches:
+        batches = [MiniBatch(rs.randn(BATCH, HW, HW, CIN).astype(np.float32),
+                             (np.arange(BATCH) % NCLS).astype(np.int32))
+                   for _ in range(8)]
+        ds = _HostDataSet(batches, iters)
+    else:
+        x = rs.randn(BATCH, HW, HW, CIN).astype(np.float32)
+        y = (np.arange(BATCH) % NCLS).astype(np.int32)
+        ds = _RepeatDataSet(MiniBatch(jnp.asarray(x), jnp.asarray(y)), iters)
+    o = optim_mod.DistriOptimizer(
+        _model(), ds, nn.ClassNLLCriterion(),
+        optim_method=SGD(learning_rate=0.01),
+        end_trigger=Trigger.max_iteration(iters))
+    o.set_feed(prefetch_depth)
+    o.optimize()  # warm: compiles the step + telemetry-ring write
+    o.end_when = Trigger.max_iteration(2 * iters)
+    t0 = time.perf_counter()
+    o.optimize()
+    per = (time.perf_counter() - t0) / iters
+    return per, o.metrics.get("feed stall")
+
+
+def feed_ab(iters=ITERS):
+    """Feed off/on A-B (ISSUE 2 acceptance): same work, staging inline vs
+    overlapped.  Returns the two host-batch ms/step numbers."""
+    rows = {}
+    for depth in (0, 2):
+        per, stall = min((measure_feed(depth, iters=iters)
+                          for _ in range(3)), key=lambda r: r[0])
+        rows[depth] = per
+        print(json.dumps({
+            "path": "feed_ab_host_batches", "prefetch_depth": depth,
+            "ms_per_step": round(per * 1e3, 2),
+            "feed_stall_ms_per_step": round(stall * 1e3, 3)}))
+    # device-resident batches: staging is a no-op put, stall must vanish
+    per, stall = measure_feed(2, host_batches=False, iters=iters)
+    print(json.dumps({
+        "path": "feed_device_resident", "prefetch_depth": 2,
+        "ms_per_step": round(per * 1e3, 2),
+        "feed_stall_ms_per_step": round(stall * 1e3, 3)}))
+    assert stall < 2e-3, f"device-resident feed stall {stall*1e3:.2f} ms"
+    print(json.dumps({
+        "metric": "feed_overlap_ok",
+        "value": bool(rows[2] <= rows[0] * 1.10),
+        "speedup_on_vs_off": round(rows[0] / rows[2], 3)}))
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--feed-only", action="store_true",
+                    help="run just the DeviceFeed A-B (quick capture mode)")
+    ap.add_argument("--iters", type=int, default=ITERS)
+    args = ap.parse_args(argv)
+    if args.feed_only:
+        feed_ab(args.iters)
+        return
     lat, rere = measure_readback_latency()
     print(json.dumps({"metric": "env_readback_latency_ms",
                       "fresh_result": round(lat * 1e3, 2),
@@ -224,6 +314,8 @@ def main():
     print(json.dumps({"metric": "loop_overhead_explained", "value": True,
                       "host_python_ms": round(host_cost * 1e3, 3),
                       "readback_amortized_ms": round(lat / flush * 1e3, 2)}))
+
+    feed_ab(args.iters)
 
 
 if __name__ == "__main__":

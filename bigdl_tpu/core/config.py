@@ -66,6 +66,12 @@ class EngineConfig:
     # trailing up to `depth` steps.  Deterministic triggers only; loss-
     # reading triggers (min_loss/max_score) force synchronous mode.
     async_depth: int = 32
+    # Input-feed prefetch depth: batches the DeviceFeed worker stages on
+    # device ahead of the step loop (host collate + H2D transfer overlap
+    # in-flight compute).  Host memory bound: at most `feed_depth + 1`
+    # assembled batches exist at once.  0 = synchronous staging (the
+    # pre-feed loop).  See docs/training.md "Input feed & overlap".
+    feed_depth: int = 2
 
     def parse_mesh(self) -> Optional[dict]:
         if not self.mesh_spec:
@@ -96,6 +102,7 @@ class EngineConfig:
             seed=_env_int("SEED", 1),
             mesh_spec=os.environ.get(_PREFIX + "MESH"),
             async_depth=_env_int("ASYNC_DEPTH", 32),
+            feed_depth=_env_int("FEED_DEPTH", 2),
         )
         if _PREFIX + "COORDINATOR_ADDRESS" in os.environ:
             cfg.coordinator_address = os.environ[_PREFIX + "COORDINATOR_ADDRESS"]

@@ -2,6 +2,7 @@ from bigdl_tpu.dataset.sample import Sample, SparseBag, SparseFeature
 from bigdl_tpu.dataset.minibatch import MiniBatch, SparseMiniBatch
 from bigdl_tpu.dataset.transformer import Transformer, SampleToMiniBatch
 from bigdl_tpu.dataset.dataset import DataSet, LocalDataSet, ArrayDataSet
+from bigdl_tpu.dataset.feed import DeviceFeed, FeedItem, InlineFeed, make_feed
 from bigdl_tpu.dataset.datamining import (RowTransformer, RowTransformSchema,
                                           TableToSample)
 from bigdl_tpu.dataset.tfrecord import VarLenFeature

@@ -1,0 +1,233 @@
+"""DeviceFeed — async host->device input staging.
+
+Reference: dataset/image/MTLabeledBGRImgToBatch.scala — the reference hid
+image decode behind the training loop with a multi-threaded batch
+assembler.  Here the analogous un-overlapped stage is batch ASSEMBLY
+(dataset iteration -> transformer chain -> MiniBatch stack) plus the
+host->device transfer of the staged arrays: the step loop paid both
+serially before every dispatch (optimizer.py put + device_put per step).
+
+DeviceFeed runs assembly + staging in ONE background worker thread over a
+bounded queue (double/triple buffering via `prefetch_depth`), so host
+collate and H2D transfer overlap in-flight device compute:
+
+  * batch ORDER is exactly the source iterator's (one worker, FIFO
+    queue) — consumers see the same sequence as iterating inline, so
+    losses are bitwise-equal feed on vs off;
+  * the queue is BOUNDED: a slow consumer backpressures the worker
+    instead of ballooning host/device memory past
+    `prefetch_depth + 1` staged batches (one in the worker's hands);
+  * staging uses the CALLER's put function (the trainer passes its
+    sharded `_put_batch`), so arrays land on the mesh with the step's
+    `data`-axis NamedSharding before the step wants them;
+  * shutdown is deterministic: `close()` (or the `with` block / iterator
+    exhaustion) stops the worker, unblocks any pending bounded-queue
+    put, and joins the thread — an early `end_when` break or an
+    exception in the consumer leaks nothing;
+  * a worker-side exception (bad record, OOM in collate) propagates to
+    the consumer's next `__next__` instead of hanging the loop.
+
+Observability counters ride on the feed object: per-item consumer stall
+time (how long the step loop waited on the queue), staged-buffer
+occupancy at hand-off, and worker assembly throughput — the trainer
+surfaces them through Metrics/TrainSummary as FeedStall/FeedOccupancy.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator, NamedTuple, Optional
+
+__all__ = ["DeviceFeed", "InlineFeed", "FeedItem", "make_feed"]
+
+_DONE = object()
+
+
+class FeedItem(NamedTuple):
+    """One staged batch as handed to the consumer."""
+
+    batch: Any        # the original MiniBatch (shapes, size(), init)
+    payload: Any      # whatever put_fn returned (device-staged arrays)
+    stall_s: float    # how long the consumer blocked waiting for this item
+    occupancy: int    # staged batches ready in the buffer at hand-off
+
+
+class DeviceFeed:
+    """Bounded-depth async feed: assembly + H2D staging off the hot loop.
+
+    Parameters
+    ----------
+    batches : iterable of batches (typically MiniBatch)
+    put_fn : batch -> payload, run IN THE WORKER (device_put lives here)
+    prefetch_depth : staged batches the worker may run ahead (>= 1)
+    """
+
+    def __init__(self, batches: Iterable[Any], put_fn: Callable[[Any], Any],
+                 prefetch_depth: int = 2, name: str = "DeviceFeed"):
+        if prefetch_depth < 1:
+            raise ValueError(f"prefetch_depth must be >= 1, got {prefetch_depth}")
+        self.prefetch_depth = int(prefetch_depth)
+        self._put = put_fn
+        self._it = iter(batches)
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_depth)
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        # worker-side counters (read by the consumer after hand-off; a
+        # torn read would only skew a metric by one batch)
+        self._staged = 0
+        self._staged_records = 0
+        self._work_s = 0.0
+        # daemon: a crashed consumer must not wedge interpreter exit; the
+        # conftest leak guard still flags any feed thread alive post-test
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    # worker
+    # ------------------------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    batch = next(self._it)
+                except StopIteration:
+                    break
+                payload = self._put(batch)
+                self._work_s += time.perf_counter() - t0
+                self._staged += 1
+                size = getattr(batch, "size", None)
+                if callable(size):
+                    try:
+                        self._staged_records += int(size())
+                    except Exception:
+                        pass
+                if not self._offer((batch, payload)):
+                    return  # stopped while blocked on a full queue
+        except BaseException as e:  # propagate to the consumer, never hang
+            self._error = e
+        finally:
+            self._offer(_DONE)
+
+    def _offer(self, item: Any) -> bool:
+        """Bounded put that a close() can always unblock."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # ------------------------------------------------------------------
+    # consumer
+    # ------------------------------------------------------------------
+
+    def __iter__(self) -> Iterator[FeedItem]:
+        return self
+
+    def __next__(self) -> FeedItem:
+        if self._closed:
+            raise StopIteration
+        t0 = time.perf_counter()
+        item = self._q.get()
+        stall = time.perf_counter() - t0
+        if item is _DONE:
+            self.close()
+            if self._error is not None:
+                raise RuntimeError(
+                    f"{self._thread.name} worker failed while assembling/"
+                    f"staging a batch") from self._error
+            raise StopIteration
+        batch, payload = item
+        return FeedItem(batch, payload, stall, self._q.qsize() + 1)
+
+    def __enter__(self) -> "DeviceFeed":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        """Idempotent shutdown: stop, unblock, join, surface late errors."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        # drain so a worker blocked mid-put can observe the stop flag
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
+        if self._thread.is_alive():  # pragma: no cover - defensive
+            raise RuntimeError(f"{self._thread.name} worker did not stop")
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+
+    def assembly_records_per_s(self) -> float:
+        """Worker-side throughput of assembly + staging (records/s)."""
+        return self._staged_records / self._work_s if self._work_s > 0 else 0.0
+
+    @property
+    def staged_batches(self) -> int:
+        return self._staged
+
+
+class InlineFeed:
+    """Feed-off fallback: same FeedItem interface, zero threads — assembly
+    and staging run inline in the consumer exactly as the pre-feed loop
+    did (the bitwise-parity baseline and the `prefetch_depth=0` path)."""
+
+    prefetch_depth = 0
+
+    def __init__(self, batches: Iterable[Any], put_fn: Callable[[Any], Any]):
+        self._put = put_fn
+        self._it = iter(batches)
+        self._staged_records = 0
+        self._work_s = 0.0
+
+    def __iter__(self) -> Iterator[FeedItem]:
+        return self
+
+    def __next__(self) -> FeedItem:
+        t0 = time.perf_counter()
+        batch = next(self._it)
+        payload = self._put(batch)
+        self._work_s += time.perf_counter() - t0
+        size = getattr(batch, "size", None)
+        if callable(size):
+            try:
+                self._staged_records += int(size())
+            except Exception:
+                pass
+        # inline: the "stall" IS the assembly+staging time the loop paid
+        return FeedItem(batch, payload, time.perf_counter() - t0, 0)
+
+    def __enter__(self) -> "InlineFeed":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        pass
+
+    def assembly_records_per_s(self) -> float:
+        return self._staged_records / self._work_s if self._work_s > 0 else 0.0
+
+
+def make_feed(batches: Iterable[Any], put_fn: Callable[[Any], Any],
+              prefetch_depth: int, name: str = "DeviceFeed"):
+    """`prefetch_depth >= 1` -> async DeviceFeed; `<= 0` -> InlineFeed."""
+    if prefetch_depth and prefetch_depth > 0:
+        return DeviceFeed(batches, put_fn, prefetch_depth, name=name)
+    return InlineFeed(batches, put_fn)

@@ -41,6 +41,31 @@ class MiniBatch:
 
         return MiniBatch(sl(self.input), sl(self.target) if self.target is not None else None)
 
+    def pad_to(self, n: int) -> "MiniBatch":
+        """Pad the batch (leading) dim to `n` rows by repeating the last
+        row, keeping XLA batch shapes static across the epoch tail (the
+        reference pads rather than recompiling; the trailing partial
+        batch otherwise forces a fresh train-step compile every epoch).
+        The result's `pad_rows` records how many trailing rows are
+        repeats — they DO enter loss/metric means unless the consumer
+        masks them, which is why `SampleToMiniBatch(drop_remainder=True)`
+        stays the exactness default."""
+        k = self.size()
+        if k >= n:
+            return self
+
+        def pad(x):
+            if isinstance(x, (tuple, list)):
+                return type(x)(pad(v) for v in x)
+            x = np.asarray(x)
+            return np.concatenate([x, np.repeat(x[-1:], n - k, axis=0)],
+                                  axis=0)
+
+        out = type(self)(pad(self.input),
+                         pad(self.target) if self.target is not None else None)
+        out.pad_rows = n - k
+        return out
+
     @staticmethod
     def from_samples(samples: Sequence[Sample],
                      feature_padding: Optional[float] = None,

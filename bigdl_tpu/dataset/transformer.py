@@ -60,14 +60,22 @@ class SampleToMiniBatch(Transformer):
     reference: dataset/MiniBatch.scala SampleToMiniBatch (:579+).
 
     `drop_remainder=True` keeps batch shapes static for XLA (the trailing
-    partial batch would force a recompile; the reference pads instead)."""
+    partial batch would force a recompile; the reference pads instead).
+    `pad_to_full=True` is the reference's pad alternative: the trailing
+    partial batch is kept and padded to `batch_size` by repeating its
+    last sample (`MiniBatch.pad_to`), so every record trains each epoch
+    under ONE compiled step shape — at the cost of the repeated rows
+    entering the tail batch's loss mean (the padded batch carries
+    `pad_rows` for consumers that want to mask)."""
 
     def __init__(self, batch_size: int, feature_padding: Optional[float] = None,
-                 label_padding: Optional[float] = None, drop_remainder: bool = True):
+                 label_padding: Optional[float] = None, drop_remainder: bool = True,
+                 pad_to_full: bool = False):
         self.batch_size = batch_size
         self.feature_padding = feature_padding
         self.label_padding = label_padding
         self.drop_remainder = drop_remainder
+        self.pad_to_full = pad_to_full
 
     def __call__(self, it: Iterator[Sample]) -> Iterator[MiniBatch]:
         buf: List[Sample] = []
@@ -76,8 +84,9 @@ class SampleToMiniBatch(Transformer):
             if len(buf) == self.batch_size:
                 yield self._batch(buf)
                 buf = []
-        if buf and not self.drop_remainder:
-            yield self._batch(buf)
+        if buf and (self.pad_to_full or not self.drop_remainder):
+            tail = self._batch(buf)
+            yield tail.pad_to(self.batch_size) if self.pad_to_full else tail
 
     def _batch(self, buf: List[Sample]) -> MiniBatch:
         # samples carrying SparseFeatures batch via SparseMiniBatch, like the

@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from bigdl_tpu.core.engine import AXIS_DATA, Engine
 from bigdl_tpu.core.random import RandomGenerator
 from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.dataset.feed import make_feed
 from bigdl_tpu.dataset.minibatch import MiniBatch
 from bigdl_tpu.nn.criterion import Criterion
 from bigdl_tpu.nn.module import Module
@@ -167,6 +168,8 @@ class Optimizer:
         # summaries
         self.train_summary: Optional[TrainSummary] = None
         self.val_summary: Optional[ValidationSummary] = None
+        # input feed: None = Engine.config().feed_depth; 0 = synchronous
+        self.feed_depth: Optional[int] = None
         # gradient processing
         self.processors: List[ParameterProcessor] = []
         # state — adopt weights already on the model so repeated fit()s
@@ -224,6 +227,18 @@ class Optimizer:
 
     def disable_gradient_clipping(self) -> "Optimizer":
         self.processors = []
+        return self
+
+    def set_feed(self, prefetch_depth: int) -> "Optimizer":
+        """Input-feed prefetch depth: how many batches the DeviceFeed
+        worker assembles and stages on the mesh AHEAD of the step loop,
+        overlapping host collate + H2D transfer with in-flight device
+        compute (dataset/feed.py).  0 forces synchronous staging (the
+        bitwise-identical baseline); default comes from
+        `BIGDL_TPU_FEED_DEPTH` (2).  Batch order, RNG folding and losses
+        are identical either way — the feed only moves WHERE the staging
+        work runs."""
+        self.feed_depth = int(prefetch_depth)
         return self
 
     def set_profile(self, enabled: bool = True) -> "Optimizer":
@@ -533,6 +548,19 @@ class Optimizer:
             return max(0, Engine.config().async_depth)
         return 0
 
+    def _feed_depth(self) -> int:
+        if self.feed_depth is not None:
+            return max(0, self.feed_depth)
+        return max(0, Engine.config().feed_depth)
+
+    def _stage_batch(self, batch: MiniBatch):
+        """Assembly hand-off -> device staging, run in the feed worker:
+        the arrays land under the step's data-axis sharding before the
+        loop asks for them."""
+        tgt = batch.get_target()
+        return (self._put_batch(batch.get_input()),
+                None if tgt is None else self._put_batch(tgt))
+
     def _optimize_impl(self):
         state = self._driver_state
         step_fn = None
@@ -548,7 +576,10 @@ class Optimizer:
             self._pending_restore = None
 
         depth = self._async_depth()
-        pending = deque()  # (epoch, neval, bs, slot, ring_snapshot)
+        feed_depth = self._feed_depth()
+        feed_ref = [None]  # current epoch's feed, for drain-side telemetry
+        # (epoch, neval, bs, slot, ring_snapshot, feed_stall_s, feed_occ)
+        pending = deque()
         drain_clock = [time.perf_counter(), 1.0]  # [last drain t, last dt]
         lr_cache = [None, None]  # [host float, device scalar]
         lr_zero = jnp.zeros((), jnp.float32)
@@ -590,13 +621,15 @@ class Optimizer:
             per_step = dt_total / len(burst) if dt_total > 1e-7 \
                 else drain_clock[1]
             drain_clock[0], drain_clock[1] = now, per_step
-            for ep, it, bs, slot, _ in burst:
+            for ep, it, bs, slot, _, stall_s, occ in burst:
                 loss_f = float(packed[slot, 0])
                 lr_f = float(packed[slot, 1])
                 state["loss"] = loss_f
                 throughput = bs / per_step
                 self.metrics.add("computing time", per_step)
                 self.metrics.set("throughput", throughput)
+                self.metrics.add("feed stall", stall_s)
+                self.metrics.set("feed occupancy", occ)
                 # driver log (reference: DistriOptimizer.scala:402-407)
                 logger.info(
                     "Epoch %d iteration %d: loss %.6f, throughput %.1f "
@@ -609,62 +642,90 @@ class Optimizer:
                         s.add_scalar("Throughput", throughput, it)
                     if s.should_log("LearningRate", it):
                         s.add_scalar("LearningRate", lr_f, it)
+                    if s.should_log("FeedStallMs", it):
+                        s.add_scalar("FeedStallMs", stall_s * 1e3, it)
+                    if s.should_log("FeedOccupancy", it):
+                        s.add_scalar("FeedOccupancy", occ, it)
+            feed = feed_ref[0]
+            if feed is not None and feed.prefetch_depth > 0:
+                # one aggregate feed line per drain burst (Loss/Throughput
+                # stay on their own per-iteration lines above)
+                asm = feed.assembly_records_per_s()
+                self.metrics.set("feed assembly throughput", asm)
+                logger.info(
+                    "Feed: stall %.2f ms/step, occupancy %.1f/%d, "
+                    "assembly %.0f records/s",
+                    1e3 * sum(e[5] for e in burst) / len(burst),
+                    sum(e[6] for e in burst) / len(burst),
+                    feed.prefetch_depth, asm)
 
         while not self._agreed_trigger(self.end_when, state):
             state["epoch_finished"] = False
             epoch_start = time.time()
             record_count_epoch = 0
             completed_epoch = True
-            for batch in self.dataset.data(train=True):
-                if self._agreed_trigger(self.end_when, state):
-                    completed_epoch = False
-                    break
-                if self.params is None or step_fn is None:
-                    self._init_model(batch)
-                    step_fn = self._build_step()
-                bs = batch.size()
-                x = self._put_batch(batch.get_input())
-                y = self._put_batch(batch.get_target())
-                rng = _fold_in(root_key, state["neval"])
-                if self._host_lr():
-                    # schedules hold the lr constant for stretches of
-                    # steps; reuse the device scalar instead of a fresh
-                    # host->device put per step (a put can serialize the
-                    # in-flight step pipeline)
-                    lr_f = float(self._current_lr())
-                    if lr_cache[0] != lr_f:
-                        lr_cache[0] = lr_f
-                        lr_cache[1] = jnp.asarray(lr_f, jnp.float32)
-                    lr = lr_cache[1]
-                else:
-                    lr = lr_zero  # unused; device schedule
-                (self.params, self.model_state, self.opt_state, loss,
-                 lr_used) = step_fn(
-                    self.params, self.model_state, self.opt_state, x, y, rng,
-                    lr)
-                state["neval"] += 1
-                slot = (state["neval"] - 1) % ring_cap
-                ring = _ring_write(ring, slot, loss, lr_used)
-                pending.append((state["epoch"] + 1, state["neval"], bs,
-                                slot, ring))
-                drain(depth)
-                if getattr(self, "_profile", False) \
-                        and not getattr(self, "_profiled", False):
-                    self._profiled = True
-                    self._run_profile(x)
-                record_count_epoch += bs
-                t_cb = time.perf_counter()
-                self._maybe_validate(state)
-                self._maybe_checkpoint(state)
-                dt_cb = time.perf_counter() - t_cb
-                if dt_cb > 1e-3:
-                    # exclude validation/checkpoint time from the next
-                    # drain's per-step throughput attribution; clamp to
-                    # 'now' — callbacks overlap in-flight device compute,
-                    # and an unclamped advance can pass the next drain's
-                    # timestamp, making dt_total<=0 there
-                    drain_clock[0] = min(time.perf_counter(),
-                                         drain_clock[0] + dt_cb)
+            # batch assembly (iteration -> transformer chain -> stack) and
+            # the H2D put run in the feed worker, `feed_depth` batches
+            # ahead of the dispatch head; the bounded queue backpressures
+            # instead of accumulating host memory.  close() in the finally
+            # makes an end_when break or a raising step leak no thread.
+            feed = make_feed(self.dataset.data(train=True),
+                             self._stage_batch, feed_depth,
+                             name="DeviceFeed-train")
+            feed_ref[0] = feed
+            try:
+                for item in feed:
+                    if self._agreed_trigger(self.end_when, state):
+                        completed_epoch = False
+                        break
+                    batch = item.batch
+                    if self.params is None or step_fn is None:
+                        self._init_model(batch)
+                        step_fn = self._build_step()
+                    bs = batch.size()
+                    x, y = item.payload
+                    rng = _fold_in(root_key, state["neval"])
+                    if self._host_lr():
+                        # schedules hold the lr constant for stretches of
+                        # steps; reuse the device scalar instead of a fresh
+                        # host->device put per step (a put can serialize the
+                        # in-flight step pipeline)
+                        lr_f = float(self._current_lr())
+                        if lr_cache[0] != lr_f:
+                            lr_cache[0] = lr_f
+                            lr_cache[1] = jnp.asarray(lr_f, jnp.float32)
+                        lr = lr_cache[1]
+                    else:
+                        lr = lr_zero  # unused; device schedule
+                    (self.params, self.model_state, self.opt_state, loss,
+                     lr_used) = step_fn(
+                        self.params, self.model_state, self.opt_state, x, y,
+                        rng, lr)
+                    state["neval"] += 1
+                    slot = (state["neval"] - 1) % ring_cap
+                    ring = _ring_write(ring, slot, loss, lr_used)
+                    pending.append((state["epoch"] + 1, state["neval"], bs,
+                                    slot, ring, item.stall_s, item.occupancy))
+                    drain(depth)
+                    if getattr(self, "_profile", False) \
+                            and not getattr(self, "_profiled", False):
+                        self._profiled = True
+                        self._run_profile(x)
+                    record_count_epoch += bs
+                    t_cb = time.perf_counter()
+                    self._maybe_validate(state)
+                    self._maybe_checkpoint(state)
+                    dt_cb = time.perf_counter() - t_cb
+                    if dt_cb > 1e-3:
+                        # exclude validation/checkpoint time from the next
+                        # drain's per-step throughput attribution; clamp to
+                        # 'now' — callbacks overlap in-flight device compute,
+                        # and an unclamped advance can pass the next drain's
+                        # timestamp, making dt_total<=0 there
+                        drain_clock[0] = min(time.perf_counter(),
+                                             drain_clock[0] + dt_cb)
+            finally:
+                feed.close()
             # epoch boundary: under async depth the backlog can ride
             # across epochs (deterministic triggers never read
             # state['loss']); the synchronous path (depth=0) still
@@ -773,14 +834,31 @@ class Optimizer:
                 or any(a is not b for a, b in zip(cached_key, key)):
             self._compiled_eval = self._build_eval_step()
             self._compiled_eval_key = key
-        totals = [ValidationResult(0.0, 0, m.name) for m in self.val_methods]
-        for batch in self.val_dataset.data(train=False):
-            x = self._put_batch(batch.get_input())
-            y = self._put_batch(batch.get_target())
-            outs = self._compiled_eval(self.params, self.model_state, x, y)
-            for i, (v, c) in enumerate(outs):
-                totals[i] = totals[i] + ValidationResult(float(v), int(c), totals[i].name)
-        return totals
+        # Numerators/counts accumulate ON DEVICE across batches (eager adds
+        # dispatch async, no host sync); ONE packed transfer at the end
+        # converts every method's totals.  The old per-batch float(v)/
+        # int(c) pattern host-synced O(N) times — each sync a full queue
+        # wait + round trip (~100 ms through the remote tunnel).  Batch
+        # staging runs through the same DeviceFeed as training.
+        totals_v = totals_c = None
+        with make_feed(self.val_dataset.data(train=False), self._stage_batch,
+                       self._feed_depth(), name="DeviceFeed-eval") as feed:
+            for item in feed:
+                x, y = item.payload
+                outs = self._compiled_eval(self.params, self.model_state, x, y)
+                if totals_v is None:
+                    totals_v = [v for v, _ in outs]
+                    totals_c = [c for _, c in outs]
+                else:
+                    totals_v = [tv + v for tv, (v, _) in zip(totals_v, outs)]
+                    totals_c = [tc + c for tc, (_, c) in zip(totals_c, outs)]
+        if totals_v is None:
+            return [ValidationResult(0.0, 0, m.name) for m in self.val_methods]
+        # the single sanctioned device->host transfer of the whole eval
+        vals = np.asarray(jnp.stack(totals_v), np.float64)
+        cnts = np.asarray(jnp.stack(totals_c))
+        return [ValidationResult(float(v), int(c), m.name)
+                for v, c, m in zip(vals, cnts, self.val_methods)]
 
     def _maybe_checkpoint(self, state):
         if self.ckpt_path is None or self.ckpt_trigger is None:

@@ -30,6 +30,7 @@ import numpy as np
 
 from bigdl_tpu.core.engine import AXIS_DATA
 from bigdl_tpu.core.table import Table
+from bigdl_tpu.dataset.feed import make_feed
 from bigdl_tpu.dataset.minibatch import MiniBatch
 from bigdl_tpu.dataset.sample import Sample
 from bigdl_tpu.nn.module import Module
@@ -115,12 +116,16 @@ class Predictor:
     """
 
     def __init__(self, model: Module, params: Any, state: Any,
-                 mesh=None, batch_size: int = 32):
+                 mesh=None, batch_size: int = 32,
+                 prefetch_depth: Optional[int] = None):
         self.model = model
         self.params = params
         self.state = state
         self.mesh = mesh
         self.batch_size = int(batch_size)
+        # batches this many stage-ahead H2D puts behind a DeviceFeed
+        # worker (None = BIGDL_TPU_FEED_DEPTH, 0 = synchronous)
+        self.prefetch_depth = prefetch_depth
         if mesh is not None:
             sharding = NamedSharding(mesh, P())
             self.params = jax.device_put(params, sharding)
@@ -150,16 +155,30 @@ class Predictor:
         bs = batch_size or self.batch_size
         outs: List[Any] = []
         multi = False
-        for batch in _as_batches(data, bs):
+
+        def stage(batch):
+            # pad-to-compiled-shape + H2D put, in the feed worker: the
+            # next batch stages while the device runs the current forward
             x = batch.get_input()
             n = _batch_rows(x)
             xp = _pad_batch(x, bs) if n < bs else x
-            y = self._fwd(self.params, self.state, self._put(xp))
-            if isinstance(y, (Table, list, tuple)):
-                multi = True
-                outs.append([np.asarray(h)[:n] for h in y])
-            else:
-                outs.append(np.asarray(y)[:n])
+            return n, self._put(xp)
+
+        depth = self.prefetch_depth
+        if depth is None:
+            from bigdl_tpu.core.engine import Engine
+
+            depth = Engine.config().feed_depth
+        with make_feed(_as_batches(data, bs), stage, depth,
+                       name="DeviceFeed-predict") as feed:
+            for item in feed:
+                n, xd = item.payload
+                y = self._fwd(self.params, self.state, xd)
+                if isinstance(y, (Table, list, tuple)):
+                    multi = True
+                    outs.append([np.asarray(h)[:n] for h in y])
+                else:
+                    outs.append(np.asarray(y)[:n])
         if multi:
             return [np.concatenate([o[i] for o in outs], axis=0)
                     for i in range(len(outs[0]))]

@@ -41,6 +41,35 @@ def rng():
     return jax.random.PRNGKey(0)
 
 
+@pytest.fixture(autouse=True)
+def _thread_leak_guard():
+    """No worker thread may survive a test: a DeviceFeed (or any new
+    non-daemon thread) still alive after the test body means a close()
+    path is broken — the class of leak that deadlocks interpreter exit or
+    poisons the next test's timing.  Pre-existing threads (pytest's own,
+    library pools started at import) are exempt via the snapshot."""
+    import threading
+    import time
+
+    before = set(threading.enumerate())
+
+    def offenders():
+        return [t for t in threading.enumerate()
+                if t not in before and t.is_alive()
+                and (not t.daemon or t.name.startswith("DeviceFeed"))]
+
+    yield
+    # grace for threads mid-shutdown (close() joins, but a worker that
+    # observed the stop flag may need a scheduler tick to finish dying)
+    deadline = time.time() + 2.0
+    while offenders() and time.time() < deadline:
+        time.sleep(0.01)
+    leaked = offenders()
+    assert not leaked, (
+        f"worker threads leaked past the test: "
+        f"{[(t.name, t.daemon) for t in leaked]}")
+
+
 def pytest_configure(config):
     # two-tier test strategy (the reference tag-splits integration tests,
     # spark/dl/pom.xml:327-341): the quick tier is `pytest -m "not slow"`
