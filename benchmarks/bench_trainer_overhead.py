@@ -40,8 +40,13 @@ exists) with the feed off (inline staging, prefetch_depth=0) vs on
 (depth 2, staging overlapped in the worker), plus the device-resident
 path where the feed's residual stall must be ~0.
 
+A sixth experiment A-Bs checkpoint saving (ISSUE 3): trigger-driven saves
+with `async_save=False` (the loop pays serialize+fsync+rename inline) vs
+the AsyncCheckpointer default (the loop pays only the on-device snapshot
+dispatch; IO overlaps in the bounded writer thread).
+
 Run: PYTHONPATH=. JAX_PLATFORMS=cpu python benchmarks/bench_trainer_overhead.py
-     [--feed-only]
+     [--feed-only | --ckpt]
 Prints one json line per row.
 """
 
@@ -265,14 +270,67 @@ def feed_ab(iters=ITERS):
     return rows
 
 
+def measure_ckpt(async_save, every=5, iters=ITERS):
+    """optimize() with trigger-driven checkpoints in sync vs async mode.
+
+    Returns (ms_per_step, stall_s_per_save, n_saves): `checkpoint stall`
+    is what the step loop PAID at each trigger — the full
+    serialize+fsync+rename for sync, only the on-device snapshot dispatch
+    (+ any writer backpressure) for async.
+    """
+    import tempfile
+
+    from bigdl_tpu.resilience import committed_steps
+
+    with tempfile.TemporaryDirectory() as tmp:
+        o, _, _ = _build(iters)
+        o.optimize()  # warm: compiles the step + telemetry-ring write
+        o.set_checkpoint(tmp, Trigger.several_iteration(every),
+                         async_save=async_save, keep_last=3)
+        o.end_when = Trigger.max_iteration(2 * iters)
+        t0 = time.perf_counter()
+        o.optimize()
+        per = (time.perf_counter() - t0) / iters
+        n_saves = len(committed_steps(tmp))
+    return per, o.metrics.get("checkpoint stall"), n_saves
+
+
+def ckpt_ab(iters=ITERS):
+    """Sync/async checkpoint A-B (ISSUE 3 acceptance): same saves, the
+    write either stalls the loop or overlaps it in the writer thread."""
+    rows = {}
+    for mode in ("sync", "async"):
+        per, stall, n = min((measure_ckpt(mode == "async", iters=iters)
+                             for _ in range(3)), key=lambda r: r[0])
+        rows[mode] = (per, stall)
+        print(json.dumps({
+            "path": "ckpt_ab", "mode": mode, "n_saves": n,
+            "ms_per_step": round(per * 1e3, 2),
+            "ckpt_stall_ms_per_save": round(stall * 1e3, 3)}))
+    sync_stall, async_stall = rows["sync"][1], rows["async"][1]
+    assert async_stall < sync_stall, (
+        f"async save stall {async_stall*1e3:.2f} ms/save not below sync "
+        f"{sync_stall*1e3:.2f} ms/save")
+    print(json.dumps({
+        "metric": "ckpt_async_overlap_ok", "value": True,
+        "stall_ratio_sync_over_async":
+            round(sync_stall / max(async_stall, 1e-9), 1)}))
+    return rows
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--feed-only", action="store_true",
                     help="run just the DeviceFeed A-B (quick capture mode)")
+    ap.add_argument("--ckpt", action="store_true",
+                    help="run just the sync/async checkpoint A-B")
     ap.add_argument("--iters", type=int, default=ITERS)
     args = ap.parse_args(argv)
     if args.feed_only:
         feed_ab(args.iters)
+        return
+    if args.ckpt:
+        ckpt_ab(args.iters)
         return
     lat, rere = measure_readback_latency()
     print(json.dumps({"metric": "env_readback_latency_ms",

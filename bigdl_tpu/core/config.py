@@ -28,6 +28,10 @@ def _env_bool(name: str, default: bool) -> bool:
     return _env(name, str(default)).lower() in ("1", "true", "yes", "on")
 
 
+def _env_float(name: str, default: float) -> float:
+    return float(_env(name, str(default)))
+
+
 @dataclasses.dataclass
 class EngineConfig:
     """Runtime knobs, analogous to the `bigdl.*` property namespace.
@@ -44,10 +48,24 @@ class EngineConfig:
     # fp16 wire compression, parameters/FP16CompressedTensor.scala — on TPU
     # bf16 is native and the compression layer disappears into dtype choice).
     compute_dtype: str = "float32"
-    # Failure-retry budget for the training loop
-    # (reference: optim/DistriOptimizer.scala:855-935).
+    # Failure-restart budget for the training loop: up to
+    # `failure_retry_times` restarts from the latest committed checkpoint,
+    # with exponential backoff `backoff_base_s * 2^attempt` capped at
+    # `failure_retry_interval_s` (reference: the unbounded retry of
+    # optim/DistriOptimizer.scala:855-935, now bounded — see
+    # bigdl_tpu/resilience).
     failure_retry_times: int = 5
     failure_retry_interval_s: int = 120
+    backoff_base_s: float = 2.0
+    # Checkpoint saves default to the AsyncCheckpointer (snapshot on
+    # device, bounded background writer, atomic tmp->rename commit);
+    # 0/false restores the synchronous in-loop save.  Multi-process runs
+    # force the synchronous collective path regardless.
+    ckpt_async: bool = True
+    # Path polled by the PreemptionGuard: the file's existence requests a
+    # clean preemption exit (final sync checkpoint + resumable marker) —
+    # the test/orchestrator channel equivalent of SIGTERM.
+    preempt_file: Optional[str] = None
     # Multi-host coordination (replaces Spark driver/executor bring-up).
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
@@ -98,6 +116,9 @@ class EngineConfig:
             compute_dtype=_env("COMPUTE_DTYPE", "float32"),
             failure_retry_times=_env_int("FAILURE_RETRY_TIMES", 5),
             failure_retry_interval_s=_env_int("FAILURE_RETRY_INTERVAL_S", 120),
+            backoff_base_s=_env_float("BACKOFF_BASE_S", 2.0),
+            ckpt_async=_env_bool("CKPT_ASYNC", True),
+            preempt_file=os.environ.get(_PREFIX + "PREEMPT_FILE"),
             log_level=_env("LOG_LEVEL", "INFO"),
             seed=_env_int("SEED", 1),
             mesh_spec=os.environ.get(_PREFIX + "MESH"),

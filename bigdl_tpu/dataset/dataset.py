@@ -33,6 +33,17 @@ class DataSet:
     def shuffle(self) -> None:
         pass
 
+    def seek_epoch(self, epoch: int) -> None:
+        """Align the per-epoch shuffle stream with driver epoch `epoch`
+        (0-based).  The built-in datasets shuffle with
+        `seed + epoch_counter`; a resumed run's FRESH dataset object must
+        replay the interrupted epoch's exact order for losses to stay
+        bitwise-equal to the uninterrupted run, so the trainer calls this
+        before every `data(train=True)` — making shuffle order a pure
+        function of (seed, driver epoch) instead of call count."""
+        if hasattr(self, "_epoch"):
+            self._epoch = int(epoch)
+
     def size(self) -> int:
         raise NotImplementedError
 
@@ -180,6 +191,9 @@ class TransformedDataSet(DataSet):
 
     def size(self) -> int:
         return self.base.size()
+
+    def seek_epoch(self, epoch: int) -> None:
+        self.base.seek_epoch(epoch)
 
     def data(self, train: bool) -> Iterator[Any]:
         return self.transformer(self.base.data(train))

@@ -22,8 +22,11 @@ collate and H2D transfer overlap in-flight device compute:
     `data`-axis NamedSharding before the step wants them;
   * shutdown is deterministic: `close()` (or the `with` block / iterator
     exhaustion) stops the worker, unblocks any pending bounded-queue
-    put, and joins the thread — an early `end_when` break or an
-    exception in the consumer leaks nothing;
+    put, and joins the thread — an early `end_when` break, a preemption
+    exit (resilience.PreemptionGuard drains the feed through this same
+    close()), or an exception in the consumer leaks nothing;
+  * `delivered_batches` counts hand-offs to the consumer — the trainer's
+    mid-epoch resume bookkeeping (driver `epoch_batch`) cross-checks it;
   * a worker-side exception (bad record, OOM in collate) propagates to
     the consumer's next `__next__` instead of hanging the loop.
 
@@ -80,6 +83,7 @@ class DeviceFeed:
         self._staged = 0
         self._staged_records = 0
         self._work_s = 0.0
+        self._delivered = 0
         # daemon: a crashed consumer must not wedge interpreter exit; the
         # conftest leak guard still flags any feed thread alive post-test
         self._thread = threading.Thread(target=self._run, name=name,
@@ -145,6 +149,7 @@ class DeviceFeed:
                     f"staging a batch") from self._error
             raise StopIteration
         batch, payload = item
+        self._delivered += 1
         return FeedItem(batch, payload, stall, self._q.qsize() + 1)
 
     def __enter__(self) -> "DeviceFeed":
@@ -181,6 +186,12 @@ class DeviceFeed:
     def staged_batches(self) -> int:
         return self._staged
 
+    @property
+    def delivered_batches(self) -> int:
+        """Batches handed to the consumer (staged ones still queued when
+        the feed closes — e.g. on preemption — are NOT counted)."""
+        return self._delivered
+
 
 class InlineFeed:
     """Feed-off fallback: same FeedItem interface, zero threads — assembly
@@ -194,6 +205,7 @@ class InlineFeed:
         self._it = iter(batches)
         self._staged_records = 0
         self._work_s = 0.0
+        self._delivered = 0
 
     def __iter__(self) -> Iterator[FeedItem]:
         return self
@@ -210,6 +222,7 @@ class InlineFeed:
             except Exception:
                 pass
         # inline: the "stall" IS the assembly+staging time the loop paid
+        self._delivered += 1
         return FeedItem(batch, payload, time.perf_counter() - t0, 0)
 
     def __enter__(self) -> "InlineFeed":
@@ -223,6 +236,10 @@ class InlineFeed:
 
     def assembly_records_per_s(self) -> float:
         return self._staged_records / self._work_s if self._work_s > 0 else 0.0
+
+    @property
+    def delivered_batches(self) -> int:
+        return self._delivered
 
 
 def make_feed(batches: Iterable[Any], put_fn: Callable[[Any], Any],

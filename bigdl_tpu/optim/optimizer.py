@@ -56,6 +56,8 @@ from bigdl_tpu.optim.regularizer import apply_regularizers, collect_regularizers
 from bigdl_tpu.optim.schedules import Plateau
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+from bigdl_tpu.resilience.async_ckpt import AsyncCheckpointer
+from bigdl_tpu.resilience.preemption import Preempted, clear_marker, write_marker
 from bigdl_tpu.utils.checkpoint import latest_checkpoint, load_checkpoint, save_checkpoint
 from bigdl_tpu.utils.summary import TrainSummary, ValidationSummary
 
@@ -162,9 +164,20 @@ class Optimizer:
         self.val_trigger: Optional[Trigger] = None
         self.val_dataset: Optional[DataSet] = None
         self.val_methods: Optional[List[ValidationMethod]] = None
-        # checkpoint
+        # checkpoint (async writer + retention: bigdl_tpu/resilience)
         self.ckpt_path: Optional[str] = None
         self.ckpt_trigger: Optional[Trigger] = None
+        self.ckpt_async: Optional[bool] = None  # None = Engine config
+        self.ckpt_keep_last: Optional[int] = None
+        self.ckpt_keep_every: Optional[int] = None
+        self._ckpt_writer: Optional[AsyncCheckpointer] = None
+        # fault tolerance: bounded restarts with exponential backoff
+        self.max_restarts: Optional[int] = None  # None = Engine config
+        self.backoff_base_s: Optional[float] = None
+        self._preempt_guard = None
+        self._chaos = None
+        self._ckpt_fault = None
+        self._resume_skip = 0  # batches of the current epoch already trained
         # summaries
         self.train_summary: Optional[TrainSummary] = None
         self.val_summary: Optional[ValidationSummary] = None
@@ -183,7 +196,8 @@ class Optimizer:
         self._compiled = None
         self._compiled_key = None
         self._driver_state: Dict[str, Any] = {"epoch": 0, "neval": 0, "loss": None,
-                                              "score": None, "epoch_finished": False}
+                                              "score": None, "epoch_finished": False,
+                                              "epoch_batch": 0}
 
     # ------------------------------------------------------------------
     # Builder API (reference: optim/Optimizer.scala:111-452)
@@ -196,9 +210,63 @@ class Optimizer:
         self.val_methods = list(methods)
         return self
 
-    def set_checkpoint(self, path: str, trigger: Trigger) -> "Optimizer":
+    def set_checkpoint(self, path: str, trigger: Trigger, *,
+                       async_save: Optional[bool] = None,
+                       keep_last: Optional[int] = None,
+                       keep_every: Optional[int] = None) -> "Optimizer":
+        """Trigger-driven checkpoints under `path`.
+
+        `async_save` (default `BIGDL_TPU_CKPT_ASYNC`, on): the step loop
+        pays only an on-device snapshot; transfer + atomic commit run in
+        the bounded AsyncCheckpointer writer thread.  False restores the
+        synchronous in-loop save; multi-process runs are always
+        synchronous (the save is a collective).  `keep_last`/`keep_every`
+        set the retention policy (resilience.apply_retention)."""
         self.ckpt_path = path
         self.ckpt_trigger = trigger
+        self.ckpt_async = async_save
+        self.ckpt_keep_last = keep_last
+        self.ckpt_keep_every = keep_every
+        return self
+
+    def set_fault_tolerance(self, max_restarts: Optional[int] = None,
+                            backoff_base_s: Optional[float] = None) -> "Optimizer":
+        """Bound the failure-restart loop: up to `max_restarts` restores
+        from the latest committed checkpoint, sleeping
+        `backoff_base_s * 2^attempt` (capped at the config's
+        failure_retry_interval_s) between attempts.  Defaults come from
+        `BIGDL_TPU_FAILURE_RETRY_TIMES` / `BIGDL_TPU_BACKOFF_BASE_S`."""
+        if max_restarts is not None:
+            self.max_restarts = int(max_restarts)
+        if backoff_base_s is not None:
+            self.backoff_base_s = float(backoff_base_s)
+        return self
+
+    def set_preemption(self, guard: Any = True) -> "Optimizer":
+        """Cooperative preemption handling: SIGTERM/SIGINT (or the
+        `BIGDL_TPU_PREEMPT_FILE` poll) stop training at the next batch
+        boundary with one final synchronous checkpoint, a resumable
+        `PREEMPTED.json` marker, and a `Preempted` exception — instead of
+        dying mid-step.  Pass a configured
+        `resilience.PreemptionGuard`, True for the default, or False/None
+        to disable."""
+        if guard is True:
+            from bigdl_tpu.resilience.preemption import PreemptionGuard
+
+            guard = PreemptionGuard(
+                preempt_file=Engine.config().preempt_file)
+        self._preempt_guard = guard or None
+        return self
+
+    def set_chaos(self, hook: Any = None, *,
+                  ckpt_fault: Any = None) -> "Optimizer":
+        """Deterministic fault injection (tests/benchmarks only):
+        `hook.on_step(neval)` runs before every step dispatch and may
+        raise (resilience.chaos.StepFaultInjector) or trigger the
+        preemption guard (SimulatedPreemption); `ckpt_fault` is passed to
+        the AsyncCheckpointer as its write-fault hook."""
+        self._chaos = hook
+        self._ckpt_fault = ckpt_fault
         return self
 
     def set_train_summary(self, summary: TrainSummary) -> "Optimizer":
@@ -497,36 +565,85 @@ class Optimizer:
     # ------------------------------------------------------------------
 
     def optimize(self):
-        retries = Engine.config().failure_retry_times
-        while True:
-            try:
-                return self._optimize_impl()
-            except KeyboardInterrupt:
-                raise
-            except Exception:
-                # failure retry from last checkpoint
-                # (reference: optim/DistriOptimizer.scala:855-935)
-                if retries <= 0 or self.ckpt_path is None:
+        cfg = Engine.config()
+        max_restarts = self.max_restarts if self.max_restarts is not None \
+            else cfg.failure_retry_times
+        backoff = self.backoff_base_s if self.backoff_base_s is not None \
+            else cfg.backoff_base_s
+        cap = max(backoff, float(cfg.failure_retry_interval_s))
+        guard = self._preempt_guard
+        attempt = 0
+        if guard is not None:
+            guard.install()
+        try:
+            while True:
+                try:
+                    return self._optimize_impl()
+                except (KeyboardInterrupt, Preempted):
+                    # a preemption exit is intentional: the final
+                    # checkpoint + marker are already on disk; restarting
+                    # here would fight the scheduler evicting us
                     raise
-                retries -= 1
-                ckpt = latest_checkpoint(self.ckpt_path)
-                logger.exception("training failed; retrying from checkpoint %s "
-                                 "(%d retries left)", ckpt, retries)
-                if ckpt is not None:
-                    self._restore(ckpt)
+                except Exception:
+                    # bounded restart from the latest COMMITTED checkpoint
+                    # with exponential backoff — replaces the reference's
+                    # unbounded driver retry
+                    # (optim/DistriOptimizer.scala:855-935)
+                    if attempt >= max_restarts or self.ckpt_path is None:
+                        raise
+                    attempt += 1
+                    if self._ckpt_writer is not None:
+                        self._ckpt_writer.wait()
+                    ckpt = latest_checkpoint(self.ckpt_path, gc_partial=True)
+                    delay = min(backoff * (2 ** (attempt - 1)), cap)
+                    logger.exception(
+                        "training failed; restart %d/%d from %s after "
+                        "%.2fs backoff", attempt, max_restarts,
+                        ckpt or "current in-memory state", delay)
+                    if ckpt is not None:
+                        self._restore(ckpt)
+                    if delay > 0:
+                        time.sleep(delay)
+        finally:
+            if guard is not None:
+                guard.uninstall()
+            if self._ckpt_writer is not None:
+                self._ckpt_writer.close()
+                self._ckpt_writer = None
 
     def _restore(self, ckpt_dir: str) -> None:
         self.params, self.model_state, self.opt_state, driver = load_checkpoint(
             ckpt_dir, self.params, self.model_state, self.opt_state)
+        driver = dict(driver)
+        seed = driver.pop("rng_seed", None)
+        if seed is not None and int(seed) != RandomGenerator.get_seed():
+            # step rng and epoch shuffles derive from the global seed: a
+            # resume under a different seed would fork the trajectory from
+            # the uninterrupted run
+            logger.warning("restore: adopting global seed %s from "
+                           "checkpoint (was %s)", seed,
+                           RandomGenerator.get_seed())
+            RandomGenerator.set_seed(int(seed))
         self._driver_state.update(driver)
+        # mid-epoch checkpoints record how far into the epoch they are;
+        # the epoch loop replays the SAME shuffled order (seek_epoch) and
+        # skips exactly this many batches before training resumes
+        self._resume_skip = int(driver.get("epoch_batch", 0) or 0)
 
     def resume_from(self, ckpt_path: str) -> "Optimizer":
-        """Explicit resume (reference: Train --model/--state snapshots)."""
-        ckpt = latest_checkpoint(ckpt_path) if not ckpt_path.endswith(".json") else ckpt_path
+        """Explicit resume (reference: Train --model/--state snapshots).
+        Interrupted partial checkpoint dirs found next to the committed
+        ones are garbage-collected with a warning."""
+        ckpt = latest_checkpoint(ckpt_path, gc_partial=True) \
+            if not ckpt_path.endswith(".json") else ckpt_path
         if ckpt is None:
             raise FileNotFoundError(f"no checkpoint under {ckpt_path}")
         # Need built params first: build lazily on first batch then restore
         self._pending_restore = ckpt
+        # a clean finish retires the preemption marker at this root even
+        # when the resumed run itself writes no checkpoints
+        if not ckpt_path.endswith(".json"):
+            self._resume_root = ckpt_path
         return self
 
     def _async_depth(self) -> int:
@@ -563,8 +680,13 @@ class Optimizer:
 
     def _optimize_impl(self):
         state = self._driver_state
+        state.setdefault("epoch_batch", 0)
         step_fn = None
-        root_key = RandomGenerator.next_key()
+        # the step-rng root is a NAMED stream, not next_key(): a resumed
+        # process (fresh key counter) must derive the same per-step rng
+        # (fold_in(root, neval)) as the uninterrupted run for losses to
+        # stay bitwise-equal across restarts
+        root_key = RandomGenerator.key_for("optimizer/train-step")
         wall_start = time.time()
 
         # Resume must restore BEFORE the first end_when check so a
@@ -664,13 +786,31 @@ class Optimizer:
             epoch_start = time.time()
             record_count_epoch = 0
             completed_epoch = True
+            # deterministic epoch order: shuffle is a pure function of
+            # (seed, driver epoch), so a resumed run replays the
+            # interrupted epoch's exact batch sequence
+            seek = getattr(self.dataset, "seek_epoch", None)
+            if callable(seek):
+                seek(state["epoch"])
+            src = self.dataset.data(train=True)
+            skip = int(self._resume_skip or 0)
+            self._resume_skip = 0
+            if skip:
+                # mid-epoch resume: drop the batches the checkpoint
+                # already trained on (assembly of the skipped batches runs
+                # lazily in the feed worker, off the hot path)
+                logger.info("resume: skipping %d already-trained batch(es) "
+                            "of epoch %d", skip, state["epoch"] + 1)
+                src = _skip_batches(src, skip)
+            else:
+                state["epoch_batch"] = 0
             # batch assembly (iteration -> transformer chain -> stack) and
             # the H2D put run in the feed worker, `feed_depth` batches
             # ahead of the dispatch head; the bounded queue backpressures
             # instead of accumulating host memory.  close() in the finally
-            # makes an end_when break or a raising step leak no thread.
-            feed = make_feed(self.dataset.data(train=True),
-                             self._stage_batch, feed_depth,
+            # makes an end_when break, a raising step or a preemption exit
+            # leak no thread.
+            feed = make_feed(src, self._stage_batch, feed_depth,
                              name="DeviceFeed-train")
             feed_ref[0] = feed
             try:
@@ -678,6 +818,13 @@ class Optimizer:
                     if self._agreed_trigger(self.end_when, state):
                         completed_epoch = False
                         break
+                    if self._preempt_guard is not None \
+                            and self._preempt_guard.requested():
+                        # batch boundary: params/opt_state are consistent
+                        # here — final sync save + marker, then raise
+                        self._handle_preemption(state, feed)
+                    if self._chaos is not None:
+                        self._chaos.on_step(state["neval"])
                     batch = item.batch
                     if self.params is None or step_fn is None:
                         self._init_model(batch)
@@ -702,6 +849,7 @@ class Optimizer:
                         self.params, self.model_state, self.opt_state, x, y,
                         rng, lr)
                     state["neval"] += 1
+                    state["epoch_batch"] += 1
                     slot = (state["neval"] - 1) % ring_cap
                     ring = _ring_write(ring, slot, loss, lr_used)
                     pending.append((state["epoch"] + 1, state["neval"], bs,
@@ -734,6 +882,7 @@ class Optimizer:
             if not completed_epoch:
                 break
             state["epoch"] += 1
+            state["epoch_batch"] = 0
             state["epoch_finished"] = True
             if self.opt_state is not None:
                 # preserve the old leaf's sharding: a plain jnp.asarray
@@ -755,6 +904,19 @@ class Optimizer:
                 drain_clock[0] = min(time.perf_counter(),
                                      drain_clock[0] + dt_cb)
         drain(0)
+        if self._ckpt_writer is not None:
+            # wait() barrier: every queued async save is committed before
+            # optimize() returns — latest_checkpoint right after training
+            # must see the final state
+            t0 = time.perf_counter()
+            self._ckpt_writer.wait()
+            dt = time.perf_counter() - t0
+            if dt > 1e-3:
+                logger.info("drained async checkpoint writer (%.2fs)", dt)
+        for root in {self.ckpt_path, getattr(self, "_resume_root", None)}:
+            if root is not None:
+                # a clean finish retires any stale preemption marker
+                clear_marker(root)
         logger.info("Training finished after %d iterations (%.1fs)",
                     state["neval"], time.time() - wall_start)
         self.model.params = self.params
@@ -860,16 +1022,100 @@ class Optimizer:
         return [ValidationResult(float(v), int(c), m.name)
                 for v, c, m in zip(vals, cnts, self.val_methods)]
 
+    # ------------------------------------------------------------------
+    # Checkpointing + preemption (bigdl_tpu/resilience)
+    # ------------------------------------------------------------------
+
+    def _use_async_ckpt(self) -> bool:
+        if jax.process_count() > 1:
+            return False  # the multi-process save is a collective
+        if self.ckpt_async is not None:
+            return bool(self.ckpt_async)
+        return bool(Engine.config().ckpt_async)
+
+    def _ensure_ckpt_writer(self) -> AsyncCheckpointer:
+        if self._ckpt_writer is None:
+            self._ckpt_writer = AsyncCheckpointer(
+                self.ckpt_path, keep_last=self.ckpt_keep_last,
+                keep_every=self.ckpt_keep_every, fault=self._ckpt_fault)
+        return self._ckpt_writer
+
+    def _driver_snapshot(self, state) -> Dict[str, Any]:
+        driver = {k: v for k, v in state.items()
+                  if k in ("epoch", "neval", "loss", "score", "epoch_batch")}
+        # the seed travels with the checkpoint so a fresh process resumes
+        # the same step-rng stream and epoch shuffles
+        driver["rng_seed"] = RandomGenerator.get_seed()
+        return driver
+
+    def _sync_save(self, state) -> str:
+        if jax.process_count() > 1:
+            from bigdl_tpu.resilience.async_ckpt import apply_retention
+
+            d = save_checkpoint(self.ckpt_path, state["neval"], self.params,
+                                self.model_state, self.opt_state,
+                                driver_state=self._driver_snapshot(state))
+            if jax.process_index() == 0:
+                apply_retention(self.ckpt_path, self.ckpt_keep_last,
+                                self.ckpt_keep_every)
+            return d
+        return self._ensure_ckpt_writer().save_sync(
+            state["neval"], self.params, self.model_state, self.opt_state,
+            self._driver_snapshot(state))
+
     def _maybe_checkpoint(self, state):
         if self.ckpt_path is None or self.ckpt_trigger is None:
             return
         if not self._agreed_trigger(self.ckpt_trigger, state):
             return
-        d = save_checkpoint(self.ckpt_path, state["neval"], self.params,
-                            self.model_state, self.opt_state,
-                            driver_state={k: v for k, v in state.items()
-                                          if k in ("epoch", "neval", "loss", "score")})
-        logger.info("Checkpoint saved to %s", d)
+        t0 = time.perf_counter()
+        if self._use_async_ckpt():
+            # the loop pays only the on-device snapshot dispatch (and, if
+            # the bounded writer queue is full, the backpressure wait)
+            self._ensure_ckpt_writer().save_async(
+                state["neval"], self.params, self.model_state,
+                self.opt_state, self._driver_snapshot(state))
+            logger.info("Checkpoint step %d queued (async)", state["neval"])
+        else:
+            d = self._sync_save(state)
+            logger.info("Checkpoint saved to %s", d)
+        stall = time.perf_counter() - t0
+        self.metrics.add("checkpoint stall", stall)
+        if self.train_summary is not None \
+                and self.train_summary.should_log("CheckpointStallMs",
+                                                  state["neval"]):
+            self.train_summary.add_scalar("CheckpointStallMs", stall * 1e3,
+                                          state["neval"])
+
+    def _handle_preemption(self, state, feed) -> None:
+        guard = self._preempt_guard
+        reason = guard.reason
+        step = state["neval"]
+        logger.warning(
+            "preemption (%s): stopping at step %d (%d batch(es) into epoch "
+            "%d; feed delivered %d)", reason, step,
+            state.get("epoch_batch", 0), state["epoch"] + 1,
+            getattr(feed, "delivered_batches", -1))
+        ckpt_dir = None
+        if self.ckpt_path is not None:
+            if self._ckpt_writer is not None:
+                self._ckpt_writer.wait()  # queued saves commit first
+            ckpt_dir = self._sync_save(state)
+            write_marker(self.ckpt_path, step=step, epoch=state["epoch"],
+                         checkpoint=ckpt_dir, reason=reason)
+            logger.warning("preemption: final checkpoint %s and resumable "
+                           "marker written", ckpt_dir)
+        raise Preempted(reason, step=step, checkpoint=ckpt_dir)
+
+
+def _skip_batches(it, n: int):
+    """Drop the first `n` batches of an epoch iterator (mid-epoch resume:
+    the checkpoint already trained on them; the replayed shuffle order
+    makes the remainder identical to the uninterrupted run).  Lazy, so the
+    skipping assembles in the feed worker, not on the step loop."""
+    for i, item in enumerate(it):
+        if i >= n:
+            yield item
 
 
 def _flatten_spec_axes(spec) -> set:

@@ -1,0 +1,66 @@
+"""bigdl_tpu.resilience — production fault tolerance for the trainer.
+
+Reference: the BigDL production story is trigger-driven synchronous saves
+(optim/AbstractOptimizer.scala:202-221) plus an unbounded driver-side
+retry (optim/DistriOptimizer.scala:855-935).  On a preemptible TPU pool
+that design loses work twice over: every save stalls the dispatch head
+for the full host write, and an eviction between triggers replays
+everything since the last one.
+
+Three cooperating parts close the gap:
+
+  * `AsyncCheckpointer` (async_ckpt.py): the step loop pays only an
+    on-device snapshot; transfer + atomic commit (`tmp.<step>` -> fsync ->
+    rename, meta.json last) run in a bounded writer thread, with
+    `keep_last`/`keep_every` retention and stale-staging-dir GC.
+  * `PreemptionGuard` (preemption.py): SIGTERM/SIGINT or a preempt-file
+    poll become a cooperative flag; the trainer writes one final
+    synchronous checkpoint at the exact current step, drains feed+writer,
+    drops a `PREEMPTED.json` marker and raises `Preempted`.
+  * `chaos` (chaos.py): deterministic, seeded fault injectors (step
+    exceptions, mid-file checkpoint write failures, simulated preemption)
+    so every recovery path above has a test that actually kills training.
+
+The `Optimizer` consumes all three: `set_checkpoint(..., async_save=,
+keep_last=, keep_every=)`, `set_preemption()`, `set_fault_tolerance(
+max_restarts=, backoff_base_s=)` (bounded exponential-backoff restarts
+replacing the one-shot retry), and `set_chaos(hook)`.
+"""
+
+from bigdl_tpu.resilience.async_ckpt import (
+    AsyncCheckpointer,
+    CheckpointWriteError,
+    apply_retention,
+    committed_steps,
+)
+from bigdl_tpu.resilience.chaos import (
+    ChaosStepFault,
+    CheckpointWriteFault,
+    SimulatedPreemption,
+    StepFaultInjector,
+    compose,
+)
+from bigdl_tpu.resilience.preemption import (
+    Preempted,
+    PreemptionGuard,
+    clear_marker,
+    read_marker,
+    write_marker,
+)
+
+__all__ = [
+    "AsyncCheckpointer",
+    "ChaosStepFault",
+    "CheckpointWriteError",
+    "CheckpointWriteFault",
+    "Preempted",
+    "PreemptionGuard",
+    "SimulatedPreemption",
+    "StepFaultInjector",
+    "apply_retention",
+    "clear_marker",
+    "committed_steps",
+    "compose",
+    "read_marker",
+    "write_marker",
+]

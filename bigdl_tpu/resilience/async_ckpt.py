@@ -1,0 +1,347 @@
+"""AsyncCheckpointer — checkpoint IO off the step loop, committed atomically.
+
+Reference: optim/AbstractOptimizer.scala:202-221 saves synchronously inside
+the iteration callback — the driver (and with it the dispatch head) stalls
+for the full serialize+write on every trigger.  Here the step loop pays
+only an on-device snapshot (a handful of async copy dispatches); the
+device->host transfer and the file writes run in ONE bounded background
+writer thread, overlapping in-flight device compute exactly like the
+DeviceFeed overlaps H2D staging on the input side.
+
+Commit protocol (local paths): every file lands in a `tmp.<step>` staging
+dir, each file is fsync'd, `meta.json` is written LAST, then the staging
+dir is atomically renamed to `ckpt_<step>` and the parent dir fsync'd.  A
+crash at ANY point leaves either a committed checkpoint or a `tmp.*` /
+meta-less dir that `latest_checkpoint(gc_partial=True)` reclaims on resume
+— never a half-checkpoint that loads.  Remote (fsspec) paths have no
+atomic rename, so they write in place with meta.json as the last-write
+commit marker (the scheme `latest_checkpoint` already trusts).
+
+Retention: `keep_last=N` keeps the N newest committed checkpoints;
+`keep_every=K` additionally pins every step that is a multiple of K
+(the "hourly keeper" policy).  GC also reclaims stale `tmp.*` staging
+dirs that no in-flight job owns.
+
+Failure policy: a failed write is logged, counted and remembered
+(`last_error`), but does NOT kill training — losing one checkpoint is
+recoverable, killing the run is not.  `wait()` drains the queue so
+end-of-training and pre-restore paths observe every commit.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import os
+import queue
+import re
+import shutil
+import threading
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.utils.checkpoint import (
+    SCHEMA_VERSION,
+    _exists,
+    _flatten,
+    _is_remote,
+    _isdir,
+    _join,
+    _listdir,
+    _makedirs,
+    _open,
+    _rmtree,
+)
+
+logger = logging.getLogger("bigdl_tpu.resilience")
+
+_STOP = object()
+
+
+class CheckpointWriteError(RuntimeError):
+    """A checkpoint file write failed (possibly mid-file)."""
+
+
+class _Job(NamedTuple):
+    step: int
+    trees: Tuple[Any, Any, Any]  # device snapshots: params, model_state, opt_state
+    driver_state: Dict[str, Any]
+
+
+def _snapshot(tree: Any) -> Any:
+    """On-device copy of every jax leaf — the only cost the step loop pays.
+
+    The jitted step DONATES its buffers, so the writer cannot hold the live
+    params: the copies are enqueued before the next step's dispatch and the
+    in-order device executes them first, giving the writer a stable buffer
+    to transfer at its leisure.  Host leaves are copied too (optimizer
+    slots mutated in place must not race the writer)."""
+    if tree is None:
+        return None
+    return jax.tree_util.tree_map(
+        lambda l: jnp.copy(l) if isinstance(l, jax.Array)
+        else (np.array(l) if isinstance(l, np.ndarray) else l), tree)
+
+
+def committed_steps(path: str) -> List[int]:
+    """Steps of committed checkpoints (dirs with a meta.json) under path."""
+    if not _isdir(path):
+        return []
+    steps = []
+    for name in _listdir(path):
+        m = re.fullmatch(r"ckpt_(\d+)", name)
+        if m and _exists(_join(path, name, "meta.json")):
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def apply_retention(path: str, keep_last: Optional[int],
+                    keep_every: Optional[int],
+                    protect: Tuple[int, ...] = ()) -> List[str]:
+    """Delete committed checkpoints outside the retention policy, and stale
+    `tmp.*` staging dirs not owned by an in-flight (`protect`ed) job.
+    Returns the removed paths.  keep_last=None keeps everything."""
+    removed: List[str] = []
+    if not _isdir(path):
+        return removed
+    steps = committed_steps(path)
+    keep = set(steps if keep_last is None else steps[-max(0, keep_last):])
+    if keep_every:
+        keep |= {s for s in steps if s % keep_every == 0}
+    keep |= set(protect)
+    for s in steps:
+        if s not in keep:
+            d = _join(path, f"ckpt_{s}")
+            _rmtree(d)
+            removed.append(d)
+    for name in _listdir(path):
+        m = re.fullmatch(r"tmp\.(\d+)", name)
+        if m and int(m.group(1)) not in protect:
+            d = _join(path, name)
+            _rmtree(d)
+            removed.append(d)
+    if removed:
+        logger.info("checkpoint retention: removed %d dir(s): %s",
+                    len(removed), [os.path.basename(r) for r in removed])
+    return removed
+
+
+class AsyncCheckpointer:
+    """Bounded background checkpoint writer with atomic commit + retention.
+
+    Parameters
+    ----------
+    path : checkpoint root (the trainer's `set_checkpoint` path)
+    keep_last / keep_every : retention policy (see module docstring)
+    queue_depth : max queued snapshots; a full queue backpressures
+        `save_async` (bounding host memory at queue_depth+1 snapshots)
+    fault : chaos hook `f(relname) -> bool`; True makes the write of that
+        file fail mid-file (tests of the partial-checkpoint recovery path)
+    """
+
+    def __init__(self, path: str, *, keep_last: Optional[int] = None,
+                 keep_every: Optional[int] = None, queue_depth: int = 2,
+                 fault: Optional[Callable[[str], bool]] = None,
+                 name: str = "AsyncCkptWriter"):
+        if queue_depth < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+        self.path = str(path)
+        self.keep_last = keep_last
+        self.keep_every = keep_every
+        self._fault = fault
+        self._name = name
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._inflight: set = set()
+        self.committed: List[int] = []
+        self.failed: List[int] = []
+        self.last_error: Optional[BaseException] = None
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    # producer side (the step loop)
+    # ------------------------------------------------------------------
+
+    def save_async(self, step: int, params: Any, model_state: Any = None,
+                   opt_state: Any = None,
+                   driver_state: Optional[Dict] = None) -> None:
+        """Snapshot on device and enqueue; returns as soon as the copies
+        are dispatched (the step loop's entire checkpoint cost)."""
+        if self._closed:
+            raise RuntimeError(f"{self._name} is closed")
+        job = _Job(int(step),
+                   (_snapshot(params), _snapshot(model_state),
+                    _snapshot(opt_state)),
+                   dict(driver_state or {}))
+        with self._lock:
+            self._inflight.add(job.step)
+        self._ensure_thread()
+        self._q.put(job)  # bounded: backpressure instead of host-mem growth
+
+    def save_sync(self, step: int, params: Any, model_state: Any = None,
+                  opt_state: Any = None,
+                  driver_state: Optional[Dict] = None) -> str:
+        """Drain the queue, then write THIS checkpoint inline (the
+        preemption path's final save, and the `async_save=False` mode).
+        Raises CheckpointWriteError on failure — a sync save that is lost
+        silently defeats its purpose."""
+        self.wait()
+        job = _Job(int(step),
+                   (_snapshot(params), _snapshot(model_state),
+                    _snapshot(opt_state)),
+                   dict(driver_state or {}))
+        with self._lock:
+            self._inflight.add(job.step)
+        try:
+            d = self._write(job)
+        except BaseException as e:
+            self.failed.append(job.step)
+            self.last_error = e
+            raise CheckpointWriteError(
+                f"sync checkpoint at step {job.step} failed") from e
+        finally:
+            with self._lock:
+                self._inflight.discard(job.step)
+        self.committed.append(job.step)
+        apply_retention(self.path, self.keep_last, self.keep_every,
+                        protect=tuple(self._inflight))
+        return d
+
+    def wait(self) -> None:
+        """Barrier: every queued snapshot is committed (or failed+logged)
+        when this returns.  End-of-training and every restore path call
+        this so `latest_checkpoint` sees the full commit history."""
+        self._q.join()
+
+    def close(self) -> None:
+        """Drain, stop and join the writer thread.  Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread is not None:
+            self._q.put(_STOP)
+            self._q.join()
+            self._thread.join(timeout=30.0)
+            if self._thread.is_alive():  # pragma: no cover - defensive
+                raise RuntimeError(f"{self._name} did not stop")
+            self._thread = None
+
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------
+    # writer thread
+    # ------------------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            # daemon: a crashed driver must not hang interpreter exit; the
+            # conftest leak guard still flags one alive past a test
+            self._thread = threading.Thread(target=self._run,
+                                            name=self._name, daemon=True)
+            self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is _STOP:
+                self._q.task_done()
+                return
+            try:
+                d = self._write(job)
+                self.committed.append(job.step)
+                logger.info("checkpoint step %d committed to %s",
+                            job.step, d)
+                apply_retention(self.path, self.keep_last, self.keep_every,
+                                protect=tuple(self._inflight))
+            except BaseException as e:
+                # a lost checkpoint is recoverable; a killed run is not —
+                # the partial staging dir stays on disk (cleanup code after
+                # an IO error is untrustworthy) and resume-time GC reclaims
+                self.failed.append(job.step)
+                self.last_error = e
+                logger.exception("async checkpoint at step %d failed "
+                                 "(training continues)", job.step)
+            finally:
+                with self._lock:
+                    self._inflight.discard(job.step)
+                self._q.task_done()
+
+    # ------------------------------------------------------------------
+    # atomic commit
+    # ------------------------------------------------------------------
+
+    def _write(self, job: _Job) -> str:
+        flats = {}
+        for name, tree in zip(("params", "model_state", "opt_state"),
+                              job.trees):
+            if tree is not None:
+                flats[name + ".npz"] = _flatten(tree)  # device->host here
+        meta = {"schema_version": SCHEMA_VERSION, "step": job.step,
+                "driver_state": job.driver_state}
+        final = _join(self.path, f"ckpt_{job.step}")
+        if _is_remote(self.path):
+            return self._write_remote(final, flats, meta)
+        return self._write_local(final, flats, meta, job.step)
+
+    def _write_local(self, final: str, flats: Dict[str, Dict],
+                     meta: Dict, step: int) -> str:
+        tmp = os.path.join(self.path, f"tmp.{step}")
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        for relname, flat in flats.items():
+            buf = io.BytesIO()
+            np.savez(buf, **flat)
+            self._write_file(os.path.join(tmp, relname), buf.getbuffer(),
+                             relname)
+        # meta.json LAST: its presence is the per-dir commit marker
+        self._write_file(os.path.join(tmp, "meta.json"),
+                         json.dumps(meta, indent=2).encode(), "meta.json")
+        if os.path.isdir(final):
+            shutil.rmtree(final)  # re-save of the same step
+        os.rename(tmp, final)
+        # fsync the parent so the rename itself survives a power cut
+        dfd = os.open(self.path, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+        return final
+
+    def _write_remote(self, final: str, flats: Dict[str, Dict],
+                      meta: Dict) -> str:
+        _makedirs(final)
+        for relname, flat in flats.items():
+            if self._fault is not None and self._fault(relname):
+                raise CheckpointWriteError(f"chaos: fault writing {relname}")
+            buf = io.BytesIO()
+            np.savez(buf, **flat)
+            with _open(_join(final, relname), "wb") as fh:
+                fh.write(buf.getbuffer())
+        with _open(_join(final, "meta.json"), "w") as fh:
+            json.dump(meta, fh, indent=2)
+        return final
+
+    def _write_file(self, path: str, payload, relname: str) -> None:
+        """fsync'd local write; the chaos fault leaves the file truncated
+        mid-payload (the crash-while-writing shape resume must survive)."""
+        fail = self._fault is not None and self._fault(relname)
+        with open(path, "wb") as fh:
+            if fail:
+                fh.write(payload[:max(1, len(payload) // 2)])
+                fh.flush()
+                raise CheckpointWriteError(
+                    f"chaos: injected mid-file failure writing {relname}")
+            fh.write(payload)
+            fh.flush()
+            os.fsync(fh.fileno())

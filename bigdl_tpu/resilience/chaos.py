@@ -1,0 +1,123 @@
+"""chaos — deterministic fault injection for the resilience test suite.
+
+Recovery code that is never executed is recovery code that does not work:
+the reference's driver-side retry (optim/DistriOptimizer.scala:855-935)
+shipped for years with no test killing a training job.  This module makes
+every failure mode a REPRODUCIBLE fixture:
+
+  * `StepFaultInjector` — raises at exact (or seeded-pseudorandom) global
+    step indices, exercising the optimizer's bounded retry+restore loop;
+  * `CheckpointWriteFault` — fails the Nth checkpoint file write MID-FILE
+    (half the payload on disk), exercising the atomic-commit protocol and
+    the partial-dir GC on resume;
+  * `SimulatedPreemption` — triggers a PreemptionGuard at a step index,
+    exercising the final-sync-save + marker + clean-drain path without
+    touching process signals.
+
+Everything is seeded/step-indexed — no wall clock, no real randomness —
+so a failing recovery path replays bit-for-bit under pytest.  Hooks attach
+with `Optimizer.set_chaos(hook)`; compose several with `compose()`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional, Sequence, Set
+
+import numpy as np
+
+from bigdl_tpu.resilience.preemption import PreemptionGuard
+
+
+class ChaosStepFault(RuntimeError):
+    """The injected step-function failure (stands in for a lost device,
+    a poisoned batch, an XLA runtime error...)."""
+
+
+class StepFaultInjector:
+    """Raise `exc_type` immediately before the step whose global index
+    (completed-step count, the optimizer's `neval`) is in `fail_steps`.
+
+    `seed`/`horizon`/`n_faults` derive the fail set pseudorandomly but
+    reproducibly.  `once=True` (default) fires each step index a single
+    time across restarts — the injector outlives the retry loop, so the
+    replayed step succeeds on the next attempt (a transient fault);
+    `once=False` models a persistent fault that exhausts the retry budget.
+    """
+
+    def __init__(self, fail_steps: Sequence[int] = (), *,
+                 seed: Optional[int] = None, horizon: Optional[int] = None,
+                 n_faults: int = 1, once: bool = True,
+                 exc_type: type = ChaosStepFault):
+        steps = set(int(s) for s in fail_steps)
+        if seed is not None:
+            if not horizon:
+                raise ValueError("seeded injection needs `horizon` (the "
+                                 "step range to draw fail steps from)")
+            rs = np.random.RandomState(seed)
+            # steps 1..horizon-1: step 0 has no checkpoint to restore from
+            draw = rs.choice(np.arange(1, horizon), size=min(n_faults, horizon - 1),
+                             replace=False)
+            steps |= {int(s) for s in draw}
+        self.fail_steps: Set[int] = steps
+        self.once = once
+        self.exc_type = exc_type
+        self.fired: list = []
+
+    def on_step(self, step: int) -> None:
+        if step in self.fail_steps and (not self.once
+                                        or step not in self.fired):
+            self.fired.append(step)
+            raise self.exc_type(f"chaos: injected fault before step {step}")
+
+
+class CheckpointWriteFault:
+    """`fault=` hook for AsyncCheckpointer: fail the write of `fail_file`
+    on the `fail_on_save`-th checkpoint attempt (1-based), mid-file."""
+
+    def __init__(self, fail_on_save: int = 1, fail_file: str = "params.npz",
+                 n_failures: int = 1):
+        self.fail_on_save = int(fail_on_save)
+        self.fail_file = fail_file
+        self.n_failures = int(n_failures)
+        self.saves_seen = 0
+        self.fired = 0
+
+    def __call__(self, relname: str) -> bool:
+        if relname == self.fail_file:
+            self.saves_seen += 1
+            if self.saves_seen >= self.fail_on_save \
+                    and self.fired < self.n_failures:
+                self.fired += 1
+                return True
+        return False
+
+
+class SimulatedPreemption:
+    """Trigger `guard` right before step `at_step` — the deterministic
+    stand-in for the SIGTERM a preemptible pool delivers."""
+
+    def __init__(self, guard: PreemptionGuard, at_step: int,
+                 reason: str = "chaos: simulated preemption"):
+        self.guard = guard
+        self.at_step = int(at_step)
+        self.reason = reason
+        self.fired = False
+
+    def on_step(self, step: int) -> None:
+        if not self.fired and step >= self.at_step:
+            self.fired = True
+            self.guard.trigger(self.reason)
+
+
+def compose(*hooks) -> "_Composed":
+    """One chaos hook fanning out to several injectors, in order."""
+    return _Composed(hooks)
+
+
+class _Composed:
+    def __init__(self, hooks: Iterable):
+        self.hooks = list(hooks)
+
+    def on_step(self, step: int) -> None:
+        for h in self.hooks:
+            h.on_step(step)

@@ -83,6 +83,33 @@ class ModelRegistry:
         return self.register(version, params, state if state is not None else {},
                              activate=activate, source=str(ckpt_dir))
 
+    def register_from_checkpoint(self, path: str, *,
+                                 version: Optional[str] = None,
+                                 activate: bool = True) -> ModelVersion:
+        """Register straight from a trainer checkpoint tree: `path` may be
+        either one `ckpt_<step>` dir or the checkpoint ROOT the trainer
+        wrote into — the newest COMMITTED step is resolved via
+        `latest_checkpoint` (interrupted partial saves never load; the
+        meta.json commit marker gates them out).  `version` defaults to
+        the resolved dir's basename (e.g. "ckpt_1200"), so rolling
+        promotion from a training run is one call per save point."""
+        import os
+
+        from bigdl_tpu.utils.checkpoint import latest_checkpoint
+
+        ckpt_dir = path
+        base = os.path.basename(str(path).rstrip("/"))
+        if not (base.startswith("ckpt_")
+                and base[len("ckpt_"):].isdigit()):
+            resolved = latest_checkpoint(path)
+            if resolved is None:
+                raise FileNotFoundError(
+                    f"no committed checkpoint under {path!r}")
+            ckpt_dir = resolved
+        if version is None:
+            version = os.path.basename(str(ckpt_dir).rstrip("/"))
+        return self.register_checkpoint(version, ckpt_dir, activate=activate)
+
     def activate(self, version: str) -> ModelVersion:
         """Atomic swap to an already-registered version (e.g. rollback)."""
         with self._lock:

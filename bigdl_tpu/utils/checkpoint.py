@@ -20,12 +20,16 @@ support.  Plain paths use the local filesystem directly.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import re
-from typing import Any, Dict, Optional, Tuple
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+logger = logging.getLogger("bigdl_tpu.checkpoint")
 
 SCHEMA_VERSION = 1
 _SEP = "/"
@@ -78,6 +82,13 @@ def _exists(path: str) -> bool:
     if _is_remote(path):
         return _fs_for(path).exists(path)
     return os.path.exists(path)
+
+
+def _rmtree(path: str) -> None:
+    if _is_remote(path):
+        _fs_for(path).rm(path, recursive=True)
+    else:
+        shutil.rmtree(path, ignore_errors=True)
 
 
 def _join(*parts: str) -> str:
@@ -294,13 +305,46 @@ def load_params(ckpt_dir: str, params_template: Any,
     return params, model_state
 
 
-def latest_checkpoint(path: str) -> Optional[str]:
-    """Newest ckpt dir under `path`, agreed across processes (collective
-    when multi-process): only process 0's filesystem answer counts —
-    checkpoints are written by process 0, so on hosts without a shared
-    filesystem the others see nothing yet must resume the SAME step."""
+def gc_partial_checkpoints(path: str) -> List[str]:
+    """Reclaim interrupted checkpoint debris under `path`: `ckpt_<N>` dirs
+    missing their meta.json commit marker (a save killed mid-write) and
+    `tmp.<N>` staging dirs the async writer never got to rename.  Returns
+    the removed paths.
+
+    Call this only on RESUME paths (no writer can be mid-save then) — a
+    live writer's staging dir looks exactly like debris."""
+    removed: List[str] = []
+    if not _isdir(path):
+        return removed
+    for name in _listdir(path):
+        m = re.fullmatch(r"ckpt_(\d+)", name)
+        partial = (m is not None
+                   and not _exists(_join(path, name, "meta.json"))) \
+            or re.fullmatch(r"tmp\.(\d+)", name) is not None
+        full = _join(path, name)
+        if partial and _isdir(full):
+            _rmtree(full)
+            removed.append(full)
+    if removed:
+        logger.warning(
+            "garbage-collected %d interrupted partial checkpoint dir(s) "
+            "under %s: %s — resuming from the newest COMMITTED checkpoint",
+            len(removed), path, sorted(os.path.basename(r) for r in removed))
+    return removed
+
+
+def latest_checkpoint(path: str, gc_partial: bool = False) -> Optional[str]:
+    """Newest COMMITTED ckpt dir under `path`, agreed across processes
+    (collective when multi-process): only process 0's filesystem answer
+    counts — checkpoints are written by process 0, so on hosts without a
+    shared filesystem the others see nothing yet must resume the SAME step.
+
+    `gc_partial=True` (resume paths only) deletes interrupted partial
+    checkpoint dirs with a warning instead of silently skipping them."""
     best_step = -1
     if jax.process_count() <= 1 or jax.process_index() == 0:
+        if gc_partial:
+            gc_partial_checkpoints(path)
         if _isdir(path):
             for name in _listdir(path):
                 m = re.fullmatch(r"ckpt_(\d+)", name)

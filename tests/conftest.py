@@ -56,7 +56,8 @@ def _thread_leak_guard():
     def offenders():
         return [t for t in threading.enumerate()
                 if t not in before and t.is_alive()
-                and (not t.daemon or t.name.startswith("DeviceFeed"))]
+                and (not t.daemon
+                     or t.name.startswith(("DeviceFeed", "AsyncCkptWriter")))]
 
     yield
     # grace for threads mid-shutdown (close() joins, but a worker that
@@ -78,3 +79,7 @@ def pytest_configure(config):
         "markers",
         "slow: heavyweight tier — differential oracles, trainer loops, "
         "registry-wide sweeps; deselect with -m \"not slow\"")
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection tests (resilience subsystem); "
+        "the CI quick tier runs them as their own lane")

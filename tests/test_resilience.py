@@ -1,0 +1,391 @@
+"""Resilience subsystem: async checkpointing, preemption, chaos recovery.
+
+The contract under test is the survey's hardest one: a training run KILLED
+at an arbitrary step (chaos fault, SIGTERM, preempt file) must resume from
+its checkpoints to a final state BITWISE-EQUAL to the uninterrupted run —
+same params, same per-step losses — with the DeviceFeed on or off.
+"""
+
+import glob
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import optim
+from bigdl_tpu.core.random import RandomGenerator
+from bigdl_tpu.dataset import ArrayDataSet, Sample, SampleToMiniBatch
+from bigdl_tpu.optim import SGD, Trigger
+from bigdl_tpu.resilience import (
+    AsyncCheckpointer,
+    ChaosStepFault,
+    CheckpointWriteFault,
+    Preempted,
+    PreemptionGuard,
+    SimulatedPreemption,
+    StepFaultInjector,
+    apply_retention,
+    committed_steps,
+    read_marker,
+)
+from bigdl_tpu.utils.checkpoint import latest_checkpoint
+
+
+def make_dataset(n=64, dim=8, batch=8, seed=7):
+    rs = np.random.RandomState(seed)
+    samples = [Sample.from_ndarray(rs.randn(dim).astype(np.float32),
+                                   rs.randn(4).astype(np.float32))
+               for _ in range(n)]
+    return ArrayDataSet(samples).transform(SampleToMiniBatch(batch))
+
+
+def make_optimizer(epochs=3, feed_depth=None, seed=42):
+    RandomGenerator.set_seed(seed)
+    model = nn.Sequential(nn.Linear(8, 4))
+    o = optim.LocalOptimizer(model, make_dataset(), nn.MSECriterion(),
+                             optim_method=SGD(learning_rate=0.05),
+                             end_trigger=Trigger.max_epoch(epochs))
+    if feed_depth is not None:
+        o.set_feed(feed_depth)
+    o.set_fault_tolerance(backoff_base_s=0.0)
+    return o
+
+
+def param_leaves(o):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(o.params)]
+
+
+def assert_bitwise_equal(a_leaves, b_leaves):
+    assert len(a_leaves) == len(b_leaves)
+    for a, b in zip(a_leaves, b_leaves):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+
+
+# ----------------------------------------------------------------------
+# AsyncCheckpointer unit behaviour
+# ----------------------------------------------------------------------
+
+class TestAsyncCheckpointer:
+    def test_commit_wait_and_retention(self, tmp_path):
+        root = str(tmp_path)
+        params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+        with AsyncCheckpointer(root, keep_last=2, keep_every=10) as w:
+            for step in range(1, 31):
+                w.save_async(step, params, driver_state={"neval": step})
+            w.wait()
+            assert not w.failed
+        # keep_last=2 -> {29, 30}; keep_every=10 pins {10, 20, 30}
+        assert committed_steps(root) == [10, 20, 29, 30]
+        # no staging debris after a clean drain
+        assert not glob.glob(os.path.join(root, "tmp.*"))
+        # the commit is loadable and atomic: meta.json present everywhere
+        for d in glob.glob(os.path.join(root, "ckpt_*")):
+            assert os.path.exists(os.path.join(d, "meta.json"))
+
+    def test_save_sync_returns_committed_dir(self, tmp_path):
+        root = str(tmp_path)
+        with AsyncCheckpointer(root) as w:
+            d = w.save_sync(5, {"w": np.ones(3, np.float32)})
+        assert os.path.basename(d) == "ckpt_5"
+        assert latest_checkpoint(root) == d
+
+    def test_midfile_fault_leaves_previous_intact(self, tmp_path):
+        """A write killed mid-file must leave a meta-less partial the
+        commit protocol never surfaces: latest_checkpoint keeps answering
+        with the previous INTACT checkpoint."""
+        root = str(tmp_path)
+        fault = CheckpointWriteFault(fail_on_save=2, fail_file="params.npz")
+        with AsyncCheckpointer(root, fault=fault) as w:
+            w.save_async(1, {"w": np.ones(100, np.float32)})
+            w.wait()
+            w.save_async(2, {"w": np.full(100, 2.0, np.float32)})
+            w.wait()
+            assert w.failed == [2]
+            assert w.last_error is not None
+        assert committed_steps(root) == [1]
+        # the half-written staging dir stays (cleanup after an IO error is
+        # untrustworthy); resume-time GC owns it
+        debris = glob.glob(os.path.join(root, "tmp.2"))
+        assert debris and not os.path.exists(
+            os.path.join(debris[0], "meta.json"))
+        assert latest_checkpoint(root).endswith("ckpt_1")
+
+    def test_sync_save_fault_raises(self, tmp_path):
+        from bigdl_tpu.resilience import CheckpointWriteError
+
+        fault = CheckpointWriteFault(fail_on_save=1)
+        with AsyncCheckpointer(str(tmp_path), fault=fault) as w:
+            with pytest.raises(CheckpointWriteError):
+                w.save_sync(1, {"w": np.ones(8, np.float32)})
+
+    def test_apply_retention_protects_inflight(self, tmp_path):
+        root = str(tmp_path)
+        with AsyncCheckpointer(root) as w:
+            for s in (1, 2, 3):
+                w.save_sync(s, {"w": np.ones(2, np.float32)})
+        os.makedirs(os.path.join(root, "tmp.9"))
+        removed = apply_retention(root, keep_last=1, keep_every=None,
+                                  protect=(9,))
+        assert committed_steps(root) == [3]
+        assert os.path.isdir(os.path.join(root, "tmp.9"))  # protected
+        assert len(removed) == 2
+
+
+# ----------------------------------------------------------------------
+# GC of interrupted partials on resume
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_gc_partial_on_resume_warns(tmp_path, caplog):
+    root = str(tmp_path)
+    with AsyncCheckpointer(root) as w:
+        w.save_sync(4, {"w": np.ones(4, np.float32)})
+    # fabricate interrupted-save debris: a meta-less ckpt dir + a stale tmp
+    os.makedirs(os.path.join(root, "ckpt_8"))
+    np.savez(os.path.join(root, "ckpt_8", "params.npz"),
+             w=np.ones(4, np.float32))
+    os.makedirs(os.path.join(root, "tmp.12"))
+    with caplog.at_level("WARNING", logger="bigdl_tpu.checkpoint"):
+        best = latest_checkpoint(root, gc_partial=True)
+    assert best.endswith("ckpt_4")
+    assert not os.path.exists(os.path.join(root, "ckpt_8"))
+    assert not os.path.exists(os.path.join(root, "tmp.12"))
+    assert any("partial checkpoint" in r.message.lower()
+               for r in caplog.records)
+
+
+# ----------------------------------------------------------------------
+# chaos kill -> bounded retry -> bitwise-equal trajectory
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestChaosRecovery:
+    @pytest.mark.parametrize("feed_depth", [2, 0],
+                             ids=["feed-on", "feed-off"])
+    def test_kill_and_resume_bitwise_equal(self, tmp_path, feed_depth):
+        """Kill mid-epoch (step 13 of 8-step epochs = 5 batches into epoch
+        2), resume in a 'fresh process', and require the final params to be
+        BITWISE equal to the uninterrupted run's."""
+        baseline = make_optimizer(feed_depth=feed_depth)
+        base_leaves = param_leaves_after(baseline)
+
+        root = str(tmp_path / "ck")
+        o = make_optimizer(feed_depth=feed_depth)
+        o.set_checkpoint(root, Trigger.several_iteration(4))
+        o.set_chaos(StepFaultInjector(fail_steps=(13,)))
+        o.set_fault_tolerance(max_restarts=0)
+        with pytest.raises(ChaosStepFault):
+            o.optimize()
+        assert committed_steps(root)  # something to resume from
+
+        # fresh process: different ambient seed — the checkpoint's stored
+        # seed must win or the epoch shuffle forks the trajectory
+        RandomGenerator.set_seed(999)
+        o2 = optim.LocalOptimizer(nn.Sequential(nn.Linear(8, 4)),
+                                  make_dataset(), nn.MSECriterion(),
+                                  optim_method=SGD(learning_rate=0.05),
+                                  end_trigger=Trigger.max_epoch(3))
+        if feed_depth is not None:
+            o2.set_feed(feed_depth)
+        o2.resume_from(root)
+        o2.optimize()
+        assert_bitwise_equal(base_leaves, param_leaves(o2))
+
+    def test_resumed_losses_bitwise_equal(self, tmp_path):
+        """The per-step LOSSES after resume match the uninterrupted run's
+        exactly — not just the final params (satellite: resume under
+        DeviceFeed compares losses)."""
+        from bigdl_tpu.utils import TrainSummary
+
+        baseline = make_optimizer(feed_depth=2)
+        baseline.set_train_summary(
+            TrainSummary(str(tmp_path / "sum_a"), "base"))
+        baseline.optimize()
+        base_losses = dict(baseline.train_summary.read_scalar("Loss"))
+
+        root = str(tmp_path / "ck")
+        o = make_optimizer(feed_depth=2)
+        o.set_checkpoint(root, Trigger.several_iteration(4))
+        o.set_chaos(StepFaultInjector(fail_steps=(13,)))
+        o.set_fault_tolerance(max_restarts=0)
+        with pytest.raises(ChaosStepFault):
+            o.optimize()
+
+        RandomGenerator.set_seed(999)
+        o2 = optim.LocalOptimizer(nn.Sequential(nn.Linear(8, 4)),
+                                  make_dataset(), nn.MSECriterion(),
+                                  optim_method=SGD(learning_rate=0.05),
+                                  end_trigger=Trigger.max_epoch(3))
+        o2.set_feed(2)
+        o2.set_train_summary(TrainSummary(str(tmp_path / "sum_b"), "res"))
+        o2.resume_from(root)
+        o2.optimize()
+        res_losses = dict(o2.train_summary.read_scalar("Loss"))
+        assert res_losses, "resumed run logged no losses"
+        for step, loss in res_losses.items():
+            assert loss == base_losses[step], (
+                f"step {step}: resumed loss {loss!r} != "
+                f"uninterrupted {base_losses[step]!r}")
+
+    def test_transient_fault_self_heals_in_place(self, tmp_path):
+        """once=True models a transient fault: the bounded retry loop
+        restores from the latest commit and the SAME run converges to the
+        uninterrupted trajectory — no external resume needed."""
+        baseline = make_optimizer()
+        base_leaves = param_leaves_after(baseline)
+
+        o = make_optimizer()
+        o.set_checkpoint(str(tmp_path / "ck"), Trigger.several_iteration(4))
+        chaos = StepFaultInjector(fail_steps=(10,), once=True)
+        o.set_chaos(chaos)
+        o.set_fault_tolerance(max_restarts=2, backoff_base_s=0.0)
+        o.optimize()
+        assert chaos.fired == [10]
+        assert_bitwise_equal(base_leaves, param_leaves(o))
+
+    def test_persistent_fault_exhausts_restart_budget(self, tmp_path):
+        o = make_optimizer()
+        o.set_checkpoint(str(tmp_path / "ck"), Trigger.several_iteration(4))
+        o.set_chaos(StepFaultInjector(fail_steps=(10,), once=False))
+        o.set_fault_tolerance(max_restarts=2, backoff_base_s=0.0)
+        with pytest.raises(ChaosStepFault):
+            o.optimize()
+
+    def test_seeded_injector_is_reproducible(self):
+        a = StepFaultInjector(seed=5, horizon=100, n_faults=3)
+        b = StepFaultInjector(seed=5, horizon=100, n_faults=3)
+        assert a.fail_steps == b.fail_steps and len(a.fail_steps) == 3
+        assert 0 not in a.fail_steps
+
+
+def param_leaves_after(o):
+    o.optimize()
+    return param_leaves(o)
+
+
+# ----------------------------------------------------------------------
+# preemption: simulated, signal, and file channels
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestPreemption:
+    def test_simulated_preemption_saves_marker_and_resumes(self, tmp_path):
+        baseline = make_optimizer()
+        base_leaves = param_leaves_after(baseline)
+
+        root = str(tmp_path / "ck")
+        guard = PreemptionGuard(signals=())
+        o = make_optimizer()
+        o.set_checkpoint(root, Trigger.several_iteration(4))
+        o.set_preemption(guard)
+        o.set_chaos(SimulatedPreemption(guard, at_step=11))
+        with pytest.raises(Preempted) as exc:
+            o.optimize()
+        # the trigger lands at step 11; the loop observes it at the NEXT
+        # batch boundary, so the final sync save is at step 12 — the exact
+        # current step, not the last periodic trigger (step 8)
+        assert exc.value.step == 12
+        assert committed_steps(root)[-1] == 12
+        marker = read_marker(root)
+        assert marker is not None and marker["resumable"]
+        assert marker["step"] == 12
+        assert marker["checkpoint"].endswith("ckpt_12")
+
+        RandomGenerator.set_seed(999)
+        o2 = optim.LocalOptimizer(nn.Sequential(nn.Linear(8, 4)),
+                                  make_dataset(), nn.MSECriterion(),
+                                  optim_method=SGD(learning_rate=0.05),
+                                  end_trigger=Trigger.max_epoch(3))
+        o2.resume_from(root)
+        o2.optimize()
+        assert_bitwise_equal(base_leaves, param_leaves(o2))
+        # a clean finish retires the marker
+        assert read_marker(root) is None
+
+    def test_sigterm_triggers_clean_preemption(self, tmp_path):
+        """A real SIGTERM mid-training (the preemptible-pool eviction
+        notice) exits through the same final-save + marker path."""
+
+        class _Sigterm:
+            def __init__(self, at_step):
+                self.at_step, self.fired = at_step, False
+
+            def on_step(self, step):
+                if not self.fired and step >= self.at_step:
+                    self.fired = True
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+        root = str(tmp_path / "ck")
+        o = make_optimizer()
+        o.set_checkpoint(root, Trigger.several_iteration(4))
+        o.set_preemption(True)  # default guard: installs the handlers
+        o.set_chaos(_Sigterm(at_step=9))
+        with pytest.raises(Preempted) as exc:
+            o.optimize()
+        assert "SIGTERM" in exc.value.reason
+        assert committed_steps(root)[-1] == exc.value.step
+        assert read_marker(root)["resumable"]
+        # optimize()'s finally uninstalled the handler
+        assert signal.getsignal(signal.SIGTERM) != o._preempt_guard._on_signal
+
+    def test_preempt_file_channel(self, tmp_path):
+        flag = str(tmp_path / "evict-me")
+        root = str(tmp_path / "ck")
+
+        class _Touch:
+            def __init__(self, at_step):
+                self.at_step = at_step
+
+            def on_step(self, step):
+                if step >= self.at_step and not os.path.exists(flag):
+                    open(flag, "w").close()
+
+        guard = PreemptionGuard(signals=(), preempt_file=flag,
+                                poll_interval_s=0.0)
+        o = make_optimizer()
+        o.set_checkpoint(root, Trigger.several_iteration(4))
+        o.set_preemption(guard)
+        o.set_chaos(_Touch(at_step=9))
+        with pytest.raises(Preempted) as exc:
+            o.optimize()
+        assert flag in exc.value.reason
+        assert read_marker(root)["resumable"]
+
+
+# ----------------------------------------------------------------------
+# serving: promote a trainer checkpoint into the registry
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_register_from_checkpoint(tmp_path):
+    from bigdl_tpu.serving import ModelRegistry
+
+    root = str(tmp_path / "ck")
+    o = make_optimizer(epochs=2)
+    o.set_checkpoint(root, Trigger.every_epoch())
+    o.optimize()
+    steps = committed_steps(root)
+    assert steps
+
+    reg = ModelRegistry()
+    reg.register("v0", o.params, o.model_state or {})
+    # root path resolves to the newest COMMITTED step; version defaults to
+    # the resolved dir's basename
+    mv = reg.register_from_checkpoint(root)
+    assert mv.version == f"ckpt_{steps[-1]}"
+    assert reg.active_version == mv.version
+    for a, b in zip(jax.tree_util.tree_leaves(o.params),
+                    jax.tree_util.tree_leaves(mv.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # an explicit ckpt_<step> dir registers directly
+    mv2 = reg.register_from_checkpoint(
+        os.path.join(root, f"ckpt_{steps[0]}"), version="rollback",
+        activate=False)
+    assert mv2.version == "rollback" and reg.active_version == mv.version
+    with pytest.raises(FileNotFoundError):
+        reg.register_from_checkpoint(str(tmp_path / "empty"))

@@ -19,6 +19,18 @@ timeout --signal=TERM "$BUDGET" python -m pytest tests/ -m "not slow" -q
 rc=$?
 elapsed=$(( $(date +%s) - start ))
 
+if [ "$rc" -eq 0 ]; then
+    # chaos lane: the deterministic fault-injection tests get their own
+    # visible pass/fail line (a broken recovery path must not hide in the
+    # bulk tier's dots) and run inside the same wall-clock budget
+    remaining=$(( BUDGET - elapsed ))
+    [ "$remaining" -lt 30 ] && remaining=30
+    timeout --signal=TERM "$remaining" python -m pytest tests/test_resilience.py \
+        -m "chaos and not slow" -q
+    rc=$?
+    elapsed=$(( $(date +%s) - start ))
+fi
+
 if [ "$rc" -eq 124 ]; then
     echo "FAIL: quick tier exceeded the ${BUDGET}s budget (killed)" >&2
     exit 1
