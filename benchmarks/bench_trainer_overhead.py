@@ -318,12 +318,94 @@ def ckpt_ab(iters=ITERS):
     return rows
 
 
+def lint_hotpath_ab(iters=ITERS):
+    """A-B of the tpu_lint host-sync fixes (bigdl_tpu.analysis): each
+    "before" leg re-injects the exact pattern the linter flagged, the
+    "after" leg runs the shipped code path.
+
+      * predict loop: pre-fix per-batch `np.asarray(y)` (one full device
+        sync per batch) vs device slices + ONE `jax.device_get` epilogue;
+      * trainer host-lr path: pre-fix per-step `float(self._current_lr())`
+        device pull vs the Plateau host-side mirror (`host_value`), where
+        the device scalar is put once per lr CHANGE.
+    """
+    from bigdl_tpu.optim.predictor import Predictor
+    from bigdl_tpu.optim.schedules import Plateau
+
+    DIM = 64
+    rs = np.random.RandomState(0)
+    model = nn.Sequential(nn.Linear(DIM, 128), nn.ReLU(),
+                          nn.Linear(128, NCLS), nn.LogSoftMax())
+    params, state, _ = model.build(jax.random.PRNGKey(0), (BATCH, DIM))
+    pred = Predictor(model, params, state, batch_size=BATCH,
+                     prefetch_depth=0)  # inline staging: fair vs `before`
+    data = rs.randn(iters * BATCH, DIM).astype(np.float32)
+
+    def predict_before():
+        # the pre-fix Predictor.predict body: host sync EVERY batch
+        outs = []
+        for off in range(0, data.shape[0], BATCH):
+            xd = pred._put(data[off:off + BATCH])
+            y = pred._fwd(pred.params, pred.state, xd)
+            outs.append(np.asarray(y))
+        return np.concatenate(outs, axis=0)
+
+    def predict_after():
+        return pred.predict(data)
+
+    predict_before(), predict_after()  # warm the compile
+    t0 = time.perf_counter()
+    a = predict_before()
+    t_before = (time.perf_counter() - t0) / iters
+    t0 = time.perf_counter()
+    b = predict_after()
+    t_after = (time.perf_counter() - t0) / iters
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+    print(json.dumps({"path": "lint_predict_per_batch_sync", "fixed": False,
+                      "ms_per_batch": round(t_before * 1e3, 3)}))
+    print(json.dumps({"path": "lint_predict_device_accumulate", "fixed": True,
+                      "ms_per_batch": round(t_after * 1e3, 3)}))
+
+    import bigdl_tpu.optim.optimizer as om
+
+    def lr_run(emulate_prefix):
+        RandomGenerator.set_seed(7)
+        rs2 = np.random.RandomState(0)
+        x = rs2.randn(BATCH, HW, HW, CIN).astype(np.float32)
+        y = (np.arange(BATCH) % NCLS).astype(np.int32)
+        ds = _RepeatDataSet(MiniBatch(jnp.asarray(x), jnp.asarray(y)), iters)
+        o = optim_mod.DistriOptimizer(
+            _model(), ds, nn.ClassNLLCriterion(),
+            optim_method=SGD(learning_rate=0.01, schedule=Plateau()),
+            end_trigger=Trigger.max_iteration(iters))
+        saved = om.Optimizer._current_lr_host
+        if emulate_prefix:
+            om.Optimizer._current_lr_host = \
+                lambda self: float(self._current_lr())
+        try:
+            o.optimize()  # warm: compiles the step + telemetry-ring write
+            o.end_when = Trigger.max_iteration(2 * iters)
+            t0 = time.perf_counter()
+            o.optimize()
+            return (time.perf_counter() - t0) / iters
+        finally:
+            om.Optimizer._current_lr_host = saved
+
+    for fixed in (False, True):
+        per = min(lr_run(emulate_prefix=not fixed) for _ in range(2))
+        print(json.dumps({"path": "lint_hostlr_device_pull" if not fixed
+                          else "lint_hostlr_host_mirror", "fixed": fixed,
+                          "ms_per_step": round(per * 1e3, 2)}))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--feed-only", action="store_true",
                     help="run just the DeviceFeed A-B (quick capture mode)")
     ap.add_argument("--ckpt", action="store_true",
                     help="run just the sync/async checkpoint A-B")
+    ap.add_argument("--lint-hotpath", action="store_true",
+                    help="A-B the tpu_lint host-sync fixes (quick capture)")
     ap.add_argument("--iters", type=int, default=ITERS)
     args = ap.parse_args(argv)
     if args.feed_only:
@@ -331,6 +413,9 @@ def main(argv=None):
         return
     if args.ckpt:
         ckpt_ab(args.iters)
+        return
+    if args.lint_hotpath:
+        lint_hotpath_ab(args.iters)
         return
     lat, rere = measure_readback_latency()
     print(json.dumps({"metric": "env_readback_latency_ms",

@@ -1,0 +1,37 @@
+"""bigdl_tpu.analysis: TPU-hostile-pattern linter + runtime sanitizers.
+
+Static side: `analyze_paths` / `analyze_sources` run six AST rule
+families (host-sync, recompile, tracer-leak, concurrency, donation,
+blocking-io) over the tree; `tools/tpu_lint.py` is the CLI and CI
+gate.  Runtime side: `strict_transfers` wraps hot sections in
+`jax.transfer_guard("disallow")` so implicit transfers fail loudly
+(env `BIGDL_TPU_STRICT_TRANSFERS`).  See docs/analysis.md.
+"""
+
+from bigdl_tpu.analysis.linter import (
+    DEFAULT_HOT_ROOTS,
+    Finding,
+    HOT_PATH_RULES,
+    RULES,
+    analyze_paths,
+    analyze_sources,
+    iter_python_files,
+)
+from bigdl_tpu.analysis.runtime import (
+    ENV_FLAG,
+    strict_transfers,
+    strict_transfers_enabled,
+)
+
+__all__ = [
+    "DEFAULT_HOT_ROOTS",
+    "ENV_FLAG",
+    "Finding",
+    "HOT_PATH_RULES",
+    "RULES",
+    "analyze_paths",
+    "analyze_sources",
+    "iter_python_files",
+    "strict_transfers",
+    "strict_transfers_enabled",
+]

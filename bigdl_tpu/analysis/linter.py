@@ -1,0 +1,1298 @@
+"""TPU-hostile-pattern linter: AST static analysis over the framework.
+
+The paper's value proposition is hot paths that stay on the accelerator;
+the JAX/XLA failure modes that silently break it are host syncs inside
+the step/serve/feed loops, avoidable retraces, tracer leaks into Python
+state, data races in the background threads, donated buffers read after
+the donating call, and blocking I/O under trace.  This module is the
+mechanical gate: `tools/tpu_lint.py` runs it over the tree in CI.
+
+Six rule families (ids are what `# tpu-lint: disable=<rule>` takes):
+
+  host-sync    d2h pulls (float/int/bool/.item/.tolist/np.*) of device
+               values in hot-path functions, and if/while on traced
+               values inside jitted code
+  recompile    jitted closures that read `self` at trace time (stale
+               closure + retrace hazard) and Python host scalars passed
+               to jitted callables inside hot loops (implicit h2d +
+               weak-type retrace)
+  tracer-leak  traced values stored on `self`, module globals, or
+               captured containers from inside jitted code
+  concurrency  threads with neither daemon nor join, unbounded
+               queue.put/get/join on shutdown paths, shared mutable
+               containers touched by both worker and driver methods
+               without a lock
+  donation     donated buffers read after the donating call
+  blocking-io  open/sleep/subprocess/sockets inside jitted code or
+               inside loops of hot-path functions
+
+The analysis is a per-function taint walk (DEV / HOST / UNK lattice)
+plus name-level cross-file summaries (`returns_device`,
+`syncing_params`) iterated to a small fixpoint — precise enough to
+catch `float(self._current_lr())` through two calls while staying
+quiet on `int(self._resume_skip or 0)`.  Precision choices that keep
+the false-positive rate workable on this codebase:
+
+  * explicit transfer APIs (`jax.device_get` / `jax.device_put`) are
+    always sanctioned — they are the documented way to cross the
+    boundary and the runtime transfer guard allows them too;
+  * a sink on a DEV value fires anywhere in a hot or jitted function;
+    a sink on an UNK value fires only inside a lexical `for` loop of a
+    hot function (per-step pulls are the expensive ones; one-shot
+    pulls of unknowns at setup/teardown are noise);
+  * `self.<attr>` loads are UNK, so host bookkeeping reads stay quiet
+    while method calls with a device-returning summary still taint.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+RULES = (
+    "host-sync",
+    "recompile",
+    "tracer-leak",
+    "concurrency",
+    "donation",
+    "blocking-io",
+)
+
+# Rules that guard the hot path itself: a finding is a live perf/correctness
+# bug, so the committed baseline may never carry one (CLI enforces).
+HOT_PATH_RULES = frozenset({"host-sync", "tracer-leak", "donation"})
+
+# Functions reachable from these qualnames are "hot": their per-call cost
+# multiplies by steps/requests/batches.  Same-module callees inherit the
+# flag (depth-bounded BFS below).
+DEFAULT_HOT_ROOTS = (
+    r"Optimizer\._optimize_impl$",
+    r"Optimizer\.validate$",
+    r"ParallelOptimizer\._optimize_impl$",
+    r"Predictor\.predict$",
+    r"Evaluator\.test$",
+    r"ServingRuntime\._dispatch$",
+    r"MicroBatcher\._loop$",
+    r"DeviceFeed\._worker$",
+    r"DeviceFeed\.__next__$",
+    r"InlineFeed\.__next__$",
+    r"AsyncCheckpointer\._run$",
+)
+
+_HOT_PROPAGATION_DEPTH = 3
+_SUPPRESS_RE = re.compile(r"#\s*tpu-lint:\s*disable=([\w\-,\s]+)")
+
+_SYNC_BUILTINS = {"float", "int", "bool"}
+_SYNC_METHODS = {"item", "tolist"}
+# numpy entry points that force a d2h copy when handed a jax array
+_NP_ROOTS = {"np", "numpy"}
+_JNP_ROOTS = {"jnp"}
+_BLOCKING_CALLS = {
+    "open", "input",
+    "time.sleep",
+    "os.system", "os.popen",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "requests.get", "requests.post", "requests.put", "requests.request",
+    "urllib.request.urlopen",
+    "socket.socket", "socket.create_connection",
+}
+# loggers are async-ish and deliberate; never blocking-io findings
+_BLOCKING_EXEMPT_ROOTS = {"logger", "logging"}
+# stdlib roots whose calls produce host values (kills the
+# `int(_STEP_RE.match(name).group(1))` class of false positives)
+_HOST_ROOTS = {
+    "os", "time", "re", "json", "math", "random", "itertools",
+    "functools", "collections", "string", "pathlib", "logging", "sys",
+    "io", "struct", "pickle", "hashlib", "glob", "shutil", "tempfile",
+    "threading", "queue", "dataclasses", "copy", "warnings", "enum",
+    "len", "range", "enumerate", "zip", "sorted", "min", "max", "sum",
+    "abs", "str", "repr", "list", "dict", "set", "tuple", "frozenset",
+    "isinstance", "hasattr", "getattr", "type", "id", "deque",
+}
+# numpy entry points that WRITE rather than convert: their transfer is
+# the deliberate spill (async checkpoint writer), not a hot-loop sync
+_NP_WRITERS = {"savez", "savez_compressed", "save", "load", "errstate",
+               "seterr"}
+# jax APIs that return plain host values (topology queries, config) —
+# without this, `if jax.process_count() > 1:` reads as a device branch
+_JAX_HOST_CALLS = {
+    "jax.process_count", "jax.process_index", "jax.device_count",
+    "jax.local_device_count", "jax.devices", "jax.local_devices",
+    "jax.default_backend",
+}
+
+
+# ---------------------------------------------------------------------------
+# taint lattice
+# ---------------------------------------------------------------------------
+
+class TS:
+    """Taint state: kind in {DEV, HOST, UNK} + originating param indices."""
+
+    __slots__ = ("kind", "params")
+
+    def __init__(self, kind: str, params: frozenset = frozenset()):
+        self.kind = kind
+        self.params = params
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"TS({self.kind},{sorted(self.params)})"
+
+
+def _join(a: TS, b: TS) -> TS:
+    if a.kind == "DEV" or b.kind == "DEV":
+        kind = "DEV"
+    elif a.kind == "UNK" or b.kind == "UNK":
+        kind = "UNK"
+    else:
+        kind = "HOST"
+    return TS(kind, a.params | b.params)
+
+
+_HOST = TS("HOST")
+_UNK = TS("UNK")
+_DEV = TS("DEV")
+
+
+# ---------------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    func: str
+    message: str
+    code: str = ""
+
+    def fingerprint(self) -> str:
+        """Stable id: survives line shifts (no line number), breaks when the
+        offending code itself changes — the baseline then forces a re-look."""
+        key = f"{self.rule}|{self.path}|{self.func}|{self.code.strip()}"
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        return f"{loc}: {self.rule} [{self.func}] {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# module indexing
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FuncInfo:
+    qualname: str
+    name: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    path: str
+    class_name: Optional[str]
+    parent: Optional["FuncInfo"]
+    is_jit: bool = False
+    donate: Tuple[int, ...] = ()
+    hot: bool = False
+    # summaries (fixpoint over the project)
+    returns_device: bool = False
+    returns_host: bool = False
+    syncing_params: Set[int] = field(default_factory=set)
+    calls: Set[str] = field(default_factory=set)  # bare callee names
+
+
+def _attr_chain(node: ast.AST) -> Optional[str]:
+    """'jax.random.fold_in' for nested Attribute/Name, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> Tuple[bool, Tuple[int, ...]]:
+    """Is `node` a jit-producing expression?  Returns (is_jit, donate)."""
+    if isinstance(node, ast.Call):
+        chain = _attr_chain(node.func)
+        if chain in ("jax.jit", "jit", "pjit", "jax.pjit", "partial",
+                     "functools.partial"):
+            inner_jit = chain not in ("partial", "functools.partial")
+            if not inner_jit and node.args:
+                inner_jit, _ = _is_jit_expr(node.args[0])
+            donate: Tuple[int, ...] = ()
+            for kw in node.keywords:
+                if kw.arg in ("donate_argnums", "donate_argnames") and \
+                        isinstance(kw.value, (ast.Tuple, ast.List)):
+                    donate = tuple(
+                        e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, int))
+                elif kw.arg == "donate_argnums" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, int):
+                    donate = (kw.value.value,)
+            return inner_jit, donate
+        if chain in ("jax.shard_map", "shard_map", "jax.experimental."
+                     "shard_map.shard_map", "jax.pmap", "pmap"):
+            # traced like jit for the purposes of tracer/self rules
+            return True, ()
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        chain = _attr_chain(node)
+        if chain in ("jax.jit", "jit", "pjit", "jax.pjit"):
+            return True, ()
+    return False, ()
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """One pass per file: functions with qualnames, jit marks, suppressions
+    already parsed by the caller, thread/queue bookkeeping for the
+    concurrency rules."""
+
+    def __init__(self, path: str, tree: ast.Module):
+        self.path = path
+        self.functions: List[FuncInfo] = []
+        self._stack: List[FuncInfo] = []
+        self._class: List[str] = []
+        # name (local or attr tail) -> donated indices, for call-site checks
+        self.donated_names: Dict[str, Tuple[int, ...]] = {}
+        self.jit_names: Set[str] = set()
+        self.visit(tree)
+        self._mark_wrapped(tree)
+
+    # -- function collection ------------------------------------------------
+    def _qualname(self, name: str) -> str:
+        bits = list(self._class)
+        bits += [f.name for f in self._stack]
+        bits.append(name)
+        return ".".join(bits)
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._class.append(node.name)
+        self.generic_visit(node)
+        self._class.pop()
+
+    def _visit_func(self, node):
+        is_jit, donate = False, ()
+        for dec in node.decorator_list:
+            j, d = _is_jit_expr(dec)
+            if j:
+                is_jit, donate = True, d
+        info = FuncInfo(
+            qualname=self._qualname(node.name), name=node.name, node=node,
+            path=self.path,
+            class_name=self._class[-1] if self._class else None,
+            parent=self._stack[-1] if self._stack else None,
+            is_jit=is_jit, donate=donate)
+        self.functions.append(info)
+        self._stack.append(info)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # -- wrapped jit: `f2 = jax.jit(f)`, `return jax.jit(f, ...)` -----------
+    def _mark_wrapped(self, tree: ast.Module):
+        by_name: Dict[str, List[FuncInfo]] = {}
+        for f in self.functions:
+            by_name.setdefault(f.name, []).append(f)
+
+        def mark(call: ast.Call, target: Optional[str]):
+            is_jit, donate = _is_jit_expr(call)
+            if not is_jit:
+                return
+            args = call.args
+            if _attr_chain(call.func) in ("partial", "functools.partial"):
+                args = call.args[1:]
+            wrapped = args[0] if args else None
+            if isinstance(wrapped, ast.Name) and wrapped.id in by_name:
+                for f in by_name[wrapped.id]:
+                    f.is_jit = True
+                    f.donate = f.donate or donate
+            if target:
+                self.jit_names.add(target)
+                if donate:
+                    self.donated_names[target] = donate
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                for t in node.targets:
+                    name = None
+                    if isinstance(t, ast.Name):
+                        name = t.id
+                    elif isinstance(t, ast.Attribute):
+                        name = t.attr  # self._fwd = jax.jit(...)
+                    mark(node.value, name)
+            elif isinstance(node, ast.Return) and \
+                    isinstance(node.value, ast.Call):
+                mark(node.value, None)
+            elif isinstance(node, ast.Call):
+                mark(node, None)
+
+
+def _parse_suppressions(text: str) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            out[i] = rules
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the per-function rule walker
+# ---------------------------------------------------------------------------
+
+class _FuncWalker:
+    """Taint walk over one function body, emitting findings.
+
+    Flow-insensitive within a statement list (assignments update the env
+    in textual order); loop bodies are walked twice so second-iteration
+    hazards (donated buffer reuse, cached device values) are seen."""
+
+    def __init__(self, proj: "Project", idx: _ModuleIndex, info: FuncInfo):
+        self.proj = proj
+        self.idx = idx
+        self.info = info
+        self.findings: List[Finding] = []
+        self.env: Dict[str, TS] = {}
+        self.for_depth = 0
+        self.loop_depth = 0
+        self.lock_depth = 0
+        self.return_state: TS = _HOST
+        self.param_sinks: Set[int] = set()
+        node = info.node
+        self.env.update(getattr(idx, "module_env", {}))
+        args = getattr(node, "args", None)
+        self.param_names: List[str] = []
+        if args is not None:
+            all_args = list(args.posonlyargs) + list(args.args)
+            for i, a in enumerate(all_args):
+                self.param_names.append(a.arg)
+                base = _DEV if info.is_jit else _UNK
+                self.env[a.arg] = TS(base.kind, frozenset({i}))
+            for a in list(args.kwonlyargs):
+                self.env[a.arg] = _UNK
+
+    # -- driver -------------------------------------------------------------
+    def run(self):
+        body = getattr(self.info.node, "body", [])
+        self.walk_stmts(body)
+        return self
+
+    def emit(self, rule: str, node: ast.AST, message: str):
+        line = getattr(node, "lineno", 0)
+        src = self.proj.source_lines.get(self.info.path, [])
+        code = src[line - 1] if 0 < line <= len(src) else ""
+        self.findings.append(Finding(
+            rule=rule, path=self.info.path, line=line,
+            col=getattr(node, "col_offset", 0), func=self.info.qualname,
+            message=message, code=code))
+
+    # -- statements ---------------------------------------------------------
+    def walk_stmts(self, stmts: Sequence[ast.stmt]):
+        for st in stmts:
+            self.walk_stmt(st)
+
+    def walk_stmt(self, st: ast.stmt):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # nested defs get their own walker
+        if isinstance(st, ast.Assign):
+            val = self.eval_expr(st.value)
+            for t in st.targets:
+                self.assign(t, val, st)
+            return
+        if isinstance(st, ast.AugAssign):
+            val = _join(self.eval_expr(st.value),
+                        self.eval_expr(st.target))
+            self.assign(st.target, val, st)
+            return
+        if isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self.assign(st.target, self.eval_expr(st.value), st)
+            return
+        if isinstance(st, ast.Return):
+            if st.value is not None:
+                self.return_state = _join(self.return_state,
+                                          self.eval_expr(st.value))
+            return
+        if isinstance(st, ast.Expr):
+            self.eval_expr(st.value)
+            return
+        if isinstance(st, ast.If):
+            self.check_branch(st.test)
+            self.eval_expr(st.test)
+            self.walk_stmts(st.body)
+            self.walk_stmts(st.orelse)
+            return
+        if isinstance(st, ast.While):
+            self.check_branch(st.test)
+            self.eval_expr(st.test)
+            self.loop_depth += 1
+            self.walk_stmts(st.body)
+            self.walk_stmts(st.body)  # loop reentry
+            self.loop_depth -= 1
+            self.walk_stmts(st.orelse)
+            return
+        if isinstance(st, ast.For):
+            it = self.eval_expr(st.iter)
+            self.assign(st.target, TS(it.kind, it.params), st)
+            self.for_depth += 1
+            self.loop_depth += 1
+            self.walk_stmts(st.body)
+            self.walk_stmts(st.body)  # loop reentry
+            self.loop_depth -= 1
+            self.for_depth -= 1
+            self.walk_stmts(st.orelse)
+            return
+        if isinstance(st, ast.With):
+            locky = any(
+                "lock" in (_attr_chain(item.context_expr.func
+                           if isinstance(item.context_expr, ast.Call)
+                           else item.context_expr) or "").lower()
+                for item in st.items)
+            for item in st.items:
+                v = self.eval_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self.assign(item.optional_vars, v, st)
+            if locky:
+                self.lock_depth += 1
+            self.walk_stmts(st.body)
+            if locky:
+                self.lock_depth -= 1
+            return
+        if isinstance(st, ast.Try):
+            self.walk_stmts(st.body)
+            for h in st.handlers:
+                self.walk_stmts(h.body)
+            self.walk_stmts(st.orelse)
+            self.walk_stmts(st.finalbody)
+            return
+        if isinstance(st, (ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self.eval_expr(child)
+            return
+        # Pass/Break/Continue/Import/Global/Nonlocal/Delete: nothing to taint
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self.eval_expr(child)
+
+    def assign(self, target: ast.expr, val: TS, st: ast.stmt):
+        if isinstance(target, ast.Name):
+            self.env[target.id] = val
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self.assign(e, TS(val.kind, val.params), st)
+            return
+        if isinstance(target, ast.Attribute):
+            # tracer-leak: storing a traced value on long-lived state from
+            # inside jitted code leaks the tracer out of the trace
+            if self.info.is_jit and val.kind == "DEV":
+                self.emit("tracer-leak", st,
+                          "traced value stored on "
+                          f"`{_attr_chain(target) or 'attribute'}` inside "
+                          "jitted code — the tracer escapes the trace")
+            self.eval_expr(target.value)
+            return
+        if isinstance(target, ast.Subscript):
+            if self.info.is_jit and val.kind == "DEV":
+                base = _attr_chain(target.value)
+                if base is None or not self._is_local(target.value):
+                    self.emit("tracer-leak", st,
+                              "traced value stored into captured container "
+                              "inside jitted code")
+            self.eval_expr(target.value)
+            return
+
+    def _is_local(self, node: ast.expr) -> bool:
+        return isinstance(node, ast.Name) and node.id in self.env
+
+    # -- branches -----------------------------------------------------------
+    def _branch_taint(self, test: ast.expr) -> TS:
+        """Taint of a branch condition, looking THROUGH comparisons and
+        boolean combinators: eval_expr deliberately types `a == b` as HOST
+        (flagging every comparison is noise), but at a branch site the
+        comparison's device operands are what gets concretized."""
+        if isinstance(test, ast.Compare):
+            out = self.eval_expr(test.left)
+            for c in test.comparators:
+                out = _join(out, self.eval_expr(c))
+            return out
+        if isinstance(test, ast.BoolOp):
+            out = _HOST
+            for v in test.values:
+                out = _join(out, self._branch_taint(v))
+            return out
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._branch_taint(test.operand)
+        return self.eval_expr(test)
+
+    def check_branch(self, test: ast.expr):
+        st = self._branch_taint(test)
+        if self.info.is_jit and st.kind == "DEV":
+            self.emit("host-sync", test,
+                      "Python branch on a traced value inside jitted code "
+                      "(forces concretization; trace error or silent "
+                      "constant-fold)")
+        elif self.info.hot and st.kind == "DEV":
+            self.emit("host-sync", test,
+                      "branch on a device value in a hot-path function "
+                      "(implicit bool() device sync)")
+
+    # -- expressions --------------------------------------------------------
+    def eval_expr(self, node: ast.expr) -> TS:
+        if isinstance(node, ast.Call):
+            return self.eval_call(node)
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, _UNK)
+        if isinstance(node, ast.Constant):
+            return _HOST
+        if isinstance(node, ast.Attribute):
+            base = self.eval_expr(node.value)
+            chain = _attr_chain(node)
+            if chain and chain.split(".")[0] in _JNP_ROOTS:
+                return _DEV
+            if isinstance(node.value, ast.Name) and \
+                    node.value.id == "self" and self.info.class_name:
+                cls_env = getattr(self.idx, "class_envs", {}).get(
+                    self.info.class_name, {})
+                if node.attr in cls_env:
+                    return cls_env[node.attr]
+            if base.kind == "DEV" and node.attr in ("at", "T", "real",
+                                                    "imag", "mT"):
+                return base
+            if base.kind == "DEV" and node.attr in ("shape", "ndim",
+                                                    "dtype", "size",
+                                                    "sharding"):
+                return _HOST  # static metadata, no transfer
+            return TS("UNK", base.params)
+        if isinstance(node, ast.Subscript):
+            base = self.eval_expr(node.value)
+            self.eval_expr(node.slice)
+            return base
+        if isinstance(node, ast.BinOp):
+            return _join(self.eval_expr(node.left),
+                         self.eval_expr(node.right))
+        if isinstance(node, ast.UnaryOp):
+            return self.eval_expr(node.operand)
+        if isinstance(node, ast.BoolOp):
+            out = _HOST
+            for v in node.values:
+                out = _join(out, self.eval_expr(v))
+            return out
+        if isinstance(node, ast.Compare):
+            self.eval_expr(node.left)
+            for c in node.comparators:
+                self.eval_expr(c)
+            return _HOST  # comparison of device values yields a device
+            # bool, but flagging every `==` is noise; branch checks catch
+            # the harmful consumption
+        if isinstance(node, ast.IfExp):
+            self.eval_expr(node.test)
+            return _join(self.eval_expr(node.body),
+                         self.eval_expr(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out = _HOST
+            for e in node.elts:
+                out = _join(out, self.eval_expr(e))
+            return out
+        if isinstance(node, ast.Dict):
+            out = _HOST
+            for k, v in zip(node.keys, node.values):
+                if k is not None:
+                    self.eval_expr(k)
+                out = _join(out, self.eval_expr(v))
+            return out
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            return _UNK  # comprehension envs are their own scope; UNK keeps
+            # the in-loop heuristic from firing on summary math
+        if isinstance(node, ast.Starred):
+            return self.eval_expr(node.value)
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    self.eval_expr(v.value)
+            return _HOST
+        if isinstance(node, ast.Lambda):
+            return _HOST
+        if isinstance(node, ast.NamedExpr):
+            val = self.eval_expr(node.value)
+            self.assign(node.target, val, node)
+            return val
+        return _UNK
+
+    # -- calls (where every rule except concurrency lives) -------------------
+    def eval_call(self, node: ast.Call) -> TS:
+        chain = _attr_chain(node.func)
+        arg_states = [self.eval_expr(a) for a in node.args]
+        for kw in node.keywords:
+            self.eval_expr(kw.value)
+        root = chain.split(".")[0] if chain else None
+
+        # blocking-io
+        if chain in _BLOCKING_CALLS and root not in _BLOCKING_EXEMPT_ROOTS:
+            if self.info.is_jit:
+                self.emit("blocking-io", node,
+                          f"blocking call `{chain}` inside jitted code")
+            elif self.info.hot and self.loop_depth > 0:
+                self.emit("blocking-io", node,
+                          f"blocking call `{chain}` inside a hot-path loop")
+        if chain == "print" and self.info.is_jit:
+            self.emit("blocking-io", node,
+                      "print() inside jitted code (runs at trace time "
+                      "only, or forces a callback)")
+
+        # explicit transfer APIs: sanctioned, never findings
+        if chain in ("jax.device_get",):
+            return _HOST
+        if chain in _JAX_HOST_CALLS:
+            return _HOST
+        if chain in ("jax.device_put",
+                     "jax.make_array_from_process_local_data"):
+            return _DEV
+
+        # host-sync sinks ----------------------------------------------------
+        if chain in _SYNC_BUILTINS and len(node.args) >= 1:
+            self._sink(node, arg_states[0], f"{chain}()")
+            return _HOST
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _SYNC_METHODS:
+            obj = self.eval_expr(node.func.value)
+            self._sink(node, obj, f".{node.func.attr}()")
+            return _HOST
+        if root in _NP_ROOTS:
+            if chain.split(".")[-1] not in _NP_WRITERS:
+                for a_st in arg_states:
+                    self._sink(node, a_st, f"{chain}()")
+            return _HOST
+        if chain in ("jax.tree_util.tree_map", "jax.tree.map",
+                     "tree_map") and len(node.args) >= 2:
+            f0 = node.args[0]
+            f0_chain = _attr_chain(f0)
+            if f0_chain and f0_chain.split(".")[0] in _NP_ROOTS:
+                for a_st in arg_states[1:]:
+                    self._sink(node, a_st, f"tree_map({f0_chain}, ...)")
+                return _HOST
+            if f0_chain and f0_chain.split(".")[0] in _JNP_ROOTS:
+                return _DEV
+            return _UNK
+
+        # stdlib / builtin host producers
+        if root in _HOST_ROOTS or chain in _HOST_ROOTS:
+            return _HOST
+
+        # device producers
+        if root in _JNP_ROOTS:
+            return _DEV
+        if root == "jax":
+            return _DEV  # jax.random / jax.lax / jax.nn / grad etc.
+
+        # self/local/method calls: name-level resolution + summaries
+        bname = None
+        if isinstance(node.func, ast.Name):
+            bname = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            bname = node.func.attr
+        infos = self.proj.by_name.get(bname, []) if bname else []
+        jitted_callee = any(f.is_jit for f in infos) or \
+            (bname in self.idx.jit_names)
+        if bname and infos:
+            self.info.calls.add(bname)
+
+        # recompile: host scalar into a known-jitted callable per step.
+        # Only confidently-HOST args fire — UNK covers staged batches and
+        # `self.<attr>` trees that are device-resident at runtime.
+        if jitted_callee and self.info.hot and self.for_depth > 0 \
+                and not self.info.is_jit:
+            for a, a_st in zip(node.args, arg_states):
+                if a_st.kind == "HOST" and not isinstance(a, ast.Constant):
+                    self.emit(
+                        "recompile", node,
+                        f"host value passed to jitted `{bname}` inside "
+                        "a hot loop — implicit h2d put per step (stage "
+                        "with jax.device_put once, or keep it on device)")
+
+        # call-site host-sync through a syncing callee summary
+        if infos and all(f.syncing_params for f in infos):
+            common: Set[int] = set.intersection(
+                *[f.syncing_params for f in infos])
+            for i in common:
+                if i < len(arg_states) and \
+                        arg_states[i].kind == "DEV" and \
+                        (self.info.hot or self.info.is_jit):
+                    self.emit(
+                        "host-sync", node,
+                        f"device value flows into `{bname}` which syncs "
+                        f"its argument {i} to host (float()/int()/"
+                        ".item() in its body)")
+        if jitted_callee or (infos and all(f.returns_device
+                                           for f in infos)):
+            return _DEV  # jitted callables return device values
+        if infos:
+            if all(f.returns_host for f in infos):
+                return _HOST
+            return _UNK
+
+        # method on an object: device stays device, host stays host
+        if isinstance(node.func, ast.Attribute):
+            obj = self.eval_expr(node.func.value)
+            if obj.kind == "DEV":
+                return TS("DEV", obj.params)
+            if obj.kind == "HOST":
+                return _HOST
+            return _UNK
+        return _UNK
+
+    def _sink(self, node: ast.AST, st: TS, what: str):
+        if st.kind == "DEV" and (self.info.hot or self.info.is_jit):
+            where = "jitted code" if self.info.is_jit else \
+                "a hot-path function"
+            self.emit("host-sync", node,
+                      f"{what} on a device value in {where} — d2h sync "
+                      "stalls the dispatch pipeline (batch into the "
+                      "one-transfer summary path or use jax.device_get "
+                      "at a sanctioned boundary)")
+        elif st.kind == "UNK" and self.info.hot and self.for_depth > 0 \
+                and not self.info.is_jit:
+            self.emit("host-sync", node,
+                      f"{what} on a possibly-device value inside a "
+                      "hot-path loop — if this is a jax array it is a "
+                      "per-step d2h sync")
+        if st.params:
+            self.param_sinks |= st.params
+
+
+
+# ---------------------------------------------------------------------------
+# concurrency rules (class-granular, not taint-based)
+# ---------------------------------------------------------------------------
+
+def _concurrency_findings(proj: "Project", idx: _ModuleIndex,
+                          tree: ast.Module) -> List[Finding]:
+    findings: List[Finding] = []
+    src = proj.source_lines.get(idx.path, [])
+
+    def mk(rule, node, func, msg):
+        line = getattr(node, "lineno", 0)
+        code = src[line - 1] if 0 < line <= len(src) else ""
+        findings.append(Finding(rule=rule, path=idx.path, line=line,
+                                col=getattr(node, "col_offset", 0),
+                                func=func, message=msg, code=code))
+
+    classes = [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]
+    scopes: List[Tuple[str, List[ast.stmt]]] = [
+        (c.name, c.body) for c in classes]
+    top = [s for s in tree.body
+           if not isinstance(s, (ast.ClassDef,))]
+    scopes.append(("<module>", top))
+
+    for scope_name, body in scopes:
+        scope_src = ast.Module(body=list(body), type_ignores=[])
+        thread_targets: Set[str] = set()      # worker method names
+        thread_creations: List[Tuple[ast.Call, str, bool]] = []
+        queue_attrs: Set[str] = set()
+        joined_names: Set[str] = set()
+        container_attrs: Set[str] = set()
+        # attr -> {method} for container mutations, split by lock coverage
+        mut_by_method: Dict[str, Dict[str, bool]] = {}
+
+        for node in ast.walk(scope_src):
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if chain in ("threading.Thread", "Thread"):
+                    daemon = any(
+                        kw.arg == "daemon" and
+                        isinstance(kw.value, ast.Constant) and
+                        kw.value.value is True
+                        for kw in node.keywords)
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            t = _attr_chain(kw.value)
+                            if t:
+                                thread_targets.add(t.split(".")[-1])
+                    thread_creations.append((node, scope_name, daemon))
+                elif chain and chain.endswith(".join"):
+                    base = _attr_chain(node.func.value) \
+                        if isinstance(node.func, ast.Attribute) else None
+                    if base:
+                        joined_names.add(base.split(".")[-1])
+                elif chain in ("queue.Queue", "Queue", "queue.SimpleQueue",
+                               "SimpleQueue", "queue.LifoQueue"):
+                    pass  # assignment handler below records the attr
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+            if targets and isinstance(node.value, ast.Call):
+                vchain = _attr_chain(node.value.func)
+                for t in targets:
+                    aname = t.attr if isinstance(t, ast.Attribute) else (
+                        t.id if isinstance(t, ast.Name) else None)
+                    if aname is None:
+                        continue
+                    if vchain in ("queue.Queue", "Queue",
+                                  "queue.SimpleQueue", "SimpleQueue",
+                                  "queue.LifoQueue"):
+                        queue_attrs.add(aname)
+                    if vchain in ("list", "dict", "set"):
+                        container_attrs.add(aname)
+            if targets and isinstance(node.value,
+                                      (ast.List, ast.Dict, ast.Set)):
+                for t in targets:
+                    if isinstance(t, ast.Attribute):
+                        container_attrs.add(t.attr)
+
+        # thread without daemon and without any .join in scope
+        for call, sname, daemon in thread_creations:
+            if daemon:
+                continue
+            # the created thread is joined if ANY name in this scope is
+            # joined — name-level, deliberately permissive
+            if joined_names:
+                continue
+            mk("concurrency", call, f"{sname}",
+               "thread created with neither daemon=True nor a join() on "
+               "any shutdown path — leaks past interpreter exit and "
+               "test teardown")
+
+        owns_thread = bool(thread_creations) or bool(thread_targets)
+        if not owns_thread:
+            continue
+
+        def scan_call(e: ast.Call, method_name: str, lock_depth: int):
+            if not isinstance(e.func, ast.Attribute):
+                return
+            base = e.func.value
+            aname = base.attr if isinstance(base, ast.Attribute) \
+                else (base.id if isinstance(base, ast.Name) else None)
+            meth = e.func.attr
+            if aname in queue_attrs:
+                has_bound = any(kw.arg in ("timeout", "block")
+                                for kw in e.keywords) or len(e.args) > 1
+                if meth in ("put", "get") and not has_bound:
+                    mk("concurrency", e, f"{scope_name}.{method_name}",
+                       f"`{aname}.{meth}()` without timeout in a "
+                       "thread-owning class — hangs forever if the peer "
+                       "thread died (bound it and poll aliveness)")
+                if meth == "join" and method_name in (
+                        "close", "stop", "shutdown", "wait",
+                        "__exit__", "__del__"):
+                    mk("concurrency", e, f"{scope_name}.{method_name}",
+                       f"`{aname}.join()` (queue join, no timeout "
+                       "possible) on a shutdown path — replace with a "
+                       "bounded wait on all_tasks_done")
+            if aname in container_attrs and meth in (
+                    "append", "extend", "pop", "remove", "clear",
+                    "update", "add", "insert", "popitem", "setdefault"):
+                d = mut_by_method.setdefault(aname, {})
+                # True == at least one unlocked mutation in this method
+                d[method_name] = d.get(method_name, False) or \
+                    lock_depth == 0
+
+        def walk_method(stmts, method_name: str, lock_depth: int):
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                    continue
+                if isinstance(st, ast.With):
+                    locky = any(
+                        "lock" in (_attr_chain(
+                            i.context_expr.func
+                            if isinstance(i.context_expr, ast.Call)
+                            else i.context_expr) or "").lower()
+                        for i in st.items)
+                    for i in st.items:
+                        for sub in ast.walk(i.context_expr):
+                            if isinstance(sub, ast.Call):
+                                scan_call(sub, method_name, lock_depth)
+                    walk_method(st.body, method_name,
+                                lock_depth + (1 if locky else 0))
+                    continue
+                if isinstance(st, (ast.If, ast.For, ast.While, ast.Try)):
+                    for e in ast.iter_child_nodes(st):
+                        if isinstance(e, ast.expr):
+                            for sub in ast.walk(e):
+                                if isinstance(sub, ast.Call):
+                                    scan_call(sub, method_name, lock_depth)
+                    for block in (getattr(st, "body", []),
+                                  getattr(st, "orelse", []),
+                                  getattr(st, "finalbody", [])):
+                        walk_method(block, method_name, lock_depth)
+                    for h in getattr(st, "handlers", []):
+                        walk_method(h.body, method_name, lock_depth)
+                    continue
+                for sub in ast.walk(st):
+                    if isinstance(sub, ast.Call):
+                        scan_call(sub, method_name, lock_depth)
+
+        for fn in [n for n in body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            walk_method(fn.body, fn.name, 0)
+
+        # shared container mutated by worker AND driver without lock
+        for attr, methods in mut_by_method.items():
+            worker_m = {m for m in methods if m in thread_targets}
+            driver_m = set(methods) - worker_m
+            if worker_m and driver_m:
+                unlocked = [m for m, unl in methods.items() if unl]
+                if unlocked:
+                    first_fn = sorted(methods)[0]
+                    node = next(
+                        (n for n in body
+                         if isinstance(n, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))
+                         and n.name in unlocked), body[0])
+                    mk("concurrency", node,
+                       f"{scope_name}",
+                       f"`self.{attr}` is mutated from worker "
+                       f"({sorted(worker_m)}) and driver "
+                       f"({sorted(driver_m)}) methods; mutation in "
+                       f"{sorted(unlocked)} is not under a lock")
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# project driver
+# ---------------------------------------------------------------------------
+
+class Project:
+    """All files under analysis: indexes, summaries, rule walks."""
+
+    def __init__(self, hot_roots: Optional[Sequence[str]] = None):
+        self.hot_roots = [re.compile(p)
+                          for p in (hot_roots or DEFAULT_HOT_ROOTS)]
+        self.indexes: List[_ModuleIndex] = []
+        self.trees: Dict[str, ast.Module] = {}
+        self.source_lines: Dict[str, List[str]] = {}
+        self.suppressions: Dict[str, Dict[int, Set[str]]] = {}
+        self.by_name: Dict[str, List[FuncInfo]] = {}
+
+    def add_source(self, path: str, text: str):
+        try:
+            tree = ast.parse(text, filename=path)
+        except SyntaxError as e:  # pragma: no cover - defensive
+            raise ValueError(f"{path}: {e}") from e
+        self.trees[path] = tree
+        self.source_lines[path] = text.splitlines()
+        self.suppressions[path] = _parse_suppressions(text)
+        idx = _ModuleIndex(path, tree)
+        self.indexes.append(idx)
+        for f in idx.functions:
+            self.by_name.setdefault(f.name, []).append(f)
+
+    # -- hot propagation ----------------------------------------------------
+    def _mark_hot(self):
+        for idx in self.indexes:
+            for f in idx.functions:
+                if any(p.search(f.qualname) for p in self.hot_roots):
+                    f.hot = True
+        # nested defs inherit the enclosing function's heat
+        changed = True
+        while changed:
+            changed = False
+            for idx in self.indexes:
+                for f in idx.functions:
+                    if not f.hot and f.parent is not None and f.parent.hot:
+                        f.hot = True
+                        changed = True
+        # same-module callee propagation, depth-bounded
+        for _ in range(_HOT_PROPAGATION_DEPTH):
+            spread = False
+            for idx in self.indexes:
+                local = {f.name: f for f in idx.functions}
+                for f in idx.functions:
+                    if not f.hot:
+                        continue
+                    for callee in f.calls:
+                        g = local.get(callee)
+                        if g is not None and not g.hot and not g.is_jit:
+                            g.hot = True
+                            spread = True
+            if not spread:
+                break
+
+    # -- run ----------------------------------------------------------------
+    def _module_env(self, idx: _ModuleIndex) -> Dict[str, TS]:
+        """Taint module-level `NAME = expr` bindings so function walks see
+        e.g. `_STEP_RE = re.compile(...)` as HOST and module jit wrappers
+        as device producers."""
+        fake = FuncInfo(qualname="<module>", name="<module>",
+                        node=ast.parse("def _m(): pass").body[0],
+                        path=idx.path, class_name=None, parent=None)
+        w = _FuncWalker(self, idx, fake)
+        env: Dict[str, TS] = {}
+        for st in self.trees[idx.path].body:
+            targets = []
+            if isinstance(st, ast.Assign):
+                targets = st.targets
+            elif isinstance(st, ast.AnnAssign) and st.value is not None:
+                targets = [st.target]
+            else:
+                continue
+            val = w.eval_expr(st.value)
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    env[t.id] = val
+        return env
+
+    def _class_envs(self, idx: _ModuleIndex) -> Dict[str, Dict[str, TS]]:
+        """Taint `self.X = expr` bindings from each class's __init__ so the
+        driver-state dict of host ints reads as HOST and jit-wrapped
+        callables on self read as device producers."""
+        envs: Dict[str, Dict[str, TS]] = {}
+        for f in idx.functions:
+            if f.name != "__init__" or f.class_name is None:
+                continue
+            w = _FuncWalker(self, idx, f)
+            env = envs.setdefault(f.class_name, {})
+            for st in ast.walk(f.node):
+                if isinstance(st, ast.Assign):
+                    targets = st.targets
+                elif isinstance(st, ast.AnnAssign) and st.value is not None:
+                    targets = [st.target]
+                else:
+                    continue
+                val = w.eval_expr(st.value)
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        env[t.attr] = val
+        return envs
+
+    def run(self) -> List[Finding]:
+        # fixpoint over summaries: 3 passes covers the call depth the
+        # codebase actually has (test -> to_result, _current_lr ->
+        # current_lr -> schedule)
+        for _ in range(3):
+            for idx in self.indexes:
+                idx.module_env = self._module_env(idx)
+            for idx in self.indexes:
+                idx.class_envs = self._class_envs(idx)
+            for idx in self.indexes:
+                for f in idx.functions:
+                    w = _FuncWalker(self, idx, f).run()
+                    f.returns_device = w.return_state.kind == "DEV"
+                    f.returns_host = w.return_state.kind == "HOST"
+                    f.syncing_params = w.param_sinks
+            self._mark_hot()
+
+        findings: List[Finding] = []
+        for idx in self.indexes:
+            for f in idx.functions:
+                w = _FuncWalker(self, idx, f).run()
+                self._rule_self_in_jit(w, f)
+                self._rule_donation_callsites(w, idx, f)
+                findings.extend(w.findings)
+            findings.extend(
+                _concurrency_findings(self, idx, self.trees[idx.path]))
+        return self._apply_suppressions(findings)
+
+    def _rule_self_in_jit(self, w: _FuncWalker, f: FuncInfo):
+        """recompile: jitted body reading `self` — the closure is captured
+        at trace time, so any later mutation of the object is silently
+        stale AND unhashable state invites retraces."""
+        if not f.is_jit or "self" in w.param_names:
+            return
+        # walk the WHOLE body, nested defs included: everything lexically
+        # inside a jitted function traces into the same compiled program,
+        # so a `self` read in an inner closure is just as frozen
+        for node in ast.walk(f.node):
+            if isinstance(node, ast.Name) and node.id == "self" and \
+                    isinstance(node.ctx, ast.Load):
+                w.emit("recompile", node,
+                       "jitted code reads `self` at trace time — the "
+                       "value is frozen into the compiled program (stale "
+                       "closure) and retraces can multiply; hoist it to "
+                       "a local before building the step")
+                return  # one per function is enough
+
+    def _rule_donation_callsites(self, w: _FuncWalker, idx: _ModuleIndex,
+                                 f: FuncInfo):
+        """donation: `r = step(a, ...)` where `a` is a donated position and
+        `a` is read again before rebinding.  Implemented as a second walk
+        that tracks textual order + loop reentry (walk_stmt runs loop
+        bodies twice), piggybacking on _FuncWalker.donated_pending."""
+        donated = idx.donated_names
+        local_jit = {g.name: g.donate for g in idx.functions if g.donate}
+        if not donated and not local_jit:
+            return
+
+        pending: Dict[str, Tuple[int, str]] = {}
+
+        def scan_stmts(stmts):
+            for st in stmts:
+                scan(st)
+
+        def process_expr(e: ast.expr):
+            """Reads first (donation check), then record new donations."""
+            for node in ast.walk(e):
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load) and \
+                        node.id in pending:
+                    line, callee = pending.pop(node.id)
+                    w.emit("donation", node,
+                           f"`{node.id}` was donated to `{callee}` "
+                           f"(line {line}) and read again — donated "
+                           "buffers are deallocated after the call; "
+                           "rebind the result or drop donate_argnums")
+            for node in ast.walk(e):
+                if isinstance(node, ast.Call):
+                    name = None
+                    if isinstance(node.func, ast.Name):
+                        name = node.func.id
+                    elif isinstance(node.func, ast.Attribute):
+                        name = node.func.attr
+                    idxs = donated.get(name) or local_jit.get(name)
+                    if idxs:
+                        for i in idxs:
+                            if i < len(node.args) and isinstance(
+                                    node.args[i], ast.Name):
+                                pending[node.args[i].id] = (
+                                    node.lineno, name)
+
+        def clear_targets(targets):
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        pending.pop(sub.id, None)
+
+        def scan(st):
+            # compound statements: only their header expressions are
+            # processed here; bodies recurse so a rebinding assignment
+            # inside a loop clears its own donation before the reentry
+            # walk re-reads the names
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                return
+            if isinstance(st, ast.For):
+                process_expr(st.iter)
+                clear_targets([st.target])
+                scan_stmts(st.body)
+                scan_stmts(st.body)  # reentry: donate in iter 1, read in 2
+                scan_stmts(st.orelse)
+                return
+            if isinstance(st, ast.While):
+                process_expr(st.test)
+                scan_stmts(st.body)
+                scan_stmts(st.body)
+                scan_stmts(st.orelse)
+                return
+            if isinstance(st, ast.If):
+                process_expr(st.test)
+                scan_stmts(st.body)
+                scan_stmts(st.orelse)
+                return
+            if isinstance(st, ast.With):
+                for item in st.items:
+                    process_expr(item.context_expr)
+                scan_stmts(st.body)
+                return
+            if isinstance(st, ast.Try):
+                scan_stmts(st.body)
+                for h in st.handlers:
+                    scan_stmts(h.body)
+                scan_stmts(st.orelse)
+                scan_stmts(st.finalbody)
+                return
+            if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                if getattr(st, "value", None) is not None:
+                    process_expr(st.value)
+                targets = st.targets if isinstance(st, ast.Assign) \
+                    else [st.target]
+                clear_targets(targets)
+                return
+            for node in ast.iter_child_nodes(st):
+                if isinstance(node, ast.expr):
+                    process_expr(node)
+
+        scan_stmts(getattr(f.node, "body", []))
+
+    def _apply_suppressions(self, findings: List[Finding]) -> List[Finding]:
+        out = []
+        seen = set()
+        for fd in findings:
+            key = (fd.rule, fd.path, fd.line, fd.func, fd.message)
+            if key in seen:
+                continue  # the double loop-body walk can duplicate
+            seen.add(key)
+            sup = self.suppressions.get(fd.path, {})
+            rules = sup.get(fd.line, set())
+            if fd.rule in rules or "all" in rules:
+                continue
+            # a suppression on the `def` line covers the whole function
+            f = self._func_at(fd.path, fd.func)
+            if f is not None:
+                def_rules = sup.get(f.node.lineno, set())
+                if fd.rule in def_rules or "all" in def_rules:
+                    continue
+            out.append(fd)
+        out.sort(key=lambda fd: (fd.path, fd.line, fd.rule))
+        return out
+
+    def _func_at(self, path: str, qualname: str) -> Optional[FuncInfo]:
+        for idx in self.indexes:
+            if idx.path != path:
+                continue
+            for f in idx.functions:
+                if f.qualname == qualname:
+                    return f
+        return None
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def analyze_sources(sources: Dict[str, str],
+                    hot_roots: Optional[Sequence[str]] = None
+                    ) -> List[Finding]:
+    """Lint in-memory sources ({path: text}).  Test entry point."""
+    proj = Project(hot_roots=hot_roots)
+    for path, text in sources.items():
+        proj.add_source(path, text)
+    return proj.run()
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", ".git", ".venv")]
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                if name.endswith("_pb2.py") or name.endswith("_pb2_grpc.py"):
+                    continue  # generated protobuf code
+                out.append(os.path.join(root, name))
+    return out
+
+
+def analyze_paths(paths: Sequence[str],
+                  hot_roots: Optional[Sequence[str]] = None
+                  ) -> List[Finding]:
+    proj = Project(hot_roots=hot_roots)
+    for fp in iter_python_files(paths):
+        with open(fp, "r", encoding="utf-8") as fh:
+            proj.add_source(fp, fh.read())
+    return proj.run()

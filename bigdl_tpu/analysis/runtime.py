@@ -1,0 +1,64 @@
+"""Runtime sanitizer: strict-transfer guard for hot sections.
+
+The static linter (bigdl_tpu.analysis.linter) models host syncs it can
+see in the AST; this module is the runtime backstop for the ones it
+can't.  `strict_transfers()` wraps a hot section in
+`jax.transfer_guard("disallow")`, so any IMPLICIT transfer inside —
+`jnp.asarray(py_scalar)`, a Python scalar handed to a jitted call, a
+numpy batch silently put to device mid-step — raises immediately at
+the offending line instead of quietly serializing the dispatch
+pipeline.
+
+Explicit transfers (`jax.device_put` / `jax.device_get`) stay allowed:
+they are the sanctioned boundary APIs the hot paths use deliberately.
+Note the asymmetry on current jax (0.4.x): the guard intercepts
+implicit host-to-device transfers reliably, while device-to-host pulls
+via `__array__`/`float()` may pass — a full sync round-trip still
+trips on its h2d half (e.g. `jnp.asarray(float(dev))`), and the static
+host-sync rule covers the pull side.
+
+The guard is thread/context-local: enabling it around the driver's
+dispatch section does NOT affect the DeviceFeed worker's deliberate
+H2D staging in its own thread.
+
+Enable globally with `BIGDL_TPU_STRICT_TRANSFERS=1`, per-run with
+`Optimizer.set_strict_transfers()` / `ServingRuntime(strict_transfers=
+True)`, or per-test with the `strict_transfers` fixture in conftest.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+import jax
+
+ENV_FLAG = "BIGDL_TPU_STRICT_TRANSFERS"
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def strict_transfers_enabled(override: Optional[bool] = None) -> bool:
+    """Resolve the strict-transfer switch: explicit override wins, else the
+    BIGDL_TPU_STRICT_TRANSFERS environment variable.
+
+    Reads the environment directly (not Engine config) so tests and
+    debugging sessions can flip it without rebuilding cached config."""
+    if override is not None:
+        return bool(override)
+    return os.environ.get(ENV_FLAG, "").strip().lower() in _TRUTHY
+
+
+@contextlib.contextmanager
+def strict_transfers(enabled: Optional[bool] = None):
+    """Context manager: disallow implicit device transfers inside.
+
+    `enabled=None` defers to the environment flag; False is a cheap
+    no-op so hot loops can wrap their dispatch section unconditionally.
+    """
+    if not strict_transfers_enabled(enabled):
+        yield
+        return
+    with jax.transfer_guard("disallow"):
+        yield

@@ -139,7 +139,28 @@ class DeviceFeed:
         if self._closed:
             raise StopIteration
         t0 = time.perf_counter()
-        item = self._q.get()
+        # timeout-bounded get (mirrors _offer): a worker that dies without
+        # posting _DONE — or is killed hard by the OS — surfaces here as
+        # an error instead of blocking the step loop forever
+        while True:
+            try:
+                item = self._q.get(timeout=0.05)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    # the worker may have posted its last item (or _DONE)
+                    # between our timeout and the aliveness check
+                    try:
+                        item = self._q.get_nowait()
+                        break
+                    except queue.Empty:
+                        pass
+                    self.close()
+                    if self._error is not None:
+                        raise RuntimeError(
+                            f"{self._thread.name} worker failed while "
+                            f"assembling/staging a batch") from self._error
+                    raise StopIteration
         stall = time.perf_counter() - t0
         if item is _DONE:
             self.close()

@@ -57,6 +57,7 @@ from bigdl_tpu.optim.schedules import Plateau
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
 from bigdl_tpu.resilience.async_ckpt import AsyncCheckpointer
+from bigdl_tpu.analysis.runtime import strict_transfers, strict_transfers_enabled
 from bigdl_tpu.resilience.preemption import Preempted, clear_marker, write_marker
 from bigdl_tpu.utils.checkpoint import latest_checkpoint, load_checkpoint, save_checkpoint
 from bigdl_tpu.utils.summary import TrainSummary, ValidationSummary
@@ -69,6 +70,16 @@ logger = logging.getLogger("bigdl_tpu.optim")
 # XLA compile per burst length (stack) — measured as the dominant loop
 # overhead in benchmarks/bench_trainer_overhead.py
 _fold_in = jax.jit(jax.random.fold_in)
+
+
+def _put_scalar(v, dtype=np.int32):
+    """Explicit h2d put for per-step driver scalars (step index, ring slot).
+
+    The transfer itself is not new — jit argument canonicalization was
+    already putting these Python ints every step.  Making it explicit
+    keeps the strict transfer guard (analysis.runtime) quiet and pins
+    the dtype so the first call doesn't retrace on weak-typed ints."""
+    return jax.device_put(dtype(v))
 
 
 @jax.jit
@@ -183,6 +194,8 @@ class Optimizer:
         self.val_summary: Optional[ValidationSummary] = None
         # input feed: None = Engine.config().feed_depth; 0 = synchronous
         self.feed_depth: Optional[int] = None
+        # strict-transfer debug guard: None = BIGDL_TPU_STRICT_TRANSFERS
+        self._strict_transfers: Optional[bool] = None
         # gradient processing
         self.processors: List[ParameterProcessor] = []
         # state — adopt weights already on the model so repeated fit()s
@@ -256,6 +269,17 @@ class Optimizer:
             guard = PreemptionGuard(
                 preempt_file=Engine.config().preempt_file)
         self._preempt_guard = guard or None
+        return self
+
+    def set_strict_transfers(self, flag: bool = True) -> "Optimizer":
+        """Debug guard: wrap the per-step dispatch section (and validate's
+        per-batch eval) in `jax.transfer_guard("disallow")` so any
+        implicit device transfer a future change sneaks into the hot loop
+        raises at the offending line instead of silently serializing the
+        pipeline.  Default (None) follows `BIGDL_TPU_STRICT_TRANSFERS`;
+        the guard is thread-local and does not affect the DeviceFeed
+        worker's deliberate H2D staging.  See docs/analysis.md."""
+        self._strict_transfers = flag
         return self
 
     def set_chaos(self, hook: Any = None, *,
@@ -447,6 +471,10 @@ class Optimizer:
         regs = collect_regularizers(model)
         cast = self._cast_compute
         has_policy = self.compute_dtype is not None
+        # hoisted: reading self inside the jitted closure freezes the
+        # answer at trace time anyway, and invites retraces (linter:
+        # recompile rule) — bind the bool once, here
+        host_lr = self._host_lr()
 
         def train_step(params, model_state, opt_state, x, y, rng, lr):
             def loss_fn(p):
@@ -467,9 +495,9 @@ class Optimizer:
                 grads = proc.process(grads)
             # the applied lr travels back as a DEVICE scalar so the driver
             # can log it without a host round-trip per step
-            lr_used = lr if self._host_lr() else optim.current_lr(opt_state)
+            lr_used = lr if host_lr else optim.current_lr(opt_state)
             new_params, new_opt_state = optim.step(
-                grads, params, opt_state, lr=(lr if self._host_lr() else None))
+                grads, params, opt_state, lr=(lr if host_lr else None))
             return new_params, new_model_state, new_opt_state, loss, lr_used
 
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
@@ -487,6 +515,7 @@ class Optimizer:
         fwd = self._pipeline_forward(training=True)
         cast = self._cast_compute
         has_policy = self.compute_dtype is not None
+        host_lr = self._host_lr()
 
         def train_step(params, model_state, opt_state, x, y, rng, lr):
             def loss_fn(p):
@@ -504,9 +533,9 @@ class Optimizer:
             grads = apply_regularizers(grads, params, regs)
             for proc in processors:
                 grads = proc.process(grads)
-            lr_used = lr if self._host_lr() else optim.current_lr(opt_state)
+            lr_used = lr if host_lr else optim.current_lr(opt_state)
             new_params, new_opt_state = optim.step(
-                grads, params, opt_state, lr=(lr if self._host_lr() else None))
+                grads, params, opt_state, lr=(lr if host_lr else None))
             return new_params, new_model_state, new_opt_state, loss, lr_used
 
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
@@ -705,6 +734,11 @@ class Optimizer:
         drain_clock = [time.perf_counter(), 1.0]  # [last drain t, last dt]
         lr_cache = [None, None]  # [host float, device scalar]
         lr_zero = jnp.zeros((), jnp.float32)
+        # loop invariants hoisted: reading self per step inside the loop
+        # (or worse, inside the jitted closure) is the stale-closure /
+        # retrace hazard the analysis linter's recompile rule flags
+        host_lr = self._host_lr()
+        strict = strict_transfers_enabled(self._strict_transfers)
         ring_cap = depth + 2  # burst span never exceeds depth+1 entries
         ring = jnp.zeros((ring_cap, 2), jnp.float32)
 
@@ -831,27 +865,34 @@ class Optimizer:
                         step_fn = self._build_step()
                     bs = batch.size()
                     x, y = item.payload
-                    rng = _fold_in(root_key, state["neval"])
-                    if self._host_lr():
-                        # schedules hold the lr constant for stretches of
-                        # steps; reuse the device scalar instead of a fresh
-                        # host->device put per step (a put can serialize the
-                        # in-flight step pipeline)
-                        lr_f = float(self._current_lr())
-                        if lr_cache[0] != lr_f:
-                            lr_cache[0] = lr_f
-                            lr_cache[1] = jnp.asarray(lr_f, jnp.float32)
-                        lr = lr_cache[1]
-                    else:
-                        lr = lr_zero  # unused; device schedule
-                    (self.params, self.model_state, self.opt_state, loss,
-                     lr_used) = step_fn(
-                        self.params, self.model_state, self.opt_state, x, y,
-                        rng, lr)
-                    state["neval"] += 1
-                    state["epoch_batch"] += 1
-                    slot = (state["neval"] - 1) % ring_cap
-                    ring = _ring_write(ring, slot, loss, lr_used)
+                    # strict_transfers is a no-op unless enabled: any
+                    # IMPLICIT transfer a future change sneaks into this
+                    # dispatch section then raises at the offending line
+                    with strict_transfers(strict):
+                        rng = _fold_in(root_key,
+                                       _put_scalar(state["neval"]))
+                        if host_lr:
+                            # schedules hold the lr constant for stretches
+                            # of steps; Plateau state lives on host, so
+                            # the current lr is host math — no device
+                            # round-trip — and the device scalar is put
+                            # once per lr CHANGE, not per step
+                            lr_f = self._current_lr_host()
+                            if lr_cache[0] != lr_f:
+                                lr_cache[0] = lr_f
+                                lr_cache[1] = _put_scalar(lr_f, np.float32)
+                            lr = lr_cache[1]
+                        else:
+                            lr = lr_zero  # unused; device schedule
+                        (self.params, self.model_state, self.opt_state,
+                         loss, lr_used) = step_fn(
+                            self.params, self.model_state, self.opt_state,
+                            x, y, rng, lr)
+                        state["neval"] += 1
+                        state["epoch_batch"] += 1
+                        slot = (state["neval"] - 1) % ring_cap
+                        ring = _ring_write(ring, _put_scalar(slot), loss,
+                                           lr_used)
                     pending.append((state["epoch"] + 1, state["neval"], bs,
                                     slot, ring, item.stall_s, item.occupancy))
                     drain(depth)
@@ -948,6 +989,17 @@ class Optimizer:
             return self.optim_method.learning_rate
         return self.optim_method.current_lr(self.opt_state)
 
+    def _current_lr_host(self) -> float:
+        """Current lr as a host float WITHOUT a device round-trip.
+
+        Only meaningful for host-driven schedules (Plateau): their state
+        (current_factor, min_lr) lives on host, so the lr is pure host
+        math.  The old `float(self._current_lr())` pulled a device
+        scalar every step — the per-step d2h sync the analysis linter's
+        host-sync rule exists to catch."""
+        sched = self.optim_method.schedule
+        return sched.host_value(self.optim_method.learning_rate)
+
     # ------------------------------------------------------------------
 
     def _agreed_trigger(self, trigger, state) -> bool:
@@ -1003,8 +1055,13 @@ class Optimizer:
         # wait + round trip (~100 ms through the remote tunnel).  Batch
         # staging runs through the same DeviceFeed as training.
         totals_v = totals_c = None
+        # guard covers dispatch + on-device accumulation; the feed worker
+        # thread stages batches outside it (transfer_guard is thread-local)
+        # and the sanctioned end-of-eval pull below sits after the block
+        strict = strict_transfers_enabled(self._strict_transfers)
         with make_feed(self.val_dataset.data(train=False), self._stage_batch,
-                       self._feed_depth(), name="DeviceFeed-eval") as feed:
+                       self._feed_depth(), name="DeviceFeed-eval") as feed, \
+                strict_transfers(strict):
             for item in feed:
                 x, y = item.payload
                 outs = self._compiled_eval(self.params, self.model_state, x, y)
@@ -1017,8 +1074,8 @@ class Optimizer:
         if totals_v is None:
             return [ValidationResult(0.0, 0, m.name) for m in self.val_methods]
         # the single sanctioned device->host transfer of the whole eval
-        vals = np.asarray(jnp.stack(totals_v), np.float64)
-        cnts = np.asarray(jnp.stack(totals_c))
+        vals = np.asarray(jnp.stack(totals_v), np.float64)  # tpu-lint: disable=host-sync
+        cnts = np.asarray(jnp.stack(totals_c))  # tpu-lint: disable=host-sync
         return [ValidationResult(float(v), int(c), m.name)
                 for v, c, m in zip(vals, cnts, self.val_methods)]
 
@@ -1262,6 +1319,7 @@ class ParallelOptimizer(DistriOptimizer):
         optim, processors = self.optim_method, list(self.processors)
         regs = collect_regularizers(model)
         mesh = self.mesh
+        host_lr = self._host_lr()
 
         def shard_step(params, model_state, opt_state, x, y, rng, lr):
             def loss_fn(p):
@@ -1281,9 +1339,9 @@ class ParallelOptimizer(DistriOptimizer):
             grads = apply_regularizers(grads, params, regs)
             for proc in processors:
                 grads = proc.process(grads)
-            lr_used = lr if self._host_lr() else optim.current_lr(opt_state)
+            lr_used = lr if host_lr else optim.current_lr(opt_state)
             new_params, new_opt_state = optim.step(
-                grads, params, opt_state, lr=(lr if self._host_lr() else None))
+                grads, params, opt_state, lr=(lr if host_lr else None))
             return new_params, new_model_state, new_opt_state, loss, lr_used
 
         rep = P()

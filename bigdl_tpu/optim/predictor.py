@@ -83,7 +83,7 @@ def _to_device(x: Any) -> Any:
         return Table(*[_to_device(v) for v in x])
     if isinstance(x, (list, tuple)):  # multi-input x / multi-output y
         return type(x)(_to_device(v) for v in x)
-    return jnp.asarray(np.asarray(x))
+    return jax.device_put(np.asarray(x))  # explicit h2d, guard-friendly
 
 
 def _batch_rows(x: Any) -> int:
@@ -130,6 +130,12 @@ class Predictor:
             sharding = NamedSharding(mesh, P())
             self.params = jax.device_put(params, sharding)
             self.state = jax.device_put(state, sharding)
+        else:
+            # commit once at construction: host-resident leaves would
+            # otherwise re-transfer on EVERY _fwd call (implicit h2d per
+            # batch), which the strict transfer guard rejects
+            self.params = jax.device_put(params)
+            self.state = jax.device_put(state)
 
         model_ref = self.model
 
@@ -145,7 +151,7 @@ class Predictor:
         if isinstance(x, (list, tuple)):  # keras multi-input batches
             return type(x)(self._put(v) for v in x)
         if self.mesh is None:
-            return jnp.asarray(x)
+            return jax.device_put(np.asarray(x))
         return jax.device_put(jnp.asarray(x), NamedSharding(self.mesh, P(AXIS_DATA)))
 
     def predict(self, data: Any, batch_size: Optional[int] = None):
@@ -174,11 +180,15 @@ class Predictor:
             for item in feed:
                 n, xd = item.payload
                 y = self._fwd(self.params, self.state, xd)
+                # slice on device and keep the handle: forwards dispatch
+                # async back-to-back instead of host-syncing per batch
                 if isinstance(y, (Table, list, tuple)):
                     multi = True
-                    outs.append([np.asarray(h)[:n] for h in y])
+                    outs.append([h[:n] for h in y])
                 else:
-                    outs.append(np.asarray(y)[:n])
+                    outs.append(y[:n])
+        # the one sanctioned device->host pull of the whole predict
+        outs = jax.device_get(outs)
         if multi:
             return [np.concatenate([o[i] for o in outs], axis=0)
                     for i in range(len(outs[0]))]
@@ -236,7 +246,7 @@ class Evaluator:
             sharding = NamedSharding(self.mesh, P())
             params = jax.device_put(params, sharding)
             state = jax.device_put(state, sharding)
-        totals: List[Optional[ValidationResult]] = [None] * len(methods)
+        totals: List[Optional[Any]] = [None] * len(methods)
         for batch in _as_batches(data, batch_size):
             x, y = batch.get_input(), batch.get_target()
             n = _batch_rows(x)
@@ -249,10 +259,17 @@ class Evaluator:
                 xp = self._put_batch(x)
                 yp = self._put_batch(y)
                 pairs = step(params, state, xp, yp)
+            # accumulate (sum, count) ON DEVICE — to_result per batch
+            # would host-sync O(N) times; the adds dispatch async
             for i, (v, c) in enumerate(pairs):
-                r = methods[i].to_result(v, c)
-                totals[i] = r if totals[i] is None else totals[i] + r
-        return [t for t in totals if t is not None]
+                tv, tc = totals[i] if totals[i] is not None else (0.0, 0)
+                totals[i] = (tv + v, tc + c)
+        done = [(i, t) for i, t in enumerate(totals) if t is not None]
+        # single end-of-eval transfer; ValidationResult.+ is plain
+        # addition, so summing device scalars first is equivalent
+        host = jax.device_get([t for _, t in done])
+        return [methods[i].to_result(v, c)
+                for (i, _), (v, c) in zip(done, host)]
 
     def _put_batch(self, x):
         from bigdl_tpu.optim.optimizer import put_batch_array

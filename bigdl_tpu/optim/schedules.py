@@ -18,6 +18,7 @@ import math
 from typing import List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -243,3 +244,12 @@ class Plateau(LearningRateSchedule):
 
     def __call__(self, base_lr, iteration, epoch):
         return jnp.maximum(base_lr * self.current_factor, self.min_lr)
+
+    def host_value(self, base_lr: float) -> float:
+        """Host-side twin of __call__: Plateau state is host floats, so
+        the driver can read the current lr without a device round-trip.
+        f32 math mirrors the device computation bit-for-bit so the value
+        that reaches the step is identical either way."""
+        return float(np.maximum(np.float32(base_lr)
+                                * np.float32(self.current_factor),
+                                np.float32(self.min_lr)))

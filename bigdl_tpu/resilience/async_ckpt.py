@@ -182,7 +182,16 @@ class AsyncCheckpointer:
         with self._lock:
             self._inflight.add(job.step)
         self._ensure_thread()
-        self._q.put(job)  # bounded: backpressure instead of host-mem growth
+        # bounded: backpressure instead of host-mem growth.  The timeout
+        # loop keeps the wait interruptible — a writer that died outside
+        # its try (interpreter teardown, untrappable kill) gets restarted
+        # instead of leaving the step loop blocked on a full queue forever
+        while True:
+            try:
+                self._q.put(job, timeout=0.1)
+                return
+            except queue.Full:
+                self._ensure_thread()
 
     def save_sync(self, step: int, params: Any, model_state: Any = None,
                   opt_state: Any = None,
@@ -201,23 +210,42 @@ class AsyncCheckpointer:
         try:
             d = self._write(job)
         except BaseException as e:
-            self.failed.append(job.step)
-            self.last_error = e
+            with self._lock:
+                self.failed.append(job.step)
+                self.last_error = e
             raise CheckpointWriteError(
                 f"sync checkpoint at step {job.step} failed") from e
         finally:
             with self._lock:
                 self._inflight.discard(job.step)
-        self.committed.append(job.step)
+        with self._lock:
+            self.committed.append(job.step)
+            protect = tuple(self._inflight)
         apply_retention(self.path, self.keep_last, self.keep_every,
-                        protect=tuple(self._inflight))
+                        protect=protect)
         return d
 
     def wait(self) -> None:
         """Barrier: every queued snapshot is committed (or failed+logged)
         when this returns.  End-of-training and every restore path call
         this so `latest_checkpoint` sees the full commit history."""
-        self._q.join()
+        self._drain()
+
+    def _drain(self) -> None:
+        """Bounded-step equivalent of `Queue.join()`: waits on the same
+        all_tasks_done condition, but wakes every 100 ms to restart a
+        writer that died outside its try block — a bare join() there
+        deadlocks the driver with jobs stranded in the queue."""
+        q = self._q
+        with q.all_tasks_done:
+            while q.unfinished_tasks:
+                if not self._closed and (self._thread is None
+                                         or not self._thread.is_alive()):
+                    self._ensure_thread()
+                q.all_tasks_done.wait(timeout=0.1)
+                if self._closed and (self._thread is None
+                                     or not self._thread.is_alive()):
+                    break  # closing and the writer is gone: nothing will drain
 
     def close(self) -> None:
         """Drain, stop and join the writer thread.  Idempotent."""
@@ -225,8 +253,14 @@ class AsyncCheckpointer:
             return
         self._closed = True
         if self._thread is not None:
-            self._q.put(_STOP)
-            self._q.join()
+            while True:
+                try:
+                    self._q.put(_STOP, timeout=0.1)
+                    break
+                except queue.Full:
+                    if not self._thread.is_alive():
+                        break  # dead writer, full queue: nothing to stop
+            self._drain()
             self._thread.join(timeout=30.0)
             if self._thread.is_alive():  # pragma: no cover - defensive
                 raise RuntimeError(f"{self._name} did not stop")
@@ -252,23 +286,32 @@ class AsyncCheckpointer:
 
     def _run(self) -> None:
         while True:
-            job = self._q.get()
+            try:
+                # bounded get: idle wake-ups are cheap and keep the worker
+                # loop responsive to interpreter teardown (daemon threads
+                # stuck in an unbounded get can't be reasoned about)
+                job = self._q.get(timeout=0.5)
+            except queue.Empty:
+                continue
             if job is _STOP:
                 self._q.task_done()
                 return
             try:
                 d = self._write(job)
-                self.committed.append(job.step)
+                with self._lock:
+                    self.committed.append(job.step)
+                    protect = tuple(self._inflight)
                 logger.info("checkpoint step %d committed to %s",
                             job.step, d)
                 apply_retention(self.path, self.keep_last, self.keep_every,
-                                protect=tuple(self._inflight))
+                                protect=protect)
             except BaseException as e:
                 # a lost checkpoint is recoverable; a killed run is not —
                 # the partial staging dir stays on disk (cleanup code after
                 # an IO error is untrustworthy) and resume-time GC reclaims
-                self.failed.append(job.step)
-                self.last_error = e
+                with self._lock:
+                    self.failed.append(job.step)
+                    self.last_error = e
                 logger.exception("async checkpoint at step %d failed "
                                  "(training continues)", job.step)
             finally:

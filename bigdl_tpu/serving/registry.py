@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
+import jax
+
 
 class ModelVersion(NamedTuple):
     version: str
@@ -60,6 +62,10 @@ class ModelRegistry:
                  activate: bool = True, source: str = "memory") -> ModelVersion:
         if state is None:
             state = {}
+        # commit the trees once here: host-resident leaves (checkpoint
+        # loads arrive as numpy) would re-transfer on every dispatch
+        params = jax.device_put(params)
+        state = jax.device_put(state)
         mv = ModelVersion(str(version), params, state, time.time(), source)
         if self._warmup is not None:
             # compile/warm BEFORE the swap: requests keep hitting the old

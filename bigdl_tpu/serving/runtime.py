@@ -30,6 +30,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from bigdl_tpu.analysis.runtime import strict_transfers, strict_transfers_enabled
 from bigdl_tpu.core.table import Table
 from bigdl_tpu.nn.module import Module
 from bigdl_tpu.optim.predictor import _batch_rows, _pad_batch
@@ -43,11 +44,15 @@ class ServingConfig:
 
     def __init__(self, buckets: Sequence[int] = (1, 8, 32),
                  max_wait_ms: float = 2.0, capacity: int = 128,
-                 default_deadline_ms: Optional[float] = None):
+                 default_deadline_ms: Optional[float] = None,
+                 strict_transfers: Optional[bool] = None):
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.max_wait_ms = float(max_wait_ms)
         self.capacity = int(capacity)
         self.default_deadline_ms = default_deadline_ms
+        # None = env BIGDL_TPU_STRICT_TRANSFERS; True wraps every batch
+        # dispatch in jax.transfer_guard("disallow") (docs/analysis.md)
+        self.strict_transfers = strict_transfers
 
 
 def _concat_rows(xs: List[Any]) -> Any:
@@ -133,13 +138,11 @@ class ServingRuntime:
 
     @staticmethod
     def _to_device(x: Any) -> Any:
-        import jax.numpy as jnp
-
         if isinstance(x, Table):
             return Table(*[ServingRuntime._to_device(v) for v in x])
         if isinstance(x, (list, tuple)):
             return type(x)(ServingRuntime._to_device(v) for v in x)
-        return jnp.asarray(np.asarray(x))
+        return jax.device_put(np.asarray(x))  # explicit h2d, guard-friendly
 
     def _dispatch(self, requests, bucket: int) -> None:
         t_dispatch = time.perf_counter()
@@ -151,8 +154,10 @@ class ServingRuntime:
         x = _concat_rows([r.x for r in requests])
         xp = _pad_batch(x, bucket) if rows < bucket else x
         self._record_shape(xp)
-        y = self._fwd(snap.params, snap.state, self._to_device(xp))
-        y = jax.tree_util.tree_map(np.asarray, y)  # host sync + split copy
+        with strict_transfers(strict_transfers_enabled(
+                self.config.strict_transfers)):
+            y = self._fwd(snap.params, snap.state, self._to_device(xp))
+        y = jax.device_get(y)  # ONE host sync per batch, post-dispatch
         t_done = time.perf_counter()
         self.metrics.on_batch(bucket, rows, (t_done - t_dispatch) * 1e3)
         off = 0

@@ -41,6 +41,18 @@ def rng():
     return jax.random.PRNGKey(0)
 
 
+@pytest.fixture()
+def strict_transfers():
+    """Run the test body under jax.transfer_guard("disallow"): any implicit
+    h2d transfer (e.g. a Python scalar canonicalized into a jitted call)
+    raises instead of silently syncing.  See docs/analysis.md for the
+    h2d/d2h asymmetry — d2h pulls need the static linter."""
+    from bigdl_tpu.analysis.runtime import strict_transfers as _guard
+
+    with _guard(True):
+        yield
+
+
 @pytest.fixture(autouse=True)
 def _thread_leak_guard():
     """No worker thread may survive a test: a DeviceFeed (or any new
@@ -57,7 +69,8 @@ def _thread_leak_guard():
         return [t for t in threading.enumerate()
                 if t not in before and t.is_alive()
                 and (not t.daemon
-                     or t.name.startswith(("DeviceFeed", "AsyncCkptWriter")))]
+                     or t.name.startswith(("DeviceFeed", "AsyncCkptWriter",
+                                           "serving-batcher")))]
 
     yield
     # grace for threads mid-shutdown (close() joins, but a worker that

@@ -1,0 +1,616 @@
+"""bigdl_tpu.analysis — the TPU-hostile-pattern linter + strict transfer guard.
+
+Every rule family gets at least one positive fixture (the pattern is
+caught) and one negative fixture (the idiomatic rewrite passes) so the
+linter's precision/recall contract is pinned, not assumed.  The runtime
+half pins the empirical `jax.transfer_guard("disallow")` semantics the
+docs claim: implicit h2d raises, d2h pulls do NOT (which is exactly why
+the static linter owns the d2h side).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.analysis import (
+    HOT_PATH_RULES,
+    RULES,
+    analyze_sources,
+    strict_transfers,
+    strict_transfers_enabled,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT_CLI = os.path.join(REPO, "tools", "tpu_lint.py")
+
+
+def _findings(src, hot_roots=None, path="mod.py"):
+    return analyze_sources({path: src}, hot_roots=hot_roots)
+
+
+def _rules(src, hot_roots=None):
+    return {f.rule for f in _findings(src, hot_roots=hot_roots)}
+
+
+# ----------------------------------------------------------------------
+# rule family: host-sync
+# ----------------------------------------------------------------------
+
+class TestHostSync:
+    def test_positive_float_pull_in_hot_loop(self):
+        src = """
+import jax.numpy as jnp
+
+def train_loop(xs):
+    total = jnp.zeros(())
+    for x in xs:
+        total = total + x
+        print(float(total))
+    return total
+"""
+        assert "host-sync" in _rules(src, hot_roots=[r"train_loop$"])
+
+    def test_positive_np_asarray_of_device_value(self):
+        src = """
+import numpy as np
+import jax.numpy as jnp
+
+def train_loop(xs):
+    total = jnp.zeros(())
+    for x in xs:
+        total = total + x
+        log = np.asarray(total)
+    return log
+"""
+        assert "host-sync" in _rules(src, hot_roots=[r"train_loop$"])
+
+    def test_positive_branch_on_traced_value(self):
+        src = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    y = jnp.sum(x)
+    if y > 0:
+        return y
+    return -y
+"""
+        assert "host-sync" in _rules(src)
+
+    def test_negative_device_get_is_sanctioned(self):
+        src = """
+import jax
+import jax.numpy as jnp
+
+def train_loop(xs):
+    total = jnp.zeros(())
+    for x in xs:
+        total = total + x
+    return jax.device_get(total)
+"""
+        assert "host-sync" not in _rules(src, hot_roots=[r"train_loop$"])
+
+    def test_negative_cold_function_not_flagged(self):
+        src = """
+import jax.numpy as jnp
+
+def summarize(xs):
+    total = jnp.zeros(())
+    for x in xs:
+        total = total + x
+        print(float(total))
+    return total
+"""
+        assert _rules(src) == set()  # no hot root matches `summarize`
+
+
+# ----------------------------------------------------------------------
+# rule family: recompile
+# ----------------------------------------------------------------------
+
+class TestRecompile:
+    def test_positive_self_read_inside_jit(self):
+        src = """
+import jax
+
+class Trainer:
+    def build(self):
+        def step(x):
+            def inner(y):
+                return y * 2
+            return inner(x) * self.scale
+        return jax.jit(step)
+"""
+        assert "recompile" in _rules(src)
+
+    def test_positive_host_scalar_into_jitted_call_in_hot_loop(self):
+        src = """
+import jax
+
+@jax.jit
+def step(x):
+    return x * 2
+
+def train_loop(xs):
+    acc = []
+    for x in xs:
+        scale = len(acc) + 1
+        acc.append(step(scale))
+    return acc
+"""
+        assert "recompile" in _rules(src, hot_roots=[r"train_loop$"])
+
+    def test_negative_hoisted_self_and_device_args(self):
+        src = """
+import jax
+
+class Trainer:
+    def build(self):
+        scale = self.scale
+        def step(x):
+            return x * scale
+        return jax.jit(step)
+
+@jax.jit
+def double(x):
+    return x * 2
+
+def train_loop(xs):
+    out = []
+    for x in xs:
+        out.append(double(x))
+    return out
+"""
+        assert "recompile" not in _rules(src, hot_roots=[r"train_loop$"])
+
+
+# ----------------------------------------------------------------------
+# rule family: tracer-leak
+# ----------------------------------------------------------------------
+
+class TestTracerLeak:
+    def test_positive_store_on_self_inside_jit(self):
+        src = """
+import jax
+
+class Model:
+    def build(self):
+        @jax.jit
+        def step(x):
+            y = x * 2
+            self.cache = y
+            return y
+        return step
+"""
+        assert "tracer-leak" in _rules(src)
+
+    def test_positive_store_into_captured_container(self):
+        src = """
+import jax
+
+def build(cache):
+    @jax.jit
+    def step(x):
+        y = x * 2
+        cache["y"] = y
+        return y
+    return step
+"""
+        assert "tracer-leak" in _rules(src)
+
+    def test_negative_local_container_is_fine(self):
+        src = """
+import jax
+
+@jax.jit
+def step(x):
+    scratch = {}
+    scratch["y"] = x * 2
+    return scratch["y"]
+"""
+        assert "tracer-leak" not in _rules(src)
+
+
+# ----------------------------------------------------------------------
+# rule family: concurrency
+# ----------------------------------------------------------------------
+
+class TestConcurrency:
+    def test_positive_thread_without_daemon_or_join(self):
+        src = """
+import threading
+
+class Pump:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        pass
+"""
+        assert "concurrency" in _rules(src)
+
+    def test_positive_unbounded_queue_get_in_worker_class(self):
+        src = """
+import queue
+import threading
+
+class Pump:
+    def __init__(self):
+        self._q = queue.Queue(maxsize=2)
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+
+    def close(self):
+        self._t.join(timeout=5.0)
+"""
+        assert "concurrency" in _rules(src)
+
+    def test_positive_shared_list_mutated_without_lock(self):
+        src = """
+import threading
+
+class Tracker:
+    def __init__(self):
+        self.items = []
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        self.items.append(1)
+
+    def close(self):
+        self.items.append(2)
+        self._t.join(timeout=1.0)
+"""
+        assert "concurrency" in _rules(src)
+
+    def test_negative_full_discipline(self):
+        src = """
+import queue
+import threading
+
+class Pump:
+    def __init__(self):
+        self._q = queue.Queue(maxsize=2)
+        self._lock = threading.Lock()
+        self.items = []
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is None:
+                return
+            with self._lock:
+                self.items.append(item)
+
+    def close(self):
+        self._q.put(None, timeout=1.0)
+        self._t.join(timeout=5.0)
+        with self._lock:
+            return list(self.items)
+"""
+        assert "concurrency" not in _rules(src)
+
+
+# ----------------------------------------------------------------------
+# rule family: donation
+# ----------------------------------------------------------------------
+
+class TestDonation:
+    def test_positive_read_after_donating_call(self):
+        src = """
+import jax
+
+def _step(p, x):
+    return p + x
+
+step = jax.jit(_step, donate_argnums=(0,))
+
+def run(p, xs):
+    out = None
+    for x in xs:
+        out = step(p, x)
+        norm = p.sum()
+    return out, norm
+"""
+        assert "donation" in _rules(src)
+
+    def test_negative_rebinding_loop(self):
+        src = """
+import jax
+
+def _step(p, x):
+    return p + x
+
+step = jax.jit(_step, donate_argnums=(0,))
+
+def run(p, xs):
+    for x in xs:
+        p = step(p, x)
+    return p
+"""
+        assert "donation" not in _rules(src)
+
+
+# ----------------------------------------------------------------------
+# rule family: blocking-io
+# ----------------------------------------------------------------------
+
+class TestBlockingIO:
+    def test_positive_open_inside_jit(self):
+        src = """
+import jax
+
+@jax.jit
+def step(x):
+    with open('/tmp/debug.log', 'w') as fh:
+        fh.write('hi')
+    return x * 2
+"""
+        assert "blocking-io" in _rules(src)
+
+    def test_positive_sleep_in_hot_loop(self):
+        src = """
+import time
+
+def train_loop(xs):
+    out = []
+    for x in xs:
+        time.sleep(0.01)
+        out.append(x)
+    return out
+"""
+        assert "blocking-io" in _rules(src, hot_roots=[r"train_loop$"])
+
+    def test_negative_logging_and_cold_io(self):
+        src = """
+import logging
+import time
+
+logger = logging.getLogger(__name__)
+
+def train_loop(xs):
+    out = []
+    for x in xs:
+        logger.info("step %d", len(out))
+        out.append(x)
+    return out
+
+def report(path, text):
+    with open(path, 'w') as fh:
+        fh.write(text)
+"""
+        assert "blocking-io" not in _rules(src, hot_roots=[r"train_loop$"])
+
+
+# ----------------------------------------------------------------------
+# suppressions + fingerprints
+# ----------------------------------------------------------------------
+
+class TestSuppressionsAndFingerprints:
+    SRC = """
+import jax.numpy as jnp
+
+def train_loop(xs):
+    total = jnp.zeros(())
+    for x in xs:
+        total = total + x
+        log = float(total){SUPPRESS}
+    return log
+"""
+
+    def test_inline_disable_silences_one_rule(self):
+        noisy = self.SRC.replace("{SUPPRESS}", "")
+        quiet = self.SRC.replace("{SUPPRESS}",
+                                 "  # tpu-lint: disable=host-sync")
+        assert "host-sync" in _rules(noisy, hot_roots=[r"train_loop$"])
+        assert "host-sync" not in _rules(quiet, hot_roots=[r"train_loop$"])
+
+    def test_def_line_disable_all_covers_function(self):
+        src = self.SRC.replace("{SUPPRESS}", "").replace(
+            "def train_loop(xs):",
+            "def train_loop(xs):  # tpu-lint: disable=all")
+        assert _rules(src, hot_roots=[r"train_loop$"]) == set()
+
+    def test_fingerprint_survives_line_moves(self):
+        noisy = self.SRC.replace("{SUPPRESS}", "")
+        shifted = "\n\n\n" + noisy  # same code, three lines lower
+        fp = {f.fingerprint()
+              for f in _findings(noisy, hot_roots=[r"train_loop$"])}
+        fp2 = {f.fingerprint()
+               for f in _findings(shifted, hot_roots=[r"train_loop$"])}
+        assert fp and fp == fp2
+
+
+# ----------------------------------------------------------------------
+# CLI + baseline policy
+# ----------------------------------------------------------------------
+
+HOT_FIXTURE = """
+import jax.numpy as jnp
+
+class Optimizer:
+    def _optimize_impl(self, xs):
+        total = jnp.zeros(())
+        for x in xs:
+            total = total + x
+            log = float(total)
+        return log
+"""
+
+COLD_FIXTURE = """
+import threading
+
+class Pump:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        pass
+"""
+
+
+def _run_cli(*args):
+    return subprocess.run([sys.executable, LINT_CLI, *args],
+                          capture_output=True, text=True, cwd=REPO)
+
+
+class TestCli:
+    def test_findings_exit_1_then_baseline_exits_0(self, tmp_path):
+        (tmp_path / "pump.py").write_text(COLD_FIXTURE)
+        baseline = tmp_path / "baseline.json"
+        r = _run_cli(str(tmp_path))
+        assert r.returncode == 1 and "concurrency" in r.stdout
+        r = _run_cli(str(tmp_path), "--baseline", str(baseline),
+                     "--write-baseline")
+        assert r.returncode == 0, r.stderr
+        r = _run_cli(str(tmp_path), "--baseline", str(baseline))
+        assert r.returncode == 0 and "clean" in r.stdout
+
+    def test_hot_path_rules_cannot_be_baselined(self, tmp_path):
+        (tmp_path / "opt.py").write_text(HOT_FIXTURE)
+        baseline = tmp_path / "baseline.json"
+        r = _run_cli(str(tmp_path), "--baseline", str(baseline),
+                     "--write-baseline")
+        assert r.returncode == 2
+        assert "refusing" in r.stderr
+        assert not baseline.exists()
+
+    def test_handcrafted_hot_baseline_is_rejected(self, tmp_path):
+        (tmp_path / "opt.py").write_text(HOT_FIXTURE)
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(json.dumps({
+            "version": 1,
+            "suppressions": [{"fingerprint": "deadbeefdeadbeef",
+                              "rule": "host-sync", "path": "opt.py",
+                              "func": "Optimizer._optimize_impl",
+                              "message": "sneaky"}]}))
+        r = _run_cli(str(tmp_path), "--baseline", str(baseline))
+        assert r.returncode == 2
+        assert "grandfathered" in r.stderr
+
+    def test_unknown_rule_is_config_error(self, tmp_path):
+        (tmp_path / "empty.py").write_text("x = 1\n")
+        r = _run_cli(str(tmp_path), "--rules", "no-such-rule")
+        assert r.returncode == 2
+
+    def test_rules_registry_consistent(self):
+        assert HOT_PATH_RULES < set(RULES)
+
+    def test_repo_tree_is_clean(self):
+        r = _run_cli("bigdl_tpu/", "examples/", "benchmarks/", "--baseline",
+                     os.path.join(REPO, "tools", "tpu_lint_baseline.json"))
+        assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ----------------------------------------------------------------------
+# runtime strict-transfer guard
+# ----------------------------------------------------------------------
+
+class TestStrictTransfers:
+    def test_env_flag_parsing(self, monkeypatch):
+        monkeypatch.delenv("BIGDL_TPU_STRICT_TRANSFERS", raising=False)
+        assert not strict_transfers_enabled()
+        monkeypatch.setenv("BIGDL_TPU_STRICT_TRANSFERS", "1")
+        assert strict_transfers_enabled()
+        monkeypatch.setenv("BIGDL_TPU_STRICT_TRANSFERS", "0")
+        assert not strict_transfers_enabled()
+        # explicit override beats the env both ways
+        monkeypatch.setenv("BIGDL_TPU_STRICT_TRANSFERS", "1")
+        assert not strict_transfers_enabled(False)
+        monkeypatch.delenv("BIGDL_TPU_STRICT_TRANSFERS")
+        assert strict_transfers_enabled(True)
+
+    def test_implicit_h2d_raises_under_guard(self):
+        f = jax.jit(lambda x: x + 1)
+        f(jnp.float32(1.0))  # compile OUTSIDE the guard
+        with strict_transfers(True):
+            with pytest.raises(Exception, match="(?i)transfer"):
+                f(2.0)  # python scalar -> implicit h2d put
+
+    def test_device_args_pass_under_guard(self):
+        f = jax.jit(lambda x: x + 1)
+        x = jax.device_put(jnp.float32(1.0))
+        with strict_transfers(True):
+            assert float(jax.device_get(f(x))) == 2.0
+
+    def test_d2h_pull_is_not_caught(self):
+        # the asymmetry the docs warn about: transfer_guard("disallow")
+        # does NOT catch device->host pulls — that's the linter's job.
+        # If a jax upgrade ever flips this, the docs need rewording.
+        y = jnp.float32(3.0) * 2
+        with strict_transfers(True):
+            assert float(y) == 6.0
+
+    def test_disabled_guard_is_a_noop(self):
+        f = jax.jit(lambda x: x + 1)
+        f(jnp.float32(1.0))
+        with strict_transfers(False):
+            assert float(jax.device_get(f(2.0))) == 3.0
+
+    def test_conftest_fixture(self, strict_transfers):
+        f = jax.jit(lambda x: x * 3)
+        # np.float32, not jnp.float32: the latter lowers through
+        # convert_element_type — itself an implicit h2d the guard rejects
+        x = jax.device_put(np.float32(2.0))
+        assert float(jax.device_get(f(x))) == 6.0
+
+
+class TestStrictTrainerIntegration:
+    def _fit(self, monkeypatch, inject):
+        import bigdl_tpu.nn as nn
+        import bigdl_tpu.optim.optimizer as opt_mod
+        from bigdl_tpu.dataset import ArrayDataSet, MiniBatch
+        from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+
+        if inject:
+            # reintroduce the exact bug the linter caught at
+            # optimizer.py:_optimize_impl (pre-fix): the per-step fold_in
+            # index passed as a raw Python int — an implicit h2d put
+            # inside the guarded hot section
+            real = jax.jit(jax.random.fold_in)
+            monkeypatch.setattr(opt_mod, "_fold_in",
+                                lambda key, i: real(key, int(i)))
+
+        rs = np.random.RandomState(0)
+        items = [MiniBatch(jnp.asarray(rs.rand(8, 4), jnp.float32),
+                           jnp.asarray(rs.randint(0, 2, 8)))
+                 for _ in range(4)]
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2),
+                              nn.LogSoftMax())
+        opt = LocalOptimizer(model, ArrayDataSet(items),
+                             nn.ClassNLLCriterion(),
+                             optim_method=SGD(learning_rate=0.1),
+                             end_trigger=Trigger.max_epoch(1))
+        opt.set_strict_transfers(True)
+        return opt.optimize()
+
+    def test_injected_host_sync_raises(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_TPU_STRICT_TRANSFERS", "1")
+        with pytest.raises(Exception, match="(?i)transfer"):
+            self._fit(monkeypatch, inject=True)
+
+    def test_clean_hot_loop_fits_under_guard(self, monkeypatch):
+        # regression: the shipped hot loop must stay strict-clean
+        self._fit(monkeypatch, inject=False)

@@ -14,6 +14,11 @@ cd "$(dirname "$0")/.."
 # docs must track the code: PARITY.md claims vs shipped evidence
 python tools/parity_drift_guard.py || exit 1
 
+# TPU-hostile-pattern lint (docs/analysis.md): hot-path findings are
+# hard failures, non-hot-path ones must be in the committed baseline
+python tools/tpu_lint.py bigdl_tpu/ examples/ benchmarks/ \
+    --baseline tools/tpu_lint_baseline.json || exit 1
+
 start=$(date +%s)
 timeout --signal=TERM "$BUDGET" python -m pytest tests/ -m "not slow" -q
 rc=$?

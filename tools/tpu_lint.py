@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""TPU-hostile-pattern linter CLI (bigdl_tpu.analysis).
+
+    tools/tpu_lint.py bigdl_tpu/ examples/ benchmarks/ \
+        --baseline tools/tpu_lint_baseline.json
+
+Exit codes: 0 clean (or every finding baselined/suppressed), 1 new
+findings, 2 configuration error (unknown rule, hot-path finding in the
+baseline — those rules guard live perf bugs and may never be
+grandfathered).
+
+The baseline stores line-number-free fingerprints so refactors that
+merely move code don't churn it; changing the offending line itself
+invalidates the entry and forces a re-look.  `--write-baseline`
+refuses to record hot-path rules (host-sync / tracer-leak / donation):
+fix those or suppress them inline with an explanation.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from bigdl_tpu.analysis.linter import (  # noqa: E402
+    HOT_PATH_RULES, RULES, analyze_paths)
+
+DEFAULT_PATHS = ["bigdl_tpu/"]
+
+
+def load_baseline(path):
+    if not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        data = json.load(fh)
+    out = {}
+    for entry in data.get("suppressions", []):
+        out[entry["fingerprint"]] = entry
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: bigdl_tpu/)")
+    ap.add_argument("--baseline", default=None,
+                    help="JSON baseline of accepted findings")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings to --baseline and exit")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rules to report")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--hot-root", action="append", default=[],
+                    help="extra hot-root qualname regex (repeatable)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES:
+            tag = " (hot-path: not baselinable)" if r in HOT_PATH_RULES \
+                else ""
+            print(f"{r}{tag}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(RULES)
+        if unknown:
+            print(f"tpu_lint: unknown rule(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    paths = args.paths or DEFAULT_PATHS
+    from bigdl_tpu.analysis.linter import DEFAULT_HOT_ROOTS
+    hot_roots = list(DEFAULT_HOT_ROOTS) + args.hot_root
+    findings = analyze_paths(paths, hot_roots=hot_roots)
+    if rules is not None:
+        findings = [f for f in findings if f.rule in rules]
+
+    if args.write_baseline:
+        if not args.baseline:
+            print("tpu_lint: --write-baseline needs --baseline",
+                  file=sys.stderr)
+            return 2
+        hot = [f for f in findings if f.rule in HOT_PATH_RULES]
+        if hot:
+            print("tpu_lint: refusing to baseline hot-path findings "
+                  "(fix or suppress inline with a reason):",
+                  file=sys.stderr)
+            for f in hot:
+                print("  " + f.render(), file=sys.stderr)
+            return 2
+        payload = {
+            "version": 1,
+            "comment": "accepted non-hot-path findings; hot-path rules "
+                       "(host-sync/tracer-leak/donation) may never "
+                       "appear here — tools/tpu_lint.py enforces",
+            "suppressions": [
+                {"fingerprint": f.fingerprint(), "rule": f.rule,
+                 "path": f.path, "func": f.func, "message": f.message}
+                for f in findings],
+        }
+        with open(args.baseline, "w") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"tpu_lint: wrote {len(findings)} suppression(s) to "
+              f"{args.baseline}")
+        return 0
+
+    baseline = {}
+    if args.baseline:
+        baseline = load_baseline(args.baseline)
+        bad = [e for e in baseline.values()
+               if e.get("rule") in HOT_PATH_RULES]
+        if bad:
+            print("tpu_lint: baseline contains hot-path rule entries — "
+                  "these guard live perf bugs and may never be "
+                  "grandfathered:", file=sys.stderr)
+            for e in bad:
+                print(f"  {e['rule']} {e['path']} [{e.get('func', '?')}]",
+                      file=sys.stderr)
+            return 2
+
+    fresh = [f for f in findings if f.fingerprint() not in baseline]
+    for f in fresh:
+        print(f.render())
+    n_base = len(findings) - len(fresh)
+    if fresh:
+        print(f"tpu_lint: {len(fresh)} finding(s) "
+              f"({n_base} baselined)", file=sys.stderr)
+        return 1
+    suffix = f" ({n_base} baselined)" if n_base else ""
+    print(f"tpu_lint: clean{suffix}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
