@@ -318,6 +318,51 @@ def ckpt_ab(iters=ITERS):
     return rows
 
 
+def measure_watchdog(enabled, iters=ITERS):
+    """optimize() ms/step with the divergence watchdog off vs on (ISSUE 5
+    acceptance: the health fold-in — finite-check on loss + grad global
+    norm, the 3-column telemetry ring, the gated update — must cost <1%).
+    Both legs run at the SAME async depth (the watchdog caps depth at
+    `max_lag`; the A-B must not conflate that cadence change with the
+    in-step arithmetic)."""
+    o, _, _ = _build(iters)
+    depth = min(o._async_depth(), 8)
+    o._async_depth = lambda: depth
+    if enabled:
+        from bigdl_tpu.health import WatchdogConfig
+
+        o.set_watchdog(WatchdogConfig(max_lag=depth))
+    o.optimize()  # warm: compiles the step + telemetry-ring write
+    o.end_when = Trigger.max_iteration(2 * iters)
+    t0 = time.perf_counter()
+    o.optimize()
+    return (time.perf_counter() - t0) / iters
+
+
+def watchdog_ab(iters=ITERS, rounds=4):
+    """Watchdog off/on A-B; prints one row per leg + the overhead verdict.
+
+    The legs are INTERLEAVED (off, on, off, on, ...) and each leg takes
+    its min across rounds: on a shared host the background load drifts by
+    more than the effect under test, and back-to-back blocks would charge
+    that drift to whichever leg ran second."""
+    rows = {False: float("inf"), True: float("inf")}
+    for _ in range(rounds):
+        for enabled in (False, True):
+            rows[enabled] = min(rows[enabled],
+                                measure_watchdog(enabled, iters))
+    for enabled in (False, True):
+        print(json.dumps({
+            "path": "watchdog_ab", "watchdog": enabled,
+            "ms_per_step": round(rows[enabled] * 1e3, 2)}))
+    overhead = rows[True] / rows[False] - 1.0
+    print(json.dumps({
+        "metric": "watchdog_overhead_ok",
+        "value": bool(overhead < 0.01),
+        "overhead_pct": round(overhead * 100, 2)}))
+    return rows
+
+
 def lint_hotpath_ab(iters=ITERS):
     """A-B of the tpu_lint host-sync fixes (bigdl_tpu.analysis): each
     "before" leg re-injects the exact pattern the linter flagged, the
@@ -406,6 +451,8 @@ def main(argv=None):
                     help="run just the sync/async checkpoint A-B")
     ap.add_argument("--lint-hotpath", action="store_true",
                     help="A-B the tpu_lint host-sync fixes (quick capture)")
+    ap.add_argument("--watchdog", action="store_true",
+                    help="run just the divergence-watchdog off/on A-B")
     ap.add_argument("--iters", type=int, default=ITERS)
     args = ap.parse_args(argv)
     if args.feed_only:
@@ -416,6 +463,9 @@ def main(argv=None):
         return
     if args.lint_hotpath:
         lint_hotpath_ab(args.iters)
+        return
+    if args.watchdog:
+        watchdog_ab(args.iters)
         return
     lat, rere = measure_readback_latency()
     print(json.dumps({"metric": "env_readback_latency_ms",

@@ -90,6 +90,16 @@ class EngineConfig:
     # assembled batches exist at once.  0 = synchronous staging (the
     # pre-feed loop).  See docs/training.md "Input feed & overlap".
     feed_depth: int = 2
+    # Numeric-divergence watchdog (bigdl_tpu.health): a device-side finite
+    # check on loss + grad norm folded into the jitted step, with the
+    # skip -> lr_backoff -> rollback -> abort policy ladder.  Off by
+    # default: it adds one f32 to the step output and caps async_depth at
+    # the watchdog's max_lag.  See docs/training.md "Numeric health".
+    watchdog: bool = False
+    # Restore-time per-leaf CRC32C verification of checkpoint files
+    # against meta.json's integrity block (on by default — integrity is
+    # opt-out; pre-integrity checkpoints load unverified either way).
+    ckpt_verify: bool = True
 
     def parse_mesh(self) -> Optional[dict]:
         if not self.mesh_spec:
@@ -124,6 +134,8 @@ class EngineConfig:
             mesh_spec=os.environ.get(_PREFIX + "MESH"),
             async_depth=_env_int("ASYNC_DEPTH", 32),
             feed_depth=_env_int("FEED_DEPTH", 2),
+            watchdog=_env_bool("WATCHDOG", False),
+            ckpt_verify=_env_bool("CKPT_VERIFY", True),
         )
         if _PREFIX + "COORDINATOR_ADDRESS" in os.environ:
             cfg.coordinator_address = os.environ[_PREFIX + "COORDINATOR_ADDRESS"]

@@ -68,11 +68,16 @@ class DeviceFeed:
     """
 
     def __init__(self, batches: Iterable[Any], put_fn: Callable[[Any], Any],
-                 prefetch_depth: int = 2, name: str = "DeviceFeed"):
+                 prefetch_depth: int = 2, name: str = "DeviceFeed",
+                 stall_check: Optional[Callable[[], None]] = None):
         if prefetch_depth < 1:
             raise ValueError(f"prefetch_depth must be >= 1, got {prefetch_depth}")
         self.prefetch_depth = int(prefetch_depth)
         self._put = put_fn
+        # hang-watchdog hook: called each empty-queue poll in __next__ so
+        # a wedged worker raises StalledStep into the consumer instead of
+        # stalling the step loop until the phase deadline is forgotten
+        self._stall_check = stall_check
         self._it = iter(batches)
         self._q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_depth)
         self._stop = threading.Event()
@@ -147,6 +152,8 @@ class DeviceFeed:
                 item = self._q.get(timeout=0.05)
                 break
             except queue.Empty:
+                if self._stall_check is not None:
+                    self._stall_check()
                 if not self._thread.is_alive():
                     # the worker may have posted its last item (or _DONE)
                     # between our timeout and the aliveness check
@@ -264,8 +271,10 @@ class InlineFeed:
 
 
 def make_feed(batches: Iterable[Any], put_fn: Callable[[Any], Any],
-              prefetch_depth: int, name: str = "DeviceFeed"):
+              prefetch_depth: int, name: str = "DeviceFeed",
+              stall_check: Optional[Callable[[], None]] = None):
     """`prefetch_depth >= 1` -> async DeviceFeed; `<= 0` -> InlineFeed."""
     if prefetch_depth and prefetch_depth > 0:
-        return DeviceFeed(batches, put_fn, prefetch_depth, name=name)
+        return DeviceFeed(batches, put_fn, prefetch_depth, name=name,
+                          stall_check=stall_check)
     return InlineFeed(batches, put_fn)

@@ -11,11 +11,14 @@ fallback keeps everything working where g++ is absent.
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import struct
 from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
+
+logger = logging.getLogger("bigdl_tpu.dataset")
 
 from bigdl_tpu import native
 from bigdl_tpu.dataset.dataset import DataSet
@@ -31,9 +34,33 @@ def frame_record(record: bytes) -> bytes:
             record + struct.pack("<I", native.crc32c_masked(record)))
 
 
-def iter_framed(fh, what: str = "record") -> Iterator[bytes]:
+_warned_corrupt = [False]
+
+
+def _note_corrupt(on_corrupt, n: int, why: str) -> None:
+    """skip_corrupt bookkeeping: count through the caller's hook and warn
+    ONCE per process (every further skip is a counter increment, not log
+    spam — the per-run total surfaces via dataset.corrupt_records)."""
+    if on_corrupt is not None:
+        on_corrupt(n)
+    if not _warned_corrupt[0]:
+        _warned_corrupt[0] = True
+        logger.warning(
+            "skip_corrupt: dropping corrupt TFRecord data (%s); further "
+            "skips are counted silently — see the CorruptRecords metric",
+            why)
+
+
+def iter_framed(fh, what: str = "record", *, skip_corrupt: bool = False,
+                on_corrupt=None) -> Iterator[bytes]:
     """Iterate frames from an open binary file, verifying checksums;
-    raises IOError (never struct.error) on truncation or corruption."""
+    raises IOError (never struct.error) on truncation or corruption.
+
+    `skip_corrupt` drops records whose DATA crc mismatches (the framing
+    is intact, so the stream resyncs at the next header) instead of
+    raising; each drop calls `on_corrupt(1)` and warns once per process.
+    A corrupt length crc or truncation still raises — without a trusted
+    length there is no next frame to resync to."""
     while True:
         header = fh.read(12)
         if not header:
@@ -50,6 +77,9 @@ def iter_framed(fh, what: str = "record") -> Iterator[bytes]:
             raise IOError(f"truncated {what} body")
         (data_crc,) = struct.unpack("<I", tail)
         if native.crc32c_masked(data) != data_crc:
+            if skip_corrupt:
+                _note_corrupt(on_corrupt, 1, f"{what} data crc mismatch")
+                continue
             raise IOError(f"corrupt {what} data crc")
         yield data
 
@@ -112,10 +142,16 @@ class TFRecordWriter:
         self.close()
 
 
-def read_tfrecords(path: str) -> Iterator[bytes]:
-    """Iterate records of one file, verifying checksums."""
+def read_tfrecords(path: str, *, skip_corrupt: bool = False,
+                   on_corrupt=None) -> Iterator[bytes]:
+    """Iterate records of one file, verifying checksums.
+
+    `skip_corrupt` routes through the python framing reader (which can
+    resync past a bad data crc) even when the native reader is built —
+    the native reader stops a shard at the first corrupt frame, so the
+    lenient policy must own the framing to salvage the tail."""
     lib = native.get_lib()
-    if lib is not None:
+    if lib is not None and not skip_corrupt:
         h = lib.bigdl_tfrecord_reader_open(path.encode())
         if not h:
             raise IOError(f"cannot open {path}")
@@ -133,7 +169,9 @@ def read_tfrecords(path: str) -> Iterator[bytes]:
     else:
         with open(path, "rb") as f:
             try:
-                yield from iter_framed(f, "TFRecord")
+                yield from iter_framed(f, "TFRecord",
+                                       skip_corrupt=skip_corrupt,
+                                       on_corrupt=on_corrupt)
             except IOError as e:
                 raise IOError(f"{e} in {path}") from None
 
@@ -146,17 +184,23 @@ class PrefetchRecordReader:
     decode)."""
 
     def __init__(self, paths: Sequence[str], n_threads: int = 4,
-                 capacity: int = 256):
+                 capacity: int = 256, *, skip_corrupt: bool = False,
+                 on_corrupt=None):
         self.paths = list(paths)
         self._lib = native.get_lib()
         self._h = None
         self._n_threads = n_threads
         self._capacity = capacity
+        self.skip_corrupt = bool(skip_corrupt)
+        self._on_corrupt = on_corrupt
 
     def __iter__(self) -> Iterator[bytes]:
-        if self._lib is None:  # fallback: sequential python reader
+        if self._lib is None or self.skip_corrupt:
+            # python reader: sequential, but the only framing layer that
+            # can resync past a corrupt record (see read_tfrecords)
             for p in self.paths:
-                yield from read_tfrecords(p)
+                yield from read_tfrecords(p, skip_corrupt=self.skip_corrupt,
+                                          on_corrupt=self._on_corrupt)
             return
         arr = (ctypes.c_char_p * len(self.paths))(
             *[p.encode() for p in self.paths])
@@ -344,7 +388,7 @@ class ParsedExampleDataSet(DataSet):
                  label_key: str, n_threads: int = 4,
                  label_dtype: str = "int32",
                  sparse_features: Sequence[VarLenFeature] = (),
-                 feature_padding=None):
+                 feature_padding=None, skip_corrupt: bool = False):
         from bigdl_tpu.nn.tf_ops import ParseExample
 
         self.paths = list(paths)
@@ -357,10 +401,25 @@ class ParsedExampleDataSet(DataSet):
         self.label_dtype = label_dtype
         self.sparse_features = list(sparse_features)
         self.feature_padding = feature_padding
+        # skip_corrupt: drop records with a bad data crc (count + warn
+        # once) instead of killing the epoch — long-lived corpora on
+        # flaky storage rot one record at a time, and one bad record
+        # should cost one record, not the run.  Default strict.
+        self.skip_corrupt = bool(skip_corrupt)
+        self._corrupt = 0
         self._dense_shapes = [tuple(s) for s in dense_shapes]
         self._parser = ParseExample(dense_keys, dense_shapes)
         self._epoch = 0
         self._size = -1
+
+    @property
+    def corrupt_records(self) -> int:
+        """Records dropped by the skip_corrupt policy so far (the trainer
+        surfaces this as the CorruptRecords metric)."""
+        return self._corrupt
+
+    def _count_corrupt(self, n: int) -> None:
+        self._corrupt += int(n)
 
     def size(self) -> int:
         if self._size < 0:
@@ -383,7 +442,9 @@ class ParsedExampleDataSet(DataSet):
         li = self.dense_keys.index(self.label_key)
 
         def records():
-            it = PrefetchRecordReader(paths, n_threads=self.n_threads)
+            it = PrefetchRecordReader(paths, n_threads=self.n_threads,
+                                      skip_corrupt=self.skip_corrupt,
+                                      on_corrupt=self._count_corrupt)
             if rs is None:
                 yield from it
                 return

@@ -1,0 +1,58 @@
+"""bigdl_tpu.health — numeric-divergence watchdog, checkpoint integrity
+CRCs, and hang detection.
+
+Three failure families the process-level resilience layer (PR 3) cannot
+see, and what this package does about each:
+
+  numeric divergence  DivergenceWatchdog + a device-side finite check on
+                      loss and grad global-norm folded into the jitted
+                      train step (one extra scalar in the telemetry
+                      ring, zero added host syncs); policy ladder
+                      skip_batch -> lr_backoff -> rollback -> abort.
+  bit rot             CRC32C per array leaf, computed in the async
+                      checkpoint writer, stamped into meta.json and
+                      verified on restore; `latest_checkpoint` grows a
+                      fallback chain that skips corrupt or
+                      diverged-stamped checkpoints.
+  wedged runs         HangWatchdog monitor thread with per-phase
+                      deadlines; dumps all thread stacks and raises the
+                      retryable `StalledStep`.
+
+See docs/training.md "Numeric health, integrity & hang detection".
+"""
+
+from bigdl_tpu.health.integrity import (
+    CorruptCheckpointError,
+    INTEGRITY_COUNTERS,
+    leaf_crc,
+    reset_counters,
+    tree_crcs,
+    verify_enabled,
+    verify_flat,
+)
+from bigdl_tpu.health.watchdog import (
+    DivergenceAbort,
+    DivergenceWatchdog,
+    HangWatchdog,
+    NumericDivergence,
+    StalledStep,
+    WatchdogConfig,
+    dump_thread_stacks,
+)
+
+__all__ = [
+    "CorruptCheckpointError",
+    "DivergenceAbort",
+    "DivergenceWatchdog",
+    "HangWatchdog",
+    "INTEGRITY_COUNTERS",
+    "NumericDivergence",
+    "StalledStep",
+    "WatchdogConfig",
+    "dump_thread_stacks",
+    "leaf_crc",
+    "reset_counters",
+    "tree_crcs",
+    "verify_enabled",
+    "verify_flat",
+]

@@ -1,0 +1,119 @@
+"""Checkpoint integrity primitives: CRC32C per array leaf.
+
+Reference: the BigDL artifact path ships "generated protobuf + a CRC"
+(survey §2.6 / PAPER.md) — every serialized module carries a checksum the
+loader verifies.  Here the analogous artifact is the `ckpt_<step>/` dir:
+each flattened pytree leaf gets a CRC32C (bigdl_tpu.native.crc32c — the
+same native/pure-python pair the TFRecord framing uses) computed in the
+AsyncCheckpointer writer thread, stored under `meta.json["integrity"]`,
+and verified on restore.
+
+This module holds the PURE primitives (checksum a flat dict, compare two
+checksum maps) plus the process-wide counters the restore fallback chain
+feeds — it deliberately imports nothing from `utils.checkpoint` so both
+that module and `resilience.async_ckpt` can use it without a cycle.
+
+Verification is on by default and gated by `BIGDL_TPU_CKPT_VERIFY`
+(docs/training.md "Numeric health, integrity & hang detection").
+Checkpoints written before this schema addition have no `integrity` block
+and load without verification — old runs stay restorable.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from bigdl_tpu import native
+
+__all__ = [
+    "CorruptCheckpointError",
+    "INTEGRITY_COUNTERS",
+    "leaf_crc",
+    "reset_counters",
+    "tree_crcs",
+    "verify_enabled",
+    "verify_flat",
+]
+
+
+class CorruptCheckpointError(IOError):
+    """A checkpoint file failed its CRC32C (or could not be read at all).
+
+    Raised by `utils.checkpoint.verify_checkpoint` / `load_checkpoint`;
+    `latest_checkpoint(verify=True)` catches it per candidate and walks
+    the fallback chain instead of crashing the restore."""
+
+
+# Process-wide counters for the restore fallback chain (warn + METRIC per
+# the health contract): the trainer snapshots these into Metrics/summary
+# after a rollback restore.  Guarded by a lock — latest_checkpoint may be
+# called from the driver while the async writer commits.
+_lock = threading.Lock()
+INTEGRITY_COUNTERS: Dict[str, int] = {
+    "verified": 0,           # checkpoints that passed a full CRC verify
+    "corrupt_skipped": 0,    # candidates skipped for CRC/read failures
+    "unhealthy_skipped": 0,  # candidates skipped for a diverged verdict
+}
+
+
+def count(name: str, n: int = 1) -> None:
+    with _lock:
+        INTEGRITY_COUNTERS[name] = INTEGRITY_COUNTERS.get(name, 0) + n
+
+
+def reset_counters() -> None:
+    with _lock:
+        for k in INTEGRITY_COUNTERS:
+            INTEGRITY_COUNTERS[k] = 0
+
+
+def verify_enabled(override: Optional[bool] = None) -> bool:
+    """Restore-time CRC verification toggle: explicit override wins, else
+    `BIGDL_TPU_CKPT_VERIFY` (default ON — integrity is opt-out)."""
+    if override is not None:
+        return bool(override)
+    return os.environ.get("BIGDL_TPU_CKPT_VERIFY", "1").lower() in (
+        "1", "true", "yes", "on")
+
+
+def leaf_crc(arr: np.ndarray) -> int:
+    """CRC32C over one leaf's raw bytes.  dtype + shape are folded in via
+    a tiny header so a reinterpreted buffer (same bytes, different view)
+    cannot masquerade as the original tensor."""
+    a = np.ascontiguousarray(arr)
+    head = f"{a.dtype.str}:{a.shape}".encode()
+    crc = native.crc32c(head + a.tobytes())
+    return int(crc) & 0xFFFFFFFF
+
+
+def tree_crcs(flat: Dict[str, np.ndarray]) -> Dict[str, int]:
+    """Checksum map for one flattened pytree ({leaf key: crc32c})."""
+    return {key: leaf_crc(arr) for key, arr in flat.items()}
+
+
+def verify_flat(flat: Dict[str, np.ndarray], expected: Dict[str, int],
+                where: str) -> None:
+    """Compare a loaded flat dict against its stored checksum map.
+
+    Raises CorruptCheckpointError naming every failing leaf — a restore
+    that dies on integrity must say WHICH tensor rotted, not just that
+    something did.  Leaves present on disk but absent from the map (or
+    vice versa) count as corruption: a dropped/duplicated entry is as
+    fatal as a flipped bit."""
+    bad = []
+    for key, want in expected.items():
+        if key not in flat:
+            bad.append(f"{key} (missing from file)")
+            continue
+        got = leaf_crc(flat[key])
+        if got != int(want) & 0xFFFFFFFF:
+            bad.append(f"{key} (crc {got:#010x} != stored {int(want):#010x})")
+    extra = sorted(set(flat) - set(expected))
+    bad.extend(f"{key} (not in stored checksums)" for key in extra)
+    if bad:
+        raise CorruptCheckpointError(
+            f"checkpoint integrity failure in {where}: " + "; ".join(bad))

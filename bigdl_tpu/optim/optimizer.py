@@ -31,6 +31,7 @@ import logging
 import sys
 import time
 from collections import deque
+from contextlib import nullcontext
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -56,8 +57,17 @@ from bigdl_tpu.optim.regularizer import apply_regularizers, collect_regularizers
 from bigdl_tpu.optim.schedules import Plateau
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+from bigdl_tpu.health.integrity import verify_enabled as _ckpt_verify_enabled
+from bigdl_tpu.health.watchdog import (
+    DivergenceAbort,
+    DivergenceWatchdog,
+    HangWatchdog,
+    NumericDivergence,
+    WatchdogConfig,
+)
 from bigdl_tpu.resilience.async_ckpt import AsyncCheckpointer
 from bigdl_tpu.analysis.runtime import strict_transfers, strict_transfers_enabled
+from bigdl_tpu.resilience.chaos import POISON_GRAD, POISON_LOSS
 from bigdl_tpu.resilience.preemption import Preempted, clear_marker, write_marker
 from bigdl_tpu.utils.checkpoint import latest_checkpoint, load_checkpoint, save_checkpoint
 from bigdl_tpu.utils.summary import TrainSummary, ValidationSummary
@@ -95,6 +105,94 @@ def _ring_write(ring, slot, loss, lr):
     eaten).  NOT donated: pending holds per-step snapshots."""
     entry = jnp.stack([loss.astype(jnp.float32), lr.astype(jnp.float32)])
     return ring.at[slot].set(entry)
+
+
+@jax.jit
+def _ring_write_h(ring, slot, loss, lr, health):
+    """3-column ring writer for the watchdog path: (loss, lr, healthy).
+
+    A separate jitted function (not a width-polymorphic _ring_write) so
+    the watchdog-OFF hot loop keeps its exact existing program — zero
+    overhead when the feature is disabled.  Same no-packing-at-drain
+    rules as _ring_write."""
+    entry = jnp.stack([loss.astype(jnp.float32), lr.astype(jnp.float32),
+                       health.astype(jnp.float32)])
+    return ring.at[slot].set(entry)
+
+
+def _gate_tree(healthy, new, old):
+    """Device-side skip: keep `new` where the step was healthy, `old`
+    otherwise (the watchdog's skip_batch rung — the bad update never
+    lands, no host round-trip involved).  `healthy` is a traced bool
+    scalar; where() broadcasts it over every leaf."""
+    if new is None:
+        return None
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(healthy, n, o), new, old)
+
+
+def _finish_step_health(loss_fn, params, model_state, opt_state, lr,
+                        lr_scale, poison, optim, processors, regs, host_lr):
+    """Shared tail of every watchdog-enabled train step: poison -> grads
+    -> finite check on loss + grad global-norm -> gated update.
+
+    ONE extra f32 (the health flag) rides the telemetry ring; detection
+    is pure device math, so the strict transfer guard stays silent.  The
+    optimizer's step counter still advances on a skipped step — the
+    device neval must stay aligned with the driver's, or the per-step
+    rng folding would fork after the first skip."""
+    (loss, new_model_state), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(params)
+    # chaos: NaNInjector's device-side poison.  The loss poison is
+    # additive-constant wrt params (grads stay finite; detection is the
+    # loss isfinite); the grad poison lands on every leaf post-autodiff
+    # (loss stays finite; detection is the gnorm isfinite).
+    loss = loss + jnp.where(poison == POISON_LOSS,
+                            jnp.float32(jnp.nan), jnp.float32(0.0))
+    bad_g = jnp.where(poison == POISON_GRAD,
+                      jnp.float32(jnp.nan), jnp.float32(0.0))
+    grads = jax.tree_util.tree_map(
+        lambda g: g + bad_g.astype(g.dtype), grads)
+    grads = apply_regularizers(grads, params, regs)
+    for proc in processors:
+        grads = proc.process(grads)
+    # global grad norm (squared; the sqrt adds nothing to a finite check)
+    gnorm_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                   for g in jax.tree_util.tree_leaves(grads))
+    healthy = jnp.isfinite(loss) & jnp.isfinite(gnorm_sq)
+    # lr_backoff rung: a device-side scale on the effective lr, updated
+    # by re-putting ONE scalar — no recompile, no per-step transfer
+    lr_eff = (lr if host_lr else optim.current_lr(opt_state)) * lr_scale
+    new_params, new_opt_state = optim.step(grads, params, opt_state,
+                                           lr=lr_eff)
+    new_params = _gate_tree(healthy, new_params, params)
+    new_model_state = _gate_tree(healthy, new_model_state, model_state)
+    new_opt_state = _gate_tree(healthy, new_opt_state, opt_state)
+    # the counter advances even on a skip (see docstring)
+    new_opt_state = dict(new_opt_state, neval=opt_state["neval"] + 1)
+    return (new_params, new_model_state, new_opt_state, loss, lr_eff,
+            healthy.astype(jnp.float32))
+
+
+def _phase(hang, name):
+    """Hang-watchdog phase bracket, or a free nullcontext when disabled."""
+    return hang.phase(name) if hang is not None else nullcontext()
+
+
+def _guarded_iter(feed, hang):
+    """Iterate the feed with each blocking __next__ under the hang
+    watchdog's `feed_next` phase: a wedged assembly worker (or a source
+    that stops producing) raises StalledStep into the step loop instead
+    of parking it forever.  The in-between consumer work is NOT in the
+    phase — only the waits are on the clock."""
+    it = iter(feed)
+    while True:
+        with _phase(hang, "feed_next"):
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+        yield item
 
 
 _warned_shard_equiv = [False]
@@ -188,7 +286,16 @@ class Optimizer:
         self._preempt_guard = None
         self._chaos = None
         self._ckpt_fault = None
+        self._ckpt_corrupt = None
         self._resume_skip = 0  # batches of the current epoch already trained
+        # numeric-divergence watchdog (bigdl_tpu.health): None = follow
+        # BIGDL_TPU_WATCHDOG, False = forced off, WatchdogConfig = on.
+        # The DivergenceWatchdog instance persists across in-process
+        # restarts: the marked bad-step set and the rollback budget must
+        # outlive the trajectory they rolled back.
+        self._watchdog_cfg: Any = None
+        self._watchdog: Optional[DivergenceWatchdog] = None
+        self._hang: Optional[HangWatchdog] = None
         # summaries
         self.train_summary: Optional[TrainSummary] = None
         self.val_summary: Optional[ValidationSummary] = None
@@ -282,16 +389,57 @@ class Optimizer:
         self._strict_transfers = flag
         return self
 
-    def set_chaos(self, hook: Any = None, *,
-                  ckpt_fault: Any = None) -> "Optimizer":
+    def set_chaos(self, hook: Any = None, *, ckpt_fault: Any = None,
+                  ckpt_corrupt: Any = None) -> "Optimizer":
         """Deterministic fault injection (tests/benchmarks only):
         `hook.on_step(neval)` runs before every step dispatch and may
         raise (resilience.chaos.StepFaultInjector) or trigger the
-        preemption guard (SimulatedPreemption); `ckpt_fault` is passed to
-        the AsyncCheckpointer as its write-fault hook."""
+        preemption guard (SimulatedPreemption); a hook exposing
+        `poison_code(step)` (NaNInjector) poisons the step's numerics ON
+        DEVICE when the watchdog is enabled.  `ckpt_fault` is passed to
+        the AsyncCheckpointer as its write-fault hook; `ckpt_corrupt`
+        (BitFlipCheckpointFault) as its post-commit hook."""
         self._chaos = hook
         self._ckpt_fault = ckpt_fault
+        self._ckpt_corrupt = ckpt_corrupt
         return self
+
+    def set_watchdog(self, config: Any = True) -> "Optimizer":
+        """Numeric-divergence watchdog (bigdl_tpu.health): a finite check
+        on loss + gradient global-norm folded into the jitted step (one
+        extra f32 in the telemetry ring, zero added host syncs), with the
+        policy ladder skip_batch -> lr_backoff -> rollback_to_last_good
+        -> abort.  Rollback restores the newest checkpoint STAMPED
+        healthy (meta.json watchdog verdict) through the fault-tolerance
+        machinery and marks the offending step range so the replay skips
+        it without re-escalating.  Pass a `health.WatchdogConfig`, True
+        for defaults, or False to force off; default (unset) follows
+        `BIGDL_TPU_WATCHDOG`.  See docs/training.md "Numeric health"."""
+        if config is False or config is None:
+            self._watchdog_cfg = False
+            self._watchdog = None
+        elif config is True:
+            self._watchdog_cfg = WatchdogConfig()
+        else:
+            self._watchdog_cfg = config
+        self._compiled = None  # the step signature changes with the flag
+        self._compiled_key = None
+        return self
+
+    def _watchdog_enabled(self) -> bool:
+        if self._watchdog_cfg is None:
+            return bool(Engine.config().watchdog)
+        return self._watchdog_cfg is not False
+
+    def _ensure_watchdog(self) -> Optional[DivergenceWatchdog]:
+        if not self._watchdog_enabled():
+            return None
+        if self._watchdog is None:
+            cfg = self._watchdog_cfg \
+                if isinstance(self._watchdog_cfg, WatchdogConfig) \
+                else WatchdogConfig()
+            self._watchdog = DivergenceWatchdog(cfg)
+        return self._watchdog
 
     def set_train_summary(self, summary: TrainSummary) -> "Optimizer":
         self.train_summary = summary
@@ -456,7 +604,7 @@ class Optimizer:
         key = (self.compute_dtype, id(self.model), id(self.criterion),
                id(self.optim_method), self.mesh,
                tuple(self.processors), self._pipeline_axis(),
-               rules_key, self.batch_partition)
+               rules_key, self.batch_partition, self._watchdog_enabled())
         if self._compiled is not None and self._compiled_key == key:
             return self._compiled
         self._compiled = self._build_step_uncached()
@@ -475,8 +623,9 @@ class Optimizer:
         # answer at trace time anyway, and invites retraces (linter:
         # recompile rule) — bind the bool once, here
         host_lr = self._host_lr()
+        watchdog = self._watchdog_enabled()
 
-        def train_step(params, model_state, opt_state, x, y, rng, lr):
+        def make_loss_fn(model_state, x, y, rng):
             def loss_fn(p):
                 p = cast(p)
                 out, new_state = model.apply(p, model_state, cast(x),
@@ -486,8 +635,24 @@ class Optimizer:
                     new_state = _cast_floats(new_state, jnp.float32)
                     out = _cast_floats(out, jnp.float32)
                 return criterion.forward(out, y), new_state
+            return loss_fn
 
-            (loss, new_model_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        if watchdog:
+            # health variant: same math plus poison + finite check + gated
+            # update (_finish_step_health); two extra DEVICE scalar args
+            # (lr_scale, poison), one extra f32 output (the health flag)
+            def train_step_h(params, model_state, opt_state, x, y, rng, lr,
+                             lr_scale, poison):
+                return _finish_step_health(
+                    make_loss_fn(model_state, x, y, rng), params,
+                    model_state, opt_state, lr, lr_scale, poison, optim,
+                    processors, regs, host_lr)
+
+            return jax.jit(train_step_h, donate_argnums=(0, 1, 2))
+
+        def train_step(params, model_state, opt_state, x, y, rng, lr):
+            (loss, new_model_state), grads = jax.value_and_grad(
+                make_loss_fn(model_state, x, y, rng), has_aux=True)(params)
             # per-layer wRegularizer/bRegularizer contributions
             # (reference: accGradParameters + optim/Regularizer.scala)
             grads = apply_regularizers(grads, params, regs)
@@ -516,8 +681,9 @@ class Optimizer:
         cast = self._cast_compute
         has_policy = self.compute_dtype is not None
         host_lr = self._host_lr()
+        watchdog = self._watchdog_enabled()
 
-        def train_step(params, model_state, opt_state, x, y, rng, lr):
+        def make_loss_fn(model_state, x, y, rng):
             def loss_fn(p):
                 out, new_state = fwd(cast(p), model_state, cast(x), rng)
                 if has_policy:
@@ -527,9 +693,21 @@ class Optimizer:
                     new_state = _cast_floats(new_state, jnp.float32)
                     out = _cast_floats(out, jnp.float32)
                 return criterion.forward(out, y), new_state
+            return loss_fn
 
+        if watchdog:
+            def train_step_h(params, model_state, opt_state, x, y, rng, lr,
+                             lr_scale, poison):
+                return _finish_step_health(
+                    make_loss_fn(model_state, x, y, rng), params,
+                    model_state, opt_state, lr, lr_scale, poison, optim,
+                    processors, regs, host_lr)
+
+            return jax.jit(train_step_h, donate_argnums=(0, 1, 2))
+
+        def train_step(params, model_state, opt_state, x, y, rng, lr):
             (loss, new_model_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+                make_loss_fn(model_state, x, y, rng), has_aux=True)(params)
             grads = apply_regularizers(grads, params, regs)
             for proc in processors:
                 grads = proc.process(grads)
@@ -604,15 +782,55 @@ class Optimizer:
         attempt = 0
         if guard is not None:
             guard.install()
+        wd = self._ensure_watchdog()
+        if wd is not None and self._hang is None \
+                and wd.config.hang_deadlines is not None:
+            self._hang = HangWatchdog(wd.config.hang_deadlines,
+                                      poll_s=wd.config.hang_poll_s).start()
         try:
             while True:
                 try:
                     return self._optimize_impl()
-                except (KeyboardInterrupt, Preempted):
-                    # a preemption exit is intentional: the final
+                except (KeyboardInterrupt, Preempted, DivergenceAbort):
+                    # a preemption exit is intentional (the final
                     # checkpoint + marker are already on disk; restarting
-                    # here would fight the scheduler evicting us
+                    # would fight the scheduler evicting us), and
+                    # DivergenceAbort means the watchdog's own rollback
+                    # budget is spent — a restart would replay the same
+                    # divergence a sixth time
                     raise
+                except NumericDivergence as e:
+                    # watchdog rollback rung: restore the newest HEALTHY
+                    # checkpoint (verdict-stamped, CRC-verified) and
+                    # replay — the marked bad steps are skipped on device
+                    # without re-escalating.  Deliberately does NOT spend
+                    # the generic restart budget: max_rollbacks bounds
+                    # this path (note_rollback -> DivergenceAbort).
+                    if self.ckpt_path is None:
+                        raise
+                    self._ckpt_wait()
+                    ckpt = latest_checkpoint(self.ckpt_path, gc_partial=True,
+                                             require_healthy=True)
+                    if ckpt is None:
+                        raise
+                    wd = self._watchdog
+                    wd.note_rollback()
+                    logger.warning(
+                        "numeric divergence at step(s) %s: rolling back to "
+                        "%s (rollback %d/%d)", list(e.bad_steps), ckpt,
+                        wd.rollbacks, wd.config.max_rollbacks)
+                    self.metrics.add("rollback count", 1)
+                    if self.train_summary is not None:
+                        step = self._driver_state["neval"]
+                        self.train_summary.add_scalar(
+                            "RollbackCount", wd.rollbacks, step)
+                        self.train_summary.add_event(
+                            "rollback", {"to": ckpt,
+                                         "bad_steps": list(e.bad_steps)},
+                            step)
+                    if self._hang is not None:
+                        self._hang.clear()
+                    self._restore(ckpt)
                 except Exception:
                     # bounded restart from the latest COMMITTED checkpoint
                     # with exponential backoff — replaces the reference's
@@ -621,14 +839,17 @@ class Optimizer:
                     if attempt >= max_restarts or self.ckpt_path is None:
                         raise
                     attempt += 1
-                    if self._ckpt_writer is not None:
-                        self._ckpt_writer.wait()
-                    ckpt = latest_checkpoint(self.ckpt_path, gc_partial=True)
+                    self._ckpt_wait()
+                    ckpt = latest_checkpoint(
+                        self.ckpt_path, gc_partial=True,
+                        verify=_ckpt_verify_enabled(None) or None)
                     delay = min(backoff * (2 ** (attempt - 1)), cap)
                     logger.exception(
                         "training failed; restart %d/%d from %s after "
                         "%.2fs backoff", attempt, max_restarts,
                         ckpt or "current in-memory state", delay)
+                    if self._hang is not None:
+                        self._hang.clear()
                     if ckpt is not None:
                         self._restore(ckpt)
                     if delay > 0:
@@ -636,13 +857,35 @@ class Optimizer:
         finally:
             if guard is not None:
                 guard.uninstall()
+            if self._hang is not None:
+                self._hang.stop()
+                self._hang = None
             if self._ckpt_writer is not None:
                 self._ckpt_writer.close()
                 self._ckpt_writer = None
 
+    def _ckpt_wait(self) -> None:
+        """Drain the async writer under the hang watchdog's ckpt_wait
+        phase: a wedged writer thread (stuck remote fs) raises StalledStep
+        instead of blocking the driver indefinitely."""
+        if self._ckpt_writer is None:
+            return
+        hang = self._hang
+        with _phase(hang, "ckpt_wait"):
+            self._ckpt_writer.wait(
+                stall_check=hang.check if hang is not None else None)
+
     def _restore(self, ckpt_dir: str) -> None:
         self.params, self.model_state, self.opt_state, driver = load_checkpoint(
             ckpt_dir, self.params, self.model_state, self.opt_state)
+        # commit the restored host trees to device NOW: the next dispatch
+        # may run under strict_transfers, where a numpy leaf reaching the
+        # jitted step is an (intended-to-be-fatal) implicit h2d transfer
+        self.params = jax.device_put(self.params)
+        if self.model_state is not None:
+            self.model_state = jax.device_put(self.model_state)
+        if self.opt_state is not None:
+            self.opt_state = jax.device_put(self.opt_state)
         driver = dict(driver)
         seed = driver.pop("rng_seed", None)
         if seed is not None and int(seed) != RandomGenerator.get_seed():
@@ -653,6 +896,12 @@ class Optimizer:
                            "checkpoint (was %s)", seed,
                            RandomGenerator.get_seed())
             RandomGenerator.set_seed(int(seed))
+        # the watchdog verdict stamped at save time: a fresh process
+        # resuming after a rollback must keep skipping the marked bad
+        # steps (and must NOT copy the stamp into live driver state)
+        health = driver.pop("health", None)
+        if health is not None and self._ensure_watchdog() is not None:
+            self._watchdog.adopt_marked(health.get("bad_steps", ()))
         self._driver_state.update(driver)
         # mid-epoch checkpoints record how far into the epoch they are;
         # the epoch loop replays the SAME shuffled order (seek_epoch) and
@@ -663,7 +912,8 @@ class Optimizer:
         """Explicit resume (reference: Train --model/--state snapshots).
         Interrupted partial checkpoint dirs found next to the committed
         ones are garbage-collected with a warning."""
-        ckpt = latest_checkpoint(ckpt_path, gc_partial=True) \
+        ckpt = latest_checkpoint(ckpt_path, gc_partial=True,
+                                 verify=_ckpt_verify_enabled(None) or None) \
             if not ckpt_path.endswith(".json") else ckpt_path
         if ckpt is None:
             raise FileNotFoundError(f"no checkpoint under {ckpt_path}")
@@ -727,6 +977,13 @@ class Optimizer:
             self._pending_restore = None
 
         depth = self._async_depth()
+        # numeric-divergence watchdog: the drain's verdict must arrive at
+        # most max_lag steps after the bad step (the policy acts on what
+        # the drain reads), so the async depth is capped by it
+        wd = self._ensure_watchdog()
+        hang = self._hang
+        if wd is not None:
+            depth = min(depth, max(0, wd.config.max_lag))
         feed_depth = self._feed_depth()
         feed_ref = [None]  # current epoch's feed, for drain-side telemetry
         # (epoch, neval, bs, slot, ring_snapshot, feed_stall_s, feed_occ)
@@ -740,7 +997,14 @@ class Optimizer:
         host_lr = self._host_lr()
         strict = strict_transfers_enabled(self._strict_transfers)
         ring_cap = depth + 2  # burst span never exceeds depth+1 entries
-        ring = jnp.zeros((ring_cap, 2), jnp.float32)
+        ring = jnp.zeros((ring_cap, 3 if wd is not None else 2), jnp.float32)
+        # watchdog device scalars, re-put only on CHANGE (lr_backoff is a
+        # once-per-escalation event; poison codes repeat from a tiny set)
+        scale_cache = [None, None]       # [host float, device scalar]
+        poison_cache: Dict[int, Any] = {}  # code -> device scalar
+        poison_fn = getattr(self._chaos, "poison_code", None) \
+            if self._chaos is not None else None
+        corrupt_seen = [0]  # dataset corrupt-record count already reported
 
         def drain(keep: int):
             """Read back completed steps, keeping `keep` in flight.
@@ -771,7 +1035,7 @@ class Optimizer:
             # entries' slots are still intact in that snapshot (overwrites
             # only happen in newer snapshots).  See _ring_write for why no
             # packing program may run at drain time.
-            packed = np.asarray(burst[-1][4], np.float32)  # (ring_cap, 2)
+            packed = np.asarray(burst[-1][4], np.float32)  # (ring_cap, 2|3)
             now = time.perf_counter()
             dt_total = now - drain_clock[0]
             per_step = dt_total / len(burst) if dt_total > 1e-7 \
@@ -780,6 +1044,29 @@ class Optimizer:
             for ep, it, bs, slot, _, stall_s, occ in burst:
                 loss_f = float(packed[slot, 0])
                 lr_f = float(packed[slot, 1])
+                if wd is not None:
+                    # the health flag rode the same snapshot as the loss —
+                    # the verdict costs no extra transfer.  `it` is the
+                    # post-increment neval, so the step index is it - 1.
+                    # observe() may raise NumericDivergence (rollback) or
+                    # DivergenceAbort; both unwind to optimize()'s ladder.
+                    healthy = bool(packed[slot, 2] >= 0.5)
+                    action = wd.observe(it - 1, healthy)
+                    if action != "ok":
+                        self.metrics.add("health events", 1)
+                        self.metrics.add("skipped batches", 1)
+                        logger.warning(
+                            "health: step %d non-finite -> %s "
+                            "(skipped %d, lr_scale %g)", it - 1, action,
+                            wd.skipped, wd.lr_scale)
+                        if self.train_summary is not None:
+                            self.train_summary.add_scalar(
+                                "SkippedBatches", wd.skipped, it - 1)
+                            self.train_summary.add_scalar(
+                                "HealthEvents", len(wd.events), it - 1)
+                            self.train_summary.add_event(
+                                "health", {"action": action,
+                                           "lr_scale": wd.lr_scale}, it - 1)
                 state["loss"] = loss_f
                 throughput = bs / per_step
                 self.metrics.add("computing time", per_step)
@@ -814,6 +1101,18 @@ class Optimizer:
                     1e3 * sum(e[5] for e in burst) / len(burst),
                     sum(e[6] for e in burst) / len(burst),
                     feed.prefetch_depth, asm)
+            # tfrecord skip_corrupt telemetry: surface newly skipped
+            # records through the same drain cadence as the feed stats
+            corrupt = int(getattr(self.dataset, "corrupt_records", 0) or 0)
+            if corrupt > corrupt_seen[0]:
+                corrupt_seen[0] = corrupt
+                self.metrics.set("corrupt records", corrupt)
+                last_it = burst[-1][1]
+                if self.train_summary is not None:
+                    self.train_summary.add_scalar(
+                        "CorruptRecords", corrupt, last_it)
+                logger.warning("dataset: %d corrupt record(s) skipped so "
+                               "far (skip_corrupt policy)", corrupt)
 
         while not self._agreed_trigger(self.end_when, state):
             state["epoch_finished"] = False
@@ -845,10 +1144,17 @@ class Optimizer:
             # makes an end_when break, a raising step or a preemption exit
             # leak no thread.
             feed = make_feed(src, self._stage_batch, feed_depth,
-                             name="DeviceFeed-train")
+                             name="DeviceFeed-train",
+                             stall_check=hang.check if hang is not None
+                             else None)
             feed_ref[0] = feed
             try:
-                for item in feed:
+                for item in _guarded_iter(feed, hang):
+                    if hang is not None:
+                        # surface a stall another thread detected (e.g.
+                        # the writer wedged) at the batch boundary, where
+                        # the StalledStep is cleanly retryable
+                        hang.check()
                     if self._agreed_trigger(self.end_when, state):
                         completed_epoch = False
                         break
@@ -868,7 +1174,8 @@ class Optimizer:
                     # strict_transfers is a no-op unless enabled: any
                     # IMPLICIT transfer a future change sneaks into this
                     # dispatch section then raises at the offending line
-                    with strict_transfers(strict):
+                    with _phase(hang, "step_dispatch"), \
+                            strict_transfers(strict):
                         rng = _fold_in(root_key,
                                        _put_scalar(state["neval"]))
                         if host_lr:
@@ -884,15 +1191,44 @@ class Optimizer:
                             lr = lr_cache[1]
                         else:
                             lr = lr_zero  # unused; device schedule
-                        (self.params, self.model_state, self.opt_state,
-                         loss, lr_used) = step_fn(
-                            self.params, self.model_state, self.opt_state,
-                            x, y, rng, lr)
-                        state["neval"] += 1
-                        state["epoch_batch"] += 1
-                        slot = (state["neval"] - 1) % ring_cap
-                        ring = _ring_write(ring, _put_scalar(slot), loss,
-                                           lr_used)
+                        if wd is not None:
+                            # watchdog scalars: marked steps replay as
+                            # forced skips (poison code LOSS) so a rolled-
+                            # back trajectory never re-trains a bad step;
+                            # both device scalars are cached puts, not
+                            # per-step transfers
+                            if scale_cache[0] != wd.lr_scale:
+                                scale_cache[0] = wd.lr_scale
+                                scale_cache[1] = _put_scalar(wd.lr_scale,
+                                                             np.float32)
+                            code = poison_fn(state["neval"]) \
+                                if poison_fn is not None else 0
+                            if code == 0 and state["neval"] in wd.marked:
+                                code = POISON_LOSS
+                            pdev = poison_cache.get(code)
+                            if pdev is None:
+                                pdev = poison_cache.setdefault(
+                                    code, _put_scalar(code))
+                            (self.params, self.model_state, self.opt_state,
+                             loss, lr_used, health) = step_fn(
+                                self.params, self.model_state,
+                                self.opt_state, x, y, rng, lr,
+                                scale_cache[1], pdev)
+                            state["neval"] += 1
+                            state["epoch_batch"] += 1
+                            slot = (state["neval"] - 1) % ring_cap
+                            ring = _ring_write_h(ring, _put_scalar(slot),
+                                                 loss, lr_used, health)
+                        else:
+                            (self.params, self.model_state, self.opt_state,
+                             loss, lr_used) = step_fn(
+                                self.params, self.model_state,
+                                self.opt_state, x, y, rng, lr)
+                            state["neval"] += 1
+                            state["epoch_batch"] += 1
+                            slot = (state["neval"] - 1) % ring_cap
+                            ring = _ring_write(ring, _put_scalar(slot),
+                                               loss, lr_used)
                     pending.append((state["epoch"] + 1, state["neval"], bs,
                                     slot, ring, item.stall_s, item.occupancy))
                     drain(depth)
@@ -950,7 +1286,7 @@ class Optimizer:
             # optimize() returns — latest_checkpoint right after training
             # must see the final state
             t0 = time.perf_counter()
-            self._ckpt_writer.wait()
+            self._ckpt_wait()
             dt = time.perf_counter() - t0
             if dt > 1e-3:
                 logger.info("drained async checkpoint writer (%.2fs)", dt)
@@ -1094,7 +1430,8 @@ class Optimizer:
         if self._ckpt_writer is None:
             self._ckpt_writer = AsyncCheckpointer(
                 self.ckpt_path, keep_last=self.ckpt_keep_last,
-                keep_every=self.ckpt_keep_every, fault=self._ckpt_fault)
+                keep_every=self.ckpt_keep_every, fault=self._ckpt_fault,
+                post_commit=self._ckpt_corrupt)
         return self._ckpt_writer
 
     def _driver_snapshot(self, state) -> Dict[str, Any]:
@@ -1103,6 +1440,11 @@ class Optimizer:
         # the seed travels with the checkpoint so a fresh process resumes
         # the same step-rng stream and epoch shuffles
         driver["rng_seed"] = RandomGenerator.get_seed()
+        if self._watchdog is not None:
+            # stamp the watchdog verdict: rollback restores only from
+            # checkpoints whose stamp says the trajectory was healthy when
+            # they were taken (latest_checkpoint require_healthy)
+            driver["health"] = self._watchdog.verdict(state["neval"])
         return driver
 
     def _sync_save(self, state) -> str:
@@ -1155,11 +1497,12 @@ class Optimizer:
             getattr(feed, "delivered_batches", -1))
         ckpt_dir = None
         if self.ckpt_path is not None:
-            if self._ckpt_writer is not None:
-                self._ckpt_writer.wait()  # queued saves commit first
+            self._ckpt_wait()  # queued saves commit first
             ckpt_dir = self._sync_save(state)
             write_marker(self.ckpt_path, step=step, epoch=state["epoch"],
-                         checkpoint=ckpt_dir, reason=reason)
+                         checkpoint=ckpt_dir, reason=reason,
+                         health=self._watchdog.verdict(step)
+                         if self._watchdog is not None else None)
             logger.warning("preemption: final checkpoint %s and resumable "
                            "marker written", ckpt_dir)
         raise Preempted(reason, step=step, checkpoint=ckpt_dir)
@@ -1320,8 +1663,9 @@ class ParallelOptimizer(DistriOptimizer):
         regs = collect_regularizers(model)
         mesh = self.mesh
         host_lr = self._host_lr()
+        watchdog = self._watchdog_enabled()
 
-        def shard_step(params, model_state, opt_state, x, y, rng, lr):
+        def make_loss_fn(model_state, x, y, rng):
             def loss_fn(p):
                 out, new_state = model.apply(p, model_state, x, training=True,
                                              rng=rng)
@@ -1333,9 +1677,31 @@ class ParallelOptimizer(DistriOptimizer):
                 # those cotangent psums already happened.
                 local = criterion.forward(out, y)
                 return jax.lax.pmean(local, AXIS_DATA), new_state
+            return loss_fn
 
+        rep = P()
+        data = P(AXIS_DATA)
+        if watchdog:
+            # health flag, lr_scale and poison are replicated scalars; the
+            # pmean'd loss and psum'd grads feeding the finite check are
+            # replicated too, so the health out_spec is rep like the rest
+            def shard_step_h(params, model_state, opt_state, x, y, rng, lr,
+                             lr_scale, poison):
+                return _finish_step_health(
+                    make_loss_fn(model_state, x, y, rng), params,
+                    model_state, opt_state, lr, lr_scale, poison, optim,
+                    processors, regs, host_lr)
+
+            sharded_h = jax.shard_map(
+                shard_step_h, mesh=mesh,
+                in_specs=(rep, rep, rep, data, data, rep, rep, rep, rep),
+                out_specs=(rep, rep, rep, rep, rep, rep),
+                axis_names=frozenset({AXIS_DATA}))
+            return jax.jit(sharded_h, donate_argnums=(0, 1, 2))
+
+        def shard_step(params, model_state, opt_state, x, y, rng, lr):
             (loss, new_model_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+                make_loss_fn(model_state, x, y, rng), has_aux=True)(params)
             grads = apply_regularizers(grads, params, regs)
             for proc in processors:
                 grads = proc.process(grads)
@@ -1344,8 +1710,6 @@ class ParallelOptimizer(DistriOptimizer):
                 grads, params, opt_state, lr=(lr if host_lr else None))
             return new_params, new_model_state, new_opt_state, loss, lr_used
 
-        rep = P()
-        data = P(AXIS_DATA)
         # manual over 'data' only: the in/out specs constrain just the
         # data axis (params replicated over it), while tp/ep axes stay
         # AUTO — GSPMD propagates the rule-applied param shardings

@@ -18,8 +18,10 @@ Three cooperating parts close the gap:
     synchronous checkpoint at the exact current step, drains feed+writer,
     drops a `PREEMPTED.json` marker and raises `Preempted`.
   * `chaos` (chaos.py): deterministic, seeded fault injectors (step
-    exceptions, mid-file checkpoint write failures, simulated preemption)
-    so every recovery path above has a test that actually kills training.
+    exceptions, mid-file checkpoint write failures, simulated preemption,
+    device-side NaN poisoning, post-commit checkpoint bit flips) so every
+    recovery path above — and the bigdl_tpu.health watchdog ladder — has
+    a test that actually kills training.
 
 The `Optimizer` consumes all three: `set_checkpoint(..., async_save=,
 keep_last=, keep_every=)`, `set_preemption()`, `set_fault_tolerance(
@@ -34,8 +36,10 @@ from bigdl_tpu.resilience.async_ckpt import (
     committed_steps,
 )
 from bigdl_tpu.resilience.chaos import (
+    BitFlipCheckpointFault,
     ChaosStepFault,
     CheckpointWriteFault,
+    NaNInjector,
     SimulatedPreemption,
     StepFaultInjector,
     compose,
@@ -50,7 +54,9 @@ from bigdl_tpu.resilience.preemption import (
 
 __all__ = [
     "AsyncCheckpointer",
+    "BitFlipCheckpointFault",
     "ChaosStepFault",
+    "NaNInjector",
     "CheckpointWriteError",
     "CheckpointWriteFault",
     "Preempted",

@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu.health import integrity as _integrity
 from bigdl_tpu.utils.checkpoint import (
     SCHEMA_VERSION,
     _exists,
@@ -142,11 +143,15 @@ class AsyncCheckpointer:
         `save_async` (bounding host memory at queue_depth+1 snapshots)
     fault : chaos hook `f(relname) -> bool`; True makes the write of that
         file fail mid-file (tests of the partial-checkpoint recovery path)
+    post_commit : chaos hook `f(ckpt_dir)` invoked AFTER the atomic rename
+        commits a checkpoint — the BitFlipCheckpointFault attachment point
+        (bit-rot happens to committed files, not in-flight writes)
     """
 
     def __init__(self, path: str, *, keep_last: Optional[int] = None,
                  keep_every: Optional[int] = None, queue_depth: int = 2,
                  fault: Optional[Callable[[str], bool]] = None,
+                 post_commit: Optional[Callable[[str], None]] = None,
                  name: str = "AsyncCkptWriter"):
         if queue_depth < 1:
             raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
@@ -154,6 +159,7 @@ class AsyncCheckpointer:
         self.keep_last = keep_last
         self.keep_every = keep_every
         self._fault = fault
+        self._post_commit = post_commit
         self._name = name
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
         self._thread: Optional[threading.Thread] = None
@@ -225,13 +231,17 @@ class AsyncCheckpointer:
                         protect=protect)
         return d
 
-    def wait(self) -> None:
+    def wait(self, stall_check: Optional[Callable[[], None]] = None) -> None:
         """Barrier: every queued snapshot is committed (or failed+logged)
         when this returns.  End-of-training and every restore path call
-        this so `latest_checkpoint` sees the full commit history."""
-        self._drain()
+        this so `latest_checkpoint` sees the full commit history.
 
-    def _drain(self) -> None:
+        `stall_check` (the hang watchdog's `check`) is called each poll so
+        a wedged writer raises `StalledStep` into the driver instead of
+        blocking it forever."""
+        self._drain(stall_check)
+
+    def _drain(self, stall_check: Optional[Callable[[], None]] = None) -> None:
         """Bounded-step equivalent of `Queue.join()`: waits on the same
         all_tasks_done condition, but wakes every 100 ms to restart a
         writer that died outside its try block — a bare join() there
@@ -239,6 +249,8 @@ class AsyncCheckpointer:
         q = self._q
         with q.all_tasks_done:
             while q.unfinished_tasks:
+                if stall_check is not None:
+                    stall_check()
                 if not self._closed and (self._thread is None
                                          or not self._thread.is_alive()):
                     self._ensure_thread()
@@ -330,7 +342,12 @@ class AsyncCheckpointer:
             if tree is not None:
                 flats[name + ".npz"] = _flatten(tree)  # device->host here
         meta = {"schema_version": SCHEMA_VERSION, "step": job.step,
-                "driver_state": job.driver_state}
+                "driver_state": job.driver_state,
+                # per-leaf CRC32C computed HERE, in the writer thread —
+                # restore verifies against these (health/integrity.py);
+                # the step loop never pays for the checksum pass
+                "integrity": {n: _integrity.tree_crcs(f)
+                              for n, f in flats.items()}}
         final = _join(self.path, f"ckpt_{job.step}")
         if _is_remote(self.path):
             return self._write_remote(final, flats, meta)
@@ -359,6 +376,8 @@ class AsyncCheckpointer:
             os.fsync(dfd)
         finally:
             os.close(dfd)
+        if self._post_commit is not None:
+            self._post_commit(final)  # chaos: bit-rot a COMMITTED shard
         return final
 
     def _write_remote(self, final: str, flats: Dict[str, Dict],
@@ -373,6 +392,8 @@ class AsyncCheckpointer:
                 fh.write(buf.getbuffer())
         with _open(_join(final, "meta.json"), "w") as fh:
             json.dump(meta, fh, indent=2)
+        if self._post_commit is not None:
+            self._post_commit(final)
         return final
 
     def _write_file(self, path: str, payload, relname: str) -> None:

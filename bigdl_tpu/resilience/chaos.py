@@ -12,7 +12,14 @@ every failure mode a REPRODUCIBLE fixture:
     the partial-dir GC on resume;
   * `SimulatedPreemption` — triggers a PreemptionGuard at a step index,
     exercising the final-sync-save + marker + clean-drain path without
-    touching process signals.
+    touching process signals;
+  * `NaNInjector` — poisons the loss or gradients of exact (or seeded)
+    step indices ON DEVICE (the optimizer folds its poison code into the
+    jitted step), exercising the divergence watchdog's full policy ladder
+    skip -> lr_backoff -> rollback -> abort;
+  * `BitFlipCheckpointFault` — flips seeded byte(s) of a COMMITTED
+    checkpoint shard after the atomic rename, exercising the CRC32C
+    verify + restore fallback chain (bit-rot, not a torn write).
 
 Everything is seeded/step-indexed — no wall clock, no real randomness —
 so a failing recovery path replays bit-for-bit under pytest.  Hooks attach
@@ -109,6 +116,102 @@ class SimulatedPreemption:
             self.guard.trigger(self.reason)
 
 
+POISON_NONE = 0
+POISON_LOSS = 1
+POISON_GRAD = 2
+
+
+class NaNInjector:
+    """Poison the numerics of the steps in `fail_steps` — the divergence
+    watchdog's test fixture.
+
+    Unlike the other injectors this one does not raise on the host: the
+    optimizer queries `poison_code(step)` at dispatch and feeds the code
+    to the jitted step as a device scalar, which adds NaN to the loss
+    (`target="loss"`) or to every gradient leaf (`target="grad"`) ON
+    DEVICE — so the watchdog's detection path (finite-check folded into
+    the step, zero extra host syncs) is exercised end to end, not
+    shortcut by a host-side exception.
+
+    `persistent=True` (default) keeps poisoning a step every time it is
+    replayed — the shape that escalates the ladder and, after a rollback,
+    proves the marked-step skip; `persistent=False` poisons each index
+    once (a transient cosmic-ray batch the skip rung absorbs)."""
+
+    TARGETS = {"loss": POISON_LOSS, "grad": POISON_GRAD}
+
+    def __init__(self, fail_steps: Sequence[int] = (), *,
+                 seed: Optional[int] = None, horizon: Optional[int] = None,
+                 n_faults: int = 1, target: str = "loss",
+                 persistent: bool = True):
+        if target not in self.TARGETS:
+            raise ValueError(f"target must be one of {sorted(self.TARGETS)}, "
+                             f"got {target!r}")
+        steps = set(int(s) for s in fail_steps)
+        if seed is not None:
+            if not horizon:
+                raise ValueError("seeded injection needs `horizon` (the "
+                                 "step range to draw fail steps from)")
+            rs = np.random.RandomState(seed)
+            draw = rs.choice(np.arange(1, horizon),
+                             size=min(n_faults, horizon - 1), replace=False)
+            steps |= {int(s) for s in draw}
+        self.fail_steps: Set[int] = steps
+        self.target = target
+        self.persistent = persistent
+        self.fired: list = []
+
+    def on_step(self, step: int) -> None:
+        """No host-side fault — poisoning happens on device."""
+
+    def poison_code(self, step: int) -> int:
+        if step in self.fail_steps and (self.persistent
+                                        or step not in self.fired):
+            self.fired.append(step)
+            return self.TARGETS[self.target]
+        return POISON_NONE
+
+
+class BitFlipCheckpointFault:
+    """`post_commit=` hook for AsyncCheckpointer: xor seeded byte(s) of
+    `file` inside the `fail_on_save`-th COMMITTED checkpoint dir — silent
+    bit-rot the npz zip layer or the per-leaf CRC32C must catch on
+    restore.  Local paths only (the test fixture's scope)."""
+
+    def __init__(self, fail_on_save: int = 1, file: str = "params.npz", *,
+                 seed: int = 0, n_bytes: int = 1, n_failures: int = 1):
+        self.fail_on_save = int(fail_on_save)
+        self.file = file
+        self.seed = int(seed)
+        self.n_bytes = max(1, int(n_bytes))
+        self.n_failures = int(n_failures)
+        self.saves_seen = 0
+        self.fired: list = []
+
+    def __call__(self, ckpt_dir: str) -> None:
+        import os
+
+        self.saves_seen += 1
+        if self.saves_seen < self.fail_on_save \
+                or len(self.fired) >= self.n_failures:
+            return
+        path = os.path.join(ckpt_dir, self.file)
+        if not os.path.isfile(path):
+            return
+        size = os.path.getsize(path)
+        if size == 0:
+            return
+        rs = np.random.RandomState(self.seed + self.saves_seen)
+        offsets = rs.randint(0, size, size=self.n_bytes)
+        with open(path, "r+b") as fh:
+            for off in offsets:
+                fh.seek(int(off))
+                b = fh.read(1)
+                fh.seek(int(off))
+                fh.write(bytes([b[0] ^ 0x80]))
+        self.fired.append(ckpt_dir)
+
+
 def compose(*hooks) -> "_Composed":
     """One chaos hook fanning out to several injectors, in order."""
     return _Composed(hooks)
@@ -121,3 +224,14 @@ class _Composed:
     def on_step(self, step: int) -> None:
         for h in self.hooks:
             h.on_step(step)
+
+    def poison_code(self, step: int) -> int:
+        """Fan in: first non-zero poison wins (composing two NaNInjectors
+        on the same step is a fixture bug, not a real scenario)."""
+        for h in self.hooks:
+            fn = getattr(h, "poison_code", None)
+            if fn is not None:
+                code = fn(step)
+                if code:
+                    return code
+        return POISON_NONE

@@ -140,15 +140,21 @@ class PreemptionGuard:
 # ----------------------------------------------------------------------
 
 def write_marker(ckpt_path: str, *, step: int, epoch: int,
-                 checkpoint: Optional[str], reason: str) -> str:
+                 checkpoint: Optional[str], reason: str,
+                 health: Optional[Dict] = None) -> str:
     """Drop `PREEMPTED.json` under the checkpoint root: orchestrators (and
     humans) can tell an intentional preemption exit from a crash, and know
-    exactly which checkpoint resumes it."""
+    exactly which checkpoint resumes it.  `health` carries the divergence
+    watchdog's verdict at preemption time so an orchestrator can tell a
+    clean eviction from one that interrupted an unhealthy run."""
     marker = _join(ckpt_path, MARKER_NAME)
+    payload = {"step": int(step), "epoch": int(epoch),
+               "checkpoint": checkpoint, "reason": reason,
+               "resumable": checkpoint is not None}
+    if health is not None:
+        payload["health"] = health
     with _open(marker, "w") as fh:
-        json.dump({"step": int(step), "epoch": int(epoch),
-                   "checkpoint": checkpoint, "reason": reason,
-                   "resumable": checkpoint is not None}, fh, indent=2)
+        json.dump(payload, fh, indent=2)
     return marker
 
 

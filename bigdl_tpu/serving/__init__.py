@@ -34,7 +34,11 @@ from bigdl_tpu.serving.batcher import (
 )
 from bigdl_tpu.serving.metrics import LatencyHistogram, ServingMetrics
 from bigdl_tpu.serving.registry import ModelRegistry, ModelVersion
-from bigdl_tpu.serving.runtime import ServingConfig, ServingRuntime
+from bigdl_tpu.serving.runtime import (
+    NonFiniteOutput,
+    ServingConfig,
+    ServingRuntime,
+)
 
 __all__ = [
     "DeadlineExceeded",
@@ -42,6 +46,7 @@ __all__ = [
     "MicroBatcher",
     "ModelRegistry",
     "ModelVersion",
+    "NonFiniteOutput",
     "Rejected",
     "ServingClosed",
     "ServingConfig",

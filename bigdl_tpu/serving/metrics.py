@@ -108,6 +108,7 @@ class ServingMetrics:
         self.rejected_queue_full = 0
         self.rejected_deadline = 0
         self.rejected_shutdown = 0
+        self.rejected_nonfinite = 0
         self.batches = 0
         self.rows_real = 0
         self.rows_padded = 0
@@ -150,6 +151,13 @@ class ServingMetrics:
             self.total_ms.observe(total_ms)
             self.queue_depth = depth
 
+    def on_nonfinite(self) -> None:
+        """A request's OUTPUT rows contained NaN/Inf and the runtime's
+        reject_nonfinite guard refused to return them (health policy —
+        the serving dual of the trainer's divergence watchdog)."""
+        with self._lock:
+            self.rejected_nonfinite += 1
+
     def on_swap(self) -> None:
         with self._lock:
             self.swaps += 1
@@ -174,6 +182,7 @@ class ServingMetrics:
                 "rejected_queue_full": self.rejected_queue_full,
                 "rejected_deadline": self.rejected_deadline,
                 "rejected_shutdown": self.rejected_shutdown,
+                "rejected_nonfinite": self.rejected_nonfinite,
                 "batches": self.batches,
                 "batch_occupancy": round(self.occupancy, 4),
                 "per_bucket": per_bucket,
@@ -207,6 +216,7 @@ class ServingMetrics:
             f"{prefix}/batch_occupancy": snap["batch_occupancy"],
             f"{prefix}/rejected_queue_full": snap["rejected_queue_full"],
             f"{prefix}/rejected_deadline": snap["rejected_deadline"],
+            f"{prefix}/rejected_nonfinite": snap["rejected_nonfinite"],
             f"{prefix}/requests_completed": snap["requests_completed"],
             f"{prefix}/batches": snap["batches"],
         }

@@ -98,20 +98,31 @@ class ModelRegistry:
         `latest_checkpoint` (interrupted partial saves never load; the
         meta.json commit marker gates them out).  `version` defaults to
         the resolved dir's basename (e.g. "ckpt_1200"), so rolling
-        promotion from a training run is one call per save point."""
+        promotion from a training run is one call per save point.
+
+        Integrity: unless `BIGDL_TPU_CKPT_VERIFY` is off, the candidate's
+        per-leaf CRC32C checksums are verified before it can become a
+        serving version — root resolution walks PAST corrupt saves to the
+        newest intact one, and a directly-named corrupt dir raises
+        `CorruptCheckpointError` instead of serving flipped bits."""
         import os
 
-        from bigdl_tpu.utils.checkpoint import latest_checkpoint
+        from bigdl_tpu.health.integrity import verify_enabled
+        from bigdl_tpu.utils.checkpoint import (latest_checkpoint,
+                                                verify_checkpoint)
 
+        verify = verify_enabled(None)
         ckpt_dir = path
         base = os.path.basename(str(path).rstrip("/"))
         if not (base.startswith("ckpt_")
                 and base[len("ckpt_"):].isdigit()):
-            resolved = latest_checkpoint(path)
+            resolved = latest_checkpoint(path, verify=verify or None)
             if resolved is None:
                 raise FileNotFoundError(
                     f"no committed checkpoint under {path!r}")
             ckpt_dir = resolved
+        elif verify:
+            verify_checkpoint(ckpt_dir)
         if version is None:
             version = os.path.basename(str(ckpt_dir).rstrip("/"))
         return self.register_checkpoint(version, ckpt_dir, activate=activate)

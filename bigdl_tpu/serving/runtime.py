@@ -39,13 +39,21 @@ from bigdl_tpu.serving.metrics import ServingMetrics
 from bigdl_tpu.serving.registry import ModelRegistry, ModelVersion
 
 
+class NonFiniteOutput(RuntimeError):
+    """The model produced NaN/Inf in this request's output rows and the
+    runtime's `reject_nonfinite` guard refused to return them (serving's
+    dual of the trainer's divergence watchdog: a poisoned model version
+    fails requests loudly instead of shipping garbage scores)."""
+
+
 class ServingConfig:
     """Knobs for the micro-batching scheduler (docs/serving.md)."""
 
     def __init__(self, buckets: Sequence[int] = (1, 8, 32),
                  max_wait_ms: float = 2.0, capacity: int = 128,
                  default_deadline_ms: Optional[float] = None,
-                 strict_transfers: Optional[bool] = None):
+                 strict_transfers: Optional[bool] = None,
+                 reject_nonfinite: bool = False):
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.max_wait_ms = float(max_wait_ms)
         self.capacity = int(capacity)
@@ -53,6 +61,10 @@ class ServingConfig:
         # None = env BIGDL_TPU_STRICT_TRANSFERS; True wraps every batch
         # dispatch in jax.transfer_guard("disallow") (docs/analysis.md)
         self.strict_transfers = strict_transfers
+        # per-request non-finite output guard: a request whose OWN rows
+        # contain NaN/Inf gets NonFiniteOutput; finite co-batched rows
+        # still succeed.  Costs one np.isfinite pass over host outputs.
+        self.reject_nonfinite = bool(reject_nonfinite)
 
 
 def _concat_rows(xs: List[Any]) -> Any:
@@ -162,6 +174,7 @@ class ServingRuntime:
         self.metrics.on_batch(bucket, rows, (t_done - t_dispatch) * 1e3)
         off = 0
         depth = self._batcher.queue_depth
+        reject_nonfinite = self.config.reject_nonfinite
         for req in requests:
             out = _slice_rows(y, off, off + req.rows)
             off += req.rows
@@ -170,6 +183,14 @@ class ServingRuntime:
                 "queue_ms": (t_dispatch - req.t_enqueue) * 1e3,
                 "batch_ms": (t_done - t_dispatch) * 1e3,
             }
+            if reject_nonfinite and not _rows_finite(out):
+                # per-request: only the poisoned rows fail; finite rows
+                # co-batched with them still resolve normally below
+                self.metrics.on_nonfinite()
+                req.future.set_error(NonFiniteOutput(
+                    f"non-finite values in output rows (model version "
+                    f"{snap.version!r}, bucket {bucket})"))
+                continue
             self.metrics.on_complete((t_dispatch - req.t_enqueue) * 1e3,
                                      (t_done - req.t_enqueue) * 1e3, depth)
             req.future.set_result(out)
@@ -234,6 +255,19 @@ class ServingRuntime:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def _rows_finite(out: Any) -> bool:  # tpu-lint: disable=host-sync
+    """True when every float leaf of one request's output is finite
+    (int/bool outputs are finite by construction).  Leaves are host rows
+    already — sliced from the one post-batch d2h — so the np calls here
+    are no-op wraps, not device syncs."""
+    leaves = out if isinstance(out, list) else [out]
+    for leaf in leaves:
+        a = np.asarray(leaf)
+        if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
+            return False
+    return True
 
 
 def _slice_rows_like(x: Any, lo: int, hi: int) -> Any:

@@ -29,6 +29,9 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from bigdl_tpu.health import integrity as _integrity
+from bigdl_tpu.health.integrity import CorruptCheckpointError
+
 logger = logging.getLogger("bigdl_tpu.checkpoint")
 
 SCHEMA_VERSION = 1
@@ -203,13 +206,18 @@ def save_checkpoint(path: str, step: int, params: Any, model_state: Any = None,
         if opt_state is not None else None
     if writer:
         _makedirs(d)
-        meta = {"schema_version": SCHEMA_VERSION, "step": int(step),
-                "driver_state": driver_state or {}}
-        _savez(_join(d, "params.npz"), flat_p)
+        named = {"params.npz": flat_p}
         if flat_ms is not None:
-            _savez(_join(d, "model_state.npz"), flat_ms)
+            named["model_state.npz"] = flat_ms
         if flat_os is not None:
-            _savez(_join(d, "opt_state.npz"), flat_os)
+            named["opt_state.npz"] = flat_os
+        meta = {"schema_version": SCHEMA_VERSION, "step": int(step),
+                "driver_state": driver_state or {},
+                # per-leaf CRC32C, verified on restore (health/integrity.py)
+                "integrity": {n: _integrity.tree_crcs(f)
+                              for n, f in named.items()}}
+        for n, f in named.items():
+            _savez(_join(d, n), f)
         with _open(_join(d, "meta.json"), "w") as f:
             json.dump(meta, f, indent=2)
     if jax.process_count() > 1:
@@ -221,13 +229,20 @@ def save_checkpoint(path: str, step: int, params: Any, model_state: Any = None,
 
 def load_checkpoint(ckpt_dir: str, params_template: Any,
                     model_state_template: Any = None,
-                    opt_state_template: Any = None) -> Tuple[Any, Any, Any, Dict]:
+                    opt_state_template: Any = None,
+                    verify: Optional[bool] = None) -> Tuple[Any, Any, Any, Dict]:
     """Returns (params, model_state, opt_state, driver_state).
 
     Multi-process: collective — EVERY process must call.  Only process 0
     reads the filesystem (the writer side mirrors this); the loaded values
     are broadcast to all processes, so hosts without a shared filesystem
-    resume identically."""
+    resume identically.
+
+    `verify` gates per-leaf CRC32C checks against meta.json's `integrity`
+    block (None defers to `BIGDL_TPU_CKPT_VERIFY`, default ON).  A
+    mismatch — or any unreadable file — raises CorruptCheckpointError;
+    checkpoints from before the integrity schema load unverified."""
+    verify = _integrity.verify_enabled(verify)
     reader = jax.process_count() <= 1 or jax.process_index() == 0
     meta = {"schema_version": SCHEMA_VERSION, "driver_state": {}}
     if reader:
@@ -236,6 +251,7 @@ def load_checkpoint(ckpt_dir: str, params_template: Any,
         if meta.get("schema_version") != SCHEMA_VERSION:
             raise ValueError(
                 f"unsupported checkpoint schema {meta.get('schema_version')}")
+    expected_crcs = meta.get("integrity") if verify else None
 
     # File presence is decided by the reader and agreed collectively, so
     # every process takes the same branch (loads+broadcast vs None).
@@ -259,8 +275,23 @@ def load_checkpoint(ckpt_dir: str, params_template: Any,
             return None
         p = _join(ckpt_dir, name)
         if reader:
-            with _loadz(p) as z:
-                return _unflatten_into(template, dict(z))
+            # npz is a zip: a flipped bit usually surfaces as a BadZipFile
+            # or zlib error from np.load rather than wrong bytes, so ANY
+            # read failure under verification is an integrity failure —
+            # the fallback chain must treat both identically
+            try:
+                with _loadz(p) as z:
+                    flat = dict(z)
+            except CorruptCheckpointError:
+                raise
+            except Exception as e:
+                if expected_crcs is not None:
+                    raise CorruptCheckpointError(
+                        f"checkpoint file {p} unreadable: {e}") from e
+                raise
+            if expected_crcs is not None and name in expected_crcs:
+                _integrity.verify_flat(flat, expected_crcs[name], p)
+            return _unflatten_into(template, flat)
         # non-reader: zeros placeholder in template structure, overwritten
         # by the broadcast below
         return jax.tree_util.tree_map(
@@ -269,6 +300,8 @@ def load_checkpoint(ckpt_dir: str, params_template: Any,
     params = load_npz("params.npz", params_template, present[0])
     model_state = load_npz("model_state.npz", model_state_template, present[1])
     opt_state = load_npz("opt_state.npz", opt_state_template, present[2])
+    if reader and expected_crcs is not None:
+        _integrity.count("verified")
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
@@ -305,6 +338,48 @@ def load_params(ckpt_dir: str, params_template: Any,
     return params, model_state
 
 
+def verify_checkpoint(ckpt_dir: str) -> Dict:
+    """Full integrity pass over one committed checkpoint dir: every file
+    named in meta.json's `integrity` block is read back and every leaf's
+    CRC32C compared.  Returns the parsed meta on success; raises
+    CorruptCheckpointError on any mismatch or unreadable file.  A
+    pre-integrity checkpoint (no block) passes vacuously — old runs stay
+    restorable.
+
+    Local-only (no collective): callers are process 0's restore/registry
+    paths, which already own the filesystem decision."""
+    try:
+        with _open(_join(ckpt_dir, "meta.json"), "r") as f:
+            meta = json.load(f)
+    except Exception as e:
+        raise CorruptCheckpointError(
+            f"checkpoint {ckpt_dir} meta.json unreadable: {e}") from e
+    for name, expected in (meta.get("integrity") or {}).items():
+        p = _join(ckpt_dir, name)
+        try:
+            with _loadz(p) as z:
+                flat = dict(z)
+        except Exception as e:
+            raise CorruptCheckpointError(
+                f"checkpoint file {p} unreadable: {e}") from e
+        _integrity.verify_flat(flat, expected, p)
+    return meta
+
+
+def checkpoint_health(ckpt_dir: str) -> Dict:
+    """The watchdog verdict stamped into a checkpoint's driver_state
+    (`{"verdict": "healthy"|"diverged", "bad_steps": [...]}`).  Missing
+    stamp (pre-health checkpoints, or watchdog off) reads as healthy."""
+    try:
+        with _open(_join(ckpt_dir, "meta.json"), "r") as f:
+            meta = json.load(f)
+    except Exception as e:
+        raise CorruptCheckpointError(
+            f"checkpoint {ckpt_dir} meta.json unreadable: {e}") from e
+    return (meta.get("driver_state") or {}).get("health") \
+        or {"verdict": "healthy", "bad_steps": []}
+
+
 def gc_partial_checkpoints(path: str) -> List[str]:
     """Reclaim interrupted checkpoint debris under `path`: `ckpt_<N>` dirs
     missing their meta.json commit marker (a save killed mid-write) and
@@ -333,18 +408,31 @@ def gc_partial_checkpoints(path: str) -> List[str]:
     return removed
 
 
-def latest_checkpoint(path: str, gc_partial: bool = False) -> Optional[str]:
+def latest_checkpoint(path: str, gc_partial: bool = False, *,
+                      verify: Optional[bool] = None,
+                      require_healthy: bool = False) -> Optional[str]:
     """Newest COMMITTED ckpt dir under `path`, agreed across processes
     (collective when multi-process): only process 0's filesystem answer
     counts — checkpoints are written by process 0, so on hosts without a
     shared filesystem the others see nothing yet must resume the SAME step.
 
     `gc_partial=True` (resume paths only) deletes interrupted partial
-    checkpoint dirs with a warning instead of silently skipping them."""
+    checkpoint dirs with a warning instead of silently skipping them.
+
+    Fallback chain: with `verify=True` (or None + `BIGDL_TPU_CKPT_VERIFY`
+    on, when either gate is requested) candidates are walked NEWEST FIRST
+    and any that fails its CRC32C pass is skipped with a warning + counter
+    instead of crashing the restore; `require_healthy=True` additionally
+    skips checkpoints whose meta carries a diverged watchdog verdict (the
+    rollback path — "last good" means last stamped healthy).  Plain calls
+    (both gates off) keep the original single-stat fast path."""
+    check_crc = verify is True or (
+        require_healthy and _integrity.verify_enabled(verify))
     best_step = -1
     if jax.process_count() <= 1 or jax.process_index() == 0:
         if gc_partial:
             gc_partial_checkpoints(path)
+        steps: List[int] = []
         if _isdir(path):
             for name in _listdir(path):
                 m = re.fullmatch(r"ckpt_(\d+)", name)
@@ -352,7 +440,32 @@ def latest_checkpoint(path: str, gc_partial: bool = False) -> Optional[str]:
                 # interrupted save and must not block resume from the
                 # previous intact checkpoint
                 if m and _exists(_join(path, name, "meta.json")):
-                    best_step = max(best_step, int(m.group(1)))
+                    steps.append(int(m.group(1)))
+        if not (check_crc or require_healthy):
+            best_step = max(steps, default=-1)
+        else:
+            for s in sorted(steps, reverse=True):
+                d = _join(path, f"ckpt_{s}")
+                try:
+                    if require_healthy:
+                        h = checkpoint_health(d)
+                        if h.get("verdict") == "diverged":
+                            _integrity.count("unhealthy_skipped")
+                            logger.warning(
+                                "restore fallback: skipping %s — stamped "
+                                "diverged (bad steps %s)", d,
+                                h.get("bad_steps"))
+                            continue
+                    if check_crc:
+                        verify_checkpoint(d)
+                except CorruptCheckpointError as e:
+                    _integrity.count("corrupt_skipped")
+                    logger.warning(
+                        "restore fallback: skipping corrupt checkpoint "
+                        "%s: %s", d, e)
+                    continue
+                best_step = s
+                break
     best_step = agree_from_process_zero(best_step)
     if best_step < 0:
         return None

@@ -30,7 +30,9 @@ class Summary:
         self.dir = os.path.join(log_dir, app_name, kind)
         os.makedirs(self.dir, exist_ok=True)
         self.path = os.path.join(self.dir, "scalars.jsonl")
+        self.events_path = os.path.join(self.dir, "events.jsonl")
         self._fh = open(self.path, "a")
+        self._efh = None  # events.jsonl opened lazily: most runs have none
         self._writer = FileWriter(self.dir)
         self._triggers: Dict[str, int] = {}
 
@@ -65,8 +67,34 @@ class Summary:
                     out.append((rec["step"], rec["value"]))
         return out
 
+    def add_event(self, kind: str, payload: Dict, step: int) -> None:
+        """Structured (non-scalar) happenings — watchdog skips/backoffs/
+        rollbacks, restore fallbacks — as an append-only `events.jsonl`
+        stream next to the scalars: a post-mortem needs WHICH steps were
+        skipped and WHY, not just a counter's final value."""
+        if self._efh is None:
+            self._efh = open(self.events_path, "a")
+        rec = {"kind": kind, "step": int(step), "wall_time": time.time(),
+               **payload}
+        self._efh.write(json.dumps(rec) + "\n")
+        self._efh.flush()
+
+    def read_events(self, kind: Optional[str] = None) -> List[Dict]:
+        """Read back the event stream, optionally filtered by kind."""
+        out: List[Dict] = []
+        if not os.path.exists(self.events_path):
+            return out
+        with open(self.events_path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if kind is None or rec.get("kind") == kind:
+                    out.append(rec)
+        return out
+
     def close(self) -> None:
         self._fh.close()
+        if self._efh is not None:
+            self._efh.close()
         self._writer.close()
 
 

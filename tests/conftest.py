@@ -70,7 +70,8 @@ def _thread_leak_guard():
                 if t not in before and t.is_alive()
                 and (not t.daemon
                      or t.name.startswith(("DeviceFeed", "AsyncCkptWriter",
-                                           "serving-batcher")))]
+                                           "serving-batcher",
+                                           "HealthWatchdog")))]
 
     yield
     # grace for threads mid-shutdown (close() joins, but a worker that

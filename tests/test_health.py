@@ -1,0 +1,574 @@
+"""Health subsystem: divergence watchdog, checkpoint integrity, hang
+detection (bigdl_tpu.health).
+
+The acceptance contract: with a NaNInjector firing persistent NaN at step
+k, the watchdog's rollback restores the last HEALTHY checkpoint and the
+run completes with params BITWISE-equal to a run whose bad steps were
+skipped on device and never landed — feed on or off, under strict
+transfers.  Plus the integrity half: a bit-flipped committed shard is
+detected by its per-leaf CRC32C and the restore fallback chain walks past
+it; and the hang half: a wedged feed blows its phase deadline, raises the
+retryable StalledStep, and the restart loop recovers the run.
+"""
+
+import os
+import struct
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import optim
+from bigdl_tpu.core.random import RandomGenerator
+from bigdl_tpu.dataset import ArrayDataSet, Sample, SampleToMiniBatch
+from bigdl_tpu.health import (
+    INTEGRITY_COUNTERS,
+    CorruptCheckpointError,
+    DivergenceAbort,
+    DivergenceWatchdog,
+    HangWatchdog,
+    NumericDivergence,
+    StalledStep,
+    WatchdogConfig,
+    dump_thread_stacks,
+    leaf_crc,
+    reset_counters,
+    tree_crcs,
+    verify_enabled,
+    verify_flat,
+)
+from bigdl_tpu.optim import SGD, Trigger
+from bigdl_tpu.resilience import (
+    AsyncCheckpointer,
+    BitFlipCheckpointFault,
+    NaNInjector,
+)
+from bigdl_tpu.utils.checkpoint import (
+    checkpoint_health,
+    latest_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+    verify_checkpoint,
+)
+
+
+def make_dataset(n=64, dim=8, batch=8, seed=7):
+    rs = np.random.RandomState(seed)
+    samples = [Sample.from_ndarray(rs.randn(dim).astype(np.float32),
+                                   rs.randn(4).astype(np.float32))
+               for _ in range(n)]
+    return ArrayDataSet(samples).transform(SampleToMiniBatch(batch))
+
+
+def param_leaves(o):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(o.params)]
+
+
+def assert_bitwise_equal(a_leaves, b_leaves):
+    assert len(a_leaves) == len(b_leaves)
+    for a, b in zip(a_leaves, b_leaves):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+
+
+def _xor_bytes(path, offsets, mask=0x80):
+    with open(path, "r+b") as fh:
+        for off in offsets:
+            fh.seek(off)
+            b = fh.read(1)
+            fh.seek(off)
+            fh.write(bytes([b[0] ^ mask]))
+
+
+def _corrupt_npz(ckpt_dir, name="params.npz"):
+    """Flip bytes spread through the file: at least one lands in a zip
+    member's data or structure, so np.load or the leaf CRC must object."""
+    p = os.path.join(ckpt_dir, name)
+    size = os.path.getsize(p)
+    _xor_bytes(p, [size // 3, size // 2, 2 * size // 3])
+
+
+def _record_offsets(path):
+    """(frame_offset, data_length) per record of a TFRecord file."""
+    offs = []
+    with open(path, "rb") as fh:
+        off = 0
+        while True:
+            fh.seek(off)
+            header = fh.read(12)
+            if not header:
+                return offs
+            (length,) = struct.unpack("<Q", header[:8])
+            offs.append((off, length))
+            off += 12 + length + 4
+
+
+# ----------------------------------------------------------------------
+# Integrity primitives
+# ----------------------------------------------------------------------
+
+class TestIntegrityPrimitives:
+    def test_leaf_crc_deterministic_and_byte_sensitive(self):
+        a = np.arange(32, dtype=np.float32).reshape(4, 8)
+        assert leaf_crc(a) == leaf_crc(a.copy())
+        b = a.copy()
+        b.view(np.uint8).reshape(-1)[5] ^= 0x01
+        assert leaf_crc(b) != leaf_crc(a)
+
+    def test_leaf_crc_folds_dtype_and_shape(self):
+        a = np.arange(8, dtype=np.float32)
+        assert leaf_crc(a) != leaf_crc(a.view(np.int32))
+        assert leaf_crc(a) != leaf_crc(a.reshape(2, 4))
+
+    def test_verify_flat_names_the_offending_leaf(self):
+        flat = {"w": np.ones(4, np.float32), "b": np.zeros(2, np.float32)}
+        crcs = tree_crcs(flat)
+        verify_flat(flat, crcs, "ok")  # clean pass
+
+        bad = dict(flat, w=np.full(4, 7.0, np.float32))
+        with pytest.raises(CorruptCheckpointError, match="w"):
+            verify_flat(bad, crcs, "here")
+        with pytest.raises(CorruptCheckpointError, match="missing from file"):
+            verify_flat({"w": flat["w"]}, crcs, "here")
+        with pytest.raises(CorruptCheckpointError, match="not in stored"):
+            verify_flat(dict(flat, extra=np.ones(1, np.float32)), crcs, "here")
+
+    def test_verify_enabled_env(self, monkeypatch):
+        monkeypatch.delenv("BIGDL_TPU_CKPT_VERIFY", raising=False)
+        assert verify_enabled(None) is True  # integrity is opt-out
+        monkeypatch.setenv("BIGDL_TPU_CKPT_VERIFY", "0")
+        assert verify_enabled(None) is False
+        assert verify_enabled(True) is True  # explicit override wins
+        monkeypatch.setenv("BIGDL_TPU_CKPT_VERIFY", "on")
+        assert verify_enabled(None) is True
+
+
+# ----------------------------------------------------------------------
+# Divergence policy ladder (host-side, no device)
+# ----------------------------------------------------------------------
+
+class TestDivergenceLadder:
+    def test_skip_backoff_rollback_abort_progression(self):
+        wd = DivergenceWatchdog(WatchdogConfig(
+            skip_limit=1, backoff_factor=0.5, max_backoffs=1,
+            max_rollbacks=1, hang_deadlines=None))
+        assert wd.observe(0, True) == "ok"
+        assert wd.observe(1, False) == "skip"
+        assert wd.observe(2, False) == "lr_backoff"
+        assert wd.lr_scale == 0.5 and wd.backoffs == 1
+        assert wd.observe(3, False) == "skip"  # backoff reset the streak
+        with pytest.raises(NumericDivergence) as ei:
+            wd.observe(4, False)
+        assert ei.value.bad_steps == (1, 2, 3, 4)
+        assert wd.marked == {1, 2, 3, 4}
+        wd.note_rollback()
+        assert wd.rollbacks == 1
+        # replaying a marked step skips without re-escalating
+        assert wd.observe(3, False) == "skip"
+        assert wd.observe(5, True) == "ok"
+        # rollback budget spent: the next escalation aborts
+        assert wd.observe(6, False) == "skip"
+        with pytest.raises(DivergenceAbort):
+            wd.observe(7, False)
+
+    def test_adopt_marked_from_checkpoint_stamp(self):
+        wd = DivergenceWatchdog(WatchdogConfig(skip_limit=0,
+                                               hang_deadlines=None))
+        wd.adopt_marked([7, 8])
+        assert wd.observe(7, False) == "skip"  # no escalation on marked
+
+    def test_verdict_lag_window(self):
+        wd = DivergenceWatchdog(WatchdogConfig(skip_limit=5, max_lag=4,
+                                               hang_deadlines=None))
+        wd.observe(2, False)
+        # unresolved bad run: any snapshot now is suspect
+        assert wd.verdict(10)["verdict"] == "diverged"
+        wd.observe(3, True)
+        # resolved, and step 2 is outside the lag window of step 10
+        assert wd.verdict(10)["verdict"] == "healthy"
+        v = wd.verdict(4)  # ...but inside the window of step 4
+        assert v["verdict"] == "diverged" and v["bad_steps"] == [2]
+
+
+# ----------------------------------------------------------------------
+# Hang watchdog
+# ----------------------------------------------------------------------
+
+class TestHangWatchdog:
+    def test_deadline_breach_raises_once_then_clears(self):
+        hw = HangWatchdog({"feed_next": 0.1}, poll_s=0.02)
+        with hw:
+            with hw.phase("feed_next"):
+                time.sleep(0.4)
+            with pytest.raises(StalledStep) as ei:
+                hw.check()
+            assert ei.value.phase == "feed_next"
+            assert ei.value.elapsed_s > ei.value.deadline_s
+            hw.check()  # the pending stall is consumed: no double kill
+            assert hw.stalls and hw.stalls[0][0] == "feed_next"
+            # a phase with no configured deadline never stalls
+            with hw.phase("step_dispatch"):
+                time.sleep(0.3)
+            hw.check()
+            # clear() drops a pending stall (restart-resume path)
+            with hw.phase("feed_next"):
+                time.sleep(0.4)
+            hw.clear()
+            hw.check()
+
+    def test_dump_thread_stacks_lists_main(self):
+        assert "MainThread" in dump_thread_stacks()
+
+
+# ----------------------------------------------------------------------
+# Checkpoint integrity: CRC verify + restore fallback chain
+# ----------------------------------------------------------------------
+
+class TestCheckpointIntegrity:
+    def test_roundtrip_verifies_and_counts(self, tmp_path):
+        reset_counters()
+        root = str(tmp_path)
+        params = {"w": np.arange(24, dtype=np.float32).reshape(4, 6)}
+        d = save_checkpoint(root, 3, params)
+        meta = verify_checkpoint(d)
+        assert "params.npz" in meta["integrity"]
+        loaded, _, _, _ = load_checkpoint(
+            d, {"w": np.zeros((4, 6), np.float32)}, verify=True)
+        np.testing.assert_array_equal(loaded["w"], params["w"])
+        assert INTEGRITY_COUNTERS["verified"] >= 1
+
+    def test_corrupt_shard_detected_and_fallback(self, tmp_path):
+        reset_counters()
+        root = str(tmp_path)
+        params = {"w": np.arange(64, dtype=np.float32)}
+        save_checkpoint(root, 1, params)
+        d2 = save_checkpoint(root, 2, params)
+        _corrupt_npz(d2)
+        with pytest.raises(CorruptCheckpointError):
+            verify_checkpoint(d2)
+        with pytest.raises(CorruptCheckpointError):
+            load_checkpoint(d2, {"w": np.zeros(64, np.float32)}, verify=True)
+        # fast path (no verify) still answers newest-committed ...
+        assert latest_checkpoint(root).endswith("ckpt_2")
+        # ... the verified chain walks past the rotten one
+        assert latest_checkpoint(root, verify=True).endswith("ckpt_1")
+        assert INTEGRITY_COUNTERS["corrupt_skipped"] >= 1
+
+    def test_require_healthy_skips_diverged_stamp(self, tmp_path):
+        reset_counters()
+        root = str(tmp_path)
+        params = {"w": np.ones(8, np.float32)}
+        save_checkpoint(root, 1, params, driver_state={
+            "health": {"verdict": "healthy", "bad_steps": []}})
+        d2 = save_checkpoint(root, 2, params, driver_state={
+            "health": {"verdict": "diverged", "bad_steps": [9]}})
+        assert checkpoint_health(d2)["verdict"] == "diverged"
+        assert latest_checkpoint(root).endswith("ckpt_2")
+        assert latest_checkpoint(
+            root, require_healthy=True).endswith("ckpt_1")
+        assert INTEGRITY_COUNTERS["unhealthy_skipped"] >= 1
+
+    @pytest.mark.chaos
+    def test_bitflip_after_commit_skipped_on_restore(self, tmp_path):
+        """BitFlipCheckpointFault rots a COMMITTED shard behind the
+        writer's back; the CRC32C chain must catch it on restore."""
+        reset_counters()
+        root = str(tmp_path)
+        fault = BitFlipCheckpointFault(fail_on_save=3, file="params.npz",
+                                       n_bytes=8)
+        params = {"w": np.arange(64, dtype=np.float32)}
+        with AsyncCheckpointer(root, post_commit=fault) as w:
+            for step in (1, 2, 3):
+                w.save_async(step, params)
+            w.wait()
+            assert not w.failed  # the write itself succeeded; rot came later
+        assert fault.fired and fault.fired[0].endswith("ckpt_3")
+        assert latest_checkpoint(root).endswith("ckpt_3")
+        assert latest_checkpoint(root, verify=True).endswith("ckpt_2")
+        assert INTEGRITY_COUNTERS["corrupt_skipped"] >= 1
+        with pytest.raises(CorruptCheckpointError):
+            verify_checkpoint(os.path.join(root, "ckpt_3"))
+
+
+# ----------------------------------------------------------------------
+# Trainer integration: the policy ladder end to end
+# ----------------------------------------------------------------------
+
+def run_training(feed, strict, injector, cfg, root=None, max_restarts=0,
+                 seed=42, epochs=2):
+    RandomGenerator.set_seed(seed)
+    model = nn.Sequential(nn.Linear(8, 4))
+    o = optim.LocalOptimizer(model, make_dataset(), nn.MSECriterion(),
+                             optim_method=SGD(learning_rate=0.05),
+                             end_trigger=Trigger.max_epoch(epochs))
+    o.set_fault_tolerance(max_restarts=max_restarts, backoff_base_s=0.0)
+    o.set_feed(feed)
+    if strict:
+        o.set_strict_transfers(True)
+    o.set_watchdog(cfg)
+    if injector is not None:
+        o.set_chaos(injector)
+    if root is not None:
+        o.set_checkpoint(root, Trigger.several_iteration(2))
+    o.optimize()
+    return o
+
+
+class TestTrainerWatchdog:
+    @pytest.mark.chaos
+    def test_transient_nan_absorbed_by_skip_rung(self):
+        o = run_training(
+            0, False, NaNInjector(fail_steps=(3,), persistent=False),
+            WatchdogConfig(skip_limit=3, max_backoffs=0, max_rollbacks=0,
+                           hang_deadlines=None))
+        wd = o._watchdog
+        assert wd.skipped == 1 and wd.bad_steps == {3}
+        assert wd.backoffs == 0 and wd.rollbacks == 0 and wd.lr_scale == 1.0
+        assert o._driver_state["neval"] == 16
+        for leaf in param_leaves(o):
+            assert np.isfinite(leaf).all()
+
+    @pytest.mark.chaos
+    def test_lr_backoff_rung(self):
+        o = run_training(
+            0, False, NaNInjector(fail_steps=(4, 5), persistent=False),
+            WatchdogConfig(skip_limit=1, backoff_factor=0.5, max_backoffs=1,
+                           max_rollbacks=0, hang_deadlines=None))
+        wd = o._watchdog
+        assert wd.backoffs == 1 and wd.lr_scale == 0.5
+        assert o._driver_state["neval"] == 16
+        for leaf in param_leaves(o):
+            assert np.isfinite(leaf).all()
+
+    @pytest.mark.chaos
+    @pytest.mark.parametrize("feed", [0, 2])
+    def test_rollback_bitwise_parity(self, tmp_path, feed):
+        """The acceptance demo: persistent NaN at steps 5-7 escalates to a
+        rollback; the rolled-back run must finish BITWISE-equal to a run
+        that only ever skipped those steps on device (the bad updates
+        never landed either way) — feed on and off, strict transfers."""
+        ref = run_training(
+            feed, True, NaNInjector(fail_steps=(5, 6, 7), persistent=True),
+            WatchdogConfig(skip_limit=100, max_backoffs=0, max_rollbacks=0,
+                           hang_deadlines=None))
+        roll = run_training(
+            feed, True, NaNInjector(fail_steps=(5, 6, 7), persistent=True),
+            WatchdogConfig(skip_limit=2, max_backoffs=0, max_rollbacks=1,
+                           hang_deadlines=None),
+            root=str(tmp_path / f"ck{feed}"))
+        wd = roll._watchdog
+        assert wd.rollbacks == 1
+        assert wd.marked == {5, 6, 7}
+        assert roll._driver_state["neval"] == ref._driver_state["neval"] == 16
+        assert roll._driver_state["loss"] == ref._driver_state["loss"]
+        assert_bitwise_equal(param_leaves(ref), param_leaves(roll))
+
+    @pytest.mark.chaos
+    def test_rollback_without_checkpoint_raises(self):
+        with pytest.raises(NumericDivergence):
+            run_training(
+                0, False, NaNInjector(fail_steps=(3,), persistent=True),
+                WatchdogConfig(skip_limit=0, max_backoffs=0, max_rollbacks=1,
+                               hang_deadlines=None))
+
+    @pytest.mark.chaos
+    def test_abort_when_ladder_exhausted(self):
+        with pytest.raises(DivergenceAbort):
+            run_training(
+                0, False, NaNInjector(fail_steps=(3,), persistent=True),
+                WatchdogConfig(skip_limit=0, max_backoffs=0, max_rollbacks=0,
+                               hang_deadlines=None))
+
+
+# ----------------------------------------------------------------------
+# Hang watchdog end to end: a wedged feed recovered by restart
+# ----------------------------------------------------------------------
+
+class _StallOnce:
+    """Dataset proxy whose FIRST train pass sleeps mid-epoch — a wedged
+    feed the hang watchdog must flag; replays stream normally."""
+
+    def __init__(self, inner, after=3, stall_s=1.2):
+        self._inner = inner
+        self._after = after
+        self._stall_s = stall_s
+        self.train_calls = 0
+        self.stalled = 0
+
+    def data(self, train):
+        src = self._inner.data(train=train)
+        if not train:
+            return src
+        self.train_calls += 1
+        return src if self.train_calls > 1 else self._stalling(src)
+
+    def _stalling(self, src):
+        for i, item in enumerate(src):
+            if i == self._after:
+                self.stalled += 1
+                time.sleep(self._stall_s)
+            yield item
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_inner"), name)
+
+
+class TestHangRecovery:
+    @pytest.mark.chaos
+    def test_stalled_feed_recovered_by_restart(self, tmp_path):
+        ref = run_training(0, False, None,
+                           WatchdogConfig(hang_deadlines=None))
+        RandomGenerator.set_seed(42)
+        model = nn.Sequential(nn.Linear(8, 4))
+        ds = _StallOnce(make_dataset())
+        o = optim.LocalOptimizer(model, ds, nn.MSECriterion(),
+                                 optim_method=SGD(learning_rate=0.05),
+                                 end_trigger=Trigger.max_epoch(2))
+        o.set_fault_tolerance(max_restarts=2, backoff_base_s=0.0)
+        o.set_feed(0)
+        o.set_watchdog(WatchdogConfig(hang_deadlines={"feed_next": 0.3},
+                                      hang_poll_s=0.05))
+        o.set_checkpoint(str(tmp_path / "ck"), Trigger.several_iteration(2))
+        o.optimize()
+        assert ds.stalled == 1
+        assert ds.train_calls >= 3  # the stalled epoch was re-entered
+        assert o._hang is None  # monitor thread stopped on exit
+        assert o._driver_state["neval"] == 16
+        assert_bitwise_equal(param_leaves(ref), param_leaves(o))
+
+
+# ----------------------------------------------------------------------
+# Serving: per-request non-finite output guard + registry CRC verify
+# ----------------------------------------------------------------------
+
+class TestServingHealth:
+    def _model(self):
+        model = nn.Sequential(nn.Linear(6, 4))
+        params, state, _ = model.build(jax.random.PRNGKey(0), (8, 6))
+        return model, params, state
+
+    def test_reject_nonfinite_guard(self):
+        from bigdl_tpu.serving import NonFiniteOutput, ServingRuntime
+
+        model, params, state = self._model()
+        bad = jax.tree_util.tree_map(
+            lambda a: np.full(np.shape(a), np.nan,
+                              np.asarray(a).dtype), params)
+        x = np.zeros((2, 6), np.float32)
+        example = np.zeros((1, 6), np.float32)
+        with ServingRuntime(model, bad, state, buckets=(1, 8),
+                            example_input=example,
+                            reject_nonfinite=True) as rt:
+            with pytest.raises(NonFiniteOutput):
+                rt.predict(x, timeout=30.0)
+            assert rt.metrics.snapshot()["rejected_nonfinite"] == 1
+            # swapping in a finite version heals the endpoint
+            rt.swap("v1", params, state)
+            out = rt.predict(x, timeout=30.0)
+            assert np.isfinite(np.asarray(out)).all()
+
+    def test_guard_off_passes_nan_through(self):
+        from bigdl_tpu.serving import ServingRuntime
+
+        model, params, state = self._model()
+        bad = jax.tree_util.tree_map(
+            lambda a: np.full(np.shape(a), np.nan,
+                              np.asarray(a).dtype), params)
+        with ServingRuntime(model, bad, state, buckets=(1, 8),
+                            example_input=np.zeros((1, 6),
+                                                   np.float32)) as rt:
+            out = rt.predict(np.zeros((2, 6), np.float32), timeout=30.0)
+            assert not np.isfinite(np.asarray(out)).any()
+            assert rt.metrics.snapshot()["rejected_nonfinite"] == 0
+
+    def test_registry_register_from_checkpoint_verifies(self, tmp_path):
+        from bigdl_tpu.serving import ModelRegistry
+
+        reset_counters()
+        root = str(tmp_path)
+        save_checkpoint(root, 1, {"w": np.ones((2, 3), np.float32)})
+        d2 = save_checkpoint(root, 2, {"w": np.full((2, 3), 2.0,
+                                                    np.float32)})
+        _corrupt_npz(d2)
+        r = ModelRegistry()
+        r.register("v0", {"w": np.zeros((2, 3), np.float32)})
+        mv = r.register_from_checkpoint(root)
+        assert mv.source.endswith("ckpt_1")  # walked past the rotten one
+        assert INTEGRITY_COUNTERS["corrupt_skipped"] >= 1
+        with pytest.raises(CorruptCheckpointError):
+            r.register_from_checkpoint(d2)  # directly named: loud failure
+
+
+# ----------------------------------------------------------------------
+# TFRecord skip_corrupt policy
+# ----------------------------------------------------------------------
+
+class TestTFRecordSkipCorrupt:
+    def _shard(self, tmp_path, n=6):
+        from bigdl_tpu.dataset.tfrecord import write_sample_shards
+
+        rs = np.random.RandomState(0)
+        samples = [Sample.from_ndarray(rs.randn(8).astype(np.float32),
+                                       rs.randn(4).astype(np.float32))
+                   for _ in range(n)]
+        return write_sample_shards(samples, str(tmp_path), n_shards=1)[0]
+
+    def test_data_crc_skipped_and_counted(self, tmp_path):
+        from bigdl_tpu.dataset.tfrecord import (PrefetchRecordReader,
+                                                read_tfrecords)
+
+        path = self._shard(tmp_path)
+        offs = _record_offsets(path)
+        assert len(offs) == 6
+        _xor_bytes(path, [offs[2][0] + 12 + 5])  # record 2's data region
+        with pytest.raises(IOError):
+            list(read_tfrecords(path))  # strict default: the run dies
+        dropped = [0]
+        recs = list(read_tfrecords(path, skip_corrupt=True,
+                                   on_corrupt=lambda n: dropped.__setitem__(
+                                       0, dropped[0] + n)))
+        assert len(recs) == 5 and dropped[0] == 1  # resynced past the rot
+        assert len(list(PrefetchRecordReader([path],
+                                             skip_corrupt=True))) == 5
+
+    def test_length_crc_still_raises(self, tmp_path):
+        """Without a trusted length there is no next frame to resync to:
+        skip_corrupt only forgives DATA rot, not framing rot."""
+        from bigdl_tpu.dataset.tfrecord import read_tfrecords
+
+        path = self._shard(tmp_path)
+        offs = _record_offsets(path)
+        _xor_bytes(path, [offs[2][0] + 2])  # inside the length header
+        with pytest.raises(IOError):
+            list(read_tfrecords(path, skip_corrupt=True))
+
+    def test_parsed_example_dataset_counts_corrupt(self, tmp_path):
+        from bigdl_tpu.dataset.tfrecord import (ParsedExampleDataSet,
+                                                TFRecordWriter)
+        from bigdl_tpu.nn.tf_ops import build_example_proto
+
+        path = str(tmp_path / "ex.tfrecord")
+        rs = np.random.RandomState(0)
+        with TFRecordWriter(path) as w:
+            for i in range(24):
+                w.write(build_example_proto(
+                    {"x": rs.randn(4).astype(np.float32),
+                     "y": np.asarray([i % 3], np.int64)}))
+        offs = _record_offsets(path)
+        _xor_bytes(path, [offs[1][0] + 12 + 3])
+
+        strict = ParsedExampleDataSet(
+            [path], batch_size=4, dense_keys=["x", "y"],
+            dense_shapes=[(4,), ()], label_key="y")
+        with pytest.raises(IOError):
+            list(strict.data(train=False))
+
+        lenient = ParsedExampleDataSet(
+            [path], batch_size=4, dense_keys=["x", "y"],
+            dense_shapes=[(4,), ()], label_key="y", skip_corrupt=True)
+        batches = list(lenient.data(train=False))
+        assert len(batches) == 5  # 23 intact records -> 5 full batches
+        assert lenient.corrupt_records == 1

@@ -30,7 +30,8 @@ if [ "$rc" -eq 0 ]; then
     # bulk tier's dots) and run inside the same wall-clock budget
     remaining=$(( BUDGET - elapsed ))
     [ "$remaining" -lt 30 ] && remaining=30
-    timeout --signal=TERM "$remaining" python -m pytest tests/test_resilience.py \
+    timeout --signal=TERM "$remaining" python -m pytest \
+        tests/test_resilience.py tests/test_health.py \
         -m "chaos and not slow" -q
     rc=$?
     elapsed=$(( $(date +%s) - start ))
