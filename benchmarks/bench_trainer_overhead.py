@@ -363,6 +363,55 @@ def watchdog_ab(iters=ITERS, rounds=4):
     return rows
 
 
+def measure_obs(tracing, iters=ITERS):
+    """optimize() ms/step with the obs plane at its default (metrics +
+    compile monitor on) vs full span tracing on.  Returns (ms/step,
+    events recorded) — the on leg must actually have traced the loop."""
+    from bigdl_tpu import obs
+
+    o, _, _ = _build(iters)
+    obs.set_observability(tracing=tracing)
+    try:
+        o.optimize()  # warm: compiles the step + telemetry-ring write
+        o.end_when = Trigger.max_iteration(2 * iters)
+        t0 = time.perf_counter()
+        o.optimize()
+        per = (time.perf_counter() - t0) / iters
+        tr = obs.tracer()
+        return per, (len(tr.events()) if tr is not None else 0)
+    finally:
+        obs.set_observability(tracing=False)
+
+
+def obs_ab(iters=ITERS, rounds=8):
+    """Tracing off/on A-B (obs ISSUE acceptance): the span tracer on the
+    trainer's phase seams (feed_next, step_dispatch, drain instants) must
+    cost <1% of a step.  Same interleave-and-min discipline as
+    watchdog_ab: background load drifts by more than the effect under
+    test, so back-to-back blocks would charge that drift to whichever
+    leg ran second."""
+    rows = {False: float("inf"), True: float("inf")}
+    events = 0
+    for _ in range(rounds):
+        for tracing in (False, True):
+            per, n = measure_obs(tracing, iters)
+            rows[tracing] = min(rows[tracing], per)
+            if tracing:
+                events = max(events, n)
+    assert events >= iters, f"tracing-on leg recorded only {events} events"
+    for tracing in (False, True):
+        print(json.dumps({
+            "path": "obs_ab", "tracing": tracing,
+            "ms_per_step": round(rows[tracing] * 1e3, 2),
+            **({"trace_events": events} if tracing else {})}))
+    overhead = rows[True] / rows[False] - 1.0
+    print(json.dumps({
+        "metric": "obs_tracing_overhead_ok",
+        "value": bool(overhead < 0.01),
+        "overhead_pct": round(overhead * 100, 2)}))
+    return rows
+
+
 def lint_hotpath_ab(iters=ITERS):
     """A-B of the tpu_lint host-sync fixes (bigdl_tpu.analysis): each
     "before" leg re-injects the exact pattern the linter flagged, the
@@ -453,6 +502,8 @@ def main(argv=None):
                     help="A-B the tpu_lint host-sync fixes (quick capture)")
     ap.add_argument("--watchdog", action="store_true",
                     help="run just the divergence-watchdog off/on A-B")
+    ap.add_argument("--obs", action="store_true",
+                    help="run just the obs span-tracing off/on A-B")
     ap.add_argument("--iters", type=int, default=ITERS)
     args = ap.parse_args(argv)
     if args.feed_only:
@@ -466,6 +517,9 @@ def main(argv=None):
         return
     if args.watchdog:
         watchdog_ab(args.iters)
+        return
+    if args.obs:
+        obs_ab(args.iters)
         return
     lat, rere = measure_readback_latency()
     print(json.dumps({"metric": "env_readback_latency_ms",

@@ -20,3 +20,5 @@ shuffle (reference: parameters/AllReduceParameter.scala:84) is replaced by
 __version__ = "0.1.0"
 
 from bigdl_tpu.core.engine import Engine  # noqa: F401
+from bigdl_tpu import obs  # noqa: F401  (metrics plane is default-on)
+from bigdl_tpu.obs import set_observability  # noqa: F401

@@ -43,6 +43,8 @@ import threading
 import time
 from typing import Any, Callable, Iterable, Iterator, NamedTuple, Optional
 
+from bigdl_tpu import obs as _obs
+
 __all__ = ["DeviceFeed", "InlineFeed", "FeedItem", "make_feed"]
 
 _DONE = object()
@@ -102,12 +104,24 @@ class DeviceFeed:
     def _run(self) -> None:
         try:
             while not self._stop.is_set():
+                tr = _obs.tracer()  # per batch: picks up late enabling
                 t0 = time.perf_counter()
-                try:
-                    batch = next(self._it)
-                except StopIteration:
-                    break
-                payload = self._put(batch)
+                if tr is not None:
+                    with tr.span("feed.assemble", cat="feed",
+                                 batch=self._staged):
+                        try:
+                            batch = next(self._it)
+                        except StopIteration:
+                            break
+                    with tr.span("feed.h2d_stage", cat="feed",
+                                 batch=self._staged):
+                        payload = self._put(batch)
+                else:
+                    try:
+                        batch = next(self._it)
+                    except StopIteration:
+                        break
+                    payload = self._put(batch)
                 self._work_s += time.perf_counter() - t0
                 self._staged += 1
                 size = getattr(batch, "size", None)
@@ -192,6 +206,11 @@ class DeviceFeed:
             return
         self._closed = True
         self._stop.set()
+        reg = _obs.registry()
+        reg.inc("feed/staged_batches", self._staged)
+        reg.inc("feed/delivered_batches", self._delivered)
+        reg.set_gauge("feed/assembly_records_per_s",
+                      self.assembly_records_per_s())
         # drain so a worker blocked mid-put can observe the stop flag
         while True:
             try:
@@ -239,9 +258,15 @@ class InlineFeed:
         return self
 
     def __next__(self) -> FeedItem:
+        tr = _obs.tracer()
         t0 = time.perf_counter()
-        batch = next(self._it)
-        payload = self._put(batch)
+        if tr is not None:
+            with tr.span("feed.inline_stage", cat="feed"):
+                batch = next(self._it)
+                payload = self._put(batch)
+        else:
+            batch = next(self._it)
+            payload = self._put(batch)
         self._work_s += time.perf_counter() - t0
         size = getattr(batch, "size", None)
         if callable(size):

@@ -420,6 +420,10 @@ class ParsedExampleDataSet(DataSet):
 
     def _count_corrupt(self, n: int) -> None:
         self._corrupt += int(n)
+        # mirror onto the obs metrics plane (per-dataset count stays the
+        # source of truth for the trainer's CorruptRecords scalar)
+        from bigdl_tpu import obs as _obs
+        _obs.registry().inc("dataset/corrupt_records", int(n))
 
     def size(self) -> int:
         if self._size < 0:

@@ -22,12 +22,13 @@ and load without verification — old runs stay restorable.
 from __future__ import annotations
 
 import os
-import threading
-from typing import Dict, Optional
+from collections.abc import Mapping
+from typing import Dict, Iterator, Optional
 
 import numpy as np
 
 from bigdl_tpu import native
+from bigdl_tpu import obs as _obs
 
 __all__ = [
     "CorruptCheckpointError",
@@ -48,27 +49,50 @@ class CorruptCheckpointError(IOError):
     the fallback chain instead of crashing the restore."""
 
 
-# Process-wide counters for the restore fallback chain (warn + METRIC per
-# the health contract): the trainer snapshots these into Metrics/summary
-# after a rollback restore.  Guarded by a lock — latest_checkpoint may be
-# called from the driver while the async writer commits.
-_lock = threading.Lock()
-INTEGRITY_COUNTERS: Dict[str, int] = {
-    "verified": 0,           # checkpoints that passed a full CRC verify
-    "corrupt_skipped": 0,    # candidates skipped for CRC/read failures
-    "unhealthy_skipped": 0,  # candidates skipped for a diverged verdict
-}
+# Counters for the restore fallback chain (warn + METRIC per the health
+# contract).  The state lives on the active `bigdl_tpu.obs` MetricsRegistry
+# under the "integrity/" namespace — not in this module — so parallel
+# tests stop sharing counters (swap the registry, get fresh counters).
+# `INTEGRITY_COUNTERS` survives as a read-through Mapping alias.
+_PREFIX = "integrity/"
+_BASE_KEYS = (
+    "verified",           # checkpoints that passed a full CRC verify
+    "corrupt_skipped",    # candidates skipped for CRC/read failures
+    "unhealthy_skipped",  # candidates skipped for a diverged verdict
+)
+
+
+class _CounterView(Mapping):
+    """Live read-only view of the active registry's integrity/ counters."""
+
+    def __getitem__(self, key: str) -> int:
+        return int(_obs.registry().get(_PREFIX + key, 0))
+
+    def _keys(self):
+        names = set(_BASE_KEYS)
+        names.update(k[len(_PREFIX):]
+                     for k in _obs.registry().counters(_PREFIX))
+        return names
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(sorted(self._keys()))
+
+    def __len__(self) -> int:
+        return len(self._keys())
+
+    def __repr__(self) -> str:
+        return repr({k: self[k] for k in self})
+
+
+INTEGRITY_COUNTERS = _CounterView()
 
 
 def count(name: str, n: int = 1) -> None:
-    with _lock:
-        INTEGRITY_COUNTERS[name] = INTEGRITY_COUNTERS.get(name, 0) + n
+    _obs.registry().inc(_PREFIX + name, n)
 
 
 def reset_counters() -> None:
-    with _lock:
-        for k in INTEGRITY_COUNTERS:
-            INTEGRITY_COUNTERS[k] = 0
+    _obs.registry().reset(_PREFIX)
 
 
 def verify_enabled(override: Optional[bool] = None) -> bool:

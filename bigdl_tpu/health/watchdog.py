@@ -47,6 +47,8 @@ import time
 import traceback
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
+from bigdl_tpu import obs as _obs
+
 logger = logging.getLogger("bigdl_tpu.health")
 
 __all__ = [
@@ -240,6 +242,12 @@ class DivergenceWatchdog:
         self.events.append({"kind": kind, "step": int(step), **payload})
         if len(self.events) > 1024:  # bounded: long runs must not grow
             del self.events[:512]
+        # policy transitions on the shared timeline: an lr backoff or a
+        # rollback shows up between the step spans that caused it
+        _obs.registry().inc(f"health/{kind}")
+        _obs.instant(f"watchdog.{kind}", cat="health", step=int(step),
+                     **{k: v for k, v in payload.items()
+                        if isinstance(v, (int, float, str, bool))})
 
 
 class _Phase:
@@ -353,6 +361,10 @@ class HangWatchdog:
                     self._stall = StalledStep(ph.name, elapsed, deadline)
                     self.stalls.append((ph.name, elapsed))
             if first:
+                _obs.registry().inc("health/stalls")
+                _obs.instant("watchdog.stall", cat="health", phase=ph.name,
+                             elapsed_s=round(elapsed, 3),
+                             deadline_s=deadline)
                 logger.error(
                     "hang watchdog: phase %r exceeded its %.1fs deadline "
                     "(%.1fs elapsed); dumping all thread stacks\n%s",

@@ -1,0 +1,193 @@
+"""Runtime compile-event monitor: attribute XLA compiles, alarm recompiles.
+
+`tpu_lint`'s recompile rules are static — they catch `self.` reads inside
+jitted code before it ships.  This module is the *runtime* alarm for
+whatever the linter can't see: it listens to `jax.monitoring`'s
+`/jax/core/compile/backend_compile_duration` event (fired once per actual
+backend compile; jit cache hits fire nothing) and attributes each compile
+to the bucket/step signature the caller declared.
+
+Attribution is scope-based because the monitoring event carries no source
+info: compiles run synchronously on the thread that triggered them, so a
+thread-local stack of `attribute("serving/bucket=8")` scopes names every
+compile that fires inside.  Compiles outside any scope land under
+"unattributed".
+
+Warmup vs steady-state is decided per signature by *settling*: a
+signature's compiles count as warmup until some later `attribute(sig)`
+entry completes with zero new compiles — proof the executable set for
+that signature is cached.  Every compile after that is a steady-state
+RECOMPILE: the executable set grew when it should have been closed
+(exactly the condition the lint rules guard against, e.g. a shape leak
+past the bucket padding or a `self` read baked into a jitted closure).
+`mark_steady()` force-settles (the serving registry calls it after
+warmup, so the very first post-warmup compile alarms).
+
+jax.monitoring has no selective unregister (only a global
+clear_event_listeners), so ONE process-global listener is registered
+lazily and forwards to the swappable active monitor — tests swap
+monitors, never the listener.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Optional
+
+logger = logging.getLogger("bigdl_tpu.obs")
+
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+UNATTRIBUTED = "unattributed"
+
+_listener_lock = threading.Lock()
+_listener_installed = False
+_active_monitor: Optional["CompileMonitor"] = None
+
+
+def _forward(event: str, duration: float, **kwargs) -> None:
+    mon = _active_monitor
+    if mon is not None and event == BACKEND_COMPILE_EVENT:
+        mon.on_compile(duration)
+
+
+def install_monitor(monitor: Optional["CompileMonitor"]) -> None:
+    """Make `monitor` the target of the process-global jax.monitoring
+    listener (None detaches).  The listener itself is registered once,
+    ever — jax.monitoring cannot unregister a single listener."""
+    global _listener_installed, _active_monitor
+    with _listener_lock:
+        _active_monitor = monitor
+        if monitor is not None and not _listener_installed:
+            from jax import monitoring as _jm
+            _jm.register_event_duration_secs_listener(_forward)
+            _listener_installed = True
+
+
+def active_monitor() -> Optional["CompileMonitor"]:
+    return _active_monitor
+
+
+class _Scope:
+    __slots__ = ("_mon", "_sig", "_compiles_at_entry")
+
+    def __init__(self, mon: "CompileMonitor", sig: str):
+        self._mon = mon
+        self._sig = sig
+        self._compiles_at_entry = 0
+
+    def __enter__(self):
+        self._compiles_at_entry = self._mon._enter_scope(self._sig)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._mon._exit_scope(self._sig, self._compiles_at_entry)
+        return False
+
+
+class CompileMonitor:
+    """Per-signature compile accounting with warmup/steady-state split."""
+
+    def __init__(self, registry_fn: Callable[[], Any] = None,
+                 tracer_fn: Callable[[], Any] = None,
+                 history: int = 1024):
+        self._registry_fn = registry_fn
+        self._tracer_fn = tracer_fn
+        self._lock = threading.Lock()
+        # sig -> {"compiles", "recompiles", "secs", "settled"}
+        self._sigs: Dict[str, Dict[str, Any]] = {}
+        self.records: deque = deque(maxlen=history)
+        self._tls = threading.local()
+
+    # -- attribution scopes (hot-adjacent: two dict ops per entry) ---------
+
+    def attribute(self, signature: str) -> _Scope:
+        """Scope naming every compile that fires inside (this thread)."""
+        return _Scope(self, signature)
+
+    def _stack(self):
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _enter_scope(self, sig: str) -> int:
+        self._stack().append(sig)
+        with self._lock:
+            rec = self._sigs.get(sig)
+            return rec["compiles"] if rec else 0
+
+    def _exit_scope(self, sig: str, compiles_at_entry: int) -> None:
+        st = self._stack()
+        if st and st[-1] == sig:
+            st.pop()
+        with self._lock:
+            rec = self._sigs.get(sig)
+            # settle: a re-entry that compiled nothing proves the
+            # executable set for this signature is closed and cached
+            if (rec is not None and not rec["settled"]
+                    and compiles_at_entry > 0
+                    and rec["compiles"] == compiles_at_entry):
+                rec["settled"] = True
+
+    def mark_steady(self, prefix: str = "") -> None:
+        """Force-settle signatures under `prefix` (""= all): any further
+        compile under them is a steady-state recompile alarm."""
+        with self._lock:
+            for sig, rec in self._sigs.items():
+                if sig.startswith(prefix):
+                    rec["settled"] = True
+
+    # -- listener target ---------------------------------------------------
+
+    def on_compile(self, duration_s: float) -> None:
+        st = getattr(self._tls, "stack", None)
+        sig = st[-1] if st else UNATTRIBUTED
+        with self._lock:
+            rec = self._sigs.setdefault(
+                sig, {"compiles": 0, "recompiles": 0, "secs": 0.0,
+                      "settled": False})
+            steady = rec["settled"]
+            rec["compiles"] += 1
+            rec["secs"] += duration_s
+            if steady:
+                rec["recompiles"] += 1
+            self.records.append((sig, duration_s, steady))
+        reg = self._registry_fn() if self._registry_fn else None
+        if reg is not None:
+            reg.inc("compile/total")
+            if steady:
+                reg.inc("compile/steady_recompiles")
+        tr = self._tracer_fn() if self._tracer_fn else None
+        if tr is not None:
+            # backdate so the span covers the compile, not its end
+            t1 = time.perf_counter_ns()
+            dur_ns = int(duration_s * 1e9)
+            tr._append("X", "xla_compile", "compile", t1 - dur_ns, dur_ns,
+                       {"signature": sig, "steady_recompile": steady})
+        if steady:
+            logger.warning(
+                "steady-state XLA recompile under %r (%.2fs): the "
+                "executable set grew after warmup settled — check for "
+                "shape drift past the bucket padding or a traced value "
+                "baked into the jitted closure", sig, duration_s)
+
+    # -- inspection --------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {sig: dict(rec) for sig, rec in self._sigs.items()}
+
+    def compiles(self, signature: Optional[str] = None) -> int:
+        with self._lock:
+            if signature is not None:
+                rec = self._sigs.get(signature)
+                return rec["compiles"] if rec else 0
+            return sum(r["compiles"] for r in self._sigs.values())
+
+    def recompiles(self, prefix: str = "") -> int:
+        with self._lock:
+            return sum(r["recompiles"] for sig, r in self._sigs.items()
+                       if sig.startswith(prefix))

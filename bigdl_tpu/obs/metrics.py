@@ -1,0 +1,139 @@
+"""MetricsRegistry: one spine for the counters scattered across subsystems.
+
+Before this module, every subsystem grew its own mutable counter state —
+`health.integrity.INTEGRITY_COUNTERS` (module-global dict),
+`ParsedExampleDataSet.corrupt_records`, ServingMetrics' locked dict, the
+FeedStallMs/FeedOccupancy scalars the trainer pushes straight into
+TrainSummary.  The registry absorbs them behind one API:
+
+  * `inc(name, n)`        — monotonically increasing counter
+  * `set_gauge(name, v)`  — last-value gauge (throughput, occupancy, p99)
+  * `get(name)`           — read either kind (counters win on collision)
+  * `snapshot()`          — {"counters": {...}, "gauges": {...}} copy
+  * `export_jsonl(path)`  — append one JSON line per call (tail-able)
+  * `export_prometheus(path)` — node_exporter textfile-collector format
+  * `to_summary(summary, step)` — bridge into TrainSummary/ServingSummary
+
+Names are slash-namespaced (`integrity/verified`, `serving/batches`,
+`feed/stall_ms`); exporters sanitize for their own formats.  The active
+registry is process-global (`bigdl_tpu.obs.registry()`) but swappable
+(`set_registry`) so parallel tests stop sharing counters — the back-compat
+`INTEGRITY_COUNTERS` mapping in `health.integrity` reads *through* the
+active registry rather than owning state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, Optional
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+class MetricsRegistry:
+    """Thread-safe counter/gauge registry with JSONL + Prometheus export."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+
+    # -- write path --------------------------------------------------------
+
+    def inc(self, name: str, n: float = 1) -> float:
+        with self._lock:
+            v = self._counters.get(name, 0) + n
+            self._counters[name] = v
+            return v
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    # -- read path ---------------------------------------------------------
+
+    def get(self, name: str, default: float = 0) -> float:
+        with self._lock:
+            if name in self._counters:
+                return self._counters[name]
+            return self._gauges.get(name, default)
+
+    def counters(self, prefix: str = "") -> Dict[str, float]:
+        with self._lock:
+            return {k: v for k, v in self._counters.items()
+                    if k.startswith(prefix)}
+
+    def gauges(self, prefix: str = "") -> Dict[str, float]:
+        with self._lock:
+            return {k: v for k, v in self._gauges.items()
+                    if k.startswith(prefix)}
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {"counters": dict(self._counters),
+                    "gauges": dict(self._gauges)}
+
+    def reset(self, prefix: str = "") -> None:
+        """Zero counters and drop gauges under `prefix` ("" = everything)."""
+        with self._lock:
+            for k in list(self._counters):
+                if k.startswith(prefix):
+                    del self._counters[k]
+            for k in list(self._gauges):
+                if k.startswith(prefix):
+                    del self._gauges[k]
+
+    # -- exporters (cold path; never called from hot loops) ----------------
+
+    def export_jsonl(self, path: str, step: Optional[int] = None,
+                     extra: Optional[Dict[str, Any]] = None) -> None:
+        """Append one snapshot line; a run's file is a tail-able series."""
+        snap = self.snapshot()
+        line: Dict[str, Any] = {"ts": time.time()}
+        if step is not None:
+            line["step"] = int(step)
+        if extra:
+            line.update(extra)
+        line.update(snap)
+        with open(path, "a") as f:
+            f.write(json.dumps(line) + "\n")
+
+    def export_prometheus(self, path: str,
+                          namespace: str = "bigdl_tpu") -> None:
+        """Write node_exporter textfile-collector format (atomic rename)."""
+        snap = self.snapshot()
+        lines = []
+        for kind, series in (("counter", snap["counters"]),
+                             ("gauge", snap["gauges"])):
+            for name in sorted(series):
+                prom = namespace + "_" + _PROM_BAD.sub("_", name)
+                lines.append(f"# TYPE {prom} {kind}")
+                lines.append(f"{prom} {series[name]}")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        os.replace(tmp, path)
+
+    def to_summary(self, summary, step: int, prefix: str = "") -> None:
+        """Bridge into TrainSummary/ServingSummary: one scalar per metric
+        (slashes kept — the summary machinery namespaces on them)."""
+        snap = self.snapshot()
+        for series in (snap["counters"], snap["gauges"]):
+            for name, value in series.items():
+                if name.startswith(prefix):
+                    summary.add_scalar(name, float(value), step)
+
+
+class NullRegistry(MetricsRegistry):
+    """Registry with recording disabled (`set_observability(metrics=False)`):
+    writes are no-ops, reads return defaults, exporters write empties."""
+
+    def inc(self, name: str, n: float = 1) -> float:  # noqa: ARG002
+        return 0
+
+    def set_gauge(self, name: str, value: float) -> None:
+        pass

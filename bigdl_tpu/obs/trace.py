@@ -1,0 +1,138 @@
+"""Host-side span tracer: bounded ring, monotonic clocks, zero device syncs.
+
+The tracer records *host* phase seams — the ones the HangWatchdog already
+names (`feed_next`, `step_dispatch`, `ckpt_wait`) plus the serving request
+lifecycle — into a lock-protected ring of plain tuples.  Nothing here ever
+touches a device array, so traced hot loops stay legal under
+`strict_transfers()` (jax.transfer_guard "disallow"); the only clock is
+`time.perf_counter_ns()` (monotonic, ~20ns per read).
+
+Export is Chrome-trace JSON (`chrome://tracing` / https://ui.perfetto.dev):
+one lane per thread (pid = process, tid = thread ident, thread_name
+metadata from the recording thread), "X" complete events for spans, "i"
+instant events for point occurrences (watchdog stalls, checkpoint commits,
+serving admissions).  Correlation ids ride in the event `args` so a
+request can be followed across the submitter thread, the batcher lane,
+and the dispatch lane.
+
+The ring is bounded (`capacity` events, default 65536 ≈ a few MB); old
+events fall off the front and `dropped` counts them, so an always-on
+tracer can never grow without bound.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+# Event tuples (kind, name, cat, tid, tname, ts_ns, dur_ns, args):
+#   kind "X": complete span (dur_ns set), kind "i": instant (dur_ns = 0).
+_KIND_SPAN = "X"
+_KIND_INSTANT = "i"
+
+
+class _SpanCtx:
+    """Reusable-per-call span context: stamps enter/exit on one thread."""
+
+    __slots__ = ("_tracer", "_name", "_cat", "_args", "_t0")
+
+    def __init__(self, tracer: "SpanTracer", name: str, cat: str,
+                 args: Optional[Dict[str, Any]]):
+        self._tracer = tracer
+        self._name = name
+        self._cat = cat
+        self._args = args
+        self._t0 = 0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter_ns()
+        self._tracer._append(_KIND_SPAN, self._name, self._cat,
+                             self._t0, t1 - self._t0, self._args)
+        return False
+
+
+class SpanTracer:
+    """Bounded in-memory trace ring with Chrome-trace export."""
+
+    def __init__(self, capacity: int = 65536):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.capacity)
+        self.dropped = 0
+        # epoch so exported ts starts near 0 (µs since tracer creation)
+        self._epoch_ns = time.perf_counter_ns()
+
+    # -- recording (hot path: one lock + one deque append) -----------------
+
+    def _append(self, kind: str, name: str, cat: str, ts_ns: int,
+                dur_ns: int, args: Optional[Dict[str, Any]]) -> None:
+        t = threading.current_thread()
+        ev = (kind, name, cat, t.ident, t.name, ts_ns, dur_ns, args)
+        with self._lock:
+            if len(self._ring) == self.capacity:
+                self.dropped += 1
+            self._ring.append(ev)
+
+    def span(self, name: str, cat: str = "host", **args) -> _SpanCtx:
+        """Context manager timing one host phase on the calling thread."""
+        return _SpanCtx(self, name, cat, args or None)
+
+    def instant(self, name: str, cat: str = "event", **args) -> None:
+        """Point event (watchdog stall, ckpt commit, request admission)."""
+        self._append(_KIND_INSTANT, name, cat, time.perf_counter_ns(), 0,
+                     args or None)
+
+    # -- inspection / export (cold path) -----------------------------------
+
+    def events(self) -> List[tuple]:
+        """Snapshot of the ring, oldest first (copies under the lock)."""
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+
+    def to_chrome(self) -> Dict[str, Any]:
+        """Chrome-trace dict: spans as "X", instants as "i", one
+        thread_name metadata event per lane."""
+        pid = os.getpid()
+        events = self.events()
+        out: List[Dict[str, Any]] = []
+        lanes: Dict[int, str] = {}
+        epoch = self._epoch_ns
+        for kind, name, cat, tid, tname, ts_ns, dur_ns, args in events:
+            lanes.setdefault(tid, tname)
+            ev: Dict[str, Any] = {
+                "ph": kind, "name": name, "cat": cat, "pid": pid,
+                "tid": tid, "ts": (ts_ns - epoch) / 1e3,
+            }
+            if kind == _KIND_SPAN:
+                ev["dur"] = dur_ns / 1e3
+            else:
+                ev["s"] = "t"  # thread-scoped instant
+            if args:
+                ev["args"] = dict(args)
+            out.append(ev)
+        meta = [{"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                 "args": {"name": tname}} for tid, tname in lanes.items()]
+        return {"traceEvents": meta + out, "displayTimeUnit": "ms",
+                "otherData": {"dropped_events": self.dropped}}
+
+    def export_chrome(self, path: str) -> Dict[str, Any]:
+        """Write the Chrome-trace JSON to `path`; returns the dict."""
+        doc = self.to_chrome()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return doc

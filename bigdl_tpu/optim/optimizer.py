@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from bigdl_tpu import obs as _obs
 from bigdl_tpu.core.engine import AXIS_DATA, Engine
 from bigdl_tpu.core.random import RandomGenerator
 from bigdl_tpu.dataset.dataset import DataSet
@@ -174,20 +175,27 @@ def _finish_step_health(loss_fn, params, model_state, opt_state, lr,
             healthy.astype(jnp.float32))
 
 
+_NULLCTX = nullcontext()  # reusable: hot paths must not allocate one per use
+
+
 def _phase(hang, name):
     """Hang-watchdog phase bracket, or a free nullcontext when disabled."""
-    return hang.phase(name) if hang is not None else nullcontext()
+    return hang.phase(name) if hang is not None else _NULLCTX
 
 
-def _guarded_iter(feed, hang):
+def _guarded_iter(feed, hang, tr=None):
     """Iterate the feed with each blocking __next__ under the hang
     watchdog's `feed_next` phase: a wedged assembly worker (or a source
     that stops producing) raises StalledStep into the step loop instead
     of parking it forever.  The in-between consumer work is NOT in the
-    phase — only the waits are on the clock."""
+    phase — only the waits are on the clock.  `tr` (obs.SpanTracer, or
+    None when tracing is off) records the same waits as `feed_next`
+    spans on the consumer lane."""
     it = iter(feed)
     while True:
-        with _phase(hang, "feed_next"):
+        with _phase(hang, "feed_next"), \
+                (tr.span("feed_next", cat="trainer") if tr is not None
+                 else _NULLCTX):
             try:
                 item = next(it)
             except StopIteration:
@@ -871,7 +879,8 @@ class Optimizer:
         if self._ckpt_writer is None:
             return
         hang = self._hang
-        with _phase(hang, "ckpt_wait"):
+        with _phase(hang, "ckpt_wait"), _obs.span("ckpt_wait",
+                                                  cat="trainer"):
             self._ckpt_writer.wait(
                 stall_check=hang.check if hang is not None else None)
 
@@ -996,6 +1005,12 @@ class Optimizer:
         # retrace hazard the analysis linter's recompile rule flags
         host_lr = self._host_lr()
         strict = strict_transfers_enabled(self._strict_transfers)
+        # obs plane, hoisted once (the hot-loop contract): tr is None when
+        # tracing is off, and every span below is guarded on that — the
+        # tracing-off loop is byte-for-byte the pre-obs loop
+        tr = _obs.tracer()
+        mon = _obs.compile_monitor()
+        obs_reg = _obs.registry()
         ring_cap = depth + 2  # burst span never exceeds depth+1 entries
         ring = jnp.zeros((ring_cap, 3 if wd is not None else 2), jnp.float32)
         # watchdog device scalars, re-put only on CHANGE (lr_backoff is a
@@ -1073,10 +1088,21 @@ class Optimizer:
                 self.metrics.set("throughput", throughput)
                 self.metrics.add("feed stall", stall_s)
                 self.metrics.set("feed occupancy", occ)
-                # driver log (reference: DistriOptimizer.scala:402-407)
+                obs_reg.inc("train/steps")
+                obs_reg.set_gauge("train/loss", loss_f)
+                obs_reg.set_gauge("train/throughput", throughput)
+                obs_reg.set_gauge("feed/stall_ms", stall_s * 1e3)
+                obs_reg.set_gauge("feed/occupancy", occ)
+                # driver log (reference: DistriOptimizer.scala:402-407);
+                # `extra` fields land in the JSONL records when
+                # BIGDL_TPU_LOG_JSON=1 (utils/logger_filter.py)
                 logger.info(
                     "Epoch %d iteration %d: loss %.6f, throughput %.1f "
-                    "records/s, lr %.6g", ep, it, loss_f, throughput, lr_f)
+                    "records/s, lr %.6g", ep, it, loss_f, throughput, lr_f,
+                    extra={"step": it, "epoch": ep})
+                if tr is not None:
+                    tr.instant("step_drained", cat="trainer", step=it,
+                               loss=loss_f)
                 if self.train_summary is not None:
                     s = self.train_summary
                     if s.should_log("Loss", it):
@@ -1149,7 +1175,7 @@ class Optimizer:
                              else None)
             feed_ref[0] = feed
             try:
-                for item in _guarded_iter(feed, hang):
+                for item in _guarded_iter(feed, hang, tr):
                     if hang is not None:
                         # surface a stall another thread detected (e.g.
                         # the writer wedged) at the batch boundary, where
@@ -1175,6 +1201,11 @@ class Optimizer:
                     # IMPLICIT transfer a future change sneaks into this
                     # dispatch section then raises at the offending line
                     with _phase(hang, "step_dispatch"), \
+                            (tr.span("step_dispatch", cat="trainer",
+                                     step=state["neval"])
+                             if tr is not None else _NULLCTX), \
+                            (mon.attribute(f"train/step/bs={bs}")
+                             if mon is not None else _NULLCTX), \
                             strict_transfers(strict):
                         rng = _fold_in(root_key,
                                        _put_scalar(state["neval"]))
@@ -1265,10 +1296,17 @@ class Optimizer:
                 # preserve the old leaf's sharding: a plain jnp.asarray
                 # here changes the step signature (SingleDeviceSharding vs
                 # the step output's NamedSharding) and forces a ~20s FULL
-                # RECOMPILE of the train step at every epoch boundary
+                # RECOMPILE of the train step at every epoch boundary.
+                # Only device_put when the old leaf was COMMITTED, though:
+                # committing it in a single-device run (where every other
+                # arg is uncommitted) flips the pjit argument mapping from
+                # UnspecifiedValue to a concrete sharding and triggers the
+                # exact recompile pair this branch exists to prevent (the
+                # obs CompileMonitor flags them as steady_recompiles)
                 new_epoch = jnp.asarray(state["epoch"], jnp.int32)
                 old = self.opt_state.get("epoch")
-                if hasattr(old, "sharding"):
+                if hasattr(old, "sharding") and getattr(old, "committed",
+                                                        False):
                     new_epoch = jax.device_put(new_epoch, old.sharding)
                 self.opt_state = dict(self.opt_state, epoch=new_epoch)
             logger.info("Epoch %d done: %d records in %.1fs",
@@ -1397,6 +1435,8 @@ class Optimizer:
         strict = strict_transfers_enabled(self._strict_transfers)
         with make_feed(self.val_dataset.data(train=False), self._stage_batch,
                        self._feed_depth(), name="DeviceFeed-eval") as feed, \
+                _obs.span("validate", cat="trainer"), \
+                _obs.attribute("eval/step"), \
                 strict_transfers(strict):
             for item in feed:
                 x, y = item.payload
@@ -1468,18 +1508,23 @@ class Optimizer:
         if not self._agreed_trigger(self.ckpt_trigger, state):
             return
         t0 = time.perf_counter()
-        if self._use_async_ckpt():
-            # the loop pays only the on-device snapshot dispatch (and, if
-            # the bounded writer queue is full, the backpressure wait)
-            self._ensure_ckpt_writer().save_async(
-                state["neval"], self.params, self.model_state,
-                self.opt_state, self._driver_snapshot(state))
-            logger.info("Checkpoint step %d queued (async)", state["neval"])
-        else:
-            d = self._sync_save(state)
-            logger.info("Checkpoint saved to %s", d)
+        with _obs.span("ckpt_save", cat="trainer", step=state["neval"]):
+            if self._use_async_ckpt():
+                # the loop pays only the on-device snapshot dispatch (and,
+                # if the bounded writer queue is full, the backpressure
+                # wait)
+                self._ensure_ckpt_writer().save_async(
+                    state["neval"], self.params, self.model_state,
+                    self.opt_state, self._driver_snapshot(state))
+                logger.info("Checkpoint step %d queued (async)",
+                            state["neval"], extra={"step": state["neval"]})
+            else:
+                d = self._sync_save(state)
+                logger.info("Checkpoint saved to %s", d,
+                            extra={"step": state["neval"]})
         stall = time.perf_counter() - t0
         self.metrics.add("checkpoint stall", stall)
+        _obs.registry().set_gauge("ckpt/stall_ms", stall * 1e3)
         if self.train_summary is not None \
                 and self.train_summary.should_log("CheckpointStallMs",
                                                   state["neval"]):

@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu import obs as _obs
 from bigdl_tpu.health import integrity as _integrity
 from bigdl_tpu.utils.checkpoint import (
     SCHEMA_VERSION,
@@ -219,11 +220,14 @@ class AsyncCheckpointer:
             with self._lock:
                 self.failed.append(job.step)
                 self.last_error = e
+            _obs.registry().inc("ckpt/failed")
             raise CheckpointWriteError(
                 f"sync checkpoint at step {job.step} failed") from e
         finally:
             with self._lock:
                 self._inflight.discard(job.step)
+        _obs.registry().inc("ckpt/committed")
+        _obs.instant("ckpt.commit", cat="ckpt", step=job.step)
         with self._lock:
             self.committed.append(job.step)
             protect = tuple(self._inflight)
@@ -308,13 +312,20 @@ class AsyncCheckpointer:
             if job is _STOP:
                 self._q.task_done()
                 return
+            tr = _obs.tracer()
             try:
-                d = self._write(job)
+                if tr is not None:
+                    with tr.span("ckpt.write", cat="ckpt", step=job.step):
+                        d = self._write(job)
+                    tr.instant("ckpt.commit", cat="ckpt", step=job.step)
+                else:
+                    d = self._write(job)
                 with self._lock:
                     self.committed.append(job.step)
                     protect = tuple(self._inflight)
+                _obs.registry().inc("ckpt/committed")
                 logger.info("checkpoint step %d committed to %s",
-                            job.step, d)
+                            job.step, d, extra={"step": job.step})
                 apply_retention(self.path, self.keep_last, self.keep_every,
                                 protect=protect)
             except BaseException as e:
@@ -324,6 +335,7 @@ class AsyncCheckpointer:
                 with self._lock:
                     self.failed.append(job.step)
                     self.last_error = e
+                _obs.registry().inc("ckpt/failed")
                 logger.exception("async checkpoint at step %d failed "
                                  "(training continues)", job.step)
             finally:

@@ -29,10 +29,15 @@ splitting.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from bigdl_tpu import obs as _obs
+
+logger = logging.getLogger("bigdl_tpu.serving")
 
 
 class Rejected(RuntimeError):
@@ -79,7 +84,7 @@ class _Future:
 
 
 class _Request:
-    __slots__ = ("x", "rows", "future", "t_enqueue", "deadline")
+    __slots__ = ("x", "rows", "future", "t_enqueue", "deadline", "cid")
 
     def __init__(self, x: Any, rows: int, deadline: Optional[float]):
         self.x = x
@@ -87,6 +92,10 @@ class _Request:
         self.future = _Future()
         self.t_enqueue = time.perf_counter()
         self.deadline = deadline  # absolute perf_counter time, or None
+        # correlation id: stitches this request across the submitter
+        # thread, the batcher lane and the dispatch lane in the trace,
+        # and lands in future.meta + the driver log
+        self.cid = _obs.next_cid()
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
@@ -153,11 +162,19 @@ class MicroBatcher:
         except queue.Full:
             if self._metrics:
                 self._metrics.on_reject("queue_full")
+            _obs.instant("serve.reject", cat="serving", cid=req.cid,
+                         reason="queue_full")
             raise Rejected(
                 f"serving queue full ({self._queue.maxsize} requests); "
                 "backpressure — retry with backoff or raise capacity")
         if self._metrics:
             self._metrics.on_admit(self._queue.qsize())
+        tr = _obs.tracer()
+        if tr is not None:
+            tr.instant("serve.admit", cat="serving", cid=req.cid,
+                       rows=rows, depth=self._queue.qsize())
+        logger.debug("admitted request %s (%d rows)", req.cid, rows,
+                     extra={"cid": req.cid})
         return req.future
 
     @property
@@ -207,6 +224,8 @@ class MicroBatcher:
             if req.expired(now):
                 if self._metrics:
                     self._metrics.on_reject("deadline")
+                _obs.instant("serve.reject", cat="serving", cid=req.cid,
+                             reason="deadline")
                 req.future.set_error(DeadlineExceeded(
                     f"deadline passed after {1e3 * (now - req.t_enqueue):.1f} ms "
                     "in queue (coalesced but not dispatched)"))

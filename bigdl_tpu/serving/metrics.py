@@ -23,6 +23,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from bigdl_tpu import obs as _obs
+
 _LO_MS = 1e-2
 _HI_MS = 1e5
 _N_BUCKETS = 60
@@ -125,6 +127,7 @@ class ServingMetrics:
             self.queue_depth = depth
             if depth > self.queue_depth_peak:
                 self.queue_depth_peak = depth
+        _obs.registry().inc("serving/requests_admitted")
 
     def on_reject(self, reason: str) -> None:
         with self._lock:
@@ -134,6 +137,7 @@ class ServingMetrics:
                 self.rejected_deadline += 1
             else:
                 self.rejected_shutdown += 1
+        _obs.registry().inc(f"serving/rejected_{reason}")
 
     def on_batch(self, bucket: int, rows: int, batch_ms: float) -> None:
         with self._lock:
@@ -143,6 +147,7 @@ class ServingMetrics:
             self.batch_ms.observe(batch_ms)
             b, r = self._per_bucket.get(bucket, (0, 0))
             self._per_bucket[bucket] = (b + 1, r + rows)
+        _obs.registry().inc("serving/batches")
 
     def on_complete(self, queue_ms: float, total_ms: float, depth: int) -> None:
         with self._lock:
@@ -150,6 +155,7 @@ class ServingMetrics:
             self.queue_ms.observe(queue_ms)
             self.total_ms.observe(total_ms)
             self.queue_depth = depth
+        _obs.registry().inc("serving/requests_completed")
 
     def on_nonfinite(self) -> None:
         """A request's OUTPUT rows contained NaN/Inf and the runtime's
@@ -157,10 +163,12 @@ class ServingMetrics:
         the serving dual of the trainer's divergence watchdog)."""
         with self._lock:
             self.rejected_nonfinite += 1
+        _obs.registry().inc("serving/rejected_nonfinite")
 
     def on_swap(self) -> None:
         with self._lock:
             self.swaps += 1
+        _obs.registry().inc("serving/swaps")
 
     # -- read-back ---------------------------------------------------------
 
@@ -171,6 +179,17 @@ class ServingMetrics:
         return self.rows_real / dispatched if dispatched else 0.0
 
     def snapshot(self) -> Dict:
+        snap = self._snapshot_locked()
+        # gauge mirror: the registry's serving/ view tracks the last
+        # snapshot (counters above are incremented at record time)
+        reg = _obs.registry()
+        reg.set_gauge("serving/latency_p50_ms", snap["latency_ms"]["p50"])
+        reg.set_gauge("serving/latency_p99_ms", snap["latency_ms"]["p99"])
+        reg.set_gauge("serving/batch_occupancy", snap["batch_occupancy"])
+        reg.set_gauge("serving/queue_depth_peak", snap["queue_depth_peak"])
+        return snap
+
+    def _snapshot_locked(self) -> Dict:
         with self._lock:
             per_bucket = {
                 str(b): {"batches": n, "rows": r,

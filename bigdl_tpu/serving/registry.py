@@ -28,6 +28,8 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
 import jax
 
+from bigdl_tpu import obs as _obs
+
 
 class ModelVersion(NamedTuple):
     version: str
@@ -70,11 +72,16 @@ class ModelRegistry:
         if self._warmup is not None:
             # compile/warm BEFORE the swap: requests keep hitting the old
             # version until the new one is ready to serve at full speed
-            self._warmup(mv.params, mv.state)
+            with _obs.span("registry.warmup", cat="serving",
+                           version=mv.version):
+                self._warmup(mv.params, mv.state)
         with self._lock:
             self._versions[mv.version] = mv
             if activate or self._active is None:
                 self._active = mv
+        _obs.registry().inc("serving/registrations")
+        _obs.instant("registry.activate", cat="serving", version=mv.version,
+                     source=source)
         return mv
 
     def register_checkpoint(self, version: str, ckpt_dir: str, *,

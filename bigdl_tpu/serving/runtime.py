@@ -25,11 +25,13 @@ serving:
 from __future__ import annotations
 
 import time
+from contextlib import nullcontext
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
+from bigdl_tpu import obs as _obs
 from bigdl_tpu.analysis.runtime import strict_transfers, strict_transfers_enabled
 from bigdl_tpu.core.table import Table
 from bigdl_tpu.nn.module import Module
@@ -37,6 +39,8 @@ from bigdl_tpu.optim.predictor import _batch_rows, _pad_batch
 from bigdl_tpu.serving.batcher import MicroBatcher
 from bigdl_tpu.serving.metrics import ServingMetrics
 from bigdl_tpu.serving.registry import ModelRegistry, ModelVersion
+
+_NULL = nullcontext()  # reusable: hot paths must not allocate one per call
 
 
 class NonFiniteOutput(RuntimeError):
@@ -109,6 +113,11 @@ class ServingRuntime:
 
         self.registry = ModelRegistry(warmup=self._warmup)
         self.registry.register(version, params, state if state is not None else {})
+        # warmup compiled every bucket above: from here on any compile
+        # under a serving/ signature is a steady-state recompile alarm
+        mon = _obs.compile_monitor()
+        if mon is not None:
+            mon.mark_steady("serving/")
         self._batcher = MicroBatcher(
             self._dispatch, buckets=self.config.buckets,
             max_wait_ms=self.config.max_wait_ms,
@@ -130,9 +139,11 @@ class ServingRuntime:
         for bucket in self.config.buckets:
             xp = _pad_batch(self._example, bucket)
             self._record_shape(xp)
-            y = self._fwd(params, state, self._to_device(xp))
-            jax.tree_util.tree_map(
-                lambda l: getattr(l, "block_until_ready", lambda: l)(), y)
+            with _obs.attribute(f"serving/bucket={bucket}"), \
+                    _obs.span("serve.warmup", cat="serving", bucket=bucket):
+                y = self._fwd(params, state, self._to_device(xp))
+                jax.tree_util.tree_map(
+                    lambda l: getattr(l, "block_until_ready", lambda: l)(), y)
 
     def compile_count(self) -> int:
         """Distinct compiled forward shapes.  The jit cache size is the
@@ -157,6 +168,8 @@ class ServingRuntime:
         return jax.device_put(np.asarray(x))  # explicit h2d, guard-friendly
 
     def _dispatch(self, requests, bucket: int) -> None:
+        tr = _obs.tracer()
+        mon = _obs.compile_monitor()
         t_dispatch = time.perf_counter()
         snap: ModelVersion = self.registry.active()
         if self._example is None:
@@ -166,10 +179,15 @@ class ServingRuntime:
         x = _concat_rows([r.x for r in requests])
         xp = _pad_batch(x, bucket) if rows < bucket else x
         self._record_shape(xp)
-        with strict_transfers(strict_transfers_enabled(
-                self.config.strict_transfers)):
-            y = self._fwd(snap.params, snap.state, self._to_device(xp))
-        y = jax.device_get(y)  # ONE host sync per batch, post-dispatch
+        with (tr.span("serve.dispatch", cat="serving", bucket=bucket,
+                      rows=rows, cids=[r.cid for r in requests])
+              if tr is not None else _NULL), \
+                (mon.attribute(f"serving/bucket={bucket}")
+                 if mon is not None else _NULL):
+            with strict_transfers(strict_transfers_enabled(
+                    self.config.strict_transfers)):
+                y = self._fwd(snap.params, snap.state, self._to_device(xp))
+            y = jax.device_get(y)  # ONE host sync per batch, post-dispatch
         t_done = time.perf_counter()
         self.metrics.on_batch(bucket, rows, (t_done - t_dispatch) * 1e3)
         off = 0
@@ -179,6 +197,7 @@ class ServingRuntime:
             out = _slice_rows(y, off, off + req.rows)
             off += req.rows
             req.future.meta = {
+                "cid": req.cid,
                 "version": snap.version, "bucket": bucket, "batch_rows": rows,
                 "queue_ms": (t_dispatch - req.t_enqueue) * 1e3,
                 "batch_ms": (t_done - t_dispatch) * 1e3,
@@ -187,12 +206,18 @@ class ServingRuntime:
                 # per-request: only the poisoned rows fail; finite rows
                 # co-batched with them still resolve normally below
                 self.metrics.on_nonfinite()
+                if tr is not None:
+                    tr.instant("serve.nonfinite", cat="serving",
+                               cid=req.cid, version=snap.version)
                 req.future.set_error(NonFiniteOutput(
                     f"non-finite values in output rows (model version "
                     f"{snap.version!r}, bucket {bucket})"))
                 continue
             self.metrics.on_complete((t_dispatch - req.t_enqueue) * 1e3,
                                      (t_done - req.t_enqueue) * 1e3, depth)
+            if tr is not None:
+                tr.instant("serve.complete", cat="serving", cid=req.cid,
+                           queue_ms=round(req.future.meta["queue_ms"], 3))
             req.future.set_result(out)
 
     def submit(self, x: Any, deadline_ms: Optional[float] = None):

@@ -1,19 +1,94 @@
-"""Console-noise control for training runs.
+"""Console-noise control + structured driver logs for training runs.
 
 Reference: utils/LoggerFilter.scala:91 (redirectSparkInfoLogs) — routes the
 noisy engine-under-the-framework logs (Spark/Akka INFO there; jax/absl/XLA
 chatter here) into a log file, while `bigdl.optim` keeps logging the
 per-iteration loss/throughput lines to the console.
+
+Structured option (`BIGDL_TPU_LOG_JSON=1`): driver-log lines become JSONL
+records carrying the contextual fields call sites attach via logging's
+`extra=` — the trainer stamps `step`/`epoch`, serving stamps the request
+correlation id `cid` — so a log pipeline can join driver lines against
+the obs trace/metrics by the same keys.  The human format stays the
+default; JSON is strictly opt-in.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
+import time
 from typing import Optional, Sequence
 
 DEFAULT_NOISY = ("jax", "jax._src", "absl", "orbax", "flax")
 _redirected: list = []
+_json_handlers: list = []
+
+# LogRecord's own attribute set: anything beyond these on a record came in
+# through `extra=` and belongs in the JSON payload (step, epoch, cid, ...)
+_RECORD_FIELDS = frozenset(vars(logging.makeLogRecord({})))
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line: ts/level/logger/msg + `extra` fields."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        doc = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        for key, value in record.__dict__.items():
+            if key not in _RECORD_FIELDS and not key.startswith("_"):
+                doc[key] = value if isinstance(
+                    value, (int, float, str, bool, type(None))) else repr(value)
+        if record.exc_info:
+            doc["exc"] = self.formatException(record.exc_info)
+        return json.dumps(doc)
+
+
+def json_logs_enabled(override: Optional[bool] = None) -> bool:
+    """Structured-driver-log toggle: explicit override wins, else
+    `BIGDL_TPU_LOG_JSON` (default OFF — human format)."""
+    if override is not None:
+        return bool(override)
+    return os.environ.get("BIGDL_TPU_LOG_JSON", "").lower() in (
+        "1", "true", "yes", "on")
+
+
+def enable_json_logs(logger_name: str = "bigdl_tpu",
+                     stream=None) -> logging.Handler:
+    """Attach a JSONL console handler to `logger_name` (propagation off so
+    lines don't double-print through root's human handler)."""
+    lg = logging.getLogger(logger_name)
+    handler = logging.StreamHandler(stream)
+    handler.setFormatter(JsonFormatter())
+    lg.addHandler(handler)
+    lg.setLevel(logging.INFO)
+    lg.propagate = False
+    _json_handlers.append((lg, handler))
+    return handler
+
+
+def disable_json_logs() -> None:
+    """Detach handlers installed by enable_json_logs (tests/cleanup)."""
+    while _json_handlers:
+        lg, handler = _json_handlers.pop()
+        lg.removeHandler(handler)
+        lg.propagate = True
+        handler.close()
+
+
+def maybe_enable_json_logs(logger_name: str = "bigdl_tpu") -> bool:
+    """Install the JSONL handler iff BIGDL_TPU_LOG_JSON asks for it and
+    one is not already attached.  Returns whether JSON logging is on."""
+    if not json_logs_enabled():
+        return False
+    if not _json_handlers:
+        enable_json_logs(logger_name)
+    return True
 
 
 def redirect_verbose_logs(log_path: Optional[str] = None,
@@ -26,10 +101,12 @@ def redirect_verbose_logs(log_path: Optional[str] = None,
     reference: utils/LoggerFilter.scala:91-137.
     """
     undo_redirect()  # calling twice must not stack handlers / double lines
+    maybe_enable_json_logs(keep_console)
     path = log_path or os.environ.get("BIGDL_LOG_PATH", "bigdl_tpu.log")
     handler = logging.FileHandler(path)
-    handler.setFormatter(logging.Formatter(
-        "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+    handler.setFormatter(JsonFormatter() if json_logs_enabled()
+                         else logging.Formatter(
+                             "%(asctime)s %(levelname)s %(name)s: %(message)s"))
     for name in noisy_loggers:
         lg = logging.getLogger(name)
         lg.addHandler(handler)

@@ -79,6 +79,14 @@ class Summary:
         self._efh.write(json.dumps(rec) + "\n")
         self._efh.flush()
 
+    def log_registry(self, step: int, prefix: str = "") -> None:
+        """Bridge the active obs MetricsRegistry into this summary: one
+        scalar per counter/gauge under `prefix` (""= everything), so the
+        unified metrics plane lands in the same TensorBoard/JSONL stream
+        as Loss/Throughput (docs/observability.md)."""
+        from bigdl_tpu import obs as _obs
+        _obs.registry().to_summary(self, int(step), prefix)
+
     def read_events(self, kind: Optional[str] = None) -> List[Dict]:
         """Read back the event stream, optionally filtered by kind."""
         out: List[Dict] = []

@@ -1,0 +1,551 @@
+"""bigdl_tpu.obs: span tracer, compile attribution, metrics plane.
+
+The acceptance-criteria tests live here: a traced serving burst must
+carry one correlation id per request from admission through completion
+(trace instants + future.meta agree), the exported Chrome trace must be
+valid JSON with the required per-event fields, the compile monitor must
+count the 1/8/32 bucket warmup compiles and see ZERO steady-state
+recompiles afterwards, the legacy counter surfaces (INTEGRITY_COUNTERS,
+ServingMetrics) must read the same values as the registry that now owns
+them, and a traced hot section must stay legal under strict_transfers —
+the tracer itself adds no device syncs.
+"""
+
+import json
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import obs
+from bigdl_tpu.analysis.runtime import strict_transfers
+from bigdl_tpu.obs import CompileMonitor, MetricsRegistry, NullRegistry, SpanTracer
+from bigdl_tpu.serving import ServingRuntime
+
+
+@pytest.fixture()
+def fresh_obs():
+    """Fresh tracer + monitor + registry for one test; the default plane
+    (metrics + compile monitor on, tracing off) is restored afterwards so
+    this module never leaks counters into other test files."""
+    old_reg = obs.set_registry(MetricsRegistry())
+    obs.set_observability(metrics=True, tracing=True, compile_monitor=True)
+    yield
+    obs.set_observability(metrics=True, tracing=False, compile_monitor=True)
+    obs.set_registry(old_reg)
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    model = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 4),
+                          nn.LogSoftMax())
+    params, state, _ = model.build(jax.random.PRNGKey(0), (8, 6))
+    return model, params, state
+
+
+def _runtime(small_model, **kw):
+    model, params, state = small_model
+    kw.setdefault("buckets", (1, 8, 32))
+    kw.setdefault("example_input", np.zeros((1, 6), np.float32))
+    return ServingRuntime(model, params, state, **kw)
+
+
+def _events_named(tr, name):
+    return [e for e in tr.events() if e[1] == name]
+
+
+# -- span tracer -----------------------------------------------------------
+
+
+def test_span_nesting_and_ordering():
+    tr = SpanTracer(capacity=128)
+    with tr.span("outer", cat="t", step=1):
+        time.sleep(0.002)
+        with tr.span("inner", cat="t"):
+            time.sleep(0.001)
+        tr.instant("mark", cat="t", k="v")
+    evs = tr.events()
+    # exit order: inner completes (appends) before outer
+    assert [e[1] for e in evs] == ["inner", "mark", "outer"]
+    inner, mark, outer = evs
+    # containment: inner's [ts, ts+dur) sits inside outer's
+    assert outer[5] <= inner[5]
+    assert inner[5] + inner[6] <= outer[5] + outer[6]
+    assert mark[6] == 0 and mark[0] == "i"
+    assert outer[7] == {"step": 1}
+    assert inner[3] == threading.current_thread().ident
+
+
+def test_ring_bounded_and_counts_drops():
+    tr = SpanTracer(capacity=8)
+    for i in range(20):
+        tr.instant("e%d" % i)
+    evs = tr.events()
+    assert len(evs) == 8
+    assert [e[1] for e in evs] == ["e%d" % i for i in range(12, 20)]
+    assert tr.dropped == 12
+    tr.clear()
+    assert tr.events() == [] and tr.dropped == 0
+
+
+def test_chrome_export_is_valid_trace_json(tmp_path):
+    tr = SpanTracer()
+    with tr.span("phase", cat="host", step=3):
+        tr.instant("tick", cat="host")
+    path = str(tmp_path / "trace.json")
+    tr.export_chrome(path)
+    with open(path) as f:
+        doc = json.load(f)  # must be VALID json, not json-ish
+    assert doc["displayTimeUnit"] == "ms"
+    assert doc["otherData"]["dropped_events"] == 0
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and evs
+    for ev in evs:
+        for field in ("ph", "name", "pid", "tid"):
+            assert field in ev, f"{field} missing from {ev}"
+        if ev["ph"] in ("X", "i"):
+            assert "ts" in ev and ev["ts"] >= 0
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+    metas = [e for e in evs if e["ph"] == "M"]
+    assert metas and all(e["name"] == "thread_name" for e in metas)
+    span = next(e for e in evs if e["name"] == "phase")
+    assert span["ph"] == "X" and span["args"] == {"step": 3}
+    tick = next(e for e in evs if e["name"] == "tick")
+    assert tick["ph"] == "i" and tick["s"] == "t"
+    # the instant happened while the span was open
+    assert span["ts"] <= tick["ts"] <= span["ts"] + span["dur"]
+
+
+def test_export_trace_returns_empty_when_tracing_off():
+    assert obs.tracer() is None  # module default: tracing is opt-in
+    assert obs.export_trace("/nonexistent/never-written.json") == {}
+
+
+# -- serving: correlation ids through a concurrent burst -------------------
+
+
+def test_cid_propagation_through_concurrent_burst(fresh_obs, small_model):
+    rs = np.random.RandomState(0)
+    xs = [rs.randn(1, 6).astype(np.float32) for _ in range(48)]
+    with _runtime(small_model, max_wait_ms=5.0) as rt:
+        with ThreadPoolExecutor(max_workers=48) as pool:
+            futures = list(pool.map(rt.submit, xs))
+        outs = [f.result(30.0) for f in futures]
+    assert all(o.shape == (1, 4) for o in outs)
+
+    cids = [f.meta["cid"] for f in futures]
+    assert len(set(cids)) == len(cids), "correlation ids must be unique"
+    tr = obs.tracer()
+    admits = {e[7]["cid"] for e in _events_named(tr, "serve.admit")}
+    completes = {e[7]["cid"] for e in _events_named(tr, "serve.complete")}
+    assert set(cids) <= admits
+    assert set(cids) <= completes
+    # dispatch spans list the cids they co-batched; the union covers the
+    # burst, and coalescing means fewer dispatches than requests
+    dispatches = _events_named(tr, "serve.dispatch")
+    assert 0 < len(dispatches) < len(xs)
+    batched = [cid for e in dispatches for cid in e[7]["cids"]]
+    assert set(cids) <= set(batched)
+    assert len(batched) == len(set(batched)), "a request dispatched twice"
+    # admit and complete happen on different lanes (submitter vs batcher)
+    admit_tids = {e[3] for e in _events_named(tr, "serve.admit")}
+    complete_tids = {e[3] for e in _events_named(tr, "serve.complete")}
+    assert admit_tids.isdisjoint(complete_tids)
+
+
+# -- compile monitor -------------------------------------------------------
+
+
+def test_bucket_warmup_attributed_zero_steady_recompiles(fresh_obs,
+                                                         small_model):
+    mon = obs.compile_monitor()
+    rs = np.random.RandomState(1)
+    xs = [rs.randn(1, 6).astype(np.float32) for _ in range(64)]
+    with _runtime(small_model, max_wait_ms=5.0) as rt:
+        snap = mon.snapshot()
+        # every bucket's warmup compiled under its own signature and was
+        # force-settled by the runtime's mark_steady("serving/")
+        for bucket in (1, 8, 32):
+            sig = f"serving/bucket={bucket}"
+            assert snap[sig]["compiles"] >= 1, snap
+            assert snap[sig]["settled"], snap
+            assert snap[sig]["recompiles"] == 0
+        with ThreadPoolExecutor(max_workers=64) as pool:
+            list(pool.map(rt.predict, xs))
+    # the burst replays warmed shapes: the executable set may not grow
+    assert mon.recompiles("serving/") == 0
+    assert obs.registry().get("compile/steady_recompiles") == 0
+    # the trace carries the compile events, attributed
+    compiles = _events_named(obs.tracer(), "xla_compile")
+    attributed = [e for e in compiles
+                  if e[7]["signature"].startswith("serving/bucket=")]
+    assert len(attributed) >= 3
+    assert not any(e[7]["steady_recompile"] for e in attributed)
+
+
+def test_settle_heuristic_and_steady_recompile_alarm(fresh_obs, caplog):
+    mon = obs.compile_monitor()
+    with mon.attribute("t/step"):
+        mon.on_compile(0.25)  # warmup compile
+    assert not mon.snapshot()["t/step"]["settled"]
+    with mon.attribute("t/step"):
+        pass  # re-entry with zero new compiles: signature settles
+    assert mon.snapshot()["t/step"]["settled"]
+    with caplog.at_level(logging.WARNING, logger="bigdl_tpu.obs"):
+        with mon.attribute("t/step"):
+            mon.on_compile(0.05)  # the executable set grew after settling
+    rec = mon.snapshot()["t/step"]
+    assert rec["compiles"] == 2 and rec["recompiles"] == 1
+    assert rec["secs"] == pytest.approx(0.30)
+    assert obs.registry().get("compile/total") == 2
+    assert obs.registry().get("compile/steady_recompiles") == 1
+    assert any("steady-state XLA recompile" in r.message
+               for r in caplog.records)
+
+
+def test_mark_steady_and_nested_attribution(fresh_obs):
+    mon = obs.compile_monitor()
+    mon.on_compile(0.1)  # outside any scope
+    with mon.attribute("outer"):
+        with mon.attribute("outer/inner"):
+            mon.on_compile(0.2)  # innermost scope wins
+        mon.on_compile(0.3)
+    snap = mon.snapshot()
+    assert snap["unattributed"]["compiles"] == 1
+    assert snap["outer/inner"]["compiles"] == 1
+    assert snap["outer"]["compiles"] == 1
+    mon.mark_steady("outer")
+    with mon.attribute("outer/inner"):
+        mon.on_compile(0.1)
+    assert mon.recompiles("outer") == 1
+    assert mon.compiles() == 4
+
+
+# -- legacy counter surfaces read through the registry ---------------------
+
+
+def test_integrity_counters_alias_reads_registry(fresh_obs):
+    from bigdl_tpu.health import INTEGRITY_COUNTERS, reset_counters
+    from bigdl_tpu.health.integrity import count
+
+    reset_counters()
+    assert INTEGRITY_COUNTERS["verified"] == 0
+    count("verified", 3)
+    count("corrupt_skipped")
+    assert INTEGRITY_COUNTERS["verified"] == 3
+    assert INTEGRITY_COUNTERS["corrupt_skipped"] == 1
+    assert INTEGRITY_COUNTERS["unhealthy_skipped"] == 0
+    # the mapping view and the registry are the SAME state
+    assert obs.registry().get("integrity/verified") == 3
+    assert dict(INTEGRITY_COUNTERS) == {"verified": 3, "corrupt_skipped": 1,
+                                        "unhealthy_skipped": 0}
+    reset_counters()
+    assert INTEGRITY_COUNTERS["verified"] == 0
+    assert obs.registry().get("integrity/verified") == 0
+
+
+def test_serving_metrics_mirror_into_registry(fresh_obs):
+    from bigdl_tpu.serving.metrics import ServingMetrics
+
+    sm = ServingMetrics()
+    for depth in (1, 2, 3):
+        sm.on_admit(depth)
+    sm.on_batch(8, 5, 1.5)
+    sm.on_complete(0.4, 2.1, 2)
+    sm.on_reject("queue_full")
+    sm.on_reject("deadline")
+    sm.on_nonfinite()
+    snap = sm.snapshot()
+    reg = obs.registry()
+    assert reg.get("serving/requests_admitted") == snap["requests_admitted"] == 3
+    assert reg.get("serving/requests_completed") == snap["requests_completed"] == 1
+    assert reg.get("serving/batches") == snap["batches"] == 1
+    assert reg.get("serving/rejected_queue_full") == 1
+    assert reg.get("serving/rejected_deadline") == 1
+    assert reg.get("serving/rejected_nonfinite") == 1
+    # snapshot() mirrors the derived values as gauges
+    assert reg.get("serving/latency_p50_ms") == snap["latency_ms"]["p50"]
+    assert reg.get("serving/batch_occupancy") == snap["batch_occupancy"]
+    assert reg.get("serving/queue_depth_peak") == 3
+
+
+# -- registry mechanics + exporters ----------------------------------------
+
+
+def test_registry_counters_gauges_and_reset():
+    reg = MetricsRegistry()
+    assert reg.inc("a/x") == 1
+    assert reg.inc("a/x", 4) == 5
+    reg.set_gauge("a/g", 2.5)
+    reg.set_gauge("b/g", 7)
+    assert reg.get("a/x") == 5 and reg.get("a/g") == 2.5
+    assert reg.get("missing", -1) == -1
+    assert reg.counters("a/") == {"a/x": 5}
+    assert set(reg.gauges()) == {"a/g", "b/g"}
+    reg.reset("a/")
+    assert reg.get("a/x") == 0 and reg.get("b/g") == 7
+
+
+def test_set_registry_isolates(fresh_obs):
+    mine = MetricsRegistry()
+    prev = obs.set_registry(mine)
+    try:
+        obs.registry().inc("iso/x")
+        assert mine.get("iso/x") == 1
+        assert prev.get("iso/x") == 0
+    finally:
+        obs.set_registry(prev)
+    assert obs.registry() is prev
+
+
+def test_null_registry_discards():
+    reg = NullRegistry()
+    assert reg.inc("x", 5) == 0
+    reg.set_gauge("g", 1.0)
+    assert reg.get("x") == 0 and reg.get("g") == 0
+    assert reg.snapshot() == {"counters": {}, "gauges": {}}
+
+
+def test_jsonl_export_appends_tailable_lines(tmp_path):
+    reg = MetricsRegistry()
+    reg.inc("train/steps", 10)
+    reg.set_gauge("train/loss", 0.5)
+    path = str(tmp_path / "metrics.jsonl")
+    reg.export_jsonl(path, step=10)
+    reg.inc("train/steps", 10)
+    reg.export_jsonl(path, step=20, extra={"run": "quick"})
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 2
+    assert lines[0]["step"] == 10
+    assert lines[0]["counters"]["train/steps"] == 10
+    assert lines[1]["counters"]["train/steps"] == 20
+    assert lines[1]["run"] == "quick"
+    assert lines[1]["gauges"]["train/loss"] == 0.5
+    assert lines[0]["ts"] <= lines[1]["ts"]
+
+
+def test_prometheus_textfile_format(tmp_path):
+    reg = MetricsRegistry()
+    reg.inc("serving/requests_completed", 64)
+    reg.set_gauge("serving/latency_p99_ms", 12.5)
+    path = str(tmp_path / "metrics.prom")
+    reg.export_prometheus(path)
+    text = open(path).read()
+    assert text.endswith("\n")
+    assert ("# TYPE bigdl_tpu_serving_requests_completed counter"
+            in text.splitlines())
+    assert "bigdl_tpu_serving_requests_completed 64" in text.splitlines()
+    assert "bigdl_tpu_serving_latency_p99_ms 12.5" in text.splitlines()
+    # sanitized names only: no slashes may survive
+    assert "/" not in text
+
+
+def test_registry_to_summary_bridge(tmp_path):
+    from bigdl_tpu.utils.summary import TrainSummary
+
+    reg = MetricsRegistry()
+    reg.inc("train/steps", 16)
+    reg.set_gauge("feed/stall_ms", 0.25)
+    prev = obs.set_registry(reg)
+    try:
+        summary = TrainSummary(str(tmp_path), "obs_test")
+        summary.log_registry(step=16)
+        summary.close()
+        assert summary.read_scalar("train/steps") == [(16, 16.0)]
+        assert summary.read_scalar("feed/stall_ms") == [(16, 0.25)]
+    finally:
+        obs.set_registry(prev)
+
+
+# -- gating ----------------------------------------------------------------
+
+
+def test_set_observability_gating(fresh_obs):
+    state = obs.set_observability(tracing=False)
+    assert state["tracing"] is False and obs.tracer() is None
+    with obs.span("noop"):  # shared nullcontext: still usable
+        pass
+    obs.instant("noop")  # no-op, no error
+    state = obs.set_observability(metrics=False)
+    assert state["metrics"] is False
+    assert isinstance(obs.registry(), NullRegistry)
+    obs.registry().inc("x")
+    assert obs.registry().get("x") == 0
+    state = obs.set_observability(metrics=True, tracing=True)
+    assert state == {"metrics": True, "tracing": True,
+                     "compile_monitor": True}
+    assert isinstance(obs.registry(), MetricsRegistry)
+    assert obs.tracer() is not None
+    # fresh ring on re-enable, not the old one
+    assert obs.tracer().events() == []
+
+
+def test_env_gating(monkeypatch):
+    from bigdl_tpu.obs import _init_from_env
+
+    old_reg = obs.set_registry(MetricsRegistry())
+    try:
+        monkeypatch.setenv("BIGDL_TPU_OBS", "0")
+        _init_from_env()
+        assert obs.observability() == {"metrics": False, "tracing": False,
+                                       "compile_monitor": False}
+        monkeypatch.setenv("BIGDL_TPU_OBS", "trace")
+        _init_from_env()
+        assert obs.observability() == {"metrics": True, "tracing": True,
+                                       "compile_monitor": True}
+        monkeypatch.delenv("BIGDL_TPU_OBS")
+        _init_from_env()
+        assert obs.observability() == {"metrics": True, "tracing": False,
+                                       "compile_monitor": True}
+    finally:
+        obs.set_observability(metrics=True, tracing=False,
+                              compile_monitor=True)
+        obs.set_registry(old_reg)
+
+
+# -- strict transfers: the tracer adds zero device syncs -------------------
+
+
+def test_traced_span_adds_no_syncs_under_strict_transfers(fresh_obs):
+    f = jax.jit(lambda x: x * 2)
+    x = jax.device_put(jnp.ones((4,), jnp.float32))
+    f(x)  # compile OUTSIDE the guard
+    tr = obs.tracer()
+    with strict_transfers(True):
+        with tr.span("hot", cat="t", step=1):
+            y = f(x)  # device-resident args: must pass
+            tr.instant("dispatched", cat="t")
+    assert float(jax.device_get(y)[0]) == 2.0
+    # compile events from the warm call ride the same ring; the traced
+    # section itself recorded exactly its instant + span
+    names = [e[1] for e in tr.events() if e[1] != "xla_compile"]
+    assert names == ["dispatched", "hot"]
+
+
+def test_injected_host_sync_inside_traced_span_still_raises(fresh_obs):
+    f = jax.jit(lambda x: x + 1)
+    f(jnp.float32(1.0))  # compile OUTSIDE the guard
+    tr = obs.tracer()
+    with strict_transfers(True):
+        with pytest.raises(Exception, match="(?i)transfer"):
+            with tr.span("hot", cat="t"):
+                f(2.0)  # python scalar -> implicit h2d: the guard, not
+                # the tracer, must be what fires
+    # the span still closed and recorded despite the exception
+    assert [e[1] for e in tr.events() if e[1] != "xla_compile"] == ["hot"]
+
+
+# -- structured driver logs ------------------------------------------------
+
+
+def test_json_formatter_carries_extra_fields():
+    import io
+
+    from bigdl_tpu.utils import logger_filter as lf
+
+    buf = io.StringIO()
+    lf.enable_json_logs("bigdl_tpu_obs_json_test", stream=buf)
+    try:
+        lg = logging.getLogger("bigdl_tpu_obs_json_test.optim")
+        lg.info("Epoch %d iteration %d: loss %f", 1, 7, 0.25,
+                extra={"step": 7, "epoch": 1})
+        lg.info("admitted request %s", "r-42", extra={"cid": "r-42"})
+        lg.info("payload %s", "x", extra={"blob": {"a": 1}})
+    finally:
+        lf.disable_json_logs()
+    lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+    assert len(lines) == 3
+    assert lines[0]["msg"] == "Epoch 1 iteration 7: loss 0.250000"
+    assert lines[0]["step"] == 7 and lines[0]["epoch"] == 1
+    assert lines[0]["level"] == "INFO"
+    assert lines[0]["logger"] == "bigdl_tpu_obs_json_test.optim"
+    assert lines[1]["cid"] == "r-42"
+    assert lines[2]["blob"] == repr({"a": 1})  # non-scalars stringified
+    # the propagation flag was restored by disable
+    assert logging.getLogger("bigdl_tpu_obs_json_test").propagate
+
+
+def test_json_logs_env_toggle(monkeypatch):
+    from bigdl_tpu.utils import logger_filter as lf
+
+    monkeypatch.delenv("BIGDL_TPU_LOG_JSON", raising=False)
+    assert not lf.json_logs_enabled()  # human format is the default
+    assert not lf.maybe_enable_json_logs("bigdl_tpu_obs_env_test")
+    monkeypatch.setenv("BIGDL_TPU_LOG_JSON", "1")
+    assert lf.json_logs_enabled()
+    try:
+        assert lf.maybe_enable_json_logs("bigdl_tpu_obs_env_test")
+        # idempotent: a second call must not stack a second handler
+        assert lf.maybe_enable_json_logs("bigdl_tpu_obs_env_test")
+        assert len(logging.getLogger(
+            "bigdl_tpu_obs_env_test").handlers) == 1
+    finally:
+        lf.disable_json_logs()
+    assert lf.json_logs_enabled(override=False) is False
+    assert lf.json_logs_enabled(override=True) is True
+
+
+# -- correlation ids -------------------------------------------------------
+
+
+def test_next_cid_unique_across_threads():
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        cids = list(pool.map(lambda _: obs.next_cid(), range(200)))
+    assert len(set(cids)) == 200
+    assert all(c.startswith("r-") for c in cids)
+
+
+# -- end-to-end: a short traced training run -------------------------------
+
+
+def test_traced_training_run_spans_and_metrics(fresh_obs, tmp_path):
+    from bigdl_tpu import optim
+    from bigdl_tpu.dataset import ArrayDataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.optim import SGD, Trigger
+
+    rs = np.random.RandomState(7)
+    samples = [Sample.from_ndarray(rs.randn(8).astype(np.float32),
+                                   rs.randn(4).astype(np.float32))
+               for _ in range(64)]
+    ds = ArrayDataSet(samples).transform(SampleToMiniBatch(16))
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    o = optim.LocalOptimizer(model, ds, nn.MSECriterion(),
+                             optim_method=SGD(learning_rate=0.05),
+                             end_trigger=Trigger.max_epoch(2))
+    o.set_checkpoint(str(tmp_path / "ckpt"), Trigger.several_iteration(3))
+    o.set_strict_transfers(True)
+    o.optimize()
+
+    tr = obs.tracer()
+    names = {e[1] for e in tr.events()}
+    for required in ("feed_next", "step_dispatch", "step_drained",
+                     "ckpt_save", "ckpt.write", "ckpt.commit",
+                     "xla_compile"):
+        assert required in names, f"{required} missing from {sorted(names)}"
+    steps = [e[7]["step"] for e in _events_named(tr, "step_dispatch")]
+    # step args stamp the pre-increment neval: 64/16 = 4 batches x 2 epochs
+    assert steps == list(range(8))
+
+    mon = obs.compile_monitor()
+    snap = mon.snapshot()["train/step/bs=16"]
+    assert snap["compiles"] >= 1 and snap["settled"]
+    assert snap["recompiles"] == 0, (
+        "steady-state recompile in a vanilla fixed-shape run")
+
+    reg = obs.registry()
+    assert reg.get("train/steps") == 8
+    assert reg.get("ckpt/committed") >= 2
+    assert reg.get("train/loss") > 0
+    assert reg.get("train/throughput") > 0
+
+    doc = obs.export_trace(str(tmp_path / "train_trace.json"))
+    with open(tmp_path / "train_trace.json") as f:
+        assert json.load(f) == doc

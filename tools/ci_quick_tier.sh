@@ -37,6 +37,18 @@ if [ "$rc" -eq 0 ]; then
     elapsed=$(( $(date +%s) - start ))
 fi
 
+if [ "$rc" -eq 0 ]; then
+    # obs lane: a short traced train + serving burst in one process; the
+    # exported Chrome trace must be valid JSON with the feed/dispatch/
+    # ckpt/serving spans and >=1 compile event attributed to a bucket
+    # signature, and the metrics snapshot must export cleanly
+    remaining=$(( BUDGET - elapsed ))
+    [ "$remaining" -lt 30 ] && remaining=30
+    timeout --signal=TERM "$remaining" python tools/obs_smoke.py
+    rc=$?
+    elapsed=$(( $(date +%s) - start ))
+fi
+
 if [ "$rc" -eq 124 ]; then
     echo "FAIL: quick tier exceeded the ${BUDGET}s budget (killed)" >&2
     exit 1

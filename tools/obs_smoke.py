@@ -1,0 +1,182 @@
+"""CI obs lane: one traced train + serving burst, validated end to end.
+
+Runs a short traced training run and a concurrent serving burst in ONE
+process with the full observability plane on, exports the Chrome trace
+and a metrics snapshot, and exits nonzero unless:
+
+  * the trace file parses as VALID Chrome-trace JSON (json.load, not
+    json-ish) and every event carries ph/name/pid/tid (+ts for X/i,
+    +dur for X);
+  * the trace contains the trainer phase spans (feed_next,
+    step_dispatch), a checkpoint span (ckpt_save + the writer lane's
+    ckpt.write), and the serving lifecycle (serve.admit, serve.dispatch,
+    serve.complete);
+  * at least one xla_compile event is attributed to a
+    serving/bucket=N signature (the acceptance criterion) and one to
+    train/step/bs=N;
+  * zero steady-state recompiles were flagged across the whole run;
+  * the metrics snapshot carries the expected train/serving/ckpt
+    counters and exports to JSONL + Prometheus textfile formats.
+
+Usage: python tools/obs_smoke.py [outdir]   (default: a temp dir)
+"""
+
+import json
+import os
+import sys
+import tempfile
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax.extend.backend as _jeb
+
+    _jeb.clear_backends()
+except Exception:  # pragma: no cover - fallback for older jax
+    import jax._src.xla_bridge as _xb
+
+    _xb._clear_backends()
+
+import bigdl_tpu.nn as nn  # noqa: E402
+from bigdl_tpu import obs, optim  # noqa: E402
+from bigdl_tpu.dataset import ArrayDataSet, Sample, SampleToMiniBatch  # noqa: E402
+from bigdl_tpu.optim import SGD, Trigger  # noqa: E402
+from bigdl_tpu.serving import ServingRuntime  # noqa: E402
+
+REQUIRED_SPANS = ("feed_next", "step_dispatch", "ckpt_save", "ckpt.write",
+                  "serve.dispatch")
+REQUIRED_INSTANTS = ("serve.admit", "serve.complete", "ckpt.commit")
+
+
+def fail(msg):
+    print(f"FAIL(obs_smoke): {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def run_traced_train(ckpt_dir):
+    rs = np.random.RandomState(7)
+    samples = [Sample.from_ndarray(rs.randn(8).astype(np.float32),
+                                   rs.randn(4).astype(np.float32))
+               for _ in range(64)]
+    ds = ArrayDataSet(samples).transform(SampleToMiniBatch(16))
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    o = optim.LocalOptimizer(model, ds, nn.MSECriterion(),
+                             optim_method=SGD(learning_rate=0.05),
+                             end_trigger=Trigger.max_epoch(2))
+    o.set_checkpoint(ckpt_dir, Trigger.several_iteration(3))
+    o.set_strict_transfers(True)  # the tracer must add zero device syncs
+    o.optimize()
+
+
+def run_serving_burst():
+    model = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 4),
+                          nn.LogSoftMax())
+    params, state, _ = model.build(jax.random.PRNGKey(0), (8, 6))
+    rs = np.random.RandomState(0)
+    xs = [rs.randn(1, 6).astype(np.float32) for _ in range(32)]
+    with ServingRuntime(model, params, state, buckets=(1, 8, 32),
+                        example_input=np.zeros((1, 6), np.float32),
+                        max_wait_ms=5.0) as rt:
+        with ThreadPoolExecutor(max_workers=32) as pool:
+            futures = list(pool.map(rt.submit, xs))
+        outs = [f.result(30.0) for f in futures]
+    cids = [f.meta["cid"] for f in futures]
+    if len(set(cids)) != len(xs):
+        fail(f"correlation ids not unique: {len(set(cids))}/{len(xs)}")
+    if not all(o.shape == (1, 4) for o in outs):
+        fail("serving outputs have wrong shapes")
+
+
+def validate_trace(path):
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except Exception as e:
+        fail(f"trace is not valid JSON: {e}")
+    evs = doc.get("traceEvents")
+    if not isinstance(evs, list) or not evs:
+        fail("traceEvents missing or empty")
+    for ev in evs:
+        for field in ("ph", "name", "pid", "tid"):
+            if field not in ev:
+                fail(f"event missing {field!r}: {ev}")
+        if ev["ph"] in ("X", "i") and "ts" not in ev:
+            fail(f"timed event missing ts: {ev}")
+        if ev["ph"] == "X" and "dur" not in ev:
+            fail(f"complete event missing dur: {ev}")
+    names = {e["name"] for e in evs}
+    for req in REQUIRED_SPANS + REQUIRED_INSTANTS:
+        if req not in names:
+            fail(f"span/instant {req!r} absent from trace "
+                 f"(have: {sorted(names)})")
+    compiles = [e for e in evs if e["name"] == "xla_compile"]
+    sigs = {e["args"]["signature"] for e in compiles}
+    if not any(s.startswith("serving/bucket=") for s in sigs):
+        fail(f"no compile event attributed to a bucket signature: {sigs}")
+    if not any(s.startswith("train/step/bs=") for s in sigs):
+        fail(f"no compile event attributed to a train step: {sigs}")
+    if any(e["args"]["steady_recompile"] for e in compiles):
+        fail("steady-state recompile flagged during the smoke run")
+    return len(evs), sorted(sigs)
+
+
+def validate_metrics(outdir):
+    reg = obs.registry()
+    snap = reg.snapshot()
+    for counter, at_least in (("train/steps", 8),
+                              ("ckpt/committed", 2),
+                              ("serving/requests_admitted", 32),
+                              ("serving/requests_completed", 32),
+                              ("compile/total", 2)):
+        if snap["counters"].get(counter, 0) < at_least:
+            fail(f"counter {counter} = {snap['counters'].get(counter, 0)} "
+                 f"< {at_least}")
+    if snap["counters"].get("compile/steady_recompiles", 0):
+        fail("compile/steady_recompiles nonzero")
+    if "train/loss" not in snap["gauges"]:
+        fail("train/loss gauge missing")
+    jsonl = os.path.join(outdir, "metrics.jsonl")
+    prom = os.path.join(outdir, "metrics.prom")
+    reg.export_jsonl(jsonl, step=int(snap["counters"]["train/steps"]))
+    reg.export_prometheus(prom)
+    with open(jsonl) as f:
+        json.loads(f.readline())
+    with open(prom) as f:
+        if "bigdl_tpu_train_steps" not in f.read():
+            fail("prometheus export missing bigdl_tpu_train_steps")
+    return snap
+
+
+def main():
+    outdir = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp(
+        prefix="obs_smoke_")
+    os.makedirs(outdir, exist_ok=True)
+    obs.set_observability(metrics=True, tracing=True, compile_monitor=True)
+    run_traced_train(os.path.join(outdir, "ckpt"))
+    run_serving_burst()
+    trace_path = os.path.join(outdir, "trace.json")
+    obs.export_trace(trace_path)
+    n_events, sigs = validate_trace(trace_path)
+    snap = validate_metrics(outdir)
+    print(json.dumps({
+        "obs_smoke": "ok", "trace_events": n_events,
+        "compile_signatures": sigs,
+        "train_steps": snap["counters"]["train/steps"],
+        "serving_completed": snap["counters"]["serving/requests_completed"],
+        "artifacts": outdir}))
+
+
+if __name__ == "__main__":
+    main()
