@@ -17,6 +17,9 @@ and three request phases per variant:
   * burst64_b1 — 64 concurrent single-row requests (the coalescing smoke:
            occupancy/batches show the scheduler folding them into few
            fixed-shape forwards)
+  * swap — params-only hot-swap under traffic: swap_ms and
+           swap-to-first-request ms (the registry reuses every live
+           compiled executable, so neither includes a re-trace)
 
 Emits one JSON row per (variant, phase) with p50/p99/mean latency, batch
 occupancy, device-batch count and compiled-shape count, and writes the
@@ -110,6 +113,42 @@ def run_phase(module, params, state, image: int, phase: str, n: int):
         rt.close()
 
 
+def run_swap_phase(module, params, state, image: int):
+    """Hot-swap cost: under steady traffic, register a same-shaped second
+    version (a params-only swap — the registry reuses every live compiled
+    executable) and time both the swap itself and swap-to-first-request."""
+    import jax
+
+    from bigdl_tpu import obs
+    from bigdl_tpu.serving import ServingConfig, ServingRuntime
+
+    rs = np.random.RandomState(1)
+    example = rs.rand(1, image, image, 3).astype(np.float32)
+    rt = ServingRuntime(
+        module, params, state, example_input=example,
+        config=ServingConfig(buckets=BUCKETS, max_wait_ms=MAX_WAIT_MS,
+                             capacity=256))
+    try:
+        x = rs.rand(1, image, image, 3).astype(np.float32)
+        rt.predict(x)  # steady traffic before the swap
+        reused0 = obs.registry().get("serving/warmup_reused")
+        t0 = time.perf_counter()
+        rt.swap("v1", jax.tree_util.tree_map(lambda l: l, params), state)
+        swap_s = time.perf_counter() - t0
+        rt.predict(x)
+        first_s = time.perf_counter() - t0
+        return {
+            "phase": "swap", "requests": 1,
+            "swap_ms": round(swap_s * 1e3, 2),
+            "swap_to_first_request_ms": round(first_s * 1e3, 2),
+            "warmup_reused": int(obs.registry().get("serving/warmup_reused")
+                                 - reused0),
+            "compiled_shapes": rt.compile_count(),
+        }
+    finally:
+        rt.close()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -136,6 +175,12 @@ def main(argv=None):
                    **run_phase(module, params, state, image, phase, n)}
             rows.append(row)
             print(json.dumps(row), flush=True)
+        row = {"model": model_name, "variant": variant,
+               "platform": platform, "max_wait_ms": MAX_WAIT_MS,
+               "buckets": list(BUCKETS),
+               **run_swap_phase(module, params, state, image)}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
 
     out = os.path.join(os.path.dirname(__file__), "results", "serving.json")
     with open(out, "w") as f:

@@ -1,0 +1,256 @@
+"""bigdl_tpu.compilecache — persistent executable store for cold starts.
+
+Every deliberate-restart path in this repo (preemption resume, watchdog
+rollback, hang-detection restart, registry hot-swap, serving activation)
+used to pay full XLA recompilation of every step/bucket executable.
+This package makes restart-to-first-step a disk read instead:
+
+  * **AOT layer** (`load_or_compile`): for the executables we control
+    end-to-end, `jit_fn.lower(*args)` is hashed into a content key
+    (keys.py: StableHLO fingerprint + shapes/dtypes + mesh/sharding +
+    donation + jax version + backend/device kind), and the serialized
+    executable (`jax.experimental.serialize_executable`) is stored under
+    that key (store.py: atomic tmp→rename writes, CRC-gated reads, LRU
+    byte cap).  A later process with the same key deserializes in
+    milliseconds — no trace, no lower, no backend compile.
+  * **XLA layer**: enabling the store also points jax's own persistent
+    compilation cache at `<root>/xla`, so programs that go through the
+    plain jit path (shapes we didn't pre-warm, helper programs) still
+    skip `backend_compile` on a second process.
+
+Gating: set env `BIGDL_TPU_COMPILE_CACHE=/path/to/dir` (or call
+`set_cache_dir(path)`).  Unset / "0" / "off" disables both layers —
+the default, so behaviour without the env var is byte-identical to the
+pre-cache code.  The loaded executable runs the same XLA program the
+compiler would produce, so outputs are bitwise-equal cache-on vs
+cache-off (tests/test_compilecache.py locks this under strict_transfers).
+
+Observability: hits/misses/corruption land in the obs MetricsRegistry
+(`compile/cache_hits`, `compile/cache_misses`, `compile/cache_load_ms`,
+`compile/cache_corrupt`, `compile/cache_errors`), loads emit
+`compile.cache_load` trace spans, and the CompileMonitor is told about
+loads (`note_cache_load`) so a deserialized executable after restart is
+never mistaken for a steady-state recompile.
+
+Failure policy: every cache error degrades to the plain jit/compile
+path with a warning — a broken cache dir can slow a start, never fail it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import threading
+import time
+from contextlib import nullcontext
+from typing import Any, Dict, Optional, Tuple
+
+from bigdl_tpu import obs as _obs
+from bigdl_tpu.compilecache.keys import (STORE_VERSION, device_fingerprint,
+                                         executable_key, jax_version,
+                                         mesh_descriptor)
+from bigdl_tpu.compilecache.store import ExecutableStore
+
+logger = logging.getLogger("bigdl_tpu.compilecache")
+
+ENV_VAR = "BIGDL_TPU_COMPILE_CACHE"
+_OFF_VALUES = ("", "0", "off", "none", "false")
+
+_UNSET = object()
+_lock = threading.Lock()
+_override: Any = _UNSET          # set_cache_dir() beats the env var
+_store: Optional[ExecutableStore] = None
+_store_root: Optional[str] = None
+_xla_layer_root: Optional[str] = None
+
+
+# -- gating ----------------------------------------------------------------
+
+
+def cache_dir() -> Optional[str]:
+    """Active cache root, or None when the cache is disabled."""
+    if _override is not _UNSET:
+        return _override
+    val = os.environ.get(ENV_VAR, "").strip()
+    if val.lower() in _OFF_VALUES:
+        return None
+    return val
+
+
+def enabled() -> bool:
+    return cache_dir() is not None
+
+
+def set_cache_dir(path: Optional[str]) -> None:
+    """Programmatic override: a path enables the cache there, None
+    disables it (both win over the env var; `reset()` reverts to env)."""
+    global _override
+    with _lock:
+        _override = path if path is None else str(path)
+    _sync_layers()
+
+
+def reset() -> None:
+    """Back to env-driven gating; drops the store singleton."""
+    global _override
+    with _lock:
+        _override = _UNSET
+    _sync_layers()
+
+
+# -- layers ----------------------------------------------------------------
+
+
+def _configure_xla_layer(root: Optional[str]) -> None:
+    """Point jax's persistent compilation cache at `<root>/xla` (None
+    detaches it).  Thresholds drop to zero so even the tiny CPU-proxy
+    programs in tests/benchmarks persist."""
+    global _xla_layer_root
+    if root == _xla_layer_root:
+        return
+    import jax
+    try:
+        if root is None:
+            jax.config.update("jax_compilation_cache_dir", None)
+        else:
+            xdir = os.path.join(root, "xla")
+            os.makedirs(xdir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", xdir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        _xla_layer_root = root
+    except Exception as e:  # pragma: no cover - config name drift
+        logger.warning("compilecache: could not configure jax persistent "
+                       "compilation cache (%s); AOT layer still active", e)
+
+
+def _sync_layers() -> None:
+    global _store, _store_root
+    root = cache_dir()
+    with _lock:
+        if root is None:
+            _store = None
+            _store_root = None
+        elif _store is None or _store_root != root:
+            _store = ExecutableStore(root)
+            _store_root = root
+    _configure_xla_layer(root)
+
+
+def store() -> Optional[ExecutableStore]:
+    """The active ExecutableStore (None when disabled); creating it also
+    attaches jax's own persistent compilation cache under the same root."""
+    if cache_dir() != _store_root or (_store is None) != (cache_dir() is None):
+        _sync_layers()
+    return _store
+
+
+# -- the AOT fast path ------------------------------------------------------
+
+
+def load_or_compile(jit_fn, args: Tuple[Any, ...], *,
+                    signature: Optional[str] = None,
+                    extra_key: Optional[Dict[str, Any]] = None):
+    """Executable for `jit_fn(*args)` via the store.
+
+    Returns `(callable, status)`:
+
+      * status "off"   — cache disabled; `callable` IS `jit_fn` untouched.
+      * status "hit"   — deserialized executable from disk (no compile).
+      * status "miss"  — compiled AOT now, serialized into the store.
+      * status "error" — lowering/packing failed; plain `jit_fn` returned.
+
+    The returned callable takes the exact same positional args.  All
+    cache failures degrade to a real compile — never to a raised error.
+    """
+    st = store()
+    if st is None:
+        return jit_fn, "off"
+    reg = _obs.registry()
+    mon = _obs.compile_monitor()
+    sig = signature or "unattributed"
+    try:
+        lowered = jit_fn.lower(*args)
+        extra = dict(extra_key) if extra_key else {}
+        key = executable_key(lowered, extra=extra or None)
+    except Exception as e:
+        logger.warning("compilecache: lowering failed under %r (%s); "
+                       "falling back to the jit path", sig, e)
+        reg.inc("compile/cache_errors")
+        return jit_fn, "error"
+
+    had_entry = st.has(key)
+    blob = st.get(key)
+    if blob is None and had_entry:
+        reg.inc("compile/cache_corrupt")  # store dropped a damaged entry
+    if blob is not None:
+        t0 = time.perf_counter()
+        try:
+            from jax.experimental import serialize_executable as _se
+            with _obs.span("compile.cache_load", cat="compile",
+                           signature=sig, key=key[:12]):
+                payload, in_tree, out_tree = pickle.loads(blob)
+                load_scope = (mon.cache_load(sig) if mon is not None
+                              else nullcontext())
+                with load_scope:
+                    compiled = _se.deserialize_and_load(payload, in_tree,
+                                                        out_tree)
+            dt = time.perf_counter() - t0
+            reg.inc("compile/cache_hits")
+            reg.set_gauge("compile/cache_load_ms", dt * 1e3)
+            if mon is not None:
+                mon.note_cache_load(sig, dt)
+            logger.info("compilecache: %s loaded from cache in %.1f ms "
+                        "(key %s)", sig, dt * 1e3, key[:12])
+            return compiled, "hit"
+        except Exception as e:
+            logger.warning("compilecache: entry %s for %r failed to "
+                           "deserialize (%s); dropping it and recompiling",
+                           key[:12], sig, e)
+            st.remove(key)
+            reg.inc("compile/cache_corrupt")
+
+    # Miss: compile ahead-of-time under attribution, then persist.
+    attr = mon.attribute(sig) if mon is not None else nullcontext()
+    with attr:
+        compiled = lowered.compile()
+    reg.inc("compile/cache_misses")
+    try:
+        from jax.experimental import serialize_executable as _se
+        payload, in_tree, out_tree = _se.serialize(compiled)
+        blob = pickle.dumps((payload, in_tree, out_tree),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        st.put(key, blob, meta={
+            "v": STORE_VERSION,
+            "jax": jax_version(),
+            "signature": sig,
+            "extra": extra_key,
+            **device_fingerprint(),
+        })
+        logger.info("compilecache: %s compiled and stored (key %s, %d bytes)",
+                    sig, key[:12], len(blob))
+    except Exception as e:
+        logger.warning("compilecache: could not serialize executable for %r "
+                       "(%s); it will recompile on next cold start", sig, e)
+        reg.inc("compile/cache_errors")
+    return compiled, "miss"
+
+
+def stats() -> Dict[str, float]:
+    """Cache counters from the active obs registry (all zero when off)."""
+    reg = _obs.registry()
+    return {
+        "hits": reg.get("compile/cache_hits"),
+        "misses": reg.get("compile/cache_misses"),
+        "corrupt": reg.get("compile/cache_corrupt"),
+        "errors": reg.get("compile/cache_errors"),
+        "load_ms": reg.get("compile/cache_load_ms"),
+    }
+
+
+__all__ = [
+    "ENV_VAR", "STORE_VERSION", "ExecutableStore", "cache_dir", "enabled",
+    "executable_key", "device_fingerprint", "jax_version", "load_or_compile",
+    "mesh_descriptor", "reset", "set_cache_dir", "stats", "store",
+]

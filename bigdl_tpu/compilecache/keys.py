@@ -1,0 +1,76 @@
+"""Content-addressed executable keys.
+
+A cache entry may only be reused when the executable it holds is the one
+XLA would have produced right now.  Everything that feeds the compiler is
+therefore folded into one digest:
+
+  * the lowered StableHLO module text — this carries the jaxpr structure,
+    every static shape/dtype, the donation map (input/output aliasing
+    attributes) and the sharding annotations (`mhlo.sharding` +
+    `mhlo.num_partitions`) exactly as the compiler will see them;
+  * the jax version (a jax upgrade may lower the same program
+    differently, and the serialized-executable format is not stable
+    across versions);
+  * the backend platform, device kind, device count and process count
+    (an executable compiled for 8 virtual CPU devices must never load
+    onto a 1-device process, and a TPU v4 binary never onto v5e);
+  * a store schema version (bump to invalidate every existing entry);
+  * an optional caller-supplied `extra` dict (mesh axis layout, donation
+    argnums, consumer kind) for facts the HLO text alone may not pin.
+
+Wrong-topology or stale entries are thus rejected BY KEY — they simply
+hash elsewhere — rather than by a load-time compatibility check that
+would have to enumerate every way two programs can differ.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, Optional
+
+import jax
+
+# Bump to invalidate every entry written by older code (schema change in
+# the pickled payload, new key ingredient, serialization format fix...).
+STORE_VERSION = 1
+
+
+def jax_version() -> str:
+    """The running jax version (separate function so tests can stub a
+    'different jax' and assert the key rejects the old entry)."""
+    return jax.__version__
+
+
+def device_fingerprint() -> Dict[str, Any]:
+    """Backend identity: platform, device kind, topology width."""
+    devs = jax.devices()
+    return {
+        "backend": devs[0].platform,
+        "device_kind": getattr(devs[0], "device_kind", "unknown"),
+        "n_devices": len(devs),
+        "process_count": jax.process_count(),
+    }
+
+
+def mesh_descriptor(mesh) -> Optional[Dict[str, int]]:
+    """Stable description of a jax.sharding.Mesh (None stays None)."""
+    if mesh is None:
+        return None
+    return {str(name): int(size)
+            for name, size in zip(mesh.axis_names, mesh.devices.shape)}
+
+
+def executable_key(lowered, extra: Optional[Dict[str, Any]] = None) -> str:
+    """Digest of a `jax.stages.Lowered` + environment (hex sha256)."""
+    hlo = hashlib.sha256(lowered.as_text().encode("utf-8")).hexdigest()
+    payload: Dict[str, Any] = {
+        "v": STORE_VERSION,
+        "jax": jax_version(),
+        "hlo": hlo,
+        **device_fingerprint(),
+    }
+    if extra:
+        payload["extra"] = extra
+    blob = json.dumps(payload, sort_keys=True, default=repr)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()

@@ -1,0 +1,195 @@
+"""On-disk executable store: atomic writes, CRC-gated reads, LRU cap.
+
+Layout (one entry = one payload + one commit marker):
+
+    <root>/
+      aot/
+        <key>.bin    # pickled (serialized_executable, in_tree, out_tree)
+        <key>.json   # commit marker: size, crc32, key ingredients, ctime
+      xla/           # jax's own persistent compilation cache (2nd layer)
+
+Write discipline mirrors `resilience.async_ckpt.AsyncCheckpointer`:
+payload is staged to `tmp.<key>.<pid>`, fsynced, renamed into place, and
+the meta json lands LAST (same stage→fsync→rename) — an entry without
+its `.json` is an aborted write and is invisible to readers.  Rename is
+atomic on POSIX, so a reader never observes a half-written payload and
+concurrent writers of the same key simply race to an identical result.
+
+Reads verify size + crc32 against the meta before the payload is
+trusted; any mismatch (truncation, bitflip, stray partial file) deletes
+the entry and reports a miss so the caller falls back to a real compile.
+
+Eviction is LRU by mtime with a byte cap (`BIGDL_TPU_COMPILE_CACHE_MAX_MB`,
+default 512): hits re-touch the payload, and after every put the oldest
+entries are dropped until the cache fits.  Corrupt-meta entries sort
+first so damage is reclaimed before healthy executables.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("bigdl_tpu.compilecache")
+
+_DEFAULT_MAX_MB = 512.0
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:  # pragma: no cover - platform quirk, best effort
+        pass
+
+
+class ExecutableStore:
+    """Filesystem-backed byte store for serialized executables."""
+
+    def __init__(self, root: str, max_bytes: Optional[int] = None):
+        self.root = os.path.abspath(root)
+        self.aot_dir = os.path.join(self.root, "aot")
+        os.makedirs(self.aot_dir, exist_ok=True)
+        if max_bytes is None:
+            mb = float(os.environ.get("BIGDL_TPU_COMPILE_CACHE_MAX_MB",
+                                      str(_DEFAULT_MAX_MB)) or _DEFAULT_MAX_MB)
+            max_bytes = int(mb * 1024 * 1024)
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+
+    # -- paths -------------------------------------------------------------
+
+    def _bin(self, key: str) -> str:
+        return os.path.join(self.aot_dir, f"{key}.bin")
+
+    def _meta(self, key: str) -> str:
+        return os.path.join(self.aot_dir, f"{key}.json")
+
+    # -- read --------------------------------------------------------------
+
+    def get(self, key: str) -> Optional[bytes]:
+        """Payload bytes for `key`, or None on miss/corruption.
+
+        A corrupt entry (missing meta, size or crc32 mismatch, unreadable
+        payload) is deleted on sight and reported as a miss — the caller
+        recompiles and the next `put` rewrites a healthy entry.
+        """
+        bin_path, meta_path = self._bin(key), self._meta(key)
+        try:
+            with open(meta_path, "r", encoding="utf-8") as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            if os.path.exists(bin_path):
+                # payload without a commit marker: aborted write
+                self.remove(key)
+            return None
+        try:
+            with open(bin_path, "rb") as f:
+                payload = f.read()
+        except OSError:
+            self.remove(key)
+            return None
+        if (len(payload) != int(meta.get("size", -1))
+                or (zlib.crc32(payload) & 0xFFFFFFFF) != int(meta.get("crc32", -1))):
+            logger.warning("compilecache: corrupt entry %s (size/crc mismatch); "
+                           "dropping and recompiling", key[:12])
+            self.remove(key)
+            return None
+        try:
+            now = time.time()
+            os.utime(bin_path, (now, now))  # LRU touch
+        except OSError:  # pragma: no cover
+            pass
+        return payload
+
+    def has(self, key: str) -> bool:
+        return os.path.exists(self._meta(key)) and os.path.exists(self._bin(key))
+
+    # -- write -------------------------------------------------------------
+
+    def put(self, key: str, payload: bytes,
+            meta: Optional[Dict[str, Any]] = None) -> str:
+        """Atomically commit `payload` under `key`; returns the bin path."""
+        bin_path, meta_path = self._bin(key), self._meta(key)
+        record = dict(meta or {})
+        record.update({
+            "size": len(payload),
+            "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+            "created_at": time.time(),
+        })
+        pid = os.getpid()
+        tmp_bin = os.path.join(self.aot_dir, f"tmp.{key}.{pid}.bin")
+        tmp_meta = os.path.join(self.aot_dir, f"tmp.{key}.{pid}.json")
+        with self._lock:
+            with open(tmp_bin, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp_bin, bin_path)
+            with open(tmp_meta, "w", encoding="utf-8") as f:
+                json.dump(record, f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp_meta, meta_path)  # commit marker lands last
+            _fsync_dir(self.aot_dir)
+        self.evict_to_cap()
+        return bin_path
+
+    def remove(self, key: str) -> None:
+        for p in (self._meta(key), self._bin(key)):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def entries(self) -> List[Tuple[str, int, float]]:
+        """[(key, total_bytes, payload_mtime)] for committed entries."""
+        out: List[Tuple[str, int, float]] = []
+        try:
+            names = os.listdir(self.aot_dir)
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(".bin") or name.startswith("tmp."):
+                continue
+            key = name[:-len(".bin")]
+            bin_path, meta_path = self._bin(key), self._meta(key)
+            if not os.path.exists(meta_path):
+                continue
+            try:
+                st = os.stat(bin_path)
+                size = st.st_size + os.stat(meta_path).st_size
+                out.append((key, size, st.st_mtime))
+            except OSError:
+                continue
+        return out
+
+    def total_bytes(self) -> int:
+        return sum(size for _, size, _ in self.entries())
+
+    def evict_to_cap(self) -> int:
+        """Drop least-recently-used entries until under the byte cap."""
+        if self.max_bytes <= 0:
+            return 0
+        entries = sorted(self.entries(), key=lambda e: e[2])  # oldest first
+        total = sum(size for _, size, _ in entries)
+        evicted = 0
+        while entries and total > self.max_bytes:
+            key, size, _ = entries.pop(0)
+            self.remove(key)
+            total -= size
+            evicted += 1
+        if evicted:
+            logger.info("compilecache: evicted %d LRU entr%s (cap %d bytes)",
+                        evicted, "y" if evicted == 1 else "ies", self.max_bytes)
+        return evicted

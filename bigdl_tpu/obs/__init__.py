@@ -35,6 +35,7 @@ from typing import Any, Dict, Optional
 
 from bigdl_tpu.obs.compile_monitor import (  # noqa: F401
     BACKEND_COMPILE_EVENT,
+    PERSISTENT_CACHE_HIT_EVENT,
     CompileMonitor,
     install_monitor,
 )
@@ -182,7 +183,8 @@ def device_profile(logdir: str):
 _init_from_env()
 
 __all__ = [
-    "BACKEND_COMPILE_EVENT", "CompileMonitor", "MetricsRegistry",
+    "BACKEND_COMPILE_EVENT", "PERSISTENT_CACHE_HIT_EVENT",
+    "CompileMonitor", "MetricsRegistry",
     "NullRegistry", "SpanTracer", "attribute", "compile_monitor",
     "device_profile", "export_trace", "install_monitor", "instant",
     "next_cid", "observability", "registry", "set_observability",

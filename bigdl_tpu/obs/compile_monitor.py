@@ -40,6 +40,10 @@ from typing import Any, Callable, Dict, Optional
 logger = logging.getLogger("bigdl_tpu.obs")
 
 BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+# jax's persistent compilation cache fires this (plain event, no duration)
+# INSTEAD of BACKEND_COMPILE_EVENT on a disk hit — backend_compile is
+# skipped entirely, so a warm second process compiles nothing.
+PERSISTENT_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
 UNATTRIBUTED = "unattributed"
 
 _listener_lock = threading.Lock()
@@ -53,6 +57,12 @@ def _forward(event: str, duration: float, **kwargs) -> None:
         mon.on_compile(duration)
 
 
+def _forward_event(event: str, **kwargs) -> None:
+    mon = _active_monitor
+    if mon is not None and event == PERSISTENT_CACHE_HIT_EVENT:
+        mon.on_persistent_cache_hit()
+
+
 def install_monitor(monitor: Optional["CompileMonitor"]) -> None:
     """Make `monitor` the target of the process-global jax.monitoring
     listener (None detaches).  The listener itself is registered once,
@@ -63,6 +73,7 @@ def install_monitor(monitor: Optional["CompileMonitor"]) -> None:
         if monitor is not None and not _listener_installed:
             from jax import monitoring as _jm
             _jm.register_event_duration_secs_listener(_forward)
+            _jm.register_event_listener(_forward_event)
             _listener_installed = True
 
 
@@ -84,6 +95,34 @@ class _Scope:
 
     def __exit__(self, exc_type, exc, tb):
         self._mon._exit_scope(self._sig, self._compiles_at_entry)
+        return False
+
+
+class _LoadScope:
+    """Attribution scope + thread-local in-cache-load flag: compiles that
+    fire while a serialized executable is being deserialized are warmup
+    by definition (restart recovery), never steady-state recompiles.
+    Unlike `_Scope`, entering/leaving takes NO part in settling — a load
+    proves nothing about the signature's executable set being closed."""
+
+    __slots__ = ("_mon", "_sig")
+
+    def __init__(self, mon: "CompileMonitor", sig: str):
+        self._mon = mon
+        self._sig = sig
+
+    def __enter__(self):
+        self._mon._stack().append(self._sig)
+        tls = self._mon._tls
+        tls.in_cache_load = getattr(tls, "in_cache_load", 0) + 1
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        tls = self._mon._tls
+        tls.in_cache_load = max(0, getattr(tls, "in_cache_load", 1) - 1)
+        st = self._mon._stack()
+        if st and st[-1] == self._sig:
+            st.pop()
         return False
 
 
@@ -140,16 +179,57 @@ class CompileMonitor:
                 if sig.startswith(prefix):
                     rec["settled"] = True
 
+    # -- executable-cache awareness ----------------------------------------
+
+    def cache_load(self, signature: str):
+        """Scope for deserializing a cached executable: attributes any
+        stray compile inside to `signature` AND classifies it as warmup —
+        loading a stored executable after restart is the *opposite* of a
+        steady-state recompile, even if the signature already settled."""
+        return _LoadScope(self, signature)
+
+    def note_cache_load(self, signature: str, duration_s: float = 0.0) -> None:
+        """Record one deserialized-executable load (NOT a compile)."""
+        with self._lock:
+            rec = self._rec(signature)
+            rec["cache_loads"] += 1
+            rec["load_secs"] += duration_s
+
+    def on_persistent_cache_hit(self) -> None:
+        """jax's persistent compilation cache served a disk hit: the jit
+        path warmed without a backend compile.  Counted as a cache load
+        for the current scope so warm restarts are visible, never as a
+        compile/recompile."""
+        st = getattr(self._tls, "stack", None)
+        sig = st[-1] if st else UNATTRIBUTED
+        with self._lock:
+            rec = self._rec(sig)
+            rec["cache_loads"] += 1
+        reg = self._registry_fn() if self._registry_fn else None
+        if reg is not None:
+            reg.inc("compile/persistent_cache_hits")
+
+    def _rec(self, sig: str) -> Dict[str, Any]:
+        rec = self._sigs.get(sig)
+        if rec is None:
+            rec = self._sigs[sig] = {
+                "compiles": 0, "recompiles": 0, "secs": 0.0,
+                "settled": False, "cache_loads": 0, "load_secs": 0.0}
+        else:
+            # records written by pre-cache code paths lack the load keys
+            rec.setdefault("cache_loads", 0)
+            rec.setdefault("load_secs", 0.0)
+        return rec
+
     # -- listener target ---------------------------------------------------
 
     def on_compile(self, duration_s: float) -> None:
         st = getattr(self._tls, "stack", None)
         sig = st[-1] if st else UNATTRIBUTED
+        in_load = bool(getattr(self._tls, "in_cache_load", 0))
         with self._lock:
-            rec = self._sigs.setdefault(
-                sig, {"compiles": 0, "recompiles": 0, "secs": 0.0,
-                      "settled": False})
-            steady = rec["settled"]
+            rec = self._rec(sig)
+            steady = rec["settled"] and not in_load
             rec["compiles"] += 1
             rec["secs"] += duration_s
             if steady:
@@ -190,4 +270,17 @@ class CompileMonitor:
     def recompiles(self, prefix: str = "") -> int:
         with self._lock:
             return sum(r["recompiles"] for sig, r in self._sigs.items()
+                       if sig.startswith(prefix))
+
+    def compile_secs(self, prefix: str = "") -> float:
+        """Total backend-compile seconds under `prefix` — the pre-first-
+        step cost a warm executable cache is supposed to eliminate."""
+        with self._lock:
+            return sum(r["secs"] for sig, r in self._sigs.items()
+                       if sig.startswith(prefix))
+
+    def cache_loads(self, prefix: str = "") -> int:
+        with self._lock:
+            return sum(r.get("cache_loads", 0)
+                       for sig, r in self._sigs.items()
                        if sig.startswith(prefix))

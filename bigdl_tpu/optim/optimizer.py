@@ -323,6 +323,11 @@ class Optimizer:
         self.metrics = Metrics()
         self._compiled = None
         self._compiled_key = None
+        # AOT executables resolved through bigdl_tpu.compilecache (None
+        # when the cache is off: dispatch then calls the plain jit fn)
+        self._aot_steps: Dict[Any, Any] = {}
+        self._aot_eval = None
+        self._aot_eval_key = None
         self._driver_state: Dict[str, Any] = {"epoch": 0, "neval": 0, "loss": None,
                                               "score": None, "epoch_finished": False,
                                               "epoch_batch": 0}
@@ -619,6 +624,53 @@ class Optimizer:
         self._compiled_key = key
         return self._compiled
 
+    def _resolve_step_call(self, step_fn, args, bs: int):
+        """The callable dispatch actually invokes for the train step.
+
+        With the executable cache off (the default) this IS `step_fn`.
+        With `BIGDL_TPU_COMPILE_CACHE` set, the step is lowered once,
+        content-hashed, and served from the on-disk AOT store — so a
+        restarted process (preemption resume, watchdog rollback, fresh
+        driver) reaches its first step on a deserialize instead of a
+        full XLA compile.  Resolved at FIRST dispatch (concrete args are
+        needed to lower) and instance-cached alongside `_compiled_key`;
+        any cache failure falls back to the plain jit path.
+        """
+        from bigdl_tpu import compilecache as _cc
+        if not _cc.enabled():
+            return step_fn
+        key = (self._compiled_key, bs, len(args))
+        fn = self._aot_steps.get(key)
+        if fn is not None:
+            return fn
+        fn, status = _cc.load_or_compile(
+            step_fn, args, signature=f"train/step/bs={bs}",
+            extra_key={"kind": "train", "donate": [0, 1, 2],
+                       "mesh": _cc.mesh_descriptor(self.mesh)})
+        if status == "error":
+            fn = step_fn
+        self._aot_steps[key] = fn
+        return fn
+
+    def _resolve_eval_call(self, args):
+        """Same contract as `_resolve_step_call`, for the eval step."""
+        from bigdl_tpu import compilecache as _cc
+        if not _cc.enabled():
+            return self._compiled_eval
+        key = (self._compiled_eval_key, tuple(
+            (tuple(l.shape), str(l.dtype))
+            for l in jax.tree_util.tree_leaves(args[2:])))
+        if self._aot_eval is not None and self._aot_eval_key == key:
+            return self._aot_eval
+        fn, status = _cc.load_or_compile(
+            self._compiled_eval, args, signature="eval/step",
+            extra_key={"kind": "eval",
+                       "mesh": _cc.mesh_descriptor(self.mesh)})
+        if status == "error":
+            fn = self._compiled_eval
+        self._aot_eval, self._aot_eval_key = fn, key
+        return fn
+
     def _build_step_uncached(self):
         if self._pipeline_axis() is not None:
             return self._build_pipeline_step()
@@ -895,6 +947,10 @@ class Optimizer:
             self.model_state = jax.device_put(self.model_state)
         if self.opt_state is not None:
             self.opt_state = jax.device_put(self.opt_state)
+        # the restored trees are freshly committed: drop any AOT step
+        # resolved against the pre-restore arrays so the next dispatch
+        # re-lowers with the new shardings (a disk hit when unchanged)
+        self._aot_steps.clear()
         driver = dict(driver)
         seed = driver.pop("rng_seed", None)
         if seed is not None and int(seed) != RandomGenerator.get_seed():
@@ -969,7 +1025,17 @@ class Optimizer:
     def _optimize_impl(self):
         state = self._driver_state
         state.setdefault("epoch_batch", 0)
+        from bigdl_tpu import compilecache as _cc
+        if _cc.enabled():
+            # attach the XLA persistent-cache layer before the FIRST
+            # compile of this run, so helper programs (rng fold-in,
+            # telemetry ring writes) persist across restarts too
+            _cc.store()
         step_fn = None
+        # AOT-resolved at first dispatch (compilecache); re-resolved when
+        # the batch size changes (ragged final batch = its own executable)
+        step_call = None
+        step_call_bs = None
         # the step-rng root is a NAMED stream, not next_key(): a resumed
         # process (fresh key counter) must derive the same per-step rng
         # (fold_in(root, neval)) as the uninterrupted run for losses to
@@ -1195,6 +1261,8 @@ class Optimizer:
                     if self.params is None or step_fn is None:
                         self._init_model(batch)
                         step_fn = self._build_step()
+                        step_call = None
+                        step_call_bs = None
                     bs = batch.size()
                     x, y = item.payload
                     # strict_transfers is a no-op unless enabled: any
@@ -1240,21 +1308,29 @@ class Optimizer:
                             if pdev is None:
                                 pdev = poison_cache.setdefault(
                                     code, _put_scalar(code))
+                            step_args = (self.params, self.model_state,
+                                         self.opt_state, x, y, rng, lr,
+                                         scale_cache[1], pdev)
+                            if step_call is None or step_call_bs != bs:
+                                step_call = self._resolve_step_call(
+                                    step_fn, step_args, bs)
+                                step_call_bs = bs
                             (self.params, self.model_state, self.opt_state,
-                             loss, lr_used, health) = step_fn(
-                                self.params, self.model_state,
-                                self.opt_state, x, y, rng, lr,
-                                scale_cache[1], pdev)
+                             loss, lr_used, health) = step_call(*step_args)
                             state["neval"] += 1
                             state["epoch_batch"] += 1
                             slot = (state["neval"] - 1) % ring_cap
                             ring = _ring_write_h(ring, _put_scalar(slot),
                                                  loss, lr_used, health)
                         else:
+                            step_args = (self.params, self.model_state,
+                                         self.opt_state, x, y, rng, lr)
+                            if step_call is None or step_call_bs != bs:
+                                step_call = self._resolve_step_call(
+                                    step_fn, step_args, bs)
+                                step_call_bs = bs
                             (self.params, self.model_state, self.opt_state,
-                             loss, lr_used) = step_fn(
-                                self.params, self.model_state,
-                                self.opt_state, x, y, rng, lr)
+                             loss, lr_used) = step_call(*step_args)
                             state["neval"] += 1
                             state["epoch_batch"] += 1
                             slot = (state["neval"] - 1) % ring_cap
@@ -1438,9 +1514,18 @@ class Optimizer:
                 _obs.span("validate", cat="trainer"), \
                 _obs.attribute("eval/step"), \
                 strict_transfers(strict):
+            eval_call = None
+            eval_shape = None
             for item in feed:
                 x, y = item.payload
-                outs = self._compiled_eval(self.params, self.model_state, x, y)
+                eval_args = (self.params, self.model_state, x, y)
+                sh = tuple(l.shape
+                           for l in jax.tree_util.tree_leaves((x, y)))
+                if eval_call is None or eval_shape != sh:
+                    # ragged final batch resolves its own executable
+                    eval_call = self._resolve_eval_call(eval_args)
+                    eval_shape = sh
+                outs = eval_call(*eval_args)
                 if totals_v is None:
                     totals_v = [v for v, _ in outs]
                     totals_c = [c for _, c in outs]

@@ -110,6 +110,14 @@ class ServingRuntime:
 
         self._fwd = jax.jit(fwd)
         self._shapes = set()  # distinct padded input shapes ever dispatched
+        # warmed executables keyed by padded-input shape signature: the
+        # jit fn when the compile cache is off, an AOT-loaded executable
+        # when it's on.  `_warmed_psig` pins the param/state tree shapes
+        # the entries were warmed for — a params-only swap (same shapes)
+        # reuses them outright instead of re-lowering every bucket.
+        self._warmed: dict = {}
+        self._warmed_psig = None
+        self._psig_cache: dict = {}  # (version, registered_at) -> tree sig
 
         self.registry = ModelRegistry(warmup=self._warmup)
         self.registry.register(version, params, state if state is not None else {})
@@ -127,32 +135,97 @@ class ServingRuntime:
 
     # -- warmup / compile probe -------------------------------------------
 
-    def _record_shape(self, x: Any) -> None:
+    @staticmethod
+    def _shape_key(x: Any) -> tuple:
         leaves = jax.tree_util.tree_leaves(x)
-        self._shapes.add(tuple(tuple(np.shape(l)) for l in leaves))
+        return tuple(tuple(np.shape(l)) for l in leaves)
+
+    @staticmethod
+    def _tree_sig(tree: Any) -> tuple:
+        """Shape+dtype signature of a params/state tree: two versions with
+        the same signature share every compiled executable (the jit cache —
+        and the AOT store — key on avals, never on values)."""
+        return tuple((tuple(np.shape(l)), str(getattr(l, "dtype", type(l))))
+                     for l in jax.tree_util.tree_leaves(tree))
+
+    def _psig_of(self, snap: ModelVersion) -> tuple:
+        """`_tree_sig` of a registry snapshot, memoized per version (the
+        dispatch path pays one dict lookup, not a tree walk per batch)."""
+        key = (snap.version, snap.registered_at)
+        sig = self._psig_cache.get(key)
+        if sig is None:
+            sig = self._psig_cache[key] = self._tree_sig((snap.params,
+                                                          snap.state))
+            if len(self._psig_cache) > 16:
+                self._psig_cache.pop(next(iter(self._psig_cache)))
+        return sig
+
+    def _record_shape(self, x: Any) -> None:
+        self._shapes.add(self._shape_key(x))
 
     def _warmup(self, params: Any, state: Any) -> None:
-        """One forward per bucket shape (jit compile on first registration;
-        cache hits on same-shaped swaps) so no request ever eats a compile."""
+        """Warm every bucket shape BEFORE a version activates so no
+        request ever eats a compile.
+
+        Three tiers, cheapest first:
+          * params-only swap (identical param/state + bucket signatures):
+            every live executable is reused outright — no re-trace, no
+            forward, just a counter bump per bucket.
+          * compile cache ON (`BIGDL_TPU_COMPILE_CACHE`): each bucket
+            resolves through `compilecache.load_or_compile` — a restarted
+            server deserializes its executables from disk instead of
+            recompiling them.
+          * compile cache OFF: original behaviour, one jitted forward per
+            bucket (compile on first registration, jit-cache hits after).
+        """
+        from bigdl_tpu import compilecache as _cc
         if self._example is None:
             return
+        psig = self._tree_sig((params, state))
+        if psig != self._warmed_psig:
+            # shape-drifted version: every warmed executable is stale
+            self._warmed.clear()
+        use_cache = _cc.enabled()
+        reg = _obs.registry()
         for bucket in self.config.buckets:
             xp = _pad_batch(self._example, bucket)
-            self._record_shape(xp)
+            isig = self._shape_key(xp)
+            self._shapes.add(isig)
+            if isig in self._warmed:
+                # identical function signature/buckets: reuse the live
+                # compiled executable — a params-only swap re-traces nothing
+                reg.inc("serving/warmup_reused")
+                _obs.instant("serve.warmup_reused", cat="serving",
+                             bucket=bucket)
+                continue
             with _obs.attribute(f"serving/bucket={bucket}"), \
                     _obs.span("serve.warmup", cat="serving", bucket=bucket):
-                y = self._fwd(params, state, self._to_device(xp))
-                jax.tree_util.tree_map(
-                    lambda l: getattr(l, "block_until_ready", lambda: l)(), y)
+                xd = self._to_device(xp)
+                if use_cache:
+                    fn, status = _cc.load_or_compile(
+                        self._fwd, (params, state, xd),
+                        signature=f"serving/bucket={bucket}",
+                        extra_key={"kind": "serving", "bucket": bucket})
+                    self._warmed[isig] = fn if status != "error" else self._fwd
+                else:
+                    y = self._fwd(params, state, xd)
+                    jax.tree_util.tree_map(
+                        lambda l: getattr(l, "block_until_ready",
+                                          lambda: l)(), y)
+                    self._warmed[isig] = self._fwd
+        self._warmed_psig = psig
 
     def compile_count(self) -> int:
         """Distinct compiled forward shapes.  The jit cache size is the
-        ground truth when the runtime exposes it; the dispatched-shape set
-        is the structural fallback (identical whenever padding is sound)."""
+        ground truth when the runtime exposes it (plus the AOT-loaded
+        executables, which live outside the jit cache); the dispatched-
+        shape set is the structural fallback (identical whenever padding
+        is sound)."""
+        aot = sum(1 for fn in self._warmed.values() if fn is not self._fwd)
         try:
             n = self._fwd._cache_size()  # pjit probe (jax >= 0.4)
             if n is not None:
-                return int(n)
+                return int(n) + aot
         except Exception:
             pass
         return len(self._shapes)
@@ -178,7 +251,14 @@ class ServingRuntime:
         rows = sum(r.rows for r in requests)
         x = _concat_rows([r.x for r in requests])
         xp = _pad_batch(x, bucket) if rows < bucket else x
-        self._record_shape(xp)
+        isig = self._shape_key(xp)
+        self._shapes.add(isig)
+        # warmed executable for this shape (AOT-loaded when the compile
+        # cache is on, the jit fn otherwise); the psig check keeps a
+        # shape-drifted snapshot off executables warmed for another tree
+        fwd = self._fwd
+        if self._warmed and self._warmed_psig == self._psig_of(snap):
+            fwd = self._warmed.get(isig, self._fwd)
         with (tr.span("serve.dispatch", cat="serving", bucket=bucket,
                       rows=rows, cids=[r.cid for r in requests])
               if tr is not None else _NULL), \
@@ -186,7 +266,7 @@ class ServingRuntime:
                  if mon is not None else _NULL):
             with strict_transfers(strict_transfers_enabled(
                     self.config.strict_transfers)):
-                y = self._fwd(snap.params, snap.state, self._to_device(xp))
+                y = fwd(snap.params, snap.state, self._to_device(xp))
             y = jax.device_get(y)  # ONE host sync per batch, post-dispatch
         t_done = time.perf_counter()
         self.metrics.on_batch(bucket, rows, (t_done - t_dispatch) * 1e3)

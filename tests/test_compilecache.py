@@ -1,0 +1,452 @@
+"""Persistent executable store (compilecache): keys, store, parity, resume.
+
+The contract under test: with `BIGDL_TPU_COMPILE_CACHE` set, every restart
+path loads serialized executables instead of recompiling — and the loaded
+executable is bitwise-indistinguishable from a fresh compile.  Wrong-world
+entries (different shapes, mesh, jax version) must be rejected BY KEY,
+corrupt entries must self-heal into a plain compile, and a deserialized
+load must never be mistaken for a steady-state recompile.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import compilecache as cc
+from bigdl_tpu import obs, optim
+from bigdl_tpu.compilecache import keys as cc_keys
+from bigdl_tpu.compilecache.store import ExecutableStore
+from bigdl_tpu.core.random import RandomGenerator
+from bigdl_tpu.dataset import ArrayDataSet, Sample, SampleToMiniBatch
+from bigdl_tpu.obs.metrics import MetricsRegistry
+from bigdl_tpu.optim import SGD, Trigger
+from bigdl_tpu.resilience import ChaosStepFault, StepFaultInjector, committed_steps
+
+
+@pytest.fixture()
+def fresh_registry():
+    """Swap in a private MetricsRegistry so counter deltas are this test's."""
+    old = obs.set_registry(MetricsRegistry())
+    try:
+        yield obs.registry()
+    finally:
+        obs.set_registry(old)
+
+
+@pytest.fixture()
+def cache_root(tmp_path):
+    """Enable the executable cache in a throwaway dir; disable on exit."""
+    root = str(tmp_path / "cc")
+    cc.set_cache_dir(root)
+    try:
+        yield root
+    finally:
+        cc.reset()
+
+
+def lowered_for(shape, extra=None):
+    fn = jax.jit(lambda x: jnp.tanh(x) + 1.0)
+    return fn.lower(jnp.zeros(shape, jnp.float32)), extra
+
+
+# ----------------------------------------------------------------------
+# keys: stability where the world is the same, rejection where it isn't
+# ----------------------------------------------------------------------
+
+class TestKeys:
+    def test_key_deterministic_in_process(self):
+        l1, _ = lowered_for((4, 8))
+        l2, _ = lowered_for((4, 8))
+        e = {"kind": "t", "donate": [0]}
+        assert cc.executable_key(l1, extra=e) == cc.executable_key(l2, extra=e)
+
+    def test_key_stable_across_processes(self, tmp_path):
+        """The same program + environment hashes to the same key from a
+        fresh interpreter — the property that makes a restart warm at all."""
+        script = tmp_path / "keygen.py"
+        script.write_text(
+            "import os\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "flags = os.environ.get('XLA_FLAGS', '')\n"
+            "if 'xla_force_host_platform_device_count' not in flags:\n"
+            "    os.environ['XLA_FLAGS'] = (flags +"
+            " ' --xla_force_host_platform_device_count=8').strip()\n"
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "try:\n"
+            "    import jax.extend.backend as _jeb\n"
+            "    _jeb.clear_backends()\n"
+            "except Exception:\n"
+            "    import jax._src.xla_bridge as _xb\n"
+            "    _xb._clear_backends()\n"
+            "from bigdl_tpu.compilecache import executable_key\n"
+            "fn = jax.jit(lambda x: jnp.tanh(x) + 1.0)\n"
+            "lowered = fn.lower(jnp.zeros((4, 8), jnp.float32))\n"
+            "print('KEY', executable_key(lowered,"
+            " extra={'kind': 't', 'donate': [0]}))\n")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        proc = subprocess.run([sys.executable, str(script)], env=env,
+                              capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        child_key = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("KEY "):
+                child_key = line.split(" ", 1)[1].strip()
+        assert child_key, proc.stdout
+        lowered, _ = lowered_for((4, 8))
+        assert cc.executable_key(
+            lowered, extra={"kind": "t", "donate": [0]}) == child_key
+
+    def test_shape_change_changes_key(self):
+        l1, _ = lowered_for((4, 8))
+        l2, _ = lowered_for((8, 8))
+        assert cc.executable_key(l1) != cc.executable_key(l2)
+
+    def test_mesh_extra_changes_key(self):
+        lowered, _ = lowered_for((4, 8))
+        k1 = cc.executable_key(lowered, extra={"mesh": {"dp": 8}})
+        k2 = cc.executable_key(lowered, extra={"mesh": {"dp": 4}})
+        assert k1 != k2
+
+    def test_jax_version_changes_key(self, monkeypatch):
+        """An entry written by a different jax simply hashes elsewhere."""
+        lowered, _ = lowered_for((4, 8))
+        k_now = cc.executable_key(lowered)
+        monkeypatch.setattr(cc_keys, "jax_version", lambda: "999.0.0-other")
+        assert cc.executable_key(lowered) != k_now
+
+    def test_mesh_descriptor(self):
+        assert cc.mesh_descriptor(None) is None
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+        assert cc.mesh_descriptor(mesh) == {"dp": 8}
+
+
+# ----------------------------------------------------------------------
+# store: atomic commit, corruption self-healing, LRU cap
+# ----------------------------------------------------------------------
+
+class TestStore:
+    def test_roundtrip_and_no_stray_tmp(self, tmp_path):
+        st = ExecutableStore(str(tmp_path))
+        payload = os.urandom(512)
+        st.put("k" * 64, payload, meta={"signature": "t"})
+        assert st.has("k" * 64)
+        assert st.get("k" * 64) == payload
+        # atomic discipline: nothing staged survives a committed put
+        assert not [n for n in os.listdir(st.aot_dir)
+                    if n.startswith("tmp.")]
+
+    def test_truncated_payload_dropped(self, tmp_path):
+        st = ExecutableStore(str(tmp_path))
+        st.put("a" * 64, os.urandom(512))
+        with open(st._bin("a" * 64), "wb") as f:
+            f.write(b"short")
+        assert st.get("a" * 64) is None
+        assert not st.has("a" * 64)  # deleted on sight, next put reheals
+
+    def test_bitflip_dropped_by_crc(self, tmp_path):
+        st = ExecutableStore(str(tmp_path))
+        payload = os.urandom(512)
+        st.put("b" * 64, payload)
+        flipped = bytes([payload[0] ^ 0xFF]) + payload[1:]
+        with open(st._bin("b" * 64), "wb") as f:
+            f.write(flipped)  # same size, wrong crc
+        assert st.get("b" * 64) is None
+
+    def test_payload_without_marker_is_invisible(self, tmp_path):
+        st = ExecutableStore(str(tmp_path))
+        with open(st._bin("c" * 64), "wb") as f:
+            f.write(os.urandom(64))  # aborted write: no .json landed
+        assert not st.has("c" * 64)
+        assert st.get("c" * 64) is None
+        assert not os.path.exists(st._bin("c" * 64))
+
+    def test_lru_eviction_drops_oldest(self, tmp_path):
+        st = ExecutableStore(str(tmp_path), max_bytes=2600)
+        st.put("a" * 64, os.urandom(1000))
+        os.utime(st._bin("a" * 64), (1000.0, 1000.0))
+        st.put("b" * 64, os.urandom(1000))
+        os.utime(st._bin("b" * 64), (2000.0, 2000.0))
+        st.put("c" * 64, os.urandom(1000))  # over cap: oldest must go
+        assert not st.has("a" * 64)
+        assert st.has("b" * 64) and st.has("c" * 64)
+
+    def test_hit_refreshes_lru_position(self, tmp_path):
+        st = ExecutableStore(str(tmp_path), max_bytes=2600)
+        st.put("a" * 64, os.urandom(1000))
+        os.utime(st._bin("a" * 64), (1000.0, 1000.0))
+        st.put("b" * 64, os.urandom(1000))
+        os.utime(st._bin("b" * 64), (2000.0, 2000.0))
+        assert st.get("a" * 64) is not None  # touch: now newest
+        st.put("c" * 64, os.urandom(1000))
+        assert st.has("a" * 64)
+        assert not st.has("b" * 64)
+
+
+# ----------------------------------------------------------------------
+# load_or_compile: gating, hit/miss, corruption fallback, monitor truce
+# ----------------------------------------------------------------------
+
+class TestLoadOrCompile:
+    def test_disabled_returns_jit_fn_untouched(self):
+        cc.set_cache_dir(None)
+        try:
+            fn = jax.jit(lambda x: x * 2.0)
+            got, status = cc.load_or_compile(fn, (jnp.ones((2, 2)),))
+            assert status == "off" and got is fn
+        finally:
+            cc.reset()
+
+    def test_miss_then_hit_bitwise_equal(self, cache_root, fresh_registry):
+        from bigdl_tpu.analysis.runtime import strict_transfers as guard
+
+        x = jax.device_put(
+            np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        fn1 = jax.jit(lambda a: jnp.tanh(a) @ a.T)
+        with guard(True):  # cached executables add zero implicit transfers
+            expect = np.asarray(fn1(x))
+
+            call1, s1 = cc.load_or_compile(
+                jax.jit(lambda a: jnp.tanh(a) @ a.T), (x,),
+                signature="test/fn")
+            assert s1 == "miss"
+            np.testing.assert_array_equal(np.asarray(call1(x)), expect)
+
+            call2, s2 = cc.load_or_compile(
+                jax.jit(lambda a: jnp.tanh(a) @ a.T), (x,),
+                signature="test/fn")
+            assert s2 == "hit"
+            np.testing.assert_array_equal(np.asarray(call2(x)), expect)
+        assert fresh_registry.get("compile/cache_hits") == 1
+        assert fresh_registry.get("compile/cache_misses") == 1
+        assert fresh_registry.get("compile/cache_load_ms") > 0
+
+    def test_corrupt_entry_falls_back_to_compile(self, cache_root,
+                                                 fresh_registry):
+        x = jnp.ones((3, 3), jnp.float32)
+        _, s1 = cc.load_or_compile(jax.jit(lambda a: a + 1.0), (x,),
+                                   signature="test/corrupt")
+        assert s1 == "miss"
+        st = cc.store()
+        (key, _, _), = st.entries()
+        with open(st._bin(key), "wb") as f:
+            f.write(b"garbage")
+        call, s2 = cc.load_or_compile(jax.jit(lambda a: a + 1.0), (x,),
+                                      signature="test/corrupt")
+        assert s2 == "miss"  # degraded to a real compile, never an error
+        assert fresh_registry.get("compile/cache_corrupt") >= 1
+        np.testing.assert_array_equal(np.asarray(call(x)),
+                                      np.asarray(x) + 1.0)
+
+    def test_load_is_never_a_steady_recompile(self, cache_root,
+                                              fresh_registry):
+        """A deserialized executable after 'restart' must not trip the
+        recompile alarm even when its signature has already settled."""
+        obs.set_observability(metrics=True, compile_monitor=True)
+        mon = obs.compile_monitor()
+        x = jnp.ones((5, 5), jnp.float32)
+        _, s1 = cc.load_or_compile(jax.jit(lambda a: a * a), (x,),
+                                   signature="test/steady")
+        assert s1 == "miss"
+        mon.mark_steady("test/")  # the worst case: already settled
+        _, s2 = cc.load_or_compile(jax.jit(lambda a: a * a), (x,),
+                                   signature="test/steady")
+        assert s2 == "hit"
+        assert mon.cache_loads("test/steady") >= 1
+        assert mon.recompiles("test/") == 0
+        assert fresh_registry.get("compile/steady_recompiles") == 0
+
+
+# ----------------------------------------------------------------------
+# end-to-end parity: training with the cache on is bitwise the same
+# ----------------------------------------------------------------------
+
+def make_dataset(n=64, dim=8, batch=16, seed=7):
+    rs = np.random.RandomState(seed)
+    samples = [Sample.from_ndarray(rs.randn(dim).astype(np.float32),
+                                   rs.randn(4).astype(np.float32))
+               for _ in range(n)]
+    return ArrayDataSet(samples).transform(SampleToMiniBatch(batch))
+
+
+def make_optimizer(epochs=2, seed=42):
+    RandomGenerator.set_seed(seed)
+    model = nn.Sequential(nn.Linear(8, 4))
+    o = optim.LocalOptimizer(model, make_dataset(), nn.MSECriterion(),
+                             optim_method=SGD(learning_rate=0.05),
+                             end_trigger=Trigger.max_epoch(epochs))
+    o.set_strict_transfers(True)
+    return o
+
+
+def param_leaves(o):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(o.params)]
+
+
+def assert_bitwise_equal(a_leaves, b_leaves):
+    assert len(a_leaves) == len(b_leaves)
+    for a, b in zip(a_leaves, b_leaves):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+
+
+class TestTrainingParity:
+    def test_params_bitwise_equal_cache_off_cold_warm(self, tmp_path,
+                                                      fresh_registry):
+        """cache-off, cold-cache (AOT compile+store) and warm-cache
+        (deserialize) runs must land on bitwise-identical params."""
+        cc.set_cache_dir(None)
+        try:
+            off = make_optimizer()
+            off.optimize()
+            off_leaves = param_leaves(off)
+        finally:
+            cc.reset()
+
+        cc.set_cache_dir(str(tmp_path / "cc"))
+        try:
+            cold = make_optimizer()
+            cold.optimize()
+            assert obs.registry().get("compile/cache_misses") >= 1
+            assert_bitwise_equal(off_leaves, param_leaves(cold))
+
+            warm = make_optimizer()
+            warm.optimize()
+            assert obs.registry().get("compile/cache_hits") >= 1
+            assert_bitwise_equal(off_leaves, param_leaves(warm))
+        finally:
+            cc.reset()
+
+
+# ----------------------------------------------------------------------
+# chaos: kill mid-run, resume against the warm cache
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestChaosWarmResume:
+    def test_kill_resume_warm_cache_bitwise_equal(self, tmp_path,
+                                                  fresh_registry):
+        """A run killed mid-epoch resumes from its checkpoints WITH the
+        executable cache warm: the resumed process loads instead of
+        compiling, and the final params stay bitwise-equal to the
+        uninterrupted cache-off run's.
+
+        Each leg gets a FRESH CompileMonitor: the monitor is process-
+        global, and a signature settled by an earlier test (or an earlier
+        leg) would flag this leg's fresh helper-jit closures as steady
+        recompiles — a restarted interpreter never carries that state."""
+        obs.set_observability(compile_monitor=True)
+        baseline = make_optimizer(epochs=3)
+        baseline.optimize()
+        base_leaves = param_leaves(baseline)
+
+        cc.set_cache_dir(str(tmp_path / "cc"))
+        try:
+            obs.set_observability(compile_monitor=True)  # "fresh process"
+            root = str(tmp_path / "ck")
+            o = make_optimizer(epochs=3)
+            o.set_checkpoint(root, Trigger.several_iteration(4))
+            o.set_chaos(StepFaultInjector(fail_steps=(7,)))
+            o.set_fault_tolerance(max_restarts=0, backoff_base_s=0.0)
+            with pytest.raises(ChaosStepFault):
+                o.optimize()
+            assert committed_steps(root)
+            assert obs.registry().get("compile/cache_misses") >= 1
+
+            hits_before = obs.registry().get("compile/cache_hits")
+            obs.set_observability(compile_monitor=True)  # "fresh process"
+            RandomGenerator.set_seed(999)  # the checkpoint's seed must win
+            o2 = optim.LocalOptimizer(nn.Sequential(nn.Linear(8, 4)),
+                                      make_dataset(), nn.MSECriterion(),
+                                      optim_method=SGD(learning_rate=0.05),
+                                      end_trigger=Trigger.max_epoch(3))
+            o2.set_strict_transfers(True)
+            o2.resume_from(root)
+            o2.optimize()
+            assert_bitwise_equal(base_leaves, param_leaves(o2))
+            assert obs.registry().get("compile/cache_hits") > hits_before
+            assert obs.registry().get("compile/steady_recompiles") == 0
+        finally:
+            cc.reset()
+
+
+# ----------------------------------------------------------------------
+# serving: params-only hot-swap reuses live executables (all modes)
+# ----------------------------------------------------------------------
+
+class TestServingWarmReuse:
+    def test_params_only_swap_reuses_live_executables(self, fresh_registry):
+        """A same-signature swap must not re-trace: every warm bucket is
+        reused (counter bumps once per bucket) and the compiled-shape
+        count stays flat.  This holds with the cache OFF — reuse is a
+        property of the runtime, not of the disk store."""
+        from bigdl_tpu.serving import ServingRuntime
+
+        cc.set_cache_dir(None)
+        try:
+            model = nn.Sequential(nn.Linear(6, 8), nn.ReLU(),
+                                  nn.Linear(8, 4))
+            params, state, _ = model.build(jax.random.PRNGKey(0), (8, 6))
+            x = np.random.RandomState(0).randn(1, 6).astype(np.float32)
+            with ServingRuntime(model, params, state, buckets=(1, 8),
+                                example_input=np.zeros((1, 6), np.float32),
+                                max_wait_ms=2.0) as rt:
+                y0 = np.asarray(rt.predict(x))
+                compiled_before = rt.compile_count()
+                reused0 = obs.registry().get("serving/warmup_reused")
+                rt.swap("v1", jax.tree_util.tree_map(lambda l: l, params),
+                        state)
+                y1 = np.asarray(rt.predict(x))
+                assert (obs.registry().get("serving/warmup_reused")
+                        - reused0) == 2  # one per bucket
+                assert rt.compile_count() == compiled_before
+                np.testing.assert_array_equal(y0, y1)
+        finally:
+            cc.reset()
+
+    def test_swap_with_cache_on_serves_identical_outputs(self, tmp_path,
+                                                         fresh_registry):
+        """Cache-on warmup goes through load_or_compile; outputs through
+        the AOT executables must match the plain jit path bitwise, with
+        the runtime's own strict-transfer guard on the dispatch thread."""
+        from bigdl_tpu.serving import ServingRuntime
+
+        model = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 4))
+        params, state, _ = model.build(jax.random.PRNGKey(0), (8, 6))
+        x = np.random.RandomState(1).randn(1, 6).astype(np.float32)
+
+        def serve_once():
+            with ServingRuntime(model, params, state, buckets=(1, 8),
+                                example_input=np.zeros((1, 6), np.float32),
+                                max_wait_ms=2.0,
+                                strict_transfers=True) as rt:
+                return np.asarray(rt.predict(x))
+
+        cc.set_cache_dir(None)
+        try:
+            y_off = serve_once()
+        finally:
+            cc.reset()
+
+        cc.set_cache_dir(str(tmp_path / "cc"))
+        try:
+            y_cold = serve_once()
+            assert obs.registry().get("compile/cache_misses") >= 1
+            y_warm = serve_once()
+            assert obs.registry().get("compile/cache_hits") >= 1
+        finally:
+            cc.reset()
+        np.testing.assert_array_equal(y_off, y_cold)
+        np.testing.assert_array_equal(y_off, y_warm)

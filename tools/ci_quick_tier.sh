@@ -49,6 +49,18 @@ if [ "$rc" -eq 0 ]; then
     elapsed=$(( $(date +%s) - start ))
 fi
 
+if [ "$rc" -eq 0 ]; then
+    # aot-cache lane: the same tiny train twice in fresh processes against
+    # one BIGDL_TPU_COMPILE_CACHE dir — run 1 must store executables, run 2
+    # must load them (cache hits + a compile.cache_load span) with zero
+    # steady-recompile alarms; a silent cold restart fails here, not in prod
+    remaining=$(( BUDGET - elapsed ))
+    [ "$remaining" -lt 30 ] && remaining=30
+    timeout --signal=TERM "$remaining" python tools/obs_smoke.py --aot-cache
+    rc=$?
+    elapsed=$(( $(date +%s) - start ))
+fi
+
 if [ "$rc" -eq 124 ]; then
     echo "FAIL: quick tier exceeded the ${BUDGET}s budget (killed)" >&2
     exit 1

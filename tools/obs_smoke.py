@@ -19,6 +19,13 @@ and a metrics snapshot, and exits nonzero unless:
     counters and exports to JSONL + Prometheus textfile formats.
 
 Usage: python tools/obs_smoke.py [outdir]   (default: a temp dir)
+
+`--aot-cache` runs the executable-cache lane instead (ISSUE 7 CI
+acceptance): the same tiny train TWICE in separate processes against one
+`BIGDL_TPU_COMPILE_CACHE` dir, asserting the first run stores executables
+(cache misses > 0), the second run loads them (cache hits > 0, a
+compile.cache_load span in its trace) and raises zero steady-recompile
+alarms.  `--aot-cache-child` is one such process.
 """
 
 import json
@@ -159,7 +166,69 @@ def validate_metrics(outdir):
     return snap
 
 
+def aot_cache_child(cache_dir):
+    """One process of the aot-cache lane: tiny train with the executable
+    cache on + full tracing, then report the cache counters and whether
+    the trace carries a compile.cache_load span."""
+    os.environ["BIGDL_TPU_COMPILE_CACHE"] = cache_dir
+    obs.set_observability(metrics=True, tracing=True, compile_monitor=True)
+    with tempfile.TemporaryDirectory() as ckpt:
+        run_traced_train(os.path.join(ckpt, "ckpt"))
+    reg = obs.registry()
+    tr = obs.tracer()
+    names = {e[1] for e in tr.events()} if tr is not None else set()
+    print("AOT_CACHE_CHILD " + json.dumps({
+        "cache_hits": int(reg.get("compile/cache_hits")),
+        "cache_misses": int(reg.get("compile/cache_misses")),
+        "persistent_cache_hits": int(reg.get(
+            "compile/persistent_cache_hits")),
+        "steady_recompiles": int(reg.get("compile/steady_recompiles")),
+        "cache_load_span": "compile.cache_load" in names,
+    }), flush=True)
+
+
+def aot_cache_lane():
+    """Parent: two fresh-process children against ONE cache dir."""
+    import subprocess
+
+    cache_dir = tempfile.mkdtemp(prefix="aotcache_smoke_")
+    runs = []
+    for i in range(2):
+        env = dict(os.environ)
+        env["BIGDL_TPU_COMPILE_CACHE"] = cache_dir
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--aot-cache-child", cache_dir],
+            env=env, capture_output=True, text=True, timeout=600)
+        row = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("AOT_CACHE_CHILD "):
+                row = json.loads(line[len("AOT_CACHE_CHILD "):])
+        if row is None:
+            fail(f"aot-cache child {i} produced no report "
+                 f"(rc={proc.returncode}):\n{proc.stdout[-2000:]}\n"
+                 f"{proc.stderr[-2000:]}")
+        runs.append(row)
+    if runs[0]["cache_misses"] < 1:
+        fail(f"first run stored nothing: {runs[0]}")
+    if runs[1]["cache_hits"] < 1:
+        fail(f"second run loaded nothing from the warm cache: {runs[1]}")
+    if not runs[1]["cache_load_span"]:
+        fail(f"second run's trace has no compile.cache_load span: {runs[1]}")
+    for i, row in enumerate(runs):
+        if row["steady_recompiles"]:
+            fail(f"run {i} raised steady-recompile alarms: {row}")
+    print(json.dumps({"aot_cache_smoke": "ok", "run1": runs[0],
+                      "run2": runs[1]}))
+
+
 def main():
+    if "--aot-cache-child" in sys.argv:
+        aot_cache_child(sys.argv[sys.argv.index("--aot-cache-child") + 1])
+        return
+    if "--aot-cache" in sys.argv:
+        aot_cache_lane()
+        return
     outdir = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp(
         prefix="obs_smoke_")
     os.makedirs(outdir, exist_ok=True)
