@@ -57,6 +57,7 @@ Prints one json line per row.
 
 import argparse
 import json
+import os
 import time
 from collections import deque
 
@@ -368,6 +369,78 @@ def watchdog_ab(iters=ITERS, rounds=4):
     return rows
 
 
+def measure_readers(autoscale, iters=ITERS):
+    """optimize() ms/step with the reader pool on in BOTH legs and only
+    the stall-driven autoscaler toggled (ISSUE 9 acceptance: its EMA
+    bookkeeping + scale decisions must cost <1% when the device is the
+    bottleneck).  Assembly is real work (per-sample numpy stacking of
+    32x32x3 images) but the conv step dominates, so the loop is
+    device-bound — the regime the autoscaler idles in."""
+    from bigdl_tpu.dataset import Sample, SampleToMiniBatch
+
+    RandomGenerator.set_seed(7)
+    rs = np.random.RandomState(0)
+    samples = [Sample.from_ndarray(rs.randn(HW, HW, CIN).astype(np.float32),
+                                   np.int32(i % NCLS))
+               for i in range(BATCH * iters)]
+    ds = ArrayDataSet(samples).transform(SampleToMiniBatch(BATCH))
+    o = optim_mod.DistriOptimizer(
+        _model(), ds, nn.ClassNLLCriterion(),
+        optim_method=SGD(learning_rate=0.01),
+        end_trigger=Trigger.max_iteration(iters))
+    o.set_feed(2, reader_procs=2, reader_autoscale=autoscale)
+    o.optimize()  # warm: compiles the step, forks the first pool
+    o.end_when = Trigger.max_iteration(2 * iters)
+    t0 = time.perf_counter()
+    o.optimize()
+    return (time.perf_counter() - t0) / iters
+
+
+def readers_ab(iters=ITERS, rounds=3, out_path=None):
+    """Reader-autoscaler off/on A-B, interleaved with per-leg min across
+    rounds (same discipline as watchdog_ab: shared-host load drifts by
+    more than the effect under test)."""
+    rows = {False: float("inf"), True: float("inf")}
+    for _ in range(rounds):
+        for autoscale in (False, True):
+            rows[autoscale] = min(rows[autoscale],
+                                  measure_readers(autoscale, iters))
+    out_rows = []
+    for autoscale in (False, True):
+        out_rows.append({
+            "path": "readers_ab", "reader_procs": 2,
+            "autoscale": autoscale,
+            "ms_per_step": round(rows[autoscale] * 1e3, 2)})
+        print(json.dumps(out_rows[-1]))
+    overhead = rows[True] / rows[False] - 1.0
+    out_rows.append({
+        "metric": "readers_overhead_ok",
+        "value": bool(overhead < 0.01),
+        "overhead_pct": round(overhead * 100, 2)})
+    print(json.dumps(out_rows[-1]))
+    if out_path:
+        artifact = {
+            "bench": "PYTHONPATH=. JAX_PLATFORMS=cpu python "
+                     f"benchmarks/bench_trainer_overhead.py --readers "
+                     f"--iters {iters}",
+            "date": time.strftime("%Y-%m-%d"),
+            "platform": f"cpu backend, {os.cpu_count()}-core host. Both "
+                        "legs run the procs=2 reader pool; only the "
+                        "stall-driven autoscaler differs, so the A-B "
+                        "isolates its EMA/note_feed bookkeeping from the "
+                        "pool's own IPC. Interleaved legs, per-leg min "
+                        f"over {rounds} rounds. The step is a conv net, "
+                        "device-bound, so the autoscaler sees low stall "
+                        "and holds (or shrinks) — the production idle "
+                        "regime the <1% bound is about.",
+            "rows": out_rows,
+        }
+        with open(out_path, "w") as fh:
+            json.dump(artifact, fh, indent=2)
+            fh.write("\n")
+    return rows
+
+
 def measure_obs(tracing, iters=ITERS):
     """optimize() ms/step with the obs plane at its default (metrics +
     compile monitor on) vs full span tracing on.  Returns (ms/step,
@@ -659,6 +732,9 @@ def main(argv=None):
                     help="A-B the tpu_lint host-sync fixes (quick capture)")
     ap.add_argument("--watchdog", action="store_true",
                     help="run just the divergence-watchdog off/on A-B")
+    ap.add_argument("--readers", action="store_true",
+                    help="run just the reader-autoscaler off/on A-B "
+                         "(procs=2 pool in both legs)")
     ap.add_argument("--obs", action="store_true",
                     help="run just the obs span-tracing off/on A-B")
     ap.add_argument("--restart", action="store_true",
@@ -694,6 +770,10 @@ def main(argv=None):
         return
     if args.watchdog:
         watchdog_ab(args.iters)
+        return
+    if args.readers:
+        readers_ab(args.iters, rounds=max(args.rounds, 3),
+                   out_path=args.out)
         return
     if args.obs:
         obs_ab(args.iters)

@@ -802,18 +802,25 @@ def _concurrency_findings(proj: "Project", idx: _ModuleIndex,
 
     for scope_name, body in scopes:
         scope_src = ast.Module(body=list(body), type_ignores=[])
-        thread_targets: Set[str] = set()      # worker method names
+        thread_targets: Set[str] = set()      # worker method/fn names
         thread_creations: List[Tuple[ast.Call, str, bool]] = []
+        proc_creations: List[Tuple[ast.Call, str, bool]] = []
         queue_attrs: Set[str] = set()
         joined_names: Set[str] = set()
         container_attrs: Set[str] = set()
         # attr -> {method} for container mutations, split by lock coverage
         mut_by_method: Dict[str, Dict[str, bool]] = {}
 
+        # worker creations: threads AND multiprocessing child processes
+        # (`multiprocessing.Process`, `mp.Process`, `ctx.Process`, ... —
+        # matched by last chain segment so a stored start-method context
+        # like `self._ctx.Process(...)` counts too)
         for node in ast.walk(scope_src):
             if isinstance(node, ast.Call):
                 chain = _attr_chain(node.func)
-                if chain in ("threading.Thread", "Thread"):
+                last = chain.split(".")[-1] if chain else ""
+                if chain in ("threading.Thread", "Thread") \
+                        or last == "Process":
                     daemon = any(
                         kw.arg == "daemon" and
                         isinstance(kw.value, ast.Constant) and
@@ -824,15 +831,14 @@ def _concurrency_findings(proj: "Project", idx: _ModuleIndex,
                             t = _attr_chain(kw.value)
                             if t:
                                 thread_targets.add(t.split(".")[-1])
-                    thread_creations.append((node, scope_name, daemon))
+                    (proc_creations if last == "Process"
+                     else thread_creations).append(
+                        (node, scope_name, daemon))
                 elif chain and chain.endswith(".join"):
                     base = _attr_chain(node.func.value) \
                         if isinstance(node.func, ast.Attribute) else None
                     if base:
                         joined_names.add(base.split(".")[-1])
-                elif chain in ("queue.Queue", "Queue", "queue.SimpleQueue",
-                               "SimpleQueue", "queue.LifoQueue"):
-                    pass  # assignment handler below records the attr
             targets = []
             if isinstance(node, ast.Assign):
                 targets = node.targets
@@ -845,9 +851,12 @@ def _concurrency_findings(proj: "Project", idx: _ModuleIndex,
                         t.id if isinstance(t, ast.Name) else None)
                     if aname is None:
                         continue
-                    if vchain in ("queue.Queue", "Queue",
-                                  "queue.SimpleQueue", "SimpleQueue",
-                                  "queue.LifoQueue"):
+                    # thread queues AND multiprocessing queues
+                    # (mp.Queue / ctx.Queue / JoinableQueue): suffix
+                    # match, same bounded put/get discipline either way
+                    if vchain and vchain.split(".")[-1] in (
+                            "Queue", "SimpleQueue", "LifoQueue",
+                            "JoinableQueue"):
                         queue_attrs.add(aname)
                     if vchain in ("list", "dict", "set"):
                         container_attrs.add(aname)
@@ -857,22 +866,29 @@ def _concurrency_findings(proj: "Project", idx: _ModuleIndex,
                     if isinstance(t, ast.Attribute):
                         container_attrs.add(t.attr)
 
-        # thread without daemon and without any .join in scope
-        for call, sname, daemon in thread_creations:
-            if daemon:
-                continue
-            # the created thread is joined if ANY name in this scope is
-            # joined — name-level, deliberately permissive
-            if joined_names:
-                continue
-            mk("concurrency", call, f"{sname}",
-               "thread created with neither daemon=True nor a join() on "
-               "any shutdown path — leaks past interpreter exit and "
-               "test teardown")
+        # thread/process without daemon and without any .join in scope
+        for creations, what, leak in (
+                (thread_creations, "thread",
+                 "leaks past interpreter exit and test teardown"),
+                (proc_creations, "child process",
+                 "orphans past parent exit, holding pipes and the "
+                 "inherited file descriptors")):
+            for call, sname, daemon in creations:
+                if daemon:
+                    continue
+                # the created worker is joined if ANY name in this scope
+                # is joined — name-level, deliberately permissive
+                if joined_names:
+                    continue
+                mk("concurrency", call, f"{sname}",
+                   f"{what} created with neither daemon=True nor a "
+                   f"join() on any shutdown path — {leak}")
 
-        owns_thread = bool(thread_creations) or bool(thread_targets)
+        owns_thread = bool(thread_creations) or bool(proc_creations) \
+            or bool(thread_targets)
         if not owns_thread:
             continue
+        owns_procs = bool(proc_creations)
 
         def scan_call(e: ast.Call, method_name: str, lock_depth: int):
             if not isinstance(e.func, ast.Attribute):
@@ -896,6 +912,18 @@ def _concurrency_findings(proj: "Project", idx: _ModuleIndex,
                        f"`{aname}.join()` (queue join, no timeout "
                        "possible) on a shutdown path — replace with a "
                        "bounded wait on all_tasks_done")
+            elif owns_procs and meth == "join" and not e.args and not any(
+                    kw.arg == "timeout" for kw in e.keywords) \
+                    and method_name in ("close", "stop", "shutdown",
+                                        "__exit__", "__del__"):
+                # process-owning scope: an unbounded join on a shutdown
+                # path deadlocks the parent when a child died mid-put
+                # with the queue full (its feeder thread never flushes)
+                mk("concurrency", e, f"{scope_name}.{method_name}",
+                   f"unbounded `{aname or '<expr>'}.join()` on a "
+                   "shutdown path of a process-owning class — a child "
+                   "blocked flushing a full mp queue never exits; join "
+                   "with a timeout, then terminate()/kill()")
             if aname in container_attrs and meth in (
                     "append", "extend", "pop", "remove", "clear",
                     "update", "add", "insert", "popitem", "setdefault"):

@@ -90,6 +90,15 @@ class EngineConfig:
     # assembled batches exist at once.  0 = synchronous staging (the
     # pre-feed loop).  See docs/training.md "Input feed & overlap".
     feed_depth: int = 2
+    # Disaggregated input plane (dataset/readers.py): reader PROCESSES
+    # that own batch assembly (decode/augment/stack) outside the trainer
+    # process, feeding DeviceFeed through a sequence-numbered reorder
+    # stage (batch order — and losses — stay bitwise-equal to in-thread
+    # assembly).  0 = off (in-thread).  reader_autoscale lets the
+    # stall-driven autoscaler grow/shrink within [1, reader_procs].
+    # See docs/training.md "Disaggregated readers & autoscaling".
+    reader_procs: int = 0
+    reader_autoscale: bool = True
     # Numeric-divergence watchdog (bigdl_tpu.health): a device-side finite
     # check on loss + grad norm folded into the jitted step, with the
     # skip -> lr_backoff -> rollback -> abort policy ladder.  Off by
@@ -134,6 +143,8 @@ class EngineConfig:
             mesh_spec=os.environ.get(_PREFIX + "MESH"),
             async_depth=_env_int("ASYNC_DEPTH", 32),
             feed_depth=_env_int("FEED_DEPTH", 2),
+            reader_procs=_env_int("READER_PROCS", 0),
+            reader_autoscale=_env_bool("READER_AUTOSCALE", True),
             watchdog=_env_bool("WATCHDOG", False),
             ckpt_verify=_env_bool("CKPT_VERIFY", True),
         )

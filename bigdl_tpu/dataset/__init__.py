@@ -3,6 +3,9 @@ from bigdl_tpu.dataset.minibatch import MiniBatch, SparseMiniBatch
 from bigdl_tpu.dataset.transformer import Transformer, SampleToMiniBatch
 from bigdl_tpu.dataset.dataset import DataSet, LocalDataSet, ArrayDataSet
 from bigdl_tpu.dataset.feed import DeviceFeed, FeedItem, InlineFeed, make_feed
+from bigdl_tpu.dataset.readers import (ChunkWork, ReaderPool, ReaderWork,
+                                       ReaderWorkerError, make_reader_source,
+                                       reader_work_for)
 from bigdl_tpu.dataset.datamining import (RowTransformer, RowTransformSchema,
                                           TableToSample)
 from bigdl_tpu.dataset.tfrecord import VarLenFeature
@@ -12,6 +15,9 @@ from bigdl_tpu.dataset import text
 __all__ = ["Sample", "SparseBag", "SparseFeature", "MiniBatch", "SparseMiniBatch",
            "Transformer", "SampleToMiniBatch",
            "DataSet", "LocalDataSet", "ArrayDataSet",
+           "DeviceFeed", "FeedItem", "InlineFeed", "make_feed",
+           "ChunkWork", "ReaderPool", "ReaderWork", "ReaderWorkerError",
+           "make_reader_source", "reader_work_for",
            "RowTransformer", "RowTransformSchema", "TableToSample",
            "VarLenFeature", "image", "text"]
 from bigdl_tpu.dataset import datasets

@@ -34,6 +34,23 @@ Observability counters ride on the feed object: per-item consumer stall
 time (how long the step loop waited on the queue), staged-buffer
 occupancy at hand-off, and worker assembly throughput — the trainer
 surfaces them through Metrics/TrainSummary as FeedStall/FeedOccupancy.
+
+BatchSource seam: `batches` may be ANY iterable of batches — an inline
+generator (the in-thread assembler: dataset iteration -> transformer
+chain runs inside this worker's `feed.assemble` span) or a remote
+source like `readers.ReaderPool`, whose `__next__` only reorders
+batches other PROCESSES assembled.  Both shapes share this one worker
+loop and the one `feed.h2d_stage` staging path.  A source may opt into
+two hooks:
+
+  * `close_with_feed = True` + `close()`: the feed closes the source —
+    BEFORE joining its worker for a concurrent-close-safe source (so a
+    worker parked in the source's `__next__` unblocks immediately, and
+    an early break / preemption exit tears the whole pipeline down
+    through one `feed.close()`);
+  * `note_feed(stall_s, occupancy)`: called at every consumer hand-off
+    with the live stall/occupancy telemetry — the ReaderPool's
+    stall-driven autoscaler rides this.
 """
 
 from __future__ import annotations
@@ -80,6 +97,10 @@ class DeviceFeed:
         # a wedged worker raises StalledStep into the consumer instead of
         # stalling the step loop until the phase deadline is forgotten
         self._stall_check = stall_check
+        # BatchSource seam: keep the source for close-through and the
+        # autoscaler's hand-off hook (see module docstring)
+        self._src = batches
+        self._note_feed = getattr(batches, "note_feed", None)
         self._it = iter(batches)
         self._q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_depth)
         self._stop = threading.Event()
@@ -192,7 +213,12 @@ class DeviceFeed:
             raise StopIteration
         batch, payload = item
         self._delivered += 1
-        return FeedItem(batch, payload, stall, self._q.qsize() + 1)
+        occ = self._q.qsize() + 1
+        if self._note_feed is not None:
+            # autoscaler hand-off hook (ReaderPool.note_feed): consumer
+            # thread, cheap host math only
+            self._note_feed(stall, occ)
+        return FeedItem(batch, payload, stall, occ)
 
     def __enter__(self) -> "DeviceFeed":
         return self
@@ -201,22 +227,44 @@ class DeviceFeed:
         self.close()
 
     def close(self) -> None:
-        """Idempotent shutdown: stop, unblock, join, surface late errors."""
+        """Idempotent shutdown: stop, unblock, join, surface late errors.
+
+        Ordering matters for the remote-source case: a concurrent-close-
+        safe source (`close_with_feed`, e.g. readers.ReaderPool) is
+        closed BEFORE the join, so a worker parked inside the source's
+        `__next__` (waiting on reader processes) observes the shutdown
+        within one poll instead of riding out a full assembly — the join
+        below then cannot time out against a stuck producer.  Plain
+        generator sources are never closed concurrently (generators
+        forbid it) — for those the stop flag + queue drain unblock the
+        worker exactly as before."""
         if self._closed:
             return
         self._closed = True
         self._stop.set()
+        if getattr(self._src, "close_with_feed", False):
+            try:
+                self._src.close()
+            except Exception:  # pragma: no cover - teardown best-effort
+                pass
         reg = _obs.registry()
         reg.inc("feed/staged_batches", self._staged)
         reg.inc("feed/delivered_batches", self._delivered)
         reg.set_gauge("feed/assembly_records_per_s",
                       self.assembly_records_per_s())
-        # drain so a worker blocked mid-put can observe the stop flag
+        # drain so a worker blocked mid-put can observe the stop flag;
+        # keep draining until the worker exits — one pass can lose the
+        # race against a worker completing a put between drain and join
+        deadline = time.perf_counter() + 5.0
         while True:
             try:
                 self._q.get_nowait()
             except queue.Empty:
-                break
+                if not self._thread.is_alive():
+                    break
+                if time.perf_counter() > deadline:
+                    break
+                time.sleep(0.005)
         self._thread.join(timeout=5.0)
         if self._thread.is_alive():  # pragma: no cover - defensive
             raise RuntimeError(f"{self._thread.name} worker did not stop")
@@ -249,6 +297,8 @@ class InlineFeed:
 
     def __init__(self, batches: Iterable[Any], put_fn: Callable[[Any], Any]):
         self._put = put_fn
+        self._src = batches
+        self._note_feed = getattr(batches, "note_feed", None)
         self._it = iter(batches)
         self._staged_records = 0
         self._work_s = 0.0
@@ -276,7 +326,10 @@ class InlineFeed:
                 pass
         # inline: the "stall" IS the assembly+staging time the loop paid
         self._delivered += 1
-        return FeedItem(batch, payload, time.perf_counter() - t0, 0)
+        stall = time.perf_counter() - t0
+        if self._note_feed is not None:
+            self._note_feed(stall, 0)
+        return FeedItem(batch, payload, stall, 0)
 
     def __enter__(self) -> "InlineFeed":
         return self
@@ -285,7 +338,10 @@ class InlineFeed:
         self.close()
 
     def close(self) -> None:
-        pass
+        # close-through: the feed-off (depth=0) path over a ReaderPool
+        # must tear down reader processes exactly like the async path
+        if getattr(self._src, "close_with_feed", False):
+            self._src.close()
 
     def assembly_records_per_s(self) -> float:
         return self._staged_records / self._work_s if self._work_s > 0 else 0.0

@@ -41,6 +41,21 @@ class MiniBatch:
 
         return MiniBatch(sl(self.input), sl(self.target) if self.target is not None else None)
 
+    def nbytes(self) -> int:
+        """Host-memory footprint of the batch payload, in bytes.  The
+        reader pool sizes its bounded queue in batches, so `window *
+        nbytes()` is the parent-side buffering ceiling — exposed for
+        memory accounting and the feed occupancy telemetry."""
+
+        def nb(x):
+            if x is None:
+                return 0
+            if isinstance(x, (tuple, list)):
+                return sum(nb(v) for v in x)
+            return int(np.asarray(x).nbytes)
+
+        return nb(self.input) + nb(self.target)
+
     def pad_to(self, n: int) -> "MiniBatch":
         """Pad the batch (leading) dim to `n` rows by repeating the last
         row, keeping XLA batch shapes static across the epoch tail (the

@@ -1,0 +1,629 @@
+"""Disaggregated input plane: sharded multi-process reader pool.
+
+Reference: dataset/image/MTLabeledBGRImgToBatch.scala ran decode/augment
+on a thread pool INSIDE the training JVM; the GIL makes that a ceiling
+here — bench_input_pipeline measured ~25 host cores of decode+augment to
+feed one chip, all serialized behind one interpreter lock.  This module
+moves batch ASSEMBLY (record read -> decode/augment -> MiniBatch stack)
+into N worker *processes*, the tf.data-service-style input split, while
+keeping the delivered batch sequence bitwise-identical to the in-thread
+assembler so the resilience layer's kill->resume parity survives.
+
+Design:
+
+  * WORK, not shards, is the unit: a picklable `ReaderWork` object
+    describes one epoch as an indexed stream of cheap *items* (record
+    buffers, path chunks, sample chunks) plus an `assemble(item)` that
+    does the expensive part.  Batch `k`'s content is a pure function of
+    (work, k) — never of which worker built it.
+  * workers CLAIM indices from a shared counter (each claim is one
+    batch), skip their cheap item stream forward to the claimed index,
+    assemble, and post `(seq, batch)` on a bounded mp queue.  Claiming
+    adapts to heterogeneous item cost and to the pool growing or
+    shrinking mid-epoch; determinism comes from the reorder stage, not
+    from a static worker:shard map.
+  * the parent restores STRICT order by sequence number before handing
+    batches to the consumer, so `seek_epoch` + skip-batches resume (the
+    pool starts claiming at `start_index`) stays bitwise-equal to the
+    single-process path.
+  * a claim WINDOW (`served + window` is the claim ceiling) bounds
+    host memory: at most `window` assembled batches exist across the
+    queue, the reorder buffer and workers' hands.
+  * worker death is a RETRYABLE fault: a nonzero exitcode (or an
+    exception shipped over the queue) surfaces as `ReaderWorkerError`
+    from `__next__` within one poll interval — never a deadlock, even
+    with the queue full — and the Optimizer's bounded-restart path
+    treats it like any transient step failure.
+  * the stall-driven AUTOSCALER rides the DeviceFeed telemetry seam:
+    `note_feed(stall_s, occupancy)` is called at every consumer
+    hand-off; an EMA of the stall grows the pool when the consumer is
+    starved and shrinks it when the queue stays ahead, with hysteresis
+    (wide grow/shrink band + cooldown) so it never thrashes.  Decisions
+    export as the `feed/reader_procs` gauge and `feed.reader_scale`
+    trace instants through bigdl_tpu.obs.
+
+Start method: `fork` by default (BIGDL_TPU_READER_START overrides) —
+the test/CI environment initializes the real TPU backend at interpreter
+startup via sitecustomize, which a `spawn` child would repeat; forked
+workers run numpy-only code and never touch jax.  Under `spawn` the
+ReaderWork object must be picklable.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import queue
+import threading
+import time
+import traceback
+from typing import Any, Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu import obs as _obs
+
+__all__ = ["ReaderWork", "ChunkWork", "ReaderPool", "ReaderWorkerError",
+           "reader_work_for", "make_reader_source"]
+
+# message kinds on the worker -> parent queue
+_MSG_BATCH = 0   # (kind, seq, batch, corrupt_cumulative)
+_MSG_END = 1     # stream exhausted at this claim index
+_MSG_ERR = 2     # payload = formatted traceback
+
+_NO_ITEM = object()
+
+
+class ReaderWorkerError(RuntimeError):
+    """A reader worker process failed (exception or hard death).  Raised
+    from the pool's `__next__`; the Optimizer's restart path treats it as
+    a retryable fault (a fresh pool re-reads the epoch deterministically)."""
+
+
+class ReaderWork:
+    """One epoch of batch-assembly work, split into a CHEAP indexed item
+    stream and an EXPENSIVE per-item assemble.  Implementations must be
+    deterministic: item `k` and `assemble(item_k)` may not depend on
+    process, worker count or wall clock (that is what makes procs=1 and
+    procs=N bitwise-equal)."""
+
+    def item_stream(self, start: int) -> Iterator[Any]:
+        """Yield work items from global batch index `start` on.  Must be
+        cheap per item — every worker iterates this stream and assembles
+        only the items it claimed."""
+        raise NotImplementedError
+
+    def assemble(self, item: Any) -> Any:
+        """Item -> batch (MiniBatch).  The expensive stage; runs only in
+        the worker that claimed the item."""
+        raise NotImplementedError
+
+    def corrupt_count(self) -> int:
+        """Cumulative corrupt records this process observed while reading
+        the item stream (shipped with every message; the parent routes the
+        max across workers to the dataset's counter)."""
+        return 0
+
+
+class ChunkWork(ReaderWork):
+    """List-backed work: `elements` is the epoch's (already shuffled)
+    cheap element list; item `k` is the slice
+    `elements[k*chunk : (k+1)*chunk]` and `assemble_fn(chunk_list)` turns
+    it into one batch.  `keep_tail=False` drops the trailing partial
+    chunk (SampleToMiniBatch's drop_remainder semantics)."""
+
+    def __init__(self, elements: Sequence[Any], chunk: int,
+                 assemble_fn: Callable[[List[Any]], Any],
+                 keep_tail: bool = False):
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        self.elements = list(elements)
+        self.chunk = int(chunk)
+        self.assemble_fn = assemble_fn
+        self.keep_tail = bool(keep_tail)
+
+    def __len__(self) -> int:
+        n, rem = divmod(len(self.elements), self.chunk)
+        return n + (1 if rem and self.keep_tail else 0)
+
+    def item_stream(self, start: int) -> Iterator[Any]:
+        for k in range(start, len(self)):
+            yield self.elements[k * self.chunk:(k + 1) * self.chunk]
+
+    def assemble(self, item: Any) -> Any:
+        return self.assemble_fn(item)
+
+
+# ---------------------------------------------------------------------------
+# worker process body (module-level: picklable under spawn)
+# ---------------------------------------------------------------------------
+
+def _post(q, msg, stop_ev) -> bool:
+    """Bounded put the parent's close() can always unblock.  On abort the
+    queue's feeder thread is cancelled so process exit never blocks
+    flushing into a pipe nobody reads."""
+    while not stop_ev.is_set():
+        try:
+            q.put(msg, timeout=0.05)
+            return True
+        except queue.Full:
+            continue
+    q.cancel_join_thread()
+    return False
+
+
+def _reader_worker(work, wid, out_q, claim, served, window, target,
+                   stop_ev, start_index):
+    """Claim-assemble-post loop.  No jax, no logging, no obs: forked
+    children must not touch locks another parent thread might have held
+    at fork time; errors ship to the parent as formatted tracebacks."""
+    k = -1
+    try:
+        it = None
+        pos = int(start_index)
+        while True:
+            if stop_ev.is_set():
+                out_q.cancel_join_thread()
+                return
+            if target.value <= wid:  # retired by the autoscaler
+                out_q.cancel_join_thread()
+                return
+            with claim.get_lock():
+                k = claim.value
+                if k >= served.value + window:
+                    k = -1  # claim window full: consumer is behind
+                else:
+                    claim.value = k + 1
+            if k < 0:
+                time.sleep(0.002)
+                continue
+            if it is None:
+                it = work.item_stream(int(start_index))
+            item = _NO_ITEM
+            while pos <= k:
+                try:
+                    item = next(it)
+                except StopIteration:
+                    item = _NO_ITEM
+                    break
+                pos += 1
+            if item is _NO_ITEM:
+                # stream exhausted before (or at) the claimed index: this
+                # claim's slot is the epoch's end marker
+                _post(out_q, (_MSG_END, k, None,
+                              int(work.corrupt_count())), stop_ev)
+                return
+            batch = work.assemble(item)
+            if not _post(out_q, (_MSG_BATCH, k, batch,
+                                 int(work.corrupt_count())), stop_ev):
+                return
+    except BaseException:
+        _post(out_q, (_MSG_ERR, k, traceback.format_exc(),
+                      int(getattr(work, "corrupt_count", lambda: 0)())),
+              stop_ev)
+
+
+# ---------------------------------------------------------------------------
+# the pool
+# ---------------------------------------------------------------------------
+
+class ReaderPool:
+    """Multi-process batch source with strict-order delivery.
+
+    Iterates assembled batches in exact `work` index order starting at
+    `start_index`; plugs into DeviceFeed as the `batches` source (the
+    feed's worker thread then only dequeues + stages, sharing the
+    `feed.h2d_stage` path with the in-thread assembler).
+
+    Parameters
+    ----------
+    work : ReaderWork
+    procs : initial worker count (>= 1)
+    start_index : first batch index to produce (mid-epoch resume skip)
+    max_procs : autoscaler ceiling (default `procs`)
+    autoscale : stall-driven grow/shrink between [1, max_procs]
+    on_corrupt : callable(delta) fed the skip_corrupt counter deltas
+    window : claimed-but-undelivered ceiling (host memory bound in
+        batches); default `2 * max_procs + 2`
+    """
+
+    # BatchSource protocol (dataset/feed.py): DeviceFeed.close() closes
+    # this source CONCURRENTLY with its worker thread — every method
+    # here tolerates a close() racing a blocked __next__
+    close_with_feed = True
+
+    def __init__(self, work: ReaderWork, procs: int = 1,
+                 start_index: int = 0, name: str = "ReaderPool",
+                 max_procs: Optional[int] = None, autoscale: bool = False,
+                 on_corrupt: Optional[Callable[[int], None]] = None,
+                 window: Optional[int] = None,
+                 start_method: Optional[str] = None,
+                 grow_stall_frac: float = 0.05,
+                 shrink_stall_frac: float = 0.005,
+                 cooldown_s: float = 1.0):
+        if procs < 1:
+            raise ValueError(f"procs must be >= 1, got {procs}")
+        self.name = name
+        self._work = work
+        self._min_procs = 1
+        self._max_procs = max(int(max_procs or procs), procs)
+        self._autoscale = bool(autoscale)
+        self._on_corrupt = on_corrupt
+        self._window = int(window or (2 * self._max_procs + 2))
+        # scale thresholds are FRACTIONS of the consumer's step interval,
+        # not absolute milliseconds: a 2 ms stall is starvation on a 5 ms
+        # step but idle-regime noise on a 100 ms conv step, and forking a
+        # worker into the latter only steals host CPU from XLA
+        self._grow_frac = float(grow_stall_frac)
+        self._shrink_frac = float(shrink_stall_frac)
+        self._cooldown_s = float(cooldown_s)
+        method = start_method or os.environ.get(
+            "BIGDL_TPU_READER_START", "fork")
+        self._ctx = mp.get_context(method)
+        self._q = self._ctx.Queue(maxsize=self._window)
+        self._stop = self._ctx.Event()
+        start = int(start_index)
+        self._claim = self._ctx.Value("l", start)
+        self._served = self._ctx.Value("l", start)
+        self._target = self._ctx.Value("i", int(procs))
+        self._start_index = start
+        # parent-side state.  _lock covers the worker table: __next__ and
+        # its death checks run on the DeviceFeed worker thread while
+        # note_feed (autoscale) and close() run on the consumer thread.
+        self._lock = threading.Lock()
+        self._workers: dict = {}
+        self._buf: dict = {}
+        self._next_seq = start
+        self._delivered = 0
+        self._corrupt_reported = 0
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._stall_ema: Optional[float] = None
+        self._interval_ema: Optional[float] = None
+        self._last_note: Optional[float] = None
+        self._notes = 0
+        self._last_scale = time.monotonic()
+        for wid in range(int(procs)):
+            self._spawn(wid)
+        _obs.registry().set_gauge("feed/reader_procs", int(procs))
+
+    # -- worker management -------------------------------------------------
+
+    def _spawn(self, wid: int) -> None:
+        p = self._ctx.Process(
+            target=_reader_worker, name=f"{self.name}-w{wid}", daemon=True,
+            args=(self._work, wid, self._q, self._claim, self._served,
+                  self._window, self._target, self._stop, self._start_index))
+        p.start()
+        self._workers[wid] = p
+
+    @property
+    def procs(self) -> int:
+        """Current autoscaler target (== live workers, modulo the short
+        ramp while a retired worker finishes its last claim)."""
+        return int(self._target.value)
+
+    @property
+    def delivered_batches(self) -> int:
+        return self._delivered
+
+    # -- consumer side (runs on the DeviceFeed worker thread) --------------
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        if self._closed:
+            raise StopIteration
+        if self._error is not None:
+            raise self._wrap_error()
+        while self._next_seq not in self._buf:
+            if self._stop.is_set():  # concurrent close(): clean end
+                raise StopIteration
+            try:
+                msg = self._q.get(timeout=0.05)
+            except queue.Empty:
+                self._check_workers()
+                continue
+            except (OSError, ValueError):  # queue torn down by close()
+                raise StopIteration from None
+            kind, seq, payload, corrupt = msg
+            self._note_corrupt(corrupt)
+            if kind == _MSG_ERR:
+                self._error = ReaderWorkerError(
+                    f"{self.name} worker failed assembling batch "
+                    f"{seq}:\n{payload}")
+                self.close()
+                raise self._wrap_error()
+            self._buf[seq] = (kind, payload)
+        kind, payload = self._buf.pop(self._next_seq)
+        if kind == _MSG_END:
+            self.close()
+            raise StopIteration
+        self._next_seq += 1
+        with self._served.get_lock():
+            self._served.value = self._next_seq
+        self._delivered += 1
+        return payload
+
+    def _wrap_error(self) -> BaseException:
+        return self._error if self._error is not None else \
+            ReaderWorkerError(f"{self.name} failed")
+
+    def _check_workers(self) -> None:
+        """Poll for a worker that died WITHOUT posting (kill -9, OOM):
+        the bounded-timeout get above plus this check is what makes a
+        dead producer surface as an error instead of a consumer hang."""
+        with self._lock:
+            workers = list(self._workers.values())
+        dead_dirty = [p for p in workers
+                      if not p.is_alive() and p.exitcode not in (0, None)]
+        if dead_dirty:
+            p = dead_dirty[0]
+            self._error = ReaderWorkerError(
+                f"{self.name} worker {p.name} died (exitcode {p.exitcode}) "
+                f"before posting its claimed batch")
+            self.close()
+            raise self._wrap_error()
+        if workers and all(not p.is_alive() for p in workers) \
+                and self._q.empty() and self._next_seq not in self._buf:
+            # every worker exited cleanly yet the sequence has a hole and
+            # no END reached us — defensive: surface instead of spinning
+            self._error = ReaderWorkerError(
+                f"{self.name}: all workers exited without completing the "
+                f"epoch (next_seq={self._next_seq})")
+            self.close()
+            raise self._wrap_error()
+
+    def _note_corrupt(self, cumulative: int) -> None:
+        # every worker reads the full (cheap) item stream, so each one
+        # observes the same corrupt records: route the MAX across
+        # workers, as deltas, to the dataset's counter
+        c = int(cumulative or 0)
+        if c > self._corrupt_reported:
+            delta = c - self._corrupt_reported
+            self._corrupt_reported = c
+            if self._on_corrupt is not None:
+                self._on_corrupt(delta)
+
+    # -- autoscaler (runs on the consumer thread via DeviceFeed) -----------
+
+    def note_feed(self, stall_s: float, occupancy: int) -> None:
+        """DeviceFeed hand-off hook: fold the consumer's stall into the
+        EMA and apply the grow/shrink policy with hysteresis.  The stall
+        is judged as a fraction of the inter-note interval (= the
+        consumer's step time, also EMA-tracked), so the policy adapts to
+        the step's own speed instead of a fixed millisecond bar."""
+        if not self._autoscale or self._closed:
+            return
+        now = time.monotonic()
+        if self._last_note is not None:
+            dt = now - self._last_note
+            self._interval_ema = dt if self._interval_ema is None \
+                else 0.2 * dt + 0.8 * self._interval_ema
+        self._last_note = now
+        ema = self._stall_ema
+        self._stall_ema = stall_s if ema is None \
+            else 0.2 * stall_s + 0.8 * ema
+        self._notes += 1
+        if self._notes < 8:  # warmup: first batches measure pool ramp
+            return
+        if now - self._last_scale < self._cooldown_s:
+            return
+        if not self._interval_ema or self._interval_ema <= 0:
+            return
+        frac = self._stall_ema / self._interval_ema
+        ema_ms = self._stall_ema * 1e3
+        if frac > self._grow_frac:
+            self._scale(+1, now, ema_ms)
+        elif frac < self._shrink_frac:
+            self._scale(-1, now, ema_ms)
+
+    def _scale(self, delta: int, now: float, ema_ms: float) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            cur = int(self._target.value)
+            n = min(max(cur + delta, self._min_procs), self._max_procs)
+            # reset the decision clock even at the bounds, so a pool
+            # pinned at max_procs doesn't spin the policy every note
+            self._last_scale = now
+            self._stall_ema = None
+            self._notes = 0
+            if n == cur:
+                return
+            self._target.value = n
+            if n > cur:
+                for wid in range(cur, n):
+                    p = self._workers.get(wid)
+                    if p is not None and p.is_alive():
+                        continue  # still draining its retirement
+                    self._spawn(wid)
+            # shrink: workers with wid >= n observe the target and retire
+            # after finishing their current claim; close() reaps them
+        _obs.registry().set_gauge("feed/reader_procs", n)
+        _obs.instant("feed.reader_scale", cat="feed", procs=n,
+                     stall_ms=round(ema_ms, 3))
+
+    # -- shutdown ----------------------------------------------------------
+
+    def __enter__(self) -> "ReaderPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        """Idempotent shutdown with the bounded-timeout discipline: stop,
+        drain (so a worker blocked mid-put can observe the flag), join
+        with timeouts, terminate stragglers.  Never blocks unbounded —
+        a worker that ignores SIGTERM is SIGKILLed."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        with self._lock:
+            workers = list(self._workers.values())
+        deadline = time.monotonic() + 5.0
+        while any(p.is_alive() for p in workers) \
+                and time.monotonic() < deadline:
+            try:
+                self._q.get(timeout=0.05)
+            except queue.Empty:
+                pass
+            except (OSError, ValueError):  # pragma: no cover - defensive
+                break
+        for p in workers:
+            p.join(timeout=1.0)
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=1.0)
+            if p.is_alive():  # pragma: no cover - SIGTERM-immune worker
+                p.kill()
+                p.join(timeout=1.0)
+        self._buf.clear()
+        reg = _obs.registry()
+        reg.inc("feed/reader_batches", self._delivered)
+
+
+# ---------------------------------------------------------------------------
+# dataset -> ReaderWork adapters
+# ---------------------------------------------------------------------------
+
+def _chain_stages(transformer) -> Optional[List[Any]]:
+    """Flatten a Transformer into its stage list, or None if opaque."""
+    from bigdl_tpu.dataset.transformer import (ChainedTransformer,
+                                               Transformer)
+    if isinstance(transformer, ChainedTransformer):
+        out: List[Any] = []
+        for s in transformer.stages:
+            sub = _chain_stages(s)
+            if sub is None:
+                return None
+            out.extend(sub)
+        return out
+    if isinstance(transformer, Transformer):
+        return [transformer]
+    return None
+
+
+def _elementwise_prefix(stages) -> bool:
+    """True when every pre-batch stage is 1:1 elementwise, so applying
+    the chain to one batch_size chunk of base elements yields exactly the
+    batch the streaming path would have built from those elements.  A
+    filtering/stateful custom Transformer would silently change batch
+    composition — reject those (the caller falls back to in-thread
+    assembly)."""
+    from bigdl_tpu.dataset.transformer import FnTransformer
+    return all(isinstance(s, FnTransformer) for s in stages)
+
+
+class _TransformChunkWork(ChunkWork):
+    """ChunkWork whose assemble runs `decode` per element then the
+    transformer chain over the chunk (exactly one SampleToMiniBatch group
+    per chunk, so chunk k == batch k of the streaming path)."""
+
+    def __init__(self, elements, batch_size, transformer, decode=None,
+                 keep_tail=False):
+        super().__init__(elements, batch_size, None, keep_tail=keep_tail)
+        self._transformer = transformer
+        self._decode = decode
+
+    def assemble(self, item):
+        elems = item if self._decode is None \
+            else [self._decode(e) for e in item]
+        batches = list(self._transformer(iter(elems)))
+        if len(batches) != 1:  # pragma: no cover - guarded by adapter
+            raise RuntimeError(
+                f"reader chunk produced {len(batches)} batches (expected "
+                f"1) — transformer chain is not chunk-aligned")
+        return batches[0]
+
+
+def _decode_image_entry(entry):
+    """(path, label) -> Sample, the ImageFolderDataSet.data decode moved
+    into the worker (module-level: picklable under spawn)."""
+    from PIL import Image
+
+    from bigdl_tpu.dataset.sample import Sample
+    p, label = entry
+    with Image.open(p) as im:
+        arr = np.asarray(im.convert("RGB"), np.float32)
+    return Sample(arr, None if label is None else np.int32(label))
+
+
+def reader_work_for(dataset, train: bool) -> Optional[ReaderWork]:
+    """Derive this epoch's ReaderWork from `dataset`, or None when its
+    assembly cannot be disaggregated safely (caller falls back to the
+    in-thread path; bitwise behaviour is then unchanged).
+
+    CONSUMES the epoch exactly like `dataset.data(train)` would: the
+    shuffle replay (`RandomState(seed + epoch)`) happens here in the
+    parent and the epoch counter advances, so seek_epoch/resume semantics
+    are identical pool on or off.
+    """
+    from bigdl_tpu.core.random import RandomGenerator
+    from bigdl_tpu.dataset.dataset import (ArrayDataSet, ImageFolderDataSet,
+                                           TransformedDataSet)
+    from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+
+    own = getattr(dataset, "reader_work", None)
+    if callable(own):
+        return own(train)
+    if not isinstance(dataset, TransformedDataSet):
+        return None
+    stages = _chain_stages(dataset.transformer)
+    if not stages or not isinstance(stages[-1], SampleToMiniBatch) \
+            or not _elementwise_prefix(stages[:-1]):
+        return None
+    smb: SampleToMiniBatch = stages[-1]
+    keep_tail = smb.pad_to_full or not smb.drop_remainder
+    base = dataset.base
+    if isinstance(base, ArrayDataSet):
+        if train:
+            idx = np.arange(len(base.items))
+            rs = np.random.RandomState(RandomGenerator.get_seed()
+                                       + base._epoch)
+            rs.shuffle(idx)
+            base._epoch += 1
+            elements = [base.items[i] for i in idx]
+        else:
+            elements = list(base.items)
+        return _TransformChunkWork(elements, smb.batch_size,
+                                   dataset.transformer, keep_tail=keep_tail)
+    if isinstance(base, ImageFolderDataSet):
+        entries = list(base.entries)
+        if train:
+            rs = np.random.RandomState(RandomGenerator.get_seed()
+                                       + base._epoch)
+            rs.shuffle(entries)
+            base._epoch += 1
+        return _TransformChunkWork(entries, smb.batch_size,
+                                   dataset.transformer,
+                                   decode=_decode_image_entry,
+                                   keep_tail=keep_tail)
+    # RecordShardDataSet is out: its multi-thread prefetch order is
+    # nondeterministic by design, so there is no single-process sequence
+    # to be bitwise-equal to
+    return None
+
+
+def make_reader_source(dataset, train: bool, procs: int,
+                       start_index: int = 0, autoscale: bool = False,
+                       max_procs: Optional[int] = None,
+                       name: str = "ReaderPool",
+                       **pool_kw) -> Optional[ReaderPool]:
+    """ReaderPool over `dataset`'s epoch, or None when the dataset's
+    assembly cannot be disaggregated (the caller keeps the in-thread
+    path).  Corrupt-record counts flow back into the dataset's
+    `_count_corrupt` so the trainer's CorruptRecords telemetry is
+    pool-agnostic."""
+    if procs < 1:
+        return None
+    work = reader_work_for(dataset, train)
+    if work is None:
+        return None
+    on_corrupt = getattr(dataset, "_count_corrupt", None)
+    return ReaderPool(work, procs=procs, start_index=start_index,
+                      autoscale=autoscale, max_procs=max_procs, name=name,
+                      on_corrupt=on_corrupt, **pool_kw)

@@ -364,6 +364,145 @@ class VarLenFeature:
             values.astype(self.dtype), (self.size,))
 
 
+def _dense_minibatch(parser, records, label_index, label_dtype,
+                     np_only: bool = False):
+    """Record buffer -> dense MiniBatch: the one assembly seam shared by
+    the in-thread path (`data()`) and the reader-process path
+    (`_ParsedExampleWork.assemble`).  `np_only` keeps every column on the
+    host (reader workers must not touch the forked jax backend; values
+    are bitwise-equal after the feed's staging put canonicalizes)."""
+    import numpy as _np
+
+    from bigdl_tpu.dataset.minibatch import MiniBatch
+
+    if np_only:
+        cols = parser.compute_np(records)
+    else:
+        cols = list(parser.compute(_np.asarray(records, dtype=object)))
+    y = _np.asarray(cols[label_index]).astype(label_dtype)
+    xs = [c for i, c in enumerate(cols) if i != label_index]
+    return MiniBatch(xs[0] if len(xs) == 1 else tuple(xs), y)
+
+
+def _sparse_minibatch(records, dense_keys, dense_shapes, label_key,
+                      label_dtype, sparse_features, feature_padding):
+    """Per-record parse -> Sample(dense..., SparseFeature...) ->
+    SparseMiniBatch (densified at this batch boundary).  Module-level and
+    numpy-only for the same reader-process reason as _dense_minibatch."""
+    import numpy as _np
+
+    from bigdl_tpu.dataset.minibatch import SparseMiniBatch
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.nn.tf_ops import parse_example_proto
+
+    samples = []
+    for rec in records:
+        feats = parse_example_proto(bytes(rec))
+        parts = []
+        label = None
+        for k, sh in zip(dense_keys, dense_shapes):
+            v = _np.asarray(feats[k]).reshape(sh)
+            if k == label_key:
+                label = v.astype(label_dtype)
+            else:
+                parts.append(v)
+        for sf in sparse_features:
+            parts.append(sf.to_sparse(feats.get(sf.key, ())))
+        samples.append(Sample(tuple(parts) if len(parts) > 1
+                              else parts[0], label))
+    return SparseMiniBatch.from_samples(
+        samples, feature_padding=feature_padding)
+
+
+class _ParsedExampleWork:
+    """ReaderWork (dataset/readers.py protocol) over TFRecord Example
+    shards: items are full record buffers (cheap framing reads + the
+    reservoir-shuffle replay), assemble is the proto parse -> MiniBatch
+    stack (the expensive stage).
+
+    Determinism: records stream through the SEQUENTIAL framing reader
+    (`read_tfrecords` per shard, in the parent's shuffled path order) —
+    never the native multi-thread prefetcher, whose cross-shard order is
+    a thread race.  Every worker therefore sees the identical record
+    stream and batch `k` is a pure function of (paths, rs, k), which is
+    what makes procs=1 vs procs=N bitwise-equal.  The `skip_corrupt`
+    resync policy applies per shard exactly as in-thread."""
+
+    def __init__(self, paths, batch_size, dense_keys, dense_shapes,
+                 label_key, label_dtype, sparse_features, feature_padding,
+                 skip_corrupt, rs):
+        self.paths = list(paths)
+        self.batch_size = int(batch_size)
+        self.dense_keys = list(dense_keys)
+        self.dense_shapes = [tuple(s) for s in dense_shapes]
+        self.label_key = label_key
+        self.label_dtype = label_dtype
+        self.sparse_features = list(sparse_features)
+        self.feature_padding = feature_padding
+        self.skip_corrupt = bool(skip_corrupt)
+        self._rs = rs  # post-path-shuffle RandomState (None for eval)
+        self._li = self.dense_keys.index(label_key)
+        self._corrupt = 0
+        self._parser = None  # built lazily in the worker
+
+    def corrupt_count(self) -> int:
+        return self._corrupt
+
+    def _bump_corrupt(self, n: int) -> None:
+        self._corrupt += int(n)
+
+    def item_stream(self, start: int):
+        rs = self._rs
+
+        def records():
+            for p in self.paths:
+                yield from read_tfrecords(p, skip_corrupt=self.skip_corrupt,
+                                          on_corrupt=self._bump_corrupt)
+
+        def shuffled():
+            it = records()
+            if rs is None:
+                yield from it
+                return
+            # the reservoir window replay: same rs draws per record as
+            # ParsedExampleDataSet.data, so the shuffled stream (and the
+            # rs state) is identical in every worker
+            window: List[bytes] = []
+            cap = max(4 * self.batch_size, 1024)
+            for rec in it:
+                window.append(rec)
+                if len(window) >= cap:
+                    k = rs.randint(len(window))
+                    window[k], window[-1] = window[-1], window[k]
+                    yield window.pop()
+            rs.shuffle(window)
+            yield from window
+
+        buf: List[bytes] = []
+        k = 0
+        for rec in shuffled():
+            buf.append(rec)
+            if len(buf) == self.batch_size:
+                if k >= start:
+                    yield buf
+                buf = []
+                k += 1
+        # trailing partial batch dropped, as in data()
+
+    def assemble(self, records):
+        if self.sparse_features:
+            return _sparse_minibatch(records, self.dense_keys,
+                                     self.dense_shapes, self.label_key,
+                                     self.label_dtype, self.sparse_features,
+                                     self.feature_padding)
+        if self._parser is None:
+            from bigdl_tpu.nn.tf_ops import ParseExample
+
+            self._parser = ParseExample(self.dense_keys, self.dense_shapes)
+        return _dense_minibatch(self._parser, records, self._li,
+                                self.label_dtype, np_only=True)
+
+
 class ParsedExampleDataSet(DataSet):
     """TFRecord shards of serialized tf.train.Examples -> MiniBatches via
     the host-side ParseExample op: the imported-graph training data path
@@ -473,36 +612,36 @@ class ParsedExampleDataSet(DataSet):
                 if self.sparse_features:
                     yield self._sparse_batch(buf)
                 else:
-                    cols = list(self._parser.compute(
-                        _np.asarray(buf, dtype=object)))
-                    y = _np.asarray(cols[li]).astype(self.label_dtype)
-                    xs = [c for i, c in enumerate(cols) if i != li]
-                    yield MiniBatch(xs[0] if len(xs) == 1 else tuple(xs), y)
+                    yield _dense_minibatch(self._parser, buf, li,
+                                           self.label_dtype)
                 buf = []
 
-    def _sparse_batch(self, records: Sequence[bytes]):
-        """Per-record parse -> Sample(dense..., SparseFeature...) ->
-        SparseMiniBatch (densified at this batch boundary)."""
+    def reader_work(self, train: bool) -> "_ParsedExampleWork":
+        """This epoch's assembly as ReaderWork for `readers.ReaderPool`.
+        Consumes the epoch exactly like `data(train)`: the path shuffle
+        runs HERE (same RandomState draws) and `_epoch` advances, so
+        seek_epoch + skip-batches resume behaves identically pool on or
+        off.  The post-shuffle rs ships to the workers, whose reservoir
+        replay continues its state."""
         import numpy as _np
 
-        from bigdl_tpu.dataset.minibatch import SparseMiniBatch
-        from bigdl_tpu.dataset.sample import Sample
-        from bigdl_tpu.nn.tf_ops import parse_example_proto
+        from bigdl_tpu.core.random import RandomGenerator
 
-        samples = []
-        for rec in records:
-            feats = parse_example_proto(bytes(rec))
-            parts = []
-            label = None
-            for k, sh in zip(self.dense_keys, self._dense_shapes):
-                v = _np.asarray(feats[k]).reshape(sh)
-                if k == self.label_key:
-                    label = v.astype(self.label_dtype)
-                else:
-                    parts.append(v)
-            for sf in self.sparse_features:
-                parts.append(sf.to_sparse(feats.get(sf.key, ())))
-            samples.append(Sample(tuple(parts) if len(parts) > 1
-                                  else parts[0], label))
-        return SparseMiniBatch.from_samples(
-            samples, feature_padding=self.feature_padding)
+        paths = list(self.paths)
+        rs = None
+        if train:
+            rs = _np.random.RandomState(RandomGenerator.get_seed()
+                                        + self._epoch)
+            rs.shuffle(paths)
+            self._epoch += 1
+        return _ParsedExampleWork(paths, self.batch_size, self.dense_keys,
+                                  self._dense_shapes, self.label_key,
+                                  self.label_dtype, self.sparse_features,
+                                  self.feature_padding, self.skip_corrupt,
+                                  rs)
+
+    def _sparse_batch(self, records: Sequence[bytes]):
+        return _sparse_minibatch(records, self.dense_keys,
+                                 self._dense_shapes, self.label_key,
+                                 self.label_dtype, self.sparse_features,
+                                 self.feature_padding)

@@ -458,7 +458,10 @@ class ParseSingleExample(Operation):
         self.dense_shapes = ([tuple(s) for s in dense_shapes]
                              if dense_shapes else None)
 
-    def _one(self, buf: bytes) -> List[Any]:
+    def _one_np(self, buf: bytes) -> List[Any]:
+        """Numpy-only per-record parse: the host half of `_one`.  Reader
+        worker PROCESSES (dataset/readers.py) assemble batches with this —
+        a forked child must never touch the inherited jax backend."""
         feats = parse_example_proto(bytes(buf))
         row = []
         for i, k in enumerate(self.dense_keys):
@@ -468,8 +471,12 @@ class ParseSingleExample(Operation):
                 continue
             if self.dense_shapes:
                 v = v.reshape(self.dense_shapes[i])
-            row.append(jnp.asarray(v))
+            row.append(np.asarray(v))
         return row
+
+    def _one(self, buf: bytes) -> List[Any]:
+        return [r if r.dtype == object else jnp.asarray(r)
+                for r in self._one_np(buf)]
 
     def compute(self, x):
         buf = x if isinstance(x, (bytes, bytearray)) else bytes(
@@ -492,6 +499,15 @@ class ParseExample(ParseSingleExample):
             else:
                 cols.append(jnp.stack(vals))
         return Table(*cols)
+
+    def compute_np(self, bufs: Sequence[bytes]) -> List[np.ndarray]:
+        """Batch parse with HOST stacking only: same `_one_np` rows as
+        `compute`, but no jnp — values land on device later via the
+        feed's staging put (bitwise-equal after dtype canonicalization).
+        This is the reader-process assembly path."""
+        rows = [self._one_np(bytes(b)) for b in bufs]
+        return [np.stack([r[i] for r in rows])
+                for i in range(len(self.dense_keys))]
 
 
 # ---------------------------------------------------------------------------

@@ -309,6 +309,10 @@ class Optimizer:
         self.val_summary: Optional[ValidationSummary] = None
         # input feed: None = Engine.config().feed_depth; 0 = synchronous
         self.feed_depth: Optional[int] = None
+        # disaggregated readers: None = Engine.config().reader_procs;
+        # 0 = in-thread assembly (dataset/readers.py)
+        self.reader_procs: Optional[int] = None
+        self.reader_autoscale: Optional[bool] = None
         # strict-transfer debug guard: None = BIGDL_TPU_STRICT_TRANSFERS
         self._strict_transfers: Optional[bool] = None
         # gradient processing
@@ -482,16 +486,35 @@ class Optimizer:
         self.processors = []
         return self
 
-    def set_feed(self, prefetch_depth: int) -> "Optimizer":
-        """Input-feed prefetch depth: how many batches the DeviceFeed
-        worker assembles and stages on the mesh AHEAD of the step loop,
+    def set_feed(self, prefetch_depth: Optional[int] = None,
+                 reader_procs: Optional[int] = None,
+                 reader_autoscale: Optional[bool] = None) -> "Optimizer":
+        """Input-feed wiring: prefetch depth and the reader-process pool.
+
+        `prefetch_depth` — how many batches the DeviceFeed worker
+        assembles and stages on the mesh AHEAD of the step loop,
         overlapping host collate + H2D transfer with in-flight device
         compute (dataset/feed.py).  0 forces synchronous staging (the
         bitwise-identical baseline); default comes from
-        `BIGDL_TPU_FEED_DEPTH` (2).  Batch order, RNG folding and losses
-        are identical either way — the feed only moves WHERE the staging
-        work runs."""
-        self.feed_depth = int(prefetch_depth)
+        `BIGDL_TPU_FEED_DEPTH` (2).
+
+        `reader_procs` — batch ASSEMBLY moves into this many reader
+        processes (dataset/readers.py), feeding the same DeviceFeed
+        staging path through the reorder stage.  0 keeps assembly
+        in-thread; default comes from `BIGDL_TPU_READER_PROCS` (0).
+        `reader_autoscale` turns the stall-driven autoscaler on/off
+        within [1, reader_procs] (`BIGDL_TPU_READER_AUTOSCALE`, on).
+
+        Batch order, RNG folding and losses are identical under every
+        combination — the feed/readers only move WHERE the assembly and
+        staging work runs (datasets whose assembly cannot be
+        disaggregated silently keep the in-thread path)."""
+        if prefetch_depth is not None:
+            self.feed_depth = int(prefetch_depth)
+        if reader_procs is not None:
+            self.reader_procs = int(reader_procs)
+        if reader_autoscale is not None:
+            self.reader_autoscale = bool(reader_autoscale)
         return self
 
     def set_profile(self, enabled: bool = True) -> "Optimizer":
@@ -970,7 +993,12 @@ class Optimizer:
         self._driver_state.update(driver)
         # mid-epoch checkpoints record how far into the epoch they are;
         # the epoch loop replays the SAME shuffled order (seek_epoch) and
-        # skips exactly this many batches before training resumes
+        # skips exactly this many batches before training resumes.  No
+        # reader-pool state survives a restore: the pool is per-epoch
+        # (closed in the epoch's finally before the restart ladder runs)
+        # and the next epoch builds a fresh one whose workers start
+        # claiming at this skip index — the reorder stage makes the
+        # resumed sequence bitwise-equal to the uninterrupted run.
         self._resume_skip = int(driver.get("epoch_batch", 0) or 0)
 
     def resume_from(self, ckpt_path: str) -> "Optimizer":
@@ -1013,6 +1041,39 @@ class Optimizer:
         if self.feed_depth is not None:
             return max(0, self.feed_depth)
         return max(0, Engine.config().feed_depth)
+
+    def _reader_procs(self) -> int:
+        if self.reader_procs is not None:
+            return max(0, self.reader_procs)
+        return max(0, Engine.config().reader_procs)
+
+    def _reader_autoscale(self) -> bool:
+        if self.reader_autoscale is not None:
+            return self.reader_autoscale
+        return bool(Engine.config().reader_autoscale)
+
+    def _make_train_source(self, skip: int):
+        """This epoch's batch source: a ReaderPool when the disaggregated
+        input plane is on AND the dataset's assembly can move out of
+        process, else the in-thread `data(train=True)` generator.  Either
+        way the epoch is consumed exactly once (the pool adapter replays
+        the same shuffle draws data() would), and a resume skip lands as
+        the pool's `start_index` — workers skip ITEMS cheaply instead of
+        assembling and discarding `skip` batches."""
+        procs = self._reader_procs()
+        if procs > 0:
+            from bigdl_tpu.dataset.readers import make_reader_source
+
+            pool = make_reader_source(
+                self.dataset, True, procs=procs, start_index=skip,
+                autoscale=self._reader_autoscale(), max_procs=procs,
+                name="ReaderPool-train")
+            if pool is not None:
+                return pool, pool
+        src = self.dataset.data(train=True)
+        if skip:
+            src = _skip_batches(src, skip)
+        return src, None
 
     def _stage_batch(self, batch: MiniBatch):
         """Assembly hand-off -> device staging, run in the feed worker:
@@ -1061,6 +1122,7 @@ class Optimizer:
             depth = min(depth, max(0, wd.config.max_lag))
         feed_depth = self._feed_depth()
         feed_ref = [None]  # current epoch's feed, for drain-side telemetry
+        reader_ref = [None]  # current epoch's ReaderPool (None = in-thread)
         # (epoch, neval, bs, slot, ring_snapshot, feed_stall_s, feed_occ)
         pending = deque()
         drain_clock = [time.perf_counter(), 1.0]  # [last drain t, last dt]
@@ -1193,6 +1255,19 @@ class Optimizer:
                     1e3 * sum(e[5] for e in burst) / len(burst),
                     sum(e[6] for e in burst) / len(burst),
                     feed.prefetch_depth, asm)
+            pool = reader_ref[0]
+            if pool is not None:
+                # reader-pool telemetry on the same drain cadence: the
+                # autoscaler's current target (gauge also set at each
+                # scale decision; this keeps it fresh when idle)
+                n_procs = pool.procs
+                self.metrics.set("reader procs", n_procs)
+                obs_reg.set_gauge("feed/reader_procs", n_procs)
+                if self.train_summary is not None:
+                    last_it = burst[-1][1]
+                    if self.train_summary.should_log("ReaderProcs", last_it):
+                        self.train_summary.add_scalar(
+                            "ReaderProcs", n_procs, last_it)
             # tfrecord skip_corrupt telemetry: surface newly skipped
             # records through the same drain cadence as the feed stats
             corrupt = int(getattr(self.dataset, "corrupt_records", 0) or 0)
@@ -1217,18 +1292,19 @@ class Optimizer:
             seek = getattr(self.dataset, "seek_epoch", None)
             if callable(seek):
                 seek(state["epoch"])
-            src = self.dataset.data(train=True)
             skip = int(self._resume_skip or 0)
             self._resume_skip = 0
             if skip:
                 # mid-epoch resume: drop the batches the checkpoint
-                # already trained on (assembly of the skipped batches runs
-                # lazily in the feed worker, off the hot path)
+                # already trained on (in-thread: assembly of the skipped
+                # batches runs lazily in the feed worker; pool: workers
+                # skip the cheap item stream and assemble nothing)
                 logger.info("resume: skipping %d already-trained batch(es) "
                             "of epoch %d", skip, state["epoch"] + 1)
-                src = _skip_batches(src, skip)
             else:
                 state["epoch_batch"] = 0
+            src, reader_pool = self._make_train_source(skip)
+            reader_ref[0] = reader_pool
             # batch assembly (iteration -> transformer chain -> stack) and
             # the H2D put run in the feed worker, `feed_depth` batches
             # ahead of the dispatch head; the bounded queue backpressures
@@ -1357,7 +1433,14 @@ class Optimizer:
                         drain_clock[0] = min(time.perf_counter(),
                                              drain_clock[0] + dt_cb)
             finally:
+                # close-through: a ReaderPool source is torn down inside
+                # feed.close() (before the join, so a worker parked on the
+                # pool unblocks); the explicit pool.close() is idempotent
+                # insurance for a feed that failed to construct
                 feed.close()
+                if reader_pool is not None:
+                    reader_pool.close()
+                    reader_ref[0] = None
             # epoch boundary: under async depth the backlog can ride
             # across epochs (deterministic triggers never read
             # state['loss']); the synchronous path (depth=0) still

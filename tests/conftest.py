@@ -55,15 +55,20 @@ def strict_transfers():
 
 @pytest.fixture(autouse=True)
 def _thread_leak_guard():
-    """No worker thread may survive a test: a DeviceFeed (or any new
-    non-daemon thread) still alive after the test body means a close()
-    path is broken — the class of leak that deadlocks interpreter exit or
-    poisons the next test's timing.  Pre-existing threads (pytest's own,
-    library pools started at import) are exempt via the snapshot."""
+    """No worker thread OR reader process may survive a test: a DeviceFeed
+    thread (or any new non-daemon thread) still alive after the test body
+    means a close() path is broken — the class of leak that deadlocks
+    interpreter exit or poisons the next test's timing — and an orphaned
+    reader child (dataset/readers.py worker) keeps assembling batches
+    into a dead pipe forever.  Pre-existing threads (pytest's own, library
+    pools started at import) and pre-existing children are exempt via the
+    snapshots."""
+    import multiprocessing
     import threading
     import time
 
     before = set(threading.enumerate())
+    procs_before = {p.pid for p in multiprocessing.active_children()}
 
     def offenders():
         return [t for t in threading.enumerate()
@@ -73,16 +78,26 @@ def _thread_leak_guard():
                                            "serving-batcher",
                                            "HealthWatchdog")))]
 
+    def child_offenders():
+        # active_children() also reaps finished children; any new child
+        # still alive past the grace period is a pool-shutdown bug
+        return [p for p in multiprocessing.active_children()
+                if p.pid not in procs_before and p.is_alive()]
+
     yield
     # grace for threads mid-shutdown (close() joins, but a worker that
     # observed the stop flag may need a scheduler tick to finish dying)
     deadline = time.time() + 2.0
-    while offenders() and time.time() < deadline:
+    while (offenders() or child_offenders()) and time.time() < deadline:
         time.sleep(0.01)
     leaked = offenders()
     assert not leaked, (
         f"worker threads leaked past the test: "
         f"{[(t.name, t.daemon) for t in leaked]}")
+    leaked_procs = child_offenders()
+    assert not leaked_procs, (
+        f"reader processes leaked past the test: "
+        f"{[(p.name, p.pid) for p in leaked_procs]}")
 
 
 def pytest_configure(config):

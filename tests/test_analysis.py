@@ -311,6 +311,109 @@ class Pump:
 """
         assert "concurrency" not in _rules(src)
 
+    # -- multiprocessing idioms (reader-pool family) -------------------
+
+    def test_positive_process_without_daemon_or_join(self):
+        src = """
+import multiprocessing as mp
+
+class Pool:
+    def __init__(self):
+        self._p = mp.Process(target=self._run)
+        self._p.start()
+
+    def _run(self):
+        pass
+"""
+        assert "concurrency" in _rules(src)
+
+    def test_positive_unbounded_mp_queue_get(self):
+        src = """
+import multiprocessing as mp
+
+class Pool:
+    def __init__(self):
+        self._ctx = mp.get_context("fork")
+        self._q = self._ctx.Queue(maxsize=4)
+        self._p = self._ctx.Process(target=self._run, daemon=True)
+        self._p.start()
+
+    def _run(self):
+        pass
+
+    def __next__(self):
+        return self._q.get()
+
+    def close(self):
+        self._p.join(timeout=1.0)
+"""
+        assert "concurrency" in _rules(src)
+
+    def test_positive_unbounded_process_join_on_shutdown(self):
+        src = """
+import multiprocessing as mp
+
+class Pool:
+    def __init__(self):
+        self._p = mp.Process(target=self._run, daemon=True)
+        self._p.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        self._p.join()
+"""
+        assert "concurrency" in _rules(src)
+
+    def test_negative_mp_full_discipline(self):
+        src = """
+import queue
+import multiprocessing as mp
+
+class Pool:
+    def __init__(self):
+        self._ctx = mp.get_context("fork")
+        self._q = self._ctx.Queue(maxsize=4)
+        self._p = self._ctx.Process(target=self._run, daemon=True)
+        self._p.start()
+
+    def _run(self):
+        pass
+
+    def __next__(self):
+        try:
+            return self._q.get(timeout=0.05)
+        except queue.Empty:
+            return None
+
+    def close(self):
+        self._p.join(timeout=1.0)
+        if self._p.is_alive():
+            self._p.terminate()
+"""
+        assert "concurrency" not in _rules(src)
+
+    def test_negative_unbounded_thread_join_outside_process_scope(self):
+        # the unbounded-join shutdown rule is scoped to process-owning
+        # classes: a thread-owning class keeps the (join-with-timeout)
+        # guidance but plain join() alone is not flagged there
+        src = """
+import threading
+
+class Pump:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        self._t.join()
+"""
+        assert "concurrency" not in _rules(src)
+
 
 # ----------------------------------------------------------------------
 # rule family: donation

@@ -1,0 +1,506 @@
+"""Disaggregated reader pool (dataset/readers.py) — ISSUE 9.
+
+Pins the load-bearing properties of the multi-process input plane:
+  * strict-order delivery: batch k's CONTENT is a pure function of
+    (work, k), so procs=1 and procs=4 epoch sequences are bitwise-equal
+    (the reorder stage, not a static worker:shard map, owns determinism);
+  * resume: `start_index` makes workers skip cheap items, and the pooled
+    kill->resume trajectory stays bitwise-equal to the uninterrupted run
+    (chaos lane);
+  * failure: a worker that dies — exception or SIGKILL, even with the
+    queue full — surfaces as ReaderWorkerError from the consumer within
+    a bounded time instead of deadlocking DeviceFeed shutdown;
+  * lifecycle: close() reaps every child (conftest's process-leak guard
+    backstops all tests here), and the feed-off InlineFeed path closes
+    through the same way;
+  * autoscale: the stall EMA grows/shrinks the worker count with
+    hysteresis and exports the `feed/reader_procs` gauge.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import optim
+from bigdl_tpu.core.random import RandomGenerator
+from bigdl_tpu.dataset import (ArrayDataSet, MiniBatch, Sample,
+                               SampleToMiniBatch)
+from bigdl_tpu.dataset.feed import DeviceFeed, InlineFeed
+from bigdl_tpu.dataset.readers import (ChunkWork, ReaderPool,
+                                       ReaderWorkerError, make_reader_source,
+                                       reader_work_for)
+from bigdl_tpu.dataset.tfrecord import ParsedExampleDataSet, TFRecordWriter
+from bigdl_tpu.dataset.transformer import FnTransformer, Transformer
+from bigdl_tpu.nn.tf_ops import build_example_proto
+from bigdl_tpu.optim import SGD, Trigger
+
+
+def _ident_chunks(n=23, chunk=4):
+    return ChunkWork(list(range(n)), chunk,
+                     lambda c: np.asarray(c, np.int64))
+
+
+def _class_ds(n=96, dim=6, classes=3, batch=16, seed=0):
+    centers = np.random.RandomState(99).randn(classes, dim).astype(np.float32) * 3
+    rs = np.random.RandomState(seed)
+    samples = [Sample.from_ndarray(
+        centers[i % classes] + rs.randn(dim).astype(np.float32) * 0.3,
+        np.int32(i % classes)) for i in range(n)]
+    return ArrayDataSet(samples).transform(SampleToMiniBatch(batch))
+
+
+def _mlp(dim=6, classes=3):
+    return nn.Sequential(nn.Linear(dim, 16), nn.ReLU(),
+                         nn.Linear(16, classes), nn.LogSoftMax())
+
+
+def _write_shards(tmp_path, n_shards=3, per_shard=40, dim=4):
+    rs = np.random.RandomState(0)
+    paths = []
+    for s in range(n_shards):
+        p = str(tmp_path / f"shard{s}.tfrecord")
+        with TFRecordWriter(p) as w:
+            for i in range(per_shard):
+                w.write(build_example_proto(
+                    {"x": rs.randn(dim).astype(np.float32),
+                     "y": np.asarray([s * per_shard + i], np.int64)}))
+        paths.append(p)
+    return paths
+
+
+def _parsed_ds(paths, batch=8, dim=4):
+    # skip_corrupt=True routes through the sequential python framing
+    # reader on the inline path too, so pool-vs-inline is apples to apples
+    return ParsedExampleDataSet(paths, batch_size=batch,
+                                dense_keys=["x", "y"],
+                                dense_shapes=[(dim,), ()], label_key="y",
+                                skip_corrupt=True)
+
+
+def _batches(it):
+    return [(np.asarray(b.get_input()), np.asarray(b.get_target()))
+            for b in it]
+
+
+def _assert_batches_equal(a, b):
+    assert len(a) == len(b)
+    for i, ((xa, ya), (xb, yb)) in enumerate(zip(a, b)):
+        assert xa.dtype == xb.dtype and ya.dtype == yb.dtype
+        np.testing.assert_array_equal(xa, xb, err_msg=f"batch {i} input")
+        np.testing.assert_array_equal(ya, yb, err_msg=f"batch {i} target")
+
+
+# ----------------------------------------------------------------------
+# ChunkWork / pool unit behaviour
+# ----------------------------------------------------------------------
+
+class TestChunkWork:
+    def test_len_and_tail(self):
+        assert len(ChunkWork(list(range(10)), 4, None)) == 2
+        assert len(ChunkWork(list(range(10)), 4, None, keep_tail=True)) == 3
+        assert len(ChunkWork(list(range(8)), 4, None, keep_tail=True)) == 2
+
+    def test_item_stream_slices(self):
+        w = ChunkWork(list(range(10)), 3, None, keep_tail=True)
+        assert list(w.item_stream(0)) == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+        assert list(w.item_stream(2)) == [[6, 7, 8], [9]]
+
+
+class TestReaderPoolUnit:
+    def test_strict_order_multi_proc(self):
+        with ReaderPool(_ident_chunks(), procs=2) as pool:
+            got = list(pool)
+        assert len(got) == 5
+        for k, g in enumerate(got):
+            np.testing.assert_array_equal(
+                g, np.asarray(list(range(23))[k * 4:(k + 1) * 4], np.int64))
+
+    def test_start_index_resume_skip(self):
+        with ReaderPool(_ident_chunks(), procs=2, start_index=3) as pool:
+            got = list(pool)
+        assert [list(g) for g in got] == [[12, 13, 14, 15], [16, 17, 18, 19]]
+
+    def test_worker_exception_surfaces_with_traceback(self):
+        def boom(chunk):
+            raise ValueError("kaput record")
+
+        with ReaderPool(ChunkWork(list(range(8)), 2, boom), procs=2) as pool:
+            with pytest.raises(ReaderWorkerError, match="kaput record"):
+                next(iter(pool))
+
+    def test_sigkilled_worker_surfaces_not_hangs(self):
+        def slow(chunk):
+            time.sleep(0.005)
+            return np.asarray(chunk)
+
+        pool = ReaderPool(ChunkWork(list(range(4000)), 2, slow), procs=2)
+        it = iter(pool)
+        next(it)
+        for p in list(pool._workers.values()):
+            p.kill()
+        t0 = time.monotonic()
+        with pytest.raises(ReaderWorkerError, match="died"):
+            for _ in range(5000):
+                next(it)
+        assert time.monotonic() - t0 < 10.0
+        pool.close()
+
+    def test_close_mid_stream_is_bounded_and_idempotent(self):
+        pool = ReaderPool(_ident_chunks(n=4000, chunk=2), procs=3)
+        it = iter(pool)
+        for _ in range(3):
+            next(it)
+        t0 = time.monotonic()
+        pool.close()
+        pool.close()
+        assert time.monotonic() - t0 < 8.0
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_window_bounds_claims(self):
+        # claim ceiling = served + window: with the consumer stopped,
+        # workers cannot run away past the window
+        pool = ReaderPool(_ident_chunks(n=4000, chunk=2), procs=2, window=4)
+        try:
+            time.sleep(0.5)
+            assert int(pool._claim.value) <= 4
+        finally:
+            pool.close()
+
+
+# ----------------------------------------------------------------------
+# dataset adapters: deterministic resharding
+# ----------------------------------------------------------------------
+
+class TestDatasetAdapters:
+    def test_array_dataset_pool_matches_inline(self):
+        RandomGenerator.set_seed(1234)
+        inline = _batches(_class_ds().data(train=True))
+        RandomGenerator.set_seed(1234)
+        src = make_reader_source(_class_ds(), train=True, procs=3)
+        assert src is not None
+        with src:
+            pooled = _batches(src)
+        _assert_batches_equal(inline, pooled)
+
+    def test_array_dataset_procs_1_vs_4_bitwise(self):
+        def epoch(procs):
+            RandomGenerator.set_seed(7)
+            ds = _class_ds()
+            out = []
+            for _ in range(2):  # two epochs: the shuffle replay advances
+                with make_reader_source(ds, train=True, procs=procs) as src:
+                    out.append(_batches(src))
+            return out
+
+        a, b = epoch(1), epoch(4)
+        for ea, eb in zip(a, b):
+            _assert_batches_equal(ea, eb)
+        # and the two epochs genuinely reshuffled
+        assert not np.array_equal(a[0][0][0], a[1][0][0])
+
+    def test_transform_chain_applies_in_workers(self):
+        RandomGenerator.set_seed(5)
+        ds = (ArrayDataSet([Sample.from_ndarray(
+            np.full((3,), i, np.float32), np.int32(i)) for i in range(32)])
+            .transform(FnTransformer(lambda s: Sample(s.feature * 2.0,
+                                                      s.label)))
+            .transform(SampleToMiniBatch(8)))
+        with make_reader_source(ds, train=False, procs=2) as src:
+            got = _batches(src)
+        assert len(got) == 4
+        # FnTransformer ran: features are doubled
+        np.testing.assert_array_equal(got[0][0][0], np.full((3,), 0.0))
+        np.testing.assert_array_equal(got[1][0][0], np.full((3,), 16.0))
+
+    def test_opaque_transformer_falls_back(self):
+        class Stateful(Transformer):
+            def __call__(self, it):
+                for i, s in enumerate(it):
+                    if i % 2 == 0:  # filtering: not chunk-alignable
+                        yield s
+
+        ds = (ArrayDataSet([Sample.from_ndarray(np.zeros(2, np.float32),
+                                                np.int32(0))] * 16)
+              .transform(Stateful())
+              .transform(SampleToMiniBatch(4)))
+        assert reader_work_for(ds, train=False) is None
+        assert make_reader_source(ds, train=False, procs=2) is None
+
+    def test_zero_procs_means_no_pool(self):
+        assert make_reader_source(_class_ds(), train=True, procs=0) is None
+
+
+class TestParsedExampleReaders:
+    def test_pool_matches_inline_and_procs_reshard(self, tmp_path):
+        paths = _write_shards(tmp_path)
+        RandomGenerator.set_seed(42)
+        inline = _batches(_parsed_ds(paths).data(train=True))
+
+        def pooled_epoch(procs):
+            RandomGenerator.set_seed(42)
+            ds = _parsed_ds(paths)
+            with ReaderPool(ds.reader_work(train=True), procs=procs,
+                            on_corrupt=ds._count_corrupt) as pool:
+                return _batches(pool)
+
+        one, four = pooled_epoch(1), pooled_epoch(4)
+        _assert_batches_equal(inline, one)
+        _assert_batches_equal(one, four)
+
+    def test_corrupt_record_counted_once_across_workers(self, tmp_path):
+        import struct
+
+        paths = _write_shards(tmp_path, n_shards=2, per_shard=24)
+        # flip one payload byte of shard0's first record: framing stays
+        # intact, data crc mismatches, skip_corrupt resyncs past it
+        with open(paths[0], "r+b") as fh:
+            header = fh.read(12)
+            (length,) = struct.unpack("<Q", header[:8])
+            fh.seek(12 + length // 2)
+            b0 = fh.read(1)
+            fh.seek(12 + length // 2)
+            fh.write(bytes([b0[0] ^ 0xFF]))
+        ds = _parsed_ds(paths)
+        with ReaderPool(ds.reader_work(train=False), procs=3,
+                        on_corrupt=ds._count_corrupt) as pool:
+            n = sum(1 for _ in pool)
+        # every worker reads the same stream; the parent must route the
+        # MAX cumulative count (1), not the sum across workers (3)
+        assert ds.corrupt_records == 1
+        assert n == (2 * 24 - 1) // 8
+
+
+# ----------------------------------------------------------------------
+# DeviceFeed integration: the shutdown-ordering regression
+# ----------------------------------------------------------------------
+
+class TestFeedIntegration:
+    def test_feed_over_pool_strict_order(self):
+        pool = ReaderPool(_ident_chunks(n=40, chunk=4), procs=2)
+        with DeviceFeed(pool, put_fn=lambda b: b * 10,
+                        prefetch_depth=2) as feed:
+            got = [item.payload for item in feed]
+        assert len(got) == 10
+        for k, g in enumerate(got):
+            np.testing.assert_array_equal(
+                g, np.asarray(list(range(40))[k * 4:(k + 1) * 4],
+                              np.int64) * 10)
+
+    def test_worker_killed_with_queue_full_no_deadlock(self):
+        """THE regression this PR fixes in DeviceFeed shutdown ordering:
+        reader children SIGKILLed while the bounded queues are full must
+        surface the worker's failure at the consumer within a bounded
+        time — and feed.close() must reap everything — instead of the
+        consumer and the feed join deadlocking against a dead producer."""
+        def slow(chunk):
+            time.sleep(0.005)
+            return np.asarray(chunk)
+
+        pool = ReaderPool(ChunkWork(list(range(4000)), 2, slow), procs=2,
+                          window=4)
+        feed = DeviceFeed(pool, put_fn=lambda b: b, prefetch_depth=1)
+        it = iter(feed)
+        next(it)
+        time.sleep(0.3)  # queues fill: workers block mid-put
+        for p in list(pool._workers.values()):
+            p.kill()
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError) as ei:
+            for _ in range(10_000):
+                next(it)
+        assert time.monotonic() - t0 < 10.0
+        assert isinstance(ei.value.__cause__, ReaderWorkerError)
+        t0 = time.monotonic()
+        feed.close()
+        assert time.monotonic() - t0 < 8.0
+
+    def test_early_break_tears_down_pool_through_feed_close(self):
+        pool = ReaderPool(_ident_chunks(n=4000, chunk=2), procs=3)
+        feed = DeviceFeed(pool, put_fn=lambda b: b, prefetch_depth=2)
+        it = iter(feed)
+        for _ in range(3):
+            next(it)
+        feed.close()  # close-through: no explicit pool.close() needed
+        assert pool._closed
+        assert all(not p.is_alive() for p in pool._workers.values())
+
+    def test_inline_feed_closes_through(self):
+        pool = ReaderPool(_ident_chunks(n=400, chunk=2), procs=2)
+        feed = InlineFeed(pool, put_fn=lambda b: b)
+        next(iter(feed))
+        feed.close()
+        assert pool._closed
+
+
+# ----------------------------------------------------------------------
+# autoscaler
+# ----------------------------------------------------------------------
+
+class TestAutoscaler:
+    def test_grows_under_stall_and_exports_gauge(self):
+        from bigdl_tpu import obs as _obs
+
+        def slow(chunk):
+            time.sleep(0.002)
+            return np.asarray(chunk)
+
+        pool = ReaderPool(ChunkWork(list(range(4000)), 2, slow), procs=1,
+                          max_procs=3, autoscale=True, cooldown_s=0.05)
+        try:
+            it = iter(pool)
+            for _ in range(60):
+                next(it)
+                pool.note_feed(0.05, 1)  # consumer reports 50 ms stalls
+                if pool.procs >= 2:
+                    break
+            assert pool.procs >= 2
+            snap = _obs.registry().snapshot()
+            assert snap["gauges"]["feed/reader_procs"] == pool.procs
+        finally:
+            pool.close()
+
+    def test_shrinks_when_idle_with_hysteresis(self):
+        pool = ReaderPool(_ident_chunks(n=8000, chunk=2), procs=3,
+                          max_procs=3, autoscale=True, cooldown_s=0.02)
+        try:
+            it = iter(pool)
+            for _ in range(80):
+                next(it)
+                pool.note_feed(0.0, 3)  # queue always ahead: zero stall
+                if pool.procs == 1:
+                    break
+                time.sleep(0.001)
+            assert pool.procs < 3
+            # hysteresis floor: never below 1
+            assert pool.procs >= 1
+        finally:
+            pool.close()
+
+    def test_off_by_default(self):
+        pool = ReaderPool(_ident_chunks(), procs=2, max_procs=4)
+        try:
+            for _ in range(20):
+                pool.note_feed(1.0, 0)
+            assert pool.procs == 2
+        finally:
+            pool.close()
+
+
+# ----------------------------------------------------------------------
+# trainer integration: bitwise parity + chaos kill->resume
+# ----------------------------------------------------------------------
+
+class TestTrainerParity:
+    def _train(self, procs, tmp_path, tag):
+        from bigdl_tpu.utils.summary import TrainSummary
+
+        RandomGenerator.set_seed(7)
+        o = optim.LocalOptimizer(_mlp(), _class_ds(), nn.ClassNLLCriterion(),
+                                 optim_method=SGD(learning_rate=0.3),
+                                 end_trigger=Trigger.max_epoch(2))
+        o.set_feed(2, reader_procs=procs)
+        o.set_train_summary(TrainSummary(str(tmp_path), tag))
+        o.optimize()
+        losses = [v for _, v in o.train_summary.read_scalar("Loss")]
+        params = [np.asarray(l) for l in jax.tree_util.tree_leaves(o.params)]
+        return losses, params
+
+    def test_bitwise_loss_and_param_parity_readers_on_vs_off(self, tmp_path):
+        losses_off, params_off = self._train(0, tmp_path, "off")
+        losses_on, params_on = self._train(2, tmp_path, "on")
+        assert losses_off == losses_on
+        for a, b in zip(params_off, params_on):
+            np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.chaos
+class TestReaderChaosParity:
+    def _make(self, procs, epochs=3, seed=42):
+        RandomGenerator.set_seed(seed)
+        o = optim.LocalOptimizer(_mlp(), _class_ds(), nn.ClassNLLCriterion(),
+                                 optim_method=SGD(learning_rate=0.3),
+                                 end_trigger=Trigger.max_epoch(epochs))
+        o.set_feed(2, reader_procs=procs)
+        o.set_fault_tolerance(backoff_base_s=0.0)
+        return o
+
+    def test_kill_and_resume_losses_bitwise_equal(self, tmp_path):
+        """Chaos kill at step 8 (mid-epoch-2 with 6-step epochs), resume
+        from the checkpoint in a 'fresh process': per-step losses under
+        reader_procs=2 match the uninterrupted reader_procs=2 run — and
+        the uninterrupted procs=0 run — bitwise."""
+        from bigdl_tpu.resilience import (ChaosStepFault, StepFaultInjector,
+                                          committed_steps)
+        from bigdl_tpu.utils.summary import TrainSummary
+
+        base = self._make(0)
+        base.set_train_summary(TrainSummary(str(tmp_path / "a"), "base"))
+        base.optimize()
+        base_losses = dict(base.train_summary.read_scalar("Loss"))
+
+        root = str(tmp_path / "ck")
+        o = self._make(2)
+        o.set_checkpoint(root, Trigger.several_iteration(4))
+        o.set_chaos(StepFaultInjector(fail_steps=(8,)))
+        o.set_fault_tolerance(max_restarts=0, backoff_base_s=0.0)
+        with pytest.raises(ChaosStepFault):
+            o.optimize()
+        assert committed_steps(root)
+
+        RandomGenerator.set_seed(999)  # ckpt seed must win
+        o2 = optim.LocalOptimizer(_mlp(), _class_ds(),
+                                  nn.ClassNLLCriterion(),
+                                  optim_method=SGD(learning_rate=0.3),
+                                  end_trigger=Trigger.max_epoch(3))
+        o2.set_feed(2, reader_procs=2)
+        o2.set_train_summary(TrainSummary(str(tmp_path / "b"), "res"))
+        o2.resume_from(root)
+        o2.optimize()
+        res_losses = dict(o2.train_summary.read_scalar("Loss"))
+        assert res_losses
+        for step, loss in res_losses.items():
+            assert loss == base_losses[step], (
+                f"step {step}: resumed pooled loss {loss!r} != "
+                f"uninterrupted {base_losses[step]!r}")
+
+    def test_dead_reader_worker_is_retryable(self, tmp_path, monkeypatch):
+        """A reader child dying mid-training is a transient fault: the
+        bounded-restart ladder resumes from the checkpoint and finishes
+        with the same final params as an undisturbed run.  The kill is
+        deterministic: the SECOND epoch's pool (epoch 1 committed a
+        checkpoint at step 4) has its workers SIGKILLed at creation."""
+        import bigdl_tpu.dataset.readers as readers_mod
+
+        base = self._make(0)
+        base.optimize()
+        base_leaves = [np.asarray(l)
+                       for l in jax.tree_util.tree_leaves(base.params)]
+
+        real = readers_mod.make_reader_source
+        made = []
+
+        def sabotaged(dataset, train, **kw):
+            pool = real(dataset, train, **kw)
+            if pool is not None:
+                made.append(pool)
+                if len(made) == 2:  # epoch 2's pool: murder its workers
+                    for p in list(pool._workers.values()):
+                        p.kill()
+            return pool
+
+        monkeypatch.setattr(readers_mod, "make_reader_source", sabotaged)
+        o = self._make(2)
+        o.set_checkpoint(str(tmp_path / "ck"), Trigger.several_iteration(4))
+        o.set_fault_tolerance(max_restarts=2, backoff_base_s=0.0)
+        o.optimize()
+        assert len(made) >= 3  # the sabotaged pool WAS replaced by a restart
+        leaves = [np.asarray(l)
+                  for l in jax.tree_util.tree_leaves(o.params)]
+        for a, b in zip(base_leaves, leaves):
+            np.testing.assert_array_equal(a, b)

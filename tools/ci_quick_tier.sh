@@ -61,6 +61,18 @@ if [ "$rc" -eq 0 ]; then
     elapsed=$(( $(date +%s) - start ))
 fi
 
+if [ "$rc" -eq 0 ]; then
+    # readers lane: the disaggregated input plane under JAX_PLATFORMS=cpu
+    # — a procs=2 pool must be bitwise-equal to the inline path (epoch
+    # sequence AND trainer losses) and leak zero children; order bugs in
+    # the reorder stage fail here, not as silent training-data skew
+    remaining=$(( BUDGET - elapsed ))
+    [ "$remaining" -lt 30 ] && remaining=30
+    timeout --signal=TERM "$remaining" python tools/readers_smoke.py
+    rc=$?
+    elapsed=$(( $(date +%s) - start ))
+fi
+
 if [ "$rc" -eq 124 ]; then
     echo "FAIL: quick tier exceeded the ${BUDGET}s budget (killed)" >&2
     exit 1
