@@ -1,0 +1,141 @@
+"""Autoregressive generation latency through the prefill/decode engine
+(ISSUE 10 acceptance: per-token p50/p99 committed to results/).
+
+Times the END-TO-END path — admission, prefill executable, continuous-
+batched decode steps, per-step (slots,) token readback — through
+`bigdl_tpu.generation.GenerationEngine`, not the bare cached forward.
+Two weight variants of the same LM:
+
+  * fp32        — the model as built (bf16 on TPU-sized runs)
+  * weight_only — leaf-wise int8 weights (`WeightOnlyInt8.from_float`),
+                  the decode-class quantization (bandwidth-bound)
+
+and two load phases per variant:
+
+  * seq1   — sequential single requests (interactive latency: TTFT plus
+             ms/token with one active slot)
+  * burstN — N concurrent requests over `slots` slots (throughput:
+             ms/token is per decode STEP, every active slot advances one
+             token per step, so tokens/s = active x 1000 / ms_per_token)
+
+Emits one JSON row per (variant, phase) with TTFT and per-token p50/p99,
+prefill ms, tokens/s, executable count (must stay <= buckets x 2), and
+writes the table to benchmarks/results/generation_quick.json (--quick)
+or generation.json.
+
+    python benchmarks/bench_generation.py            # TPU-sized LM
+    python benchmarks/bench_generation.py --quick    # CPU-sized LM
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_variants(quick: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.nn.quantized import WeightOnlyInt8
+
+    if quick:
+        kw = dict(vocab_size=512, hidden_size=64, n_layer=2, n_head=4)
+    else:
+        kw = dict(vocab_size=32000, hidden_size=1024, n_layer=12, n_head=16)
+    model = TransformerLM(max_len=1024, use_flash=False, **kw)
+    params, _ = model.init((1, 16), rng=jax.random.PRNGKey(0))
+    variants = [("fp32", model, params)]
+    qm, qp = WeightOnlyInt8.from_float(
+        model, params, compute_dtype=None if quick else jnp.bfloat16)
+    variants.append(("weight_only", qm, qp))
+    return kw["vocab_size"], variants
+
+
+def run_phase(engine, vocab: int, phase: str, n: int, max_new: int) -> dict:
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, vocab, size=int(rng.randint(4, 14)))
+               for _ in range(n)]
+    t0 = time.perf_counter()
+    if phase == "seq1":
+        results = [engine.generate(p, max_new_tokens=max_new)
+                   for p in prompts]
+    else:
+        futs = [engine.submit(p, max_new_tokens=max_new) for p in prompts]
+        results = [f.result(timeout=600) for f in futs]
+    wall = time.perf_counter() - t0
+    snap = engine.metrics.snapshot()
+    tokens = sum(r.meta["tokens"] for r in results)
+    ttft = sorted(r.meta["ttft_ms"] for r in results)
+    per_tok = sorted(r.meta["ms_per_token"] for r in results
+                     if r.meta["ms_per_token"] is not None)
+
+    def pct(xs, q):
+        return round(xs[min(len(xs) - 1, int(q / 100 * len(xs)))], 3)
+
+    return {
+        "phase": phase, "requests": n, "max_new_tokens": max_new,
+        "tokens": tokens,
+        "ttft_p50_ms": pct(ttft, 50), "ttft_p99_ms": pct(ttft, 99),
+        "ms_per_token_p50": pct(per_tok, 50),
+        "ms_per_token_p99": pct(per_tok, 99),
+        "prefill_p50_ms": snap["prefill_ms"]["p50"],
+        "tokens_per_s": round(tokens / wall, 1),
+        "compiled_executables": engine.compile_count(),
+        "wall_s": round(wall, 2),
+    }
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="2-layer hidden-64 LM, fewer requests (CPU-sized)")
+    ap.add_argument("--requests", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from bigdl_tpu.generation import GenerationConfig, GenerationEngine
+
+    platform = jax.devices()[0].platform
+    n_seq = args.requests or (12 if args.quick else 32)
+    max_new = 16 if args.quick else 64
+    buckets = (32, 128) if args.quick else (128, 512)
+    slots = 4 if args.quick else 8
+    vocab, variants = build_variants(args.quick)
+
+    rows = []
+    for variant, module, params in variants:
+        cfg = GenerationConfig(buckets=buckets, slots=slots,
+                               capacity=256, max_new_tokens=max_new)
+        engine = GenerationEngine(module, params, config=cfg)
+        budget = 2 * len(buckets)
+        try:
+            for phase, n in (("seq1", n_seq), (f"burst{4 * slots}",
+                                               4 * slots)):
+                row = {"variant": variant, "platform": platform,
+                       "buckets": list(buckets), "slots": slots,
+                       **run_phase(engine, vocab, phase, n, max_new)}
+                assert row["compiled_executables"] <= budget, row
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+        finally:
+            engine.close()
+
+    name = "generation_quick.json" if args.quick else "generation.json"
+    out = os.path.join(os.path.dirname(__file__), "results", name)
+    with open(out, "w") as f:
+        json.dump(rows, f, indent=1)
+    print(f"# wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""bigdl_tpu.generation — TPU-native autoregressive inference.
+
+The LLM-serving subsystem: ring-buffer KV caches at bucketed max lengths
+(kvcache.py), on-device greedy/temperature/top-k sampling (sampling.py),
+and a continuous-batching prefill/decode engine (engine.py) layered on the
+serving stack's registry/hot-swap/AOT-warmup machinery.  See the module
+docstrings and docs/serving.md "Autoregressive generation".
+
+```python
+from bigdl_tpu.generation import GenerationEngine
+
+eng = GenerationEngine(model, params, buckets=(64, 256), slots=8,
+                       temperature=0.0, eos_id=2)
+out = eng.generate([5, 17, 99], max_new_tokens=32)   # GenerationResult
+fut = eng.submit([5, 17], temperature=0.8)           # continuous batching
+print(eng.export_metrics())                          # ttft / ms-per-token
+eng.close()
+```
+
+Or attached to a live runtime so hot-swaps warm BOTH paths:
+`rt.enable_generation(buckets=(64,), slots=8)`.
+"""
+
+from bigdl_tpu.generation.engine import (
+    GenerationConfig,
+    GenerationEngine,
+    GenerationResult,
+)
+from bigdl_tpu.generation.kvcache import KVCache, alloc, insert
+from bigdl_tpu.generation.sampling import apply_top_k, sample_tokens
+
+__all__ = [
+    "GenerationConfig",
+    "GenerationEngine",
+    "GenerationResult",
+    "KVCache",
+    "alloc",
+    "apply_top_k",
+    "insert",
+    "sample_tokens",
+]

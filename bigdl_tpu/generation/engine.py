@@ -1,0 +1,607 @@
+"""GenerationEngine: prefill/decode serving with continuous batching.
+
+The serving stack (bigdl_tpu.serving) turned fixed-shape forwards into a
+production path: bucketed executables, versioned hot-swap, AOT warmup,
+admission control.  This module does the same for AUTOREGRESSIVE
+generation, where the reference has nothing at all (its
+PredictionService.scala runs one stateless forward per request — "decode"
+would be a full prompt re-forward per token).
+
+Shape discipline (the TPU cost model, same as MicroBatcher's buckets):
+
+  * Each configured length bucket C owns one DECODE LANE: a ring-buffer
+    `KVCache` of (slots, C) plus exactly TWO executables —
+    `generation/prefill/bucket=C` (prompt padded to C, writes one slot,
+    samples the first token) and `generation/decode/bucket=C` (length-1
+    query for ALL slots at once, samples the next token per slot).  The
+    executable set is `len(buckets) x 2`, ever; a 64-request burst
+    compiles nothing past warmup (tests/test_generation.py asserts it,
+    with CompileMonitor's steady-state recompile alarm as the witness).
+  * Continuous batching: the engine thread interleaves admission with
+    in-flight decode — a new request claims a free slot, prefills, and
+    joins the NEXT decode step of requests already mid-generation; EOS /
+    max-token / non-finite retirement frees the slot for the queue.  Slot
+    claim/free are traced indices inside the compiled step, never new
+    shapes.
+  * Sampling (greedy / temperature / top-k, generation/sampling.py) runs
+    on device inside the decode executable; the per-step host traffic is
+    one (slots,) token read-back.
+
+Serving integration: the engine reuses `ModelRegistry` (atomic hot-swap;
+its warmup chain AOT-warms prefill+decode per bucket BEFORE a version
+activates — through `compilecache.load_or_compile` when the persistent
+store is on), the serving admission-control idiom (bounded queue,
+`Rejected`/`ServingClosed`), and the runtime's `reject_nonfinite` health
+policy.  `ServingRuntime.enable_generation()` attaches an engine to a
+live runtime so one registry swap warms BOTH the batch forwards and the
+generation executables.  A swap mid-generation applies to subsequent
+tokens of in-flight requests (their cached K/V is kept); call `drain()`
+first when strict single-version generations are required.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import nullcontext
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import obs as _obs
+from bigdl_tpu.analysis.runtime import strict_transfers, strict_transfers_enabled
+from bigdl_tpu.generation.kvcache import KVCache, insert
+from bigdl_tpu.generation.sampling import sample_tokens
+from bigdl_tpu.serving.batcher import Rejected, ServingClosed, _Future
+from bigdl_tpu.serving.metrics import GenerationMetrics
+from bigdl_tpu.serving.registry import ModelRegistry, ModelVersion
+
+_NULL = nullcontext()
+
+
+class GenerationConfig:
+    """Knobs for the generation engine (docs/serving.md)."""
+
+    def __init__(self, buckets: Sequence[int] = (64, 256), slots: int = 4,
+                 capacity: int = 128, max_new_tokens: int = 64,
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_id: Optional[int] = None, cache_dtype=None,
+                 seed: int = 0, reject_nonfinite: bool = False,
+                 strict_transfers: Optional[bool] = None):
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not self.buckets or self.buckets[0] < 2:
+            raise ValueError(f"length buckets must be >= 2, got {buckets}")
+        self.slots = int(slots)          # concurrent requests per bucket lane
+        self.capacity = int(capacity)    # admission queue bound
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)          # static: part of the executables
+        self.eos_id = eos_id
+        self.cache_dtype = cache_dtype or jnp.float32
+        self.seed = int(seed)
+        self.reject_nonfinite = bool(reject_nonfinite)
+        self.strict_transfers = strict_transfers
+
+
+class GenerationResult(NamedTuple):
+    """Generated token ids (prompt excluded) + per-request meta
+    (cid, version, bucket, finish_reason, ttft_ms, ms_per_token, ...)."""
+
+    tokens: np.ndarray
+    meta: Dict[str, Any]
+
+
+class _SlotState:
+    __slots__ = ("req", "tokens", "generated", "t_first", "step_ms_sum")
+
+    def __init__(self, req):
+        self.req = req
+        self.tokens: List[int] = []  # generated ids, streamed back per step
+        self.generated = 0
+        self.t_first: Optional[float] = None
+        self.step_ms_sum = 0.0
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_new", "temperature", "eos_id", "future",
+                 "t_submit", "cid", "uid")
+
+    def __init__(self, prompt, max_new, temperature, eos_id, uid):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.temperature = temperature
+        self.eos_id = eos_id
+        self.future = _Future()
+        self.t_submit = time.perf_counter()
+        self.cid = _obs.next_cid()
+        self.uid = uid  # per-engine request index; folds the sampling rng
+
+
+class _Lane:
+    """One length bucket: a (slots, C) KV cache + host-side bookkeeping."""
+
+    def __init__(self, model, bucket: int, slots: int, dtype):
+        self.bucket = bucket
+        # committed placement: pjit caches key on sharding commitment, so
+        # every input (cache, tokens, scalars) must be device_put like the
+        # warmup args or the first real step silently re-traces
+        self.cache: KVCache = jax.device_put(
+            model.init_cache(slots, bucket, dtype))
+        self.slots: List[Optional[_SlotState]] = [None] * slots
+        self.free: List[int] = list(range(slots))
+        # host mirrors, device_put explicitly each step (tiny, guard-safe)
+        self.last_np = np.zeros((slots, 1), np.int32)
+        self.temps_np = np.zeros((slots,), np.float32)
+        self.active_np = np.zeros((slots,), bool)
+
+    @property
+    def n_active(self) -> int:
+        return int(self.active_np.sum())
+
+
+def _tree_sig(tree: Any) -> tuple:
+    return tuple((tuple(np.shape(l)), str(getattr(l, "dtype", type(l))))
+                 for l in jax.tree_util.tree_leaves(tree))
+
+
+class GenerationEngine:
+    """Continuous-batching prefill/decode engine over a versioned registry.
+
+    `model` must expose the cache-aware protocol (`init_cache`,
+    `apply_cached`) — TransformerLM natively, and quantized wrappers like
+    `WeightOnlyInt8` by delegation, so int8 weight-only decode via
+    `quantize(mode='auto')` drops in unchanged.
+    """
+
+    def __init__(self, model, params: Any = None, state: Any = None, *,
+                 config: Optional[GenerationConfig] = None,
+                 registry: Optional[ModelRegistry] = None,
+                 version: str = "v0", summary=None, **config_kw):
+        if not (hasattr(model, "apply_cached") and hasattr(model, "init_cache")):
+            raise TypeError(
+                f"{type(model).__name__} has no KV-cache forward "
+                "(init_cache/apply_cached); generation needs a cache-aware "
+                "model (models/transformer.TransformerLM or a wrapper)")
+        self.model = model
+        self.config = config or GenerationConfig(**config_kw)
+        self.metrics = GenerationMetrics()
+        self.summary = summary
+        self._export_step = 0
+        self._uid_counter = 0
+        self._steps = 0
+        self._strict = strict_transfers_enabled(self.config.strict_transfers)
+        self._lanes: Dict[int, _Lane] = {
+            b: _Lane(model, b, self.config.slots, self.config.cache_dtype)
+            for b in self.config.buckets}
+        self._prefill, self._decode = self._build_fns()
+        # warmed executables: (phase, bucket) -> callable (AOT-loaded when
+        # the compile cache is on, the pjit fn otherwise); psig pins the
+        # param tree they were warmed for, exactly like ServingRuntime
+        self._warmed: Dict[Tuple[str, int], Any] = {}
+        self._warmed_psig: Optional[tuple] = None
+
+        self._pending: "deque[_GenRequest]" = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._abort = False
+        self._drained = threading.Event()
+
+        if registry is None:
+            self.registry = ModelRegistry(warmup=self._warmup)
+            self.registry.register(version, params,
+                                   state if state is not None else {})
+        else:
+            # layered behind a live ServingRuntime: warm the ACTIVE version
+            # now, then join the registry's warmup chain so every future
+            # hot-swap warms generation executables before activation too
+            self.registry = registry
+            snap = registry.active()
+            self._warmup(snap.params, snap.state)
+            registry.add_warmup(self._warmup)
+        mon = _obs.compile_monitor()
+        if mon is not None:
+            # warmup compiled every (bucket x phase) above: any compile
+            # under generation/ from here on is a steady-state alarm
+            mon.mark_steady("generation/")
+        self._thread = threading.Thread(target=self._loop,
+                                        name="generation-engine", daemon=True)
+        self._thread.start()
+
+    # -- compiled step functions ------------------------------------------
+
+    def _build_fns(self):
+        m = self.model
+        top_k = self.config.top_k
+
+        def prefill(params, cache, tokens, n, slot, temp, seed, uid):
+            # fresh single-slot cache at the lane's capacity; fold the
+            # prompt in, sample token #1 from the last REAL row, then
+            # write the slot — all one executable per bucket, so slot
+            # claim costs no extra compile
+            L, _, C, H, D = cache.k.shape
+            fresh = KVCache(k=jnp.zeros((L, 1, C, H, D), cache.k.dtype),
+                            v=jnp.zeros((L, 1, C, H, D), cache.v.dtype),
+                            lengths=jnp.zeros((1,), jnp.int32))
+            logp, fresh = m.apply_cached(params, tokens, fresh)
+            last = jax.lax.dynamic_slice_in_dim(logp, n - 1, 1, axis=1)[:, 0]
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), uid)
+            tok = sample_tokens(last, key, temp, top_k=top_k)
+            ok = jnp.isfinite(last).all()
+            return tok, insert(cache, slot, fresh, n), ok
+
+        def decode(params, cache, last_tokens, temps, active, step, seed):
+            logp, new = m.apply_cached(params, last_tokens, cache)
+            logits = logp[:, 0]
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+            toks = sample_tokens(logits, key, temps, top_k=top_k)
+            # free/parked slots still flow through the fixed-shape step;
+            # only ACTIVE slots advance their ring position
+            lengths = jnp.where(active, new.lengths, cache.lengths)
+            ok = jnp.isfinite(logits).all(axis=-1)
+            return toks[:, None], new._replace(lengths=lengths), ok
+
+        return jax.jit(prefill), jax.jit(decode)
+
+    def _warmup_args(self, params, lane: _Lane):
+        # every non-param arg is device_put so warmup avals (committed
+        # arrays) match the hot path exactly — an uncommitted numpy arg
+        # here would warm an executable the real steps never hit
+        s, c = self.config.slots, lane.bucket
+        throwaway = jax.device_put(
+            self.model.init_cache(s, c, self.config.cache_dtype))
+        pre = (params, throwaway) + jax.device_put(
+            (np.zeros((1, c), np.int32), np.int32(1), np.int32(0),
+             np.zeros((1,), np.float32), np.int32(self.config.seed),
+             np.int32(0)))
+        dec = (params, throwaway) + jax.device_put(
+            (np.zeros((s, 1), np.int32), np.zeros((s,), np.float32),
+             np.zeros((s,), bool), np.int32(0),
+             np.int32(self.config.seed)))
+        return pre, dec
+
+    def _warmup(self, params: Any, state: Any = None) -> None:
+        """Warm prefill+decode for every bucket BEFORE a version activates
+        (ModelRegistry calls this off the request path).  Same three tiers
+        as ServingRuntime._warmup: params-only swap reuses live
+        executables; compile cache on -> AOT load from disk; off -> one
+        real call per (bucket, phase)."""
+        from bigdl_tpu import compilecache as _cc
+
+        psig = _tree_sig(params)
+        if psig != self._warmed_psig:
+            self._warmed.clear()
+        use_cache = _cc.enabled()
+        reg = _obs.registry()
+        for lane in self._lanes.values():
+            pre_args, dec_args = self._warmup_args(params, lane)
+            for phase, fn, args in (("prefill", self._prefill, pre_args),
+                                    ("decode", self._decode, dec_args)):
+                keyk = (phase, lane.bucket)
+                if keyk in self._warmed:
+                    reg.inc("generation/warmup_reused")
+                    continue
+                sig = f"generation/{phase}/bucket={lane.bucket}"
+                with _obs.attribute(sig), \
+                        _obs.span("gen.warmup", cat="generation",
+                                  phase=phase, bucket=lane.bucket):
+                    if use_cache:
+                        warmed, status = _cc.load_or_compile(
+                            fn, args, signature=sig,
+                            extra_key={"kind": "generation", "phase": phase,
+                                       "bucket": lane.bucket,
+                                       "slots": self.config.slots,
+                                       "top_k": self.config.top_k})
+                        self._warmed[keyk] = warmed if status != "error" else fn
+                    else:
+                        out = fn(*args)
+                        jax.tree_util.tree_map(
+                            lambda l: getattr(l, "block_until_ready",
+                                              lambda: l)(), out)
+                        self._warmed[keyk] = fn
+        self._warmed_psig = psig
+
+    def _fn(self, phase: str, bucket: int, snap: ModelVersion):
+        if self._warmed and self._warmed_psig == _tree_sig(snap.params):
+            fn = self._warmed.get((phase, bucket))
+            if fn is not None:
+                return fn
+        return self._prefill if phase == "prefill" else self._decode
+
+    def compile_count(self) -> int:
+        """Distinct compiled generation executables — the bucket-discipline
+        probe (must stay <= len(buckets) x 2).  pjit cache sizes are the
+        ground truth, plus AOT-loaded executables which live outside it."""
+        aot = sum(1 for fn in self._warmed.values()
+                  if fn is not self._prefill and fn is not self._decode)
+        try:
+            n = self._prefill._cache_size() + self._decode._cache_size()
+            return int(n) + aot
+        except Exception:
+            return len(self._warmed)
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
+               temperature: Optional[float] = None,
+               eos_id: Optional[int] = None) -> _Future:
+        """Async admission: returns a future resolving to a
+        `GenerationResult` (`.result(timeout=...)`)."""
+        toks = np.asarray(prompt, np.int32).reshape(-1)
+        if toks.size < 1:
+            raise ValueError("empty prompt")
+        if toks.size > self.config.buckets[-1]:
+            raise ValueError(
+                f"prompt of {toks.size} tokens exceeds the largest length "
+                f"bucket {self.config.buckets[-1]}; truncate or configure "
+                "a larger bucket")
+        max_new = max(1, int(self.config.max_new_tokens
+                             if max_new_tokens is None else max_new_tokens))
+        temp = float(self.config.temperature
+                     if temperature is None else temperature)
+        eos = self.config.eos_id if eos_id is None else eos_id
+        with self._cond:
+            if self._closed:
+                self.metrics.on_reject("shutdown")
+                raise ServingClosed("generation engine is closed")
+            if len(self._pending) >= self.config.capacity:
+                self.metrics.on_reject("queue_full")
+                _obs.instant("gen.reject", cat="generation",
+                             reason="queue_full")
+                raise Rejected(
+                    f"generation queue full ({self.config.capacity} "
+                    "requests); backpressure — retry with backoff or raise "
+                    "capacity")
+            self._uid_counter += 1
+            req = _GenRequest(toks, max_new, temp, eos, self._uid_counter)
+            self._pending.append(req)
+            depth = len(self._pending)
+            self._cond.notify()
+        self.metrics.on_admit(depth)
+        _obs.instant("gen.admit", cat="generation", cid=req.cid,
+                     prompt_tokens=int(toks.size), depth=depth)
+        return req.future
+
+    def generate(self, prompt, timeout: Optional[float] = 120.0,
+                 **kw) -> GenerationResult:
+        """Blocking single-request generation."""
+        return self.submit(prompt, **kw).result(timeout)
+
+    # -- scheduler loop ----------------------------------------------------
+
+    def _pick_lane(self, req: _GenRequest) -> Optional[_Lane]:
+        """Smallest bucket holding prompt+completion without ring wrap;
+        otherwise the LARGEST bucket that fits the prompt (wrap = sliding
+        window over the last C tokens).  Returns None when no eligible
+        lane has a free slot (the request stays queued, FIFO)."""
+        n = int(req.prompt.size)
+        fits = [b for b in self.config.buckets if b >= n + req.max_new]
+        wraps = [b for b in reversed(self.config.buckets) if b >= n]
+        for b in fits + wraps:
+            if self._lanes[b].free:
+                return self._lanes[b]
+        return None
+
+    def _n_active(self) -> int:
+        return sum(lane.n_active for lane in self._lanes.values())
+
+    def _admit(self, snap: ModelVersion, tr) -> None:
+        mon = _obs.compile_monitor()
+        while True:
+            with self._cond:
+                if not self._pending:
+                    return
+                lane = self._pick_lane(self._pending[0])
+                if lane is None:
+                    return  # every eligible slot busy; retry after decode
+                req = self._pending.popleft()
+            s = lane.free.pop()
+            n = int(req.prompt.size)
+            padded = np.zeros((1, lane.bucket), np.int32)
+            padded[0, :n] = req.prompt
+            fn = self._fn("prefill", lane.bucket, snap)
+            t0 = time.perf_counter()
+            with (tr.span("gen.prefill", cat="generation", cid=req.cid,
+                          bucket=lane.bucket, prompt_tokens=n)
+                  if tr is not None else _NULL), \
+                    (mon.attribute(f"generation/prefill/bucket={lane.bucket}")
+                     if mon is not None else _NULL), \
+                    strict_transfers(self._strict):
+                tok, lane.cache, ok = fn(
+                    snap.params, lane.cache, *jax.device_put(
+                        (padded, np.int32(n), np.int32(s),
+                         np.asarray([req.temperature], np.float32),
+                         np.int32(self.config.seed), np.int32(req.uid))))
+                tok = int(jax.device_get(tok)[0])
+                ok = bool(jax.device_get(ok))
+            t1 = time.perf_counter()
+            st = _SlotState(req)
+            st.t_first = t1
+            st.tokens.append(tok)
+            lane.slots[s] = st
+            lane.temps_np[s] = req.temperature
+            lane.active_np[s] = True
+            lane.last_np[s, 0] = tok
+            self.metrics.on_prefill((t1 - t0) * 1e3,
+                                    (t1 - req.t_submit) * 1e3)
+            self.metrics.set_active(self._n_active())
+            if self.config.reject_nonfinite and not ok:
+                self._retire(lane, s, "error", tr)
+                continue
+            st.generated = 1
+            if (req.eos_id is not None and tok == req.eos_id) \
+                    or req.max_new <= 1:
+                self._retire(lane, s,
+                             "eos" if req.eos_id is not None
+                             and tok == req.eos_id else "length", tr)
+
+    def _decode_lane(self, lane: _Lane, snap: ModelVersion, tr) -> None:
+        mon = _obs.compile_monitor()
+        k = lane.n_active
+        fn = self._fn("decode", lane.bucket, snap)
+        cids = [lane.slots[s].req.cid for s in range(self.config.slots)
+                if lane.slots[s] is not None]
+        t0 = time.perf_counter()
+        with (tr.span("gen.decode_step", cat="generation",
+                      bucket=lane.bucket, active=k, cids=cids)
+              if tr is not None else _NULL), \
+                (mon.attribute(f"generation/decode/bucket={lane.bucket}")
+                 if mon is not None else _NULL), \
+                strict_transfers(self._strict):
+            toks, lane.cache, ok = fn(
+                snap.params, lane.cache, *jax.device_put(
+                    (lane.last_np, lane.temps_np, lane.active_np,
+                     np.int32(self._steps), np.int32(self.config.seed))))
+            toks_np = jax.device_get(toks)  # the ONE per-step host sync
+            ok_np = jax.device_get(ok)
+        step_ms = (time.perf_counter() - t0) * 1e3
+        self._steps += 1
+        self.metrics.on_tokens(k, step_ms)
+        for s in range(self.config.slots):
+            st = lane.slots[s]
+            if st is None:
+                continue
+            if self.config.reject_nonfinite and not bool(ok_np[s]):
+                self._retire(lane, s, "error", tr)
+                continue
+            tok = int(toks_np[s, 0])
+            lane.last_np[s, 0] = tok
+            st.tokens.append(tok)
+            st.generated += 1
+            st.step_ms_sum += step_ms
+            if st.req.eos_id is not None and tok == st.req.eos_id:
+                self._retire(lane, s, "eos", tr)
+            elif st.generated >= st.req.max_new:
+                self._retire(lane, s, "length", tr)
+
+    def _retire(self, lane: _Lane, s: int, reason: str, tr) -> None:
+        st = lane.slots[s]
+        req = st.req
+        lane.slots[s] = None
+        lane.active_np[s] = False
+        lane.free.append(s)
+        now = time.perf_counter()
+        snap_version = self.registry.active_version
+        if reason == "error":
+            self.metrics.on_nonfinite()
+            if tr is not None:
+                tr.instant("gen.nonfinite", cat="generation", cid=req.cid)
+            from bigdl_tpu.serving.runtime import NonFiniteOutput
+
+            req.future.set_error(NonFiniteOutput(
+                f"non-finite logits while generating (model version "
+                f"{snap_version!r}, bucket {lane.bucket})"))
+            self.metrics.set_active(self._n_active())
+            return
+        n_gen = st.generated
+        tokens = st.tokens
+        ttft_ms = (st.t_first - req.t_submit) * 1e3
+        meta = {
+            "cid": req.cid, "version": snap_version, "bucket": lane.bucket,
+            "finish_reason": reason, "prompt_tokens": int(req.prompt.size),
+            "tokens": n_gen, "ttft_ms": round(ttft_ms, 3),
+            "ms_per_token": round(st.step_ms_sum / max(1, n_gen - 1), 3)
+            if n_gen > 1 else None,
+        }
+        self.metrics.on_complete((now - req.t_submit) * 1e3, n_gen)
+        self.metrics.set_active(self._n_active())
+        if tr is not None:
+            tr.instant("gen.complete", cat="generation", cid=req.cid,
+                       tokens=n_gen, reason=reason)
+        req.future.meta = meta
+        req.future.set_result(GenerationResult(np.asarray(tokens, np.int32),
+                                               meta))
+
+    # -- main loop ---------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while (not self._closed and not self._pending
+                       and self._n_active() == 0):
+                    self._cond.wait(0.05)
+                if self._closed and self._abort:
+                    break
+                if (self._closed and not self._pending
+                        and self._n_active() == 0):
+                    break
+            tr = _obs.tracer()
+            try:
+                snap = self.registry.active()
+                self._admit(snap, tr)
+                for lane in self._lanes.values():
+                    if lane.n_active:
+                        self._decode_lane(lane, snap, tr)
+            except BaseException as e:  # noqa: BLE001 — fail loudly, keep serving
+                self._fail_inflight(e)
+        # abort path: fail everything still queued or in-flight
+        self._fail_inflight(ServingClosed("generation engine shut down"))
+        self._drained.set()
+
+    def _fail_inflight(self, err: BaseException) -> None:
+        with self._cond:
+            pending, self._pending = list(self._pending), deque()
+        for req in pending:
+            self.metrics.on_reject("shutdown")
+            if not req.future.done():
+                req.future.set_error(err)
+        for lane in self._lanes.values():
+            for s in range(self.config.slots):
+                st = lane.slots[s]
+                if st is not None:
+                    lane.slots[s] = None
+                    lane.active_np[s] = False
+                    lane.free.append(s)
+                    if not st.req.future.done():
+                        st.req.future.set_error(err)
+        self.metrics.set_active(0)
+
+    # -- versioning / lifecycle -------------------------------------------
+
+    def swap(self, version: str, params: Any, state: Any = None) -> None:
+        """Hot-swap: AOT-warm prefill+decode for the new version (off the
+        decode path), then activate atomically.  In-flight requests keep
+        their KV cache and continue on the new weights from their next
+        token; `drain()` first for strict per-request version pinning."""
+        self.registry.register(version, params,
+                               state if state is not None else {})
+        self.metrics.on_swap()
+
+    def drain(self, timeout: Optional[float] = 60.0) -> None:
+        """Block until every admitted request has retired."""
+        deadline = None if timeout is None else time.perf_counter() + timeout
+        while self._pending or self._n_active():
+            if deadline is not None and time.perf_counter() > deadline:
+                raise TimeoutError("generation engine did not drain in time")
+            time.sleep(0.002)
+
+    @property
+    def active_version(self) -> Optional[str]:
+        return self.registry.active_version
+
+    def export_metrics(self, step: Optional[int] = None) -> dict:
+        snap = self.metrics.snapshot()
+        if self.summary is not None:
+            if step is None:
+                step = self._export_step
+            self._export_step = step + 1
+            self.metrics.export(self.summary, step)
+        return snap
+
+    def close(self, drain: bool = True, timeout: Optional[float] = 60.0) -> None:
+        with self._cond:
+            self._closed = True
+            if not drain:
+                self._abort = True
+            self._cond.notify_all()
+        if not self._drained.wait(timeout):
+            raise TimeoutError("generation engine did not drain in time")
+        self._thread.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,80 @@
+"""Ring-buffer KV cache for autoregressive decode.
+
+No reference counterpart (the reference's only sequence model is LSTM/GRU
+recurrence, nn/Recurrent.scala — its "state" is the recurrent hidden, not
+an attention cache).  The TPU-native design constraint is SHAPE STABILITY:
+XLA compiles one executable per shape, so the cache is a fixed-capacity
+ring buffer allocated at a bucketed max length and every decode step runs
+the exact same program regardless of how many tokens each request holds.
+
+Layout: K/V are (n_layer, slots, capacity, n_head, head_dim) — layer-major
+so `lax.scan` over the model's stacked blocks consumes the cache as a
+scanned input, mirroring models/transformer.py's weight-stationary layout.
+`lengths` (slots,) counts TOTAL tokens ever written per slot; the ring
+index of position p is simply `p % capacity`, and a slot that outgrows its
+bucket degrades to sliding-window attention over the last `capacity`
+tokens instead of recompiling at a bigger shape.
+
+The pytree is a NamedTuple, so it flows through jit/scan unchanged and a
+whole cache update is one functional `.at[].set` per layer inside the
+compiled step — never a host round-trip.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class KVCache(NamedTuple):
+    """Per-model KV ring buffer (a jax pytree; see module docstring)."""
+
+    k: jax.Array        # (n_layer, slots, capacity, n_head, head_dim)
+    v: jax.Array        # same shape as k
+    lengths: jax.Array  # (slots,) int32 — total tokens written per slot
+
+    @property
+    def n_layer(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def capacity(self) -> int:
+        return self.k.shape[2]
+
+    def window(self) -> jax.Array:
+        """Tokens currently resident per slot (= lengths until the ring
+        wraps, then the sliding-window size `capacity`)."""
+        return jnp.minimum(self.lengths, self.capacity)
+
+
+def alloc(n_layer: int, slots: int, capacity: int, n_head: int,
+          head_dim: int, dtype=jnp.float32) -> KVCache:
+    """Zeroed cache for `slots` concurrent requests of up to `capacity`
+    resident tokens each."""
+    shape = (n_layer, slots, capacity, n_head, head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   lengths=jnp.zeros((slots,), jnp.int32))
+
+
+def insert(cache: KVCache, slot, src: KVCache, length) -> KVCache:
+    """Write single-slot cache `src` (same capacity) into `slot` of
+    `cache` and pin that slot's length to `length` (the REAL token count —
+    a bucketed prefill runs padded to capacity, so `src.lengths` counts
+    pad rows too).  Traced-index safe: runs inside jit with `slot` and
+    `length` as scalars, so slot claim/free never triggers a recompile."""
+    if src.k.shape[2] != cache.k.shape[2]:
+        raise ValueError(
+            f"capacity mismatch: inserting {src.k.shape[2]} into "
+            f"{cache.k.shape[2]} (prefill and decode lanes must share a "
+            "length bucket)")
+    return KVCache(
+        k=jax.lax.dynamic_update_index_in_dim(cache.k, src.k[:, 0], slot, 1),
+        v=jax.lax.dynamic_update_index_in_dim(cache.v, src.v[:, 0], slot, 1),
+        lengths=cache.lengths.at[slot].set(
+            jnp.asarray(length, jnp.int32)))

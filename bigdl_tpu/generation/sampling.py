@@ -1,0 +1,43 @@
+"""On-device token sampling: greedy, temperature, top-k.
+
+Sampling runs INSIDE the decode executable (bigdl_tpu/generation/engine.py
+jits it together with the forward), so the per-step host traffic is one
+(slots,) int32 read-back — never the (slots, vocab) logits.  Greedy vs
+temperature is a traced `jnp.where`, not a Python branch: per-slot
+temperatures ride in as an array, so requests with different sampling
+settings share one executable and continuous batching never recompiles.
+`top_k` is the one STATIC knob (lax.top_k needs a static k); it is fixed
+per engine config, keeping the executable set at buckets x {prefill,
+decode}.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.ops.attention import NEG_INF
+
+
+def apply_top_k(logits: jax.Array, k: int) -> jax.Array:
+    """Mask all but the k highest logits per row (k static; k<=0 = off)."""
+    if k <= 0 or k >= logits.shape[-1]:
+        return logits
+    thresh = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits >= thresh, logits, NEG_INF)
+
+
+def sample_tokens(logits: jax.Array, key: jax.Array,
+                  temperatures: jax.Array, *, top_k: int = 0) -> jax.Array:
+    """One token per row of (B, V) logits -> (B,) int32.
+
+    Per-row `temperatures` (B,): 0 = greedy (argmax), >0 = softmax sample
+    at that temperature over the (optionally top-k-masked) logits.  Both
+    paths are always computed and selected with `where`, so a batch mixing
+    greedy and sampled requests stays a single executable.
+    """
+    greedy = jnp.argmax(logits, axis=-1)
+    temps = jnp.asarray(temperatures)
+    safe = jnp.where(temps > 0, temps, 1.0)[:, None]
+    sampled = jax.random.categorical(key, apply_top_k(logits, top_k) / safe)
+    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)

@@ -144,6 +144,70 @@ class TransformerLM(Module):
         logits = h @ head
         return jax.nn.log_softmax(logits, axis=-1), state
 
+    # -- autoregressive generation (bigdl_tpu.generation) ------------------
+
+    def init_cache(self, slots: int, capacity: int, dtype=jnp.float32):
+        """Zeroed ring-buffer KV cache for `slots` concurrent requests of
+        up to `capacity` resident tokens (generation/kvcache.py)."""
+        from bigdl_tpu.generation.kvcache import alloc
+
+        if not self.rope and capacity > self.max_len:
+            raise ValueError(
+                f"cache capacity {capacity} exceeds max_len {self.max_len} "
+                "(learned positions cannot extrapolate; use rope=True for "
+                "ring wrap-around past max_len)")
+        return alloc(self.n_layer, slots, capacity, self.n_head,
+                     self.hidden_size // self.n_head, dtype)
+
+    def apply_cached(self, params, tokens, cache):
+        """Cache-aware forward: `tokens` (B, S) are NEW tokens appended at
+        absolute positions `cache.lengths[b]..+S-1`; returns (log-probs
+        (B, S, V), updated cache with lengths += S).
+
+        Prefill is one call with the prompt (S <= capacity, fresh cache);
+        decode is S=1 against the cached prefix — a length-1 query, RoPE
+        offset by position, masked by the offset causal mask
+        (nn/attention.py causal_mask), bitwise the same math as re-running
+        the full context (tests/test_generation.py locks the parity).
+        Dropout/training paths are deliberately absent: this is the
+        inference hot loop.
+        """
+        b, s = tokens.shape
+        h, _ = self.embed.apply(params["embed"], {}, tokens)
+        lengths = cache.lengths
+        if not self.rope:
+            pos = jnp.minimum(lengths[:, None] + jnp.arange(s)[None, :],
+                              self.max_len - 1)
+            h = h + jnp.take(params["pos"], pos, axis=0)
+
+        blk = self.block
+
+        if self.scan_layers:
+            def body(hh, xs):
+                lp, kl, vl = xs
+                out, kv = blk.apply_cached(lp, hh, {"k": kl, "v": vl},
+                                           lengths=lengths)
+                return out, (kv["k"], kv["v"])
+
+            h, (nk, nv) = lax.scan(body, h, (params["blocks"], cache.k,
+                                             cache.v))
+        else:
+            ks, vs = [], []
+            for i in range(self.n_layer):
+                h, kv = blk.apply_cached(params["blocks"][str(i)], h,
+                                         {"k": cache.k[i], "v": cache.v[i]},
+                                         lengths=lengths)
+                ks.append(kv["k"])
+                vs.append(kv["v"])
+            nk, nv = jnp.stack(ks), jnp.stack(vs)
+
+        h, _ = self.ln_f.apply(params["ln_f"], {}, h)
+        head = params["embed"]["weight"].T if self.tie_embeddings \
+            else params["head"]
+        logits = h @ head
+        new_cache = cache._replace(k=nk, v=nv, lengths=lengths + s)
+        return jax.nn.log_softmax(logits, axis=-1), new_cache
+
     def output_shape(self, input_shape):
         return tuple(input_shape) + (self.vocab_size,)
 

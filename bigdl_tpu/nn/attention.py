@@ -44,17 +44,41 @@ from bigdl_tpu.ops.flash_attention import flash_attention
 
 def apply_rope(x: jax.Array, *, base: float = 10000.0,
                positions: Optional[jax.Array] = None) -> jax.Array:
-    """Rotary position embedding over (B, S, H, D) (D even)."""
+    """Rotary position embedding over (B, S, H, D) (D even).
+
+    `positions` may be (S,) — shared across the batch, the training case —
+    or (B, S) for per-row offsets (the decode path, where every KV-cache
+    slot sits at its own absolute position).
+    """
     b, s, h, d = x.shape
     if positions is None:
         positions = jnp.arange(s)
+    positions = jnp.asarray(positions)
     freqs = base ** (-jnp.arange(0, d, 2) / d)
-    angles = positions[:, None] * freqs[None, :]  # (S, D/2)
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions[..., :, None] * freqs  # (S, D/2) or (B, S, D/2)
+    if angles.ndim == 2:
+        angles = angles[None]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = x[..., ::2], x[..., 1::2]
     rot = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return rot.reshape(b, s, h, d).astype(x.dtype)
+
+
+def causal_mask(q_len: int, kv_len: int, *,
+                q_offset: "int | jax.Array" = 0) -> jax.Array:
+    """Boolean (q_len, kv_len) causal mask with a query position offset.
+
+    Query row i sits at absolute position `q_offset + i`; key column j at
+    position j.  True = attend.  With `q_offset=0, kv_len=q_len` this is
+    the standard lower-triangular training mask; a length-1 decode query
+    against a cached prefix uses `causal_mask(1, capacity, q_offset=t)`,
+    which both enforces causality AND excludes the not-yet-written tail of
+    the ring buffer (cache index j only holds a valid entry once position
+    j has been written, i.e. j <= t).  `q_offset` may be a traced scalar.
+    """
+    qpos = q_offset + jnp.arange(q_len)
+    return qpos[:, None] >= jnp.arange(kv_len)[None, :]
 
 
 def _active_mesh(explicit: Optional[Mesh]) -> Optional[Mesh]:
@@ -151,6 +175,51 @@ class MultiHeadAttention(Module):
                                                    training=training, rng=rng)
         return out, state
 
+    def apply_cached(self, params, x, kv, *, lengths):
+        """Cache-aware inference forward (the generation hot path).
+
+        `x` is (B, S, D) NEW tokens only; `kv` is a {"k", "v"} dict of
+        (B, C, H, Dh) ring buffers; `lengths` (B,) int32 counts tokens
+        already written per row, so row b's new tokens sit at absolute
+        positions lengths[b]..lengths[b]+S-1 and land at ring indices
+        `position % C`.  Returns (out, new_kv).  Two shapes matter:
+        prefill (B=1, S<=C, lengths=0) and decode (S=1, per-row lengths,
+        ring wrap-around = sliding-window attention).  Multi-token append
+        AFTER a wrap is not supported — the mask below indexes keys by
+        ring slot, which equals position only while writes are monotone
+        within the window (bigdl_tpu/generation/engine.py keeps to that).
+        """
+        b, s, d = x.shape
+        h, hd = self.n_head, self.head_dim
+
+        def proj(name, t):
+            y = t @ params["w" + name]
+            if self.with_bias:
+                y = y + params["b" + name]
+            return y.reshape(b, s, h, hd)
+
+        q, k, v = proj("q", x), proj("k", x), proj("v", x)
+        positions = lengths[:, None] + jnp.arange(s)[None, :]  # (B, S)
+        if self.rope:
+            # keys are stored rope'd at their absolute write position;
+            # the decode query ropes at its own offset, so Q.K stays the
+            # relative-position product regardless of cache state
+            q = apply_rope(q, positions=positions)
+            k = apply_rope(k, positions=positions)
+        cap = kv["k"].shape[1]
+        idx = positions % cap
+        bi = jnp.arange(b)[:, None]
+        new_k = kv["k"].at[bi, idx].set(k.astype(kv["k"].dtype))
+        new_v = kv["v"].at[bi, idx].set(v.astype(kv["v"].dtype))
+        # per-row causal mask over the full ring: (B, S, C) -> (B,1,S,C)
+        mask = jax.vmap(lambda off: causal_mask(s, cap, q_offset=off))(lengths)
+        ctx = dense_attention(q, new_k.astype(q.dtype), new_v.astype(q.dtype),
+                              mask=mask[:, None])
+        out = ctx.reshape(b, s, d) @ params["wo"]
+        if self.with_bias:
+            out = out + params["bo"]
+        return out, {"k": new_k, "v": new_v}
+
 
 class TransformerBlock(Container):
     """Pre-LN transformer decoder/encoder block:
@@ -198,6 +267,19 @@ class TransformerBlock(Container):
         h, _ = c["mlp"].apply(params["mlp"], st.get("mlp", {}), h,
                               training=training, rng=child_rng(rng, 1))
         return x + h, state
+
+    def apply_cached(self, params, x, kv, *, lengths):
+        """Inference-only block forward against a per-layer KV ring
+        buffer (see MultiHeadAttention.apply_cached); returns
+        (out, new_kv)."""
+        c = self.children
+        h, _ = c["ln1"].apply(params["ln1"], {}, x)
+        h, new_kv = c["attn"].apply_cached(params["attn"], h, kv,
+                                           lengths=lengths)
+        x = x + h
+        h, _ = c["ln2"].apply(params["ln2"], {}, x)
+        h, _ = c["mlp"].apply(params["mlp"], {}, h, training=False)
+        return x + h, new_kv
 
 
 class _Mlp(Container):

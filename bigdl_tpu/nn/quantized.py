@@ -524,5 +524,22 @@ class WeightOnlyInt8(Module):
         return self.inner.apply(self._dequantize(params, dtype), state, x,
                                 training=training, rng=rng)
 
+    # -- KV-cache generation protocol (bigdl_tpu.generation) --------------
+    # int8 weight-only IS the decode-class quantization (bandwidth-bound,
+    # halved weight traffic), so the wrapper forwards the cache-aware
+    # protocol and quantize(mode='auto') models drop into GenerationEngine
+    # unchanged.
+
+    def init_cache(self, slots: int, capacity: int, dtype=None):
+        return self.inner.init_cache(
+            slots, capacity, dtype if dtype is not None
+            else (self.compute_dtype or jnp.float32))
+
+    def apply_cached(self, params, tokens, cache):
+        dtype = self.compute_dtype if self.compute_dtype is not None \
+            else jnp.float32
+        return self.inner.apply_cached(self._dequantize(params, dtype),
+                                       tokens, cache)
+
     def output_shape(self, input_shape):
         return self.inner.output_shape(input_shape)

@@ -32,7 +32,8 @@ from bigdl_tpu.serving.batcher import (
     Rejected,
     ServingClosed,
 )
-from bigdl_tpu.serving.metrics import LatencyHistogram, ServingMetrics
+from bigdl_tpu.serving.metrics import (GenerationMetrics, LatencyHistogram,
+                                       ServingMetrics)
 from bigdl_tpu.serving.registry import ModelRegistry, ModelVersion
 from bigdl_tpu.serving.runtime import (
     NonFiniteOutput,
@@ -42,6 +43,7 @@ from bigdl_tpu.serving.runtime import (
 
 __all__ = [
     "DeadlineExceeded",
+    "GenerationMetrics",
     "LatencyHistogram",
     "MicroBatcher",
     "ModelRegistry",

@@ -243,3 +243,167 @@ class ServingMetrics:
             summary.add_scalar(tag, float(value), step)
         summary.add_histogram(f"{prefix}/latency_ms",
                               self.total_ms.values_for_tensorboard(), step)
+
+
+class GenerationMetrics:
+    """Per-token observability for the generation engine
+    (bigdl_tpu/generation/engine.py) — the autoregressive dual of
+    `ServingMetrics`.  The units shift from per-request to per-TOKEN:
+
+      * `ttft_ms` — time-to-first-token (submit -> prefill's sampled
+        token), the interactive-latency number.
+      * `per_token_ms` — decode-step wall time; every in-flight request
+        advances one token per step, so this IS ms/token under load.
+      * `prefill_ms` — on-device prompt fold cost per admission.
+      * `tokens_generated`, active-slot occupancy, rejection counters.
+
+    Same log-bucketed histograms (no per-token memory growth) and the
+    same Summary/TensorBoard export spine as serving.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ttft_ms = LatencyHistogram()
+        self.per_token_ms = LatencyHistogram()
+        self.prefill_ms = LatencyHistogram()
+        self.e2e_ms = LatencyHistogram()
+        self.tokens_generated = 0
+        self.requests_admitted = 0
+        self.requests_completed = 0
+        self.rejected_queue_full = 0
+        self.rejected_shutdown = 0
+        self.rejected_nonfinite = 0
+        self.prefills = 0
+        self.decode_steps = 0
+        self.queue_depth = 0
+        self.queue_depth_peak = 0
+        self.active_slots = 0
+        self.active_slots_peak = 0
+        self.swaps = 0
+
+    # -- recording ---------------------------------------------------------
+
+    def on_admit(self, depth: int) -> None:
+        with self._lock:
+            self.requests_admitted += 1
+            self.queue_depth = depth
+            if depth > self.queue_depth_peak:
+                self.queue_depth_peak = depth
+        _obs.registry().inc("generation/requests_admitted")
+
+    def on_reject(self, reason: str) -> None:
+        with self._lock:
+            if reason == "queue_full":
+                self.rejected_queue_full += 1
+            else:
+                self.rejected_shutdown += 1
+        _obs.registry().inc(f"generation/rejected_{reason}")
+
+    def on_prefill(self, prefill_ms: float, ttft_ms: float) -> None:
+        """One admission: prompt folded, first token sampled."""
+        with self._lock:
+            self.prefills += 1
+            self.tokens_generated += 1  # prefill samples token #1
+            self.prefill_ms.observe(prefill_ms)
+            self.ttft_ms.observe(ttft_ms)
+        _obs.registry().inc("generation/prefills")
+        _obs.registry().inc("generation/tokens")
+
+    def on_tokens(self, n: int, step_ms: float) -> None:
+        """One decode step advancing `n` in-flight requests a token each."""
+        with self._lock:
+            self.decode_steps += 1
+            self.tokens_generated += n
+            self.per_token_ms.observe(step_ms)
+        _obs.registry().inc("generation/tokens", n)
+        _obs.registry().inc("generation/decode_steps")
+
+    def on_complete(self, e2e_ms: float, tokens: int) -> None:
+        with self._lock:
+            self.requests_completed += 1
+            self.e2e_ms.observe(e2e_ms)
+        _obs.registry().inc("generation/requests_completed")
+
+    def on_nonfinite(self) -> None:
+        with self._lock:
+            self.rejected_nonfinite += 1
+        _obs.registry().inc("generation/rejected_nonfinite")
+
+    def on_swap(self) -> None:
+        with self._lock:
+            self.swaps += 1
+        _obs.registry().inc("generation/swaps")
+
+    def set_active(self, n: int) -> None:
+        with self._lock:
+            self.active_slots = n
+            if n > self.active_slots_peak:
+                self.active_slots_peak = n
+
+    # -- read-back ---------------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            snap = {
+                "requests_admitted": self.requests_admitted,
+                "requests_completed": self.requests_completed,
+                "rejected_queue_full": self.rejected_queue_full,
+                "rejected_shutdown": self.rejected_shutdown,
+                "rejected_nonfinite": self.rejected_nonfinite,
+                "tokens_generated": self.tokens_generated,
+                "prefills": self.prefills,
+                "decode_steps": self.decode_steps,
+                "queue_depth_peak": self.queue_depth_peak,
+                "active_slots": self.active_slots,
+                "active_slots_peak": self.active_slots_peak,
+                "swaps": self.swaps,
+                "ttft_ms": {
+                    "p50": round(self.ttft_ms.percentile(50), 3),
+                    "p99": round(self.ttft_ms.percentile(99), 3),
+                    "mean": round(self.ttft_ms.mean_ms, 3),
+                },
+                "ms_per_token": {
+                    "p50": round(self.per_token_ms.percentile(50), 3),
+                    "p99": round(self.per_token_ms.percentile(99), 3),
+                    "mean": round(self.per_token_ms.mean_ms, 3),
+                    "max": round(self.per_token_ms.max_ms, 3),
+                },
+                "prefill_ms": {
+                    "p50": round(self.prefill_ms.percentile(50), 3),
+                    "p99": round(self.prefill_ms.percentile(99), 3),
+                },
+                "e2e_ms": {
+                    "p50": round(self.e2e_ms.percentile(50), 3),
+                    "p99": round(self.e2e_ms.percentile(99), 3),
+                },
+            }
+        reg = _obs.registry()
+        reg.set_gauge("generation/ms_per_token_p50", snap["ms_per_token"]["p50"])
+        reg.set_gauge("generation/ms_per_token_p99", snap["ms_per_token"]["p99"])
+        reg.set_gauge("generation/ttft_p50_ms", snap["ttft_ms"]["p50"])
+        reg.set_gauge("generation/active_slots_peak", snap["active_slots_peak"])
+        return snap
+
+    def export(self, summary, step: int, prefix: str = "generation") -> None:
+        """Scalar set through `utils/summary.Summary` — attach a
+        `ServingSummary` and generation latency lands beside the serving
+        p50/p99 in the same TensorBoard stream."""
+        snap = self.snapshot()
+        scalars = {
+            f"{prefix}/tokens_generated": snap["tokens_generated"],
+            f"{prefix}/ms_per_token_p50": snap["ms_per_token"]["p50"],
+            f"{prefix}/ms_per_token_p99": snap["ms_per_token"]["p99"],
+            f"{prefix}/ttft_p50_ms": snap["ttft_ms"]["p50"],
+            f"{prefix}/ttft_p99_ms": snap["ttft_ms"]["p99"],
+            f"{prefix}/prefill_p99_ms": snap["prefill_ms"]["p99"],
+            f"{prefix}/requests_completed": snap["requests_completed"],
+            f"{prefix}/rejected_queue_full": snap["rejected_queue_full"],
+            f"{prefix}/rejected_nonfinite": snap["rejected_nonfinite"],
+            f"{prefix}/active_slots_peak": snap["active_slots_peak"],
+            f"{prefix}/decode_steps": snap["decode_steps"],
+        }
+        for tag, value in scalars.items():
+            summary.add_scalar(tag, float(value), step)
+        summary.add_histogram(f"{prefix}/ms_per_token",
+                              self.per_token_ms.values_for_tensorboard(),
+                              step)

@@ -46,7 +46,14 @@ class ModelRegistry:
         self._lock = threading.Lock()
         self._versions: Dict[str, ModelVersion] = {}
         self._active: Optional[ModelVersion] = None
-        self._warmup = warmup
+        self._warmups: List[Callable[[Any, Any], None]] = \
+            [warmup] if warmup is not None else []
+
+    def add_warmup(self, warmup: Callable[[Any, Any], None]) -> None:
+        """Join the pre-activation warmup chain (e.g. a GenerationEngine
+        layering its prefill/decode executables behind the same registry:
+        one hot-swap warms every consumer before the version goes live)."""
+        self._warmups.append(warmup)
 
     # -- hot path ----------------------------------------------------------
 
@@ -69,12 +76,12 @@ class ModelRegistry:
         params = jax.device_put(params)
         state = jax.device_put(state)
         mv = ModelVersion(str(version), params, state, time.time(), source)
-        if self._warmup is not None:
+        for warmup in self._warmups:
             # compile/warm BEFORE the swap: requests keep hitting the old
             # version until the new one is ready to serve at full speed
             with _obs.span("registry.warmup", cat="serving",
                            version=mv.version):
-                self._warmup(mv.params, mv.state)
+                warmup(mv.params, mv.state)
         with self._lock:
             self._versions[mv.version] = mv
             if activate or self._active is None:

@@ -103,6 +103,7 @@ class ServingRuntime:
         self.summary = summary
         self._example = example_input  # one-row example for AOT warmup
         self._export_step = 0
+        self._generation = None  # GenerationEngine via enable_generation()
 
         def fwd(p, s, x):
             out, _ = model.apply(p, s, x, training=False)
@@ -320,6 +321,35 @@ class ServingRuntime:
                     for i in range(len(outs[0]))]
         return np.concatenate(outs, axis=0)
 
+    # -- autoregressive generation ----------------------------------------
+
+    def enable_generation(self, config=None, **config_kw):
+        """Attach a `GenerationEngine` (bigdl_tpu.generation) behind this
+        runtime's registry: prefill/decode executables are AOT-warmed for
+        the active version now, every later `swap()`/`swap_checkpoint()`
+        warms them BEFORE activation (the registry warmup chain), and
+        `export_metrics()` reports the per-token surface alongside the
+        batch-forward latencies.  The model must be cache-aware
+        (`init_cache`/`apply_cached` — TransformerLM or a quantized
+        wrapper).  Returns the engine (`submit()`/`generate()` live there;
+        `close()` here closes it too)."""
+        if self._generation is not None:
+            return self._generation
+        from bigdl_tpu.generation import GenerationConfig, GenerationEngine
+
+        cfg = config or GenerationConfig(**config_kw)
+        if cfg.strict_transfers is None:
+            cfg.strict_transfers = self.config.strict_transfers
+        self._generation = GenerationEngine(
+            self.model, config=cfg, registry=self.registry,
+            summary=self.summary)
+        return self._generation
+
+    @property
+    def generation(self):
+        """The attached GenerationEngine, or None."""
+        return self._generation
+
     # -- versioning --------------------------------------------------------
 
     def swap(self, version: str, params: Any, state: Any = None) -> None:
@@ -348,9 +378,13 @@ class ServingRuntime:
                 step = self._export_step
             self._export_step = step + 1
             self.metrics.export(self.summary, step)
+        if self._generation is not None:
+            snap["generation"] = self._generation.export_metrics(step)
         return snap
 
     def close(self, drain: bool = True, timeout: Optional[float] = 30.0) -> None:
+        if self._generation is not None:
+            self._generation.close(drain=drain, timeout=timeout)
         self._batcher.close(drain=drain, timeout=timeout)
         if self.summary is not None:
             self.export_metrics()

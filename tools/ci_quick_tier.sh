@@ -73,6 +73,18 @@ if [ "$rc" -eq 0 ]; then
     elapsed=$(( $(date +%s) - start ))
 fi
 
+if [ "$rc" -eq 0 ]; then
+    # generation lane: 32 concurrent prompts through the prefill/decode
+    # engine — the executable set must stay <= buckets x 2 with zero
+    # steady-state recompile alarms, greedy output must match a full
+    # re-forward loop, and a hot-swap under traffic must not re-trace
+    remaining=$(( BUDGET - elapsed ))
+    [ "$remaining" -lt 30 ] && remaining=30
+    timeout --signal=TERM "$remaining" python tools/generation_smoke.py
+    rc=$?
+    elapsed=$(( $(date +%s) - start ))
+fi
+
 if [ "$rc" -eq 124 ]; then
     echo "FAIL: quick tier exceeded the ${BUDGET}s budget (killed)" >&2
     exit 1
