@@ -77,6 +77,9 @@ DEFAULT_HOT_ROOTS = (
     r"Evaluator\.test$",
     r"ServingRuntime\._dispatch$",
     r"MicroBatcher\._loop$",
+    r"FleetRouter\._loop$",
+    r"FleetRouter\._complete_loop$",
+    r"FleetAutoscaler\._loop$",
     r"DeviceFeed\._worker$",
     r"DeviceFeed\.__next__$",
     r"InlineFeed\.__next__$",

@@ -64,6 +64,12 @@ _store: Optional[ExecutableStore] = None
 _store_root: Optional[str] = None
 _xla_layer_root: Optional[str] = None
 
+# Process-level live-executable layer (opt-in via `process_scope=`):
+# replicas of one fleet in one process share already-loaded executables
+# by key, skipping even the disk read + deserialize of a store hit.
+_live_lock = threading.Lock()
+_live: Dict[str, Any] = {}
+
 
 # -- gating ----------------------------------------------------------------
 
@@ -88,6 +94,8 @@ def set_cache_dir(path: Optional[str]) -> None:
     global _override
     with _lock:
         _override = path if path is None else str(path)
+    with _live_lock:
+        _live.clear()
     _sync_layers()
 
 
@@ -96,6 +104,8 @@ def reset() -> None:
     global _override
     with _lock:
         _override = _UNSET
+    with _live_lock:
+        _live.clear()
     _sync_layers()
 
 
@@ -151,15 +161,24 @@ def store() -> Optional[ExecutableStore]:
 
 def load_or_compile(jit_fn, args: Tuple[Any, ...], *,
                     signature: Optional[str] = None,
-                    extra_key: Optional[Dict[str, Any]] = None):
+                    extra_key: Optional[Dict[str, Any]] = None,
+                    process_scope: Optional[str] = None):
     """Executable for `jit_fn(*args)` via the store.
 
     Returns `(callable, status)`:
 
       * status "off"   — cache disabled; `callable` IS `jit_fn` untouched.
-      * status "hit"   — deserialized executable from disk (no compile).
+      * status "hit"   — deserialized executable from disk (no compile),
+        or — with `process_scope` set — the already-loaded executable
+        shared by an earlier caller in THIS process (no disk read).
       * status "miss"  — compiled AOT now, serialized into the store.
       * status "error" — lowering/packing failed; plain `jit_fn` returned.
+
+    `process_scope` opts in to the process-level live layer: executables
+    resolved under the same (scope, content key) are shared across
+    callers in one process — how fleet replicas of the same model warm
+    without touching disk.  Live hits count in `compile/cache_hits`
+    (they ARE cache hits) and additionally `compile/cache_hits_live`.
 
     The returned callable takes the exact same positional args.  All
     cache failures degrade to a real compile — never to a raised error.
@@ -179,6 +198,20 @@ def load_or_compile(jit_fn, args: Tuple[Any, ...], *,
                        "falling back to the jit path", sig, e)
         reg.inc("compile/cache_errors")
         return jit_fn, "error"
+
+    live_key = None
+    if process_scope is not None:
+        live_key = f"{process_scope}:{key}"
+        with _live_lock:
+            shared = _live.get(live_key)
+        if shared is not None:
+            reg.inc("compile/cache_hits")
+            reg.inc("compile/cache_hits_live")
+            if mon is not None:
+                mon.note_cache_load(sig, 0.0)
+            logger.info("compilecache: %s shared live executable "
+                        "(scope %s, key %s)", sig, process_scope, key[:12])
+            return shared, "hit"
 
     had_entry = st.has(key)
     blob = st.get(key)
@@ -203,6 +236,9 @@ def load_or_compile(jit_fn, args: Tuple[Any, ...], *,
                 mon.note_cache_load(sig, dt)
             logger.info("compilecache: %s loaded from cache in %.1f ms "
                         "(key %s)", sig, dt * 1e3, key[:12])
+            if live_key is not None:
+                with _live_lock:
+                    _live[live_key] = compiled
             return compiled, "hit"
         except Exception as e:
             logger.warning("compilecache: entry %s for %r failed to "
@@ -234,6 +270,9 @@ def load_or_compile(jit_fn, args: Tuple[Any, ...], *,
         logger.warning("compilecache: could not serialize executable for %r "
                        "(%s); it will recompile on next cold start", sig, e)
         reg.inc("compile/cache_errors")
+    if live_key is not None:
+        with _live_lock:
+            _live[live_key] = compiled
     return compiled, "miss"
 
 
@@ -242,6 +281,7 @@ def stats() -> Dict[str, float]:
     reg = _obs.registry()
     return {
         "hits": reg.get("compile/cache_hits"),
+        "hits_live": reg.get("compile/cache_hits_live"),
         "misses": reg.get("compile/cache_misses"),
         "corrupt": reg.get("compile/cache_corrupt"),
         "errors": reg.get("compile/cache_errors"),

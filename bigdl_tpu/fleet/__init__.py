@@ -1,0 +1,37 @@
+"""bigdl_tpu.fleet — multi-tenant front door over serving replicas.
+
+One process, many models, many SLOs: the fleet layer multiplexes
+tenants over N in-process `ServingRuntime`/`GenerationEngine` replicas
+(ROADMAP item 5 — the scenario BigDL pitched as "DL as a standard
+multi-tenant cluster workload", re-grounded on TPU serving economics).
+
+  * `tenancy`    — admission classes (interactive/batch/best_effort
+    tiers), bounded per-tenant queues, deficit-weighted fair share.
+  * `replica`    — replica lifecycle (READY/DRAINING/DEAD), the
+    SIGKILL-analog `kill()` that bounces in-flight work back to the
+    router with zero silent drops.
+  * `router`     — the front door: one dispatcher thread, completion
+    chaining via future callbacks, redispatch on replica loss, warm
+    scale-out accounting.
+  * `autoscaler` — hysteretic grow/retire off the obs MetricsRegistry
+    signals (queue depth, p99, steady-recompile alarm veto).
+
+See docs/fleet.md for the tenancy model, env vars, and when NOT to
+enable the fleet layer (one tenant + one model needs none of this).
+"""
+
+from bigdl_tpu.fleet.autoscaler import AutoscalerConfig, FleetAutoscaler
+from bigdl_tpu.fleet.replica import (DEAD, DRAINING, READY,
+                                     GenerationAdapter, Replica, ReplicaDead)
+from bigdl_tpu.fleet.router import FleetRouter
+from bigdl_tpu.fleet.tenancy import (TIER_DEADLINES_MS, TIERS,
+                                     FairShareScheduler, FleetRequest,
+                                     TenantConfig, TenantQueue)
+
+__all__ = [
+    "AutoscalerConfig", "DEAD", "DRAINING", "FairShareScheduler",
+    "GenerationAdapter",
+    "FleetAutoscaler", "FleetRequest", "FleetRouter", "READY", "Replica",
+    "ReplicaDead", "TenantConfig", "TenantQueue", "TIERS",
+    "TIER_DEADLINES_MS",
+]

@@ -1,0 +1,198 @@
+"""FleetAutoscaler: grow/retire replicas from signals the fleet already emits.
+
+No new telemetry: the autoscaler reads the queues the router owns, the
+per-tenant p99 the `ServingMetrics` histograms already track, and the
+CompileMonitor's `compile/steady_recompiles` alarm counter — the
+observability plane IS the control plane.
+
+Decisions are hysteretic, the classic way:
+
+  * GROW  after `grow_after` CONSECUTIVE high ticks (total queue depth ≥
+    `high_queue_depth`, or worst-tenant p99 ≥ `high_p99_ms`) while below
+    `max_replicas`.
+  * RETIRE after `shrink_after` consecutive low ticks (depth ≤
+    `low_queue_depth` and p99 healthy) while above `min_replicas` —
+    VETOED whenever the steady-recompile alarm fired since the last
+    tick: a fleet that is recompiling in steady state must not also
+    churn replicas (retire→regrow would repeat the compiles the alarm
+    is complaining about).
+  * Every action starts a `cooldown_ticks` refractory window, and any
+    neutral tick resets both streaks — oscillating load holds.
+
+Config defaults come from `BIGDL_TPU_FLEET_*` env vars (docs/fleet.md
+lists them) so a deployment tunes thresholds without code.  `tick()` is
+a pure, synchronous decision step driven by an injectable `signals_fn`
+— tests feed deterministic signal sequences and assert the decision
+trace; `start()` merely runs `tick()` on a `fleet-autoscaler` wall-clock
+thread.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from bigdl_tpu import obs as _obs
+
+logger = logging.getLogger("bigdl_tpu.fleet")
+
+
+def _env(name: str, default: float) -> float:
+    val = os.environ.get(name, "").strip()
+    if not val:
+        return default
+    try:
+        return float(val)
+    except ValueError:
+        logger.warning("fleet: ignoring non-numeric %s=%r", name, val)
+        return default
+
+
+@dataclass
+class AutoscalerConfig:
+    """Hysteresis thresholds; every default reads its BIGDL_TPU_FLEET_*
+    env var so deployments tune without code."""
+
+    min_replicas: int = field(
+        default_factory=lambda: int(_env("BIGDL_TPU_FLEET_MIN_REPLICAS", 1)))
+    max_replicas: int = field(
+        default_factory=lambda: int(_env("BIGDL_TPU_FLEET_MAX_REPLICAS", 4)))
+    high_queue_depth: float = field(
+        default_factory=lambda: _env("BIGDL_TPU_FLEET_HIGH_QUEUE", 16))
+    high_p99_ms: float = field(
+        default_factory=lambda: _env("BIGDL_TPU_FLEET_HIGH_P99_MS", 200.0))
+    low_queue_depth: float = field(
+        default_factory=lambda: _env("BIGDL_TPU_FLEET_LOW_QUEUE", 1))
+    grow_after: int = field(
+        default_factory=lambda: int(_env("BIGDL_TPU_FLEET_GROW_AFTER", 3)))
+    shrink_after: int = field(
+        default_factory=lambda: int(_env("BIGDL_TPU_FLEET_SHRINK_AFTER", 6)))
+    cooldown_ticks: int = field(
+        default_factory=lambda: int(_env("BIGDL_TPU_FLEET_COOLDOWN", 5)))
+    interval_s: float = field(
+        default_factory=lambda: _env("BIGDL_TPU_FLEET_AUTOSCALE_INTERVAL", 1.0))
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+
+
+class FleetAutoscaler:
+    """Hysteretic replica-count controller over a FleetRouter."""
+
+    def __init__(self, router, config: Optional[AutoscalerConfig] = None,
+                 signals_fn: Optional[Callable[[], Dict[str, float]]] = None):
+        self.router = router
+        self.config = config or AutoscalerConfig()
+        self._signals_fn = signals_fn or self._default_signals
+        self._high = 0
+        self._low = 0
+        self._cooldown = 0
+        self._last_alarms = _obs.registry().get("compile/steady_recompiles")
+        self.decisions: list = []  # (tick_index, decision) trace
+        self._ticks = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- signals ------------------------------------------------------------
+
+    def _default_signals(self) -> Dict[str, float]:
+        """Live signals off the router + registry (the injectable seam
+        tests replace with scripted sequences)."""
+        with self.router._lock:
+            queues = list(self.router._tenants.values())
+        p99 = max((q.metrics.total_ms.percentile(99) for q in queues),
+                  default=0.0)
+        return {
+            "queue_depth": float(self.router.queue_depth_total()),
+            "p99_ms": float(p99),
+            "recompile_alarms":
+                _obs.registry().get("compile/steady_recompiles"),
+        }
+
+    # -- the decision step --------------------------------------------------
+
+    def tick(self) -> str:
+        """One synchronous decision: returns "grow", "shrink", or "hold"
+        (and performs the action on the router)."""
+        cfg = self.config
+        sig = self._signals_fn()
+        depth = sig.get("queue_depth", 0.0)
+        p99 = sig.get("p99_ms", 0.0)
+        alarms = sig.get("recompile_alarms", 0.0)
+        alarm_delta = alarms - self._last_alarms
+        self._last_alarms = alarms
+
+        high = depth >= cfg.high_queue_depth or p99 >= cfg.high_p99_ms
+        low = depth <= cfg.low_queue_depth and p99 < cfg.high_p99_ms
+        if high:
+            self._high += 1
+            self._low = 0
+        elif low:
+            self._low += 1
+            self._high = 0
+        else:  # neutral tick resets both streaks — oscillation holds
+            self._high = 0
+            self._low = 0
+        if self._cooldown > 0:
+            self._cooldown -= 1
+
+        n = self.router.n_replicas()
+        decision = "hold"
+        if (self._cooldown == 0 and self._high >= cfg.grow_after
+                and n < cfg.max_replicas):
+            self.router.add_replica()
+            decision = "grow"
+        elif (self._cooldown == 0 and self._low >= cfg.shrink_after
+                and n > cfg.min_replicas):
+            if alarm_delta > 0:
+                logger.warning(
+                    "fleet autoscaler: retire vetoed — %d steady-state "
+                    "recompile alarm(s) since last tick", int(alarm_delta))
+                decision = "veto"
+            elif self.router.retire_replica() is not None:
+                decision = "shrink"
+        if decision in ("grow", "shrink"):
+            self._high = 0
+            self._low = 0
+            self._cooldown = cfg.cooldown_ticks
+        reg = _obs.registry()
+        reg.set_gauge("fleet/autoscaler_high_streak", self._high)
+        reg.set_gauge("fleet/autoscaler_low_streak", self._low)
+        if decision != "hold":
+            reg.inc(f"fleet/autoscaler_{decision}")
+            logger.info("fleet autoscaler: %s (depth=%.0f p99=%.1fms "
+                        "replicas=%d)", decision, depth, p99,
+                        self.router.n_replicas())
+        self.decisions.append((self._ticks, decision))
+        self._ticks += 1
+        return decision
+
+    # -- wall-clock driver --------------------------------------------------
+
+    def start(self) -> "FleetAutoscaler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fleet-autoscaler", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — a bad tick must not kill scaling
+                logger.exception("fleet autoscaler tick failed")
+
+    def close(self, timeout: Optional[float] = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None

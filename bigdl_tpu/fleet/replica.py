@@ -1,0 +1,157 @@
+"""Replica: one serving backend under the fleet router.
+
+Wraps a `ServingRuntime` (or anything with `submit(x, deadline_ms)` →
+future and `close(drain, timeout)`) with the lifecycle the router needs:
+
+    READY ──drain()──> DRAINING ──idle──> DEAD
+      └──────────────── kill() ────────────> DEAD
+
+  * READY     — the dispatcher may route new work here.
+  * DRAINING  — no new picks; outstanding requests finish normally
+    (graceful retirement: scale-in, hot maintenance).
+  * DEAD      — `kill()` is the SIGKILL analogue: every outstanding
+    inner future is failed with `ReplicaDead` IMMEDIATELY, which fires
+    the router's done-callbacks and requeues the requests onto their
+    tenant queues for redispatch.  The backing runtime is then torn down
+    off the dispatch path (the router's reaper thread).
+
+Outstanding accounting is a set of inner futures guarded by the
+replica's own lock; the dispatcher reads `outstanding()` for its
+least-loaded pick, and `wait_idle()` is the drain barrier.
+
+Kill/complete races are benign by construction: `_Future` fires its
+done-callbacks exactly once (first settle wins), so a request that
+completes in the same instant the replica dies either returns its real
+result or redispatches and recomputes — predictions are deterministic,
+so at-least-once redispatch never changes an answer, and an accepted
+request is never silently dropped.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional, Set
+
+from bigdl_tpu.serving.batcher import _Future
+
+READY = "ready"
+DRAINING = "draining"
+DEAD = "dead"
+
+
+class ReplicaDead(RuntimeError):
+    """The replica holding this request died before completing it; the
+    router requeues the request onto its tenant queue (not an SLO
+    failure — redispatch preserves the original deadline)."""
+
+
+class Replica:
+    """One backend runtime + lifecycle state + outstanding accounting."""
+
+    def __init__(self, name: str, runtime, *, max_inflight: int = 64):
+        self.name = name
+        self.runtime = runtime
+        self.max_inflight = int(max_inflight)
+        self.state = READY
+        self.created_at = time.perf_counter()
+        self._lock = threading.Lock()
+        self._outstanding: Set[_Future] = set()
+        self._idle = threading.Event()
+        self._idle.set()
+
+    # -- dispatch path (router's dispatcher thread) -------------------------
+
+    def available(self) -> bool:
+        return self.state == READY and self.outstanding() < self.max_inflight
+
+    def outstanding(self) -> int:
+        with self._lock:
+            return len(self._outstanding)
+
+    def submit(self, x, deadline_ms: Optional[float]) -> _Future:
+        """Route one request into the backing runtime.  Raises
+        `ReplicaDead` if the replica is no longer READY (the dispatcher
+        rechecks, but kill can win the race) and lets the runtime's own
+        admission errors (`Rejected`, `ServingClosed`) propagate."""
+        with self._lock:
+            if self.state != READY:
+                raise ReplicaDead(f"replica {self.name!r} is {self.state}")
+            inner = self.runtime.submit(x, deadline_ms=deadline_ms)
+            self._outstanding.add(inner)
+            self._idle.clear()
+        inner.add_done_callback(self._forget)
+        return inner
+
+    def _forget(self, fut: _Future) -> None:
+        with self._lock:
+            self._outstanding.discard(fut)
+            if not self._outstanding:
+                self._idle.set()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def drain(self) -> None:
+        """Stop new picks; outstanding work finishes normally."""
+        with self._lock:
+            if self.state == READY:
+                self.state = DRAINING
+
+    def wait_idle(self, timeout: Optional[float] = 30.0) -> bool:
+        return self._idle.wait(timeout)
+
+    def kill(self) -> int:
+        """SIGKILL analogue: mark DEAD and fail every outstanding inner
+        future with `ReplicaDead` NOW — their done-callbacks (the
+        router's completion chain) requeue the requests.  Returns how
+        many futures were failed.  Does NOT close the runtime — a dead
+        process doesn't run its own destructor; the router's reaper
+        does that off-path."""
+        with self._lock:
+            if self.state == DEAD:
+                return 0
+            self.state = DEAD
+            doomed = list(self._outstanding)
+            self._outstanding.clear()
+            self._idle.set()
+        err = ReplicaDead(f"replica {self.name!r} killed with "
+                          f"{len(doomed)} requests in flight")
+        for fut in doomed:
+            fut.set_error(err)
+        return len(doomed)
+
+    def close(self, drain: bool = True, timeout: Optional[float] = 30.0) -> None:
+        """Graceful teardown of the backing runtime."""
+        with self._lock:
+            self.state = DEAD
+        self.runtime.close(drain=drain, timeout=timeout)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"Replica({self.name!r}, {self.state}, "
+                f"outstanding={self.outstanding()})")
+
+
+class GenerationAdapter:
+    """Duck-type a `GenerationEngine` to the replica runtime contract
+    (`submit(x, deadline_ms)` / `close(drain, timeout)`).
+
+    The fleet enforces deadlines at its OWN queues (pre-dispatch expiry
+    in tenancy.py); a dispatched generation runs to completion — an
+    autoregressive request cannot be meaningfully truncated by a
+    deadline without changing its answer, so `deadline_ms` stops
+    applying once the prompt reaches the engine.  Fixed sampling
+    settings for the tenant ride in `submit_kw`."""
+
+    def __init__(self, engine, **submit_kw):
+        self.engine = engine
+        self.submit_kw = submit_kw
+        self.config = getattr(engine, "config", None)
+
+    def submit(self, x, deadline_ms: Optional[float] = None) -> _Future:
+        return self.engine.submit(x, **self.submit_kw)
+
+    def close(self, drain: bool = True, timeout: Optional[float] = 30.0) -> None:
+        self.engine.close(drain=drain, timeout=timeout)
+
+
+ReplicaFactory = Callable[[str], object]

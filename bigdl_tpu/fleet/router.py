@@ -1,0 +1,540 @@
+"""FleetRouter: the multi-tenant front door over N serving replicas.
+
+One dispatcher thread (`fleet-router`) pulls from the per-tenant bounded
+queues (tenancy.py) in strict-tier + deficit-weighted fair-share order
+and places each request on the least-loaded READY replica.  Completion
+is CHAINED, not polled: the replica's inner future fires a done-callback
+(`_Future.add_done_callback`) that settles the caller's outer future —
+no per-request waiter threads, so a thousand in-flight requests cost a
+thousand closures, not a thousand stacks.
+
+Failure semantics — the part worth being precise about:
+
+  * An ACCEPTED request (admit() returned a future) has exactly three
+    endings: a result, a `DeadlineExceeded`, or a loud `Rejected` after
+    the redispatch budget.  Silently dropped is not an ending.
+  * A replica dying mid-flight (`kill_replica`, the chaos
+    `ReplicaKillFault`) fails its outstanding inner futures with
+    `ReplicaDead`; the done-callbacks requeue those requests at the
+    HEAD of their tenant queues and the dispatcher places them on a
+    surviving replica with their ORIGINAL deadline.  At-least-once
+    redispatch: a kill/complete photo-finish may recompute a request on
+    the new replica — deterministic forwards make that invisible.
+  * Runtime backpressure (inner queue full) requeues without burning
+    redispatch budget; replica loss burns budget (`max_redispatch`,
+    then a loud `Rejected`).
+
+The dead replica's runtime is torn down on a `fleet-reaper-*` thread —
+never on the dispatcher (a stuck XLA teardown must not stall dispatch).
+
+Scale-out is warm by construction: `add_replica` builds the runtime
+through the caller's factory, and because every replica warms through
+`compilecache.load_or_compile(..., process_scope=...)`, the second
+replica of a model family reuses the first one's live executables —
+the observed `compile/cache_hits` delta lands in `fleet/warmup_reused`.
+
+`pause()/resume()` freeze dispatch (admission stays open) so tests can
+stage an exact queue state and then observe pure scheduler order in
+`dispatch_log`.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from bigdl_tpu import obs as _obs
+from bigdl_tpu.compilecache import enabled as _cc_enabled
+from bigdl_tpu.serving.batcher import (DeadlineExceeded, Rejected,
+                                       ServingClosed, _Future)
+from bigdl_tpu.fleet.replica import (DEAD, READY, Replica, ReplicaDead,
+                                     ReplicaFactory)
+from bigdl_tpu.fleet.tenancy import (FairShareScheduler, FleetRequest,
+                                     TenantConfig, TenantQueue)
+
+logger = logging.getLogger("bigdl_tpu.fleet")
+
+
+from bigdl_tpu.optim.predictor import _batch_rows  # noqa: E402 — shared
+# row-count helper (Table/tuple/array aware); serving uses the same one
+
+
+class FleetRouter:
+    """Front-door router: per-tenant admission -> fair share -> replicas.
+
+    All queue/scheduler/replica-list state is owned by `self._lock` (a
+    Condition); the dispatcher thread is the only consumer of the
+    queues, done-callbacks only requeue/notify under the same lock, so
+    tpu_lint's thread-ownership rules see one lock per shared container.
+    """
+
+    def __init__(self, replica_factory: ReplicaFactory, *,
+                 n_replicas: int = 1,
+                 tenants: Sequence[Union[TenantConfig, dict]] = (),
+                 quantum_rows: float = 8.0,
+                 max_redispatch: int = 5,
+                 max_inflight_per_replica: int = 64,
+                 name: str = "fleet"):
+        self.name = name
+        self._factory = replica_factory
+        self._scheduler = FairShareScheduler(quantum_rows=quantum_rows)
+        self._max_redispatch = int(max_redispatch)
+        self._max_inflight = int(max_inflight_per_replica)
+        self._tenants: Dict[str, TenantQueue] = {}
+        self._replicas: List[Replica] = []
+        self._replica_seq = 0
+        self._closed = False
+        self._stop = False
+        self._paused = False
+        self._dispatched = 0
+        self._chaos = None
+        self._reapers: List[threading.Thread] = []
+        # the dispatch decision record: (tenant, cid, replica) per pick,
+        # appended under the lock — tests read scheduler order off it
+        self.dispatch_log: List[Tuple[str, int, str]] = []
+        self._lock = threading.Condition()
+        # settlement queue: inner done-callbacks (which run on the
+        # replica BATCHER threads, i.e. the compute-critical path) only
+        # enqueue here; the fleet-complete thread does the per-request
+        # bookkeeping (tenant metrics, meta, outer settle) off-path
+        self._done_lock = threading.Condition()
+        self._done_q: deque = deque()
+        self._settling = 0
+        self._stop_done = False
+        for t in tenants:
+            self.add_tenant(t)
+        for _ in range(int(n_replicas)):
+            self.add_replica()
+        self._done_thread = threading.Thread(target=self._complete_loop,
+                                             name="fleet-complete",
+                                             daemon=True)
+        self._done_thread.start()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fleet-router", daemon=True)
+        self._thread.start()
+
+    # -- tenancy ------------------------------------------------------------
+
+    def add_tenant(self, config: Union[TenantConfig, dict]) -> TenantQueue:
+        if isinstance(config, dict):
+            config = TenantConfig(**config)
+        with self._lock:
+            if config.name in self._tenants:
+                raise ValueError(f"tenant {config.name!r} already registered")
+            q = TenantQueue(config)
+            self._tenants[config.name] = q
+            return q
+
+    def tenants(self) -> List[str]:
+        with self._lock:
+            return sorted(self._tenants)
+
+    # -- replica lifecycle --------------------------------------------------
+
+    def add_replica(self) -> str:
+        """Grow the fleet by one replica.  The factory builds (and
+        warms) the runtime; with the compilecache on, warmup resolves
+        through the process-scoped live layer, so the cache-hit delta
+        observed here IS the work scale-out did not repeat
+        (`fleet/warmup_reused`)."""
+        reg = _obs.registry()
+        with self._lock:
+            self._replica_seq += 1
+            rname = f"{self.name}-r{self._replica_seq}"
+        hits_before = reg.get("compile/cache_hits")
+        t0 = time.perf_counter()
+        runtime = self._factory(rname)
+        warm_ms = (time.perf_counter() - t0) * 1e3
+        reused = reg.get("compile/cache_hits") - hits_before
+        if reused > 0:
+            reg.inc("fleet/warmup_reused", reused)
+        cap = getattr(getattr(runtime, "config", None), "capacity", None)
+        max_inflight = (min(self._max_inflight, int(cap))
+                        if cap else self._max_inflight)
+        replica = Replica(rname, runtime, max_inflight=max_inflight)
+        with self._lock:
+            self._replicas.append(replica)
+            self._set_replica_gauge_locked()
+            self._lock.notify_all()
+        reg.set_gauge("fleet/scaleout_warm_ms", warm_ms)
+        logger.info("fleet %s: replica %s up in %.1f ms (cache %s, "
+                    "%d executables reused)", self.name, rname, warm_ms,
+                    "on" if _cc_enabled() else "off", int(reused))
+        return rname
+
+    def retire_replica(self, name: Optional[str] = None,
+                       timeout: Optional[float] = 30.0) -> Optional[str]:
+        """Graceful scale-in: drain the least-loaded READY replica (or
+        `name`), wait for its in-flight work, close it.  Returns the
+        retired name, or None if no replica was eligible."""
+        with self._lock:
+            ready = [r for r in self._replicas if r.state == READY]
+            if name is not None:
+                ready = [r for r in ready if r.name == name]
+            if not ready or (name is None and len(ready) <= 1):
+                return None  # never drain the last replica implicitly
+            cand = min(ready, key=lambda r: r.outstanding())
+            cand.drain()
+        if not cand.wait_idle(timeout):
+            logger.warning("fleet %s: replica %s did not drain in %.0fs",
+                           self.name, cand.name, timeout or 0)
+        cand.close(drain=True, timeout=timeout)
+        with self._lock:
+            if cand in self._replicas:
+                self._replicas.remove(cand)
+            self._set_replica_gauge_locked()
+        _obs.registry().inc("fleet/replicas_retired")
+        logger.info("fleet %s: replica %s retired", self.name, cand.name)
+        return cand.name
+
+    def kill_replica(self, name: Optional[str] = None) -> Optional[str]:
+        """SIGKILL analogue (the chaos lane): drop a replica NOW.  Its
+        outstanding requests fail with `ReplicaDead`, requeue through
+        the done-callbacks, and redispatch to survivors; the dead
+        runtime is torn down on a reaper thread, off the dispatch
+        path."""
+        with self._lock:
+            cands = [r for r in self._replicas if r.state != DEAD]
+            if name is not None:
+                cands = [r for r in cands if r.name == name]
+            if not cands:
+                return None
+            # default target: the busiest replica (kill where it hurts)
+            cand = max(cands, key=lambda r: r.outstanding())
+            self._replicas.remove(cand)
+            self._set_replica_gauge_locked()
+        n_inflight = cand.kill()  # callbacks requeue under self._lock
+        _obs.registry().inc("fleet/replica_kills")
+        _obs.instant("fleet.replica_kill", cat="fleet", replica=cand.name,
+                     inflight=n_inflight)
+        reaper = threading.Thread(
+            target=self._reap, args=(cand,),
+            name=f"fleet-reaper-{cand.name}", daemon=True)
+        reaper.start()
+        with self._lock:
+            self._reapers.append(reaper)
+            self._lock.notify_all()
+        logger.warning("fleet %s: replica %s KILLED with %d in flight",
+                       self.name, cand.name, n_inflight)
+        return cand.name
+
+    @staticmethod
+    def _reap(replica: Replica) -> None:
+        try:
+            replica.runtime.close(drain=False, timeout=10.0)
+        except Exception:  # noqa: BLE001 — a dead replica's teardown may rot
+            logger.exception("fleet: reaping replica %s failed", replica.name)
+
+    def replicas(self) -> List[str]:
+        with self._lock:
+            return [r.name for r in self._replicas]
+
+    def n_replicas(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas if r.state == READY)
+
+    def _set_replica_gauge_locked(self) -> None:
+        _obs.registry().set_gauge(
+            "fleet/replicas",
+            sum(1 for r in self._replicas if r.state == READY))
+
+    # -- chaos / test hooks -------------------------------------------------
+
+    def set_chaos(self, hook) -> None:
+        """`hook.on_dispatch(n_dispatched, router)` fires after every
+        dispatch decision, outside the lock (it may kill replicas)."""
+        self._chaos = hook
+
+    def pause(self) -> None:
+        """Freeze dispatch (admission stays open) — tests stage a queue
+        state, then `resume()` and read pure scheduler order from
+        `dispatch_log`."""
+        with self._lock:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._lock:
+            self._paused = False
+            self._lock.notify_all()
+
+    # -- admission ----------------------------------------------------------
+
+    def submit(self, tenant: str, x,
+               deadline_ms: Optional[float] = None) -> _Future:
+        """Async admission for `tenant`: returns the OUTER future.
+        Deadline defaults to the tenant's tier class; it is absolute
+        from now and survives redispatch."""
+        rows = _batch_rows(x)
+        with self._lock:
+            q = self._tenants.get(tenant)
+            if q is None:
+                raise KeyError(f"unknown tenant {tenant!r}; "
+                               f"registered: {sorted(self._tenants)}")
+            if self._closed:
+                q.metrics.on_reject("shutdown")
+                raise ServingClosed("fleet router is closed")
+            if deadline_ms is None:
+                deadline_ms = q.config.effective_deadline_ms
+            deadline = (time.perf_counter() + deadline_ms / 1e3
+                        if deadline_ms is not None else None)
+            req = FleetRequest(tenant, x, rows, deadline)
+            q.admit(req)  # raises Rejected when the tenant queue is full
+            self._lock.notify_all()
+        _obs.instant("fleet.admit", cat="fleet", cid=req.cid, tenant=tenant,
+                     rows=rows)
+        _obs.registry().inc(q.k_admitted)
+        return req.future
+
+    def predict(self, tenant: str, x, deadline_ms: Optional[float] = None,
+                timeout: Optional[float] = 60.0):
+        """Blocking single-request predict through the front door."""
+        return self.submit(tenant, x, deadline_ms).result(timeout)
+
+    def queue_depth_total(self) -> int:
+        with self._lock:
+            return sum(len(q) for q in self._tenants.values())
+
+    # -- dispatcher ---------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                pick = None
+                while pick is None:
+                    if self._stop:
+                        return
+                    now = time.perf_counter()
+                    for q in self._tenants.values():
+                        q.expire(now)
+                    if not self._paused:
+                        pick = self._pick_locked()
+                    if pick is None:
+                        self._lock.wait(0.02)
+                req, replica = pick
+                req.t_dispatch = time.perf_counter()
+                self.dispatch_log.append((req.tenant, req.cid, replica.name))
+                self._dispatched += 1
+                n = self._dispatched
+            self._dispatch_one(req, replica, n)
+
+    def _pick_locked(self) -> Optional[Tuple[FleetRequest, Replica]]:
+        queues = [q for q in self._tenants.values() if len(q)]
+        if not queues:
+            return None
+        replica = None
+        for r in self._replicas:  # least-loaded READY replica
+            if r.available() and (replica is None
+                                  or r.outstanding() < replica.outstanding()):
+                replica = r
+        if replica is None:
+            return None
+        q = self._scheduler.pick_next(queues)
+        if q is None:
+            return None
+        return q.pop(), replica
+
+    def _dispatch_one(self, req: FleetRequest, replica: Replica,
+                      n: int) -> None:
+        """Place one request on a replica — OUTSIDE the lock (the chaos
+        hook may kill replicas; runtime.submit takes the batcher's
+        queue)."""
+        hook = self._chaos
+        if hook is not None:
+            try:
+                hook.on_dispatch(n, self)
+            except Exception:  # noqa: BLE001 — chaos must not break dispatch
+                logger.exception("fleet chaos hook raised")
+        _obs.instant("fleet.dispatch", cat="fleet", cid=req.cid,
+                     tenant=req.tenant, replica=replica.name,
+                     attempt=req.attempts)
+        now = time.perf_counter()
+        try:
+            inner = replica.submit(req.x, deadline_ms=req.remaining_ms(now))
+        except ReplicaDead:
+            self._requeue(req, replica, burn_budget=True)
+            return
+        except Rejected:  # inner queue full / runtime closing under us:
+            self._requeue(req, replica, burn_budget=False)  # backpressure
+            time.sleep(0.001)  # yield so the replica makes progress
+            return
+        except BaseException as e:  # noqa: BLE001 — e.g. rows > bucket
+            self._fail(req, e)
+            return
+        inner.add_done_callback(
+            lambda fut, req=req, rep=replica: self._on_inner_done(
+                req, rep, fut))
+
+    # -- completion chain ---------------------------------------------------
+
+    def _on_inner_done(self, req: FleetRequest, replica: Replica,
+                       fut: _Future) -> None:
+        """Inner done-callback: runs on the replica's batcher thread (or
+        the killer's) — hand off to the fleet-complete thread instead of
+        doing bookkeeping on the compute-critical path."""
+        with self._done_lock:
+            self._done_q.append((req, replica, fut))
+            self._done_lock.notify()
+
+    def _complete_loop(self) -> None:
+        while True:
+            with self._done_lock:
+                while not self._done_q and not self._stop_done:
+                    self._done_lock.wait(0.05)
+                if not self._done_q and self._stop_done:
+                    return
+                req, replica, fut = self._done_q.popleft()
+                self._settling += 1
+            try:
+                self._settle(req, replica, fut)
+            finally:
+                with self._done_lock:
+                    self._settling -= 1
+                # no notify: close()'s drain loop polls (wait(0.02)), and
+                # waking the dispatcher per settle is pure hot-path churn
+
+    def _done_pending(self) -> bool:
+        with self._done_lock:
+            return bool(self._done_q) or self._settling > 0
+
+    def _settle(self, req: FleetRequest, replica: Replica,
+                fut: _Future) -> None:
+        err = fut.error()
+        if err is None:
+            self._complete(req, replica, fut)
+            return
+        lost = isinstance(err, ReplicaDead) or (
+            isinstance(err, ServingClosed) and replica.state != READY)
+        if lost:
+            self._requeue(req, replica, burn_budget=True)
+            return
+        if isinstance(err, DeadlineExceeded):
+            with self._lock:
+                q = self._tenants.get(req.tenant)
+            if q is not None:  # mirror the inner rejection per tenant
+                q.metrics.on_reject("deadline")
+        self._fail(req, err)
+
+    def _complete(self, req: FleetRequest, replica: Replica,
+                  fut: _Future) -> None:
+        now = time.perf_counter()
+        # lock-free reads: dict.get and len(deque) are atomic under the
+        # GIL, and a completion racing a tenant map change only risks a
+        # momentarily stale depth gauge — never corrupts queue state
+        q = self._tenants.get(req.tenant)
+        depth = len(q) if q is not None else 0
+        t_disp = getattr(req, "t_dispatch", req.t_enqueue)
+        if q is not None:
+            q.metrics.on_complete(
+                queue_ms=(t_disp - req.t_enqueue) * 1e3,
+                total_ms=(now - req.t_enqueue) * 1e3, depth=depth)
+            _obs.registry().inc(q.k_completed)
+        req.future.meta.update(fut.meta)
+        req.future.meta.update({"tenant": req.tenant, "replica": replica.name,
+                                "fleet_cid": req.cid,
+                                "attempts": req.attempts + 1})
+        req.future.set_result(fut.result(0))
+
+    def _fail(self, req: FleetRequest, err: BaseException) -> None:
+        req.future.meta.update({"tenant": req.tenant, "fleet_cid": req.cid,
+                                "attempts": req.attempts + 1})
+        req.future.set_error(err)
+
+    def _requeue(self, req: FleetRequest, replica: Replica,
+                 burn_budget: bool) -> None:
+        """Put a bounced request back at the head of its tenant queue.
+        Replica loss burns redispatch budget; backpressure does not."""
+        if burn_budget:
+            req.attempts += 1
+            if req.attempts >= self._max_redispatch:
+                with self._lock:
+                    q = self._tenants.get(req.tenant)
+                if q is not None:
+                    q.metrics.on_reject("replica_lost")
+                self._fail(req, Rejected(
+                    f"request lost its replica {req.attempts} times "
+                    "(fleet redispatch budget exhausted)"))
+                return
+            _obs.registry().inc("fleet/redispatched")
+            _obs.instant("fleet.redispatch", cat="fleet", cid=req.cid,
+                         tenant=req.tenant, from_replica=replica.name,
+                         attempt=req.attempts)
+        with self._lock:
+            q = self._tenants.get(req.tenant)
+            if q is None or self._stop:
+                # tenant vanished or the dispatcher already stopped
+                # (close raced the bounce): fail LOUDLY — a request
+                # parked in a queue nobody drains is a silent drop
+                self._fail(req, ServingClosed("fleet router closed"))
+                return
+            q.push_front(req)
+            self._lock.notify_all()
+
+    # -- read-back / shutdown -----------------------------------------------
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            tenants = {name: q for name, q in self._tenants.items()}
+            replicas = [(r.name, r.state, r.outstanding())
+                        for r in self._replicas]
+            dispatched = self._dispatched
+        reg = _obs.registry()
+        return {
+            "tenants": {name: q.metrics.snapshot()
+                        for name, q in tenants.items()},
+            "replicas": [{"name": n, "state": s, "outstanding": o}
+                         for n, s, o in replicas],
+            "dispatched": dispatched,
+            "redispatched": reg.get("fleet/redispatched"),
+            "replica_kills": reg.get("fleet/replica_kills"),
+            "warmup_reused": reg.get("fleet/warmup_reused"),
+        }
+
+    def close(self, drain: bool = True, timeout: Optional[float] = 30.0) -> None:
+        """Stop admission; `drain=True` completes everything accepted
+        (redispatches included), `drain=False` fails still-queued
+        requests with ServingClosed."""
+        with self._lock:
+            if self._stop:
+                return
+            self._closed = True
+            self._lock.notify_all()
+        deadline = time.monotonic() + (timeout if timeout is not None else 60.0)
+        if drain:
+            with self._lock:
+                while (any(len(q) for q in self._tenants.values())
+                       or any(r.outstanding() for r in self._replicas)
+                       or self._done_pending()):
+                    if time.monotonic() > deadline:
+                        raise TimeoutError("fleet router did not drain in time")
+                    self._lock.wait(0.02)
+        else:
+            with self._lock:
+                for q in self._tenants.values():
+                    q.fail_all(ServingClosed("fleet router shut down"))
+        with self._lock:
+            self._stop = True
+            self._lock.notify_all()
+        self._thread.join(timeout)
+        for r in list(self._replicas):
+            r.close(drain=drain, timeout=timeout)
+        # replica close may have bounced last inner futures into the
+        # settlement queue — let the fleet-complete thread finish them,
+        # then stop it
+        while self._done_pending() and time.monotonic() < deadline + 5.0:
+            time.sleep(0.005)
+        with self._done_lock:
+            self._stop_done = True
+            self._done_lock.notify_all()
+        self._done_thread.join(timeout)
+        for reaper in self._reapers:
+            reaper.join(max(0.0, deadline - time.monotonic()) + 5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close(drain=not any(exc))
+        return False

@@ -1,0 +1,259 @@
+"""Tenancy: per-tenant admission classes + deficit-weighted fair share.
+
+A fleet front door multiplexes tenants with different SLOs over shared
+replicas.  Two mechanisms keep them honest:
+
+  * **Admission classes** — every tenant belongs to a latency TIER
+    (`interactive` > `batch` > `best_effort`), which fixes its default
+    deadline and its strict scheduling priority.  Each tenant gets its
+    own BOUNDED queue (the serving/batcher admission idiom: overload
+    rejects loudly at admission, never queues into timeout oblivion) and
+    its own `ServingMetrics(tenant=...)` mirror, so per-tenant p50/p99
+    export through the registry's label dimension.
+  * **Deficit-weighted round robin** (`FairShareScheduler.pick_next`) —
+    within a tier, tenants accumulate row credit (`quantum × weight`)
+    per scheduler visit and spend it per dispatched row, so a tenant
+    bursting 10× the traffic cannot starve a peer: the peer's head
+    request is dispatched after a bounded number of the burster's rows
+    (the starvation bound asserted in tests/test_fleet.py).  Tiers are
+    STRICT priority: a waiting interactive request always dispatches
+    before any batch request — that is what the deadline classes mean.
+
+Deficits reset when a queue runs empty (no banking unlimited credit
+while idle), and the per-tier pointer stays on the current holder while
+its deficit affords the head request, which is what turns weights into
+real dispatch ratios instead of plain round robin.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import time
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Optional, Sequence
+
+from bigdl_tpu import obs as _obs
+from bigdl_tpu.serving.batcher import DeadlineExceeded, Rejected, _Future
+from bigdl_tpu.serving.metrics import ServingMetrics
+
+# Strict-priority order, highest first.
+TIERS = ("interactive", "batch", "best_effort")
+
+# Default deadline per tier (ms); None = no deadline (best effort waits).
+TIER_DEADLINES_MS: Dict[str, Optional[float]] = {
+    "interactive": 250.0,
+    "batch": 5000.0,
+    "best_effort": None,
+}
+
+
+@dataclass(frozen=True)
+class TenantConfig:
+    """One tenant's admission contract.
+
+    weight scales the DRR quantum within the tier (2.0 = twice the rows
+    per scheduling round of a weight-1.0 peer); capacity bounds the
+    tenant's own queue (its burst cannot consume a peer's headroom);
+    deadline_ms None inherits the tier default.
+    """
+
+    name: str
+    tier: str = "batch"
+    weight: float = 1.0
+    capacity: int = 128
+    deadline_ms: Optional[float] = None
+
+    def __post_init__(self):
+        if self.tier not in TIERS:
+            raise ValueError(f"tier must be one of {TIERS}, got {self.tier!r}")
+        if self.weight <= 0:
+            raise ValueError(f"weight must be > 0, got {self.weight}")
+        if self.capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {self.capacity}")
+
+    @property
+    def effective_deadline_ms(self) -> Optional[float]:
+        if self.deadline_ms is not None:
+            return self.deadline_ms
+        return TIER_DEADLINES_MS[self.tier]
+
+
+class FleetRequest:
+    """One accepted request riding through the router.
+
+    Carries its OWN future (settled exactly once toward the caller) and
+    redispatch state: `attempts` counts dispatches, and the absolute
+    deadline survives redispatch so a request bounced off a dead replica
+    keeps its original SLO, not a fresh one.
+    """
+
+    __slots__ = ("tenant", "x", "rows", "future", "deadline", "t_enqueue",
+                 "t_dispatch", "cid", "attempts")
+
+    def __init__(self, tenant: str, x, rows: int,
+                 deadline: Optional[float]):
+        self.tenant = tenant
+        self.x = x
+        self.rows = rows
+        self.future = _Future()
+        self.deadline = deadline  # absolute perf_counter time, or None
+        self.t_enqueue = time.perf_counter()
+        self.t_dispatch = self.t_enqueue  # updated per dispatch attempt
+        self.cid = _obs.next_cid()
+        self.attempts = 0
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+    def remaining_ms(self, now: float) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return max(0.0, (self.deadline - now) * 1e3)
+
+
+class TenantQueue:
+    """Bounded FIFO + DRR state + per-tenant metrics for one tenant.
+
+    NOT self-locking: the router's dispatcher condition (`FleetRouter.
+    _lock`) owns every mutation — admission, pop, requeue, and expiry all
+    happen under it, so queue state and scheduler state move together.
+    """
+
+    def __init__(self, config: TenantConfig):
+        self.config = config
+        self.name = config.name
+        self.metrics = ServingMetrics(tenant=config.name)
+        self.deficit = 0.0
+        self._q: Deque[FleetRequest] = collections.deque()
+        # registry keys are per-request hot-path costs: build them once
+        self.k_admitted = f"fleet/admitted|tenant={config.name}"
+        self.k_completed = f"fleet/completed|tenant={config.name}"
+        # earliest queued deadline: expire() is called every dispatcher
+        # wake, so the common no-expiry case must be O(1), not O(queue)
+        self._min_deadline = math.inf
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def admit(self, req: FleetRequest) -> None:
+        if len(self._q) >= self.config.capacity:
+            self.metrics.on_reject("queue_full")
+            _obs.instant("fleet.reject", cat="fleet", cid=req.cid,
+                         tenant=self.name, reason="queue_full")
+            raise Rejected(
+                f"tenant {self.name!r} queue full ({self.config.capacity} "
+                "requests); backpressure — retry with backoff")
+        self._q.append(req)
+        if req.deadline is not None and req.deadline < self._min_deadline:
+            self._min_deadline = req.deadline
+        self.metrics.on_admit(len(self._q))
+
+    def head_rows(self) -> int:
+        return self._q[0].rows if self._q else 0
+
+    def pop(self) -> FleetRequest:
+        req = self._q.popleft()
+        if not self._q:
+            self.deficit = 0.0  # no banking credit while idle
+        return req
+
+    def push_front(self, req: FleetRequest) -> None:
+        """Redispatch path: a request bounced off a dying replica goes
+        back to the HEAD of its tenant queue (it already waited)."""
+        self._q.appendleft(req)
+        if req.deadline is not None and req.deadline < self._min_deadline:
+            self._min_deadline = req.deadline
+
+    def expire(self, now: float) -> List[FleetRequest]:
+        """Fail every deadline-passed request loudly (an *accepted*
+        request is never silently dropped — it completes or it fails
+        with DeadlineExceeded).  Returns the expired ones."""
+        if now <= self._min_deadline:
+            return []  # earliest deadline still ahead: nothing to scan
+        expired = [r for r in self._q if r.expired(now)]
+        if expired:
+            self._q = collections.deque(
+                r for r in self._q if not r.expired(now))
+            if not self._q:
+                self.deficit = 0.0
+        # the min is maintained as a floor (pops leave it stale-low);
+        # recompute here, on the rare slow path
+        self._min_deadline = min(
+            (r.deadline for r in self._q if r.deadline is not None),
+            default=math.inf)
+        if expired:
+            for req in expired:
+                self.metrics.on_reject("deadline")
+                _obs.instant("fleet.reject", cat="fleet", cid=req.cid,
+                             tenant=self.name, reason="deadline")
+                req.future.set_error(DeadlineExceeded(
+                    f"tenant {self.name!r} deadline passed after "
+                    f"{1e3 * (now - req.t_enqueue):.1f} ms in fleet queue"))
+        return expired
+
+    def fail_all(self, err: BaseException, reason: str = "shutdown") -> int:
+        n = 0
+        while self._q:
+            req = self._q.popleft()
+            self.metrics.on_reject(reason)
+            req.future.set_error(err)
+            n += 1
+        self.deficit = 0.0
+        return n
+
+
+class FairShareScheduler:
+    """Strict tier priority + per-tier deficit-weighted round robin."""
+
+    # A head request never exceeds the largest serving bucket, so a few
+    # rounds of quantum top-ups always afford it; the bound is a pure
+    # backstop against a misconfigured quantum ≪ bucket.
+    MAX_ROUNDS = 64
+
+    def __init__(self, quantum_rows: float = 8.0):
+        if quantum_rows <= 0:
+            raise ValueError(f"quantum_rows must be > 0, got {quantum_rows}")
+        self.quantum = float(quantum_rows)
+        self._ptr: Dict[str, str] = {}  # tier -> name of current DRR holder
+
+    def pick_next(self, queues: Sequence[TenantQueue]) -> Optional[TenantQueue]:
+        """Choose the tenant whose head request dispatches next.
+
+        Caller passes the non-empty queues and holds the router lock;
+        the pick SPENDS the head's rows from the winner's deficit, so
+        call it once per dispatched request.
+        """
+        by_tier: Dict[str, List[TenantQueue]] = {}
+        for q in queues:
+            if len(q):
+                by_tier.setdefault(q.config.tier, []).append(q)
+        for tier in TIERS:  # strict priority: first populated tier wins
+            qs = by_tier.get(tier)
+            if qs:
+                return self._pick_drr(tier, qs)
+        return None
+
+    def _pick_drr(self, tier: str, qs: List[TenantQueue]) -> TenantQueue:
+        qs = sorted(qs, key=lambda q: q.name)  # deterministic ring order
+        names = [q.name for q in qs]
+        cur = self._ptr.get(tier)
+        if cur in names:
+            q = qs[names.index(cur)]
+            if q.deficit >= q.head_rows():  # holder keeps the floor while
+                q.deficit -= q.head_rows()  # its credit affords the head
+                return q
+            start = names.index(cur) + 1
+        else:
+            start = 0
+        for hop in range(len(qs) * self.MAX_ROUNDS):
+            q = qs[(start + hop) % len(qs)]
+            q.deficit += self.quantum * q.config.weight  # fresh-visit top-up
+            if q.deficit >= q.head_rows():
+                q.deficit -= q.head_rows()
+                self._ptr[tier] = q.name
+                return q
+        q = max(qs, key=lambda q: q.deficit)  # backstop: misconfigured quantum
+        q.deficit = max(0.0, q.deficit - q.head_rows())
+        self._ptr[tier] = q.name
+        return q

@@ -293,7 +293,8 @@ class GenerationEngine:
                             extra_key={"kind": "generation", "phase": phase,
                                        "bucket": lane.bucket,
                                        "slots": self.config.slots,
-                                       "top_k": self.config.top_k})
+                                       "top_k": self.config.top_k},
+                            process_scope="generation")
                         self._warmed[keyk] = warmed if status != "error" else fn
                     else:
                         out = fn(*args)

@@ -15,7 +15,13 @@ TrainSummary.  The registry absorbs them behind one API:
   * `to_summary(summary, step)` — bridge into TrainSummary/ServingSummary
 
 Names are slash-namespaced (`integrity/verified`, `serving/batches`,
-`feed/stall_ms`); exporters sanitize for their own formats.  The active
+`feed/stall_ms`); exporters sanitize for their own formats.  A name may
+carry a LABEL SUFFIX after `|` (`serving/latency_p99_ms|tenant=acme`,
+comma-separated `k=v` pairs): the JSONL exporter passes it through
+verbatim, while the Prometheus exporter renders it as a label set on the
+base metric (`bigdl_tpu_serving_latency_p99_ms{tenant="acme"}`) — so a
+multi-tenant fleet exports per-tenant series through the SAME registry
+and metric family instead of a parallel metrics path.  The active
 registry is process-global (`bigdl_tpu.obs.registry()`) but swappable
 (`set_registry`) so parallel tests stop sharing counters — the back-compat
 `INTEGRITY_COUNTERS` mapping in `health.integrity` reads *through* the
@@ -29,9 +35,29 @@ import os
 import re
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 _PROM_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def prom_series(name: str, namespace: str = "bigdl_tpu") -> Tuple[str, str]:
+    """Split a registry name into (prom_metric_name, label_block).
+
+    `serving/p99|tenant=acme,tier=interactive` ->
+    (`bigdl_tpu_serving_p99`, `{tenant="acme",tier="interactive"}`);
+    label VALUES are escaped per the exposition format, label KEYS are
+    sanitized like metric names.  No `|` -> empty label block.
+    """
+    base, _, labelpart = name.partition("|")
+    prom = namespace + "_" + _PROM_BAD.sub("_", base)
+    if not labelpart:
+        return prom, ""
+    pairs = []
+    for item in labelpart.split(","):
+        k, _, v = item.partition("=")
+        v = v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+        pairs.append(f'{_PROM_BAD.sub("_", k)}="{v}"')
+    return prom, "{" + ",".join(pairs) + "}"
 
 
 class MetricsRegistry:
@@ -109,10 +135,13 @@ class MetricsRegistry:
         lines = []
         for kind, series in (("counter", snap["counters"]),
                              ("gauge", snap["gauges"])):
+            typed = set()  # one TYPE line per metric family, labels or not
             for name in sorted(series):
-                prom = namespace + "_" + _PROM_BAD.sub("_", name)
-                lines.append(f"# TYPE {prom} {kind}")
-                lines.append(f"{prom} {series[name]}")
+                prom, labels = prom_series(name, namespace)
+                if prom not in typed:
+                    typed.add(prom)
+                    lines.append(f"# TYPE {prom} {kind}")
+                lines.append(f"{prom}{labels} {series[name]}")
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             f.write("\n".join(lines) + "\n")

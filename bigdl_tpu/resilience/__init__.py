@@ -40,6 +40,7 @@ from bigdl_tpu.resilience.chaos import (
     ChaosStepFault,
     CheckpointWriteFault,
     NaNInjector,
+    ReplicaKillFault,
     SimulatedPreemption,
     StepFaultInjector,
     compose,
@@ -61,6 +62,7 @@ __all__ = [
     "CheckpointWriteFault",
     "Preempted",
     "PreemptionGuard",
+    "ReplicaKillFault",
     "SimulatedPreemption",
     "StepFaultInjector",
     "apply_retention",

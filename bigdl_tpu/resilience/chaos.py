@@ -212,6 +212,48 @@ class BitFlipCheckpointFault:
         self.fired.append(ckpt_dir)
 
 
+class ReplicaKillFault:
+    """Fleet chaos hook: SIGKILL-analog drop of one serving replica
+    mid-burst.
+
+    Attach with `FleetRouter.set_chaos(fault)`: `on_dispatch(n, router)`
+    fires after every dispatch decision, and on the `at_dispatch`-th one
+    the fault calls `router.kill_replica(name)` — the replica's in-flight
+    requests fail with `ReplicaDead`, requeue onto their tenant queues,
+    and redispatch to survivors.  The invariant under test: zero
+    ACCEPTED requests silently dropped (a loud deadline rejection is
+    allowed; a hung future is not).
+
+    Deterministic like every fixture here: dispatch-count indexed, no
+    wall clock, `fired` records what was killed for assertions.
+    `n_kills` > 1 re-arms every `at_dispatch` dispatches after the
+    previous kill (a rolling failure, bounded so survivors remain)."""
+
+    def __init__(self, at_dispatch: int = 1, *, name: Optional[str] = None,
+                 n_kills: int = 1):
+        if at_dispatch < 1:
+            raise ValueError(f"at_dispatch must be >= 1, got {at_dispatch}")
+        self.at_dispatch = int(at_dispatch)
+        self.name = name
+        self.n_kills = int(n_kills)
+        self.fired: list = []
+        self._next_at = self.at_dispatch
+
+    def on_step(self, step: int) -> None:
+        """No-op: this fault rides the fleet dispatch stream, not the
+        trainer step stream (compose() compatibility)."""
+
+    def on_dispatch(self, n_dispatched: int, router) -> None:
+        if len(self.fired) >= self.n_kills or n_dispatched < self._next_at:
+            return
+        if router.n_replicas() <= 1:
+            return  # never kill the last replica — that is an outage, not chaos
+        killed = router.kill_replica(self.name)
+        if killed is not None:
+            self.fired.append((n_dispatched, killed))
+            self._next_at = n_dispatched + self.at_dispatch
+
+
 def compose(*hooks) -> "_Composed":
     """One chaos hook fanning out to several injectors, in order."""
     return _Composed(hooks)
@@ -224,6 +266,12 @@ class _Composed:
     def on_step(self, step: int) -> None:
         for h in self.hooks:
             h.on_step(step)
+
+    def on_dispatch(self, n_dispatched: int, router) -> None:
+        for h in self.hooks:
+            fn = getattr(h, "on_dispatch", None)
+            if fn is not None:
+                fn(n_dispatched, router)
 
     def poison_code(self, step: int) -> int:
         """Fan in: first non-zero poison wins (composing two NaNInjectors

@@ -56,24 +56,54 @@ class _Future:
     """Single-assignment result slot (stdlib concurrent.futures would drag
     in an executor; the scheduler thread IS the executor here)."""
 
-    __slots__ = ("_event", "_value", "_error", "meta")
+    __slots__ = ("_event", "_value", "_error", "meta", "_cb_lock",
+                 "_callbacks")
 
     def __init__(self):
         self._event = threading.Event()
         self._value = None
         self._error: Optional[BaseException] = None
         self.meta: dict = {}
+        self._cb_lock = threading.Lock()
+        self._callbacks: list = []
+
+    def _settle(self) -> None:
+        """Fire registered callbacks exactly once (the first set_result/
+        set_error wins; a late overwrite finds the list already drained)."""
+        with self._cb_lock:
+            cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — a broken callback must not
+                logger.exception("future done-callback raised")  # hang peers
 
     def set_result(self, value) -> None:
         self._value = value
         self._event.set()
+        self._settle()
 
     def set_error(self, err: BaseException) -> None:
         self._error = err
         self._event.set()
+        self._settle()
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    def error(self) -> Optional[BaseException]:
+        """Peek the failure without raising (None while pending/ok)."""
+        return self._error
+
+    def add_done_callback(self, fn) -> None:
+        """`fn(future)` when the future settles — immediately if it
+        already has.  Runs on the settling thread (the fleet router's
+        completion chaining; keep callbacks cheap and non-blocking)."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
 
     def result(self, timeout: Optional[float] = None):
         if not self._event.wait(timeout):

@@ -17,6 +17,7 @@ run-to-run noise of any real latency measurement.
 
 from __future__ import annotations
 
+import bisect
 import math
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -37,13 +38,16 @@ class LatencyHistogram:
         # bucket i covers [_edges[i], _edges[i+1]); first/last are catch-all
         self._edges = np.logspace(math.log10(_LO_MS), math.log10(_HI_MS),
                                   _N_BUCKETS + 1)
+        # observe() runs per request on serving hot paths: bisect on a
+        # plain list is ~10x cheaper than np.searchsorted on a scalar
+        self._edge_list = self._edges.tolist()
         self._counts = np.zeros(_N_BUCKETS + 2, np.int64)
         self._sum_ms = 0.0
         self._count = 0
         self._max_ms = 0.0
 
     def observe(self, ms: float) -> None:
-        idx = int(np.searchsorted(self._edges, ms, side="right"))
+        idx = bisect.bisect_right(self._edge_list, ms)
         self._counts[idx] += 1
         self._sum_ms += ms
         self._count += 1
@@ -98,9 +102,23 @@ class ServingMetrics:
       * queue depth (current + high-water)
       * batch occupancy: real rows / padded bucket rows, per bucket
       * rejection counters: queue-full, deadline, shutdown
+
+    `tenant=` adds a label dimension to every MetricsRegistry mirror
+    (`serving/requests_admitted|tenant=<name>`, rendered by the
+    Prometheus-textfile exporter as `{tenant="<name>"}`): the fleet
+    front door gives each tenant its own ServingMetrics so per-tenant
+    p50/p99/occupancy export through the SAME registry names instead of
+    a parallel metrics path.  Unlabeled (tenant=None) behaviour is
+    byte-identical to before.
     """
 
-    def __init__(self):
+    def __init__(self, tenant: Optional[str] = None):
+        self.tenant = tenant
+        self._label = "" if tenant is None else f"|tenant={tenant}"
+        # per-request registry keys, built once (hot-path string concat)
+        self._k_admitted = "serving/requests_admitted" + self._label
+        self._k_completed = "serving/requests_completed" + self._label
+        self._k_batches = "serving/batches" + self._label
         self._lock = threading.Lock()
         self.queue_ms = LatencyHistogram()
         self.batch_ms = LatencyHistogram()
@@ -127,7 +145,7 @@ class ServingMetrics:
             self.queue_depth = depth
             if depth > self.queue_depth_peak:
                 self.queue_depth_peak = depth
-        _obs.registry().inc("serving/requests_admitted")
+        _obs.registry().inc(self._k_admitted)
 
     def on_reject(self, reason: str) -> None:
         with self._lock:
@@ -137,7 +155,7 @@ class ServingMetrics:
                 self.rejected_deadline += 1
             else:
                 self.rejected_shutdown += 1
-        _obs.registry().inc(f"serving/rejected_{reason}")
+        _obs.registry().inc(f"serving/rejected_{reason}{self._label}")
 
     def on_batch(self, bucket: int, rows: int, batch_ms: float) -> None:
         with self._lock:
@@ -147,7 +165,7 @@ class ServingMetrics:
             self.batch_ms.observe(batch_ms)
             b, r = self._per_bucket.get(bucket, (0, 0))
             self._per_bucket[bucket] = (b + 1, r + rows)
-        _obs.registry().inc("serving/batches")
+        _obs.registry().inc(self._k_batches)
 
     def on_complete(self, queue_ms: float, total_ms: float, depth: int) -> None:
         with self._lock:
@@ -155,7 +173,7 @@ class ServingMetrics:
             self.queue_ms.observe(queue_ms)
             self.total_ms.observe(total_ms)
             self.queue_depth = depth
-        _obs.registry().inc("serving/requests_completed")
+        _obs.registry().inc(self._k_completed)
 
     def on_nonfinite(self) -> None:
         """A request's OUTPUT rows contained NaN/Inf and the runtime's
@@ -163,12 +181,12 @@ class ServingMetrics:
         the serving dual of the trainer's divergence watchdog)."""
         with self._lock:
             self.rejected_nonfinite += 1
-        _obs.registry().inc("serving/rejected_nonfinite")
+        _obs.registry().inc("serving/rejected_nonfinite" + self._label)
 
     def on_swap(self) -> None:
         with self._lock:
             self.swaps += 1
-        _obs.registry().inc("serving/swaps")
+        _obs.registry().inc("serving/swaps" + self._label)
 
     # -- read-back ---------------------------------------------------------
 
@@ -183,10 +201,10 @@ class ServingMetrics:
         # gauge mirror: the registry's serving/ view tracks the last
         # snapshot (counters above are incremented at record time)
         reg = _obs.registry()
-        reg.set_gauge("serving/latency_p50_ms", snap["latency_ms"]["p50"])
-        reg.set_gauge("serving/latency_p99_ms", snap["latency_ms"]["p99"])
-        reg.set_gauge("serving/batch_occupancy", snap["batch_occupancy"])
-        reg.set_gauge("serving/queue_depth_peak", snap["queue_depth_peak"])
+        reg.set_gauge("serving/latency_p50_ms" + self._label, snap["latency_ms"]["p50"])
+        reg.set_gauge("serving/latency_p99_ms" + self._label, snap["latency_ms"]["p99"])
+        reg.set_gauge("serving/batch_occupancy" + self._label, snap["batch_occupancy"])
+        reg.set_gauge("serving/queue_depth_peak" + self._label, snap["queue_depth_peak"])
         return snap
 
     def _snapshot_locked(self) -> Dict:
@@ -261,7 +279,9 @@ class GenerationMetrics:
     same Summary/TensorBoard export spine as serving.
     """
 
-    def __init__(self):
+    def __init__(self, tenant: Optional[str] = None):
+        self.tenant = tenant
+        self._label = "" if tenant is None else f"|tenant={tenant}"
         self._lock = threading.Lock()
         self.ttft_ms = LatencyHistogram()
         self.per_token_ms = LatencyHistogram()
@@ -289,7 +309,7 @@ class GenerationMetrics:
             self.queue_depth = depth
             if depth > self.queue_depth_peak:
                 self.queue_depth_peak = depth
-        _obs.registry().inc("generation/requests_admitted")
+        _obs.registry().inc("generation/requests_admitted" + self._label)
 
     def on_reject(self, reason: str) -> None:
         with self._lock:
@@ -297,7 +317,7 @@ class GenerationMetrics:
                 self.rejected_queue_full += 1
             else:
                 self.rejected_shutdown += 1
-        _obs.registry().inc(f"generation/rejected_{reason}")
+        _obs.registry().inc(f"generation/rejected_{reason}{self._label}")
 
     def on_prefill(self, prefill_ms: float, ttft_ms: float) -> None:
         """One admission: prompt folded, first token sampled."""
@@ -306,8 +326,8 @@ class GenerationMetrics:
             self.tokens_generated += 1  # prefill samples token #1
             self.prefill_ms.observe(prefill_ms)
             self.ttft_ms.observe(ttft_ms)
-        _obs.registry().inc("generation/prefills")
-        _obs.registry().inc("generation/tokens")
+        _obs.registry().inc("generation/prefills" + self._label)
+        _obs.registry().inc("generation/tokens" + self._label)
 
     def on_tokens(self, n: int, step_ms: float) -> None:
         """One decode step advancing `n` in-flight requests a token each."""
@@ -315,24 +335,24 @@ class GenerationMetrics:
             self.decode_steps += 1
             self.tokens_generated += n
             self.per_token_ms.observe(step_ms)
-        _obs.registry().inc("generation/tokens", n)
-        _obs.registry().inc("generation/decode_steps")
+        _obs.registry().inc("generation/tokens" + self._label, n)
+        _obs.registry().inc("generation/decode_steps" + self._label)
 
     def on_complete(self, e2e_ms: float, tokens: int) -> None:
         with self._lock:
             self.requests_completed += 1
             self.e2e_ms.observe(e2e_ms)
-        _obs.registry().inc("generation/requests_completed")
+        _obs.registry().inc("generation/requests_completed" + self._label)
 
     def on_nonfinite(self) -> None:
         with self._lock:
             self.rejected_nonfinite += 1
-        _obs.registry().inc("generation/rejected_nonfinite")
+        _obs.registry().inc("generation/rejected_nonfinite" + self._label)
 
     def on_swap(self) -> None:
         with self._lock:
             self.swaps += 1
-        _obs.registry().inc("generation/swaps")
+        _obs.registry().inc("generation/swaps" + self._label)
 
     def set_active(self, n: int) -> None:
         with self._lock:
@@ -378,10 +398,10 @@ class GenerationMetrics:
                 },
             }
         reg = _obs.registry()
-        reg.set_gauge("generation/ms_per_token_p50", snap["ms_per_token"]["p50"])
-        reg.set_gauge("generation/ms_per_token_p99", snap["ms_per_token"]["p99"])
-        reg.set_gauge("generation/ttft_p50_ms", snap["ttft_ms"]["p50"])
-        reg.set_gauge("generation/active_slots_peak", snap["active_slots_peak"])
+        reg.set_gauge("generation/ms_per_token_p50" + self._label, snap["ms_per_token"]["p50"])
+        reg.set_gauge("generation/ms_per_token_p99" + self._label, snap["ms_per_token"]["p99"])
+        reg.set_gauge("generation/ttft_p50_ms" + self._label, snap["ttft_ms"]["p50"])
+        reg.set_gauge("generation/active_slots_peak" + self._label, snap["active_slots_peak"])
         return snap
 
     def export(self, summary, step: int, prefix: str = "generation") -> None:

@@ -206,7 +206,8 @@ class ServingRuntime:
                     fn, status = _cc.load_or_compile(
                         self._fwd, (params, state, xd),
                         signature=f"serving/bucket={bucket}",
-                        extra_key={"kind": "serving", "bucket": bucket})
+                        extra_key={"kind": "serving", "bucket": bucket},
+                        process_scope="serving")
                     self._warmed[isig] = fn if status != "error" else self._fwd
                 else:
                     y = self._fwd(params, state, xd)

@@ -76,7 +76,11 @@ def _thread_leak_guard():
                 and (not t.daemon
                      or t.name.startswith(("DeviceFeed", "AsyncCkptWriter",
                                            "serving-batcher",
-                                           "HealthWatchdog")))]
+                                           "HealthWatchdog",
+                                           "fleet-router",
+                                           "fleet-autoscaler",
+                                           "fleet-reaper",
+                                           "fleet-complete")))]
 
     def child_offenders():
         # active_children() also reaps finished children; any new child

@@ -1,0 +1,600 @@
+"""bigdl_tpu.fleet: multi-tenant front door (ISSUE 11).
+
+The acceptance-criteria tests live here:
+
+  * fair share under asymmetric load — a flooding tenant cannot starve
+    a peer (starvation bound asserted on the dispatch log);
+  * strict deadline-tier ordering — interactive dispatches before batch
+    regardless of arrival order;
+  * autoscaler grow/retire hysteresis on scripted signal sequences,
+    including the steady-recompile-alarm retire veto;
+  * warm scale-out through the process-scoped compilecache live layer
+    (`fleet/warmup_reused` > 0, zero steady-state recompile alarms);
+  * replica kill mid-flight: zero ACCEPTED requests lost — every future
+    settles with a result or a loud error, never hangs.
+
+Scheduler/router mechanics run against fake runtimes (no device work,
+so the ordering assertions are exact); the scale-out and kill-burst
+tests run real ServingRuntimes on the CPU backend.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import bigdl_tpu.compilecache as cc
+import bigdl_tpu.nn as nn
+from bigdl_tpu import obs
+from bigdl_tpu.fleet import (
+    AutoscalerConfig,
+    FairShareScheduler,
+    FleetAutoscaler,
+    FleetRouter,
+    TenantConfig,
+    TenantQueue,
+)
+from bigdl_tpu.fleet.tenancy import FleetRequest
+from bigdl_tpu.obs.metrics import MetricsRegistry, prom_series
+from bigdl_tpu.resilience import ReplicaKillFault
+from bigdl_tpu.serving import ServingRuntime
+from bigdl_tpu.serving.batcher import (
+    DeadlineExceeded,
+    Rejected,
+    ServingClosed,
+    _Future,
+)
+from bigdl_tpu.serving.metrics import ServingMetrics
+
+
+@pytest.fixture()
+def fresh_registry():
+    old = obs.set_registry(MetricsRegistry())
+    try:
+        yield obs.registry()
+    finally:
+        obs.set_registry(old)
+
+
+@pytest.fixture()
+def cache_root(tmp_path):
+    root = str(tmp_path / "cc")
+    cc.set_cache_dir(root)
+    try:
+        yield root
+    finally:
+        cc.reset()
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    model = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 4))
+    params, state, _ = model.build(jax.random.PRNGKey(0), (8, 6))
+    return model, params, state
+
+
+def _row(seed=0):
+    return np.random.RandomState(seed).rand(1, 6).astype(np.float32)
+
+
+# -- fake runtimes (exact scheduler-order assertions, no device work) ------
+
+
+class EchoRuntime:
+    """Settles every request immediately with its input."""
+
+    def __init__(self):
+        self.submitted = []
+
+    def submit(self, x, deadline_ms=None):
+        fut = _Future()
+        self.submitted.append(x)
+        fut.set_result(x)
+        return fut
+
+    def close(self, drain=True, timeout=None):
+        pass
+
+
+class ManualRuntime:
+    """Holds every request open until the test releases (or closes) it —
+    the stand-in for a replica with work in flight."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = []
+
+    def submit(self, x, deadline_ms=None):
+        fut = _Future()
+        with self._lock:
+            self.pending.append((x, fut))
+        return fut
+
+    def n_pending(self):
+        with self._lock:
+            return len(self.pending)
+
+    def release_all(self):
+        with self._lock:
+            pend, self.pending = self.pending, []
+        for x, fut in pend:
+            fut.set_result(x)
+
+    def close(self, drain=True, timeout=None):
+        with self._lock:
+            pend, self.pending = self.pending, []
+        for _, fut in pend:
+            if not fut.done():
+                fut.set_error(ServingClosed("runtime shut down"))
+
+
+def _wait_until(cond, timeout=10.0, msg="condition"):
+    deadline = time.time() + timeout
+    while not cond():
+        if time.time() > deadline:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.005)
+
+
+# -- _Future done-callbacks (the completion-chaining primitive) ------------
+
+
+def test_future_callback_after_settle_fires_inline():
+    fut = _Future()
+    fut.set_result(41)
+    seen = []
+    fut.add_done_callback(lambda f: seen.append(f.result(0)))
+    assert seen == [41]
+
+
+def test_future_callback_fires_exactly_once():
+    fut = _Future()
+    seen = []
+    fut.add_done_callback(lambda f: seen.append("cb"))
+    fut.set_error(RuntimeError("first wins"))
+    fut.set_result("late overwrite")  # must not re-fire
+    assert seen == ["cb"]
+    assert isinstance(fut.error(), RuntimeError)
+
+
+# -- deficit-weighted fair share (pure scheduler) --------------------------
+
+
+def _queue(name, tier="batch", weight=1.0, n_reqs=0, rows=1):
+    q = TenantQueue(TenantConfig(name, tier=tier, weight=weight,
+                                 capacity=max(256, n_reqs)))
+    for _ in range(n_reqs):
+        q.admit(FleetRequest(name, None, rows, None))
+    return q
+
+
+def test_drr_strict_tier_priority(fresh_registry):
+    sched = FairShareScheduler(quantum_rows=4)
+    interactive = _queue("i", tier="interactive", n_reqs=2)
+    batch = _queue("b", tier="batch", n_reqs=50)
+    best = _queue("e", tier="best_effort", n_reqs=50)
+    order = []
+    while len(interactive) or len(batch):
+        q = sched.pick_next([interactive, batch, best])
+        order.append(q.name)
+        q.pop()
+    # every interactive request dispatched before ANY batch request,
+    # every batch before any best-effort
+    assert order[:2] == ["i", "i"]
+    assert "e" not in order
+
+
+def test_drr_weight_sets_dispatch_ratio(fresh_registry):
+    sched = FairShareScheduler(quantum_rows=1)
+    heavy = _queue("heavy", weight=3.0, n_reqs=60)
+    light = _queue("light", weight=1.0, n_reqs=60)
+    picks = [sched.pick_next([heavy, light]).pop().tenant for _ in range(40)]
+    ratio = picks.count("heavy") / picks.count("light")
+    assert 2.0 < ratio < 4.5, f"expected ~3:1 dispatch ratio, got {ratio}"
+
+
+def test_drr_starvation_bound_under_flood(fresh_registry):
+    quantum = 8
+    sched = FairShareScheduler(quantum_rows=quantum)
+    flood = _queue("flood", n_reqs=200)
+    victim = _queue("victim", n_reqs=1)
+    picks = []
+    for _ in range(3 * quantum):
+        picks.append(sched.pick_next([flood, victim]).pop().tenant)
+        if "victim" in picks:
+            break
+    # equal weights: the victim's head request dispatches within one
+    # quantum round of the flood, never later
+    assert "victim" in picks
+    assert picks.index("victim") <= quantum + 1
+
+
+def test_drr_deficit_resets_when_queue_empties(fresh_registry):
+    q = _queue("t", n_reqs=1)
+    q.deficit = 999.0
+    q.pop()
+    assert q.deficit == 0.0  # no banking credit while idle
+
+
+# -- router over fake runtimes ---------------------------------------------
+
+
+def _echo_router(tenants, **kw):
+    runtimes = {}
+
+    def factory(name):
+        rt = EchoRuntime()
+        runtimes[name] = rt
+        return rt
+
+    kw.setdefault("n_replicas", 1)
+    router = FleetRouter(factory, tenants=tenants, **kw)
+    return router, runtimes
+
+
+def test_tier_preemption_ordering(fresh_registry):
+    router, _ = _echo_router(
+        [TenantConfig("bulk", tier="batch"),
+         TenantConfig("chat", tier="interactive")])
+    try:
+        router.pause()
+        futs = [router.submit("bulk", _row(), deadline_ms=60_000)
+                for _ in range(5)]
+        futs += [router.submit("chat", _row(), deadline_ms=60_000)
+                 for _ in range(5)]
+        router.resume()
+        for f in futs:
+            f.result(10)
+        tenants_in_order = [t for t, _, _ in router.dispatch_log]
+        # batch arrived FIRST, but interactive's strict priority wins
+        assert tenants_in_order[:5] == ["chat"] * 5
+        assert tenants_in_order[5:] == ["bulk"] * 5
+    finally:
+        router.close()
+
+
+def test_fair_share_bounds_starvation_in_dispatch_log(fresh_registry):
+    quantum = 4
+    router, _ = _echo_router(
+        [TenantConfig("flood", tier="batch"),
+         TenantConfig("victim", tier="batch")],
+        quantum_rows=quantum)
+    try:
+        router.pause()
+        futs = [router.submit("flood", _row(), deadline_ms=60_000)
+                for _ in range(30)]
+        futs += [router.submit("victim", _row(), deadline_ms=60_000)
+                 for _ in range(3)]
+        router.resume()
+        for f in futs:
+            f.result(10)
+        tenants_in_order = [t for t, _, _ in router.dispatch_log]
+        first_victim = tenants_in_order.index("victim")
+        assert first_victim <= quantum + 1, (
+            f"victim starved for {first_victim} dispatches under the flood")
+    finally:
+        router.close()
+
+
+def test_tenant_queue_full_rejects_loudly(fresh_registry):
+    router, _ = _echo_router([TenantConfig("t", capacity=2)])
+    try:
+        router.pause()
+        router.submit("t", _row(), deadline_ms=60_000)
+        router.submit("t", _row(), deadline_ms=60_000)
+        with pytest.raises(Rejected):
+            router.submit("t", _row(), deadline_ms=60_000)
+        assert fresh_registry.get("serving/rejected_queue_full|tenant=t") == 1
+    finally:
+        router.resume()
+        router.close()
+
+
+def test_unknown_tenant_raises(fresh_registry):
+    router, _ = _echo_router([TenantConfig("t")])
+    try:
+        with pytest.raises(KeyError):
+            router.submit("nobody", _row())
+    finally:
+        router.close()
+
+
+def test_deadline_expires_in_fleet_queue(fresh_registry):
+    router, _ = _echo_router([TenantConfig("t", tier="interactive")])
+    try:
+        router.pause()  # nothing dispatches; the deadline must still fire
+        fut = router.submit("t", _row(), deadline_ms=30)
+        _wait_until(fut.done, 5, "deadline expiry")
+        with pytest.raises(DeadlineExceeded):
+            fut.result(0)
+        assert fresh_registry.get("serving/rejected_deadline|tenant=t") == 1
+    finally:
+        router.resume()
+        router.close()
+
+
+def test_close_rejects_new_and_drains_accepted(fresh_registry):
+    router, _ = _echo_router([TenantConfig("t")])
+    futs = [router.submit("t", _row(), deadline_ms=60_000) for _ in range(4)]
+    router.close(drain=True)
+    for f in futs:  # accepted work completed through the drain
+        assert f.result(0).shape == (1, 6)
+    with pytest.raises(ServingClosed):
+        router.submit("t", _row())
+
+
+def test_close_no_drain_fails_queued_loudly(fresh_registry):
+    router, _ = _echo_router([TenantConfig("t")])
+    router.pause()
+    futs = [router.submit("t", _row(), deadline_ms=60_000) for _ in range(4)]
+    router.close(drain=False)
+    for f in futs:
+        assert isinstance(f.error(), ServingClosed)  # loud, not lost
+
+
+def test_kill_replica_redispatches_inflight_zero_lost(fresh_registry):
+    runtimes = {}
+
+    def factory(name):
+        rt = ManualRuntime()
+        runtimes[name] = rt
+        return rt
+
+    router = FleetRouter(factory, n_replicas=2, tenants=[TenantConfig("t")])
+    try:
+        futs = [router.submit("t", _row(i), deadline_ms=60_000)
+                for i in range(10)]
+        _wait_until(
+            lambda: sum(rt.n_pending() for rt in runtimes.values()) == 10,
+            msg="all 10 requests dispatched")
+        victim_name = max(runtimes, key=lambda n: runtimes[n].n_pending())
+        n_inflight = runtimes[victim_name].n_pending()
+        assert n_inflight > 0
+        assert router.kill_replica(victim_name) == victim_name
+        survivor = next(rt for n, rt in runtimes.items() if n != victim_name)
+        # every request the victim held redispatches to the survivor
+        _wait_until(lambda: survivor.n_pending() == 10,
+                    msg="redistribution to the survivor")
+        survivor.release_all()
+        for f in futs:  # zero accepted requests lost
+            assert f.result(10).shape == (1, 6)
+        snap = router.snapshot()
+        assert snap["redispatched"] >= n_inflight
+        assert snap["replica_kills"] == 1
+        completed = {f.meta["replica"] for f in futs}
+        assert completed == {next(n for n in runtimes if n != victim_name)}
+    finally:
+        router.close()
+
+
+def test_redispatch_budget_exhaustion_is_loud(fresh_registry):
+    """With every replica dying, an accepted request fails with a loud
+    Rejected after max_redispatch bounces — never a silent hang."""
+    runtimes = {}
+
+    def factory(name):
+        rt = ManualRuntime()
+        runtimes[name] = rt
+        return rt
+
+    router = FleetRouter(factory, n_replicas=2, tenants=[TenantConfig("t")],
+                         max_redispatch=2)
+    try:
+        fut = router.submit("t", _row(), deadline_ms=60_000)
+        for _ in range(3):
+            _wait_until(
+                lambda: any(rt.n_pending() for rt in runtimes.values())
+                or fut.done(), msg="dispatch or settle")
+            if fut.done():
+                break
+            router.add_replica()  # keep a landing spot for the redispatch
+            victim = next(n for n in runtimes if runtimes[n].n_pending())
+            router.kill_replica(victim)
+        _wait_until(fut.done, 10, "loud failure")
+        assert isinstance(fut.error(), Rejected)
+        assert fresh_registry.get("serving/rejected_replica_lost|tenant=t") == 1
+    finally:
+        router.close(drain=False)
+
+
+# -- autoscaler hysteresis (scripted signals) ------------------------------
+
+
+class FakeFleet:
+    def __init__(self, n=1):
+        self.n = n
+        self.events = []
+
+    def n_replicas(self):
+        return self.n
+
+    def add_replica(self):
+        self.n += 1
+        self.events.append("grow")
+        return f"r{self.n}"
+
+    def retire_replica(self, name=None, timeout=None):
+        if self.n <= 1:
+            return None
+        self.n -= 1
+        self.events.append("shrink")
+        return f"r{self.n + 1}"
+
+
+def _autoscaler(fleet, signals, **cfg_kw):
+    cfg_kw.setdefault("min_replicas", 1)
+    cfg_kw.setdefault("max_replicas", 3)
+    cfg_kw.setdefault("grow_after", 3)
+    cfg_kw.setdefault("shrink_after", 3)
+    cfg_kw.setdefault("cooldown_ticks", 2)
+    cfg_kw.setdefault("high_queue_depth", 10)
+    cfg_kw.setdefault("high_p99_ms", 500.0)
+    cfg_kw.setdefault("low_queue_depth", 1)
+    it = iter(signals)
+    return FleetAutoscaler(fleet, AutoscalerConfig(**cfg_kw),
+                           signals_fn=lambda: next(it))
+
+
+def _sig(depth=0.0, p99=0.0, alarms=0.0):
+    return {"queue_depth": depth, "p99_ms": p99, "recompile_alarms": alarms}
+
+
+def test_autoscaler_grows_after_consecutive_high_ticks(fresh_registry):
+    fleet = FakeFleet(1)
+    auto = _autoscaler(fleet, [_sig(depth=50)] * 6)
+    decisions = [auto.tick() for _ in range(6)]
+    # 2 high ticks hold; the 3rd grows; the action resets the streak and
+    # starts the cooldown, so the NEXT grow needs 3 more high ticks
+    assert decisions == ["hold", "hold", "grow", "hold", "hold", "grow"]
+    assert fleet.n == 3
+
+
+def test_autoscaler_oscillation_holds(fresh_registry):
+    fleet = FakeFleet(1)
+    sigs = [_sig(depth=50), _sig(depth=50), _sig(depth=5),  # neutral resets
+            _sig(depth=50), _sig(depth=50), _sig(depth=5)]
+    auto = _autoscaler(fleet, sigs)
+    decisions = [auto.tick() for _ in range(6)]
+    assert "grow" not in decisions
+    assert fleet.n == 1
+
+
+def test_autoscaler_retires_after_low_streak_with_cooldown(fresh_registry):
+    fleet = FakeFleet(3)
+    auto = _autoscaler(fleet, [_sig(depth=0)] * 10)
+    decisions = [auto.tick() for _ in range(10)]
+    # shrink every (streak-rebuild) 3 ticks until min_replicas, then hold
+    assert decisions[:6] == ["hold", "hold", "shrink", "hold", "hold",
+                             "shrink"]
+    assert fleet.n == 1
+    assert "shrink" not in decisions[6:]  # at min_replicas: never below
+
+
+def test_autoscaler_alarm_vetoes_retire(fresh_registry):
+    fleet = FakeFleet(2)
+    # low load, but the steady-recompile alarm count keeps climbing
+    sigs = [_sig(depth=0, alarms=float(i)) for i in range(6)]
+    auto = _autoscaler(fleet, sigs)
+    decisions = [auto.tick() for _ in range(6)]
+    assert "shrink" not in decisions
+    assert "veto" in decisions
+    assert fleet.n == 2
+
+
+def test_autoscaler_respects_max_replicas(fresh_registry):
+    fleet = FakeFleet(3)
+    auto = _autoscaler(fleet, [_sig(depth=50)] * 5, max_replicas=3)
+    decisions = [auto.tick() for _ in range(5)]
+    assert "grow" not in decisions
+
+
+# -- Prometheus tenant label dimension -------------------------------------
+
+
+def test_prom_series_renders_label_suffix():
+    assert prom_series("serving/p99|tenant=acme") == (
+        "bigdl_tpu_serving_p99", '{tenant="acme"}')
+    assert prom_series("serving/p99") == ("bigdl_tpu_serving_p99", "")
+    name, labels = prom_series('a/b|k=va"lue,tier=x')
+    assert labels == '{k="va\\"lue",tier="x"}'
+
+
+def test_prometheus_exports_per_tenant_series(fresh_registry, tmp_path):
+    ServingMetrics(tenant="acme").on_admit(1)
+    ServingMetrics(tenant="bulk").on_admit(1)
+    ServingMetrics().on_admit(1)  # unlabeled series coexists
+    path = str(tmp_path / "metrics.prom")
+    fresh_registry.export_prometheus(path)
+    text = open(path).read()
+    assert 'bigdl_tpu_serving_requests_admitted{tenant="acme"} 1' in text
+    assert 'bigdl_tpu_serving_requests_admitted{tenant="bulk"} 1' in text
+    assert "\nbigdl_tpu_serving_requests_admitted 1" in text
+    # one TYPE line per metric family, labels notwithstanding
+    assert text.count("# TYPE bigdl_tpu_serving_requests_admitted counter") == 1
+
+
+# -- real runtimes: warm scale-out + chaos kill under burst ----------------
+
+
+def _serving_factory(small_model):
+    model, params, state = small_model
+
+    def factory(name):
+        return ServingRuntime(model, params, state, buckets=(1, 8),
+                              max_wait_ms=1.0,
+                              example_input=np.zeros((1, 6), np.float32))
+
+    return factory
+
+
+def test_warm_scaleout_reuses_cache_no_steady_recompiles(
+        small_model, fresh_registry, cache_root):
+    obs.set_observability(metrics=True, compile_monitor=True)
+    router = FleetRouter(_serving_factory(small_model), n_replicas=1,
+                         tenants=[TenantConfig("t")])
+    try:
+        assert router.predict("t", _row(), deadline_ms=30_000,
+                              timeout=30).shape == (1, 4)
+        hits_before = fresh_registry.get("compile/cache_hits")
+        router.add_replica()  # scale-out: must warm from the live layer
+        assert fresh_registry.get("compile/cache_hits") > hits_before
+        assert fresh_registry.get("fleet/warmup_reused") > 0
+        # zero steady-state recompiles: scale-out compiled NOTHING anew
+        assert fresh_registry.get("compile/steady_recompiles") == 0
+        assert router.predict("t", _row(1), deadline_ms=30_000,
+                              timeout=30).shape == (1, 4)
+    finally:
+        router.close()
+
+
+def test_replica_kill_mid_burst_zero_lost(small_model, fresh_registry,
+                                          cache_root):
+    """The chaos lane acceptance bar: SIGKILL-analog drop of one replica
+    mid-burst; every ACCEPTED request settles with a result or a loud
+    deadline/rejection error — silently dropped is not an ending."""
+    router = FleetRouter(_serving_factory(small_model), n_replicas=2,
+                         tenants=[TenantConfig("bulk", tier="batch"),
+                                  TenantConfig("chat", tier="interactive")])
+    fault = ReplicaKillFault(at_dispatch=5)
+    router.set_chaos(fault)
+    try:
+        futs = []
+        for i in range(24):
+            tenant = "chat" if i % 3 == 0 else "bulk"
+            futs.append(router.submit(tenant, _row(i), deadline_ms=60_000))
+        settled = [f.result(60) for f in futs]
+        assert len(settled) == len(futs)  # zero lost, zero hung
+        assert all(o.shape == (1, 4) for o in settled)
+        assert len(fault.fired) == 1
+        snap = router.snapshot()
+        assert snap["replica_kills"] == 1
+        done = sum(snap["tenants"][t]["requests_completed"]
+                   for t in ("bulk", "chat"))
+        assert done == len(futs)
+    finally:
+        router.close()
+
+
+def test_routed_output_bitwise_equals_direct(small_model, fresh_registry):
+    """The front door adds scheduling, not numerics: routed output is
+    BITWISE the direct runtime's output."""
+    model, params, state = small_model
+    x = _row(7)
+    direct = ServingRuntime(model, params, state, buckets=(1, 8),
+                            max_wait_ms=1.0,
+                            example_input=np.zeros((1, 6), np.float32))
+    try:
+        want = np.asarray(direct.predict(x))
+    finally:
+        direct.close()
+    router = FleetRouter(_serving_factory(small_model), n_replicas=1,
+                         tenants=[TenantConfig("t")])
+    try:
+        got = np.asarray(router.predict("t", x, deadline_ms=30_000,
+                                        timeout=30))
+        np.testing.assert_array_equal(want, got)
+    finally:
+        router.close()

@@ -85,6 +85,19 @@ if [ "$rc" -eq 0 ]; then
     elapsed=$(( $(date +%s) - start ))
 fi
 
+if [ "$rc" -eq 0 ]; then
+    # fleet lane: 2 tenants x 2 replicas through the multi-tenant front
+    # door with a replica SIGKILL mid-burst — the interactive tenant's
+    # SLO must hold under the batch flood, zero accepted requests may be
+    # silently dropped, and the replacement replica must warm from the
+    # compilecache (warmup_reused > 0, zero steady-recompile alarms)
+    remaining=$(( BUDGET - elapsed ))
+    [ "$remaining" -lt 30 ] && remaining=30
+    timeout --signal=TERM "$remaining" python tools/fleet_smoke.py
+    rc=$?
+    elapsed=$(( $(date +%s) - start ))
+fi
+
 if [ "$rc" -eq 124 ]; then
     echo "FAIL: quick tier exceeded the ${BUDGET}s budget (killed)" >&2
     exit 1

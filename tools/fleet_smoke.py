@@ -1,0 +1,168 @@
+"""CI fleet lane: the multi-tenant front door, validated end to end.
+
+Runs — in ONE process under JAX_PLATFORMS=cpu — the ISSUE 11 acceptance
+scenario: 2 tenants x 2 replicas with the compile cache on, a batch-tier
+flood against an interactive tenant, a SIGKILL-analog replica drop
+mid-burst, and the assertions that make the fleet layer trustworthy:
+
+  * SLO isolation: the flooding batch tenant does not starve the
+    interactive tenant — every interactive request completes within its
+    deadline class, zero deadline rejections for it;
+  * zero silent drops: every ACCEPTED request settles with a result or
+    a loud error (killing one replica mid-burst loses nothing);
+  * warm scale-out: the replacement replica warms from the process-
+    scoped compilecache live layer — `fleet/warmup_reused` > 0 and ZERO
+    steady-state recompile alarms;
+  * per-tenant metrics: the Prometheus textfile export carries
+    `{tenant="..."}` labeled series for both tenants.
+
+Usage: python tools/fleet_smoke.py
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax.extend.backend as _jeb
+
+    _jeb.clear_backends()
+except Exception:  # pragma: no cover - fallback for older jax
+    import jax._src.xla_bridge as _xb
+
+    _xb._clear_backends()
+
+import bigdl_tpu.compilecache as cc  # noqa: E402
+import bigdl_tpu.nn as nn  # noqa: E402
+from bigdl_tpu import obs  # noqa: E402
+from bigdl_tpu.fleet import FleetRouter, TenantConfig  # noqa: E402
+from bigdl_tpu.resilience import ReplicaKillFault  # noqa: E402
+from bigdl_tpu.serving import ServingRuntime  # noqa: E402
+
+N_BULK = 40
+N_CHAT = 12
+CHAT_DEADLINE_MS = 10_000.0  # generous for a shared-CPU CI box; the SLO
+#                              bar is "completed in deadline", not a
+#                              wall-clock latency claim
+
+
+def main() -> int:
+    obs.set_observability(metrics=True, tracing=True, compile_monitor=True)
+    reg = obs.registry()
+    cache_dir = tempfile.mkdtemp(prefix="fleet_smoke_cc_")
+    cc.set_cache_dir(cache_dir)
+
+    model = nn.Sequential(nn.Linear(6, 32), nn.ReLU(), nn.Linear(32, 4))
+    params, state, _ = model.build(jax.random.PRNGKey(0), (8, 6))
+
+    def factory(name):
+        return ServingRuntime(model, params, state, buckets=(1, 8),
+                              max_wait_ms=1.0,
+                              example_input=np.zeros((1, 6), np.float32))
+
+    router = FleetRouter(
+        factory, n_replicas=2,
+        tenants=[TenantConfig("bulk", tier="batch", weight=2.0,
+                              capacity=256),
+                 TenantConfig("chat", tier="interactive", capacity=64)])
+    fault = ReplicaKillFault(at_dispatch=8)
+    router.set_chaos(fault)
+
+    rng = np.random.RandomState(0)
+    futs = []
+    for i in range(N_BULK + N_CHAT):
+        if i % ((N_BULK + N_CHAT) // N_CHAT) == 0 and \
+                sum(1 for t, _ in futs if t == "chat") < N_CHAT:
+            futs.append(("chat", router.submit(
+                "chat", rng.rand(1, 6).astype(np.float32),
+                deadline_ms=CHAT_DEADLINE_MS)))
+        else:
+            futs.append(("bulk", router.submit(
+                "bulk", rng.rand(4, 6).astype(np.float32),
+                deadline_ms=60_000)))
+
+    # scale back out while the burst drains (the replacement must warm
+    # from the live layer, not recompile)
+    router.add_replica()
+
+    lost = 0
+    for tenant, fut in futs:
+        try:
+            out = fut.result(60)
+            assert np.all(np.isfinite(np.asarray(out)))
+        except Exception as e:  # noqa: BLE001 — loud errors are allowed…
+            print(f"  loud failure ({tenant}): {type(e).__name__}: {e}")
+            if tenant == "chat":
+                lost += 1  # …but not for the interactive SLO tenant
+
+    snap = router.snapshot()
+    chat, bulk = snap["tenants"]["chat"], snap["tenants"]["bulk"]
+    prom_path = os.path.join(cache_dir, "metrics.prom")
+    reg.export_prometheus(prom_path)
+    prom = open(prom_path).read()
+    router.close()
+    cc.reset()
+
+    n_chat = sum(1 for t, _ in futs if t == "chat")
+    n_bulk = len(futs) - n_chat
+    print(f"fleet_smoke: {n_bulk} bulk + {n_chat} chat requests, "
+          f"kill at dispatch #{fault.at_dispatch}")
+    print(f"  killed replica: {fault.fired}")
+    print(f"  chat:  completed={chat['requests_completed']} "
+          f"deadline_rejected={chat['rejected_deadline']} "
+          f"p99={chat['latency_ms']['p99']:.1f}ms")
+    print(f"  bulk:  completed={bulk['requests_completed']} "
+          f"deadline_rejected={bulk['rejected_deadline']}")
+    print(f"  redispatched={snap['redispatched']} "
+          f"warmup_reused={snap['warmup_reused']} "
+          f"steady_recompiles={reg.get('compile/steady_recompiles')}")
+
+    failures = []
+    if len(fault.fired) != 1:
+        failures.append(f"chaos fault fired {len(fault.fired)} times, want 1")
+    if chat["requests_completed"] != n_chat or lost:
+        failures.append(
+            f"interactive SLO breach: {chat['requests_completed']}/{n_chat} "
+            f"chat requests completed ({lost} failed loudly)")
+    total_settled = (chat["requests_completed"] + chat["rejected_deadline"]
+                     + bulk["requests_completed"] + bulk["rejected_deadline"])
+    if total_settled < len(futs):
+        failures.append(
+            f"silent drop: {len(futs)} accepted, only {total_settled} "
+            "settled with a result or a loud deadline rejection")
+    if snap["warmup_reused"] <= 0:
+        failures.append("scale-out warmed nothing from the compilecache "
+                        "(fleet/warmup_reused == 0)")
+    if reg.get("compile/steady_recompiles") > 0:
+        failures.append(
+            f"{int(reg.get('compile/steady_recompiles'))} steady-state "
+            "recompile alarm(s): warm scale-out recompiled")
+    for tenant in ("chat", "bulk"):
+        needle = f'{{tenant="{tenant}"}}'
+        if needle not in prom:
+            failures.append(f"Prometheus export missing {needle} series")
+
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}")
+        return 1
+    print("OK: fleet lane green (SLO isolation, zero silent drops, "
+          "warm scale-out, per-tenant metrics)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
