@@ -25,6 +25,20 @@ or generation.json.
 
     python benchmarks/bench_generation.py            # TPU-sized LM
     python benchmarks/bench_generation.py --quick    # CPU-sized LM
+
+`--decode-quick` instead runs the ISSUE 12 decode-path evidence and
+writes results/decode_quick.json:
+
+  * interleaved A/B of the decode-attention lowerings (dense ring-mask
+    path vs the length-1-query `decode_attention_ref`) per KV capacity,
+    including a long-context frontier — the measurements backing
+    `_MEASURED_DEFAULTS` in bigdl_tpu/ops/decode_attention.py (the
+    shipping table must agree with this file's winners);
+  * KV bytes-per-resident-token for fp32 vs int8 pools (the >= 1.9x
+    resident-tokens-per-byte acceptance bar);
+  * an engine-level ring vs paged vs paged+int8 A/B on a mixed-length
+    workload: same greedy tokens, executable budget, and the HBM bytes
+    actually resident (paged pool oversubscribed below ring worst case).
 """
 
 from __future__ import annotations
@@ -94,12 +108,176 @@ def run_phase(engine, vocab: int, phase: str, n: int, max_new: int) -> dict:
     }
 
 
+def _bench_decode_impls(capacities, b=4, h=4, d=16, iters=200, rounds=7):
+    """Interleaved A/B of the S=1 decode-attention lowerings at each KV
+    capacity.  Alternating dense/ref inside every round cancels thermal
+    and allocator drift; the per-round medians are what decides the
+    `_MEASURED_DEFAULTS` shipping table."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.attention import causal_mask
+    from bigdl_tpu.ops.attention import dense_attention
+    from bigdl_tpu.ops.decode_attention import decode_attention_ref
+
+    rows = []
+    for cap in capacities:
+        rng = np.random.default_rng(cap)
+        q = jnp.asarray(rng.normal(size=(b, h, d)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(b, cap, h, d)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(b, cap, h, d)).astype(np.float32))
+        lengths = jnp.asarray(
+            rng.integers(cap // 2, cap, size=(b,)).astype(np.int32))
+
+        @jax.jit
+        def dense(q, k, v, lengths):
+            mask = jax.vmap(
+                lambda off: causal_mask(1, k.shape[1], q_offset=off))(lengths)
+            return dense_attention(q[:, None], k, v, mask=mask[:, None])
+
+        @jax.jit
+        def ref(q, k, v, lengths):
+            return decode_attention_ref(q, k, v, lengths=lengths)
+
+        fns = {"dense": dense, "ref": ref}
+        for f in fns.values():  # warm outside the timed region
+            jax.block_until_ready(f(q, k, v, lengths))
+        samples = {name: [] for name in fns}
+        for _ in range(rounds):
+            for name, f in fns.items():  # interleave A/B every round
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = f(q, k, v, lengths)
+                jax.block_until_ready(out)
+                samples[name].append((time.perf_counter() - t0) / iters)
+        med = {name: float(np.median(ts) * 1e6)
+               for name, ts in samples.items()}
+        winner = min(med, key=med.get)
+        rows.append({
+            "capacity": int(cap), "batch": b, "n_head": h, "head_dim": d,
+            "dense_us": round(med["dense"], 2), "ref_us": round(med["ref"], 2),
+            "winner": winner,
+            "speedup_vs_dense": round(med["dense"] / med[winner], 3),
+        })
+        print(json.dumps(rows[-1]), flush=True)
+    return rows
+
+
+def _bench_kv_bytes():
+    """Bytes per resident KV token, fp32 vs int8(+fp32 scales)."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.generation import BlockPool
+
+    rows = []
+    for tag, n_layer, n_head, head_dim in (("quick", 2, 4, 16),
+                                           ("7b-ish", 32, 32, 128)):
+        fp = BlockPool(n_layer, 2, 16, n_head, head_dim, jnp.float32)
+        q8 = BlockPool(n_layer, 2, 16, n_head, head_dim, jnp.int8)
+        ratio = fp.bytes_per_token() / q8.bytes_per_token()
+        rows.append({
+            "model": tag, "n_layer": n_layer, "n_head": n_head,
+            "head_dim": head_dim,
+            "fp32_bytes_per_token": fp.bytes_per_token(),
+            "int8_bytes_per_token": q8.bytes_per_token(),
+            "resident_tokens_per_byte_ratio": round(ratio, 3),
+        })
+        print(json.dumps(rows[-1]), flush=True)
+    return rows
+
+
+def _bench_engine_paged(vocab, variants):
+    """Ring fp32 vs paged fp32 vs paged int8 through the REAL engine on a
+    mixed-length workload.  The paged pool is sized BELOW ring worst case
+    (oversubscribed) so admission backpressure and block recycling are in
+    the measured path; fp32 paged tokens must equal ring bitwise."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.generation import GenerationConfig, GenerationEngine
+
+    _, model, params = variants[0]
+    buckets, slots, max_new = (32, 128), 4, 16
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, vocab, size=int(s))
+               for s in rng.choice([5, 9, 24, 60, 100], size=16)]
+
+    def run(label, **kw):
+        # fresh CompileMonitor per engine: the previous engine already
+        # marked generation/ steady, so this engine's own warmup would
+        # otherwise read as false steady-state alarms
+        from bigdl_tpu import obs
+        obs.set_observability(metrics=True, compile_monitor=True)
+        cfg = GenerationConfig(buckets=buckets, slots=slots, capacity=64,
+                               max_new_tokens=max_new, **kw)
+        eng = GenerationEngine(model, params, config=cfg)
+        try:
+            t0 = time.perf_counter()
+            futs = [eng.submit(p) for p in prompts]
+            toks = [f.result(timeout=600).tokens.tolist() for f in futs]
+            wall = time.perf_counter() - t0
+            row = {"engine": label, "kv_hbm_bytes": eng.kv_nbytes(),
+                   "wall_s": round(wall, 2),
+                   "compiled_executables": eng.compile_count(),
+                   "tokens": sum(len(t) for t in toks)}
+            if eng._pool is not None:
+                assert eng._pool.blocks_free == eng._pool.n_allocatable
+            return row, toks
+        finally:
+            eng.close()
+
+    # worst case would be 2*4 + 8*4 + 1 = 41 blocks of 16; give 24 so the
+    # pool is ~0.56x ring worst case and admission has to recycle
+    rows = []
+    ring_row, ring_toks = run("ring_fp32")
+    rows.append(ring_row)
+    for label, kw in (
+            ("paged_fp32", dict(paged=True, kv_pool_blocks=24)),
+            ("paged_int8", dict(paged=True, kv_pool_blocks=24,
+                                cache_dtype=jnp.int8))):
+        row, toks = run(label, **kw)
+        row["hbm_vs_ring"] = round(row["kv_hbm_bytes"]
+                                   / ring_row["kv_hbm_bytes"], 3)
+        row["tokens_equal_ring"] = toks == ring_toks
+        if label == "paged_fp32":
+            assert toks == ring_toks, "paged fp32 lost bitwise parity"
+        rows.append(row)
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    return rows
+
+
+def run_decode_quick() -> None:
+    import jax
+
+    platform = jax.devices()[0].platform
+    out = {
+        "platform": platform,
+        "decode_attention_us": _bench_decode_impls((32, 128, 512)),
+        "long_context_frontier_us": _bench_decode_impls(
+            (1024, 4096), iters=50, rounds=5),
+        "kv_bytes_per_token": _bench_kv_bytes(),
+        "engine_paged_ab": _bench_engine_paged(*build_variants(True)),
+    }
+    path = os.path.join(os.path.dirname(__file__), "results",
+                        "decode_quick.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"# wrote {path}")
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="2-layer hidden-64 LM, fewer requests (CPU-sized)")
+    ap.add_argument("--decode-quick", action="store_true",
+                    help="decode-attention A/B + paged/int8 KV evidence "
+                         "(writes results/decode_quick.json)")
     ap.add_argument("--requests", type=int, default=None)
     args = ap.parse_args(argv)
+
+    if args.decode_quick:
+        run_decode_quick()
+        return
 
     import jax
 

@@ -1,10 +1,13 @@
 """bigdl_tpu.generation — TPU-native autoregressive inference.
 
 The LLM-serving subsystem: ring-buffer KV caches at bucketed max lengths
-(kvcache.py), on-device greedy/temperature/top-k sampling (sampling.py),
-and a continuous-batching prefill/decode engine (engine.py) layered on the
-serving stack's registry/hot-swap/AOT-warmup machinery.  See the module
-docstrings and docs/serving.md "Autoregressive generation".
+(kvcache.py) or a shared paged block pool (pagedkv.py, env
+`BIGDL_TPU_PAGED_KV`), optional int8 KV quantization
+(`BIGDL_TPU_KV_DTYPE=int8`), on-device greedy/temperature/top-k sampling
+(sampling.py), and a continuous-batching prefill/decode engine
+(engine.py) layered on the serving stack's registry/hot-swap/AOT-warmup
+machinery.  See the module docstrings and docs/serving.md
+"Autoregressive generation" / "Paged KV & quantized cache".
 
 ```python
 from bigdl_tpu.generation import GenerationEngine
@@ -27,15 +30,25 @@ from bigdl_tpu.generation.engine import (
     GenerationResult,
 )
 from bigdl_tpu.generation.kvcache import KVCache, alloc, insert
+from bigdl_tpu.generation.pagedkv import (
+    DEFAULT_BLOCK_SIZE,
+    BlockPool,
+    PagedKVCache,
+    blocks_for,
+)
 from bigdl_tpu.generation.sampling import apply_top_k, sample_tokens
 
 __all__ = [
+    "DEFAULT_BLOCK_SIZE",
+    "BlockPool",
     "GenerationConfig",
     "GenerationEngine",
     "GenerationResult",
     "KVCache",
+    "PagedKVCache",
     "alloc",
     "apply_top_k",
+    "blocks_for",
     "insert",
     "sample_tokens",
 ]

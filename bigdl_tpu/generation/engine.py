@@ -41,6 +41,8 @@ first when strict single-version generations are required.
 
 from __future__ import annotations
 
+import logging
+import os
 import threading
 import time
 from collections import deque
@@ -54,23 +56,39 @@ import numpy as np
 from bigdl_tpu import obs as _obs
 from bigdl_tpu.analysis.runtime import strict_transfers, strict_transfers_enabled
 from bigdl_tpu.generation.kvcache import KVCache, insert
+from bigdl_tpu.generation.pagedkv import (DEFAULT_BLOCK_SIZE, BlockPool,
+                                          PagedKVCache, blocks_for)
 from bigdl_tpu.generation.sampling import sample_tokens
 from bigdl_tpu.serving.batcher import Rejected, ServingClosed, _Future
 from bigdl_tpu.serving.metrics import GenerationMetrics
 from bigdl_tpu.serving.registry import ModelRegistry, ModelVersion
 
 _NULL = nullcontext()
+_log = logging.getLogger("bigdl_tpu.generation")
+
+_KV_DTYPES = {"int8": jnp.int8, "bf16": jnp.bfloat16,
+              "bfloat16": jnp.bfloat16, "fp32": jnp.float32,
+              "float32": jnp.float32}
 
 
 class GenerationConfig:
-    """Knobs for the generation engine (docs/serving.md)."""
+    """Knobs for the generation engine (docs/serving.md).
+
+    `paged=None` / `cache_dtype=None` defer to the `BIGDL_TPU_PAGED_KV` /
+    `BIGDL_TPU_KV_DTYPE` environment variables (docs/serving.md "Paged KV
+    & quantized cache"), so deployments flip the allocator and KV dtype
+    without touching call sites; the in-code default stays the ring
+    fp32 baseline."""
 
     def __init__(self, buckets: Sequence[int] = (64, 256), slots: int = 4,
                  capacity: int = 128, max_new_tokens: int = 64,
                  temperature: float = 0.0, top_k: int = 0,
                  eos_id: Optional[int] = None, cache_dtype=None,
                  seed: int = 0, reject_nonfinite: bool = False,
-                 strict_transfers: Optional[bool] = None):
+                 strict_transfers: Optional[bool] = None,
+                 paged: Optional[bool] = None,
+                 kv_block_size: int = DEFAULT_BLOCK_SIZE,
+                 kv_pool_blocks: Optional[int] = None):
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         if not self.buckets or self.buckets[0] < 2:
             raise ValueError(f"length buckets must be >= 2, got {buckets}")
@@ -80,10 +98,29 @@ class GenerationConfig:
         self.temperature = float(temperature)
         self.top_k = int(top_k)          # static: part of the executables
         self.eos_id = eos_id
+        if cache_dtype is None:
+            env = os.environ.get("BIGDL_TPU_KV_DTYPE", "").strip().lower()
+            if env and env not in _KV_DTYPES:
+                raise ValueError(
+                    f"BIGDL_TPU_KV_DTYPE={env!r}: expected one of "
+                    f"{sorted(_KV_DTYPES)}")
+            cache_dtype = _KV_DTYPES.get(env)
         self.cache_dtype = cache_dtype or jnp.float32
         self.seed = int(seed)
         self.reject_nonfinite = bool(reject_nonfinite)
         self.strict_transfers = strict_transfers
+        if paged is None:
+            paged = os.environ.get("BIGDL_TPU_PAGED_KV", "").strip().lower() \
+                in ("1", "true", "on", "yes")
+        self.paged = bool(paged)
+        self.kv_block_size = int(kv_block_size)
+        self.kv_pool_blocks = kv_pool_blocks
+        if self.paged:
+            bad = [b for b in self.buckets if b % self.kv_block_size]
+            if bad:
+                raise ValueError(
+                    f"paged KV needs every bucket divisible by "
+                    f"kv_block_size={self.kv_block_size}, got {bad}")
 
 
 class GenerationResult(NamedTuple):
@@ -121,15 +158,36 @@ class _GenRequest:
 
 
 class _Lane:
-    """One length bucket: a (slots, C) KV cache + host-side bookkeeping."""
+    """One length bucket: its KV residency + host-side bookkeeping.
 
-    def __init__(self, model, bucket: int, slots: int, dtype):
+    Ring mode owns a private `(slots, C)` `KVCache`; paged mode owns no
+    K/V at all — just this lane's (slots, max_blocks) block table and
+    lengths over the engine-wide `BlockPool`, composed into a
+    `PagedKVCache` view per step.  Table edits happen on the host mirror
+    (`table_np`) and upload lazily (`_table_dirty`) so steady-state
+    decode with no claims moves zero table bytes."""
+
+    def __init__(self, model, bucket: int, slots: int, dtype,
+                 pool: Optional[BlockPool] = None):
         self.bucket = bucket
-        # committed placement: pjit caches key on sharding commitment, so
-        # every input (cache, tokens, scalars) must be device_put like the
-        # warmup args or the first real step silently re-traces
-        self.cache: KVCache = jax.device_put(
-            model.init_cache(slots, bucket, dtype))
+        self.pool = pool
+        if pool is None:
+            # committed placement: pjit caches key on sharding commitment,
+            # so every input (cache, tokens, scalars) must be device_put
+            # like the warmup args or the first real step silently
+            # re-traces
+            self.cache: KVCache = jax.device_put(
+                model.init_cache(slots, bucket, dtype))
+        else:
+            nbb = bucket // pool.block_size
+            self.table_np = np.zeros((slots, nbb), np.int32)
+            self._table_dev = jax.device_put(jnp.zeros((slots, nbb),
+                                                       jnp.int32))
+            self._table_dirty = False
+            self.lengths_dev = jax.device_put(jnp.zeros((slots,), jnp.int32))
+            self.lengths_np = np.zeros((slots,), np.int64)
+            self.claimed: List[List[int]] = [[] for _ in range(slots)]
+            self.reserved: List[int] = [0] * slots
         self.slots: List[Optional[_SlotState]] = [None] * slots
         self.free: List[int] = list(range(slots))
         # host mirrors, device_put explicitly each step (tiny, guard-safe)
@@ -140,6 +198,12 @@ class _Lane:
     @property
     def n_active(self) -> int:
         return int(self.active_np.sum())
+
+    def table_dev(self) -> jax.Array:
+        if self._table_dirty:
+            self._table_dev = jax.device_put(jnp.asarray(self.table_np))
+            self._table_dirty = False
+        return self._table_dev
 
 
 def _tree_sig(tree: Any) -> tuple:
@@ -173,9 +237,32 @@ class GenerationEngine:
         self._uid_counter = 0
         self._steps = 0
         self._strict = strict_transfers_enabled(self.config.strict_transfers)
+        self._pool: Optional[BlockPool] = None
+        if self.config.paged:
+            blk = self.config.kv_block_size
+            # probe each bucket through init_cache so paged lanes get the
+            # same rope/max_len validation as ring lanes, and read the
+            # model's cache dims off the last probe (works through
+            # delegating wrappers like WeightOnlyInt8)
+            for b in self.config.buckets:
+                probe = model.init_cache(1, b, self.config.cache_dtype)
+            n_layer, _, _, n_head, head_dim = probe.k.shape
+            n_blocks = self.config.kv_pool_blocks
+            if n_blocks is None:
+                # worst case every slot of every lane fully resident,
+                # +1 for the trash block — sized for zero admission
+                # backpressure; shrink kv_pool_blocks to oversubscribe
+                n_blocks = 1 + sum(
+                    blocks_for(b, blk) * self.config.slots
+                    for b in self.config.buckets)
+            self._pool = BlockPool(n_layer, int(n_blocks), blk, n_head,
+                                   head_dim, self.config.cache_dtype)
         self._lanes: Dict[int, _Lane] = {
-            b: _Lane(model, b, self.config.slots, self.config.cache_dtype)
+            b: _Lane(model, b, self.config.slots, self.config.cache_dtype,
+                     pool=self._pool)
             for b in self.config.buckets}
+        self._warned_wrap = False
+        self._update_kv_gauges()
         self._prefill, self._decode = self._build_fns()
         # warmed executables: (phase, bucket) -> callable (AOT-loaded when
         # the compile cache is on, the pjit fn otherwise); psig pins the
@@ -215,22 +302,51 @@ class GenerationEngine:
     def _build_fns(self):
         m = self.model
         top_k = self.config.top_k
+        paged = self.config.paged
 
-        def prefill(params, cache, tokens, n, slot, temp, seed, uid):
+        def prefill_ring(params, cache, tokens, n, slot, temp, seed, uid):
             # fresh single-slot cache at the lane's capacity; fold the
             # prompt in, sample token #1 from the last REAL row, then
             # write the slot — all one executable per bucket, so slot
             # claim costs no extra compile
             L, _, C, H, D = cache.k.shape
-            fresh = KVCache(k=jnp.zeros((L, 1, C, H, D), cache.k.dtype),
-                            v=jnp.zeros((L, 1, C, H, D), cache.v.dtype),
-                            lengths=jnp.zeros((1,), jnp.int32))
+            quant = cache.k_scale is not None
+            fresh = KVCache(
+                k=jnp.zeros((L, 1, C, H, D), cache.k.dtype),
+                v=jnp.zeros((L, 1, C, H, D), cache.v.dtype),
+                lengths=jnp.zeros((1,), jnp.int32),
+                k_scale=jnp.zeros((L, 1, C, H), jnp.float32)
+                if quant else None,
+                v_scale=jnp.zeros((L, 1, C, H), jnp.float32)
+                if quant else None)
             logp, fresh = m.apply_cached(params, tokens, fresh)
             last = jax.lax.dynamic_slice_in_dim(logp, n - 1, 1, axis=1)[:, 0]
             key = jax.random.fold_in(jax.random.PRNGKey(seed), uid)
             tok = sample_tokens(last, key, temp, top_k=top_k)
             ok = jnp.isfinite(last).all()
             return tok, insert(cache, slot, fresh, n), ok
+
+        def prefill_paged(params, cache, tokens, n, slot, temp, seed, uid):
+            # no fresh buffer + insert here: the slot's table row is
+            # sliced out and the prompt's K/V stream STRAIGHT into the
+            # claimed pool blocks (pad positions past the claimed prefix
+            # hit the trash block).  Same signature, so the warmup /
+            # compile-count machinery is allocator-agnostic.
+            row = jax.lax.dynamic_slice_in_dim(cache.block_tables, slot, 1, 0)
+            sub = PagedKVCache(k=cache.k, v=cache.v, block_tables=row,
+                               lengths=jnp.zeros((1,), jnp.int32),
+                               k_scale=cache.k_scale, v_scale=cache.v_scale)
+            logp, sub = m.apply_cached(params, tokens, sub)
+            last = jax.lax.dynamic_slice_in_dim(logp, n - 1, 1, axis=1)[:, 0]
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), uid)
+            tok = sample_tokens(last, key, temp, top_k=top_k)
+            ok = jnp.isfinite(last).all()
+            new = cache._replace(
+                k=sub.k, v=sub.v, k_scale=sub.k_scale, v_scale=sub.v_scale,
+                lengths=cache.lengths.at[slot].set(jnp.asarray(n, jnp.int32)))
+            return tok, new, ok
+
+        prefill = prefill_paged if paged else prefill_ring
 
         def decode(params, cache, last_tokens, temps, active, step, seed):
             logp, new = m.apply_cached(params, last_tokens, cache)
@@ -250,8 +366,17 @@ class GenerationEngine:
         # arrays) match the hot path exactly — an uncommitted numpy arg
         # here would warm an executable the real steps never hit
         s, c = self.config.slots, lane.bucket
-        throwaway = jax.device_put(
-            self.model.init_cache(s, c, self.config.cache_dtype))
+        if self._pool is not None:
+            # warm against the REAL pool arrays (functional: outputs are
+            # discarded), with an all-trash table — same avals as the hot
+            # path without double-allocating pool-sized HBM
+            nbb = c // self._pool.block_size
+            throwaway = self._pool.lane_view(
+                jax.device_put(jnp.zeros((s, nbb), jnp.int32)),
+                jax.device_put(jnp.zeros((s,), jnp.int32)))
+        else:
+            throwaway = jax.device_put(
+                self.model.init_cache(s, c, self.config.cache_dtype))
         pre = (params, throwaway) + jax.device_put(
             (np.zeros((1, c), np.int32), np.int32(1), np.int32(0),
              np.zeros((1,), np.float32), np.int32(self.config.seed),
@@ -293,7 +418,17 @@ class GenerationEngine:
                             extra_key={"kind": "generation", "phase": phase,
                                        "bucket": lane.bucket,
                                        "slots": self.config.slots,
-                                       "top_k": self.config.top_k},
+                                       "top_k": self.config.top_k,
+                                       # allocator/dtype enter the traced
+                                       # avals (table shapes, int8 pools)
+                                       # and so the StableHLO digest too;
+                                       # keyed explicitly as belt and
+                                       # suspenders
+                                       "paged": self.config.paged,
+                                       "kv_dtype": str(jnp.dtype(
+                                           self.config.cache_dtype)),
+                                       "block": self.config.kv_block_size
+                                       if self.config.paged else 0},
                             process_scope="generation")
                         self._warmed[keyk] = warmed if status != "error" else fn
                     else:
@@ -322,6 +457,45 @@ class GenerationEngine:
             return int(n) + aot
         except Exception:
             return len(self._warmed)
+
+    # -- KV residency ------------------------------------------------------
+
+    def _lane_cache(self, lane: _Lane):
+        """The device cache pytree for one step: the lane's private ring,
+        or a PagedKVCache view composing the shared pool with this lane's
+        (lazily uploaded) table + lengths."""
+        if self._pool is None:
+            return lane.cache
+        return self._pool.lane_view(lane.table_dev(), lane.lengths_dev)
+
+    def _store_cache(self, lane: _Lane, new) -> None:
+        if self._pool is None:
+            lane.cache = new
+            return
+        self._pool.update_from(new)
+        lane.lengths_dev = new.lengths
+
+    def kv_nbytes(self) -> int:
+        """Device bytes resident for KV (pool, or the sum of ring lanes)."""
+        if self._pool is not None:
+            return self._pool.nbytes()
+        return sum(lane.cache.nbytes() for lane in self._lanes.values())
+
+    def _update_kv_gauges(self) -> None:
+        # HBM budgeting gauges (Prometheus: bigdl_tpu_generation_...
+        # {lane="..."}); host-side arithmetic only, no device sync
+        reg = _obs.registry()
+        if self._pool is not None:
+            reg.set_gauge("generation/kv_hbm_bytes|lane=pool",
+                          float(self._pool.nbytes()))
+            reg.set_gauge("generation/kv_blocks_free",
+                          float(self._pool.blocks_free))
+            reg.set_gauge("generation/kv_blocks_reserved",
+                          float(self._pool.blocks_reserved))
+        else:
+            for b, lane in self._lanes.items():
+                reg.set_gauge(f"generation/kv_hbm_bytes|lane={b}",
+                              float(lane.cache.nbytes()))
 
     # -- admission ---------------------------------------------------------
 
@@ -398,8 +572,49 @@ class GenerationEngine:
                 if lane is None:
                     return  # every eligible slot busy; retry after decode
                 req = self._pending.popleft()
-            s = lane.free.pop()
             n = int(req.prompt.size)
+            if lane.bucket < n + req.max_new:
+                # the prompt only fit a wrap lane: generation will slide
+                # the window over the last `bucket` tokens — correct but
+                # lossy, so make the degradation observable
+                _obs.registry().inc("generation/wrapped_prefills")
+                if not self._warned_wrap:
+                    self._warned_wrap = True
+                    _log.warning(
+                        "prefill of %d tokens + %d max_new exceeds bucket "
+                        "%d: the KV ring will wrap and attention degrades "
+                        "to a sliding window over the last %d tokens "
+                        "(further wraps counted in "
+                        "generation/wrapped_prefills, warned once)",
+                        n, req.max_new, lane.bucket, lane.bucket)
+            if self._pool is not None:
+                # worst-case logical reservation up front so the lazy
+                # per-step claims below can never fail mid-decode
+                need = blocks_for(min(lane.bucket, n + req.max_new),
+                                  self._pool.block_size)
+                if need > self._pool.n_allocatable:
+                    req.future.set_error(Rejected(
+                        f"request needs {need} KV blocks but the pool only "
+                        f"has {self._pool.n_allocatable}; raise "
+                        "kv_pool_blocks or shrink max_new_tokens"))
+                    continue
+                if not self._pool.reserve(need):
+                    # pool budget exhausted: requeue at head, retry after
+                    # an in-flight request retires and releases blocks
+                    with self._cond:
+                        self._pending.appendleft(req)
+                    return
+            s = lane.free.pop()
+            if self._pool is not None:
+                npre = blocks_for(n, self._pool.block_size)
+                ids = self._pool.claim(npre)
+                lane.claimed[s] = ids
+                lane.reserved[s] = need
+                lane.table_np[s, :] = 0
+                lane.table_np[s, :npre] = ids
+                lane._table_dirty = True
+                lane.lengths_np[s] = n
+                self._update_kv_gauges()
             padded = np.zeros((1, lane.bucket), np.int32)
             padded[0, :n] = req.prompt
             fn = self._fn("prefill", lane.bucket, snap)
@@ -410,11 +625,12 @@ class GenerationEngine:
                     (mon.attribute(f"generation/prefill/bucket={lane.bucket}")
                      if mon is not None else _NULL), \
                     strict_transfers(self._strict):
-                tok, lane.cache, ok = fn(
-                    snap.params, lane.cache, *jax.device_put(
+                tok, new_cache, ok = fn(
+                    snap.params, self._lane_cache(lane), *jax.device_put(
                         (padded, np.int32(n), np.int32(s),
                          np.asarray([req.temperature], np.float32),
                          np.int32(self.config.seed), np.int32(req.uid))))
+                self._store_cache(lane, new_cache)
                 tok = int(jax.device_get(tok)[0])
                 ok = bool(jax.device_get(ok))
             t1 = time.perf_counter()
@@ -444,6 +660,25 @@ class GenerationEngine:
         fn = self._fn("decode", lane.bucket, snap)
         cids = [lane.slots[s].req.cid for s in range(self.config.slots)
                 if lane.slots[s] is not None]
+        if self._pool is not None:
+            # lazy physical claims: a slot whose NEXT write position
+            # crosses into an unclaimed block claims it now (covered by
+            # the admission reservation, so this cannot fail); ring wrap
+            # cycles back into already-claimed blocks and claims nothing
+            claimed_any = False
+            for s in range(self.config.slots):
+                if not lane.active_np[s]:
+                    continue
+                bi = (int(lane.lengths_np[s]) % lane.bucket) \
+                    // self._pool.block_size
+                if bi == len(lane.claimed[s]):
+                    bid = self._pool.claim(1)[0]
+                    lane.claimed[s].append(bid)
+                    lane.table_np[s, bi] = bid
+                    lane._table_dirty = True
+                    claimed_any = True
+            if claimed_any:
+                self._update_kv_gauges()
         t0 = time.perf_counter()
         with (tr.span("gen.decode_step", cat="generation",
                       bucket=lane.bucket, active=k, cids=cids)
@@ -451,14 +686,19 @@ class GenerationEngine:
                 (mon.attribute(f"generation/decode/bucket={lane.bucket}")
                  if mon is not None else _NULL), \
                 strict_transfers(self._strict):
-            toks, lane.cache, ok = fn(
-                snap.params, lane.cache, *jax.device_put(
+            toks, new_cache, ok = fn(
+                snap.params, self._lane_cache(lane), *jax.device_put(
                     (lane.last_np, lane.temps_np, lane.active_np,
                      np.int32(self._steps), np.int32(self.config.seed))))
+            self._store_cache(lane, new_cache)
             toks_np = jax.device_get(toks)  # the ONE per-step host sync
             ok_np = jax.device_get(ok)
         step_ms = (time.perf_counter() - t0) * 1e3
         self._steps += 1
+        if self._pool is not None:
+            for s in range(self.config.slots):
+                if lane.active_np[s]:
+                    lane.lengths_np[s] += 1
         self.metrics.on_tokens(k, step_ms)
         for s in range(self.config.slots):
             st = lane.slots[s]
@@ -477,12 +717,28 @@ class GenerationEngine:
             elif st.generated >= st.req.max_new:
                 self._retire(lane, s, "length", tr)
 
+    def _release_blocks(self, lane: _Lane, s: int) -> None:
+        """Return a retired slot's pool blocks + reservation and point its
+        table row back at the trash block (so its fixed-shape decode
+        writes stop touching real blocks)."""
+        if self._pool is None:
+            return
+        self._pool.release(lane.claimed[s])
+        self._pool.unreserve(lane.reserved[s])
+        lane.claimed[s] = []
+        lane.reserved[s] = 0
+        lane.table_np[s, :] = 0
+        lane._table_dirty = True
+        lane.lengths_np[s] = 0
+        self._update_kv_gauges()
+
     def _retire(self, lane: _Lane, s: int, reason: str, tr) -> None:
         st = lane.slots[s]
         req = st.req
         lane.slots[s] = None
         lane.active_np[s] = False
         lane.free.append(s)
+        self._release_blocks(lane, s)
         now = time.perf_counter()
         snap_version = self.registry.active_version
         if reason == "error":
@@ -555,6 +811,7 @@ class GenerationEngine:
                     lane.slots[s] = None
                     lane.active_np[s] = False
                     lane.free.append(s)
+                    self._release_blocks(lane, s)
                     if not st.req.future.done():
                         st.req.future.set_error(err)
         self.metrics.set_active(0)

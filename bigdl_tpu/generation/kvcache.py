@@ -22,18 +22,28 @@ compiled step — never a host round-trip.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class KVCache(NamedTuple):
-    """Per-model KV ring buffer (a jax pytree; see module docstring)."""
+    """Per-model KV ring buffer (a jax pytree; see module docstring).
+
+    With an int8 cache dtype the ring additionally carries per-token
+    per-head fp32 scale planes (`k_scale`/`v_scale`): K/V rows are
+    quantized symmetrically at write time and dequantized fused into the
+    attention read (nn/attention.py), halving-plus HBM per resident
+    token.  fp32/bf16 caches leave the scale fields None.
+    """
 
     k: jax.Array        # (n_layer, slots, capacity, n_head, head_dim)
     v: jax.Array        # same shape as k
     lengths: jax.Array  # (slots,) int32 — total tokens written per slot
+    k_scale: Optional[jax.Array] = None  # (n_layer, slots, capacity, n_head)
+    v_scale: Optional[jax.Array] = None
 
     @property
     def n_layer(self) -> int:
@@ -52,14 +62,30 @@ class KVCache(NamedTuple):
         wraps, then the sliding-window size `capacity`)."""
         return jnp.minimum(self.lengths, self.capacity)
 
+    def nbytes(self) -> int:
+        """Device bytes this cache pins in HBM (K + V + scales +
+        bookkeeping) — the per-lane reservation the paged allocator
+        (pagedkv.py) exists to shrink."""
+        return sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                   for l in (self.k, self.v, self.lengths,
+                             self.k_scale, self.v_scale)
+                   if l is not None)
+
 
 def alloc(n_layer: int, slots: int, capacity: int, n_head: int,
           head_dim: int, dtype=jnp.float32) -> KVCache:
     """Zeroed cache for `slots` concurrent requests of up to `capacity`
-    resident tokens each."""
+    resident tokens each.  `dtype=jnp.int8` allocates the quantized ring
+    (int8 K/V + fp32 per-token per-head scales)."""
     shape = (n_layer, slots, capacity, n_head, head_dim)
+    k_scale = v_scale = None
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+        sshape = (n_layer, slots, capacity, n_head)
+        k_scale = jnp.zeros(sshape, jnp.float32)
+        v_scale = jnp.zeros(sshape, jnp.float32)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
-                   lengths=jnp.zeros((slots,), jnp.int32))
+                   lengths=jnp.zeros((slots,), jnp.int32),
+                   k_scale=k_scale, v_scale=v_scale)
 
 
 def insert(cache: KVCache, slot, src: KVCache, length) -> KVCache:
@@ -73,8 +99,15 @@ def insert(cache: KVCache, slot, src: KVCache, length) -> KVCache:
             f"capacity mismatch: inserting {src.k.shape[2]} into "
             f"{cache.k.shape[2]} (prefill and decode lanes must share a "
             "length bucket)")
+    def upd(dst, src_arr):
+        if dst is None:
+            return None
+        return jax.lax.dynamic_update_index_in_dim(dst, src_arr[:, 0], slot, 1)
+
     return KVCache(
-        k=jax.lax.dynamic_update_index_in_dim(cache.k, src.k[:, 0], slot, 1),
-        v=jax.lax.dynamic_update_index_in_dim(cache.v, src.v[:, 0], slot, 1),
+        k=upd(cache.k, src.k),
+        v=upd(cache.v, src.v),
         lengths=cache.lengths.at[slot].set(
-            jnp.asarray(length, jnp.int32)))
+            jnp.asarray(length, jnp.int32)),
+        k_scale=upd(cache.k_scale, src.k_scale),
+        v_scale=upd(cache.v_scale, src.v_scale))

@@ -1,0 +1,224 @@
+"""Paged KV allocator: one shared HBM block pool for every decode lane.
+
+The ring-buffer cache (kvcache.py) reserves worst-case `(slots, capacity)`
+HBM per bucket lane — a 4-slot 256-bucket lane holds 1024 tokens of K/V
+whether its slots serve 12-token chat turns or full-context documents.
+This module is the vLLM/PagedAttention answer on TPU terms: K/V live in
+fixed-size BLOCKS (`block_size` tokens x n_head x head_dim) inside ONE
+pool shared by all lanes, and each slot owns an int32 BLOCK TABLE padded
+to its bucket's max block count.  Shape discipline is unchanged — the
+table shape per bucket is static, so the executable set stays
+`len(buckets) x 2` — but HBM is claimed per ~block_size tokens actually
+resident instead of per worst-case bucket.
+
+Two halves:
+
+  * `PagedKVCache` — the device pytree (pool arrays + block tables +
+    lengths) that flows through jit exactly like `KVCache`.  Block 0 is
+    the TRASH BLOCK: unclaimed table entries point at it, so the
+    fixed-shape decode step can scatter pad/inactive writes somewhere
+    harmless and gather finite (masked-out) values for unclaimed tail
+    columns.  Nothing ever reads block 0 unmasked, which is what keeps
+    paged-on vs paged-off bitwise-equal at fp32.
+  * `BlockPool` — the HOST-side allocator: a free list over block ids
+    with `claim`/`release` on slot admit/EOS and a logical `reserve`
+    taken at admission for a request's worst-case block count, so a
+    mid-decode claim can never fail (claims are lazy, reservations are
+    conservative; the gap between the two is what the gauges show).
+
+Int8 KV rides along: pass `dtype=jnp.int8` and the pool carries
+per-token per-head fp32 scale planes (`k_scale`/`v_scale`), quantized at
+write and dequantized fused into the decode attention read
+(nn/attention.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_BLOCK_SIZE = 16
+
+
+def _leaf_nbytes(*leaves) -> int:
+    return sum(int(np.prod(l.shape)) * l.dtype.itemsize
+               for l in leaves if l is not None)
+
+
+class PagedKVCache(NamedTuple):
+    """Per-lane view of the shared block pool (a jax pytree).
+
+    `k`/`v` are the POOL: (n_layer, n_blocks, block_size, n_head,
+    head_dim), shared by every lane.  `block_tables` is this lane's
+    (slots, max_blocks) int32 map from ring-block index to pool block id
+    (0 = trash block for unclaimed entries); `lengths` counts total
+    tokens written per slot, exactly like `KVCache.lengths`.  The
+    logical per-slot capacity is `max_blocks * block_size`, and ring
+    index `p % capacity` lives at block `idx // block_size`, offset
+    `idx % block_size`.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    block_tables: jax.Array  # (slots, max_blocks) int32 pool block ids
+    lengths: jax.Array       # (slots,) int32 — total tokens written
+    k_scale: Optional[jax.Array] = None  # (n_layer, n_blocks, block, n_head)
+    v_scale: Optional[jax.Array] = None
+
+    @property
+    def n_layer(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def n_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def slots(self) -> int:
+        return self.block_tables.shape[0]
+
+    @property
+    def max_blocks(self) -> int:
+        return self.block_tables.shape[1]
+
+    @property
+    def capacity(self) -> int:
+        return self.max_blocks * self.block_size
+
+    def window(self) -> jax.Array:
+        return jnp.minimum(self.lengths, self.capacity)
+
+    def nbytes(self) -> int:
+        """Device bytes of the POOL (shared across lanes) plus this
+        lane's table/lengths bookkeeping."""
+        return _leaf_nbytes(self.k, self.v, self.k_scale, self.v_scale,
+                            self.block_tables, self.lengths)
+
+
+def blocks_for(tokens: int, block_size: int) -> int:
+    """Blocks needed to hold `tokens` resident tokens."""
+    return -(-int(tokens) // int(block_size))
+
+
+class BlockPool:
+    """Host-side allocator over the shared device block pool.
+
+    Block 0 is reserved as the trash block and never handed out, so
+    `n_allocatable = n_blocks - 1`.  `reserve(n)` is the ADMISSION-time
+    logical budget (a request's worst-case resident blocks,
+    `ceil(min(bucket, prompt + max_new) / block_size)`); `claim(n)` is
+    the lazy physical allocation as the ring head actually crosses a
+    block boundary.  Because every claim is covered by a prior
+    reservation, `claim` cannot fail mid-decode — admission is the only
+    place that can run out, and it backpressures there.  Thread-safe:
+    the engine loop and `export_metrics` callers may race.
+    """
+
+    def __init__(self, n_layer: int, n_blocks: int, block_size: int,
+                 n_head: int, head_dim: int, dtype=jnp.float32):
+        if n_blocks < 2:
+            raise ValueError(f"pool needs >= 2 blocks (1 is the trash "
+                             f"block), got {n_blocks}")
+        self.block_size = int(block_size)
+        shape = (n_layer, n_blocks, block_size, n_head, head_dim)
+        self.k = jax.device_put(jnp.zeros(shape, dtype))
+        self.v = jax.device_put(jnp.zeros(shape, dtype))
+        self.k_scale = self.v_scale = None
+        if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+            sshape = (n_layer, n_blocks, block_size, n_head)
+            self.k_scale = jax.device_put(jnp.zeros(sshape, jnp.float32))
+            self.v_scale = jax.device_put(jnp.zeros(sshape, jnp.float32))
+        self._lock = threading.Lock()
+        # LIFO free list: recently-released blocks are re-claimed first,
+        # keeping the hot working set compact in the pool
+        self._free: List[int] = list(range(n_blocks - 1, 0, -1))
+        self._reserved = 0
+
+    # -- sizing ------------------------------------------------------------
+
+    @property
+    def n_blocks(self) -> int:
+        return int(self.k.shape[1])
+
+    @property
+    def n_allocatable(self) -> int:
+        return self.n_blocks - 1  # block 0 is the trash block
+
+    @property
+    def blocks_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def blocks_reserved(self) -> int:
+        with self._lock:
+            return self._reserved
+
+    def nbytes(self) -> int:
+        return _leaf_nbytes(self.k, self.v, self.k_scale, self.v_scale)
+
+    def bytes_per_token(self) -> int:
+        """HBM bytes per resident token across all layers (the
+        resident-tokens-per-byte denominator for the int8 A/B)."""
+        n_layer, _, blk, n_head, head_dim = self.k.shape
+        per = 2 * n_layer * n_head * head_dim * self.k.dtype.itemsize
+        if self.k_scale is not None:
+            per += 2 * n_layer * n_head * self.k_scale.dtype.itemsize
+        return per
+
+    # -- allocation --------------------------------------------------------
+
+    def reserve(self, n: int) -> bool:
+        """Logically reserve `n` blocks at admission; False = pool budget
+        exhausted (caller keeps the request queued)."""
+        with self._lock:
+            if self._reserved + n > self.n_allocatable:
+                return False
+            self._reserved += n
+            return True
+
+    def unreserve(self, n: int) -> None:
+        with self._lock:
+            self._reserved -= n
+            assert self._reserved >= 0, "unreserve underflow"
+
+    def claim(self, n: int = 1) -> List[int]:
+        """Physically allocate `n` block ids.  Raises if the free list
+        is short — impossible while every claim is reservation-covered."""
+        with self._lock:
+            if len(self._free) < n:
+                raise RuntimeError(
+                    f"block pool exhausted: want {n}, free {len(self._free)}"
+                    " (claim without a covering reservation?)")
+            out = [self._free.pop() for _ in range(n)]
+            return out
+
+    def release(self, ids: Sequence[int]) -> None:
+        with self._lock:
+            for b in ids:
+                assert 0 < b < self.n_blocks, f"bad block id {b}"
+                assert b not in self._free, f"double release of block {b}"
+                self._free.append(b)
+
+    # -- device-side sync --------------------------------------------------
+
+    def update_from(self, cache: PagedKVCache) -> None:
+        """Adopt the pool arrays a compiled step returned (the engine
+        threads ONE pool through every lane's executables)."""
+        self.k, self.v = cache.k, cache.v
+        if cache.k_scale is not None:
+            self.k_scale, self.v_scale = cache.k_scale, cache.v_scale
+
+    def lane_view(self, block_tables: jax.Array,
+                  lengths: jax.Array) -> PagedKVCache:
+        return PagedKVCache(k=self.k, v=self.v, block_tables=block_tables,
+                            lengths=lengths, k_scale=self.k_scale,
+                            v_scale=self.v_scale)

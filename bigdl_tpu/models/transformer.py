@@ -164,6 +164,13 @@ class TransformerLM(Module):
         absolute positions `cache.lengths[b]..+S-1`; returns (log-probs
         (B, S, V), updated cache with lengths += S).
 
+        `cache` is either a ring `KVCache` or a paged `PagedKVCache`
+        (generation/pagedkv.py) — the layout difference is static pytree
+        structure, so each compiles to its own (still shape-stable)
+        executable.  Either may carry int8 K/V with fp32 scale planes;
+        the per-layer kv dict handed to the block advertises both via
+        its keys (nn/attention.py apply_cached).
+
         Prefill is one call with the prompt (S <= capacity, fresh cache);
         decode is S=1 against the cached prefix — a length-1 query, RoPE
         offset by position, masked by the offset causal mask
@@ -172,6 +179,8 @@ class TransformerLM(Module):
         Dropout/training paths are deliberately absent: this is the
         inference hot loop.
         """
+        from bigdl_tpu.generation.pagedkv import PagedKVCache
+
         b, s = tokens.shape
         h, _ = self.embed.apply(params["embed"], {}, tokens)
         lengths = cache.lengths
@@ -181,31 +190,61 @@ class TransformerLM(Module):
             h = h + jnp.take(params["pos"], pos, axis=0)
 
         blk = self.block
+        paged = isinstance(cache, PagedKVCache)
+        quant = cache.k_scale is not None
+
+        def layer_kv(kl, vl, ksl, vsl):
+            kv = {"k": kl, "v": vl}
+            if quant:
+                kv["k_scale"], kv["v_scale"] = ksl, vsl
+            if paged:
+                # the table is shared by every layer (one claim covers
+                # all layers' pool planes), so it rides via closure, not
+                # as a scanned input
+                kv["table"] = cache.block_tables
+            return kv
 
         if self.scan_layers:
             def body(hh, xs):
-                lp, kl, vl = xs
-                out, kv = blk.apply_cached(lp, hh, {"k": kl, "v": vl},
-                                           lengths=lengths)
-                return out, (kv["k"], kv["v"])
+                out, kv = blk.apply_cached(
+                    xs["lp"], hh,
+                    layer_kv(xs["k"], xs["v"], xs.get("ks"), xs.get("vs")),
+                    lengths=lengths)
+                ys = {"k": kv["k"], "v": kv["v"]}
+                if quant:
+                    ys["ks"], ys["vs"] = kv["k_scale"], kv["v_scale"]
+                return out, ys
 
-            h, (nk, nv) = lax.scan(body, h, (params["blocks"], cache.k,
-                                             cache.v))
+            xs = {"lp": params["blocks"], "k": cache.k, "v": cache.v}
+            if quant:
+                xs["ks"], xs["vs"] = cache.k_scale, cache.v_scale
+            h, ys = lax.scan(body, h, xs)
+            nk, nv = ys["k"], ys["v"]
+            nks, nvs = ys.get("ks"), ys.get("vs")
         else:
-            ks, vs = [], []
+            ks, vs, kss, vss = [], [], [], []
             for i in range(self.n_layer):
-                h, kv = blk.apply_cached(params["blocks"][str(i)], h,
-                                         {"k": cache.k[i], "v": cache.v[i]},
-                                         lengths=lengths)
+                h, kv = blk.apply_cached(
+                    params["blocks"][str(i)], h,
+                    layer_kv(cache.k[i], cache.v[i],
+                             cache.k_scale[i] if quant else None,
+                             cache.v_scale[i] if quant else None),
+                    lengths=lengths)
                 ks.append(kv["k"])
                 vs.append(kv["v"])
+                if quant:
+                    kss.append(kv["k_scale"])
+                    vss.append(kv["v_scale"])
             nk, nv = jnp.stack(ks), jnp.stack(vs)
+            nks = jnp.stack(kss) if quant else None
+            nvs = jnp.stack(vss) if quant else None
 
         h, _ = self.ln_f.apply(params["ln_f"], {}, h)
         head = params["embed"]["weight"].T if self.tie_embeddings \
             else params["head"]
         logits = h @ head
-        new_cache = cache._replace(k=nk, v=nv, lengths=lengths + s)
+        new_cache = cache._replace(k=nk, v=nv, lengths=lengths + s,
+                                   k_scale=nks, v_scale=nvs)
         return jax.nn.log_softmax(logits, axis=-1), new_cache
 
     def output_shape(self, input_shape):

@@ -39,6 +39,8 @@ from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.nn.module import Container, Module, child_rng
 from bigdl_tpu.nn.norm import LayerNormalization
 from bigdl_tpu.ops.attention import dense_attention, ring_attention, ulysses_attention
+from bigdl_tpu.ops.decode_attention import (decode_attention_pallas,
+                                            decode_attention_ref, decode_impl)
 from bigdl_tpu.ops.flash_attention import flash_attention
 
 
@@ -79,6 +81,16 @@ def causal_mask(q_len: int, kv_len: int, *,
     """
     qpos = q_offset + jnp.arange(q_len)
     return qpos[:, None] >= jnp.arange(kv_len)[None, :]
+
+
+def quantize_kv(t: jax.Array) -> "tuple[jax.Array, jax.Array]":
+    """Symmetric per-token per-head int8 quantization of a K or V tensor
+    (..., head_dim) -> (int8 values, fp32 scales over the leading dims).
+    Scales are absmax/127 floored at 1e-8 so all-zero rows stay exactly
+    zero after dequant (the trash-block / unwritten-tail invariant)."""
+    scale = jnp.maximum(jnp.max(jnp.abs(t), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(t / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
 
 
 def _active_mesh(explicit: Optional[Mesh]) -> Optional[Mesh]:
@@ -178,16 +190,33 @@ class MultiHeadAttention(Module):
     def apply_cached(self, params, x, kv, *, lengths):
         """Cache-aware inference forward (the generation hot path).
 
-        `x` is (B, S, D) NEW tokens only; `kv` is a {"k", "v"} dict of
-        (B, C, H, Dh) ring buffers; `lengths` (B,) int32 counts tokens
-        already written per row, so row b's new tokens sit at absolute
-        positions lengths[b]..lengths[b]+S-1 and land at ring indices
-        `position % C`.  Returns (out, new_kv).  Two shapes matter:
-        prefill (B=1, S<=C, lengths=0) and decode (S=1, per-row lengths,
-        ring wrap-around = sliding-window attention).  Multi-token append
-        AFTER a wrap is not supported — the mask below indexes keys by
-        ring slot, which equals position only while writes are monotone
-        within the window (bigdl_tpu/generation/engine.py keeps to that).
+        `x` is (B, S, D) NEW tokens only; `lengths` (B,) int32 counts
+        tokens already written per row, so row b's new tokens sit at
+        absolute positions lengths[b]..lengths[b]+S-1 and land at ring
+        indices `position % C`.  `kv` is a dict describing ONE layer's
+        cache in one of two layouts:
+
+          * ring (kvcache.py): {"k","v"} of (B, C, H, Dh);
+          * paged (pagedkv.py): {"k","v"} are the POOL (n_blocks,
+            block_size, H, Dh) shared across slots, plus "table"
+            (B, max_blocks) int32 block ids (0 = trash block); the
+            logical ring index maps through the table.
+
+        Either layout optionally carries {"k_scale","v_scale"} (int8 KV):
+        K/V are quantized per token per head at write and dequantized at
+        read.  Returns (out, new_kv) with new_kv in the same layout.
+
+        Two shapes matter: prefill (B=1, S<=C, lengths=0) and decode
+        (S=1, per-row lengths, ring wrap-around = sliding-window
+        attention).  S=1 dispatches to the decode-specialized lane when
+        measured to win (ops/decode_attention.py `decode_impl`); the
+        paged read otherwise gathers pool blocks back into ring layout
+        and runs the IDENTICAL dense path, which is what keeps paged-on
+        vs paged-off bitwise-equal at fp32 (masked trash/stale columns
+        get exactly-zero softmax weight).  Multi-token append AFTER a
+        wrap is not supported — the mask indexes keys by ring slot,
+        which equals position only while writes are monotone within the
+        window (bigdl_tpu/generation/engine.py keeps to that).
         """
         b, s, d = x.shape
         h, hd = self.n_head, self.head_dim
@@ -206,19 +235,70 @@ class MultiHeadAttention(Module):
             # relative-position product regardless of cache state
             q = apply_rope(q, positions=positions)
             k = apply_rope(k, positions=positions)
-        cap = kv["k"].shape[1]
-        idx = positions % cap
-        bi = jnp.arange(b)[:, None]
-        new_k = kv["k"].at[bi, idx].set(k.astype(kv["k"].dtype))
-        new_v = kv["v"].at[bi, idx].set(v.astype(kv["v"].dtype))
-        # per-row causal mask over the full ring: (B, S, C) -> (B,1,S,C)
-        mask = jax.vmap(lambda off: causal_mask(s, cap, q_offset=off))(lengths)
-        ctx = dense_attention(q, new_k.astype(q.dtype), new_v.astype(q.dtype),
-                              mask=mask[:, None])
+        paged = "table" in kv
+        quant = kv.get("k_scale") is not None
+        if paged:
+            table = kv["table"]
+            blk = kv["k"].shape[1]
+            cap = table.shape[1] * blk
+            idx = positions % cap
+            # the write index IS the table lookup: unclaimed entries are 0,
+            # so pad/inactive writes scatter harmlessly into the trash block
+            wix = (jnp.take_along_axis(table, idx // blk, axis=1), idx % blk)
+        else:
+            cap = kv["k"].shape[1]
+            idx = positions % cap
+            wix = (jnp.arange(b)[:, None], idx)
+        if quant:
+            k_q, k_sc = quantize_kv(k)
+            v_q, v_sc = quantize_kv(v)
+            new_kv = {"k": kv["k"].at[wix].set(k_q),
+                      "v": kv["v"].at[wix].set(v_q),
+                      "k_scale": kv["k_scale"].at[wix].set(k_sc),
+                      "v_scale": kv["v_scale"].at[wix].set(v_sc)}
+        else:
+            new_kv = {"k": kv["k"].at[wix].set(k.astype(kv["k"].dtype)),
+                      "v": kv["v"].at[wix].set(v.astype(kv["v"].dtype))}
+        if paged:
+            new_kv["table"] = table
+
+        impl = decode_impl(cap) if s == 1 else "dense"
+        if impl == "pallas" and paged:
+            # fused gather: the kernel DMAs pool blocks straight off the
+            # scalar-prefetched table — no materialized (B, C, H, Dh)
+            ctx = decode_attention_pallas(
+                q[:, 0], new_kv["k"], new_kv["v"], table, lengths,
+                k_scale=new_kv.get("k_scale"),
+                v_scale=new_kv.get("v_scale"))[:, None]
+        else:
+            if paged:
+                keys = new_kv["k"][table].reshape(b, cap, h, hd)
+                vals = new_kv["v"][table].reshape(b, cap, h, hd)
+                if quant:
+                    k_sc = new_kv["k_scale"][table].reshape(b, cap, h)
+                    v_sc = new_kv["v_scale"][table].reshape(b, cap, h)
+            else:
+                keys, vals = new_kv["k"], new_kv["v"]
+                if quant:
+                    k_sc, v_sc = new_kv["k_scale"], new_kv["v_scale"]
+            if quant:
+                keys = keys.astype(q.dtype) * k_sc[..., None]
+                vals = vals.astype(q.dtype) * v_sc[..., None]
+            else:
+                keys = keys.astype(q.dtype)
+                vals = vals.astype(q.dtype)
+            if impl in ("ref", "pallas"):
+                ctx = decode_attention_ref(q[:, 0], keys, vals,
+                                           lengths=lengths)[:, None]
+            else:
+                # per-row causal mask over the full ring: (B,S,C)->(B,1,S,C)
+                mask = jax.vmap(
+                    lambda off: causal_mask(s, cap, q_offset=off))(lengths)
+                ctx = dense_attention(q, keys, vals, mask=mask[:, None])
         out = ctx.reshape(b, s, d) @ params["wo"]
         if self.with_bias:
             out = out + params["bo"]
-        return out, {"k": new_k, "v": new_v}
+        return out, new_kv
 
 
 class TransformerBlock(Container):

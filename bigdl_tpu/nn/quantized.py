@@ -528,7 +528,11 @@ class WeightOnlyInt8(Module):
     # int8 weight-only IS the decode-class quantization (bandwidth-bound,
     # halved weight traffic), so the wrapper forwards the cache-aware
     # protocol and quantize(mode='auto') models drop into GenerationEngine
-    # unchanged.
+    # unchanged.  The SAME delegation seam carries int8 KV-cache
+    # quantization: `dtype=jnp.int8` (or BIGDL_TPU_KV_DTYPE=int8 through
+    # GenerationConfig) flows to the inner model's init_cache, which
+    # allocates the quantized ring/pool with fp32 scale planes — weights
+    # and KV quantize independently and compose.
 
     def init_cache(self, slots: int, capacity: int, dtype=None):
         return self.inner.init_cache(

@@ -8,4 +8,9 @@ from bigdl_tpu.ops.attention import (
     ring_attention,
     ulysses_attention,
 )
+from bigdl_tpu.ops.decode_attention import (
+    decode_attention_pallas,
+    decode_attention_ref,
+    decode_impl,
+)
 from bigdl_tpu.ops.flash_attention import flash_attention

@@ -1,0 +1,231 @@
+"""Decode-specialized attention: length-1 query against a (paged) KV cache.
+
+The generation hot loop (bigdl_tpu/generation/engine.py) spends its life
+in exactly one attention shape: ONE new query token per slot against the
+slot's cached prefix.  The generic cached path (nn/attention.py) serves
+that shape with full machinery — a vmapped materialized `(B, 1, C)` mask
+and `dense_attention` logits carrying a dead q-length axis.  This module
+is the raw-speed lane for that shape (ROADMAP item 4), in two tiers:
+
+  * `decode_attention_ref` — the specialized XLA lowering: no q-length
+    axis anywhere, the position mask computed directly from `lengths`
+    (one `(B, C)` compare instead of a vmapped `causal_mask` build).
+    This is the BASELINE every kernel must beat, and the shipped default
+    where measured to win (see `decode_impl`).
+  * `decode_attention_pallas` — a Pallas TPU kernel: fused
+    gather-via-block-table (scalar-prefetched table indexes the pool
+    block DMA directly — no materialized `(B, C, H, D)` gather), ring
+    mask, online softmax and V-accumulate in VMEM scratch; never
+    materializes `(1, capacity)` scores in HBM.  Int8 KV dequant happens
+    on the block inside the kernel.
+
+Shipping discipline (the round-5 rule, BENCH_APPENDIX "Decode attention
+kernel"): a tier is enabled by default ONLY for backends/bucket sizes
+where the interleaved A/B (benchmarks/bench_generation.py
+--decode-quick, committed in benchmarks/results/decode_quick.json) shows
+it beating the incumbent.  `BIGDL_TPU_DECODE_KERNEL` overrides:
+`dense` (generic path) | `ref` | `pallas` | `auto` (default, measured
+table).  Losing configurations stay OFF and documented.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+NEG_INF = -1e30
+
+# Measured defaults per backend (decode_quick.json is the evidence; see
+# module docstring).  Values: "ref" | "pallas" | "dense".  A backend or
+# bucket size missing here falls back to "dense" — the generic path —
+# because an unmeasured fast path is a rumor, not a default.
+#   * cpu: the interleaved A/B (decode_quick.json, 2026-08) split by
+#     capacity — the generic path won at 32/128 (13.8 vs 19.7 us, 35.2
+#     vs 58.1 us) and the specialized lowering won from 512 up (1.07x /
+#     1.04x / 1.03x at 512/1024/4096).  Only the measured winners ship;
+#     unmeasured capacities take the "*" dense fallback rather than
+#     interpolating the crossover.
+#   * tpu: NO valid on-TPU measurement exists yet for either tier (the
+#     container is CPU-only); both stay off by default until a real A/B
+#     lands, exactly like the round-5 flash retirement.  Force with
+#     BIGDL_TPU_DECODE_KERNEL=ref|pallas to measure.
+_MEASURED_DEFAULTS = {
+    "cpu": {32: "dense", 128: "dense", 512: "ref", 1024: "ref",
+            4096: "ref", "*": "dense"},
+    "tpu": {},
+}
+
+
+def decode_impl(capacity: int, platform: Optional[str] = None) -> str:
+    """Resolve which decode-attention tier serves a bucket of `capacity`:
+    env override first, else the measured default table, else "dense"."""
+    env = os.environ.get("BIGDL_TPU_DECODE_KERNEL", "auto").strip().lower()
+    if env in ("0", "off", "false", "dense"):
+        return "dense"
+    if env in ("ref", "xla"):
+        return "ref"
+    if env == "pallas":
+        return "pallas"
+    platform = platform or jax.default_backend()
+    table = _MEASURED_DEFAULTS.get(platform, {})
+    return table.get(capacity, table.get("*", "dense"))
+
+
+# -- XLA-lowering reference (the baseline to beat) -------------------------
+
+
+def decode_attention_ref(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                         lengths: jax.Array,
+                         sm_scale: Optional[float] = None) -> jax.Array:
+    """Length-1-query attention over a ring cache, specialized lowering.
+
+    q: (B, H, D) — the single new token per slot, already rope'd.
+    k/v: (B, C, H, D) — the resident ring (dequantized if int8).
+    lengths: (B,) int32 — the query's absolute position per slot; ring
+    column j is attendable iff j <= lengths[b] (same semantics as
+    `causal_mask(1, C, q_offset=lengths)` in the generic path).
+    Returns (B, H, D).
+    """
+    d = q.shape[-1]
+    qs = q * (sm_scale if sm_scale is not None else d ** -0.5)
+    logits = jnp.einsum("bhd,bkhd->bhk", qs, k)
+    mask = lengths[:, None] >= jnp.arange(k.shape[1])[None, :]  # (B, C)
+    logits = jnp.where(mask[:, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhk,bkhd->bhd", probs, v)
+
+
+# -- pallas kernel: fused gather + mask + online softmax + V-accumulate ----
+
+
+def _decode_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+                   sm_scale: float, block_size: int, quant: bool):
+    if quant:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32)  # (H, D)
+    k = k_ref[0]                      # (BLK, H, D) — the table-gathered block
+    v = v_ref[0]
+    if quant:
+        k = k.astype(jnp.float32) * ks_ref[0][..., None]  # (BLK, H) scales
+        v = v.astype(jnp.float32) * vs_ref[0][..., None]
+    # (H, BLK): contract D, batch over H — one small MXU matmul per head
+    s = lax.dot_general(
+        q * sm_scale, k, (((1,), (2,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32)
+    # ring column j*BLK + r is attendable iff <= lengths[b] (the query's
+    # absolute position); also excludes the unwritten tail AND trash-block
+    # columns of unclaimed table entries
+    cols = j * block_size + lax.broadcasted_iota(
+        jnp.int32, (1, block_size), 1)
+    s = jnp.where(cols <= len_ref[b], s, NEG_INF)
+
+    m_prev = m_ref[:]
+    m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+    m_safe = jnp.where(m_new <= NEG_INF, 0.0, m_new)
+    p = jnp.exp(s - m_safe)
+    correction = jnp.exp(jnp.where(m_prev <= NEG_INF, NEG_INF,
+                                   m_prev - m_safe))
+    l_ref[:] = l_ref[:] * correction + p.sum(axis=1, keepdims=True)
+    # (H, D) += (H, BLK) @ (BLK, H, D) batched over H
+    pv = lax.dot_general(p, v.astype(jnp.float32),
+                         (((1,), (0,)), ((), ())))  # (H, H, D)? no — see below
+    # dot_general without batch dims over (H,BLK)x(BLK,H,D) contracts to
+    # (H, H, D); we need the DIAGONAL over the two H axes, so instead use
+    # a batched contraction: batch H, contract BLK
+    del pv
+    pv = lax.dot_general(p, v.astype(jnp.float32),
+                         (((1,), (0,)), ((0,), (1,))))
+    acc_ref[:] = acc_ref[:] * correction + pv
+    m_ref[:] = m_new
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def decode_attention_pallas(q: jax.Array, pool_k: jax.Array,
+                            pool_v: jax.Array, table: jax.Array,
+                            lengths: jax.Array, *,
+                            k_scale: Optional[jax.Array] = None,
+                            v_scale: Optional[jax.Array] = None,
+                            sm_scale: Optional[float] = None,
+                            interpret: bool = False) -> jax.Array:
+    """Paged decode attention: the block table drives the K/V block DMA.
+
+    q: (B, H, D); pool_k/pool_v: (n_blocks, BLK, H, D) — ONE layer of the
+    shared pool; table: (B, max_blocks) int32 pool block ids (0 = trash
+    block, whose columns the ring mask excludes); lengths: (B,) int32.
+    Optional k_scale/v_scale: (n_blocks, BLK, H) fp32 for int8 pools.
+    Returns (B, H, D) in q's dtype.
+
+    The scalar-prefetched `table`/`lengths` are available before the
+    kernel body runs, so the per-(slot, block) grid step DMAs exactly the
+    pool block the table names — the gather IS the index map
+    (PrefetchScalarGridSpec, per /opt/skills/guides/pallas_guide.md).
+    """
+    if not _HAS_PLTPU:
+        raise NotImplementedError("pallas TPU backend unavailable")
+    b, h, d = q.shape
+    nb = table.shape[1]
+    blk = pool_k.shape[1]
+    quant = k_scale is not None
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    kernel = functools.partial(_decode_kernel, sm_scale=scale,
+                               block_size=blk, quant=quant)
+    in_specs = [
+        pl.BlockSpec((1, h, d), lambda i, j, tr, lr: (i, 0, 0)),
+        pl.BlockSpec((1, blk, h, d), lambda i, j, tr, lr: (tr[i, j], 0, 0, 0)),
+        pl.BlockSpec((1, blk, h, d), lambda i, j, tr, lr: (tr[i, j], 0, 0, 0)),
+    ]
+    args = [q, pool_k, pool_v]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, blk, h), lambda i, j, tr, lr: (tr[i, j], 0, 0)),
+            pl.BlockSpec((1, blk, h), lambda i, j, tr, lr: (tr[i, j], 0, 0)),
+        ]
+        args += [k_scale, v_scale]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nb),  # block axis innermost => sequential on TPU
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, h, d), lambda i, j, tr, lr: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(table.astype(jnp.int32), lengths.astype(jnp.int32), *args)

@@ -1,0 +1,437 @@
+"""Paged KV allocator + decode kernel + int8 KV (ISSUE 12 acceptance).
+
+The parity bars, verified here:
+
+  * paged-on vs paged-off at fp32 is BITWISE equal — masked trash/stale
+    columns get exactly-zero softmax weight (NEG_INF -> exp underflows to
+    0.0), so the gathered pool read is indistinguishable from the ring;
+  * int8 KV decode vs the full fp32 forward holds `INT8_TOL` (see below);
+  * the decode-specialized lowering and the Pallas kernel (interpret
+    mode) match the dense path / each other at fp32 epsilon;
+  * a wrapped ring slot attends over EXACTLY the last `capacity` tokens
+    (sliding window) — shown at the MultiHeadAttention level, where a
+    fresh same-capacity cache fed only the window reproduces the wrapped
+    cache's output bitwise;
+  * block claim/release is leak-free: the free list and reservation
+    count return to their initial state after EOS, drain, and abort.
+"""
+
+import logging
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import obs
+from bigdl_tpu.generation import (
+    BlockPool,
+    GenerationConfig,
+    GenerationEngine,
+    PagedKVCache,
+    blocks_for,
+)
+from bigdl_tpu.models.transformer import TransformerLM
+from bigdl_tpu.nn.attention import MultiHeadAttention
+from bigdl_tpu.ops.decode_attention import (
+    decode_attention_pallas,
+    decode_attention_ref,
+    decode_impl,
+)
+
+# int8 KV vs full fp32 forward, in log-prob space on the quick-tier LM
+# (vocab 61 / hidden 32): measured max |dlogp| ~2e-3; the bar carries
+# ~10x margin and is the documented tolerance (docs/serving.md).
+INT8_TOL = dict(rtol=0.0, atol=3e-2)
+
+
+def _lm(**kw):
+    kw.setdefault("vocab_size", 61)
+    kw.setdefault("hidden_size", 32)
+    kw.setdefault("n_layer", 2)
+    kw.setdefault("n_head", 4)
+    kw.setdefault("max_len", 128)
+    kw.setdefault("use_flash", False)
+    model = TransformerLM(**kw)
+    params, _ = model.init((1, 16), rng=jax.random.PRNGKey(0))
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _lm()
+
+
+# -- BlockPool allocator ---------------------------------------------------
+
+
+def test_blocks_for():
+    assert blocks_for(1, 16) == 1
+    assert blocks_for(16, 16) == 1
+    assert blocks_for(17, 16) == 2
+    assert blocks_for(0, 16) == 0
+
+
+def test_block_pool_claim_release_reserve():
+    pool = BlockPool(n_layer=1, n_blocks=5, block_size=4, n_head=2,
+                     head_dim=4)
+    assert pool.n_allocatable == 4  # block 0 is the trash block
+    assert pool.blocks_free == 4
+    ids = pool.claim(3)
+    assert len(ids) == 3 and 0 not in ids
+    assert pool.blocks_free == 1
+    with pytest.raises(RuntimeError, match="exhausted"):
+        pool.claim(2)
+    pool.release(ids)
+    assert pool.blocks_free == 4
+    # reservations are a logical budget independent of claims
+    assert pool.reserve(3) and pool.reserve(1)
+    assert not pool.reserve(1)
+    pool.unreserve(4)
+    assert pool.blocks_reserved == 0
+    # every handed-out id is distinct and never the trash block
+    all_ids = pool.claim(4)
+    assert sorted(all_ids) == [1, 2, 3, 4]
+
+
+def test_block_pool_rejects_tiny_and_tracks_bytes():
+    with pytest.raises(ValueError, match="trash"):
+        BlockPool(1, 1, 4, 2, 4)
+    pool = BlockPool(n_layer=2, n_blocks=3, block_size=4, n_head=2,
+                     head_dim=8, dtype=jnp.float32)
+    # k + v pools: 2 * (2,3,4,2,8) fp32
+    assert pool.nbytes() == 2 * 2 * 3 * 4 * 2 * 8 * 4
+    assert pool.bytes_per_token() == 2 * 2 * 2 * 8 * 4
+    p8 = BlockPool(n_layer=2, n_blocks=3, block_size=4, n_head=2,
+                   head_dim=8, dtype=jnp.int8)
+    # int8 K/V + fp32 per-token per-head scales
+    assert p8.bytes_per_token() == 2 * 2 * 2 * 8 + 2 * 2 * 2 * 4
+    # the acceptance bar: >= 1.9x resident tokens per byte at head_dim 64
+    p64 = BlockPool(1, 2, 4, 1, 64, dtype=jnp.float32)
+    q64 = BlockPool(1, 2, 4, 1, 64, dtype=jnp.int8)
+    assert p64.bytes_per_token() / q64.bytes_per_token() >= 1.9
+
+
+def test_paged_cache_pytree_shapes():
+    pool = BlockPool(n_layer=2, n_blocks=9, block_size=4, n_head=2,
+                     head_dim=8)
+    cache = pool.lane_view(jnp.zeros((3, 4), jnp.int32),
+                           jnp.zeros((3,), jnp.int32))
+    assert isinstance(cache, PagedKVCache)
+    assert cache.n_layer == 2 and cache.n_blocks == 9
+    assert cache.block_size == 4 and cache.max_blocks == 4
+    assert cache.slots == 3 and cache.capacity == 16
+    leaves = jax.tree_util.tree_leaves(cache)
+    assert len(leaves) == 4  # k, v, tables, lengths — a jit-able pytree
+    assert cache.nbytes() == pool.nbytes() + 3 * 4 * 4 + 3 * 4
+
+
+# -- decode-specialized attention lowering ---------------------------------
+
+
+def _rand_ring(seed, b=3, c=24, h=4, d=16):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(b, c, h, d)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(b, c, h, d)).astype(np.float32))
+    lengths = jnp.asarray(np.array([0, 11, 23], np.int32))
+    return q, k, v, lengths
+
+
+def test_decode_ref_matches_dense_path():
+    from bigdl_tpu.nn.attention import causal_mask
+    from bigdl_tpu.ops.attention import dense_attention
+
+    q, k, v, lengths = _rand_ring(0)
+    got = decode_attention_ref(q, k, v, lengths=lengths)
+    mask = jax.vmap(lambda off: causal_mask(1, k.shape[1],
+                                            q_offset=off))(lengths)
+    want = dense_attention(q[:, None], k, v, mask=mask[:, None])[:, 0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_decode_pallas_interpret_matches_ref():
+    rng = np.random.default_rng(1)
+    B, H, D, NB, BLK, NBB = 3, 4, 16, 12, 8, 4
+    q = jnp.asarray(rng.normal(size=(B, H, D)).astype(np.float32))
+    pk = jnp.asarray(rng.normal(size=(NB, BLK, H, D)).astype(np.float32))
+    pv = jnp.asarray(rng.normal(size=(NB, BLK, H, D)).astype(np.float32))
+    table = jnp.asarray(rng.integers(1, NB, size=(B, NBB)).astype(np.int32))
+    table = table.at[2, 2:].set(0)  # slot 2 claimed only 2 blocks
+    lengths = jnp.asarray(np.array([5, 31, 12], np.int32))
+    got = decode_attention_pallas(q, pk, pv, table, lengths, interpret=True)
+    keys = pk[table].reshape(B, NBB * BLK, H, D)
+    vals = pv[table].reshape(B, NBB * BLK, H, D)
+    want = decode_attention_ref(q, keys, vals, lengths=lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_decode_pallas_interpret_int8_dequant():
+    rng = np.random.default_rng(2)
+    B, H, D, NB, BLK, NBB = 2, 4, 16, 8, 8, 2
+    q = jnp.asarray(rng.normal(size=(B, H, D)).astype(np.float32))
+    pk = jnp.asarray(rng.integers(-127, 128, size=(NB, BLK, H, D))
+                     .astype(np.int8))
+    pv = jnp.asarray(rng.integers(-127, 128, size=(NB, BLK, H, D))
+                     .astype(np.int8))
+    ks = jnp.asarray(rng.uniform(1e-3, 2e-2, size=(NB, BLK, H))
+                     .astype(np.float32))
+    vs = jnp.asarray(rng.uniform(1e-3, 2e-2, size=(NB, BLK, H))
+                     .astype(np.float32))
+    table = jnp.asarray(rng.integers(1, NB, size=(B, NBB)).astype(np.int32))
+    lengths = jnp.asarray(np.array([3, 15], np.int32))
+    got = decode_attention_pallas(q, pk, pv, table, lengths,
+                                  k_scale=ks, v_scale=vs, interpret=True)
+    keys = (pk[table].astype(jnp.float32)
+            * ks[table][..., None]).reshape(B, NBB * BLK, H, D)
+    vals = (pv[table].astype(jnp.float32)
+            * vs[table][..., None]).reshape(B, NBB * BLK, H, D)
+    want = decode_attention_ref(q, keys, vals, lengths=lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_decode_impl_env_override(monkeypatch):
+    monkeypatch.setenv("BIGDL_TPU_DECODE_KERNEL", "off")
+    assert decode_impl(64) == "dense"
+    monkeypatch.setenv("BIGDL_TPU_DECODE_KERNEL", "ref")
+    assert decode_impl(64) == "ref"
+    monkeypatch.setenv("BIGDL_TPU_DECODE_KERNEL", "pallas")
+    assert decode_impl(64) == "pallas"
+    monkeypatch.delenv("BIGDL_TPU_DECODE_KERNEL")
+    # auto on an unmeasured backend falls back to the generic path
+    assert decode_impl(64, platform="tpu") == "dense"
+
+
+# -- ring wrap IS a sliding window (satellite) -----------------------------
+
+
+def test_ring_wrap_attends_exactly_last_capacity_tokens():
+    """At the attention layer: after the ring wraps, the decode output is
+    BITWISE what a fresh same-capacity cache produces when fed only the
+    last `capacity` tokens at their true absolute positions — old tokens
+    are fully evicted, not faintly attended."""
+    rng = np.random.default_rng(0)
+    D, H, CAP, T = 32, 4, 8, 14
+    mha = MultiHeadAttention(D, H, causal=True, rope=True, use_flash=False)
+    params, _, _ = mha.build(jax.random.PRNGKey(0), (1, 1, D))
+    xs = [jnp.asarray(rng.normal(size=(1, 1, D)).astype(np.float32))
+          for _ in range(T)]
+
+    def fresh():
+        return {"k": jnp.zeros((1, CAP, H, D // H), jnp.float32),
+                "v": jnp.zeros((1, CAP, H, D // H), jnp.float32)}
+
+    kv = fresh()
+    for t in range(T):  # full history through the wrapping ring
+        out_full, kv = mha.apply_cached(params, xs[t], kv,
+                                        lengths=jnp.asarray([t], jnp.int32))
+
+    kv_win = fresh()  # only the window, same absolute positions
+    for t in range(T - CAP + 1, T + 1):
+        out_win, kv_win = mha.apply_cached(
+            params, xs[t - 1], kv_win, lengths=jnp.asarray([t - 1], jnp.int32))
+
+    np.testing.assert_array_equal(np.asarray(out_full), np.asarray(out_win))
+
+
+# -- parity bars through the full model ------------------------------------
+
+
+def _greedy_paged_vs_ring(model, params, dtype, prompt, steps=6):
+    """Run prefill + greedy decode through a ring cache and through a
+    paged cache (same dtype) and return both log-prob trajectories."""
+    BUCKET, BLK = 32, 8
+    n = len(prompt)
+
+    def drive(cache):
+        toks = jnp.zeros((1, BUCKET), jnp.int32).at[0, :n].set(
+            jnp.asarray(prompt))
+        logp, cache = model.apply_cached(params, toks, cache)
+        cache = cache._replace(lengths=jnp.asarray([n], jnp.int32))
+        traj = [np.asarray(logp[0, n - 1])]
+        last = int(jnp.argmax(logp[0, n - 1]))
+        for _ in range(steps):
+            lp, cache = model.apply_cached(
+                params, jnp.asarray([[last]], jnp.int32), cache)
+            traj.append(np.asarray(lp[0, 0]))
+            last = int(jnp.argmax(lp[0, 0]))
+        return np.stack(traj)
+
+    ring = drive(model.init_cache(1, BUCKET, dtype))
+    pool = BlockPool(model.n_layer, BUCKET // BLK + 1, BLK, model.n_head,
+                     model.hidden_size // model.n_head, dtype)
+    table = np.zeros((1, BUCKET // BLK), np.int32)
+    table[0, :] = pool.claim(BUCKET // BLK)
+    paged = drive(pool.lane_view(jnp.asarray(table),
+                                 jnp.zeros((1,), jnp.int32)))
+    return ring, paged
+
+
+def test_paged_vs_ring_bitwise_fp32(lm):
+    model, params = lm
+    prompt = [7, 3, 19, 4, 33, 2, 40, 11, 5, 28, 9]
+    ring, paged = _greedy_paged_vs_ring(model, params, jnp.float32, prompt)
+    np.testing.assert_array_equal(ring, paged)
+
+
+def test_paged_vs_ring_bitwise_int8(lm):
+    model, params = lm
+    prompt = [7, 3, 19, 4, 33]
+    ring, paged = _greedy_paged_vs_ring(model, params, jnp.int8, prompt)
+    np.testing.assert_array_equal(ring, paged)
+
+
+def test_int8_kv_decode_vs_full_fp32_forward(lm):
+    """The documented int8-KV tolerance: greedy decode through a
+    quantized cache stays within INT8_TOL of the full-precision
+    full-context forward, token by token."""
+    model, params = lm
+    rng = np.random.RandomState(3)
+    T, n = 12, 5
+    tokens = rng.randint(0, 61, size=(1, T)).astype(np.int32)
+    full, _ = model.apply(params, {}, jnp.asarray(tokens), training=False)
+    full = np.asarray(full)
+
+    cache = model.init_cache(1, 16, jnp.int8)
+    assert cache.k.dtype == jnp.int8 and cache.k_scale is not None
+    logp, cache = model.apply_cached(params, jnp.asarray(tokens[:, :n]),
+                                     cache)
+    np.testing.assert_allclose(np.asarray(logp)[0], full[0, :n], **INT8_TOL)
+    for t in range(n, T):
+        step, cache = model.apply_cached(
+            params, jnp.asarray(tokens[:, t:t + 1]), cache)
+        np.testing.assert_allclose(np.asarray(step)[0, 0], full[0, t],
+                                   **INT8_TOL, err_msg=f"decode step t={t}")
+
+
+# -- engine integration ----------------------------------------------------
+
+
+def test_engine_paged_matches_ring_and_frees_blocks(lm):
+    """Mixed-length prompts through an OVERSUBSCRIBED pool (smaller than
+    worst case) produce the same greedy tokens as the ring engine, and
+    every block + reservation is returned when the traffic drains."""
+    model, params = lm
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(1, 61, size=s).tolist()
+               for s in (3, 9, 14, 30, 6, 21)]
+
+    def run(**kw):
+        eng = GenerationEngine(model, params, buckets=(16, 64), slots=2,
+                               max_new_tokens=6, **kw)
+        try:
+            futs = [eng.submit(p) for p in prompts]
+            return eng, [f.result(timeout=120).tokens.tolist() for f in futs]
+        finally:
+            eng.close()
+
+    _, ring = run()
+    # worst case would be (16/8)*2 + (64/8)*2 + 1 = 21 blocks; give 13 so
+    # admission has to backpressure on the pool and recycle blocks
+    eng, paged = run(paged=True, kv_block_size=8, kv_pool_blocks=13)
+    assert ring == paged
+    pool = eng._pool
+    assert pool.blocks_free == pool.n_allocatable, "leaked blocks"
+    assert pool.blocks_reserved == 0, "leaked reservations"
+    for lane in eng._lanes.values():
+        assert all(not c for c in lane.claimed)
+        assert (lane.table_np == 0).all()
+
+
+def test_engine_abort_releases_blocks(lm):
+    model, params = lm
+    eng = GenerationEngine(model, params, buckets=(16,), slots=1,
+                           max_new_tokens=200, paged=True, kv_block_size=8)
+    f = eng.submit([1, 2, 3])
+    deadline = time.time() + 30
+    while eng.metrics.snapshot()["prefills"] < 1:
+        assert time.time() < deadline
+        time.sleep(0.002)
+    assert eng._pool.blocks_free < eng._pool.n_allocatable
+    eng.close(drain=False)  # abort: _fail_inflight must release
+    with pytest.raises(Exception):
+        f.result(timeout=10)
+    assert eng._pool.blocks_free == eng._pool.n_allocatable
+    assert eng._pool.blocks_reserved == 0
+
+
+def test_engine_paged_int8_compile_budget(lm):
+    """The executable-set bar with paged + int8 BOTH on: <= buckets x 2,
+    zero steady-state recompile alarms across a concurrent burst."""
+    model, params = lm
+    obs.set_observability(compile_monitor=True)  # fresh monitor
+    mon = obs.compile_monitor()
+    cfg = GenerationConfig(buckets=(16, 64), slots=4, capacity=128,
+                           max_new_tokens=5, paged=True, kv_block_size=8,
+                           cache_dtype=jnp.int8)
+    eng = GenerationEngine(model, params, config=cfg)
+    try:
+        assert eng.compile_count() <= 2 * len(cfg.buckets)
+        rng = np.random.RandomState(0)
+        futs = [eng.submit(rng.randint(0, 61, size=rng.randint(1, 12)),
+                           max_new_tokens=int(rng.randint(1, 6)))
+                for _ in range(32)]
+        for f in futs:
+            f.result(timeout=240)
+        assert eng.compile_count() <= 2 * len(cfg.buckets)
+        assert mon.recompiles("generation/") == 0, mon.snapshot()
+    finally:
+        eng.close()
+
+
+def test_engine_kv_gauges_exported(lm):
+    model, params = lm
+    reg = obs.registry()
+    reg.reset("generation/kv_")
+    with GenerationEngine(model, params, buckets=(16,), slots=2,
+                          max_new_tokens=2) as eng:
+        ring_bytes = reg.get("generation/kv_hbm_bytes|lane=16")
+        assert ring_bytes == eng.kv_nbytes() > 0
+    reg.reset("generation/kv_")
+    with GenerationEngine(model, params, buckets=(16,), slots=2,
+                          max_new_tokens=2, paged=True,
+                          kv_block_size=8) as eng:
+        assert reg.get("generation/kv_hbm_bytes|lane=pool") == \
+            eng._pool.nbytes() > 0
+        free0 = reg.get("generation/kv_blocks_free")
+        assert free0 == eng._pool.n_allocatable
+        eng.generate([1, 2, 3])
+        eng.drain()
+        assert reg.get("generation/kv_blocks_free") == free0
+
+
+def test_wrapped_prefill_counter_and_warning(lm, caplog):
+    model, params = lm
+    reg = obs.registry()
+    reg.reset("generation/wrapped_prefills")
+    with GenerationEngine(model, params, buckets=(16,), slots=1,
+                          max_new_tokens=12) as eng:
+        eng._warned_wrap = False
+        with caplog.at_level(logging.WARNING, "bigdl_tpu.generation"):
+            eng.generate(list(range(1, 13)))  # 12 + 12 > 16 -> wrap lane
+            eng.generate(list(range(1, 13)))
+    assert reg.get("generation/wrapped_prefills") == 2
+    warns = [r for r in caplog.records
+             if "sliding window" in r.getMessage()]
+    assert len(warns) == 1  # warned once, counted every time
+
+
+def test_config_env_gating(monkeypatch, lm):
+    monkeypatch.setenv("BIGDL_TPU_PAGED_KV", "1")
+    monkeypatch.setenv("BIGDL_TPU_KV_DTYPE", "int8")
+    cfg = GenerationConfig(buckets=(16,))
+    assert cfg.paged and cfg.cache_dtype == jnp.int8
+    monkeypatch.setenv("BIGDL_TPU_KV_DTYPE", "nope")
+    with pytest.raises(ValueError, match="BIGDL_TPU_KV_DTYPE"):
+        GenerationConfig(buckets=(16,))
+    monkeypatch.delenv("BIGDL_TPU_PAGED_KV")
+    monkeypatch.delenv("BIGDL_TPU_KV_DTYPE")
+    assert not GenerationConfig(buckets=(16,)).paged
+    # explicit arg beats env; block-size divisibility is validated
+    with pytest.raises(ValueError, match="divisible"):
+        GenerationConfig(buckets=(20,), paged=True, kv_block_size=16)

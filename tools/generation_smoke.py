@@ -14,7 +14,12 @@ acceptance):
     new version;
   * observability: gen.prefill / gen.decode_step spans land in the trace
     ring carrying request cids, and the metrics snapshot exports ttft /
-    ms-per-token percentiles.
+    ms-per-token percentiles;
+  * paged + int8 KV lane (ISSUE 12): the same burst through a shared
+    block pool (oversubscribed below ring worst case) with int8 K/V
+    holds the SAME executable budget with zero steady alarms, a paged
+    fp32 engine reproduces the ring engine's greedy tokens exactly, and
+    the pool releases every block and reservation when traffic drains.
 
 Usage: python tools/generation_smoke.py
 """
@@ -128,9 +133,68 @@ def main() -> int:
               f"requests, {toks} tokens in {wall:.2f}s, "
               f"{n_exec}/{budget} executables, 0 steady recompiles, "
               f"ms/token p50={snap['ms_per_token']['p50']}")
-        return 0
     finally:
         eng.close()
+
+    # -- paged + int8 KV lane (ISSUE 12) ---------------------------------
+    # fresh CompileMonitor: the ring engine above marked generation/
+    # steady, so this engine's own warmup would read as false alarms
+    obs.set_observability(metrics=True, tracing=True, compile_monitor=True)
+    mon = obs.compile_monitor()
+    reg = obs.registry()
+    # pool oversubscribed below ring worst case — buckets 16/64 x 4
+    # slots at block 8 would need 2*4 + 8*4 + 1 = 41 blocks; give 24 so
+    # admission backpressure and block recycling are on the tested path
+    cfg8 = GenerationConfig(buckets=BUCKETS, slots=SLOTS,
+                            capacity=N_REQUESTS + 8, max_new_tokens=6,
+                            paged=True, kv_block_size=8, kv_pool_blocks=24,
+                            cache_dtype=jnp.int8)
+    eng8 = GenerationEngine(model, params, config=cfg8)
+    try:
+        rng = np.random.RandomState(0)
+        futs = [eng8.submit(rng.randint(0, 61, size=int(rng.randint(1, 14))),
+                            max_new_tokens=int(rng.randint(1, 7)))
+                for _ in range(N_REQUESTS)]
+        for f in futs:
+            f.result(timeout=240)
+        n_exec8 = eng8.compile_count()
+        assert n_exec8 <= budget, \
+            f"paged+int8 burst grew the executable set to {n_exec8} " \
+            f"(budget {budget})"
+        n_re8 = mon.recompiles("generation/")
+        assert n_re8 == 0, \
+            f"{n_re8} steady-state recompiles under generation/ with " \
+            f"paged+int8: {mon.snapshot()}"
+        pool = eng8._pool
+        assert pool.blocks_free == pool.n_allocatable, \
+            f"leaked blocks: {pool.blocks_free}/{pool.n_allocatable} free"
+        assert pool.blocks_reserved == 0, "leaked reservations"
+        assert reg.get("generation/kv_hbm_bytes|lane=pool") == \
+            eng8.kv_nbytes() > 0
+    finally:
+        eng8.close()
+
+    # paged fp32 must reproduce the ring engine's greedy tokens EXACTLY
+    # (bitwise cache parity); int8 above holds its own tolerance bar in
+    # tests/test_pagedkv.py, so here the fp32 lane carries the equality
+    obs.set_observability(metrics=True, tracing=True, compile_monitor=True)
+    cfgp = GenerationConfig(buckets=BUCKETS, slots=SLOTS, capacity=8,
+                            max_new_tokens=6, paged=True, kv_block_size=8)
+    with GenerationEngine(model, params, config=cfgp) as engp:
+        prompt = [7, 3, 19]
+        got = engp.generate(prompt, max_new_tokens=5).tokens
+        ctx = list(prompt)
+        for want_i in range(5):
+            logp, _ = model.apply(params, {}, jnp.asarray([ctx], jnp.int32),
+                                  training=False)
+            tok = int(jnp.argmax(logp[0, -1]))
+            assert int(got[want_i]) == tok, (got, ctx, tok)
+            ctx.append(tok)
+
+    print(f"OK: paged+int8 lane green — {N_REQUESTS} requests through a "
+          f"24-block pool, {n_exec8}/{budget} executables, 0 steady "
+          f"recompiles, pool leak-free, paged fp32 greedy == ring greedy")
+    return 0
 
 
 if __name__ == "__main__":
