@@ -58,8 +58,19 @@ Prints one json line per row.
 import argparse
 import json
 import os
+import sys
 import time
 from collections import deque
+
+# the --ckpt reshard A-B shards a training mesh over virtual devices;
+# the 8-device host platform must be forced BEFORE jax initializes
+# (same pattern as tools/obs_smoke.py).  Other modes leave the
+# environment untouched so their committed captures stay comparable.
+if "--ckpt" in sys.argv:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import numpy as np
 
@@ -322,6 +333,153 @@ def ckpt_ab(iters=ITERS):
         "stall_ratio_sync_over_async":
             round(sync_stall / max(async_stall, 1e-9), 1)}))
     return rows
+
+
+def _reshard_build(layout, root, iters, every, mesh_b=False):
+    """A tp-sharded MLP under dp(2)xtp(2) writing `layout` checkpoints —
+    or, with mesh_b, the RESTORE-side twin: dp(4)xtp(2) with a different
+    tp rule set, so loading a mesh-A save re-cuts every sharded leaf."""
+    from jax.sharding import PartitionSpec as P
+
+    from bigdl_tpu.core.engine import AXIS_DATA, AXIS_MODEL, Engine
+    from bigdl_tpu.parallel import ShardingRules
+
+    RandomGenerator.set_seed(7)
+    rs = np.random.RandomState(0)
+    feat, hidden, ncls = 256, 1024, 10
+    x = rs.randn(BATCH, feat).astype(np.float32)
+    y = (np.arange(BATCH) % ncls).astype(np.int32)
+    ds = _RepeatDataSet(MiniBatch(jnp.asarray(x), jnp.asarray(y)), iters)
+    model = nn.Sequential(nn.Linear(feat, hidden), nn.ReLU(),
+                          nn.Linear(hidden, ncls), nn.LogSoftMax())
+    if mesh_b:
+        mesh = Engine.build_mesh(**{AXIS_DATA: 4, AXIS_MODEL: 2})
+        rules = ShardingRules().add(r"^0/weight$", P(AXIS_MODEL, None))
+    else:
+        mesh = Engine.build_mesh(devices=jax.devices()[:4],
+                                 **{AXIS_DATA: 2, AXIS_MODEL: 2})
+        rules = (ShardingRules()
+                 .add(r"^0/weight$", P(None, AXIS_MODEL))
+                 .add(r"^0/bias$", P(AXIS_MODEL))
+                 .add(r"^2/weight$", P(AXIS_MODEL, None)))
+    o = optim_mod.DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                                  optim_method=SGD(learning_rate=0.05),
+                                  mesh=mesh, sharding_rules=rules,
+                                  end_trigger=Trigger.max_iteration(iters))
+    if root is not None:
+        o.set_checkpoint(root, Trigger.several_iteration(every),
+                         async_save=True, keep_last=2, layout=layout)
+    return o
+
+
+def _leaf_nbytes(leaf):
+    return int(leaf.nbytes) if hasattr(leaf, "nbytes") \
+        else int(np.asarray(leaf).nbytes)
+
+
+def measure_reshard(layout, iters=8, every=4, restore_rounds=3):
+    """One leg of the chunked-vs-monolithic A-B: train under dp(2)xtp(2)
+    with trigger-driven async saves in `layout`, then time restoring the
+    committed checkpoint onto a DIFFERENT topology (dp(4)xtp(2), changed
+    tp rules).  Returns (stall_s_per_save, n_saves, peak_host_bytes,
+    tree_bytes, max_chunk_bytes, restore_s)."""
+    import tempfile
+
+    from bigdl_tpu.resilience import committed_steps
+    from bigdl_tpu.utils.checkpoint import latest_checkpoint, load_checkpoint
+    from bigdl_tpu.utils.ckpt_chunked import plan_chunks
+
+    with tempfile.TemporaryDirectory() as tmp:
+        o = _reshard_build(layout, tmp, iters, every)
+        # keep a handle on the writer: optimize() closes and drops it in
+        # its finally block, but peak_host_bytes survives on the object
+        writer = o._ensure_ckpt_writer()
+        o.optimize()
+        stall = o.metrics.get("checkpoint stall")
+        n_saves = len(committed_steps(tmp))
+        peak = int(writer.peak_host_bytes)
+        trees = [t for t in (o.params, o.model_state, o.opt_state)
+                 if t is not None]
+        leaves = [l for t in trees for l in jax.tree_util.tree_leaves(t)]
+        total = sum(_leaf_nbytes(l) for l in leaves)
+        # the writer's contract: peak host memory == the largest single
+        # chunk (one shard of one leaf), never the gathered tree
+        max_chunk = 0
+        for leaf in leaves:
+            item = np.dtype(getattr(leaf, "dtype", None)
+                            or np.asarray(leaf).dtype).itemsize
+            for _start, cshape, _fetch in plan_chunks(leaf):
+                max_chunk = max(
+                    max_chunk, int(np.prod(cshape, dtype=np.int64)) * item)
+        ckpt = latest_checkpoint(tmp)
+        o_b = _reshard_build(layout, None, 1, 1, mesh_b=True)
+        o_b.optimize()  # builds + shards the restore-side templates
+        restore = float("inf")
+        for _ in range(restore_rounds):
+            t0 = time.perf_counter()
+            loaded = load_checkpoint(
+                ckpt, o_b.params,
+                o_b.model_state if o_b.model_state else None,
+                o_b.opt_state)
+            jax.block_until_ready(
+                [l for tree in loaded[:3] if tree is not None
+                 for l in jax.tree_util.tree_leaves(tree)])
+            restore = min(restore, time.perf_counter() - t0)
+    return stall, n_saves, peak, total, max_chunk, restore
+
+
+def reshard_ab(iters=8, out_path=None):
+    """Chunked-vs-monolithic checkpoint A-B (elastic-reshard acceptance):
+    same mesh, same saves — the layouts differ in save stall, writer peak
+    host bytes, and restore-onto-a-different-mesh wall time.  Asserts the
+    chunked writer's bounded-host contract: peak == largest chunk, never
+    the gathered tree."""
+    out_rows = []
+    legs = {}
+    for layout in ("monolithic", "chunked"):
+        stall, n, peak, total, max_chunk, restore = \
+            measure_reshard(layout, iters=max(iters, 8))
+        legs[layout] = (peak, total, max_chunk)
+        out_rows.append({
+            "path": "reshard_ab", "layout": layout, "n_saves": n,
+            "ckpt_stall_ms_per_save": round(stall * 1e3, 3),
+            "peak_host_bytes": peak, "tree_bytes": total,
+            "restore_onto_new_mesh_ms": round(restore * 1e3, 2)})
+        print(json.dumps(out_rows[-1]), flush=True)
+    c_peak, total, max_chunk = legs["chunked"]
+    m_peak = legs["monolithic"][0]
+    assert c_peak <= max_chunk, (
+        f"chunked writer peak {c_peak} B exceeds its largest chunk "
+        f"{max_chunk} B — a full gather leaked into the save path")
+    assert c_peak < m_peak, (
+        f"chunked peak {c_peak} B not below monolithic {m_peak} B")
+    out_rows.append({
+        "metric": "reshard_bounded_host_ok", "value": True,
+        "max_chunk_bytes": max_chunk,
+        "host_bytes_ratio_monolithic_over_chunked":
+            round(m_peak / max(c_peak, 1), 1)})
+    print(json.dumps(out_rows[-1]))
+    if out_path:
+        artifact = {
+            "bench": "PYTHONPATH=. JAX_PLATFORMS=cpu python "
+                     "benchmarks/bench_trainer_overhead.py --ckpt "
+                     f"--iters {iters}",
+            "date": time.strftime("%Y-%m-%d"),
+            "platform": f"cpu backend, {os.cpu_count()}-core host forced "
+                        "to 8 virtual devices. Both legs train the same "
+                        "tp-sharded MLP under dp(2)xtp(2) with async "
+                        "saves every 4 steps; restore is timed onto a "
+                        "dp(4)xtp(2) mesh with a DIFFERENT tp rule set "
+                        "(reshard-on-load), min over 3 rounds. "
+                        "Monolithic restore returns host trees (the v1 "
+                        "reader contract); chunked assembles each target "
+                        "shard on device from intersecting chunks.",
+            "rows": out_rows,
+        }
+        with open(out_path, "w") as fh:
+            json.dump(artifact, fh, indent=2)
+        print(f"wrote {out_path}")
+    return out_rows
 
 
 def measure_watchdog(enabled, iters=ITERS):
@@ -727,7 +885,9 @@ def main(argv=None):
     ap.add_argument("--feed-only", action="store_true",
                     help="run just the DeviceFeed A-B (quick capture mode)")
     ap.add_argument("--ckpt", action="store_true",
-                    help="run just the sync/async checkpoint A-B")
+                    help="run the sync/async checkpoint A-B plus the "
+                         "chunked-vs-monolithic reshard A-B (writes "
+                         "results/reshard_quick.json)")
     ap.add_argument("--lint-hotpath", action="store_true",
                     help="A-B the tpu_lint host-sync fixes (quick capture)")
     ap.add_argument("--watchdog", action="store_true",
@@ -752,7 +912,6 @@ def main(argv=None):
         restart_child(max(2, min(args.iters, 8)))
         return
     if args.restart:
-        import os
         out = args.out or os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "results",
             "aotcache_quick.json")
@@ -764,6 +923,10 @@ def main(argv=None):
         return
     if args.ckpt:
         ckpt_ab(args.iters)
+        out = args.out or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "results",
+            "reshard_quick.json")
+        reshard_ab(iters=max(2, min(args.iters, 12)), out_path=out)
         return
     if args.lint_hotpath:
         lint_hotpath_ab(args.iters)

@@ -109,6 +109,11 @@ class EngineConfig:
     # against meta.json's integrity block (on by default — integrity is
     # opt-out; pre-integrity checkpoints load unverified either way).
     ckpt_verify: bool = True
+    # Checkpoint writer layout: "chunked" (v2 — per-shard chunk files,
+    # mesh descriptor + per-chunk CRCs in meta.json, elastic restore onto
+    # a different topology, host memory bounded by one chunk) or
+    # "monolithic" (v1 — one .npz per tree).  The reader accepts both.
+    ckpt_layout: str = "chunked"
 
     def parse_mesh(self) -> Optional[dict]:
         if not self.mesh_spec:
@@ -147,6 +152,7 @@ class EngineConfig:
             reader_autoscale=_env_bool("READER_AUTOSCALE", True),
             watchdog=_env_bool("WATCHDOG", False),
             ckpt_verify=_env_bool("CKPT_VERIFY", True),
+            ckpt_layout=_env("CKPT_LAYOUT", "chunked"),
         )
         if _PREFIX + "COORDINATOR_ADDRESS" in os.environ:
             cfg.coordinator_address = os.environ[_PREFIX + "COORDINATOR_ADDRESS"]

@@ -83,14 +83,20 @@ logger = logging.getLogger("bigdl_tpu.optim")
 _fold_in = jax.jit(jax.random.fold_in)
 
 
-def _put_scalar(v, dtype=np.int32):
+def _put_scalar(v, dtype=np.int32, sharding=None):
     """Explicit h2d put for per-step driver scalars (step index, ring slot).
 
     The transfer itself is not new — jit argument canonicalization was
     already putting these Python ints every step.  Making it explicit
     keeps the strict transfer guard (analysis.runtime) quiet and pins
-    the dtype so the first call doesn't retrace on weak-typed ints."""
-    return jax.device_put(dtype(v))
+    the dtype so the first call doesn't retrace on weak-typed ints.
+    Under a mesh, pass the replicated sharding so the scalar lands on
+    every device up front — consumers like _ring_write take mesh-resident
+    operands, and an implicit single-device→mesh broadcast at dispatch
+    would trip strict_transfers."""
+    if sharding is None:
+        return jax.device_put(dtype(v))
+    return jax.device_put(dtype(v), sharding)
 
 
 @jax.jit
@@ -287,6 +293,7 @@ class Optimizer:
         self.ckpt_async: Optional[bool] = None  # None = Engine config
         self.ckpt_keep_last: Optional[int] = None
         self.ckpt_keep_every: Optional[int] = None
+        self.ckpt_layout: Optional[str] = None  # None = Engine config
         self._ckpt_writer: Optional[AsyncCheckpointer] = None
         # fault tolerance: bounded restarts with exponential backoff
         self.max_restarts: Optional[int] = None  # None = Engine config
@@ -350,7 +357,8 @@ class Optimizer:
     def set_checkpoint(self, path: str, trigger: Trigger, *,
                        async_save: Optional[bool] = None,
                        keep_last: Optional[int] = None,
-                       keep_every: Optional[int] = None) -> "Optimizer":
+                       keep_every: Optional[int] = None,
+                       layout: Optional[str] = None) -> "Optimizer":
         """Trigger-driven checkpoints under `path`.
 
         `async_save` (default `BIGDL_TPU_CKPT_ASYNC`, on): the step loop
@@ -358,12 +366,20 @@ class Optimizer:
         the bounded AsyncCheckpointer writer thread.  False restores the
         synchronous in-loop save; multi-process runs are always
         synchronous (the save is a collective).  `keep_last`/`keep_every`
-        set the retention policy (resilience.apply_retention)."""
+        set the retention policy (resilience.apply_retention).
+
+        `layout` (default `BIGDL_TPU_CKPT_LAYOUT`, "chunked"): the v2
+        sharded layout — per-shard chunk files with a mesh descriptor and
+        per-chunk CRCs, host memory bounded by one chunk, restorable onto
+        a DIFFERENT topology (a run killed on N chips resumes on M) —
+        or "monolithic" for the v1 per-tree .npz.  Restore accepts both,
+        so the knob only affects new saves."""
         self.ckpt_path = path
         self.ckpt_trigger = trigger
         self.ckpt_async = async_save
         self.ckpt_keep_last = keep_last
         self.ckpt_keep_every = keep_every
+        self.ckpt_layout = layout
         return self
 
     def set_fault_tolerance(self, max_restarts: Optional[int] = None,
@@ -960,6 +976,12 @@ class Optimizer:
                 stall_check=hang.check if hang is not None else None)
 
     def _restore(self, ckpt_dir: str) -> None:
+        # templates are the LIVE trees, already sharded over the current
+        # mesh — for a chunked (v2) checkpoint the loader assembles each
+        # target shard from exactly the intersecting chunks, so a run
+        # saved under mesh A resumes here under mesh B (different dp/tp
+        # split, fewer or more chips) without ever gathering the full
+        # tree on host
         self.params, self.model_state, self.opt_state, driver = load_checkpoint(
             ckpt_dir, self.params, self.model_state, self.opt_state)
         # commit the restored host trees to device NOW: the next dispatch
@@ -1141,6 +1163,14 @@ class Optimizer:
         obs_reg = _obs.registry()
         ring_cap = depth + 2  # burst span never exceeds depth+1 entries
         ring = jnp.zeros((ring_cap, 3 if wd is not None else 2), jnp.float32)
+        rep = self._replicated()  # None off-mesh; NamedSharding(mesh, P())
+        if rep is not None:
+            # commit the ring (and below, the slot scalars) onto the mesh
+            # at creation: _ring_write's other inputs (loss, lr) live on
+            # the mesh, so a default-device ring would need an implicit
+            # d2d broadcast at the first dispatch — exactly what
+            # strict_transfers disallows
+            ring = jax.device_put(ring, rep)
         # watchdog device scalars, re-put only on CHANGE (lr_backoff is a
         # once-per-escalation event; poison codes repeat from a tiny set)
         scale_cache = [None, None]       # [host float, device scalar]
@@ -1396,7 +1426,9 @@ class Optimizer:
                             state["neval"] += 1
                             state["epoch_batch"] += 1
                             slot = (state["neval"] - 1) % ring_cap
-                            ring = _ring_write_h(ring, _put_scalar(slot),
+                            ring = _ring_write_h(ring,
+                                                 _put_scalar(slot,
+                                                             sharding=rep),
                                                  loss, lr_used, health)
                         else:
                             step_args = (self.params, self.model_state,
@@ -1410,7 +1442,9 @@ class Optimizer:
                             state["neval"] += 1
                             state["epoch_batch"] += 1
                             slot = (state["neval"] - 1) % ring_cap
-                            ring = _ring_write(ring, _put_scalar(slot),
+                            ring = _ring_write(ring,
+                                               _put_scalar(slot,
+                                                           sharding=rep),
                                                loss, lr_used)
                     pending.append((state["epoch"] + 1, state["neval"], bs,
                                     slot, ring, item.stall_s, item.occupancy))
@@ -1636,10 +1670,13 @@ class Optimizer:
 
     def _ensure_ckpt_writer(self) -> AsyncCheckpointer:
         if self._ckpt_writer is None:
+            layout = self.ckpt_layout
+            if layout is None:
+                layout = Engine.config().ckpt_layout
             self._ckpt_writer = AsyncCheckpointer(
                 self.ckpt_path, keep_last=self.ckpt_keep_last,
                 keep_every=self.ckpt_keep_every, fault=self._ckpt_fault,
-                post_commit=self._ckpt_corrupt)
+                post_commit=self._ckpt_corrupt, layout=layout)
         return self._ckpt_writer
 
     def _driver_snapshot(self, state) -> Dict[str, Any]:

@@ -34,6 +34,7 @@ from bigdl_tpu.resilience.async_ckpt import (
     CheckpointWriteError,
     apply_retention,
     committed_steps,
+    default_layout,
 )
 from bigdl_tpu.resilience.chaos import (
     BitFlipCheckpointFault,
@@ -69,6 +70,7 @@ __all__ = [
     "clear_marker",
     "committed_steps",
     "compose",
+    "default_layout",
     "read_marker",
     "write_marker",
 ]

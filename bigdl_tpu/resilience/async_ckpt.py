@@ -47,7 +47,9 @@ import numpy as np
 
 from bigdl_tpu import obs as _obs
 from bigdl_tpu.health import integrity as _integrity
+from bigdl_tpu.utils import ckpt_chunked as _ck
 from bigdl_tpu.utils.checkpoint import (
+    CHUNKED_SCHEMA_VERSION,
     SCHEMA_VERSION,
     _exists,
     _flatten,
@@ -63,6 +65,18 @@ from bigdl_tpu.utils.checkpoint import (
 logger = logging.getLogger("bigdl_tpu.resilience")
 
 _STOP = object()
+_LAYOUTS = ("chunked", "monolithic")
+
+
+def default_layout() -> str:
+    """Writer layout: `chunked` (v2 — per-shard chunk files + mesh
+    manifest, elastic restore) unless `BIGDL_TPU_CKPT_LAYOUT=monolithic`
+    pins the v1 single-.npz-per-tree format."""
+    v = os.environ.get("BIGDL_TPU_CKPT_LAYOUT", "chunked").strip().lower()
+    if v not in _LAYOUTS:
+        raise ValueError(
+            f"BIGDL_TPU_CKPT_LAYOUT must be one of {_LAYOUTS}, got {v!r}")
+    return v
 
 
 class CheckpointWriteError(RuntimeError):
@@ -147,16 +161,31 @@ class AsyncCheckpointer:
     post_commit : chaos hook `f(ckpt_dir)` invoked AFTER the atomic rename
         commits a checkpoint — the BitFlipCheckpointFault attachment point
         (bit-rot happens to committed files, not in-flight writes)
+    layout : `"chunked"` (default, from `BIGDL_TPU_CKPT_LAYOUT`) writes
+        the v2 sharded layout — one chunk file per distinct shard of each
+        leaf, device->host transfer bounded by ONE chunk at a time, mesh
+        descriptor + per-chunk CRC manifest in meta.json, restorable onto
+        a different topology.  `"monolithic"` keeps the v1 per-tree .npz.
+        `peak_host_bytes` records the last save's high-water host buffer
+        (max chunk vs full gathered tree) for the bench to assert on.
     """
 
     def __init__(self, path: str, *, keep_last: Optional[int] = None,
                  keep_every: Optional[int] = None, queue_depth: int = 2,
                  fault: Optional[Callable[[str], bool]] = None,
                  post_commit: Optional[Callable[[str], None]] = None,
+                 layout: Optional[str] = None,
                  name: str = "AsyncCkptWriter"):
         if queue_depth < 1:
             raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+        if layout is None:
+            layout = default_layout()
+        if layout not in _LAYOUTS:
+            raise ValueError(
+                f"layout must be one of {_LAYOUTS}, got {layout!r}")
         self.path = str(path)
+        self.layout = layout
+        self.peak_host_bytes = 0
         self.keep_last = keep_last
         self.keep_every = keep_every
         self._fault = fault
@@ -348,11 +377,17 @@ class AsyncCheckpointer:
     # ------------------------------------------------------------------
 
     def _write(self, job: _Job) -> str:
+        if self.layout == "chunked":
+            return self._write_chunked(job)
         flats = {}
         for name, tree in zip(("params", "model_state", "opt_state"),
                               job.trees):
             if tree is not None:
                 flats[name + ".npz"] = _flatten(tree)  # device->host here
+        self.peak_host_bytes = sum(a.nbytes for f in flats.values()
+                                   for a in f.values())
+        _obs.registry().set_gauge("ckpt/peak_host_bytes",
+                                  float(self.peak_host_bytes))
         meta = {"schema_version": SCHEMA_VERSION, "step": job.step,
                 "driver_state": job.driver_state,
                 # per-leaf CRC32C computed HERE, in the writer thread —
@@ -364,6 +399,77 @@ class AsyncCheckpointer:
         if _is_remote(self.path):
             return self._write_remote(final, flats, meta)
         return self._write_local(final, flats, meta, job.step)
+
+    def _write_chunked(self, job: _Job) -> str:
+        """v2 save: same tmp -> fsync -> rename commit protocol, but the
+        payload is one chunk file per distinct shard of each leaf and the
+        device->host transfer happens inside `write_tree` one chunk at a
+        time — the full gathered tree NEVER exists on host."""
+        note = getattr(self._fault, "note_save", None)
+        if note is not None:
+            note()  # a chunked save is many file writes; count saves here
+        remote = _is_remote(self.path)
+        final = _join(self.path, f"ckpt_{job.step}")
+        if remote:
+            dest = final
+            _makedirs(dest)
+        else:
+            dest = os.path.join(self.path, f"tmp.{job.step}")
+            if os.path.isdir(dest):
+                shutil.rmtree(dest)
+            os.makedirs(dest)
+
+        def emit(relname: str, payload) -> None:
+            if remote:
+                if self._fault is not None and self._fault(relname):
+                    raise CheckpointWriteError(
+                        f"chaos: fault writing {relname}")
+                with _open(_join(dest, relname), "wb") as fh:
+                    fh.write(payload)
+            else:
+                p = os.path.join(dest, relname)
+                os.makedirs(os.path.dirname(p), exist_ok=True)
+                self._write_file(p, payload, relname)
+
+        peak = [0]
+        manifest = {}
+        for name, tree in zip(_ck.TREE_NAMES, job.trees):
+            if tree is not None:
+                manifest[name] = _ck.write_tree(
+                    name, tree, emit,
+                    note_host=lambda nb: peak.__setitem__(
+                        0, max(peak[0], nb)))
+        self.peak_host_bytes = peak[0]
+        _obs.registry().set_gauge("ckpt/peak_host_bytes", float(peak[0]))
+        meta = {"schema_version": CHUNKED_SCHEMA_VERSION, "step": job.step,
+                "driver_state": job.driver_state,
+                # the mesh the save ran under — restore onto a DIFFERENT
+                # topology reads this to know the source layout
+                "mesh": _ck.mesh_descriptor(job.trees),
+                # per-leaf chunk grid + per-chunk CRC32C (writer thread;
+                # the step loop never pays for the checksum pass)
+                "manifest": manifest}
+        payload = json.dumps(meta, indent=2).encode()
+        if remote:
+            # no atomic rename on object stores: meta.json is the
+            # last-write commit marker, same as the v1 remote path
+            with _open(_join(dest, "meta.json"), "wb") as fh:
+                fh.write(payload)
+        else:
+            # meta.json LAST, then atomic rename + parent fsync
+            self._write_file(os.path.join(dest, "meta.json"), payload,
+                             "meta.json")
+            if os.path.isdir(final):
+                shutil.rmtree(final)  # re-save of the same step
+            os.rename(dest, final)
+            dfd = os.open(self.path, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        if self._post_commit is not None:
+            self._post_commit(final)  # chaos: bit-rot a COMMITTED chunk
+        return final
 
     def _write_local(self, final: str, flats: Dict[str, Dict],
                      meta: Dict, step: int) -> str:

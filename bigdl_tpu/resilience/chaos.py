@@ -79,7 +79,15 @@ class StepFaultInjector:
 
 class CheckpointWriteFault:
     """`fault=` hook for AsyncCheckpointer: fail the write of `fail_file`
-    on the `fail_on_save`-th checkpoint attempt (1-based), mid-file."""
+    on the `fail_on_save`-th checkpoint attempt (1-based), mid-file.
+
+    Layout-aware: under the chunked (v2) layout a tree is many chunk
+    files, so a `fail_file` of `"<tree>.npz"` also matches that tree's
+    chunk files (`<tree>/...npy`) and fires on the FIRST one of the
+    armed save.  The chunked writer announces each save via `note_save()`
+    (one save = many writes — counting per-file would inflate
+    `saves_seen`); the monolithic writer never calls it and the original
+    one-match-per-save counting applies."""
 
     def __init__(self, fail_on_save: int = 1, fail_file: str = "params.npz",
                  n_failures: int = 1):
@@ -88,14 +96,25 @@ class CheckpointWriteFault:
         self.n_failures = int(n_failures)
         self.saves_seen = 0
         self.fired = 0
+        self._per_save = False
+
+    def note_save(self) -> None:
+        """Chunked-writer save announcement: counts the save attempt."""
+        self._per_save = True
+        self.saves_seen += 1
 
     def __call__(self, relname: str) -> bool:
-        if relname == self.fail_file:
-            self.saves_seen += 1
-            if self.saves_seen >= self.fail_on_save \
-                    and self.fired < self.n_failures:
-                self.fired += 1
-                return True
+        tree = self.fail_file[:-len(".npz")] \
+            if self.fail_file.endswith(".npz") else None
+        if relname != self.fail_file and not (
+                tree and relname.startswith(tree + "/")):
+            return False
+        if not self._per_save:
+            self.saves_seen += 1  # monolithic: one matching write per save
+        if self.saves_seen >= self.fail_on_save \
+                and self.fired < self.n_failures:
+            self.fired += 1
+            return True
         return False
 
 
@@ -176,17 +195,41 @@ class BitFlipCheckpointFault:
     """`post_commit=` hook for AsyncCheckpointer: xor seeded byte(s) of
     `file` inside the `fail_on_save`-th COMMITTED checkpoint dir — silent
     bit-rot the npz zip layer or the per-leaf CRC32C must catch on
-    restore.  Local paths only (the test fixture's scope)."""
+    restore.  Local paths only (the test fixture's scope).
+
+    Chunked (v2) layout: a `file` of `"<tree>.npz"` that does not exist
+    as a literal file resolves to ONE chunk file of that tree — index
+    `chunk` (default 0) into the sorted chunk list.  The corruption is a
+    single flipped chunk; the per-chunk CRC must name exactly it and the
+    restore fallback chain must walk back to the previous good save."""
 
     def __init__(self, fail_on_save: int = 1, file: str = "params.npz", *,
-                 seed: int = 0, n_bytes: int = 1, n_failures: int = 1):
+                 seed: int = 0, n_bytes: int = 1, n_failures: int = 1,
+                 chunk: int = 0):
         self.fail_on_save = int(fail_on_save)
         self.file = file
         self.seed = int(seed)
         self.n_bytes = max(1, int(n_bytes))
         self.n_failures = int(n_failures)
+        self.chunk = int(chunk)
         self.saves_seen = 0
         self.fired: list = []
+
+    def _resolve(self, ckpt_dir: str):
+        import os
+
+        path = os.path.join(ckpt_dir, self.file)
+        if os.path.isfile(path):
+            return path
+        tree = self.file[:-len(".npz")] \
+            if self.file.endswith(".npz") else self.file
+        tdir = os.path.join(ckpt_dir, tree)
+        if os.path.isdir(tdir):
+            chunks = sorted(f for f in os.listdir(tdir)
+                            if f.endswith(".npy"))
+            if chunks:
+                return os.path.join(tdir, chunks[self.chunk % len(chunks)])
+        return None
 
     def __call__(self, ckpt_dir: str) -> None:
         import os
@@ -195,8 +238,8 @@ class BitFlipCheckpointFault:
         if self.saves_seen < self.fail_on_save \
                 or len(self.fired) >= self.n_failures:
             return
-        path = os.path.join(ckpt_dir, self.file)
-        if not os.path.isfile(path):
+        path = self._resolve(ckpt_dir)
+        if path is None:
             return
         size = os.path.getsize(path)
         if size == 0:

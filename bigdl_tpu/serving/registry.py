@@ -94,7 +94,14 @@ class ModelRegistry:
     def register_checkpoint(self, version: str, ckpt_dir: str, *,
                             activate: bool = True) -> ModelVersion:
         """Load `ckpt_dir` (a trainer `ckpt_<step>` dir) templated on the
-        active version's trees and register it."""
+        active version's trees and register it.
+
+        Both layouts load: a chunked (v2) checkpoint saved under the
+        TRAINING mesh reshards on load — each leaf is assembled onto the
+        active version's own (inference) sharding from exactly the chunks
+        intersecting it, per-chunk CRC-verified, so a tp=4 training save
+        serves on a tp=2 inference mesh without a host-side gather.  The
+        warmup chain + compilecache reuse in `register` are unchanged."""
         from bigdl_tpu.utils.checkpoint import load_params
 
         current = self.active()
@@ -115,9 +122,10 @@ class ModelRegistry:
         promotion from a training run is one call per save point.
 
         Integrity: unless `BIGDL_TPU_CKPT_VERIFY` is off, the candidate's
-        per-leaf CRC32C checksums are verified before it can become a
-        serving version — root resolution walks PAST corrupt saves to the
-        newest intact one, and a directly-named corrupt dir raises
+        CRC32C checksums are verified before it can become a serving
+        version — per-leaf for monolithic (v1) saves, per-chunk for
+        sharded (v2) saves — and root resolution walks PAST corrupt saves
+        to the newest intact one; a directly-named corrupt dir raises
         `CorruptCheckpointError` instead of serving flipped bits."""
         import os
 

@@ -6,11 +6,20 @@ and utils/File.scala (local/HDFS/S3).  Resume restores mid-epoch because
 counters live in optimizer state (optim/DistriOptimizer.scala:127-137).
 
 Format: a directory per checkpoint containing a schema-versioned
-`meta.json` plus one `.npz` per pytree (params / model_state / opt_state).
-Pytrees are flattened to path-keyed arrays ("0/weight", "cell/w_ih"), so
-the format is stable across process restarts and inspectable with numpy —
-the same goals as the reference's protobuf ModuleSerializer (§2.6), without
-inventing a binary schema.
+`meta.json` plus the tree payloads in one of two layouts:
+
+  * **v1 (monolithic)** — one `.npz` per pytree (params / model_state /
+    opt_state), pytrees flattened to path-keyed arrays ("0/weight",
+    "cell/w_ih"); stable across process restarts and inspectable with
+    numpy — the same goals as the reference's protobuf ModuleSerializer
+    (§2.6), without inventing a binary schema.
+  * **v2 (chunked, the default writer layout)** — per-leaf chunk files
+    whose boundaries come from the live `NamedSharding`, plus a mesh
+    descriptor and per-chunk CRC manifest in meta.json, enabling elastic
+    restore onto a different topology.  See `utils/ckpt_chunked.py`.
+
+The reader here accepts BOTH (old monolithic checkpoints stay
+restorable) and refuses a directory that mixes the two layouts.
 
 Remote paths: any `scheme://...` path (gs://, s3://, hdfs://, memory://)
 routes through fsspec — the analogue of utils/File.scala's hdfs:/s3a:
@@ -34,8 +43,10 @@ from bigdl_tpu.health.integrity import CorruptCheckpointError
 
 logger = logging.getLogger("bigdl_tpu.checkpoint")
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 1           # monolithic per-tree .npz
+CHUNKED_SCHEMA_VERSION = 2   # per-leaf sharded chunks + mesh descriptor
 _SEP = "/"
+_TREE_NAMES = ("params", "model_state", "opt_state")
 
 
 def _is_remote(path: str) -> bool:
@@ -227,10 +238,36 @@ def save_checkpoint(path: str, step: int, params: Any, model_state: Any = None,
     return d
 
 
+def _refuse_mixed_layout(ckpt_dir: str, meta: Dict) -> None:
+    """A checkpoint dir must be ONE layout.  meta.json is the commit
+    marker, so a v1 meta sitting next to chunk dirs (or a v2 meta next to
+    monolithic `.npz` files) means two saves interleaved into one dir or a
+    botched migration — loading the half matching the meta would silently
+    resurrect stale tensors from the other.  Refuse loudly; the
+    `latest_checkpoint` fallback chain treats this like any other
+    corruption and walks back to an intact candidate."""
+    sv = meta.get("schema_version")
+    has_npz = any(_exists(_join(ckpt_dir, t + ".npz")) for t in _TREE_NAMES)
+    has_chunks = any(_isdir(_join(ckpt_dir, t)) for t in _TREE_NAMES)
+    if sv == SCHEMA_VERSION and (has_chunks or meta.get("manifest")):
+        raise CorruptCheckpointError(
+            f"checkpoint {ckpt_dir} declares monolithic schema "
+            f"v{SCHEMA_VERSION} but also contains chunked-layout data — "
+            f"mixed-layout dirs are refused; keep each save in one layout")
+    if sv == CHUNKED_SCHEMA_VERSION and has_npz:
+        raise CorruptCheckpointError(
+            f"checkpoint {ckpt_dir} declares chunked schema "
+            f"v{CHUNKED_SCHEMA_VERSION} but also contains monolithic .npz "
+            f"files — mixed-layout dirs are refused; keep each save in one "
+            f"layout")
+
+
 def load_checkpoint(ckpt_dir: str, params_template: Any,
                     model_state_template: Any = None,
                     opt_state_template: Any = None,
-                    verify: Optional[bool] = None) -> Tuple[Any, Any, Any, Dict]:
+                    verify: Optional[bool] = None,
+                    target_shardings: Optional[Dict[str, Dict]] = None
+                    ) -> Tuple[Any, Any, Any, Dict]:
     """Returns (params, model_state, opt_state, driver_state).
 
     Multi-process: collective — EVERY process must call.  Only process 0
@@ -238,27 +275,47 @@ def load_checkpoint(ckpt_dir: str, params_template: Any,
     are broadcast to all processes, so hosts without a shared filesystem
     resume identically.
 
-    `verify` gates per-leaf CRC32C checks against meta.json's `integrity`
-    block (None defers to `BIGDL_TPU_CKPT_VERIFY`, default ON).  A
-    mismatch — or any unreadable file — raises CorruptCheckpointError;
-    checkpoints from before the integrity schema load unverified."""
+    Accepts both layouts: v1 monolithic `.npz` and v2 chunked (elastic
+    reshard-on-load — a chunked checkpoint saved under mesh A restores
+    onto the templates' CURRENT shardings, so N chips -> M just works).
+    `target_shardings` optionally overrides placement per tree:
+    `{"params": {leaf_key: Sharding}}` wins over the template leaf's own
+    sharding (single-process only — the chunked writer's domain).
+
+    `verify` gates CRC32C checks — per-leaf against meta.json's
+    `integrity` block (v1) or per-chunk against the manifest (v2); None
+    defers to `BIGDL_TPU_CKPT_VERIFY`, default ON.  A mismatch — or any
+    unreadable file — raises CorruptCheckpointError; checkpoints from
+    before the integrity schema load unverified."""
     verify = _integrity.verify_enabled(verify)
     reader = jax.process_count() <= 1 or jax.process_index() == 0
     meta = {"schema_version": SCHEMA_VERSION, "driver_state": {}}
+    chunked = 0
     if reader:
         with _open(_join(ckpt_dir, "meta.json"), "r") as f:
             meta = json.load(f)
-        if meta.get("schema_version") != SCHEMA_VERSION:
+        if meta.get("schema_version") not in (SCHEMA_VERSION,
+                                              CHUNKED_SCHEMA_VERSION):
             raise ValueError(
                 f"unsupported checkpoint schema {meta.get('schema_version')}")
+        _refuse_mixed_layout(ckpt_dir, meta)
+        chunked = int(meta.get("schema_version") == CHUNKED_SCHEMA_VERSION)
+    chunked = agree_from_process_zero(chunked)
     expected_crcs = meta.get("integrity") if verify else None
+    manifest = meta.get("manifest") or {}
 
     # File presence is decided by the reader and agreed collectively, so
     # every process takes the same branch (loads+broadcast vs None).
-    names = ("params.npz", "model_state.npz", "opt_state.npz")
     present = [0, 0, 0]
     if reader:
-        present = [int(_exists(_join(ckpt_dir, n))) for n in names]
+        if chunked:
+            # key presence, not truthiness: an empty tree (e.g. a
+            # stateless model's `{}` model_state) is saved as an empty
+            # entry list and must round-trip as `{}`, not None
+            present = [int(manifest.get(t) is not None) for t in _TREE_NAMES]
+        else:
+            present = [int(_exists(_join(ckpt_dir, t + ".npz")))
+                       for t in _TREE_NAMES]
     present = [agree_from_process_zero(v) for v in present]
 
     def load_npz(name, template, is_present):
@@ -297,10 +354,38 @@ def load_checkpoint(ckpt_dir: str, params_template: Any,
         return jax.tree_util.tree_map(
             lambda l: np.zeros(np.shape(l), np.asarray(l).dtype), template)
 
-    params = load_npz("params.npz", params_template, present[0])
-    model_state = load_npz("model_state.npz", model_state_template, present[1])
-    opt_state = load_npz("opt_state.npz", opt_state_template, present[2])
-    if reader and expected_crcs is not None:
+    def load_chunked(tree_name, template, is_present):
+        if template is None:
+            return None
+        if not is_present:
+            if tree_name == "params":
+                raise FileNotFoundError(
+                    f"checkpoint {ckpt_dir} has no {tree_name} chunks")
+            return None
+        if reader:
+            from bigdl_tpu.utils import ckpt_chunked as _ck
+
+            # multi-process: assemble on host here, the broadcast tail
+            # below ships it; single-process: reshard straight onto the
+            # template's (current mesh's) shardings
+            return _ck.load_tree(
+                ckpt_dir, manifest[tree_name], template, verify,
+                to_device=jax.process_count() <= 1,
+                target_shardings=(target_shardings or {}).get(tree_name))
+        return jax.tree_util.tree_map(
+            lambda l: np.zeros(np.shape(l), np.asarray(l).dtype), template)
+
+    if chunked:
+        params = load_chunked("params", params_template, present[0])
+        model_state = load_chunked("model_state", model_state_template,
+                                   present[1])
+        opt_state = load_chunked("opt_state", opt_state_template, present[2])
+    else:
+        params = load_npz("params.npz", params_template, present[0])
+        model_state = load_npz("model_state.npz", model_state_template,
+                               present[1])
+        opt_state = load_npz("opt_state.npz", opt_state_template, present[2])
+    if reader and verify and (chunked or expected_crcs is not None):
         _integrity.count("verified")
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
@@ -339,12 +424,13 @@ def load_params(ckpt_dir: str, params_template: Any,
 
 
 def verify_checkpoint(ckpt_dir: str) -> Dict:
-    """Full integrity pass over one committed checkpoint dir: every file
-    named in meta.json's `integrity` block is read back and every leaf's
-    CRC32C compared.  Returns the parsed meta on success; raises
-    CorruptCheckpointError on any mismatch or unreadable file.  A
-    pre-integrity checkpoint (no block) passes vacuously — old runs stay
-    restorable.
+    """Full integrity pass over one committed checkpoint dir: every
+    payload is read back and its CRC32C compared — per-leaf against the
+    `integrity` block (v1 monolithic) or per-chunk against the manifest
+    (v2 chunked, which also checks each leaf's grid covers its shape).
+    Returns the parsed meta on success; raises CorruptCheckpointError on
+    any mismatch, unreadable file, or mixed-layout dir.  A pre-integrity
+    checkpoint (no block) passes vacuously — old runs stay restorable.
 
     Local-only (no collective): callers are process 0's restore/registry
     paths, which already own the filesystem decision."""
@@ -354,6 +440,12 @@ def verify_checkpoint(ckpt_dir: str) -> Dict:
     except Exception as e:
         raise CorruptCheckpointError(
             f"checkpoint {ckpt_dir} meta.json unreadable: {e}") from e
+    _refuse_mixed_layout(ckpt_dir, meta)
+    if meta.get("schema_version") == CHUNKED_SCHEMA_VERSION:
+        from bigdl_tpu.utils import ckpt_chunked as _ck
+
+        _ck.verify_manifest(ckpt_dir, meta.get("manifest"))
+        return meta
     for name, expected in (meta.get("integrity") or {}).items():
         p = _join(ckpt_dir, name)
         try:
@@ -383,8 +475,10 @@ def checkpoint_health(ckpt_dir: str) -> Dict:
 def gc_partial_checkpoints(path: str) -> List[str]:
     """Reclaim interrupted checkpoint debris under `path`: `ckpt_<N>` dirs
     missing their meta.json commit marker (a save killed mid-write) and
-    `tmp.<N>` staging dirs the async writer never got to rename.  Returns
-    the removed paths.
+    `tmp.<N>` staging dirs the async writer never got to rename.  Applies
+    to both layouts — a chunked dir with committed chunk files but no
+    meta.json is exactly as dead as a lone `.npz` and is reclaimed whole,
+    never half-loaded.  Returns the removed paths.
 
     Call this only on RESUME paths (no writer can be mid-save then) — a
     live writer's staging dir looks exactly like debris."""

@@ -1,0 +1,329 @@
+"""Chunked, mesh-aware checkpoint layout (schema v2) — the elastic format.
+
+The monolithic v1 layout gathers every pytree to host and writes one
+`.npz` per tree: a full-tree host-memory cliff on save, an all-or-nothing
+loss on a killed write, and no record of how the saved leaves were laid
+out across the mesh.  This module is the v2 core shared by the writer
+(`resilience.async_ckpt`) and the reader (`utils.checkpoint`):
+
+  * **Per-leaf chunk grid from the live sharding.**  Each leaf is written
+    as one chunk file per distinct shard of its `NamedSharding` (the
+    shard boundaries ARE the chunk boundaries), so the device->host
+    transfer and the host buffer are bounded by ONE CHUNK at a time —
+    never the gathered tree.  Replicated/host leaves are one chunk.
+  * **Manifest in meta.json.**  Per leaf: global shape, dtype,
+    PartitionSpec, chunk grid (file, start offsets, shape), and a
+    CRC32C per chunk (extending the v1 per-leaf stamps — a flipped bit
+    names the exact chunk, and restore re-reads only that much).
+  * **Mesh descriptor.**  Axis names/sizes, device kind, backend and the
+    multislice boundary (`n_slices`) of the mesh the save ran under, so
+    a restore under a DIFFERENT topology knows the source layout.
+  * **Reshard-on-load.**  `load_tree` assembles each target shard
+    directly from the chunks that intersect it
+    (`jax.make_array_from_callback`): a tree saved on N chips restores
+    onto M without ever materializing the full tree on one host.
+
+Layout on disk (inside the same tmp -> fsync -> rename commit protocol
+as v1; `meta.json` stays the last-written commit marker):
+
+    ckpt_<step>/
+      meta.json                 # schema_version=2, mesh, manifest, ...
+      params/00000.00000.npy    # <leaf idx>.<chunk idx>
+      params/00001.00000.npy
+      opt_state/...
+
+Import direction: this module imports `utils.checkpoint` (fs helpers +
+schema constants); `utils.checkpoint` imports this module lazily inside
+its load/verify functions, and `resilience.async_ckpt` imports both.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+from bigdl_tpu.health import integrity as _integrity
+from bigdl_tpu.health.integrity import CorruptCheckpointError
+from bigdl_tpu.utils.checkpoint import (
+    CHUNKED_SCHEMA_VERSION,
+    _is_remote,
+    _join,
+    _open,
+    _path_part,
+)
+
+logger = logging.getLogger("bigdl_tpu.checkpoint")
+
+__all__ = [
+    "CHUNKED_SCHEMA_VERSION",
+    "TREE_NAMES",
+    "load_tree",
+    "mesh_descriptor",
+    "plan_chunks",
+    "verify_manifest",
+    "write_tree",
+]
+
+TREE_NAMES = ("params", "model_state", "opt_state")
+_SEP = "/"
+
+
+def _leaf_key(path) -> str:
+    return _SEP.join(_path_part(p) for p in path) or "_root"
+
+
+def _spec_to_json(sharding) -> Optional[List[Any]]:
+    """PartitionSpec of a NamedSharding as a JSON value (None = replicated
+    or not a named sharding — the layout information lives in the chunk
+    grid either way; the spec is the human/debug record of intent)."""
+    if not isinstance(sharding, NamedSharding):
+        return None
+    out: List[Any] = []
+    for e in tuple(sharding.spec):
+        if e is None:
+            out.append(None)
+        elif isinstance(e, (tuple, list)):
+            out.append([str(x) for x in e])
+        else:
+            out.append(str(e))
+    return out
+
+
+def mesh_descriptor(trees: Any) -> Dict[str, Any]:
+    """Describe the mesh the first NamedSharding leaf in `trees` lives on
+    (axis names/sizes, device kind, backend, multislice DCN boundary).
+    Falls back to a single-device descriptor when nothing is mesh-placed —
+    the restore side still learns the device world the save ran under."""
+    mesh = None
+    for leaf in jax.tree_util.tree_leaves(trees):
+        sh = getattr(leaf, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            mesh = sh.mesh
+            break
+    if mesh is not None:
+        devs = list(mesh.devices.flat)
+        axes = {str(n): int(s)
+                for n, s in zip(mesh.axis_names, mesh.devices.shape)}
+    else:
+        devs = jax.devices()[:1]
+        axes = {}
+    slices = {int(getattr(d, "slice_index", 0) or 0) for d in devs}
+    return {
+        "axes": axes,
+        "backend": devs[0].platform,
+        "device_kind": getattr(devs[0], "device_kind", "unknown"),
+        "n_devices": len(devs),
+        "n_slices": len(slices),
+    }
+
+
+def plan_chunks(leaf: Any) -> List[Tuple[Tuple[int, ...], Tuple[int, ...],
+                                         Callable[[], np.ndarray]]]:
+    """Chunk plan for one leaf: `[(start, shape, fetch)]` covering the
+    global array exactly once.
+
+    A fully-addressable `jax.Array` contributes one chunk per DISTINCT
+    shard index (replicas dedup away), each `fetch` pulling only that
+    shard to host.  Host leaves (and, defensively, cross-process shards —
+    the chunked writer runs single-process) are a single whole-array
+    chunk."""
+    if isinstance(leaf, jax.Array) and leaf.is_fully_addressable:
+        shape = leaf.shape
+        seen: Dict[Tuple, Any] = {}
+        for sh in leaf.addressable_shards:
+            start = tuple(0 if s.start is None else int(s.start)
+                          for s in sh.index)
+            stop = tuple(dim if s.stop is None else int(s.stop)
+                         for s, dim in zip(sh.index, shape))
+            if (start, stop) not in seen:
+                seen[(start, stop)] = sh
+        return [(start,
+                 tuple(b - a for a, b in zip(start, stop)),
+                 (lambda s=shard: np.asarray(s.data)))
+                for (start, stop), shard in sorted(seen.items())]
+    arr_shape = tuple(np.shape(leaf))
+    return [((0,) * len(arr_shape), arr_shape,
+             (lambda l=leaf: np.asarray(l)))]
+
+
+def write_tree(tree_name: str, tree: Any,
+               emit: Callable[[str, Any], None],
+               note_host: Optional[Callable[[int], None]] = None
+               ) -> List[Dict[str, Any]]:
+    """Write one pytree as chunk files via `emit(relname, payload_bytes)`
+    and return its manifest entries.  Exactly ONE chunk's host buffer is
+    alive at a time: fetch -> serialize -> emit -> drop, so the writer's
+    peak host memory is bounded by the largest chunk, not the tree."""
+    entries: List[Dict[str, Any]] = []
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for li, (path, leaf) in enumerate(flat):
+        chunks: List[Dict[str, Any]] = []
+        dtype = None
+        for ci, (start, cshape, fetch) in enumerate(plan_chunks(leaf)):
+            arr = fetch()  # the ONLY device->host transfer, one chunk wide
+            if note_host is not None:
+                note_host(int(arr.nbytes))
+            buf = io.BytesIO()
+            np.save(buf, arr)
+            relname = f"{tree_name}/{li:05d}.{ci:05d}.npy"
+            emit(relname, buf.getbuffer())
+            chunks.append({"file": relname, "start": list(start),
+                           "shape": list(cshape),
+                           "crc32c": _integrity.leaf_crc(arr)})
+            dtype = arr.dtype.str
+            del arr, buf
+        entries.append({"key": _leaf_key(path),
+                        "shape": list(np.shape(leaf)),
+                        "dtype": dtype,
+                        "spec": _spec_to_json(getattr(leaf, "sharding",
+                                                      None)),
+                        "chunks": chunks})
+    return entries
+
+
+def _read_chunk(ckpt_dir: str, ch: Dict[str, Any],
+                verify: bool) -> np.ndarray:
+    """One chunk file -> host array; under verification ANY read failure
+    or CRC/shape mismatch is an integrity failure naming the chunk (the
+    fallback chain treats both identically, as with v1 npz reads)."""
+    p = _join(ckpt_dir, ch["file"])
+    try:
+        if _is_remote(p):
+            with _open(p, "rb") as fh:
+                arr = np.load(io.BytesIO(fh.read()))
+        else:
+            arr = np.load(p)
+    except Exception as e:
+        raise CorruptCheckpointError(
+            f"checkpoint chunk {p} unreadable: {e}") from e
+    if verify:
+        if list(arr.shape) != list(ch["shape"]):
+            raise CorruptCheckpointError(
+                f"checkpoint chunk {p} shape {list(arr.shape)} != manifest "
+                f"{ch['shape']}")
+        got = _integrity.leaf_crc(arr)
+        want = int(ch["crc32c"]) & 0xFFFFFFFF
+        if got != want:
+            raise CorruptCheckpointError(
+                f"checkpoint chunk {p} crc {got:#010x} != stored "
+                f"{want:#010x}")
+    return arr
+
+
+def _assemble_region(ckpt_dir: str, entry: Dict[str, Any],
+                     region: Tuple, verify: bool,
+                     cache: Optional[Dict[str, np.ndarray]] = None
+                     ) -> np.ndarray:
+    """Assemble the sub-array `region` (tuple of slices in global coords)
+    of one leaf from EXACTLY the chunks intersecting it — the
+    reshard-on-load read path.  Raises if the chunk grid does not cover
+    the region exactly once (a dropped or duplicated chunk is corruption,
+    same bar as a flipped bit)."""
+    shape = tuple(entry["shape"])
+    starts = [0 if s.start is None else int(s.start) for s in region]
+    stops = [d if s.stop is None else int(s.stop)
+             for s, d in zip(region, shape)]
+    out = np.empty(tuple(b - a for a, b in zip(starts, stops)),
+                   np.dtype(entry["dtype"]))
+    covered = 0
+    for ch in entry["chunks"]:
+        cstart, cshape = ch["start"], ch["shape"]
+        los = [max(a, cs) for a, cs in zip(starts, cstart)]
+        his = [min(b, cs + cl) for b, cs, cl in zip(stops, cstart, cshape)]
+        if any(lo >= hi for lo, hi in zip(los, his)):
+            continue
+        data = None if cache is None else cache.get(ch["file"])
+        if data is None:
+            data = _read_chunk(ckpt_dir, ch, verify)
+            if cache is not None:
+                cache[ch["file"]] = data
+        src = tuple(slice(lo - cs, hi - cs)
+                    for lo, hi, cs in zip(los, his, cstart))
+        dst = tuple(slice(lo - a, hi - a)
+                    for lo, hi, a in zip(los, his, starts))
+        out[dst] = data[src]
+        covered += int(np.prod([hi - lo for lo, hi in zip(los, his)],
+                               dtype=np.int64)) if los else 1
+    if covered != out.size:
+        raise CorruptCheckpointError(
+            f"checkpoint leaf '{entry['key']}' chunk grid covers {covered} "
+            f"of {out.size} elements of region {region} — manifest and "
+            f"chunk files disagree")
+    return out
+
+
+def load_tree(ckpt_dir: str, entries: List[Dict[str, Any]], template: Any,
+              verify: bool, to_device: bool = True,
+              target_shardings: Optional[Dict[str, Any]] = None) -> Any:
+    """Rebuild a pytree in the structure of `template` from a chunked
+    checkpoint, resharding on load.
+
+    Placement per leaf: an explicit `target_shardings[key]` wins; else a
+    `jax.Array` template leaf's OWN sharding (the current mesh's layout —
+    how `Optimizer._restore` and the serving registry pass theirs); else
+    a plain host array.  Sharded targets are assembled shard-by-shard via
+    `jax.make_array_from_callback`, reading only the chunks intersecting
+    each target shard — N saved chips -> M restore chips without the full
+    tree ever living on one host.  Chunk reads are cached within one leaf
+    (a chunk may straddle several target shards) and dropped after it."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    by_key = {e["key"]: e for e in entries}
+    leaves = []
+    for path, leaf in flat:
+        key = _leaf_key(path)
+        e = by_key.get(key)
+        if e is None:
+            raise KeyError(f"checkpoint missing tensor '{key}'")
+        if tuple(e["shape"]) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"checkpoint tensor '{key}' shape {tuple(e['shape'])} != "
+                f"model {np.shape(leaf)}")
+        target = None
+        if target_shardings is not None and key in target_shardings:
+            target = target_shardings[key]
+        elif to_device and isinstance(leaf, jax.Array) \
+                and isinstance(leaf.sharding, NamedSharding):
+            # only mesh-sharded templates assemble on device; a plain
+            # single-device template gets host numpy — the v1 reader's
+            # contract — so the caller's own placement path runs and the
+            # restored run lowers the SAME step program as a fresh one
+            # (a committed single-device array would change the sharding
+            # annotations and miss the persistent compile cache)
+            target = leaf.sharding
+        cache: Dict[str, np.ndarray] = {}
+        if target is not None:
+            arr = jax.make_array_from_callback(
+                tuple(e["shape"]), target,
+                lambda idx, e=e, c=cache: _assemble_region(
+                    ckpt_dir, e, idx, verify, c))
+        else:
+            region = tuple(slice(0, d) for d in e["shape"])
+            arr = _assemble_region(ckpt_dir, e, region, verify, cache)
+        cache.clear()
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def verify_manifest(ckpt_dir: str, manifest: Optional[Dict[str, Any]]) -> None:
+    """Full integrity pass over a chunked checkpoint: every chunk of every
+    leaf is read back and CRC-checked, and each leaf's grid must cover its
+    global shape exactly.  Raises CorruptCheckpointError on any failure."""
+    for tree_name, entries in (manifest or {}).items():
+        for e in entries:
+            total = 0
+            for ch in e["chunks"]:
+                arr = _read_chunk(ckpt_dir, ch, verify=True)
+                total += int(arr.size)
+                del arr
+            expect = int(np.prod(e["shape"], dtype=np.int64)) \
+                if e["shape"] else 1
+            if total != expect:
+                raise CorruptCheckpointError(
+                    f"checkpoint leaf '{tree_name}/{e['key']}' chunks hold "
+                    f"{total} elements, manifest shape {e['shape']} needs "
+                    f"{expect}")

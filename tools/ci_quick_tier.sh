@@ -27,11 +27,15 @@ elapsed=$(( $(date +%s) - start ))
 if [ "$rc" -eq 0 ]; then
     # chaos lane: the deterministic fault-injection tests get their own
     # visible pass/fail line (a broken recovery path must not hide in the
-    # bulk tier's dots) and run inside the same wall-clock budget
+    # bulk tier's dots) and run inside the same wall-clock budget —
+    # including the sharded-checkpoint faults (single-chunk bitflip must
+    # fall back loudly) and the kill-under-mesh-A / resume-under-mesh-B
+    # topology-change fixture
     remaining=$(( BUDGET - elapsed ))
     [ "$remaining" -lt 30 ] && remaining=30
     timeout --signal=TERM "$remaining" python -m pytest \
         tests/test_resilience.py tests/test_health.py \
+        tests/test_sharded_ckpt.py tests/test_elastic_reshard.py \
         -m "chaos and not slow" -q
     rc=$?
     elapsed=$(( $(date +%s) - start ))
