@@ -58,6 +58,7 @@ Prints one json line per row.
 import argparse
 import json
 import os
+import statistics
 import sys
 import time
 from collections import deque
@@ -648,6 +649,180 @@ def obs_ab(iters=ITERS, rounds=8):
     return rows
 
 
+def flight_trainer_rows(iters, rounds, flight_dir):
+    """Trainer leg of the flight A-B: the obs_ab traced leg with the
+    flight recorder ADDITIONALLY armed (tracing + ring notes + log-tail
+    handler; no trigger fires in the window, so the measured cost is the
+    passive black box).  Unlike measure_obs, both legs share ONE built
+    optimizer and alternate per SHORT timed window — the plane is
+    re-read at each optimize() (the hot loop hoists `obs.tracer()` once
+    per call), so toggling between calls is exact.  The verdict is the
+    MEDIAN of per-pair on/off ratios: adjacent windows (~1.5 s apart)
+    see the same background load, so each ratio cancels the minute-scale
+    drift this shared host shows (±12% between runs — per-leg mins over
+    long windows provably did not converge under it)."""
+    from bigdl_tpu import obs
+
+    o, _, _ = _build(iters)
+    obs.set_observability(tracing=False, flight=False)
+    o.optimize()  # warm: compiles the step + telemetry-ring write
+    total = iters
+    mins = {False: float("inf"), True: float("inf")}
+    ratios = []
+    events = 0
+    try:
+        for _ in range(rounds):
+            pair = {}
+            for on in (False, True):
+                if on:
+                    obs.set_observability(tracing=True, flight=True,
+                                          flight_dir=flight_dir)
+                    assert obs.flight_recorder() is not None
+                else:
+                    obs.set_observability(tracing=False, flight=False)
+                total += iters
+                o.end_when = Trigger.max_iteration(total)
+                t0 = time.perf_counter()
+                o.optimize()
+                pair[on] = (time.perf_counter() - t0) / iters
+                mins[on] = min(mins[on], pair[on])
+                if on:
+                    events = max(events, len(obs.tracer().events()))
+            ratios.append(pair[True] / pair[False])
+    finally:
+        obs.set_observability(tracing=False, flight=False)
+    assert events >= iters, f"armed leg recorded only {events} events"
+    out_rows = []
+    for on in (False, True):
+        out_rows.append({
+            "path": "flight_trainer_ab", "tracing": on, "flight_armed": on,
+            "ms_per_step_min": round(mins[on] * 1e3, 2),
+            **({"trace_events": events} if on else {})})
+        print(json.dumps(out_rows[-1]), flush=True)
+    overhead = statistics.median(ratios) - 1.0
+    out_rows.append({
+        "metric": "flight_trainer_overhead_ok",
+        "value": bool(overhead < 0.01),
+        "overhead_pct": round(overhead * 100, 2),
+        "pairs": len(ratios)})
+    print(json.dumps(out_rows[-1]))
+    return out_rows
+
+
+def fleet_flight_ab(n_requests=64, trials=11):
+    """Routed-burst A-B with the flight recorder off vs armed — tracing
+    OFF in both legs, the recommended incident posture (metrics +
+    compile monitor + flight ON, tracing OFF; docs/observability.md).
+    This isolates exactly what "always-on" costs the serving path: the
+    log-tail handler plus the trigger check, nothing per request.  The
+    armed leg must cost <1% wall on the same burst, and must still
+    produce a complete on-demand bundle afterwards (proof the recorder
+    was live, not a disarmed no-op)."""
+    import tempfile
+
+    import bigdl_tpu.compilecache as cc
+    from bigdl_tpu import obs
+    from bigdl_tpu.fleet import FleetRouter, TenantConfig
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import bench_fleet
+
+    cc.set_cache_dir(tempfile.mkdtemp(prefix="flight_fleet_cc_"))
+    flight_dir = tempfile.mkdtemp(prefix="flight_fleet_")
+    model, params, state = bench_fleet.build_model(True)
+    rs = np.random.RandomState(1)
+    requests = [rs.rand(bench_fleet.BUCKETS[-1], 128).astype(np.float32)
+                for _ in range(n_requests)]
+    router = FleetRouter(
+        lambda name: bench_fleet.make_runtime(model, params, state),
+        n_replicas=2,
+        tenants=[TenantConfig("bench", tier="batch", capacity=1024)])
+    walls = {False: float("inf"), True: float("inf")}
+    ratios = []
+    try:
+        for armed in (False, True):  # untimed: page in both postures
+            obs.set_observability(flight=armed, flight_dir=flight_dir)
+            bench_fleet.burst(requests, lambda x: router.submit("bench", x))
+        for _ in range(trials):
+            pair = {}
+            for armed in (False, True):
+                obs.set_observability(flight=armed, flight_dir=flight_dir)
+                pair[armed] = bench_fleet.burst(
+                    requests, lambda x: router.submit("bench", x))
+                walls[armed] = min(walls[armed], pair[armed])
+            ratios.append(pair[True] / pair[False])
+        # still armed after the last leg: the recorder must be real
+        bundle = obs.dump_flight("bench.capture")
+        assert bundle is not None, "armed leg had no live flight recorder"
+        with open(os.path.join(bundle, "trace.json")) as fh:
+            json.load(fh)
+    finally:
+        obs.set_observability(flight=False)
+        router.close()
+        cc.reset()
+    out_rows = []
+    for armed in (False, True):
+        out_rows.append({
+            "path": "fleet_flight_ab", "flight_armed": armed,
+            "requests": n_requests, "replicas": 2, "trials": trials,
+            "burst_wall_ms_min": round(walls[armed] * 1e3, 2)})
+        print(json.dumps(out_rows[-1]), flush=True)
+    # median of per-trial pairwise ratios — adjacent bursts see the same
+    # host load, so drift cancels (the bench_fleet router-overhead
+    # discipline, needed even more at a 1% bar than at its 2%)
+    overhead = statistics.median(ratios) - 1.0
+    out_rows.append({
+        "metric": "flight_fleet_overhead_ok",
+        "value": bool(overhead < 0.01),
+        "overhead_pct": round(overhead * 100, 2),
+        "bundle_on_demand": True})
+    print(json.dumps(out_rows[-1]))
+    return out_rows
+
+
+def flight_ab(iters=ITERS, rounds=24, out_path=None):
+    """The flight-recorder A-B pair (obs ISSUE acceptance re-proven with
+    the black box armed): trainer leg (tracing + armed recorder vs off)
+    and fleet leg (armed recorder alone vs off on a routed burst), both
+    interleaved with per-pair ratio medians.  Writes
+    results/flight_quick.json."""
+    import tempfile
+
+    out_rows = flight_trainer_rows(iters, rounds,
+                                   tempfile.mkdtemp(prefix="flight_bench_"))
+    out_rows.extend(fleet_flight_ab())
+    if out_path:
+        artifact = {
+            "bench": "PYTHONPATH=. JAX_PLATFORMS=cpu python "
+                     "benchmarks/bench_trainer_overhead.py --obs --flight "
+                     f"--iters {iters}",
+            "date": time.strftime("%Y-%m-%d"),
+            "platform": f"cpu backend, {os.cpu_count()}-core shared host "
+                        "whose background load drifts by more than the "
+                        "effect under test, so both legs take the MEDIAN "
+                        "of per-pair on/off ratios over adjacent windows "
+                        "(drift cancels in each ratio) rather than "
+                        "per-leg aggregates. Trainer leg: ONE built "
+                        f"optimizer, {rounds} alternating {iters}-iter "
+                        "windows of off vs tracing+armed-recorder — no "
+                        "trigger fires in the window, so the on leg pays "
+                        "tracing plus the passive black box (log-tail "
+                        "handler). Fleet leg: the same 64-request routed "
+                        "burst through a 2-replica FleetRouter with the "
+                        "flight recorder off vs armed, tracing off in "
+                        "BOTH legs (the recommended incident posture); "
+                        "the armed leg then dumps a bundle on demand to "
+                        "prove the recorder was live. The <1% bars are "
+                        "the ISSUE acceptance criterion.",
+            "rows": out_rows,
+        }
+        with open(out_path, "w") as fh:
+            json.dump(artifact, fh, indent=2)
+            fh.write("\n")
+        print(f"# wrote {out_path}")
+    return out_rows
+
+
 def lint_hotpath_ab(iters=ITERS):
     """A-B of the tpu_lint host-sync fixes (bigdl_tpu.analysis): each
     "before" leg re-injects the exact pattern the linter flagged, the
@@ -897,6 +1072,10 @@ def main(argv=None):
                          "(procs=2 pool in both legs)")
     ap.add_argument("--obs", action="store_true",
                     help="run just the obs span-tracing off/on A-B")
+    ap.add_argument("--flight", action="store_true",
+                    help="with --obs: arm the flight recorder on the "
+                         "traced leg and add the routed-fleet black-box "
+                         "A-B (writes results/flight_quick.json)")
     ap.add_argument("--restart", action="store_true",
                     help="cold/warm executable-cache restart A-B "
                          "(subprocess legs; writes --out)")
@@ -939,7 +1118,13 @@ def main(argv=None):
                    out_path=args.out)
         return
     if args.obs:
-        obs_ab(args.iters)
+        if args.flight:
+            out = args.out or os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "results",
+                "flight_quick.json")
+            flight_ab(args.iters, out_path=out)
+        else:
+            obs_ab(args.iters)
         return
     lat, rere = measure_readback_latency()
     print(json.dumps({"metric": "env_readback_latency_ms",

@@ -64,6 +64,8 @@ class AutoscalerConfig:
         default_factory=lambda: _env("BIGDL_TPU_FLEET_HIGH_QUEUE", 16))
     high_p99_ms: float = field(
         default_factory=lambda: _env("BIGDL_TPU_FLEET_HIGH_P99_MS", 200.0))
+    high_burn_rate: float = field(
+        default_factory=lambda: _env("BIGDL_TPU_FLEET_HIGH_BURN", 6.0))
     low_queue_depth: float = field(
         default_factory=lambda: _env("BIGDL_TPU_FLEET_LOW_QUEUE", 1))
     grow_after: int = field(
@@ -108,11 +110,16 @@ class FleetAutoscaler:
             queues = list(self.router._tenants.values())
         p99 = max((q.metrics.total_ms.percentile(99) for q in queues),
                   default=0.0)
+        # SLO burn rate: the worst per-tenant fast-window burn the
+        # SloMonitor exported on its last tick (0.0 when no monitor runs)
+        burn = max((v for k, v in _obs.registry().gauges().items()
+                    if k.startswith("slo/burn_rate")), default=0.0)
         return {
             "queue_depth": float(self.router.queue_depth_total()),
             "p99_ms": float(p99),
             "recompile_alarms":
                 _obs.registry().get("compile/steady_recompiles"),
+            "slo_burn_rate": float(burn),
         }
 
     # -- the decision step --------------------------------------------------
@@ -125,11 +132,14 @@ class FleetAutoscaler:
         depth = sig.get("queue_depth", 0.0)
         p99 = sig.get("p99_ms", 0.0)
         alarms = sig.get("recompile_alarms", 0.0)
+        burn = sig.get("slo_burn_rate", 0.0)
         alarm_delta = alarms - self._last_alarms
         self._last_alarms = alarms
 
-        high = depth >= cfg.high_queue_depth or p99 >= cfg.high_p99_ms
-        low = depth <= cfg.low_queue_depth and p99 < cfg.high_p99_ms
+        high = (depth >= cfg.high_queue_depth or p99 >= cfg.high_p99_ms
+                or burn >= cfg.high_burn_rate)
+        low = (depth <= cfg.low_queue_depth and p99 < cfg.high_p99_ms
+               and burn < cfg.high_burn_rate)
         if high:
             self._high += 1
             self._low = 0

@@ -29,6 +29,7 @@ request is never silently dropped.
 
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 from typing import Callable, Optional, Set
@@ -59,6 +60,16 @@ class Replica:
         self._outstanding: Set[_Future] = set()
         self._idle = threading.Event()
         self._idle.set()
+        # trace stitching: pass the router's cid down only to runtimes
+        # whose submit() takes it (decided once here — duck-typed
+        # backends predating the cid contract keep working)
+        try:
+            params = inspect.signature(runtime.submit).parameters
+            self._fwd_cid = "cid" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values())
+        except (TypeError, ValueError):  # pragma: no cover - C callables
+            self._fwd_cid = False
 
     # -- dispatch path (router's dispatcher thread) -------------------------
 
@@ -69,7 +80,8 @@ class Replica:
         with self._lock:
             return len(self._outstanding)
 
-    def submit(self, x, deadline_ms: Optional[float]) -> _Future:
+    def submit(self, x, deadline_ms: Optional[float],
+               cid: Optional[str] = None) -> _Future:
         """Route one request into the backing runtime.  Raises
         `ReplicaDead` if the replica is no longer READY (the dispatcher
         rechecks, but kill can win the race) and lets the runtime's own
@@ -77,7 +89,11 @@ class Replica:
         with self._lock:
             if self.state != READY:
                 raise ReplicaDead(f"replica {self.name!r} is {self.state}")
-            inner = self.runtime.submit(x, deadline_ms=deadline_ms)
+            if cid is not None and self._fwd_cid:
+                inner = self.runtime.submit(x, deadline_ms=deadline_ms,
+                                            cid=cid)
+            else:
+                inner = self.runtime.submit(x, deadline_ms=deadline_ms)
             self._outstanding.add(inner)
             self._idle.clear()
         inner.add_done_callback(self._forget)
@@ -147,8 +163,9 @@ class GenerationAdapter:
         self.submit_kw = submit_kw
         self.config = getattr(engine, "config", None)
 
-    def submit(self, x, deadline_ms: Optional[float] = None) -> _Future:
-        return self.engine.submit(x, **self.submit_kw)
+    def submit(self, x, deadline_ms: Optional[float] = None,
+               cid: Optional[str] = None) -> _Future:
+        return self.engine.submit(x, cid=cid, **self.submit_kw)
 
     def close(self, drain: bool = True, timeout: Optional[float] = 30.0) -> None:
         self.engine.close(drain=drain, timeout=timeout)

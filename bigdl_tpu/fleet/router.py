@@ -210,6 +210,8 @@ class FleetRouter:
         _obs.registry().inc("fleet/replica_kills")
         _obs.instant("fleet.replica_kill", cat="fleet", replica=cand.name,
                      inflight=n_inflight)
+        _obs.flight_notify("fleet.replica_death", replica=cand.name,
+                           inflight=n_inflight)
         reaper = threading.Thread(
             target=self._reap, args=(cand,),
             name=f"fleet-reaper-{cand.name}", daemon=True)
@@ -231,6 +233,13 @@ class FleetRouter:
     def replicas(self) -> List[str]:
         with self._lock:
             return [r.name for r in self._replicas]
+
+    def tenant_metrics(self, name: str):
+        """Live `ServingMetrics` for one tenant (the SloMonitor source),
+        or None for an unknown tenant."""
+        with self._lock:
+            q = self._tenants.get(name)
+        return q.metrics if q is not None else None
 
     def n_replicas(self) -> int:
         with self._lock:
@@ -352,7 +361,8 @@ class FleetRouter:
                      attempt=req.attempts)
         now = time.perf_counter()
         try:
-            inner = replica.submit(req.x, deadline_ms=req.remaining_ms(now))
+            inner = replica.submit(req.x, deadline_ms=req.remaining_ms(now),
+                                   cid=req.cid)
         except ReplicaDead:
             self._requeue(req, replica, burn_budget=True)
             return
@@ -432,9 +442,15 @@ class FleetRouter:
                 total_ms=(now - req.t_enqueue) * 1e3, depth=depth)
             _obs.registry().inc(q.k_completed)
         req.future.meta.update(fut.meta)
+        # ONE cid per request across replicas: the router's id (threaded
+        # through replica.submit, so it usually already matches the
+        # inner meta) wins even over a backend that minted its own
         req.future.meta.update({"tenant": req.tenant, "replica": replica.name,
-                                "fleet_cid": req.cid,
+                                "cid": req.cid, "fleet_cid": req.cid,
                                 "attempts": req.attempts + 1})
+        _obs.instant("fleet.complete", cat="fleet", cid=req.cid,
+                     tenant=req.tenant, replica=replica.name,
+                     attempts=req.attempts + 1)
         req.future.set_result(fut.result(0))
 
     def _fail(self, req: FleetRequest, err: BaseException) -> None:
@@ -453,11 +469,15 @@ class FleetRouter:
                     q = self._tenants.get(req.tenant)
                 if q is not None:
                     q.metrics.on_reject("replica_lost")
+                _obs.flight_notify("fleet.redispatch_budget_exhausted",
+                                   tenant=req.tenant, cid=req.cid,
+                                   attempts=req.attempts)
                 self._fail(req, Rejected(
                     f"request lost its replica {req.attempts} times "
                     "(fleet redispatch budget exhausted)"))
                 return
             _obs.registry().inc("fleet/redispatched")
+            _obs.registry().inc(f"fleet/redispatches|tenant={req.tenant}")
             _obs.instant("fleet.redispatch", cat="fleet", cid=req.cid,
                          tenant=req.tenant, from_replica=replica.name,
                          attempt=req.attempts)

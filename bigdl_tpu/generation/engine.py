@@ -146,14 +146,17 @@ class _GenRequest:
     __slots__ = ("prompt", "max_new", "temperature", "eos_id", "future",
                  "t_submit", "cid", "uid")
 
-    def __init__(self, prompt, max_new, temperature, eos_id, uid):
+    def __init__(self, prompt, max_new, temperature, eos_id, uid,
+                 cid=None):
         self.prompt = prompt
         self.max_new = max_new
         self.temperature = temperature
         self.eos_id = eos_id
         self.future = _Future()
         self.t_submit = time.perf_counter()
-        self.cid = _obs.next_cid()
+        # fleet-routed prompts carry the router's cid so one id spans
+        # replicas; direct submits mint a fresh one
+        self.cid = cid if cid is not None else _obs.next_cid()
         self.uid = uid  # per-engine request index; folds the sampling rng
 
 
@@ -501,7 +504,8 @@ class GenerationEngine:
 
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
                temperature: Optional[float] = None,
-               eos_id: Optional[int] = None) -> _Future:
+               eos_id: Optional[int] = None,
+               cid: Optional[str] = None) -> _Future:
         """Async admission: returns a future resolving to a
         `GenerationResult` (`.result(timeout=...)`)."""
         toks = np.asarray(prompt, np.int32).reshape(-1)
@@ -530,7 +534,8 @@ class GenerationEngine:
                     "requests); backpressure — retry with backoff or raise "
                     "capacity")
             self._uid_counter += 1
-            req = _GenRequest(toks, max_new, temp, eos, self._uid_counter)
+            req = _GenRequest(toks, max_new, temp, eos, self._uid_counter,
+                              cid=cid)
             self._pending.append(req)
             depth = len(self._pending)
             self._cond.notify()

@@ -248,6 +248,10 @@ class DivergenceWatchdog:
         _obs.instant(f"watchdog.{kind}", cat="health", step=int(step),
                      **{k: v for k, v in payload.items()
                         if isinstance(v, (int, float, str, bool))})
+        if kind in ("rollback", "abort"):
+            # the run is about to unwind — snapshot the black box NOW,
+            # while the offending steps are still in the ring
+            _obs.flight_notify(f"watchdog.{kind}", step=int(step))
 
 
 class _Phase:
@@ -365,6 +369,8 @@ class HangWatchdog:
                 _obs.instant("watchdog.stall", cat="health", phase=ph.name,
                              elapsed_s=round(elapsed, 3),
                              deadline_s=deadline)
+                _obs.flight_notify("watchdog.stall", phase=ph.name,
+                                   elapsed_s=round(elapsed, 3))
                 logger.error(
                     "hang watchdog: phase %r exceeded its %.1fs deadline "
                     "(%.1fs elapsed); dumping all thread stacks\n%s",

@@ -39,7 +39,11 @@ from bigdl_tpu.obs.compile_monitor import (  # noqa: F401
     CompileMonitor,
     install_monitor,
 )
+from bigdl_tpu.obs.flight import FlightRecorder  # noqa: F401
+from bigdl_tpu.obs.flight import build_fleet_trace as _build_fleet_trace
+from bigdl_tpu.obs.flight import request_timeline as _request_timeline
 from bigdl_tpu.obs.metrics import MetricsRegistry, NullRegistry  # noqa: F401
+from bigdl_tpu.obs.slo import SloMonitor, SLOObjective, mfu_estimate  # noqa: F401
 from bigdl_tpu.obs.trace import SpanTracer  # noqa: F401
 
 _NULL = nullcontext()
@@ -48,6 +52,7 @@ _state_lock = threading.Lock()
 _tracer: Optional[SpanTracer] = None
 _registry: MetricsRegistry = MetricsRegistry()
 _monitor: Optional[CompileMonitor] = None
+_flight: Optional[FlightRecorder] = None
 _metrics_on = True
 _cid_counter = itertools.count(1)
 
@@ -59,12 +64,17 @@ def _env_mode() -> str:
 def set_observability(metrics: Optional[bool] = None,
                       tracing: Optional[bool] = None,
                       compile_monitor: Optional[bool] = None,
-                      trace_capacity: int = 65536) -> Dict[str, bool]:
+                      trace_capacity: int = 65536,
+                      flight: Optional[bool] = None,
+                      flight_dir: Optional[str] = None,
+                      flight_min_interval_s: float = 30.0) -> Dict[str, bool]:
     """Flip parts of the plane; `None` leaves a part unchanged.  Enabling
     tracing swaps in a FRESH tracer ring (capacity `trace_capacity`);
-    disabling drops it.  Returns the resulting {metrics, tracing,
-    compile_monitor} state."""
-    global _tracer, _monitor, _metrics_on, _registry
+    disabling drops it.  Enabling `flight` installs a FlightRecorder
+    writing postmortem bundles under `flight_dir` (temp dir when None).
+    Returns the resulting {metrics, tracing, compile_monitor, flight}
+    state."""
+    global _tracer, _monitor, _metrics_on, _registry, _flight
     with _state_lock:
         if metrics is not None:
             _metrics_on = bool(metrics)
@@ -81,12 +91,23 @@ def set_observability(metrics: Optional[bool] = None,
             else:
                 _monitor = None
             install_monitor(_monitor)
+        if flight is not None:
+            if _flight is not None:
+                _flight.close()
+                _flight = None
+            if flight:
+                _flight = FlightRecorder(
+                    out_dir=flight_dir,
+                    min_interval_s=flight_min_interval_s,
+                    registry_fn=registry, tracer_fn=tracer,
+                    state_fn=observability)
     return observability()
 
 
 def observability() -> Dict[str, bool]:
     return {"metrics": _metrics_on, "tracing": _tracer is not None,
-            "compile_monitor": _monitor is not None}
+            "compile_monitor": _monitor is not None,
+            "flight": _flight is not None}
 
 
 def _init_from_env() -> None:
@@ -98,6 +119,11 @@ def _init_from_env() -> None:
         set_observability(metrics=True, tracing=True, compile_monitor=True)
     else:  # unset / "metrics": the default-on metrics plane
         set_observability(metrics=True, tracing=False, compile_monitor=True)
+    # flight recorder: BIGDL_TPU_FLIGHT=1 (temp bundles) or =/some/dir
+    fl = os.environ.get("BIGDL_TPU_FLIGHT", "").strip()
+    if fl and fl not in ("0", "off", "none"):
+        set_observability(flight=True,
+                          flight_dir=None if fl in ("1", "on") else fl)
     # structured driver logs ride the same init: BIGDL_TPU_LOG_JSON=1
     # switches the bigdl_tpu logger to JSONL (utils/logger_filter.py)
     from bigdl_tpu.utils.logger_filter import maybe_enable_json_logs
@@ -127,6 +153,27 @@ def set_registry(reg: MetricsRegistry) -> MetricsRegistry:
 
 def compile_monitor() -> Optional[CompileMonitor]:
     return _monitor
+
+
+def flight_recorder() -> Optional[FlightRecorder]:
+    """Active flight recorder, or None when off."""
+    return _flight
+
+
+def flight_notify(reason: str, **details) -> Optional[str]:
+    """A postmortem trigger fired (replica death, watchdog policy,
+    steady-recompile alarm, budget exhaustion, SIGTERM).  No-op when the
+    flight recorder is off; otherwise dedupes per reason and returns the
+    bundle path when one was written."""
+    fr = _flight
+    return fr.notify(reason, **details) if fr is not None else None
+
+
+def dump_flight(reason: str = "manual", **details) -> Optional[str]:
+    """Explicitly write a postmortem bundle now (no dedupe).  Returns
+    the bundle directory, or None when the recorder is off."""
+    fr = _flight
+    return fr.dump(reason, **details) if fr is not None else None
 
 
 def next_cid() -> str:
@@ -165,6 +212,37 @@ def export_trace(path: str) -> Dict[str, Any]:
     return tr.export_chrome(path)
 
 
+def export_fleet_trace(path: Optional[str] = None,
+                       extra_tracers=()) -> Dict[str, Any]:
+    """Stitched fleet trace: router lane + one process-lane per replica
+    + flow events linking each cid's admit -> dispatch -> complete chain
+    (see obs/flight.py).  `extra_tracers` merges rings from tracers with
+    explicit lanes (out-of-process replicas).  Returns {} when tracing
+    is off; writes Chrome-trace JSON to `path` when given."""
+    import json as _json
+
+    tr = _tracer
+    if tr is None:
+        return {}
+    doc = _build_fleet_trace(tr, extra_tracers)
+    if path is not None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            _json.dump(doc, f)
+        os.replace(tmp, path)
+    return doc
+
+
+def request_timeline(cid: str) -> Dict[str, Any]:
+    """Hop-by-hop latency breakdown for one request cid from the active
+    ring (queue wait, redispatches, batcher wait, device time, settle).
+    {} when tracing is off."""
+    tr = _tracer
+    if tr is None:
+        return {}
+    return _request_timeline(tr, cid)
+
+
 @contextmanager
 def device_profile(logdir: str):
     """Opt-in jax.profiler session around a block, so a device profile
@@ -184,9 +262,11 @@ _init_from_env()
 
 __all__ = [
     "BACKEND_COMPILE_EVENT", "PERSISTENT_CACHE_HIT_EVENT",
-    "CompileMonitor", "MetricsRegistry",
-    "NullRegistry", "SpanTracer", "attribute", "compile_monitor",
-    "device_profile", "export_trace", "install_monitor", "instant",
-    "next_cid", "observability", "registry", "set_observability",
-    "set_registry", "span", "tracer",
+    "CompileMonitor", "FlightRecorder", "MetricsRegistry",
+    "NullRegistry", "SLOObjective", "SloMonitor", "SpanTracer",
+    "attribute", "compile_monitor", "device_profile", "dump_flight",
+    "export_fleet_trace", "export_trace", "flight_notify",
+    "flight_recorder", "install_monitor", "instant", "mfu_estimate",
+    "next_cid", "observability", "registry", "request_timeline",
+    "set_observability", "set_registry", "span", "tracer",
 ]

@@ -253,6 +253,12 @@ class CompileMonitor:
                 "executable set grew after warmup settled — check for "
                 "shape drift past the bucket padding or a traced value "
                 "baked into the jitted closure", sig, duration_s)
+            # flight trigger (lazy import: obs.__init__ imports this
+            # module, so the package is only reachable at call time)
+            from bigdl_tpu import obs as _obs
+
+            _obs.flight_notify("compile.steady_recompile", signature=sig,
+                               duration_s=round(duration_s, 3))
 
     # -- inspection --------------------------------------------------------
 

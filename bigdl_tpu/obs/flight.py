@@ -1,0 +1,400 @@
+"""Flight recorder + cross-replica trace stitching (the fleet black box).
+
+Two halves, both host-only (no device arrays, monotonic clocks — traced
+hot loops stay legal under `strict_transfers()`):
+
+  * `FlightRecorder` — an always-on bounded ring of trigger notes plus a
+    last-N tail of the `bigdl_tpu` driver log, dumping a postmortem
+    bundle (stitched trace JSON + metrics snapshot + log tail +
+    config/env fingerprint) when something dies: replica kill, watchdog
+    rollback/abort/stall, steady-state recompile alarm, redispatch
+    budget exhaustion, SIGTERM (via the PreemptionGuard), or an explicit
+    `obs.dump_flight(reason)`.  Triggers are deduplicated per reason
+    within `min_interval_s`, so one incident yields ONE bundle, not one
+    per bounced request.
+
+  * `build_fleet_trace` / `request_timeline` — stitch the fleet request
+    lifecycle out of the span ring.  The in-process fleet shares one
+    tracer, so replica separation is reconstructed from the router's
+    `fleet.dispatch` instants (cid -> replica at time t): `serve.*` /
+    `gen.*` events re-export under a per-replica pid lane with
+    `process_name` metadata, the router's `fleet.*` events get their own
+    lane, and flow events (`ph: s/t/f`, id = cid) link
+    admit -> dispatch -> redispatch -> complete across lanes.  Rings
+    from out-of-process replicas (SpanTracer with an explicit `lane`)
+    merge in via `extra_tracers`.
+
+Recording costs one lock + one deque append per trigger note; the dump
+path (file IO, JSON) only runs on a trigger and is cold by definition.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger("bigdl_tpu.obs")
+
+__all__ = ["FlightRecorder", "build_fleet_trace", "request_timeline"]
+
+# pid lanes for the synthesized fleet trace: the un-attributed process
+# lane (trainer, submitter threads), the router, then one per replica
+_LANE_PROCESS = 0
+_LANE_ROUTER = 1
+_LANE_REPLICA0 = 2
+
+
+# ---------------------------------------------------------------------------
+# trace stitching
+# ---------------------------------------------------------------------------
+
+
+def _event_cids(args: Optional[Dict[str, Any]]) -> Tuple[str, ...]:
+    if not args:
+        return ()
+    cid = args.get("cid")
+    if cid is not None:
+        return (cid,)
+    cids = args.get("cids")
+    if isinstance(cids, (list, tuple)):
+        return tuple(cids)
+    return ()
+
+
+def _dispatch_timeline(events: Sequence[tuple]) -> Dict[str, List[Tuple[int, str]]]:
+    """cid -> [(ts_ns, replica), ...] from the router's dispatch instants."""
+    out: Dict[str, List[Tuple[int, str]]] = {}
+    for kind, name, _cat, _tid, _tn, ts_ns, _dur, args in events:
+        if name == "fleet.dispatch" and args:
+            cid, rep = args.get("cid"), args.get("replica")
+            if cid is not None and rep is not None:
+                out.setdefault(cid, []).append((ts_ns, rep))
+    for seq in out.values():
+        seq.sort()
+    return out
+
+
+def _replica_at(seq: List[Tuple[int, str]], ts_ns: int) -> Optional[str]:
+    """The replica the cid was dispatched to most recently at `ts_ns`."""
+    rep = None
+    for t, r in seq:
+        if t <= ts_ns:
+            rep = r
+        else:
+            break
+    return rep if rep is not None else (seq[0][1] if seq else None)
+
+
+def build_fleet_trace(tracer, extra_tracers: Sequence = ()) -> Dict[str, Any]:
+    """One Chrome-trace doc from the shared ring: router lane on top,
+    one process-lane per replica, flow events linking each fleet cid's
+    admit -> dispatch -> (redispatch ->) complete chain."""
+    events = tracer.events()
+    epoch = tracer._epoch_ns
+    dispatches = _dispatch_timeline(events)
+    replica_lane: Dict[str, int] = {}
+    for seq in dispatches.values():
+        for _ts, rep in seq:
+            if rep not in replica_lane:
+                replica_lane[rep] = _LANE_REPLICA0 + len(replica_lane)
+
+    out: List[Dict[str, Any]] = []
+    lanes_seen: Dict[Tuple[int, int], str] = {}  # (pid, tid) -> thread name
+    chains: Dict[str, List[Tuple[int, int, int]]] = {}  # cid -> (ts, pid, tid)
+    for kind, name, cat, tid, tname, ts_ns, dur_ns, args in events:
+        cids = _event_cids(args)
+        if name.startswith("fleet."):
+            pid = _LANE_ROUTER
+        elif cids and (name.startswith("serve.") or name.startswith("gen.")):
+            pid = _LANE_PROCESS
+            for cid in cids:
+                seq = dispatches.get(cid)
+                if seq:
+                    rep = _replica_at(seq, ts_ns)
+                    if rep is not None:
+                        pid = replica_lane[rep]
+                        break
+        else:
+            pid = _LANE_PROCESS
+        lanes_seen.setdefault((pid, tid), tname)
+        ev: Dict[str, Any] = {"ph": kind, "name": name, "cat": cat,
+                              "pid": pid, "tid": tid,
+                              "ts": (ts_ns - epoch) / 1e3}
+        if kind == "X":
+            ev["dur"] = dur_ns / 1e3
+        else:
+            ev["s"] = "t"
+        if args:
+            ev["args"] = dict(args)
+        out.append(ev)
+        # the request chain only follows lifecycle seams, not every
+        # event that happens to mention the cid
+        if name in ("fleet.admit", "fleet.dispatch", "fleet.redispatch",
+                    "serve.complete", "gen.complete", "fleet.complete"):
+            for cid in cids:
+                if cid in dispatches:
+                    chains.setdefault(cid, []).append((ts_ns, pid, tid))
+
+    flows: List[Dict[str, Any]] = []
+    for cid, hops in chains.items():
+        if len(hops) < 2:
+            continue
+        hops.sort()
+        for i, (ts_ns, pid, tid) in enumerate(hops):
+            ph = "s" if i == 0 else ("f" if i == len(hops) - 1 else "t")
+            fl = {"ph": ph, "name": "fleet.request", "cat": "fleet",
+                  "id": cid, "pid": pid, "tid": tid,
+                  "ts": (ts_ns - epoch) / 1e3}
+            if ph == "f":
+                fl["bp"] = "e"
+            flows.append(fl)
+
+    lane_names = {_LANE_PROCESS: "process", _LANE_ROUTER: "fleet-router"}
+    lane_names.update({lane: f"replica:{rep}"
+                       for rep, lane in replica_lane.items()})
+    meta: List[Dict[str, Any]] = [
+        {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+         "args": {"name": pname}} for pid, pname in sorted(lane_names.items())]
+    meta.extend({"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                 "args": {"name": tname}}
+                for (pid, tid), tname in lanes_seen.items())
+
+    dropped = tracer.dropped
+    merged = meta + out + flows
+    for extra in extra_tracers:
+        doc = extra.to_chrome(epoch_ns=epoch)
+        merged.extend(doc["traceEvents"])
+        dropped += doc["otherData"]["dropped_events"]
+    return {"traceEvents": merged, "displayTimeUnit": "ms",
+            "otherData": {"dropped_events": dropped,
+                          # string keys: the doc must round-trip as JSON
+                          "replica_lanes": {str(pid): pname for pid, pname
+                                            in lane_names.items()}}}
+
+
+def request_timeline(tracer, cid: str) -> Dict[str, Any]:
+    """Hop-by-hop latency reconstruction for one fleet request: every
+    lifecycle event carrying the cid, plus the derived breakdown (fleet
+    queue wait, redispatch count, batcher wait, device time, settle)."""
+    events = tracer.events()
+    epoch = tracer._epoch_ns
+    hops: List[Dict[str, Any]] = []
+    named: Dict[str, List[tuple]] = {}
+    for kind, name, _cat, _tid, _tn, ts_ns, dur_ns, args in events:
+        if cid not in _event_cids(args):
+            continue
+        row = {"name": name, "ts_ms": (ts_ns - epoch) / 1e3,
+               "dur_ms": dur_ns / 1e3 if kind == "X" else None,
+               "args": dict(args) if args else {}}
+        hops.append(row)
+        named.setdefault(name, []).append((ts_ns, dur_ns, args))
+    hops.sort(key=lambda r: r["ts_ms"])
+
+    def first(name):
+        seq = named.get(name)
+        return min(seq) if seq else None
+
+    def last(name):
+        seq = named.get(name)
+        return max(seq) if seq else None
+
+    admit = first("fleet.admit")
+    disp = first("fleet.dispatch")
+    serve_admit = last("serve.admit") or last("gen.admit")
+    serve_disp = last("serve.dispatch") or last("gen.prefill")
+    complete = last("serve.complete") or last("gen.complete")
+    settle = last("fleet.complete")
+    out: Dict[str, Any] = {
+        "cid": cid, "hops": hops,
+        "redispatches": len(named.get("fleet.redispatch", ())),
+        "replicas": [a.get("replica") for _t, _d, a in
+                     sorted(named.get("fleet.dispatch", ())) if a],
+    }
+
+    def ms(a, b):
+        return (b[0] - a[0]) / 1e6 if a and b else None
+
+    out["queue_wait_ms"] = ms(admit, disp)
+    out["batcher_wait_ms"] = ms(serve_admit, serve_disp)
+    out["device_ms"] = serve_disp[1] / 1e6 if serve_disp else None
+    out["settle_ms"] = ms(complete, settle)
+    if hops:
+        out["total_ms"] = hops[-1]["ts_ms"] - hops[0]["ts_ms"]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class _LogTail(logging.Handler):
+    """Last-N formatted driver log lines, bounded, lock via deque."""
+
+    def __init__(self, n: int):
+        super().__init__(level=logging.DEBUG)
+        self.ring: deque = deque(maxlen=n)
+        self.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self.ring.append(self.format(record))
+        except Exception:  # noqa: BLE001 — logging must never raise
+            pass
+
+
+class FlightRecorder:
+    """Bounded trigger ring + postmortem bundle writer.
+
+    Accessors are injected (same pattern as CompileMonitor) so the
+    recorder never imports the obs package it lives under:
+
+      * `registry_fn` -> the active MetricsRegistry
+      * `tracer_fn`   -> the active SpanTracer or None
+      * `state_fn`    -> the current observability() dict
+
+    `notify(reason)` is the trigger path: cheap note always, bundle dump
+    at most once per `min_interval_s` per reason and at most
+    `max_bundles` total.  `dump(reason)` is unconditional (the explicit
+    `obs.dump_flight()` API).
+    """
+
+    def __init__(self, out_dir: Optional[str] = None, capacity: int = 2048,
+                 log_lines: int = 256, min_interval_s: float = 30.0,
+                 max_bundles: int = 16,
+                 registry_fn: Optional[Callable] = None,
+                 tracer_fn: Optional[Callable] = None,
+                 state_fn: Optional[Callable] = None):
+        self.out_dir = out_dir
+        self.min_interval_s = float(min_interval_s)
+        self.max_bundles = int(max_bundles)
+        self._registry_fn = registry_fn
+        self._tracer_fn = tracer_fn
+        self._state_fn = state_fn
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=int(capacity))
+        self._last_dump: Dict[str, float] = {}
+        self._seq = 0
+        self.bundles: List[str] = []
+        self.triggers = 0
+        self._log_tail = _LogTail(int(log_lines))
+        logging.getLogger("bigdl_tpu").addHandler(self._log_tail)
+
+    # -- recording (hot enough to stay tiny) -------------------------------
+
+    def note(self, kind: str, **details) -> None:
+        """Breadcrumb into the ring without any dump consideration."""
+        with self._lock:
+            self._ring.append((time.perf_counter_ns(), kind, details))
+
+    def notify(self, reason: str, **details) -> Optional[str]:
+        """A trigger fired.  Returns the bundle path if one was written."""
+        now = time.monotonic()
+        with self._lock:
+            self.triggers += 1
+            self._ring.append((time.perf_counter_ns(), reason, details))
+            last = self._last_dump.get(reason)
+            dump = (len(self.bundles) < self.max_bundles
+                    and (last is None or now - last >= self.min_interval_s))
+            if dump:
+                self._last_dump[reason] = now
+        reg = self._registry_fn() if self._registry_fn else None
+        if reg is not None:
+            reg.inc("flight/triggers_total")
+            reg.inc(f"flight/triggers_total|reason={reason}")
+        if not dump:
+            return None
+        return self.dump(reason, **details)
+
+    # -- bundle writer (cold path) -----------------------------------------
+
+    def _bundle_dir(self, reason: str) -> str:
+        base = self.out_dir
+        if base is None:
+            import tempfile
+
+            base = tempfile.mkdtemp(prefix="bigdl_tpu_flight_")
+            self.out_dir = base
+        os.makedirs(base, exist_ok=True)
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        slug = "".join(c if c.isalnum() else "_" for c in reason)[:48]
+        path = os.path.join(base, f"flight_{seq:03d}_{slug}")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def _fingerprint(self) -> Dict[str, Any]:
+        fp: Dict[str, Any] = {
+            "pid": os.getpid(),
+            "argv": list(sys.argv),
+            "python": sys.version.split()[0],
+            "cwd": os.getcwd(),
+            "env": {k: v for k, v in sorted(os.environ.items())
+                    if k.startswith(("BIGDL_TPU_", "JAX_", "XLA_"))},
+        }
+        try:
+            import jax
+
+            fp["jax"] = jax.__version__
+        except Exception:  # noqa: BLE001 — fingerprint must never fail
+            pass
+        if self._state_fn is not None:
+            fp["observability"] = self._state_fn()
+        return fp
+
+    def dump(self, reason: str, **details) -> str:
+        """Write one postmortem bundle; returns its directory path."""
+        path = self._bundle_dir(reason)
+        with self._lock:
+            ring = [{"ts_ns": t, "kind": k, "details": d}
+                    for t, k, d in self._ring]
+            log_lines = list(self._log_tail.ring)
+        manifest = {
+            "reason": reason, "details": details,
+            "unix_time": time.time(), "triggers_seen": self.triggers,
+            "bundle": os.path.basename(path),
+            "contents": ["MANIFEST.json", "fingerprint.json", "events.json",
+                         "log_tail.txt", "metrics.json", "trace.json"],
+        }
+        tr = self._tracer_fn() if self._tracer_fn else None
+        reg = self._registry_fn() if self._registry_fn else None
+        try:
+            with open(os.path.join(path, "MANIFEST.json"), "w") as f:
+                json.dump(manifest, f, indent=2)
+            with open(os.path.join(path, "fingerprint.json"), "w") as f:
+                json.dump(self._fingerprint(), f, indent=2)
+            with open(os.path.join(path, "events.json"), "w") as f:
+                json.dump(ring, f)
+            with open(os.path.join(path, "log_tail.txt"), "w") as f:
+                f.write("\n".join(log_lines) + ("\n" if log_lines else ""))
+            if reg is not None:
+                with open(os.path.join(path, "metrics.json"), "w") as f:
+                    json.dump(reg.snapshot(), f, indent=2, default=str)
+            # tracing off still yields a bundle whose trace.json simply
+            # carries no spans — consumers get one fixed file set either way
+            trace_doc = build_fleet_trace(tr) if tr is not None else {
+                "traceEvents": [], "displayTimeUnit": "ms",
+                "otherData": {"dropped_events": 0, "replica_lanes": {}}}
+            with open(os.path.join(path, "trace.json"), "w") as f:
+                json.dump(trace_doc, f)
+        except OSError:
+            logger.exception("flight recorder could not write bundle %s",
+                             path)
+        with self._lock:
+            self.bundles.append(path)
+        if reg is not None:
+            reg.inc("flight/dumps_total")
+        logger.warning("flight recorder: postmortem bundle for %r at %s",
+                       reason, path, extra={"reason": reason})
+        return path
+
+    def close(self) -> None:
+        logging.getLogger("bigdl_tpu").removeHandler(self._log_tail)

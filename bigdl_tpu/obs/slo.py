@@ -1,0 +1,274 @@
+"""Per-tenant SLO objectives, multi-window burn-rate alerting, goodput.
+
+The fleet exports per-tenant latency histograms and rejection counters
+(`serving/...{tenant="..."}`) but nothing watches them.  This module is
+the watcher, after the SRE-workbook multi-window pattern:
+
+  * an `SLOObjective` names the targets for one tenant — p99 latency,
+    deadline-miss rate, TTFT p99 for generation tenants — each with an
+    error budget (the tolerated fraction of bad requests; 1% for a p99
+    target by construction).
+  * `SloMonitor.tick()` snapshots the tenant's counters/histograms and
+    evaluates each objective as a burn rate over TWO windows — fast
+    (default 60 s: catches a cliff) and slow (default 1800 s: ignores a
+    blip) — where burn = observed bad-request rate / budget.  An alert
+    fires only when BOTH windows burn past their thresholds (fast 14x /
+    slow 6x, the page-worthy tier), increments `slo/alerts_total` (+
+    per-tenant label), lands in the trace as an `slo.alert` instant, and
+    re-arms once the fast window recovers.
+  * goodput — completed-in-deadline requests / everything dispatched —
+    exports as `slo/goodput{tenant=...}` per tick; the max burn rate
+    across tenants exports as `slo/burn_rate{tenant=...}` and feeds the
+    FleetAutoscaler's grow signal.
+
+Windowing is snapshot-delta: the monitor keeps a bounded deque of
+(t, counts) rows and differences against the oldest row inside each
+window, so cumulative counters work unchanged and nothing here needs a
+thread — tick from the autoscaler loop, a test, or any periodic caller.
+Everything is host-side arithmetic on already-host counters: zero
+device syncs, legal under `strict_transfers()`.
+
+The trainer-side `mfu_estimate` is the same discipline for training:
+model FLOPs/step (6 * params * rows for the standard fwd+bwd) over
+step time, against `BIGDL_TPU_PEAK_TFLOPS` when the operator declares
+the hardware peak.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger("bigdl_tpu.obs")
+
+__all__ = ["SLOObjective", "SloMonitor", "mfu_estimate"]
+
+
+class SLOObjective:
+    """Targets + error budget for one tenant.
+
+    Parameters
+    ----------
+    tenant : tenant name (matches the fleet's TenantConfig.name).
+    p99_ms : end-to-end latency target; a request slower than this is a
+        budget-burning "bad" request.  Budget 1% by construction (p99).
+    deadline_miss_rate : tolerated fraction of deadline rejections
+        (None disables the dimension).
+    ttft_p99_ms : time-to-first-token target for generation tenants.
+    budget : error budget for the latency dimensions (default 0.01).
+    """
+
+    def __init__(self, tenant: str, p99_ms: Optional[float] = None,
+                 deadline_miss_rate: Optional[float] = None,
+                 ttft_p99_ms: Optional[float] = None,
+                 budget: float = 0.01):
+        if p99_ms is None and deadline_miss_rate is None \
+                and ttft_p99_ms is None:
+            raise ValueError(f"objective for {tenant!r} has no targets")
+        self.tenant = tenant
+        self.p99_ms = p99_ms
+        self.deadline_miss_rate = deadline_miss_rate
+        self.ttft_p99_ms = ttft_p99_ms
+        self.budget = float(budget)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"SLOObjective({self.tenant!r}, p99_ms={self.p99_ms}, "
+                f"deadline_miss_rate={self.deadline_miss_rate}, "
+                f"ttft_p99_ms={self.ttft_p99_ms})")
+
+
+def _counts_for(metrics, obj: SLOObjective) -> Dict[str, float]:
+    """Cumulative counts the burn-rate math differences.  `metrics` is a
+    ServingMetrics or GenerationMetrics (duck-typed: histograms +
+    counters both expose the same names)."""
+    total_hist = getattr(metrics, "total_ms", None) \
+        or getattr(metrics, "e2e_ms", None)
+    row: Dict[str, float] = {
+        "completed": float(getattr(metrics, "requests_completed", 0)),
+        "deadline_rejected": float(getattr(metrics, "rejected_deadline", 0)),
+        "dispatched": float(getattr(metrics, "requests_completed", 0)
+                            + getattr(metrics, "rejected_deadline", 0)
+                            + getattr(metrics, "rejected_shutdown", 0)
+                            + getattr(metrics, "rejected_nonfinite", 0)),
+    }
+    if obj.p99_ms is not None and total_hist is not None:
+        row["slow"] = float(total_hist.count_above(obj.p99_ms))
+        row["latency_n"] = float(total_hist.count)
+    ttft = getattr(metrics, "ttft_ms", None)
+    if obj.ttft_p99_ms is not None and ttft is not None:
+        row["ttft_slow"] = float(ttft.count_above(obj.ttft_p99_ms))
+        row["ttft_n"] = float(ttft.count)
+    return row
+
+
+class SloMonitor:
+    """Multi-window burn-rate evaluator over per-tenant fleet metrics.
+
+    `source` maps a tenant name to its live metrics object — pass
+    `router.tenant_metrics` for the fleet, or any callable for direct
+    ServingMetrics/GenerationMetrics.  Call `tick()` periodically (the
+    autoscaler's signal closure is the natural place); pass `now` in
+    tests to script time.
+    """
+
+    def __init__(self, objectives: List[SLOObjective],
+                 source: Callable[[str], Any],
+                 fast_window_s: float = 60.0, slow_window_s: float = 1800.0,
+                 fast_burn_threshold: float = 14.0,
+                 slow_burn_threshold: float = 6.0,
+                 registry_fn: Optional[Callable] = None):
+        self.objectives = list(objectives)
+        self.source = source
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.fast_burn_threshold = float(fast_burn_threshold)
+        self.slow_burn_threshold = float(slow_burn_threshold)
+        self._registry_fn = registry_fn
+        # (t, {tenant: counts}) rows, bounded by the slow window
+        self._rows: deque = deque()
+        self._firing: Dict[str, bool] = {}  # "tenant/dimension" -> armed
+        self.alerts: List[Dict[str, Any]] = []
+
+    # ------------------------------------------------------------------
+
+    def _burn(self, cur: Dict[str, float], old: Dict[str, float],
+              bad_key: str, total_key: str, budget: float) -> float:
+        bad = cur.get(bad_key, 0.0) - old.get(bad_key, 0.0)
+        total = cur.get(total_key, 0.0) - old.get(total_key, 0.0)
+        if total <= 0.0:
+            return 0.0
+        return (bad / total) / max(budget, 1e-9)
+
+    def _window_rows(self, now: float, window_s: float,
+                     tenant: str) -> Optional[Dict[str, float]]:
+        """The snapshot closest to (at or before) the window start, so
+        the burn delta covers at least `window_s` of history — never a
+        stale superset when newer baselines exist.  When every row is
+        inside the window (cold start) the oldest row is the best
+        available baseline: the slow window means 'all time so far'."""
+        chosen = None
+        for t, per_tenant in self._rows:
+            if tenant not in per_tenant:
+                continue
+            if chosen is None or t <= now - window_s:
+                chosen = per_tenant[tenant]
+            if t > now - window_s:
+                break
+        return chosen
+
+    def tick(self, now: Optional[float] = None) -> Dict[str, Dict[str, Any]]:
+        """Evaluate every objective; returns {tenant: verdict}."""
+        now = time.monotonic() if now is None else float(now)
+        reg = self._registry_fn() if self._registry_fn else None
+        cur_row: Dict[str, Dict[str, float]] = {}
+        out: Dict[str, Dict[str, Any]] = {}
+        for obj in self.objectives:
+            metrics = self.source(obj.tenant)
+            if metrics is None:
+                continue
+            cur = _counts_for(metrics, obj)
+            cur_row[obj.tenant] = cur
+            dims: Dict[str, Dict[str, float]] = {}
+            if obj.p99_ms is not None:
+                dims["latency"] = {"bad": cur.get("slow", 0.0),
+                                   "n": cur.get("latency_n", 0.0),
+                                   "budget": obj.budget,
+                                   "bad_key": "slow",
+                                   "total_key": "latency_n"}
+            if obj.deadline_miss_rate is not None:
+                dims["deadline"] = {"budget": obj.deadline_miss_rate,
+                                    "bad_key": "deadline_rejected",
+                                    "total_key": "dispatched"}
+            if obj.ttft_p99_ms is not None:
+                dims["ttft"] = {"budget": obj.budget,
+                                "bad_key": "ttft_slow",
+                                "total_key": "ttft_n"}
+            verdict: Dict[str, Any] = {"alerts": [], "burn_fast": 0.0,
+                                       "burn_slow": 0.0}
+            fast_old = self._window_rows(now, self.fast_window_s, obj.tenant)
+            slow_old = self._window_rows(now, self.slow_window_s, obj.tenant)
+            zero: Dict[str, float] = {}
+            for dim, spec in dims.items():
+                burn_fast = self._burn(cur, fast_old or zero,
+                                       spec["bad_key"], spec["total_key"],
+                                       spec["budget"])
+                burn_slow = self._burn(cur, slow_old or zero,
+                                       spec["bad_key"], spec["total_key"],
+                                       spec["budget"])
+                verdict["burn_fast"] = max(verdict["burn_fast"], burn_fast)
+                verdict["burn_slow"] = max(verdict["burn_slow"], burn_slow)
+                key = f"{obj.tenant}/{dim}"
+                firing = (burn_fast >= self.fast_burn_threshold
+                          and burn_slow >= self.slow_burn_threshold)
+                if firing and not self._firing.get(key):
+                    self._firing[key] = True
+                    alert = {"tenant": obj.tenant, "dimension": dim,
+                             "burn_fast": round(burn_fast, 3),
+                             "burn_slow": round(burn_slow, 3)}
+                    verdict["alerts"].append(alert)
+                    self.alerts.append(alert)
+                    if reg is not None:
+                        reg.inc("slo/alerts_total")
+                        reg.inc(f"slo/alerts_total|tenant={obj.tenant}")
+                    from bigdl_tpu import obs as _obs
+
+                    _obs.instant("slo.alert", cat="slo", tenant=obj.tenant,
+                                 dimension=dim,
+                                 burn_fast=round(burn_fast, 3),
+                                 burn_slow=round(burn_slow, 3))
+                    logger.warning(
+                        "SLO burn-rate alert: tenant %r dimension %s "
+                        "burning %.1fx fast / %.1fx slow (thresholds "
+                        "%gx/%gx)", obj.tenant, dim, burn_fast, burn_slow,
+                        self.fast_burn_threshold, self.slow_burn_threshold,
+                        extra={"tenant": obj.tenant})
+                elif not firing and burn_fast < self.fast_burn_threshold:
+                    self._firing[key] = False  # re-arm once fast recovers
+            dispatched = cur.get("dispatched", 0.0)
+            goodput = (cur.get("completed", 0.0) / dispatched
+                       if dispatched else 1.0)
+            verdict["goodput"] = goodput
+            if reg is not None:
+                reg.set_gauge(f"slo/burn_rate|tenant={obj.tenant}",
+                              verdict["burn_fast"])
+                reg.set_gauge(f"slo/goodput|tenant={obj.tenant}", goodput)
+            out[obj.tenant] = verdict
+        self._rows.append((now, cur_row))
+        while self._rows and self._rows[0][0] < now - self.slow_window_s:
+            self._rows.popleft()
+        return out
+
+    def max_burn_rate(self) -> float:
+        """Latest max fast-window burn across tenants (autoscaler grow
+        signal; 0.0 before the first tick)."""
+        reg = self._registry_fn() if self._registry_fn else None
+        if reg is None:
+            return 0.0
+        burns = [v for k, v in reg.gauges().items()
+                 if k.startswith("slo/burn_rate")]
+        return max(burns) if burns else 0.0
+
+
+def mfu_estimate(n_params: int, rows: float, step_time_s: float,
+                 flops_per_row: Optional[float] = None,
+                 peak_flops: Optional[float] = None) -> Dict[str, float]:
+    """Step-time-derived model-FLOPs utilisation.
+
+    `flops_per_row` defaults to the standard dense fwd+bwd estimate
+    (6 * params); `peak_flops` defaults to `BIGDL_TPU_PEAK_TFLOPS` * 1e12
+    when set.  Returns {"model_flops_per_s": ..., "mfu": ...} with mfu
+    0.0 when no peak is declared (an estimate against an unknown peak is
+    noise, not a metric)."""
+    if step_time_s <= 0.0:
+        return {"model_flops_per_s": 0.0, "mfu": 0.0}
+    if flops_per_row is None:
+        flops_per_row = 6.0 * float(n_params)
+    achieved = flops_per_row * float(rows) / float(step_time_s)
+    if peak_flops is None:
+        peak_env = os.environ.get("BIGDL_TPU_PEAK_TFLOPS")
+        peak_flops = float(peak_env) * 1e12 if peak_env else 0.0
+    mfu = achieved / peak_flops if peak_flops else 0.0
+    return {"model_flops_per_s": achieved, "mfu": mfu}

@@ -60,10 +60,19 @@ class _SpanCtx:
 
 
 class SpanTracer:
-    """Bounded in-memory trace ring with Chrome-trace export."""
+    """Bounded in-memory trace ring with Chrome-trace export.
 
-    def __init__(self, capacity: int = 65536):
+    `lane` / `lane_name` give the tracer an explicit pid-like lane: a
+    merged fleet trace holds one SpanTracer per replica, and without an
+    explicit lane every ring would export under the same os.getpid() and
+    collide on tid.  `lane_name` becomes `M process_name` metadata so
+    Perfetto shows "replica:r0" instead of a bare number."""
+
+    def __init__(self, capacity: int = 65536, lane: Optional[int] = None,
+                 lane_name: Optional[str] = None):
         self.capacity = int(capacity)
+        self.lane = lane
+        self.lane_name = lane_name
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=self.capacity)
         self.dropped = 0
@@ -102,14 +111,17 @@ class SpanTracer:
             self._ring.clear()
             self.dropped = 0
 
-    def to_chrome(self) -> Dict[str, Any]:
+    def to_chrome(self, epoch_ns: Optional[int] = None) -> Dict[str, Any]:
         """Chrome-trace dict: spans as "X", instants as "i", one
-        thread_name metadata event per lane."""
-        pid = os.getpid()
+        thread_name metadata event per lane (+ a process_name metadata
+        event when the tracer carries an explicit lane).  `epoch_ns`
+        overrides the tracer's own epoch so rings from several tracers
+        in one process export onto a shared timeline."""
+        pid = self.lane if self.lane is not None else os.getpid()
         events = self.events()
         out: List[Dict[str, Any]] = []
         lanes: Dict[int, str] = {}
-        epoch = self._epoch_ns
+        epoch = self._epoch_ns if epoch_ns is None else int(epoch_ns)
         for kind, name, cat, tid, tname, ts_ns, dur_ns, args in events:
             lanes.setdefault(tid, tname)
             ev: Dict[str, Any] = {
@@ -123,8 +135,13 @@ class SpanTracer:
             if args:
                 ev["args"] = dict(args)
             out.append(ev)
-        meta = [{"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
-                 "args": {"name": tname}} for tid, tname in lanes.items()]
+        meta: List[Dict[str, Any]] = []
+        if self.lane_name is not None:
+            meta.append({"ph": "M", "name": "process_name", "pid": pid,
+                         "tid": 0, "args": {"name": self.lane_name}})
+        meta.extend({"ph": "M", "name": "thread_name", "pid": pid,
+                     "tid": tid, "args": {"name": tname}}
+                    for tid, tname in lanes.items())
         return {"traceEvents": meta + out, "displayTimeUnit": "ms",
                 "otherData": {"dropped_events": self.dropped}}
 

@@ -332,6 +332,7 @@ class Optimizer:
         self._adopted_params = self.params is not None
         self.opt_state = None
         self.metrics = Metrics()
+        self._n_params: Optional[int] = None  # cached for the MFU gauge
         self._compiled = None
         self._compiled_key = None
         # AOT executables resolved through bigdl_tpu.compilecache (None
@@ -1249,6 +1250,19 @@ class Optimizer:
                 obs_reg.inc("train/steps")
                 obs_reg.set_gauge("train/loss", loss_f)
                 obs_reg.set_gauge("train/throughput", throughput)
+                # step-time-derived MFU: param count is host shape
+                # metadata (no device sync), peak comes from
+                # BIGDL_TPU_PEAK_TFLOPS — without a declared peak only
+                # the achieved model-FLOPs gauge exports
+                if self._n_params is None:
+                    self._n_params = sum(
+                        int(l.size) for l in
+                        jax.tree_util.tree_leaves(self.params))
+                est = _obs.mfu_estimate(self._n_params, bs, per_step)
+                obs_reg.set_gauge("train/model_flops_per_s",
+                                  est["model_flops_per_s"])
+                if est["mfu"]:
+                    obs_reg.set_gauge("train/mfu", est["mfu"])
                 obs_reg.set_gauge("feed/stall_ms", stall_s * 1e3)
                 obs_reg.set_gauge("feed/occupancy", occ)
                 # driver log (reference: DistriOptimizer.scala:402-407);

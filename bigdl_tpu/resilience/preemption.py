@@ -116,6 +116,11 @@ class PreemptionGuard:
             self._reason = reason
             self._flag.set()
             logger.warning("preemption requested: %s", reason)
+            # SIGTERM postmortem: the grace window is the last chance to
+            # capture what the run looked like when the eviction landed
+            from bigdl_tpu import obs as _obs
+
+            _obs.flight_notify("preemption", cause=reason)
 
     def requested(self) -> bool:
         """Polled once per batch by the trainer: flag check + rate-limited

@@ -116,7 +116,8 @@ class _Future:
 class _Request:
     __slots__ = ("x", "rows", "future", "t_enqueue", "deadline", "cid")
 
-    def __init__(self, x: Any, rows: int, deadline: Optional[float]):
+    def __init__(self, x: Any, rows: int, deadline: Optional[float],
+                 cid: Optional[str] = None):
         self.x = x
         self.rows = rows
         self.future = _Future()
@@ -124,8 +125,10 @@ class _Request:
         self.deadline = deadline  # absolute perf_counter time, or None
         # correlation id: stitches this request across the submitter
         # thread, the batcher lane and the dispatch lane in the trace,
-        # and lands in future.meta + the driver log
-        self.cid = _obs.next_cid()
+        # and lands in future.meta + the driver log.  The fleet router
+        # passes its own cid down so ONE id follows a request across
+        # replicas (including redispatch); direct submits mint here.
+        self.cid = cid if cid is not None else _obs.next_cid()
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
@@ -173,7 +176,8 @@ class MicroBatcher:
     # -- admission ---------------------------------------------------------
 
     def submit(self, x: Any, rows: int,
-               deadline_ms: Optional[float] = None) -> _Future:
+               deadline_ms: Optional[float] = None,
+               cid: Optional[str] = None) -> _Future:
         if rows < 1 or rows > self.buckets[-1]:
             raise ValueError(
                 f"request rows {rows} outside [1, {self.buckets[-1]}] "
@@ -186,7 +190,7 @@ class MicroBatcher:
             deadline_ms = self.default_deadline_ms
         deadline = (time.perf_counter() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
-        req = _Request(x, rows, deadline)
+        req = _Request(x, rows, deadline, cid=cid)
         try:
             self._queue.put_nowait(req)
         except queue.Full:

@@ -66,6 +66,14 @@ class LatencyHistogram:
     def max_ms(self) -> float:
         return self._max_ms
 
+    def count_above(self, ms: float) -> int:
+        """Samples in buckets whose lower edge is >= `ms` (slightly
+        conservative: the bucket straddling `ms` does not count).  The
+        SLO burn-rate evaluator differences this against a prior
+        snapshot to get bad-request counts per window."""
+        jmin = bisect.bisect_left(self._edge_list, ms) + 1
+        return int(self._counts[jmin:].sum())
+
     def percentile(self, q: float) -> float:
         """q in [0, 100].  Returns the upper edge of the bucket holding the
         q-th sample (conservative: never understates latency)."""

@@ -302,9 +302,13 @@ class ServingRuntime:
                            queue_ms=round(req.future.meta["queue_ms"], 3))
             req.future.set_result(out)
 
-    def submit(self, x: Any, deadline_ms: Optional[float] = None):
-        """Async admission: returns a future (result(timeout=...))."""
-        return self._batcher.submit(x, _batch_rows(x), deadline_ms=deadline_ms)
+    def submit(self, x: Any, deadline_ms: Optional[float] = None,
+               cid: Optional[str] = None):
+        """Async admission: returns a future (result(timeout=...)).
+        `cid` overrides the minted correlation id (the fleet router
+        passes its own so one id spans replicas)."""
+        return self._batcher.submit(x, _batch_rows(x),
+                                    deadline_ms=deadline_ms, cid=cid)
 
     def predict(self, x: Any, deadline_ms: Optional[float] = None,
                 timeout: Optional[float] = 60.0) -> Any:

@@ -491,6 +491,58 @@ def test_autoscaler_respects_max_replicas(fresh_registry):
     assert "grow" not in decisions
 
 
+def test_autoscaler_grows_on_slo_burn_rate(fresh_registry):
+    # queue shallow and p99 healthy, but a tenant is burning its error
+    # budget: the burn-rate gauge alone must drive the grow streak
+    fleet = FakeFleet(1)
+    sigs = [dict(_sig(depth=0, p99=0), slo_burn_rate=20.0)] * 3
+    auto = _autoscaler(fleet, sigs)
+    decisions = [auto.tick() for _ in range(3)]
+    assert decisions == ["hold", "hold", "grow"]
+    assert fleet.n == 2
+
+
+def test_autoscaler_burn_blocks_retire(fresh_registry):
+    # a burning tenant is never "low load" no matter how empty the queue
+    fleet = FakeFleet(2)
+    sigs = [dict(_sig(depth=0, p99=0), slo_burn_rate=20.0)] * 8
+    auto = _autoscaler(fleet, sigs, max_replicas=2)
+    decisions = [auto.tick() for _ in range(8)]
+    assert "shrink" not in decisions
+    assert fleet.n == 2
+
+
+def test_slo_monitor_over_router_feeds_autoscaler_signal(fresh_registry):
+    from bigdl_tpu.obs import SLOObjective, SloMonitor
+
+    router = FleetRouter(lambda name: EchoRuntime(), n_replicas=1,
+                         tenants=[TenantConfig("t")])
+    try:
+        assert router.tenant_metrics("nope") is None
+        m = router.tenant_metrics("t")
+        assert isinstance(m, ServingMetrics)
+        mon = SloMonitor([SLOObjective("t", p99_ms=50.0)],
+                         source=router.tenant_metrics,
+                         registry_fn=obs.registry)
+        mon.tick(now=0.0)
+        # a latency cliff on the live tenant metrics
+        for _ in range(20):
+            m.on_complete(queue_ms=1.0, total_ms=500.0, depth=0)
+        out = mon.tick(now=10.0)
+        assert out["t"]["alerts"], out
+        assert fresh_registry.get("slo/alerts_total|tenant=t") == 1
+        # ...surfaces through the autoscaler's live signal closure
+        auto = FleetAutoscaler(router, AutoscalerConfig(
+            min_replicas=1, max_replicas=2, grow_after=1, shrink_after=99,
+            cooldown_ticks=0, high_queue_depth=1e9, high_p99_ms=1e9))
+        sig = auto._default_signals()
+        assert sig["slo_burn_rate"] >= auto.config.high_burn_rate
+        assert auto.tick() == "grow"
+        assert router.n_replicas() == 2
+    finally:
+        router.close()
+
+
 # -- Prometheus tenant label dimension -------------------------------------
 
 
@@ -576,6 +628,87 @@ def test_replica_kill_mid_burst_zero_lost(small_model, fresh_registry,
         assert done == len(futs)
     finally:
         router.close()
+
+
+def test_kill_mid_burst_stitched_trace_one_cid_one_bundle(
+        small_model, fresh_registry, cache_root, tmp_path):
+    """The flight-recorder acceptance bar: a replica dies mid-burst and
+    the black box yields (a) exactly ONE postmortem bundle naming the
+    trigger, (b) a stitched trace whose flow chain follows the bounced
+    request admit -> dispatch(A) -> redispatch -> dispatch(B) ->
+    complete across lanes, and (c) ONE cid on the future across the
+    redispatch, counted per tenant."""
+    import json
+    import os
+
+    flight_dir = str(tmp_path / "flight")
+    # fresh compile monitor: signatures settled by earlier tests must not
+    # classify THIS test's warmup compiles as steady recompiles
+    obs.set_observability(tracing=True, compile_monitor=True,
+                          flight=True, flight_dir=flight_dir)
+    router = FleetRouter(_serving_factory(small_model), n_replicas=2,
+                         tenants=[TenantConfig("bulk", tier="batch"),
+                                  TenantConfig("chat", tier="interactive")])
+    router.set_chaos(ReplicaKillFault(at_dispatch=5))
+    try:
+        before = {t.name for t in threading.enumerate()
+                  if not t.name.startswith("fleet-reaper")}
+        futs = []
+        for i in range(24):
+            tenant = "chat" if i % 3 == 0 else "bulk"
+            futs.append(router.submit(tenant, _row(i), deadline_ms=60_000))
+        assert all(f.result(60).shape == (1, 4) for f in futs)
+
+        # ONE cid per request, held across the redispatch
+        cids = [f.meta["cid"] for f in futs]
+        assert len(set(cids)) == len(futs)
+        bounced = [f for f in futs if f.meta["attempts"] > 1]
+        assert bounced, "the kill must strand at least one request"
+        n_redis = sum(
+            fresh_registry.get(f"fleet/redispatches|tenant={t}")
+            for t in ("bulk", "chat"))
+        assert n_redis == fresh_registry.get("fleet/redispatched") > 0
+
+        # the bounced cid's timeline names both replicas
+        cid = bounced[0].meta["cid"]
+        tl = obs.request_timeline(cid)
+        assert tl["redispatches"] >= 1
+        assert len(set(tl["replicas"])) == 2
+        hop_names = [h["name"] for h in tl["hops"]]
+        for expected in ("fleet.admit", "fleet.dispatch", "fleet.redispatch",
+                         "fleet.complete"):
+            assert expected in hop_names, hop_names
+
+        # stitched trace: valid JSON, replica lanes, cross-lane flow
+        doc = obs.export_fleet_trace(str(tmp_path / "fleet_trace.json"))
+        with open(tmp_path / "fleet_trace.json") as f:
+            assert json.load(f) == doc
+        lanes = doc["otherData"]["replica_lanes"]
+        assert sum(1 for n in lanes.values()
+                   if n.startswith("replica:")) == 2
+        flow = [e for e in doc["traceEvents"]
+                if e.get("id") == cid and e["name"] == "fleet.request"]
+        assert [e["ph"] for e in flow] == \
+            ["s"] + ["t"] * (len(flow) - 2) + ["f"]
+        assert len({e["pid"] for e in flow}) >= 2  # crosses lanes
+
+        # exactly ONE bundle for the death (dedup ate the per-request
+        # bounces), and its trace round-trips as JSON
+        bundles = [d for d in os.listdir(flight_dir)
+                   if "fleet_replica_death" in d]
+        assert len(bundles) == 1
+        with open(os.path.join(flight_dir, bundles[0],
+                               "MANIFEST.json")) as f:
+            assert json.load(f)["reason"] == "fleet.replica_death"
+        with open(os.path.join(flight_dir, bundles[0], "trace.json")) as f:
+            assert json.load(f)["traceEvents"]
+    finally:
+        router.close()
+        obs.set_observability(tracing=False, flight=False)
+    # no thread leaks: the recorder and stitcher added zero threads
+    _wait_until(lambda: {t.name for t in threading.enumerate()
+                         if not t.name.startswith("fleet-reaper")} <= before,
+                msg="fleet threads torn down")
 
 
 def test_routed_output_bitwise_equals_direct(small_model, fresh_registry):

@@ -13,6 +13,7 @@ the tracer itself adds no device syncs.
 
 import json
 import logging
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -381,7 +382,7 @@ def test_set_observability_gating(fresh_obs):
     assert obs.registry().get("x") == 0
     state = obs.set_observability(metrics=True, tracing=True)
     assert state == {"metrics": True, "tracing": True,
-                     "compile_monitor": True}
+                     "compile_monitor": True, "flight": False}
     assert isinstance(obs.registry(), MetricsRegistry)
     assert obs.tracer() is not None
     # fresh ring on re-enable, not the old one
@@ -396,15 +397,18 @@ def test_env_gating(monkeypatch):
         monkeypatch.setenv("BIGDL_TPU_OBS", "0")
         _init_from_env()
         assert obs.observability() == {"metrics": False, "tracing": False,
-                                       "compile_monitor": False}
+                                       "compile_monitor": False,
+                                       "flight": False}
         monkeypatch.setenv("BIGDL_TPU_OBS", "trace")
         _init_from_env()
         assert obs.observability() == {"metrics": True, "tracing": True,
-                                       "compile_monitor": True}
+                                       "compile_monitor": True,
+                                       "flight": False}
         monkeypatch.delenv("BIGDL_TPU_OBS")
         _init_from_env()
         assert obs.observability() == {"metrics": True, "tracing": False,
-                                       "compile_monitor": True}
+                                       "compile_monitor": True,
+                                       "flight": False}
     finally:
         obs.set_observability(metrics=True, tracing=False,
                               compile_monitor=True)
@@ -549,3 +553,386 @@ def test_traced_training_run_spans_and_metrics(fresh_obs, tmp_path):
     doc = obs.export_trace(str(tmp_path / "train_trace.json"))
     with open(tmp_path / "train_trace.json") as f:
         assert json.load(f) == doc
+    # step-time-derived MFU plumbing: FLOPs/s gauge always; the mfu
+    # ratio only appears when BIGDL_TPU_PEAK_TFLOPS declares a peak
+    assert reg.get("train/model_flops_per_s") > 0
+
+
+# -- flight recorder (postmortem bundles) ----------------------------------
+
+
+BUNDLE_FILES = ("MANIFEST.json", "fingerprint.json", "events.json",
+                "log_tail.txt", "metrics.json", "trace.json")
+
+
+@pytest.fixture()
+def flight_obs(tmp_path):
+    """Metrics + tracing + flight recorder on, bundles under tmp_path;
+    everything restored (flight OFF) afterwards."""
+    old_reg = obs.set_registry(MetricsRegistry())
+    obs.set_observability(metrics=True, tracing=True, compile_monitor=True,
+                          flight=True, flight_dir=str(tmp_path / "flight"),
+                          flight_min_interval_s=30.0)
+    yield str(tmp_path / "flight")
+    obs.set_observability(metrics=True, tracing=False, compile_monitor=True,
+                          flight=False)
+    obs.set_registry(old_reg)
+
+
+def _bundles(flight_dir):
+    import os
+    if not os.path.isdir(flight_dir):
+        return []
+    return sorted(d for d in os.listdir(flight_dir)
+                  if d.startswith("flight_"))
+
+
+def test_dump_flight_writes_complete_bundle(flight_obs):
+    import os
+
+    obs.instant("fleet.admit", cat="fleet", cid="r-x", tenant="t")
+    logging.getLogger("bigdl_tpu.obs").warning("something telling")
+    path = obs.dump_flight("manual_test", detail=42)
+    assert path is not None and os.path.isdir(path)
+    for name in BUNDLE_FILES:
+        assert os.path.exists(os.path.join(path, name)), name
+    with open(os.path.join(path, "MANIFEST.json")) as f:
+        manifest = json.load(f)
+    assert manifest["reason"] == "manual_test"
+    assert manifest["details"] == {"detail": 42}
+    # the stitched trace in the bundle is VALID Chrome-trace JSON
+    with open(os.path.join(path, "trace.json")) as f:
+        doc = json.load(f)
+    assert isinstance(doc["traceEvents"], list)
+    for ev in doc["traceEvents"]:
+        for field in ("ph", "name", "pid", "tid"):
+            assert field in ev, ev
+    # fingerprint names the observability state that produced the bundle
+    with open(os.path.join(path, "fingerprint.json")) as f:
+        fp = json.load(f)
+    assert fp["observability"]["flight"] is True
+    assert "env" in fp and "python" in fp
+    # the log tail carries the driver log line emitted above
+    with open(os.path.join(path, "log_tail.txt")) as f:
+        assert "something telling" in f.read()
+    assert obs.registry().get("flight/dumps_total") == 1
+
+
+def test_flight_notify_dedupes_per_reason(flight_obs):
+    # one incident = one bundle: the second trigger inside the window
+    # notes but does not dump; a DIFFERENT reason dumps immediately
+    first = obs.flight_notify("fleet.replica_death", replica="r0")
+    second = obs.flight_notify("fleet.replica_death", replica="r0")
+    other = obs.flight_notify("watchdog.stall", phase="feed_next")
+    assert first is not None and second is None and other is not None
+    assert len(_bundles(flight_obs)) == 2
+    reg = obs.registry()
+    assert reg.get("flight/triggers_total") == 3
+    assert reg.get("flight/triggers_total|reason=fleet.replica_death") == 2
+    assert reg.get("flight/dumps_total") == 2
+
+
+def test_flight_noop_when_off(tmp_path):
+    obs.set_observability(flight=False)
+    assert obs.flight_recorder() is None
+    assert obs.flight_notify("anything") is None
+    assert obs.dump_flight("anything") is None
+
+
+def test_preemption_trigger_dumps_one_bundle(flight_obs):
+    """SIGTERM path: PreemptionGuard.trigger must produce a bundle — and
+    must NOT raise into the trainer's retry ladder (a kwarg collision
+    here once rolled the loop back to the last checkpoint)."""
+    from bigdl_tpu.resilience.preemption import PreemptionGuard
+
+    guard = PreemptionGuard(signals=())
+    guard.trigger("chaos: eviction notice")
+    assert guard.requested()
+    bundles = _bundles(flight_obs)
+    assert len(bundles) == 1
+    with open(f"{flight_obs}/{bundles[0]}/MANIFEST.json") as f:
+        manifest = json.load(f)
+    assert manifest["reason"] == "preemption"
+    assert manifest["details"] == {"cause": "chaos: eviction notice"}
+
+
+def test_flight_bundle_complete_with_tracing_off(tmp_path):
+    """The incident posture docs recommend — flight ON, tracing OFF —
+    must still dump the full six-file bundle; trace.json just carries
+    no spans."""
+    flight_dir = str(tmp_path / "flight")
+    obs.set_observability(metrics=True, tracing=False,
+                          flight=True, flight_dir=flight_dir)
+    try:
+        bundle = obs.dump_flight("manual.notrace")
+        for name in BUNDLE_FILES:
+            assert os.path.exists(os.path.join(bundle, name)), name
+        with open(os.path.join(bundle, "trace.json")) as f:
+            doc = json.load(f)
+        assert doc["traceEvents"] == []
+        assert doc["otherData"]["replica_lanes"] == {}
+    finally:
+        obs.set_observability(flight=False)
+
+
+def test_steady_recompile_alarm_dumps_one_bundle(flight_obs):
+    mon = obs.compile_monitor()
+    with mon.attribute("t/step"):
+        mon.on_compile(0.25)  # warmup
+    with mon.attribute("t/step"):
+        pass  # settles
+    with mon.attribute("t/step"):
+        mon.on_compile(0.05)  # steady-state recompile: the alarm
+        mon.on_compile(0.04)  # same incident, deduped by reason
+    bundles = _bundles(flight_obs)
+    assert len(bundles) == 1
+    assert "compile_steady_recompile" in bundles[0]
+    with open(f"{flight_obs}/{bundles[0]}/MANIFEST.json") as f:
+        assert json.load(f)["reason"] == "compile.steady_recompile"
+
+
+def test_watchdog_rollback_dumps_one_bundle(flight_obs):
+    from bigdl_tpu.health.watchdog import (
+        DivergenceWatchdog,
+        NumericDivergence,
+        WatchdogConfig,
+    )
+
+    wd = DivergenceWatchdog(WatchdogConfig(
+        skip_limit=0, max_backoffs=0, max_rollbacks=1, hang_deadlines=None))
+    with pytest.raises(NumericDivergence):
+        wd.observe(3, False)  # straight to rollback
+    bundles = _bundles(flight_obs)
+    assert len(bundles) == 1
+    with open(f"{flight_obs}/{bundles[0]}/MANIFEST.json") as f:
+        manifest = json.load(f)
+    assert manifest["reason"] == "watchdog.rollback"
+    assert manifest["details"] == {"step": 3}
+
+
+def test_flight_recorder_leaves_no_threads(flight_obs):
+    # the recorder is passive (notes + dumps on the caller's thread):
+    # enabling it must not add a single thread
+    before = {t.name for t in threading.enumerate()}
+    obs.flight_notify("fleet.replica_death", replica="r9")
+    obs.dump_flight("thread_check")
+    after = {t.name for t in threading.enumerate()}
+    assert after == before
+
+
+def test_flight_note_legal_under_strict_transfers(flight_obs):
+    f = jax.jit(lambda x: x * 3)
+    x = jax.device_put(jnp.ones((4,), jnp.float32))
+    f(x)  # compile OUTSIDE the guard
+    fr = obs.flight_recorder()
+    with strict_transfers(True):
+        fr.note("hot.breadcrumb", step=1)
+        y = f(x)
+        fr.note("hot.breadcrumb", step=2)
+    assert float(jax.device_get(y)[0]) == 3.0
+
+
+# -- cross-replica trace stitching -----------------------------------------
+
+
+def test_tracer_lane_and_process_name_metadata():
+    tr = SpanTracer(capacity=64, lane=7, lane_name="replica:r7")
+    with tr.span("work", cat="t"):
+        pass
+    doc = tr.to_chrome()
+    assert all(ev["pid"] == 7 for ev in doc["traceEvents"])
+    pn = [e for e in doc["traceEvents"]
+          if e["ph"] == "M" and e["name"] == "process_name"]
+    assert pn and pn[0]["args"]["name"] == "replica:r7"
+    # epoch override rebases timestamps onto a shared zero for merging
+    ev = next(e for e in doc["traceEvents"] if e["name"] == "work")
+    rebased = tr.to_chrome(epoch_ns=tr._epoch_ns - 1_000_000)
+    ev2 = next(e for e in rebased["traceEvents"] if e["name"] == "work")
+    assert ev2["ts"] == pytest.approx(ev["ts"] + 1000.0)
+
+
+def test_fleet_trace_stitching_lanes_and_flows(fresh_obs):
+    # synthesize the router's lifecycle instants for two requests served
+    # by different replicas; the stitcher must put serve.* events on the
+    # replica's pid lane and link each cid with s/t/f flow events
+    tr = obs.tracer()
+    for cid, rep in (("r-1", "a"), ("r-2", "b")):
+        tr.instant("fleet.admit", cat="fleet", cid=cid, tenant="t")
+        tr.instant("fleet.dispatch", cat="fleet", cid=cid, replica=rep,
+                   tenant="t", attempt=0)
+        with tr.span("serve.dispatch", cat="serving", cids=[cid]):
+            time.sleep(0.001)
+        tr.instant("serve.complete", cat="serving", cid=cid)
+        tr.instant("fleet.complete", cat="fleet", cid=cid, tenant="t",
+                   replica=rep, attempts=1)
+    doc = obs.export_fleet_trace()
+    lanes = doc["otherData"]["replica_lanes"]
+    assert set(lanes.values()) >= {"fleet-router", "replica:a", "replica:b"}
+    lane_of = {name: int(pid) for pid, name in lanes.items()}
+    evs = doc["traceEvents"]
+    # router events on the router lane, serve.* on the owning replica's
+    for ev in evs:
+        if ev["ph"] == "M" or ev["name"] == "fleet.request":
+            continue
+        if ev["name"].startswith("fleet."):
+            assert ev["pid"] == lane_of["fleet-router"], ev
+    d1 = next(e for e in evs if e["name"] == "serve.dispatch"
+              and "r-1" in e["args"]["cids"])
+    assert d1["pid"] == lane_of["replica:a"]
+    d2 = next(e for e in evs if e["name"] == "serve.dispatch"
+              and "r-2" in e["args"]["cids"])
+    assert d2["pid"] == lane_of["replica:b"]
+    # one s...f flow chain per cid, crossing router -> replica lanes
+    for cid in ("r-1", "r-2"):
+        flow = [e for e in evs if e.get("id") == cid
+                and e["name"] == "fleet.request"]
+        assert [e["ph"] for e in flow] == \
+            ["s"] + ["t"] * (len(flow) - 2) + ["f"]
+        assert flow[-1]["bp"] == "e"
+        assert len({e["pid"] for e in flow}) >= 2
+
+
+def test_request_timeline_breakdown(fresh_obs):
+    tr = obs.tracer()
+    tr.instant("fleet.admit", cat="fleet", cid="r-9", tenant="t")
+    time.sleep(0.002)
+    tr.instant("fleet.dispatch", cat="fleet", cid="r-9", replica="a",
+               tenant="t", attempt=0)
+    tr.instant("fleet.redispatch", cat="fleet", cid="r-9", tenant="t",
+               from_replica="a", attempt=1)
+    tr.instant("fleet.dispatch", cat="fleet", cid="r-9", replica="b",
+               tenant="t", attempt=1)
+    with tr.span("serve.dispatch", cat="serving", cids=["r-9"]):
+        time.sleep(0.001)
+    tr.instant("serve.complete", cat="serving", cid="r-9")
+    tr.instant("fleet.complete", cat="fleet", cid="r-9", tenant="t",
+               replica="b", attempts=2)
+    tl = obs.request_timeline("r-9")
+    assert tl["cid"] == "r-9"
+    assert tl["redispatches"] == 1
+    assert tl["replicas"] == ["a", "b"]
+    assert tl["queue_wait_ms"] >= 2.0
+    assert tl["device_ms"] >= 1.0
+    assert tl["settle_ms"] is not None and tl["total_ms"] > 0
+    assert [h["name"] for h in tl["hops"]][0] == "fleet.admit"
+    assert [h["name"] for h in tl["hops"]][-1] == "fleet.complete"
+    # tracing off -> {} (the documented cold answer, not an exception)
+    assert obs.request_timeline("nope")["hops"] == []
+
+
+# -- SLO burn-rate alerting ------------------------------------------------
+
+
+class _FakeHist:
+    def __init__(self):
+        self.count = 0
+        self.slow = 0
+
+    def add(self, n, slow=0):
+        self.count += n
+        self.slow += slow
+
+    def count_above(self, ms):
+        return self.slow
+
+
+class _FakeMetrics:
+    def __init__(self):
+        self.total_ms = _FakeHist()
+        self.requests_completed = 0
+        self.rejected_deadline = 0
+        self.rejected_shutdown = 0
+        self.rejected_nonfinite = 0
+
+
+def test_slo_burn_alert_fires_and_rearms(fresh_obs):
+    from bigdl_tpu.obs import SLOObjective, SloMonitor
+
+    m = _FakeMetrics()
+    mon = SloMonitor([SLOObjective("chat", p99_ms=50.0, budget=0.01)],
+                     source=lambda t: m, fast_window_s=60,
+                     slow_window_s=600, registry_fn=obs.registry)
+    # healthy baseline: 100 requests, none slow
+    m.total_ms.add(100)
+    m.requests_completed = 100
+    out = mon.tick(now=0.0)
+    assert out["chat"]["alerts"] == []
+    assert obs.registry().get("slo/burn_rate|tenant=chat") == 0.0
+    # latency cliff: 50 of the next 100 blow the p99 target -> burn
+    # (50/100)/0.01 = 50x on both windows -> page
+    m.total_ms.add(100, slow=50)
+    m.requests_completed = 200
+    out = mon.tick(now=10.0)
+    assert len(out["chat"]["alerts"]) == 1
+    assert out["chat"]["alerts"][0]["dimension"] == "latency"
+    assert out["chat"]["burn_fast"] == pytest.approx(50.0)
+    assert obs.registry().get("slo/alerts_total") == 1
+    assert obs.registry().get("slo/alerts_total|tenant=chat") == 1
+    # still burning next tick: NO duplicate alert while firing
+    m.total_ms.add(10, slow=5)
+    m.requests_completed = 210
+    out = mon.tick(now=20.0)
+    assert out["chat"]["alerts"] == []
+    assert obs.registry().get("slo/alerts_total") == 1
+    # recovery re-arms, a second cliff pages again
+    m.total_ms.add(200)
+    m.requests_completed = 410
+    mon.tick(now=100.0)
+    m.total_ms.add(100, slow=60)
+    m.requests_completed = 510
+    out = mon.tick(now=110.0)
+    assert len(out["chat"]["alerts"]) == 1
+    assert obs.registry().get("slo/alerts_total") == 2
+    # the alert landed in the trace as an instant
+    assert _events_named(obs.tracer(), "slo.alert")
+
+
+def test_slo_goodput_and_deadline_dimension(fresh_obs):
+    from bigdl_tpu.obs import SLOObjective, SloMonitor
+
+    m = _FakeMetrics()
+    mon = SloMonitor(
+        [SLOObjective("bulk", deadline_miss_rate=0.05)],
+        source=lambda t: m, fast_window_s=60, slow_window_s=600,
+        registry_fn=obs.registry)
+    m.requests_completed = 90
+    m.rejected_deadline = 10  # 10% missed vs 5% tolerated -> 2x burn
+    out = mon.tick(now=0.0)
+    assert out["bulk"]["goodput"] == pytest.approx(0.9)
+    assert out["bulk"]["burn_fast"] == pytest.approx(2.0)
+    assert out["bulk"]["alerts"] == []  # 2x is below the page tier
+    assert obs.registry().get("slo/goodput|tenant=bulk") == \
+        pytest.approx(0.9)
+    assert mon.max_burn_rate() == pytest.approx(2.0)
+
+
+def test_slo_objective_requires_a_target():
+    from bigdl_tpu.obs import SLOObjective
+
+    with pytest.raises(ValueError):
+        SLOObjective("t")
+
+
+def test_latency_histogram_count_above():
+    from bigdl_tpu.serving.metrics import LatencyHistogram
+
+    h = LatencyHistogram()
+    for ms in (1.0, 2.0, 40.0, 900.0):
+        h.observe(ms)
+    assert h.count == 4
+    assert h.count_above(1e9) == 0
+    assert h.count_above(0.0) == 4
+    # conservative: only buckets entirely above the threshold count
+    assert 1 <= h.count_above(100.0) <= 2
+
+
+def test_mfu_estimate():
+    est = obs.mfu_estimate(1_000_000, rows=32, step_time_s=0.01,
+                           peak_flops=1e12)
+    assert est["model_flops_per_s"] == pytest.approx(6e6 * 32 / 0.01)
+    assert est["mfu"] == pytest.approx(est["model_flops_per_s"] / 1e12)
+    # no declared peak: FLOPs/s still reported, mfu suppressed to 0
+    est = obs.mfu_estimate(1_000_000, rows=32, step_time_s=0.01)
+    assert est["model_flops_per_s"] > 0 and est["mfu"] == 0.0
+    assert obs.mfu_estimate(10, 1, 0.0) == \
+        {"model_flops_per_s": 0.0, "mfu": 0.0}

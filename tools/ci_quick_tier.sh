@@ -102,6 +102,20 @@ if [ "$rc" -eq 0 ]; then
     elapsed=$(( $(date +%s) - start ))
 fi
 
+if [ "$rc" -eq 0 ]; then
+    # flight-recorder lane: the same 2x2 fleet with the black box armed
+    # and a replica killed mid-burst — the incident must leave exactly
+    # ONE postmortem bundle naming the trigger, the stitched fleet trace
+    # must link the bounced request's admit -> dispatch -> redispatch ->
+    # complete chain across lanes, and the SloMonitor must page a
+    # burn-rate alert for the affected tenant
+    remaining=$(( BUDGET - elapsed ))
+    [ "$remaining" -lt 30 ] && remaining=30
+    timeout --signal=TERM "$remaining" python tools/obs_smoke.py --fleet
+    rc=$?
+    elapsed=$(( $(date +%s) - start ))
+fi
+
 if [ "$rc" -eq 124 ]; then
     echo "FAIL: quick tier exceeded the ${BUDGET}s budget (killed)" >&2
     exit 1

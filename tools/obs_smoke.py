@@ -222,7 +222,140 @@ def aot_cache_lane():
                       "run2": runs[1]}))
 
 
+def fleet_lane():
+    """Fleet observability lane (ISSUE 14 CI acceptance): 2 tenants x 2
+    replicas with the flight recorder on, one replica killed mid-burst.
+    Exits nonzero unless the incident leaves exactly ONE postmortem
+    bundle naming the trigger, the stitched fleet trace links the
+    bounced request's admit -> dispatch(A) -> redispatch -> dispatch(B)
+    -> complete chain across lanes, and the SloMonitor pages a
+    burn-rate alert for the affected tenant."""
+    import bigdl_tpu.compilecache as cc
+    from bigdl_tpu.fleet import FleetRouter, TenantConfig
+    from bigdl_tpu.obs import SLOObjective, SloMonitor
+    from bigdl_tpu.resilience import ReplicaKillFault
+
+    outdir = tempfile.mkdtemp(prefix="obs_smoke_fleet_")
+    flight_dir = os.path.join(outdir, "flight")
+    obs.set_observability(metrics=True, tracing=True, compile_monitor=True,
+                          flight=True, flight_dir=flight_dir)
+    cc.set_cache_dir(os.path.join(outdir, "cc"))
+
+    model = nn.Sequential(nn.Linear(6, 32), nn.ReLU(), nn.Linear(32, 4))
+    params, state, _ = model.build(jax.random.PRNGKey(0), (8, 6))
+
+    def factory(name):
+        return ServingRuntime(model, params, state, buckets=(1, 8),
+                              max_wait_ms=1.0,
+                              example_input=np.zeros((1, 6), np.float32))
+
+    router = FleetRouter(factory, n_replicas=2,
+                         tenants=[TenantConfig("bulk", tier="batch",
+                                               weight=2.0, capacity=256),
+                                  TenantConfig("chat", tier="interactive",
+                                               capacity=64)])
+    # a p99 target below any real CPU round-trip: every completion burns
+    # budget, so the alert MUST page once the burst lands
+    slo = SloMonitor([SLOObjective("chat", p99_ms=0.01),
+                      SLOObjective("bulk", p99_ms=0.01)],
+                     source=router.tenant_metrics, registry_fn=obs.registry)
+    fault = ReplicaKillFault(at_dispatch=8)
+    router.set_chaos(fault)
+    rs = np.random.RandomState(3)
+    try:
+        slo.tick(now=0.0)  # pre-burst baseline row
+        futs = []
+        for i in range(52):
+            tenant = "chat" if i % 4 == 0 else "bulk"
+            futs.append(router.submit(
+                tenant, rs.rand(1, 6).astype(np.float32),
+                deadline_ms=60_000))
+        outs = [f.result(60) for f in futs]
+        if not all(o.shape == (1, 4) for o in outs):
+            fail("fleet outputs have wrong shapes")
+        if len(fault.fired) != 1:
+            fail(f"chaos kill fired {len(fault.fired)} times, want 1")
+        verdicts = slo.tick(now=10.0)
+        bounced = [f for f in futs if f.meta["attempts"] > 1]
+        if not bounced:
+            fail("no request bounced through the redispatch path")
+        cids = [f.meta["cid"] for f in futs]
+        if len(set(cids)) != len(futs):
+            fail("correlation ids not unique across the fleet burst")
+        trace_path = os.path.join(outdir, "fleet_trace.json")
+        obs.export_fleet_trace(trace_path)
+    finally:
+        router.close()
+
+    # -- stitched trace: valid JSON, every event field-complete ---------
+    try:
+        with open(trace_path) as f:
+            doc = json.load(f)
+    except Exception as e:
+        fail(f"fleet trace is not valid JSON: {e}")
+    evs = doc["traceEvents"]
+    for ev in evs:
+        for field in ("ph", "name", "pid", "tid"):
+            if field not in ev:
+                fail(f"fleet-trace event missing {field!r}: {ev}")
+        if ev["ph"] in ("X", "i", "s", "t", "f") and "ts" not in ev:
+            fail(f"timed event missing ts: {ev}")
+        if ev["ph"] == "X" and "dur" not in ev:
+            fail(f"complete event missing dur: {ev}")
+    lanes = doc["otherData"]["replica_lanes"]
+    if sum(1 for n in lanes.values() if n.startswith("replica:")) != 2:
+        fail(f"expected 2 replica lanes, got {lanes}")
+    # the bounced cid's flow chain crosses lanes, s -> t... -> f
+    cid = bounced[0].meta["cid"]
+    flow = [e for e in evs
+            if e.get("id") == cid and e["name"] == "fleet.request"]
+    phs = [e["ph"] for e in flow]
+    if phs != ["s"] + ["t"] * (len(flow) - 2) + ["f"] or len(flow) < 4:
+        fail(f"bounced cid {cid} flow chain malformed: {phs}")
+    if len({e["pid"] for e in flow}) < 2:
+        fail(f"flow chain for {cid} never crossed a lane boundary")
+    tl = obs.request_timeline(cid)
+    if tl["redispatches"] < 1 or len(set(tl["replicas"])) != 2:
+        fail(f"timeline for {cid} missing the redispatch hop: {tl}")
+
+    # -- exactly ONE postmortem bundle naming the trigger ---------------
+    bundles = sorted(d for d in os.listdir(flight_dir)
+                     if "fleet_replica_death" in d)
+    if len(bundles) != 1:
+        fail(f"want exactly 1 replica-death bundle, got {bundles}")
+    with open(os.path.join(flight_dir, bundles[0], "MANIFEST.json")) as f:
+        manifest = json.load(f)
+    if manifest["reason"] != "fleet.replica_death":
+        fail(f"bundle names the wrong trigger: {manifest['reason']}")
+    for name in ("fingerprint.json", "events.json", "log_tail.txt",
+                 "metrics.json", "trace.json"):
+        if not os.path.exists(os.path.join(flight_dir, bundles[0], name)):
+            fail(f"bundle incomplete: {name} missing")
+
+    # -- burn-rate alert for the affected tenant ------------------------
+    reg = obs.registry()
+    if reg.get("slo/alerts_total") < 1 or not slo.alerts:
+        fail(f"no SLO burn-rate alert paged: {verdicts}")
+    alert_tenants = {a["tenant"] for a in slo.alerts}
+    if not alert_tenants & {"bulk", "chat"}:
+        fail(f"alert names no fleet tenant: {slo.alerts}")
+    n_redis = sum(reg.get(f"fleet/redispatches|tenant={t}")
+                  for t in ("bulk", "chat"))
+    if not n_redis or n_redis != reg.get("fleet/redispatched"):
+        fail(f"per-tenant redispatch count wrong: {n_redis} vs "
+             f"{reg.get('fleet/redispatched')}")
+    print(json.dumps({
+        "obs_smoke_fleet": "ok", "requests": len(futs),
+        "bounced": len(bounced), "bounced_cid": cid,
+        "redispatches": int(n_redis),
+        "alert_tenants": sorted(alert_tenants),
+        "bundle": bundles[0], "artifacts": outdir}))
+
+
 def main():
+    if "--fleet" in sys.argv:
+        fleet_lane()
+        return
     if "--aot-cache-child" in sys.argv:
         aot_cache_child(sys.argv[sys.argv.index("--aot-cache-child") + 1])
         return
