@@ -6,8 +6,13 @@ The LLM-serving subsystem: ring-buffer KV caches at bucketed max lengths
 (`BIGDL_TPU_KV_DTYPE=int8`), on-device greedy/temperature/top-k sampling
 (sampling.py), and a continuous-batching prefill/decode engine
 (engine.py) layered on the serving stack's registry/hot-swap/AOT-warmup
-machinery.  See the module docstrings and docs/serving.md
-"Autoregressive generation" / "Paged KV & quantized cache".
+machinery.  Chunked prefill (`BIGDL_TPU_PREFILL_CHUNK`) interleaves long
+prompt ingestion with in-flight decode; speculative decoding
+(`BIGDL_TPU_SPEC_DECODE` + a draft model) runs a draft-verify lane with
+a provably unchanged output distribution (sampling.spec_accept).  See
+the module docstrings and docs/serving.md "Autoregressive generation" /
+"Paged KV & quantized cache" / "Chunked prefill & speculative
+decoding".
 
 ```python
 from bigdl_tpu.generation import GenerationEngine
@@ -29,14 +34,19 @@ from bigdl_tpu.generation.engine import (
     GenerationEngine,
     GenerationResult,
 )
-from bigdl_tpu.generation.kvcache import KVCache, alloc, insert
+from bigdl_tpu.generation.kvcache import KVCache, alloc, insert, slot_view
 from bigdl_tpu.generation.pagedkv import (
     DEFAULT_BLOCK_SIZE,
     BlockPool,
     PagedKVCache,
     blocks_for,
 )
-from bigdl_tpu.generation.sampling import apply_top_k, sample_tokens
+from bigdl_tpu.generation.sampling import (
+    adjusted_log_probs,
+    apply_top_k,
+    sample_tokens,
+    spec_accept,
+)
 
 __all__ = [
     "DEFAULT_BLOCK_SIZE",
@@ -46,9 +56,12 @@ __all__ = [
     "GenerationResult",
     "KVCache",
     "PagedKVCache",
+    "adjusted_log_probs",
     "alloc",
     "apply_top_k",
     "blocks_for",
     "insert",
     "sample_tokens",
+    "slot_view",
+    "spec_accept",
 ]

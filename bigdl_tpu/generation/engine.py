@@ -10,13 +10,19 @@ would be a full prompt re-forward per token).
 Shape discipline (the TPU cost model, same as MicroBatcher's buckets):
 
   * Each configured length bucket C owns one DECODE LANE: a ring-buffer
-    `KVCache` of (slots, C) plus exactly TWO executables —
+    `KVCache` of (slots, C) plus a PINNED executable set —
     `generation/prefill/bucket=C` (prompt padded to C, writes one slot,
     samples the first token) and `generation/decode/bucket=C` (length-1
-    query for ALL slots at once, samples the next token per slot).  The
-    executable set is `len(buckets) x 2`, ever; a 64-request burst
-    compiles nothing past warmup (tests/test_generation.py asserts it,
-    with CompileMonitor's steady-state recompile alarm as the witness).
+    query for ALL slots at once, samples the next token per slot).
+    Chunked prefill (BIGDL_TPU_PREFILL_CHUNK) REPLACES prefill with
+    `prefill_chunk` (fixed chunk width, traced progress — still 2 per
+    bucket); speculative decoding (BIGDL_TPU_SPEC_DECODE + a draft
+    model) adds `draft_prefill`-or-`draft_chunk`, `draft_step` and
+    `verify` (5 per bucket).  The set is documented in
+    `compile_count()`, pinned at warmup, and never grows after — a
+    64-request burst compiles nothing past warmup
+    (tests/test_generation.py asserts it, with CompileMonitor's
+    steady-state recompile alarm as the witness).
   * Continuous batching: the engine thread interleaves admission with
     in-flight decode — a new request claims a free slot, prefills, and
     joins the NEXT decode step of requests already mid-generation; EOS /
@@ -56,9 +62,11 @@ import numpy as np
 from bigdl_tpu import obs as _obs
 from bigdl_tpu.analysis.runtime import strict_transfers, strict_transfers_enabled
 from bigdl_tpu.generation.kvcache import KVCache, insert
+from bigdl_tpu.generation.kvcache import slot_view as _ring_slot_view
 from bigdl_tpu.generation.pagedkv import (DEFAULT_BLOCK_SIZE, BlockPool,
                                           PagedKVCache, blocks_for)
-from bigdl_tpu.generation.sampling import sample_tokens
+from bigdl_tpu.generation.pagedkv import slot_view as _paged_slot_view
+from bigdl_tpu.generation.sampling import sample_tokens, spec_accept
 from bigdl_tpu.serving.batcher import Rejected, ServingClosed, _Future
 from bigdl_tpu.serving.metrics import GenerationMetrics
 from bigdl_tpu.serving.registry import ModelRegistry, ModelVersion
@@ -70,6 +78,19 @@ _KV_DTYPES = {"int8": jnp.int8, "bf16": jnp.bfloat16,
               "bfloat16": jnp.bfloat16, "fp32": jnp.float32,
               "float32": jnp.float32}
 
+# What ships ON by default per backend, decided by the interleaved A/B in
+# benchmarks/bench_generation.py --decode-quick (numbers committed to
+# benchmarks/results/spec_quick.json) — same discipline as
+# ops/decode_attention._MEASURED_DEFAULTS.  Chunked prefill wins its
+# TTFT-under-long-prompt target on cpu but stays OPT-IN (it reshapes the
+# admission latency profile, a policy change deployments should choose);
+# spec decode LOSES ms/token on the cpu quick tier (the draft's k extra
+# dispatches outweigh accepted tokens against a tiny target) so it ships
+# off everywhere until a tpu measurement says otherwise.  Flip only with
+# fresh numbers in spec_quick.json.
+_MEASURED_CHUNK_DEFAULTS = {"cpu": 0, "tpu": 0}
+_MEASURED_SPEC_DEFAULTS = {"cpu": False, "tpu": False}
+
 
 class GenerationConfig:
     """Knobs for the generation engine (docs/serving.md).
@@ -78,7 +99,14 @@ class GenerationConfig:
     `BIGDL_TPU_KV_DTYPE` environment variables (docs/serving.md "Paged KV
     & quantized cache"), so deployments flip the allocator and KV dtype
     without touching call sites; the in-code default stays the ring
-    fp32 baseline."""
+    fp32 baseline.
+
+    `prefill_chunk=None` / `spec_decode=None` likewise defer to
+    `BIGDL_TPU_PREFILL_CHUNK` (tokens per prefill chunk; 0 disables) and
+    `BIGDL_TPU_SPEC_DECODE` (on/off, or an integer which both enables
+    speculative decoding and sets `spec_k`), falling back to the
+    per-backend measured defaults above (docs/serving.md "Chunked
+    prefill & speculative decoding")."""
 
     def __init__(self, buckets: Sequence[int] = (64, 256), slots: int = 4,
                  capacity: int = 128, max_new_tokens: int = 64,
@@ -88,7 +116,9 @@ class GenerationConfig:
                  strict_transfers: Optional[bool] = None,
                  paged: Optional[bool] = None,
                  kv_block_size: int = DEFAULT_BLOCK_SIZE,
-                 kv_pool_blocks: Optional[int] = None):
+                 kv_pool_blocks: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 spec_decode: Optional[bool] = None, spec_k: int = 4):
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         if not self.buckets or self.buckets[0] < 2:
             raise ValueError(f"length buckets must be >= 2, got {buckets}")
@@ -121,6 +151,52 @@ class GenerationConfig:
                 raise ValueError(
                     f"paged KV needs every bucket divisible by "
                     f"kv_block_size={self.kv_block_size}, got {bad}")
+        if prefill_chunk is None:
+            env = os.environ.get("BIGDL_TPU_PREFILL_CHUNK", "").strip()
+            if env:
+                try:
+                    prefill_chunk = int(env)
+                except ValueError:
+                    raise ValueError(
+                        f"BIGDL_TPU_PREFILL_CHUNK={env!r}: expected an "
+                        "integer chunk size in tokens (0 disables)")
+            else:
+                prefill_chunk = _MEASURED_CHUNK_DEFAULTS.get(
+                    jax.default_backend(), 0)
+        self.prefill_chunk = max(0, int(prefill_chunk))
+        self.spec_k = int(spec_k)
+        if spec_decode is None:
+            env = os.environ.get("BIGDL_TPU_SPEC_DECODE", "").strip().lower()
+            if env in ("1", "on", "true", "yes"):
+                spec_decode = True
+            elif env in ("0", "off", "false", "no"):
+                spec_decode = False
+            elif env:
+                try:
+                    self.spec_k = int(env)
+                except ValueError:
+                    raise ValueError(
+                        f"BIGDL_TPU_SPEC_DECODE={env!r}: expected on/off "
+                        "or an integer draft length k")
+                spec_decode = True
+            else:
+                spec_decode = _MEASURED_SPEC_DEFAULTS.get(
+                    jax.default_backend(), False)
+        self.spec_decode = bool(spec_decode)
+        if self.spec_decode:
+            if self.spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {self.spec_k}")
+            if self.spec_k + 1 >= self.buckets[-1]:
+                raise ValueError(
+                    f"spec_k={self.spec_k} needs k+1 verify positions but "
+                    f"the largest bucket is {self.buckets[-1]}; no lane "
+                    "could ever run a speculative round")
+
+    def chunk_for(self, bucket: int) -> int:
+        """Prefill-chunk executable width for one bucket (a configured
+        chunk wider than the bucket clamps to it)."""
+        return min(self.prefill_chunk, int(bucket)) if self.prefill_chunk \
+            else 0
 
 
 class GenerationResult(NamedTuple):
@@ -140,6 +216,37 @@ class _SlotState:
         self.generated = 0
         self.t_first: Optional[float] = None
         self.step_ms_sum = 0.0
+
+
+class _PrefillState:
+    """Host bookkeeping for one slot mid chunked-prefill: which chunk of
+    the schedule folds next, accumulated fold time, and whether another
+    long prefill was already in flight at admission (feeds the
+    TTFT-under-long-prompt histogram)."""
+
+    __slots__ = ("req", "sched", "next_i", "prefill_ms", "contended")
+
+    def __init__(self, req, sched, contended):
+        self.req = req
+        self.sched = sched  # [(progress, n_valid), ...]
+        self.next_i = 0
+        self.prefill_ms = 0.0
+        self.contended = contended
+
+
+def _chunk_schedule(n: int, ch: int) -> "List[Tuple[int, int]]":
+    """Chunk offsets for an n-token prompt at executable width `ch`: full
+    chunks, then a RIGHT-ALIGNED remainder (the final chunk re-folds the
+    last `ch` tokens, ending exactly at n).  The overlap rewrite is
+    bitwise idempotent — K/V at a position are a deterministic function
+    of token, position and prior context — so right alignment avoids a
+    padded tail chunk clobbering live ring columns past n."""
+    if n <= ch:
+        return [(0, n)]
+    sched = [(i * ch, ch) for i in range(n // ch)]
+    if n % ch:
+        sched.append((n - ch, ch))
+    return sched
 
 
 class _GenRequest:
@@ -171,7 +278,7 @@ class _Lane:
     decode with no claims moves zero table bytes."""
 
     def __init__(self, model, bucket: int, slots: int, dtype,
-                 pool: Optional[BlockPool] = None):
+                 pool: Optional[BlockPool] = None, draft_model=None):
         self.bucket = bucket
         self.pool = pool
         if pool is None:
@@ -188,9 +295,22 @@ class _Lane:
                                                        jnp.int32))
             self._table_dirty = False
             self.lengths_dev = jax.device_put(jnp.zeros((slots,), jnp.int32))
-            self.lengths_np = np.zeros((slots,), np.int64)
             self.claimed: List[List[int]] = [[] for _ in range(slots)]
             self.reserved: List[int] = [0] * slots
+        # host position mirror (ring AND paged): total tokens written per
+        # slot — the spec-round base, chunk progress, and claim cursor
+        self.lengths_np = np.zeros((slots,), np.int64)
+        # the draft lane is always a private ring (the draft is small);
+        # its lengths are overridden per draft step from lengths_np
+        self.dcache: Optional[KVCache] = None
+        if draft_model is not None:
+            self.dcache = jax.device_put(
+                draft_model.init_cache(slots, bucket, dtype))
+        # slots mid chunked-prefill, FIFO by admission order
+        self.prefilling: Dict[int, _PrefillState] = {}
+        # latched True when a plain decode step advances a slot the draft
+        # cache didn't see; such a slot stays non-speculative until retire
+        self.spec_stale = np.zeros((slots,), bool)
         self.slots: List[Optional[_SlotState]] = [None] * slots
         self.free: List[int] = list(range(slots))
         # host mirrors, device_put explicitly each step (tiny, guard-safe)
@@ -214,6 +334,19 @@ def _tree_sig(tree: Any) -> tuple:
                  for l in jax.tree_util.tree_leaves(tree))
 
 
+def _vocab_size(model) -> Optional[int]:
+    """vocab_size through delegating wrappers (WeightOnlyInt8 exposes the
+    cache protocol by delegation but not the attribute — walk `.inner`)."""
+    seen = 0
+    while model is not None and seen < 8:
+        v = getattr(model, "vocab_size", None)
+        if v is not None:
+            return int(v)
+        model = getattr(model, "inner", None)
+        seen += 1
+    return None
+
+
 class GenerationEngine:
     """Continuous-batching prefill/decode engine over a versioned registry.
 
@@ -226,7 +359,9 @@ class GenerationEngine:
     def __init__(self, model, params: Any = None, state: Any = None, *,
                  config: Optional[GenerationConfig] = None,
                  registry: Optional[ModelRegistry] = None,
-                 version: str = "v0", summary=None, **config_kw):
+                 version: str = "v0", summary=None,
+                 draft_model=None, draft_params: Any = None,
+                 draft_version: str = "draft", **config_kw):
         if not (hasattr(model, "apply_cached") and hasattr(model, "init_cache")):
             raise TypeError(
                 f"{type(model).__name__} has no KV-cache forward "
@@ -240,6 +375,34 @@ class GenerationEngine:
         self._uid_counter = 0
         self._steps = 0
         self._strict = strict_transfers_enabled(self.config.strict_transfers)
+        self._chunk_on = self.config.prefill_chunk > 0
+        if self.config.spec_decode and draft_model is None:
+            _log.warning(
+                "spec_decode is enabled but no draft model was supplied; "
+                "speculative decoding stays off (pass draft_model= / "
+                "draft_params= or enable_generation(draft_model=...))")
+        self._spec_on = bool(self.config.spec_decode
+                             and draft_model is not None)
+        self._draft_model = draft_model if self._spec_on else None
+        self._vocab: Optional[int] = None
+        if self._spec_on:
+            if not (hasattr(draft_model, "apply_cached")
+                    and hasattr(draft_model, "init_cache")):
+                raise TypeError(
+                    f"draft {type(draft_model).__name__} has no KV-cache "
+                    "forward (init_cache/apply_cached)")
+            tv, dv = _vocab_size(model), _vocab_size(draft_model)
+            if tv is not None and dv is not None and tv != dv:
+                raise ValueError(
+                    f"draft vocab_size {dv} != target vocab_size {tv}: the "
+                    "verify pass compares their distributions row-for-row")
+            self._vocab = tv if tv is not None else dv
+            if self._vocab is None:
+                raise ValueError(
+                    "cannot determine vocab_size from target or draft "
+                    "model; speculative decoding needs it for the draft "
+                    "log-prob buffer")
+        self._long_inflight = 0  # chunked prefills spanning >1 chunk
         self._pool: Optional[BlockPool] = None
         if self.config.paged:
             blk = self.config.kv_block_size
@@ -262,16 +425,31 @@ class GenerationEngine:
                                    head_dim, self.config.cache_dtype)
         self._lanes: Dict[int, _Lane] = {
             b: _Lane(model, b, self.config.slots, self.config.cache_dtype,
-                     pool=self._pool)
+                     pool=self._pool, draft_model=self._draft_model)
             for b in self.config.buckets}
         self._warned_wrap = False
         self._update_kv_gauges()
-        self._prefill, self._decode = self._build_fns()
+        (self._prefill, self._chunk, self._decode, self._dprefill,
+         self._dchunk, self._dstep, self._verify) = self._build_fns()
+        if self._spec_on:
+            # constant round inputs, allocated once: the zero draft
+            # buffers every round starts from, and the k+1 step indices
+            # (device-resident so the draft loop transfers nothing)
+            k = self.config.spec_k
+            self._toks0 = jax.device_put(
+                jnp.zeros((self.config.slots, k), jnp.int32))
+            self._q0 = jax.device_put(
+                jnp.zeros((self.config.slots, k, self._vocab), jnp.float32))
+            self._i_dev = jax.device_put(
+                tuple(np.int32(i) for i in range(k + 1)))
         # warmed executables: (phase, bucket) -> callable (AOT-loaded when
         # the compile cache is on, the pjit fn otherwise); psig pins the
-        # param tree they were warmed for, exactly like ServingRuntime
+        # param tree they were warmed for, exactly like ServingRuntime.
+        # Draft-phase entries trace against DRAFT params and are pinned by
+        # dsig instead, surviving target swaps untouched.
         self._warmed: Dict[Tuple[str, int], Any] = {}
         self._warmed_psig: Optional[tuple] = None
+        self._warmed_dsig: Optional[tuple] = None
 
         self._pending: "deque[_GenRequest]" = deque()
         self._cond = threading.Condition()
@@ -281,6 +459,13 @@ class GenerationEngine:
 
         if registry is None:
             self.registry = ModelRegistry(warmup=self._warmup)
+            if self._spec_on:
+                # install the draft BEFORE the first register: the warmup
+                # chain then warms draft+verify executables together with
+                # prefill/decode, and every future target hot-swap re-warms
+                # the verify lane (it traces against target params) before
+                # activation — never a cold compile mid-traffic
+                self.registry.set_draft(draft_version, draft_params)
             self.registry.register(version, params,
                                    state if state is not None else {})
         else:
@@ -288,6 +473,8 @@ class GenerationEngine:
             # now, then join the registry's warmup chain so every future
             # hot-swap warms generation executables before activation too
             self.registry = registry
+            if self._spec_on:
+                registry.set_draft(draft_version, draft_params)
             snap = registry.active()
             self._warmup(snap.params, snap.state)
             registry.add_warmup(self._warmup)
@@ -304,30 +491,35 @@ class GenerationEngine:
 
     def _build_fns(self):
         m = self.model
+        dm = self._draft_model
         top_k = self.config.top_k
         paged = self.config.paged
 
-        def prefill_ring(params, cache, tokens, n, slot, temp, seed, uid):
-            # fresh single-slot cache at the lane's capacity; fold the
-            # prompt in, sample token #1 from the last REAL row, then
-            # write the slot — all one executable per bucket, so slot
-            # claim costs no extra compile
-            L, _, C, H, D = cache.k.shape
-            quant = cache.k_scale is not None
-            fresh = KVCache(
-                k=jnp.zeros((L, 1, C, H, D), cache.k.dtype),
-                v=jnp.zeros((L, 1, C, H, D), cache.v.dtype),
-                lengths=jnp.zeros((1,), jnp.int32),
-                k_scale=jnp.zeros((L, 1, C, H), jnp.float32)
-                if quant else None,
-                v_scale=jnp.zeros((L, 1, C, H), jnp.float32)
-                if quant else None)
-            logp, fresh = m.apply_cached(params, tokens, fresh)
-            last = jax.lax.dynamic_slice_in_dim(logp, n - 1, 1, axis=1)[:, 0]
-            key = jax.random.fold_in(jax.random.PRNGKey(seed), uid)
-            tok = sample_tokens(last, key, temp, top_k=top_k)
-            ok = jnp.isfinite(last).all()
-            return tok, insert(cache, slot, fresh, n), ok
+        def ring_prefill_for(model):
+            def prefill_ring(params, cache, tokens, n, slot, temp, seed,
+                             uid):
+                # fresh single-slot cache at the lane's capacity; fold the
+                # prompt in, sample token #1 from the last REAL row, then
+                # write the slot — all one executable per bucket, so slot
+                # claim costs no extra compile
+                L, _, C, H, D = cache.k.shape
+                quant = cache.k_scale is not None
+                fresh = KVCache(
+                    k=jnp.zeros((L, 1, C, H, D), cache.k.dtype),
+                    v=jnp.zeros((L, 1, C, H, D), cache.v.dtype),
+                    lengths=jnp.zeros((1,), jnp.int32),
+                    k_scale=jnp.zeros((L, 1, C, H), jnp.float32)
+                    if quant else None,
+                    v_scale=jnp.zeros((L, 1, C, H), jnp.float32)
+                    if quant else None)
+                logp, fresh = model.apply_cached(params, tokens, fresh)
+                last = jax.lax.dynamic_slice_in_dim(logp, n - 1, 1,
+                                                    axis=1)[:, 0]
+                key = jax.random.fold_in(jax.random.PRNGKey(seed), uid)
+                tok = sample_tokens(last, key, temp, top_k=top_k)
+                ok = jnp.isfinite(last).all()
+                return tok, insert(cache, slot, fresh, n), ok
+            return prefill_ring
 
         def prefill_paged(params, cache, tokens, n, slot, temp, seed, uid):
             # no fresh buffer + insert here: the slot's table row is
@@ -349,7 +541,50 @@ class GenerationEngine:
                 lengths=cache.lengths.at[slot].set(jnp.asarray(n, jnp.int32)))
             return tok, new, ok
 
-        prefill = prefill_paged if paged else prefill_ring
+        prefill = jax.jit(prefill_paged if paged else ring_prefill_for(m))
+
+        def ring_chunk_for(model):
+            def chunk_ring(params, cache, tokens, n_valid, progress, slot,
+                           temp, seed, uid):
+                # fold ONE chunk against the slot's accumulated prefix:
+                # slice the slot out at its current progress, append with
+                # the wrap-safe mask (a prompt longer than the ring slides
+                # its window chunk by chunk), write back.  Same-signature
+                # per bucket regardless of n_valid/progress, so chunking
+                # adds ZERO executables beyond swapping prefill for
+                # prefill_chunk.  The final chunk's last row is bitwise
+                # the unchunked prefill's last row (chunk-parity tests),
+                # and the SAME fold_in(seed, uid) key samples from it, so
+                # token #1 is bitwise chunking-invariant.
+                sub = _ring_slot_view(cache, slot, progress)
+                logp, sub = model.apply_cached(params, tokens, sub,
+                                               wrapped_append=True)
+                last = jax.lax.dynamic_slice_in_dim(logp, n_valid - 1, 1,
+                                                    axis=1)[:, 0]
+                key = jax.random.fold_in(jax.random.PRNGKey(seed), uid)
+                tok = sample_tokens(last, key, temp, top_k=top_k)
+                ok = jnp.isfinite(last).all()
+                return tok, insert(cache, slot, sub, progress + n_valid), ok
+            return chunk_ring
+
+        def chunk_paged(params, cache, tokens, n_valid, progress, slot,
+                        temp, seed, uid):
+            sub = _paged_slot_view(cache, slot, progress)
+            logp, sub = m.apply_cached(params, tokens, sub,
+                                       wrapped_append=True)
+            last = jax.lax.dynamic_slice_in_dim(logp, n_valid - 1, 1,
+                                                axis=1)[:, 0]
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), uid)
+            tok = sample_tokens(last, key, temp, top_k=top_k)
+            ok = jnp.isfinite(last).all()
+            new = cache._replace(
+                k=sub.k, v=sub.v, k_scale=sub.k_scale, v_scale=sub.v_scale,
+                lengths=cache.lengths.at[slot].set(
+                    jnp.asarray(progress + n_valid, jnp.int32)))
+            return tok, new, ok
+
+        chunk = jax.jit(chunk_paged if paged else ring_chunk_for(m)) \
+            if self._chunk_on else None
 
         def decode(params, cache, last_tokens, temps, active, step, seed):
             logp, new = m.apply_cached(params, last_tokens, cache)
@@ -362,13 +597,68 @@ class GenerationEngine:
             ok = jnp.isfinite(logits).all(axis=-1)
             return toks[:, None], new._replace(lengths=lengths), ok
 
-        return jax.jit(prefill), jax.jit(decode)
+        if dm is None:
+            return (prefill, chunk, jax.jit(decode), None, None, None, None)
 
-    def _warmup_args(self, params, lane: _Lane):
-        # every non-param arg is device_put so warmup avals (committed
-        # arrays) match the hot path exactly — an uncommitted numpy arg
-        # here would warm an executable the real steps never hit
+        dprefill = jax.jit(ring_prefill_for(dm)) if not self._chunk_on \
+            else None
+        dchunk = jax.jit(ring_chunk_for(dm)) if self._chunk_on else None
+
+        def draft_step(dparams, dcache, cur, base_len, toks_buf, q_buf, i,
+                       temps, step, seed):
+            # draft step i of a spec round: feed the previous token at
+            # absolute position base+i, record the proposal and its
+            # PROPOSAL distribution (what spec_accept tests against) at
+            # buffer row i.  The extra call at i=k exists only to write
+            # d_k's K/V into the draft cache so the NEXT round's step 0
+            # starts from a complete prefix; its outputs are discarded
+            # (the clamped buffer index keeps it from clobbering row k-1).
+            dc = dcache._replace(lengths=base_len + i)
+            logp, dc = dm.apply_cached(dparams, cur, dc)
+            row = logp[:, 0]
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(seed), step), i)
+            tok = sample_tokens(row, key, temps, top_k=top_k)
+            j = jnp.minimum(i, toks_buf.shape[1] - 1)
+            toks2 = jax.lax.dynamic_update_slice(toks_buf, tok[:, None],
+                                                 (0, j))
+            q2 = jax.lax.dynamic_update_slice(q_buf, row[:, None], (0, j, 0))
+            return tok[:, None], toks2, q2, dc
+
+        def verify(params, cache, base_len, last, toks_buf, q_buf, temps,
+                   active, step, seed):
+            # ONE batched target forward scores the whole (k+1)-token
+            # window: [last, d_1..d_k] appends at base..base+k, row i of
+            # the log-probs is the target distribution after accepting i
+            # draft tokens.  Rejected suffixes roll back by SHRINKING
+            # lengths — no K/V copy; the stale columns are overwritten
+            # before they can become attendable (monotone-write
+            # invariant), and inactive/prefilling slots keep base.
+            c = cache._replace(lengths=base_len)
+            x = jnp.concatenate([last, toks_buf], axis=1)
+            logp, new = m.apply_cached(params, x, c, wrapped_append=True)
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(seed), step), 0x5BEC)
+            n_acc, emitted = spec_accept(logp, q_buf, toks_buf, temps, key,
+                                         top_k=top_k)
+            ok = jnp.isfinite(logp).all(axis=(1, 2))
+            lengths = jnp.where(active, base_len + n_acc + 1, base_len)
+            return (toks_buf, emitted[:, None], n_acc,
+                    new._replace(lengths=lengths), ok)
+
+        return (prefill, chunk, jax.jit(decode), dprefill, dchunk,
+                jax.jit(draft_step), jax.jit(verify))
+
+    def _warmup_args(self, params, lane: _Lane) -> "Dict[str, tuple]":
+        """Per-phase warmup argument tuples for one lane — exactly the
+        phases the hot path will run given the chunk/spec configuration
+        (chunking REPLACES prefill with prefill_chunk; spec adds the
+        draft lane + verify).  Every non-param arg is device_put so
+        warmup avals (committed arrays) match the hot path exactly — an
+        uncommitted numpy arg here would warm an executable the real
+        steps never hit."""
         s, c = self.config.slots, lane.bucket
+        seed = np.int32(self.config.seed)
         if self._pool is not None:
             # warm against the REAL pool arrays (functional: outputs are
             # discarded), with an all-trash table — same avals as the hot
@@ -380,33 +670,77 @@ class GenerationEngine:
         else:
             throwaway = jax.device_put(
                 self.model.init_cache(s, c, self.config.cache_dtype))
-        pre = (params, throwaway) + jax.device_put(
-            (np.zeros((1, c), np.int32), np.int32(1), np.int32(0),
-             np.zeros((1,), np.float32), np.int32(self.config.seed),
-             np.int32(0)))
-        dec = (params, throwaway) + jax.device_put(
+        args: Dict[str, tuple] = {}
+        if self._chunk_on:
+            ch = self.config.chunk_for(c)
+            args["prefill_chunk"] = (params, throwaway) + jax.device_put(
+                (np.zeros((1, ch), np.int32), np.int32(1), np.int32(0),
+                 np.int32(0), np.zeros((1,), np.float32), seed, np.int32(0)))
+        else:
+            args["prefill"] = (params, throwaway) + jax.device_put(
+                (np.zeros((1, c), np.int32), np.int32(1), np.int32(0),
+                 np.zeros((1,), np.float32), seed, np.int32(0)))
+        args["decode"] = (params, throwaway) + jax.device_put(
             (np.zeros((s, 1), np.int32), np.zeros((s,), np.float32),
-             np.zeros((s,), bool), np.int32(0),
-             np.int32(self.config.seed)))
-        return pre, dec
+             np.zeros((s,), bool), np.int32(0), seed))
+        if self._spec_on:
+            args["verify"] = (params, throwaway) + jax.device_put(
+                (np.zeros((s,), np.int32), np.zeros((s, 1), np.int32))) + (
+                self._toks0, self._q0) + jax.device_put(
+                (np.zeros((s,), np.float32), np.zeros((s,), bool),
+                 np.int32(0), seed))
+            dp = self.registry.draft().params
+            dthrow = jax.device_put(self._draft_model.init_cache(
+                s, c, self.config.cache_dtype))
+            if self._chunk_on:
+                ch = self.config.chunk_for(c)
+                args["draft_chunk"] = (dp, dthrow) + jax.device_put(
+                    (np.zeros((1, ch), np.int32), np.int32(1), np.int32(0),
+                     np.int32(0), np.zeros((1,), np.float32), seed,
+                     np.int32(0)))
+            else:
+                args["draft_prefill"] = (dp, dthrow) + jax.device_put(
+                    (np.zeros((1, c), np.int32), np.int32(1), np.int32(0),
+                     np.zeros((1,), np.float32), seed, np.int32(0)))
+            args["draft_step"] = (dp, dthrow) + jax.device_put(
+                (np.zeros((s, 1), np.int32), np.zeros((s,), np.int32))) + (
+                self._toks0, self._q0, self._i_dev[0]) + jax.device_put(
+                (np.zeros((s,), np.float32), np.int32(0), seed))
+        return args
+
+    def _base_fn(self, phase: str):
+        return {"prefill": self._prefill, "prefill_chunk": self._chunk,
+                "decode": self._decode, "draft_prefill": self._dprefill,
+                "draft_chunk": self._dchunk, "draft_step": self._dstep,
+                "verify": self._verify}[phase]
 
     def _warmup(self, params: Any, state: Any = None) -> None:
-        """Warm prefill+decode for every bucket BEFORE a version activates
-        (ModelRegistry calls this off the request path).  Same three tiers
-        as ServingRuntime._warmup: params-only swap reuses live
-        executables; compile cache on -> AOT load from disk; off -> one
-        real call per (bucket, phase)."""
+        """Warm every hot-path executable for every bucket BEFORE a
+        version activates (ModelRegistry calls this off the request
+        path).  Same three tiers as ServingRuntime._warmup: params-only
+        swap reuses live executables; compile cache on -> AOT load from
+        disk; off -> one real call per (bucket, phase).  Draft-phase
+        entries trace against draft params, so a TARGET hot-swap keeps
+        them and re-warms only prefill/decode/verify — and a draft swap
+        (`registry.set_draft`) does the converse."""
         from bigdl_tpu import compilecache as _cc
 
         psig = _tree_sig(params)
         if psig != self._warmed_psig:
-            self._warmed.clear()
+            self._warmed = {kk: vv for kk, vv in self._warmed.items()
+                            if kk[0].startswith("draft_")}
+        draft = self.registry.draft() if self._spec_on else None
+        if draft is not None:
+            dsig = _tree_sig(draft.params)
+            if dsig != self._warmed_dsig:
+                self._warmed = {kk: vv for kk, vv in self._warmed.items()
+                                if not kk[0].startswith("draft_")}
+                self._warmed_dsig = dsig
         use_cache = _cc.enabled()
         reg = _obs.registry()
         for lane in self._lanes.values():
-            pre_args, dec_args = self._warmup_args(params, lane)
-            for phase, fn, args in (("prefill", self._prefill, pre_args),
-                                    ("decode", self._decode, dec_args)):
+            for phase, args in self._warmup_args(params, lane).items():
+                fn = self._base_fn(phase)
                 keyk = (phase, lane.bucket)
                 if keyk in self._warmed:
                     reg.inc("generation/warmup_reused")
@@ -431,7 +765,11 @@ class GenerationEngine:
                                        "kv_dtype": str(jnp.dtype(
                                            self.config.cache_dtype)),
                                        "block": self.config.kv_block_size
-                                       if self.config.paged else 0},
+                                       if self.config.paged else 0,
+                                       "chunk": self.config.chunk_for(
+                                           lane.bucket),
+                                       "spec_k": self.config.spec_k
+                                       if self._spec_on else 0},
                             process_scope="generation")
                         self._warmed[keyk] = warmed if status != "error" else fn
                     else:
@@ -443,21 +781,31 @@ class GenerationEngine:
         self._warmed_psig = psig
 
     def _fn(self, phase: str, bucket: int, snap: ModelVersion):
-        if self._warmed and self._warmed_psig == _tree_sig(snap.params):
+        # draft phases are pinned by the DRAFT param signature (snap is
+        # then the draft ModelVersion), target phases by the active one
+        sig = self._warmed_dsig if phase.startswith("draft_") \
+            else self._warmed_psig
+        if self._warmed and sig == _tree_sig(snap.params):
             fn = self._warmed.get((phase, bucket))
             if fn is not None:
                 return fn
-        return self._prefill if phase == "prefill" else self._decode
+        return self._base_fn(phase)
 
     def compile_count(self) -> int:
         """Distinct compiled generation executables — the bucket-discipline
-        probe (must stay <= len(buckets) x 2).  pjit cache sizes are the
-        ground truth, plus AOT-loaded executables which live outside it."""
-        aot = sum(1 for fn in self._warmed.values()
-                  if fn is not self._prefill and fn is not self._decode)
+        probe.  The pinned budget per bucket: both features off =
+        {prefill, decode} (2, pre-existing); chunked prefill on =
+        {prefill_chunk, decode} (still 2 — chunking REPLACES prefill);
+        spec decode on adds {draft_prefill | draft_chunk, draft_step,
+        verify} (5 total).  pjit cache sizes are the ground truth, plus
+        AOT-loaded executables which live outside them."""
+        fns = [f for f in (self._prefill, self._chunk, self._decode,
+                           self._dprefill, self._dchunk, self._dstep,
+                           self._verify) if f is not None]
+        base = {id(f) for f in fns}
+        aot = sum(1 for fn in self._warmed.values() if id(fn) not in base)
         try:
-            n = self._prefill._cache_size() + self._decode._cache_size()
-            return int(n) + aot
+            return int(sum(f._cache_size() for f in fns)) + aot
         except Exception:
             return len(self._warmed)
 
@@ -511,7 +859,9 @@ class GenerationEngine:
         toks = np.asarray(prompt, np.int32).reshape(-1)
         if toks.size < 1:
             raise ValueError("empty prompt")
-        if toks.size > self.config.buckets[-1]:
+        if toks.size > self.config.buckets[-1] and not self._chunk_on:
+            # with chunked prefill on, a longer prompt folds through the
+            # largest bucket chunk by chunk (sliding window past C)
             raise ValueError(
                 f"prompt of {toks.size} tokens exceeds the largest length "
                 f"bucket {self.config.buckets[-1]}; truncate or configure "
@@ -559,6 +909,11 @@ class GenerationEngine:
         n = int(req.prompt.size)
         fits = [b for b in self.config.buckets if b >= n + req.max_new]
         wraps = [b for b in reversed(self.config.buckets) if b >= n]
+        if not wraps and self._chunk_on:
+            # longer than every bucket: chunked prefill folds the FULL
+            # prompt through the largest ring (sliding window), instead
+            # of the pre-chunking submit-time rejection
+            wraps = [self.config.buckets[-1]]
         for b in fits + wraps:
             if self._lanes[b].free:
                 return self._lanes[b]
@@ -579,24 +934,36 @@ class GenerationEngine:
                 req = self._pending.popleft()
             n = int(req.prompt.size)
             if lane.bucket < n + req.max_new:
-                # the prompt only fit a wrap lane: generation will slide
-                # the window over the last `bucket` tokens — correct but
-                # lossy, so make the degradation observable
-                _obs.registry().inc("generation/wrapped_prefills")
-                if not self._warned_wrap:
-                    self._warned_wrap = True
-                    _log.warning(
-                        "prefill of %d tokens + %d max_new exceeds bucket "
-                        "%d: the KV ring will wrap and attention degrades "
-                        "to a sliding window over the last %d tokens "
-                        "(further wraps counted in "
-                        "generation/wrapped_prefills, warned once)",
-                        n, req.max_new, lane.bucket, lane.bucket)
+                if self._chunk_on and n > lane.bucket:
+                    # a prompt longer than every bucket routes through
+                    # chunking: the FULL prompt folds (sliding window past
+                    # C), nothing is truncated at admission — counted
+                    # separately from wrap-truncated generations
+                    _obs.registry().inc("generation/chunked_long_prompts")
+                else:
+                    # the prompt only fit a wrap lane: generation will
+                    # slide the window over the last `bucket` tokens —
+                    # correct but lossy, so make the degradation
+                    # observable
+                    _obs.registry().inc("generation/wrapped_prefills")
+                    if not self._warned_wrap:
+                        self._warned_wrap = True
+                        _log.warning(
+                            "prefill of %d tokens + %d max_new exceeds "
+                            "bucket %d: the KV ring will wrap and attention "
+                            "degrades to a sliding window over the last %d "
+                            "tokens (further wraps counted in "
+                            "generation/wrapped_prefills, warned once)",
+                            n, req.max_new, lane.bucket, lane.bucket)
             if self._pool is not None:
                 # worst-case logical reservation up front so the lazy
-                # per-step claims below can never fail mid-decode
-                need = blocks_for(min(lane.bucket, n + req.max_new),
-                                  self._pool.block_size)
+                # per-step claims below can never fail mid-decode; spec
+                # rounds write up to k positions past the emitted length,
+                # so the reservation covers them too
+                spec_extra = self.config.spec_k if self._spec_on else 0
+                need = blocks_for(
+                    min(lane.bucket, n + req.max_new + spec_extra),
+                    self._pool.block_size)
                 if need > self._pool.n_allocatable:
                     req.future.set_error(Rejected(
                         f"request needs {need} KV blocks but the pool only "
@@ -610,6 +977,33 @@ class GenerationEngine:
                         self._pending.appendleft(req)
                     return
             s = lane.free.pop()
+            lane.spec_stale[s] = False
+            if self._chunk_on:
+                # multi-chunk admission runs NO executable here: the slot
+                # parks in lane.prefilling and _advance_prefill folds one
+                # chunk per scheduler iteration, interleaved with decode
+                # steps — in-flight lanes never stall longer than one
+                # chunk on a long prompt.  A prompt that fits ONE chunk
+                # folds synchronously below (same chunk executable, so
+                # the pinned set is unchanged): short requests pay no
+                # scheduler-pass deferral for having chunking enabled
+                if self._pool is not None:
+                    lane.claimed[s] = []
+                    lane.reserved[s] = need
+                    lane.table_np[s, :] = 0
+                    lane._table_dirty = True
+                    self._update_kv_gauges()
+                lane.lengths_np[s] = 0
+                lane.slots[s] = _SlotState(req)
+                lane.active_np[s] = False
+                sched = _chunk_schedule(n, self.config.chunk_for(lane.bucket))
+                lane.prefilling[s] = _PrefillState(
+                    req, sched, self._long_inflight > 0)
+                if len(sched) > 1:
+                    self._long_inflight += 1
+                else:
+                    self._advance_prefill(lane, snap, tr, slot=s)
+                continue
             if self._pool is not None:
                 npre = blocks_for(n, self._pool.block_size)
                 ids = self._pool.claim(npre)
@@ -618,8 +1012,8 @@ class GenerationEngine:
                 lane.table_np[s, :] = 0
                 lane.table_np[s, :npre] = ids
                 lane._table_dirty = True
-                lane.lengths_np[s] = n
                 self._update_kv_gauges()
+            lane.lengths_np[s] = n
             padded = np.zeros((1, lane.bucket), np.int32)
             padded[0, :n] = req.prompt
             fn = self._fn("prefill", lane.bucket, snap)
@@ -630,12 +1024,24 @@ class GenerationEngine:
                     (mon.attribute(f"generation/prefill/bucket={lane.bucket}")
                      if mon is not None else _NULL), \
                     strict_transfers(self._strict):
+                args = jax.device_put(
+                    (padded, np.int32(n), np.int32(s),
+                     np.asarray([req.temperature], np.float32),
+                     np.int32(self.config.seed), np.int32(req.uid)))
                 tok, new_cache, ok = fn(
-                    snap.params, self._lane_cache(lane), *jax.device_put(
-                        (padded, np.int32(n), np.int32(s),
-                         np.asarray([req.temperature], np.float32),
-                         np.int32(self.config.seed), np.int32(req.uid))))
+                    snap.params, self._lane_cache(lane), *args)
                 self._store_cache(lane, new_cache)
+                if self._spec_on:
+                    # mirror the prompt into the draft cache so round 0's
+                    # draft steps continue from a complete prefix (sampled
+                    # token and finite-check are the target's business)
+                    dsnap = self.registry.draft()
+                    dfn = self._fn("draft_prefill", lane.bucket, dsnap)
+                    with (mon.attribute(
+                            f"generation/draft_prefill/bucket={lane.bucket}")
+                            if mon is not None else _NULL):
+                        _dt, dc, _dok = dfn(dsnap.params, lane.dcache, *args)
+                        lane.dcache = dc
                 tok = int(jax.device_get(tok)[0])
                 ok = bool(jax.device_get(ok))
             t1 = time.perf_counter()
@@ -659,12 +1065,222 @@ class GenerationEngine:
                              "eos" if req.eos_id is not None
                              and tok == req.eos_id else "length", tr)
 
+    def _advance_prefill(self, lane: _Lane, snap: ModelVersion, tr,
+                         slot: Optional[int] = None) -> None:
+        """Fold ONE chunk of the lane's oldest mid-prefill request (or of
+        `slot`, for the synchronous single-chunk admission) — the
+        admission policy: decode lanes wait at most one chunk of any long
+        prompt per scheduler iteration.  Non-final chunks dispatch async
+        (no host sync; a NaN poisons the cache and surfaces at the final
+        chunk's finite-check); the final chunk activates the slot exactly
+        like an unchunked prefill, sampling token #1 from a bitwise-
+        identical last row with the same fold_in(seed, uid) key."""
+        mon = _obs.compile_monitor()
+        s = next(iter(lane.prefilling)) if slot is None else slot
+        ps = lane.prefilling[s]
+        req = ps.req
+        prog, nv = ps.sched[ps.next_i]
+        final = ps.next_i == len(ps.sched) - 1
+        ch = self.config.chunk_for(lane.bucket)
+        if self._pool is not None:
+            blk = self._pool.block_size
+            # claims stay a dense prefix of block indices; a chunk that
+            # wrapped past the ring cycles into already-claimed low
+            # indices and claims nothing new
+            hi = max((p % lane.bucket) // blk for p in range(prog, prog + nv))
+            claimed_any = False
+            while len(lane.claimed[s]) <= hi:
+                bi = len(lane.claimed[s])
+                bid = self._pool.claim(1)[0]
+                lane.claimed[s].append(bid)
+                lane.table_np[s, bi] = bid
+                lane._table_dirty = True
+                claimed_any = True
+            if claimed_any:
+                self._update_kv_gauges()
+        toks = np.zeros((1, ch), np.int32)
+        toks[0, :nv] = req.prompt[prog:prog + nv]
+        fn = self._fn("prefill_chunk", lane.bucket, snap)
+        t0 = time.perf_counter()
+        with (tr.span("gen.prefill_chunk", cat="generation", cid=req.cid,
+                      bucket=lane.bucket, progress=prog, n_valid=nv)
+              if tr is not None else _NULL), \
+                (mon.attribute(
+                    f"generation/prefill_chunk/bucket={lane.bucket}")
+                 if mon is not None else _NULL), \
+                strict_transfers(self._strict):
+            args = jax.device_put(
+                (toks, np.int32(nv), np.int32(prog), np.int32(s),
+                 np.asarray([req.temperature], np.float32),
+                 np.int32(self.config.seed), np.int32(req.uid)))
+            tok, new_cache, ok = fn(
+                snap.params, self._lane_cache(lane), *args)
+            self._store_cache(lane, new_cache)
+            if self._spec_on:
+                dsnap = self.registry.draft()
+                dfn = self._fn("draft_chunk", lane.bucket, dsnap)
+                with (mon.attribute(
+                        f"generation/draft_chunk/bucket={lane.bucket}")
+                        if mon is not None else _NULL):
+                    _dt, dc, _dok = dfn(dsnap.params, lane.dcache, *args)
+                    lane.dcache = dc
+            if final:
+                tok = int(jax.device_get(tok)[0])
+                ok = bool(jax.device_get(ok))
+        t1 = time.perf_counter()
+        ps.prefill_ms += (t1 - t0) * 1e3
+        lane.lengths_np[s] = prog + nv
+        ps.next_i += 1
+        self.metrics.on_prefill_chunk()
+        if not final:
+            return
+        del lane.prefilling[s]
+        if len(ps.sched) > 1:
+            self._long_inflight -= 1
+        st = lane.slots[s]
+        st.t_first = t1
+        st.tokens.append(tok)
+        lane.temps_np[s] = req.temperature
+        lane.active_np[s] = True
+        lane.last_np[s, 0] = tok
+        self.metrics.on_prefill(ps.prefill_ms, (t1 - req.t_submit) * 1e3,
+                                contended=ps.contended)
+        self.metrics.set_active(self._n_active())
+        if self.config.reject_nonfinite and not ok:
+            self._retire(lane, s, "error", tr)
+            return
+        st.generated = 1
+        if (req.eos_id is not None and tok == req.eos_id) \
+                or req.max_new <= 1:
+            self._retire(lane, s,
+                         "eos" if req.eos_id is not None
+                         and tok == req.eos_id else "length", tr)
+
+    def _spec_ok(self, lane: _Lane) -> bool:
+        """A speculative round needs every ACTIVE slot able to take k+1
+        more positions without wrapping (once a slot nears its bucket it
+        plain-decodes; lengths only grow, so it never flips back) and a
+        draft cache that mirrors the target (a slot that ever rode a
+        plain decode step is latched stale until it retires)."""
+        k = self.config.spec_k
+        any_active = False
+        for s in range(self.config.slots):
+            if not lane.active_np[s]:
+                continue
+            if lane.spec_stale[s] \
+                    or int(lane.lengths_np[s]) + k + 1 > lane.bucket:
+                return False
+            any_active = True
+        return any_active
+
+    def _spec_round(self, lane: _Lane, snap: ModelVersion, tr) -> None:
+        """One draft-verify decode round: k chained draft steps propose
+        tokens + proposal log-probs on device, ONE batched verify forward
+        scores the (k+1)-token window against the target cache, and
+        accept/resample emits n_acc+1 tokens per active slot.  Rejected
+        suffixes roll back by SHRINKING lengths — no K/V copy (stale
+        columns are rewritten before they can become attendable).  Host
+        traffic is one device_get per ROUND, same budget as one plain
+        decode step."""
+        mon = _obs.compile_monitor()
+        k = self.config.spec_k
+        n_act = lane.n_active
+        dsnap = self.registry.draft()
+        if self._pool is not None:
+            # claims must cover the k garbage positions past each active
+            # slot's length (no wrap, by the _spec_ok gate; covered by
+            # the spec-aware admission reservation, so cannot fail)
+            blk = self._pool.block_size
+            claimed_any = False
+            for s in range(self.config.slots):
+                if not lane.active_np[s]:
+                    continue
+                hi = (int(lane.lengths_np[s]) + k) // blk
+                while len(lane.claimed[s]) <= hi:
+                    bi = len(lane.claimed[s])
+                    bid = self._pool.claim(1)[0]
+                    lane.claimed[s].append(bid)
+                    lane.table_np[s, bi] = bid
+                    lane._table_dirty = True
+                    claimed_any = True
+            if claimed_any:
+                self._update_kv_gauges()
+        cids = [lane.slots[s].req.cid for s in range(self.config.slots)
+                if lane.slots[s] is not None and lane.active_np[s]]
+        t0 = time.perf_counter()
+        with (tr.span("gen.spec_round", cat="generation", bucket=lane.bucket,
+                      active=n_act, k=k, cids=cids)
+              if tr is not None else _NULL), \
+                strict_transfers(self._strict):
+            base, cur, temps, active, step, seed = jax.device_put(
+                (lane.lengths_np.astype(np.int32), lane.last_np,
+                 lane.temps_np, lane.active_np, np.int32(self._steps),
+                 np.int32(self.config.seed)))
+            last_dev = cur
+            toks_buf, q_buf = self._toks0, self._q0
+            dfn = self._fn("draft_step", lane.bucket, dsnap)
+            dc = lane.dcache
+            with (mon.attribute(f"generation/draft_step/bucket={lane.bucket}")
+                  if mon is not None else _NULL):
+                for i in range(k + 1):
+                    # call k only writes d_k's K/V into the draft cache;
+                    # its proposal is discarded (buffer index clamped)
+                    tok_d, t2, q2, dc = dfn(dsnap.params, dc, cur, base,
+                                            toks_buf, q_buf, self._i_dev[i],
+                                            temps, step, seed)
+                    if i < k:
+                        cur, toks_buf, q_buf = tok_d, t2, q2
+            lane.dcache = dc
+            vfn = self._fn("verify", lane.bucket, snap)
+            with (mon.attribute(f"generation/verify/bucket={lane.bucket}")
+                  if mon is not None else _NULL):
+                d_toks, emitted, n_acc, new_cache, ok = vfn(
+                    snap.params, self._lane_cache(lane), base, last_dev,
+                    toks_buf, q_buf, temps, active, step, seed)
+                self._store_cache(lane, new_cache)
+            d_np, em_np, na_np, ok_np = jax.device_get(
+                (d_toks, emitted, n_acc, ok))  # the ONE per-round sync
+        step_ms = (time.perf_counter() - t0) * 1e3
+        self._steps += 1
+        accepted = 0
+        emitted_total = 0
+        for s in range(self.config.slots):
+            st = lane.slots[s]
+            if st is None or not lane.active_np[s]:
+                continue
+            if self.config.reject_nonfinite and not bool(ok_np[s]):
+                self._retire(lane, s, "error", tr)
+                continue
+            na = int(na_np[s])
+            accepted += na
+            lane.lengths_np[s] += na + 1
+            st.step_ms_sum += step_ms
+            done = None
+            for t in [int(x) for x in d_np[s, :na]] + [int(em_np[s, 0])]:
+                st.tokens.append(t)
+                st.generated += 1
+                emitted_total += 1
+                if st.req.eos_id is not None and t == st.req.eos_id:
+                    done = "eos"
+                    break
+                if st.generated >= st.req.max_new:
+                    done = "length"
+                    break
+            lane.last_np[s, 0] = st.tokens[-1]
+            if done is not None:
+                self._retire(lane, s, done, tr)
+        self.metrics.on_tokens(emitted_total, step_ms)
+        self.metrics.on_spec_round(n_act * k, accepted, k + 1)
+
     def _decode_lane(self, lane: _Lane, snap: ModelVersion, tr) -> None:
+        if self._spec_on and self._spec_ok(lane):
+            self._spec_round(lane, snap, tr)
+            return
         mon = _obs.compile_monitor()
         k = lane.n_active
         fn = self._fn("decode", lane.bucket, snap)
         cids = [lane.slots[s].req.cid for s in range(self.config.slots)
-                if lane.slots[s] is not None]
+                if lane.slots[s] is not None and lane.active_np[s]]
         if self._pool is not None:
             # lazy physical claims: a slot whose NEXT write position
             # crosses into an unclaimed block claims it now (covered by
@@ -700,14 +1316,17 @@ class GenerationEngine:
             ok_np = jax.device_get(ok)
         step_ms = (time.perf_counter() - t0) * 1e3
         self._steps += 1
-        if self._pool is not None:
-            for s in range(self.config.slots):
-                if lane.active_np[s]:
-                    lane.lengths_np[s] += 1
+        for s in range(self.config.slots):
+            if lane.active_np[s]:
+                lane.lengths_np[s] += 1
+        if self._spec_on:
+            # this step advanced target state the draft cache didn't see:
+            # latch the slots out of speculative rounds until they retire
+            lane.spec_stale |= lane.active_np
         self.metrics.on_tokens(k, step_ms)
         for s in range(self.config.slots):
             st = lane.slots[s]
-            if st is None:
+            if st is None or not lane.active_np[s]:
                 continue
             if self.config.reject_nonfinite and not bool(ok_np[s]):
                 self._retire(lane, s, "error", tr)
@@ -727,6 +1346,7 @@ class GenerationEngine:
         table row back at the trash block (so its fixed-shape decode
         writes stop touching real blocks)."""
         if self._pool is None:
+            lane.lengths_np[s] = 0
             return
         self._pool.release(lane.claimed[s])
         self._pool.unreserve(lane.reserved[s])
@@ -742,6 +1362,7 @@ class GenerationEngine:
         req = st.req
         lane.slots[s] = None
         lane.active_np[s] = False
+        lane.spec_stale[s] = False
         lane.free.append(s)
         self._release_blocks(lane, s)
         now = time.perf_counter()
@@ -778,22 +1399,32 @@ class GenerationEngine:
 
     # -- main loop ---------------------------------------------------------
 
+    def _n_prefilling(self) -> int:
+        return sum(len(lane.prefilling) for lane in self._lanes.values())
+
     def _loop(self) -> None:
         while True:
             with self._cond:
                 while (not self._closed and not self._pending
-                       and self._n_active() == 0):
+                       and self._n_active() == 0
+                       and self._n_prefilling() == 0):
                     self._cond.wait(0.05)
                 if self._closed and self._abort:
                     break
                 if (self._closed and not self._pending
-                        and self._n_active() == 0):
+                        and self._n_active() == 0
+                        and self._n_prefilling() == 0):
                     break
             tr = _obs.tracer()
             try:
                 snap = self.registry.active()
                 self._admit(snap, tr)
                 for lane in self._lanes.values():
+                    # one chunk of the oldest mid-prefill prompt, THEN the
+                    # lane's decode step: short-request TTFT under a long
+                    # admission is bounded by one chunk, not one prompt
+                    if lane.prefilling:
+                        self._advance_prefill(lane, snap, tr)
                     if lane.n_active:
                         self._decode_lane(lane, snap, tr)
             except BaseException as e:  # noqa: BLE001 — fail loudly, keep serving
@@ -810,6 +1441,8 @@ class GenerationEngine:
             if not req.future.done():
                 req.future.set_error(err)
         for lane in self._lanes.values():
+            lane.prefilling.clear()
+            lane.spec_stale[:] = False
             for s in range(self.config.slots):
                 st = lane.slots[s]
                 if st is not None:
@@ -819,6 +1452,7 @@ class GenerationEngine:
                     self._release_blocks(lane, s)
                     if not st.req.future.done():
                         st.req.future.set_error(err)
+        self._long_inflight = 0
         self.metrics.set_active(0)
 
     # -- versioning / lifecycle -------------------------------------------
@@ -835,7 +1469,7 @@ class GenerationEngine:
     def drain(self, timeout: Optional[float] = 60.0) -> None:
         """Block until every admitted request has retired."""
         deadline = None if timeout is None else time.perf_counter() + timeout
-        while self._pending or self._n_active():
+        while self._pending or self._n_active() or self._n_prefilling():
             if deadline is not None and time.perf_counter() > deadline:
                 raise TimeoutError("generation engine did not drain in time")
             time.sleep(0.002)

@@ -88,6 +88,31 @@ def alloc(n_layer: int, slots: int, capacity: int, n_head: int,
                    k_scale=k_scale, v_scale=v_scale)
 
 
+def slot_view(cache: KVCache, slot, length) -> KVCache:
+    """Slice `slot` out of a lane cache as a single-slot `KVCache` whose
+    `lengths` is pinned to `length` (total tokens already written) — the
+    working view for a k-token append that RESUMES mid-ring: chunked
+    prefill folds chunk i against `slot_view(cache, s, i*chunk)` and
+    writes back with `insert`, so prompt ingestion never needs a
+    capacity-sized fresh buffer per chunk.  Traced-index safe (`slot`
+    and `length` may be jit scalars).
+
+    Rollback is the degenerate append: because `lengths` alone decides
+    where the next write lands and what the mask attends, rejecting a
+    speculated suffix is `cache._replace(lengths=shorter)` — no K/V
+    copy; the stale rows beyond `lengths` are masked until sequential
+    writes overwrite them (engine.py's spec-decode verify relies on
+    this)."""
+    def take(a):
+        if a is None:
+            return None
+        return jax.lax.dynamic_slice_in_dim(a, slot, 1, axis=1)
+
+    return KVCache(k=take(cache.k), v=take(cache.v),
+                   lengths=jnp.asarray(length, jnp.int32)[None],
+                   k_scale=take(cache.k_scale), v_scale=take(cache.v_scale))
+
+
 def insert(cache: KVCache, slot, src: KVCache, length) -> KVCache:
     """Write single-slot cache `src` (same capacity) into `slot` of
     `cache` and pin that slot's length to `length` (the REAL token count —

@@ -108,6 +108,25 @@ def blocks_for(tokens: int, block_size: int) -> int:
     return -(-int(tokens) // int(block_size))
 
 
+def slot_view(cache: PagedKVCache, slot, length) -> PagedKVCache:
+    """Single-slot view for a k-token append resuming at `length` tokens
+    written: the slot's block-table row is sliced out (the pool arrays
+    are shared, so no K/V moves) and `lengths` pinned — the paged twin
+    of `kvcache.slot_view`, used by the chunked-prefill executable.
+    Writes through the view scatter into the slot's claimed pool blocks
+    (unclaimed entries hit the trash block); merge back by adopting the
+    returned pool arrays and setting the lane's `lengths[slot]`.
+
+    Rollback after a rejected speculative suffix is, as with the ring,
+    just a shorter `lengths` — claimed blocks stay claimed (still
+    covered by the admission reservation) and are rewritten in place by
+    subsequent sequential appends, so the BlockPool leak accounting is
+    untouched by any accept/reject pattern."""
+    row = jax.lax.dynamic_slice_in_dim(cache.block_tables, slot, 1, axis=0)
+    return cache._replace(block_tables=row,
+                          lengths=jnp.asarray(length, jnp.int32)[None])
+
+
 class BlockPool:
     """Host-side allocator over the shared device block pool.
 

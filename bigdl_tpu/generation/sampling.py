@@ -41,3 +41,75 @@ def sample_tokens(logits: jax.Array, key: jax.Array,
     safe = jnp.where(temps > 0, temps, 1.0)[:, None]
     sampled = jax.random.categorical(key, apply_top_k(logits, top_k) / safe)
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+
+def adjusted_log_probs(logits: jax.Array, temperatures: jax.Array, *,
+                       top_k: int = 0) -> jax.Array:
+    """Log-probs of the distribution `sample_tokens` actually draws from:
+    top-k mask, then temperature, then log-softmax.  `logits` is (..., V)
+    with `temperatures` broadcast over the leading axes; rows at
+    temperature 0 divide by 1 (their argmax — the greedy pick — is
+    unaffected).  Speculative decoding needs this explicitly: the
+    accept test compares the DRAFT proposal distribution against the
+    TARGET sampling distribution, and both must be exactly what the
+    non-speculative path would have sampled from for the output
+    distribution to be provably unchanged."""
+    temps = jnp.asarray(temperatures)
+    safe = jnp.where(temps > 0, temps, 1.0)
+    safe = safe.reshape(safe.shape + (1,) * (logits.ndim - safe.ndim))
+    return jax.nn.log_softmax(apply_top_k(logits, top_k) / safe, axis=-1)
+
+
+def spec_accept(p_logp: jax.Array, q_logp: jax.Array, draft: jax.Array,
+                temperatures: jax.Array, key: jax.Array, *,
+                top_k: int = 0) -> "tuple[jax.Array, jax.Array]":
+    """Vectorized speculative accept/resample (runs inside the verify
+    executable, one call per decode round).
+
+    `p_logp` (B, k+1, V): the TARGET's log-probs from the batched verify
+    forward — row i is the distribution of the token after accepting the
+    first i draft tokens.  `q_logp` (B, k, V): the DRAFT's raw log-probs
+    that proposed `draft` (B, k) int32.  Returns `(n_acc, emitted)`:
+    per row, the count of accepted draft tokens (longest accepted
+    prefix) and the ONE extra token the target always contributes —
+    so every round emits `n_acc + 1` tokens.
+
+    Greedy rows (temperature 0) accept while the draft matches the
+    target argmax and emit the argmax at the first mismatch (or the
+    bonus row after a full accept): BITWISE the sequence the
+    non-speculative greedy loop produces.  Sampled rows run the
+    standard rejection scheme on the tempered/top-k'd distributions
+    p', q': accept d_i iff u < p'(d_i)/q'(d_i); on rejection resample
+    from the residual max(p' - q', 0) renormalized, on full accept
+    sample row k of p' — the textbook construction whose marginal
+    equals sampling from p' directly."""
+    b, k1, _ = p_logp.shape
+    k = k1 - 1
+    temps = jnp.asarray(temperatures)
+    greedy = jnp.argmax(p_logp, axis=-1).astype(jnp.int32)      # (B, k+1)
+    p_adj = adjusted_log_probs(p_logp, temps, top_k=top_k)      # (B, k+1, V)
+    q_adj = adjusted_log_probs(q_logp, temps, top_k=top_k)      # (B, k, V)
+    pd = jnp.take_along_axis(p_adj[:, :k], draft[..., None], axis=-1)[..., 0]
+    qd = jnp.take_along_axis(q_adj, draft[..., None], axis=-1)[..., 0]
+    key_u, key_r = jax.random.split(key)
+    u = jax.random.uniform(key_u, (b, k))
+    acc = jnp.where(temps[:, None] > 0,
+                    jnp.log(u) < pd - qd,                       # u < p'/q'
+                    draft == greedy[:, :k])
+    prefix = jnp.cumprod(acc.astype(jnp.int32), axis=1)
+    n_acc = prefix.sum(axis=1).astype(jnp.int32)                # (B,)
+    # the +1 token: residual resample at the rejection row, or the bonus
+    # row-k distribution when every draft token survived
+    row = jnp.minimum(n_acc, k)
+    p_row = jnp.take_along_axis(p_adj, row[:, None, None], axis=1)[:, 0]
+    q_row = jnp.take_along_axis(
+        q_adj, jnp.minimum(row, k - 1)[:, None, None], axis=1)[:, 0]
+    resid = jnp.maximum(jnp.exp(p_row) - jnp.exp(q_row), 0.0)
+    mass = resid.sum(axis=-1, keepdims=True)
+    bonus = (n_acc == k)[:, None] | (mass <= 0.0)  # mass==0 only numerically
+    dist = jnp.where(bonus, jnp.exp(p_row), resid)
+    sampled = jax.random.categorical(key_r,
+                                     jnp.log(jnp.maximum(dist, 1e-38)))
+    g_row = jnp.take_along_axis(greedy, row[:, None], axis=1)[:, 0]
+    emitted = jnp.where(temps > 0, sampled, g_row).astype(jnp.int32)
+    return n_acc, emitted

@@ -159,10 +159,15 @@ class TransformerLM(Module):
         return alloc(self.n_layer, slots, capacity, self.n_head,
                      self.hidden_size // self.n_head, dtype)
 
-    def apply_cached(self, params, tokens, cache):
+    def apply_cached(self, params, tokens, cache, *, wrapped_append=False):
         """Cache-aware forward: `tokens` (B, S) are NEW tokens appended at
         absolute positions `cache.lengths[b]..+S-1`; returns (log-probs
         (B, S, V), updated cache with lengths += S).
+
+        `wrapped_append=True` selects the wrap-safe multi-token mask
+        (nn/attention.py) so a chunked prefill or spec-decode verify
+        append that crosses the ring boundary stays causally correct;
+        boolean-identical to the default mask while writes fit the ring.
 
         `cache` is either a ring `KVCache` or a paged `PagedKVCache`
         (generation/pagedkv.py) — the layout difference is static pytree
@@ -209,7 +214,7 @@ class TransformerLM(Module):
                 out, kv = blk.apply_cached(
                     xs["lp"], hh,
                     layer_kv(xs["k"], xs["v"], xs.get("ks"), xs.get("vs")),
-                    lengths=lengths)
+                    lengths=lengths, wrapped_append=wrapped_append)
                 ys = {"k": kv["k"], "v": kv["v"]}
                 if quant:
                     ys["ks"], ys["vs"] = kv["k_scale"], kv["v_scale"]
@@ -229,7 +234,7 @@ class TransformerLM(Module):
                     layer_kv(cache.k[i], cache.v[i],
                              cache.k_scale[i] if quant else None,
                              cache.v_scale[i] if quant else None),
-                    lengths=lengths)
+                    lengths=lengths, wrapped_append=wrapped_append)
                 ks.append(kv["k"])
                 vs.append(kv["v"])
                 if quant:

@@ -187,7 +187,7 @@ class MultiHeadAttention(Module):
                                                    training=training, rng=rng)
         return out, state
 
-    def apply_cached(self, params, x, kv, *, lengths):
+    def apply_cached(self, params, x, kv, *, lengths, wrapped_append=False):
         """Cache-aware inference forward (the generation hot path).
 
         `x` is (B, S, D) NEW tokens only; `lengths` (B,) int32 counts
@@ -213,10 +213,17 @@ class MultiHeadAttention(Module):
         paged read otherwise gathers pool blocks back into ring layout
         and runs the IDENTICAL dense path, which is what keeps paged-on
         vs paged-off bitwise-equal at fp32 (masked trash/stale columns
-        get exactly-zero softmax weight).  Multi-token append AFTER a
-        wrap is not supported — the mask indexes keys by ring slot,
-        which equals position only while writes are monotone within the
-        window (bigdl_tpu/generation/engine.py keeps to that).
+        get exactly-zero softmax weight).  The default mask indexes keys
+        by ring slot, which equals position only while writes are
+        monotone within the window — a multi-token append AFTER a wrap
+        needs `wrapped_append=True`: the mask then recovers each
+        column's LATEST written position (`e - ((e - j) % C)` for last
+        write position e) so chunked prefill of a prompt longer than
+        the ring and the spec-decode verify pass stay causally correct.
+        In the no-wrap case the recovered position equals the column
+        index, so the two masks are boolean-identical and the outputs
+        bitwise-equal — which is what lets the chunked executables use
+        it unconditionally without breaking chunk-vs-unchunked parity.
         """
         b, s, d = x.shape
         h, hd = self.n_head, self.head_dim
@@ -290,6 +297,17 @@ class MultiHeadAttention(Module):
             if impl in ("ref", "pallas"):
                 ctx = decode_attention_ref(q[:, 0], keys, vals,
                                            lengths=lengths)[:, None]
+            elif wrapped_append and s > 1:
+                # wrap-safe multi-token append: column j holds the
+                # LATEST position p ≡ j (mod C) with p <= e, where e is
+                # the last position written this pass; attend iff that
+                # position is causally visible and was ever written.
+                # Without a wrap pos_j == j, reducing to the mask below.
+                e = positions[:, -1][:, None]               # (B, 1)
+                pos_j = e - ((e - jnp.arange(cap)[None, :]) % cap)
+                mask = (pos_j[:, None, :] <= positions[:, :, None]) \
+                    & (pos_j[:, None, :] >= 0)              # (B, S, C)
+                ctx = dense_attention(q, keys, vals, mask=mask[:, None])
             else:
                 # per-row causal mask over the full ring: (B,S,C)->(B,1,S,C)
                 mask = jax.vmap(
@@ -348,14 +366,15 @@ class TransformerBlock(Container):
                               training=training, rng=child_rng(rng, 1))
         return x + h, state
 
-    def apply_cached(self, params, x, kv, *, lengths):
+    def apply_cached(self, params, x, kv, *, lengths, wrapped_append=False):
         """Inference-only block forward against a per-layer KV ring
         buffer (see MultiHeadAttention.apply_cached); returns
         (out, new_kv)."""
         c = self.children
         h, _ = c["ln1"].apply(params["ln1"], {}, x)
         h, new_kv = c["attn"].apply_cached(params["attn"], h, kv,
-                                           lengths=lengths)
+                                           lengths=lengths,
+                                           wrapped_append=wrapped_append)
         x = x + h
         h, _ = c["ln2"].apply(params["ln2"], {}, x)
         h, _ = c["mlp"].apply(params["mlp"], {}, h, training=False)

@@ -539,11 +539,12 @@ class WeightOnlyInt8(Module):
             slots, capacity, dtype if dtype is not None
             else (self.compute_dtype or jnp.float32))
 
-    def apply_cached(self, params, tokens, cache):
+    def apply_cached(self, params, tokens, cache, *, wrapped_append=False):
         dtype = self.compute_dtype if self.compute_dtype is not None \
             else jnp.float32
         return self.inner.apply_cached(self._dequantize(params, dtype),
-                                       tokens, cache)
+                                       tokens, cache,
+                                       wrapped_append=wrapped_append)
 
     def output_shape(self, input_shape):
         return self.inner.output_shape(input_shape)

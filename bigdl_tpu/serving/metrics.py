@@ -292,9 +292,18 @@ class GenerationMetrics:
         self._label = "" if tenant is None else f"|tenant={tenant}"
         self._lock = threading.Lock()
         self.ttft_ms = LatencyHistogram()
+        # TTFT of requests admitted while another request's chunked long
+        # prefill was in flight — the interactive-latency-under-long-
+        # prompt number the chunked-prefill admission policy protects
+        self.ttft_long_ms = LatencyHistogram()
         self.per_token_ms = LatencyHistogram()
         self.prefill_ms = LatencyHistogram()
         self.e2e_ms = LatencyHistogram()
+        self.prefill_chunks = 0
+        self.spec_rounds = 0
+        self.draft_steps = 0
+        self.draft_tokens_proposed = 0
+        self.draft_tokens_accepted = 0
         self.tokens_generated = 0
         self.requests_admitted = 0
         self.requests_completed = 0
@@ -327,15 +336,47 @@ class GenerationMetrics:
                 self.rejected_shutdown += 1
         _obs.registry().inc(f"generation/rejected_{reason}{self._label}")
 
-    def on_prefill(self, prefill_ms: float, ttft_ms: float) -> None:
-        """One admission: prompt folded, first token sampled."""
+    def on_prefill(self, prefill_ms: float, ttft_ms: float,
+                   contended: bool = False) -> None:
+        """One admission: prompt folded, first token sampled.
+        `contended=True` marks a request whose admission overlapped a
+        chunked long prefill — its TTFT additionally lands in the
+        under-long-prompt histogram."""
         with self._lock:
             self.prefills += 1
             self.tokens_generated += 1  # prefill samples token #1
             self.prefill_ms.observe(prefill_ms)
             self.ttft_ms.observe(ttft_ms)
+            if contended:
+                self.ttft_long_ms.observe(ttft_ms)
         _obs.registry().inc("generation/prefills" + self._label)
         _obs.registry().inc("generation/tokens" + self._label)
+
+    def on_prefill_chunk(self) -> None:
+        """One prefill_chunk executable ran (chunked prompt ingestion)."""
+        with self._lock:
+            self.prefill_chunks += 1
+        _obs.registry().inc("generation/prefill_chunks" + self._label)
+
+    def on_spec_round(self, proposed: int, accepted: int,
+                      draft_steps: int) -> None:
+        """One speculative decode round: `proposed` draft tokens offered
+        across active slots, `accepted` survived verification,
+        `draft_steps` draft-model forwards ran.  The acceptance-rate
+        gauge is cumulative (accepted / proposed over the engine's
+        life) — the number to watch when deciding whether the draft is
+        worth its steps (docs/serving.md)."""
+        with self._lock:
+            self.spec_rounds += 1
+            self.draft_steps += draft_steps
+            self.draft_tokens_proposed += proposed
+            self.draft_tokens_accepted += accepted
+            rate = self.draft_tokens_accepted / self.draft_tokens_proposed \
+                if self.draft_tokens_proposed else 0.0
+        reg = _obs.registry()
+        reg.inc("generation/spec_rounds" + self._label)
+        reg.inc("generation/draft_steps" + self._label, draft_steps)
+        reg.set_gauge("generation/spec_accept_rate" + self._label, rate)
 
     def on_tokens(self, n: int, step_ms: float) -> None:
         """One decode step advancing `n` in-flight requests a token each."""
@@ -404,6 +445,17 @@ class GenerationMetrics:
                     "p50": round(self.e2e_ms.percentile(50), 3),
                     "p99": round(self.e2e_ms.percentile(99), 3),
                 },
+                "prefill_chunks": self.prefill_chunks,
+                "spec_rounds": self.spec_rounds,
+                "draft_steps": self.draft_steps,
+                "spec_accept_rate": round(
+                    self.draft_tokens_accepted / self.draft_tokens_proposed,
+                    4) if self.draft_tokens_proposed else 0.0,
+                "ttft_under_long_prefill_ms": {
+                    "count": self.ttft_long_ms.count,
+                    "p50": round(self.ttft_long_ms.percentile(50), 3),
+                    "p99": round(self.ttft_long_ms.percentile(99), 3),
+                },
             }
         reg = _obs.registry()
         reg.set_gauge("generation/ms_per_token_p50" + self._label, snap["ms_per_token"]["p50"])
@@ -429,6 +481,12 @@ class GenerationMetrics:
             f"{prefix}/rejected_nonfinite": snap["rejected_nonfinite"],
             f"{prefix}/active_slots_peak": snap["active_slots_peak"],
             f"{prefix}/decode_steps": snap["decode_steps"],
+            f"{prefix}/prefill_chunks": snap["prefill_chunks"],
+            f"{prefix}/spec_rounds": snap["spec_rounds"],
+            f"{prefix}/draft_steps": snap["draft_steps"],
+            f"{prefix}/spec_accept_rate": snap["spec_accept_rate"],
+            f"{prefix}/ttft_under_long_prefill_p99_ms":
+                snap["ttft_under_long_prefill_ms"]["p99"],
         }
         for tag, value in scalars.items():
             summary.add_scalar(tag, float(value), step)

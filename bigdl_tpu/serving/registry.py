@@ -46,6 +46,7 @@ class ModelRegistry:
         self._lock = threading.Lock()
         self._versions: Dict[str, ModelVersion] = {}
         self._active: Optional[ModelVersion] = None
+        self._draft: Optional[ModelVersion] = None
         self._warmups: List[Callable[[Any, Any], None]] = \
             [warmup] if warmup is not None else []
 
@@ -148,6 +149,38 @@ class ModelRegistry:
         if version is None:
             version = os.path.basename(str(ckpt_dir).rstrip("/"))
         return self.register_checkpoint(version, ckpt_dir, activate=activate)
+
+    def set_draft(self, version: str, params: Any,
+                  state: Any = None) -> ModelVersion:
+        """Install the speculative-decoding DRAFT model's weights beside
+        the target versions.  The draft is not a serving version (it
+        never becomes `active()`); it exists so the warmup chain can warm
+        the draft/verify executables exactly like target swaps warm
+        prefill/decode — when a target is already active, the chain is
+        re-run here so replacing the draft never cold-compiles the spec
+        lane mid-traffic.  Conversely `register()` re-runs the same
+        chain, so a TARGET hot-swap re-warms the verify executable (it
+        traces against target params) before activation."""
+        if state is None:
+            state = {}
+        params = jax.device_put(params)
+        state = jax.device_put(state)
+        mv = ModelVersion(str(version), params, state, time.time(), "draft")
+        with self._lock:
+            self._draft = mv
+        active = self._active
+        if active is not None:
+            for warmup in self._warmups:
+                with _obs.span("registry.warmup", cat="serving",
+                               version=f"draft:{mv.version}"):
+                    warmup(active.params, active.state)
+        _obs.instant("registry.set_draft", cat="serving", version=mv.version)
+        return mv
+
+    def draft(self) -> Optional[ModelVersion]:
+        """The installed draft version, or None (one atomic read, same
+        contract as `active()`)."""
+        return self._draft
 
     def activate(self, version: str) -> ModelVersion:
         """Atomic swap to an already-registered version (e.g. rollback)."""

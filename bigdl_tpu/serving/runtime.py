@@ -342,12 +342,18 @@ class ServingRuntime:
             return self._generation
         from bigdl_tpu.generation import GenerationConfig, GenerationEngine
 
+        # speculative decoding: the draft model rides through to the
+        # engine (and the registry's draft slot), not GenerationConfig
+        draft_model = config_kw.pop("draft_model", None)
+        draft_params = config_kw.pop("draft_params", None)
+        draft_version = config_kw.pop("draft_version", "draft")
         cfg = config or GenerationConfig(**config_kw)
         if cfg.strict_transfers is None:
             cfg.strict_transfers = self.config.strict_transfers
         self._generation = GenerationEngine(
             self.model, config=cfg, registry=self.registry,
-            summary=self.summary)
+            summary=self.summary, draft_model=draft_model,
+            draft_params=draft_params, draft_version=draft_version)
         return self._generation
 
     @property

@@ -1,0 +1,402 @@
+"""Chunked prefill + speculative decoding (spec-decode PR).
+
+The acceptance-criteria tests live here: chunked prefill must be
+BITWISE — folding a prompt in chunks at EVERY chunk size produces
+identical fp32 cache contents and an identical first sampled token to
+the unchunked prefill; speculative decoding must leave the output
+distribution unchanged — greedy spec-on equals greedy spec-off token
+for token across ring, paged, and int8-KV caches; rejected-suffix
+rollback through the paged pool must leak zero blocks; a prompt longer
+than the largest bucket routes through chunking (and stops counting as
+a wrapped prefill); and the pinned executable set grows to exactly the
+documented budget (5 per bucket with spec on, 2 without) with zero
+steady-state recompile alarms, surviving both target hot-swaps and
+draft replacement.
+
+Quick tier: target LM vocab 61 / hidden 32 / 2 layers, draft 1 layer.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import obs
+from bigdl_tpu.generation import (
+    GenerationConfig,
+    GenerationEngine,
+    insert,
+    slot_view,
+    spec_accept,
+)
+from bigdl_tpu.generation.engine import _chunk_schedule
+from bigdl_tpu.models.transformer import TransformerLM
+
+
+def _lm(**kw):
+    kw.setdefault("vocab_size", 61)
+    kw.setdefault("hidden_size", 32)
+    kw.setdefault("n_layer", 2)
+    kw.setdefault("n_head", 4)
+    kw.setdefault("max_len", 256)
+    kw.setdefault("use_flash", False)
+    model = TransformerLM(**kw)
+    params, _ = model.init((1, 16), rng=jax.random.PRNGKey(0))
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _lm()
+
+
+@pytest.fixture(scope="module")
+def draft():
+    # the spec-decode draft: same tokenizer/vocab, half the layers
+    return _lm(n_layer=1)
+
+
+def _prompts(sizes, seed=0, vocab=61):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, vocab, size=n).astype(np.int32).tolist()
+            for n in sizes]
+
+
+def _run_engine(model, params, prompts, **kw):
+    """Fresh monitor + engine; returns (token lists, compile count,
+    metrics snapshot, steady recompile count)."""
+    obs.set_observability(metrics=True, compile_monitor=True)
+    mon = obs.compile_monitor()
+    kw.setdefault("buckets", (32, 128))
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_new_tokens", 12)
+    kw.setdefault("temperature", 0.0)
+    eng = GenerationEngine(model, params, **kw)
+    try:
+        futs = [eng.submit(p) for p in prompts]
+        outs = [list(f.result(timeout=120).tokens) for f in futs]
+        return (outs, eng.compile_count(), eng.metrics.snapshot(),
+                mon.recompiles("generation/"), eng)
+    finally:
+        eng.close()
+
+
+# -- chunk schedule --------------------------------------------------------
+
+
+def test_chunk_schedule_covers_and_right_aligns():
+    # short prompt: one chunk, no padding games
+    assert _chunk_schedule(5, 8) == [(0, 5)]
+    assert _chunk_schedule(8, 8) == [(0, 8)]
+    # remainder is RIGHT-ALIGNED at full width: the last chunk re-writes
+    # the overlap bitwise-identically so every executable sees one shape
+    assert _chunk_schedule(20, 8) == [(0, 8), (8, 8), (12, 8)]
+    assert _chunk_schedule(16, 8) == [(0, 8), (8, 8)]
+    for n in range(1, 40):
+        for ch in range(1, 12):
+            sched = _chunk_schedule(n, ch)
+            covered = set()
+            for start, nv in sched:
+                assert nv <= ch and start + nv <= n
+                covered.update(range(start, start + nv))
+            assert covered == set(range(n)), (n, ch)
+            assert sched[-1][0] + sched[-1][1] == n  # ends exactly at n
+
+
+# -- chunk-boundary parity: bitwise cache + first token at every offset ----
+
+
+def test_chunked_prefill_bitwise_at_every_chunk_size(lm):
+    """Folding the prompt through slot_view/insert in chunks — the exact
+    engine protocol — must reproduce the unchunked prefill's fp32 cache
+    CONTENTS and final-position logits bit for bit, for every chunk
+    size >= 2 (every chunk size places its first boundary at a
+    different prompt offset, so this sweeps the boundary positions).
+    Width-1 chunks lower to XLA's gemv decode kernel instead of the
+    gemm path — same association-order drift the decode-parity TOL in
+    test_generation.py documents — so chunk=1 asserts tight allclose
+    plus an identical argmax (the sampled token stays invariant)."""
+    model, params = lm
+    toks = np.asarray(_prompts([13], seed=3)[0], np.int32)
+    n, cap = len(toks), 32
+
+    def fold(ch):
+        cache = model.init_cache(1, cap)
+        last = None
+        for start, nv in _chunk_schedule(n, ch):
+            sub = slot_view(cache, 0, start)
+            logp, sub = model.apply_cached(
+                params, jnp.asarray(toks[None, start:start + nv]), sub,
+                wrapped_append=True)
+            cache = insert(cache, 0, sub, start + nv)
+            last = np.asarray(logp)[0, nv - 1]
+        return np.asarray(cache.k), np.asarray(cache.v), last
+
+    k_ref, v_ref, logits_ref = fold(n)  # single chunk == unchunked
+    for ch in range(2, n):
+        k_ch, v_ch, logits_ch = fold(ch)
+        np.testing.assert_array_equal(k_ch, k_ref, err_msg=f"K, chunk={ch}")
+        np.testing.assert_array_equal(v_ch, v_ref, err_msg=f"V, chunk={ch}")
+        np.testing.assert_array_equal(logits_ch, logits_ref,
+                                      err_msg=f"logits, chunk={ch}")
+    k_1, v_1, logits_1 = fold(1)
+    np.testing.assert_allclose(k_1, k_ref, rtol=0, atol=2e-6)
+    np.testing.assert_allclose(v_1, v_ref, rtol=0, atol=2e-6)
+    assert int(np.argmax(logits_1)) == int(np.argmax(logits_ref))
+
+
+def test_engine_chunked_matches_unchunked_every_offset(lm):
+    """End to end: the first sampled token (and all that follow) are
+    chunking-invariant for chunk sizes that split the prompt at every
+    possible boundary."""
+    model, params = lm
+    prompts = _prompts([5, 17, 29], seed=1)
+    base, _, _, _, _ = _run_engine(model, params, prompts,
+                                   buckets=(32,), max_new_tokens=6)
+    for ch in (1, 3, 7, 16):
+        got, _, _, _, _ = _run_engine(model, params, prompts, buckets=(32,),
+                                      max_new_tokens=6, prefill_chunk=ch)
+        assert got == base, f"chunk={ch} diverged from unchunked"
+
+
+# -- spec-decode greedy parity: ring, paged, int8 --------------------------
+
+
+@pytest.mark.parametrize("extra", [
+    {},                                                     # ring fp32
+    {"paged": True, "kv_block_size": 16},                   # paged pool
+    {"cache_dtype": jnp.int8},                              # int8 ring KV
+    {"paged": True, "kv_block_size": 16,
+     "cache_dtype": jnp.int8},                              # int8 paged
+], ids=["ring", "paged", "int8", "paged-int8"])
+def test_spec_greedy_parity(lm, draft, extra):
+    """Greedy spec-on must emit the SAME token sequence as greedy
+    spec-off: acceptance keeps the argmax path, rejection emits the
+    target argmax — the output distribution is provably unchanged."""
+    model, params = lm
+    dm, dp = draft
+    prompts = _prompts([5, 17, 40, 70], seed=0)
+    base, _, _, _, _ = _run_engine(model, params, prompts, **extra)
+    got, _, snap, alarms, _ = _run_engine(
+        model, params, prompts, spec_decode=True, spec_k=3,
+        draft_model=dm, draft_params=dp, **extra)
+    assert got == base
+    assert alarms == 0
+    assert snap["spec_rounds"] > 0          # the spec lane actually ran
+    assert snap["draft_steps"] >= snap["spec_rounds"]
+    assert 0.0 <= snap["spec_accept_rate"] <= 1.0
+
+
+def test_chunk_plus_spec_together_match_baseline(lm, draft):
+    model, params = lm
+    dm, dp = draft
+    prompts = _prompts([5, 17, 40, 70], seed=0)
+    base, _, _, _, _ = _run_engine(model, params, prompts)
+    got, _, snap, alarms, _ = _run_engine(
+        model, params, prompts, prefill_chunk=8, spec_decode=True,
+        spec_k=3, draft_model=dm, draft_params=dp)
+    assert got == base
+    assert alarms == 0
+    assert snap["prefill_chunks"] > 0 and snap["spec_rounds"] > 0
+
+
+# -- rollback leak-check through the paged pool ----------------------------
+
+
+def test_spec_rollback_releases_all_blocks(lm, draft):
+    """Spec rounds claim blocks ahead for up to k+1 tokens and roll the
+    cache length back on rejection; after the traffic drains every
+    block and reservation must be back in the pool."""
+    model, params = lm
+    dm, dp = draft
+    prompts = _prompts([3, 9, 30, 6, 21, 14], seed=2)
+    _, _, snap, alarms, eng = _run_engine(
+        model, params, prompts, buckets=(32, 128), slots=2,
+        max_new_tokens=8, paged=True, kv_block_size=8, kv_pool_blocks=40,
+        spec_decode=True, spec_k=3, draft_model=dm, draft_params=dp)
+    assert snap["spec_rounds"] > 0
+    assert alarms == 0
+    pool = eng._pool
+    assert pool.blocks_free == pool.n_allocatable, "leaked blocks"
+    assert pool.blocks_reserved == 0, "leaked reservations"
+    for lane in eng._lanes.values():
+        assert all(not c for c in lane.claimed)
+        assert (lane.table_np == 0).all()
+
+
+# -- long prompts route through chunking (wrapped_prefills regression) -----
+
+
+def test_long_prompt_chunks_instead_of_wrapping(lm):
+    """With chunking ON a prompt longer than the largest bucket folds
+    through the ring chunk-by-chunk: `generation/chunked_long_prompts`
+    increments and `generation/wrapped_prefills` must NOT (the
+    single-shot lossy wrap is gone from this path)."""
+    model, params = lm
+    obs.set_observability(metrics=True, compile_monitor=True)
+    reg = obs.registry()
+    reg.reset("generation/wrapped_prefills")
+    reg.reset("generation/chunked_long_prompts")
+    long = _prompts([50], seed=4)[0]
+    with GenerationEngine(model, params, buckets=(32,), slots=2,
+                          max_new_tokens=4, temperature=0.0,
+                          prefill_chunk=8) as eng:
+        res = eng.generate(long, timeout=120)
+        assert res.meta["finish_reason"] in ("length", "eos")
+    assert reg.get("generation/chunked_long_prompts") == 1
+    assert not reg.get("generation/wrapped_prefills")
+    # chunking OFF keeps the pre-PR contract: too-long prompts are
+    # rejected at submit (test_engine_validates_prompts locks the wording)
+    with GenerationEngine(model, params, buckets=(16,), slots=1,
+                          max_new_tokens=4) as eng:
+        with pytest.raises(ValueError, match="bucket"):
+            eng.submit(list(range(17)))
+
+
+def test_short_request_admitted_during_long_prefill(lm):
+    """Stall-free admission: while a long prompt is mid-chunking, a
+    short request entering the other slot must complete — and its TTFT
+    lands in the contended histogram."""
+    model, params = lm
+    obs.set_observability(metrics=True, compile_monitor=True)
+    long = _prompts([120], seed=5)[0]
+    with GenerationEngine(model, params, buckets=(128,), slots=2,
+                          max_new_tokens=64, temperature=0.0,
+                          prefill_chunk=4) as eng:
+        f_long = eng.submit(long, max_new_tokens=64)
+        f_short = eng.submit([9, 9], max_new_tokens=2)
+        r_short = f_short.result(timeout=120)
+        r_long = f_long.result(timeout=240)
+        snap = eng.metrics.snapshot()
+    assert len(r_short.tokens) == 2 and len(r_long.tokens) == 64
+    assert snap["prefill_chunks"] >= 30  # 120 tokens / 4-wide chunks
+    assert snap["ttft_under_long_prefill_ms"]["count"] >= 1
+
+
+# -- pinned executable budget + steady-state alarms ------------------------
+
+
+def test_compile_budget_chunk_and_spec(lm, draft):
+    """The documented pinned set: 2 executables per bucket without spec
+    (chunked prefill REPLACES the one-shot prefill, it does not add),
+    5 per bucket with spec on (prefill/chunk, decode, draft prefill/
+    chunk, draft step, verify) — zero steady alarms under a burst."""
+    model, params = lm
+    dm, dp = draft
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 61, size=rng.randint(2, 30)).tolist()
+               for _ in range(24)]
+    _, cc, _, alarms, _ = _run_engine(model, params, prompts,
+                                      prefill_chunk=8, max_new_tokens=4)
+    assert cc <= 2 * 2 and alarms == 0
+    _, cc, _, alarms, _ = _run_engine(
+        model, params, prompts, prefill_chunk=8, spec_decode=True,
+        spec_k=3, draft_model=dm, draft_params=dp, max_new_tokens=4)
+    assert cc <= 5 * 2 and alarms == 0
+
+
+def test_swap_keeps_spec_executables_warm(lm, draft):
+    """A TARGET hot-swap re-runs the warmup chain over the draft/verify
+    lane; a DRAFT replacement likewise — neither may grow the
+    executable set or trip a steady-state alarm mid-traffic."""
+    model, params = lm
+    dm, dp = draft
+    params2 = jax.tree_util.tree_map(lambda a: a * 1.5, params)
+    dp2 = jax.tree_util.tree_map(lambda a: a * 0.5, dp)
+    obs.set_observability(metrics=True, compile_monitor=True)
+    mon = obs.compile_monitor()
+    with GenerationEngine(model, params, buckets=(32,), slots=2,
+                          max_new_tokens=4, temperature=0.0,
+                          spec_decode=True, spec_k=3,
+                          draft_model=dm, draft_params=dp) as eng:
+        r0 = eng.generate([3, 1, 4], timeout=120)
+        n0 = eng.compile_count()
+        eng.swap("v1", params2)                      # target hot-swap
+        r1 = eng.generate([3, 1, 4], timeout=120)
+        assert eng.compile_count() == n0
+        eng.registry.set_draft("draft-v2", dp2)      # draft replacement
+        r2 = eng.generate([3, 1, 4], timeout=120)
+        assert eng.compile_count() == n0
+        assert mon.recompiles("generation/") == 0, mon.snapshot()
+        assert r0.meta["version"] == "v0"
+        assert r1.meta["version"] == r2.meta["version"] == "v1"
+        assert eng.metrics.snapshot()["spec_rounds"] > 0
+
+
+# -- config gates: both features off reproduce pre-PR behaviour ------------
+
+
+def test_defaults_keep_both_features_off(monkeypatch):
+    monkeypatch.delenv("BIGDL_TPU_PREFILL_CHUNK", raising=False)
+    monkeypatch.delenv("BIGDL_TPU_SPEC_DECODE", raising=False)
+    cfg = GenerationConfig(buckets=(16,))
+    assert cfg.prefill_chunk == 0 and not cfg.spec_decode
+    assert cfg.chunk_for(16) == 0
+
+
+def test_env_gates_parse(monkeypatch):
+    monkeypatch.setenv("BIGDL_TPU_PREFILL_CHUNK", "8")
+    monkeypatch.setenv("BIGDL_TPU_SPEC_DECODE", "3")
+    cfg = GenerationConfig(buckets=(32,))
+    assert cfg.prefill_chunk == 8
+    assert cfg.spec_decode and cfg.spec_k == 3
+    assert cfg.chunk_for(32) == 8 and cfg.chunk_for(4) == 4
+    monkeypatch.setenv("BIGDL_TPU_SPEC_DECODE", "off")
+    assert not GenerationConfig(buckets=(32,)).spec_decode
+    # spec window must fit the smallest bucket
+    with pytest.raises(ValueError, match="spec_k"):
+        GenerationConfig(buckets=(4,), spec_decode=True, spec_k=8)
+
+
+def test_spec_without_draft_degrades_to_plain_decode(lm, caplog):
+    """spec_decode=True with no draft model: warn and serve plain —
+    never crash, never change outputs."""
+    model, params = lm
+    prompts = _prompts([5, 9], seed=6)
+    base, cc_base, _, _, _ = _run_engine(model, params, prompts,
+                                         buckets=(32,))
+    with caplog.at_level("WARNING", logger="bigdl_tpu.generation"):
+        got, cc, snap, _, _ = _run_engine(model, params, prompts,
+                                          buckets=(32,), spec_decode=True)
+    assert any("draft" in r.message for r in caplog.records)
+    assert got == base and cc == cc_base
+    assert snap["spec_rounds"] == 0
+
+
+# -- spec_accept unit behaviour --------------------------------------------
+
+
+def test_spec_accept_greedy_prefix_and_correction():
+    """Greedy rows accept exactly the matching prefix and emit the
+    target argmax at the first mismatch (or the bonus row on a full
+    accept) — the construction that makes spec-on == spec-off."""
+    v, k = 7, 3
+    p = jnp.full((2, k + 1, v), -10.0)
+    # target argmax path: 4, 5, 6, then bonus 1
+    for row, tok in enumerate((4, 5, 6, 1)):
+        p = p.at[:, row, tok].set(0.0)
+    q = jnp.full((2, k, v), -1.0)  # draft dists (only used for sampled rows)
+    draft = jnp.asarray([[4, 5, 6],     # full match -> accept 3, emit bonus 1
+                         [4, 2, 6]])    # mismatch at i=1 -> accept 1, emit 5
+    n_acc, emitted = spec_accept(p, q, draft, jnp.zeros((2,)),
+                                 jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(n_acc), [3, 1])
+    np.testing.assert_array_equal(np.asarray(emitted), [1, 5])
+
+
+def test_spec_accept_sampled_rows_bounded():
+    """Sampled rows: n_acc stays in [0, k] and the emitted token is a
+    valid vocab id drawn from the residual/bonus distribution."""
+    rng = jax.random.PRNGKey(1)
+    v, k, b = 11, 4, 3
+    p = jax.nn.log_softmax(jax.random.normal(rng, (b, k + 1, v)))
+    q = jax.nn.log_softmax(jax.random.normal(jax.random.fold_in(rng, 1),
+                                             (b, k, v)))
+    draft = jax.random.randint(jax.random.fold_in(rng, 2), (b, k), 0, v)
+    n_acc, emitted = spec_accept(p, q, draft, jnp.ones((b,)) * 0.8,
+                                 jax.random.PRNGKey(3))
+    assert ((np.asarray(n_acc) >= 0) & (np.asarray(n_acc) <= k)).all()
+    assert ((np.asarray(emitted) >= 0) & (np.asarray(emitted) < v)).all()
