@@ -9,10 +9,13 @@ The LLM-serving subsystem: ring-buffer KV caches at bucketed max lengths
 machinery.  Chunked prefill (`BIGDL_TPU_PREFILL_CHUNK`) interleaves long
 prompt ingestion with in-flight decode; speculative decoding
 (`BIGDL_TPU_SPEC_DECODE` + a draft model) runs a draft-verify lane with
-a provably unchanged output distribution (sampling.spec_accept).  See
+a provably unchanged output distribution (sampling.spec_accept); the
+content-addressed prefix store (prefixcache.py, `BIGDL_TPU_PREFIX_CACHE`)
+shares refcounted immutable pool blocks across requests with a common
+prompt head, so chunked prefill skips the warm chunks entirely.  See
 the module docstrings and docs/serving.md "Autoregressive generation" /
 "Paged KV & quantized cache" / "Chunked prefill & speculative
-decoding".
+decoding" / "Prefix caching".
 
 ```python
 from bigdl_tpu.generation import GenerationEngine
@@ -41,6 +44,11 @@ from bigdl_tpu.generation.pagedkv import (
     PagedKVCache,
     blocks_for,
 )
+from bigdl_tpu.generation.prefixcache import (
+    PrefixStore,
+    block_addr,
+    world_key,
+)
 from bigdl_tpu.generation.sampling import (
     adjusted_log_probs,
     apply_top_k,
@@ -56,12 +64,15 @@ __all__ = [
     "GenerationResult",
     "KVCache",
     "PagedKVCache",
+    "PrefixStore",
     "adjusted_log_probs",
     "alloc",
     "apply_top_k",
+    "block_addr",
     "blocks_for",
     "insert",
     "sample_tokens",
     "slot_view",
     "spec_accept",
+    "world_key",
 ]

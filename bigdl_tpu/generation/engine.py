@@ -66,6 +66,7 @@ from bigdl_tpu.generation.kvcache import slot_view as _ring_slot_view
 from bigdl_tpu.generation.pagedkv import (DEFAULT_BLOCK_SIZE, BlockPool,
                                           PagedKVCache, blocks_for)
 from bigdl_tpu.generation.pagedkv import slot_view as _paged_slot_view
+from bigdl_tpu.generation.prefixcache import PrefixStore, world_key
 from bigdl_tpu.generation.sampling import sample_tokens, spec_accept
 from bigdl_tpu.serving.batcher import Rejected, ServingClosed, _Future
 from bigdl_tpu.serving.metrics import GenerationMetrics
@@ -90,6 +91,22 @@ _KV_DTYPES = {"int8": jnp.int8, "bf16": jnp.bfloat16,
 # fresh numbers in spec_quick.json.
 _MEASURED_CHUNK_DEFAULTS = {"cpu": 0, "tpu": 0}
 _MEASURED_SPEC_DEFAULTS = {"cpu": False, "tpu": False}
+# Prefix caching (benchmarks/bench_generation.py --prefix-quick, numbers
+# in benchmarks/results/prefix_quick.json): shared-on wins its bars on
+# cpu — fewer cold prefill tokens and chunks, lower p50 TTFT, bitwise
+# parity — but it REQUIRES chunked prefill, which ships opt-in as an
+# admission-policy change, so the default follows its prerequisite: off
+# until a deployment opts into chunking and flips
+# BIGDL_TPU_PREFIX_CACHE alongside it.
+_MEASURED_PREFIX_DEFAULTS = {"cpu": False, "tpu": False}
+
+_SIZE_SUFFIX = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}
+
+
+def _parse_bytes(text: str) -> int:
+    t = text.strip().lower()
+    mult = _SIZE_SUFFIX.get(t[-1:], 1)
+    return int(float(t[:-1] if mult != 1 else t) * mult)
 
 
 class GenerationConfig:
@@ -106,7 +123,12 @@ class GenerationConfig:
     `BIGDL_TPU_SPEC_DECODE` (on/off, or an integer which both enables
     speculative decoding and sets `spec_k`), falling back to the
     per-backend measured defaults above (docs/serving.md "Chunked
-    prefill & speculative decoding")."""
+    prefill & speculative decoding").
+
+    `prefix_cache=None` defers to `BIGDL_TPU_PREFIX_CACHE` (on/off, or
+    a byte budget like `64M` which also caps the store) with
+    `BIGDL_TPU_PREFIX_CACHE_MAX_BLOCKS` as a block-count cap; requires
+    paged KV + chunked prefill (docs/serving.md "Prefix caching")."""
 
     def __init__(self, buckets: Sequence[int] = (64, 256), slots: int = 4,
                  capacity: int = 128, max_new_tokens: int = 64,
@@ -118,7 +140,10 @@ class GenerationConfig:
                  kv_block_size: int = DEFAULT_BLOCK_SIZE,
                  kv_pool_blocks: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
-                 spec_decode: Optional[bool] = None, spec_k: int = 4):
+                 spec_decode: Optional[bool] = None, spec_k: int = 4,
+                 prefix_cache: Optional[bool] = None,
+                 prefix_cache_bytes: Optional[int] = None,
+                 prefix_cache_max_blocks: Optional[int] = None):
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         if not self.buckets or self.buckets[0] < 2:
             raise ValueError(f"length buckets must be >= 2, got {buckets}")
@@ -182,6 +207,56 @@ class GenerationConfig:
             else:
                 spec_decode = _MEASURED_SPEC_DEFAULTS.get(
                     jax.default_backend(), False)
+        self.prefix_cache_bytes = prefix_cache_bytes
+        if prefix_cache is None:
+            env = os.environ.get("BIGDL_TPU_PREFIX_CACHE", "").strip().lower()
+            if env in ("1", "on", "true", "yes"):
+                prefix_cache = True
+            elif env in ("0", "off", "false", "no"):
+                prefix_cache = False
+            elif env:
+                try:
+                    self.prefix_cache_bytes = _parse_bytes(env)
+                except ValueError:
+                    raise ValueError(
+                        f"BIGDL_TPU_PREFIX_CACHE={env!r}: expected on/off "
+                        "or a byte budget like 64M / 2G")
+                prefix_cache = True
+            else:
+                prefix_cache = _MEASURED_PREFIX_DEFAULTS.get(
+                    jax.default_backend(), False)
+        self.prefix_cache = bool(prefix_cache)
+        if prefix_cache_max_blocks is None:
+            env = os.environ.get(
+                "BIGDL_TPU_PREFIX_CACHE_MAX_BLOCKS", "").strip()
+            if env:
+                try:
+                    prefix_cache_max_blocks = int(env)
+                except ValueError:
+                    raise ValueError(
+                        f"BIGDL_TPU_PREFIX_CACHE_MAX_BLOCKS={env!r}: "
+                        "expected an integer block count")
+        self.prefix_cache_max_blocks = prefix_cache_max_blocks
+        if self.prefix_cache:
+            # the store shares immutable POOL blocks and skips CHUNKS —
+            # both prerequisites are hard, so misconfiguration fails
+            # loudly instead of silently serving cold
+            if not self.paged:
+                raise ValueError(
+                    "prefix_cache requires the paged KV allocator "
+                    "(paged=True / BIGDL_TPU_PAGED_KV=1): only pool "
+                    "blocks can be shared across slots")
+            if self.prefill_chunk <= 0:
+                raise ValueError(
+                    "prefix_cache requires chunked prefill "
+                    "(prefill_chunk / BIGDL_TPU_PREFILL_CHUNK > 0): hits "
+                    "are realized by skipping whole prefill chunks")
+            if self.prefill_chunk % self.kv_block_size:
+                raise ValueError(
+                    f"prefix_cache needs prefill_chunk "
+                    f"({self.prefill_chunk}) divisible by kv_block_size "
+                    f"({self.kv_block_size}) so chunk boundaries land on "
+                    "block boundaries")
         self.spec_decode = bool(spec_decode)
         if self.spec_decode:
             if self.spec_k < 1:
@@ -224,7 +299,8 @@ class _PrefillState:
     long prefill was already in flight at admission (feeds the
     TTFT-under-long-prompt histogram)."""
 
-    __slots__ = ("req", "sched", "next_i", "prefill_ms", "contended")
+    __slots__ = ("req", "sched", "next_i", "prefill_ms", "contended",
+                 "long", "map_shared")
 
     def __init__(self, req, sched, contended):
         self.req = req
@@ -232,6 +308,16 @@ class _PrefillState:
         self.next_i = 0
         self.prefill_ms = 0.0
         self.contended = contended
+        # spans >1 scheduler pass (counted in _long_inflight); a prefix
+        # hit can resume the schedule at its last chunk, making a long
+        # prompt short — admission overrides after seeding next_i
+        self.long = len(sched) > 1
+        # shared blocks to map into the device table at the FIRST fold
+        # (not at admission): the batched decode step writes K/V for
+        # every slot at its DEVICE length, and a just-admitted slot's
+        # device length is stale until its first fold sets it — mapping
+        # early would let that garbage write land inside a shared block
+        self.map_shared = 0
 
 
 def _chunk_schedule(n: int, ch: int) -> "List[Tuple[int, int]]":
@@ -423,6 +509,16 @@ class GenerationEngine:
                     for b in self.config.buckets)
             self._pool = BlockPool(n_layer, int(n_blocks), blk, n_head,
                                    head_dim, self.config.cache_dtype)
+        self._prefix: Optional[PrefixStore] = None
+        self._prefix_version: Optional[str] = None
+        if self.config.prefix_cache:
+            # config validation guarantees paged + chunked here; the
+            # reclaim hook lets a claim shortfall evict idle store
+            # entries instead of failing
+            self._prefix = PrefixStore(
+                self._pool, max_bytes=self.config.prefix_cache_bytes,
+                max_blocks=self.config.prefix_cache_max_blocks)
+            self._pool.set_reclaim(self._prefix.reclaim)
         self._lanes: Dict[int, _Lane] = {
             b: _Lane(model, b, self.config.slots, self.config.cache_dtype,
                      pool=self._pool, draft_model=self._draft_model)
@@ -832,6 +928,50 @@ class GenerationEngine:
             return self._pool.nbytes()
         return sum(lane.cache.nbytes() for lane in self._lanes.values())
 
+    def _prefix_store(self, snap: ModelVersion) -> Optional[PrefixStore]:
+        """The prefix store pinned to `snap`'s KV world — refreshes the
+        world fingerprint on the first touch after a hot-swap, which
+        sweeps idle entries written under the old weights (in-flight
+        mappings linger until their slots retire, then evict)."""
+        if self._prefix is None:
+            return None
+        if snap.version != self._prefix_version:
+            self._prefix.set_world(world_key(
+                snap.version, _tree_sig(snap.params),
+                str(jnp.dtype(self.config.cache_dtype)),
+                self.config.kv_block_size))
+            self._prefix_version = snap.version
+        return self._prefix
+
+    @property
+    def prefix_store(self) -> Optional[PrefixStore]:
+        return self._prefix
+
+    def kv_sharing(self) -> Dict[str, int]:
+        """Host-side sharing snapshot: logical resident blocks (each
+        slot's claims counted independently), unique resident blocks
+        (slot claims + store-held), and the bytes each implies — the
+        resident-tokens-per-HBM-byte numerator/denominator for the
+        prefix A/B (no device sync)."""
+        if self._pool is None:
+            return {}
+        per_block = self._pool.bytes_per_token() * self._pool.block_size
+        logical = 0
+        uniq: set = set()
+        tokens = 0
+        for lane in self._lanes.values():
+            for s in range(self.config.slots):
+                logical += len(lane.claimed[s])
+                uniq.update(lane.claimed[s])
+                tokens += int(min(lane.lengths_np[s], lane.bucket))
+        if self._prefix is not None:
+            uniq.update(self._prefix.block_ids())
+        return {"logical_blocks": logical, "unique_blocks": len(uniq),
+                "logical_bytes": logical * per_block,
+                "unique_bytes": len(uniq) * per_block,
+                "resident_tokens": tokens,
+                "shared_blocks": self._pool.blocks_shared}
+
     def _update_kv_gauges(self) -> None:
         # HBM budgeting gauges (Prometheus: bigdl_tpu_generation_...
         # {lane="..."}); host-side arithmetic only, no device sync
@@ -843,6 +983,11 @@ class GenerationEngine:
                           float(self._pool.blocks_free))
             reg.set_gauge("generation/kv_blocks_reserved",
                           float(self._pool.blocks_reserved))
+            reg.set_gauge("generation/kv_blocks_shared",
+                          float(self._pool.blocks_shared))
+            if self._prefix is not None:
+                reg.set_gauge("generation/prefix_cache_blocks",
+                              float(len(self._prefix)))
         else:
             for b, lane in self._lanes.items():
                 reg.set_gauge(f"generation/kv_hbm_bytes|lane={b}",
@@ -955,6 +1100,11 @@ class GenerationEngine:
                             "tokens (further wraps counted in "
                             "generation/wrapped_prefills, warned once)",
                             n, req.max_new, lane.bucket, lane.bucket)
+            sched = _chunk_schedule(n, self.config.chunk_for(lane.bucket)) \
+                if self._chunk_on else None
+            shared_ids: List[int] = []
+            skip = 0       # prompt tokens covered by mapped shared blocks
+            resume_i = 0   # first chunk of the schedule that still folds
             if self._pool is not None:
                 # worst-case logical reservation up front so the lazy
                 # per-step claims below can never fail mid-decode; spec
@@ -970,9 +1120,45 @@ class GenerationEngine:
                         f"has {self._pool.n_allocatable}; raise "
                         "kv_pool_blocks or shrink max_new_tokens"))
                     continue
+                store = self._prefix_store(snap)
+                if store is not None and sched is not None \
+                        and len(sched) > 1 \
+                        and n + req.max_new + spec_extra <= lane.bucket:
+                    # map the warm prefix read-only: resume the chunk
+                    # schedule at the largest block-aligned offset the
+                    # store's cached prefix covers.  The final chunk
+                    # always folds (it samples token #1), so even a
+                    # full-prompt hit runs one chunk — which also
+                    # guarantees every subsequent write (cold suffix,
+                    # decode, spec overhang) lands past `skip`, i.e. in
+                    # private blocks: copy-on-write by never mapping the
+                    # first divergent block.  Wrap lanes are excluded —
+                    # a wrapping ring rewrites low block indices, which
+                    # must stay private.
+                    blk = self._pool.block_size
+                    hit_ids = store.lookup(req.prompt)
+                    hit = len(hit_ids) * blk
+                    for i in range(1, len(sched)):
+                        off = sched[i][0]
+                        if off > hit:
+                            break
+                        if off % blk == 0:
+                            resume_i = i
+                    if resume_i > 0:
+                        skip = sched[resume_i][0]
+                        shared_ids = hit_ids[:skip // blk]
+                        # pin BEFORE reserving: the reserve gate
+                        # discounts shared (refcount >= 2) blocks
+                        self._pool.addref(shared_ids)
+                # a warm prefix is already resident: reserve only the
+                # COLD blocks, or a warm pool rejects requests it can
+                # serve (tests/test_pagedkv.py oversubscription test)
+                need -= len(shared_ids)
                 if not self._pool.reserve(need):
                     # pool budget exhausted: requeue at head, retry after
                     # an in-flight request retires and releases blocks
+                    if shared_ids:
+                        self._pool.release(shared_ids)
                     with self._cond:
                         self._pending.appendleft(req)
                     return
@@ -988,18 +1174,42 @@ class GenerationEngine:
                 # the pinned set is unchanged): short requests pay no
                 # scheduler-pass deferral for having chunking enabled
                 if self._pool is not None:
-                    lane.claimed[s] = []
+                    # a mapped hit prefix seeds claimed[s] with SHARED
+                    # ids (a dense prefix, so the lazy claim cursor and
+                    # the uniform release-on-retire path need no special
+                    # casing) — but the DEVICE table row stays all-trash
+                    # until the first fold maps them (ps.map_shared):
+                    # until that fold sets the slot's device length,
+                    # batched-decode writes for this not-yet-active slot
+                    # land at a STALE device length, and the trash row is
+                    # what keeps them out of the shared blocks
+                    lane.claimed[s] = list(shared_ids)
                     lane.reserved[s] = need
                     lane.table_np[s, :] = 0
                     lane._table_dirty = True
                     self._update_kv_gauges()
-                lane.lengths_np[s] = 0
+                lane.lengths_np[s] = skip
                 lane.slots[s] = _SlotState(req)
                 lane.active_np[s] = False
-                sched = _chunk_schedule(n, self.config.chunk_for(lane.bucket))
-                lane.prefilling[s] = _PrefillState(
-                    req, sched, self._long_inflight > 0)
-                if len(sched) > 1:
+                ps = _PrefillState(req, sched, self._long_inflight > 0)
+                ps.next_i = resume_i
+                ps.long = len(sched) - resume_i > 1
+                ps.map_shared = len(shared_ids)
+                lane.prefilling[s] = ps
+                if skip:
+                    if self._spec_on:
+                        # the draft cache never sees the skipped chunks,
+                        # so its prefix K/V would be garbage: latch the
+                        # slot out of speculative rounds (verify would
+                        # stay correct, but every proposal would be
+                        # noise) — spec and shared prefixes meet only
+                        # through private tail blocks
+                        lane.spec_stale[s] = True
+                    self.metrics.on_prefix_hit(skip)
+                    _obs.instant("gen.prefix_hit", cat="generation",
+                                 cid=req.cid, tokens=skip,
+                                 blocks=len(shared_ids))
+                if ps.long:
                     self._long_inflight += 1
                 else:
                     self._advance_prefill(lane, snap, tr, slot=s)
@@ -1084,6 +1294,18 @@ class GenerationEngine:
         ch = self.config.chunk_for(lane.bucket)
         if self._pool is not None:
             blk = self._pool.block_size
+            if ps.map_shared:
+                # deferred hit mapping: the shared ids enter the device
+                # table in the SAME launch that folds the first cold
+                # chunk and sets the slot's device length past them —
+                # between admission and here the row was all-trash, so
+                # batched-decode writes for this not-yet-active slot
+                # (landing at its stale device length) hit the trash
+                # block, never a shared one
+                lane.table_np[s, :ps.map_shared] = \
+                    lane.claimed[s][:ps.map_shared]
+                lane._table_dirty = True
+                ps.map_shared = 0
             # claims stay a dense prefix of block indices; a chunk that
             # wrapped past the ring cycles into already-claimed low
             # indices and claims nothing new
@@ -1135,7 +1357,7 @@ class GenerationEngine:
         if not final:
             return
         del lane.prefilling[s]
-        if len(ps.sched) > 1:
+        if ps.long:
             self._long_inflight -= 1
         st = lane.slots[s]
         st.t_first = t1
@@ -1149,6 +1371,18 @@ class GenerationEngine:
         if self.config.reject_nonfinite and not ok:
             self._retire(lane, s, "error", tr)
             return
+        store = self._prefix_store(snap) if self._pool is not None else None
+        if store is not None:
+            spec_extra = self.config.spec_k if self._spec_on else 0
+            npr = int(req.prompt.size)
+            if npr + req.max_new + spec_extra <= lane.bucket:
+                # offer the folded prompt's full blocks to the store
+                # (blocks whose address is already cached keep the
+                # existing entry; fresh ones get the store's own pin).
+                # Wrap lanes never publish: their low blocks get
+                # rewritten by the sliding window.
+                if store.publish(req.prompt, npr, lane.claimed[s]):
+                    self._update_kv_gauges()
         st.generated = 1
         if (req.eos_id is not None and tok == req.eos_id) \
                 or req.max_new <= 1:

@@ -35,7 +35,8 @@ write and dequantized fused into the decode attention read
 from __future__ import annotations
 
 import threading
-from typing import List, NamedTuple, Optional, Sequence
+from typing import (Callable, Dict, List, NamedTuple, Optional, Sequence,
+                    Tuple)
 
 import jax
 import jax.numpy as jnp
@@ -102,6 +103,32 @@ class PagedKVCache(NamedTuple):
         return _leaf_nbytes(self.k, self.v, self.k_scale, self.v_scale,
                             self.block_tables, self.lengths)
 
+    def resident_nbytes(self) -> "Tuple[int, int]":
+        """(logical, unique) resident K/V bytes across this lane's slots.
+
+        Logical counts every slot's resident blocks independently; unique
+        counts distinct pool block ids, so `logical / unique` is the
+        prefix-sharing ratio (1.0 with no shared blocks).  Trash-block
+        entries (id 0) are excluded from both.  Pulls the table/lengths
+        mirrors to host — a reporting method, not a hot-path one."""
+        tables = np.asarray(self.block_tables)
+        lengths = np.asarray(self.lengths)
+        n_layer, _, blk, n_head, head_dim = self.k.shape
+        per_block = 2 * n_layer * blk * n_head * head_dim \
+            * self.k.dtype.itemsize
+        if self.k_scale is not None:
+            per_block += 2 * n_layer * blk * n_head \
+                * self.k_scale.dtype.itemsize
+        logical = 0
+        uniq: set = set()
+        for s in range(tables.shape[0]):
+            nb = min(blocks_for(min(int(lengths[s]), self.capacity), blk),
+                     self.max_blocks)
+            ids = [int(b) for b in tables[s, :nb] if int(b) != 0]
+            logical += len(ids)
+            uniq.update(ids)
+        return logical * per_block, len(uniq) * per_block
+
 
 def blocks_for(tokens: int, block_size: int) -> int:
     """Blocks needed to hold `tokens` resident tokens."""
@@ -139,6 +166,20 @@ class BlockPool:
     reservation, `claim` cannot fail mid-decode — admission is the only
     place that can run out, and it backpressures there.  Thread-safe:
     the engine loop and `export_metrics` callers may race.
+
+    Blocks are REFCOUNTED so the prefix store (prefixcache.py) can map
+    one immutable block into several slots: `claim` hands out blocks at
+    refcount 1, `addref` pins an extra owner, and `release` only returns
+    a block to the free list when the last owner lets go — slot retire
+    paths call the same `release` whether a block was private or shared.
+    The reserve gate discounts shared blocks (refcount >= 2): a shared
+    block is pinned by the store for as long as any slot maps it, so no
+    reservation will ever need to claim it again, and counting it
+    against the budget would make a warm pool reject requests it can
+    serve.  Invariant: claims stay fail-safe because
+    `sum(reservations) <= n_allocatable - blocks_shared` at every grant,
+    and store-held idle blocks (refcount 1, no slot) are reclaimed on
+    demand via the `set_reclaim` hook before a claim is allowed to fail.
     """
 
     def __init__(self, n_layer: int, n_blocks: int, block_size: int,
@@ -155,11 +196,15 @@ class BlockPool:
             sshape = (n_layer, n_blocks, block_size, n_head)
             self.k_scale = jax.device_put(jnp.zeros(sshape, jnp.float32))
             self.v_scale = jax.device_put(jnp.zeros(sshape, jnp.float32))
-        self._lock = threading.Lock()
+        # reentrant: a claim shortfall invokes the reclaim hook, whose
+        # evictions call back into release() on the same thread
+        self._lock = threading.RLock()
         # LIFO free list: recently-released blocks are re-claimed first,
         # keeping the hot working set compact in the pool
         self._free: List[int] = list(range(n_blocks - 1, 0, -1))
         self._reserved = 0
+        self._refs: Dict[int, int] = {}  # block id -> owner count
+        self._reclaim: Optional[Callable[[int], int]] = None
 
     # -- sizing ------------------------------------------------------------
 
@@ -181,6 +226,13 @@ class BlockPool:
         with self._lock:
             return self._reserved
 
+    @property
+    def blocks_shared(self) -> int:
+        """Blocks with more than one owner (store + >=1 slot, or two
+        slots riding one prefix) — the `kv_blocks_shared` gauge."""
+        with self._lock:
+            return sum(1 for c in self._refs.values() if c >= 2)
+
     def nbytes(self) -> int:
         return _leaf_nbytes(self.k, self.v, self.k_scale, self.v_scale)
 
@@ -195,11 +247,27 @@ class BlockPool:
 
     # -- allocation --------------------------------------------------------
 
+    def set_reclaim(self, cb: Optional[Callable[[int], int]]) -> None:
+        """Install the claim-shortfall hook: `cb(n)` must try to free at
+        least `n` blocks (the prefix store evicts idle refcount-1
+        entries) and return how many it released.  Called under the pool
+        lock on the claiming thread — the lock is reentrant so the
+        hook's `release` calls land back here safely."""
+        with self._lock:
+            self._reclaim = cb
+
     def reserve(self, n: int) -> bool:
         """Logically reserve `n` blocks at admission; False = pool budget
-        exhausted (caller keeps the request queued)."""
+        exhausted (caller keeps the request queued).  Shared blocks
+        (refcount >= 2) are discounted from the budget: they are pinned
+        resident already, so a request riding them reserves only its
+        COLD blocks — the caller subtracts the hit prefix before calling.
+        The published-but-still-private overlap (a slot's own blocks the
+        store just pinned) double-counts against the budget until that
+        slot retires; conservative, never unsafe."""
         with self._lock:
-            if self._reserved + n > self.n_allocatable:
+            shared = sum(1 for c in self._refs.values() if c >= 2)
+            if self._reserved + n > self.n_allocatable - shared:
                 return False
             self._reserved += n
             return True
@@ -210,22 +278,48 @@ class BlockPool:
             assert self._reserved >= 0, "unreserve underflow"
 
     def claim(self, n: int = 1) -> List[int]:
-        """Physically allocate `n` block ids.  Raises if the free list
-        is short — impossible while every claim is reservation-covered."""
+        """Physically allocate `n` block ids at refcount 1.  A shortfall
+        first asks the reclaim hook to evict idle store-held blocks;
+        raising after that is impossible while every claim is
+        reservation-covered (reservations are granted against
+        `n_allocatable - blocks_shared`, and non-shared resident blocks
+        are either reservation-covered or reclaimable)."""
         with self._lock:
+            if len(self._free) < n and self._reclaim is not None:
+                self._reclaim(n - len(self._free))
             if len(self._free) < n:
                 raise RuntimeError(
                     f"block pool exhausted: want {n}, free {len(self._free)}"
                     " (claim without a covering reservation?)")
             out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._refs[b] = 1
             return out
 
+    def addref(self, ids: Sequence[int]) -> None:
+        """Pin an extra owner on already-claimed blocks (the prefix
+        store on publish; the engine when mapping a hit into a slot)."""
+        with self._lock:
+            for b in ids:
+                assert b in self._refs, f"addref of unclaimed block {b}"
+                self._refs[b] += 1
+
+    def refcount(self, b: int) -> int:
+        with self._lock:
+            return self._refs.get(int(b), 0)
+
     def release(self, ids: Sequence[int]) -> None:
+        """Drop one owner per id; a block returns to the free list only
+        when its last owner releases it (shared prefixes just decrement)."""
         with self._lock:
             for b in ids:
                 assert 0 < b < self.n_blocks, f"bad block id {b}"
-                assert b not in self._free, f"double release of block {b}"
-                self._free.append(b)
+                assert self._refs.get(b, 0) > 0, \
+                    f"double release of block {b}"
+                self._refs[b] -= 1
+                if self._refs[b] == 0:
+                    del self._refs[b]
+                    self._free.append(b)
 
     # -- device-side sync --------------------------------------------------
 

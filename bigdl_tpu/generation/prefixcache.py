@@ -1,0 +1,303 @@
+"""Process-global prefix cache: content-addressed, copy-on-write paged KV.
+
+At serving scale most prompts share a head — system prompts, few-shot
+headers, RAG boilerplate — and the engine re-prefills every byte of it
+per request.  The paged allocator already stores K/V in fixed immutable
+blocks behind per-slot block tables, and chunked prefill already folds
+prompts in fixed-width chunks; this module is the missing join (vLLM's
+shared-prefix block pool, SGLang's RadixAttention turned into cache
+hits): a host-side store mapping BLOCK-ALIGNED token prefixes to pool
+block ids, so a new admission maps the warm prefix into its table and
+folds only the cold suffix.
+
+Content addressing (the compilecache discipline, keys.py): each full
+block of a prompt hashes to a CHAINED digest over
+
+    world fingerprint  (model version + param tree signature + kv dtype
+                        + block size — everything that decides whether
+                        cached K/V bytes are valid)
+  + parent address     (the digest of the preceding block, so an address
+                        pins the entire prefix, not just its own tokens)
+  + the block's tokens
+
+A hit is valid only in the exact KV world it was written under — a
+hot-swap changes the fingerprint and every old entry goes cold by
+construction (wrong-world entries are unreachable BY KEY and evicted
+preferentially).  Addresses deliberately exclude the bucket: K/V at a
+position depend only on the identical token prefix and absolute RoPE
+positions, so one cached block serves every lane.
+
+Copy-on-write is REUSE-UNTIL-WRITE, implemented without any new
+executable: shared blocks are mapped read-only into the table prefix,
+admission seeds chunk progress past them, and every subsequent write —
+the cold prefill suffix, decode appends, speculative overhang — lands
+at positions past the mapped prefix, i.e. in PRIVATE blocks claimed the
+normal lazy way.  The first divergent block is simply never mapped: its
+tokens fold with the cold suffix into a fresh block (recompute-on-write
+at block granularity), so the compiled step functions never see a "fork
+this block" path and the pinned executable set is unchanged.
+
+Eviction is refcount-0 LRU under a byte budget (`BIGDL_TPU_PREFIX_CACHE`
+accepts on/off or a byte budget like `256M`;
+`BIGDL_TPU_PREFIX_CACHE_MAX_BLOCKS` caps block count): only idle leaves
+— refcount 1 (store-only) and no cached children — are evictable, so a
+block a slot still maps can never be yanked, and a claim shortfall in
+`BlockPool.claim` reclaims idle entries on demand before it may fail.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu import obs as _obs
+from bigdl_tpu.generation.pagedkv import BlockPool
+
+_ROOT = "root"  # parent address of a prompt's first block
+
+
+def world_key(version: str, params_sig: Any, kv_dtype: str,
+              block_size: int) -> str:
+    """Fingerprint of the KV world cached blocks were written under.
+
+    Mirrors compilecache key discipline: everything that decides whether
+    the cached BYTES are still the bytes a fresh prefill would write
+    goes into the digest — model version and param tree signature (a
+    swap invalidates), kv dtype (int8 vs fp32 pools hold different
+    bytes), block size (addresses chunk tokens per block).  Buckets are
+    deliberately absent: absolute positions make blocks bucket-portable.
+    """
+    payload = json.dumps(
+        {"v": 1, "version": str(version), "params": repr(params_sig),
+         "kv_dtype": str(kv_dtype), "block": int(block_size)},
+        sort_keys=True)
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def block_addr(world: str, parent: Optional[str],
+               tokens: np.ndarray) -> str:
+    """Chained content address of one full block: world fingerprint +
+    parent address + this block's tokens.  The parent link makes the
+    address a commitment to the ENTIRE prefix — two prompts sharing
+    tokens [B..2B) but differing in [0..B) hash to different addresses
+    for their second block."""
+    h = hashlib.sha256()
+    h.update(world.encode())
+    h.update(b"\x00")
+    h.update((parent or _ROOT).encode())
+    h.update(b"\x00")
+    h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
+    return h.hexdigest()
+
+
+class _Entry:
+    __slots__ = ("addr", "block_id", "parent", "world", "children", "seq")
+
+    def __init__(self, addr: str, block_id: int, parent: Optional[str],
+                 world: str, seq: int):
+        self.addr = addr
+        self.block_id = block_id
+        self.parent = parent
+        self.world = world
+        self.children = 0  # cached entries whose parent is this addr
+        self.seq = seq     # LRU clock at last touch
+
+
+class PrefixStore:
+    """Host-side content-addressed map from block-aligned token prefixes
+    to resident pool blocks.
+
+    The store owns ONE refcount on every cached block (taken at publish
+    via `pool.addref`, dropped at eviction via `pool.release`); slots
+    mapping a hit take their own ref per block, so `pool.blocks_shared`
+    (refcount >= 2) counts exactly the store blocks some slot currently
+    rides.  All mutation happens on the engine scheduler thread; the
+    internal lock only guards metric/snapshot readers.
+
+    Lock order: `BlockPool.claim` -> reclaim hook -> store lock ->
+    `pool.release` (pool lock is reentrant); publish/evict take store
+    lock -> pool lock.  Both composite paths run on the engine thread
+    only, and other threads take at most one of the locks, so the
+    apparent cycle cannot deadlock.
+    """
+
+    def __init__(self, pool: BlockPool, max_bytes: Optional[int] = None,
+                 max_blocks: Optional[int] = None):
+        self.pool = pool
+        self.block_size = pool.block_size
+        per_block = pool.bytes_per_token() * pool.block_size
+        cap = pool.n_allocatable
+        if max_blocks is not None:
+            cap = min(cap, int(max_blocks))
+        if max_bytes is not None:
+            cap = min(cap, int(max_bytes) // per_block)
+        self.cap_blocks = max(0, cap)
+        self._block_bytes = per_block
+        self._lock = threading.RLock()
+        self._entries: Dict[str, _Entry] = {}
+        self._world: Optional[str] = None
+        self._seq = 0
+        self.evictions = 0
+        self.publishes = 0
+
+    # -- world -------------------------------------------------------------
+
+    def set_world(self, world: str) -> None:
+        """Pin the current KV world (call on every version activation).
+        Idle entries from other worlds are swept eagerly; entries still
+        mapped by in-flight slots linger unreachable-by-key until their
+        slots retire, then fall to the preferential dead-world eviction.
+        """
+        with self._lock:
+            if world == self._world:
+                return
+            self._world = world
+            self._evict_idle(lambda e: e.world != world, limit=None)
+
+    @property
+    def world(self) -> Optional[str]:
+        with self._lock:
+            return self._world
+
+    # -- lookup / publish --------------------------------------------------
+
+    def lookup(self, tokens: np.ndarray) -> List[int]:
+        """Longest cached block-prefix of `tokens`: walks the address
+        chain over full blocks and returns the matched pool block ids
+        (possibly empty).  Touches matched entries' LRU clocks.  The ids
+        stay valid until the next claim/publish on the engine thread —
+        the caller (admission) pins them with `pool.addref` immediately,
+        with no allocation in between."""
+        B = self.block_size
+        out: List[int] = []
+        with self._lock:
+            if self._world is None:
+                return out
+            self._seq += 1
+            parent: Optional[str] = None
+            for i in range(int(tokens.size) // B):
+                addr = block_addr(self._world, parent,
+                                  tokens[i * B:(i + 1) * B])
+                ent = self._entries.get(addr)
+                if ent is None:
+                    break
+                ent.seq = self._seq
+                out.append(ent.block_id)
+                parent = addr
+        return out
+
+    def publish(self, tokens: np.ndarray, n_tokens: int,
+                block_ids: Sequence[int]) -> int:
+        """Offer the first `n_tokens` (floor to full blocks) of a folded
+        prompt to the store; `block_ids` are the owning slot's claimed
+        blocks in table order.  New entries addref their block (the
+        store's own pin); blocks whose address is already cached keep
+        the existing entry — the slot's duplicate stays private and
+        frees at retire.  Stops early (returns entries added so far)
+        when the budget has no evictable room."""
+        B = self.block_size
+        added = 0
+        with self._lock:
+            if self._world is None:
+                return 0
+            self._seq += 1
+            parent: Optional[str] = None
+            for i in range(int(n_tokens) // B):
+                addr = block_addr(self._world, parent,
+                                  tokens[i * B:(i + 1) * B])
+                ent = self._entries.get(addr)
+                if ent is not None:
+                    ent.seq = self._seq
+                    parent = addr
+                    continue
+                if len(self._entries) >= self.cap_blocks:
+                    self._evict_idle(
+                        lambda e: True,
+                        limit=len(self._entries) - self.cap_blocks + 1)
+                    if len(self._entries) >= self.cap_blocks:
+                        break  # everything resident is pinned; no room
+                self.pool.addref([block_ids[i]])
+                self._entries[addr] = _Entry(addr, int(block_ids[i]),
+                                             parent, self._world, self._seq)
+                if parent is not None:
+                    self._entries[parent].children += 1
+                parent = addr
+                added += 1
+            if added:
+                self.publishes += added
+        return added
+
+    # -- eviction ----------------------------------------------------------
+
+    def _evictable(self, e: _Entry) -> bool:
+        # idle leaf: no cached children and no slot maps it (the store's
+        # own pin is the single remaining ref)
+        return e.children == 0 and self.pool.refcount(e.block_id) == 1
+
+    def _evict_idle(self, pred, limit: Optional[int]) -> int:
+        """Evict up to `limit` idle-leaf entries matching `pred`,
+        dead-world first, then least recently used.  Caller holds the
+        store lock.  Returns blocks released to the pool."""
+        freed = 0
+        while limit is None or freed < limit:
+            cand = [e for e in self._entries.values()
+                    if pred(e) and self._evictable(e)]
+            if not cand:
+                break
+            cand.sort(key=lambda e: (e.world == self._world, e.seq))
+            take = cand if limit is None \
+                else cand[:limit - freed]
+            for e in take:
+                del self._entries[e.addr]
+                if e.parent is not None and e.parent in self._entries:
+                    self._entries[e.parent].children -= 1
+                self.pool.release([e.block_id])
+                self.evictions += 1
+                freed += 1
+            _obs.registry().inc("generation/prefix_evictions", len(take))
+            _obs.instant("gen.prefix_evict", cat="generation",
+                         blocks=len(take),
+                         resident=len(self._entries))
+            # parents of evicted leaves may now be idle leaves: loop
+        return freed
+
+    def reclaim(self, n: int) -> int:
+        """`BlockPool.set_reclaim` hook: free >= `n` blocks if possible
+        by evicting idle entries (LRU).  Runs under the pool lock on the
+        claiming thread."""
+        with self._lock:
+            return self._evict_idle(lambda e: True, limit=max(1, int(n)))
+
+    def clear(self) -> int:
+        """Evict every idle entry (tests / explicit flush); entries
+        still mapped by slots survive.  Returns blocks released."""
+        with self._lock:
+            return self._evict_idle(lambda e: True, limit=None)
+
+    # -- reporting ---------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def nbytes(self) -> int:
+        with self._lock:
+            return len(self._entries) * self._block_bytes
+
+    def block_ids(self) -> List[int]:
+        with self._lock:
+            return [e.block_id for e in self._entries.values()]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "cap_blocks": self.cap_blocks,
+                "nbytes": len(self._entries) * self._block_bytes,
+                "publishes": self.publishes,
+                "evictions": self.evictions,
+            }

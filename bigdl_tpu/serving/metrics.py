@@ -300,6 +300,8 @@ class GenerationMetrics:
         self.prefill_ms = LatencyHistogram()
         self.e2e_ms = LatencyHistogram()
         self.prefill_chunks = 0
+        self.prefix_hits = 0
+        self.prefix_tokens_reused = 0
         self.spec_rounds = 0
         self.draft_steps = 0
         self.draft_tokens_proposed = 0
@@ -357,6 +359,18 @@ class GenerationMetrics:
         with self._lock:
             self.prefill_chunks += 1
         _obs.registry().inc("generation/prefill_chunks" + self._label)
+
+    def on_prefix_hit(self, tokens_reused: int) -> None:
+        """One admission mapped a warm prefix from the prefix store
+        (prefixcache.py): `tokens_reused` prompt tokens were skipped by
+        chunked prefill because their KV blocks were already resident."""
+        with self._lock:
+            self.prefix_hits += 1
+            self.prefix_tokens_reused += int(tokens_reused)
+        reg = _obs.registry()
+        reg.inc("generation/prefix_hits" + self._label)
+        reg.inc("generation/prefix_tokens_reused" + self._label,
+                int(tokens_reused))
 
     def on_spec_round(self, proposed: int, accepted: int,
                       draft_steps: int) -> None:
@@ -446,6 +460,8 @@ class GenerationMetrics:
                     "p99": round(self.e2e_ms.percentile(99), 3),
                 },
                 "prefill_chunks": self.prefill_chunks,
+                "prefix_hits": self.prefix_hits,
+                "prefix_tokens_reused": self.prefix_tokens_reused,
                 "spec_rounds": self.spec_rounds,
                 "draft_steps": self.draft_steps,
                 "spec_accept_rate": round(
@@ -482,6 +498,8 @@ class GenerationMetrics:
             f"{prefix}/active_slots_peak": snap["active_slots_peak"],
             f"{prefix}/decode_steps": snap["decode_steps"],
             f"{prefix}/prefill_chunks": snap["prefill_chunks"],
+            f"{prefix}/prefix_hits": snap["prefix_hits"],
+            f"{prefix}/prefix_tokens_reused": snap["prefix_tokens_reused"],
             f"{prefix}/spec_rounds": snap["spec_rounds"],
             f"{prefix}/draft_steps": snap["draft_steps"],
             f"{prefix}/spec_accept_rate": snap["spec_accept_rate"],

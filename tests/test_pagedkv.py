@@ -114,6 +114,30 @@ def test_block_pool_rejects_tiny_and_tracks_bytes():
     assert p64.bytes_per_token() / q64.bytes_per_token() >= 1.9
 
 
+def test_block_pool_refcounts_and_shared_reserve_discount():
+    """Prefix-cache accounting: `addref` turns a resident block into a
+    SHARED one (refcount >= 2), and `reserve` budgets only COLD blocks —
+    shared residents are backed by bytes already paid for, so they don't
+    compete for the allocatable budget."""
+    pool = BlockPool(n_layer=1, n_blocks=7, block_size=4, n_head=2,
+                     head_dim=4)  # 6 allocatable
+    ids = pool.claim(4)
+    pool.addref(ids)  # a second rider maps the same blocks
+    assert [pool.refcount(b) for b in ids] == [2, 2, 2, 2]
+    assert pool.blocks_shared == 4
+    assert pool.reserve(2)      # 2 cold fit beside 4 shared residents
+    assert not pool.reserve(1)  # a 3rd cold block would overcommit
+    pool.release(ids)           # one rider retires: decrement only
+    assert pool.blocks_shared == 0
+    assert pool.blocks_free == 2
+    assert pool.reserve(1)      # no shared residents left to discount
+    pool.unreserve(3)
+    pool.release(ids)
+    assert pool.blocks_free == 6
+    with pytest.raises(AssertionError, match="double release"):
+        pool.release(ids)
+
+
 def test_paged_cache_pytree_shapes():
     pool = BlockPool(n_layer=2, n_blocks=9, block_size=4, n_head=2,
                      head_dim=8)
@@ -341,6 +365,47 @@ def test_engine_paged_matches_ring_and_frees_blocks(lm):
     for lane in eng._lanes.values():
         assert all(not c for c in lane.claimed)
         assert (lane.table_np == 0).all()
+
+
+
+def test_engine_shared_prefix_rides_oversubscribed_pool(lm):
+    """Oversubscribed-pool regression for the prefix cache: warm
+    admissions reserve only their COLD suffix blocks, so two slots run
+    a shared 32-token head concurrently through a pool (8 allocatable)
+    that could never hold two cold 5-block requests plus the resident
+    store copy (4 + 2 x 5 = 14 blocks).  The traffic drains leak-free:
+    free + store == allocatable, and `clear()` returns every block."""
+    model, params = lm
+    obs.set_observability(compile_monitor=False)
+    rng = np.random.RandomState(7)
+    head = rng.randint(1, 61, size=32).tolist()  # 4 shared blocks
+    prompts = [head + rng.randint(1, 61, size=2).tolist()
+               for _ in range(8)]
+    eng = GenerationEngine(model, params, buckets=(64,), slots=2,
+                           max_new_tokens=6, temperature=0.0, paged=True,
+                           kv_block_size=8, kv_pool_blocks=9,
+                           prefill_chunk=16, prefix_cache=True)
+    try:
+        futs = [eng.submit(p) for p in prompts]
+        peak_shared = 0
+        while not all(f.done() for f in futs):
+            peak_shared = max(peak_shared, eng._pool.blocks_shared)
+            time.sleep(0.001)
+        for f in futs:
+            f.result(timeout=120)
+        # the first request folds cold and publishes; everyone after it
+        # maps the warm head (4 blocks) and folds only the 2-token tail
+        assert eng.metrics.snapshot()["prefix_hits"] >= 6
+        assert peak_shared >= 4  # some slot rode the store's blocks
+        pool, store = eng._pool, eng.prefix_store
+        eng.drain()
+        assert pool.blocks_free + len(store) == pool.n_allocatable
+        assert pool.blocks_reserved == 0
+        assert pool.blocks_shared == 0
+        assert len(store) == 4 and store.clear() == 4
+        assert pool.blocks_free == pool.n_allocatable
+    finally:
+        eng.close()
 
 
 def test_engine_abort_releases_blocks(lm):

@@ -1,0 +1,517 @@
+"""Prefix cache: content-addressed, copy-on-write paged KV (ISSUE 18).
+
+The bars, verified here:
+
+  * chained content addresses commit to the WHOLE prefix (two prompts
+    sharing block 1 but differing in block 0 never collide) and to the
+    KV world (a different model version / param signature / kv dtype
+    rejects the entry BY KEY — the compilecache discipline);
+  * refcount lifecycle: publish pins, mapping pins again, slot retire
+    only decrements, eviction frees — and the leak invariant holds with
+    the store on: `blocks_free + store entries == n_allocatable` after
+    drain, `blocks_free == n_allocatable` after `clear()`;
+  * LRU eviction under the block budget evicts idle leaves only, least
+    recently used first;
+  * copy-on-write fork: two requests share a prefix and diverge —
+    greedy tokens are BITWISE equal to an unshared engine at fp32, at
+    every chunk offset around the block/chunk boundaries;
+  * the pinned executable set is unchanged: prefix hits skip chunks,
+    they never add executables (compile_count <= buckets x 2, zero
+    steady-state recompile alarms);
+  * spec decode + CoW interact only through private tail blocks.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import obs
+from bigdl_tpu.generation import (
+    BlockPool,
+    GenerationConfig,
+    GenerationEngine,
+    PrefixStore,
+    block_addr,
+    world_key,
+)
+from bigdl_tpu.models.transformer import TransformerLM
+
+
+def _lm(**kw):
+    kw.setdefault("vocab_size", 61)
+    kw.setdefault("hidden_size", 32)
+    kw.setdefault("n_layer", 2)
+    kw.setdefault("n_head", 4)
+    kw.setdefault("max_len", 128)
+    kw.setdefault("use_flash", False)
+    model = TransformerLM(**kw)
+    params, _ = model.init((1, 16), rng=jax.random.PRNGKey(0))
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _lm()
+
+
+def _pool(n_blocks=9, block_size=4):
+    return BlockPool(n_layer=1, n_blocks=n_blocks, block_size=block_size,
+                     n_head=2, head_dim=4)
+
+
+def _toks(*vals):
+    return np.asarray(vals, np.int32)
+
+
+# -- content addresses -----------------------------------------------------
+
+
+def test_block_addr_chains_commit_to_whole_prefix():
+    w = world_key("v0", ("sig",), "float32", 4)
+    a0 = block_addr(w, None, _toks(1, 2, 3, 4))
+    a1 = block_addr(w, a0, _toks(5, 6, 7, 8))
+    # same second-block tokens under a different first block: different
+    # address (the parent link pins the entire prefix)
+    b0 = block_addr(w, None, _toks(9, 9, 9, 9))
+    b1 = block_addr(w, b0, _toks(5, 6, 7, 8))
+    assert a1 != b1
+    # deterministic
+    assert a0 == block_addr(w, None, _toks(1, 2, 3, 4))
+
+
+def test_world_key_separates_kv_worlds():
+    base = world_key("v0", ("sig",), "float32", 4)
+    assert world_key("v1", ("sig",), "float32", 4) != base
+    assert world_key("v0", ("other",), "float32", 4) != base
+    assert world_key("v0", ("sig",), "int8", 4) != base
+    assert world_key("v0", ("sig",), "float32", 8) != base
+
+
+def test_store_lookup_walks_chain_and_rejects_wrong_world():
+    pool = _pool()
+    store = PrefixStore(pool)
+    store.set_world("w1")
+    prompt = np.arange(1, 13, dtype=np.int32)  # 3 full blocks of 4
+    ids = pool.claim(3)
+    assert store.publish(prompt, 12, ids) == 3
+    assert store.lookup(prompt) == ids
+    # partial prefix: first two blocks match, third diverges
+    div = prompt.copy()
+    div[9] = 60
+    assert store.lookup(div) == ids[:2]
+    # sub-block tail is ignored (addresses are full blocks only)
+    assert store.lookup(prompt[:7]) == ids[:1]
+    # wrong world rejects BY KEY: nothing matches, entries survive as
+    # dead-world until idle-swept
+    store.set_world("w2")
+    assert store.lookup(prompt) == []
+
+
+def test_store_set_world_sweeps_idle_foreign_entries():
+    pool = _pool()
+    store = PrefixStore(pool)
+    store.set_world("w1")
+    prompt = np.arange(1, 9, dtype=np.int32)
+    ids = pool.claim(2)
+    store.publish(prompt, 8, ids)
+    pool.release(ids)  # slot retires; store's pin remains
+    free_before = pool.blocks_free
+    store.set_world("w2")
+    assert len(store) == 0
+    assert pool.blocks_free == free_before + 2
+
+
+# -- refcount lifecycle ----------------------------------------------------
+
+
+def test_refcount_lifecycle_publish_map_release_evict():
+    pool = _pool()
+    store = PrefixStore(pool)
+    store.set_world("w")
+    prompt = np.arange(1, 9, dtype=np.int32)
+    ids = pool.claim(2)            # slot A's private blocks (refs 1)
+    assert [pool.refcount(b) for b in ids] == [1, 1]
+    store.publish(prompt, 8, ids)  # store pins (refs 2)
+    assert [pool.refcount(b) for b in ids] == [2, 2]
+    assert pool.blocks_shared == 2
+    hit = store.lookup(prompt)
+    pool.addref(hit)               # slot B maps the hit (refs 3)
+    assert [pool.refcount(b) for b in ids] == [3, 3]
+    pool.release(ids)              # slot A retires: decrement only
+    assert [pool.refcount(b) for b in ids] == [2, 2]
+    assert pool.blocks_free == pool.n_allocatable - 2
+    pool.release(hit)              # slot B retires
+    assert pool.blocks_shared == 0
+    assert [pool.refcount(b) for b in ids] == [1, 1]  # store-only
+    assert store.clear() == 2      # eviction drops the last ref
+    assert pool.blocks_free == pool.n_allocatable
+    assert [pool.refcount(b) for b in ids] == [0, 0]
+
+
+def test_release_below_zero_still_asserts():
+    pool = _pool()
+    ids = pool.claim(1)
+    pool.release(ids)
+    with pytest.raises(AssertionError, match="double release"):
+        pool.release(ids)
+
+
+def test_reserve_discounts_shared_blocks():
+    pool = _pool(n_blocks=6)  # 5 allocatable
+    ids = pool.claim(3)
+    pool.addref(ids)  # shared: pinned resident, never claimed again
+    assert pool.blocks_shared == 3
+    assert pool.reserve(2)          # 2 cold <= 5 - 3 shared
+    assert not pool.reserve(1)      # would overcommit the cold budget
+    pool.release(ids)               # drop the share; still claimed once
+    assert pool.blocks_shared == 0
+    assert pool.reserve(1)
+    pool.unreserve(3)
+    pool.release(ids)
+
+
+def test_claim_shortfall_reclaims_idle_store_blocks():
+    pool = _pool(n_blocks=5)  # 4 allocatable
+    store = PrefixStore(pool)
+    store.set_world("w")
+    pool.set_reclaim(store.reclaim)
+    prompt = np.arange(1, 13, dtype=np.int32)
+    ids = pool.claim(3)
+    store.publish(prompt, 12, ids)
+    pool.release(ids)  # all 3 now idle store-held
+    assert pool.blocks_free == 1
+    got = pool.claim(3)  # shortfall: reclaim evicts idle LRU entries
+    assert len(got) == 3
+    assert store.snapshot()["evictions"] >= 2
+    pool.release(got)
+
+
+# -- LRU eviction under budget ---------------------------------------------
+
+
+def test_lru_eviction_under_block_budget():
+    pool = _pool(n_blocks=17, block_size=4)
+    store = PrefixStore(pool, max_blocks=4)
+    store.set_world("w")
+    pa = np.arange(1, 9, dtype=np.int32)        # 2 blocks
+    pb = np.arange(21, 29, dtype=np.int32)      # 2 blocks
+    pc = np.arange(41, 49, dtype=np.int32)      # 2 blocks
+    ia = pool.claim(2)
+    store.publish(pa, 8, ia)
+    pool.release(ia)
+    ib = pool.claim(2)
+    store.publish(pb, 8, ib)
+    pool.release(ib)
+    assert len(store) == 4  # at budget
+    store.lookup(pb)        # touch B: A becomes the LRU chain
+    ic = pool.claim(2)
+    added = store.publish(pc, 8, ic)
+    pool.release(ic)
+    assert added == 2
+    assert len(store) == 4
+    assert store.lookup(pa) == []      # A evicted (leaf-first cascade)
+    assert store.lookup(pb) == ib      # B survived (recently used)
+    assert store.snapshot()["evictions"] == 2
+
+
+def test_budget_refuses_publish_when_everything_pinned():
+    pool = _pool(n_blocks=9, block_size=4)
+    store = PrefixStore(pool, max_blocks=2)
+    store.set_world("w")
+    pa = np.arange(1, 9, dtype=np.int32)
+    ia = pool.claim(2)
+    store.publish(pa, 8, ia)  # fills the budget; slot still maps it
+    pb = np.arange(21, 29, dtype=np.int32)
+    ib = pool.claim(2)
+    assert store.publish(pb, 8, ib) == 0  # no evictable room
+    pool.release(ia)
+    pool.release(ib)
+
+
+# -- engine integration: bitwise parity at every chunk offset --------------
+
+
+def _eng_kw(**over):
+    kw = dict(buckets=(64,), slots=2, paged=True, kv_block_size=8,
+              prefill_chunk=16, max_new_tokens=6, temperature=0.0)
+    kw.update(over)
+    return kw
+
+
+def test_engine_parity_shared_vs_unshared_every_chunk_offset(lm):
+    """Greedy tokens bitwise-equal shared vs unshared at fp32, swept
+    across prompt lengths covering every offset around the chunk and
+    block boundaries (hit sizes 0..3 blocks, aligned and not)."""
+    model, params = lm
+    # no monitor: two engines share the process, and each one's warmup
+    # looks like a steady-state recompile to the other's marks
+    obs.set_observability(compile_monitor=False)
+    rng = np.random.default_rng(7)
+    prefix = rng.integers(1, 60, size=48)
+    lengths = list(range(17, 41))  # 1..3 chunks of 16, all offsets
+    cold = GenerationEngine(model, params, **_eng_kw())
+    warm = GenerationEngine(model, params, prefix_cache=True, **_eng_kw())
+    try:
+        for n in lengths:
+            prompt = prefix[:n]
+            a = cold.generate(prompt).tokens
+            # twice on the warm engine: first publishes, second hits
+            warm.generate(prompt)
+            b = warm.generate(prompt).tokens
+            np.testing.assert_array_equal(a, b, err_msg=f"len={n}")
+        snap = warm.metrics.snapshot()
+        assert snap["prefix_hits"] > 0
+        assert snap["prefix_tokens_reused"] > 0
+        # hits fold strictly fewer chunks than the cold engine did
+        assert snap["prefill_chunks"] < 2 * cold.metrics.snapshot()[
+            "prefill_chunks"]
+    finally:
+        cold.close()
+        warm.close()
+
+
+def test_engine_cow_fork_diverging_suffixes(lm):
+    """Two requests share a warm prefix then diverge: each must match
+    the unshared engine bitwise — the divergent block is never mapped
+    (recompute-on-write), so neither request sees the other's tokens."""
+    model, params = lm
+    obs.set_observability(compile_monitor=False)
+    rng = np.random.default_rng(3)
+    prefix = rng.integers(1, 60, size=32)
+    suffixes = [rng.integers(1, 60, size=k) for k in (3, 9, 16)]
+    cold = GenerationEngine(model, params, **_eng_kw())
+    warm = GenerationEngine(model, params, prefix_cache=True, **_eng_kw())
+    try:
+        warm.generate(prefix)  # publish the shared head
+        for sfx in suffixes:
+            prompt = np.concatenate([prefix, sfx])
+            np.testing.assert_array_equal(
+                cold.generate(prompt).tokens,
+                warm.generate(prompt).tokens)
+        assert warm.metrics.snapshot()["prefix_hits"] >= len(suffixes)
+    finally:
+        cold.close()
+        warm.close()
+
+
+def test_engine_concurrent_shared_prefix_leak_free(lm):
+    """A concurrent burst riding one prefix through an OVERSUBSCRIBED
+    pool: all complete, blocks_shared was live, and after drain the
+    leak invariant holds (free + store == allocatable; reservations 0;
+    clear() returns the pool to pristine)."""
+    model, params = lm
+    obs.set_observability(compile_monitor=False)
+    rng = np.random.default_rng(11)
+    prefix = rng.integers(1, 60, size=32)
+    # worst case per request: blocks_for(min(64, 35+6), 8) = 6 blocks;
+    # 4 slots x 6 = 24 >> 15 allocatable — only cold-only reservations
+    # for the warm majority let the burst through without deadlock
+    eng = GenerationEngine(model, params, prefix_cache=True,
+                           **_eng_kw(slots=4, kv_pool_blocks=16,
+                                     max_new_tokens=6))
+    try:
+        eng.generate(prefix)  # publish
+        # shared blocks are IMMUTABLE: the batched decode step writes
+        # K/V for every slot at its device length, and a just-admitted
+        # warm slot's device length is stale until its first fold — the
+        # deferred table mapping must keep those writes in the trash
+        # block, never a shared one (checked bytewise after the burst)
+        ids = sorted(eng.prefix_store.block_ids())
+        k0 = np.asarray(eng._pool.k)[:, ids].copy()
+        v0 = np.asarray(eng._pool.v)[:, ids].copy()
+        futs = [eng.submit(np.concatenate(
+            [prefix, rng.integers(1, 60, size=3)])) for _ in range(8)]
+        for f in futs:
+            f.result(timeout=240)
+        snap = eng.metrics.snapshot()
+        assert snap["prefix_hits"] >= 8
+        assert np.array_equal(k0, np.asarray(eng._pool.k)[:, ids]), \
+            "a concurrent burst mutated shared prefix K blocks"
+        assert np.array_equal(v0, np.asarray(eng._pool.v)[:, ids]), \
+            "a concurrent burst mutated shared prefix V blocks"
+        pool, store = eng._pool, eng.prefix_store
+        eng.drain()
+        assert pool.blocks_free + len(store) == pool.n_allocatable
+        assert pool.blocks_reserved == 0
+        assert pool.blocks_shared == 0  # no slot maps store blocks now
+        store.clear()
+        assert pool.blocks_free == pool.n_allocatable
+    finally:
+        eng.close()
+
+
+def test_engine_abort_with_shared_blocks_leak_free(lm):
+    model, params = lm
+    obs.set_observability(compile_monitor=False)
+    rng = np.random.default_rng(13)
+    prefix = rng.integers(1, 60, size=32)
+    eng = GenerationEngine(model, params, prefix_cache=True,
+                           **_eng_kw(slots=2, max_new_tokens=28))
+    eng.generate(prefix, max_new_tokens=2)  # publish
+    futs = [eng.submit(np.concatenate([prefix, rng.integers(1, 60, size=2)]))
+            for _ in range(8)]
+    time.sleep(0.1)  # let some admissions map the shared prefix
+    pool, store = eng._pool, eng.prefix_store
+    eng.close(drain=False)  # abort: _fail_inflight must release slot refs
+    aborted = 0
+    for f in futs:
+        try:
+            f.result(timeout=10)
+        except Exception:
+            aborted += 1
+    assert aborted >= 1  # 8 warm requests x 28 decode steps outlive 0.1s
+    assert pool.blocks_free + len(store) == pool.n_allocatable
+    assert pool.blocks_reserved == 0
+    assert pool.blocks_shared == 0
+    store.clear()
+    assert pool.blocks_free == pool.n_allocatable
+
+
+def test_engine_prefix_compile_budget_unchanged(lm):
+    """The pinned-executable-set bar with prefix caching ON and hits
+    occurring: <= buckets x 2 (chunking replaces prefill), zero
+    steady-state recompile alarms — a hit changes WHICH chunks fold,
+    never the executable signatures."""
+    model, params = lm
+    obs.set_observability(compile_monitor=True)  # fresh monitor
+    mon = obs.compile_monitor()
+    cfg = GenerationConfig(buckets=(32, 64), slots=2, paged=True,
+                           kv_block_size=8, prefill_chunk=16,
+                           prefix_cache=True, max_new_tokens=4,
+                           temperature=0.0)
+    eng = GenerationEngine(model, params, config=cfg)
+    try:
+        assert eng.compile_count() <= 2 * len(cfg.buckets)
+        rng = np.random.default_rng(5)
+        prefix = rng.integers(1, 60, size=24)
+        # suffixes mix bucket-32 traffic whose resume offsets never
+        # block-align (chunk 16, remainder right-aligned: publishes but
+        # can't skip) with bucket-64 traffic that resumes at 16/24
+        sizes = [2, 10, 3, 16, 2, 16, 10, 3, 16, 10, 2, 16, 10, 3, 16, 10]
+        futs = [eng.submit(np.concatenate(
+            [prefix, rng.integers(1, 60, size=int(k))]))
+            for k in sizes]
+        for f in futs:
+            f.result(timeout=240)
+        assert eng.metrics.snapshot()["prefix_hits"] > 0
+        assert eng.compile_count() <= 2 * len(cfg.buckets)
+        assert mon.recompiles("generation/") == 0, mon.snapshot()
+    finally:
+        eng.close()
+
+
+def test_engine_spec_decode_writes_only_private_tail(lm):
+    """Spec decode + CoW interact only through private tail blocks: a
+    speculative engine riding a shared prefix must keep every store
+    block's content authoritative — a second hit after heavy spec
+    traffic still reproduces the non-spec engine's greedy tokens
+    bitwise, and shared blocks never enter the spec claim path."""
+    model, params = lm
+    dmodel, dparams = _lm(hidden_size=16, n_layer=1, n_head=2)
+    obs.set_observability(compile_monitor=False)
+    rng = np.random.default_rng(17)
+    prefix = rng.integers(1, 60, size=32)
+    kw = _eng_kw(max_new_tokens=10)
+    plain = GenerationEngine(model, params, **kw)
+    spec = GenerationEngine(model, params, draft_model=dmodel,
+                            draft_params=dparams, prefix_cache=True,
+                            spec_decode=True, spec_k=2, **kw)
+    try:
+        spec.generate(prefix)  # publish under spec reservations
+        for k in (2, 5):
+            prompt = np.concatenate([prefix, rng.integers(1, 60, size=k)])
+            np.testing.assert_array_equal(
+                plain.generate(prompt).tokens,
+                spec.generate(prompt).tokens)
+        snap = spec.metrics.snapshot()
+        assert snap["prefix_hits"] >= 2
+        assert snap["spec_rounds"] > 0  # spec actually ran on hits
+        spec.drain()
+        pool, store = spec._pool, spec.prefix_store
+        assert pool.blocks_free + len(store) == pool.n_allocatable
+        assert pool.blocks_shared == 0
+    finally:
+        plain.close()
+        spec.close()
+
+
+# -- gauges / reporting ----------------------------------------------------
+
+
+def test_kv_blocks_shared_gauge_and_resident_nbytes(lm):
+    """Mid-flight, two slots riding one warm prefix must show up in the
+    kv_blocks_shared gauge, in `kv_sharing()` (logical > unique blocks)
+    and in `PagedKVCache.resident_nbytes()` (logical > unique bytes)."""
+    model, params = lm
+    obs.set_observability(metrics=True, compile_monitor=False)
+    reg = obs.registry()
+    reg.reset("generation/")
+    rng = np.random.default_rng(19)
+    prefix = rng.integers(1, 60, size=32)
+    # chunk 8 -> a 34-token prompt resumes at offset 24: 3 shared blocks
+    eng = GenerationEngine(model, params, prefix_cache=True,
+                           **_eng_kw(prefill_chunk=8, max_new_tokens=28,
+                                     slots=2))
+    try:
+        eng.generate(prefix, max_new_tokens=2)  # publish
+        # hold two slots on the shared prefix mid-flight (28 decode
+        # steps each: a wide window for the polling below)
+        futs = [eng.submit(np.concatenate(
+            [prefix, rng.integers(1, 60, size=2)])) for _ in range(2)]
+        peak = 0
+        saw_sharing = False  # host view: kv_sharing() mirrors
+        saw_device = False   # device view: lane tables/lengths
+        t0 = time.time()
+        while time.time() - t0 < 60 and not all(f.done() for f in futs):
+            peak = max(peak, int(reg.get("generation/kv_blocks_shared")))
+            sh = eng.kv_sharing()
+            if sh and sh["logical_blocks"] > sh["unique_blocks"]:
+                saw_sharing = True
+            # the two views evolve on the engine thread between our
+            # reads, so each must show overlap on its OWN snapshot
+            lane = eng._lanes[64]
+            cache = eng._pool.lane_view(lane.table_dev(),
+                                        lane.lengths_dev)
+            logical, unique = cache.resident_nbytes()
+            if logical > unique:
+                saw_device = True
+                assert unique > 0
+            time.sleep(0.0005)
+        for f in futs:
+            f.result(timeout=60)
+        assert peak >= 3  # 3 shared blocks while a mapper was in flight
+        assert saw_sharing  # both mappers held the prefix at once
+        assert saw_device   # ... and the device tables agree
+        assert reg.get("generation/prefix_hits") >= 2
+        assert reg.get("generation/prefix_tokens_reused") >= 2 * 24
+    finally:
+        eng.close()
+
+
+def test_config_validation_and_env_gating(monkeypatch):
+    with pytest.raises(ValueError, match="paged"):
+        GenerationConfig(buckets=(16,), prefix_cache=True,
+                         prefill_chunk=8)
+    with pytest.raises(ValueError, match="chunked prefill"):
+        GenerationConfig(buckets=(16,), prefix_cache=True, paged=True,
+                         kv_block_size=8, prefill_chunk=0)
+    with pytest.raises(ValueError, match="divisible"):
+        GenerationConfig(buckets=(16,), prefix_cache=True, paged=True,
+                         kv_block_size=8, prefill_chunk=12)
+    monkeypatch.setenv("BIGDL_TPU_PREFIX_CACHE", "64M")
+    monkeypatch.setenv("BIGDL_TPU_PREFIX_CACHE_MAX_BLOCKS", "7")
+    cfg = GenerationConfig(buckets=(16,), paged=True, kv_block_size=8,
+                           prefill_chunk=8)
+    assert cfg.prefix_cache
+    assert cfg.prefix_cache_bytes == 64 << 20
+    assert cfg.prefix_cache_max_blocks == 7
+    monkeypatch.setenv("BIGDL_TPU_PREFIX_CACHE", "nope")
+    with pytest.raises(ValueError, match="BIGDL_TPU_PREFIX_CACHE"):
+        GenerationConfig(buckets=(16,), paged=True, kv_block_size=8,
+                         prefill_chunk=8)
+    monkeypatch.setenv("BIGDL_TPU_PREFIX_CACHE", "off")
+    assert not GenerationConfig(buckets=(16,)).prefix_cache

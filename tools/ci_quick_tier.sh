@@ -81,7 +81,10 @@ if [ "$rc" -eq 0 ]; then
     # generation lane: 32 concurrent prompts through the prefill/decode
     # engine — the executable set must stay <= buckets x 2 with zero
     # steady-state recompile alarms, greedy output must match a full
-    # re-forward loop, and a hot-swap under traffic must not re-trace
+    # re-forward loop, and a hot-swap under traffic must not re-trace;
+    # plus the paged+int8, chunk+spec, and prefix-cache lanes (a 1k
+    # shared system prompt through an oversubscribed pool: hits > 0,
+    # greedy identical to the cold run, zero leaked blocks)
     remaining=$(( BUDGET - elapsed ))
     [ "$remaining" -lt 30 ] && remaining=30
     timeout --signal=TERM "$remaining" python tools/generation_smoke.py

@@ -25,7 +25,13 @@ acceptance):
     prefill on — short requests keep completing while it folds — and
     the spec-on engine (1-layer draft, k=3) emits tokens identical to
     spec-off greedy, at the documented 5-per-bucket executable budget,
-    zero steady alarms, zero leaked blocks.
+    zero steady alarms, zero leaked blocks;
+  * prefix cache lane (ISSUE 18): N requests sharing a 1k-token system
+    prompt ride an oversubscribed pool — warm admissions map the shared
+    head read-only and fold only their cold tail, so prefix_hits > 0,
+    prefill chunk count collapses vs the cold run, greedy output stays
+    IDENTICAL to the prefix-off engine, zero steady alarms, and every
+    block drains (free + store == allocatable; clear() returns the rest).
 
 Usage: python tools/generation_smoke.py
 """
@@ -257,6 +263,69 @@ def main() -> int:
           f"greedy == spec-off greedy, accept rate "
           f"{snap_s['spec_accept_rate']}, {n_spec}/{spec_budget} "
           f"executables, 0 steady recompiles, pool leak-free")
+
+    # -- prefix cache lane (ISSUE 18) ------------------------------------
+    # a taller model (max_len 2048) so a 1k system prompt fits the
+    # no-wrap bucket; pool of 100 blocks is oversubscribed (two cold
+    # 66-block requests would need 132) so warm admissions must ride
+    # the shared head to run concurrently
+    big = TransformerLM(vocab_size=61, hidden_size=32, n_layer=2,
+                        n_head=4, max_len=2048, use_flash=False)
+    bparams, _ = big.init((1, 16), rng=jax.random.PRNGKey(0))
+    rng = np.random.RandomState(4)
+    head = rng.randint(1, 61, size=1024).tolist()
+    prompts = [head + rng.randint(1, 61, size=int(k)).tolist()
+               for k in rng.randint(4, 17, size=6)]
+
+    def prefix_burst(on):
+        obs.set_observability(metrics=True, tracing=True,
+                              compile_monitor=True)
+        m = obs.compile_monitor()
+        e = GenerationEngine(
+            big, bparams, buckets=(1152,), slots=2, capacity=8,
+            max_new_tokens=8, temperature=0.0, paged=True,
+            kv_block_size=16, kv_pool_blocks=100, prefill_chunk=64,
+            prefix_cache=on)
+        try:
+            futs = [e.submit(p) for p in prompts]
+            toks = [list(f.result(timeout=240).tokens) for f in futs]
+            e.drain()
+            pool, store = e._pool, e.prefix_store
+            held = len(store) if on else 0
+            assert pool.blocks_free + held == pool.n_allocatable, \
+                f"leaked blocks: {pool.blocks_free} free + {held} " \
+                f"store-held != {pool.n_allocatable}"
+            assert pool.blocks_reserved == 0, "leaked reservations"
+            assert pool.blocks_shared == 0, "shared refs outlived slots"
+            if on:
+                store.clear()
+                assert pool.blocks_free == pool.n_allocatable, \
+                    "store.clear() leaked blocks"
+            return (toks, e.compile_count(),
+                    m.recompiles("generation/"), e.metrics.snapshot())
+        finally:
+            e.close()
+
+    cold_toks, _, _, snap_c = prefix_burst(False)
+    warm_toks, n_px, n_re_p, snap_w = prefix_burst(True)
+    assert warm_toks == cold_toks, \
+        "prefix-cache greedy diverged from the cold engine"
+    assert snap_w["prefix_hits"] >= len(prompts) - 1, snap_w
+    assert snap_w["prefix_tokens_reused"] >= (len(prompts) - 1) * 960, \
+        snap_w
+    assert snap_w["prefill_chunks"] * 2 < snap_c["prefill_chunks"], \
+        (snap_w["prefill_chunks"], snap_c["prefill_chunks"])
+    assert n_px <= 2, \
+        f"prefix burst grew the executable set to {n_px} (budget 2)"
+    assert n_re_p == 0, \
+        f"{n_re_p} steady-state recompiles with prefix cache on"
+
+    print(f"OK: prefix cache lane green — {len(prompts)} requests on a "
+          f"1k shared head, {snap_w['prefix_hits']} hits, "
+          f"{snap_w['prefix_tokens_reused']} tokens reused, chunks "
+          f"{snap_c['prefill_chunks']} cold -> {snap_w['prefill_chunks']} "
+          f"warm, greedy identical, {n_px}/2 executables, 0 steady "
+          f"recompiles, pool leak-free")
     return 0
 
 
