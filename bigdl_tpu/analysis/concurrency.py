@@ -1,0 +1,916 @@
+"""Lock-discipline static analysis: lock graph, guarded state, blocking.
+
+The serving stack is now heavily threaded — fleet dispatcher + settle
+threads, per-replica MicroBatchers, the generation engine scheduler,
+DeviceFeed/reader-pool workers, AsyncCheckpointer, watchdog — with ~20
+files holding `threading.Lock/RLock/Condition`.  This module extends
+the linter's module-index machinery with a whole-program model of that
+locking, validated at runtime by `bigdl_tpu.analysis.lockdep` (every
+edge lockdep observes must be predicted here — the reconciliation test
+and `tools/lockdep_reconcile.py` enforce it).
+
+Three rule families (`# tpu-lint: disable=<rule>` escapes apply):
+
+  lock-order          the acquired-before graph must be a DAG.  Lock
+                      attributes created in `__init__` (or at class /
+                      module scope) become nodes; `with self._lock:`
+                      blocks plus interprocedural propagation over the
+                      call model become edges; a strong cycle or a
+                      re-acquisition of a non-reentrant lock on a
+                      self-call path is a deadlock waiting for the
+                      right interleaving.  Never baselinable.
+  unguarded-state     for each `self._x` accessed outside __init__ in a
+                      thread-owning class, the guarding lock is
+                      inferred by majority of access sites; minority
+                      UNGUARDED reads/writes of state that worker and
+                      driver threads share are flagged.  Baselinable
+                      (some lock-free reads are deliberate — suppress
+                      inline with the reason instead when possible).
+  blocking-under-lock hot-root code that performs a blocking operation
+                      while holding a lock: device dispatch or a
+                      `.block_until_ready()`, `queue.get/put` without a
+                      bound, `.result()`/`.wait()` without timeout,
+                      file I/O / sleep / subprocess.  Every waiter on
+                      that lock inherits the stall.  Never baselinable.
+
+Precision model (kept deliberately two-tier):
+
+  * STRONG call resolution — `self.m()` (same class), `self.attr.m()`
+    where `attr`'s class is known from `__init__` (direct constructor
+    call or a ctor parameter annotation), and uniquely-named module
+    functions.  Strong edges feed cycle DETECTION.
+  * WEAK resolution — any other `obj.m()` resolves name-level to every
+    class method called `m` (bounded fan-out, generic container verbs
+    excluded).  Weak edges land in the graph (so runtime reconciliation
+    and `--lock-graph` stay complete) but never report cycles: a false
+    deadlock from name collisions would train people to ignore the rule.
+
+The runtime half keys locks by creation site (`file:line`), which is
+exactly `LockSite.path/line` here — `LockGraph.site_index()` is the
+join used for static-vs-runtime reconciliation.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from bigdl_tpu.analysis.linter import (
+    _BLOCKING_CALLS,
+    _attr_chain,
+    Finding,
+    FuncInfo,
+)
+
+# threading factories that create a lock node; value = reentrant.
+# Event carries a hidden Condition(Lock()) that set/clear/wait acquire
+# transiently — modelled so runtime edges into an Event's internal lock
+# (keyed by the Event's creation site) reconcile against this graph
+_LOCK_FACTORIES = {"Lock": False, "RLock": True, "Condition": True,
+                   "Event": False}
+
+# methods on an Event attr that acquire its internal lock
+_EVENT_OPS = {"set", "clear", "wait"}
+
+# attributes that are thread-plumbing, never guarded application state
+_INFRA_SUFFIXES = {
+    "Lock", "RLock", "Condition", "Event", "Semaphore",
+    "BoundedSemaphore", "Barrier", "Thread", "Timer", "local",
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+    "JoinableQueue", "Process", "Value", "Array", "Pipe", "Manager",
+}
+_QUEUE_SUFFIXES = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+                   "JoinableQueue"}
+
+# method names too generic for name-level (weak) callee resolution —
+# resolving `.get()` to every class's `get` would wire the graph into a
+# near-clique of false edges
+_WEAK_STOP = {
+    "get", "put", "pop", "append", "extend", "add", "remove", "clear",
+    "update", "insert", "join", "start", "items", "keys", "values",
+    "setdefault", "sort", "index", "copy", "count", "read", "write",
+    "flush", "close", "send", "recv", "result", "set", "is_set",
+    "wait", "notify", "notify_all", "acquire", "release", "cancel",
+    "done", "run", "popleft", "appendleft", "format", "split", "strip",
+    "encode", "decode", "match", "search", "group",
+}
+_WEAK_MAX_TARGETS = 6
+
+_ANON = "?"  # a `with <something locky>:` whose lock we cannot name
+
+
+def norm_site(path: str, line: int) -> str:
+    """Canonical creation-site key shared with the runtime half: abspath
+    when the file exists (runtime frames always do), raw otherwise (toy
+    in-memory sources in tests)."""
+    p = os.path.abspath(path) if os.path.exists(path) else path
+    return f"{p}:{int(line)}"
+
+
+@dataclass
+class LockSite:
+    key: str          # "Class._attr" or "module._NAME"
+    path: str
+    line: int
+    kind: str         # Lock | RLock | Condition
+
+    @property
+    def reentrant(self) -> bool:
+        return _LOCK_FACTORIES.get(self.kind, False)
+
+    def site(self) -> str:
+        return norm_site(self.path, self.line)
+
+
+@dataclass
+class _Edge:
+    strong: bool = False
+    witness: List[Tuple[str, int, str, str]] = field(default_factory=list)
+    # witness entries: (path, line, func qualname, via-description)
+
+
+class LockGraph:
+    """The inferred acquired-before relation over named lock sites."""
+
+    def __init__(self):
+        self.nodes: Dict[str, LockSite] = {}
+        self.edges: Dict[Tuple[str, str], _Edge] = {}
+
+    def add_node(self, s: LockSite):
+        self.nodes.setdefault(s.key, s)
+
+    def add_edge(self, a: str, b: str, strong: bool,
+                 wit: Tuple[str, int, str, str]):
+        e = self.edges.setdefault((a, b), _Edge())
+        e.strong = e.strong or strong
+        if len(e.witness) < 8 and wit not in e.witness:
+            e.witness.append(wit)
+
+    # -- cycle detection (strong edges only) --------------------------------
+
+    def strong_sccs(self) -> List[List[str]]:
+        """Tarjan over the strong subgraph; returns SCCs of size >= 2
+        (self-loops are handled separately by the re-acquisition rule)."""
+        adj: Dict[str, List[str]] = {}
+        for (a, b), e in self.edges.items():
+            if e.strong and a != b:
+                adj.setdefault(a, []).append(b)
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on: Set[str] = set()
+        stack: List[str] = []
+        out: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str):
+            # iterative Tarjan: (node, child-iterator) work stack
+            work = [(v, iter(adj.get(v, ())))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on.add(w)
+                        work.append((w, iter(adj.get(w, ()))))
+                        advanced = True
+                        break
+                    elif w in on:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        out.append(sorted(scc))
+
+        for v in list(adj):
+            if v not in index:
+                strongconnect(v)
+        return out
+
+    # -- export -------------------------------------------------------------
+
+    def site_index(self) -> Dict[str, str]:
+        """creation-site (`abspath:line`) -> lock key; the join key the
+        runtime lockdep graph is reconciled through."""
+        return {s.site(): k for k, s in self.nodes.items()}
+
+    def to_dot(self) -> str:
+        lines = ["digraph lock_order {", "  rankdir=LR;",
+                 '  node [shape=box, fontsize=10];']
+        for key in sorted(self.nodes):
+            s = self.nodes[key]
+            lines.append(
+                f'  "{key}" [label="{key}\\n'
+                f'{os.path.basename(s.path)}:{s.line} ({s.kind})"];')
+        for (a, b) in sorted(self.edges):
+            e = self.edges[(a, b)]
+            wit = e.witness[0] if e.witness else ("?", 0, "?", "?")
+            style = "" if e.strong else ", style=dashed"
+            lines.append(
+                f'  "{a}" -> "{b}" [label="{wit[2]}"'
+                f'{style}];')
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+
+    def to_json(self) -> Dict:
+        return {
+            "version": 1,
+            "nodes": {
+                k: {"path": s.path, "line": s.line, "kind": s.kind,
+                    "site": s.site()}
+                for k, s in self.nodes.items()},
+            "edges": [
+                {"src": a, "dst": b, "strong": e.strong,
+                 "witness": [
+                     {"path": w[0], "line": w[1], "func": w[2],
+                      "via": w[3]} for w in e.witness]}
+                for (a, b), e in sorted(self.edges.items())],
+        }
+
+
+# ---------------------------------------------------------------------------
+# per-class facts
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _ClassFacts:
+    name: str
+    path: str
+    locks: Dict[str, LockSite] = field(default_factory=dict)  # attr -> site
+    aliases: Dict[str, str] = field(default_factory=dict)     # attr -> attr
+    infra_attrs: Set[str] = field(default_factory=set)
+    queue_attrs: Set[str] = field(default_factory=set)
+    attr_types: Dict[str, Set[str]] = field(default_factory=dict)
+    method_names: Set[str] = field(default_factory=set)
+    thread_targets: Set[str] = field(default_factory=set)  # worker methods
+    owns_threads: bool = False
+
+
+def _factory_kind(call: ast.Call) -> Optional[str]:
+    chain = _attr_chain(call.func)
+    if not chain:
+        return None
+    last = chain.split(".")[-1]
+    if last in _LOCK_FACTORIES and (
+            chain == last or chain == f"threading.{last}"):
+        return last
+    return None
+
+
+def _ann_class_names(ann: ast.AST, classes: Set[str]) -> Set[str]:
+    """Project-class names mentioned anywhere in an annotation — covers
+    `C`, `Optional[C]`, `Union[A, B]` and string forms."""
+    out: Set[str] = set()
+    for node in ast.walk(ann):
+        if isinstance(node, ast.Name) and node.id in classes:
+            out.add(node.id)
+        elif isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and node.value in classes:
+            out.add(node.value)
+    return out
+
+
+def _iter_nodes(root: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk that does not descend into nested function/class bodies
+    (those are analyzed as their own FuncInfo) nor lambdas."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+# ---------------------------------------------------------------------------
+# the held-set walker (one per function)
+# ---------------------------------------------------------------------------
+
+class _Scan:
+    """One pass over a function body tracking the set of held lock keys;
+    records acquisitions, direct nestings, call sites (with held sets),
+    `self.<attr>` accesses, and blocking operations under a lock."""
+
+    def __init__(self, disc: "_Discipline", info: FuncInfo):
+        self.disc = disc
+        self.info = info
+        self.cls = disc.class_facts.get(info.class_name) \
+            if info.class_name else None
+        self.acq_direct: Set[str] = set()
+        self.pairs: List[Tuple[str, str, ast.AST]] = []
+        # (held, targets, strong, self_call, node, via)
+        self.calls: List[Tuple[Tuple[str, ...], Tuple[int, ...], bool,
+                               bool, ast.AST, str]] = []
+        self.accesses: List[Tuple[str, bool, Tuple[str, ...], ast.AST]] = []
+        # (node, what, held, wait-receiver-key-or-None)
+        self.blocking: List[Tuple[ast.AST, str, Tuple[str, ...],
+                                  Optional[str]]] = []
+        # (event key, held, node, method) — set/clear/wait acquire the
+        # Event's internal lock transiently
+        self.event_ops: List[Tuple[str, Tuple[str, ...], ast.AST,
+                                   str]] = []
+
+    # -- lock resolution ----------------------------------------------------
+
+    def _lock_site(self, expr: ast.AST) -> Optional[LockSite]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls") and self.cls is not None:
+            attr = self.cls.aliases.get(expr.attr, expr.attr)
+            return self.cls.locks.get(attr)
+        if isinstance(expr, ast.Name):
+            mod = self.disc.module_locks.get(self.info.path, {})
+            return mod.get(expr.id)
+        return None
+
+    def _lock_key(self, expr: ast.AST) -> Optional[str]:
+        s = self._lock_site(expr)
+        return s.key if s is not None else None
+
+    def _with_entries(self, st: ast.With) -> List[Optional[str]]:
+        out: List[Optional[str]] = []
+        for item in st.items:
+            key = self._lock_key(item.context_expr)
+            if key is not None:
+                out.append(key)
+                continue
+            chain = _attr_chain(
+                item.context_expr.func
+                if isinstance(item.context_expr, ast.Call)
+                else item.context_expr) or ""
+            out.append(_ANON if "lock" in chain.lower() else None)
+        return out
+
+    # -- walking ------------------------------------------------------------
+
+    def run(self):
+        self.walk(getattr(self.info.node, "body", []), ())
+        return self
+
+    def walk(self, stmts: Sequence[ast.stmt], held: Tuple[str, ...]):
+        for st in stmts:
+            self.stmt(st, held)
+
+    def stmt(self, st: ast.stmt, held: Tuple[str, ...]):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return
+        if isinstance(st, ast.With):
+            keys = self._with_entries(st)
+            for item in st.items:
+                self.exprs(item.context_expr, held)
+            inner = held
+            for k in keys:
+                if k is None:
+                    continue
+                if k != _ANON:
+                    self.acq_direct.add(k)
+                    for h in inner:
+                        if h != _ANON and h != k:
+                            self.pairs.append((h, k, st))
+                inner = inner + (k,)
+            self.walk(st.body, inner)
+            return
+        if isinstance(st, ast.If):
+            self.exprs(st.test, held)
+            self.walk(st.body, held)
+            self.walk(st.orelse, held)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self.exprs(st.iter, held)
+            self.walk(st.body, held)
+            self.walk(st.orelse, held)
+            return
+        if isinstance(st, ast.While):
+            self.exprs(st.test, held)
+            self.walk(st.body, held)
+            self.walk(st.orelse, held)
+            return
+        if isinstance(st, ast.Try):
+            self.walk(st.body, held)
+            for h in st.handlers:
+                self.walk(h.body, held)
+            self.walk(st.orelse, held)
+            self.walk(st.finalbody, held)
+            return
+        self.exprs(st, held)
+
+    def exprs(self, root: ast.AST, held: Tuple[str, ...]):
+        """Process every expression node under `root` (no nested defs):
+        calls and self-attribute accesses, in held-lock context."""
+        call_funcs: Set[int] = set()
+        nodes = list(_iter_nodes(root))
+        for node in nodes:
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                call_funcs.add(id(node.func))
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                self.call(node, held)
+            elif isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self" and \
+                    id(node) not in call_funcs:
+                self.access(node, held)
+
+    def access(self, node: ast.Attribute, held: Tuple[str, ...]):
+        if self.cls is None:
+            return
+        attr = node.attr
+        if attr in self.cls.infra_attrs or attr in self.cls.method_names \
+                or attr in self.cls.locks or attr in self.cls.aliases:
+            return
+        write = isinstance(node.ctx, (ast.Store, ast.Del))
+        self.accesses.append((attr, write, held, node))
+
+    # -- calls --------------------------------------------------------------
+
+    def _resolve(self, node: ast.Call) -> Tuple[List[FuncInfo], bool, bool,
+                                                str]:
+        """-> (targets, strong, self_call, via-description)."""
+        f = node.func
+        if isinstance(f, ast.Name):
+            cands = [g for g in self.disc.proj.by_name.get(f.id, [])
+                     if g.class_name is None]
+            return cands, len(cands) == 1, False, f.id
+        if not isinstance(f, ast.Attribute):
+            return [], False, False, ""
+        meth = f.attr
+        base = f.value
+        if isinstance(base, ast.Name) and base.id in ("self", "cls") \
+                and self.info.class_name:
+            cands = self.disc.methods_of(self.info.class_name, meth)
+            return cands, True, True, f"self.{meth}"
+        if isinstance(base, ast.Attribute) and \
+                isinstance(base.value, ast.Name) and \
+                base.value.id == "self" and self.cls is not None:
+            types = self.cls.attr_types.get(base.attr, set())
+            if types:
+                cands = [g for t in sorted(types)
+                         for g in self.disc.methods_of(t, meth)]
+                if cands:
+                    return cands, True, False, \
+                        f"self.{base.attr}.{meth}"
+        # weak: name-level over every class method with this name
+        if meth in _WEAK_STOP:
+            return [], False, False, meth
+        cands = [g for g in self.disc.proj.by_name.get(meth, [])
+                 if g.class_name is not None]
+        if 0 < len(cands) <= _WEAK_MAX_TARGETS:
+            return cands, False, False, f"<any>.{meth}"
+        return [], False, False, meth
+
+    def call(self, node: ast.Call, held: Tuple[str, ...]):
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _EVENT_OPS:
+            site = self._lock_site(node.func.value)
+            if site is not None and site.kind == "Event":
+                self.event_ops.append(
+                    (site.key, held, node, node.func.attr))
+        targets, strong, self_call, via = self._resolve(node)
+        if targets:
+            self.calls.append((
+                held, tuple(id(t) for t in targets), strong, self_call,
+                node, via))
+        if self.info.hot:
+            self._check_blocking(node, held)
+
+    def _check_blocking(self, node: ast.Call, held: Tuple[str, ...]):
+        """Record blocking-op candidates; whether a lock is actually held
+        (including locks the CALLER holds, per caller_held inference) is
+        decided at the findings phase."""
+        chain = _attr_chain(node.func)
+
+        def emit(what: str, rkey: Optional[str] = None):
+            self.blocking.append((node, what, held, rkey))
+
+        if chain in _BLOCKING_CALLS:
+            emit(f"blocking call `{chain}`")
+            return
+        if isinstance(node.func, ast.Attribute):
+            meth = node.func.attr
+            recv = node.func.value
+            bounded = any(kw.arg in ("timeout", "block")
+                          for kw in node.keywords) or len(node.args) >= 1
+            if meth in ("get", "put") and isinstance(recv, ast.Attribute) \
+                    and isinstance(recv.value, ast.Name) \
+                    and recv.value.id == "self" and self.cls is not None \
+                    and recv.attr in self.cls.queue_attrs:
+                qbound = any(kw.arg in ("timeout", "block")
+                             for kw in node.keywords) or len(node.args) > 1
+                if not qbound:
+                    emit(f"unbounded `self.{recv.attr}.{meth}()`")
+                return
+            if meth == "result" and not bounded:
+                emit("`.result()` without timeout")
+                return
+            if meth == "block_until_ready":
+                emit("device sync `.block_until_ready()`")
+                return
+            if meth == "wait" and not bounded:
+                # cond.wait() releases its own lock; the findings phase
+                # flags it only if OTHER locks stay held across the wait
+                emit("unbounded `.wait()`", rkey=self._lock_key(recv))
+                return
+        # device dispatch: a jitted callee traced/executed under the lock
+        bname = None
+        if isinstance(node.func, ast.Name):
+            bname = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            bname = node.func.attr
+        jitted = False
+        if bname:
+            idx = self.disc.index_of.get(self.info.path)
+            if idx is not None and bname in idx.jit_names:
+                jitted = True
+            infos = self.disc.proj.by_name.get(bname, [])
+            if infos and any(g.is_jit for g in infos):
+                jitted = True
+        if jitted:
+            emit(f"device dispatch `{bname}(...)`")
+
+
+# ---------------------------------------------------------------------------
+# the whole-program pass
+# ---------------------------------------------------------------------------
+
+class _Discipline:
+    def __init__(self, proj):
+        self.proj = proj
+        self.class_facts: Dict[str, _ClassFacts] = {}
+        self.module_locks: Dict[str, Dict[str, LockSite]] = {}
+        self.index_of = {idx.path: idx for idx in proj.indexes}
+        self.graph = LockGraph()
+        self.findings: List[Finding] = []
+        self._methods: Dict[Tuple[str, str], List[FuncInfo]] = {}
+
+    def methods_of(self, cls: str, name: str) -> List[FuncInfo]:
+        return self._methods.get((cls, name), [])
+
+    # -- fact collection ----------------------------------------------------
+
+    def collect(self):
+        class_names: Set[str] = set()
+        for path, tree in self.proj.trees.items():
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef):
+                    class_names.add(node.name)
+        for idx in self.proj.indexes:
+            for f in idx.functions:
+                if f.class_name:
+                    self._methods.setdefault(
+                        (f.class_name, f.name), []).append(f)
+
+        for path, tree in self.proj.trees.items():
+            stem = os.path.splitext(os.path.basename(path))[0]
+            if stem == "__init__":  # package locks: name by the package
+                stem = os.path.basename(os.path.dirname(path)) or stem
+            mod: Dict[str, LockSite] = {}
+            self.module_locks[path] = mod
+            # module-level locks
+            for st in tree.body:
+                if isinstance(st, ast.Assign) and \
+                        isinstance(st.value, ast.Call):
+                    kind = _factory_kind(st.value)
+                    if kind is None:
+                        continue
+                    for t in st.targets:
+                        if isinstance(t, ast.Name):
+                            site = LockSite(f"{stem}.{t.id}", path,
+                                            st.value.lineno, kind)
+                            mod[t.id] = site
+                            self.graph.add_node(site)
+            for cnode in [n for n in ast.walk(tree)
+                          if isinstance(n, ast.ClassDef)]:
+                cf = self.class_facts.setdefault(
+                    cnode.name, _ClassFacts(cnode.name, path))
+                self._collect_class(cf, cnode, class_names, stem)
+
+    def _collect_class(self, cf: _ClassFacts, cnode: ast.ClassDef,
+                       class_names: Set[str], stem: str):
+        for st in cnode.body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cf.method_names.add(st.name)
+            elif isinstance(st, ast.Assign) and \
+                    isinstance(st.value, ast.Call):
+                kind = _factory_kind(st.value)
+                for t in st.targets:
+                    if isinstance(t, ast.Name) and kind is not None:
+                        site = LockSite(f"{cf.name}.{t.id}", cf.path,
+                                        st.value.lineno, kind)
+                        cf.locks[t.id] = site
+                        self.graph.add_node(site)
+
+        init_params: Dict[str, Set[str]] = {}
+        for meth in [n for n in cnode.body
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]:
+            if meth.name == "__init__":
+                args = meth.args
+                for a in list(args.posonlyargs) + list(args.args) + \
+                        list(args.kwonlyargs):
+                    if a.annotation is not None:
+                        types = _ann_class_names(a.annotation, class_names)
+                        if types:
+                            init_params[a.arg] = types
+            for node in ast.walk(meth):
+                # threads this class owns
+                if isinstance(node, ast.Call):
+                    chain = _attr_chain(node.func)
+                    if chain in ("threading.Thread", "Thread"):
+                        cf.owns_threads = True
+                        for kw in node.keywords:
+                            if kw.arg == "target":
+                                t = _attr_chain(kw.value)
+                                if t:
+                                    cf.thread_targets.add(
+                                        t.split(".")[-1])
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if not (isinstance(t, ast.Attribute) and
+                            isinstance(t.value, ast.Name) and
+                            t.value.id in ("self", "cls")):
+                        continue
+                    attr = t.attr
+                    if isinstance(node.value, ast.Call):
+                        kind = _factory_kind(node.value)
+                        if kind is not None:
+                            # Condition(self._other) aliases the wrapped
+                            # lock: acquiring the condition IS acquiring it
+                            if kind == "Condition" and node.value.args:
+                                a0 = node.value.args[0]
+                                if isinstance(a0, ast.Attribute) and \
+                                        isinstance(a0.value, ast.Name) and \
+                                        a0.value.id == "self":
+                                    cf.aliases[attr] = a0.attr
+                                    continue
+                            if attr not in cf.locks:
+                                site = LockSite(
+                                    f"{cf.name}.{attr}", cf.path,
+                                    node.value.lineno, kind)
+                                cf.locks[attr] = site
+                                self.graph.add_node(site)
+                            continue
+                        chain = _attr_chain(node.value.func) or ""
+                        last = chain.split(".")[-1]
+                        if last in _INFRA_SUFFIXES:
+                            cf.infra_attrs.add(attr)
+                            if last in _QUEUE_SUFFIXES:
+                                cf.queue_attrs.add(attr)
+                        if last in class_names and meth.name == "__init__":
+                            cf.attr_types.setdefault(attr, set()).add(last)
+                    elif isinstance(node.value, ast.Name) and \
+                            meth.name == "__init__":
+                        types = init_params.get(node.value.id)
+                        if types:
+                            cf.attr_types.setdefault(attr, set()) \
+                                .update(types)
+
+    # -- analysis -----------------------------------------------------------
+
+    def run(self) -> Tuple[List[Finding], LockGraph]:
+        self.collect()
+        scans: Dict[int, _Scan] = {}
+        infos: Dict[int, FuncInfo] = {}
+        for idx in self.proj.indexes:
+            for f in idx.functions:
+                s = _Scan(self, f).run()
+                scans[id(f)] = s
+                infos[id(f)] = f
+
+        # caller-held inference: a `_locked` helper only ever invoked
+        # under `with self._lock:` inherits that lock at every site —
+        # without this, the guarded-field rule flags the helper's reads
+        # as unguarded.  Intersection over all STRONG SELF call sites
+        # (same instance, so the caller's self-locks genuinely cover);
+        # any bare call site empties it.
+        sites_of: Dict[int, List[Tuple[int, Tuple[str, ...]]]] = {}
+        called: Set[int] = set()
+        for fid, s in scans.items():
+            for held, targets, strong, self_call, node, via in s.calls:
+                known = tuple(h for h in held if h != _ANON)
+                for t in targets:
+                    if strong and self_call:
+                        sites_of.setdefault(t, []).append((fid, known))
+                    called.add(t)
+        caller_held: Dict[int, Set[str]] = {fid: set() for fid in scans}
+        for _ in range(4):
+            for fid in scans:
+                sites = sites_of.get(fid)
+                # a function that is ALSO reachable some other way (weak
+                # call, thread target, public entry) gets no credit: only
+                # purely-internal helpers qualify.  Heuristic: every
+                # known call is a strong self-call and the name is
+                # private ("_x"), i.e. not an external entry point.
+                if not sites or not infos[fid].name.startswith("_") or \
+                        infos[fid].hot and infos[fid].name in (
+                            "_loop", "_run", "_worker"):
+                    continue
+                acc: Optional[Set[str]] = None
+                for caller_fid, held in sites:
+                    eff = set(held) | caller_held.get(caller_fid, set())
+                    acc = eff if acc is None else (acc & eff)
+                caller_held[fid] = acc or set()
+
+        # transitive acquires: strong (typed chains), all (incl. weak),
+        # and self (same-instance `self.m()` chains only)
+        strong_acq = {fid: set(s.acq_direct) for fid, s in scans.items()}
+        all_acq = {fid: set(s.acq_direct) for fid, s in scans.items()}
+        self_acq = {fid: set(s.acq_direct) for fid, s in scans.items()}
+        for _ in range(24):
+            changed = False
+            for fid, s in scans.items():
+                for held, targets, strong, self_call, node, via in s.calls:
+                    for t in targets:
+                        if t not in all_acq:
+                            continue
+                        if not all_acq[t] <= all_acq[fid]:
+                            all_acq[fid] |= all_acq[t]
+                            changed = True
+                        if strong and not strong_acq[t] <= strong_acq[fid]:
+                            strong_acq[fid] |= strong_acq[t]
+                            changed = True
+                        if self_call and \
+                                not self_acq[t] <= self_acq[fid]:
+                            self_acq[fid] |= self_acq[t]
+                            changed = True
+            if not changed:
+                break
+
+        # edges
+        self_deadlocks: List[Tuple[FuncInfo, ast.AST, str, str]] = []
+        for fid, s in scans.items():
+            f = infos[fid]
+            for (a, b, node) in s.pairs:
+                self.graph.add_edge(a, b, True,
+                                    (f.path, getattr(node, "lineno", 0),
+                                     f.qualname, "nested with"))
+            for (ek, held, node, meth) in s.event_ops:
+                eff = {h for h in held if h != _ANON} \
+                    | caller_held.get(fid, set())
+                for h in eff:
+                    if h != ek:
+                        self.graph.add_edge(
+                            h, ek, True,
+                            (f.path, getattr(node, "lineno", 0),
+                             f.qualname, f"event .{meth}()"))
+            for held, targets, strong, self_call, node, via in s.calls:
+                known = [h for h in held if h != _ANON]
+                if not known:
+                    continue
+                for t in targets:
+                    sacq = self_acq.get(t, set())
+                    tstrong = strong_acq.get(t, set())
+                    for L in all_acq.get(t, set()):
+                        for h in known:
+                            if h == L:
+                                site = self.graph.nodes.get(h)
+                                if self_call and L in sacq and \
+                                        site is not None and \
+                                        not site.reentrant:
+                                    self_deadlocks.append(
+                                        (f, node, h, via))
+                                continue
+                            self.graph.add_edge(
+                                h, L, strong and L in tstrong,
+                                (f.path, getattr(node, "lineno", 0),
+                                 f.qualname, f"{via} -> {L}"))
+
+        self._findings_lock_order(self_deadlocks)
+        self._findings_unguarded(scans, infos, caller_held)
+        self._findings_blocking(scans, infos, caller_held)
+        return self.findings, self.graph
+
+    # -- findings -----------------------------------------------------------
+
+    def _emit(self, rule: str, path: str, line: int, func: str, msg: str):
+        src = self.proj.source_lines.get(path, [])
+        code = src[line - 1] if 0 < line <= len(src) else ""
+        self.findings.append(Finding(
+            rule=rule, path=path, line=line, col=0, func=func,
+            message=msg, code=code))
+
+    def _findings_lock_order(self, self_deadlocks):
+        for f, node, key, via in self_deadlocks:
+            self._emit(
+                "lock-order", f.path, getattr(node, "lineno", 0),
+                f.qualname,
+                f"re-acquisition of non-reentrant `{key}` on a self-call "
+                f"path (via `{via}`) — self-deadlock; make the inner "
+                "path lock-free or split a _locked variant")
+        for scc in self.graph.strong_sccs():
+            cyc = " <-> ".join(scc)
+            for (a, b), e in sorted(self.graph.edges.items()):
+                if not e.strong or a not in scc or b not in scc:
+                    continue
+                for w in e.witness[:1]:
+                    self._emit(
+                        "lock-order", w[0], w[1], w[2],
+                        f"lock-order cycle: `{a}` is held while acquiring "
+                        f"`{b}` (via {w[3]}), closing the cycle {cyc} — "
+                        "acquired-before edges must form a DAG; pick one "
+                        "global order or drop the nested acquisition")
+
+    def _findings_unguarded(self, scans, infos, caller_held):
+        # per (class, attr): access sites across all methods
+        by_attr: Dict[Tuple[str, str],
+                      List[Tuple[FuncInfo, bool, Tuple[str, ...],
+                                 ast.AST]]] = {}
+        for fid, s in scans.items():
+            f = infos[fid]
+            if f.class_name is None or f.name == "__init__":
+                continue
+            cf = self.class_facts.get(f.class_name)
+            if cf is None or not (cf.owns_threads or cf.thread_targets):
+                continue
+            inherited = tuple(sorted(caller_held.get(fid, set())))
+            for attr, write, held, node in s.accesses:
+                by_attr.setdefault((f.class_name, attr), []).append(
+                    (f, write, held + inherited, node))
+
+        for (cls, attr), sites in by_attr.items():
+            cf = self.class_facts[cls]
+            workers = cf.thread_targets
+            methods = {f.name for f, *_ in sites}
+            cross = (methods & workers and methods - workers) or \
+                len(methods & workers) >= 2
+            if not cross:
+                continue
+            counts: Dict[str, int] = {}
+            for _, _, held, _ in sites:
+                for h in held:
+                    if h != _ANON:
+                        counts[h] = counts.get(h, 0) + 1
+            if not counts:
+                continue
+            guard = max(counts, key=lambda k: counts[k])
+            guarded = [s for s in sites if guard in s[2]]
+            unguarded = [s for s in sites if guard not in s[2]]
+            if len(guarded) < 2 or not unguarded or \
+                    len(guarded) <= len(unguarded):
+                continue
+            for f, write, held, node in unguarded:
+                kind = "written" if write else "read"
+                self._emit(
+                    "unguarded-state", f.path,
+                    getattr(node, "lineno", 0), f.qualname,
+                    f"`self.{attr}` is {kind} without `{guard}` here, but "
+                    f"{len(guarded)}/{len(sites)} access sites hold it and "
+                    f"the attribute is shared with the "
+                    f"{sorted(methods & workers)} worker thread(s) — take "
+                    "the lock or suppress with the reason the race is "
+                    "benign")
+
+    def _findings_blocking(self, scans, infos, caller_held):
+        for fid, s in scans.items():
+            f = infos[fid]
+            inherited = caller_held.get(fid, set())
+            for node, what, held, rkey in s.blocking:
+                eff = [h for h in held if h != _ANON] + \
+                    sorted(inherited - set(held))
+                anon_only = not eff and _ANON in held
+                if not eff and not anon_only:
+                    continue
+                if rkey is not None:
+                    # a cond.wait() releases its own lock while waiting
+                    others = [h for h in eff if h != rkey]
+                    if not others and not anon_only:
+                        continue
+                    eff = others
+                locks = ", ".join(f"`{h}`" for h in eff) or "a lock"
+                self._emit(
+                    "blocking-under-lock", f.path,
+                    getattr(node, "lineno", 0), f.qualname,
+                    f"{what} while holding {locks} in a hot-path "
+                    "function — every thread contending on the lock "
+                    "inherits the stall")
+
+
+def analyze_lock_discipline(proj) -> Tuple[List[Finding], LockGraph]:
+    """Entry point called from `linter.Project.run()`: returns the three
+    rule families' findings plus the inferred acquired-before graph."""
+    d = _Discipline(proj)
+    return d.run()

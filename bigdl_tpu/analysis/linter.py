@@ -60,11 +60,18 @@ RULES = (
     "concurrency",
     "donation",
     "blocking-io",
+    "lock-order",
+    "unguarded-state",
+    "blocking-under-lock",
 )
 
 # Rules that guard the hot path itself: a finding is a live perf/correctness
 # bug, so the committed baseline may never carry one (CLI enforces).
-HOT_PATH_RULES = frozenset({"host-sync", "tracer-leak", "donation"})
+# lock-order (a deadlock waiting for the right interleaving) and
+# blocking-under-lock (defined only on hot roots) join the set: fix or
+# suppress inline with a reason, never grandfather.
+HOT_PATH_RULES = frozenset({"host-sync", "tracer-leak", "donation",
+                            "lock-order", "blocking-under-lock"})
 
 # Functions reachable from these qualnames are "hot": their per-call cost
 # multiplies by steps/requests/batches.  Same-module callees inherit the
@@ -1013,6 +1020,7 @@ class Project:
         self.source_lines: Dict[str, List[str]] = {}
         self.suppressions: Dict[str, Dict[int, Set[str]]] = {}
         self.by_name: Dict[str, List[FuncInfo]] = {}
+        self.lock_graph = None  # set by run() (concurrency.LockGraph)
 
     def add_source(self, path: str, text: str):
         try:
@@ -1133,6 +1141,12 @@ class Project:
                 findings.extend(w.findings)
             findings.extend(
                 _concurrency_findings(self, idx, self.trees[idx.path]))
+        # lock-discipline pass (lock-order / unguarded-state /
+        # blocking-under-lock) — lazy import avoids a module cycle
+        from bigdl_tpu.analysis import concurrency as _lockdisc
+        lock_findings, self.lock_graph = \
+            _lockdisc.analyze_lock_discipline(self)
+        findings.extend(lock_findings)
         return self._apply_suppressions(findings)
 
     def _rule_self_in_jit(self, w: _FuncWalker, f: FuncInfo):
@@ -1299,6 +1313,30 @@ def analyze_sources(sources: Dict[str, str],
     for path, text in sources.items():
         proj.add_source(path, text)
     return proj.run()
+
+
+def project_for_sources(sources: Dict[str, str],
+                        hot_roots: Optional[Sequence[str]] = None
+                        ) -> Project:
+    """Like analyze_sources but returns the Project after the run, for
+    callers that also want `project.lock_graph` (CLI dot dump, the
+    static-vs-runtime reconciliation)."""
+    proj = Project(hot_roots=hot_roots)
+    for path, text in sources.items():
+        proj.add_source(path, text)
+    proj.findings = proj.run()
+    return proj
+
+
+def project_for_paths(paths: Sequence[str],
+                      hot_roots: Optional[Sequence[str]] = None
+                      ) -> Project:
+    proj = Project(hot_roots=hot_roots)
+    for fp in iter_python_files(paths):
+        with open(fp, "r", encoding="utf-8") as fh:
+            proj.add_source(fp, fh.read())
+    proj.findings = proj.run()
+    return proj
 
 
 def iter_python_files(paths: Iterable[str]) -> List[str]:

@@ -1,0 +1,523 @@
+"""Runtime lock-order sanitizer (lockdep) for the threaded serving stack.
+
+The static pass in `bigdl_tpu.analysis.concurrency` predicts the
+acquired-before graph from source; this module OBSERVES it.  With
+`BIGDL_TPU_LOCKDEP=1` (or an explicit `instrument_locks()` call),
+`threading.Lock` / `threading.RLock` creation inside `bigdl_tpu.*`
+returns a thin wrapper that records, per thread, the set of wrapped
+locks currently held and folds every nested acquisition into a
+process-global acquired-before graph keyed by the lock's CREATION SITE
+(`file:line` — the same key `concurrency.LockGraph.site_index()`
+exposes, so runtime edges reconcile 1:1 against static predictions,
+see `tools/lockdep_reconcile.py`).
+
+The moment a blocking acquisition would close a cycle in that graph the
+wrapper raises `LockOrderViolation` — *before* touching the inner lock,
+so tests exercising a real deadlock get an exception with BOTH
+acquisition stacks instead of a hang.  Additional checks, counter-only:
+
+  * blocking-op-while-held — `time.sleep`, `queue.Queue.get/put`
+    (blocking, no timeout) entered while any instrumented lock is held;
+  * held-too-long — a lock held beyond `BIGDL_TPU_LOCKDEP_HELD_MS`
+    (default 200 ms) at release time;
+  * plain-`Lock` same-thread blocking re-acquire — guaranteed
+    self-deadlock, raised immediately.
+
+Semantics kept honest:
+
+  * RLock re-entry by the owning thread is counted, never an edge —
+    reentrancy is not an ordering fact.
+  * Non-blocking (`acquire(False)`) and bounded-timeout acquisitions
+    never add edges and never raise: a trylock cannot deadlock, so it
+    creates no ordering dependency (same rule as Linux lockdep).
+  * Edges between two locks from the SAME creation site (two instances
+    of one class) are recorded for the report but excluded from cycle
+    search — instance-level order on sibling locks is a real hazard but
+    site-keying cannot distinguish A->B from B->A, so flagging it here
+    would be pure noise; the static pass owns that rule.
+  * `Condition` support rides the `_release_save` / `_acquire_restore`
+    / `_is_owned` forwarding protocol: `cond.wait()` drops the lock
+    from the held set for the duration and restores it without
+    re-recording edges (the order was established at first acquire).
+
+Cost model: bookkeeping uses one raw `_thread` lock (never itself
+instrumented), `time.perf_counter` only, and captures a stack ONLY when
+a new edge is first witnessed — steady state is a couple of dict hits
+per nested acquire and zero per uncontended leaf acquire.  No device
+syncs, no allocation on the hot path beyond the held-list entry.  This
+is a TEST/CI tool: keep it off in production serving
+(`bench_trainer_overhead --lockdep` quantifies the delta and asserts
+the off-switch is free).
+
+Counters surface through the metrics plane as `lockdep/*` via
+`publish_metrics()` (called by `export_graph`), pull-style so lock
+bookkeeping never recurses into the registry's own (instrumented) lock.
+"""
+
+from __future__ import annotations
+
+import _thread
+import atexit
+import json
+import os
+import queue
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "LockOrderViolation",
+    "instrument_locks",
+    "uninstrument_locks",
+    "install_if_enabled",
+    "enabled",
+    "reset",
+    "snapshot",
+    "export_graph",
+    "publish_metrics",
+]
+
+_MAX_EDGES = 4096
+_MAX_VIOLATIONS = 64
+_MAX_BLOCKING = 256
+_STACK_DEPTH = 16
+
+# this module's own source path — frame walks must skip exactly THIS
+# file, not anything whose name merely contains "lockdep.py" (a test
+# module named test_lockdep.py would match a substring check)
+_SELF_FILE = os.path.abspath(__file__)
+
+# raw lock: guards every module-global below and is invisible to the
+# instrumentation (allocated via _thread, not threading.Lock)
+_state_lock = _thread.allocate_lock()
+_tls = threading.local()
+
+_edges: Dict[Tuple[str, str], Dict[str, Any]] = {}
+_adj: Dict[str, set] = {}          # cycle-search graph (same-site pairs excluded)
+_violations: List[Dict[str, Any]] = []
+_blocking: List[Dict[str, Any]] = []
+_counters: Dict[str, int] = {}
+_orig: Optional[Dict[str, Any]] = None  # saved originals while instrumented
+_match: Callable[[str], bool] = lambda path: "bigdl_tpu" in path
+_held_ms: float = 200.0
+
+
+class LockOrderViolation(RuntimeError):
+    """A blocking acquisition closed a cycle in the acquired-before
+    graph (or a plain Lock was blocking-reacquired by its owner).  The
+    message carries the cycle's sites and both acquisition stacks."""
+
+
+def _counters_init() -> Dict[str, int]:
+    return {"edges": 0, "violations": 0,
+            "blocking_under_lock": 0, "held_too_long": 0}
+
+
+_counters = _counters_init()
+
+
+def _held() -> List[list]:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = []
+        _tls.held = h
+    return h
+
+
+def _stack(skip: int = 2) -> List[str]:
+    frames = traceback.format_stack(sys._getframe(skip), limit=_STACK_DEPTH)
+    return [ln for ln in frames if _SELF_FILE not in ln]
+
+
+def _creation_site() -> str:
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if os.path.abspath(fn) != _SELF_FILE and not fn.endswith("threading.py"):
+            return os.path.abspath(fn) + ":" + str(f.f_lineno)
+        f = f.f_back
+    return "?:0"
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """DFS in the site graph; returns a site path src..dst or None.
+    Caller holds `_state_lock`."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in _adj.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+class _LockWrapper:
+    """Records ordering facts around an inner threading lock.  The
+    `_ld_` prefix keeps the namespace clear of anything client code or
+    `threading.Condition` might probe for."""
+
+    __slots__ = ("_ld_inner", "_ld_site", "_ld_reentrant")
+
+    def __init__(self, inner, site: str, reentrant: bool):
+        self._ld_inner = inner
+        self._ld_site = site
+        self._ld_reentrant = reentrant
+
+    # -- core protocol -----------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        held = _held()
+        for ent in held:
+            if ent[0] is self:
+                if self._ld_reentrant:
+                    ok = self._ld_inner.acquire(blocking, timeout)
+                    if ok:
+                        ent[1] += 1
+                    return ok
+                if blocking and (timeout is None or timeout < 0):
+                    self._ld_raise_self_deadlock()
+                # bounded/try re-acquire of an owned plain Lock: let the
+                # caller observe the failure it is coded to handle
+                return self._ld_inner.acquire(blocking, timeout)
+        unbounded = blocking and (timeout is None or timeout < 0)
+        if held and unbounded:
+            self._ld_check_cycle(held)
+        ok = self._ld_inner.acquire(blocking, timeout)
+        if ok:
+            if held and unbounded:
+                self._ld_record_edges(held)
+            held.append([self, 1, time.perf_counter()])
+        return ok
+
+    def release(self) -> None:
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            ent = held[i]
+            if ent[0] is self:
+                ent[1] -= 1
+                if ent[1] == 0:
+                    dur_ms = (time.perf_counter() - ent[2]) * 1000.0
+                    del held[i]
+                    if dur_ms > _held_ms:
+                        with _state_lock:
+                            _counters["held_too_long"] += 1
+                break
+        # not found: released from a thread that never acquired through
+        # the wrapper (signalling pattern) — forward untracked
+        self._ld_inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        f = getattr(self._ld_inner, "locked", None)
+        if f is not None:
+            return f()
+        return self._is_owned()
+
+    def __repr__(self) -> str:
+        return "<lockdep %s wrapping %r>" % (self._ld_site, self._ld_inner)
+
+    # -- Condition forwarding protocol ------------------------------------
+
+    def _is_owned(self) -> bool:
+        f = getattr(self._ld_inner, "_is_owned", None)
+        if f is not None:
+            return f()
+        for ent in _held():
+            if ent[0] is self:
+                return True
+        return False
+
+    def _release_save(self):
+        count = 1
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is self:
+                count = held[i][1]
+                del held[i]
+                break
+        f = getattr(self._ld_inner, "_release_save", None)
+        if f is not None:
+            return (count, f())
+        self._ld_inner.release()
+        return (count, None)
+
+    def _acquire_restore(self, saved) -> None:
+        count, inner_state = saved
+        f = getattr(self._ld_inner, "_acquire_restore", None)
+        if f is not None:
+            f(inner_state)
+        else:
+            self._ld_inner.acquire()
+        # no edge recording: the wait() round-trip restores an order the
+        # original acquire already established
+        _held().append([self, count, time.perf_counter()])
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def _ld_check_cycle(self, held: List[list]) -> None:
+        b = self._ld_site
+        boom = None
+        with _state_lock:
+            for ent in held:
+                a = ent[0]._ld_site
+                if a == b:
+                    continue
+                path = _find_path(b, a)
+                if path is not None:
+                    first = _edges.get((path[0], path[1]), {})
+                    rec = {
+                        "kind": "lock-order",
+                        "cycle": path + [b],
+                        "acquiring": b,
+                        "holding": a,
+                        "stack": _stack(3),
+                        "other_stack": list(first.get("stack", ())),
+                        "thread": threading.current_thread().name,
+                    }
+                    if len(_violations) < _MAX_VIOLATIONS:
+                        _violations.append(rec)
+                    _counters["violations"] += 1
+                    boom = rec
+                    break
+        if boom is not None:
+            raise LockOrderViolation(
+                "lock-order cycle: acquiring %s while holding %s would close "
+                "%s\n--- this acquisition (thread %s):\n%s"
+                "--- first witness of the reverse edge %s -> %s:\n%s"
+                % (boom["acquiring"], boom["holding"],
+                   " -> ".join(boom["cycle"]), boom["thread"],
+                   "".join(boom["stack"]),
+                   boom["cycle"][0], boom["cycle"][1],
+                   "".join(boom["other_stack"]) or "  (stack not recorded)\n"))
+
+    def _ld_record_edges(self, held: List[list]) -> None:
+        b = self._ld_site
+        with _state_lock:
+            for ent in held:
+                a = ent[0]._ld_site
+                key = (a, b)
+                rec = _edges.get(key)
+                if rec is not None:
+                    rec["count"] += 1
+                    continue
+                if len(_edges) >= _MAX_EDGES:
+                    continue
+                _edges[key] = {"count": 1, "same_site": a == b,
+                               "stack": _stack(3),
+                               "thread": threading.current_thread().name}
+                if a != b:
+                    _adj.setdefault(a, set()).add(b)
+                _counters["edges"] = len(_edges)
+
+    def _ld_raise_self_deadlock(self) -> None:
+        rec = {
+            "kind": "self-deadlock",
+            "cycle": [self._ld_site, self._ld_site],
+            "acquiring": self._ld_site,
+            "holding": self._ld_site,
+            "stack": _stack(3),
+            "other_stack": [],
+            "thread": threading.current_thread().name,
+        }
+        with _state_lock:
+            if len(_violations) < _MAX_VIOLATIONS:
+                _violations.append(rec)
+            _counters["violations"] += 1
+        raise LockOrderViolation(
+            "self-deadlock: thread %s blocking-reacquired non-reentrant lock "
+            "%s it already holds\n%s"
+            % (rec["thread"], self._ld_site, "".join(rec["stack"])))
+
+
+# -- blocking-op hooks -----------------------------------------------------
+
+def _note_blocking(what: str) -> None:
+    held = getattr(_tls, "held", None)
+    if not held:
+        return
+    with _state_lock:
+        _counters["blocking_under_lock"] += 1
+        if len(_blocking) < _MAX_BLOCKING:
+            _blocking.append({"what": what,
+                              "held": [e[0]._ld_site for e in held],
+                              "stack": _stack(3),
+                              "thread": threading.current_thread().name})
+
+
+def _make_sleep(orig):
+    def sleep(secs):
+        if secs and secs >= 0.0005:
+            _note_blocking("time.sleep")
+        return orig(secs)
+    return sleep
+
+
+def _make_qget(orig):
+    def get(self, block=True, timeout=None):
+        if block and timeout is None:
+            _note_blocking("queue.get")
+        return orig(self, block, timeout)
+    return get
+
+
+def _make_qput(orig):
+    def put(self, item, block=True, timeout=None):
+        if block and timeout is None:
+            _note_blocking("queue.put")
+        return orig(self, item, block, timeout)
+    return put
+
+
+# -- factories -------------------------------------------------------------
+
+def _make_factory(orig_factory, reentrant: bool):
+    def factory():
+        inner = orig_factory()
+        site = _creation_site()
+        if not _match(site):
+            return inner
+        return _LockWrapper(inner, site, reentrant)
+    return factory
+
+
+def instrument_locks(path_substr: str = "bigdl_tpu",
+                     path_filter: Optional[Callable[[str], bool]] = None,
+                     held_ms: Optional[float] = None) -> bool:
+    """Patch `threading.Lock`/`threading.RLock` so locks subsequently
+    created at matching sites come back wrapped, and hook the blocking
+    primitives.  Returns False (and changes nothing) if already
+    instrumented.  Only affects locks created AFTER the call — install
+    before constructing the stack under test."""
+    global _orig, _match, _held_ms
+    with _state_lock:
+        if _orig is not None:
+            return False
+        _orig = {
+            "Lock": threading.Lock,
+            "RLock": threading.RLock,
+            "sleep": time.sleep,
+            "qget": queue.Queue.get,
+            "qput": queue.Queue.put,
+        }
+        _match = path_filter if path_filter is not None \
+            else (lambda p: path_substr in p)
+        if held_ms is not None:
+            _held_ms = float(held_ms)
+        else:
+            _held_ms = float(os.environ.get("BIGDL_TPU_LOCKDEP_HELD_MS",
+                                            "200"))
+    threading.Lock = _make_factory(_orig["Lock"], False)
+    threading.RLock = _make_factory(_orig["RLock"], True)
+    time.sleep = _make_sleep(_orig["sleep"])
+    queue.Queue.get = _make_qget(_orig["qget"])
+    queue.Queue.put = _make_qput(_orig["qput"])
+    return True
+
+
+def uninstrument_locks() -> bool:
+    """Restore the original factories/primitives.  Locks already
+    created while instrumented keep their wrappers (they stay correct,
+    just still observed); call `reset()` to drop collected state."""
+    global _orig
+    with _state_lock:
+        orig, _orig = _orig, None
+    if orig is None:
+        return False
+    threading.Lock = orig["Lock"]
+    threading.RLock = orig["RLock"]
+    time.sleep = orig["sleep"]
+    queue.Queue.get = orig["qget"]
+    queue.Queue.put = orig["qput"]
+    return True
+
+
+def instrumented() -> bool:
+    return _orig is not None
+
+
+def enabled() -> bool:
+    return os.environ.get("BIGDL_TPU_LOCKDEP", "").strip().lower() in (
+        "1", "true", "on", "yes")
+
+
+def install_if_enabled() -> bool:
+    """Entry point for smokes/CI: instrument iff `BIGDL_TPU_LOCKDEP` is
+    set, and arm an atexit export when `BIGDL_TPU_LOCKDEP_EXPORT` names
+    a path."""
+    if not enabled():
+        return False
+    fresh = instrument_locks()
+    export = os.environ.get("BIGDL_TPU_LOCKDEP_EXPORT")
+    if fresh and export:
+        atexit.register(export_graph, export)
+    return fresh
+
+
+# -- reporting -------------------------------------------------------------
+
+def reset() -> None:
+    """Drop every collected edge/violation/counter (keeps the patch
+    state); the per-thread held lists are live acquisitions and are
+    left alone."""
+    global _counters
+    with _state_lock:
+        _edges.clear()
+        _adj.clear()
+        del _violations[:]
+        del _blocking[:]
+        _counters = _counters_init()
+
+
+def snapshot() -> Dict[str, Any]:
+    with _state_lock:
+        return {
+            "instrumented": _orig is not None,
+            "counters": dict(_counters),
+            "edges": [
+                {"src": a, "dst": b, "count": rec["count"],
+                 "same_site": rec["same_site"], "thread": rec["thread"]}
+                for (a, b), rec in _edges.items()
+            ],
+            "violations": [dict(v) for v in _violations],
+            "blocking": [dict(bk) for bk in _blocking],
+        }
+
+
+def publish_metrics(registry=None) -> None:
+    """Mirror the counters into the metrics plane as `lockdep/*`.
+    Pull-style (called here and by exporters), never from the acquire
+    path — the registry's own lock may itself be instrumented."""
+    if registry is None:
+        from bigdl_tpu import obs
+        registry = obs.registry()
+    with _state_lock:
+        counters = dict(_counters)
+    for name, val in counters.items():
+        registry.set_gauge("lockdep/" + name, val)
+
+
+def export_graph(path: str) -> Dict[str, Any]:
+    """Write the observed graph as JSON (the reconciliation input for
+    `tools/lockdep_reconcile.py`) and publish counters."""
+    snap = snapshot()
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(snap, fh, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    try:
+        publish_metrics()
+    except Exception:
+        pass  # exporting from atexit: the obs plane may already be torn down
+    return snap

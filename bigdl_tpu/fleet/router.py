@@ -433,7 +433,7 @@ class FleetRouter:
         # lock-free reads: dict.get and len(deque) are atomic under the
         # GIL, and a completion racing a tenant map change only risks a
         # momentarily stale depth gauge — never corrupts queue state
-        q = self._tenants.get(req.tenant)
+        q = self._tenants.get(req.tenant)  # tpu-lint: disable=unguarded-state
         depth = len(q) if q is not None else 0
         t_disp = getattr(req, "t_dispatch", req.t_enqueue)
         if q is not None:
@@ -537,8 +537,13 @@ class FleetRouter:
         with self._lock:
             self._stop = True
             self._lock.notify_all()
+            # snapshot under the lock: kill_replica appends a reaper (and
+            # mutates the replica list) from autoscaler/chaos threads, and
+            # an unlocked iteration here can race a late kill
+            replicas = list(self._replicas)
+            reapers = list(self._reapers)
         self._thread.join(timeout)
-        for r in list(self._replicas):
+        for r in replicas:
             r.close(drain=drain, timeout=timeout)
         # replica close may have bounced last inner futures into the
         # settlement queue — let the fleet-complete thread finish them,
@@ -549,7 +554,7 @@ class FleetRouter:
             self._stop_done = True
             self._done_lock.notify_all()
         self._done_thread.join(timeout)
-        for reaper in self._reapers:
+        for reaper in reapers:
             reaper.join(max(0.0, deadline - time.monotonic()) + 5.0)
 
     def __enter__(self):

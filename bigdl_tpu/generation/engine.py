@@ -1703,7 +1703,10 @@ class GenerationEngine:
     def drain(self, timeout: Optional[float] = 60.0) -> None:
         """Block until every admitted request has retired."""
         deadline = None if timeout is None else time.perf_counter() + timeout
-        while self._pending or self._n_active() or self._n_prefilling():
+        # poll loop: a stale lock-free read of the pending deque only
+        # delays exit by one 2ms tick; taking _cond here would contend
+        # with the scheduler thread for nothing
+        while self._pending or self._n_active() or self._n_prefilling():  # tpu-lint: disable=unguarded-state
             if deadline is not None and time.perf_counter() > deadline:
                 raise TimeoutError("generation engine did not drain in time")
             time.sleep(0.002)

@@ -196,9 +196,12 @@ class BlockPool:
             sshape = (n_layer, n_blocks, block_size, n_head)
             self.k_scale = jax.device_put(jnp.zeros(sshape, jnp.float32))
             self.v_scale = jax.device_put(jnp.zeros(sshape, jnp.float32))
-        # reentrant: a claim shortfall invokes the reclaim hook, whose
-        # evictions call back into release() on the same thread
-        self._lock = threading.RLock()
+        # plain (non-reentrant) lock, and a LEAF in the global lock
+        # order: no pool method calls out while holding it — a claim
+        # shortfall invokes the reclaim hook with the lock RELEASED, so
+        # the hook's store-lock -> release() path nests store -> pool,
+        # never pool -> store (lockdep enforces the DAG at runtime)
+        self._lock = threading.Lock()
         # LIFO free list: recently-released blocks are re-claimed first,
         # keeping the hot working set compact in the pool
         self._free: List[int] = list(range(n_blocks - 1, 0, -1))
@@ -250,9 +253,10 @@ class BlockPool:
     def set_reclaim(self, cb: Optional[Callable[[int], int]]) -> None:
         """Install the claim-shortfall hook: `cb(n)` must try to free at
         least `n` blocks (the prefix store evicts idle refcount-1
-        entries) and return how many it released.  Called under the pool
-        lock on the claiming thread — the lock is reentrant so the
-        hook's `release` calls land back here safely."""
+        entries) and return how many it released.  Called WITHOUT the
+        pool lock held, on the claiming thread: the hook may take its
+        own lock and call `release` freely, and the acquired-before
+        order stays store -> pool everywhere."""
         with self._lock:
             self._reclaim = cb
 
@@ -283,10 +287,18 @@ class BlockPool:
         raising after that is impossible while every claim is
         reservation-covered (reservations are granted against
         `n_allocatable - blocks_shared`, and non-shared resident blocks
-        are either reservation-covered or reclaimable)."""
+        are either reservation-covered or reclaimable).
+
+        The hook runs with the pool lock RELEASED (it takes the store
+        lock and calls back into `release`); claims are engine-thread-
+        only and reservation-covered, so the drop-and-retake window
+        cannot be raced into a false exhaustion."""
         with self._lock:
-            if len(self._free) < n and self._reclaim is not None:
-                self._reclaim(n - len(self._free))
+            shortfall = n - len(self._free)
+            reclaim = self._reclaim
+        if shortfall > 0 and reclaim is not None:
+            reclaim(shortfall)
+        with self._lock:
             if len(self._free) < n:
                 raise RuntimeError(
                     f"block pool exhausted: want {n}, free {len(self._free)}"

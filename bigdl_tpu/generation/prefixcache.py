@@ -118,11 +118,12 @@ class PrefixStore:
     rides.  All mutation happens on the engine scheduler thread; the
     internal lock only guards metric/snapshot readers.
 
-    Lock order: `BlockPool.claim` -> reclaim hook -> store lock ->
-    `pool.release` (pool lock is reentrant); publish/evict take store
-    lock -> pool lock.  Both composite paths run on the engine thread
-    only, and other threads take at most one of the locks, so the
-    apparent cycle cannot deadlock.
+    Lock order: store lock -> pool lock, everywhere.  Publish/evict
+    nest `pool.addref`/`pool.release` under the store lock, and the
+    claim-shortfall reclaim hook runs with the pool lock RELEASED
+    (`BlockPool.claim` drops it before invoking the hook), so the
+    acquired-before graph is a DAG — both locks are plain non-reentrant
+    Locks and the runtime lockdep sanitizer verifies the order.
     """
 
     def __init__(self, pool: BlockPool, max_bytes: Optional[int] = None,
@@ -137,7 +138,9 @@ class PrefixStore:
             cap = min(cap, int(max_bytes) // per_block)
         self.cap_blocks = max(0, cap)
         self._block_bytes = per_block
-        self._lock = threading.RLock()
+        # plain lock; always taken BEFORE the pool lock (never re-entered:
+        # _evict_idle is caller-holds-lock by convention)
+        self._lock = threading.Lock()
         self._entries: Dict[str, _Entry] = {}
         self._world: Optional[str] = None
         self._seq = 0
@@ -267,8 +270,8 @@ class PrefixStore:
 
     def reclaim(self, n: int) -> int:
         """`BlockPool.set_reclaim` hook: free >= `n` blocks if possible
-        by evicting idle entries (LRU).  Runs under the pool lock on the
-        claiming thread."""
+        by evicting idle entries (LRU).  Runs on the claiming thread
+        with the pool lock NOT held (store -> pool order preserved)."""
         with self._lock:
             return self._evict_idle(lambda e: True, limit=max(1, int(n)))
 

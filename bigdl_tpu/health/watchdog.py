@@ -319,7 +319,9 @@ class HangWatchdog:
     def check(self) -> None:
         """Raise the pending StalledStep, once.  Cheap enough for poll
         loops: one lock-free read on the happy path."""
-        stall = self._stall
+        # double-checked: the lock-free fast-path read may be stale for
+        # one poll tick; the locked re-read below decides for real
+        stall = self._stall  # tpu-lint: disable=unguarded-state
         if stall is not None:
             with self._lock:
                 stall, self._stall = self._stall, None

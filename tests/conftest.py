@@ -9,6 +9,7 @@ and switch the platform config to cpu.
 """
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
@@ -51,6 +52,21 @@ def strict_transfers():
 
     with _guard(True):
         yield
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_reset():
+    """Lockdep state is process-global (edges, violations, counters) and
+    its instrumentation patches `threading.Lock`/`RLock` — a test that
+    instruments and fails before restoring would silently observe every
+    later test.  Restore the factories and drop collected state after
+    each test that touched the sanitizer; tests that never import it pay
+    one sys.modules dict hit."""
+    yield
+    mod = sys.modules.get("bigdl_tpu.analysis.lockdep")
+    if mod is not None:
+        mod.uninstrument_locks()
+        mod.reset()
 
 
 @pytest.fixture(autouse=True)

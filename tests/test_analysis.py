@@ -717,3 +717,342 @@ class TestStrictTrainerIntegration:
     def test_clean_hot_loop_fits_under_guard(self, monkeypatch):
         # regression: the shipped hot loop must stay strict-clean
         self._fit(monkeypatch, inject=False)
+
+
+# ----------------------------------------------------------------------
+# rule family: lock-order (static lock-discipline pass)
+# ----------------------------------------------------------------------
+
+class TestLockOrder:
+    def test_positive_abba_cycle_between_typed_classes(self):
+        src = """
+import threading
+
+
+class Pool:
+    def __init__(self, store: "Store"):
+        self.store = store
+        self._lock = threading.Lock()
+
+    def claim(self):
+        with self._lock:
+            self.store.evict()
+
+    def free(self):
+        with self._lock:
+            pass
+
+
+class Store:
+    def __init__(self, pool: "Pool"):
+        self.pool = pool
+        self._lock = threading.Lock()
+
+    def evict(self):
+        with self._lock:
+            pass
+
+    def publish(self):
+        with self._lock:
+            self.pool.free()
+"""
+        fs = [f for f in _findings(src) if f.rule == "lock-order"]
+        assert fs, "ABBA cycle through typed attrs must be reported"
+        assert any("Pool._lock" in f.message and "Store._lock" in f.message
+                   for f in fs)
+
+    def test_negative_single_global_order(self):
+        src = """
+import threading
+
+
+class Pool:
+    def __init__(self, store: "Store"):
+        self.store = store
+        self._lock = threading.Lock()
+
+    def free(self):
+        with self._lock:
+            pass
+
+
+class Store:
+    def __init__(self, pool: "Pool"):
+        self.pool = pool
+        self._lock = threading.Lock()
+
+    def evict(self):
+        with self._lock:
+            self.pool.free()
+
+    def publish(self):
+        with self._lock:
+            self.pool.free()
+"""
+        assert "lock-order" not in _rules(src)
+
+    def test_positive_self_deadlock_on_nonreentrant_self_call(self):
+        src = """
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def step(self):
+        with self._lock:
+            self._flush()
+
+    def _flush(self):
+        with self._lock:
+            pass
+"""
+        fs = [f for f in _findings(src) if f.rule == "lock-order"]
+        assert any("self-deadlock" in f.message for f in fs)
+
+    def test_negative_rlock_self_call_is_reentrant(self):
+        src = """
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def step(self):
+        with self._lock:
+            self._flush()
+
+    def _flush(self):
+        with self._lock:
+            pass
+"""
+        assert "lock-order" not in _rules(src)
+
+    def test_inline_disable_silences(self):
+        src = """
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def step(self):
+        with self._lock:
+            self._flush()  # tpu-lint: disable=lock-order
+
+    def _flush(self):
+        with self._lock:
+            pass
+"""
+        assert "lock-order" not in _rules(src)
+
+
+# ----------------------------------------------------------------------
+# rule family: unguarded-state
+# ----------------------------------------------------------------------
+
+UNGUARDED_SRC = """
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self.items.append(1)
+
+    def push(self, x):
+        with self._lock:
+            self.items.append(x)
+
+    def flush(self):
+        self.items.clear()%s
+"""
+
+
+class TestUnguardedState:
+    def test_positive_majority_guarded_minority_not(self):
+        fs = [f for f in _findings(UNGUARDED_SRC % "")
+              if f.rule == "unguarded-state"]
+        assert fs, "2 guarded + 1 unguarded cross-thread site must report"
+        assert any("items" in f.message for f in fs)
+
+    def test_negative_all_sites_guarded(self):
+        src = (UNGUARDED_SRC % "").replace(
+            "        self.items.clear()",
+            "        with self._lock:\n"
+            "            self.items.clear()")
+        assert "unguarded-state" not in _rules(src)
+
+    def test_negative_no_thread_ownership_no_rule(self):
+        src = (UNGUARDED_SRC % "").replace(
+            "        self._t = threading.Thread("
+            "target=self._loop, daemon=True)\n"
+            "        self._t.start()\n", "")
+        assert "unguarded-state" not in _rules(src)
+
+    def test_inline_disable_silences(self):
+        src = UNGUARDED_SRC % "  # tpu-lint: disable=unguarded-state"
+        assert "unguarded-state" not in _rules(src)
+
+
+# ----------------------------------------------------------------------
+# rule family: blocking-under-lock
+# ----------------------------------------------------------------------
+
+class TestBlockingUnderLock:
+    def test_positive_sleep_under_lock_on_hot_root(self):
+        src = """
+import threading
+import time
+
+
+class DeviceFeed:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _worker(self):
+        with self._lock:
+            time.sleep(1.0)
+"""
+        fs = [f for f in _findings(src, hot_roots=[r"_worker$"])
+              if f.rule == "blocking-under-lock"]
+        assert fs and any("time.sleep" in f.message for f in fs)
+
+    def test_positive_caller_held_lock_reaches_helper(self):
+        src = """
+import threading
+import time
+
+
+class MicroBatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _loop(self):
+        with self._lock:
+            self._drain()
+
+    def _drain(self):
+        time.sleep(0.5)
+"""
+        fs = [f for f in _findings(src, hot_roots=[r"_loop$"])
+              if f.rule == "blocking-under-lock"]
+        assert fs, "lock held by the caller must count (caller-held " \
+                   "inference)"
+
+    def test_negative_blocking_outside_lock(self):
+        src = """
+import threading
+import time
+
+
+class DeviceFeed:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _worker(self):
+        with self._lock:
+            n = 1
+        time.sleep(1.0)
+"""
+        assert "blocking-under-lock" not in _rules(
+            src, hot_roots=[r"_worker$"])
+
+    def test_negative_bounded_queue_get_under_lock(self):
+        src = """
+import queue
+import threading
+
+
+class DeviceFeed:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+
+    def _worker(self):
+        with self._lock:
+            item = self._q.get(timeout=0.5)
+        return item
+"""
+        assert "blocking-under-lock" not in _rules(
+            src, hot_roots=[r"_worker$"])
+
+    def test_hot_path_rule_never_baselinable(self):
+        assert "lock-order" in HOT_PATH_RULES
+        assert "blocking-under-lock" in HOT_PATH_RULES
+        assert "unguarded-state" not in HOT_PATH_RULES
+
+
+# ----------------------------------------------------------------------
+# the static lock graph surface
+# ----------------------------------------------------------------------
+
+class TestLockGraph:
+    def test_graph_nodes_edges_and_dot(self):
+        from bigdl_tpu.analysis.linter import project_for_sources
+        src = """
+import threading
+
+
+class Store:
+    def __init__(self, pool: "Pool"):
+        self.pool = pool
+        self._lock = threading.Lock()
+
+    def evict(self):
+        with self._lock:
+            self.pool.free()
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+
+    def free(self):
+        with self._lock:
+            self._done.set()
+"""
+        proj = project_for_sources({"mod.py": src})
+        g = proj.lock_graph
+        assert {"Store._lock", "Pool._lock", "Pool._done"} <= set(g.nodes)
+        assert ("Store._lock", "Pool._lock") in g.edges
+        assert g.edges[("Store._lock", "Pool._lock")].strong
+        # Event internal-lock edge: free() holds Pool._lock across set()
+        assert ("Pool._lock", "Pool._done") in g.edges
+        dot = g.to_dot()
+        assert "digraph" in dot and "Store._lock" in dot
+
+    def test_condition_aliases_its_backing_lock(self):
+        from bigdl_tpu.analysis.linter import project_for_sources
+        src = """
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def kick(self):
+        with self._cond:
+            pass
+
+    def wait_done(self):
+        with self._lock:
+            pass
+"""
+        proj = project_for_sources({"mod.py": src})
+        g = proj.lock_graph
+        assert "Engine._lock" in g.nodes
+        assert "Engine._cond" not in g.nodes  # alias, not a second lock

@@ -1,0 +1,308 @@
+"""Runtime lockdep sanitizer (bigdl_tpu.analysis.lockdep).
+
+Pins the wrapper semantics the docs claim: a blocking acquisition that
+closes a cycle raises with BOTH stacks instead of deadlocking, RLock
+re-entry is never an ordering fact, trylocks create no edges, Condition
+round-trips through the forwarding protocol, instrument/uninstrument is
+idempotent, and the whole observed graph reconciles against the static
+pass over a toy two-class project (runtime ⊆ static).
+"""
+
+import importlib.util
+import json
+import os
+import queue
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from bigdl_tpu.analysis import lockdep
+from bigdl_tpu.analysis.lockdep import LockOrderViolation
+
+
+def _instrument():
+    # match locks created in THIS file and in the toy module only — the
+    # default "bigdl_tpu" filter would skip tests/ paths
+    assert lockdep.instrument_locks(
+        path_filter=lambda p: "test_lockdep" in p or "toy_locks" in p)
+
+
+def _run(fn):
+    """Run `fn` on a fresh joined thread, returning its exception."""
+    box = []
+
+    def body():
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 - the exception IS the result
+            box.append(e)
+
+    t = threading.Thread(target=body, name="lockdep-test")
+    t.start()
+    t.join(10)
+    assert not t.is_alive(), "test thread wedged"
+    return box[0] if box else None
+
+
+class TestOrdering:
+    def test_ab_ba_raises_with_both_stacks(self):
+        _instrument()
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        err = _run(ba)
+        assert isinstance(err, LockOrderViolation)
+        msg = str(err)
+        assert "this acquisition" in msg and "reverse edge" in msg
+        # both acquisition stacks must name this test's call sites
+        assert msg.count("test_lockdep.py") >= 2
+        snap = lockdep.snapshot()
+        assert snap["counters"]["violations"] == 1
+        assert snap["violations"][0]["kind"] == "lock-order"
+
+    def test_three_lock_cycle_detected_transitively(self):
+        _instrument()
+        # distinct lines on purpose: locks born on the SAME line share a
+        # site key and their edges are same-site-exempt from cycle search
+        a = threading.Lock()
+        b = threading.Lock()
+        c = threading.Lock()
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+
+        def ca():
+            with c:
+                with a:
+                    pass
+
+        err = _run(ca)
+        assert isinstance(err, LockOrderViolation)
+        cyc = lockdep.snapshot()["violations"][0]["cycle"]
+        assert len(cyc) == 4  # c -> a -> b -> c (closing node repeated)
+
+    def test_rlock_reentrancy_is_not_an_edge(self):
+        _instrument()
+        r = threading.RLock()
+        other = threading.Lock()
+        with r:
+            with r:  # re-entry: no self edge, no violation
+                with other:
+                    pass
+        snap = lockdep.snapshot()
+        assert snap["counters"]["violations"] == 0
+        # the only edge is r -> other, recorded once despite re-entry
+        assert [(e["src"] == e["dst"]) for e in snap["edges"]] == [False]
+
+    def test_plain_lock_self_reacquire_raises_not_hangs(self):
+        _instrument()
+        lk = threading.Lock()
+        with lk:
+            with pytest.raises(LockOrderViolation, match="self-deadlock"):
+                lk.acquire()
+            # a trylock of an owned lock is a legitimate probe: False
+            assert lk.acquire(False) is False
+        assert lockdep.snapshot()["counters"]["violations"] == 1
+
+    def test_trylock_creates_no_edges(self):
+        _instrument()
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            assert b.acquire(False)
+            b.release()
+        assert lockdep.snapshot()["edges"] == []
+
+    def test_condition_wait_roundtrip(self):
+        _instrument()
+        cond = threading.Condition()  # default RLock, wrapped
+        assert isinstance(cond._lock, lockdep._LockWrapper)
+        hits = []
+
+        def waiter():
+            with cond:
+                while not hits:
+                    cond.wait(timeout=5)
+
+        t = threading.Thread(target=waiter, name="lockdep-test-wait")
+        t.start()
+        time.sleep(0.05)
+        with cond:
+            hits.append(1)
+            cond.notify_all()
+        t.join(10)
+        assert not t.is_alive()
+        assert lockdep.snapshot()["counters"]["violations"] == 0
+
+
+class TestBlockingUnderLock:
+    def test_sleep_and_unbounded_queue_ops_counted(self):
+        _instrument()
+        lk = threading.Lock()
+        q = queue.Queue()
+        q.put("primed")  # not under lock: not counted
+        base = lockdep.snapshot()["counters"]["blocking_under_lock"]
+        with lk:
+            time.sleep(0.002)          # counted
+            q.get()                    # unbounded get: counted
+            q.put("x", block=True, timeout=0.1)  # bounded: not counted
+            q.get(timeout=0.1)         # bounded: not counted
+        time.sleep(0.002)              # no lock held: not counted
+        snap = lockdep.snapshot()
+        assert snap["counters"]["blocking_under_lock"] - base == 2
+        whats = {b["what"] for b in snap["blocking"]}
+        assert whats == {"time.sleep", "queue.get"}
+        assert all(b["held"] for b in snap["blocking"])
+
+
+class TestLifecycle:
+    def test_instrument_uninstrument_idempotent(self):
+        orig_lock, orig_rlock = threading.Lock, threading.RLock
+        orig_sleep = time.sleep
+        _instrument()
+        assert not lockdep.instrument_locks()  # second call: no-op
+        assert isinstance(threading.Lock(), lockdep._LockWrapper)
+        assert lockdep.uninstrument_locks()
+        assert not lockdep.uninstrument_locks()  # second call: no-op
+        assert threading.Lock is orig_lock
+        assert threading.RLock is orig_rlock
+        assert time.sleep is orig_sleep
+        assert not isinstance(threading.Lock(), lockdep._LockWrapper)
+
+    def test_filter_skips_foreign_sites(self):
+        assert lockdep.instrument_locks(path_filter=lambda p: False)
+        lk = threading.Lock()
+        assert not isinstance(lk, lockdep._LockWrapper)
+
+    def test_reset_drops_state_keeps_patch(self):
+        _instrument()
+        a, b = threading.Lock(), threading.Lock()
+        with a:
+            with b:
+                pass
+        assert lockdep.snapshot()["edges"]
+        lockdep.reset()
+        snap = lockdep.snapshot()
+        assert snap["edges"] == [] and snap["counters"]["edges"] == 0
+        assert snap["instrumented"]
+
+    def test_install_if_enabled_gates_on_env(self, monkeypatch):
+        monkeypatch.delenv("BIGDL_TPU_LOCKDEP", raising=False)
+        assert not lockdep.install_if_enabled()
+        assert not lockdep.instrumented()
+        monkeypatch.setenv("BIGDL_TPU_LOCKDEP", "1")
+        assert lockdep.install_if_enabled()
+        assert lockdep.instrumented()
+
+    def test_export_graph_writes_json(self, tmp_path):
+        _instrument()
+        a, b = threading.Lock(), threading.Lock()
+        with a:
+            with b:
+                pass
+        out = tmp_path / "lockdep.json"
+        lockdep.export_graph(str(out))
+        snap = json.loads(out.read_text())
+        assert snap["edges"] and snap["counters"]["edges"] == 1
+        # counters surfaced on the metrics plane as lockdep/* gauges
+        from bigdl_tpu import obs
+        assert obs.registry().get("lockdep/edges") == 1
+
+
+TOY_SRC = textwrap.dedent("""
+    import threading
+
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.freed = 0
+            self.cb = None
+
+        def release(self):
+            with self._lock:
+                self.freed += 1
+
+        def poke(self):
+            # callback under the lock: the static pass cannot see what
+            # `cb` acquires — exactly the blind spot reconciliation
+            # exists to catch
+            with self._lock:
+                if self.cb is not None:
+                    self.cb()
+
+
+    class Store:
+        def __init__(self, pool: "Pool"):
+            self.pool = pool
+            self._lock = threading.Lock()
+
+        def evict(self):
+            with self._lock:
+                self.pool.release()
+
+        def touch(self):
+            with self._lock:
+                pass
+""")
+
+
+class TestReconciliation:
+    """Static-vs-runtime join over a toy two-class project: every edge
+    lockdep observes must be predicted by the static graph, and an edge
+    taken through an opaque callback must FAIL reconciliation."""
+
+    def _load_toy(self, tmp_path):
+        p = tmp_path / "toy_locks.py"
+        p.write_text(TOY_SRC)
+        spec = importlib.util.spec_from_file_location("toy_locks", str(p))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return p, mod
+
+    def _reconcile(self, export, toy_path):
+        spec = importlib.util.spec_from_file_location(
+            "lockdep_reconcile",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "tools",
+                "lockdep_reconcile.py"))
+        tool = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tool)
+        return tool.main([str(export), str(toy_path), "--require-edges",
+                          "1"])
+
+    def test_predicted_edges_reconcile(self, tmp_path, capsys):
+        toy_path, mod = self._load_toy(tmp_path)
+        _instrument()
+        store = mod.Store(mod.Pool())  # locks created while instrumented
+        store.evict()                  # Store._lock -> Pool._lock
+        out = tmp_path / "export.json"
+        lockdep.export_graph(str(out))
+        assert self._reconcile(out, toy_path) == 0
+        assert "all statically predicted" in capsys.readouterr().out
+
+    def test_callback_edge_fails_reconciliation(self, tmp_path, capsys):
+        toy_path, mod = self._load_toy(tmp_path)
+        _instrument()
+        pool = mod.Pool()
+        store = mod.Store(pool)
+        pool.cb = store.touch
+        pool.poke()                    # Pool._lock -> Store._lock, opaque
+        out = tmp_path / "export.json"
+        lockdep.export_graph(str(out))
+        assert self._reconcile(out, toy_path) == 1
+        assert "unpredicted edge" in capsys.readouterr().err

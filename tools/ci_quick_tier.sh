@@ -119,6 +119,33 @@ if [ "$rc" -eq 0 ]; then
     elapsed=$(( $(date +%s) - start ))
 fi
 
+if [ "$rc" -eq 0 ]; then
+    # lockdep lane: the fleet + generation + readers smokes again, this
+    # time with the runtime lock-order sanitizer armed — any acquisition
+    # that closes a cycle in the acquired-before graph raises inside the
+    # smoke (rc != 0), each exported graph must be non-empty, and every
+    # runtime edge must be predicted by the static lock-discipline pass
+    # (tools/lockdep_reconcile.py: runtime ⊆ static, see docs/analysis.md)
+    ld_dir=$(mktemp -d)
+    for smoke in fleet generation readers; do
+        [ "$rc" -ne 0 ] && break
+        remaining=$(( BUDGET - elapsed ))
+        [ "$remaining" -lt 30 ] && remaining=30
+        BIGDL_TPU_LOCKDEP=1 \
+        BIGDL_TPU_LOCKDEP_EXPORT="$ld_dir/${smoke}.json" \
+            timeout --signal=TERM "$remaining" \
+            python "tools/${smoke}_smoke.py"
+        rc=$?
+        elapsed=$(( $(date +%s) - start ))
+        if [ "$rc" -eq 0 ]; then
+            python tools/lockdep_reconcile.py "$ld_dir/${smoke}.json" \
+                --require-edges 1
+            rc=$?
+        fi
+    done
+    rm -rf "$ld_dir"
+fi
+
 if [ "$rc" -eq 124 ]; then
     echo "FAIL: quick tier exceeded the ${BUDGET}s budget (killed)" >&2
     exit 1

@@ -31,6 +31,20 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+# lockdep must wrap locks AT CREATION, and importing any bigdl_tpu module
+# creates module-level locks — so load the (stdlib-only) sanitizer by file
+# path and instrument before the first bigdl_tpu import below
+import importlib.util  # noqa: E402
+
+_ld_spec = importlib.util.spec_from_file_location(
+    "bigdl_tpu.analysis.lockdep",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "bigdl_tpu", "analysis", "lockdep.py"))
+lockdep = importlib.util.module_from_spec(_ld_spec)
+sys.modules[_ld_spec.name] = lockdep
+_ld_spec.loader.exec_module(lockdep)
+lockdep.install_if_enabled()
+
 import numpy as np  # noqa: E402
 
 import jax  # noqa: E402

@@ -24,7 +24,7 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from bigdl_tpu.analysis.linter import (  # noqa: E402
-    HOT_PATH_RULES, RULES, analyze_paths)
+    HOT_PATH_RULES, RULES)
 
 DEFAULT_PATHS = ["bigdl_tpu/"]
 
@@ -53,6 +53,10 @@ def main(argv=None):
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--hot-root", action="append", default=[],
                     help="extra hot-root qualname regex (repeatable)")
+    ap.add_argument("--lock-graph", default=None, metavar="OUT",
+                    help="dump the static acquired-before lock graph "
+                         "(.dot for graphviz, .json for "
+                         "tools/lockdep_reconcile.py)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -72,11 +76,25 @@ def main(argv=None):
             return 2
 
     paths = args.paths or DEFAULT_PATHS
-    from bigdl_tpu.analysis.linter import DEFAULT_HOT_ROOTS
+    from bigdl_tpu.analysis.linter import (DEFAULT_HOT_ROOTS,
+                                           project_for_paths)
     hot_roots = list(DEFAULT_HOT_ROOTS) + args.hot_root
-    findings = analyze_paths(paths, hot_roots=hot_roots)
+    proj = project_for_paths(paths, hot_roots=hot_roots)
+    findings = proj.findings
     if rules is not None:
         findings = [f for f in findings if f.rule in rules]
+
+    if args.lock_graph:
+        graph = proj.lock_graph
+        out = args.lock_graph
+        with open(out, "w") as fh:
+            if out.endswith(".json"):
+                json.dump(graph.to_json(), fh, indent=1, sort_keys=True)
+                fh.write("\n")
+            else:
+                fh.write(graph.to_dot())
+        print(f"tpu_lint: wrote lock graph ({len(graph.nodes)} locks, "
+              f"{len(graph.edges)} edges) to {out}")
 
     if args.write_baseline:
         if not args.baseline:
