@@ -13,11 +13,19 @@ front of a runtime:
      layer — the warm path must reuse executables (`warmup_reused` > 0)
      instead of recompiling.
 
+`--failover-quick` (ISSUE 20) answers two more and writes
+benchmarks/results/failover_quick.json: prefix-warm vs cold recovery
+TTFT for a >=1k-token in-flight resume (bar: warm >= 2x faster,
+token parity, leak-free pool) and the no-fault cost of the per-step
+progress snapshots that make resume possible (interleaved A/B,
+bar: <= 1% at the median of pairwise ratios).
+
 Emits one JSON row per phase and writes
 benchmarks/results/fleet_quick.json under --quick.
 
     python benchmarks/bench_fleet.py            # TPU-sized
     python benchmarks/bench_fleet.py --quick    # CPU-sized (CI)
+    python benchmarks/bench_fleet.py --failover-quick
 """
 
 from __future__ import annotations
@@ -160,10 +168,132 @@ def run_scaleout(model, params, state):
         cc.reset()
 
 
+def run_failover_recovery(quick: bool):
+    """Prefix-warm vs cold recovery TTFT for a >=1k-token in-flight
+    request (ISSUE 20 acceptance).  One engine, interleaved trials: a
+    `prefix_store.clear()` forces the cold arm to re-fold the whole
+    1k-token effective prompt; the cold run itself republishes it, so
+    the warm arm that follows rides the chunk-skipping path.  Both arms
+    must stay token-for-token identical to the unkilled baseline."""
+    import jax
+
+    from bigdl_tpu.generation import GenerationConfig, GenerationEngine
+    from bigdl_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(vocab_size=61, hidden_size=32, n_layer=2,
+                          n_head=4, max_len=2048, use_flash=False)
+    params, _ = model.init((1, 16), rng=jax.random.PRNGKey(0))
+    rs = np.random.RandomState(5)
+    prompt = rs.randint(1, 61, size=1024).astype(np.int32)
+    max_new = 32
+    trials = 5 if quick else 9
+    eng = GenerationEngine(model, params, config=GenerationConfig(
+        buckets=(1280,), slots=2, max_new_tokens=max_new, temperature=0.0,
+        paged=True, kv_block_size=16, prefill_chunk=128,
+        spec_decode=False, prefix_cache=True))
+    try:
+        base = eng.generate(prompt, timeout=600, cid="fo-bench")
+        want = [int(t) for t in base.tokens]
+        resume = want[:max_new // 2]  # the victim died mid-decode
+        cold, warm = [], []
+        parity = True
+        prefix_tokens = 0
+        for _ in range(trials):
+            eng.prefix_store.clear()
+            r_cold = eng.generate(prompt, timeout=600, cid="fo-bench",
+                                  resume_tokens=resume)
+            r_warm = eng.generate(prompt, timeout=600, cid="fo-bench",
+                                  resume_tokens=resume)
+            cold.append(float(r_cold.meta["ttft_ms"]))
+            warm.append(float(r_warm.meta["ttft_ms"]))
+            parity = parity and [int(t) for t in r_cold.tokens] == want \
+                and [int(t) for t in r_warm.tokens] == want
+            prefix_tokens = int(r_warm.meta.get("recovery_prefix_tokens", 0))
+        eng.drain()
+        pool, store = eng._pool, eng.prefix_store
+        leak_free = bool(
+            pool.blocks_free + len(store) == pool.n_allocatable
+            and pool.blocks_reserved == 0)
+    finally:
+        eng.close()
+    c_med, w_med = statistics.median(cold), statistics.median(warm)
+    speedup = c_med / w_med if w_med else None
+    return {
+        "phase": "failover_recovery_ttft",
+        "prompt_tokens": int(prompt.size), "resumed_tokens": len(resume),
+        "trials": trials,
+        "cold_recovery_ttft_ms_median": round(c_med, 2),
+        "warm_recovery_ttft_ms_median": round(w_med, 2),
+        "cold_ttft_ms_all": [round(t, 2) for t in cold],
+        "warm_ttft_ms_all": [round(t, 2) for t in warm],
+        "warm_speedup": round(speedup, 2) if speedup else None,
+        "recovery_prefix_tokens": prefix_tokens,
+        "token_parity": bool(parity), "pool_leak_free": leak_free,
+        "bar_speedup": 2.0,
+        "pass": bool(parity and leak_free and speedup and speedup >= 2.0),
+    }
+
+
+def run_progress_overhead(quick: bool):
+    """Failover-on-no-faults cost: the progress snapshots published at
+    every decode step, measured as an interleaved A/B of the SAME decode
+    burst with `progress_meta` on vs off.  Pairwise per-trial ratios
+    (the run_ab discipline) — the bar is <= 1% at the median."""
+    import jax
+
+    from bigdl_tpu.generation import GenerationConfig, GenerationEngine
+    from bigdl_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(vocab_size=61, hidden_size=32, n_layer=2,
+                          n_head=4, max_len=128, use_flash=False)
+    params, _ = model.init((1, 16), rng=jax.random.PRNGKey(0))
+    rs = np.random.RandomState(7)
+    prompts = [rs.randint(1, 61, size=24).astype(np.int32)
+               for _ in range(8)]
+    trials = 7 if quick else 11
+
+    def mk(progress):
+        return GenerationEngine(model, params, config=GenerationConfig(
+            buckets=(64,), slots=4, max_new_tokens=32, temperature=0.0,
+            paged=False, prefill_chunk=0, spec_decode=False,
+            prefix_cache=False, progress_meta=progress))
+
+    def lap(eng):
+        t0 = time.perf_counter()
+        futs = [eng.submit(p) for p in prompts]
+        for f in futs:
+            f.result(120)
+        return time.perf_counter() - t0
+
+    eng_on, eng_off = mk(True), mk(False)
+    try:
+        lap(eng_on), lap(eng_off)  # untimed: settle compiles per arm
+        on, off = [], []
+        for _ in range(trials):
+            off.append(lap(eng_off))
+            on.append(lap(eng_on))
+    finally:
+        eng_on.close()
+        eng_off.close()
+    ratios = [a / b for a, b in zip(on, off)]
+    overhead_pct = (statistics.median(ratios) - 1.0) * 100.0
+    return {
+        "phase": "progress_meta_overhead",
+        "requests": len(prompts), "max_new_tokens": 32, "trials": trials,
+        "wall_ms_median_on": round(statistics.median(on) * 1e3, 2),
+        "wall_ms_median_off": round(statistics.median(off) * 1e3, 2),
+        "overhead_pct": round(overhead_pct, 2),
+        "bar_pct": 1.0, "pass": bool(overhead_pct < 1.0),
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="small MLP, fewer trials (CPU-sized)")
+    ap.add_argument("--failover-quick", action="store_true",
+                    help="ISSUE 20 failover bars only: warm-vs-cold "
+                         "recovery TTFT + progress-meta overhead A/B")
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--trials", type=int, default=None)
     args = ap.parse_args(argv)
@@ -178,6 +308,22 @@ def main(argv=None):
     from bigdl_tpu import obs
 
     obs.set_observability(metrics=True, compile_monitor=True)
+
+    if args.failover_quick:
+        cc.set_cache_dir(tempfile.mkdtemp(prefix="bench_failover_"))
+        meta = {"platform": platform, "model": "transformer-lm-tiny"}
+        rows = []
+        for row in (run_failover_recovery(quick=True),
+                    run_progress_overhead(quick=True)):
+            rows.append({**meta, **row})
+            print(json.dumps(rows[-1]), flush=True)
+        out = os.path.join(os.path.dirname(__file__), "results",
+                           "failover_quick.json")
+        with open(out, "w") as f:
+            json.dump(rows, f, indent=1)
+        print(f"# wrote {out}")
+        return 0 if all(r["pass"] for r in rows) else 1
+
     # cache on for the A/B phase too: the routed arm's replica warms
     # from the live layer instead of re-tracing what the direct arm's
     # runtime already compiled (fleets run with the cache on)

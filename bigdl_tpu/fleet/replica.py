@@ -68,8 +68,14 @@ class Replica:
             self._fwd_cid = "cid" in params or any(
                 p.kind is inspect.Parameter.VAR_KEYWORD
                 for p in params.values())
+            # failover resume only goes to runtimes that NAME the param
+            # (no VAR_KEYWORD fallback: a progress dict surprising a
+            # duck-typed backend's **kw would fail inside the runtime,
+            # after the budget was burned)
+            self._fwd_resume = "resume" in params
         except (TypeError, ValueError):  # pragma: no cover - C callables
             self._fwd_cid = False
+            self._fwd_resume = False
 
     # -- dispatch path (router's dispatcher thread) -------------------------
 
@@ -81,19 +87,24 @@ class Replica:
             return len(self._outstanding)
 
     def submit(self, x, deadline_ms: Optional[float],
-               cid: Optional[str] = None) -> _Future:
+               cid: Optional[str] = None,
+               resume: Optional[dict] = None) -> _Future:
         """Route one request into the backing runtime.  Raises
         `ReplicaDead` if the replica is no longer READY (the dispatcher
         rechecks, but kill can win the race) and lets the runtime's own
-        admission errors (`Rejected`, `ServingClosed`) propagate."""
+        admission errors (`Rejected`, `ServingClosed`) propagate.
+        `resume` is a dead peer's progress snapshot; it reaches only
+        runtimes whose submit() names the param — others recompute from
+        scratch (at-least-once semantics are unchanged)."""
         with self._lock:
             if self.state != READY:
                 raise ReplicaDead(f"replica {self.name!r} is {self.state}")
+            kw = {}
             if cid is not None and self._fwd_cid:
-                inner = self.runtime.submit(x, deadline_ms=deadline_ms,
-                                            cid=cid)
-            else:
-                inner = self.runtime.submit(x, deadline_ms=deadline_ms)
+                kw["cid"] = cid
+            if resume is not None and self._fwd_resume:
+                kw["resume"] = resume
+            inner = self.runtime.submit(x, deadline_ms=deadline_ms, **kw)
             self._outstanding.add(inner)
             self._idle.clear()
         inner.add_done_callback(self._forget)
@@ -164,8 +175,27 @@ class GenerationAdapter:
         self.config = getattr(engine, "config", None)
 
     def submit(self, x, deadline_ms: Optional[float] = None,
-               cid: Optional[str] = None) -> _Future:
-        return self.engine.submit(x, cid=cid, **self.submit_kw)
+               cid: Optional[str] = None,
+               resume: Optional[dict] = None) -> _Future:
+        kw = dict(self.submit_kw)
+        if resume is not None and resume.get("tokens"):
+            tokens = resume["tokens"]
+            cfg = self.config
+            n_eff = len(x) + len(tokens) if hasattr(x, "__len__") else None
+            if (cfg is not None and n_eff is not None
+                    and not getattr(cfg, "prefill_chunk", 0)
+                    and n_eff > cfg.buckets[-1]):
+                # the effective prompt (prompt + salvaged tokens) would
+                # not fit any bucket on an unchunked engine: drop the
+                # snapshot and recompute cold rather than bounce the
+                # request off admission — the original prompt fit, so
+                # this always dispatches
+                pass
+            else:
+                kw["resume_tokens"] = tokens
+                if resume.get("rng_uid") is not None:
+                    kw["rng_uid"] = resume["rng_uid"]
+        return self.engine.submit(x, cid=cid, **kw)
 
     def close(self, drain: bool = True, timeout: Optional[float] = 30.0) -> None:
         self.engine.close(drain=drain, timeout=timeout)

@@ -23,6 +23,16 @@ Failure semantics — the part worth being precise about:
   * Runtime backpressure (inner queue full) requeues without burning
     redispatch budget; replica loss burns budget (`max_redispatch`,
     then a loud `Rejected`).
+  * A GENERATION request bounced off a dead replica salvages the
+    victim's `gen_progress` snapshot (emitted tokens + sampling-stream
+    id, published in the inner future's meta at every settle-safe
+    boundary) and re-admits with it: the survivor treats the salvaged
+    tokens as prompt tail (prefix-warm prefill when the store is hot)
+    and continues the same RNG stream — zero lost tokens, and
+    exactly-once emission because the outer future settles once with
+    the FULL token list.  `min_recovery_ms` optionally fails
+    interactive requests fast when the remaining deadline cannot cover
+    a recovery (docs/fleet.md, "Failure semantics").
 
 The dead replica's runtime is torn down on a `fleet-reaper-*` thread —
 never on the dispatcher (a stuck XLA teardown must not stall dispatch).
@@ -77,12 +87,20 @@ class FleetRouter:
                  quantum_rows: float = 8.0,
                  max_redispatch: int = 5,
                  max_inflight_per_replica: int = 64,
+                 min_recovery_ms: float = 0.0,
                  name: str = "fleet"):
         self.name = name
         self._factory = replica_factory
         self._scheduler = FairShareScheduler(quantum_rows=quantum_rows)
         self._max_redispatch = int(max_redispatch)
         self._max_inflight = int(max_inflight_per_replica)
+        # deadline-aware recovery admission: an INTERACTIVE-tier request
+        # bounced off a dead replica with less than this much deadline
+        # left is failed loudly (Rejected) instead of redispatched — a
+        # recovery that cannot possibly land inside the SLO is a zombie
+        # retry burning survivor capacity.  0 = resume whenever the
+        # deadline has not already passed (queue expiry still applies).
+        self._min_recovery_ms = float(min_recovery_ms)
         self._tenants: Dict[str, TenantQueue] = {}
         self._replicas: List[Replica] = []
         self._replica_seq = 0
@@ -362,7 +380,7 @@ class FleetRouter:
         now = time.perf_counter()
         try:
             inner = replica.submit(req.x, deadline_ms=req.remaining_ms(now),
-                                   cid=req.cid)
+                                   cid=req.cid, resume=req.resume)
         except ReplicaDead:
             self._requeue(req, replica, burn_budget=True)
             return
@@ -418,7 +436,7 @@ class FleetRouter:
         lost = isinstance(err, ReplicaDead) or (
             isinstance(err, ServingClosed) and replica.state != READY)
         if lost:
-            self._requeue(req, replica, burn_budget=True)
+            self._requeue(req, replica, burn_budget=True, fut=fut)
             return
         if isinstance(err, DeadlineExceeded):
             with self._lock:
@@ -448,6 +466,14 @@ class FleetRouter:
         req.future.meta.update({"tenant": req.tenant, "replica": replica.name,
                                 "cid": req.cid, "fleet_cid": req.cid,
                                 "attempts": req.attempts + 1})
+        if fut.meta.get("recovered"):
+            # tenant-labeled mirror of the engine-side recovery counters
+            # (each engine's GenerationMetrics is per-replica, unlabeled)
+            reg = _obs.registry()
+            reg.inc(f"fleet/recovered_requests|tenant={req.tenant}")
+            if fut.meta.get("recovery_prefix_tokens"):
+                reg.inc("generation/recovery_prefix_hits"
+                        f"|tenant={req.tenant}")
         _obs.instant("fleet.complete", cat="fleet", cid=req.cid,
                      tenant=req.tenant, replica=replica.name,
                      attempts=req.attempts + 1)
@@ -459,11 +485,37 @@ class FleetRouter:
         req.future.set_error(err)
 
     def _requeue(self, req: FleetRequest, replica: Replica,
-                 burn_budget: bool) -> None:
+                 burn_budget: bool, fut: Optional[_Future] = None) -> None:
         """Put a bounced request back at the head of its tenant queue.
-        Replica loss burns redispatch budget; backpressure does not."""
+        Replica loss burns redispatch budget; backpressure does not.
+
+        On replica loss, the dead replica's inner future (`fut`) may
+        carry a `gen_progress` snapshot in its meta — the tokens the
+        victim emitted up to its last settle-safe boundary, plus the
+        request's sampling-stream id.  Salvage it into `req.resume` so
+        the next dispatch warm-resumes instead of recomputing; a
+        snapshot never goes backwards (a stale retry cannot shrink an
+        earlier, larger salvage)."""
         if burn_budget:
             req.attempts += 1
+            salvaged = 0
+            if fut is not None:
+                gp = fut.meta.get("gen_progress")
+                if gp and gp.get("tokens"):
+                    prev = req.resume.get("tokens") if req.resume else ()
+                    if len(gp["tokens"]) > len(prev or ()):
+                        req.resume = gp
+                        salvaged = len(gp["tokens"])
+            reg = _obs.registry()
+            reg.inc("fleet/failovers")
+            reg.inc(f"fleet/failovers|tenant={req.tenant}")
+            if salvaged:
+                reg.inc("fleet/resumed_tokens", salvaged)
+                reg.inc(f"fleet/resumed_tokens|tenant={req.tenant}",
+                        salvaged)
+            _obs.instant("fleet.failover", cat="fleet", cid=req.cid,
+                         tenant=req.tenant, from_replica=replica.name,
+                         attempt=req.attempts, resumed_tokens=salvaged)
             if req.attempts >= self._max_redispatch:
                 with self._lock:
                     q = self._tenants.get(req.tenant)
@@ -476,6 +528,25 @@ class FleetRouter:
                     f"request lost its replica {req.attempts} times "
                     "(fleet redispatch budget exhausted)"))
                 return
+            with self._lock:
+                q = self._tenants.get(req.tenant)
+            if (self._min_recovery_ms > 0 and q is not None
+                    and q.config.tier == "interactive"):
+                rem = req.remaining_ms(time.perf_counter())
+                if rem is not None and rem < self._min_recovery_ms:
+                    # the remaining deadline cannot cover recovery:
+                    # fail LOUDLY now instead of a zombie retry that
+                    # burns survivor capacity only to expire anyway
+                    q.metrics.on_reject("deadline")
+                    _obs.flight_notify("fleet.recovery_rejected",
+                                       tenant=req.tenant, cid=req.cid,
+                                       remaining_ms=round(rem, 1),
+                                       min_recovery_ms=self._min_recovery_ms)
+                    self._fail(req, Rejected(
+                        f"replica died with {rem:.0f} ms of deadline left "
+                        f"(< min_recovery_ms={self._min_recovery_ms:.0f}); "
+                        "recovery cannot meet the interactive SLO"))
+                    return
             _obs.registry().inc("fleet/redispatched")
             _obs.registry().inc(f"fleet/redispatches|tenant={req.tenant}")
             _obs.instant("fleet.redispatch", cat="fleet", cid=req.cid,
@@ -509,6 +580,8 @@ class FleetRouter:
             "dispatched": dispatched,
             "redispatched": reg.get("fleet/redispatched"),
             "replica_kills": reg.get("fleet/replica_kills"),
+            "failovers": reg.get("fleet/failovers"),
+            "resumed_tokens": reg.get("fleet/resumed_tokens"),
             "warmup_reused": reg.get("fleet/warmup_reused"),
         }
 

@@ -89,7 +89,7 @@ class FleetRequest:
     """
 
     __slots__ = ("tenant", "x", "rows", "future", "deadline", "t_enqueue",
-                 "t_dispatch", "cid", "attempts")
+                 "t_dispatch", "cid", "attempts", "resume")
 
     def __init__(self, tenant: str, x, rows: int,
                  deadline: Optional[float]):
@@ -102,6 +102,11 @@ class FleetRequest:
         self.t_dispatch = self.t_enqueue  # updated per dispatch attempt
         self.cid = _obs.next_cid()
         self.attempts = 0
+        # failover progress: the dead replica's last settle-safe snapshot
+        # ({"tokens": [...], "rng_uid": int}, from the inner future's
+        # gen_progress meta), re-offered to the next replica so a
+        # generation resumes mid-stream instead of recomputing
+        self.resume: Optional[dict] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline

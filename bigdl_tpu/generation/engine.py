@@ -51,6 +51,7 @@ import logging
 import os
 import threading
 import time
+import zlib
 from collections import deque
 from contextlib import nullcontext
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
@@ -67,7 +68,10 @@ from bigdl_tpu.generation.pagedkv import (DEFAULT_BLOCK_SIZE, BlockPool,
                                           PagedKVCache, blocks_for)
 from bigdl_tpu.generation.pagedkv import slot_view as _paged_slot_view
 from bigdl_tpu.generation.prefixcache import PrefixStore, world_key
-from bigdl_tpu.generation.sampling import sample_tokens, spec_accept
+from bigdl_tpu.generation.sampling import (request_key, request_keys,
+                                           sample_tokens,
+                                           sample_tokens_per_slot,
+                                           spec_accept)
 from bigdl_tpu.serving.batcher import Rejected, ServingClosed, _Future
 from bigdl_tpu.serving.metrics import GenerationMetrics
 from bigdl_tpu.serving.registry import ModelRegistry, ModelVersion
@@ -143,7 +147,8 @@ class GenerationConfig:
                  spec_decode: Optional[bool] = None, spec_k: int = 4,
                  prefix_cache: Optional[bool] = None,
                  prefix_cache_bytes: Optional[int] = None,
-                 prefix_cache_max_blocks: Optional[int] = None):
+                 prefix_cache_max_blocks: Optional[int] = None,
+                 progress_meta: Optional[bool] = None):
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         if not self.buckets or self.buckets[0] < 2:
             raise ValueError(f"length buckets must be >= 2, got {buckets}")
@@ -257,6 +262,17 @@ class GenerationConfig:
                     f"({self.prefill_chunk}) divisible by kv_block_size "
                     f"({self.kv_block_size}) so chunk boundaries land on "
                     "block boundaries")
+        if progress_meta is None:
+            # emitted-token progress snapshots in future.meta (the fleet
+            # failover resume source) ship ON: host-side dict writes per
+            # settle-safe boundary, measured <=1% on the bench_fleet
+            # --failover-quick interleaved A/B.  BIGDL_TPU_GEN_PROGRESS=0
+            # turns them off (and fleet recovery degrades to a cold
+            # full-recompute redispatch).
+            progress_meta = os.environ.get(
+                "BIGDL_TPU_GEN_PROGRESS", "1").strip().lower() \
+                not in ("0", "off", "false", "no")
+        self.progress_meta = bool(progress_meta)
         self.spec_decode = bool(spec_decode)
         if self.spec_decode:
             if self.spec_k < 1:
@@ -287,8 +303,16 @@ class _SlotState:
 
     def __init__(self, req):
         self.req = req
-        self.tokens: List[int] = []  # generated ids, streamed back per step
-        self.generated = 0
+        # generated ids, streamed back per step.  A resumed request's
+        # slot starts with the victim's emitted tokens already in the
+        # list (they sit at the tail of the effective prompt), so the
+        # settled result always carries the FULL emission — exactly-once
+        # delivery is structural: one single-assignment future, one
+        # complete list, set once.
+        self.tokens: List[int] = [
+            int(t) for t in req.prompt[req.prompt.size - req.resume_n:]
+        ] if req.resume_n else []
+        self.generated = req.resume_n
         self.t_first: Optional[float] = None
         self.step_ms_sum = 0.0
 
@@ -337,10 +361,17 @@ def _chunk_schedule(n: int, ch: int) -> "List[Tuple[int, int]]":
 
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "temperature", "eos_id", "future",
-                 "t_submit", "cid", "uid")
+                 "t_submit", "cid", "uid", "rng_uid", "resume_n",
+                 "hit_tokens")
 
     def __init__(self, prompt, max_new, temperature, eos_id, uid,
-                 cid=None):
+                 cid=None, rng_uid=None, resume_n=0):
+        # `prompt` is the EFFECTIVE prompt: original prompt + any tokens
+        # resumed from a dead replica's progress snapshot (resume_n of
+        # them, at the tail).  All admission machinery — bucket pick,
+        # chunk schedule, prefix lookup/publish — operates on it
+        # unchanged; only sampling indices and result meta distinguish
+        # resumed tokens from prompt tokens.
         self.prompt = prompt
         self.max_new = max_new
         self.temperature = temperature
@@ -350,7 +381,16 @@ class _GenRequest:
         # fleet-routed prompts carry the router's cid so one id spans
         # replicas; direct submits mint a fresh one
         self.cid = cid if cid is not None else _obs.next_cid()
-        self.uid = uid  # per-engine request index; folds the sampling rng
+        self.uid = uid  # per-engine request index (admission ordering)
+        # the sampling stream id: derived from the cid by default so a
+        # request redispatched across replicas (same cid) keeps its
+        # stream — sampled output is bitwise resumable given the same
+        # engine seed.  Distinct requests get distinct cids, hence
+        # distinct streams.
+        self.rng_uid = int(rng_uid) if rng_uid is not None \
+            else zlib.crc32(self.cid.encode()) & 0x7FFFFFFF
+        self.resume_n = int(resume_n)
+        self.hit_tokens = 0  # prefix-store tokens mapped at admission
 
 
 class _Lane:
@@ -403,6 +443,11 @@ class _Lane:
         self.last_np = np.zeros((slots, 1), np.int32)
         self.temps_np = np.zeros((slots,), np.float32)
         self.active_np = np.zeros((slots,), bool)
+        # per-slot sampling stream: rng_uid + next generated index (the
+        # decode executable folds both per row, so sampled sequences are
+        # slot- and interleaving-independent — resumable across replicas)
+        self.uids_np = np.zeros((slots,), np.int32)
+        self.gens_np = np.zeros((slots,), np.int32)
 
     @property
     def n_active(self) -> int:
@@ -460,6 +505,8 @@ class GenerationEngine:
         self._export_step = 0
         self._uid_counter = 0
         self._steps = 0
+        self._chunk_folds = 0  # cumulative prefill-chunk executions
+        self._step_hook = None  # chaos: fn(kind, count), see set_step_hook
         self._strict = strict_transfers_enabled(self.config.strict_transfers)
         self._chunk_on = self.config.prefill_chunk > 0
         if self.config.spec_decode and draft_model is None:
@@ -593,11 +640,13 @@ class GenerationEngine:
 
         def ring_prefill_for(model):
             def prefill_ring(params, cache, tokens, n, slot, temp, seed,
-                             uid):
+                             uid, gen0):
                 # fresh single-slot cache at the lane's capacity; fold the
-                # prompt in, sample token #1 from the last REAL row, then
-                # write the slot — all one executable per bucket, so slot
-                # claim costs no extra compile
+                # prompt in, sample the first GENERATED token (index gen0
+                # of the request's rng stream: 0 normally, the resumed
+                # count after a failover re-admission) from the last REAL
+                # row, then write the slot — all one executable per
+                # bucket, so slot claim costs no extra compile
                 L, _, C, H, D = cache.k.shape
                 quant = cache.k_scale is not None
                 fresh = KVCache(
@@ -611,13 +660,14 @@ class GenerationEngine:
                 logp, fresh = model.apply_cached(params, tokens, fresh)
                 last = jax.lax.dynamic_slice_in_dim(logp, n - 1, 1,
                                                     axis=1)[:, 0]
-                key = jax.random.fold_in(jax.random.PRNGKey(seed), uid)
+                key = request_key(seed, uid, gen0)
                 tok = sample_tokens(last, key, temp, top_k=top_k)
                 ok = jnp.isfinite(last).all()
                 return tok, insert(cache, slot, fresh, n), ok
             return prefill_ring
 
-        def prefill_paged(params, cache, tokens, n, slot, temp, seed, uid):
+        def prefill_paged(params, cache, tokens, n, slot, temp, seed, uid,
+                          gen0):
             # no fresh buffer + insert here: the slot's table row is
             # sliced out and the prompt's K/V stream STRAIGHT into the
             # claimed pool blocks (pad positions past the claimed prefix
@@ -629,7 +679,7 @@ class GenerationEngine:
                                k_scale=cache.k_scale, v_scale=cache.v_scale)
             logp, sub = m.apply_cached(params, tokens, sub)
             last = jax.lax.dynamic_slice_in_dim(logp, n - 1, 1, axis=1)[:, 0]
-            key = jax.random.fold_in(jax.random.PRNGKey(seed), uid)
+            key = request_key(seed, uid, gen0)
             tok = sample_tokens(last, key, temp, top_k=top_k)
             ok = jnp.isfinite(last).all()
             new = cache._replace(
@@ -641,7 +691,7 @@ class GenerationEngine:
 
         def ring_chunk_for(model):
             def chunk_ring(params, cache, tokens, n_valid, progress, slot,
-                           temp, seed, uid):
+                           temp, seed, uid, gen0):
                 # fold ONE chunk against the slot's accumulated prefix:
                 # slice the slot out at its current progress, append with
                 # the wrap-safe mask (a prompt longer than the ring slides
@@ -650,27 +700,27 @@ class GenerationEngine:
                 # adds ZERO executables beyond swapping prefill for
                 # prefill_chunk.  The final chunk's last row is bitwise
                 # the unchunked prefill's last row (chunk-parity tests),
-                # and the SAME fold_in(seed, uid) key samples from it, so
-                # token #1 is bitwise chunking-invariant.
+                # and the SAME request_key(seed, uid, gen0) samples from
+                # it, so token #1 is bitwise chunking-invariant.
                 sub = _ring_slot_view(cache, slot, progress)
                 logp, sub = model.apply_cached(params, tokens, sub,
                                                wrapped_append=True)
                 last = jax.lax.dynamic_slice_in_dim(logp, n_valid - 1, 1,
                                                     axis=1)[:, 0]
-                key = jax.random.fold_in(jax.random.PRNGKey(seed), uid)
+                key = request_key(seed, uid, gen0)
                 tok = sample_tokens(last, key, temp, top_k=top_k)
                 ok = jnp.isfinite(last).all()
                 return tok, insert(cache, slot, sub, progress + n_valid), ok
             return chunk_ring
 
         def chunk_paged(params, cache, tokens, n_valid, progress, slot,
-                        temp, seed, uid):
+                        temp, seed, uid, gen0):
             sub = _paged_slot_view(cache, slot, progress)
             logp, sub = m.apply_cached(params, tokens, sub,
                                        wrapped_append=True)
             last = jax.lax.dynamic_slice_in_dim(logp, n_valid - 1, 1,
                                                 axis=1)[:, 0]
-            key = jax.random.fold_in(jax.random.PRNGKey(seed), uid)
+            key = request_key(seed, uid, gen0)
             tok = sample_tokens(last, key, temp, top_k=top_k)
             ok = jnp.isfinite(last).all()
             new = cache._replace(
@@ -682,11 +732,19 @@ class GenerationEngine:
         chunk = jax.jit(chunk_paged if paged else ring_chunk_for(m)) \
             if self._chunk_on else None
 
-        def decode(params, cache, last_tokens, temps, active, step, seed):
+        def decode(params, cache, last_tokens, temps, active, uids, gens,
+                   seed):
+            # per-row keys over (rng_uid, generated index) — NOT the
+            # engine's global step: a request's sampled sequence is then
+            # a pure function of (seed, rng_uid, index), invariant to
+            # slot placement and batch interleaving, which is what makes
+            # mid-stream failover token-for-token resumable on another
+            # engine with the same seed
             logp, new = m.apply_cached(params, last_tokens, cache)
             logits = logp[:, 0]
-            key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
-            toks = sample_tokens(logits, key, temps, top_k=top_k)
+            toks = sample_tokens_per_slot(logits,
+                                          request_keys(seed, uids, gens),
+                                          temps, top_k=top_k)
             # free/parked slots still flow through the fixed-shape step;
             # only ACTIVE slots advance their ring position
             lengths = jnp.where(active, new.lengths, cache.lengths)
@@ -771,14 +829,17 @@ class GenerationEngine:
             ch = self.config.chunk_for(c)
             args["prefill_chunk"] = (params, throwaway) + jax.device_put(
                 (np.zeros((1, ch), np.int32), np.int32(1), np.int32(0),
-                 np.int32(0), np.zeros((1,), np.float32), seed, np.int32(0)))
+                 np.int32(0), np.zeros((1,), np.float32), seed, np.int32(0),
+                 np.int32(0)))
         else:
             args["prefill"] = (params, throwaway) + jax.device_put(
                 (np.zeros((1, c), np.int32), np.int32(1), np.int32(0),
-                 np.zeros((1,), np.float32), seed, np.int32(0)))
+                 np.zeros((1,), np.float32), seed, np.int32(0),
+                 np.int32(0)))
         args["decode"] = (params, throwaway) + jax.device_put(
             (np.zeros((s, 1), np.int32), np.zeros((s,), np.float32),
-             np.zeros((s,), bool), np.int32(0), seed))
+             np.zeros((s,), bool), np.zeros((s,), np.int32),
+             np.zeros((s,), np.int32), seed))
         if self._spec_on:
             args["verify"] = (params, throwaway) + jax.device_put(
                 (np.zeros((s,), np.int32), np.zeros((s, 1), np.int32))) + (
@@ -793,11 +854,12 @@ class GenerationEngine:
                 args["draft_chunk"] = (dp, dthrow) + jax.device_put(
                     (np.zeros((1, ch), np.int32), np.int32(1), np.int32(0),
                      np.int32(0), np.zeros((1,), np.float32), seed,
-                     np.int32(0)))
+                     np.int32(0), np.int32(0)))
             else:
                 args["draft_prefill"] = (dp, dthrow) + jax.device_put(
                     (np.zeros((1, c), np.int32), np.int32(1), np.int32(0),
-                     np.zeros((1,), np.float32), seed, np.int32(0)))
+                     np.zeros((1,), np.float32), seed, np.int32(0),
+                     np.int32(0)))
             args["draft_step"] = (dp, dthrow) + jax.device_put(
                 (np.zeros((s, 1), np.int32), np.zeros((s,), np.int32))) + (
                 self._toks0, self._q0, self._i_dev[0]) + jax.device_put(
@@ -998,24 +1060,52 @@ class GenerationEngine:
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
                temperature: Optional[float] = None,
                eos_id: Optional[int] = None,
-               cid: Optional[str] = None) -> _Future:
+               cid: Optional[str] = None,
+               resume_tokens=None,
+               rng_uid: Optional[int] = None) -> _Future:
         """Async admission: returns a future resolving to a
-        `GenerationResult` (`.result(timeout=...)`)."""
+        `GenerationResult` (`.result(timeout=...)`).
+
+        `resume_tokens` re-admits a request that already emitted tokens
+        on a replica that died (the fleet failover path): they fold as
+        part of the EFFECTIVE prompt — a chunk-skipping warm prefill
+        when the prefix store holds the prompt head — and generation
+        continues at sampling index `len(resume_tokens)` of the
+        request's rng stream (`rng_uid`, defaulting to a digest of the
+        cid so victim and survivor derive the same stream).  The result
+        contains the FULL token list, resumed + new, so settle-side
+        dedup is structural: one future, one list, set once."""
         toks = np.asarray(prompt, np.int32).reshape(-1)
         if toks.size < 1:
             raise ValueError("empty prompt")
-        if toks.size > self.config.buckets[-1] and not self._chunk_on:
-            # with chunked prefill on, a longer prompt folds through the
-            # largest bucket chunk by chunk (sliding window past C)
-            raise ValueError(
-                f"prompt of {toks.size} tokens exceeds the largest length "
-                f"bucket {self.config.buckets[-1]}; truncate or configure "
-                "a larger bucket")
+        resume = np.asarray(
+            resume_tokens if resume_tokens is not None else [],
+            np.int32).reshape(-1)
         max_new = max(1, int(self.config.max_new_tokens
                              if max_new_tokens is None else max_new_tokens))
         temp = float(self.config.temperature
                      if temperature is None else temperature)
         eos = self.config.eos_id if eos_id is None else eos_id
+        if resume.size:
+            done = None
+            if eos is not None and int(eos) in resume:
+                # the victim emitted EOS but died before (or while)
+                # settling: the request is already complete — settle
+                # from the snapshot, refolding nothing
+                resume = resume[:int(np.argmax(resume == int(eos))) + 1]
+                done = "eos"
+            elif resume.size >= max_new:
+                done = "length"
+            if done is not None:
+                return self._settle_resumed(toks, resume, done, cid, temp)
+        eff = np.concatenate([toks, resume]) if resume.size else toks
+        if eff.size > self.config.buckets[-1] and not self._chunk_on:
+            # with chunked prefill on, a longer prompt folds through the
+            # largest bucket chunk by chunk (sliding window past C)
+            raise ValueError(
+                f"prompt of {eff.size} tokens exceeds the largest length "
+                f"bucket {self.config.buckets[-1]}; truncate or configure "
+                "a larger bucket")
         with self._cond:
             if self._closed:
                 self.metrics.on_reject("shutdown")
@@ -1029,15 +1119,39 @@ class GenerationEngine:
                     "requests); backpressure — retry with backoff or raise "
                     "capacity")
             self._uid_counter += 1
-            req = _GenRequest(toks, max_new, temp, eos, self._uid_counter,
-                              cid=cid)
+            req = _GenRequest(eff, max_new, temp, eos, self._uid_counter,
+                              cid=cid, rng_uid=rng_uid,
+                              resume_n=int(resume.size))
             self._pending.append(req)
             depth = len(self._pending)
             self._cond.notify()
         self.metrics.on_admit(depth)
         _obs.instant("gen.admit", cat="generation", cid=req.cid,
-                     prompt_tokens=int(toks.size), depth=depth)
+                     prompt_tokens=int(toks.size), depth=depth,
+                     resumed=int(resume.size))
         return req.future
+
+    def _settle_resumed(self, prompt, resume, reason: str,
+                        cid: Optional[str], temp: float) -> _Future:
+        """A resumed request whose snapshot already finished (EOS emitted
+        or max_new reached before the kill): settle immediately with the
+        snapshot tokens — refolding would regenerate past the end."""
+        fut = _Future()
+        cid = cid if cid is not None else _obs.next_cid()
+        self.metrics.on_admit(0)
+        meta = {
+            "cid": cid, "version": self.registry.active_version,
+            "bucket": None, "finish_reason": reason,
+            "prompt_tokens": int(prompt.size), "tokens": int(resume.size),
+            "ttft_ms": 0.0, "ms_per_token": None,
+            "resumed_tokens": int(resume.size), "recovered": True,
+        }
+        self.metrics.on_complete(0.0, int(resume.size))
+        _obs.instant("gen.complete", cat="generation", cid=cid,
+                     tokens=int(resume.size), reason=reason, recovered=True)
+        fut.meta = meta
+        fut.set_result(GenerationResult(np.asarray(resume, np.int32), meta))
+        return fut
 
     def generate(self, prompt, timeout: Optional[float] = 120.0,
                  **kw) -> GenerationResult:
@@ -1052,7 +1166,12 @@ class GenerationEngine:
         window over the last C tokens).  Returns None when no eligible
         lane has a free slot (the request stays queued, FIFO)."""
         n = int(req.prompt.size)
-        fits = [b for b in self.config.buckets if b >= n + req.max_new]
+        # max_new counts TOTAL emission (resumed + new), and resumed
+        # tokens already sit inside the effective prompt — subtract them
+        # or a resumed request would double-count its own progress and
+        # get bumped into a needlessly large bucket
+        fits = [b for b in self.config.buckets
+                if b >= n + req.max_new - req.resume_n]
         wraps = [b for b in reversed(self.config.buckets) if b >= n]
         if not wraps and self._chunk_on:
             # longer than every bucket: chunked prefill folds the FULL
@@ -1078,7 +1197,8 @@ class GenerationEngine:
                     return  # every eligible slot busy; retry after decode
                 req = self._pending.popleft()
             n = int(req.prompt.size)
-            if lane.bucket < n + req.max_new:
+            rem = req.max_new - req.resume_n  # new tokens still to emit
+            if lane.bucket < n + rem:
                 if self._chunk_on and n > lane.bucket:
                     # a prompt longer than every bucket routes through
                     # chunking: the FULL prompt folds (sliding window past
@@ -1112,7 +1232,7 @@ class GenerationEngine:
                 # so the reservation covers them too
                 spec_extra = self.config.spec_k if self._spec_on else 0
                 need = blocks_for(
-                    min(lane.bucket, n + req.max_new + spec_extra),
+                    min(lane.bucket, n + rem + spec_extra),
                     self._pool.block_size)
                 if need > self._pool.n_allocatable:
                     req.future.set_error(Rejected(
@@ -1123,7 +1243,7 @@ class GenerationEngine:
                 store = self._prefix_store(snap)
                 if store is not None and sched is not None \
                         and len(sched) > 1 \
-                        and n + req.max_new + spec_extra <= lane.bucket:
+                        and n + rem + spec_extra <= lane.bucket:
                     # map the warm prefix read-only: resume the chunk
                     # schedule at the largest block-aligned offset the
                     # store's cached prefix covers.  The final chunk
@@ -1164,6 +1284,14 @@ class GenerationEngine:
                     return
             s = lane.free.pop()
             lane.spec_stale[s] = False
+            if self._spec_on and req.resume_n:
+                # speculative rounds key their draws on the engine's
+                # GLOBAL step counter, which the survivor does not share
+                # with the victim: a resumed sampled request would
+                # diverge from its stream.  Latch it onto the plain
+                # decode path, whose per-(rng_uid, index) keys make the
+                # continuation bitwise identical.
+                lane.spec_stale[s] = True
             if self._chunk_on:
                 # multi-chunk admission runs NO executable here: the slot
                 # parks in lane.prefilling and _advance_prefill folds one
@@ -1205,6 +1333,7 @@ class GenerationEngine:
                         # noise) — spec and shared prefixes meet only
                         # through private tail blocks
                         lane.spec_stale[s] = True
+                    req.hit_tokens = skip
                     self.metrics.on_prefix_hit(skip)
                     _obs.instant("gen.prefix_hit", cat="generation",
                                  cid=req.cid, tokens=skip,
@@ -1237,7 +1366,8 @@ class GenerationEngine:
                 args = jax.device_put(
                     (padded, np.int32(n), np.int32(s),
                      np.asarray([req.temperature], np.float32),
-                     np.int32(self.config.seed), np.int32(req.uid)))
+                     np.int32(self.config.seed), np.int32(req.rng_uid),
+                     np.int32(req.resume_n)))
                 tok, new_cache, ok = fn(
                     snap.params, self._lane_cache(lane), *args)
                 self._store_cache(lane, new_cache)
@@ -1268,9 +1398,16 @@ class GenerationEngine:
             if self.config.reject_nonfinite and not ok:
                 self._retire(lane, s, "error", tr)
                 continue
-            st.generated = 1
+            st.generated = req.resume_n + 1
+            if req.resume_n:
+                self.metrics.on_recovery((t1 - req.t_submit) * 1e3,
+                                         req.resume_n, req.hit_tokens)
+                _obs.instant("gen.recovered", cat="generation", cid=req.cid,
+                             resumed=req.resume_n,
+                             prefix_tokens=req.hit_tokens)
+            self._snap_progress(st)
             if (req.eos_id is not None and tok == req.eos_id) \
-                    or req.max_new <= 1:
+                    or st.generated >= req.max_new:
                 self._retire(lane, s,
                              "eos" if req.eos_id is not None
                              and tok == req.eos_id else "length", tr)
@@ -1334,7 +1471,8 @@ class GenerationEngine:
             args = jax.device_put(
                 (toks, np.int32(nv), np.int32(prog), np.int32(s),
                  np.asarray([req.temperature], np.float32),
-                 np.int32(self.config.seed), np.int32(req.uid)))
+                 np.int32(self.config.seed), np.int32(req.rng_uid),
+                 np.int32(req.resume_n)))
             tok, new_cache, ok = fn(
                 snap.params, self._lane_cache(lane), *args)
             self._store_cache(lane, new_cache)
@@ -1354,6 +1492,8 @@ class GenerationEngine:
         lane.lengths_np[s] = prog + nv
         ps.next_i += 1
         self.metrics.on_prefill_chunk()
+        self._chunk_folds += 1
+        self._fire_step_hook("prefill_chunk")
         if not final:
             return
         del lane.prefilling[s]
@@ -1375,7 +1515,7 @@ class GenerationEngine:
         if store is not None:
             spec_extra = self.config.spec_k if self._spec_on else 0
             npr = int(req.prompt.size)
-            if npr + req.max_new + spec_extra <= lane.bucket:
+            if npr + req.max_new - req.resume_n + spec_extra <= lane.bucket:
                 # offer the folded prompt's full blocks to the store
                 # (blocks whose address is already cached keep the
                 # existing entry; fresh ones get the store's own pin).
@@ -1383,9 +1523,15 @@ class GenerationEngine:
                 # rewritten by the sliding window.
                 if store.publish(req.prompt, npr, lane.claimed[s]):
                     self._update_kv_gauges()
-        st.generated = 1
+        st.generated = req.resume_n + 1
+        if req.resume_n:
+            self.metrics.on_recovery((t1 - req.t_submit) * 1e3,
+                                     req.resume_n, req.hit_tokens)
+            _obs.instant("gen.recovered", cat="generation", cid=req.cid,
+                         resumed=req.resume_n, prefix_tokens=req.hit_tokens)
+        self._snap_progress(st)
         if (req.eos_id is not None and tok == req.eos_id) \
-                or req.max_new <= 1:
+                or st.generated >= req.max_new:
             self._retire(lane, s,
                          "eos" if req.eos_id is not None
                          and tok == req.eos_id else "length", tr)
@@ -1501,10 +1647,12 @@ class GenerationEngine:
                     done = "length"
                     break
             lane.last_np[s, 0] = st.tokens[-1]
+            self._snap_progress(st)
             if done is not None:
                 self._retire(lane, s, done, tr)
         self.metrics.on_tokens(emitted_total, step_ms)
         self.metrics.on_spec_round(n_act * k, accepted, k + 1)
+        self._fire_step_hook("decode")
 
     def _decode_lane(self, lane: _Lane, snap: ModelVersion, tr) -> None:
         if self._spec_on and self._spec_ok(lane):
@@ -1541,10 +1689,18 @@ class GenerationEngine:
                 (mon.attribute(f"generation/decode/bucket={lane.bucket}")
                  if mon is not None else _NULL), \
                 strict_transfers(self._strict):
+            for s in range(self.config.slots):
+                st = lane.slots[s]
+                if st is not None and lane.active_np[s]:
+                    # per-slot sampling keys: each active request draws
+                    # token index `generated` of its own stream this step
+                    lane.uids_np[s] = st.req.rng_uid
+                    lane.gens_np[s] = st.generated
             toks, new_cache, ok = fn(
                 snap.params, self._lane_cache(lane), *jax.device_put(
                     (lane.last_np, lane.temps_np, lane.active_np,
-                     np.int32(self._steps), np.int32(self.config.seed))))
+                     lane.uids_np, lane.gens_np,
+                     np.int32(self.config.seed))))
             self._store_cache(lane, new_cache)
             toks_np = jax.device_get(toks)  # the ONE per-step host sync
             ok_np = jax.device_get(ok)
@@ -1570,10 +1726,12 @@ class GenerationEngine:
             st.tokens.append(tok)
             st.generated += 1
             st.step_ms_sum += step_ms
+            self._snap_progress(st)
             if st.req.eos_id is not None and tok == st.req.eos_id:
                 self._retire(lane, s, "eos", tr)
             elif st.generated >= st.req.max_new:
                 self._retire(lane, s, "length", tr)
+        self._fire_step_hook("decode")
 
     def _release_blocks(self, lane: _Lane, s: int) -> None:
         """Return a retired slot's pool blocks + reservation and point its
@@ -1590,6 +1748,42 @@ class GenerationEngine:
         lane._table_dirty = True
         lane.lengths_np[s] = 0
         self._update_kv_gauges()
+
+    def _snap_progress(self, st: _SlotState) -> None:
+        """Publish emitted-token progress into the future's meta at a
+        settle-safe boundary (after a step's tokens are appended, before
+        the next executable launches).  A fleet thread that catches
+        `ReplicaDead` reads `future.meta["gen_progress"]` to re-admit the
+        request on a survivor with zero token loss.  The snapshot is a
+        fresh dict + fresh list assigned in ONE dict-item store
+        (GIL-atomic), so a concurrent reader sees either this boundary or
+        an earlier complete one — never a torn list.  `rng_uid` rides
+        along so the survivor continues the exact sampling stream; the
+        token COUNT is the RNG state (keys fold (rng_uid, index))."""
+        if not self.config.progress_meta:
+            return
+        st.req.future.meta["gen_progress"] = {
+            "tokens": list(st.tokens), "rng_uid": st.req.rng_uid}
+
+    def set_step_hook(self, fn) -> None:
+        """Chaos instrumentation: arm `fn(kind, count)` to fire from the
+        engine thread after every decode step (`kind="decode"`, count =
+        cumulative steps) and every prefill-chunk fold
+        (`kind="prefill_chunk"`, count = cumulative folds) — each a
+        settle-safe boundary, so a hook that kills this replica models
+        the worst honest mid-stream death.  Pass None to disarm.  A
+        raising hook is disarmed, never fails the request."""
+        self._step_hook = fn
+
+    def _fire_step_hook(self, kind: str) -> None:
+        fn = self._step_hook
+        if fn is None:
+            return
+        try:
+            fn(kind, self._steps if kind == "decode" else self._chunk_folds)
+        except Exception:
+            _log.exception("generation step hook raised; disarmed")
+            self._step_hook = None
 
     def _retire(self, lane: _Lane, s: int, reason: str, tr) -> None:
         st = lane.slots[s]
@@ -1613,15 +1807,21 @@ class GenerationEngine:
             self.metrics.set_active(self._n_active())
             return
         n_gen = st.generated
+        n_new = n_gen - req.resume_n  # emitted on THIS engine
         tokens = st.tokens
         ttft_ms = (st.t_first - req.t_submit) * 1e3
         meta = {
             "cid": req.cid, "version": snap_version, "bucket": lane.bucket,
-            "finish_reason": reason, "prompt_tokens": int(req.prompt.size),
+            "finish_reason": reason,
+            "prompt_tokens": int(req.prompt.size) - req.resume_n,
             "tokens": n_gen, "ttft_ms": round(ttft_ms, 3),
-            "ms_per_token": round(st.step_ms_sum / max(1, n_gen - 1), 3)
-            if n_gen > 1 else None,
+            "ms_per_token": round(st.step_ms_sum / max(1, n_new - 1), 3)
+            if n_new > 1 else None,
         }
+        if req.resume_n:
+            meta["resumed_tokens"] = req.resume_n
+            meta["recovered"] = True
+            meta["recovery_prefix_tokens"] = req.hit_tokens
         self.metrics.on_complete((now - req.t_submit) * 1e3, n_gen)
         self.metrics.set_active(self._n_active())
         if tr is not None:

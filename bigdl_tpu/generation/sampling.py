@@ -43,6 +43,45 @@ def sample_tokens(logits: jax.Array, key: jax.Array,
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
 
+def request_key(seed, uid, gen) -> jax.Array:
+    """The per-token sampling key: fold the request's rng stream id
+    (`uid`) and the token's GENERATED INDEX (`gen`, 0 = the prefill
+    token) into the engine seed.  Keying on (uid, index) instead of the
+    engine's global step makes sampled decoding RESUMABLE: a request
+    re-admitted on another engine with the same seed/uid/index draws
+    bitwise the same tokens regardless of slot placement, batch
+    interleaving, or how many steps the new engine has run (the fleet
+    failover parity bar).  `jax.random.categorical` derives its gumbel
+    noise from the same counter stream for (1, V) and per-row (V,)
+    shapes, so the prefill draw at index g and a decode draw at index g
+    are bitwise interchangeable."""
+    return jax.random.fold_in(
+        jax.random.fold_in(jax.random.PRNGKey(seed), uid), gen)
+
+
+def request_keys(seed, uids: jax.Array, gens: jax.Array) -> jax.Array:
+    """Vectorized `request_key` over per-slot (B,) uid/index arrays."""
+    base = jax.random.PRNGKey(seed)
+    return jax.vmap(
+        lambda u, g: jax.random.fold_in(jax.random.fold_in(base, u), g)
+    )(uids, gens)
+
+
+def sample_tokens_per_slot(logits: jax.Array, keys: jax.Array,
+                           temperatures: jax.Array, *,
+                           top_k: int = 0) -> jax.Array:
+    """`sample_tokens` with an independent key PER ROW (B, 2) — the
+    decode executable's form: each slot draws from its own request
+    stream, so retirement/admission churn in the other slots never
+    perturbs a request's sampled sequence."""
+    greedy = jnp.argmax(logits, axis=-1)
+    temps = jnp.asarray(temperatures)
+    safe = jnp.where(temps > 0, temps, 1.0)[:, None]
+    masked = apply_top_k(logits, top_k) / safe
+    sampled = jax.vmap(jax.random.categorical)(keys, masked)
+    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+
 def adjusted_log_probs(logits: jax.Array, temperatures: jax.Array, *,
                        top_k: int = 0) -> jax.Array:
     """Log-probs of the distribution `sample_tokens` actually draws from:

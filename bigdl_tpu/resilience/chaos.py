@@ -270,23 +270,52 @@ class ReplicaKillFault:
     Deterministic like every fixture here: dispatch-count indexed, no
     wall clock, `fired` records what was killed for assertions.
     `n_kills` > 1 re-arms every `at_dispatch` dispatches after the
-    previous kill (a rolling failure, bounded so survivors remain)."""
+    previous kill (a rolling failure, bounded so survivors remain).
 
-    def __init__(self, at_dispatch: int = 1, *, name: Optional[str] = None,
-                 n_kills: int = 1):
-        if at_dispatch < 1:
+    Generation fleets can aim the kill INSIDE a request instead of at
+    the dispatch stream: `at_decode_step=n` (or `at_prefill_chunk=n`)
+    kills after the bound engine's n-th decode step (prefill-chunk
+    fold) — the mid-stream death the failover layer exists for.  Wire
+    it with `bind_engine(engine, router, replica_name)`; the engine's
+    step hook fires `on_engine_step` from the victim's own scheduler
+    thread at a settle-safe boundary (`kill_replica` only marks DEAD
+    and spawns a reaper, so killing from that thread cannot
+    deadlock)."""
+
+    def __init__(self, at_dispatch: Optional[int] = None, *,
+                 name: Optional[str] = None, n_kills: int = 1,
+                 at_decode_step: Optional[int] = None,
+                 at_prefill_chunk: Optional[int] = None):
+        if at_dispatch is None and at_decode_step is None \
+                and at_prefill_chunk is None:
+            at_dispatch = 1
+        if at_dispatch is not None and at_dispatch < 1:
             raise ValueError(f"at_dispatch must be >= 1, got {at_dispatch}")
-        self.at_dispatch = int(at_dispatch)
+        if at_decode_step is not None and at_decode_step < 1:
+            raise ValueError(
+                f"at_decode_step must be >= 1, got {at_decode_step}")
+        if at_prefill_chunk is not None and at_prefill_chunk < 1:
+            raise ValueError(
+                f"at_prefill_chunk must be >= 1, got {at_prefill_chunk}")
+        self.at_dispatch = int(at_dispatch) if at_dispatch is not None \
+            else None
+        self.at_decode_step = int(at_decode_step) \
+            if at_decode_step is not None else None
+        self.at_prefill_chunk = int(at_prefill_chunk) \
+            if at_prefill_chunk is not None else None
         self.name = name
         self.n_kills = int(n_kills)
         self.fired: list = []
         self._next_at = self.at_dispatch
+        self._router = None
 
     def on_step(self, step: int) -> None:
         """No-op: this fault rides the fleet dispatch stream, not the
         trainer step stream (compose() compatibility)."""
 
     def on_dispatch(self, n_dispatched: int, router) -> None:
+        if self.at_dispatch is None:
+            return  # engine-step targeted: on_engine_step pulls the trigger
         if len(self.fired) >= self.n_kills or n_dispatched < self._next_at:
             return
         if router.n_replicas() <= 1:
@@ -295,6 +324,32 @@ class ReplicaKillFault:
         if killed is not None:
             self.fired.append((n_dispatched, killed))
             self._next_at = n_dispatched + self.at_dispatch
+
+    def bind_engine(self, engine, router, replica_name: str) -> None:
+        """Arm the engine-indexed triggers on one generation engine:
+        kill `replica_name` off `router` after the engine's
+        `at_decode_step`-th decode step or `at_prefill_chunk`-th chunk
+        fold (whichever is configured; counts are cumulative per
+        engine).  The victim must be the engine's OWN replica — the
+        point is mid-stream death of in-flight work."""
+        self._router = router
+        if self.name is None:
+            self.name = replica_name
+        engine.set_step_hook(self.on_engine_step)
+
+    def on_engine_step(self, kind: str, count: int) -> None:
+        if len(self.fired) >= self.n_kills:
+            return
+        at = self.at_decode_step if kind == "decode" \
+            else self.at_prefill_chunk
+        if at is None or count < at:
+            return
+        router = self._router
+        if router is None or router.n_replicas() <= 1:
+            return
+        killed = router.kill_replica(self.name)
+        if killed is not None:
+            self.fired.append((f"{kind}:{count}", killed))
 
 
 def compose(*hooks) -> "_Composed":
@@ -315,6 +370,12 @@ class _Composed:
             fn = getattr(h, "on_dispatch", None)
             if fn is not None:
                 fn(n_dispatched, router)
+
+    def on_engine_step(self, kind: str, count: int) -> None:
+        for h in self.hooks:
+            fn = getattr(h, "on_engine_step", None)
+            if fn is not None:
+                fn(kind, count)
 
     def poison_code(self, step: int) -> int:
         """Fan in: first non-zero poison wins (composing two NaNInjectors

@@ -302,6 +302,13 @@ class GenerationMetrics:
         self.prefill_chunks = 0
         self.prefix_hits = 0
         self.prefix_tokens_reused = 0
+        # failover recovery: requests re-admitted with a dead replica's
+        # progress snapshot (resume_tokens), their restart latency, and
+        # how many rode a warm prefix instead of a cold recompute
+        self.recovery_ttft_ms = LatencyHistogram()
+        self.recoveries = 0
+        self.recovered_tokens = 0
+        self.recovery_prefix_hits = 0
         self.spec_rounds = 0
         self.draft_steps = 0
         self.draft_tokens_proposed = 0
@@ -371,6 +378,27 @@ class GenerationMetrics:
         reg.inc("generation/prefix_hits" + self._label)
         reg.inc("generation/prefix_tokens_reused" + self._label,
                 int(tokens_reused))
+
+    def on_recovery(self, ttft_ms: float, resumed_tokens: int,
+                    prefix_tokens: int) -> None:
+        """One resumed request reached its first NEW token on this engine
+        after a replica death: `ttft_ms` is submit-on-survivor to first
+        fresh token (the recovery-latency number the warm-prefix path
+        exists to shrink), `resumed_tokens` came from the victim's
+        progress snapshot, `prefix_tokens` of the effective prompt were
+        skipped via the prefix store (0 = cold recompute)."""
+        with self._lock:
+            self.recoveries += 1
+            self.recovered_tokens += int(resumed_tokens)
+            self.recovery_ttft_ms.observe(ttft_ms)
+            if prefix_tokens > 0:
+                self.recovery_prefix_hits += 1
+        reg = _obs.registry()
+        reg.inc("generation/recoveries" + self._label)
+        reg.inc("generation/recovered_tokens" + self._label,
+                int(resumed_tokens))
+        if prefix_tokens > 0:
+            reg.inc("generation/recovery_prefix_hits" + self._label)
 
     def on_spec_round(self, proposed: int, accepted: int,
                       draft_steps: int) -> None:
@@ -462,6 +490,14 @@ class GenerationMetrics:
                 "prefill_chunks": self.prefill_chunks,
                 "prefix_hits": self.prefix_hits,
                 "prefix_tokens_reused": self.prefix_tokens_reused,
+                "recoveries": self.recoveries,
+                "recovered_tokens": self.recovered_tokens,
+                "recovery_prefix_hits": self.recovery_prefix_hits,
+                "recovery_ttft_ms": {
+                    "count": self.recovery_ttft_ms.count,
+                    "p50": round(self.recovery_ttft_ms.percentile(50), 3),
+                    "p99": round(self.recovery_ttft_ms.percentile(99), 3),
+                },
                 "spec_rounds": self.spec_rounds,
                 "draft_steps": self.draft_steps,
                 "spec_accept_rate": round(
@@ -500,6 +536,11 @@ class GenerationMetrics:
             f"{prefix}/prefill_chunks": snap["prefill_chunks"],
             f"{prefix}/prefix_hits": snap["prefix_hits"],
             f"{prefix}/prefix_tokens_reused": snap["prefix_tokens_reused"],
+            f"{prefix}/recoveries": snap["recoveries"],
+            f"{prefix}/recovered_tokens": snap["recovered_tokens"],
+            f"{prefix}/recovery_prefix_hits": snap["recovery_prefix_hits"],
+            f"{prefix}/recovery_ttft_p99_ms":
+                snap["recovery_ttft_ms"]["p99"],
             f"{prefix}/spec_rounds": snap["spec_rounds"],
             f"{prefix}/draft_steps": snap["draft_steps"],
             f"{prefix}/spec_accept_rate": snap["spec_accept_rate"],

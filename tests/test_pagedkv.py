@@ -17,6 +17,7 @@ The parity bars, verified here:
 """
 
 import logging
+import threading
 import time
 
 import numpy as np
@@ -500,3 +501,77 @@ def test_config_env_gating(monkeypatch, lm):
     # explicit arg beats env; block-size divisibility is validated
     with pytest.raises(ValueError, match="divisible"):
         GenerationConfig(buckets=(20,), paged=True, kv_block_size=16)
+
+
+def test_block_pool_claim_lock_drop_race_no_double_claim():
+    """Regression for the claim() lock-drop window (PR-19): the
+    shortfall is computed under the pool lock, the reclaim hook runs
+    with the lock RELEASED, and the free-list pop happens after a
+    retake.  Concurrent release/claim traffic landing inside that
+    window must never hand the same block to two owners or leak one:
+    all handed-out id sets stay disjoint and the free list is exactly
+    restored after the releases.  The CI lockdep lane replays this
+    shape under BIGDL_TPU_LOCKDEP=1, which also checks the
+    store -> pool acquired-before order on the reclaim path."""
+    pool = BlockPool(n_layer=1, n_blocks=9, block_size=4, n_head=2,
+                     head_dim=4)  # 8 allocatable
+    held = pool.claim(8)  # exhaust the pool: any claim now has a shortfall
+    in_window = threading.Event()
+    resume = threading.Event()
+
+    def reclaim(n):
+        # the victim thread is parked in claim()'s lock-drop window
+        in_window.set()
+        assert resume.wait(10), "race partner never ran"
+        pool.release(held[:n])  # cover the shortfall, like the store's evict
+        return n
+
+    pool.set_reclaim(reclaim)
+    got = {}
+    t = threading.Thread(
+        target=lambda: got.__setitem__("victim", pool.claim(2)))
+    t.start()
+    assert in_window.wait(10)
+    # race the open window: release two DIFFERENT blocks and re-claim
+    # them from this thread while the victim is mid-claim
+    pool.release(held[2:4])
+    racer = pool.claim(2)  # shortfall 0: pops without touching the hook
+    resume.set()
+    t.join(10)
+    assert not t.is_alive()
+    victim = got["victim"]
+    still_held = held[4:]
+    owners = victim + racer + still_held
+    assert len(owners) == len(set(owners)), (
+        f"double-claimed block: victim={victim} racer={racer} "
+        f"held={still_held}")
+    assert all(pool.refcount(b) == 1 for b in owners)
+    pool.release(owners)
+    assert pool.blocks_free == 8, "leaked a block through the race window"
+
+
+def test_block_pool_claim_raises_loudly_when_window_is_stolen():
+    """If a concurrent claimer steals the blocks the reclaim hook just
+    freed before the victim retakes the lock, the victim must fail with
+    the explicit exhaustion RuntimeError — never allocate a block that
+    another owner already holds.  (The engine never hits this: claims
+    are reservation-covered and engine-thread-only; the invariant here
+    is pool-level.)"""
+    pool = BlockPool(n_layer=1, n_blocks=5, block_size=4, n_head=2,
+                     head_dim=4)  # 4 allocatable
+    held = pool.claim(4)
+    stolen = {}
+
+    def reclaim(n):
+        pool.release(held[:n])
+        stolen["ids"] = pool.claim(n)  # steal inside the window
+        return n
+
+    pool.set_reclaim(reclaim)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        pool.claim(2)
+    assert len(stolen["ids"]) == 2
+    assert all(pool.refcount(b) == 1 for b in stolen["ids"])
+    pool.release(stolen["ids"])
+    pool.release(held[2:])
+    assert pool.blocks_free == 4

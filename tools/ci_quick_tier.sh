@@ -36,6 +36,7 @@ if [ "$rc" -eq 0 ]; then
     timeout --signal=TERM "$remaining" python -m pytest \
         tests/test_resilience.py tests/test_health.py \
         tests/test_sharded_ckpt.py tests/test_elastic_reshard.py \
+        tests/test_failover.py \
         -m "chaos and not slow" -q
     rc=$?
     elapsed=$(( $(date +%s) - start ))
@@ -101,6 +102,20 @@ if [ "$rc" -eq 0 ]; then
     remaining=$(( BUDGET - elapsed ))
     [ "$remaining" -lt 30 ] && remaining=30
     timeout --signal=TERM "$remaining" python tools/fleet_smoke.py
+    rc=$?
+    elapsed=$(( $(date +%s) - start ))
+fi
+
+if [ "$rc" -eq 0 ]; then
+    # failover lane: a 2-replica GENERATION fleet over an oversubscribed
+    # paged pool — one request killed mid-decode must settle token-for-
+    # token identical to the unkilled run through a prefix-warm resume
+    # on the survivor, one killed mid-prefill-chunk must recompute cold
+    # with zero loss, and the incident must leave exactly one flight
+    # bundle, leak-free survivor pools, and zero steady-recompile alarms
+    remaining=$(( BUDGET - elapsed ))
+    [ "$remaining" -lt 30 ] && remaining=30
+    timeout --signal=TERM "$remaining" python tools/fleet_smoke.py --failover
     rc=$?
     elapsed=$(( $(date +%s) - start ))
 fi

@@ -16,7 +16,15 @@ mid-burst, and the assertions that make the fleet layer trustworthy:
   * per-tenant metrics: the Prometheus textfile export carries
     `{tenant="..."}` labeled series for both tenants.
 
-Usage: python tools/fleet_smoke.py
+`--failover` runs the ISSUE 20 acceptance scenario instead: a
+2-replica GENERATION fleet over an oversubscribed paged pool, one
+request killed mid-decode (token-for-token greedy parity with the
+unkilled run, resumed through the survivor's prefix-warm store) and a
+second killed mid-prefill-chunk (cold recompute, still zero loss),
+with exactly one flight bundle, a leak-free survivor pool, and zero
+steady-state recompile alarms.
+
+Usage: python tools/fleet_smoke.py [--failover]
 """
 
 import os
@@ -178,5 +186,166 @@ def main() -> int:
     return 0
 
 
+def failover_main() -> int:
+    """Zero-loss mid-stream failover lane (ISSUE 20 acceptance)."""
+    from bigdl_tpu.fleet import GenerationAdapter
+    from bigdl_tpu.generation import GenerationConfig, GenerationEngine
+    from bigdl_tpu.models.transformer import TransformerLM
+
+    outdir = tempfile.mkdtemp(prefix="fleet_failover_")
+    flight_dir = os.path.join(outdir, "flight")
+    obs.set_observability(metrics=True, tracing=True, compile_monitor=True,
+                          flight=True, flight_dir=flight_dir)
+    reg = obs.registry()
+    cc.set_cache_dir(os.path.join(outdir, "cc"))
+
+    model = TransformerLM(vocab_size=61, hidden_size=32, n_layer=2,
+                          n_head=4, max_len=256, use_flash=False)
+    params, _ = model.init((1, 16), rng=jax.random.PRNGKey(0))
+
+    max_new = 16
+    engines = {}
+
+    def factory(name):
+        # oversubscribed: 24 allocatable blocks < 2 slots x 16
+        # worst-case resident — recovery must ride the reservation
+        # accounting, not pool headroom
+        eng = GenerationEngine(
+            model, params,
+            config=GenerationConfig(
+                buckets=(64,), slots=2, max_new_tokens=max_new,
+                temperature=0.0, paged=True, kv_block_size=4,
+                kv_pool_blocks=25, prefill_chunk=16,
+                spec_decode=False, prefix_cache=True))
+        engines[name] = eng
+        return GenerationAdapter(eng)
+
+    router = FleetRouter(
+        factory, n_replicas=2, name="fo",
+        tenants=[TenantConfig("t", tier="batch", deadline_ms=120_000.0)])
+
+    rng = np.random.RandomState(11)
+    prompt = rng.randint(1, 61, size=40).astype(np.int32)  # 3 chunk folds
+
+    failures = []
+    try:
+        # warm both replicas' prefix stores with the prompt head, and
+        # take the unkilled greedy baseline off the first run
+        want = [int(t)
+                for t in engines["fo-r1"].generate(prompt, timeout=120).tokens]
+        warm2 = [int(t)
+                 for t in engines["fo-r2"].generate(prompt, timeout=120).tokens]
+        if warm2 != want:
+            failures.append("replicas disagree before any fault was injected")
+
+        # -- scenario A: kill the serving replica mid-decode ------------
+        fault_a = ReplicaKillFault(
+            at_decode_step=engines["fo-r1"]._steps + 6)
+        fault_a.bind_engine(engines["fo-r1"], router, "fo-r1")
+        fut = router.submit("t", prompt)
+        res = fut.result(120)
+        got = [int(t) for t in res.tokens]
+        if not fault_a.fired:
+            failures.append("mid-decode kill never fired")
+        if got != want:
+            failures.append(f"mid-decode failover diverged: want {want}, "
+                            f"got {got}")
+        if fut.meta.get("attempts") != 2:
+            failures.append(f"want 2 dispatch attempts, got "
+                            f"{fut.meta.get('attempts')}")
+        resumed = int(res.meta.get("resumed_tokens", 0))
+        if not res.meta.get("recovered") or resumed < 1:
+            failures.append(f"survivor did not resume mid-stream "
+                            f"(resumed_tokens={resumed})")
+        if int(res.meta.get("recovery_prefix_tokens", 0)) < 16:
+            failures.append(
+                "recovery prefill was cold: recovery_prefix_tokens="
+                f"{res.meta.get('recovery_prefix_tokens')} (store was warm)")
+        surv = engines["fo-r2"].metrics.snapshot()
+        if surv["recoveries"] < 1 or surv["recovery_ttft_ms"]["count"] < 1:
+            failures.append(f"survivor engine recorded no recovery: {surv}")
+
+        # -- scenario B: kill during a prefill chunk fold ----------------
+        router.add_replica()  # fo-r3, warmed from the compilecache
+        # drop r2's warm store so the next prefill folds cold through
+        # all three chunks — the kill must land MID-prefill, not on the
+        # single fold a chunk-skipping warm prefill needs
+        engines["fo-r2"].prefix_store.clear()
+        fault_b = ReplicaKillFault(
+            at_prefill_chunk=engines["fo-r2"]._chunk_folds + 2)
+        fault_b.bind_engine(engines["fo-r2"], router, "fo-r2")
+        fut_b = router.submit("t", prompt)
+        res_b = fut_b.result(120)
+        got_b = [int(t) for t in res_b.tokens]
+        if not fault_b.fired:
+            failures.append("mid-prefill kill never fired")
+        if got_b != want:
+            failures.append(f"mid-prefill failover diverged: want {want}, "
+                            f"got {got_b}")
+        if fut_b.meta.get("attempts") != 2:
+            failures.append(f"prefill-kill want 2 attempts, got "
+                            f"{fut_b.meta.get('attempts')}")
+
+        # -- fleet counters ---------------------------------------------
+        if reg.get("fleet/failovers|tenant=t") != 2:
+            failures.append(
+                f"want 2 tenant-labeled failovers, got "
+                f"{reg.get('fleet/failovers|tenant=t')}")
+        if reg.get("fleet/resumed_tokens|tenant=t") < 1:
+            failures.append("fleet/resumed_tokens never incremented")
+        if reg.get("generation/recovery_prefix_hits|tenant=t") < 1:
+            failures.append("no tenant-labeled recovery prefix hit")
+        if snapshotted := router.snapshot():
+            if snapshotted["warmup_reused"] <= 0:
+                failures.append("scale-out replica warmed nothing from "
+                                "the compilecache")
+
+        # -- leak-free survivor pools -----------------------------------
+        for name in router.replicas():
+            eng = engines[name]
+            eng.drain()
+            pool, store = eng._pool, eng.prefix_store
+            if pool.blocks_free + len(store) != pool.n_allocatable \
+                    or pool.blocks_reserved != 0:
+                failures.append(
+                    f"{name} pool leaked: free={pool.blocks_free} "
+                    f"store={len(store)} reserved={pool.blocks_reserved} "
+                    f"allocatable={pool.n_allocatable}")
+            store.clear()
+            if pool.blocks_free != pool.n_allocatable:
+                failures.append(f"{name} store clear() left blocks behind")
+    finally:
+        router.close(drain=False)
+
+    # -- exactly one flight bundle (two kills inside the per-reason
+    # cooldown collapse into one incident) ------------------------------
+    bundles = sorted(d for d in os.listdir(flight_dir)
+                     if "fleet_replica_death" in d) \
+        if os.path.isdir(flight_dir) else []
+    if len(bundles) != 1:
+        failures.append(f"want exactly 1 replica-death flight bundle, "
+                        f"got {bundles}")
+
+    steady = int(reg.get("compile/steady_recompiles"))
+    if steady:
+        failures.append(f"{steady} steady-state recompile alarm(s): the "
+                        "resume path changed the pinned executable set")
+
+    print(f"fleet_smoke --failover: kills={fault_a.fired + fault_b.fired} "
+          f"resumed_tokens={resumed} "
+          f"prefix_warm={res.meta.get('recovery_prefix_tokens')} "
+          f"failovers={int(reg.get('fleet/failovers'))} "
+          f"bundles={len(bundles)} steady_recompiles={steady}")
+    cc.reset()
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}")
+        return 1
+    print("OK: failover lane green (mid-decode parity, mid-prefill "
+          "parity, prefix-warm recovery, leak-free pools, one bundle, "
+          "zero steady recompiles)")
+    return 0
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(failover_main() if "--failover" in sys.argv else main())
